@@ -1,0 +1,34 @@
+"""presto_tpu: a TPU-native distributed SQL query engine.
+
+A from-scratch reimplementation of the capabilities of Presto
+(reference: haozhun/presto @ 0.208-SNAPSHOT) designed idiomatically for
+TPUs: columnar Pages are device-resident ``jnp.ndarray`` batches,
+Presto's runtime-JIT'd JVM bytecode kernels become XLA-compiled JAX
+functions, and the HTTP pull-shuffle becomes ``jax.lax.all_to_all``
+over the ICI mesh.
+
+Layer map (mirrors reference layers; see SURVEY.md §1):
+  L0 data representation  -> presto_tpu.page, presto_tpu.types
+  L2 operators            -> presto_tpu.ops
+  L2b expression JIT      -> presto_tpu.expr
+  L3/L4 driver/task exec  -> presto_tpu.exec
+  L5 exchange             -> presto_tpu.parallel
+  L7-L9 SQL frontend      -> presto_tpu.sql
+  L12 connectors          -> presto_tpu.connectors
+"""
+
+__version__ = "0.1.0"
+
+from presto_tpu.types import (  # noqa: F401
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    VARCHAR,
+    DecimalType,
+    Type,
+    common_super_type,
+    parse_type,
+)
+from presto_tpu.page import Block, Dictionary, Page  # noqa: F401

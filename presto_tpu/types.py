@@ -1,0 +1,143 @@
+"""SQL type system mapped onto TPU-friendly device dtypes.
+
+Reference analog: ``presto-spi/src/main/java/com/facebook/presto/spi/type/``
+(BigintType.java, DoubleType.java, DateType.java, DecimalType.java,
+VarcharType.java, BooleanType.java ...).  Unlike the reference's
+object-per-value Java model, every type here defines a *device
+representation*: one fixed-width ``jnp`` dtype per column, so whole
+columns live in HBM as dense arrays and all ops compile onto the MXU/VPU.
+
+Representation decisions (TPU-first):
+  BIGINT / INTEGER  -> int64 / int32
+  DOUBLE            -> float64 on host, float32 or float64 on device
+                       (TPU float64 is emulated; aggregations keep exact
+                       sums for DECIMAL-typed data via scaled int64)
+  BOOLEAN           -> bool_
+  DATE              -> int32 days since 1970-01-01 (same as reference
+                       DateType.java which stores days-since-epoch)
+  DECIMAL(p<=18,s)  -> int64 scaled by 10**s ("short decimal"; reference
+                       long decimals use 2x64-bit — out of scope v0)
+  VARCHAR           -> int32 dictionary code per row + host-side
+                       ``Dictionary`` of unique strings.  TPC-H string
+                       columns are low-cardinality or only ever touched
+                       by predicates, so predicates evaluate host-side on
+                       the dictionary once and broadcast as boolean LUTs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """A SQL type with a fixed-width device representation."""
+
+    name: str
+    np_dtype: np.dtype
+    # True for types whose device array holds dictionary codes, with the
+    # actual values host-side (VARCHAR/CHAR).
+    dictionary: bool = False
+    # decimal scale (digits after the point) when this is a DECIMAL.
+    scale: Optional[int] = None
+    precision: Optional[int] = None
+
+    def __repr__(self) -> str:
+        if self.scale is not None:
+            return f"decimal({self.precision},{self.scale})"
+        return self.name
+
+    # -- classification helpers -------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self.name in ("bigint", "integer", "double", "decimal")
+
+    @property
+    def is_integerlike(self) -> bool:
+        return self.name in ("bigint", "integer", "date")
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.name == "decimal"
+
+    @property
+    def is_string(self) -> bool:
+        return self.dictionary
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Type):
+            return NotImplemented
+        return (
+            self.name == other.name
+            and self.scale == other.scale
+            and self.precision == other.precision
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.name, self.scale, self.precision))
+
+
+BIGINT = Type("bigint", np.dtype(np.int64))
+INTEGER = Type("integer", np.dtype(np.int32))
+DOUBLE = Type("double", np.dtype(np.float64))
+BOOLEAN = Type("boolean", np.dtype(np.bool_))
+DATE = Type("date", np.dtype(np.int32))
+VARCHAR = Type("varchar", np.dtype(np.int32), dictionary=True)
+
+
+def DecimalType(precision: int = 18, scale: int = 0) -> Type:
+    """Short decimal: int64 scaled by 10**scale.
+
+    Reference: spi/type/DecimalType.java (short decimals, p <= 18).
+    """
+    if precision > 18:
+        raise ValueError("only short decimals (precision <= 18) supported")
+    return Type("decimal", np.dtype(np.int64), scale=scale, precision=precision)
+
+
+def common_super_type(a: Type, b: Type) -> Type:
+    """Implicit coercion for binary ops (reference: FunctionRegistry
+    coercion matrix, metadata/FunctionRegistry.java:349)."""
+    if a == b:
+        return a
+    order = {"boolean": 0, "integer": 1, "date": 1, "bigint": 2, "decimal": 3, "double": 4}
+    if a.name in order and b.name in order:
+        winner = a if order[a.name] >= order[b.name] else b
+        loser = b if winner is a else a
+        if winner.is_decimal and loser.is_decimal:
+            scale = max(a.scale, b.scale)
+            return DecimalType(18, scale)
+        if winner.is_decimal and loser.name in ("bigint", "integer"):
+            return winner
+        return winner
+    raise TypeError(f"no common super type for {a} and {b}")
+
+
+def parse_type(s: str) -> Type:
+    """Parse a SQL type name, e.g. 'bigint', 'decimal(12,2)', 'varchar(25)'."""
+    s = s.strip().lower()
+    if s.startswith("decimal"):
+        if "(" in s:
+            inner = s[s.index("(") + 1 : s.rindex(")")]
+            parts = [p.strip() for p in inner.split(",")]
+            p = int(parts[0])
+            sc = int(parts[1]) if len(parts) > 1 else 0
+            return DecimalType(p, sc)
+        return DecimalType()
+    if s.startswith("varchar") or s.startswith("char"):
+        return VARCHAR
+    m = {
+        "bigint": BIGINT,
+        "integer": INTEGER,
+        "int": INTEGER,
+        "double": DOUBLE,
+        "double precision": DOUBLE,
+        "boolean": BOOLEAN,
+        "date": DATE,
+    }
+    if s in m:
+        return m[s]
+    raise ValueError(f"unknown type: {s}")

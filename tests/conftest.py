@@ -1,0 +1,23 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's DistributedQueryRunner approach (SURVEY.md §4.5:
+one JVM hosting coordinator + N workers) — here one process hosting an
+8-device virtual TPU topology via XLA's host-platform device count, so
+multi-chip sharding is exercised without hardware.
+
+Note: jax is pre-imported at interpreter startup in this image (axon
+platform plugin), so env vars alone are too late — use jax.config,
+which takes effect before the backend is first initialized.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# float64/int64 for DOUBLE/BIGINT columns on the CPU test backend.
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,96 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu.page import Block, Dictionary, Page, concat_pages_host
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    VARCHAR,
+    DecimalType,
+    common_super_type,
+    parse_type,
+)
+
+
+def test_parse_type():
+    assert parse_type("bigint") is BIGINT
+    assert parse_type("decimal(12,2)").scale == 2
+    assert parse_type("varchar(25)") is VARCHAR
+    assert parse_type("date") is DATE
+
+
+def test_common_super_type():
+    assert common_super_type(BIGINT, DOUBLE) is DOUBLE
+    assert common_super_type(DecimalType(12, 2), BIGINT).scale == 2
+    d = common_super_type(DecimalType(12, 2), DecimalType(10, 4))
+    assert d.scale == 4
+
+
+def test_block_from_numpy_padding():
+    b = Block.from_numpy(np.array([1, 2, 3]), BIGINT, capacity=8)
+    assert b.capacity == 8
+    assert b.data.dtype == jnp.int64
+    assert np.asarray(b.valid).sum() == 3
+
+
+def test_page_roundtrip():
+    p = Page.from_arrays(
+        [np.array([1, 2, 3], dtype=np.int64), np.array([1.5, 2.5, 3.5])],
+        [BIGINT, DOUBLE],
+        capacity=10,
+    )
+    assert p.capacity == 10
+    assert int(p.num_rows()) == 3
+    rows = p.to_pylist()
+    assert rows == [(1, 1.5), (2, 2.5), (3, 3.5)]
+
+
+def test_page_nulls_and_decimal():
+    p = Page.from_arrays(
+        [np.array([150, 225], dtype=np.int64)],
+        [DecimalType(12, 2)],
+        valids=[np.array([True, False])],
+    )
+    rows = p.to_pylist()
+    assert rows == [(1.5,), (None,)]
+
+
+def test_dictionary_block():
+    d = Dictionary(["AIR", "MAIL", "SHIP"])
+    p = Page.from_arrays(
+        [np.array([2, 0, 1], dtype=np.int32)],
+        [VARCHAR],
+        dictionaries=[d],
+    )
+    assert p.to_pylist() == [("SHIP",), ("AIR",), ("MAIL",)]
+    lut = d.lut(lambda s: s.startswith("M"))
+    assert lut.tolist() == [False, True, False]
+    assert d.code_of("SHIP") == 2
+    assert d.code_of("nope") == -1
+
+
+def test_page_is_pytree():
+    p = Page.from_arrays([np.array([1, 2], dtype=np.int64)], [BIGINT], capacity=4)
+
+    @jax.jit
+    def f(page):
+        return page.num_rows()
+
+    assert int(f(p)) == 2
+    leaves = jax.tree_util.tree_leaves(p)
+    assert len(leaves) == 3  # data, valid, row_mask
+
+
+def test_compact_host_and_concat():
+    p = Page.from_arrays([np.arange(6, dtype=np.int64)], [BIGINT], capacity=8)
+    mask = np.asarray(p.row_mask).copy()
+    mask[1] = False
+    p = Page(p.blocks, jnp.asarray(mask))
+    c = p.compact_host()
+    assert [r[0] for r in c.to_pylist()] == [0, 2, 3, 4, 5]
+    both = concat_pages_host([c, c])
+    assert int(both.num_rows()) == 10
