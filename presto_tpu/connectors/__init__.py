@@ -1,0 +1,5 @@
+"""Connectors: data sources producing columnar Pages.
+
+Reference analog: presto-tpch / presto-memory / presto-blackhole
+connector modules plus the connector SPI (presto-spi/.../spi/connector/).
+"""

@@ -1,0 +1,116 @@
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.tpch import (
+    CURRENT_DATE,
+    MAX_ORDER_DATE,
+    MIN_ORDER_DATE,
+    SCHEMAS,
+    Tpch,
+)
+
+
+@pytest.fixture(scope="module")
+def tpch():
+    return Tpch(sf=0.01)
+
+
+def test_row_counts(tpch):
+    assert tpch.row_count("region") == 5
+    assert tpch.row_count("nation") == 25
+    assert tpch.row_count("customer") == 1500
+    assert tpch.row_count("orders") == 15000
+    assert tpch.row_count("part") == 2000
+    # lineitem ~4x orders
+    n = tpch.row_count("lineitem")
+    assert 15000 * 1 <= n <= 15000 * 7
+    assert abs(n / 15000 - 4.0) < 0.2
+
+
+def test_determinism(tpch):
+    a = tpch.generate_split("lineitem", 0)
+    b = Tpch(sf=0.01).generate_split("lineitem", 0)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_value_domains(tpch):
+    li = tpch.generate_split("lineitem", 0)
+    assert li["l_quantity"].min() >= 100 and li["l_quantity"].max() <= 5000
+    assert li["l_discount"].min() >= 0 and li["l_discount"].max() <= 10
+    assert li["l_tax"].min() >= 0 and li["l_tax"].max() <= 8
+    assert (li["l_shipdate"] > MIN_ORDER_DATE).all()
+    assert (li["l_receiptdate"] > li["l_shipdate"]).all()
+    o = tpch.generate_split("orders", 0)
+    assert o["o_orderdate"].min() >= MIN_ORDER_DATE
+    assert o["o_orderdate"].max() <= MAX_ORDER_DATE
+    # linestatus consistent with shipdate
+    assert ((li["l_linestatus"] == 1) == (li["l_shipdate"] > CURRENT_DATE)).all()
+
+
+def test_referential_integrity(tpch):
+    li = tpch.generate_split("lineitem", 0)
+    o = tpch.generate_split("orders", 0)
+    assert set(np.unique(li["l_orderkey"])) == set(np.unique(o["o_orderkey"]))
+    assert li["l_partkey"].max() <= tpch.n_parts
+    assert li["l_suppkey"].max() <= tpch.n_suppliers
+    assert o["o_custkey"].max() <= tpch.n_customers
+    ps = tpch.generate_split("partsupp", 0)
+    assert ps["ps_suppkey"].min() >= 1 and ps["ps_suppkey"].max() <= tpch.n_suppliers
+    # each part has 4 distinct suppliers
+    assert len(set(ps["ps_suppkey"][:4])) == 4
+
+
+def test_totalprice_consistency(tpch):
+    o = tpch.generate_split("orders", 0)
+    li = tpch.generate_split("lineitem", 0)
+    k = o["o_orderkey"][7]
+    lines = li["l_orderkey"] == k
+    charge = (
+        li["l_extendedprice"][lines]
+        * (100 + li["l_tax"][lines])
+        * (100 - li["l_discount"][lines])
+    ) // 10000
+    assert charge.sum() == o["o_totalprice"][7]
+
+
+def test_dictionaries(tpch):
+    d = tpch.dictionary_for("lineitem", "l_shipmode")
+    assert "AIR" in d.values and len(d) == 7
+    names = tpch.dictionary_for("customer", "c_name")
+    assert names.decode(np.array([0]))[0] == "Customer#000000001"
+    ptype = tpch.dictionary_for("part", "p_type")
+    assert len(ptype) == 150
+    lut = ptype.lut(lambda s: s.startswith("PROMO"))
+    assert lut.sum() == 25
+    phone = tpch.dictionary_for("customer", "c_phone")
+    v = phone.decode(np.array([5]))[0]
+    assert len(v.split("-")) == 4 and 10 <= int(v.split("-")[0]) <= 34
+
+
+def test_pages(tpch):
+    page = tpch.page_for_split("nation", 0)
+    rows = page.to_pylist()
+    assert len(rows) == 25
+    assert rows[6][1] == "FRANCE" and rows[6][2] == 3
+    # lineitem page types decode
+    lp = tpch.page_for_split("lineitem", 0)
+    r0 = lp.to_pylist()[0]
+    schema = [n for n, _ in SCHEMAS["lineitem"]]
+    row = dict(zip(schema, r0))
+    assert row["l_returnflag"] in ("A", "N", "R")
+    assert isinstance(row["l_quantity"], float) and 1 <= row["l_quantity"] <= 50
+
+
+def test_split_alignment():
+    t = Tpch(sf=0.01, split_rows=4096)
+    assert t.num_splits("orders") == 4  # 15000 / 4096
+    total = 0
+    seen = set()
+    for i in range(t.num_splits("lineitem")):
+        cols = t.generate_split("lineitem", i)
+        total += len(cols["l_orderkey"])
+        keys = set(np.unique(cols["l_orderkey"]))
+        assert not (keys & seen)  # order-aligned: no key spans splits
+        seen |= keys
+    assert total == t.row_count("lineitem")
