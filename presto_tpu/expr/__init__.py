@@ -1,0 +1,23 @@
+"""Expression IR and its JAX compiler.
+
+Reference analog: the expression JIT tier — RowExpression trees compiled
+to JVM bytecode PageProjection/PageFilter classes
+(presto-main/.../sql/gen/ExpressionCompiler.java:53,
+PageFunctionCompiler.java:101). Here the "bytecode" target is XLA: an
+Expr tree compiles to a Python closure over jnp ops, which jits (and
+fuses) into the enclosing stage program.
+"""
+
+from presto_tpu.expr.ir import (  # noqa: F401
+    AggCall,
+    Call,
+    ColumnRef,
+    Expr,
+    Literal,
+    and_,
+    call,
+    col,
+    eq,
+    lit,
+)
+from presto_tpu.expr.compile import compile_expr, compile_filter  # noqa: F401

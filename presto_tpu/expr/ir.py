@@ -1,0 +1,161 @@
+"""Row expression IR.
+
+Reference analog: RowExpression (presto-main/.../sql/relational/
+RowExpression.java and CallExpression/InputReferenceExpression/
+ConstantExpression) — the typed post-analysis expression form the
+reference compiles to bytecode. Same role here, compiled to jnp ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    DecimalType,
+    Type,
+    common_super_type,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    type: Type
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnRef(Expr):
+    """Input channel reference (InputReferenceExpression analog)."""
+
+    index: int = 0
+    name: str = ""  # debugging only
+
+    def __repr__(self):
+        return f"${self.index}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    """Constant (ConstantExpression analog). Decimals store the scaled
+    int; dates store epoch days; strings store the raw python str
+    (resolved to a dictionary code at compile time)."""
+
+    value: Any = None
+
+    def __repr__(self):
+        return f"lit({self.value!r}:{self.type})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Expr):
+    """Function call (CallExpression analog)."""
+
+    fn: str = ""
+    args: Tuple[Expr, ...] = ()
+
+    def __repr__(self):
+        return f"{self.fn}({', '.join(map(repr, self.args))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class AggCall:
+    """One aggregate in an aggregation node: fn over an argument
+    expression, with optional DISTINCT and output type.
+
+    Reference analog: the parsed form behind
+    operator/aggregation/InternalAggregationFunction.java.
+    """
+
+    fn: str  # sum | count | count_star | min | max | avg
+    arg: Optional[Expr]
+    type: Type
+    distinct: bool = False
+    filter: Optional[Expr] = None
+
+    def __repr__(self):
+        a = "*" if self.arg is None else repr(self.arg)
+        return f"{self.fn}({'DISTINCT ' if self.distinct else ''}{a})"
+
+
+# ---------------------------------------------------------------------------
+# Typing rules (FunctionRegistry / SignatureBinder analog, kept pragmatic)
+# ---------------------------------------------------------------------------
+
+ARITH = {"add", "sub", "mul", "div", "mod", "neg"}
+CMP = {"eq", "ne", "lt", "le", "gt", "ge"}
+LOGIC = {"and", "or", "not"}
+
+
+def infer_type(fn: str, args: Sequence[Expr]) -> Type:
+    ts = [a.type for a in args]
+    if fn in CMP or fn in LOGIC or fn in ("like", "is_null", "not_null", "in", "between"):
+        return BOOLEAN
+    if fn == "neg":
+        return ts[0]
+    if fn in ARITH:
+        a, b = ts[0], ts[1]
+        if a.is_decimal or b.is_decimal:
+            ad = a if a.is_decimal else DecimalType(18, 0)
+            bd = b if b.is_decimal else DecimalType(18, 0)
+            if a.name == "double" or b.name == "double":
+                return DOUBLE
+            if fn == "mul":
+                return DecimalType(18, ad.scale + bd.scale)
+            if fn == "div":
+                return DOUBLE  # deviation: reference returns decimal
+            return DecimalType(18, max(ad.scale, bd.scale))
+        if fn == "div" and a.name != "double" and b.name != "double":
+            return common_super_type(a, b)  # integer division stays integral
+        return common_super_type(a, b)
+    if fn in ("year", "month", "day"):
+        return BIGINT
+    if fn == "date_add_days":
+        return DATE
+    if fn == "coalesce":
+        out = ts[0]
+        for t in ts[1:]:
+            out = common_super_type(out, t)
+        return out
+    if fn == "if":
+        return common_super_type(ts[1], ts[2])
+    if fn == "case":
+        # args = [when1, then1, ..., else]: supertype over all branches
+        branch_ts = [ts[i] for i in range(1, len(ts) - 1, 2)] + [ts[-1]]
+        out = branch_ts[0]
+        for t in branch_ts[1:]:
+            out = common_super_type(out, t)
+        return out
+    if fn == "cast_double":
+        return DOUBLE
+    if fn == "cast_bigint":
+        return BIGINT
+    raise KeyError(f"unknown function {fn} for types {ts}")
+
+
+# -- convenience constructors ------------------------------------------------
+
+def col(index: int, type_: Type, name: str = "") -> ColumnRef:
+    return ColumnRef(type=type_, index=index, name=name)
+
+
+def lit(value: Any, type_: Type) -> Literal:
+    return Literal(type=type_, value=value)
+
+
+def call(fn: str, *args: Expr) -> Call:
+    return Call(type=infer_type(fn, args), fn=fn, args=tuple(args))
+
+
+def eq(a: Expr, b: Expr) -> Call:
+    return call("eq", a, b)
+
+
+def and_(*xs: Expr) -> Expr:
+    out = xs[0]
+    for x in xs[1:]:
+        out = call("and", out, x)
+    return out

@@ -1,0 +1,23 @@
+"""Vectorized relational operators over Pages.
+
+Reference analog: presto-main/.../operator/ (the vectorized kernel tier:
+FilterAndProjectOperator, HashAggregationOperator + GroupByHash,
+HashBuilderOperator/LookupJoinOperator + PagesHash, OrderByOperator,
+TopNOperator ...). Re-designed for TPU: no row loops and no
+open-addressing hash probes — grouping and joins are sort/searchsorted
+algorithms with static shapes, so everything compiles to fused XLA.
+"""
+
+from presto_tpu.ops.filter_project import filter_page, project_page  # noqa: F401
+from presto_tpu.ops.aggregate import (  # noqa: F401
+    AggSpec,
+    grouped_aggregate,
+    merge_aggregate,
+)
+from presto_tpu.ops.join import (  # noqa: F401
+    JoinBuild,
+    build_join,
+    probe_expand,
+    probe_join,
+)
+from presto_tpu.ops.sort import limit_page, sort_page, topn_page  # noqa: F401

@@ -1,0 +1,426 @@
+"""Grouped aggregation kernels.
+
+Reference analog: HashAggregationOperator
+(operator/HashAggregationOperator.java:46) with GroupByHash
+(operator/MultiChannelGroupByHash.java:54 — open-addressing row hash)
+and the JIT-compiled accumulators (operator/aggregation/,
+AccumulatorCompiler.java). Open-addressing probes are scalar-serial and
+hostile to the TPU's vector units, so group resolution is re-designed:
+
+* **Packed-direct path**: when every group key has a known small domain
+  (dictionary codes, flags, small ints), the packed key IS the group id
+  — no sort, one `segment_sum` per aggregate. This is the TPC-H Q1
+  shape (6 groups) and the analog of the reference's
+  BigintGroupByHash specialization.
+
+* **Sort path**: general case. Pack (exact, when domains fit in 63
+  bits) or hash-mix the key columns into one int64, argsort once,
+  derive group ids from sorted-run boundaries, then segment-reduce.
+  Deterministic output order (sorted by packed/hashed key).
+
+Aggregates are expressed as (state columns, merge, finalize) triples so
+the same kernel serves single-node, partial (pre-exchange) and final
+(post-exchange) aggregation — the PARTIAL/FINAL split of
+iterative/rule/PushPartialAggregationThroughExchange.java.
+
+Exact sums: DECIMAL aggregates accumulate in scaled int64, which is
+exact; chunk-level partial states are combined by the final step, and
+the driver can combine per-chunk int64 partials host-side in arbitrary
+precision if a single chunk could overflow (SF100 Q1 sum_charge).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.expr.compile import ExprCompiler
+from presto_tpu.expr.ir import AggCall, Expr
+from presto_tpu.page import Block, Page
+from presto_tpu.types import BIGINT, DOUBLE, DecimalType, Type
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+AggSpec = AggCall  # public alias
+
+DIRECT_GROUP_LIMIT = 1 << 14
+
+
+# ---------------------------------------------------------------------------
+# agg state machinery
+# ---------------------------------------------------------------------------
+
+def _sum_type(t: Type) -> Type:
+    if t.is_decimal:
+        return DecimalType(18, t.scale)
+    if t.name == "double":
+        return DOUBLE
+    return BIGINT
+
+
+def state_types(agg: AggCall) -> List[Type]:
+    """Column types of this aggregate's partial state."""
+    if agg.fn == "count_star" or agg.fn == "count":
+        return [BIGINT]
+    t = agg.arg.type
+    if agg.fn == "sum":
+        return [_sum_type(t), BIGINT]
+    if agg.fn == "avg":
+        return [_sum_type(t), BIGINT]
+    if agg.fn in ("min", "max"):
+        return [t, BIGINT]
+    raise KeyError(f"unknown aggregate {agg.fn}")
+
+
+def output_type(agg: AggCall) -> Type:
+    if agg.fn in ("count", "count_star"):
+        return BIGINT
+    if agg.fn == "sum":
+        return _sum_type(agg.arg.type)
+    if agg.fn == "avg":
+        return DOUBLE  # deviation: reference keeps decimal scale for avg(decimal)
+    return agg.arg.type
+
+
+def _seg_sum(vals, gid, n):
+    return jax.ops.segment_sum(vals, gid, num_segments=n)
+
+
+def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int):
+    """Compute per-group state columns for each aggregate.
+
+    gid must already be ``n`` for dead rows (dropped by segment ops via
+    an extra slot)."""
+    c = ExprCompiler.for_page(page)
+    out: List[List[jax.Array]] = []
+    live = page.row_mask
+    for agg in aggs:
+        if agg.filter is not None:
+            fd, fv = c.compile(agg.filter)(page)
+            rowsel = live & fd & fv
+        else:
+            rowsel = live
+        gid_a = jnp.where(rowsel, gid, n)
+        if agg.fn == "count_star":
+            cnt = _seg_sum(jnp.ones_like(gid_a, dtype=jnp.int64), gid_a, n + 1)[:n]
+            out.append([cnt])
+            continue
+        data, valid = c.compile(agg.arg)(page)
+        nonnull = rowsel & valid
+        gid_nn = jnp.where(nonnull, gid, n)
+        cnt = _seg_sum(nonnull.astype(jnp.int64), gid_nn, n + 1)[:n]
+        if agg.fn == "count":
+            out.append([cnt])
+        elif agg.fn in ("sum", "avg"):
+            st = _sum_type(agg.arg.type)
+            vals = data.astype(st.np_dtype)
+            vals = jnp.where(nonnull, vals, jnp.zeros_like(vals))
+            s = _seg_sum(vals, gid_nn, n + 1)[:n]
+            out.append([s, cnt])
+        elif agg.fn in ("min", "max"):
+            if agg.fn == "min":
+                fill = _type_max(agg.arg.type)
+                m = jax.ops.segment_min(
+                    jnp.where(nonnull, data, fill), gid_nn, num_segments=n + 1
+                )[:n]
+            else:
+                fill = _type_min(agg.arg.type)
+                m = jax.ops.segment_max(
+                    jnp.where(nonnull, data, fill), gid_nn, num_segments=n + 1
+                )[:n]
+            out.append([m, cnt])
+        else:
+            raise KeyError(agg.fn)
+    return out
+
+
+def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
+    """Merge partial-state rows (one row per upstream group) into final
+    groups: sums/counts add, mins/maxes reduce."""
+    out: List[List[jax.Array]] = []
+    for agg, cols in zip(aggs, state_cols):
+        if agg.fn in ("count", "count_star"):
+            out.append([_seg_sum(cols[0], gid, n + 1)[:n]])
+        elif agg.fn in ("sum", "avg"):
+            out.append([
+                _seg_sum(cols[0], gid, n + 1)[:n],
+                _seg_sum(cols[1], gid, n + 1)[:n],
+            ])
+        elif agg.fn == "min":
+            out.append([
+                jax.ops.segment_min(cols[0], gid, num_segments=n + 1)[:n],
+                _seg_sum(cols[1], gid, n + 1)[:n],
+            ])
+        elif agg.fn == "max":
+            out.append([
+                jax.ops.segment_max(cols[0], gid, num_segments=n + 1)[:n],
+                _seg_sum(cols[1], gid, n + 1)[:n],
+            ])
+    return out
+
+
+def _finalize(states: List[List[jax.Array]], aggs) -> List[Block]:
+    blocks = []
+    for agg, cols in zip(aggs, states):
+        t = output_type(agg)
+        if agg.fn in ("count", "count_star"):
+            blocks.append(Block(cols[0].astype(jnp.int64), jnp.ones_like(cols[0], jnp.bool_), t))
+        elif agg.fn == "sum":
+            s, cnt = cols
+            blocks.append(Block(s.astype(t.np_dtype), cnt > 0, t))
+        elif agg.fn == "avg":
+            s, cnt = cols
+            st = _sum_type(agg.arg.type)
+            num = s.astype(jnp.float64)
+            if st.is_decimal:
+                num = num / (10.0 ** st.scale)
+            d = num / jnp.maximum(cnt, 1).astype(jnp.float64)
+            blocks.append(Block(d, cnt > 0, t))
+        elif agg.fn in ("min", "max"):
+            m, cnt = cols
+            blocks.append(Block(m.astype(t.np_dtype), cnt > 0, t))
+    return blocks
+
+
+def _type_max(t: Type):
+    return jnp.asarray(jnp.finfo(jnp.float64).max if t.name == "double" else _I64_MAX).astype(t.np_dtype)
+
+
+def _type_min(t: Type):
+    return jnp.asarray(jnp.finfo(jnp.float64).min if t.name == "double" else -_I64_MAX - 1).astype(t.np_dtype)
+
+
+# ---------------------------------------------------------------------------
+# group id assignment
+# ---------------------------------------------------------------------------
+
+def _mix64(x: jax.Array) -> jax.Array:
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def _key_codes(datas, valids, domains):
+    """Per-column null-aware codes (0 = NULL), plus cardinalities."""
+    codes, cards = [], []
+    for (d, v), dom in zip(zip(datas, valids), domains):
+        lo, hi = dom
+        code = jnp.where(v, d.astype(jnp.int64) - lo + 1, 0)
+        codes.append(code)
+        cards.append(int(hi - lo + 2))
+    return codes, cards
+
+
+def pack_or_hash_keys(datas, valids, domains) -> Tuple[jax.Array, bool]:
+    """Combine key columns into one int64. Exact packing when domains
+    fit 63 bits (always true for TPC-H keys); else 64-bit mix (collision
+    odds ~ n^2/2^65 — the planner can demand exactness by supplying
+    domains)."""
+    if not datas:
+        return None, True
+    if domains is not None and all(d is not None for d in domains):
+        codes, cards = _key_codes(datas, valids, domains)
+        prod = 1
+        for c in cards:
+            prod *= c
+        if prod < (1 << 62):
+            key = jnp.zeros_like(codes[0])
+            for code, card in zip(codes, cards):
+                key = key * card + code
+            return key, True
+    h = jnp.zeros(datas[0].shape, dtype=jnp.uint64)
+    for d, v in zip(datas, valids):
+        # NULLs must hash identically regardless of residual data: zero
+        # the data lane and fold the null flag in separately.
+        lane = jnp.where(v, d.astype(jnp.int64), 0).astype(jnp.uint64)
+        h = _mix64(h ^ _mix64(lane + jnp.uint64(0x9E37) * v.astype(jnp.uint64)))
+    return h.astype(jnp.int64) & jnp.int64(0x7FFFFFFFFFFFFFFF), False
+
+
+def _sorted_group_ids(key: jax.Array, live: jax.Array, max_groups: int):
+    """Shared sort-path grouping: returns per-row group ids (dead rows
+    -> max_groups), the live group count, and a representative row per
+    group (first sorted occurrence)."""
+    key_live = jnp.where(live, key, _I64_MAX)
+    order = jnp.argsort(key_live)
+    sk = key_live[order]
+    is_live_sorted = sk != _I64_MAX
+    first = jnp.concatenate([jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]]) & is_live_sorted
+    gid_sorted = jnp.cumsum(first.astype(jnp.int32)) - 1
+    gid_sorted = jnp.where(is_live_sorted, jnp.minimum(gid_sorted, max_groups), max_groups)
+    num_groups = jnp.sum(first.astype(jnp.int32))
+    gid = jnp.zeros_like(gid_sorted).at[order].set(gid_sorted)
+    gid = jnp.where(live, gid, max_groups).astype(jnp.int32)
+    rep_slot = jnp.where(first, gid_sorted, max_groups)
+    rep_rows = (
+        jnp.zeros(max_groups + 1, dtype=jnp.int32)
+        .at[rep_slot]
+        .set(order.astype(jnp.int32), mode="drop")
+    )[:max_groups]
+    return gid, num_groups, rep_rows
+
+
+# ---------------------------------------------------------------------------
+# main kernels
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class GroupLayout:
+    """Static description of a grouped aggregation's output page:
+    group-key blocks then one block per state column (partial) or per
+    aggregate (final/single)."""
+
+    num_keys: int
+    aggs: Tuple[AggCall, ...]
+    mode: str  # single | partial | final
+
+
+def grouped_aggregate(
+    page: Page,
+    group_exprs: Sequence[Expr],
+    aggs: Sequence[AggCall],
+    max_groups: int,
+    key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
+    mode: str = "single",
+    return_count: bool = False,
+) -> Page:
+    """Aggregate ``page`` by ``group_exprs``.
+
+    mode='single' emits finalized values; 'partial' emits state columns
+    (for exchange + merge_aggregate).
+
+    Overflow: if the input has more than ``max_groups`` distinct keys
+    the output is silently truncated to the first ``max_groups`` groups
+    in key order — pass ``return_count=True`` to get (page, num_groups)
+    so the driver can detect ``num_groups > max_groups`` and re-plan
+    with a larger capacity (the reference instead rehashes:
+    MultiChannelGroupByHash.java:138-145 tryRehash).
+    """
+    c = ExprCompiler.for_page(page)
+    kd = [c.compile(e)(page) for e in group_exprs]
+    datas = [d for d, _ in kd]
+    valids = [v for _, v in kd]
+    key_dicts = []
+    from presto_tpu.expr.ir import ColumnRef
+
+    for e in group_exprs:
+        key_dicts.append(page.blocks[e.index].dictionary if isinstance(e, ColumnRef) else None)
+
+    live = page.row_mask
+
+    if not group_exprs:
+        # global aggregation: one group
+        gid = jnp.where(live, 0, 1)
+        states = _partial_states(page, aggs, gid, 1)
+        key_blocks: List[Block] = []
+        out_mask = jnp.ones(1, dtype=jnp.bool_)
+        out = _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts)
+        return (out, jnp.ones((), jnp.int32)) if return_count else out
+
+    key, exact = pack_or_hash_keys(datas, valids, key_domains)
+
+    # packed-direct: group id == packed key, no sort; output capacity is
+    # always max_groups (padded above prod) so downstream shapes match
+    # the sort path.
+    if exact and key_domains is not None and all(d is not None for d in key_domains):
+        _, cards = _key_codes(datas, valids, key_domains)
+        prod = 1
+        for card in cards:
+            prod *= card
+        if prod <= min(max_groups, DIRECT_GROUP_LIMIT):
+            gid = jnp.where(live, key, max_groups)
+            states = _partial_states(page, aggs, gid, max_groups)
+            present = _seg_sum(live.astype(jnp.int64), gid, max_groups + 1)[:max_groups] > 0
+            key_blocks = _unpack_key_blocks(
+                cards, key_domains, group_exprs, key_dicts, prod, max_groups
+            )
+            out = _emit(key_blocks, states, aggs, present, mode, group_exprs, key_dicts)
+            return (out, jnp.sum(present.astype(jnp.int32))) if return_count else out
+
+    # sort path
+    gid, num_groups, rep_rows = _sorted_group_ids(key, live, max_groups)
+    states = _partial_states(page, aggs, gid, max_groups)
+    key_blocks = []
+    for (d, v), e, dic in zip(kd, group_exprs, key_dicts):
+        kb_data = d[rep_rows].astype(e.type.np_dtype)
+        kb_valid = v[rep_rows]
+        key_blocks.append(Block(kb_data, kb_valid, e.type, dic))
+    out_mask = jnp.arange(max_groups) < num_groups
+    out = _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts)
+    return (out, num_groups) if return_count else out
+
+
+def _unpack_key_blocks(cards, domains, group_exprs, key_dicts, prod, capacity) -> List[Block]:
+    gids = jnp.arange(capacity, dtype=jnp.int64)
+    in_range = gids < prod
+    blocks = []
+    stride = prod
+    for card, (lo, _), e, dic in zip(cards, domains, group_exprs, key_dicts):
+        stride //= card
+        code = (gids // stride) % card
+        data = (code - 1 + lo).astype(e.type.np_dtype)
+        blocks.append(Block(data, (code > 0) & in_range, e.type, dic))
+    return blocks
+
+
+def _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts) -> Page:
+    if mode == "partial":
+        blocks = list(key_blocks)
+        for agg, cols in zip(aggs, states):
+            for t, colv in zip(state_types(agg), cols):
+                blocks.append(Block(colv.astype(t.np_dtype), out_mask, t))
+        return Page(tuple(blocks), out_mask)
+    agg_blocks = _finalize(states, aggs)
+    # clamp validity to live groups
+    agg_blocks = [Block(b.data, b.valid & out_mask, b.type, b.dictionary) for b in agg_blocks]
+    return Page(tuple(key_blocks) + tuple(agg_blocks), out_mask)
+
+
+def merge_aggregate(
+    partial: Page,
+    num_keys: int,
+    aggs: Sequence[AggCall],
+    max_groups: int,
+    key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
+    mode: str = "single",
+) -> Page:
+    """Final aggregation over a page of partial states (group keys in
+    the first ``num_keys`` blocks, then state columns in
+    ``state_types`` order)."""
+    live = partial.row_mask
+    datas = [partial.blocks[i].data for i in range(num_keys)]
+    valids = [partial.blocks[i].valid for i in range(num_keys)]
+    key_dicts = [partial.blocks[i].dictionary for i in range(num_keys)]
+    key_types = [partial.blocks[i].type for i in range(num_keys)]
+
+    # slice state columns per agg
+    state_cols: List[List[jax.Array]] = []
+    pos = num_keys
+    for agg in aggs:
+        ncols = len(state_types(agg))
+        state_cols.append([partial.blocks[pos + j].data for j in range(ncols)])
+        pos += ncols
+
+    from presto_tpu.expr.ir import ColumnRef
+
+    group_exprs = [
+        ColumnRef(type=key_types[i], index=i) for i in range(num_keys)
+    ]
+
+    if num_keys == 0:
+        gid = jnp.where(live, 0, 1).astype(jnp.int32)
+        merged = _merge_states(state_cols, aggs, gid, 1)
+        return _emit([], merged, aggs, jnp.ones(1, jnp.bool_), mode, group_exprs, key_dicts)
+
+    key, exact = pack_or_hash_keys(datas, valids, key_domains)
+    gid, num_groups, rep_rows = _sorted_group_ids(key, live, max_groups)
+    merged = _merge_states(state_cols, aggs, gid, max_groups)
+    key_blocks = []
+    for d, v, t, dic in zip(datas, valids, key_types, key_dicts):
+        key_blocks.append(Block(d[rep_rows].astype(t.np_dtype), v[rep_rows], t, dic))
+    out_mask = jnp.arange(max_groups) < num_groups
+    return _emit(key_blocks, merged, aggs, out_mask, mode, group_exprs, key_dicts)

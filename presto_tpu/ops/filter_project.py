@@ -1,0 +1,45 @@
+"""Filter and projection over Pages.
+
+Reference analog: FilterAndProjectOperator
+(operator/FilterAndProjectOperator.java:31) + the JIT'd PageProcessor
+(operator/project/PageProcessor.java:77-102). The reference evaluates a
+compiled PageFilter into SelectedPositions then materializes projections
+position-by-position; here the filter just ANDs into the row mask (no
+compaction — selection is free on TPU and shapes stay static) and
+projections are whole-column jnp computations that XLA fuses.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from presto_tpu.expr.compile import ExprCompiler, compile_filter
+from presto_tpu.expr.ir import Expr
+from presto_tpu.page import Block, Page
+
+
+def filter_page(page: Page, predicate: Expr) -> Page:
+    """Rows where predicate is not TRUE (false or NULL) are masked out."""
+    return Page(page.blocks, compile_filter(predicate, page)(page))
+
+
+def project_page(page: Page, projections: Sequence[Expr]) -> Page:
+    """Produce a new Page with one block per projection expression.
+
+    Dictionary provenance: a projection that is a bare ColumnRef keeps
+    the source block's dictionary (dictionary-aware projection,
+    DictionaryAwarePageProjection.java analog).
+    """
+    c = ExprCompiler.for_page(page)
+    blocks: List[Block] = []
+    for e in projections:
+        data, valid = c.compile(e)(page)
+        dictionary = None
+        from presto_tpu.expr.ir import ColumnRef
+
+        if isinstance(e, ColumnRef):
+            dictionary = page.blocks[e.index].dictionary
+        if data.dtype != e.type.np_dtype:
+            data = data.astype(e.type.np_dtype)
+        blocks.append(Block(data, valid, e.type, dictionary))
+    return Page(tuple(blocks), page.row_mask)

@@ -1,0 +1,181 @@
+"""Hash-join kernels: build + probe via sorted lookup.
+
+Reference analog: HashBuilderOperator (operator/HashBuilderOperator.java:51)
+building PagesIndex/PagesHash (operator/PagesHash.java:34 — open
+addressing over build rows with synthetic addresses) probed by
+LookupJoinOperator (operator/LookupJoinOperator.java:53) through
+JoinProbe. Random-probe hash tables serialize on TPU, so the build side
+is instead *sorted by join key* and probes are vectorized
+``searchsorted`` binary searches — every probe row resolves its match
+range [lo, hi) in parallel on the VPU.
+
+Match semantics: keys are packed exactly (domains from table metadata;
+TPC-H keys always fit 63 bits) so equality is exact, or hash-mixed as a
+fallback. NULL join keys never match (SQL semantics) — they pack to the
+reserved 0 code which is excluded, or sort to the +inf sentinel.
+
+Shapes: probe_join aligned outputs (unique build keys, or first-match)
+keep the probe page's capacity. probe_expand emits up to out_capacity
+rows for many-to-many joins, with an overflow flag the driver checks
+(it re-probes in smaller chunks on overflow — the analog of the
+reference's yielding LookupJoinPageBuilder)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.expr.compile import ExprCompiler
+from presto_tpu.expr.ir import Expr
+from presto_tpu.ops.aggregate import pack_or_hash_keys
+from presto_tpu.page import Block, Page
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class JoinBuild:
+    """Sorted build-side index (LookupSource analog)."""
+
+    sorted_keys: jax.Array  # int64 (cap,), +inf padded
+    perm: jax.Array  # int32 (cap,): sorted pos -> build row
+    page: Page  # original build page (payload source)
+
+    def tree_flatten(self):
+        return (self.sorted_keys, self.perm, self.page), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self) -> int:
+        return self.sorted_keys.shape[0]
+
+
+def build_join(
+    page: Page,
+    key_exprs: Sequence[Expr],
+    key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
+) -> JoinBuild:
+    c = ExprCompiler.for_page(page)
+    kd = [c.compile(e)(page) for e in key_exprs]
+    datas = [d for d, _ in kd]
+    valids = [v for _, v in kd]
+    key, _ = pack_or_hash_keys(datas, valids, key_domains)
+    # NULL keys never participate: exclude rows with any null key
+    all_valid = page.row_mask
+    for v in valids:
+        all_valid = all_valid & v
+    key = jnp.where(all_valid, key, _I64_MAX)
+    order = jnp.argsort(key)
+    return JoinBuild(key[order], order.astype(jnp.int32), page)
+
+
+def _probe_keys(page: Page, key_exprs: Sequence[Expr], key_domains):
+    c = ExprCompiler.for_page(page)
+    kd = [c.compile(e)(page) for e in key_exprs]
+    datas = [d for d, _ in kd]
+    valids = [v for _, v in kd]
+    key, _ = pack_or_hash_keys(datas, valids, key_domains)
+    ok = page.row_mask
+    for v in valids:
+        ok = ok & v
+    return jnp.where(ok, key, _I64_MAX - 1), ok  # distinct sentinel: never matches build
+
+
+def probe_join(
+    build: JoinBuild,
+    probe: Page,
+    probe_key_exprs: Sequence[Expr],
+    key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
+    kind: str = "inner",
+    build_output: Optional[Sequence[int]] = None,
+) -> Page:
+    """Probe-aligned join for unique (or first-match) build keys.
+
+    kind: inner | left | semi | anti.
+    Output: probe blocks followed by the selected build blocks
+    (build_output indexes into build.page.blocks; default all).
+    semi/anti emit probe blocks only, with the row mask filtered.
+    """
+    key, _ = _probe_keys(probe, probe_key_exprs, key_domains)
+    pos = jnp.searchsorted(build.sorted_keys, key)
+    pos_c = jnp.clip(pos, 0, build.capacity - 1)
+    match = (build.sorted_keys[pos_c] == key) & probe.row_mask
+    build_row = build.perm[pos_c]
+
+    if kind == "semi":
+        return Page(probe.blocks, probe.row_mask & match)
+    if kind == "anti":
+        return Page(probe.blocks, probe.row_mask & jnp.logical_not(match))
+
+    if build_output is None:
+        build_output = range(len(build.page.blocks))
+    out_blocks: List[Block] = list(probe.blocks)
+    for i in build_output:
+        b = build.page.blocks[i]
+        data = b.data[build_row]
+        valid = b.valid[build_row] & match
+        out_blocks.append(Block(data, valid, b.type, b.dictionary))
+    if kind == "inner":
+        mask = probe.row_mask & match
+    elif kind == "left":
+        mask = probe.row_mask
+    else:
+        raise ValueError(kind)
+    return Page(tuple(out_blocks), mask)
+
+
+def probe_expand(
+    build: JoinBuild,
+    probe: Page,
+    probe_key_exprs: Sequence[Expr],
+    out_capacity: int,
+    key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
+    kind: str = "inner",
+    build_output: Optional[Sequence[int]] = None,
+) -> Tuple[Page, jax.Array]:
+    """Many-to-many join: each probe row emits one output row per
+    matching build row. Returns (page, total_matches); if
+    total_matches > out_capacity the page is truncated and the driver
+    must re-probe in chunks.
+
+    kind: inner | left (left emits one null-extended row for probes
+    with no match)."""
+    key, _ = _probe_keys(probe, probe_key_exprs, key_domains)
+    lo = jnp.searchsorted(build.sorted_keys, key, side="left")
+    hi = jnp.searchsorted(build.sorted_keys, key, side="right")
+    counts = jnp.where(probe.row_mask, hi - lo, 0)
+    if kind == "left":
+        counts = jnp.where(probe.row_mask & (counts == 0), 1, counts)
+    offsets = jnp.cumsum(counts) - counts
+    total = jnp.sum(counts)
+
+    out_idx = jnp.arange(out_capacity)
+    # probe row for each output slot
+    p_row = jnp.searchsorted(offsets, out_idx, side="right") - 1
+    p_row = jnp.clip(p_row, 0, probe.capacity - 1).astype(jnp.int32)
+    j = out_idx - offsets[p_row]
+    live_out = out_idx < total
+    b_pos = jnp.clip(lo[p_row] + j, 0, build.capacity - 1)
+    matched = j < (hi[p_row] - lo[p_row])  # false only for left-join null rows
+    b_row = build.perm[b_pos]
+
+    out_blocks: List[Block] = []
+    for b in probe.blocks:
+        out_blocks.append(
+            Block(b.data[p_row], b.valid[p_row] & live_out, b.type, b.dictionary)
+        )
+    if build_output is None:
+        build_output = range(len(build.page.blocks))
+    for i in build_output:
+        b = build.page.blocks[i]
+        out_blocks.append(
+            Block(b.data[b_row], b.valid[b_row] & matched & live_out, b.type, b.dictionary)
+        )
+    return Page(tuple(out_blocks), live_out), total

@@ -1,0 +1,96 @@
+"""Sort and TopN kernels.
+
+Reference analog: OrderByOperator (operator/OrderByOperator.java:30)
+over PagesIndex with JIT'd comparators (sql/gen/OrderingCompiler.java),
+and TopNOperator's bounded heap (operator/TopNOperator.java:35). Row
+heaps don't vectorize; both become whole-array XLA sorts: multi-key
+ORDER BY is a sequence of stable argsorts from the least-significant
+key up (radix-style composition), and TopN is the same sort with the
+consumer reading only the first n live rows via the row mask.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.expr.compile import ExprCompiler
+from presto_tpu.expr.ir import Expr
+from presto_tpu.page import Block, Page
+
+def _value_key(data: jax.Array, ascending: bool) -> jax.Array:
+    """Exact sortable form of one key's values. Integers stay integral
+    (no float64 round-trip — BIGINT/DECIMAL beyond 2^53 must order
+    exactly); descending integers use bitwise complement (~x = -x-1,
+    overflow-free), descending floats negate."""
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int32)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return -data if not ascending else data
+    return jnp.invert(data) if not ascending else data
+
+
+def sort_perm(
+    page: Page,
+    sort_exprs: Sequence[Expr],
+    ascending: Sequence[bool],
+    nulls_first: Optional[Sequence[bool]] = None,
+) -> jax.Array:
+    """Permutation ordering live rows by the sort keys; dead rows go
+    last. Stable composition from the least-significant key up; each
+    key is two stable passes (values, then a null-rank pass) so NULL
+    ordering is exact without sentinel values colliding with real
+    data."""
+    c = ExprCompiler.for_page(page)
+    if nulls_first is None:
+        nulls_first = [False] * len(sort_exprs)
+    perm = jnp.arange(page.capacity)
+    for e, asc, nf in list(zip(sort_exprs, ascending, nulls_first))[::-1]:
+        d, v = c.compile(e)(page)
+        k = _value_key(d, asc)
+        perm = perm[jnp.argsort(k[perm], stable=True)]
+        null_rank = jnp.where(v, 1, 0) if nf else jnp.where(v, 0, 1)
+        perm = perm[jnp.argsort(null_rank[perm], stable=True)]
+    # dead rows to the end, preserving key order among live rows
+    dead = jnp.logical_not(page.row_mask)[perm]
+    perm = perm[jnp.argsort(dead, stable=True)]
+    return perm
+
+
+def gather_page(page: Page, perm: jax.Array, live: Optional[jax.Array] = None) -> Page:
+    blocks: List[Block] = []
+    for b in page.blocks:
+        blocks.append(Block(b.data[perm], b.valid[perm], b.type, b.dictionary))
+    mask = page.row_mask[perm] if live is None else live
+    return Page(tuple(blocks), mask)
+
+
+def sort_page(
+    page: Page,
+    sort_exprs: Sequence[Expr],
+    ascending: Sequence[bool],
+    nulls_first: Optional[Sequence[bool]] = None,
+) -> Page:
+    perm = sort_perm(page, sort_exprs, ascending, nulls_first)
+    return gather_page(page, perm)
+
+
+def topn_page(
+    page: Page,
+    sort_exprs: Sequence[Expr],
+    ascending: Sequence[bool],
+    n: int,
+    nulls_first: Optional[Sequence[bool]] = None,
+) -> Page:
+    """Sorted page keeping only the first n live rows."""
+    out = sort_page(page, sort_exprs, ascending, nulls_first)
+    keep = jnp.arange(page.capacity) < n
+    return Page(out.blocks, out.row_mask & keep)
+
+
+def limit_page(page: Page, n: int) -> Page:
+    """First n live rows in current order (LimitOperator analog)."""
+    seen = jnp.cumsum(page.row_mask.astype(jnp.int64))
+    return Page(page.blocks, page.row_mask & (seen <= n))

@@ -1,0 +1,144 @@
+import jax
+import numpy as np
+import pytest
+
+from presto_tpu.expr import compile_expr, compile_filter
+from presto_tpu.expr.ir import call, col, lit
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType
+
+
+def page_fixture():
+    d = Dictionary(["AIR", "MAIL", "SHIP", "TRUCK"])
+    return Page.from_arrays(
+        [
+            np.array([1, 2, 3, 4], dtype=np.int64),
+            np.array([100, 250, 375, 500], dtype=np.int64),  # decimal(12,2)
+            np.array([0.5, 1.5, 2.5, 3.5]),
+            np.array([3, 0, 1, 2], dtype=np.int32),
+            np.array([9204, 9215, 9226, 9237], dtype=np.int32),  # dates in 1995
+        ],
+        [BIGINT, DecimalType(12, 2), DOUBLE, VARCHAR, DATE],
+        valids=[None, np.array([True, True, False, True]), None, None, None],
+        dictionaries=[None, None, None, d, None],
+    )
+
+
+def run(e, page=None):
+    page = page or page_fixture()
+    f = compile_expr(e, page)
+    d, v = f(page)
+    return np.asarray(d), np.asarray(v)
+
+
+def test_arith_bigint():
+    p = page_fixture()
+    d, v = run(call("add", col(0, BIGINT), lit(10, BIGINT)), p)
+    assert d[:4].tolist() == [11, 12, 13, 14]
+    assert v[:4].all()
+
+
+def test_decimal_add_rescale():
+    dec = DecimalType(12, 2)
+    e = call("add", col(1, dec), lit(100, dec))  # +1.00
+    d, v = run(e)
+    assert d[:2].tolist() == [200, 350]
+    assert v[:4].tolist() == [True, True, False, True]  # null propagates
+
+
+def test_decimal_times_bigint_and_double():
+    dec = DecimalType(12, 2)
+    e = call("mul", col(1, dec), lit(2, BIGINT))
+    assert e.type.scale == 2
+    d, _ = run(e)
+    assert d[0] == 200
+    e2 = call("mul", col(1, dec), col(2, DOUBLE))
+    assert e2.type is DOUBLE
+    d2, _ = run(e2)
+    assert d2[1] == pytest.approx(2.5 * 1.5)
+
+
+def test_cmp_and_3vl_logic():
+    dec = DecimalType(12, 2)
+    ge = call("ge", col(1, dec), lit(250, dec))
+    d, v = run(ge)
+    assert d[[0, 1, 3]].tolist() == [False, True, True]
+    assert not v[2]
+    # null AND false = false (valid), null AND true = null
+    false_lit = call("eq", lit(1, BIGINT), lit(2, BIGINT))
+    e_and = call("and", ge, false_lit)
+    d2, v2 = run(e_and)
+    assert v2[2] and not d2[2]
+    true_lit = call("eq", lit(1, BIGINT), lit(1, BIGINT))
+    e_and2 = call("and", ge, true_lit)
+    _, v3 = run(e_and2)
+    assert not v3[2]
+
+
+def test_between_dates():
+    e = call("between", col(4, DATE), lit(9210, DATE), lit(9230, DATE))
+    d, _ = run(e)
+    assert d[:4].tolist() == [False, True, True, False]
+
+
+def test_string_eq_and_in_and_like():
+    p = page_fixture()
+    e = call("eq", col(3, VARCHAR), lit("AIR", VARCHAR))
+    d, _ = run(e, p)
+    assert d[:4].tolist() == [False, True, False, False]
+    e_in = call("in", col(3, VARCHAR), lit("AIR", VARCHAR), lit("SHIP", VARCHAR))
+    d, _ = run(e_in, p)
+    assert d[:4].tolist() == [False, True, False, True]
+    e_like = call("like", col(3, VARCHAR), lit("%AI%", VARCHAR))
+    d, _ = run(e_like, p)
+    assert d[:4].tolist() == [False, True, True, False]  # AIR, MAIL
+    e_like2 = call("like", col(3, VARCHAR), lit("A__", VARCHAR))
+    d, _ = run(e_like2, p)
+    assert d[:4].tolist() == [False, True, False, False]
+
+
+def test_case_and_if():
+    e = call(
+        "case",
+        call("eq", col(0, BIGINT), lit(1, BIGINT)), lit(10, BIGINT),
+        call("eq", col(0, BIGINT), lit(2, BIGINT)), lit(20, BIGINT),
+        lit(0, BIGINT),
+    )
+    d, v = run(e)
+    assert d[:4].tolist() == [10, 20, 0, 0]
+    assert v[:4].all()
+
+
+def test_year_extract():
+    e = call("year", col(4, DATE))
+    d, _ = run(e)
+    assert d[:4].tolist() == [1995, 1995, 1995, 1995]
+    # check a specific date: 1995-03-15 = 9204 days
+    import datetime
+    assert (datetime.date(1970, 1, 1) + datetime.timedelta(days=9204)).year == 1995
+
+
+def test_is_null_coalesce():
+    dec = DecimalType(12, 2)
+    d, v = run(call("is_null", col(1, dec)))
+    assert d[:4].tolist() == [False, False, True, False]
+    assert v[:4].all()
+    d2, v2 = run(call("coalesce", col(1, dec), lit(-1, dec)))
+    assert d2[2] == -1 and v2[:4].all()
+
+
+def test_filter_masks_nulls():
+    p = page_fixture()
+    dec = DecimalType(12, 2)
+    f = compile_filter(call("ge", col(1, dec), lit(0, dec)), p)
+    mask = np.asarray(f(p))
+    assert mask[:4].tolist() == [True, True, False, True]  # null row excluded
+
+
+def test_compiled_expr_jits():
+    p = page_fixture()
+    e = call("mul", col(1, DecimalType(12, 2)), call("sub", lit(100, DecimalType(12, 2)), col(1, DecimalType(12, 2))))
+    f = compile_expr(e, p)
+    jf = jax.jit(lambda pg: f(pg))
+    d, v = jf(p)
+    assert np.asarray(d)[0] == 100 * (100 - 100)

@@ -1,0 +1,283 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu.expr.ir import AggCall, call, col, lit
+from presto_tpu.ops import (
+    build_join,
+    filter_page,
+    grouped_aggregate,
+    limit_page,
+    merge_aggregate,
+    probe_expand,
+    probe_join,
+    project_page,
+    sort_page,
+    topn_page,
+)
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR, DecimalType
+
+
+def rows(page):
+    return page.to_pylist()
+
+
+# ---------------------------------------------------------------------------
+# filter / project
+# ---------------------------------------------------------------------------
+
+def test_filter_project():
+    p = Page.from_arrays(
+        [np.arange(10, dtype=np.int64), np.arange(10, dtype=np.float64) * 1.5],
+        [BIGINT, DOUBLE],
+    )
+    f = filter_page(p, call("lt", col(0, BIGINT), lit(5, BIGINT)))
+    assert int(f.num_rows()) == 5
+    pr = project_page(f, [call("mul", col(1, DOUBLE), lit(2.0, DOUBLE))])
+    assert [r[0] for r in rows(pr)] == [0.0, 3.0, 6.0, 9.0, 12.0]
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def _agg_page():
+    # group col (3 distinct), value col with one NULL
+    g = np.array([2, 1, 2, 1, 0, 2, 1, 2], dtype=np.int64)
+    v = np.array([10, 20, 30, 40, 50, 60, 70, 80], dtype=np.int64)
+    valid = np.array([True] * 7 + [False])
+    return Page.from_arrays([g, v], [BIGINT, BIGINT], valids=[None, valid])
+
+
+def _expected():
+    # g=0: [50]; g=1: [20,40,70]; g=2: [10,30,60,(null)]
+    return {
+        0: dict(count=1, sum=50, mn=50, mx=50, cstar=1),
+        1: dict(count=3, sum=130, mn=20, mx=70, cstar=3),
+        2: dict(count=3, sum=100, mn=10, mx=60, cstar=4),
+    }
+
+
+AGGS = [
+    AggCall("sum", col(1, BIGINT), BIGINT),
+    AggCall("count", col(1, BIGINT), BIGINT),
+    AggCall("count_star", None, BIGINT),
+    AggCall("min", col(1, BIGINT), BIGINT),
+    AggCall("max", col(1, BIGINT), BIGINT),
+    AggCall("avg", col(1, BIGINT), DOUBLE),
+]
+
+
+@pytest.mark.parametrize("domains", [None, [(0, 2)]])
+def test_grouped_aggregate(domains):
+    p = _agg_page()
+    out = grouped_aggregate(p, [col(0, BIGINT)], AGGS, max_groups=16, key_domains=domains)
+    got = {r[0]: r[1:] for r in rows(out)}
+    exp = _expected()
+    assert set(got) == set(exp)
+    for g, (s, c, cs, mn, mx, avg) in got.items():
+        e = exp[g]
+        assert (s, c, cs, mn, mx) == (e["sum"], e["count"], e["cstar"], e["mn"], e["mx"])
+        assert avg == pytest.approx(e["sum"] / e["count"])
+
+
+def test_global_aggregate():
+    p = _agg_page()
+    out = grouped_aggregate(p, [], AGGS, max_groups=1)
+    (r,) = rows(out)
+    assert r == (280, 7, 8, 10, 70, pytest.approx(280 / 7))
+
+
+def test_grouped_aggregate_decimal_and_null_group():
+    dec = DecimalType(12, 2)
+    g = np.array([1, 1, 2, 2], dtype=np.int64)
+    gvalid = np.array([True, True, False, False])  # group NULL bucket
+    v = np.array([150, 250, 100, 300], dtype=np.int64)
+    p = Page.from_arrays([g, v], [BIGINT, dec], valids=[gvalid, None])
+    out = grouped_aggregate(
+        p, [col(0, BIGINT)], [AggCall("sum", col(1, dec), dec)], max_groups=8,
+        key_domains=[(1, 2)],
+    )
+    got = {r[0]: r[1] for r in rows(out)}
+    assert got == {1: 4.0, None: 4.0}
+
+
+def test_partial_final_split():
+    p = _agg_page()
+    # split page into two halves, partial-agg each, then merge
+    m1 = np.zeros(8, bool); m1[:4] = True
+    m2 = np.zeros(8, bool); m2[4:] = True
+    p1 = Page(p.blocks, jnp.asarray(m1) & p.row_mask)
+    p2 = Page(p.blocks, jnp.asarray(m2) & p.row_mask)
+    pa1 = grouped_aggregate(p1, [col(0, BIGINT)], AGGS, max_groups=8, mode="partial")
+    pa2 = grouped_aggregate(p2, [col(0, BIGINT)], AGGS, max_groups=8, mode="partial")
+    from presto_tpu.page import concat_pages_host
+
+    merged_in = concat_pages_host([pa1, pa2])
+    out = merge_aggregate(merged_in, 1, AGGS, max_groups=8)
+    got = {r[0]: r[1:] for r in rows(out)}
+    exp = _expected()
+    for g, (s, c, cs, mn, mx, avg) in got.items():
+        e = exp[g]
+        assert (s, c, cs, mn, mx) == (e["sum"], e["count"], e["cstar"], e["mn"], e["mx"])
+
+
+def test_packed_direct_multikey():
+    # two small-domain keys -> direct path, no sort
+    a = np.array([0, 1, 0, 1, 0], dtype=np.int64)
+    b = np.array([5, 5, 6, 6, 5], dtype=np.int64)
+    v = np.array([1, 2, 3, 4, 5], dtype=np.int64)
+    p = Page.from_arrays([a, b, v], [BIGINT, BIGINT, BIGINT])
+    out = grouped_aggregate(
+        p,
+        [col(0, BIGINT), col(1, BIGINT)],
+        [AggCall("sum", col(2, BIGINT), BIGINT)],
+        max_groups=16,
+        key_domains=[(0, 1), (5, 6)],
+    )
+    got = {(r[0], r[1]): r[2] for r in rows(out)}
+    assert got == {(0, 5): 6, (1, 5): 2, (0, 6): 3, (1, 6): 4}
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+def _build_probe():
+    build = Page.from_arrays(
+        [np.array([10, 20, 30], dtype=np.int64), np.array([1.0, 2.0, 3.0])],
+        [BIGINT, DOUBLE],
+    )
+    probe = Page.from_arrays(
+        [np.array([20, 10, 99, 30, 20], dtype=np.int64),
+         np.array([5, 6, 7, 8, 9], dtype=np.int64)],
+        [BIGINT, BIGINT],
+    )
+    return build, probe
+
+
+def test_inner_join_unique():
+    b, p = _build_probe()
+    jb = build_join(b, [col(0, BIGINT)])
+    out = probe_join(jb, p, [col(0, BIGINT)], kind="inner", build_output=[1])
+    assert sorted(rows(out)) == [(10, 6, 1.0), (20, 5, 2.0), (20, 9, 2.0), (30, 8, 3.0)]
+
+
+def test_left_join_nulls():
+    b, p = _build_probe()
+    jb = build_join(b, [col(0, BIGINT)])
+    out = probe_join(jb, p, [col(0, BIGINT)], kind="left", build_output=[1])
+    got = sorted(rows(out))
+    assert (99, 7, None) in got and len(got) == 5
+
+
+def test_semi_anti_join():
+    b, p = _build_probe()
+    jb = build_join(b, [col(0, BIGINT)])
+    semi = probe_join(jb, p, [col(0, BIGINT)], kind="semi")
+    assert sorted(r[0] for r in rows(semi)) == [10, 20, 20, 30]
+    anti = probe_join(jb, p, [col(0, BIGINT)], kind="anti")
+    assert [r[0] for r in rows(anti)] == [99]
+
+
+def test_null_keys_never_match():
+    b = Page.from_arrays(
+        [np.array([10, 20], dtype=np.int64)], [BIGINT],
+        valids=[np.array([True, False])],
+    )
+    p = Page.from_arrays(
+        [np.array([10, 20], dtype=np.int64)], [BIGINT],
+        valids=[np.array([True, False])],
+    )
+    jb = build_join(b, [col(0, BIGINT)])
+    out = probe_join(jb, p, [col(0, BIGINT)], kind="inner", build_output=[])
+    assert rows(out) == [(10,)]
+
+
+def test_expand_join_many_to_many():
+    build = Page.from_arrays(
+        [np.array([1, 1, 2, 3, 3, 3], dtype=np.int64),
+         np.array([100, 101, 200, 300, 301, 302], dtype=np.int64)],
+        [BIGINT, BIGINT],
+    )
+    probe = Page.from_arrays(
+        [np.array([3, 1, 7], dtype=np.int64), np.array([-1, -2, -3], dtype=np.int64)],
+        [BIGINT, BIGINT],
+    )
+    jb = build_join(build, [col(0, BIGINT)])
+    out, total = probe_expand(jb, probe, [col(0, BIGINT)], out_capacity=16, build_output=[1])
+    assert int(total) == 5
+    got = sorted(rows(out))
+    assert got == [(1, -2, 100), (1, -2, 101), (3, -1, 300), (3, -1, 301), (3, -1, 302)]
+    # left flavor keeps unmatched probe rows
+    outl, totall = probe_expand(jb, probe, [col(0, BIGINT)], out_capacity=16, kind="left", build_output=[1])
+    assert int(totall) == 6
+    assert (7, -3, None) in rows(outl)
+
+
+def test_expand_join_overflow_reported():
+    build = Page.from_arrays([np.zeros(4, dtype=np.int64)], [BIGINT])
+    probe = Page.from_arrays([np.zeros(4, dtype=np.int64)], [BIGINT])
+    jb = build_join(build, [col(0, BIGINT)])
+    out, total = probe_expand(jb, probe, [col(0, BIGINT)], out_capacity=8)
+    assert int(total) == 16  # 4x4 — caller must chunk
+
+
+def test_composite_key_join():
+    build = Page.from_arrays(
+        [np.array([1, 1, 2], dtype=np.int64), np.array([7, 8, 7], dtype=np.int64),
+         np.array([11, 12, 13], dtype=np.int64)],
+        [BIGINT, BIGINT, BIGINT],
+    )
+    probe = Page.from_arrays(
+        [np.array([1, 2, 1], dtype=np.int64), np.array([8, 7, 9], dtype=np.int64)],
+        [BIGINT, BIGINT],
+    )
+    doms = [(1, 2), (7, 9)]
+    jb = build_join(build, [col(0, BIGINT), col(1, BIGINT)], key_domains=doms)
+    out = probe_join(jb, probe, [col(0, BIGINT), col(1, BIGINT)], key_domains=doms,
+                     kind="inner", build_output=[2])
+    assert sorted(rows(out)) == [(1, 8, 12), (2, 7, 13)]
+
+
+# ---------------------------------------------------------------------------
+# sort / topn / limit
+# ---------------------------------------------------------------------------
+
+def test_sort_multi_key():
+    p = Page.from_arrays(
+        [np.array([2, 1, 2, 1], dtype=np.int64), np.array([5.0, 6.0, 4.0, 7.0])],
+        [BIGINT, DOUBLE],
+    )
+    out = sort_page(p, [col(0, BIGINT), col(1, DOUBLE)], [True, False])
+    assert rows(out) == [(1, 7.0), (1, 6.0), (2, 5.0), (2, 4.0)]
+
+
+def test_sort_nulls_last_and_dead_rows():
+    p = Page.from_arrays(
+        [np.array([3, 1, 2], dtype=np.int64)], [BIGINT],
+        valids=[np.array([True, False, True])],
+    )
+    out = sort_page(p, [col(0, BIGINT)], [True])
+    assert rows(out) == [(2,), (3,), (None,)]
+
+
+def test_topn_limit():
+    p = Page.from_arrays([np.array([4, 2, 9, 1, 7], dtype=np.int64)], [BIGINT])
+    t = topn_page(p, [col(0, BIGINT)], [True], n=3)
+    assert rows(t) == [(1,), (2,), (4,)]
+    l = limit_page(p, 2)
+    assert rows(l) == [(4,), (2,)]
+
+
+def test_kernels_jit_cleanly():
+    p = _agg_page()
+
+    @jax.jit
+    def agg(pg):
+        return grouped_aggregate(pg, [col(0, BIGINT)], AGGS, max_groups=8)
+
+    out = agg(p)
+    assert len(rows(out)) == 3
