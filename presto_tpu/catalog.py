@@ -1,0 +1,99 @@
+"""Catalog / metadata layer.
+
+Reference analog: ``presto-main/.../metadata/MetadataManager.java`` (the
+engine-facing facade over connectors) plus the connector metadata SPI
+(``presto-spi/.../connector/ConnectorMetadata.java``).  Kept deliberately
+small: a Connector exposes schemas, splits and Pages; the Catalog maps
+``table`` names to connectors and serves column stats (min/max domains)
+that the planner uses for exact key packing (see ops/aggregate.py
+pack_or_hash_keys) — the analog of the reference's table statistics path
+(``spi/statistics/TableStatistics.java`` via ``metadata/MetadataManager``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Protocol, Sequence, Tuple
+
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import Type
+
+
+class Connector(Protocol):
+    """Data-source contract (ConnectorMetadata + ConnectorSplitManager +
+    ConnectorPageSourceProvider rolled together; reference:
+    presto-spi/.../connector/)."""
+
+    def table_names(self) -> List[str]: ...
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]: ...
+
+    def num_splits(self, table: str) -> int: ...
+
+    def page_for_split(self, table: str, split: int, capacity: Optional[int] = None) -> Page: ...
+
+    def row_count(self, table: str) -> int: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnHandle:
+    """Resolved column: position in the scan output + type + stats."""
+
+    name: str
+    type: Type
+    index: int
+    domain: Optional[Tuple[int, int]] = None  # known (lo, hi) in device repr
+    dictionary: Optional[Dictionary] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHandle:
+    connector_name: str
+    table: str
+    columns: Tuple[ColumnHandle, ...]
+    row_count: int
+    num_splits: int
+
+    def column(self, name: str) -> Optional[ColumnHandle]:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        return None
+
+
+class Catalog:
+    """Connector registry + name resolution (MetadataManager analog)."""
+
+    def __init__(self):
+        self._connectors: Dict[str, object] = {}
+
+    def register(self, name: str, connector) -> None:
+        self._connectors[name] = connector
+
+    def connector(self, name: str):
+        return self._connectors[name]
+
+    def resolve(self, table: str) -> TableHandle:
+        """Find ``table`` in any registered connector (single default
+        schema — the reference's catalog.schema.table triple collapses
+        to a flat namespace here; connectors can prefix)."""
+        for cname, conn in self._connectors.items():
+            if table in conn.table_names():
+                schema = conn.schema(table)
+                cols = []
+                for i, (col, t) in enumerate(schema):
+                    dom = None
+                    dic = None
+                    if hasattr(conn, "column_domain"):
+                        dom = conn.column_domain(table, col)
+                    if hasattr(conn, "dictionary_for"):
+                        dic = conn.dictionary_for(table, col)
+                    cols.append(ColumnHandle(col, t, i, dom, dic))
+                return TableHandle(
+                    connector_name=cname,
+                    table=table,
+                    columns=tuple(cols),
+                    row_count=conn.row_count(table),
+                    num_splits=conn.num_splits(table),
+                )
+        raise KeyError(f"table not found in any catalog: {table}")

@@ -621,6 +621,47 @@ class Tpch:
     def column_names(self, table: str) -> List[str]:
         return [n for n, _ in SCHEMAS[table]]
 
+    def table_names(self) -> List[str]:
+        return list(SCHEMAS.keys())
+
+    def column_domain(self, table: str, column: str) -> Optional[Tuple[int, int]]:
+        """Known (lo, hi) of a column in its device representation —
+        the stats feed for exact key packing (planner/exact joins).
+        Reference analog: presto-tpch/.../statistics/ column stats."""
+        t = dict(SCHEMAS[table])[column]
+        if t.is_string:
+            return (0, len(self.dictionary_for(table, column)) - 1)
+        max_orderkey = int(((self.n_orders - 1) >> 3) << 5 | ((self.n_orders - 1) & 7)) + 1
+        doms: Dict[str, Tuple[int, int]] = {
+            "r_regionkey": (0, 4),
+            "n_nationkey": (0, 24),
+            "n_regionkey": (0, 4),
+            "s_suppkey": (1, self.n_suppliers),
+            "s_nationkey": (0, 24),
+            "c_custkey": (1, self.n_customers),
+            "c_nationkey": (0, 24),
+            "p_partkey": (1, self.n_parts),
+            "p_size": (1, 50),
+            "ps_partkey": (1, self.n_parts),
+            "ps_suppkey": (1, self.n_suppliers),
+            "ps_availqty": (1, 9999),
+            "o_orderkey": (1, max_orderkey),
+            "o_custkey": (1, self.n_customers),
+            "o_orderdate": (MIN_ORDER_DATE, MAX_ORDER_DATE),
+            "o_shippriority": (0, 0),
+            "l_orderkey": (1, max_orderkey),
+            "l_partkey": (1, self.n_parts),
+            "l_suppkey": (1, self.n_suppliers),
+            "l_linenumber": (1, 7),
+            "l_quantity": (100, 5000),
+            "l_discount": (0, 10),
+            "l_tax": (0, 8),
+            "l_shipdate": (MIN_ORDER_DATE + 1, MAX_ORDER_DATE + 121),
+            "l_commitdate": (MIN_ORDER_DATE + 30, MAX_ORDER_DATE + 90),
+            "l_receiptdate": (MIN_ORDER_DATE + 2, MAX_ORDER_DATE + 151),
+        }
+        return doms.get(column)
+
 
 def _address(i: int, salt: int) -> str:
     h = int(_hash_u64(salt, np.asarray([i]))[0])

@@ -1,0 +1,1 @@
+from presto_tpu.exec.local import LocalRunner, MaterializedResult  # noqa: F401

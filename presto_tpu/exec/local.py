@@ -1,0 +1,424 @@
+"""Single-process pipeline executor.
+
+Reference analog: the worker execution tier — ``operator/Driver.java:262``
+(processFor loop moving Pages between operators), pipelines from
+``planner/LocalExecutionPlanner.java:271``, and the in-process harness
+``testing/LocalQueryRunner.java:584``.
+
+TPU-first redesign: instead of thread-per-driver pulling one Page at a
+time through virtual operator calls, the executor fuses every *streaming
+chain* of a plan (scan -> filter -> project -> join-probe -> partial-agg)
+into ONE jitted function Page -> Page, so XLA compiles the whole chain
+into a single fused TPU program per split.  Pipeline breakers
+(aggregation finalization, join build, sort) materialize, mirroring the
+reference's pipeline boundaries at LocalExchange/HashBuilder points.
+
+Data-dependent sizes (the big CPU/TPU impedance mismatch, SURVEY.md §7)
+are handled with static capacities + live masks; expanding joins and
+group-by overflow use count-check-and-retry with doubled capacity
+(the analog of MultiChannelGroupByHash.tryRehash and the yielding
+LookupJoinPageBuilder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.ops.aggregate import grouped_aggregate, merge_aggregate
+from presto_tpu.ops.filter_project import filter_page, project_page
+from presto_tpu.ops.join import JoinBuild, build_join, probe_expand, probe_join
+from presto_tpu.ops.sort import limit_page, sort_page, sort_perm, topn_page
+from presto_tpu.page import Block, Page
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    FilterNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    ValuesNode,
+)
+from presto_tpu.types import Type
+
+
+@dataclasses.dataclass
+class MaterializedResult:
+    """Host-side query result (testing/MaterializedResult.java analog)."""
+
+    names: List[str]
+    types: List[Type]
+    rows: List[tuple]
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __iter__(self):
+        return iter(self.rows)
+
+
+def concat_pages_device(pages: Sequence[Page]) -> Page:
+    """Concatenate pages column-wise on device (capacities may differ)."""
+    if len(pages) == 1:
+        return pages[0]
+    blocks = []
+    for i in range(pages[0].num_blocks):
+        data = jnp.concatenate([p.blocks[i].data for p in pages])
+        valid = jnp.concatenate([p.blocks[i].valid for p in pages])
+        b0 = pages[0].blocks[i]
+        blocks.append(Block(data, valid, b0.type, b0.dictionary))
+    mask = jnp.concatenate([p.row_mask for p in pages])
+    return Page(tuple(blocks), mask)
+
+
+def slice_page(page: Page, n: int) -> Page:
+    """First n physical rows (static slice — used after sorts where live
+    rows are compacted to the front)."""
+    blocks = tuple(
+        Block(b.data[:n], b.valid[:n], b.type, b.dictionary) for b in page.blocks
+    )
+    return Page(blocks, page.row_mask[:n])
+
+
+class GroupCapacityExceeded(Exception):
+    """An aggregation saw more groups than its static capacity; the
+    runner retries the query with a doubled max_groups (the analog of
+    MultiChannelGroupByHash.java:138 tryRehash)."""
+
+    def __init__(self, needed: int):
+        self.needed = needed
+
+
+def _is_streaming_join(node: JoinNode) -> bool:
+    """True when the probe is row-aligned (jittable in a chain):
+    semi/anti (presence tests) or unique-key builds."""
+    return node.kind in ("semi", "anti") or node.unique_build
+
+
+class LocalRunner:
+    """Executes a plan tree against registered connectors.
+
+    ``jit=False`` runs chains eagerly for debugging.
+    """
+
+    def __init__(self, catalog: Catalog, jit: bool = True, split_capacity: Optional[int] = None):
+        self.catalog = catalog
+        self.jit = jit
+        self.split_capacity = split_capacity
+        self._chain_cache: Dict[PlanNode, Callable] = {}
+        self._fold_cache: Dict[PlanNode, Callable] = {}
+        self._agg_overrides: Dict[PlanNode, int] = {}
+        self._partial_nodes: Dict[PlanNode, AggregationNode] = {}
+        self._builds: Dict[JoinNode, JoinBuild] = {}
+
+    # ------------------------------------------------------------------
+    def run(self, plan: PlanNode) -> MaterializedResult:
+        page = self.run_to_page(plan)
+        out = page.compact_host()
+        return MaterializedResult(
+            names=plan.output_names,
+            types=plan.output_types,
+            rows=out.to_pylist(),
+        )
+
+    def run_to_page(self, plan: PlanNode) -> Page:
+        while True:
+            try:
+                self._builds.clear()
+                return self._execute_to_page(plan)
+            except GroupCapacityExceeded:
+                continue  # _agg_overrides updated; re-execute
+
+    def explain(self, plan: PlanNode) -> str:
+        from presto_tpu.planner.plan import plan_tree_str
+
+        return plan_tree_str(plan)
+
+    # ------------------------------------------------------------------
+    def _execute_to_page(self, node: PlanNode) -> Page:
+        pages = list(self._pages(node))
+        if not pages:
+            return Page.empty(node.output_types, 1)
+        return concat_pages_device(pages)
+
+    def _pages(self, node: PlanNode) -> Iterator[Page]:
+        """Stream output pages of ``node`` (pull model, Driver analog)."""
+        if isinstance(node, OutputNode):
+            yield from self._pages(node.source)
+            return
+
+        if isinstance(node, LimitNode):
+            remaining = node.count
+            for p in self._pages(node.source):
+                if remaining <= 0:
+                    return
+                p = limit_page(p, remaining)
+                remaining -= int(np.asarray(p.num_rows()))
+                yield p
+            return
+
+        if isinstance(node, SortNode):
+            src = self._execute_to_page(node.source)
+            yield sort_page(src, node.sort_exprs, node.ascending, node.nulls_first)
+            return
+
+        if isinstance(node, TopNNode):
+            yield self._run_topn(node)
+            return
+
+        if isinstance(node, AggregationNode) and node.step in ("single", "final"):
+            yield self._run_aggregation(node)
+            return
+
+        if isinstance(node, ValuesNode):
+            cols = [
+                np.asarray([r[i] for r in node.rows], dtype=t.np_dtype)
+                for i, t in enumerate(node.types)
+            ]
+            yield Page.from_arrays(cols, node.types)
+            return
+
+        if isinstance(node, JoinNode) and not _is_streaming_join(node):
+            yield from self._expanding_join_pages(node)
+            return
+
+        # streaming chain rooted at a scan or breaker
+        yield from self._chain_pages(node)
+
+    # ------------------------------------------------------------------
+    # streaming-chain compilation
+    # ------------------------------------------------------------------
+    def _chain_pages(self, node: PlanNode) -> Iterator[Page]:
+        leaf = self._chain_leaf(node)
+        joins: List[JoinNode] = []
+        stage = self._build_stage(node, joins)
+        if node in self._chain_cache:
+            fn = self._chain_cache[node]
+        else:
+            fn = jax.jit(stage) if self.jit else stage
+            self._chain_cache[node] = fn
+        consts = {f"build_{i}": self._materialize_build(j) for i, j in enumerate(joins)}
+        for page in self._source_pages(leaf):
+            yield fn(page, consts)
+
+    def _chain_leaf(self, node: PlanNode) -> PlanNode:
+        if isinstance(node, (FilterNode, ProjectNode)):
+            return self._chain_leaf(node.source)
+        if isinstance(node, AggregationNode) and node.step == "partial":
+            return self._chain_leaf(node.source)
+        if isinstance(node, JoinNode) and _is_streaming_join(node):
+            return self._chain_leaf(node.left)  # probe side streams
+        return node
+
+    def _build_stage(self, node: PlanNode, joins: List[JoinNode]):
+        """Recursively build fn(page, consts)->page for the streaming
+        prefix of ``node``; below the chain leaf, the identity."""
+        if isinstance(node, FilterNode):
+            inner = self._build_stage(node.source, joins)
+            pred = node.predicate
+            return lambda p, c: filter_page(inner(p, c), pred)
+
+        if isinstance(node, ProjectNode):
+            inner = self._build_stage(node.source, joins)
+            projections = list(node.projections)
+            return lambda p, c: project_page(inner(p, c), projections)
+
+        if isinstance(node, AggregationNode) and node.step == "partial":
+            inner = self._build_stage(node.source, joins)
+            group_exprs = list(node.group_exprs)
+            aggs = list(node.aggs)
+            mg = self._max_groups(node)
+            kd = node.key_domains
+
+            def agg_stage(p, c):
+                return grouped_aggregate(
+                    inner(p, c), group_exprs, aggs, mg, key_domains=kd, mode="partial"
+                )
+
+            return agg_stage
+
+        if isinstance(node, JoinNode) and _is_streaming_join(node):
+            inner = self._build_stage(node.left, joins)
+            key = f"build_{len(joins)}"
+            joins.append(node)
+            build_output = list(range(len(node.right.channels)))
+            kd = node.key_domains
+            left_keys = list(node.left_keys)
+            kind = node.kind
+
+            def probe_stage(p, c):
+                return probe_join(
+                    c[key], inner(p, c), left_keys, key_domains=kd,
+                    kind=kind, build_output=build_output,
+                )
+
+            return probe_stage
+
+        # chain leaf (scan / breaker / expanding join): identity
+        return lambda p, c: p
+
+    def _source_pages(self, node: PlanNode) -> Iterator[Page]:
+        if isinstance(node, TableScanNode):
+            conn = self.catalog.connector(node.handle.connector_name)
+            full = [ch.name for ch in node.handle.columns]
+            idx = list(node.columns)
+            for split in range(node.handle.num_splits):
+                page = conn.page_for_split(
+                    node.handle.table, split, capacity=self.split_capacity
+                )
+                yield Page(tuple(page.blocks[i] for i in idx), page.row_mask)
+        else:
+            yield from self._pages(node)
+
+    def _materialize_build(self, node: JoinNode) -> JoinBuild:
+        if node not in self._builds:
+            build_page = self._execute_to_page(node.right)
+            self._builds[node] = build_join(
+                build_page, node.right_keys, key_domains=node.key_domains
+            )
+        return self._builds[node]
+
+    # ------------------------------------------------------------------
+    def _expanding_join_pages(self, node: JoinNode) -> Iterator[Page]:
+        """Many-to-many probe with capacity retry (the analog of the
+        reference's yielding LookupJoinPageBuilder)."""
+        build = self._materialize_build(node)
+        kd = node.key_domains
+        left_keys = list(node.left_keys)
+        build_output = list(range(len(node.right.channels)))
+        kind = node.kind
+
+        def probe(b, p, out_capacity):
+            return probe_expand(
+                b, p, left_keys, out_capacity, key_domains=kd,
+                kind=kind, build_output=build_output,
+            )
+
+        if node in self._chain_cache:
+            fn = self._chain_cache[node]
+        else:
+            fn = jax.jit(probe, static_argnames=("out_capacity",)) if self.jit else probe
+            self._chain_cache[node] = fn
+
+        for p in self._pages(node.left):
+            cap = max(int(p.capacity), 1024)
+            out, total = fn(build, p, out_capacity=cap)
+            t = int(np.asarray(total))
+            if t > cap:
+                cap2 = 1 << (t - 1).bit_length()
+                out, _ = fn(build, p, out_capacity=cap2)
+            yield out
+
+    # ------------------------------------------------------------------
+    def _run_topn(self, node: TopNNode) -> Page:
+        """Fold: keep a device-resident accumulator of exactly ``count``
+        rows; each input page is sorted together with the accumulator
+        and truncated (TopNOperator.java bounded-heap analog)."""
+        n = node.count
+        sort_exprs = list(node.sort_exprs)
+        ascending = list(node.ascending)
+        nulls_first = node.nulls_first
+
+        def fold(acc: Optional[Page], p: Page) -> Page:
+            cand = p if acc is None else concat_pages_device([acc, p])
+            s = sort_page(cand, sort_exprs, ascending, nulls_first)
+            keep = jnp.arange(s.capacity) < n
+            return slice_page(Page(s.blocks, s.row_mask & keep), n)
+
+        fold_fn = self._fold_cache.get(node)
+        if fold_fn is None:
+            fold_fn = jax.jit(fold) if self.jit else fold
+            self._fold_cache[node] = fold_fn
+
+        acc: Optional[Page] = None
+        for p in self._pages(node.source):
+            acc = fold(acc, p) if acc is None else fold_fn(acc, p)
+        if acc is None:
+            return Page.empty(node.output_types, max(n, 1))
+        return acc
+
+    # ------------------------------------------------------------------
+    def _max_groups(self, node: AggregationNode) -> int:
+        if node in self._agg_overrides:
+            return self._agg_overrides[node]
+        kd = node.key_domains
+        if node.group_exprs and kd and all(d is not None for d in kd):
+            prod = 1
+            for lo, hi in kd:
+                prod *= hi - lo + 2
+            if prod <= node.max_groups:
+                return prod
+        return node.max_groups
+
+    def _exact_capacity(self, node: AggregationNode, mg: int) -> bool:
+        kd = node.key_domains
+        if node.group_exprs and kd and all(d is not None for d in kd):
+            prod = 1
+            for lo, hi in kd:
+                prod *= hi - lo + 2
+            return prod <= mg
+        return False
+
+    def _run_aggregation(self, node: AggregationNode) -> Page:
+        """Breaker: stream partial pages and fold-merge with a bounded
+        accumulator (2*max_groups concat each step, static shapes)."""
+        mg = self._max_groups(node)
+        aggs = list(node.aggs)
+        num_keys = len(node.group_exprs)
+        kd = node.key_domains
+
+        if node.step == "final":
+            source: PlanNode = node.source
+        else:
+            # step == 'single': inject a per-page partial step
+            partial = self._partial_nodes.get(node)
+            if partial is None:
+                partial = AggregationNode(
+                    source=node.source,
+                    group_exprs=node.group_exprs,
+                    group_names=node.group_names,
+                    aggs=node.aggs,
+                    agg_names=node.agg_names,
+                    step="partial",
+                    max_groups=node.max_groups,
+                )
+                self._partial_nodes[node] = partial
+            self._agg_overrides[partial] = mg
+            source = partial
+
+        def fold(acc: Optional[Page], p: Page) -> Page:
+            cand = p if acc is None else concat_pages_device([acc, p])
+            return merge_aggregate(cand, num_keys, aggs, mg, key_domains=kd, mode="partial")
+
+        fold_fn = self._fold_cache.get(node)
+        if fold_fn is None:
+            fold_fn = jax.jit(fold) if self.jit else fold
+            self._fold_cache[node] = fold_fn
+
+        acc: Optional[Page] = None
+        for p in self._pages(source):
+            acc = fold(acc, p) if acc is None else fold_fn(acc, p)
+        if acc is None:
+            return Page.empty(node.output_types, max(mg, 1))
+        out = merge_aggregate(acc, num_keys, aggs, mg, key_domains=kd, mode="single")
+        self._check_overflow(node, out, mg)
+        return out
+
+    def _check_overflow(self, node: AggregationNode, out: Page, mg: int) -> None:
+        if not node.group_exprs or self._exact_capacity(node, mg):
+            return
+        live = int(np.asarray(jnp.sum(out.row_mask.astype(jnp.int32))))
+        if live >= mg and mg < (1 << 26):
+            self._agg_overrides[node] = mg * 2
+            self._chain_cache.clear()
+            self._fold_cache.clear()
+            raise GroupCapacityExceeded(mg * 2)

@@ -1,0 +1,13 @@
+from presto_tpu.planner.plan import (  # noqa: F401
+    AggregationNode,
+    FilterNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    ValuesNode,
+)

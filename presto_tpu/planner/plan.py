@@ -1,0 +1,306 @@
+"""Logical/physical plan nodes.
+
+Reference analog: ``presto-main/.../sql/planner/plan/`` (46 node types:
+TableScanNode.java, FilterNode.java, ProjectNode.java,
+AggregationNode.java, JoinNode.java, SortNode.java, TopNNode.java,
+LimitNode.java, OutputNode.java, ExchangeNode.java, ValuesNode.java...).
+The reference's symbol-based plans (Symbol -> Expression maps) become
+positional: every node's output is a flat channel list, expressions are
+``expr.ir`` trees over the source's channels.  Positional channels keep
+the lowering to device kernels trivial — a channel IS a Block index.
+
+Each node knows its output schema: ``output_names`` / ``output_types``
+(+ per-channel dictionary/domain metadata threaded for planner use).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from presto_tpu.catalog import TableHandle
+from presto_tpu.expr.ir import AggCall, Expr
+from presto_tpu.page import Dictionary
+from presto_tpu.types import Type
+
+from presto_tpu.ops.aggregate import output_type as agg_output_type
+from presto_tpu.ops.aggregate import state_types as agg_state_types
+
+
+@dataclasses.dataclass(eq=False)
+class Channel:
+    """Output column descriptor: name + type + optional dictionary and
+    known value domain (for exact key packing)."""
+
+    name: str
+    type: Type
+    dictionary: Optional[Dictionary] = None
+    domain: Optional[Tuple[int, int]] = None
+
+
+class PlanNode:
+    @property
+    def sources(self) -> List["PlanNode"]:
+        return []
+
+    @property
+    def channels(self) -> List[Channel]:
+        raise NotImplementedError
+
+    @property
+    def output_names(self) -> List[str]:
+        return [c.name for c in self.channels]
+
+    @property
+    def output_types(self) -> List[Type]:
+        return [c.type for c in self.channels]
+
+
+def _expr_channel(e: Expr, name: str, src: List[Channel]) -> Channel:
+    """Derive output channel metadata for a projection expression."""
+    from presto_tpu.expr.ir import ColumnRef
+
+    if isinstance(e, ColumnRef) and e.index < len(src):
+        s = src[e.index]
+        return Channel(name, e.type, s.dictionary, s.domain)
+    return Channel(name, e.type)
+
+
+@dataclasses.dataclass(eq=False)
+class TableScanNode(PlanNode):
+    """Scan selected columns of a table (TableScanNode.java analog).
+    ``columns`` are indexes into the connector's full schema."""
+
+    handle: TableHandle
+    columns: List[int]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return [
+            Channel(c.name, c.type, c.dictionary, c.domain)
+            for i in self.columns
+            for c in [self.handle.columns[i]]
+        ]
+
+
+@dataclasses.dataclass(eq=False)
+class FilterNode(PlanNode):
+    source: PlanNode
+    predicate: Expr
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.source.channels
+
+
+@dataclasses.dataclass(eq=False)
+class ProjectNode(PlanNode):
+    source: PlanNode
+    projections: List[Expr]
+    names: List[str]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def channels(self) -> List[Channel]:
+        src = self.source.channels
+        return [_expr_channel(e, n, src) for e, n in zip(self.projections, self.names)]
+
+
+@dataclasses.dataclass(eq=False)
+class AggregationNode(PlanNode):
+    """Grouped/global aggregation (AggregationNode.java analog).
+
+    step: 'single' | 'partial' | 'final' — the PARTIAL/FINAL split of
+    iterative/rule/PushPartialAggregationThroughExchange.java.
+    For step='final' the source emits partial-state pages (keys then
+    state columns).
+    """
+
+    source: PlanNode
+    group_exprs: List[Expr]
+    group_names: List[str]
+    aggs: List[AggCall]
+    agg_names: List[str]
+    step: str = "single"
+    max_groups: int = 1 << 16
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def key_domains(self) -> List[Optional[Tuple[int, int]]]:
+        from presto_tpu.expr.ir import ColumnRef
+
+        src = self.source.channels
+        out = []
+        for e in self.group_exprs:
+            if isinstance(e, ColumnRef) and src[e.index].domain is not None:
+                out.append(src[e.index].domain)
+            else:
+                out.append(None)
+        return out
+
+    @property
+    def channels(self) -> List[Channel]:
+        src = self.source.channels
+        keys = [_expr_channel(e, n, src) for e, n in zip(self.group_exprs, self.group_names)]
+        if self.step == "partial":
+            states = []
+            for agg, name in zip(self.aggs, self.agg_names):
+                for j, t in enumerate(agg_state_types(agg)):
+                    states.append(Channel(f"{name}${j}", t))
+            return keys + states
+        return keys + [
+            Channel(n, agg_output_type(a)) for a, n in zip(self.aggs, self.agg_names)
+        ]
+
+
+@dataclasses.dataclass(eq=False)
+class JoinNode(PlanNode):
+    """Hash join (JoinNode.java analog). ``left`` is the probe side,
+    ``right`` the build side (the reference also builds on the right).
+    kind: inner | left | semi | anti.  ``unique_build``: planner's
+    guarantee that build keys are unique (primary-key joins) enabling
+    the probe-aligned kernel instead of the expanding one."""
+
+    left: PlanNode
+    right: PlanNode
+    left_keys: List[Expr]
+    right_keys: List[Expr]
+    kind: str = "inner"
+    unique_build: bool = False
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+    @property
+    def key_domains(self) -> List[Optional[Tuple[int, int]]]:
+        """Join-key packing domains: union of probe/build side domains
+        per key position (both sides must pack identically)."""
+        from presto_tpu.expr.ir import ColumnRef
+
+        lch, rch = self.left.channels, self.right.channels
+        out = []
+        for le, re_ in zip(self.left_keys, self.right_keys):
+            ld = lch[le.index].domain if isinstance(le, ColumnRef) else None
+            rd = rch[re_.index].domain if isinstance(re_, ColumnRef) else None
+            if ld is not None and rd is not None:
+                out.append((min(ld[0], rd[0]), max(ld[1], rd[1])))
+            else:
+                out.append(None)
+        return out
+
+    @property
+    def channels(self) -> List[Channel]:
+        if self.kind in ("semi", "anti"):
+            return self.left.channels
+        return self.left.channels + self.right.channels
+
+
+@dataclasses.dataclass(eq=False)
+class SortNode(PlanNode):
+    source: PlanNode
+    sort_exprs: List[Expr]
+    ascending: List[bool]
+    nulls_first: Optional[List[bool]] = None
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.source.channels
+
+
+@dataclasses.dataclass(eq=False)
+class TopNNode(PlanNode):
+    source: PlanNode
+    sort_exprs: List[Expr]
+    ascending: List[bool]
+    count: int = 0
+    nulls_first: Optional[List[bool]] = None
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.source.channels
+
+
+@dataclasses.dataclass(eq=False)
+class LimitNode(PlanNode):
+    source: PlanNode
+    count: int
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.source.channels
+
+
+@dataclasses.dataclass(eq=False)
+class ValuesNode(PlanNode):
+    """Literal rows (ValuesNode.java analog)."""
+
+    names: List[str]
+    types: List[Type]
+    rows: List[tuple]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return [Channel(n, t) for n, t in zip(self.names, self.types)]
+
+
+@dataclasses.dataclass(eq=False)
+class OutputNode(PlanNode):
+    """Root: names the final result columns (OutputNode.java analog)."""
+
+    source: PlanNode
+    names: List[str]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def channels(self) -> List[Channel]:
+        src = self.source.channels
+        return [Channel(n, c.type, c.dictionary, c.domain) for n, c in zip(self.names, src)]
+
+
+def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style rendering (planPrinter/PlanPrinter.java analog)."""
+    pad = "  " * indent
+    name = type(node).__name__.replace("Node", "")
+    detail = ""
+    if isinstance(node, TableScanNode):
+        detail = f" {node.handle.table}{[c.name for c in node.channels]}"
+    elif isinstance(node, FilterNode):
+        detail = f" {node.predicate!r}"
+    elif isinstance(node, ProjectNode):
+        detail = f" {node.names}"
+    elif isinstance(node, AggregationNode):
+        detail = f" [{node.step}] keys={node.group_names} aggs={node.aggs!r}"
+    elif isinstance(node, JoinNode):
+        detail = f" [{node.kind}] {node.left_keys!r} = {node.right_keys!r}"
+    elif isinstance(node, (LimitNode, TopNNode)):
+        detail = f" {node.count}"
+    out = f"{pad}- {name}{detail}\n"
+    for s in node.sources:
+        out += plan_tree_str(s, indent + 1)
+    return out

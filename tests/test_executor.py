@@ -1,0 +1,257 @@
+"""Executor tests: hand-built plans vs numpy oracles on TPC-H data.
+
+Reference analog: operator-level tests driving the Operator interface
+with hand-built inputs (presto-main test OperatorAssertion pattern) and
+LocalQueryRunner end-to-end checks.
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.exec.local import LocalRunner
+from presto_tpu.expr.ir import AggCall, call, col, lit
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    FilterNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+)
+from presto_tpu.types import BIGINT, DATE, DOUBLE, DecimalType
+
+
+@pytest.fixture(scope="module")
+def env():
+    tpch = Tpch(sf=0.01, split_rows=8192)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    return tpch, catalog, LocalRunner(catalog)
+
+
+def _scan(catalog, table, cols):
+    h = catalog.resolve(table)
+    names = [c.name for c in h.columns]
+    return TableScanNode(h, [names.index(c) for c in cols]), h
+
+
+def _full(tpch, table):
+    """All splits of a table concatenated host-side."""
+    parts = [tpch.generate_split(table, s) for s in range(tpch.num_splits(table))]
+    return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+
+DATE_1994 = (np.datetime64("1994-01-01") - np.datetime64("1970-01-01")).astype(int)
+DATE_1995 = (np.datetime64("1995-01-01") - np.datetime64("1970-01-01")).astype(int)
+
+
+def test_q6_shape(env):
+    """TPC-H Q6: scan+filter+project+global agg (BASELINE.md config)."""
+    tpch, catalog, runner = env
+    scan, h = _scan(catalog, "lineitem", ["l_shipdate", "l_discount", "l_quantity", "l_extendedprice"])
+    shipdate = col(0, DATE)
+    discount = col(1, DecimalType(12, 2))
+    quantity = col(2, DecimalType(12, 2))
+    extprice = col(3, DecimalType(12, 2))
+    pred = call(
+        "and",
+        call(
+            "and",
+            call("ge", shipdate, lit(DATE_1994, DATE)),
+            call("lt", shipdate, lit(DATE_1995, DATE)),
+        ),
+        call(
+            "and",
+            call("between", discount, lit(5, DecimalType(12, 2)), lit(7, DecimalType(12, 2))),
+            call("lt", quantity, lit(2400, DecimalType(12, 2))),
+        ),
+    )
+    f = FilterNode(scan, pred)
+    proj = ProjectNode(f, [call("mul", extprice, discount)], ["revenue"])
+    agg = AggregationNode(
+        proj, [], [], [AggCall("sum", col(0, DecimalType(18, 4)), DecimalType(18, 4))], ["revenue"]
+    )
+    out = OutputNode(agg, ["revenue"])
+    res = runner.run(out)
+
+    li = _full(tpch, "lineitem")
+    sel = (
+        (li["l_shipdate"] >= DATE_1994)
+        & (li["l_shipdate"] < DATE_1995)
+        & (li["l_discount"] >= 5)
+        & (li["l_discount"] <= 7)
+        & (li["l_quantity"] < 2400)
+    )
+    expected = (li["l_extendedprice"][sel] * li["l_discount"][sel]).sum() / 1e4
+    assert len(res) == 1
+    assert res.rows[0][0] == pytest.approx(expected, rel=1e-12)
+
+
+def test_q1_shape(env):
+    """TPC-H Q1: grouped agg over returnflag/linestatus with the
+    packed-direct path (dictionary keys, 6 groups)."""
+    tpch, catalog, runner = env
+    cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice", "l_discount", "l_tax", "l_shipdate"]
+    scan, h = _scan(catalog, "lineitem", cols)
+    rf, ls = col(0, h.column("l_returnflag").type), col(1, h.column("l_linestatus").type)
+    qty = col(2, DecimalType(12, 2))
+    price = col(3, DecimalType(12, 2))
+    disc = col(4, DecimalType(12, 2))
+    tax = col(5, DecimalType(12, 2))
+    shipdate = col(6, DATE)
+    cutoff = (np.datetime64("1998-09-02") - np.datetime64("1970-01-01")).astype(int) - 90
+    f = FilterNode(scan, call("le", shipdate, lit(cutoff, DATE)))
+    disc_price = call("mul", price, call("sub", lit(100, DecimalType(12, 2)), disc))
+    charge = call("mul", disc_price, call("add", lit(100, DecimalType(12, 2)), tax))
+    aggs = [
+        AggCall("sum", qty, DecimalType(18, 2)),
+        AggCall("sum", price, DecimalType(18, 2)),
+        AggCall("sum", disc_price, disc_price.type),
+        AggCall("sum", charge, charge.type),
+        AggCall("avg", qty, DOUBLE),
+        AggCall("count_star", None, BIGINT),
+    ]
+    agg = AggregationNode(
+        f, [rf, ls], ["l_returnflag", "l_linestatus"], aggs,
+        ["sum_qty", "sum_base_price", "sum_disc_price", "sum_charge", "avg_qty", "count_order"],
+    )
+    sort = SortNode(agg, [col(0, rf.type), col(1, ls.type)], [True, True])
+    out = OutputNode(sort, agg.output_names)
+    res = runner.run(out)
+
+    li = _full(tpch, "lineitem")
+    sel = li["l_shipdate"] <= cutoff
+    rf_dict = tpch.dictionary_for("lineitem", "l_returnflag")
+    ls_dict = tpch.dictionary_for("lineitem", "l_linestatus")
+    keys = sorted(
+        set(zip(li["l_returnflag"][sel].tolist(), li["l_linestatus"][sel].tolist()))
+    )
+    assert len(res) == len(keys)
+    for row, (kr, kl) in zip(res.rows, keys):
+        m = sel & (li["l_returnflag"] == kr) & (li["l_linestatus"] == kl)
+        assert row[0] == rf_dict.decode(np.asarray([kr]))[0]
+        assert row[1] == ls_dict.decode(np.asarray([kl]))[0]
+        assert row[2] == pytest.approx(li["l_quantity"][m].sum() / 100, rel=1e-12)
+        assert row[3] == pytest.approx(li["l_extendedprice"][m].sum() / 100, rel=1e-12)
+        dp = li["l_extendedprice"][m] * (100 - li["l_discount"][m])
+        assert row[4] == pytest.approx(dp.sum() / 1e4, rel=1e-12)
+        ch = dp * (100 + li["l_tax"][m])
+        assert row[5] == pytest.approx(ch.sum() / 1e6, rel=1e-12)
+        assert row[6] == pytest.approx(li["l_quantity"][m].mean() / 100, rel=1e-12)
+        assert row[7] == int(m.sum())
+
+
+def test_join_unique_build(env):
+    """lineitem ⋈ orders on orderkey (unique build side, streamed probe)."""
+    tpch, catalog, runner = env
+    li_scan, lh = _scan(catalog, "lineitem", ["l_orderkey", "l_extendedprice"])
+    o_scan, oh = _scan(catalog, "orders", ["o_orderkey", "o_orderdate"])
+    join = JoinNode(
+        left=li_scan,
+        right=o_scan,
+        left_keys=[col(0, BIGINT)],
+        right_keys=[col(0, BIGINT)],
+        kind="inner",
+        unique_build=True,
+    )
+    # filter post-join on o_orderdate < 1995-01-01, sum extendedprice
+    f = FilterNode(join, call("lt", col(3, DATE), lit(DATE_1995, DATE)))
+    agg = AggregationNode(
+        f, [], [], [AggCall("sum", col(1, DecimalType(12, 2)), DecimalType(18, 2)),
+                    AggCall("count_star", None, BIGINT)], ["s", "n"]
+    )
+    res = runner.run(OutputNode(agg, ["s", "n"]))
+
+    li = _full(tpch, "lineitem")
+    o = _full(tpch, "orders")
+    odate = dict(zip(o["o_orderkey"].tolist(), o["o_orderdate"].tolist()))
+    sel = np.asarray([odate[k] < DATE_1995 for k in li["l_orderkey"].tolist()])
+    assert res.rows[0][1] == int(sel.sum())
+    assert res.rows[0][0] == pytest.approx(li["l_extendedprice"][sel].sum() / 100, rel=1e-12)
+
+
+def test_expanding_join(env):
+    """orders ⋈ lineitem on orderkey (non-unique build: ~4 lines/order)."""
+    tpch, catalog, runner = env
+    o_scan, oh = _scan(catalog, "orders", ["o_orderkey", "o_totalprice"])
+    li_scan, lh = _scan(catalog, "lineitem", ["l_orderkey", "l_quantity"])
+    join = JoinNode(
+        left=o_scan,
+        right=li_scan,
+        left_keys=[col(0, BIGINT)],
+        right_keys=[col(0, BIGINT)],
+        kind="inner",
+        unique_build=False,
+    )
+    agg = AggregationNode(
+        join, [], [], [AggCall("count_star", None, BIGINT),
+                       AggCall("sum", col(3, DecimalType(12, 2)), DecimalType(18, 2))], ["n", "q"]
+    )
+    res = runner.run(OutputNode(agg, ["n", "q"]))
+    li = _full(tpch, "lineitem")
+    assert res.rows[0][0] == len(li["l_orderkey"])  # every line matches its order
+    assert res.rows[0][1] == pytest.approx(li["l_quantity"].sum() / 100, rel=1e-12)
+
+
+def test_semi_join(env):
+    """customers with at least one order (semi join)."""
+    tpch, catalog, runner = env
+    c_scan, ch = _scan(catalog, "customer", ["c_custkey"])
+    o_scan, oh = _scan(catalog, "orders", ["o_custkey"])
+    join = JoinNode(
+        left=c_scan, right=o_scan,
+        left_keys=[col(0, BIGINT)], right_keys=[col(0, BIGINT)],
+        kind="semi",
+    )
+    agg = AggregationNode(join, [], [], [AggCall("count_star", None, BIGINT)], ["n"])
+    res = runner.run(OutputNode(agg, ["n"]))
+    o = _full(tpch, "orders")
+    assert res.rows[0][0] == len(np.unique(o["o_custkey"]))
+
+
+def test_topn_and_limit(env):
+    tpch, catalog, runner = env
+    scan, h = _scan(catalog, "orders", ["o_orderkey", "o_totalprice"])
+    topn = TopNNode(scan, [col(1, DecimalType(12, 2))], [False], 10)
+    res = runner.run(OutputNode(topn, ["o_orderkey", "o_totalprice"]))
+    o = _full(tpch, "orders")
+    top10 = np.sort(o["o_totalprice"])[::-1][:10] / 100
+    assert [r[1] for r in res.rows] == pytest.approx(top10.tolist())
+
+    lim = LimitNode(scan, 7)
+    res2 = runner.run(OutputNode(lim, ["o_orderkey", "o_totalprice"]))
+    assert len(res2) == 7
+
+
+def test_grouped_join_agg(env):
+    """Q3-ish: join + grouped agg via hash path (many groups)."""
+    tpch, catalog, runner = env
+    li_scan, lh = _scan(catalog, "lineitem", ["l_orderkey", "l_extendedprice", "l_discount"])
+    o_scan, oh = _scan(catalog, "orders", ["o_orderkey", "o_orderdate", "o_shippriority"])
+    join = JoinNode(
+        left=li_scan, right=o_scan,
+        left_keys=[col(0, BIGINT)], right_keys=[col(0, BIGINT)],
+        kind="inner", unique_build=True,
+    )
+    rev = call("mul", col(1, DecimalType(12, 2)), call("sub", lit(100, DecimalType(12, 2)), col(2, DecimalType(12, 2))))
+    proj = ProjectNode(join, [col(0, BIGINT), rev], ["l_orderkey", "rev"])
+    agg = AggregationNode(
+        proj, [col(0, BIGINT)], ["l_orderkey"],
+        [AggCall("sum", col(1, rev.type), rev.type)], ["revenue"],
+        max_groups=1 << 15,
+    )
+    topn = TopNNode(agg, [col(1, rev.type)], [False], 5)
+    res = runner.run(OutputNode(topn, ["l_orderkey", "revenue"]))
+
+    li = _full(tpch, "lineitem")
+    revs = li["l_extendedprice"] * (100 - li["l_discount"])
+    agg_map = {}
+    for k, r in zip(li["l_orderkey"].tolist(), revs.tolist()):
+        agg_map[k] = agg_map.get(k, 0) + r
+    top = sorted(agg_map.values(), reverse=True)[:5]
+    assert [r[1] for r in res.rows] == pytest.approx([t / 1e4 for t in top], rel=1e-12)
