@@ -624,6 +624,18 @@ class Tpch:
     def table_names(self) -> List[str]:
         return list(SCHEMAS.keys())
 
+    def primary_key(self, table: str) -> Optional[List[str]]:
+        return {
+            "region": ["r_regionkey"],
+            "nation": ["n_nationkey"],
+            "supplier": ["s_suppkey"],
+            "customer": ["c_custkey"],
+            "part": ["p_partkey"],
+            "partsupp": ["ps_partkey", "ps_suppkey"],
+            "orders": ["o_orderkey"],
+            "lineitem": ["l_orderkey", "l_linenumber"],
+        }.get(table)
+
     def column_domain(self, table: str, column: str) -> Optional[Tuple[int, int]]:
         """Known (lo, hi) of a column in its device representation —
         the stats feed for exact key packing (planner/exact joins).

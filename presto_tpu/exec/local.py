@@ -37,6 +37,7 @@ from presto_tpu.ops.sort import limit_page, sort_page, sort_perm, topn_page
 from presto_tpu.page import Block, Page
 from presto_tpu.planner.plan import (
     AggregationNode,
+    CrossSingleNode,
     FilterNode,
     JoinNode,
     LimitNode,
@@ -217,6 +218,8 @@ class LocalRunner:
             return self._chain_leaf(node.source)
         if isinstance(node, JoinNode) and _is_streaming_join(node):
             return self._chain_leaf(node.left)  # probe side streams
+        if isinstance(node, CrossSingleNode):
+            return self._chain_leaf(node.left)
         return node
 
     def _build_stage(self, node: PlanNode, joins: List[JoinNode]):
@@ -263,6 +266,28 @@ class LocalRunner:
 
             return probe_stage
 
+        if isinstance(node, CrossSingleNode):
+            inner = self._build_stage(node.left, joins)
+            key = f"build_{len(joins)}"
+            joins.append(node)
+
+            def cross_stage(p, c):
+                q = inner(p, c)
+                r: Page = c[key]  # single-row page
+                blocks = list(q.blocks)
+                for b in r.blocks:
+                    blocks.append(
+                        Block(
+                            jnp.broadcast_to(b.data[0], (q.capacity,)),
+                            jnp.broadcast_to(b.valid[0] & r.row_mask[0], (q.capacity,)),
+                            b.type,
+                            b.dictionary,
+                        )
+                    )
+                return Page(tuple(blocks), q.row_mask)
+
+            return cross_stage
+
         # chain leaf (scan / breaker / expanding join): identity
         return lambda p, c: p
 
@@ -279,12 +304,15 @@ class LocalRunner:
         else:
             yield from self._pages(node)
 
-    def _materialize_build(self, node: JoinNode) -> JoinBuild:
+    def _materialize_build(self, node):
         if node not in self._builds:
             build_page = self._execute_to_page(node.right)
-            self._builds[node] = build_join(
-                build_page, node.right_keys, key_domains=node.key_domains
-            )
+            if isinstance(node, CrossSingleNode):
+                self._builds[node] = slice_page(build_page.compact_host(), 1)
+            else:
+                self._builds[node] = build_join(
+                    build_page, node.right_keys, key_domains=node.key_domains
+                )
         return self._builds[node]
 
     # ------------------------------------------------------------------

@@ -207,6 +207,26 @@ class JoinNode(PlanNode):
 
 
 @dataclasses.dataclass(eq=False)
+class CrossSingleNode(PlanNode):
+    """Cross join against a guaranteed single-row relation — the
+    planner's lowering of uncorrelated scalar subqueries (reference:
+    EnforceSingleRowNode.java + cross join in
+    TransformUncorrelatedSubqueryToJoin); executed as a broadcast of
+    the single row's values into the probe stream."""
+
+    left: PlanNode
+    right: PlanNode
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.left.channels + self.right.channels
+
+
+@dataclasses.dataclass(eq=False)
 class SortNode(PlanNode):
     source: PlanNode
     sort_exprs: List[Expr]

@@ -1,0 +1,31 @@
+"""Top-level query runner: SQL in, rows out.
+
+Reference analog: ``testing/LocalQueryRunner.java:207`` — the
+full-pipeline in-process harness (parse -> analyze -> plan -> execute)
+used by the reference's tests and benchmarks, and the model for the
+coordinator's query lifecycle (execution/SqlQueryExecution.java).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.exec.local import LocalRunner, MaterializedResult
+from presto_tpu.sql.binder import Binder
+
+
+class QueryRunner:
+    def __init__(self, catalog: Catalog, jit: bool = True):
+        self.catalog = catalog
+        self.binder = Binder(catalog)
+        self.executor = LocalRunner(catalog, jit=jit)
+
+    def plan(self, sql: str):
+        return self.binder.plan(sql)
+
+    def execute(self, sql: str) -> MaterializedResult:
+        return self.executor.run(self.plan(sql))
+
+    def explain(self, sql: str) -> str:
+        return self.executor.explain(self.plan(sql))

@@ -1,0 +1,1 @@
+from presto_tpu.sql.parser import parse_query  # noqa: F401

@@ -1,0 +1,206 @@
+"""SQL abstract syntax tree.
+
+Reference analog: ``presto-parser/src/main/java/com/facebook/presto/sql/tree/``
+(155 node classes — Query.java, QuerySpecification.java, Select.java,
+ComparisonExpression.java, FunctionCall.java, ...).  Collapsed to the
+node set the TPU engine's dialect needs; growth model is the same
+(one dataclass per syntactic form).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# -- expressions -------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Node):
+    parts: Tuple[str, ...]  # possibly qualified: ("l", "shipdate") or ("revenue",)
+
+    @property
+    def name(self) -> str:
+        return self.parts[-1]
+
+    @property
+    def qualifier(self) -> Optional[str]:
+        return self.parts[0] if len(self.parts) > 1 else None
+
+
+@dataclasses.dataclass(frozen=True)
+class NumberLit(Node):
+    text: str  # raw literal; binder decides bigint vs decimal vs double
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLit(Node):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DateLit(Node):
+    value: str  # 'YYYY-MM-DD'
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLit(Node):
+    value: str  # e.g. '3'
+    unit: str  # day | month | year
+    negative: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class NullLit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Unary(Node):
+    op: str  # '-' | 'not'
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class Binary(Node):
+    op: str  # + - * / % = <> < <= > >= and or
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Node):
+    value: Node
+    items: Tuple[Node, ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Node):
+    value: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Node):
+    value: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Node):
+    whens: Tuple[Tuple[Node, Node], ...]  # (condition, result)
+    else_: Optional[Node]
+    operand: Optional[Node] = None  # simple CASE x WHEN v THEN ...
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Node):
+    value: Node
+    type_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Node):
+    field: str  # year | month | day
+    value: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class Substring(Node):
+    value: Node
+    start: Node
+    length: Optional[Node]
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncCall(Node):
+    name: str
+    args: Tuple[Node, ...]
+    distinct: bool = False
+    star: bool = False  # count(*)
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Node):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Node):
+    qualifier: Optional[str] = None
+
+
+# -- relations ---------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TableRef(Node):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRel(Node):
+    query: "Query"
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinRel(Node):
+    left: Node
+    right: Node
+    kind: str  # inner | left | cross
+    on: Optional[Node] = None
+
+
+# -- query -------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node  # or Star
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Node):
+    select: Tuple[SelectItem, ...]
+    distinct: bool = False
+    from_: Tuple[Node, ...] = ()  # relations (comma list, possibly JoinRel trees)
+    where: Optional[Node] = None
+    group_by: Tuple[Node, ...] = ()
+    having: Optional[Node] = None
+    order_by: Tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None

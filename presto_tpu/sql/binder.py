@@ -1,0 +1,1040 @@
+"""Semantic analysis + logical planning: AST -> plan tree.
+
+Reference analog: the analyzer/planner stack —
+``sql/analyzer/StatementAnalyzer.java`` (name/type resolution, scopes),
+``sql/planner/LogicalPlanner.java:137`` + ``QueryPlanner``/
+``RelationPlanner`` (AST -> PlanNode DAG), and the key optimizer passes
+folded in at build time the way AddExchanges folds distribution:
+
+* predicate pushdown (optimizations/PredicatePushDown.java) — WHERE
+  conjuncts routed to their source relations before joins;
+* cross-join elimination via the equi-join graph
+  (optimizations/EliminateCrossJoins.java) — comma-FROM + WHERE becomes
+  a join tree greedily, probe side = largest estimated input
+  (DetermineJoinDistributionType.java's build-small heuristic);
+* partial-aggregation splitting happens in the executor
+  (PushPartialAggregationThroughExchange.java analog);
+* subquery decorrelation (TransformCorrelatedScalarAggregationToJoin,
+  TransformExistsApplyToLateralNode rules): EXISTS -> semi/anti join,
+  correlated scalar aggregates -> grouped-agg join, uncorrelated
+  scalar subqueries -> single-row cross join.
+
+Scopes are positional: binding produces ``expr.ir`` trees whose
+ColumnRefs index the current plan node's output channels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.expr.ir import AggCall, Call, ColumnRef, Expr, Literal, call, infer_type
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    Channel,
+    CrossSingleNode,
+    FilterNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    ValuesNode,
+)
+from presto_tpu.sql import ast
+from presto_tpu.sql.parser import parse_query
+from presto_tpu.types import BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType, Type
+
+AGG_FUNCTIONS = {"sum", "avg", "count", "min", "max"}
+
+# Correlated bindings mark outer-scope columns with this offset so a
+# conjunct's inner/outer sides are separable after binding.
+_OUTER_BASE = 1 << 20
+
+
+class BindError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class ScopeCol:
+    qualifier: Optional[str]
+    name: str
+    channel: Channel
+
+
+class Scope:
+    """Positional name resolution, optionally chained to an outer
+    query's scope (StatementAnalyzer's Scope.java analog).  A parent
+    hit resolves to ``len(self) + parent_index`` — the combined index
+    space a correlated binding uses to separate inner from outer refs."""
+
+    def __init__(self, cols: Sequence[ScopeCol], parent: Optional["Scope"] = None):
+        self.cols = list(cols)
+        self.parent = parent
+
+    @classmethod
+    def of(cls, node: PlanNode, qualifier: Optional[str] = None) -> "Scope":
+        return cls([ScopeCol(qualifier, c.name, c) for c in node.channels])
+
+    def concat(self, other: "Scope") -> "Scope":
+        return Scope(self.cols + other.cols)
+
+    def col(self, idx: int) -> ScopeCol:
+        if idx < len(self.cols):
+            return self.cols[idx]
+        return self.parent.col(idx - len(self.cols))
+
+    def resolve(self, qualifier: Optional[str], name: str) -> int:
+        hits = [
+            i
+            for i, c in enumerate(self.cols)
+            if c.name == name and (qualifier is None or c.qualifier == qualifier)
+        ]
+        if not hits:
+            if self.parent is not None:
+                return len(self.cols) + self.parent.resolve(qualifier, name)
+            raise BindError(f"column not found: {qualifier + '.' if qualifier else ''}{name}")
+        if len(hits) > 1:
+            raise BindError(f"ambiguous column: {name}")
+        return hits[0]
+
+    def __len__(self):
+        return len(self.cols)
+
+
+def split_conjuncts(node: Optional[ast.Node]) -> List[ast.Node]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Binary) and node.op == "and":
+        return split_conjuncts(node.left) + split_conjuncts(node.right)
+    return [node]
+
+
+def expr_refs(e: Expr) -> List[int]:
+    if isinstance(e, ColumnRef):
+        return [e.index]
+    if isinstance(e, Call):
+        return [r for a in e.args for r in expr_refs(a)]
+    return []
+
+
+def remap_expr(e: Expr, mapping: Dict[int, int]) -> Expr:
+    if isinstance(e, ColumnRef):
+        return ColumnRef(type=e.type, index=mapping[e.index], name=e.name)
+    if isinstance(e, Call):
+        return Call(type=e.type, fn=e.fn, args=tuple(remap_expr(a, mapping) for a in e.args))
+    return e
+
+
+def _parse_date(s: str) -> int:
+    d = datetime.date.fromisoformat(s)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def _shift_date(days: int, n: int, unit: str) -> int:
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+    if unit == "day":
+        d = d + datetime.timedelta(days=n)
+    else:
+        months = n * (12 if unit == "year" else 1)
+        m = d.month - 1 + months
+        y = d.year + m // 12
+        m = m % 12 + 1
+        day = min(d.day, [31, 29 if y % 4 == 0 and (y % 100 != 0 or y % 400 == 0) else 28,
+                          31, 30, 31, 30, 31, 31, 30, 31, 30, 31][m - 1])
+        d = datetime.date(y, m, day)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def _is_subquery_conjunct(c: ast.Node) -> bool:
+    if isinstance(c, (ast.InSubquery, ast.Exists)):
+        return True
+    if isinstance(c, ast.Unary) and c.op == "not":
+        return _is_subquery_conjunct(c.operand)
+    if isinstance(c, ast.Binary) and c.op in ("=", "<>", "<", "<=", ">", ">="):
+        return isinstance(c.left, ast.ScalarSubquery) or isinstance(c.right, ast.ScalarSubquery)
+    return False
+
+
+@dataclasses.dataclass
+class AggCtx:
+    """Aggregation binding context: group expr matching + agg collection."""
+
+    group_asts: List[ast.Node]
+    group_irs: List[Expr]  # over the pre-agg scope
+    aggs: List[AggCall] = dataclasses.field(default_factory=list)
+
+    def key_ref(self, i: int) -> ColumnRef:
+        return ColumnRef(type=self.group_irs[i].type, index=i)
+
+    def agg_ref(self, agg: AggCall) -> ColumnRef:
+        from presto_tpu.ops.aggregate import output_type
+
+        for j, a in enumerate(self.aggs):
+            if a == agg:
+                return ColumnRef(type=output_type(a), index=len(self.group_irs) + j)
+        self.aggs.append(agg)
+        return ColumnRef(type=output_type(agg), index=len(self.group_irs) + len(self.aggs) - 1)
+
+
+@dataclasses.dataclass
+class Term:
+    """One FROM relation: its plan + scope + global channel offset."""
+
+    node: PlanNode
+    scope: Scope
+    offset: int = 0
+
+
+class Binder:
+    """Plans one SELECT query against a catalog."""
+
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        # subquery conjuncts discovered while joining the current
+        # query's FROM terms, applied after the join tree is built
+        self._pending_subqueries: List[Tuple[ast.Node, Scope]] = []
+
+    # ==================================================================
+    def plan(self, sql: str) -> OutputNode:
+        return self.plan_ast(parse_query(sql))
+
+    def plan_ast(self, q: ast.Query) -> OutputNode:
+        node, names = self._plan_query(q)
+        return OutputNode(node, names)
+
+    # ==================================================================
+    # relation planning
+    # ==================================================================
+    def _plan_relation(self, rel: ast.Node) -> Tuple[PlanNode, Scope]:
+        if isinstance(rel, ast.TableRef):
+            handle = self.catalog.resolve(rel.name)
+            scan = TableScanNode(handle, list(range(len(handle.columns))))
+            return scan, Scope.of(scan, rel.alias or rel.name)
+        if isinstance(rel, ast.SubqueryRel):
+            node, names = self._plan_query(rel.query)
+            scope = Scope(
+                [ScopeCol(rel.alias, n, c) for n, c in zip(names, node.channels)]
+            )
+            return node, scope
+        if isinstance(rel, ast.JoinRel):
+            return self._plan_join_rel(rel)
+        raise BindError(f"unsupported relation {rel!r}")
+
+    def _flatten_from(self, rels: Sequence[ast.Node]) -> Tuple[List[Term], List[ast.Node]]:
+        """Flatten comma relations + inner join trees into terms and a
+        conjunct pool (EliminateCrossJoins flattening)."""
+        terms: List[Term] = []
+        conjuncts: List[ast.Node] = []
+
+        def walk(rel: ast.Node):
+            if isinstance(rel, ast.JoinRel) and rel.kind == "inner":
+                walk(rel.left)
+                walk(rel.right)
+                conjuncts.extend(split_conjuncts(rel.on))
+            elif isinstance(rel, ast.JoinRel) and rel.kind == "cross":
+                walk(rel.left)
+                walk(rel.right)
+            else:
+                node, scope = self._plan_relation(rel)
+                terms.append(Term(node, scope))
+
+        for r in rels:
+            walk(r)
+        off = 0
+        for t in terms:
+            t.offset = off
+            off += len(t.scope)
+        return terms, conjuncts
+
+    def _plan_join_rel(self, rel: ast.JoinRel) -> Tuple[PlanNode, Scope]:
+        """Explicit JOIN trees. Inner joins route through the join-graph
+        planner; LEFT joins are planned directly (null-extension pins
+        probe/build sides)."""
+        if rel.kind in ("inner", "cross"):
+            terms, conjuncts = self._flatten_from([rel])
+            node, scope, _ = self._join_terms(terms, conjuncts)
+            return node, scope
+        assert rel.kind == "left", rel.kind
+        lnode, lscope = self._plan_relation(rel.left)
+        rnode, rscope = self._plan_relation(rel.right)
+        glob = lscope.concat(rscope)
+        lkeys: List[Expr] = []
+        rkeys: List[Expr] = []
+        post: List[Expr] = []
+        for c in split_conjuncts(rel.on):
+            ir = self._bind(c, glob)
+            refs = expr_refs(ir)
+            left_refs = [r for r in refs if r < len(lscope)]
+            right_refs = [r for r in refs if r >= len(lscope)]
+            if (
+                isinstance(ir, Call) and ir.fn == "eq"
+                and all(isinstance(a, ColumnRef) for a in ir.args)
+                and len(left_refs) == 1 and len(right_refs) == 1
+            ):
+                a, b = ir.args
+                if a.index >= len(lscope):
+                    a, b = b, a
+                lkeys.append(a)
+                rkeys.append(ColumnRef(type=b.type, index=b.index - len(lscope)))
+            elif not left_refs:
+                # right-side-only ON predicate: prefilter build (valid
+                # for LEFT joins — unmatched probes still null-extend)
+                rmap = {r: r - len(lscope) for r in right_refs}
+                rnode = FilterNode(rnode, remap_expr(ir, rmap))
+            else:
+                raise BindError(f"unsupported LEFT JOIN ON predicate: {c!r}")
+        if not lkeys:
+            raise BindError("LEFT JOIN requires at least one equi-condition")
+        join = JoinNode(
+            left=lnode, right=rnode, left_keys=lkeys, right_keys=rkeys,
+            kind="left", unique_build=self._build_is_unique(rnode, rkeys),
+        )
+        return join, glob
+
+    # ==================================================================
+    # join graph (comma FROM + WHERE equi conjuncts)
+    # ==================================================================
+    def _join_terms(
+        self, terms: List[Term], conjunct_asts: List[ast.Node]
+    ) -> Tuple[PlanNode, Scope, Dict[int, int]]:
+        """Returns (tree, scope, glob->tree channel mapping)."""
+        glob = Scope([])
+        for t in terms:
+            glob = glob.concat(t.scope)
+
+        plain: List[Expr] = []
+        for c in conjunct_asts:
+            if _is_subquery_conjunct(c):
+                self._pending_subqueries.append((c, glob))
+                continue
+            plain.append(self._bind(c, glob))
+
+        def term_of(ref: int) -> int:
+            for i, t in enumerate(terms):
+                if t.offset <= ref < t.offset + len(t.scope):
+                    return i
+            raise AssertionError(ref)
+
+        # route single-term conjuncts as pushed-down filters
+        edges: List[Tuple[int, int, Expr]] = []  # (term_i, term_j, eq ir)
+        post: List[Expr] = []
+        for ir in plain:
+            tset = sorted({term_of(r) for r in expr_refs(ir)})
+            if len(tset) == 0:
+                post.append(ir)  # constant predicate
+            elif len(tset) == 1:
+                i = tset[0]
+                mapping = {r: r - terms[i].offset for r in expr_refs(ir)}
+                terms[i].node = FilterNode(terms[i].node, remap_expr(ir, mapping))
+            elif (
+                len(tset) == 2
+                and isinstance(ir, Call) and ir.fn == "eq"
+                and all(isinstance(a, ColumnRef) for a in ir.args)
+            ):
+                edges.append((tset[0], tset[1], ir))
+            else:
+                post.append(ir)
+
+        if len(terms) == 1:
+            node = terms[0].node
+            g2c = {terms[0].offset + i: i for i in range(len(terms[0].scope))}
+        else:
+            node, g2c = self._greedy_join(terms, edges, post)
+
+        for ir in post:
+            node = FilterNode(node, remap_expr(ir, g2c))
+        return node, glob, g2c
+
+    def _greedy_join(self, terms, edges, post):
+        """Probe = largest estimated term; repeatedly hash-join the
+        smallest connected term as build side."""
+        est = [self._estimate(t.node) for t in terms]
+        start = max(range(len(terms)), key=lambda i: est[i])
+        joined = {start}
+        node = terms[start].node
+        g2c = {terms[start].offset + i: i for i in range(len(terms[start].scope))}
+        used = [False] * len(edges)
+        remaining = set(range(len(terms))) - joined
+
+        while remaining:
+            candidates = set()
+            for k, (i, j, _) in enumerate(edges):
+                if used[k]:
+                    continue
+                if i in joined and j in remaining:
+                    candidates.add(j)
+                elif j in joined and i in remaining:
+                    candidates.add(i)
+            if not candidates:
+                # disconnected: cross join smallest remaining term
+                pick = min(remaining, key=lambda i: est[i])
+                zero = Literal(type=BIGINT, value=0)
+                t = terms[pick]
+                node = JoinNode(
+                    left=node, right=t.node, left_keys=[zero], right_keys=[zero],
+                    kind="inner", unique_build=self._estimate(t.node) <= 1,
+                )
+                base = len(g2c)
+                for li in range(len(t.scope)):
+                    g2c[t.offset + li] = base + li
+                joined.add(pick)
+                remaining.discard(pick)
+                continue
+            pick = min(candidates, key=lambda i: est[i])
+            t = terms[pick]
+            lkeys: List[Expr] = []
+            rkeys: List[Expr] = []
+            for k, (i, j, ir) in enumerate(edges):
+                if used[k]:
+                    continue
+                if (i in joined and j == pick) or (j in joined and i == pick):
+                    a, b = ir.args
+                    if term_of_ref(terms, a.index) == pick:
+                        a, b = b, a
+                    lkeys.append(ColumnRef(type=a.type, index=g2c[a.index]))
+                    rkeys.append(ColumnRef(type=b.type, index=b.index - t.offset))
+                    used[k] = True
+            build_unique = self._build_is_unique(t.node, rkeys)
+            node = JoinNode(
+                left=node, right=t.node, left_keys=lkeys, right_keys=rkeys,
+                kind="inner", unique_build=build_unique,
+            )
+            base = len(g2c)
+            for li in range(len(t.scope)):
+                g2c[t.offset + li] = base + li
+            joined.add(pick)
+            remaining.discard(pick)
+        # cycle edges (both ends already joined) become post filters
+        for k, (i, j, ir) in enumerate(edges):
+            if not used[k]:
+                post.append(ir)
+        return node, g2c
+
+    # ------------------------------------------------------------------
+    def _estimate(self, node: PlanNode) -> float:
+        """Row-count guess for join ordering (cost/StatsCalculator.java's
+        role, collapsed to fixed selectivities)."""
+        if isinstance(node, TableScanNode):
+            return float(node.handle.row_count)
+        if isinstance(node, FilterNode):
+            return self._estimate(node.source) * 0.3
+        if isinstance(node, AggregationNode):
+            return min(self._estimate(node.source), float(node.max_groups))
+        if isinstance(node, JoinNode):
+            if node.kind in ("semi", "anti"):
+                return self._estimate(node.left) * 0.5
+            return max(self._estimate(node.left), self._estimate(node.right))
+        if isinstance(node, (LimitNode, TopNNode)):
+            return float(node.count)
+        srcs = node.sources
+        return self._estimate(srcs[0]) if srcs else 1.0
+
+    def _build_is_unique(self, node: PlanNode, rkeys: Sequence[Expr]) -> bool:
+        """True if the build side's join keys are unique: primary-key
+        scans or group-by outputs (reference: the planner's knowledge in
+        e.g. metadata uniqueness; used to pick the aligned probe kernel)."""
+        key_idx = sorted(
+            k.index for k in rkeys if isinstance(k, ColumnRef)
+        )
+        if len(key_idx) != len(rkeys):
+            return False
+        n = node
+        while isinstance(n, (FilterNode, OutputNode)):
+            n = n.source
+        if isinstance(n, AggregationNode):
+            return key_idx == list(range(len(n.group_exprs)))
+        if isinstance(n, ProjectNode):
+            # project of a PK scan: map refs through bare column projections
+            inner_idx = []
+            for i in key_idx:
+                p = n.projections[i]
+                if not isinstance(p, ColumnRef):
+                    return False
+                inner_idx.append(p.index)
+            return self._build_is_unique(n.source, [
+                ColumnRef(type=n.projections[i].type, index=j)
+                for i, j in zip(key_idx, inner_idx)
+            ])
+        if isinstance(n, TableScanNode):
+            conn = self.catalog.connector(n.handle.connector_name)
+            if not hasattr(conn, "primary_key"):
+                return False
+            pk = conn.primary_key(n.handle.table)
+            if pk is None:
+                return False
+            names = [n.handle.columns[i].name for i in n.columns]
+            try:
+                pk_idx = sorted(names.index(c) for c in pk)
+            except ValueError:
+                return False
+            return key_idx == pk_idx
+        return False
+
+    # ==================================================================
+    # query planning
+    # ==================================================================
+    def _plan_query(self, q: ast.Query) -> Tuple[PlanNode, List[str]]:
+        saved_pending = self._pending_subqueries
+        self._pending_subqueries = []
+        if q.from_:
+            terms, conjuncts = self._flatten_from(q.from_)
+            conjuncts = conjuncts + split_conjuncts(q.where)
+            node, glob, g2c = self._join_terms(terms, conjuncts)
+            scope = Scope(
+                [glob.cols[g] for g, _ in sorted(g2c.items(), key=lambda kv: kv[1])]
+            )
+        else:
+            node = ValuesNode(names=["$dummy"], types=[BIGINT], rows=[(0,)])
+            scope = Scope([])
+            g2c = {}
+
+        # subquery conjuncts (IN/EXISTS/scalar comparisons) -> joins
+        pending = self._pending_subqueries
+        self._pending_subqueries = []
+        for c, cglob in pending:
+            node, scope = self._apply_subquery_conjunct(node, scope, g2c, c, cglob)
+        self._pending_subqueries = saved_pending
+
+        # select list expansion
+        items: List[Tuple[ast.Node, str]] = []
+        for it in q.select:
+            if isinstance(it.expr, ast.Star):
+                for sc in scope.cols:
+                    if it.expr.qualifier is None or sc.qualifier == it.expr.qualifier:
+                        items.append((ast.Identifier((sc.qualifier, sc.name) if sc.qualifier else (sc.name,)), sc.name))
+            else:
+                items.append((it.expr, it.alias or self._derive_name(it.expr)))
+
+        group_asts = list(q.group_by)
+        # ordinal group-by ("GROUP BY 1")
+        group_asts = [
+            items[int(g.text) - 1][0] if isinstance(g, ast.NumberLit) else g
+            for g in group_asts
+        ]
+        has_aggs = bool(group_asts) or any(
+            self._contains_agg(e) for e, _ in items
+        ) or (q.having is not None and self._contains_agg(q.having))
+
+        order_items = list(q.order_by)
+
+        if has_aggs:
+            node, out_irs, names, order_irs = self._plan_aggregation(
+                node, scope, items, group_asts, q.having, order_items
+            )
+        else:
+            if q.having is not None:
+                raise BindError("HAVING without aggregation")
+            out_irs = [self._bind(e, scope) for e, _ in items]
+            names = [n for _, n in items]
+            order_irs = self._bind_order(order_items, items, out_irs, scope)
+
+        node = ProjectNode(node, out_irs + [ir for ir in order_irs if ir not in out_irs],
+                           names + [f"$order{i}" for i, ir in enumerate(order_irs) if ir not in out_irs])
+        # order exprs as channel refs over the project output
+        order_channels: List[ColumnRef] = []
+        for ir in order_irs:
+            idx = node.projections.index(ir)
+            order_channels.append(ColumnRef(type=ir.type, index=idx))
+
+        if q.distinct:
+            node = AggregationNode(
+                node,
+                [ColumnRef(type=c.type, index=i) for i, c in enumerate(node.channels)],
+                node.output_names,
+                [], [],
+                max_groups=self._distinct_capacity(node),
+            )
+
+        if order_items:
+            asc = [o.ascending for o in order_items]
+            nf = [o.nulls_first if o.nulls_first is not None else (not o.ascending) for o in order_items]
+            if q.limit is not None:
+                node = TopNNode(node, order_channels, asc, q.limit, nf)
+            else:
+                node = SortNode(node, order_channels, asc, nf)
+        elif q.limit is not None:
+            node = LimitNode(node, q.limit)
+
+        if len(node.channels) > len(names):  # drop hidden order-by channels
+            node = ProjectNode(
+                node,
+                [ColumnRef(type=c.type, index=i) for i, c in enumerate(node.channels[: len(names)])],
+                names,
+            )
+        return node, names
+
+    def _distinct_capacity(self, node: PlanNode) -> int:
+        est = int(self._estimate(node))
+        return max(1 << 10, min(1 << (max(est - 1, 1)).bit_length(), 1 << 24))
+
+    def _derive_name(self, e: ast.Node) -> str:
+        if isinstance(e, ast.Identifier):
+            return e.name
+        if isinstance(e, ast.FuncCall):
+            return e.name
+        return "_col"
+
+    def _contains_agg(self, e: ast.Node) -> bool:
+        if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCTIONS:
+            return True
+        for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else []:
+            v = getattr(e, f.name)
+            for x in v if isinstance(v, tuple) else [v]:
+                if isinstance(x, ast.Node) and not isinstance(x, ast.Query) and self._contains_agg(x):
+                    return True
+        return False
+
+    # ------------------------------------------------------------------
+    def _plan_aggregation(self, node, scope, items, group_asts, having, order_items):
+        group_irs = [self._bind(g, scope) for g in group_asts]
+        agg_ctx = AggCtx(group_asts=group_asts, group_irs=group_irs)
+
+        out_irs = [self._bind_agg(e, scope, agg_ctx) for e, _ in items]
+        names = [n for _, n in items]
+        having_ir = self._bind_agg(having, scope, agg_ctx) if having is not None else None
+        order_irs = []
+        for o in order_items:
+            e = o.expr
+            if isinstance(e, ast.NumberLit):  # ordinal
+                order_irs.append(out_irs[int(e.text) - 1])
+                continue
+            # select alias?
+            alias_hit = next(
+                (out_irs[i] for i, (se, n) in enumerate(items) if isinstance(e, ast.Identifier) and e.name == n),
+                None,
+            )
+            if alias_hit is not None:
+                order_irs.append(alias_hit)
+            else:
+                order_irs.append(self._bind_agg(e, scope, agg_ctx))
+
+        group_names = [self._derive_name(g) for g in group_asts]
+        agg_names = [f"$agg{j}" for j in range(len(agg_ctx.aggs))]
+
+        # distinct aggregates: rewrite through a distinct pre-aggregation
+        if any(a.distinct for a in agg_ctx.aggs):
+            node, agg_ctx = self._rewrite_distinct_aggs(node, scope, group_irs, agg_ctx)
+            group_irs = agg_ctx.group_irs
+
+        est = self._estimate(node)
+        agg = AggregationNode(
+            node, group_irs, group_names, agg_ctx.aggs, agg_names,
+            max_groups=self._group_capacity(group_irs, scope, est),
+        )
+        out: PlanNode = agg
+        if having_ir is not None:
+            out = FilterNode(out, having_ir)
+        return out, out_irs, names, order_irs
+
+    def _group_capacity(self, group_irs: List[Expr], scope: Scope, est_rows: float) -> int:
+        if not group_irs:
+            return 1
+        prod = 1
+        for g in group_irs:
+            if (
+                isinstance(g, ColumnRef)
+                and g.index < len(scope.cols)
+                and scope.cols[g.index].channel.domain is not None
+            ):
+                lo, hi = scope.cols[g.index].channel.domain
+                prod *= hi - lo + 2
+            else:
+                prod = 1 << 60
+                break
+        cap = min(prod, int(est_rows) + 1)
+        cap = 1 << (max(cap - 1, 1)).bit_length()
+        return max(1 << 4, min(cap, 1 << 24))
+
+    def _rewrite_distinct_aggs(self, node, scope, group_irs, agg_ctx: AggCtx):
+        """agg(DISTINCT x) GROUP BY g  ->  inner distinct on (g, x),
+        outer agg(x) (MarkDistinct/MultipleDistinctAggregationToMarkDistinct
+        analog, restricted to all-distinct-same-arg aggregations)."""
+        distinct_args = {a.arg for a in agg_ctx.aggs if a.distinct}
+        if not all(a.distinct for a in agg_ctx.aggs) or len(distinct_args) != 1:
+            raise BindError("mixed/multi-arg DISTINCT aggregates unsupported")
+        (arg,) = distinct_args
+        inner_keys = group_irs + [arg]
+        inner = AggregationNode(
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))], [], [],
+            max_groups=self._group_capacity(inner_keys, scope, self._estimate(node)),
+        )
+        new_group = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
+        arg_ref = ColumnRef(type=arg.type, index=len(group_irs))
+        new_aggs = [
+            AggCall(fn=a.fn, arg=arg_ref, type=a.type, distinct=False)
+            for a in agg_ctx.aggs
+        ]
+        ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group, aggs=new_aggs)
+        return inner, ctx
+
+    # ==================================================================
+    # subquery conjuncts
+    # ==================================================================
+    def _apply_subquery_conjunct(self, node, scope, g2c, c: ast.Node, glob: Scope):
+        negated = False
+        while isinstance(c, ast.Unary) and c.op == "not":
+            negated = not negated
+            c = c.operand
+
+        remap = dict(g2c)
+
+        if isinstance(c, ast.InSubquery):
+            sub, sub_names = self._plan_query(c.query)
+            value_ir = remap_expr(self._bind(c.value, glob), remap)
+            kind = "anti" if (negated ^ c.negated) else "semi"
+            join = JoinNode(
+                left=node, right=sub,
+                left_keys=[value_ir],
+                right_keys=[ColumnRef(type=sub.channels[0].type, index=0)],
+                kind=kind,
+            )
+            return join, scope
+
+        if isinstance(c, ast.Exists):
+            kind = "anti" if (negated ^ c.negated) else "semi"
+            return self._plan_exists(node, scope, remap, glob, c.query, kind)
+
+        if isinstance(c, ast.Binary):
+            lhs, rhs, op = c.left, c.right, c.op
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+            if isinstance(lhs, ast.ScalarSubquery):
+                lhs, rhs, op = rhs, lhs, flip.get(op, op)
+            assert isinstance(rhs, ast.ScalarSubquery)
+            node, scope, value_ref = self._plan_scalar_subquery(node, scope, remap, glob, rhs.query)
+            lhs_ir = remap_expr(self._bind(lhs, glob), remap)
+            opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+            pred: Expr = call(opmap[op], lhs_ir, value_ref)
+            if negated:
+                pred = call("not", pred)
+            return FilterNode(node, pred), scope
+
+        raise BindError(f"unsupported subquery conjunct {c!r}")
+
+    def _split_correlation(self, q: ast.Query, outer_glob: Scope):
+        """Plan a subquery's FROM; bind its WHERE in (inner + outer)
+        scope; separate correlation equi-conjuncts from inner filters."""
+        terms, conjuncts = self._flatten_from(q.from_)
+        conjuncts = conjuncts + split_conjuncts(q.where)
+        inner_glob = Scope([])
+        for t in terms:
+            inner_glob = inner_glob.concat(t.scope)
+
+        combined = Scope(inner_glob.cols, parent=outer_glob)
+
+        inner_conjuncts: List[ast.Node] = []
+        corr: List[Tuple[Expr, int]] = []  # (inner ir, outer glob ref)
+        nested: List[ast.Node] = []
+        for c in conjuncts:
+            if _is_subquery_conjunct(c):
+                nested.append(c)
+                continue
+            ir = self._bind(c, combined)
+            refs = expr_refs(ir)
+            outer_refs = [r for r in refs if r >= len(inner_glob)]
+            if not outer_refs:
+                inner_conjuncts.append(c)
+            elif (
+                isinstance(ir, Call) and ir.fn == "eq"
+                and all(isinstance(a, ColumnRef) for a in ir.args)
+                and len(outer_refs) == 1
+            ):
+                a, b = ir.args
+                if a.index >= len(inner_glob):
+                    a, b = b, a
+                corr.append((a, b.index - len(inner_glob)))
+            else:
+                raise BindError(f"unsupported correlated predicate {c!r}")
+        return terms, inner_conjuncts, corr, nested, inner_glob
+
+    def _plan_exists(self, node, scope, remap, glob, q: ast.Query, kind: str):
+        terms, inner_conjuncts, corr, nested, inner_glob = self._split_correlation(q, glob)
+        if not corr:
+            raise BindError("uncorrelated EXISTS unsupported")
+        saved = self._pending_subqueries
+        self._pending_subqueries = []
+        inner_node, _, inner_map = self._join_terms(terms, inner_conjuncts)
+        for c, cglob in self._pending_subqueries:
+            inner_node, _ = self._apply_subquery_conjunct(
+                inner_node, Scope([]), inner_map, c, cglob
+            )
+        self._pending_subqueries = saved
+        if nested:
+            raise BindError("nested subquery in EXISTS unsupported")
+        left_keys = [
+            remap_expr(ColumnRef(type=glob.cols[g].channel.type, index=g), remap)
+            for _, g in corr
+        ]
+        right_keys = [remap_expr(ir, inner_map) for ir, _ in corr]
+        join = JoinNode(
+            left=node, right=inner_node, left_keys=left_keys, right_keys=right_keys,
+            kind=kind,
+        )
+        return join, scope
+
+    def _plan_scalar_subquery(self, node, scope, remap, glob, q: ast.Query):
+        """Returns (new node, scope, ColumnRef to the scalar value)."""
+        if len(q.select) != 1:
+            raise BindError("scalar subquery must select one column")
+        sel = q.select[0].expr
+
+        terms, inner_conjuncts, corr, nested, inner_glob = self._split_correlation(q, glob)
+        saved = self._pending_subqueries
+        self._pending_subqueries = []
+        inner_node, _, inner_map = self._join_terms(terms, inner_conjuncts)
+        pend = self._pending_subqueries
+        self._pending_subqueries = saved
+        inner_scope = Scope(
+            [inner_glob.cols[g] for g, _ in sorted(inner_map.items(), key=lambda kv: kv[1])]
+        )
+        for c, cglob in pend:
+            inner_node, inner_scope = self._apply_subquery_conjunct(
+                inner_node, inner_scope, inner_map, c, cglob
+            )
+
+        if not corr:
+            # uncorrelated: single-row cross join
+            if not self._contains_agg(sel):
+                raise BindError("uncorrelated scalar subquery must aggregate")
+            agg_ctx = AggCtx(group_asts=[], group_irs=[])
+            sel_ir = self._bind_agg_scope(sel, inner_scope, inner_map, agg_ctx)
+            agg = AggregationNode(
+                inner_node, [], [], agg_ctx.aggs,
+                [f"$agg{j}" for j in range(len(agg_ctx.aggs))],
+            )
+            proj = ProjectNode(agg, [sel_ir], ["$scalar"])
+            out = CrossSingleNode(left=node, right=proj)
+            ref = ColumnRef(type=sel_ir.type, index=len(node.channels))
+            return out, scope, ref
+
+        # correlated scalar aggregate -> grouped agg joined on correlation
+        if not self._contains_agg(sel):
+            raise BindError("correlated scalar subquery must aggregate")
+        group_irs = [remap_expr(ir, inner_map) for ir, _ in corr]
+        agg_ctx = AggCtx(group_asts=[], group_irs=group_irs)
+        sel_ir = self._bind_agg_scope(sel, inner_scope, inner_map, agg_ctx)
+        agg = AggregationNode(
+            inner_node, group_irs, [f"$k{i}" for i in range(len(group_irs))],
+            agg_ctx.aggs, [f"$agg{j}" for j in range(len(agg_ctx.aggs))],
+            max_groups=self._group_capacity(group_irs, inner_scope, self._estimate(inner_node)),
+        )
+        key_refs = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
+        proj = ProjectNode(agg, key_refs + [sel_ir],
+                           [f"$k{i}" for i in range(len(key_refs))] + ["$scalar"])
+        left_keys = [
+            remap_expr(ColumnRef(type=glob.cols[g].channel.type, index=g), remap)
+            for _, g in corr
+        ]
+        join = JoinNode(
+            left=node, right=proj, left_keys=left_keys, right_keys=key_refs,
+            kind="inner", unique_build=True,
+        )
+        ref = ColumnRef(type=sel_ir.type, index=len(node.channels) + len(key_refs))
+        return join, scope, ref
+
+    def _bind_agg_scope(self, e: ast.Node, inner_scope: Scope, inner_map, agg_ctx: AggCtx):
+        """Bind a subquery select expr with aggregates over the joined
+        inner tree (inner_scope indexes = tree channels)."""
+        return self._bind_agg(e, inner_scope, agg_ctx)
+
+    # ==================================================================
+    # expression binding
+    # ==================================================================
+    def _bind(self, e: ast.Node, scope: Scope) -> Expr:
+        return self._bind_impl(e, scope, None)
+
+    def _bind_agg(self, e: ast.Node, scope: Scope, agg_ctx: AggCtx) -> Expr:
+        return self._bind_impl(e, scope, agg_ctx)
+
+    def _bind_impl(self, e: ast.Node, scope: Scope, agg: Optional[AggCtx]) -> Expr:
+        if agg is not None:
+            # group-expr match (AST or bound-IR equality)
+            for i, g in enumerate(agg.group_asts):
+                if e == g:
+                    return agg.key_ref(i)
+            if not isinstance(e, (ast.NumberLit, ast.StringLit, ast.DateLit, ast.NullLit, ast.IntervalLit)):
+                try:
+                    ir = self._bind_impl(e, scope, None)
+                    for i, g in enumerate(agg.group_irs):
+                        if ir == g:
+                            return agg.key_ref(i)
+                except BindError:
+                    pass
+            if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCTIONS:
+                return self._bind_agg_call(e, scope, agg)
+
+        if isinstance(e, ast.Identifier):
+            idx = scope.resolve(e.qualifier, e.name)
+            ch = scope.col(idx).channel
+            if agg is not None:
+                raise BindError(f"column {e.name} not in GROUP BY")
+            return ColumnRef(type=ch.type, index=idx, name=e.name)
+
+        if isinstance(e, ast.NumberLit):
+            return self._bind_number(e.text)
+        if isinstance(e, ast.StringLit):
+            return Literal(type=VARCHAR, value=e.value)
+        if isinstance(e, ast.DateLit):
+            return Literal(type=DATE, value=_parse_date(e.value))
+        if isinstance(e, ast.NullLit):
+            return Literal(type=BIGINT, value=None)
+
+        if isinstance(e, ast.Binary):
+            if e.op in ("and", "or"):
+                return call(e.op, self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
+            if e.op in ("=", "<>", "<", "<=", ">", ">="):
+                opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+                return call(opmap[e.op], self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
+            if e.op in ("+", "-") and (
+                isinstance(e.right, ast.IntervalLit) or isinstance(e.left, ast.IntervalLit)
+            ):
+                return self._bind_date_arith(e, scope, agg)
+            opmap = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod"}
+            return call(opmap[e.op], self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
+
+        if isinstance(e, ast.Unary):
+            if e.op == "not":
+                return call("not", self._bind_impl(e.operand, scope, agg))
+            operand = self._bind_impl(e.operand, scope, agg)
+            if isinstance(operand, Literal) and operand.value is not None:
+                return Literal(type=operand.type, value=-operand.value)
+            return call("neg", operand)
+
+        if isinstance(e, ast.Between):
+            v = self._bind_impl(e.value, scope, agg)
+            lo = self._bind_impl(e.low, scope, agg)
+            hi = self._bind_impl(e.high, scope, agg)
+            out = call("between", v, lo, hi)
+            return call("not", out) if e.negated else out
+
+        if isinstance(e, ast.InList):
+            v = self._bind_impl(e.value, scope, agg)
+            items = [self._bind_impl(x, scope, agg) for x in e.items]
+            out = call("in", v, *items)
+            return call("not", out) if e.negated else out
+
+        if isinstance(e, ast.Like):
+            v = self._bind_impl(e.value, scope, agg)
+            p = self._bind_impl(e.pattern, scope, agg)
+            out = call("like", v, p)
+            return call("not", out) if e.negated else out
+
+        if isinstance(e, ast.IsNull):
+            v = self._bind_impl(e.value, scope, agg)
+            return call("is_null" if not e.negated else "not_null", v)
+
+        if isinstance(e, ast.Case):
+            return self._bind_case(e, scope, agg)
+
+        if isinstance(e, ast.Cast):
+            v = self._bind_impl(e.value, scope, agg)
+            tn = e.type_name.lower()
+            if tn in ("double", "double precision"):
+                return call("cast_double", v)
+            if tn in ("bigint", "integer", "int"):
+                return call("cast_bigint", v)
+            if tn.startswith("decimal"):
+                return v  # decimal arithmetic already exact
+            raise BindError(f"unsupported CAST to {e.type_name}")
+
+        if isinstance(e, ast.Extract):
+            return call(e.field, self._bind_impl(e.value, scope, agg))
+
+        if isinstance(e, ast.FuncCall):
+            if e.name in AGG_FUNCTIONS:
+                if agg is None:
+                    raise BindError(f"aggregate {e.name} in scalar context")
+                return self._bind_agg_call(e, scope, agg)
+            raise BindError(f"unknown function {e.name}")
+
+        if isinstance(e, ast.Substring):
+            raise BindError("substring not yet supported")
+
+        raise BindError(f"cannot bind {e!r}")
+
+    def _bind_number(self, text: str) -> Literal:
+        if "." in text or "e" in text.lower():
+            frac = text.split(".", 1)[1] if "." in text else ""
+            scale = len(frac)
+            scaled = int(round(float(text) * (10 ** scale)))
+            return Literal(type=DecimalType(18, scale), value=scaled)
+        return Literal(type=BIGINT, value=int(text))
+
+    def _bind_date_arith(self, e: ast.Binary, scope: Scope, agg) -> Expr:
+        if isinstance(e.right, ast.IntervalLit):
+            base_ast, iv = e.left, e.right
+        else:
+            if e.op == "-":
+                raise BindError("interval - date unsupported")
+            base_ast, iv = e.right, e.left
+        n = int(iv.value) * (-1 if iv.negative else 1)
+        if e.op == "-":
+            n = -n
+        base = self._bind_impl(base_ast, scope, agg)
+        if isinstance(base, Literal) and base.type == DATE:
+            return Literal(type=DATE, value=_shift_date(base.value, n, iv.unit))
+        if iv.unit == "day":
+            return call("date_add_days", base, Literal(type=BIGINT, value=n))
+        raise BindError("month/year interval on non-literal date unsupported")
+
+    def _bind_case(self, e: ast.Case, scope: Scope, agg) -> Expr:
+        whens = []
+        for cond, res in e.whens:
+            if e.operand is not None:
+                cond = ast.Binary("=", e.operand, cond)
+            whens.append((self._bind_impl(cond, scope, agg), self._bind_impl(res, scope, agg)))
+        args: List[Expr] = []
+        for c, r in whens:
+            args.extend([c, r])
+        if e.else_ is not None:
+            else_ir = self._bind_impl(e.else_, scope, agg)
+        else:
+            else_ir = Literal(type=whens[0][1].type, value=None)
+        args.append(else_ir)
+        return call("case", *args)
+
+    def _bind_agg_call(self, e: ast.FuncCall, scope: Scope, agg: AggCtx) -> ColumnRef:
+        from presto_tpu.ops.aggregate import output_type
+
+        if e.star or (e.name == "count" and not e.args):
+            a = AggCall(fn="count_star", arg=None, type=BIGINT)
+            return agg.agg_ref(a)
+        if len(e.args) != 1:
+            raise BindError(f"aggregate {e.name} takes one argument")
+        arg = self._bind(e.args[0], scope)
+        a = AggCall(fn=e.name, arg=arg, type=arg.type, distinct=e.distinct)
+        a = AggCall(fn=a.fn, arg=a.arg, type=output_type(a), distinct=a.distinct)
+        return agg.agg_ref(a)
+
+    # ------------------------------------------------------------------
+    def _bind_order(self, order_items, items, out_irs, scope) -> List[Expr]:
+        order_irs: List[Expr] = []
+        for o in order_items:
+            e = o.expr
+            if isinstance(e, ast.NumberLit):
+                order_irs.append(out_irs[int(e.text) - 1])
+                continue
+            hit = next(
+                (out_irs[i] for i, (se, n) in enumerate(items)
+                 if (isinstance(e, ast.Identifier) and e.qualifier is None and e.name == n) or se == e),
+                None,
+            )
+            if hit is not None:
+                order_irs.append(hit)
+            else:
+                order_irs.append(self._bind(e, scope))
+        return order_irs
+
+
+def term_of_ref(terms: List[Term], ref: int) -> int:
+    for i, t in enumerate(terms):
+        if t.offset <= ref < t.offset + len(t.scope):
+            return i
+    raise AssertionError(ref)

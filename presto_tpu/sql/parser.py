@@ -1,0 +1,478 @@
+"""SQL lexer + recursive-descent parser.
+
+Reference analog: ``presto-parser`` — the ANTLR4 grammar
+``SqlBase.g4`` (765 lines) with ``AstBuilder.java`` lowering parse
+trees to AST.  Re-done as a hand-rolled recursive-descent parser over
+the dialect subset the engine executes (SELECT queries: joins,
+subqueries, aggregates, CASE/CAST/EXTRACT, date/interval literals);
+precedence mirrors the grammar's ``booleanExpression``/
+``valueExpression`` ladder.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from presto_tpu.sql import ast
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<number>\d+\.\d*|\.\d+|\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><>|!=|<=|>=|\|\||[,().;+\-*/%<>=])
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having", "order",
+    "limit", "as", "and", "or", "not", "in", "like", "between", "is", "null",
+    "case", "when", "then", "else", "end", "cast", "extract", "exists",
+    "join", "inner", "left", "right", "outer", "cross", "on", "asc", "desc",
+    "date", "interval", "year", "month", "day", "true", "false", "substring",
+    "for", "nulls", "first", "last", "all", "any", "union",
+}
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind: str, value: str, pos: int):
+        self.kind = kind  # number | string | ident | keyword | op | eof
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise SyntaxError(f"cannot tokenize at {sql[i:i+20]!r}")
+        i = m.end()
+        if m.lastgroup == "ws":
+            continue
+        kind = m.lastgroup
+        val = m.group()
+        if kind == "ident" and val.lower() in KEYWORDS:
+            kind, val = "keyword", val.lower()
+        elif kind == "string":
+            val = val[1:-1].replace("''", "'")
+        out.append(Token(kind, val, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    @property
+    def tok(self) -> Token:
+        return self.tokens[self.i]
+
+    def peek(self, *vals: str) -> bool:
+        t = self.tok
+        return (t.kind in ("keyword", "op")) and t.value in vals
+
+    def peek2(self, val: str) -> bool:
+        t = self.tokens[self.i + 1]
+        return t.kind in ("keyword", "op") and t.value == val
+
+    def accept(self, *vals: str) -> Optional[str]:
+        if self.peek(*vals):
+            v = self.tok.value
+            self.i += 1
+            return v
+        return None
+
+    def expect(self, val: str) -> None:
+        if not self.accept(val):
+            raise SyntaxError(f"expected {val!r}, got {self.tok!r}")
+
+    def ident(self) -> str:
+        t = self.tok
+        if t.kind == "ident":
+            self.i += 1
+            return t.value
+        # non-reserved keywords usable as identifiers
+        if t.kind == "keyword" and t.value in ("year", "month", "day", "date", "first", "last"):
+            self.i += 1
+            return t.value
+        raise SyntaxError(f"expected identifier, got {t!r}")
+
+    # -- entry -------------------------------------------------------------
+    def parse_query(self) -> ast.Query:
+        q = self._query()
+        self.accept(";")
+        if self.tok.kind != "eof":
+            raise SyntaxError(f"trailing input at {self.tok!r}")
+        return q
+
+    def _query(self) -> ast.Query:
+        self.expect("select")
+        distinct = bool(self.accept("distinct"))
+        self.accept("all")
+        items = [self._select_item()]
+        while self.accept(","):
+            items.append(self._select_item())
+
+        from_: Tuple[ast.Node, ...] = ()
+        if self.accept("from"):
+            rels = [self._relation()]
+            while self.accept(","):
+                rels.append(self._relation())
+            from_ = tuple(rels)
+
+        where = self._expr() if self.accept("where") else None
+
+        group_by: Tuple[ast.Node, ...] = ()
+        if self.accept("group"):
+            self.expect("by")
+            g = [self._expr()]
+            while self.accept(","):
+                g.append(self._expr())
+            group_by = tuple(g)
+
+        having = self._expr() if self.accept("having") else None
+
+        order_by: Tuple[ast.OrderItem, ...] = ()
+        if self.accept("order"):
+            self.expect("by")
+            o = [self._order_item()]
+            while self.accept(","):
+                o.append(self._order_item())
+            order_by = tuple(o)
+
+        limit = None
+        if self.accept("limit"):
+            t = self.tok
+            if t.kind != "number":
+                raise SyntaxError(f"expected number after LIMIT, got {t!r}")
+            self.i += 1
+            limit = int(t.value)
+
+        return ast.Query(
+            select=tuple(items), distinct=distinct, from_=from_, where=where,
+            group_by=group_by, having=having, order_by=order_by, limit=limit,
+        )
+
+    def _select_item(self) -> ast.SelectItem:
+        if self.peek("*"):
+            self.i += 1
+            return ast.SelectItem(ast.Star())
+        # qualified star: ident.*
+        t = self.tok
+        if t.kind == "ident" and self.peek2(".") and self.tokens[self.i + 2].value == "*":
+            self.i += 3
+            return ast.SelectItem(ast.Star(qualifier=t.value))
+        e = self._expr()
+        alias = None
+        if self.accept("as"):
+            alias = self.ident()
+        elif self.tok.kind == "ident":
+            alias = self.ident()
+        return ast.SelectItem(e, alias)
+
+    def _order_item(self) -> ast.OrderItem:
+        e = self._expr()
+        asc = True
+        if self.accept("desc"):
+            asc = False
+        else:
+            self.accept("asc")
+        nulls_first = None
+        if self.accept("nulls"):
+            if self.accept("first"):
+                nulls_first = True
+            else:
+                self.expect("last")
+                nulls_first = False
+        return ast.OrderItem(e, asc, nulls_first)
+
+    # -- relations ---------------------------------------------------------
+    def _relation(self) -> ast.Node:
+        rel = self._relation_primary()
+        while True:
+            if self.accept("cross"):
+                self.expect("join")
+                right = self._relation_primary()
+                rel = ast.JoinRel(rel, right, "cross")
+                continue
+            kind = None
+            if self.peek("join"):
+                kind = "inner"
+            elif self.peek("inner") and self.peek2("join"):
+                kind = "inner"
+                self.i += 1
+            elif self.peek("left"):
+                kind = "left"
+                self.i += 1
+                self.accept("outer")
+            elif self.peek("right"):
+                kind = "right"
+                self.i += 1
+                self.accept("outer")
+            if kind is None:
+                return rel
+            self.expect("join")
+            right = self._relation_primary()
+            self.expect("on")
+            cond = self._expr()
+            if kind == "right":  # normalize: right join = left join flipped
+                rel = ast.JoinRel(right, rel, "left", cond)
+            else:
+                rel = ast.JoinRel(rel, right, kind, cond)
+
+    def _relation_primary(self) -> ast.Node:
+        if self.accept("("):
+            if self.peek("select"):
+                q = self._query()
+                self.expect(")")
+                alias = None
+                if self.accept("as"):
+                    alias = self.ident()
+                elif self.tok.kind == "ident":
+                    alias = self.ident()
+                return ast.SubqueryRel(q, alias)
+            rel = self._relation()
+            self.expect(")")
+            return rel
+        name = self.ident()
+        alias = None
+        if self.accept("as"):
+            alias = self.ident()
+        elif self.tok.kind == "ident":
+            alias = self.ident()
+        return ast.TableRef(name, alias)
+
+    # -- expressions (precedence ladder) ------------------------------------
+    def _expr(self) -> ast.Node:
+        return self._or()
+
+    def _or(self) -> ast.Node:
+        e = self._and()
+        while self.accept("or"):
+            e = ast.Binary("or", e, self._and())
+        return e
+
+    def _and(self) -> ast.Node:
+        e = self._not()
+        while self.accept("and"):
+            e = ast.Binary("and", e, self._not())
+        return e
+
+    def _not(self) -> ast.Node:
+        if self.accept("not"):
+            return ast.Unary("not", self._not())
+        return self._predicate()
+
+    def _predicate(self) -> ast.Node:
+        e = self._addsub()
+        while True:
+            if self.peek("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.tok.value
+                self.i += 1
+                op = {"!=": "<>"}.get(op, op)
+                rhs = self._addsub()
+                e = ast.Binary(op, e, rhs)
+                continue
+            negated = False
+            save = self.i
+            if self.accept("not"):
+                if self.peek("in", "like", "between"):
+                    negated = True
+                else:
+                    self.i = save
+                    return e
+            if self.accept("between"):
+                lo = self._addsub()
+                self.expect("and")
+                hi = self._addsub()
+                e = ast.Between(e, lo, hi, negated)
+                continue
+            if self.accept("in"):
+                self.expect("(")
+                if self.peek("select"):
+                    q = self._query()
+                    self.expect(")")
+                    e = ast.InSubquery(e, q, negated)
+                else:
+                    items = [self._expr()]
+                    while self.accept(","):
+                        items.append(self._expr())
+                    self.expect(")")
+                    e = ast.InList(e, tuple(items), negated)
+                continue
+            if self.accept("like"):
+                e = ast.Like(e, self._addsub(), negated)
+                continue
+            if self.accept("is"):
+                neg = bool(self.accept("not"))
+                self.expect("null")
+                e = ast.IsNull(e, neg)
+                continue
+            return e
+
+    def _addsub(self) -> ast.Node:
+        e = self._muldiv()
+        while self.peek("+", "-"):
+            op = self.tok.value
+            self.i += 1
+            e = ast.Binary(op, e, self._muldiv())
+        return e
+
+    def _muldiv(self) -> ast.Node:
+        e = self._unary()
+        while self.peek("*", "/", "%"):
+            op = self.tok.value
+            self.i += 1
+            e = ast.Binary(op, e, self._unary())
+        return e
+
+    def _unary(self) -> ast.Node:
+        if self.accept("-"):
+            return ast.Unary("-", self._unary())
+        if self.accept("+"):
+            return self._unary()
+        return self._primary()
+
+    def _primary(self) -> ast.Node:
+        t = self.tok
+
+        if t.kind == "number":
+            self.i += 1
+            return ast.NumberLit(t.value)
+        if t.kind == "string":
+            self.i += 1
+            return ast.StringLit(t.value)
+        if self.accept("null"):
+            return ast.NullLit()
+        if self.accept("true"):
+            return ast.NumberLit("1")  # boolean literal folded
+        if self.accept("false"):
+            return ast.NumberLit("0")
+
+        if self.accept("date"):
+            s = self.tok
+            if s.kind != "string":
+                raise SyntaxError("expected string after DATE")
+            self.i += 1
+            return ast.DateLit(s.value)
+
+        if self.accept("interval"):
+            neg = bool(self.accept("-"))
+            s = self.tok
+            if s.kind != "string":
+                raise SyntaxError("expected string after INTERVAL")
+            self.i += 1
+            unit = self.tok.value
+            if not self.accept("year", "month", "day"):
+                raise SyntaxError(f"unsupported interval unit {unit!r}")
+            return ast.IntervalLit(s.value, unit, neg)
+
+        if self.accept("case"):
+            operand = None
+            if not self.peek("when"):
+                operand = self._expr()
+            whens = []
+            while self.accept("when"):
+                c = self._expr()
+                self.expect("then")
+                r = self._expr()
+                whens.append((c, r))
+            else_ = self._expr() if self.accept("else") else None
+            self.expect("end")
+            return ast.Case(tuple(whens), else_, operand)
+
+        if self.accept("cast"):
+            self.expect("(")
+            v = self._expr()
+            self.expect("as")
+            # type name: ident or keyword like DATE, possibly with (p, s)
+            tt = self.tok
+            self.i += 1
+            type_name = tt.value
+            if self.accept("("):
+                type_name += "("
+                while not self.peek(")"):
+                    type_name += self.tok.value
+                    self.i += 1
+                type_name += ")"
+                self.expect(")")
+            self.expect(")")
+            return ast.Cast(v, type_name)
+
+        if self.accept("extract"):
+            self.expect("(")
+            field = self.tok.value
+            if not self.accept("year", "month", "day"):
+                raise SyntaxError(f"unsupported extract field {field!r}")
+            self.expect("from")
+            v = self._expr()
+            self.expect(")")
+            return ast.Extract(field, v)
+
+        if self.accept("substring"):
+            self.expect("(")
+            v = self._expr()
+            if self.accept("from"):
+                start = self._expr()
+                length = self._expr() if self.accept("for") else None
+            else:
+                self.expect(",")
+                start = self._expr()
+                length = self._expr() if self.accept(",") else None
+            self.expect(")")
+            return ast.Substring(v, start, length)
+
+        if self.accept("exists"):
+            self.expect("(")
+            q = self._query()
+            self.expect(")")
+            return ast.Exists(q)
+
+        if self.accept("("):
+            if self.peek("select"):
+                q = self._query()
+                self.expect(")")
+                return ast.ScalarSubquery(q)
+            e = self._expr()
+            self.expect(")")
+            return e
+
+        if t.kind == "ident" or (t.kind == "keyword" and t.value in ("year", "month", "day")):
+            name = t.value
+            self.i += 1
+            if self.accept("("):  # function call
+                if self.accept("*"):
+                    self.expect(")")
+                    return ast.FuncCall(name.lower(), (), star=True)
+                distinct = bool(self.accept("distinct"))
+                args: List[ast.Node] = []
+                if not self.peek(")"):
+                    args.append(self._expr())
+                    while self.accept(","):
+                        args.append(self._expr())
+                self.expect(")")
+                return ast.FuncCall(name.lower(), tuple(args), distinct=distinct)
+            parts = [name]
+            while self.peek(".") :
+                self.i += 1
+                parts.append(self.ident())
+            return ast.Identifier(tuple(parts))
+
+        raise SyntaxError(f"unexpected token {t!r}")
+
+
+def parse_query(sql: str) -> ast.Query:
+    return Parser(sql).parse_query()

@@ -1,0 +1,162 @@
+"""SQLite correctness oracle.
+
+Reference analog: ``presto-tests/.../H2QueryRunner.java`` — the
+reference runs its SQL corpus against the H2 embedded database and
+diffs result multisets (QueryAssertions.assertQuery).  Here: load the
+same generated TPC-H data into sqlite, translate the dialect (date
+literals -> epoch-day ints, extract -> UDFs), and compare rows with
+float tolerance.
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import re
+import sqlite3
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _days(s: str) -> int:
+    return (datetime.date.fromisoformat(s) - datetime.date(1970, 1, 1)).days
+
+
+def _shift(days: int, n: int, unit: str) -> int:
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+    if unit == "day":
+        return days + n
+    months = n * (12 if unit == "year" else 1)
+    m = d.month - 1 + months
+    y = d.year + m // 12
+    m = m % 12 + 1
+    import calendar
+
+    day = min(d.day, calendar.monthrange(y, m)[1])
+    return (datetime.date(y, m, day) - datetime.date(1970, 1, 1)).days
+
+
+def translate(sql: str) -> str:
+    """Engine dialect -> sqlite: fold date/interval arithmetic into int
+    literals, extract() -> UDFs, substring from/for -> substr."""
+
+    def fold_date_arith(m):
+        base = _days(m.group(1))
+        op = m.group(2)
+        n = int(m.group(3)) * (1 if op == "+" else -1)
+        return str(_shift(base, n, m.group(4)))
+
+    sql = re.sub(
+        r"date\s+'(\d{4}-\d{2}-\d{2})'\s*([+-])\s*interval\s+'(\d+)'\s+(day|month|year)",
+        fold_date_arith,
+        sql,
+        flags=re.IGNORECASE,
+    )
+    sql = re.sub(
+        r"date\s+'(\d{4}-\d{2}-\d{2})'", lambda m: str(_days(m.group(1))), sql,
+        flags=re.IGNORECASE,
+    )
+    sql = re.sub(
+        r"extract\s*\(\s*(year|month|day)\s+from\s+([a-zA-Z0-9_.]+)\s*\)",
+        lambda m: f"{m.group(1)}_of({m.group(2)})",
+        sql,
+        flags=re.IGNORECASE,
+    )
+    sql = re.sub(
+        r"substring\s*\(\s*([a-zA-Z0-9_.]+)\s+from\s+(\d+)\s+for\s+(\d+)\s*\)",
+        lambda m: f"substr({m.group(1)}, {m.group(2)}, {m.group(3)})",
+        sql,
+        flags=re.IGNORECASE,
+    )
+
+    # fold decimal-literal +/- exactly: sqlite would compute 0.06 - 0.01
+    # in binary floats (0.049999...), while the engine (like Presto)
+    # uses exact DECIMAL arithmetic.
+    from decimal import Decimal
+
+    def fold_dec(m):
+        a, op, b = Decimal(m.group(1)), m.group(2), Decimal(m.group(3))
+        return str(a + b if op == "+" else a - b)
+
+    sql = re.sub(r"(\d+\.\d+)\s*([+-])\s*(\d+\.\d+)", fold_dec, sql)
+    return sql
+
+
+def load_oracle(tpch) -> sqlite3.Connection:
+    """Load all TPC-H tables (decoded values: strings, int epoch days,
+    float decimals) into an in-memory sqlite database."""
+    conn = sqlite3.connect(":memory:")
+
+    def year_of(days):
+        return (datetime.date(1970, 1, 1) + datetime.timedelta(days=days)).year
+
+    def month_of(days):
+        return (datetime.date(1970, 1, 1) + datetime.timedelta(days=days)).month
+
+    def day_of(days):
+        return (datetime.date(1970, 1, 1) + datetime.timedelta(days=days)).day
+
+    conn.create_function("year_of", 1, year_of)
+    conn.create_function("month_of", 1, month_of)
+    conn.create_function("day_of", 1, day_of)
+
+    from presto_tpu.connectors.tpch import SCHEMAS
+
+    for table in tpch.table_names():
+        schema = SCHEMAS[table]
+        cols = ", ".join(n for n, _ in schema)
+        conn.execute(f"create table {table} ({cols})")
+        for split in range(tpch.num_splits(table)):
+            data = tpch.generate_split(table, split)
+            out_cols = []
+            for name, t in schema:
+                arr = data[name]
+                if t.is_string:
+                    d = tpch.dictionary_for(table, name)
+                    out_cols.append(d.decode(arr).tolist())
+                elif t.is_decimal:
+                    out_cols.append((arr / (10.0 ** t.scale)).tolist())
+                else:
+                    out_cols.append(arr.tolist())
+            rows = list(zip(*out_cols))
+            ph = ", ".join("?" for _ in schema)
+            conn.executemany(f"insert into {table} values ({ph})", rows)
+    conn.commit()
+    return conn
+
+
+def _key(row: Sequence) -> tuple:
+    out = []
+    for v in row:
+        if isinstance(v, float):
+            out.append(round(v, 2))
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def assert_rows_match(actual: List[tuple], expected: List[tuple], ordered: bool):
+    assert len(actual) == len(expected), (
+        f"row count mismatch: got {len(actual)}, want {len(expected)}\n"
+        f"got: {actual[:5]}\nwant: {expected[:5]}"
+    )
+    a = actual if ordered else sorted(actual, key=_key)
+    e = expected if ordered else sorted(expected, key=_key)
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        assert len(ra) == len(re_), f"row {i} arity mismatch: {ra} vs {re_}"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            if isinstance(va, float) or isinstance(ve, float):
+                if va is None or ve is None:
+                    assert va is None and ve is None, f"row {i} col {j}: {va} vs {ve}"
+                    continue
+                assert math.isclose(float(va), float(ve), rel_tol=1e-9, abs_tol=1e-6), (
+                    f"row {i} col {j}: {va} != {ve}\nrow got: {ra}\nrow want: {re_}"
+                )
+            else:
+                assert va == ve, f"row {i} col {j}: {va!r} != {ve!r}\nrow got: {ra}\nrow want: {re_}"
+
+
+def run_oracle(conn: sqlite3.Connection, sql: str) -> List[tuple]:
+    cur = conn.execute(translate(sql))
+    return [tuple(r) for r in cur.fetchall()]
