@@ -397,6 +397,14 @@ class Tpch:
             self._li_count = total
         return self._li_count
 
+    def max_split_rows(self, table: str) -> int:
+        """Static upper bound on rows in any split (static-shape wave
+        capacity for distributed scans)."""
+        if table == "lineitem":
+            per = max(self.split_rows // 4, 1)
+            return min(per * 7, max(self.row_count("lineitem"), 1))
+        return min(self.split_rows, max(self.row_count(table), 1))
+
     def num_splits(self, table: str) -> int:
         if table in ("orders", "lineitem"):
             per = max(self.split_rows // 4, 1) if table == "lineitem" else self.split_rows

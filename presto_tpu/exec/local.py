@@ -43,6 +43,7 @@ from presto_tpu.planner.plan import (
     LimitNode,
     OutputNode,
     PlanNode,
+    PrecomputedNode,
     ProjectNode,
     SortNode,
     TableScanNode,
@@ -186,6 +187,10 @@ class LocalRunner:
                 for i, t in enumerate(node.types)
             ]
             yield Page.from_arrays(cols, node.types)
+            return
+
+        if isinstance(node, PrecomputedNode):
+            yield node.page
             return
 
         if isinstance(node, JoinNode) and not _is_streaming_join(node):

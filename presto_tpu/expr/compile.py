@@ -34,6 +34,31 @@ from presto_tpu.types import BOOLEAN, DOUBLE, Type
 
 CompiledExpr = Callable[[Page], Tuple[jax.Array, jax.Array]]
 
+# derived-dictionary cache: (id(inner), start, length) -> (inner, derived).
+# Keeping the inner reference alive pins its id.
+_DERIVED_DICTS: dict = {}
+
+
+def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Optional[Dictionary]:
+    """Dictionary provenance of a string-typed expression: bare columns
+    keep theirs; substr() derives a transformed dictionary host-side
+    (codes unchanged — only the code->value mapping transforms)."""
+    if isinstance(e, ColumnRef):
+        return dictionaries[e.index]
+    if isinstance(e, Call) and e.fn == "substr":
+        inner = expr_dictionary(e.args[0], dictionaries)
+        if inner is None:
+            return None
+        start = e.args[1].value
+        length = e.args[2].value if len(e.args) > 2 else None
+        key = (id(inner), start, length)
+        if key not in _DERIVED_DICTS:
+            end = None if length is None else start - 1 + length
+            values = [v[start - 1 : end] for v in inner.values]
+            _DERIVED_DICTS[key] = (inner, Dictionary(values))
+        return _DERIVED_DICTS[key][1]
+    return None
+
 
 def _rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
     if to_scale > from_scale:
@@ -194,6 +219,11 @@ class ExprCompiler:
                 return d.astype(jnp.int64), v
 
             return run_cast_bigint
+        if fn == "substr":
+            # dictionary codes pass through unchanged; the *values* are
+            # transformed host-side once (see _dict_of) — the device
+            # never touches bytes (DictionaryAwarePageProjection analog)
+            return self.compile(expr.args[0])
         raise KeyError(f"cannot compile {expr}")
 
     # ------------------------------------------------------------------
@@ -254,9 +284,7 @@ class ExprCompiler:
         return d.code_of(s)
 
     def _dict_of(self, e: Expr) -> Optional[Dictionary]:
-        if isinstance(e, ColumnRef):
-            return self.dictionaries[e.index]
-        return None
+        return expr_dictionary(e, self.dictionaries)
 
     def _compile_cmp(self, expr: Call) -> CompiledExpr:
         lhs, rhs = expr.args
@@ -308,13 +336,16 @@ class ExprCompiler:
         cf = self.compile(colref)
         d = self._dict_of(colref)
         if op in ("eq", "ne"):
-            code = self._string_code(colref, s)
+            # LUT, not code equality: derived dictionaries (substr) may
+            # map many codes to the same value
+            if d is None:
+                raise ValueError(f"no dictionary for string column {colref}")
             want_eq = op == "eq"
+            lut = jnp.asarray(d.lut(lambda v: (v == s) == want_eq))
 
             def run_eq(page):
                 dd, v = cf(page)
-                r = (dd == code) if want_eq else (dd != code)
-                return r, v
+                return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
 
             return run_eq
         # ordered: LUT of predicate over dictionary values
@@ -352,14 +383,15 @@ class ExprCompiler:
         values = expr.args[1:]
         cf = self.compile(colref)
         if colref.type.is_string:
-            codes = [self._string_code(colref, v.value) for v in values]
+            d = self._dict_of(colref)
+            if d is None:
+                raise ValueError(f"no dictionary for string column {colref}")
+            wanted = {v.value for v in values}
+            lut = jnp.asarray(d.lut(lambda s: s in wanted))
 
             def run_in_str(page):
                 dd, v = cf(page)
-                hit = jnp.zeros_like(dd, dtype=jnp.bool_)
-                for c in codes:
-                    hit = hit | (dd == c)
-                return hit, v
+                return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
 
             return run_in_str
         lits = [v.value for v in values]

@@ -133,6 +133,8 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return DOUBLE
     if fn == "cast_bigint":
         return BIGINT
+    if fn == "substr":
+        return ts[0]  # dictionary codes pass through; values derive
     raise KeyError(f"unknown function {fn} for types {ts}")
 
 

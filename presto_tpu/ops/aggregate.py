@@ -304,11 +304,12 @@ def grouped_aggregate(
     kd = [c.compile(e)(page) for e in group_exprs]
     datas = [d for d, _ in kd]
     valids = [v for _, v in kd]
-    key_dicts = []
-    from presto_tpu.expr.ir import ColumnRef
+    from presto_tpu.expr.compile import expr_dictionary
 
-    for e in group_exprs:
-        key_dicts.append(page.blocks[e.index].dictionary if isinstance(e, ColumnRef) else None)
+    dicts = [b.dictionary for b in page.blocks]
+    key_dicts = [
+        expr_dictionary(e, dicts) if e.type.is_string else None for e in group_exprs
+    ]
 
     live = page.row_mask
 

@@ -30,15 +30,14 @@ def project_page(page: Page, projections: Sequence[Expr]) -> Page:
     the source block's dictionary (dictionary-aware projection,
     DictionaryAwarePageProjection.java analog).
     """
+    from presto_tpu.expr.compile import expr_dictionary
+
     c = ExprCompiler.for_page(page)
+    dicts = [b.dictionary for b in page.blocks]
     blocks: List[Block] = []
     for e in projections:
         data, valid = c.compile(e)(page)
-        dictionary = None
-        from presto_tpu.expr.ir import ColumnRef
-
-        if isinstance(e, ColumnRef):
-            dictionary = page.blocks[e.index].dictionary
+        dictionary = expr_dictionary(e, dicts) if e.type.is_string else None
         if data.dtype != e.type.np_dtype:
             data = data.astype(e.type.np_dtype)
         blocks.append(Block(data, valid, e.type, dictionary))

@@ -1,0 +1,4 @@
+from presto_tpu.parallel.exchange import (  # noqa: F401
+    exchange_page,
+    partition_for_exchange,
+)

@@ -1,0 +1,129 @@
+"""Device-mesh exchange: the TPU-native shuffle.
+
+Reference analog: the data plane of Presto's partitioned exchange —
+``operator/PartitionedOutputOperator.java:48`` (hash rows to partition
+buckets) + ``execution/buffer/PartitionedOutputBuffer.java`` +
+``operator/ExchangeClient.java:58`` (HTTP pull).  On a TPU slice the
+whole producer-buffer-consumer pipeline collapses into one collective:
+each device bucketizes its rows by target partition and a single
+``jax.lax.all_to_all`` over the ICI mesh delivers every bucket — no
+serde, no acking, no backpressure (SPMD barrier semantics instead of
+pull-based flow control; see SURVEY.md §2.3).
+
+Bucket capacity is static (XLA shapes): each device may send at most
+``bucket_cap`` rows to each target.  Overflow is detected (count
+returned) and the driver re-runs the wave with a larger bucket — the
+moral equivalent of the reference's bounded output buffers blocking the
+producer (``OutputBufferMemoryManager``), resolved at compile-size
+granularity instead of at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.expr.compile import ExprCompiler
+from presto_tpu.expr.ir import Expr
+from presto_tpu.ops.aggregate import _mix64, pack_or_hash_keys
+from presto_tpu.page import Block, Page
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def partition_targets(
+    page: Page,
+    key_exprs: Sequence[Expr],
+    n_parts: int,
+    key_domains=None,
+) -> jax.Array:
+    """Target partition per row (int32; dead rows -> n_parts).
+
+    The hash-mix ensures partitioning is independent of the packed
+    key's structure (LocalPartitionGenerator analog)."""
+    c = ExprCompiler.for_page(page)
+    kd = [c.compile(e)(page) for e in key_exprs]
+    datas = [d for d, _ in kd]
+    valids = [v for _, v in kd]
+    key, _ = pack_or_hash_keys(datas, valids, key_domains)
+    h = _mix64(key.astype(jnp.uint64))
+    t = (h % jnp.uint64(n_parts)).astype(jnp.int32)
+    return jnp.where(page.row_mask, t, n_parts)
+
+
+def partition_for_exchange(
+    page: Page,
+    target: jax.Array,
+    n_parts: int,
+    bucket_cap: int,
+) -> Tuple[Page, jax.Array]:
+    """Scatter rows into ``n_parts`` contiguous buckets of ``bucket_cap``
+    rows each (output capacity n_parts*bucket_cap, bucket p occupying
+    rows [p*bucket_cap, (p+1)*bucket_cap)).
+
+    Returns (bucketized page, max bucket fill) — fill > bucket_cap
+    means overflow: rows were dropped and the caller must retry with a
+    larger bucket_cap."""
+    cap = page.capacity
+    order = jnp.argsort(target)  # groups rows by target, dead last
+    sorted_t = target[order]
+    idx = jnp.arange(cap)
+    first = jnp.concatenate([jnp.ones(1, jnp.bool_), sorted_t[1:] != sorted_t[:-1]])
+    run_start = jax.lax.associative_scan(jnp.maximum, jnp.where(first, idx, 0))
+    slot = idx - run_start
+    live_sorted = sorted_t < n_parts
+    dest = jnp.where(
+        live_sorted & (slot < bucket_cap),
+        sorted_t * bucket_cap + slot,
+        n_parts * bucket_cap,  # dropped (out of range)
+    )
+    counts = jax.ops.segment_sum(
+        jnp.ones(cap, jnp.int32), jnp.where(live_sorted, sorted_t, n_parts),
+        num_segments=n_parts + 1,
+    )[:n_parts]
+    fill = jnp.max(counts) if n_parts > 0 else jnp.zeros((), jnp.int32)
+
+    out_cap = n_parts * bucket_cap
+    blocks: List[Block] = []
+    for b in page.blocks:
+        data = jnp.zeros((out_cap,), dtype=b.data.dtype).at[dest].set(
+            b.data[order], mode="drop"
+        )
+        valid = jnp.zeros((out_cap,), dtype=jnp.bool_).at[dest].set(
+            b.valid[order], mode="drop"
+        )
+        blocks.append(Block(data, valid, b.type, b.dictionary))
+    mask = jnp.zeros((out_cap,), dtype=jnp.bool_).at[dest].set(
+        page.row_mask[order], mode="drop"
+    )
+    return Page(tuple(blocks), mask), fill
+
+
+def exchange_page(page: Page, axis_name: str) -> Page:
+    """All-to-all a bucketized page over the mesh axis: bucket p of
+    device s arrives at device p as bucket s.  Must be called inside
+    shard_map; capacity must be n_devices * bucket_cap."""
+
+    def a2a(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+    blocks = tuple(
+        Block(a2a(b.data), a2a(b.valid), b.type, b.dictionary) for b in page.blocks
+    )
+    return Page(blocks, a2a(page.row_mask))
+
+
+def broadcast_gather_page(page: Page, axis_name: str) -> Page:
+    """All-gather a page over the mesh axis (broadcast exchange analog:
+    execution/buffer/BroadcastOutputBuffer.java — every device ends up
+    with every row)."""
+
+    def ag(x):
+        return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+    blocks = tuple(
+        Block(ag(b.data), ag(b.valid), b.type, b.dictionary) for b in page.blocks
+    )
+    return Page(blocks, ag(page.row_mask))

@@ -58,11 +58,16 @@ class PlanNode:
 
 def _expr_channel(e: Expr, name: str, src: List[Channel]) -> Channel:
     """Derive output channel metadata for a projection expression."""
+    from presto_tpu.expr.compile import expr_dictionary
     from presto_tpu.expr.ir import ColumnRef
 
     if isinstance(e, ColumnRef) and e.index < len(src):
         s = src[e.index]
         return Channel(name, e.type, s.dictionary, s.domain)
+    if e.type.is_string:
+        d = expr_dictionary(e, [c.dictionary for c in src])
+        if d is not None:
+            return Channel(name, e.type, d, (0, len(d) - 1))
     return Channel(name, e.type)
 
 
@@ -284,6 +289,21 @@ class ValuesNode(PlanNode):
     @property
     def channels(self) -> List[Channel]:
         return [Channel(n, t) for n, t in zip(self.names, self.types)]
+
+
+@dataclasses.dataclass(eq=False)
+class PrecomputedNode(PlanNode):
+    """A materialized Page injected into a plan — how distributed stage
+    results re-enter local post-processing (the role RemoteSourceNode /
+    ExchangeNode plays between fragments in
+    planner/plan/RemoteSourceNode.java)."""
+
+    page: object  # Page
+    channel_list: List[Channel]
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.channel_list
 
 
 @dataclasses.dataclass(eq=False)

@@ -599,7 +599,28 @@ class Binder:
 
         out_irs = [self._bind_agg(e, scope, agg_ctx) for e, _ in items]
         names = [n for _, n in items]
-        having_ir = self._bind_agg(having, scope, agg_ctx) if having is not None else None
+
+        # HAVING: plain conjuncts filter the agg output; scalar-subquery
+        # comparisons (Q11 shape) become single-row cross joins + filter
+        having_plain: List[Expr] = []
+        having_sub: List[Tuple[str, Expr, ast.Query, bool]] = []
+        for c in split_conjuncts(having):
+            negated = False
+            while isinstance(c, ast.Unary) and c.op == "not":
+                negated = not negated
+                c = c.operand
+            if _is_subquery_conjunct(c):
+                if not isinstance(c, ast.Binary):
+                    raise BindError("only scalar-subquery comparisons supported in HAVING")
+                lhs, rhs, op = c.left, c.right, c.op
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                if isinstance(lhs, ast.ScalarSubquery):
+                    lhs, rhs, op = rhs, lhs, flip.get(op, op)
+                lhs_ir = self._bind_agg(lhs, scope, agg_ctx)
+                having_sub.append((op, lhs_ir, rhs.query, negated))
+            else:
+                ir = self._bind_agg(c, scope, agg_ctx)
+                having_plain.append(call("not", ir) if negated else ir)
         order_irs = []
         for o in order_items:
             e = o.expr
@@ -630,8 +651,17 @@ class Binder:
             max_groups=self._group_capacity(group_irs, scope, est),
         )
         out: PlanNode = agg
-        if having_ir is not None:
-            out = FilterNode(out, having_ir)
+        for ir in having_plain:
+            out = FilterNode(out, ir)
+        opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+        for op, lhs_ir, subq, negated in having_sub:
+            sub_node, _ = self._plan_query(subq)
+            ref = ColumnRef(type=sub_node.channels[0].type, index=len(out.channels))
+            out = CrossSingleNode(left=out, right=sub_node)
+            pred: Expr = call(opmap[op], lhs_ir, ref)
+            if negated:
+                pred = call("not", pred)
+            out = FilterNode(out, pred)
         return out, out_irs, names, order_irs
 
     def _group_capacity(self, group_irs: List[Expr], scope: Scope, est_rows: float) -> int:
@@ -718,6 +748,14 @@ class Binder:
 
         raise BindError(f"unsupported subquery conjunct {c!r}")
 
+    def _is_correlated(self, q: ast.Query, outer_glob: Scope) -> bool:
+        """A subquery is correlated iff it does not bind standalone."""
+        try:
+            self._plan_query(q)
+            return False
+        except BindError:
+            return True
+
     def _split_correlation(self, q: ast.Query, outer_glob: Scope):
         """Plan a subquery's FROM; bind its WHERE in (inner + outer)
         scope; separate correlation equi-conjuncts from inner filters."""
@@ -731,7 +769,11 @@ class Binder:
 
         inner_conjuncts: List[ast.Node] = []
         corr: List[Tuple[Expr, int]] = []  # (inner ir, outer glob ref)
+        # non-equi correlation: (cmp fn, inner ir, outer glob ref) —
+        # decorrelated via per-group min/max aggregates (Q21 shape)
+        corr_extra: List[Tuple[str, Expr, int]] = []
         nested: List[ast.Node] = []
+        flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "ne": "ne"}
         for c in conjuncts:
             if _is_subquery_conjunct(c):
                 nested.append(c)
@@ -750,34 +792,93 @@ class Binder:
                 if a.index >= len(inner_glob):
                     a, b = b, a
                 corr.append((a, b.index - len(inner_glob)))
+            elif (
+                isinstance(ir, Call) and ir.fn in flip
+                and len(ir.args) == 2 and len(outer_refs) == 1
+            ):
+                a, b = ir.args
+                fn = ir.fn
+                if isinstance(a, ColumnRef) and a.index >= len(inner_glob):
+                    a, b, fn = b, a, flip[fn]
+                if not (
+                    isinstance(b, ColumnRef) and b.index >= len(inner_glob)
+                    and all(r < len(inner_glob) for r in expr_refs(a))
+                ):
+                    raise BindError(f"unsupported correlated predicate {c!r}")
+                corr_extra.append((fn, a, b.index - len(inner_glob)))
             else:
                 raise BindError(f"unsupported correlated predicate {c!r}")
-        return terms, inner_conjuncts, corr, nested, inner_glob
+        return terms, inner_conjuncts, corr, corr_extra, nested, inner_glob
 
     def _plan_exists(self, node, scope, remap, glob, q: ast.Query, kind: str):
-        terms, inner_conjuncts, corr, nested, inner_glob = self._split_correlation(q, glob)
+        terms, inner_conjuncts, corr, corr_extra, nested, inner_glob = \
+            self._split_correlation(q, glob)
         if not corr:
             raise BindError("uncorrelated EXISTS unsupported")
+        if nested:
+            raise BindError("nested subquery in EXISTS unsupported")
         saved = self._pending_subqueries
         self._pending_subqueries = []
         inner_node, _, inner_map = self._join_terms(terms, inner_conjuncts)
-        for c, cglob in self._pending_subqueries:
-            inner_node, _ = self._apply_subquery_conjunct(
-                inner_node, Scope([]), inner_map, c, cglob
-            )
         self._pending_subqueries = saved
-        if nested:
-            raise BindError("nested subquery in EXISTS unsupported")
+
         left_keys = [
             remap_expr(ColumnRef(type=glob.cols[g].channel.type, index=g), remap)
             for _, g in corr
         ]
         right_keys = [remap_expr(ir, inner_map) for ir, _ in corr]
-        join = JoinNode(
-            left=node, right=inner_node, left_keys=left_keys, right_keys=right_keys,
-            kind=kind,
+
+        if not corr_extra:
+            join = JoinNode(
+                left=node, right=inner_node, left_keys=left_keys, right_keys=right_keys,
+                kind=kind,
+            )
+            return join, scope
+
+        # Non-equi correlation (e.g. Q21's  l2.x <> l1.x):
+        # EXISTS(k = outer.k AND x <> outer.x)  <=>
+        #   group inner by k with min(x), max(x); left-join on k;
+        #   matched AND (min <> outer.x OR max <> outer.x).
+        # (for <,<=: test min; for >,>=: test max)
+        if len(corr_extra) != 1:
+            raise BindError("multiple non-equi correlated predicates unsupported")
+        fn, inner_x, outer_g = corr_extra[0]
+        x = remap_expr(inner_x, inner_map)
+        group_irs = right_keys
+        aggs = [AggCall("min", x, x.type), AggCall("max", x, x.type)]
+        inner_scope_cols = [
+            inner_glob.cols[g] for g, _ in sorted(inner_map.items(), key=lambda kv: kv[1])
+        ]
+        agg = AggregationNode(
+            inner_node, group_irs, [f"$k{i}" for i in range(len(group_irs))],
+            aggs, ["$min", "$max"],
+            max_groups=self._group_capacity(
+                group_irs, Scope(inner_scope_cols), self._estimate(inner_node)
+            ),
         )
-        return join, scope
+        key_refs = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
+        join = JoinNode(
+            left=node, right=agg, left_keys=left_keys, right_keys=key_refs,
+            kind="left", unique_build=True,
+        )
+        base = len(node.channels) + len(group_irs)
+        min_ref = ColumnRef(type=x.type, index=base)
+        max_ref = ColumnRef(type=x.type, index=base + 1)
+        outer_val = remap_expr(
+            ColumnRef(type=glob.cols[outer_g].channel.type, index=outer_g), remap
+        )
+        matched = call("not_null", min_ref)
+        if fn == "ne":
+            cond = call("and", matched,
+                        call("or", call("ne", min_ref, outer_val), call("ne", max_ref, outer_val)))
+        elif fn in ("lt", "le"):
+            cond = call("and", matched, call(fn, min_ref, outer_val))
+        elif fn in ("gt", "ge"):
+            cond = call("and", matched, call(fn, max_ref, outer_val))
+        else:
+            raise BindError(f"unsupported correlated comparison {fn}")
+        pred = cond if kind == "semi" else call("not", cond)
+        return FilterNode(join, pred), scope
 
     def _plan_scalar_subquery(self, node, scope, remap, glob, q: ast.Query):
         """Returns (new node, scope, ColumnRef to the scalar value)."""
@@ -785,7 +886,19 @@ class Binder:
             raise BindError("scalar subquery must select one column")
         sel = q.select[0].expr
 
-        terms, inner_conjuncts, corr, nested, inner_glob = self._split_correlation(q, glob)
+        if not self._is_correlated(q, glob):
+            # uncorrelated: plan the full query, single-row cross join
+            sub_node, _ = self._plan_query(q)
+            out = CrossSingleNode(left=node, right=sub_node)
+            ref = ColumnRef(type=sub_node.channels[0].type, index=len(node.channels))
+            return out, scope, ref
+
+        terms, inner_conjuncts, corr, corr_extra, nested, inner_glob = \
+            self._split_correlation(q, glob)
+        if corr_extra:
+            raise BindError("non-equi correlation in scalar subquery unsupported")
+        if not corr:
+            raise BindError(f"cannot bind scalar subquery {q!r}")
         saved = self._pending_subqueries
         self._pending_subqueries = []
         inner_node, _, inner_map = self._join_terms(terms, inner_conjuncts)
@@ -798,21 +911,6 @@ class Binder:
             inner_node, inner_scope = self._apply_subquery_conjunct(
                 inner_node, inner_scope, inner_map, c, cglob
             )
-
-        if not corr:
-            # uncorrelated: single-row cross join
-            if not self._contains_agg(sel):
-                raise BindError("uncorrelated scalar subquery must aggregate")
-            agg_ctx = AggCtx(group_asts=[], group_irs=[])
-            sel_ir = self._bind_agg_scope(sel, inner_scope, inner_map, agg_ctx)
-            agg = AggregationNode(
-                inner_node, [], [], agg_ctx.aggs,
-                [f"$agg{j}" for j in range(len(agg_ctx.aggs))],
-            )
-            proj = ProjectNode(agg, [sel_ir], ["$scalar"])
-            out = CrossSingleNode(left=node, right=proj)
-            ref = ColumnRef(type=sel_ir.type, index=len(node.channels))
-            return out, scope, ref
 
         # correlated scalar aggregate -> grouped agg joined on correlation
         if not self._contains_agg(sel):
@@ -955,7 +1053,17 @@ class Binder:
             raise BindError(f"unknown function {e.name}")
 
         if isinstance(e, ast.Substring):
-            raise BindError("substring not yet supported")
+            v = self._bind_impl(e.value, scope, agg)
+            start = self._bind_impl(e.start, scope, agg)
+            if not isinstance(start, Literal):
+                raise BindError("substring start must be a literal")
+            args = [v, start]
+            if e.length is not None:
+                ln = self._bind_impl(e.length, scope, agg)
+                if not isinstance(ln, Literal):
+                    raise BindError("substring length must be a literal")
+                args.append(ln)
+            return call("substr", *args)
 
         raise BindError(f"cannot bind {e!r}")
 

@@ -11,8 +11,8 @@ from presto_tpu.runner import QueryRunner
 from tests.oracle import assert_rows_match, load_oracle, run_oracle
 from tests.tpch_queries import QUERIES
 
-SUPPORTED = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 12, 13, 14, 15, 16, 17, 18, 19, 20]
-NOT_YET = [11, 21, 22]
+SUPPORTED = list(range(1, 23))
+NOT_YET = []
 
 
 @pytest.fixture(scope="module")
