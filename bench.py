@@ -1,0 +1,95 @@
+"""Benchmark entry point: TPC-H operator throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): the reference publishes no absolute numbers —
+its own harness (presto-benchmark BenchmarkSuite / HandTpchQuery1,
+HandTpchQuery6) measures rows/s of the operator pipeline over TPC-H
+data already in memory.  We mirror that: TPC-H tables are pre-loaded
+into the HBM-resident memory connector (no host generation inside the
+timed region), then Q1 (hash aggregation), Q6 (scan+filter+project)
+and Q3 (hash join + grouped agg) run end-to-end through the SQL engine.
+
+value  = geometric mean over queries of (lineitem rows / wall seconds)
+vs_baseline = value / 1e7 — 1e7 rows/s stands in for presto-main's
+single-worker CPU operator throughput on HandTpchQuery1-class pipelines
+(the reference harness measured on typical server CPUs; no published
+number exists to import, see BASELINE.md).
+
+Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 3).
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+
+    import presto_tpu  # noqa: F401  (enables x64)
+    import jax
+
+    log(f"devices: {jax.devices()}")
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.runner import QueryRunner
+
+    t0 = time.time()
+    tpch = Tpch(sf=sf, split_rows=1 << 20)
+    mem = MemoryConnector()
+    mem.load_from(
+        tpch, "lineitem",
+        columns=[
+            "l_orderkey", "l_quantity", "l_extendedprice", "l_discount",
+            "l_tax", "l_returnflag", "l_linestatus", "l_shipdate",
+        ],
+    )
+    mem.load_from(tpch, "orders", columns=["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"])
+    mem.load_from(tpch, "customer", columns=["c_custkey", "c_mktsegment"])
+    lineitem_rows = mem.row_count("lineitem")
+    log(f"loaded sf={sf}: lineitem={lineitem_rows} rows in {time.time()-t0:.1f}s")
+
+    catalog = Catalog()
+    catalog.register("mem", mem)
+    runner = QueryRunner(catalog)
+
+    from tests.tpch_queries import QUERIES  # the shared corpus
+
+    bench_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3]}
+
+    rates = {}
+    for name, sql in bench_queries.items():
+        t0 = time.time()
+        res = runner.execute(sql)  # warmup: compile + execute
+        log(f"{name}: warmup {time.time()-t0:.2f}s, {len(res)} rows")
+        times = []
+        for _ in range(iters):
+            t0 = time.time()
+            runner.execute(sql)
+            times.append(time.time() - t0)
+        best = min(times)
+        rates[name] = lineitem_rows / best
+        log(f"{name}: best {best:.3f}s -> {rates[name]:.3e} lineitem rows/s")
+
+    value = math.exp(sum(math.log(r) for r in rates.values()) / len(rates))
+    baseline_cpu_rows_per_sec = 1.0e7
+    print(json.dumps({
+        "metric": "tpch_sf%g_q1_q6_q3_lineitem_rows_per_sec_geomean" % sf,
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(value / baseline_cpu_rows_per_sec, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,14 @@ Layer map (mirrors reference layers; see SURVEY.md §1):
 
 __version__ = "0.1.0"
 
+# SQL semantics demand 64-bit: BIGINT is int64, exact DECIMAL sums
+# accumulate in int64 (spi/type/BigintType.java; DOUBLE is IEEE 754
+# 64-bit).  jax defaults to 32-bit — opt the process into x64 before
+# any array is created.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
 from presto_tpu.types import (  # noqa: F401
     BIGINT,
     BOOLEAN,

@@ -1,0 +1,95 @@
+"""In-memory (HBM-resident) table connector.
+
+Reference analog: ``presto-memory`` (worker-RAM tables,
+``presto-memory/src/main/java/com/facebook/presto/plugin/memory/``).
+Tables are lists of device-resident Pages; loading from another
+connector is the CTAS path.  Used by benchmarks to measure pure device
+execution without per-run host data generation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.page import Page
+from presto_tpu.types import Type
+
+
+class MemoryConnector:
+    def __init__(self):
+        self._tables: Dict[str, List[Page]] = {}
+        self._schemas: Dict[str, List[Tuple[str, Type]]] = {}
+        self._domains: Dict[str, Dict[str, Optional[Tuple[int, int]]]] = {}
+        self._pks: Dict[str, Optional[List[str]]] = {}
+        self._dicts: Dict[str, Dict[str, object]] = {}
+
+    # -- loading ------------------------------------------------------------
+    def create_table(
+        self,
+        name: str,
+        schema: Sequence[Tuple[str, Type]],
+        pages: Sequence[Page],
+        domains: Optional[Dict[str, Tuple[int, int]]] = None,
+        primary_key: Optional[List[str]] = None,
+    ) -> None:
+        self._tables[name] = list(pages)
+        self._schemas[name] = list(schema)
+        self._domains[name] = dict(domains or {})
+        self._pks[name] = primary_key
+        self._dicts[name] = {}
+        for page in pages[:1]:
+            for (col, t), b in zip(schema, page.blocks):
+                if t.is_string:
+                    self._dicts[name][col] = b.dictionary
+
+    def load_from(self, conn, table: str, name: Optional[str] = None,
+                  columns: Optional[List[str]] = None) -> None:
+        """Copy a table from another connector onto the device (CTAS).
+        ``columns`` prunes to the listed columns."""
+        name = name or table
+        schema = conn.schema(table)
+        keep = [i for i, (c, _) in enumerate(schema)
+                if columns is None or c in columns]
+        pages = []
+        for s in range(conn.num_splits(table)):
+            p = conn.page_for_split(table, s)
+            pages.append(Page(tuple(p.blocks[i] for i in keep), p.row_mask))
+        pruned_schema = [schema[i] for i in keep]
+        domains = {}
+        if hasattr(conn, "column_domain"):
+            for c, _ in pruned_schema:
+                domains[c] = conn.column_domain(table, c)
+        pk = conn.primary_key(table) if hasattr(conn, "primary_key") else None
+        if pk is not None and any(c not in [n for n, _ in pruned_schema] for c in pk):
+            pk = None
+        self.create_table(name, pruned_schema, pages, domains, pk)
+
+    # -- connector protocol -------------------------------------------------
+    def table_names(self) -> List[str]:
+        return list(self._tables.keys())
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return self._schemas[table]
+
+    def num_splits(self, table: str) -> int:
+        return len(self._tables[table])
+
+    def page_for_split(self, table: str, split: int, capacity: Optional[int] = None) -> Page:
+        return self._tables[table][split]
+
+    def row_count(self, table: str) -> int:
+        import numpy as np
+
+        return sum(int(np.asarray(p.num_rows())) for p in self._tables[table])
+
+    def column_domain(self, table: str, column: str) -> Optional[Tuple[int, int]]:
+        return self._domains.get(table, {}).get(column)
+
+    def primary_key(self, table: str) -> Optional[List[str]]:
+        return self._pks.get(table)
+
+    def dictionary_for(self, table: str, column: str):
+        return self._dicts.get(table, {}).get(column)
+
+    def max_split_rows(self, table: str) -> int:
+        return max(p.capacity for p in self._tables[table])

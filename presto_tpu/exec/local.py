@@ -170,7 +170,18 @@ class LocalRunner:
 
         if isinstance(node, SortNode):
             src = self._execute_to_page(node.source)
-            yield sort_page(src, node.sort_exprs, node.ascending, node.nulls_first)
+            fn = self._fold_cache.get(node)
+            if fn is None:
+                sort_exprs = list(node.sort_exprs)
+                ascending = list(node.ascending)
+                nulls_first = node.nulls_first
+
+                def do_sort(p):
+                    return sort_page(p, sort_exprs, ascending, nulls_first)
+
+                fn = jax.jit(do_sort) if self.jit else do_sort
+                self._fold_cache[node] = fn
+            yield fn(src)
             return
 
         if isinstance(node, TopNNode):
@@ -311,13 +322,26 @@ class LocalRunner:
 
     def _materialize_build(self, node):
         if node not in self._builds:
-            build_page = self._execute_to_page(node.right)
             if isinstance(node, CrossSingleNode):
+                build_page = self._execute_to_page(node.right)
                 self._builds[node] = slice_page(build_page.compact_host(), 1)
             else:
-                self._builds[node] = build_join(
-                    build_page, node.right_keys, key_domains=node.key_domains
-                )
+                pages = tuple(self._pages(node.right))
+                if not pages:
+                    pages = (Page.empty(node.right.output_types, 1),)
+                fn = self._fold_cache.get(node)
+                if fn is None:
+                    right_keys = list(node.right_keys)
+                    kd = node.key_domains
+
+                    def make_build(ps):
+                        return build_join(
+                            concat_pages_device(list(ps)), right_keys, key_domains=kd
+                        )
+
+                    fn = jax.jit(make_build) if self.jit else make_build
+                    self._fold_cache[node] = fn
+                self._builds[node] = fn(pages)
         return self._builds[node]
 
     # ------------------------------------------------------------------
@@ -374,7 +398,7 @@ class LocalRunner:
 
         acc: Optional[Page] = None
         for p in self._pages(node.source):
-            acc = fold(acc, p) if acc is None else fold_fn(acc, p)
+            acc = fold_fn(acc, p)
         if acc is None:
             return Page.empty(node.output_types, max(n, 1))
         return acc
@@ -432,17 +456,21 @@ class LocalRunner:
             cand = p if acc is None else concat_pages_device([acc, p])
             return merge_aggregate(cand, num_keys, aggs, mg, key_domains=kd, mode="partial")
 
-        fold_fn = self._fold_cache.get(node)
+        def final(acc: Page) -> Page:
+            return merge_aggregate(acc, num_keys, aggs, mg, key_domains=kd, mode="single")
+
+        fold_fn, final_fn = self._fold_cache.get(node, (None, None))
         if fold_fn is None:
             fold_fn = jax.jit(fold) if self.jit else fold
-            self._fold_cache[node] = fold_fn
+            final_fn = jax.jit(final) if self.jit else final
+            self._fold_cache[node] = (fold_fn, final_fn)
 
         acc: Optional[Page] = None
         for p in self._pages(source):
-            acc = fold(acc, p) if acc is None else fold_fn(acc, p)
+            acc = fold_fn(acc, p)
         if acc is None:
             return Page.empty(node.output_types, max(mg, 1))
-        out = merge_aggregate(acc, num_keys, aggs, mg, key_domains=kd, mode="single")
+        out = final_fn(acc)
         self._check_overflow(node, out, mg)
         return out
 

@@ -20,9 +20,18 @@ class QueryRunner:
         self.catalog = catalog
         self.binder = Binder(catalog)
         self.executor = LocalRunner(catalog, jit=jit)
+        # plan cache: repeated executions of the same SQL reuse the same
+        # plan-node identities, so the executor's compiled-chain caches
+        # hit and nothing retraces (ExpressionCompiler's cache role,
+        # sql/gen/ExpressionCompiler.java:53 cache field)
+        self._plans = {}
 
     def plan(self, sql: str):
-        return self.binder.plan(sql)
+        plan = self._plans.get(sql)
+        if plan is None:
+            plan = self.binder.plan(sql)
+            self._plans[sql] = plan
+        return plan
 
     def execute(self, sql: str) -> MaterializedResult:
         return self.executor.run(self.plan(sql))
