@@ -216,10 +216,15 @@ def _key_codes(datas, valids, domains):
 
 
 def pack_or_hash_keys(datas, valids, domains) -> Tuple[jax.Array, bool]:
-    """Combine key columns into one int64. Exact packing when domains
-    fit 63 bits (always true for TPC-H keys); else 64-bit mix (collision
-    odds ~ n^2/2^65 — the planner can demand exactness by supplying
-    domains)."""
+    """Combine key columns into one integer key. Exact packing when
+    domains fit 63 bits (always true for TPC-H keys); else 64-bit mix
+    (collision odds ~ n^2/2^65 — the planner can demand exactness by
+    supplying domains).
+
+    TPU dtype note: packed keys narrow to int32 when the domain product
+    fits 31 bits — int64 is emulated on TPU (v5e has no native 64-bit
+    lanes), so narrow keys make the downstream sorts/searches/scatters
+    run at native width."""
     if not datas:
         return None, True
     if domains is not None and all(d is not None for d in domains):
@@ -231,6 +236,8 @@ def pack_or_hash_keys(datas, valids, domains) -> Tuple[jax.Array, bool]:
             key = jnp.zeros_like(codes[0])
             for code, card in zip(codes, cards):
                 key = key * card + code
+            if prod < (1 << 31):
+                key = key.astype(jnp.int32)
             return key, True
     h = jnp.zeros(datas[0].shape, dtype=jnp.uint64)
     for d, v in zip(datas, valids):
@@ -245,10 +252,11 @@ def _sorted_group_ids(key: jax.Array, live: jax.Array, max_groups: int):
     """Shared sort-path grouping: returns per-row group ids (dead rows
     -> max_groups), the live group count, and a representative row per
     group (first sorted occurrence)."""
-    key_live = jnp.where(live, key, _I64_MAX)
+    sentinel = jnp.iinfo(key.dtype).max
+    key_live = jnp.where(live, key, sentinel)
     order = jnp.argsort(key_live)
     sk = key_live[order]
-    is_live_sorted = sk != _I64_MAX
+    is_live_sorted = sk != sentinel
     first = jnp.concatenate([jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]]) & is_live_sorted
     gid_sorted = jnp.cumsum(first.astype(jnp.int32)) - 1
     gid_sorted = jnp.where(is_live_sorted, jnp.minimum(gid_sorted, max_groups), max_groups)

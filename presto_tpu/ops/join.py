@@ -71,7 +71,7 @@ def build_join(
     all_valid = page.row_mask
     for v in valids:
         all_valid = all_valid & v
-    key = jnp.where(all_valid, key, _I64_MAX)
+    key = jnp.where(all_valid, key, jnp.iinfo(key.dtype).max)
     order = jnp.argsort(key)
     return JoinBuild(key[order], order.astype(jnp.int32), page)
 
@@ -85,7 +85,8 @@ def _probe_keys(page: Page, key_exprs: Sequence[Expr], key_domains):
     ok = page.row_mask
     for v in valids:
         ok = ok & v
-    return jnp.where(ok, key, _I64_MAX - 1), ok  # distinct sentinel: never matches build
+    # distinct sentinel from the build's (max): never matches build keys
+    return jnp.where(ok, key, jnp.iinfo(key.dtype).max - 1), ok
 
 
 def probe_join(

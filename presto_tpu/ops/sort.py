@@ -91,6 +91,8 @@ def topn_page(
 
 
 def limit_page(page: Page, n: int) -> Page:
-    """First n live rows in current order (LimitOperator analog)."""
-    seen = jnp.cumsum(page.row_mask.astype(jnp.int64))
+    """First n live rows in current order (LimitOperator analog).
+    int32 running count: int64 scans are emulated (and observed
+    pathological) on TPU."""
+    seen = jnp.cumsum(page.row_mask.astype(jnp.int32))
     return Page(page.blocks, page.row_mask & (seen <= n))
