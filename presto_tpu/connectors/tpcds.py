@@ -1,0 +1,372 @@
+"""Deterministic TPC-DS generator connector (star-schema subset).
+
+Reference analog: ``presto-tpcds`` (teradata tpcds-backed generator,
+`presto-tpcds/src/main/java/com/facebook/presto/tpcds/`).  From-scratch
+counter-hash generation in the same style as connectors/tpch.py:
+every value is a pure function of (table, column, row index), so splits
+generate independently on any worker.  Distributions follow the TPC-DS
+spec's shapes (fact rows scale with sf, dimensions fixed or sublinear;
+customer_demographics is the spec's exact 1,920,800-row demographic
+cross product) — byte-parity with the official dsdgen is a non-goal
+since correctness is oracle-checked on the same generated data.
+
+Covers the star-join benchmark queries (Q3/Q7/Q42/Q52/Q55 class):
+store_sales fact + date_dim/item/customer_demographics/promotion/store
+dimensions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.connectors.tpch import PatternDictionary, _hash_u64, _uniform_int
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import BIGINT, DATE, INTEGER, VARCHAR, DecimalType, Type
+
+_MONEY = DecimalType(12, 2)
+
+# date_dim: 1900-01-01 .. 2100-01-01, sk = julian-style offset
+DATE_DIM_ROWS = 73049
+D_SK0 = 2415022  # spec's first d_date_sk
+_EPOCH_OFF = (np.datetime64("1970-01-01") - np.datetime64("1900-01-01")).astype(int)
+
+# sales window: 1998-01-01 (+5 years)
+_SALES_START = int((np.datetime64("1998-01-01") - np.datetime64("1900-01-01")).astype(int))
+_SALES_DAYS = 1826
+
+GENDERS = ["M", "F"]
+MARITAL = ["M", "S", "D", "W", "U"]
+EDUCATION = [
+    "Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+    "Advanced Degree", "Unknown",
+]
+CREDIT = ["Low Risk", "High Risk", "Good", "Unknown"]
+CATEGORIES = [
+    "Books", "Children", "Electronics", "Home", "Jewelry",
+    "Men", "Music", "Shoes", "Sports", "Women",
+]
+YN = ["N", "Y"]
+
+CD_ROWS = 2 * 5 * 7 * 20 * 4 * 7 * 7 * 7  # 1,920,800 (spec cross product)
+
+
+def _seed(t: str, c: str) -> int:
+    h = 1469598103934665603
+    for ch in f"tpcds.{t}.{c}":
+        h = ((h ^ ord(ch)) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
+    "date_dim": [
+        ("d_date_sk", BIGINT), ("d_date", DATE), ("d_year", BIGINT),
+        ("d_moy", BIGINT), ("d_dom", BIGINT), ("d_qoy", BIGINT),
+        ("d_day_name", VARCHAR), ("d_month_seq", BIGINT),
+    ],
+    "item": [
+        ("i_item_sk", BIGINT), ("i_item_id", VARCHAR), ("i_item_desc", VARCHAR),
+        ("i_brand_id", BIGINT), ("i_brand", VARCHAR),
+        ("i_class_id", BIGINT), ("i_class", VARCHAR),
+        ("i_category_id", BIGINT), ("i_category", VARCHAR),
+        ("i_manufact_id", BIGINT), ("i_manager_id", BIGINT),
+        ("i_current_price", _MONEY),
+    ],
+    "customer_demographics": [
+        ("cd_demo_sk", BIGINT), ("cd_gender", VARCHAR),
+        ("cd_marital_status", VARCHAR), ("cd_education_status", VARCHAR),
+        ("cd_purchase_estimate", BIGINT), ("cd_credit_rating", VARCHAR),
+        ("cd_dep_count", BIGINT), ("cd_dep_employed_count", BIGINT),
+        ("cd_dep_college_count", BIGINT),
+    ],
+    "promotion": [
+        ("p_promo_sk", BIGINT), ("p_promo_id", VARCHAR),
+        ("p_channel_dmail", VARCHAR), ("p_channel_email", VARCHAR),
+        ("p_channel_event", VARCHAR), ("p_channel_tv", VARCHAR),
+    ],
+    "store": [
+        ("s_store_sk", BIGINT), ("s_store_id", VARCHAR),
+        ("s_store_name", VARCHAR), ("s_number_employees", BIGINT),
+        ("s_state", VARCHAR),
+    ],
+    "store_sales": [
+        ("ss_sold_date_sk", BIGINT), ("ss_item_sk", BIGINT),
+        ("ss_customer_sk", BIGINT), ("ss_cdemo_sk", BIGINT),
+        ("ss_store_sk", BIGINT), ("ss_promo_sk", BIGINT),
+        ("ss_ticket_number", BIGINT), ("ss_quantity", BIGINT),
+        ("ss_wholesale_cost", _MONEY), ("ss_list_price", _MONEY),
+        ("ss_sales_price", _MONEY), ("ss_ext_discount_amt", _MONEY),
+        ("ss_ext_sales_price", _MONEY), ("ss_ext_list_price", _MONEY),
+        ("ss_coupon_amt", _MONEY), ("ss_net_paid", _MONEY),
+        ("ss_net_profit", _MONEY),
+    ],
+}
+
+STATES = ["TN", "CA", "TX", "OH", "GA", "NY", "WA", "IL", "MI", "FL"]
+
+
+class Tpcds:
+    def __init__(self, sf: float = 1.0, split_rows: int = 1 << 20,
+                 cd_rows: Optional[int] = None):
+        self.sf = float(sf)
+        self.split_rows = int(split_rows)
+        # test harnesses may truncate the demographic cross product
+        self.cd_rows = int(cd_rows) if cd_rows is not None else CD_ROWS
+        self.n_store_sales = max(int(round(2_880_000 * self.sf)), 1)
+        self.n_items = 18000
+        self.n_customers = max(int(round(100_000 * self.sf)), 1)
+        self.n_promos = 300
+        self.n_stores = max(int(round(12 * max(self.sf, 1.0))), 1)
+        self._dicts: Dict[str, Dictionary] = {}
+
+    # -- metadata -----------------------------------------------------------
+    def table_names(self) -> List[str]:
+        return list(SCHEMAS.keys())
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return SCHEMAS[table]
+
+    def row_count(self, table: str) -> int:
+        return {
+            "date_dim": DATE_DIM_ROWS,
+            "item": self.n_items,
+            "customer_demographics": self.cd_rows,
+            "promotion": self.n_promos,
+            "store": self.n_stores,
+            "store_sales": self.n_store_sales,
+        }[table]
+
+    def num_splits(self, table: str) -> int:
+        return max(1, -(-self.row_count(table) // self.split_rows))
+
+    def max_split_rows(self, table: str) -> int:
+        return min(self.split_rows, max(self.row_count(table), 1))
+
+    def primary_key(self, table: str) -> Optional[List[str]]:
+        return {
+            "date_dim": ["d_date_sk"],
+            "item": ["i_item_sk"],
+            "customer_demographics": ["cd_demo_sk"],
+            "promotion": ["p_promo_sk"],
+            "store": ["s_store_sk"],
+            "store_sales": None,
+        }[table]
+
+    def column_domain(self, table: str, column: str) -> Optional[Tuple[int, int]]:
+        t = dict(SCHEMAS[table])[column]
+        if t.is_string:
+            return (0, len(self.dictionary_for(table, column)) - 1)
+        doms: Dict[str, Tuple[int, int]] = {
+            "d_date_sk": (D_SK0, D_SK0 + DATE_DIM_ROWS - 1),
+            "d_year": (1900, 2100),
+            "d_moy": (1, 12),
+            "d_dom": (1, 31),
+            "d_qoy": (1, 4),
+            "i_item_sk": (1, self.n_items),
+            "i_brand_id": (1, 1000),
+            "i_class_id": (1, 100),
+            "i_category_id": (1, 10),
+            "i_manufact_id": (1, 1000),
+            "i_manager_id": (1, 100),
+            "cd_demo_sk": (1, self.cd_rows),
+            "p_promo_sk": (1, self.n_promos),
+            "s_store_sk": (1, self.n_stores),
+            "ss_sold_date_sk": (D_SK0 + _SALES_START, D_SK0 + _SALES_START + _SALES_DAYS - 1),
+            "ss_item_sk": (1, self.n_items),
+            "ss_customer_sk": (1, self.n_customers),
+            "ss_cdemo_sk": (1, self.cd_rows),
+            "ss_store_sk": (1, self.n_stores),
+            "ss_promo_sk": (0, self.n_promos),
+            "ss_quantity": (1, 100),
+        }
+        return doms.get(column)
+
+    # -- dictionaries -------------------------------------------------------
+    def dictionary_for(self, table: str, column: str) -> Optional[Dictionary]:
+        t = dict(SCHEMAS[table])[column]
+        if not t.is_string:
+            return None
+        if column in self._dicts:
+            return self._dicts[column]
+        d: Dictionary
+        if column == "d_day_name":
+            d = Dictionary(["Sunday", "Monday", "Tuesday", "Wednesday",
+                            "Thursday", "Friday", "Saturday"])
+        elif column == "i_item_id":
+            d = PatternDictionary(lambda i: f"AAAAAAAA{i + 1:08d}", self.n_items)
+        elif column == "i_item_desc":
+            d = PatternDictionary(lambda i: f"item description {i + 1}", 4096)
+        elif column == "i_brand":
+            d = PatternDictionary(lambda i: f"brand#{i + 1}", 1000)
+        elif column == "i_class":
+            d = PatternDictionary(lambda i: f"class#{i + 1}", 100)
+        elif column == "i_category":
+            d = Dictionary(CATEGORIES)
+        elif column == "cd_gender":
+            d = Dictionary(GENDERS)
+        elif column == "cd_marital_status":
+            d = Dictionary(MARITAL)
+        elif column == "cd_education_status":
+            d = Dictionary(EDUCATION)
+        elif column == "cd_credit_rating":
+            d = Dictionary(CREDIT)
+        elif column == "p_promo_id":
+            d = PatternDictionary(lambda i: f"promo#{i + 1:08d}", self.n_promos)
+        elif column in ("p_channel_dmail", "p_channel_email", "p_channel_event", "p_channel_tv"):
+            d = Dictionary(YN)
+        elif column == "s_store_id":
+            d = PatternDictionary(lambda i: f"store#{i + 1:08d}", self.n_stores)
+        elif column == "s_store_name":
+            d = Dictionary(["ought", "able", "pri", "ese", "anti", "cally", "ation", "eing"])
+        elif column == "s_state":
+            d = Dictionary(STATES)
+        else:
+            raise KeyError(column)
+        self._dicts[column] = d
+        return d
+
+    # -- generators ---------------------------------------------------------
+    def generate_split(self, table: str, split: int) -> Dict[str, np.ndarray]:
+        n = self.row_count(table)
+        lo = split * self.split_rows
+        hi = min(lo + self.split_rows, n)
+        idx = np.arange(lo, hi)
+        return getattr(self, f"_{table}")(idx)
+
+    def _date_dim(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        days = idx.astype("int64")  # days since 1900-01-01
+        dt = np.datetime64("1900-01-01") + days.astype("timedelta64[D]")
+        y = dt.astype("datetime64[Y]").astype(int) + 1970
+        month0 = dt.astype("datetime64[M]").astype(int)
+        moy = month0 % 12 + 1
+        dom = (dt - dt.astype("datetime64[M]")).astype(int) + 1
+        dow = (days + 1) % 7  # 1900-01-01 was a Monday; 0=Sunday
+        return {
+            "d_date_sk": days + D_SK0,
+            "d_date": (days - _EPOCH_OFF).astype(np.int32),
+            "d_year": y.astype(np.int64),
+            "d_moy": moy.astype(np.int64),
+            "d_dom": dom.astype(np.int64),
+            "d_qoy": ((moy - 1) // 3 + 1).astype(np.int64),
+            "d_day_name": dow.astype(np.int32),
+            "d_month_seq": (month0 + 840).astype(np.int64),
+        }
+
+    def _item(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        s = lambda c: _seed("item", c)
+        brand_id = _uniform_int(s("brand"), idx, 1, 1000)
+        class_id = _uniform_int(s("class"), idx, 1, 100)
+        return {
+            "i_item_sk": idx.astype(np.int64) + 1,
+            "i_item_id": idx.astype(np.int32),
+            "i_item_desc": (_hash_u64(s("desc"), idx) % 4096).astype(np.int32),
+            "i_brand_id": brand_id,
+            "i_brand": (brand_id - 1).astype(np.int32),
+            "i_class_id": class_id,
+            "i_class": (class_id - 1).astype(np.int32),
+            "i_category_id": (class_id - 1) % 10 + 1,
+            "i_category": ((class_id - 1) % 10).astype(np.int32),
+            "i_manufact_id": _uniform_int(s("manufact"), idx, 1, 1000),
+            "i_manager_id": _uniform_int(s("manager"), idx, 1, 100),
+            "i_current_price": _uniform_int(s("price"), idx, 100, 9999),
+        }
+
+    def _customer_demographics(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        # mixed-radix decode of the demographic cross product (spec
+        # enumerates all combinations exactly once)
+        x = idx.copy()
+        gender = x % 2; x //= 2
+        marital = x % 5; x //= 5
+        education = x % 7; x //= 7
+        purchase = x % 20; x //= 20
+        credit = x % 4; x //= 4
+        dep = x % 7; x //= 7
+        dep_emp = x % 7; x //= 7
+        dep_col = x % 7
+        return {
+            "cd_demo_sk": idx.astype(np.int64) + 1,
+            "cd_gender": gender.astype(np.int32),
+            "cd_marital_status": marital.astype(np.int32),
+            "cd_education_status": education.astype(np.int32),
+            "cd_purchase_estimate": (purchase + 1).astype(np.int64) * 500,
+            "cd_credit_rating": credit.astype(np.int32),
+            "cd_dep_count": dep.astype(np.int64),
+            "cd_dep_employed_count": dep_emp.astype(np.int64),
+            "cd_dep_college_count": dep_col.astype(np.int64),
+        }
+
+    def _promotion(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        s = lambda c: _seed("promotion", c)
+        chan = lambda c: (_hash_u64(s(c), idx) % 10 == 0).astype(np.int32)  # 10% 'Y'
+        return {
+            "p_promo_sk": idx.astype(np.int64) + 1,
+            "p_promo_id": idx.astype(np.int32),
+            "p_channel_dmail": chan("dmail"),
+            "p_channel_email": chan("email"),
+            "p_channel_event": chan("event"),
+            "p_channel_tv": chan("tv"),
+        }
+
+    def _store(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        s = lambda c: _seed("store", c)
+        return {
+            "s_store_sk": idx.astype(np.int64) + 1,
+            "s_store_id": idx.astype(np.int32),
+            "s_store_name": (idx % 8).astype(np.int32),
+            "s_number_employees": _uniform_int(s("emp"), idx, 200, 300),
+            "s_state": (_hash_u64(s("state"), idx) % len(STATES)).astype(np.int32),
+        }
+
+    def _store_sales(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        s = lambda c: _seed("store_sales", c)
+        date_sk = D_SK0 + _SALES_START + _uniform_int(s("date"), idx, 0, _SALES_DAYS - 1)
+        qty = _uniform_int(s("qty"), idx, 1, 100)
+        wholesale = _uniform_int(s("wholesale"), idx, 100, 8800)
+        markup = _uniform_int(s("markup"), idx, 100, 200)  # 1.00x-2.00x, scale 2
+        list_price = wholesale * markup // 100
+        discount = _uniform_int(s("discount"), idx, 0, 99)  # % of list
+        sales_price = list_price * (100 - discount) // 100
+        coupon_on = _hash_u64(s("coupon_on"), idx) % 5 == 0
+        coupon = np.where(coupon_on, sales_price * qty // 10, 0)
+        ext_sales = qty * sales_price
+        ext_list = qty * list_price
+        net_paid = ext_sales - coupon
+        # 20% of cdemo/promo fks are 0 = "null" (no matching dimension row)
+        promo = np.where(
+            _hash_u64(s("promo_null"), idx) % 5 == 0,
+            0,
+            _uniform_int(s("promo"), idx, 1, self.n_promos),
+        )
+        return {
+            "ss_sold_date_sk": date_sk,
+            "ss_item_sk": _uniform_int(s("item"), idx, 1, self.n_items),
+            "ss_customer_sk": _uniform_int(s("cust"), idx, 1, self.n_customers),
+            "ss_cdemo_sk": _uniform_int(s("cdemo"), idx, 1, self.cd_rows),
+            "ss_store_sk": _uniform_int(s("store"), idx, 1, self.n_stores),
+            "ss_promo_sk": promo,
+            "ss_ticket_number": idx.astype(np.int64) + 1,
+            "ss_quantity": qty,
+            "ss_wholesale_cost": wholesale,
+            "ss_list_price": list_price,
+            "ss_sales_price": sales_price,
+            "ss_ext_discount_amt": (ext_list - ext_sales),
+            "ss_ext_sales_price": ext_sales,
+            "ss_ext_list_price": ext_list,
+            "ss_coupon_amt": coupon,
+            "ss_net_paid": net_paid,
+            "ss_net_profit": net_paid - qty * wholesale,
+        }
+
+    # -- Page production ----------------------------------------------------
+    def page_for_split(self, table: str, split: int, capacity: Optional[int] = None) -> Page:
+        cols = self.generate_split(table, split)
+        schema = SCHEMAS[table]
+        arrays = [cols[name] for name, _ in schema]
+        types = [t for _, t in schema]
+        dicts = [self.dictionary_for(table, name) for name, _ in schema]
+        return Page.from_arrays(arrays, types, dictionaries=dicts, capacity=capacity)
+
+    def pages(self, table: str, capacity: Optional[int] = None) -> Iterator[Page]:
+        for i in range(self.num_splits(table)):
+            yield self.page_for_split(table, i, capacity=capacity)
