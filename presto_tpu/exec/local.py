@@ -49,6 +49,7 @@ from presto_tpu.planner.plan import (
     TableScanNode,
     TopNNode,
     ValuesNode,
+    WindowNode,
 )
 from presto_tpu.types import Type
 
@@ -202,6 +203,29 @@ class LocalRunner:
 
         if isinstance(node, PrecomputedNode):
             yield node.page
+            return
+
+        if isinstance(node, WindowNode):
+            src = self._execute_to_page(node.source)
+            fn = self._fold_cache.get(node)
+            if fn is None:
+                from presto_tpu.ops.window import window_page
+
+                partition_exprs = list(node.partition_exprs)
+                order_exprs = list(node.order_exprs)
+                ascending = list(node.ascending)
+                funcs = list(node.funcs)
+                pd = node.partition_domains
+
+                def do_window(p):
+                    return window_page(
+                        p, partition_exprs, order_exprs, ascending, funcs,
+                        partition_domains=pd,
+                    )
+
+                fn = jax.jit(do_window) if self.jit else do_window
+                self._fold_cache[node] = fn
+            yield fn(src)
             return
 
         if isinstance(node, JoinNode) and not _is_streaming_join(node):

@@ -292,6 +292,43 @@ class ValuesNode(PlanNode):
 
 
 @dataclasses.dataclass(eq=False)
+class WindowNode(PlanNode):
+    """Window functions over one (partition, order) spec
+    (WindowNode.java / WindowOperator analog); appends one channel per
+    function."""
+
+    source: PlanNode
+    partition_exprs: List[Expr]
+    order_exprs: List[Expr]
+    ascending: List[bool]
+    funcs: List[object]  # ops.window.WindowFunc
+    func_names: List[str]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def partition_domains(self):
+        from presto_tpu.expr.ir import ColumnRef
+
+        src = self.source.channels
+        out = []
+        for e in self.partition_exprs:
+            if isinstance(e, ColumnRef) and src[e.index].domain is not None:
+                out.append(src[e.index].domain)
+            else:
+                out.append(None)
+        return out
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.source.channels + [
+            Channel(n, f.type) for f, n in zip(self.funcs, self.func_names)
+        ]
+
+
+@dataclasses.dataclass(eq=False)
 class PrecomputedNode(PlanNode):
     """A materialized Page injected into a plan — how distributed stage
     results re-enter local post-processing (the role RemoteSourceNode /

@@ -142,6 +142,15 @@ class FuncCall(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowExpr(Node):
+    """fn(...) OVER (PARTITION BY ... ORDER BY ...)."""
+
+    func: "FuncCall"
+    partition_by: Tuple[Node, ...] = ()
+    order_by: Tuple["OrderItem", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class Exists(Node):
     query: "Query"
     negated: bool = False

@@ -57,6 +57,16 @@ AGG_FUNCTIONS = {"sum", "avg", "count", "min", "max"}
 # conjunct's inner/outer sides are separable after binding.
 _OUTER_BASE = 1 << 20
 
+# Window results bind as sentinel channel refs during select binding and
+# are patched to real appended-channel indexes once the aggregation's
+# channel count is final.
+_WIN_BASE = 1 << 24
+
+WINDOW_FUNCTIONS = {
+    "row_number", "rank", "dense_rank", "lead", "lag",
+    "first_value", "last_value",
+} | AGG_FUNCTIONS
+
 
 class BindError(Exception):
     pass
@@ -201,6 +211,10 @@ class Binder:
         # subquery conjuncts discovered while joining the current
         # query's FROM terms, applied after the join tree is built
         self._pending_subqueries: List[Tuple[ast.Node, Scope]] = []
+        # window expressions registered while binding the current
+        # query's select/order items: ast -> (slot, spec, WindowFunc)
+        self._windows: List[Tuple[ast.WindowExpr, object, List[Expr], List[Expr], List[bool]]] = []
+        self._win_slots: Dict[ast.WindowExpr, int] = {}
 
     # ==================================================================
     def plan(self, sql: str) -> OutputNode:
@@ -483,7 +497,16 @@ class Binder:
     # ==================================================================
     def _plan_query(self, q: ast.Query) -> Tuple[PlanNode, List[str]]:
         saved_pending = self._pending_subqueries
+        saved_windows, saved_slots = self._windows, self._win_slots
         self._pending_subqueries = []
+        self._windows, self._win_slots = [], {}
+        try:
+            return self._plan_query_inner(q, saved_pending)
+        finally:
+            self._pending_subqueries = saved_pending
+            self._windows, self._win_slots = saved_windows, saved_slots
+
+    def _plan_query_inner(self, q: ast.Query, saved_pending) -> Tuple[PlanNode, List[str]]:
         if q.from_:
             terms, conjuncts = self._flatten_from(q.from_)
             conjuncts = conjuncts + split_conjuncts(q.where)
@@ -536,6 +559,13 @@ class Binder:
             names = [n for _, n in items]
             order_irs = self._bind_order(order_items, items, out_irs, scope)
 
+        # windows sit above aggregation/having; patch sentinel refs to
+        # real appended channels
+        if self._windows:
+            node, win_map = self._attach_windows(node)
+            out_irs = [self._patch_windows(ir, win_map) for ir in out_irs]
+            order_irs = [self._patch_windows(ir, win_map) for ir in order_irs]
+
         node = ProjectNode(node, out_irs + [ir for ir in order_irs if ir not in out_irs],
                            names + [f"$order{i}" for i, ir in enumerate(order_irs) if ir not in out_irs])
         # order exprs as channel refs over the project output
@@ -583,6 +613,14 @@ class Binder:
         return "_col"
 
     def _contains_agg(self, e: ast.Node) -> bool:
+        if isinstance(e, ast.WindowExpr):
+            # a window function is NOT an aggregate query trigger —
+            # only aggregates nested inside its arguments are
+            return (
+                any(self._contains_agg(a) for a in e.func.args)
+                or any(self._contains_agg(p) for p in e.partition_by)
+                or any(self._contains_agg(o.expr) for o in e.order_by)
+            )
         if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCTIONS:
             return True
         for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else []:
@@ -957,7 +995,7 @@ class Binder:
             for i, g in enumerate(agg.group_asts):
                 if e == g:
                     return agg.key_ref(i)
-            if not isinstance(e, (ast.NumberLit, ast.StringLit, ast.DateLit, ast.NullLit, ast.IntervalLit)):
+            if not isinstance(e, (ast.NumberLit, ast.StringLit, ast.DateLit, ast.NullLit, ast.IntervalLit, ast.WindowExpr)):
                 try:
                     ir = self._bind_impl(e, scope, None)
                     for i, g in enumerate(agg.group_irs):
@@ -1027,6 +1065,9 @@ class Binder:
         if isinstance(e, ast.IsNull):
             v = self._bind_impl(e.value, scope, agg)
             return call("is_null" if not e.negated else "not_null", v)
+
+        if isinstance(e, ast.WindowExpr):
+            return self._register_window(e, scope, agg)
 
         if isinstance(e, ast.Case):
             return self._bind_case(e, scope, agg)
@@ -1107,6 +1148,89 @@ class Binder:
             else_ir = Literal(type=whens[0][1].type, value=None)
         args.append(else_ir)
         return call("case", *args)
+
+    def _register_window(self, e: ast.WindowExpr, scope: Scope, agg) -> ColumnRef:
+        from presto_tpu.ops.window import WindowFunc
+
+        if e in self._win_slots:
+            slot = self._win_slots[e]
+            return ColumnRef(type=self._windows[slot][1].type, index=_WIN_BASE + slot)
+
+        fc = e.func
+        name = fc.name
+        if name not in WINDOW_FUNCTIONS:
+            raise BindError(f"unknown window function {name}")
+        kind = name
+        arg = None
+        offset = 1
+        if name in ("row_number", "rank", "dense_rank"):
+            if fc.args:
+                raise BindError(f"{name} takes no arguments")
+        elif name == "count" and (fc.star or not fc.args):
+            kind = "count_star"
+        else:
+            if not fc.args:
+                raise BindError(f"{name} requires an argument")
+            arg = self._bind_impl(fc.args[0], scope, agg)
+            if name in ("lead", "lag") and len(fc.args) > 1:
+                off_ir = self._bind_impl(fc.args[1], scope, agg)
+                if not isinstance(off_ir, Literal):
+                    raise BindError("lead/lag offset must be a literal")
+                offset = int(off_ir.value)
+        wf = WindowFunc(kind=kind, arg=arg, offset=offset)
+        partition_irs = [self._bind_impl(p, scope, agg) for p in e.partition_by]
+        order_irs = [self._bind_impl(o.expr, scope, agg) for o in e.order_by]
+        ascending = [o.ascending for o in e.order_by]
+        slot = len(self._windows)
+        self._windows.append((e, wf, partition_irs, order_irs, ascending))
+        self._win_slots[e] = slot
+        return ColumnRef(type=wf.type, index=_WIN_BASE + slot)
+
+    def _attach_windows(self, node: PlanNode) -> Tuple[PlanNode, Dict[int, int]]:
+        """Build WindowNode(s) above ``node``, grouping registered
+        windows by identical (partition, order) spec; returns the node
+        and the sentinel-slot -> real-channel mapping."""
+        from presto_tpu.planner.plan import WindowNode
+
+        specs: List[Tuple[tuple, List[int]]] = []  # (spec key, slots)
+        for slot, (e, wf, p_irs, o_irs, asc) in enumerate(self._windows):
+            key = (tuple(p_irs), tuple(o_irs), tuple(asc))
+            for k, slots in specs:
+                if k == key:
+                    slots.append(slot)
+                    break
+            else:
+                specs.append((key, [slot]))
+        base = len(node.channels)
+        mapping: Dict[int, int] = {}
+        for key, slots in specs:
+            p_irs, o_irs, asc = key
+            funcs = [self._windows[s][1] for s in slots]
+            names = [f"$win{s}" for s in slots]
+            for j, s in enumerate(slots):
+                mapping[s] = base + j
+            node = WindowNode(
+                source=node,
+                partition_exprs=list(p_irs),
+                order_exprs=list(o_irs),
+                ascending=list(asc),
+                funcs=funcs,
+                func_names=names,
+            )
+            base += len(slots)
+        return node, mapping
+
+    def _patch_windows(self, e: Expr, mapping: Dict[int, int]) -> Expr:
+        if isinstance(e, ColumnRef):
+            if e.index >= _WIN_BASE:
+                return ColumnRef(type=e.type, index=mapping[e.index - _WIN_BASE], name=e.name)
+            return e
+        if isinstance(e, Call):
+            return Call(
+                type=e.type, fn=e.fn,
+                args=tuple(self._patch_windows(a, mapping) for a in e.args),
+            )
+        return e
 
     def _bind_agg_call(self, e: ast.FuncCall, scope: Scope, agg: AggCtx) -> ColumnRef:
         from presto_tpu.ops.aggregate import output_type

@@ -34,6 +34,7 @@ KEYWORDS = {
     "join", "inner", "left", "right", "outer", "cross", "on", "asc", "desc",
     "date", "interval", "year", "month", "day", "true", "false", "substring",
     "for", "nulls", "first", "last", "all", "any", "union",
+    "over", "partition",
 }
 
 
@@ -456,15 +457,33 @@ class Parser:
             if self.accept("("):  # function call
                 if self.accept("*"):
                     self.expect(")")
-                    return ast.FuncCall(name.lower(), (), star=True)
-                distinct = bool(self.accept("distinct"))
-                args: List[ast.Node] = []
-                if not self.peek(")"):
-                    args.append(self._expr())
-                    while self.accept(","):
+                    fc = ast.FuncCall(name.lower(), (), star=True)
+                else:
+                    distinct = bool(self.accept("distinct"))
+                    args: List[ast.Node] = []
+                    if not self.peek(")"):
                         args.append(self._expr())
-                self.expect(")")
-                return ast.FuncCall(name.lower(), tuple(args), distinct=distinct)
+                        while self.accept(","):
+                            args.append(self._expr())
+                    self.expect(")")
+                    fc = ast.FuncCall(name.lower(), tuple(args), distinct=distinct)
+                if self.accept("over"):
+                    self.expect("(")
+                    partition: List[ast.Node] = []
+                    if self.accept("partition"):
+                        self.expect("by")
+                        partition.append(self._expr())
+                        while self.accept(","):
+                            partition.append(self._expr())
+                    order: List[ast.OrderItem] = []
+                    if self.accept("order"):
+                        self.expect("by")
+                        order.append(self._order_item())
+                        while self.accept(","):
+                            order.append(self._order_item())
+                    self.expect(")")
+                    return ast.WindowExpr(fc, tuple(partition), tuple(order))
+                return fc
             parts = [name]
             while self.peek(".") :
                 self.i += 1

@@ -1,0 +1,85 @@
+"""Window function tests vs the sqlite oracle.
+
+Reference analog: the reference's window coverage
+(presto-main/src/test/.../operator/window/, TestWindowOperator,
+AbstractTestQueries window sections)."""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+
+from tests.oracle import assert_rows_match, load_oracle, run_oracle
+
+
+@pytest.fixture(scope="module")
+def env():
+    tpch = Tpch(sf=0.001, split_rows=4096)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    return QueryRunner(catalog), load_oracle(tpch)
+
+
+WINDOW_QUERIES = [
+    # ranking over partitions
+    """select c_custkey, c_nationkey,
+              row_number() over (partition by c_nationkey order by c_acctbal desc) as rn
+       from customer""",
+    """select o_orderkey, o_custkey,
+              rank() over (partition by o_custkey order by o_orderdate) as rnk,
+              dense_rank() over (partition by o_custkey order by o_orderdate) as drnk
+       from orders""",
+    # running aggregates (RANGE UNBOUNDED PRECEDING default frame)
+    """select o_orderkey, o_custkey,
+              sum(o_totalprice) over (partition by o_custkey order by o_orderdate) as running,
+              count(*) over (partition by o_custkey order by o_orderdate) as cnt
+       from orders""",
+    # whole-partition aggregates (no ORDER BY)
+    """select s_suppkey, s_nationkey,
+              sum(s_acctbal) over (partition by s_nationkey) as nation_total,
+              avg(s_acctbal) over (partition by s_nationkey) as nation_avg,
+              min(s_acctbal) over (partition by s_nationkey) as nation_min,
+              max(s_acctbal) over (partition by s_nationkey) as nation_max
+       from supplier""",
+    # lead/lag/first_value
+    """select o_orderkey, o_custkey,
+              lag(o_totalprice) over (partition by o_custkey order by o_orderdate, o_orderkey) as prev_price,
+              lead(o_totalprice) over (partition by o_custkey order by o_orderdate, o_orderkey) as next_price,
+              first_value(o_totalprice) over (partition by o_custkey order by o_orderdate, o_orderkey) as first_price
+       from orders""",
+    # window over aggregation output
+    """select c_nationkey, count(*) as cnt,
+              rank() over (order by count(*) desc) as rnk
+       from customer group by c_nationkey""",
+    # unpartitioned window
+    """select o_orderkey, row_number() over (order by o_totalprice desc, o_orderkey) as rn
+       from orders limit 10000""",
+]
+
+
+@pytest.mark.parametrize("i", range(len(WINDOW_QUERIES)))
+def test_window_query(env, i):
+    runner, oracle = env
+    sql = WINDOW_QUERIES[i]
+    expected = run_oracle(oracle, sql)
+    actual = runner.execute(sql).rows
+    assert_rows_match(actual, expected, ordered=False)
+
+
+def test_topn_per_group_pattern(env):
+    """The classic top-n-per-group derived-table pattern."""
+    runner, oracle = env
+    sql = """
+    select c_nationkey, c_custkey, c_acctbal
+    from (
+        select c_nationkey, c_custkey, c_acctbal,
+               row_number() over (partition by c_nationkey order by c_acctbal desc, c_custkey) as rn
+        from customer
+    ) as t
+    where rn <= 3
+    order by c_nationkey, rn
+    """
+    expected = run_oracle(oracle, sql)
+    actual = runner.execute(sql).rows
+    assert_rows_match(actual, expected, ordered=False)
