@@ -92,6 +92,31 @@ def slice_page(page: Page, n: int) -> Page:
     return Page(blocks, page.row_mask[:n])
 
 
+class QueryStats:
+    """Per-plan-node execution stats (QueryStats/OperatorStats analog).
+    Wall times are inclusive of upstream stages (chains are fused into
+    one XLA program; exclusive per-operator timing would require
+    breaking fusion)."""
+
+    def __init__(self):
+        self.by_node: Dict[PlanNode, Dict[str, float]] = {}
+
+    def record(self, node: PlanNode, wall: float, rows: int) -> None:
+        s = self.by_node.setdefault(node, {"invocations": 0, "rows": 0, "wall_s": 0.0})
+        s["invocations"] += 1
+        s["rows"] += rows
+        s["wall_s"] += wall
+
+    def annotation(self, node: PlanNode) -> str:
+        s = self.by_node.get(node)
+        if s is None:
+            return ""
+        return (
+            f"  [rows={s['rows']}, pages={s['invocations']}, "
+            f"wall={s['wall_s'] * 1e3:.1f}ms]"
+        )
+
+
 class GroupCapacityExceeded(Exception):
     """An aggregation saw more groups than its static capacity; the
     runner retries the query with a doubled max_groups (the analog of
@@ -117,6 +142,7 @@ class LocalRunner:
         self.catalog = catalog
         self.jit = jit
         self.split_capacity = split_capacity
+        self.stats: Optional[QueryStats] = None
         self._chain_cache: Dict[PlanNode, Callable] = {}
         self._fold_cache: Dict[PlanNode, Callable] = {}
         self._agg_overrides: Dict[PlanNode, int] = {}
@@ -146,6 +172,11 @@ class LocalRunner:
 
         return plan_tree_str(plan)
 
+    def explain_with_stats(self, plan: PlanNode, stats: "QueryStats") -> str:
+        from presto_tpu.planner.plan import plan_tree_str
+
+        return plan_tree_str(plan, stats=stats)
+
     # ------------------------------------------------------------------
     def _execute_to_page(self, node: PlanNode) -> Page:
         pages = list(self._pages(node))
@@ -154,7 +185,28 @@ class LocalRunner:
         return concat_pages_device(pages)
 
     def _pages(self, node: PlanNode) -> Iterator[Page]:
-        """Stream output pages of ``node`` (pull model, Driver analog)."""
+        """Stream output pages of ``node`` (pull model, Driver analog),
+        recording per-stage stats when enabled (OperatorContext /
+        OperatorStats analog, operator/OperatorStats.java:38 — times
+        here are inclusive of the stage's inputs since chains fuse)."""
+        if self.stats is None:
+            yield from self._pages_impl(node)
+            return
+        import time
+
+        gen = self._pages_impl(node)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                p = next(gen)
+            except StopIteration:
+                return
+            wall = time.perf_counter() - t0
+            rows = int(np.asarray(p.num_rows()))
+            self.stats.record(node, wall, rows)
+            yield p
+
+    def _pages_impl(self, node: PlanNode) -> Iterator[Page]:
         if isinstance(node, OutputNode):
             yield from self._pages(node.source)
             return

@@ -360,8 +360,9 @@ class OutputNode(PlanNode):
         return [Channel(n, c.type, c.dictionary, c.domain) for n, c in zip(self.names, src)]
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style rendering (planPrinter/PlanPrinter.java analog)."""
+def plan_tree_str(node: PlanNode, indent: int = 0, stats=None) -> str:
+    """EXPLAIN-style rendering (planPrinter/PlanPrinter.java analog);
+    pass the executor's QueryStats for EXPLAIN ANALYZE annotations."""
     pad = "  " * indent
     name = type(node).__name__.replace("Node", "")
     detail = ""
@@ -375,9 +376,12 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f" [{node.step}] keys={node.group_names} aggs={node.aggs!r}"
     elif isinstance(node, JoinNode):
         detail = f" [{node.kind}] {node.left_keys!r} = {node.right_keys!r}"
+    elif isinstance(node, WindowNode):
+        detail = f" partition={node.partition_exprs!r} funcs={[f.kind for f in node.funcs]}"
     elif isinstance(node, (LimitNode, TopNNode)):
         detail = f" {node.count}"
-    out = f"{pad}- {name}{detail}\n"
+    ann = stats.annotation(node) if stats is not None else ""
+    out = f"{pad}- {name}{detail}{ann}\n"
     for s in node.sources:
-        out += plan_tree_str(s, indent + 1)
+        out += plan_tree_str(s, indent + 1, stats)
     return out

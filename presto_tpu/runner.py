@@ -4,6 +4,8 @@ Reference analog: ``testing/LocalQueryRunner.java:207`` — the
 full-pipeline in-process harness (parse -> analyze -> plan -> execute)
 used by the reference's tests and benchmarks, and the model for the
 coordinator's query lifecycle (execution/SqlQueryExecution.java).
+Statement dispatch mirrors the coordinator's non-query statement
+handlers (EXPLAIN via QueryExplainer, SET SESSION, SHOW metadata).
 """
 
 from __future__ import annotations
@@ -11,21 +13,36 @@ from __future__ import annotations
 from typing import Optional
 
 from presto_tpu.catalog import Catalog
-from presto_tpu.exec.local import LocalRunner, MaterializedResult
+from presto_tpu.exec.local import LocalRunner, MaterializedResult, QueryStats
+from presto_tpu.session import Session
+from presto_tpu.sql import ast
 from presto_tpu.sql.binder import Binder
+from presto_tpu.sql.parser import parse_statement
+from presto_tpu.types import BIGINT, VARCHAR, Type
 
 
 class QueryRunner:
-    def __init__(self, catalog: Catalog, jit: bool = True):
+    def __init__(self, catalog: Catalog, session: Optional[Session] = None, jit: bool = True):
         self.catalog = catalog
+        self.session = session or Session()
         self.binder = Binder(catalog)
-        self.executor = LocalRunner(catalog, jit=jit)
+        self._jit_default = jit
+        self.executor = self._make_executor()
         # plan cache: repeated executions of the same SQL reuse the same
         # plan-node identities, so the executor's compiled-chain caches
         # hit and nothing retraces (ExpressionCompiler's cache role,
         # sql/gen/ExpressionCompiler.java:53 cache field)
         self._plans = {}
 
+    def _make_executor(self) -> LocalRunner:
+        cap = self.session.get("split_capacity") or None
+        return LocalRunner(
+            self.catalog,
+            jit=self._jit_default and self.session.get("jit"),
+            split_capacity=cap,
+        )
+
+    # ------------------------------------------------------------------
     def plan(self, sql: str):
         plan = self._plans.get(sql)
         if plan is None:
@@ -34,7 +51,61 @@ class QueryRunner:
         return plan
 
     def execute(self, sql: str) -> MaterializedResult:
-        return self.executor.run(self.plan(sql))
+        stmt = parse_statement(sql)
+
+        if isinstance(stmt, ast.Query):
+            return self.executor.run(self._plan_cached(sql, stmt))
+
+        if isinstance(stmt, ast.Explain):
+            plan = self.binder.plan_ast(stmt.query)
+            if stmt.analyze:
+                stats = QueryStats()
+                self.executor.stats = stats
+                try:
+                    self.executor.run(plan)
+                finally:
+                    self.executor.stats = None
+                text = self.executor.explain_with_stats(plan, stats)
+            else:
+                text = self.executor.explain(plan)
+            return MaterializedResult(["Query Plan"], [VARCHAR], [(text,)])
+
+        if isinstance(stmt, ast.SetSession):
+            self.session.set(stmt.name, stmt.value)
+            # executor knobs may have changed; rebuild (plans survive)
+            self.executor = self._make_executor()
+            return MaterializedResult(["result"], [VARCHAR], [("SET SESSION",)])
+
+        if isinstance(stmt, ast.ShowSession):
+            rows = [
+                (name, str(value), str(default), desc)
+                for name, value, default, desc in self.session.describe()
+            ]
+            return MaterializedResult(
+                ["name", "value", "default", "description"], [VARCHAR] * 4, rows
+            )
+
+        if isinstance(stmt, ast.ShowTables):
+            names = sorted(
+                t
+                for cname in self.catalog._connectors
+                for t in self.catalog.connector(cname).table_names()
+            )
+            return MaterializedResult(["table"], [VARCHAR], [(n,) for n in names])
+
+        if isinstance(stmt, ast.ShowColumns):
+            handle = self.catalog.resolve(stmt.table)
+            rows = [(c.name, repr(c.type)) for c in handle.columns]
+            return MaterializedResult(["column", "type"], [VARCHAR, VARCHAR], rows)
+
+        raise ValueError(f"unsupported statement {stmt!r}")
+
+    def _plan_cached(self, sql: str, q: ast.Query):
+        plan = self._plans.get(sql)
+        if plan is None:
+            plan = self.binder.plan_ast(q)
+            self._plans[sql] = plan
+        return plan
 
     def explain(self, sql: str) -> str:
         return self.executor.explain(self.plan(sql))

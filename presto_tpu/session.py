@@ -1,0 +1,88 @@
+"""Session + system properties.
+
+Reference analog: ``Session.java`` + ``SystemSessionProperties.java:50``
+(57 typed session properties, settable per query over the wire or via
+SET SESSION) and the ``@Config``-bound config beans
+(execution/TaskManagerConfig.java).  One typed registry serves both
+roles; connectors may register their own namespaced properties.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyMetadata:
+    name: str
+    description: str
+    default: Any
+    parse: Callable[[str], Any]
+
+
+def _bool(s: str) -> bool:
+    return s.strip().lower() in ("true", "1", "yes", "on")
+
+
+SYSTEM_PROPERTIES = [
+    PropertyMetadata(
+        "jit", "compile streaming chains with XLA (debugging escape hatch)",
+        True, _bool,
+    ),
+    PropertyMetadata(
+        "distributed", "execute over the device mesh when the plan shape allows",
+        False, _bool,
+    ),
+    PropertyMetadata(
+        "hash_partition_count",
+        "partitions for distributed exchanges (devices used of the mesh)",
+        0, int,  # 0 = all mesh devices
+    ),
+    PropertyMetadata(
+        "max_groups",
+        "default static group-by capacity before overflow retry",
+        1 << 16, int,
+    ),
+    PropertyMetadata(
+        "split_capacity",
+        "pad scan splits to this static row capacity (0 = natural size)",
+        0, int,
+    ),
+    PropertyMetadata(
+        "collect_stats",
+        "record per-stage rows/wall-time (EXPLAIN ANALYZE forces this)",
+        False, _bool,
+    ),
+]
+
+
+class Session:
+    """Per-query context: properties + (later) principal/tx/trace."""
+
+    def __init__(self, properties: Optional[Dict[str, Any]] = None, user: str = "presto"):
+        self._meta = {p.name: p for p in SYSTEM_PROPERTIES}
+        self.properties: Dict[str, Any] = {
+            p.name: p.default for p in SYSTEM_PROPERTIES
+        }
+        if properties:
+            for k, v in properties.items():
+                self.set(k, v)
+        self.user = user
+
+    def get(self, name: str) -> Any:
+        return self.properties[name]
+
+    def set(self, name: str, value) -> None:
+        meta = self._meta.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        if isinstance(value, str):
+            value = meta.parse(value)
+        self.properties[name] = value
+
+    def describe(self):
+        return [
+            (p.name, self.properties[p.name], p.default, p.description)
+            for p in SYSTEM_PROPERTIES
+        ]

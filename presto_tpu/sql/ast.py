@@ -204,6 +204,33 @@ class OrderItem(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Explain(Node):
+    query: "Query"
+    analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowColumns(Node):
+    table: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class Query(Node):
     select: Tuple[SelectItem, ...]
     distinct: bool = False

@@ -35,6 +35,7 @@ KEYWORDS = {
     "date", "interval", "year", "month", "day", "true", "false", "substring",
     "for", "nulls", "first", "last", "all", "any", "union",
     "over", "partition",
+    "explain", "analyze", "set", "session", "show", "tables", "columns",
 }
 
 
@@ -495,3 +496,39 @@ class Parser:
 
 def parse_query(sql: str) -> ast.Query:
     return Parser(sql).parse_query()
+
+
+def parse_statement(sql: str) -> ast.Node:
+    """Statement-level entry (SqlParser.createStatement analog):
+    SELECT | EXPLAIN [ANALYZE] | SET SESSION | SHOW TABLES/COLUMNS/SESSION."""
+    p = Parser(sql)
+    if p.accept("explain"):
+        analyze = bool(p.accept("analyze"))
+        q = p._query()
+        p.accept(";")
+        return ast.Explain(q, analyze)
+    if p.accept("set"):
+        p.expect("session")
+        name = p.ident()
+        p.expect("=")
+        t = p.tok
+        if t.kind in ("number", "string", "ident", "keyword"):
+            p.i += 1
+            value = t.value
+        else:
+            raise SyntaxError(f"bad SET SESSION value {t!r}")
+        p.accept(";")
+        return ast.SetSession(name, value)
+    if p.accept("show"):
+        if p.accept("tables"):
+            p.accept(";")
+            return ast.ShowTables()
+        if p.accept("session"):
+            p.accept(";")
+            return ast.ShowSession()
+        p.expect("columns")
+        p.expect("from")
+        table = p.ident()
+        p.accept(";")
+        return ast.ShowColumns(table)
+    return p.parse_query()

@@ -1,0 +1,59 @@
+"""Statement-level tests: EXPLAIN (ANALYZE), SET SESSION, SHOW.
+
+Reference analog: coordinator statement handling
+(sql/analyzer/QueryExplainer.java, SystemSessionProperties round trip,
+metadata SHOW queries)."""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    return QueryRunner(catalog)
+
+
+def test_explain(runner):
+    res = runner.execute("explain select count(*) from orders where o_orderdate > date '1995-01-01'")
+    text = res.rows[0][0]
+    assert "Aggregation" in text and "TableScan" in text and "Filter" in text
+
+
+def test_explain_analyze(runner):
+    res = runner.execute("explain analyze select o_orderpriority, count(*) from orders group by o_orderpriority")
+    text = res.rows[0][0]
+    assert "rows=" in text and "wall=" in text
+
+
+def test_set_session_and_show(runner):
+    res = runner.execute("show session")
+    names = [r[0] for r in res.rows]
+    assert "jit" in names and "distributed" in names
+    runner.execute("set session max_groups = 1024")
+    assert runner.session.get("max_groups") == 1024
+    with pytest.raises(KeyError):
+        runner.execute("set session bogus_prop = 1")
+
+
+def test_show_tables_and_columns(runner):
+    res = runner.execute("show tables")
+    tables = [r[0] for r in res.rows]
+    assert "lineitem" in tables and "orders" in tables
+    res = runner.execute("show columns from lineitem")
+    cols = dict(res.rows)
+    assert cols["l_orderkey"] == "bigint"
+    assert cols["l_quantity"].startswith("decimal")
+
+
+def test_jit_off_still_correct(runner):
+    runner.execute("set session jit = false")
+    try:
+        res = runner.execute("select count(*) from orders")
+        assert res.rows[0][0] == 1500
+    finally:
+        runner.execute("set session jit = true")
