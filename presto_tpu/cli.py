@@ -1,0 +1,97 @@
+"""Interactive SQL console.
+
+Reference analog: ``presto-cli`` (``cli/Console.java`` — jline REPL
+with aligned table output and \\-commands).  Runs either in-process
+(embedded QueryRunner over the TPC-H catalog) or against a coordinator
+via --server.
+
+Usage:
+  python -m presto_tpu.cli [--server URI] [--sf 0.01] [-e "SQL"]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def format_table(names, rows, max_rows: int = 200) -> str:
+    cols = [str(n) for n in names]
+    shown = rows[:max_rows]
+    cells = [[("NULL" if v is None else str(v)) for v in r] for r in shown]
+    widths = [
+        max(len(cols[i]), *(len(r[i]) for r in cells)) if cells else len(cols[i])
+        for i in range(len(cols))
+    ]
+    sep = "-+-".join("-" * w for w in widths)
+    out = [" | ".join(c.ljust(w) for c, w in zip(cols, widths)), sep]
+    for r in cells:
+        out.append(" | ".join(c.rjust(w) for c, w in zip(r, widths)))
+    if len(rows) > max_rows:
+        out.append(f"... ({len(rows) - max_rows} more rows)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="presto-tpu")
+    ap.add_argument("--server", help="coordinator URI (default: embedded engine)")
+    ap.add_argument("--sf", type=float, default=0.01, help="embedded TPC-H scale factor")
+    ap.add_argument("-e", "--execute", help="run one statement and exit")
+    args = ap.parse_args(argv)
+
+    if args.server:
+        from presto_tpu.client import StatementClient
+
+        client = StatementClient(args.server)
+
+        def run(sql):
+            columns, rows = client.execute(sql)
+            return [c["name"] for c in columns], rows
+    else:
+        from presto_tpu.catalog import Catalog
+        from presto_tpu.connectors.tpch import Tpch
+        from presto_tpu.runner import QueryRunner
+
+        catalog = Catalog()
+        catalog.register("tpch", Tpch(sf=args.sf))
+        runner = QueryRunner(catalog)
+
+        def run(sql):
+            res = runner.execute(sql)
+            return res.names, res.rows
+
+    def run_one(sql: str) -> int:
+        t0 = time.time()
+        try:
+            names, rows = run(sql)
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(format_table(names, rows))
+        print(f"({len(rows)} rows, {time.time() - t0:.2f}s)")
+        return 0
+
+    if args.execute:
+        return run_one(args.execute)
+
+    print(f"presto-tpu console ({'server ' + args.server if args.server else f'embedded tpch sf={args.sf}'})")
+    buf = ""
+    while True:
+        try:
+            line = input("... " if buf else "presto-tpu> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not buf and line.strip().lower() in ("quit", "exit", "\\q"):
+            return 0
+        buf = (buf + "\n" + line) if buf else line
+        if buf.strip().endswith(";") or line == "":
+            sql = buf.strip().rstrip(";")
+            buf = ""
+            if sql:
+                run_one(sql)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""REST protocol client.
+
+Reference analog: ``presto-client``'s ``StatementClientV1.java`` — POST
+the statement, then follow ``nextUri`` pages until exhausted.  Uses
+stdlib urllib (no external HTTP dependency).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Iterator, List, Optional, Tuple
+
+
+class StatementClient:
+    def __init__(self, server_uri: str):
+        self.server_uri = server_uri.rstrip("/")
+
+    def execute(self, sql: str) -> Tuple[List[dict], List[tuple]]:
+        """Run a statement; returns (columns, rows)."""
+        req = urllib.request.Request(
+            f"{self.server_uri}/v1/statement",
+            data=sql.encode(),
+            method="POST",
+            headers={"Content-Type": "text/plain"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            page = json.load(resp)
+        if page.get("error"):
+            raise RuntimeError(page["error"])
+        columns = page.get("columns", [])
+        rows = [tuple(r) for r in page.get("data", [])]
+        while page.get("nextUri"):
+            with urllib.request.urlopen(page["nextUri"]) as resp:
+                page = json.load(resp)
+            if page.get("error"):
+                raise RuntimeError(page["error"])
+            rows.extend(tuple(r) for r in page.get("data", []))
+        return columns, rows
+
+    def server_info(self) -> dict:
+        with urllib.request.urlopen(f"{self.server_uri}/v1/info") as resp:
+            return json.load(resp)
+
+    def queries(self) -> list:
+        with urllib.request.urlopen(f"{self.server_uri}/v1/query") as resp:
+            return json.load(resp)

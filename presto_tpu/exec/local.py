@@ -386,9 +386,9 @@ class LocalRunner:
     def _source_pages(self, node: PlanNode) -> Iterator[Page]:
         if isinstance(node, TableScanNode):
             conn = self.catalog.connector(node.handle.connector_name)
-            full = [ch.name for ch in node.handle.columns]
             idx = list(node.columns)
-            for split in range(node.handle.num_splits):
+            splits = node.splits if node.splits is not None else range(node.handle.num_splits)
+            for split in splits:
                 page = conn.page_for_split(
                     node.handle.table, split, capacity=self.split_capacity
                 )

@@ -1,0 +1,227 @@
+"""Multi-host distributed execution over HTTP workers (the DCN tier).
+
+Reference analog: the coordinator's distributed scheduling stack —
+``SqlQueryScheduler.java:441`` (stage scheduling), split placement
+(``scheduler/NodeScheduler.java``), ``HttpRemoteTask.java:99`` with
+``RequestErrorTracker``/``Backoff`` (transient RPC tolerance), and
+``failureDetector/HeartbeatFailureDetector.java:77`` (exclude dead
+nodes from scheduling).
+
+TPU framing: the ICI tier (parallel/dist.py) shards a query across the
+chips of one slice; THIS tier fans leaf fragments out across hosts
+(each host owning its own slice/chip) and merges partial aggregation
+states at the coordinator — i.e. the cross-slice exchange rides DCN as
+serialized partial-state pages, while intra-fragment work stays
+all-XLA on each host.  Unlike the reference (any task failure fails
+the query, SURVEY.md §2.2 recovery row), leaf fragments here are pure
+functions of (table, splits), so a failed worker's splits are
+re-scheduled on the survivors.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.exec.local import LocalRunner, MaterializedResult, concat_pages_device
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    FilterNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    PrecomputedNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    WindowNode,
+)
+from presto_tpu.server.serde import deserialize_page, plan_to_json
+from presto_tpu.server.worker import parse_task_response
+
+
+class MultiHostUnsupported(Exception):
+    pass
+
+
+class WorkerClient:
+    """One remote worker (HttpRemoteTask + Backoff analog)."""
+
+    def __init__(self, uri: str, max_attempts: int = 3, timeout: float = 300.0):
+        self.uri = uri.rstrip("/")
+        self.max_attempts = max_attempts
+        self.timeout = timeout
+        self.alive = True
+
+    def ping(self, timeout: float = 5.0) -> bool:
+        try:
+            with urllib.request.urlopen(f"{self.uri}/v1/info", timeout=timeout) as r:
+                json.load(r)
+            self.alive = True
+        except Exception:
+            self.alive = False
+        return self.alive
+
+    def run_fragment(self, fragment_json: dict) -> List[bytes]:
+        body = json.dumps({"fragment": fragment_json}).encode()
+        last: Optional[Exception] = None
+        for attempt in range(self.max_attempts):
+            try:
+                req = urllib.request.Request(
+                    f"{self.uri}/v1/task", data=body, method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return parse_task_response(resp.read())
+            except Exception as e:
+                last = e
+                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+        self.alive = False
+        raise ConnectionError(f"worker {self.uri} failed: {last}")
+
+
+class MultiHostRunner:
+    """Fans leaf-fragment execution out to HTTP workers.
+
+    Supported plan shape (same as DistributedRunner): post-agg nodes
+    over a single-step aggregation over a scan-rooted chain.  The
+    chain + partial aggregation run on workers over disjoint split
+    assignments; final merge + post-processing run at the coordinator.
+    """
+
+    def __init__(self, catalog: Catalog, worker_uris: Sequence[str]):
+        self.catalog = catalog
+        self.workers = [WorkerClient(u) for u in worker_uris]
+        self.local = LocalRunner(catalog)
+
+    def run(self, plan: PlanNode) -> MaterializedResult:
+        try:
+            return self._run_distributed(plan)
+        except MultiHostUnsupported:
+            return self.local.run(plan)
+
+    # ------------------------------------------------------------------
+    def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
+        path: List[PlanNode] = []
+        node = plan
+        while not isinstance(node, AggregationNode):
+            if isinstance(node, (OutputNode, ProjectNode, FilterNode, SortNode,
+                                 TopNNode, LimitNode, WindowNode)):
+                path.append(node)
+                node = node.source
+            else:
+                raise MultiHostUnsupported(type(node).__name__)
+        agg = node
+        if agg.step != "single":
+            raise MultiHostUnsupported("non-single aggregation")
+
+        scan = self._leaf_scan(agg.source)
+        partial = AggregationNode(
+            source=agg.source, group_exprs=agg.group_exprs,
+            group_names=agg.group_names, aggs=agg.aggs, agg_names=agg.agg_names,
+            step="partial", max_groups=agg.max_groups,
+        )
+
+        partial_pages = self._run_fragments(partial, scan)
+
+        final = AggregationNode(
+            source=PrecomputedNode(
+                page=concat_pages_device(partial_pages), channel_list=partial.channels
+            ),
+            group_exprs=[_key_ref(partial, i) for i in range(len(agg.group_exprs))],
+            group_names=agg.group_names, aggs=agg.aggs, agg_names=agg.agg_names,
+            step="final", max_groups=agg.max_groups,
+        )
+        merged = self.local._execute_to_page(final)
+
+        pre = PrecomputedNode(page=merged, channel_list=agg.channels)
+        if not path:
+            out = self.local.run(pre)
+            out.names, out.types = plan.output_names, plan.output_types
+            return out
+        parent = path[-1]
+        original = parent.source
+        try:
+            parent.source = pre
+            return self.local.run(plan)
+        finally:
+            parent.source = original
+
+    def _leaf_scan(self, node: PlanNode) -> TableScanNode:
+        n = self.local._chain_leaf(node)
+        if not isinstance(n, TableScanNode):
+            raise MultiHostUnsupported("chain leaf is not a table scan")
+        return n
+
+    # ------------------------------------------------------------------
+    def _run_fragments(self, partial: AggregationNode, scan: TableScanNode):
+        """Schedule split ranges across live workers; reassign a failed
+        worker's splits to survivors (elastic leaf recovery)."""
+        alive = [w for w in self.workers if w.ping()]
+        if not alive:
+            raise MultiHostUnsupported("no live workers")
+
+        n_splits = scan.handle.num_splits
+        assignments: Dict[WorkerClient, List[int]] = {w: [] for w in alive}
+        for s in range(n_splits):
+            assignments[alive[s % len(alive)]].append(s)
+
+        results: List[bytes] = []
+        lock = threading.Lock()
+        failed: List[tuple] = []
+
+        dictionaries = [c.dictionary for c in partial.channels]
+
+        def make_fragment(splits: List[int]) -> dict:
+            # serialize on the scheduling thread — the splits field is
+            # set transiently on the shared scan node
+            original = scan.splits
+            try:
+                scan.splits = splits
+                return plan_to_json(partial)
+            finally:
+                scan.splits = original
+
+        def run_on(w: WorkerClient, splits: List[int], fragment: dict):
+            try:
+                raws = w.run_fragment(fragment)
+                with lock:
+                    results.extend(raws)
+            except ConnectionError:
+                with lock:
+                    failed.append((w, splits))
+
+        def launch(pairs):
+            threads = [
+                threading.Thread(target=run_on, args=(w, s, make_fragment(s)))
+                for w, s in pairs if s
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        launch(assignments.items())
+
+        # failover: re-run dead workers' splits on survivors
+        while failed:
+            w_dead, splits = failed.pop()
+            survivors = [w for w in alive if w.alive]
+            if not survivors:
+                raise ConnectionError("all workers failed")
+            chunks = [splits[i :: len(survivors)] for i in range(len(survivors))]
+            launch(list(zip(survivors, chunks)))
+
+        return [deserialize_page(r, dictionaries) for r in results]
+
+
+def _key_ref(partial: AggregationNode, i: int):
+    from presto_tpu.expr.ir import ColumnRef
+
+    ch = partial.channels[i]
+    return ColumnRef(type=ch.type, index=i)

@@ -74,10 +74,13 @@ def _expr_channel(e: Expr, name: str, src: List[Channel]) -> Channel:
 @dataclasses.dataclass(eq=False)
 class TableScanNode(PlanNode):
     """Scan selected columns of a table (TableScanNode.java analog).
-    ``columns`` are indexes into the connector's full schema."""
+    ``columns`` are indexes into the connector's full schema;
+    ``splits`` optionally restricts to an assigned split subset (the
+    worker-side view of a split assignment, metadata/Split.java)."""
 
     handle: TableHandle
     columns: List[int]
+    splits: Optional[List[int]] = None
 
     @property
     def channels(self) -> List[Channel]:

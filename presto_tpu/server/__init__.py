@@ -1,0 +1,1 @@
+from presto_tpu.server.coordinator import CoordinatorServer  # noqa: F401

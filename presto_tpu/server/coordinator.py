@@ -1,0 +1,177 @@
+"""Coordinator REST server.
+
+Reference analog: the V1 statement protocol —
+``server/protocol/StatementResource.java:82`` (POST /v1/statement
+creates a query; results are paged via GET nextUri with token
+acknowledgement; DELETE cancels) plus the info/status resources
+(``server/ServerInfoResource``, ``QueryResource``).  stdlib
+http.server stands in for airlift/jetty; query execution runs on a
+worker thread per query with paged result buffers.
+
+Protocol (JSON):
+  POST /v1/statement            body = SQL
+  GET  /v1/statement/{id}/{tok} next page
+  DELETE /v1/statement/{id}     cancel
+  GET  /v1/info                 server info
+  GET  /v1/query                finished/running query summaries
+Responses carry: id, columns [{name, type}], data [[row...]...],
+stats {state, rows}, error?, nextUri?.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from presto_tpu import __version__
+from presto_tpu.runner import QueryRunner
+
+PAGE_ROWS = 1000
+
+
+class _QueryState:
+    def __init__(self, qid: str, sql: str):
+        self.id = qid
+        self.sql = sql
+        self.state = "QUEUED"  # QUEUED -> RUNNING -> FINISHED | FAILED | CANCELED
+        self.columns: List[dict] = []
+        self.rows: List[tuple] = []
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+
+    def summary(self) -> dict:
+        return {
+            "id": self.id,
+            "query": self.sql,
+            "state": self.state,
+            "rows": len(self.rows),
+        }
+
+
+class CoordinatorServer:
+    """Embeds a QueryRunner behind the REST protocol.  Queries run on
+    daemon threads (the coordinator's query-execution pool); the state
+    machine mirrors QueryState.java:21 (trimmed to the states a
+    single-process coordinator hits)."""
+
+    def __init__(self, runner: QueryRunner, host: str = "127.0.0.1", port: int = 0):
+        self.runner = runner
+        self.queries: Dict[str, _QueryState] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/v1/statement":
+                    self._json(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                sql = self.rfile.read(n).decode()
+                q = outer._submit(sql)
+                q.done.wait(timeout=600)
+                self._json(200, outer._page_response(q, 0))
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["v1", "info"]:
+                    self._json(200, {
+                        "nodeVersion": {"version": __version__},
+                        "coordinator": True,
+                        "state": "ACTIVE",
+                    })
+                    return
+                if parts == ["v1", "query"]:
+                    with outer._lock:
+                        self._json(200, [q.summary() for q in outer.queries.values()])
+                    return
+                if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
+                    qid, token = parts[2], int(parts[3])
+                    q = outer.queries.get(qid)
+                    if q is None:
+                        self._json(404, {"error": "unknown query"})
+                        return
+                    self._json(200, outer._page_response(q, token))
+                    return
+                self._json(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) >= 3 and parts[:2] == ["v1", "statement"]:
+                    q = outer.queries.get(parts[2])
+                    if q is not None and not q.done.is_set():
+                        q.state = "CANCELED"
+                        q.done.set()
+                    self._json(204, {})
+                    return
+                self._json(404, {"error": "not found"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # ------------------------------------------------------------------
+    def _submit(self, sql: str) -> _QueryState:
+        qid = uuid.uuid4().hex[:16]
+        q = _QueryState(qid, sql)
+        with self._lock:
+            self.queries[qid] = q
+
+        def run():
+            q.state = "RUNNING"
+            try:
+                res = self.runner.execute(sql)
+                q.columns = [
+                    {"name": n, "type": repr(t)} for n, t in zip(res.names, res.types)
+                ]
+                q.rows = res.rows
+                q.state = "FINISHED"
+            except Exception as e:  # surfaces to the client as error
+                q.error = f"{type(e).__name__}: {e}"
+                q.state = "FAILED"
+            finally:
+                q.done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return q
+
+    def _page_response(self, q: _QueryState, token: int) -> dict:
+        out = {
+            "id": q.id,
+            "columns": q.columns,
+            "stats": {"state": q.state, "rows": len(q.rows)},
+        }
+        if q.error:
+            out["error"] = q.error
+            return out
+        start = token * PAGE_ROWS
+        chunk = q.rows[start : start + PAGE_ROWS]
+        out["data"] = [list(r) for r in chunk]
+        if start + PAGE_ROWS < len(q.rows):
+            out["nextUri"] = f"{self.uri}/v1/statement/{q.id}/{token + 1}"
+        return out

@@ -1,0 +1,110 @@
+"""Worker task server.
+
+Reference analog: the worker side of the task protocol —
+``server/TaskResource.java:120`` (POST /v1/task/{taskId} with the
+serialized fragment + splits, results served from output buffers) and
+``execution/SqlTaskManager.java:339``.  Collapsed for the
+request/response model: a task executes its fragment synchronously and
+returns the serialized result pages in the response body (the pull
+buffer protocol is unnecessary when the coordinator is the only
+consumer and fragments end in bounded partial states).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from presto_tpu import __version__
+from presto_tpu.catalog import Catalog
+from presto_tpu.exec.local import LocalRunner
+from presto_tpu.server.serde import plan_from_json, serialize_page
+
+
+class WorkerServer:
+    """Executes plan fragments against the worker's own catalog.
+
+    POST /v1/task   body: {"fragment": <plan json>}
+                    response: concatenated serialized pages
+                    (4-byte count prefix, then length-prefixed pages)
+    GET  /v1/info   liveness + version (heartbeat endpoint)
+    """
+
+    def __init__(self, catalog: Catalog, host: str = "127.0.0.1", port: int = 0):
+        self.catalog = catalog
+        self.runner = LocalRunner(catalog)
+        self.tasks_executed = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/v1/info":
+                    body = json.dumps(
+                        {"nodeVersion": {"version": __version__},
+                         "coordinator": False, "state": "ACTIVE",
+                         "tasks": outer.tasks_executed}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):
+                if self.path != "/v1/task":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n).decode())
+                try:
+                    fragment = plan_from_json(req["fragment"], outer.catalog)
+                    pages = [serialize_page(p) for p in outer.runner._pages(fragment)]
+                    outer.tasks_executed += 1
+                    body = len(pages).to_bytes(4, "little") + b"".join(
+                        len(p).to_bytes(8, "little") + p for p in pages
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:
+                    body = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def parse_task_response(raw: bytes):
+    npages = int.from_bytes(raw[:4], "little")
+    off = 4
+    out = []
+    for _ in range(npages):
+        ln = int.from_bytes(raw[off : off + 8], "little")
+        off += 8
+        out.append(raw[off : off + ln])
+        off += ln
+    return out

@@ -1,0 +1,107 @@
+"""Multi-host distributed execution tests: coordinator + N worker HTTP
+servers in one process.
+
+Reference analog: ``DistributedQueryRunner.java:69`` (one coordinator +
+N TestingPrestoServers in one JVM on localhost ports, full protocol
+end-to-end) including worker-failure behavior — with the improvement
+that leaf fragments are rescheduled instead of failing the query."""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.parallel.multihost import MultiHostRunner
+from presto_tpu.runner import QueryRunner
+from presto_tpu.server.worker import WorkerServer
+
+from tests.tpch_queries import QUERIES
+
+
+def make_catalog():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.005, split_rows=2048))
+    return catalog
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    workers = [WorkerServer(make_catalog()) for _ in range(3)]
+    for w in workers:
+        w.start()
+    catalog = make_catalog()
+    local = QueryRunner(catalog)
+    multi = MultiHostRunner(catalog, [w.uri for w in workers])
+    yield local, multi, workers
+    for w in workers:
+        try:
+            w.stop()
+        except Exception:
+            pass
+
+
+def _key(row):
+    return tuple(round(v, 6) if isinstance(v, float) else v for v in row)
+
+
+def _check(local, multi, sql):
+    expected = local.executor.run(local.plan(sql)).rows
+    actual = multi.run(local.binder.plan(sql)).rows
+    assert len(actual) == len(expected)
+    for a, e in zip(sorted(actual, key=_key), sorted(expected, key=_key)):
+        for va, ve in zip(a, e):
+            if isinstance(va, float):
+                assert va == pytest.approx(ve, rel=1e-12), (a, e)
+            else:
+                assert va == ve, (a, e)
+
+
+def test_multihost_q1(cluster):
+    local, multi, _ = cluster
+    _check(local, multi, QUERIES[1])
+
+
+def test_multihost_q6(cluster):
+    local, multi, _ = cluster
+    _check(local, multi, QUERIES[6])
+
+
+def test_multihost_q3_joins(cluster):
+    local, multi, _ = cluster
+    _check(local, multi, QUERIES[3])
+
+
+def test_worker_failure_reschedules(cluster):
+    """Kill one worker: its splits must be re-run on survivors and the
+    result stay exact (beyond-reference: the reference fails the query
+    on task failure, SURVEY.md §2.2)."""
+    local, multi, workers = cluster
+    victim = workers[0]
+    victim.stop()
+    try:
+        _check(local, multi, QUERIES[6])
+        _check(local, multi, QUERIES[1])
+    finally:
+        pass  # victim stays down; other tests use ping-based liveness
+
+
+def test_task_serde_roundtrip():
+    """Fragment + page wire formats round-trip exactly."""
+    import numpy as np
+
+    from presto_tpu.server.serde import (
+        deserialize_page, plan_from_json, plan_to_json, serialize_page,
+    )
+
+    catalog = make_catalog()
+    runner = QueryRunner(catalog)
+    plan = runner.plan("select l_orderkey, l_quantity from lineitem where l_quantity < 10")
+    d = plan_to_json(plan)
+    plan2 = plan_from_json(d, catalog)
+    r1 = runner.executor.run(plan)
+    r2 = runner.executor.run(plan2)
+    assert sorted(r1.rows) == sorted(r2.rows)
+
+    page = next(runner.executor._pages(plan))
+    raw = serialize_page(page)
+    back = deserialize_page(raw)
+    assert int(np.asarray(back.num_rows())) == int(np.asarray(page.num_rows()))
