@@ -51,11 +51,24 @@ def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Op
             return None
         start = e.args[1].value
         length = e.args[2].value if len(e.args) > 2 else None
-        key = (id(inner), start, length)
+        key = (id(inner), "substr", start, length)
         if key not in _DERIVED_DICTS:
             end = None if length is None else start - 1 + length
             values = [v[start - 1 : end] for v in inner.values]
             _DERIVED_DICTS[key] = (inner, Dictionary(values))
+        return _DERIVED_DICTS[key][1]
+    if isinstance(e, Call) and e.fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
+        inner = expr_dictionary(e.args[0], dictionaries)
+        if inner is None:
+            return None
+        key = (id(inner), e.fn)
+        if key not in _DERIVED_DICTS:
+            f = {
+                "upper": str.upper, "lower": str.lower, "trim": str.strip,
+                "ltrim": str.lstrip, "rtrim": str.rstrip,
+                "reverse": lambda s: s[::-1],
+            }[e.fn]
+            _DERIVED_DICTS[key] = (inner, Dictionary([f(v) for v in inner.values]))
         return _DERIVED_DICTS[key][1]
     return None
 
@@ -219,12 +232,139 @@ class ExprCompiler:
                 return d.astype(jnp.int64), v
 
             return run_cast_bigint
-        if fn == "substr":
+        if fn in ("substr", "upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
             # dictionary codes pass through unchanged; the *values* are
             # transformed host-side once (see _dict_of) — the device
             # never touches bytes (DictionaryAwarePageProjection analog)
             return self.compile(expr.args[0])
+        if fn in ("length", "strpos"):
+            return self._compile_string_lut_fn(expr)
+        if fn in ("abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10",
+                  "power", "pow", "ceil", "ceiling", "floor", "round"):
+            return self._compile_math(expr)
+        if fn in ("greatest", "least"):
+            return self._compile_greatest_least(expr)
+        if fn == "nullif":
+            a, b = [self.compile(x) for x in expr.args]
+            ta, tb = expr.args[0].type, expr.args[1].type
+
+            def run_nullif(page):
+                (da, va), (db, vb) = a(page), b(page)
+                da2, db2 = self._align_pair(da, ta, db, tb)
+                eq_ = va & vb & (da2 == db2)
+                return da, va & jnp.logical_not(eq_)
+
+            return run_nullif
+        if fn in ("day_of_week", "day_of_year", "quarter", "week"):
+            return self._compile_datepart(expr)
         raise KeyError(f"cannot compile {expr}")
+
+    def _compile_string_lut_fn(self, expr: Call) -> CompiledExpr:
+        """String scalar -> int via a host-computed LUT over the
+        dictionary, one device gather (length, strpos)."""
+        colref = expr.args[0]
+        cf = self.compile(colref)
+        d = self._dict_of(colref)
+        if d is None:
+            raise ValueError(f"no dictionary for string column {colref}")
+        if expr.fn == "length":
+            lut_vals = [len(v) for v in d.values]
+        else:  # strpos(col, substring_literal): 1-based, 0 = not found
+            sub = expr.args[1]
+            assert isinstance(sub, Literal), "strpos needle must be a literal"
+            lut_vals = [v.find(sub.value) + 1 for v in d.values]
+        lut = jnp.asarray(lut_vals, dtype=jnp.int64)
+
+        def run_lut(page):
+            dd, v = cf(page)
+            return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
+
+        return run_lut
+
+    def _compile_math(self, expr: Call) -> CompiledExpr:
+        fn = expr.fn
+        a = self.compile(expr.args[0])
+        ta = expr.args[0].type
+
+        if fn in ("power", "pow"):
+            b = self.compile(expr.args[1])
+            tb = expr.args[1].type
+
+            def run_pow(page):
+                (da, va), (db, vb) = a(page), b(page)
+                return jnp.power(_to_double(da, ta), _to_double(db, tb)), va & vb
+
+            return run_pow
+
+        if fn == "round" and len(expr.args) > 1:
+            digits = expr.args[1].value
+        else:
+            digits = 0
+
+        def run_math(page):
+            da, va = a(page)
+            if fn == "abs":
+                return jnp.abs(da), va
+            if fn == "sign":
+                return jnp.sign(_to_double(da, ta)).astype(jnp.int64), va
+            if fn in ("sqrt", "cbrt", "exp", "ln", "log10"):
+                x = _to_double(da, ta)
+                out = {
+                    "sqrt": lambda: jnp.sqrt(x),
+                    "cbrt": lambda: jnp.cbrt(x),
+                    "exp": lambda: jnp.exp(x),
+                    "ln": lambda: jnp.log(x),
+                    "log10": lambda: jnp.log10(x),
+                }[fn]()
+                return out, va
+            if fn in ("ceil", "ceiling", "floor"):
+                up = fn in ("ceil", "ceiling")
+                if ta.is_decimal:
+                    # scaled-int ceil/floor: // floors for any sign
+                    s = 10 ** ta.scale
+                    q = (da + (s - 1)) // s if up else da // s
+                    return q.astype(jnp.int64), va
+                if ta.name == "double":
+                    return (jnp.ceil(da) if up else jnp.floor(da)), va
+                return da, va
+            if fn == "round":
+                if ta.is_decimal:
+                    drop = ta.scale - min(digits, ta.scale)
+                    if drop <= 0:
+                        return da, va
+                    p = 10 ** drop
+                    half = p // 2
+                    q = jnp.where(da >= 0, (da + half) // p, -((-da + half) // p))
+                    return q, va
+                if ta.name == "double":
+                    m = 10.0 ** digits
+                    x = da * m
+                    r = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+                    return r / m, va
+                return da, va
+            raise KeyError(fn)
+
+        return run_math
+
+    def _compile_greatest_least(self, expr: Call) -> CompiledExpr:
+        parts = [(self.compile(x), x.type) for x in expr.args]
+        out_t = expr.type
+        take_max = expr.fn == "greatest"
+
+        def run_gl(page):
+            data = None
+            valid = None
+            for cf, t in parts:
+                d, v = cf(page)
+                d = self._coerce(d, t, out_t)
+                if data is None:
+                    data, valid = d, v
+                else:
+                    data = jnp.maximum(data, d) if take_max else jnp.minimum(data, d)
+                    valid = valid & v  # NULL if any argument is NULL (Presto)
+            return data, valid
+
+        return run_gl
 
     # ------------------------------------------------------------------
     def _compile_literal(self, expr: Literal) -> CompiledExpr:
@@ -464,8 +604,23 @@ class ExprCompiler:
 
         def run_datepart(page):
             d, v = a(page)
-            y, m, day = _civil_from_days(d.astype(jnp.int64))
-            out = {"year": y, "month": m, "day": day}[part]
+            days = d.astype(jnp.int64)
+            y, m, day = _civil_from_days(days)
+            if part in ("year", "month", "day"):
+                out = {"year": y, "month": m, "day": day}[part]
+            elif part == "quarter":
+                out = (m - 1) // 3 + 1
+            elif part == "day_of_week":
+                # ISO: Monday=1..Sunday=7; 1970-01-01 was a Thursday
+                out = (days + 3) % 7 + 1
+            elif part == "day_of_year":
+                jan1 = days - _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(day))
+                out = jan1 + 1
+            elif part == "week":
+                jan1 = days - _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(day))
+                out = jan1 // 7 + 1  # simple week-of-year
+            else:
+                raise KeyError(part)
             return out.astype(jnp.int64), v
 
         return run_datepart
@@ -536,6 +691,18 @@ def _civil_from_days(z: jax.Array):
     m = jnp.where(mp < 10, mp + 3, mp - 9)
     y = jnp.where(m <= 2, y + 1, y)
     return y, m, d
+
+
+def _days_from_civil(y: jax.Array, m: jax.Array, d: jax.Array) -> jax.Array:
+    """(year, month, day) -> epoch days (inverse of _civil_from_days,
+    same public-domain algorithm)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
 
 
 # -- module-level helpers ----------------------------------------------------

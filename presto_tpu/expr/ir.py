@@ -111,10 +111,36 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         if fn == "div" and a.name != "double" and b.name != "double":
             return common_super_type(a, b)  # integer division stays integral
         return common_super_type(a, b)
-    if fn in ("year", "month", "day"):
+    if fn in ("year", "month", "day", "day_of_week", "day_of_year", "quarter", "week"):
         return BIGINT
     if fn == "date_add_days":
         return DATE
+    if fn in ("sqrt", "cbrt", "exp", "ln", "log10", "power", "pow"):
+        return DOUBLE
+    if fn == "abs":
+        return ts[0]
+    if fn in ("ceil", "ceiling", "floor"):
+        t = ts[0]
+        return BIGINT if t.is_decimal else t
+    if fn == "round":
+        t = ts[0]
+        if t.is_decimal:
+            digits = args[1].value if len(args) > 1 and isinstance(args[1], Literal) else 0
+            return DecimalType(18, min(digits, t.scale))
+        return t
+    if fn == "sign":
+        return BIGINT
+    if fn in ("greatest", "least"):
+        out = ts[0]
+        for t in ts[1:]:
+            out = common_super_type(out, t)
+        return out
+    if fn == "nullif":
+        return ts[0]
+    if fn in ("length", "strpos"):
+        return BIGINT
+    if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
+        return ts[0]
     if fn == "coalesce":
         out = ts[0]
         for t in ts[1:]:

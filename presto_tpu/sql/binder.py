@@ -67,6 +67,16 @@ WINDOW_FUNCTIONS = {
     "first_value", "last_value",
 } | AGG_FUNCTIONS
 
+# scalar builtins (reference: operator/scalar/ ~130 files; the engine's
+# set grows here + in expr/compile.py)
+SCALAR_FUNCTIONS = {
+    "abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10", "power", "pow",
+    "ceil", "ceiling", "floor", "round", "mod", "greatest", "least",
+    "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
+    "trim", "ltrim", "rtrim", "reverse", "substr",
+    "year", "month", "day", "day_of_week", "day_of_year", "quarter", "week",
+}
+
 
 class BindError(Exception):
     pass
@@ -1091,6 +1101,9 @@ class Binder:
                 if agg is None:
                     raise BindError(f"aggregate {e.name} in scalar context")
                 return self._bind_agg_call(e, scope, agg)
+            if e.name in SCALAR_FUNCTIONS:
+                args = [self._bind_impl(a, scope, agg) for a in e.args]
+                return call(e.name, *args)
             raise BindError(f"unknown function {e.name}")
 
         if isinstance(e, ast.Substring):

@@ -100,6 +100,7 @@ def load_oracle(tpch) -> sqlite3.Connection:
     conn.create_function("year_of", 1, year_of)
     conn.create_function("month_of", 1, month_of)
     conn.create_function("day_of", 1, day_of)
+    register_scalar_udfs(conn)
 
     from presto_tpu.connectors.tpch import SCHEMAS
 
@@ -124,6 +125,32 @@ def load_oracle(tpch) -> sqlite3.Connection:
             conn.executemany(f"insert into {table} values ({ph})", rows)
     conn.commit()
     return conn
+
+
+def register_scalar_udfs(conn: sqlite3.Connection) -> None:
+    """Scalar builtins the engine supports but sqlite may lack."""
+
+    def _d(days):
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+
+    fns1 = {
+        "ceil": math.ceil, "ceiling": math.ceil, "floor": math.floor,
+        "sqrt": math.sqrt, "cbrt": lambda x: math.copysign(abs(x) ** (1 / 3), x),
+        "exp": math.exp, "ln": math.log, "log10": math.log10,
+        "sign": lambda x: (x > 0) - (x < 0),
+        "day_of_week": lambda days: _d(days).isoweekday(),
+        "day_of_year": lambda days: _d(days).timetuple().tm_yday,
+        "quarter": lambda days: (_d(days).month - 1) // 3 + 1,
+        "week": lambda days: (_d(days).timetuple().tm_yday - 1) // 7 + 1,
+        "reverse": lambda s: s[::-1],
+    }
+    for name, fn in fns1.items():
+        conn.create_function(name, 1, fn)
+    conn.create_function("power", 2, lambda a, b: float(a) ** float(b))
+    conn.create_function("pow", 2, lambda a, b: float(a) ** float(b))
+    conn.create_function("strpos", 2, lambda s, sub: s.find(sub) + 1)
+    conn.create_function("greatest", -1, lambda *a: max(a))
+    conn.create_function("least", -1, lambda *a: min(a))
 
 
 def _key(row: Sequence) -> tuple:
