@@ -48,6 +48,7 @@ from presto_tpu.planner.plan import (
     SortNode,
     TableScanNode,
     TopNNode,
+    UnionNode,
     ValuesNode,
     WindowNode,
 )
@@ -255,6 +256,18 @@ class LocalRunner:
 
         if isinstance(node, PrecomputedNode):
             yield node.page
+            return
+
+        if isinstance(node, UnionNode):
+            chans = node.channels
+            for k, src in enumerate(node.inputs):
+                offs = node.code_offsets[k]
+                for p in self._pages(src):
+                    blocks = []
+                    for i, b in enumerate(p.blocks):
+                        data = b.data + offs[i] if offs[i] else b.data
+                        blocks.append(Block(data, b.valid, chans[i].type, chans[i].dictionary))
+                    yield Page(tuple(blocks), p.row_mask)
             return
 
         if isinstance(node, WindowNode):

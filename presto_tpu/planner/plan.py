@@ -295,6 +295,61 @@ class ValuesNode(PlanNode):
 
 
 @dataclasses.dataclass(eq=False)
+class UnionNode(PlanNode):
+    """UNION ALL concatenation (UnionNode.java analog).  Sources must
+    be type-aligned by the planner; VARCHAR columns whose arms carry
+    different dictionaries get a merged dictionary with per-source code
+    offsets (applied by the executor)."""
+
+    inputs: List[PlanNode]
+
+    def __post_init__(self):
+        self._channels: Optional[List[Channel]] = None
+        self._offsets: Optional[List[List[int]]] = None
+
+    def _compute(self):
+        if self._channels is not None:
+            return
+        chans: List[Channel] = []
+        offsets = [[0] * len(self.inputs[0].channels) for _ in self.inputs]
+        for i, base in enumerate(self.inputs[0].channels):
+            dicts = [src.channels[i].dictionary for src in self.inputs]
+            if base.type.is_string and len({id(d) for d in dicts}) > 1:
+                values: List[str] = []
+                for k, d in enumerate(dicts):
+                    offsets[k][i] = len(values)
+                    values.extend(list(d.values))
+                merged = Dictionary(values)
+                chans.append(Channel(base.name, base.type, merged, (0, len(values) - 1)))
+            else:
+                domain = base.domain
+                for src in self.inputs[1:]:
+                    d2 = src.channels[i].domain
+                    domain = (
+                        (min(domain[0], d2[0]), max(domain[1], d2[1]))
+                        if domain is not None and d2 is not None
+                        else None
+                    )
+                chans.append(Channel(base.name, base.type, base.dictionary, domain))
+        self._channels = chans
+        self._offsets = offsets
+
+    @property
+    def sources(self):
+        return list(self.inputs)
+
+    @property
+    def channels(self) -> List[Channel]:
+        self._compute()
+        return self._channels
+
+    @property
+    def code_offsets(self) -> List[List[int]]:
+        self._compute()
+        return self._offsets
+
+
+@dataclasses.dataclass(eq=False)
 class WindowNode(PlanNode):
     """Window functions over one (partition, order) spec
     (WindowNode.java / WindowOperator analog); appends one channel per

@@ -53,7 +53,7 @@ class QueryRunner:
     def execute(self, sql: str) -> MaterializedResult:
         stmt = parse_statement(sql)
 
-        if isinstance(stmt, ast.Query):
+        if isinstance(stmt, (ast.Query, ast.Union)):
             return self.executor.run(self._plan_cached(sql, stmt))
 
         if isinstance(stmt, ast.Explain):

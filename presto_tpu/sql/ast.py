@@ -231,6 +231,15 @@ class ShowSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Union(Node):
+    left: Node  # Query or Union
+    right: Node
+    distinct: bool = False
+    order_by: Tuple["OrderItem", ...] = ()
+    limit: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Query(Node):
     select: Tuple[SelectItem, ...]
     distinct: bool = False

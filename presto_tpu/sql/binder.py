@@ -49,7 +49,9 @@ from presto_tpu.planner.plan import (
 )
 from presto_tpu.sql import ast
 from presto_tpu.sql.parser import parse_query
-from presto_tpu.types import BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType, Type
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType, Type, common_super_type,
+)
 
 AGG_FUNCTIONS = {"sum", "avg", "count", "min", "max"}
 
@@ -230,9 +232,77 @@ class Binder:
     def plan(self, sql: str) -> OutputNode:
         return self.plan_ast(parse_query(sql))
 
-    def plan_ast(self, q: ast.Query) -> OutputNode:
-        node, names = self._plan_query(q)
+    def plan_ast(self, q: ast.Node) -> OutputNode:
+        node, names = self._plan_query_like(q)
         return OutputNode(node, names)
+
+    def _plan_query_like(self, q: ast.Node) -> Tuple[PlanNode, List[str]]:
+        if isinstance(q, ast.Union):
+            return self._plan_union(q)
+        return self._plan_query(q)
+
+    def _plan_union(self, u: ast.Union) -> Tuple[PlanNode, List[str]]:
+        from presto_tpu.planner.plan import UnionNode
+
+        lnode, lnames = self._plan_query_like(u.left)
+        rnode, rnames = self._plan_query_like(u.right)
+        if len(lnode.channels) != len(rnode.channels):
+            raise BindError("UNION arms have different column counts")
+        # type alignment via cast projections
+        targets = [
+            common_super_type(a.type, b.type)
+            for a, b in zip(lnode.channels, rnode.channels)
+        ]
+        lnode = self._coerce_columns(lnode, targets, lnames)
+        rnode = self._coerce_columns(rnode, targets, lnames)
+        node: PlanNode = UnionNode([lnode, rnode])
+        names = lnames
+        if u.distinct:
+            node = AggregationNode(
+                node,
+                [ColumnRef(type=c.type, index=i) for i, c in enumerate(node.channels)],
+                names, [], [],
+                max_groups=self._distinct_capacity(node),
+            )
+        order_channels: List[ColumnRef] = []
+        for o in u.order_by:
+            e = o.expr
+            if isinstance(e, ast.NumberLit):
+                i = int(e.text) - 1
+            elif isinstance(e, ast.Identifier) and e.name in names:
+                i = names.index(e.name)
+            else:
+                raise BindError("UNION ORDER BY must use output names or ordinals")
+            order_channels.append(ColumnRef(type=node.channels[i].type, index=i))
+        if u.order_by:
+            asc = [o.ascending for o in u.order_by]
+            nf = [o.nulls_first if o.nulls_first is not None else (not o.ascending) for o in u.order_by]
+            if u.limit is not None:
+                node = TopNNode(node, order_channels, asc, u.limit, nf)
+            else:
+                node = SortNode(node, order_channels, asc, nf)
+        elif u.limit is not None:
+            node = LimitNode(node, u.limit)
+        return node, names
+
+    def _coerce_columns(self, node: PlanNode, targets: List[Type], names: List[str]) -> PlanNode:
+        if all(c.type == t for c, t in zip(node.channels, targets)):
+            return node
+        projections = []
+        for i, (c, t) in enumerate(zip(node.channels, targets)):
+            ref = ColumnRef(type=c.type, index=i)
+            if c.type == t:
+                projections.append(ref)
+            elif t.name == "double":
+                projections.append(call("cast_double", ref))
+            elif t.is_decimal:
+                # rescale through exact decimal addition of 0
+                projections.append(call("add", ref, Literal(type=t, value=0)))
+            elif t.name == "bigint":
+                projections.append(call("cast_bigint", ref))
+            else:
+                raise BindError(f"cannot unify UNION column types {c.type} and {t}")
+        return ProjectNode(node, projections, list(names))
 
     # ==================================================================
     # relation planning
@@ -243,7 +313,7 @@ class Binder:
             scan = TableScanNode(handle, list(range(len(handle.columns))))
             return scan, Scope.of(scan, rel.alias or rel.name)
         if isinstance(rel, ast.SubqueryRel):
-            node, names = self._plan_query(rel.query)
+            node, names = self._plan_query_like(rel.query)
             scope = Scope(
                 [ScopeCol(rel.alias, n, c) for n, c in zip(names, node.channels)]
             )
@@ -703,7 +773,7 @@ class Binder:
             out = FilterNode(out, ir)
         opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
         for op, lhs_ir, subq, negated in having_sub:
-            sub_node, _ = self._plan_query(subq)
+            sub_node, _ = self._plan_query_like(subq)
             ref = ColumnRef(type=sub_node.channels[0].type, index=len(out.channels))
             out = CrossSingleNode(left=out, right=sub_node)
             pred: Expr = call(opmap[op], lhs_ir, ref)
@@ -765,7 +835,7 @@ class Binder:
         remap = dict(g2c)
 
         if isinstance(c, ast.InSubquery):
-            sub, sub_names = self._plan_query(c.query)
+            sub, sub_names = self._plan_query_like(c.query)
             value_ir = remap_expr(self._bind(c.value, glob), remap)
             kind = "anti" if (negated ^ c.negated) else "semi"
             join = JoinNode(
@@ -799,7 +869,7 @@ class Binder:
     def _is_correlated(self, q: ast.Query, outer_glob: Scope) -> bool:
         """A subquery is correlated iff it does not bind standalone."""
         try:
-            self._plan_query(q)
+            self._plan_query_like(q)
             return False
         except BindError:
             return True
@@ -858,7 +928,9 @@ class Binder:
                 raise BindError(f"unsupported correlated predicate {c!r}")
         return terms, inner_conjuncts, corr, corr_extra, nested, inner_glob
 
-    def _plan_exists(self, node, scope, remap, glob, q: ast.Query, kind: str):
+    def _plan_exists(self, node, scope, remap, glob, q, kind: str):
+        if isinstance(q, ast.Union):
+            raise BindError("EXISTS over UNION unsupported")
         terms, inner_conjuncts, corr, corr_extra, nested, inner_glob = \
             self._split_correlation(q, glob)
         if not corr:
@@ -928,15 +1000,20 @@ class Binder:
         pred = cond if kind == "semi" else call("not", cond)
         return FilterNode(join, pred), scope
 
-    def _plan_scalar_subquery(self, node, scope, remap, glob, q: ast.Query):
+    def _plan_scalar_subquery(self, node, scope, remap, glob, q):
         """Returns (new node, scope, ColumnRef to the scalar value)."""
+        if isinstance(q, ast.Union):
+            sub_node, _ = self._plan_union(q)
+            out = CrossSingleNode(left=node, right=sub_node)
+            ref = ColumnRef(type=sub_node.channels[0].type, index=len(node.channels))
+            return out, scope, ref
         if len(q.select) != 1:
             raise BindError("scalar subquery must select one column")
         sel = q.select[0].expr
 
         if not self._is_correlated(q, glob):
             # uncorrelated: plan the full query, single-row cross join
-            sub_node, _ = self._plan_query(q)
+            sub_node, _ = self._plan_query_like(q)
             out = CrossSingleNode(left=node, right=sub_node)
             ref = ColumnRef(type=sub_node.channels[0].type, index=len(node.channels))
             return out, scope, ref

@@ -120,7 +120,27 @@ class Parser:
             raise SyntaxError(f"trailing input at {self.tok!r}")
         return q
 
-    def _query(self) -> ast.Query:
+    def _query(self) -> ast.Node:
+        """query := select_query (UNION [ALL|DISTINCT] select_query)*
+        with ORDER BY/LIMIT binding to the union result."""
+        q = self._select_query()
+        while self.accept("union"):
+            all_ = bool(self.accept("all"))
+            if not all_:
+                self.accept("distinct")
+            distinct = not all_
+            right = self._select_query()
+            # hoist trailing order/limit from the right arm to the union
+            order_by, limit = right.order_by, right.limit
+            right = ast.Query(
+                select=right.select, distinct=right.distinct, from_=right.from_,
+                where=right.where, group_by=right.group_by, having=right.having,
+            )
+            q = ast.Union(left=q, right=right, distinct=distinct,
+                          order_by=order_by, limit=limit)
+        return q
+
+    def _select_query(self) -> ast.Query:
         self.expect("select")
         distinct = bool(self.accept("distinct"))
         self.accept("all")

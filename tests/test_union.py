@@ -1,0 +1,49 @@
+"""UNION [ALL] tests vs the sqlite oracle."""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+
+from tests.oracle import assert_rows_match, load_oracle, run_oracle
+
+
+@pytest.fixture(scope="module")
+def env():
+    tpch = Tpch(sf=0.001, split_rows=4096)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    return QueryRunner(catalog), load_oracle(tpch)
+
+
+CASES = [
+    # same-dictionary arms
+    "select c_custkey as k from customer union all select s_suppkey from supplier",
+    # distinct union deduplicates
+    "select n_regionkey as r from nation union select r_regionkey from region",
+    # type coercion across arms (bigint + decimal)
+    "select s_suppkey as v from supplier union all select s_acctbal from supplier",
+    # merged dictionaries across different VARCHAR columns
+    "select n_name as name from nation union all select r_name from region",
+    # union + order + limit
+    """select c_custkey as k, c_acctbal as v from customer
+       union all
+       select s_suppkey, s_acctbal from supplier
+       order by v desc limit 20""",
+    # union as a subquery relation feeding aggregation
+    """select cnt, count(*) from (
+         select n_regionkey as cnt from nation
+         union all
+         select r_regionkey from region
+       ) as t group by cnt""",
+]
+
+
+@pytest.mark.parametrize("i", range(len(CASES)))
+def test_union_case(env, i):
+    runner, oracle = env
+    sql = CASES[i]
+    expected = run_oracle(oracle, sql)
+    actual = runner.execute(sql).rows
+    assert_rows_match(actual, expected, ordered=False)
