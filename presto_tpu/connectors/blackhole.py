@@ -1,0 +1,57 @@
+"""Blackhole connector: synthetic no-op tables.
+
+Reference analog: ``presto-blackhole`` — /dev/null-style tables with
+configurable split/page/row counts and artificial latency, used as a
+test fixture for scheduling/cancellation behavior.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.page import Page
+from presto_tpu.types import BIGINT, Type
+
+
+class BlackholeConnector:
+    def __init__(self):
+        self._tables: Dict[str, dict] = {}
+
+    def create_table(
+        self,
+        name: str,
+        schema: List[Tuple[str, Type]],
+        splits: int = 1,
+        rows_per_split: int = 0,
+        page_latency_s: float = 0.0,
+    ) -> None:
+        self._tables[name] = {
+            "schema": schema, "splits": splits,
+            "rows": rows_per_split, "latency": page_latency_s,
+        }
+
+    # -- connector protocol -------------------------------------------------
+    def table_names(self) -> List[str]:
+        return list(self._tables.keys())
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return self._tables[table]["schema"]
+
+    def num_splits(self, table: str) -> int:
+        return self._tables[table]["splits"]
+
+    def row_count(self, table: str) -> int:
+        t = self._tables[table]
+        return t["splits"] * t["rows"]
+
+    def page_for_split(self, table: str, split: int, capacity: Optional[int] = None) -> Page:
+        t = self._tables[table]
+        if t["latency"]:
+            time.sleep(t["latency"])
+        n = t["rows"]
+        cols = [np.zeros(n, dtype=ty.np_dtype) for _, ty in t["schema"]]
+        types = [ty for _, ty in t["schema"]]
+        return Page.from_arrays(cols, types, capacity=capacity or max(n, 1))

@@ -1,0 +1,92 @@
+"""System tables: engine state queryable as SQL.
+
+Reference analog: the system connector in
+``presto-main/.../connector/system/`` — system.runtime.queries /
+system.runtime.nodes fed by the coordinator's live state.  Tables here
+are flat-named (``system_runtime_queries``...) and draw from a query
+history recorded via the event-listener pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.events import EventListener, QueryCompletedEvent
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR, Type
+
+
+class QueryHistory(EventListener):
+    """Accumulates completed-query summaries (QueryMonitor sink)."""
+
+    def __init__(self, limit: int = 1000):
+        self.completed: List[QueryCompletedEvent] = []
+        self.limit = limit
+
+    def query_completed(self, e: QueryCompletedEvent) -> None:
+        self.completed.append(e)
+        if len(self.completed) > self.limit:
+            self.completed.pop(0)
+
+
+class SystemConnector:
+    """system_runtime_queries + system_runtime_nodes."""
+
+    def __init__(self, history: QueryHistory, nodes: Optional[Callable[[], List[dict]]] = None):
+        self.history = history
+        self.nodes = nodes or (lambda: [{"node_id": "local", "state": "ACTIVE"}])
+
+    SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
+        "system_runtime_queries": [
+            ("query_id", VARCHAR), ("state", VARCHAR), ("user", VARCHAR),
+            ("rows", BIGINT), ("wall_seconds", DOUBLE), ("query", VARCHAR),
+        ],
+        "system_runtime_nodes": [
+            ("node_id", VARCHAR), ("state", VARCHAR),
+        ],
+    }
+
+    def table_names(self) -> List[str]:
+        return list(self.SCHEMAS.keys())
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return self.SCHEMAS[table]
+
+    def num_splits(self, table: str) -> int:
+        return 1
+
+    def row_count(self, table: str) -> int:
+        if table == "system_runtime_queries":
+            return len(self.history.completed)
+        return len(self.nodes())
+
+    def page_for_split(self, table: str, split: int, capacity: Optional[int] = None) -> Page:
+        if table == "system_runtime_queries":
+            evs = list(self.history.completed)
+            cols: List[List] = [
+                [e.query_id for e in evs],
+                [e.state for e in evs],
+                [e.user for e in evs],
+                [e.rows for e in evs],
+                [e.end_time - e.create_time for e in evs],
+                [e.sql.strip()[:200] for e in evs],
+            ]
+        else:
+            ns = self.nodes()
+            cols = [[n["node_id"] for n in ns], [n["state"] for n in ns]]
+        schema = self.SCHEMAS[table]
+        arrays, dicts = [], []
+        for vals, (_, t) in zip(cols, schema):
+            if t.is_string:
+                d = Dictionary(sorted(set(vals)))
+                arrays.append(np.asarray([d.code_of(v) for v in vals], dtype=np.int32))
+                dicts.append(d)
+            else:
+                arrays.append(np.asarray(vals, dtype=t.np_dtype))
+                dicts.append(None)
+        n = len(cols[0])
+        return Page.from_arrays(
+            arrays, [t for _, t in schema], dictionaries=dicts, capacity=max(n, 1)
+        )

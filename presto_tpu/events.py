@@ -1,0 +1,65 @@
+"""Query event pipeline.
+
+Reference analog: ``event/query/QueryMonitor.java:114`` emitting
+QueryCreated/QueryCompleted/SplitCompleted events to the
+``EventListener`` SPI (``spi/eventlistener/EventListener.java``) via
+``EventListenerManager`` — the hook warehouses use for query logging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    user: str
+    create_time: float
+
+
+@dataclasses.dataclass
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    user: str
+    state: str  # FINISHED | FAILED
+    create_time: float
+    end_time: float
+    rows: int = 0
+    error: Optional[str] = None
+
+
+class EventListener:
+    """Subclass and override (EventListener SPI analog)."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:  # pragma: no cover
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:  # pragma: no cover
+        pass
+
+
+class EventListenerManager:
+    def __init__(self):
+        self._listeners: List[EventListener] = []
+
+    def add(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        for l in self._listeners:
+            l.query_created(event)
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        for l in self._listeners:
+            l.query_completed(event)
+
+
+def new_query_id() -> str:
+    """Presto-style query id: yyyymmdd_hhmmss_ncccc_xxxxx."""
+    return time.strftime("%Y%m%d_%H%M%S") + "_" + uuid.uuid4().hex[:5]

@@ -139,11 +139,15 @@ class LocalRunner:
     ``jit=False`` runs chains eagerly for debugging.
     """
 
-    def __init__(self, catalog: Catalog, jit: bool = True, split_capacity: Optional[int] = None):
+    def __init__(self, catalog: Catalog, jit: bool = True, split_capacity: Optional[int] = None,
+                 memory_pool=None):
         self.catalog = catalog
         self.jit = jit
         self.split_capacity = split_capacity
         self.stats: Optional[QueryStats] = None
+        # HBM accounting (memory/MemoryPool.java analog); None = untracked
+        self.memory_pool = memory_pool
+        self._mem = None
         self._chain_cache: Dict[PlanNode, Callable] = {}
         self._fold_cache: Dict[PlanNode, Callable] = {}
         self._agg_overrides: Dict[PlanNode, int] = {}
@@ -161,12 +165,30 @@ class LocalRunner:
         )
 
     def run_to_page(self, plan: PlanNode) -> Page:
-        while True:
-            try:
-                self._builds.clear()
-                return self._execute_to_page(plan)
-            except GroupCapacityExceeded:
-                continue  # _agg_overrides updated; re-execute
+        if self.memory_pool is not None:
+            from presto_tpu.memory import QueryMemoryContext
+            import uuid
+
+            self._mem = QueryMemoryContext(self.memory_pool, uuid.uuid4().hex[:8])
+        try:
+            while True:
+                try:
+                    self._builds.clear()
+                    return self._execute_to_page(plan)
+                except GroupCapacityExceeded:
+                    continue  # _agg_overrides updated; re-execute
+        finally:
+            if self._mem is not None:
+                self._mem.release_all()
+                self._mem = None
+
+    def _account(self, what: str, page) -> None:
+        """Charge a materialized device intermediate against the pool
+        (operator-level LocalMemoryContext.setBytes analog)."""
+        if self._mem is not None:
+            from presto_tpu.memory import page_bytes
+
+            self._mem.reserve(what, page_bytes(page))
 
     def explain(self, plan: PlanNode) -> str:
         from presto_tpu.planner.plan import plan_tree_str
@@ -224,6 +246,7 @@ class LocalRunner:
 
         if isinstance(node, SortNode):
             src = self._execute_to_page(node.source)
+            self._account("sort_input", src)
             fn = self._fold_cache.get(node)
             if fn is None:
                 sort_exprs = list(node.sort_exprs)
@@ -430,7 +453,9 @@ class LocalRunner:
 
                     fn = jax.jit(make_build) if self.jit else make_build
                     self._fold_cache[node] = fn
-                self._builds[node] = fn(pages)
+                build = fn(pages)
+                self._account("join_build", build.page)
+                self._builds[node] = build
         return self._builds[node]
 
     # ------------------------------------------------------------------
@@ -556,7 +581,11 @@ class LocalRunner:
 
         acc: Optional[Page] = None
         for p in self._pages(source):
-            acc = fold_fn(acc, p)
+            if acc is None:
+                acc = fold_fn(acc, p)
+                self._account("agg_accumulator", acc)
+            else:
+                acc = fold_fn(acc, p)
         if acc is None:
             return Page.empty(node.output_types, max(mg, 1))
         out = final_fn(acc)

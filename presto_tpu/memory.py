@@ -1,0 +1,102 @@
+"""Memory accounting against the device's HBM budget.
+
+Reference analog: the hierarchical memory system —
+``presto-memory-context`` (AggregatedMemoryContext/LocalMemoryContext),
+``memory/MemoryPool.java:43`` (tagged reservations, listeners) and the
+per-query limit enforcement of ``memory/QueryContext.java``.  The
+reference tracks JVM heap bytes and kills/spills on pressure; here the
+scarce resource is HBM, and the accountable objects are materialized
+device intermediates (join builds, aggregation accumulators,
+concatenated pages).  Exceeding the query limit raises
+ExceededMemoryLimitError — the executor's capacity-retry machinery and
+(future) host-offload chunking are the spill analogs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class ExceededMemoryLimitError(Exception):
+    def __init__(self, tag: str, requested: int, reserved: int, limit: int):
+        super().__init__(
+            f"query exceeded memory limit: {tag} requested {requested} bytes, "
+            f"{reserved} reserved, limit {limit}"
+        )
+        self.tag = tag
+        self.requested = requested
+        self.reserved = reserved
+        self.limit = limit
+
+
+def page_bytes(page) -> int:
+    """Accountable HBM footprint of a Page."""
+    total = 0
+    for b in page.blocks:
+        total += b.data.size * b.data.dtype.itemsize
+        total += b.valid.size  # bool byte each
+    total += page.row_mask.size
+    return total
+
+
+class MemoryPool:
+    """Tagged byte reservations with a hard limit (MemoryPool.java
+    semantics minus the GENERAL/RESERVED two-pool OOM dance — a single
+    chip has one HBM)."""
+
+    def __init__(self, limit_bytes: int):
+        self.limit = int(limit_bytes)
+        self._lock = threading.Lock()
+        self._tagged: Dict[str, int] = {}
+        self.reserved = 0
+        self.peak = 0
+
+    def reserve(self, tag: str, nbytes: int) -> None:
+        with self._lock:
+            if self.reserved + nbytes > self.limit:
+                raise ExceededMemoryLimitError(tag, nbytes, self.reserved, self.limit)
+            self._tagged[tag] = self._tagged.get(tag, 0) + nbytes
+            self.reserved += nbytes
+            self.peak = max(self.peak, self.reserved)
+
+    def free(self, tag: str) -> None:
+        with self._lock:
+            n = self._tagged.pop(tag, 0)
+            self.reserved -= n
+
+    def free_all(self) -> None:
+        with self._lock:
+            self._tagged.clear()
+            self.reserved = 0
+
+    def tags(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._tagged)
+
+
+class QueryMemoryContext:
+    """Per-query view over a pool (QueryContext analog): unique tags
+    per allocation site, freed together at query end."""
+
+    def __init__(self, pool: MemoryPool, query_id: str = "q"):
+        self.pool = pool
+        self.query_id = query_id
+        self._seq = 0
+
+    def reserve(self, what: str, nbytes: int) -> str:
+        self._seq += 1
+        tag = f"{self.query_id}/{what}#{self._seq}"
+        self.pool.reserve(tag, nbytes)
+        return tag
+
+    def reserve_page(self, what: str, page) -> str:
+        return self.reserve(what, page_bytes(page))
+
+    def free(self, tag: str) -> None:
+        self.pool.free(tag)
+
+    def release_all(self) -> None:
+        for tag in list(self.pool.tags()):
+            if tag.startswith(self.query_id + "/"):
+                self.pool.free(tag)

@@ -61,6 +61,9 @@ def _sum_type(t: Type) -> Type:
     return BIGINT
 
 
+VARIANCE_FNS = ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop")
+
+
 def state_types(agg: AggCall) -> List[Type]:
     """Column types of this aggregate's partial state."""
     if agg.fn == "count_star" or agg.fn == "count":
@@ -72,6 +75,10 @@ def state_types(agg: AggCall) -> List[Type]:
         return [_sum_type(t), BIGINT]
     if agg.fn in ("min", "max"):
         return [t, BIGINT]
+    if agg.fn in VARIANCE_FNS:
+        return [DOUBLE, DOUBLE, BIGINT]  # sum, sum of squares, count
+    if agg.fn in ("bool_and", "bool_or", "every"):
+        return [BIGINT, BIGINT]  # count of true, count of non-null
     raise KeyError(f"unknown aggregate {agg.fn}")
 
 
@@ -82,6 +89,12 @@ def output_type(agg: AggCall) -> Type:
         return _sum_type(agg.arg.type)
     if agg.fn == "avg":
         return DOUBLE  # deviation: reference keeps decimal scale for avg(decimal)
+    if agg.fn in VARIANCE_FNS:
+        return DOUBLE
+    if agg.fn in ("bool_and", "bool_or", "every"):
+        from presto_tpu.types import BOOLEAN
+
+        return BOOLEAN
     return agg.arg.type
 
 
@@ -132,6 +145,17 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
                     jnp.where(nonnull, data, fill), gid_nn, num_segments=n + 1
                 )[:n]
             out.append([m, cnt])
+        elif agg.fn in VARIANCE_FNS:
+            from presto_tpu.expr.compile import _to_double
+
+            x = jnp.where(nonnull, _to_double(data, agg.arg.type), 0.0)
+            s = _seg_sum(x, gid_nn, n + 1)[:n]
+            s2 = _seg_sum(x * x, gid_nn, n + 1)[:n]
+            out.append([s, s2, cnt])
+        elif agg.fn in ("bool_and", "bool_or", "every"):
+            t = _seg_sum((nonnull & data.astype(jnp.bool_)).astype(jnp.int64),
+                         gid_nn, n + 1)[:n]
+            out.append([t, cnt])
         else:
             raise KeyError(agg.fn)
     return out
@@ -159,6 +183,10 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
                 jax.ops.segment_max(cols[0], gid, num_segments=n + 1)[:n],
                 _seg_sum(cols[1], gid, n + 1)[:n],
             ])
+        elif agg.fn in VARIANCE_FNS:
+            out.append([_seg_sum(c, gid, n + 1)[:n] for c in cols])
+        elif agg.fn in ("bool_and", "bool_or", "every"):
+            out.append([_seg_sum(c, gid, n + 1)[:n] for c in cols])
     return out
 
 
@@ -182,6 +210,27 @@ def _finalize(states: List[List[jax.Array]], aggs) -> List[Block]:
         elif agg.fn in ("min", "max"):
             m, cnt = cols
             blocks.append(Block(m.astype(t.np_dtype), cnt > 0, t))
+        elif agg.fn in VARIANCE_FNS:
+            s, s2, cnt = cols
+            n = jnp.maximum(cnt, 1).astype(jnp.float64)
+            mean = s / n
+            pop_var = jnp.maximum(s2 / n - mean * mean, 0.0)
+            sample = agg.fn in ("stddev", "stddev_samp", "variance", "var_samp")
+            if sample:
+                var = pop_var * n / jnp.maximum(n - 1, 1)
+                valid = cnt > 1
+            else:
+                var = pop_var
+                valid = cnt > 0
+            out_v = jnp.sqrt(var) if agg.fn.startswith("stddev") else var
+            blocks.append(Block(out_v, valid, t))
+        elif agg.fn in ("bool_and", "bool_or", "every"):
+            trues, cnt = cols
+            if agg.fn == "bool_or":
+                v = trues > 0
+            else:
+                v = trues == cnt
+            blocks.append(Block(v, cnt > 0, t))
     return blocks
 
 

@@ -1,0 +1,101 @@
+"""Resource groups: hierarchical admission control.
+
+Reference analog: ``execution/resourceGroups/InternalResourceGroup.java``
++ ``InternalResourceGroupManager`` and the spi/resourceGroups selector
+contract — queries are admitted into a tree of groups with concurrency
+and queue quotas; over-quota queries wait in FIFO order (the reference
+also offers weighted/priority queues).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class QueryQueueFullError(Exception):
+    pass
+
+
+class ResourceGroup:
+    """One node of the group tree: hard_concurrency + max_queued."""
+
+    def __init__(self, name: str, hard_concurrency: int = 8, max_queued: int = 100,
+                 parent: Optional["ResourceGroup"] = None):
+        self.name = name
+        self.hard_concurrency = hard_concurrency
+        self.max_queued = max_queued
+        self.parent = parent
+        self.children: Dict[str, "ResourceGroup"] = {}
+        self._lock = threading.Condition()
+        self.running = 0
+        self.queued = 0
+
+    def subgroup(self, name: str, hard_concurrency: int = 8, max_queued: int = 100) -> "ResourceGroup":
+        g = self.children.get(name)
+        if g is None:
+            g = ResourceGroup(f"{self.name}.{name}", hard_concurrency, max_queued, self)
+            self.children[name] = g
+        return g
+
+    # ------------------------------------------------------------------
+    def _can_run(self) -> bool:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            if g.running >= g.hard_concurrency:
+                return False
+            g = g.parent
+        return True
+
+    def _charge(self, delta: int) -> None:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            g.running += delta
+            g = g.parent
+
+    def acquire(self, timeout: Optional[float] = None) -> None:
+        """Block until this query may run (FIFO within the group)."""
+        with self._lock:
+            if self.queued >= self.max_queued:
+                raise QueryQueueFullError(
+                    f"group {self.name}: {self.queued} queries queued (max {self.max_queued})"
+                )
+            self.queued += 1
+            try:
+                while not self._can_run():
+                    if not self._lock.wait(timeout=timeout):
+                        raise TimeoutError(f"group {self.name}: queue wait timed out")
+                self._charge(1)
+            finally:
+                self.queued -= 1
+
+    def release(self) -> None:
+        with self._lock:
+            self._charge(-1)
+            self._lock.notify_all()
+
+    def run(self, fn: Callable, timeout: Optional[float] = None):
+        self.acquire(timeout=timeout)
+        try:
+            return fn()
+        finally:
+            self.release()
+
+
+class ResourceGroupManager:
+    """Selector: maps (user, source) to a group
+    (spi/resourceGroups/ResourceGroupConfigurationManager analog)."""
+
+    def __init__(self, root: Optional[ResourceGroup] = None):
+        self.root = root or ResourceGroup("global", hard_concurrency=16, max_queued=1000)
+        self._selectors: List[Callable[[str], Optional[ResourceGroup]]] = []
+
+    def add_selector(self, fn: Callable[[str], Optional[ResourceGroup]]) -> None:
+        self._selectors.append(fn)
+
+    def group_for(self, user: str) -> ResourceGroup:
+        for sel in self._selectors:
+            g = sel(user)
+            if g is not None:
+                return g
+        return self.root

@@ -22,11 +22,16 @@ from presto_tpu.types import BIGINT, VARCHAR, Type
 
 
 class QueryRunner:
-    def __init__(self, catalog: Catalog, session: Optional[Session] = None, jit: bool = True):
+    def __init__(self, catalog: Catalog, session: Optional[Session] = None, jit: bool = True,
+                 memory_pool=None):
+        from presto_tpu.events import EventListenerManager
+
         self.catalog = catalog
         self.session = session or Session()
         self.binder = Binder(catalog)
         self._jit_default = jit
+        self.memory_pool = memory_pool
+        self.events = EventListenerManager()
         self.executor = self._make_executor()
         # plan cache: repeated executions of the same SQL reuse the same
         # plan-node identities, so the executor's compiled-chain caches
@@ -40,6 +45,7 @@ class QueryRunner:
             self.catalog,
             jit=self._jit_default and self.session.get("jit"),
             split_capacity=cap,
+            memory_pool=self.memory_pool,
         )
 
     # ------------------------------------------------------------------
@@ -51,10 +57,33 @@ class QueryRunner:
         return plan
 
     def execute(self, sql: str) -> MaterializedResult:
+        import time
+
+        from presto_tpu.events import (
+            QueryCompletedEvent, QueryCreatedEvent, new_query_id,
+        )
+
         stmt = parse_statement(sql)
 
         if isinstance(stmt, (ast.Query, ast.Union)):
-            return self.executor.run(self._plan_cached(sql, stmt))
+            qid = new_query_id()
+            t0 = time.time()
+            self.events.query_created(
+                QueryCreatedEvent(qid, sql, self.session.user, t0)
+            )
+            try:
+                res = self.executor.run(self._plan_cached(sql, stmt))
+            except Exception as e:
+                self.events.query_completed(QueryCompletedEvent(
+                    qid, sql, self.session.user, "FAILED", t0, time.time(),
+                    error=f"{type(e).__name__}: {e}",
+                ))
+                raise
+            self.events.query_completed(QueryCompletedEvent(
+                qid, sql, self.session.user, "FINISHED", t0, time.time(),
+                rows=len(res.rows),
+            ))
+            return res
 
         if isinstance(stmt, ast.Explain):
             plan = self.binder.plan_ast(stmt.query)

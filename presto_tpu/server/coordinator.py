@@ -57,9 +57,13 @@ class CoordinatorServer:
     machine mirrors QueryState.java:21 (trimmed to the states a
     single-process coordinator hits)."""
 
-    def __init__(self, runner: QueryRunner, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, runner: QueryRunner, host: str = "127.0.0.1", port: int = 0,
+                 resource_groups=None):
+        from presto_tpu.resource_groups import ResourceGroupManager
+
         self.runner = runner
         self.queries: Dict[str, _QueryState] = {}
+        self.resource_groups = resource_groups or ResourceGroupManager()
         self._lock = threading.Lock()
         outer = self
 
@@ -143,6 +147,14 @@ class CoordinatorServer:
             self.queries[qid] = q
 
         def run():
+            group = self.resource_groups.group_for(self.runner.session.user)
+            try:
+                group.acquire(timeout=600)
+            except Exception as e:
+                q.error = f"{type(e).__name__}: {e}"
+                q.state = "FAILED"
+                q.done.set()
+                return
             q.state = "RUNNING"
             try:
                 res = self.runner.execute(sql)
@@ -155,6 +167,7 @@ class CoordinatorServer:
                 q.error = f"{type(e).__name__}: {e}"
                 q.state = "FAILED"
             finally:
+                group.release()
                 q.done.set()
 
         threading.Thread(target=run, daemon=True).start()

@@ -53,7 +53,11 @@ from presto_tpu.types import (
     BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType, Type, common_super_type,
 )
 
-AGG_FUNCTIONS = {"sum", "avg", "count", "min", "max"}
+AGG_FUNCTIONS = {
+    "sum", "avg", "count", "min", "max",
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "bool_and", "bool_or", "every",
+}
 
 # Correlated bindings mark outer-scope columns with this offset so a
 # conjunct's inner/outer sides are separable after binding.

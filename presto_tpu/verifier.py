@@ -1,0 +1,123 @@
+"""Result verifier: replay a query suite on two engines and diff.
+
+Reference analog: ``presto-verifier`` (``verifier/Verifier.java``,
+``Validator.java``) — replays production queries against a control and
+a test cluster and compares checksummed results.  Here the two sides
+are any pair of callables ``sql -> rows`` (two QueryRunners, a runner
+vs the sqlite oracle, local vs distributed, two REST endpoints via
+StatementClient).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Dict, List, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class VerifierResult:
+    name: str
+    status: str  # MATCH | MISMATCH | CONTROL_FAILED | TEST_FAILED
+    control_time: float = 0.0
+    test_time: float = 0.0
+    detail: str = ""
+
+
+def _canonical(rows: Sequence[tuple], float_digits: int = 6) -> List[tuple]:
+    def key(row):
+        return tuple(
+            round(v, float_digits) if isinstance(v, float) else v for v in row
+        )
+
+    return sorted((key(r) for r in rows))
+
+
+def rows_match(a: Sequence[tuple], b: Sequence[tuple], rel_tol: float = 1e-9) -> bool:
+    if len(a) != len(b):
+        return False
+    ca, cb = _canonical(a), _canonical(b)
+    for ra, rb in zip(ca, cb):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) or isinstance(vb, float):
+                if va is None or vb is None:
+                    if va is not vb:
+                        return False
+                elif not math.isclose(float(va), float(vb), rel_tol=rel_tol, abs_tol=1e-6):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+class Verifier:
+    def __init__(
+        self,
+        control: Callable[[str], Sequence[tuple]],
+        test: Callable[[str], Sequence[tuple]],
+    ):
+        self.control = control
+        self.test = test
+
+    def verify(self, queries: Dict[str, str]) -> List[VerifierResult]:
+        out: List[VerifierResult] = []
+        for name, sql in queries.items():
+            t0 = time.time()
+            try:
+                control_rows = self.control(sql)
+            except Exception as e:
+                out.append(VerifierResult(name, "CONTROL_FAILED", detail=str(e)))
+                continue
+            tc = time.time() - t0
+            t0 = time.time()
+            try:
+                test_rows = self.test(sql)
+            except Exception as e:
+                out.append(VerifierResult(name, "TEST_FAILED", control_time=tc, detail=str(e)))
+                continue
+            tt = time.time() - t0
+            if rows_match(control_rows, test_rows):
+                out.append(VerifierResult(name, "MATCH", tc, tt))
+            else:
+                out.append(VerifierResult(
+                    name, "MISMATCH", tc, tt,
+                    detail=f"control {len(control_rows)} rows vs test {len(test_rows)} rows",
+                ))
+        return out
+
+
+def main() -> int:  # pragma: no cover - CLI convenience
+    """Verify the TPC-H corpus: engine vs sqlite oracle."""
+    import sys
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.runner import QueryRunner
+
+    sys.path.insert(0, "tests")
+    from oracle import load_oracle, run_oracle  # type: ignore
+    from tpch_queries import QUERIES  # type: ignore
+
+    tpch = Tpch(sf=0.01)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    runner = QueryRunner(catalog)
+    oracle = load_oracle(tpch)
+
+    v = Verifier(
+        control=lambda sql: run_oracle(oracle, sql),
+        test=lambda sql: runner.execute(sql).rows,
+    )
+    results = v.verify({f"q{k:02d}": sql for k, sql in sorted(QUERIES.items())})
+    bad = 0
+    for r in results:
+        print(f"{r.name}: {r.status}  control={r.control_time:.2f}s test={r.test_time:.2f}s {r.detail}")
+        bad += r.status != "MATCH"
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,116 @@
+"""Blackhole connector, system tables, resource groups.
+
+Reference analogs: presto-blackhole, connector/system (runtime
+tables), execution/resourceGroups/InternalResourceGroup."""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.blackhole import BlackholeConnector
+from presto_tpu.connectors.system import QueryHistory, SystemConnector
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.resource_groups import QueryQueueFullError, ResourceGroup, ResourceGroupManager
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import BIGINT
+
+
+def test_blackhole():
+    bh = BlackholeConnector()
+    bh.create_table("sink", [("x", BIGINT)], splits=3, rows_per_split=10)
+    catalog = Catalog()
+    catalog.register("blackhole", bh)
+    runner = QueryRunner(catalog)
+    res = runner.execute("select count(*) from sink")
+    assert res.rows == [(30,)]
+
+
+def test_blackhole_latency():
+    bh = BlackholeConnector()
+    bh.create_table("slow", [("x", BIGINT)], splits=2, rows_per_split=1,
+                    page_latency_s=0.05)
+    catalog = Catalog()
+    catalog.register("blackhole", bh)
+    runner = QueryRunner(catalog)
+    t0 = time.time()
+    runner.execute("select count(*) from slow")
+    assert time.time() - t0 >= 0.1
+
+
+def test_system_runtime_queries():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    history = QueryHistory()
+    catalog.register("system", SystemConnector(history))
+    runner = QueryRunner(catalog)
+    runner.events.add(history)
+
+    runner.execute("select count(*) from nation")
+    res = runner.execute("select state, rows from system_runtime_queries")
+    assert ("FINISHED", 1) in [(r[0], r[1]) for r in res.rows]
+    nodes = runner.execute("select node_id, state from system_runtime_nodes")
+    assert nodes.rows == [("local", "ACTIVE")]
+
+
+def test_resource_group_concurrency():
+    g = ResourceGroup("test", hard_concurrency=2, max_queued=10)
+    running = []
+    peak = []
+
+    def job(i):
+        def body():
+            running.append(i)
+            peak.append(len(running))
+            time.sleep(0.05)
+            running.remove(i)
+        g.run(body)
+
+    threads = [threading.Thread(target=job, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) <= 2  # hard_concurrency respected
+
+
+def test_resource_group_queue_full():
+    g = ResourceGroup("tiny", hard_concurrency=1, max_queued=1)
+    release = threading.Event()
+
+    def hold():
+        g.acquire()
+        release.wait()
+        g.release()
+
+    t = threading.Thread(target=hold)
+    t.start()
+    time.sleep(0.05)
+
+    # one more can queue...
+    waiter = threading.Thread(target=lambda: g.run(lambda: None))
+    waiter.start()
+    time.sleep(0.05)
+    # ...but the queue is now full
+    with pytest.raises(QueryQueueFullError):
+        g.acquire()
+    release.set()
+    t.join()
+    waiter.join()
+
+
+def test_hierarchical_groups():
+    mgr = ResourceGroupManager(ResourceGroup("global", hard_concurrency=2))
+    adhoc = mgr.root.subgroup("adhoc", hard_concurrency=2)
+    etl = mgr.root.subgroup("etl", hard_concurrency=2)
+    mgr.add_selector(lambda user: adhoc if user.startswith("a_") else etl)
+    assert mgr.group_for("a_alice") is adhoc
+    assert mgr.group_for("bob") is etl
+    # parent cap binds across children
+    adhoc.acquire()
+    etl.acquire()
+    with pytest.raises(TimeoutError):
+        adhoc.acquire(timeout=0.05)
+    adhoc.release()
+    etl.release()

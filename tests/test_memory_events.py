@@ -1,0 +1,78 @@
+"""Memory accounting + event listener tests.
+
+Reference analogs: memory limit enforcement (memory/MemoryPool.java,
+ExceededMemoryLimitException) and the QueryMonitor -> EventListener
+pipeline (event/query/QueryMonitor.java)."""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.events import EventListener
+from presto_tpu.memory import ExceededMemoryLimitError, MemoryPool
+from presto_tpu.runner import QueryRunner
+from presto_tpu.verifier import Verifier
+
+
+def make_runner(limit=None):
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    pool = MemoryPool(limit) if limit else None
+    return QueryRunner(catalog, memory_pool=pool), pool
+
+
+def test_memory_tracked_and_released():
+    runner, pool = make_runner(limit=1 << 30)
+    runner.execute(
+        "select c_custkey, o_orderkey from customer, orders where c_custkey = o_custkey"
+    )
+    assert pool.peak > 0  # join build was charged
+    assert pool.reserved == 0  # released at query end
+
+
+def test_memory_limit_enforced():
+    runner, pool = make_runner(limit=1 << 10)  # 1 KiB: any build blows it
+    with pytest.raises(ExceededMemoryLimitError):
+        runner.execute(
+            "select count(*) from customer, orders where c_custkey = o_custkey"
+        )
+    assert pool.reserved == 0  # released even on failure
+
+
+def test_event_listener():
+    runner, _ = make_runner()
+    seen = []
+
+    class L(EventListener):
+        def query_created(self, e):
+            seen.append(("created", e.query_id))
+
+        def query_completed(self, e):
+            seen.append(("completed", e.state, e.rows))
+
+    runner.events.add(L())
+    runner.execute("select count(*) from nation")
+    assert seen[0][0] == "created"
+    assert seen[1] == ("completed", "FINISHED", 1)
+
+    with pytest.raises(Exception):
+        runner.execute("select no_such_column from nation")
+    assert seen[-1][1] == "FAILED"
+
+
+def test_verifier_match_and_mismatch():
+    runner, _ = make_runner()
+
+    v = Verifier(
+        control=lambda sql: runner.execute(sql).rows,
+        test=lambda sql: runner.execute(sql).rows,
+    )
+    res = v.verify({"ok": "select count(*) from nation"})
+    assert res[0].status == "MATCH"
+
+    v2 = Verifier(
+        control=lambda sql: [(999,)],
+        test=lambda sql: runner.execute(sql).rows,
+    )
+    res = v2.verify({"bad": "select count(*) from nation"})
+    assert res[0].status == "MISMATCH"

@@ -43,3 +43,41 @@ def test_scalar_case(env, i):
     expected = run_oracle(oracle, sql)
     actual = runner.execute(sql).rows
     assert_rows_match(actual, expected, ordered=False)
+
+
+AGG_CASES = [
+    # sqlite lacks stddev; emulate via sum/count identities
+    ("select s_nationkey, stddev_pop(s_acctbal) from supplier group by s_nationkey",
+     """select s_nationkey,
+               case when count(s_acctbal) > 0 then
+                 sqrt(max(sum(s_acctbal*s_acctbal)/count(s_acctbal)
+                      - (sum(s_acctbal)/count(s_acctbal))*(sum(s_acctbal)/count(s_acctbal)), 0))
+               end
+        from supplier group by s_nationkey"""),
+    ("select var_samp(s_acctbal) from supplier",
+     """select (sum(s_acctbal*s_acctbal) - sum(s_acctbal)*sum(s_acctbal)/count(s_acctbal))
+               / (count(s_acctbal) - 1) from supplier"""),
+    ("select n_regionkey, bool_and(n_nationkey > 2), bool_or(n_nationkey > 20) from nation group by n_regionkey",
+     """select n_regionkey, min(n_nationkey > 2), max(n_nationkey > 20)
+        from nation group by n_regionkey"""),
+]
+
+
+@pytest.mark.parametrize("i", range(len(AGG_CASES)))
+def test_agg_function_case(env, i):
+    runner, oracle = env
+    sql, oracle_sql = AGG_CASES[i]
+    expected = run_oracle(oracle, oracle_sql)
+    # sum-of-squares variance is cancellation-prone at ~1e10 magnitudes:
+    # blunt both sides below the noise floor before exact compare
+    def blunt(rows):
+        return [
+            tuple(
+                round(float(v), 3) if isinstance(v, float)
+                else int(v) if isinstance(v, bool) else v
+                for v in row
+            )
+            for row in rows
+        ]
+
+    assert_rows_match(blunt(actual := runner.execute(sql).rows), blunt(expected), ordered=False)
