@@ -66,9 +66,15 @@ class Catalog:
 
     def __init__(self):
         self._connectors: Dict[str, object] = {}
+        # target for CREATE TABLE AS (the reference routes writes to the
+        # connector named in the qualified table name; flat namespace
+        # here routes to a designated writable connector)
+        self.write_connector: Optional[str] = None
 
-    def register(self, name: str, connector) -> None:
+    def register(self, name: str, connector, writable: bool = False) -> None:
         self._connectors[name] = connector
+        if writable or (self.write_connector is None and hasattr(connector, "create_table")):
+            self.write_connector = name
 
     def connector(self, name: str):
         return self._connectors[name]

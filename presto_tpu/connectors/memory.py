@@ -42,6 +42,13 @@ class MemoryConnector:
                 if t.is_string:
                     self._dicts[name][col] = b.dictionary
 
+    def append_pages(self, name: str, pages: Sequence[Page]) -> None:
+        self._tables[name].extend(pages)
+
+    def drop_table(self, name: str) -> None:
+        for d in (self._tables, self._schemas, self._domains, self._pks, self._dicts):
+            d.pop(name, None)
+
     def load_from(self, conn, table: str, name: Optional[str] = None,
                   columns: Optional[List[str]] = None) -> None:
         """Copy a table from another connector onto the device (CTAS).

@@ -23,14 +23,16 @@ from presto_tpu.types import BIGINT, VARCHAR, Type
 
 class QueryRunner:
     def __init__(self, catalog: Catalog, session: Optional[Session] = None, jit: bool = True,
-                 memory_pool=None):
+                 memory_pool=None, access_control=None):
         from presto_tpu.events import EventListenerManager
+        from presto_tpu.security import AccessControl
 
         self.catalog = catalog
         self.session = session or Session()
         self.binder = Binder(catalog)
         self._jit_default = jit
         self.memory_pool = memory_pool
+        self.access_control = access_control or AccessControl()
         self.events = EventListenerManager()
         self.executor = self._make_executor()
         # plan cache: repeated executions of the same SQL reuse the same
@@ -72,7 +74,9 @@ class QueryRunner:
                 QueryCreatedEvent(qid, sql, self.session.user, t0)
             )
             try:
-                res = self.executor.run(self._plan_cached(sql, stmt))
+                plan = self._plan_cached(sql, stmt)
+                self._check_access(plan)
+                res = self.executor.run(plan)
             except Exception as e:
                 self.events.query_completed(QueryCompletedEvent(
                     qid, sql, self.session.user, "FAILED", t0, time.time(),
@@ -114,6 +118,17 @@ class QueryRunner:
                 ["name", "value", "default", "description"], [VARCHAR] * 4, rows
             )
 
+        if isinstance(stmt, (ast.CreateTableAs, ast.InsertInto)):
+            return self._write(stmt)
+
+        if isinstance(stmt, ast.DropTable):
+            handle = self.catalog.resolve(stmt.name)
+            conn = self.catalog.connector(handle.connector_name)
+            if not hasattr(conn, "drop_table"):
+                raise ValueError(f"connector {handle.connector_name} is read-only")
+            conn.drop_table(stmt.name)
+            return MaterializedResult(["result"], [VARCHAR], [("DROP TABLE",)])
+
         if isinstance(stmt, ast.ShowTables):
             names = sorted(
                 t
@@ -129,12 +144,48 @@ class QueryRunner:
 
         raise ValueError(f"unsupported statement {stmt!r}")
 
+    def _write(self, stmt) -> MaterializedResult:
+        """CTAS / INSERT (TableWriterOperator + TableFinishOperator
+        analog: the query result lands in the writable connector and
+        the row count is returned)."""
+        import numpy as np
+
+        plan = self.binder.plan_ast(stmt.query)
+        self._check_access(plan)
+        self.access_control.check_can_write(self.session.user, stmt.name)
+        page = self.executor.run_to_page(plan).compact_host()
+        rows = int(np.asarray(page.num_rows()))
+
+        if isinstance(stmt, ast.CreateTableAs):
+            if self.catalog.write_connector is None:
+                raise ValueError("no writable connector registered")
+            conn = self.catalog.connector(self.catalog.write_connector)
+            schema = list(zip(plan.output_names, plan.output_types))
+            conn.create_table(stmt.name, schema, [page])
+        else:
+            handle = self.catalog.resolve(stmt.name)
+            conn = self.catalog.connector(handle.connector_name)
+            if not hasattr(conn, "append_pages"):
+                raise ValueError(f"connector {handle.connector_name} is read-only")
+            want = [c.type for c in handle.columns]
+            got = plan.output_types
+            if [t.name for t in want] != [t.name for t in got]:
+                raise ValueError(f"INSERT schema mismatch: {want} vs {got}")
+            conn.append_pages(stmt.name, [page])
+        return MaterializedResult(["rows"], [BIGINT], [(rows,)])
+
     def _plan_cached(self, sql: str, q: ast.Query):
         plan = self._plans.get(sql)
         if plan is None:
             plan = self.binder.plan_ast(q)
             self._plans[sql] = plan
         return plan
+
+    def _check_access(self, plan) -> None:
+        from presto_tpu.security import scan_tables
+
+        for table in scan_tables(plan):
+            self.access_control.check_can_select(self.session.user, table)
 
     def explain(self, sql: str) -> str:
         return self.executor.explain(self.plan(sql))

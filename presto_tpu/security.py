@@ -1,0 +1,71 @@
+"""Access control.
+
+Reference analog: ``security/AccessControlManager.java`` +
+``FileBasedSystemAccessControl`` (rule-list policies) and the
+ConnectorAccessControl SPI.  Checks run against the tables a plan
+actually touches, before execution.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import List, Optional, Tuple
+
+
+class AccessDeniedError(Exception):
+    def __init__(self, user: str, action: str, table: str):
+        super().__init__(f"Access Denied: user {user} cannot {action} table {table}")
+        self.user = user
+        self.action = action
+        self.table = table
+
+
+class AccessControl:
+    """Default: allow everything (AllowAllAccessControl)."""
+
+    def check_can_select(self, user: str, table: str) -> None:
+        pass
+
+    def check_can_write(self, user: str, table: str) -> None:
+        pass
+
+
+class RuleBasedAccessControl(AccessControl):
+    """First-match rule list: (user glob, table glob, allow, writable)
+    — the file-based system access control's model."""
+
+    def __init__(self, rules: List[Tuple[str, str, bool, bool]]):
+        self.rules = rules
+
+    def _find(self, user: str, table: str) -> Optional[Tuple[bool, bool]]:
+        for user_pat, table_pat, allow, writable in self.rules:
+            if fnmatch.fnmatch(user, user_pat) and fnmatch.fnmatch(table, table_pat):
+                return allow, writable
+        return None
+
+    def check_can_select(self, user: str, table: str) -> None:
+        hit = self._find(user, table)
+        if hit is None or not hit[0]:
+            raise AccessDeniedError(user, "select from", table)
+
+    def check_can_write(self, user: str, table: str) -> None:
+        hit = self._find(user, table)
+        if hit is None or not hit[0] or not hit[1]:
+            raise AccessDeniedError(user, "write to", table)
+
+
+def scan_tables(plan) -> List[str]:
+    """All table names a plan reads."""
+    from presto_tpu.planner.plan import TableScanNode
+
+    out: List[str] = []
+
+    def walk(node):
+        if isinstance(node, TableScanNode):
+            out.append(node.handle.table)
+        for s in node.sources:
+            walk(s)
+
+    walk(plan)
+    return out

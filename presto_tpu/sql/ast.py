@@ -231,6 +231,23 @@ class ShowSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateTableAs(Node):
+    name: str
+    query: Node  # Query | Union
+
+
+@dataclasses.dataclass(frozen=True)
+class InsertInto(Node):
+    name: str
+    query: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Union(Node):
     left: Node  # Query or Union
     right: Node

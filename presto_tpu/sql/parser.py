@@ -36,6 +36,7 @@ KEYWORDS = {
     "for", "nulls", "first", "last", "all", "any", "union",
     "over", "partition",
     "explain", "analyze", "set", "session", "show", "tables", "columns",
+    "create", "table", "insert", "into", "drop",
 }
 
 
@@ -539,6 +540,24 @@ def parse_statement(sql: str) -> ast.Node:
             raise SyntaxError(f"bad SET SESSION value {t!r}")
         p.accept(";")
         return ast.SetSession(name, value)
+    if p.accept("create"):
+        p.expect("table")
+        name = p.ident()
+        p.expect("as")
+        q = p._query()
+        p.accept(";")
+        return ast.CreateTableAs(name, q)
+    if p.accept("insert"):
+        p.expect("into")
+        name = p.ident()
+        q = p._query()
+        p.accept(";")
+        return ast.InsertInto(name, q)
+    if p.accept("drop"):
+        p.expect("table")
+        name = p.ident()
+        p.accept(";")
+        return ast.DropTable(name)
     if p.accept("show"):
         if p.accept("tables"):
             p.accept(";")
