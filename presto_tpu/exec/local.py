@@ -127,6 +127,25 @@ class GroupCapacityExceeded(Exception):
         self.needed = needed
 
 
+def _split_pruned(constraints, stats) -> bool:
+    """True if split min/max stats prove no row can satisfy ALL the
+    pushed-down conjuncts (ORC stripe-stats pruning role)."""
+    for col, op, v in constraints:
+        st = stats.get(col)
+        if st is None:
+            continue
+        lo, hi = st
+        if (
+            (op == "eq" and (v < lo or v > hi))
+            or (op == "lt" and lo >= v)
+            or (op == "le" and lo > v)
+            or (op == "gt" and hi <= v)
+            or (op == "ge" and hi < v)
+        ):
+            return True
+    return False
+
+
 def _is_streaming_join(node: JoinNode) -> bool:
     """True when the probe is row-aligned (jittable in a chain):
     semi/anti (presence tests) or unique-key builds."""
@@ -425,6 +444,10 @@ class LocalRunner:
             idx = list(node.columns)
             splits = node.splits if node.splits is not None else range(node.handle.num_splits)
             for split in splits:
+                if node.constraints and hasattr(conn, "split_stats"):
+                    stats = conn.split_stats(node.handle.table, split)
+                    if _split_pruned(node.constraints, stats):
+                        continue
                 page = conn.page_for_split(
                     node.handle.table, split, capacity=self.split_capacity
                 )

@@ -81,6 +81,9 @@ class TableScanNode(PlanNode):
     handle: TableHandle
     columns: List[int]
     splits: Optional[List[int]] = None
+    # simple pushed-down range constraints (col, op, device-repr value)
+    # for stats-based split pruning (TupleDomain pushdown analog)
+    constraints: List[Tuple[str, str, int]] = dataclasses.field(default_factory=list)
 
     @property
     def channels(self) -> List[Channel]:

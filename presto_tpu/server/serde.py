@@ -120,6 +120,7 @@ def plan_to_json(node: PlanNode) -> dict:
             "table": node.handle.table,
             "columns": list(node.columns),
             "splits": node.splits,
+            "constraints": [list(c) for c in node.constraints],
         }
     if isinstance(node, FilterNode):
         return {"k": "filter", "src": plan_to_json(node.source),
@@ -182,7 +183,10 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
     k = d["k"]
     if k == "scan":
         handle = catalog.resolve(d["table"])
-        return TableScanNode(handle, list(d["columns"]), d.get("splits"))
+        return TableScanNode(
+            handle, list(d["columns"]), d.get("splits"),
+            constraints=[tuple(c) for c in d.get("constraints", [])],
+        )
     if k == "filter":
         return FilterNode(plan_from_json(d["src"], catalog), expr_from_json(d["pred"]))
     if k == "project":

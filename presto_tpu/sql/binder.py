@@ -57,6 +57,9 @@ AGG_FUNCTIONS = {
     "sum", "avg", "count", "min", "max",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every",
+    # approx_distinct is exact here (distinct rewrite) — better accuracy
+    # than the reference's HLL at the cost of a wider shuffle
+    "approx_distinct",
 }
 
 # Correlated bindings mark outer-scope columns with this offset so a
@@ -431,7 +434,9 @@ class Binder:
             elif len(tset) == 1:
                 i = tset[0]
                 mapping = {r: r - terms[i].offset for r in expr_refs(ir)}
-                terms[i].node = FilterNode(terms[i].node, remap_expr(ir, mapping))
+                local = remap_expr(ir, mapping)
+                terms[i].node = FilterNode(terms[i].node, local)
+                self._push_scan_constraints(terms[i].node, local)
             elif (
                 len(tset) == 2
                 and isinstance(ir, Call) and ir.fn == "eq"
@@ -515,6 +520,43 @@ class Binder:
             if not used[k]:
                 post.append(ir)
         return node, g2c
+
+    def _push_scan_constraints(self, node: PlanNode, ir: Expr) -> None:
+        """Record simple (col cmp literal) conjuncts on the underlying
+        scan for stats-based split pruning (PickTableLayout /
+        TupleDomain-pushdown analog)."""
+        scan = node
+        while isinstance(scan, FilterNode):
+            scan = scan.source
+        if not isinstance(scan, TableScanNode):
+            return
+        names = [scan.handle.columns[i].name for i in scan.columns]
+        flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+
+        def emit(op: str, col: ColumnRef, lit: Literal):
+            if lit.value is not None and not col.type.is_string:
+                scan.constraints.append((names[col.index], op, lit.value))
+
+        def walk(e: Expr):
+            if not isinstance(e, Call):
+                return
+            if e.fn == "and":
+                walk(e.args[0])
+                walk(e.args[1])
+                return
+            if e.fn in ("eq", "lt", "le", "gt", "ge") and len(e.args) == 2:
+                a, b = e.args
+                if isinstance(a, ColumnRef) and isinstance(b, Literal):
+                    emit(e.fn, a, b)
+                elif isinstance(b, ColumnRef) and isinstance(a, Literal):
+                    emit(flip[e.fn], b, a)
+            elif e.fn == "between" and isinstance(e.args[0], ColumnRef):
+                if isinstance(e.args[1], Literal):
+                    emit("ge", e.args[0], e.args[1])
+                if isinstance(e.args[2], Literal):
+                    emit("le", e.args[0], e.args[2])
+
+        walk(ir)
 
     # ------------------------------------------------------------------
     def _estimate(self, node: PlanNode) -> float:
@@ -1335,7 +1377,10 @@ class Binder:
         if len(e.args) != 1:
             raise BindError(f"aggregate {e.name} takes one argument")
         arg = self._bind(e.args[0], scope)
-        a = AggCall(fn=e.name, arg=arg, type=arg.type, distinct=e.distinct)
+        fn, distinct = e.name, e.distinct
+        if fn == "approx_distinct":
+            fn, distinct = "count", True
+        a = AggCall(fn=fn, arg=arg, type=arg.type, distinct=distinct)
         a = AggCall(fn=a.fn, arg=a.arg, type=output_type(a), distinct=a.distinct)
         return agg.agg_ref(a)
 

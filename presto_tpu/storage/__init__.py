@@ -1,0 +1,1 @@
+from presto_tpu.storage.columnfile import FileConnector, write_table  # noqa: F401

@@ -1,0 +1,79 @@
+"""Columnar file storage + split pruning tests.
+
+Reference analogs: presto-orc (columnar reader/writer with stripe
+stats pruning), presto-raptor (native storage), local-file connector."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+from presto_tpu.storage import FileConnector, write_table
+
+
+@pytest.fixture()
+def stored(tmp_path):
+    """TPC-H orders written to disk, one split per generator split."""
+    tpch = Tpch(sf=0.002, split_rows=512)
+    schema = tpch.schema("orders")
+    pages = [tpch.page_for_split("orders", s) for s in range(tpch.num_splits("orders"))]
+    write_table(str(tmp_path), "orders_disk", schema, pages)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    catalog.register("file", FileConnector(str(tmp_path)))
+    return QueryRunner(catalog), tpch
+
+
+def test_roundtrip_counts(stored):
+    runner, tpch = stored
+    a = runner.execute("select count(*), sum(o_totalprice) from orders_disk").rows
+    b = runner.execute("select count(*), sum(o_totalprice) from orders").rows
+    assert a == b
+
+
+def test_strings_roundtrip(stored):
+    runner, _ = stored
+    a = sorted(runner.execute("select o_orderpriority, count(*) from orders_disk group by o_orderpriority").rows)
+    b = sorted(runner.execute("select o_orderpriority, count(*) from orders group by o_orderpriority").rows)
+    assert a == b
+
+
+def test_split_pruning(stored):
+    runner, _ = stored
+    # o_orderkey is monotonically increasing across splits, so a tight
+    # key range must prune most splits
+    plan = runner.plan("select count(*) from orders_disk where o_orderkey < 100")
+    from presto_tpu.planner.plan import TableScanNode
+
+    def find_scan(n):
+        if isinstance(n, TableScanNode):
+            return n
+        for s in n.sources:
+            r = find_scan(s)
+            if r is not None:
+                return r
+        return None
+
+    scan = find_scan(plan)
+    assert scan.constraints  # pushdown recorded
+    res = runner.executor.run(plan)
+    expected = runner.execute("select count(*) from orders where o_orderkey < 100").rows
+    assert res.rows == expected
+
+    # verify pruning actually skips splits
+    conn = runner.catalog.connector("file")
+    from presto_tpu.exec.local import _split_pruned
+
+    pruned = sum(
+        _split_pruned(scan.constraints, conn.split_stats("orders_disk", s))
+        for s in range(conn.num_splits("orders_disk"))
+    )
+    assert pruned >= conn.num_splits("orders_disk") - 1
+
+
+def test_domains_from_stats(stored):
+    runner, _ = stored
+    conn = runner.catalog.connector("file")
+    dom = conn.column_domain("orders_disk", "o_orderkey")
+    assert dom is not None and dom[0] >= 1
