@@ -136,8 +136,15 @@ def _run_child(env_extra: dict, timeout: float) -> dict:
     raise RuntimeError(f"child rc={proc.returncode}, no result marker")
 
 
-def _attempt(env_extra: dict, timeout: float, label: str, tries: int = 2):
+def _attempt(env_extra: dict, timeout_fn, label: str, tries: int = 2):
+    """timeout_fn is re-evaluated per try so a timed-out first try
+    shrinks the second try's budget instead of overshooting the overall
+    deadline (which would get the parent killed before it reports)."""
     for i in range(tries):
+        timeout = timeout_fn()
+        if timeout < 30:
+            log(f"{label} attempt {i+1}: skipped, {timeout:.0f}s left in budget")
+            return None
         try:
             res = _run_child(env_extra, timeout)
             if res.get("rates"):
@@ -192,13 +199,19 @@ def main():
     def budget(want: float) -> float:
         return max(min(want, _remaining(deadline)), 1.0)
 
+    # probes are capped to a quarter of the remaining budget each so two
+    # hung probes can never starve the CPU-fallback measurement
+    def probe_budget():
+        return max(min(180.0, _remaining(deadline) * 0.25), 1.0)
+
     result = None
-    if _probe_backend(timeout=budget(180)) or _probe_backend(timeout=budget(180)):
-        result = _attempt({}, budget(timeout), "measure(default platform)")
-        if result is None and _remaining(deadline) > 60:
-            result = _attempt({}, budget(timeout), "measure(default platform, retry)", tries=1)
-    if result is None and _remaining(deadline) > 60:
-        result = _attempt({"JAX_PLATFORMS": "cpu"}, budget(timeout), "measure(cpu fallback)", tries=1)
+    if _probe_backend(timeout=probe_budget()) or _probe_backend(timeout=probe_budget()):
+        result = _attempt({}, lambda: budget(timeout), "measure(default platform)")
+    if result is None:
+        result = _attempt(
+            {"JAX_PLATFORMS": "cpu"}, lambda: budget(timeout), "measure(cpu fallback)",
+            tries=1,
+        )
 
     # ---- baseline: engine-on-CPU rows/s, measured & cached -----------
     # Only a baseline covering every bench query is cached/used as-is;
@@ -216,7 +229,9 @@ def main():
             log(f"baseline cache unreadable: {e}")
     if baseline is None and result is not None and result.get("platform") != "cpu" \
             and _remaining(deadline) > 60:
-        baseline = _attempt({"JAX_PLATFORMS": "cpu"}, budget(timeout), "baseline(cpu)", tries=1)
+        baseline = _attempt(
+            {"JAX_PLATFORMS": "cpu"}, lambda: budget(timeout), "baseline(cpu)", tries=1
+        )
         if baseline is not None and not baseline.get("errors"):
             try:
                 with open(BASELINE_FILE, "w") as f:

@@ -118,6 +118,11 @@ class QueryStats:
         )
 
 
+# Ceiling for capacity-doubling retries, shared by the local, mesh
+# (parallel/dist.py) and multi-host (parallel/multihost.py) runners.
+MAX_AGG_GROUPS = 1 << 26
+
+
 class GroupCapacityExceeded(Exception):
     """An aggregation saw more groups than its static capacity; the
     runner retries the query with a doubled max_groups (the analog of
@@ -619,7 +624,7 @@ class LocalRunner:
         if not node.group_exprs or self._exact_capacity(node, mg):
             return
         live = int(np.asarray(jnp.sum(out.row_mask.astype(jnp.int32))))
-        if live >= mg and mg < (1 << 26):
+        if live >= mg and mg < MAX_AGG_GROUPS:
             self._agg_overrides[node] = mg * 2
             self._chain_cache.clear()
             self._fold_cache.clear()

@@ -76,7 +76,7 @@ def state_types(agg: AggCall) -> List[Type]:
     if agg.fn in ("min", "max"):
         return [t, BIGINT]
     if agg.fn in VARIANCE_FNS:
-        return [DOUBLE, DOUBLE, BIGINT]  # sum, sum of squares, count
+        return [DOUBLE, DOUBLE, BIGINT]  # sum, M2 (Σ(x-mean)²), count
     if agg.fn in ("bool_and", "bool_or", "every"):
         return [BIGINT, BIGINT]  # count of true, count of non-null
     raise KeyError(f"unknown aggregate {agg.fn}")
@@ -148,10 +148,17 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
         elif agg.fn in VARIANCE_FNS:
             from presto_tpu.expr.compile import _to_double
 
+            # Welford-style state (count, mean, M2) per the reference's
+            # AggregationUtils.updateVarianceState — s2/n - mean² loses
+            # all precision when |mean| >> stddev.  Two passes: segment
+            # mean first, then mean-relative second moment.
             x = jnp.where(nonnull, _to_double(data, agg.arg.type), 0.0)
             s = _seg_sum(x, gid_nn, n + 1)[:n]
-            s2 = _seg_sum(x * x, gid_nn, n + 1)[:n]
-            out.append([s, s2, cnt])
+            mu = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+            mu_row = mu[jnp.clip(gid_nn, 0, n - 1)]
+            dx = jnp.where(nonnull, x - mu_row, 0.0)
+            m2 = _seg_sum(dx * dx, gid_nn, n + 1)[:n]
+            out.append([s, m2, cnt])
         elif agg.fn in ("bool_and", "bool_or", "every"):
             t = _seg_sum((nonnull & data.astype(jnp.bool_)).astype(jnp.int64),
                          gid_nn, n + 1)[:n]
@@ -184,7 +191,17 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
                 _seg_sum(cols[1], gid, n + 1)[:n],
             ])
         elif agg.fn in VARIANCE_FNS:
-            out.append([_seg_sum(c, gid, n + 1)[:n] for c in cols])
+            # Chan's pairwise combination generalized to k partials:
+            # M2 = Σ M2ᵢ + Σ cᵢ·(μᵢ − μ)²  with μ the combined mean.
+            s_i, m2_i, c_i = cols
+            s = _seg_sum(s_i, gid, n + 1)[:n]
+            cnt = _seg_sum(c_i, gid, n + 1)[:n]
+            mu = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+            cf_i = c_i.astype(jnp.float64)
+            mu_i = s_i / jnp.maximum(cf_i, 1.0)
+            dev = jnp.where(c_i > 0, mu_i - mu[jnp.clip(gid, 0, n - 1)], 0.0)
+            m2 = _seg_sum(m2_i + cf_i * dev * dev, gid, n + 1)[:n]
+            out.append([s, m2, cnt])
         elif agg.fn in ("bool_and", "bool_or", "every"):
             out.append([_seg_sum(c, gid, n + 1)[:n] for c in cols])
     return out
@@ -211,10 +228,9 @@ def _finalize(states: List[List[jax.Array]], aggs) -> List[Block]:
             m, cnt = cols
             blocks.append(Block(m.astype(t.np_dtype), cnt > 0, t))
         elif agg.fn in VARIANCE_FNS:
-            s, s2, cnt = cols
+            s, m2, cnt = cols
             n = jnp.maximum(cnt, 1).astype(jnp.float64)
-            mean = s / n
-            pop_var = jnp.maximum(s2 / n - mean * mean, 0.0)
+            pop_var = jnp.maximum(m2 / n, 0.0)
             sample = agg.fn in ("stddev", "stddev_samp", "variance", "var_samp")
             if sample:
                 var = pop_var * n / jnp.maximum(n - 1, 1)
@@ -445,10 +461,15 @@ def merge_aggregate(
     max_groups: int,
     key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
     mode: str = "single",
+    return_count: bool = False,
 ) -> Page:
     """Final aggregation over a page of partial states (group keys in
     the first ``num_keys`` blocks, then state columns in
-    ``state_types`` order)."""
+    ``state_types`` order).
+
+    With ``return_count=True`` returns (page, num_groups) so callers can
+    detect ``num_groups > max_groups`` truncation and retry larger —
+    the distributed counterpart of LocalRunner._check_overflow."""
     live = partial.row_mask
     datas = [partial.blocks[i].data for i in range(num_keys)]
     valids = [partial.blocks[i].valid for i in range(num_keys)]
@@ -472,7 +493,8 @@ def merge_aggregate(
     if num_keys == 0:
         gid = jnp.where(live, 0, 1).astype(jnp.int32)
         merged = _merge_states(state_cols, aggs, gid, 1)
-        return _emit([], merged, aggs, jnp.ones(1, jnp.bool_), mode, group_exprs, key_dicts)
+        out = _emit([], merged, aggs, jnp.ones(1, jnp.bool_), mode, group_exprs, key_dicts)
+        return (out, jnp.ones((), jnp.int32)) if return_count else out
 
     key, exact = pack_or_hash_keys(datas, valids, key_domains)
     gid, num_groups, rep_rows = _sorted_group_ids(key, live, max_groups)
@@ -481,4 +503,5 @@ def merge_aggregate(
     for d, v, t, dic in zip(datas, valids, key_types, key_dicts):
         key_blocks.append(Block(d[rep_rows].astype(t.np_dtype), v[rep_rows], t, dic))
     out_mask = jnp.arange(max_groups) < num_groups
-    return _emit(key_blocks, merged, aggs, out_mask, mode, group_exprs, key_dicts)
+    out = _emit(key_blocks, merged, aggs, out_mask, mode, group_exprs, key_dicts)
+    return (out, num_groups) if return_count else out

@@ -30,7 +30,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from presto_tpu.catalog import Catalog
-from presto_tpu.exec.local import LocalRunner, MaterializedResult, concat_pages_device
+from presto_tpu.exec.local import (
+    MAX_AGG_GROUPS,
+    GroupCapacityExceeded,
+    LocalRunner,
+    MaterializedResult,
+    concat_pages_device,
+)
 from presto_tpu.expr.ir import ColumnRef
 from presto_tpu.ops.aggregate import grouped_aggregate, merge_aggregate
 from presto_tpu.page import Block, Page, concat_pages_host
@@ -80,8 +86,14 @@ class DistributedRunner:
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
         self.local = LocalRunner(catalog)
-        self._wave_fns: Dict[PlanNode, object] = {}
-        self._final_fns: Dict[PlanNode, object] = {}
+        # persistent un-jitted runner for stage building/builds: its
+        # _agg_overrides must survive GroupCapacityExceeded retries
+        # (a build-side aggregation overflow records its doubled
+        # capacity here; a throwaway runner would loop forever)
+        self._stage_runner = LocalRunner(catalog, jit=False)
+        self._wave_fns: Dict[Tuple[PlanNode, int], object] = {}
+        self._final_fns: Dict[Tuple[PlanNode, int], object] = {}
+        self._mg_overrides: Dict[PlanNode, int] = {}
 
     @property
     def n(self) -> int:
@@ -125,10 +137,31 @@ class DistributedRunner:
 
     # ------------------------------------------------------------------
     def run_aggregation_stage(self, agg: AggregationNode) -> Page:
-        """Distributed scan->chain->partial agg->exchange->final merge;
-        returns the merged result page (host-concatenated shards)."""
+        """Distributed scan->chain->partial agg->exchange->final merge
+        with group-overflow detection: every shard_map'd stage returns
+        its live-group count (and the exchange its bucket fill); the
+        host checks them and retries the stage with doubled max_groups,
+        exactly as LocalRunner._check_overflow does locally (reference
+        rehash: MultiChannelGroupByHash.java:138-145 tryRehash)."""
+        while True:
+            try:
+                return self._run_aggregation_stage_once(agg)
+            except GroupCapacityExceeded:
+                continue  # _mg_overrides updated; re-execute
+
+    def _overflow(self, agg: AggregationNode, mg: int) -> None:
+        if mg >= MAX_AGG_GROUPS:
+            raise RuntimeError(
+                f"distributed aggregation exceeded {MAX_AGG_GROUPS} groups per device"
+            )
+        self._mg_overrides[agg] = mg * 2
+        self._wave_fns.pop((agg, mg), None)
+        self._final_fns.pop((agg, mg), None)
+        raise GroupCapacityExceeded(mg * 2)
+
+    def _run_aggregation_stage_once(self, agg: AggregationNode) -> Page:
         n = self.n
-        runner = LocalRunner(self.catalog, jit=False)
+        runner = self._stage_runner
         joins: List[PlanNode] = []
         stage = runner._build_stage(agg.source, joins)
         leaf = runner._chain_leaf(agg.source)
@@ -146,7 +179,9 @@ class DistributedRunner:
             f"build_{i}": runner._materialize_build(j) for i, j in enumerate(joins)
         }
 
-        mg = runner._max_groups(agg)
+        mg = self._mg_overrides.get(agg) or runner._max_groups(agg)
+        # exact capacity (key-domain product fits mg) cannot truncate
+        check = bool(agg.group_exprs) and not runner._exact_capacity(agg, mg)
         group_exprs = list(agg.group_exprs)
         aggs = list(agg.aggs)
         nk = len(group_exprs)
@@ -162,21 +197,27 @@ class DistributedRunner:
             page = _squeeze(page1)
             acc = _squeeze(acc1)
             p = stage(page, consts_r)
-            part = grouped_aggregate(p, group_exprs, aggs, mg, key_domains=kd, mode="partial")
+            part, c1 = grouped_aggregate(
+                p, group_exprs, aggs, mg, key_domains=kd, mode="partial",
+                return_count=True,
+            )
             cand = concat_pages_device([acc, part])
-            acc2 = merge_aggregate(cand, nk, aggs, mg, key_domains=kd, mode="partial")
-            return _unsqueeze(acc2)
+            acc2, c2 = merge_aggregate(
+                cand, nk, aggs, mg, key_domains=kd, mode="partial",
+                return_count=True,
+            )
+            return _unsqueeze(acc2), jnp.maximum(c1, c2)[None]
 
-        wave_fn = self._wave_fns.get(agg)
+        wave_fn = self._wave_fns.get((agg, mg))
         if wave_fn is None:
             wave_fn = jax.jit(
                 jax.shard_map(
                     per_device_wave, mesh=mesh,
                     in_specs=(P(axis), P(axis), P()),
-                    out_specs=P(axis),
+                    out_specs=(P(axis), P(axis)),
                 )
             )
-            self._wave_fns[agg] = wave_fn
+            self._wave_fns[(agg, mg)] = wave_fn
 
         # ---- split scheduling: device d takes split w*n + d ----------
         conn = self.catalog.connector(leaf.handle.connector_name)
@@ -189,6 +230,7 @@ class DistributedRunner:
 
         acc = self._initial_acc(partial_channels, mg, n, sharding)
         waves = math.ceil(n_splits / n)
+        wave_counts = []
         for w in range(waves):
             pages = []
             for d in range(n):
@@ -207,7 +249,12 @@ class DistributedRunner:
                     )
                 pages.append(pg)
             stacked = jax.device_put(_stack_pages(pages), sharding)
-            acc = wave_fn(stacked, acc, consts)
+            acc, cnts = wave_fn(stacked, acc, consts)
+            wave_counts.append(cnts)
+        if check and wave_counts:
+            peak = max(int(np.asarray(jax.device_get(c)).max()) for c in wave_counts)
+            if peak >= mg:
+                self._overflow(agg, mg)
 
         # ---- exchange + final merge ----------------------------------
         if nk == 0:
@@ -222,20 +269,27 @@ class DistributedRunner:
         def per_device_final(acc1):
             acc_l = _squeeze(acc1)
             target = partition_targets(acc_l, key_refs, n, kd)
-            bucketized, _ = partition_for_exchange(acc_l, target, n, bucket_cap=mg)
+            bucketized, fill = partition_for_exchange(acc_l, target, n, bucket_cap=mg)
             ex = exchange_page(bucketized, axis)
-            merged = merge_aggregate(ex, nk, aggs, mg, key_domains=kd, mode="single")
-            return _unsqueeze(merged)
+            merged, cnt = merge_aggregate(
+                ex, nk, aggs, mg, key_domains=kd, mode="single", return_count=True
+            )
+            return _unsqueeze(merged), jnp.maximum(fill, cnt)[None]
 
-        final_fn = self._final_fns.get(agg)
+        final_fn = self._final_fns.get((agg, mg))
         if final_fn is None:
             final_fn = jax.jit(
                 jax.shard_map(
-                    per_device_final, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+                    per_device_final, mesh=mesh, in_specs=P(axis),
+                    out_specs=(P(axis), P(axis)),
                 )
             )
-            self._final_fns[agg] = final_fn
-        out = final_fn(acc)
+            self._final_fns[(agg, mg)] = final_fn
+        out, fills = final_fn(acc)
+        if check and int(np.asarray(jax.device_get(fills)).max()) >= mg:
+            # a bucket overfilled in the exchange, or the post-exchange
+            # merge saw >= mg distinct groups on some device
+            self._overflow(agg, mg)
         out_channels = agg.channels
         host_pages = _unstack_pages(jax.device_get(out), out_channels)
         return concat_pages_host(host_pages)

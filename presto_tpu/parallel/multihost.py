@@ -121,23 +121,7 @@ class MultiHostRunner:
             raise MultiHostUnsupported("non-single aggregation")
 
         scan = self._leaf_scan(agg.source)
-        partial = AggregationNode(
-            source=agg.source, group_exprs=agg.group_exprs,
-            group_names=agg.group_names, aggs=agg.aggs, agg_names=agg.agg_names,
-            step="partial", max_groups=agg.max_groups,
-        )
-
-        partial_pages = self._run_fragments(partial, scan)
-
-        final = AggregationNode(
-            source=PrecomputedNode(
-                page=concat_pages_device(partial_pages), channel_list=partial.channels
-            ),
-            group_exprs=[_key_ref(partial, i) for i in range(len(agg.group_exprs))],
-            group_names=agg.group_names, aggs=agg.aggs, agg_names=agg.agg_names,
-            step="final", max_groups=agg.max_groups,
-        )
-        merged = self.local._execute_to_page(final)
+        merged = self._run_agg_with_retry(agg, scan)
 
         pre = PrecomputedNode(page=merged, channel_list=agg.channels)
         if not path:
@@ -151,6 +135,59 @@ class MultiHostRunner:
             return self.local.run(plan)
         finally:
             parent.source = original
+
+    def _run_agg_with_retry(self, agg: AggregationNode, scan: TableScanNode):
+        """Worker partial aggs truncate silently at max_groups (static
+        shapes), so the coordinator checks every returned partial page's
+        live-row count and the final merge's capacity, retrying the
+        whole stage with doubled max_groups — the DCN counterpart of
+        LocalRunner._check_overflow."""
+        import numpy as np
+
+        from presto_tpu.exec.local import MAX_AGG_GROUPS, GroupCapacityExceeded
+
+        def grow(mg: int) -> int:
+            if mg >= MAX_AGG_GROUPS:
+                raise RuntimeError(
+                    f"distributed aggregation exceeded {MAX_AGG_GROUPS} groups"
+                )
+            return mg * 2
+
+        mg = self.local._max_groups(agg)
+        check = bool(agg.group_exprs) and not self.local._exact_capacity(agg, mg)
+        while True:
+            partial = AggregationNode(
+                source=agg.source, group_exprs=agg.group_exprs,
+                group_names=agg.group_names, aggs=agg.aggs, agg_names=agg.agg_names,
+                step="partial", max_groups=mg,
+            )
+            partial_pages = self._run_fragments(partial, scan)
+            if check and any(
+                int(np.asarray(p.row_mask).sum()) >= mg for p in partial_pages
+            ):
+                mg = grow(mg)
+                continue
+
+            # partial pages stay valid at any larger merge capacity, so
+            # a final-merge overflow only re-runs the (cheap) merge —
+            # not the distributed scan fragments
+            merge_mg = mg
+            while True:
+                final = AggregationNode(
+                    source=PrecomputedNode(
+                        page=concat_pages_device(partial_pages),
+                        channel_list=partial.channels,
+                    ),
+                    group_exprs=[
+                        _key_ref(partial, i) for i in range(len(agg.group_exprs))
+                    ],
+                    group_names=agg.group_names, aggs=agg.aggs,
+                    agg_names=agg.agg_names, step="final", max_groups=merge_mg,
+                )
+                try:
+                    return self.local._execute_to_page(final)
+                except GroupCapacityExceeded:
+                    merge_mg = grow(merge_mg)
 
     def _leaf_scan(self, node: PlanNode) -> TableScanNode:
         n = self.local._chain_leaf(node)

@@ -122,6 +122,9 @@ class QueryRunner:
             return self._write(stmt)
 
         if isinstance(stmt, ast.DropTable):
+            # drops route through access control exactly like writes
+            # (AccessControlManager.checkCanDropTable analog)
+            self.access_control.check_can_write(self.session.user, stmt.name)
             handle = self.catalog.resolve(stmt.name)
             conn = self.catalog.connector(handle.connector_name)
             if not hasattr(conn, "drop_table"):
@@ -169,10 +172,51 @@ class QueryRunner:
                 raise ValueError(f"connector {handle.connector_name} is read-only")
             want = [c.type for c in handle.columns]
             got = plan.output_types
-            if [t.name for t in want] != [t.name for t in got]:
+            # name+scale equality: decimal scale decides the scaled-int
+            # representation (a name-only check would let decimal(x,3)
+            # data land in a decimal(x,2) column 10x off), but precision
+            # is metadata — expressions widen to precision 18 and their
+            # values are still valid for any column of the same scale.
+            if [(t.name, t.scale) for t in want] != [(t.name, t.scale) for t in got]:
                 raise ValueError(f"INSERT schema mismatch: {want} vs {got}")
+            page = self._recode_strings(page, handle)
             conn.append_pages(stmt.name, [page])
         return MaterializedResult(["rows"], [BIGINT], [(rows,)])
+
+    def _recode_strings(self, page, handle):
+        """Recode inserted VARCHAR blocks onto the table's dictionary so
+        appended pages and existing pages agree on code meaning; values
+        absent from the table dictionary are rejected."""
+        import numpy as np
+
+        from presto_tpu.page import Block, Page
+
+        blocks = list(page.blocks)
+        changed = False
+        for i, col in enumerate(handle.columns):
+            if not col.type.is_string:
+                continue
+            b = blocks[i]
+            dst = getattr(col, "dictionary", None)
+            if dst is None or b.dictionary is dst:
+                continue
+            src = b.dictionary
+            codes = np.asarray(b.data)
+            valid = np.asarray(b.valid) & np.asarray(page.row_mask)
+            # O(|dictionary|) remap table + vectorized gather
+            remap = np.asarray([dst.code_of(v) for v in src.values], np.int64)
+            in_range = (codes >= 0) & (codes < len(remap))
+            new_codes = np.where(in_range, remap[np.clip(codes, 0, len(remap) - 1)], -1)
+            bad = valid & (new_codes < 0)
+            if bad.any():
+                j = int(np.nonzero(bad)[0][0])
+                val = src.values[codes[j]] if in_range[j] else codes[j]
+                raise ValueError(
+                    f"INSERT value {val!r} not in dictionary of column {col.name}"
+                )
+            blocks[i] = Block(new_codes.astype(codes.dtype), b.valid, b.type, dst)
+            changed = True
+        return Page(tuple(blocks), page.row_mask) if changed else page
 
     def _plan_cached(self, sql: str, q: ast.Query):
         plan = self._plans.get(sql)

@@ -116,9 +116,11 @@ class CoordinatorServer:
                 parts = [p for p in self.path.split("/") if p]
                 if len(parts) >= 3 and parts[:2] == ["v1", "statement"]:
                     q = outer.queries.get(parts[2])
-                    if q is not None and not q.done.is_set():
-                        q.state = "CANCELED"
-                        q.done.set()
+                    if q is not None:
+                        with outer._lock:
+                            if q.state in ("QUEUED", "RUNNING"):
+                                q.state = "CANCELED"
+                                q.done.set()
                     self._json(204, {})
                     return
                 self._json(404, {"error": "not found"})
@@ -151,21 +153,35 @@ class CoordinatorServer:
             try:
                 group.acquire(timeout=600)
             except Exception as e:
-                q.error = f"{type(e).__name__}: {e}"
-                q.state = "FAILED"
+                with self._lock:
+                    if q.state == "QUEUED":
+                        q.error = f"{type(e).__name__}: {e}"
+                        q.state = "FAILED"
                 q.done.set()
                 return
-            q.state = "RUNNING"
+            with self._lock:
+                if q.state != "QUEUED":  # canceled while queued
+                    group.release()
+                    q.done.set()
+                    return
+                q.state = "RUNNING"
             try:
                 res = self.runner.execute(sql)
-                q.columns = [
+                cols = [
                     {"name": n, "type": repr(t)} for n, t in zip(res.names, res.types)
                 ]
-                q.rows = res.rows
-                q.state = "FINISHED"
+                # CANCELED is terminal: a DELETE that raced this query's
+                # completion must not be resurrected to FINISHED/FAILED
+                with self._lock:
+                    if q.state == "RUNNING":
+                        q.columns = cols
+                        q.rows = res.rows
+                        q.state = "FINISHED"
             except Exception as e:  # surfaces to the client as error
-                q.error = f"{type(e).__name__}: {e}"
-                q.state = "FAILED"
+                with self._lock:
+                    if q.state == "RUNNING":
+                        q.error = f"{type(e).__name__}: {e}"
+                        q.state = "FAILED"
             finally:
                 group.release()
                 q.done.set()

@@ -1,0 +1,262 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+distributed group-overflow retry, DROP TABLE access control, INSERT
+type/dictionary validation, Welford variance, cancel race."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.security import AccessDeniedError, RuleBasedAccessControl
+from presto_tpu.session import Session
+from presto_tpu.types import VARCHAR, DecimalType
+
+
+@pytest.fixture()
+def catalog():
+    c = Catalog()
+    c.register("tpch", Tpch(sf=0.001, split_rows=2048))
+    c.register("mem", MemoryConnector(), writable=True)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# high: DROP TABLE must route through access control
+# ---------------------------------------------------------------------------
+
+def test_drop_denied_for_readonly_user(catalog):
+    ac = RuleBasedAccessControl([
+        ("admin", "*", True, True),
+        ("analyst", "*", True, False),  # read everything, write nothing
+    ])
+    admin = QueryRunner(catalog, session=Session(user="admin"), access_control=ac)
+    admin.execute("create table guarded as select n_nationkey from nation")
+
+    analyst = QueryRunner(catalog, session=Session(user="analyst"), access_control=ac)
+    assert analyst.execute("select count(*) from guarded").rows == [(25,)]
+    with pytest.raises(AccessDeniedError):
+        analyst.execute("drop table guarded")
+    # still there, and the owner can drop it
+    assert admin.execute("select count(*) from guarded").rows == [(25,)]
+    admin.execute("drop table guarded")
+
+
+# ---------------------------------------------------------------------------
+# medium: INSERT must compare full types (decimal scale!) and recode
+# dictionary strings onto the table dictionary
+# ---------------------------------------------------------------------------
+
+def test_insert_decimal_scale_mismatch_rejected(catalog):
+    mem = catalog.connector("mem")
+    t2 = DecimalType(10, 2)
+    t3 = DecimalType(10, 3)
+    mem.create_table(
+        "dst", [("x", t2)], [Page.from_arrays([np.array([125], np.int64)], [t2])]
+    )
+    mem.create_table(
+        "src", [("x", t3)], [Page.from_arrays([np.array([1250], np.int64)], [t3])]
+    )
+    runner = QueryRunner(catalog)
+    with pytest.raises(ValueError, match="INSERT schema mismatch"):
+        runner.execute("insert into dst select x from src")
+
+
+def test_insert_recodes_foreign_dictionary(catalog):
+    runner = QueryRunner(catalog)
+    runner.execute("create table names as select n_name from nation")
+
+    mem = catalog.connector("mem")
+    src_dict = Dictionary(["GERMANY", "FRANCE"])  # different object + order
+    page = Page.from_arrays(
+        [np.array([1, 0, 1], np.int32)], [VARCHAR], dictionaries=[src_dict]
+    )
+    mem.create_table("extra", [("n_name", VARCHAR)], [page])
+
+    runner.execute("insert into names select n_name from extra")
+    rows = runner.execute(
+        "select count(*) from names where n_name = 'FRANCE'"
+    ).rows
+    assert rows == [(3,)]  # 1 original + 2 inserted
+    assert runner.execute(
+        "select count(*) from names where n_name = 'GERMANY'"
+    ).rows == [(2,)]
+
+
+def test_insert_unknown_dictionary_value_rejected(catalog):
+    runner = QueryRunner(catalog)
+    runner.execute("create table names2 as select n_name from nation")
+    mem = catalog.connector("mem")
+    src_dict = Dictionary(["ATLANTIS"])
+    page = Page.from_arrays(
+        [np.array([0], np.int32)], [VARCHAR], dictionaries=[src_dict]
+    )
+    mem.create_table("extra2", [("n_name", VARCHAR)], [page])
+    with pytest.raises(ValueError, match="not in dictionary"):
+        runner.execute("insert into names2 select n_name from extra2")
+
+
+# ---------------------------------------------------------------------------
+# medium: variance via Welford/Chan state — no catastrophic cancellation
+# ---------------------------------------------------------------------------
+
+def test_variance_large_mean(catalog):
+    mem = catalog.connector("mem")
+    from presto_tpu.types import BIGINT, DOUBLE
+
+    rng = np.random.default_rng(7)
+    vals = 1.0e8 + rng.standard_normal(4096)  # |mean| >> stddev
+    grp = rng.integers(0, 4, size=4096)
+    mem.create_table(
+        "bigmean",
+        [("g", BIGINT), ("x", DOUBLE)],
+        [Page.from_arrays([grp.astype(np.int64), vals], [BIGINT, DOUBLE])],
+    )
+    runner = QueryRunner(catalog)
+    rows = runner.execute(
+        "select g, stddev(x), var_pop(x) from bigmean group by g order by g"
+    ).rows
+    for g, sd, vp in rows:
+        sel = vals[grp == g]
+        assert sd == pytest.approx(np.std(sel, ddof=1), rel=1e-6)
+        assert vp == pytest.approx(np.var(sel), rel=1e-6)
+
+
+def test_variance_partial_merge_across_splits(catalog):
+    # multiple splits force the partial/merge path (Chan combination)
+    mem = catalog.connector("mem")
+    from presto_tpu.types import DOUBLE
+
+    rng = np.random.default_rng(11)
+    vals = 5.0e7 + rng.standard_normal(3000)
+    pages = [
+        Page.from_arrays([vals[i : i + 1000]], [DOUBLE])
+        for i in range(0, 3000, 1000)
+    ]
+    mem.create_table("chunked", [("x", DOUBLE)], pages)
+    runner = QueryRunner(catalog)
+    (row,) = runner.execute("select stddev(x), variance(x) from chunked").rows
+    assert row[0] == pytest.approx(np.std(vals, ddof=1), rel=1e-6)
+    assert row[1] == pytest.approx(np.var(vals, ddof=1), rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# high: distributed aggregation detects group overflow and retries
+# ---------------------------------------------------------------------------
+
+def test_distributed_agg_overflow_retry():
+    from presto_tpu.parallel.dist import DistributedRunner, make_mesh
+    from presto_tpu.planner.plan import AggregationNode
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=512))
+    runner = QueryRunner(catalog)
+    # group by a DOUBLE: no key domain -> hash path, overflow checkable
+    sql = "select l_quantity, count(*), sum(l_extendedprice) from lineitem group by l_quantity"
+    expected = sorted(runner.execute(sql).rows)
+    assert len(expected) == 50
+
+    plan = runner.plan(sql)
+    node = plan
+    while not isinstance(node, AggregationNode):
+        node = node.source
+    node.max_groups = 8  # far fewer than the 50 distinct quantities
+
+    dist = DistributedRunner(catalog, make_mesh(4))
+    got = sorted(dist.run(plan).rows)
+    assert node in dist._mg_overrides  # the retry actually happened
+    assert len(got) == len(expected)
+    for a, e in zip(got, expected):
+        assert a[0] == pytest.approx(e[0])
+        assert a[1] == e[1]
+        assert a[2] == pytest.approx(e[2], rel=1e-9)
+
+
+def test_multihost_agg_overflow_retry():
+    from presto_tpu.parallel.multihost import MultiHostRunner
+    from presto_tpu.planner.plan import AggregationNode
+    from presto_tpu.server.worker import WorkerServer
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=512))
+    runner = QueryRunner(catalog)
+    sql = "select l_quantity, count(*) from lineitem group by l_quantity"
+    expected = sorted(runner.execute(sql).rows)
+
+    workers = [WorkerServer(catalog) for _ in range(2)]
+    for w in workers:
+        w.start()
+    try:
+        plan = runner.plan(sql)
+        node = plan
+        while not isinstance(node, AggregationNode):
+            node = node.source
+        node.max_groups = 8
+        mh = MultiHostRunner(catalog, [w.uri for w in workers])
+        got = sorted(mh.run(plan).rows)
+        assert got == [
+            (pytest.approx(e[0]), e[1]) for e in expected
+        ] or len(got) == len(expected)
+        for a, e in zip(got, expected):
+            assert a[0] == pytest.approx(e[0]) and a[1] == e[1]
+    finally:
+        for w in workers:
+            w.stop()
+
+
+# ---------------------------------------------------------------------------
+# low: DELETE (cancel) is terminal — completion must not resurrect it
+# ---------------------------------------------------------------------------
+
+def test_cancel_not_resurrected_by_completion(catalog):
+    import time
+    import urllib.request
+
+    from presto_tpu.connectors.blackhole import BlackholeConnector
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    bh = BlackholeConnector()
+    bh.create_table(
+        "slow", [("x", __import__("presto_tpu").BIGINT)],
+        splits=4, rows_per_split=8, page_latency_s=0.5,
+    )
+    catalog.register("bh", bh)
+    runner = QueryRunner(catalog)
+    server = CoordinatorServer(runner)
+    server.start()
+    try:
+        import threading
+
+        req = urllib.request.Request(
+            f"{server.uri}/v1/statement",
+            data=b"select count(*) from slow",
+            method="POST",
+        )
+        # POST blocks until the query finishes, so submit on a thread
+        # and cancel from here while it is still running
+        post = threading.Thread(
+            target=lambda: urllib.request.urlopen(req, timeout=60).read()
+        )
+        post.start()
+        deadline = time.time() + 10
+        qid = None
+        while qid is None and time.time() < deadline:
+            with server._lock:
+                if server.queries:
+                    qid = next(iter(server.queries))
+            time.sleep(0.01)
+        assert qid is not None
+        cancel = urllib.request.Request(
+            f"{server.uri}/v1/statement/{qid}", method="DELETE"
+        )
+        with urllib.request.urlopen(cancel, timeout=30):
+            pass
+        q = server.queries[qid]
+        post.join(60)
+        # wait for the worker thread to (incorrectly) overwrite state
+        time.sleep(1.0)
+        assert q.state == "CANCELED"
+    finally:
+        server.stop()
