@@ -93,6 +93,23 @@ def slice_page(page: Page, n: int) -> Page:
     return Page(blocks, page.row_mask[:n])
 
 
+def cross_append_single(q: Page, r: Page) -> Page:
+    """Append a single-row page's columns to every row of ``q`` (the
+    cross-join-with-scalar-subquery kernel, EnforceSingleRow +
+    NestedLoopJoin's one-row case)."""
+    blocks = list(q.blocks)
+    for b in r.blocks:
+        blocks.append(
+            Block(
+                jnp.broadcast_to(b.data[0], (q.capacity,)),
+                jnp.broadcast_to(b.valid[0] & r.row_mask[0], (q.capacity,)),
+                b.type,
+                b.dictionary,
+            )
+        )
+    return Page(tuple(blocks), q.row_mask)
+
+
 class QueryStats:
     """Per-plan-node execution stats (QueryStats/OperatorStats analog).
     Wall times are inclusive of upstream stages (chains are fused into
@@ -424,19 +441,7 @@ class LocalRunner:
             joins.append(node)
 
             def cross_stage(p, c):
-                q = inner(p, c)
-                r: Page = c[key]  # single-row page
-                blocks = list(q.blocks)
-                for b in r.blocks:
-                    blocks.append(
-                        Block(
-                            jnp.broadcast_to(b.data[0], (q.capacity,)),
-                            jnp.broadcast_to(b.valid[0] & r.row_mask[0], (q.capacity,)),
-                            b.type,
-                            b.dictionary,
-                        )
-                    )
-                return Page(tuple(blocks), q.row_mask)
+                return cross_append_single(inner(p, c), c[key])
 
             return cross_stage
 

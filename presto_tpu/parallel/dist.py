@@ -8,11 +8,19 @@ shards; the shuffle is ``all_to_all`` over ICI (see exchange.py); the
 scheduler is the wave loop feeding each device one split per wave
 (SourcePartitionedScheduler's role).
 
-Supported distributed shape this round (BASELINE configs Q1/Q3/Q6/Q14):
+Supported distributed shape:
     [Output/Project/Sort/TopN/Limit/Filter]*
       -> Aggregation(single)
-        -> streaming chain (scan -> filter/project -> replicated-build
-           joins -> ...)
+        -> streaming chain (scan -> filter/project -> joins -> ...)
+Joins distribute per the fragmenter's decision
+(parallel/fragment.py, DetermineJoinDistributionType.java:33 analog):
+small builds replicate to every device (BROADCAST); large builds are
+hash-partitioned across devices and the probe rows ride an
+``all_to_all`` on the join key inside the wave program (FIXED_HASH —
+the repartitioned join of AddExchanges.java:738).  Expanding
+(many-to-many) joins run in-program with static output capacities and
+count-check-and-retry, like the local runner.
+
 Post-aggregation nodes run locally on the gathered (small) result via
 PrecomputedNode splicing.  Anything else falls back to LocalRunner.
 """
@@ -37,6 +45,8 @@ from presto_tpu.exec.local import (
     MaterializedResult,
     concat_pages_device,
 )
+from presto_tpu.ops.join import JoinBuild, build_join, probe_expand, probe_join
+from presto_tpu.parallel.fragment import DEFAULT_BROADCAST_THRESHOLD
 from presto_tpu.expr.ir import ColumnRef
 from presto_tpu.ops.aggregate import grouped_aggregate, merge_aggregate
 from presto_tpu.page import Block, Page, concat_pages_host
@@ -63,6 +73,54 @@ class DistributedUnsupported(Exception):
     pass
 
 
+class _BuildOverflow(Exception):
+    """A sharded-build exchange bucket overfilled; retry with the given
+    bucket capacity."""
+
+    def __init__(self, needed: int):
+        self.needed = needed
+
+
+class _ChainCtx:
+    """Build-time context for a distributed chain: registered join
+    builds (broadcast consts vs sharded consts) and the runtime check
+    names the host must verify after each wave."""
+
+    def __init__(self, cap: int):
+        self.cap = cap  # leaf split capacity (sizes the default buckets)
+        self.broadcast: Dict[str, PlanNode] = {}
+        self.sharded: Dict[str, PlanNode] = {}
+        self.checks: List[str] = []
+        self.check_meta: List[Tuple[str, PlanNode, str]] = []
+        self._i = 0
+
+    def add_broadcast(self, node) -> str:
+        key = f"build_{self._i}"
+        self._i += 1
+        self.broadcast[key] = node
+        return key
+
+    def add_sharded(self, node) -> str:
+        key = f"sbuild_{self._i}"
+        self._i += 1
+        self.sharded[key] = node
+        return key
+
+    def add_check(self, node, kind: str) -> str:
+        name = f"{kind}_{len(self.checks)}"
+        self.checks.append(name)
+        self.check_meta.append((name, node, kind))
+        return name
+
+    def sig(self, join_cfg) -> Tuple:
+        """Capacity signature: compiled programs are cached per config."""
+        out = []
+        for name, node, _ in self.check_meta:
+            cfg = join_cfg.get(node, {})
+            out.append((name, cfg.get("bucket_cap"), cfg.get("out_cap")))
+        return tuple(out)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "d") -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
@@ -81,19 +139,28 @@ class DistributedRunner:
     """Runs plans over a mesh; falls back to LocalRunner when the plan
     shape isn't distributable yet."""
 
-    def __init__(self, catalog: Catalog, mesh: Optional[Mesh] = None, axis: str = "d"):
+    def __init__(
+        self,
+        catalog: Catalog,
+        mesh: Optional[Mesh] = None,
+        axis: str = "d",
+        broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
+    ):
         self.catalog = catalog
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
+        self.broadcast_threshold = broadcast_threshold
         self.local = LocalRunner(catalog)
         # persistent un-jitted runner for stage building/builds: its
         # _agg_overrides must survive GroupCapacityExceeded retries
         # (a build-side aggregation overflow records its doubled
         # capacity here; a throwaway runner would loop forever)
         self._stage_runner = LocalRunner(catalog, jit=False)
-        self._wave_fns: Dict[Tuple[PlanNode, int], object] = {}
-        self._final_fns: Dict[Tuple[PlanNode, int], object] = {}
+        self._wave_fns: Dict[Tuple, object] = {}
+        self._final_fns: Dict[Tuple, object] = {}
         self._mg_overrides: Dict[PlanNode, int] = {}
+        self._join_cfg: Dict[PlanNode, Dict[str, int]] = {}
+        self._sharded_builds: Dict[Tuple, JoinBuild] = {}
 
     @property
     def n(self) -> int:
@@ -107,6 +174,13 @@ class DistributedRunner:
             return self.local.run(plan)
 
     def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
+        # fresh join builds per query, like LocalRunner.run_to_page's
+        # per-run _builds.clear(): table data may have changed since the
+        # last run (a stale build would join fresh probe rows against
+        # old build rows)
+        self._stage_runner._builds.clear()
+        self._sharded_builds.clear()
+
         # peel post-aggregation nodes
         path: List[PlanNode] = []
         node = plan
@@ -155,28 +229,244 @@ class DistributedRunner:
                 f"distributed aggregation exceeded {MAX_AGG_GROUPS} groups per device"
             )
         self._mg_overrides[agg] = mg * 2
-        self._wave_fns.pop((agg, mg), None)
-        self._final_fns.pop((agg, mg), None)
+        self._evict_stage_fns(agg)
         raise GroupCapacityExceeded(mg * 2)
+
+    def _evict_stage_fns(self, agg) -> None:
+        """Drop compiled programs superseded by a capacity bump (their
+        old (agg, mg, sig) keys are unreachable and pin executables)."""
+        self._wave_fns = {k: v for k, v in self._wave_fns.items() if k[0] is not agg}
+        self._final_fns = {k: v for k, v in self._final_fns.items() if k[0] is not agg}
+
+    def _verify_checks(
+        self, agg, ctx: "_ChainCtx", wave_checks, mg: int, check_groups: bool
+    ) -> None:
+        """Host-side verification of the wave programs' counters:
+        exchange bucket fills, expanding-join totals, and live group
+        counts.  Any exceeded capacity updates its config and raises
+        GroupCapacityExceeded so the stage re-runs (counts are true
+        totals, so one retry per knob suffices)."""
+        if not wave_checks:
+            return
+        peaks: Dict[str, int] = {}
+        for cks in wave_checks:
+            for name, arr in cks.items():
+                v = int(np.asarray(jax.device_get(arr)).max())
+                peaks[name] = max(peaks.get(name, 0), v)
+        bumped = False
+        for name, jnode, kind in ctx.check_meta:
+            peak = peaks.get(name, 0)
+            cfg = self._join_cfg[jnode]
+            if kind == "fill" and peak > cfg["bucket_cap"]:
+                cfg["bucket_cap"] = 1 << (peak - 1).bit_length()
+                bumped = True
+            elif kind == "expand" and peak > cfg["out_cap"]:
+                cfg["out_cap"] = 1 << (peak - 1).bit_length()
+                bumped = True
+        if check_groups and peaks.get("groups", 0) >= mg:
+            self._overflow(agg, mg)  # raises
+        if bumped:
+            self._evict_stage_fns(agg)
+            raise GroupCapacityExceeded(0)
+
+    # ------------------------------------------------------------------
+    # distributed chain compilation (joins distribute per fragmenter)
+    # ------------------------------------------------------------------
+    def _dist_chain_leaf(self, node: PlanNode) -> PlanNode:
+        """Chain leaf for the distributed tier: descends through ALL
+        joins' probe sides (expanding joins run in-program here, unlike
+        the local chain)."""
+        from presto_tpu.planner.plan import CrossSingleNode, JoinNode
+
+        if isinstance(node, (FilterNode, ProjectNode)):
+            return self._dist_chain_leaf(node.source)
+        if isinstance(node, AggregationNode) and node.step == "partial":
+            return self._dist_chain_leaf(node.source)
+        if isinstance(node, CrossSingleNode):
+            return self._dist_chain_leaf(node.left)
+        if isinstance(node, JoinNode):
+            return self._dist_chain_leaf(node.left)
+        return node
+
+    def _join_mode(self, jnode) -> str:
+        """The fragmenter's broadcast-vs-repartition decision (it also
+        owns the downgrade for non-chainable build sides, so EXPLAIN
+        rendering and execution always agree)."""
+        from presto_tpu.parallel.fragment import decide_join_distribution
+
+        mode, _ = decide_join_distribution(jnode, self.broadcast_threshold)
+        return mode
+
+    def _join_cfg_for(self, jnode, cap: int) -> Dict[str, int]:
+        """Static capacities for a partitioned/expanding join, grown by
+        the check-and-retry protocol."""
+        cfg = self._join_cfg.setdefault(jnode, {})
+        n = self.n
+        cfg.setdefault("bucket_cap", max(2 * cap // max(n, 1), 1024))
+        cfg.setdefault("out_cap", max(2 * cap, 4096))
+        cfg.setdefault("build_bucket_cap", 0)  # lazily set from build cap
+        return cfg
+
+    def _build_dist_stage(self, node: PlanNode, ctx: "_ChainCtx"):
+        """fn(page, consts) -> (page, checks): the distributed analog of
+        LocalRunner._build_stage.  ``checks`` maps check names to scalar
+        counts (exchange fills, expand totals) the host verifies."""
+        from presto_tpu.ops.filter_project import filter_page, project_page
+        from presto_tpu.planner.plan import CrossSingleNode, JoinNode
+
+        if isinstance(node, FilterNode):
+            inner = self._build_dist_stage(node.source, ctx)
+            pred = node.predicate
+
+            def f_filter(p, c):
+                q, ch = inner(p, c)
+                return filter_page(q, pred), ch
+
+            return f_filter
+
+        if isinstance(node, ProjectNode):
+            inner = self._build_dist_stage(node.source, ctx)
+            projections = list(node.projections)
+
+            def f_project(p, c):
+                q, ch = inner(p, c)
+                return project_page(q, projections), ch
+
+            return f_project
+
+        if isinstance(node, AggregationNode) and node.step == "partial":
+            inner = self._build_dist_stage(node.source, ctx)
+            group_exprs = list(node.group_exprs)
+            aggs = list(node.aggs)
+            pmg = self._stage_runner._max_groups(node)
+            pkd = node.key_domains
+
+            def f_pagg(p, c):
+                q, ch = inner(p, c)
+                return (
+                    grouped_aggregate(
+                        q, group_exprs, aggs, pmg, key_domains=pkd, mode="partial"
+                    ),
+                    ch,
+                )
+
+            return f_pagg
+
+        if isinstance(node, CrossSingleNode):
+            from presto_tpu.exec.local import cross_append_single
+
+            inner = self._build_dist_stage(node.left, ctx)
+            key = ctx.add_broadcast(node)
+
+            def f_cross(p, c):
+                q, ch = inner(p, c)
+                return cross_append_single(q, c[key]), ch
+
+            return f_cross
+
+        if isinstance(node, JoinNode):
+            from presto_tpu.exec.local import _is_streaming_join
+
+            inner = self._build_dist_stage(node.left, ctx)
+            mode = self._join_mode(node)
+            left_keys = list(node.left_keys)
+            kd = node.key_domains
+            kind = node.kind
+            build_output = list(range(len(node.right.channels)))
+            streaming = _is_streaming_join(node)
+            cfg = self._join_cfg_for(node, ctx.cap)
+            n, axis = self.n, self.axis
+
+            if mode == "broadcast":
+                key = ctx.add_broadcast(node)
+                if streaming:
+
+                    def f_bjoin(p, c):
+                        q, ch = inner(p, c)
+                        return (
+                            probe_join(
+                                c[key], q, left_keys, key_domains=kd,
+                                kind=kind, build_output=build_output,
+                            ),
+                            ch,
+                        )
+
+                    return f_bjoin
+
+                out_cap = cfg["out_cap"]
+                expand_check = ctx.add_check(node, "expand")
+
+                def f_bexpand(p, c):
+                    q, ch = inner(p, c)
+                    out, total = probe_expand(
+                        c[key], q, left_keys, out_cap, key_domains=kd,
+                        kind=kind, build_output=build_output,
+                    )
+                    return out, {**ch, expand_check: total.astype(jnp.int32)}
+
+                return f_bexpand
+
+            # partitioned (repartitioned join): exchange probe rows on
+            # the join key, probe the local build shard
+            key = ctx.add_sharded(node)
+            bucket_cap = cfg["bucket_cap"]
+            fill_check = ctx.add_check(node, "fill")
+            if streaming:
+
+                def f_pjoin(p, c):
+                    q, ch = inner(p, c)
+                    t = partition_targets(q, left_keys, n, kd)
+                    bucketized, fill = partition_for_exchange(q, t, n, bucket_cap)
+                    ex = exchange_page(bucketized, axis)
+                    out = probe_join(
+                        _squeeze(c[key]), ex, left_keys, key_domains=kd,
+                        kind=kind, build_output=build_output,
+                    )
+                    return out, {**ch, fill_check: fill}
+
+                return f_pjoin
+
+            out_cap = cfg["out_cap"]
+            expand_check = ctx.add_check(node, "expand")
+
+            def f_pexpand(p, c):
+                q, ch = inner(p, c)
+                t = partition_targets(q, left_keys, n, kd)
+                bucketized, fill = partition_for_exchange(q, t, n, bucket_cap)
+                ex = exchange_page(bucketized, axis)
+                out, total = probe_expand(
+                    _squeeze(c[key]), ex, left_keys, out_cap, key_domains=kd,
+                    kind=kind, build_output=build_output,
+                )
+                return out, {
+                    **ch, fill_check: fill, expand_check: total.astype(jnp.int32),
+                }
+
+            return f_pexpand
+
+        # chain leaf (scan): identity
+        return lambda p, c: (p, {})
 
     def _run_aggregation_stage_once(self, agg: AggregationNode) -> Page:
         n = self.n
         runner = self._stage_runner
-        joins: List[PlanNode] = []
-        stage = runner._build_stage(agg.source, joins)
-        leaf = runner._chain_leaf(agg.source)
+
+        leaf = self._dist_chain_leaf(agg.source)
         if not isinstance(leaf, TableScanNode):
             raise DistributedUnsupported("chain leaf is not a table scan")
-        for j in joins:
-            if hasattr(j, "kind") and not (
-                j.kind in ("semi", "anti") or getattr(j, "unique_build", False)
-            ):
-                raise DistributedUnsupported("expanding join in distributed chain")
+        conn = self.catalog.connector(leaf.handle.connector_name)
+        cap = self._split_capacity(conn, leaf.handle.table)
 
-        # replicated join builds (broadcast-join analog: every device
-        # holds the full build, BroadcastOutputBuffer.java's semantics)
-        consts = {
-            f"build_{i}": runner._materialize_build(j) for i, j in enumerate(joins)
+        ctx = _ChainCtx(cap)
+        stage = self._build_dist_stage(agg.source, ctx)
+
+        # broadcast builds replicate to every device (BroadcastOutputBuffer
+        # semantics); partitioned builds shard by join-key hash
+        consts_rep = {
+            key: runner._materialize_build(j) for key, j in ctx.broadcast.items()
+        }
+        consts_shard = {
+            key: self._materialize_build_sharded(j) for key, j in ctx.sharded.items()
         }
 
         mg = self._mg_overrides.get(agg) or runner._max_groups(agg)
@@ -193,10 +483,10 @@ class DistributedRunner:
 
         mesh, axis = self.mesh, self.axis
 
-        def per_device_wave(page1, acc1, consts_r):
+        def per_device_wave(page1, acc1, consts_r, consts_s):
             page = _squeeze(page1)
             acc = _squeeze(acc1)
-            p = stage(page, consts_r)
+            p, checks = stage(page, {**consts_r, **consts_s})
             part, c1 = grouped_aggregate(
                 p, group_exprs, aggs, mg, key_domains=kd, mode="partial",
                 return_count=True,
@@ -206,55 +496,43 @@ class DistributedRunner:
                 cand, nk, aggs, mg, key_domains=kd, mode="partial",
                 return_count=True,
             )
-            return _unsqueeze(acc2), jnp.maximum(c1, c2)[None]
+            checks = dict(checks)
+            checks["groups"] = jnp.maximum(c1, c2)
+            return _unsqueeze(acc2), {k: v[None] for k, v in checks.items()}
 
-        wave_fn = self._wave_fns.get((agg, mg))
+        fn_key = (agg, mg, ctx.sig(self._join_cfg))
+        wave_fn = self._wave_fns.get(fn_key)
         if wave_fn is None:
+            check_specs = {name: P(axis) for name in ctx.checks}
+            check_specs["groups"] = P(axis)
             wave_fn = jax.jit(
                 jax.shard_map(
                     per_device_wave, mesh=mesh,
-                    in_specs=(P(axis), P(axis), P()),
-                    out_specs=(P(axis), P(axis)),
+                    in_specs=(
+                        P(axis), P(axis), P(),
+                        {k: P(axis) for k in consts_shard},
+                    ),
+                    out_specs=(P(axis), check_specs),
                 )
             )
-            self._wave_fns[(agg, mg)] = wave_fn
+            self._wave_fns[fn_key] = wave_fn
 
         # ---- split scheduling: device d takes split w*n + d ----------
-        conn = self.catalog.connector(leaf.handle.connector_name)
         table = leaf.handle.table
         n_splits = leaf.handle.num_splits
-        full = [ch.name for ch in leaf.handle.columns]
         col_idx = list(leaf.columns)
-        cap = self._split_capacity(conn, table)
         sharding = NamedSharding(mesh, P(axis))
 
         acc = self._initial_acc(partial_channels, mg, n, sharding)
         waves = math.ceil(n_splits / n)
-        wave_counts = []
+        wave_checks = []
         for w in range(waves):
-            pages = []
-            for d in range(n):
-                s = w * n + d
-                if s < n_splits:
-                    pg = conn.page_for_split(table, s, capacity=cap)
-                    pg = Page(tuple(pg.blocks[i] for i in col_idx), pg.row_mask)
-                else:
-                    pg = Page.empty([leaf.handle.columns[i].type for i in col_idx], cap)
-                    pg = Page(
-                        tuple(
-                            Block(b.data, b.valid, b.type, leaf.handle.columns[i].dictionary)
-                            for b, i in zip(pg.blocks, col_idx)
-                        ),
-                        pg.row_mask,
-                    )
-                pages.append(pg)
-            stacked = jax.device_put(_stack_pages(pages), sharding)
-            acc, cnts = wave_fn(stacked, acc, consts)
-            wave_counts.append(cnts)
-        if check and wave_counts:
-            peak = max(int(np.asarray(jax.device_get(c)).max()) for c in wave_counts)
-            if peak >= mg:
-                self._overflow(agg, mg)
+            stacked = jax.device_put(
+                self._stacked_wave(conn, leaf, col_idx, w, cap), sharding
+            )
+            acc, cks = wave_fn(stacked, acc, consts_rep, consts_shard)
+            wave_checks.append(cks)
+        self._verify_checks(agg, ctx, wave_checks, mg, check)
 
         # ---- exchange + final merge ----------------------------------
         if nk == 0:
@@ -293,6 +571,135 @@ class DistributedRunner:
         out_channels = agg.channels
         host_pages = _unstack_pages(jax.device_get(out), out_channels)
         return concat_pages_host(host_pages)
+
+    # ------------------------------------------------------------------
+    def _stacked_wave(self, conn, leaf: TableScanNode, col_idx, w: int, cap: int) -> Page:
+        """Host-assemble wave ``w``'s one-split-per-device stacked page
+        (device d takes split w*n + d; missing splits pad empty)."""
+        n = self.n
+        table = leaf.handle.table
+        n_splits = leaf.handle.num_splits
+        pages = []
+        for d in range(n):
+            s = w * n + d
+            if s < n_splits:
+                pg = conn.page_for_split(table, s, capacity=cap)
+                pg = Page(tuple(pg.blocks[i] for i in col_idx), pg.row_mask)
+            else:
+                pg = Page.empty([leaf.handle.columns[i].type for i in col_idx], cap)
+                pg = Page(
+                    tuple(
+                        Block(b.data, b.valid, b.type, leaf.handle.columns[i].dictionary)
+                        for b, i in zip(pg.blocks, col_idx)
+                    ),
+                    pg.row_mask,
+                )
+            pages.append(pg)
+        return _stack_pages(pages)
+
+    # ------------------------------------------------------------------
+    # sharded (repartitioned) join builds
+    # ------------------------------------------------------------------
+    def _materialize_build_sharded(self, jnode) -> JoinBuild:
+        """Build side of a repartitioned join: wave-scan the build
+        chain over the mesh, hash-exchange rows on the join key, then
+        build one sorted JoinBuild per device over its key partition.
+        Device p ends up holding exactly the build rows with
+        hash(key) % n == p — the PartitionedLookupSourceFactory analog
+        with the shuffle collapsed into ``all_to_all``."""
+        runner = self._stage_runner
+        leaf_r = runner._chain_leaf(jnode.right)
+        conn_r = self.catalog.connector(leaf_r.handle.connector_name)
+        cap_r = self._split_capacity(conn_r, leaf_r.handle.table)
+        cfg = self._join_cfg.setdefault(jnode, {})
+        if not cfg.get("build_bucket_cap"):
+            cfg["build_bucket_cap"] = max(2 * cap_r // max(self.n, 1), 1024)
+        while True:
+            key = (jnode, cfg["build_bucket_cap"])
+            cached = self._sharded_builds.get(key)
+            if cached is not None:
+                return cached
+            try:
+                build = self._materialize_build_sharded_once(
+                    jnode, leaf_r, conn_r, cap_r, cfg["build_bucket_cap"]
+                )
+                self._sharded_builds[key] = build
+                return build
+            except _BuildOverflow as e:
+                # evict the undersized build (it pins device memory and
+                # its key is unreachable once the cap grows)
+                self._sharded_builds.pop(key, None)
+                cfg["build_bucket_cap"] = e.needed
+
+    def _materialize_build_sharded_once(
+        self, jnode, leaf_r: TableScanNode, conn_r, cap_r: int, bcap: int
+    ) -> JoinBuild:
+        n, mesh, axis = self.n, self.mesh, self.axis
+        runner = self._stage_runner
+        joins_r: List[PlanNode] = []
+        stage_r = runner._build_stage(jnode.right, joins_r)
+        consts_r = {
+            f"build_{i}": runner._materialize_build(j) for i, j in enumerate(joins_r)
+        }
+        right_keys = list(jnode.right_keys)
+        kd = jnode.key_domains
+
+        def bw(page1, crep):
+            page = _squeeze(page1)
+            q = stage_r(page, crep)
+            t = partition_targets(q, right_keys, n, kd)
+            bucketized, fill = partition_for_exchange(q, t, n, bcap)
+            ex = exchange_page(bucketized, axis)
+            return _unsqueeze(ex), fill[None]
+
+        bw_fn = jax.jit(
+            jax.shard_map(
+                bw, mesh=mesh, in_specs=(P(axis), P()),
+                out_specs=(P(axis), P(axis)),
+            )
+        )
+        sharding = NamedSharding(mesh, P(axis))
+        col_idx = list(leaf_r.columns)
+        received: List[Page] = []
+        fills = []
+        waves = math.ceil(leaf_r.handle.num_splits / n)
+        for w in range(waves):
+            stacked = jax.device_put(
+                self._stacked_wave(conn_r, leaf_r, col_idx, w, cap_r), sharding
+            )
+            rec, fill = bw_fn(stacked, consts_r)
+            received.append(rec)
+            fills.append(fill)
+        peak = max(int(np.asarray(jax.device_get(f)).max()) for f in fills)
+        if peak > bcap:
+            raise _BuildOverflow(1 << (peak - 1).bit_length())
+
+        if len(received) == 1:
+            big = received[0]
+        else:  # concat per device along the row axis (axis 0 is devices)
+            b0 = received[0]
+            big = Page(
+                tuple(
+                    Block(
+                        jnp.concatenate([r.blocks[i].data for r in received], axis=1),
+                        jnp.concatenate([r.blocks[i].valid for r in received], axis=1),
+                        b.type,
+                        b.dictionary,
+                    )
+                    for i, b in enumerate(b0.blocks)
+                ),
+                jnp.concatenate([r.row_mask for r in received], axis=1),
+            )
+
+        bj_fn = jax.jit(
+            jax.shard_map(
+                lambda pg1: _unsqueeze(
+                    build_join(_squeeze(pg1), right_keys, key_domains=kd)
+                ),
+                mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            )
+        )
+        return bj_fn(big)
 
     # ------------------------------------------------------------------
     def _split_capacity(self, conn, table: str) -> int:

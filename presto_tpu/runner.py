@@ -233,3 +233,10 @@ class QueryRunner:
 
     def explain(self, sql: str) -> str:
         return self.executor.explain(self.plan(sql))
+
+    def explain_distributed(self, sql: str) -> str:
+        """Fragment-tree rendering (EXPLAIN (TYPE DISTRIBUTED) analog:
+        sql/planner/PlanFragmenter SubPlans printed by PlanPrinter)."""
+        from presto_tpu.parallel.fragment import explain_distributed
+
+        return explain_distributed(self.plan(sql))

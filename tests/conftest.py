@@ -21,3 +21,15 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 # float64/int64 for DOUBLE/BIGINT columns on the CPU test backend.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the suite's wall-clock is dominated by
+# XLA recompilation (every query/capacity pair is a fresh program), so
+# compiled executables are cached on disk across runs and processes.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# NOTE: deliberately NOT enabling jax_persistent_cache_enable_xla_caches:
+# XLA:CPU kernel caches are AOT-compiled for this host's CPU features and
+# replaying them on a different machine can SIGILL; the jit cache alone
+# is portable (it keys on the platform) and captures most of the win.
