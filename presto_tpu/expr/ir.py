@@ -16,6 +16,7 @@ from presto_tpu.types import (
     BOOLEAN,
     DATE,
     DOUBLE,
+    TIMESTAMP,
     DecimalType,
     Type,
     common_super_type,
@@ -111,10 +112,21 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         if fn == "div" and a.name != "double" and b.name != "double":
             return common_super_type(a, b)  # integer division stays integral
         return common_super_type(a, b)
-    if fn in ("year", "month", "day", "day_of_week", "day_of_year", "quarter", "week"):
+    if fn in ("year", "month", "day", "day_of_week", "day_of_year", "quarter", "week",
+              "hour", "minute", "second", "millisecond", "date_diff"):
         return BIGINT
-    if fn == "date_add_days":
+    if fn in ("date_add_days", "date_add_months"):
         return DATE
+    if fn in ("ts_add_micros", "ts_add_months", "cast_timestamp", "from_unixtime"):
+        return TIMESTAMP
+    if fn == "cast_date":
+        return DATE
+    if fn == "to_unixtime":
+        return DOUBLE
+    if fn == "date_trunc":
+        return ts[1]  # truncation preserves the operand's type
+    if fn == "date_add":
+        return ts[2]
     if fn in ("sqrt", "cbrt", "exp", "ln", "log10", "power", "pow"):
         return DOUBLE
     if fn == "abs":

@@ -239,6 +239,10 @@ def _to_py(v, t: Type):
         return float(v)
     if t.name == "boolean":
         return bool(v)
+    if t.name == "timestamp":
+        import datetime
+
+        return datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(v))
     if t.is_string:
         return v  # already decoded (str) or raw code
     if isinstance(v, (np.integer,)):

@@ -48,9 +48,14 @@ class DateLit(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class TimestampLit(Node):
+    value: str  # 'YYYY-MM-DD[ HH:MM:SS[.ffffff]]'
+
+
+@dataclasses.dataclass(frozen=True)
 class IntervalLit(Node):
     value: str  # e.g. '3'
-    unit: str  # day | month | year
+    unit: str  # second | minute | hour | day | month | year
     negative: bool = False
 
 
@@ -122,7 +127,7 @@ class Cast(Node):
 
 @dataclasses.dataclass(frozen=True)
 class Extract(Node):
-    field: str  # year | month | day
+    field: str  # year | quarter | month | week | day | hour | minute | second | ...
     value: Node
 
 

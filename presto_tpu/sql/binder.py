@@ -50,7 +50,8 @@ from presto_tpu.planner.plan import (
 from presto_tpu.sql import ast
 from presto_tpu.sql.parser import parse_query
 from presto_tpu.types import (
-    BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType, Type, common_super_type,
+    BIGINT, BOOLEAN, DATE, DOUBLE, MICROS_PER_DAY, TIMESTAMP, VARCHAR,
+    DecimalType, Type, common_super_type,
 )
 
 AGG_FUNCTIONS = {
@@ -84,6 +85,8 @@ SCALAR_FUNCTIONS = {
     "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
     "trim", "ltrim", "rtrim", "reverse", "substr",
     "year", "month", "day", "day_of_week", "day_of_year", "quarter", "week",
+    "hour", "minute", "second", "millisecond",
+    "date_trunc", "date_add", "date_diff", "from_unixtime", "to_unixtime",
 }
 
 
@@ -165,6 +168,18 @@ def remap_expr(e: Expr, mapping: Dict[int, int]) -> Expr:
 def _parse_date(s: str) -> int:
     d = datetime.date.fromisoformat(s)
     return (d - datetime.date(1970, 1, 1)).days
+
+
+def _parse_timestamp(s: str) -> int:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' -> epoch microseconds."""
+    s = s.strip()
+    if " " in s or "T" in s:
+        dt = datetime.datetime.fromisoformat(s.replace("T", " "))
+    else:
+        d = datetime.date.fromisoformat(s)
+        dt = datetime.datetime(d.year, d.month, d.day)
+    delta = dt - datetime.datetime(1970, 1, 1)
+    return (delta.days * 86_400 + delta.seconds) * 1_000_000 + delta.microseconds
 
 
 def _shift_date(days: int, n: int, unit: str) -> int:
@@ -1152,6 +1167,8 @@ class Binder:
             return Literal(type=VARCHAR, value=e.value)
         if isinstance(e, ast.DateLit):
             return Literal(type=DATE, value=_parse_date(e.value))
+        if isinstance(e, ast.TimestampLit):
+            return Literal(type=TIMESTAMP, value=_parse_timestamp(e.value))
         if isinstance(e, ast.NullLit):
             return Literal(type=BIGINT, value=None)
 
@@ -1212,12 +1229,21 @@ class Binder:
                 return call("cast_double", v)
             if tn in ("bigint", "integer", "int"):
                 return call("cast_bigint", v)
+            if tn == "date":
+                if isinstance(v, Literal) and v.type == VARCHAR:
+                    return Literal(type=DATE, value=_parse_date(v.value))
+                return call("cast_date", v)
+            if tn == "timestamp":
+                if isinstance(v, Literal) and v.type == VARCHAR:
+                    return Literal(type=TIMESTAMP, value=_parse_timestamp(v.value))
+                return call("cast_timestamp", v)
             if tn.startswith("decimal"):
                 return v  # decimal arithmetic already exact
             raise BindError(f"unsupported CAST to {e.type_name}")
 
         if isinstance(e, ast.Extract):
-            return call(e.field, self._bind_impl(e.value, scope, agg))
+            field = {"dow": "day_of_week", "doy": "day_of_year"}.get(e.field, e.field)
+            return call(field, self._bind_impl(e.value, scope, agg))
 
         if isinstance(e, ast.FuncCall):
             if e.name in AGG_FUNCTIONS:
@@ -1263,11 +1289,36 @@ class Binder:
         if e.op == "-":
             n = -n
         base = self._bind_impl(base_ast, scope, agg)
-        if isinstance(base, Literal) and base.type == DATE:
+        micros = {"second": 1_000_000, "minute": 60_000_000, "hour": 3_600_000_000}
+        if isinstance(base, Literal) and base.type == DATE and base.value is not None:
+            if iv.unit in micros:
+                return Literal(type=TIMESTAMP,
+                               value=base.value * MICROS_PER_DAY + n * micros[iv.unit])
             return Literal(type=DATE, value=_shift_date(base.value, n, iv.unit))
+        if isinstance(base, Literal) and base.type == TIMESTAMP and base.value is not None:
+            if iv.unit in micros:
+                return Literal(type=TIMESTAMP, value=base.value + n * micros[iv.unit])
+            days = base.value // MICROS_PER_DAY
+            tod = base.value - days * MICROS_PER_DAY
+            return Literal(type=TIMESTAMP,
+                           value=_shift_date(days, n, iv.unit) * MICROS_PER_DAY + tod)
+        if base.type == TIMESTAMP:
+            if iv.unit in micros:
+                return call("ts_add_micros", base,
+                            Literal(type=BIGINT, value=n * micros[iv.unit]))
+            if iv.unit == "day":
+                return call("ts_add_micros", base,
+                            Literal(type=BIGINT, value=n * MICROS_PER_DAY))
+            return call("ts_add_months", base,
+                        Literal(type=BIGINT, value=n * (12 if iv.unit == "year" else 1)))
         if iv.unit == "day":
             return call("date_add_days", base, Literal(type=BIGINT, value=n))
-        raise BindError("month/year interval on non-literal date unsupported")
+        if iv.unit in ("month", "year"):
+            return call("date_add_months", base,
+                        Literal(type=BIGINT, value=n * (12 if iv.unit == "year" else 1)))
+        # date column +/- an hour/minute/second interval promotes to timestamp
+        return call("ts_add_micros", call("cast_timestamp", base),
+                    Literal(type=BIGINT, value=n * micros[iv.unit]))
 
     def _bind_case(self, e: ast.Case, scope: Scope, agg) -> Expr:
         whens = []

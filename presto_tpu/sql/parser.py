@@ -32,7 +32,7 @@ KEYWORDS = {
     "limit", "as", "and", "or", "not", "in", "like", "between", "is", "null",
     "case", "when", "then", "else", "end", "cast", "extract", "exists",
     "join", "inner", "left", "right", "outer", "cross", "on", "asc", "desc",
-    "date", "interval", "year", "month", "day", "true", "false", "substring",
+    "date", "timestamp", "interval", "year", "month", "day", "true", "false", "substring",
     "for", "nulls", "first", "last", "all", "any", "union",
     "over", "partition",
     "explain", "analyze", "set", "session", "show", "tables", "columns",
@@ -102,13 +102,22 @@ class Parser:
         if not self.accept(val):
             raise SyntaxError(f"expected {val!r}, got {self.tok!r}")
 
+    def accept_word(self, *vals: str) -> Optional[str]:
+        """Accept a keyword OR bare identifier matching one of ``vals``
+        (case-insensitive) — for non-reserved words like interval units."""
+        t = self.tok
+        if t.kind in ("keyword", "ident") and t.value.lower() in vals:
+            self.i += 1
+            return t.value.lower()
+        return None
+
     def ident(self) -> str:
         t = self.tok
         if t.kind == "ident":
             self.i += 1
             return t.value
         # non-reserved keywords usable as identifiers
-        if t.kind == "keyword" and t.value in ("year", "month", "day", "date", "first", "last"):
+        if t.kind == "keyword" and t.value in ("year", "month", "day", "date", "timestamp", "first", "last"):
             self.i += 1
             return t.value
         raise SyntaxError(f"expected identifier, got {t!r}")
@@ -392,16 +401,23 @@ class Parser:
             self.i += 1
             return ast.DateLit(s.value)
 
+        if self.peek("timestamp") and self.tokens[self.i + 1].kind == "string":
+            self.i += 1
+            s = self.tok
+            self.i += 1
+            return ast.TimestampLit(s.value)
+
         if self.accept("interval"):
             neg = bool(self.accept("-"))
             s = self.tok
             if s.kind != "string":
                 raise SyntaxError("expected string after INTERVAL")
             self.i += 1
-            unit = self.tok.value
-            if not self.accept("year", "month", "day"):
-                raise SyntaxError(f"unsupported interval unit {unit!r}")
-            return ast.IntervalLit(s.value, unit, neg)
+            unit = self.accept_word("year", "month", "day", "hour", "minute", "second",
+                                    "years", "months", "days", "hours", "minutes", "seconds")
+            if unit is None:
+                raise SyntaxError(f"unsupported interval unit {self.tok.value!r}")
+            return ast.IntervalLit(s.value, unit.rstrip("s"), neg)
 
         if self.accept("case"):
             operand = None
@@ -437,9 +453,11 @@ class Parser:
 
         if self.accept("extract"):
             self.expect("(")
-            field = self.tok.value
-            if not self.accept("year", "month", "day"):
-                raise SyntaxError(f"unsupported extract field {field!r}")
+            field = self.accept_word("year", "quarter", "month", "week", "day",
+                                     "hour", "minute", "second", "day_of_week",
+                                     "dow", "day_of_year", "doy")
+            if field is None:
+                raise SyntaxError(f"unsupported extract field {self.tok.value!r}")
             self.expect("from")
             v = self._expr()
             self.expect(")")

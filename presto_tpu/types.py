@@ -15,6 +15,10 @@ Representation decisions (TPU-first):
   BOOLEAN           -> bool_
   DATE              -> int32 days since 1970-01-01 (same as reference
                        DateType.java which stores days-since-epoch)
+  TIMESTAMP         -> int64 microseconds since 1970-01-01 00:00:00
+                       (reference TimestampType.java stores epoch
+                       millis; micros here so device datetime math
+                       never loses sub-ms precision)
   DECIMAL(p<=18,s)  -> int64 scaled by 10**s ("short decimal"; reference
                        long decimals use 2x64-bit — out of scope v0)
   VARCHAR           -> int32 dictionary code per row + host-side
@@ -57,7 +61,7 @@ class Type:
 
     @property
     def is_integerlike(self) -> bool:
-        return self.name in ("bigint", "integer", "date")
+        return self.name in ("bigint", "integer", "date", "timestamp")
 
     @property
     def is_decimal(self) -> bool:
@@ -85,6 +89,8 @@ INTEGER = Type("integer", np.dtype(np.int32))
 DOUBLE = Type("double", np.dtype(np.float64))
 BOOLEAN = Type("boolean", np.dtype(np.bool_))
 DATE = Type("date", np.dtype(np.int32))
+TIMESTAMP = Type("timestamp", np.dtype(np.int64))
+MICROS_PER_DAY = 86_400_000_000
 VARCHAR = Type("varchar", np.dtype(np.int32), dictionary=True)
 
 
@@ -103,6 +109,8 @@ def common_super_type(a: Type, b: Type) -> Type:
     coercion matrix, metadata/FunctionRegistry.java:349)."""
     if a == b:
         return a
+    if {a.name, b.name} == {"date", "timestamp"}:
+        return TIMESTAMP
     order = {"boolean": 0, "integer": 1, "date": 1, "bigint": 2, "decimal": 3, "double": 4}
     if a.name in order and b.name in order:
         winner = a if order[a.name] >= order[b.name] else b
@@ -137,6 +145,7 @@ def parse_type(s: str) -> Type:
         "double precision": DOUBLE,
         "boolean": BOOLEAN,
         "date": DATE,
+        "timestamp": TIMESTAMP,
     }
     if s in m:
         return m[s]

@@ -1,0 +1,207 @@
+"""TIMESTAMP type end-to-end: literals, casts, extraction, truncation,
+interval arithmetic, date_add/date_diff, group-by and order-by.
+
+Reference analog: presto-main/src/test/.../scalar/TestDateTimeFunctions.java
+and spi/type/TimestampType.java (epoch millis there; epoch micros here).
+Expectations are computed with python datetime (no sqlite dependency —
+sqlite has no native timestamp type either).
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.page import Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import BIGINT, DATE, DOUBLE, TIMESTAMP
+
+EPOCH = datetime.datetime(1970, 1, 1)
+
+
+def ts(s: str) -> int:
+    dt = datetime.datetime.fromisoformat(s)
+    delta = dt - EPOCH
+    return (delta.days * 86_400 + delta.seconds) * 1_000_000 + delta.microseconds
+
+
+def days(s: str) -> int:
+    return (datetime.date.fromisoformat(s) - EPOCH.date()).days
+
+
+ROWS = [
+    # (id, created_at, event_date, amount)
+    (1, "2021-01-31 10:30:15.250000", "2021-01-31", 10.0),
+    (2, "2021-02-28 23:59:59", "2021-02-28", 20.0),
+    (3, "2021-03-01 00:00:00", "2021-03-01", 30.0),
+    (4, "2020-02-29 12:00:00", "2020-02-29", 40.0),
+    (5, "1969-12-31 23:00:00", "1969-12-31", 50.0),
+    (6, "2021-01-31 10:45:00", "2021-01-31", 60.0),
+]
+
+
+@pytest.fixture(scope="module")
+def runner():
+    mem = MemoryConnector()
+    schema = [("id", BIGINT), ("created_at", TIMESTAMP),
+              ("event_date", DATE), ("amount", DOUBLE)]
+    page = Page.from_arrays(
+        [np.array([r[0] for r in ROWS], dtype=np.int64),
+         np.array([ts(r[1]) for r in ROWS], dtype=np.int64),
+         np.array([days(r[2]) for r in ROWS], dtype=np.int32),
+         np.array([r[3] for r in ROWS], dtype=np.float64)],
+        [t for _, t in schema],
+    )
+    mem.create_table("events", schema, [page])
+    catalog = Catalog()
+    catalog.register("mem", mem)
+    return QueryRunner(catalog)
+
+
+def dt(s: str) -> datetime.datetime:
+    return datetime.datetime.fromisoformat(s)
+
+
+def test_timestamp_roundtrip(runner):
+    rows = runner.execute(
+        "select id, created_at from events order by id").rows
+    assert rows == [(r[0], dt(r[1])) for r in ROWS]
+
+
+def test_timestamp_literal_filter(runner):
+    rows = runner.execute(
+        "select id from events where created_at > timestamp '2021-02-01 00:00:00'"
+        " order by id").rows
+    assert rows == [(2,), (3,)]
+
+
+def test_timestamp_vs_date_coercion(runner):
+    # comparing timestamp with a date literal promotes the date to midnight
+    rows = runner.execute(
+        "select id from events where created_at >= date '2021-03-01'").rows
+    assert rows == [(3,)]
+    rows = runner.execute(
+        "select id from events where cast(event_date as timestamp) = "
+        "date_trunc('day', created_at) order by id").rows
+    assert rows == [(1,), (2,), (3,), (4,), (5,), (6,)]
+
+
+def test_extract_fields(runner):
+    rows = runner.execute(
+        "select id, extract(year from created_at), extract(month from created_at),"
+        " extract(day from created_at), extract(hour from created_at),"
+        " extract(minute from created_at), extract(second from created_at)"
+        " from events order by id").rows
+    for (i, y, m, d, h, mi, s), r in zip(rows, ROWS):
+        e = dt(r[1])
+        assert (y, m, d, h, mi, s) == (e.year, e.month, e.day, e.hour, e.minute, e.second), i
+
+
+def test_hour_minute_second_millisecond(runner):
+    rows = runner.execute(
+        "select hour(created_at), minute(created_at), second(created_at),"
+        " millisecond(created_at) from events where id = 1").rows
+    assert rows == [(10, 30, 15, 250)]
+
+
+def test_date_trunc(runner):
+    rows = runner.execute(
+        "select date_trunc('hour', created_at), date_trunc('month', created_at),"
+        " date_trunc('year', created_at), date_trunc('week', created_at)"
+        " from events where id = 1").rows
+    assert rows == [(dt("2021-01-31 10:00:00"), dt("2021-01-01"),
+                     dt("2021-01-01"), dt("2021-01-25"))]
+
+
+def test_date_trunc_on_date(runner):
+    rows = runner.execute(
+        "select date_trunc('month', event_date), date_trunc('quarter', event_date)"
+        " from events where id = 2").rows
+    assert rows == [(days("2021-02-01"), days("2021-01-01"))]
+
+
+def test_interval_arith_on_timestamp_column(runner):
+    rows = runner.execute(
+        "select created_at + interval '90' minute from events where id = 2").rows
+    assert rows == [(dt("2021-03-01 01:29:59"),)]
+    rows = runner.execute(
+        "select created_at - interval '1' month from events where id = 3").rows
+    assert rows == [(dt("2021-02-01"),)]
+    # day-of-month clamping: Jan 31 + 1 month = Feb 28 (2021 not a leap year)
+    rows = runner.execute(
+        "select created_at + interval '1' month from events where id = 1").rows
+    assert rows == [(dt("2021-02-28 10:30:15.250000"),)]
+
+
+def test_interval_arith_literal(runner):
+    rows = runner.execute(
+        "select timestamp '2021-01-31 10:00:00' + interval '2' hour").rows
+    assert rows == [(dt("2021-01-31 12:00:00"),)]
+    rows = runner.execute("select date '2021-01-31' + interval '1' month").rows
+    assert rows == [(days("2021-02-28"),)]
+
+
+def test_interval_month_on_date_column(runner):
+    rows = runner.execute(
+        "select event_date + interval '1' month from events where id = 4").rows
+    assert rows == [(days("2020-03-29"),)]
+    rows = runner.execute(
+        "select event_date - interval '1' year from events where id = 1").rows
+    assert rows == [(days("2020-01-31"),)]
+
+
+def test_date_add_diff(runner):
+    rows = runner.execute(
+        "select date_add('hour', 3, created_at), date_add('month', 2, event_date)"
+        " from events where id = 2").rows
+    assert rows == [(dt("2021-03-01 02:59:59"), days("2021-04-28"))]
+    rows = runner.execute(
+        "select date_diff('day', date '2021-01-01', event_date),"
+        " date_diff('hour', timestamp '2021-02-28 00:00:00', created_at)"
+        " from events where id = 2").rows
+    assert rows == [(58, 23)]
+    rows = runner.execute(
+        "select date_diff('month', date '2020-11-15', event_date) from events"
+        " where id = 3").rows
+    assert rows == [(4,)]
+
+
+def test_unixtime(runner):
+    rows = runner.execute(
+        "select to_unixtime(created_at) from events where id = 3").rows
+    assert rows == [(ts("2021-03-01 00:00:00") / 1e6,)]
+    rows = runner.execute(
+        "select from_unixtime(1614556800) ").rows
+    assert rows == [(dt("2021-03-01"),)]
+
+
+def test_cast_timestamp_date(runner):
+    rows = runner.execute(
+        "select cast(created_at as date) from events where id = 5").rows
+    assert rows == [(days("1969-12-31"),)]  # floor, not trunc-toward-zero
+    rows = runner.execute(
+        "select cast(event_date as timestamp) from events where id = 3").rows
+    assert rows == [(dt("2021-03-01 00:00:00"),)]
+
+
+def test_group_by_timestamp(runner):
+    rows = runner.execute(
+        "select date_trunc('day', created_at) as d, count(*), sum(amount)"
+        " from events group by date_trunc('day', created_at)"
+        " order by d").rows
+    expect = {}
+    for r in ROWS:
+        k = dt(r[1]).replace(hour=0, minute=0, second=0, microsecond=0)
+        c, s = expect.get(k, (0, 0.0))
+        expect[k] = (c + 1, s + r[3])
+    want = sorted((k, c, s) for k, (c, s) in expect.items())
+    assert rows == want
+
+
+def test_min_max_timestamp(runner):
+    rows = runner.execute(
+        "select min(created_at), max(created_at) from events").rows
+    all_ts = [dt(r[1]) for r in ROWS]
+    assert rows == [(min(all_ts), max(all_ts))]
