@@ -39,6 +39,7 @@ from presto_tpu.planner.plan import (
     AggregationNode,
     CrossSingleNode,
     FilterNode,
+    GroupIdNode,
     JoinNode,
     LimitNode,
     OutputNode,
@@ -170,7 +171,11 @@ def _split_pruned(constraints, stats) -> bool:
 
 def _is_streaming_join(node: JoinNode) -> bool:
     """True when the probe is row-aligned (jittable in a chain):
-    semi/anti (presence tests) or unique-key builds."""
+    semi/anti (presence tests) or unique-key builds. FULL joins always
+    take the materializing path — the unmatched-build tail needs
+    cross-page match state."""
+    if node.kind == "full":
+        return False
     return node.kind in ("semi", "anti") or node.unique_build
 
 
@@ -357,6 +362,10 @@ class LocalRunner:
             yield fn(src)
             return
 
+        if isinstance(node, GroupIdNode):
+            yield from self._groupid_pages(node)
+            return
+
         if isinstance(node, JoinNode) and not _is_streaming_join(node):
             yield from self._expanding_join_pages(node)
             return
@@ -499,12 +508,13 @@ class LocalRunner:
         kd = node.key_domains
         left_keys = list(node.left_keys)
         build_output = list(range(len(node.right.channels)))
-        kind = node.kind
+        is_full = node.kind == "full"
+        kind = "left" if is_full else node.kind
 
         def probe(b, p, out_capacity):
             return probe_expand(
                 b, p, left_keys, out_capacity, key_domains=kd,
-                kind=kind, build_output=build_output,
+                kind=kind, build_output=build_output, return_matched=is_full,
             )
 
         if node in self._chain_cache:
@@ -513,14 +523,62 @@ class LocalRunner:
             fn = jax.jit(probe, static_argnames=("out_capacity",)) if self.jit else probe
             self._chain_cache[node] = fn
 
+        matched_acc = None
         for p in self._pages(node.left):
             cap = max(int(p.capacity), 1024)
-            out, total = fn(build, p, out_capacity=cap)
-            t = int(np.asarray(total))
+            res = fn(build, p, out_capacity=cap)
+            t = int(np.asarray(res[1]))
             if t > cap:
                 cap2 = 1 << (t - 1).bit_length()
-                out, _ = fn(build, p, out_capacity=cap2)
-            yield out
+                res = fn(build, p, out_capacity=cap2)
+            yield res[0]
+            if is_full:
+                matched_acc = res[2] if matched_acc is None else matched_acc | res[2]
+
+        if is_full:
+            from presto_tpu.ops.join import outer_build_tail
+
+            if matched_acc is None:
+                matched_acc = jnp.zeros((build.page.capacity,), dtype=jnp.bool_)
+            probe_spec = [(c.type, c.dictionary) for c in node.left.channels]
+            yield outer_build_tail(build, matched_acc, probe_spec, build_output)
+
+    # ------------------------------------------------------------------
+    def _groupid_pages(self, node: GroupIdNode) -> Iterator[Page]:
+        """Emit each source page once per grouping set: source blocks +
+        key blocks (inactive keys NULL-masked) + constant $group_id
+        (GroupIdOperator.java analog; replication stays on device)."""
+        fns = self._fold_cache.get(node)
+        if fns is None:
+            from presto_tpu.expr.compile import ExprCompiler
+
+            key_exprs = list(node.key_exprs)
+            nsrc = len(node.source.channels)
+            key_chans = node.channels[nsrc:nsrc + len(key_exprs)]
+
+            def make(mask, gid):
+                def run(p: Page) -> Page:
+                    comp = ExprCompiler.for_page(p)
+                    blocks = list(p.blocks)
+                    for e, live, ch in zip(key_exprs, mask, key_chans):
+                        d, v = comp.compile(e)(p)
+                        if not live:
+                            v = jnp.zeros_like(v)
+                        blocks.append(Block(d, v, e.type, ch.dictionary))
+                    gid_data = jnp.full((p.capacity,), gid, dtype=jnp.int64)
+                    blocks.append(
+                        Block(gid_data, jnp.ones(p.capacity, dtype=jnp.bool_),
+                              node.channels[-1].type)
+                    )
+                    return Page(tuple(blocks), p.row_mask)
+
+                return jax.jit(run) if self.jit else run
+
+            fns = [make(mask, gid) for gid, mask in enumerate(node.set_masks)]
+            self._fold_cache[node] = fns
+        for p in self._pages(node.source):
+            for fn in fns:
+                yield fn(p)
 
     # ------------------------------------------------------------------
     def _run_topn(self, node: TopNNode) -> Page:

@@ -140,6 +140,7 @@ def probe_expand(
     key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
     kind: str = "inner",
     build_output: Optional[Sequence[int]] = None,
+    return_matched: bool = False,
 ) -> Tuple[Page, jax.Array]:
     """Many-to-many join: each probe row emits one output row per
     matching build row. Returns (page, total_matches); if
@@ -147,7 +148,13 @@ def probe_expand(
     must re-probe in chunks.
 
     kind: inner | left (left emits one null-extended row for probes
-    with no match)."""
+    with no match).
+
+    return_matched: additionally return a bool (build_capacity,) mask of
+    build rows touched by a match — the driver ORs these across probe
+    pages to emit the FULL OUTER tail (reference:
+    operator/LookupOuterOperator.java, which streams unvisited build
+    positions after all probes finish)."""
     key, _ = _probe_keys(probe, probe_key_exprs, key_domains)
     lo = jnp.searchsorted(build.sorted_keys, key, side="left")
     hi = jnp.searchsorted(build.sorted_keys, key, side="right")
@@ -179,4 +186,31 @@ def probe_expand(
         out_blocks.append(
             Block(b.data[b_row], b.valid[b_row] & matched & live_out, b.type, b.dictionary)
         )
-    return Page(tuple(out_blocks), live_out), total
+    out_page = Page(tuple(out_blocks), live_out)
+    if return_matched:
+        b_matched = jnp.zeros((build.page.capacity,), dtype=jnp.bool_)
+        b_matched = b_matched.at[b_row].max(matched & live_out, mode="drop")
+        return out_page, total, b_matched
+    return out_page, total
+
+
+def outer_build_tail(
+    build: JoinBuild,
+    matched: jax.Array,
+    probe_types_dicts: Sequence[Tuple],
+    build_output: Optional[Sequence[int]] = None,
+) -> Page:
+    """FULL OUTER tail: build rows never matched by any probe page,
+    null-extended on the probe columns. ``probe_types_dicts`` is
+    [(Type, Dictionary|None)] for the probe side's output layout."""
+    cap = build.page.capacity
+    blocks: List[Block] = []
+    for t, d in probe_types_dicts:
+        blocks.append(
+            Block(jnp.zeros(cap, dtype=t.np_dtype), jnp.zeros(cap, dtype=jnp.bool_), t, d)
+        )
+    if build_output is None:
+        build_output = range(len(build.page.blocks))
+    for i in build_output:
+        blocks.append(build.page.blocks[i])
+    return Page(tuple(blocks), build.page.row_mask & jnp.logical_not(matched))

@@ -41,20 +41,29 @@ from presto_tpu.types import BIGINT, DOUBLE, Type
 class WindowFunc:
     """One window function application.
 
-    kind: row_number | rank | dense_rank | ntile? (later) |
-          sum | avg | min | max | count | count_star |
-          lead | lag | first_value | last_value
+    kind: row_number | rank | dense_rank | ntile | percent_rank |
+          cume_dist | nth_value | sum | avg | min | max | count |
+          count_star | lead | lag | first_value | last_value
+
+    frame: None for the default frame (RANGE UNBOUNDED PRECEDING ..
+    CURRENT ROW with ORDER BY, whole partition without — the same
+    default as the reference, operator/window/WindowOperator.java);
+    ("whole",) for the entire partition;
+    ("rows", start, end) for a ROWS frame with signed row offsets
+    relative to the current row (None = unbounded in that direction).
     """
 
     kind: str
     arg: Optional[Expr] = None
-    offset: int = 1  # lead/lag
+    offset: int = 1  # lead/lag offset; ntile buckets; nth_value n
+    frame: Optional[tuple] = None
 
     @property
     def type(self) -> Type:
-        if self.kind in ("row_number", "rank", "dense_rank", "count", "count_star"):
+        if self.kind in ("row_number", "rank", "dense_rank", "count", "count_star",
+                         "ntile"):
             return BIGINT
-        if self.kind == "avg":
+        if self.kind in ("avg", "percent_rank", "cume_dist"):
             return DOUBLE
         if self.kind == "sum":
             from presto_tpu.ops.aggregate import _sum_type
@@ -139,13 +148,14 @@ def window_page(
     )
 
     has_order = len(order_exprs) > 0
+    seg_last = _segment_last(seg_first, cap)
 
     # ---- 3. per-function computation in sorted space -----------------
     out_blocks: List[Block] = list(page.blocks)
     for f in funcs:
         data_s, valid_s = _compute_sorted(
             f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
-            seg_start, last_peer, has_order,
+            seg_start, last_peer, has_order, seg_last,
         )
         # ---- 4. scatter back to original order ----------------------
         data = jnp.zeros_like(data_s).at[perm].set(data_s)
@@ -155,7 +165,7 @@ def window_page(
 
 
 def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
-                    seg_start, last_peer, has_order):
+                    seg_start, last_peer, has_order, seg_last):
     if f.kind == "row_number":
         rn = (idx - seg_start + 1).astype(jnp.int64)
         return rn, jnp.ones(cap, jnp.bool_)
@@ -166,6 +176,29 @@ def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
         cum = jnp.cumsum(peer_first.astype(jnp.int32))
         cum_at_start = cum[seg_start]
         return (cum - cum_at_start + 1).astype(jnp.int64), jnp.ones(cap, jnp.bool_)
+    if f.kind == "ntile":
+        # presto semantics: first (count % n) buckets get one extra row
+        n = f.offset
+        rn0 = (idx - seg_start).astype(jnp.int64)
+        count = (seg_last - seg_start + 1).astype(jnp.int64)
+        q, r = count // n, count % n
+        big = (q + 1) * r  # rows covered by the larger buckets
+        bucket = jnp.where(
+            rn0 < big,
+            rn0 // jnp.maximum(q + 1, 1),
+            r + (rn0 - big) // jnp.maximum(q, 1),
+        )
+        return bucket + 1, jnp.ones(cap, jnp.bool_)
+    if f.kind == "percent_rank":
+        fp_pos = jax.lax.associative_scan(jnp.maximum, jnp.where(peer_first, idx, 0))
+        rank = (fp_pos - seg_start).astype(jnp.float64)
+        count = (seg_last - seg_start).astype(jnp.float64)  # count-1
+        out = jnp.where(count > 0, rank / jnp.maximum(count, 1.0), 0.0)
+        return out, jnp.ones(cap, jnp.bool_)
+    if f.kind == "cume_dist":
+        covered = (last_peer - seg_start + 1).astype(jnp.float64)
+        count = (seg_last - seg_start + 1).astype(jnp.float64)
+        return covered / jnp.maximum(count, 1.0), jnp.ones(cap, jnp.bool_)
 
     if f.kind in ("lead", "lag"):
         d, v = c.compile(f.arg)(page)
@@ -178,42 +211,67 @@ def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
         ok = in_range & same_seg
         return jnp.where(ok, ds[src_c], jnp.zeros_like(ds)), ok & vs[src_c]
 
-    if f.kind == "first_value":
-        d, v = c.compile(f.arg)(page)
-        ds, vs = d[perm], v[perm]
-        return ds[seg_start], vs[seg_start]
-    if f.kind == "last_value":
-        d, v = c.compile(f.arg)(page)
-        ds, vs = d[perm], v[perm]
-        return ds[last_peer], vs[last_peer]  # default frame: up to last peer
+    # ---- frame resolution: each row's [f_start, f_end] in sorted space.
+    # Default: RANGE UNBOUNDED PRECEDING .. CURRENT ROW (end = last
+    # peer) with ORDER BY, whole partition without; ("whole",) forces
+    # the partition; ("rows", s, e) clamps signed offsets to the
+    # segment. empty marks frames that exclude every row.
+    frame = f.frame
+    if frame is not None and frame[0] == "rows":
+        s_off, e_off = frame[1], frame[2]
+        f_start = seg_start if s_off is None else jnp.maximum(seg_start, idx + s_off)
+        f_end = seg_last if e_off is None else jnp.minimum(seg_last, idx + e_off)
+    elif frame == ("whole",) or not has_order:
+        f_start, f_end = seg_start, seg_last
+    else:
+        f_start, f_end = seg_start, last_peer
+    empty = f_end < f_start
+    s_c = jnp.clip(f_start, 0, cap - 1)
+    e_c = jnp.clip(f_end, 0, cap - 1)
 
-    # aggregates
+    if f.kind in ("first_value", "last_value", "nth_value"):
+        d, v = c.compile(f.arg)(page)
+        ds, vs = d[perm], v[perm]
+        if f.kind == "first_value":
+            pos = s_c
+        elif f.kind == "last_value":
+            pos = e_c
+        else:
+            pos = jnp.clip(f_start + (f.offset - 1), 0, cap - 1)
+            empty = empty | (f_start + (f.offset - 1) > f_end)
+        return ds[pos], vs[pos] & jnp.logical_not(empty)
+
+    # aggregates over the frame: global prefix sums + frame-bound
+    # differences (frames never span segments, so a segmented scan is
+    # unnecessary); min/max use the running segmented scan and support
+    # unbounded-start frames only.
     if f.kind == "count_star":
-        cnt = _segmented_scan(jnp.add, live_s.astype(jnp.int64), seg_first)
-        out = cnt[last_peer] if has_order else _broadcast_total(cnt, seg_first, seg_start, cap)
-        return out, jnp.ones(cap, jnp.bool_)
+        vcount = live_s
+    else:
+        d, v = c.compile(f.arg)(page)
+        ds, vs = d[perm], v[perm] & live_s
+        vcount = vs
 
-    d, v = c.compile(f.arg)(page)
-    ds, vs = d[perm], v[perm] & live_s
-    if f.kind == "count":
-        cnt = _segmented_scan(jnp.add, vs.astype(jnp.int64), seg_first)
-        out = cnt[last_peer] if has_order else _broadcast_total(cnt, seg_first, seg_start, cap)
-        return out, jnp.ones(cap, jnp.bool_)
+    def frame_sum(vals):
+        p = jnp.cumsum(vals, axis=0)
+        out = p[e_c] - p[s_c] + vals[s_c]
+        return jnp.where(empty, jnp.zeros_like(out), out)
+
+    cnt = frame_sum(vcount.astype(jnp.int64))
+    if f.kind in ("count", "count_star"):
+        return cnt, jnp.ones(cap, jnp.bool_)
     if f.kind in ("sum", "avg"):
         from presto_tpu.ops.aggregate import _sum_type
 
         st = _sum_type(f.arg.type)
         vals = jnp.where(vs, ds.astype(st.np_dtype), jnp.zeros((), st.np_dtype))
-        s = _segmented_scan(jnp.add, vals, seg_first)
-        cnt = _segmented_scan(jnp.add, vs.astype(jnp.int64), seg_first)
-        s_out = s[last_peer] if has_order else _broadcast_total(s, seg_first, seg_start, cap)
-        c_out = cnt[last_peer] if has_order else _broadcast_total(cnt, seg_first, seg_start, cap)
+        s_out = frame_sum(vals)
         if f.kind == "sum":
-            return s_out, c_out > 0
+            return s_out, cnt > 0
         num = s_out.astype(jnp.float64)
         if st.is_decimal:
             num = num / (10.0 ** st.scale)
-        return num / jnp.maximum(c_out, 1).astype(jnp.float64), c_out > 0
+        return num / jnp.maximum(cnt, 1).astype(jnp.float64), cnt > 0
     if f.kind in ("min", "max"):
         from presto_tpu.ops.aggregate import _type_max, _type_min
 
@@ -221,10 +279,9 @@ def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
         op = jnp.minimum if f.kind == "min" else jnp.maximum
         vals = jnp.where(vs, ds, fill)
         m = _segmented_scan(op, vals, seg_first)
-        cnt = _segmented_scan(jnp.add, vs.astype(jnp.int64), seg_first)
-        m_out = m[last_peer] if has_order else _broadcast_total_op(m, seg_first, seg_start, cap)
-        c_out = cnt[last_peer] if has_order else _broadcast_total(cnt, seg_first, seg_start, cap)
-        return m_out, c_out > 0
+        # running scan value at the frame end (start must be unbounded —
+        # enforced at bind time, sql/binder.py _register_window)
+        return m[e_c], cnt > 0
     raise KeyError(f.kind)
 
 

@@ -175,6 +175,40 @@ class AggregationNode(PlanNode):
 
 
 @dataclasses.dataclass(eq=False)
+class GroupIdNode(PlanNode):
+    """Grouping-set row replication (operator/GroupIdOperator.java
+    analog). Each input page is emitted once per grouping set with the
+    set's inactive key channels masked to NULL plus a constant $group_id
+    channel; a single downstream aggregation grouped by
+    (keys..., $group_id) then computes every set in one pass — the
+    TPU-friendly form of GROUPING SETS / ROLLUP / CUBE (no per-set
+    re-scan, all replicas are device-resident concatenations).
+
+    Output channel layout: source channels, then one channel per key
+    expression, then $group_id.
+    """
+
+    source: PlanNode
+    key_exprs: List[Expr]
+    key_names: List[str]
+    # per grouping set: which key positions are live (unmasked)
+    set_masks: List[List[bool]]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def channels(self) -> List[Channel]:
+        src = self.source.channels
+        keys = [_expr_channel(e, n, src) for e, n in zip(self.key_exprs, self.key_names)]
+        from presto_tpu.types import BIGINT as _BIGINT
+
+        gid = Channel("$group_id", _BIGINT, None, (0, max(len(self.set_masks) - 1, 0)))
+        return src + keys + [gid]
+
+
+@dataclasses.dataclass(eq=False)
 class JoinNode(PlanNode):
     """Hash join (JoinNode.java analog). ``left`` is the probe side,
     ``right`` the build side (the reference also builds on the right).

@@ -148,11 +148,17 @@ class FuncCall(Node):
 
 @dataclasses.dataclass(frozen=True)
 class WindowExpr(Node):
-    """fn(...) OVER (PARTITION BY ... ORDER BY ...)."""
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [ROWS|RANGE frame]).
+
+    frame: None for the default, else (type, start, end) with type
+    'rows'|'range' and each bound a (kind, n) pair, kind in
+    unbounded_preceding | preceding | current | following |
+    unbounded_following."""
 
     func: "FuncCall"
     partition_by: Tuple[Node, ...] = ()
     order_by: Tuple["OrderItem", ...] = ()
+    frame: Optional[Tuple[str, Tuple[str, int], Tuple[str, int]]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -169,6 +175,23 @@ class ScalarSubquery(Node):
 @dataclasses.dataclass(frozen=True)
 class Star(Node):
     qualifier: Optional[str] = None
+
+
+# -- grouping-set group-by items ---------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rollup(Node):
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Cube(Node):
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupingSets(Node):
+    sets: Tuple[Tuple[Node, ...], ...]
 
 
 # -- relations ---------------------------------------------------------------

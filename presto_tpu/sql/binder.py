@@ -37,6 +37,7 @@ from presto_tpu.planner.plan import (
     Channel,
     CrossSingleNode,
     FilterNode,
+    GroupIdNode,
     JoinNode,
     LimitNode,
     OutputNode,
@@ -74,7 +75,8 @@ _WIN_BASE = 1 << 24
 
 WINDOW_FUNCTIONS = {
     "row_number", "rank", "dense_rank", "lead", "lag",
-    "first_value", "last_value",
+    "first_value", "last_value", "ntile", "percent_rank", "cume_dist",
+    "nth_value",
 } | AGG_FUNCTIONS
 
 # scalar builtins (reference: operator/scalar/ ~130 files; the engine's
@@ -372,13 +374,14 @@ class Binder:
 
     def _plan_join_rel(self, rel: ast.JoinRel) -> Tuple[PlanNode, Scope]:
         """Explicit JOIN trees. Inner joins route through the join-graph
-        planner; LEFT joins are planned directly (null-extension pins
-        probe/build sides)."""
+        planner; LEFT/FULL joins are planned directly (null-extension
+        pins probe/build sides). Reference: LookupJoinOperators.java:37
+        (innerJoin/probeOuterJoin/lookupOuterJoin/fullOuterJoin)."""
         if rel.kind in ("inner", "cross"):
             terms, conjuncts = self._flatten_from([rel])
             node, scope, _ = self._join_terms(terms, conjuncts)
             return node, scope
-        assert rel.kind == "left", rel.kind
+        assert rel.kind in ("left", "full"), rel.kind
         lnode, lscope = self._plan_relation(rel.left)
         rnode, rscope = self._plan_relation(rel.right)
         glob = lscope.concat(rscope)
@@ -400,18 +403,20 @@ class Binder:
                     a, b = b, a
                 lkeys.append(a)
                 rkeys.append(ColumnRef(type=b.type, index=b.index - len(lscope)))
-            elif not left_refs:
+            elif not left_refs and rel.kind == "left":
                 # right-side-only ON predicate: prefilter build (valid
-                # for LEFT joins — unmatched probes still null-extend)
+                # for LEFT joins — unmatched probes still null-extend;
+                # NOT valid for FULL, where filtered build rows must
+                # still appear null-extended)
                 rmap = {r: r - len(lscope) for r in right_refs}
                 rnode = FilterNode(rnode, remap_expr(ir, rmap))
             else:
-                raise BindError(f"unsupported LEFT JOIN ON predicate: {c!r}")
+                raise BindError(f"unsupported {rel.kind.upper()} JOIN ON predicate: {c!r}")
         if not lkeys:
-            raise BindError("LEFT JOIN requires at least one equi-condition")
+            raise BindError(f"{rel.kind.upper()} JOIN requires at least one equi-condition")
         join = JoinNode(
             left=lnode, right=rnode, left_keys=lkeys, right_keys=rkeys,
-            kind="left", unique_build=self._build_is_unique(rnode, rkeys),
+            kind=rel.kind, unique_build=self._build_is_unique(rnode, rkeys),
         )
         return join, glob
 
@@ -683,7 +688,11 @@ class Binder:
             items[int(g.text) - 1][0] if isinstance(g, ast.NumberLit) else g
             for g in group_asts
         ]
-        has_aggs = bool(group_asts) or any(
+        grouping_sets = None
+        expanded = self._expand_grouping(group_asts)
+        if expanded is not None:
+            group_asts, grouping_sets = expanded
+        has_aggs = bool(group_asts) or grouping_sets is not None or any(
             self._contains_agg(e) for e, _ in items
         ) or (q.having is not None and self._contains_agg(q.having))
 
@@ -691,7 +700,8 @@ class Binder:
 
         if has_aggs:
             node, out_irs, names, order_irs = self._plan_aggregation(
-                node, scope, items, group_asts, q.having, order_items
+                node, scope, items, group_asts, q.having, order_items,
+                grouping_sets=grouping_sets,
             )
         else:
             if q.having is not None:
@@ -742,6 +752,42 @@ class Binder:
             )
         return node, names
 
+    def _expand_grouping(self, group_by) -> Optional[Tuple[List[ast.Node], List[List[int]]]]:
+        """Expand ROLLUP/CUBE/GROUPING SETS group-by items into
+        (full key list, grouping sets as key-index lists); None for plain
+        GROUP BY. Mixed items combine by cartesian concatenation, the
+        reference's semantics (sql/analyzer/StatementAnalyzer.java
+        analyzeGroupBy: cross product of grouping-element sets)."""
+        comps: List[List[Tuple[ast.Node, ...]]] = []
+        plain = True
+        for g in group_by:
+            if isinstance(g, ast.Rollup):
+                comps.append([tuple(g.items[:i]) for i in range(len(g.items), -1, -1)])
+                plain = False
+            elif isinstance(g, ast.Cube):
+                sets = []
+                for bits in range(1 << len(g.items)):
+                    sets.append(tuple(e for i, e in enumerate(g.items) if bits & (1 << i)))
+                comps.append(sets)
+                plain = False
+            elif isinstance(g, ast.GroupingSets):
+                comps.append([tuple(s) for s in g.sets])
+                plain = False
+            else:
+                comps.append([(g,)])
+        if plain:
+            return None
+        combined: List[Tuple[ast.Node, ...]] = [()]
+        for sets in comps:
+            combined = [c + s for c in combined for s in sets]
+        full: List[ast.Node] = []
+        for s in combined:
+            for e in s:
+                if e not in full:
+                    full.append(e)
+        sets_idx = [sorted({full.index(e) for e in s}) for s in combined]
+        return full, sets_idx
+
     def _distinct_capacity(self, node: PlanNode) -> int:
         est = int(self._estimate(node))
         return max(1 << 10, min(1 << (max(est - 1, 1)).bit_length(), 1 << 24))
@@ -772,8 +818,21 @@ class Binder:
         return False
 
     # ------------------------------------------------------------------
-    def _plan_aggregation(self, node, scope, items, group_asts, having, order_items):
+    def _plan_aggregation(self, node, scope, items, group_asts, having, order_items,
+                          grouping_sets=None):
         group_irs = [self._bind(g, scope) for g in group_asts]
+        if grouping_sets is not None:
+            # GROUPING SETS: replicate rows per set via GroupIdNode and
+            # aggregate once grouped by (keys..., $group_id); inactive
+            # keys are NULL-masked so each set groups independently.
+            nsrc = len(scope)
+            key_names = [self._derive_name(g) for g in group_asts]
+            masks = [[i in s for i in range(len(group_asts))] for s in grouping_sets]
+            node = GroupIdNode(node, group_irs, key_names, masks)
+            group_irs = [
+                ColumnRef(type=g.type, index=nsrc + i, name=key_names[i])
+                for i, g in enumerate(group_irs)
+            ] + [ColumnRef(type=BIGINT, index=nsrc + len(group_asts), name="$group_id")]
         agg_ctx = AggCtx(group_asts=group_asts, group_irs=group_irs)
 
         out_irs = [self._bind_agg(e, scope, agg_ctx) for e, _ in items]
@@ -817,6 +876,8 @@ class Binder:
                 order_irs.append(self._bind_agg(e, scope, agg_ctx))
 
         group_names = [self._derive_name(g) for g in group_asts]
+        if grouping_sets is not None:
+            group_names = group_names + ["$group_id"]
         agg_names = [f"$agg{j}" for j in range(len(agg_ctx.aggs))]
 
         # distinct aggregates: rewrite through a distinct pre-aggregation
@@ -1350,21 +1411,29 @@ class Binder:
         kind = name
         arg = None
         offset = 1
-        if name in ("row_number", "rank", "dense_rank"):
+        if name in ("row_number", "rank", "dense_rank", "percent_rank", "cume_dist"):
             if fc.args:
                 raise BindError(f"{name} takes no arguments")
+        elif name == "ntile":
+            if len(fc.args) != 1:
+                raise BindError("ntile takes one argument")
+            n_ir = self._bind_impl(fc.args[0], scope, agg)
+            if not isinstance(n_ir, Literal) or not n_ir.value:
+                raise BindError("ntile bucket count must be a positive literal")
+            offset = int(n_ir.value)
         elif name == "count" and (fc.star or not fc.args):
             kind = "count_star"
         else:
             if not fc.args:
                 raise BindError(f"{name} requires an argument")
             arg = self._bind_impl(fc.args[0], scope, agg)
-            if name in ("lead", "lag") and len(fc.args) > 1:
+            if name in ("lead", "lag", "nth_value") and len(fc.args) > 1:
                 off_ir = self._bind_impl(fc.args[1], scope, agg)
                 if not isinstance(off_ir, Literal):
-                    raise BindError("lead/lag offset must be a literal")
+                    raise BindError(f"{name} offset must be a literal")
                 offset = int(off_ir.value)
-        wf = WindowFunc(kind=kind, arg=arg, offset=offset)
+        frame = self._bind_frame(e.frame, kind)
+        wf = WindowFunc(kind=kind, arg=arg, offset=offset, frame=frame)
         partition_irs = [self._bind_impl(p, scope, agg) for p in e.partition_by]
         order_irs = [self._bind_impl(o.expr, scope, agg) for o in e.order_by]
         ascending = [o.ascending for o in e.order_by]
@@ -1372,6 +1441,33 @@ class Binder:
         self._windows.append((e, wf, partition_irs, order_irs, ascending))
         self._win_slots[e] = slot
         return ColumnRef(type=wf.type, index=_WIN_BASE + slot)
+
+    def _bind_frame(self, frame, kind: str):
+        """AST frame -> WindowFunc.frame. RANGE frames support only the
+        unbounded/current bounds (reference parity: 0.208 rejects RANGE
+        with value offsets); ROWS frames become signed row offsets."""
+        if frame is None:
+            return None
+        ft, (sk, sn), (ek, en) = frame
+        if ft == "range":
+            if sk != "unbounded_preceding":
+                raise BindError("RANGE frame start must be UNBOUNDED PRECEDING")
+            if ek == "current":
+                return None  # the default frame
+            if ek == "unbounded_following":
+                return ("whole",)
+            raise BindError("RANGE frame end must be CURRENT ROW or UNBOUNDED FOLLOWING")
+        s_off = {"unbounded_preceding": None, "preceding": -sn, "current": 0,
+                 "following": sn}.get(sk)
+        e_off = {"unbounded_following": None, "preceding": -en, "current": 0,
+                 "following": en}.get(ek)
+        if sk == "unbounded_following" or ek == "unbounded_preceding":
+            raise BindError("invalid ROWS frame bounds")
+        if kind in ("min", "max") and s_off is not None:
+            raise BindError(f"{kind} supports only UNBOUNDED PRECEDING frame starts")
+        if (s_off, e_off) == (None, None):
+            return ("whole",)
+        return ("rows", s_off, e_off)
 
     def _attach_windows(self, node: PlanNode) -> Tuple[PlanNode, Dict[int, int]]:
         """Build WindowNode(s) above ``node``, grouping registered

@@ -31,7 +31,7 @@ KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having", "order",
     "limit", "as", "and", "or", "not", "in", "like", "between", "is", "null",
     "case", "when", "then", "else", "end", "cast", "extract", "exists",
-    "join", "inner", "left", "right", "outer", "cross", "on", "asc", "desc",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on", "asc", "desc",
     "date", "timestamp", "interval", "year", "month", "day", "true", "false", "substring",
     "for", "nulls", "first", "last", "all", "any", "union",
     "over", "partition",
@@ -170,9 +170,9 @@ class Parser:
         group_by: Tuple[ast.Node, ...] = ()
         if self.accept("group"):
             self.expect("by")
-            g = [self._expr()]
+            g = [self._group_item()]
             while self.accept(","):
-                g.append(self._expr())
+                g.append(self._group_item())
             group_by = tuple(g)
 
         having = self._expr() if self.accept("having") else None
@@ -197,6 +197,61 @@ class Parser:
             select=tuple(items), distinct=distinct, from_=from_, where=where,
             group_by=group_by, having=having, order_by=order_by, limit=limit,
         )
+
+    def _frame_bound(self) -> Tuple[str, int]:
+        if self.accept_word("unbounded"):
+            w = self.accept_word("preceding", "following")
+            if w is None:
+                raise SyntaxError("expected PRECEDING/FOLLOWING after UNBOUNDED")
+            return (f"unbounded_{w}", 0)
+        if self.accept_word("current"):
+            if self.accept_word("row") is None:
+                raise SyntaxError("expected ROW after CURRENT")
+            return ("current", 0)
+        t = self.tok
+        if t.kind != "number":
+            raise SyntaxError(f"expected frame bound, got {t!r}")
+        self.i += 1
+        w = self.accept_word("preceding", "following")
+        if w is None:
+            raise SyntaxError("expected PRECEDING/FOLLOWING after frame offset")
+        return (w, int(t.value))
+
+    def _group_item(self) -> ast.Node:
+        """GROUP BY item: expr | ROLLUP(...) | CUBE(...) |
+        GROUPING SETS ((a, b), (a), ())."""
+        t = self.tok
+        if t.kind == "ident" and t.value.lower() in ("rollup", "cube") and self.peek2("("):
+            name = t.value.lower()
+            self.i += 1
+            self.expect("(")
+            items = [self._expr()]
+            while self.accept(","):
+                items.append(self._expr())
+            self.expect(")")
+            return ast.Rollup(tuple(items)) if name == "rollup" else ast.Cube(tuple(items))
+        nxt = self.tokens[self.i + 1]
+        if (t.kind == "ident" and t.value.lower() == "grouping"
+                and nxt.kind == "ident" and nxt.value.lower() == "sets"):
+            self.i += 2
+            self.expect("(")
+            sets = []
+            while True:
+                if self.accept("("):
+                    s: List[ast.Node] = []
+                    if not self.peek(")"):
+                        s.append(self._expr())
+                        while self.accept(","):
+                            s.append(self._expr())
+                    self.expect(")")
+                    sets.append(tuple(s))
+                else:
+                    sets.append((self._expr(),))
+                if not self.accept(","):
+                    break
+            self.expect(")")
+            return ast.GroupingSets(tuple(sets))
+        return self._expr()
 
     def _select_item(self) -> ast.SelectItem:
         if self.peek("*"):
@@ -252,6 +307,10 @@ class Parser:
                 self.accept("outer")
             elif self.peek("right"):
                 kind = "right"
+                self.i += 1
+                self.accept("outer")
+            elif self.peek("full"):
+                kind = "full"
                 self.i += 1
                 self.accept("outer")
             if kind is None:
@@ -521,8 +580,19 @@ class Parser:
                         order.append(self._order_item())
                         while self.accept(","):
                             order.append(self._order_item())
+                    frame = None
+                    ft = self.accept_word("rows", "range")
+                    if ft is not None:
+                        if self.accept("between"):
+                            fs = self._frame_bound()
+                            self.expect("and")
+                            fe = self._frame_bound()
+                        else:
+                            fs = self._frame_bound()
+                            fe = ("current", 0)
+                        frame = (ft, fs, fe)
                     self.expect(")")
-                    return ast.WindowExpr(fc, tuple(partition), tuple(order))
+                    return ast.WindowExpr(fc, tuple(partition), tuple(order), frame)
                 return fc
             parts = [name]
             while self.peek(".") :

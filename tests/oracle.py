@@ -154,12 +154,20 @@ def register_scalar_udfs(conn: sqlite3.Connection) -> None:
 
 
 def _key(row: Sequence) -> tuple:
+    """Total-order sort key across mixed/NULL columns (outer joins emit
+    None alongside ints/strings; bare tuples would TypeError)."""
     out = []
     for v in row:
-        if isinstance(v, float):
-            out.append(round(v, 2))
+        if v is None:
+            out.append((0, 0, ""))
+        elif isinstance(v, bool):
+            out.append((1, int(v), ""))
+        elif isinstance(v, float):
+            out.append((1, round(v, 2), ""))
+        elif isinstance(v, int):
+            out.append((1, v, ""))
         else:
-            out.append(v)
+            out.append((2, 0, str(v)))
     return tuple(out)
 
 

@@ -55,6 +55,43 @@ WINDOW_QUERIES = [
     # unpartitioned window
     """select o_orderkey, row_number() over (order by o_totalprice desc, o_orderkey) as rn
        from orders limit 10000""",
+    # ntile / percent_rank / cume_dist
+    """select c_custkey,
+              ntile(4) over (partition by c_nationkey order by c_acctbal, c_custkey) as quartile,
+              percent_rank() over (partition by c_nationkey order by c_acctbal) as pr,
+              cume_dist() over (partition by c_nationkey order by c_acctbal) as cd
+       from customer""",
+    # ROWS BETWEEN n PRECEDING AND CURRENT ROW (moving aggregates)
+    """select o_orderkey,
+              sum(o_totalprice) over (partition by o_custkey order by o_orderdate, o_orderkey
+                                      rows between 2 preceding and current row) as moving_sum,
+              avg(o_totalprice) over (partition by o_custkey order by o_orderdate, o_orderkey
+                                      rows between 2 preceding and current row) as moving_avg
+       from orders""",
+    # ROWS with FOLLOWING end and both-sided window
+    """select s_suppkey,
+              count(*) over (partition by s_nationkey order by s_acctbal, s_suppkey
+                             rows between 1 preceding and 1 following) as c3,
+              sum(s_acctbal) over (partition by s_nationkey order by s_acctbal, s_suppkey
+                                   rows between current row and 2 following) as ahead
+       from supplier""",
+    # ROWS UNBOUNDED PRECEDING (running, row-based not peer-based)
+    """select o_orderkey,
+              max(o_totalprice) over (partition by o_custkey order by o_orderdate, o_orderkey
+                                      rows between unbounded preceding and current row) as run_max,
+              last_value(o_totalprice) over (partition by o_custkey order by o_orderdate, o_orderkey
+                                             rows between unbounded preceding and current row) as lv
+       from orders""",
+    # RANGE BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING
+    """select s_suppkey,
+              sum(s_acctbal) over (partition by s_nationkey order by s_acctbal
+                                   range between unbounded preceding and unbounded following) as tot
+       from supplier""",
+    # nth_value over the default frame
+    """select o_orderkey,
+              nth_value(o_totalprice, 2) over (partition by o_custkey
+                                               order by o_orderdate, o_orderkey) as second_price
+       from orders""",
 ]
 
 
