@@ -678,10 +678,43 @@ class LocalRunner:
             else:
                 acc = fold_fn(acc, p)
         if acc is None:
-            return Page.empty(node.output_types, max(mg, 1))
+            return self._groupid_empty_fixup(node, Page.empty(node.output_types, max(mg, 1)))
         out = final_fn(acc)
         self._check_overflow(node, out, mg)
-        return out
+        return self._groupid_empty_fixup(node, out)
+
+    def _groupid_empty_fixup(self, node: AggregationNode, out: Page) -> Page:
+        """GROUPING SETS over empty input: sets with no keys (the ()
+        set of ROLLUP/CUBE) must still emit their one global-aggregate
+        row (count=0, other aggregates NULL) — grouped hashing alone
+        produces nothing from nothing."""
+        src = node.source
+        if not isinstance(src, GroupIdNode):
+            return out
+        empty_gids = [gid for gid, m in enumerate(src.set_masks) if not any(m)]
+        if not empty_gids:
+            return out
+        if int(np.asarray(jnp.sum(out.row_mask.astype(jnp.int32)))) > 0:
+            return out
+        nkeys = len(node.group_exprs) - 1  # last group expr is $group_id
+        types = node.output_types
+        k = len(empty_gids)
+        cols, valids = [], []
+        for i, t in enumerate(types):
+            if i < nkeys:
+                cols.append(np.zeros(k, t.np_dtype))
+                valids.append(np.zeros(k, np.bool_))
+            elif i == nkeys:
+                cols.append(np.asarray(empty_gids, t.np_dtype))
+                valids.append(np.ones(k, np.bool_))
+            else:
+                agg = node.aggs[i - nkeys - 1]
+                cols.append(np.zeros(k, t.np_dtype))
+                valids.append(
+                    np.full(k, agg.fn in ("count", "count_star"), np.bool_)
+                )
+        dicts = [c.dictionary for c in node.channels]
+        return Page.from_arrays(cols, types, valids=valids, dictionaries=dicts)
 
     def _check_overflow(self, node: AggregationNode, out: Page, mg: int) -> None:
         if not node.group_exprs or self._exact_capacity(node, mg):

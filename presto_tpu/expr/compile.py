@@ -470,7 +470,30 @@ class ExprCompiler:
             da_ = self._dict_of(lhs)
             db_ = self._dict_of(rhs)
             if da_ is not db_:
-                raise ValueError("cross-dictionary string comparison unsupported")
+                # cross-dictionary eq/ne: translate rhs codes into the
+                # lhs dictionary's code space host-side once (the
+                # DictionaryBlock id-remap analog); -1 never equals a
+                # valid lhs code. Ordered comparisons would need a
+                # merged collation — unsupported, not silently wrong.
+                if da_ is None or db_ is None or op not in ("eq", "ne"):
+                    raise ValueError(
+                        f"cross-dictionary string {op} comparison unsupported")
+                rev = {v: i for i, v in enumerate(da_.values)}
+                xlat = jnp.asarray(
+                    [rev.get(v, -1) for v in db_.values], dtype=jnp.int32
+                )
+
+                def run_cx(page):
+                    (da, va), (db, vb) = a(page), b(page)
+                    db2 = xlat[jnp.clip(db, 0, xlat.shape[0] - 1)]
+                    d = (da == db2) if op == "eq" else (da != db2)
+                    return d, va & vb
+
+                return run_cx
+
+            if op not in ("eq", "ne"):
+                # dictionary codes are not collation-ordered
+                raise ValueError(f"string column {op} comparison unsupported")
 
             def run_cc(page):
                 (da, va), (db, vb) = a(page), b(page)

@@ -367,6 +367,10 @@ class DistributedRunner:
         if isinstance(node, JoinNode):
             from presto_tpu.exec.local import _is_streaming_join
 
+            if node.kind == "full":
+                # the unmatched-build tail needs cross-page (and
+                # cross-device) match state; falls back to local
+                raise DistributedUnsupported("full outer join")
             inner = self._build_dist_stage(node.left, ctx)
             mode = self._join_mode(node)
             left_keys = list(node.left_keys)

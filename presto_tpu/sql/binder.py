@@ -199,13 +199,31 @@ def _shift_date(days: int, n: int, unit: str) -> int:
     return (d - datetime.date(1970, 1, 1)).days
 
 
+def _find_scalar_subqueries(e: ast.Node, out: List[ast.Node]) -> None:
+    """Collect ScalarSubquery nodes inside an expression (not descending
+    into their query bodies)."""
+    if isinstance(e, ast.ScalarSubquery):
+        out.append(e)
+        return
+    if isinstance(e, (ast.Query, ast.Union, ast.InSubquery, ast.Exists)):
+        return
+    if dataclasses.is_dataclass(e):
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            for x in v if isinstance(v, tuple) else [v]:
+                if isinstance(x, ast.Node):
+                    _find_scalar_subqueries(x, out)
+
+
 def _is_subquery_conjunct(c: ast.Node) -> bool:
     if isinstance(c, (ast.InSubquery, ast.Exists)):
         return True
     if isinstance(c, ast.Unary) and c.op == "not":
         return _is_subquery_conjunct(c.operand)
     if isinstance(c, ast.Binary) and c.op in ("=", "<>", "<", "<=", ">", ">="):
-        return isinstance(c.left, ast.ScalarSubquery) or isinstance(c.right, ast.ScalarSubquery)
+        subs: List[ast.Node] = []
+        _find_scalar_subqueries(c, subs)
+        return bool(subs)
     return False
 
 
@@ -251,6 +269,9 @@ class Binder:
         # query's select/order items: ast -> (slot, spec, WindowFunc)
         self._windows: List[Tuple[ast.WindowExpr, object, List[Expr], List[Expr], List[bool]]] = []
         self._win_slots: Dict[ast.WindowExpr, int] = {}
+        # planned scalar-subquery marker refs keyed by id(ast node),
+        # live only while binding the enclosing conjunct
+        self._scalar_refs: Dict[int, ColumnRef] = {}
 
     # ==================================================================
     def plan(self, sql: str) -> OutputNode:
@@ -973,15 +994,25 @@ class Binder:
             return self._plan_exists(node, scope, remap, glob, c.query, kind)
 
         if isinstance(c, ast.Binary):
-            lhs, rhs, op = c.left, c.right, c.op
-            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-            if isinstance(lhs, ast.ScalarSubquery):
-                lhs, rhs, op = rhs, lhs, flip.get(op, op)
-            assert isinstance(rhs, ast.ScalarSubquery)
-            node, scope, value_ref = self._plan_scalar_subquery(node, scope, remap, glob, rhs.query)
-            lhs_ir = remap_expr(self._bind(lhs, glob), remap)
-            opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
-            pred: Expr = call(opmap[op], lhs_ir, value_ref)
+            # the scalar subquery may sit anywhere inside the comparison
+            # (e.g. price > 1.2 * (select avg(...))): plan it, bind the
+            # conjunct with the subquery replaced by a marker ref, then
+            # remap the marker to the planned output channel
+            subs: List[ast.Node] = []
+            _find_scalar_subqueries(c, subs)
+            if len(subs) != 1:
+                raise BindError("exactly one scalar subquery per conjunct supported")
+            sq = subs[0]
+            node, scope, value_ref = self._plan_scalar_subquery(node, scope, remap, glob, sq.query)
+            marker = 1 << 28
+            self._scalar_refs[id(sq)] = ColumnRef(type=value_ref.type, index=marker)
+            try:
+                ir = self._bind(c, glob)
+            finally:
+                del self._scalar_refs[id(sq)]
+            full_map = dict(remap)
+            full_map[marker] = value_ref.index
+            pred = remap_expr(ir, full_map)
             if negated:
                 pred = call("not", pred)
             return FilterNode(node, pred), scope
@@ -1222,6 +1253,11 @@ class Binder:
                 raise BindError(f"column {e.name} not in GROUP BY")
             return ColumnRef(type=ch.type, index=idx, name=e.name)
 
+        if isinstance(e, ast.ScalarSubquery):
+            ref = self._scalar_refs.get(id(e))
+            if ref is not None:
+                return ref
+
         if isinstance(e, ast.NumberLit):
             return self._bind_number(e.text)
         if isinstance(e, ast.StringLit):
@@ -1418,7 +1454,8 @@ class Binder:
             if len(fc.args) != 1:
                 raise BindError("ntile takes one argument")
             n_ir = self._bind_impl(fc.args[0], scope, agg)
-            if not isinstance(n_ir, Literal) or not n_ir.value:
+            if (not isinstance(n_ir, Literal) or n_ir.value is None
+                    or int(n_ir.value) < 1):
                 raise BindError("ntile bucket count must be a positive literal")
             offset = int(n_ir.value)
         elif name == "count" and (fc.star or not fc.args):
@@ -1429,9 +1466,13 @@ class Binder:
             arg = self._bind_impl(fc.args[0], scope, agg)
             if name in ("lead", "lag", "nth_value") and len(fc.args) > 1:
                 off_ir = self._bind_impl(fc.args[1], scope, agg)
-                if not isinstance(off_ir, Literal):
+                if not isinstance(off_ir, Literal) or off_ir.value is None:
                     raise BindError(f"{name} offset must be a literal")
                 offset = int(off_ir.value)
+                if name == "nth_value" and offset < 1:
+                    raise BindError("nth_value position must be >= 1")
+                if offset < 0:
+                    raise BindError(f"{name} offset must be non-negative")
         frame = self._bind_frame(e.frame, kind)
         wf = WindowFunc(kind=kind, arg=arg, offset=offset, frame=frame)
         partition_irs = [self._bind_impl(p, scope, agg) for p in e.partition_by]

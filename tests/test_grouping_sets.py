@@ -79,6 +79,22 @@ def test_grouping_sets(env, i):
     assert_rows_match(actual, expected, ordered=False)
 
 
+def test_rollup_empty_input(env):
+    """Empty input still yields one row per empty grouping set —
+    the () set behaves like a global aggregate."""
+    runner, _ = env
+    rows = runner.execute(
+        "select n_regionkey, count(*), sum(n_nationkey) from nation"
+        " where n_nationkey < 0 group by rollup(n_regionkey)"
+    ).rows
+    assert rows == [(None, 0, None)]
+    rows = runner.execute(
+        "select n_regionkey, n_nationkey, count(*) from nation"
+        " where n_nationkey < 0 group by cube(n_regionkey, n_nationkey)"
+    ).rows
+    assert rows == [(None, None, 0)]
+
+
 def test_rollup_cube_parse_shapes(env):
     runner, _ = env
     # cube over two keys = 4 grouping sets

@@ -104,6 +104,20 @@ def test_window_query(env, i):
     assert_rows_match(actual, expected, ordered=False)
 
 
+def test_window_arg_validation(env):
+    from presto_tpu.sql.binder import BindError
+
+    runner, _ = env
+    for bad in [
+        "select ntile(-2) over (order by n_nationkey) from nation",
+        "select ntile(0) over (order by n_nationkey) from nation",
+        "select nth_value(n_name, 0) over (order by n_nationkey) from nation",
+        "select lag(n_name, -1) over (order by n_nationkey) from nation",
+    ]:
+        with pytest.raises(BindError):
+            runner.execute(bad)
+
+
 def test_topn_per_group_pattern(env):
     """The classic top-n-per-group derived-table pattern."""
     runner, oracle = env

@@ -1,8 +1,33 @@
-"""TPC-DS star-join queries (spec defaults), engine dialect.
-Authored from the public TPC-DS spec; reference analog: the tpcds SQL
-corpus the reference benchmarks (presto-benchto-benchmarks tpcds)."""
+"""TPC-DS benchmark corpus, engine dialect — 26 queries spanning star
+joins, outer/full joins, window frames, ROLLUP, correlated scalar
+subqueries and NOT EXISTS.
+
+Authored from the public TPC-DS spec query shapes, adapted to the
+generated schema's column subset and data distributions; reference
+analog: presto-benchto-benchmarks/src/main/resources/sql/presto/tpcds/.
+
+``QUERIES``: qid -> engine SQL (also valid sqlite unless overridden).
+``ORACLE_OVERRIDES``: qid -> sqlite-equivalent SQL for constructs sqlite
+lacks (ROLLUP -> UNION ALL expansion).
+"""
 
 QUERIES = {
+    # correlated scalar subquery: customers returning > 1.2x store average
+    1: """
+select ctr_customer_sk, ctr_total
+from (select sr_customer_sk as ctr_customer_sk, sr_store_sk as ctr_store_sk,
+             sum(sr_return_amt) as ctr_total
+      from store_returns, date_dim
+      where sr_returned_date_sk = d_date_sk and d_year = 1998
+      group by sr_customer_sk, sr_store_sk) t1
+where ctr_total > (select avg(ctr_total2) * 1.2
+                   from (select sr_store_sk as ctr_store_sk2,
+                                sum(sr_return_amt) as ctr_total2
+                         from store_returns, date_dim
+                         where sr_returned_date_sk = d_date_sk and d_year = 1998
+                         group by sr_customer_sk, sr_store_sk) t2
+                   where ctr_store_sk2 = ctr_store_sk)
+""",
     3: """
 select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) as sum_agg
 from date_dim, store_sales, item
@@ -13,6 +38,21 @@ where d_date_sk = ss_sold_date_sk
 group by d_year, i_brand_id, i_brand
 order by d_year, sum_agg desc, i_brand_id
 limit 100
+""",
+    # correlated scalar subquery against the item dimension
+    6: """
+select ca_state, count(*) as cnt
+from customer_address, customer, store_sales, date_dim, item
+where ca_address_sk = c_current_addr_sk
+    and c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_item_sk = i_item_sk
+    and d_year = 2000 and d_moy = 1
+    and i_current_price > 1.2 * (select avg(j.i_current_price) from item j
+                                 where j.i_category = i_category)
+group by ca_state
+having count(*) >= 10
+order by cnt, ca_state
 """,
     7: """
 select i_item_id,
@@ -34,6 +74,133 @@ group by i_item_id
 order by i_item_id
 limit 100
 """,
+    # category revenue share via a partitioned window over agg output
+    12: """
+select i_item_id, i_category, sum(ws_ext_sales_price) as itemrevenue,
+       sum(ws_ext_sales_price) * 100.0
+         / sum(sum(ws_ext_sales_price)) over (partition by i_class) as revenueratio
+from web_sales, item, date_dim
+where ws_item_sk = i_item_sk
+    and i_category in ('Sports', 'Books', 'Home')
+    and ws_sold_date_sk = d_date_sk
+    and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_class, i_category
+""",
+    15: """
+select ca_zip, sum(cs_sales_price) as total
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+    and (ca_state in ('CA', 'WA', 'GA') or cs_sales_price > 60.00)
+    and cs_sold_date_sk = d_date_sk
+    and d_qoy = 1 and d_year = 2001
+group by ca_zip
+order by ca_zip
+limit 100
+""",
+    # ROLLUP over customer geography (oracle: UNION ALL expansion)
+    18: """
+select i_item_id, ca_country, ca_state, ca_county,
+       avg(cs_quantity) as agg1,
+       avg(cs_list_price) as agg2,
+       avg(cs_coupon_amt) as agg3
+from catalog_sales, customer_demographics, customer, customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk
+    and cs_item_sk = i_item_sk
+    and cs_bill_cdemo_sk = cd_demo_sk
+    and cs_bill_customer_sk = c_customer_sk
+    and cd_gender = 'F'
+    and cd_education_status = 'Unknown'
+    and c_current_addr_sk = ca_address_sk
+    and d_year = 1998
+group by rollup(i_item_id, ca_country, ca_state, ca_county)
+""",
+    19: """
+select i_brand_id, i_brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk
+    and ss_item_sk = i_item_sk
+    and i_manager_id = 8
+    and d_moy = 11
+    and d_year = 1998
+    and ss_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+    and ss_store_sk = s_store_sk
+    and ca_state <> s_state
+group by i_brand_id, i_brand, i_manufact_id, i_manufact
+order by ext_price desc, i_brand_id, i_manufact_id
+limit 100
+""",
+    # ROLLUP over the inventory fact (oracle: UNION ALL expansion)
+    22: """
+select i_category, i_class, i_brand, avg(inv_quantity_on_hand) as qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk
+    and inv_item_sk = i_item_sk
+    and d_month_seq between 1176 and 1187
+group by rollup(i_category, i_class, i_brand)
+""",
+    # three-fact join: sales -> returns -> catalog re-purchase
+    25: """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_year = 1998
+    and d1.d_date_sk = ss_sold_date_sk
+    and ss_item_sk = i_item_sk
+    and ss_store_sk = s_store_sk
+    and ss_customer_sk = sr_customer_sk
+    and ss_item_sk = sr_item_sk
+    and ss_ticket_number = sr_ticket_number
+    and sr_returned_date_sk = d2.d_date_sk
+    and d2.d_year between 1998 and 1999
+    and sr_customer_sk = cs_bill_customer_sk
+    and sr_item_sk = cs_item_sk
+    and cs_sold_date_sk = d3.d_date_sk
+    and d3.d_year between 1998 and 1999
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+""",
+    26: """
+select i_item_id,
+    avg(cs_quantity) as agg1,
+    avg(cs_list_price) as agg2,
+    avg(cs_coupon_amt) as agg3,
+    avg(cs_sales_price) as agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk
+    and cs_item_sk = i_item_sk
+    and cs_bill_cdemo_sk = cd_demo_sk
+    and cs_promo_sk = p_promo_sk
+    and cd_gender = 'M'
+    and cd_marital_status = 'S'
+    and cd_education_status = 'College'
+    and (p_channel_email = 'N' or p_channel_event = 'N')
+    and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    # per-ticket counts joined back to customer
+    34: """
+select c_last_name, c_first_name, ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) as cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk
+          and ss_store_sk = s_store_sk
+          and ss_hdemo_sk = hd_demo_sk
+          and (d_dom between 1 and 3 or d_dom between 25 and 28)
+          and hd_buy_potential = '>10000'
+          and hd_vehicle_count > 0
+          and d_year = 1999
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk
+    and cnt between 1 and 5
+order by c_last_name, c_first_name, ss_ticket_number
+""",
     42: """
 select d_year, i_category_id, i_category, sum(ss_ext_sales_price) as total_sales
 from date_dim, store_sales, item
@@ -45,6 +212,61 @@ where d_date_sk = ss_sold_date_sk
 group by d_year, i_category_id, i_category
 order by total_sales desc, d_year, i_category_id, i_category
 limit 100
+""",
+    # day-of-week pivot via CASE aggregation
+    43: """
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday' then ss_sales_price end) as sun_sales,
+       sum(case when d_day_name = 'Monday' then ss_sales_price end) as mon_sales,
+       sum(case when d_day_name = 'Tuesday' then ss_sales_price end) as tue_sales,
+       sum(case when d_day_name = 'Wednesday' then ss_sales_price end) as wed_sales,
+       sum(case when d_day_name = 'Thursday' then ss_sales_price end) as thu_sales,
+       sum(case when d_day_name = 'Friday' then ss_sales_price end) as fri_sales,
+       sum(case when d_day_name = 'Saturday' then ss_sales_price end) as sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk
+    and s_store_sk = ss_store_sk
+    and d_year = 1998
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100
+""",
+    # OR'd demographic/price bands over an equi-joined probe
+    48: """
+select sum(ss_quantity) as total
+from store_sales, store, customer_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+    and ss_sold_date_sk = d_date_sk and d_year = 1999
+    and cd_demo_sk = ss_cdemo_sk
+    and ((cd_marital_status = 'M' and cd_education_status = '4 yr Degree'
+          and ss_sales_price between 100.00 and 150.00)
+      or (cd_marital_status = 'D' and cd_education_status = '2 yr Degree'
+          and ss_sales_price between 50.00 and 100.00)
+      or (cd_marital_status = 'S' and cd_education_status = 'College'
+          and ss_sales_price between 150.00 and 200.00))
+    and ss_addr_sk = ca_address_sk
+    and ca_country = 'United States'
+""",
+    # cumulative store vs web revenue series, FULL OUTER + ROWS frame
+    51: """
+select store_d, store_cum, web_cum
+from (select ds as store_d, store_cum, web_cum
+      from (select d_date as ds,
+                   sum(sum(ss_ext_sales_price)) over (order by d_date
+                       rows between unbounded preceding and current row) as store_cum
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk and d_year = 2000 and d_moy = 1
+            group by d_date) s
+      full outer join
+           (select d_date as dw,
+                   sum(sum(ws_ext_sales_price)) over (order by d_date
+                       rows between unbounded preceding and current row) as web_cum
+            from web_sales, date_dim
+            where ws_sold_date_sk = d_date_sk and d_year = 2000
+                and d_moy = 1 and d_dom < 20
+            group by d_date) w
+      on ds = dw) x
+order by store_d
 """,
     52: """
 select d_year, i_brand_id as brand_id, i_brand as brand, sum(ss_ext_sales_price) as ext_price
@@ -58,6 +280,21 @@ group by d_year, i_brand_id, i_brand
 order by d_year, ext_price desc, brand_id
 limit 100
 """,
+    # manager monthly sums vs their partitioned average (window over agg)
+    53: """
+select i_manager_id, sum_sales, avg_monthly_sales
+from (select i_manager_id, d_moy, sum(ss_sales_price) as sum_sales,
+             avg(sum(ss_sales_price)) over (partition by i_manager_id) as avg_monthly_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk
+          and ss_sold_date_sk = d_date_sk
+          and ss_store_sk = s_store_sk
+          and d_month_seq between 1176 and 1187
+      group by i_manager_id, d_moy) tmp
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+""",
     55: """
 select i_brand_id as brand_id, i_brand as brand, sum(ss_ext_sales_price) as ext_price
 from date_dim, store_sales, item
@@ -70,4 +307,160 @@ group by i_brand_id, i_brand
 order by ext_price desc, brand_id
 limit 100
 """,
+    # items under 10% of their store's average revenue (correlated)
+    65: """
+select s_store_name, i_item_desc, revenue
+from store, item,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk and d_month_seq between 1176 and 1179
+      group by ss_store_sk, ss_item_sk) sc
+where revenue <= (select 0.1 * avg(rev2)
+                  from (select ss_store_sk as store2, sum(ss_sales_price) as rev2
+                        from store_sales, date_dim
+                        where ss_sold_date_sk = d_date_sk
+                            and d_month_seq between 1176 and 1179
+                        group by ss_store_sk, ss_item_sk) sb
+                  where store2 = ss_store_sk)
+    and s_store_sk = ss_store_sk
+    and i_item_sk = ss_item_sk
+""",
+    # bought-city vs home-city ticket roll-up
+    68: """
+select c_last_name, c_first_name, ca_city, bought_city, extended_price
+from (select ss_ticket_number, ss_customer_sk, ca_city as bought_city,
+             sum(ss_ext_sales_price) as extended_price
+      from store_sales, date_dim, store, household_demographics, customer_address
+      where ss_sold_date_sk = d_date_sk
+          and ss_store_sk = s_store_sk
+          and ss_hdemo_sk = hd_demo_sk
+          and ss_addr_sk = ca_address_sk
+          and d_year = 1999
+          and (hd_dep_count = 4 or hd_vehicle_count = 3)
+      group by ss_ticket_number, ss_customer_sk, ca_city) dn,
+     customer, customer_address
+where ss_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+    and ca_city <> bought_city
+""",
+    # time-of-day traffic counts, cross join of single-row aggregates
+    88: """
+select h8, h9, h10, h11
+from (select count(*) as h8 from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+          and ss_store_sk = s_store_sk and t_hour = 8
+          and hd_dep_count = 2 and s_store_name = 'ese') s1,
+     (select count(*) as h9 from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+          and ss_store_sk = s_store_sk and t_hour = 9
+          and hd_dep_count = 2 and s_store_name = 'ese') s2,
+     (select count(*) as h10 from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+          and ss_store_sk = s_store_sk and t_hour = 10
+          and hd_dep_count = 2 and s_store_name = 'ese') s3,
+     (select count(*) as h11 from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+          and ss_store_sk = s_store_sk and t_hour = 11
+          and hd_dep_count = 2 and s_store_name = 'ese') s4
+""",
+    # LEFT OUTER to returns with reason filter + actual-sale computation
+    93: """
+select ss_customer_sk, sum(act_sales) as sumsales
+from (select ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity) * ss_sales_price
+                  else ss_quantity * ss_sales_price end as act_sales
+      from store_sales left outer join store_returns
+           on sr_item_sk = ss_item_sk and sr_ticket_number = ss_ticket_number,
+           reason
+      where sr_reason_sk = r_reason_sk
+          and r_reason_desc = 'Wrong size') t
+group by ss_customer_sk
+""",
+    # NOT EXISTS anti-join on returns
+    94: """
+select count(*) as order_count, sum(ws_ext_ship_cost) as total_shipping_cost
+from web_sales, date_dim, customer_address, web_site
+where d_date between date '1999-02-01' and date '1999-04-30'
+    and ws_ship_date_sk = d_date_sk
+    and ws_ship_addr_sk = ca_address_sk
+    and ca_state = 'CA'
+    and ws_web_site_sk = web_site_sk
+    and web_name = 'site_1'
+    and not exists (select * from web_returns
+                    where ws_order_number = wr_order_number)
+""",
+    96: """
+select count(*) as cnt
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = t_time_sk
+    and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk
+    and t_hour = 20
+    and t_minute >= 30
+    and hd_dep_count = 7
+    and s_store_name = 'ese'
+""",
+    # store/catalog buyer overlap via FULL OUTER over grouped facts
+    97: """
+select sum(case when customer_sk is not null and customer_sk2 is null then 1 else 0 end) as store_only,
+       sum(case when customer_sk is null and customer_sk2 is not null then 1 else 0 end) as catalog_only,
+       sum(case when customer_sk is not null and customer_sk2 is not null then 1 else 0 end) as store_and_catalog
+from (select ss_customer_sk as customer_sk, ss_item_sk as item_sk
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk and d_month_seq between 1176 and 1181
+      group by ss_customer_sk, ss_item_sk) ssci
+full outer join
+     (select cs_bill_customer_sk as customer_sk2, cs_item_sk as item_sk2
+      from catalog_sales, date_dim
+      where cs_sold_date_sk = d_date_sk and d_month_seq between 1176 and 1181
+      group by cs_bill_customer_sk, cs_item_sk) csci
+on customer_sk = customer_sk2 and item_sk = item_sk2
+""",
+}
+
+
+def _rollup_union(select_cols, aggs, from_where, groups):
+    """Expand GROUP BY ROLLUP into sqlite UNION ALL (oracle side)."""
+    parts = []
+    for level in range(len(groups), -1, -1):
+        live = groups[:level]
+        cols = ", ".join(c if c in live else f"null as {c}" for c in select_cols)
+        gb = f" group by {', '.join(live)}" if live else ""
+        parts.append(f"select {cols}, {aggs} {from_where}{gb}")
+    return " union all ".join(parts)
+
+
+_Q18_FW = """
+from catalog_sales, customer_demographics, customer, customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk
+    and cs_item_sk = i_item_sk
+    and cs_bill_cdemo_sk = cd_demo_sk
+    and cs_bill_customer_sk = c_customer_sk
+    and cd_gender = 'F'
+    and cd_education_status = 'Unknown'
+    and c_current_addr_sk = ca_address_sk
+    and d_year = 1998
+"""
+
+_Q22_FW = """
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk
+    and inv_item_sk = i_item_sk
+    and d_month_seq between 1176 and 1187
+"""
+
+ORACLE_OVERRIDES = {
+    18: _rollup_union(
+        ["i_item_id", "ca_country", "ca_state", "ca_county"],
+        "avg(cs_quantity) as agg1, avg(cs_list_price) as agg2, avg(cs_coupon_amt) as agg3",
+        _Q18_FW,
+        ["i_item_id", "ca_country", "ca_state", "ca_county"],
+    ),
+    22: _rollup_union(
+        ["i_category", "i_class", "i_brand"],
+        "avg(inv_quantity_on_hand) as qoh",
+        _Q22_FW,
+        ["i_category", "i_class", "i_brand"],
+    ),
 }
