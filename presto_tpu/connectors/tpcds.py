@@ -836,31 +836,48 @@ class Tpcds:
             "ss_net_profit": core["net_profit"],
         }
 
-    def _store_returns(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        # each return samples a parent sale; (item, ticket) join back
-        s = lambda c: _seed("store_returns", c)
-        ss = lambda c: _seed("store_sales", c)
-        sale = (_hash_u64(s("sale"), idx) % self.n_store_sales).astype(np.int64)
-        sale_date = D_SK0 + _SALES_START + _uniform_int(ss("date"), sale, 0, _SALES_DAYS - 1)
-        sale_qty = _uniform_int(ss("qty"), sale, 1, 100)
-        wholesale = _uniform_int(ss("wholesale"), sale, 100, 8800)
-        markup = _uniform_int(ss("markup"), sale, 100, 200)
+    def _returns_core(self, ret_table: str, sale_table: str, n_sales: int,
+                      idx: np.ndarray) -> Dict[str, np.ndarray]:
+        """Shared return-fact machinery: each return samples a parent
+        sale (pure index function, so (item, ticket/order) joins back)
+        and re-derives the parent's price waterfall from the sale seeds."""
+        s = lambda c: _seed(ret_table, c)
+        ps = lambda c: _seed(sale_table, c)
+        sale = (_hash_u64(s("sale"), idx) % n_sales).astype(np.int64)
+        sale_date = D_SK0 + _SALES_START + _uniform_int(ps("date"), sale, 0, _SALES_DAYS - 1)
+        sale_qty = _uniform_int(ps("qty"), sale, 1, 100)
+        wholesale = _uniform_int(ps("wholesale"), sale, 100, 8800)
+        markup = _uniform_int(ps("markup"), sale, 100, 200)
         list_price = wholesale * markup // 100
-        discount = _uniform_int(ss("discount"), sale, 0, 99)
+        discount = _uniform_int(ps("discount"), sale, 0, 99)
         sales_price = list_price * (100 - discount) // 100
         rqty = 1 + _hash_u64(s("rqty"), idx) % np.maximum(sale_qty, 1)
         ramt = rqty * sales_price
         return {
-            "sr_returned_date_sk": sale_date + _uniform_int(s("lag"), idx, 1, 90),
-            "sr_item_sk": _uniform_int(ss("item"), sale, 1, self.n_items),
+            "sale": sale,
+            "returned_date_sk": sale_date + _uniform_int(s("lag"), idx, 1, 90),
+            "item_sk": _uniform_int(ps("item"), sale, 1, self.n_items),
+            "reason_sk": _uniform_int(s("reason"), idx, 1, self.n_reasons),
+            "return_quantity": rqty.astype(np.int64),
+            "return_amt": ramt.astype(np.int64),
+            "net_loss": (ramt + _uniform_int(s("fee"), idx, 50, 10000)).astype(np.int64),
+        }
+
+    def _store_returns(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        core = self._returns_core("store_returns", "store_sales", self.n_store_sales, idx)
+        sale = core["sale"]
+        ss = lambda c: _seed("store_sales", c)
+        return {
+            "sr_returned_date_sk": core["returned_date_sk"],
+            "sr_item_sk": core["item_sk"],
             "sr_customer_sk": _uniform_int(ss("cust"), sale, 1, self.n_customers),
             "sr_cdemo_sk": _uniform_int(ss("cdemo"), sale, 1, self.cd_rows),
             "sr_store_sk": _uniform_int(ss("store"), sale, 1, self.n_stores),
-            "sr_reason_sk": _uniform_int(s("reason"), idx, 1, self.n_reasons),
+            "sr_reason_sk": core["reason_sk"],
             "sr_ticket_number": sale + 1,
-            "sr_return_quantity": rqty.astype(np.int64),
-            "sr_return_amt": ramt.astype(np.int64),
-            "sr_net_loss": (ramt + _uniform_int(s("fee"), idx, 50, 10000)).astype(np.int64),
+            "sr_return_quantity": core["return_quantity"],
+            "sr_return_amt": core["return_amt"],
+            "sr_net_loss": core["net_loss"],
         }
 
     def _catalog_sales(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
@@ -899,28 +916,20 @@ class Tpcds:
         }
 
     def _catalog_returns(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        s = lambda c: _seed("catalog_returns", c)
+        core = self._returns_core("catalog_returns", "catalog_sales",
+                                  self.n_catalog_sales, idx)
+        sale = core["sale"]
         cs = lambda c: _seed("catalog_sales", c)
-        sale = (_hash_u64(s("sale"), idx) % self.n_catalog_sales).astype(np.int64)
-        sale_date = D_SK0 + _SALES_START + _uniform_int(cs("date"), sale, 0, _SALES_DAYS - 1)
-        sale_qty = _uniform_int(cs("qty"), sale, 1, 100)
-        wholesale = _uniform_int(cs("wholesale"), sale, 100, 8800)
-        markup = _uniform_int(cs("markup"), sale, 100, 200)
-        list_price = wholesale * markup // 100
-        discount = _uniform_int(cs("discount"), sale, 0, 99)
-        sales_price = list_price * (100 - discount) // 100
-        rqty = 1 + _hash_u64(s("rqty"), idx) % np.maximum(sale_qty, 1)
-        ramt = rqty * sales_price
         return {
-            "cr_returned_date_sk": sale_date + _uniform_int(s("lag"), idx, 1, 90),
-            "cr_item_sk": _uniform_int(cs("item"), sale, 1, self.n_items),
+            "cr_returned_date_sk": core["returned_date_sk"],
+            "cr_item_sk": core["item_sk"],
             "cr_returning_customer_sk": _uniform_int(cs("bcust"), sale, 1, self.n_customers),
             "cr_call_center_sk": _uniform_int(cs("cc"), sale, 1, self.n_call_centers),
-            "cr_reason_sk": _uniform_int(s("reason"), idx, 1, self.n_reasons),
+            "cr_reason_sk": core["reason_sk"],
             "cr_order_number": sale + 1,
-            "cr_return_quantity": rqty.astype(np.int64),
-            "cr_return_amount": ramt.astype(np.int64),
-            "cr_net_loss": (ramt + _uniform_int(s("fee"), idx, 50, 10000)).astype(np.int64),
+            "cr_return_quantity": core["return_quantity"],
+            "cr_return_amount": core["return_amt"],
+            "cr_net_loss": core["net_loss"],
         }
 
     def _web_sales(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
@@ -956,27 +965,18 @@ class Tpcds:
         }
 
     def _web_returns(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        s = lambda c: _seed("web_returns", c)
+        core = self._returns_core("web_returns", "web_sales", self.n_web_sales, idx)
+        sale = core["sale"]
         ws = lambda c: _seed("web_sales", c)
-        sale = (_hash_u64(s("sale"), idx) % self.n_web_sales).astype(np.int64)
-        sale_date = D_SK0 + _SALES_START + _uniform_int(ws("date"), sale, 0, _SALES_DAYS - 1)
-        sale_qty = _uniform_int(ws("qty"), sale, 1, 100)
-        wholesale = _uniform_int(ws("wholesale"), sale, 100, 8800)
-        markup = _uniform_int(ws("markup"), sale, 100, 200)
-        list_price = wholesale * markup // 100
-        discount = _uniform_int(ws("discount"), sale, 0, 99)
-        sales_price = list_price * (100 - discount) // 100
-        rqty = 1 + _hash_u64(s("rqty"), idx) % np.maximum(sale_qty, 1)
-        ramt = rqty * sales_price
         return {
-            "wr_returned_date_sk": sale_date + _uniform_int(s("lag"), idx, 1, 90),
-            "wr_item_sk": _uniform_int(ws("item"), sale, 1, self.n_items),
+            "wr_returned_date_sk": core["returned_date_sk"],
+            "wr_item_sk": core["item_sk"],
             "wr_returning_customer_sk": _uniform_int(ws("bcust"), sale, 1, self.n_customers),
-            "wr_reason_sk": _uniform_int(s("reason"), idx, 1, self.n_reasons),
+            "wr_reason_sk": core["reason_sk"],
             "wr_order_number": sale + 1,
-            "wr_return_quantity": rqty.astype(np.int64),
-            "wr_return_amt": ramt.astype(np.int64),
-            "wr_net_loss": (ramt + _uniform_int(s("fee"), idx, 50, 10000)).astype(np.int64),
+            "wr_return_quantity": core["return_quantity"],
+            "wr_return_amt": core["return_amt"],
+            "wr_net_loss": core["net_loss"],
         }
 
     # -- Page production ----------------------------------------------------

@@ -39,38 +39,214 @@ CompiledExpr = Callable[[Page], Tuple[jax.Array, jax.Array]]
 _DERIVED_DICTS: dict = {}
 
 
-def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Optional[Dictionary]:
-    """Dictionary provenance of a string-typed expression: bare columns
-    keep theirs; substr() derives a transformed dictionary host-side
-    (codes unchanged — only the code->value mapping transforms)."""
-    if isinstance(e, ColumnRef):
-        return dictionaries[e.index]
-    if isinstance(e, Call) and e.fn == "substr":
-        inner = expr_dictionary(e.args[0], dictionaries)
-        if inner is None:
-            return None
+# fns whose result is a per-value string transform of a single string
+# column: codes pass through, only the dictionary's values change
+# (DictionaryAwarePageProjection analog). Transforms may return None
+# (SQL NULL) — compile() folds a null-LUT into validity.
+STRING_TRANSFORM_FNS = frozenset({
+    "substr", "upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+    "regexp_extract", "regexp_replace", "replace", "split_part",
+    "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
+    "url_extract_host", "url_extract_path", "url_extract_protocol",
+    "url_extract_query",
+})
+
+
+def _json_path_get(doc: str, path: str):
+    """Tiny JSONPath subset: $, .name, [idx] (reference:
+    operator/scalar/JsonExtract.java's path engine)."""
+    import json as _json
+
+    try:
+        cur = _json.loads(doc)
+    except Exception:
+        return None
+    if not path.startswith("$"):
+        return None
+    i = 1
+    toks = re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]", path[i:])
+    consumed = sum(len(f".{a}") if a else len(f"[{b}]") for a, b in toks)
+    if consumed != len(path) - 1:
+        return None
+    for name, idx in toks:
+        if name:
+            if not isinstance(cur, dict) or name not in cur:
+                return None
+            cur = cur[name]
+        else:
+            j = int(idx)
+            if not isinstance(cur, list) or j >= len(cur):
+                return None
+            cur = cur[j]
+    return cur
+
+
+def _string_transform(e: "Call"):
+    """value -> Optional[value] host transform for STRING_TRANSFORM_FNS,
+    plus a hashable cache key; None if ``e`` is not such a call."""
+    fn = e.fn
+    lits = tuple(a.value for a in e.args if isinstance(a, Literal))
+    key = (fn,) + lits
+
+    if fn == "substr":
         start = e.args[1].value
         length = e.args[2].value if len(e.args) > 2 else None
-        key = (id(inner), "substr", start, length)
-        if key not in _DERIVED_DICTS:
-            end = None if length is None else start - 1 + length
-            values = [v[start - 1 : end] for v in inner.values]
-            _DERIVED_DICTS[key] = (inner, Dictionary(values))
-        return _DERIVED_DICTS[key][1]
-    if isinstance(e, Call) and e.fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
-        inner = expr_dictionary(e.args[0], dictionaries)
+        end = None if length is None else start - 1 + length
+        return lambda v: v[start - 1 : end], key
+    if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
+        f = {"upper": str.upper, "lower": str.lower, "trim": str.strip,
+             "ltrim": str.lstrip, "rtrim": str.rstrip,
+             "reverse": lambda s: s[::-1]}[fn]
+        return f, key
+    if fn == "regexp_extract":
+        rx = re.compile(e.args[1].value)
+        group = int(e.args[2].value) if len(e.args) > 2 else 0
+
+        def f(v, rx=rx, g=group):
+            m = rx.search(v)
+            return m.group(g) if m else None
+
+        return f, key
+    if fn == "regexp_replace":
+        rx = re.compile(e.args[1].value)
+        repl = e.args[2].value if len(e.args) > 2 else ""
+        py_repl = re.sub(r"\$(\d+)", r"\\\1", repl)  # $1 -> \1
+        return lambda v: rx.sub(py_repl, v), key
+    if fn == "replace":
+        frm = e.args[1].value
+        to = e.args[2].value if len(e.args) > 2 else ""
+        return lambda v: v.replace(frm, to), key
+    if fn == "split_part":
+        delim, n = e.args[1].value, int(e.args[2].value)
+
+        def f(v, delim=delim, n=n):
+            parts = v.split(delim)
+            return parts[n - 1] if 0 < n <= len(parts) else None
+
+        return f, key
+    if fn in ("lpad", "rpad"):
+        n = int(e.args[1].value)
+        pad = e.args[2].value if len(e.args) > 2 else " "
+        if fn == "lpad":
+            def f(v, n=n, pad=pad):
+                if len(v) >= n:
+                    return v[:n]
+                fill = (pad * n)[: n - len(v)]
+                return fill + v
+        else:
+            def f(v, n=n, pad=pad):
+                if len(v) >= n:
+                    return v[:n]
+                return v + (pad * n)[: n - len(v)]
+        return f, key
+    if fn == "concat":
+        # one string column + literals in any positions
+        parts = []
+        for a in e.args:
+            parts.append(a.value if isinstance(a, Literal) else None)
+        if parts.count(None) != 1:
+            return None
+
+        def f(v, parts=tuple(parts)):
+            return "".join(v if p is None else str(p) for p in parts)
+
+        return f, key + ("@" + str(parts.index(None)),)
+    if fn in ("json_extract", "json_extract_scalar"):
+        path = e.args[1].value
+        scalar = fn == "json_extract_scalar"
+
+        def f(v, path=path, scalar=scalar):
+            import json as _json
+
+            got = _json_path_get(v, path)
+            if got is None:
+                return None
+            if scalar:
+                if isinstance(got, (dict, list)):
+                    return None
+                if isinstance(got, bool):
+                    return "true" if got else "false"
+                return str(got)
+            return _json.dumps(got, separators=(",", ":"))
+
+        return f, key
+    if fn.startswith("url_extract_"):
+        from urllib.parse import urlparse
+
+        part = fn[len("url_extract_"):]
+
+        def f(v, part=part):
+            try:
+                u = urlparse(v)
+            except Exception:
+                return None
+            got = {"host": u.hostname, "path": u.path, "protocol": u.scheme,
+                   "query": u.query}[part]
+            return got if got else (got if part == "path" else None)
+
+        return f, key
+    return None
+
+
+def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Optional[Dictionary]:
+    """Dictionary provenance of a string-typed expression: bare columns
+    keep theirs; string-transform calls derive a transformed dictionary
+    host-side (codes unchanged — only the code->value mapping
+    transforms; None results become "" with validity handled by the
+    compiler's null LUT)."""
+    if isinstance(e, ColumnRef):
+        return dictionaries[e.index]
+    if isinstance(e, Call) and e.fn in STRING_TRANSFORM_FNS:
+        col = _transform_column(e)
+        if col is None:
+            return None
+        inner = expr_dictionary(col, dictionaries)
         if inner is None:
             return None
-        key = (id(inner), e.fn)
+        tf = _string_transform(e)
+        if tf is None:
+            return None
+        f, tkey = tf
+        key = (id(inner),) + tkey
         if key not in _DERIVED_DICTS:
-            f = {
-                "upper": str.upper, "lower": str.lower, "trim": str.strip,
-                "ltrim": str.lstrip, "rtrim": str.rstrip,
-                "reverse": lambda s: s[::-1],
-            }[e.fn]
-            _DERIVED_DICTS[key] = (inner, Dictionary([f(v) for v in inner.values]))
+            values = [f(v) for v in inner.values]
+            nulls = [v is None for v in values]
+            d = Dictionary(["" if v is None else v for v in values])
+            _DERIVED_DICTS[key] = (inner, d, nulls)
         return _DERIVED_DICTS[key][1]
     return None
+
+
+def _transform_column(e: "Call") -> Optional[Expr]:
+    """The single string-typed non-literal argument of a transform."""
+    cols = [a for a in e.args if not isinstance(a, Literal)]
+    if len(cols) != 1:
+        return None
+    return cols[0]
+
+
+def _transform_null_lut(e: "Call", dictionaries) -> Optional["jnp.ndarray"]:
+    """Per-code validity for a derived dictionary (False where the
+    transform yielded NULL); None when no entry is null."""
+    col = _transform_column(e)
+    inner = expr_dictionary(col, dictionaries)
+    tf = _string_transform(e)
+    if inner is None or tf is None:
+        return None
+    _, tkey = tf
+    key = (id(inner),) + tkey
+    entry = _DERIVED_DICTS.get(key)
+    if entry is None or not any(entry[2]):
+        return None
+    return jnp.asarray([not n for n in entry[2]])
+
+
+def _mix_u64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer over uint64 lanes (device hash)."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
 
 
 def _rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
@@ -232,13 +408,34 @@ class ExprCompiler:
                 return d.astype(jnp.int64), v
 
             return run_cast_bigint
-        if fn in ("substr", "upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
+        if fn in STRING_TRANSFORM_FNS:
             # dictionary codes pass through unchanged; the *values* are
             # transformed host-side once (see _dict_of) — the device
-            # never touches bytes (DictionaryAwarePageProjection analog)
-            return self.compile(expr.args[0])
-        if fn in ("length", "strpos"):
+            # never touches bytes (DictionaryAwarePageProjection analog).
+            # Transforms that can yield NULL fold a per-code LUT into
+            # validity.
+            col = _transform_column(expr)
+            if col is None:
+                raise KeyError(f"cannot compile {expr}")
+            # force derived-dict materialization so the null LUT exists
+            expr_dictionary(expr, self.dictionaries)
+            null_lut = _transform_null_lut(expr, self.dictionaries)
+            inner_f = self.compile(col)
+            if null_lut is None:
+                return inner_f
+
+            def run_derived(page):
+                d, v = inner_f(page)
+                return d, v & null_lut[jnp.clip(d, 0, null_lut.shape[0] - 1)]
+
+            return run_derived
+        if fn in ("length", "strpos", "codepoint", "json_array_length",
+                  "url_extract_port"):
             return self._compile_string_lut_fn(expr)
+        if fn in ("regexp_like", "starts_with", "ends_with", "is_json_scalar"):
+            return self._compile_string_bool_lut(expr)
+        if fn in ("hll_bucket", "hll_rho"):
+            return self._compile_hll(expr)
         if fn in ("abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10",
                   "power", "pow", "ceil", "ceiling", "floor", "round"):
             return self._compile_math(expr)
@@ -266,25 +463,137 @@ class ExprCompiler:
 
     def _compile_string_lut_fn(self, expr: Call) -> CompiledExpr:
         """String scalar -> int via a host-computed LUT over the
-        dictionary, one device gather (length, strpos)."""
+        dictionary, one device gather (length, strpos, codepoint,
+        json_array_length, url_extract_port). None values null out."""
         colref = expr.args[0]
         cf = self.compile(colref)
         d = self._dict_of(colref)
         if d is None:
             raise ValueError(f"no dictionary for string column {colref}")
-        if expr.fn == "length":
+        fn = expr.fn
+        if fn == "length":
             lut_vals = [len(v) for v in d.values]
-        else:  # strpos(col, substring_literal): 1-based, 0 = not found
+        elif fn == "strpos":  # strpos(col, needle_literal): 1-based, 0 = miss
             sub = expr.args[1]
             assert isinstance(sub, Literal), "strpos needle must be a literal"
             lut_vals = [v.find(sub.value) + 1 for v in d.values]
-        lut = jnp.asarray(lut_vals, dtype=jnp.int64)
+        elif fn == "codepoint":
+            lut_vals = [ord(v[0]) if v else None for v in d.values]
+        elif fn == "json_array_length":
+            import json as _json
+
+            def jal(v):
+                try:
+                    got = _json.loads(v)
+                except Exception:
+                    return None
+                return len(got) if isinstance(got, list) else None
+
+            lut_vals = [jal(v) for v in d.values]
+        else:  # url_extract_port
+            from urllib.parse import urlparse
+
+            def port(v):
+                try:
+                    return urlparse(v).port
+                except Exception:
+                    return None
+
+            lut_vals = [port(v) for v in d.values]
+        nulls = [v is None for v in lut_vals]
+        lut = jnp.asarray([0 if v is None else v for v in lut_vals], dtype=jnp.int64)
+        vlut = None if not any(nulls) else jnp.asarray([not n for n in nulls])
 
         def run_lut(page):
             dd, v = cf(page)
-            return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
+            c = jnp.clip(dd, 0, lut.shape[0] - 1)
+            if vlut is not None:
+                v = v & vlut[c]
+            return lut[c], v
 
         return run_lut
+
+    def _compile_string_bool_lut(self, expr: Call) -> CompiledExpr:
+        """String predicate via a host-computed boolean LUT over the
+        dictionary (regexp_like, starts_with, ends_with, is_json_scalar)."""
+        colref = expr.args[0]
+        cf = self.compile(colref)
+        d = self._dict_of(colref)
+        if d is None:
+            raise ValueError(f"no dictionary for string column {colref}")
+        fn = expr.fn
+        if fn == "regexp_like":
+            rx = re.compile(expr.args[1].value)
+            pred = lambda v: rx.search(v) is not None
+        elif fn == "starts_with":
+            prefix = expr.args[1].value
+            pred = lambda v: v.startswith(prefix)
+        elif fn == "ends_with":
+            suffix = expr.args[1].value
+            pred = lambda v: v.endswith(suffix)
+        else:  # is_json_scalar
+            import json as _json
+
+            def pred(v):
+                try:
+                    return not isinstance(_json.loads(v), (dict, list))
+                except Exception:
+                    return False
+
+        lut = jnp.asarray(d.lut(pred))
+
+        def run_blut(page):
+            dd, v = cf(page)
+            return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
+
+        return run_blut
+
+    # HLL sketch primitives (reference:
+    # operator/aggregation/ApproximateCountDistinctAggregations.java +
+    # airlift HyperLogLog; here integer device math, m = 4096 buckets)
+    HLL_P = 12
+    HLL_M = 1 << 12
+
+    def _compile_hll(self, expr: Call) -> CompiledExpr:
+        (colref,) = expr.args
+        cf = self.compile(colref)
+        t = colref.type
+        fn = expr.fn
+        canon_lut = None
+        if t.is_string:
+            # canonicalize codes to value ids so transforms that map
+            # many codes to one value (substr/upper/...) count distinct
+            # VALUES, not distinct source codes
+            d = expr_dictionary(colref, self.dictionaries)
+            if d is None:
+                raise ValueError(f"no dictionary for string column {colref}")
+            canon: dict = {}
+            canon_lut = jnp.asarray(
+                [canon.setdefault(v, len(canon)) for v in d.values],
+                dtype=jnp.int64)
+
+        def run_hll(page):
+            d, v = cf(page)
+            if t.name == "double":
+                lane = jax.lax.bitcast_convert_type(d, jnp.int64)
+            elif canon_lut is not None:
+                lane = canon_lut[jnp.clip(d, 0, canon_lut.shape[0] - 1)]
+            else:
+                lane = d.astype(jnp.int64)
+            h = _mix_u64(lane.astype(jnp.uint64))
+            if fn == "hll_bucket":
+                return (h >> jnp.uint64(64 - ExprCompiler.HLL_P)).astype(jnp.int64), v
+            # rho: leading-zero count of the remaining 52 bits, +1 (capped)
+            rest = (h << jnp.uint64(ExprCompiler.HLL_P)) | jnp.uint64(1 << (ExprCompiler.HLL_P - 1))
+            clz = jnp.zeros(d.shape, dtype=jnp.uint64)
+            x = rest
+            for shift in (32, 16, 8, 4, 2, 1):
+                empty = x < (jnp.uint64(1) << jnp.uint64(64 - shift))
+                clz = clz + jnp.where(empty, jnp.uint64(shift), jnp.uint64(0))
+                x = jnp.where(empty, x << jnp.uint64(shift), x)
+            return (clz + jnp.uint64(1)).astype(jnp.int64), v
+
+        return run_hll
 
     def _compile_math(self, expr: Call) -> CompiledExpr:
         fn = expr.fn
@@ -469,35 +778,28 @@ class ExprCompiler:
             a, b = self.compile(lhs), self.compile(rhs)
             da_ = self._dict_of(lhs)
             db_ = self._dict_of(rhs)
-            if da_ is not db_:
-                # cross-dictionary eq/ne: translate rhs codes into the
-                # lhs dictionary's code space host-side once (the
-                # DictionaryBlock id-remap analog); -1 never equals a
-                # valid lhs code. Ordered comparisons would need a
-                # merged collation — unsupported, not silently wrong.
-                if da_ is None or db_ is None or op not in ("eq", "ne"):
-                    raise ValueError(
-                        f"cross-dictionary string {op} comparison unsupported")
-                rev = {v: i for i, v in enumerate(da_.values)}
-                xlat = jnp.asarray(
-                    [rev.get(v, -1) for v in db_.values], dtype=jnp.int32
-                )
-
-                def run_cx(page):
-                    (da, va), (db, vb) = a(page), b(page)
-                    db2 = xlat[jnp.clip(db, 0, xlat.shape[0] - 1)]
-                    d = (da == db2) if op == "eq" else (da != db2)
-                    return d, va & vb
-
-                return run_cx
-
-            if op not in ("eq", "ne"):
-                # dictionary codes are not collation-ordered
-                raise ValueError(f"string column {op} comparison unsupported")
+            if da_ is None or db_ is None or op not in ("eq", "ne"):
+                # ordered col-col comparison would need a merged
+                # collation — unsupported, not silently wrong
+                raise ValueError(
+                    f"string column {op} comparison unsupported")
+            # canonical-value-id comparison: both sides' codes map to a
+            # shared value-id space host-side (the DictionaryBlock
+            # id-remap analog). Robust to duplicate values in derived
+            # dictionaries (upper/substr map many codes to one value).
+            canon: dict = {}
+            lut_a = jnp.asarray(
+                [canon.setdefault(v, len(canon)) for v in da_.values],
+                dtype=jnp.int32)
+            lut_b = jnp.asarray(
+                [canon.setdefault(v, len(canon)) for v in db_.values],
+                dtype=jnp.int32)
 
             def run_cc(page):
                 (da, va), (db, vb) = a(page), b(page)
-                d = (da == db) if op == "eq" else (da != db)
+                ca = lut_a[jnp.clip(da, 0, lut_a.shape[0] - 1)]
+                cb = lut_b[jnp.clip(db, 0, lut_b.shape[0] - 1)]
+                d = (ca == cb) if op == "eq" else (ca != cb)
                 return d, va & vb
 
             return run_cc

@@ -69,13 +69,17 @@ class AggCall:
 
     Reference analog: the parsed form behind
     operator/aggregation/InternalAggregationFunction.java.
+
+    ``arg2`` is the second argument of two-argument aggregates
+    (min_by/max_by's key, approx_percentile's fraction literal).
     """
 
-    fn: str  # sum | count | count_star | min | max | avg
+    fn: str  # sum | count | count_star | min | max | avg | min_by | ...
     arg: Optional[Expr]
     type: Type
     distinct: bool = False
     filter: Optional[Expr] = None
+    arg2: Optional[Expr] = None
 
     def __repr__(self):
         a = "*" if self.arg is None else repr(self.arg)
@@ -149,10 +153,19 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return out
     if fn == "nullif":
         return ts[0]
-    if fn in ("length", "strpos"):
+    if fn in ("length", "strpos", "codepoint", "json_array_length",
+              "url_extract_port", "hll_bucket", "hll_rho"):
         return BIGINT
-    if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
+    if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+              "regexp_extract", "regexp_replace", "replace", "split_part",
+              "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
+              "json_format", "url_extract_host", "url_extract_path",
+              "url_extract_protocol", "url_extract_query", "url_decode",
+              "url_encode", "normalize", "to_hex"):
         return ts[0]
+    if fn in ("regexp_like", "starts_with", "ends_with", "contains_str",
+              "is_json_scalar"):
+        return BOOLEAN
     if fn == "coalesce":
         out = ts[0]
         for t in ts[1:]:

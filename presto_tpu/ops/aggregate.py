@@ -48,6 +48,11 @@ AggSpec = AggCall  # public alias
 
 DIRECT_GROUP_LIMIT = 1 << 14
 
+# HyperLogLog bucket count (2^p, p=12 — the reference's default
+# approx_distinct standard error 2.3%/sqrt-law class); must match
+# ExprCompiler.HLL_P in expr/compile.py
+HLL_M = 1 << 12
+
 
 # ---------------------------------------------------------------------------
 # agg state machinery
@@ -79,11 +84,18 @@ def state_types(agg: AggCall) -> List[Type]:
         return [DOUBLE, DOUBLE, BIGINT]  # sum, M2 (Σ(x-mean)²), count
     if agg.fn in ("bool_and", "bool_or", "every"):
         return [BIGINT, BIGINT]  # count of true, count of non-null
+    if agg.fn in ("min_by", "max_by"):
+        # x-at-extreme, x-non-null flag, extreme key, count of valid keys
+        return [t, BIGINT, agg.arg2.type, BIGINT]
+    if agg.fn == "hll_merge":
+        # HyperLogLog register fold: Σ 2^-M over present buckets, count
+        # of present buckets (input rows are one-per-(group, bucket))
+        return [DOUBLE, BIGINT]
     raise KeyError(f"unknown aggregate {agg.fn}")
 
 
 def output_type(agg: AggCall) -> Type:
-    if agg.fn in ("count", "count_star"):
+    if agg.fn in ("count", "count_star", "hll_merge", "approx_distinct"):
         return BIGINT
     if agg.fn == "sum":
         return _sum_type(agg.arg.type)
@@ -95,7 +107,7 @@ def output_type(agg: AggCall) -> Type:
         from presto_tpu.types import BOOLEAN
 
         return BOOLEAN
-    return agg.arg.type
+    return agg.arg.type  # min/max/min_by/max_by/approx_percentile: x's type
 
 
 def _seg_sum(vals, gid, n):
@@ -122,6 +134,12 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
             out.append([cnt])
             continue
         data, valid = c.compile(agg.arg)(page)
+        if agg.fn in ("min", "max") and agg.arg.type.is_string:
+            # reduce over collation ranks, not assignment-ordered codes
+            adict = _agg_dict(agg, [b.dictionary for b in page.blocks])
+            if adict is not None:
+                rank_lut, _ = _collation_luts(adict)
+                data = rank_lut[jnp.clip(data, 0, rank_lut.shape[0] - 1)]
         nonnull = rowsel & valid
         gid_nn = jnp.where(nonnull, gid, n)
         cnt = _seg_sum(nonnull.astype(jnp.int64), gid_nn, n + 1)[:n]
@@ -163,6 +181,41 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
             t = _seg_sum((nonnull & data.astype(jnp.bool_)).astype(jnp.int64),
                          gid_nn, n + 1)[:n]
             out.append([t, cnt])
+        elif agg.fn in ("min_by", "max_by"):
+            # two-phase coupled reduction: per-group extreme of the key,
+            # then (any) x among the rows achieving it (reference:
+            # operator/aggregation/minmaxby/ MinMaxByStateFactory)
+            y_data, y_valid = c.compile(agg.arg2)(page)
+            if agg.arg2.type.is_string:
+                from presto_tpu.expr.compile import expr_dictionary
+
+                ydict = expr_dictionary(agg.arg2, [b.dictionary for b in page.blocks])
+                if ydict is not None:
+                    y_rank, _ = _collation_luts(ydict)
+                    y_data = y_rank[jnp.clip(y_data, 0, y_rank.shape[0] - 1)]
+            sel = rowsel & y_valid
+            gid_y = jnp.where(sel, gid, n)
+            ycnt = _seg_sum(sel.astype(jnp.int64), gid_y, n + 1)[:n]
+            if agg.fn == "min_by":
+                yfill = _type_max(agg.arg2.type)
+                y_best = jax.ops.segment_min(
+                    jnp.where(sel, y_data, yfill), gid_y, num_segments=n + 1)[:n]
+            else:
+                yfill = _type_min(agg.arg2.type)
+                y_best = jax.ops.segment_max(
+                    jnp.where(sel, y_data, yfill), gid_y, num_segments=n + 1)[:n]
+            tie = sel & (y_data == y_best[jnp.clip(gid_y, 0, n - 1)])
+            xv = tie & valid
+            x_best = jax.ops.segment_max(
+                jnp.where(xv, data, _type_min(agg.arg.type)),
+                jnp.where(xv, gid, n), num_segments=n + 1)[:n]
+            xv_cnt = _seg_sum(xv.astype(jnp.int64), jnp.where(xv, gid, n), n + 1)[:n]
+            out.append([x_best, (xv_cnt > 0).astype(jnp.int64), y_best, ycnt])
+        elif agg.fn == "hll_merge":
+            # fold rho rows (one per (group, bucket)) into the sketch sum
+            rho = jnp.where(nonnull, data.astype(jnp.float64), 0.0)
+            s = _seg_sum(jnp.where(nonnull, jnp.exp2(-rho), 0.0), gid_nn, n + 1)[:n]
+            out.append([s, cnt])
         else:
             raise KeyError(agg.fn)
     return out
@@ -204,12 +257,72 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
             out.append([s, m2, cnt])
         elif agg.fn in ("bool_and", "bool_or", "every"):
             out.append([_seg_sum(c, gid, n + 1)[:n] for c in cols])
+        elif agg.fn in ("min_by", "max_by"):
+            x_i, xv_i, y_i, c_i = cols
+            sel = c_i > 0
+            gid_y = jnp.where(sel, gid, n)
+            ycnt = _seg_sum(c_i, gid_y, n + 1)[:n]
+            if agg.fn == "min_by":
+                yfill = _type_max(agg.arg2.type)
+                y_best = jax.ops.segment_min(
+                    jnp.where(sel, y_i, yfill), gid_y, num_segments=n + 1)[:n]
+            else:
+                yfill = _type_min(agg.arg2.type)
+                y_best = jax.ops.segment_max(
+                    jnp.where(sel, y_i, yfill), gid_y, num_segments=n + 1)[:n]
+            tie = sel & (y_i == y_best[jnp.clip(gid_y, 0, n - 1)])
+            xv_in = tie & (xv_i > 0)
+            x_best = jax.ops.segment_max(
+                jnp.where(xv_in, x_i, _type_min(agg.arg.type)),
+                jnp.where(xv_in, gid, n), num_segments=n + 1)[:n]
+            xv_cnt = _seg_sum(xv_in.astype(jnp.int64), jnp.where(xv_in, gid, n), n + 1)[:n]
+            out.append([x_best, (xv_cnt > 0).astype(jnp.int64), y_best, ycnt])
+        elif agg.fn == "hll_merge":
+            out.append([
+                _seg_sum(cols[0], gid, n + 1)[:n],
+                _seg_sum(cols[1], gid, n + 1)[:n],
+            ])
+        else:
+            raise KeyError(agg.fn)
     return out
 
 
-def _finalize(states: List[List[jax.Array]], aggs) -> List[Block]:
+def _agg_dict(agg: AggCall, dictionaries) -> Optional[object]:
+    """Dictionary carried through value-preserving aggregates
+    (min/max/min_by/max_by of a VARCHAR argument)."""
+    if agg.fn not in ("min", "max", "min_by", "max_by"):
+        return None
+    if agg.arg is None or not agg.arg.type.is_string:
+        return None
+    from presto_tpu.expr.compile import expr_dictionary
+
+    return expr_dictionary(agg.arg, dictionaries)
+
+
+def _collation_luts(d) -> Tuple[jax.Array, jax.Array]:
+    """(code -> collation rank, rank -> representative code) LUTs.
+    Dictionary codes are assignment-ordered, not collation-ordered, so
+    string min/max must reduce over ranks (duplicate values share a
+    rank; the inverse picks a representative code)."""
+    values = d.values
+    order = sorted(range(len(values)), key=lambda i: values[i])
+    rank = [0] * len(values)
+    inv = [0] * len(values)
+    prev = None
+    r = 0
+    for pos, i in enumerate(order):
+        if values[i] != prev:
+            r = pos
+            prev = values[i]
+            inv[r] = i
+        rank[i] = r
+    return (jnp.asarray(rank, dtype=jnp.int32), jnp.asarray(inv, dtype=jnp.int32))
+
+
+def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block]:
     blocks = []
-    for agg, cols in zip(aggs, states):
+    agg_dicts = agg_dicts or [None] * len(aggs)
+    for agg, cols, adict in zip(aggs, states, agg_dicts):
         t = output_type(agg)
         if agg.fn in ("count", "count_star"):
             blocks.append(Block(cols[0].astype(jnp.int64), jnp.ones_like(cols[0], jnp.bool_), t))
@@ -226,7 +339,11 @@ def _finalize(states: List[List[jax.Array]], aggs) -> List[Block]:
             blocks.append(Block(d, cnt > 0, t))
         elif agg.fn in ("min", "max"):
             m, cnt = cols
-            blocks.append(Block(m.astype(t.np_dtype), cnt > 0, t))
+            if adict is not None:
+                # state holds collation ranks; map back to codes
+                _, inv_lut = _collation_luts(adict)
+                m = inv_lut[jnp.clip(m.astype(jnp.int32), 0, inv_lut.shape[0] - 1)]
+            blocks.append(Block(m.astype(t.np_dtype), cnt > 0, t, adict))
         elif agg.fn in VARIANCE_FNS:
             s, m2, cnt = cols
             n = jnp.maximum(cnt, 1).astype(jnp.float64)
@@ -247,6 +364,24 @@ def _finalize(states: List[List[jax.Array]], aggs) -> List[Block]:
             else:
                 v = trues == cnt
             blocks.append(Block(v, cnt > 0, t))
+        elif agg.fn in ("min_by", "max_by"):
+            x, xv, _y, cnt = cols
+            blocks.append(Block(x.astype(t.np_dtype), (cnt > 0) & (xv > 0), t, adict))
+        elif agg.fn == "hll_merge":
+            # HLL estimator with linear-counting small-range correction
+            # (airlift HyperLogLog / the original Flajolet et al. paper)
+            s, present = cols
+            m = float(HLL_M)
+            alpha = 0.7213 / (1.0 + 1.079 / m)
+            zeros = m - present.astype(jnp.float64)
+            s_full = s + zeros  # absent buckets contribute 2^-0 = 1
+            raw = alpha * m * m / jnp.maximum(s_full, 1e-12)
+            lc = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+            est = jnp.where((raw <= 2.5 * m) & (zeros > 0), lc, raw)
+            blocks.append(Block(jnp.round(est).astype(jnp.int64),
+                                jnp.ones_like(present, jnp.bool_), t))
+        else:
+            raise KeyError(agg.fn)
     return blocks
 
 
@@ -383,6 +518,7 @@ def grouped_aggregate(
     key_dicts = [
         expr_dictionary(e, dicts) if e.type.is_string else None for e in group_exprs
     ]
+    agg_dicts = [_agg_dict(a, dicts) for a in aggs]
 
     live = page.row_mask
 
@@ -392,7 +528,7 @@ def grouped_aggregate(
         states = _partial_states(page, aggs, gid, 1)
         key_blocks: List[Block] = []
         out_mask = jnp.ones(1, dtype=jnp.bool_)
-        out = _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts)
+        out = _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts, agg_dicts)
         return (out, jnp.ones((), jnp.int32)) if return_count else out
 
     key, exact = pack_or_hash_keys(datas, valids, key_domains)
@@ -412,7 +548,7 @@ def grouped_aggregate(
             key_blocks = _unpack_key_blocks(
                 cards, key_domains, group_exprs, key_dicts, prod, max_groups
             )
-            out = _emit(key_blocks, states, aggs, present, mode, group_exprs, key_dicts)
+            out = _emit(key_blocks, states, aggs, present, mode, group_exprs, key_dicts, agg_dicts)
             return (out, jnp.sum(present.astype(jnp.int32))) if return_count else out
 
     # sort path
@@ -424,7 +560,7 @@ def grouped_aggregate(
         kb_valid = v[rep_rows]
         key_blocks.append(Block(kb_data, kb_valid, e.type, dic))
     out_mask = jnp.arange(max_groups) < num_groups
-    out = _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts)
+    out = _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts, agg_dicts)
     return (out, num_groups) if return_count else out
 
 
@@ -441,14 +577,17 @@ def _unpack_key_blocks(cards, domains, group_exprs, key_dicts, prod, capacity) -
     return blocks
 
 
-def _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts) -> Page:
+def _emit(key_blocks, states, aggs, out_mask, mode, group_exprs, key_dicts,
+          agg_dicts=None) -> Page:
+    agg_dicts = agg_dicts or [None] * len(aggs)
     if mode == "partial":
         blocks = list(key_blocks)
-        for agg, cols in zip(aggs, states):
-            for t, colv in zip(state_types(agg), cols):
-                blocks.append(Block(colv.astype(t.np_dtype), out_mask, t))
+        for agg, cols, adict in zip(aggs, states, agg_dicts):
+            for j, (t, colv) in enumerate(zip(state_types(agg), cols)):
+                blocks.append(Block(colv.astype(t.np_dtype), out_mask, t,
+                                    adict if j == 0 else None))
         return Page(tuple(blocks), out_mask)
-    agg_blocks = _finalize(states, aggs)
+    agg_blocks = _finalize(states, aggs, agg_dicts)
     # clamp validity to live groups
     agg_blocks = [Block(b.data, b.valid & out_mask, b.type, b.dictionary) for b in agg_blocks]
     return Page(tuple(key_blocks) + tuple(agg_blocks), out_mask)
@@ -476,12 +615,15 @@ def merge_aggregate(
     key_dicts = [partial.blocks[i].dictionary for i in range(num_keys)]
     key_types = [partial.blocks[i].type for i in range(num_keys)]
 
-    # slice state columns per agg
+    # slice state columns per agg; the first state column carries the
+    # dictionary for value-preserving aggregates (min/max/min_by/max_by)
     state_cols: List[List[jax.Array]] = []
+    agg_dicts: List[Optional[object]] = []
     pos = num_keys
     for agg in aggs:
         ncols = len(state_types(agg))
         state_cols.append([partial.blocks[pos + j].data for j in range(ncols)])
+        agg_dicts.append(partial.blocks[pos].dictionary)
         pos += ncols
 
     from presto_tpu.expr.ir import ColumnRef
@@ -493,7 +635,7 @@ def merge_aggregate(
     if num_keys == 0:
         gid = jnp.where(live, 0, 1).astype(jnp.int32)
         merged = _merge_states(state_cols, aggs, gid, 1)
-        out = _emit([], merged, aggs, jnp.ones(1, jnp.bool_), mode, group_exprs, key_dicts)
+        out = _emit([], merged, aggs, jnp.ones(1, jnp.bool_), mode, group_exprs, key_dicts, agg_dicts)
         return (out, jnp.ones((), jnp.int32)) if return_count else out
 
     key, exact = pack_or_hash_keys(datas, valids, key_domains)
@@ -503,5 +645,5 @@ def merge_aggregate(
     for d, v, t, dic in zip(datas, valids, key_types, key_dicts):
         key_blocks.append(Block(d[rep_rows].astype(t.np_dtype), v[rep_rows], t, dic))
     out_mask = jnp.arange(max_groups) < num_groups
-    out = _emit(key_blocks, merged, aggs, out_mask, mode, group_exprs, key_dicts)
+    out = _emit(key_blocks, merged, aggs, out_mask, mode, group_exprs, key_dicts, agg_dicts)
     return (out, num_groups) if return_count else out

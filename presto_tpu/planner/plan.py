@@ -159,6 +159,13 @@ class AggregationNode(PlanNode):
                 out.append(None)
         return out
 
+    def _agg_dict(self, agg, src: List[Channel]):
+        """Dictionary of value-preserving aggregates — the single
+        source of truth lives in ops/aggregate.py (_agg_dict)."""
+        from presto_tpu.ops.aggregate import _agg_dict as agg_dictionary
+
+        return agg_dictionary(agg, [c.dictionary for c in src])
+
     @property
     def channels(self) -> List[Channel]:
         src = self.source.channels
@@ -166,11 +173,13 @@ class AggregationNode(PlanNode):
         if self.step == "partial":
             states = []
             for agg, name in zip(self.aggs, self.agg_names):
+                d = self._agg_dict(agg, src)
                 for j, t in enumerate(agg_state_types(agg)):
-                    states.append(Channel(f"{name}${j}", t))
+                    states.append(Channel(f"{name}${j}", t, d if j == 0 else None))
             return keys + states
         return keys + [
-            Channel(n, agg_output_type(a)) for a, n in zip(self.aggs, self.agg_names)
+            Channel(n, agg_output_type(a), self._agg_dict(a, src))
+            for a, n in zip(self.aggs, self.agg_names)
         ]
 
 

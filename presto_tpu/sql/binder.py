@@ -59,9 +59,10 @@ AGG_FUNCTIONS = {
     "sum", "avg", "count", "min", "max",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every",
-    # approx_distinct is exact here (distinct rewrite) — better accuracy
-    # than the reference's HLL at the cost of a wider shuffle
+    # approx_distinct: real HyperLogLog sketch (m=4096), lowered to a
+    # two-level aggregation (see _rewrite_approx_distinct)
     "approx_distinct",
+    "min_by", "max_by", "approx_percentile",
 }
 
 # Correlated bindings mark outer-scope columns with this offset so a
@@ -89,6 +90,12 @@ SCALAR_FUNCTIONS = {
     "year", "month", "day", "day_of_week", "day_of_year", "quarter", "week",
     "hour", "minute", "second", "millisecond",
     "date_trunc", "date_add", "date_diff", "from_unixtime", "to_unixtime",
+    "regexp_like", "regexp_extract", "regexp_replace", "replace",
+    "split_part", "lpad", "rpad", "concat", "starts_with", "ends_with",
+    "codepoint",
+    "json_extract", "json_extract_scalar", "json_array_length", "is_json_scalar",
+    "url_extract_host", "url_extract_path", "url_extract_protocol",
+    "url_extract_query", "url_extract_port",
 }
 
 
@@ -901,6 +908,15 @@ class Binder:
             group_names = group_names + ["$group_id"]
         agg_names = [f"$agg{j}" for j in range(len(agg_ctx.aggs))]
 
+        # approx_percentile: exact-rank rewrite through a window pre-pass
+        if any(a.fn == "approx_percentile" for a in agg_ctx.aggs):
+            node = self._rewrite_approx_percentile(node, group_irs, agg_ctx)
+
+        # approx_distinct: HyperLogLog two-level aggregation rewrite
+        if any(a.fn == "approx_distinct" for a in agg_ctx.aggs):
+            node, agg_ctx = self._rewrite_approx_distinct(node, scope, group_irs, agg_ctx)
+            group_irs = agg_ctx.group_irs
+
         # distinct aggregates: rewrite through a distinct pre-aggregation
         if any(a.distinct for a in agg_ctx.aggs):
             node, agg_ctx = self._rewrite_distinct_aggs(node, scope, group_irs, agg_ctx)
@@ -943,6 +959,77 @@ class Binder:
         cap = min(prod, int(est_rows) + 1)
         cap = 1 << (max(cap - 1, 1)).bit_length()
         return max(1 << 4, min(cap, 1 << 24))
+
+    def _rewrite_approx_percentile(self, node, group_irs, agg_ctx: AggCtx):
+        """approx_percentile(x, p) -> max(if(rn = floor(p*(cnt-1))+1, x))
+        over a window pre-pass computing rn = row_number() and cnt =
+        count(x) per group partition ordered by x. Exact rank selection
+        (better than the reference's qdigest approximation,
+        operator/aggregation/ApproximateLongPercentileAggregations.java)
+        expressed with existing segmented-scan machinery — no sketch
+        state to merge. Entries are replaced in place so already-bound
+        output references stay valid."""
+        from presto_tpu.ops.window import WindowFunc
+        from presto_tpu.planner.plan import WindowNode
+
+        for j, a in enumerate(list(agg_ctx.aggs)):
+            if a.fn != "approx_percentile":
+                continue
+            if a.distinct:
+                raise BindError("approx_percentile DISTINCT unsupported")
+            x, p = a.arg, a.arg2
+            base = len(node.channels)
+            node = WindowNode(
+                source=node,
+                partition_exprs=list(group_irs),
+                order_exprs=[x],
+                ascending=[True],
+                funcs=[WindowFunc(kind="row_number"),
+                       WindowFunc(kind="count", arg=x, frame=("whole",))],
+                func_names=[f"$pctl_rn{j}", f"$pctl_cnt{j}"],
+            )
+            rn_ref = ColumnRef(type=BIGINT, index=base)
+            cnt_ref = ColumnRef(type=BIGINT, index=base + 1)
+            target = call(
+                "add",
+                call("cast_bigint",
+                     call("floor",
+                          call("mul", p,
+                               call("cast_double",
+                                    call("sub", cnt_ref, Literal(type=BIGINT, value=1)))))),
+                Literal(type=BIGINT, value=1),
+            )
+            newarg = call("if", call("eq", rn_ref, target), x,
+                          Literal(type=x.type, value=None))
+            agg_ctx.aggs[j] = AggCall(fn="max", arg=newarg, type=a.type,
+                                      filter=a.filter)
+        return node
+
+    def _rewrite_approx_distinct(self, node, scope, group_irs, agg_ctx: AggCtx):
+        """approx_distinct(x) -> inner aggregation grouped by
+        (keys..., hll_bucket(x)) computing max(hll_rho(x)), outer
+        hll_merge folding the per-bucket registers into the HLL
+        estimate. Reference: ApproximateCountDistinctAggregations.java
+        (airlift HyperLogLog); here the register file IS the inner
+        aggregation's output — no per-group register arrays."""
+        if not all(a.fn == "approx_distinct" for a in agg_ctx.aggs):
+            raise BindError("approx_distinct cannot mix with other aggregates")
+        args = {a.arg for a in agg_ctx.aggs}
+        if len(args) != 1:
+            raise BindError("multiple approx_distinct arguments unsupported")
+        (arg,) = args
+        inner_keys = group_irs + [call("hll_bucket", arg)]
+        inner = AggregationNode(
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
+            [AggCall(fn="max", arg=call("hll_rho", arg), type=BIGINT)], ["$rho"],
+            max_groups=self._group_capacity(inner_keys, scope, self._estimate(node)),
+        )
+        new_group = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
+        rho_ref = ColumnRef(type=BIGINT, index=len(inner_keys))
+        new_aggs = [AggCall(fn="hll_merge", arg=rho_ref, type=BIGINT)
+                    for _ in agg_ctx.aggs]
+        ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group, aggs=new_aggs)
+        return inner, ctx
 
     def _rewrite_distinct_aggs(self, node, scope, group_irs, agg_ctx: AggCtx):
         """agg(DISTINCT x) GROUP BY g  ->  inner distinct on (g, x),
@@ -1349,6 +1436,15 @@ class Binder:
                 return self._bind_agg_call(e, scope, agg)
             if e.name in SCALAR_FUNCTIONS:
                 args = [self._bind_impl(a, scope, agg) for a in e.args]
+                if e.name == "concat":
+                    non_lit = [a for a in args if not isinstance(a, Literal)]
+                    if not non_lit:
+                        return Literal(type=VARCHAR,
+                                       value="".join(str(a.value) for a in args))
+                    if len(non_lit) != 1:
+                        raise BindError(
+                            "concat/|| supports one column operand plus literals"
+                            " (multi-column concatenation needs raw varchar)")
                 return call(e.name, *args)
             raise BindError(f"unknown function {e.name}")
 
@@ -1562,12 +1658,28 @@ class Binder:
         if e.star or (e.name == "count" and not e.args):
             a = AggCall(fn="count_star", arg=None, type=BIGINT)
             return agg.agg_ref(a)
+        fn, distinct = e.name, e.distinct
+        if fn in ("min_by", "max_by", "approx_percentile"):
+            if len(e.args) != 2:
+                raise BindError(f"aggregate {fn} takes two arguments")
+            if distinct:
+                raise BindError(f"DISTINCT unsupported for {fn}")
+            arg = self._bind(e.args[0], scope)
+            arg2 = self._bind(e.args[1], scope)
+            if fn == "approx_percentile":
+                if not isinstance(arg2, Literal) or arg2.value is None:
+                    raise BindError("approx_percentile fraction must be a literal")
+                p = float(arg2.value) / (10.0 ** (arg2.type.scale or 0)
+                                         if arg2.type.is_decimal else 1.0)
+                if not 0.0 <= p <= 1.0:
+                    raise BindError("approx_percentile fraction must be in [0, 1]")
+                arg2 = Literal(type=DOUBLE, value=p)
+            a = AggCall(fn=fn, arg=arg, type=arg.type, distinct=distinct, arg2=arg2)
+            a = dataclasses.replace(a, type=output_type(a))
+            return agg.agg_ref(a)
         if len(e.args) != 1:
             raise BindError(f"aggregate {e.name} takes one argument")
         arg = self._bind(e.args[0], scope)
-        fn, distinct = e.name, e.distinct
-        if fn == "approx_distinct":
-            fn, distinct = "count", True
         a = AggCall(fn=fn, arg=arg, type=arg.type, distinct=distinct)
         a = AggCall(fn=a.fn, arg=a.arg, type=output_type(a), distinct=a.distinct)
         return agg.agg_ref(a)

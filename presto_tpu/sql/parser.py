@@ -368,13 +368,13 @@ class Parser:
         return self._predicate()
 
     def _predicate(self) -> ast.Node:
-        e = self._addsub()
+        e = self._concat()
         while True:
             if self.peek("=", "<>", "!=", "<", "<=", ">", ">="):
                 op = self.tok.value
                 self.i += 1
                 op = {"!=": "<>"}.get(op, op)
-                rhs = self._addsub()
+                rhs = self._concat()
                 e = ast.Binary(op, e, rhs)
                 continue
             negated = False
@@ -386,9 +386,9 @@ class Parser:
                     self.i = save
                     return e
             if self.accept("between"):
-                lo = self._addsub()
+                lo = self._concat()
                 self.expect("and")
-                hi = self._addsub()
+                hi = self._concat()
                 e = ast.Between(e, lo, hi, negated)
                 continue
             if self.accept("in"):
@@ -405,7 +405,7 @@ class Parser:
                     e = ast.InList(e, tuple(items), negated)
                 continue
             if self.accept("like"):
-                e = ast.Like(e, self._addsub(), negated)
+                e = ast.Like(e, self._concat(), negated)
                 continue
             if self.accept("is"):
                 neg = bool(self.accept("not"))
@@ -413,6 +413,13 @@ class Parser:
                 e = ast.IsNull(e, neg)
                 continue
             return e
+
+    def _concat(self) -> ast.Node:
+        e = self._addsub()
+        while self.peek("||"):
+            self.i += 1
+            e = ast.FuncCall("concat", (e, self._addsub()))
+        return e
 
     def _addsub(self) -> ast.Node:
         e = self._muldiv()

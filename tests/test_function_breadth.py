@@ -1,0 +1,315 @@
+"""Function-breadth coverage: regex/JSON/URL scalars, min_by/max_by,
+approx_percentile, HyperLogLog approx_distinct.
+
+Reference analogs: operator/scalar/{RegexpFunctions,JsonFunctions,
+UrlFunctions,StringFunctions}.java, operator/aggregation/minmaxby/,
+ApproximateLongPercentileAggregations.java,
+ApproximateCountDistinctAggregations.java."""
+
+import math
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+
+from tests.oracle import assert_rows_match, load_oracle, run_oracle
+
+
+@pytest.fixture(scope="module")
+def env():
+    tpch = Tpch(sf=0.001, split_rows=4096)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    return QueryRunner(catalog), load_oracle(tpch)
+
+
+@pytest.fixture(scope="module")
+def docs_runner():
+    """A table with JSON/URL shaped strings."""
+    docs = [
+        '{"a": 1, "b": [10, 20, 30], "c": {"d": "x"}}',
+        '{"a": 2, "b": [], "s": "str"}',
+        '{"a": null}',
+        "[1, 2, 3]",
+        "not json",
+        '{"a": 42, "b": [7]}',
+    ]
+    urls = [
+        "https://example.com:8080/path/to/page?q=1",
+        "http://presto.io/docs",
+        "https://tpu.dev/",
+        "ftp://files.org/a/b.txt",
+        "not a url",
+        "https://example.com/other?x=2",
+    ]
+    d_docs, d_urls = Dictionary(docs), Dictionary(urls)
+    n = len(docs)
+    page = Page.from_arrays(
+        [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int32),
+         np.arange(n, dtype=np.int32)],
+        [BIGINT, VARCHAR, VARCHAR],
+        dictionaries=[None, d_docs, d_urls],
+    )
+    mem = MemoryConnector()
+    mem.create_table("docs", [("id", BIGINT), ("doc", VARCHAR), ("url", VARCHAR)], [page])
+    catalog = Catalog()
+    catalog.register("mem", mem)
+    return QueryRunner(catalog), docs, urls
+
+
+# ---------------------------------------------------------------------------
+# regex / string transforms (vs sqlite-computed or python expectations)
+# ---------------------------------------------------------------------------
+
+def test_regexp_like(env):
+    runner, oracle = env
+    sql = "select n_name from nation where regexp_like(n_name, '^[A-C].*A$')"
+    import re as _re
+
+    expected = [r for r in run_oracle(oracle, "select n_name from nation")
+                if _re.search("^[A-C].*A$", r[0])]
+    actual = runner.execute(sql).rows
+    assert_rows_match(actual, expected, ordered=False)
+
+
+def test_regexp_extract_replace(env):
+    runner, oracle = env
+    rows = runner.execute(
+        "select c_phone, regexp_extract(c_phone, '^([0-9]+)-', 1),"
+        " regexp_replace(c_phone, '[0-9]', '#') from customer limit 200").rows
+    import re as _re
+
+    for phone, cc, masked in rows:
+        m = _re.search(r"^([0-9]+)-", phone)
+        assert cc == (m.group(1) if m else None)
+        assert masked == _re.sub("[0-9]", "#", phone)
+
+
+def test_replace_split_pad_concat(env):
+    runner, _ = env
+    rows = runner.execute(
+        "select n_name, replace(n_name, 'A', '@'), split_part(n_name, 'A', 1),"
+        " lpad(n_name, 12, '*'), rpad(n_name, 12, '*'),"
+        " 'x-' || n_name, concat(n_name, '!') from nation").rows
+    for name, repl, sp, lp, rp, cc, cc2 in rows:
+        assert repl == name.replace("A", "@")
+        assert sp == name.split("A")[0]
+        assert lp == ("*" * 12)[: 12 - len(name)] + name if len(name) < 12 else name[:12]
+        assert rp == (name + "*" * 12)[:12] if len(name) < 12 else name[:12]
+        assert cc == "x-" + name
+        assert cc2 == name + "!"
+
+
+def test_starts_ends_with_codepoint(env):
+    runner, _ = env
+    rows = runner.execute(
+        "select n_name, starts_with(n_name, 'A'), ends_with(n_name, 'A'),"
+        " codepoint(n_name) from nation").rows
+    for name, sw, ew, cp in rows:
+        assert sw == name.startswith("A")
+        assert ew == name.endswith("A")
+        assert cp == ord(name[0])
+
+
+def test_split_part_out_of_range_null(env):
+    runner, _ = env
+    rows = runner.execute(
+        "select count(*) from nation where split_part(n_name, 'Q', 2) is null").rows
+    # names without 'Q' have no part 2
+    names = runner.execute("select n_name from nation").rows
+    want = sum(1 for (n,) in names if len(n.split("Q")) < 2)
+    assert rows == [(want,)]
+
+
+# ---------------------------------------------------------------------------
+# JSON / URL
+# ---------------------------------------------------------------------------
+
+def test_json_functions(docs_runner):
+    runner, docs, _ = docs_runner
+    rows = runner.execute(
+        "select id, json_extract_scalar(doc, '$.a'),"
+        " json_extract(doc, '$.b'), json_array_length(doc),"
+        " json_extract_scalar(doc, '$.c.d'), json_extract_scalar(doc, '$.b[1]'),"
+        " is_json_scalar(doc)"
+        " from docs order by id").rows
+    import json as _json
+
+    for i, a, b, alen, cd, b1, scalar in rows:
+        doc = docs[i]
+        try:
+            parsed = _json.loads(doc)
+        except Exception:
+            parsed = None
+        want_a = None
+        if isinstance(parsed, dict) and parsed.get("a") is not None:
+            want_a = str(parsed["a"])
+        assert a == want_a, (i, a)
+        want_b = None
+        if isinstance(parsed, dict) and "b" in parsed:
+            want_b = _json.dumps(parsed["b"], separators=(",", ":"))
+        assert b == want_b
+        assert alen == (len(parsed) if isinstance(parsed, list) else None)
+        want_cd = None
+        if isinstance(parsed, dict) and isinstance(parsed.get("c"), dict):
+            want_cd = parsed["c"].get("d")
+        assert cd == want_cd
+        want_b1 = None
+        if isinstance(parsed, dict) and isinstance(parsed.get("b"), list) and len(parsed["b"]) > 1:
+            want_b1 = str(parsed["b"][1])
+        assert b1 == want_b1
+        assert scalar == (parsed is not None and not isinstance(parsed, (dict, list)))
+
+
+def test_url_functions(docs_runner):
+    runner, _, urls = docs_runner
+    rows = runner.execute(
+        "select id, url_extract_host(url), url_extract_path(url),"
+        " url_extract_protocol(url), url_extract_query(url), url_extract_port(url)"
+        " from docs order by id").rows
+    from urllib.parse import urlparse
+
+    for i, host, path, proto, query, port in rows:
+        u = urlparse(urls[i])
+        assert host == (u.hostname or None)
+        assert path == (u.path if u.path else (None if u.scheme else u.path or None)) or path == u.path
+        assert proto == (u.scheme or None)
+        assert query == (u.query or None)
+        assert port == u.port
+
+
+# ---------------------------------------------------------------------------
+# min_by / max_by / approx_percentile / approx_distinct
+# ---------------------------------------------------------------------------
+
+def test_min_by_max_by(env):
+    runner, oracle = env
+    actual = runner.execute(
+        "select s_nationkey, min_by(s_name, s_acctbal), max_by(s_name, s_acctbal)"
+        " from supplier group by s_nationkey").rows
+    expected = run_oracle(oracle, """
+        select s_nationkey,
+               (select s2.s_name from supplier s2 where s2.s_nationkey = s1.s_nationkey
+                order by s2.s_acctbal asc limit 1),
+               (select s3.s_name from supplier s3 where s3.s_nationkey = s1.s_nationkey
+                order by s3.s_acctbal desc limit 1)
+        from supplier s1 group by s_nationkey""")
+    assert_rows_match(actual, expected, ordered=False)
+
+
+def test_min_by_global(env):
+    runner, oracle = env
+    actual = runner.execute(
+        "select max_by(c_name, c_acctbal) from customer").rows
+    expected = run_oracle(
+        oracle, "select c_name from customer order by c_acctbal desc limit 1")
+    assert actual == expected
+
+
+def test_approx_percentile(env):
+    runner, oracle = env
+    for p in (0.0, 0.25, 0.5, 0.9, 1.0):
+        actual = runner.execute(
+            f"select approx_percentile(o_totalprice, {p}) from orders").rows
+        vals = sorted(v for (v,) in run_oracle(oracle, "select o_totalprice from orders"))
+        want = vals[int(math.floor(p * (len(vals) - 1)))]
+        assert math.isclose(actual[0][0], want, rel_tol=1e-9), (p, actual, want)
+
+
+def test_approx_percentile_grouped(env):
+    runner, oracle = env
+    actual = dict(runner.execute(
+        "select s_nationkey, approx_percentile(s_acctbal, 0.5)"
+        " from supplier group by s_nationkey").rows)
+    groups = {}
+    for k, v in run_oracle(oracle, "select s_nationkey, s_acctbal from supplier"):
+        groups.setdefault(k, []).append(v)
+    for k, vals in groups.items():
+        vals.sort()
+        want = vals[int(math.floor(0.5 * (len(vals) - 1)))]
+        assert math.isclose(actual[k], want, rel_tol=1e-9), k
+
+
+def test_approx_distinct_hll(env):
+    runner, oracle = env
+    for col, table in (("o_custkey", "orders"), ("l_partkey", "lineitem"),
+                       ("s_nationkey", "supplier")):
+        actual = runner.execute(f"select approx_distinct({col}) from {table}").rows[0][0]
+        exact = run_oracle(oracle, f"select count(distinct {col}) from {table}")[0][0]
+        assert abs(actual - exact) <= max(0.05 * exact, 2), (col, actual, exact)
+
+
+def test_approx_distinct_grouped(env):
+    runner, oracle = env
+    actual = dict(runner.execute(
+        "select o_orderstatus, approx_distinct(o_custkey) from orders"
+        " group by o_orderstatus").rows)
+    expected = dict(run_oracle(
+        oracle, "select o_orderstatus, count(distinct o_custkey) from orders"
+        " group by o_orderstatus"))
+    assert set(actual) == set(expected)
+    for k, exact in expected.items():
+        assert abs(actual[k] - exact) <= max(0.05 * exact, 2), k
+
+
+def test_approx_distinct_empty(env):
+    runner, _ = env
+    rows = runner.execute(
+        "select approx_distinct(o_custkey) from orders where o_orderkey < 0").rows
+    assert rows == [(0,)]
+
+
+def test_varchar_min_max_collation(env):
+    """min/max over VARCHAR must order by value, not dictionary code
+    (s_name codes are assignment-ordered; p_type's are not lexicographic)."""
+    runner, oracle = env
+    for col, table in (("p_type", "part"), ("c_mktsegment", "customer"),
+                       ("s_name", "supplier")):
+        actual = runner.execute(f"select min({col}), max({col}) from {table}").rows
+        expected = run_oracle(oracle, f"select min({col}), max({col}) from {table}")
+        assert actual == expected, col
+
+
+def test_min_by_string_key(env):
+    """min_by/max_by with a VARCHAR ordering key compares values."""
+    runner, oracle = env
+    actual = runner.execute(
+        "select min_by(p_partkey, p_type), max_by(p_partkey, p_type) from part").rows
+    expected = run_oracle(oracle, """
+        select (select p_partkey from part order by p_type asc, p_partkey limit 1),
+               (select p_partkey from part order by p_type desc, p_partkey limit 1)""")
+    # ties on p_type broken arbitrarily: compare the chosen key's type
+    types = dict(run_oracle(oracle, "select p_partkey, p_type from part"))
+    want_min = run_oracle(oracle, "select min(p_type) from part")[0][0]
+    want_max = run_oracle(oracle, "select max(p_type) from part")[0][0]
+    assert types[actual[0][0]] == want_min
+    assert types[actual[0][1]] == want_max
+
+
+def test_approx_distinct_over_transform(env):
+    """approx_distinct(substr(x, 1, 1)) counts distinct transformed
+    values, not distinct source codes."""
+    runner, oracle = env
+    actual = runner.execute(
+        "select approx_distinct(substr(c_name, 1, 10)) from customer").rows[0][0]
+    exact = run_oracle(
+        oracle, "select count(distinct substr(c_name, 1, 10)) from customer")[0][0]
+    assert abs(actual - exact) <= max(0.05 * exact, 2), (actual, exact)
+
+
+def test_cross_dict_eq_with_derived(env):
+    """Equality through a derived dictionary that maps many codes to one
+    value (substr) must compare values."""
+    runner, oracle = env
+    sql = ("select count(*) from supplier, customer"
+           " where substr(s_phone, 1, 2) = substr(c_phone, 1, 2)"
+           " and s_suppkey < 20 and c_custkey < 50")
+    actual = runner.execute(sql).rows
+    expected = run_oracle(oracle, sql)
+    assert_rows_match(actual, expected, ordered=False)
