@@ -16,13 +16,17 @@ on this host (the engine itself on the XLA CPU backend is the baseline
 floor; stored in BASELINE_MEASURED.json so the denominator is traceable
 to a real run, per BASELINE.md "must be self-measured").
 
-Robustness: the parent process never imports jax.  Measurement runs in
-a bounded-time child process (retried on backend-init failure, then
-retried on the CPU backend), so one flaky TPU init cannot cost the
-round's perf evidence; a JSON line is emitted no matter what.
+Robustness (hard-learned): the axon TPU tunnel's remote-compile service
+can die mid-run, hanging in-process jax calls indefinitely.  The parent
+therefore never imports jax; each QUERY runs in its own bounded-time
+child process, a dead backend is detected by timeout/UNAVAILABLE and
+the remaining TPU queries are skipped, and at least 45% of the wall
+budget is always reserved for the CPU fallback so a JSON line with a
+real measured number is emitted no matter what the tunnel does.
 
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 3),
-BENCH_TIMEOUT (per-child seconds, default 2400).
+BENCH_TIMEOUT (per-child cap seconds, default 1200),
+BENCH_DEADLINE (overall seconds, default 3300).
 """
 
 import json
@@ -35,16 +39,18 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_FILE = os.path.join(HERE, "BASELINE_MEASURED.json")
 
+QUERY_NAMES = ("q1", "q6", "q3")
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
 # ----------------------------------------------------------------------
-# child mode: actually measure (runs under a fixed platform)
+# child mode: measure one query (or all) under a fixed platform
 # ----------------------------------------------------------------------
 
-def _measure(sf: float, iters: int) -> dict:
+def _measure(sf: float, iters: int, only: str) -> dict:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # jax may be pre-imported at interpreter startup (axon platform
         # plugin) so the env var can be too late; jax.config still works
@@ -85,7 +91,8 @@ def _measure(sf: float, iters: int) -> dict:
 
     from tests.tpch_queries import QUERIES  # the shared corpus
 
-    bench_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3]}
+    all_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3]}
+    bench_queries = {only: all_queries[only]} if only else all_queries
 
     rates = {}
     errors = {}
@@ -105,12 +112,13 @@ def _measure(sf: float, iters: int) -> dict:
         except Exception as e:  # keep going: partial evidence beats none
             errors[name] = f"{type(e).__name__}: {e}"
             log(f"{name}: FAILED {errors[name]}")
+            if "UNAVAILABLE" in str(e) or "Connection" in str(e) or "transport" in str(e):
+                log("backend unreachable; aborting remaining queries")
+                break
 
     out = {"platform": platform, "sf": sf, "rates": rates}
     if errors:
         out["errors"] = errors
-    if rates:
-        out["geomean"] = math.exp(sum(math.log(r) for r in rates.values()) / len(rates))
     return out
 
 
@@ -121,10 +129,14 @@ def _measure(sf: float, iters: int) -> dict:
 MARKER = "BENCH_RESULT_JSON:"
 
 
-def _run_child(env_extra: dict, timeout: float) -> dict:
+def _run_child(env_extra: dict, timeout: float, only: str = "") -> dict:
     env = dict(os.environ)
     env.update(env_extra)
     env["BENCH_MODE"] = "child"
+    if only:
+        env["BENCH_QUERY"] = only
+    else:
+        env.pop("BENCH_QUERY", None)
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
         env=env, cwd=HERE, timeout=timeout,
@@ -134,27 +146,6 @@ def _run_child(env_extra: dict, timeout: float) -> dict:
         if line.startswith(MARKER):
             return json.loads(line[len(MARKER):])
     raise RuntimeError(f"child rc={proc.returncode}, no result marker")
-
-
-def _attempt(env_extra: dict, timeout_fn, label: str, tries: int = 2):
-    """timeout_fn is re-evaluated per try so a timed-out first try
-    shrinks the second try's budget instead of overshooting the overall
-    deadline (which would get the parent killed before it reports)."""
-    for i in range(tries):
-        timeout = timeout_fn()
-        if timeout < 30:
-            log(f"{label} attempt {i+1}: skipped, {timeout:.0f}s left in budget")
-            return None
-        try:
-            res = _run_child(env_extra, timeout)
-            if res.get("rates"):
-                return res
-            log(f"{label} attempt {i+1}: no rates ({res.get('errors')})")
-        except subprocess.TimeoutExpired:
-            log(f"{label} attempt {i+1}: timed out after {timeout}s")
-        except Exception as e:
-            log(f"{label} attempt {i+1}: {type(e).__name__}: {e}")
-    return None
 
 
 _START = time.time()
@@ -173,7 +164,9 @@ def _probe_backend(timeout: float) -> bool:
     """Bounded-time check that the default backend initializes at all."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            [sys.executable, "-c",
+             "import jax; print(jax.devices());"
+             "import jax.numpy as jnp; print(int(jnp.arange(8).sum()))"],
             timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         log(f"backend probe: rc={proc.returncode} {proc.stdout.decode().strip()[-200:]}")
@@ -183,40 +176,60 @@ def _probe_backend(timeout: float) -> bool:
         return False
 
 
+def _measure_tpu_per_query(sf, deadline, per_child_cap) -> dict:
+    """One child per query; a timeout/unreachable child skips the rest
+    (dead-tunnel fail-fast)."""
+    result = {"platform": None, "sf": sf, "rates": {}, "errors": {}}
+    for name in QUERY_NAMES:
+        # never eat into the CPU-fallback reserve (45% of total budget)
+        budget = _remaining(deadline) - 0.45 * deadline
+        timeout = min(per_child_cap, budget)
+        if timeout < 60:
+            log(f"tpu {name}: skipped, {budget:.0f}s tpu budget left")
+            break
+        try:
+            res = _run_child({}, timeout, only=name)
+        except subprocess.TimeoutExpired:
+            log(f"tpu {name}: child timed out after {timeout:.0f}s; "
+                "assuming backend dead, skipping remaining TPU queries")
+            result["errors"][name] = "timeout"
+            break
+        except Exception as e:
+            log(f"tpu {name}: {type(e).__name__}: {e}")
+            result["errors"][name] = str(e)
+            break
+        result["platform"] = res.get("platform")
+        result["rates"].update(res.get("rates", {}))
+        result["errors"].update(res.get("errors", {}))
+        if res.get("errors"):
+            break  # backend already reported unreachable inside the child
+        if result["platform"] == "cpu":
+            # default platform resolved to CPU: this IS the baseline run
+            break
+    return result
+
+
 def main():
     if os.environ.get("BENCH_MODE") == "child":
         sf = float(os.environ.get("BENCH_SF", "1.0"))
         iters = int(os.environ.get("BENCH_ITERS", "3"))
-        print(MARKER + json.dumps(_measure(sf, iters)), flush=True)
+        only = os.environ.get("BENCH_QUERY", "")
+        print(MARKER + json.dumps(_measure(sf, iters, only)), flush=True)
         return
 
     sf = float(os.environ.get("BENCH_SF", "1.0"))
-    timeout = float(os.environ.get("BENCH_TIMEOUT", "2400"))
-    # Overall wall budget: a parent killed by an outer harness emits no
-    # JSON at all, so every child timeout is clamped to what's left.
+    per_child_cap = float(os.environ.get("BENCH_TIMEOUT", "1200"))
     deadline = float(os.environ.get("BENCH_DEADLINE", "3300"))
 
-    def budget(want: float) -> float:
-        return max(min(want, _remaining(deadline)), 1.0)
-
-    # probes are capped to a quarter of the remaining budget each so two
-    # hung probes can never starve the CPU-fallback measurement
-    def probe_budget():
-        return max(min(180.0, _remaining(deadline) * 0.25), 1.0)
-
     result = None
-    if _probe_backend(timeout=probe_budget()) or _probe_backend(timeout=probe_budget()):
-        result = _attempt({}, lambda: budget(timeout), "measure(default platform)")
-    if result is None:
-        result = _attempt(
-            {"JAX_PLATFORMS": "cpu"}, lambda: budget(timeout), "measure(cpu fallback)",
-            tries=1,
-        )
+    if _probe_backend(timeout=min(120.0, max(_remaining(deadline) * 0.1, 30.0))):
+        result = _measure_tpu_per_query(sf, deadline, per_child_cap)
+        if not result.get("rates"):
+            result = None
+    else:
+        log("default backend unreachable; going straight to CPU")
 
-    # ---- baseline: engine-on-CPU rows/s, measured & cached -----------
-    # Only a baseline covering every bench query is cached/used as-is;
-    # ratios are always computed over the intersection of query sets so
-    # a partial run never compares mismatched geomeans.
+    # ---- CPU measurement: fallback result and/or the baseline --------
     baseline = None
     if os.path.exists(BASELINE_FILE):
         try:
@@ -224,22 +237,29 @@ def main():
                 cached = json.load(f)
             if cached.get("sf") == sf and cached.get("rates"):
                 baseline = cached
-                log(f"baseline: cached {cached['rates']} (cpu, sf={sf})")
+                log(f"baseline: cached (cpu, sf={sf})")
         except Exception as e:
             log(f"baseline cache unreadable: {e}")
-    if baseline is None and result is not None and result.get("platform") != "cpu" \
-            and _remaining(deadline) > 60:
-        baseline = _attempt(
-            {"JAX_PLATFORMS": "cpu"}, lambda: budget(timeout), "baseline(cpu)", tries=1
-        )
-        if baseline is not None and not baseline.get("errors"):
-            try:
-                with open(BASELINE_FILE, "w") as f:
-                    json.dump(baseline, f, indent=1, sort_keys=True)
-            except Exception as e:
-                log(f"baseline cache write failed: {e}")
-    if baseline is None and result is not None and result.get("platform") == "cpu":
-        baseline = result  # measured on CPU: the floor is itself
+
+    need_cpu = baseline is None or result is None
+    if need_cpu and _remaining(deadline) > 60:
+        try:
+            cpu_res = _run_child({"JAX_PLATFORMS": "cpu"},
+                                 max(_remaining(deadline), 60.0))
+        except Exception as e:
+            log(f"cpu measurement failed: {type(e).__name__}: {e}")
+            cpu_res = None
+        if cpu_res is not None and cpu_res.get("rates"):
+            if baseline is None and not cpu_res.get("errors"):
+                baseline = cpu_res
+                try:
+                    with open(BASELINE_FILE, "w") as f:
+                        json.dump(cpu_res, f, indent=1, sort_keys=True)
+                except Exception as e:
+                    log(f"baseline cache write failed: {e}")
+            if result is None:
+                result = cpu_res
+                baseline = baseline or cpu_res
 
     out = {
         "metric": "tpch_sf%g_q1_q6_q3_lineitem_rows_per_sec_geomean" % sf,
@@ -255,6 +275,8 @@ def main():
         out["rates"] = {k: round(v, 1) for k, v in result["rates"].items()}
         if result.get("errors"):
             out["partial"] = sorted(result["errors"])
+        # ratios over the intersection only — a partial run never
+        # compares mismatched geomeans
         common = sorted(set(result["rates"]) & set((baseline or {}).get("rates", {})))
         if common:
             ratio = _geomean([result["rates"][q] for q in common]) / _geomean(

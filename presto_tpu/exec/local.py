@@ -140,14 +140,21 @@ class QueryStats:
 # (parallel/dist.py) and multi-host (parallel/multihost.py) runners.
 MAX_AGG_GROUPS = 1 << 26
 
+# Capacity beyond which aggregation stops doubling in place and
+# switches to host-RAM partitioned (spill) execution instead —
+# the MemoryRevokingScheduler threshold analog.
+SPILL_GROUP_THRESHOLD = 1 << 22
+
 
 class GroupCapacityExceeded(Exception):
     """An aggregation saw more groups than its static capacity; the
     runner retries the query with a doubled max_groups (the analog of
-    MultiChannelGroupByHash.java:138 tryRehash)."""
+    MultiChannelGroupByHash.java:138 tryRehash), or switches to the
+    partitioned spill path past SPILL_GROUP_THRESHOLD."""
 
-    def __init__(self, needed: int):
+    def __init__(self, needed: int, node=None):
         self.needed = needed
+        self.node = node
 
 
 def _split_pruned(constraints, stats) -> bool:
@@ -169,6 +176,18 @@ def _split_pruned(constraints, stats) -> bool:
     return False
 
 
+def _probe_with_retry(probe_fn, build, page):
+    """One expanding probe with the pow2 capacity retry shared by the
+    in-HBM and spilled join paths (yielding LookupJoinPageBuilder
+    analog). probe_fn(build, page, out_capacity) -> (page, total, ...)."""
+    cap = max(int(page.capacity), 1024)
+    res = probe_fn(build, page, cap)
+    total = int(np.asarray(res[1]))
+    if total > cap:
+        res = probe_fn(build, page, 1 << (total - 1).bit_length())
+    return res
+
+
 def _is_streaming_join(node: JoinNode) -> bool:
     """True when the probe is row-aligned (jittable in a chain):
     semi/anti (presence tests) or unique-key builds. FULL joins always
@@ -186,19 +205,23 @@ class LocalRunner:
     """
 
     def __init__(self, catalog: Catalog, jit: bool = True, split_capacity: Optional[int] = None,
-                 memory_pool=None):
+                 memory_pool=None, spill_partitions: int = 8):
         self.catalog = catalog
         self.jit = jit
         self.split_capacity = split_capacity
         self.stats: Optional[QueryStats] = None
         # HBM accounting (memory/MemoryPool.java analog); None = untracked
         self.memory_pool = memory_pool
+        # host-RAM spill fan-out when state exceeds the pool/threshold
+        self.spill_partitions = spill_partitions
         self._mem = None
         self._chain_cache: Dict[PlanNode, Callable] = {}
         self._fold_cache: Dict[PlanNode, Callable] = {}
         self._agg_overrides: Dict[PlanNode, int] = {}
         self._partial_nodes: Dict[PlanNode, AggregationNode] = {}
         self._builds: Dict[JoinNode, JoinBuild] = {}
+        # joins demoted out of fused chains because their build spilled
+        self._force_expanding: set = set()
 
     # ------------------------------------------------------------------
     def run(self, plan: PlanNode) -> MaterializedResult:
@@ -228,12 +251,16 @@ class LocalRunner:
                 self._mem.release_all()
                 self._mem = None
 
-    def _account(self, what: str, page) -> None:
+    def _account(self, what: str, page, node=None) -> None:
         """Charge a materialized device intermediate against the pool
-        (operator-level LocalMemoryContext.setBytes analog)."""
+        (operator-level LocalMemoryContext.setBytes analog). ``node``
+        tags the reservation so spill fallbacks can attribute failures
+        to their own plan node."""
         if self._mem is not None:
             from presto_tpu.memory import page_bytes
 
+            if node is not None:
+                what = f"{what}@{id(node)}"
             self._mem.reserve(what, page_bytes(page))
 
     def explain(self, plan: PlanNode) -> str:
@@ -366,26 +393,43 @@ class LocalRunner:
             yield from self._groupid_pages(node)
             return
 
-        if isinstance(node, JoinNode) and not _is_streaming_join(node):
+        if isinstance(node, JoinNode) and not self._streaming(node):
             yield from self._expanding_join_pages(node)
             return
 
         # streaming chain rooted at a scan or breaker
         yield from self._chain_pages(node)
 
+    def _streaming(self, node: JoinNode) -> bool:
+        return _is_streaming_join(node) and node not in self._force_expanding
+
     # ------------------------------------------------------------------
     # streaming-chain compilation
     # ------------------------------------------------------------------
     def _chain_pages(self, node: PlanNode) -> Iterator[Page]:
+        from presto_tpu.memory import ExceededMemoryLimitError
+
         leaf = self._chain_leaf(node)
         joins: List[JoinNode] = []
         stage = self._build_stage(node, joins)
+        try:
+            consts = {f"build_{i}": self._materialize_build(j) for i, j in enumerate(joins)}
+        except ExceededMemoryLimitError as e:
+            victim = next((j for j in joins if f"join_build@{id(j)}#" in e.tag), None)
+            if victim is None:
+                raise
+            # demote the oversized build's join out of the fused chain;
+            # it re-plans through the partitioned (spilled) join path
+            self._force_expanding.add(victim)
+            self._chain_cache.clear()
+            self._fold_cache.clear()
+            yield from self._pages_impl(node)
+            return
         if node in self._chain_cache:
             fn = self._chain_cache[node]
         else:
             fn = jax.jit(stage) if self.jit else stage
             self._chain_cache[node] = fn
-        consts = {f"build_{i}": self._materialize_build(j) for i, j in enumerate(joins)}
         for page in self._source_pages(leaf):
             yield fn(page, consts)
 
@@ -394,7 +438,7 @@ class LocalRunner:
             return self._chain_leaf(node.source)
         if isinstance(node, AggregationNode) and node.step == "partial":
             return self._chain_leaf(node.source)
-        if isinstance(node, JoinNode) and _is_streaming_join(node):
+        if isinstance(node, JoinNode) and self._streaming(node):
             return self._chain_leaf(node.left)  # probe side streams
         if isinstance(node, CrossSingleNode):
             return self._chain_leaf(node.left)
@@ -427,7 +471,7 @@ class LocalRunner:
 
             return agg_stage
 
-        if isinstance(node, JoinNode) and _is_streaming_join(node):
+        if isinstance(node, JoinNode) and self._streaming(node):
             inner = self._build_stage(node.left, joins)
             key = f"build_{len(joins)}"
             joins.append(node)
@@ -496,15 +540,27 @@ class LocalRunner:
                     fn = jax.jit(make_build) if self.jit else make_build
                     self._fold_cache[node] = fn
                 build = fn(pages)
-                self._account("join_build", build.page)
+                self._account("join_build", build.page, node)
                 self._builds[node] = build
         return self._builds[node]
 
     # ------------------------------------------------------------------
     def _expanding_join_pages(self, node: JoinNode) -> Iterator[Page]:
         """Many-to-many probe with capacity retry (the analog of the
-        reference's yielding LookupJoinPageBuilder)."""
-        build = self._materialize_build(node)
+        reference's yielding LookupJoinPageBuilder). A build side that
+        exceeds the pool falls back to host-RAM partitioned join."""
+        from presto_tpu.memory import ExceededMemoryLimitError
+
+        if node in self._force_expanding:
+            yield from self._partitioned_join_pages(node)
+            return
+        try:
+            build = self._materialize_build(node)
+        except ExceededMemoryLimitError as e:
+            if f"join_build@{id(node)}#" not in e.tag:
+                raise
+            yield from self._partitioned_join_pages(node)
+            return
         kd = node.key_domains
         left_keys = list(node.left_keys)
         build_output = list(range(len(node.right.channels)))
@@ -525,12 +581,8 @@ class LocalRunner:
 
         matched_acc = None
         for p in self._pages(node.left):
-            cap = max(int(p.capacity), 1024)
-            res = fn(build, p, out_capacity=cap)
-            t = int(np.asarray(res[1]))
-            if t > cap:
-                cap2 = 1 << (t - 1).bit_length()
-                res = fn(build, p, out_capacity=cap2)
+            res = _probe_with_retry(
+                lambda b, pg, cap: fn(b, pg, out_capacity=cap), build, p)
             yield res[0]
             if is_full:
                 matched_acc = res[2] if matched_acc is None else matched_acc | res[2]
@@ -579,6 +631,79 @@ class LocalRunner:
         for p in self._pages(node.source):
             for fn in fns:
                 yield fn(p)
+
+    # ------------------------------------------------------------------
+    def _partitioned_join_pages(self, node: JoinNode) -> Iterator[Page]:
+        """Spilled hash join: both sides hash-partition by join key into
+        host-RAM buckets, then each partition joins independently on
+        device — build state is bounded by the largest partition
+        (reference: spilled lookup joins,
+        operator/SpilledLookupSourceHandle.java +
+        GenericPartitioningSpiller)."""
+        from presto_tpu.exec.spill import HostPage, make_bucket_fn, partition_to_host
+        from presto_tpu.ops.join import outer_build_tail
+
+        K = self.spill_partitions
+        kd = node.key_domains
+        left_keys = list(node.left_keys)
+        right_keys = list(node.right_keys)
+        build_output = list(range(len(node.right.channels)))
+        is_full = node.kind == "full"
+        kind = "left" if is_full else node.kind
+        right_types = node.right.output_types
+
+        bfn_r = make_bucket_fn(right_keys, kd, K, jit=self.jit)
+        bfn_l = make_bucket_fn(left_keys, kd, K, jit=self.jit)
+
+        bbuckets: List[List[HostPage]] = [[] for _ in range(K)]
+        for p in self._pages(node.right):
+            for k, hp in enumerate(partition_to_host(p, bfn_r(p), K)):
+                if hp is not None:
+                    bbuckets[k].append(hp)
+        pbuckets: List[List[HostPage]] = [[] for _ in range(K)]
+        for p in self._pages(node.left):
+            for k, hp in enumerate(partition_to_host(p, bfn_l(p), K)):
+                if hp is not None:
+                    pbuckets[k].append(hp)
+
+        probe_spec = [(c.type, c.dictionary) for c in node.left.channels]
+        for k in range(K):
+            if not pbuckets[k] and not (is_full and bbuckets[k]):
+                continue
+            if bbuckets[k]:
+                bpage = concat_pages_device([hp.rehydrate() for hp in bbuckets[k]])
+            else:
+                bpage = Page.empty(right_types, 1)
+            build = build_join(bpage, right_keys, key_domains=kd)
+            tag = None
+            if self._mem is not None:
+                from presto_tpu.memory import page_bytes
+
+                tag = self._mem.reserve(f"join_build_partition@{id(node)}",
+                                        page_bytes(build.page))
+            def probe_fn(b, p, out_capacity):
+                return probe_expand(
+                    b, p, left_keys, out_capacity, key_domains=kd,
+                    kind=kind, build_output=build_output, return_matched=is_full,
+                )
+
+            matched_acc = None
+            for hp in pbuckets[k]:
+                p = hp.rehydrate()
+                if kind in ("semi", "anti"):
+                    yield probe_join(build, p, left_keys, key_domains=kd,
+                                     kind=kind, build_output=build_output)
+                    continue
+                res = _probe_with_retry(probe_fn, build, p)
+                yield res[0]
+                if is_full:
+                    matched_acc = res[2] if matched_acc is None else matched_acc | res[2]
+            if is_full:
+                if matched_acc is None:
+                    matched_acc = jnp.zeros((build.page.capacity,), dtype=jnp.bool_)
+                yield outer_build_tail(build, matched_acc, probe_spec, build_output)
+            if tag is not None:
+                self._mem.free(tag)  # partition done; its build leaves HBM
 
     # ------------------------------------------------------------------
     def _run_topn(self, node: TopNNode) -> Page:
@@ -631,6 +756,97 @@ class LocalRunner:
         return False
 
     def _run_aggregation(self, node: AggregationNode) -> Page:
+        """Breaker with spill fallback: the in-place path folds partial
+        pages on device; past the pool limit or the capacity threshold
+        it re-executes partitioned through host RAM (spiller analog)."""
+        from presto_tpu.memory import ExceededMemoryLimitError
+
+        try:
+            return self._run_aggregation_impl(node)
+        except ExceededMemoryLimitError as e:
+            if f"agg_accumulator@{id(node)}#" not in e.tag:
+                raise
+        except GroupCapacityExceeded as e:
+            if e.node is not node or e.needed <= SPILL_GROUP_THRESHOLD:
+                raise
+        return self._run_aggregation_spilled(node)
+
+    def _run_aggregation_spilled(self, node: AggregationNode) -> Page:
+        """Lifespan-style partitioned aggregation: hash-partition the
+        pre-aggregation rows into host-RAM buckets, then aggregate each
+        bucket to completion on device (grouped execution + partitioning
+        spiller, execution/Lifespan.java:26 +
+        spiller/GenericPartitioningSpiller.java)."""
+        from presto_tpu.exec.spill import HostPage, make_bucket_fn, partition_to_host
+        from presto_tpu.ops.aggregate import grouped_aggregate
+
+        K = self.spill_partitions
+        group_exprs = list(node.group_exprs)
+        aggs = list(node.aggs)
+        kd = node.key_domains
+        num_keys = len(group_exprs)
+        partial_input = node.step == "final"
+        if partial_input:
+            # source emits partial-state pages: keys are the first
+            # num_keys channels
+            from presto_tpu.expr.ir import ColumnRef
+
+            src_ch = node.source.channels
+            bucket_exprs = [ColumnRef(type=src_ch[i].type, index=i)
+                            for i in range(num_keys)]
+        else:
+            bucket_exprs = group_exprs
+        bucket_fn = make_bucket_fn(bucket_exprs, kd, K, jit=self.jit)
+
+        buckets: List[List[HostPage]] = [[] for _ in range(K)]
+        for p in self._pages(node.source):
+            for k, hp in enumerate(partition_to_host(p, bucket_fn(p), K)):
+                if hp is not None:
+                    buckets[k].append(hp)
+
+        # per-bucket capacity ~ total/K (keys hash-spread); per-bucket
+        # doubling below recovers skewed buckets
+        cap0 = max(1 << 10, min(self._max_groups(node), SPILL_GROUP_THRESHOLD) // K)
+
+        def fold_bucket(pages: List[HostPage], cap: int):
+            acc: Optional[Page] = None
+            for hp in pages:
+                p = hp.rehydrate()
+                if partial_input:
+                    pp = p
+                else:
+                    pp = grouped_aggregate(p, group_exprs, aggs, cap,
+                                           key_domains=kd, mode="partial")
+                cand = pp if acc is None else concat_pages_device([acc, pp])
+                acc = merge_aggregate(cand, num_keys, aggs, cap,
+                                      key_domains=kd, mode="partial")
+            return acc
+
+        outs: List[Page] = []
+        for k in range(K):
+            if not buckets[k]:
+                continue
+            cap = cap0
+            while True:
+                acc = fold_bucket(buckets[k], cap)
+                out = merge_aggregate(acc, num_keys, aggs, cap,
+                                      key_domains=kd, mode="single")
+                live = int(np.asarray(jnp.sum(out.row_mask.astype(jnp.int32))))
+                if live < cap or cap >= MAX_AGG_GROUPS:
+                    break
+                cap *= 2
+            # bucket outputs are result stream, not operator state — not
+            # charged against the pool (the whole point of the spill)
+            outs.append(out)
+        if not outs:
+            out = Page.empty(node.output_types, max(cap0, 1))
+            return self._groupid_empty_fixup(node, out)
+        if not node.group_exprs:
+            # global agg never spills (one group); defensive
+            return outs[0]
+        return concat_pages_device(outs)
+
+    def _run_aggregation_impl(self, node: AggregationNode) -> Page:
         """Breaker: stream partial pages and fold-merge with a bounded
         accumulator (2*max_groups concat each step, static shapes)."""
         mg = self._max_groups(node)
@@ -674,7 +890,7 @@ class LocalRunner:
         for p in self._pages(source):
             if acc is None:
                 acc = fold_fn(acc, p)
-                self._account("agg_accumulator", acc)
+                self._account("agg_accumulator", acc, node)
             else:
                 acc = fold_fn(acc, p)
         if acc is None:
@@ -724,4 +940,4 @@ class LocalRunner:
             self._agg_overrides[node] = mg * 2
             self._chain_cache.clear()
             self._fold_cache.clear()
-            raise GroupCapacityExceeded(mg * 2)
+            raise GroupCapacityExceeded(mg * 2, node)

@@ -1,0 +1,96 @@
+"""Host-RAM spill: lifespan-style partitioned fallback for oversized
+aggregation/join state.
+
+Reference analog: the revocable-memory + spill tier —
+``execution/MemoryRevokingScheduler.java:46`` triggers revocation,
+``spiller/FileSingleStreamSpiller.java`` / ``GenericPartitioningSpiller``
+write pages to local disk, and grouped execution
+(``execution/Lifespan.java:26``) bounds hash state by processing
+bucketed keyspaces one at a time.
+
+A TPU chip has no local disk; the offload target is host RAM (pages
+leave HBM as numpy arrays). The mechanism is the partitioning spiller's:
+rows hash-partition by key into K buckets held host-side, then each
+bucket is processed to completion on device with per-bucket capacity —
+state never exceeds pool_limit/K-ish instead of the whole keyspace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.expr.compile import ExprCompiler
+from presto_tpu.expr.ir import Expr
+from presto_tpu.ops.aggregate import pack_or_hash_keys
+from presto_tpu.page import Block, Page
+
+
+@dataclasses.dataclass
+class HostPage:
+    """A Page offloaded to host RAM (numpy-backed; the spill file
+    analog — nothing device-resident)."""
+
+    columns: List[Tuple[np.ndarray, np.ndarray, object, object]]  # data, valid, type, dict
+    mask: np.ndarray
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.mask.sum())
+
+    def rehydrate(self, capacity: Optional[int] = None) -> Page:
+        n = len(self.mask)
+        cap = capacity if capacity is not None else max(n, 1)
+        blocks = []
+        for data, valid, t, d in self.columns:
+            dd = np.zeros(cap, dtype=data.dtype)
+            dd[:n] = data
+            vv = np.zeros(cap, dtype=np.bool_)
+            vv[:n] = valid
+            blocks.append(Block(jnp.asarray(dd), jnp.asarray(vv), t, d))
+        mask = np.zeros(cap, dtype=np.bool_)
+        mask[:n] = self.mask
+        return Page(tuple(blocks), jnp.asarray(mask))
+
+
+def make_bucket_fn(key_exprs: Sequence[Expr], key_domains, num_buckets: int,
+                   jit: bool = True):
+    """Compile page -> int32 bucket-id-per-row (hash of the group/join
+    key, the GenericPartitioningSpiller partition function)."""
+
+    def bucket_ids(page: Page) -> jax.Array:
+        c = ExprCompiler.for_page(page)
+        kd = [c.compile(e)(page) for e in key_exprs]
+        key, _ = pack_or_hash_keys([d for d, _ in kd], [v for _, v in kd], key_domains)
+        if key is None:
+            return jnp.zeros(page.capacity, dtype=jnp.int32)
+        # re-mix so packed (non-hashed) keys spread across buckets
+        h = key.astype(jnp.uint64)
+        h = (h ^ (h >> jnp.uint64(33))) * jnp.uint64(0xFF51AFD7ED558CCD)
+        h = h ^ (h >> jnp.uint64(33))
+        return (h % jnp.uint64(num_buckets)).astype(jnp.int32)
+
+    return jax.jit(bucket_ids) if jit else bucket_ids
+
+
+def partition_to_host(page: Page, bids: jax.Array, num_buckets: int) -> List[Optional[HostPage]]:
+    """Split one device page into per-bucket host pages (the spill
+    write). Returns None for empty buckets."""
+    bids_np = np.asarray(bids)
+    mask_np = np.asarray(page.row_mask)
+    out: List[Optional[HostPage]] = []
+    datas = [np.asarray(b.data) for b in page.blocks]
+    valids = [np.asarray(b.valid) for b in page.blocks]
+    for k in range(num_buckets):
+        idx = np.nonzero(mask_np & (bids_np == k))[0]
+        if len(idx) == 0:
+            out.append(None)
+            continue
+        cols = [(d[idx], v[idx], b.type, b.dictionary)
+                for d, v, b in zip(datas, valids, page.blocks)]
+        out.append(HostPage(cols, np.ones(len(idx), dtype=np.bool_)))
+    return out

@@ -110,7 +110,8 @@ def _string_transform(e: "Call"):
     if fn == "regexp_replace":
         rx = re.compile(e.args[1].value)
         repl = e.args[2].value if len(e.args) > 2 else ""
-        py_repl = re.sub(r"\$(\d+)", r"\\\1", repl)  # $1 -> \1
+        # $N -> \g<N> (plain \N would make $0 a NUL octal escape)
+        py_repl = re.sub(r"\$(\d+)", r"\\g<\1>", repl)
         return lambda v: rx.sub(py_repl, v), key
     if fn == "replace":
         frm = e.args[1].value
@@ -415,10 +416,13 @@ class ExprCompiler:
             # Transforms that can yield NULL fold a per-code LUT into
             # validity.
             col = _transform_column(expr)
-            if col is None:
-                raise KeyError(f"cannot compile {expr}")
+            if col is None or _string_transform(expr) is None:
+                # never silently pass raw codes through an underivable
+                # transform — that would surface codes as values
+                raise KeyError(f"cannot compile string transform {expr}")
             # force derived-dict materialization so the null LUT exists
-            expr_dictionary(expr, self.dictionaries)
+            if expr_dictionary(expr, self.dictionaries) is None:
+                raise ValueError(f"no dictionary for string transform {expr}")
             null_lut = _transform_null_lut(expr, self.dictionaries)
             inner_f = self.compile(col)
             if null_lut is None:
@@ -683,7 +687,7 @@ class ExprCompiler:
     # ------------------------------------------------------------------
     def _compile_literal(self, expr: Literal) -> CompiledExpr:
         t = expr.type
-        if t.is_string:
+        if t.is_string and expr.value is not None:
             raise ValueError(
                 "string literal must be resolved against a dictionary via eq/in/like"
             )

@@ -299,11 +299,21 @@ def _agg_dict(agg: AggCall, dictionaries) -> Optional[object]:
     return expr_dictionary(agg.arg, dictionaries)
 
 
+# (id(dict)) -> (dict ref, rank list, inv list); host lists so nothing
+# device-resident leaks across traces (cached: the sort is O(n log n)
+# per dictionary and the eager spill path calls kernels per page)
+_COLLATION_CACHE: dict = {}
+
+
 def _collation_luts(d) -> Tuple[jax.Array, jax.Array]:
     """(code -> collation rank, rank -> representative code) LUTs.
     Dictionary codes are assignment-ordered, not collation-ordered, so
     string min/max must reduce over ranks (duplicate values share a
     rank; the inverse picks a representative code)."""
+    cached = _COLLATION_CACHE.get(id(d))
+    if cached is not None:
+        _, rank, inv = cached
+        return (jnp.asarray(rank, dtype=jnp.int32), jnp.asarray(inv, dtype=jnp.int32))
     values = d.values
     order = sorted(range(len(values)), key=lambda i: values[i])
     rank = [0] * len(values)
@@ -316,6 +326,7 @@ def _collation_luts(d) -> Tuple[jax.Array, jax.Array]:
             prev = values[i]
             inv[r] = i
         rank[i] = r
+    _COLLATION_CACHE[id(d)] = (d, rank, inv)
     return (jnp.asarray(rank, dtype=jnp.int32), jnp.asarray(inv, dtype=jnp.int32))
 
 

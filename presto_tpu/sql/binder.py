@@ -1437,6 +1437,8 @@ class Binder:
             if e.name in SCALAR_FUNCTIONS:
                 args = [self._bind_impl(a, scope, agg) for a in e.args]
                 if e.name == "concat":
+                    if any(isinstance(a, Literal) and a.value is None for a in args):
+                        return Literal(type=VARCHAR, value=None)  # NULL-propagating
                     non_lit = [a for a in args if not isinstance(a, Literal)]
                     if not non_lit:
                         return Literal(type=VARCHAR,

@@ -1,0 +1,135 @@
+"""Host-RAM spill tier: aggregation and join state exceeding the memory
+pool completes with correct results via partitioned (lifespan-style)
+execution.
+
+Reference analog: TestDistributedSpilledQueries /
+TestHashJoinOperator.testInnerJoinWithSpill — queries run under a
+constrained pool and must produce identical results to the unconstrained
+run."""
+
+import numpy as np
+import pytest
+
+import presto_tpu.exec.local as local_mod
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.exec.local import LocalRunner
+from presto_tpu.memory import ExceededMemoryLimitError, MemoryPool
+from presto_tpu.sql.binder import Binder
+
+from tests.oracle import assert_rows_match
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.01, split_rows=1 << 13))
+    return catalog
+
+
+AGG_SQL = ("select l_orderkey, count(*), sum(l_quantity), max(l_extendedprice)"
+           " from lineitem group by l_orderkey")
+JOIN_SQL = ("select o_orderkey, o_totalprice, l_quantity from orders, lineitem"
+            " where o_orderkey = l_orderkey and l_linenumber = 1")
+FULL_SQL = ("select o1.k, o2.k from"
+            " (select o_orderkey as k from orders where o_orderkey < 40000) o1"
+            " full outer join"
+            " (select l_orderkey + 1 as k from lineitem where l_linenumber = 1) o2"
+            " on o1.k = o2.k")
+
+
+def run(catalog, sql, pool=None, **kw):
+    runner = LocalRunner(catalog, memory_pool=pool, **kw)
+    return runner.run(Binder(catalog).plan(sql))
+
+
+def _agg_acc_bytes(catalog):
+    """Measure the unconstrained aggregation accumulator footprint."""
+    pool = MemoryPool(1 << 40)
+    run(catalog, AGG_SQL, pool=pool)
+    return max(n for t, n in pool_peek(pool).items() if "agg_accumulator" in t)
+
+
+def pool_peek(pool):
+    return getattr(pool, "_peek_tags", {})
+
+
+class PeekPool(MemoryPool):
+    """Pool that remembers every reservation size (test instrumentation)."""
+
+    def __init__(self, limit):
+        super().__init__(limit)
+        self._peek_tags = {}
+
+    def reserve(self, tag, nbytes):
+        self._peek_tags[tag] = nbytes
+        super().reserve(tag, nbytes)
+
+
+def test_agg_spill_memory_trigger(catalog):
+    expected = run(catalog, AGG_SQL).rows
+
+    probe = PeekPool(1 << 40)
+    run(catalog, AGG_SQL, pool=probe)
+    acc_bytes = max(n for t, n in probe._peek_tags.items() if "agg_accumulator" in t)
+
+    # pool too small for the in-place accumulator but fine for 1/8 buckets
+    pool = MemoryPool(int(acc_bytes * 0.6))
+    actual = run(catalog, AGG_SQL, pool=pool).rows
+    assert_rows_match(actual, expected, ordered=False)
+
+
+def test_agg_spill_capacity_trigger(catalog, monkeypatch):
+    """Overflow beyond SPILL_GROUP_THRESHOLD switches to partitioned
+    execution instead of doubling forever."""
+    expected = run(catalog, AGG_SQL).rows
+
+    monkeypatch.setattr(local_mod, "SPILL_GROUP_THRESHOLD", 1 << 12)
+    binder = Binder(catalog)
+    plan = binder.plan(AGG_SQL)
+    # force a tiny initial capacity so the doubling path overflows
+    from presto_tpu.planner.plan import AggregationNode
+
+    node = plan
+    while not isinstance(node, AggregationNode):
+        node = node.source
+    node.max_groups = 1 << 10
+    runner = LocalRunner(catalog)
+    actual = runner.run(plan).rows
+    assert_rows_match(actual, expected, ordered=False)
+
+
+def test_join_spill(catalog):
+    expected = run(catalog, JOIN_SQL).rows
+
+    probe = PeekPool(1 << 40)
+    run(catalog, JOIN_SQL, pool=probe)
+    build_bytes = max(n for t, n in probe._peek_tags.items() if "join_build@" in t)
+
+    pool = MemoryPool(int(build_bytes * 0.6))
+    actual = run(catalog, JOIN_SQL, pool=pool).rows
+    assert_rows_match(actual, expected, ordered=False)
+    # the partitioned path really ran (per-partition builds were tagged)
+    peek = PeekPool(int(build_bytes * 0.6))
+    run(catalog, JOIN_SQL, pool=peek)
+    assert any("join_build_partition" in t for t in peek._peek_tags)
+
+
+def test_full_outer_join_spill(catalog):
+    expected = run(catalog, FULL_SQL).rows
+
+    probe = PeekPool(1 << 40)
+    run(catalog, FULL_SQL, pool=probe)
+    build_bytes = max(n for t, n in probe._peek_tags.items() if "join_build@" in t)
+
+    pool = MemoryPool(int(build_bytes * 0.6))
+    actual = run(catalog, FULL_SQL, pool=pool).rows
+    assert_rows_match(actual, expected, ordered=False)
+
+
+def test_pool_still_enforced_for_oversized_results(catalog):
+    """A query whose sort input genuinely exceeds the pool still fails
+    cleanly (spill covers agg/join state, not arbitrary materialization)."""
+    pool = MemoryPool(1 << 10)
+    with pytest.raises(ExceededMemoryLimitError):
+        run(catalog, "select * from lineitem order by l_extendedprice", pool=pool)
