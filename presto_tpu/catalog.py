@@ -44,6 +44,7 @@ class ColumnHandle:
     index: int
     domain: Optional[Tuple[int, int]] = None  # known (lo, hi) in device repr
     dictionary: Optional[Dictionary] = None
+    ndv: Optional[int] = None  # distinct values when domain width overstates
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +54,7 @@ class TableHandle:
     columns: Tuple[ColumnHandle, ...]
     row_count: int
     num_splits: int
+    primary_key: Optional[Tuple[str, ...]] = None
 
     def column(self, name: str) -> Optional[ColumnHandle]:
         for c in self.columns:
@@ -90,16 +92,24 @@ class Catalog:
                 for i, (col, t) in enumerate(schema):
                     dom = None
                     dic = None
+                    ndv = None
                     if hasattr(conn, "column_domain"):
                         dom = conn.column_domain(table, col)
                     if hasattr(conn, "dictionary_for"):
                         dic = conn.dictionary_for(table, col)
-                    cols.append(ColumnHandle(col, t, i, dom, dic))
+                    if hasattr(conn, "column_ndv"):
+                        ndv = conn.column_ndv(table, col)
+                    cols.append(ColumnHandle(col, t, i, dom, dic, ndv))
+                pk = None
+                if hasattr(conn, "primary_key"):
+                    got = conn.primary_key(table)
+                    pk = tuple(got) if got else None
                 return TableHandle(
                     connector_name=cname,
                     table=table,
                     columns=tuple(cols),
                     row_count=conn.row_count(table),
                     num_splits=conn.num_splits(table),
+                    primary_key=pk,
                 )
         raise KeyError(f"table not found in any catalog: {table}")

@@ -644,6 +644,21 @@ class Tpch:
             "lineitem": ["l_orderkey", "l_linenumber"],
         }.get(table)
 
+    def column_ndv(self, table: str, column: str) -> Optional[int]:
+        """Distinct-value counts where the domain width overstates them
+        (sparse keys: orderkeys skip 8-of-32 slots). Reference analog:
+        presto-tpch/.../statistics/ ColumnStatisticsData distinctValues."""
+        ndvs: Dict[str, int] = {
+            "o_orderkey": self.n_orders,
+            "l_orderkey": self.n_orders,
+            "o_custkey": int(self.n_customers * 2 / 3),  # spec: 1/3 hold no orders
+            "l_partkey": self.n_parts,
+            "l_suppkey": self.n_suppliers,
+            "ps_partkey": self.n_parts,
+            "ps_suppkey": self.n_suppliers,
+        }
+        return ndvs.get(column)
+
     def column_domain(self, table: str, column: str) -> Optional[Tuple[int, int]]:
         """Known (lo, hi) of a column in its device representation —
         the stats feed for exact key packing (planner/exact joins).

@@ -85,65 +85,26 @@ class Fragment:
         return "\n".join(lines)
 
 
-def estimate_rows(node: PlanNode) -> Optional[int]:
-    """Row-count estimate from table metadata (the seed of
-    cost/StatsCalculator.java — filters use the reference's coefficient
-    heuristics, FilterStatsCalculator's UNKNOWN_FILTER_COEFFICIENT)."""
-    if isinstance(node, TableScanNode):
-        base = node.handle.row_count
-        # pushed-down conjuncts: 0.9 each (UNKNOWN_FILTER_COEFFICIENT)
-        for _ in node.constraints or ():
-            base = int(base * 0.9)
-        return base
-    if isinstance(node, FilterNode):
-        e = estimate_rows(node.source)
-        return None if e is None else max(int(e * 0.5), 1)
-    if isinstance(node, (ProjectNode, OutputNode)):
-        return estimate_rows(node.source)
-    if isinstance(node, (LimitNode, TopNNode)):
-        e = estimate_rows(node.source)
-        return node.count if e is None else min(node.count, e)
-    if isinstance(node, SortNode):
-        return estimate_rows(node.source)
-    if isinstance(node, WindowNode):
-        return estimate_rows(node.source)
-    if isinstance(node, AggregationNode):
-        if not node.group_exprs:
-            return 1
-        e = estimate_rows(node.source)
-        kd = node.key_domains
-        if kd and all(d is not None for d in kd):
-            prod = 1
-            for lo, hi in kd:
-                prod *= hi - lo + 2
-            return prod if e is None else min(e, prod)
-        return e
-    if isinstance(node, JoinNode):
-        le = estimate_rows(node.left)
-        if node.kind in ("semi", "anti"):
-            return le
-        # FK->PK joins keep probe cardinality; general joins unknown
-        if node.unique_build:
-            return le
-        re_ = estimate_rows(node.right)
-        if le is None or re_ is None:
-            return None
-        return max(le, re_)
-    if isinstance(node, CrossSingleNode):
-        return estimate_rows(node.left)
-    if isinstance(node, ValuesNode):
-        return len(node.rows)
-    if isinstance(node, UnionNode):
-        total = 0
-        for s in node.inputs:
-            e = estimate_rows(s)
-            if e is None:
-                return None
-            total += e
-        return total
+_SHARED_CALC = None
+
+
+def estimate_rows(node: PlanNode, calc=None) -> Optional[int]:
+    """Row-count estimate via the shared stats calculator
+    (planner/stats.py — cost/StatsCalculator.java analog), so the
+    broadcast-vs-partitioned distribution decision and the binder's join
+    ordering act on the same numbers. A process-wide calculator memoizes
+    across the repeated per-join calls of a fragmentation pass (safe:
+    the memo holds node references, so recycled ids can't alias)."""
     if isinstance(node, PrecomputedNode):
         return None
-    return None
+    global _SHARED_CALC
+    if calc is None:
+        if _SHARED_CALC is None:
+            from presto_tpu.planner.stats import StatsCalculator
+
+            _SHARED_CALC = StatsCalculator()
+        calc = _SHARED_CALC
+    return int(calc.rows(node))
 
 
 def build_side_chainable(node: PlanNode) -> bool:

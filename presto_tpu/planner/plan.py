@@ -464,9 +464,15 @@ class OutputNode(PlanNode):
         return [Channel(n, c.type, c.dictionary, c.domain) for n, c in zip(self.names, src)]
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0, stats=None) -> str:
+def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None) -> str:
     """EXPLAIN-style rendering (planPrinter/PlanPrinter.java analog);
-    pass the executor's QueryStats for EXPLAIN ANALYZE annotations."""
+    pass the executor's QueryStats for EXPLAIN ANALYZE annotations and a
+    planner StatsCalculator for cost estimates ({rows: N} like the
+    reference's estimate lines)."""
+    if estimator is None and stats is None and indent == 0:
+        from presto_tpu.planner.stats import StatsCalculator
+
+        estimator = StatsCalculator()
     pad = "  " * indent
     name = type(node).__name__.replace("Node", "")
     detail = ""
@@ -485,7 +491,12 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None) -> str:
     elif isinstance(node, (LimitNode, TopNNode)):
         detail = f" {node.count}"
     ann = stats.annotation(node) if stats is not None else ""
+    if estimator is not None:
+        try:
+            ann += "  {rows: %d}" % int(estimator.rows(node))
+        except Exception:
+            pass
     out = f"{pad}- {name}{detail}{ann}\n"
     for s in node.sources:
-        out += plan_tree_str(s, indent + 1, stats)
+        out += plan_tree_str(s, indent + 1, stats, estimator)
     return out

@@ -1,0 +1,336 @@
+"""Plan statistics calculator (CBO v1).
+
+Reference analog: ``presto-main/.../cost/`` — ``StatsCalculator`` rule
+set (``FilterStatsCalculator``, ``JoinStatsRule``,
+``AggregationStatsRule``) producing ``PlanNodeStatsEstimate`` /
+``SymbolStatsEstimate``.  Collapsed to the two quantities this planner
+acts on: output row count and per-channel (domain, NDV) ranges derived
+from connector metadata, propagated bottom-up with the textbook
+selectivity rules:
+
+  eq literal        1 / ndv, domain pins to the value
+  range literal     overlap fraction of the domain
+  IN (k literals)   k / ndv
+  join (inner)      |L| * |R| / max(ndv_L, ndv_R) per key
+  group by          min(prod key ndvs, rows)
+
+Used by the binder for join ordering / build-side choice / aggregation
+capacity sizing, and by the fragmenter for broadcast-vs-partitioned
+distribution (DetermineJoinDistributionType.java:33 AUTOMATIC mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.expr.ir import Call, ColumnRef, Expr, Literal
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    CrossSingleNode,
+    FilterNode,
+    GroupIdNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    ValuesNode,
+    WindowNode,
+)
+
+UNKNOWN_FILTER_SELECTIVITY = 0.25  # FilterStatsCalculator's default-ish
+
+
+@dataclasses.dataclass
+class ColumnEstimate:
+    """SymbolStatsEstimate analog: value range + distinct count."""
+
+    domain: Optional[Tuple[float, float]] = None
+    ndv: Optional[float] = None
+
+
+@dataclasses.dataclass
+class PlanEstimate:
+    """PlanNodeStatsEstimate analog."""
+
+    rows: float
+    columns: List[ColumnEstimate]
+
+    def col(self, i: int) -> ColumnEstimate:
+        if 0 <= i < len(self.columns):
+            return self.columns[i]
+        return ColumnEstimate()
+
+
+class StatsCalculator:
+    """Memoized bottom-up estimator. The memo holds the node reference
+    alongside its estimate — id() keys alone would go stale when CPython
+    recycles a collected node's address for a new one (a calculator may
+    outlive individual plans, e.g. the binder's)."""
+
+    _MEMO_CAP = 1 << 17
+
+    def __init__(self):
+        self._memo: Dict[int, Tuple[PlanNode, PlanEstimate]] = {}
+
+    def rows(self, node: PlanNode) -> float:
+        return self.estimate(node).rows
+
+    def estimate(self, node: PlanNode) -> PlanEstimate:
+        got = self._memo.get(id(node))
+        if got is not None and got[0] is node:
+            return got[1]
+        est = self._compute(node)
+        est.rows = max(est.rows, 0.0)
+        if len(self._memo) > self._MEMO_CAP:
+            self._memo.clear()
+        self._memo[id(node)] = (node, est)
+        return est
+
+    # ------------------------------------------------------------------
+    def _compute(self, node: PlanNode) -> PlanEstimate:
+        if isinstance(node, TableScanNode):
+            rows = float(node.handle.row_count)
+            pk = set(getattr(node.handle, "primary_key", None) or [])
+            cols = []
+            for i in node.columns:
+                ch = node.handle.columns[i]
+                ndv = None
+                if getattr(ch, "ndv", None) is not None:
+                    ndv = float(ch.ndv)
+                elif ch.name in pk:
+                    ndv = rows
+                elif ch.domain is not None:
+                    lo, hi = ch.domain
+                    ndv = min(float(hi - lo + 1), rows)
+                cols.append(ColumnEstimate(
+                    domain=(float(ch.domain[0]), float(ch.domain[1])) if ch.domain else None,
+                    ndv=ndv,
+                ))
+            return PlanEstimate(rows, cols)
+
+        if isinstance(node, FilterNode):
+            src = self.estimate(node.source)
+            sel, cols = self._filter(node.predicate, src)
+            rows = src.rows * sel
+            out_cols = [ColumnEstimate(c.domain,
+                                       None if c.ndv is None else min(c.ndv, max(rows, 1.0)))
+                        for c in cols]
+            return PlanEstimate(rows, out_cols)
+
+        if isinstance(node, ProjectNode):
+            src = self.estimate(node.source)
+            cols = []
+            for e in node.projections:
+                if isinstance(e, ColumnRef):
+                    cols.append(src.col(e.index))
+                elif isinstance(e, Literal):
+                    cols.append(ColumnEstimate(None, 1.0))
+                else:
+                    cols.append(ColumnEstimate())
+            return PlanEstimate(src.rows, cols)
+
+        if isinstance(node, JoinNode):
+            return self._join(node)
+
+        if isinstance(node, CrossSingleNode):
+            src = self.estimate(node.left)
+            right = self.estimate(node.right)
+            return PlanEstimate(src.rows, src.columns + right.columns)
+
+        if isinstance(node, AggregationNode):
+            src = self.estimate(node.source)
+            groups = 1.0
+            key_cols = []
+            for e in node.group_exprs:
+                ndv = None
+                if isinstance(e, ColumnRef):
+                    ndv = src.col(e.index).ndv
+                    key_cols.append(src.col(e.index))
+                else:
+                    key_cols.append(ColumnEstimate())
+                groups *= ndv if ndv is not None else max(src.rows ** 0.5, 1.0)
+            rows = min(groups, src.rows) if node.group_exprs else 1.0
+            agg_cols = [ColumnEstimate() for _ in node.channels[len(node.group_exprs):]]
+            return PlanEstimate(rows, key_cols + agg_cols)
+
+        if isinstance(node, GroupIdNode):
+            src = self.estimate(node.source)
+            nsets = max(len(node.set_masks), 1)
+            key_cols = []
+            for e in node.key_exprs:
+                key_cols.append(src.col(e.index) if isinstance(e, ColumnRef)
+                                else ColumnEstimate())
+            gid = ColumnEstimate((0.0, float(nsets - 1)), float(nsets))
+            return PlanEstimate(src.rows * nsets, src.columns + key_cols + [gid])
+
+        if isinstance(node, (LimitNode, TopNNode)):
+            src = self.estimate(node.source)
+            return PlanEstimate(min(float(node.count), src.rows), src.columns)
+
+        if isinstance(node, UnionNode):
+            rows = sum(self.estimate(s).rows for s in node.inputs)
+            return PlanEstimate(rows, [ColumnEstimate() for _ in node.channels])
+
+        if isinstance(node, ValuesNode):
+            return PlanEstimate(float(len(node.rows)),
+                                [ColumnEstimate() for _ in node.types])
+
+        from presto_tpu.planner.plan import PrecomputedNode
+
+        if isinstance(node, PrecomputedNode):
+            # materialized page: exact row count available
+            import numpy as _np
+
+            rows = float(_np.asarray(node.page.row_mask).sum())
+            return PlanEstimate(rows, [ColumnEstimate() for _ in node.channels])
+
+        if isinstance(node, (SortNode, OutputNode, WindowNode)):
+            src = self.estimate(node.source)
+            ncols = len(node.channels)
+            cols = list(src.columns) + [ColumnEstimate()] * (ncols - len(src.columns))
+            return PlanEstimate(src.rows, cols[:ncols])
+
+        srcs = node.sources
+        if srcs:
+            src = self.estimate(srcs[0])
+            return PlanEstimate(src.rows, [ColumnEstimate() for _ in node.channels])
+        return PlanEstimate(1.0, [ColumnEstimate() for _ in node.channels])
+
+    # ------------------------------------------------------------------
+    def _join(self, node: JoinNode) -> PlanEstimate:
+        left = self.estimate(node.left)
+        right = self.estimate(node.right)
+        # per-key selectivity: 1 / max(ndv_l, ndv_r)
+        sel = 1.0
+        any_stats = False
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            ndv_l = left.col(lk.index).ndv if isinstance(lk, ColumnRef) else None
+            ndv_r = right.col(rk.index).ndv if isinstance(rk, ColumnRef) else None
+            m = max(ndv_l or 0.0, ndv_r or 0.0)
+            if m > 0:
+                sel /= m
+                any_stats = True
+        if node.kind == "semi":
+            # fraction of probe rows with a match
+            frac = 0.5
+            if any_stats and left.rows > 0:
+                inner = left.rows * right.rows * sel
+                frac = min(inner / left.rows, 1.0)
+            return PlanEstimate(left.rows * frac, left.columns)
+        if node.kind == "anti":
+            frac = 0.5
+            if any_stats and left.rows > 0:
+                inner = left.rows * right.rows * sel
+                frac = min(inner / left.rows, 1.0)
+            return PlanEstimate(left.rows * (1.0 - frac), left.columns)
+        if any_stats:
+            rows = left.rows * right.rows * sel
+        else:
+            rows = max(left.rows, right.rows)
+        if node.unique_build and node.kind in ("inner", "left"):
+            # each probe row matches at most once (FK->PK): probe-bound
+            rows = min(rows, left.rows)
+        if node.kind in ("left", "full"):
+            rows = max(rows, left.rows)
+        if node.kind == "full":
+            rows = max(rows, right.rows)
+        return PlanEstimate(rows, left.columns + right.columns)
+
+    # ------------------------------------------------------------------
+    def _filter(self, e: Expr, src: PlanEstimate) -> Tuple[float, List[ColumnEstimate]]:
+        """(selectivity, narrowed column estimates)."""
+        cols = [dataclasses.replace(c) for c in src.columns]
+        sel = self._conjunct(e, cols)
+        return sel, cols
+
+    def _conjunct(self, e: Expr, cols: List[ColumnEstimate]) -> float:
+        if not isinstance(e, Call):
+            return UNKNOWN_FILTER_SELECTIVITY
+        fn = e.fn
+        if fn == "and":
+            return self._conjunct(e.args[0], cols) * self._conjunct(e.args[1], cols)
+        if fn == "or":
+            a = self._conjunct(e.args[0], list(cols))
+            b = self._conjunct(e.args[1], list(cols))
+            return min(a + b, 1.0)
+        if fn == "not":
+            return max(1.0 - self._conjunct(e.args[0], list(cols)), 0.05)
+        col, lit, op = self._col_lit(e)
+        if col is None:
+            if fn == "is_null":
+                return 0.05
+            if fn == "not_null":
+                return 0.95
+            if fn == "in" and isinstance(e.args[0], ColumnRef):
+                c = cols[e.args[0].index] if e.args[0].index < len(cols) else ColumnEstimate()
+                k = float(len(e.args) - 1)
+                if c.ndv:
+                    return min(k / c.ndv, 1.0)
+                return UNKNOWN_FILTER_SELECTIVITY
+            if fn == "between" and isinstance(e.args[0], ColumnRef):
+                sel = 1.0
+                if isinstance(e.args[1], Literal):
+                    sel *= self._range_sel(cols, e.args[0], e.args[1], "ge")
+                if isinstance(e.args[2], Literal):
+                    sel *= self._range_sel(cols, e.args[0], e.args[2], "le")
+                return sel
+            return UNKNOWN_FILTER_SELECTIVITY
+        if op == "eq":
+            c = cols[col.index] if col.index < len(cols) else ColumnEstimate()
+            if lit.value is not None and not col.type.is_string:
+                v = float(lit.value)
+                cols[col.index] = ColumnEstimate((v, v), 1.0)
+            if c.ndv:
+                return 1.0 / c.ndv
+            return 0.1
+        if op == "ne":
+            c = cols[col.index] if col.index < len(cols) else ColumnEstimate()
+            return 1.0 - (1.0 / c.ndv) if c.ndv else 0.9
+        return self._range_sel(cols, col, lit, op)
+
+    def _col_lit(self, e: Call):
+        """Normalize (col cmp literal) conjuncts; returns (col, lit, op)."""
+        if e.fn not in ("eq", "ne", "lt", "le", "gt", "ge") or len(e.args) != 2:
+            return None, None, None
+        a, b = e.args
+        flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+        if isinstance(a, ColumnRef) and isinstance(b, Literal):
+            return a, b, e.fn
+        if isinstance(b, ColumnRef) and isinstance(a, Literal):
+            return b, a, flip.get(e.fn, e.fn)
+        return None, None, None
+
+    def _range_sel(self, cols, col: ColumnRef, lit: Literal, op: str) -> float:
+        if col.index >= len(cols) or lit is None or lit.value is None \
+                or col.type.is_string:
+            return UNKNOWN_FILTER_SELECTIVITY
+        c = cols[col.index]
+        if c.domain is None:
+            return UNKNOWN_FILTER_SELECTIVITY
+        lo, hi = c.domain
+        try:
+            v = float(lit.value)
+            # align scaled-int decimal spaces (domains are raw values)
+            col_scale = (col.type.scale or 0) if col.type.is_decimal else 0
+            lit_scale = (lit.type.scale or 0) if lit.type.is_decimal else 0
+            if col_scale != lit_scale:
+                v = v * (10.0 ** (col_scale - lit_scale))
+        except (TypeError, ValueError):
+            return UNKNOWN_FILTER_SELECTIVITY
+        width = max(hi - lo, 1e-9)
+        if op in ("lt", "le"):
+            frac = (min(v, hi) - lo) / width
+            new_dom = (lo, min(v, hi))
+        else:  # gt, ge
+            frac = (hi - max(v, lo)) / width
+            new_dom = (max(v, lo), hi)
+        frac = min(max(frac, 0.0), 1.0)
+        new_ndv = None if c.ndv is None else max(c.ndv * frac, 1.0)
+        cols[col.index] = ColumnEstimate(new_dom, new_ndv)
+        return max(frac, 1e-4)

@@ -279,6 +279,11 @@ class Binder:
         # planned scalar-subquery marker refs keyed by id(ast node),
         # live only while binding the enclosing conjunct
         self._scalar_refs: Dict[int, ColumnRef] = {}
+        # CBO stats (cost/StatsCalculator.java analog); memo is safe to
+        # share across plan() calls since plan nodes are identity-keyed
+        from presto_tpu.planner.stats import StatsCalculator
+
+        self._stats = StatsCalculator()
 
     # ==================================================================
     def plan(self, sql: str) -> OutputNode:
@@ -608,22 +613,9 @@ class Binder:
 
     # ------------------------------------------------------------------
     def _estimate(self, node: PlanNode) -> float:
-        """Row-count guess for join ordering (cost/StatsCalculator.java's
-        role, collapsed to fixed selectivities)."""
-        if isinstance(node, TableScanNode):
-            return float(node.handle.row_count)
-        if isinstance(node, FilterNode):
-            return self._estimate(node.source) * 0.3
-        if isinstance(node, AggregationNode):
-            return min(self._estimate(node.source), float(node.max_groups))
-        if isinstance(node, JoinNode):
-            if node.kind in ("semi", "anti"):
-                return self._estimate(node.left) * 0.5
-            return max(self._estimate(node.left), self._estimate(node.right))
-        if isinstance(node, (LimitNode, TopNNode)):
-            return float(node.count)
-        srcs = node.sources
-        return self._estimate(srcs[0]) if srcs else 1.0
+        """Estimated output rows, via the stats calculator
+        (cost/StatsCalculator.java analog, planner/stats.py)."""
+        return self._stats.rows(node)
 
     def _build_is_unique(self, node: PlanNode, rkeys: Sequence[Expr]) -> bool:
         """True if the build side's join keys are unique: primary-key
@@ -925,7 +917,7 @@ class Binder:
         est = self._estimate(node)
         agg = AggregationNode(
             node, group_irs, group_names, agg_ctx.aggs, agg_names,
-            max_groups=self._group_capacity(group_irs, scope, est),
+            max_groups=self._group_capacity(group_irs, scope, est, node=node),
         )
         out: PlanNode = agg
         for ir in having_plain:
@@ -941,22 +933,28 @@ class Binder:
             out = FilterNode(out, pred)
         return out, out_irs, names, order_irs
 
-    def _group_capacity(self, group_irs: List[Expr], scope: Scope, est_rows: float) -> int:
+    def _group_capacity(self, group_irs: List[Expr], scope: Scope, est_rows: float,
+                        node: Optional[PlanNode] = None) -> int:
+        """Initial group capacity from domains / NDV stats; the executor
+        doubles (or spills) on overflow, so this is a starting size, not
+        a correctness bound."""
         if not group_irs:
             return 1
-        prod = 1
+        prod = 1.0
         for g in group_irs:
-            if (
-                isinstance(g, ColumnRef)
-                and g.index < len(scope.cols)
-                and scope.cols[g.index].channel.domain is not None
-            ):
-                lo, hi = scope.cols[g.index].channel.domain
-                prod *= hi - lo + 2
-            else:
-                prod = 1 << 60
+            ndv = None
+            if isinstance(g, ColumnRef):
+                if node is not None:
+                    ndv = self._stats.estimate(node).col(g.index).ndv
+                if ndv is None and g.index < len(scope.cols) \
+                        and scope.cols[g.index].channel.domain is not None:
+                    lo, hi = scope.cols[g.index].channel.domain
+                    ndv = float(hi - lo + 2)
+            if ndv is None:
+                prod = float(1 << 60)
                 break
-        cap = min(prod, int(est_rows) + 1)
+            prod *= max(ndv, 1.0)
+        cap = int(min(prod, est_rows + 1))
         cap = 1 << (max(cap - 1, 1)).bit_length()
         return max(1 << 4, min(cap, 1 << 24))
 
@@ -1022,7 +1020,7 @@ class Binder:
         inner = AggregationNode(
             node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
             [AggCall(fn="max", arg=call("hll_rho", arg), type=BIGINT)], ["$rho"],
-            max_groups=self._group_capacity(inner_keys, scope, self._estimate(node)),
+            max_groups=self._group_capacity(inner_keys, scope, self._estimate(node), node=node),
         )
         new_group = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
         rho_ref = ColumnRef(type=BIGINT, index=len(inner_keys))
@@ -1042,7 +1040,7 @@ class Binder:
         inner_keys = group_irs + [arg]
         inner = AggregationNode(
             node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))], [], [],
-            max_groups=self._group_capacity(inner_keys, scope, self._estimate(node)),
+            max_groups=self._group_capacity(inner_keys, scope, self._estimate(node), node=node),
         )
         new_group = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
         arg_ref = ColumnRef(type=arg.type, index=len(group_irs))
