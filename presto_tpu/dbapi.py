@@ -1,0 +1,196 @@
+"""PEP 249 (DB-API 2.0) driver over the REST protocol.
+
+Reference analog: ``presto-jdbc`` — the standard database-driver
+surface (Connection/Cursor here instead of JDBC's Connection/Statement/
+ResultSet) speaking ``presto-client``'s V1 statement protocol
+underneath (client.py's StatementClient).
+
+    import presto_tpu.dbapi as dbapi
+    conn = dbapi.connect("http://127.0.0.1:8080")
+    cur = conn.cursor()
+    cur.execute("select count(*) from lineitem")
+    print(cur.fetchall())
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from presto_tpu.client import StatementClient
+
+apilevel = "2.0"
+threadsafety = 1  # threads may share the module, not connections
+paramstyle = "qmark"
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+class ProgrammingError(DatabaseError):
+    pass
+
+
+def connect(uri: str) -> "Connection":
+    return Connection(uri)
+
+
+class Connection:
+    def __init__(self, uri: str):
+        self._client = StatementClient(uri)
+        self._closed = False
+
+    def cursor(self) -> "Cursor":
+        if self._closed:
+            raise InterfaceError("connection is closed")
+        return Cursor(self._client)
+
+    def close(self) -> None:
+        self._closed = True
+
+    # autocommit engine: commit/rollback are no-ops (the reference's
+    # JDBC driver behaves the same outside explicit transactions)
+    def commit(self) -> None:
+        pass
+
+    def rollback(self) -> None:
+        raise DatabaseError("transactions are not supported")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _quote(value: Any) -> str:
+    if value is None:
+        return "null"
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, (int, float)):
+        return repr(value)
+    s = str(value).replace("'", "''")
+    return f"'{s}'"
+
+
+def _substitute(operation: str, parameters: Sequence[Any]) -> str:
+    """qmark substitution that skips ? inside quoted strings."""
+    out = []
+    it = iter(parameters)
+    used = 0
+    i = 0
+    n = len(operation)
+    while i < n:
+        ch = operation[i]
+        if ch == "'":
+            j = i + 1
+            while j < n:
+                if operation[j] == "'":
+                    if j + 1 < n and operation[j + 1] == "'":  # escaped ''
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(operation[i : j + 1])
+            i = j + 1
+            continue
+        if ch == "?":
+            try:
+                out.append(_quote(next(it)))
+            except StopIteration:
+                raise ProgrammingError(
+                    f"statement has more placeholders than the "
+                    f"{len(parameters)} parameters given") from None
+            used += 1
+            i += 1
+            continue
+        out.append(ch)
+        i += 1
+    if used != len(parameters):
+        raise ProgrammingError(
+            f"statement has {used} placeholders, "
+            f"{len(parameters)} parameters given")
+    return "".join(out)
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, client: StatementClient):
+        self._client = client
+        self._rows: Optional[List[tuple]] = None
+        self._pos = 0
+        self.description: Optional[List[tuple]] = None
+        self.rowcount = -1
+
+    def execute(self, operation: str, parameters: Optional[Sequence[Any]] = None) -> "Cursor":
+        if parameters:
+            operation = _substitute(operation, parameters)
+        try:
+            columns, rows = self._client.execute(operation)
+        except Exception as e:
+            raise DatabaseError(str(e)) from e
+        self._rows = rows
+        self._pos = 0
+        self.rowcount = len(rows)
+        self.description = [
+            (c.get("name"), c.get("type"), None, None, None, None, None)
+            for c in columns
+        ]
+        return self
+
+    def executemany(self, operation: str, seq_of_parameters) -> "Cursor":
+        for p in seq_of_parameters:
+            self.execute(operation, p)
+        return self
+
+    def fetchone(self) -> Optional[tuple]:
+        self._check()
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[tuple]:
+        self._check()
+        n = size or self.arraysize
+        out = self._rows[self._pos : self._pos + n]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> List[tuple]:
+        self._check()
+        out = self._rows[self._pos :]
+        self._pos = len(self._rows)
+        return out
+
+    def _check(self):
+        if self._rows is None:
+            raise ProgrammingError("no result set: call execute() first")
+
+    def close(self) -> None:
+        self._rows = None
+
+    def setinputsizes(self, sizes) -> None:
+        pass
+
+    def setoutputsize(self, size, column=None) -> None:
+        pass
+
+    def __iter__(self):
+        self._check()
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row

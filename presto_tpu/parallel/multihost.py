@@ -50,7 +50,12 @@ class MultiHostUnsupported(Exception):
 
 
 class WorkerClient:
-    """One remote worker (HttpRemoteTask + Backoff analog)."""
+    """One remote worker (HttpRemoteTask + Backoff analog). Results
+    stream through the worker's acked pull buffers: long-poll GETs with
+    token acknowledgement (ExchangeClient/HttpPageBufferClient.java:291
+    sendGetResults + .../acknowledge), so large shuffles never hold a
+    whole task's output in one response and the producer sees
+    backpressure from unacknowledged bytes."""
 
     def __init__(self, uri: str, max_attempts: int = 3, timeout: float = 300.0):
         self.uri = uri.rstrip("/")
@@ -68,21 +73,65 @@ class WorkerClient:
         return self.alive
 
     def run_fragment(self, fragment_json: dict) -> List[bytes]:
-        body = json.dumps({"fragment": fragment_json}).encode()
         last: Optional[Exception] = None
         for attempt in range(self.max_attempts):
             try:
-                req = urllib.request.Request(
-                    f"{self.uri}/v1/task", data=body, method="POST",
-                    headers={"Content-Type": "application/json"},
-                )
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    return parse_task_response(resp.read())
+                # a fresh task id per attempt: fragments are pure, so a
+                # retried task simply recomputes (at-least-once overall,
+                # de-duplicated by task id server-side)
+                return self._pull_task(fragment_json)
             except Exception as e:
                 last = e
                 time.sleep(min(0.1 * (2 ** attempt), 2.0))
         self.alive = False
         raise ConnectionError(f"worker {self.uri} failed: {last}")
+
+    def _pull_task(self, fragment_json: dict) -> List[bytes]:
+        import uuid
+
+        tid = uuid.uuid4().hex[:16]
+        body = json.dumps({"fragment": fragment_json}).encode()
+        req = urllib.request.Request(
+            f"{self.uri}/v1/task/{tid}", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            json.load(resp)
+        pages: List[bytes] = []
+        token = 0
+        # no-progress deadline: a wedged producer must fail the pull
+        # (the old one-shot POST failed at its socket timeout; the
+        # long-poll loop needs the equivalent wall-clock bound)
+        last_progress = time.monotonic()
+        try:
+            while True:
+                if time.monotonic() - last_progress > self.timeout:
+                    raise TimeoutError(
+                        f"task {tid} made no progress for {self.timeout}s")
+                with urllib.request.urlopen(
+                    f"{self.uri}/v1/task/{tid}/results/{token}",
+                    timeout=self.timeout,
+                ) as resp:
+                    batch = parse_task_response(resp.read())
+                    nxt = int(resp.headers.get("X-Next-Token", token))
+                    complete = resp.headers.get("X-Complete") == "1"
+                pages.extend(batch)
+                if nxt > token:
+                    token = nxt
+                    last_progress = time.monotonic()
+                    urllib.request.urlopen(
+                        f"{self.uri}/v1/task/{tid}/results/{token}/acknowledge",
+                        timeout=self.timeout,
+                    ).close()
+                if complete:
+                    return pages
+        finally:
+            try:
+                req = urllib.request.Request(
+                    f"{self.uri}/v1/task/{tid}", method="DELETE")
+                urllib.request.urlopen(req, timeout=10.0).close()
+            except Exception:
+                pass
 
 
 class MultiHostRunner:

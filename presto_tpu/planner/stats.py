@@ -87,10 +87,16 @@ class StatsCalculator:
             return got[1]
         est = self._compute(node)
         est.rows = max(est.rows, 0.0)
-        if len(self._memo) > self._MEMO_CAP:
-            self._memo.clear()
-        self._memo[id(node)] = (node, est)
+        from presto_tpu.planner.plan import PrecomputedNode
+
+        if not isinstance(node, PrecomputedNode):  # don't pin device pages
+            if len(self._memo) > self._MEMO_CAP:
+                self._memo.clear()
+            self._memo[id(node)] = (node, est)
         return est
+
+    def reset(self) -> None:
+        self._memo.clear()
 
     # ------------------------------------------------------------------
     def _compute(self, node: PlanNode) -> PlanEstimate:
@@ -103,7 +109,8 @@ class StatsCalculator:
                 ndv = None
                 if getattr(ch, "ndv", None) is not None:
                     ndv = float(ch.ndv)
-                elif ch.name in pk:
+                elif ch.name in pk and len(pk) == 1:
+                    # composite-key members are NOT unique individually
                     ndv = rows
                 elif ch.domain is not None:
                     lo, hi = ch.domain

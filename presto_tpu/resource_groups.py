@@ -3,38 +3,67 @@
 Reference analog: ``execution/resourceGroups/InternalResourceGroup.java``
 + ``InternalResourceGroupManager`` and the spi/resourceGroups selector
 contract — queries are admitted into a tree of groups with concurrency
-and queue quotas; over-quota queries wait in FIFO order (the reference
-also offers weighted/priority queues).
+and queue quotas.  Scheduling policies mirror the reference's:
+
+  fair            FIFO within the group (FifoQueue)
+  weighted_fair   a freed slot goes to the contending sibling with the
+                  lowest running/weight ratio (WeightedFairQueue.java)
+  query_priority  highest submission priority first
+                  (the reference's StochasticPriorityQueue/priority mode,
+                  deterministic here)
+
+All groups of a tree share one lock; eligibility walks the ancestor
+chain so sibling fairness is enforced at every level.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 class QueryQueueFullError(Exception):
     pass
 
 
+_seq = itertools.count()
+
+
 class ResourceGroup:
-    """One node of the group tree: hard_concurrency + max_queued."""
+    """One node of the group tree."""
 
     def __init__(self, name: str, hard_concurrency: int = 8, max_queued: int = 100,
-                 parent: Optional["ResourceGroup"] = None):
+                 parent: Optional["ResourceGroup"] = None,
+                 scheduling_weight: int = 1, scheduling_policy: str = "fair"):
         self.name = name
         self.hard_concurrency = hard_concurrency
         self.max_queued = max_queued
         self.parent = parent
+        self.scheduling_weight = max(int(scheduling_weight), 1)
+        self.scheduling_policy = scheduling_policy
         self.children: Dict[str, "ResourceGroup"] = {}
-        self._lock = threading.Condition()
+        # one condition per TREE: cross-group fairness needs a shared
+        # monitor (the reference synchronizes on the root too,
+        # InternalResourceGroup.root lock)
+        self._lock = parent._lock if parent is not None else threading.Condition()
         self.running = 0
         self.queued = 0
+        self.pending = 0  # waiters in this subtree (for sibling contention)
+        self._wait_queue: List[Tuple[int, int]] = []  # (order_key, seq)
+        # stride-scheduling virtual time: each admission costs 1/weight,
+        # so long-run admissions converge to the weight ratio even when
+        # instantaneous running counts tie (WeightedFairQueue's
+        # utilization/share comparison, made history-aware)
+        self._vtime = 0.0
 
-    def subgroup(self, name: str, hard_concurrency: int = 8, max_queued: int = 100) -> "ResourceGroup":
+    def subgroup(self, name: str, hard_concurrency: int = 8, max_queued: int = 100,
+                 scheduling_weight: int = 1,
+                 scheduling_policy: str = "fair") -> "ResourceGroup":
         g = self.children.get(name)
         if g is None:
-            g = ResourceGroup(f"{self.name}.{name}", hard_concurrency, max_queued, self)
+            g = ResourceGroup(f"{self.name}.{name}", hard_concurrency, max_queued,
+                              self, scheduling_weight, scheduling_policy)
             self.children[name] = g
         return g
 
@@ -53,29 +82,77 @@ class ResourceGroup:
             g.running += delta
             g = g.parent
 
-    def acquire(self, timeout: Optional[float] = None) -> None:
-        """Block until this query may run (FIFO within the group)."""
+    def _charge_pending(self, delta: int) -> None:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            g.pending += delta
+            g = g.parent
+
+    def _eligible(self, entry: Tuple[int, int]) -> bool:
+        """entry may run: it heads its own queue AND every contended
+        weighted-fair ancestor prefers this path."""
+        if not self._wait_queue or min(self._wait_queue) != entry:
+            return False
+        g: ResourceGroup = self
+        while g.parent is not None:
+            parent = g.parent
+            if parent.scheduling_policy == "weighted_fair":
+                # only siblings that can actually admit contend — a
+                # capacity-saturated preferred child must not idle the
+                # parent's free slots (head-of-line starvation)
+                contenders = [c for c in parent.children.values()
+                              if c.pending > 0 and c.running < c.hard_concurrency]
+                if len(contenders) > 1 and g in contenders:
+                    preferred = min(contenders, key=lambda c: (c._vtime, c.name))
+                    if preferred is not g:
+                        return False
+            g = parent
+        return True
+
+    def acquire(self, timeout: Optional[float] = None, priority: int = 0) -> None:
+        """Block until this query may run under the group's policy."""
+        import time as _time
+
+        order_key = -priority if self.scheduling_policy == "query_priority" else 0
+        entry = (order_key, next(_seq))
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._lock:
             if self.queued >= self.max_queued:
                 raise QueryQueueFullError(
                     f"group {self.name}: {self.queued} queries queued (max {self.max_queued})"
                 )
             self.queued += 1
+            self._wait_queue.append(entry)
+            self._charge_pending(1)
             try:
-                while not self._can_run():
-                    if not self._lock.wait(timeout=timeout):
+                while not (self._can_run() and self._eligible(entry)):
+                    # absolute deadline: notify_all wakeups must not
+                    # restart the timeout window
+                    remaining = None if deadline is None \
+                        else deadline - _time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError(f"group {self.name}: queue wait timed out")
+                    if not self._lock.wait(timeout=remaining):
                         raise TimeoutError(f"group {self.name}: queue wait timed out")
                 self._charge(1)
+                g: Optional[ResourceGroup] = self
+                while g is not None:
+                    g._vtime += 1.0 / g.scheduling_weight
+                    g = g.parent
             finally:
                 self.queued -= 1
+                self._wait_queue.remove(entry)
+                self._charge_pending(-1)
+                # a state change may unblock a different sibling
+                self._lock.notify_all()
 
     def release(self) -> None:
         with self._lock:
             self._charge(-1)
             self._lock.notify_all()
 
-    def run(self, fn: Callable, timeout: Optional[float] = None):
-        self.acquire(timeout=timeout)
+    def run(self, fn: Callable, timeout: Optional[float] = None, priority: int = 0):
+        self.acquire(timeout=timeout, priority=priority)
         try:
             return fn()
         finally:

@@ -1,0 +1,111 @@
+"""Task output buffers: the shuffle server's acked page store.
+
+Reference analog: ``execution/buffer/OutputBuffer.java`` (``get(bufferId,
+token, maxSize)`` at :65, ``enqueue`` at :86) with ``ClientBuffer``'s
+token protocol and ``OutputBufferMemoryManager``'s bounded footprint:
+
+* pages are identified by a monotonically increasing token (their
+  sequence number); a GET at token t returns pages [t, t+k) plus the
+  next token — re-GETs of an unacknowledged token return the same pages
+  (at-least-once delivery with client-side dedupe by token);
+* acknowledge(t) frees all pages below t;
+* the producer blocks when unacknowledged bytes exceed the buffer's
+  cap — pull-side backpressure, the deadlock-free flow control the
+  reference gets from bounded OutputBufferMemoryManager.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+
+class BufferAborted(Exception):
+    pass
+
+
+class TaskOutputBuffer:
+    """One task's serialized-page output buffer."""
+
+    def __init__(self, max_bytes: int = 64 << 20):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pages: List[Optional[bytes]] = []  # None = acknowledged/freed
+        self._acked = 0  # tokens below this are freed
+        self._bytes = 0  # unacknowledged payload bytes
+        self._complete = False
+        self._aborted = False
+        self._error: Optional[str] = None
+
+    # -- producer side ------------------------------------------------------
+    def enqueue(self, page: bytes) -> None:
+        with self._cond:
+            while self._bytes >= self.max_bytes and not self._aborted:
+                self._cond.wait(timeout=1.0)
+            if self._aborted:
+                raise BufferAborted()
+            self._pages.append(page)
+            self._bytes += len(page)
+            self._cond.notify_all()
+
+    def set_complete(self) -> None:
+        with self._cond:
+            self._complete = True
+            self._cond.notify_all()
+
+    def fail(self, message: str) -> None:
+        with self._cond:
+            self._error = message
+            self._complete = True
+            self._cond.notify_all()
+
+    def abort(self) -> None:
+        with self._cond:
+            self._aborted = True
+            self._pages = []
+            self._bytes = 0
+            self._cond.notify_all()
+
+    # -- consumer side ------------------------------------------------------
+    def get(self, token: int, max_bytes: int = 8 << 20,
+            timeout: float = 10.0) -> Tuple[List[bytes], int, bool, Optional[str]]:
+        """(pages, next_token, buffer_complete, error): long-polls up to
+        ``timeout`` for data at ``token``; tokens below the acknowledged
+        watermark cannot be replayed (the client already saw them)."""
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+        with self._cond:
+            if token < self._acked:
+                raise KeyError(f"token {token} already acknowledged")
+            if not self._complete and token >= len(self._pages):
+                self._cond.wait(timeout=deadline)
+            out: List[bytes] = []
+            t = token
+            size = 0
+            while t < len(self._pages):
+                p = self._pages[t]
+                if p is None:  # freed (should not happen above _acked)
+                    t += 1
+                    continue
+                if out and size + len(p) > max_bytes:
+                    break
+                out.append(p)
+                size += len(p)
+                t += 1
+            done = self._complete and t >= len(self._pages)
+            return out, t, done, self._error
+
+    def acknowledge(self, token: int) -> None:
+        with self._cond:
+            for i in range(self._acked, min(token, len(self._pages))):
+                p = self._pages[i]
+                if p is not None:
+                    self._bytes -= len(p)
+                    self._pages[i] = None
+            self._acked = max(self._acked, token)
+            self._cond.notify_all()
+
+    @property
+    def unacked_bytes(self) -> int:
+        with self._lock:
+            return self._bytes

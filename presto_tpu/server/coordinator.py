@@ -31,6 +31,43 @@ from presto_tpu.runner import QueryRunner
 
 PAGE_ROWS = 1000
 
+# Minimal cluster console (the reference serves a React app from
+# presto-main/src/main/resources/webapp/; this single inline page covers
+# the same first-stop view — cluster tiles + live query list — from the
+# same REST resources).
+_UI_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>presto-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#16181d;color:#e8e8e8}
+ h1{font-size:1.3rem} .tiles{display:flex;gap:1rem;margin:1rem 0}
+ .tile{background:#23262e;border-radius:8px;padding:1rem 1.5rem;min-width:8rem}
+ .tile .v{font-size:1.8rem;font-weight:600} .tile .l{color:#9aa0ab;font-size:.8rem}
+ table{border-collapse:collapse;width:100%;margin-top:1rem}
+ th,td{text-align:left;padding:.4rem .6rem;border-bottom:1px solid #2e323b;font-size:.85rem}
+ th{color:#9aa0ab;font-weight:500}
+ .FINISHED{color:#6fcf97}.RUNNING{color:#56ccf2}.FAILED,.CANCELED{color:#eb5757}
+ .QUEUED{color:#f2c94c} td.q{font-family:ui-monospace,monospace;max-width:40rem;
+ overflow:hidden;text-overflow:ellipsis;white-space:nowrap}
+</style></head><body>
+<h1>presto-tpu cluster console</h1>
+<div class="tiles" id="tiles"></div>
+<table><thead><tr><th>query id</th><th>state</th><th>rows</th><th>sql</th></tr></thead>
+<tbody id="queries"></tbody></table>
+<script>
+async function refresh(){
+  const c = await (await fetch('/v1/cluster')).json();
+  document.getElementById('tiles').innerHTML =
+    ['runningQueries','queuedQueries','finishedQueries','failedQueries']
+    .map(k=>`<div class="tile"><div class="v">${c[k]??0}</div><div class="l">${k.replace('Queries',' queries')}</div></div>`).join('')
+    + (c.totalBytes?`<div class="tile"><div class="v">${(100*c.reservedBytes/c.totalBytes).toFixed(1)}%</div><div class="l">pool reserved</div></div>`:'');
+  const qs = await (await fetch('/v1/query')).json();
+  document.getElementById('queries').innerHTML = qs.reverse().map(q=>
+    `<tr><td>${q.id}</td><td class="${q.state}">${q.state}</td><td>${q.rows}</td><td class="q">${q.query.replace(/</g,'&lt;')}</td></tr>`).join('');
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
 
 class _QueryState:
     def __init__(self, qid: str, sql: str):
@@ -72,12 +109,22 @@ class CoordinatorServer:
                 pass
 
             def _json(self, code: int, obj) -> None:
-                body = json.dumps(obj).encode()
+                # default=str: timestamps/decimals render as ISO strings
+                # (the reference's JSON protocol does the same)
+                body = json.dumps(obj, default=str).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _html(self, code: int, body: str) -> None:
+                raw = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
 
             def do_POST(self):
                 if self.path != "/v1/statement":
@@ -101,6 +148,12 @@ class CoordinatorServer:
                 if parts == ["v1", "query"]:
                     with outer._lock:
                         self._json(200, [q.summary() for q in outer.queries.values()])
+                    return
+                if parts == ["v1", "cluster"]:
+                    self._json(200, outer._cluster_stats())
+                    return
+                if parts in ([], ["ui"]):
+                    self._html(200, _UI_HTML)
                     return
                 if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
                     qid, token = parts[2], int(parts[3])
@@ -151,7 +204,11 @@ class CoordinatorServer:
         def run():
             group = self.resource_groups.group_for(self.runner.session.user)
             try:
-                group.acquire(timeout=600)
+                try:
+                    prio = int(self.runner.session.get("query_priority"))
+                except Exception:
+                    prio = 0
+                group.acquire(timeout=600, priority=prio)
             except Exception as e:
                 with self._lock:
                     if q.state == "QUEUED":
@@ -188,6 +245,22 @@ class CoordinatorServer:
 
         threading.Thread(target=run, daemon=True).start()
         return q
+
+    def _cluster_stats(self) -> dict:
+        """ClusterStatsResource analog (feeds the web UI tiles)."""
+        with self._lock:
+            states = [q.state for q in self.queries.values()]
+        out = {
+            "runningQueries": states.count("RUNNING"),
+            "queuedQueries": states.count("QUEUED"),
+            "finishedQueries": states.count("FINISHED"),
+            "failedQueries": states.count("FAILED") + states.count("CANCELED"),
+        }
+        pool = getattr(self.runner.executor, "memory_pool", None)
+        if pool is not None:
+            out["reservedBytes"] = pool.reserved
+            out["totalBytes"] = pool.limit
+        return out
 
     def _page_response(self, q: _QueryState, token: int) -> dict:
         out = {

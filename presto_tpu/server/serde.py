@@ -41,13 +41,15 @@ from presto_tpu.planner.plan import (
     ValuesNode,
     WindowNode,
 )
-from presto_tpu.types import BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, VARCHAR, DecimalType, Type
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, TIMESTAMP, VARCHAR, DecimalType, Type,
+)
 
 # ---------------------------------------------------------------------------
 # types
 # ---------------------------------------------------------------------------
 
-_BASIC = {t.name: t for t in (BIGINT, INTEGER, DOUBLE, BOOLEAN, DATE, VARCHAR)}
+_BASIC = {t.name: t for t in (BIGINT, INTEGER, DOUBLE, BOOLEAN, DATE, TIMESTAMP, VARCHAR)}
 
 
 def type_to_json(t: Type) -> dict:
@@ -98,6 +100,7 @@ def _agg_to_json(a: AggCall) -> dict:
     return {
         "fn": a.fn, "arg": expr_to_json(a.arg), "t": type_to_json(a.type),
         "distinct": a.distinct, "filter": expr_to_json(a.filter),
+        "arg2": expr_to_json(a.arg2),
     }
 
 
@@ -105,6 +108,7 @@ def _agg_from_json(d: dict) -> AggCall:
     return AggCall(
         fn=d["fn"], arg=expr_from_json(d["arg"]), type=type_from_json(d["t"]),
         distinct=d["distinct"], filter=expr_from_json(d["filter"]),
+        arg2=expr_from_json(d.get("arg2")),
     )
 
 
@@ -165,7 +169,8 @@ def plan_to_json(node: PlanNode) -> dict:
             "order": [expr_to_json(e) for e in node.order_exprs],
             "asc": list(node.ascending),
             "funcs": [
-                {"kind": f.kind, "arg": expr_to_json(f.arg), "offset": f.offset}
+                {"kind": f.kind, "arg": expr_to_json(f.arg), "offset": f.offset,
+                 "frame": list(f.frame) if f.frame else None}
                 for f in node.funcs
             ],
             "names": list(node.func_names),
@@ -230,7 +235,8 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
             [expr_from_json(e) for e in d["partition"]],
             [expr_from_json(e) for e in d["order"]],
             list(d["asc"]),
-            [WindowFunc(kind=f["kind"], arg=expr_from_json(f["arg"]), offset=f["offset"])
+            [WindowFunc(kind=f["kind"], arg=expr_from_json(f["arg"]), offset=f["offset"],
+                        frame=tuple(f["frame"]) if f.get("frame") else None)
              for f in d["funcs"]],
             list(d["names"]),
         )
@@ -248,8 +254,13 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
 # pages (shuffle wire format)
 # ---------------------------------------------------------------------------
 
-def serialize_page(page: Page) -> bytes:
-    """Compact live rows and encode: JSON header + raw column bytes."""
+def serialize_page(page: Page, compress: bool = True) -> bytes:
+    """Compact live rows and encode: JSON header + column bytes,
+    zlib-compressed when that shrinks the payload (the reference's
+    optional LZ4 page compression, execution/buffer/PagesSerde.java:66 +
+    exchange_compression; zlib is the stdlib codec here)."""
+    import zlib
+
     p = page.compact_host()
     header = {"types": [], "n": int(np.asarray(p.num_rows()))}
     payload = b""
@@ -260,14 +271,23 @@ def serialize_page(page: Page) -> bytes:
             {"t": type_to_json(b.type), "dtype": str(data.dtype)}
         )
         payload += data.tobytes() + np.packbits(valid).tobytes()
+    if compress:
+        z = zlib.compress(payload, 1)
+        if len(z) < len(payload):
+            header["z"] = len(payload)  # uncompressed size
+            payload = z
     hjson = json.dumps(header).encode()
     return len(hjson).to_bytes(4, "little") + hjson + payload
 
 
 def deserialize_page(raw: bytes, dictionaries=None) -> Page:
+    import zlib
+
     hlen = int.from_bytes(raw[:4], "little")
     header = json.loads(raw[4 : 4 + hlen].decode())
     n = header["n"]
+    if header.get("z"):
+        raw = raw[: 4 + hlen] + zlib.decompress(raw[4 + hlen :])
     off = 4 + hlen
     blocks = []
     import jax.numpy as jnp

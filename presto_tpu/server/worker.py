@@ -1,97 +1,254 @@
 """Worker task server.
 
 Reference analog: the worker side of the task protocol —
-``server/TaskResource.java:120`` (POST /v1/task/{taskId} with the
-serialized fragment + splits, results served from output buffers) and
-``execution/SqlTaskManager.java:339``.  Collapsed for the
-request/response model: a task executes its fragment synchronously and
-returns the serialized result pages in the response body (the pull
-buffer protocol is unnecessary when the coordinator is the only
-consumer and fragments end in bounded partial states).
+``server/TaskResource.java`` (POST /v1/task/{taskId} creating the task
+at :124, GET .../results/{bufferId}/{token} long-poll at :239, token
+acknowledge at :298, DELETE abort) executed by
+``execution/SqlTaskManager.java:339``.  A task runs its fragment in a
+background thread, streaming serialized pages into a bounded
+:class:`TaskOutputBuffer`; consumers long-poll with token
+acknowledgement (at-least-once + client dedupe) and the producer blocks
+on unacknowledged bytes — pull-side backpressure end to end.
+
+The legacy one-shot ``POST /v1/task`` (fragment in, all pages out) is
+kept for small control-plane uses.
 """
 
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
 
 from presto_tpu import __version__
 from presto_tpu.catalog import Catalog
 from presto_tpu.exec.local import LocalRunner
+from presto_tpu.server.buffers import BufferAborted, TaskOutputBuffer
 from presto_tpu.server.serde import plan_from_json, serialize_page
+
+_RESULTS_RE = re.compile(r"^/v1/task/([\w-]+)/results/(\d+)(/acknowledge)?$")
+_TASK_RE = re.compile(r"^/v1/task/([\w-]+)$")
+
+# task states (execution/TaskState.java:21 — PLANNED/RUNNING/FINISHED/
+# CANCELED/ABORTED/FAILED collapsed to the ones a pull consumer observes)
+RUNNING, FINISHED, FAILED, ABORTED = "RUNNING", "FINISHED", "FAILED", "ABORTED"
+
+
+class _Task:
+    def __init__(self, task_id: str, buffer_bytes: int):
+        import time
+
+        self.task_id = task_id
+        self.buffer = TaskOutputBuffer(max_bytes=buffer_bytes)
+        self.state = RUNNING
+        self.error: Optional[str] = None
+        self.last_access = time.monotonic()
+
+    def touch(self) -> None:
+        import time
+
+        self.last_access = time.monotonic()
 
 
 class WorkerServer:
     """Executes plan fragments against the worker's own catalog.
 
-    POST /v1/task   body: {"fragment": <plan json>}
-                    response: concatenated serialized pages
-                    (4-byte count prefix, then length-prefixed pages)
-    GET  /v1/info   liveness + version (heartbeat endpoint)
+    POST   /v1/task/{id}  body: {"fragment": ...} -> task status JSON;
+                          pages stream into the task's output buffer
+    GET    /v1/task/{id}/results/{token}[?maxsize=N] -> page batch
+                          (binary, X-Next-Token / X-Complete headers)
+    GET    /v1/task/{id}/results/{token}/acknowledge -> frees < token
+    DELETE /v1/task/{id}  abort + drop buffers
+    POST   /v1/task       legacy one-shot (all pages in the response)
+    GET    /v1/info       liveness + version (heartbeat endpoint)
     """
 
-    def __init__(self, catalog: Catalog, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, catalog: Catalog, host: str = "127.0.0.1", port: int = 0,
+                 buffer_bytes: int = 64 << 20, task_ttl: float = 300.0):
         self.catalog = catalog
         self.runner = LocalRunner(catalog)
         self.tasks_executed = 0
+        self.buffer_bytes = buffer_bytes
+        # abandoned-task expiry: a consumer that dies mid-pull must not
+        # leak its buffer + blocked producer forever (the reference
+        # expires tasks via TaskManagerConfig.infoMaxAge/clientTimeout)
+        self.task_ttl = task_ttl
+        self._tasks: Dict[str, _Task] = {}
+        self._tasks_lock = threading.Lock()
+        self.draining = False
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):
                 pass
 
+            def _send(self, code: int, body: bytes, ctype="application/json",
+                      headers=()):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/v1/info":
-                    body = json.dumps(
+                    self._send(200, json.dumps(
                         {"nodeVersion": {"version": __version__},
-                         "coordinator": False, "state": "ACTIVE",
-                         "tasks": outer.tasks_executed}
-                    ).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                else:
-                    self.send_response(404)
-                    self.end_headers()
+                         "coordinator": False,
+                         "state": "SHUTTING_DOWN" if outer.draining else "ACTIVE",
+                         "tasks": outer.tasks_executed}).encode())
+                    return
+                m = _RESULTS_RE.match(self.path.split("?")[0])
+                if m:
+                    outer._expire_tasks()
+                    task = outer._tasks.get(m.group(1))
+                    if task is None:
+                        self._send(404, b"{}")
+                        return
+                    task.touch()
+                    token = int(m.group(2))
+                    if m.group(3):  # acknowledge
+                        task.buffer.acknowledge(token)
+                        self._send(200, b"{}")
+                        return
+                    maxsize = 8 << 20
+                    if "maxsize=" in self.path:
+                        maxsize = int(self.path.split("maxsize=")[1].split("&")[0])
+                    pages, nxt, done, err = task.buffer.get(token, maxsize)
+                    if err is not None:
+                        self._send(500, json.dumps({"error": err}).encode())
+                        return
+                    body = len(pages).to_bytes(4, "little") + b"".join(
+                        len(p).to_bytes(8, "little") + p for p in pages)
+                    self._send(200, body, "application/octet-stream",
+                               headers=[("X-Next-Token", str(nxt)),
+                                        ("X-Complete", "1" if done else "0")])
+                    return
+                m = _TASK_RE.match(self.path)
+                if m:
+                    task = outer._tasks.get(m.group(1))
+                    if task is None:
+                        self._send(404, b"{}")
+                        return
+                    self._send(200, json.dumps(
+                        {"taskId": task.task_id, "state": task.state,
+                         "error": task.error}).encode())
+                    return
+                self._send(404, b"{}")
 
             def do_POST(self):
-                if self.path != "/v1/task":
-                    self.send_response(404)
-                    self.end_headers()
-                    return
                 n = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(n).decode())
-                try:
-                    fragment = plan_from_json(req["fragment"], outer.catalog)
-                    pages = [serialize_page(p) for p in outer.runner._pages(fragment)]
-                    outer.tasks_executed += 1
-                    body = len(pages).to_bytes(4, "little") + b"".join(
-                        len(p).to_bytes(8, "little") + p for p in pages
-                    )
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/octet-stream")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except Exception as e:
-                    body = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
-                    self.send_response(500)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                m = _TASK_RE.match(self.path)
+                if m:
+                    tid = m.group(1)
+                    task = outer._create_task(tid, req["fragment"])
+                    self._send(200, json.dumps(
+                        {"taskId": tid, "state": task.state}).encode())
+                    return
+                if self.path == "/v1/task":  # legacy one-shot
+                    try:
+                        fragment = plan_from_json(req["fragment"], outer.catalog)
+                        pages = [serialize_page(p)
+                                 for p in outer.runner._pages(fragment)]
+                        outer.tasks_executed += 1
+                        body = len(pages).to_bytes(4, "little") + b"".join(
+                            len(p).to_bytes(8, "little") + p for p in pages)
+                        self._send(200, body, "application/octet-stream")
+                    except Exception as e:
+                        self._send(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode())
+                    return
+                self._send(404, b"{}")
+
+            def do_DELETE(self):
+                m = _TASK_RE.match(self.path)
+                if m:
+                    outer._abort_task(m.group(1))
+                    self._send(200, b"{}")
+                    return
+                self._send(404, b"{}")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
+    # ------------------------------------------------------------------
+    def _create_task(self, task_id: str, fragment_json: dict) -> _Task:
+        with self._tasks_lock:
+            existing = self._tasks.get(task_id)
+            if existing is not None:  # idempotent create (client retry)
+                return existing
+            task = _Task(task_id, self.buffer_bytes)
+            self._tasks[task_id] = task
+
+        def run():
+            try:
+                fragment = plan_from_json(fragment_json, self.catalog)
+                for p in self.runner._pages(fragment):
+                    task.buffer.enqueue(serialize_page(p))
+                task.state = FINISHED
+                task.buffer.set_complete()
+                self.tasks_executed += 1
+            except BufferAborted:
+                task.state = ABORTED
+            except Exception as e:
+                task.state = FAILED
+                task.error = f"{type(e).__name__}: {e}"
+                task.buffer.fail(task.error)
+
+        threading.Thread(target=run, daemon=True).start()
+        return task
+
+    def _abort_task(self, task_id: str) -> None:
+        with self._tasks_lock:
+            task = self._tasks.pop(task_id, None)
+        if task is not None:
+            task.buffer.abort()
+            if task.state == RUNNING:
+                task.state = ABORTED
+
+    def _expire_tasks(self) -> None:
+        """Drop tasks untouched for task_ttl (lazy sweep per request)."""
+        import time
+
+        now = time.monotonic()
+        with self._tasks_lock:
+            dead = [tid for tid, t in self._tasks.items()
+                    if now - t.last_access > self.task_ttl]
+        for tid in dead:
+            self._abort_task(tid)
+
+    # ------------------------------------------------------------------
     def start(self) -> None:
         self._thread.start()
 
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: refuse visibility as ACTIVE, wait for
+        running tasks to finish, then stop
+        (server/GracefulShutdownHandler.java:73)."""
+        import time
+
+        self.draining = True
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._tasks_lock:
+                if all(t.state != RUNNING for t in self._tasks.values()):
+                    break
+            time.sleep(0.05)
+        drained = all(t.state != RUNNING for t in self._tasks.values())
+        self.stop()
+        return drained
 
     @property
     def uri(self) -> str:

@@ -54,6 +54,11 @@ SYSTEM_PROPERTIES = [
         "record per-stage rows/wall-time (EXPLAIN ANALYZE forces this)",
         False, _bool,
     ),
+    PropertyMetadata(
+        "query_priority",
+        "admission priority within query_priority resource groups",
+        0, int,
+    ),
 ]
 
 

@@ -287,6 +287,7 @@ class Binder:
 
     # ==================================================================
     def plan(self, sql: str) -> OutputNode:
+        self._stats.reset()  # don't pin prior queries' plan trees
         return self.plan_ast(parse_query(sql))
 
     def plan_ast(self, q: ast.Node) -> OutputNode:
