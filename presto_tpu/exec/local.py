@@ -102,7 +102,7 @@ def cross_append_single(q: Page, r: Page) -> Page:
     for b in r.blocks:
         blocks.append(
             Block(
-                jnp.broadcast_to(b.data[0], (q.capacity,)),
+                jnp.broadcast_to(b.data[0], (q.capacity,) + b.data.shape[1:]),
                 jnp.broadcast_to(b.valid[0] & r.row_mask[0], (q.capacity,)),
                 b.type,
                 b.dictionary,
@@ -918,14 +918,14 @@ class LocalRunner:
         cols, valids = [], []
         for i, t in enumerate(types):
             if i < nkeys:
-                cols.append(np.zeros(k, t.np_dtype))
+                cols.append(np.zeros((k,) + t.value_shape, t.np_dtype))
                 valids.append(np.zeros(k, np.bool_))
             elif i == nkeys:
                 cols.append(np.asarray(empty_gids, t.np_dtype))
                 valids.append(np.ones(k, np.bool_))
             else:
                 agg = node.aggs[i - nkeys - 1]
-                cols.append(np.zeros(k, t.np_dtype))
+                cols.append(np.zeros((k,) + t.value_shape, t.np_dtype))
                 valids.append(
                     np.full(k, agg.fn in ("count", "count_star"), np.bool_)
                 )

@@ -47,7 +47,7 @@ class HostPage:
         cap = capacity if capacity is not None else max(n, 1)
         blocks = []
         for data, valid, t, d in self.columns:
-            dd = np.zeros(cap, dtype=data.dtype)
+            dd = np.zeros((cap,) + data.shape[1:], dtype=data.dtype)
             dd[:n] = data
             vv = np.zeros(cap, dtype=np.bool_)
             vv[:n] = valid

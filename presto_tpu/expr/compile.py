@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from presto_tpu.expr.ir import AggCall, Call, ColumnRef, Expr, Literal
 from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import BIGINT as BIGINT_T
 from presto_tpu.types import BOOLEAN, DOUBLE, MICROS_PER_DAY, Type
 
 CompiledExpr = Callable[[Page], Tuple[jax.Array, jax.Array]]
@@ -259,9 +260,31 @@ def _rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
 
 
 def _to_double(data: jax.Array, t: Type) -> jax.Array:
+    if t.is_long_decimal:
+        from presto_tpu.ops import decimal128 as d128
+
+        return d128.to_double(data, t.scale)
     if t.is_decimal:
         return data.astype(jnp.float64) / (10.0 ** t.scale)
     return data.astype(jnp.float64)
+
+
+def _to_long_limbs(data: jax.Array, t: Type, from_scale: int, to_scale: int) -> jax.Array:
+    """Coerce a short/long decimal (or integer) column to long-decimal
+    limbs at the target scale."""
+    from presto_tpu.ops import decimal128 as d128
+
+    if t.is_long_decimal:
+        return d128.rescale(data, from_scale, to_scale)
+    return d128.rescale(d128.from_int64(data.astype(jnp.int64)), from_scale, to_scale)
+
+
+def _where_rows(cond: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Row-mask select that broadcasts over per-value trailing dims
+    (long-decimal limbs)."""
+    if a.ndim > cond.ndim:
+        cond = cond.reshape(cond.shape + (1,) * (a.ndim - cond.ndim))
+    return jnp.where(cond, a, b)
 
 
 def _trunc_div(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -349,6 +372,10 @@ class ExprCompiler:
             return self._compile_arith(expr)
         if fn == "neg":
             (a,) = [self.compile(x) for x in expr.args]
+            if expr.type.is_long_decimal:
+                from presto_tpu.ops import decimal128 as d128
+
+                return lambda page: ((lambda dv: (d128.neg(dv[0]), dv[1]))(a(page)))
             return lambda page: ((lambda dv: (-dv[0], dv[1]))(a(page)))
         if fn in ("year", "month", "day"):
             return self._compile_datepart(expr)
@@ -370,7 +397,7 @@ class ExprCompiler:
                 dt2 = self._coerce(dt, tt, out_t)
                 df2 = self._coerce(df, ft, out_t)
                 cond = dc & vc
-                return jnp.where(cond, dt2, df2), jnp.where(cond, vt, vf)
+                return _where_rows(cond, dt2, df2), jnp.where(cond, vt, vf)
 
             return run_if
         if fn == "case":
@@ -389,7 +416,7 @@ class ExprCompiler:
                         data, valid = d, v
                     else:
                         take = jnp.logical_not(valid) & v
-                        data = jnp.where(take, d, data)
+                        data = _where_rows(take, d, data)
                         valid = valid | v
                 return data, valid
 
@@ -404,6 +431,8 @@ class ExprCompiler:
 
             def run_cast_bigint(page):
                 d, v = a(page)
+                if t.is_long_decimal:
+                    return self._coerce(d, t, BIGINT_T), v
                 if t.is_decimal:
                     d = d // (10 ** t.scale)
                 return d.astype(jnp.int64), v
@@ -440,6 +469,28 @@ class ExprCompiler:
             return self._compile_string_bool_lut(expr)
         if fn in ("hll_bucket", "hll_rho"):
             return self._compile_hll(expr)
+        if fn == "cast_decimal":
+            (a,) = [self.compile(x) for x in expr.args[:1]]
+            t0 = expr.args[0].type
+            out_t = expr.type
+
+            def run_cast_decimal(page):
+                d, v = a(page)
+                if t0.name == "double":
+                    if out_t.is_long_decimal:
+                        # scale in limb space: hi/lo split of the scaled
+                        # float stays within int64 for any p<=36 value
+                        from presto_tpu.ops import decimal128 as d128
+
+                        scaled = jnp.round(d * (10.0 ** out_t.scale))
+                        hi = jnp.floor(scaled / float(d128.BASE))
+                        lo = scaled - hi * float(d128.BASE)
+                        return d128.normalize(hi.astype(jnp.int64),
+                                              lo.astype(jnp.int64)), v
+                    return jnp.round(d * (10.0 ** out_t.scale)).astype(jnp.int64), v
+                return self._coerce(d, t0, out_t), v
+
+            return run_cast_decimal
         if fn in ("abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10",
                   "power", "pow", "ceil", "ceiling", "floor", "round"):
             return self._compile_math(expr)
@@ -604,6 +655,27 @@ class ExprCompiler:
         a = self.compile(expr.args[0])
         ta = expr.args[0].type
 
+        if ta.is_long_decimal:
+            from presto_tpu.ops import decimal128 as d128
+
+            if fn == "abs":
+                def run_labs(page):
+                    d, v = a(page)
+                    neg = d[..., 0] < 0
+                    return _where_rows(neg, d128.neg(d), d), v
+
+                return run_labs
+            if fn == "sign":
+                def run_lsign(page):
+                    d, v = a(page)
+                    hi, lo = d128.split(d)
+                    s = jnp.where(hi < 0, -1, jnp.where((hi > 0) | (lo > 0), 1, 0))
+                    return s.astype(jnp.int64), v
+
+                return run_lsign
+            # silently-wrong elementwise limb math is worse than an error
+            raise ValueError(f"{fn} on long decimals unsupported (cast first)")
+
         if fn in ("power", "pow"):
             b = self.compile(expr.args[1])
             tb = expr.args[1].type
@@ -677,6 +749,13 @@ class ExprCompiler:
                 d = self._coerce(d, t, out_t)
                 if data is None:
                     data, valid = d, v
+                elif out_t.is_long_decimal:
+                    from presto_tpu.ops import decimal128 as d128
+
+                    lt, _, _ = d128.compare(d, data)
+                    take_d = ~lt if take_max else lt  # ties keep either
+                    data = _where_rows(take_d, d, data)
+                    valid = valid & v
                 else:
                     data = jnp.maximum(data, d) if take_max else jnp.minimum(data, d)
                     valid = valid & v  # NULL if any argument is NULL (Presto)
@@ -697,11 +776,25 @@ class ExprCompiler:
             def run_null(page):
                 n = page.capacity
                 return (
-                    jnp.zeros(n, dtype=t.np_dtype),
+                    jnp.zeros((n,) + t.value_shape, dtype=t.np_dtype),
                     jnp.zeros(n, dtype=jnp.bool_),
                 )
 
             return run_null
+
+        if t.is_long_decimal:
+            from presto_tpu.ops.decimal128 import encode_py
+
+            limbs = encode_py([int(val)], 1)[0]
+
+            def run_llit(page):
+                n = page.capacity
+                return (
+                    jnp.broadcast_to(jnp.asarray(limbs), (n, 2)),
+                    jnp.ones(n, dtype=jnp.bool_),
+                )
+
+            return run_llit
 
         def run_lit(page):
             n = page.capacity
@@ -753,6 +846,24 @@ class ExprCompiler:
         a, b = self.compile(lhs), self.compile(rhs)
         ta, tb = lhs.type, rhs.type
         op = expr.fn
+
+        if (ta.is_long_decimal or tb.is_long_decimal) \
+                and "double" not in (ta.name, tb.name):
+            # (a double operand compares in double space via _align_pair)
+            from presto_tpu.ops import decimal128 as d128
+
+            s = max(ta.scale if ta.is_decimal else 0, tb.scale if tb.is_decimal else 0)
+
+            def run_lcmp(page):
+                (da, va), (db, vb) = a(page), b(page)
+                la = _to_long_limbs(da, ta, ta.scale if ta.is_decimal else 0, s)
+                lb = _to_long_limbs(db, tb, tb.scale if tb.is_decimal else 0, s)
+                lt, eq, gt = d128.compare(la, lb)
+                d = {"eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
+                     "gt": gt, "ge": gt | eq}[op]
+                return d, va & vb
+
+            return run_lcmp
 
         def run_cmp(page):
             (da, va), (db, vb) = a(page), b(page)
@@ -900,6 +1011,28 @@ class ExprCompiler:
                 if op in ("div", "mod"):
                     valid = valid & (db2 != 0)
                 return d, valid
+            if tr.is_long_decimal:
+                from presto_tpu.ops import decimal128 as d128
+
+                sa = ta.scale if ta.is_decimal else 0
+                sb = tb.scale if tb.is_decimal else 0
+                if op == "mul":
+                    # long x short: exact (result scale = sa + sb);
+                    # long x long products exceed p=36
+                    if ta.is_long_decimal and not tb.is_long_decimal:
+                        return d128.mul_long_short(da, db.astype(jnp.int64)), valid
+                    if tb.is_long_decimal and not ta.is_long_decimal:
+                        return d128.mul_long_short(db, da.astype(jnp.int64)), valid
+                    raise ValueError("long-decimal x long-decimal mul unsupported")
+                da2 = _to_long_limbs(da, ta, sa, tr.scale)
+                db2 = _to_long_limbs(db, tb, sb, tr.scale)
+                d = {
+                    "add": lambda: d128.add(da2, db2),
+                    "sub": lambda: d128.sub(da2, db2),
+                }.get(op)
+                if d is None:
+                    raise ValueError(f"long-decimal {op} unsupported")
+                return d(), valid
             if tr.is_decimal:
                 sa = ta.scale if ta.is_decimal else 0
                 sb = tb.scale if tb.is_decimal else 0
@@ -1162,7 +1295,7 @@ class ExprCompiler:
                 (td, tv) = tf(page)
                 td = self._coerce(td, tt, out_t)
                 cond = wd & wv & jnp.logical_not(taken)
-                data = jnp.where(cond, td, data)
+                data = _where_rows(cond, td, data)
                 valid = jnp.where(cond, tv, valid)
                 taken = taken | (wd & wv)
             return data, valid
@@ -1194,10 +1327,23 @@ class ExprCompiler:
             return data.astype(jnp.int64) * MICROS_PER_DAY
         if to_t.name == "double":
             return _to_double(data, from_t)
+        if to_t.is_long_decimal:
+            fs = from_t.scale if from_t.is_decimal else 0
+            return _to_long_limbs(data, from_t, fs, to_t.scale)
         if to_t.is_decimal:
+            if from_t.is_long_decimal:
+                from presto_tpu.ops import decimal128 as d128
+
+                limbs = d128.rescale(data, from_t.scale, to_t.scale)
+                return limbs[..., 0] * d128.BASE + limbs[..., 1]  # narrow
             fs = from_t.scale if from_t.is_decimal else 0
             return _rescale(data.astype(jnp.int64), fs, to_t.scale)
         if to_t.name == "bigint":
+            if from_t.is_long_decimal:
+                from presto_tpu.ops import decimal128 as d128
+
+                limbs = d128.rescale(data, from_t.scale or 0, 0)
+                return limbs[..., 0] * d128.BASE + limbs[..., 1]  # exact in range
             return data.astype(jnp.int64)
         return data
 

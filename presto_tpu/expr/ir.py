@@ -108,11 +108,16 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
             bd = b if b.is_decimal else DecimalType(18, 0)
             if a.name == "double" or b.name == "double":
                 return DOUBLE
+            # long operands stay long (two-limb); short stays short —
+            # deviation: the reference widens short x short products
+            # past p=18 automatically, here that needs an explicit cast
+            long_ = ad.is_long_decimal or bd.is_long_decimal
+            p = 36 if long_ else 18
             if fn == "mul":
-                return DecimalType(18, ad.scale + bd.scale)
+                return DecimalType(p, ad.scale + bd.scale)
             if fn == "div":
                 return DOUBLE  # deviation: reference returns decimal
-            return DecimalType(18, max(ad.scale, bd.scale))
+            return DecimalType(p, max(ad.scale, bd.scale))
         if fn == "div" and a.name != "double" and b.name != "double":
             return common_super_type(a, b)  # integer division stays integral
         return common_super_type(a, b)
@@ -184,6 +189,8 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return DOUBLE
     if fn == "cast_bigint":
         return BIGINT
+    if fn == "cast_decimal":
+        return DecimalType(int(args[1].value), int(args[2].value))
     if fn == "substr":
         return ts[0]  # dictionary codes pass through; values derive
     raise KeyError(f"unknown function {fn} for types {ts}")

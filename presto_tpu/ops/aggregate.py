@@ -60,7 +60,7 @@ HLL_M = 1 << 12
 
 def _sum_type(t: Type) -> Type:
     if t.is_decimal:
-        return DecimalType(18, t.scale)
+        return DecimalType(36 if t.is_long_decimal else 18, t.scale)
     if t.name == "double":
         return DOUBLE
     return BIGINT
@@ -145,12 +145,21 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
         cnt = _seg_sum(nonnull.astype(jnp.int64), gid_nn, n + 1)[:n]
         if agg.fn == "count":
             out.append([cnt])
+        elif agg.fn in ("sum", "avg") and agg.arg.type.is_long_decimal:
+            from presto_tpu.ops import decimal128 as d128
+
+            limbs = d128.to_sum_limbs(data)
+            limbs = jnp.where(nonnull[:, None], limbs, 0)
+            s = d128.from_sum_limbs(_seg_sum(limbs, gid_nn, n + 1)[:n])
+            out.append([s, cnt])
         elif agg.fn in ("sum", "avg"):
             st = _sum_type(agg.arg.type)
             vals = data.astype(st.np_dtype)
             vals = jnp.where(nonnull, vals, jnp.zeros_like(vals))
             s = _seg_sum(vals, gid_nn, n + 1)[:n]
             out.append([s, cnt])
+        elif agg.fn in ("min", "max") and agg.arg.type.is_long_decimal:
+            out.append(_minmax_long(agg.fn, data, nonnull, gid_nn, n) + [cnt])
         elif agg.fn in ("min", "max"):
             if agg.fn == "min":
                 fill = _type_max(agg.arg.type)
@@ -228,11 +237,29 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
     for agg, cols in zip(aggs, state_cols):
         if agg.fn in ("count", "count_star"):
             out.append([_seg_sum(cols[0], gid, n + 1)[:n]])
+        elif agg.fn in ("sum", "avg") and agg.arg is not None \
+                and agg.arg.type.is_long_decimal:
+            from presto_tpu.ops import decimal128 as d128
+
+            live_rows = cols[1] > 0
+            limbs = jnp.where(live_rows[:, None], d128.to_sum_limbs(cols[0]), 0)
+            out.append([
+                d128.from_sum_limbs(_seg_sum(limbs, gid, n + 1)[:n]),
+                _seg_sum(cols[1], gid, n + 1)[:n],
+            ])
         elif agg.fn in ("sum", "avg"):
             out.append([
                 _seg_sum(cols[0], gid, n + 1)[:n],
                 _seg_sum(cols[1], gid, n + 1)[:n],
             ])
+        elif agg.fn in ("min", "max") and agg.arg is not None \
+                and agg.arg.type.is_long_decimal:
+            nonnull = cols[1] > 0
+            gid_nn = jnp.where(nonnull, gid, n)
+            out.append(
+                _minmax_long(agg.fn, cols[0], nonnull, gid_nn, n)
+                + [_seg_sum(cols[1], gid, n + 1)[:n]]
+            )
         elif agg.fn == "min":
             out.append([
                 jax.ops.segment_min(cols[0], gid, num_segments=n + 1)[:n],
@@ -343,9 +370,14 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
         elif agg.fn == "avg":
             s, cnt = cols
             st = _sum_type(agg.arg.type)
-            num = s.astype(jnp.float64)
-            if st.is_decimal:
-                num = num / (10.0 ** st.scale)
+            if st.is_long_decimal:
+                from presto_tpu.ops import decimal128 as d128
+
+                num = d128.to_double(s, st.scale)
+            else:
+                num = s.astype(jnp.float64)
+                if st.is_decimal:
+                    num = num / (10.0 ** st.scale)
             d = num / jnp.maximum(cnt, 1).astype(jnp.float64)
             blocks.append(Block(d, cnt > 0, t))
         elif agg.fn in ("min", "max"):
@@ -404,6 +436,21 @@ def _type_min(t: Type):
     return jnp.asarray(jnp.finfo(jnp.float64).min if t.name == "double" else -_I64_MAX - 1).astype(t.np_dtype)
 
 
+def _minmax_long(fn: str, data, nonnull, gid_nn, n):
+    """Two-phase lexicographic extreme over (hi, lo) limb pairs — limb
+    order IS value order (lo canonical in [0, 10^18))."""
+    hi, lo = data[..., 0], data[..., 1]
+    if fn == "min":
+        red, fill = jax.ops.segment_min, _I64_MAX
+    else:
+        red, fill = jax.ops.segment_max, -_I64_MAX - 1
+    hi_best = red(jnp.where(nonnull, hi, fill), gid_nn, num_segments=n + 1)[:n]
+    tie = nonnull & (hi == hi_best[jnp.clip(gid_nn, 0, n - 1)])
+    gid_tie = jnp.where(tie, gid_nn, n)
+    lo_best = red(jnp.where(tie, lo, fill), gid_tie, num_segments=n + 1)[:n]
+    return [jnp.stack([hi_best, lo_best], axis=-1)]
+
+
 # ---------------------------------------------------------------------------
 # group id assignment
 # ---------------------------------------------------------------------------
@@ -438,6 +485,11 @@ def pack_or_hash_keys(datas, valids, domains) -> Tuple[jax.Array, bool]:
     run at native width."""
     if not datas:
         return None, True
+    for d in datas:
+        if d.ndim > 1:
+            raise ValueError(
+                "long-decimal grouping/join keys unsupported (cast to "
+                "a shorter decimal or double)")
     if domains is not None and all(d is not None for d in domains):
         codes, cards = _key_codes(datas, valids, domains)
         prod = 1

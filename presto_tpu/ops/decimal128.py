@@ -1,0 +1,185 @@
+"""Long-decimal (p <= 36) limb arithmetic.
+
+Reference analog: ``presto-spi/.../type/Decimals.java`` +
+``UnscaledDecimal128Arithmetic.java`` — the reference packs 128-bit
+unscaled values into two java longs and implements add/compare/rescale
+over them.  TPU redesign: limbs are **base 10^18** signed int64 arrays
+(`value = hi * 10^18 + lo`, invariant `0 <= lo < 10^18`), so every
+carry/borrow is a native vector op — no 128-bit emulation, no byte
+swizzles, and decimal rescaling by powers of ten stays exact.
+
+Device layout: a long-decimal Block's data has shape (capacity, 2) with
+[:, 0] = hi, [:, 1] = lo.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASE = 10 ** 18
+_B9 = 10 ** 9
+
+
+# -- host-side encode/decode --------------------------------------------------
+
+def encode_py(values, capacity: int) -> np.ndarray:
+    """Python ints (arbitrary precision) -> (capacity, 2) limbs."""
+    out = np.zeros((capacity, 2), dtype=np.int64)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        hi, lo = divmod(int(v), BASE)  # python divmod: 0 <= lo < BASE
+        out[i, 0] = hi
+        out[i, 1] = lo
+    return out
+
+
+def decode_py(limbs: np.ndarray):
+    """(n, 2) limbs -> list of python ints."""
+    return [int(h) * BASE + int(l) for h, l in np.asarray(limbs, dtype=np.int64)]
+
+
+# -- normalization ------------------------------------------------------------
+
+def normalize(hi: jax.Array, lo: jax.Array) -> jax.Array:
+    """Restore the 0 <= lo < BASE invariant after add/sub; returns
+    stacked (..., 2)."""
+    carry = jnp.floor_divide(lo, BASE)
+    lo = lo - carry * BASE
+    hi = hi + carry
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def split(d: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return d[..., 0], d[..., 1]
+
+
+# -- arithmetic ---------------------------------------------------------------
+
+def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    ah, al = split(a)
+    bh, bl = split(b)
+    return normalize(ah + bh, al + bl)  # lo sums < 2*BASE: no int64 overflow
+
+
+def neg(a: jax.Array) -> jax.Array:
+    ah, al = split(a)
+    return normalize(-ah, -al)
+
+
+def sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    ah, al = split(a)
+    bh, bl = split(b)
+    return normalize(ah - bh, al - bl)
+
+
+def from_int64(x: jax.Array) -> jax.Array:
+    """Short (int64) value -> limbs."""
+    return normalize(jnp.zeros_like(x), x)
+
+
+def mul_small(a: jax.Array, k: jax.Array) -> jax.Array:
+    """Multiply limbs by a small int64 (|k| <= ~4*10^9, e.g. rescale
+    powers of ten): split lo into base-10^9 halves so every partial
+    product fits int64."""
+    ah, al = split(a)
+    l1, l0 = jnp.floor_divide(al, _B9), jnp.remainder(al, _B9)
+    p0 = l0 * k  # < 10^9 * 4*10^9 < 9.2*10^18 OK
+    p1 = l1 * k
+    # p1 contributes at 10^9: fold its overflow beyond 10^9 into hi
+    c1 = jnp.floor_divide(p1, _B9)
+    r1 = p1 - c1 * _B9
+    return normalize(ah * k + c1, r1 * _B9 + p0)
+
+
+def mul_int64(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Full product of two int64 scaled values (|a|,|b| < 10^18) ->
+    limbs. Schoolbook over base-10^9 halves; every partial < 10^18."""
+    a1, a0 = jnp.floor_divide(a, _B9), jnp.remainder(a, _B9)
+    b1, b0 = jnp.floor_divide(b, _B9), jnp.remainder(b, _B9)
+    # value = a1*b1*10^18 + (a1*b0 + a0*b1)*10^9 + a0*b0
+    cross = a1 * b0 + a0 * b1  # < 2*10^18 OK
+    c_hi = jnp.floor_divide(cross, _B9)
+    c_lo = cross - c_hi * _B9
+    return normalize(a1 * b1 + c_hi, c_lo * _B9 + a0 * b0)
+
+
+def mul_long_short(a: jax.Array, k: jax.Array) -> jax.Array:
+    """Long limbs x int64 scaled value: (hi*B + lo)*k = (hi*k)*B + lo*k,
+    with lo*k going through the full int64 multiplier. Exact whenever
+    the result fits p<=36 (hi*k then < 10^18)."""
+    ah, al = split(a)
+    low = mul_int64(al, k)
+    lh, ll = split(low)
+    return normalize(ah * k + lh, ll)
+
+
+def rescale(a: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
+    if to_scale > from_scale:
+        k = to_scale - from_scale
+        out = a
+        while k > 0:  # static python loop: at most a few steps of 10^9
+            step = min(k, 9)
+            out = mul_small(out, jnp.asarray(10 ** step, jnp.int64))
+            k -= step
+        return out
+    if to_scale < from_scale:
+        k = from_scale - to_scale
+        if k > 18:
+            raise ValueError("long-decimal downscale beyond 18 digits unsupported")
+        d = 10 ** k  # k <= 18: divides BASE exactly
+        ah, al = split(a)
+        # floor((hi*BASE + lo)/d) = hi*(BASE/d) + floor(lo/d): the first
+        # term can exceed 10^18, so it goes through the limb multiplier
+        m = jnp.broadcast_to(jnp.asarray(BASE // d, jnp.int64), ah.shape)
+        return add(mul_int64(ah, m), from_int64(jnp.floor_divide(al, d)))
+    return a
+
+
+def compare(a: jax.Array, b: jax.Array):
+    """(lt, eq, gt) boolean triples — limb order is value order since
+    lo is canonical."""
+    ah, al = split(a)
+    bh, bl = split(b)
+    lt = (ah < bh) | ((ah == bh) & (al < bl))
+    eq = (ah == bh) & (al == bl)
+    return lt, eq, ~(lt | eq)
+
+
+def to_double(a: jax.Array, scale: int) -> jax.Array:
+    ah, al = split(a)
+    return (ah.astype(jnp.float64) * float(BASE) + al.astype(jnp.float64)) / (10.0 ** scale)
+
+
+# -- aggregation support -------------------------------------------------------
+
+def to_sum_limbs(a: jax.Array) -> jax.Array:
+    """(n, 2) base-10^18 -> (n, 4) base-10^9 limbs, safe to segment_sum
+    over ~9*10^9 rows without int64 overflow."""
+    ah, al = split(a)
+    return jnp.stack([
+        jnp.floor_divide(ah, _B9), jnp.remainder(ah, _B9),
+        jnp.floor_divide(al, _B9), jnp.remainder(al, _B9),
+    ], axis=-1)
+
+
+def from_sum_limbs(s: jax.Array) -> jax.Array:
+    """(n, 4) summed base-10^9 limbs -> normalized (n, 2)."""
+    h1, h0, l1, l0 = s[..., 0], s[..., 1], s[..., 2], s[..., 3]
+    # fold base-10^9 carries upward
+    c = jnp.floor_divide(l0, _B9)
+    l0 = l0 - c * _B9
+    l1 = l1 + c
+    c = jnp.floor_divide(l1, _B9)
+    l1 = l1 - c * _B9
+    hi_extra = c
+    lo = l1 * _B9 + l0
+    c = jnp.floor_divide(h0, _B9)
+    h0 = h0 - c * _B9
+    h1 = h1 + c
+    hi = h1 * _B9 + h0 + hi_extra
+    return normalize(hi, lo)

@@ -207,7 +207,8 @@ def outer_build_tail(
     blocks: List[Block] = []
     for t, d in probe_types_dicts:
         blocks.append(
-            Block(jnp.zeros(cap, dtype=t.np_dtype), jnp.zeros(cap, dtype=jnp.bool_), t, d)
+            Block(jnp.zeros((cap,) + t.value_shape, dtype=t.np_dtype),
+                  jnp.zeros(cap, dtype=jnp.bool_), t, d)
         )
     if build_output is None:
         build_output = range(len(build.page.blocks))

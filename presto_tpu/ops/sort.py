@@ -25,6 +25,10 @@ def _value_key(data: jax.Array, ascending: bool) -> jax.Array:
     (no float64 round-trip — BIGINT/DECIMAL beyond 2^53 must order
     exactly); descending integers use bitwise complement (~x = -x-1,
     overflow-free), descending floats negate."""
+    if data.ndim > 1:
+        raise ValueError(
+            "long-decimal sort keys unsupported (cast to a shorter "
+            "decimal or double)")
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.int32)
     if jnp.issubdtype(data.dtype, jnp.floating):

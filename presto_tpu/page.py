@@ -111,8 +111,16 @@ class Block:
     ) -> "Block":
         n = len(values)
         cap = capacity if capacity is not None else n
-        data = np.zeros(cap, dtype=type_.np_dtype)
-        data[:n] = values
+        if type_.is_long_decimal and (
+            not isinstance(values, np.ndarray) or values.ndim == 1
+        ):
+            # python ints (possibly > 2^63) -> two base-10^18 limbs
+            from presto_tpu.ops.decimal128 import encode_py
+
+            data = encode_py(list(values), cap)
+        else:
+            data = np.zeros((cap,) + type_.value_shape, dtype=type_.np_dtype)
+            data[:n] = values
         v = np.zeros(cap, dtype=np.bool_)
         v[:n] = True if valid is None else valid
         return cls(jnp.asarray(data), jnp.asarray(v), type_, dictionary)
@@ -170,7 +178,7 @@ class Page:
     def empty(cls, types: Sequence[Type], capacity: int) -> "Page":
         blocks = tuple(
             Block(
-                jnp.zeros(capacity, dtype=t.np_dtype),
+                jnp.zeros((capacity,) + t.value_shape, dtype=t.np_dtype),
                 jnp.zeros(capacity, dtype=jnp.bool_),
                 t,
             )
@@ -206,6 +214,11 @@ class Page:
             valid = np.asarray(b.valid)[rows_idx]
             if b.type.is_string and b.dictionary is not None and decode_strings:
                 vals = b.dictionary.decode(data)
+            elif b.type.is_long_decimal:
+                from presto_tpu.ops.decimal128 import decode_py
+
+                scale = 10.0 ** b.type.scale
+                vals = np.asarray([v / scale for v in decode_py(data)])
             elif b.type.is_decimal:
                 vals = data.astype(np.float64) / (10.0 ** b.type.scale)
             else:

@@ -268,7 +268,8 @@ def serialize_page(page: Page, compress: bool = True) -> bytes:
         data = np.asarray(b.data)[: header["n"]]
         valid = np.asarray(b.valid)[: header["n"]]
         header["types"].append(
-            {"t": type_to_json(b.type), "dtype": str(data.dtype)}
+            {"t": type_to_json(b.type), "dtype": str(data.dtype),
+             "shape": list(data.shape[1:])}
         )
         payload += data.tobytes() + np.packbits(valid).tobytes()
     if compress:
@@ -294,8 +295,10 @@ def deserialize_page(raw: bytes, dictionaries=None) -> Page:
 
     for i, tinfo in enumerate(header["types"]):
         dtype = np.dtype(tinfo["dtype"])
-        nbytes = n * dtype.itemsize
-        data = np.frombuffer(raw[off : off + nbytes], dtype=dtype)
+        vshape = tuple(tinfo.get("shape", ()))
+        vcount = int(np.prod(vshape)) if vshape else 1
+        nbytes = n * vcount * dtype.itemsize
+        data = np.frombuffer(raw[off : off + nbytes], dtype=dtype).reshape((n,) + vshape)
         off += nbytes
         vbytes = (n + 7) // 8
         valid = np.unpackbits(
@@ -305,7 +308,7 @@ def deserialize_page(raw: bytes, dictionaries=None) -> Page:
         t = type_from_json(tinfo["t"])
         dic = dictionaries[i] if dictionaries is not None else None
         cap = max(n, 1)
-        d = np.zeros(cap, dtype=dtype)
+        d = np.zeros((cap,) + vshape, dtype=dtype)
         d[:n] = data
         v = np.zeros(cap, dtype=bool)
         v[:n] = valid

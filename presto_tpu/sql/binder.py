@@ -1421,7 +1421,15 @@ class Binder:
                     return Literal(type=TIMESTAMP, value=_parse_timestamp(v.value))
                 return call("cast_timestamp", v)
             if tn.startswith("decimal"):
-                return v  # decimal arithmetic already exact
+                from presto_tpu.types import parse_type
+
+                t = parse_type(tn)
+                if v.type.is_decimal and v.type.scale == t.scale \
+                        and v.type.is_long_decimal == t.is_long_decimal:
+                    return v
+                return call("cast_decimal", v,
+                            Literal(type=BIGINT, value=t.precision or 18),
+                            Literal(type=BIGINT, value=t.scale or 0))
             raise BindError(f"unsupported CAST to {e.type_name}")
 
         if isinstance(e, ast.Extract):
@@ -1465,11 +1473,20 @@ class Binder:
         raise BindError(f"cannot bind {e!r}")
 
     def _bind_number(self, text: str) -> Literal:
-        if "." in text or "e" in text.lower():
-            frac = text.split(".", 1)[1] if "." in text else ""
+        if "e" in text.lower():
+            return Literal(type=DOUBLE, value=float(text))
+        if "." in text:
+            # exact digit parse (float round-trips lose precision past
+            # 15-16 digits); > 18 digits becomes a long decimal
+            whole, frac = text.split(".", 1)
             scale = len(frac)
-            scaled = int(round(float(text) * (10 ** scale)))
-            return Literal(type=DecimalType(18, scale), value=scaled)
+            scaled = int((whole + frac) or "0")
+            digits = len((whole + frac).lstrip("+-").lstrip("0")) or 1
+            precision = max(digits, scale)
+            if precision > 36:
+                raise BindError(f"decimal literal exceeds 36 digits: {text}")
+            return Literal(type=DecimalType(36 if precision > 18 else 18, scale),
+                           value=scaled)
         return Literal(type=BIGINT, value=int(text))
 
     def _bind_date_arith(self, e: ast.Binary, scope: Scope, agg) -> Expr:

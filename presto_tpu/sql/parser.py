@@ -19,7 +19,7 @@ from presto_tpu.sql import ast
 _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+|--[^\n]*)
-  | (?P<number>\d+\.\d*|\.\d+|\d+)
+  | (?P<number>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
   | (?P<op><>|!=|<=|>=|\|\||[,().;+\-*/%<>=])

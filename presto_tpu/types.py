@@ -19,8 +19,11 @@ Representation decisions (TPU-first):
                        (reference TimestampType.java stores epoch
                        millis; micros here so device datetime math
                        never loses sub-ms precision)
-  DECIMAL(p<=18,s)  -> int64 scaled by 10**s ("short decimal"; reference
-                       long decimals use 2x64-bit — out of scope v0)
+  DECIMAL(p<=18,s)  -> int64 scaled by 10**s ("short decimal")
+  DECIMAL(p<=36,s)  -> (capacity, 2) int64 limbs: value = hi*10^18 + lo
+                       with lo in [0, 10^18) ("long decimal"; reference
+                       uses 2x64-bit UnscaledDecimal128 — base-10^18
+                       limbs here keep every carry in native int64 ops)
   VARCHAR           -> int32 dictionary code per row + host-side
                        ``Dictionary`` of unique strings.  TPC-H string
                        columns are low-cardinality or only ever touched
@@ -68,6 +71,16 @@ class Type:
         return self.name == "decimal"
 
     @property
+    def is_long_decimal(self) -> bool:
+        return self.name == "decimal" and (self.precision or 0) > 18
+
+    @property
+    def value_shape(self) -> tuple:
+        """Trailing per-value shape of the device array ((2,) for
+        two-limb long decimals, () for everything else)."""
+        return (2,) if self.is_long_decimal else ()
+
+    @property
     def is_string(self) -> bool:
         return self.dictionary
 
@@ -94,13 +107,18 @@ MICROS_PER_DAY = 86_400_000_000
 VARCHAR = Type("varchar", np.dtype(np.int32), dictionary=True)
 
 
-def DecimalType(precision: int = 18, scale: int = 0) -> Type:
-    """Short decimal: int64 scaled by 10**scale.
+LONG_DECIMAL_BASE = 10 ** 18
 
-    Reference: spi/type/DecimalType.java (short decimals, p <= 18).
+
+def DecimalType(precision: int = 18, scale: int = 0) -> Type:
+    """Scaled-integer decimal: int64 for p <= 18, two base-10^18 limbs
+    for p <= 36.
+
+    Reference: spi/type/DecimalType.java + spi/type/Decimals.java
+    (short = long java primitive, long = Slice-backed 128-bit).
     """
-    if precision > 18:
-        raise ValueError("only short decimals (precision <= 18) supported")
+    if precision > 36:
+        raise ValueError("decimal precision > 36 unsupported")
     return Type("decimal", np.dtype(np.int64), scale=scale, precision=precision)
 
 
@@ -117,7 +135,8 @@ def common_super_type(a: Type, b: Type) -> Type:
         loser = b if winner is a else a
         if winner.is_decimal and loser.is_decimal:
             scale = max(a.scale, b.scale)
-            return DecimalType(18, scale)
+            long_ = a.is_long_decimal or b.is_long_decimal
+            return DecimalType(36 if long_ else 18, scale)
         if winner.is_decimal and loser.name in ("bigint", "integer"):
             return winner
         return winner

@@ -1,0 +1,184 @@
+"""Long DECIMAL (precision 19-36): two-limb base-10^18 arithmetic
+end-to-end through the SQL surface, exactness-checked against python
+ints/Decimal.
+
+Reference analog: spi/type/Decimals.java + UnscaledDecimal128Arithmetic
+and TestDecimalOperators (128-bit add/sub/compare/aggregate)."""
+
+import random
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.page import Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import BIGINT, DecimalType
+
+SCALE = 4
+T = DecimalType(30, SCALE)  # long: 30 digits, scale 4
+
+random.seed(11)
+VALUES = [random.randint(-10**28, 10**28) for _ in range(500)] + [
+    0, 1, -1, 10**18, -(10**18), 10**27,
+]
+
+
+@pytest.fixture(scope="module")
+def runner():
+    mem = MemoryConnector()
+    page = Page.from_arrays(
+        [np.arange(len(VALUES), dtype=np.int64), VALUES],
+        [BIGINT, T],
+    )
+    mem.create_table("big", [("id", BIGINT), ("x", T)], [page])
+    catalog = Catalog()
+    catalog.register("mem", mem)
+    return QueryRunner(catalog)
+
+
+def as_float(v: int) -> float:
+    return float(Decimal(v) / Decimal(10**SCALE))
+
+
+def test_roundtrip_and_filter(runner):
+    rows = runner.execute("select count(*) from big").rows
+    assert rows == [(len(VALUES),)]
+    n_pos = sum(1 for v in VALUES if v > 0)
+    assert runner.execute("select count(*) from big where x > 0").rows == [(n_pos,)]
+    # compare against a long literal with full precision
+    thresh = 10**27  # scaled; literal below has 23 int digits + 4 frac
+    lit = "1" + "0" * 22 + ".0000"
+    n_gt = sum(1 for v in VALUES if v > int(lit.replace(".", "")))
+    assert runner.execute(
+        f"select count(*) from big where x > {lit}").rows == [(n_gt,)]
+
+
+def test_exact_sum(runner):
+    """The headline: sums beyond int64/float53 stay exact."""
+    got = runner.execute("select sum(x) from big").rows[0][0]
+    exact = sum(VALUES)
+    assert got == pytest.approx(as_float(exact), rel=1e-15)
+    # the underlying value is exact: compare through the plan output page
+    from presto_tpu.sql.binder import Binder
+
+    plan = Binder(runner.catalog).plan("select sum(x) from big")
+    page = runner.executor.run_to_page(plan)
+    from presto_tpu.ops.decimal128 import decode_py
+
+    limbs = np.asarray(page.blocks[0].data)[:1]
+    assert decode_py(limbs)[0] == exact
+
+
+def test_add_sub_mul_between_long_and_short(runner):
+    rows = runner.execute(
+        "select id, x + 1.5, x - x, x + x from big where id < 5 order by id").rows
+    for (i, plus, zero, double) in rows:
+        v = VALUES[i]
+        assert zero == 0.0
+        assert plus == pytest.approx(as_float(v + 15000), rel=1e-12)
+        assert double == pytest.approx(as_float(2 * v), rel=1e-12)
+
+
+def test_short_mul_overflow_via_cast(runner):
+    """cast to a long decimal makes 18+18-digit products exact."""
+    got = runner.execute(
+        "select sum(cast(x as decimal(36, 4))) from big where id < 100").rows[0][0]
+    exact = sum(VALUES[:100])
+    assert got == pytest.approx(as_float(exact), rel=1e-15)
+
+
+def test_min_max_avg(runner):
+    got = runner.execute("select min(x), max(x), avg(x) from big").rows[0]
+    assert got[0] == pytest.approx(as_float(min(VALUES)), rel=1e-15)
+    assert got[1] == pytest.approx(as_float(max(VALUES)), rel=1e-15)
+    assert got[2] == pytest.approx(
+        float(Decimal(sum(VALUES)) / len(VALUES) / 10**SCALE), rel=1e-12)
+
+
+def test_grouped_long_sum(runner):
+    got = dict(runner.execute(
+        "select mod(id, 7), sum(x) from big group by mod(id, 7)").rows)
+    for k in range(7):
+        exact = sum(v for i, v in enumerate(VALUES) if i % 7 == k)
+        assert got[k] == pytest.approx(as_float(exact), rel=1e-15), k
+
+
+def test_case_and_null_handling(runner):
+    got = runner.execute(
+        "select sum(case when x > 0 then x end) from big").rows[0][0]
+    exact = sum(v for v in VALUES if v > 0)
+    assert got == pytest.approx(as_float(exact), rel=1e-15)
+
+
+def test_long_decimal_key_rejected(runner):
+    with pytest.raises(Exception, match="long-decimal"):
+        runner.execute("select x, count(*) from big group by x")
+    with pytest.raises(Exception, match="long-decimal"):
+        runner.execute("select * from big order by x")
+
+
+def test_cast_down_to_short(runner):
+    # only values that fit p=18 post-cast (narrowing overflow wraps,
+    # like short-decimal arithmetic overflow)
+    rows = runner.execute(
+        "select id, cast(x as decimal(18, 2)) from big"
+        " where x between -999999999999.0 and 999999999999.0 order by id").rows
+    assert rows  # the fixed sentinel values 0/1/-1 qualify
+    for i, v in rows:
+        assert v == pytest.approx(float(VALUES[i] // 100) / 100.0, rel=1e-12)
+
+
+def test_review_edge_semantics(runner):
+    """neg canonical form, abs/sign, greatest/least, double compare,
+    exact bigint cast, long x short products, coalesce supertype."""
+    # unary minus keeps compare order (canonical limbs)
+    n = runner.execute(
+        "select count(*) from big where -x < x").rows[0][0]
+    assert n == sum(1 for v in VALUES if -v < v)
+    # abs / sign
+    rows = runner.execute(
+        "select id, abs(x), sign(x) from big where id < 20 order by id").rows
+    for i, av, sv in rows:
+        assert av == pytest.approx(as_float(abs(VALUES[i])), rel=1e-12)
+        assert sv == (VALUES[i] > 0) - (VALUES[i] < 0)
+    # greatest/least across long values
+    rows = runner.execute(
+        "select id, greatest(x, 0.0000), least(x, 0.0000) from big"
+        " where id < 20 order by id").rows
+    for i, g, l in rows:
+        assert g == pytest.approx(as_float(max(VALUES[i], 0)), rel=1e-12)
+        assert l == pytest.approx(as_float(min(VALUES[i], 0)), rel=1e-12)
+    # compare vs double goes through double space (fractions kept)
+    n = runner.execute(
+        "select count(*) from big where x < 0.5e0").rows[0][0]
+    assert n == sum(1 for v in VALUES if as_float(v) < 0.5)
+    # exact bigint narrowing (above 2^53)
+    got = runner.execute(
+        "select cast(cast(123456789012345678.0000 as decimal(36, 4)) as bigint)"
+    ).rows[0][0]
+    assert got == 123456789012345678
+    # long x short product exact at full width
+    got = runner.execute(
+        "select sum(x * 3) from big").rows[0][0]
+    assert got == pytest.approx(as_float(3 * sum(VALUES)), rel=1e-15)
+    # coalesce keeps the long representation
+    got = runner.execute(
+        "select sum(coalesce(x, 0.0000)) from big").rows[0][0]
+    assert got == pytest.approx(as_float(sum(VALUES)), rel=1e-15)
+    # round() on long decimals fails loudly instead of silently wrong
+    with pytest.raises(Exception, match="long decimal"):
+        runner.execute("select round(x) from big")
+
+
+def test_serde_roundtrip(runner):
+    from presto_tpu.server.serde import deserialize_page, serialize_page
+
+    conn = runner.catalog.connector("mem")
+    page = conn.page_for_split("big", 0)
+    back = deserialize_page(serialize_page(page))
+    a = page.to_pylist(decode_strings=False)
+    b = back.to_pylist(decode_strings=False)
+    assert a == b
