@@ -27,6 +27,7 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from presto_tpu.expr.ir import AggCall, Call, ColumnRef, Expr, Literal
 from presto_tpu.page import Dictionary, Page
@@ -243,6 +244,23 @@ def _transform_null_lut(e: "Call", dictionaries) -> Optional["jnp.ndarray"]:
     return jnp.asarray([not n for n in entry[2]])
 
 
+def _hll_from_hash(h: jax.Array, fn: str) -> jax.Array:
+    """Shared HLL tail over a mixed uint64 hash lane: bucket = top P
+    bits; rho = leading-zero count of the remainder + 1 (sentinel bit
+    caps it)."""
+    P = ExprCompiler.HLL_P
+    if fn == "hll_bucket":
+        return (h >> jnp.uint64(64 - P)).astype(jnp.int64)
+    rest = (h << jnp.uint64(P)) | jnp.uint64(1 << (P - 1))
+    clz = jnp.zeros(h.shape, dtype=jnp.uint64)
+    x = rest
+    for shift in (32, 16, 8, 4, 2, 1):
+        empty = x < (jnp.uint64(1) << jnp.uint64(64 - shift))
+        clz = clz + jnp.where(empty, jnp.uint64(shift), jnp.uint64(0))
+        x = jnp.where(empty, x << jnp.uint64(shift), x)
+    return (clz + jnp.uint64(1)).astype(jnp.int64)
+
+
 def _mix_u64(x: jax.Array) -> jax.Array:
     """splitmix64 finalizer over uint64 lanes (device hash)."""
     x = x.astype(jnp.uint64)
@@ -388,9 +406,11 @@ class ExprCompiler:
 
             return run_dadd
         if fn == "if":
-            c, t, f = [self.compile(x) for x in expr.args]
-            tt, ft = expr.args[1].type, expr.args[2].type
             out_t = expr.type
+            c = self.compile(expr.args[0])
+            t = self._compile_operand(expr.args[1], out_t)
+            f = self._compile_operand(expr.args[2], out_t)
+            tt, ft = expr.args[1].type, expr.args[2].type
 
             def run_if(page):
                 (dc, vc), (dt, vt), (df, vf) = c(page), t(page), f(page)
@@ -403,8 +423,8 @@ class ExprCompiler:
         if fn == "case":
             return self._compile_case(expr)
         if fn == "coalesce":
-            parts = [(self.compile(x), x.type) for x in expr.args]
             out_t = expr.type
+            parts = [(self._compile_operand(x, out_t), x.type) for x in expr.args]
 
             def run_coalesce(page):
                 data = None
@@ -439,6 +459,13 @@ class ExprCompiler:
 
             return run_cast_bigint
         if fn in STRING_TRANSFORM_FNS:
+            if fn == "concat" and any(
+                a.type.is_raw_string for a in expr.args if not isinstance(a, Literal)
+            ):
+                return self._compile_raw_concat(expr)
+            _rc = _transform_column(expr)
+            if _rc is not None and _rc.type.is_raw_string:
+                return self._compile_raw_transform(expr)
             # dictionary codes pass through unchanged; the *values* are
             # transformed host-side once (see _dict_of) — the device
             # never touches bytes (DictionaryAwarePageProjection analog).
@@ -464,8 +491,12 @@ class ExprCompiler:
             return run_derived
         if fn in ("length", "strpos", "codepoint", "json_array_length",
                   "url_extract_port"):
+            if expr.args[0].type.is_raw_string:
+                return self._compile_raw_int_fn(expr)
             return self._compile_string_lut_fn(expr)
         if fn in ("regexp_like", "starts_with", "ends_with", "is_json_scalar"):
+            if expr.args[0].type.is_raw_string:
+                return self._compile_raw_bool(expr)
             return self._compile_string_bool_lut(expr)
         if fn in ("hll_bucket", "hll_rho"):
             return self._compile_hll(expr)
@@ -497,8 +528,18 @@ class ExprCompiler:
         if fn in ("greatest", "least"):
             return self._compile_greatest_least(expr)
         if fn == "nullif":
-            a, b = [self.compile(x) for x in expr.args]
             ta, tb = expr.args[0].type, expr.args[1].type
+            a = self.compile(expr.args[0])
+            b = self._compile_operand(expr.args[1], ta)
+            if ta.is_raw_string:
+                from presto_tpu.ops import rawstring as rs
+
+                def run_nullif_raw(page):
+                    (da, va), (db, vb) = a(page), b(page)
+                    _, eq_ = rs.compare(da, db)
+                    return da, va & jnp.logical_not(va & vb & eq_)
+
+                return run_nullif_raw
 
             def run_nullif(page):
                 (da, va), (db, vb) = a(page), b(page)
@@ -615,6 +656,15 @@ class ExprCompiler:
         t = colref.type
         fn = expr.fn
         canon_lut = None
+        if t.is_raw_string:
+            from presto_tpu.ops.rawstring import hash_bytes
+
+            def run_raw_hll(page):
+                d, v = cf(page)
+                h = _mix_u64(hash_bytes(d).astype(jnp.uint64))
+                return _hll_from_hash(h, fn), v
+
+            return run_raw_hll
         if t.is_string:
             # canonicalize codes to value ids so transforms that map
             # many codes to one value (substr/upper/...) count distinct
@@ -636,17 +686,7 @@ class ExprCompiler:
             else:
                 lane = d.astype(jnp.int64)
             h = _mix_u64(lane.astype(jnp.uint64))
-            if fn == "hll_bucket":
-                return (h >> jnp.uint64(64 - ExprCompiler.HLL_P)).astype(jnp.int64), v
-            # rho: leading-zero count of the remaining 52 bits, +1 (capped)
-            rest = (h << jnp.uint64(ExprCompiler.HLL_P)) | jnp.uint64(1 << (ExprCompiler.HLL_P - 1))
-            clz = jnp.zeros(d.shape, dtype=jnp.uint64)
-            x = rest
-            for shift in (32, 16, 8, 4, 2, 1):
-                empty = x < (jnp.uint64(1) << jnp.uint64(64 - shift))
-                clz = clz + jnp.where(empty, jnp.uint64(shift), jnp.uint64(0))
-                x = jnp.where(empty, x << jnp.uint64(shift), x)
-            return (clz + jnp.uint64(1)).astype(jnp.int64), v
+            return _hll_from_hash(h, fn), v
 
         return run_hll
 
@@ -736,9 +776,28 @@ class ExprCompiler:
 
         return run_math
 
+    def _compile_operand(self, e: Expr, out_t: Type) -> CompiledExpr:
+        """Compile an argument in the context of a raw-string result:
+        dictionary-typed string literals encode to byte rows."""
+        if out_t.is_raw_string and isinstance(e, Literal) and e.type.is_string \
+                and not e.type.is_raw_string:
+            from presto_tpu.ops import rawstring as rs
+
+            width = out_t.value_shape[0]
+            lit = rs.encode_literal(str(e.value), width)
+            null = e.value is None
+
+            def run_rawlit(page):
+                n = page.capacity
+                return (jnp.broadcast_to(lit[None, :], (n, width)),
+                        jnp.zeros(n, jnp.bool_) if null else jnp.ones(n, jnp.bool_))
+
+            return run_rawlit
+        return self.compile(e)
+
     def _compile_greatest_least(self, expr: Call) -> CompiledExpr:
-        parts = [(self.compile(x), x.type) for x in expr.args]
         out_t = expr.type
+        parts = [(self._compile_operand(x, out_t), x.type) for x in expr.args]
         take_max = expr.fn == "greatest"
 
         def run_gl(page):
@@ -754,6 +813,13 @@ class ExprCompiler:
 
                     lt, _, _ = d128.compare(d, data)
                     take_d = ~lt if take_max else lt  # ties keep either
+                    data = _where_rows(take_d, d, data)
+                    valid = valid & v
+                elif out_t.is_raw_string:
+                    from presto_tpu.ops import rawstring as rs
+
+                    lt, eq = rs.compare(d, data)
+                    take_d = ~(lt | eq) if take_max else lt
                     data = _where_rows(take_d, d, data)
                     valid = valid & v
                 else:
@@ -883,6 +949,8 @@ class ExprCompiler:
     def _compile_string_cmp(self, expr: Call) -> CompiledExpr:
         lhs, rhs = expr.args
         op = expr.fn
+        if lhs.type.is_raw_string or rhs.type.is_raw_string:
+            return self._compile_raw_cmp(expr)
         if isinstance(rhs, Literal):
             colref, s = lhs, rhs.value
         elif isinstance(lhs, Literal):
@@ -947,9 +1015,186 @@ class ExprCompiler:
 
         return run_ord
 
+    # ------------------------------------------------------------------
+    # raw (non-dictionary) varchar paths
+    # ------------------------------------------------------------------
+
+    def _compile_raw_cmp(self, expr: Call) -> CompiledExpr:
+        from presto_tpu.ops import rawstring as rs
+
+        lhs, rhs = expr.args
+        op = expr.fn
+        if isinstance(rhs, Literal):
+            col, lit = lhs, rhs
+        elif isinstance(lhs, Literal):
+            col, lit = rhs, lhs
+            op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
+        else:
+            if not (lhs.type.is_raw_string and rhs.type.is_raw_string):
+                raise ValueError("raw-vs-dictionary string comparison unsupported")
+            a, b = self.compile(lhs), self.compile(rhs)
+
+            def run_rcc(page):
+                (da, va), (db, vb) = a(page), b(page)
+                lt, eq = rs.compare(da, db)
+                d = {"eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
+                     "gt": ~(lt | eq), "ge": ~lt}[op]
+                return d, va & vb
+
+            return run_rcc
+        cf = self.compile(col)
+        width = col.type.value_shape[0]
+        lit_bytes = rs.encode_literal(str(lit.value), max(width, len(str(lit.value).encode())))
+
+        def run_rcl(page):
+            d, v = cf(page)
+            lt, eq = rs.compare(d, lit_bytes[None, :])
+            out = {"eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
+                   "gt": ~(lt | eq), "ge": ~lt}[op]
+            return out, v
+
+        return run_rcl
+
+    def _compile_raw_transform(self, expr: Call) -> CompiledExpr:
+        """Value transforms on raw varchar: substr/upper/lower run on
+        device; everything else reuses the host transform through a
+        per-page callback."""
+        from presto_tpu.ops import rawstring as rs
+
+        fn = expr.fn
+        col = _transform_column(expr)
+        if col is None:
+            raise KeyError(f"cannot compile string transform {expr}")
+        cf = self.compile(col)
+        if fn == "substr":
+            start = int(expr.args[1].value)
+            length = int(expr.args[2].value) if len(expr.args) > 2 else None
+            return lambda page: ((lambda dv: (rs.substr(dv[0], start, length), dv[1]))(cf(page)))
+        if fn in ("upper", "lower"):
+            up = fn == "upper"
+            return lambda page: ((lambda dv: (rs.change_case(dv[0], up), dv[1]))(cf(page)))
+        tf = _string_transform(expr)
+        if tf is None:
+            raise KeyError(f"cannot compile string transform {expr}")
+        f, _ = tf
+        width = expr.type.value_shape[0]
+
+        def run_cb(page):
+            d, v = cf(page)
+
+            def cb(arr):
+                vals = [f(s) for s in rs.decode_strings(arr)]
+                data = rs.encode_strings(["" if x is None else x for x in vals], width)
+                nulls = np.asarray([x is None for x in vals], dtype=np.bool_)
+                return data, nulls
+
+            out, nulls = jax.pure_callback(
+                cb,
+                (jax.ShapeDtypeStruct(d.shape[:-1] + (width,), jnp.uint8),
+                 jax.ShapeDtypeStruct(d.shape[:-1], jnp.bool_)),
+                d, vmap_method="sequential",
+            )
+            return out, v & ~nulls
+
+        return run_cb
+
+    def _compile_raw_bool(self, expr: Call) -> CompiledExpr:
+        """LIKE/regexp_like/starts_with/ends_with on raw varchar via the
+        host-predicate callback."""
+        from presto_tpu.ops import rawstring as rs
+
+        fn = expr.fn
+        colref = expr.args[0]
+        cf = self.compile(colref)
+        if fn == "like":
+            rx = _like_to_regex(expr.args[1].value)
+            pred = lambda s: rx.match(s) is not None
+        elif fn == "regexp_like":
+            rx = re.compile(expr.args[1].value)
+            pred = lambda s: rx.search(s) is not None
+        elif fn == "starts_with":
+            prefix = expr.args[1].value
+            pred = lambda s: s.startswith(prefix)
+        else:
+            suffix = expr.args[1].value
+            pred = lambda s: s.endswith(suffix)
+        runner = rs.host_predicate(pred)
+
+        def run_rb(page):
+            d, v = cf(page)
+            return runner(d), v
+
+        return run_rb
+
+    def _compile_raw_int_fn(self, expr: Call) -> CompiledExpr:
+        from presto_tpu.ops import rawstring as rs
+
+        fn = expr.fn
+        cf = self.compile(expr.args[0])
+        if fn == "length":
+            # code points, matching the dictionary path (byte counts
+            # diverge on non-ASCII; rs.lengths stays the internal
+            # byte-level helper)
+            runner_pred = len
+        elif fn == "strpos":
+            needle = expr.args[1].value
+            runner_pred = lambda s: s.find(needle) + 1
+        elif fn == "codepoint":
+            runner_pred = lambda s: ord(s[0]) if s else 0
+        else:
+            raise KeyError(fn)
+
+        def run_ri(page):
+            d, v = cf(page)
+
+            def cb(arr):
+                return np.asarray([runner_pred(s) for s in rs.decode_strings(arr)],
+                                  dtype=np.int64)
+
+            out = jax.pure_callback(
+                cb, jax.ShapeDtypeStruct(d.shape[:-1], jnp.int64), d,
+                vmap_method="sequential",
+            )
+            return out, v
+
+        return run_ri
+
+    def _compile_raw_concat(self, expr: Call) -> CompiledExpr:
+        from presto_tpu.ops import rawstring as rs
+
+        parts = []
+        for a in expr.args:
+            if isinstance(a, Literal):
+                lit = rs.encode_literal(str(a.value), len(str(a.value).encode()) or 1)
+                parts.append(("lit", lit))
+            elif a.type.is_raw_string:
+                parts.append(("col", self.compile(a)))
+            else:
+                raise ValueError("concat mixes raw and dictionary strings")
+
+        def run_rcat(page):
+            data = None
+            valid = None
+            for kind, p in parts:
+                if kind == "lit":
+                    d = jnp.broadcast_to(p[None, :], (page.capacity, p.shape[0]))
+                    v = jnp.ones(page.capacity, dtype=jnp.bool_)
+                else:
+                    d, v = p(page)
+                if data is None:
+                    data, valid = d, v
+                else:
+                    data = rs.concat(data, d)
+                    valid = valid & v
+            return data, valid
+
+        return run_rcat
+
     def _compile_like(self, expr: Call) -> CompiledExpr:
         colref, pat = expr.args
         assert isinstance(pat, Literal), "LIKE pattern must be a literal"
+        if colref.type.is_raw_string:
+            return self._compile_raw_bool(expr)
         cf = self.compile(colref)
         d = self._dict_of(colref)
         if d is None:
@@ -967,6 +1212,23 @@ class ExprCompiler:
         colref = expr.args[0]
         values = expr.args[1:]
         cf = self.compile(colref)
+        if colref.type.is_raw_string:
+            from presto_tpu.ops import rawstring as rs
+
+            lits = [rs.encode_literal(
+                str(v.value),
+                max(colref.type.value_shape[0], len(str(v.value).encode())))
+                for v in values]
+
+            def run_in_raw(page):
+                d, v = cf(page)
+                hit = jnp.zeros(page.capacity, dtype=jnp.bool_)
+                for lb in lits:
+                    _, eq = rs.compare(d, lb[None, :])
+                    hit = hit | eq
+                return hit, v
+
+            return run_in_raw
         if colref.type.is_string:
             d = self._dict_of(colref)
             if d is None:
@@ -1280,11 +1542,12 @@ class ExprCompiler:
     def _compile_case(self, expr: Call) -> CompiledExpr:
         # args = [when1, then1, when2, then2, ..., else]
         args = expr.args
-        pairs = [(self.compile(args[i]), self.compile(args[i + 1]), args[i + 1].type)
-                 for i in range(0, len(args) - 1, 2)]
-        else_f = self.compile(args[-1])
-        else_t = args[-1].type
         out_t = expr.type
+        pairs = [(self.compile(args[i]),
+                  self._compile_operand(args[i + 1], out_t), args[i + 1].type)
+                 for i in range(0, len(args) - 1, 2)]
+        else_f = self._compile_operand(args[-1], out_t)
+        else_t = args[-1].type
 
         def run_case(page):
             data, valid = else_f(page)

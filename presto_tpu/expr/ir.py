@@ -161,6 +161,18 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
     if fn in ("length", "strpos", "codepoint", "json_array_length",
               "url_extract_port", "hll_bucket", "hll_rho"):
         return BIGINT
+    if fn == "concat" and any(t.is_raw_string for t in ts):
+        from presto_tpu.types import VarcharType
+
+        width = 0
+        for a in args:
+            if isinstance(a, Literal):
+                width += len(str(a.value).encode()) if a.value is not None else 0
+            elif a.type.is_raw_string:
+                width += a.type.value_shape[0]
+            else:
+                raise TypeError("concat mixes raw and dictionary strings")
+        return VarcharType(max(width, 1), raw=True)
     if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
               "regexp_extract", "regexp_replace", "replace", "split_part",
               "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",

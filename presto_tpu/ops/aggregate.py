@@ -134,6 +134,8 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
             out.append([cnt])
             continue
         data, valid = c.compile(agg.arg)(page)
+        if agg.fn in ("min", "max") and agg.arg.type.is_raw_string:
+            raise ValueError("min/max over raw varchar unsupported")
         if agg.fn in ("min", "max") and agg.arg.type.is_string:
             # reduce over collation ranks, not assignment-ordered codes
             adict = _agg_dict(agg, [b.dictionary for b in page.blocks])
@@ -194,6 +196,9 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
             # two-phase coupled reduction: per-group extreme of the key,
             # then (any) x among the rows achieving it (reference:
             # operator/aggregation/minmaxby/ MinMaxByStateFactory)
+            if agg.arg.type.value_shape or agg.arg2.type.value_shape:
+                raise ValueError(
+                    f"{agg.fn} over raw varchar / long decimal unsupported")
             y_data, y_valid = c.compile(agg.arg2)(page)
             if agg.arg2.type.is_string:
                 from presto_tpu.expr.compile import expr_dictionary
@@ -485,11 +490,26 @@ def pack_or_hash_keys(datas, valids, domains) -> Tuple[jax.Array, bool]:
     run at native width."""
     if not datas:
         return None, True
-    for d in datas:
-        if d.ndim > 1:
-            raise ValueError(
-                "long-decimal grouping/join keys unsupported (cast to "
-                "a shorter decimal or double)")
+    if any(d.ndim > 1 for d in datas):
+        # raw-varchar keys fold through a byte hash lane; long-decimal
+        # limbs have no safe hash-collision semantics for decimals
+        from presto_tpu.ops.rawstring import hash_bytes
+
+        lanes = []
+        for d, v in zip(datas, valids):
+            if d.ndim > 1 and d.dtype == jnp.uint8:
+                lanes.append((hash_bytes(d), v))
+            elif d.ndim > 1:
+                raise ValueError(
+                    "long-decimal grouping/join keys unsupported (cast to "
+                    "a shorter decimal or double)")
+            else:
+                lanes.append((d, v))
+        h = jnp.zeros(datas[0].shape[0], dtype=jnp.uint64)
+        for d, v in lanes:
+            lane = jnp.where(v, d.astype(jnp.int64), 0).astype(jnp.uint64)
+            h = _mix64(h ^ _mix64(lane + jnp.uint64(0x9E37) * v.astype(jnp.uint64)))
+        return h.astype(jnp.int64) & jnp.int64(0x7FFFFFFFFFFFFFFF), False
     if domains is not None and all(d is not None for d in domains):
         codes, cards = _key_codes(datas, valids, domains)
         prod = 1

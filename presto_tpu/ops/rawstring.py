@@ -1,0 +1,137 @@
+"""Raw (non-dictionary) VARCHAR kernels over fixed-width byte matrices.
+
+Reference analog: ``spi/block/VariableWidthBlock.java`` ((offsets, bytes)
+slices) and the byte-level comparisons of ``type/VarcharOperators.java``.
+TPU redesign: a raw varchar column is a zero-padded ``(capacity, W)``
+uint8 matrix (W static from the declared VARCHAR(n) length), so
+equality/order/substr/concat are static-shape vector ops on the VPU;
+only genuinely irregular ops (LIKE, regex) fall back to a host callback
+per page (``jax.pure_callback`` — the host-side fallback eval the
+variable-width representation was specced with).
+
+Semantics note: device fast paths (substr positions, upper/lower) are
+BYTE-oriented and exact for ASCII; multi-byte UTF-8 routes through the
+host transforms for code-point-correct results (length does so
+unconditionally to match the dictionary path's code-point counts)."""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def encode_strings(values, width: int) -> np.ndarray:
+    """List of str/None -> (n, width) uint8, zero-padded/truncated."""
+    out = np.zeros((len(values), width), dtype=np.uint8)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        b = str(v).encode("utf-8")[:width]
+        out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return out
+
+
+def decode_strings(data: np.ndarray):
+    """(n, W) uint8 -> list of str (padding stripped)."""
+    out = []
+    for row in np.asarray(data, dtype=np.uint8):
+        b = row.tobytes().rstrip(b"\x00")
+        out.append(b.decode("utf-8", errors="replace"))
+    return out
+
+
+def encode_literal(s: str, width: int) -> jnp.ndarray:
+    return jnp.asarray(encode_strings([s], width)[0])
+
+
+def lengths(data: jax.Array) -> jax.Array:
+    """Byte length per row (padding is the only NUL source)."""
+    return jnp.sum((data != 0).astype(jnp.int64), axis=-1)
+
+
+def _pad_to(data: jax.Array, width: int) -> jax.Array:
+    w = data.shape[-1]
+    if w == width:
+        return data
+    if w > width:
+        return data[..., :width]
+    pad = jnp.zeros(data.shape[:-1] + (width - w,), dtype=data.dtype)
+    return jnp.concatenate([data, pad], axis=-1)
+
+
+def compare(a: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(lt, eq) lexicographic over rows; zero padding sorts shortest
+    first ('' < 'a'), matching SQL byte collation."""
+    w = max(a.shape[-1], b.shape[-1])
+    a = _pad_to(a, w)
+    b = _pad_to(b, w)
+    diff = a != b
+    any_diff = jnp.any(diff, axis=-1)
+    first = jnp.argmax(diff, axis=-1)
+    av = jnp.take_along_axis(a, first[..., None], axis=-1)[..., 0]
+    bv = jnp.take_along_axis(b, first[..., None], axis=-1)[..., 0]
+    lt = any_diff & (av < bv)
+    return lt, ~any_diff
+
+
+def substr(data: jax.Array, start: int, length=None) -> jax.Array:
+    """1-based static slice, re-padded to the column width (the type's
+    declared width is preserved; only the live bytes change)."""
+    w = data.shape[-1]
+    s = max(start - 1, 0)
+    end = w if length is None else min(s + length, w)
+    return _pad_to(data[..., s:end], w)
+
+
+def change_case(data: jax.Array, upper: bool) -> jax.Array:
+    if upper:
+        in_range = (data >= ord("a")) & (data <= ord("z"))
+        return jnp.where(in_range, data - 32, data)
+    in_range = (data >= ord("A")) & (data <= ord("Z"))
+    return jnp.where(in_range, data + 32, data)
+
+
+def concat(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Row-wise concatenation: output width Wa+Wb; b's bytes land right
+    after a's length via a gathered shift (static shapes throughout)."""
+    wa, wb = a.shape[-1], b.shape[-1]
+    w = wa + wb
+    la = lengths(a)
+    out_idx = jnp.arange(w)
+    # for each output byte j: a[j] if j < la else b[j - la]
+    from_b = out_idx[None, :] >= la[:, None]
+    a_pad = _pad_to(a, w)
+    bj = jnp.clip(out_idx[None, :] - la[:, None], 0, wb - 1)
+    b_vals = jnp.take_along_axis(b, bj.astype(jnp.int32), axis=-1)
+    in_b = from_b & (out_idx[None, :] - la[:, None] < lengths(b)[:, None])
+    return jnp.where(in_b, b_vals, jnp.where(from_b, 0, a_pad))
+
+
+def hash_bytes(data: jax.Array) -> jax.Array:
+    """Fold a (n, W) byte matrix into one int64 hash lane per row
+    (FNV-1a over the static width; the pack_or_hash fallback lane for
+    raw-string keys)."""
+    h = jnp.full(data.shape[:-1], 0xCBF29CE484222325, dtype=jnp.uint64)
+    for j in range(data.shape[-1]):  # static W: unrolled, fuses on VPU
+        h = (h ^ data[..., j].astype(jnp.uint64)) * jnp.uint64(0x100000001B3)
+    return h.astype(jnp.int64) & jnp.int64(0x7FFFFFFFFFFFFFFF)
+
+
+def host_predicate(pred: Callable[[str], bool]):
+    """Wrap a python str predicate as a page-level device op via host
+    callback (LIKE/regex on raw strings — the irregular tail)."""
+
+    def run(data: jax.Array) -> jax.Array:
+        def cb(arr):
+            return np.asarray([bool(pred(s)) for s in decode_strings(arr)],
+                              dtype=np.bool_)
+
+        return jax.pure_callback(
+            cb, jax.ShapeDtypeStruct(data.shape[:-1], jnp.bool_), data,
+            vmap_method="sequential",
+        )
+
+    return run

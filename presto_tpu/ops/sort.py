@@ -53,8 +53,15 @@ def sort_perm(
     perm = jnp.arange(page.capacity)
     for e, asc, nf in list(zip(sort_exprs, ascending, nulls_first))[::-1]:
         d, v = c.compile(e)(page)
-        k = _value_key(d, asc)
-        perm = perm[jnp.argsort(k[perm], stable=True)]
+        if e.type.is_raw_string and d.ndim > 1:
+            # lexicographic byte order = stable radix passes from the
+            # last byte column to the first (static width unrolls)
+            for j in range(d.shape[-1] - 1, -1, -1):
+                kb = _value_key(d[:, j].astype(jnp.int32), asc)
+                perm = perm[jnp.argsort(kb[perm], stable=True)]
+        else:
+            k = _value_key(d, asc)
+            perm = perm[jnp.argsort(k[perm], stable=True)]
         null_rank = jnp.where(v, 1, 0) if nf else jnp.where(v, 0, 1)
         perm = perm[jnp.argsort(null_rank[perm], stable=True)]
     # dead rows to the end, preserving key order among live rows

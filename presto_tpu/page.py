@@ -118,6 +118,12 @@ class Block:
             from presto_tpu.ops.decimal128 import encode_py
 
             data = encode_py(list(values), cap)
+        elif type_.is_raw_string and not isinstance(values, np.ndarray):
+            from presto_tpu.ops.rawstring import encode_strings
+
+            width = type_.value_shape[0]
+            data = np.zeros((cap, width), dtype=np.uint8)
+            data[:n] = encode_strings(list(values), width)
         else:
             data = np.zeros((cap,) + type_.value_shape, dtype=type_.np_dtype)
             data[:n] = values
@@ -214,6 +220,10 @@ class Page:
             valid = np.asarray(b.valid)[rows_idx]
             if b.type.is_string and b.dictionary is not None and decode_strings:
                 vals = b.dictionary.decode(data)
+            elif b.type.is_raw_string and decode_strings:
+                from presto_tpu.ops.rawstring import decode_strings as _dec
+
+                vals = np.asarray(_dec(data), dtype=object)
             elif b.type.is_long_decimal:
                 from presto_tpu.ops.decimal128 import decode_py
 

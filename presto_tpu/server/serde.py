@@ -53,12 +53,19 @@ _BASIC = {t.name: t for t in (BIGINT, INTEGER, DOUBLE, BOOLEAN, DATE, TIMESTAMP,
 
 
 def type_to_json(t: Type) -> dict:
-    return {"name": t.name, "scale": t.scale, "precision": t.precision}
+    out = {"name": t.name, "scale": t.scale, "precision": t.precision}
+    if t.is_raw_string:
+        out["raw"] = True
+    return out
 
 
 def type_from_json(d: dict) -> Type:
     if d["name"] == "decimal":
         return DecimalType(d["precision"], d["scale"])
+    if d.get("raw"):
+        from presto_tpu.types import VarcharType
+
+        return VarcharType(d["precision"] or 32, raw=True)
     return _BASIC[d["name"]]
 
 

@@ -1450,10 +1450,11 @@ class Binder:
                     if not non_lit:
                         return Literal(type=VARCHAR,
                                        value="".join(str(a.value) for a in args))
-                    if len(non_lit) != 1:
+                    if (len(non_lit) != 1
+                            and not all(a.type.is_raw_string for a in non_lit)):
                         raise BindError(
-                            "concat/|| supports one column operand plus literals"
-                            " (multi-column concatenation needs raw varchar)")
+                            "multi-column concat needs raw varchar operands"
+                            " (dictionary columns support one column + literals)")
                 return call(e.name, *args)
             raise BindError(f"unknown function {e.name}")
 

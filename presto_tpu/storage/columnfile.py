@@ -29,6 +29,8 @@ _META = "meta.json"
 def _type_str(t: Type) -> str:
     if t.is_decimal:
         return f"decimal({t.precision},{t.scale})"
+    if t.is_raw_string:
+        return f"raw_varchar({t.precision})"
     return t.name
 
 

@@ -29,6 +29,15 @@ Representation decisions (TPU-first):
                        columns are low-cardinality or only ever touched
                        by predicates, so predicates evaluate host-side on
                        the dictionary once and broadcast as boolean LUTs.
+  VARCHAR(n) raw    -> (capacity, n) uint8 byte matrix, zero-padded
+                       (VarcharType(n, raw=True)).  The non-dictionary
+                       representation for unbounded-cardinality text:
+                       comparisons/substr/concat/upper/lower run as
+                       vector byte ops on device; LIKE/regex fall back
+                       to a host callback per page (reference analog:
+                       spi/block/VariableWidthBlock.java — offsets+bytes
+                       there, fixed-width padded here so XLA keeps
+                       static shapes).
 """
 
 from __future__ import annotations
@@ -76,13 +85,22 @@ class Type:
 
     @property
     def value_shape(self) -> tuple:
-        """Trailing per-value shape of the device array ((2,) for
-        two-limb long decimals, () for everything else)."""
-        return (2,) if self.is_long_decimal else ()
+        """Trailing per-value shape of the device array: (2,) for
+        two-limb long decimals, (width,) for raw varchar byte matrices,
+        () for everything else."""
+        if self.is_long_decimal:
+            return (2,)
+        if self.is_raw_string:
+            return (self.precision or 32,)
+        return ()
 
     @property
     def is_string(self) -> bool:
-        return self.dictionary
+        return self.name in ("varchar", "char")
+
+    @property
+    def is_raw_string(self) -> bool:
+        return self.is_string and not self.dictionary
 
     def __eq__(self, other) -> bool:
         if not isinstance(other, Type):
@@ -104,6 +122,16 @@ BOOLEAN = Type("boolean", np.dtype(np.bool_))
 DATE = Type("date", np.dtype(np.int32))
 TIMESTAMP = Type("timestamp", np.dtype(np.int64))
 MICROS_PER_DAY = 86_400_000_000
+
+
+def VarcharType(length: int = 32, raw: bool = False) -> Type:
+    """Raw (non-dictionary) varchar: (capacity, length) uint8, padded.
+    The dictionary-coded VARCHAR remains the default for low-cardinality
+    columns; raw is the unbounded-cardinality representation."""
+    if not raw:
+        return VARCHAR
+    return Type("varchar", np.dtype(np.uint8), dictionary=False,
+                precision=int(length))
 VARCHAR = Type("varchar", np.dtype(np.int32), dictionary=True)
 
 
@@ -129,6 +157,15 @@ def common_super_type(a: Type, b: Type) -> Type:
         return a
     if {a.name, b.name} == {"date", "timestamp"}:
         return TIMESTAMP
+    if a.is_string and b.is_string:
+        if a.is_raw_string and b.is_raw_string:
+            return a if (a.precision or 0) >= (b.precision or 0) else b
+        # raw wins over a dictionary-typed operand (string literals are
+        # dictionary-typed until they meet a raw column)
+        if a.is_raw_string:
+            return a
+        if b.is_raw_string:
+            return b
     order = {"boolean": 0, "integer": 1, "date": 1, "bigint": 2, "decimal": 3, "double": 4}
     if a.name in order and b.name in order:
         winner = a if order[a.name] >= order[b.name] else b
@@ -144,8 +181,12 @@ def common_super_type(a: Type, b: Type) -> Type:
 
 
 def parse_type(s: str) -> Type:
-    """Parse a SQL type name, e.g. 'bigint', 'decimal(12,2)', 'varchar(25)'."""
+    """Parse a SQL type name, e.g. 'bigint', 'decimal(12,2)', 'varchar(25)',
+    'raw_varchar(24)' (the non-dictionary fixed-width representation)."""
     s = s.strip().lower()
+    if s.startswith("raw_varchar"):
+        width = int(s[s.index("(") + 1 : s.rindex(")")]) if "(" in s else 32
+        return VarcharType(width, raw=True)
     if s.startswith("decimal"):
         if "(" in s:
             inner = s[s.index("(") + 1 : s.rindex(")")]
