@@ -1,0 +1,114 @@
+"""Cluster memory management: pool polling + low-memory killer.
+
+Reference analog: ``memory/ClusterMemoryManager.java:88`` — the
+coordinator polls every worker's memory pools (``RemoteNodeMemory``),
+and when the cluster is out of memory picks a victim query via the
+pluggable ``LowMemoryKiller`` (default
+``TotalReservationLowMemoryKiller``: the query with the largest total
+reservation).  Here the pools are HBM ``MemoryPool``s; workers expose
+reservation in ``/v1/info`` and the coordinator kills through the
+normal cancel path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+def total_reservation_low_memory_killer(
+    by_query: Dict[str, int]
+) -> Optional[str]:
+    """Pick the query holding the most reserved bytes
+    (TotalReservationLowMemoryKiller.java)."""
+    if not by_query:
+        return None
+    return max(by_query.items(), key=lambda kv: kv[1])[0]
+
+
+def query_reservations(pool) -> Dict[str, int]:
+    """Aggregate a pool's tagged reservations by query id (tags are
+    '{query_id}/{what}#{seq}' — memory.py QueryMemoryContext)."""
+    out: Dict[str, int] = {}
+    for tag, nbytes in pool.tags().items():
+        qid = tag.split("/", 1)[0]
+        out[qid] = out.get(qid, 0) + nbytes
+    return out
+
+
+class ClusterMemoryManager:
+    """Polls local + remote pools; kills the biggest query when the
+    cluster exceeds its memory threshold."""
+
+    def __init__(
+        self,
+        local_pool,
+        kill_query: Callable[[str], None],
+        worker_uris: Sequence[str] = (),
+        threshold: float = 0.95,
+        poll_interval: float = 1.0,
+        killer: Callable[[Dict[str, int]], Optional[str]] = total_reservation_low_memory_killer,
+    ):
+        self.local_pool = local_pool
+        self.kill_query = kill_query
+        self.worker_uris = list(worker_uris)
+        self.threshold = threshold
+        self.poll_interval = poll_interval
+        self.killer = killer
+        self.kills: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- polling ------------------------------------------------------------
+    def cluster_usage(self) -> Dict[str, int]:
+        """(reserved, limit) across local + remote pools
+        (RemoteNodeMemory poll)."""
+        reserved = self.local_pool.reserved if self.local_pool else 0
+        limit = self.local_pool.limit if self.local_pool else 0
+        for uri in self.worker_uris:
+            try:
+                with urllib.request.urlopen(f"{uri}/v1/info", timeout=2.0) as r:
+                    info = json.load(r)
+                mem = info.get("memory") or {}
+                reserved += int(mem.get("reserved", 0))
+                limit += int(mem.get("limit", 0))
+            except Exception:
+                continue  # dead workers are the failure detector's job
+        return {"reserved": reserved, "limit": limit}
+
+    def check_once(self) -> Optional[str]:
+        """One poll cycle; returns the killed query id, if any. A kill
+        frees the victim's reservations immediately (pool.kill_query)
+        so the next cycle escalates to the next-biggest query instead
+        of re-selecting a dead one."""
+        if self.local_pool is None:
+            return None
+        usage = self.cluster_usage()
+        if usage["limit"] <= 0 or usage["reserved"] < self.threshold * usage["limit"]:
+            return None
+        candidates = {q: b for q, b in query_reservations(self.local_pool).items()
+                      if q not in self.kills}
+        victim = self.killer(candidates)
+        if victim is None:
+            return None
+        self.kills.append(victim)
+        self.local_pool.kill_query(victim)  # immediate relief
+        self.kill_query(victim)
+        return victim
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.poll_interval):
+                try:
+                    self.check_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

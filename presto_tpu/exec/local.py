@@ -159,21 +159,13 @@ class GroupCapacityExceeded(Exception):
 
 def _split_pruned(constraints, stats) -> bool:
     """True if split min/max stats prove no row can satisfy ALL the
-    pushed-down conjuncts (ORC stripe-stats pruning role)."""
-    for col, op, v in constraints:
-        st = stats.get(col)
-        if st is None:
-            continue
-        lo, hi = st
-        if (
-            (op == "eq" and (v < lo or v > hi))
-            or (op == "lt" and lo >= v)
-            or (op == "le" and lo > v)
-            or (op == "gt" and hi <= v)
-            or (op == "ge" and hi < v)
-        ):
-            return True
-    return False
+    pushed-down conjuncts (ORC stripe-stats pruning role), via the
+    TupleDomain pushdown language (spi/predicate/TupleDomain.java
+    analog; closed-interval form is conservative for strict bounds)."""
+    from presto_tpu.predicate import TupleDomain
+
+    td = TupleDomain.from_constraints(constraints)
+    return td.is_none or not td.overlaps_split_stats(stats)
 
 
 def _probe_with_retry(probe_fn, build, page):
@@ -214,7 +206,12 @@ class LocalRunner:
         self.memory_pool = memory_pool
         # host-RAM spill fan-out when state exceeds the pool/threshold
         self.spill_partitions = spill_partitions
-        self._mem = None
+        # per-THREAD query memory context: concurrent queries share one
+        # runner (the coordinator runs each on its own thread), so the
+        # active context must not be clobbered across threads
+        import threading as _threading
+
+        self._mem_tls = _threading.local()
         self._chain_cache: Dict[PlanNode, Callable] = {}
         self._fold_cache: Dict[PlanNode, Callable] = {}
         self._agg_overrides: Dict[PlanNode, int] = {}
@@ -224,8 +221,8 @@ class LocalRunner:
         self._force_expanding: set = set()
 
     # ------------------------------------------------------------------
-    def run(self, plan: PlanNode) -> MaterializedResult:
-        page = self.run_to_page(plan)
+    def run(self, plan: PlanNode, query_id: Optional[str] = None) -> MaterializedResult:
+        page = self.run_to_page(plan, query_id=query_id)
         out = page.compact_host()
         return MaterializedResult(
             names=plan.output_names,
@@ -233,12 +230,15 @@ class LocalRunner:
             rows=out.to_pylist(),
         )
 
-    def run_to_page(self, plan: PlanNode) -> Page:
+    def run_to_page(self, plan: PlanNode, query_id: Optional[str] = None) -> Page:
         if self.memory_pool is not None:
             from presto_tpu.memory import QueryMemoryContext
             import uuid
 
-            self._mem = QueryMemoryContext(self.memory_pool, uuid.uuid4().hex[:8])
+            # pool reservations tagged by the COORDINATOR's query id so
+            # the cluster memory manager can attribute + kill by query
+            self._mem = QueryMemoryContext(
+                self.memory_pool, query_id or uuid.uuid4().hex[:8])
         try:
             while True:
                 try:
@@ -250,6 +250,14 @@ class LocalRunner:
             if self._mem is not None:
                 self._mem.release_all()
                 self._mem = None
+
+    @property
+    def _mem(self):
+        return getattr(self._mem_tls, "ctx", None)
+
+    @_mem.setter
+    def _mem(self, value):
+        self._mem_tls.ctx = value
 
     def _account(self, what: str, page, node=None) -> None:
         """Charge a materialized device intermediate against the pool
@@ -506,10 +514,17 @@ class LocalRunner:
             conn = self.catalog.connector(node.handle.connector_name)
             idx = list(node.columns)
             splits = node.splits if node.splits is not None else range(node.handle.num_splits)
+            td = None
+            if node.constraints and hasattr(conn, "split_stats"):
+                from presto_tpu.predicate import TupleDomain
+
+                td = TupleDomain.from_constraints(node.constraints)
+                if td.is_none:
+                    return  # provably empty scan
             for split in splits:
-                if node.constraints and hasattr(conn, "split_stats"):
+                if td is not None:
                     stats = conn.split_stats(node.handle.table, split)
-                    if _split_pruned(node.constraints, stats):
+                    if not td.overlaps_split_stats(stats):
                         continue
                 page = conn.page_for_split(
                     node.handle.table, split, capacity=self.split_capacity

@@ -18,6 +18,11 @@ import threading
 from typing import Dict, Optional
 
 
+class QueryKilledError(Exception):
+    """Raised at the next reservation of a query the cluster memory
+    manager killed — the execution thread's interruption point."""
+
+
 class ExceededMemoryLimitError(Exception):
     def __init__(self, tag: str, requested: int, reserved: int, limit: int):
         super().__init__(
@@ -51,14 +56,30 @@ class MemoryPool:
         self._tagged: Dict[str, int] = {}
         self.reserved = 0
         self.peak = 0
+        self._killed: set = set()
 
     def reserve(self, tag: str, nbytes: int) -> None:
         with self._lock:
+            qid = tag.split("/", 1)[0]
+            if qid in self._killed:
+                raise QueryKilledError(f"query {qid} killed by the memory manager")
             if self.reserved + nbytes > self.limit:
                 raise ExceededMemoryLimitError(tag, nbytes, self.reserved, self.limit)
             self._tagged[tag] = self._tagged.get(tag, 0) + nbytes
             self.reserved += nbytes
             self.peak = max(self.peak, self.reserved)
+
+    def kill_query(self, query_id: str) -> int:
+        """Free a query's reservations immediately and fail its future
+        reserves (ClusterMemoryManager's actual relief mechanism — the
+        execution thread dies at its next reservation)."""
+        freed = 0
+        with self._lock:
+            self._killed.add(query_id)
+            for tag in [t for t in self._tagged if t.split("/", 1)[0] == query_id]:
+                freed += self._tagged.pop(tag)
+            self.reserved -= freed
+        return freed
 
     def free(self, tag: str) -> None:
         with self._lock:

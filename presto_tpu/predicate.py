@@ -1,0 +1,157 @@
+"""Pushdown predicate language: Domain / TupleDomain.
+
+Reference analog: ``presto-spi/.../spi/predicate/`` — ``TupleDomain``
+(column -> Domain map, the engine<->connector pushdown contract),
+``Domain`` (value set + nullability) and ``Range``.  Collapsed to the
+ordered-range form the TPU engine's device representations use: every
+column value is an int/float in device space (epoch days, scaled
+decimals, dictionary codes), so a Domain is a list of closed numeric
+ranges plus a null flag.
+
+Used by the planner to summarize scan conjuncts, by split pruning
+(min/max stats vs domain overlap) and by connectors that can skip or
+pre-filter data (the ConnectorTableLayout / constraint path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_NEG_INF = float("-inf")
+_POS_INF = float("inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class Range:
+    """Closed numeric interval [low, high] in device value space."""
+
+    low: float = _NEG_INF
+    high: float = _POS_INF
+
+    def overlaps(self, other: "Range") -> bool:
+        return self.low <= other.high and other.low <= self.high
+
+    def intersect(self, other: "Range") -> Optional["Range"]:
+        lo, hi = max(self.low, other.low), min(self.high, other.high)
+        return Range(lo, hi) if lo <= hi else None
+
+
+@dataclasses.dataclass(frozen=True)
+class Domain:
+    """Allowed values of one column: union of ranges + NULL flag
+    (spi/predicate/Domain.java)."""
+
+    ranges: Tuple[Range, ...] = (Range(),)
+    null_allowed: bool = False
+
+    @classmethod
+    def all(cls) -> "Domain":
+        return cls((Range(),), True)
+
+    @classmethod
+    def single(cls, value) -> "Domain":
+        v = float(value)
+        return cls((Range(v, v),), False)
+
+    @classmethod
+    def range(cls, low=None, high=None) -> "Domain":
+        return cls((Range(_NEG_INF if low is None else float(low),
+                          _POS_INF if high is None else float(high)),), False)
+
+    @classmethod
+    def only_null(cls) -> "Domain":
+        return cls((), True)
+
+    @property
+    def is_none(self) -> bool:
+        """Provably empty: no ranges and no NULL."""
+        return not self.ranges and not self.null_allowed
+
+    def intersect(self, other: "Domain") -> "Domain":
+        out: List[Range] = []
+        for a in self.ranges:
+            for b in other.ranges:
+                got = a.intersect(b)
+                if got is not None:
+                    out.append(got)
+        return Domain(tuple(out), self.null_allowed and other.null_allowed)
+
+    def union(self, other: "Domain") -> "Domain":
+        return Domain(tuple(self.ranges) + tuple(other.ranges),
+                      self.null_allowed or other.null_allowed)
+
+    def overlaps_stats(self, lo, hi) -> bool:
+        """Could any value in [lo, hi] satisfy this domain? (split
+        pruning: ORC stripe-stats role)."""
+        if self.null_allowed:
+            return True  # stats say nothing about nulls
+        probe = Range(float(lo), float(hi))
+        return any(r.overlaps(probe) for r in self.ranges)
+
+    def contains_value(self, v) -> bool:
+        v = float(v)
+        return any(r.low <= v <= r.high for r in self.ranges)
+
+
+@dataclasses.dataclass(frozen=True)
+class TupleDomain:
+    """Per-column Domain conjunction (spi/predicate/TupleDomain.java).
+    Columns absent from the map are unconstrained."""
+
+    domains: Tuple[Tuple[str, Domain], ...] = ()
+
+    @classmethod
+    def all(cls) -> "TupleDomain":
+        return cls(())
+
+    @classmethod
+    def of(cls, mapping: Dict[str, Domain]) -> "TupleDomain":
+        return cls(tuple(sorted(mapping.items())))
+
+    def as_dict(self) -> Dict[str, Domain]:
+        return dict(self.domains)
+
+    @property
+    def is_none(self) -> bool:
+        return any(d.is_none for _, d in self.domains)
+
+    def domain(self, column: str) -> Domain:
+        for c, d in self.domains:
+            if c == column:
+                return d
+        return Domain.all()
+
+    def intersect(self, other: "TupleDomain") -> "TupleDomain":
+        merged = self.as_dict()
+        for c, d in other.domains:
+            merged[c] = merged[c].intersect(d) if c in merged else d
+        return TupleDomain.of(merged)
+
+    def overlaps_split_stats(self, stats: Dict[str, Tuple[float, float]]) -> bool:
+        """False when the split's min/max stats prove no row matches."""
+        for col, dom in self.domains:
+            st = stats.get(col)
+            if st is None:
+                continue
+            if not dom.overlaps_stats(st[0], st[1]):
+                return False
+        return True
+
+    @classmethod
+    def from_constraints(
+        cls, constraints: Sequence[Tuple[str, str, float]]
+    ) -> "TupleDomain":
+        """Build from the planner's (col, op, value) conjunct triples."""
+        merged: Dict[str, Domain] = {}
+        for col, op, v in constraints:
+            if op == "eq":
+                d = Domain.single(v)
+            elif op in ("lt", "le"):
+                d = Domain.range(high=v)
+            elif op in ("gt", "ge"):
+                d = Domain.range(low=v)
+            else:
+                continue
+            merged[col] = merged[col].intersect(d) if col in merged else d
+        return cls.of(merged)

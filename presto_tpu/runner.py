@@ -58,7 +58,7 @@ class QueryRunner:
             self._plans[sql] = plan
         return plan
 
-    def execute(self, sql: str) -> MaterializedResult:
+    def execute(self, sql: str, query_id=None) -> MaterializedResult:
         import time
 
         from presto_tpu.events import (
@@ -68,7 +68,7 @@ class QueryRunner:
         stmt = parse_statement(sql)
 
         if isinstance(stmt, (ast.Query, ast.Union)):
-            qid = new_query_id()
+            qid = query_id or new_query_id()
             t0 = time.time()
             self.events.query_created(
                 QueryCreatedEvent(qid, sql, self.session.user, t0)
@@ -76,7 +76,7 @@ class QueryRunner:
             try:
                 plan = self._plan_cached(sql, stmt)
                 self._check_access(plan)
-                res = self.executor.run(plan)
+                res = self.executor.run(plan, query_id=qid)
             except Exception as e:
                 self.events.query_completed(QueryCompletedEvent(
                     qid, sql, self.session.user, "FAILED", t0, time.time(),
@@ -119,7 +119,7 @@ class QueryRunner:
             )
 
         if isinstance(stmt, (ast.CreateTableAs, ast.InsertInto)):
-            return self._write(stmt)
+            return self._write(stmt, query_id=query_id)
 
         if isinstance(stmt, ast.DropTable):
             # drops route through access control exactly like writes
@@ -147,7 +147,7 @@ class QueryRunner:
 
         raise ValueError(f"unsupported statement {stmt!r}")
 
-    def _write(self, stmt) -> MaterializedResult:
+    def _write(self, stmt, query_id=None) -> MaterializedResult:
         """CTAS / INSERT (TableWriterOperator + TableFinishOperator
         analog: the query result lands in the writable connector and
         the row count is returned)."""
@@ -156,7 +156,7 @@ class QueryRunner:
         plan = self.binder.plan_ast(stmt.query)
         self._check_access(plan)
         self.access_control.check_can_write(self.session.user, stmt.name)
-        page = self.executor.run_to_page(plan).compact_host()
+        page = self.executor.run_to_page(plan, query_id=query_id).compact_host()
         rows = int(np.asarray(page.num_rows()))
 
         if isinstance(stmt, ast.CreateTableAs):

@@ -95,13 +95,24 @@ class CoordinatorServer:
     single-process coordinator hits)."""
 
     def __init__(self, runner: QueryRunner, host: str = "127.0.0.1", port: int = 0,
-                 resource_groups=None):
+                 resource_groups=None, worker_uris=(), memory_threshold: float = 0.95):
         from presto_tpu.resource_groups import ResourceGroupManager
 
         self.runner = runner
         self.queries: Dict[str, _QueryState] = {}
         self.resource_groups = resource_groups or ResourceGroupManager()
         self._lock = threading.Lock()
+        # cluster-wide OOM protection (memory/ClusterMemoryManager.java:88):
+        # polls local + worker pools, kills the biggest reserver at the
+        # threshold. Only active when the executor runs with a pool.
+        self.memory_manager = None
+        pool = getattr(runner.executor, "memory_pool", None)
+        if pool is not None:
+            from presto_tpu.cluster_memory import ClusterMemoryManager
+
+            self.memory_manager = ClusterMemoryManager(
+                pool, self._kill_query, worker_uris=worker_uris,
+                threshold=memory_threshold)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -185,10 +196,26 @@ class CoordinatorServer:
     # ------------------------------------------------------------------
     def start(self) -> None:
         self._thread.start()
+        if self.memory_manager is not None:
+            self.memory_manager.start()
 
     def stop(self) -> None:
-        self.httpd.shutdown()
+        if self.memory_manager is not None:
+            self.memory_manager.stop()
+        if self._thread.is_alive():  # shutdown() blocks unless serving
+            self.httpd.shutdown()
         self.httpd.server_close()
+
+    def _kill_query(self, qid: str) -> None:
+        """LowMemoryKiller action: cancel through the normal state path
+        (the computation thread discards its result on completion)."""
+        q = self.queries.get(qid)
+        if q is not None:
+            with self._lock:
+                if q.state in ("QUEUED", "RUNNING"):
+                    q.state = "CANCELED"
+                    q.error = "query killed by the cluster memory manager"
+                    q.done.set()
 
     @property
     def uri(self) -> str:
@@ -223,7 +250,7 @@ class CoordinatorServer:
                     return
                 q.state = "RUNNING"
             try:
-                res = self.runner.execute(sql)
+                res = self.runner.execute(sql, query_id=q.id)
                 cols = [
                     {"name": n, "type": repr(t)} for n, t in zip(res.names, res.types)
                 ]

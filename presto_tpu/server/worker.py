@@ -66,9 +66,10 @@ class WorkerServer:
     """
 
     def __init__(self, catalog: Catalog, host: str = "127.0.0.1", port: int = 0,
-                 buffer_bytes: int = 64 << 20, task_ttl: float = 300.0):
+                 buffer_bytes: int = 64 << 20, task_ttl: float = 300.0,
+                 memory_pool=None):
         self.catalog = catalog
-        self.runner = LocalRunner(catalog)
+        self.runner = LocalRunner(catalog, memory_pool=memory_pool)
         self.tasks_executed = 0
         self.buffer_bytes = buffer_bytes
         # abandoned-task expiry: a consumer that dies mid-pull must not
@@ -98,11 +99,15 @@ class WorkerServer:
 
             def do_GET(self):
                 if self.path == "/v1/info":
-                    self._send(200, json.dumps(
-                        {"nodeVersion": {"version": __version__},
-                         "coordinator": False,
-                         "state": "SHUTTING_DOWN" if outer.draining else "ACTIVE",
-                         "tasks": outer.tasks_executed}).encode())
+                    info = {"nodeVersion": {"version": __version__},
+                            "coordinator": False,
+                            "state": "SHUTTING_DOWN" if outer.draining else "ACTIVE",
+                            "tasks": outer.tasks_executed}
+                    pool = outer.runner.memory_pool
+                    if pool is not None:
+                        info["memory"] = {"reserved": pool.reserved,
+                                          "limit": pool.limit}
+                    self._send(200, json.dumps(info).encode())
                     return
                 m = _RESULTS_RE.match(self.path.split("?")[0])
                 if m:
@@ -189,7 +194,13 @@ class WorkerServer:
             self._tasks[task_id] = task
 
         def run():
+            mem_ctx = None
             try:
+                if self.runner.memory_pool is not None:
+                    from presto_tpu.memory import QueryMemoryContext
+
+                    mem_ctx = QueryMemoryContext(self.runner.memory_pool, task_id)
+                    self.runner._mem = mem_ctx  # thread-local
                 fragment = plan_from_json(fragment_json, self.catalog)
                 for p in self.runner._pages(fragment):
                     task.buffer.enqueue(serialize_page(p))
@@ -202,6 +213,10 @@ class WorkerServer:
                 task.state = FAILED
                 task.error = f"{type(e).__name__}: {e}"
                 task.buffer.fail(task.error)
+            finally:
+                if mem_ctx is not None:
+                    mem_ctx.release_all()
+                    self.runner._mem = None
 
         threading.Thread(target=run, daemon=True).start()
         return task

@@ -1,0 +1,92 @@
+"""Cluster memory manager: pool polling + low-memory killer.
+
+Reference analog: memory/ClusterMemoryManager.java +
+TestTotalReservationLowMemoryKiller."""
+
+import pytest
+
+from presto_tpu.cluster_memory import (
+    ClusterMemoryManager,
+    query_reservations,
+    total_reservation_low_memory_killer,
+)
+from presto_tpu.memory import MemoryPool, QueryMemoryContext
+
+
+def test_killer_picks_biggest():
+    assert total_reservation_low_memory_killer({"a": 10, "b": 99, "c": 5}) == "b"
+    assert total_reservation_low_memory_killer({}) is None
+
+
+def test_query_reservations_aggregates_tags():
+    pool = MemoryPool(1 << 20)
+    qa = QueryMemoryContext(pool, "qa")
+    qb = QueryMemoryContext(pool, "qb")
+    qa.reserve("join_build", 100)
+    qa.reserve("agg", 50)
+    qb.reserve("sort", 70)
+    by_q = query_reservations(pool)
+    assert by_q == {"qa": 150, "qb": 70}
+
+
+def test_check_once_kills_over_threshold():
+    pool = MemoryPool(1000)
+    killed = []
+    mgr = ClusterMemoryManager(pool, killed.append, threshold=0.5)
+    QueryMemoryContext(pool, "small").reserve("x", 100)
+    assert mgr.check_once() is None  # 10% < 50%
+    QueryMemoryContext(pool, "big").reserve("y", 600)
+    assert mgr.check_once() == "big"
+    assert killed == ["big"]
+    # the kill actually freed the victim's reservations (real relief)
+    assert pool.reserved == 100
+    assert mgr.check_once() is None  # back under threshold
+
+
+def test_kill_escalates_and_interrupts():
+    from presto_tpu.memory import QueryKilledError
+
+    pool = MemoryPool(1000)
+    killed = []
+    mgr = ClusterMemoryManager(pool, killed.append, threshold=0.5)
+    a = QueryMemoryContext(pool, "a")
+    b = QueryMemoryContext(pool, "b")
+    a.reserve("x", 500)
+    b.reserve("y", 450)
+    assert mgr.check_once() == "a"
+    b.reserve("more", 400)  # b grows past the threshold next
+    assert mgr.check_once() == "b"  # escalation, not re-killing a
+    with pytest.raises(QueryKilledError):
+        a.reserve("z", 10)  # the killed query dies at its next reserve
+
+
+def test_coordinator_kill_path():
+    """End-to-end: an over-threshold pool cancels the reserving query
+    through the coordinator's state machine."""
+    import jax
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.exec.local import LocalRunner
+    from presto_tpu.runner import QueryRunner
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    pool = MemoryPool(1 << 30)
+    runner = QueryRunner(catalog)
+    runner.executor = LocalRunner(catalog, memory_pool=pool)
+    srv = CoordinatorServer(runner)
+    assert srv.memory_manager is not None
+    # simulate a query holding nearly the whole pool
+    q = srv._submit("select count(*) from nation")
+    q.done.wait(timeout=60)
+    ctx = QueryMemoryContext(pool, q.id)
+    ctx.reserve("huge", int(0.96 * (1 << 30)))
+    with srv._lock:
+        q.state = "RUNNING"  # pretend it is still executing
+        q.done.clear()
+    victim = srv.memory_manager.check_once()
+    assert victim == q.id
+    assert q.state == "CANCELED" and "memory manager" in q.error
+    srv.stop()
