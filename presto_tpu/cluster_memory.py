@@ -64,29 +64,51 @@ class ClusterMemoryManager:
     # -- polling ------------------------------------------------------------
     def cluster_usage(self) -> Dict[str, int]:
         """(reserved, limit) across local + remote pools
-        (RemoteNodeMemory poll)."""
+        (RemoteNodeMemory poll). Workers are polled concurrently so one
+        hung socket cannot stretch the decision cycle past ~2s."""
         reserved = self.local_pool.reserved if self.local_pool else 0
         limit = self.local_pool.limit if self.local_pool else 0
-        for uri in self.worker_uris:
+        results: List[Dict] = []
+        lock = threading.Lock()
+
+        def poll(uri):
             try:
                 with urllib.request.urlopen(f"{uri}/v1/info", timeout=2.0) as r:
                     info = json.load(r)
-                mem = info.get("memory") or {}
-                reserved += int(mem.get("reserved", 0))
-                limit += int(mem.get("limit", 0))
+                with lock:
+                    results.append(info.get("memory") or {})
             except Exception:
-                continue  # dead workers are the failure detector's job
+                pass  # dead workers are the failure detector's job
+
+        threads = [threading.Thread(target=poll, args=(u,), daemon=True)
+                   for u in self.worker_uris]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=2.5)
+        for mem in results:
+            reserved += int(mem.get("reserved", 0))
+            limit += int(mem.get("limit", 0))
         return {"reserved": reserved, "limit": limit}
 
     def check_once(self) -> Optional[str]:
         """One poll cycle; returns the killed query id, if any. A kill
         frees the victim's reservations immediately (pool.kill_query)
         so the next cycle escalates to the next-biggest query instead
-        of re-selecting a dead one."""
+        of re-selecting a dead one.
+
+        Kill authority is LOCAL: the decision threshold uses the local
+        pool only, so remote worker pressure (whose queries this
+        coordinator cannot attribute) never kills innocent local
+        queries. The freeing itself is cooperative — the victim's
+        thread unwinds at its next reservation, so a short overcommit
+        window exists while it finishes its current kernel (the
+        reference's revoke protocol has the same property).
+        cluster_usage() remains the fleet-wide view for /v1/cluster."""
         if self.local_pool is None:
             return None
-        usage = self.cluster_usage()
-        if usage["limit"] <= 0 or usage["reserved"] < self.threshold * usage["limit"]:
+        reserved, limit = self.local_pool.reserved, self.local_pool.limit
+        if limit <= 0 or reserved < self.threshold * limit:
             return None
         candidates = {q: b for q, b in query_reservations(self.local_pool).items()
                       if q not in self.kills}

@@ -216,7 +216,10 @@ class LocalRunner:
         self._fold_cache: Dict[PlanNode, Callable] = {}
         self._agg_overrides: Dict[PlanNode, int] = {}
         self._partial_nodes: Dict[PlanNode, AggregationNode] = {}
-        self._builds: Dict[JoinNode, JoinBuild] = {}
+        # per-THREAD materialized join builds: device-resident state
+        # that concurrent queries (and worker task threads) must not
+        # share or clobber; dies with the thread
+        self._builds_tls = _threading.local()
         # joins demoted out of fused chains because their build spilled
         self._force_expanding: set = set()
 
@@ -250,6 +253,14 @@ class LocalRunner:
             if self._mem is not None:
                 self._mem.release_all()
                 self._mem = None
+
+    @property
+    def _builds(self) -> Dict[JoinNode, JoinBuild]:
+        got = getattr(self._builds_tls, "builds", None)
+        if got is None:
+            got = {}
+            self._builds_tls.builds = got
+        return got
 
     @property
     def _mem(self):

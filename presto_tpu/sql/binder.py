@@ -206,6 +206,38 @@ def _shift_date(days: int, n: int, unit: str) -> int:
     return (d - datetime.date(1970, 1, 1)).days
 
 
+def _flatten_bool(e: Expr, fn: str) -> List[Expr]:
+    if isinstance(e, Call) and e.fn == fn:
+        return _flatten_bool(e.args[0], fn) + _flatten_bool(e.args[1], fn)
+    return [e]
+
+
+def _extract_common_or(ir: Expr) -> List[Expr]:
+    """Factor conjuncts common to every OR branch out of the OR
+    (ExtractCommonPredicatesExpressionRewriter analog, on bound IR with
+    structural equality). Returns the replacement conjunct list."""
+    if not (isinstance(ir, Call) and ir.fn == "or"):
+        return [ir]
+    branches = [_flatten_bool(b, "and") for b in _flatten_bool(ir, "or")]
+    common = [c for c in branches[0]
+              if all(any(c == d for d in bc) for bc in branches[1:])]
+    if not common:
+        return [ir]
+    reduced = []
+    for bc in branches:
+        rest = [c for c in bc if not any(c == d for d in common)]
+        if not rest:
+            return common  # one branch is fully covered: OR is implied
+        out = rest[0]
+        for c in rest[1:]:
+            out = call("and", out, c)
+        reduced.append(out)
+    new_or = reduced[0]
+    for b in reduced[1:]:
+        new_or = call("or", new_or, b)
+    return common + [new_or]
+
+
 def _find_scalar_subqueries(e: ast.Node, out: List[ast.Node]) -> None:
     """Collect ScalarSubquery nodes inside an expression (not descending
     into their query bodies)."""
@@ -470,7 +502,11 @@ class Binder:
             if _is_subquery_conjunct(c):
                 self._pending_subqueries.append((c, glob))
                 continue
-            plain.append(self._bind(c, glob))
+            # (A and X) or (A and Y) -> A and (X or Y): frees common
+            # equi-conjuncts (e.g. TPC-H Q19's join key) out of OR
+            # blocks so they become join edges instead of a cross join
+            # (optimizations/ExtractCommonPredicatesExpressionRewriter)
+            plain.extend(_extract_common_or(self._bind(c, glob)))
 
         def term_of(ref: int) -> int:
             for i, t in enumerate(terms):

@@ -123,6 +123,12 @@ def load_oracle(tpch) -> sqlite3.Connection:
             rows = list(zip(*out_cols))
             ph = ", ".join("?" for _ in schema)
             conn.executemany(f"insert into {table} values ({ph})", rows)
+    # key-column indexes: sqlite otherwise nested-loops correlated
+    # subqueries (Q21-class) at minutes per query
+    for table in tpch.table_names():
+        for name, _ in SCHEMAS[table]:
+            if name.endswith("key"):
+                conn.execute(f"create index idx_{table}_{name} on {table}({name})")
     conn.commit()
     return conn
 

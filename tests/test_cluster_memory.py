@@ -60,6 +60,52 @@ def test_kill_escalates_and_interrupts():
         a.reserve("z", 10)  # the killed query dies at its next reserve
 
 
+def test_concurrent_queries_share_runner():
+    """Concurrent queries on one LocalRunner keep independent memory
+    contexts and join-build state (thread-local)."""
+    import threading
+
+    import jax
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.exec.local import LocalRunner
+    from presto_tpu.runner import QueryRunner
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.002, split_rows=4096))
+    pool = MemoryPool(1 << 30)
+    runner = QueryRunner(catalog)
+    runner.executor = LocalRunner(catalog, memory_pool=pool)
+
+    sqls = [
+        "select count(*) from lineitem, orders where l_orderkey = o_orderkey",
+        "select n_name, count(*) from nation, supplier"
+        " where n_nationkey = s_nationkey group by n_name",
+        "select sum(l_quantity) from lineitem where l_discount > 0.02",
+    ] * 2
+    expected = [runner.execute(s).rows for s in sqls]
+
+    results = [None] * len(sqls)
+    errors = []
+
+    def go(i):
+        try:
+            results[i] = runner.execute(sqls[i]).rows
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(len(sqls))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    for got, want in zip(results, expected):
+        assert sorted(got) == sorted(want)
+    assert pool.reserved == 0  # every context released its own tags
+
+
 def test_coordinator_kill_path():
     """End-to-end: an over-threshold pool cancels the reserving query
     through the coordinator's state machine."""

@@ -22,8 +22,10 @@ from tests.oracle import assert_rows_match
 
 @pytest.fixture(scope="module")
 def catalog():
+    # small sf: every spilled bucket fold compiles at a fresh capacity
+    # (uncacheable), so data volume directly buys suite wall-clock
     catalog = Catalog()
-    catalog.register("tpch", Tpch(sf=0.01, split_rows=1 << 13))
+    catalog.register("tpch", Tpch(sf=0.004, split_rows=1 << 12))
     return catalog
 
 
