@@ -50,6 +50,7 @@ from presto_tpu.planner.plan import (
     TableScanNode,
     TopNNode,
     UnionNode,
+    UnnestNode,
     ValuesNode,
     WindowNode,
 )
@@ -410,6 +411,24 @@ class LocalRunner:
 
         if isinstance(node, GroupIdNode):
             yield from self._groupid_pages(node)
+            return
+
+        if isinstance(node, UnnestNode):
+            fn = self._fold_cache.get(node)
+            if fn is None:
+                from presto_tpu.ops.container import unnest_expand
+
+                exprs = list(node.unnest_exprs)
+                ordinality = node.ordinality
+                chans = node.channels
+
+                def do_unnest(p: Page) -> Page:
+                    return unnest_expand(p, exprs, ordinality, chans)
+
+                fn = jax.jit(do_unnest) if self.jit else do_unnest
+                self._fold_cache[node] = fn
+            for p in self._pages(node.source):
+                yield fn(p)
             return
 
         if isinstance(node, JoinNode) and not self._streaming(node):

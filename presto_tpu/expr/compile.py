@@ -54,6 +54,14 @@ STRING_TRANSFORM_FNS = frozenset({
 })
 
 
+_CONTAINER_FNS = frozenset({
+    "array_construct", "subscript", "element_at", "cardinality",
+    "contains", "array_position", "array_min", "array_max", "array_sum",
+    "array_average", "array_sort", "array_distinct", "map_keys",
+    "map_values", "map", "map_construct",
+})
+
+
 def _json_path_get(doc: str, path: str):
     """Tiny JSONPath subset: $, .name, [idx] (reference:
     operator/scalar/JsonExtract.java's path engine)."""
@@ -356,6 +364,8 @@ class ExprCompiler:
 
         assert isinstance(expr, Call), expr
         fn = expr.fn
+        if fn in _CONTAINER_FNS:
+            return self._compile_container(expr)
         if fn in ("and", "or"):
             return self._compile_logic(expr)
         if fn == "not":
@@ -689,6 +699,93 @@ class ExprCompiler:
             return _hll_from_hash(h, fn), v
 
         return run_hll
+
+    def _compile_container(self, expr: Call) -> CompiledExpr:
+        """ARRAY/MAP functions -> masked trailing-axis vector kernels
+        (ops/container.py; reference operator/scalar/ArrayFunctions,
+        MapKeys, MapValues, ElementAt, CardinalityFunction)."""
+        from presto_tpu.ops import container as ct
+
+        fn = expr.fn
+        out_t = expr.type
+        if fn == "array_construct":
+            elem_t = out_t.element
+            parts = [(self._compile_operand(a, elem_t), a.type) for a in expr.args]
+
+            def run_construct(page):
+                datas, valids = [], []
+                for cf, t in parts:
+                    d, v = cf(page)
+                    datas.append(self._coerce(d, t, elem_t))
+                    valids.append(v)
+                n = page.capacity
+                return ct.construct_array(datas, valids, out_t), jnp.ones(n, jnp.bool_)
+
+            return run_construct
+        if fn in ("map", "map_construct"):
+            k = self.compile(expr.args[0])
+            v = self.compile(expr.args[1])
+            kt, vt = expr.args[0].type, expr.args[1].type
+
+            def run_map(page):
+                (kd, kv), (vd, vv) = k(page), v(page)
+                return ct.construct_map(kd, kt, vd, vt, out_t), kv & vv
+
+            return run_map
+
+        arg0 = self.compile(expr.args[0])
+        t0 = expr.args[0].type
+        if fn in ("subscript", "element_at"):
+            idx = self.compile(expr.args[1])
+
+            def run_sub(page):
+                (d, v), (di, vi) = arg0(page), idx(page)
+                out, ov = ct.subscript(d, t0, di, vi)
+                return out.astype(out_t.np_dtype), v & ov
+
+            return run_sub
+        if fn == "cardinality":
+
+            def run_card(page):
+                d, v = arg0(page)
+                return ct.cardinality(d), v
+
+            return run_card
+        if fn in ("contains", "array_position"):
+            x = self.compile(expr.args[1])
+            kern = ct.contains if fn == "contains" else ct.array_position
+
+            def run_ct(page):
+                (d, v), (xd, xv) = arg0(page), x(page)
+                out, ov = kern(d, t0, xd, xv)
+                return out, v & ov
+
+            return run_ct
+        if fn in ("array_min", "array_max", "array_sum", "array_average"):
+
+            def run_red(page):
+                d, v = arg0(page)
+                out, nonempty = ct.array_reduce(d, t0, fn)
+                return out.astype(out_t.np_dtype), v & nonempty
+
+            return run_red
+        if fn in ("array_sort", "array_distinct"):
+            kern = ct.array_sort if fn == "array_sort" else ct.array_distinct
+
+            def run_tf(page):
+                d, v = arg0(page)
+                return kern(d, t0), v
+
+            return run_tf
+        if fn in ("map_keys", "map_values"):
+            kern = ct.map_keys_array if fn == "map_keys" else ct.map_values_array
+
+            def run_mk(page):
+                d, v = arg0(page)
+                return kern(d, t0, out_t), v
+
+            return run_mk
+        raise KeyError(fn)
 
     def _compile_math(self, expr: Call) -> CompiledExpr:
         fn = expr.fn

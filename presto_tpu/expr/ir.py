@@ -205,6 +205,49 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return DecimalType(int(args[1].value), int(args[2].value))
     if fn == "substr":
         return ts[0]  # dictionary codes pass through; values derive
+    # -- ARRAY / MAP (reference: operator/scalar/ArrayFunctions et al.)
+    if fn == "array_construct":
+        from presto_tpu.types import ArrayType
+
+        elem = ts[0] if ts else BIGINT
+        for t in ts[1:]:
+            elem = common_super_type(elem, t)
+        return ArrayType(elem, max(len(ts), 1))
+    if fn in ("subscript", "element_at"):
+        t = ts[0]
+        if not (t.is_array or t.is_map):
+            raise TypeError(f"{fn} over non-container type {t}")
+        return t.element
+    if fn == "cardinality":
+        return BIGINT
+    if fn in ("contains",):
+        return BOOLEAN
+    if fn == "array_position":
+        return BIGINT
+    if fn in ("array_min", "array_max"):
+        return ts[0].element
+    if fn == "array_sum":
+        e = ts[0].element
+        return DOUBLE if e.name == "double" else (e if e.is_decimal else BIGINT)
+    if fn == "array_average":
+        return DOUBLE
+    if fn in ("array_sort", "array_distinct"):
+        return ts[0]
+    if fn == "map_keys":
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(ts[0].key_element, ts[0].max_elems)
+    if fn == "map_values":
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(ts[0].element, ts[0].max_elems)
+    if fn in ("map", "map_construct"):
+        from presto_tpu.types import MapType
+
+        if len(ts) != 2 or not (ts[0].is_array and ts[1].is_array):
+            raise TypeError("map(keys_array, values_array) expected")
+        return MapType(ts[0].element, ts[1].element,
+                       min(ts[0].max_elems, ts[1].max_elems))
     raise KeyError(f"unknown function {fn} for types {ts}")
 
 

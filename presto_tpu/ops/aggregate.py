@@ -53,6 +53,11 @@ DIRECT_GROUP_LIMIT = 1 << 14
 # ExprCompiler.HLL_P in expr/compile.py
 HLL_M = 1 << 12
 
+# static per-group element capacity of array_agg (the reference's
+# ArrayAggregationFunction is unbounded; a fixed slot count keeps the
+# state a dense (groups, cap) matrix — results past the cap truncate)
+ARRAY_AGG_CAP = 64
+
 
 # ---------------------------------------------------------------------------
 # agg state machinery
@@ -91,12 +96,20 @@ def state_types(agg: AggCall) -> List[Type]:
         # HyperLogLog register fold: Σ 2^-M over present buckets, count
         # of present buckets (input rows are one-per-(group, bucket))
         return [DOUBLE, BIGINT]
+    if agg.fn == "array_agg":
+        from presto_tpu.types import ArrayType
+
+        return [ArrayType(t, ARRAY_AGG_CAP), BIGINT]
     raise KeyError(f"unknown aggregate {agg.fn}")
 
 
 def output_type(agg: AggCall) -> Type:
     if agg.fn in ("count", "count_star", "hll_merge", "approx_distinct"):
         return BIGINT
+    if agg.fn == "array_agg":
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(agg.arg.type, ARRAY_AGG_CAP)
     if agg.fn == "sum":
         return _sum_type(agg.arg.type)
     if agg.fn == "avg":
@@ -230,9 +243,47 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int)
             rho = jnp.where(nonnull, data.astype(jnp.float64), 0.0)
             s = _seg_sum(jnp.where(nonnull, jnp.exp2(-rho), 0.0), gid_nn, n + 1)[:n]
             out.append([s, cnt])
+        elif agg.fn == "array_agg":
+            # scatter (group, within-group-rank) -> slot; NULL inputs
+            # keep their position as sentinel slots (reference
+            # ArrayAggregationFunction keeps nulls)
+            at = state_types(agg)[0]
+            cap_e = at.max_elems
+            storage = at.np_dtype
+            sent = _container_sent(storage)
+            sel = rowsel
+            gid_sel = jnp.where(sel, gid, n)
+            rcnt = _seg_sum(sel.astype(jnp.int64), gid_sel, n + 1)[:n]
+            rank = _within_group_rank(gid_sel)
+            vals = jnp.where(valid, data.astype(storage), sent)
+            ok = sel & (rank < cap_e) & (gid_sel < n)
+            tgt = jnp.where(ok, gid_sel.astype(jnp.int64) * cap_e + rank, n * cap_e)
+            flat = jnp.full((n * cap_e,), sent, dtype=storage)
+            flat = flat.at[tgt].set(vals, mode="drop")
+            arr = flat.reshape(n, cap_e)
+            length = jnp.minimum(rcnt, cap_e).astype(storage)
+            out.append([jnp.concatenate([length[:, None], arr], axis=1), rcnt])
         else:
             raise KeyError(agg.fn)
     return out
+
+
+def _container_sent(storage):
+    if jnp.issubdtype(storage, jnp.floating):
+        return jnp.asarray(jnp.nan, dtype=storage)
+    return jnp.asarray(jnp.iinfo(storage).min, dtype=storage)
+
+
+def _within_group_rank(gid: jax.Array) -> jax.Array:
+    """0-based occurrence index of each row within its gid class
+    (stable: earlier rows get lower ranks)."""
+    order = jnp.argsort(gid, stable=True)
+    gs = gid[order]
+    idx = jnp.arange(gs.shape[0], dtype=jnp.int64)
+    first = jnp.concatenate([jnp.ones(1, jnp.bool_), gs[1:] != gs[:-1]])
+    start = jax.lax.cummax(jnp.where(first, idx, 0))
+    rank_sorted = idx - start
+    return jnp.zeros_like(rank_sorted).at[order].set(rank_sorted)
 
 
 def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
@@ -314,6 +365,42 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
                 _seg_sum(cols[0], gid, n + 1)[:n],
                 _seg_sum(cols[1], gid, n + 1)[:n],
             ])
+        elif agg.fn == "array_agg":
+            # concatenate partial arrays per group: each partial row's
+            # elements land at the group's running offset (stable order)
+            arr_col, cnt_col = cols
+            at = state_types(agg)[0]
+            cap_e = at.max_elems
+            storage = arr_col.dtype
+            sent = _container_sent(storage)
+            l0 = arr_col[:, 0]
+            if jnp.issubdtype(storage, jnp.floating):
+                l0 = jnp.where(jnp.isnan(l0), 0.0, l0)
+            lens = jnp.where(gid < n, jnp.maximum(l0.astype(jnp.int64), 0), 0)
+            order = jnp.argsort(gid, stable=True)
+            gs = gid[order]
+            lens_s = lens[order]
+            cum = jnp.cumsum(lens_s) - lens_s  # global exclusive prefix
+            first = jnp.concatenate([jnp.ones(1, jnp.bool_), gs[1:] != gs[:-1]])
+            base = jax.lax.cummax(jnp.where(first, cum, 0))
+            off_s = cum - base
+            off = jnp.zeros_like(off_s).at[order].set(off_s)
+            j = jnp.arange(cap_e, dtype=jnp.int64)[None, :]
+            ok = (j < lens[:, None]) & ((off[:, None] + j) < cap_e) & (gid < n)[:, None]
+            tgt = jnp.where(
+                ok, gid.astype(jnp.int64)[:, None] * cap_e + off[:, None] + j,
+                n * cap_e,
+            )
+            flat = jnp.full((n * cap_e,), sent, dtype=storage)
+            flat = flat.at[tgt.reshape(-1)].set(
+                arr_col[:, 1:].reshape(-1), mode="drop")
+            arr = flat.reshape(n, cap_e)
+            total = _seg_sum(lens, gid, n + 1)[:n]
+            length = jnp.minimum(total, cap_e).astype(storage)
+            out.append([
+                jnp.concatenate([length[:, None], arr], axis=1),
+                _seg_sum(cnt_col, gid, n + 1)[:n],
+            ])
         else:
             raise KeyError(agg.fn)
     return out
@@ -322,7 +409,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n):
 def _agg_dict(agg: AggCall, dictionaries) -> Optional[object]:
     """Dictionary carried through value-preserving aggregates
     (min/max/min_by/max_by of a VARCHAR argument)."""
-    if agg.fn not in ("min", "max", "min_by", "max_by"):
+    if agg.fn not in ("min", "max", "min_by", "max_by", "array_agg"):
         return None
     if agg.arg is None or not agg.arg.type.is_string:
         return None
@@ -415,6 +502,9 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
         elif agg.fn in ("min_by", "max_by"):
             x, xv, _y, cnt = cols
             blocks.append(Block(x.astype(t.np_dtype), (cnt > 0) & (xv > 0), t, adict))
+        elif agg.fn == "array_agg":
+            arr_state, cnt = cols
+            blocks.append(Block(arr_state.astype(t.np_dtype), cnt > 0, t, adict))
         elif agg.fn == "hll_merge":
             # HLL estimator with linear-counting small-range correction
             # (airlift HyperLogLog / the original Flajolet et al. paper)

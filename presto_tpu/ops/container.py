@@ -1,0 +1,431 @@
+"""ARRAY / MAP container kernels.
+
+Reference analog: spi/type/ArrayType.java + spi/block/ArrayBlock.java
+(offset-indexed variable-length element runs) and MapType/MapBlock, plus
+the scalar array/map functions in presto-main operator/scalar/
+(ArrayFunctions, CardinalityFunction, ArrayContains, ArrayMinMax,
+MapKeys, MapValues, ElementAt...).
+
+TPU-first re-design: a container column is a dense
+``(capacity, 1 + slots)`` matrix in one storage dtype.  Slot 0 holds
+the length (entry count for maps), the remaining slots hold elements
+padded with a null sentinel (INT_MIN / NaN).  Every function below is a
+masked reduction or gather over the trailing axis — static shapes, no
+per-row interpretation, everything fuses in XLA.
+
+Layout:
+  array:  [len, e1..emax]
+  map:    [len, k1..kmax, v1..vmax]
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.types import Type, null_sentinel
+
+_I64_MIN = np.iinfo(np.int64).min
+
+
+# ---------------------------------------------------------------------------
+# host encode / decode (page construction and result materialization)
+# ---------------------------------------------------------------------------
+
+def encode_arrays(values: Sequence[Optional[list]], t: Type,
+                  capacity: int) -> np.ndarray:
+    """Encode python lists into the (capacity, 1+max) matrix.  ``None``
+    rows encode as length 0 (row NULL-ness lives in Block.valid);
+    ``None`` elements encode as the storage sentinel."""
+    max_elems = t.max_elems
+    storage = t.np_dtype
+    sent = null_sentinel(storage)
+    out = np.full((capacity, 1 + max_elems), sent, dtype=storage)
+    out[:, 0] = 0
+    elem = t.element
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        n = min(len(v), max_elems)
+        if len(v) > max_elems:
+            raise ValueError(
+                f"array literal of {len(v)} elements exceeds the column's "
+                f"static capacity {max_elems} (declare array(T, N) wider)")
+        out[i, 0] = n
+        for j, e in enumerate(v[:n]):
+            out[i, 1 + j] = sent if e is None else _encode_scalar(e, elem)
+    return out
+
+
+def encode_maps(values: Sequence[Optional[dict]], t: Type,
+                capacity: int) -> np.ndarray:
+    max_elems = t.max_elems
+    storage = t.np_dtype
+    sent = null_sentinel(storage)
+    out = np.full((capacity, 1 + 2 * max_elems), sent, dtype=storage)
+    out[:, 0] = 0
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        items = list(v.items())
+        if len(items) > max_elems:
+            raise ValueError(
+                f"map of {len(items)} entries exceeds static capacity {max_elems}")
+        out[i, 0] = len(items)
+        for j, (k, val) in enumerate(items):
+            out[i, 1 + j] = _encode_scalar(k, t.key_element)
+            out[i, 1 + max_elems + j] = sent if val is None else _encode_scalar(val, t.element)
+    return out
+
+
+def _encode_scalar(v, t: Type):
+    if t.is_string:
+        raise ValueError(
+            "string container elements must be pre-coded to dictionary "
+            "codes before encode (binder resolves literals)")
+    if t.is_decimal:
+        return int(round(float(v) * 10 ** (t.scale or 0)))
+    if t.name == "boolean":
+        return int(bool(v))
+    return v
+
+
+def _decode_scalar(v, t: Type, dictionary=None):
+    if t.is_string:
+        code = int(v)
+        if dictionary is not None and 0 <= code < len(dictionary):
+            return dictionary.values[code]
+        return None
+    if t.name == "double":
+        return float(v)
+    if t.is_decimal:
+        return float(v) / 10 ** (t.scale or 0)
+    if t.name == "boolean":
+        return bool(v)
+    return int(v)
+
+
+def _is_null_slot(x, storage: np.dtype) -> bool:
+    if storage.kind == "f":
+        return bool(np.isnan(x))
+    return int(x) == np.iinfo(storage).min
+
+
+def decode_arrays(data: np.ndarray, t: Type, dictionary=None) -> List[list]:
+    """(n, 1+max) matrix -> python lists (row validity handled by caller)."""
+    out = []
+    storage = t.np_dtype
+    for row in data:
+        n = int(row[0]) if not _is_null_slot(row[0], storage) else 0
+        out.append([
+            None if _is_null_slot(x, storage) else _decode_scalar(x, t.element, dictionary)
+            for x in row[1 : 1 + n]
+        ])
+    return out
+
+
+def decode_maps(data: np.ndarray, t: Type, dictionary=None) -> List[dict]:
+    out = []
+    storage = t.np_dtype
+    m = t.max_elems
+    for row in data:
+        n = int(row[0]) if not _is_null_slot(row[0], storage) else 0
+        d = {}
+        for j in range(n):
+            k = _decode_scalar(row[1 + j], t.key_element, dictionary)
+            v = row[1 + m + j]
+            d[k] = None if _is_null_slot(v, storage) else _decode_scalar(v, t.element)
+        out.append(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device kernels (used by the expression compiler)
+# ---------------------------------------------------------------------------
+
+def _null_const(storage) -> jax.Array:
+    if jnp.issubdtype(storage, jnp.floating):
+        return jnp.asarray(jnp.nan, dtype=storage)
+    return jnp.asarray(jnp.iinfo(storage).min, dtype=storage)
+
+
+def slot_mask(data: jax.Array, nslots: int) -> jax.Array:
+    """(n, slots) bool: slot j live iff j < len (slot 0 excluded)."""
+    length = lengths(data)
+    return jnp.arange(nslots)[None, :] < length[:, None]
+
+
+def lengths(data: jax.Array) -> jax.Array:
+    l0 = data[:, 0]
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        l0 = jnp.where(jnp.isnan(l0), 0.0, l0)
+    return jnp.maximum(l0.astype(jnp.int64), 0)
+
+
+def elem_slots(data: jax.Array, t: Type) -> jax.Array:
+    """Element slots of an array value: (n, max)."""
+    return data[:, 1 : 1 + t.max_elems]
+
+
+def map_key_slots(data: jax.Array, t: Type) -> jax.Array:
+    return data[:, 1 : 1 + t.max_elems]
+
+
+def map_value_slots(data: jax.Array, t: Type) -> jax.Array:
+    m = t.max_elems
+    return data[:, 1 + m : 1 + 2 * m]
+
+
+def elem_null_mask(slots: jax.Array) -> jax.Array:
+    """True where an element slot holds the null sentinel."""
+    if jnp.issubdtype(slots.dtype, jnp.floating):
+        return jnp.isnan(slots)
+    return slots == jnp.iinfo(slots.dtype).min
+
+
+def construct_array(elem_datas: Sequence[jax.Array],
+                    elem_valids: Sequence[jax.Array], t: Type) -> jax.Array:
+    """ARRAY[e1..en] constructor: stack per-row scalars into the matrix."""
+    n = elem_datas[0].shape[0] if elem_datas else 0
+    storage = t.np_dtype
+    sent = _null_const(storage)
+    cols = [jnp.full((n,), float(len(elem_datas)), dtype=storage)
+            if storage.kind == "f"
+            else jnp.full((n,), len(elem_datas), dtype=storage)]
+    for d, v in zip(elem_datas, elem_valids):
+        cols.append(jnp.where(v, d.astype(storage), sent))
+    pad = t.max_elems - len(elem_datas)
+    for _ in range(pad):
+        cols.append(jnp.full((n,), sent, dtype=storage))
+    return jnp.stack(cols, axis=1)
+
+
+def subscript(data: jax.Array, t: Type, idx: jax.Array, idx_valid: jax.Array):
+    """arr[i] (1-based) / map[k]: returns (value, valid).  Out-of-range
+    or missing-key access yields NULL (reference element_at semantics;
+    the subscript form raises there — deviation noted)."""
+    if t.is_map:
+        return map_get(data, t, idx, idx_valid)
+    length = lengths(data)
+    i0 = idx.astype(jnp.int64) - 1
+    ok = idx_valid & (i0 >= 0) & (i0 < length)
+    gathered = jnp.take_along_axis(
+        elem_slots(data, t), jnp.clip(i0, 0, t.max_elems - 1)[:, None], axis=1
+    )[:, 0]
+    valid = ok & ~elem_null_mask(gathered)
+    return gathered, valid
+
+
+def map_get(data: jax.Array, t: Type, key: jax.Array, key_valid: jax.Array):
+    keys = map_key_slots(data, t)
+    vals = map_value_slots(data, t)
+    live = slot_mask(data, t.max_elems)
+    hit = live & (keys == key.astype(keys.dtype)[:, None]) & key_valid[:, None]
+    any_hit = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1)
+    v = jnp.take_along_axis(vals, first[:, None], axis=1)[:, 0]
+    return v, any_hit & ~elem_null_mask(v)
+
+
+def cardinality(data: jax.Array) -> jax.Array:
+    return lengths(data)
+
+
+def contains(data: jax.Array, t: Type, x: jax.Array, x_valid: jax.Array):
+    slots = elem_slots(data, t)
+    live = slot_mask(data, t.max_elems) & ~elem_null_mask(slots)
+    hit = live & (slots == x.astype(slots.dtype)[:, None])
+    return jnp.any(hit, axis=1), x_valid
+
+
+def array_position(data: jax.Array, t: Type, x: jax.Array, x_valid: jax.Array):
+    slots = elem_slots(data, t)
+    live = slot_mask(data, t.max_elems) & ~elem_null_mask(slots)
+    hit = live & (slots == x.astype(slots.dtype)[:, None])
+    any_hit = jnp.any(hit, axis=1)
+    pos = jnp.where(any_hit, jnp.argmax(hit, axis=1) + 1, 0)
+    return pos.astype(jnp.int64), x_valid
+
+
+def array_reduce(data: jax.Array, t: Type, fn: str):
+    """array_min / array_max / array_sum / array_average over the slots."""
+    slots = elem_slots(data, t)
+    live = slot_mask(data, t.max_elems) & ~elem_null_mask(slots)
+    n = jnp.sum(live.astype(jnp.int64), axis=1)
+    storage = slots.dtype
+    if fn in ("array_min", "array_max"):
+        if jnp.issubdtype(storage, jnp.floating):
+            fill = jnp.asarray(jnp.inf if fn == "array_min" else -jnp.inf, storage)
+        else:
+            info = jnp.iinfo(storage)
+            fill = jnp.asarray(info.max if fn == "array_min" else info.min + 1, storage)
+        red = jnp.min if fn == "array_min" else jnp.max
+        out = red(jnp.where(live, slots, fill), axis=1)
+        return out, n > 0
+    s = jnp.sum(jnp.where(live, slots, jnp.zeros_like(slots)), axis=1)
+    if fn == "array_sum":
+        return s, n > 0
+    return s.astype(jnp.float64) / jnp.maximum(n, 1).astype(jnp.float64), n > 0
+
+
+def array_sort(data: jax.Array, t: Type) -> jax.Array:
+    """Sort elements ascending, NULL elements last (reference
+    ArraySortFunction null-last semantics)."""
+    slots = elem_slots(data, t)
+    live = slot_mask(data, t.max_elems)
+    isnull = elem_null_mask(slots)
+    storage = slots.dtype
+    if jnp.issubdtype(storage, jnp.floating):
+        # values sort to the front (nan keys last for nulls AND dead
+        # slots alike); the non-null count nn is the boundary between
+        # sorted values and trailing nulls — real +/-inf values sort as
+        # ordinary values this way
+        sort_key = jnp.where(live & ~isnull, slots, jnp.asarray(jnp.nan, storage))
+        sorted_ = jnp.sort(sort_key, axis=1)
+        j = jnp.arange(t.max_elems)[None, :]
+        nn = jnp.sum((live & ~isnull).astype(jnp.int64), axis=1)[:, None]
+        back = jnp.where(j < nn, sorted_, jnp.asarray(jnp.nan, storage))
+    else:
+        info = jnp.iinfo(storage)
+        sort_key = jnp.where(live & ~isnull, slots.astype(jnp.int64),
+                             jnp.int64(info.max))
+        # null elements sort between values and dead slots
+        sort_key = jnp.where(live & isnull, jnp.int64(info.max) - 1, sort_key)
+        sorted_ = jnp.sort(sort_key, axis=1)
+        n_live = lengths(data)
+        j = jnp.arange(t.max_elems)[None, :]
+        nn = jnp.sum((live & ~isnull).astype(jnp.int64), axis=1)[:, None]
+        back = jnp.where(j < nn, sorted_, jnp.int64(info.min)).astype(storage)
+        back = jnp.where(j < n_live[:, None], back, jnp.int64(info.min).astype(storage))
+    return jnp.concatenate([data[:, :1], back], axis=1)
+
+
+def array_distinct(data: jax.Array, t: Type) -> jax.Array:
+    """Distinct elements, first-occurrence order dropped in favor of
+    sorted order (deviation: reference keeps first occurrence; sorted
+    is the shape-static TPU formulation).  Pads are separated from real
+    extreme values (INT64_MAX / +inf) by position against the non-null
+    count, never by value comparison."""
+    slots = elem_slots(data, t)
+    live = slot_mask(data, t.max_elems)
+    isnull = elem_null_mask(slots)
+    storage = slots.dtype
+    j = jnp.arange(t.max_elems)[None, :]
+    nn = jnp.sum((live & ~isnull).astype(jnp.int64), axis=1)
+    had_null = jnp.any(live & isnull, axis=1)
+    floating = jnp.issubdtype(storage, jnp.floating)
+    if floating:
+        pad = jnp.asarray(jnp.nan, storage)  # nan sorts last
+        s = jnp.sort(jnp.where(live & ~isnull, slots, pad), axis=1)
+        sent = jnp.asarray(jnp.nan, storage)
+    else:
+        info = jnp.iinfo(storage)
+        pad = jnp.asarray(info.max, jnp.int64)
+        s = jnp.sort(jnp.where(live & ~isnull, slots.astype(jnp.int64), pad), axis=1)
+        sent = jnp.int64(info.min)
+    # first occurrence among the leading nn sorted values
+    keep = jnp.concatenate(
+        [jnp.ones_like(s[:, :1], jnp.bool_), s[:, 1:] != s[:, :-1]], axis=1
+    ) & (j < nn[:, None])
+    # compact kept values to a prefix: stable argsort on the drop flag
+    # preserves ascending value order among the kept slots
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    comp = jnp.take_along_axis(s, order, axis=1)
+    nkeep = jnp.sum(keep.astype(jnp.int64), axis=1)
+    out = jnp.where(j < nkeep[:, None], comp, sent)
+    total = nkeep + had_null.astype(jnp.int64)
+    if floating:
+        return jnp.concatenate([total[:, None].astype(storage), out], axis=1)
+    return jnp.concatenate([total[:, None], out], axis=1).astype(storage)
+
+
+def map_keys_array(data: jax.Array, t: Type, out_t: Type) -> jax.Array:
+    """map_keys(m) -> array of keys (order = insertion order)."""
+    n = lengths(data)
+    keys = map_key_slots(data, t).astype(out_t.np_dtype)
+    return jnp.concatenate([n[:, None].astype(out_t.np_dtype), keys], axis=1)
+
+
+def map_values_array(data: jax.Array, t: Type, out_t: Type) -> jax.Array:
+    n = lengths(data)
+    vals = map_value_slots(data, t).astype(out_t.np_dtype)
+    return jnp.concatenate([n[:, None].astype(out_t.np_dtype), vals], axis=1)
+
+
+def unnest_expand(page, unnest_exprs, ordinality: bool, out_types):
+    """Expand container columns to one row per element (UnnestOperator
+    analog).  Output capacity = capacity * M where M is the widest
+    static slot count; row r, slot j maps to output position r*M+j,
+    live iff the source row is live and j < max(len over args) — rows
+    whose containers are all empty/NULL produce nothing, shorter args
+    NULL-pad (reference UNNEST multi-argument semantics)."""
+    from presto_tpu.expr.compile import ExprCompiler
+    from presto_tpu.page import Block, Page
+
+    c = ExprCompiler.for_page(page)
+    cap = page.capacity
+    M = max(e.type.max_elems for e in unnest_exprs)
+    rep = lambda a: jnp.repeat(a, M, axis=0)
+    slot_j = jnp.tile(jnp.arange(M, dtype=jnp.int64), cap)
+
+    evaluated = [(c.compile(e)(page), e.type) for e in unnest_exprs]
+    total_len = jnp.zeros(cap, dtype=jnp.int64)
+    for (d, v), t in evaluated:
+        total_len = jnp.maximum(total_len, jnp.where(v, lengths(d), 0))
+    live = rep(page.row_mask) & (slot_j < rep(total_len))
+
+    out_blocks = []
+    ti = 0
+    for b in page.blocks:
+        out_blocks.append(Block(rep(b.data), rep(b.valid) & live, b.type, b.dictionary))
+        ti += 1
+
+    def elem_block(slots, n_slots, t_elem, dictionary, v_container):
+        pad = M - slots.shape[1]
+        if pad:
+            sent = _null_const(slots.dtype)
+            slots = jnp.concatenate(
+                [slots, jnp.full((cap, pad), sent, dtype=slots.dtype)], axis=1)
+        flat = slots.reshape(cap * M)
+        ev = (rep(v_container) & live & (slot_j < rep(n_slots))
+              & ~elem_null_mask(flat))
+        return Block(flat.astype(t_elem.np_dtype), ev, t_elem, dictionary)
+
+    for (d, v), t in evaluated:
+        n = jnp.where(v, lengths(d), 0)
+        elem_dict = out_types[ti].dictionary if hasattr(out_types[ti], "dictionary") else None
+        if t.is_map:
+            key_dict = elem_dict
+            out_blocks.append(elem_block(map_key_slots(d, t), n, t.key_element, key_dict, v))
+            ti += 1
+            val_dict = out_types[ti].dictionary if hasattr(out_types[ti], "dictionary") else None
+            out_blocks.append(elem_block(map_value_slots(d, t), n, t.element, val_dict, v))
+            ti += 1
+        else:
+            out_blocks.append(elem_block(elem_slots(d, t), n, t.element, elem_dict, v))
+            ti += 1
+
+    if ordinality:
+        from presto_tpu.types import BIGINT
+
+        out_blocks.append(Block(slot_j + 1, live, BIGINT))
+
+    return Page(tuple(out_blocks), live)
+
+
+def construct_map(keys: jax.Array, key_t: Type, values: jax.Array,
+                  val_t: Type, out_t: Type) -> jax.Array:
+    """map(array_k, array_v) constructor: zip two array columns."""
+    n = jnp.minimum(lengths(keys), lengths(values))
+    m = out_t.max_elems
+    storage = out_t.np_dtype
+    k = elem_slots(keys, key_t)[:, :m].astype(storage)
+    v = elem_slots(values, val_t)[:, :m].astype(storage)
+    sent = _null_const(storage)
+    live = jnp.arange(m)[None, :] < n[:, None]
+    k = jnp.where(live, k, sent)
+    v = jnp.where(live, v, sent)
+    return jnp.concatenate([n[:, None].astype(storage), k, v], axis=1)

@@ -124,6 +124,18 @@ class Block:
             width = type_.value_shape[0]
             data = np.zeros((cap, width), dtype=np.uint8)
             data[:n] = encode_strings(list(values), width)
+        elif type_.is_array and (
+            not isinstance(values, np.ndarray) or values.ndim == 1
+        ):
+            from presto_tpu.ops.container import encode_arrays
+
+            data = encode_arrays(list(values), type_, cap)
+        elif type_.is_map and (
+            not isinstance(values, np.ndarray) or values.ndim == 1
+        ):
+            from presto_tpu.ops.container import encode_maps
+
+            data = encode_maps(list(values), type_, cap)
         else:
             data = np.zeros((cap,) + type_.value_shape, dtype=type_.np_dtype)
             data[:n] = values
@@ -224,6 +236,16 @@ class Page:
                 from presto_tpu.ops.rawstring import decode_strings as _dec
 
                 vals = np.asarray(_dec(data), dtype=object)
+            elif b.type.is_array:
+                from presto_tpu.ops.container import decode_arrays
+
+                vals = np.empty(len(data), dtype=object)
+                vals[:] = decode_arrays(data, b.type, b.dictionary)
+            elif b.type.is_map:
+                from presto_tpu.ops.container import decode_maps
+
+                vals = np.empty(len(data), dtype=object)
+                vals[:] = decode_maps(data, b.type, b.dictionary)
             elif b.type.is_long_decimal:
                 from presto_tpu.ops.decimal128 import decode_py
 

@@ -281,6 +281,62 @@ class CrossSingleNode(PlanNode):
 
 
 @dataclasses.dataclass(eq=False)
+class UnnestNode(PlanNode):
+    """Expand array/map-valued expressions to one output row per
+    element, replicating the source row's columns (reference:
+    operator/UnnestOperator.java:35, plan/UnnestNode.java).  Output
+    channels = source channels + per-arg element column(s) (maps emit a
+    key column then a value column) + optional ordinality column.
+
+    TPU shape: output capacity = source capacity * max_elems — a
+    static cross of (row, slot) with liveness row_mask[r] & (j <
+    len[r]), so the expansion is one reshape/gather kernel."""
+
+    source: PlanNode
+    unnest_exprs: List[Expr]
+    elem_names: List[str]
+    ordinality: bool = False
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def max_elems(self) -> int:
+        return max(e.type.max_elems for e in self.unnest_exprs)
+
+    @property
+    def channels(self) -> List[Channel]:
+        from presto_tpu.types import BIGINT
+
+        out = list(self.source.channels)
+        i = 0
+        srcs = self.source.channels
+        for e in self.unnest_exprs:
+            if e.type.is_map:
+                out.append(_expr_channel_elem(e, self.elem_names[i], srcs, key=True))
+                out.append(_expr_channel_elem(e, self.elem_names[i + 1], srcs))
+                i += 2
+            else:
+                out.append(_expr_channel_elem(e, self.elem_names[i], srcs))
+                i += 1
+        if self.ordinality:
+            out.append(Channel(self.elem_names[i] if i < len(self.elem_names)
+                               else "ordinality", BIGINT))
+        return out
+
+
+def _expr_channel_elem(e: Expr, name: str, src: List[Channel], key: bool = False) -> Channel:
+    """Channel for an unnested element column: element type, with the
+    container column's dictionary if the elements are dict-coded."""
+    t = e.type.key_element if key else e.type.element
+    from presto_tpu.expr.compile import expr_dictionary
+
+    d = expr_dictionary(e, [c.dictionary for c in src]) if t.is_string else None
+    return Channel(name, t, d)
+
+
+@dataclasses.dataclass(eq=False)
 class SortNode(PlanNode):
     source: PlanNode
     sort_exprs: List[Expr]

@@ -56,10 +56,23 @@ def type_to_json(t: Type) -> dict:
     out = {"name": t.name, "scale": t.scale, "precision": t.precision}
     if t.is_raw_string:
         out["raw"] = True
+    if t.element is not None:
+        out["element"] = type_to_json(t.element)
+    if t.key_element is not None:
+        out["key"] = type_to_json(t.key_element)
     return out
 
 
 def type_from_json(d: dict) -> Type:
+    if d["name"] == "array":
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(type_from_json(d["element"]), d["precision"] or 8)
+    if d["name"] == "map":
+        from presto_tpu.types import MapType
+
+        return MapType(type_from_json(d["key"]), type_from_json(d["element"]),
+                       d["precision"] or 8)
     if d["name"] == "decimal":
         return DecimalType(d["precision"], d["scale"])
     if d.get("raw"):

@@ -113,6 +113,21 @@ class IsNull(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayCtor(Node):
+    """ARRAY[e1, e2, ...] literal (sql/tree/ArrayConstructor.java)."""
+
+    items: Tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscript(Node):
+    """base[index] (sql/tree/SubscriptExpression.java)."""
+
+    base: Node = None
+    index: Node = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Case(Node):
     whens: Tuple[Tuple[Node, Node], ...]  # (condition, result)
     else_: Optional[Node]
@@ -214,6 +229,18 @@ class JoinRel(Node):
     right: Node
     kind: str  # inner | left | cross
     on: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Unnest(Node):
+    """UNNEST(arr [, arr2...]) [WITH ORDINALITY] [AS alias (col, ...)]
+    — lateral relation over columns of the preceding FROM terms
+    (reference: sql/tree/Unnest.java + operator/UnnestOperator.java:35)."""
+
+    args: Tuple[Node, ...] = ()
+    ordinality: bool = False
+    alias: Optional[str] = None
+    column_names: Tuple[str, ...] = ()
 
 
 # -- query -------------------------------------------------------------------

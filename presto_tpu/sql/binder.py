@@ -63,6 +63,7 @@ AGG_FUNCTIONS = {
     # two-level aggregation (see _rewrite_approx_distinct)
     "approx_distinct",
     "min_by", "max_by", "approx_percentile",
+    "array_agg",
 }
 
 # Correlated bindings mark outer-scope columns with this offset so a
@@ -96,6 +97,10 @@ SCALAR_FUNCTIONS = {
     "json_extract", "json_extract_scalar", "json_array_length", "is_json_scalar",
     "url_extract_host", "url_extract_path", "url_extract_protocol",
     "url_extract_query", "url_extract_port",
+    # ARRAY / MAP (operator/scalar/ArrayFunctions, MapKeys, MapValues...)
+    "cardinality", "contains", "element_at", "array_position",
+    "array_min", "array_max", "array_sum", "array_average",
+    "array_sort", "array_distinct", "map_keys", "map_values", "map",
 }
 
 
@@ -311,6 +316,8 @@ class Binder:
         # planned scalar-subquery marker refs keyed by id(ast node),
         # live only while binding the enclosing conjunct
         self._scalar_refs: Dict[int, ColumnRef] = {}
+        # UNNEST relations of the FROM clause currently being flattened
+        self._from_unnests: List[ast.Unnest] = []
         # CBO stats (cost/StatsCalculator.java analog); memo is safe to
         # share across plan() calls since plan nodes are identity-keyed
         from presto_tpu.planner.stats import StatsCalculator
@@ -426,6 +433,11 @@ class Binder:
             elif isinstance(rel, ast.JoinRel) and rel.kind == "cross":
                 walk(rel.left)
                 walk(rel.right)
+            elif isinstance(rel, ast.Unnest):
+                # lateral: binds against the joined FROM scope, applied
+                # after the join graph (UNNEST is always a cross-join
+                # expansion of the preceding terms)
+                self._from_unnests.append(rel)
             else:
                 node, scope = self._plan_relation(rel)
                 terms.append(Term(node, scope))
@@ -437,6 +449,69 @@ class Binder:
             t.offset = off
             off += len(t.scope)
         return terms, conjuncts
+
+    def _names_resolvable(self, e: ast.Node, scope: Scope) -> bool:
+        """True if every free Identifier in ``e`` resolves in ``scope``
+        (subquery bodies are skipped — they bind their own scopes)."""
+        ok = True
+
+        def walk(n):
+            nonlocal ok
+            if not ok or not isinstance(n, ast.Node):
+                return
+            if isinstance(n, ast.InSubquery):
+                walk(n.value)  # the probe value is free; the body is not
+                return
+            if isinstance(n, (ast.ScalarSubquery, ast.Exists)):
+                return  # inner scopes resolve separately
+            if isinstance(n, ast.Identifier):
+                qualifier = n.parts[0] if len(n.parts) > 1 else None
+                try:
+                    scope.resolve(qualifier, n.parts[-1])
+                except BindError:
+                    ok = False
+                return
+            for f in dataclasses.fields(n):
+                visit(getattr(n, f.name))
+
+        def visit(v):
+            # tuples nest (Case.whens is a tuple of (cond, result) pairs)
+            if isinstance(v, tuple):
+                for x in v:
+                    visit(x)
+            else:
+                walk(v)
+
+        walk(e)
+        return ok
+
+    def _apply_unnest(self, node: PlanNode, scope: Scope,
+                      un: ast.Unnest) -> Tuple[PlanNode, Scope]:
+        """UNNEST(args) lateral expansion (UnnestOperator.java:35)."""
+        from presto_tpu.planner.plan import UnnestNode
+
+        exprs = [self._bind(a, scope) for a in un.args]
+        ncols = 0
+        for e in exprs:
+            if not (e.type.is_array or e.type.is_map):
+                raise BindError(f"UNNEST argument must be ARRAY or MAP, got {e.type}")
+            ncols += 2 if e.type.is_map else 1
+        want = ncols + (1 if un.ordinality else 0)
+        if un.column_names:
+            if len(un.column_names) != want:
+                raise BindError(
+                    f"UNNEST alias declares {len(un.column_names)} columns, "
+                    f"expansion produces {want}")
+            names = list(un.column_names)
+        else:
+            names = [f"col{i+1}" for i in range(ncols)]
+            if un.ordinality:
+                names.append("ordinality")
+        out = UnnestNode(node, exprs, names, un.ordinality)
+        new_cols = [
+            ScopeCol(un.alias, c.name, c) for c in out.channels[len(scope):]
+        ]
+        return out, Scope(scope.cols + new_cols)
 
     def _plan_join_rel(self, rel: ast.JoinRel) -> Tuple[PlanNode, Scope]:
         """Explicit JOIN trees. Inner joins route through the join-graph
@@ -701,22 +776,52 @@ class Binder:
     def _plan_query(self, q: ast.Query) -> Tuple[PlanNode, List[str]]:
         saved_pending = self._pending_subqueries
         saved_windows, saved_slots = self._windows, self._win_slots
+        saved_unnests = self._from_unnests
         self._pending_subqueries = []
         self._windows, self._win_slots = [], {}
+        self._from_unnests = []
         try:
             return self._plan_query_inner(q, saved_pending)
         finally:
             self._pending_subqueries = saved_pending
             self._windows, self._win_slots = saved_windows, saved_slots
+            self._from_unnests = saved_unnests
 
     def _plan_query_inner(self, q: ast.Query, saved_pending) -> Tuple[PlanNode, List[str]]:
         if q.from_:
             terms, conjuncts = self._flatten_from(q.from_)
-            conjuncts = conjuncts + split_conjuncts(q.where)
+            where_cs = split_conjuncts(q.where)
+            deferred_cs: List[ast.Node] = []
+            if self._from_unnests:
+                # WHERE conjuncts over unnest output columns apply after
+                # the expansion; name-resolvability against the pre-unnest
+                # scope decides placement (no side effects)
+                preview = Scope([])
+                for t in terms:
+                    preview = preview.concat(t.scope)
+                kept = []
+                for c in where_cs:
+                    if not self._names_resolvable(c, preview):
+                        deferred_cs.append(c)
+                    else:
+                        kept.append(c)
+                where_cs = kept
+            conjuncts = conjuncts + where_cs
             node, glob, g2c = self._join_terms(terms, conjuncts)
             scope = Scope(
                 [glob.cols[g] for g, _ in sorted(g2c.items(), key=lambda kv: kv[1])]
             )
+            unnests = self._from_unnests
+            self._from_unnests = []
+            for un in unnests:
+                node, scope = self._apply_unnest(node, scope, un)
+            for c in deferred_cs:
+                if _is_subquery_conjunct(c):
+                    ident = {i: i for i in range(len(scope))}
+                    node, scope = self._apply_subquery_conjunct(
+                        node, scope, ident, c, scope)
+                else:
+                    node = FilterNode(node, self._bind(c, scope))
         else:
             node = ValuesNode(names=["$dummy"], types=[BIGINT], rows=[(0,)])
             scope = Scope([])
@@ -1493,6 +1598,32 @@ class Binder:
                             " (dictionary columns support one column + literals)")
                 return call(e.name, *args)
             raise BindError(f"unknown function {e.name}")
+
+        if isinstance(e, ast.ArrayCtor):
+            items = [self._bind_impl(x, scope, agg) for x in e.items]
+            if not items:
+                raise BindError("empty ARRAY[] needs a typed context")
+            # NULL literals adopt the elements' common type
+            typed = [a for a in items if not (isinstance(a, Literal) and a.value is None)]
+            if typed:
+                elem_t = typed[0].type
+                for a in typed[1:]:
+                    elem_t = common_super_type(elem_t, a.type)
+                items = [
+                    Literal(type=elem_t, value=None)
+                    if isinstance(a, Literal) and a.value is None else a
+                    for a in items
+                ]
+            if any(a.type.is_string for a in items):
+                raise BindError(
+                    "ARRAY of strings unsupported in expressions (array "
+                    "columns with dictionary-coded string elements work)")
+            return call("array_construct", *items)
+
+        if isinstance(e, ast.Subscript):
+            base = self._bind_impl(e.base, scope, agg)
+            idx = self._bind_impl(e.index, scope, agg)
+            return call("subscript", base, idx)
 
         if isinstance(e, ast.Substring):
             v = self._bind_impl(e.value, scope, agg)

@@ -22,7 +22,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><>|!=|<=|>=|\|\||[,().;+\-*/%<>=])
+  | (?P<op><>|!=|<=|>=|\|\||[,().;+\-*/%<>=\[\]])
     """,
     re.VERBOSE,
 )
@@ -325,6 +325,35 @@ class Parser:
                 rel = ast.JoinRel(rel, right, kind, cond)
 
     def _relation_primary(self) -> ast.Node:
+        t = self.tok
+        if t.kind == "ident" and t.value.lower() == "unnest" and self.peek2("("):
+            self.i += 2  # 'unnest' '('
+            args = [self._expr()]
+            while self.accept(","):
+                args.append(self._expr())
+            self.expect(")")
+            ordinality = False
+            if self.accept_word("with"):
+                if self.accept_word("ordinality") is None:
+                    raise SyntaxError("expected ORDINALITY after WITH")
+                ordinality = True
+            alias = None
+            cols: List[str] = []
+            if self.accept("as"):
+                alias = self.ident()
+                if self.accept("("):
+                    cols.append(self.ident())
+                    while self.accept(","):
+                        cols.append(self.ident())
+                    self.expect(")")
+            elif self.tok.kind == "ident":
+                alias = self.ident()
+                if self.accept("("):
+                    cols.append(self.ident())
+                    while self.accept(","):
+                        cols.append(self.ident())
+                    self.expect(")")
+            return ast.Unnest(tuple(args), ordinality, alias, tuple(cols))
         if self.accept("("):
             if self.peek("select"):
                 q = self._query()
@@ -442,7 +471,15 @@ class Parser:
             return ast.Unary("-", self._unary())
         if self.accept("+"):
             return self._unary()
-        return self._primary()
+        return self._postfix()
+
+    def _postfix(self) -> ast.Node:
+        e = self._primary()
+        while self.accept("["):
+            idx = self._expr()
+            self.expect("]")
+            e = ast.Subscript(e, idx)
+        return e
 
     def _primary(self) -> ast.Node:
         t = self.tok
@@ -556,6 +593,16 @@ class Parser:
             e = self._expr()
             self.expect(")")
             return e
+
+        if t.kind == "ident" and t.value.lower() == "array" and self.peek2("["):
+            self.i += 2  # 'array' '['
+            items: List[ast.Node] = []
+            if not self.peek("]"):
+                items.append(self._expr())
+                while self.accept(","):
+                    items.append(self._expr())
+            self.expect("]")
+            return ast.ArrayCtor(tuple(items))
 
         if t.kind == "ident" or (t.kind == "keyword" and t.value in ("year", "month", "day")):
             name = t.value

@@ -60,8 +60,15 @@ class Type:
     # decimal scale (digits after the point) when this is a DECIMAL.
     scale: Optional[int] = None
     precision: Optional[int] = None
+    # ARRAY element type / MAP value type (None otherwise); MAP key type.
+    element: Optional["Type"] = None
+    key_element: Optional["Type"] = None
 
     def __repr__(self) -> str:
+        if self.name == "array":
+            return f"array({self.element!r})"
+        if self.name == "map":
+            return f"map({self.key_element!r},{self.element!r})"
         if self.scale is not None:
             return f"decimal({self.precision},{self.scale})"
         return self.name
@@ -87,11 +94,17 @@ class Type:
     def value_shape(self) -> tuple:
         """Trailing per-value shape of the device array: (2,) for
         two-limb long decimals, (width,) for raw varchar byte matrices,
+        (1+max,) for arrays (slot 0 = length), (1+2*max,) for maps
+        (slot 0 = entry count, then keys, then values),
         () for everything else."""
         if self.is_long_decimal:
             return (2,)
         if self.is_raw_string:
             return (self.precision or 32,)
+        if self.name == "array":
+            return (1 + (self.precision or 8),)
+        if self.name == "map":
+            return (1 + 2 * (self.precision or 8),)
         return ()
 
     @property
@@ -102,6 +115,19 @@ class Type:
     def is_raw_string(self) -> bool:
         return self.is_string and not self.dictionary
 
+    @property
+    def is_array(self) -> bool:
+        return self.name == "array"
+
+    @property
+    def is_map(self) -> bool:
+        return self.name == "map"
+
+    @property
+    def max_elems(self) -> int:
+        """Static element-slot capacity of an ARRAY/MAP value."""
+        return self.precision or 8
+
     def __eq__(self, other) -> bool:
         if not isinstance(other, Type):
             return NotImplemented
@@ -109,10 +135,13 @@ class Type:
             self.name == other.name
             and self.scale == other.scale
             and self.precision == other.precision
+            and self.element == other.element
+            and self.key_element == other.key_element
         )
 
     def __hash__(self) -> int:
-        return hash((self.name, self.scale, self.precision))
+        return hash((self.name, self.scale, self.precision,
+                     self.element, self.key_element))
 
 
 BIGINT = Type("bigint", np.dtype(np.int64))
@@ -136,6 +165,49 @@ VARCHAR = Type("varchar", np.dtype(np.int32), dictionary=True)
 
 
 LONG_DECIMAL_BASE = 10 ** 18
+
+
+def _container_storage_dtype(*types: Type) -> np.dtype:
+    """Storage dtype for ARRAY/MAP slots: one fixed-width lane wide
+    enough for every participating scalar type (booleans widen to int32,
+    everything integer-like rides int64, doubles force float64)."""
+    for t in types:
+        if t.value_shape:
+            raise ValueError(f"nested container element type {t} unsupported")
+    if any(t.name == "double" for t in types):
+        return np.dtype(np.float64)
+    if all(t.name == "boolean" for t in types):
+        return np.dtype(np.int32)
+    return np.dtype(np.int64)
+
+
+def ArrayType(element: Type, max_elems: int = 8) -> Type:
+    """ARRAY(element) with a static per-value slot capacity.
+
+    Reference: spi/type/ArrayType.java (variable-length element blocks
+    with offsets).  TPU-first re-design: a (capacity, 1+max_elems)
+    matrix per column — slot 0 holds the length, slots 1.. hold
+    elements padded with the type's null sentinel — so every array op
+    is a masked vector op over the trailing axis and shapes stay
+    static for XLA."""
+    return Type("array", _container_storage_dtype(element),
+                precision=int(max_elems), element=element)
+
+
+def MapType(key: Type, value: Type, max_elems: int = 8) -> Type:
+    """MAP(key, value): (capacity, 1+2*max) matrix — slot 0 = entry
+    count, slots 1..max = keys, slots max+1..2*max = values, in one
+    common storage dtype (reference: spi/type/MapType.java)."""
+    return Type("map", _container_storage_dtype(key, value),
+                precision=int(max_elems), element=value, key_element=key)
+
+
+def null_sentinel(storage: np.dtype):
+    """In-slot NULL marker for container elements (int: INT64_MIN
+    truncated to the lane dtype; float: NaN)."""
+    if storage.kind == "f":
+        return np.nan
+    return np.iinfo(storage).min
 
 
 def DecimalType(precision: int = 18, scale: int = 0) -> Type:
@@ -180,10 +252,37 @@ def common_super_type(a: Type, b: Type) -> Type:
     raise TypeError(f"no common super type for {a} and {b}")
 
 
+def _split_top_level(s: str) -> list:
+    """Split 'a,b,c' on commas not nested inside parentheses."""
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur).strip())
+    return out
+
+
 def parse_type(s: str) -> Type:
     """Parse a SQL type name, e.g. 'bigint', 'decimal(12,2)', 'varchar(25)',
     'raw_varchar(24)' (the non-dictionary fixed-width representation)."""
     s = s.strip().lower()
+    if s.startswith("array"):
+        inner = s[s.index("(") + 1 : s.rindex(")")]
+        parts = _split_top_level(inner)
+        max_elems = int(parts[1]) if len(parts) > 1 and parts[1].isdigit() else 8
+        return ArrayType(parse_type(parts[0]), max_elems)
+    if s.startswith("map"):
+        inner = s[s.index("(") + 1 : s.rindex(")")]
+        parts = _split_top_level(inner)
+        max_elems = int(parts[2]) if len(parts) > 2 and parts[2].isdigit() else 8
+        return MapType(parse_type(parts[0]), parse_type(parts[1]), max_elems)
     if s.startswith("raw_varchar"):
         width = int(s[s.index("(") + 1 : s.rindex(")")]) if "(" in s else 32
         return VarcharType(width, raw=True)

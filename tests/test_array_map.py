@@ -1,0 +1,207 @@
+"""ARRAY / MAP types, container functions, UNNEST, array_agg.
+
+Reference analog: presto-main operator/scalar array/map function tests
+(TestArrayOperators, TestMapOperators), TestUnnestOperator, and the
+array_agg aggregation tests (TestArrayAggregation).
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import (
+    BIGINT, DOUBLE, VARCHAR, ArrayType, MapType, parse_type,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    mem = MemoryConnector()
+    at = ArrayType(BIGINT, 4)
+    mt = MapType(BIGINT, BIGINT, 4)
+    page = Page.from_arrays(
+        [
+            np.arange(1, 5),
+            [[1, 2], [3], [], [4, 5, None]],
+            [{1: 10}, {2: 20, 3: 30}, {}, {9: None}],
+            np.array([1, 1, 2, 2]),
+        ],
+        [BIGINT, at, mt, BIGINT],
+    )
+    mem.create_table(
+        "t", [("id", BIGINT), ("arr", at), ("mp", mt), ("g", BIGINT)], [page]
+    )
+    cat = Catalog()
+    cat.register("mem", mem)
+    return QueryRunner(cat)
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+# ---------------------------------------------------------------------------
+# scalar container functions
+# ---------------------------------------------------------------------------
+
+def test_array_literal_and_cardinality(runner):
+    assert q(runner, "SELECT cardinality(ARRAY[1,2,3])") == [(3,)]
+
+
+def test_subscript_and_element_at(runner):
+    assert q(runner, "SELECT ARRAY[1,2,3][2]") == [(2,)]
+    # out-of-range subscript is NULL (element_at semantics; the
+    # reference's [] raises — deviation)
+    assert q(runner, "SELECT element_at(ARRAY[10,20], 5)") == [(None,)]
+
+
+def test_contains_position(runner):
+    assert q(runner, "SELECT contains(ARRAY[1,2,3], 2)") == [(True,)]
+    assert q(runner, "SELECT array_position(ARRAY[5,6], 6)") == [(2,)]
+    assert q(runner, "SELECT array_position(ARRAY[5,6], 7)") == [(0,)]
+
+
+def test_array_reductions(runner):
+    assert q(runner, "SELECT array_min(ARRAY[3,1,2]), array_max(ARRAY[3,1,2])") == [(1, 3)]
+    assert q(runner, "SELECT array_sum(ARRAY[1,2,3])") == [(6,)]
+    assert q(runner, "SELECT array_average(ARRAY[1,2,3,4])") == [(2.5,)]
+
+
+def test_array_sort_distinct(runner):
+    assert q(runner, "SELECT array_sort(ARRAY[3,1,2])") == [([1, 2, 3],)]
+    assert q(runner, "SELECT array_distinct(ARRAY[3,1,3,2,1])") == [([1, 2, 3],)]
+
+
+def test_array_type_coercion(runner):
+    # int + decimal literal -> decimal elements
+    assert q(runner, "SELECT ARRAY[1, 2.5]") == [([1.0, 2.5],)]
+
+
+def test_map_functions(runner):
+    assert q(runner, "SELECT map(ARRAY[1,2],ARRAY[10,20])[2]") == [(20,)]
+    assert q(runner, "SELECT map_keys(map(ARRAY[1,2],ARRAY[10,20]))") == [([1, 2],)]
+    assert q(runner, "SELECT map_values(map(ARRAY[1,2],ARRAY[10,20]))") == [([10, 20],)]
+    assert q(runner, "SELECT cardinality(map(ARRAY[1,2],ARRAY[10,20]))") == [(2,)]
+    # missing key -> NULL
+    assert q(runner, "SELECT map(ARRAY[1],ARRAY[10])[7]") == [(None,)]
+
+
+def test_container_column_roundtrip(runner):
+    assert q(runner, "SELECT id, arr FROM t ORDER BY id") == [
+        (1, [1, 2]), (2, [3]), (3, []), (4, [4, 5, None]),
+    ]
+    assert q(runner, "SELECT mp FROM t WHERE id = 2") == [({2: 20, 3: 30},)]
+
+
+def test_container_in_predicates(runner):
+    assert q(runner, "SELECT id FROM t WHERE cardinality(arr) > 1 ORDER BY id") == [
+        (1,), (4,),
+    ]
+    assert q(runner, "SELECT id FROM t WHERE contains(arr, 3)") == [(2,)]
+
+
+# ---------------------------------------------------------------------------
+# UNNEST
+# ---------------------------------------------------------------------------
+
+def test_unnest_array(runner):
+    assert q(runner, "SELECT id, e FROM t CROSS JOIN UNNEST(arr) AS u(e) ORDER BY id, e") == [
+        (1, 1), (1, 2), (2, 3), (4, 4), (4, 5), (4, None),
+    ]
+
+
+def test_unnest_with_ordinality(runner):
+    rows = q(runner, "SELECT id, e, o FROM t CROSS JOIN UNNEST(arr) "
+                     "WITH ORDINALITY AS u(e, o) ORDER BY id, o")
+    assert rows == [(1, 1, 1), (1, 2, 2), (2, 3, 1), (4, 4, 1), (4, 5, 2), (4, None, 3)]
+
+
+def test_unnest_map(runner):
+    rows = q(runner, "SELECT id, k, v FROM t CROSS JOIN UNNEST(mp) AS u(k, v) ORDER BY id, k")
+    assert rows == [(1, 1, 10), (2, 2, 20), (2, 3, 30), (4, 9, None)]
+
+
+def test_unnest_comma_form_with_filter(runner):
+    # the filter references the unnest output -> applies post-expansion
+    assert q(runner, "SELECT id, e FROM t, UNNEST(arr) AS u(e) WHERE e > 2 ORDER BY e") == [
+        (2, 3), (4, 4), (4, 5),
+    ]
+
+
+def test_unnest_aggregate(runner):
+    assert q(runner, "SELECT sum(e) FROM t CROSS JOIN UNNEST(arr) AS u(e)") == [(15,)]
+    assert q(runner, "SELECT id, count(e) FROM t CROSS JOIN UNNEST(arr) AS u(e) "
+                     "GROUP BY id ORDER BY id") == [(1, 2), (2, 1), (4, 2)]
+
+
+def test_unnest_filter_with_case(runner):
+    # identifiers nested inside tuple AST fields (CASE whens) must still
+    # defer the conjunct past the expansion
+    rows = q(runner, "SELECT id, e FROM t, UNNEST(arr) AS u(e) "
+                     "WHERE CASE WHEN e > 2 THEN true ELSE false END ORDER BY e")
+    assert rows == [(2, 3), (4, 4), (4, 5)]
+
+
+def test_unnest_filter_with_subquery(runner):
+    # subquery conjuncts over unnest output apply post-expansion
+    rows = q(runner, "SELECT e FROM t, UNNEST(arr) AS u(e) "
+                     "WHERE e IN (SELECT id FROM t) ORDER BY e")
+    assert rows == [(1,), (2,), (3,), (4,)]
+
+
+def test_array_sort_nulls_last_double(runner):
+    # float path: NULLs sort last, not inf-before-null
+    rows = q(runner, "SELECT array_sort(arr) FROM t WHERE id = 4")
+    assert rows == [([4, 5, None],)]
+
+
+def test_array_distinct_keeps_extreme_values(runner):
+    assert q(runner, "SELECT array_distinct(ARRAY[9223372036854775807, 1, "
+                     "9223372036854775807])") == [([1, 9223372036854775807],)]
+
+
+# ---------------------------------------------------------------------------
+# array_agg
+# ---------------------------------------------------------------------------
+
+def test_array_agg_grouped(runner):
+    assert q(runner, "SELECT g, array_agg(id) FROM t GROUP BY g ORDER BY g") == [
+        (1, [1, 2]), (2, [3, 4]),
+    ]
+
+
+def test_array_agg_global(runner):
+    assert q(runner, "SELECT array_agg(id) FROM t") == [([1, 2, 3, 4],)]
+
+
+def test_array_agg_roundtrip_unnest(runner):
+    # array_agg then unnest recovers the rows
+    rows = q(runner, "SELECT e FROM (SELECT array_agg(id) AS a FROM t) "
+                     "CROSS JOIN UNNEST(a) AS u(e) ORDER BY e")
+    assert rows == [(1,), (2,), (3,), (4,)]
+
+
+# ---------------------------------------------------------------------------
+# type plumbing
+# ---------------------------------------------------------------------------
+
+def test_parse_type_containers():
+    at = parse_type("array(bigint, 16)")
+    assert at.is_array and at.element == BIGINT and at.max_elems == 16
+    mt = parse_type("map(bigint, double)")
+    assert mt.is_map and mt.key_element == BIGINT and mt.element == DOUBLE
+    assert parse_type("array(double)").np_dtype == np.dtype(np.float64)
+
+
+def test_distributed_smoke_with_arrays():
+    """Array columns survive the page serde (worker protocol)."""
+    from presto_tpu.server.serde import deserialize_page, serialize_page
+
+    at = ArrayType(BIGINT, 3)
+    page = Page.from_arrays([np.arange(3), [[1], [2, 2], []]], [BIGINT, at])
+    blob = serialize_page(page.compact_host())
+    back = deserialize_page(blob)
+    assert back.to_pylist() == page.to_pylist()
