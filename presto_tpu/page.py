@@ -221,9 +221,31 @@ class Page:
         return jnp.sum(self.row_mask.astype(jnp.int32))
 
     # -- host materialization ---------------------------------------------
+    def device_get(self) -> "Page":
+        """One batched device->host transfer of the whole page.  The
+        axon TPU tunnel charges a full round trip (~70ms) per
+        *separate* host read, so serial ``np.asarray`` per block is
+        k+1 round trips; ``jax.device_get`` of the pytree batches them.
+        The returned Page holds numpy arrays (valid pytree leaves —
+        they re-upload transparently if handed back to device code)."""
+        datas, valids, mask = jax.device_get((
+            tuple(b.data for b in self.blocks),
+            tuple(b.valid for b in self.blocks),
+            self.row_mask,
+        ))
+        return Page(
+            tuple(
+                Block(d, v, b.type, b.dictionary)
+                for d, v, b in zip(datas, valids, self.blocks)
+            ),
+            mask,
+        )
+
     def to_pylist(self, decode_strings: bool = True) -> List[tuple]:
         """Compact live rows to host python tuples (None for NULLs).
         Test/CLI/REST output path — not on the hot loop."""
+        if isinstance(self.row_mask, jax.Array):
+            return self.device_get().to_pylist(decode_strings)
         mask = np.asarray(self.row_mask)
         rows_idx = np.nonzero(mask)[0]
         cols = []
@@ -260,20 +282,24 @@ class Page:
         return [tuple(c[i] for c in cols) for i in range(len(rows_idx))]
 
     def compact_host(self) -> "Page":
-        """Host-side compaction: gather live rows to a prefix."""
-        mask = np.asarray(self.row_mask)
+        """Host-side compaction: gather live rows to a prefix.  Pulls
+        the page in ONE batched transfer and stays numpy — consumers
+        that need device arrays re-upload on first use."""
+        p = self.device_get() if isinstance(self.row_mask, jax.Array) else self
+        mask = np.asarray(p.row_mask)
         idx = np.nonzero(mask)[0]
         n = len(idx)
         blocks = []
-        for b in self.blocks:
+        for b in p.blocks:
             data = np.asarray(b.data)[idx]
             valid = np.asarray(b.valid)[idx]
-            blocks.append(
-                Block.from_numpy(data, b.type, valid=valid, dictionary=b.dictionary, capacity=max(n, 1))
-            )
+            if n == 0:
+                data = np.zeros((1,) + data.shape[1:], dtype=data.dtype)
+                valid = np.zeros(1, dtype=np.bool_)
+            blocks.append(Block(data, valid, b.type, b.dictionary))
         mask_out = np.zeros(max(n, 1), dtype=np.bool_)
         mask_out[:n] = True
-        return Page(tuple(blocks), jnp.asarray(mask_out))
+        return Page(tuple(blocks), mask_out)
 
     def __repr__(self) -> str:
         return f"Page({self.num_blocks} blocks, capacity={self.capacity})"
@@ -298,13 +324,13 @@ def _to_py(v, t: Type):
 def concat_pages_host(pages: Sequence[Page]) -> Page:
     """Host-side concatenation of compacted pages (result assembly)."""
     pages = [p.compact_host() for p in pages]
-    pages = [p for p in pages if int(np.asarray(p.num_rows())) > 0] or pages[:1]
+    pages = [p for p in pages if int(np.asarray(p.row_mask).sum()) > 0] or pages[:1]
     ntypes = pages[0].types
     cols, valids, dicts = [], [], []
     for i, t in enumerate(ntypes):
         datas, vs = [], []
         for p in pages:
-            n = int(np.asarray(p.num_rows()))
+            n = int(np.asarray(p.row_mask).sum())
             datas.append(np.asarray(p.blocks[i].data)[:n])
             vs.append(np.asarray(p.blocks[i].valid)[:n])
         cols.append(np.concatenate(datas) if datas else np.zeros(0, t.np_dtype))

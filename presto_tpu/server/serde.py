@@ -282,7 +282,7 @@ def serialize_page(page: Page, compress: bool = True) -> bytes:
     import zlib
 
     p = page.compact_host()
-    header = {"types": [], "n": int(np.asarray(p.num_rows()))}
+    header = {"types": [], "n": int(np.asarray(p.row_mask).sum())}
     payload = b""
     for b in p.blocks:
         data = np.asarray(b.data)[: header["n"]]
