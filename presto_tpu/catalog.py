@@ -82,10 +82,17 @@ class Catalog:
         return self._connectors[name]
 
     def resolve(self, table: str) -> TableHandle:
-        """Find ``table`` in any registered connector (single default
-        schema — the reference's catalog.schema.table triple collapses
-        to a flat namespace here; connectors can prefix)."""
-        for cname, conn in self._connectors.items():
+        """Find ``table`` in any registered connector, or resolve a
+        ``catalog.table`` qualified name against the named connector
+        (the reference's catalog.schema.table triple collapses to
+        catalog[.table] — there is a single default schema)."""
+        items = self._connectors.items()
+        if "." in table:
+            cname, bare = table.split(".", 1)
+            if cname in self._connectors:
+                items = [(cname, self._connectors[cname])]
+                table = bare
+        for cname, conn in items:
             if table in conn.table_names():
                 schema = conn.schema(table)
                 cols = []

@@ -1,0 +1,135 @@
+"""File-based configuration.
+
+Reference analog: airlift ``@Config`` bean binding from
+``etc/config.properties`` (server/PrestoServer.java bootstraps from the
+etc/ directory: config.properties, node.properties, plus per-catalog
+``etc/catalog/*.properties``).  Java-properties syntax: ``key=value``
+lines, ``#``/``!`` comments, no sections.
+
+Recognized keys (the engine's subset of the reference's config space):
+  coordinator                 true/false (role selection)
+  http-server.http.port       REST port
+  node.id                     stable node identifier
+  query.max-memory-per-node   bytes for the local MemoryPool
+  task.buffer-bytes           worker output-buffer cap
+  session.<property>          default for any system session property
+
+Catalog files (``etc/catalog/<name>.properties``) declare
+``connector.name=<tpch|tpcds|memory|blackhole|...>`` plus
+connector-specific keys (e.g. ``tpch.scale-factor=1.0``), mirroring the
+reference's per-catalog property files.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+
+def parse_properties(text: str) -> Dict[str, str]:
+    """Java-properties subset: key=value, # or ! comments, blank lines."""
+    out: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#") or line.startswith("!"):
+            continue
+        if "=" not in line:
+            raise ValueError(f"malformed property line: {raw!r}")
+        k, v = line.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def load_properties(path: str) -> Dict[str, str]:
+    with open(path) as f:
+        return parse_properties(f.read())
+
+
+class EngineConfig:
+    """Parsed etc/ directory (PrestoServer bootstrap analog)."""
+
+    def __init__(self, props: Optional[Dict[str, str]] = None,
+                 catalogs: Optional[Dict[str, Dict[str, str]]] = None):
+        self.props = dict(props or {})
+        self.catalogs = dict(catalogs or {})
+
+    # -- typed accessors ----------------------------------------------------
+    def bool(self, key: str, default: bool = False) -> bool:
+        v = self.props.get(key)
+        return default if v is None else v.lower() in ("true", "1", "yes")
+
+    def int(self, key: str, default: int = 0) -> int:
+        v = self.props.get(key)
+        return default if v is None else int(v)
+
+    def str(self, key: str, default: str = "") -> str:
+        return self.props.get(key, default)
+
+    def session_defaults(self) -> Dict[str, str]:
+        """``session.<name>`` keys become session-property defaults."""
+        return {
+            k[len("session."):]: v
+            for k, v in self.props.items()
+            if k.startswith("session.")
+        }
+
+    # -- loading ------------------------------------------------------------
+    @classmethod
+    def from_etc(cls, etc_dir: str) -> "EngineConfig":
+        props = {}
+        cfg = os.path.join(etc_dir, "config.properties")
+        if os.path.exists(cfg):
+            props.update(load_properties(cfg))
+        node = os.path.join(etc_dir, "node.properties")
+        if os.path.exists(node):
+            props.update(load_properties(node))
+        catalogs = {}
+        catdir = os.path.join(etc_dir, "catalog")
+        if os.path.isdir(catdir):
+            for fn in sorted(os.listdir(catdir)):
+                if fn.endswith(".properties"):
+                    catalogs[fn[:-len(".properties")]] = load_properties(
+                        os.path.join(catdir, fn))
+        return cls(props, catalogs)
+
+    # -- materialization ----------------------------------------------------
+    def build_catalog(self):
+        """Instantiate connectors from the catalog property files
+        (PluginManager + ConnectorFactory analog, keyed by
+        ``connector.name``)."""
+        from presto_tpu.catalog import Catalog
+
+        catalog = Catalog()
+        for name, props in self.catalogs.items():
+            kind = props.get("connector.name")
+            conn = _make_connector(kind, props)
+            catalog.register(name, conn)
+        return catalog
+
+    def build_session(self):
+        from presto_tpu.session import Session
+
+        return Session(properties=self.session_defaults())
+
+
+def _make_connector(kind: Optional[str], props: Dict[str, str]):
+    if kind == "tpch":
+        from presto_tpu.connectors.tpch import Tpch
+
+        return Tpch(
+            sf=float(props.get("tpch.scale-factor", "0.01")),
+            split_rows=int(props.get("tpch.split-rows", str(1 << 20))),
+        )
+    if kind == "tpcds":
+        from presto_tpu.connectors.tpcds import Tpcds
+
+        return Tpcds(sf=float(props.get("tpcds.scale-factor", "0.01")))
+    if kind == "memory":
+        from presto_tpu.connectors.memory import MemoryConnector
+
+        return MemoryConnector()
+    if kind == "blackhole":
+        from presto_tpu.connectors.blackhole import BlackholeConnector
+
+        return BlackholeConnector()
+    raise ValueError(f"unknown connector.name: {kind!r}")

@@ -32,7 +32,7 @@ class MemoryConnector:
         domains: Optional[Dict[str, Tuple[int, int]]] = None,
         primary_key: Optional[List[str]] = None,
     ) -> None:
-        self._tables[name] = list(pages)
+        self._tables[name] = [_to_device(p) for p in pages]
         self._schemas[name] = list(schema)
         self._domains[name] = dict(domains or {})
         self._pks[name] = primary_key
@@ -43,7 +43,7 @@ class MemoryConnector:
                     self._dicts[name][col] = b.dictionary
 
     def append_pages(self, name: str, pages: Sequence[Page]) -> None:
-        self._tables[name].extend(pages)
+        self._tables[name].extend(_to_device(p) for p in pages)
 
     def drop_table(self, name: str) -> None:
         for d in (self._tables, self._schemas, self._domains, self._pks, self._dicts):
@@ -100,3 +100,50 @@ class MemoryConnector:
 
     def max_split_rows(self, table: str) -> int:
         return max(p.capacity for p in self._tables[table])
+
+    # -- transactions --------------------------------------------------------
+    # Reference: ConnectorMetadata transaction hooks driven by
+    # transaction/TransactionManager.java.  Writes stage on the handle
+    # and publish atomically at commit (read-committed; no
+    # read-your-writes inside an open transaction).
+
+    def begin_transaction(self):
+        return _MemoryTx()
+
+    def commit_transaction(self, tx: "_MemoryTx") -> None:
+        for op, args in tx.ops:
+            getattr(self, op)(*args)
+
+    def rollback_transaction(self, tx: "_MemoryTx") -> None:
+        tx.ops.clear()
+
+    def stage(self, tx: "_MemoryTx", op: str, *args) -> None:
+        """Record a write to apply at commit (op = method name)."""
+        tx.ops.append((op, args))
+
+
+class _MemoryTx:
+    """Staged write list (ConnectorTransactionHandle analog)."""
+
+    def __init__(self):
+        self.ops: List[tuple] = []
+
+
+def _to_device(page: Page):
+    """Pin a page's arrays in HBM once at write time — compacted result
+    pages arrive numpy-backed (page.compact_host), and storing them
+    as-is would re-pay the host->device transfer on every later scan."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.page import Block
+
+    if not any(isinstance(b.data, np.ndarray) for b in page.blocks):
+        return page
+    return Page(
+        tuple(
+            Block(jnp.asarray(b.data), jnp.asarray(b.valid), b.type, b.dictionary)
+            for b in page.blocks
+        ),
+        jnp.asarray(page.row_mask),
+    )

@@ -20,6 +20,9 @@ class QueryCreatedEvent:
     sql: str
     user: str
     create_time: float
+    # request-correlation token propagated end to end (reference:
+    # X-Presto-Trace-Token, server/GenerateTraceTokenRequestFilter.java:29)
+    trace_token: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -32,6 +35,11 @@ class QueryCompletedEvent:
     end_time: float
     rows: int = 0
     error: Optional[str] = None
+    trace_token: Optional[str] = None
+
+
+def new_trace_token() -> str:
+    return "trace_" + uuid.uuid4().hex[:16]
 
 
 class EventListener:

@@ -34,6 +34,11 @@ class QueryRunner:
         self.memory_pool = memory_pool
         self.access_control = access_control or AccessControl()
         self.events = EventListenerManager()
+        # per-session explicit transaction (transaction/TransactionManager.java)
+        from presto_tpu.transaction import TransactionManager
+
+        self.transactions = TransactionManager()
+        self._open_tx = None
         self.executor = self._make_executor()
         # plan cache: repeated executions of the same SQL reuse the same
         # plan-node identities, so the executor's compiled-chain caches
@@ -68,10 +73,13 @@ class QueryRunner:
         stmt = parse_statement(sql)
 
         if isinstance(stmt, (ast.Query, ast.Union)):
+            from presto_tpu.events import new_trace_token
+
             qid = query_id or new_query_id()
+            trace = self.session.trace_token or new_trace_token()
             t0 = time.time()
             self.events.query_created(
-                QueryCreatedEvent(qid, sql, self.session.user, t0)
+                QueryCreatedEvent(qid, sql, self.session.user, t0, trace_token=trace)
             )
             try:
                 plan = self._plan_cached(sql, stmt)
@@ -80,12 +88,12 @@ class QueryRunner:
             except Exception as e:
                 self.events.query_completed(QueryCompletedEvent(
                     qid, sql, self.session.user, "FAILED", t0, time.time(),
-                    error=f"{type(e).__name__}: {e}",
+                    error=f"{type(e).__name__}: {e}", trace_token=trace,
                 ))
                 raise
             self.events.query_completed(QueryCompletedEvent(
                 qid, sql, self.session.user, "FINISHED", t0, time.time(),
-                rows=len(res.rows),
+                rows=len(res.rows), trace_token=trace,
             ))
             return res
 
@@ -118,18 +126,50 @@ class QueryRunner:
                 ["name", "value", "default", "description"], [VARCHAR] * 4, rows
             )
 
+        if isinstance(stmt, ast.StartTransaction):
+            from presto_tpu.transaction import TransactionError
+
+            if self._open_tx is not None:
+                raise TransactionError("a transaction is already open")
+            self._open_tx = self.transactions.begin(read_only=stmt.read_only)
+            return MaterializedResult(["result"], [VARCHAR], [("START TRANSACTION",)])
+
+        if isinstance(stmt, ast.Commit):
+            from presto_tpu.transaction import TransactionError
+
+            if self._open_tx is None:
+                raise TransactionError("no transaction is open")
+            tx, self._open_tx = self._open_tx, None
+            self.transactions.commit(tx.tx_id)
+            self._invalidate_plans()  # published writes change table state
+            return MaterializedResult(["result"], [VARCHAR], [("COMMIT",)])
+
+        if isinstance(stmt, ast.Rollback):
+            from presto_tpu.transaction import TransactionError
+
+            if self._open_tx is None:
+                raise TransactionError("no transaction is open")
+            tx, self._open_tx = self._open_tx, None
+            self.transactions.rollback(tx.tx_id)
+            return MaterializedResult(["result"], [VARCHAR], [("ROLLBACK",)])
+
         if isinstance(stmt, (ast.CreateTableAs, ast.InsertInto)):
             return self._write(stmt, query_id=query_id)
 
         if isinstance(stmt, ast.DropTable):
             # drops route through access control exactly like writes
             # (AccessControlManager.checkCanDropTable analog)
-            self.access_control.check_can_write(self.session.user, stmt.name)
             handle = self.catalog.resolve(stmt.name)
+            # access rules key on bare table names
+            self.access_control.check_can_write(self.session.user, handle.table)
             conn = self.catalog.connector(handle.connector_name)
             if not hasattr(conn, "drop_table"):
                 raise ValueError(f"connector {handle.connector_name} is read-only")
-            conn.drop_table(stmt.name)
+            self._check_tx_writable(handle.connector_name, conn)
+            if self._stage_write(handle.connector_name, conn, "drop_table", handle.table):
+                return MaterializedResult(["result"], [VARCHAR], [("DROP TABLE (staged)",)])
+            conn.drop_table(handle.table)
+            self._invalidate_plans()
             return MaterializedResult(["result"], [VARCHAR], [("DROP TABLE",)])
 
         if isinstance(stmt, ast.ShowTables):
@@ -155,21 +195,31 @@ class QueryRunner:
 
         plan = self.binder.plan_ast(stmt.query)
         self._check_access(plan)
-        self.access_control.check_can_write(self.session.user, stmt.name)
+        self.access_control.check_can_write(
+            self.session.user, stmt.name.split(".")[-1])
+
+        # resolve the write target BEFORE running the source query so a
+        # READ ONLY transaction / non-transactional connector rejects
+        # without burning device time on the doomed SELECT
+        if isinstance(stmt, ast.CreateTableAs):
+            cname, table = self._write_target(stmt.name)
+            conn = self.catalog.connector(cname)
+        else:
+            handle = self.catalog.resolve(stmt.name)
+            cname, table = handle.connector_name, handle.table
+            conn = self.catalog.connector(cname)
+            if not hasattr(conn, "append_pages"):
+                raise ValueError(f"connector {cname} is read-only")
+        self._check_tx_writable(cname, conn)
+
         page = self.executor.run_to_page(plan, query_id=query_id).compact_host()
         rows = int(np.asarray(page.num_rows()))
 
         if isinstance(stmt, ast.CreateTableAs):
-            if self.catalog.write_connector is None:
-                raise ValueError("no writable connector registered")
-            conn = self.catalog.connector(self.catalog.write_connector)
             schema = list(zip(plan.output_names, plan.output_types))
-            conn.create_table(stmt.name, schema, [page])
+            if not self._stage_write(cname, conn, "create_table", table, schema, [page]):
+                conn.create_table(table, schema, [page])
         else:
-            handle = self.catalog.resolve(stmt.name)
-            conn = self.catalog.connector(handle.connector_name)
-            if not hasattr(conn, "append_pages"):
-                raise ValueError(f"connector {handle.connector_name} is read-only")
             want = [c.type for c in handle.columns]
             got = plan.output_types
             # name+scale equality: decimal scale decides the scaled-int
@@ -180,8 +230,57 @@ class QueryRunner:
             if [(t.name, t.scale) for t in want] != [(t.name, t.scale) for t in got]:
                 raise ValueError(f"INSERT schema mismatch: {want} vs {got}")
             page = self._recode_strings(page, handle)
-            conn.append_pages(stmt.name, [page])
+            if not self._stage_write(cname, conn, "append_pages", table, [page]):
+                conn.append_pages(table, [page])
+        self._invalidate_plans()
         return MaterializedResult(["rows"], [BIGINT], [(rows,)])
+
+    def _write_target(self, name: str):
+        """(connector, bare table) for a CTAS target: a 'catalog.table'
+        prefix routes to that connector, else the default writable one."""
+        if "." in name:
+            cname, bare = name.split(".", 1)
+            if cname in self.catalog._connectors:
+                return cname, bare
+        if self.catalog.write_connector is None:
+            raise ValueError("no writable connector registered")
+        return self.catalog.write_connector, name
+
+    def _check_tx_writable(self, connector_name: str, conn) -> None:
+        """Early rejection for writes that cannot proceed in the open
+        transaction (read-only / connector without tx hooks)."""
+        if self._open_tx is None:
+            return
+        from presto_tpu.transaction import TransactionError
+
+        if self._open_tx.read_only:
+            raise TransactionError("transaction is READ ONLY")
+        if not hasattr(conn, "begin_transaction") or not hasattr(conn, "stage"):
+            raise TransactionError(
+                f"connector {connector_name} does not support transactions")
+
+    def _invalidate_plans(self) -> None:
+        """Writes change split counts / stats snapshotted into cached
+        plans (TableHandle.num_splits, row_count); drop them so the next
+        query re-resolves metadata (the reference re-resolves per query
+        — its plans are never cached across queries)."""
+        self._plans.clear()
+
+    def _stage_write(self, connector_name: str, conn, op: str, *args) -> bool:
+        """Inside an open transaction, stage the write on the connector's
+        tx handle instead of applying it; returns True when staged."""
+        if self._open_tx is None:
+            return False
+        from presto_tpu.transaction import TransactionError
+
+        if self._open_tx.read_only:
+            raise TransactionError("transaction is READ ONLY")
+        if not hasattr(conn, "begin_transaction") or not hasattr(conn, "stage"):
+            raise TransactionError(
+                f"connector {connector_name} does not support transactions")
+        handle = self._open_tx.handle_for(connector_name, conn)
+        conn.stage(handle, op, *args)
+        return True
 
     def _recode_strings(self, page, handle):
         """Recode inserted VARCHAR blocks onto the table's dictionary so

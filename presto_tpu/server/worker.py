@@ -147,17 +147,42 @@ class WorkerServer:
                     return
                 self._send(404, b"{}")
 
+            def do_PUT(self):
+                # PUT /v1/info/state "SHUTTING_DOWN" triggers a drain in
+                # the background (server/GracefulShutdownHandler.java:43)
+                if self.path == "/v1/info/state":
+                    n = int(self.headers.get("Content-Length", "0"))
+                    want = self.rfile.read(n).decode().strip().strip('"')
+                    if want == "SHUTTING_DOWN":
+                        outer.draining = True
+                        threading.Thread(target=outer.drain, daemon=True).start()
+                        self._send(200, b"{}")
+                    else:
+                        self._send(400, json.dumps(
+                            {"error": f"invalid state {want!r}"}).encode())
+                    return
+                self._send(404, b"{}")
+
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(n).decode())
                 m = _TASK_RE.match(self.path)
                 if m:
+                    if outer.draining:
+                        # a draining worker accepts no new tasks
+                        self._send(503, json.dumps(
+                            {"error": "worker is shutting down"}).encode())
+                        return
                     tid = m.group(1)
                     task = outer._create_task(tid, req["fragment"])
                     self._send(200, json.dumps(
                         {"taskId": tid, "state": task.state}).encode())
                     return
                 if self.path == "/v1/task":  # legacy one-shot
+                    if outer.draining:
+                        self._send(503, json.dumps(
+                            {"error": "worker is shutting down"}).encode())
+                        return
                     try:
                         fragment = plan_from_json(req["fragment"], outer.catalog)
                         pages = [serialize_page(p)

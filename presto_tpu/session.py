@@ -65,7 +65,8 @@ SYSTEM_PROPERTIES = [
 class Session:
     """Per-query context: properties + (later) principal/tx/trace."""
 
-    def __init__(self, properties: Optional[Dict[str, Any]] = None, user: str = "presto"):
+    def __init__(self, properties: Optional[Dict[str, Any]] = None, user: str = "presto",
+                 trace_token: Optional[str] = None):
         self._meta = {p.name: p for p in SYSTEM_PROPERTIES}
         self.properties: Dict[str, Any] = {
             p.name: p.default for p in SYSTEM_PROPERTIES
@@ -74,6 +75,9 @@ class Session:
             for k, v in properties.items():
                 self.set(k, v)
         self.user = user
+        # request-correlation token (X-Presto-Trace-Token analog); one
+        # is generated per query when the client supplies none
+        self.trace_token = trace_token
 
     def get(self, name: str) -> Any:
         return self.properties[name]

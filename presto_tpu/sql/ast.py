@@ -303,6 +303,23 @@ class DropTable(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class StartTransaction(Node):
+    """START TRANSACTION [READ ONLY] (sql/tree/StartTransaction.java)."""
+
+    read_only: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class Union(Node):
     left: Node  # Query or Union
     right: Node

@@ -408,7 +408,8 @@ class Binder:
         if isinstance(rel, ast.TableRef):
             handle = self.catalog.resolve(rel.name)
             scan = TableScanNode(handle, list(range(len(handle.columns))))
-            return scan, Scope.of(scan, rel.alias or rel.name)
+            # a catalog-qualified name aliases to its bare table name
+            return scan, Scope.of(scan, rel.alias or rel.name.split(".")[-1])
         if isinstance(rel, ast.SubqueryRel):
             node, names = self._plan_query_like(rel.query)
             scope = Scope(

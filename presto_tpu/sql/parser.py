@@ -368,6 +368,9 @@ class Parser:
             self.expect(")")
             return rel
         name = self.ident()
+        while self.peek("."):  # catalog-qualified: catalog.table
+            self.i += 1
+            name += "." + self.ident()
         alias = None
         if self.accept("as"):
             alias = self.ident()
@@ -661,6 +664,23 @@ def parse_query(sql: str) -> ast.Query:
     return Parser(sql).parse_query()
 
 
+def _qualified_name(p: Parser) -> str:
+    name = p.ident()
+    while p.peek("."):
+        p.i += 1
+        name += "." + p.ident()
+    return name
+
+
+def _finish(p: Parser, node: ast.Node) -> ast.Node:
+    """Require end of input (trailing tokens would silently change the
+    statement's meaning, e.g. COMMIT AND CHAIN)."""
+    p.accept(";")
+    if p.tok.kind != "eof":
+        raise SyntaxError(f"trailing input at {p.tok!r}")
+    return node
+
+
 def parse_statement(sql: str) -> ast.Node:
     """Statement-level entry (SqlParser.createStatement analog):
     SELECT | EXPLAIN [ANALYZE] | SET SESSION | SHOW TABLES/COLUMNS/SESSION."""
@@ -684,22 +704,37 @@ def parse_statement(sql: str) -> ast.Node:
         return ast.SetSession(name, value)
     if p.accept("create"):
         p.expect("table")
-        name = p.ident()
+        name = _qualified_name(p)
         p.expect("as")
         q = p._query()
-        p.accept(";")
-        return ast.CreateTableAs(name, q)
+        return _finish(p, ast.CreateTableAs(name, q))
     if p.accept("insert"):
         p.expect("into")
-        name = p.ident()
+        name = _qualified_name(p)
         q = p._query()
-        p.accept(";")
-        return ast.InsertInto(name, q)
+        return _finish(p, ast.InsertInto(name, q))
     if p.accept("drop"):
         p.expect("table")
-        name = p.ident()
-        p.accept(";")
-        return ast.DropTable(name)
+        name = _qualified_name(p)
+        return _finish(p, ast.DropTable(name))
+    if p.accept_word("start"):
+        if p.accept_word("transaction") is None:
+            raise SyntaxError("expected TRANSACTION after START")
+        read_only = False
+        if p.accept_word("read"):
+            if p.accept_word("only"):
+                read_only = True
+            elif p.accept_word("write"):
+                read_only = False
+            else:
+                raise SyntaxError("expected ONLY/WRITE after READ")
+        return _finish(p, ast.StartTransaction(read_only))
+    if p.accept_word("commit"):
+        p.accept_word("work")
+        return _finish(p, ast.Commit())
+    if p.accept_word("rollback"):
+        p.accept_word("work")
+        return _finish(p, ast.Rollback())
     if p.accept("show"):
         if p.accept("tables"):
             p.accept(";")

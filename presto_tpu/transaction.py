@@ -1,0 +1,105 @@
+"""Transaction management.
+
+Reference analog: ``transaction/TransactionManager.java`` — per-query
+transactions with connector-scoped ``ConnectorTransactionHandle``s,
+autocommit for standalone statements, and explicit
+START TRANSACTION / COMMIT / ROLLBACK driven through the session
+(Session.java's transactionId).  Isolation here is snapshot-free
+read-committed over the engine's immutable pages: reads see published
+table state; writes stage per-transaction and publish atomically at
+commit.
+
+Connectors opt in by implementing the duck-typed hooks
+``begin_transaction() -> handle``, ``commit_transaction(handle)`` and
+``rollback_transaction(handle)``; connectors without the hooks behave
+as autocommit-only (the reference's ConnectorMetadata.beginQuery
+no-op default).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+
+class TransactionError(Exception):
+    pass
+
+
+class Transaction:
+    """One open transaction: connector name -> connector tx handle."""
+
+    def __init__(self, tx_id: str, read_only: bool = False):
+        self.tx_id = tx_id
+        self.read_only = read_only
+        self.created_at = time.time()
+        self.handles: Dict[str, object] = {}
+        self._connectors: Dict[str, object] = {}
+
+    def handle_for(self, connector_name: str, connector) -> Optional[object]:
+        """Lazily open the connector-side transaction the first time a
+        statement inside this tx touches that connector."""
+        if connector_name not in self.handles:
+            begin = getattr(connector, "begin_transaction", None)
+            self.handles[connector_name] = begin() if begin else None
+            self._connectors[connector_name] = connector
+        return self.handles[connector_name]
+
+    def commit(self) -> None:
+        for name, handle in self.handles.items():
+            conn = self._connectors[name]
+            fn = getattr(conn, "commit_transaction", None)
+            if fn and handle is not None:
+                fn(handle)
+
+    def rollback(self) -> None:
+        for name, handle in self.handles.items():
+            conn = self._connectors[name]
+            fn = getattr(conn, "rollback_transaction", None)
+            if fn and handle is not None:
+                fn(handle)
+
+
+class TransactionManager:
+    """Registry of open transactions (TransactionManager.java analog).
+    One open transaction per session at most; autocommit transactions
+    are created and resolved around a single statement."""
+
+    def __init__(self):
+        self._open: Dict[str, Transaction] = {}
+        self._lock = threading.Lock()
+
+    def begin(self, read_only: bool = False) -> Transaction:
+        tx = Transaction(f"tx_{uuid.uuid4().hex[:12]}", read_only)
+        with self._lock:
+            self._open[tx.tx_id] = tx
+        return tx
+
+    def get(self, tx_id: str) -> Transaction:
+        with self._lock:
+            tx = self._open.get(tx_id)
+        if tx is None:
+            raise TransactionError(f"unknown or closed transaction {tx_id}")
+        return tx
+
+    def commit(self, tx_id: str) -> None:
+        tx = self.get(tx_id)
+        try:
+            tx.commit()
+        finally:
+            with self._lock:
+                self._open.pop(tx_id, None)
+
+    def rollback(self, tx_id: str) -> None:
+        tx = self.get(tx_id)
+        try:
+            tx.rollback()
+        finally:
+            with self._lock:
+                self._open.pop(tx_id, None)
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open)

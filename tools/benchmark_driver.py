@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Benchmark driver: run SQL suites and report wall-clock percentiles.
+
+Reference analog: ``presto-benchmark-driver`` (BenchmarkDriver.java +
+docs presto-docs/src/main/sphinx/installation/benchmark-driver.rst) —
+a CLI that executes named query suites against an engine and prints
+per-query wall/cpu statistics (median, mean, stddev).
+
+Suites are directories of ``.sql`` files (the layout of
+presto-benchto-benchmarks/src/main/resources/sql/presto/tpch/) or the
+built-in ``tpch``/``tpcds`` corpora from tests/.
+
+Usage:
+  python tools/benchmark_driver.py --suite tpch --sf 0.01 --runs 3
+  python tools/benchmark_driver.py --suite path/to/dir --catalog tpch
+  python tools/benchmark_driver.py --suite tpch --queries q1,q6 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_suite(name: str):
+    """-> list of (query_name, sql)."""
+    if os.path.isdir(name):
+        out = []
+        for fn in sorted(os.listdir(name)):
+            if fn.endswith(".sql"):
+                with open(os.path.join(name, fn)) as f:
+                    out.append((fn[:-4], f.read()))
+        if not out:
+            raise SystemExit(f"no .sql files in {name}")
+        return out
+    if name == "tpch":
+        from tests.tpch_queries import QUERIES
+
+        return [(f"q{i}", sql) for i, sql in sorted(QUERIES.items())]
+    if name == "tpcds":
+        from tests.tpcds_queries import QUERIES
+
+        return [(f"q{i}", sql) for i, sql in sorted(QUERIES.items())]
+    raise SystemExit(f"unknown suite {name!r} (builtin: tpch, tpcds)")
+
+
+def build_runner(args):
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.runner import QueryRunner
+
+    catalog = Catalog()
+    if args.suite == "tpcds" or args.catalog == "tpcds":
+        from presto_tpu.connectors.tpcds import Tpcds
+
+        catalog.register("tpcds", Tpcds(sf=args.sf))
+    else:
+        from presto_tpu.connectors.tpch import Tpch
+
+        catalog.register("tpch", Tpch(sf=args.sf))
+    return QueryRunner(catalog)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--suite", default="tpch",
+                    help="builtin suite name (tpch/tpcds) or a directory of .sql files")
+    ap.add_argument("--catalog", default=None,
+                    help="builtin catalog to register for directory suites")
+    ap.add_argument("--sf", type=float, default=0.01, help="generator scale factor")
+    ap.add_argument("--runs", type=int, default=3, help="timed runs per query (after 1 warmup)")
+    ap.add_argument("--queries", default=None, help="comma list filter, e.g. q1,q6")
+    ap.add_argument("--cpu", action="store_true", help="force the XLA CPU backend")
+    ap.add_argument("--json", action="store_true", help="one JSON line per query")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import presto_tpu  # noqa: F401  (x64 etc.)
+
+    suite = load_suite(args.suite)
+    if args.queries:
+        want = set(args.queries.split(","))
+        suite = [(n, q) for n, q in suite if n in want]
+        if not suite:
+            raise SystemExit(f"no queries match {args.queries!r}")
+
+    runner = build_runner(args)
+
+    results = []
+    for name, sql in suite:
+        try:
+            t0 = time.time()
+            res = runner.execute(sql)
+            warmup = time.time() - t0
+            times = []
+            for _ in range(args.runs):
+                t0 = time.time()
+                runner.execute(sql)
+                times.append(time.time() - t0)
+            row = {
+                "query": name,
+                "rows": len(res),
+                "warmup_s": round(warmup, 3),
+                "median_s": round(statistics.median(times), 4),
+                "mean_s": round(statistics.mean(times), 4),
+                "min_s": round(min(times), 4),
+                "max_s": round(max(times), 4),
+                "stddev_s": round(statistics.stdev(times), 4) if len(times) > 1 else 0.0,
+            }
+        except Exception as e:
+            row = {"query": name, "error": f"{type(e).__name__}: {e}"}
+        results.append(row)
+        if args.json:
+            print(json.dumps(row), flush=True)
+        elif "error" in row:
+            print(f"{name:>8}  ERROR {row['error']}", flush=True)
+        else:
+            print(f"{name:>8}  rows={row['rows']:<8} median={row['median_s']:.4f}s "
+                  f"mean={row['mean_s']:.4f}s min={row['min_s']:.4f}s "
+                  f"max={row['max_s']:.4f}s (warmup {row['warmup_s']:.1f}s)",
+                  flush=True)
+
+    ok = [r for r in results if "error" not in r]
+    if ok and not args.json:
+        total = sum(r["median_s"] for r in ok)
+        print(f"\n{len(ok)}/{len(results)} queries ok; total median wall {total:.2f}s")
+    sys.exit(0 if len(ok) == len(results) else 1)
+
+
+if __name__ == "__main__":
+    main()
