@@ -1,0 +1,111 @@
+"""Local-file connector: directories of csv / json-lines as tables.
+
+Reference analog: ``presto-local-file`` (reads server log files via a
+declared schema) combined with the record-decoder layer the kafka/redis
+connectors share (presto-record-decoder).  One table = one file or one
+directory of same-format files; one file = one split.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.connectors.jdbc import _encode_column
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.record_decoder import decoder_for
+from presto_tpu.types import Type, parse_type
+
+
+class LocalFileConnector:
+    """Tables registered as (name, path, format, schema).
+
+    ``schema`` entries use SQL type names ('bigint', 'double',
+    'varchar', 'date', ...); dates/timestamps parse from ISO strings.
+    """
+
+    def __init__(self):
+        self._tables: Dict[str, dict] = {}
+        self._cache: Dict[str, List[Page]] = {}
+        self._dicts: Dict[str, Dict[str, Dictionary]] = {}
+
+    def add_table(self, name: str, path: str, fmt: str,
+                  schema: Sequence[Tuple[str, str]], **decoder_kw) -> None:
+        typed = [(c, parse_type(t) if isinstance(t, str) else t)
+                 for c, t in schema]
+        self._tables[name] = {
+            "path": path, "fmt": fmt, "schema": typed, "kw": decoder_kw,
+        }
+
+    # -- connector protocol -------------------------------------------------
+    def table_names(self) -> List[str]:
+        return list(self._tables)
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return self._tables[table]["schema"]
+
+    def _files(self, table: str) -> List[str]:
+        path = self._tables[table]["path"]
+        if os.path.isdir(path):
+            return [os.path.join(path, f) for f in sorted(os.listdir(path))
+                    if not f.startswith(".")]
+        return [path]
+
+    def num_splits(self, table: str) -> int:
+        return max(1, len(self._files(table)))
+
+    def row_count(self, table: str) -> int:
+        self._load(table)
+        import numpy as np
+
+        return sum(int(np.asarray(p.row_mask).sum()) for p in self._cache[table])
+
+    def page_for_split(self, table: str, split: int,
+                       capacity: Optional[int] = None) -> Page:
+        self._load(table)
+        return self._cache[table][split]
+
+    def dictionary_for(self, table: str, column: str):
+        self._load(table)
+        return self._dicts.get(table, {}).get(column)
+
+    # -- loading ------------------------------------------------------------
+    def _load(self, table: str) -> None:
+        if table in self._cache:
+            return
+        meta = self._tables[table]
+        schema = meta["schema"]
+        dec = decoder_for(meta["fmt"], schema, **meta["kw"])
+        dicts: Dict[str, Dictionary] = {}
+        pages = []
+        for path in self._files(table):
+            with open(path) as f:
+                cols_raw = dec.decode(f)
+            cols, valids, page_dicts = [], [], []
+            for (name, t), raw in zip(schema, cols_raw):
+                converted = [_convert_temporal(v, t) for v in raw]
+                data, valid, d = _encode_column(converted, t, dicts.get(name))
+                if d is not None:
+                    dicts[name] = d
+                cols.append(data)
+                valids.append(valid)
+                page_dicts.append(d)
+            pages.append(Page.from_arrays(cols, [t for _, t in schema],
+                                          valids=valids, dictionaries=page_dicts))
+        self._cache[table] = pages
+        self._dicts[table] = dicts
+
+
+def _convert_temporal(v, t: Type):
+    if v is None:
+        return None
+    if t.name == "date":
+        from presto_tpu.connectors.jdbc import _parse_date
+
+        return _parse_date(v)
+    if t.name == "timestamp":
+        from presto_tpu.connectors.jdbc import _parse_ts
+
+        return _parse_ts(v)
+    return v

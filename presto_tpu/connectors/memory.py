@@ -21,6 +21,7 @@ class MemoryConnector:
         self._schemas: Dict[str, List[Tuple[str, Type]]] = {}
         self._domains: Dict[str, Dict[str, Optional[Tuple[int, int]]]] = {}
         self._pks: Dict[str, Optional[List[str]]] = {}
+        self._sort: Dict[str, Optional[List[str]]] = {}
         self._dicts: Dict[str, Dict[str, object]] = {}
 
     # -- loading ------------------------------------------------------------
@@ -31,11 +32,13 @@ class MemoryConnector:
         pages: Sequence[Page],
         domains: Optional[Dict[str, Tuple[int, int]]] = None,
         primary_key: Optional[List[str]] = None,
+        sort_order: Optional[List[str]] = None,
     ) -> None:
         self._tables[name] = [_to_device(p) for p in pages]
         self._schemas[name] = list(schema)
         self._domains[name] = dict(domains or {})
         self._pks[name] = primary_key
+        self._sort[name] = list(sort_order) if sort_order else None
         self._dicts[name] = {}
         for page in pages[:1]:
             for (col, t), b in zip(schema, page.blocks):
@@ -46,7 +49,8 @@ class MemoryConnector:
         self._tables[name].extend(_to_device(p) for p in pages)
 
     def drop_table(self, name: str) -> None:
-        for d in (self._tables, self._schemas, self._domains, self._pks, self._dicts):
+        for d in (self._tables, self._schemas, self._domains, self._pks,
+                  self._sort, self._dicts):
             d.pop(name, None)
 
     def load_from(self, conn, table: str, name: Optional[str] = None,
@@ -69,7 +73,10 @@ class MemoryConnector:
         pk = conn.primary_key(table) if hasattr(conn, "primary_key") else None
         if pk is not None and any(c not in [n for n, _ in pruned_schema] for c in pk):
             pk = None
-        self.create_table(name, pruned_schema, pages, domains, pk)
+        so = conn.sort_order(table) if hasattr(conn, "sort_order") else None
+        if so is not None and any(c not in [n for n, _ in pruned_schema] for c in so):
+            so = None
+        self.create_table(name, pruned_schema, pages, domains, pk, sort_order=so)
 
     # -- connector protocol -------------------------------------------------
     def table_names(self) -> List[str]:
@@ -94,6 +101,12 @@ class MemoryConnector:
 
     def primary_key(self, table: str) -> Optional[List[str]]:
         return self._pks.get(table)
+
+    def sort_order(self, table: str) -> Optional[List[str]]:
+        """Declared physical ordering of the stored pages (feeds the
+        streaming-aggregation path; ConnectorMetadata local-properties
+        analog)."""
+        return self._sort.get(table)
 
     def dictionary_for(self, table: str, column: str):
         return self._dicts.get(table, {}).get(column)

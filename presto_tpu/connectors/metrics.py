@@ -1,0 +1,113 @@
+"""Runtime-metrics connector: process/VM internals as queryable tables.
+
+Reference analog: ``presto-jmx`` (JMX MBeans of each node queryable as
+SQL tables — jmx.current."java.lang:type=memory" etc.).  The python
+runtime's equivalents: process memory/cpu from /proc, gc generation
+stats, thread counts, and the JAX device inventory.
+
+Tables:
+  runtime   one row per (name, value) process metric
+  gc        one row per gc generation
+  devices   one row per jax device
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR, Type
+
+
+def _proc_status() -> Dict[str, int]:
+    out = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(("VmRSS", "VmHWM", "VmSize", "Threads")):
+                    k, v = line.split(":", 1)
+                    out[k] = int(v.strip().split()[0])
+    except OSError:
+        pass
+    return out
+
+
+class MetricsConnector:
+    """Live metrics snapshot per scan (presto-jmx analog)."""
+
+    def table_names(self) -> List[str]:
+        return ["runtime", "gc", "devices"]
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        if table == "runtime":
+            return [("name", VARCHAR), ("value", DOUBLE)]
+        if table == "gc":
+            return [("generation", BIGINT), ("collections", BIGINT),
+                    ("collected", BIGINT), ("uncollectable", BIGINT)]
+        if table == "devices":
+            return [("id", BIGINT), ("platform", VARCHAR), ("kind", VARCHAR)]
+        raise KeyError(table)
+
+    def num_splits(self, table: str) -> int:
+        return 1
+
+    def row_count(self, table: str) -> int:
+        return int(np.asarray(self.page_for_split(table, 0).row_mask).sum())
+
+    def page_for_split(self, table: str, split: int,
+                       capacity: Optional[int] = None) -> Page:
+        if table == "runtime":
+            status = _proc_status()
+            cpu = os.times()
+            rows = [
+                ("process.rss_kb", float(status.get("VmRSS", 0))),
+                ("process.peak_rss_kb", float(status.get("VmHWM", 0))),
+                ("process.vsize_kb", float(status.get("VmSize", 0))),
+                ("process.threads", float(threading.active_count())),
+                ("process.cpu_user_s", float(cpu.user)),
+                ("process.cpu_system_s", float(cpu.system)),
+                ("process.uptime_s", float(time.monotonic())),
+            ]
+            names = [r[0] for r in rows]
+            d = Dictionary(names)
+            return Page.from_arrays(
+                [np.arange(len(rows), dtype=np.int32),
+                 np.asarray([r[1] for r in rows])],
+                [VARCHAR, DOUBLE], dictionaries=[d, None],
+            )
+        if table == "gc":
+            stats = gc.get_stats()
+            return Page.from_arrays(
+                [np.arange(len(stats), dtype=np.int64),
+                 np.asarray([s.get("collections", 0) for s in stats], np.int64),
+                 np.asarray([s.get("collected", 0) for s in stats], np.int64),
+                 np.asarray([s.get("uncollectable", 0) for s in stats], np.int64)],
+                [BIGINT] * 4,
+            )
+        if table == "devices":
+            import jax
+
+            devs = jax.devices()
+            plats = Dictionary(sorted({d.platform for d in devs}))
+            kinds = Dictionary(sorted({d.device_kind for d in devs}))
+            return Page.from_arrays(
+                [np.asarray([d.id for d in devs], np.int64),
+                 np.asarray([plats.code_of(d.platform) for d in devs], np.int32),
+                 np.asarray([kinds.code_of(d.device_kind) for d in devs], np.int32)],
+                [BIGINT, VARCHAR, VARCHAR], dictionaries=[None, plats, kinds],
+            )
+        raise KeyError(table)
+
+    def dictionary_for(self, table: str, column: str):
+        # dictionaries are per-snapshot; predicates re-resolve per scan
+        page = self.page_for_split(table, 0)
+        for (name, t), b in zip(self.schema(table), page.blocks):
+            if name == column:
+                return b.dictionary
+        return None

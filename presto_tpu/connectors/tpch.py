@@ -644,6 +644,13 @@ class Tpch:
             "lineitem": ["l_orderkey", "l_linenumber"],
         }.get(table)
 
+    def sort_order(self, table: str) -> Optional[List[str]]:
+        """The generator emits rows in primary-key order (sequential
+        keys per split), so the physical ordering IS the primary key —
+        the streaming-aggregation trigger (ConnectorMetadata
+        local-properties analog)."""
+        return self.primary_key(table)
+
     def column_ndv(self, table: str, column: str) -> Optional[int]:
         """Distinct-value counts where the domain width overstates them
         (sparse keys: orderkeys skip 8-of-32 slots). Reference analog:

@@ -207,6 +207,9 @@ class LocalRunner:
         self.memory_pool = memory_pool
         # host-RAM spill fan-out when state exceeds the pool/threshold
         self.spill_partitions = spill_partitions
+        # multi-producer ORDER BY: per-page sorts + order-preserving
+        # merge (distributed_sort session property analog)
+        self.merge_sort = True
         # per-THREAD query memory context: concurrent queries share one
         # runner (the coordinator runs each on its own thread), so the
         # active context must not be clobbered across threads
@@ -338,19 +341,33 @@ class LocalRunner:
             return
 
         if isinstance(node, SortNode):
-            src = self._execute_to_page(node.source)
-            self._account("sort_input", src)
+            sort_exprs = list(node.sort_exprs)
+            ascending = list(node.ascending)
+            nulls_first = node.nulls_first
             fn = self._fold_cache.get(node)
             if fn is None:
-                sort_exprs = list(node.sort_exprs)
-                ascending = list(node.ascending)
-                nulls_first = node.nulls_first
 
                 def do_sort(p):
                     return sort_page(p, sort_exprs, ascending, nulls_first)
 
                 fn = jax.jit(do_sort) if self.jit else do_sort
                 self._fold_cache[node] = fn
+            pages = list(self._pages(node.source))
+            if len(pages) > 1 and self.merge_sort:
+                # distributed-sort shape: sort each producer page, then
+                # an order-preserving k-way merge (MergeOperator.java:45
+                # + MergeHashSort) — no monolithic re-sort of the union
+                from presto_tpu.ops.merge import merge_sorted_pages
+
+                sorted_pages = [fn(p) for p in pages]
+                for p in sorted_pages:
+                    self._account("sort_input", p)
+                yield merge_sorted_pages(sorted_pages, sort_exprs,
+                                         ascending, nulls_first)
+                return
+            src = concat_pages_device(pages) if pages else Page.empty(
+                node.output_types, 1)
+            self._account("sort_input", src)
             yield fn(src)
             return
 
@@ -501,10 +518,12 @@ class LocalRunner:
             aggs = list(node.aggs)
             mg = self._max_groups(node)
             kd = node.key_domains
+            presorted = node.presorted
 
             def agg_stage(p, c):
                 return grouped_aggregate(
-                    inner(p, c), group_exprs, aggs, mg, key_domains=kd, mode="partial"
+                    inner(p, c), group_exprs, aggs, mg, key_domains=kd,
+                    mode="partial", presorted=presorted,
                 )
 
             return agg_stage
@@ -913,6 +932,7 @@ class LocalRunner:
                     agg_names=node.agg_names,
                     step="partial",
                     max_groups=node.max_groups,
+                    presorted=node.presorted,
                 )
                 self._partial_nodes[node] = partial
             self._agg_overrides[partial] = mg

@@ -47,12 +47,18 @@ _DERIVED_DICTS: dict = {}
 # (SQL NULL) — compile() folds a null-LUT into validity.
 STRING_TRANSFORM_FNS = frozenset({
     "substr", "upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+    "char2hexint",
     "regexp_extract", "regexp_replace", "replace", "split_part",
     "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
     "url_extract_host", "url_extract_path", "url_extract_protocol",
     "url_extract_query",
 })
 
+
+_GEO_FNS = frozenset({
+    "st_geometryfromtext", "st_point", "st_distance", "st_contains",
+    "st_area", "st_x", "st_y",
+})
 
 _CONTAINER_FNS = frozenset({
     "array_construct", "subscript", "element_at", "cardinality",
@@ -108,6 +114,9 @@ def _string_transform(e: "Call"):
              "ltrim": str.lstrip, "rtrim": str.rstrip,
              "reverse": lambda s: s[::-1]}[fn]
         return f, key
+    if fn == "char2hexint":
+        # teradata: utf-16be code units as uppercase hex
+        return lambda v: "".join(f"{ord(ch):04X}" for ch in v), key
     if fn == "regexp_extract":
         rx = re.compile(e.args[1].value)
         group = int(e.args[2].value) if len(e.args) > 2 else 0
@@ -366,6 +375,10 @@ class ExprCompiler:
         fn = expr.fn
         if fn in _CONTAINER_FNS:
             return self._compile_container(expr)
+        if fn in _GEO_FNS:
+            return self._compile_geo(expr)
+        if fn in ("regress", "classify"):
+            return self._compile_ml(expr)
         if fn in ("and", "or"):
             return self._compile_logic(expr)
         if fn == "not":
@@ -699,6 +712,184 @@ class ExprCompiler:
             return _hll_from_hash(h, fn), v
 
         return run_hll
+
+    def _compile_ml(self, expr: Call) -> CompiledExpr:
+        """regress(model, features) / classify(model, features) —
+        models are ARRAY(double) values from learn_regressor /
+        learn_classifier (presto-ml's model type realized as plain
+        arrays, so inference is pure device math)."""
+        from presto_tpu.ops import container as ct
+
+        model_e, feats_e = expr.args
+        mf = self.compile(model_e)
+        ff = self.compile(feats_e)
+        mt, ft = model_e.type, feats_e.type
+        if not (mt.is_array and ft.is_array):
+            raise ValueError(f"{expr.fn} expects (model array, features array)")
+        k = ft.max_elems
+
+        def feats_matrix(fd):
+            slots = ct.elem_slots(fd, ft)
+            return jnp.where(ct.elem_null_mask(slots), 0.0,
+                             slots.astype(jnp.float64))
+
+        if expr.fn == "regress":
+
+            def run_regress(page):
+                (md, mv), (fd, fv) = mf(page), ff(page)
+                w = ct.elem_slots(md, mt).astype(jnp.float64)
+                x = feats_matrix(fd)
+                pred = jnp.sum(w[:, :k] * x, axis=1) + w[:, k]
+                return pred, mv & fv
+
+            return run_regress
+
+        from presto_tpu.ops.aggregate import ML_MAX_CLASSES
+
+        C = ML_MAX_CLASSES
+
+        def run_classify(page):
+            (md, mv), (fd, fv) = mf(page), ff(page)
+            m = ct.elem_slots(md, mt).astype(jnp.float64)
+            x = feats_matrix(fd)
+            n = x.shape[0]
+            prior = m[:, 1 : 1 + C]
+            mean = m[:, 1 + C : 1 + C + C * k].reshape(n, C, k)
+            var = jnp.maximum(m[:, 1 + C + C * k : 1 + C + 2 * C * k]
+                              .reshape(n, C, k), 1e-12)
+            ll = jnp.log(jnp.maximum(prior, 1e-12)) + jnp.sum(
+                -0.5 * jnp.log(2 * jnp.pi * var)
+                - (x[:, None, :] - mean) ** 2 / (2 * var), axis=2)
+            return jnp.argmax(ll, axis=1).astype(jnp.int64), mv & fv
+
+        return run_classify
+
+    def _compile_geo(self, expr: Call) -> CompiledExpr:
+        """ST_* functions (presto-geospatial GeoFunctions.java).  WKT
+        geometries ride dictionary varchar: host parse per distinct
+        value, device kernels per row (geo.py)."""
+        from presto_tpu import geo
+
+        fn = expr.fn
+        if fn == "st_geometryfromtext":
+            arg = expr.args[0]
+            if isinstance(arg, Literal) and arg.value is not None:
+                geo.parse_wkt(str(arg.value))  # fail at compile, not per row
+            return self.compile(arg)
+        if fn == "st_point":
+            raise ValueError(
+                "ST_Point is only usable inside ST_Distance / ST_Contains")
+        if fn in ("st_area", "st_x", "st_y"):
+            host = {"st_area": geo.st_area, "st_x": geo.st_x, "st_y": geo.st_y}[fn]
+            return self._geo_float_lut(expr.args[0], host)
+        if fn == "st_distance":
+            ax, ay = self._point_accessor(expr.args[0])
+            bx, by = self._point_accessor(expr.args[1])
+
+            def run_dist(page):
+                (x1, v1), (y1, vy1) = ax(page), ay(page)
+                (x2, v2), (y2, vy2) = bx(page), by(page)
+                return (geo.point_distance(x1, y1, x2, y2),
+                        v1 & vy1 & v2 & vy2)
+
+            return run_dist
+        assert fn == "st_contains"
+        garg = _unwrap_geomtext(expr.args[0])
+        px, py = self._point_accessor(expr.args[1])
+        if isinstance(garg, Literal):
+            g = geo.parse_wkt(str(garg.value))
+
+            def run_contains_lit(page):
+                (x, vx), (y, vy) = px(page), py(page)
+                hit = geo.bbox_mask(g.bbox, x, y) & geo.points_in_geometry(g, x, y)
+                return hit, vx & vy
+
+            return run_contains_lit
+        # dictionary-coded geometry column: one fused PIP per distinct
+        # geometry, selected by code (the spatial-join inner kernel)
+        d = self._dict_of(garg)
+        if d is None:
+            raise ValueError("ST_Contains geometry must be a WKT literal or "
+                             "dictionary varchar column")
+        cf = self.compile(garg)
+        geoms = []
+        for v in d.values:
+            try:
+                geoms.append(geo.parse_wkt(v))
+            except Exception:
+                geoms.append(None)
+
+        def run_contains_col(page):
+            (code, vg) = cf(page)
+            (x, vx), (y, vy) = px(page), py(page)
+            hit = jnp.zeros(x.shape[0], dtype=jnp.bool_)
+            ok = jnp.zeros(x.shape[0], dtype=jnp.bool_)
+            for gi, g in enumerate(geoms):
+                sel = code == gi
+                if g is None:
+                    continue
+                ok = ok | sel
+                ghit = geo.bbox_mask(g.bbox, x, y) & geo.points_in_geometry(g, x, y)
+                hit = jnp.where(sel, ghit, hit)
+            return hit, vg & vx & vy & ok
+
+        return run_contains_col
+
+    def _geo_float_lut(self, arg: Expr, host) -> CompiledExpr:
+        """varchar WKT -> float via host LUT over the dictionary."""
+        arg = _unwrap_geomtext(arg)
+        if isinstance(arg, Literal):
+            val = host(str(arg.value)) if arg.value is not None else None
+
+            def run_const(page):
+                n = page.capacity
+                return (jnp.full(n, 0.0 if val is None else float(val)),
+                        jnp.full(n, val is not None))
+
+            return run_const
+        d = self._dict_of(arg)
+        if d is None:
+            raise ValueError("geometry argument needs a WKT literal or "
+                             "dictionary varchar column")
+        cf = self.compile(arg)
+        vals = []
+        for v in d.values:
+            try:
+                vals.append(host(v))
+            except Exception:
+                vals.append(None)
+        lut = jnp.asarray([0.0 if v is None else float(v) for v in vals])
+        vlut = jnp.asarray([v is not None for v in vals])
+
+        def run_lut(page):
+            code, v = cf(page)
+            c = jnp.clip(code, 0, lut.shape[0] - 1)
+            return lut[c], v & vlut[c]
+
+        return run_lut
+
+    def _point_accessor(self, e: Expr):
+        """-> (x_fn, y_fn) compiled accessors for a point operand:
+        ST_Point(x, y) call, WKT literal, or dictionary point column."""
+        e = _unwrap_geomtext(e)
+        if isinstance(e, Call) and e.fn == "st_point":
+            xa = self.compile(e.args[0])
+            ya = self.compile(e.args[1])
+            tx, ty = e.args[0].type, e.args[1].type
+
+            def run_x(page):
+                data, v = xa(page)
+                return _to_double(data, tx), v
+
+            def run_y(page):
+                data, v = ya(page)
+                return _to_double(data, ty), v
+
+            return run_x, run_y
+        from presto_tpu import geo
+
+        return (self._geo_float_lut(e, geo.st_x),
+                self._geo_float_lut(e, geo.st_y))
 
     def _compile_container(self, expr: Call) -> CompiledExpr:
         """ARRAY/MAP functions -> masked trailing-axis vector kernels
@@ -1706,6 +1897,14 @@ class ExprCompiler:
                 return limbs[..., 0] * d128.BASE + limbs[..., 1]  # exact in range
             return data.astype(jnp.int64)
         return data
+
+
+def _unwrap_geomtext(e: Expr) -> Expr:
+    """ST_GeometryFromText is representation-transparent (WKT in, WKT
+    out): peel it so accessors see the underlying literal/column."""
+    while isinstance(e, Call) and e.fn == "st_geometryfromtext":
+        e = e.args[0]
+    return e
 
 
 def _civil_from_days(z: jax.Array):

@@ -173,7 +173,8 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
             else:
                 raise TypeError("concat mixes raw and dictionary strings")
         return VarcharType(max(width, 1), raw=True)
-    if fn in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+    if fn in ("char2hexint",
+              "upper", "lower", "trim", "ltrim", "rtrim", "reverse",
               "regexp_extract", "regexp_replace", "replace", "split_part",
               "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
               "json_format", "url_extract_host", "url_extract_path",
@@ -205,6 +206,22 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return DecimalType(int(args[1].value), int(args[2].value))
     if fn == "substr":
         return ts[0]  # dictionary codes pass through; values derive
+    # -- ML (reference: presto-ml LearnClassifierAggregation etc.)
+    if fn == "regress":
+        return DOUBLE
+    if fn == "classify":
+        return BIGINT
+    # -- geospatial (reference: presto-geospatial GeoFunctions.java)
+    if fn in ("st_area", "st_x", "st_y", "st_distance"):
+        return DOUBLE
+    if fn == "st_contains":
+        return BOOLEAN
+    if fn == "st_geometryfromtext":
+        return ts[0]
+    if fn == "st_point":
+        from presto_tpu.types import GEOMETRY_POINT
+
+        return GEOMETRY_POINT
     # -- ARRAY / MAP (reference: operator/scalar/ArrayFunctions et al.)
     if fn == "array_construct":
         from presto_tpu.types import ArrayType

@@ -1,0 +1,230 @@
+"""Geospatial support: WKT geometries, ST_* kernels, spatial index.
+
+Reference analog: ``presto-geospatial`` / ``presto-geospatial-toolkit``
+(GeoFunctions.java ST_* scalar functions over an ESRI/JTS geometry
+type) and the spatial join tier (operator/SpatialJoinOperator.java:38
+with PagesRTreeIndex.java).
+
+TPU re-design: geometries are WKT strings riding the engine's
+dictionary-coded VARCHAR columns (parse once per distinct value,
+host-side), while the per-row hot paths — point-in-polygon tests and
+point distances — run as vectorized device kernels: a polygon is a
+static (nv, 2) vertex array, and ray-casting over N probe points is a
+single broadcast compare/accumulate that XLA fuses.  The spatial join
+prefilters with bounding boxes (the R-tree's role: cheap candidate
+rejection) and runs one fused PIP kernel per build geometry.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# WKT parsing (host; once per distinct geometry string)
+# ---------------------------------------------------------------------------
+
+_WKT_CACHE: Dict[str, "Geometry"] = {}
+
+
+class Geometry:
+    """Parsed geometry: kind + rings (list of (nv, 2) float arrays).
+    POINT -> one 1-vertex ring; POLYGON -> outer ring + holes;
+    MULTIPOLYGON -> list of (outer, holes) groups flattened with signs.
+    """
+
+    __slots__ = ("kind", "rings", "holes", "bbox")
+
+    def __init__(self, kind: str, rings: List[np.ndarray], holes: List[bool]):
+        self.kind = kind
+        self.rings = rings
+        self.holes = holes
+        if rings:
+            allv = np.concatenate(rings, axis=0)
+            self.bbox = (float(allv[:, 0].min()), float(allv[:, 1].min()),
+                         float(allv[:, 0].max()), float(allv[:, 1].max()))
+        else:
+            self.bbox = (math.inf, math.inf, -math.inf, -math.inf)
+
+    @property
+    def point(self) -> Tuple[float, float]:
+        assert self.kind == "POINT"
+        return float(self.rings[0][0, 0]), float(self.rings[0][0, 1])
+
+
+def _parse_ring(text: str) -> np.ndarray:
+    pts = []
+    for pair in text.split(","):
+        xy = pair.strip().split()
+        pts.append((float(xy[0]), float(xy[1])))
+    return np.asarray(pts, dtype=np.float64)
+
+
+def parse_wkt(wkt: str) -> Geometry:
+    """POINT / POLYGON / MULTIPOLYGON subset of GeoFunctions'
+    ST_GeometryFromText surface."""
+    cached = _WKT_CACHE.get(wkt)
+    if cached is not None:
+        return cached
+    s = wkt.strip()
+    m = re.match(r"(?is)^\s*POINT\s*\(\s*([-\d.eE]+)\s+([-\d.eE]+)\s*\)\s*$", s)
+    if m:
+        g = Geometry("POINT", [np.asarray([[float(m.group(1)), float(m.group(2))]])], [False])
+        _WKT_CACHE[wkt] = g
+        return g
+    m = re.match(r"(?is)^\s*POLYGON\s*\((.*)\)\s*$", s)
+    if m:
+        rings, holes = _parse_poly_body(m.group(1))
+        g = Geometry("POLYGON", rings, holes)
+        _WKT_CACHE[wkt] = g
+        return g
+    m = re.match(r"(?is)^\s*MULTIPOLYGON\s*\((.*)\)\s*$", s)
+    if m:
+        body = m.group(1)
+        rings: List[np.ndarray] = []
+        holes: List[bool] = []
+        for poly in _split_top(body):
+            poly = poly.strip()
+            if poly.startswith("("):
+                poly = poly[1:-1]
+            r, h = _parse_poly_body(poly)
+            rings.extend(r)
+            holes.extend(h)
+        g = Geometry("MULTIPOLYGON", rings, holes)
+        _WKT_CACHE[wkt] = g
+        return g
+    raise ValueError(f"unsupported WKT: {wkt[:40]!r}")
+
+
+def _split_top(s: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _parse_poly_body(body: str):
+    """'(ring1),(ring2)...' -> rings + hole flags (first ring = shell)."""
+    rings, holes = [], []
+    for i, ring in enumerate(_split_top(body)):
+        ring = ring.strip()
+        if ring.startswith("("):
+            ring = ring[1:-1]
+        rings.append(_parse_ring(ring))
+        holes.append(i > 0)
+    return rings, holes
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+def points_in_geometry(g: Geometry, xs: jax.Array, ys: jax.Array) -> jax.Array:
+    """Vectorized point-in-polygon over N probe points: even-odd
+    ray casting per ring, XOR of shells and holes (the PIP hot loop of
+    the reference's EsriGeometry contains, vectorized).  Boundary
+    points follow the even-odd rule's edge convention."""
+    if g.kind == "POINT":
+        px, py = g.point
+        return (xs == px) & (ys == py)
+    inside = jnp.zeros(xs.shape[0], dtype=jnp.bool_)
+    for ring in g.rings:
+        vx = jnp.asarray(ring[:, 0])
+        vy = jnp.asarray(ring[:, 1])
+        vx2 = jnp.roll(vx, -1)
+        vy2 = jnp.roll(vy, -1)
+        # edge crosses the horizontal ray at y if one endpoint is above
+        # and the other at-or-below; x-intersection right of the point
+        cond = (vy[None, :] > ys[:, None]) != (vy2[None, :] > ys[:, None])
+        denom = vy2[None, :] - vy[None, :]
+        t = jnp.where(cond, (ys[:, None] - vy[None, :]) / jnp.where(denom == 0, 1.0, denom), 0.0)
+        xint = vx[None, :] + t * (vx2[None, :] - vx[None, :])
+        crossings = jnp.sum((cond & (xint > xs[:, None])).astype(jnp.int32), axis=1)
+        inside = inside ^ (crossings % 2 == 1)
+    return inside
+
+
+def point_distance(x1, y1, x2, y2):
+    return jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+
+
+def bbox_mask(bbox, xs: jax.Array, ys: jax.Array) -> jax.Array:
+    x0, y0, x1, y1 = bbox
+    return (xs >= x0) & (xs <= x1) & (ys >= y0) & (ys <= y1)
+
+
+# ---------------------------------------------------------------------------
+# host-side geometry scalar ops (per distinct WKT; dictionary LUT path)
+# ---------------------------------------------------------------------------
+
+def st_area(wkt: str) -> float:
+    g = parse_wkt(wkt)
+    total = 0.0
+    for ring, hole in zip(g.rings, g.holes):
+        x, y = ring[:, 0], ring[:, 1]
+        a = 0.5 * abs(float(np.dot(x, np.roll(y, -1)) - np.dot(np.roll(x, -1), y)))
+        total += -a if hole else a
+    return total
+
+
+def st_x(wkt: str) -> Optional[float]:
+    g = parse_wkt(wkt)
+    return g.point[0] if g.kind == "POINT" else None
+
+
+def st_y(wkt: str) -> Optional[float]:
+    g = parse_wkt(wkt)
+    return g.point[1] if g.kind == "POINT" else None
+
+
+def st_contains_host(outer_wkt: str, inner_wkt: str) -> bool:
+    """Host fallback for geometry×geometry containment: inner POINT
+    only (the engine's device path covers point probes; polygon-in-
+    polygon is out of the v1 subset)."""
+    inner = parse_wkt(inner_wkt)
+    if inner.kind != "POINT":
+        raise ValueError("ST_Contains inner operand must be a POINT")
+    g = parse_wkt(outer_wkt)
+    x, y = inner.point
+    return bool(np.asarray(points_in_geometry(
+        g, jnp.asarray([x]), jnp.asarray([y])))[0])
+
+
+# ---------------------------------------------------------------------------
+# spatial join (SpatialJoinOperator + PagesRTreeIndex analog)
+# ---------------------------------------------------------------------------
+
+class SpatialIndex:
+    """Build-side index: parsed geometries + bboxes.  The R-tree's job
+    (reject distant candidates cheaply) is done by the vectorized bbox
+    mask; each surviving geometry runs one fused PIP kernel."""
+
+    def __init__(self, wkts: Sequence[str]):
+        self.geoms = [parse_wkt(w) if w is not None else None for w in wkts]
+
+    def probe(self, xs: jax.Array, ys: jax.Array) -> List[Tuple[int, jax.Array]]:
+        """-> [(build_index, bool mask over probe rows)] for geometries
+        with any bbox-candidate points."""
+        out = []
+        for i, g in enumerate(self.geoms):
+            if g is None:
+                continue
+            cand = bbox_mask(g.bbox, xs, ys)
+            hit = cand & points_in_geometry(g, xs, ys)
+            out.append((i, hit))
+        return out

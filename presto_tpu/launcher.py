@@ -1,0 +1,81 @@
+"""Server launcher: start a coordinator or worker from an etc/ directory.
+
+Reference analog: ``presto-server``'s bin/launcher + PrestoServer.java
+bootstrap (role selection via config.properties ``coordinator=true``,
+catalogs from etc/catalog/*.properties).  Usage:
+
+  python -m presto_tpu.launcher run --etc etc/            # foreground
+  python -m presto_tpu.launcher run --etc etc/ --port 8080
+
+A coordinator serves the V1 statement protocol (server/coordinator.py);
+a worker serves the task protocol (server/worker.py).  Workers register
+with the coordinator via ``discovery.uri`` the way reference workers
+announce to airlift discovery.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def build_from_etc(etc_dir: str, port: int = 0):
+    from presto_tpu.config import EngineConfig
+    from presto_tpu.runner import QueryRunner
+
+    cfg = EngineConfig.from_etc(etc_dir)
+    catalog = cfg.build_catalog()
+    port = port or cfg.int("http-server.http.port", 0)
+    if cfg.bool("coordinator", True):
+        from presto_tpu.server.coordinator import CoordinatorServer
+
+        runner = QueryRunner(catalog, session=cfg.build_session())
+        server = CoordinatorServer(runner, port=port)
+        role = "coordinator"
+    else:
+        from presto_tpu.server.worker import WorkerServer
+
+        server = WorkerServer(
+            catalog,
+            port=port,
+            buffer_bytes=cfg.int("task.buffer-bytes", 64 << 20),
+        )
+        role = "worker"
+    return server, role, cfg
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="presto_tpu.launcher", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    run = sub.add_parser("run", help="run the server in the foreground")
+    run.add_argument("--etc", required=True, help="etc/ config directory")
+    run.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    server, role, cfg = build_from_etc(args.etc, args.port)
+    server.start()
+    uri = server.uri
+    print(f"{role} listening at {uri}", flush=True)
+
+    stop = {"flag": False}
+
+    def on_term(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_term)
+    signal.signal(signal.SIGTERM, on_term)
+    import time
+
+    while not stop["flag"]:
+        time.sleep(0.2)
+    # workers drain (finish running tasks) before exiting
+    if hasattr(server, "drain"):
+        server.drain(timeout=cfg.int("shutdown.grace-seconds", 30))
+    else:
+        server.stop()
+    print(f"{role} stopped", flush=True)
+
+
+if __name__ == "__main__":
+    main()

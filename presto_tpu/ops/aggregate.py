@@ -58,6 +58,11 @@ HLL_M = 1 << 12
 # state a dense (groups, cap) matrix — results past the cap truncate)
 ARRAY_AGG_CAP = 64
 
+# class-count cap of learn_classifier (labels must be ints in [0, C));
+# reference presto-ml trains libsvm models — here Gaussian naive Bayes,
+# whose sufficient statistics are plain segment sums (TPU-native)
+ML_MAX_CLASSES = 8
+
 
 # ---------------------------------------------------------------------------
 # agg state machinery
@@ -100,6 +105,19 @@ def state_types(agg: AggCall) -> List[Type]:
         from presto_tpu.types import ArrayType
 
         return [ArrayType(t, ARRAY_AGG_CAP), BIGINT]
+    if agg.fn == "learn_regressor":
+        # normal-equation sufficient statistics: flattened upper
+        # triangle-free full XtX (dim*dim) + Xty (dim), dim = k+1 bias
+        from presto_tpu.types import ArrayType
+
+        dim = agg.arg2.type.max_elems + 1
+        return [ArrayType(DOUBLE, dim * dim + dim), BIGINT]
+    if agg.fn == "learn_classifier":
+        # per class: count, sum x_j, sum x_j^2  (Gaussian NB stats)
+        from presto_tpu.types import ArrayType
+
+        k = agg.arg2.type.max_elems
+        return [ArrayType(DOUBLE, ML_MAX_CLASSES * (1 + 2 * k)), BIGINT]
     raise KeyError(f"unknown aggregate {agg.fn}")
 
 
@@ -110,6 +128,15 @@ def output_type(agg: AggCall) -> Type:
         from presto_tpu.types import ArrayType
 
         return ArrayType(agg.arg.type, ARRAY_AGG_CAP)
+    if agg.fn == "learn_regressor":
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(DOUBLE, agg.arg2.type.max_elems + 1)
+    if agg.fn == "learn_classifier":
+        from presto_tpu.types import ArrayType
+
+        k = agg.arg2.type.max_elems
+        return ArrayType(DOUBLE, 1 + ML_MAX_CLASSES * (1 + 2 * k))
     if agg.fn == "sum":
         return _sum_type(agg.arg.type)
     if agg.fn == "avg":
@@ -304,6 +331,46 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             rho = jnp.where(nonnull, data.astype(jnp.float64), 0.0)
             s = _seg_sum(jnp.where(nonnull, jnp.exp2(-rho), 0.0), gid_nn, n + 1)[:n]
             out.append([s, cnt])
+        elif agg.fn in ("learn_regressor", "learn_classifier"):
+            # sufficient statistics are segment sums (TPU-native
+            # training): normal equations for the regressor, Gaussian
+            # NB class stats for the classifier (presto-ml analog)
+            from presto_tpu.ops import container as ct
+
+            ft = agg.arg2.type
+            f_data, f_valid = c.compile(agg.arg2)(page)
+            k = ft.max_elems
+            slots = ct.elem_slots(f_data, ft)
+            feats = jnp.where(ct.elem_null_mask(slots), 0.0,
+                              slots.astype(jnp.float64))
+            sel = rowsel & valid & f_valid
+            gid_s = jnp.where(sel, gid, n)
+            scnt = _gsum(ctx, sel.astype(jnp.int64), gid_s, n)
+            if agg.fn == "learn_regressor":
+                from presto_tpu.expr.compile import _to_double
+
+                y = jnp.where(sel, _to_double(data, agg.arg.type), 0.0)
+                x_aug = jnp.concatenate(
+                    [feats, jnp.ones((feats.shape[0], 1))], axis=1)
+                dim = k + 1
+                outer = (x_aug[:, :, None] * x_aug[:, None, :]).reshape(
+                    feats.shape[0], dim * dim)
+                lanes = jnp.concatenate([outer, x_aug * y[:, None]], axis=1)
+            else:
+                cls = jnp.clip(data.astype(jnp.int64), 0, ML_MAX_CLASSES - 1)
+                onehot = (cls[:, None] == jnp.arange(ML_MAX_CLASSES)[None, :]
+                          ).astype(jnp.float64)
+                sumx = (onehot[:, :, None] * feats[:, None, :]).reshape(
+                    feats.shape[0], ML_MAX_CLASSES * k)
+                sumx2 = (onehot[:, :, None] * (feats ** 2)[:, None, :]).reshape(
+                    feats.shape[0], ML_MAX_CLASSES * k)
+                lanes = jnp.concatenate([onehot, sumx, sumx2], axis=1)
+            lanes = jnp.where(sel[:, None], lanes, 0.0)
+            s = _gsum(ctx, lanes, gid_s, n)
+            m = lanes.shape[1]
+            state = jnp.concatenate(
+                [jnp.full((n, 1), float(m)), s], axis=1)
+            out.append([state, scnt])
         elif agg.fn == "array_agg":
             # scatter (group, within-group-rank) -> slot; NULL inputs
             # keep their position as sentinel slots (reference
@@ -426,6 +493,13 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
             out.append([
                 _gsum(ctx, cols[0], gid, n),
                 _gsum(ctx, cols[1], gid, n),
+            ])
+        elif agg.fn in ("learn_regressor", "learn_classifier"):
+            arr, cnt = cols
+            zero_dead = jnp.where((gid < n)[:, None], arr, 0.0)
+            out.append([
+                _gsum(ctx, zero_dead, gid, n),
+                _gsum(ctx, cnt, gid, n),
             ])
         elif agg.fn == "array_agg":
             # concatenate partial arrays per group: each partial row's
@@ -564,6 +638,36 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
         elif agg.fn in ("min_by", "max_by"):
             x, xv, _y, cnt = cols
             blocks.append(Block(x.astype(t.np_dtype), (cnt > 0) & (xv > 0), t, adict))
+        elif agg.fn == "learn_regressor":
+            s, cnt = cols
+            dim = agg.arg2.type.max_elems + 1
+            n = s.shape[0]
+            xtx = s[:, 1 : 1 + dim * dim].reshape(n, dim, dim)
+            xty = s[:, 1 + dim * dim : 1 + dim * dim + dim]
+            # tiny ridge keeps rank-deficient groups solvable
+            reg = 1e-8 * jnp.eye(dim)[None, :, :]
+            w = jnp.linalg.solve(xtx + reg, xty[..., None])[..., 0]
+            model = jnp.concatenate([jnp.full((n, 1), float(dim)), w], axis=1)
+            blocks.append(Block(model.astype(t.np_dtype), cnt > 0, t))
+        elif agg.fn == "learn_classifier":
+            s, cnt = cols
+            k = agg.arg2.type.max_elems
+            C = ML_MAX_CLASSES
+            n = s.shape[0]
+            counts = s[:, 1 : 1 + C]
+            sumx = s[:, 1 + C : 1 + C + C * k].reshape(n, C, k)
+            sumx2 = s[:, 1 + C + C * k : 1 + C + 2 * C * k].reshape(n, C, k)
+            total = jnp.maximum(jnp.sum(counts, axis=1, keepdims=True), 1.0)
+            prior = counts / total
+            cc = jnp.maximum(counts, 1.0)[:, :, None]
+            mean = sumx / cc
+            var = jnp.maximum(sumx2 / cc - mean ** 2, 1e-9)
+            model = jnp.concatenate([
+                jnp.full((n, 1), float(1 + C * (1 + 2 * k))),
+                jnp.full((n, 1), float(C)),
+                prior, mean.reshape(n, C * k), var.reshape(n, C * k),
+            ], axis=1)
+            blocks.append(Block(model.astype(t.np_dtype), cnt > 0, t))
         elif agg.fn == "array_agg":
             arr_state, cnt = cols
             blocks.append(Block(arr_state.astype(t.np_dtype), cnt > 0, t, adict))
@@ -761,6 +865,37 @@ def _sorted_group_ids(key: jax.Array, live: jax.Array, max_groups: int,
     return gid, num_groups, rep_rows, ctx
 
 
+def _presorted_group_ids(key: jax.Array, live: jax.Array, max_groups: int):
+    """Streaming-aggregation grouping (StreamingAggregationOperator.java:38
+    analog): input rows arrive grouped (equal keys contiguous), so run
+    boundaries come from comparing each live row with the previous LIVE
+    row (cummax forward-fill skips filtered holes) — no sort at all.
+    Returns the same (gid, num_groups, rep_rows, ctx) shape as
+    _sorted_group_ids with an identity traversal order."""
+    rows = key.shape[0]
+    idx = jnp.arange(rows, dtype=jnp.int32)
+    last_live = jax.lax.cummax(jnp.where(live, idx, -1))
+    prev_live = jnp.concatenate([jnp.full(1, -1, jnp.int32), last_live[:-1]])
+    prev_key = key[jnp.clip(prev_live, 0, rows - 1)]
+    first = live & ((prev_live < 0) | (prev_key != key))
+    gid_raw = jnp.cumsum(first.astype(jnp.int32)) - 1
+    gid = jnp.where(live, jnp.minimum(gid_raw, max_groups), max_groups).astype(jnp.int32)
+    num_groups = jnp.sum(first.astype(jnp.int32))
+    rep_slot = jnp.where(first, gid_raw, max_groups)
+    starts = (
+        jnp.zeros(max_groups + 1, dtype=jnp.int32)
+        .at[rep_slot]
+        .set(idx, mode="drop")
+    )[:max_groups]
+    g = jnp.arange(max_groups, dtype=jnp.int32)
+    next_start = jnp.where(g + 1 < num_groups,
+                           jnp.concatenate([starts[1:], jnp.zeros(1, jnp.int32)]),
+                           rows)
+    ctx = _SortCtx(order=idx, starts=starts, ends=next_start - 1,
+                   group_live=g < num_groups)
+    return gid, num_groups, starts, ctx
+
+
 # ---------------------------------------------------------------------------
 # main kernels
 # ---------------------------------------------------------------------------
@@ -784,8 +919,11 @@ def grouped_aggregate(
     key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
     mode: str = "single",
     return_count: bool = False,
+    presorted: bool = False,
 ) -> Page:
-    """Aggregate ``page`` by ``group_exprs``.
+    """Aggregate ``page`` by ``group_exprs``.  With ``presorted=True``
+    the input is promised to arrive with equal group keys contiguous
+    (streaming aggregation) and grouping skips the argsort.
 
     mode='single' emits finalized values; 'partial' emits state columns
     (for exchange + merge_aggregate).
@@ -821,6 +959,19 @@ def grouped_aggregate(
         return (out, jnp.ones((), jnp.int32)) if return_count else out
 
     key, exact = pack_or_hash_keys(datas, valids, key_domains)
+
+    if presorted:
+        # streaming path: run boundaries from the input order itself
+        gid, num_groups, rep_rows, ctx = _presorted_group_ids(key, live, max_groups)
+        states = _partial_states(page, aggs, gid, max_groups, ctx=ctx)
+        key_blocks = []
+        for (d, v), e, dic in zip(kd, group_exprs, key_dicts):
+            key_blocks.append(Block(d[rep_rows].astype(e.type.np_dtype),
+                                    v[rep_rows], e.type, dic))
+        out_mask = jnp.arange(max_groups) < num_groups
+        out = _emit(key_blocks, states, aggs, out_mask, mode, group_exprs,
+                    key_dicts, agg_dicts)
+        return (out, num_groups) if return_count else out
 
     # packed-direct: group id == packed key, no sort; output capacity is
     # always max_groups (padded above prod) so downstream shapes match

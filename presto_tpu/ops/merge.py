@@ -1,0 +1,163 @@
+"""Order-preserving merge of pre-sorted pages.
+
+Reference analog: ``operator/MergeOperator.java:45`` +
+``operator/MergeHashSort.java`` — the consumer-side k-way merge that
+keeps distributed sort distributed: each producer sorts its partition,
+the consumer merges without re-sorting.
+
+TPU re-design: no scalar heap walk.  Each row gets one int64
+total-order key (floats map through the IEEE-754 order-isomorphic
+bit trick; multi-key specs pack lanes by their observed ranges); two
+sorted runs then merge with two ``searchsorted`` rank computations and
+one scatter — an element's output position is its own rank plus its
+rank in the other run.  k runs fold pairwise (log k rounds); ties
+break toward the earlier run, so the fold is stable across producers.
+Specs that cannot form a single exact key (e.g. several float lanes)
+fall back to concatenate+sort, which is still correct.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.expr.compile import ExprCompiler
+from presto_tpu.expr.ir import Expr
+from presto_tpu.page import Block, Page
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+def _float_order_bits(x: jax.Array) -> jax.Array:
+    """IEEE-754 total-order map: float64 -> int64 with the same <."""
+    i = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
+    return jnp.where(i < 0, jnp.int64(-1) ^ (i & _I64_MAX), i)
+
+
+class _NoScalarKey(Exception):
+    pass
+
+
+def _raw_lane(page: Page, e: Expr, asc: bool):
+    """Order-isomorphic int64 lane + validity, NULL/dead garbage NOT yet
+    masked."""
+    c = ExprCompiler.for_page(page)
+    d, v = c.compile(e)(page)
+    if d.ndim > 1:
+        raise _NoScalarKey()
+    lane = (_float_order_bits(d)
+            if jnp.issubdtype(d.dtype, jnp.floating)
+            else d.astype(jnp.int64))
+    if not asc:
+        lane = ~lane
+    return lane, v
+
+
+def merge_keys_for_pages(pages: Sequence[Page], sort_exprs: Sequence[Expr],
+                         ascending: Sequence[bool],
+                         nulls_first: Optional[Sequence[bool]] = None):
+    """One int64 total-order key per row for EVERY page jointly — lane
+    ranges are global, so keys compare across producers.  Dead rows get
+    INT64_MAX (the tail of each sorted page).  Raises _NoScalarKey when
+    the combined lanes cannot pack into 62 bits.  Eager-only (ranges
+    are read from device data to detect packing overflow)."""
+    if nulls_first is None:
+        nulls_first = [False] * len(sort_exprs)
+    if len(sort_exprs) == 1:
+        # single lane: the raw order-isomorphic lane is globally
+        # comparable without packing; NULLs pin to the extremes
+        # (collision with actual INT64_MIN+1/MAX-1 values is the
+        # documented edge)
+        e, a, nf = sort_exprs[0], ascending[0], nulls_first[0]
+        null_key = jnp.iinfo(jnp.int64).min + 1 if nf else _I64_MAX - 1
+        keys = []
+        for p in pages:
+            lane, v = _raw_lane(p, e, a)
+            keys.append(jnp.where(p.row_mask,
+                                  jnp.where(v, lane, null_key), _I64_MAX))
+        return keys
+
+    per_page_lanes = []  # [page][lane] = (masked_lane, valid)
+    cards = []
+    for li, (e, a, nf) in enumerate(zip(sort_exprs, ascending, nulls_first)):
+        lanes = []
+        lo, hi = None, None
+        for p in pages:
+            lane, v = _raw_lane(p, e, a)
+            present = v & p.row_mask
+            neutral = jnp.where(jnp.any(present), lane[jnp.argmax(present)], 0)
+            lane = jnp.where(present, lane, neutral)
+            lanes.append((lane, v))
+            plo, phi = int(jnp.min(lane)), int(jnp.max(lane))
+            lo = plo if lo is None else min(lo, plo)
+            hi = phi if hi is None else max(hi, phi)
+        width = hi - lo + 1
+        cards.append(width + 2)
+        per_page_lanes.append([(lane - lo, v) for lane, v in lanes])
+        null_key = -1 if nulls_first[li] else width
+        per_page_lanes[-1] = [
+            (jnp.where(v, lk, null_key) + 1, v) for lk, v in per_page_lanes[-1]
+        ]
+    total = 1
+    for c in cards:
+        total *= c
+        if total >= (1 << 62):
+            raise _NoScalarKey()
+    keys = []
+    for pi, p in enumerate(pages):
+        key = jnp.zeros(p.capacity, dtype=jnp.int64)
+        for li, card in enumerate(cards):
+            key = key * card + per_page_lanes[li][pi][0]
+        keys.append(jnp.where(p.row_mask, key, _I64_MAX))
+    return keys
+
+
+def merge_two_sorted(a: Page, b: Page, key_a: jax.Array,
+                     key_b: jax.Array) -> Tuple[Page, jax.Array]:
+    """Merge two sorted pages by per-row keys (dead rows at the tail
+    with INT64_MAX keys)."""
+    na, nb = a.capacity, b.capacity
+    pos_a = jnp.arange(na) + jnp.searchsorted(key_b, key_a, side="left")
+    pos_b = jnp.arange(nb) + jnp.searchsorted(key_a, key_b, side="right")
+    n = na + nb
+    blocks = []
+    for ba, bb in zip(a.blocks, b.blocks):
+        data = jnp.zeros((n,) + ba.data.shape[1:], dtype=ba.data.dtype)
+        data = data.at[pos_a].set(ba.data).at[pos_b].set(bb.data)
+        valid = jnp.zeros(n, dtype=jnp.bool_)
+        valid = valid.at[pos_a].set(ba.valid).at[pos_b].set(bb.valid)
+        blocks.append(Block(data, valid, ba.type, ba.dictionary or bb.dictionary))
+    mask = jnp.zeros(n, dtype=jnp.bool_)
+    mask = mask.at[pos_a].set(a.row_mask).at[pos_b].set(b.row_mask)
+    key = jnp.full(n, _I64_MAX, dtype=jnp.int64)
+    key = key.at[pos_a].set(key_a).at[pos_b].set(key_b)
+    return Page(tuple(blocks), mask), key
+
+
+def merge_sorted_pages(pages: Sequence[Page], sort_exprs: Sequence[Expr],
+                       ascending: Sequence[bool],
+                       nulls_first: Optional[Sequence[bool]] = None) -> Page:
+    """k-way order-preserving merge of per-producer sorted pages;
+    falls back to concatenate+sort when no exact scalar key exists."""
+    from presto_tpu.exec.local import concat_pages_device
+    from presto_tpu.ops.sort import sort_page
+
+    if len(pages) == 1:
+        return pages[0]
+    try:
+        keys = merge_keys_for_pages(pages, sort_exprs, ascending, nulls_first)
+        items = list(zip(pages, keys))
+    except _NoScalarKey:
+        return sort_page(concat_pages_device(list(pages)), list(sort_exprs),
+                         list(ascending), nulls_first)
+    while len(items) > 1:
+        nxt = []
+        for i in range(0, len(items) - 1, 2):
+            (pa, ka), (pb, kb) = items[i], items[i + 1]
+            nxt.append(merge_two_sorted(pa, pb, ka, kb))
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0][0]

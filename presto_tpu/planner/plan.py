@@ -141,6 +141,10 @@ class AggregationNode(PlanNode):
     agg_names: List[str]
     step: str = "single"
     max_groups: int = 1 << 16
+    # equal group keys are contiguous in the input (scan sort order
+    # covers the keys): the streaming-aggregation path skips the sort
+    # (StreamingAggregationOperator.java:38)
+    presorted: bool = False
 
     @property
     def sources(self):

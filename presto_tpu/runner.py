@@ -271,13 +271,7 @@ class QueryRunner:
         tx handle instead of applying it; returns True when staged."""
         if self._open_tx is None:
             return False
-        from presto_tpu.transaction import TransactionError
-
-        if self._open_tx.read_only:
-            raise TransactionError("transaction is READ ONLY")
-        if not hasattr(conn, "begin_transaction") or not hasattr(conn, "stage"):
-            raise TransactionError(
-                f"connector {connector_name} does not support transactions")
+        self._check_tx_writable(connector_name, conn)
         handle = self._open_tx.handle_for(connector_name, conn)
         conn.stage(handle, op, *args)
         return True

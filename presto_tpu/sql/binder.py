@@ -64,6 +64,8 @@ AGG_FUNCTIONS = {
     "approx_distinct",
     "min_by", "max_by", "approx_percentile",
     "array_agg",
+    # presto-ml analogs: sufficient-statistic training aggregates
+    "learn_regressor", "learn_classifier",
 }
 
 # Correlated bindings mark outer-scope columns with this offset so a
@@ -97,6 +99,13 @@ SCALAR_FUNCTIONS = {
     "json_extract", "json_extract_scalar", "json_array_length", "is_json_scalar",
     "url_extract_host", "url_extract_path", "url_extract_protocol",
     "url_extract_query", "url_extract_port",
+    # geospatial (presto-geospatial GeoFunctions.java)
+    "st_geometryfromtext", "st_point", "st_distance", "st_contains",
+    "st_area", "st_x", "st_y",
+    # ML inference (presto-ml regress/classify over array models)
+    "regress", "classify", "features",
+    # teradata compat (presto-teradata-functions)
+    "index", "char2hexint", "nvl",
     # ARRAY / MAP (operator/scalar/ArrayFunctions, MapKeys, MapValues...)
     "cardinality", "contains", "element_at", "array_position",
     "array_min", "array_max", "array_sum", "array_average",
@@ -450,6 +459,49 @@ class Binder:
             t.offset = off
             off += len(t.scope)
         return terms, conjuncts
+
+    def _input_presorted(self, node: PlanNode, group_irs) -> bool:
+        """True when the aggregation input provably arrives with equal
+        group keys contiguous: the input chain is scan(+filter/projection
+        pass-through) of a table whose declared sort order's prefix is
+        exactly the group-key set (connector ``sort_order`` metadata —
+        the reference's ConnectorMetadata local properties feeding
+        StreamingAggregationOperator selection)."""
+        remap: Optional[Dict[int, int]] = None  # None = identity (no Project seen)
+        cur = node
+        while True:
+            if isinstance(cur, FilterNode):
+                cur = cur.source
+            elif isinstance(cur, ProjectNode):
+                proj_map = {}
+                for i, p in enumerate(cur.projections):
+                    if isinstance(p, ColumnRef):
+                        proj_map[i] = p.index
+                src_items = (remap.items() if remap is not None else
+                             ((i, i) for i in range(len(cur.channels))))
+                remap = {}
+                for out_i, in_i in src_items:
+                    if in_i in proj_map:
+                        remap[out_i] = proj_map[in_i]
+                cur = cur.source
+            else:
+                break
+        if not isinstance(cur, TableScanNode):
+            return False
+        conn = self.catalog.connector(cur.handle.connector_name)
+        so = conn.sort_order(cur.handle.table) if hasattr(conn, "sort_order") else None
+        if not so:
+            return False
+        names = set()
+        for e in group_irs:
+            if not isinstance(e, ColumnRef):
+                return False
+            idx = e.index if remap is None else remap.get(e.index)
+            if idx is None or idx >= len(cur.columns):
+                return False
+            names.add(cur.handle.columns[cur.columns[idx]].name)
+        k = len(names)
+        return 0 < k <= len(so) and set(so[:k]) == names
 
     def _names_resolvable(self, e: ast.Node, scope: Scope) -> bool:
         """True if every free Identifier in ``e`` resolves in ``scope``
@@ -1061,6 +1113,7 @@ class Binder:
         agg = AggregationNode(
             node, group_irs, group_names, agg_ctx.aggs, agg_names,
             max_groups=self._group_capacity(group_irs, scope, est, node=node),
+            presorted=self._input_presorted(node, group_irs),
         )
         out: PlanNode = agg
         for ir in having_plain:
@@ -1579,6 +1632,19 @@ class Binder:
             return call(field, self._bind_impl(e.value, scope, agg))
 
         if isinstance(e, ast.FuncCall):
+            if e.name == "index":
+                # teradata index(s, sub) = strpos (DateTimeFunctions.java
+                # analog in presto-teradata-functions)
+                return self._bind_impl(
+                    ast.FuncCall("strpos", e.args), scope, agg)
+            if e.name == "nvl":
+                return self._bind_impl(
+                    ast.FuncCall("coalesce", e.args), scope, agg)
+            if e.name == "features":
+                # presto-ml feature vector -> ARRAY(double)
+                args = [call("cast_double", self._bind_impl(a, scope, agg))
+                        for a in e.args]
+                return call("array_construct", *args)
             if e.name in AGG_FUNCTIONS:
                 if agg is None:
                     raise BindError(f"aggregate {e.name} in scalar context")
@@ -1846,7 +1912,8 @@ class Binder:
             a = AggCall(fn="count_star", arg=None, type=BIGINT)
             return agg.agg_ref(a)
         fn, distinct = e.name, e.distinct
-        if fn in ("min_by", "max_by", "approx_percentile"):
+        if fn in ("min_by", "max_by", "approx_percentile",
+                  "learn_regressor", "learn_classifier"):
             if len(e.args) != 2:
                 raise BindError(f"aggregate {fn} takes two arguments")
             if distinct:

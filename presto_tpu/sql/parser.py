@@ -367,10 +367,7 @@ class Parser:
             rel = self._relation()
             self.expect(")")
             return rel
-        name = self.ident()
-        while self.peek("."):  # catalog-qualified: catalog.table
-            self.i += 1
-            name += "." + self.ident()
+        name = _qualified_name(self)  # catalog-qualified: catalog.table
         alias = None
         if self.accept("as"):
             alias = self.ident()

@@ -166,6 +166,12 @@ VARCHAR = Type("varchar", np.dtype(np.int32), dictionary=True)
 
 LONG_DECIMAL_BASE = 10 ** 18
 
+# pseudo-type of ST_Point(x, y): never materializes as a column — it
+# exists only inside ST_Distance / ST_Contains argument positions
+# (reference GeometryType is a real SliceType; point construction here
+# stays two device lanes until a consuming kernel uses them)
+GEOMETRY_POINT = Type("geometry_point", np.dtype(np.float64))
+
 
 def _container_storage_dtype(*types: Type) -> np.dtype:
     """Storage dtype for ARRAY/MAP slots: one fixed-width lane wide

@@ -1,0 +1,91 @@
+"""ML SQL functions: learn_regressor/regress, learn_classifier/classify.
+
+Reference analog: presto-ml (LearnClassifierAggregation,
+LearnRegressorAggregation, ClassifyFunction, RegressFunction over
+libsvm models).  Training here is segment-sum sufficient statistics —
+normal equations for linear regression, Gaussian naive Bayes for
+classification — so models are ARRAY(double) values and both training
+and inference run as device kernels.
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.page import Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import BIGINT, DOUBLE
+
+
+@pytest.fixture(scope="module")
+def runner():
+    rng = np.random.RandomState(7)
+    n = 400
+    x1 = rng.uniform(-3, 3, n)
+    x2 = rng.uniform(-3, 3, n)
+    y = 2.0 * x1 - 0.5 * x2 + 1.25  # exact linear target
+    cls = (x1 + x2 > 0).astype(np.int64)  # separable-ish classes
+    mem = MemoryConnector()
+    mem.create_table(
+        "train",
+        [("x1", DOUBLE), ("x2", DOUBLE), ("y", DOUBLE), ("label", BIGINT)],
+        [Page.from_arrays([x1, x2, y, cls], [DOUBLE, DOUBLE, DOUBLE, BIGINT])],
+    )
+    cat = Catalog()
+    cat.register("mem", mem)
+    return QueryRunner(cat)
+
+
+def test_learn_regressor_recovers_weights(runner):
+    rows = runner.execute(
+        "SELECT learn_regressor(y, features(x1, x2)) FROM train").rows
+    (model,) = rows[0]
+    # weights [w1, w2, bias]
+    assert model[0] == pytest.approx(2.0, abs=1e-6)
+    assert model[1] == pytest.approx(-0.5, abs=1e-6)
+    assert model[2] == pytest.approx(1.25, abs=1e-6)
+
+
+def test_regress_predicts(runner):
+    rows = runner.execute(
+        "SELECT regress(m, features(1.0, 2.0)) FROM "
+        "(SELECT learn_regressor(y, features(x1, x2)) AS m FROM train)").rows
+    assert rows[0][0] == pytest.approx(2.0 * 1 - 0.5 * 2 + 1.25, abs=1e-6)
+
+
+def test_classifier_end_to_end(runner):
+    # train + classify the training points: NB should get most right
+    rows = runner.execute(
+        "SELECT avg(CASE WHEN classify(m, features(x1, x2)) = label "
+        "THEN 1.0 ELSE 0.0 END) FROM train "
+        "CROSS JOIN (SELECT learn_classifier(label, features(x1, x2)) AS m "
+        "FROM train)").rows
+    assert rows[0][0] > 0.9
+
+
+def test_grouped_models(runner):
+    rows = runner.execute(
+        "SELECT label, learn_regressor(y, features(x1, x2)) FROM train "
+        "GROUP BY label ORDER BY label").rows
+    assert len(rows) == 2
+    for _, model in rows:
+        assert model[0] == pytest.approx(2.0, abs=1e-5)
+
+
+def test_partial_final_split_across_pages():
+    # two splits force partial states + merge
+    mem = MemoryConnector()
+    xs = np.linspace(-2, 2, 50)
+    pages = [
+        Page.from_arrays([xs[:25], 3 * xs[:25] + 1], [DOUBLE, DOUBLE]),
+        Page.from_arrays([xs[25:], 3 * xs[25:] + 1], [DOUBLE, DOUBLE]),
+    ]
+    mem.create_table("t2", [("x", DOUBLE), ("y", DOUBLE)], pages)
+    cat = Catalog()
+    cat.register("mem", mem)
+    r = QueryRunner(cat)
+    (model,) = r.execute(
+        "SELECT learn_regressor(y, features(x)) FROM t2").rows[0]
+    assert model[0] == pytest.approx(3.0, abs=1e-6)
+    assert model[1] == pytest.approx(1.0, abs=1e-6)

@@ -142,7 +142,7 @@ def test_config_properties_parsing(tmp_path):
     session = cfg.build_session()
     assert session.get("max_groups") == 4096
     r = QueryRunner(catalog, session=session)
-    assert r.execute("SELECT count(*) FROM tiny.region").rows or True  # resolves
+    assert r.execute("SELECT count(*) FROM tiny.region").rows == [(5,)]
     assert r.execute("SELECT count(*) FROM region").rows == [(5,)]
 
 
@@ -196,6 +196,48 @@ def test_worker_drain_rejects_new_tasks():
             w.stop()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# launcher / packaging
+# ---------------------------------------------------------------------------
+
+def test_launcher_coordinator_from_etc(tmp_path):
+    from presto_tpu.client import StatementClient
+    from presto_tpu.launcher import build_from_etc
+
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text("coordinator=true\n")
+    (etc / "catalog" / "tiny.properties").write_text(
+        "connector.name=tpch\ntpch.scale-factor=0.001\n")
+    server, role, _ = build_from_etc(str(etc))
+    assert role == "coordinator"
+    server.start()
+    try:
+        _, rows = StatementClient(server.uri).execute("SELECT count(*) FROM region")
+        assert rows == [(5,)]
+    finally:
+        server.stop()
+
+
+def test_launcher_worker_role(tmp_path):
+    from presto_tpu.launcher import build_from_etc
+
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text("coordinator=false\n")
+    server, role, _ = build_from_etc(str(etc))
+    assert role == "worker"
+    server.start()
+    try:
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(server.uri + "/v1/info", timeout=5) as resp:
+            assert _json.loads(resp.read())["state"] == "ACTIVE"
+    finally:
+        server.stop()
 
 
 # ---------------------------------------------------------------------------
