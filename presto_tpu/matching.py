@@ -1,0 +1,82 @@
+"""Structural pattern matching for plan rewrite rules.
+
+Reference analog: ``presto-matching`` (Pattern.java / Match.java — the
+tiny library the iterative optimizer's rules declare their shapes
+with).  A pattern matches a plan node by type, optional predicates,
+and optional source sub-patterns; ``match`` returns a Match carrying
+captured nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Capture:
+    """A named slot filled by a sub-pattern match."""
+
+    name: str
+
+
+@dataclasses.dataclass
+class Match:
+    node: Any
+    captures: Dict[str, Any]
+
+    def get(self, capture: Capture):
+        return self.captures[capture.name]
+
+
+class Pattern:
+    """node-type pattern with predicates and source sub-patterns."""
+
+    def __init__(self, node_type=None):
+        self.node_type = node_type
+        self.predicates: List[Callable[[Any], bool]] = []
+        self.source_patterns: Optional[List["Pattern"]] = None
+        self.capture_as: Optional[Capture] = None
+
+    @classmethod
+    def type_of(cls, node_type) -> "Pattern":
+        return cls(node_type)
+
+    @classmethod
+    def any(cls) -> "Pattern":
+        return cls(None)
+
+    def where(self, pred: Callable[[Any], bool]) -> "Pattern":
+        self.predicates.append(pred)
+        return self
+
+    def with_sources(self, *patterns: "Pattern") -> "Pattern":
+        self.source_patterns = list(patterns)
+        return self
+
+    def captured_as(self, capture: Capture) -> "Pattern":
+        self.capture_as = capture
+        return self
+
+    def match(self, node) -> Optional[Match]:
+        caps: Dict[str, Any] = {}
+        if self._match_into(node, caps):
+            return Match(node, caps)
+        return None
+
+    def _match_into(self, node, caps: Dict[str, Any]) -> bool:
+        if self.node_type is not None and not isinstance(node, self.node_type):
+            return False
+        for p in self.predicates:
+            if not p(node):
+                return False
+        if self.source_patterns is not None:
+            sources = node.sources
+            if len(sources) != len(self.source_patterns):
+                return False
+            for sp, s in zip(self.source_patterns, sources):
+                if not sp._match_into(s, caps):
+                    return False
+        if self.capture_as is not None:
+            caps[self.capture_as.name] = node
+        return True

@@ -1,0 +1,219 @@
+"""Iterative rule-based plan optimizer.
+
+Reference analog: ``sql/planner/iterative/IterativeOptimizer.java``
+with ``Memo.java`` and the ``Rule`` interface (79 rules in
+``iterative/rule/``).  The memo here is an explored-set keyed by node
+identity (plan nodes are identity-hashed DAG nodes, so a rewritten
+node re-enters the queue and already-stable subtrees are skipped);
+rules fire bottom-up to a fixpoint with an iteration budget.
+
+Rules shipped (the subset with teeth for this engine's plan shapes —
+each names its reference rule):
+  MergeAdjacentFilters        iterative/rule/MergeFilters.java
+  MergeAdjacentProjects       iterative/rule/MergeAdjacentProjects (via
+                              InlineProjections.java)
+  PushFilterThroughProject    iterative/rule/PushdownFilterIntoRow... /
+                              PredicatePushDown's project case
+  RemoveIdentityProjection    iterative/rule/RemoveRedundantIdentityProjections.java
+  EvaluateConstantFilter      iterative/rule/RemoveTrivialFilters.java
+  PushLimitThroughProject     iterative/rule/PushLimitThroughProject.java
+  MergeLimits                 iterative/rule/MergeLimitWithSort / MergeLimits
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from presto_tpu.expr.ir import Call, ColumnRef, Expr, Literal
+from presto_tpu.matching import Pattern
+from presto_tpu.planner.plan import (
+    FilterNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    TableScanNode,
+    TopNNode,
+    ValuesNode,
+)
+
+
+class Rule:
+    pattern: Pattern
+
+    def apply(self, node: PlanNode) -> Optional[PlanNode]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _subst(e: Expr, inputs: List[Expr]) -> Expr:
+    """Replace ColumnRefs with the corresponding input expressions
+    (projection inlining)."""
+    if isinstance(e, ColumnRef):
+        return inputs[e.index]
+    if isinstance(e, Call):
+        return Call(type=e.type, fn=e.fn,
+                    args=tuple(_subst(a, inputs) for a in e.args))
+    return e
+
+
+class MergeAdjacentFilters(Rule):
+    pattern = Pattern.type_of(FilterNode).with_sources(Pattern.type_of(FilterNode))
+
+    def apply(self, node: FilterNode) -> Optional[PlanNode]:
+        inner: FilterNode = node.source
+        from presto_tpu.types import BOOLEAN
+
+        combined = Call(type=BOOLEAN, fn="and",
+                        args=(inner.predicate, node.predicate))
+        return FilterNode(inner.source, combined)
+
+
+class MergeAdjacentProjects(Rule):
+    pattern = Pattern.type_of(ProjectNode).with_sources(Pattern.type_of(ProjectNode))
+
+    def apply(self, node: ProjectNode) -> Optional[PlanNode]:
+        inner: ProjectNode = node.source
+        # inline only when no inner expression is referenced twice by a
+        # non-trivial outer use (avoids duplicating compute; XLA CSE
+        # would fuse anyway, but keep plans readable)
+        refs: dict = {}
+        for p in node.projections:
+            for r in _expr_refs(p):
+                refs[r] = refs.get(r, 0) + 1
+        for i, ip in enumerate(inner.projections):
+            if refs.get(i, 0) > 1 and not isinstance(ip, (ColumnRef, Literal)):
+                return None
+        new_projs = [_subst(p, list(inner.projections)) for p in node.projections]
+        return ProjectNode(inner.source, new_projs, list(node.names))
+
+
+class PushFilterThroughProject(Rule):
+    pattern = Pattern.type_of(FilterNode).with_sources(Pattern.type_of(ProjectNode))
+
+    def apply(self, node: FilterNode) -> Optional[PlanNode]:
+        proj: ProjectNode = node.source
+        pred = _subst(node.predicate, list(proj.projections))
+        return ProjectNode(FilterNode(proj.source, pred),
+                           list(proj.projections), list(proj.names))
+
+
+class RemoveIdentityProjection(Rule):
+    pattern = Pattern.type_of(ProjectNode).where(
+        lambda n: len(n.projections) == len(n.source.channels)
+        and all(
+            isinstance(p, ColumnRef) and p.index == i
+            for i, p in enumerate(n.projections)
+        )
+        and [c.name for c in n.source.channels] == list(n.names)
+    )
+
+    def apply(self, node: ProjectNode) -> Optional[PlanNode]:
+        return node.source
+
+
+class EvaluateConstantFilter(Rule):
+    pattern = Pattern.type_of(FilterNode).where(
+        lambda n: isinstance(n.predicate, Literal))
+
+    def apply(self, node: FilterNode) -> Optional[PlanNode]:
+        pred: Literal = node.predicate
+        if pred.value:
+            return node.source
+        # provably-false filter -> empty values relation
+        return ValuesNode(
+            names=list(node.output_names), types=list(node.output_types),
+            rows=[],
+        )
+
+
+class PushLimitThroughProject(Rule):
+    pattern = Pattern.type_of(LimitNode).with_sources(Pattern.type_of(ProjectNode))
+
+    def apply(self, node: LimitNode) -> Optional[PlanNode]:
+        proj: ProjectNode = node.source
+        return ProjectNode(LimitNode(proj.source, node.count),
+                           list(proj.projections), list(proj.names))
+
+
+class MergeLimits(Rule):
+    pattern = Pattern.type_of(LimitNode).with_sources(Pattern.type_of(LimitNode))
+
+    def apply(self, node: LimitNode) -> Optional[PlanNode]:
+        inner: LimitNode = node.source
+        return LimitNode(inner.source, min(node.count, inner.count))
+
+
+def _expr_refs(e: Expr) -> List[int]:
+    if isinstance(e, ColumnRef):
+        return [e.index]
+    if isinstance(e, Call):
+        return [r for a in e.args for r in _expr_refs(a)]
+    return []
+
+
+DEFAULT_RULES: List[Rule] = [
+    MergeAdjacentFilters(),
+    PushFilterThroughProject(),
+    MergeAdjacentProjects(),
+    RemoveIdentityProjection(),
+    EvaluateConstantFilter(),
+    PushLimitThroughProject(),
+    MergeLimits(),
+]
+
+
+class IterativeOptimizer:
+    """Bottom-up fixpoint driver (IterativeOptimizer.java's exploration
+    loop over a Memo, with node identity as the group key)."""
+
+    def __init__(self, rules: Optional[List[Rule]] = None, max_iterations: int = 1000):
+        self.rules = rules if rules is not None else DEFAULT_RULES
+        self.max_iterations = max_iterations
+
+    def optimize(self, root: PlanNode) -> PlanNode:
+        self._budget = self.max_iterations
+        return self._explore(root)
+
+    def _explore(self, node: PlanNode) -> PlanNode:
+        # children first so parents see stable sources
+        node = self._rewrite_sources(node)
+        progress = True
+        while progress and self._budget > 0:
+            progress = False
+            for rule in self.rules:
+                if rule.pattern.match(node) is None:
+                    continue
+                out = rule.apply(node)
+                if out is None or out is node:
+                    continue
+                self._budget -= 1
+                node = self._rewrite_sources(out)
+                progress = True
+                break
+        return node
+
+    def _rewrite_sources(self, node: PlanNode) -> PlanNode:
+        srcs = node.sources
+        if not srcs:
+            return node
+        new = [self._explore(s) for s in srcs]
+        if all(a is b for a, b in zip(new, srcs)):
+            return node
+        _replace_sources(node, new)
+        return node
+
+
+def _replace_sources(node: PlanNode, new_sources: List[PlanNode]) -> None:
+    """In-place source replacement: plan nodes are plain dataclasses
+    whose source fields are named 'source' / 'left' / 'right' /
+    'inputs'."""
+    if hasattr(node, "source"):
+        node.source = new_sources[0]
+        return
+    if hasattr(node, "left"):
+        node.left, node.right = new_sources
+        return
+    if hasattr(node, "inputs"):
+        node.inputs = list(new_sources)
+        return
+    raise AssertionError(f"cannot replace sources of {type(node).__name__}")

@@ -340,7 +340,12 @@ class Binder:
 
     def plan_ast(self, q: ast.Node) -> OutputNode:
         node, names = self._plan_query_like(q)
-        return OutputNode(node, names)
+        out = OutputNode(node, names)
+        # iterative rule engine over the bound plan
+        # (sql/planner/iterative/IterativeOptimizer.java)
+        from presto_tpu.planner.iterative import IterativeOptimizer
+
+        return IterativeOptimizer().optimize(out)
 
     def _plan_query_like(self, q: ast.Node) -> Tuple[PlanNode, List[str]]:
         if isinstance(q, ast.Union):

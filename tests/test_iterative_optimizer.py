@@ -1,0 +1,119 @@
+"""Iterative rule-based optimizer + pattern matching.
+
+Reference analogs: presto-matching (Pattern/Match) and
+sql/planner/iterative/IterativeOptimizer.java with its rule set.
+"""
+
+import numpy as np
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.expr.ir import Call, ColumnRef, Literal
+from presto_tpu.matching import Capture, Pattern
+from presto_tpu.page import Page
+from presto_tpu.planner.iterative import (
+    DEFAULT_RULES, EvaluateConstantFilter, IterativeOptimizer, MergeLimits,
+)
+from presto_tpu.planner.plan import (
+    FilterNode, LimitNode, OutputNode, ProjectNode, TableScanNode, ValuesNode,
+)
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import BIGINT, BOOLEAN, DOUBLE
+
+
+def make_runner():
+    mem = MemoryConnector()
+    mem.create_table(
+        "t", [("a", BIGINT), ("b", DOUBLE)],
+        [Page.from_arrays([np.arange(10), np.arange(10) * 1.5],
+                          [BIGINT, DOUBLE])])
+    cat = Catalog()
+    cat.register("mem", mem)
+    return QueryRunner(cat)
+
+
+def _walk(node):
+    yield node
+    for s in node.sources:
+        yield from _walk(s)
+
+
+# ---------------------------------------------------------------------------
+# pattern matching
+# ---------------------------------------------------------------------------
+
+def test_pattern_type_and_predicate():
+    n = LimitNode(ValuesNode(names=["x"], types=[BIGINT], rows=[(1,)]), 5)
+    assert Pattern.type_of(LimitNode).match(n)
+    assert Pattern.type_of(FilterNode).match(n) is None
+    assert Pattern.type_of(LimitNode).where(lambda x: x.count > 3).match(n)
+    assert Pattern.type_of(LimitNode).where(lambda x: x.count > 9).match(n) is None
+
+
+def test_pattern_sources_and_capture():
+    src = ValuesNode(names=["x"], types=[BIGINT], rows=[(1,)])
+    n = LimitNode(LimitNode(src, 3), 5)
+    cap = Capture("inner")
+    m = Pattern.type_of(LimitNode).with_sources(
+        Pattern.type_of(LimitNode).captured_as(cap)).match(n)
+    assert m is not None and m.get(cap) is n.source
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def test_merge_limits_rule():
+    src = ValuesNode(names=["x"], types=[BIGINT], rows=[(i,) for i in range(10)])
+    n = LimitNode(LimitNode(src, 3), 7)
+    out = IterativeOptimizer([MergeLimits()]).optimize(n)
+    assert isinstance(out, LimitNode) and out.count == 3
+    assert not isinstance(out.source, LimitNode)
+
+
+def test_constant_false_filter_becomes_empty_values():
+    src = ValuesNode(names=["x"], types=[BIGINT], rows=[(1,)])
+    n = FilterNode(src, Literal(type=BOOLEAN, value=False))
+    out = IterativeOptimizer([EvaluateConstantFilter()]).optimize(n)
+    assert isinstance(out, ValuesNode) and out.rows == []
+
+
+def test_constant_true_filter_removed():
+    src = ValuesNode(names=["x"], types=[BIGINT], rows=[(1,)])
+    n = FilterNode(src, Literal(type=BOOLEAN, value=True))
+    out = IterativeOptimizer([EvaluateConstantFilter()]).optimize(n)
+    assert out is src
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the engine
+# ---------------------------------------------------------------------------
+
+def test_nested_projections_collapse():
+    r = make_runner()
+    plan = r.plan("SELECT y + 1 FROM (SELECT a + 1 AS y FROM (SELECT a FROM t))")
+    projects = [n for n in _walk(plan) if isinstance(n, ProjectNode)]
+    # nested single-use projections inline into few nodes
+    assert len(projects) <= 2
+    assert r.execute(
+        "SELECT y + 1 FROM (SELECT a + 1 AS y FROM (SELECT a FROM t)) "
+        "ORDER BY 1 LIMIT 2").rows == [(2,), (3,)]
+
+
+def test_filter_pushes_through_project():
+    r = make_runner()
+    plan = r.plan("SELECT y FROM (SELECT a + 1 AS y FROM t) WHERE y > 5")
+    # after pushdown, no FilterNode sits directly on a ProjectNode
+    for n in _walk(plan):
+        if isinstance(n, FilterNode):
+            assert not isinstance(n.source, ProjectNode)
+    assert r.execute("SELECT y FROM (SELECT a + 1 AS y FROM t) WHERE y > 5 "
+                     "ORDER BY y").rows == [(6,), (7,), (8,), (9,), (10,)]
+
+
+def test_default_rules_preserve_correctness():
+    r = make_runner()
+    rows = r.execute(
+        "SELECT a, b FROM (SELECT a, b FROM t WHERE a >= 2) "
+        "WHERE a < 5 ORDER BY a LIMIT 10").rows
+    assert rows == [(2, 3.0), (3, 4.5), (4, 6.0)]
