@@ -147,6 +147,50 @@ class JdbcConnector:
         finally:
             conn.close()
 
+    # -- index lookups (ConnectorIndex / presto index-join SPI) -------------
+    def supports_index(self, table: str, key_columns: Sequence[str]) -> bool:
+        """True when point lookups on ``key_columns`` can run remotely
+        (spi/connector/ConnectorIndexProvider analog).  Any column works
+        for a SQL backend — the remote engine does the indexing."""
+        cols = {c for c, _ in self.schema(table)}
+        return all(c in cols for c in key_columns)
+
+    INDEX_CHUNK = 900  # sqlite parameter limit guard
+
+    def index_lookup(self, table: str, key_columns: Sequence[str],
+                     keys: Sequence[tuple]) -> List[Page]:
+        """Fetch only the rows matching the probe keys (IndexLoader /
+        IndexSourceOperator analog): WHERE (k1, k2) IN (...) chunked."""
+        schema = self.schema(table)
+        out_rows: List[tuple] = []
+        keys = list(dict.fromkeys(keys))  # distinct, order-stable
+        cols = [c for c, _ in schema]
+        for start in range(0, len(keys), self.INDEX_CHUNK):
+            chunk = keys[start : start + self.INDEX_CHUNK]
+            if len(key_columns) == 1:
+                ph = ", ".join("?" for _ in chunk)
+                where = f"{_q(key_columns[0])} IN ({ph})"
+                params = [k[0] for k in chunk]
+            else:
+                tuple_ph = "(" + ", ".join("?" for _ in key_columns) + ")"
+                where = ("(" + ", ".join(_q(c) for c in key_columns) + ") IN ("
+                         + ", ".join(tuple_ph for _ in chunk) + ")")
+                params = [v for k in chunk for v in k]
+            out_rows.extend(self.scan_remote(table, cols, where, params))
+        dicts: Dict[str, Dictionary] = dict(self._dicts.get(table, {}))
+        cols_np, valids, page_dicts = [], [], []
+        for i, (name, t) in enumerate(schema):
+            raw = [r[i] for r in out_rows]
+            data, valid, d = _encode_column(raw, t, dicts.get(name))
+            if d is not None:
+                dicts[name] = d
+            cols_np.append(data)
+            valids.append(valid)
+            page_dicts.append(d)
+        self._dicts.setdefault(table, {}).update(dicts)
+        return [Page.from_arrays(cols_np, [t for _, t in schema],
+                                 valids=valids, dictionaries=page_dicts)]
+
     # -- loading ------------------------------------------------------------
     def _load(self, table: str) -> None:
         if table in self._pages:

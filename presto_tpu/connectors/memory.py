@@ -22,6 +22,7 @@ class MemoryConnector:
         self._domains: Dict[str, Dict[str, Optional[Tuple[int, int]]]] = {}
         self._pks: Dict[str, Optional[List[str]]] = {}
         self._sort: Dict[str, Optional[List[str]]] = {}
+        self._bucketing: Dict[str, Optional[tuple]] = {}
         self._dicts: Dict[str, Dict[str, object]] = {}
 
     # -- loading ------------------------------------------------------------
@@ -33,12 +34,14 @@ class MemoryConnector:
         domains: Optional[Dict[str, Tuple[int, int]]] = None,
         primary_key: Optional[List[str]] = None,
         sort_order: Optional[List[str]] = None,
+        bucketing: Optional[tuple] = None,
     ) -> None:
         self._tables[name] = [_to_device(p) for p in pages]
         self._schemas[name] = list(schema)
         self._domains[name] = dict(domains or {})
         self._pks[name] = primary_key
         self._sort[name] = list(sort_order) if sort_order else None
+        self._bucketing[name] = bucketing
         self._dicts[name] = {}
         for page in pages[:1]:
             for (col, t), b in zip(schema, page.blocks):
@@ -50,7 +53,7 @@ class MemoryConnector:
 
     def drop_table(self, name: str) -> None:
         for d in (self._tables, self._schemas, self._domains, self._pks,
-                  self._sort, self._dicts):
+                  self._sort, self._bucketing, self._dicts):
             d.pop(name, None)
 
     def load_from(self, conn, table: str, name: Optional[str] = None,
@@ -76,7 +79,11 @@ class MemoryConnector:
         so = conn.sort_order(table) if hasattr(conn, "sort_order") else None
         if so is not None and any(c not in [n for n, _ in pruned_schema] for c in so):
             so = None
-        self.create_table(name, pruned_schema, pages, domains, pk, sort_order=so)
+        bk = conn.bucketing(table) if hasattr(conn, "bucketing") else None
+        if bk is not None and any(c not in [n for n, _ in pruned_schema] for c in bk[0]):
+            bk = None
+        self.create_table(name, pruned_schema, pages, domains, pk,
+                          sort_order=so, bucketing=bk)
 
     # -- connector protocol -------------------------------------------------
     def table_names(self) -> List[str]:
@@ -107,6 +114,11 @@ class MemoryConnector:
         streaming-aggregation path; ConnectorMetadata local-properties
         analog)."""
         return self._sort.get(table)
+
+    def bucketing(self, table: str) -> Optional[tuple]:
+        """(bucket_columns, alignment_token, bucket_count): split index
+        is the bucket id (ConnectorNodePartitioningProvider analog)."""
+        return self._bucketing.get(table)
 
     def dictionary_for(self, table: str, column: str):
         return self._dicts.get(table, {}).get(column)

@@ -284,9 +284,15 @@ class Tpch:
 
     COMMENT_VOCAB = 4096
 
-    def __init__(self, sf: float = 1.0, split_rows: int = 1 << 20):
+    def __init__(self, sf: float = 1.0, split_rows: int = 1 << 20,
+                 aligned_buckets: bool = False):
         self.sf = float(sf)
         self.split_rows = int(split_rows)
+        # aligned_buckets: orders and lineitem use the SAME order-range
+        # granularity per split, making split index a shared bucket id
+        # (ConnectorNodePartitioningProvider analog — enables colocated
+        # joins; lineitem splits are ~4x the rows of orders splits)
+        self.aligned_buckets = bool(aligned_buckets)
         self.n_orders = int(round(1_500_000 * self.sf))
         self.n_customers = int(round(150_000 * self.sf))
         self.n_parts = int(round(200_000 * self.sf))
@@ -401,18 +407,24 @@ class Tpch:
         """Static upper bound on rows in any split (static-shape wave
         capacity for distributed scans)."""
         if table == "lineitem":
-            per = max(self.split_rows // 4, 1)
+            per = self._per("lineitem")
             return min(per * 7, max(self.row_count("lineitem"), 1))
         return min(self.split_rows, max(self.row_count(table), 1))
 
     def num_splits(self, table: str) -> int:
         if table in ("orders", "lineitem"):
-            per = max(self.split_rows // 4, 1) if table == "lineitem" else self.split_rows
+            per = self._per(table)
             return max(1, -(-self.n_orders // per))
         return max(1, -(-self.row_count(table) // self.split_rows))
 
+    def _per(self, table: str) -> int:
+        """Orders per split for the order-range-partitioned tables."""
+        if table == "lineitem" and not self.aligned_buckets:
+            return max(self.split_rows // 4, 1)
+        return self.split_rows
+
     def _order_range(self, table: str, split: int) -> Tuple[int, int]:
-        per = max(self.split_rows // 4, 1) if table == "lineitem" else self.split_rows
+        per = self._per(table)
         lo = split * per
         return lo, min(lo + per, self.n_orders)
 
@@ -643,6 +655,17 @@ class Tpch:
             "orders": ["o_orderkey"],
             "lineitem": ["l_orderkey", "l_linenumber"],
         }.get(table)
+
+    def bucketing(self, table: str) -> Optional[Tuple[List[str], tuple, int]]:
+        """(bucket_columns, alignment_token, bucket_count) — split index
+        IS the bucket id; orders/lineitem share order-range buckets when
+        ``aligned_buckets`` (ConnectorNodePartitioningProvider analog,
+        presto-tpch TpchNodePartitioningProvider)."""
+        if table in ("orders", "lineitem") and self._per("orders") == self._per(table):
+            col = "o_orderkey" if table == "orders" else "l_orderkey"
+            token = ("tpch-order-range", self.sf, self._per(table))
+            return ([col], token, self.num_splits(table))
+        return None
 
     def sort_order(self, table: str) -> Optional[List[str]]:
         """The generator emits rows in primary-key order (sequential

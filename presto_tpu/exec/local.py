@@ -448,6 +448,10 @@ class LocalRunner:
                 yield fn(p)
             return
 
+        if isinstance(node, JoinNode) and node.use_index:
+            yield from self._index_join_pages(node)
+            return
+
         if isinstance(node, JoinNode) and not self._streaming(node):
             yield from self._expanding_join_pages(node)
             return
@@ -695,6 +699,60 @@ class LocalRunner:
         for p in self._pages(node.source):
             for fn in fns:
                 yield fn(p)
+
+    # ------------------------------------------------------------------
+    def _index_join_pages(self, node: JoinNode) -> Iterator[Page]:
+        """Index join: fetch build rows per probe batch through the
+        connector's point-lookup SPI (operator/index/IndexLoader.java +
+        IndexSourceOperator.java).  Each probe page's distinct key
+        tuples go to the connector; only matching build rows ever
+        materialize."""
+        from presto_tpu.expr.compile import ExprCompiler
+
+        scan: TableScanNode = node.right
+        conn = self.catalog.connector(scan.handle.connector_name)
+        key_cols = [
+            scan.handle.columns[scan.columns[k.index]].name
+            for k in node.right_keys
+        ]
+        left_keys = list(node.left_keys)
+        right_keys = list(node.right_keys)
+        build_output = list(range(len(node.right.channels)))
+        col_idx = list(scan.columns)
+
+        for p in self._pages(node.left):
+            ph = p.compact_host()
+            c = ExprCompiler.for_page(ph)
+            lanes = []
+            sel = np.asarray(ph.row_mask)
+            for e in left_keys:
+                d, v = c.compile(e)(ph)
+                lanes.append(np.asarray(d))
+                sel = sel & np.asarray(v)
+            keys = {tuple(int(lane[i]) for lane in lanes)
+                    for i in np.nonzero(sel)[0]}
+            fetched = conn.index_lookup(scan.handle.table, key_cols, sorted(keys))
+            pruned = [Page(tuple(fp.blocks[i] for i in col_idx), fp.row_mask)
+                      for fp in fetched]
+            bpage = concat_pages_device(pruned) if pruned else Page.empty(
+                node.right.output_types, 1)
+            build = build_join(bpage, right_keys, key_domains=None)
+            self._account("index_join_build", build.page, node)
+            if node.kind in ("semi", "anti"):
+                yield probe_join(build, p, left_keys, key_domains=None,
+                                 kind=node.kind, build_output=build_output)
+            elif node.unique_build:
+                yield probe_join(build, p, left_keys, key_domains=None,
+                                 kind=node.kind, build_output=build_output)
+            else:
+                def probe_fn(b, pp, out_capacity):
+                    return probe_expand(
+                        b, pp, left_keys, out_capacity, key_domains=None,
+                        kind=node.kind, build_output=build_output,
+                    )
+
+                res = _probe_with_retry(probe_fn, build, p)
+                yield res[0]
 
     # ------------------------------------------------------------------
     def _partitioned_join_pages(self, node: JoinNode) -> Iterator[Page]:

@@ -294,7 +294,8 @@ class DistributedRunner:
         rendering and execution always agree)."""
         from presto_tpu.parallel.fragment import decide_join_distribution
 
-        mode, _ = decide_join_distribution(jnode, self.broadcast_threshold)
+        mode, _ = decide_join_distribution(jnode, self.broadcast_threshold,
+                                           catalog=self.catalog)
         return mode
 
     def _join_cfg_for(self, jnode, cap: int) -> Dict[str, int]:
@@ -410,6 +411,37 @@ class DistributedRunner:
 
                 return f_bexpand
 
+            if mode == "colocated":
+                # bucket-aligned sides: device d already holds build
+                # bucket w*n+d when probing split w*n+d — NO exchange
+                # on either side (colocated_join /
+                # NodePartitioningManager bucket alignment)
+                key = ctx.add_sharded(node)
+                if streaming:
+
+                    def f_cjoin(p, c):
+                        q, ch = inner(p, c)
+                        out = probe_join(
+                            _squeeze(c[key]), q, left_keys, key_domains=kd,
+                            kind=kind, build_output=build_output,
+                        )
+                        return out, ch
+
+                    return f_cjoin
+
+                out_cap = cfg["out_cap"]
+                expand_check = ctx.add_check(node, "expand")
+
+                def f_cexpand(p, c):
+                    q, ch = inner(p, c)
+                    out, total = probe_expand(
+                        _squeeze(c[key]), q, left_keys, out_cap, key_domains=kd,
+                        kind=kind, build_output=build_output,
+                    )
+                    return out, {**ch, expand_check: total.astype(jnp.int32)}
+
+                return f_cexpand
+
             # partitioned (repartitioned join): exchange probe rows on
             # the join key, probe the local build shard
             key = ctx.add_sharded(node)
@@ -470,7 +502,10 @@ class DistributedRunner:
             key: runner._materialize_build(j) for key, j in ctx.broadcast.items()
         }
         consts_shard = {
-            key: self._materialize_build_sharded(j) for key, j in ctx.sharded.items()
+            key: (self._materialize_build_colocated(j)
+                  if self._join_mode(j) == "colocated"
+                  else self._materialize_build_sharded(j))
+            for key, j in ctx.sharded.items()
         }
 
         mg = self._mg_overrides.get(agg) or runner._max_groups(agg)
@@ -604,6 +639,74 @@ class DistributedRunner:
     # ------------------------------------------------------------------
     # sharded (repartitioned) join builds
     # ------------------------------------------------------------------
+    def _materialize_build_colocated(self, jnode) -> JoinBuild:
+        """Build side of a colocated join: device d wave-scans its OWN
+        build splits (the same w*n+d placement the probe leaf uses, so
+        bucket b always lands where probe bucket b executes) — no
+        exchange at all.  Reference: colocated joins over
+        ConnectorNodePartitioningProvider bucketed tables."""
+        key = (jnode, "colocated")
+        cached = self._sharded_builds.get(key)
+        if cached is not None:
+            return cached
+        n, mesh, axis = self.n, self.mesh, self.axis
+        runner = self._stage_runner
+        leaf_r = runner._chain_leaf(jnode.right)
+        conn_r = self.catalog.connector(leaf_r.handle.connector_name)
+        cap_r = self._split_capacity(conn_r, leaf_r.handle.table)
+        joins_r: List[PlanNode] = []
+        stage_r = runner._build_stage(jnode.right, joins_r)
+        consts_r = {
+            f"build_{i}": runner._materialize_build(j) for i, j in enumerate(joins_r)
+        }
+        right_keys = list(jnode.right_keys)
+        kd = jnode.key_domains
+
+        def bw(page1, crep):
+            return _unsqueeze(stage_r(_squeeze(page1), crep))
+
+        bw_fn = jax.jit(
+            jax.shard_map(bw, mesh=mesh, in_specs=(P(axis), P()),
+                          out_specs=P(axis))
+        )
+        sharding = NamedSharding(mesh, P(axis))
+        col_idx = list(leaf_r.columns)
+        received: List[Page] = []
+        waves = math.ceil(leaf_r.handle.num_splits / n)
+        for w in range(waves):
+            stacked = jax.device_put(
+                self._stacked_wave(conn_r, leaf_r, col_idx, w, cap_r), sharding
+            )
+            received.append(bw_fn(stacked, consts_r))
+
+        if len(received) == 1:
+            big = received[0]
+        else:
+            b0 = received[0]
+            big = Page(
+                tuple(
+                    Block(
+                        jnp.concatenate([r.blocks[i].data for r in received], axis=1),
+                        jnp.concatenate([r.blocks[i].valid for r in received], axis=1),
+                        b.type,
+                        b.dictionary,
+                    )
+                    for i, b in enumerate(b0.blocks)
+                ),
+                jnp.concatenate([r.row_mask for r in received], axis=1),
+            )
+        bj_fn = jax.jit(
+            jax.shard_map(
+                lambda pg1: _unsqueeze(
+                    build_join(_squeeze(pg1), right_keys, key_domains=kd)
+                ),
+                mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            )
+        )
+        build = bj_fn(big)
+        self._sharded_builds[key] = build
+        return build
+
     def _materialize_build_sharded(self, jnode) -> JoinBuild:
         """Build side of a repartitioned join: wave-scan the build
         chain over the mesh, hash-exchange rows on the join key, then

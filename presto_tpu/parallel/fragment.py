@@ -40,11 +40,14 @@ from presto_tpu.planner.plan import (
     WindowNode,
 )
 
-# Partitioning handle kinds (SystemPartitioningHandle.java:58-66)
+# Partitioning handle kinds (SystemPartitioningHandle.java:58-66; the
+# colocated kind is the bucket-aligned no-exchange placement the
+# reference expresses via connector partitioning handles)
 SINGLE = "SINGLE"
 FIXED_HASH = "FIXED_HASH"
 BROADCAST = "BROADCAST"
 SOURCE = "SOURCE"
+COLOCATED = "COLOCATED"
 
 # Build sides at or below this estimated row count replicate to every
 # device (join_distribution_type=AUTOMATIC's size cutoff; the reference
@@ -124,10 +127,74 @@ def build_side_chainable(node: PlanNode) -> bool:
     return isinstance(node, TableScanNode)
 
 
+def _trace_to_scan_columns(node: PlanNode, keys) -> Optional[Tuple[PlanNode, List[str]]]:
+    """Map ColumnRef join keys through filter/pass-through-projection
+    chains to (leaf scan, column names); None when any key derives."""
+    from presto_tpu.expr.ir import ColumnRef
+
+    remap = None
+    cur = node
+    while True:
+        if isinstance(cur, FilterNode):
+            cur = cur.source
+        elif isinstance(cur, ProjectNode):
+            proj_map = {i: p.index for i, p in enumerate(cur.projections)
+                        if isinstance(p, ColumnRef)}
+            src_items = (remap.items() if remap is not None else
+                         ((i, i) for i in range(len(cur.channels))))
+            remap = {o: proj_map[i] for o, i in src_items if i in proj_map}
+            cur = cur.source
+        else:
+            break
+    if not isinstance(cur, TableScanNode):
+        return None
+    names = []
+    for k in keys:
+        if not isinstance(k, ColumnRef):
+            return None
+        idx = k.index if remap is None else remap.get(k.index)
+        if idx is None or idx >= len(cur.columns):
+            return None
+        names.append(cur.handle.columns[cur.columns[idx]].name)
+    return cur, names
+
+
+def colocated_join_scans(jnode, catalog) -> Optional[Tuple[PlanNode, PlanNode]]:
+    """(probe_scan, build_scan) when both join sides are scan chains of
+    compatibly bucketed tables joined exactly on the bucket columns —
+    the shuffle-free colocated join (colocated_join session property +
+    NodePartitioningManager bucket-to-node alignment in the reference).
+    Bucket id = split index on both sides, so the wave scheduler's
+    'device d takes split w*n+d' placement already colocates them."""
+    if isinstance(jnode, CrossSingleNode) or catalog is None:
+        return None
+    left = _trace_to_scan_columns(jnode.left, jnode.left_keys)
+    right = _trace_to_scan_columns(jnode.right, jnode.right_keys)
+    if left is None or right is None:
+        return None
+    (lscan, lcols), (rscan, rcols) = left, right
+    try:
+        lconn = catalog.connector(lscan.handle.connector_name)
+        rconn = catalog.connector(rscan.handle.connector_name)
+    except KeyError:
+        return None
+    lb = lconn.bucketing(lscan.handle.table) if hasattr(lconn, "bucketing") else None
+    rb = rconn.bucketing(rscan.handle.table) if hasattr(rconn, "bucketing") else None
+    if lb is None or rb is None:
+        return None
+    if lb[1] != rb[1] or lb[2] != rb[2]:
+        return None  # different alignment or bucket counts
+    if lcols != lb[0] or rcols != rb[0]:
+        return None  # join keys must be exactly the bucket columns
+    return lscan, rscan
+
+
 def decide_join_distribution(
-    jnode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD
+    jnode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
+    catalog=None,
 ) -> Tuple[str, Optional[int]]:
-    """(mode, estimated build rows): 'broadcast' replicates the build to
+    """(mode, estimated build rows): 'colocated' joins bucket-aligned
+    scans with no exchange at all; 'broadcast' replicates the build to
     every device; 'partitioned' hash-exchanges both sides on the join
     key (DetermineJoinDistributionType.java:33 —
     AUTOMATIC chooses by build size).  Build sides that can't wave-scan
@@ -136,6 +203,9 @@ def decide_join_distribution(
     if isinstance(jnode, CrossSingleNode):
         return "broadcast", 1
     est = estimate_rows(jnode.right)
+    if (colocated_join_scans(jnode, catalog) is not None
+            and build_side_chainable(jnode.right)):
+        return "colocated", est
     if est is None or est <= broadcast_threshold:
         return "broadcast", est
     if not build_side_chainable(jnode.right):
@@ -144,7 +214,8 @@ def decide_join_distribution(
 
 
 def fragment_plan(
-    plan: PlanNode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD
+    plan: PlanNode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
+    catalog=None,
 ) -> Fragment:
     """Lower a plan into a SubPlan-style fragment tree.  Fragments are
     created at the distributed runner's exchange points: the SINGLE
@@ -169,13 +240,14 @@ def fragment_plan(
             out += build_fragments(node.source)
         elif isinstance(node, (JoinNode, CrossSingleNode)):
             out += build_fragments(node.left)
-            mode, _ = decide_join_distribution(node, broadcast_threshold)
+            mode, _ = decide_join_distribution(node, broadcast_threshold, catalog=catalog)
             right = node.right
-            kind = (
-                BROADCAST
-                if mode == "broadcast"
-                else FIXED_HASH
-            )
+            if mode == "broadcast":
+                kind = BROADCAST
+            elif mode == "colocated":
+                kind = COLOCATED
+            else:
+                kind = FIXED_HASH
             keys = tuple(getattr(node, "right_keys", ()))
             out.append(
                 Fragment(
@@ -240,6 +312,7 @@ def fragment_plan(
 
 
 def explain_distributed(
-    plan: PlanNode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD
+    plan: PlanNode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
+    catalog=None,
 ) -> str:
-    return fragment_plan(plan, broadcast_threshold).tree_str()
+    return fragment_plan(plan, broadcast_threshold, catalog=catalog).tree_str()

@@ -235,6 +235,10 @@ class JoinNode(PlanNode):
     right_keys: List[Expr]
     kind: str = "inner"
     unique_build: bool = False
+    # build side fetched per probe batch via the connector's point-
+    # lookup SPI instead of a full scan (operator/index/IndexLoader +
+    # planner IndexJoinOptimizer.java)
+    use_index: bool = False
 
     @property
     def sources(self):

@@ -332,4 +332,4 @@ class QueryRunner:
         sql/planner/PlanFragmenter SubPlans printed by PlanPrinter)."""
         from presto_tpu.parallel.fragment import explain_distributed
 
-        return explain_distributed(self.plan(sql))
+        return explain_distributed(self.plan(sql), catalog=self.catalog)

@@ -345,7 +345,68 @@ class Binder:
         # (sql/planner/iterative/IterativeOptimizer.java)
         from presto_tpu.planner.iterative import IterativeOptimizer
 
-        return IterativeOptimizer().optimize(out)
+        out = IterativeOptimizer().optimize(out)
+        self._enable_index_joins(out)
+        return out
+
+    def _enable_index_joins(self, root: PlanNode) -> None:
+        """Flag (or side-swap) joins where one side is a bare scan of an
+        index-capable connector and the other is much smaller: fetching
+        build rows by probe keys beats the full scan
+        (IndexJoinOptimizer.java).  The hash planner puts the largest
+        term on the probe side, so the indexed scan usually arrives as
+        ``left`` — inner joins swap sides behind a reordering
+        projection."""
+        from presto_tpu.planner.iterative import _replace_sources
+
+        def indexable(scan: PlanNode, keys) -> bool:
+            if not (isinstance(scan, TableScanNode) and not scan.constraints):
+                return False
+            if not all(isinstance(k, ColumnRef)
+                       and k.type.name in ("bigint", "integer") for k in keys):
+                return False
+            conn = self.catalog.connector(scan.handle.connector_name)
+            if not (hasattr(conn, "supports_index")
+                    and hasattr(conn, "index_lookup")):
+                return False
+            key_cols = [scan.handle.columns[scan.columns[k.index]].name
+                        for k in keys]
+            return conn.supports_index(scan.handle.table, key_cols)
+
+        def walk(n: PlanNode) -> PlanNode:
+            srcs = n.sources
+            if srcs:
+                new = [walk(s) for s in srcs]
+                if any(a is not b for a, b in zip(new, srcs)):
+                    _replace_sources(n, new)
+            if not isinstance(n, JoinNode):
+                return n
+            if (n.kind in ("inner", "semi", "anti")
+                    and indexable(n.right, n.right_keys)
+                    and self._estimate(n.left) * 10 < self._estimate(n.right)):
+                n.use_index = True
+                return n
+            if (n.kind == "inner" and not n.use_index
+                    and indexable(n.left, n.left_keys)
+                    and self._estimate(n.right) * 10 < self._estimate(n.left)):
+                nl, nr = len(n.left.channels), len(n.right.channels)
+                swapped = JoinNode(
+                    left=n.right, right=n.left,
+                    left_keys=list(n.right_keys), right_keys=list(n.left_keys),
+                    kind="inner", use_index=True,
+                )
+                chans = swapped.channels  # right-side first
+                projections = (
+                    [ColumnRef(type=chans[nr + i].type, index=nr + i)
+                     for i in range(nl)]
+                    + [ColumnRef(type=chans[i].type, index=i) for i in range(nr)]
+                )
+                names = ([c.name for c in chans[nr:]]
+                         + [c.name for c in chans[:nr]])
+                return ProjectNode(swapped, projections, names)
+            return n
+
+        walk(root)
 
     def _plan_query_like(self, q: ast.Node) -> Tuple[PlanNode, List[str]]:
         if isinstance(q, ast.Union):

@@ -78,6 +78,53 @@ def test_jdbc_pushdown_escape_hatch(sqlite_db):
     assert rows == [(1, "alice"), (2, "bob")]
 
 
+def test_index_join_point_lookup(tmp_path):
+    """Index join: the big remote table is fetched by probe keys only
+    (IndexLoader analog)."""
+    import numpy as np
+
+    from presto_tpu.connectors.jdbc import JdbcConnector
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.page import Page
+    from presto_tpu.planner.plan import JoinNode
+    from presto_tpu.types import BIGINT
+
+    path = str(tmp_path / "big.db")
+    db = sqlite3.connect(path)
+    db.execute("CREATE TABLE big (id INTEGER PRIMARY KEY, payload INTEGER)")
+    db.executemany("INSERT INTO big VALUES (?, ?)",
+                   [(i, i * 100) for i in range(5000)])
+    db.commit()
+    db.close()
+
+    mem = MemoryConnector()
+    mem.create_table("probe", [("k", BIGINT)],
+                     [Page.from_arrays([np.asarray([3, 4999, 7, 3])], [BIGINT])])
+    jdbc = JdbcConnector.sqlite(path)
+    lookups = []
+    orig = jdbc.index_lookup
+    jdbc.index_lookup = lambda *a: (lookups.append(a), orig(*a))[1]
+    cat = Catalog()
+    cat.register("mem", mem)
+    cat.register("ext", jdbc)
+    r = QueryRunner(cat)
+
+    sql = ("SELECT k, payload FROM probe JOIN big ON k = id ORDER BY k, payload")
+    plan = r.plan(sql)
+
+    def walk(n):
+        yield n
+        for s in n.sources:
+            yield from walk(s)
+
+    joins = [n for n in walk(plan) if isinstance(n, JoinNode)]
+    assert joins and any(j.use_index for j in joins)
+    rows = r.execute(sql).rows
+    assert rows == [(3, 300), (3, 300), (7, 700), (4999, 499900)]
+    # the lookup ran with only the distinct probe keys
+    assert lookups and sorted(lookups[0][2]) == [(3,), (7,), (4999,)]
+
+
 def test_localfile_csv_and_json(tmp_path):
     from presto_tpu.connectors.localfile import LocalFileConnector
 
