@@ -93,16 +93,27 @@ class EngineConfig:
         return cls(props, catalogs)
 
     # -- materialization ----------------------------------------------------
-    def build_catalog(self):
+    def build_catalog(self, plugin_manager=None):
         """Instantiate connectors from the catalog property files
         (PluginManager + ConnectorFactory analog, keyed by
-        ``connector.name``)."""
+        ``connector.name``).  Unknown kinds resolve through the plugin
+        manager (``plugin.dir`` in config.properties loads one)."""
         from presto_tpu.catalog import Catalog
 
+        if plugin_manager is None and self.props.get("plugin.dir"):
+            from presto_tpu.plugin import PluginManager
+
+            plugin_manager = PluginManager()
+            plugin_manager.load_directory(self.props["plugin.dir"])
         catalog = Catalog()
         for name, props in self.catalogs.items():
             kind = props.get("connector.name")
-            conn = _make_connector(kind, props)
+            if kind in _BUILTIN_CONNECTORS:
+                conn = _make_connector(kind, props)
+            elif plugin_manager is not None and kind in plugin_manager.connector_factories:
+                conn = plugin_manager.make_connector(kind, props)
+            else:
+                raise ValueError(f"unknown connector.name: {kind!r}")
             catalog.register(name, conn)
         return catalog
 
@@ -110,6 +121,9 @@ class EngineConfig:
         from presto_tpu.session import Session
 
         return Session(properties=self.session_defaults())
+
+
+_BUILTIN_CONNECTORS = ("tpch", "tpcds", "memory", "blackhole")
 
 
 def _make_connector(kind: Optional[str], props: Dict[str, str]):

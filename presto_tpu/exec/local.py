@@ -237,26 +237,46 @@ class LocalRunner:
             rows=out.to_pylist(),
         )
 
-    def run_to_page(self, plan: PlanNode, query_id: Optional[str] = None) -> Page:
-        if self.memory_pool is not None:
-            from presto_tpu.memory import QueryMemoryContext
-            import uuid
+    def _query_mem(self, query_id: Optional[str]):
+        """Per-query memory-context ceremony shared by run_to_page and
+        stream_pages: pool reservations tagged by the COORDINATOR's
+        query id so the cluster memory manager can attribute + kill."""
+        import contextlib
 
-            # pool reservations tagged by the COORDINATOR's query id so
-            # the cluster memory manager can attribute + kill by query
-            self._mem = QueryMemoryContext(
-                self.memory_pool, query_id or uuid.uuid4().hex[:8])
-        try:
+        @contextlib.contextmanager
+        def ctx():
+            if self.memory_pool is not None:
+                from presto_tpu.memory import QueryMemoryContext
+                import uuid
+
+                self._mem = QueryMemoryContext(
+                    self.memory_pool, query_id or uuid.uuid4().hex[:8])
+            try:
+                yield
+            finally:
+                if self._mem is not None:
+                    self._mem.release_all()
+                    self._mem = None
+
+        return ctx()
+
+    def run_to_page(self, plan: PlanNode, query_id: Optional[str] = None) -> Page:
+        with self._query_mem(query_id):
             while True:
                 try:
                     self._builds.clear()
                     return self._execute_to_page(plan)
                 except GroupCapacityExceeded:
                     continue  # _agg_overrides updated; re-execute
-        finally:
-            if self._mem is not None:
-                self._mem.release_all()
-                self._mem = None
+
+    def stream_pages(self, plan: PlanNode, query_id: Optional[str] = None) -> Iterator[Page]:
+        """Stream output pages with run_to_page's memory-context
+        ceremony but no internal retry: GroupCapacityExceeded
+        propagates so a caller that consumed partial output can restart
+        from scratch (the scaled-writer ingest path)."""
+        with self._query_mem(query_id):
+            self._builds.clear()
+            yield from self._pages(plan)
 
     @property
     def _builds(self) -> Dict[JoinNode, JoinBuild]:

@@ -1,0 +1,66 @@
+"""Plugin loading: connector factories from external module files.
+
+Reference analog: ``server/PluginManager.java`` + ``spi/Plugin.java`` —
+each plugin directory's jar exposes a Plugin whose factories register
+into the engine.  Python version: each ``*.py`` file in the plugin
+directory is imported as its own module (namespaced under
+``presto_tpu_plugins.<file>`` — the classloader-isolation analog is
+module-namespace isolation; python cannot isolate transitive imports
+the way PluginClassLoader does) and must define::
+
+    PLUGIN = {
+        "name": "my-plugin",
+        "connector_factories": {"mykind": lambda props: MyConnector(...)},
+    }
+
+``EngineConfig.build_catalog`` consults registered factories for any
+``connector.name`` the builtins don't know.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Callable, Dict, List, Optional
+
+
+class PluginManager:
+    def __init__(self):
+        self.plugins: List[dict] = []
+        self.connector_factories: Dict[str, Callable] = {}
+
+    def load_directory(self, plugin_dir: str) -> List[str]:
+        """Import every *.py in ``plugin_dir`` as an isolated module and
+        register its PLUGIN declaration; returns loaded plugin names."""
+        loaded = []
+        if not os.path.isdir(plugin_dir):
+            return loaded
+        for fn in sorted(os.listdir(plugin_dir)):
+            if not fn.endswith(".py") or fn.startswith("_"):
+                continue
+            name = fn[:-3]
+            loaded.append(self.load_file(os.path.join(plugin_dir, fn), name))
+        return loaded
+
+    def load_file(self, path: str, name: Optional[str] = None) -> str:
+        modname = f"presto_tpu_plugins.{name or os.path.basename(path)[:-3]}"
+        spec = importlib.util.spec_from_file_location(modname, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[modname] = mod
+        spec.loader.exec_module(mod)
+        decl = getattr(mod, "PLUGIN", None)
+        if not isinstance(decl, dict) or "name" not in decl:
+            raise ValueError(f"{path}: no PLUGIN declaration")
+        self.plugins.append(decl)
+        for kind, factory in decl.get("connector_factories", {}).items():
+            if kind in self.connector_factories:
+                raise ValueError(f"duplicate connector factory {kind!r}")
+            self.connector_factories[kind] = factory
+        return decl["name"]
+
+    def make_connector(self, kind: str, props: Dict[str, str]):
+        factory = self.connector_factories.get(kind)
+        if factory is None:
+            raise KeyError(kind)
+        return factory(props)

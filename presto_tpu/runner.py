@@ -212,13 +212,36 @@ class QueryRunner:
                 raise ValueError(f"connector {cname} is read-only")
         self._check_tx_writable(cname, conn)
 
-        page = self.executor.run_to_page(plan, query_id=query_id).compact_host()
-        rows = int(np.asarray(page.num_rows()))
+        # scaled writers: per-page transfer+compaction runs on a pool
+        # that grows while the producer outpaces it; results publish
+        # atomically after the whole query succeeds
+        # (scheduler/ScaledWriterScheduler.java + TableFinishOperator)
+        from presto_tpu.exec.local import GroupCapacityExceeded
+        from presto_tpu.writer import ScaledWriter
+
+        while True:
+            writer = ScaledWriter(lambda p: p.compact_host())
+            done = False
+            try:
+                for p in self.executor.stream_pages(plan, query_id=query_id):
+                    writer.submit(p)
+                pages = writer.finish()
+                done = True
+                break
+            except GroupCapacityExceeded:
+                pass  # restart with the executor's larger caps
+            finally:
+                if not done:
+                    writer.abort()  # never leak blocked writer threads
+        live = [p for p in pages
+                if int(np.asarray(p.row_mask).sum()) > 0]
+        pages = live or pages[:1]
+        rows = sum(int(np.asarray(p.row_mask).sum()) for p in pages)
 
         if isinstance(stmt, ast.CreateTableAs):
             schema = list(zip(plan.output_names, plan.output_types))
-            if not self._stage_write(cname, conn, "create_table", table, schema, [page]):
-                conn.create_table(table, schema, [page])
+            if not self._stage_write(cname, conn, "create_table", table, schema, pages):
+                conn.create_table(table, schema, pages)
         else:
             want = [c.type for c in handle.columns]
             got = plan.output_types
@@ -229,9 +252,9 @@ class QueryRunner:
             # values are still valid for any column of the same scale.
             if [(t.name, t.scale) for t in want] != [(t.name, t.scale) for t in got]:
                 raise ValueError(f"INSERT schema mismatch: {want} vs {got}")
-            page = self._recode_strings(page, handle)
-            if not self._stage_write(cname, conn, "append_pages", table, [page]):
-                conn.append_pages(table, [page])
+            pages = [self._recode_strings(p, handle) for p in pages]
+            if not self._stage_write(cname, conn, "append_pages", table, pages):
+                conn.append_pages(table, pages)
         self._invalidate_plans()
         return MaterializedResult(["rows"], [BIGINT], [(rows,)])
 

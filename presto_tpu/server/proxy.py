@@ -1,0 +1,119 @@
+"""Authenticating reverse proxy for the statement protocol.
+
+Reference analog: ``presto-proxy`` (ProxyResource.java — forwards the
+V1 REST protocol to a backing coordinator, authenticating callers and
+rewriting nextUri links so clients keep talking to the proxy).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib import request as _request
+from urllib.error import HTTPError
+
+
+class ProxyServer:
+    """Forwards /v1/* to ``backend_uri``; optional bearer-token check.
+
+    nextUri values in JSON responses rewrite from the backend authority
+    to the proxy's, so paging clients never learn the backend address
+    (ProxyResource's rewriteUri)."""
+
+    def __init__(self, backend_uri: str, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None,
+                 authenticate: Optional[Callable[[str], bool]] = None,
+                 public_host: Optional[str] = None):
+        self.backend = backend_uri.rstrip("/")
+        self.token = token
+        self.authenticate = authenticate
+        # the authority clients reach the proxy at — used by nextUri
+        # rewriting; a 0.0.0.0 bind must supply its public name
+        self._public_host = public_host or (
+            host if host not in ("0.0.0.0", "::") else None)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reject(self, code: int, msg: str) -> None:
+                body = json.dumps({"error": msg}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authorized(self) -> bool:
+                if outer.token is None and outer.authenticate is None:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                got = auth[len("Bearer "):] if auth.startswith("Bearer ") else ""
+                if outer.authenticate is not None:
+                    return outer.authenticate(got)
+                return got == outer.token
+
+            def _forward(self, method: str) -> None:
+                if not self.path.startswith("/v1/"):
+                    self._reject(404, "not found")
+                    return
+                if not self._authorized():
+                    self._reject(401, "unauthorized")
+                    return
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                body = self.rfile.read(n) if n else None
+                req = _request.Request(outer.backend + self.path, data=body,
+                                       method=method)
+                for h in ("Content-Type", "X-Presto-User", "X-Trace-Token"):
+                    if self.headers.get(h):
+                        req.add_header(h, self.headers[h])
+                try:
+                    with _request.urlopen(req, timeout=60) as resp:
+                        payload = resp.read()
+                        ctype = resp.headers.get("Content-Type", "application/json")
+                        code = resp.status
+                except HTTPError as e:
+                    payload = e.read()
+                    ctype = e.headers.get("Content-Type", "application/json")
+                    code = e.code
+                if b"nextUri" in payload:
+                    payload = payload.replace(
+                        outer.backend.encode(), outer.uri.encode())
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._forward("GET")
+
+            def do_POST(self):
+                self._forward("POST")
+
+            def do_DELETE(self):
+                self._forward("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def uri(self) -> str:
+        if self._public_host is None:
+            raise ValueError(
+                "proxy bound to a wildcard address needs public_host= for "
+                "client-facing nextUri rewriting")
+        return f"http://{self._public_host}:{self.port}"

@@ -1,0 +1,95 @@
+"""Scaled table writers.
+
+Reference analog: ``scheduler/ScaledWriterScheduler.java`` +
+``SystemPartitioningHandle.SCALED_WRITER`` — writer tasks are added
+dynamically while producers outpace the writers.  Here the expensive
+per-page write work (device->host transfer, compaction, dictionary
+recoding) runs on a thread pool that grows one writer at a time
+whenever the queue backs up, and the staged results publish atomically
+at finish (TableFinishOperator's commit role).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+
+class ScaledWriter:
+    """Submit pages; ``finish()`` returns the processed results.
+
+    ``write_fn(page) -> result`` runs on writer threads.  One writer
+    starts immediately; another is added (up to ``max_writers``)
+    whenever a submit observes more than ``scale_depth`` queued pages —
+    the produced-data-rate trigger of ScaledWriterScheduler.
+    """
+
+    def __init__(self, write_fn: Callable, max_writers: int = 4,
+                 scale_depth: int = 2):
+        self._write = write_fn
+        self.max_writers = max_writers
+        self.scale_depth = scale_depth
+        self._q: "queue.Queue" = queue.Queue()
+        self._seq = 0
+        self._results: List = []
+        self._errors: List[BaseException] = []
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._stop = object()
+        self._spawn()
+
+    # -- internals ----------------------------------------------------------
+    def _spawn(self) -> None:
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._stop:
+                return
+            seq, page = item
+            try:
+                out = self._write(page)
+                with self._lock:
+                    self._results.append((seq, out))
+            except BaseException as e:  # surfaced by finish()
+                with self._lock:
+                    self._errors.append(e)
+
+    # -- public -------------------------------------------------------------
+    @property
+    def writer_count(self) -> int:
+        return len(self._threads)
+
+    def submit(self, page) -> None:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        self._q.put((seq, page))
+        if (self._q.qsize() > self.scale_depth
+                and len(self._threads) < self.max_writers):
+            self._spawn()
+
+    def finish(self) -> List:
+        """Drain, join writers, and return results in submit order."""
+        for _ in self._threads:
+            self._q.put(self._stop)
+        for t in self._threads:
+            t.join()
+        if self._errors:
+            raise self._errors[0]
+        return [r for _, r in sorted(self._results, key=lambda x: x[0])]
+
+    def abort(self) -> None:
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        for _ in self._threads:
+            self._q.put(self._stop)
+        for t in self._threads:
+            t.join()

@@ -199,6 +199,50 @@ def test_worker_drain_rejects_new_tasks():
 
 
 # ---------------------------------------------------------------------------
+# scaled writers
+# ---------------------------------------------------------------------------
+
+def test_scaled_writer_scales_and_orders():
+    import time
+
+    from presto_tpu.writer import ScaledWriter
+
+    w = ScaledWriter(lambda x: (time.sleep(0.02), x * 10)[1],
+                     max_writers=4, scale_depth=1)
+    for i in range(20):
+        w.submit(i)
+    out = w.finish()
+    assert out == [i * 10 for i in range(20)]
+    assert w.writer_count > 1  # queue depth triggered extra writers
+
+
+def test_scaled_writer_error_propagates():
+    from presto_tpu.writer import ScaledWriter
+
+    w = ScaledWriter(lambda x: 1 / 0)
+    w.submit(1)
+    with pytest.raises(ZeroDivisionError):
+        w.finish()
+
+
+def test_ctas_multisplit_preserves_splits():
+    """A multi-split source CTAS lands as a multi-split table (parallel
+    writers, one split per produced page)."""
+    from presto_tpu.connectors.tpch import Tpch
+
+    cat = Catalog()
+    cat.register("tpch", Tpch(sf=0.01, split_rows=1 << 12))
+    mem = MemoryConnector()
+    cat.register("mem", mem, writable=True)
+    r = QueryRunner(cat)
+    r.execute("CREATE TABLE li2 AS SELECT l_orderkey, l_quantity FROM lineitem")
+    assert mem.num_splits("li2") > 1
+    got = r.execute("SELECT count(*), sum(l_quantity) FROM li2").rows
+    want = r.execute("SELECT count(*), sum(l_quantity) FROM lineitem").rows
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
 # launcher / packaging
 # ---------------------------------------------------------------------------
 
