@@ -1,0 +1,158 @@
+"""Cooperative task executor: fixed thread pool + multilevel feedback
+queue with time quanta.
+
+Reference analog: ``execution/executor/TaskExecutor.java:75`` (fixed
+runner threads, 1s quanta), ``MultilevelSplitQueue.java:41`` (priority
+levels by cumulative CPU: 0/1/10/60/300s, 2x level weighting) and
+``PrioritizedSplitRunner.java`` (yieldable split work).  Work items
+here are page-granularity generators: a runner thread drives one item
+for up to a quantum, then re-enqueues it at the level its cumulative
+runtime has earned — long-running queries sink to lower-priority
+levels so short interactive work stays responsive, exactly the
+reference's fairness mechanism.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, Iterator, List, Optional
+
+# cumulative-seconds thresholds of the levels (TaskExecutor's 0/1/10/60/300)
+LEVEL_THRESHOLDS = (0.0, 1.0, 10.0, 60.0, 300.0)
+# each level gets half the scheduling weight of the one above
+LEVEL_WEIGHT = 2.0
+
+
+def _level_of(cpu_seconds: float) -> int:
+    lvl = 0
+    for i, t in enumerate(LEVEL_THRESHOLDS):
+        if cpu_seconds >= t:
+            lvl = i
+    return lvl
+
+
+class TaskHandle:
+    """One submitted task: a generator of work steps + accounting."""
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(self, work: Iterator, on_done: Optional[Callable] = None,
+                 on_error: Optional[Callable] = None):
+        self.work = work
+        self.on_done = on_done
+        self.on_error = on_error
+        self.cpu = 0.0
+        self.steps = 0
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.canceled = False
+        with TaskHandle._seq_lock:
+            TaskHandle._seq += 1
+            self.seq = TaskHandle._seq
+
+    @property
+    def level(self) -> int:
+        return _level_of(self.cpu)
+
+    def cancel(self) -> None:
+        self.canceled = True
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+
+class TaskExecutor:
+    """Fixed pool of runner threads over a multilevel feedback queue.
+
+    ``submit`` takes a zero-arg-step generator; each ``next()`` is one
+    cooperative step (process one page).  A runner drives a task until
+    its quantum expires, accumulates its cpu time, and re-enqueues it
+    at the earned level; lower levels are picked with exponentially
+    decayed frequency (MultilevelSplitQueue's 2x weighting via a
+    virtual-time priority)."""
+
+    def __init__(self, num_threads: int = 4, quantum: float = 0.1):
+        self.quantum = quantum
+        self._heap: List = []  # (virtual_priority, seq, handle)
+        self._lock = threading.Lock()
+        self._available = threading.Condition(self._lock)
+        self._shutdown = False
+        self.completed_tasks = 0
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True, name=f"task-runner-{i}")
+            for i in range(num_threads)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, work: Iterator, on_done: Optional[Callable] = None,
+               on_error: Optional[Callable] = None) -> TaskHandle:
+        h = TaskHandle(work, on_done, on_error)
+        self._enqueue(h)
+        return h
+
+    def _priority(self, h: TaskHandle) -> float:
+        # virtual time: cpu scaled up by the level weight — deeper
+        # levels accumulate priority faster, so they run less often
+        return h.cpu * (LEVEL_WEIGHT ** h.level)
+
+    def _enqueue(self, h: TaskHandle) -> None:
+        with self._available:
+            heapq.heappush(self._heap, (self._priority(h), h.seq, h))
+            self._available.notify()
+
+    # -- runner loop --------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._available:
+                while not self._heap and not self._shutdown:
+                    self._available.wait()
+                if self._shutdown and not self._heap:
+                    return
+                _, _, h = heapq.heappop(self._heap)
+            self._process(h)
+
+    def _process(self, h: TaskHandle) -> None:
+        if h.canceled:
+            self._finish(h, None)
+            return
+        start = time.monotonic()
+        try:
+            while True:
+                next(h.work)
+                h.steps += 1
+                elapsed = time.monotonic() - start
+                if elapsed >= self.quantum or h.canceled:
+                    h.cpu += elapsed
+                    self._enqueue(h)
+                    return
+        except StopIteration:
+            h.cpu += time.monotonic() - start
+            self._finish(h, None)
+        except BaseException as e:
+            h.cpu += time.monotonic() - start
+            self._finish(h, e)
+
+    def _finish(self, h: TaskHandle, error: Optional[BaseException]) -> None:
+        h.error = error
+        self.completed_tasks += 1
+        h.done.set()
+        cb = h.on_error if error is not None else h.on_done
+        if cb is not None:
+            try:
+                cb(h) if error is None else cb(h, error)
+            except Exception:
+                pass
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self, wait: bool = True) -> None:
+        with self._available:
+            self._shutdown = True
+            self._available.notify_all()
+        if wait:
+            for t in self._threads:
+                t.join()

@@ -67,9 +67,14 @@ class WorkerServer:
 
     def __init__(self, catalog: Catalog, host: str = "127.0.0.1", port: int = 0,
                  buffer_bytes: int = 64 << 20, task_ttl: float = 300.0,
-                 memory_pool=None):
+                 memory_pool=None, task_threads: int = 4):
+        from presto_tpu.executor import TaskExecutor
+
         self.catalog = catalog
         self.runner = LocalRunner(catalog, memory_pool=memory_pool)
+        # cooperative scheduler: page-granularity quanta over a
+        # multilevel feedback queue (execution/executor/TaskExecutor.java)
+        self.executor = TaskExecutor(num_threads=task_threads)
         self.tasks_executed = 0
         self.buffer_bytes = buffer_bytes
         # abandoned-task expiry: a consumer that dies mid-pull must not
@@ -218,17 +223,32 @@ class WorkerServer:
             task = _Task(task_id, self.buffer_bytes)
             self._tasks[task_id] = task
 
-        def run():
-            mem_ctx = None
-            try:
-                if self.runner.memory_pool is not None:
-                    from presto_tpu.memory import QueryMemoryContext
+        mem_ctx = None
+        if self.runner.memory_pool is not None:
+            from presto_tpu.memory import QueryMemoryContext
 
-                    mem_ctx = QueryMemoryContext(self.runner.memory_pool, task_id)
-                    self.runner._mem = mem_ctx  # thread-local
+            mem_ctx = QueryMemoryContext(self.runner.memory_pool, task_id)
+
+        def steps():
+            """One yield per produced page: the cooperative quantum
+            boundary (PrioritizedSplitRunner.process analog).  Runner
+            threads can change between quanta, so the thread-local
+            memory context re-binds around every step."""
+            try:
                 fragment = plan_from_json(fragment_json, self.catalog)
-                for p in self.runner._pages(fragment):
+                gen = self.runner._pages(fragment)
+                while True:
+                    if mem_ctx is not None:
+                        self.runner._mem = mem_ctx
+                    try:
+                        p = next(gen)
+                    except StopIteration:
+                        break
+                    finally:
+                        if mem_ctx is not None:
+                            self.runner._mem = None
                     task.buffer.enqueue(serialize_page(p))
+                    yield
                 task.state = FINISHED
                 task.buffer.set_complete()
                 self.tasks_executed += 1
@@ -241,9 +261,8 @@ class WorkerServer:
             finally:
                 if mem_ctx is not None:
                     mem_ctx.release_all()
-                    self.runner._mem = None
 
-        threading.Thread(target=run, daemon=True).start()
+        self.executor.submit(steps())
         return task
 
     def _abort_task(self, task_id: str) -> None:
@@ -272,6 +291,7 @@ class WorkerServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.executor.shutdown(wait=False)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown: refuse visibility as ACTIVE, wait for

@@ -1,0 +1,139 @@
+"""Cooperative task executor: quanta, multilevel feedback, fairness.
+
+Reference analogs: execution/executor/TaskExecutor.java:75,
+MultilevelSplitQueue.java:41, PrioritizedSplitRunner.java.
+"""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.executor import LEVEL_THRESHOLDS, TaskExecutor, _level_of
+
+
+def test_levels_by_cumulative_cpu():
+    assert _level_of(0.0) == 0
+    assert _level_of(0.5) == 0
+    assert _level_of(1.5) == 1
+    assert _level_of(30.0) == 2
+    assert _level_of(100.0) == 3
+    assert _level_of(1000.0) == len(LEVEL_THRESHOLDS) - 1
+
+
+def test_tasks_complete_and_callbacks_fire():
+    ex = TaskExecutor(num_threads=2, quantum=0.01)
+    done = []
+
+    def work(n):
+        for _ in range(n):
+            yield
+
+    handles = [ex.submit(work(5), on_done=lambda h: done.append(h.seq))
+               for _ in range(8)]
+    for h in handles:
+        assert h.wait(10)
+    assert len(done) == 8
+    assert all(h.steps == 5 for h in handles)
+    ex.shutdown()
+
+
+def test_error_propagates_to_handle():
+    ex = TaskExecutor(num_threads=1, quantum=0.01)
+    errs = []
+
+    def bad():
+        yield
+        raise RuntimeError("boom")
+
+    h = ex.submit(bad(), on_error=lambda hh, e: errs.append(str(e)))
+    assert h.wait(10)
+    assert isinstance(h.error, RuntimeError) and errs == ["boom"]
+    ex.shutdown()
+
+
+def test_long_task_sinks_and_short_tasks_stay_responsive():
+    """A cpu-hog re-enqueues at a deeper level; short tasks submitted
+    later still finish long before the hog (the MLFQ fairness goal)."""
+    ex = TaskExecutor(num_threads=1, quantum=0.005)
+    order = []
+
+    def hog():
+        end = time.monotonic() + 1.0
+        while time.monotonic() < end:
+            time.sleep(0.001)
+            yield
+        order.append("hog")
+
+    def quick(i):
+        time.sleep(0.001)
+        yield
+        order.append(f"q{i}")
+
+    hh = ex.submit(hog())
+    time.sleep(0.05)  # the hog has accumulated cpu by now
+    quicks = [ex.submit(quick(i)) for i in range(3)]
+    for q in quicks:
+        assert q.wait(10)
+    assert not hh.done.is_set()  # quick tasks beat the hog
+    assert hh.wait(15)
+    assert order[-1] == "hog"
+    assert hh.level >= 0 and hh.cpu > 0.5
+    ex.shutdown()
+
+
+def test_cancel_stops_requeue():
+    ex = TaskExecutor(num_threads=1, quantum=0.005)
+
+    def endless():
+        while True:
+            time.sleep(0.001)
+            yield
+
+    h = ex.submit(endless())
+    time.sleep(0.05)
+    h.cancel()
+    assert h.wait(10)
+    ex.shutdown()
+
+
+def test_worker_still_serves_through_executor():
+    import numpy as np
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.page import Page
+    from presto_tpu.server.serde import plan_to_json
+    from presto_tpu.server.worker import WorkerServer, parse_task_response
+    from presto_tpu.types import BIGINT
+
+    mem = MemoryConnector()
+    mem.create_table(
+        "t", [("x", BIGINT)],
+        [Page.from_arrays([np.arange(4, dtype=np.int64)], [BIGINT])])
+    cat = Catalog()
+    cat.register("mem", mem)
+    w = WorkerServer(cat)
+    w.start()
+    try:
+        import json
+        import urllib.request
+
+        from presto_tpu.catalog import TableHandle
+        from presto_tpu.planner.plan import TableScanNode
+
+        handle = cat.resolve("t")
+        frag = TableScanNode(handle, [0])
+        req = urllib.request.Request(
+            w.uri + "/v1/task",
+            data=json.dumps({"fragment": plan_to_json(frag)}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            blobs = parse_task_response(resp.read())
+        from presto_tpu.server.serde import deserialize_page
+
+        rows = [r for b in blobs for r in deserialize_page(b).to_pylist()]
+        assert sorted(rows) == [(0,), (1,), (2,), (3,)]
+        assert w.executor.completed_tasks >= 0
+    finally:
+        w.stop()
