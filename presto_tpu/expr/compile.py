@@ -65,6 +65,8 @@ _CONTAINER_FNS = frozenset({
     "contains", "array_position", "array_min", "array_max", "array_sum",
     "array_average", "array_sort", "array_distinct", "map_keys",
     "map_values", "map", "map_construct",
+    "array_transform", "array_filter", "any_match", "all_match",
+    "none_match",
 })
 
 
@@ -976,7 +978,88 @@ class ExprCompiler:
                 return kern(d, t0, out_t), v
 
             return run_mk
+        if fn in ("array_transform", "array_filter", "any_match",
+                  "all_match", "none_match"):
+            return self._compile_array_lambda(expr, arg0, t0)
         raise KeyError(fn)
+
+    def _compile_array_lambda(self, expr: Call, arr_f, t0: Type) -> CompiledExpr:
+        """Lambda functions over arrays (LambdaBytecodeGenerator +
+        ArrayTransformFunction/ArrayFilterFunction analogs): the body
+        evaluates ONCE over the flattened element lanes — rows repeat M
+        times so outer-column references broadcast, and the lambda
+        variable becomes an appended virtual channel.  Shapes stay
+        static; XLA fuses the whole thing."""
+        from presto_tpu.expr.ir import LambdaVar
+        from presto_tpu.ops import container as ct
+        from presto_tpu.page import Block as _Block, Page as _Page
+
+        fn = expr.fn
+        body = expr.args[1]
+        out_t = expr.type
+        M = t0.max_elems
+        elem_t = t0.element
+
+        def substitute(e, var_index):
+            if isinstance(e, LambdaVar):
+                from presto_tpu.expr.ir import ColumnRef as _Ref
+
+                return _Ref(type=e.type, index=var_index, name="λ")
+            if isinstance(e, Call):
+                return Call(type=e.type, fn=e.fn,
+                            args=tuple(substitute(a, var_index) for a in e.args))
+            return e
+
+        def run(page):
+            d, v = arr_f(page)
+            slots = ct.elem_slots(d, t0)
+            live = ct.slot_mask(d, M)
+            elem_ok = live & ~ct.elem_null_mask(slots)
+            cap = page.capacity
+            flat = slots.reshape(cap * M).astype(elem_t.np_dtype)
+            rep_blocks = tuple(
+                _Block(jnp.repeat(b.data, M, axis=0), jnp.repeat(b.valid, M),
+                       b.type, b.dictionary)
+                for b in page.blocks
+            )
+            lam = _Block(flat, elem_ok.reshape(cap * M), elem_t)
+            epage = _Page(rep_blocks + (lam,), jnp.repeat(page.row_mask, M))
+            body2 = substitute(body, len(page.blocks))
+            bd, bv = ExprCompiler.for_page(epage).compile(body2)(epage)
+            bd2 = bd.reshape(cap, M)
+            bv2 = bv.reshape(cap, M)
+            n_live = ct.lengths(d)
+
+            if fn == "array_transform":
+                storage = out_t.np_dtype
+                sent = ct._null_const(storage)
+                vals = jnp.where(live & bv2, bd2.astype(storage), sent)
+                out = jnp.concatenate(
+                    [n_live[:, None].astype(storage), vals], axis=1)
+                return out, v
+            if fn == "array_filter":
+                keep = live & bv2 & bd2.astype(jnp.bool_)
+                order = jnp.argsort(~keep, axis=1, stable=True)
+                comp = jnp.take_along_axis(slots, order, axis=1)
+                nkeep = jnp.sum(keep.astype(jnp.int64), axis=1)
+                j = jnp.arange(M)[None, :]
+                storage = t0.np_dtype
+                sent = ct._null_const(storage)
+                out_vals = jnp.where(j < nkeep[:, None], comp, sent)
+                out = jnp.concatenate(
+                    [nkeep[:, None].astype(storage), out_vals], axis=1)
+                return out, v
+            hit = live & bv2 & bd2.astype(jnp.bool_)
+            if fn == "any_match":
+                return jnp.any(hit, axis=1), v
+            if fn == "none_match":
+                return ~jnp.any(hit, axis=1), v
+            # all_match: vacuously true on empty arrays; a null lambda
+            # result counts false (deviation from 3-valued logic)
+            ok = jnp.where(live, hit, True)
+            return jnp.all(ok, axis=1), v
+
+        return run
 
     def _compile_math(self, expr: Call) -> CompiledExpr:
         fn = expr.fn

@@ -63,6 +63,18 @@ class Call(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class LambdaVar(Expr):
+    """The bound variable of an array-lambda body
+    (VariableReferenceExpression inside LambdaDefinitionExpression) —
+    only meaningful inside array_transform/array_filter/..._match
+    second arguments, where the compiler binds it to the flattened
+    element lanes."""
+
+    def __repr__(self):
+        return f"λx:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
 class AggCall:
     """One aggregate in an aggregation node: fn over an argument
     expression, with optional DISTINCT and output type.
@@ -258,6 +270,14 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         from presto_tpu.types import ArrayType
 
         return ArrayType(ts[0].element, ts[0].max_elems)
+    if fn == "array_transform":
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(ts[1], ts[0].max_elems)  # args = (arr, body)
+    if fn == "array_filter":
+        return ts[0]
+    if fn in ("any_match", "all_match", "none_match"):
+        return BOOLEAN
     if fn in ("map", "map_construct"):
         from presto_tpu.types import MapType
 

@@ -128,6 +128,15 @@ class Subscript(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(Node):
+    """param -> body (sql/tree/LambdaExpression.java; single-parameter
+    subset — the array function surface)."""
+
+    param: str = ""
+    body: Node = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Case(Node):
     whens: Tuple[Tuple[Node, Node], ...]  # (condition, result)
     else_: Optional[Node]

@@ -1697,7 +1697,15 @@ class Binder:
             field = {"dow": "day_of_week", "doy": "day_of_year"}.get(e.field, e.field)
             return call(field, self._bind_impl(e.value, scope, agg))
 
+        if isinstance(e, ast.Lambda):
+            raise BindError("lambda only valid as an argument of "
+                            "transform/filter/any_match/all_match/none_match")
+
         if isinstance(e, ast.FuncCall):
+            if e.name in ("transform", "filter", "any_match", "all_match",
+                          "none_match") and len(e.args) == 2 \
+                    and isinstance(e.args[1], ast.Lambda):
+                return self._bind_array_lambda(e, scope, agg)
             if e.name == "index":
                 # teradata index(s, sub) = strpos (DateTimeFunctions.java
                 # analog in presto-teradata-functions)
@@ -1772,6 +1780,58 @@ class Binder:
             return call("substr", *args)
 
         raise BindError(f"cannot bind {e!r}")
+
+    def _bind_array_lambda(self, e: ast.FuncCall, scope: Scope, agg) -> Expr:
+        """transform/filter/..._match(arr, x -> body): the lambda body
+        binds in a scope where the parameter resolves to a LambdaVar of
+        the array's element type (LambdaBytecodeGenerator's captured
+        scope, realized as an extra virtual channel)."""
+        from presto_tpu.expr.ir import LambdaVar
+
+        arr = self._bind_impl(e.args[0], scope, agg)
+        if not arr.type.is_array:
+            raise BindError(f"{e.name} expects an ARRAY first argument")
+        lam: ast.Lambda = e.args[1]
+        var = LambdaVar(type=arr.type.element)
+        body = self._bind_lambda_body(lam.body, lam.param, var, scope, agg)
+        fn = {"transform": "array_transform", "filter": "array_filter"}.get(
+            e.name, e.name)
+        if fn == "array_filter" or fn.endswith("_match"):
+            if body.type.name != "boolean":
+                raise BindError(f"{e.name} lambda must return boolean")
+        return call(fn, arr, body)
+
+    def _bind_lambda_body(self, body: ast.Node, param: str, var,
+                          scope: Scope, agg) -> Expr:
+        """Bind with ``param`` shadowing outer columns: the parameter
+        resolves to a marker channel, rewritten to the LambdaVar."""
+        marker = 1 << 27
+        outer = scope
+
+        class _MarkScope(Scope):
+            def __init__(self):
+                self.cols = outer.cols
+                self.parent = outer.parent
+
+            def resolve(self, qualifier, name):
+                if qualifier is None and name == param:
+                    return marker
+                return outer.resolve(qualifier, name)
+
+            def col(self, idx):
+                if idx == marker:
+                    return ScopeCol(None, param, Channel(param, var.type))
+                return outer.col(idx)
+
+        def rewrite(ir):
+            if isinstance(ir, ColumnRef) and ir.index == marker:
+                return var
+            if isinstance(ir, Call):
+                return Call(type=ir.type, fn=ir.fn,
+                            args=tuple(rewrite(a) for a in ir.args))
+            return ir
+
+        return rewrite(self._bind_impl(body, _MarkScope(), agg))
 
     def _bind_number(self, text: str) -> Literal:
         if "e" in text.lower():

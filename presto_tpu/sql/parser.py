@@ -22,7 +22,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><>|!=|<=|>=|\|\||[,().;+\-*/%<>=\[\]])
+  | (?P<op><>|!=|<=|>=|\|\||->|[,().;+\-*/%<>=\[\]])
     """,
     re.VERBOSE,
 )
@@ -377,6 +377,12 @@ class Parser:
 
     # -- expressions (precedence ladder) ------------------------------------
     def _expr(self) -> ast.Node:
+        # lambda: ident -> body (valid only as a function argument;
+        # the binder rejects stray lambdas)
+        if self.tok.kind == "ident" and self.peek2("->"):
+            param = self.ident()
+            self.i += 1  # '->'
+            return ast.Lambda(param, self._expr())
         return self._or()
 
     def _or(self) -> ast.Node:

@@ -185,6 +185,50 @@ def test_array_agg_roundtrip_unnest(runner):
 
 
 # ---------------------------------------------------------------------------
+# lambdas (LambdaBytecodeGenerator + ArrayTransform/Filter analogs)
+# ---------------------------------------------------------------------------
+
+def test_transform_lambda(runner):
+    assert q(runner, "SELECT transform(ARRAY[1,2,3], x -> x * 2)") == [([2, 4, 6],)]
+    # type-changing body
+    assert q(runner, "SELECT transform(ARRAY[1,2], x -> x * 0.5)") == [([0.5, 1.0],)]
+
+
+def test_transform_captures_outer_column(runner):
+    rows = q(runner, "SELECT id, transform(arr, x -> x + id) FROM t "
+                     "WHERE id <= 2 ORDER BY id")
+    assert rows == [(1, [2, 3]), (2, [5])]
+
+
+def test_filter_lambda(runner):
+    assert q(runner, "SELECT filter(ARRAY[1,2,3,4], x -> x % 2 = 0)") == [([2, 4],)]
+    rows = q(runner, "SELECT id, filter(arr, x -> x > 1) FROM t ORDER BY id")
+    assert rows == [(1, [2]), (2, [3]), (3, []), (4, [4, 5])]
+
+
+def test_match_lambdas(runner):
+    assert q(runner, "SELECT any_match(ARRAY[1,2], x -> x > 1)") == [(True,)]
+    assert q(runner, "SELECT all_match(ARRAY[2,4], x -> x % 2 = 0)") == [(True,)]
+    assert q(runner, "SELECT none_match(ARRAY[1,3], x -> x > 5)") == [(True,)]
+    # empty arrays: any=false, all vacuously true
+    assert q(runner, "SELECT any_match(arr, x -> x > 0), "
+                     "all_match(arr, x -> x > 0) FROM t WHERE id = 3") == [
+        (False, True)]
+
+
+def test_lambda_in_where(runner):
+    assert q(runner, "SELECT id FROM t WHERE any_match(arr, x -> x >= 4) "
+                     "ORDER BY id") == [(4,)]
+
+
+def test_stray_lambda_rejected(runner):
+    from presto_tpu.sql.binder import BindError
+
+    with pytest.raises(BindError):
+        runner.execute("SELECT x -> x + 1")
+
+
+# ---------------------------------------------------------------------------
 # type plumbing
 # ---------------------------------------------------------------------------
 
