@@ -62,6 +62,14 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     import presto_tpu  # noqa: F401  (enables x64)
     import jax
 
+    # persistent compilation cache: TPU warmups through the tunnel cost
+    # minutes per program (q3 measured 551s cold); cached executables
+    # replay across bench children and rounds
+    cache_dir = os.path.join(HERE, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
     platform = jax.devices()[0].platform
     log(f"devices: {jax.devices()}")
 

@@ -105,6 +105,10 @@ def state_types(agg: AggCall) -> List[Type]:
         from presto_tpu.types import ArrayType
 
         return [ArrayType(t, ARRAY_AGG_CAP), BIGINT]
+    if agg.fn == "map_agg":
+        from presto_tpu.types import MapType
+
+        return [MapType(t, agg.arg2.type, ARRAY_AGG_CAP), BIGINT]
     if agg.fn == "learn_regressor":
         # normal-equation sufficient statistics: flattened upper
         # triangle-free full XtX (dim*dim) + Xty (dim), dim = k+1 bias
@@ -128,6 +132,10 @@ def output_type(agg: AggCall) -> Type:
         from presto_tpu.types import ArrayType
 
         return ArrayType(agg.arg.type, ARRAY_AGG_CAP)
+    if agg.fn == "map_agg":
+        from presto_tpu.types import MapType
+
+        return MapType(agg.arg.type, agg.arg2.type, ARRAY_AGG_CAP)
     if agg.fn == "learn_regressor":
         from presto_tpu.types import ArrayType
 
@@ -391,6 +399,30 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             arr = flat.reshape(n, cap_e)
             length = jnp.minimum(rcnt, cap_e).astype(storage)
             out.append([jnp.concatenate([length[:, None], arr], axis=1), rcnt])
+        elif agg.fn == "map_agg":
+            # two scatters, same (group, rank) geometry: keys then
+            # values (MapAggregationFunction analog); NULL-key rows drop
+            mt = state_types(agg)[0]
+            cap_e = mt.max_elems
+            storage = mt.np_dtype
+            sent = _container_sent(storage)
+            v_data, v_valid = c.compile(agg.arg2)(page)
+            sel = rowsel & valid  # keys must be non-null
+            gid_sel = jnp.where(sel, gid, n)
+            rcnt = _gsum(ctx, sel.astype(jnp.int64), gid_sel, n)
+            rank = _within_group_rank(gid_sel)
+            ok = sel & (rank < cap_e) & (gid_sel < n)
+            tgt = jnp.where(ok, gid_sel.astype(jnp.int64) * cap_e + rank, n * cap_e)
+            kflat = jnp.full((n * cap_e,), sent, dtype=storage)
+            kflat = kflat.at[tgt].set(data.astype(storage), mode="drop")
+            vflat = jnp.full((n * cap_e,), sent, dtype=storage)
+            vflat = vflat.at[tgt].set(
+                jnp.where(v_valid, v_data.astype(storage), sent), mode="drop")
+            length = jnp.minimum(rcnt, cap_e).astype(storage)
+            state = jnp.concatenate(
+                [length[:, None], kflat.reshape(n, cap_e),
+                 vflat.reshape(n, cap_e)], axis=1)
+            out.append([state, rcnt])
         else:
             raise KeyError(agg.fn)
     return out
@@ -501,9 +533,10 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 _gsum(ctx, zero_dead, gid, n),
                 _gsum(ctx, cnt, gid, n),
             ])
-        elif agg.fn == "array_agg":
-            # concatenate partial arrays per group: each partial row's
-            # elements land at the group's running offset (stable order)
+        elif agg.fn in ("array_agg", "map_agg"):
+            # concatenate partial containers per group: each partial
+            # row's elements land at the group's running offset (stable
+            # order); maps scatter both key and value halves
             arr_col, cnt_col = cols
             at = state_types(agg)[0]
             cap_e = at.max_elems
@@ -527,14 +560,18 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 ok, gid.astype(jnp.int64)[:, None] * cap_e + off[:, None] + j,
                 n * cap_e,
             )
-            flat = jnp.full((n * cap_e,), sent, dtype=storage)
-            flat = flat.at[tgt.reshape(-1)].set(
-                arr_col[:, 1:].reshape(-1), mode="drop")
-            arr = flat.reshape(n, cap_e)
             total = _gsum(ctx, lens, gid, n)
             length = jnp.minimum(total, cap_e).astype(storage)
+            halves = []
+            nhalves = 2 if agg.fn == "map_agg" else 1
+            for h in range(nhalves):
+                flat = jnp.full((n * cap_e,), sent, dtype=storage)
+                flat = flat.at[tgt.reshape(-1)].set(
+                    arr_col[:, 1 + h * cap_e : 1 + (h + 1) * cap_e].reshape(-1),
+                    mode="drop")
+                halves.append(flat.reshape(n, cap_e))
             out.append([
-                jnp.concatenate([length[:, None], arr], axis=1),
+                jnp.concatenate([length[:, None]] + halves, axis=1),
                 _gsum(ctx, cnt_col, gid, n),
             ])
         else:
@@ -668,7 +705,7 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
                 prior, mean.reshape(n, C * k), var.reshape(n, C * k),
             ], axis=1)
             blocks.append(Block(model.astype(t.np_dtype), cnt > 0, t))
-        elif agg.fn == "array_agg":
+        elif agg.fn in ("array_agg", "map_agg"):
             arr_state, cnt = cols
             blocks.append(Block(arr_state.astype(t.np_dtype), cnt > 0, t, adict))
         elif agg.fn == "hll_merge":

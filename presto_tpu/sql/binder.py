@@ -63,7 +63,7 @@ AGG_FUNCTIONS = {
     # two-level aggregation (see _rewrite_approx_distinct)
     "approx_distinct",
     "min_by", "max_by", "approx_percentile",
-    "array_agg",
+    "array_agg", "map_agg",
     # presto-ml analogs: sufficient-statistic training aggregates
     "learn_regressor", "learn_classifier",
 }
@@ -2038,7 +2038,7 @@ class Binder:
             a = AggCall(fn="count_star", arg=None, type=BIGINT)
             return agg.agg_ref(a)
         fn, distinct = e.name, e.distinct
-        if fn in ("min_by", "max_by", "approx_percentile",
+        if fn in ("min_by", "max_by", "approx_percentile", "map_agg",
                   "learn_regressor", "learn_classifier"):
             if len(e.args) != 2:
                 raise BindError(f"aggregate {fn} takes two arguments")

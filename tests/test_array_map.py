@@ -177,6 +177,14 @@ def test_array_agg_global(runner):
     assert q(runner, "SELECT array_agg(id) FROM t") == [([1, 2, 3, 4],)]
 
 
+def test_map_agg(runner):
+    rows = q(runner, "SELECT g, map_agg(id, id * 10) FROM t GROUP BY g ORDER BY g")
+    assert rows == [(1, {1: 10, 2: 20}), (2, {3: 30, 4: 40})]
+    assert q(runner, "SELECT cardinality(map_agg(id, g)) FROM t") == [(4,)]
+    # subscript over an aggregated map
+    assert q(runner, "SELECT map_agg(id, g)[3] FROM t") == [(2,)]
+
+
 def test_array_agg_roundtrip_unnest(runner):
     # array_agg then unnest recovers the rows
     rows = q(runner, "SELECT e FROM (SELECT array_agg(id) AS a FROM t) "
