@@ -21,6 +21,33 @@ from presto_tpu.sql.parser import parse_statement
 from presto_tpu.types import BIGINT, VARCHAR, Type
 
 
+def _substitute_params(node, params):
+    """Replace ? Parameter nodes with the EXECUTE ... USING expressions
+    (sql/tree/Parameter.java rewriting in the reference's
+    ParameterRewriter)."""
+    import dataclasses as _dc
+
+    if isinstance(node, ast.Parameter):
+        if node.index >= len(params):
+            raise ValueError(
+                f"parameter ?{node.index + 1} has no USING value")
+        return params[node.index]
+    if not isinstance(node, ast.Node):
+        return node
+    changes = {}
+    for f in _dc.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, tuple):
+            nv = tuple(_substitute_params(x, params) for x in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                changes[f.name] = nv
+        elif isinstance(v, ast.Node):
+            nv = _substitute_params(v, params)
+            if nv is not v:
+                changes[f.name] = nv
+    return _dc.replace(node, **changes) if changes else node
+
+
 class QueryRunner:
     def __init__(self, catalog: Catalog, session: Optional[Session] = None, jit: bool = True,
                  memory_pool=None, access_control=None):
@@ -39,6 +66,9 @@ class QueryRunner:
 
         self.transactions = TransactionManager()
         self._open_tx = None
+        # PREPARE name FROM <query> registry (StatementResource's
+        # prepared-statement session map analog)
+        self._prepared = {}
         self.executor = self._make_executor()
         # plan cache: repeated executions of the same SQL reuse the same
         # plan-node identities, so the executor's compiled-chain caches
@@ -171,6 +201,48 @@ class QueryRunner:
             conn.drop_table(handle.table)
             self._invalidate_plans()
             return MaterializedResult(["result"], [VARCHAR], [("DROP TABLE",)])
+
+        if isinstance(stmt, ast.Prepare):
+            self._prepared[stmt.name] = stmt.query
+            return MaterializedResult(["result"], [VARCHAR], [("PREPARE",)])
+
+        if isinstance(stmt, ast.Execute):
+            q = self._prepared.get(stmt.name)
+            if q is None:
+                raise ValueError(f"prepared statement not found: {stmt.name}")
+            bound = _substitute_params(q, list(stmt.params))
+            # parameters make each execution a distinct plan; don't
+            # pollute the text-keyed plan cache
+            plan = self.binder.plan_ast(bound)
+            self._check_access(plan)
+            return self.executor.run(plan, query_id=query_id)
+
+        if isinstance(stmt, ast.Deallocate):
+            if self._prepared.pop(stmt.name, None) is None:
+                raise ValueError(f"prepared statement not found: {stmt.name}")
+            return MaterializedResult(["result"], [VARCHAR], [("DEALLOCATE",)])
+
+        if isinstance(stmt, ast.ShowCatalogs):
+            names = sorted(self.catalog._connectors)
+            return MaterializedResult(["catalog"], [VARCHAR], [(n,) for n in names])
+
+        if isinstance(stmt, ast.ShowFunctions):
+            from presto_tpu.sql.binder import AGG_FUNCTIONS, SCALAR_FUNCTIONS
+
+            window = ["rank", "dense_rank", "row_number", "ntile",
+                      "percent_rank", "cume_dist", "lead", "lag",
+                      "first_value", "last_value", "nth_value"]
+            rows = sorted(
+                [(f, "scalar") for f in SCALAR_FUNCTIONS]
+                + [(f, "aggregate") for f in AGG_FUNCTIONS]
+                + [(f, "window") for f in window]
+            )
+            return MaterializedResult(["function", "kind"], [VARCHAR, VARCHAR], rows)
+
+        if isinstance(stmt, ast.Describe):
+            handle = self.catalog.resolve(stmt.table)
+            rows = [(c.name, repr(c.type)) for c in handle.columns]
+            return MaterializedResult(["column", "type"], [VARCHAR, VARCHAR], rows)
 
         if isinstance(stmt, ast.ShowTables):
             names = sorted(

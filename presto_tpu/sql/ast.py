@@ -128,6 +128,53 @@ class Subscript(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class RowCtor(Node):
+    """(e1, e2, ...) row constructor (sql/tree/Row.java) — desugars to
+    pairwise comparisons in =/<>/IN contexts."""
+
+    items: Tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    name: str = ""
+    query: Node = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Execute(Node):
+    name: str = ""
+    params: Tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter(Node):
+    """A ? placeholder (sql/tree/Parameter.java)."""
+
+    index: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCatalogs(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowFunctions(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Describe(Node):
+    table: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class Lambda(Node):
     """param -> body (sql/tree/LambdaExpression.java; single-parameter
     subset — the array function surface)."""

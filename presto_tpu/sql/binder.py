@@ -1616,9 +1616,18 @@ class Binder:
         if isinstance(e, ast.NullLit):
             return Literal(type=BIGINT, value=None)
 
+        if isinstance(e, ast.Parameter):
+            raise BindError(
+                f"unbound parameter ?{e.index + 1} — run via EXECUTE ... USING")
+
         if isinstance(e, ast.Binary):
             if e.op in ("and", "or"):
                 return call(e.op, self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
+            if e.op in ("=", "<>") and (
+                isinstance(e.left, ast.RowCtor) or isinstance(e.right, ast.RowCtor)
+            ):
+                return self._bind_impl(
+                    _row_comparison(e.left, e.right, e.op), scope, agg)
             if e.op in ("=", "<>", "<", "<=", ">", ">="):
                 opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
                 return call(opmap[e.op], self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
@@ -1645,6 +1654,18 @@ class Binder:
             return call("not", out) if e.negated else out
 
         if isinstance(e, ast.InList):
+            if isinstance(e.value, ast.RowCtor):
+                # (a, b) IN ((1, 2), (3, 4)) -> OR of pairwise ANDs
+                # (sql/tree/Row.java comparisons)
+                out_ast = None
+                for item in e.items:
+                    conj = _row_comparison(e.value, item, "=")
+                    out_ast = conj if out_ast is None else ast.Binary("or", out_ast, conj)
+                if out_ast is None:
+                    raise BindError("empty IN list")
+                if e.negated:
+                    out_ast = ast.Unary("not", out_ast)
+                return self._bind_impl(out_ast, scope, agg)
             v = self._bind_impl(e.value, scope, agg)
             items = [self._bind_impl(x, scope, agg) for x in e.items]
             out = call("in", v, *items)
@@ -2082,6 +2103,20 @@ class Binder:
             else:
                 order_irs.append(self._bind(e, scope))
         return order_irs
+
+
+def _row_comparison(left: ast.Node, right: ast.Node, op: str) -> ast.Node:
+    """(a, b) = (c, d) -> a = c AND b = d; <> negates the conjunction."""
+    if not (isinstance(left, ast.RowCtor) and isinstance(right, ast.RowCtor)):
+        raise BindError("row comparison needs row constructors on both sides")
+    if len(left.items) != len(right.items):
+        raise BindError(
+            f"row arity mismatch: {len(left.items)} vs {len(right.items)}")
+    conj = None
+    for l, r in zip(left.items, right.items):
+        eq = ast.Binary("=", l, r)
+        conj = eq if conj is None else ast.Binary("and", conj, eq)
+    return ast.Unary("not", conj) if op == "<>" else conj
 
 
 def term_of_ref(terms: List[Term], ref: int) -> int:

@@ -22,7 +22,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><>|!=|<=|>=|\|\||->|[,().;+\-*/%<>=\[\]])
+  | (?P<op><>|!=|<=|>=|\|\||->|[,().;+\-*/%<>=\[\]?])
     """,
     re.VERBOSE,
 )
@@ -77,6 +77,7 @@ class Parser:
     def __init__(self, sql: str):
         self.tokens = tokenize(sql)
         self.i = 0
+        self.n_params = 0  # ? placeholders seen (PREPARE/EXECUTE)
 
     # -- token helpers -----------------------------------------------------
     @property
@@ -591,12 +592,22 @@ class Parser:
             self.expect(")")
             return ast.Exists(q)
 
+        if self.accept("?"):
+            self.n_params += 1
+            return ast.Parameter(self.n_params - 1)
+
         if self.accept("("):
             if self.peek("select"):
                 q = self._query()
                 self.expect(")")
                 return ast.ScalarSubquery(q)
             e = self._expr()
+            if self.peek(","):  # row constructor: (a, b, ...)
+                items = [e]
+                while self.accept(","):
+                    items.append(self._expr())
+                self.expect(")")
+                return ast.RowCtor(tuple(items))
             self.expect(")")
             return e
 
@@ -740,14 +751,41 @@ def parse_statement(sql: str) -> ast.Node:
         return _finish(p, ast.Rollback())
     if p.accept("show"):
         if p.accept("tables"):
-            p.accept(";")
-            return ast.ShowTables()
+            return _finish(p, ast.ShowTables())
         if p.accept("session"):
-            p.accept(";")
-            return ast.ShowSession()
+            return _finish(p, ast.ShowSession())
+        if p.accept_word("catalogs"):
+            return _finish(p, ast.ShowCatalogs())
+        if p.accept_word("functions"):
+            return _finish(p, ast.ShowFunctions())
+        if p.accept_word("schemas"):
+            return _finish(p, ast.ShowCatalogs())  # schema == catalog here
         p.expect("columns")
         p.expect("from")
-        table = p.ident()
-        p.accept(";")
-        return ast.ShowColumns(table)
+        table = _qualified_name(p)
+        return _finish(p, ast.ShowColumns(table))
+    if p.accept_word("describe") or p.accept_word("desc"):
+        return _finish(p, ast.Describe(_qualified_name(p)))
+    if p.accept_word("prepare"):
+        name = p.ident()
+        if p.accept_word("from") is None:
+            p.expect("from")
+        q = parse_statement_body(p)
+        return _finish(p, ast.Prepare(name, q))
+    if p.accept_word("execute"):
+        name = p.ident()
+        params = []
+        if p.accept_word("using"):
+            params.append(p._expr())
+            while p.accept(","):
+                params.append(p._expr())
+        return _finish(p, ast.Execute(name, tuple(params)))
+    if p.accept_word("deallocate"):
+        p.accept_word("prepare")
+        return _finish(p, ast.Deallocate(p.ident()))
     return p.parse_query()
+
+
+def parse_statement_body(p: Parser) -> ast.Node:
+    """The statement after PREPARE name FROM (query subset)."""
+    return p._query()

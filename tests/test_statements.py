@@ -50,6 +50,43 @@ def test_show_tables_and_columns(runner):
     assert cols["l_quantity"].startswith("decimal")
 
 
+def test_row_comparisons(runner):
+    assert runner.execute(
+        "SELECT count(*) FROM nation WHERE (n_regionkey, n_nationkey) "
+        "IN ((1, 1), (2, 8))").rows == [(2,)]
+    assert runner.execute(
+        "SELECT count(*) FROM nation WHERE (n_regionkey, n_nationkey) "
+        "NOT IN ((1, 1))").rows == [(24,)]
+    assert runner.execute(
+        "SELECT count(*) FROM nation WHERE (n_regionkey, 0) <> (1, 0)").rows == [(20,)]
+
+
+def test_prepare_execute_deallocate(runner):
+    runner.execute("PREPARE stq FROM SELECT count(*) FROM nation "
+                   "WHERE n_regionkey = ?")
+    assert runner.execute("EXECUTE stq USING 1").rows == [(5,)]
+    assert runner.execute("EXECUTE stq USING 3").rows == [(5,)]
+    runner.execute("DEALLOCATE PREPARE stq")
+    import pytest
+
+    with pytest.raises(ValueError):
+        runner.execute("EXECUTE stq USING 1")
+    # a bare ? outside EXECUTE is a bind error
+    from presto_tpu.sql.binder import BindError
+
+    with pytest.raises(BindError):
+        runner.execute("SELECT ? + 1")
+
+
+def test_show_catalogs_functions_describe(runner):
+    assert runner.execute("SHOW CATALOGS").rows == [("tpch",)]
+    fns = dict(runner.execute("SHOW FUNCTIONS").rows)
+    assert fns["sum"] == "aggregate" and fns["sqrt"] == "scalar"
+    assert fns["row_number"] == "window"
+    cols = dict(runner.execute("DESCRIBE region").rows)
+    assert cols["r_regionkey"] == "bigint"
+
+
 def test_jit_off_still_correct(runner):
     runner.execute("set session jit = false")
     try:
