@@ -145,11 +145,18 @@ class DistributedRunner:
         mesh: Optional[Mesh] = None,
         axis: str = "d",
         broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
+        session=None,
     ):
         self.catalog = catalog
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = axis
         self.broadcast_threshold = broadcast_threshold
+        # session controls (SystemSessionProperties analogs)
+        self.join_distribution_type = "AUTOMATIC"
+        self.allow_colocated = True
+        if session is not None:
+            self.join_distribution_type = session.get("join_distribution_type")
+            self.allow_colocated = bool(session.get("colocated_join"))
         self.local = LocalRunner(catalog)
         # persistent un-jitted runner for stage building/builds: its
         # _agg_overrides must survive GroupCapacityExceeded retries
@@ -294,8 +301,11 @@ class DistributedRunner:
         rendering and execution always agree)."""
         from presto_tpu.parallel.fragment import decide_join_distribution
 
-        mode, _ = decide_join_distribution(jnode, self.broadcast_threshold,
-                                           catalog=self.catalog)
+        mode, _ = decide_join_distribution(
+            jnode, self.broadcast_threshold, catalog=self.catalog,
+            forced=self.join_distribution_type,
+            allow_colocated=self.allow_colocated,
+        )
         return mode
 
     def _join_cfg_for(self, jnode, cap: int) -> Dict[str, int]:

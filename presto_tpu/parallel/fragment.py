@@ -191,24 +191,31 @@ def colocated_join_scans(jnode, catalog) -> Optional[Tuple[PlanNode, PlanNode]]:
 
 def decide_join_distribution(
     jnode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
-    catalog=None,
+    catalog=None, forced: str = "AUTOMATIC", allow_colocated: bool = True,
 ) -> Tuple[str, Optional[int]]:
     """(mode, estimated build rows): 'colocated' joins bucket-aligned
     scans with no exchange at all; 'broadcast' replicates the build to
     every device; 'partitioned' hash-exchanges both sides on the join
     key (DetermineJoinDistributionType.java:33 —
-    AUTOMATIC chooses by build size).  Build sides that can't wave-scan
-    on the mesh downgrade to broadcast — the decision here is the single
-    source of truth for both EXPLAIN rendering and execution."""
+    AUTOMATIC chooses by build size; the session's
+    join_distribution_type forces BROADCAST/PARTITIONED).  Build sides
+    that can't wave-scan on the mesh downgrade to broadcast — the
+    decision here is the single source of truth for both EXPLAIN
+    rendering and execution."""
     if isinstance(jnode, CrossSingleNode):
         return "broadcast", 1
     est = estimate_rows(jnode.right)
-    if (colocated_join_scans(jnode, catalog) is not None
-            and build_side_chainable(jnode.right)):
+    if forced == "BROADCAST":
+        return "broadcast", est
+    chainable = build_side_chainable(jnode.right)
+    if forced == "PARTITIONED":
+        return ("partitioned" if chainable else "broadcast"), est
+    if (allow_colocated and chainable
+            and colocated_join_scans(jnode, catalog) is not None):
         return "colocated", est
     if est is None or est <= broadcast_threshold:
         return "broadcast", est
-    if not build_side_chainable(jnode.right):
+    if not chainable:
         return "broadcast", est
     return "partitioned", est
 

@@ -78,12 +78,14 @@ class QueryRunner:
 
     def _make_executor(self) -> LocalRunner:
         cap = self.session.get("split_capacity") or None
-        return LocalRunner(
+        ex = LocalRunner(
             self.catalog,
             jit=self._jit_default and self.session.get("jit"),
             split_capacity=cap,
             memory_pool=self.memory_pool,
         )
+        ex.merge_sort = bool(self.session.get("distributed_sort"))
+        return ex
 
     # ------------------------------------------------------------------
     def plan(self, sql: str):
@@ -114,7 +116,7 @@ class QueryRunner:
             try:
                 plan = self._plan_cached(sql, stmt)
                 self._check_access(plan)
-                res = self.executor.run(plan, query_id=qid)
+                res = self._run_plan(plan, qid)
             except Exception as e:
                 self.events.query_completed(QueryCompletedEvent(
                     qid, sql, self.session.user, "FAILED", t0, time.time(),
@@ -145,6 +147,7 @@ class QueryRunner:
             self.session.set(stmt.name, stmt.value)
             # executor knobs may have changed; rebuild (plans survive)
             self.executor = self._make_executor()
+            self._dist = None  # mesh/session knobs re-resolve lazily
             return MaterializedResult(["result"], [VARCHAR], [("SET SESSION",)])
 
         if isinstance(stmt, ast.ShowSession):
@@ -405,6 +408,23 @@ class QueryRunner:
             blocks[i] = Block(new_codes.astype(codes.dtype), b.valid, b.type, dst)
             changed = True
         return Page(tuple(blocks), page.row_mask) if changed else page
+
+    def _run_plan(self, plan, query_id=None):
+        """Route through the device-mesh tier when ``SET SESSION
+        distributed = true`` and the plan shape distributes; otherwise
+        (or on DistributedUnsupported) the local executor."""
+        if self.session.get("distributed"):
+            return self._distributed().run(plan)
+        return self.executor.run(plan, query_id=query_id)
+
+    def _distributed(self):
+        if getattr(self, "_dist", None) is None:
+            from presto_tpu.parallel.dist import DistributedRunner, make_mesh
+
+            n = self.session.get("hash_partition_count") or None
+            self._dist = DistributedRunner(
+                self.catalog, mesh=make_mesh(n), session=self.session)
+        return self._dist
 
     def _plan_cached(self, sql: str, q: ast.Query):
         plan = self._plans.get(sql)

@@ -59,6 +59,21 @@ SYSTEM_PROPERTIES = [
         "admission priority within query_priority resource groups",
         0, int,
     ),
+    PropertyMetadata(
+        "distributed_sort",
+        "multi-producer ORDER BY: per-page sorts + order-preserving merge",
+        True, _bool,
+    ),
+    PropertyMetadata(
+        "colocated_join",
+        "use bucket-aligned exchange-free joins when tables allow",
+        True, _bool,
+    ),
+    PropertyMetadata(
+        "join_distribution_type",
+        "AUTOMATIC | BROADCAST | PARTITIONED (DetermineJoinDistributionType)",
+        "AUTOMATIC", lambda s: s.strip().upper(),
+    ),
 ]
 
 
