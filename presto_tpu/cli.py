@@ -33,12 +33,47 @@ def format_table(names, rows, max_rows: int = 200) -> str:
     return "\n".join(out)
 
 
+def format_output(names, rows, fmt: str) -> str:
+    """ALIGNED (default) | CSV | TSV | JSON — the reference CLI's
+    --output-format set (cli/OutputFormat subset)."""
+    if fmt == "ALIGNED":
+        return format_table(names, rows)
+    if fmt in ("CSV", "TSV"):
+        import csv
+        import io
+
+        buf = io.StringIO()
+        w = csv.writer(buf, delimiter="," if fmt == "CSV" else "\t",
+                       lineterminator="\n")
+        w.writerow(names)
+        for r in rows:
+            w.writerow(["" if v is None else v for v in r])
+        return buf.getvalue().rstrip("\n")
+    if fmt == "JSON":
+        import json
+
+        return "\n".join(
+            json.dumps(dict(zip(names, r)), default=str) for r in rows)
+    raise SystemExit(f"unknown output format {fmt!r}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="presto-tpu")
     ap.add_argument("--server", help="coordinator URI (default: embedded engine)")
     ap.add_argument("--sf", type=float, default=0.01, help="embedded TPC-H scale factor")
     ap.add_argument("-e", "--execute", help="run one statement and exit")
+    ap.add_argument("--output-format", default="ALIGNED",
+                    choices=["ALIGNED", "CSV", "TSV", "JSON"],
+                    help="result rendering (reference --output-format)")
+    ap.add_argument("--platform", default=None,
+                    help="force the jax backend (e.g. cpu) — useful when "
+                         "the accelerator tunnel is unreachable")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     if args.server:
         from presto_tpu.client import StatementClient
@@ -68,8 +103,9 @@ def main(argv=None) -> int:
         except Exception as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
-        print(format_table(names, rows))
-        print(f"({len(rows)} rows, {time.time() - t0:.2f}s)")
+        print(format_output(names, rows, args.output_format))
+        if args.output_format == "ALIGNED":
+            print(f"({len(rows)} rows, {time.time() - t0:.2f}s)")
         return 0
 
     if args.execute:

@@ -126,6 +126,47 @@ class EvaluateConstantFilter(Rule):
         )
 
 
+class RecordScanConstraints(Rule):
+    """Filter directly over a scan: record simple (col cmp literal)
+    conjuncts on the scan for stats-based split pruning — rewrites that
+    move filters below projections re-expose this opportunity after
+    binding (PickTableLayout / TupleDomain pushdown analog)."""
+
+    pattern = Pattern.type_of(FilterNode).with_sources(Pattern.type_of(TableScanNode))
+
+    def apply(self, node: FilterNode) -> Optional[PlanNode]:
+        scan: TableScanNode = node.source
+        names = [scan.handle.columns[i].name for i in scan.columns]
+        flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+        found = []
+
+        def emit(op: str, col: ColumnRef, lit: Literal):
+            if lit.value is not None and not col.type.is_string \
+                    and col.index < len(names):
+                found.append((names[col.index], op, lit.value))
+
+        def walk(e: Expr):
+            if not isinstance(e, Call):
+                return
+            if e.fn == "and":
+                walk(e.args[0])
+                walk(e.args[1])
+                return
+            if e.fn in ("eq", "lt", "le", "gt", "ge") and len(e.args) == 2:
+                a, b = e.args
+                if isinstance(a, ColumnRef) and isinstance(b, Literal):
+                    emit(e.fn, a, b)
+                elif isinstance(b, ColumnRef) and isinstance(a, Literal):
+                    emit(flip[e.fn], b, a)
+
+        walk(node.predicate)
+        new = [c for c in found if c not in scan.constraints]
+        if not new:
+            return None  # fixpoint: nothing to record
+        scan.constraints.extend(new)
+        return node  # same node, enriched scan (counts as progress once)
+
+
 class PushLimitThroughProject(Rule):
     pattern = Pattern.type_of(LimitNode).with_sources(Pattern.type_of(ProjectNode))
 
@@ -157,6 +198,7 @@ DEFAULT_RULES: List[Rule] = [
     MergeAdjacentProjects(),
     RemoveIdentityProjection(),
     EvaluateConstantFilter(),
+    RecordScanConstraints(),
     PushLimitThroughProject(),
     MergeLimits(),
 ]
