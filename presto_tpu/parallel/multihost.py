@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
@@ -43,6 +44,63 @@ from presto_tpu.planner.plan import (
 )
 from presto_tpu.server.serde import deserialize_page, plan_to_json
 from presto_tpu.server.worker import parse_task_response
+
+
+class TaskFailed(Exception):
+    """The remote task hit a deterministic query error (its fragment
+    raised) — distinct from worker/transport failure, so the caller
+    neither retries nor excludes the worker."""
+
+
+class TaskStatusFetcher:
+    """Background task-state poller (ContinuousTaskStatusFetcher
+    analog, server/remotetask/): while the data pull long-polls the
+    results endpoint, this thread watches /v1/task/{id} so a FAILED
+    state surfaces with its error message even between result polls."""
+
+    def __init__(self, uri: str, task_id: str, interval: float = 0.5):
+        self.uri = uri.rstrip("/")
+        self.task_id = task_id
+        self.interval = interval
+        self.failed_error = None
+        self._stop = False
+        self._thread = None
+
+    def start(self) -> None:
+        import threading
+
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def poll_once(self):
+        try:
+            with urllib.request.urlopen(
+                f"{self.uri}/v1/task/{self.task_id}", timeout=5.0
+            ) as r:
+                info = json.load(r)
+            if info.get("state") == "FAILED":
+                return info.get("error") or "task failed"
+        except Exception:
+            pass
+        return None
+
+    def _run(self) -> None:
+        while not self._stop:
+            err = self.poll_once()
+            if err is not None:
+                self.failed_error = err
+                return
+            time.sleep(self.interval)
+
+    def stop(self) -> None:
+        self._stop = True
+
+
+def _error_detail(e) -> str:
+    try:
+        return json.loads(e.read()).get("error", "")
+    except Exception:
+        return ""
 
 
 class MultiHostUnsupported(Exception):
@@ -80,6 +138,11 @@ class WorkerClient:
                 # retried task simply recomputes (at-least-once overall,
                 # de-duplicated by task id server-side)
                 return self._pull_task(fragment_json)
+            except TaskFailed:
+                # a deterministic query error, NOT a worker fault:
+                # retrying recomputes the same failure and blaming the
+                # worker would poison failover
+                raise
             except Exception as e:
                 last = e
                 time.sleep(min(0.1 * (2 ** attempt), 2.0))
@@ -103,18 +166,30 @@ class WorkerClient:
         # (the old one-shot POST failed at its socket timeout; the
         # long-poll loop needs the equivalent wall-clock bound)
         last_progress = time.monotonic()
+        fetcher = TaskStatusFetcher(self.uri, tid)
+        fetcher.start()
         try:
             while True:
+                if fetcher.failed_error is not None:
+                    raise TaskFailed(fetcher.failed_error)
                 if time.monotonic() - last_progress > self.timeout:
                     raise TimeoutError(
                         f"task {tid} made no progress for {self.timeout}s")
-                with urllib.request.urlopen(
-                    f"{self.uri}/v1/task/{tid}/results/{token}",
-                    timeout=self.timeout,
-                ) as resp:
-                    batch = parse_task_response(resp.read())
-                    nxt = int(resp.headers.get("X-Next-Token", token))
-                    complete = resp.headers.get("X-Complete") == "1"
+                try:
+                    with urllib.request.urlopen(
+                        f"{self.uri}/v1/task/{tid}/results/{token}",
+                        timeout=self.timeout,
+                    ) as resp:
+                        batch = parse_task_response(resp.read())
+                        nxt = int(resp.headers.get("X-Next-Token", token))
+                        complete = resp.headers.get("X-Complete") == "1"
+                except urllib.error.HTTPError as e:
+                    # a failed task answers 500 with the error payload:
+                    # surface it as a query failure, not a worker fault
+                    detail = _error_detail(e) or fetcher.poll_once()
+                    if detail:
+                        raise TaskFailed(detail)
+                    raise
                 pages.extend(batch)
                 if nxt > token:
                     token = nxt
@@ -126,6 +201,7 @@ class WorkerClient:
                 if complete:
                     return pages
         finally:
+            fetcher.stop()
             try:
                 req = urllib.request.Request(
                     f"{self.uri}/v1/task/{tid}", method="DELETE")

@@ -105,3 +105,40 @@ def test_task_serde_roundtrip():
     raw = serialize_page(page)
     back = deserialize_page(raw)
     assert int(np.asarray(back.num_rows())) == int(np.asarray(page.num_rows()))
+
+
+def test_task_failure_is_not_worker_failure():
+    """A deterministic query error raises TaskFailed without retries or
+    marking the worker dead (ContinuousTaskStatusFetcher analog)."""
+    import numpy as np
+    import pytest
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.page import Page
+    from presto_tpu.parallel.multihost import TaskFailed, WorkerClient
+    from presto_tpu.planner.plan import TableScanNode
+    from presto_tpu.server.serde import plan_to_json
+    from presto_tpu.server.worker import WorkerServer
+    from presto_tpu.types import BIGINT
+
+    mem = MemoryConnector()
+    mem.create_table(
+        "t", [("x", BIGINT)],
+        [Page.from_arrays([np.arange(3, dtype=np.int64)], [BIGINT])])
+    cat = Catalog()
+    cat.register("mem", mem)
+    w = WorkerServer(cat)
+    w.start()
+    try:
+        handle = cat.resolve("t")
+        good = plan_to_json(TableScanNode(handle, [0]))
+        bad = dict(good, table="missing_table")
+        client = WorkerClient(w.uri, timeout=20.0)
+        with pytest.raises(TaskFailed):
+            client.run_fragment(bad)
+        assert client.alive  # the worker is fine; the query was not
+        # and the worker still serves good fragments afterwards
+        assert client.run_fragment(good)
+    finally:
+        w.stop()
