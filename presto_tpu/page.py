@@ -269,12 +269,22 @@ class Page:
                 vals = np.empty(len(data), dtype=object)
                 vals[:] = decode_maps(data, b.type, b.dictionary)
             elif b.type.is_long_decimal:
+                import decimal
+
                 from presto_tpu.ops.decimal128 import decode_py
 
-                scale = 10.0 ** b.type.scale
-                vals = np.asarray([v / scale for v in decode_py(data)])
+                vals = np.empty(len(data), dtype=object)
+                vals[:] = [decimal.Decimal(v).scaleb(-(b.type.scale or 0))
+                           for v in decode_py(data)]
             elif b.type.is_decimal:
-                vals = data.astype(np.float64) / (10.0 ** b.type.scale)
+                # exact scaled-int values surface as decimal.Decimal —
+                # floats would silently round p>15 results (the
+                # reference returns java BigDecimal)
+                import decimal
+
+                sc = b.type.scale or 0
+                vals = np.empty(len(data), dtype=object)
+                vals[:] = [decimal.Decimal(int(v)).scaleb(-sc) for v in data]
             else:
                 vals = data
             col = [None if not v else _to_py(vals[i], b.type) for i, v in enumerate(valid)]
@@ -306,7 +316,9 @@ class Page:
 
 
 def _to_py(v, t: Type):
-    if t.name == "double" or t.name == "decimal":
+    if t.name == "decimal":
+        return v  # already decimal.Decimal (exact)
+    if t.name == "double":
         return float(v)
     if t.name == "boolean":
         return bool(v)

@@ -170,6 +170,8 @@ def _key(row: Sequence) -> tuple:
             out.append((1, int(v), ""))
         elif isinstance(v, float):
             out.append((1, round(v, 2), ""))
+        elif type(v).__name__ == "Decimal":
+            out.append((1, round(float(v), 2), ""))
         elif isinstance(v, int):
             out.append((1, v, ""))
         else:
@@ -187,7 +189,9 @@ def assert_rows_match(actual: List[tuple], expected: List[tuple], ordered: bool)
     for i, (ra, re_) in enumerate(zip(a, e)):
         assert len(ra) == len(re_), f"row {i} arity mismatch: {ra} vs {re_}"
         for j, (va, ve) in enumerate(zip(ra, re_)):
-            if isinstance(va, float) or isinstance(ve, float):
+            from decimal import Decimal as _D
+
+            if isinstance(va, (float, _D)) or isinstance(ve, (float, _D)):
                 if va is None or ve is None:
                     assert va is None and ve is None, f"row {i} col {j}: {va} vs {ve}"
                     continue

@@ -89,7 +89,7 @@ def test_q6_shape(env):
     )
     expected = (li["l_extendedprice"][sel] * li["l_discount"][sel]).sum() / 1e4
     assert len(res) == 1
-    assert res.rows[0][0] == pytest.approx(expected, rel=1e-12)
+    assert float(res.rows[0][0]) == pytest.approx(expected, rel=1e-12)
 
 
 def test_q1_shape(env):
@@ -136,12 +136,12 @@ def test_q1_shape(env):
         m = sel & (li["l_returnflag"] == kr) & (li["l_linestatus"] == kl)
         assert row[0] == rf_dict.decode(np.asarray([kr]))[0]
         assert row[1] == ls_dict.decode(np.asarray([kl]))[0]
-        assert row[2] == pytest.approx(li["l_quantity"][m].sum() / 100, rel=1e-12)
-        assert row[3] == pytest.approx(li["l_extendedprice"][m].sum() / 100, rel=1e-12)
+        assert float(row[2]) == pytest.approx(li["l_quantity"][m].sum() / 100, rel=1e-12)
+        assert float(row[3]) == pytest.approx(li["l_extendedprice"][m].sum() / 100, rel=1e-12)
         dp = li["l_extendedprice"][m] * (100 - li["l_discount"][m])
-        assert row[4] == pytest.approx(dp.sum() / 1e4, rel=1e-12)
+        assert float(row[4]) == pytest.approx(dp.sum() / 1e4, rel=1e-12)
         ch = dp * (100 + li["l_tax"][m])
-        assert row[5] == pytest.approx(ch.sum() / 1e6, rel=1e-12)
+        assert float(row[5]) == pytest.approx(ch.sum() / 1e6, rel=1e-12)
         assert row[6] == pytest.approx(li["l_quantity"][m].mean() / 100, rel=1e-12)
         assert row[7] == int(m.sum())
 
@@ -172,7 +172,7 @@ def test_join_unique_build(env):
     odate = dict(zip(o["o_orderkey"].tolist(), o["o_orderdate"].tolist()))
     sel = np.asarray([odate[k] < DATE_1995 for k in li["l_orderkey"].tolist()])
     assert res.rows[0][1] == int(sel.sum())
-    assert res.rows[0][0] == pytest.approx(li["l_extendedprice"][sel].sum() / 100, rel=1e-12)
+    assert float(res.rows[0][0]) == pytest.approx(li["l_extendedprice"][sel].sum() / 100, rel=1e-12)
 
 
 def test_expanding_join(env):
@@ -195,7 +195,7 @@ def test_expanding_join(env):
     res = runner.run(OutputNode(agg, ["n", "q"]))
     li = _full(tpch, "lineitem")
     assert res.rows[0][0] == len(li["l_orderkey"])  # every line matches its order
-    assert res.rows[0][1] == pytest.approx(li["l_quantity"].sum() / 100, rel=1e-12)
+    assert float(res.rows[0][1]) == pytest.approx(li["l_quantity"].sum() / 100, rel=1e-12)
 
 
 def test_semi_join(env):
@@ -221,7 +221,7 @@ def test_topn_and_limit(env):
     res = runner.run(OutputNode(topn, ["o_orderkey", "o_totalprice"]))
     o = _full(tpch, "orders")
     top10 = np.sort(o["o_totalprice"])[::-1][:10] / 100
-    assert [r[1] for r in res.rows] == pytest.approx(top10.tolist())
+    assert [float(r[1]) for r in res.rows] == pytest.approx(top10.tolist())
 
     lim = LimitNode(scan, 7)
     res2 = runner.run(OutputNode(lim, ["o_orderkey", "o_totalprice"]))
@@ -254,4 +254,4 @@ def test_grouped_join_agg(env):
     for k, r in zip(li["l_orderkey"].tolist(), revs.tolist()):
         agg_map[k] = agg_map.get(k, 0) + r
     top = sorted(agg_map.values(), reverse=True)[:5]
-    assert [r[1] for r in res.rows] == pytest.approx([t / 1e4 for t in top], rel=1e-12)
+    assert [float(r[1]) for r in res.rows] == pytest.approx([t / 1e4 for t in top], rel=1e-12)

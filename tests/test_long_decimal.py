@@ -39,8 +39,10 @@ def runner():
     return QueryRunner(catalog)
 
 
-def as_float(v: int) -> float:
-    return float(Decimal(v) / Decimal(10**SCALE))
+def as_exact(v: int) -> Decimal:
+    """Exact expected value: results are decimal.Decimal now, so the
+    headline exactness claims compare with == (no float tolerance)."""
+    return Decimal(v).scaleb(-SCALE)
 
 
 def test_roundtrip_and_filter(runner):
@@ -60,7 +62,7 @@ def test_exact_sum(runner):
     """The headline: sums beyond int64/float53 stay exact."""
     got = runner.execute("select sum(x) from big").rows[0][0]
     exact = sum(VALUES)
-    assert got == pytest.approx(as_float(exact), rel=1e-15)
+    assert got == as_exact(exact)
     # the underlying value is exact: compare through the plan output page
     from presto_tpu.sql.binder import Binder
 
@@ -78,8 +80,8 @@ def test_add_sub_mul_between_long_and_short(runner):
     for (i, plus, zero, double) in rows:
         v = VALUES[i]
         assert zero == 0.0
-        assert plus == pytest.approx(as_float(v + 15000), rel=1e-12)
-        assert double == pytest.approx(as_float(2 * v), rel=1e-12)
+        assert plus == as_exact(v + 15000)
+        assert double == as_exact(2 * v)
 
 
 def test_short_mul_overflow_via_cast(runner):
@@ -87,13 +89,13 @@ def test_short_mul_overflow_via_cast(runner):
     got = runner.execute(
         "select sum(cast(x as decimal(36, 4))) from big where id < 100").rows[0][0]
     exact = sum(VALUES[:100])
-    assert got == pytest.approx(as_float(exact), rel=1e-15)
+    assert got == as_exact(exact)
 
 
 def test_min_max_avg(runner):
     got = runner.execute("select min(x), max(x), avg(x) from big").rows[0]
-    assert got[0] == pytest.approx(as_float(min(VALUES)), rel=1e-15)
-    assert got[1] == pytest.approx(as_float(max(VALUES)), rel=1e-15)
+    assert got[0] == as_exact(min(VALUES))
+    assert got[1] == as_exact(max(VALUES))
     assert got[2] == pytest.approx(
         float(Decimal(sum(VALUES)) / len(VALUES) / 10**SCALE), rel=1e-12)
 
@@ -103,14 +105,14 @@ def test_grouped_long_sum(runner):
         "select mod(id, 7), sum(x) from big group by mod(id, 7)").rows)
     for k in range(7):
         exact = sum(v for i, v in enumerate(VALUES) if i % 7 == k)
-        assert got[k] == pytest.approx(as_float(exact), rel=1e-15), k
+        assert got[k] == as_exact(exact), k
 
 
 def test_case_and_null_handling(runner):
     got = runner.execute(
         "select sum(case when x > 0 then x end) from big").rows[0][0]
     exact = sum(v for v in VALUES if v > 0)
-    assert got == pytest.approx(as_float(exact), rel=1e-15)
+    assert got == as_exact(exact)
 
 
 def test_long_decimal_key_rejected(runner):
@@ -128,7 +130,7 @@ def test_cast_down_to_short(runner):
         " where x between -999999999999.0 and 999999999999.0 order by id").rows
     assert rows  # the fixed sentinel values 0/1/-1 qualify
     for i, v in rows:
-        assert v == pytest.approx(float(VALUES[i] // 100) / 100.0, rel=1e-12)
+        assert v == Decimal(VALUES[i] // 100).scaleb(-2)
 
 
 def test_review_edge_semantics(runner):
@@ -142,19 +144,19 @@ def test_review_edge_semantics(runner):
     rows = runner.execute(
         "select id, abs(x), sign(x) from big where id < 20 order by id").rows
     for i, av, sv in rows:
-        assert av == pytest.approx(as_float(abs(VALUES[i])), rel=1e-12)
+        assert av == as_exact(abs(VALUES[i]))
         assert sv == (VALUES[i] > 0) - (VALUES[i] < 0)
     # greatest/least across long values
     rows = runner.execute(
         "select id, greatest(x, 0.0000), least(x, 0.0000) from big"
         " where id < 20 order by id").rows
     for i, g, l in rows:
-        assert g == pytest.approx(as_float(max(VALUES[i], 0)), rel=1e-12)
-        assert l == pytest.approx(as_float(min(VALUES[i], 0)), rel=1e-12)
+        assert g == as_exact(max(VALUES[i], 0))
+        assert l == as_exact(min(VALUES[i], 0))
     # compare vs double goes through double space (fractions kept)
     n = runner.execute(
         "select count(*) from big where x < 0.5e0").rows[0][0]
-    assert n == sum(1 for v in VALUES if as_float(v) < 0.5)
+    assert n == sum(1 for v in VALUES if float(as_exact(v)) < 0.5)
     # exact bigint narrowing (above 2^53)
     got = runner.execute(
         "select cast(cast(123456789012345678.0000 as decimal(36, 4)) as bigint)"
@@ -163,11 +165,11 @@ def test_review_edge_semantics(runner):
     # long x short product exact at full width
     got = runner.execute(
         "select sum(x * 3) from big").rows[0][0]
-    assert got == pytest.approx(as_float(3 * sum(VALUES)), rel=1e-15)
+    assert got == as_exact(3 * sum(VALUES))
     # coalesce keeps the long representation
     got = runner.execute(
         "select sum(coalesce(x, 0.0000)) from big").rows[0][0]
-    assert got == pytest.approx(as_float(sum(VALUES)), rel=1e-15)
+    assert got == as_exact(sum(VALUES))
     # round() on long decimals fails loudly instead of silently wrong
     with pytest.raises(Exception, match="long decimal"):
         runner.execute("select round(x) from big")

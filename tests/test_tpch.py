@@ -99,7 +99,9 @@ def test_pages(tpch):
     schema = [n for n, _ in SCHEMAS["lineitem"]]
     row = dict(zip(schema, r0))
     assert row["l_returnflag"] in ("A", "N", "R")
-    assert isinstance(row["l_quantity"], float) and 1 <= row["l_quantity"] <= 50
+    from decimal import Decimal
+
+    assert isinstance(row["l_quantity"], Decimal) and 1 <= row["l_quantity"] <= 50
 
 
 def test_split_alignment():
