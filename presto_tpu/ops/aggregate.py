@@ -243,8 +243,19 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             out.append([cnt])
             continue
         data, valid = c.compile(agg.arg)(page)
-        if agg.fn in ("min", "max") and agg.arg.type.is_raw_string:
-            raise ValueError("min/max over raw varchar unsupported")
+        if agg.fn in ("min", "max") and agg.arg.type.is_string and not agg.arg.type.dictionary:
+            # raw varchar: k-phase lexicographic reduction over
+            # order-preserving int64 lanes (PagesIndex VARCHAR
+            # comparator role, no scalar loops)
+            from presto_tpu.ops import rawstring as rs
+
+            nonnull = rowsel & valid
+            gid_nn = jnp.where(nonnull, gid, n)
+            cnt = _gsum(ctx, nonnull.astype(jnp.int64), gid_nn, n)
+            lanes = rs.pack_lanes(data)
+            best = _minmax_lanes(agg.fn, lanes, nonnull, gid_nn, n)
+            out.append([rs.unpack_lanes(best, data.shape[-1]), cnt])
+            continue
         if agg.fn in ("min", "max") and agg.arg.type.is_string:
             # reduce over collation ranks, not assignment-ordered codes
             adict = _agg_dict(agg, [b.dictionary for b in page.blocks])
@@ -477,6 +488,18 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 _minmax_long(agg.fn, cols[0], nonnull, gid_nn, n)
                 + [_gsum(ctx, cols[1], gid, n)]
             )
+        elif agg.fn in ("min", "max") and agg.arg is not None \
+                and agg.arg.type.is_string and not agg.arg.type.dictionary:
+            from presto_tpu.ops import rawstring as rs
+
+            nonnull = cols[1] > 0
+            gid_nn = jnp.where(nonnull, gid, n)
+            lanes = rs.pack_lanes(cols[0])
+            best = _minmax_lanes(agg.fn, lanes, nonnull, gid_nn, n)
+            out.append([
+                rs.unpack_lanes(best, cols[0].shape[-1]),
+                _gsum(ctx, cols[1], gid, n),
+            ])
         elif agg.fn == "min":
             out.append([
                 _seg_min(cols[0], gid, n + 1)[:n],
@@ -732,6 +755,23 @@ def _type_max(t: Type):
 
 def _type_min(t: Type):
     return jnp.asarray(jnp.finfo(jnp.float64).min if t.name == "double" else -_I64_MAX - 1).astype(t.np_dtype)
+
+
+def _minmax_lanes(fn: str, lanes, nonnull, gid_nn, n):
+    """k-phase lexicographic segment extreme over (rows, k) int64
+    lanes: phase c reduces lane c among rows still tying on lanes
+    < c (generalizes _minmax_long's two-limb walk)."""
+    red = _seg_min if fn == "min" else _seg_max
+    fill = _I64_MAX if fn == "min" else -_I64_MAX - 1
+    tie = nonnull
+    gid_cur = gid_nn
+    best = []
+    for c in range(lanes.shape[-1]):
+        b = red(jnp.where(tie, lanes[..., c], fill), gid_cur, n + 1)[:n]
+        best.append(b)
+        tie = tie & (lanes[..., c] == b[jnp.clip(gid_cur, 0, n - 1)])
+        gid_cur = jnp.where(tie, gid_nn, n)
+    return jnp.stack(best, axis=-1)
 
 
 def _minmax_long(fn: str, data, nonnull, gid_nn, n):

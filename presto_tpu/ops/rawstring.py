@@ -120,6 +120,38 @@ def hash_bytes(data: jax.Array) -> jax.Array:
     return h.astype(jnp.int64) & jnp.int64(0x7FFFFFFFFFFFFFFF)
 
 
+def pack_lanes(data: jax.Array) -> jax.Array:
+    """(n, W) bytes -> (n, ceil(W/8)) int64 lanes whose lexicographic
+    lane order equals byte order: big-endian 8-byte chunks, sign bit of
+    the leading byte flipped so signed int64 comparison matches
+    unsigned byte comparison.  Enables min/max over raw strings as a
+    k-phase lexicographic segment reduction (the PagesIndex comparator
+    role for VARCHAR, without scalar loops)."""
+    w = data.shape[-1]
+    k = -(-w // 8)
+    padded = _pad_to(data, k * 8).astype(jnp.uint64)
+    lanes = []
+    for c in range(k):
+        lane = jnp.zeros(data.shape[:-1], dtype=jnp.uint64)
+        for j in range(8):  # static: unrolls and fuses
+            lane = (lane << jnp.uint64(8)) | padded[..., c * 8 + j]
+        # flip the sign bit: unsigned order -> signed int64 order
+        lanes.append((lane ^ jnp.uint64(1 << 63)).astype(jnp.int64))
+    return jnp.stack(lanes, axis=-1)
+
+
+def unpack_lanes(lanes: jax.Array, width: int) -> jax.Array:
+    """Inverse of pack_lanes -> (n, width) uint8."""
+    k = lanes.shape[-1]
+    u = (lanes.astype(jnp.uint64) ^ jnp.uint64(1 << 63))
+    cols = []
+    for c in range(k):
+        for j in range(8):
+            shift = jnp.uint64(8 * (7 - j))
+            cols.append(((u[..., c] >> shift) & jnp.uint64(0xFF)).astype(jnp.uint8))
+    return jnp.stack(cols, axis=-1)[..., :width]
+
+
 def host_predicate(pred: Callable[[str], bool]):
     """Wrap a python str predicate as a page-level device op via host
     callback (LIKE/regex on raw strings — the irregular tail)."""

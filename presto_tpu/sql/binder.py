@@ -287,6 +287,9 @@ class AggCtx:
     group_asts: List[ast.Node]
     group_irs: List[Expr]  # over the pre-agg scope
     aggs: List[AggCall] = dataclasses.field(default_factory=list)
+    # grouping-set membership masks (GroupIdNode's set_masks) when the
+    # aggregation came from GROUPING SETS/ROLLUP/CUBE — powers grouping()
+    set_masks: Optional[List[List[bool]]] = None
 
     def key_ref(self, i: int) -> ColumnRef:
         return ColumnRef(type=self.group_irs[i].type, index=i)
@@ -1114,7 +1117,8 @@ class Binder:
                 ColumnRef(type=g.type, index=nsrc + i, name=key_names[i])
                 for i, g in enumerate(group_irs)
             ] + [ColumnRef(type=BIGINT, index=nsrc + len(group_asts), name="$group_id")]
-        agg_ctx = AggCtx(group_asts=group_asts, group_irs=group_irs)
+        agg_ctx = AggCtx(group_asts=group_asts, group_irs=group_irs,
+                         set_masks=masks if grouping_sets is not None else None)
 
         out_irs = [self._bind_agg(e, scope, agg_ctx) for e, _ in items]
         names = [n for _, n in items]
@@ -1592,6 +1596,8 @@ class Binder:
                     pass
             if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCTIONS:
                 return self._bind_agg_call(e, scope, agg)
+            if isinstance(e, ast.FuncCall) and e.name == "grouping":
+                return self._bind_grouping(e, scope, agg)
 
         if isinstance(e, ast.Identifier):
             idx = scope.resolve(e.qualifier, e.name)
@@ -1853,6 +1859,38 @@ class Binder:
             return ir
 
         return rewrite(self._bind_impl(body, _MarkScope(), agg))
+
+    def _bind_grouping(self, e: ast.FuncCall, scope: Scope, agg: AggCtx) -> Expr:
+        """grouping(a, b, ...) -> bitmask int: bit j (MSB-first) is 1
+        when argument j is NOT aggregated in the current grouping set
+        (sql/tree/GroupingOperation.java + the reference's rewrite to a
+        $group_id lookup in QueryPlanner.planGroupingOperations)."""
+        from presto_tpu.expr.ir import lit
+
+        if agg.set_masks is None:
+            raise BindError(
+                "grouping() requires GROUPING SETS / ROLLUP / CUBE")
+        idxs = []
+        for a in e.args:
+            hit = next((i for i, g in enumerate(agg.group_asts) if g == a), None)
+            if hit is None:
+                raise BindError(
+                    f"grouping() argument {a!r} is not a grouping column")
+            idxs.append(hit)
+        k = len(idxs)
+        vals = []
+        for mask in agg.set_masks:
+            v = 0
+            for j, i in enumerate(idxs):
+                if not mask[i]:
+                    v |= 1 << (k - 1 - j)
+            vals.append(v)
+        gid_ref = agg.key_ref(len(agg.group_irs) - 1)  # $group_id key
+        expr: Expr = lit(vals[-1], BIGINT)
+        for g in range(len(vals) - 2, -1, -1):
+            expr = call("if", call("eq", gid_ref, lit(g, BIGINT)),
+                        lit(vals[g], BIGINT), expr)
+        return expr
 
     def _bind_number(self, text: str) -> Literal:
         if "e" in text.lower():

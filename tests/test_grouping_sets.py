@@ -103,3 +103,39 @@ def test_rollup_cube_parse_shapes(env):
     ).rows
     # 5 regions x nations(25) + 5 regions + 25 nations + 1 global
     assert len(rows) == 25 + 5 + 25 + 1
+
+
+def test_grouping_function_rollup():
+    """grouping(a, b) bitmask over ROLLUP levels
+    (sql/tree/GroupingOperation.java). Also a regression pin for a
+    once-observed row drop through the ORDER BY merge path."""
+    import numpy as np
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.page import Page
+    from presto_tpu.runner import QueryRunner
+    from presto_tpu.types import BIGINT
+
+    mem = MemoryConnector()
+    mem.create_table(
+        "gt", [("a", BIGINT), ("b", BIGINT), ("v", BIGINT)],
+        [Page.from_arrays([np.array([1, 1, 2]), np.array([10, 20, 10]),
+                           np.array([5, 6, 7])], [BIGINT] * 3)])
+    cat = Catalog()
+    cat.register("mem", mem)
+    r = QueryRunner(cat)
+    for _ in range(3):
+        rows = r.execute(
+            "SELECT a, b, grouping(a, b), sum(v) FROM gt "
+            "GROUP BY ROLLUP(a, b) ORDER BY 3, 1, 2").rows
+        assert rows == [
+            (1, 10, 0, 5), (1, 20, 0, 6), (2, 10, 0, 7),
+            (1, None, 1, 11), (2, None, 1, 7), (None, None, 3, 18)]
+    # grouping() without grouping sets is a bind error
+    import pytest
+
+    from presto_tpu.sql.binder import BindError
+
+    with pytest.raises(BindError):
+        r.execute("SELECT a, grouping(a) FROM gt GROUP BY a")

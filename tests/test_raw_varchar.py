@@ -141,9 +141,10 @@ def test_distinct_and_approx_distinct(runner):
     assert abs(approx - exact) <= max(0.05 * exact, 2)
 
 
-def test_min_max_raw_rejected(runner):
-    with pytest.raises(Exception, match="raw varchar"):
-        runner.execute("select min(s) from txt")
+def test_min_max_raw_supported(runner):
+    rows = runner.execute("select min(s), max(s) from txt").rows
+    assert rows == [(min(STRINGS), max(STRINGS))]
+    # two-argument extremes over raw strings remain out of scope
     with pytest.raises(Exception, match="raw varchar"):
         runner.execute("select max_by(s, id) from txt")
 
@@ -188,3 +189,49 @@ def test_columnfile_roundtrip_raw(runner, tmp_path):
     assert t.is_raw_string and t.precision == W
     assert fc.page_for_split("txt", 0).to_pylist() == \
         conn.page_for_split("txt", 0).to_pylist()
+
+
+def test_raw_varchar_min_max():
+    """Lexicographic min/max via order-preserving int64 lane packing
+    (PagesIndex VARCHAR comparator role)."""
+    import numpy as np
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.page import Page
+    from presto_tpu.runner import QueryRunner
+    from presto_tpu.types import BIGINT, VarcharType
+
+    rt = VarcharType(12, raw=True)
+    mem = MemoryConnector()
+    mem.create_table(
+        "mt", [("g", BIGINT), ("s", rt)],
+        [Page.from_arrays(
+            [np.array([1, 1, 2, 2, 1]),
+             ["banana", "apple", "zebra", "aardvark", None]],
+            [BIGINT, rt],
+            valids=[None, np.array([True, True, True, True, False])]),
+         Page.from_arrays([np.array([2, 1]), ["yak", "cherry"]], [BIGINT, rt])])
+    cat = Catalog()
+    cat.register("mem", mem)
+    r = QueryRunner(cat)
+    assert r.execute("SELECT g, min(s), max(s) FROM mt GROUP BY g ORDER BY g").rows == [
+        (1, "apple", "cherry"), (2, "aardvark", "zebra")]
+    assert r.execute("SELECT min(s), max(s) FROM mt").rows == [("aardvark", "zebra")]
+    # all-NULL group -> NULL; '' sorts before any letter
+    assert r.execute("SELECT min(s) FROM mt WHERE g = 3").rows == [(None,)]
+
+
+def test_pack_lanes_roundtrip_and_order():
+    import numpy as np
+
+    from presto_tpu.ops.rawstring import encode_strings, pack_lanes, unpack_lanes
+
+    vals = ["", "a", "ab", "b", "zzzzzzzzzzzzzzzzzzzzzzzz", "Z", "0"]
+    data = encode_strings(vals, 24)
+    lanes = np.asarray(pack_lanes(data))
+    back = np.asarray(unpack_lanes(lanes, 24))
+    assert (back == data).all()
+    # lane tuple order == byte order
+    order = sorted(range(len(vals)), key=lambda i: tuple(lanes[i]))
+    assert [vals[i] for i in order] == sorted(vals)
