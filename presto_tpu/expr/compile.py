@@ -66,7 +66,7 @@ _CONTAINER_FNS = frozenset({
     "array_average", "array_sort", "array_distinct", "map_keys",
     "map_values", "map", "map_construct",
     "array_transform", "array_filter", "any_match", "all_match",
-    "none_match",
+    "none_match", "sequence", "slice", "repeat", "array_concat",
 })
 
 
@@ -925,6 +925,50 @@ class ExprCompiler:
                 return ct.construct_map(kd, kt, vd, vt, out_t), kv & vv
 
             return run_map
+        if fn == "sequence":
+            lo = int(expr.args[0].value)
+            step = int(expr.args[2].value) if len(expr.args) > 2 else 1
+            n = out_t.max_elems
+            row = jnp.concatenate([
+                jnp.asarray([n], dtype=jnp.int64),
+                lo + step * jnp.arange(n, dtype=jnp.int64),
+            ])
+
+            def run_seq(page):
+                cap = page.capacity
+                return (jnp.broadcast_to(row[None, :], (cap, n + 1)),
+                        jnp.ones(cap, jnp.bool_))
+
+            return run_seq
+        if fn == "repeat":
+            val = self.compile(expr.args[0])
+            n = out_t.max_elems
+            count = int(expr.args[1].value)
+            storage = out_t.np_dtype
+
+            def run_repeat(page):
+                d, v = val(page)
+                sent = ct._null_const(storage)
+                elems = jnp.where(v[:, None], d.astype(storage)[:, None],
+                                  sent)
+                body = jnp.broadcast_to(elems, (page.capacity, n))
+                length = jnp.full((page.capacity, 1), float(count)
+                                  if storage.kind == "f" else count,
+                                  dtype=storage)
+                return (jnp.concatenate([length, body], axis=1),
+                        jnp.ones(page.capacity, jnp.bool_))
+
+            return run_repeat
+        if fn == "array_concat":
+            a = self.compile(expr.args[0])
+            b = self.compile(expr.args[1])
+            ta, tb = expr.args[0].type, expr.args[1].type
+
+            def run_cat(page):
+                (da, va), (db, vb) = a(page), b(page)
+                return ct.concat_arrays(da, ta, db, tb, out_t), va & vb
+
+            return run_cat
 
         arg0 = self.compile(expr.args[0])
         t0 = expr.args[0].type
@@ -981,6 +1025,18 @@ class ExprCompiler:
         if fn in ("array_transform", "array_filter", "any_match",
                   "all_match", "none_match"):
             return self._compile_array_lambda(expr, arg0, t0)
+        if fn == "slice":
+            start_e, len_e = expr.args[1], expr.args[2]
+            if not (isinstance(start_e, Literal) and isinstance(len_e, Literal)):
+                raise ValueError("slice() start/length must be literals")
+            start = int(start_e.value)
+            ln = int(len_e.value)
+
+            def run_slice(page):
+                d, v = arg0(page)
+                return ct.slice_array(d, t0, start, ln), v
+
+            return run_slice
         raise KeyError(fn)
 
     def _compile_array_lambda(self, expr: Call, arr_f, t0: Type) -> CompiledExpr:

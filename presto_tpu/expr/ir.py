@@ -270,6 +270,41 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         from presto_tpu.types import ArrayType
 
         return ArrayType(ts[0].element, ts[0].max_elems)
+    if fn == "sequence":
+        from presto_tpu.types import ArrayType
+
+        if not all(isinstance(a, Literal) for a in args):
+            raise TypeError("sequence() bounds must be literals (static shape)")
+        lo, hi = int(args[0].value), int(args[1].value)
+        step = int(args[2].value) if len(args) > 2 else 1
+        n = max((hi - lo) // step + 1, 0) if step else 0
+        if n <= 0 or n > 10000:
+            raise TypeError(f"sequence() produces {n} elements (1..10000)")
+        return ArrayType(BIGINT, n)
+    if fn == "slice":
+        if len(args) != 3 or not (isinstance(args[1], Literal)
+                                  and isinstance(args[2], Literal)):
+            raise TypeError("slice(arr, start, length) needs literal "
+                            "start/length (static shape)")
+        if int(args[1].value) == 0:
+            raise TypeError("SQL array indices start at 1")
+        if int(args[2].value) < 0:
+            raise TypeError("slice() length must be >= 0")
+        return ts[0]
+    if fn == "repeat":
+        from presto_tpu.types import ArrayType
+
+        if not isinstance(args[1], Literal):
+            raise TypeError("repeat() count must be a literal (static shape)")
+        n = int(args[1].value)
+        if n < 0 or n > 10000:
+            raise TypeError("repeat() count out of range")
+        return ArrayType(ts[0], max(n, 1))
+    if fn == "array_concat":
+        from presto_tpu.types import ArrayType
+
+        elem = common_super_type(ts[0].element, ts[1].element)
+        return ArrayType(elem, ts[0].max_elems + ts[1].max_elems)
     if fn == "array_transform":
         from presto_tpu.types import ArrayType
 

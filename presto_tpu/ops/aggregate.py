@@ -243,7 +243,7 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             out.append([cnt])
             continue
         data, valid = c.compile(agg.arg)(page)
-        if agg.fn in ("min", "max") and agg.arg.type.is_string and not agg.arg.type.dictionary:
+        if agg.fn in ("min", "max") and agg.arg.type.is_raw_string:
             # raw varchar: k-phase lexicographic reduction over
             # order-preserving int64 lanes (PagesIndex VARCHAR
             # comparator role, no scalar loops)
@@ -489,7 +489,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 + [_gsum(ctx, cols[1], gid, n)]
             )
         elif agg.fn in ("min", "max") and agg.arg is not None \
-                and agg.arg.type.is_string and not agg.arg.type.dictionary:
+                and agg.arg.type.is_raw_string:
             from presto_tpu.ops import rawstring as rs
 
             nonnull = cols[1] > 0

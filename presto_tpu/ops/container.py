@@ -342,6 +342,74 @@ def array_distinct(data: jax.Array, t: Type) -> jax.Array:
     return jnp.concatenate([total[:, None], out], axis=1).astype(storage)
 
 
+def slice_array(data: jax.Array, t: Type, start: int, length: int) -> jax.Array:
+    """slice(arr, start, length) — 1-based; negative start counts from
+    the array end (ArraySliceFunction semantics); static offsets keep
+    shapes fixed.  start==0 / negative length reject at bind time."""
+    n = lengths(data)
+    slots = elem_slots(data, t)
+    if start > 0:
+        base = jnp.full_like(n, start - 1)
+    else:
+        base = jnp.maximum(n + start, 0)
+    avail = jnp.clip(jnp.minimum(n - base, length), 0, None)
+    M = t.max_elems
+    j = jnp.arange(M)[None, :]
+    src = jnp.clip(j + base[:, None], 0, M - 1)
+    gathered = jnp.take_along_axis(slots, src, axis=1)
+    sent = _null_const(slots.dtype)
+    out = jnp.where(j < avail[:, None], gathered, sent)
+    return jnp.concatenate([avail[:, None].astype(data.dtype), out], axis=1)
+
+
+def coerce_slots(slots: jax.Array, from_t: Type, to_t: Type,
+                 storage) -> jax.Array:
+    """Element-wise conversion of container slots between scalar types,
+    preserving NULL sentinels across storage dtypes (the container
+    analog of the expression compiler's _coerce)."""
+    isnull = elem_null_mask(slots)
+    vals = slots
+    if from_t.is_decimal or to_t.is_decimal:
+        fs = from_t.scale or 0 if from_t.is_decimal else 0
+        tscale = to_t.scale or 0 if to_t.is_decimal else 0
+        if to_t.name == "double":
+            vals = vals.astype(jnp.float64) / (10.0 ** fs)
+        elif to_t.is_decimal:
+            if from_t.name == "double":
+                vals = jnp.round(vals * (10.0 ** tscale))
+            elif tscale >= fs:
+                vals = vals.astype(jnp.int64) * (10 ** (tscale - fs))
+            else:
+                vals = vals.astype(jnp.int64) // (10 ** (fs - tscale))
+    vals = vals.astype(storage)
+    sent = _null_const(storage)
+    return jnp.where(isnull, sent, vals)
+
+
+def concat_arrays(a: jax.Array, ta: Type, b: jax.Array, tb: Type,
+                  out_t: Type) -> jax.Array:
+    """a || b element concatenation (ArrayConcatFunction analog)."""
+    la, lb = lengths(a), lengths(b)
+    M = out_t.max_elems
+    storage = out_t.np_dtype
+    sent = _null_const(storage)
+    elem_t = out_t.element
+    sa = coerce_slots(elem_slots(a, ta), ta.element, elem_t, storage)
+    sb = coerce_slots(elem_slots(b, tb), tb.element, elem_t, storage)
+    wa = sa.shape[1]
+    j = jnp.arange(M)[None, :]
+    from_a = j < la[:, None]
+    # position j takes a[j] when j < la, else b[j - la]
+    bj = jnp.clip(j - la[:, None], 0, sb.shape[1] - 1)
+    b_vals = jnp.take_along_axis(sb, bj, axis=1)
+    a_pad = jnp.concatenate(
+        [sa, jnp.full((sa.shape[0], M - wa), sent, dtype=storage)], axis=1)
+    out = jnp.where(from_a, a_pad, b_vals)
+    total = la + lb
+    out = jnp.where(j < total[:, None], out, sent)
+    return jnp.concatenate([total[:, None].astype(storage), out], axis=1)
+
+
 def map_keys_array(data: jax.Array, t: Type, out_t: Type) -> jax.Array:
     """map_keys(m) -> array of keys (order = insertion order)."""
     n = lengths(data)

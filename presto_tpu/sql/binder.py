@@ -110,6 +110,7 @@ SCALAR_FUNCTIONS = {
     "cardinality", "contains", "element_at", "array_position",
     "array_min", "array_max", "array_sum", "array_average",
     "array_sort", "array_distinct", "map_keys", "map_values", "map",
+    "sequence", "slice", "repeat",
 }
 
 
@@ -1752,6 +1753,16 @@ class Binder:
                 return self._bind_agg_call(e, scope, agg)
             if e.name in SCALAR_FUNCTIONS:
                 args = [self._bind_impl(a, scope, agg) for a in e.args]
+                if e.name == "concat" and len(args) == 2 \
+                        and any(a.type.is_array for a in args):
+                    # ARRAY || scalar appends the element (and the
+                    # symmetric prepend) — wrap the scalar side
+                    a0, a1 = args
+                    if not a0.type.is_array:
+                        a0 = call("array_construct", a0)
+                    if not a1.type.is_array:
+                        a1 = call("array_construct", a1)
+                    return call("array_concat", a0, a1)
                 if e.name == "concat":
                     if any(isinstance(a, Literal) and a.value is None for a in args):
                         return Literal(type=VARCHAR, value=None)  # NULL-propagating

@@ -177,6 +177,28 @@ def test_array_agg_global(runner):
     assert q(runner, "SELECT array_agg(id) FROM t") == [([1, 2, 3, 4],)]
 
 
+def test_sequence_slice_repeat_concat(runner):
+    assert q(runner, "SELECT sequence(1, 5)") == [([1, 2, 3, 4, 5],)]
+    assert q(runner, "SELECT sequence(0, 10, 3)") == [([0, 3, 6, 9],)]
+    assert q(runner, "SELECT slice(ARRAY[1,2,3,4,5], 2, 3)") == [([2, 3, 4],)]
+    assert q(runner, "SELECT slice(ARRAY[1,2], 2, 9)") == [([2],)]
+    assert q(runner, "SELECT repeat(7, 3)") == [([7, 7, 7],)]
+    assert q(runner, "SELECT ARRAY[1,2] || ARRAY[3,4]") == [([1, 2, 3, 4],)]
+    # negative start counts from the end; element append/prepend
+    assert q(runner, "SELECT slice(ARRAY[1,2,3,4], -2, 2)") == [([3, 4],)]
+    assert q(runner, "SELECT ARRAY[1,2] || 3") == [([1, 2, 3],)]
+    assert q(runner, "SELECT 0 || ARRAY[1]") == [([0, 1],)]
+    # mixed-type concat rescales decimals and keeps NULL elements
+    assert q(runner, "SELECT ARRAY[1.5] || ARRAY[2.25]") == [([1.5, 2.25],)]
+    assert q(runner, "SELECT ARRAY[1, 2] || ARRAY[2.5]") == [([1.0, 2.0, 2.5],)]
+    with pytest.raises(Exception, match="indices start at 1"):
+        q(runner, "SELECT slice(ARRAY[1,2], 0, 1)")
+    with pytest.raises(Exception, match="length"):
+        q(runner, "SELECT slice(ARRAY[1,2], 1, -1)")
+    assert q(runner, "SELECT transform(sequence(1, 4), x -> x * x)") == [
+        ([1, 4, 9, 16],)]
+
+
 def test_map_agg(runner):
     rows = q(runner, "SELECT g, map_agg(id, id * 10) FROM t GROUP BY g ORDER BY g")
     assert rows == [(1, {1: 10, 2: 20}), (2, {3: 30, 4: 40})]
