@@ -1,0 +1,94 @@
+"""HTTP connector: tables served by remote HTTP endpoints.
+
+Reference analog: ``presto-example-http`` (the connector-SPI tutorial
+connector: a JSON catalog maps tables to lists of data URIs, each URI
+serving CSV; one URI = one split).  Same shape here, riding the shared
+record-decoder layer.
+
+Catalog description::
+
+    {
+      "tables": {
+        "events": {
+          "format": "csv",
+          "schema": [["ts", "varchar"], ["n", "bigint"]],
+          "sources": ["http://host/part1.csv", "http://host/part2.csv"]
+        }
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.connectors.jdbc import _encode_column
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.record_decoder import decoder_for
+from presto_tpu.types import Type, parse_type
+
+
+class HttpConnector:
+    def __init__(self, catalog_uri: Optional[str] = None,
+                 description: Optional[dict] = None, timeout: float = 30.0):
+        if description is None:
+            if catalog_uri is None:
+                raise ValueError("need catalog_uri or description")
+            with urllib.request.urlopen(catalog_uri, timeout=timeout) as r:
+                description = json.load(r)
+        self.tables = description["tables"]
+        self.timeout = timeout
+        self._cache: Dict[Tuple[str, int], Page] = {}
+        self._dicts: Dict[str, Dict[str, Dictionary]] = {}
+
+    # -- connector protocol -------------------------------------------------
+    def table_names(self) -> List[str]:
+        return list(self.tables)
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return [(c, parse_type(t)) for c, t in self.tables[table]["schema"]]
+
+    def num_splits(self, table: str) -> int:
+        return len(self.tables[table]["sources"])
+
+    def row_count(self, table: str) -> int:
+        import numpy as np
+
+        return sum(
+            int(np.asarray(self.page_for_split(table, s).row_mask).sum())
+            for s in range(self.num_splits(table))
+        )
+
+    def page_for_split(self, table: str, split: int,
+                       capacity: Optional[int] = None) -> Page:
+        key = (table, split)
+        if key not in self._cache:
+            meta = self.tables[table]
+            uri = meta["sources"][split]
+            with urllib.request.urlopen(uri, timeout=self.timeout) as r:
+                text = r.read().decode()
+            schema = self.schema(table)
+            dec = decoder_for(meta.get("format", "csv"), schema,
+                              **meta.get("decoder", {}))
+            cols_raw = dec.decode(text.splitlines())
+            dicts = self._dicts.setdefault(table, {})
+            cols, valids, page_dicts = [], [], []
+            for (name, t), raw in zip(schema, cols_raw):
+                data, valid, d = _encode_column(raw, t, dicts.get(name))
+                if d is not None:
+                    dicts[name] = d
+                cols.append(data)
+                valids.append(valid)
+                page_dicts.append(d)
+            self._cache[key] = Page.from_arrays(
+                cols, [t for _, t in schema], valids=valids,
+                dictionaries=page_dicts)
+        return self._cache[key]
+
+    def dictionary_for(self, table: str, column: str):
+        # ensure dictionaries cover every split before predicates bind
+        for s in range(self.num_splits(table)):
+            self.page_for_split(table, s)
+        return self._dicts.get(table, {}).get(column)

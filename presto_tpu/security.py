@@ -69,3 +69,63 @@ def scan_tables(plan) -> List[str]:
 
     walk(plan)
     return out
+
+
+# ---------------------------------------------------------------------------
+# authentication (server/security/ + presto-password-authenticators)
+# ---------------------------------------------------------------------------
+
+class AuthenticationError(Exception):
+    pass
+
+
+class PasswordAuthenticator:
+    """SPI: authenticate(user, password) -> None or raise
+    (spi/security/PasswordAuthenticator.java)."""
+
+    def authenticate(self, user: str, password: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FilePasswordAuthenticator(PasswordAuthenticator):
+    """user:salted-sha256 lines (the file password authenticator's
+    model; htpasswd-style)."""
+
+    def __init__(self, entries=None, path: str = None):
+        import hashlib
+
+        self._hash = hashlib.sha256
+        self.users = {}
+        if path is not None:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        user, salted = line.split(":", 1)
+                        salt, digest = salted.split("$", 1)
+                        self.users[user] = (salt, digest)
+        for user, password in (entries or {}).items():
+            salt = "s0"
+            self.users[user] = (salt, self._digest(salt, password))
+
+    def _digest(self, salt: str, password: str) -> str:
+        return self._hash((salt + password).encode()).hexdigest()
+
+    def authenticate(self, user: str, password: str) -> None:
+        got = self.users.get(user)
+        if got is None or self._digest(got[0], password) != got[1]:
+            raise AuthenticationError(f"invalid credentials for {user}")
+
+
+def parse_basic_auth(header: str):
+    """'Basic base64(user:pass)' -> (user, password) or None."""
+    import base64
+
+    if not header.startswith("Basic "):
+        return None
+    try:
+        raw = base64.b64decode(header[len("Basic "):]).decode()
+        user, _, password = raw.partition(":")
+        return user, password
+    except Exception:
+        return None

@@ -95,9 +95,13 @@ class CoordinatorServer:
     single-process coordinator hits)."""
 
     def __init__(self, runner: QueryRunner, host: str = "127.0.0.1", port: int = 0,
-                 resource_groups=None, worker_uris=(), memory_threshold: float = 0.95):
+                 resource_groups=None, worker_uris=(), memory_threshold: float = 0.95,
+                 authenticator=None):
         from presto_tpu.resource_groups import ResourceGroupManager
 
+        # optional PasswordAuthenticator (server/security + the
+        # password-authenticator plugins): HTTP Basic on /v1/statement
+        self.authenticator = authenticator
         self.runner = runner
         self.queries: Dict[str, _QueryState] = {}
         self.resource_groups = resource_groups or ResourceGroupManager()
@@ -137,9 +141,31 @@ class CoordinatorServer:
                 self.end_headers()
                 self.wfile.write(raw)
 
+            def _authenticated(self) -> bool:
+                if outer.authenticator is None:
+                    return True
+                from presto_tpu.security import (
+                    AuthenticationError, parse_basic_auth,
+                )
+
+                got = parse_basic_auth(self.headers.get("Authorization", ""))
+                if got is not None:
+                    try:
+                        outer.authenticator.authenticate(*got)
+                        return True
+                    except AuthenticationError:
+                        pass
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", "Basic realm=\"presto\"")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return False
+
             def do_POST(self):
                 if self.path != "/v1/statement":
                     self._json(404, {"error": "not found"})
+                    return
+                if not self._authenticated():
                     return
                 n = int(self.headers.get("Content-Length", "0"))
                 sql = self.rfile.read(n).decode()
