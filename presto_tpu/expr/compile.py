@@ -70,6 +70,18 @@ _CONTAINER_FNS = frozenset({
 })
 
 
+# single-argument double -> double math (MathFunctions.java sweep)
+_UNARY_DOUBLE_FNS = {
+    "sqrt": jnp.sqrt, "cbrt": jnp.cbrt, "exp": jnp.exp, "ln": jnp.log,
+    "log10": jnp.log10, "log2": jnp.log2,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "is_nan": jnp.isnan, "is_finite": jnp.isfinite,
+}
+
+
 def _json_path_get(doc: str, path: str):
     """Tiny JSONPath subset: $, .name, [idx] (reference:
     operator/scalar/JsonExtract.java's path engine)."""
@@ -547,8 +559,11 @@ class ExprCompiler:
                 return self._coerce(d, t0, out_t), v
 
             return run_cast_decimal
-        if fn in ("abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10",
-                  "power", "pow", "ceil", "ceiling", "floor", "round"):
+        if fn in ("abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10", "log2",
+                  "power", "pow", "ceil", "ceiling", "floor", "round",
+                  "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+                  "sinh", "cosh", "tanh", "degrees", "radians", "truncate",
+                  "width_bucket", "is_nan", "is_finite"):
             return self._compile_math(expr)
         if fn in ("greatest", "least"):
             return self._compile_greatest_least(expr)
@@ -1143,15 +1158,33 @@ class ExprCompiler:
             # silently-wrong elementwise limb math is worse than an error
             raise ValueError(f"{fn} on long decimals unsupported (cast first)")
 
-        if fn in ("power", "pow"):
+        if fn in ("power", "pow", "atan2"):
             b = self.compile(expr.args[1])
             tb = expr.args[1].type
+            op = jnp.power if fn in ("power", "pow") else jnp.arctan2
 
             def run_pow(page):
                 (da, va), (db, vb) = a(page), b(page)
-                return jnp.power(_to_double(da, ta), _to_double(db, tb)), va & vb
+                return op(_to_double(da, ta), _to_double(db, tb)), va & vb
 
             return run_pow
+
+        if fn == "width_bucket":
+            args = [self.compile(x) for x in expr.args]
+            ts = [x.type for x in expr.args]
+
+            def run_wb(page):
+                (x, vx), (lo, vlo), (hi, vhi), (n, vn) = [f(page) for f in args]
+                xd = _to_double(x, ts[0])
+                lod = _to_double(lo, ts[1])
+                hid = _to_double(hi, ts[2])
+                nb = n.astype(jnp.int64)
+                frac = (xd - lod) / jnp.where(hid == lod, 1.0, hid - lod)
+                b = jnp.floor(frac * nb.astype(jnp.float64)).astype(jnp.int64) + 1
+                b = jnp.clip(b, 0, nb + 1)
+                return b, vx & vlo & vhi & vn
+
+            return run_wb
 
         if fn == "round" and len(expr.args) > 1:
             digits = expr.args[1].value
@@ -1164,16 +1197,11 @@ class ExprCompiler:
                 return jnp.abs(da), va
             if fn == "sign":
                 return jnp.sign(_to_double(da, ta)).astype(jnp.int64), va
-            if fn in ("sqrt", "cbrt", "exp", "ln", "log10"):
+            if fn in _UNARY_DOUBLE_FNS:
+                return _UNARY_DOUBLE_FNS[fn](_to_double(da, ta)), va
+            if fn == "truncate":
                 x = _to_double(da, ta)
-                out = {
-                    "sqrt": lambda: jnp.sqrt(x),
-                    "cbrt": lambda: jnp.cbrt(x),
-                    "exp": lambda: jnp.exp(x),
-                    "ln": lambda: jnp.log(x),
-                    "log10": lambda: jnp.log10(x),
-                }[fn]()
-                return out, va
+                return jnp.trunc(x), va
             if fn in ("ceil", "ceiling", "floor"):
                 up = fn in ("ceil", "ceiling")
                 if ta.is_decimal:

@@ -148,7 +148,17 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return ts[1]  # truncation preserves the operand's type
     if fn == "date_add":
         return ts[2]
-    if fn in ("sqrt", "cbrt", "exp", "ln", "log10", "power", "pow"):
+    if fn in ("sqrt", "cbrt", "exp", "ln", "log10", "log2", "power", "pow",
+              "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+              "sinh", "cosh", "tanh", "degrees", "radians", "truncate"):
+        return DOUBLE
+    if fn in ("is_nan", "is_finite"):
+        return BOOLEAN
+    if fn == "width_bucket":
+        return BIGINT
+    if fn == "pi":
+        return DOUBLE
+    if fn == "e":
         return DOUBLE
     if fn == "abs":
         return ts[0]

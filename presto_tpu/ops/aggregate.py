@@ -78,6 +78,10 @@ def _sum_type(t: Type) -> Type:
 
 VARIANCE_FNS = ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop")
 
+# two-argument moment statistics (AggregationUtils covariance/corr/
+# regression states): fn(y, x) with state (sx, sy, sxy, sxx, syy, n)
+COVAR_FNS = ("covar_pop", "covar_samp", "corr", "regr_slope", "regr_intercept")
+
 
 def state_types(agg: AggCall) -> List[Type]:
     """Column types of this aggregate's partial state."""
@@ -94,6 +98,10 @@ def state_types(agg: AggCall) -> List[Type]:
         return [DOUBLE, DOUBLE, BIGINT]  # sum, M2 (Σ(x-mean)²), count
     if agg.fn in ("bool_and", "bool_or", "every"):
         return [BIGINT, BIGINT]  # count of true, count of non-null
+    if agg.fn in COVAR_FNS:
+        return [DOUBLE, DOUBLE, DOUBLE, DOUBLE, DOUBLE, BIGINT]
+    if agg.fn == "checksum":
+        return [BIGINT]
     if agg.fn in ("min_by", "max_by"):
         # x-at-extreme, x-non-null flag, extreme key, count of valid keys
         return [t, BIGINT, agg.arg2.type, BIGINT]
@@ -149,8 +157,10 @@ def output_type(agg: AggCall) -> Type:
         return _sum_type(agg.arg.type)
     if agg.fn == "avg":
         return DOUBLE  # deviation: reference keeps decimal scale for avg(decimal)
-    if agg.fn in VARIANCE_FNS:
+    if agg.fn in VARIANCE_FNS or agg.fn in COVAR_FNS:
         return DOUBLE
+    if agg.fn == "checksum":
+        return BIGINT
     if agg.fn in ("bool_and", "bool_or", "every"):
         from presto_tpu.types import BOOLEAN
 
@@ -312,6 +322,40 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             t = _seg_sum((nonnull & data.astype(jnp.bool_)).astype(jnp.int64),
                          gid_nn, n + 1)[:n]
             out.append([t, cnt])
+        elif agg.fn in COVAR_FNS:
+            from presto_tpu.expr.compile import _to_double
+
+            x_data, x_valid = c.compile(agg.arg2)(page)
+            sel = rowsel & valid & x_valid
+            gid_s = jnp.where(sel, gid, n)
+            y = jnp.where(sel, _to_double(data, agg.arg.type), 0.0)
+            x = jnp.where(sel, _to_double(x_data, agg.arg2.type), 0.0)
+            out.append([
+                _gsum(ctx, x, gid_s, n),
+                _gsum(ctx, y, gid_s, n),
+                _gsum(ctx, x * y, gid_s, n),
+                _gsum(ctx, x * x, gid_s, n),
+                _gsum(ctx, y * y, gid_s, n),
+                _gsum(ctx, sel.astype(jnp.int64), gid_s, n),
+            ])
+        elif agg.fn == "checksum":
+            # order-independent wrapping sum of per-value hashes
+            # (CheckSumAggregation — the verifier's result digest)
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                lane = jax.lax.bitcast_convert_type(
+                    data.astype(jnp.float64), jnp.int64)
+            elif data.ndim > 1:
+                from presto_tpu.ops.rawstring import hash_bytes
+
+                lane = (hash_bytes(data.astype(jnp.uint8))
+                        if data.dtype == jnp.uint8
+                        else data[..., 0] * jnp.int64(1000003) + data[..., 1])
+            else:
+                lane = data.astype(jnp.int64)
+            h = _mix64(lane.astype(jnp.uint64)).astype(jnp.int64)
+            h = jnp.where(valid, h, jnp.int64(0x9E3779B97F4A7C15 - 2 ** 64))
+            h = jnp.where(rowsel, h, 0)
+            out.append([_gsum(ctx, h, jnp.where(rowsel, gid, n), n)])
         elif agg.fn in ("min_by", "max_by"):
             # two-phase coupled reduction: per-group extreme of the key,
             # then (any) x among the rows achieving it (reference:
@@ -524,6 +568,11 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
             out.append([s, m2, cnt])
         elif agg.fn in ("bool_and", "bool_or", "every"):
             out.append([_gsum(ctx, c, gid, n) for c in cols])
+        elif agg.fn in COVAR_FNS:
+            zero = [jnp.where(gid < n, c, jnp.zeros_like(c)) for c in cols]
+            out.append([_gsum(ctx, c, gid, n) for c in zero])
+        elif agg.fn == "checksum":
+            out.append([_gsum(ctx, jnp.where(gid < n, cols[0], 0), gid, n)])
         elif agg.fn in ("min_by", "max_by"):
             x_i, xv_i, y_i, c_i = cols
             sel = c_i > 0
@@ -688,6 +737,32 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
                 valid = cnt > 0
             out_v = jnp.sqrt(var) if agg.fn.startswith("stddev") else var
             blocks.append(Block(out_v, valid, t))
+        elif agg.fn in COVAR_FNS:
+            sx, sy, sxy, sxx, syy, cnt = cols
+            nf = jnp.maximum(cnt, 1).astype(jnp.float64)
+            cov = sxy / nf - (sx / nf) * (sy / nf)
+            varx = jnp.maximum(sxx / nf - (sx / nf) ** 2, 0.0)
+            vary = jnp.maximum(syy / nf - (sy / nf) ** 2, 0.0)
+            if agg.fn == "covar_pop":
+                v, ok = cov, cnt > 0
+            elif agg.fn == "covar_samp":
+                v = cov * nf / jnp.maximum(nf - 1, 1.0)
+                ok = cnt > 1
+            elif agg.fn == "corr":
+                denom = jnp.sqrt(varx * vary)
+                v = cov / jnp.where(denom == 0, 1.0, denom)
+                ok = (cnt > 1) & (denom > 0)
+            elif agg.fn == "regr_slope":
+                v = cov / jnp.where(varx == 0, 1.0, varx)
+                ok = (cnt > 1) & (varx > 0)
+            else:  # regr_intercept
+                slope = cov / jnp.where(varx == 0, 1.0, varx)
+                v = sy / nf - slope * (sx / nf)
+                ok = (cnt > 1) & (varx > 0)
+            blocks.append(Block(v, ok, t))
+        elif agg.fn == "checksum":
+            blocks.append(Block(cols[0].astype(jnp.int64),
+                                jnp.ones_like(cols[0], jnp.bool_), t))
         elif agg.fn in ("bool_and", "bool_or", "every"):
             trues, cnt = cols
             if agg.fn == "bool_or":

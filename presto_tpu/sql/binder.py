@@ -63,6 +63,8 @@ AGG_FUNCTIONS = {
     # two-level aggregation (see _rewrite_approx_distinct)
     "approx_distinct",
     "min_by", "max_by", "approx_percentile",
+    "covar_pop", "covar_samp", "corr", "regr_slope", "regr_intercept",
+    "checksum", "arbitrary", "count_if", "geometric_mean",
     "array_agg", "map_agg",
     # presto-ml analogs: sufficient-statistic training aggregates
     "learn_regressor", "learn_classifier",
@@ -86,7 +88,10 @@ WINDOW_FUNCTIONS = {
 # scalar builtins (reference: operator/scalar/ ~130 files; the engine's
 # set grows here + in expr/compile.py)
 SCALAR_FUNCTIONS = {
-    "abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10", "power", "pow",
+    "abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10", "log2", "power", "pow",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "degrees", "radians", "truncate",
+    "width_bucket", "is_nan", "is_finite", "pi", "e",
     "ceil", "ceiling", "floor", "round", "mod", "greatest", "least",
     "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
     "trim", "ltrim", "rtrim", "reverse", "substr",
@@ -1734,6 +1739,11 @@ class Binder:
                           "none_match") and len(e.args) == 2 \
                     and isinstance(e.args[1], ast.Lambda):
                 return self._bind_array_lambda(e, scope, agg)
+            if e.name in ("pi", "e") and not e.args:
+                import math as _math
+
+                return Literal(type=DOUBLE,
+                               value=_math.pi if e.name == "pi" else _math.e)
             if e.name == "index":
                 # teradata index(s, sub) = strpos (DateTimeFunctions.java
                 # analog in presto-teradata-functions)
@@ -2107,8 +2117,25 @@ class Binder:
         if e.star or (e.name == "count" and not e.args):
             a = AggCall(fn="count_star", arg=None, type=BIGINT)
             return agg.agg_ref(a)
+        if e.name == "arbitrary":
+            # any value per group: the max of the group qualifies
+            # (ArbitraryAggregation semantics are "some input value")
+            return self._bind_agg_call(
+                ast.FuncCall("max", e.args, distinct=e.distinct), scope, agg)
+        if e.name == "count_if":
+            if len(e.args) != 1:
+                raise BindError("count_if takes one argument")
+            pred = self._bind(e.args[0], scope)
+            a = AggCall(fn="count_star", arg=None, type=BIGINT, filter=pred)
+            return agg.agg_ref(a)
+        if e.name == "geometric_mean":
+            inner = self._bind_agg_call(
+                ast.FuncCall("avg", (ast.FuncCall("ln", e.args),)), scope, agg)
+            return call("exp", inner)
         fn, distinct = e.name, e.distinct
         if fn in ("min_by", "max_by", "approx_percentile", "map_agg",
+                  "covar_pop", "covar_samp", "corr", "regr_slope",
+                  "regr_intercept",
                   "learn_regressor", "learn_classifier"):
             if len(e.args) != 2:
                 raise BindError(f"aggregate {fn} takes two arguments")

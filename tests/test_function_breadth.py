@@ -313,3 +313,83 @@ def test_cross_dict_eq_with_derived(env):
     actual = runner.execute(sql).rows
     expected = run_oracle(oracle, sql)
     assert_rows_match(actual, expected, ordered=False)
+
+
+def test_statistical_aggregates_vs_numpy():
+    """covar/corr/regr two-argument moments (AggregationUtils states)."""
+    import numpy as np
+
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    got = r.execute(
+        "SELECT covar_pop(l_extendedprice, l_quantity), "
+        "covar_samp(l_extendedprice, l_quantity), "
+        "corr(l_extendedprice, l_quantity), "
+        "regr_slope(l_extendedprice, l_quantity), "
+        "regr_intercept(l_extendedprice, l_quantity) FROM lineitem").rows[0]
+    raw = r.execute(
+        "SELECT l_quantity, l_extendedprice FROM lineitem").rows
+    x = np.asarray([float(a) for a, _ in raw])
+    y = np.asarray([float(b) for _, b in raw])
+    n = len(x)
+    cov_pop = ((x - x.mean()) * (y - y.mean())).mean()
+    assert float(got[0]) == pytest.approx(cov_pop, rel=1e-9)
+    assert float(got[1]) == pytest.approx(cov_pop * n / (n - 1), rel=1e-9)
+    assert float(got[2]) == pytest.approx(np.corrcoef(x, y)[0, 1], rel=1e-9)
+    slope = cov_pop / x.var()
+    assert float(got[3]) == pytest.approx(slope, rel=1e-9)
+    assert float(got[4]) == pytest.approx(y.mean() - slope * x.mean(), rel=1e-9)
+
+
+def test_checksum_arbitrary_count_if_geomean():
+    import numpy as np
+
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    # checksum is order-independent and deterministic
+    a = r.execute("SELECT checksum(l_orderkey) FROM lineitem").rows
+    b = r.execute("SELECT checksum(l_orderkey) FROM "
+                  "(SELECT l_orderkey FROM lineitem ORDER BY l_orderkey DESC)").rows
+    assert a == b and isinstance(a[0][0], int)
+    # differs when the multiset differs
+    c = r.execute("SELECT checksum(l_orderkey) FROM lineitem "
+                  "WHERE l_orderkey > 5").rows
+    assert c != a
+    assert r.execute("SELECT count_if(l_quantity > 25), "
+                     "count(CASE WHEN l_quantity > 25 THEN 1 END) "
+                     "FROM lineitem").rows[0][0] == r.execute(
+        "SELECT count(*) FROM lineitem WHERE l_quantity > 25").rows[0][0]
+    flags = {f for (f,) in r.execute(
+        "SELECT DISTINCT l_returnflag FROM lineitem").rows}
+    assert r.execute("SELECT arbitrary(l_returnflag) FROM lineitem"
+                     ).rows[0][0] in flags
+    qty = [float(q) for (q,) in r.execute(
+        "SELECT l_quantity FROM lineitem").rows]
+    expect = float(np.exp(np.mean(np.log(qty))))
+    got = r.execute("SELECT geometric_mean(l_quantity) FROM lineitem").rows[0][0]
+    assert got == pytest.approx(expect, rel=1e-9)
+
+
+def test_trig_and_math_sweep():
+    import math
+
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    rows = r.execute(
+        "SELECT sin(pi()/2), cos(0), tan(0), atan2(1, 1), log2(8), "
+        "degrees(pi()), radians(180e0), truncate(-2.7e0), "
+        "width_bucket(3.5, 0, 10, 5), is_nan(sqrt(-1e0)), is_finite(1e0), "
+        "sinh(0), cosh(0), tanh(0), e()").rows[0]
+    assert rows[0] == pytest.approx(1.0)
+    assert rows[3] == pytest.approx(math.pi / 4)
+    assert rows[4] == 3.0
+    assert rows[5] == pytest.approx(180.0)
+    assert rows[6] == pytest.approx(math.pi)
+    assert rows[7] == -2.0
+    assert rows[8] == 2
+    assert rows[9] is True and rows[10] is True
+    assert rows[12] == 1.0
+    assert rows[14] == pytest.approx(math.e)
