@@ -400,11 +400,14 @@ class LocalRunner:
             return
 
         if isinstance(node, ValuesNode):
-            cols = [
-                np.asarray([r[i] for r in node.rows], dtype=t.np_dtype)
-                for i, t in enumerate(node.types)
-            ]
-            yield Page.from_arrays(cols, node.types)
+            cols, valids = [], []
+            for i, t in enumerate(node.types):
+                raw = [r[i] for r in node.rows]
+                valids.append(np.asarray([v is not None for v in raw], np.bool_))
+                cols.append(np.asarray([0 if v is None else v for v in raw],
+                                       dtype=t.np_dtype))
+            yield Page.from_arrays(cols, node.types, valids=valids,
+                                   dictionaries=node.dictionaries)
             return
 
         if isinstance(node, PrecomputedNode):
@@ -1037,6 +1040,13 @@ class LocalRunner:
             else:
                 acc = fold_fn(acc, p)
         if acc is None:
+            if not node.group_exprs:
+                # global aggregation over zero input pages still emits
+                # its one row (count 0, other aggregates NULL) — the
+                # SQL empty-input contract
+                empty = Page.empty(node.source.output_types, 1)
+                return grouped_aggregate(empty, [], list(node.aggs), 1,
+                                         mode="single")
             return self._groupid_empty_fixup(node, Page.empty(node.output_types, max(mg, 1)))
         out = final_fn(acc)
         self._check_overflow(node, out, mg)

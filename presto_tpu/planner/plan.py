@@ -393,15 +393,21 @@ class LimitNode(PlanNode):
 
 @dataclasses.dataclass(eq=False)
 class ValuesNode(PlanNode):
-    """Literal rows (ValuesNode.java analog)."""
+    """Literal rows (ValuesNode.java analog).  String columns store
+    dictionary codes with the Dictionary in ``dictionaries``."""
 
     names: List[str]
     types: List[Type]
     rows: List[tuple]
+    dictionaries: Optional[List[Optional[Dictionary]]] = None
 
     @property
     def channels(self) -> List[Channel]:
-        return [Channel(n, t) for n, t in zip(self.names, self.types)]
+        dicts = self.dictionaries or [None] * len(self.names)
+        return [
+            Channel(n, t, d, (0, len(d) - 1) if d is not None else None)
+            for n, t, d in zip(self.names, self.types, dicts)
+        ]
 
 
 @dataclasses.dataclass(eq=False)

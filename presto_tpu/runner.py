@@ -104,7 +104,7 @@ class QueryRunner:
 
         stmt = parse_statement(sql)
 
-        if isinstance(stmt, (ast.Query, ast.Union)):
+        if isinstance(stmt, (ast.Query, ast.Union, ast.With)):
             from presto_tpu.events import new_trace_token
 
             qid = query_id or new_query_id()
@@ -247,6 +247,9 @@ class QueryRunner:
             rows = [(c.name, repr(c.type)) for c in handle.columns]
             return MaterializedResult(["column", "type"], [VARCHAR, VARCHAR], rows)
 
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt, query_id=query_id)
+
         if isinstance(stmt, ast.ShowTables):
             names = sorted(
                 t
@@ -332,6 +335,46 @@ class QueryRunner:
                 conn.append_pages(table, pages)
         self._invalidate_plans()
         return MaterializedResult(["rows"], [BIGINT], [(rows,)])
+
+    def _delete(self, stmt, query_id=None) -> MaterializedResult:
+        """DELETE FROM t [WHERE pred] (DeleteOperator /
+        MetadataDeleteOperator analog): the surviving rows re-select
+        through the engine (NOT pred) and overwrite the table pages
+        atomically — connector-side delete-by-rewrite, the model the
+        memory connector supports."""
+        import numpy as np
+
+        handle = self.catalog.resolve(stmt.table)
+        self.access_control.check_can_write(self.session.user, handle.table)
+        conn = self.catalog.connector(handle.connector_name)
+        if not hasattr(conn, "create_table"):
+            raise ValueError(f"connector {handle.connector_name} is read-only")
+        self._check_tx_writable(handle.connector_name, conn)
+        before = conn.row_count(handle.table)
+        if stmt.where is None:
+            keep_sql_pred = None
+            survivors = []
+        else:
+            # survivors: NOT pred OR pred IS NULL (NULL predicates keep
+            # the row, matching DELETE's true-only semantics)
+            keep = ast.Query(
+                select=(ast.SelectItem(ast.Star()),),
+                from_=(ast.TableRef(handle.table),),
+                where=ast.Binary("or", ast.Unary("not", stmt.where),
+                                 ast.IsNull(stmt.where, False)),
+            )
+            plan = self.binder.plan_ast(keep)
+            page = self.executor.run_to_page(plan, query_id=query_id).compact_host()
+            survivors = [page]
+        schema = conn.schema(handle.table)
+        op_args = (handle.table, schema, survivors,
+                   {c.name: c.domain for c in handle.columns})
+        if self._stage_write(handle.connector_name, conn, "create_table", *op_args):
+            return MaterializedResult(["rows"], [BIGINT], [(-1,)])
+        conn.create_table(*op_args)
+        self._invalidate_plans()
+        after = conn.row_count(handle.table)
+        return MaterializedResult(["rows"], [BIGINT], [(before - after,)])
 
     def _write_target(self, name: str):
         """(connector, bare table) for a CTAS target: a 'catalog.table'

@@ -376,6 +376,34 @@ class Rollback(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class With(Node):
+    """WITH name AS (query), ... body (sql/tree/With.java + WithQuery;
+    CTEs expand by inline substitution at planning, like the
+    reference's pre-iterative expansion)."""
+
+    ctes: Tuple[Tuple[str, "Node"], ...] = ()
+    body: "Node" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuesRel(Node):
+    """VALUES (r1...), (r2...) as a relation (sql/tree/Values.java)."""
+
+    rows: Tuple[Tuple["Node", ...], ...] = ()
+    alias: Optional[str] = None
+    column_names: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    """DELETE FROM t [WHERE pred] (sql/tree/Delete.java;
+    operator/DeleteOperator.java)."""
+
+    table: str = ""
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Union(Node):
     left: Node  # Query or Union
     right: Node

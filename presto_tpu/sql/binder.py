@@ -31,6 +31,7 @@ import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from presto_tpu.catalog import Catalog
+from presto_tpu.page import Dictionary
 from presto_tpu.expr.ir import AggCall, Call, ColumnRef, Expr, Literal, call, infer_type
 from presto_tpu.planner.plan import (
     AggregationNode,
@@ -336,6 +337,8 @@ class Binder:
         self._scalar_refs: Dict[int, ColumnRef] = {}
         # UNNEST relations of the FROM clause currently being flattened
         self._from_unnests: List[ast.Unnest] = []
+        # in-scope CTE definitions (WITH name AS (...)): name -> query ast
+        self._ctes: Dict[str, ast.Node] = {}
         # CBO stats (cost/StatsCalculator.java analog); memo is safe to
         # share across plan() calls since plan nodes are identity-keyed
         from presto_tpu.planner.stats import StatsCalculator
@@ -418,6 +421,16 @@ class Binder:
         walk(root)
 
     def _plan_query_like(self, q: ast.Node) -> Tuple[PlanNode, List[str]]:
+        if isinstance(q, ast.With):
+            # CTEs expand by name substitution: TableRef resolution
+            # consults the scoped registry first (sql/tree/With.java)
+            saved = dict(self._ctes)
+            try:
+                for name, sub in q.ctes:
+                    self._ctes[name.lower()] = sub
+                return self._plan_query_like(q.body)
+            finally:
+                self._ctes = saved
         if isinstance(q, ast.Union):
             return self._plan_union(q)
         return self._plan_query(q)
@@ -490,10 +503,20 @@ class Binder:
     # ==================================================================
     def _plan_relation(self, rel: ast.Node) -> Tuple[PlanNode, Scope]:
         if isinstance(rel, ast.TableRef):
+            cte = self._ctes.get(rel.name.lower())
+            if cte is not None:
+                node, names = self._plan_query_like(cte)
+                qual = rel.alias or rel.name
+                scope = Scope(
+                    [ScopeCol(qual, n, c) for n, c in zip(names, node.channels)]
+                )
+                return node, scope
             handle = self.catalog.resolve(rel.name)
             scan = TableScanNode(handle, list(range(len(handle.columns))))
             # a catalog-qualified name aliases to its bare table name
             return scan, Scope.of(scan, rel.alias or rel.name.split(".")[-1])
+        if isinstance(rel, ast.ValuesRel):
+            return self._plan_values(rel)
         if isinstance(rel, ast.SubqueryRel):
             node, names = self._plan_query_like(rel.query)
             scope = Scope(
@@ -577,6 +600,66 @@ class Binder:
             names.add(cur.handle.columns[cur.columns[idx]].name)
         k = len(names)
         return 0 < k <= len(so) and set(so[:k]) == names
+
+    def _plan_values(self, rel: ast.ValuesRel) -> Tuple[PlanNode, Scope]:
+        """VALUES rows -> ValuesNode (sql/tree/Values.java): literal
+        cells bind standalone; column types are the per-position common
+        supertypes with NULL literals adopting them."""
+        empty = Scope([])
+        bound = [[self._bind(c, empty) for c in row] for row in rel.rows]
+        if not bound:
+            raise BindError("empty VALUES")
+        arity = len(bound[0])
+        for row in bound:
+            if len(row) != arity:
+                raise BindError("VALUES rows differ in arity")
+            for cell in row:
+                if not isinstance(cell, Literal):
+                    raise BindError("VALUES cells must be literals")
+        types: List[Type] = []
+        for j in range(arity):
+            t = None
+            for row in bound:
+                cell = row[j]
+                if cell.value is None:
+                    continue
+                t = cell.type if t is None else common_super_type(t, cell.type)
+            types.append(t if t is not None else BIGINT)
+        names = (list(rel.column_names) if rel.column_names
+                 else [f"_col{j}" for j in range(arity)])
+        if len(names) != arity:
+            raise BindError("VALUES alias declares wrong column count")
+
+        # string columns dictionary-encode over their distinct values
+        dictionaries: List = []
+        for j, t in enumerate(types):
+            if t.is_string:
+                values = sorted({row[j].value for row in bound
+                                 if row[j].value is not None})
+                dictionaries.append(Dictionary(values))
+            else:
+                dictionaries.append(None)
+
+        def cell_value(cell: Literal, t: Type, d):
+            if cell.value is None:
+                return None
+            v = cell.value
+            if d is not None:
+                return d.code_of(str(v))
+            if t.is_decimal and cell.type.is_decimal:
+                return v * 10 ** ((t.scale or 0) - (cell.type.scale or 0))
+            if t.name == "double" and cell.type.is_decimal:
+                return v / 10 ** (cell.type.scale or 0)
+            return v
+
+        rows = [
+            tuple(cell_value(c, t, d)
+                  for c, t, d in zip(row, types, dictionaries))
+            for row in bound
+        ]
+        node = ValuesNode(names=names, types=types, rows=rows,
+                          dictionaries=dictionaries)
+        return node, Scope.of(node, rel.alias)
 
     def _names_resolvable(self, e: ast.Node, scope: Scope) -> bool:
         """True if every free Identifier in ``e`` resolves in ``scope``

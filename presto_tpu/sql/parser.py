@@ -132,8 +132,24 @@ class Parser:
         return q
 
     def _query(self) -> ast.Node:
-        """query := select_query (UNION [ALL|DISTINCT] select_query)*
-        with ORDER BY/LIMIT binding to the union result."""
+        """query := [WITH ctes] select_query
+        (UNION [ALL|DISTINCT] select_query)* with ORDER BY/LIMIT
+        binding to the union result."""
+        if self.tok.kind == "ident" and self.tok.value.lower() == "with" \
+                and self.tokens[self.i + 1].kind == "ident":
+            self.i += 1
+            ctes = []
+            while True:
+                name = self.ident()
+                self.expect("as")
+                self.expect("(")
+                sub = self._query()
+                self.expect(")")
+                ctes.append((name, sub))
+                if not self.accept(","):
+                    break
+            body = self._query()
+            return ast.With(tuple(ctes), body)
         q = self._select_query()
         while self.accept("union"):
             all_ = bool(self.accept("all"))
@@ -327,6 +343,30 @@ class Parser:
 
     def _relation_primary(self) -> ast.Node:
         t = self.tok
+        if t.kind == "ident" and t.value.lower() == "values":
+            self.i += 1
+            rows = []
+            while True:
+                self.expect("(")
+                row = [self._expr()]
+                while self.accept(","):
+                    row.append(self._expr())
+                self.expect(")")
+                rows.append(tuple(row))
+                if not self.accept(","):
+                    break
+            alias = None
+            cols = []
+            if self.accept("as"):
+                alias = self.ident()
+            elif self.tok.kind == "ident":
+                alias = self.ident()
+            if alias is not None and self.accept("("):
+                cols.append(self.ident())
+                while self.accept(","):
+                    cols.append(self.ident())
+                self.expect(")")
+            return ast.ValuesRel(tuple(rows), alias, tuple(cols))
         if t.kind == "ident" and t.value.lower() == "unnest" and self.peek2("("):
             self.i += 2  # 'unnest' '('
             args = [self._expr()]
@@ -367,6 +407,24 @@ class Parser:
                 return ast.SubqueryRel(q, alias)
             rel = self._relation()
             self.expect(")")
+            if isinstance(rel, ast.ValuesRel):
+                # (VALUES ...) AS t (c1, c2): the alias binds the rows
+                alias = None
+                cols: List[str] = []
+                if self.accept("as"):
+                    alias = self.ident()
+                elif self.tok.kind == "ident":
+                    alias = self.ident()
+                if alias is not None and self.accept("("):
+                    cols.append(self.ident())
+                    while self.accept(","):
+                        cols.append(self.ident())
+                    self.expect(")")
+                if alias is not None:
+                    import dataclasses as _dc
+
+                    rel = _dc.replace(rel, alias=alias,
+                                      column_names=tuple(cols) or rel.column_names)
             return rel
         name = _qualified_name(self)  # catalog-qualified: catalog.table
         alias = None
@@ -731,6 +789,12 @@ def parse_statement(sql: str) -> ast.Node:
         p.expect("table")
         name = _qualified_name(p)
         return _finish(p, ast.DropTable(name))
+    if p.accept_word("delete"):
+        if p.accept("from") is None:
+            p.expect("from")
+        name = _qualified_name(p)
+        where = p._expr() if p.accept("where") else None
+        return _finish(p, ast.Delete(name, where))
     if p.accept_word("start"):
         if p.accept_word("transaction") is None:
             raise SyntaxError("expected TRANSACTION after START")

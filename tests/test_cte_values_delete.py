@@ -1,0 +1,76 @@
+"""WITH (CTEs), VALUES relations, DELETE.
+
+Reference analogs: sql/tree/With.java + WithQuery (inline expansion),
+sql/tree/Values.java, sql/tree/Delete.java + DeleteOperator.
+"""
+
+import pytest
+
+from presto_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(sf=0.001)
+
+
+def test_cte_basic(runner):
+    rows = runner.execute(
+        "WITH big AS (SELECT * FROM nation WHERE n_regionkey = 1), "
+        "cnt AS (SELECT count(*) AS c FROM big) SELECT c FROM cnt").rows
+    assert rows == [(5,)]
+
+
+def test_cte_referenced_twice(runner):
+    rows = runner.execute(
+        "WITH r AS (SELECT n_regionkey AS k FROM nation) "
+        "SELECT count(*) FROM r a, r b WHERE a.k = b.k").rows
+    expect = runner.execute(
+        "SELECT count(*) FROM nation a, nation b "
+        "WHERE a.n_regionkey = b.n_regionkey").rows
+    assert rows == expect
+
+
+def test_cte_with_aggregation_and_shadowing(runner):
+    rows = runner.execute(
+        "WITH x AS (SELECT n_regionkey AS k, count(*) AS c FROM nation "
+        "GROUP BY n_regionkey) SELECT sum(c) FROM x WHERE k <= 2").rows
+    assert rows == [(15,)]
+    # a CTE name shadows a catalog table
+    rows = runner.execute(
+        "WITH nation AS (SELECT 1 AS n) SELECT count(*) FROM nation").rows
+    assert rows == [(1,)]
+
+
+def test_values_relation(runner):
+    rows = runner.execute(
+        "SELECT a, b FROM (VALUES (1, 'x'), (2, 'y'), (3, NULL)) AS t (a, b) "
+        "ORDER BY a").rows
+    assert rows == [(1, "x"), (2, "y"), (3, None)]
+    assert runner.execute(
+        "SELECT sum(x) FROM (VALUES (1.5), (2.5)) AS v (x)").rows == [(4.0,)]
+    # joins against real tables
+    rows = runner.execute(
+        "SELECT n_name FROM nation JOIN (VALUES (0), (3)) AS k (rk) "
+        "ON n_nationkey = rk ORDER BY n_name").rows
+    assert rows == [("ALGERIA",), ("CANADA",)]
+
+
+def test_delete(runner):
+    runner.execute("CREATE TABLE del_t AS SELECT n_nationkey AS k FROM nation")
+    assert runner.execute("DELETE FROM del_t WHERE k >= 20").rows == [(5,)]
+    assert runner.execute("SELECT count(*) FROM del_t").rows == [(20,)]
+    # re-delete is a no-op; full delete empties
+    assert runner.execute("DELETE FROM del_t WHERE k >= 20").rows == [(0,)]
+    assert runner.execute("DELETE FROM del_t").rows == [(20,)]
+    assert runner.execute("SELECT count(*) FROM del_t").rows == [(0,)]
+    runner.execute("DROP TABLE del_t")
+
+
+def test_delete_null_predicate_keeps_row(runner):
+    runner.execute("CREATE TABLE del_n AS SELECT CASE WHEN n_nationkey < 5 "
+                   "THEN n_nationkey END AS k FROM nation")
+    # k IS NULL rows survive: DELETE removes only TRUE-predicate rows
+    assert runner.execute("DELETE FROM del_n WHERE k < 3").rows == [(3,)]
+    assert runner.execute("SELECT count(*) FROM del_n").rows == [(22,)]
+    runner.execute("DROP TABLE del_n")
