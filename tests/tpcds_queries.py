@@ -12,6 +12,42 @@ lacks (ROLLUP -> UNION ALL expansion).
 """
 
 QUERIES = {
+    # official Q1 shape: CTE referenced twice, one reference correlated
+    2: """
+with wscs as (
+    select sold_date_sk, sales_price
+    from (select ws_sold_date_sk as sold_date_sk,
+                 ws_ext_sales_price as sales_price
+          from web_sales
+          union all
+          select cs_sold_date_sk, cs_ext_sales_price
+          from catalog_sales) x
+)
+select d_year, d_dow, sum(sales_price) as tot
+from wscs, date_dim
+where sold_date_sk = d_date_sk
+group by d_year, d_dow
+order by d_year, d_dow
+limit 50
+""",
+    # Q1 in its official WITH form (the non-CTE rewrite is key 1)
+    30: """
+with customer_total_return as (
+    select sr_customer_sk as ctr_customer_sk,
+           sr_store_sk as ctr_store_sk,
+           sum(sr_return_amt) as ctr_total_return
+    from store_returns, date_dim
+    where sr_returned_date_sk = d_date_sk and d_year = 1998
+    group by sr_customer_sk, sr_store_sk
+)
+select ctr_customer_sk, ctr_total_return
+from customer_total_return ctr1
+where ctr_total_return > (select avg(ctr_total_return) * 1.2
+                          from customer_total_return ctr2
+                          where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+order by ctr_customer_sk, ctr_total_return
+limit 100
+""",
     # correlated scalar subquery: customers returning > 1.2x store average
     1: """
 select ctr_customer_sk, ctr_total
