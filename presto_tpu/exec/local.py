@@ -563,11 +563,12 @@ class LocalRunner:
             kd = node.key_domains
             left_keys = list(node.left_keys)
             kind = node.kind
+            ns = node.null_safe_keys
 
             def probe_stage(p, c):
                 return probe_join(
                     c[key], inner(p, c), left_keys, key_domains=kd,
-                    kind=kind, build_output=build_output,
+                    kind=kind, build_output=build_output, null_safe=ns,
                 )
 
             return probe_stage
@@ -622,10 +623,12 @@ class LocalRunner:
                 if fn is None:
                     right_keys = list(node.right_keys)
                     kd = node.key_domains
+                    ns = getattr(node, "null_safe_keys", False)
 
                     def make_build(ps):
                         return build_join(
-                            concat_pages_device(list(ps)), right_keys, key_domains=kd
+                            concat_pages_device(list(ps)), right_keys,
+                            key_domains=kd, null_safe=ns,
                         )
 
                     fn = jax.jit(make_build) if self.jit else make_build

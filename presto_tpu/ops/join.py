@@ -61,30 +61,36 @@ def build_join(
     page: Page,
     key_exprs: Sequence[Expr],
     key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
+    null_safe: bool = False,
 ) -> JoinBuild:
+    """``null_safe``: NULL keys match each other (IS NOT DISTINCT FROM
+    — the INTERSECT/EXCEPT comparison; default SQL joins drop them)."""
     c = ExprCompiler.for_page(page)
     kd = [c.compile(e)(page) for e in key_exprs]
     datas = [d for d, _ in kd]
     valids = [v for _, v in kd]
     key, _ = pack_or_hash_keys(datas, valids, key_domains)
-    # NULL keys never participate: exclude rows with any null key
-    all_valid = page.row_mask
-    for v in valids:
-        all_valid = all_valid & v
-    key = jnp.where(all_valid, key, jnp.iinfo(key.dtype).max)
+    live = page.row_mask
+    if not null_safe:
+        # NULL keys never participate: exclude rows with any null key
+        for v in valids:
+            live = live & v
+    key = jnp.where(live, key, jnp.iinfo(key.dtype).max)
     order = jnp.argsort(key)
     return JoinBuild(key[order], order.astype(jnp.int32), page)
 
 
-def _probe_keys(page: Page, key_exprs: Sequence[Expr], key_domains):
+def _probe_keys(page: Page, key_exprs: Sequence[Expr], key_domains,
+                null_safe: bool = False):
     c = ExprCompiler.for_page(page)
     kd = [c.compile(e)(page) for e in key_exprs]
     datas = [d for d, _ in kd]
     valids = [v for _, v in kd]
     key, _ = pack_or_hash_keys(datas, valids, key_domains)
     ok = page.row_mask
-    for v in valids:
-        ok = ok & v
+    if not null_safe:
+        for v in valids:
+            ok = ok & v
     # distinct sentinel from the build's (max): never matches build keys
     return jnp.where(ok, key, jnp.iinfo(key.dtype).max - 1), ok
 
@@ -96,6 +102,7 @@ def probe_join(
     key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
     kind: str = "inner",
     build_output: Optional[Sequence[int]] = None,
+    null_safe: bool = False,
 ) -> Page:
     """Probe-aligned join for unique (or first-match) build keys.
 
@@ -104,7 +111,7 @@ def probe_join(
     (build_output indexes into build.page.blocks; default all).
     semi/anti emit probe blocks only, with the row mask filtered.
     """
-    key, _ = _probe_keys(probe, probe_key_exprs, key_domains)
+    key, _ = _probe_keys(probe, probe_key_exprs, key_domains, null_safe)
     pos = jnp.searchsorted(build.sorted_keys, key)
     pos_c = jnp.clip(pos, 0, build.capacity - 1)
     match = (build.sorted_keys[pos_c] == key) & probe.row_mask

@@ -239,6 +239,9 @@ class JoinNode(PlanNode):
     # lookup SPI instead of a full scan (operator/index/IndexLoader +
     # planner IndexJoinOptimizer.java)
     use_index: bool = False
+    # NULL keys match each other (IS NOT DISTINCT FROM): the
+    # INTERSECT/EXCEPT lowering's comparison semantics
+    null_safe_keys: bool = False
 
     @property
     def sources(self):

@@ -104,7 +104,7 @@ class QueryRunner:
 
         stmt = parse_statement(sql)
 
-        if isinstance(stmt, (ast.Query, ast.Union, ast.With)):
+        if isinstance(stmt, (ast.Query, ast.Union, ast.With, ast.SetOp)):
             from presto_tpu.events import new_trace_token
 
             qid = query_id or new_query_id()

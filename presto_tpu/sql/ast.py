@@ -413,6 +413,19 @@ class Union(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class SetOp(Node):
+    """INTERSECT / EXCEPT (sql/tree/Intersect.java, Except.java) —
+    DISTINCT semantics (the reference's ALL variants are unsupported
+    there too at 0.208 for except/intersect hash planning)."""
+
+    kind: str = "intersect"  # intersect | except
+    left: Node = None
+    right: Node = None
+    order_by: Tuple["OrderItem", ...] = ()
+    limit: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Query(Node):
     select: Tuple[SelectItem, ...]
     distinct: bool = False

@@ -433,7 +433,65 @@ class Binder:
                 self._ctes = saved
         if isinstance(q, ast.Union):
             return self._plan_union(q)
+        if isinstance(q, ast.SetOp):
+            return self._plan_setop(q)
         return self._plan_query(q)
+
+    def _plan_setop(self, q: ast.SetOp) -> Tuple[PlanNode, List[str]]:
+        """INTERSECT -> distinct(left) SEMI-joined to right on every
+        column; EXCEPT -> ANTI join (the reference lowers through
+        SetOperationNodeTranslator to the same semi/anti shapes).
+        NULLs compare equal, per set-operation semantics — the join
+        key packing already treats NULL keys as one class."""
+        lnode, lnames = self._plan_query_like(q.left)
+        rnode, rnames = self._plan_query_like(q.right)
+        if len(lnode.channels) != len(rnode.channels):
+            raise BindError(f"{q.kind.upper()} arms have different column counts")
+        targets = [
+            common_super_type(a.type, b.type)
+            for a, b in zip(lnode.channels, rnode.channels)
+        ]
+        lnode = self._coerce_columns(lnode, targets, lnames)
+        rnode = self._coerce_columns(rnode, targets, lnames)
+        distinct_left = AggregationNode(
+            lnode,
+            [ColumnRef(type=c.type, index=i) for i, c in enumerate(lnode.channels)],
+            lnames, [], [],
+            max_groups=self._distinct_capacity(lnode),
+        )
+        join = JoinNode(
+            left=distinct_left, right=rnode,
+            left_keys=[ColumnRef(type=c.type, index=i)
+                       for i, c in enumerate(distinct_left.channels)],
+            right_keys=[ColumnRef(type=c.type, index=i)
+                        for i, c in enumerate(rnode.channels)],
+            kind="semi" if q.kind == "intersect" else "anti",
+            null_safe_keys=True,  # set-op rows compare IS NOT DISTINCT FROM
+        )
+        node: PlanNode = join
+        names = lnames
+        if q.order_by:
+            order_channels = []
+            for o in q.order_by:
+                e = o.expr
+                if isinstance(e, ast.NumberLit):
+                    i = int(e.text) - 1
+                elif isinstance(e, ast.Identifier) and e.name in names:
+                    i = names.index(e.name)
+                else:
+                    raise BindError(
+                        f"{q.kind.upper()} ORDER BY must use output names or ordinals")
+                order_channels.append(ColumnRef(type=node.channels[i].type, index=i))
+            asc = [o.ascending for o in q.order_by]
+            nf = [o.nulls_first if o.nulls_first is not None else (not o.ascending)
+                  for o in q.order_by]
+            if q.limit is not None:
+                node = TopNNode(node, order_channels, asc, q.limit, nf)
+            else:
+                node = SortNode(node, order_channels, asc, nf)
+        elif q.limit is not None:
+            node = LimitNode(node, q.limit)
+        return node, names
 
     def _plan_union(self, u: ast.Union) -> Tuple[PlanNode, List[str]]:
         from presto_tpu.planner.plan import UnionNode

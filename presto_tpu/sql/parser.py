@@ -73,6 +73,10 @@ def tokenize(sql: str) -> List[Token]:
     return out
 
 
+# non-reserved words that end an expression/relation rather than alias it
+_NON_ALIAS_WORDS = {"intersect", "except"}
+
+
 class Parser:
     def __init__(self, sql: str):
         self.tokens = tokenize(sql)
@@ -110,6 +114,15 @@ class Parser:
         if t.kind in ("keyword", "ident") and t.value.lower() in vals:
             self.i += 1
             return t.value.lower()
+        return None
+
+    def _implicit_alias(self) -> Optional[str]:
+        """Consume a bare identifier as an alias unless it is a
+        non-reserved clause word (INTERSECT/EXCEPT)."""
+        t = self.tok
+        if t.kind == "ident" and t.value.lower() not in _NON_ALIAS_WORDS:
+            self.i += 1
+            return t.value
         return None
 
     def ident(self) -> str:
@@ -150,22 +163,33 @@ class Parser:
                     break
             body = self._query()
             return ast.With(tuple(ctes), body)
-        q = self._select_query()
+        q = self._set_term()
         while self.accept("union"):
             all_ = bool(self.accept("all"))
             if not all_:
                 self.accept("distinct")
             distinct = not all_
-            right = self._select_query()
-            # hoist trailing order/limit from the right arm to the union
-            order_by, limit = right.order_by, right.limit
-            right = ast.Query(
-                select=right.select, distinct=right.distinct, from_=right.from_,
-                where=right.where, group_by=right.group_by, having=right.having,
-            )
+            right = self._set_term()
+            right, order_by, limit = _hoist_order_limit(right)
             q = ast.Union(left=q, right=right, distinct=distinct,
                           order_by=order_by, limit=limit)
         return q
+
+    def _set_term(self) -> ast.Node:
+        """INTERSECT/EXCEPT bind tighter than UNION (standard
+        precedence; SqlBase.g4 queryTerm ladder)."""
+        q = self._select_query()
+        while True:
+            kind = self.accept_word("intersect", "except")
+            if kind is None:
+                return q
+            self.accept("distinct")
+            if self.accept("all"):
+                raise SyntaxError(f"{kind.upper()} ALL unsupported")
+            right = self._select_query()
+            right, order_by, limit = _hoist_order_limit(right)
+            q = ast.SetOp(kind=kind, left=q, right=right,
+                          order_by=order_by, limit=limit)
 
     def _select_query(self) -> ast.Query:
         self.expect("select")
@@ -283,8 +307,8 @@ class Parser:
         alias = None
         if self.accept("as"):
             alias = self.ident()
-        elif self.tok.kind == "ident":
-            alias = self.ident()
+        else:
+            alias = self._implicit_alias()
         return ast.SelectItem(e, alias)
 
     def _order_item(self) -> ast.OrderItem:
@@ -359,8 +383,8 @@ class Parser:
             cols = []
             if self.accept("as"):
                 alias = self.ident()
-            elif self.tok.kind == "ident":
-                alias = self.ident()
+            else:
+                alias = self._implicit_alias()
             if alias is not None and self.accept("("):
                 cols.append(self.ident())
                 while self.accept(","):
@@ -382,18 +406,13 @@ class Parser:
             cols: List[str] = []
             if self.accept("as"):
                 alias = self.ident()
-                if self.accept("("):
+            else:
+                alias = self._implicit_alias()
+            if alias is not None and self.accept("("):
+                cols.append(self.ident())
+                while self.accept(","):
                     cols.append(self.ident())
-                    while self.accept(","):
-                        cols.append(self.ident())
-                    self.expect(")")
-            elif self.tok.kind == "ident":
-                alias = self.ident()
-                if self.accept("("):
-                    cols.append(self.ident())
-                    while self.accept(","):
-                        cols.append(self.ident())
-                    self.expect(")")
+                self.expect(")")
             return ast.Unnest(tuple(args), ordinality, alias, tuple(cols))
         if self.accept("("):
             if self.peek("select"):
@@ -402,8 +421,8 @@ class Parser:
                 alias = None
                 if self.accept("as"):
                     alias = self.ident()
-                elif self.tok.kind == "ident":
-                    alias = self.ident()
+                else:
+                    alias = self._implicit_alias()
                 return ast.SubqueryRel(q, alias)
             rel = self._relation()
             self.expect(")")
@@ -413,8 +432,8 @@ class Parser:
                 cols: List[str] = []
                 if self.accept("as"):
                     alias = self.ident()
-                elif self.tok.kind == "ident":
-                    alias = self.ident()
+                else:
+                    alias = self._implicit_alias()
                 if alias is not None and self.accept("("):
                     cols.append(self.ident())
                     while self.accept(","):
@@ -430,8 +449,8 @@ class Parser:
         alias = None
         if self.accept("as"):
             alias = self.ident()
-        elif self.tok.kind == "ident":
-            alias = self.ident()
+        else:
+            alias = self._implicit_alias()
         return ast.TableRef(name, alias)
 
     # -- expressions (precedence ladder) ------------------------------------
@@ -734,6 +753,19 @@ class Parser:
 
 def parse_query(sql: str) -> ast.Query:
     return Parser(sql).parse_query()
+
+
+def _hoist_order_limit(q: ast.Node):
+    """Trailing ORDER BY/LIMIT of a set-operation arm bind to the whole
+    operation (SELECT-level grammar has no lookahead for that)."""
+    if isinstance(q, ast.Query) and (q.order_by or q.limit is not None):
+        order_by, limit = q.order_by, q.limit
+        q = ast.Query(
+            select=q.select, distinct=q.distinct, from_=q.from_,
+            where=q.where, group_by=q.group_by, having=q.having,
+        )
+        return q, order_by, limit
+    return q, (), None
 
 
 def _qualified_name(p: Parser) -> str:

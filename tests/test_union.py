@@ -47,3 +47,38 @@ def test_union_case(env, i):
     expected = run_oracle(oracle, sql)
     actual = runner.execute(sql).rows
     assert_rows_match(actual, expected, ordered=False)
+
+
+def test_intersect_except():
+    """INTERSECT/EXCEPT lower to null-safe semi/anti joins over a
+    distinct left arm (SetOperationNodeTranslator analog)."""
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    assert r.execute(
+        "SELECT n_regionkey FROM nation INTERSECT "
+        "SELECT r_regionkey FROM region WHERE r_regionkey < 3 ORDER BY 1"
+    ).rows == [(0,), (1,), (2,)]
+    assert r.execute(
+        "SELECT r_regionkey FROM region EXCEPT "
+        "SELECT n_regionkey FROM nation WHERE n_regionkey < 4 ORDER BY 1"
+    ).rows == [(4,)]
+    # NULLs compare equal in set operations (IS NOT DISTINCT FROM)
+    assert r.execute(
+        "SELECT a FROM (VALUES (1), (NULL), (2)) AS t(a) INTERSECT "
+        "SELECT b FROM (VALUES (NULL), (2)) AS s(b) ORDER BY 1").rows == [
+        (2,), (None,)]
+    assert r.execute(
+        "SELECT a FROM (VALUES (1), (NULL)) AS t(a) EXCEPT "
+        "SELECT b FROM (VALUES (NULL)) AS s(b)").rows == [(1,)]
+    # output deduplicates (set semantics) and precedence binds
+    # INTERSECT tighter than UNION
+    assert r.execute(
+        "SELECT n_regionkey FROM nation INTERSECT "
+        "SELECT n_regionkey FROM nation WHERE n_regionkey = 1").rows == [(1,)]
+    rows = r.execute(
+        "SELECT n_regionkey FROM nation WHERE n_regionkey = 0 UNION "
+        "SELECT n_regionkey FROM nation INTERSECT "
+        "SELECT n_regionkey FROM nation WHERE n_regionkey IN (2, 3) "
+        "ORDER BY 1").rows
+    assert rows == [(0,), (2,), (3,)]
