@@ -660,11 +660,13 @@ class LocalRunner:
         build_output = list(range(len(node.right.channels)))
         is_full = node.kind == "full"
         kind = "left" if is_full else node.kind
+        ns = node.null_safe_keys
 
         def probe(b, p, out_capacity):
             return probe_expand(
                 b, p, left_keys, out_capacity, key_domains=kd,
                 kind=kind, build_output=build_output, return_matched=is_full,
+                null_safe=ns,
             )
 
         if node in self._chain_cache:
@@ -798,6 +800,7 @@ class LocalRunner:
         build_output = list(range(len(node.right.channels)))
         is_full = node.kind == "full"
         kind = "left" if is_full else node.kind
+        ns = node.null_safe_keys
         right_types = node.right.output_types
 
         bfn_r = make_bucket_fn(right_keys, kd, K, jit=self.jit)
@@ -822,7 +825,7 @@ class LocalRunner:
                 bpage = concat_pages_device([hp.rehydrate() for hp in bbuckets[k]])
             else:
                 bpage = Page.empty(right_types, 1)
-            build = build_join(bpage, right_keys, key_domains=kd)
+            build = build_join(bpage, right_keys, key_domains=kd, null_safe=ns)
             tag = None
             if self._mem is not None:
                 from presto_tpu.memory import page_bytes
@@ -833,6 +836,7 @@ class LocalRunner:
                 return probe_expand(
                     b, p, left_keys, out_capacity, key_domains=kd,
                     kind=kind, build_output=build_output, return_matched=is_full,
+                    null_safe=ns,
                 )
 
             matched_acc = None
@@ -840,7 +844,8 @@ class LocalRunner:
                 p = hp.rehydrate()
                 if kind in ("semi", "anti"):
                     yield probe_join(build, p, left_keys, key_domains=kd,
-                                     kind=kind, build_output=build_output)
+                                     kind=kind, build_output=build_output,
+                                     null_safe=ns)
                     continue
                 res = _probe_with_retry(probe_fn, build, p)
                 yield res[0]

@@ -148,6 +148,7 @@ def probe_expand(
     kind: str = "inner",
     build_output: Optional[Sequence[int]] = None,
     return_matched: bool = False,
+    null_safe: bool = False,
 ) -> Tuple[Page, jax.Array]:
     """Many-to-many join: each probe row emits one output row per
     matching build row. Returns (page, total_matches); if
@@ -162,7 +163,7 @@ def probe_expand(
     pages to emit the FULL OUTER tail (reference:
     operator/LookupOuterOperator.java, which streams unvisited build
     positions after all probes finish)."""
-    key, _ = _probe_keys(probe, probe_key_exprs, key_domains)
+    key, _ = _probe_keys(probe, probe_key_exprs, key_domains, null_safe)
     lo = jnp.searchsorted(build.sorted_keys, key, side="left")
     hi = jnp.searchsorted(build.sorted_keys, key, side="right")
     counts = jnp.where(probe.row_mask, hi - lo, 0)

@@ -387,6 +387,7 @@ class DistributedRunner:
             left_keys = list(node.left_keys)
             kd = node.key_domains
             kind = node.kind
+            ns = node.null_safe_keys
             build_output = list(range(len(node.right.channels)))
             streaming = _is_streaming_join(node)
             cfg = self._join_cfg_for(node, ctx.cap)
@@ -402,6 +403,7 @@ class DistributedRunner:
                             probe_join(
                                 c[key], q, left_keys, key_domains=kd,
                                 kind=kind, build_output=build_output,
+                                null_safe=ns,
                             ),
                             ch,
                         )
@@ -415,7 +417,7 @@ class DistributedRunner:
                     q, ch = inner(p, c)
                     out, total = probe_expand(
                         c[key], q, left_keys, out_cap, key_domains=kd,
-                        kind=kind, build_output=build_output,
+                        kind=kind, build_output=build_output, null_safe=ns,
                     )
                     return out, {**ch, expand_check: total.astype(jnp.int32)}
 
@@ -433,7 +435,7 @@ class DistributedRunner:
                         q, ch = inner(p, c)
                         out = probe_join(
                             _squeeze(c[key]), q, left_keys, key_domains=kd,
-                            kind=kind, build_output=build_output,
+                            kind=kind, build_output=build_output, null_safe=ns,
                         )
                         return out, ch
 
@@ -446,7 +448,7 @@ class DistributedRunner:
                     q, ch = inner(p, c)
                     out, total = probe_expand(
                         _squeeze(c[key]), q, left_keys, out_cap, key_domains=kd,
-                        kind=kind, build_output=build_output,
+                        kind=kind, build_output=build_output, null_safe=ns,
                     )
                     return out, {**ch, expand_check: total.astype(jnp.int32)}
 
@@ -466,7 +468,7 @@ class DistributedRunner:
                     ex = exchange_page(bucketized, axis)
                     out = probe_join(
                         _squeeze(c[key]), ex, left_keys, key_domains=kd,
-                        kind=kind, build_output=build_output,
+                        kind=kind, build_output=build_output, null_safe=ns,
                     )
                     return out, {**ch, fill_check: fill}
 
@@ -482,7 +484,7 @@ class DistributedRunner:
                 ex = exchange_page(bucketized, axis)
                 out, total = probe_expand(
                     _squeeze(c[key]), ex, left_keys, out_cap, key_domains=kd,
-                    kind=kind, build_output=build_output,
+                    kind=kind, build_output=build_output, null_safe=ns,
                 )
                 return out, {
                     **ch, fill_check: fill, expand_check: total.astype(jnp.int32),
@@ -705,10 +707,12 @@ class DistributedRunner:
                 ),
                 jnp.concatenate([r.row_mask for r in received], axis=1),
             )
+        ns = getattr(jnode, "null_safe_keys", False)
         bj_fn = jax.jit(
             jax.shard_map(
                 lambda pg1: _unsqueeze(
-                    build_join(_squeeze(pg1), right_keys, key_domains=kd)
+                    build_join(_squeeze(pg1), right_keys, key_domains=kd,
+                               null_safe=ns)
                 ),
                 mesh=mesh, in_specs=P(axis), out_specs=P(axis),
             )

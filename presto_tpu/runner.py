@@ -131,6 +131,11 @@ class QueryRunner:
 
         if isinstance(stmt, ast.Explain):
             plan = self.binder.plan_ast(stmt.query)
+            if getattr(stmt, "distributed", False):
+                from presto_tpu.parallel.fragment import explain_distributed
+
+                text = explain_distributed(plan, catalog=self.catalog)
+                return MaterializedResult(["Query Plan"], [VARCHAR], [(text,)])
             if stmt.analyze:
                 stats = QueryStats()
                 self.executor.stats = stats

@@ -318,6 +318,7 @@ class OrderItem(Node):
 class Explain(Node):
     query: "Query"
     analyze: bool = False
+    distributed: bool = False  # EXPLAIN (TYPE DISTRIBUTED)
 
 
 @dataclasses.dataclass(frozen=True)

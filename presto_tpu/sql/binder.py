@@ -443,16 +443,8 @@ class Binder:
         SetOperationNodeTranslator to the same semi/anti shapes).
         NULLs compare equal, per set-operation semantics — the join
         key packing already treats NULL keys as one class."""
-        lnode, lnames = self._plan_query_like(q.left)
-        rnode, rnames = self._plan_query_like(q.right)
-        if len(lnode.channels) != len(rnode.channels):
-            raise BindError(f"{q.kind.upper()} arms have different column counts")
-        targets = [
-            common_super_type(a.type, b.type)
-            for a, b in zip(lnode.channels, rnode.channels)
-        ]
-        lnode = self._coerce_columns(lnode, targets, lnames)
-        rnode = self._coerce_columns(rnode, targets, lnames)
+        label = q.kind.upper()
+        lnode, rnode, lnames = self._plan_set_arms(q, label)
         distinct_left = AggregationNode(
             lnode,
             [ColumnRef(type=c.type, index=i) for i, c in enumerate(lnode.channels)],
@@ -468,47 +460,55 @@ class Binder:
             kind="semi" if q.kind == "intersect" else "anti",
             null_safe_keys=True,  # set-op rows compare IS NOT DISTINCT FROM
         )
-        node: PlanNode = join
         names = lnames
-        if q.order_by:
-            order_channels = []
-            for o in q.order_by:
-                e = o.expr
-                if isinstance(e, ast.NumberLit):
-                    i = int(e.text) - 1
-                elif isinstance(e, ast.Identifier) and e.name in names:
-                    i = names.index(e.name)
-                else:
-                    raise BindError(
-                        f"{q.kind.upper()} ORDER BY must use output names or ordinals")
-                order_channels.append(ColumnRef(type=node.channels[i].type, index=i))
-            asc = [o.ascending for o in q.order_by]
-            nf = [o.nulls_first if o.nulls_first is not None else (not o.ascending)
-                  for o in q.order_by]
-            if q.limit is not None:
-                node = TopNNode(node, order_channels, asc, q.limit, nf)
-            else:
-                node = SortNode(node, order_channels, asc, nf)
-        elif q.limit is not None:
-            node = LimitNode(node, q.limit)
+        node = self._wrap_order_limit(join, names, q.order_by, q.limit, label)
         return node, names
 
-    def _plan_union(self, u: ast.Union) -> Tuple[PlanNode, List[str]]:
-        from presto_tpu.planner.plan import UnionNode
-
-        lnode, lnames = self._plan_query_like(u.left)
-        rnode, rnames = self._plan_query_like(u.right)
+    def _plan_set_arms(self, q, label: str):
+        """Shared arm planning for UNION/INTERSECT/EXCEPT: plan both
+        sides, check arity, align types via cast projections."""
+        lnode, lnames = self._plan_query_like(q.left)
+        rnode, rnames = self._plan_query_like(q.right)
         if len(lnode.channels) != len(rnode.channels):
-            raise BindError("UNION arms have different column counts")
-        # type alignment via cast projections
+            raise BindError(f"{label} arms have different column counts")
         targets = [
             common_super_type(a.type, b.type)
             for a, b in zip(lnode.channels, rnode.channels)
         ]
         lnode = self._coerce_columns(lnode, targets, lnames)
         rnode = self._coerce_columns(rnode, targets, lnames)
+        return lnode, rnode, lnames
+
+    def _wrap_order_limit(self, node: PlanNode, names: List[str], order_by,
+                          limit, label: str) -> PlanNode:
+        """Set-operation-level ORDER BY (names/ordinals) + LIMIT."""
+        order_channels: List[ColumnRef] = []
+        for o in order_by:
+            e = o.expr
+            if isinstance(e, ast.NumberLit):
+                i = int(e.text) - 1
+            elif isinstance(e, ast.Identifier) and e.name in names:
+                i = names.index(e.name)
+            else:
+                raise BindError(
+                    f"{label} ORDER BY must use output names or ordinals")
+            order_channels.append(ColumnRef(type=node.channels[i].type, index=i))
+        if order_by:
+            asc = [o.ascending for o in order_by]
+            nf = [o.nulls_first if o.nulls_first is not None else (not o.ascending)
+                  for o in order_by]
+            if limit is not None:
+                return TopNNode(node, order_channels, asc, limit, nf)
+            return SortNode(node, order_channels, asc, nf)
+        if limit is not None:
+            return LimitNode(node, limit)
+        return node
+
+    def _plan_union(self, u: ast.Union) -> Tuple[PlanNode, List[str]]:
+        from presto_tpu.planner.plan import UnionNode
+
+        lnode, rnode, names = self._plan_set_arms(u, "UNION")
         node: PlanNode = UnionNode([lnode, rnode])
-        names = lnames
         if u.distinct:
             node = AggregationNode(
                 node,
@@ -516,25 +516,7 @@ class Binder:
                 names, [], [],
                 max_groups=self._distinct_capacity(node),
             )
-        order_channels: List[ColumnRef] = []
-        for o in u.order_by:
-            e = o.expr
-            if isinstance(e, ast.NumberLit):
-                i = int(e.text) - 1
-            elif isinstance(e, ast.Identifier) and e.name in names:
-                i = names.index(e.name)
-            else:
-                raise BindError("UNION ORDER BY must use output names or ordinals")
-            order_channels.append(ColumnRef(type=node.channels[i].type, index=i))
-        if u.order_by:
-            asc = [o.ascending for o in u.order_by]
-            nf = [o.nulls_first if o.nulls_first is not None else (not o.ascending) for o in u.order_by]
-            if u.limit is not None:
-                node = TopNNode(node, order_channels, asc, u.limit, nf)
-            else:
-                node = SortNode(node, order_channels, asc, nf)
-        elif u.limit is not None:
-            node = LimitNode(node, u.limit)
+        node = self._wrap_order_limit(node, names, u.order_by, u.limit, "UNION")
         return node, names
 
     def _coerce_columns(self, node: PlanNode, targets: List[Type], names: List[str]) -> PlanNode:

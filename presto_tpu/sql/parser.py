@@ -757,7 +757,8 @@ def parse_query(sql: str) -> ast.Query:
 
 def _hoist_order_limit(q: ast.Node):
     """Trailing ORDER BY/LIMIT of a set-operation arm bind to the whole
-    operation (SELECT-level grammar has no lookahead for that)."""
+    operation (SELECT-level grammar has no lookahead for that); an
+    inner SetOp arm re-hoists what its own parse attached."""
     if isinstance(q, ast.Query) and (q.order_by or q.limit is not None):
         order_by, limit = q.order_by, q.limit
         q = ast.Query(
@@ -765,6 +766,11 @@ def _hoist_order_limit(q: ast.Node):
             where=q.where, group_by=q.group_by, having=q.having,
         )
         return q, order_by, limit
+    if isinstance(q, ast.SetOp) and (q.order_by or q.limit is not None):
+        import dataclasses as _dc
+
+        order_by, limit = q.order_by, q.limit
+        return _dc.replace(q, order_by=(), limit=None), order_by, limit
     return q, (), None
 
 
@@ -791,9 +797,20 @@ def parse_statement(sql: str) -> ast.Node:
     p = Parser(sql)
     if p.accept("explain"):
         analyze = bool(p.accept("analyze"))
+        distributed = False
+        if p.accept("("):
+            while not p.accept(")"):
+                if p.accept_word("type"):
+                    kind = p.accept_word("distributed", "logical")
+                    if kind is None:
+                        raise SyntaxError("EXPLAIN (TYPE ...) supports "
+                                          "LOGICAL | DISTRIBUTED")
+                    distributed = kind == "distributed"
+                elif p.accept(",") is None:
+                    raise SyntaxError(f"bad EXPLAIN option at {p.tok!r}")
         q = p._query()
         p.accept(";")
-        return ast.Explain(q, analyze)
+        return ast.Explain(q, analyze, distributed)
     if p.accept("set"):
         p.expect("session")
         name = p.ident()

@@ -82,3 +82,17 @@ def test_intersect_except():
         "SELECT n_regionkey FROM nation WHERE n_regionkey IN (2, 3) "
         "ORDER BY 1").rows
     assert rows == [(0,), (2,), (3,)]
+
+
+def test_setop_order_limit_hoists_to_union():
+    """ORDER BY/LIMIT after A UNION B INTERSECT C bind to the whole
+    union, not the inner intersect arm."""
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    rows = r.execute(
+        "SELECT n_regionkey FROM nation WHERE n_regionkey = 4 UNION "
+        "SELECT n_regionkey FROM nation INTERSECT "
+        "SELECT n_regionkey FROM nation WHERE n_regionkey IN (1, 2) "
+        "ORDER BY 1 DESC LIMIT 2").rows
+    assert rows == [(4,), (2,)]
