@@ -196,7 +196,10 @@ class DistributedRunner:
                 path.append(node)
                 node = node.source
             else:
-                raise DistributedUnsupported(type(node).__name__)
+                # no aggregation on the spine: distribute the streaming
+                # chain itself (scan -> filter/project -> joins) and run
+                # the sort/limit tail locally on the gathered output
+                return self._run_chain_distributed(plan)
         agg = node
         if agg.step != "single":
             raise DistributedUnsupported("non-single aggregation")
@@ -215,6 +218,105 @@ class DistributedRunner:
             return self.local.run(plan)
         finally:
             parent.source = original
+
+    # ------------------------------------------------------------------
+    def _run_chain_distributed(self, plan: PlanNode) -> MaterializedResult:
+        """Distribute a plan with no aggregation spine: wave-execute the
+        streaming chain over the mesh, gather the (filtered) output,
+        and splice it under the local sort/limit tail — the
+        leaf-fragment execution of non-aggregate queries (the SOURCE
+        stage of a SubPlan tree whose parent is SINGLE)."""
+        # walk the Output/Project/Filter/Sort/TopN/Limit spine; the
+        # chain starts after the DEEPEST sort/limit breaker (projections
+        # above breakers run locally; those below fuse into the chain)
+        spine: List[PlanNode] = []
+        node = plan
+        while isinstance(node, (OutputNode, ProjectNode, FilterNode,
+                                SortNode, TopNNode, LimitNode)):
+            spine.append(node)
+            node = node.source
+        last_break = -1
+        for i, s in enumerate(spine):
+            if isinstance(s, (SortNode, TopNNode, LimitNode)):
+                last_break = i
+        path = spine[: last_break + 1]
+        chain_root = spine[last_break + 1] if last_break + 1 < len(spine) else node
+        leaf = self._dist_chain_leaf(chain_root)
+        if not isinstance(leaf, TableScanNode):
+            raise DistributedUnsupported(
+                f"chain leaf is {type(leaf).__name__}, not a table scan")
+        while True:
+            try:
+                pages = self._run_chain_stage_once(chain_root, leaf)
+                break
+            except GroupCapacityExceeded:
+                continue  # join capacities bumped; re-execute
+        merged = concat_pages_host(pages)
+        pre = PrecomputedNode(page=merged, channel_list=chain_root.channels)
+        parent = path[-1] if path else None
+        if parent is None:
+            out = self.local.run(pre)
+            out.names, out.types = plan.output_names, plan.output_types
+            return out
+        original = parent.source
+        try:
+            parent.source = pre
+            return self.local.run(plan)
+        finally:
+            parent.source = original
+
+    def _run_chain_stage_once(self, chain_root: PlanNode,
+                              leaf: TableScanNode) -> List[Page]:
+        conn = self.catalog.connector(leaf.handle.connector_name)
+        cap = self._split_capacity(conn, leaf.handle.table)
+        ctx = _ChainCtx(cap)
+        stage = self._build_dist_stage(chain_root, ctx)
+        runner = self._stage_runner
+        consts_rep = {
+            key: runner._materialize_build(j) for key, j in ctx.broadcast.items()
+        }
+        consts_shard = {
+            key: (self._materialize_build_colocated(j)
+                  if self._join_mode(j) == "colocated"
+                  else self._materialize_build_sharded(j))
+            for key, j in ctx.sharded.items()
+        }
+        mesh, axis, n = self.mesh, self.axis, self.n
+
+        def per_device_wave(page1, consts_r, consts_s):
+            page = _squeeze(page1)
+            p, checks = stage(page, {**consts_r, **consts_s})
+            return _unsqueeze(p), {k: v[None] for k, v in checks.items()}
+
+        fn_key = (chain_root, "chain", ctx.sig(self._join_cfg))
+        wave_fn = self._wave_fns.get(fn_key)
+        if wave_fn is None:
+            check_specs = {name: P(axis) for name in ctx.checks}
+            wave_fn = jax.jit(
+                jax.shard_map(
+                    per_device_wave, mesh=mesh,
+                    in_specs=(P(axis), P(), {k: P(axis) for k in consts_shard}),
+                    out_specs=(P(axis), check_specs),
+                )
+            )
+            self._wave_fns[fn_key] = wave_fn
+
+        sharding = NamedSharding(mesh, P(axis))
+        col_idx = list(leaf.columns)
+        n_splits = leaf.handle.num_splits
+        waves = math.ceil(n_splits / n)
+        out_pages: List[Page] = []
+        wave_checks = []
+        channels = chain_root.channels
+        for w in range(waves):
+            stacked = jax.device_put(
+                self._stacked_wave(conn, leaf, col_idx, w, cap), sharding
+            )
+            out, cks = wave_fn(stacked, consts_rep, consts_shard)
+            wave_checks.append(cks)
+            out_pages.extend(_unstack_pages(jax.device_get(out), channels))
+        self._verify_checks(chain_root, ctx, wave_checks, 0, False)
+        return out_pages
 
     # ------------------------------------------------------------------
     def run_aggregation_stage(self, agg: AggregationNode) -> Page:
