@@ -240,7 +240,7 @@ class MultiHostRunner:
                 path.append(node)
                 node = node.source
             else:
-                raise MultiHostUnsupported(type(node).__name__)
+                return self._run_chain_distributed(plan)
         agg = node
         if agg.step != "single":
             raise MultiHostUnsupported("non-single aggregation")
@@ -254,6 +254,41 @@ class MultiHostRunner:
             out.names, out.types = plan.output_names, plan.output_types
             return out
         parent = path[-1]
+        original = parent.source
+        try:
+            parent.source = pre
+            return self.local.run(plan)
+        finally:
+            parent.source = original
+
+    def _run_chain_distributed(self, plan: PlanNode) -> MaterializedResult:
+        """Non-aggregate plans: ship the streaming chain as worker
+        fragments (split subsets), gather pages, and run the local
+        sort/window/limit tail over the union (the SOURCE-fragment
+        execution of plain queries at the DCN tier)."""
+        from presto_tpu.page import concat_pages_host
+
+        spine: List[PlanNode] = []
+        node = plan
+        while isinstance(node, (OutputNode, ProjectNode, FilterNode, SortNode,
+                                TopNNode, LimitNode, WindowNode)):
+            spine.append(node)
+            node = node.source
+        last_break = -1
+        for i, sp in enumerate(spine):
+            if isinstance(sp, (SortNode, TopNNode, LimitNode, WindowNode)):
+                last_break = i
+        path = spine[: last_break + 1]
+        chain_root = spine[last_break + 1] if last_break + 1 < len(spine) else node
+        scan = self._leaf_scan(chain_root)
+        pages = self._run_fragments(chain_root, scan)
+        merged = concat_pages_host(pages)
+        pre = PrecomputedNode(page=merged, channel_list=chain_root.channels)
+        parent = path[-1] if path else None
+        if parent is None:
+            out = self.local.run(pre)
+            out.names, out.types = plan.output_names, plan.output_types
+            return out
         original = parent.source
         try:
             parent.source = pre
@@ -321,9 +356,11 @@ class MultiHostRunner:
         return n
 
     # ------------------------------------------------------------------
-    def _run_fragments(self, partial: AggregationNode, scan: TableScanNode):
+    def _run_fragments(self, fragment_root: PlanNode, scan: TableScanNode):
         """Schedule split ranges across live workers; reassign a failed
-        worker's splits to survivors (elastic leaf recovery)."""
+        worker's splits to survivors (elastic leaf recovery).  The
+        shipped fragment is ``fragment_root``'s subtree with the scan's
+        split list swapped per assignment."""
         alive = [w for w in self.workers if w.ping()]
         if not alive:
             raise MultiHostUnsupported("no live workers")
@@ -337,7 +374,7 @@ class MultiHostRunner:
         lock = threading.Lock()
         failed: List[tuple] = []
 
-        dictionaries = [c.dictionary for c in partial.channels]
+        dictionaries = [c.dictionary for c in fragment_root.channels]
 
         def make_fragment(splits: List[int]) -> dict:
             # serialize on the scheduling thread — the splits field is
@@ -345,7 +382,7 @@ class MultiHostRunner:
             original = scan.splits
             try:
                 scan.splits = splits
-                return plan_to_json(partial)
+                return plan_to_json(fragment_root)
             finally:
                 scan.splits = original
 

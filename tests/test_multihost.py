@@ -142,3 +142,34 @@ def test_task_failure_is_not_worker_failure():
         assert client.run_fragment(good)
     finally:
         w.stop()
+
+
+def test_multihost_chain_without_aggregation():
+    """Non-aggregate plans fan leaf fragments over workers; the sorted
+    tail runs at the coordinator over the gathered pages."""
+    from presto_tpu.parallel.multihost import MultiHostRunner
+    from presto_tpu.server.worker import WorkerServer
+
+    catalog = make_catalog()
+    workers = [WorkerServer(catalog) for _ in range(2)]
+    for w in workers:
+        w.start()
+    try:
+        from presto_tpu.runner import QueryRunner
+
+        r = QueryRunner(catalog)
+        mh = MultiHostRunner(catalog, [w.uri for w in workers])
+        for sql in [
+            "SELECT l_orderkey, l_quantity FROM lineitem "
+            "WHERE l_quantity > 45 ORDER BY l_orderkey, l_quantity, "
+            "l_extendedprice LIMIT 25",
+            "SELECT o_orderkey, o_totalprice FROM orders "
+            "WHERE o_orderpriority = '1-URGENT' ORDER BY o_orderkey LIMIT 10",
+        ]:
+            local = r.execute(sql).rows
+            assert local
+            got = mh._run_distributed(r.plan(sql)).rows
+            assert got == local, sql
+    finally:
+        for w in workers:
+            w.stop()
