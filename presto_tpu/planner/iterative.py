@@ -32,8 +32,10 @@ from presto_tpu.planner.plan import (
     OutputNode,
     PlanNode,
     ProjectNode,
+    SortNode,
     TableScanNode,
     TopNNode,
+    UnionNode,
     ValuesNode,
 )
 
@@ -184,6 +186,55 @@ class MergeLimits(Rule):
         return LimitNode(inner.source, min(node.count, inner.count))
 
 
+class MergeLimitWithSort(Rule):
+    """Limit over Sort -> bounded TopN (MergeLimitWithSort.java) — the
+    subquery-ORDER-BY + outer-LIMIT shape the binder can't fuse."""
+
+    pattern = Pattern.type_of(LimitNode).with_sources(Pattern.type_of(SortNode))
+
+    def apply(self, node: LimitNode) -> Optional[PlanNode]:
+        srt: SortNode = node.source
+        return TopNNode(srt.source, list(srt.sort_exprs), list(srt.ascending),
+                        node.count, srt.nulls_first)
+
+
+class PushLimitThroughUnion(Rule):
+    """Limit over UNION ALL: bound each arm too (no arm needs to
+    produce more than the limit) while keeping the outer limit
+    (PushLimitThroughUnion.java)."""
+
+    pattern = Pattern.type_of(LimitNode).with_sources(Pattern.type_of(UnionNode))
+
+    def apply(self, node: LimitNode) -> Optional[PlanNode]:
+        union: UnionNode = node.source
+        if all(isinstance(i, LimitNode) and i.count <= node.count
+               for i in union.inputs):
+            return None  # already bounded
+        bounded = [
+            i if isinstance(i, LimitNode) and i.count <= node.count
+            else LimitNode(i, node.count)
+            for i in union.inputs
+        ]
+        return LimitNode(UnionNode(bounded), node.count)
+
+
+class FlattenUnions(Rule):
+    """Union arms that are themselves unions splice inline
+    (MergeUnion-style flattening keeps one concat instead of a chain)."""
+
+    pattern = Pattern.type_of(UnionNode).where(
+        lambda n: any(isinstance(i, UnionNode) for i in n.inputs))
+
+    def apply(self, node: UnionNode) -> Optional[PlanNode]:
+        flat: List[PlanNode] = []
+        for i in node.inputs:
+            if isinstance(i, UnionNode):
+                flat.extend(i.inputs)
+            else:
+                flat.append(i)
+        return UnionNode(flat)
+
+
 def _expr_refs(e: Expr) -> List[int]:
     if isinstance(e, ColumnRef):
         return [e.index]
@@ -201,6 +252,9 @@ DEFAULT_RULES: List[Rule] = [
     RecordScanConstraints(),
     PushLimitThroughProject(),
     MergeLimits(),
+    MergeLimitWithSort(),
+    PushLimitThroughUnion(),
+    FlattenUnions(),
 ]
 
 
