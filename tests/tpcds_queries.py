@@ -12,6 +12,44 @@ lacks (ROLLUP -> UNION ALL expansion).
 """
 
 QUERIES = {
+    # official q38 shape: customers present in all three channels
+    38: """
+select count(*) from (
+    select c_last_name, c_first_name, d_date
+    from store_sales, date_dim, customer
+    where ss_sold_date_sk = d_date_sk and ss_customer_sk = c_customer_sk
+        and d_year = 2000
+    intersect
+    select c_last_name, c_first_name, d_date
+    from catalog_sales, date_dim, customer
+    where cs_sold_date_sk = d_date_sk and cs_bill_customer_sk = c_customer_sk
+        and d_year = 2000
+    intersect
+    select c_last_name, c_first_name, d_date
+    from web_sales, date_dim, customer
+    where ws_sold_date_sk = d_date_sk and ws_bill_customer_sk = c_customer_sk
+        and d_year = 2000
+) hot_cust
+""",
+    # official q87 shape: store customers missing from the other channels
+    87: """
+select count(*) from (
+    select c_last_name, c_first_name, d_date
+    from store_sales, date_dim, customer
+    where ss_sold_date_sk = d_date_sk and ss_customer_sk = c_customer_sk
+        and d_year = 2000
+    except
+    select c_last_name, c_first_name, d_date
+    from catalog_sales, date_dim, customer
+    where cs_sold_date_sk = d_date_sk and cs_bill_customer_sk = c_customer_sk
+        and d_year = 2000
+    except
+    select c_last_name, c_first_name, d_date
+    from web_sales, date_dim, customer
+    where ws_sold_date_sk = d_date_sk and ws_bill_customer_sk = c_customer_sk
+        and d_year = 2000
+) cool_cust
+""",
     # official Q1 shape: CTE referenced twice, one reference correlated
     2: """
 with wscs as (
