@@ -144,6 +144,11 @@ def output_type(agg: AggCall) -> Type:
         from presto_tpu.types import MapType
 
         return MapType(agg.arg.type, agg.arg2.type, ARRAY_AGG_CAP)
+    if agg.fn == "histogram":
+        # rewritten to inner count + outer map_agg before execution
+        from presto_tpu.types import MapType
+
+        return MapType(agg.arg.type, BIGINT, ARRAY_AGG_CAP)
     if agg.fn == "learn_regressor":
         from presto_tpu.types import ArrayType
 

@@ -66,7 +66,7 @@ AGG_FUNCTIONS = {
     "min_by", "max_by", "approx_percentile",
     "covar_pop", "covar_samp", "corr", "regr_slope", "regr_intercept",
     "checksum", "arbitrary", "count_if", "geometric_mean",
-    "array_agg", "map_agg",
+    "array_agg", "map_agg", "histogram",
     # presto-ml analogs: sufficient-statistic training aggregates
     "learn_regressor", "learn_classifier",
 }
@@ -1298,6 +1298,12 @@ class Binder:
         if any(a.fn == "approx_percentile" for a in agg_ctx.aggs):
             node = self._rewrite_approx_percentile(node, group_irs, agg_ctx)
 
+        # histogram: two-level rewrite (inner per-value counts, outer
+        # map_agg) — HistogramAggregation analog
+        if any(a.fn == "histogram" for a in agg_ctx.aggs):
+            node, agg_ctx = self._rewrite_histogram(node, scope, group_irs, agg_ctx)
+            group_irs = agg_ctx.group_irs
+
         # approx_distinct: HyperLogLog two-level aggregation rewrite
         if any(a.fn == "approx_distinct" for a in agg_ctx.aggs):
             node, agg_ctx = self._rewrite_approx_distinct(node, scope, group_irs, agg_ctx)
@@ -1397,6 +1403,36 @@ class Binder:
             agg_ctx.aggs[j] = AggCall(fn="max", arg=newarg, type=a.type,
                                       filter=a.filter)
         return node
+
+    def _rewrite_histogram(self, node, scope, group_irs, agg_ctx: AggCtx):
+        """histogram(x) -> inner aggregation grouped by (keys..., x)
+        computing count(*), outer map_agg(x, count)
+        (operator/aggregation/histogram/Histogram.java realized through
+        the engine's own container machinery)."""
+        if not all(a.fn == "histogram" for a in agg_ctx.aggs):
+            raise BindError("histogram cannot mix with other aggregates")
+        args = {a.arg for a in agg_ctx.aggs}
+        if len(args) != 1:
+            raise BindError("multiple histogram arguments unsupported")
+        (arg,) = args
+        inner_keys = group_irs + [arg]
+        inner = AggregationNode(
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
+            [AggCall(fn="count_star", arg=None, type=BIGINT)], ["$cnt"],
+            max_groups=self._group_capacity(
+                inner_keys, scope, self._estimate(node), node=node),
+        )
+        new_group = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
+        x_ref = ColumnRef(type=arg.type, index=len(group_irs))
+        cnt_ref = ColumnRef(type=BIGINT, index=len(inner_keys))
+        from presto_tpu.ops.aggregate import output_type as _agg_out
+
+        proto = AggCall(fn="map_agg", arg=x_ref, type=arg.type, arg2=cnt_ref)
+        new_aggs = [dataclasses.replace(proto, type=_agg_out(proto))
+                    for _ in agg_ctx.aggs]
+        ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group,
+                     aggs=new_aggs)
+        return inner, ctx
 
     def _rewrite_approx_distinct(self, node, scope, group_irs, agg_ctx: AggCtx):
         """approx_distinct(x) -> inner aggregation grouped by

@@ -279,3 +279,19 @@ def test_distributed_smoke_with_arrays():
     blob = serialize_page(page.compact_host())
     back = deserialize_page(blob)
     assert back.to_pylist() == page.to_pylist()
+
+
+def test_histogram():
+    """histogram(x): two-level rewrite to inner counts + map_agg
+    (Histogram.java analog)."""
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    assert r.execute("SELECT histogram(n_regionkey) FROM nation").rows == [
+        ({0: 5, 1: 5, 2: 5, 3: 5, 4: 5},)]
+    rows = r.execute("SELECT n_regionkey, histogram(n_nationkey % 2) "
+                     "FROM nation GROUP BY n_regionkey ORDER BY n_regionkey").rows
+    assert len(rows) == 5
+    assert all(sum(h.values()) == 5 for _, h in rows)
+    assert r.execute("SELECT cardinality(histogram(n_regionkey)) FROM nation"
+                     ).rows == [(5,)]
