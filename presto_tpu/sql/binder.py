@@ -92,7 +92,7 @@ SCALAR_FUNCTIONS = {
     "abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10", "log2", "power", "pow",
     "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
     "sinh", "cosh", "tanh", "degrees", "radians", "truncate",
-    "width_bucket", "is_nan", "is_finite", "pi", "e",
+    "width_bucket", "is_nan", "is_finite", "pi", "e", "now",
     "ceil", "ceiling", "floor", "round", "mod", "greatest", "least",
     "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
     "trim", "ltrim", "rtrim", "reverse", "substr",
@@ -1764,6 +1764,18 @@ class Binder:
             if isinstance(e, ast.FuncCall) and e.name == "grouping":
                 return self._bind_grouping(e, scope, agg)
 
+        if isinstance(e, ast.Identifier) and e.qualifier is None \
+                and e.name.lower() in ("current_date", "current_timestamp",
+                                       "localtimestamp"):
+            # parenless niladic datetime functions (SqlBase.g4 specialForm);
+            # bind-time constants so a query sees one consistent instant
+            import time as _time
+
+            now = _time.time()
+            if e.name.lower() == "current_date":
+                return Literal(type=DATE, value=int(now // 86400))
+            return Literal(type=TIMESTAMP, value=int(now * 1_000_000))
+
         if isinstance(e, ast.Identifier):
             idx = scope.resolve(e.qualifier, e.name)
             ch = scope.col(idx).channel
@@ -1898,6 +1910,11 @@ class Binder:
                           "none_match") and len(e.args) == 2 \
                     and isinstance(e.args[1], ast.Lambda):
                 return self._bind_array_lambda(e, scope, agg)
+            if e.name == "now" and not e.args:
+                import time as _time
+
+                return Literal(type=TIMESTAMP,
+                               value=int(_time.time() * 1_000_000))
             if e.name in ("pi", "e") and not e.args:
                 import math as _math
 

@@ -205,3 +205,25 @@ def test_min_max_timestamp(runner):
         "select min(created_at), max(created_at) from events").rows
     all_ts = [dt(r[1]) for r in ROWS]
     assert rows == [(min(all_ts), max(all_ts))]
+
+
+def test_niladic_datetime_functions():
+    """current_date / current_timestamp / now() are bind-time constants
+    (SqlBase.g4 specialForm parenless functions)."""
+    import datetime
+
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    today = datetime.date.today()
+    d, ts, n, y = r.execute(
+        "SELECT current_date, current_timestamp, now(), year(current_date)"
+    ).rows[0]
+    # DATE surfaces as epoch days (engine convention)
+    assert abs(d - (today - datetime.date(1970, 1, 1)).days) <= 1
+    assert y == today.year
+    assert abs((ts - datetime.datetime.utcnow()).total_seconds()) < 120
+    assert abs((n - datetime.datetime.utcnow()).total_seconds()) < 120
+    # usable in predicates (TPC-H dates are all in the past)
+    assert r.execute("SELECT count(*) FROM orders "
+                     "WHERE o_orderdate < current_date").rows == [(1500,)]
