@@ -92,7 +92,7 @@ SCALAR_FUNCTIONS = {
     "abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10", "log2", "power", "pow",
     "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
     "sinh", "cosh", "tanh", "degrees", "radians", "truncate",
-    "width_bucket", "is_nan", "is_finite", "pi", "e", "now",
+    "width_bucket", "is_nan", "is_finite", "pi", "e",
     "ceil", "ceiling", "floor", "round", "mod", "greatest", "least",
     "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
     "trim", "ltrim", "rtrim", "reverse", "substr",
@@ -339,6 +339,8 @@ class Binder:
         self._from_unnests: List[ast.Unnest] = []
         # in-scope CTE definitions (WITH name AS (...)): name -> query ast
         self._ctes: Dict[str, ast.Node] = {}
+        # the statement's single now() instant (reset per plan_ast)
+        self._now: Optional[float] = None
         # CBO stats (cost/StatsCalculator.java analog); memo is safe to
         # share across plan() calls since plan nodes are identity-keyed
         from presto_tpu.planner.stats import StatsCalculator
@@ -346,11 +348,22 @@ class Binder:
         self._stats = StatsCalculator()
 
     # ==================================================================
+    def _query_now(self) -> float:
+        """One wall-clock instant per planned query: every
+        current_date/current_timestamp/now() in a statement sees the
+        same time (Session.getStartTime in the reference)."""
+        if self._now is None:
+            import time as _time
+
+            self._now = _time.time()
+        return self._now
+
     def plan(self, sql: str) -> OutputNode:
         self._stats.reset()  # don't pin prior queries' plan trees
         return self.plan_ast(parse_query(sql))
 
     def plan_ast(self, q: ast.Node) -> OutputNode:
+        self._now = None  # fresh instant for this statement
         node, names = self._plan_query_like(q)
         out = OutputNode(node, names)
         # iterative rule engine over the bound plan
@@ -1769,9 +1782,7 @@ class Binder:
                                        "localtimestamp"):
             # parenless niladic datetime functions (SqlBase.g4 specialForm);
             # bind-time constants so a query sees one consistent instant
-            import time as _time
-
-            now = _time.time()
+            now = self._query_now()
             if e.name.lower() == "current_date":
                 return Literal(type=DATE, value=int(now // 86400))
             return Literal(type=TIMESTAMP, value=int(now * 1_000_000))
@@ -1910,11 +1921,11 @@ class Binder:
                           "none_match") and len(e.args) == 2 \
                     and isinstance(e.args[1], ast.Lambda):
                 return self._bind_array_lambda(e, scope, agg)
-            if e.name == "now" and not e.args:
-                import time as _time
-
+            if e.name == "now":
+                if e.args:
+                    raise BindError("now() takes no arguments")
                 return Literal(type=TIMESTAMP,
-                               value=int(_time.time() * 1_000_000))
+                               value=int(self._query_now() * 1_000_000))
             if e.name in ("pi", "e") and not e.args:
                 import math as _math
 
