@@ -404,8 +404,11 @@ class LocalRunner:
             for i, t in enumerate(node.types):
                 raw = [r[i] for r in node.rows]
                 valids.append(np.asarray([v is not None for v in raw], np.bool_))
-                cols.append(np.asarray([0 if v is None else v for v in raw],
-                                       dtype=t.np_dtype))
+                if t.is_array or t.is_map:
+                    cols.append(raw)  # Page encodes container lists
+                else:
+                    cols.append(np.asarray([0 if v is None else v for v in raw],
+                                           dtype=t.np_dtype))
             yield Page.from_arrays(cols, node.types, valids=valids,
                                    dictionaries=node.dictionaries)
             return

@@ -32,18 +32,21 @@ def _substitute_params(node, params):
             raise ValueError(
                 f"parameter ?{node.index + 1} has no USING value")
         return params[node.index]
+    if isinstance(node, tuple):
+        # nested tuples (With.ctes pairs, Case.whens) recurse
+        return tuple(_substitute_params(x, params) for x in node)
     if not isinstance(node, ast.Node):
         return node
     changes = {}
     for f in _dc.fields(node):
         v = getattr(node, f.name)
-        if isinstance(v, tuple):
-            nv = tuple(_substitute_params(x, params) for x in v)
-            if any(a is not b for a, b in zip(nv, v)):
-                changes[f.name] = nv
-        elif isinstance(v, ast.Node):
+        if isinstance(v, (tuple, ast.Node)):
             nv = _substitute_params(v, params)
-            if nv is not v:
+            if nv is not v and nv != v:
+                changes[f.name] = nv
+            elif isinstance(nv, tuple) and any(
+                a is not b for a, b in zip(nv, v)
+            ):
                 changes[f.name] = nv
     return _dc.replace(node, **changes) if changes else node
 

@@ -341,6 +341,8 @@ class Binder:
         self._ctes: Dict[str, ast.Node] = {}
         # the statement's single now() instant (reset per plan_ast)
         self._now: Optional[float] = None
+        # lambda parameter scopes (innermost last): name -> LambdaVar
+        self._lambda_params: List[Dict[str, object]] = []
         # CBO stats (cost/StatsCalculator.java analog); memo is safe to
         # share across plan() calls since plan nodes are identity-keyed
         from presto_tpu.planner.stats import StatsCalculator
@@ -666,8 +668,22 @@ class Binder:
         for row in bound:
             if len(row) != arity:
                 raise BindError("VALUES rows differ in arity")
-            for cell in row:
-                if not isinstance(cell, Literal):
+            for j, cell in enumerate(row):
+                if isinstance(cell, Call) and cell.fn == "array_construct" \
+                        and all(isinstance(a, Literal) for a in cell.args):
+                    # constant-fold ARRAY[...] literals to list values
+                    vals = []
+                    for a in cell.args:
+                        if a.value is None:
+                            vals.append(None)
+                        elif a.type.is_decimal:
+                            # plain python value; the page encoder
+                            # re-scales to the element type
+                            vals.append(a.value / 10 ** (a.type.scale or 0))
+                        else:
+                            vals.append(a.value)
+                    row[j] = Literal(type=cell.type, value=vals)
+                elif not isinstance(cell, Literal):
                     raise BindError("VALUES cells must be literals")
         types: List[Type] = []
         for j in range(arity):
@@ -676,7 +692,16 @@ class Binder:
                 cell = row[j]
                 if cell.value is None:
                     continue
-                t = cell.type if t is None else common_super_type(t, cell.type)
+                if t is None:
+                    t = cell.type
+                elif t.is_array and cell.type.is_array:
+                    from presto_tpu.types import ArrayType
+
+                    t = ArrayType(
+                        common_super_type(t.element, cell.type.element),
+                        max(t.max_elems, cell.type.max_elems))
+                else:
+                    t = common_super_type(t, cell.type)
             types.append(t if t is not None else BIGINT)
         names = (list(rel.column_names) if rel.column_names
                  else [f"_col{j}" for j in range(arity)])
@@ -1777,6 +1802,11 @@ class Binder:
             if isinstance(e, ast.FuncCall) and e.name == "grouping":
                 return self._bind_grouping(e, scope, agg)
 
+        if isinstance(e, ast.Identifier) and e.qualifier is None:
+            for frame in reversed(self._lambda_params):
+                if e.name in frame:
+                    return frame[e.name]
+
         if isinstance(e, ast.Identifier) and e.qualifier is None \
                 and e.name.lower() in ("current_date", "current_timestamp",
                                        "localtimestamp"):
@@ -2038,35 +2068,14 @@ class Binder:
 
     def _bind_lambda_body(self, body: ast.Node, param: str, var,
                           scope: Scope, agg) -> Expr:
-        """Bind with ``param`` shadowing outer columns: the parameter
-        resolves to a marker channel, rewritten to the LambdaVar."""
-        marker = 1 << 27
-        outer = scope
-
-        class _MarkScope(Scope):
-            def __init__(self):
-                self.cols = outer.cols
-                self.parent = outer.parent
-
-            def resolve(self, qualifier, name):
-                if qualifier is None and name == param:
-                    return marker
-                return outer.resolve(qualifier, name)
-
-            def col(self, idx):
-                if idx == marker:
-                    return ScopeCol(None, param, Channel(param, var.type))
-                return outer.col(idx)
-
-        def rewrite(ir):
-            if isinstance(ir, ColumnRef) and ir.index == marker:
-                return var
-            if isinstance(ir, Call):
-                return Call(type=ir.type, fn=ir.fn,
-                            args=tuple(rewrite(a) for a in ir.args))
-            return ir
-
-        return rewrite(self._bind_impl(body, _MarkScope(), agg))
+        """Bind with ``param`` shadowing outer columns (and exempt from
+        group-key checks inside aggregate contexts): a scoped parameter
+        frame is consulted before identifier resolution."""
+        self._lambda_params.append({param: var})
+        try:
+            return self._bind_impl(body, scope, agg)
+        finally:
+            self._lambda_params.pop()
 
     def _bind_grouping(self, e: ast.FuncCall, scope: Scope, agg: AggCtx) -> Expr:
         """grouping(a, b, ...) -> bitmask int: bit j (MSB-first) is 1

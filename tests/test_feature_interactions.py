@@ -1,0 +1,66 @@
+"""Cross-feature stress: CTEs + set ops + unnest + windows + containers
+composed in single statements (the shapes real workloads mix)."""
+
+import pytest
+
+from presto_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(sf=0.001)
+
+
+def test_cte_over_unnest_with_window(runner):
+    rows = runner.execute(
+        "WITH expanded AS ("
+        "  SELECT k, e FROM (VALUES (1, ARRAY[3, 1]), (2, ARRAY[2])) AS t(k, a) "
+        "  CROSS JOIN UNNEST(a) AS u(e)) "
+        "SELECT k, e, row_number() OVER (PARTITION BY k ORDER BY e) AS rn "
+        "FROM expanded ORDER BY k, rn").rows
+    assert rows == [(1, 1, 1), (1, 3, 2), (2, 2, 1)]
+
+
+def test_setop_over_ctes(runner):
+    rows = runner.execute(
+        "WITH a AS (SELECT n_regionkey AS k FROM nation), "
+        "b AS (SELECT r_regionkey AS k FROM region WHERE r_regionkey >= 2) "
+        "SELECT k FROM a EXCEPT SELECT k FROM b ORDER BY k").rows
+    assert rows == [(0,), (1,)]
+
+
+def test_array_agg_of_cte_join(runner):
+    rows = runner.execute(
+        "WITH big AS (SELECT n_regionkey AS rk, n_nationkey AS nk FROM nation "
+        "WHERE n_nationkey < 6) "
+        "SELECT r_name, array_agg(nk) FROM region JOIN big ON r_regionkey = rk "
+        "GROUP BY r_name ORDER BY r_name").rows
+    assert all(isinstance(arr, list) and arr for _, arr in rows)
+    total = sum(len(arr) for _, arr in rows)
+    assert total == 6
+
+
+def test_lambda_over_aggregated_array(runner):
+    rows = runner.execute(
+        "SELECT transform(array_agg(n_nationkey), x -> x * 10) FROM nation "
+        "WHERE n_nationkey < 3").rows
+    assert sorted(rows[0][0]) == [0, 10, 20]
+
+
+def test_prepared_cte_with_parameter(runner):
+    runner.execute(
+        "PREPARE fi FROM WITH f AS (SELECT n_regionkey AS k FROM nation "
+        "WHERE n_nationkey < ?) SELECT count(*) FROM f")
+    assert runner.execute("EXECUTE fi USING 5").rows == [(5,)]
+    assert runner.execute("EXECUTE fi USING 10").rows == [(10,)]
+    runner.execute("DEALLOCATE PREPARE fi")
+
+
+def test_grouping_sets_with_having_and_setop(runner):
+    rows = runner.execute(
+        "SELECT n_regionkey, count(*) AS c FROM nation "
+        "GROUP BY ROLLUP(n_regionkey) HAVING count(*) >= 5 "
+        "EXCEPT SELECT NULL, 25 ORDER BY 2, 1").rows
+    # the rollup total row (NULL, 25) is removed by the EXCEPT
+    assert (None, 25) not in rows
+    assert all(c == 5 for _, c in rows)
