@@ -176,12 +176,21 @@ def output_type(agg: AggCall) -> Type:
 # Below this segment count, segment reductions lower to a fused masked
 # broadcast-reduce instead of XLA's scatter-add — scatter serializes on
 # the TPU (measured 583ms vs ~0ms extra for a 6M-row f64 page), while
-# the masked form fuses into one memory pass per call.
+# the masked form fuses into one memory pass per call.  XLA:CPU does
+# NOT fuse the broadcast (it materializes the (G, rows) intermediate,
+# measured 10x slower on TPC-H Q1) and its scatter-add is fine, so the
+# masked form is TPU-only.
 SMALL_SEG_LIMIT = 128
 
 
+def _masked_segments_profitable() -> bool:
+    import jax as _jax
+
+    return _jax.default_backend() != "cpu"
+
+
 def _seg_sum(vals, gid, n):
-    if n <= SMALL_SEG_LIMIT:
+    if n <= SMALL_SEG_LIMIT and _masked_segments_profitable():
         seg = jnp.arange(n, dtype=gid.dtype)
         hit = gid[None, :] == seg[:, None]
         if vals.ndim == 1:
@@ -220,7 +229,7 @@ def _ident_min(dtype):
 
 
 def _seg_min(vals, gid, n):
-    if n <= SMALL_SEG_LIMIT:
+    if n <= SMALL_SEG_LIMIT and _masked_segments_profitable():
         seg = jnp.arange(n, dtype=gid.dtype)
         hit = gid[None, :] == seg[:, None]
         fill = jnp.asarray(_ident_max(vals.dtype), vals.dtype)
@@ -229,7 +238,7 @@ def _seg_min(vals, gid, n):
 
 
 def _seg_max(vals, gid, n):
-    if n <= SMALL_SEG_LIMIT:
+    if n <= SMALL_SEG_LIMIT and _masked_segments_profitable():
         seg = jnp.arange(n, dtype=gid.dtype)
         hit = gid[None, :] == seg[:, None]
         fill = jnp.asarray(_ident_min(vals.dtype), vals.dtype)
