@@ -23,6 +23,10 @@ child process, a dead backend is detected by timeout/UNAVAILABLE and
 the remaining TPU queries are skipped, and at least 45% of the wall
 budget is always reserved for the CPU fallback so a JSON line with a
 real measured number is emitted no matter what the tunnel does.
+Every successful on-device run is persisted to TPU_MEASURED.json
+(rates, timestamp, commit); when the tunnel is dead the cached rates
+are emitted as platform "tpu-cached" next to a fresh CPU measurement,
+so a dead tunnel degrades to "stale TPU + fresh CPU", never "no TPU".
 
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 3),
 BENCH_TIMEOUT (per-child cap seconds, default 1200),
@@ -38,6 +42,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_FILE = os.path.join(HERE, "BASELINE_MEASURED.json")
+TPU_FILE = os.path.join(HERE, "TPU_MEASURED.json")
 
 QUERY_NAMES = ("q1", "q6", "q3")
 
@@ -78,8 +83,15 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     from presto_tpu.connectors.tpch import Tpch
     from presto_tpu.runner import QueryRunner
 
+    # Split granularity: one dispatch per split per chain.  On TPU,
+    # fewer/larger splits amortize dispatch+fold overhead (SF1 lineitem
+    # fits one 8M-row split: 6M x 8 cols x 8B = 384MB vs 16GB HBM); on
+    # CPU, 1M-row splits keep working sets cache-friendly (8M-row
+    # splits measured q6 51M vs 81M rows/s).  BENCH_SPLIT_ROWS for A/B.
+    default_rows = (1 << 20) if platform == "cpu" else (1 << 23)
+    split_rows = int(os.environ.get("BENCH_SPLIT_ROWS", str(default_rows)))
     t0 = time.time()
-    tpch = Tpch(sf=sf, split_rows=1 << 20)
+    tpch = Tpch(sf=sf, split_rows=split_rows)
     mem = MemoryConnector()
     mem.load_from(
         tpch, "lineitem",
@@ -102,7 +114,18 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     all_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3]}
     bench_queries = {only: all_queries[only]} if only else all_queries
 
+    # bytes the engine must stream from HBM per query (columns touched x
+    # 8 bytes x rows) — the roofline denominator for bandwidth figures
+    nrows = {t: mem.row_count(t) for t in ("lineitem", "orders", "customer")}
+    bytes_scanned = {
+        "q1": 7 * 8 * nrows["lineitem"],
+        "q6": 4 * 8 * nrows["lineitem"],
+        "q3": (4 * 8 * nrows["lineitem"] + 4 * 8 * nrows["orders"]
+               + 2 * 8 * nrows["customer"]),
+    }
+
     rates = {}
+    device = {}
     errors = {}
     for name, sql in bench_queries.items():
         try:
@@ -117,6 +140,33 @@ def _measure(sf: float, iters: int, only: str) -> dict:
             best = min(times)
             rates[name] = lineitem_rows / best
             log(f"{name}: best {best:.3f}s -> {rates[name]:.3e} lineitem rows/s")
+            # device-side attribution: same plan without the host
+            # result-materialization tax (the ~74ms/read tunnel charge),
+            # plus bytes-scanned / time vs the HBM roofline.  TPU-only
+            # (BENCH_DEVICE_TIME=1 forces it on CPU for debugging) —
+            # the extra runs must never push a TPU child past its
+            # timeout AFTER the primary rates are already measured, so
+            # they are also wrapped in their own try.
+            if platform == "cpu" and not os.environ.get("BENCH_DEVICE_TIME"):
+                continue
+            try:
+                plan = runner.plan(sql)
+                dts = []
+                for _ in range(min(iters, 2)):
+                    t0 = time.time()
+                    page = runner.executor.run_to_page(plan)
+                    jax.block_until_ready(page)
+                    dts.append(time.time() - t0)
+                dt = min(dts)
+                device[name] = {
+                    "seconds": round(dt, 4),
+                    "rows_per_sec": round(lineitem_rows / dt, 1),
+                    "bytes": bytes_scanned.get(name),
+                    "gbps": round(bytes_scanned.get(name, 0) / dt / 1e9, 2),
+                }
+                log(f"{name}: device {dt:.3f}s -> {device[name]['gbps']} GB/s")
+            except Exception as e:
+                log(f"{name}: device attribution failed: {e}")
         except Exception as e:  # keep going: partial evidence beats none
             errors[name] = f"{type(e).__name__}: {e}"
             log(f"{name}: FAILED {errors[name]}")
@@ -125,6 +175,8 @@ def _measure(sf: float, iters: int, only: str) -> dict:
                 break
 
     out = {"platform": platform, "sf": sf, "rates": rates}
+    if device:
+        out["device"] = device
     if errors:
         out["errors"] = errors
     return out
@@ -168,8 +220,64 @@ def _geomean(vals):
     return math.exp(sum(math.log(v) for v in vals) / len(vals))
 
 
-def _probe_backend(timeout: float) -> bool:
-    """Bounded-time check that the default backend initializes at all."""
+def _save_tpu(result: dict) -> None:
+    """Persist a successful on-device measurement so a later run with a
+    dead tunnel can still report a TPU figure (platform "tpu-cached")
+    instead of silently degrading to CPU-only.  Keyed by scale factor;
+    per-query rates merge so partial runs accumulate."""
+    try:
+        data = {}
+        if os.path.exists(TPU_FILE):
+            with open(TPU_FILE) as f:
+                data = json.load(f)
+        key = "sf%g" % result["sf"]
+        entry = data.get(key, {"rates": {}})
+        entry["platform"] = result["platform"]
+        entry.setdefault("rates", {}).update(
+            {k: round(v, 1) for k, v in result["rates"].items()})
+        if result.get("device"):
+            entry.setdefault("device", {}).update(result["device"])
+        entry["measured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        try:
+            entry["commit"] = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=HERE,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            ).stdout.decode().strip()
+        except Exception:
+            pass
+        data[key] = entry
+        with open(TPU_FILE, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        log(f"tpu measurement persisted to {os.path.basename(TPU_FILE)}")
+    except Exception as e:
+        log(f"tpu measurement persist failed: {e}")
+
+
+def _load_tpu(sf: float) -> dict | None:
+    """Last-good on-device rates for this scale factor, or None."""
+    try:
+        with open(TPU_FILE) as f:
+            data = json.load(f)
+        entry = data.get("sf%g" % sf)
+        if entry and entry.get("rates"):
+            return {
+                "platform": "tpu-cached", "sf": sf,
+                "rates": dict(entry["rates"]),
+                "device": dict(entry.get("device", {})),
+                "measured_at": entry.get("measured_at"),
+                "commit": entry.get("commit"),
+            }
+    except Exception as e:
+        log(f"tpu cache unreadable: {e}")
+    return None
+
+
+def _probe_backend(timeout: float) -> tuple:
+    """Bounded-time check that the default backend initializes at all.
+    Returns (ok, is_tpu) — a healthy probe that resolves to CPU means
+    the tunnel is down and the TPU per-query loop would only re-measure
+    CPU, so the parent goes straight to the one-shot CPU fallback."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -177,17 +285,18 @@ def _probe_backend(timeout: float) -> bool:
              "import jax.numpy as jnp; print(int(jnp.arange(8).sum()))"],
             timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
-        log(f"backend probe: rc={proc.returncode} {proc.stdout.decode().strip()[-200:]}")
-        return proc.returncode == 0
+        out = proc.stdout.decode()
+        log(f"backend probe: rc={proc.returncode} {out.strip()[-200:]}")
+        return proc.returncode == 0, "Cpu" not in out.split("]")[0]
     except subprocess.TimeoutExpired:
         log(f"backend probe: hung >{timeout}s")
-        return False
+        return False, False
 
 
 def _measure_tpu_per_query(sf, deadline, per_child_cap) -> dict:
     """One child per query; a timeout/unreachable child skips the rest
     (dead-tunnel fail-fast)."""
-    result = {"platform": None, "sf": sf, "rates": {}, "errors": {}}
+    result = {"platform": None, "sf": sf, "rates": {}, "device": {}, "errors": {}}
     for name in QUERY_NAMES:
         # never eat into the CPU-fallback reserve (45% of total budget)
         budget = _remaining(deadline) - 0.45 * deadline
@@ -208,6 +317,7 @@ def _measure_tpu_per_query(sf, deadline, per_child_cap) -> dict:
             break
         result["platform"] = res.get("platform")
         result["rates"].update(res.get("rates", {}))
+        result["device"].update(res.get("device", {}))
         result["errors"].update(res.get("errors", {}))
         if res.get("errors"):
             break  # backend already reported unreachable inside the child
@@ -230,12 +340,29 @@ def main():
     deadline = float(os.environ.get("BENCH_DEADLINE", "3300"))
 
     result = None
-    if _probe_backend(timeout=min(120.0, max(_remaining(deadline) * 0.1, 30.0))):
+    ok, is_tpu = _probe_backend(
+        timeout=min(120.0, max(_remaining(deadline) * 0.1, 30.0)))
+    if ok and is_tpu:
         result = _measure_tpu_per_query(sf, deadline, per_child_cap)
         if not result.get("rates"):
             result = None
+    elif ok:
+        log("default backend resolved to CPU (tunnel down); "
+            "skipping the TPU loop")
     else:
         log("default backend unreachable; going straight to CPU")
+
+    if result is not None and result.get("platform") not in (None, "cpu"):
+        _save_tpu(result)
+    elif result is not None and result.get("platform") == "cpu":
+        # defensive: a child may still resolve to CPU mid-run; its
+        # numbers are a baseline candidate, not a TPU result
+        log("TPU child resolved to CPU; treating as baseline input")
+        result = None
+    cached = _load_tpu(sf) if result is None else None
+    if cached is not None:
+        log(f"using cached TPU rates from {cached.get('measured_at')} "
+            f"(commit {cached.get('commit')})")
 
     # ---- CPU measurement: fallback result and/or the baseline --------
     baseline = None
@@ -249,6 +376,7 @@ def main():
         except Exception as e:
             log(f"baseline cache unreadable: {e}")
 
+    cpu_res = None
     need_cpu = baseline is None or result is None
     if need_cpu and _remaining(deadline) > 60:
         try:
@@ -256,7 +384,6 @@ def main():
                                  max(_remaining(deadline), 60.0))
         except Exception as e:
             log(f"cpu measurement failed: {type(e).__name__}: {e}")
-            cpu_res = None
         if cpu_res is not None and cpu_res.get("rates"):
             if baseline is None and not cpu_res.get("errors"):
                 baseline = cpu_res
@@ -265,9 +392,16 @@ def main():
                         json.dump(cpu_res, f, indent=1, sort_keys=True)
                 except Exception as e:
                     log(f"baseline cache write failed: {e}")
-            if result is None:
-                result = cpu_res
-                baseline = baseline or cpu_res
+    if result is None:
+        if cached is not None:
+            # stale TPU figure + fresh CPU figure beats a CPU-only line
+            result = cached
+            if cpu_res is not None and cpu_res.get("rates"):
+                result["cpu_rates"] = {
+                    k: round(v, 1) for k, v in cpu_res["rates"].items()}
+        elif cpu_res is not None and cpu_res.get("rates"):
+            result = cpu_res
+            baseline = baseline or cpu_res
 
     out = {
         "metric": "tpch_sf%g_q1_q6_q3_lineitem_rows_per_sec_geomean" % sf,
@@ -281,6 +415,16 @@ def main():
         out["value"] = round(_geomean(list(result["rates"].values())), 1)
         out["platform"] = result.get("platform")
         out["rates"] = {k: round(v, 1) for k, v in result["rates"].items()}
+        if result.get("device"):
+            out["device"] = result["device"]
+            if out["platform"] != "cpu":
+                # v5e HBM roofline for context on device-side GB/s
+                out["hbm_roofline_gbps"] = 819
+        if result.get("platform") == "tpu-cached":
+            out["tpu_measured_at"] = result.get("measured_at")
+            out["tpu_commit"] = result.get("commit")
+            if result.get("cpu_rates"):
+                out["cpu_rates"] = result["cpu_rates"]
         if result.get("errors"):
             out["partial"] = sorted(result["errors"])
         # ratios over the intersection only — a partial run never
