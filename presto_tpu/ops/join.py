@@ -35,18 +35,61 @@ from presto_tpu.page import Block, Page
 
 _I64_MAX = jnp.iinfo(jnp.int64).max
 
+# Direct-address lookup table cap: when the packed-key domain is dense
+# enough, the build also materializes CSR-style ``starts`` offsets over
+# the FULL key domain so every probe resolves its match range with two
+# int32 gathers instead of ~log2(build) serialized binary-search rounds
+# (the TPU answer to PagesHash.java:152's O(1) open-addressing probe).
+# Bounded in absolute size (HBM) and relative to the build (so a tiny
+# build over a huge sparse domain doesn't pay a domain-sized sort).
+DIRECT_DOMAIN_MAX = 1 << 26
+DIRECT_DOMAIN_PER_ROW = 64
+
+
+def _direct_table_profitable() -> bool:
+    """The direct table pays a domain-sized fused sort at build time to
+    make probes O(1) gathers.  That trade wins on TPU (binary-search
+    probes serialize ~log2(build) gather rounds; measured CPU-vs-TPU in
+    PERF.md) but LOSES on XLA:CPU, whose searchsorted is already cheap
+    and whose domain-sized sort is not (TPC-H Q3 SF1 measured 1.7x
+    slower with the table).  Env override PRESTO_TPU_DIRECT_JOIN=0/1
+    forces it off/on for A/B runs."""
+    import os as _os
+
+    force = _os.environ.get("PRESTO_TPU_DIRECT_JOIN")
+    if force is not None:
+        return force not in ("0", "false", "")
+    import jax as _jax
+
+    return _jax.default_backend() != "cpu"
+
+
+def packed_domain_size(domains) -> Optional[int]:
+    """Size of the packed-key code space [0, prod) when every key
+    column has a known domain (mirrors pack_or_hash_keys' exact path:
+    per-column cardinality hi-lo+2 with code 0 reserved for NULL)."""
+    if not domains or any(d is None for d in domains):
+        return None
+    prod = 1
+    for lo, hi in domains:
+        prod *= int(hi - lo + 2)
+    return prod if prod < (1 << 62) else None
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class JoinBuild:
     """Sorted build-side index (LookupSource analog)."""
 
-    sorted_keys: jax.Array  # int64 (cap,), +inf padded
+    sorted_keys: jax.Array  # packed keys (cap,), max-sentinel padded
     perm: jax.Array  # int32 (cap,): sorted pos -> build row
     page: Page  # original build page (payload source)
+    # optional direct-address table: starts[k] = first sorted position
+    # with key >= k, for k in [0, domain_size]; int32 (domain_size+1,)
+    starts: Optional[jax.Array] = None
 
     def tree_flatten(self):
-        return (self.sorted_keys, self.perm, self.page), None
+        return (self.sorted_keys, self.perm, self.page, self.starts), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -69,7 +112,7 @@ def build_join(
     kd = [c.compile(e)(page) for e in key_exprs]
     datas = [d for d, _ in kd]
     valids = [v for _, v in kd]
-    key, _ = pack_or_hash_keys(datas, valids, key_domains)
+    key, exact = pack_or_hash_keys(datas, valids, key_domains)
     live = page.row_mask
     if not null_safe:
         # NULL keys never participate: exclude rows with any null key
@@ -77,7 +120,50 @@ def build_join(
             live = live & v
     key = jnp.where(live, key, jnp.iinfo(key.dtype).max)
     order = jnp.argsort(key)
-    return JoinBuild(key[order], order.astype(jnp.int32), page)
+    sorted_keys = key[order]
+
+    starts = None
+    prod = (packed_domain_size(key_domains)
+            if exact and _direct_table_profitable() else None)
+    if prod is not None and prod <= min(
+        DIRECT_DOMAIN_MAX,
+        max(1 << 20, DIRECT_DOMAIN_PER_ROW * page.capacity),
+    ):
+        # one fused sort at build time buys O(1)-gather probes forever:
+        # dead/sentinel rows sort past prod-1 so they never enter a range
+        queries = jnp.arange(prod + 1, dtype=sorted_keys.dtype)
+        starts = jnp.searchsorted(
+            sorted_keys, queries, method="sort").astype(jnp.int32)
+    return JoinBuild(sorted_keys, order.astype(jnp.int32), page, starts)
+
+
+def _lookup_first(build: JoinBuild, key: jax.Array):
+    """(candidate sorted position, key-match mask) per probe row."""
+    if build.starts is not None:
+        d = build.starts.shape[0] - 1
+        kk = jnp.clip(key, 0, d - 1)
+        lo = build.starts[kk]
+        hi = build.starts[kk + 1]
+        in_dom = (key >= 0) & (key < d)
+        return jnp.clip(lo, 0, build.capacity - 1), (hi > lo) & in_dom
+    pos = jnp.searchsorted(build.sorted_keys, key)
+    pos_c = jnp.clip(pos, 0, build.capacity - 1)
+    return pos_c, build.sorted_keys[pos_c] == key
+
+
+def _lookup_range(build: JoinBuild, key: jax.Array):
+    """[lo, hi) sorted-position match range per probe row."""
+    if build.starts is not None:
+        d = build.starts.shape[0] - 1
+        kk = jnp.clip(key, 0, d - 1)
+        lo = build.starts[kk]
+        hi = build.starts[kk + 1]
+        in_dom = (key >= 0) & (key < d)
+        zero = jnp.zeros((), dtype=lo.dtype)
+        return jnp.where(in_dom, lo, zero), jnp.where(in_dom, hi, zero)
+    lo = jnp.searchsorted(build.sorted_keys, key, side="left")
+    hi = jnp.searchsorted(build.sorted_keys, key, side="right")
+    return lo, hi
 
 
 def _probe_keys(page: Page, key_exprs: Sequence[Expr], key_domains,
@@ -112,9 +198,8 @@ def probe_join(
     semi/anti emit probe blocks only, with the row mask filtered.
     """
     key, _ = _probe_keys(probe, probe_key_exprs, key_domains, null_safe)
-    pos = jnp.searchsorted(build.sorted_keys, key)
-    pos_c = jnp.clip(pos, 0, build.capacity - 1)
-    match = (build.sorted_keys[pos_c] == key) & probe.row_mask
+    pos_c, found = _lookup_first(build, key)
+    match = found & probe.row_mask
     build_row = build.perm[pos_c]
 
     if kind == "semi":
@@ -164,8 +249,7 @@ def probe_expand(
     operator/LookupOuterOperator.java, which streams unvisited build
     positions after all probes finish)."""
     key, _ = _probe_keys(probe, probe_key_exprs, key_domains, null_safe)
-    lo = jnp.searchsorted(build.sorted_keys, key, side="left")
-    hi = jnp.searchsorted(build.sorted_keys, key, side="right")
+    lo, hi = _lookup_range(build, key)
     counts = jnp.where(probe.row_mask, hi - lo, 0)
     if kind == "left":
         counts = jnp.where(probe.row_mask & (counts == 0), 1, counts)

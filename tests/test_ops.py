@@ -242,6 +242,73 @@ def test_composite_key_join():
     assert sorted(rows(out)) == [(1, 8, 12), (2, 7, 13)]
 
 
+def test_direct_table_join_paths(monkeypatch):
+    """The TPU direct-address table (CSR starts over the packed-key
+    domain) must agree with the searchsorted fallback on every probe
+    flavor; forced on via the A/B override since CPU test runs would
+    otherwise gate it off."""
+    monkeypatch.setenv("PRESTO_TPU_DIRECT_JOIN", "1")
+    doms = [(10, 30)]
+    b, p = _build_probe()
+    jb = build_join(b, [col(0, BIGINT)], key_domains=doms)
+    assert jb.starts is not None  # table actually engaged
+    out = probe_join(jb, p, [col(0, BIGINT)], key_domains=doms,
+                     kind="inner", build_output=[1])
+    assert sorted(rows(out)) == [(10, 6, 1.0), (20, 5, 2.0), (20, 9, 2.0), (30, 8, 3.0)]
+    outl = probe_join(jb, p, [col(0, BIGINT)], key_domains=doms,
+                      kind="left", build_output=[1])
+    assert (99, 7, None) in sorted(rows(outl)) and len(rows(outl)) == 5
+    semi = probe_join(jb, p, [col(0, BIGINT)], key_domains=doms, kind="semi")
+    assert sorted(r[0] for r in rows(semi)) == [10, 20, 20, 30]
+    anti = probe_join(jb, p, [col(0, BIGINT)], key_domains=doms, kind="anti")
+    assert [r[0] for r in rows(anti)] == [99]
+
+    # many-to-many expansion through the starts table
+    build = Page.from_arrays(
+        [np.array([1, 1, 2, 3, 3, 3], dtype=np.int64),
+         np.array([100, 101, 200, 300, 301, 302], dtype=np.int64)],
+        [BIGINT, BIGINT],
+    )
+    probe = Page.from_arrays(
+        [np.array([3, 1, 7], dtype=np.int64),
+         np.array([-1, -2, -3], dtype=np.int64)],
+        [BIGINT, BIGINT],
+    )
+    edoms = [(1, 7)]
+    jb2 = build_join(build, [col(0, BIGINT)], key_domains=edoms)
+    assert jb2.starts is not None
+    out2, total = probe_expand(jb2, probe, [col(0, BIGINT)], out_capacity=16,
+                               key_domains=edoms, build_output=[1])
+    assert int(total) == 5
+    assert sorted(rows(out2)) == [
+        (1, -2, 100), (1, -2, 101), (3, -1, 300), (3, -1, 301), (3, -1, 302)]
+
+    # null keys still never match with the table engaged
+    bn = Page.from_arrays(
+        [np.array([10, 20], dtype=np.int64)], [BIGINT],
+        valids=[np.array([True, False])],
+    )
+    pn = Page.from_arrays(
+        [np.array([10, 20], dtype=np.int64)], [BIGINT],
+        valids=[np.array([True, False])],
+    )
+    jbn = build_join(bn, [col(0, BIGINT)], key_domains=doms)
+    outn = probe_join(jbn, pn, [col(0, BIGINT)], key_domains=doms,
+                      kind="inner", build_output=[])
+    assert rows(outn) == [(10,)]
+
+
+def test_direct_table_respects_domain_budget(monkeypatch):
+    """A tiny build over a huge domain must NOT pay a domain-sized
+    sort: the per-row budget falls back to searchsorted."""
+    monkeypatch.setenv("PRESTO_TPU_DIRECT_JOIN", "1")
+    from presto_tpu.ops.join import DIRECT_DOMAIN_MAX
+
+    b, _ = _build_probe()
+    jb = build_join(b, [col(0, BIGINT)], key_domains=[(0, DIRECT_DOMAIN_MAX + 5)])
+    assert jb.starts is None
+
+
 # ---------------------------------------------------------------------------
 # sort / topn / limit
 # ---------------------------------------------------------------------------
