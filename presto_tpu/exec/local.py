@@ -46,6 +46,7 @@ from presto_tpu.planner.plan import (
     PlanNode,
     PrecomputedNode,
     ProjectNode,
+    RemoteSourceNode,
     SortNode,
     TableScanNode,
     TopNNode,
@@ -205,6 +206,7 @@ class LocalRunner:
         self.stats: Optional[QueryStats] = None
         # HBM accounting (memory/MemoryPool.java analog); None = untracked
         self.memory_pool = memory_pool
+        self.last_peak_bytes = 0
         # host-RAM spill fan-out when state exceeds the pool/threshold
         self.spill_partitions = spill_partitions
         # multi-producer ORDER BY: per-page sorts + order-preserving
@@ -255,6 +257,7 @@ class LocalRunner:
                 yield
             finally:
                 if self._mem is not None:
+                    self.last_peak_bytes = self._mem.peak
                     self._mem.release_all()
                     self._mem = None
 
@@ -314,7 +317,100 @@ class LocalRunner:
     def explain_with_stats(self, plan: PlanNode, stats: "QueryStats") -> str:
         from presto_tpu.planner.plan import plan_tree_str
 
-        return plan_tree_str(plan, stats=stats)
+        text = plan_tree_str(plan, stats=stats)
+        peak = getattr(self, "last_peak_bytes", 0)
+        if peak:
+            text = f"peak reserved memory: {peak / 1e6:.1f}MB\n" + text
+        return text
+
+    # ------------------------------------------------------------------
+    # EXPLAIN ANALYZE VERBOSE: exclusive per-operator attribution
+    # ------------------------------------------------------------------
+    def explain_analyze_verbose(self, plan: PlanNode) -> str:
+        """Fused chains make normal EXPLAIN ANALYZE times inclusive of
+        everything upstream.  VERBOSE mode re-executes every chain
+        prefix-by-prefix — scan alone, scan+filter, scan+filter+probe,
+        … — and reports the DELTAS as exclusive per-operator device
+        time (the reference's per-operator OperatorStats, recovered by
+        deliberately breaking fusion; the numbers cost extra runs and
+        differ slightly from the fused program's true schedule)."""
+        from presto_tpu.planner.plan import plan_tree_str
+
+        stats = QueryStats()
+        self.stats = stats
+        try:
+            self.run(plan)
+        finally:
+            self.stats = None
+        exclusive = self._exclusive_times(plan)
+        text = plan_tree_str(plan, stats=stats, exclusive=exclusive)
+        peak = getattr(self, "last_peak_bytes", 0)
+        if peak:
+            text = f"peak reserved memory: {peak / 1e6:.1f}MB\n" + text
+        return text
+
+    def _is_chain_member(self, n: PlanNode) -> bool:
+        return (
+            isinstance(n, (FilterNode, ProjectNode, CrossSingleNode))
+            or (isinstance(n, AggregationNode) and n.step == "partial")
+            or (isinstance(n, JoinNode) and not n.use_index and self._streaming(n))
+        )
+
+    def _exclusive_times(self, plan: PlanNode) -> Dict[PlanNode, float]:
+        out: Dict[PlanNode, float] = {}
+
+        def walk(n: PlanNode, parent_in_chain: bool) -> None:
+            member = self._is_chain_member(n)
+            if member and not parent_in_chain:
+                try:
+                    self._time_chain(n, out)
+                except Exception:
+                    pass  # attribution is best-effort diagnostics
+            if isinstance(n, (JoinNode, CrossSingleNode)):
+                walk(n.sources[0], member)  # probe side continues chain
+                walk(n.sources[1], False)  # build side is its own tree
+            else:
+                for s in n.sources:
+                    walk(s, member)
+
+        walk(plan, False)
+        return out
+
+    def _time_chain(self, root: PlanNode, out: Dict[PlanNode, float]) -> None:
+        """Time prefix programs of the chain rooted at ``root`` and
+        record per-member deltas (and the leaf's own source time)."""
+        import time
+
+        seq: List[PlanNode] = []
+        n = root
+        while self._is_chain_member(n):
+            seq.append(n)
+            n = n.sources[0] if isinstance(n, (JoinNode, CrossSingleNode)) else n.source
+        leaf = n
+
+        t0 = time.perf_counter()
+        pages = list(self._source_pages(leaf))
+        jax.block_until_ready(pages)
+        if isinstance(leaf, (TableScanNode, ValuesNode, PrecomputedNode)):
+            # breaker leaves (agg/sort/expanding join) keep inclusive
+            # wall from QueryStats; an "excl" there would double-count
+            out[leaf] = time.perf_counter() - t0
+        if not pages:
+            return
+
+        prev = 0.0
+        for prefix_root in reversed(seq):
+            joins: List[JoinNode] = []
+            stage = self._build_stage(prefix_root, joins)
+            consts = {f"build_{i}": self._materialize_build(j)
+                      for i, j in enumerate(joins)}
+            fn = jax.jit(stage) if self.jit else stage
+            jax.block_until_ready([fn(p, consts) for p in pages])  # compile
+            t0 = time.perf_counter()
+            jax.block_until_ready([fn(p, consts) for p in pages])
+            t = time.perf_counter() - t0
+            out[prefix_root] = max(t - prev, 0.0)
+            prev = t
 
     # ------------------------------------------------------------------
     def _execute_to_page(self, node: PlanNode) -> Page:
@@ -417,6 +513,18 @@ class LocalRunner:
             yield node.page
             return
 
+        if isinstance(node, RemoteSourceNode):
+            # worker-to-worker shuffle read: pull this stage's partition
+            # from every upstream task's output buffer
+            from presto_tpu.server.serde import deserialize_page
+            from presto_tpu.server.shuffle_client import pull_pages
+
+            dicts = [c.dictionary for c in node.channels]
+            for uri, tid in node.tasks:
+                for raw in pull_pages(uri, tid, node.buffer_id):
+                    yield deserialize_page(raw, dicts)
+            return
+
         if isinstance(node, UnionNode):
             chans = node.channels
             for k, src in enumerate(node.inputs):
@@ -516,7 +624,23 @@ class LocalRunner:
             fn = jax.jit(stage) if self.jit else stage
             self._chain_cache[node] = fn
         for page in self._source_pages(leaf):
-            yield fn(page, consts)
+            tag = None
+            mem = self._mem
+            if mem is not None:
+                from presto_tpu.memory import page_bytes
+
+                # transient: the in-flight scan page is accountable
+                # while the chain program consumes it, but soft — a
+                # streaming input can't be spilled; it is bounded by
+                # split capacity, not by the pool
+                tag = mem.reserve("scan_page", page_bytes(page),
+                                  enforce=False)
+            try:
+                yield fn(page, consts)
+            finally:
+                # early generator exit (LIMIT) must not leak the tag
+                if tag is not None:
+                    mem.free(tag)
 
     def _chain_leaf(self, node: PlanNode) -> PlanNode:
         if isinstance(node, (FilterNode, ProjectNode)):

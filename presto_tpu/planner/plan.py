@@ -272,6 +272,29 @@ class JoinNode(PlanNode):
 
 
 @dataclasses.dataclass(eq=False)
+class RemoteSourceNode(PlanNode):
+    """Leaf consuming another stage's task output buffers over DCN —
+    the worker-to-worker shuffle read (operator/ExchangeOperator.java:36
+    consuming execution/buffer/PartitionedOutputBuffer.java partitions
+    via HttpPageBufferClient).  ``producer`` is the upstream fragment's
+    plan, held ONLY for its output channel layout (types/dictionaries
+    must match what the upstream serialized); it is never executed by
+    the consuming worker."""
+
+    producer: PlanNode
+    tasks: List  # [(worker_uri, task_id)] upstream stage tasks
+    buffer_id: int = 0
+
+    @property
+    def sources(self):
+        return []
+
+    @property
+    def channels(self) -> List[Channel]:
+        return self.producer.channels
+
+
+@dataclasses.dataclass(eq=False)
 class CrossSingleNode(PlanNode):
     """Cross join against a guaranteed single-row relation — the
     planner's lowering of uncorrelated scalar subqueries (reference:
@@ -537,11 +560,14 @@ class OutputNode(PlanNode):
         return [Channel(n, c.type, c.dictionary, c.domain) for n, c in zip(self.names, src)]
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None) -> str:
+def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
+                  exclusive=None) -> str:
     """EXPLAIN-style rendering (planPrinter/PlanPrinter.java analog);
     pass the executor's QueryStats for EXPLAIN ANALYZE annotations and a
     planner StatsCalculator for cost estimates ({rows: N} like the
-    reference's estimate lines)."""
+    reference's estimate lines).  ``exclusive`` maps chain-member nodes
+    to per-operator EXCLUSIVE seconds (EXPLAIN ANALYZE VERBOSE — fused
+    chains re-run prefix-by-prefix; OperatorStats.java:38 analog)."""
     if estimator is None and stats is None and indent == 0:
         from presto_tpu.planner.stats import StatsCalculator
 
@@ -564,6 +590,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None) -
     elif isinstance(node, (LimitNode, TopNNode)):
         detail = f" {node.count}"
     ann = stats.annotation(node) if stats is not None else ""
+    if exclusive is not None and node in exclusive:
+        ann += f"  [excl={exclusive[node] * 1e3:.1f}ms]"
     if estimator is not None:
         try:
             ann += "  {rows: %d}" % int(estimator.rows(node))
@@ -571,5 +599,5 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None) -
             pass
     out = f"{pad}- {name}{detail}{ann}\n"
     for s in node.sources:
-        out += plan_tree_str(s, indent + 1, stats, estimator)
+        out += plan_tree_str(s, indent + 1, stats, estimator, exclusive)
     return out

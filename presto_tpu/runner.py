@@ -61,7 +61,14 @@ class QueryRunner:
         self.session = session or Session()
         self.binder = Binder(catalog)
         self._jit_default = jit
-        self.memory_pool = memory_pool
+        # Accounting is always-on (memory/MemoryPool.java:43 tracks
+        # every operator unconditionally): None selects the process
+        # pool sized to detected HBM/RAM; False disables (tests only).
+        if memory_pool is None:
+            from presto_tpu.memory import default_memory_pool
+
+            memory_pool = default_memory_pool()
+        self.memory_pool = memory_pool or None
         self.access_control = access_control or AccessControl()
         self.events = EventListenerManager()
         # per-session explicit transaction (transaction/TransactionManager.java)
@@ -139,7 +146,9 @@ class QueryRunner:
 
                 text = explain_distributed(plan, catalog=self.catalog)
                 return MaterializedResult(["Query Plan"], [VARCHAR], [(text,)])
-            if stmt.analyze:
+            if stmt.analyze and getattr(stmt, "verbose", False):
+                text = self.executor.explain_analyze_verbose(plan)
+            elif stmt.analyze:
                 stats = QueryStats()
                 self.executor.stats = stats
                 try:

@@ -319,6 +319,9 @@ class Explain(Node):
     query: "Query"
     analyze: bool = False
     distributed: bool = False  # EXPLAIN (TYPE DISTRIBUTED)
+    # EXPLAIN ANALYZE VERBOSE: exclusive per-operator times by
+    # re-running chain prefixes (fusion deliberately broken)
+    verbose: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -797,6 +797,7 @@ def parse_statement(sql: str) -> ast.Node:
     p = Parser(sql)
     if p.accept("explain"):
         analyze = bool(p.accept("analyze"))
+        verbose = analyze and bool(p.accept_word("verbose"))
         distributed = False
         if p.accept("("):
             while not p.accept(")"):
@@ -810,7 +811,7 @@ def parse_statement(sql: str) -> ast.Node:
                     raise SyntaxError(f"bad EXPLAIN option at {p.tok!r}")
         q = p._query()
         p.accept(";")
-        return ast.Explain(q, analyze, distributed)
+        return ast.Explain(q, analyze, distributed, verbose)
     if p.accept("set"):
         p.expect("session")
         name = p.ident()

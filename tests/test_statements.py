@@ -30,6 +30,25 @@ def test_explain_analyze(runner):
     assert "rows=" in text and "wall=" in text
 
 
+def test_explain_analyze_verbose_exclusive(runner):
+    """VERBOSE re-runs chain prefixes to attribute EXCLUSIVE time to
+    each fused chain member — scan, filter, and each join probe get
+    their own [excl=..] line (VERDICT: fusion-breaking attribution)."""
+    res = runner.execute(
+        "explain analyze verbose "
+        "select o_orderpriority, count(*) from orders, customer "
+        "where o_custkey = c_custkey and o_totalprice > 1000 "
+        "group by o_orderpriority")
+    text = res.rows[0][0]
+    # inclusive stats still present, plus exclusive attribution on the
+    # scan leaf, the filter, and the streaming probe
+    assert "wall=" in text
+    assert text.count("excl=") >= 3
+    for line in text.splitlines():
+        if "- TableScan orders" in line or "- Filter" in line or "- Join" in line:
+            assert "excl=" in line, line
+
+
 def test_set_session_and_show(runner):
     res = runner.execute("show session")
     names = [r[0] for r in res.rows]
