@@ -58,12 +58,16 @@ class MemoryPool:
         self.peak = 0
         self._killed: set = set()
 
-    def reserve(self, tag: str, nbytes: int) -> None:
+    def reserve(self, tag: str, nbytes: int, enforce: bool = True) -> None:
+        """``enforce=False`` counts the bytes (peak/attribution) without
+        failing on over-limit — for transient streaming state that
+        cannot be spilled or retried (in-flight scan pages), bounded by
+        split capacity rather than by the pool."""
         with self._lock:
             qid = tag.split("/", 1)[0]
             if qid in self._killed:
                 raise QueryKilledError(f"query {qid} killed by the memory manager")
-            if self.reserved + nbytes > self.limit:
+            if enforce and self.reserved + nbytes > self.limit:
                 raise ExceededMemoryLimitError(tag, nbytes, self.reserved, self.limit)
             self._tagged[tag] = self._tagged.get(tag, 0) + nbytes
             self.reserved += nbytes
@@ -98,26 +102,80 @@ class MemoryPool:
 
 class QueryMemoryContext:
     """Per-query view over a pool (QueryContext analog): unique tags
-    per allocation site, freed together at query end."""
+    per allocation site, freed together at query end.  Tracks its own
+    reserved/peak so QueryStats can report per-query peak bytes."""
 
     def __init__(self, pool: MemoryPool, query_id: str = "q"):
         self.pool = pool
         self.query_id = query_id
         self._seq = 0
+        self.reserved = 0
+        self.peak = 0
 
-    def reserve(self, what: str, nbytes: int) -> str:
+    def reserve(self, what: str, nbytes: int, enforce: bool = True) -> str:
         self._seq += 1
         tag = f"{self.query_id}/{what}#{self._seq}"
-        self.pool.reserve(tag, nbytes)
+        self.pool.reserve(tag, nbytes, enforce=enforce)
+        self.reserved += nbytes
+        self.peak = max(self.peak, self.reserved)
         return tag
 
     def reserve_page(self, what: str, page) -> str:
         return self.reserve(what, page_bytes(page))
 
     def free(self, tag: str) -> None:
+        self.reserved -= self.pool.tags().get(tag, 0)
         self.pool.free(tag)
 
     def release_all(self) -> None:
         for tag in list(self.pool.tags()):
             if tag.startswith(self.query_id + "/"):
                 self.pool.free(tag)
+        self.reserved = 0
+
+
+# ---------------------------------------------------------------------------
+# default (always-on) pool
+# ---------------------------------------------------------------------------
+
+_DEFAULT_POOL: Optional[MemoryPool] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def detected_memory_limit() -> int:
+    """Accountable-memory budget for the default pool: 90% of the
+    device's reported HBM on an accelerator, half of host RAM on the
+    CPU backend.  PRESTO_TPU_MEMORY_LIMIT_BYTES overrides (testing and
+    deployments with reserved headroom)."""
+    import os
+
+    env = os.environ.get("PRESTO_TPU_MEMORY_LIMIT_BYTES")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit * 0.9)
+    except Exception:
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            kb = int(next(ln for ln in f if ln.startswith("MemTotal")).split()[1])
+        return kb * 1024 // 2
+    except Exception:
+        return 16 << 30
+
+
+def default_memory_pool() -> MemoryPool:
+    """Process-wide pool shared by every runner that doesn't bring its
+    own — the single-HBM worker pool (memory/LocalMemoryManager.java
+    role).  Accounting is unconditional: an untracked path that works
+    at SF0.01 OOMs silently at SF100."""
+    global _DEFAULT_POOL
+    with _DEFAULT_LOCK:
+        if _DEFAULT_POOL is None:
+            _DEFAULT_POOL = MemoryPool(detected_memory_limit())
+        return _DEFAULT_POOL

@@ -63,9 +63,9 @@ class PeekPool(MemoryPool):
         super().__init__(limit)
         self._peek_tags = {}
 
-    def reserve(self, tag, nbytes):
+    def reserve(self, tag, nbytes, enforce=True):
         self._peek_tags[tag] = nbytes
-        super().reserve(tag, nbytes)
+        super().reserve(tag, nbytes, enforce=enforce)
 
 
 def test_agg_spill_memory_trigger(catalog):
