@@ -35,6 +35,7 @@ from presto_tpu.planner.plan import (
     OutputNode,
     PlanNode,
     ProjectNode,
+    RemoteSourceNode,
     SortNode,
     TableScanNode,
     TopNNode,
@@ -201,6 +202,14 @@ def plan_to_json(node: PlanNode) -> dict:
                 "rows": [list(r) for r in node.rows]}
     if isinstance(node, OutputNode):
         return {"k": "output", "src": plan_to_json(node.source), "names": list(node.names)}
+    if isinstance(node, RemoteSourceNode):
+        return {
+            "k": "remote",
+            # the upstream fragment travels for its channel layout only
+            "producer": plan_to_json(node.producer),
+            "tasks": [[u, t] for u, t in node.tasks],
+            "buffer": node.buffer_id,
+        }
     raise TypeError(f"unserializable plan node {type(node).__name__}")
 
 
@@ -267,6 +276,12 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
         )
     if k == "output":
         return OutputNode(plan_from_json(d["src"], catalog), list(d["names"]))
+    if k == "remote":
+        return RemoteSourceNode(
+            producer=plan_from_json(d["producer"], catalog),
+            tasks=[(u, t) for u, t in d["tasks"]],
+            buffer_id=d["buffer"],
+        )
     raise KeyError(k)
 
 
@@ -296,6 +311,31 @@ def serialize_page(page: Page, compress: bool = True) -> bytes:
         z = zlib.compress(payload, 1)
         if len(z) < len(payload):
             header["z"] = len(payload)  # uncompressed size
+            payload = z
+    hjson = json.dumps(header).encode()
+    return len(hjson).to_bytes(4, "little") + hjson + payload
+
+
+def serialize_host_page(hp, compress: bool = True) -> bytes:
+    """serialize_page for a spill-tier HostPage (numpy-backed, already
+    compacted) — the partitioned-output write path serializes each
+    bucket straight from host RAM without a device round trip."""
+    import zlib
+
+    n = int(hp.mask.sum())
+    header = {"types": [], "n": n}
+    payload = b""
+    for data, valid, t, _dic in hp.columns:
+        header["types"].append(
+            {"t": type_to_json(t), "dtype": str(data.dtype),
+             "shape": list(data.shape[1:])}
+        )
+        payload += np.ascontiguousarray(data).tobytes()
+        payload += np.packbits(valid).tobytes()
+    if compress:
+        z = zlib.compress(payload, 1)
+        if len(z) < len(payload):
+            header["z"] = len(payload)
             payload = z
     hjson = json.dumps(header).encode()
     return len(hjson).to_bytes(4, "little") + hjson + payload

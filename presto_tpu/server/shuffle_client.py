@@ -1,0 +1,94 @@
+"""Shuffle read client: pull one task output buffer over HTTP.
+
+Reference analog: ``operator/HttpPageBufferClient.java:88`` — the
+long-poll GET of ``/v1/task/{id}/results/{buffer}/{token}`` with token
+acknowledgement (``server/TaskResource.java:239,298``), at-least-once
+delivery de-duplicated by the client-held token, plus a no-progress
+deadline so a wedged producer fails the pull instead of hanging it.
+
+Used by BOTH tiers of the DCN exchange: the coordinator pulling a root
+stage, and a worker's RemoteSource leaf pulling an upstream stage's
+partition buffer (worker-to-worker shuffle — the ExchangeOperator.java:36
+consumption path).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Iterator, List
+
+
+class TaskPullFailed(Exception):
+    """The producing task reported FAILED (deterministic query error:
+    the failure travels; the worker is not to blame)."""
+
+
+def _parse_batch(raw: bytes) -> List[bytes]:
+    npages = int.from_bytes(raw[:4], "little")
+    off = 4
+    out = []
+    for _ in range(npages):
+        ln = int.from_bytes(raw[off:off + 8], "little")
+        off += 8
+        out.append(raw[off:off + ln])
+        off += ln
+    return out
+
+
+def _task_error(uri: str, task_id: str) -> str:
+    try:
+        with urllib.request.urlopen(f"{uri}/v1/task/{task_id}", timeout=5.0) as r:
+            info = json.load(r)
+        if info.get("state") == "FAILED":
+            return info.get("error") or "task failed"
+    except Exception:
+        pass
+    return ""
+
+
+def pull_pages(uri: str, task_id: str, buffer_id: int = 0,
+               timeout: float = 300.0, poll_timeout: float = 30.0,
+               ) -> Iterator[bytes]:
+    """Yield serialized pages from one buffer of a remote task until
+    the producer marks it complete.  Raises TaskPullFailed on producer
+    task failure, TimeoutError after ``timeout`` with no progress."""
+    uri = uri.rstrip("/")
+    token = 0
+    last_progress = time.monotonic()
+    while True:
+        if time.monotonic() - last_progress > timeout:
+            raise TimeoutError(
+                f"buffer {buffer_id} of task {task_id} on {uri} made no "
+                f"progress for {timeout}s")
+        try:
+            with urllib.request.urlopen(
+                f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}",
+                timeout=poll_timeout,
+            ) as resp:
+                batch = _parse_batch(resp.read())
+                nxt = int(resp.headers.get("X-Next-Token", token))
+                complete = resp.headers.get("X-Complete") == "1"
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                detail = ""
+            detail = detail or _task_error(uri, task_id)
+            if detail:
+                raise TaskPullFailed(detail)
+            raise
+        except TimeoutError:
+            continue  # long-poll expiry, not lack of progress
+        yield from batch
+        if nxt > token:
+            token = nxt
+            last_progress = time.monotonic()
+            urllib.request.urlopen(
+                f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}/acknowledge",
+                timeout=poll_timeout,
+            ).close()
+        if complete:
+            return

@@ -28,7 +28,11 @@ from presto_tpu.exec.local import LocalRunner
 from presto_tpu.server.buffers import BufferAborted, TaskOutputBuffer
 from presto_tpu.server.serde import plan_from_json, serialize_page
 
-_RESULTS_RE = re.compile(r"^/v1/task/([\w-]+)/results/(\d+)(/acknowledge)?$")
+# /v1/task/{id}/results/{token} (single-stream, buffer 0) or
+# /v1/task/{id}/results/{buffer}/{token} (partitioned output — the
+# reference's bufferId path, server/TaskResource.java:239)
+_RESULTS_RE = re.compile(
+    r"^/v1/task/([\w-]+)/results/(\d+)(?:/(\d+))?(/acknowledge)?$")
 _TASK_RE = re.compile(r"^/v1/task/([\w-]+)$")
 
 # task states (execution/TaskState.java:21 — PLANNED/RUNNING/FINISHED/
@@ -37,14 +41,23 @@ RUNNING, FINISHED, FAILED, ABORTED = "RUNNING", "FINISHED", "FAILED", "ABORTED"
 
 
 class _Task:
-    def __init__(self, task_id: str, buffer_bytes: int):
+    def __init__(self, task_id: str, buffer_bytes: int, n_buffers: int = 1):
         import time
 
         self.task_id = task_id
-        self.buffer = TaskOutputBuffer(max_bytes=buffer_bytes)
+        # one buffer per output partition (PartitionedOutputBuffer's
+        # ClientBuffer-per-partition layout; n_buffers=1 = TaskOutput)
+        self.buffers = [
+            TaskOutputBuffer(max_bytes=max(buffer_bytes // n_buffers, 1 << 20))
+            for _ in range(n_buffers)
+        ]
         self.state = RUNNING
         self.error: Optional[str] = None
         self.last_access = time.monotonic()
+
+    @property
+    def buffer(self) -> TaskOutputBuffer:
+        return self.buffers[0]
 
     def touch(self) -> None:
         import time
@@ -122,15 +135,23 @@ class WorkerServer:
                         self._send(404, b"{}")
                         return
                     task.touch()
-                    token = int(m.group(2))
-                    if m.group(3):  # acknowledge
-                        task.buffer.acknowledge(token)
+                    if m.group(3) is not None:  # /results/{buffer}/{token}
+                        buffer_id, token = int(m.group(2)), int(m.group(3))
+                    else:  # legacy /results/{token} = buffer 0
+                        buffer_id, token = 0, int(m.group(2))
+                    if buffer_id >= len(task.buffers):
+                        self._send(404, json.dumps(
+                            {"error": f"no buffer {buffer_id}"}).encode())
+                        return
+                    buf = task.buffers[buffer_id]
+                    if m.group(4):  # acknowledge
+                        buf.acknowledge(token)
                         self._send(200, b"{}")
                         return
                     maxsize = 8 << 20
                     if "maxsize=" in self.path:
                         maxsize = int(self.path.split("maxsize=")[1].split("&")[0])
-                    pages, nxt, done, err = task.buffer.get(token, maxsize)
+                    pages, nxt, done, err = buf.get(token, maxsize)
                     if err is not None:
                         self._send(500, json.dumps({"error": err}).encode())
                         return
@@ -179,7 +200,8 @@ class WorkerServer:
                             {"error": "worker is shutting down"}).encode())
                         return
                     tid = m.group(1)
-                    task = outer._create_task(tid, req["fragment"])
+                    task = outer._create_task(tid, req["fragment"],
+                                              req.get("output"))
                     self._send(200, json.dumps(
                         {"taskId": tid, "state": task.state}).encode())
                     return
@@ -215,12 +237,19 @@ class WorkerServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     # ------------------------------------------------------------------
-    def _create_task(self, task_id: str, fragment_json: dict) -> _Task:
+    def _create_task(self, task_id: str, fragment_json: dict,
+                     output_spec: Optional[dict] = None) -> _Task:
+        """``output_spec``: ``{"partitions": K, "key_indices": [...],
+        "domains": [[lo,hi]|null...]}`` routes each produced page's rows
+        into K per-partition buffers by key hash (the
+        PartitionedOutputOperator + PartitionedOutputBuffer write path);
+        absent = single-stream output (TaskOutputOperator)."""
+        n_buffers = int(output_spec["partitions"]) if output_spec else 1
         with self._tasks_lock:
             existing = self._tasks.get(task_id)
             if existing is not None:  # idempotent create (client retry)
                 return existing
-            task = _Task(task_id, self.buffer_bytes)
+            task = _Task(task_id, self.buffer_bytes, n_buffers)
             self._tasks[task_id] = task
 
         mem_ctx = None
@@ -236,6 +265,40 @@ class WorkerServer:
             memory context re-binds around every step."""
             try:
                 fragment = plan_from_json(fragment_json, self.catalog)
+                partition_fn = None
+                check_partial_mg = None
+                if output_spec is not None:
+                    from presto_tpu.exec.spill import make_bucket_fn
+                    from presto_tpu.expr.ir import ColumnRef
+
+                    chans = fragment.channels
+                    keys = [ColumnRef(type=chans[i].type, index=i)
+                            for i in output_spec.get("key_indices", [])]
+                    domains = [tuple(d) if d else None
+                               for d in output_spec.get("domains", [])] or None
+                    partition_fn = make_bucket_fn(keys, domains, n_buffers,
+                                                  jit=self.runner.jit)
+                    # a truncated partial-agg page scatters its mg live
+                    # states across partitions, hiding the overflow from
+                    # every downstream capacity check — so the PRODUCER
+                    # detects it (LocalRunner._check_overflow's role at
+                    # the exchange boundary) and fails for a retry
+                    from presto_tpu.planner.plan import AggregationNode
+
+                    if (isinstance(fragment, AggregationNode)
+                            and fragment.step == "partial"
+                            and fragment.group_exprs):
+                        check_partial_mg = fragment.max_groups
+                        # exact-capacity aggs legitimately fill every
+                        # slot (domain product <= capacity): live == mg
+                        # is completeness there, not truncation
+                        kd = fragment.key_domains
+                        if kd and all(d is not None for d in kd):
+                            prod = 1
+                            for lo, hi in kd:
+                                prod *= hi - lo + 2
+                            if prod <= fragment.max_groups:
+                                check_partial_mg = None
                 gen = self.runner._pages(fragment)
                 while True:
                     if mem_ctx is not None:
@@ -247,17 +310,33 @@ class WorkerServer:
                     finally:
                         if mem_ctx is not None:
                             self.runner._mem = None
-                    task.buffer.enqueue(serialize_page(p))
+                    if partition_fn is None:
+                        task.buffer.enqueue(serialize_page(p))
+                    else:
+                        from presto_tpu.exec.spill import partition_to_host
+                        from presto_tpu.server.serde import serialize_host_page
+
+                        parts = partition_to_host(p, partition_fn(p), n_buffers)
+                        live = sum(hp.num_rows for hp in parts if hp is not None)
+                        if check_partial_mg is not None and live >= check_partial_mg:
+                            raise RuntimeError(
+                                f"GroupCapacityExceeded: partial aggregation "
+                                f"truncated at {check_partial_mg} groups")
+                        for k, hp in enumerate(parts):
+                            if hp is not None:
+                                task.buffers[k].enqueue(serialize_host_page(hp))
                     yield
                 task.state = FINISHED
-                task.buffer.set_complete()
+                for buf in task.buffers:
+                    buf.set_complete()
                 self.tasks_executed += 1
             except BufferAborted:
                 task.state = ABORTED
             except Exception as e:
                 task.state = FAILED
                 task.error = f"{type(e).__name__}: {e}"
-                task.buffer.fail(task.error)
+                for buf in task.buffers:
+                    buf.fail(task.error)
             finally:
                 if mem_ctx is not None:
                     mem_ctx.release_all()
@@ -269,7 +348,8 @@ class WorkerServer:
         with self._tasks_lock:
             task = self._tasks.pop(task_id, None)
         if task is not None:
-            task.buffer.abort()
+            for buf in task.buffers:
+                buf.abort()
             if task.state == RUNNING:
                 task.state = ABORTED
 

@@ -70,6 +70,51 @@ def test_multihost_q3_joins(cluster):
     _check(local, multi, QUERIES[3])
 
 
+def test_two_stage_exchange_no_coordinator_merge(cluster):
+    """Grouped aggregation with >=2 workers must run the worker-to-
+    worker partitioned exchange: partial states flow stage-1 -> stage-2
+    between workers and the coordinator only drains the root stage
+    (ExchangeOperator.java:36 + PartitionedOutputBuffer.java analog).
+    The coordinator-merge fallback must NOT be used."""
+    local, multi, workers = cluster
+    sql = ("SELECT o_orderpriority, count(*), sum(o_totalprice) "
+           "FROM orders GROUP BY o_orderpriority")
+    original = multi._run_agg_coordinator_merge
+
+    def fail_loudly(*a, **kw):
+        raise AssertionError("coordinator-merge fallback used; the "
+                             "two-stage exchange should have handled this")
+
+    multi._run_agg_coordinator_merge = fail_loudly
+    try:
+        _check(local, multi, sql)
+    finally:
+        multi._run_agg_coordinator_merge = original
+
+
+def test_two_stage_capacity_retry(cluster):
+    """A max_groups far below the true group count must be detected at
+    the exchange boundary (producer-side truncation check) and retried
+    with doubled capacity until exact — never silently truncated."""
+    from presto_tpu.planner.plan import AggregationNode
+
+    local, multi, _ = cluster
+    sql = ("SELECT o_custkey, count(*) c FROM orders GROUP BY o_custkey")
+    expected = local.executor.run(local.plan(sql)).rows
+    assert len(expected) > 8
+    plan = local.binder.plan(sql)
+
+    def shrink(node):
+        if isinstance(node, AggregationNode):
+            node.max_groups = 4
+        for s in node.sources:
+            shrink(s)
+
+    shrink(plan)
+    actual = multi.run(plan).rows
+    assert sorted(actual) == sorted(expected)
+
+
 def test_worker_failure_reschedules(cluster):
     """Kill one worker: its splits must be re-run on survivors and the
     result stay exact (beyond-reference: the reference fails the query
