@@ -189,7 +189,7 @@ def _is_streaming_join(node: JoinNode) -> bool:
     cross-page match state."""
     if node.kind == "full":
         return False
-    return node.kind in ("semi", "anti") or node.unique_build
+    return node.kind in ("semi", "anti", "mark") or node.unique_build
 
 
 class LocalRunner:
@@ -594,7 +594,10 @@ class LocalRunner:
         yield from self._chain_pages(node)
 
     def _streaming(self, node: JoinNode) -> bool:
-        return _is_streaming_join(node) and node not in self._force_expanding
+        # index joins must not fuse into chains: the chain builder would
+        # materialize the full build scan instead of point lookups
+        return (_is_streaming_join(node) and node not in self._force_expanding
+                and not node.use_index)
 
     # ------------------------------------------------------------------
     # streaming-chain compilation
@@ -893,7 +896,7 @@ class LocalRunner:
                 node.right.output_types, 1)
             build = build_join(bpage, right_keys, key_domains=None)
             self._account("index_join_build", build.page, node)
-            if node.kind in ("semi", "anti"):
+            if node.kind in ("semi", "anti", "mark"):
                 yield probe_join(build, p, left_keys, key_domains=None,
                                  kind=node.kind, build_output=build_output)
             elif node.unique_build:
@@ -969,7 +972,7 @@ class LocalRunner:
             matched_acc = None
             for hp in pbuckets[k]:
                 p = hp.rehydrate()
-                if kind in ("semi", "anti"):
+                if kind in ("semi", "anti", "mark"):
                     yield probe_join(build, p, left_keys, key_domains=kd,
                                      kind=kind, build_output=build_output,
                                      null_safe=ns)

@@ -230,6 +230,16 @@ def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Op
     compiler's null LUT)."""
     if isinstance(e, ColumnRef):
         return dictionaries[e.index]
+    if isinstance(e, Literal) and e.value is not None:
+        # projected string constant ('store' AS channel): a singleton
+        # dictionary whose only code is the literal (cached so repeated
+        # plans share the identity-hashed Dictionary)
+        key = ("$lit", e.value)
+        if key not in _DERIVED_DICTS:
+            _DERIVED_DICTS[key] = (None, Dictionary([e.value]), [False])
+        return _DERIVED_DICTS[key][1]
+    if isinstance(e, Call) and e.fn in ("case", "if", "coalesce"):
+        return merged_string_dictionary(e, dictionaries)
     if isinstance(e, Call) and e.fn in STRING_TRANSFORM_FNS:
         col = _transform_column(e)
         if col is None:
@@ -249,6 +259,52 @@ def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Op
             _DERIVED_DICTS[key] = (inner, d, nulls)
         return _DERIVED_DICTS[key][1]
     return None
+
+
+def _string_case_branches(e: "Call") -> Sequence[Expr]:
+    """Value-producing operands of a case/if/coalesce expression."""
+    if e.fn == "case":
+        return list(e.args[1::2]) + [e.args[-1]]
+    if e.fn == "if":
+        return [e.args[1], e.args[2]]
+    return list(e.args)  # coalesce
+
+
+def merged_string_dictionary(e: "Call", dictionaries) -> Optional[Dictionary]:
+    """Union dictionary for a string-valued case/if/coalesce: every
+    branch is either a literal or an expression with a known dictionary;
+    branch codes remap into the union at compile time (the compiler's
+    _compile_string_case must build the SAME dictionary — cached by
+    branch identity so both see one object)."""
+    parts = []
+    key_parts = []
+    for b in _string_case_branches(e):
+        if isinstance(b, Literal):
+            parts.append(("lit", b.value))
+            key_parts.append(("L", b.value))
+        else:
+            d = expr_dictionary(b, dictionaries)
+            if d is None:
+                return None
+            parts.append(("dict", d))
+            key_parts.append(("D", id(d)))
+    key = ("$case",) + tuple(key_parts)
+    if key not in _DERIVED_DICTS:
+        values: list = []
+        seen: dict = {}
+        for kind, v in parts:
+            vals = [v] if kind == "lit" else v.values
+            for val in vals:
+                if val is not None and val not in seen:
+                    seen[val] = len(values)
+                    values.append(val)
+        d = Dictionary(values if values else [""])
+        # pin the branch dictionaries in the value tuple: the key uses
+        # their id()s, and a GC'd-then-reallocated Dictionary must not
+        # hit a stale entry (same contract as the transform-dict cache)
+        pins = tuple(v for kind, v in parts if kind == "dict")
+        _DERIVED_DICTS[key] = (pins, d, [False] * len(d.values))
+    return _DERIVED_DICTS[key][1]
 
 
 def _transform_column(e: "Call") -> Optional[Expr]:
@@ -443,6 +499,8 @@ class ExprCompiler:
 
             return run_dadd
         if fn == "if":
+            if self._is_dict_string_case(expr):
+                return self._compile_string_case(expr)
             out_t = expr.type
             c = self.compile(expr.args[0])
             t = self._compile_operand(expr.args[1], out_t)
@@ -458,8 +516,12 @@ class ExprCompiler:
 
             return run_if
         if fn == "case":
+            if self._is_dict_string_case(expr):
+                return self._compile_string_case(expr)
             return self._compile_case(expr)
         if fn == "coalesce":
+            if self._is_dict_string_case(expr):
+                return self._compile_string_case(expr)
             out_t = expr.type
             parts = [(self._compile_operand(x, out_t), x.type) for x in expr.args]
 
@@ -1288,9 +1350,14 @@ class ExprCompiler:
     def _compile_literal(self, expr: Literal) -> CompiledExpr:
         t = expr.type
         if t.is_string and expr.value is not None:
-            raise ValueError(
-                "string literal must be resolved against a dictionary via eq/in/like"
-            )
+            # projected constant: code 0 of the literal's singleton
+            # dictionary (expr_dictionary supplies the mapping)
+            def run_const_str(page):
+                n = page.capacity
+                return (jnp.zeros(n, dtype=jnp.int32),
+                        jnp.ones(n, dtype=jnp.bool_))
+
+            return run_const_str
         val = expr.value
         if val is None:
 
@@ -1993,6 +2060,95 @@ class ExprCompiler:
             return out.astype(jnp.int64), valid
 
         return run_diff
+
+    def _is_dict_string_case(self, expr: Call) -> bool:
+        t = expr.type
+        return (getattr(t, "is_string", False)
+                and not getattr(t, "is_raw_string", False))
+
+    def _compile_string_case(self, expr: Call) -> CompiledExpr:
+        """case/if/coalesce producing dictionary varchar: each branch's
+        codes remap into the union dictionary (merged_string_dictionary
+        — the channel metadata layer attaches the same object), so
+        SELECT CASE ... THEN 'big' ELSE 'small' END decodes correctly
+        instead of emitting branch-local code 0s."""
+        merged = merged_string_dictionary(expr, self.dictionaries)
+        if merged is None:
+            raise ValueError(
+                "string-valued case/if/coalesce branch has no resolvable "
+                "dictionary")
+        index = {v: i for i, v in enumerate(merged.values)}
+
+        def branch_fn(b: Expr) -> CompiledExpr:
+            if isinstance(b, Literal):
+                code = index.get(b.value, 0)
+                ok = b.value is not None
+
+                def run_lit(page, code=code, ok=ok):
+                    n = page.capacity
+                    return (jnp.full(n, code, dtype=jnp.int32),
+                            jnp.full(n, ok, dtype=jnp.bool_))
+
+                return run_lit
+            inner = self.compile(b)
+            bdict = expr_dictionary(b, self.dictionaries)
+            lut = jnp.asarray(
+                [index.get(v, 0) for v in bdict.values], dtype=jnp.int32)
+
+            def run_remap(page, inner=inner, lut=lut):
+                d, v = inner(page)
+                codes = jnp.clip(d.astype(jnp.int32), 0, lut.shape[0] - 1)
+                return lut[codes], v
+
+            return run_remap
+
+        if expr.fn == "coalesce":
+            parts = [branch_fn(b) for b in expr.args]
+
+            def run_coalesce_s(page):
+                data = valid = None
+                for f in parts:
+                    d, v = f(page)
+                    if data is None:
+                        data, valid = d, v
+                    else:
+                        data = _where_rows(jnp.logical_not(valid), d, data)
+                        valid = valid | v
+                return data, valid
+
+            return run_coalesce_s
+
+        if expr.fn == "if":
+            c = self.compile(expr.args[0])
+            t_f = branch_fn(expr.args[1])
+            f_f = branch_fn(expr.args[2])
+
+            def run_if_s(page):
+                (dc, vc), (dt, vt), (df, vf) = c(page), t_f(page), f_f(page)
+                cond = dc & vc
+                return _where_rows(cond, dt, df), jnp.where(cond, vt, vf)
+
+            return run_if_s
+
+        # case: [when1, then1, ..., else]
+        args = expr.args
+        pairs = [(self.compile(args[i]), branch_fn(args[i + 1]))
+                 for i in range(0, len(args) - 1, 2)]
+        else_f = branch_fn(args[-1])
+
+        def run_case_s(page):
+            data, valid = else_f(page)
+            taken = jnp.zeros(page.capacity, dtype=jnp.bool_)
+            for wf, tf in pairs:
+                wd, wv = wf(page)
+                td, tv = tf(page)
+                cond = wd & wv & jnp.logical_not(taken)
+                data = _where_rows(cond, td, data)
+                valid = jnp.where(cond, tv, valid)
+                taken = taken | (wd & wv)
+            return data, valid
+
+        return run_case_s
 
     def _compile_case(self, expr: Call) -> CompiledExpr:
         # args = [when1, then1, when2, then2, ..., else]

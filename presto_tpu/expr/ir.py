@@ -206,18 +206,22 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
     if fn in ("regexp_like", "starts_with", "ends_with", "contains_str",
               "is_json_scalar"):
         return BOOLEAN
-    if fn == "coalesce":
-        out = ts[0]
-        for t in ts[1:]:
-            out = common_super_type(out, t)
-        return out
-    if fn == "if":
-        return common_super_type(ts[1], ts[2])
-    if fn == "case":
-        # args = [when1, then1, ..., else]: supertype over all branches
-        branch_ts = [ts[i] for i in range(1, len(ts) - 1, 2)] + [ts[-1]]
-        out = branch_ts[0]
-        for t in branch_ts[1:]:
+    if fn in ("coalesce", "if", "case"):
+        # supertype over value branches; untyped NULL literals (bound
+        # as bigint by default) unify with anything, so skip them —
+        # coalesce(null, varchar_col, 'x') must not fold bigint+varchar
+        if fn == "coalesce":
+            branches = list(args)
+        elif fn == "if":
+            branches = [args[1], args[2]]
+        else:  # case: [when1, then1, ..., else]
+            branches = [args[i] for i in range(1, len(args) - 1, 2)] + [args[-1]]
+        typed = [b.type for b in branches
+                 if not (isinstance(b, Literal) and b.value is None)]
+        if not typed:
+            return branches[0].type
+        out = typed[0]
+        for t in typed[1:]:
             out = common_super_type(out, t)
         return out
     if fn == "cast_double":

@@ -88,7 +88,7 @@ def state_types(agg: AggCall) -> List[Type]:
     if agg.fn == "count_star" or agg.fn == "count":
         return [BIGINT]
     t = agg.arg.type
-    if agg.fn == "sum":
+    if agg.fn in ("sum", "sum0"):
         return [_sum_type(t), BIGINT]
     if agg.fn == "avg":
         return [_sum_type(t), BIGINT]
@@ -158,7 +158,7 @@ def output_type(agg: AggCall) -> Type:
 
         k = agg.arg2.type.max_elems
         return ArrayType(DOUBLE, 1 + ML_MAX_CLASSES * (1 + 2 * k))
-    if agg.fn == "sum":
+    if agg.fn in ("sum", "sum0"):
         return _sum_type(agg.arg.type)
     if agg.fn == "avg":
         return DOUBLE  # deviation: reference keeps decimal scale for avg(decimal)
@@ -291,14 +291,14 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
         cnt = _gsum(ctx, nonnull.astype(jnp.int64), gid_nn, n)
         if agg.fn == "count":
             out.append([cnt])
-        elif agg.fn in ("sum", "avg") and agg.arg.type.is_long_decimal:
+        elif agg.fn in ("sum", "sum0", "avg") and agg.arg.type.is_long_decimal:
             from presto_tpu.ops import decimal128 as d128
 
             limbs = d128.to_sum_limbs(data)
             limbs = jnp.where(nonnull[:, None], limbs, 0)
             s = d128.from_sum_limbs(_gsum(ctx, limbs, gid_nn, n))
             out.append([s, cnt])
-        elif agg.fn in ("sum", "avg"):
+        elif agg.fn in ("sum", "sum0", "avg"):
             st = _sum_type(agg.arg.type)
             vals = data.astype(st.np_dtype)
             vals = jnp.where(nonnull, vals, jnp.zeros_like(vals))
@@ -523,7 +523,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
     for agg, cols in zip(aggs, state_cols):
         if agg.fn in ("count", "count_star"):
             out.append([_gsum(ctx, cols[0], gid, n)])
-        elif agg.fn in ("sum", "avg") and agg.arg is not None \
+        elif agg.fn in ("sum", "sum0", "avg") and agg.arg is not None \
                 and agg.arg.type.is_long_decimal:
             from presto_tpu.ops import decimal128 as d128
 
@@ -533,7 +533,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 d128.from_sum_limbs(_gsum(ctx, limbs, gid, n)),
                 _gsum(ctx, cols[1], gid, n),
             ])
-        elif agg.fn in ("sum", "avg"):
+        elif agg.fn in ("sum", "sum0", "avg"):
             out.append([
                 _gsum(ctx, cols[0], gid, n),
                 _gsum(ctx, cols[1], gid, n),
@@ -718,6 +718,12 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
         elif agg.fn == "sum":
             s, cnt = cols
             blocks.append(Block(s.astype(t.np_dtype), cnt > 0, t))
+        elif agg.fn == "sum0":
+            # sum with 0-on-empty: the outer half of a decomposed plain
+            # count in the mixed-DISTINCT rewrite (count is never NULL)
+            s, cnt = cols
+            blocks.append(Block(s.astype(t.np_dtype),
+                                jnp.ones_like(cnt, jnp.bool_), t))
         elif agg.fn == "avg":
             s, cnt = cols
             st = _sum_type(agg.arg.type)

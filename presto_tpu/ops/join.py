@@ -206,6 +206,14 @@ def probe_join(
         return Page(probe.blocks, probe.row_mask & match)
     if kind == "anti":
         return Page(probe.blocks, probe.row_mask & jnp.logical_not(match))
+    if kind == "mark":
+        # mark join: emit the presence test as a BOOLEAN column instead
+        # of filtering — EXISTS/IN under OR (the reference's mark
+        # semijoin, MarkDistinct/SemiJoinRewriter role)
+        from presto_tpu.types import BOOLEAN
+
+        mark = Block(match, jnp.ones_like(probe.row_mask), BOOLEAN)
+        return Page(tuple(probe.blocks) + (mark,), probe.row_mask)
 
     if build_output is None:
         build_output = range(len(build.page.blocks))

@@ -47,6 +47,9 @@ def _raw_lane(page: Page, e: Expr, asc: bool):
     d, v = c.compile(e)(page)
     if d.ndim > 1:
         raise _NoScalarKey()
+    from presto_tpu.ops.sort import _dict_rank
+
+    d = _dict_rank(page, e, d)
     lane = (_float_order_bits(d)
             if jnp.issubdtype(d.dtype, jnp.floating)
             else d.astype(jnp.int64))

@@ -20,6 +20,26 @@ from presto_tpu.expr.compile import ExprCompiler
 from presto_tpu.expr.ir import Expr
 from presto_tpu.page import Block, Page
 
+def _dict_rank(page: Page, e: Expr, d: jax.Array) -> jax.Array:
+    """Dictionary-encoded varchar sort keys order by VALUE, not code:
+    codes map through the dictionary's cached collation-rank LUT
+    (ops/aggregate._collation_luts — e.g. cd_gender's dictionary is
+    ['M','F'], where code order would sort M before F)."""
+    from presto_tpu.expr.ir import ColumnRef
+    from presto_tpu.ops.aggregate import _collation_luts
+
+    if not isinstance(e, ColumnRef) or not getattr(e.type, "is_string", False):
+        return d
+    if e.index >= len(page.blocks):
+        return d
+    dic = page.blocks[e.index].dictionary
+    if dic is None:
+        return d
+    rank_lut, _ = _collation_luts(dic)
+    codes = jnp.clip(d, 0, rank_lut.shape[0] - 1)
+    return rank_lut[codes]
+
+
 def _value_key(data: jax.Array, ascending: bool) -> jax.Array:
     """Exact sortable form of one key's values. Integers stay integral
     (no float64 round-trip — BIGINT/DECIMAL beyond 2^53 must order
@@ -53,6 +73,7 @@ def sort_perm(
     perm = jnp.arange(page.capacity)
     for e, asc, nf in list(zip(sort_exprs, ascending, nulls_first))[::-1]:
         d, v = c.compile(e)(page)
+        d = _dict_rank(page, e, d)
         if e.type.is_raw_string and d.ndim > 1:
             # lexicographic byte order = stable radix passes from the
             # last byte column to the first (static width unrolls)

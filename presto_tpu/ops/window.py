@@ -104,6 +104,9 @@ def window_page(
     perm = jnp.arange(cap, dtype=jnp.int32)
     for e, asc in list(zip(order_exprs, ascending))[::-1]:
         d, v = c.compile(e)(page)
+        from presto_tpu.ops.sort import _dict_rank
+
+        d = _dict_rank(page, e, d)
         k = _value_key(d, asc)
         perm = perm[jnp.argsort(k[perm], stable=True)]
         null_rank = jnp.where(v, 0, 1)  # nulls last (Presto default asc)

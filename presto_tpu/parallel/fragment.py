@@ -121,7 +121,7 @@ def build_side_chainable(node: PlanNode) -> bool:
     if isinstance(node, CrossSingleNode):
         return build_side_chainable(node.left)
     if isinstance(node, JoinNode) and (
-        node.kind in ("semi", "anti") or node.unique_build
+        node.kind in ("semi", "anti", "mark") or node.unique_build
     ):
         return build_side_chainable(node.left)
     return isinstance(node, TableScanNode)

@@ -268,6 +268,10 @@ class JoinNode(PlanNode):
     def channels(self) -> List[Channel]:
         if self.kind in ("semi", "anti"):
             return self.left.channels
+        if self.kind == "mark":
+            from presto_tpu.types import BOOLEAN as _BOOLEAN
+
+            return self.left.channels + [Channel("$mark", _BOOLEAN)]
         return self.left.channels + self.right.channels
 
 

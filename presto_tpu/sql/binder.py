@@ -259,6 +259,63 @@ def _extract_common_or(ir: Expr) -> List[Expr]:
     return common + [new_or]
 
 
+def _flatten_bool_ast(e: ast.Node, op: str) -> List[ast.Node]:
+    if isinstance(e, ast.Binary) and e.op == op:
+        return _flatten_bool_ast(e.left, op) + _flatten_bool_ast(e.right, op)
+    return [e]
+
+
+def _extract_common_or_ast(c: ast.Node) -> List[ast.Node]:
+    """_extract_common_or at the AST level (frozen dataclasses compare
+    structurally): (X and A) or (X and B) -> [X, (A or B)].  Lets a
+    correlation conjunct shared by every OR branch factor out so
+    _split_correlation can classify it (the TPC-DS q41/q85 shape)."""
+    if not (isinstance(c, ast.Binary) and c.op == "or"):
+        return [c]
+    branches = [_flatten_bool_ast(b, "and") for b in _flatten_bool_ast(c, "or")]
+    common = [x for x in branches[0] if all(x in bc for bc in branches[1:])]
+    if not common:
+        return [c]
+    reduced = []
+    for bc in branches:
+        rest = [x for x in bc if x not in common]
+        if not rest:
+            return common  # one branch fully covered: OR is implied
+        out = rest[0]
+        for x in rest[1:]:
+            out = ast.Binary("and", out, x)
+        reduced.append(out)
+    new_or = reduced[0]
+    for b in reduced[1:]:
+        new_or = ast.Binary("or", new_or, b)
+    return common + [new_or]
+
+
+def _iter_child_nodes(v):
+    """Yield ast.Node values inside a field value, flattening nested
+    tuples (Case.whens is a tuple of (cond, result) pairs)."""
+    if isinstance(v, ast.Node):
+        yield v
+    elif isinstance(v, tuple):
+        for x in v:
+            yield from _iter_child_nodes(x)
+
+
+def _find_mark_subqueries(e: ast.Node, out: List[ast.Node]) -> None:
+    """Collect Exists/InSubquery nodes inside a general boolean
+    expression (not descending into their query bodies) — the operands
+    the mark-join lowering replaces with boolean columns."""
+    if isinstance(e, (ast.Exists, ast.InSubquery)):
+        out.append(e)
+        return
+    if isinstance(e, (ast.Query, ast.Union, ast.ScalarSubquery)):
+        return
+    if dataclasses.is_dataclass(e):
+        for f in dataclasses.fields(e):
+            for x in _iter_child_nodes(getattr(e, f.name)):
+                _find_mark_subqueries(x, out)
+
+
 def _find_scalar_subqueries(e: ast.Node, out: List[ast.Node]) -> None:
     """Collect ScalarSubquery nodes inside an expression (not descending
     into their query bodies)."""
@@ -269,10 +326,8 @@ def _find_scalar_subqueries(e: ast.Node, out: List[ast.Node]) -> None:
         return
     if dataclasses.is_dataclass(e):
         for f in dataclasses.fields(e):
-            v = getattr(e, f.name)
-            for x in v if isinstance(v, tuple) else [v]:
-                if isinstance(x, ast.Node):
-                    _find_scalar_subqueries(x, out)
+            for x in _iter_child_nodes(getattr(e, f.name)):
+                _find_scalar_subqueries(x, out)
 
 
 def _is_subquery_conjunct(c: ast.Node) -> bool:
@@ -283,8 +338,13 @@ def _is_subquery_conjunct(c: ast.Node) -> bool:
     if isinstance(c, ast.Binary) and c.op in ("=", "<>", "<", "<=", ">", ">="):
         subs: List[ast.Node] = []
         _find_scalar_subqueries(c, subs)
-        return bool(subs)
-    return False
+        if subs:
+            return True
+    # EXISTS/IN-subquery anywhere inside (OR of EXISTS etc.): the
+    # mark-join fallback owns these
+    marks: List[ast.Node] = []
+    _find_mark_subqueries(c, marks)
+    return bool(marks)
 
 
 @dataclasses.dataclass
@@ -335,6 +395,8 @@ class Binder:
         # planned scalar-subquery marker refs keyed by id(ast node),
         # live only while binding the enclosing conjunct
         self._scalar_refs: Dict[int, ColumnRef] = {}
+        # Exists/InSubquery -> mark-join boolean ref (EXISTS under OR)
+        self._mark_refs: Dict[int, ColumnRef] = {}
         # UNNEST relations of the FROM clause currently being flattened
         self._from_unnests: List[ast.Unnest] = []
         # in-scope CTE definitions (WITH name AS (...)): name -> query ast
@@ -903,12 +965,121 @@ class Binder:
         if len(terms) == 1:
             node = terms[0].node
             g2c = {terms[0].offset + i: i for i in range(len(terms[0].scope))}
+        elif len(terms) <= 6:
+            # cost-based enumeration (ReorderJoins + CostComparator +
+            # DetermineJoinDistributionType analog): DP over subsets
+            node, g2c = self._cost_based_join(terms, edges, post)
         else:
             node, g2c = self._greedy_join(terms, edges, post)
 
         for ir in post:
             node = FilterNode(node, remap_expr(ir, g2c))
         return node, glob, g2c
+
+    # nominal worker count for the broadcast-vs-partitioned exchange
+    # term of the join cost model (DetermineJoinDistributionType's
+    # cost comparison folded into join-order enumeration)
+    _COST_WORKERS = 8
+
+    def _cost_based_join(self, terms, edges, post):
+        """Selinger-style DP over connected subsets for <=6 relations
+        (iterative/rule/ReorderJoins.java + cost/CostComparator.java
+        analog).  Each join's cost = build materialization + probe pass
+        + output + the cheaper of broadcast / repartitioned exchange —
+        so the distribution choice is part of the same comparison.
+        Cross joins (no connecting edge) are admitted with their
+        Cartesian output as the penalty, keeping disconnected graphs
+        and scalar-subquery single-row terms working."""
+        from itertools import combinations
+
+        n = len(terms)
+
+        def base_map(i: int):
+            return {terms[i].offset + k: k
+                    for k in range(len(terms[i].scope))}
+
+        # subset -> (cost, rows, node, g2c, used_edges frozenset)
+        best = {}
+        for i in range(n):
+            rows = max(self._estimate(terms[i].node), 1.0)
+            best[frozenset([i])] = (0.0, rows, terms[i].node, base_map(i),
+                                    frozenset())
+
+        def join_of(s1, s2):
+            """Join best[s1] (probe) with best[s2] (build); returns a
+            candidate entry or None."""
+            c1, r1, n1, m1, u1 = best[s1]
+            c2, r2, n2, m2, u2 = best[s2]
+            cross = [k for k, (i, j, _) in enumerate(edges)
+                     if k not in u1 and k not in u2
+                     and ((i in s1 and j in s2) or (i in s2 and j in s1))]
+            lkeys: List[Expr] = []
+            rkeys: List[Expr] = []
+            for k in cross:
+                a, b = edges[k][2].args
+                if a.index in m2:  # a on the build side: swap
+                    a, b = b, a
+                lkeys.append(ColumnRef(type=a.type, index=m1[a.index]))
+                rkeys.append(ColumnRef(type=b.type, index=m2[b.index]))
+            if not cross:
+                zero = Literal(type=BIGINT, value=0)
+                lkeys, rkeys = [zero], [zero]
+                unique = self._provably_single_row(n2)
+            else:
+                key_refs = [ColumnRef(type=k.type, index=k.index)
+                            for k in rkeys]
+                unique = self._build_is_unique(n2, key_refs)
+            join = JoinNode(left=n1, right=n2, left_keys=lkeys,
+                            right_keys=rkeys, kind="inner",
+                            unique_build=unique)
+            if not cross:
+                out = r1 * r2  # never trust the calculator on lit-keys
+            else:
+                out = max(self._estimate(join), 1.0)
+            exchange = min(self._COST_WORKERS * r2, r1 + r2)
+            cost = c1 + c2 + r2 + r1 + out + exchange
+            if not cross:
+                cost += 2 * out  # Cartesian penalty
+            if not unique:
+                # non-unique builds run the expanding (materializing)
+                # kernel: extra output materialization + a host sync per
+                # probe page — strongly prefer streaming orientations
+                cost += 2 * (r1 + out)
+            g2c = dict(m1)
+            off = len(n1.channels)
+            for r, idx in m2.items():
+                g2c[r] = off + idx
+            return (cost, out, join, g2c,
+                    u1 | u2 | frozenset(cross))
+
+        idx = list(range(n))
+        for size in range(2, n + 1):
+            for comb in combinations(idx, size):
+                s = frozenset(comb)
+                entry = None
+                members = sorted(s)
+                # enumerate splits; fix the smallest member to one side
+                # to halve the symmetric space, but try BOTH probe/build
+                # orientations of each split
+                rest = [m for m in members if m != members[0]]
+                for r_size in range(0, len(rest) + 1):
+                    for picked in combinations(rest, r_size):
+                        s2 = frozenset(picked) | {members[0]}
+                        s1 = s - s2
+                        if not s1:
+                            continue
+                        for probe, build in ((s1, s2), (s2, s1)):
+                            cand = join_of(probe, build)
+                            if cand is not None and (
+                                entry is None or cand[0] < entry[0]
+                            ):
+                                entry = cand
+                best[s] = entry
+        cost, rows, node, g2c, used = best[frozenset(idx)]
+        for k, (i, j, ir) in enumerate(edges):
+            if k not in used:
+                post.append(ir)  # cycle edge -> post filter
+        return node, g2c
 
     def _greedy_join(self, terms, edges, post):
         """Probe = largest estimated term; repeatedly hash-join the
@@ -937,7 +1108,8 @@ class Binder:
                 t = terms[pick]
                 node = JoinNode(
                     left=node, right=t.node, left_keys=[zero], right_keys=[zero],
-                    kind="inner", unique_build=self._estimate(t.node) <= 1,
+                    kind="inner",
+                    unique_build=self._provably_single_row(t.node),
                 )
                 base = len(g2c)
                 for li in range(len(t.scope)):
@@ -1017,6 +1189,23 @@ class Binder:
         """Estimated output rows, via the stats calculator
         (cost/StatsCalculator.java analog, planner/stats.py)."""
         return self._stats.rows(node)
+
+    def _provably_single_row(self, node: PlanNode) -> bool:
+        """True only when the node is STRUCTURALLY guaranteed to emit
+        at most one row — a global aggregation, a one-row VALUES, or
+        LIMIT 1.  Never from cardinality estimates: unique_build is a
+        correctness property (the streaming kernel keeps first matches
+        only), and an estimate of 0-1 rows can be wrong."""
+        n = node
+        while isinstance(n, (ProjectNode, OutputNode)):
+            n = n.source
+        if isinstance(n, AggregationNode):
+            return not n.group_exprs
+        if isinstance(n, ValuesNode):
+            return len(n.rows) <= 1
+        if isinstance(n, LimitNode):
+            return n.count <= 1
+        return False
 
     def _build_is_unique(self, node: PlanNode, rkeys: Sequence[Expr]) -> bool:
         """True if the build side's join keys are unique: primary-key
@@ -1123,6 +1312,30 @@ class Binder:
             node, scope = self._apply_subquery_conjunct(node, scope, g2c, c, cglob)
         self._pending_subqueries = saved_pending
 
+        # scalar subqueries in SELECT position (uncorrelated): each
+        # plans standalone and cross-joins its single row onto the
+        # relation; the expression binder resolves the original AST
+        # node to the appended channel (TPC-DS q9's CASE-over-counts
+        # shape; reference: SubqueryPlanner's apply of uncorrelated
+        # scalars).  Aggregated outer queries keep the restriction.
+        select_scalar_subs: List[ast.Node] = []
+        for it in q.select:
+            if not isinstance(it.expr, ast.Star):
+                _find_scalar_subqueries(it.expr, select_scalar_subs)
+        select_sub_ids: List[int] = []
+        try:
+            for sq in select_scalar_subs:
+                sub_node, _ = self._plan_query_like(sq.query)
+                ref = ColumnRef(type=sub_node.channels[0].type,
+                                index=len(node.channels))
+                node = CrossSingleNode(left=node, right=sub_node)
+                self._scalar_refs[id(sq)] = ref
+                select_sub_ids.append(id(sq))
+        except BindError:
+            for k in select_sub_ids:
+                self._scalar_refs.pop(k, None)
+            raise
+
         # select list expansion
         items: List[Tuple[ast.Node, str]] = []
         for it in q.select:
@@ -1150,6 +1363,12 @@ class Binder:
         order_items = list(q.order_by)
 
         if has_aggs:
+            if select_sub_ids:
+                for k in select_sub_ids:
+                    self._scalar_refs.pop(k, None)
+                raise BindError(
+                    "scalar subquery in the SELECT of an aggregating "
+                    "query unsupported")
             node, out_irs, names, order_irs = self._plan_aggregation(
                 node, scope, items, group_asts, q.having, order_items,
                 grouping_sets=grouping_sets,
@@ -1157,7 +1376,11 @@ class Binder:
         else:
             if q.having is not None:
                 raise BindError("HAVING without aggregation")
-            out_irs = [self._bind(e, scope) for e, _ in items]
+            try:
+                out_irs = [self._bind(e, scope) for e, _ in items]
+            finally:
+                for k in select_sub_ids:
+                    self._scalar_refs.pop(k, None)
             names = [n for _, n in items]
             order_irs = self._bind_order(order_items, items, out_irs, scope)
 
@@ -1498,25 +1721,52 @@ class Binder:
         ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group, aggs=new_aggs)
         return inner, ctx
 
+    # non-distinct aggregates that survive the two-level distinct
+    # rewrite: inner per-(g, x) value re-aggregated by the outer fn
+    # count re-aggregates through sum0 (sum with 0-on-empty): a plain
+    # count must stay 0, never NULL, over empty input
+    _DECOMPOSABLE_OUTER = {"sum": "sum", "count": "sum0",
+                           "count_star": "sum0", "min": "min", "max": "max"}
+
     def _rewrite_distinct_aggs(self, node, scope, group_irs, agg_ctx: AggCtx):
-        """agg(DISTINCT x) GROUP BY g  ->  inner distinct on (g, x),
-        outer agg(x) (MarkDistinct/MultipleDistinctAggregationToMarkDistinct
-        analog, restricted to all-distinct-same-arg aggregations)."""
+        """agg(DISTINCT x) GROUP BY g  ->  inner group on (g, x), outer
+        re-aggregation (MarkDistinct /
+        MultipleDistinctAggregationToMarkDistinct analog).  All DISTINCT
+        aggregates must share one argument; non-distinct aggregates mix
+        in when they are decomposable (sum/count/min/max): the inner
+        level computes them per (g, x) and the outer level re-combines
+        (count(distinct o) + sum(cost) — the TPC-DS q16/q95 shape)."""
         distinct_args = {a.arg for a in agg_ctx.aggs if a.distinct}
-        if not all(a.distinct for a in agg_ctx.aggs) or len(distinct_args) != 1:
+        if len(distinct_args) != 1:
             raise BindError("mixed/multi-arg DISTINCT aggregates unsupported")
+        plain = [a for a in agg_ctx.aggs if not a.distinct]
+        if not all(a.fn in self._DECOMPOSABLE_OUTER for a in plain):
+            raise BindError(
+                "DISTINCT aggregates mix only with sum/count/min/max")
         (arg,) = distinct_args
         inner_keys = group_irs + [arg]
         inner = AggregationNode(
-            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))], [], [],
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
+            list(plain), [f"$p{i}" for i in range(len(plain))],
             max_groups=self._group_capacity(inner_keys, scope, self._estimate(node), node=node),
         )
         new_group = [ColumnRef(type=g.type, index=i) for i, g in enumerate(group_irs)]
         arg_ref = ColumnRef(type=arg.type, index=len(group_irs))
-        new_aggs = [
-            AggCall(fn=a.fn, arg=arg_ref, type=a.type, distinct=False)
-            for a in agg_ctx.aggs
-        ]
+        inner_out = inner.channels
+        new_aggs = []
+        plain_pos = 0
+        for a in agg_ctx.aggs:
+            if a.distinct:
+                new_aggs.append(
+                    AggCall(fn=a.fn, arg=arg_ref, type=a.type, distinct=False))
+            else:
+                ref = ColumnRef(
+                    type=inner_out[len(inner_keys) + plain_pos].type,
+                    index=len(inner_keys) + plain_pos,
+                )
+                new_aggs.append(AggCall(fn=self._DECOMPOSABLE_OUTER[a.fn],
+                                        arg=ref, type=a.type))
+                plain_pos += 1
         ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group, aggs=new_aggs)
         return inner, ctx
 
@@ -1547,7 +1797,9 @@ class Binder:
             kind = "anti" if (negated ^ c.negated) else "semi"
             return self._plan_exists(node, scope, remap, glob, c.query, kind)
 
-        if isinstance(c, ast.Binary):
+        contained_marks: List[ast.Node] = []
+        _find_mark_subqueries(c, contained_marks)
+        if isinstance(c, ast.Binary) and not contained_marks:
             # the scalar subquery may sit anywhere inside the comparison
             # (e.g. price > 1.2 * (select avg(...))): plan it, bind the
             # conjunct with the subquery replaced by a marker ref, then
@@ -1571,7 +1823,78 @@ class Binder:
                 pred = call("not", pred)
             return FilterNode(node, pred), scope
 
+        # General fallback: a boolean expression with EXISTS/IN-subquery
+        # operands in arbitrary positions (e.g. OR of two EXISTS — the
+        # TPC-DS q10/q35 shape).  Each subquery lowers to a MARK join
+        # appending a boolean presence column; the expression then binds
+        # with the subquery operands replaced by those columns
+        # (the reference's mark semijoin: SemiJoinNode + the rewrite in
+        # TransformExistsApplyToLateralNode/MarkDistinct machinery).
+        marks: List[ast.Node] = []
+        _find_mark_subqueries(c, marks)
+        if marks:
+            full_map = dict(remap)
+            planned: List[int] = []
+            try:
+                for j, m in enumerate(marks):
+                    if isinstance(m, ast.Exists):
+                        node, mark_idx = self._plan_exists_mark(
+                            node, remap, glob, m.query)
+                    else:
+                        node, mark_idx = self._plan_in_mark(node, remap, glob, m)
+                    marker = (1 << 28) + j
+                    from presto_tpu.types import BOOLEAN as _BOOLEAN
+
+                    self._mark_refs[id(m)] = ColumnRef(type=_BOOLEAN, index=marker)
+                    planned.append(id(m))
+                    full_map[marker] = mark_idx
+                ir = self._bind(c, glob)
+            finally:
+                for key in planned:
+                    self._mark_refs.pop(key, None)
+            pred = remap_expr(ir, full_map)
+            if negated:
+                pred = call("not", pred)
+            return FilterNode(node, pred), scope
+
         raise BindError(f"unsupported subquery conjunct {c!r}")
+
+    def _plan_exists_mark(self, node, remap, glob, q):
+        """EXISTS as a mark join: returns (new node, channel index of
+        the boolean presence column)."""
+        if isinstance(q, ast.Union):
+            raise BindError("EXISTS over UNION unsupported")
+        terms, inner_conjuncts, corr, corr_extra, nested, inner_glob = \
+            self._split_correlation(q, glob)
+        if not corr:
+            raise BindError("uncorrelated EXISTS unsupported")
+        if nested or corr_extra:
+            raise BindError("complex correlation under OR'd EXISTS unsupported")
+        saved = self._pending_subqueries
+        self._pending_subqueries = []
+        inner_node, _, inner_map = self._join_terms(terms, inner_conjuncts)
+        self._pending_subqueries = saved
+        left_keys = [
+            remap_expr(ColumnRef(type=glob.cols[g].channel.type, index=g), remap)
+            for _, g in corr
+        ]
+        right_keys = [remap_expr(ir, inner_map) for ir, _ in corr]
+        mark_idx = len(node.channels)
+        join = JoinNode(left=node, right=inner_node,
+                        left_keys=left_keys, right_keys=right_keys, kind="mark")
+        return join, mark_idx
+
+    def _plan_in_mark(self, node, remap, glob, m):
+        """value IN (subquery) as a mark join (uncorrelated only)."""
+        sub, _ = self._plan_query_like(m.query)
+        value_ir = remap_expr(self._bind(m.value, glob), remap)
+        mark_idx = len(node.channels)
+        join = JoinNode(
+            left=node, right=sub, left_keys=[value_ir],
+            right_keys=[ColumnRef(type=sub.channels[0].type, index=0)],
+            kind="mark",
+        )
+        return join, mark_idx
 
     def _is_correlated(self, q: ast.Query, outer_glob: Scope) -> bool:
         """A subquery is correlated iff it does not bind standalone."""
@@ -1586,6 +1909,8 @@ class Binder:
         scope; separate correlation equi-conjuncts from inner filters."""
         terms, conjuncts = self._flatten_from(q.from_)
         conjuncts = conjuncts + split_conjuncts(q.where)
+        # correlation may hide inside an OR whose branches all repeat it
+        conjuncts = [x for c in conjuncts for x in _extract_common_or_ast(c)]
         inner_glob = Scope([])
         for t in terms:
             inner_glob = inner_glob.concat(t.scope)
@@ -1828,6 +2153,13 @@ class Binder:
             ref = self._scalar_refs.get(id(e))
             if ref is not None:
                 return ref
+
+        if isinstance(e, (ast.Exists, ast.InSubquery)):
+            # lowered to a mark-join boolean column by
+            # _apply_subquery_conjunct's general fallback
+            ref = self._mark_refs.get(id(e))
+            if ref is not None:
+                return call("not", ref) if e.negated else ref
 
         if isinstance(e, ast.NumberLit):
             return self._bind_number(e.text)

@@ -1,0 +1,136 @@
+"""Round-3 SQL-surface features, unit-level (the TPC-DS corpus covers
+them end-to-end): mark joins (EXISTS under OR), mixed DISTINCT
+aggregates, SELECT-position scalar subqueries, string-valued
+case/if/coalesce over merged dictionaries, and value-ordered sorting of
+dictionary varchar keys.
+"""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    return QueryRunner(catalog)
+
+
+def test_exists_under_or_mark_join(runner):
+    got = runner.execute(
+        "select o_orderpriority, count(*) from orders o "
+        "where exists (select * from lineitem "
+        "              where l_orderkey = o.o_orderkey and l_quantity > 45) "
+        "   or exists (select * from lineitem "
+        "              where l_orderkey = o.o_orderkey and l_discount > 0.09) "
+        "group by o_orderpriority order by 1").rows
+    keys_q = {r[0] for r in runner.execute(
+        "select distinct l_orderkey from lineitem where l_quantity > 45").rows}
+    keys_d = {r[0] for r in runner.execute(
+        "select distinct l_orderkey from lineitem where l_discount > 0.09").rows}
+    ords = runner.execute("select o_orderkey, o_orderpriority from orders").rows
+    from collections import Counter
+
+    expect = sorted(Counter(
+        p for k, p in ords if k in (keys_q | keys_d)).items())
+    assert got == expect
+
+
+def test_not_exists_inside_or_expression(runner):
+    got = runner.execute(
+        "select count(*) from orders o "
+        "where o_totalprice > 300000 "
+        "   or not exists (select * from lineitem "
+        "                  where l_orderkey = o.o_orderkey "
+        "                      and l_quantity > 10)").rows[0][0]
+    keys = {r[0] for r in runner.execute(
+        "select distinct l_orderkey from lineitem where l_quantity > 10").rows}
+    ords = runner.execute("select o_orderkey, o_totalprice from orders").rows
+    expect = sum(1 for k, tp in ords if float(tp) > 300000 or k not in keys)
+    assert got == expect
+
+
+def test_mixed_distinct_aggregates(runner):
+    row = runner.execute(
+        "select count(distinct o_custkey), count(*), sum(o_totalprice), "
+        "max(o_totalprice) from orders").rows[0]
+    custs = {r[0] for r in runner.execute("select o_custkey from orders").rows}
+    assert row[0] == len(custs)
+    assert row[1] == len(runner.execute("select o_orderkey from orders").rows)
+
+
+def test_mixed_distinct_empty_input_count_is_zero(runner):
+    row = runner.execute(
+        "select count(distinct o_custkey), count(*), sum(o_totalprice) "
+        "from orders where o_orderkey < 0").rows[0]
+    assert row == (0, 0, None)
+
+
+def test_scalar_subquery_in_select_position(runner):
+    rows = runner.execute(
+        "select o_orderkey, "
+        "       case when (select count(*) from lineitem "
+        "                  where l_quantity > 45) > 10 "
+        "            then (select max(l_discount) from lineitem) "
+        "            else -1.0 end as d "
+        "from orders order by o_orderkey limit 3").rows
+    big = runner.execute(
+        "select count(*) from lineitem where l_quantity > 45").rows[0][0]
+    mx = runner.execute("select max(l_discount) from lineitem").rows[0][0]
+    want = float(mx) if big > 10 else -1.0
+    assert [float(r[1]) for r in rows] == [want] * 3
+
+
+def test_string_case_merged_dictionary(runner):
+    rows = runner.execute(
+        "select case when o_totalprice > 150000 then 'big' "
+        "            when o_totalprice > 50000 then 'mid' "
+        "            else 'small' end as sz, count(*) "
+        "from orders group by 1 order by 1").rows
+    assert [r[0] for r in rows] == sorted(r[0] for r in rows)
+    assert {r[0] for r in rows} <= {"big", "mid", "small"}
+    # cross-check totals
+    total = sum(r[1] for r in rows)
+    assert total == runner.execute("select count(*) from orders").rows[0][0]
+
+
+def test_string_coalesce_and_if_with_literals(runner):
+    rows = runner.execute(
+        "select coalesce(null, o_orderpriority, 'none') from orders limit 2").rows
+    plain = runner.execute(
+        "select o_orderpriority from orders limit 2").rows
+    assert rows == plain
+    rows = runner.execute(
+        "select if(o_orderkey % 2 = 0, 'even', o_orderpriority) x "
+        "from orders order by o_orderkey limit 4").rows
+    raw = runner.execute(
+        "select o_orderkey, o_orderpriority from orders "
+        "order by o_orderkey limit 4").rows
+    assert [r[0] for r in rows] == [
+        "even" if k % 2 == 0 else p for k, p in raw]
+
+
+def test_dictionary_sort_is_value_ordered(runner):
+    """ORDER BY on a dictionary varchar must sort by VALUE even when
+    dictionary code order differs (regression: cd_gender-style dicts)."""
+    import numpy as np
+
+    from presto_tpu.exec.local import LocalRunner
+    from presto_tpu.page import Dictionary, Page
+    from presto_tpu.planner.plan import PrecomputedNode, SortNode, Channel
+    from presto_tpu.expr.ir import ColumnRef
+    from presto_tpu.types import VARCHAR
+
+    d = Dictionary(["zebra", "apple", "mango"])  # codes NOT value-ordered
+    page = Page.from_arrays(
+        [np.array([0, 1, 2, 0, 1], dtype=np.int32)], [VARCHAR],
+        dictionaries=[d])
+    src = PrecomputedNode(page=page, channel_list=[Channel("s", VARCHAR, d)])
+    plan = SortNode(src, [ColumnRef(type=VARCHAR, index=0)], [True])
+    ex = LocalRunner(Catalog())
+    out = ex.run(plan)
+    vals = [r[0] for r in out.rows]
+    assert vals == sorted(vals)
