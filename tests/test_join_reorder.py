@@ -1,0 +1,102 @@
+"""Cost-based join-order enumeration (VERDICT r2 #6).
+
+Reference analog: iterative/rule/ReorderJoins.java +
+cost/CostComparator.java + DetermineJoinDistributionType.java:33 — the
+binder's DP over <=6-relation join graphs picks the min-cost order and
+folds the broadcast-vs-partitioned exchange term into the same
+comparison, instead of taking the FROM-clause order as given.
+"""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.planner.plan import JoinNode, TableScanNode
+from presto_tpu.runner import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.01, split_rows=16384))
+    return QueryRunner(catalog)
+
+
+def _joins(node, out):
+    if isinstance(node, JoinNode):
+        out.append(node)
+    for s in node.sources:
+        _joins(s, out)
+    return out
+
+
+def _scan_table(node):
+    n = node
+    while n.sources:
+        if isinstance(n, TableScanNode):
+            break
+        n = n.sources[0]
+    return n.handle.table if isinstance(n, TableScanNode) else None
+
+
+def _leaf_tables(node):
+    if isinstance(node, TableScanNode):
+        return {node.handle.table}
+    out = set()
+    for s in node.sources:
+        out |= _leaf_tables(s)
+    return out
+
+
+def test_star_query_reordered_away_from_from_order(runner):
+    """FROM lists the dimensions first; the fact table must still end
+    up as the probe (left) spine with the dimensions as build sides."""
+    sql = ("select count(*) from nation, region, supplier "
+           "where s_nationkey = n_nationkey and n_regionkey = r_regionkey")
+    plan = runner.plan(sql)
+    joins = _joins(plan, [])
+    assert joins, "no joins planned"
+    # the top join's probe subtree must contain supplier (the fact);
+    # neither dimension may have the fact on its build side
+    top = joins[0]
+    assert "supplier" in _leaf_tables(top.left)
+    for j in joins:
+        assert "supplier" not in _leaf_tables(j.right), (
+            "fact table chosen as a build side")
+    # and the result is right
+    got = runner.execute(sql).rows[0][0]
+    n = runner.execute("select count(*) from supplier").rows[0][0]
+    assert got == n  # every supplier matches exactly one nation/region
+
+
+def test_unique_build_orientation_preferred(runner):
+    """orders (PK build) vs lineitem (fact): whatever the FROM order,
+    the planner must probe with lineitem and build on orders so the
+    streaming kernel applies."""
+    for sql in (
+        "select count(*) from lineitem, orders where l_orderkey = o_orderkey",
+        "select count(*) from orders, lineitem where l_orderkey = o_orderkey",
+    ):
+        plan = runner.plan(sql)
+        joins = _joins(plan, [])
+        assert len(joins) == 1
+        j = joins[0]
+        assert "lineitem" in _leaf_tables(j.left)
+        assert "orders" in _leaf_tables(j.right)
+        assert j.unique_build
+
+
+def test_cross_join_unique_build_needs_proof(runner):
+    """A disconnected term whose ESTIMATE is tiny must still run the
+    expanding kernel — unique_build only from structural proof
+    (regression: a 12-row build estimated at 0 rows was streamed as
+    'unique' and dropped matches)."""
+    sql = ("select count(*) from nation, region "
+           "where n_name <> 'FRANCE' and r_name = 'EUROPE'")
+    plan = runner.plan(sql)
+    joins = _joins(plan, [])
+    assert len(joins) == 1
+    assert not joins[0].unique_build  # filtered scan is not single-row
+    got = runner.execute(sql).rows[0][0]
+    n = runner.execute("select count(*) from nation where n_name <> 'FRANCE'").rows[0][0]
+    assert got == n  # x1 region
