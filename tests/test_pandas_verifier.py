@@ -1,0 +1,33 @@
+"""Cross-engine verification: the engine's TPC-H answers vs a second
+independent engine (pandas dataframe programs sharing no code with the
+SQL path).  Together with the sqlite oracle this gives presto-verifier
+style two-independent-engines agreement (VERDICT r2 #7;
+presto-verifier/.../Validator.java + H2QueryRunner analog)."""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+
+from tests.oracle import assert_rows_match
+from tests.pandas_oracle import PANDAS_QUERIES, load_frames
+from tests.tpch_queries import QUERIES
+
+
+@pytest.fixture(scope="module")
+def env():
+    tpch = Tpch(sf=0.01, split_rows=16384)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    runner = QueryRunner(catalog)
+    frames = load_frames(tpch)
+    return runner, frames
+
+
+@pytest.mark.parametrize("qid", sorted(PANDAS_QUERIES))
+def test_tpch_vs_pandas(env, qid):
+    runner, frames = env
+    actual = runner.execute(QUERIES[qid]).rows
+    expected = PANDAS_QUERIES[qid](frames)
+    assert_rows_match(actual, expected, ordered=False)
