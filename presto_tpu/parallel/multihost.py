@@ -43,7 +43,6 @@ from presto_tpu.planner.plan import (
     WindowNode,
 )
 from presto_tpu.server.serde import deserialize_page, plan_to_json
-from presto_tpu.server.worker import parse_task_response
 
 
 class TaskFailed(Exception):
@@ -94,13 +93,6 @@ class TaskStatusFetcher:
 
     def stop(self) -> None:
         self._stop = True
-
-
-def _error_detail(e) -> str:
-    try:
-        return json.loads(e.read()).get("error", "")
-    except Exception:
-        return ""
 
 
 class MultiHostUnsupported(Exception):
@@ -170,13 +162,24 @@ class WorkerClient:
         return tid
 
     def pull_results(self, tid: str) -> List[bytes]:
-        """Drain buffer 0 of an already-created task (the pull half)."""
+        """Drain buffer 0 of an already-created task (the pull half);
+        a background TaskStatusFetcher watches /v1/task/{id} so FAILED
+        surfaces with its message even between result polls."""
         from presto_tpu.server.shuffle_client import TaskPullFailed, pull_pages
 
+        fetcher = TaskStatusFetcher(self.uri, tid)
+        fetcher.start()
+        pages: List[bytes] = []
         try:
-            return list(pull_pages(self.uri, tid, 0, timeout=self.timeout))
+            for raw in pull_pages(self.uri, tid, 0, timeout=self.timeout):
+                if fetcher.failed_error is not None:
+                    raise TaskFailed(fetcher.failed_error)
+                pages.append(raw)
+            return pages
         except TaskPullFailed as e:
             raise TaskFailed(str(e)) from e
+        finally:
+            fetcher.stop()
 
     def delete_task(self, tid: str) -> None:
         try:
@@ -187,64 +190,14 @@ class WorkerClient:
             pass
 
     def _pull_task(self, fragment_json: dict) -> List[bytes]:
-        import uuid
-
-        tid = uuid.uuid4().hex[:16]
-        body = json.dumps({"fragment": fragment_json}).encode()
-        req = urllib.request.Request(
-            f"{self.uri}/v1/task/{tid}", data=body, method="POST",
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            json.load(resp)
-        pages: List[bytes] = []
-        token = 0
-        # no-progress deadline: a wedged producer must fail the pull
-        # (the old one-shot POST failed at its socket timeout; the
-        # long-poll loop needs the equivalent wall-clock bound)
-        last_progress = time.monotonic()
-        fetcher = TaskStatusFetcher(self.uri, tid)
-        fetcher.start()
+        """create + drain + delete, composed from the shared protocol
+        pieces (one implementation of the token/ack long-poll loop:
+        server/shuffle_client.pull_pages)."""
+        tid = self.create_task(fragment_json)
         try:
-            while True:
-                if fetcher.failed_error is not None:
-                    raise TaskFailed(fetcher.failed_error)
-                if time.monotonic() - last_progress > self.timeout:
-                    raise TimeoutError(
-                        f"task {tid} made no progress for {self.timeout}s")
-                try:
-                    with urllib.request.urlopen(
-                        f"{self.uri}/v1/task/{tid}/results/{token}",
-                        timeout=self.timeout,
-                    ) as resp:
-                        batch = parse_task_response(resp.read())
-                        nxt = int(resp.headers.get("X-Next-Token", token))
-                        complete = resp.headers.get("X-Complete") == "1"
-                except urllib.error.HTTPError as e:
-                    # a failed task answers 500 with the error payload:
-                    # surface it as a query failure, not a worker fault
-                    detail = _error_detail(e) or fetcher.poll_once()
-                    if detail:
-                        raise TaskFailed(detail)
-                    raise
-                pages.extend(batch)
-                if nxt > token:
-                    token = nxt
-                    last_progress = time.monotonic()
-                    urllib.request.urlopen(
-                        f"{self.uri}/v1/task/{tid}/results/{token}/acknowledge",
-                        timeout=self.timeout,
-                    ).close()
-                if complete:
-                    return pages
+            return self.pull_results(tid)
         finally:
-            fetcher.stop()
-            try:
-                req = urllib.request.Request(
-                    f"{self.uri}/v1/task/{tid}", method="DELETE")
-                urllib.request.urlopen(req, timeout=10.0).close()
-            except Exception:
-                pass
+            self.delete_task(tid)
 
 
 class MultiHostRunner:

@@ -289,43 +289,18 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
 # pages (shuffle wire format)
 # ---------------------------------------------------------------------------
 
-def serialize_page(page: Page, compress: bool = True) -> bytes:
-    """Compact live rows and encode: JSON header + column bytes,
-    zlib-compressed when that shrinks the payload (the reference's
-    optional LZ4 page compression, execution/buffer/PagesSerde.java:66 +
-    exchange_compression; zlib is the stdlib codec here)."""
+def _encode_page(columns, n: int, compress: bool) -> bytes:
+    """Shared page frame: JSON header + column payload, zlib-compressed
+    when that shrinks it (the reference's optional LZ4 page compression,
+    execution/buffer/PagesSerde.java:66 + exchange_compression).
+    ``columns`` yields (np data, np valid, Type) already trimmed to n
+    rows — the single implementation both serialize paths share so the
+    wire format cannot drift."""
     import zlib
 
-    p = page.compact_host()
-    header = {"types": [], "n": int(np.asarray(p.row_mask).sum())}
-    payload = b""
-    for b in p.blocks:
-        data = np.asarray(b.data)[: header["n"]]
-        valid = np.asarray(b.valid)[: header["n"]]
-        header["types"].append(
-            {"t": type_to_json(b.type), "dtype": str(data.dtype),
-             "shape": list(data.shape[1:])}
-        )
-        payload += data.tobytes() + np.packbits(valid).tobytes()
-    if compress:
-        z = zlib.compress(payload, 1)
-        if len(z) < len(payload):
-            header["z"] = len(payload)  # uncompressed size
-            payload = z
-    hjson = json.dumps(header).encode()
-    return len(hjson).to_bytes(4, "little") + hjson + payload
-
-
-def serialize_host_page(hp, compress: bool = True) -> bytes:
-    """serialize_page for a spill-tier HostPage (numpy-backed, already
-    compacted) — the partitioned-output write path serializes each
-    bucket straight from host RAM without a device round trip."""
-    import zlib
-
-    n = int(hp.mask.sum())
     header = {"types": [], "n": n}
     payload = b""
-    for data, valid, t, _dic in hp.columns:
+    for data, valid, t in columns:
         header["types"].append(
             {"t": type_to_json(t), "dtype": str(data.dtype),
              "shape": list(data.shape[1:])}
@@ -335,10 +310,48 @@ def serialize_host_page(hp, compress: bool = True) -> bytes:
     if compress:
         z = zlib.compress(payload, 1)
         if len(z) < len(payload):
-            header["z"] = len(payload)
+            header["z"] = len(payload)  # uncompressed size
             payload = z
     hjson = json.dumps(header).encode()
     return len(hjson).to_bytes(4, "little") + hjson + payload
+
+
+def serialize_page(page: Page, compress: bool = True) -> bytes:
+    """Compact live rows and encode (device page path)."""
+    p = page.compact_host()
+    n = int(np.asarray(p.row_mask).sum())
+    cols = ((np.asarray(b.data)[:n], np.asarray(b.valid)[:n], b.type)
+            for b in p.blocks)
+    return _encode_page(cols, n, compress)
+
+
+def serialize_host_page(hp, compress: bool = True) -> bytes:
+    """serialize_page for a spill-tier HostPage (numpy-backed, already
+    compacted) — the partitioned-output write path serializes each
+    bucket straight from host RAM without a device round trip."""
+    n = int(hp.mask.sum())
+    cols = ((data, valid, t) for data, valid, t, _dic in hp.columns)
+    return _encode_page(cols, n, compress)
+
+
+def encode_page_batch(pages) -> bytes:
+    """[npages u32][len u64][bytes]... framing of a page batch (the
+    task-results response body)."""
+    return len(pages).to_bytes(4, "little") + b"".join(
+        len(p).to_bytes(8, "little") + p for p in pages)
+
+
+def parse_page_batch(raw: bytes):
+    """Inverse of encode_page_batch."""
+    npages = int.from_bytes(raw[:4], "little")
+    off = 4
+    out = []
+    for _ in range(npages):
+        ln = int.from_bytes(raw[off:off + 8], "little")
+        off += 8
+        out.append(raw[off:off + ln])
+        off += ln
+    return out
 
 
 def deserialize_page(raw: bytes, dictionaries=None) -> Page:

@@ -21,21 +21,12 @@ import urllib.request
 from typing import Iterator, List
 
 
+from presto_tpu.server.serde import parse_page_batch as _parse_batch
+
+
 class TaskPullFailed(Exception):
     """The producing task reported FAILED (deterministic query error:
     the failure travels; the worker is not to blame)."""
-
-
-def _parse_batch(raw: bytes) -> List[bytes]:
-    npages = int.from_bytes(raw[:4], "little")
-    off = 4
-    out = []
-    for _ in range(npages):
-        ln = int.from_bytes(raw[off:off + 8], "little")
-        off += 8
-        out.append(raw[off:off + ln])
-        off += ln
-    return out
 
 
 def _task_error(uri: str, task_id: str) -> str:

@@ -155,9 +155,10 @@ class WorkerServer:
                     if err is not None:
                         self._send(500, json.dumps({"error": err}).encode())
                         return
-                    body = len(pages).to_bytes(4, "little") + b"".join(
-                        len(p).to_bytes(8, "little") + p for p in pages)
-                    self._send(200, body, "application/octet-stream",
+                    from presto_tpu.server.serde import encode_page_batch
+
+                    self._send(200, encode_page_batch(pages),
+                               "application/octet-stream",
                                headers=[("X-Next-Token", str(nxt)),
                                         ("X-Complete", "1" if done else "0")])
                     return
@@ -215,9 +216,10 @@ class WorkerServer:
                         pages = [serialize_page(p)
                                  for p in outer.runner._pages(fragment)]
                         outer.tasks_executed += 1
-                        body = len(pages).to_bytes(4, "little") + b"".join(
-                            len(p).to_bytes(8, "little") + p for p in pages)
-                        self._send(200, body, "application/octet-stream")
+                        from presto_tpu.server.serde import encode_page_batch
+
+                        self._send(200, encode_page_batch(pages),
+                                   "application/octet-stream")
                     except Exception as e:
                         self._send(500, json.dumps(
                             {"error": f"{type(e).__name__}: {e}"}).encode())
@@ -396,12 +398,6 @@ class WorkerServer:
 
 
 def parse_task_response(raw: bytes):
-    npages = int.from_bytes(raw[:4], "little")
-    off = 4
-    out = []
-    for _ in range(npages):
-        ln = int.from_bytes(raw[off : off + 8], "little")
-        off += 8
-        out.append(raw[off : off + ln])
-        off += ln
-    return out
+    from presto_tpu.server.serde import parse_page_batch
+
+    return parse_page_batch(raw)

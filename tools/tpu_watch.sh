@@ -1,13 +1,14 @@
 #!/bin/bash
-# Poll the TPU tunnel; when it answers, run the benchmark once and exit.
+# Poll the TPU tunnel; when it answers, run the SF1 benchmark once
+# (persisting rates to TPU_MEASURED.json) and exit.
 cd /root/repo
-for i in $(seq 1 80); do
+for i in $(seq 1 200); do
   if timeout 60 python -c "import jax,jax.numpy as jnp; print(float(jnp.arange(8).sum()))" >/dev/null 2>&1; then
     echo "$(date) tunnel up, running bench" >> bench_tpu.log
-    BENCH_SF=${BENCH_SF:-0.05} BENCH_ITERS=3 timeout 1800 python bench.py >> bench_tpu.log 2>&1
+    BENCH_SF=${BENCH_SF:-1.0} BENCH_ITERS=3 BENCH_DEADLINE=3000 timeout 3300 python bench.py >> bench_tpu.log 2>&1
     echo "$(date) bench done rc=$?" >> bench_tpu.log
     exit 0
   fi
-  sleep 180
+  sleep 120
 done
 echo "$(date) gave up waiting for tunnel" >> bench_tpu.log
