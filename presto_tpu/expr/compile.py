@@ -238,6 +238,9 @@ def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Op
         if key not in _DERIVED_DICTS:
             _DERIVED_DICTS[key] = (None, Dictionary([e.value]), [False])
         return _DERIVED_DICTS[key][1]
+    if isinstance(e, Call) and e.fn == "cast_char":
+        # metadata-only re-type: same codes, same dictionary
+        return expr_dictionary(e.args[0], dictionaries)
     if isinstance(e, Call) and e.fn in ("case", "if", "coalesce"):
         return merged_string_dictionary(e, dictionaries)
     if isinstance(e, Call) and e.fn in STRING_TRANSFORM_FNS:
@@ -557,6 +560,45 @@ class ExprCompiler:
                 return d.astype(jnp.int64), v
 
             return run_cast_bigint
+        if fn in ("cast_real", "cast_smallint", "cast_tinyint"):
+            (a,) = [self.compile(x) for x in expr.args]
+            t = expr.args[0].type
+            target = {"cast_real": jnp.float32, "cast_smallint": jnp.int16,
+                      "cast_tinyint": jnp.int8}[fn]
+
+            def run_cast_narrow(page):
+                d, v = a(page)
+                if t.is_long_decimal:
+                    # collapse the two-limb matrix through the shared
+                    # coercion first (as cast_bigint does)
+                    d = (self._coerce(d, t, DOUBLE) if fn == "cast_real"
+                         else self._coerce(d, t, BIGINT_T))
+                elif t.is_decimal:
+                    d = d / (10.0 ** t.scale) if fn == "cast_real" \
+                        else d // (10 ** t.scale)
+                # overflow truncates (documented deviation: the
+                # reference raises on out-of-range casts)
+                return d.astype(target), v
+
+            return run_cast_narrow
+        if fn in ("cast_char", "cast_varbinary"):
+            # metadata-only re-typing: dictionary codes / byte matrices
+            # pass through unchanged
+            a = self.compile(expr.args[0])
+            return lambda page: a(page)
+        if fn == "cast_time":
+            (a,) = [self.compile(x) for x in expr.args]
+            t = expr.args[0].type
+            if not (t.name in ("timestamp", "time")):
+                raise ValueError(f"cannot cast {t} to time")
+
+            def run_cast_time(page):
+                d, v = a(page)
+                if t.name == "timestamp":
+                    d = jnp.mod(d, MICROS_PER_DAY)  # time-of-day part
+                return d.astype(jnp.int64), v
+
+            return run_cast_time
         if fn in STRING_TRANSFORM_FNS:
             if fn == "concat" and any(
                 a.type.is_raw_string for a in expr.args if not isinstance(a, Literal)
@@ -1783,6 +1825,19 @@ class ExprCompiler:
         def run_arith(page):
             (da, va), (db, vb) = a(page), b(page)
             valid = va & vb
+            if tr.name == "real":
+                da2 = _to_double(da, ta).astype(jnp.float32)
+                db2 = _to_double(db, tb).astype(jnp.float32)
+                d = {
+                    "add": lambda: da2 + db2,
+                    "sub": lambda: da2 - db2,
+                    "mul": lambda: da2 * db2,
+                    "div": lambda: da2 / jnp.where(db2 == 0, 1.0, db2),
+                    "mod": lambda: jnp.mod(da2, jnp.where(db2 == 0, 1.0, db2)),
+                }[op]()
+                if op in ("div", "mod"):
+                    valid = valid & (db2 != 0)
+                return d, valid
             if tr.name == "double":
                 da2, db2 = _to_double(da, ta), _to_double(db, tb)
                 d = {
@@ -2181,6 +2236,10 @@ class ExprCompiler:
         """Coerce a comparison pair to a common representation."""
         if ta.name == "double" or tb.name == "double":
             return _to_double(da, ta), _to_double(db, tb)
+        if ta.name == "real" or tb.name == "real":
+            # REAL op decimal/integer runs in float32 (REAL result type)
+            return (_to_double(da, ta).astype(jnp.float32),
+                    _to_double(db, tb).astype(jnp.float32))
         if {ta.name, tb.name} == {"date", "timestamp"}:
             if ta.name == "date":
                 return da.astype(jnp.int64) * MICROS_PER_DAY, db

@@ -109,6 +109,14 @@ LOGIC = {"and", "or", "not"}
 
 def infer_type(fn: str, args: Sequence[Expr]) -> Type:
     ts = [a.type for a in args]
+    # declarative generic signatures resolve first (FunctionRegistry +
+    # SignatureBinder analog, presto_tpu/signature.py); unknown names
+    # fall through to the structural arms below
+    from presto_tpu.signature import REGISTRY
+
+    resolved = REGISTRY.resolve(fn, ts)
+    if resolved is not None:
+        return resolved
     if fn in CMP or fn in LOGIC or fn in ("like", "is_null", "not_null", "in", "between"):
         return BOOLEAN
     if fn == "neg":
@@ -120,6 +128,10 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
             bd = b if b.is_decimal else DecimalType(18, 0)
             if a.name == "double" or b.name == "double":
                 return DOUBLE
+            if a.name == "real" or b.name == "real":
+                from presto_tpu.types import REAL
+
+                return REAL  # DECIMAL op REAL -> REAL (reference parity)
             # long operands stay long (two-limb); short stays short —
             # deviation: the reference widens short x short products
             # past p=18 automatically, here that needs an explicit cast
@@ -173,11 +185,6 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return t
     if fn == "sign":
         return BIGINT
-    if fn in ("greatest", "least"):
-        out = ts[0]
-        for t in ts[1:]:
-            out = common_super_type(out, t)
-        return out
     if fn == "nullif":
         return ts[0]
     if fn in ("length", "strpos", "codepoint", "json_array_length",
@@ -228,6 +235,30 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         return DOUBLE
     if fn == "cast_bigint":
         return BIGINT
+    if fn == "cast_real":
+        from presto_tpu.types import REAL
+
+        return REAL
+    if fn == "cast_smallint":
+        from presto_tpu.types import SMALLINT
+
+        return SMALLINT
+    if fn == "cast_tinyint":
+        from presto_tpu.types import TINYINT
+
+        return TINYINT
+    if fn == "cast_time":
+        from presto_tpu.types import TIME
+
+        return TIME
+    if fn == "cast_char":
+        from presto_tpu.types import CharType
+
+        return CharType(int(args[1].value))
+    if fn == "cast_varbinary":
+        from presto_tpu.types import VarbinaryType
+
+        return VarbinaryType(int(args[1].value))
     if fn == "cast_decimal":
         return DecimalType(int(args[1].value), int(args[2].value))
     if fn == "substr":
@@ -256,34 +287,11 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         for t in ts[1:]:
             elem = common_super_type(elem, t)
         return ArrayType(elem, max(len(ts), 1))
-    if fn in ("subscript", "element_at"):
-        t = ts[0]
-        if not (t.is_array or t.is_map):
-            raise TypeError(f"{fn} over non-container type {t}")
-        return t.element
-    if fn == "cardinality":
-        return BIGINT
-    if fn in ("contains",):
-        return BOOLEAN
-    if fn == "array_position":
-        return BIGINT
-    if fn in ("array_min", "array_max"):
-        return ts[0].element
     if fn == "array_sum":
         e = ts[0].element
         return DOUBLE if e.name == "double" else (e if e.is_decimal else BIGINT)
     if fn == "array_average":
         return DOUBLE
-    if fn in ("array_sort", "array_distinct"):
-        return ts[0]
-    if fn == "map_keys":
-        from presto_tpu.types import ArrayType
-
-        return ArrayType(ts[0].key_element, ts[0].max_elems)
-    if fn == "map_values":
-        from presto_tpu.types import ArrayType
-
-        return ArrayType(ts[0].element, ts[0].max_elems)
     if fn == "sequence":
         from presto_tpu.types import ArrayType
 

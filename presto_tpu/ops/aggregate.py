@@ -71,9 +71,9 @@ ML_MAX_CLASSES = 8
 def _sum_type(t: Type) -> Type:
     if t.is_decimal:
         return DecimalType(36 if t.is_long_decimal else 18, t.scale)
-    if t.name == "double":
-        return DOUBLE
-    return BIGINT
+    if t.name in ("double", "real"):
+        return DOUBLE  # REAL accumulates in double (reference parity)
+    return BIGINT  # tinyint/smallint/integer/bigint widen to bigint
 
 
 VARIANCE_FNS = ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop")

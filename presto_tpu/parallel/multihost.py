@@ -51,50 +51,6 @@ class TaskFailed(Exception):
     neither retries nor excludes the worker."""
 
 
-class TaskStatusFetcher:
-    """Background task-state poller (ContinuousTaskStatusFetcher
-    analog, server/remotetask/): while the data pull long-polls the
-    results endpoint, this thread watches /v1/task/{id} so a FAILED
-    state surfaces with its error message even between result polls."""
-
-    def __init__(self, uri: str, task_id: str, interval: float = 0.5):
-        self.uri = uri.rstrip("/")
-        self.task_id = task_id
-        self.interval = interval
-        self.failed_error = None
-        self._stop = False
-        self._thread = None
-
-    def start(self) -> None:
-        import threading
-
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def poll_once(self):
-        try:
-            with urllib.request.urlopen(
-                f"{self.uri}/v1/task/{self.task_id}", timeout=5.0
-            ) as r:
-                info = json.load(r)
-            if info.get("state") == "FAILED":
-                return info.get("error") or "task failed"
-        except Exception:
-            pass
-        return None
-
-    def _run(self) -> None:
-        while not self._stop:
-            err = self.poll_once()
-            if err is not None:
-                self.failed_error = err
-                return
-            time.sleep(self.interval)
-
-    def stop(self) -> None:
-        self._stop = True
-
-
 class MultiHostUnsupported(Exception):
     pass
 
@@ -162,24 +118,18 @@ class WorkerClient:
         return tid
 
     def pull_results(self, tid: str) -> List[bytes]:
-        """Drain buffer 0 of an already-created task (the pull half);
-        a background TaskStatusFetcher watches /v1/task/{id} so FAILED
-        surfaces with its message even between result polls."""
+        """Drain buffer 0 of an already-created task (the pull half).
+        Task failure surfaces through the pull itself: a failed task's
+        buffer answers 500 with the error payload, and pull_pages also
+        consults /v1/task/{id} on error (the continuous status
+        fetcher's role, ContinuousTaskStatusFetcher analog, without a
+        dedicated polling thread per pull)."""
         from presto_tpu.server.shuffle_client import TaskPullFailed, pull_pages
 
-        fetcher = TaskStatusFetcher(self.uri, tid)
-        fetcher.start()
-        pages: List[bytes] = []
         try:
-            for raw in pull_pages(self.uri, tid, 0, timeout=self.timeout):
-                if fetcher.failed_error is not None:
-                    raise TaskFailed(fetcher.failed_error)
-                pages.append(raw)
-            return pages
+            return list(pull_pages(self.uri, tid, 0, timeout=self.timeout))
         except TaskPullFailed as e:
             raise TaskFailed(str(e)) from e
-        finally:
-            fetcher.stop()
 
     def delete_task(self, tid: str) -> None:
         try:

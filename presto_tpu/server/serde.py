@@ -50,7 +50,10 @@ from presto_tpu.types import (
 # types
 # ---------------------------------------------------------------------------
 
-_BASIC = {t.name: t for t in (BIGINT, INTEGER, DOUBLE, BOOLEAN, DATE, TIMESTAMP, VARCHAR)}
+from presto_tpu.types import REAL, SMALLINT, TIME, TINYINT  # noqa: E402
+
+_BASIC = {t.name: t for t in (BIGINT, INTEGER, SMALLINT, TINYINT, DOUBLE,
+                              REAL, BOOLEAN, DATE, TIMESTAMP, TIME, VARCHAR)}
 
 
 def type_to_json(t: Type) -> dict:
@@ -80,6 +83,14 @@ def type_from_json(d: dict) -> Type:
         from presto_tpu.types import VarcharType
 
         return VarcharType(d["precision"] or 32, raw=True)
+    if d["name"] == "varbinary":
+        from presto_tpu.types import VarbinaryType
+
+        return VarbinaryType(d["precision"] or 32)
+    if d["name"] == "char":
+        from presto_tpu.types import CharType
+
+        return CharType(d["precision"] or 32)
     return _BASIC[d["name"]]
 
 

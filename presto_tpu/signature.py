@@ -1,0 +1,213 @@
+"""Generic function-signature resolution.
+
+Reference analog: ``metadata/FunctionRegistry.java:349`` +
+``SignatureBinder`` — functions declare signatures over type variables
+and parameterized containers (``array(T)``, ``map(K,V)``), and a call
+site resolves by unifying argument types against them, falling back to
+implicit coercions (common_super_type) when no exact match binds.
+
+The engine's scalar dispatch is largely name-switched in
+``expr/ir.infer_type`` (the JIT specializes per plan, so there is no
+runtime dispatch to optimize); THIS module is the declarative layer
+over it: signatures unify, produce a type-variable binding, and yield
+the return type.  ``infer_type`` consults it for registered names, and
+new functions can be added as data instead of switch arms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DOUBLE,
+    VARCHAR,
+    Type,
+    common_super_type,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TypePattern:
+    """One parameter slot: a concrete type name ('bigint'), a type
+    variable ('T', 'K', 'V'), or a container over patterns
+    ('array(T)', 'map(K,V)')."""
+
+    kind: str  # 'concrete' | 'var' | 'array' | 'map'
+    name: str = ""
+    element: Optional["TypePattern"] = None
+    key: Optional["TypePattern"] = None
+
+
+def _parse_pattern(s: str) -> TypePattern:
+    s = s.strip()
+    if s.startswith("array(") and s.endswith(")"):
+        return TypePattern("array", element=_parse_pattern(s[6:-1]))
+    if s.startswith("map(") and s.endswith(")"):
+        inner = s[4:-1]
+        depth = 0
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return TypePattern(
+                    "map", key=_parse_pattern(inner[:i]),
+                    element=_parse_pattern(inner[i + 1:]))
+        raise ValueError(f"bad map pattern {s}")
+    if len(s) == 1 and s.isupper():
+        return TypePattern("var", name=s)
+    return TypePattern("concrete", name=s)
+
+
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    """fn(arg_patterns...) -> return_pattern; ``variadic`` repeats the
+    last parameter (concat(T, T, ...))."""
+
+    name: str
+    args: Tuple[TypePattern, ...]
+    returns: TypePattern
+    variadic: bool = False
+
+    @staticmethod
+    def of(name: str, arg_specs: Sequence[str], return_spec: str,
+           variadic: bool = False) -> "Signature":
+        return Signature(
+            name, tuple(_parse_pattern(a) for a in arg_specs),
+            _parse_pattern(return_spec), variadic)
+
+
+def _unify(pattern: TypePattern, t: Type, binding: Dict[str, Type],
+           coerce: bool) -> bool:
+    if pattern.kind == "var":
+        bound = binding.get(pattern.name)
+        if bound is None:
+            binding[pattern.name] = t
+            return True
+        if bound == t:
+            return True
+        if coerce:
+            try:
+                binding[pattern.name] = common_super_type(bound, t)
+                return True
+            except TypeError:
+                return False
+        return False
+    if pattern.kind == "concrete":
+        if t.name == pattern.name:
+            return True
+        if coerce:
+            try:
+                target = _concrete_type(pattern.name)
+            except ValueError:
+                return False
+            try:
+                return common_super_type(t, target) == target
+            except TypeError:
+                return False
+        return False
+    if pattern.kind == "array":
+        return t.is_array and _unify(pattern.element, t.element, binding, coerce)
+    if pattern.kind == "map":
+        return (t.is_map and _unify(pattern.key, t.key_element, binding, coerce)
+                and _unify(pattern.element, t.element, binding, coerce))
+    return False
+
+
+def _concrete_type(name: str) -> Type:
+    from presto_tpu.types import parse_type
+
+    return parse_type(name)
+
+
+def _instantiate(pattern: TypePattern, binding: Dict[str, Type],
+                 args: Sequence[Type]) -> Type:
+    if pattern.kind == "var":
+        return binding[pattern.name]
+    if pattern.kind == "concrete":
+        return _concrete_type(pattern.name)
+    if pattern.kind == "array":
+        from presto_tpu.types import ArrayType
+
+        elem = _instantiate(pattern.element, binding, args)
+        # preserve the argument's slot capacity when a container arg
+        # flows through (static shapes: capacity is part of the type)
+        cap = next((a.max_elems for a in args if a.is_array or a.is_map), 8)
+        return ArrayType(elem, cap)
+    if pattern.kind == "map":
+        from presto_tpu.types import MapType
+
+        cap = next((a.max_elems for a in args if a.is_map), 8)
+        return MapType(_instantiate(pattern.key, binding, args),
+                       _instantiate(pattern.element, binding, args), cap)
+    raise ValueError(pattern)
+
+
+class SignatureRegistry:
+    def __init__(self):
+        self._by_name: Dict[str, List[Signature]] = {}
+
+    def register(self, sig: Signature) -> None:
+        self._by_name.setdefault(sig.name, []).append(sig)
+
+    def names(self):
+        return self._by_name.keys()
+
+    def resolve(self, name: str, arg_types: Sequence[Type]) -> Optional[Type]:
+        """Return type for the call, or None if the name is unknown.
+        Raises TypeError when the name is known but no signature binds
+        (exact pass first, then a coercion pass — the reference's
+        two-phase resolution)."""
+        sigs = self._by_name.get(name)
+        if sigs is None:
+            return None
+        for coerce in (False, True):
+            for sig in sigs:
+                n = len(sig.args)
+                if sig.variadic:
+                    if len(arg_types) < n:
+                        continue
+                    padded = list(sig.args) + [sig.args[-1]] * (
+                        len(arg_types) - n)
+                else:
+                    if len(arg_types) != n:
+                        continue
+                    padded = list(sig.args)
+                binding: Dict[str, Type] = {}
+                if all(_unify(p, t, binding, coerce)
+                       for p, t in zip(padded, arg_types)):
+                    return _instantiate(sig.returns, binding, arg_types)
+        raise TypeError(
+            f"no signature of {name} matches ({', '.join(map(repr, arg_types))})")
+
+
+REGISTRY = SignatureRegistry()
+
+# Generic container functions — the signatures the reference declares
+# with @TypeParameter in operator/scalar/ (e.g. ArrayMaxFunction
+# "array(T) -> T").  expr/ir.infer_type consults the registry FIRST
+# for these names; the old switch arms are gone, so this is the single
+# source of truth for their typing.
+for _sig in [
+    Signature.of("greatest", ["T", "T"], "T", variadic=True),
+    Signature.of("least", ["T", "T"], "T", variadic=True),
+    Signature.of("subscript", ["array(T)", "bigint"], "T"),
+    Signature.of("subscript", ["map(K,V)", "K"], "V"),
+    Signature.of("element_at", ["array(T)", "bigint"], "T"),
+    Signature.of("element_at", ["map(K,V)", "K"], "V"),
+    Signature.of("cardinality", ["array(T)"], "bigint"),
+    Signature.of("cardinality", ["map(K,V)"], "bigint"),
+    Signature.of("contains", ["array(T)", "T"], "boolean"),
+    Signature.of("array_position", ["array(T)", "T"], "bigint"),
+    Signature.of("array_min", ["array(T)"], "T"),
+    Signature.of("array_max", ["array(T)"], "T"),
+    Signature.of("array_sort", ["array(T)"], "array(T)"),
+    Signature.of("array_distinct", ["array(T)"], "array(T)"),
+    Signature.of("map_keys", ["map(K,V)"], "array(K)"),
+    Signature.of("map_values", ["map(K,V)"], "array(V)"),
+]:
+    REGISTRY.register(_sig)

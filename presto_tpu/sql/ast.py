@@ -53,6 +53,11 @@ class TimestampLit(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class TimeLit(Node):
+    value: str  # 'HH:MM:SS[.ffffff]'
+
+
+@dataclasses.dataclass(frozen=True)
 class IntervalLit(Node):
     value: str  # e.g. '3'
     unit: str  # second | minute | hour | day | month | year

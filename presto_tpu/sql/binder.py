@@ -212,6 +212,13 @@ def _parse_timestamp(s: str) -> int:
     return (delta.days * 86_400 + delta.seconds) * 1_000_000 + delta.microseconds
 
 
+def _parse_time_of_day(s: str) -> int:
+    """'HH:MM:SS[.ffffff]' -> microseconds since midnight."""
+    t = datetime.time.fromisoformat(s.strip())
+    return ((t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000
+            + t.microsecond)
+
+
 def _shift_date(days: int, n: int, unit: str) -> int:
     d = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
     if unit == "day":
@@ -2169,6 +2176,10 @@ class Binder:
             return Literal(type=DATE, value=_parse_date(e.value))
         if isinstance(e, ast.TimestampLit):
             return Literal(type=TIMESTAMP, value=_parse_timestamp(e.value))
+        if isinstance(e, ast.TimeLit):
+            from presto_tpu.types import TIME as _TIME
+
+            return Literal(type=_TIME, value=_parse_time_of_day(e.value))
         if isinstance(e, ast.NullLit):
             return Literal(type=BIGINT, value=None)
 
@@ -2268,6 +2279,40 @@ class Binder:
                 return call("cast_decimal", v,
                             Literal(type=BIGINT, value=t.precision or 18),
                             Literal(type=BIGINT, value=t.scale or 0))
+            if tn == "real":
+                return call("cast_real", v)
+            if tn == "smallint":
+                return call("cast_smallint", v)
+            if tn == "tinyint":
+                return call("cast_tinyint", v)
+            if tn == "time":
+                from presto_tpu.types import TIME as _TIME
+
+                if isinstance(v, Literal) and v.type == VARCHAR:
+                    return Literal(type=_TIME,
+                                   value=_parse_time_of_day(v.value))
+                return call("cast_time", v)
+            if tn.startswith("char"):
+                if v.type.is_string and not v.type.is_raw_string:
+                    from presto_tpu.types import parse_type
+
+                    return call("cast_char", v,
+                                Literal(type=BIGINT,
+                                        value=parse_type(tn).precision or 32))
+            if tn.startswith("varbinary"):
+                if v.type.is_raw_string:
+                    from presto_tpu.types import parse_type
+
+                    # a raw varchar IS a byte matrix; re-type in place
+                    return call("cast_varbinary", v,
+                                Literal(type=BIGINT,
+                                        value=parse_type(tn).precision
+                                        or (v.type.precision or 32)))
+            if tn.startswith("varchar"):
+                # identity for string-typed values (the engine's strings
+                # are dictionary codes; re-typing is metadata-only)
+                if v.type.is_string:
+                    return v
             raise BindError(f"unsupported CAST to {e.type_name}")
 
         if isinstance(e, ast.Extract):
@@ -2283,6 +2328,11 @@ class Binder:
                           "none_match") and len(e.args) == 2 \
                     and isinstance(e.args[1], ast.Lambda):
                 return self._bind_array_lambda(e, scope, agg)
+            if e.name == "typeof":
+                if len(e.args) != 1:
+                    raise BindError("typeof takes one argument")
+                arg = self._bind_impl(e.args[0], scope, agg)
+                return Literal(type=VARCHAR, value=repr(arg.type))
             if e.name == "now":
                 if e.args:
                     raise BindError("now() takes no arguments")

@@ -594,6 +594,13 @@ class Parser:
             self.i += 1
             return ast.TimestampLit(s.value)
 
+        if (self.tok.kind in ("ident", "keyword") and self.tok.value.lower() == "time"
+                and self.tokens[self.i + 1].kind == "string"):
+            self.i += 1
+            s = self.tok
+            self.i += 1
+            return ast.TimeLit(s.value)
+
         if self.accept("interval"):
             neg = bool(self.accept("-"))
             s = self.tok

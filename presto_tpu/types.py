@@ -71,16 +71,24 @@ class Type:
             return f"map({self.key_element!r},{self.element!r})"
         if self.scale is not None:
             return f"decimal({self.precision},{self.scale})"
+        if self.name in ("char", "varbinary") and self.precision:
+            return f"{self.name}({self.precision})"
         return self.name
 
     # -- classification helpers -------------------------------------------
     @property
     def is_numeric(self) -> bool:
-        return self.name in ("bigint", "integer", "double", "decimal")
+        return self.name in ("bigint", "integer", "smallint", "tinyint",
+                             "double", "real", "decimal")
 
     @property
     def is_integerlike(self) -> bool:
-        return self.name in ("bigint", "integer", "date", "timestamp")
+        return self.name in ("bigint", "integer", "smallint", "tinyint",
+                             "date", "timestamp", "time")
+
+    @property
+    def is_binary(self) -> bool:
+        return self.name == "varbinary"
 
     @property
     def is_decimal(self) -> bool:
@@ -99,7 +107,7 @@ class Type:
         () for everything else."""
         if self.is_long_decimal:
             return (2,)
-        if self.is_raw_string:
+        if self.is_raw_string or self.is_binary:
             return (self.precision or 32,)
         if self.name == "array":
             return (1 + (self.precision or 8),)
@@ -146,11 +154,34 @@ class Type:
 
 BIGINT = Type("bigint", np.dtype(np.int64))
 INTEGER = Type("integer", np.dtype(np.int32))
+SMALLINT = Type("smallint", np.dtype(np.int16))
+TINYINT = Type("tinyint", np.dtype(np.int8))
 DOUBLE = Type("double", np.dtype(np.float64))
+REAL = Type("real", np.dtype(np.float32))
 BOOLEAN = Type("boolean", np.dtype(np.bool_))
 DATE = Type("date", np.dtype(np.int32))
 TIMESTAMP = Type("timestamp", np.dtype(np.int64))
+# TIME: microseconds since midnight (reference: spi/type/TimeType.java)
+TIME = Type("time", np.dtype(np.int64))
 MICROS_PER_DAY = 86_400_000_000
+
+
+def VarbinaryType(length: int = 32) -> Type:
+    """VARBINARY as a fixed-capacity (capacity, length) uint8 byte
+    matrix — the raw-varchar representation without string semantics
+    (reference: spi/type/VarbinaryType.java)."""
+    return Type("varbinary", np.dtype(np.uint8), precision=length)
+
+
+VARBINARY = VarbinaryType()
+
+
+def CharType(length: int = 32) -> Type:
+    """CHAR(n): dictionary-coded like VARCHAR but typed distinctly so
+    typeof() reports char(n) (reference: spi/type/CharType.java; the
+    blank-padded comparison semantics are NOT emulated — values are
+    compared as stored)."""
+    return Type("char", np.dtype(np.int32), dictionary=True, precision=length)
 
 
 def VarcharType(length: int = 32, raw: bool = False) -> Type:
@@ -244,7 +275,17 @@ def common_super_type(a: Type, b: Type) -> Type:
             return a
         if b.is_raw_string:
             return b
-    order = {"boolean": 0, "integer": 1, "date": 1, "bigint": 2, "decimal": 3, "double": 4}
+    if a.name == "char" and b.name == "char":
+        return a if (a.precision or 0) >= (b.precision or 0) else b
+    if a.name == "char" and b.name == "varchar":
+        return b
+    if a.name == "varchar" and b.name == "char":
+        return a
+    # the ladder follows the reference's coercion matrix: fixed-width
+    # integers widen upward, DECIMAL op REAL -> REAL, anything op
+    # DOUBLE -> DOUBLE (metadata/FunctionRegistry.java:349)
+    order = {"boolean": 0, "tinyint": 1, "smallint": 2, "integer": 3,
+             "date": 3, "bigint": 4, "decimal": 5, "real": 6, "double": 7}
     if a.name in order and b.name in order:
         winner = a if order[a.name] >= order[b.name] else b
         loser = b if winner is a else a
@@ -252,7 +293,8 @@ def common_super_type(a: Type, b: Type) -> Type:
             scale = max(a.scale, b.scale)
             long_ = a.is_long_decimal or b.is_long_decimal
             return DecimalType(36 if long_ else 18, scale)
-        if winner.is_decimal and loser.name in ("bigint", "integer"):
+        if winner.is_decimal and loser.name in (
+                "bigint", "integer", "smallint", "tinyint"):
             return winner
         return winner
     raise TypeError(f"no common super type for {a} and {b}")
@@ -300,17 +342,27 @@ def parse_type(s: str) -> Type:
             sc = int(parts[1]) if len(parts) > 1 else 0
             return DecimalType(p, sc)
         return DecimalType()
-    if s.startswith("varchar") or s.startswith("char"):
+    if s.startswith("varbinary"):
+        width = int(s[s.index("(") + 1 : s.rindex(")")]) if "(" in s else 32
+        return VarbinaryType(width)
+    if s.startswith("char"):
+        width = int(s[s.index("(") + 1 : s.rindex(")")]) if "(" in s else 32
+        return CharType(width)
+    if s.startswith("varchar"):
         return VARCHAR
     m = {
         "bigint": BIGINT,
         "integer": INTEGER,
         "int": INTEGER,
+        "smallint": SMALLINT,
+        "tinyint": TINYINT,
         "double": DOUBLE,
         "double precision": DOUBLE,
+        "real": REAL,
         "boolean": BOOLEAN,
         "date": DATE,
         "timestamp": TIMESTAMP,
+        "time": TIME,
     }
     if s in m:
         return m[s]

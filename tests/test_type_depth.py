@@ -1,0 +1,117 @@
+"""Round-3 type-system depth (VERDICT r2 #8): REAL / SMALLINT /
+TINYINT / TIME / VARBINARY / CHAR, typeof(), and the generic
+signature binder (metadata/FunctionRegistry.java:349 + SignatureBinder
+analog)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.page import Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import (
+    BIGINT, DOUBLE, INTEGER, REAL, SMALLINT, TIME, TINYINT,
+    CharType, VarbinaryType, common_super_type, parse_type,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    mem = MemoryConnector()
+    mem.create_table(
+        "t", [("s", SMALLINT), ("b", TINYINT), ("r", REAL), ("x", BIGINT)],
+        [Page.from_arrays(
+            [np.array([1, 2, 3, 30000], dtype=np.int16),
+             np.array([1, 2, 3, 100], dtype=np.int8),
+             np.array([0.5, 1.5, 2.5, 3.5], dtype=np.float32),
+             np.array([10, 20, 30, 40], dtype=np.int64)],
+            [SMALLINT, TINYINT, REAL, BIGINT])])
+    cat = Catalog()
+    cat.register("mem", mem)
+    return QueryRunner(cat)
+
+
+def test_parse_and_repr():
+    assert repr(parse_type("real")) == "real"
+    assert repr(parse_type("smallint")) == "smallint"
+    assert repr(parse_type("tinyint")) == "tinyint"
+    assert repr(parse_type("time")) == "time"
+    assert repr(parse_type("varbinary(16)")) == "varbinary(16)"
+    assert repr(parse_type("char(10)")) == "char(10)"
+    assert parse_type("varbinary(16)").np_dtype == np.dtype(np.uint8)
+    assert CharType(10).dictionary and VarbinaryType(4).value_shape == (4,)
+
+
+def test_coercion_ladder():
+    assert common_super_type(TINYINT, SMALLINT) is SMALLINT
+    assert common_super_type(SMALLINT, INTEGER) is INTEGER
+    assert common_super_type(INTEGER, BIGINT) is BIGINT
+    assert common_super_type(BIGINT, REAL) is REAL
+    assert common_super_type(REAL, DOUBLE) is DOUBLE
+    assert common_super_type(parse_type("decimal(10,2)"), REAL) is REAL
+    assert common_super_type(CharType(5), parse_type("varchar")).name == "varchar"
+
+
+def test_narrow_types_execute(runner):
+    rows = runner.execute(
+        "select sum(s), sum(b), sum(r), max(s), min(b) from t").rows
+    assert rows[0][0] == 30006 and rows[0][1] == 106
+    assert rows[0][2] == pytest.approx(8.0)
+    assert rows[0][3] == 30000 and rows[0][4] == 1
+    # arithmetic promotes: smallint + bigint -> bigint, real * 2 real-ish
+    rows = runner.execute("select s + x, r * 2.0 from t order by x limit 1").rows
+    assert rows[0][0] == 11 and rows[0][1] == pytest.approx(1.0)
+
+
+def test_casts(runner):
+    rows = runner.execute(
+        "select cast(x as real), cast(x as smallint), cast(x as tinyint) "
+        "from t order by x limit 1").rows
+    assert rows[0] == (10.0, 10, 10)
+    rows = runner.execute("select cast(r as bigint) from t order by x").rows
+    assert [r[0] for r in rows] == [0, 1, 2, 3]
+
+
+def test_typeof(runner):
+    rows = runner.execute(
+        "select typeof(s), typeof(b), typeof(r), typeof(x), "
+        "typeof(r + 1.0), typeof(s + x), typeof(time '10:30:00') from t limit 1").rows
+    # r + 1.0: the literal 1.0 is decimal(18,1); DECIMAL op REAL -> REAL
+    assert rows[0] == ("smallint", "tinyint", "real", "bigint",
+                      "real", "bigint", "time")
+
+
+def test_time_literals(runner):
+    rows = runner.execute(
+        "select time '10:30:00' < time '11:00:00', "
+        "       time '23:59:59' > time '00:00:00' from t limit 1").rows
+    assert rows[0] == (True, True)
+
+
+def test_signature_binder_generics():
+    from presto_tpu.signature import REGISTRY
+    from presto_tpu.types import ArrayType, MapType, VARCHAR, BOOLEAN
+
+    arr = ArrayType(DOUBLE, 4)
+    assert REGISTRY.resolve("array_max", [arr]) is DOUBLE
+    assert REGISTRY.resolve("array_sort", [arr]) == arr
+    m = MapType(VARCHAR, BIGINT, 4)
+    assert REGISTRY.resolve("map_keys", [m]) == ArrayType(VARCHAR, 4)
+    assert REGISTRY.resolve("element_at", [m, VARCHAR]) is BIGINT
+    # coercion pass: INTEGER index coerces to the declared bigint
+    assert REGISTRY.resolve("subscript", [arr, INTEGER]) is DOUBLE
+    # T-unification with coercion: contains(array(bigint), integer)
+    assert REGISTRY.resolve("contains", [ArrayType(BIGINT, 4), INTEGER]) is BOOLEAN
+    # unknown names fall through to the structural arms
+    assert REGISTRY.resolve("no_such_fn", [BIGINT]) is None
+    with pytest.raises(TypeError):
+        REGISTRY.resolve("array_max", [BIGINT])  # known name, no match
+
+
+def test_signature_binder_through_sql(runner):
+    rows = runner.execute(
+        "select greatest(s, x), least(r, 1.0), "
+        "       array_max(array[x, x + 5]) from t order by x limit 1").rows
+    assert rows[0][0] == 10 and rows[0][1] == pytest.approx(0.5)
+    assert rows[0][2] == 15
