@@ -1,0 +1,316 @@
+"""PCF — the engine's ORC-class single-file columnar format.
+
+Reference analog: ``presto-orc`` (``orc/OrcReader.java``,
+``OrcRecordReader.java``, ``writer/``) — a self-describing file of
+row-group *stripes*, each holding per-column byte ranges with stats,
+adaptive encodings and block compression, read lazily (only the
+selected columns of the selected stripes ever leave disk).
+
+Layout (little-endian)::
+
+    [stripe 0 column chunks][stripe 1 column chunks]...
+    [footer JSON][footer-length u32][b"PCF1"]
+
+Each column chunk is the column's dtype bytes (+ packed validity
+bitmap) under an optional codec.  The footer carries the schema,
+table-level dictionaries (the engine's dictionary-coded VARCHAR), and
+per-stripe, per-column: byte ranges, dtype/shape, codec, encoding,
+min/max/null stats.
+
+TPU-first choices vs ORC:
+- chunks are raw numpy dtype bytes, not stream-encoded values — the
+  device wants dense arrays; zero parse cost on the scan path;
+- per-stripe ADAPTIVE DICTIONARY encoding applies to raw-varchar byte
+  matrices (<=255 distinct values and a byte saving -> uint8 codes +
+  a stripe-local dictionary), mirroring ORC's dictionary encoding
+  decision per stripe;
+- codecs are the stdlib's real compressors (zlib, lzma) chosen per
+  column chunk (ORC offers zlib/LZ4/ZSTD/Snappy).
+"""
+
+from __future__ import annotations
+
+import json
+import lzma
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.types import Type, parse_type
+
+MAGIC = b"PCF1"
+
+_CODECS = {
+    "raw": (lambda b: b, lambda b: b),
+    "zlib": (lambda b: zlib.compress(b, 6), zlib.decompress),
+    "lzma": (lambda b: lzma.compress(b, preset=1), lzma.decompress),
+}
+
+
+def _type_str(t: Type) -> str:
+    if t.is_decimal:
+        return f"decimal({t.precision},{t.scale})"
+    if t.is_raw_string:
+        return f"raw_varchar({t.precision})"
+    if t.is_binary:
+        return f"varbinary({t.precision})"
+    return t.name
+
+
+def _col_stats(data: np.ndarray, valid: np.ndarray, t: Type) -> dict:
+    out = {"nulls": int((~valid).sum())}
+    if data.ndim == 1 and not t.is_string and valid.any():
+        live = data[valid]
+        if np.issubdtype(data.dtype, np.integer):
+            out["min"], out["max"] = int(live.min()), int(live.max())
+        elif np.issubdtype(data.dtype, np.floating):
+            out["min"], out["max"] = float(live.min()), float(live.max())
+    return out
+
+
+class PcfWriter:
+    """Streaming stripe writer: feed pages, each page becomes one
+    stripe (the caller controls stripe granularity the way the
+    reference's writer flushes at stripe size)."""
+
+    def __init__(self, path: str, schema: Sequence[Tuple[str, Type]],
+                 compression: str = "zlib",
+                 dictionaries: Optional[Dict[str, Sequence[str]]] = None):
+        if compression not in _CODECS:
+            raise ValueError(f"unknown codec {compression!r}")
+        self.path = path
+        self.schema = list(schema)
+        self.compression = compression
+        self.dictionaries: Dict[str, List[str]] = {
+            k: list(v) for k, v in (dictionaries or {}).items()}
+        self._f = open(path, "wb")
+        self._stripes: List[dict] = []
+        self._closed = False
+
+    # -- encoding decisions -------------------------------------------------
+    def _encode_column(self, col: str, t: Type, data: np.ndarray,
+                       valid: np.ndarray) -> Tuple[bytes, dict]:
+        meta: dict = {"dtype": str(data.dtype), "shape": list(data.shape[1:]),
+                      "enc": "direct"}
+        payload = np.ascontiguousarray(data).tobytes()
+        if (t.is_raw_string or t.is_binary) and data.ndim == 2 and len(data):
+            # adaptive dictionary encoding: unique byte rows -> uint8
+            # codes + stripe-local dictionary (OrcWriter's per-stripe
+            # DICTIONARY_V2 decision)
+            uniq, codes = np.unique(data, axis=0, return_inverse=True)
+            if len(uniq) <= 255:
+                encoded = codes.astype(np.uint8).tobytes()
+                dict_bytes = uniq.tobytes()
+                if len(encoded) + len(dict_bytes) < len(payload):
+                    meta["enc"] = "dict"
+                    meta["dict_rows"] = int(len(uniq))
+                    payload = encoded + dict_bytes
+        return payload, meta
+
+    def write_page(self, page: Page) -> None:
+        assert not self._closed
+        p = page.compact_host()
+        n = int(np.asarray(p.num_rows()))
+        cols: Dict[str, dict] = {}
+        encode, _ = _CODECS[self.compression]
+        for (col, t), b in zip(self.schema, p.blocks):
+            data = np.asarray(b.data)[:n]
+            valid = np.asarray(b.valid)[:n]
+            if t.is_string and not t.is_raw_string and b.dictionary is not None \
+                    and col not in self.dictionaries:
+                self.dictionaries[col] = list(b.dictionary.values)
+            payload, meta = self._encode_column(col, t, data, valid)
+            body = encode(payload)
+            codec = self.compression
+            if len(body) >= len(payload):
+                body, codec = payload, "raw"  # incompressible: store raw
+            vbytes = np.packbits(valid).tobytes()
+            off = self._f.tell()
+            self._f.write(body)
+            voff = self._f.tell()
+            self._f.write(vbytes)
+            meta.update({"off": off, "len": len(body), "voff": voff,
+                         "vlen": len(vbytes), "codec": codec,
+                         "raw_len": len(payload)})
+            meta.update(_col_stats(data, valid, t))
+            cols[col] = meta
+        self._stripes.append({"rows": n, "columns": cols})
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        footer = {
+            "schema": [[c, _type_str(t)] for c, t in self.schema],
+            "dictionaries": self.dictionaries,
+            "stripes": self._stripes,
+        }
+        fj = json.dumps(footer).encode()
+        self._f.write(fj)
+        self._f.write(len(fj).to_bytes(4, "little"))
+        self._f.write(MAGIC)
+        self._f.close()
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_pcf(path: str, schema, pages, compression: str = "zlib",
+              dictionaries=None) -> None:
+    with PcfWriter(path, schema, compression, dictionaries) as w:
+        for p in pages:
+            w.write_page(p)
+
+
+class PcfFile:
+    """Lazy reader: the footer is parsed once; column chunks are read
+    with per-chunk seeks only when asked for (OrcRecordReader's
+    included-columns projection)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.bytes_read = 0  # observable laziness (tests + EXPLAIN)
+        with open(path, "rb") as f:
+            f.seek(-8, os.SEEK_END)
+            tail = f.read(8)
+            if tail[4:] != MAGIC:
+                raise ValueError(f"{path}: not a PCF file")
+            flen = int.from_bytes(tail[:4], "little")
+            f.seek(-8 - flen, os.SEEK_END)
+            footer = json.loads(f.read(flen))
+        self.schema: List[Tuple[str, Type]] = [
+            (c, parse_type(t)) for c, t in footer["schema"]]
+        self._dict_values = footer["dictionaries"]
+        self._dicts: Dict[str, Optional[Dictionary]] = {}
+        self.stripes: List[dict] = footer["stripes"]
+
+    @property
+    def num_stripes(self) -> int:
+        return len(self.stripes)
+
+    def stripe_rows(self, i: int) -> int:
+        return self.stripes[i]["rows"]
+
+    def stripe_stats(self, i: int) -> Dict[str, Tuple[float, float]]:
+        out = {}
+        for col, m in self.stripes[i]["columns"].items():
+            if "min" in m:
+                out[col] = (m["min"], m["max"])
+        return out
+
+    def dictionary_for(self, column: str) -> Optional[Dictionary]:
+        if column not in self._dicts:
+            vals = self._dict_values.get(column)
+            self._dicts[column] = Dictionary(vals) if vals is not None else None
+        return self._dicts[column]
+
+    def _read_range(self, f, off: int, ln: int) -> bytes:
+        f.seek(off)
+        self.bytes_read += ln
+        return f.read(ln)
+
+    def read_column(self, stripe: int, column: str):
+        """(data, valid) numpy arrays for one column of one stripe."""
+        s = self.stripes[stripe]
+        m = s["columns"][column]
+        n = s["rows"]
+        with open(self.path, "rb") as f:
+            body = self._read_range(f, m["off"], m["len"])
+            vbytes = self._read_range(f, m["voff"], m["vlen"])
+        _, decode = _CODECS[m["codec"]]
+        payload = decode(body)
+        dtype = np.dtype(m["dtype"])
+        shape = tuple(m["shape"])
+        if m.get("enc") == "dict":
+            k = m["dict_rows"]
+            codes = np.frombuffer(payload[:n], dtype=np.uint8)
+            local = np.frombuffer(payload[n:], dtype=dtype).reshape((k,) + shape)
+            data = local[codes]
+        else:
+            data = np.frombuffer(payload, dtype=dtype).reshape((n,) + shape)
+        valid = np.unpackbits(
+            np.frombuffer(vbytes, dtype=np.uint8))[:n].astype(bool)
+        return data, valid
+
+    def read_stripe(self, stripe: int, columns: Optional[Sequence[str]] = None,
+                    capacity: Optional[int] = None) -> Page:
+        names = [c for c, _ in self.schema]
+        want = list(columns) if columns is not None else names
+        types = dict(self.schema)
+        cols, valids, dicts, ts = [], [], [], []
+        n = self.stripes[stripe]["rows"]
+        for c in want:
+            data, valid = self.read_column(stripe, c)
+            cols.append(data)
+            valids.append(valid)
+            ts.append(types[c])
+            dicts.append(self.dictionary_for(c))
+        return Page.from_arrays(cols, ts, valids=valids, dictionaries=dicts,
+                                capacity=capacity or max(n, 1))
+
+
+class PcfConnector:
+    """Connector over a directory of ``<table>.pcf`` files: stripes are
+    splits, stripe stats drive split pruning, and scans read only the
+    projected columns (the presto-orc + raptor storage role behind the
+    standard connector protocol)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._files: Dict[str, PcfFile] = {}
+
+    def _file(self, table: str) -> PcfFile:
+        if table not in self._files:
+            self._files[table] = PcfFile(os.path.join(self.root, table + ".pcf"))
+        return self._files[table]
+
+    def table_names(self) -> List[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(f[:-4] for f in os.listdir(self.root)
+                      if f.endswith(".pcf"))
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return list(self._file(table).schema)
+
+    def num_splits(self, table: str) -> int:
+        return self._file(table).num_stripes
+
+    def row_count(self, table: str) -> int:
+        f = self._file(table)
+        return sum(f.stripe_rows(i) for i in range(f.num_stripes))
+
+    def split_stats(self, table: str, split: int):
+        return self._file(table).stripe_stats(split)
+
+    def column_domain(self, table: str, column: str) -> Optional[Tuple[int, int]]:
+        f = self._file(table)
+        t = dict(f.schema)[column]
+        if t.is_string and not t.is_raw_string:
+            d = f.dictionary_for(column)
+            return (0, len(d) - 1) if d is not None else None
+        los, his = [], []
+        for i in range(f.num_stripes):
+            st = f.stripe_stats(i).get(column)
+            if st is None:
+                return None
+            los.append(st[0])
+            his.append(st[1])
+        if not los or not all(isinstance(v, int) for v in los + his):
+            return None
+        return (min(los), max(his))
+
+    def dictionary_for(self, table: str, column: str) -> Optional[Dictionary]:
+        return self._file(table).dictionary_for(column)
+
+    def page_for_split(self, table: str, split: int,
+                       capacity: Optional[int] = None,
+                       columns: Optional[Sequence[str]] = None) -> Page:
+        return self._file(table).read_stripe(split, columns=columns,
+                                             capacity=capacity)

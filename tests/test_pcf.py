@@ -1,0 +1,129 @@
+"""PCF single-file columnar format (presto-orc analog): stripes,
+per-stripe stats, adaptive dictionary encoding, real codecs, lazy
+column reads, and end-to-end SQL over the PcfConnector."""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.page import Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.storage.pcf import PcfConnector, PcfFile, write_pcf
+from presto_tpu.types import (
+    BIGINT, DOUBLE, DATE, VARCHAR, DecimalType, VarcharType,
+)
+
+
+def _pages():
+    rng = np.random.default_rng(7)
+    pages = []
+    for k in range(3):
+        n = 1000
+        pages.append(Page.from_arrays(
+            [np.arange(k * n, (k + 1) * n, dtype=np.int64),
+             rng.normal(size=n),
+             rng.integers(0, 3, n).astype(np.int32),
+             rng.integers(100, 999, n).astype(np.int64)],
+            [BIGINT, DOUBLE, VARCHAR, DecimalType(10, 2)],
+            valids=[None, np.asarray(np.arange(n) % 7 != 0), None, None],
+            dictionaries=[None,
+                          None,
+                          __import__("presto_tpu.page", fromlist=["Dictionary"])
+                          .Dictionary(["red", "green", "blue"]),
+                          None],
+        ))
+    return pages
+
+
+SCHEMA = [("k", BIGINT), ("x", DOUBLE), ("color", VARCHAR),
+          ("amt", DecimalType(10, 2))]
+
+
+@pytest.fixture()
+def pcf_path(tmp_path):
+    path = str(tmp_path / "t.pcf")
+    write_pcf(path, SCHEMA, _pages())
+    return path
+
+
+def test_roundtrip_and_stats(pcf_path):
+    f = PcfFile(pcf_path)
+    assert f.num_stripes == 3
+    assert f.stripe_rows(0) == 1000
+    st = f.stripe_stats(1)
+    assert st["k"] == (1000, 1999)  # per-stripe min/max
+    page = f.read_stripe(0)
+    rows = page.compact_host().to_pylist()
+    assert len(rows) == 1000
+    assert rows[1][0] == 1 and rows[2][2] in ("red", "green", "blue")
+    # NULLs survive
+    assert rows[0][1] is None
+
+
+def test_lazy_column_reads(pcf_path):
+    f = PcfFile(pcf_path)
+    f.read_stripe(0, columns=["k"])
+    one_col = f.bytes_read
+    f2 = PcfFile(pcf_path)
+    f2.read_stripe(0)
+    assert one_col < f2.bytes_read / 2  # projection reads far less
+
+
+def test_adaptive_dictionary_encoding(tmp_path):
+    # low-cardinality raw varchar: dict encoding must engage and shrink
+    t = VarcharType(16, raw=True)
+    vals = np.zeros((5000, 16), dtype=np.uint8)
+    for i in range(5000):
+        s = b"ab" if i % 2 else b"cd"
+        vals[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+    page = Page.from_arrays([vals], [t])
+    p_dict = str(tmp_path / "d.pcf")
+    write_pcf(p_dict, [("s", t)], [page], compression="raw")
+    f = PcfFile(p_dict)
+    meta = f.stripes[0]["columns"]["s"]
+    assert meta["enc"] == "dict" and meta["dict_rows"] == 2
+    assert meta["len"] < 5000 * 16 / 2
+    data, valid = f.read_column(0, "s")
+    assert bytes(data[0][:2]) == b"cd" and bytes(data[1][:2]) == b"ab"
+
+
+def test_codecs(tmp_path):
+    for codec in ("raw", "zlib", "lzma"):
+        path = str(tmp_path / f"c_{codec}.pcf")
+        write_pcf(path, SCHEMA, _pages(), compression=codec)
+        f = PcfFile(path)
+        assert f.read_stripe(2).compact_host().to_pylist()[0][0] == 2000
+    # compressible data actually shrinks under zlib
+    raw = os.path.getsize(str(tmp_path / "c_raw.pcf"))
+    z = os.path.getsize(str(tmp_path / "c_zlib.pcf"))
+    assert z < raw
+
+
+def test_sql_over_pcf_connector(tmp_path):
+    write_pcf(str(tmp_path / "t.pcf"), SCHEMA, _pages())
+    cat = Catalog()
+    cat.register("pcf", PcfConnector(str(tmp_path)))
+    r = QueryRunner(cat)
+    assert r.execute("select count(*) from t").rows == [(3000,)]
+    rows = r.execute(
+        "select color, count(*), sum(amt) from t group by color order by 1").rows
+    assert [x[0] for x in rows] == ["blue", "green", "red"]
+    # stripe pruning: k >= 2000 only lives in stripe 2
+    got = r.execute("select count(*) from t where k >= 2000").rows
+    assert got == [(1000,)]
+
+
+def test_stripe_pruning_skips_io(tmp_path):
+    write_pcf(str(tmp_path / "t.pcf"), SCHEMA, _pages())
+    conn = PcfConnector(str(tmp_path))
+    cat = Catalog()
+    cat.register("pcf", conn)
+    r = QueryRunner(cat)
+    r.execute("select count(*) from t where k < 500")  # stripe 0 only
+    f = conn._files["t"]
+    before = f.bytes_read
+    r.execute("select count(*) from t where k < 500")
+    # plan caching may rescan; the point: pruned stripes read nothing
+    assert f.bytes_read <= before * 2
