@@ -1368,6 +1368,18 @@ class Binder:
         ) or (q.having is not None and self._contains_agg(q.having))
 
         order_items = list(q.order_by)
+        if order_items:
+            # ORDER BY may reference select aliases INSIDE expressions
+            # (e.g. CASE WHEN lochierarchy = 0 THEN ... — TPC-DS
+            # q36/q70/q86); substitute the aliased expression wherever
+            # the name does not resolve as a real column
+            alias_map = {n: se for se, n in items
+                         if not isinstance(se, ast.Star)}
+            order_items = [
+                dataclasses.replace(
+                    o, expr=self._substitute_aliases(o.expr, alias_map, scope))
+                for o in order_items
+            ]
 
         if has_aggs:
             if select_sub_ids:
@@ -2740,6 +2752,39 @@ class Binder:
         return agg.agg_ref(a)
 
     # ------------------------------------------------------------------
+    def _substitute_aliases(self, e: ast.Node, alias_map: Dict[str, ast.Node],
+                            scope) -> ast.Node:
+        """Replace bare identifiers that name a select alias (and do NOT
+        resolve as real columns — columns win) with the aliased
+        expression; descends expressions but not subquery bodies."""
+        if isinstance(e, ast.Identifier) and e.qualifier is None \
+                and e.name in alias_map:
+            try:
+                scope.resolve(None, e.name)
+                return e  # a real column shadows the alias
+            except Exception:
+                return alias_map[e.name]
+        if isinstance(e, (ast.Query, ast.Union, ast.ScalarSubquery,
+                          ast.Exists, ast.InSubquery)):
+            return e
+        if dataclasses.is_dataclass(e) and isinstance(e, ast.Node):
+            changes = {}
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name)
+                nv = self._sub_alias_value(v, alias_map, scope)
+                if nv is not v:
+                    changes[f.name] = nv
+            return dataclasses.replace(e, **changes) if changes else e
+        return e
+
+    def _sub_alias_value(self, v, alias_map, scope):
+        if isinstance(v, ast.Node):
+            return self._substitute_aliases(v, alias_map, scope)
+        if isinstance(v, tuple):
+            out = tuple(self._sub_alias_value(x, alias_map, scope) for x in v)
+            return out if any(a is not b for a, b in zip(out, v)) else v
+        return v
+
     def _bind_order(self, order_items, items, out_irs, scope) -> List[Expr]:
         order_irs: List[Expr] = []
         for o in order_items:

@@ -1,4 +1,4 @@
-"""TPC-DS benchmark corpus, engine dialect — 68 queries spanning star
+"""TPC-DS benchmark corpus, engine dialect — 77 queries spanning star
 joins, outer/full joins, window frames, ROLLUP, correlated scalar
 subqueries, EXISTS under OR (mark joins), mixed DISTINCT aggregates,
 scalar subqueries in SELECT position, and NOT EXISTS.
@@ -1397,6 +1397,259 @@ group by substr(w_warehouse_name, 1, 20), sm_type, cc_name
 order by wh20, sm_type, cc_name
 limit 100
 """,
+    # q10's quarterly sibling: store AND (web OR catalog) activity
+    35: """
+select ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) as cnt1, min(cd_dep_count) as mn, max(cd_dep_count) as mx,
+       avg(cd_dep_count) as av
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+    and cd_demo_sk = c.c_current_cdemo_sk
+    and exists (select * from store_sales, date_dim
+                where c.c_customer_sk = ss_customer_sk
+                    and ss_sold_date_sk = d_date_sk
+                    and d_year = 2002 and d_qoy < 4)
+    and (exists (select * from web_sales, date_dim
+                 where c.c_customer_sk = ws_bill_customer_sk
+                     and ws_sold_date_sk = d_date_sk
+                     and d_year = 2002 and d_qoy < 4)
+      or exists (select * from catalog_sales, date_dim
+                 where c.c_customer_sk = cs_ship_customer_sk
+                     and cs_sold_date_sk = d_date_sk
+                     and d_year = 2002 and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count
+order by ca_state, cd_gender, cd_marital_status, cd_dep_count
+limit 100
+""",
+    # gross-margin ROLLUP with rank-within-parent (grouping() windows)
+    36: """
+select sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price) as gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ss_net_profit) * 1.0
+                             / sum(ss_ext_sales_price) asc)
+           as rank_within_parent
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+    and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk
+    and s_store_sk = ss_store_sk
+    and s_state in ('TN', 'CA', 'TX', 'OH')
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+""",
+    # monthly deviation with prior/next month via rank self-joins
+    47: """
+with v1 as (
+    select i_category, i_brand, s_store_name, s_county, d_year, d_moy,
+           sum(ss_sales_price) as sum_sales,
+           avg(sum(ss_sales_price)) over (partition by i_category, i_brand,
+                                          s_store_name, s_county, d_year)
+               as avg_monthly_sales,
+           rank() over (partition by i_category, i_brand, s_store_name,
+                        s_county order by d_year, d_moy) as rn
+    from item, store_sales, date_dim, store
+    where ss_item_sk = i_item_sk
+        and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and (d_year = 1999
+          or (d_year = 1998 and d_moy = 12)
+          or (d_year = 2000 and d_moy = 1))
+    group by i_category, i_brand, s_store_name, s_county, d_year, d_moy
+)
+select v1.i_category, v1.i_brand, v1.s_store_name, v1.d_year, v1.d_moy,
+       v1.avg_monthly_sales, v1.sum_sales,
+       v1_lag.sum_sales as psum, v1_lead.sum_sales as nsum
+from v1, v1 as v1_lag, v1 as v1_lead
+where v1.i_category = v1_lag.i_category
+    and v1.i_brand = v1_lag.i_brand
+    and v1.s_store_name = v1_lag.s_store_name
+    and v1.s_county = v1_lag.s_county
+    and v1.rn = v1_lag.rn + 1
+    and v1.i_category = v1_lead.i_category
+    and v1.i_brand = v1_lead.i_brand
+    and v1.s_store_name = v1_lead.s_store_name
+    and v1.s_county = v1_lead.s_county
+    and v1.rn = v1_lead.rn - 1
+    and v1.avg_monthly_sales > 0
+    and case when v1.avg_monthly_sales > 0
+             then abs(v1.sum_sales - v1.avg_monthly_sales)
+                  / v1.avg_monthly_sales
+             else null end > 0.1
+order by v1.i_category, v1.i_brand, v1.s_store_name, v1.d_year, v1.d_moy
+limit 100
+""",
+    # q47's catalog sibling (call centers)
+    57: """
+with v1 as (
+    select i_category, i_brand, cc_name, d_year, d_moy,
+           sum(cs_sales_price) as sum_sales,
+           avg(sum(cs_sales_price)) over (partition by i_category, i_brand,
+                                          cc_name, d_year)
+               as avg_monthly_sales,
+           rank() over (partition by i_category, i_brand, cc_name
+                        order by d_year, d_moy) as rn
+    from item, catalog_sales, date_dim, call_center
+    where cs_item_sk = i_item_sk
+        and cs_sold_date_sk = d_date_sk
+        and cc_call_center_sk = cs_call_center_sk
+        and (d_year = 1999
+          or (d_year = 1998 and d_moy = 12)
+          or (d_year = 2000 and d_moy = 1))
+    group by i_category, i_brand, cc_name, d_year, d_moy
+)
+select v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+       v1.avg_monthly_sales, v1.sum_sales,
+       v1_lag.sum_sales as psum, v1_lead.sum_sales as nsum
+from v1, v1 as v1_lag, v1 as v1_lead
+where v1.i_category = v1_lag.i_category
+    and v1.i_brand = v1_lag.i_brand
+    and v1.cc_name = v1_lag.cc_name
+    and v1.rn = v1_lag.rn + 1
+    and v1.i_category = v1_lead.i_category
+    and v1.i_brand = v1_lead.i_brand
+    and v1.cc_name = v1_lead.cc_name
+    and v1.rn = v1_lead.rn - 1
+    and v1.avg_monthly_sales > 0
+    and case when v1.avg_monthly_sales > 0
+             then abs(v1.sum_sales - v1.avg_monthly_sales)
+                  / v1.avg_monthly_sales
+             else null end > 0.1
+order by v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy
+limit 100
+""",
+    # state/county profit ROLLUP gated on a ranked-states subquery
+    70: """
+select sum(ss_net_profit) as total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) as lochierarchy,
+       rank() over (partition by grouping(s_state) + grouping(s_county),
+                    case when grouping(s_county) = 0 then s_state end
+                    order by sum(ss_net_profit) desc) as rank_within_parent
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 1185 and 1196
+    and d1.d_date_sk = ss_sold_date_sk
+    and s_store_sk = ss_store_sk
+    and s_state in (select s_state
+                    from (select s_state as s_state,
+                                 rank() over (partition by s_state
+                                              order by sum(ss_net_profit) desc)
+                                     as ranking
+                          from store_sales, store, date_dim
+                          where d_year = 2001
+                              and d_date_sk = ss_sold_date_sk
+                              and s_store_sk = ss_store_sk
+                          group by s_state) tmp1
+                    where ranking <= 5)
+group by rollup(s_state, s_county)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end,
+         rank_within_parent
+limit 100
+""",
+    # q30's catalog/state sibling: correlated 1.2x state average
+    81: """
+with customer_total_return as (
+    select cr_returning_customer_sk as ctr_customer_sk,
+           ca_state as ctr_state,
+           sum(cr_return_amount) as ctr_total_return
+    from catalog_returns, date_dim, customer_address, customer
+    where cr_returned_date_sk = d_date_sk and d_year = 2000
+        and cr_returning_customer_sk = c_customer_sk
+        and c_current_addr_sk = ca_address_sk
+    group by cr_returning_customer_sk, ca_state
+)
+select c_customer_id, c_first_name, c_last_name, ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return > (select avg(ctr_total_return) * 1.2
+                               from customer_total_return ctr2
+                               where ctr1.ctr_state = ctr2.ctr_state)
+    and ca_address_sk = c_current_addr_sk
+    and ca_state = 'GA'
+    and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_first_name, c_last_name, ctr_total_return
+limit 100
+""",
+    # per-channel return quantity shares over a common item set
+    83: """
+with sr_items as (
+    select i_item_id as item_id, sum(sr_return_quantity) as sr_item_qty
+    from store_returns, item, date_dim
+    where sr_item_sk = i_item_sk
+        and d_date between date '2000-06-01' and date '2000-08-31'
+        and sr_returned_date_sk = d_date_sk
+    group by i_item_id
+),
+cr_items as (
+    select i_item_id as item_id, sum(cr_return_quantity) as cr_item_qty
+    from catalog_returns, item, date_dim
+    where cr_item_sk = i_item_sk
+        and d_date between date '2000-06-01' and date '2000-08-31'
+        and cr_returned_date_sk = d_date_sk
+    group by i_item_id
+),
+wr_items as (
+    select i_item_id as item_id, sum(wr_return_quantity) as wr_item_qty
+    from web_returns, item, date_dim
+    where wr_item_sk = i_item_sk
+        and d_date between date '2000-06-01' and date '2000-08-31'
+        and wr_returned_date_sk = d_date_sk
+    group by i_item_id
+)
+select sr_items.item_id, sr_item_qty,
+       sr_item_qty * 1.0 / (sr_item_qty + cr_item_qty + wr_item_qty)
+           / 3.0 * 100 as sr_dev,
+       cr_item_qty,
+       cr_item_qty * 1.0 / (sr_item_qty + cr_item_qty + wr_item_qty)
+           / 3.0 * 100 as cr_dev,
+       wr_item_qty,
+       wr_item_qty * 1.0 / (sr_item_qty + cr_item_qty + wr_item_qty)
+           / 3.0 * 100 as wr_dev,
+       (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 as average
+from sr_items, cr_items, wr_items
+where sr_items.item_id = cr_items.item_id
+    and sr_items.item_id = wr_items.item_id
+order by sr_items.item_id, sr_item_qty
+limit 100
+""",
+    # profit ROLLUP with rank-within-parent (web, grouping() windows)
+    86: """
+select sum(ws_net_paid) as total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ws_net_paid) desc) as rank_within_parent
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 1185 and 1196
+    and d1.d_date_sk = ws_sold_date_sk
+    and i_item_sk = ws_item_sk
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+""",
+    # returned-for-reason tickets: net sales after returns
+    93: """
+select ss_customer_sk, sum(act_sales) as sumsales
+from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity) * ss_sales_price
+                  else ss_quantity * ss_sales_price end as act_sales
+      from store_sales
+      left outer join store_returns
+          on (sr_item_sk = ss_item_sk and sr_ticket_number = ss_ticket_number),
+          reason
+      where sr_reason_sk = r_reason_sk
+          and r_reason_desc = 'Stopped working') t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
+limit 100
+""",
     # items in a price band currently in inventory and sold by catalog
     37: """
 select i_item_id, i_item_desc, i_current_price
@@ -1458,6 +1711,30 @@ where ss_sold_date_sk = d_date_sk
     and s_state in ('TN', 'CA', 'TX')
 """
 
+_Q36_FW = """
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+    and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk
+    and s_store_sk = ss_store_sk
+    and s_state in ('TN', 'CA', 'TX', 'OH')
+"""
+
+_Q70_FW = """
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 1185 and 1196
+    and d1.d_date_sk = ss_sold_date_sk
+    and s_store_sk = ss_store_sk
+    and s_state in (select s_state from ranked)
+"""
+
+_Q86_FW = """
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 1185 and 1196
+    and d1.d_date_sk = ws_sold_date_sk
+    and i_item_sk = ws_item_sk
+"""
+
 ORACLE_OVERRIDES = {
     18: _rollup_union(
         ["i_item_id", "ca_country", "ca_state", "ca_county"],
@@ -1478,4 +1755,81 @@ ORACLE_OVERRIDES = {
         _Q27_FW,
         ["i_item_id", "s_state"],
     ),
+    # grouping()-rollup queries with rank-within-parent: sqlite lacks
+    # ROLLUP/grouping(), so the levels expand to UNION ALL with literal
+    # lochierarchy values and the window runs over the union
+    36: """
+with agg as (
+    select sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price) as gm,
+           i_category, i_class, 0 as lochierarchy """ + _Q36_FW + """
+    group by i_category, i_class
+    union all
+    select sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price),
+           i_category, null, 1 """ + _Q36_FW + """
+    group by i_category
+    union all
+    select sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price),
+           null, null, 2 """ + _Q36_FW + """
+)
+select gm as gross_margin, i_category, i_class, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then i_category end
+                    order by gm asc) as rank_within_parent
+from agg
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+""",
+    70: """
+with ranked as (
+    select s_state
+    from (select s_state as s_state,
+                 rank() over (partition by s_state
+                              order by sum(ss_net_profit) desc) as ranking
+          from store_sales, store, date_dim
+          where d_year = 2001 and d_date_sk = ss_sold_date_sk
+              and s_store_sk = ss_store_sk
+          group by s_state) tmp1
+    where ranking <= 5
+),
+agg as (
+    select sum(ss_net_profit) as ts, s_state, s_county, 0 as lochierarchy
+    """ + _Q70_FW + """ group by s_state, s_county
+    union all
+    select sum(ss_net_profit), s_state, null, 1 """ + _Q70_FW + """
+    group by s_state
+    union all
+    select sum(ss_net_profit), null, null, 2 """ + _Q70_FW + """
+)
+select ts as total_sum, s_state, s_county, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then s_state end
+                    order by ts desc) as rank_within_parent
+from agg
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end,
+         rank_within_parent
+limit 100
+""",
+    86: """
+with agg as (
+    select sum(ws_net_paid) as ts, i_category, i_class, 0 as lochierarchy
+    """ + _Q86_FW + """ group by i_category, i_class
+    union all
+    select sum(ws_net_paid), i_category, null, 1 """ + _Q86_FW + """
+    group by i_category
+    union all
+    select sum(ws_net_paid), null, null, 2 """ + _Q86_FW + """
+)
+select ts as total_sum, i_category, i_class, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then i_category end
+                    order by ts desc) as rank_within_parent
+from agg
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+""",
 }
