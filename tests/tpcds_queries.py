@@ -1,4 +1,4 @@
-"""TPC-DS benchmark corpus, engine dialect — 77 queries spanning star
+"""TPC-DS benchmark corpus, engine dialect — 79 queries spanning star
 joins, outer/full joins, window frames, ROLLUP, correlated scalar
 subqueries, EXISTS under OR (mark joins), mixed DISTINCT aggregates,
 scalar subqueries in SELECT position, and NOT EXISTS.
@@ -1648,6 +1648,101 @@ from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
           and r_reason_desc = 'Stopped working') t
 group by ss_customer_sk
 order by sumsales, ss_customer_sk
+limit 100
+""",
+    # year-over-year growth comparison: one CTE self-joined four ways
+    74: """
+with year_total as (
+    select c_customer_id as customer_id, c_first_name, c_last_name,
+           d_year as year_, sum(ss_net_paid) as year_total,
+           's' as sale_type
+    from customer, store_sales, date_dim
+    where c_customer_sk = ss_customer_sk
+        and ss_sold_date_sk = d_date_sk
+        and d_year in (1999, 2000)
+    group by c_customer_id, c_first_name, c_last_name, d_year
+    union all
+    select c_customer_id, c_first_name, c_last_name,
+           d_year, sum(ws_net_paid), 'w'
+    from customer, web_sales, date_dim
+    where c_customer_sk = ws_bill_customer_sk
+        and ws_sold_date_sk = d_date_sk
+        and d_year in (1999, 2000)
+    group by c_customer_id, c_first_name, c_last_name, d_year
+)
+select t_s_secyear.customer_id, t_s_secyear.c_first_name, t_s_secyear.c_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+    and t_s_firstyear.customer_id = t_w_secyear.customer_id
+    and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+    and t_s_firstyear.sale_type = 's' and t_w_firstyear.sale_type = 'w'
+    and t_s_secyear.sale_type = 's' and t_w_secyear.sale_type = 'w'
+    and t_s_firstyear.year_ = 1999 and t_s_secyear.year_ = 2000
+    and t_w_firstyear.year_ = 1999 and t_w_secyear.year_ = 2000
+    and t_s_firstyear.year_total > 0 and t_w_firstyear.year_total > 0
+    and case when t_w_firstyear.year_total > 0
+             then t_w_secyear.year_total * 1.0 / t_w_firstyear.year_total
+             else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total * 1.0 / t_s_firstyear.year_total
+             else null end
+order by 1, 2, 3
+limit 100
+""",
+    # worst return ratios per channel, double-ranked, unioned
+    49: """
+select channel, item, return_ratio, return_rank, currency_rank
+from (
+    select 'web' as channel, web.item, web.return_ratio,
+           web.return_rank, web.currency_rank
+    from (select item, return_ratio, currency_ratio,
+                 rank() over (order by return_ratio) as return_rank,
+                 rank() over (order by currency_ratio) as currency_rank
+          from (select ws.ws_item_sk as item,
+                       sum(coalesce(wr.wr_return_quantity, 0)) * 1.0
+                         / sum(coalesce(ws.ws_quantity, 0)) as return_ratio,
+                       sum(coalesce(wr.wr_return_amt, 0)) * 1.0
+                         / sum(coalesce(ws.ws_net_paid, 0)) as currency_ratio
+                from web_sales ws
+                     left outer join web_returns wr
+                         on (ws.ws_order_number = wr.wr_order_number
+                             and ws.ws_item_sk = wr.wr_item_sk),
+                     date_dim
+                where wr.wr_return_amt > 100
+                    and ws.ws_net_profit > 1
+                    and ws.ws_net_paid > 0
+                    and ws.ws_quantity > 0
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy = 12
+                group by ws.ws_item_sk) in_web) web
+    where web.return_rank <= 10 or web.currency_rank <= 10
+    union
+    select 'catalog' as channel, c.item, c.return_ratio,
+           c.return_rank, c.currency_rank
+    from (select item, return_ratio, currency_ratio,
+                 rank() over (order by return_ratio) as return_rank,
+                 rank() over (order by currency_ratio) as currency_rank
+          from (select cs.cs_item_sk as item,
+                       sum(coalesce(cr.cr_return_quantity, 0)) * 1.0
+                         / sum(coalesce(cs.cs_quantity, 0)) as return_ratio,
+                       sum(coalesce(cr.cr_return_amount, 0)) * 1.0
+                         / sum(coalesce(cs.cs_net_paid, 0)) as currency_ratio
+                from catalog_sales cs
+                     left outer join catalog_returns cr
+                         on (cs.cs_order_number = cr.cr_order_number
+                             and cs.cs_item_sk = cr.cr_item_sk),
+                     date_dim
+                where cr.cr_return_amount > 100
+                    and cs.cs_net_profit > 1
+                    and cs.cs_net_paid > 0
+                    and cs.cs_quantity > 0
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy = 12
+                group by cs.cs_item_sk) in_cat) c
+    where c.return_rank <= 10 or c.currency_rank <= 10
+) tmp
+order by 1, 4, 5, 2
 limit 100
 """,
     # items in a price band currently in inventory and sold by catalog
