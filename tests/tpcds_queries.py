@@ -1,4 +1,4 @@
-"""TPC-DS benchmark corpus, engine dialect — 79 queries spanning star
+"""TPC-DS benchmark corpus, engine dialect — 81 queries spanning star
 joins, outer/full joins, window frames, ROLLUP, correlated scalar
 subqueries, EXISTS under OR (mark joins), mixed DISTINCT aggregates,
 scalar subqueries in SELECT position, and NOT EXISTS.
@@ -1743,6 +1743,101 @@ from (
     where c.return_rank <= 10 or c.currency_rank <= 10
 ) tmp
 order by 1, 4, 5, 2
+limit 100
+""",
+    # flagship year-over-year: three channels, one CTE self-joined 6 ways
+    4: """
+with year_total as (
+    select c_customer_id as customer_id, c_first_name, c_last_name,
+           d_year as dyear,
+           sum(((ss_ext_list_price - ss_ext_wholesale_cost
+                 - ss_ext_discount_amt) + ss_ext_sales_price) / 2) as year_total,
+           's' as sale_type
+    from customer, store_sales, date_dim
+    where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+    group by c_customer_id, c_first_name, c_last_name, d_year
+    union all
+    select c_customer_id, c_first_name, c_last_name, d_year,
+           sum(((cs_ext_list_price - cs_ext_wholesale_cost
+                 - cs_ext_discount_amt) + cs_ext_sales_price) / 2), 'c'
+    from customer, catalog_sales, date_dim
+    where c_customer_sk = cs_bill_customer_sk and cs_sold_date_sk = d_date_sk
+    group by c_customer_id, c_first_name, c_last_name, d_year
+    union all
+    select c_customer_id, c_first_name, c_last_name, d_year,
+           sum(((ws_ext_list_price - ws_ext_wholesale_cost
+                 - ws_ext_discount_amt) + ws_ext_sales_price) / 2), 'w'
+    from customer, web_sales, date_dim
+    where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+    group by c_customer_id, c_first_name, c_last_name, d_year
+)
+select t_s_secyear.customer_id, t_s_secyear.c_first_name, t_s_secyear.c_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+    and t_s_firstyear.customer_id = t_c_secyear.customer_id
+    and t_s_firstyear.customer_id = t_c_firstyear.customer_id
+    and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+    and t_s_firstyear.customer_id = t_w_secyear.customer_id
+    and t_s_firstyear.sale_type = 's' and t_c_firstyear.sale_type = 'c'
+    and t_w_firstyear.sale_type = 'w' and t_s_secyear.sale_type = 's'
+    and t_c_secyear.sale_type = 'c' and t_w_secyear.sale_type = 'w'
+    and t_s_firstyear.dyear = 2001 and t_s_secyear.dyear = 2002
+    and t_c_firstyear.dyear = 2001 and t_c_secyear.dyear = 2002
+    and t_w_firstyear.dyear = 2001 and t_w_secyear.dyear = 2002
+    and t_s_firstyear.year_total > 0 and t_c_firstyear.year_total > 0
+    and t_w_firstyear.year_total > 0
+    and case when t_c_firstyear.year_total > 0
+             then t_c_secyear.year_total * 1.0 / t_c_firstyear.year_total
+             else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total * 1.0 / t_s_firstyear.year_total
+             else null end
+    and case when t_c_firstyear.year_total > 0
+             then t_c_secyear.year_total * 1.0 / t_c_firstyear.year_total
+             else null end
+      > case when t_w_firstyear.year_total > 0
+             then t_w_secyear.year_total * 1.0 / t_w_firstyear.year_total
+             else null end
+order by 1, 2, 3
+limit 100
+""",
+    # q4's store/web sibling on list-minus-discount totals
+    11: """
+with year_total as (
+    select c_customer_id as customer_id, c_first_name, c_last_name,
+           d_year as dyear,
+           sum(ss_ext_list_price - ss_ext_discount_amt) as year_total,
+           's' as sale_type
+    from customer, store_sales, date_dim
+    where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+    group by c_customer_id, c_first_name, c_last_name, d_year
+    union all
+    select c_customer_id, c_first_name, c_last_name, d_year,
+           sum(ws_ext_list_price - ws_ext_discount_amt), 'w'
+    from customer, web_sales, date_dim
+    where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+    group by c_customer_id, c_first_name, c_last_name, d_year
+)
+select t_s_secyear.customer_id, t_s_secyear.c_first_name, t_s_secyear.c_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+    and t_s_firstyear.customer_id = t_w_secyear.customer_id
+    and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+    and t_s_firstyear.sale_type = 's' and t_w_firstyear.sale_type = 'w'
+    and t_s_secyear.sale_type = 's' and t_w_secyear.sale_type = 'w'
+    and t_s_firstyear.dyear = 2001 and t_s_secyear.dyear = 2002
+    and t_w_firstyear.dyear = 2001 and t_w_secyear.dyear = 2002
+    and t_s_firstyear.year_total > 0 and t_w_firstyear.year_total > 0
+    and case when t_w_firstyear.year_total > 0
+             then t_w_secyear.year_total * 1.0 / t_w_firstyear.year_total
+             else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total * 1.0 / t_s_firstyear.year_total
+             else null end
+order by 1, 2, 3
 limit 100
 """,
     # items in a price band currently in inventory and sold by catalog
