@@ -1,4 +1,4 @@
-"""TPC-DS benchmark corpus, engine dialect — 82 queries spanning star
+"""TPC-DS benchmark corpus, engine dialect — 81 queries spanning star
 joins, outer/full joins, window frames, ROLLUP, correlated scalar
 subqueries, EXISTS under OR (mark joins), mixed DISTINCT aggregates,
 scalar subqueries in SELECT position, and NOT EXISTS.
@@ -437,20 +437,6 @@ from (select count(*) as h8 from store_sales, household_demographics, time_dim, 
       where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
           and ss_store_sk = s_store_sk and t_hour = 11
           and hd_dep_count = 2 and s_store_name = 'ese') s4
-""",
-    # LEFT OUTER to returns with reason filter + actual-sale computation
-    93: """
-select ss_customer_sk, sum(act_sales) as sumsales
-from (select ss_customer_sk,
-             case when sr_return_quantity is not null
-                  then (ss_quantity - sr_return_quantity) * ss_sales_price
-                  else ss_quantity * ss_sales_price end as act_sales
-      from store_sales left outer join store_returns
-           on sr_item_sk = ss_item_sk and sr_ticket_number = ss_ticket_number,
-           reason
-      where sr_reason_sk = r_reason_sk
-          and r_reason_desc = 'Wrong size') t
-group by ss_customer_sk
 """,
     # NOT EXISTS anti-join on returns
     94: """
@@ -1840,83 +1826,6 @@ where t_s_secyear.customer_id = t_s_firstyear.customer_id
 order by 1, 2, 3
 limit 100
 """,
-    # per-channel sales/returns/profit report with channel ROLLUP
-    77: """
-with ss as (
-    select s_store_sk, sum(ss_ext_sales_price) as sales,
-           sum(ss_net_profit) as profit
-    from store_sales, date_dim, store
-    where ss_sold_date_sk = d_date_sk
-        and d_date between date '2000-08-03' and date '2000-09-02'
-        and ss_store_sk = s_store_sk
-    group by s_store_sk
-),
-sr as (
-    select s_store_sk, sum(sr_return_amt) as returns_,
-           sum(sr_net_loss) as profit_loss
-    from store_returns, date_dim, store
-    where sr_returned_date_sk = d_date_sk
-        and d_date between date '2000-08-03' and date '2000-09-02'
-        and sr_store_sk = s_store_sk
-    group by s_store_sk
-),
-cs as (
-    select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
-           sum(cs_net_profit) as profit
-    from catalog_sales, date_dim
-    where cs_sold_date_sk = d_date_sk
-        and d_date between date '2000-08-03' and date '2000-09-02'
-    group by cs_call_center_sk
-),
-cr as (
-    select cr_call_center_sk, sum(cr_return_amount) as returns_,
-           sum(cr_net_loss) as profit_loss
-    from catalog_returns, date_dim
-    where cr_returned_date_sk = d_date_sk
-        and d_date between date '2000-08-03' and date '2000-09-02'
-    group by cr_call_center_sk
-),
-ws as (
-    select wp_web_page_sk, sum(ws_ext_sales_price) as sales,
-           sum(ws_net_profit) as profit
-    from web_sales, date_dim, web_page
-    where ws_sold_date_sk = d_date_sk
-        and d_date between date '2000-08-03' and date '2000-09-02'
-        and ws_web_page_sk = wp_web_page_sk
-    group by wp_web_page_sk
-),
-wr as (
-    select wp_web_page_sk, sum(wr_return_amt) as returns_,
-           sum(wr_net_loss) as profit_loss
-    from web_returns, date_dim, web_page, web_sales
-    where wr_returned_date_sk = d_date_sk
-        and d_date between date '2000-08-03' and date '2000-09-02'
-        and wr_order_number = ws_order_number and wr_item_sk = ws_item_sk
-        and ws_web_page_sk = wp_web_page_sk
-    group by wp_web_page_sk
-)
-select channel, id, sum(sales) as sales, sum(returns_) as returns_,
-       sum(profit) as profit
-from (
-    select 'store channel' as channel, ss.s_store_sk as id, sales,
-           coalesce(returns_, 0) as returns_,
-           (profit - coalesce(profit_loss, 0)) as profit
-    from ss left join sr on ss.s_store_sk = sr.s_store_sk
-    union all
-    select 'catalog channel', cs.cs_call_center_sk, sales,
-           coalesce(returns_, 0),
-           (profit - coalesce(profit_loss, 0))
-    from cs left join cr on cs.cs_call_center_sk = cr.cr_call_center_sk
-    union all
-    select 'web channel', ws.wp_web_page_sk, sales,
-           coalesce(returns_, 0),
-           (profit - coalesce(profit_loss, 0))
-    from ws left join wr on ws.wp_web_page_sk = wr.wp_web_page_sk
-) x
-group by rollup(channel, id)
-order by channel, id, sales
-limit 100
-""",
     # items in a price band currently in inventory and sold by catalog
     37: """
 select i_item_id, i_item_desc, i_current_price
@@ -1978,6 +1887,90 @@ where ss_sold_date_sk = d_date_sk
     and s_state in ('TN', 'CA', 'TX')
 """
 
+_Q77_CTES = """
+with ss as (
+    select s_store_sk, sum(ss_ext_sales_price) as sales,
+           sum(ss_net_profit) as profit
+    from store_sales, date_dim, store
+    where ss_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-03' and date '2000-09-02'
+        and ss_store_sk = s_store_sk
+    group by s_store_sk
+),
+sr as (
+    select s_store_sk, sum(sr_return_amt) as returns_,
+           sum(sr_net_loss) as profit_loss
+    from store_returns, date_dim, store
+    where sr_returned_date_sk = d_date_sk
+        and d_date between date '2000-08-03' and date '2000-09-02'
+        and sr_store_sk = s_store_sk
+    group by s_store_sk
+),
+cs as (
+    select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
+           sum(cs_net_profit) as profit
+    from catalog_sales, date_dim
+    where cs_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-03' and date '2000-09-02'
+    group by cs_call_center_sk
+),
+cr as (
+    select cr_call_center_sk, sum(cr_return_amount) as returns_,
+           sum(cr_net_loss) as profit_loss
+    from catalog_returns, date_dim
+    where cr_returned_date_sk = d_date_sk
+        and d_date between date '2000-08-03' and date '2000-09-02'
+    group by cr_call_center_sk
+),
+ws as (
+    select wp_web_page_sk, sum(ws_ext_sales_price) as sales,
+           sum(ws_net_profit) as profit
+    from web_sales, date_dim, web_page
+    where ws_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-03' and date '2000-09-02'
+        and ws_web_page_sk = wp_web_page_sk
+    group by wp_web_page_sk
+),
+wr as (
+    select wp_web_page_sk, sum(wr_return_amt) as returns_,
+           sum(wr_net_loss) as profit_loss
+    from web_returns, date_dim, web_page, web_sales
+    where wr_returned_date_sk = d_date_sk
+        and d_date between date '2000-08-03' and date '2000-09-02'
+        and wr_order_number = ws_order_number and wr_item_sk = ws_item_sk
+        and ws_web_page_sk = wp_web_page_sk
+    group by wp_web_page_sk
+),
+x as (
+    select 'store channel' as channel, ss.s_store_sk as id, sales,
+           coalesce(returns_, 0) as returns_,
+           (profit - coalesce(profit_loss, 0)) as profit
+    from ss left join sr on ss.s_store_sk = sr.s_store_sk
+    union all
+    select 'catalog channel', cs.cs_call_center_sk, sales,
+           coalesce(returns_, 0),
+           (profit - coalesce(profit_loss, 0))
+    from cs left join cr on cs.cs_call_center_sk = cr.cr_call_center_sk
+    union all
+    select 'web channel', ws.wp_web_page_sk, sales,
+           coalesce(returns_, 0),
+           (profit - coalesce(profit_loss, 0))
+    from ws left join wr on ws.wp_web_page_sk = wr.wp_web_page_sk
+)
+"""
+
+# per-channel sales/returns/profit report with channel ROLLUP — built
+# from the same CTE fragment the sqlite override uses, so the two sides
+# cannot drift
+QUERIES[77] = _Q77_CTES + """
+select channel, id, sum(sales) as sales, sum(returns_) as returns_,
+       sum(profit) as profit
+from x
+group by rollup(channel, id)
+order by channel, id, sales
+limit 100
+"""
+
 _Q36_FW = """
 from store_sales, date_dim d1, item, store
 where d1.d_year = 2001
@@ -2003,6 +1996,19 @@ where d1.d_month_seq between 1185 and 1196
 """
 
 ORACLE_OVERRIDES = {
+    77: _Q77_CTES + """,
+sel as (select channel, id, sum(sales) as sales,
+        sum(returns_) as returns_, sum(profit) as profit
+        from x group by channel, id)
+select channel, id, sales, returns_, profit from sel
+union all
+select channel, null, sum(sales), sum(returns_), sum(profit)
+from sel group by channel
+union all
+select null, null, sum(sales), sum(returns_), sum(profit) from sel
+order by channel, id, sales
+limit 100
+""",
     77: "\nwith ss as (\n    select s_store_sk, sum(ss_ext_sales_price) as sales,\n           sum(ss_net_profit) as profit\n    from store_sales, date_dim, store\n    where ss_sold_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and ss_store_sk = s_store_sk\n    group by s_store_sk\n),\nsr as (\n    select s_store_sk, sum(sr_return_amt) as returns_,\n           sum(sr_net_loss) as profit_loss\n    from store_returns, date_dim, store\n    where sr_returned_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and sr_store_sk = s_store_sk\n    group by s_store_sk\n),\ncs as (\n    select cs_call_center_sk, sum(cs_ext_sales_price) as sales,\n           sum(cs_net_profit) as profit\n    from catalog_sales, date_dim\n    where cs_sold_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n    group by cs_call_center_sk\n),\ncr as (\n    select cr_call_center_sk, sum(cr_return_amount) as returns_,\n           sum(cr_net_loss) as profit_loss\n    from catalog_returns, date_dim\n    where cr_returned_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n    group by cr_call_center_sk\n),\nws as (\n    select wp_web_page_sk, sum(ws_ext_sales_price) as sales,\n           sum(ws_net_profit) as profit\n    from web_sales, date_dim, web_page\n    where ws_sold_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and ws_web_page_sk = wp_web_page_sk\n    group by wp_web_page_sk\n),\nwr as (\n    select wp_web_page_sk, sum(wr_return_amt) as returns_,\n           sum(wr_net_loss) as profit_loss\n    from web_returns, date_dim, web_page, web_sales\n    where wr_returned_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and wr_order_number = ws_order_number and wr_item_sk = ws_item_sk\n        and ws_web_page_sk = wp_web_page_sk\n    group by wp_web_page_sk\n),\nx as (\n    select 'store channel' as channel, ss.s_store_sk as id, sales,\n           coalesce(returns_, 0) as returns_,\n           (profit - coalesce(profit_loss, 0)) as profit\n    from ss left join sr on ss.s_store_sk = sr.s_store_sk\n    union all\n    select 'catalog channel', cs.cs_call_center_sk, sales,\n           coalesce(returns_, 0),\n           (profit - coalesce(profit_loss, 0))\n    from cs left join cr on cs.cs_call_center_sk = cr.cr_call_center_sk\n    union all\n    select 'web channel', ws.wp_web_page_sk, sales,\n           coalesce(returns_, 0),\n           (profit - coalesce(profit_loss, 0))\n    from ws left join wr on ws.wp_web_page_sk = wr.wp_web_page_sk\n),\nsel as (select channel, id, sum(sales) as sales,\n        sum(returns_) as returns_, sum(profit) as profit\n        from x group by channel, id)\nselect channel, id, sales, returns_, profit from sel\nunion all\nselect channel, null, sum(sales), sum(returns_), sum(profit)\nfrom sel group by channel\nunion all\nselect null, null, sum(sales), sum(returns_), sum(profit) from sel\norder by channel, id, sales\nlimit 100\n",
     18: _rollup_union(
         ["i_item_id", "ca_country", "ca_state", "ca_county"],
