@@ -1,0 +1,137 @@
+"""Device A/B of the direct-address CSR join table on TPC-H Q3.
+
+The direct table (ops/join.py DIRECT_DOMAIN_* path) is gated
+accelerator-only because it loses on XLA:CPU; this script produces the
+on-device evidence for that gate: it times Q3 with the table forced off
+(PRESTO_TPU_DIRECT_JOIN=0, binary-search probes) and forced on (=1,
+O(1) CSR gathers) in two child processes, verifies the row results
+match, and writes TPU_AB.json next to TPU_MEASURED.json.
+
+Run by tools/tpu_watch.sh when the tunnel recovers; safe to run by hand.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(HERE, "TPU_AB.json")
+
+
+def _child(direct: str) -> dict:
+    import presto_tpu  # noqa: F401
+    import jax
+
+    cache_dir = os.path.join(HERE, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.runner import QueryRunner
+    from tests.tpch_queries import QUERIES
+
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    platform = jax.devices()[0].platform
+    tpch = Tpch(sf=sf, split_rows=1 << 23)
+    mem = MemoryConnector()
+    mem.load_from(tpch, "lineitem", columns=[
+        "l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"])
+    mem.load_from(tpch, "orders", columns=[
+        "o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"])
+    mem.load_from(tpch, "customer", columns=["c_custkey", "c_mktsegment"])
+    catalog = Catalog()
+    catalog.register("mem", mem)
+    runner = QueryRunner(catalog)
+    rows = mem.row_count("lineitem")
+
+    sql = QUERIES[3]
+    res = runner.execute(sql)  # warmup (compile)
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        res = runner.execute(sql)
+        times.append(time.time() - t0)
+    best = min(times)
+    return {
+        "platform": platform,
+        "direct": direct,
+        "seconds": round(best, 4),
+        "rows_per_sec": round(rows / best, 1),
+        "result_rows": [[str(c) for c in r] for r in res],
+    }
+
+
+def _rows_match(a, b, rel=1e-9) -> bool:
+    """All rows, numeric columns compared with relative tolerance: the
+    two join paths may feed the float revenue sum in different orders,
+    so last-ulp drift must not read as a correctness mismatch."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for ca, cb in zip(ra, rb):
+            if ca == cb:
+                continue
+            try:
+                fa, fb = float(ca), float(cb)
+            except ValueError:
+                return False
+            if abs(fa - fb) > rel * max(1.0, abs(fa), abs(fb)):
+                return False
+    return True
+
+
+def main() -> int:
+    if os.environ.get("AB_MODE") == "child":
+        print("AB_RESULT:" + json.dumps(_child(
+            os.environ["PRESTO_TPU_DIRECT_JOIN"])), flush=True)
+        return 0
+
+    results = {}
+    for direct in ("0", "1"):
+        env = dict(os.environ)
+        env.update({"AB_MODE": "child", "PRESTO_TPU_DIRECT_JOIN": direct})
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                cwd=HERE, timeout=float(os.environ.get("AB_TIMEOUT", "1800")),
+                stdout=subprocess.PIPE, stderr=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"direct={direct}: child timed out", file=sys.stderr)
+            continue
+        for line in proc.stdout.decode().splitlines():
+            if line.startswith("AB_RESULT:"):
+                results[direct] = json.loads(line[len("AB_RESULT:"):])
+
+    out = {"query": "q3", "sf": float(os.environ.get("BENCH_SF", "1.0")),
+           "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    try:
+        out["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=HERE,
+            stdout=subprocess.PIPE).stdout.decode().strip()
+    except Exception:
+        pass
+    if "0" in results and "1" in results:
+        out["off"] = results["0"]
+        out["on"] = results["1"]
+        out["results_match"] = _rows_match(
+            results["0"].pop("result_rows", []),
+            results["1"].pop("result_rows", []))
+        out["speedup_direct_on_vs_off"] = round(
+            results["1"]["rows_per_sec"] / results["0"]["rows_per_sec"], 3)
+    else:
+        out["partial"] = {k: v for k, v in results.items()}
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps(out))
+    return 0 if len(results) == 2 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,14 +1,98 @@
 #!/bin/bash
-# Poll the TPU tunnel; when it answers, run the SF1 benchmark once
-# (persisting rates to TPU_MEASURED.json) and exit.
-cd /root/repo
-for i in $(seq 1 200); do
-  if timeout 60 python -c "import jax,jax.numpy as jnp; print(float(jnp.arange(8).sum()))" >/dev/null 2>&1; then
-    echo "$(date) tunnel up, running bench" >> bench_tpu.log
-    BENCH_SF=${BENCH_SF:-1.0} BENCH_ITERS=3 BENCH_DEADLINE=3000 timeout 3300 python bench.py >> bench_tpu.log 2>&1
-    echo "$(date) bench done rc=$?" >> bench_tpu.log
-    exit 0
+# Supervise the TPU tunnel for the whole round.  Poll every 2 minutes;
+# on recovery, snapshot the last COMMIT (not the mid-edit working tree)
+# into .tpu_snap and run, in order:
+#   1. SF1 bench            -> TPU_MEASURED.json (sf1)
+#   2. direct-join A/B (Q3) -> TPU_AB.json
+#   3. SF10 bench           -> TPU_MEASURED.json (sf10)
+# The tunnel is re-probed before each step (a mid-sequence death must
+# not burn hours of timeouts), and artifacts are copied back to the
+# repo root after each step, so a tunnel that dies mid-sequence still
+# leaves whatever it finished.  A sequence counts as a capture only if
+# TPU_MEASURED.json actually CHANGED (stale carry-forward is not
+# success).  After a successful capture the watcher keeps polling and
+# re-runs if HEAD has advanced >= 20 commits since.  Log: bench_tpu.log.
+cd /root/repo || exit 1
+LOG=bench_tpu.log
+SNAP=.tpu_snap
+ROUNDS=${ROUNDS:-400}
+last_capture_commit=""
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+probe() {
+  timeout 60 python -c "import jax,jax.numpy as jnp; assert jax.default_backend()!='cpu'; print(float(jnp.arange(8).sum()))" >/dev/null 2>&1
+}
+
+tpu_sum() { sha256sum TPU_MEASURED.json 2>/dev/null | cut -d' ' -f1; }
+
+snapshot() {
+  rm -rf "$SNAP"
+  mkdir -p "$SNAP"
+  git archive HEAD | tar -x -C "$SNAP" || return 1
+  mkdir -p .jax_cache
+  ln -sfn "$(pwd)/.jax_cache" "$SNAP/.jax_cache"
+  # carry forward accumulated measurements so per-sf entries merge;
+  # BASELINE_MEASURED.json comes from git archive (it is committed)
+  [ -f TPU_MEASURED.json ] && cp TPU_MEASURED.json "$SNAP/"
+  return 0
+}
+
+copy_back() {
+  for f in TPU_MEASURED.json TPU_AB.json; do
+    [ -f "$SNAP/$f" ] && cp "$SNAP/$f" .
+  done
+  return 0
+}
+
+run_sequence() {
+  snapshot || { log "snapshot failed"; return 1; }
+  local before
+  before=$(tpu_sum)
+  log "recovery: running SF1 bench"
+  (cd "$SNAP" && BENCH_SF=1.0 BENCH_ITERS=3 BENCH_DEADLINE=2700 \
+    timeout 3000 python bench.py >> "../$LOG" 2>&1)
+  log "SF1 bench rc=$?"; copy_back
+  if probe; then
+    log "running direct-join A/B"
+    (cd "$SNAP" && BENCH_SF=1.0 AB_TIMEOUT=1500 \
+      timeout 3200 python tools/tpu_ab_direct_join.py >> "../$LOG" 2>&1)
+    log "A/B rc=$?"; copy_back
+  else
+    log "tunnel died before A/B; skipping rest of sequence"
   fi
-  sleep 120
+  if probe; then
+    log "running SF10 bench"
+    (cd "$SNAP" && BENCH_SF=10 BENCH_ITERS=2 BENCH_DEADLINE=5000 \
+      timeout 5400 python bench.py >> "../$LOG" 2>&1)
+    log "SF10 bench rc=$?"; copy_back
+  else
+    log "tunnel died before SF10; skipping"
+  fi
+  if [ -f TPU_MEASURED.json ] && [ "$(tpu_sum)" != "$before" ]; then
+    last_capture_commit=$(git rev-parse HEAD)
+    log "capture complete at $last_capture_commit"
+    return 0
+  fi
+  log "sequence produced no new measurement"
+  return 1
+}
+
+log "watcher started (pid $$)"
+for i in $(seq 1 "$ROUNDS"); do
+  if probe; then
+    if [ -z "$last_capture_commit" ]; then
+      run_sequence
+    else
+      ahead=$(git rev-list --count "$last_capture_commit"..HEAD 2>/dev/null || echo 0)
+      if [ "$ahead" -ge 20 ]; then
+        log "HEAD moved $ahead commits since capture; re-running"
+        run_sequence
+      fi
+    fi
+    sleep 600
+  else
+    sleep 120
+  fi
 done
-echo "$(date) gave up waiting for tunnel" >> bench_tpu.log
+log "watcher done after $ROUNDS polls"
