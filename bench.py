@@ -285,11 +285,11 @@ def _load_baselines() -> dict:
     try:
         with open(BASELINE_FILE) as f:
             data = json.load(f)
+        if "rates" in data:  # legacy single-entry layout
+            data = {"sf%g" % data["sf"]: data}
     except Exception as e:
         log(f"baseline cache unreadable: {e}")
         return {}
-    if "rates" in data:  # legacy single-entry layout
-        data = {"sf%g" % data["sf"]: data}
     return data
 
 

@@ -36,6 +36,11 @@ class QueryCompletedEvent:
     rows: int = 0
     error: Optional[str] = None
     trace_token: Optional[str] = None
+    # distributed-tier outcome (VERDICT r3: fallbacks must be loud):
+    # mesh stages executed, and the reason when execution fell back to
+    # the coordinator despite SET SESSION distributed = true
+    dist_stages: Optional[int] = None
+    dist_fallback: Optional[str] = None
 
 
 def new_trace_token() -> str:

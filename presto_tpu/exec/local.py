@@ -364,8 +364,18 @@ class LocalRunner:
             if member and not parent_in_chain:
                 try:
                     self._time_chain(n, out)
-                except Exception:
-                    pass  # attribution is best-effort diagnostics
+                except Exception as e:
+                    # attribution is best-effort diagnostics, but a
+                    # failure must not be invisible (VERDICT r3): the
+                    # operator reading VERBOSE output needs to know the
+                    # numbers are missing rather than zero
+                    import logging
+
+                    logging.getLogger("presto_tpu.explain").warning(
+                        "EXPLAIN ANALYZE VERBOSE attribution failed for "
+                        "%s chain: %s: %s", type(n).__name__,
+                        type(e).__name__, e)
+                    out.setdefault(n, float("nan"))
             if isinstance(n, (JoinNode, CrossSingleNode)):
                 walk(n.sources[0], member)  # probe side continues chain
                 walk(n.sources[1], False)  # build side is its own tree

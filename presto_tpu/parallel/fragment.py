@@ -26,6 +26,7 @@ from presto_tpu.planner.plan import (
     AggregationNode,
     CrossSingleNode,
     FilterNode,
+    GroupIdNode,
     JoinNode,
     LimitNode,
     OutputNode,
@@ -36,6 +37,7 @@ from presto_tpu.planner.plan import (
     TableScanNode,
     TopNNode,
     UnionNode,
+    UnnestNode,
     ValuesNode,
     WindowNode,
 )
@@ -220,52 +222,285 @@ def decide_join_distribution(
     return "partitioned", est
 
 
+# ----------------------------------------------------------------------
+# Generalized stage decomposition (PlanFragmenter.java:84 analog).
+#
+# A plan of ANY shape lowers into a DAG of mesh stages: each stage is a
+# streaming chain (filter/project/partial-agg/join probes over a scan or
+# a materialized intermediate) optionally rooted by a single-step
+# aggregation.  Stage results materialize as PrecomputedNode pages that
+# feed consuming stages — the role SubPlan/RemoteSourceNode boundaries
+# play in the reference.  Glue breakers (sort, window, union, limit,
+# unnest) between stages evaluate on the coordinator, mirroring the
+# reference's SINGLE-distribution fragments.  The same traversal drives
+# execution (parallel/dist.py) and EXPLAIN (TYPE DISTRIBUTED), so what
+# EXPLAIN prints is what execution does.
+# ----------------------------------------------------------------------
+
+#: breakers the coordinator evaluates between mesh stages once their
+#: subtree is fully materialized (SqlQueryScheduler's SINGLE fragments)
+GLUE_BREAKERS = (SortNode, TopNNode, LimitNode, WindowNode, UnionNode,
+                 UnnestNode)
+
+
+def chain_distributable(node: PlanNode) -> Optional[str]:
+    """None when ``node``'s subtree is a streaming chain the mesh tier
+    compiles into one SPMD wave program; otherwise the human-readable
+    reason it is not (surfaced by EXPLAIN and the fallback event)."""
+    if isinstance(node, (FilterNode, ProjectNode)):
+        return chain_distributable(node.source)
+    if isinstance(node, AggregationNode) and node.step == "partial":
+        return chain_distributable(node.source)
+    if isinstance(node, CrossSingleNode):
+        return chain_distributable(node.left)
+    if isinstance(node, JoinNode):
+        if node.kind == "full":
+            return "full outer join needs cross-device unmatched-build state"
+        if node.use_index:
+            return "index join point-lookups do not wave-scan"
+        return chain_distributable(node.left)
+    if isinstance(node, TableScanNode):
+        return None
+    if isinstance(node, PrecomputedNode):
+        return None
+    return f"{type(node).__name__.replace('Node', '')} breaks the streaming chain"
+
+
+#: a stage whose leaf is a materialized intermediate below this many
+#: rows runs on the coordinator instead — scattering a small page over
+#: the mesh is pure dispatch overhead (session property
+#: distributed_min_stage_rows; 0 forces every stage onto the mesh,
+#: which the dryrun/tests use to exercise multi-stage plans)
+DEFAULT_MIN_STAGE_ROWS = 1 << 13
+
+
+def chain_leaf_node(node: PlanNode) -> PlanNode:
+    """The probe-spine leaf of a streaming chain (scan or materialized
+    intermediate)."""
+    while True:
+        if isinstance(node, (FilterNode, ProjectNode)):
+            node = node.source
+        elif isinstance(node, AggregationNode) and node.step == "partial":
+            node = node.source
+        elif isinstance(node, (JoinNode, CrossSingleNode)):
+            node = node.left
+        else:
+            return node
+
+
+def _leaf_big_enough(node: PlanNode, min_rows: int) -> bool:
+    if min_rows <= 0:
+        return True
+    leaf = chain_leaf_node(node)
+    if not isinstance(leaf, PrecomputedNode):
+        return True  # scans always distribute
+    if leaf.page is not None:
+        # LIVE rows, not padded capacity: per-device merge pages are
+        # allocated at group capacity regardless of actual groups
+        import numpy as np
+
+        return int(np.asarray(leaf.page.num_rows())) >= min_rows
+    est = getattr(leaf, "_est_rows", None)
+    if est is not None:
+        return est >= min_rows  # EXPLAIN simulation: planner estimate
+    return True
+
+
+def is_agg_stage(node: PlanNode,
+                 min_precomputed_rows: int = DEFAULT_MIN_STAGE_ROWS) -> bool:
+    """Root of a scan->chain->partial-agg->exchange->final-merge mesh
+    stage (the reference's FIXED_HASH aggregation fragment pair)."""
+    return (isinstance(node, AggregationNode) and node.step == "single"
+            and chain_distributable(node.source) is None
+            and _leaf_big_enough(node.source, min_precomputed_rows))
+
+
+def is_chain_stage(node: PlanNode,
+                   min_precomputed_rows: int = DEFAULT_MIN_STAGE_ROWS) -> bool:
+    """Root of a pure streaming-chain mesh stage (a SOURCE fragment
+    whose consumer is the coordinator or a glue breaker).  A bare
+    materialized page or literal is not a stage — re-scattering it
+    would be a round trip with no work."""
+    if isinstance(node, (PrecomputedNode, ValuesNode)):
+        return False
+    return (chain_distributable(node) is None
+            and _leaf_big_enough(node, min_precomputed_rows))
+
+
+def child_slots(node: PlanNode):
+    """(slot, child) edges of the node kinds the decomposition recurses
+    through.  Unknown node kinds yield nothing — their subtree stays on
+    the coordinator."""
+    if isinstance(node, (JoinNode, CrossSingleNode)):
+        return [("left", node.left), ("right", node.right)]
+    if isinstance(node, UnionNode):
+        return [(("inputs", i), s) for i, s in enumerate(node.inputs)]
+    if isinstance(node, (FilterNode, ProjectNode, AggregationNode, SortNode,
+                         TopNNode, LimitNode, WindowNode, OutputNode,
+                         GroupIdNode, UnnestNode)):
+        return [("source", node.source)]
+    return []
+
+
+def get_child(node: PlanNode, slot):
+    if isinstance(slot, tuple):
+        return getattr(node, slot[0])[slot[1]]
+    return getattr(node, slot)
+
+
+def set_child(node: PlanNode, slot, child: PlanNode) -> None:
+    if isinstance(slot, tuple):
+        getattr(node, slot[0])[slot[1]] = child
+        if isinstance(node, UnionNode):
+            # merged dictionaries/offsets were computed from the old arms
+            node._channels = None
+            node._offsets = None
+    else:
+        setattr(node, slot, child)
+
+
+def fully_materialized(node: PlanNode) -> bool:
+    """Every leaf below ``node`` is an already-materialized page or a
+    literal: evaluating the node now (coordinator-side) is exactly what
+    the final local run would do, just earlier — which is what lets an
+    ancestor stage distribute over its output."""
+    if isinstance(node, (PrecomputedNode, ValuesNode)):
+        return True
+    slots = child_slots(node)
+    if not slots:
+        return False
+    return all(fully_materialized(c) for _, c in slots)
+
+
+def _parent_fuses(parent: PlanNode, slot) -> bool:
+    """True when ``parent`` would include this child edge in its own
+    fused chain, so a stage must not be cut here — the outermost chain
+    position (whose parent is a breaker or the root) cuts instead."""
+    if isinstance(parent, (FilterNode, ProjectNode)) and slot == "source":
+        return True
+    if isinstance(parent, AggregationNode) and slot == "source":
+        return True
+    if isinstance(parent, (JoinNode, CrossSingleNode)) and slot == "left":
+        return True
+    return False
+
+
+def lower_stages(plan: PlanNode, run_agg, run_chain, eval_glue,
+                 splices: list,
+                 min_stage_rows: int = DEFAULT_MIN_STAGE_ROWS):
+    """Decompose ``plan`` into mesh stages bottom-up, splicing each
+    executed stage's materialization back into the tree.  ``run_agg`` /
+    ``run_chain`` execute a stage and return its PrecomputedNode;
+    ``eval_glue`` evaluates a fully-materialized glue breaker on the
+    coordinator (may return None to leave it in place).  ``splices``
+    records (parent, slot, old_child) for restoration.  Returns
+    (mesh_stage_count, lowered_root) — glue evaluations do not count.
+
+    Simulation (EXPLAIN) passes callbacks that fabricate empty
+    PrecomputedNodes instead of executing, walking the identical
+    decomposition, so EXPLAIN (TYPE DISTRIBUTED) always describes what
+    execution would actually do."""
+
+    def try_stage(node):
+        if is_agg_stage(node, min_stage_rows):
+            return run_agg(node)
+        if is_chain_stage(node, min_stage_rows):
+            return run_chain(node)
+        return None
+
+    def splice(parent, slot, old, new):
+        splices.append((parent, slot, old))
+        set_child(parent, slot, new)
+
+    def spine_joins(node):
+        """Join/cross nodes along a chain's probe spine (their build
+        sides are the chain's off-spine inputs)."""
+        while True:
+            if isinstance(node, (FilterNode, ProjectNode)):
+                node = node.source
+            elif isinstance(node, AggregationNode) and node.step == "partial":
+                node = node.source
+            elif isinstance(node, (JoinNode, CrossSingleNode)):
+                yield node
+                node = node.left
+            else:
+                return
+
+    def run_stage_at(parent, slot, child) -> int:
+        """Execute the stage rooted at ``child``, first lowering any
+        breakers hanging off its build sides (a join build containing
+        an aggregation subquery distributes as its own stage; build
+        splices cannot break the probe chain)."""
+        spine = child.source if isinstance(child, AggregationNode) else child
+        n = sum(lower_edge(j, "right") for j in spine_joins(spine))
+        new = try_stage(child)
+        assert new is not None  # build splices never un-distribute a chain
+        splice(parent, slot, child, new)
+        return n + 1
+
+    def lower_edge(parent, slot) -> int:
+        child = get_child(parent, slot)
+        if (isinstance(parent, (JoinNode, CrossSingleNode)) and slot == "right"
+                and build_side_chainable(child)):
+            # the stage machinery wave-scans chainable build sides
+            # itself (sharded/colocated builds); pre-materializing here
+            # would downgrade a partitioned build to broadcast
+            return 0
+        # an aggregation stage cuts regardless of the parent (a single
+        # aggregation never fuses into an ancestor chain); a pure chain
+        # cuts only at its outermost position (fusing parents defer to
+        # the ancestor that will include this subtree in its own stage)
+        fuses = _parent_fuses(parent, slot)
+        if is_agg_stage(child, min_stage_rows) or (
+                not fuses and is_chain_stage(child, min_stage_rows)):
+            return run_stage_at(parent, slot, child)
+        n = 0
+        for cslot, _ in child_slots(child):
+            n += lower_edge(child, cslot)
+        if n == 0:
+            return 0
+        if is_agg_stage(child, min_stage_rows) or (
+                not fuses and is_chain_stage(child, min_stage_rows)):
+            # children materialized: the node became a stage root (e.g.
+            # an aggregation whose chain leaf was a subquery)
+            return n + run_stage_at(parent, slot, child)
+        # a glue breaker over a fully-materialized subtree evaluates on
+        # the coordinator so an ANCESTOR stage can distribute over it
+        if isinstance(child, GLUE_BREAKERS) and fully_materialized(child):
+            new = eval_glue(child)
+            if new is not None:
+                splice(parent, slot, child, new)
+        return n
+
+    class _Holder:
+        source = plan
+
+    holder = _Holder()
+    n = lower_edge(holder, "source")
+    return n, holder.source
+
+
 def fragment_plan(
     plan: PlanNode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
-    catalog=None,
+    catalog=None, min_stage_rows: int = DEFAULT_MIN_STAGE_ROWS,
 ) -> Fragment:
-    """Lower a plan into a SubPlan-style fragment tree.  Fragments are
-    created at the distributed runner's exchange points: the SINGLE
-    coordinator fragment above the final exchange, a FIXED_HASH merge
-    fragment per distributed aggregation, SOURCE leaf fragments over
-    scans, and one fragment per join build side (BROADCAST or
-    FIXED_HASH by the distribution decision)."""
+    """Lower a plan into a SubPlan-style fragment tree by SIMULATING the
+    generalized stage decomposition (``lower_stages`` with fabricated
+    stage outputs) — the fragment tree is therefore exactly the stage
+    DAG the distributed runner would execute (for the min-stage-rows
+    cutoff the simulation uses planner ROW ESTIMATES where execution
+    sees actual intermediate sizes — the one adaptive decision that can
+    differ).  Fragments: a SINGLE coordinator fragment at the root (and
+    per glue breaker), a FIXED_HASH merge + SOURCE leaf pair per
+    distributed aggregation, SOURCE chain fragments, and one fragment
+    per join build side (BROADCAST / FIXED_HASH / COLOCATED by the
+    distribution decision)."""
     counter = [0]
 
     def next_id() -> int:
         fid = counter[0]
         counter[0] += 1
         return fid
-
-    def build_fragments(node: PlanNode) -> List[Fragment]:
-        """Fragments feeding ``node``'s streaming chain (build sides +
-        nested breakers)."""
-        out: List[Fragment] = []
-        if isinstance(node, (FilterNode, ProjectNode)):
-            out += build_fragments(node.source)
-        elif isinstance(node, AggregationNode) and node.step == "partial":
-            out += build_fragments(node.source)
-        elif isinstance(node, (JoinNode, CrossSingleNode)):
-            out += build_fragments(node.left)
-            mode, _ = decide_join_distribution(node, broadcast_threshold, catalog=catalog)
-            right = node.right
-            if mode == "broadcast":
-                kind = BROADCAST
-            elif mode == "colocated":
-                kind = COLOCATED
-            else:
-                kind = FIXED_HASH
-            keys = tuple(getattr(node, "right_keys", ()))
-            out.append(
-                Fragment(
-                    next_id(),
-                    right,
-                    distribution=_leaf_distribution(right),
-                    output=Partitioning(kind, keys if kind == FIXED_HASH else ()),
-                    children=build_fragments(right),
-                )
-            )
-        return out
 
     def _leaf_distribution(node: PlanNode) -> Partitioning:
         n = node
@@ -277,49 +512,127 @@ def fragment_plan(
                 return Partitioning(SINGLE)
             n = srcs[0]
 
-    # peel coordinator-side nodes down to the root aggregation
-    node = plan
-    while not isinstance(node, AggregationNode) and node.sources:
-        if isinstance(
-            node, (OutputNode, ProjectNode, FilterNode, SortNode, TopNNode, LimitNode,
-                   WindowNode)
-        ):
-            node = node.source
-        else:
-            break
+    def collect_children(node: PlanNode) -> List[Fragment]:
+        """Fragments feeding ``node``'s subtree: spliced child-stage
+        fragments (tagged on their PrecomputedNodes) and join build
+        fragments along streaming chains."""
+        out: List[Fragment] = []
+        frag = getattr(node, "_frag", None)
+        if frag is not None:
+            return [frag]
+        if isinstance(node, (JoinNode, CrossSingleNode)):
+            out += collect_children(node.left)
+            mode, _ = decide_join_distribution(
+                node, broadcast_threshold, catalog=catalog)
+            kind = {"broadcast": BROADCAST, "colocated": COLOCATED}.get(
+                mode, FIXED_HASH)
+            keys = tuple(getattr(node, "right_keys", ()))
+            out.append(Fragment(
+                next_id(), node.right,
+                distribution=_leaf_distribution(node.right),
+                output=Partitioning(kind, keys if kind == FIXED_HASH else ()),
+                children=collect_children(node.right),
+            ))
+            return out
+        for _, child in child_slots(node):
+            out += collect_children(child)
+        return out
 
-    if isinstance(node, AggregationNode) and node.step == "single":
-        agg = node
-        keys = tuple(agg.group_exprs)
-        leaf_frag = Fragment(
-            next_id(),
-            agg.source,
-            distribution=_leaf_distribution(agg.source),
-            output=Partitioning(FIXED_HASH, keys) if keys else Partitioning(SINGLE),
-            children=build_fragments(agg.source),
+    def tag(node: PlanNode, frag: Fragment) -> PrecomputedNode:
+        pre = PrecomputedNode(page=None, channel_list=node.channels)
+        pre._frag = frag
+        try:
+            pre._est_rows = estimate_rows(node)
+        except Exception:
+            pre._est_rows = None
+        return pre
+
+    def sim_agg(node: AggregationNode) -> PrecomputedNode:
+        keys = tuple(node.group_exprs)
+        part = Partitioning(FIXED_HASH, keys) if keys else Partitioning(SINGLE)
+        leaf = Fragment(
+            next_id(), node.source,
+            distribution=_leaf_distribution(node.source), output=part,
+            children=collect_children(node.source),
         )
-        merge_frag = Fragment(
-            next_id(),
-            agg,
-            distribution=Partitioning(FIXED_HASH, keys) if keys else Partitioning(SINGLE),
-            output=Partitioning(SINGLE),
-            children=[leaf_frag],
+        merge = Fragment(next_id(), node, distribution=part,
+                         output=Partitioning(SINGLE), children=[leaf])
+        return tag(node, merge)
+
+    def sim_chain(node: PlanNode) -> PrecomputedNode:
+        frag = Fragment(
+            next_id(), node, distribution=_leaf_distribution(node),
+            output=Partitioning(SINGLE), children=collect_children(node),
         )
-        root = Fragment(
+        return tag(node, frag)
+
+    def sim_glue(node: PlanNode) -> PrecomputedNode:
+        frag = Fragment(
+            next_id(), node, distribution=Partitioning(SINGLE),
+            output=Partitioning(SINGLE), children=collect_children(node),
+        )
+        return tag(node, frag)
+
+    splices: list = []
+    try:
+        n, root = lower_stages(plan, sim_agg, sim_chain, sim_glue, splices,
+                               min_stage_rows=min_stage_rows)
+        return Fragment(
             next_id(), plan, distribution=Partitioning(SINGLE),
-            output=Partitioning(SINGLE), children=[merge_frag],
+            output=Partitioning(SINGLE), children=collect_children(root),
         )
-        return root
+    finally:
+        for parent, slot, old in reversed(splices):
+            set_child(parent, slot, old)
 
-    # non-aggregation-rooted plan: single fragment (runs locally)
-    return Fragment(
-        next_id(), plan, distribution=Partitioning(SINGLE),
-        output=Partitioning(SINGLE), children=build_fragments(plan),
-    )
+
+def count_stages(plan: PlanNode,
+                 min_stage_rows: int = DEFAULT_MIN_STAGE_ROWS) -> int:
+    """Mesh stages the decomposition would execute (0 = the plan runs
+    entirely on the coordinator)."""
+
+    def mk(node):
+        pre = PrecomputedNode(page=None, channel_list=node.channels)
+        try:
+            pre._est_rows = estimate_rows(node)
+        except Exception:
+            pre._est_rows = None
+        return pre
+
+    splices: list = []
+    try:
+        n, _ = lower_stages(plan, mk, mk, mk, splices,
+                            min_stage_rows=min_stage_rows)
+        return n
+    finally:
+        for parent, slot, old in reversed(splices):
+            set_child(parent, slot, old)
+
+
+def undistributable_reason(plan: PlanNode) -> str:
+    """Why no stage distributes — the loud part of the fallback."""
+    node = plan
+    while isinstance(node, OutputNode):
+        node = node.source
+    if isinstance(node, AggregationNode) and node.step == "single":
+        return chain_distributable(node.source) or "distributable"
+    return chain_distributable(node) or "distributable"
 
 
 def explain_distributed(
     plan: PlanNode, broadcast_threshold: int = DEFAULT_BROADCAST_THRESHOLD,
-    catalog=None,
+    catalog=None, min_stage_rows: int = DEFAULT_MIN_STAGE_ROWS,
 ) -> str:
-    return fragment_plan(plan, broadcast_threshold, catalog=catalog).tree_str()
+    """EXPLAIN (TYPE DISTRIBUTED): the FRAGMENTED header is the loud
+    distributed-vs-local signal VERDICT r3 asked for — when execution
+    would silently have run locally, the header says so and why."""
+    n = count_stages(plan, min_stage_rows=min_stage_rows)
+    if n == 0:
+        header = (f"FRAGMENTED: no — {undistributable_reason(plan)}; "
+                  "plan executes on the coordinator only\n")
+    else:
+        header = f"FRAGMENTED: yes ({n} mesh stage{'s' if n > 1 else ''})\n"
+    return header + fragment_plan(
+        plan, broadcast_threshold, catalog=catalog,
+        min_stage_rows=min_stage_rows,
+    ).tree_str()

@@ -133,9 +133,14 @@ class QueryRunner:
                     error=f"{type(e).__name__}: {e}", trace_token=trace,
                 ))
                 raise
+            dist_stages = dist_fallback = None
+            if self.session.get("distributed") and getattr(self, "_dist", None):
+                dist_stages = self._dist.last_stage_count
+                dist_fallback = self._dist.last_fallback_reason
             self.events.query_completed(QueryCompletedEvent(
                 qid, sql, self.session.user, "FINISHED", t0, time.time(),
                 rows=len(res.rows), trace_token=trace,
+                dist_stages=dist_stages, dist_fallback=dist_fallback,
             ))
             return res
 
@@ -144,7 +149,10 @@ class QueryRunner:
             if getattr(stmt, "distributed", False):
                 from presto_tpu.parallel.fragment import explain_distributed
 
-                text = explain_distributed(plan, catalog=self.catalog)
+                text = explain_distributed(
+                    plan, catalog=self.catalog,
+                    min_stage_rows=int(
+                        self.session.get("distributed_min_stage_rows")))
                 return MaterializedResult(["Query Plan"], [VARCHAR], [(text,)])
             if stmt.analyze and getattr(stmt, "verbose", False):
                 text = self.executor.explain_analyze_verbose(plan)
@@ -507,4 +515,6 @@ class QueryRunner:
         sql/planner/PlanFragmenter SubPlans printed by PlanPrinter)."""
         from presto_tpu.parallel.fragment import explain_distributed
 
-        return explain_distributed(self.plan(sql), catalog=self.catalog)
+        return explain_distributed(
+            self.plan(sql), catalog=self.catalog,
+            min_stage_rows=int(self.session.get("distributed_min_stage_rows")))

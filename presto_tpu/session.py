@@ -74,6 +74,12 @@ SYSTEM_PROPERTIES = [
         "AUTOMATIC | BROADCAST | PARTITIONED (DetermineJoinDistributionType)",
         "AUTOMATIC", lambda s: s.strip().upper(),
     ),
+    PropertyMetadata(
+        "distributed_min_stage_rows",
+        "stages over intermediates smaller than this run on the "
+        "coordinator (0 = every stage on the mesh)",
+        1 << 13, int,
+    ),
 ]
 
 

@@ -1904,7 +1904,14 @@ class Binder:
         return join, mark_idx
 
     def _plan_in_mark(self, node, remap, glob, m):
-        """value IN (subquery) as a mark join (uncorrelated only)."""
+        """value IN (subquery) as a mark join (uncorrelated only).
+
+        Deviation (documented in PARITY.md): the mark is two-valued —
+        FALSE for unmatched rows even when the subquery side contains
+        NULL keys (ANSI three-valued IN would yield NULL there, so a
+        negated use like ``NOT (x IN (...))`` under OR keeps rows the
+        reference would drop).  Same semantics as this engine's
+        semi/anti lowering."""
         sub, _ = self._plan_query_like(m.query)
         value_ir = remap_expr(self._bind(m.value, glob), remap)
         mark_idx = len(node.channels)

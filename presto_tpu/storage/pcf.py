@@ -119,9 +119,18 @@ class PcfWriter:
         for (col, t), b in zip(self.schema, p.blocks):
             data = np.asarray(b.data)[:n]
             valid = np.asarray(b.valid)[:n]
-            if t.is_string and not t.is_raw_string and b.dictionary is not None \
-                    and col not in self.dictionaries:
-                self.dictionaries[col] = list(b.dictionary.values)
+            if t.is_string and not t.is_raw_string and b.dictionary is not None:
+                if col not in self.dictionaries:
+                    self.dictionaries[col] = list(b.dictionary.values)
+                elif self.dictionaries[col] != list(b.dictionary.values):
+                    # codes are stored as-is and decoded against the
+                    # FIRST page's dictionary; a different dictionary on
+                    # a later page would silently decode to wrong values
+                    raise ValueError(
+                        f"column {col!r}: page dictionary differs from the "
+                        "file's dictionary (PCF stores one table "
+                        "dictionary per varchar column; re-encode the "
+                        "page to the first page's dictionary)")
             payload, meta = self._encode_column(col, t, data, valid)
             body = encode(payload)
             codec = self.compression

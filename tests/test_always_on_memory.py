@@ -27,8 +27,8 @@ def test_default_pool_engaged_without_opt_in():
               "where o_custkey = c_custkey group by o_orderpriority")
     # scan pages + join build + agg accumulator were all charged
     assert r.executor.last_peak_bytes > 0
-    # and released at query end
-    assert all(not t.startswith("q") or True for t in r.memory_pool.tags())
+    # and released at query end: no reservations may remain
+    assert r.memory_pool.reserved == 0, list(r.memory_pool.tags())
 
 
 def test_peak_shows_in_explain_analyze():

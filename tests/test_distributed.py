@@ -199,3 +199,138 @@ def test_distributed_chain_without_aggregation():
         assert local, sql  # the fixture must produce rows
         got = dist._run_distributed(r.plan(sql)).rows
         assert got == local, sql
+
+
+# ---------------------------------------------------------------------------
+# generalized stage-DAG decomposition (round 4): arbitrary plan shapes
+# lower into multiple mesh stages with materialized intermediates
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def env_general(env):
+    local, dist = env
+    dist.min_stage_rows = 0  # tiny test pages must still shard
+    yield local, dist
+    dist.min_stage_rows = 1 << 13
+
+
+def _check_stages(local, dist, sql, min_stages):
+    plan = local.plan(sql)
+    got = dist._run_distributed(plan)
+    assert dist.last_stage_count >= min_stages, (
+        sql[:60], dist.last_stage_count)
+    want = local.executor.run(local.plan(sql))
+    assert len(got.rows) == len(want.rows)
+    for a, e in zip(sorted(got.rows, key=_key), sorted(want.rows, key=_key)):
+        for va, ve in zip(a, e):
+            if isinstance(va, float):
+                assert va == pytest.approx(ve, rel=1e-12), (a, e)
+            else:
+                assert va == ve, (a, e)
+
+
+def test_multi_level_aggregation_distributes(env_general):
+    """Aggregation over a subquery aggregation: both levels are mesh
+    stages — the inner agg's merged output re-chunks across devices as
+    the outer stage's source (multi-fragment SubPlan execution)."""
+    local, dist = env_general
+    _check_stages(
+        local, dist,
+        "SELECT max(c) AS mx, min(ok) AS mn, count(*) AS n FROM "
+        "(SELECT o_custkey AS ok, count(*) AS c FROM orders GROUP BY o_custkey)",
+        min_stages=2,
+    )
+
+
+def test_union_arms_distribute(env_general):
+    """Each UNION ALL arm wave-executes as its own stage; the
+    coordinator concatenates; an aggregation above shards again."""
+    local, dist = env_general
+    _check_stages(
+        local, dist,
+        "SELECT count(*) AS n, sum(k) AS s FROM ("
+        "SELECT o_orderkey AS k FROM orders WHERE o_orderkey % 2 = 0 "
+        "UNION ALL "
+        "SELECT l_orderkey AS k FROM lineitem WHERE l_linenumber = 1)",
+        min_stages=3,
+    )
+
+
+def test_window_glue_between_stages(env_general):
+    """A window function between two aggregations: stage below, window
+    on the coordinator (glue), stage above over its output."""
+    local, dist = env_general
+    _check_stages(
+        local, dist,
+        "SELECT count(*) AS n, max(rnk) AS top FROM ("
+        "  SELECT o_custkey, rank() OVER (ORDER BY c DESC) AS rnk FROM ("
+        "    SELECT o_custkey, count(*) AS c FROM orders GROUP BY o_custkey))"
+        " WHERE rnk <= 10",
+        min_stages=1,
+    )
+
+
+def test_tpcds_q7_distributes(env_general):
+    """A real TPC-DS star-join query through the general decomposition,
+    validated against LocalRunner (VERDICT r3 next-round item 2)."""
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpcds import Tpcds
+    from presto_tpu.parallel.dist import DistributedRunner, make_mesh
+    from tests.tpcds_queries import QUERIES as DS
+
+    cat = Catalog()
+    cat.register("tpcds", Tpcds(sf=0.002, split_rows=512,
+                                cd_rows=2 * 5 * 7 * 4, inv_rows=2000))
+    local = QueryRunner(cat)
+    dist = DistributedRunner(cat, make_mesh(8))
+    dist.min_stage_rows = 0
+    _check_stages(local, dist, DS[7], min_stages=1)
+
+
+def test_fallback_is_loud(env):
+    """An undistributable plan must fall back with a recorded reason
+    (VERDICT r3: the silent LocalRunner fallback hid that no TPC-DS
+    query distributed)."""
+    local, dist = env
+    # VALUES-only plan: no scan, nothing to shard
+    plan = local.plan("SELECT * FROM (VALUES (1, 'a'), (2, 'b')) t(x, y)")
+    res = dist.run(plan)
+    assert len(res.rows) == 2
+    assert dist.last_stage_count == 0
+    assert dist.last_fallback_reason  # non-empty, human-readable
+
+
+def test_explain_fragmented_header(env):
+    """EXPLAIN (TYPE DISTRIBUTED) leads with the loud FRAGMENTED header
+    that always agrees with what execution does."""
+    from presto_tpu.parallel.fragment import explain_distributed
+
+    local, _ = env
+    yes = explain_distributed(local.plan(QUERIES[3]))
+    assert yes.startswith("FRAGMENTED: yes")
+    no = explain_distributed(
+        local.plan("SELECT * FROM (VALUES (1), (2)) t(x)"))
+    assert no.startswith("FRAGMENTED: no")
+    assert "coordinator" in no
+
+
+def test_completed_event_carries_dist_outcome(env):
+    """Query events surface distributed-vs-local per query."""
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.events import EventListener
+
+    cat = Catalog()
+    cat.register("tpch", Tpch(sf=0.002, split_rows=512))
+    r = QueryRunner(cat)
+    r.session.set("distributed", "true")
+    seen = []
+
+    class L(EventListener):
+        def query_completed(self, event):
+            seen.append(event)
+
+    r.events.add(L())
+    r.execute("SELECT count(*) FROM orders")
+    assert seen and seen[-1].dist_stages >= 1
+    assert seen[-1].dist_fallback is None

@@ -2009,7 +2009,6 @@ select null, null, sum(sales), sum(returns_), sum(profit) from sel
 order by channel, id, sales
 limit 100
 """,
-    77: "\nwith ss as (\n    select s_store_sk, sum(ss_ext_sales_price) as sales,\n           sum(ss_net_profit) as profit\n    from store_sales, date_dim, store\n    where ss_sold_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and ss_store_sk = s_store_sk\n    group by s_store_sk\n),\nsr as (\n    select s_store_sk, sum(sr_return_amt) as returns_,\n           sum(sr_net_loss) as profit_loss\n    from store_returns, date_dim, store\n    where sr_returned_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and sr_store_sk = s_store_sk\n    group by s_store_sk\n),\ncs as (\n    select cs_call_center_sk, sum(cs_ext_sales_price) as sales,\n           sum(cs_net_profit) as profit\n    from catalog_sales, date_dim\n    where cs_sold_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n    group by cs_call_center_sk\n),\ncr as (\n    select cr_call_center_sk, sum(cr_return_amount) as returns_,\n           sum(cr_net_loss) as profit_loss\n    from catalog_returns, date_dim\n    where cr_returned_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n    group by cr_call_center_sk\n),\nws as (\n    select wp_web_page_sk, sum(ws_ext_sales_price) as sales,\n           sum(ws_net_profit) as profit\n    from web_sales, date_dim, web_page\n    where ws_sold_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and ws_web_page_sk = wp_web_page_sk\n    group by wp_web_page_sk\n),\nwr as (\n    select wp_web_page_sk, sum(wr_return_amt) as returns_,\n           sum(wr_net_loss) as profit_loss\n    from web_returns, date_dim, web_page, web_sales\n    where wr_returned_date_sk = d_date_sk\n        and d_date between date '2000-08-03' and date '2000-09-02'\n        and wr_order_number = ws_order_number and wr_item_sk = ws_item_sk\n        and ws_web_page_sk = wp_web_page_sk\n    group by wp_web_page_sk\n),\nx as (\n    select 'store channel' as channel, ss.s_store_sk as id, sales,\n           coalesce(returns_, 0) as returns_,\n           (profit - coalesce(profit_loss, 0)) as profit\n    from ss left join sr on ss.s_store_sk = sr.s_store_sk\n    union all\n    select 'catalog channel', cs.cs_call_center_sk, sales,\n           coalesce(returns_, 0),\n           (profit - coalesce(profit_loss, 0))\n    from cs left join cr on cs.cs_call_center_sk = cr.cr_call_center_sk\n    union all\n    select 'web channel', ws.wp_web_page_sk, sales,\n           coalesce(returns_, 0),\n           (profit - coalesce(profit_loss, 0))\n    from ws left join wr on ws.wp_web_page_sk = wr.wp_web_page_sk\n),\nsel as (select channel, id, sum(sales) as sales,\n        sum(returns_) as returns_, sum(profit) as profit\n        from x group by channel, id)\nselect channel, id, sales, returns_, profit from sel\nunion all\nselect channel, null, sum(sales), sum(returns_), sum(profit)\nfrom sel group by channel\nunion all\nselect null, null, sum(sales), sum(returns_), sum(profit) from sel\norder by channel, id, sales\nlimit 100\n",
     18: _rollup_union(
         ["i_item_id", "ca_country", "ca_state", "ca_county"],
         "avg(cs_quantity) as agg1, avg(cs_list_price) as agg2, avg(cs_coupon_amt) as agg3",
