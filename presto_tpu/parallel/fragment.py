@@ -577,10 +577,12 @@ def fragment_plan(
     try:
         n, root = lower_stages(plan, sim_agg, sim_chain, sim_glue, splices,
                                min_stage_rows=min_stage_rows)
-        return Fragment(
+        out = Fragment(
             next_id(), plan, distribution=Partitioning(SINGLE),
             output=Partitioning(SINGLE), children=collect_children(root),
         )
+        out.mesh_stages = n  # simulated stage count (FRAGMENTED header)
+        return out
     finally:
         for parent, slot, old in reversed(splices):
             set_child(parent, slot, old)
@@ -626,13 +628,12 @@ def explain_distributed(
     """EXPLAIN (TYPE DISTRIBUTED): the FRAGMENTED header is the loud
     distributed-vs-local signal VERDICT r3 asked for — when execution
     would silently have run locally, the header says so and why."""
-    n = count_stages(plan, min_stage_rows=min_stage_rows)
+    frags = fragment_plan(plan, broadcast_threshold, catalog=catalog,
+                          min_stage_rows=min_stage_rows)
+    n = frags.mesh_stages
     if n == 0:
         header = (f"FRAGMENTED: no — {undistributable_reason(plan)}; "
                   "plan executes on the coordinator only\n")
     else:
         header = f"FRAGMENTED: yes ({n} mesh stage{'s' if n > 1 else ''})\n"
-    return header + fragment_plan(
-        plan, broadcast_threshold, catalog=catalog,
-        min_stage_rows=min_stage_rows,
-    ).tree_str()
+    return header + frags.tree_str()

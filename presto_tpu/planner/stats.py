@@ -191,7 +191,14 @@ class StatsCalculator:
         from presto_tpu.planner.plan import PrecomputedNode
 
         if isinstance(node, PrecomputedNode):
-            # materialized page: exact row count available
+            # materialized page: exact row count available.  The EXPLAIN
+            # simulation fabricates page=None nodes carrying the
+            # planner's estimate instead (fragment.py tag()).
+            if node.page is None:
+                est = getattr(node, "_est_rows", None)
+                rows = float(est) if est is not None else 1.0
+                return PlanEstimate(
+                    rows, [ColumnEstimate() for _ in node.channels])
             import numpy as _np
 
             rows = float(_np.asarray(node.page.row_mask).sum())

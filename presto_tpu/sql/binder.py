@@ -878,7 +878,23 @@ class Binder:
         (innerJoin/probeOuterJoin/lookupOuterJoin/fullOuterJoin)."""
         if rel.kind in ("inner", "cross"):
             terms, conjuncts = self._flatten_from([rel])
-            node, scope, _ = self._join_terms(terms, conjuncts)
+            node, scope, g2c = self._join_terms(terms, conjuncts)
+            # join reordering permutes the tree's channel layout away
+            # from the syntactic scope order; callers (e.g. the probe
+            # side of an enclosing LEFT/FULL join) address channels BY
+            # SCOPE POSITION, so restore the order with a pass-through
+            # projection (fuses into the chain; ColumnRef projections
+            # keep dictionary/domain metadata).  Dropping the mapping
+            # here mis-bound every predicate above an outer join over a
+            # reordered cluster (silent wrong results when types align).
+            if any(g2c[i] != i for i in range(len(scope))):
+                chans = node.channels
+                node = ProjectNode(
+                    node,
+                    [ColumnRef(type=chans[g2c[i]].type, index=g2c[i])
+                     for i in range(len(scope))],
+                    [c.name for c in scope.cols],
+                )
             return node, scope
         assert rel.kind in ("left", "full"), rel.kind
         lnode, lscope = self._plan_relation(rel.left)
@@ -1532,24 +1548,37 @@ class Binder:
         out_irs = [self._bind_agg(e, scope, agg_ctx) for e, _ in items]
         names = [n for _, n in items]
 
-        # HAVING: plain conjuncts filter the agg output; scalar-subquery
-        # comparisons (Q11 shape) become single-row cross joins + filter
+        # HAVING: plain conjuncts filter the agg output; conjuncts with
+        # scalar subqueries ANYWHERE in the expression — bare (Q11) or
+        # nested in arithmetic (TPC-DS q44's avg(x) > 0.9 * (select …))
+        # — plan each subquery as a single-row cross join and bind the
+        # subquery positions to negative sentinel refs, remapped to the
+        # spliced cross-join channels after the aggregation is built.
         having_plain: List[Expr] = []
-        having_sub: List[Tuple[str, Expr, ast.Query, bool]] = []
+        having_sub: List[Tuple[Expr, List[PlanNode], bool]] = []
         for c in split_conjuncts(having):
             negated = False
             while isinstance(c, ast.Unary) and c.op == "not":
                 negated = not negated
                 c = c.operand
-            if _is_subquery_conjunct(c):
-                if not isinstance(c, ast.Binary):
-                    raise BindError("only scalar-subquery comparisons supported in HAVING")
-                lhs, rhs, op = c.left, c.right, c.op
-                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-                if isinstance(lhs, ast.ScalarSubquery):
-                    lhs, rhs, op = rhs, lhs, flip.get(op, op)
-                lhs_ir = self._bind_agg(lhs, scope, agg_ctx)
-                having_sub.append((op, lhs_ir, rhs.query, negated))
+            subs: List[ast.Node] = []
+            _find_scalar_subqueries(c, subs)
+            if subs:
+                planned: List[PlanNode] = []
+                for k, sq in enumerate(subs):
+                    sub_node, _ = self._plan_query_like(sq.query)
+                    self._scalar_refs[id(sq)] = ColumnRef(
+                        type=sub_node.channels[0].type, index=-(k + 1))
+                    planned.append(sub_node)
+                try:
+                    ir = self._bind_agg(c, scope, agg_ctx)
+                finally:
+                    for sq in subs:
+                        self._scalar_refs.pop(id(sq), None)
+                having_sub.append((ir, planned, negated))
+            elif _is_subquery_conjunct(c):
+                raise BindError(
+                    "only scalar subqueries are supported in HAVING")
             else:
                 ir = self._bind_agg(c, scope, agg_ctx)
                 having_plain.append(call("not", ir) if negated else ir)
@@ -1603,12 +1632,12 @@ class Binder:
         out: PlanNode = agg
         for ir in having_plain:
             out = FilterNode(out, ir)
-        opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
-        for op, lhs_ir, subq, negated in having_sub:
-            sub_node, _ = self._plan_query_like(subq)
-            ref = ColumnRef(type=sub_node.channels[0].type, index=len(out.channels))
-            out = CrossSingleNode(left=out, right=sub_node)
-            pred: Expr = call(opmap[op], lhs_ir, ref)
+        for ir, planned, negated in having_sub:
+            mapping = {r: r for r in expr_refs(ir) if r >= 0}
+            for k, sub_node in enumerate(planned):
+                mapping[-(k + 1)] = len(out.channels)
+                out = CrossSingleNode(left=out, right=sub_node)
+            pred = remap_expr(ir, mapping)
             if negated:
                 pred = call("not", pred)
             out = FilterNode(out, pred)

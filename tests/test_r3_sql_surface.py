@@ -134,3 +134,30 @@ def test_dictionary_sort_is_value_ordered(runner):
     out = ex.run(plan)
     vals = [r[0] for r in out.rows]
     assert vals == sorted(vals)
+
+
+def test_having_scalar_subquery_inside_arithmetic(runner):
+    """TPC-DS q44's HAVING shape: the scalar subquery sits INSIDE
+    arithmetic (avg(x) > 0.9 * (select ...)) rather than bare on one
+    side of the comparison (r4: generalized from the Q11-only form)."""
+    r = runner
+    got = r.execute("""
+        SELECT o_custkey, avg(o_totalprice) AS a
+        FROM orders GROUP BY o_custkey
+        HAVING avg(o_totalprice) > 1.2 * (SELECT avg(o_totalprice) FROM orders)
+        ORDER BY a DESC, o_custkey LIMIT 5
+    """).rows
+    threshold = 1.2 * r.execute(
+        "SELECT avg(o_totalprice) FROM orders").rows[0][0]
+    assert got, "expected some high-value customers"
+    assert all(a > float(threshold) for _, a in got)
+
+    # two subqueries in one conjunct, plus negation
+    got2 = r.execute("""
+        SELECT o_custkey, count(*) AS c
+        FROM orders GROUP BY o_custkey
+        HAVING count(*) > (SELECT count(*) FROM orders) /
+                          (SELECT count(DISTINCT o_custkey) FROM orders)
+        ORDER BY c DESC, o_custkey LIMIT 5
+    """).rows
+    assert got2
