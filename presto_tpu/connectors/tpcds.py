@@ -155,7 +155,7 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("s_store_sk", BIGINT), ("s_store_id", VARCHAR),
         ("s_store_name", VARCHAR), ("s_number_employees", BIGINT),
         ("s_state", VARCHAR), ("s_city", VARCHAR), ("s_county", VARCHAR),
-        ("s_gmt_offset", _GMT),
+        ("s_zip", VARCHAR), ("s_gmt_offset", _GMT),
     ],
     "warehouse": [
         ("w_warehouse_sk", BIGINT), ("w_warehouse_id", VARCHAR),
@@ -508,7 +508,7 @@ class Tpcds:
             d = PatternDictionary(lambda i: f"Last{i}", 1024)
         elif column == "ca_address_id":
             d = PatternDictionary(lambda i: f"AAAAAAAA{i + 1:08d}A", self.n_addresses)
-        elif column == "ca_zip":
+        elif column in ("ca_zip", "s_zip"):
             d = PatternDictionary(lambda i: f"{10000 + i * 7 % 90000:05d}", 400)
         elif column == "p_promo_id":
             d = PatternDictionary(lambda i: f"promo#{i + 1:08d}", self.n_promos)
@@ -711,6 +711,10 @@ class Tpcds:
             "s_state": (_hash_u64(s("state"), idx) % len(STATES)).astype(np.int32),
             "s_city": (_hash_u64(s("city"), idx) % len(CITIES)).astype(np.int32),
             "s_county": (_hash_u64(s("county"), idx) % len(COUNTIES)).astype(np.int32),
+            # zips share customer_address's 400-value dictionary (first
+            # 40 values only) so s_zip = ca_zip equijoins (q24) and
+            # shared prefixes (q8) hit at useful rates
+            "s_zip": (_hash_u64(s("zip"), idx) % 40).astype(np.int32),
             "s_gmt_offset": -(_uniform_int(s("gmt"), idx, 5, 8)) * 100,
         }
 
@@ -756,13 +760,16 @@ class Tpcds:
         }
 
     def _inventory(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        # mixed-radix (week, warehouse, item) enumeration of the cross
-        # product prefix; inv dates land on week boundaries like dsdgen
+        # mixed-radix (item, warehouse, week) enumeration of the cross
+        # product prefix; inv dates land on week boundaries like dsdgen.
+        # week varies FASTEST so a truncated inv_rows still spans many
+        # weeks (a per-item time series — q39's month-over-month cov
+        # self-join needs at least two months of snapshots)
         s = lambda c: _seed("inventory", c)
         x = idx.copy()
-        item = x % self.n_items; x //= self.n_items
+        week = x % INV_WEEKS; x //= INV_WEEKS
         wh = x % self.n_warehouses; x //= self.n_warehouses
-        week = x
+        item = x
         return {
             "inv_date_sk": (D_SK0 + _SALES_START + week * 7).astype(np.int64),
             "inv_item_sk": (item + 1).astype(np.int64),

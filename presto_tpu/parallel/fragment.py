@@ -588,29 +588,6 @@ def fragment_plan(
             set_child(parent, slot, old)
 
 
-def count_stages(plan: PlanNode,
-                 min_stage_rows: int = DEFAULT_MIN_STAGE_ROWS) -> int:
-    """Mesh stages the decomposition would execute (0 = the plan runs
-    entirely on the coordinator)."""
-
-    def mk(node):
-        pre = PrecomputedNode(page=None, channel_list=node.channels)
-        try:
-            pre._est_rows = estimate_rows(node)
-        except Exception:
-            pre._est_rows = None
-        return pre
-
-    splices: list = []
-    try:
-        n, _ = lower_stages(plan, mk, mk, mk, splices,
-                            min_stage_rows=min_stage_rows)
-        return n
-    finally:
-        for parent, slot, old in reversed(splices):
-            set_child(parent, slot, old)
-
-
 def undistributable_reason(plan: PlanNode) -> str:
     """Why no stage distributes — the loud part of the fallback."""
     node = plan

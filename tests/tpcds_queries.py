@@ -2106,3 +2106,40 @@ order by lochierarchy desc,
 limit 100
 """,
 }
+
+
+# ---- round-4 additions: the 18 remaining TPC-DS queries (99/99) ----
+# Adapted to this generator's data (constants tuned for nonzero
+# results at sf0.01; q5's catalog channel pivots on call centers
+# since catalog_returns carries no catalog_page key).
+QUERIES.update({
+    5: "\nwith ssr as (\nselect s_store_id,\n       sum(sales_price) as sales, sum(profit) as profit,\n       sum(return_amt) as returns_, sum(net_loss) as profit_loss\nfrom (select ss_store_sk as store_sk, ss_sold_date_sk as date_sk,\n             ss_ext_sales_price as sales_price, ss_net_profit as profit,\n             cast(0 as decimal(12,2)) as return_amt,\n             cast(0 as decimal(12,2)) as net_loss\n      from store_sales\n      union all\n      select sr_store_sk, sr_returned_date_sk,\n             cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),\n             sr_return_amt, sr_net_loss\n      from store_returns) salesreturns, date_dim, store\nwhere date_sk = d_date_sk\n  and d_date between date '2000-08-23' and date '2000-09-06'\n  and store_sk = s_store_sk\ngroup by s_store_id\n), csr as (\nselect cc_call_center_id,\n       sum(sales_price) as sales, sum(profit) as profit,\n       sum(return_amt) as returns_, sum(net_loss) as profit_loss\nfrom (select cs_call_center_sk as center_sk, cs_sold_date_sk as date_sk,\n             cs_ext_sales_price as sales_price, cs_net_profit as profit,\n             cast(0 as decimal(12,2)) as return_amt,\n             cast(0 as decimal(12,2)) as net_loss\n      from catalog_sales\n      union all\n      select cr_call_center_sk, cr_returned_date_sk,\n             cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),\n             cr_return_amount, cr_net_loss\n      from catalog_returns) salesreturns, date_dim, call_center\nwhere date_sk = d_date_sk\n  and d_date between date '2000-08-23' and date '2000-09-06'\n  and center_sk = cc_call_center_sk\ngroup by cc_call_center_id\n), wsr as (\nselect web_site_id,\n       sum(sales_price) as sales, sum(profit) as profit,\n       sum(return_amt) as returns_, sum(net_loss) as profit_loss\nfrom (select ws_web_site_sk as wsr_web_site_sk, ws_sold_date_sk as date_sk,\n             ws_ext_sales_price as sales_price, ws_net_profit as profit,\n             cast(0 as decimal(12,2)) as return_amt,\n             cast(0 as decimal(12,2)) as net_loss\n      from web_sales\n      union all\n      select ws_web_site_sk, wr_returned_date_sk,\n             cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),\n             wr_return_amt, wr_net_loss\n      from web_returns\n      left outer join web_sales on (wr_item_sk = ws_item_sk\n                                    and wr_order_number = ws_order_number)\n     ) salesreturns, date_dim, web_site\nwhere date_sk = d_date_sk\n  and d_date between date '2000-08-23' and date '2000-09-06'\n  and wsr_web_site_sk = web_site_sk\ngroup by web_site_id\n)\nselect channel, id, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit\nfrom (select 'store channel' as channel, s_store_id as id, sales, returns_,\n             profit - profit_loss as profit\n      from ssr\n      union all\n      select 'catalog channel', cc_call_center_id, sales, returns_,\n             profit - profit_loss\n      from csr\n      union all\n      select 'web channel', web_site_id, sales, returns_,\n             profit - profit_loss\n      from wsr) x\ngroup by rollup (channel, id)\norder by channel nulls first, id nulls first\nlimit 100\n",
+    8: "\nselect s_store_name, sum(ss_net_profit)\nfrom store_sales, date_dim, store,\n     (select ca_zip from (\n        select substr(ca_zip, 1, 5) ca_zip from customer_address\n        where substr(ca_zip, 1, 1) = '1'\n        intersect\n        select ca_zip from (\n          select substr(ca_zip, 1, 5) ca_zip, count(*) cnt\n          from customer_address, customer\n          where ca_address_sk = c_current_addr_sk\n          group by substr(ca_zip, 1, 5) having count(*) > 10) a1) a2) v1\nwhere ss_store_sk = s_store_sk and ss_sold_date_sk = d_date_sk\n  and d_qoy = 2 and d_year = 1998\n  and substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)\ngroup by s_store_name\norder by s_store_name\n",
+    14: "\nwith cross_items as (\n  select i_item_sk ss_item_sk\n  from item,\n   (select iss.i_brand_id brand_id, iss.i_class_id class_id,\n           iss.i_category_id category_id\n    from store_sales, item iss, date_dim d1\n    where ss_item_sk = iss.i_item_sk and ss_sold_date_sk = d1.d_date_sk\n      and d1.d_year between 1999 and 2001\n    intersect\n    select ics.i_brand_id, ics.i_class_id, ics.i_category_id\n    from catalog_sales, item ics, date_dim d2\n    where cs_item_sk = ics.i_item_sk and cs_sold_date_sk = d2.d_date_sk\n      and d2.d_year between 1999 and 2001\n    intersect\n    select iws.i_brand_id, iws.i_class_id, iws.i_category_id\n    from web_sales, item iws, date_dim d3\n    where ws_item_sk = iws.i_item_sk and ws_sold_date_sk = d3.d_date_sk\n      and d3.d_year between 1999 and 2001) x\n  where i_brand_id = brand_id and i_class_id = class_id\n    and i_category_id = category_id),\n avg_sales as (\n  select avg(quantity * list_price) average_sales\n  from (select ss_quantity quantity, ss_list_price list_price\n        from store_sales, date_dim\n        where ss_sold_date_sk = d_date_sk and d_year between 1999 and 2001\n        union all\n        select cs_quantity, cs_list_price\n        from catalog_sales, date_dim\n        where cs_sold_date_sk = d_date_sk and d_year between 1999 and 2001\n        union all\n        select ws_quantity, ws_list_price\n        from web_sales, date_dim\n        where ws_sold_date_sk = d_date_sk and d_year between 1999 and 2001) x)\nselect channel, i_brand_id, i_class_id, i_category_id, sum(sales),\n       sum(number_sales)\nfrom (\nselect 'store' channel, i_brand_id, i_class_id, i_category_id,\n       sum(ss_quantity * ss_list_price) sales, count(*) number_sales\nfrom store_sales, item, date_dim\nwhere ss_item_sk in (select ss_item_sk from cross_items)\n  and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk\n  and d_year = 2001 and d_moy = 11\ngroup by i_brand_id, i_class_id, i_category_id\nhaving sum(ss_quantity * ss_list_price) > (select average_sales from avg_sales)\n\n      union all\n      \nselect 'catalog' channel, i_brand_id, i_class_id, i_category_id,\n       sum(cs_quantity * cs_list_price) sales, count(*) number_sales\nfrom catalog_sales, item, date_dim\nwhere cs_item_sk in (select ss_item_sk from cross_items)\n  and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk\n  and d_year = 2001 and d_moy = 11\ngroup by i_brand_id, i_class_id, i_category_id\nhaving sum(cs_quantity * cs_list_price) > (select average_sales from avg_sales)\n\n      union all\n      \nselect 'web' channel, i_brand_id, i_class_id, i_category_id,\n       sum(ws_quantity * ws_list_price) sales, count(*) number_sales\nfrom web_sales, item, date_dim\nwhere ws_item_sk in (select ss_item_sk from cross_items)\n  and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk\n  and d_year = 2001 and d_moy = 11\ngroup by i_brand_id, i_class_id, i_category_id\nhaving sum(ws_quantity * ws_list_price) > (select average_sales from avg_sales)\n\n     ) y\ngroup by rollup (channel, i_brand_id, i_class_id, i_category_id)\norder by channel nulls first, i_brand_id nulls first,\n         i_class_id nulls first, i_category_id nulls first\nlimit 100\n",
+    17: '\nselect i_item_id, i_item_desc, s_state,\n       count(ss_quantity) store_sales_quantitycount,\n       avg(ss_quantity) store_sales_quantityave,\n       stddev_samp(ss_quantity) store_sales_quantitystdev,\n       stddev_samp(ss_quantity) / avg(ss_quantity) store_sales_quantitycov,\n       count(sr_return_quantity) store_returns_quantitycount,\n       avg(sr_return_quantity) store_returns_quantityave,\n       stddev_samp(sr_return_quantity) store_returns_quantitystdev,\n       stddev_samp(sr_return_quantity) / avg(sr_return_quantity) store_returns_quantitycov,\n       count(cs_quantity) catalog_sales_quantitycount,\n       avg(cs_quantity) catalog_sales_quantityave,\n       stddev_samp(cs_quantity) catalog_sales_quantitystdev,\n       stddev_samp(cs_quantity) / avg(cs_quantity) catalog_sales_quantitycov\nfrom store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,\n     date_dim d3, store, item\nwhere d1.d_year = 2000 and d1.d_qoy = 1\n  and d1.d_date_sk = ss_sold_date_sk\n  and i_item_sk = ss_item_sk\n  and s_store_sk = ss_store_sk\n  and ss_customer_sk = sr_customer_sk\n  and ss_item_sk = sr_item_sk\n  and ss_ticket_number = sr_ticket_number\n  and sr_returned_date_sk = d2.d_date_sk\n  and d2.d_year = 2000 and d2.d_qoy between 1 and 3\n  and sr_item_sk = cs_item_sk\n  and cs_sold_date_sk = d3.d_date_sk\n  and d3.d_year = 2000 and d3.d_qoy between 1 and 3\ngroup by i_item_id, i_item_desc, s_state\norder by i_item_id, i_item_desc, s_state\nlimit 100\n',
+    23: '\nwith frequent_ss_items as (\n  select substr(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,\n         d_month_seq seq, count(*) cnt\n  from store_sales, date_dim, item\n  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n    and d_year in (2000, 2001, 2002, 2003)\n  group by substr(i_item_desc, 1, 30), i_item_sk, d_month_seq\n  having count(*) > 2),\n max_store_sales as (\n  select max(csales) tpcds_cmax\n  from (select c_customer_sk, sum(ss_quantity * ss_sales_price) csales\n        from store_sales, customer, date_dim\n        where ss_customer_sk = c_customer_sk and ss_sold_date_sk = d_date_sk\n          and d_year in (2000, 2001, 2002, 2003)\n        group by c_customer_sk) t),\n best_ss_customer as (\n  select c_customer_sk, sum(ss_quantity * ss_sales_price) ssales\n  from store_sales, customer\n  where ss_customer_sk = c_customer_sk\n  group by c_customer_sk\n  having sum(ss_quantity * ss_sales_price) >\n         0.5 * (select tpcds_cmax from max_store_sales))\nselect sum(sales)\nfrom (select cs_quantity * cs_list_price sales\n      from catalog_sales, date_dim\n      where d_year = 2000 and d_moy = 2 and cs_sold_date_sk = d_date_sk\n        and cs_item_sk in (select item_sk from frequent_ss_items)\n        and cs_bill_customer_sk in (select c_customer_sk from best_ss_customer)\n      union all\n      select ws_quantity * ws_list_price sales\n      from web_sales, date_dim\n      where d_year = 2000 and d_moy = 2 and ws_sold_date_sk = d_date_sk\n        and ws_item_sk in (select item_sk from frequent_ss_items)\n        and ws_bill_customer_sk in (select c_customer_sk from best_ss_customer)\n     ) x\nlimit 100\n',
+    24: "\nwith ssales as (\n  select c_last_name, c_first_name, s_store_name, ca_state, s_state,\n         i_color, i_current_price, i_manager_id, i_size,\n         sum(ss_net_paid) netpaid\n  from store_sales, store_returns, store, item, customer, customer_address\n  where ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk\n    and ss_customer_sk = c_customer_sk and ss_item_sk = i_item_sk\n    and ss_store_sk = s_store_sk and c_current_addr_sk = ca_address_sk\n    and s_zip = ca_zip\n  group by c_last_name, c_first_name, s_store_name, ca_state, s_state,\n           i_color, i_current_price, i_manager_id, i_size)\nselect c_last_name, c_first_name, s_store_name, sum(netpaid) paid\nfrom ssales\nwhere i_color = 'white'\ngroup by c_last_name, c_first_name, s_store_name\nhaving sum(netpaid) > (select 0.05 * avg(netpaid) from ssales)\norder by c_last_name, c_first_name, s_store_name\n",
+    31: '\nwith ss as (\n  select ca_county, d_qoy, d_year, sum(ss_ext_sales_price) store_sales\n  from store_sales, date_dim, customer_address\n  where ss_sold_date_sk = d_date_sk and ss_addr_sk = ca_address_sk\n  group by ca_county, d_qoy, d_year),\n ws as (\n  select ca_county, d_qoy, d_year, sum(ws_ext_sales_price) web_sales\n  from web_sales, date_dim, customer_address\n  where ws_sold_date_sk = d_date_sk and ws_bill_addr_sk = ca_address_sk\n  group by ca_county, d_qoy, d_year)\nselect ss1.ca_county, ss1.d_year,\n       cast(ws2.web_sales as double) / ws1.web_sales web_q1_q2_increase,\n       cast(ss2.store_sales as double) / ss1.store_sales store_q1_q2_increase,\n       cast(ws3.web_sales as double) / ws2.web_sales web_q2_q3_increase,\n       cast(ss3.store_sales as double) / ss2.store_sales store_q2_q3_increase\nfrom ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3\nwhere ss1.d_qoy = 1 and ss1.d_year = 2000\n  and ss1.ca_county = ss2.ca_county and ss2.d_qoy = 2 and ss2.d_year = 2000\n  and ss2.ca_county = ss3.ca_county and ss3.d_qoy = 3 and ss3.d_year = 2000\n  and ss1.ca_county = ws1.ca_county and ws1.d_qoy = 1 and ws1.d_year = 2000\n  and ws1.ca_county = ws2.ca_county and ws2.d_qoy = 2 and ws2.d_year = 2000\n  and ws1.ca_county = ws3.ca_county and ws3.d_qoy = 3 and ws3.d_year = 2000\n  and case when ws1.web_sales > 0 then cast(ws2.web_sales as double) / ws1.web_sales else null end\n      > case when ss1.store_sales > 0 then cast(ss2.store_sales as double) / ss1.store_sales else null end\norder by ss1.ca_county\n',
+    39: '\nwith inv as (\n  select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy, stdev, mean,\n         case when mean = 0 then null else stdev / mean end cov\n  from (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,\n               stddev_samp(inv_quantity_on_hand) stdev,\n               avg(inv_quantity_on_hand) mean\n        from inventory, item, warehouse, date_dim\n        where inv_item_sk = i_item_sk\n          and inv_warehouse_sk = w_warehouse_sk\n          and inv_date_sk = d_date_sk\n          and d_year = 1998\n        group by w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy) foo\n  where case when mean = 0 then 0 else stdev / mean end > 0.6)\nselect inv1.w_warehouse_sk wsk1, inv1.i_item_sk isk1, inv1.d_moy moy1,\n       inv1.mean mean1, inv1.cov cov1,\n       inv2.w_warehouse_sk wsk2, inv2.i_item_sk isk2, inv2.d_moy moy2,\n       inv2.mean mean2, inv2.cov cov2\nfrom inv inv1, inv inv2\nwhere inv1.i_item_sk = inv2.i_item_sk\n  and inv1.w_warehouse_sk = inv2.w_warehouse_sk\n  and inv1.d_moy = 1 and inv2.d_moy = 2\norder by inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean, inv1.cov,\n         inv2.d_moy, inv2.mean, inv2.cov\n',
+    44: '\nselect asceding.rnk, i1.i_item_id best_performing, i2.i_item_id worst_performing\nfrom\n (select item_sk, rnk from (\n    select item_sk, rank() over (order by rank_col asc) rnk from (\n      select ss_item_sk item_sk, avg(ss_net_profit) rank_col\n      from store_sales where ss_store_sk = 4\n      group by ss_item_sk\n      having avg(ss_net_profit) > 0.9 * (\n        select avg(ss_net_profit) rank_col from store_sales\n        where ss_store_sk = 4 and ss_quantity > 90\n        group by ss_store_sk)) v1) v11\n  where rnk < 11) asceding,\n (select item_sk, rnk from (\n    select item_sk, rank() over (order by rank_col desc) rnk from (\n      select ss_item_sk item_sk, avg(ss_net_profit) rank_col\n      from store_sales where ss_store_sk = 4\n      group by ss_item_sk\n      having avg(ss_net_profit) > 0.9 * (\n        select avg(ss_net_profit) rank_col from store_sales\n        where ss_store_sk = 4 and ss_quantity > 90\n        group by ss_store_sk)) v2) v21\n  where rnk < 11) descending,\n item i1, item i2\nwhere asceding.rnk = descending.rnk\n  and i1.i_item_sk = asceding.item_sk\n  and i2.i_item_sk = descending.item_sk\norder by asceding.rnk\n',
+    54: "\nwith my_customers as (\n  select distinct c_customer_sk, c_current_addr_sk\n  from (select cs_sold_date_sk sold_date_sk,\n               cs_bill_customer_sk customer_sk, cs_item_sk item_sk\n        from catalog_sales\n        union all\n        select ws_sold_date_sk, ws_bill_customer_sk, ws_item_sk\n        from web_sales) cs_or_ws_sales, item, date_dim, customer\n  where sold_date_sk = d_date_sk and item_sk = i_item_sk\n    and i_category = 'Sports'\n    and c_customer_sk = customer_sk\n    and d_moy = 12 and d_year = 1998),\n my_revenue as (\n  select c_customer_sk, sum(ss_ext_sales_price) revenue\n  from my_customers, store_sales, customer_address, store, date_dim\n  where c_current_addr_sk = ca_address_sk\n    and ca_state = s_state\n    and ss_customer_sk = c_customer_sk\n    and ss_sold_date_sk = d_date_sk\n    and d_month_seq >= (select distinct d_month_seq + 1 from date_dim\n                        where d_year = 1998 and d_moy = 12)\n    and d_month_seq <= (select distinct d_month_seq + 12 from date_dim\n                        where d_year = 1998 and d_moy = 12)\n  group by c_customer_sk),\n segments as (select floor(revenue / 50) segment from my_revenue)\nselect segment, count(*) num_customers, segment * 50 segment_base\nfrom segments\ngroup by segment\norder by segment, num_customers\nlimit 100\n",
+    58: "\nwith ss_items as (\n  select i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev\n  from store_sales, item, date_dim\n  where ss_item_sk = i_item_sk\n    and d_year = (select d_year from date_dim\n                  where d_date = date '2000-02-02')\n    and ss_sold_date_sk = d_date_sk\n  group by i_item_id),\n cs_items as (\n  select i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev\n  from catalog_sales, item, date_dim\n  where cs_item_sk = i_item_sk\n    and d_year = (select d_year from date_dim\n                  where d_date = date '2000-02-02')\n    and cs_sold_date_sk = d_date_sk\n  group by i_item_id),\n ws_items as (\n  select i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev\n  from web_sales, item, date_dim\n  where ws_item_sk = i_item_sk\n    and d_year = (select d_year from date_dim\n                  where d_date = date '2000-02-02')\n    and ws_sold_date_sk = d_date_sk\n  group by i_item_id)\nselect ss_items.item_id,\n       ss_item_rev,\n       cast(ss_item_rev as double) / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 ss_dev,\n       cs_item_rev,\n       cast(cs_item_rev as double) / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 cs_dev,\n       ws_item_rev,\n       cast(ws_item_rev as double) / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 ws_dev,\n       (ss_item_rev + cs_item_rev + ws_item_rev) / 3 average\nfrom ss_items, cs_items, ws_items\nwhere ss_items.item_id = cs_items.item_id\n  and ss_items.item_id = ws_items.item_id\n  and ss_item_rev >= 0.1 * cs_item_rev and ss_item_rev <= 1.9 * cs_item_rev\n  and ss_item_rev >= 0.1 * ws_item_rev and ss_item_rev <= 1.9 * ws_item_rev\n  and cs_item_rev >= 0.1 * ss_item_rev and cs_item_rev <= 1.9 * ss_item_rev\n  and cs_item_rev >= 0.1 * ws_item_rev and cs_item_rev <= 1.9 * ws_item_rev\n  and ws_item_rev >= 0.1 * ss_item_rev and ws_item_rev <= 1.9 * ss_item_rev\n  and ws_item_rev >= 0.1 * cs_item_rev and ws_item_rev <= 1.9 * cs_item_rev\norder by ss_items.item_id, ss_item_rev\nlimit 100\n",
+    64: '\nwith cs_ui as (\n  select cs_item_sk,\n         sum(cs_ext_list_price) as sale,\n         sum(cr_return_amount) as refund\n  from catalog_sales, catalog_returns\n  where cs_item_sk = cr_item_sk and cs_order_number = cr_order_number\n  group by cs_item_sk\n  having sum(cs_ext_list_price) > 2 * sum(cr_return_amount)),\n cross_sales as (\n  select i_item_id item_id, i_item_sk item_sk, s_store_name store_name,\n         s_zip store_zip, d1.d_year syear,\n         count(*) cnt,\n         sum(ss_wholesale_cost) s1, sum(ss_list_price) s2,\n         sum(ss_coupon_amt) s3\n  from store_sales, store_returns, cs_ui, date_dim d1, store, item,\n       customer, customer_address ad2, date_dim d2\n  where ss_store_sk = s_store_sk\n    and ss_sold_date_sk = d1.d_date_sk\n    and ss_customer_sk = c_customer_sk\n    and ss_item_sk = i_item_sk\n    and ss_item_sk = sr_item_sk\n    and ss_ticket_number = sr_ticket_number\n    and ss_item_sk = cs_ui.cs_item_sk\n    and c_current_addr_sk = ad2.ca_address_sk\n    and c_first_sales_date_sk = d2.d_date_sk\n  group by i_item_id, i_item_sk, s_store_name, s_zip, d1.d_year)\nselect cs1.item_id, cs1.store_name, cs1.store_zip, cs1.syear, cs1.cnt,\n       cs1.s1 as s11, cs1.s2 as s21, cs1.s3 as s31,\n       cs2.s1 as s12, cs2.s2 as s22, cs2.s3 as s32, cs2.syear as syear2,\n       cs2.cnt as cnt2\nfrom cross_sales cs1, cross_sales cs2\nwhere cs1.item_sk = cs2.item_sk\n  and cs1.syear + 1 = cs2.syear\n  and cs2.cnt <= cs1.cnt + 5\norder by cs1.item_id, cs1.store_name, cs1.store_zip, cs1.syear, cs1.cnt,\n         s11, s21, s31, s12, s22, s32, syear2, cnt2\nlimit 100\n',
+    66: "\nselect w_warehouse_name, w_warehouse_sq_ft, w_state, ship_carriers, year_,\n       sum(jan_sales) jan_sales, sum(feb_sales) feb_sales,\n       sum(mar_sales) mar_sales, sum(apr_sales) apr_sales,\n       sum(may_sales) may_sales, sum(jun_sales) jun_sales,\n       sum(jul_sales) jul_sales, sum(aug_sales) aug_sales,\n       sum(sep_sales) sep_sales, sum(oct_sales) oct_sales,\n       sum(nov_sales) nov_sales, sum(dec_sales) dec_sales\nfrom (\n  select w_warehouse_name, w_warehouse_sq_ft, w_state,\n         'DHL,BARIAN' as ship_carriers, d_year as year_,\n         sum(case when d_moy = 1 then ws_ext_sales_price * ws_quantity else 0 end) as jan_sales,\n         sum(case when d_moy = 2 then ws_ext_sales_price * ws_quantity else 0 end) as feb_sales,\n         sum(case when d_moy = 3 then ws_ext_sales_price * ws_quantity else 0 end) as mar_sales,\n         sum(case when d_moy = 4 then ws_ext_sales_price * ws_quantity else 0 end) as apr_sales,\n         sum(case when d_moy = 5 then ws_ext_sales_price * ws_quantity else 0 end) as may_sales,\n         sum(case when d_moy = 6 then ws_ext_sales_price * ws_quantity else 0 end) as jun_sales,\n         sum(case when d_moy = 7 then ws_ext_sales_price * ws_quantity else 0 end) as jul_sales,\n         sum(case when d_moy = 8 then ws_ext_sales_price * ws_quantity else 0 end) as aug_sales,\n         sum(case when d_moy = 9 then ws_ext_sales_price * ws_quantity else 0 end) as sep_sales,\n         sum(case when d_moy = 10 then ws_ext_sales_price * ws_quantity else 0 end) as oct_sales,\n         sum(case when d_moy = 11 then ws_ext_sales_price * ws_quantity else 0 end) as nov_sales,\n         sum(case when d_moy = 12 then ws_ext_sales_price * ws_quantity else 0 end) as dec_sales\n  from web_sales, warehouse, date_dim, time_dim, ship_mode\n  where ws_warehouse_sk = w_warehouse_sk\n    and ws_sold_date_sk = d_date_sk and d_year = 2000\n    and ws_sold_time_sk = t_time_sk\n    and ws_ship_mode_sk = sm_ship_mode_sk\n    and t_time between 30838 and 30838 + 28800\n    and sm_carrier in ('DHL', 'BARIAN')\n  group by w_warehouse_name, w_warehouse_sq_ft, w_state, d_year\n  union all\n  select w_warehouse_name, w_warehouse_sq_ft, w_state,\n         'DHL,BARIAN' as ship_carriers, d_year as year_,\n         sum(case when d_moy = 1 then cs_sales_price * cs_quantity else 0 end) as jan_sales,\n         sum(case when d_moy = 2 then cs_sales_price * cs_quantity else 0 end) as feb_sales,\n         sum(case when d_moy = 3 then cs_sales_price * cs_quantity else 0 end) as mar_sales,\n         sum(case when d_moy = 4 then cs_sales_price * cs_quantity else 0 end) as apr_sales,\n         sum(case when d_moy = 5 then cs_sales_price * cs_quantity else 0 end) as may_sales,\n         sum(case when d_moy = 6 then cs_sales_price * cs_quantity else 0 end) as jun_sales,\n         sum(case when d_moy = 7 then cs_sales_price * cs_quantity else 0 end) as jul_sales,\n         sum(case when d_moy = 8 then cs_sales_price * cs_quantity else 0 end) as aug_sales,\n         sum(case when d_moy = 9 then cs_sales_price * cs_quantity else 0 end) as sep_sales,\n         sum(case when d_moy = 10 then cs_sales_price * cs_quantity else 0 end) as oct_sales,\n         sum(case when d_moy = 11 then cs_sales_price * cs_quantity else 0 end) as nov_sales,\n         sum(case when d_moy = 12 then cs_sales_price * cs_quantity else 0 end) as dec_sales\n  from catalog_sales, warehouse, date_dim, time_dim, ship_mode\n  where cs_warehouse_sk = w_warehouse_sk\n    and cs_sold_date_sk = d_date_sk and d_year = 2000\n    and cs_sold_time_sk = t_time_sk\n    and cs_ship_mode_sk = sm_ship_mode_sk\n    and t_time between 30838 and 30838 + 28800\n    and sm_carrier in ('DHL', 'BARIAN')\n  group by w_warehouse_name, w_warehouse_sq_ft, w_state, d_year\n ) x\ngroup by w_warehouse_name, w_warehouse_sq_ft, w_state, ship_carriers, year_\norder by w_warehouse_name\nlimit 100\n",
+    67: '\nselect * from (\n  select i_category, i_class, i_brand, i_item_id, d_year, d_qoy, d_moy,\n         s_store_id, sumsales,\n         rank() over (partition by i_category order by sumsales desc) rk\n  from (\nselect i_category, i_class, i_brand, i_item_id, d_year, d_qoy, d_moy,\n       s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by rollup(i_category, i_class, i_brand, i_item_id, d_year, d_qoy,\n                d_moy, s_store_id)\n) dw1) dw2\nwhere rk <= 10\norder by i_category nulls first, i_class nulls first,\n         i_brand nulls first, i_item_id nulls first, d_year nulls first,\n         d_qoy nulls first, d_moy nulls first, s_store_id nulls first,\n         sumsales nulls first, rk\nlimit 100\n',
+    72: '\nselect i_item_desc, w_warehouse_name, d1.d_week_seq,\n       sum(case when p_promo_sk is null then 1 else 0 end) no_promo,\n       sum(case when p_promo_sk is not null then 1 else 0 end) promo,\n       count(*) total_cnt\nfrom catalog_sales\njoin inventory on (cs_item_sk = inv_item_sk)\njoin warehouse on (w_warehouse_sk = inv_warehouse_sk)\njoin item on (i_item_sk = cs_item_sk)\njoin customer_demographics on (cs_bill_cdemo_sk = cd_demo_sk)\njoin household_demographics on (cs_bill_hdemo_sk = hd_demo_sk)\njoin date_dim d1 on (cs_sold_date_sk = d1.d_date_sk)\njoin date_dim d2 on (inv_date_sk = d2.d_date_sk)\njoin date_dim d3 on (cs_ship_date_sk = d3.d_date_sk)\nleft outer join promotion on (cs_promo_sk = p_promo_sk)\nleft outer join catalog_returns on (cr_item_sk = cs_item_sk and cr_order_number = cs_order_number)\nwhere d1.d_week_seq = d2.d_week_seq\n  and inv_quantity_on_hand < cs_quantity + 500\n  and d3.d_date > d1.d_date + 2\n  and d1.d_year between 1998 and 2002\ngroup by i_item_desc, w_warehouse_name, d1.d_week_seq\norder by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq\nlimit 100\n',
+    75: "\nwith all_sales as (\n  select d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,\n         sum(sales_cnt) sales_cnt, sum(sales_amt) sales_amt\n  from (\nselect d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,\n       cs_quantity - coalesce(cr_return_quantity, 0) sales_cnt,\n       cs_ext_sales_price - coalesce(cr_return_amount, 0.0) sales_amt\nfrom catalog_sales join item on i_item_sk = cs_item_sk\n             join date_dim on d_date_sk = cs_sold_date_sk\n             left join catalog_returns on (cs_order_number = cr_order_number and cs_item_sk = cr_item_sk)\nwhere i_category = 'Sports'\n\n        union all\n        \nselect d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,\n       ss_quantity - coalesce(sr_return_quantity, 0) sales_cnt,\n       ss_ext_sales_price - coalesce(sr_return_amt, 0.0) sales_amt\nfrom store_sales join item on i_item_sk = ss_item_sk\n             join date_dim on d_date_sk = ss_sold_date_sk\n             left join store_returns on (ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk)\nwhere i_category = 'Sports'\n\n        union all\n        \nselect d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,\n       ws_quantity - coalesce(wr_return_quantity, 0) sales_cnt,\n       ws_ext_sales_price - coalesce(wr_return_amt, 0.0) sales_amt\nfrom web_sales join item on i_item_sk = ws_item_sk\n             join date_dim on d_date_sk = ws_sold_date_sk\n             left join web_returns on (ws_order_number = wr_order_number and ws_item_sk = wr_item_sk)\nwhere i_category = 'Sports'\n) sales_detail\n  group by d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)\nselect prev_yr.d_year prev_year, curr_yr.d_year year_,\n       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,\n       curr_yr.i_manufact_id,\n       prev_yr.sales_cnt prev_yr_cnt, curr_yr.sales_cnt curr_yr_cnt,\n       curr_yr.sales_cnt - prev_yr.sales_cnt sales_cnt_diff,\n       curr_yr.sales_amt - prev_yr.sales_amt sales_amt_diff\nfrom all_sales curr_yr, all_sales prev_yr\nwhere curr_yr.i_brand_id = prev_yr.i_brand_id\n  and curr_yr.i_class_id = prev_yr.i_class_id\n  and curr_yr.i_category_id = prev_yr.i_category_id\n  and curr_yr.i_manufact_id = prev_yr.i_manufact_id\n  and curr_yr.d_year = 2001 and prev_yr.d_year = 2000\n  and cast(curr_yr.sales_cnt as double) / prev_yr.sales_cnt < 0.9\norder by sales_cnt_diff, sales_amt_diff\nlimit 100\n",
+    78: '\nwith ws as (\n  \nselect d_year ws_sold_year, ws_item_sk ws_item_sk, ws_bill_customer_sk ws_customer_sk,\n       sum(ws_quantity) ws_qty, sum(ws_wholesale_cost) ws_wc, sum(ws_sales_price) ws_sp\nfrom web_sales\nleft join web_returns on wr_order_number = ws_order_number and ws_item_sk = wr_item_sk\njoin date_dim on ws_sold_date_sk = d_date_sk\nwhere wr_order_number is null\ngroup by d_year, ws_item_sk, ws_bill_customer_sk\n),\n cs as (\n  \nselect d_year cs_sold_year, cs_item_sk cs_item_sk, cs_bill_customer_sk cs_customer_sk,\n       sum(cs_quantity) cs_qty, sum(cs_wholesale_cost) cs_wc, sum(cs_sales_price) cs_sp\nfrom catalog_sales\nleft join catalog_returns on cr_order_number = cs_order_number and cs_item_sk = cr_item_sk\njoin date_dim on cs_sold_date_sk = d_date_sk\nwhere cr_order_number is null\ngroup by d_year, cs_item_sk, cs_bill_customer_sk\n),\n ss as (\n  \nselect d_year ss_sold_year, ss_item_sk ss_item_sk, ss_customer_sk ss_customer_sk,\n       sum(ss_quantity) ss_qty, sum(ss_wholesale_cost) ss_wc, sum(ss_sales_price) ss_sp\nfrom store_sales\nleft join store_returns on sr_ticket_number = ss_ticket_number and ss_item_sk = sr_item_sk\njoin date_dim on ss_sold_date_sk = d_date_sk\nwhere sr_ticket_number is null\ngroup by d_year, ss_item_sk, ss_customer_sk\n)\nselect ss_sold_year, ss_item_sk, ss_customer_sk,\n       round(cast(ss_qty as double) / (coalesce(ws_qty, 0) + coalesce(cs_qty, 0)), 2) ratio,\n       ss_qty store_qty, ss_wc store_wholesale_cost, ss_sp store_sales_price,\n       coalesce(ws_qty, 0) + coalesce(cs_qty, 0) other_chan_qty,\n       coalesce(ws_wc, 0) + coalesce(cs_wc, 0) other_chan_wholesale_cost,\n       coalesce(ws_sp, 0) + coalesce(cs_sp, 0) other_chan_sales_price\nfrom ss\nleft join ws on (ws_sold_year = ss_sold_year and ws_item_sk = ss_item_sk\n                 and ws_customer_sk = ss_customer_sk)\nleft join cs on (cs_sold_year = ss_sold_year and cs_item_sk = ss_item_sk\n                 and cs_customer_sk = ss_customer_sk)\nwhere (coalesce(ws_qty, 0) > 0 or coalesce(cs_qty, 0) > 0)\n  and ss_sold_year = 2000\norder by ss_sold_year, ss_item_sk, ss_customer_sk, ss_qty desc, ss_wc desc,\n         ss_sp desc, other_chan_qty, other_chan_wholesale_cost,\n         other_chan_sales_price, ratio\nlimit 100\n',
+    80: "\nwith ssr as (\n  select s_store_id as store_id,\n         sum(ss_ext_sales_price) as sales,\n         sum(coalesce(sr_return_amt, 0)) as returns_,\n         sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit\n  from store_sales\n  left outer join store_returns\n    on (ss_item_sk = sr_item_sk and ss_ticket_number = sr_ticket_number)\n  join date_dim on ss_sold_date_sk = d_date_sk\n  join store on ss_store_sk = s_store_sk\n  join item on ss_item_sk = i_item_sk\n  join promotion on ss_promo_sk = p_promo_sk\n  where d_date between date '2000-08-23' and date '2000-09-22'\n    and i_current_price > 50\n    and p_channel_tv = 'N'\n  group by s_store_id),\n csr as (\n  select cp_catalog_page_id as catalog_page_id,\n         sum(cs_ext_sales_price) as sales,\n         sum(coalesce(cr_return_amount, 0)) as returns_,\n         sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit\n  from catalog_sales\n  left outer join catalog_returns\n    on (cs_item_sk = cr_item_sk and cs_order_number = cr_order_number)\n  join date_dim on cs_sold_date_sk = d_date_sk\n  join catalog_page on cs_catalog_page_sk = cp_catalog_page_sk\n  join item on cs_item_sk = i_item_sk\n  join promotion on cs_promo_sk = p_promo_sk\n  where d_date between date '2000-08-23' and date '2000-09-22'\n    and i_current_price > 50\n    and p_channel_tv = 'N'\n  group by cp_catalog_page_id),\n wsr as (\n  select web_site_id,\n         sum(ws_ext_sales_price) as sales,\n         sum(coalesce(wr_return_amt, 0)) as returns_,\n         sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit\n  from web_sales\n  left outer join web_returns\n    on (ws_item_sk = wr_item_sk and ws_order_number = wr_order_number)\n  join date_dim on ws_sold_date_sk = d_date_sk\n  join web_site on ws_web_site_sk = web_site_sk\n  join item on ws_item_sk = i_item_sk\n  join promotion on ws_promo_sk = p_promo_sk\n  where d_date between date '2000-08-23' and date '2000-09-22'\n    and i_current_price > 50\n    and p_channel_tv = 'N'\n  group by web_site_id)\nselect channel, id, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit\nfrom (select 'store channel' as channel, store_id as id, sales, returns_,\n             profit\n      from ssr\n      union all\n      select 'catalog channel', catalog_page_id, sales, returns_, profit\n      from csr\n      union all\n      select 'web channel', web_site_id, sales, returns_, profit\n      from wsr) x\ngroup by rollup (channel, id)\norder by channel nulls first, id nulls first\nlimit 100\n",
+})
+
+# sqlite lacks stddev_samp (q17/q39: closed form over sums) and
+# ROLLUP (q5/q14/q67/q80: grouping-set union expansion)
+ORACLE_OVERRIDES.update({
+    5: "\nwith ssr as (\nselect s_store_id,\n       sum(sales_price) as sales, sum(profit) as profit,\n       sum(return_amt) as returns_, sum(net_loss) as profit_loss\nfrom (select ss_store_sk as store_sk, ss_sold_date_sk as date_sk,\n             ss_ext_sales_price as sales_price, ss_net_profit as profit,\n             cast(0 as decimal(12,2)) as return_amt,\n             cast(0 as decimal(12,2)) as net_loss\n      from store_sales\n      union all\n      select sr_store_sk, sr_returned_date_sk,\n             cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),\n             sr_return_amt, sr_net_loss\n      from store_returns) salesreturns, date_dim, store\nwhere date_sk = d_date_sk\n  and d_date between date '2000-08-23' and date '2000-09-06'\n  and store_sk = s_store_sk\ngroup by s_store_id\n), csr as (\nselect cc_call_center_id,\n       sum(sales_price) as sales, sum(profit) as profit,\n       sum(return_amt) as returns_, sum(net_loss) as profit_loss\nfrom (select cs_call_center_sk as center_sk, cs_sold_date_sk as date_sk,\n             cs_ext_sales_price as sales_price, cs_net_profit as profit,\n             cast(0 as decimal(12,2)) as return_amt,\n             cast(0 as decimal(12,2)) as net_loss\n      from catalog_sales\n      union all\n      select cr_call_center_sk, cr_returned_date_sk,\n             cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),\n             cr_return_amount, cr_net_loss\n      from catalog_returns) salesreturns, date_dim, call_center\nwhere date_sk = d_date_sk\n  and d_date between date '2000-08-23' and date '2000-09-06'\n  and center_sk = cc_call_center_sk\ngroup by cc_call_center_id\n), wsr as (\nselect web_site_id,\n       sum(sales_price) as sales, sum(profit) as profit,\n       sum(return_amt) as returns_, sum(net_loss) as profit_loss\nfrom (select ws_web_site_sk as wsr_web_site_sk, ws_sold_date_sk as date_sk,\n             ws_ext_sales_price as sales_price, ws_net_profit as profit,\n             cast(0 as decimal(12,2)) as return_amt,\n             cast(0 as decimal(12,2)) as net_loss\n      from web_sales\n      union all\n      select ws_web_site_sk, wr_returned_date_sk,\n             cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),\n             wr_return_amt, wr_net_loss\n      from web_returns\n      left outer join web_sales on (wr_item_sk = ws_item_sk\n                                    and wr_order_number = ws_order_number)\n     ) salesreturns, date_dim, web_site\nwhere date_sk = d_date_sk\n  and d_date between date '2000-08-23' and date '2000-09-06'\n  and wsr_web_site_sk = web_site_sk\ngroup by web_site_id\n), xsrc as (select 'store channel' as channel, s_store_id as id, sales, returns_,\n             profit - profit_loss as profit\n      from ssr\n      union all\n      select 'catalog channel', cc_call_center_id, sales, returns_,\n             profit - profit_loss\n      from csr\n      union all\n      select 'web channel', web_site_id, sales, returns_,\n             profit - profit_loss\n      from wsr)\nselect channel, id, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit from xsrc group by channel, id\nunion all\nselect channel, null, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit from xsrc group by channel\nunion all\nselect null, null, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit from xsrc\norder by channel nulls first, id nulls first\nlimit 100\n",
+    14: "\nwith cross_items as (\n  select i_item_sk ss_item_sk\n  from item,\n   (select iss.i_brand_id brand_id, iss.i_class_id class_id,\n           iss.i_category_id category_id\n    from store_sales, item iss, date_dim d1\n    where ss_item_sk = iss.i_item_sk and ss_sold_date_sk = d1.d_date_sk\n      and d1.d_year between 1999 and 2001\n    intersect\n    select ics.i_brand_id, ics.i_class_id, ics.i_category_id\n    from catalog_sales, item ics, date_dim d2\n    where cs_item_sk = ics.i_item_sk and cs_sold_date_sk = d2.d_date_sk\n      and d2.d_year between 1999 and 2001\n    intersect\n    select iws.i_brand_id, iws.i_class_id, iws.i_category_id\n    from web_sales, item iws, date_dim d3\n    where ws_item_sk = iws.i_item_sk and ws_sold_date_sk = d3.d_date_sk\n      and d3.d_year between 1999 and 2001) x\n  where i_brand_id = brand_id and i_class_id = class_id\n    and i_category_id = category_id),\n avg_sales as (\n  select avg(quantity * list_price) average_sales\n  from (select ss_quantity quantity, ss_list_price list_price\n        from store_sales, date_dim\n        where ss_sold_date_sk = d_date_sk and d_year between 1999 and 2001\n        union all\n        select cs_quantity, cs_list_price\n        from catalog_sales, date_dim\n        where cs_sold_date_sk = d_date_sk and d_year between 1999 and 2001\n        union all\n        select ws_quantity, ws_list_price\n        from web_sales, date_dim\n        where ws_sold_date_sk = d_date_sk and d_year between 1999 and 2001) x), ysrc as (\nselect 'store' channel, i_brand_id, i_class_id, i_category_id,\n       sum(ss_quantity * ss_list_price) sales, count(*) number_sales\nfrom store_sales, item, date_dim\nwhere ss_item_sk in (select ss_item_sk from cross_items)\n  and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk\n  and d_year = 2001 and d_moy = 11\ngroup by i_brand_id, i_class_id, i_category_id\nhaving sum(ss_quantity * ss_list_price) > (select average_sales from avg_sales)\n\n      union all\n      \nselect 'catalog' channel, i_brand_id, i_class_id, i_category_id,\n       sum(cs_quantity * cs_list_price) sales, count(*) number_sales\nfrom catalog_sales, item, date_dim\nwhere cs_item_sk in (select ss_item_sk from cross_items)\n  and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk\n  and d_year = 2001 and d_moy = 11\ngroup by i_brand_id, i_class_id, i_category_id\nhaving sum(cs_quantity * cs_list_price) > (select average_sales from avg_sales)\n\n      union all\n      \nselect 'web' channel, i_brand_id, i_class_id, i_category_id,\n       sum(ws_quantity * ws_list_price) sales, count(*) number_sales\nfrom web_sales, item, date_dim\nwhere ws_item_sk in (select ss_item_sk from cross_items)\n  and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk\n  and d_year = 2001 and d_moy = 11\ngroup by i_brand_id, i_class_id, i_category_id\nhaving sum(ws_quantity * ws_list_price) > (select average_sales from avg_sales)\n\n     )\nselect channel, i_brand_id, i_class_id, i_category_id, sum(sales) s1, sum(number_sales) s2 from ysrc group by channel, i_brand_id, i_class_id, i_category_id union all select channel, i_brand_id, i_class_id, null as i_category_id, sum(sales) s1, sum(number_sales) s2 from ysrc group by channel, i_brand_id, i_class_id union all select channel, i_brand_id, null as i_class_id, null as i_category_id, sum(sales) s1, sum(number_sales) s2 from ysrc group by channel, i_brand_id union all select channel, null as i_brand_id, null as i_class_id, null as i_category_id, sum(sales) s1, sum(number_sales) s2 from ysrc group by channel union all select null as channel, null as i_brand_id, null as i_class_id, null as i_category_id, sum(sales) s1, sum(number_sales) s2 from ysrc \norder by channel nulls first, i_brand_id nulls first,\n         i_class_id nulls first, i_category_id nulls first\nlimit 100\n",
+    17: '\nselect i_item_id, i_item_desc, s_state,\n       count(ss_quantity) store_sales_quantitycount,\n       avg(ss_quantity) store_sales_quantityave,\n       sqrt((count(ss_quantity)*sum(ss_quantity*ss_quantity) - sum(ss_quantity)*sum(ss_quantity)) * 1.0 / (count(ss_quantity)*(count(ss_quantity)-1.0))) store_sales_quantitystdev,\n       sqrt((count(ss_quantity)*sum(ss_quantity*ss_quantity) - sum(ss_quantity)*sum(ss_quantity)) * 1.0 / (count(ss_quantity)*(count(ss_quantity)-1.0))) / avg(ss_quantity) store_sales_quantitycov,\n       count(sr_return_quantity) store_returns_quantitycount,\n       avg(sr_return_quantity) store_returns_quantityave,\n       sqrt((count(sr_return_quantity)*sum(sr_return_quantity*sr_return_quantity) - sum(sr_return_quantity)*sum(sr_return_quantity)) * 1.0 / (count(sr_return_quantity)*(count(sr_return_quantity)-1.0))) store_returns_quantitystdev,\n       sqrt((count(sr_return_quantity)*sum(sr_return_quantity*sr_return_quantity) - sum(sr_return_quantity)*sum(sr_return_quantity)) * 1.0 / (count(sr_return_quantity)*(count(sr_return_quantity)-1.0))) / avg(sr_return_quantity) store_returns_quantitycov,\n       count(cs_quantity) catalog_sales_quantitycount,\n       avg(cs_quantity) catalog_sales_quantityave,\n       sqrt((count(cs_quantity)*sum(cs_quantity*cs_quantity) - sum(cs_quantity)*sum(cs_quantity)) * 1.0 / (count(cs_quantity)*(count(cs_quantity)-1.0))) catalog_sales_quantitystdev,\n       sqrt((count(cs_quantity)*sum(cs_quantity*cs_quantity) - sum(cs_quantity)*sum(cs_quantity)) * 1.0 / (count(cs_quantity)*(count(cs_quantity)-1.0))) / avg(cs_quantity) catalog_sales_quantitycov\nfrom store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,\n     date_dim d3, store, item\nwhere d1.d_year = 2000 and d1.d_qoy = 1\n  and d1.d_date_sk = ss_sold_date_sk\n  and i_item_sk = ss_item_sk\n  and s_store_sk = ss_store_sk\n  and ss_customer_sk = sr_customer_sk\n  and ss_item_sk = sr_item_sk\n  and ss_ticket_number = sr_ticket_number\n  and sr_returned_date_sk = d2.d_date_sk\n  and d2.d_year = 2000 and d2.d_qoy between 1 and 3\n  and sr_item_sk = cs_item_sk\n  and cs_sold_date_sk = d3.d_date_sk\n  and d3.d_year = 2000 and d3.d_qoy between 1 and 3\ngroup by i_item_id, i_item_desc, s_state\norder by i_item_id, i_item_desc, s_state\nlimit 100\n',
+    39: '\nwith inv as (\n  select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy, stdev, mean,\n         case when mean = 0 then null else stdev / mean end cov\n  from (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,\n               sqrt((count(inv_quantity_on_hand)*sum(inv_quantity_on_hand*inv_quantity_on_hand) - sum(inv_quantity_on_hand)*sum(inv_quantity_on_hand)) * 1.0 / (count(inv_quantity_on_hand)*(count(inv_quantity_on_hand)-1.0))) stdev,\n               avg(inv_quantity_on_hand) mean\n        from inventory, item, warehouse, date_dim\n        where inv_item_sk = i_item_sk\n          and inv_warehouse_sk = w_warehouse_sk\n          and inv_date_sk = d_date_sk\n          and d_year = 1998\n        group by w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy) foo\n  where case when mean = 0 then 0 else stdev / mean end > 0.6)\nselect inv1.w_warehouse_sk wsk1, inv1.i_item_sk isk1, inv1.d_moy moy1,\n       inv1.mean mean1, inv1.cov cov1,\n       inv2.w_warehouse_sk wsk2, inv2.i_item_sk isk2, inv2.d_moy moy2,\n       inv2.mean mean2, inv2.cov cov2\nfrom inv inv1, inv inv2\nwhere inv1.i_item_sk = inv2.i_item_sk\n  and inv1.w_warehouse_sk = inv2.w_warehouse_sk\n  and inv1.d_moy = 1 and inv2.d_moy = 2\norder by inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean, inv1.cov,\n         inv2.d_moy, inv2.mean, inv2.cov\n',
+    67: '\nselect * from (\n  select i_category, i_class, i_brand, i_item_id, d_year, d_qoy, d_moy,\n         s_store_id, sumsales,\n         rank() over (partition by i_category order by sumsales desc) rk\n  from (\nselect i_category, i_class, i_brand, i_item_id, d_year, d_qoy, d_moy, s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category, i_class, i_brand, i_item_id, d_year, d_qoy, d_moy, s_store_id union all \nselect i_category, i_class, i_brand, i_item_id, d_year, d_qoy, d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category, i_class, i_brand, i_item_id, d_year, d_qoy, d_moy union all \nselect i_category, i_class, i_brand, i_item_id, d_year, d_qoy, null as d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category, i_class, i_brand, i_item_id, d_year, d_qoy union all \nselect i_category, i_class, i_brand, i_item_id, d_year, null as d_qoy, null as d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category, i_class, i_brand, i_item_id, d_year union all \nselect i_category, i_class, i_brand, i_item_id, null as d_year, null as d_qoy, null as d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category, i_class, i_brand, i_item_id union all \nselect i_category, i_class, i_brand, null as i_item_id, null as d_year, null as d_qoy, null as d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category, i_class, i_brand union all \nselect i_category, i_class, null as i_brand, null as i_item_id, null as d_year, null as d_qoy, null as d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category, i_class union all \nselect i_category, null as i_class, null as i_brand, null as i_item_id, null as d_year, null as d_qoy, null as d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\ngroup by i_category union all \nselect null as i_category, null as i_class, null as i_brand, null as i_item_id, null as d_year, null as d_qoy, null as d_moy, null as s_store_id, sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales\nfrom store_sales, date_dim, store, item\nwhere ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk\n  and ss_store_sk = s_store_sk\n  and d_month_seq between 1200 and 1211\n) dw1) dw2\nwhere rk <= 10\norder by i_category nulls first, i_class nulls first,\n         i_brand nulls first, i_item_id nulls first, d_year nulls first,\n         d_qoy nulls first, d_moy nulls first, s_store_id nulls first,\n         sumsales nulls first, rk\nlimit 100\n',
+    80: "\nwith ssr as (\n  select s_store_id as store_id,\n         sum(ss_ext_sales_price) as sales,\n         sum(coalesce(sr_return_amt, 0)) as returns_,\n         sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit\n  from store_sales\n  left outer join store_returns\n    on (ss_item_sk = sr_item_sk and ss_ticket_number = sr_ticket_number)\n  join date_dim on ss_sold_date_sk = d_date_sk\n  join store on ss_store_sk = s_store_sk\n  join item on ss_item_sk = i_item_sk\n  join promotion on ss_promo_sk = p_promo_sk\n  where d_date between date '2000-08-23' and date '2000-09-22'\n    and i_current_price > 50\n    and p_channel_tv = 'N'\n  group by s_store_id),\n csr as (\n  select cp_catalog_page_id as catalog_page_id,\n         sum(cs_ext_sales_price) as sales,\n         sum(coalesce(cr_return_amount, 0)) as returns_,\n         sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit\n  from catalog_sales\n  left outer join catalog_returns\n    on (cs_item_sk = cr_item_sk and cs_order_number = cr_order_number)\n  join date_dim on cs_sold_date_sk = d_date_sk\n  join catalog_page on cs_catalog_page_sk = cp_catalog_page_sk\n  join item on cs_item_sk = i_item_sk\n  join promotion on cs_promo_sk = p_promo_sk\n  where d_date between date '2000-08-23' and date '2000-09-22'\n    and i_current_price > 50\n    and p_channel_tv = 'N'\n  group by cp_catalog_page_id),\n wsr as (\n  select web_site_id,\n         sum(ws_ext_sales_price) as sales,\n         sum(coalesce(wr_return_amt, 0)) as returns_,\n         sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit\n  from web_sales\n  left outer join web_returns\n    on (ws_item_sk = wr_item_sk and ws_order_number = wr_order_number)\n  join date_dim on ws_sold_date_sk = d_date_sk\n  join web_site on ws_web_site_sk = web_site_sk\n  join item on ws_item_sk = i_item_sk\n  join promotion on ws_promo_sk = p_promo_sk\n  where d_date between date '2000-08-23' and date '2000-09-22'\n    and i_current_price > 50\n    and p_channel_tv = 'N'\n  group by web_site_id), xsrc as (select 'store channel' as channel, store_id as id, sales, returns_,\n             profit\n      from ssr\n      union all\n      select 'catalog channel', catalog_page_id, sales, returns_, profit\n      from csr\n      union all\n      select 'web channel', web_site_id, sales, returns_, profit\n      from wsr)\nselect channel, id, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit from xsrc group by channel, id\nunion all\nselect channel, null, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit from xsrc group by channel\nunion all\nselect null, null, sum(sales) as sales, sum(returns_) as returns_,\n       sum(profit) as profit from xsrc\norder by channel nulls first, id nulls first\nlimit 100\n",
+})

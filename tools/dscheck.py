@@ -1,0 +1,54 @@
+"""Interactive TPC-DS corpus authoring harness: run candidate queries
+through BOTH the engine and the sqlite oracle (same env as
+tests/test_tpcds_queries.py) and diff.  Usage:
+
+    python tools/dscheck.py file.sql            # engine vs oracle
+    python tools/dscheck.py file.sql oracle.sql # separate oracle text
+
+Keeps the loaded catalog + oracle in-process when used via -i.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import presto_tpu  # noqa: E402,F401
+from presto_tpu.catalog import Catalog  # noqa: E402
+from presto_tpu.connectors.tpcds import Tpcds  # noqa: E402
+from presto_tpu.runner import QueryRunner  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from tests.oracle import assert_rows_match, translate  # noqa: E402
+from tests.test_tpcds_queries import load_tpcds_oracle  # noqa: E402
+
+_ENV = None
+
+
+def env():
+    global _ENV
+    if _ENV is None:
+        # the suite's env fixture is the single source of generator
+        # params — reuse it so dscheck always diffs the same dataset
+        from tests.test_tpcds_queries import env as suite_env
+        runner, oracle = suite_env.__wrapped__()
+        _ENV = (runner, oracle)
+    return _ENV
+
+
+def check(sql: str, oracle_sql: str = None, ordered: bool = False):
+    runner, oracle = env()
+    expected = [tuple(r) for r in
+                oracle.execute(translate(oracle_sql or sql)).fetchall()]
+    actual = runner.execute(sql).rows
+    assert_rows_match(actual, expected, ordered=ordered)
+    print(f"MATCH: {len(actual)} rows; head: {actual[:3]}")
+    return actual
+
+
+if __name__ == "__main__":
+    sql = open(sys.argv[1]).read()
+    osql = open(sys.argv[2]).read() if len(sys.argv) > 2 else None
+    check(sql, osql)
