@@ -51,6 +51,18 @@ class TaskFailed(Exception):
     neither retries nor excludes the worker."""
 
 
+class _StageCapacity(Exception):
+    """A stage-2 task overflowed its group capacity; the caller doubles
+    max_groups and re-runs the stage."""
+
+
+#: error-text markers that mean a WORKER/transport fault (fall back to
+#: a degraded path) rather than a deterministic query failure
+_TRANSPORT_MARKERS = ("URLError", "Connection refused", "ConnectionRefused",
+                      "RemoteDisconnected", "TimeoutError", "timed out",
+                      "no progress")
+
+
 class MultiHostUnsupported(Exception):
     pass
 
@@ -159,10 +171,16 @@ class MultiHostRunner:
     assignments; final merge + post-processing run at the coordinator.
     """
 
-    def __init__(self, catalog: Catalog, worker_uris: Sequence[str]):
+    def __init__(self, catalog: Catalog, worker_uris: Sequence[str],
+                 broadcast_threshold: Optional[int] = None):
+        from presto_tpu.parallel.fragment import DEFAULT_BROADCAST_THRESHOLD
+
         self.catalog = catalog
         self.workers = [WorkerClient(u) for u in worker_uris]
         self.local = LocalRunner(catalog)
+        self.broadcast_threshold = (DEFAULT_BROADCAST_THRESHOLD
+                                    if broadcast_threshold is None
+                                    else broadcast_threshold)
 
     def run(self, plan: PlanNode) -> MaterializedResult:
         try:
@@ -241,15 +259,258 @@ class MultiHostRunner:
         two-stage shuffle (partial on all workers -> hash-partitioned
         final on all workers, coordinator receives only the root);
         otherwise (or on worker failure mid-shuffle) the
-        coordinator-merge fallback below."""
-        if agg.group_exprs:
-            alive = [w for w in self.workers if w.ping()]
-            if len(alive) >= 2:
+        coordinator-merge fallback below.  A chain containing a join
+        whose build side is too large to broadcast repartitions BOTH
+        join sides across workers first (the DCN shuffle join)."""
+        alive = [w for w in self.workers if w.ping()]
+        if len(alive) >= 2:
+            join = self._partitionable_join(agg.source)
+            if join is not None:
                 try:
-                    return self._run_agg_two_stage(agg, scan, alive)
+                    return self._run_agg_partitioned_join(agg, join, alive)
                 except ConnectionError:
                     pass  # workers died mid-shuffle; fall back
+        if agg.group_exprs and len(alive) >= 2:
+            try:
+                return self._run_agg_two_stage(agg, scan, alive)
+            except ConnectionError:
+                pass  # workers died mid-shuffle; fall back
         return self._run_agg_coordinator_merge(agg, scan)
+
+    # ------------------------------------------------------------------
+    # cross-host repartitioned join (the DCN analog of parallel/dist.py's
+    # FIXED_HASH joins: optimizations/AddExchanges.java:738 choosing a
+    # partitioned distribution + PartitionedOutputBuffer feeding the
+    # consumer stage's ExchangeOperator)
+    # ------------------------------------------------------------------
+    def _partitionable_join(self, chain: PlanNode):
+        """Outermost join on the probe spine that the distribution
+        decision repartitions and whose both sides are scan-rooted
+        chains with plain column keys (partitioning needs key channel
+        indices and per-worker split assignment on each side)."""
+        from presto_tpu.expr.ir import ColumnRef
+        from presto_tpu.parallel.fragment import decide_join_distribution
+        from presto_tpu.planner.plan import CrossSingleNode, JoinNode
+
+        node = chain
+        while True:
+            if isinstance(node, (FilterNode, ProjectNode)):
+                node = node.source
+            elif isinstance(node, AggregationNode) and node.step == "partial":
+                node = node.source
+            elif isinstance(node, CrossSingleNode):
+                node = node.left
+            elif isinstance(node, JoinNode):
+                if node.kind in ("full",) or node.use_index:
+                    node = node.left
+                    continue
+                mode, _ = decide_join_distribution(
+                    node, self.broadcast_threshold, catalog=self.catalog)
+                ok = (
+                    mode == "partitioned"
+                    and all(isinstance(e, ColumnRef) for e in node.left_keys)
+                    and all(isinstance(e, ColumnRef) for e in node.right_keys)
+                    and isinstance(self.local._chain_leaf(node.left),
+                                   TableScanNode)
+                    and isinstance(self.local._chain_leaf(node.right),
+                                   TableScanNode)
+                )
+                if ok:
+                    return node
+                node = node.left
+            else:
+                return None
+
+    def _fan_out_stage2(self, alive: List["WorkerClient"], make_frag,
+                        stage2: List[tuple]) -> List[bytes]:
+        """Create + drain one stage-2 task per worker concurrently
+        (make_frag(k) -> fragment json for worker k; created tasks are
+        appended to ``stage2`` for caller cleanup).  Error triage is
+        shared by every shuffle tier: GroupCapacityExceeded anywhere ->
+        _StageCapacity (caller doubles and re-runs); transport faults ->
+        ConnectionError (caller falls back to a degraded path);
+        deterministic task errors -> TaskFailed."""
+        results: List[bytes] = []
+        errors: List[Exception] = []
+        lock = threading.Lock()
+
+        def run_one(w: WorkerClient, k: int):
+            try:
+                tid = w.create_task(make_frag(k))
+                with lock:
+                    stage2.append((w, tid))
+                raws = w.pull_results(tid)
+                with lock:
+                    results.extend(raws)
+            except Exception as e:
+                with lock:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=run_one, args=(w, k))
+                   for k, w in enumerate(alive)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        if errors:
+            msg = " ".join(str(e) for e in errors)
+            if "GroupCapacityExceeded" in msg:
+                raise _StageCapacity(msg)
+            # a worker dying mid-shuffle surfaces as transport errors
+            # INSIDE a task's error text (a stage-2 pull hit
+            # connection-refused); that is a cluster fault, not a
+            # deterministic query failure
+            if any(t in msg for t in _TRANSPORT_MARKERS):
+                raise ConnectionError(msg)
+            for e in errors:
+                if isinstance(e, TaskFailed):
+                    raise e
+            raise ConnectionError(msg)
+        return results
+
+    def _launch_stage1(self, subtree: PlanNode, scan: TableScanNode,
+                       key_indices: List[int], key_domains,
+                       alive: List["WorkerClient"]) -> List[tuple]:
+        """Run ``subtree`` on every worker over disjoint split subsets,
+        each task hash-partitioning its output rows on ``key_indices``
+        into one buffer per worker.  ``key_domains`` must be the JOIN's
+        union domains so both sides pack (and therefore route)
+        identically."""
+        K = len(alive)
+        spec = {
+            "partitions": K,
+            "key_indices": list(key_indices),
+            "domains": [list(d) if d is not None else None
+                        for d in key_domains],
+        }
+        n_splits = scan.handle.num_splits
+        split_sets = [list(range(n_splits))[i::K] for i in range(K)]
+        tasks: List[tuple] = []
+        for w, splits in zip(alive, split_sets):
+            original = scan.splits
+            try:
+                scan.splits = splits
+                frag = plan_to_json(subtree)
+            finally:
+                scan.splits = original
+            tasks.append((w, w.create_task(frag, spec)))
+        return tasks
+
+    def _run_agg_partitioned_join(self, agg: AggregationNode, join,
+                                  alive: List["WorkerClient"]):
+        """Shuffle join over DCN: stage 1 scans each side and
+        hash-partitions rows on the join key into K buffers; stage-2
+        worker k pulls partition k of BOTH sides from every stage-1
+        task, builds the join over its build shard, probes, and runs the
+        partial aggregation; the coordinator merges the K partial
+        outputs."""
+        import numpy as np
+
+        from presto_tpu.exec.local import (
+            MAX_AGG_GROUPS,
+            GroupCapacityExceeded,
+        )
+        from presto_tpu.planner.plan import RemoteSourceNode
+
+        K = len(alive)
+        kd = join.key_domains
+        lidx = [e.index for e in join.left_keys]
+        ridx = [e.index for e in join.right_keys]
+        probe_scan = self.local._chain_leaf(join.left)
+        build_scan = self.local._chain_leaf(join.right)
+        mg = self.local._max_groups(agg)
+
+        while True:
+            # re-derive per retry: once mg covers the exact key-domain
+            # product, a full partial page means completeness, not
+            # overflow (stale check caused needless two-sided rescans)
+            check = bool(agg.group_exprs) and not self.local._exact_capacity(
+                agg, mg)
+            stage1: List[tuple] = []
+            stage2: List[tuple] = []
+            try:
+                probe_tasks = self._launch_stage1(
+                    join.left, probe_scan, lidx, kd, alive)
+                stage1 += probe_tasks
+                build_tasks = self._launch_stage1(
+                    join.right, build_scan, ridx, kd, alive)
+                stage1 += build_tasks
+
+                partial = AggregationNode(
+                    source=agg.source, group_exprs=agg.group_exprs,
+                    group_names=agg.group_names, aggs=agg.aggs,
+                    agg_names=agg.agg_names, step="partial", max_groups=mg,
+                )
+                orig_left, orig_right = join.left, join.right
+                try:
+                    join.left = RemoteSourceNode(
+                        producer=orig_left,
+                        tasks=[(w.uri, t) for w, t in probe_tasks])
+                    join.right = RemoteSourceNode(
+                        producer=orig_right,
+                        tasks=[(w.uri, t) for w, t in build_tasks])
+                    frag_base = plan_to_json(partial)
+                finally:
+                    join.left, join.right = orig_left, orig_right
+
+                def make_frag(k: int) -> dict:
+                    frag = json.loads(json.dumps(frag_base))
+                    _set_remote_buffers(frag, k)
+                    return frag
+
+                try:
+                    results = self._fan_out_stage2(alive, make_frag, stage2)
+                except _StageCapacity:
+                    if mg >= MAX_AGG_GROUPS:
+                        raise RuntimeError(
+                            f"distributed aggregation exceeded "
+                            f"{MAX_AGG_GROUPS} groups")
+                    mg *= 2
+                    continue
+
+                dicts = [c.dictionary for c in partial.channels]
+                pages = [deserialize_page(r, dicts) for r in results]
+                if not pages:
+                    from presto_tpu.page import Page
+
+                    pages = [Page.empty(
+                        [c.type for c in partial.channels], 1)]
+                if check and any(
+                    int(np.asarray(p.row_mask).sum()) >= mg for p in pages
+                ):
+                    if mg >= MAX_AGG_GROUPS:
+                        raise RuntimeError("aggregation capacity ceiling")
+                    mg *= 2
+                    continue
+
+                # group keys were hash-partitioned on the JOIN key, not
+                # the group key, so partitions may share groups: finish
+                # with the coordinator merge (cheap — inputs are K
+                # partial states)
+                merge_mg = mg
+                while True:
+                    final = AggregationNode(
+                        source=PrecomputedNode(
+                            page=concat_pages_device(pages),
+                            channel_list=partial.channels,
+                        ),
+                        group_exprs=[_key_ref(partial, i)
+                                     for i in range(len(agg.group_exprs))],
+                        group_names=agg.group_names, aggs=agg.aggs,
+                        agg_names=agg.agg_names, step="final",
+                        max_groups=merge_mg,
+                    )
+                    try:
+                        return self.local._execute_to_page(final)
+                    except GroupCapacityExceeded:
+                        if merge_mg >= MAX_AGG_GROUPS:
+                            raise RuntimeError(
+                                "aggregation capacity ceiling")
+                        merge_mg *= 2
+            finally:
+                for w, tid in stage1 + stage2:
+                    w.delete_task(tid)
 
     def _run_agg_two_stage(self, agg: AggregationNode, scan: TableScanNode,
                            alive: List[WorkerClient]):
@@ -308,56 +569,22 @@ class MultiHostRunner:
                     group_names=agg.group_names, aggs=agg.aggs,
                     agg_names=agg.agg_names, step="final", max_groups=mg,
                 )
-                results: List[bytes] = []
-                errors: List[Exception] = []
-                lock = threading.Lock()
+                fin_base = plan_to_json(final)
 
-                def run_stage2(w: WorkerClient, k: int):
-                    try:
-                        fin = plan_to_json(final)
-                        fin["src"]["buffer"] = k
-                        tid = w.create_task(fin)
-                        with lock:
-                            stage2.append((w, tid))
-                        raws = w.pull_results(tid)
-                        with lock:
-                            results.extend(raws)
-                    except Exception as e:
-                        with lock:
-                            errors.append(e)
+                def make_frag(k: int) -> dict:
+                    fin = json.loads(json.dumps(fin_base))
+                    fin["src"]["buffer"] = k
+                    return fin
 
-                threads = [threading.Thread(target=run_stage2, args=(w, k))
-                           for k, w in enumerate(alive)]
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    t.join()
-
-                if errors:
-                    msg = " ".join(str(e) for e in errors)
-                    if "GroupCapacityExceeded" in msg:
-                        if mg >= MAX_AGG_GROUPS:
-                            raise RuntimeError(
-                                f"distributed aggregation exceeded "
-                                f"{MAX_AGG_GROUPS} groups")
-                        mg *= 2
-                        continue
-                    # a worker dying mid-shuffle surfaces as transport
-                    # errors INSIDE a task's error text (the stage-2
-                    # pull hit connection-refused); that is a cluster
-                    # fault -> ConnectionError so the caller falls back
-                    # to coordinator merge over the survivors, not a
-                    # deterministic query failure
-                    transport = ("URLError", "Connection refused",
-                                 "ConnectionRefused", "RemoteDisconnected",
-                                 "TimeoutError", "timed out",
-                                 "no progress")
-                    if any(t in msg for t in transport):
-                        raise ConnectionError(msg)
-                    for e in errors:
-                        if isinstance(e, TaskFailed):
-                            raise e
-                    raise ConnectionError(msg)
+                try:
+                    results = self._fan_out_stage2(alive, make_frag, stage2)
+                except _StageCapacity:
+                    if mg >= MAX_AGG_GROUPS:
+                        raise RuntimeError(
+                            f"distributed aggregation exceeded "
+                            f"{MAX_AGG_GROUPS} groups")
+                    mg *= 2
+                    continue
 
                 dicts = [c.dictionary for c in final.channels]
                 pages = [deserialize_page(r, dicts) for r in results]
@@ -512,3 +739,17 @@ def _key_ref(partial: AggregationNode, i: int):
 
     ch = partial.channels[i]
     return ColumnRef(type=ch.type, index=i)
+
+
+def _set_remote_buffers(frag_json: dict, k: int) -> None:
+    """Point every RemoteSource leaf in a serialized fragment at
+    partition buffer ``k`` (stage-2 task k consumes partition k of
+    every upstream side)."""
+    if isinstance(frag_json, dict):
+        if frag_json.get("k") == "remote":
+            frag_json["buffer"] = k
+        for v in frag_json.values():
+            _set_remote_buffers(v, k)
+    elif isinstance(frag_json, list):
+        for v in frag_json:
+            _set_remote_buffers(v, k)

@@ -180,6 +180,7 @@ def plan_to_json(node: PlanNode) -> dict:
             "lk": [expr_to_json(e) for e in node.left_keys],
             "rk": [expr_to_json(e) for e in node.right_keys],
             "kind": node.kind, "unique": node.unique_build,
+            "null_safe": node.null_safe_keys,
         }
     if isinstance(node, CrossSingleNode):
         return {"k": "cross1", "left": plan_to_json(node.left),
@@ -251,6 +252,7 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
             plan_from_json(d["left"], catalog), plan_from_json(d["right"], catalog),
             [expr_from_json(e) for e in d["lk"]], [expr_from_json(e) for e in d["rk"]],
             kind=d["kind"], unique_build=d["unique"],
+            null_safe_keys=d.get("null_safe", False),
         )
     if k == "cross1":
         return CrossSingleNode(

@@ -218,3 +218,65 @@ def test_multihost_chain_without_aggregation():
     finally:
         for w in workers:
             w.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-host (DCN) repartitioned join: both join sides hash-partition
+# across the HTTP workers (VERDICT r3 next-round item 5)
+# ---------------------------------------------------------------------------
+
+def test_partitioned_join_q3_across_workers(cluster):
+    """Q3 with broadcast_threshold=0: the orders build (and the
+    lineitem probe) hash-partition across 3 workers; stage-2 workers
+    pull their key partition of BOTH sides, join, and partially
+    aggregate; the coordinator merges K partials."""
+    local, _, workers = cluster
+    catalog = make_catalog()
+    multi = MultiHostRunner(catalog, [w.uri for w in workers],
+                            broadcast_threshold=0)
+    sql = QUERIES[3]
+    # the shuffle-join path must actually engage
+    plan = local.binder.plan(sql)
+    from presto_tpu.planner.plan import AggregationNode
+
+    node = plan
+    while not isinstance(node, AggregationNode):
+        node = node.source
+    join = multi._partitionable_join(node.source)
+    assert join is not None, "Q3's join must qualify for repartitioning"
+    # the shuffle path must ANSWER the query, not silently fall back
+    def boom(*a, **k):
+        raise AssertionError("fell back off the partitioned-join path")
+    multi._run_agg_two_stage = boom
+    multi._run_agg_coordinator_merge = boom
+    _check(local, multi, sql)
+
+
+def test_partitioned_join_matches_broadcast_results(cluster):
+    """The same join answered by the broadcast tier and the shuffle
+    tier must agree (two independent distributed paths)."""
+    local, _, workers = cluster
+    catalog = make_catalog()
+    part = MultiHostRunner(catalog, [w.uri for w in workers],
+                           broadcast_threshold=0)
+    bcast = MultiHostRunner(catalog, [w.uri for w in workers])  # default
+    sql = ("SELECT o_orderpriority, count(*) AS c, sum(l_extendedprice) AS s "
+           "FROM lineitem, orders WHERE l_orderkey = o_orderkey "
+           "AND l_quantity < 30 GROUP BY o_orderpriority "
+           "ORDER BY o_orderpriority")
+    _check(local, part, sql)
+    got_part = part.run(local.binder.plan(sql)).rows
+    got_bcast = bcast.run(local.binder.plan(sql)).rows
+    assert got_part == got_bcast
+
+
+def test_partitioned_join_survives_capacity_retry(cluster):
+    """Undersized group capacity on stage-2 workers triggers the
+    GroupCapacityExceeded retry protocol across the shuffle."""
+    local, _, workers = cluster
+    catalog = make_catalog()
+    multi = MultiHostRunner(catalog, [w.uri for w in workers],
+                            broadcast_threshold=0)
+    sql = ("SELECT o_custkey, count(*) AS c FROM orders, lineitem "
+           "WHERE l_orderkey = o_orderkey GROUP BY o_custkey")
+    _check(local, multi, sql)

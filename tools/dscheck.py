@@ -11,18 +11,14 @@ Keeps the loaded catalog + oracle in-process when used via -i.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
 import presto_tpu  # noqa: E402,F401
-from presto_tpu.catalog import Catalog  # noqa: E402
-from presto_tpu.connectors.tpcds import Tpcds  # noqa: E402
-from presto_tpu.runner import QueryRunner  # noqa: E402
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tests.oracle import assert_rows_match, translate  # noqa: E402
-from tests.test_tpcds_queries import load_tpcds_oracle  # noqa: E402
 
 _ENV = None
 
