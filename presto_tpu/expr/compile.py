@@ -334,11 +334,12 @@ def _transform_null_lut(e: "Call", dictionaries) -> Optional["jnp.ndarray"]:
     return jnp.asarray([not n for n in entry[2]])
 
 
-def _hll_from_hash(h: jax.Array, fn: str) -> jax.Array:
+def _hll_from_hash(h: jax.Array, fn: str, P: int = None) -> jax.Array:
     """Shared HLL tail over a mixed uint64 hash lane: bucket = top P
     bits; rho = leading-zero count of the remainder + 1 (sentinel bit
     caps it)."""
-    P = ExprCompiler.HLL_P
+    if P is None:
+        P = ExprCompiler.HLL_P
     if fn == "hll_bucket":
         return (h >> jnp.uint64(64 - P)).astype(jnp.int64)
     rest = (h << jnp.uint64(P)) | jnp.uint64(1 << (P - 1))
@@ -795,7 +796,12 @@ class ExprCompiler:
     HLL_M = 1 << 12
 
     def _compile_hll(self, expr: Call) -> CompiledExpr:
-        (colref,) = expr.args
+        colref = expr.args[0]
+        # optional second literal argument: register-index width P
+        # (approx_set's value sketches use a smaller m than
+        # approx_distinct's internal rewrite)
+        P = (int(expr.args[1].value) if len(expr.args) > 1
+             else ExprCompiler.HLL_P)
         cf = self.compile(colref)
         t = colref.type
         fn = expr.fn
@@ -806,7 +812,7 @@ class ExprCompiler:
             def run_raw_hll(page):
                 d, v = cf(page)
                 h = _mix_u64(hash_bytes(d).astype(jnp.uint64))
-                return _hll_from_hash(h, fn), v
+                return _hll_from_hash(h, fn, P), v
 
             return run_raw_hll
         if t.is_string:
@@ -830,7 +836,7 @@ class ExprCompiler:
             else:
                 lane = d.astype(jnp.int64)
             h = _mix_u64(lane.astype(jnp.uint64))
-            return _hll_from_hash(h, fn), v
+            return _hll_from_hash(h, fn, P), v
 
         return run_hll
 
@@ -1101,6 +1107,29 @@ class ExprCompiler:
 
             return run_sub
         if fn == "cardinality":
+            t0 = expr.args[0].type
+            if t0.is_hll:
+                # HLL estimate with linear-counting small-range
+                # correction (same estimator family as hll_merge);
+                # slots 0..count-1 of the value half hold the rho of
+                # each populated register
+                m = t0.max_elems
+                alpha = 0.7213 / (1.0 + 1.079 / m)
+
+                def run_hll_card(page):
+                    d, v = arg0(page)
+                    cnt = jnp.clip(d[:, 0].astype(jnp.int64), 0, m)
+                    rho = d[:, 1 + m: 1 + 2 * m].astype(jnp.float64)
+                    j = jnp.arange(m, dtype=jnp.int64)[None, :]
+                    present = j < cnt[:, None]
+                    inv = jnp.where(present, jnp.exp2(-rho), 0.0).sum(axis=1)
+                    zeros = (m - cnt).astype(jnp.float64)
+                    raw = alpha * m * m / jnp.maximum(inv + zeros, 1e-12)
+                    lc = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+                    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), lc, raw)
+                    return jnp.round(est).astype(jnp.int64), v
+
+                return run_hll_card
 
             def run_card(page):
                 d, v = arg0(page)

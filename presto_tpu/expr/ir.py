@@ -92,6 +92,8 @@ class AggCall:
     distinct: bool = False
     filter: Optional[Expr] = None
     arg2: Optional[Expr] = None
+    # third argument (approx_percentile's weight column)
+    arg3: Optional[Expr] = None
 
     def __repr__(self):
         a = "*" if self.arg is None else repr(self.arg)

@@ -113,10 +113,14 @@ def state_types(agg: AggCall) -> List[Type]:
         from presto_tpu.types import ArrayType
 
         return [ArrayType(t, ARRAY_AGG_CAP), BIGINT]
-    if agg.fn == "map_agg":
+    if agg.fn in ("map_agg", "multimap_agg"):
         from presto_tpu.types import MapType
 
         return [MapType(t, agg.arg2.type, ARRAY_AGG_CAP), BIGINT]
+    if agg.fn == "hll_sketch":
+        from presto_tpu.types import HllType
+
+        return [HllType(), BIGINT]
     if agg.fn == "learn_regressor":
         # normal-equation sufficient statistics: flattened upper
         # triangle-free full XtX (dim*dim) + Xty (dim), dim = k+1 bias
@@ -136,6 +140,12 @@ def state_types(agg: AggCall) -> List[Type]:
 def output_type(agg: AggCall) -> Type:
     if agg.fn in ("count", "count_star", "hll_merge", "approx_distinct"):
         return BIGINT
+    if agg.fn == "approx_set":
+        from presto_tpu.types import HllType
+
+        return HllType()  # rewritten to the two-level sketch pipeline
+    if agg.fn == "merge":
+        return agg.arg.type  # hll in, hll out (rewritten before exec)
     if agg.fn == "array_agg":
         from presto_tpu.types import ArrayType
 
@@ -144,11 +154,29 @@ def output_type(agg: AggCall) -> Type:
         from presto_tpu.types import MapType
 
         return MapType(agg.arg.type, agg.arg2.type, ARRAY_AGG_CAP)
+    if agg.fn == "hll_sketch":
+        from presto_tpu.types import HllType
+
+        return HllType()
+    if agg.fn == "multimap_agg":
+        from presto_tpu.types import ArrayType, MapType
+
+        vt = agg.arg2.type
+        if not vt.is_array:  # pre-rewrite: second arg is the scalar v
+            vt = ArrayType(vt, ARRAY_AGG_CAP)
+        return MapType(agg.arg.type, vt, ARRAY_AGG_CAP)
     if agg.fn == "histogram":
         # rewritten to inner count + outer map_agg before execution
         from presto_tpu.types import MapType
 
         return MapType(agg.arg.type, BIGINT, ARRAY_AGG_CAP)
+    if agg.fn == "numeric_histogram":
+        # rewritten to window-span bins + map_agg before execution;
+        # the map width is the shared container cap so the rewrite's
+        # map_agg state layout and this declared type agree
+        from presto_tpu.types import MapType
+
+        return MapType(DOUBLE, DOUBLE, ARRAY_AGG_CAP)
     if agg.fn == "learn_regressor":
         from presto_tpu.types import ArrayType
 
@@ -161,7 +189,11 @@ def output_type(agg: AggCall) -> Type:
     if agg.fn in ("sum", "sum0"):
         return _sum_type(agg.arg.type)
     if agg.fn == "avg":
-        return DOUBLE  # deviation: reference keeps decimal scale for avg(decimal)
+        if agg.arg.type.is_decimal:
+            # reference parity: avg(decimal(p,s)) keeps the input type,
+            # rounded HALF_UP at scale s (DecimalAverageAggregation)
+            return agg.arg.type
+        return DOUBLE
     if agg.fn in VARIANCE_FNS or agg.fn in COVAR_FNS:
         return DOUBLE
     if agg.fn == "checksum":
@@ -468,7 +500,7 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             arr = flat.reshape(n, cap_e)
             length = jnp.minimum(rcnt, cap_e).astype(storage)
             out.append([jnp.concatenate([length[:, None], arr], axis=1), rcnt])
-        elif agg.fn == "map_agg":
+        elif agg.fn in ("map_agg", "hll_sketch"):
             # two scatters, same (group, rank) geometry: keys then
             # values (MapAggregationFunction analog); NULL-key rows drop
             mt = state_types(agg)[0]
@@ -491,6 +523,31 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             state = jnp.concatenate(
                 [length[:, None], kflat.reshape(n, cap_e),
                  vflat.reshape(n, cap_e)], axis=1)
+            out.append([state, rcnt])
+        elif agg.fn == "multimap_agg":
+            # map_agg geometry with ARRAY-valued lanes: the value half
+            # is a (cap_e, 1+av) matrix per group, scattered row-wise
+            mt = state_types(agg)[0]
+            cap_e = mt.max_elems
+            av = 1 + mt.element.max_elems
+            storage = mt.np_dtype
+            sent = _container_sent(storage)
+            v_data, v_valid = c.compile(agg.arg2)(page)
+            sel = rowsel & valid
+            gid_sel = jnp.where(sel, gid, n)
+            rcnt = _gsum(ctx, sel.astype(jnp.int64), gid_sel, n)
+            rank = _within_group_rank(gid_sel)
+            ok = sel & (rank < cap_e) & (gid_sel < n)
+            tgt = jnp.where(ok, gid_sel.astype(jnp.int64) * cap_e + rank, n * cap_e)
+            kflat = jnp.full((n * cap_e,), sent, dtype=storage)
+            kflat = kflat.at[tgt].set(data.astype(storage), mode="drop")
+            vrows = jnp.where(v_valid[:, None], v_data.astype(storage), sent)
+            vflat = jnp.full((n * cap_e, av), sent, dtype=storage)
+            vflat = vflat.at[tgt].set(vrows, mode="drop")
+            length = jnp.minimum(rcnt, cap_e).astype(storage)
+            state = jnp.concatenate(
+                [length[:, None], kflat.reshape(n, cap_e),
+                 vflat.reshape(n, cap_e * av)], axis=1)
             out.append([state, rcnt])
         else:
             raise KeyError(agg.fn)
@@ -619,7 +676,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 _gsum(ctx, zero_dead, gid, n),
                 _gsum(ctx, cnt, gid, n),
             ])
-        elif agg.fn in ("array_agg", "map_agg"):
+        elif agg.fn in ("array_agg", "map_agg", "hll_sketch"):
             # concatenate partial containers per group: each partial
             # row's elements land at the group's running offset (stable
             # order); maps scatter both key and value halves
@@ -649,7 +706,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
             total = _gsum(ctx, lens, gid, n)
             length = jnp.minimum(total, cap_e).astype(storage)
             halves = []
-            nhalves = 2 if agg.fn == "map_agg" else 1
+            nhalves = 1 if agg.fn == "array_agg" else 2
             for h in range(nhalves):
                 flat = jnp.full((n * cap_e,), sent, dtype=storage)
                 flat = flat.at[tgt.reshape(-1)].set(
@@ -658,6 +715,44 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 halves.append(flat.reshape(n, cap_e))
             out.append([
                 jnp.concatenate([length[:, None]] + halves, axis=1),
+                _gsum(ctx, cnt_col, gid, n),
+            ])
+        elif agg.fn == "multimap_agg":
+            arr_col, cnt_col = cols
+            mt = state_types(agg)[0]
+            cap_e = mt.max_elems
+            av = 1 + mt.element.max_elems
+            storage = arr_col.dtype
+            sent = _container_sent(storage)
+            l0 = arr_col[:, 0]
+            if jnp.issubdtype(storage, jnp.floating):
+                l0 = jnp.where(jnp.isnan(l0), 0.0, l0)
+            lens = jnp.where(gid < n, jnp.maximum(l0.astype(jnp.int64), 0), 0)
+            order = jnp.argsort(gid, stable=True)
+            gs = gid[order]
+            lens_s = lens[order]
+            cum = jnp.cumsum(lens_s) - lens_s
+            first = jnp.concatenate([jnp.ones(1, jnp.bool_), gs[1:] != gs[:-1]])
+            base = jax.lax.cummax(jnp.where(first, cum, 0))
+            off_s = cum - base
+            off = jnp.zeros_like(off_s).at[order].set(off_s)
+            j = jnp.arange(cap_e, dtype=jnp.int64)[None, :]
+            ok = (j < lens[:, None]) & ((off[:, None] + j) < cap_e) & (gid < n)[:, None]
+            tgt = jnp.where(
+                ok, gid.astype(jnp.int64)[:, None] * cap_e + off[:, None] + j,
+                n * cap_e,
+            )
+            total = _gsum(ctx, lens, gid, n)
+            length = jnp.minimum(total, cap_e).astype(storage)
+            kflat = jnp.full((n * cap_e,), sent, dtype=storage)
+            kflat = kflat.at[tgt.reshape(-1)].set(
+                arr_col[:, 1: 1 + cap_e].reshape(-1), mode="drop")
+            vflat = jnp.full((n * cap_e, av), sent, dtype=storage)
+            vflat = vflat.at[tgt.reshape(-1)].set(
+                arr_col[:, 1 + cap_e:].reshape(-1, av), mode="drop")
+            out.append([
+                jnp.concatenate([length[:, None], kflat.reshape(n, cap_e),
+                                 vflat.reshape(n, cap_e * av)], axis=1),
                 _gsum(ctx, cnt_col, gid, n),
             ])
         else:
@@ -727,16 +822,22 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
         elif agg.fn == "avg":
             s, cnt = cols
             st = _sum_type(agg.arg.type)
-            if st.is_long_decimal:
-                from presto_tpu.ops import decimal128 as d128
-
-                num = d128.to_double(s, st.scale)
+            n = jnp.maximum(cnt, 1)
+            if t.is_decimal and st.is_long_decimal:
+                # exact unscaled-sum / count, HALF_UP, staying decimal
+                blocks.append(Block(_avg_decimal128(s, n), cnt > 0, t))
+            elif t.is_decimal:
+                av = jnp.abs(s)
+                sign = jnp.where(s < 0, -1, 1)
+                # overflow-free HALF_UP away from zero (2*av could wrap
+                # for sums near the decimal(18) accumulator ceiling)
+                q = av // n
+                q = q + (2 * (av - q * n) >= n).astype(q.dtype)
+                blocks.append(Block((sign * q).astype(t.np_dtype), cnt > 0, t))
             else:
                 num = s.astype(jnp.float64)
-                if st.is_decimal:
-                    num = num / (10.0 ** st.scale)
-            d = num / jnp.maximum(cnt, 1).astype(jnp.float64)
-            blocks.append(Block(d, cnt > 0, t))
+                d = num / n.astype(jnp.float64)
+                blocks.append(Block(d, cnt > 0, t))
         elif agg.fn in ("min", "max"):
             m, cnt = cols
             if adict is not None:
@@ -823,7 +924,8 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
                 prior, mean.reshape(n, C * k), var.reshape(n, C * k),
             ], axis=1)
             blocks.append(Block(model.astype(t.np_dtype), cnt > 0, t))
-        elif agg.fn in ("array_agg", "map_agg"):
+        elif agg.fn in ("array_agg", "map_agg", "hll_sketch",
+                        "multimap_agg"):
             arr_state, cnt = cols
             blocks.append(Block(arr_state.astype(t.np_dtype), cnt > 0, t, adict))
         elif agg.fn == "hll_merge":
@@ -842,6 +944,34 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
         else:
             raise KeyError(agg.fn)
     return blocks
+
+
+def _avg_decimal128(s: jax.Array, n: jax.Array) -> jax.Array:
+    """Exact (cap, 2)-limb decimal sum divided by int64 count with
+    HALF_UP rounding, keeping the unscaled representation — the
+    finalize of avg(decimal) over a two-limb accumulator.  Long
+    division over base-10^6 digits so the running remainder times the
+    base never overflows int64 (sound for counts < 2^43 — far above
+    any page capacity)."""
+    from presto_tpu.ops import decimal128 as d128
+
+    neg = s[..., 0] < 0
+    a = jnp.where(neg[..., None], d128.neg(s), s)
+    hi, lo = a[..., 0], a[..., 1]
+    m = jnp.int64(1_000_000)
+    digits = [hi // (m * m), (hi // m) % m, hi % m,
+              lo // (m * m), (lo // m) % m, lo % m]
+    r = jnp.zeros_like(n)
+    qs = []
+    for d in digits:
+        cur = r * m + d
+        qs.append(cur // n)
+        r = cur % n
+    q_hi = (qs[0] * m + qs[1]) * m + qs[2]
+    q_lo = (qs[3] * m + qs[4]) * m + qs[5]
+    q_lo = q_lo + (2 * r >= n).astype(jnp.int64)  # HALF_UP
+    q = d128.normalize(q_hi, q_lo)
+    return jnp.where(neg[..., None], d128.neg(q), q)
 
 
 def _type_max(t: Type):

@@ -131,6 +131,18 @@ def decode_maps(data: np.ndarray, t: Type, dictionary=None) -> List[dict]:
     out = []
     storage = t.np_dtype
     m = t.max_elems
+    if t.element is not None and t.element.is_array:
+        # multimap layout: [count, keys(m), value-arrays(m x (1+av))]
+        av = 1 + t.element.max_elems
+        for row in data:
+            n = int(row[0]) if not _is_null_slot(row[0], storage) else 0
+            d = {}
+            for j in range(n):
+                k = _decode_scalar(row[1 + j], t.key_element, dictionary)
+                vrow = row[1 + m + j * av: 1 + m + (j + 1) * av]
+                d[k] = decode_arrays(vrow[None, :], t.element)[0]
+            out.append(d)
+        return out
     for row in data:
         n = int(row[0]) if not _is_null_slot(row[0], storage) else 0
         d = {}

@@ -77,6 +77,10 @@ def type_from_json(d: dict) -> Type:
 
         return MapType(type_from_json(d["key"]), type_from_json(d["element"]),
                        d["precision"] or 8)
+    if d["name"] == "hll":
+        from presto_tpu.types import HllType
+
+        return HllType()
     if d["name"] == "decimal":
         return DecimalType(d["precision"], d["scale"])
     if d.get("raw"):
@@ -133,6 +137,7 @@ def _agg_to_json(a: AggCall) -> dict:
         "fn": a.fn, "arg": expr_to_json(a.arg), "t": type_to_json(a.type),
         "distinct": a.distinct, "filter": expr_to_json(a.filter),
         "arg2": expr_to_json(a.arg2),
+        "arg3": expr_to_json(a.arg3),
     }
 
 
@@ -141,6 +146,7 @@ def _agg_from_json(d: dict) -> AggCall:
         fn=d["fn"], arg=expr_from_json(d["arg"]), type=type_from_json(d["t"]),
         distinct=d["distinct"], filter=expr_from_json(d["filter"]),
         arg2=expr_from_json(d.get("arg2")),
+        arg3=expr_from_json(d.get("arg3")),
     )
 
 

@@ -67,6 +67,9 @@ AGG_FUNCTIONS = {
     "covar_pop", "covar_samp", "corr", "regr_slope", "regr_intercept",
     "checksum", "arbitrary", "count_if", "geometric_mean",
     "array_agg", "map_agg", "histogram",
+    # HLL sketches as first-class values (spi HyperLogLogType):
+    # approx_set builds one, merge unions them, cardinality estimates
+    "approx_set", "merge", "numeric_histogram", "multimap_agg",
     # presto-ml analogs: sufficient-statistic training aggregates
     "learn_regressor", "learn_classifier",
 }
@@ -1618,6 +1621,27 @@ class Binder:
             node, agg_ctx = self._rewrite_approx_distinct(node, scope, group_irs, agg_ctx)
             group_irs = agg_ctx.group_irs
 
+        # multimap_agg: inner per-(keys, k) array_agg(v), outer scatter
+        if any(a.fn == "multimap_agg" for a in agg_ctx.aggs):
+            node, agg_ctx = self._rewrite_multimap(node, scope, group_irs, agg_ctx)
+            group_irs = agg_ctx.group_irs
+
+        # numeric_histogram: window min/max span -> fixed-width bins
+        if any(a.fn == "numeric_histogram" for a in agg_ctx.aggs):
+            node, agg_ctx = self._rewrite_numeric_histogram(
+                node, scope, group_irs, agg_ctx)
+            group_irs = agg_ctx.group_irs
+
+        # approx_set: two-level HLL rewrite materializing the sketch
+        if any(a.fn == "approx_set" for a in agg_ctx.aggs):
+            node, agg_ctx = self._rewrite_approx_set(node, scope, group_irs, agg_ctx)
+            group_irs = agg_ctx.group_irs
+
+        # merge(hll): unnest sketch registers, per-bucket max, re-sketch
+        if any(a.fn == "merge" for a in agg_ctx.aggs):
+            node, agg_ctx = self._rewrite_hll_union(node, scope, group_irs, agg_ctx)
+            group_irs = agg_ctx.group_irs
+
         # distinct aggregates: rewrite through a distinct pre-aggregation
         if any(a.distinct for a in agg_ctx.aggs):
             node, agg_ctx = self._rewrite_distinct_aggs(node, scope, group_irs, agg_ctx)
@@ -1680,24 +1704,70 @@ class Binder:
         from presto_tpu.ops.window import WindowFunc
         from presto_tpu.planner.plan import WindowNode
 
+        win_cache: Dict[tuple, tuple] = {}  # (x, w) -> channel refs
         for j, a in enumerate(list(agg_ctx.aggs)):
             if a.fn != "approx_percentile":
                 continue
             if a.distinct:
                 raise BindError("approx_percentile DISTINCT unsupported")
             x, p = a.arg, a.arg2
+            cache_key = (x, a.arg3, a.filter)
             base = len(node.channels)
-            node = WindowNode(
-                source=node,
-                partition_exprs=list(group_irs),
-                order_exprs=[x],
-                ascending=[True],
-                funcs=[WindowFunc(kind="row_number"),
-                       WindowFunc(kind="count", arg=x, frame=("whole",))],
-                func_names=[f"$pctl_rn{j}", f"$pctl_cnt{j}"],
-            )
-            rn_ref = ColumnRef(type=BIGINT, index=base)
-            cnt_ref = ColumnRef(type=BIGINT, index=base + 1)
+            if a.arg3 is not None:
+                # weighted: smallest x whose running weight (ordered by
+                # x) reaches p * total weight — exact weighted rank
+                # selection via a running-sum window (one window pass
+                # per distinct (x, w) spec, shared by ARRAY fractions)
+                from presto_tpu.ops.window import WindowFunc
+                from presto_tpu.planner.plan import WindowNode
+
+                if cache_key in win_cache:
+                    cw, tw = win_cache[cache_key]
+                    hit = call("ge", cw, call("mul", p, tw))
+                    newarg = call("if", hit, x,
+                                  Literal(type=x.type, value=None))
+                    agg_ctx.aggs[j] = AggCall(fn="min", arg=newarg,
+                                              type=a.type, filter=a.filter)
+                    continue
+
+                w = call("cast_double", a.arg3) \
+                    if a.arg3.type.name != "double" else a.arg3
+                # rows the aggregate ignores (NULL x, FILTER-excluded)
+                # must not contribute weight to the running/total sums
+                counted = call("not_null", x)
+                if a.filter is not None:
+                    counted = call("and", counted, a.filter)
+                w = call("if", counted, w, Literal(type=DOUBLE, value=0.0))
+                node = WindowNode(
+                    source=node, partition_exprs=list(group_irs),
+                    order_exprs=[x], ascending=[True],
+                    funcs=[WindowFunc(kind="sum", arg=w),
+                           WindowFunc(kind="sum", arg=w, frame=("whole",))],
+                    func_names=[f"$pctl_cw{j}", f"$pctl_tw{j}"],
+                )
+                cw = ColumnRef(type=DOUBLE, index=base)
+                tw = ColumnRef(type=DOUBLE, index=base + 1)
+                win_cache[cache_key] = (cw, tw)
+                hit = call("ge", cw, call("mul", p, tw))
+                newarg = call("if", hit, x, Literal(type=x.type, value=None))
+                agg_ctx.aggs[j] = AggCall(fn="min", arg=newarg, type=a.type,
+                                          filter=a.filter)
+                continue
+            if cache_key in win_cache:
+                rn_ref, cnt_ref = win_cache[cache_key]
+            else:
+                node = WindowNode(
+                    source=node,
+                    partition_exprs=list(group_irs),
+                    order_exprs=[x],
+                    ascending=[True],
+                    funcs=[WindowFunc(kind="row_number"),
+                           WindowFunc(kind="count", arg=x, frame=("whole",))],
+                    func_names=[f"$pctl_rn{j}", f"$pctl_cnt{j}"],
+                )
+                rn_ref = ColumnRef(type=BIGINT, index=base)
+                cnt_ref = ColumnRef(type=BIGINT, index=base + 1)
+                win_cache[cache_key] = (rn_ref, cnt_ref)
             target = call(
                 "add",
                 call("cast_bigint",
@@ -1767,6 +1837,172 @@ class Binder:
         new_aggs = [AggCall(fn="hll_merge", arg=rho_ref, type=BIGINT)
                     for _ in agg_ctx.aggs]
         ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group, aggs=new_aggs)
+        return inner, ctx
+
+    def _rewrite_multimap(self, node, scope, group_irs, agg_ctx: AggCtx):
+        """multimap_agg(k, v) -> inner aggregation grouped by
+        (keys..., k) computing array_agg(v), outer scatter of
+        (k, array) pairs into a MAP(K, ARRAY(V)) value (reference:
+        MultimapAggregationFunction; the nested value lanes stay fixed
+        matrices so the scatter is one 2-D gather)."""
+        if not all(a.fn == "multimap_agg" for a in agg_ctx.aggs):
+            raise BindError("multimap_agg cannot mix with other aggregates")
+        pairs = {(a.arg, a.arg2) for a in agg_ctx.aggs}
+        if len(pairs) != 1:
+            raise BindError("multiple multimap_agg argument pairs unsupported")
+        ((karg, varg),) = pairs
+        inner_keys = group_irs + [karg]
+        from presto_tpu.ops.aggregate import output_type as _agg_out
+
+        arr_proto = AggCall(fn="array_agg", arg=varg, type=varg.type)
+        arr_proto = dataclasses.replace(arr_proto, type=_agg_out(arr_proto))
+        inner = AggregationNode(
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
+            [arr_proto], ["$vals"],
+            max_groups=self._group_capacity(
+                inner_keys, scope, self._estimate(node), node=node),
+        )
+        new_group = [ColumnRef(type=g.type, index=i)
+                     for i, g in enumerate(group_irs)]
+        k_ref = ColumnRef(type=karg.type, index=len(group_irs))
+        arr_ref = ColumnRef(type=arr_proto.type, index=len(inner_keys))
+        proto = AggCall(fn="multimap_agg", arg=k_ref, type=karg.type,
+                        arg2=arr_ref)
+        proto = dataclasses.replace(proto, type=_agg_out(proto))
+        ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group,
+                     aggs=[proto for _ in agg_ctx.aggs])
+        return inner, ctx
+
+    def _rewrite_numeric_histogram(self, node, scope, group_irs,
+                                   agg_ctx: AggCtx):
+        """numeric_histogram(b, x) -> window (min/max of x per group)
+        -> bin index -> inner per-(keys, bin) avg(x) + count ->
+        outer map_agg(mean, count) as MAP(DOUBLE, DOUBLE)
+        (NumericHistogramAggregation's role: per-bin centroids and
+        weights; fixed-width bins over the group's span instead of the
+        reference's streaming Ben-Haim/Tom-Tov merges)."""
+        from presto_tpu.ops.window import WindowFunc
+        from presto_tpu.planner.plan import WindowNode
+        from presto_tpu.ops.aggregate import output_type as _agg_out
+
+        if not all(a.fn == "numeric_histogram" for a in agg_ctx.aggs):
+            raise BindError(
+                "numeric_histogram cannot mix with other aggregates")
+        pairs = {(a.arg, a.arg2.value) for a in agg_ctx.aggs}
+        if len(pairs) != 1:
+            raise BindError("multiple numeric_histogram arguments unsupported")
+        ((arg, nb),) = pairs
+        nb = int(nb)
+        base = len(node.channels)
+        x = call("cast_double", arg) if arg.type.name != "double" else arg
+        node = WindowNode(
+            source=node, partition_exprs=list(group_irs), order_exprs=[],
+            ascending=[],
+            funcs=[WindowFunc(kind="min", arg=x, frame=("whole",)),
+                   WindowFunc(kind="max", arg=x, frame=("whole",))],
+            func_names=["$nh_min", "$nh_max"],
+        )
+        mn = ColumnRef(type=DOUBLE, index=base)
+        mx = ColumnRef(type=DOUBLE, index=base + 1)
+        width = call("div", call("sub", mx, mn),
+                     Literal(type=DOUBLE, value=float(nb)))
+        safe_w = call("if", call("gt", width, Literal(type=DOUBLE, value=0.0)),
+                      width, Literal(type=DOUBLE, value=1.0))
+        bidx = call("least",
+                    call("cast_bigint",
+                         call("floor", call("div", call("sub", x, mn), safe_w))),
+                    Literal(type=BIGINT, value=nb - 1))
+        inner_keys = group_irs + [bidx]
+        inner = AggregationNode(
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
+            [AggCall(fn="avg", arg=x, type=DOUBLE),
+             AggCall(fn="count", arg=x, type=BIGINT)],
+            ["$mean", "$cnt"],
+            max_groups=self._group_capacity(
+                inner_keys, scope, self._estimate(node), node=node),
+        )
+        new_group = [ColumnRef(type=g.type, index=i)
+                     for i, g in enumerate(group_irs)]
+        mean_ref = ColumnRef(type=DOUBLE, index=len(inner_keys))
+        cnt_ref = call("cast_double",
+                       ColumnRef(type=BIGINT, index=len(inner_keys) + 1))
+        proto = AggCall(fn="map_agg", arg=mean_ref, type=DOUBLE, arg2=cnt_ref)
+        proto = dataclasses.replace(proto, type=_agg_out(proto))
+        ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group,
+                     aggs=[proto for _ in agg_ctx.aggs])
+        return inner, ctx
+
+    def _rewrite_approx_set(self, node, scope, group_irs, agg_ctx: AggCtx):
+        """approx_set(x) -> inner aggregation grouped by
+        (keys..., hll_bucket(x, P)) computing max(hll_rho(x, P)), outer
+        hll_sketch scattering (bucket, rho) into the HYPERLOGLOG map
+        value (reference: ApproximateSetAggregation.java producing a
+        P4HyperLogLog; here the sketch is the map_agg scatter over
+        m = HLL_SET_BUCKETS registers)."""
+        from presto_tpu.types import HLL_SET_BUCKETS, HllType
+
+        if not all(a.fn == "approx_set" for a in agg_ctx.aggs):
+            raise BindError("approx_set cannot mix with other aggregates")
+        args = {a.arg for a in agg_ctx.aggs}
+        if len(args) != 1:
+            raise BindError("multiple approx_set arguments unsupported")
+        (arg,) = args
+        p_lit = Literal(type=BIGINT, value=HLL_SET_BUCKETS.bit_length() - 1)
+        inner_keys = group_irs + [call("hll_bucket", arg, p_lit)]
+        inner = AggregationNode(
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
+            [AggCall(fn="max", arg=call("hll_rho", arg, p_lit), type=BIGINT)],
+            ["$rho"],
+            max_groups=self._group_capacity(
+                inner_keys, scope, self._estimate(node), node=node),
+        )
+        new_group = [ColumnRef(type=g.type, index=i)
+                     for i, g in enumerate(group_irs)]
+        bucket_ref = ColumnRef(type=BIGINT, index=len(group_irs))
+        rho_ref = ColumnRef(type=BIGINT, index=len(inner_keys))
+        new_aggs = [AggCall(fn="hll_sketch", arg=bucket_ref, type=HllType(),
+                            arg2=rho_ref)
+                    for _ in agg_ctx.aggs]
+        ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group,
+                     aggs=new_aggs)
+        return inner, ctx
+
+    def _rewrite_hll_union(self, node, scope, group_irs, agg_ctx: AggCtx):
+        """merge(sketch) -> unnest each sketch's (bucket, rho) entries,
+        per-(keys, bucket) max(rho), re-sketch — HLL union as plain
+        relational algebra (reference: MergeHyperLogLogAggregation)."""
+        from presto_tpu.planner.plan import UnnestNode
+        from presto_tpu.types import HllType
+
+        if not all(a.fn == "merge" for a in agg_ctx.aggs):
+            raise BindError("merge cannot mix with other aggregates")
+        args = {a.arg for a in agg_ctx.aggs}
+        if len(args) != 1:
+            raise BindError("multiple merge arguments unsupported")
+        (arg,) = args
+        if not arg.type.is_hll:
+            raise BindError("merge() expects a HYPERLOGLOG argument "
+                            "(approx_set output)")
+        base = len(node.channels)
+        node = UnnestNode(node, [arg], ["$hbucket", "$hrho"])
+        bucket_col = ColumnRef(type=BIGINT, index=base)
+        rho_col = ColumnRef(type=BIGINT, index=base + 1)
+        inner_keys = group_irs + [bucket_col]
+        inner = AggregationNode(
+            node, inner_keys, [f"$k{i}" for i in range(len(inner_keys))],
+            [AggCall(fn="max", arg=rho_col, type=BIGINT)], ["$rho"],
+            max_groups=self._group_capacity(
+                inner_keys, scope, self._estimate(node), node=node),
+        )
+        new_group = [ColumnRef(type=g.type, index=i)
+                     for i, g in enumerate(group_irs)]
+        bucket_ref = ColumnRef(type=BIGINT, index=len(group_irs))
+        rho_ref = ColumnRef(type=BIGINT, index=len(inner_keys))
+        new_aggs = [AggCall(fn="hll_sketch", arg=bucket_ref, type=HllType(),
+                            arg2=rho_ref)
+                    for _ in agg_ctx.aggs]
+        ctx = AggCtx(group_asts=agg_ctx.group_asts, group_irs=new_group,
+                     aggs=new_aggs)
         return inner, ctx
 
     # non-distinct aggregates that survive the two-level distinct
@@ -2759,7 +2995,53 @@ class Binder:
                 ast.FuncCall("avg", (ast.FuncCall("ln", e.args),)), scope, agg)
             return call("exp", inner)
         fn, distinct = e.name, e.distinct
+        if fn == "approx_percentile" and len(e.args) == 2 \
+                and isinstance(e.args[1], ast.ArrayCtor):
+            # array-of-fractions form: one rank-select per fraction,
+            # recomposed as ARRAY[..] (ApproximateLongPercentileArrayAggregations)
+            refs = [self._bind_agg_call(
+                        ast.FuncCall(fn, (e.args[0], p)), scope, agg)
+                    for p in e.args[1].items]
+            return call("array_construct", *refs)
+        if fn == "approx_percentile" and len(e.args) == 3:
+            # weighted form: approx_percentile(x, w, p)
+            if distinct:
+                raise BindError("approx_percentile DISTINCT unsupported")
+            arg = self._bind(e.args[0], scope)
+            w = self._bind(e.args[1], scope)
+            p_ast = e.args[2]
+            arg2 = self._bind(p_ast, scope)
+            if not isinstance(arg2, Literal) or arg2.value is None:
+                raise BindError("approx_percentile fraction must be a literal")
+            p = float(arg2.value) / (10.0 ** (arg2.type.scale or 0)
+                                     if arg2.type.is_decimal else 1.0)
+            if not 0.0 <= p <= 1.0:
+                raise BindError("approx_percentile fraction must be in [0, 1]")
+            a = AggCall(fn=fn, arg=arg, type=arg.type,
+                        arg2=Literal(type=DOUBLE, value=p), arg3=w)
+            return agg.agg_ref(a)
+        if fn == "numeric_histogram":
+            # numeric_histogram(buckets, x): fixed-width bins over the
+            # group's [min, max] span, keys = per-bin value means
+            # (NumericHistogramAggregation's Ben-Haim/Tom-Tov role)
+            if len(e.args) != 2:
+                raise BindError("numeric_histogram takes (buckets, x)")
+            b = self._bind(e.args[0], scope)
+            if not isinstance(b, Literal) or not b.type.name == "bigint":
+                raise BindError("numeric_histogram bucket count must be an "
+                                "integer literal")
+            from presto_tpu.ops.aggregate import ARRAY_AGG_CAP
+
+            if not 1 <= int(b.value) <= ARRAY_AGG_CAP:
+                raise BindError(
+                    f"numeric_histogram bucket count must be in "
+                    f"[1, {ARRAY_AGG_CAP}]")
+            arg = self._bind(e.args[1], scope)
+            a = AggCall(fn=fn, arg=arg, type=arg.type, arg2=b)
+            a = dataclasses.replace(a, type=output_type(a))
+            return agg.agg_ref(a)
         if fn in ("min_by", "max_by", "approx_percentile", "map_agg",
+                  "multimap_agg",
                   "covar_pop", "covar_samp", "corr", "regr_slope",
                   "regr_intercept",
                   "learn_regressor", "learn_classifier"):

@@ -111,8 +111,12 @@ class Type:
             return (self.precision or 32,)
         if self.name == "array":
             return (1 + (self.precision or 8),)
-        if self.name == "map":
-            return (1 + 2 * (self.precision or 8),)
+        if self.name in ("map", "hll"):
+            m = self.precision or 8
+            if self.element is not None and self.element.is_array:
+                # multimap: each value lane is itself a fixed array
+                return (1 + m + m * (1 + self.element.max_elems),)
+            return (1 + 2 * m,)
         return ()
 
     @property
@@ -129,7 +133,12 @@ class Type:
 
     @property
     def is_map(self) -> bool:
-        return self.name == "map"
+        # HYPERLOGLOG shares the map storage layout (bucket -> rho)
+        return self.name in ("map", "hll")
+
+    @property
+    def is_hll(self) -> bool:
+        return self.name == "hll"
 
     @property
     def max_elems(self) -> int:
@@ -207,13 +216,21 @@ GEOMETRY_POINT = Type("geometry_point", np.dtype(np.float64))
 def _container_storage_dtype(*types: Type) -> np.dtype:
     """Storage dtype for ARRAY/MAP slots: one fixed-width lane wide
     enough for every participating scalar type (booleans widen to int32,
-    everything integer-like rides int64, doubles force float64)."""
+    everything integer-like rides int64, doubles force float64).  A map
+    VALUE may itself be a one-level fixed array (multimap_agg's
+    MAP(K, ARRAY(V)) — its lanes flatten into the same matrix); deeper
+    nesting is unsupported."""
+    flat = []
     for t in types:
-        if t.value_shape:
+        if t.is_array and t.element is not None and not t.element.value_shape:
+            flat.append(t.element)
+        elif t.value_shape:
             raise ValueError(f"nested container element type {t} unsupported")
-    if any(t.name == "double" for t in types):
+        else:
+            flat.append(t)
+    if any(t.name == "double" for t in flat):
         return np.dtype(np.float64)
-    if all(t.name == "boolean" for t in types):
+    if all(t.name == "boolean" for t in flat):
         return np.dtype(np.int32)
     return np.dtype(np.int64)
 
@@ -237,6 +254,24 @@ def MapType(key: Type, value: Type, max_elems: int = 8) -> Type:
     common storage dtype (reference: spi/type/MapType.java)."""
     return Type("map", _container_storage_dtype(key, value),
                 precision=int(max_elems), element=value, key_element=key)
+
+
+#: HLL sketch bucket count for approx_set/merge/cardinality: m = 2^9.
+#: Smaller than approx_distinct's m=4096 (rel. error ~4.6% vs ~1.6%)
+#: because the sketch is a first-class VALUE here — every populated
+#: register occupies a slot in the column's (capacity, 1+2m) matrix.
+HLL_SET_BUCKETS = 512
+
+
+def HllType() -> Type:
+    """HYPERLOGLOG approximate-set sketch (reference:
+    spi/type/HyperLogLogType + io.airlift.stats HLL behind approx_set/
+    merge/cardinality).  TPU-first re-design: a DENSE-capable sparse
+    map bucket -> rho over the HLL_SET_BUCKETS register domain, sharing
+    the map storage layout so sketch construction is the map_agg
+    scatter and sketch union is a per-bucket max."""
+    return Type("hll", _container_storage_dtype(BIGINT, BIGINT),
+                precision=HLL_SET_BUCKETS, element=BIGINT, key_element=BIGINT)
 
 
 def null_sentinel(storage: np.dtype):
@@ -321,6 +356,8 @@ def parse_type(s: str) -> Type:
     """Parse a SQL type name, e.g. 'bigint', 'decimal(12,2)', 'varchar(25)',
     'raw_varchar(24)' (the non-dictionary fixed-width representation)."""
     s = s.strip().lower()
+    if s == "hyperloglog" or s == "hll":
+        return HllType()
     if s.startswith("array"):
         inner = s[s.index("(") + 1 : s.rindex(")")]
         parts = _split_top_level(inner)

@@ -393,3 +393,91 @@ def test_trig_and_math_sweep():
     assert rows[9] is True and rows[10] is True
     assert rows[12] == 1.0
     assert rows[14] == pytest.approx(math.e)
+
+
+# ---------------------------------------------------------------------------
+# round-4 aggregate breadth: HLL sketches as values, multimap_agg,
+# numeric_histogram, weighted/array approx_percentile, avg(decimal)
+# scale (VERDICT r3 next-round item 6)
+# ---------------------------------------------------------------------------
+
+def test_approx_set_merge_cardinality(env):
+    runner, _ = env
+    true = runner.execute(
+        "select count(distinct o_custkey) from orders").rows[0][0]
+    est = runner.execute(
+        "select cardinality(approx_set(o_custkey)) from orders").rows[0][0]
+    # m=512 registers: ~4.6% standard error; allow 4 sigma
+    assert abs(est - true) <= max(0.2 * true, 10)
+    # union of per-group sketches == the global sketch exactly
+    merged = runner.execute("""
+        select cardinality(merge(s)) from (
+          select o_orderpriority, approx_set(o_custkey) as s
+          from orders group by o_orderpriority) t
+    """).rows[0][0]
+    assert merged == est
+
+
+def test_multimap_agg(env):
+    runner, _ = env
+    got = runner.execute(
+        "select g, multimap_agg(k, v) from (values "
+        "(1,1,10),(1,1,11),(1,2,20),(2,3,30)) t(g,k,v) "
+        "group by g order by g").rows
+    assert got[0][0] == 1 and got[0][1][1] == [10, 11] and got[0][1][2] == [20]
+    assert got[1][1] == {3: [30]}
+
+
+def test_numeric_histogram(env):
+    runner, _ = env
+    (m,) = runner.execute(
+        "select numeric_histogram(4, x) from (values "
+        "(1.0),(2.0),(3.0),(4.0),(10.0)) t(x)").rows[0]
+    # weights sum to the row count; centroids are per-bin means
+    assert sum(m.values()) == 5.0
+    assert any(abs(k - 10.0) < 1e-9 for k in m)  # the outlier bin
+
+
+def test_weighted_approx_percentile(env):
+    runner, _ = env
+    (v,) = runner.execute(
+        "select approx_percentile(x, w, 0.5) from (values "
+        "(1.0, 1), (2.0, 1), (100.0, 10)) t(x, w)").rows[0]
+    assert v == 100.0  # weight 10 dominates: median lands on 100
+    (v2,) = runner.execute(
+        "select approx_percentile(x, w, 0.1) from (values "
+        "(1.0, 5), (2.0, 1), (100.0, 1)) t(x, w)").rows[0]
+    assert v2 == 1.0
+
+
+def test_array_approx_percentile(env):
+    runner, _ = env
+    (arr,) = runner.execute(
+        "select approx_percentile(o_totalprice, array[0.1, 0.5, 0.9]) "
+        "from orders").rows[0]
+    singles = [runner.execute(
+        f"select approx_percentile(o_totalprice, {p}) from orders").rows[0][0]
+        for p in (0.1, 0.5, 0.9)]
+    assert [float(a) for a in arr] == [float(s) for s in singles]
+    assert float(arr[0]) < float(arr[1]) < float(arr[2])
+
+
+def test_avg_decimal_keeps_scale(env):
+    runner, _ = env
+    from decimal import Decimal
+
+    (v,) = runner.execute(
+        "select avg(x) from (values (0.01), (0.02)) t(x)").rows[0]
+    assert v == Decimal("0.02")  # 0.015 rounds HALF_UP at scale 2
+    (v2,) = runner.execute(
+        "select avg(x) from (values (-0.01), (-0.02)) t(x)").rows[0]
+    assert v2 == Decimal("-0.02")  # away from zero
+
+
+def test_weighted_percentile_ignores_null_rows(env):
+    runner, _ = env
+    # NULL-x rows contribute no weight (review finding r4)
+    (v,) = runner.execute(
+        "select approx_percentile(nullif(x, 9.0), w, 0.5) from (values "
+        "(1.0, 1), (2.0, 1), (9.0, 2)) t(x, w)").rows[0]
+    assert v == 1.0

@@ -96,8 +96,13 @@ def test_min_max_avg(runner):
     got = runner.execute("select min(x), max(x), avg(x) from big").rows[0]
     assert got[0] == as_exact(min(VALUES))
     assert got[1] == as_exact(max(VALUES))
-    assert got[2] == pytest.approx(
-        float(Decimal(sum(VALUES)) / len(VALUES) / 10**SCALE), rel=1e-12)
+    # r4: avg(decimal) keeps the decimal scale, rounded HALF_UP
+    # (reference DecimalAverageAggregation semantics)
+    import decimal as _dec
+
+    exact = (Decimal(sum(VALUES)) / len(VALUES)).quantize(
+        Decimal(1), rounding=_dec.ROUND_HALF_UP).scaleb(-SCALE)
+    assert got[2] == exact
 
 
 def test_grouped_long_sum(runner):
