@@ -676,10 +676,13 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 _gsum(ctx, zero_dead, gid, n),
                 _gsum(ctx, cnt, gid, n),
             ])
-        elif agg.fn in ("array_agg", "map_agg", "hll_sketch"):
+        elif agg.fn in ("array_agg", "map_agg", "hll_sketch",
+                        "multimap_agg"):
             # concatenate partial containers per group: each partial
             # row's elements land at the group's running offset (stable
-            # order); maps scatter both key and value halves
+            # order).  Halves: arrays have one value lane per rank; maps
+            # add a key half; multimaps' value half is an (av)-wide
+            # matrix per rank — all three share the offset geometry.
             arr_col, cnt_col = cols
             at = state_types(agg)[0]
             cap_e = at.max_elems
@@ -705,54 +708,22 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
             )
             total = _gsum(ctx, lens, gid, n)
             length = jnp.minimum(total, cap_e).astype(storage)
+            if agg.fn == "array_agg":
+                widths = [1]
+            elif agg.fn == "multimap_agg":
+                widths = [1, 1 + at.element.max_elems]
+            else:
+                widths = [1, 1]
             halves = []
-            nhalves = 1 if agg.fn == "array_agg" else 2
-            for h in range(nhalves):
-                flat = jnp.full((n * cap_e,), sent, dtype=storage)
-                flat = flat.at[tgt.reshape(-1)].set(
-                    arr_col[:, 1 + h * cap_e : 1 + (h + 1) * cap_e].reshape(-1),
-                    mode="drop")
-                halves.append(flat.reshape(n, cap_e))
+            o = 1
+            for w in widths:
+                seg = arr_col[:, o: o + cap_e * w].reshape(-1, w)
+                flat = jnp.full((n * cap_e, w), sent, dtype=storage)
+                flat = flat.at[tgt.reshape(-1)].set(seg, mode="drop")
+                halves.append(flat.reshape(n, cap_e * w))
+                o += cap_e * w
             out.append([
                 jnp.concatenate([length[:, None]] + halves, axis=1),
-                _gsum(ctx, cnt_col, gid, n),
-            ])
-        elif agg.fn == "multimap_agg":
-            arr_col, cnt_col = cols
-            mt = state_types(agg)[0]
-            cap_e = mt.max_elems
-            av = 1 + mt.element.max_elems
-            storage = arr_col.dtype
-            sent = _container_sent(storage)
-            l0 = arr_col[:, 0]
-            if jnp.issubdtype(storage, jnp.floating):
-                l0 = jnp.where(jnp.isnan(l0), 0.0, l0)
-            lens = jnp.where(gid < n, jnp.maximum(l0.astype(jnp.int64), 0), 0)
-            order = jnp.argsort(gid, stable=True)
-            gs = gid[order]
-            lens_s = lens[order]
-            cum = jnp.cumsum(lens_s) - lens_s
-            first = jnp.concatenate([jnp.ones(1, jnp.bool_), gs[1:] != gs[:-1]])
-            base = jax.lax.cummax(jnp.where(first, cum, 0))
-            off_s = cum - base
-            off = jnp.zeros_like(off_s).at[order].set(off_s)
-            j = jnp.arange(cap_e, dtype=jnp.int64)[None, :]
-            ok = (j < lens[:, None]) & ((off[:, None] + j) < cap_e) & (gid < n)[:, None]
-            tgt = jnp.where(
-                ok, gid.astype(jnp.int64)[:, None] * cap_e + off[:, None] + j,
-                n * cap_e,
-            )
-            total = _gsum(ctx, lens, gid, n)
-            length = jnp.minimum(total, cap_e).astype(storage)
-            kflat = jnp.full((n * cap_e,), sent, dtype=storage)
-            kflat = kflat.at[tgt.reshape(-1)].set(
-                arr_col[:, 1: 1 + cap_e].reshape(-1), mode="drop")
-            vflat = jnp.full((n * cap_e, av), sent, dtype=storage)
-            vflat = vflat.at[tgt.reshape(-1)].set(
-                arr_col[:, 1 + cap_e:].reshape(-1, av), mode="drop")
-            out.append([
-                jnp.concatenate([length[:, None], kflat.reshape(n, cap_e),
-                                 vflat.reshape(n, cap_e * av)], axis=1),
                 _gsum(ctx, cnt_col, gid, n),
             ])
         else:

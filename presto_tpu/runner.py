@@ -343,7 +343,17 @@ class QueryRunner:
 
         if isinstance(stmt, ast.CreateTableAs):
             schema = list(zip(plan.output_names, plan.output_types))
-            if not self._stage_write(cname, conn, "create_table", table, schema, pages):
+            props = dict(getattr(stmt, "properties", ()) or ())
+            if props and not getattr(conn, "supports_table_properties", False):
+                raise ValueError(
+                    f"connector {cname} does not support CREATE TABLE "
+                    f"properties {sorted(props)}")
+            if props:
+                op_args = (table, schema, pages)
+                if not self._stage_write(cname, conn, "create_table",
+                                         *op_args, properties=props):
+                    conn.create_table(table, schema, pages, properties=props)
+            elif not self._stage_write(cname, conn, "create_table", table, schema, pages):
                 conn.create_table(table, schema, pages)
         else:
             want = [c.type for c in handle.columns]
@@ -432,14 +442,15 @@ class QueryRunner:
         — its plans are never cached across queries)."""
         self._plans.clear()
 
-    def _stage_write(self, connector_name: str, conn, op: str, *args) -> bool:
+    def _stage_write(self, connector_name: str, conn, op: str, *args,
+                     **kwargs) -> bool:
         """Inside an open transaction, stage the write on the connector's
         tx handle instead of applying it; returns True when staged."""
         if self._open_tx is None:
             return False
         self._check_tx_writable(connector_name, conn)
         handle = self._open_tx.handle_for(connector_name, conn)
-        conn.stage(handle, op, *args)
+        conn.stage(handle, op, *args, **kwargs)
         return True
 
     def _recode_strings(self, page, handle):
@@ -452,8 +463,15 @@ class QueryRunner:
 
         blocks = list(page.blocks)
         changed = False
+        conn = self.catalog.connector(handle.connector_name)
+        open_cols = (conn.open_dictionary_columns(handle.table)
+                     if hasattr(conn, "open_dictionary_columns") else set())
         for i, col in enumerate(handle.columns):
             if not col.type.is_string:
+                continue
+            if col.name in open_cols:
+                # dynamic partitioning: new values extend the
+                # metastore's value list instead of being rejected
                 continue
             b = blocks[i]
             dst = getattr(col, "dictionary", None)

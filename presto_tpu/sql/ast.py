@@ -354,6 +354,8 @@ class ShowSession(Node):
 class CreateTableAs(Node):
     name: str
     query: Node  # Query | Union
+    # WITH (k = v, ...) table properties (e.g. partitioned_by)
+    properties: tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)

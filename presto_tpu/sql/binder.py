@@ -1186,8 +1186,26 @@ class Binder:
         flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
 
         def emit(op: str, col: ColumnRef, lit: Literal):
-            if lit.value is not None and not col.type.is_string:
-                scan.constraints.append((names[col.index], op, lit.value))
+            if lit.value is None:
+                return
+            if col.type.is_string:
+                # dictionary columns: EQUALITY pushes as a code point
+                # constraint (split stats for varchar are code min/max;
+                # code ORDER is arbitrary, so ranges stay un-pushed).
+                # This is what prunes warehouse partitions on string
+                # partition columns.
+                if op != "eq" or col.type.is_raw_string:
+                    return
+                ch = scan.handle.columns[scan.columns[col.index]]
+                if ch.dictionary is None:
+                    return
+                try:
+                    code = list(ch.dictionary.values).index(lit.value)
+                except ValueError:
+                    code = -1  # absent value: every split prunes
+                scan.constraints.append((names[col.index], "eq", code))
+                return
+            scan.constraints.append((names[col.index], op, lit.value))
 
         def walk(e: Expr):
             if not isinstance(e, Call):

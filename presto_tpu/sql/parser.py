@@ -834,9 +834,32 @@ def parse_statement(sql: str) -> ast.Node:
     if p.accept("create"):
         p.expect("table")
         name = _qualified_name(p)
+        props = []
+        if p.accept_word("with"):
+            # WITH (partitioned_by = 'col' | ARRAY['a','b'], ...) —
+            # the reference's table properties (HiveTableProperties)
+            p.expect("(")
+            while True:
+                key = p.tok.value
+                p.i += 1
+                p.expect("=")
+                if p.accept_word("array"):
+                    p.expect("[")
+                    vals = []
+                    while not p.accept("]"):
+                        vals.append(p.tok.value)
+                        p.i += 1
+                        p.accept(",")
+                    props.append((key, tuple(vals)))
+                else:
+                    props.append((key, p.tok.value))
+                    p.i += 1
+                if not p.accept(","):
+                    break
+            p.expect(")")
         p.expect("as")
         q = p._query()
-        return _finish(p, ast.CreateTableAs(name, q))
+        return _finish(p, ast.CreateTableAs(name, q, tuple(props)))
     if p.accept("insert"):
         p.expect("into")
         name = _qualified_name(p)

@@ -213,16 +213,17 @@ LONG_DECIMAL_BASE = 10 ** 18
 GEOMETRY_POINT = Type("geometry_point", np.dtype(np.float64))
 
 
-def _container_storage_dtype(*types: Type) -> np.dtype:
+def _container_storage_dtype(*types: Type, _allow_array: bool = False) -> np.dtype:
     """Storage dtype for ARRAY/MAP slots: one fixed-width lane wide
     enough for every participating scalar type (booleans widen to int32,
-    everything integer-like rides int64, doubles force float64).  A map
-    VALUE may itself be a one-level fixed array (multimap_agg's
-    MAP(K, ARRAY(V)) — its lanes flatten into the same matrix); deeper
-    nesting is unsupported."""
+    everything integer-like rides int64, doubles force float64).
+    ``_allow_array``: a MAP value may itself be a one-level fixed array
+    (multimap_agg's MAP(K, ARRAY(V)) — its lanes flatten into the same
+    matrix); everywhere else nesting stays a bind-time error."""
     flat = []
     for t in types:
-        if t.is_array and t.element is not None and not t.element.value_shape:
+        if (_allow_array and t.is_array and t.element is not None
+                and not t.element.value_shape):
             flat.append(t.element)
         elif t.value_shape:
             raise ValueError(f"nested container element type {t} unsupported")
@@ -252,7 +253,7 @@ def MapType(key: Type, value: Type, max_elems: int = 8) -> Type:
     """MAP(key, value): (capacity, 1+2*max) matrix — slot 0 = entry
     count, slots 1..max = keys, slots max+1..2*max = values, in one
     common storage dtype (reference: spi/type/MapType.java)."""
-    return Type("map", _container_storage_dtype(key, value),
+    return Type("map", _container_storage_dtype(key, value, _allow_array=True),
                 precision=int(max_elems), element=value, key_element=key)
 
 

@@ -147,8 +147,8 @@ def test_having_scalar_subquery_inside_arithmetic(runner):
         HAVING avg(o_totalprice) > 1.2 * (SELECT avg(o_totalprice) FROM orders)
         ORDER BY a DESC, o_custkey LIMIT 5
     """).rows
-    threshold = 1.2 * r.execute(
-        "SELECT avg(o_totalprice) FROM orders").rows[0][0]
+    threshold = 1.2 * float(r.execute(
+        "SELECT avg(o_totalprice) FROM orders").rows[0][0])
     assert got, "expected some high-value customers"
     assert all(a > float(threshold) for _, a in got)
 
