@@ -56,6 +56,14 @@ class MemoryConnector:
                   self._sort, self._bucketing, self._dicts):
             d.pop(name, None)
 
+    def rename_table(self, name: str, new_name: str) -> None:
+        if new_name in self._tables:
+            raise ValueError(f"table {new_name} already exists")
+        for d in (self._tables, self._schemas, self._domains, self._pks,
+                  self._sort, self._bucketing, self._dicts):
+            if name in d:
+                d[new_name] = d.pop(name)
+
     def load_from(self, conn, table: str, name: Optional[str] = None,
                   columns: Optional[List[str]] = None) -> None:
         """Copy a table from another connector onto the device (CTAS).

@@ -738,7 +738,18 @@ class LocalRunner:
                 td = TupleDomain.from_constraints(node.constraints)
                 if td.is_none:
                     return  # provably empty scan
+            sample = node.sample
+            produced = 0
             for split in splits:
+                if node.limit is not None and produced >= node.limit:
+                    break  # pushed-down LIMIT satisfied: skip the rest
+                if sample is not None and sample[0] == "system":
+                    # SYSTEM(p): keep whole splits by a deterministic
+                    # split hash (SampleNode SYSTEM semantics); mixed so
+                    # split 0 is not a fixed point
+                    h = (((split + 1) * 2654435761) ^ 0x9E3779B9) % 10_000
+                    if h >= sample[1] * 100:
+                        continue
                 if td is not None:
                     stats = conn.split_stats(node.handle.table, split)
                     if not td.overlaps_split_stats(stats):
@@ -746,6 +757,20 @@ class LocalRunner:
                 page = conn.page_for_split(
                     node.handle.table, split, capacity=self.split_capacity
                 )
+                if sample is not None and sample[0] == "bernoulli":
+                    # BERNOULLI(p): deterministic per-(split, row) hash
+                    # mask — every row kept with probability p%
+                    r = jnp.arange(page.capacity, dtype=jnp.uint32)
+                    h = (r + jnp.uint32(split) * jnp.uint32(0x9E3779B1))
+                    h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
+                    h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+                    keep = (h % jnp.uint32(10_000)) < jnp.uint32(
+                        int(sample[1] * 100))
+                    page = Page(page.blocks, page.row_mask & keep)
+                if node.limit is not None:
+                    import numpy as _np
+
+                    produced += int(_np.asarray(page.row_mask).sum())
                 yield Page(tuple(page.blocks[i] for i in idx), page.row_mask)
         else:
             yield from self._pages(node)

@@ -27,6 +27,7 @@ from typing import List, Optional
 from presto_tpu.expr.ir import Call, ColumnRef, Expr, Literal
 from presto_tpu.matching import Pattern
 from presto_tpu.planner.plan import (
+    AggregationNode,
     FilterNode,
     LimitNode,
     OutputNode,
@@ -243,6 +244,88 @@ def _expr_refs(e: Expr) -> List[int]:
     return []
 
 
+class PushLimitIntoTableScan(Rule):
+    """LIMIT over a count-preserving chain (projections only) down to
+    the scan: the scan stops producing splits once the limit's worth of
+    live rows has been emitted, so later splits never generate/load
+    (iterative/rule/PushLimitIntoTableScan.java / the SPI's applyLimit).
+    The LimitNode stays above for the exact cut."""
+
+    pattern = Pattern(LimitNode)
+
+    def apply(self, node: LimitNode) -> Optional[PlanNode]:
+        import dataclasses as _dc
+
+        projs: List[ProjectNode] = []
+        src = node.source
+        while isinstance(src, ProjectNode):
+            projs.append(src)
+            src = src.source
+        if not isinstance(src, TableScanNode):
+            return None
+        if src.limit is not None and src.limit <= node.count:
+            return None
+        rebuilt: PlanNode = _dc.replace(src, limit=node.count)
+        for p in reversed(projs):
+            rebuilt = ProjectNode(rebuilt, p.projections, p.names)
+        return LimitNode(rebuilt, node.count)
+
+
+def _provably_distinct(src: PlanNode) -> bool:
+    """Rows of ``src`` are provably unique as full tuples: a grouped
+    aggregation's output (unique per key tuple), a projection that
+    keeps every key of such an aggregation, or a scan whose selected
+    columns include the table's primary key."""
+    if isinstance(src, AggregationNode) and src.step == "single" \
+            and src.group_exprs:
+        return True
+    if isinstance(src, TableScanNode):
+        pk = src.handle.primary_key
+        if pk:
+            names = [src.handle.columns[i].name for i in src.columns]
+            return all(k in names for k in pk)
+        return False
+    if isinstance(src, ProjectNode):
+        if not all(isinstance(p, ColumnRef) for p in src.projections):
+            return False
+        kept = {p.index for p in src.projections}
+        inner = src.source
+        if isinstance(inner, AggregationNode) and inner.step == "single" \
+                and inner.group_exprs:
+            return set(range(len(inner.group_exprs))) <= kept
+        if isinstance(inner, TableScanNode):
+            pk = inner.handle.primary_key
+            if pk:
+                names = [inner.handle.columns[i].name for i in inner.columns]
+                return all(k in names and names.index(k) in kept for k in pk)
+    return False
+
+
+class RemoveRedundantDistinct(Rule):
+    """DISTINCT (an aggregation with no aggregates) over input that is
+    already distinct on every output column is the identity
+    (iterative/rule/RemoveRedundantDistinct /
+    MultipleDistinctAggregationToMarkDistinct's pruning role)."""
+
+    pattern = Pattern(AggregationNode)
+
+    def apply(self, node: AggregationNode) -> Optional[PlanNode]:
+        if node.aggs or node.step != "single" or not node.group_exprs:
+            return None
+        src = node.source
+        n_src = len(src.channels)
+        identity = (
+            len(node.group_exprs) == n_src
+            and all(isinstance(e, ColumnRef) and e.index == i
+                    for i, e in enumerate(node.group_exprs))
+        )
+        if not identity:
+            return None
+        if not _provably_distinct(src):
+            return None
+        return src
+
+
 DEFAULT_RULES: List[Rule] = [
     MergeAdjacentFilters(),
     PushFilterThroughProject(),
@@ -255,6 +338,8 @@ DEFAULT_RULES: List[Rule] = [
     MergeLimitWithSort(),
     PushLimitThroughUnion(),
     FlattenUnions(),
+    PushLimitIntoTableScan(),
+    RemoveRedundantDistinct(),
 ]
 
 

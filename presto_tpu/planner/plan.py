@@ -84,6 +84,14 @@ class TableScanNode(PlanNode):
     # simple pushed-down range constraints (col, op, device-repr value)
     # for stats-based split pruning (TupleDomain pushdown analog)
     constraints: List[Tuple[str, str, int]] = dataclasses.field(default_factory=list)
+    # pushed-down row limit: the scan may stop producing splits once
+    # this many live rows have been emitted (PushLimitIntoTableScan /
+    # ConnectorMetadata applyLimit analog); the LimitNode above stays
+    limit: Optional[int] = None
+    # TABLESAMPLE (method, pct): "bernoulli" masks rows by a
+    # deterministic per-(split, row) hash; "system" keeps whole splits
+    # (sql/tree/SampledRelation + SampleNode analog)
+    sample: Optional[Tuple[str, float]] = None
 
     @property
     def channels(self) -> List[Channel]:

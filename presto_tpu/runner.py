@@ -168,6 +168,36 @@ class QueryRunner:
                 text = self.executor.explain(plan)
             return MaterializedResult(["Query Plan"], [VARCHAR], [(text,)])
 
+        if isinstance(stmt, (ast.Grant, ast.Revoke)):
+            ac = self.access_control
+            chk = getattr(ac, "check_can_grant", None)
+            if chk is not None:
+                chk(self.session.user)  # no self-escalation
+            fn = getattr(ac, "grant" if isinstance(stmt, ast.Grant)
+                         else "revoke", None)
+            if fn is None:
+                raise ValueError(
+                    "the active access control does not support GRANT/REVOKE"
+                    " (use GrantingAccessControl)")
+            fn(stmt.grantee, stmt.table, stmt.privileges)
+            word = "GRANT" if isinstance(stmt, ast.Grant) else "REVOKE"
+            return MaterializedResult(["result"], [VARCHAR], [(word,)])
+
+        if isinstance(stmt, ast.AlterTableRename):
+            handle = self.catalog.resolve(stmt.name)
+            conn = self.catalog.connector(handle.connector_name)
+            self._check_tx_writable(handle.connector_name, conn)
+            self.access_control.check_can_write(self.session.user,
+                                                 handle.table)
+            if not hasattr(conn, "rename_table"):
+                raise ValueError(
+                    f"connector {handle.connector_name} does not support "
+                    "ALTER TABLE RENAME")
+            new_bare = stmt.new_name.split(".")[-1]
+            conn.rename_table(handle.table, new_bare)
+            self._invalidate_plans()
+            return MaterializedResult(["result"], [VARCHAR], [("RENAME",)])
+
         if isinstance(stmt, ast.SetSession):
             self.session.set(stmt.name, stmt.value)
             # executor knobs may have changed; rebuild (plans survive)
@@ -298,8 +328,12 @@ class QueryRunner:
 
         plan = self.binder.plan_ast(stmt.query)
         self._check_access(plan)
-        self.access_control.check_can_write(
-            self.session.user, stmt.name.split(".")[-1])
+        if isinstance(stmt, ast.InsertInto):
+            self.access_control.check_can_insert(
+                self.session.user, stmt.name.split(".")[-1])
+        else:
+            self.access_control.check_can_write(
+                self.session.user, stmt.name.split(".")[-1])
 
         # resolve the write target BEFORE running the source query so a
         # READ ONLY transaction / non-transactional connector rejects
@@ -380,7 +414,7 @@ class QueryRunner:
         import numpy as np
 
         handle = self.catalog.resolve(stmt.table)
-        self.access_control.check_can_write(self.session.user, handle.table)
+        self.access_control.check_can_delete(self.session.user, handle.table)
         conn = self.catalog.connector(handle.connector_name)
         if not hasattr(conn, "create_table"):
             raise ValueError(f"connector {handle.connector_name} is read-only")

@@ -30,6 +30,59 @@ class AccessControl:
     def check_can_write(self, user: str, table: str) -> None:
         pass
 
+    # per-operation refinements default to the coarse write check
+    def check_can_insert(self, user: str, table: str) -> None:
+        self.check_can_write(user, table)
+
+    def check_can_delete(self, user: str, table: str) -> None:
+        self.check_can_write(user, table)
+
+
+class GrantingAccessControl(AccessControl):
+    """Mutable grants table driven by SQL GRANT/REVOKE (the
+    AccessControlManager grant surface + ConnectorAccessControl's
+    grantTablePrivileges role).  ``admins`` keep every privilege;
+    everyone else needs an explicit grant per table."""
+
+    def __init__(self, admins=("presto",)):
+        self.admins = set(admins)
+        self.grants: dict = {}  # (user, table) -> set of privileges
+
+    def grant(self, grantee: str, table: str, privileges) -> None:
+        self.grants.setdefault((grantee, table), set()).update(privileges)
+
+    def revoke(self, grantee: str, table: str, privileges) -> None:
+        s = self.grants.get((grantee, table))
+        if s is not None:
+            s.difference_update(privileges)
+
+    def _has(self, user: str, table: str, priv: str) -> bool:
+        if user in self.admins:
+            return True
+        return priv in self.grants.get((user, table), ())
+
+    def check_can_grant(self, user: str) -> None:
+        if user not in self.admins:
+            raise AccessDeniedError(user, "grant privileges on", "*")
+
+    def check_can_select(self, user: str, table: str) -> None:
+        if not self._has(user, table, "select"):
+            raise AccessDeniedError(user, "select from", table)
+
+    def check_can_insert(self, user: str, table: str) -> None:
+        if not self._has(user, table, "insert"):
+            raise AccessDeniedError(user, "insert into", table)
+
+    def check_can_delete(self, user: str, table: str) -> None:
+        if not self._has(user, table, "delete"):
+            raise AccessDeniedError(user, "delete from", table)
+
+    def check_can_write(self, user: str, table: str) -> None:
+        # coarse check (CTAS/rename/drop): any write privilege
+        if not (self._has(user, table, "insert")
+                or self._has(user, table, "delete")):
+            raise AccessDeniedError(user, "write to", table)
+
 
 class RuleBasedAccessControl(AccessControl):
     """First-match rule list: (user glob, table glob, allow, writable)

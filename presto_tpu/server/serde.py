@@ -163,6 +163,8 @@ def plan_to_json(node: PlanNode) -> dict:
             "columns": list(node.columns),
             "splits": node.splits,
             "constraints": [list(c) for c in node.constraints],
+            "limit": node.limit,
+            "sample": list(node.sample) if node.sample else None,
         }
     if isinstance(node, FilterNode):
         return {"k": "filter", "src": plan_to_json(node.source),
@@ -238,6 +240,8 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
         return TableScanNode(
             handle, list(d["columns"]), d.get("splits"),
             constraints=[tuple(c) for c in d.get("constraints", [])],
+            limit=d.get("limit"),
+            sample=tuple(d["sample"]) if d.get("sample") else None,
         )
     if k == "filter":
         return FilterNode(plan_from_json(d["src"], catalog), expr_from_json(d["pred"]))

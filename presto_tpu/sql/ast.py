@@ -98,6 +98,17 @@ class InList(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class QuantifiedComparison(Node):
+    """value op ANY|SOME|ALL (subquery)
+    (sql/tree/QuantifiedComparisonExpression.java)."""
+
+    op: str  # = <> < <= > >=
+    value: Node = None
+    quantifier: str = "any"  # any | all
+    query: "Query" = None
+
+
+@dataclasses.dataclass(frozen=True)
 class InSubquery(Node):
     value: Node
     query: "Query"
@@ -276,6 +287,32 @@ class GroupingSets(Node):
 class TableRef(Node):
     name: str
     alias: Optional[str] = None
+    # TABLESAMPLE (method, percentage): ("bernoulli"|"system", pct)
+    sample: Optional[Tuple[str, float]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Grant(Node):
+    """GRANT privs ON [TABLE] t TO u (sql/tree/Grant.java)."""
+
+    privileges: Tuple[str, ...] = ()
+    table: str = ""
+    grantee: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Revoke(Node):
+    privileges: Tuple[str, ...] = ()
+    table: str = ""
+    grantee: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class AlterTableRename(Node):
+    """ALTER TABLE t RENAME TO u (sql/tree/RenameTable.java)."""
+
+    name: str = ""
+    new_name: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

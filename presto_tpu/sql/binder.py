@@ -174,12 +174,60 @@ class Scope:
         return len(self.cols)
 
 
+def desugar_quantified(e: ast.Node) -> ast.Node:
+    """value op ANY|ALL (subquery) -> existing subquery forms
+    (iterative/rule/TransformQuantifiedComparisonApplyToLateralJoin's
+    role, done as an AST rewrite):
+      = ANY  -> IN            <> ALL -> NOT IN
+      < ANY  -> < max(S)      < ALL  -> < min(S)   (dually for >, <=, >=)
+      = ALL  -> = min(S) AND = max(S)
+    Deviation (PARITY.md): over an EMPTY subquery the min/max forms
+    yield NULL (row dropped) where ANSI ALL is TRUE."""
+    if not isinstance(e, ast.QuantifiedComparison):
+        return e
+    if e.quantifier == "any" and e.op == "=":
+        return ast.InSubquery(e.value, e.query, negated=False)
+    if e.quantifier == "all" and e.op == "<>":
+        return ast.InSubquery(e.value, e.query, negated=True)
+
+    if len(e.query.select) != 1 or isinstance(e.query.select[0].expr,
+                                              ast.Star):
+        raise BindError("quantified subquery must select one column")
+
+    def scalar(fn: str) -> ast.ScalarSubquery:
+        q = e.query
+        # the subquery stays INTACT as a derived table (its ORDER BY /
+        # LIMIT apply before the aggregation); only the output column
+        # gains a referenceable alias
+        inner = dataclasses.replace(q.select[0], alias="__qc")
+        wrapped = ast.Query(
+            select=(ast.SelectItem(
+                ast.FuncCall(fn, (ast.Identifier(("__qc",)),)), None),),
+            from_=(ast.SubqueryRel(
+                dataclasses.replace(q, select=(inner,)), alias="__q"),),
+        )
+        return ast.ScalarSubquery(wrapped)
+
+    minmax = {("<", "any"): "max", ("<=", "any"): "max",
+              (">", "any"): "min", (">=", "any"): "min",
+              ("<", "all"): "min", ("<=", "all"): "min",
+              (">", "all"): "max", (">=", "all"): "max"}
+    key = (e.op, e.quantifier)
+    if key in minmax:
+        return ast.Binary(e.op, e.value, scalar(minmax[key]))
+    if e.op == "=" and e.quantifier == "all":
+        return ast.Binary("and",
+                          ast.Binary("=", e.value, scalar("min")),
+                          ast.Binary("=", e.value, scalar("max")))
+    raise BindError(f"{e.op} {e.quantifier.upper()} (subquery) unsupported")
+
+
 def split_conjuncts(node: Optional[ast.Node]) -> List[ast.Node]:
     if node is None:
         return []
     if isinstance(node, ast.Binary) and node.op == "and":
         return split_conjuncts(node.left) + split_conjuncts(node.right)
-    return [node]
+    return [desugar_quantified(node)]
 
 
 def expr_refs(e: Expr) -> List[int]:
@@ -639,7 +687,8 @@ class Binder:
                 )
                 return node, scope
             handle = self.catalog.resolve(rel.name)
-            scan = TableScanNode(handle, list(range(len(handle.columns))))
+            scan = TableScanNode(handle, list(range(len(handle.columns))),
+                                 sample=getattr(rel, "sample", None))
             # a catalog-qualified name aliases to its bare table name
             return scan, Scope.of(scan, rel.alias or rel.name.split(".")[-1])
         if isinstance(rel, ast.ValuesRel):
@@ -2084,6 +2133,27 @@ class Binder:
         remap = dict(g2c)
 
         if isinstance(c, ast.InSubquery):
+            if self._is_correlated(c.query, glob):
+                # correlated IN: x IN (select y from t where corr) ==
+                # EXISTS (select 1 from t where corr and y = x) — the
+                # membership equality becomes one more correlation
+                # equi-conjunct (TransformCorrelatedInPredicateToJoin)
+                q = c.query
+                if len(q.select) != 1 or isinstance(q.select[0].expr, ast.Star):
+                    raise BindError("IN subquery must select one column")
+                if q.group_by or q.having or q.limit is not None \
+                        or self._contains_agg(q.select[0].expr):
+                    raise BindError(
+                        "correlated IN over an aggregated/limited subquery "
+                        "is unsupported")
+                eq = ast.Binary("=", q.select[0].expr, c.value)
+                new_where = eq if q.where is None else \
+                    ast.Binary("and", q.where, eq)
+                q2 = dataclasses.replace(
+                    q, select=(ast.SelectItem(ast.NumberLit("1"), None),),
+                    where=new_where)
+                kind = "anti" if (negated ^ c.negated) else "semi"
+                return self._plan_exists(node, scope, remap, glob, q2, kind)
             sub, sub_names = self._plan_query_like(c.query)
             value_ir = remap_expr(self._bind(c.value, glob), remap)
             kind = "anti" if (negated ^ c.negated) else "semi"
@@ -2457,6 +2527,9 @@ class Binder:
             if agg is not None:
                 raise BindError(f"column {e.name} not in GROUP BY")
             return ColumnRef(type=ch.type, index=idx, name=e.name)
+
+        if isinstance(e, ast.QuantifiedComparison):
+            return self._bind_impl(desugar_quantified(e), scope, agg)
 
         if isinstance(e, ast.ScalarSubquery):
             ref = self._scalar_refs.get(id(e))

@@ -74,7 +74,7 @@ def tokenize(sql: str) -> List[Token]:
 
 
 # non-reserved words that end an expression/relation rather than alias it
-_NON_ALIAS_WORDS = {"intersect", "except"}
+_NON_ALIAS_WORDS = {"intersect", "except", "tablesample"}
 
 
 class Parser:
@@ -446,12 +446,31 @@ class Parser:
                                       column_names=tuple(cols) or rel.column_names)
             return rel
         name = _qualified_name(self)  # catalog-qualified: catalog.table
+
+        def _sample_clause():
+            if not self.accept_word("tablesample"):
+                return None
+            method = self.accept_word("bernoulli", "system")
+            if method is None:
+                raise SyntaxError("expected BERNOULLI or SYSTEM")
+            self.expect("(")
+            pct = float(self.tok.value)
+            self.i += 1
+            self.expect(")")
+            return (method, pct)
+
+        # reference grammar: sampledRelation wraps aliasedRelation, so
+        # TABLESAMPLE follows the alias; the pre-alias position is also
+        # accepted
+        sample = _sample_clause()
         alias = None
         if self.accept("as"):
             alias = self.ident()
         else:
             alias = self._implicit_alias()
-        return ast.TableRef(name, alias)
+        if sample is None:
+            sample = _sample_clause()
+        return ast.TableRef(name, alias, sample)
 
     # -- expressions (precedence ladder) ------------------------------------
     def _expr(self) -> ast.Node:
@@ -487,6 +506,14 @@ class Parser:
                 op = self.tok.value
                 self.i += 1
                 op = {"!=": "<>"}.get(op, op)
+                quant = self.accept_word("any", "some", "all")
+                if quant is not None:
+                    self.expect("(")
+                    q = self._query()
+                    self.expect(")")
+                    e = ast.QuantifiedComparison(
+                        op, e, "all" if quant == "all" else "any", q)
+                    continue
                 rhs = self._concat()
                 e = ast.Binary(op, e, rhs)
                 continue
@@ -869,6 +896,41 @@ def parse_statement(sql: str) -> ast.Node:
         p.expect("table")
         name = _qualified_name(p)
         return _finish(p, ast.DropTable(name))
+    quals = p.accept_word("grant", "revoke")
+    if quals is not None:
+        is_grant = quals == "grant"
+        privs = []
+        if p.accept("all"):
+            p.accept_word("privileges")
+            privs = ["select", "insert", "delete"]
+        else:
+            while True:
+                w = p.accept_word("select", "insert", "delete")
+                if w is None:
+                    raise SyntaxError("expected privilege name")
+                privs.append(w)
+                if not p.accept(","):
+                    break
+        p.expect("on")
+        p.accept("table")
+        table = _qualified_name(p)
+        ok = (p.accept_word("to") is not None) if is_grant \
+            else p.accept("from")
+        if not ok:
+            raise SyntaxError("expected TO/FROM")
+        p.accept_word("user")
+        grantee = p.ident()
+        cls = ast.Grant if is_grant else ast.Revoke
+        return _finish(p, cls(tuple(privs), table, grantee))
+    if p.accept_word("alter"):
+        p.expect("table")
+        name = _qualified_name(p)
+        if p.accept_word("rename") is None:
+            raise SyntaxError("only ALTER TABLE ... RENAME TO supported")
+        if p.accept_word("to") is None:
+            raise SyntaxError("expected TO")
+        new_name = _qualified_name(p)
+        return _finish(p, ast.AlterTableRename(name, new_name))
     if p.accept_word("delete"):
         if p.accept("from") is None:
             p.expect("from")

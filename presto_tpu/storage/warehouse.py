@@ -254,6 +254,16 @@ class WarehouseConnector:
         self._meta_cache.pop(name, None)
         self._splits_cache.pop(name, None)
 
+    def rename_table(self, name: str, new_name: str) -> None:
+        dst = os.path.join(self.root, new_name)
+        if os.path.exists(dst):
+            raise ValueError(f"warehouse table {new_name} already exists")
+        os.rename(os.path.join(self.root, name), dst)
+        self._files = {k: v for k, v in self._files.items()
+                       if not k.startswith(f"{name}//")}
+        self._meta_cache.pop(name, None)
+        self._splits_cache.pop(name, None)
+
     # -- transactions (staged writes; ConnectorTransactionHandle) -----------
     def begin_transaction(self):
         return _WarehouseTx()

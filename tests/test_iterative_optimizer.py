@@ -117,3 +117,122 @@ def test_default_rules_preserve_correctness():
         "SELECT a, b FROM (SELECT a, b FROM t WHERE a >= 2) "
         "WHERE a < 5 ORDER BY a LIMIT 10").rows
     assert rows == [(2, 3.0), (3, 4.5), (4, 6.0)]
+
+
+# ---------------------------------------------------------------------------
+# round-4 rules (RuleTester-style plan-shape assertions +
+# end-to-end result checks)
+# ---------------------------------------------------------------------------
+
+import pytest
+from presto_tpu.connectors.tpch import Tpch
+
+
+@pytest.fixture(scope="module")
+def runner():
+    cat = Catalog()
+    cat.register("tpch", Tpch(sf=0.001, split_rows=256))
+    return QueryRunner(cat)
+
+
+def _find(plan, kind):
+    out = []
+
+    def walk(n):
+        if isinstance(n, kind):
+            out.append(n)
+        for s in n.sources:
+            walk(s)
+
+    walk(plan)
+    return out
+
+
+def test_push_limit_into_table_scan(runner):
+    from presto_tpu.planner.plan import LimitNode, TableScanNode
+
+    plan = runner.plan("SELECT o_orderkey + 1 AS k FROM orders LIMIT 7")
+    scans = _find(plan, TableScanNode)
+    assert scans and scans[0].limit == 7  # pushed into the scan
+    assert _find(plan, LimitNode)  # the exact cut stays above
+    assert len(runner.execute(
+        "SELECT o_orderkey + 1 AS k FROM orders LIMIT 7").rows) == 7
+
+
+def test_limit_not_pushed_through_filter(runner):
+    from presto_tpu.planner.plan import TableScanNode
+
+    plan = runner.plan(
+        "SELECT o_orderkey FROM orders WHERE o_custkey = 5 LIMIT 3")
+    scans = _find(plan, TableScanNode)
+    assert scans and scans[0].limit is None  # filters change row counts
+
+
+def test_remove_redundant_distinct_over_aggregation(runner):
+    from presto_tpu.planner.plan import AggregationNode
+
+    sql = ("SELECT DISTINCT o_custkey, c FROM "
+           "(SELECT o_custkey, count(*) AS c FROM orders GROUP BY o_custkey)")
+    plan = runner.plan(sql)
+    aggs = [a for a in _find(plan, AggregationNode) if a.aggs]
+    distincts = [a for a in _find(plan, AggregationNode) if not a.aggs]
+    assert len(aggs) == 1 and not distincts  # the DISTINCT was elided
+    got = sorted(runner.execute(sql).rows)
+    want = sorted(runner.execute(
+        "SELECT o_custkey, count(*) AS c FROM orders "
+        "GROUP BY o_custkey").rows)
+    assert got == want
+
+
+def test_distinct_kept_when_not_provably_unique(runner):
+    from presto_tpu.planner.plan import AggregationNode
+
+    sql = "SELECT DISTINCT o_orderpriority FROM orders"
+    plan = runner.plan(sql)
+    distincts = [a for a in _find(plan, AggregationNode) if not a.aggs]
+    assert distincts  # priorities repeat: the distinct must survive
+    assert len(runner.execute(sql).rows) == 5
+
+
+def test_distinct_removed_on_primary_key_scan(runner):
+    from presto_tpu.planner.plan import AggregationNode
+
+    sql = "SELECT DISTINCT o_orderkey, o_custkey FROM orders"
+    plan = runner.plan(sql)
+    distincts = [a for a in _find(plan, AggregationNode) if not a.aggs]
+    assert not distincts  # o_orderkey is the primary key
+    want = runner.execute("SELECT count(*) FROM orders").rows[0][0]
+    assert len(runner.execute(sql).rows) == want
+
+
+def test_quantified_comparisons_match_explicit_forms(runner):
+    got = runner.execute(
+        "SELECT count(*) FROM orders WHERE o_totalprice > ALL "
+        "(SELECT o_totalprice FROM orders WHERE o_custkey = 5)").rows
+    want = runner.execute(
+        "SELECT count(*) FROM orders WHERE o_totalprice > "
+        "(SELECT max(o_totalprice) FROM orders WHERE o_custkey = 5)").rows
+    assert got == want
+    got_any = runner.execute(
+        "SELECT count(*) FROM orders WHERE o_custkey = ANY "
+        "(SELECT c_custkey FROM customer WHERE c_acctbal > 9000.0)").rows
+    want_any = runner.execute(
+        "SELECT count(*) FROM orders WHERE o_custkey IN "
+        "(SELECT c_custkey FROM customer WHERE c_acctbal > 9000.0)").rows
+    assert got_any == want_any
+
+
+def test_correlated_in_matches_exists(runner):
+    got = runner.execute(
+        "SELECT count(*) FROM orders o WHERE o_orderkey IN "
+        "(SELECT l_orderkey FROM lineitem WHERE l_suppkey = o.o_custkey)").rows
+    want = runner.execute(
+        "SELECT count(*) FROM orders o WHERE EXISTS "
+        "(SELECT 1 FROM lineitem WHERE l_orderkey = o.o_orderkey "
+        " AND l_suppkey = o.o_custkey)").rows
+    assert got == want
+    got_not = runner.execute(
+        "SELECT count(*) FROM orders o WHERE o_orderkey NOT IN "
+        "(SELECT l_orderkey FROM lineitem WHERE l_suppkey = o.o_custkey)").rows
+    total = runner.execute("SELECT count(*) FROM orders").rows
+    assert got_not[0][0] == total[0][0] - got[0][0]
