@@ -172,7 +172,10 @@ class MultiHostRunner:
     """
 
     def __init__(self, catalog: Catalog, worker_uris: Sequence[str],
-                 broadcast_threshold: Optional[int] = None):
+                 broadcast_threshold: Optional[int] = None,
+                 worker_locations: Optional[dict] = None,
+                 max_splits_per_node: int = 0,
+                 execution_policy: str = "phased"):
         from presto_tpu.parallel.fragment import DEFAULT_BROADCAST_THRESHOLD
 
         self.catalog = catalog
@@ -181,6 +184,13 @@ class MultiHostRunner:
         self.broadcast_threshold = (DEFAULT_BROADCAST_THRESHOLD
                                     if broadcast_threshold is None
                                     else broadcast_threshold)
+        # scheduling policies (scheduler.py): split placement locality
+        # keyed by worker URI, per-node split backpressure, and the
+        # build-before-probe stage launch ordering
+        self.worker_locations = {
+            w: (worker_locations or {}).get(w.uri) for w in self.workers}
+        self.max_splits_per_node = max_splits_per_node
+        self.execution_policy = execution_policy
 
     def run(self, plan: PlanNode) -> MaterializedResult:
         try:
@@ -430,12 +440,25 @@ class MultiHostRunner:
             stage1: List[tuple] = []
             stage2: List[tuple] = []
             try:
-                probe_tasks = self._launch_stage1(
-                    join.left, probe_scan, lidx, kd, alive)
-                stage1 += probe_tasks
-                build_tasks = self._launch_stage1(
-                    join.right, build_scan, ridx, kd, alive)
-                stage1 += build_tasks
+                # phased policy (PhasedExecutionSchedule.java's core
+                # property): the BUILD side's stage-1 tasks launch
+                # before the probe side's, so probe scans never sit on
+                # workers while the build is still materializing;
+                # all_at_once launches both sides together
+                if self.execution_policy == "phased":
+                    build_tasks = self._launch_stage1(
+                        join.right, build_scan, ridx, kd, alive)
+                    stage1 += build_tasks
+                    probe_tasks = self._launch_stage1(
+                        join.left, probe_scan, lidx, kd, alive)
+                    stage1 += probe_tasks
+                else:
+                    probe_tasks = self._launch_stage1(
+                        join.left, probe_scan, lidx, kd, alive)
+                    stage1 += probe_tasks
+                    build_tasks = self._launch_stage1(
+                        join.right, build_scan, ridx, kd, alive)
+                    stage1 += build_tasks
 
                 partial = AggregationNode(
                     source=agg.source, group_exprs=agg.group_exprs,
@@ -680,10 +703,20 @@ class MultiHostRunner:
         if not alive:
             raise MultiHostUnsupported("no live workers")
 
+        from presto_tpu.parallel.scheduler import NodeSelector
+
+        conn = self.catalog.connector(scan.handle.connector_name)
         n_splits = scan.handle.num_splits
-        assignments: Dict[WorkerClient, List[int]] = {w: [] for w in alive}
-        for s in range(n_splits):
-            assignments[alive[s % len(alive)]].append(s)
+        preferred = None
+        if hasattr(conn, "split_location"):
+            preferred = {s: conn.split_location(scan.handle.table, s)
+                         for s in range(n_splits)}
+        selector = NodeSelector(
+            alive, max_splits_per_node=self.max_splits_per_node,
+            locations={id(w): self.worker_locations.get(w)
+                       for w in alive})
+        assignments: Dict[WorkerClient, List[int]] = selector.assign(
+            range(n_splits), preferred)
 
         results: List[bytes] = []
         lock = threading.Lock()

@@ -1,0 +1,132 @@
+"""Stage scheduling policies and node selection for the DCN tier.
+
+Reference analogs:
+- ``execution/scheduler/PhasedExecutionSchedule.java`` /
+  ``AllAtOnceExecutionSchedule.java`` — the ExecutionPolicy choosing
+  whether every stage of the fragment DAG starts at once or in
+  dependency phases (join build stages gated before their probes, so
+  probe-side tasks never sit idle holding memory while builds run).
+- ``execution/scheduler/NodeScheduler.java`` + ``SimpleNodeSelector`` /
+  ``TopologyAwareNodeSelector`` + ``NetworkTopology`` — split->node
+  placement with locality preference and max-splits-per-node
+  backpressure.
+
+TPU framing: the MESH tier needs neither (stages are phased by
+construction — ``lower_stages`` materializes a stage's inputs before
+the stage, and XLA owns intra-program scheduling); these policies serve
+the MULTI-HOST tier, where fragments really are independent HTTP tasks
+on independent machines.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ExecutionSchedule:
+    """Yields batches ("phases") of stages to launch together; the next
+    batch starts when the previous one's tasks are created."""
+
+    def __init__(self, fragments):
+        self.fragments = list(fragments)
+
+    def phases(self) -> List[List]:
+        raise NotImplementedError
+
+
+class AllAtOnceExecutionSchedule(ExecutionSchedule):
+    """Every stage starts immediately
+    (AllAtOnceExecutionSchedule.java)."""
+
+    def phases(self) -> List[List]:
+        return [self.fragments] if self.fragments else []
+
+
+class PhasedExecutionSchedule(ExecutionSchedule):
+    """Dependency-ordered phases: a fragment's children (its build
+    sides / upstream producers) start in earlier phases than the
+    fragment itself (PhasedExecutionSchedule.java's topological
+    ordering over the join-build dependency graph)."""
+
+    def phases(self) -> List[List]:
+        depth: Dict[int, int] = {}
+
+        def walk(frag) -> int:
+            if id(frag) in depth:
+                return depth[id(frag)]
+            d = 0
+            for ch in getattr(frag, "children", []):
+                d = max(d, walk(ch) + 1)
+            depth[id(frag)] = d
+            return d
+
+        roots = list(self.fragments)
+        for f in roots:
+            walk(f)
+        seen = set()
+        by_depth: Dict[int, List] = {}
+
+        def collect(frag):
+            if id(frag) in seen:
+                return
+            seen.add(id(frag))
+            by_depth.setdefault(depth[id(frag)], []).append(frag)
+            for ch in getattr(frag, "children", []):
+                collect(ch)
+
+        for f in roots:
+            collect(f)
+        # dependency-free fragments first (builds before their probes:
+        # depth 0 = no children)
+        return [by_depth[d] for d in sorted(by_depth)]
+
+
+class NodeSelector:
+    """Split->worker placement with locality preference and
+    max-splits-per-node backpressure (NodeScheduler.java +
+    TopologyAwareNodeSelector).
+
+    ``locations``: optional worker -> location string (e.g. a rack id).
+    A split whose connector reports a preferred location (duck-typed
+    ``split_location(table, split)``) is placed on a worker in that
+    location when one has headroom; otherwise the least-loaded worker
+    wins (the reference's fallback through topology tiers to the
+    cluster-wide pool)."""
+
+    def __init__(self, workers: Sequence, max_splits_per_node: int = 0,
+                 locations: Optional[Dict] = None):
+        self.workers = list(workers)
+        self.max_splits_per_node = max_splits_per_node  # 0 = unbounded
+        self.locations = dict(locations or {})
+
+    def _headroom(self, counts: Dict, w) -> bool:
+        if self.max_splits_per_node <= 0:
+            return True
+        return counts.get(id(w), 0) < self.max_splits_per_node
+
+    def assign(self, split_ids: Sequence[int],
+               preferred: Optional[Dict[int, str]] = None) -> Dict:
+        """{worker: [split ids]} — locality-preferred, then least
+        loaded; backpressure spills to other nodes, and when every node
+        is at its cap the caps stretch evenly (the reference queues
+        instead; here fragments are batch tasks, so stretching keeps
+        the whole batch schedulable)."""
+        preferred = preferred or {}
+        counts: Dict[int, int] = {}
+        out: Dict = {w: [] for w in self.workers}
+
+        def pick(candidates):
+            pool = [w for w in candidates if self._headroom(counts, w)]
+            if not pool:
+                pool = list(candidates)  # all at cap: stretch evenly
+            return min(pool, key=lambda w: counts.get(id(w), 0))
+
+        for s in split_ids:
+            loc = preferred.get(s)
+            local = [w for w in self.workers
+                     if loc is not None and self.locations.get(id(w)) == loc]
+            w = pick(local) if local and any(
+                self._headroom(counts, x) for x in local) else pick(self.workers)
+            out[w].append(s)
+            counts[id(w)] = counts.get(id(w), 0) + 1
+        return out

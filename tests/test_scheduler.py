@@ -1,0 +1,159 @@
+"""Stage scheduling policies + node selection (scheduler.py —
+PhasedExecutionSchedule / AllAtOnceExecutionSchedule /
+NodeScheduler+TopologyAwareNodeSelector analogs)."""
+
+import pytest
+
+from presto_tpu.parallel.scheduler import (
+    AllAtOnceExecutionSchedule,
+    NodeSelector,
+    PhasedExecutionSchedule,
+)
+
+
+class _Frag:
+    def __init__(self, name, children=()):
+        self.name = name
+        self.children = list(children)
+
+    def __repr__(self):
+        return self.name
+
+
+def test_phased_schedule_orders_builds_before_probes():
+    build = _Frag("build")
+    leaf = _Frag("leaf", [build])
+    merge = _Frag("merge", [leaf])
+    root = _Frag("root", [merge])
+    phases = PhasedExecutionSchedule([root]).phases()
+    names = [[f.name for f in p] for p in phases]
+    assert names == [["build"], ["leaf"], ["merge"], ["root"]]
+
+
+def test_phased_schedule_parallel_siblings_share_a_phase():
+    b1, b2 = _Frag("b1"), _Frag("b2")
+    leaf = _Frag("leaf", [b1, b2])
+    phases = PhasedExecutionSchedule([leaf]).phases()
+    assert [sorted(f.name for f in p) for p in phases] == [
+        ["b1", "b2"], ["leaf"]]
+
+
+def test_all_at_once_single_phase():
+    a, b = _Frag("a"), _Frag("b")
+    assert AllAtOnceExecutionSchedule([a, b]).phases() == [[a, b]]
+
+
+def test_phased_over_real_fragment_tree():
+    """The simulated fragment tree from a join+agg plan phases its
+    build fragment before the leaf that probes it."""
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.parallel.fragment import fragment_plan
+    from presto_tpu.runner import QueryRunner
+
+    cat = Catalog()
+    cat.register("tpch", Tpch(sf=0.001, split_rows=1024))
+    r = QueryRunner(cat)
+    plan = r.plan(
+        "SELECT o_orderpriority, count(*) FROM orders, customer "
+        "WHERE o_custkey = c_custkey GROUP BY o_orderpriority")
+    root = fragment_plan(plan, catalog=cat)
+    phases = PhasedExecutionSchedule([root]).phases()
+    # the customer build fragment must appear in an earlier phase than
+    # the orders leaf fragment that consumes it
+    def phase_of(pred):
+        for i, p in enumerate(phases):
+            for f in p:
+                if pred(f):
+                    return i
+        return None
+
+    build_i = phase_of(lambda f: str(f.output).startswith(("BROADCAST",
+                                                           "FIXED_HASH"))
+                       and not f.children)
+    leaf_i = phase_of(lambda f: f.children)
+    assert build_i is not None and leaf_i is not None
+    assert build_i < leaf_i
+
+
+class _W:
+    def __init__(self, uri):
+        self.uri = uri
+
+    def __repr__(self):
+        return self.uri
+
+
+def test_node_selector_balances_load():
+    ws = [_W("a"), _W("b"), _W("c")]
+    out = NodeSelector(ws).assign(range(9))
+    assert all(len(v) == 3 for v in out.values())
+
+
+def test_node_selector_prefers_local_workers():
+    ws = [_W("a"), _W("b"), _W("c")]
+    locs = {id(ws[0]): "rack1", id(ws[1]): "rack2", id(ws[2]): "rack2"}
+    sel = NodeSelector(ws, locations=locs)
+    preferred = {s: ("rack1" if s % 2 == 0 else "rack2") for s in range(8)}
+    out = sel.assign(range(8), preferred)
+    assert sorted(out[ws[0]]) == [0, 2, 4, 6]  # rack1 splits on a
+    assert sorted(out[ws[1]] + out[ws[2]]) == [1, 3, 5, 7]
+
+
+def test_node_selector_backpressure_spills_to_remote():
+    ws = [_W("a"), _W("b")]
+    locs = {id(ws[0]): "rack1", id(ws[1]): "rack2"}
+    sel = NodeSelector(ws, max_splits_per_node=2, locations=locs)
+    preferred = {s: "rack1" for s in range(4)}
+    out = sel.assign(range(4), preferred)
+    # locality wants everything on a, the cap pushes half to b
+    assert len(out[ws[0]]) == 2 and len(out[ws[1]]) == 2
+
+
+def test_node_selector_stretches_when_all_at_cap():
+    ws = [_W("a"), _W("b")]
+    sel = NodeSelector(ws, max_splits_per_node=1)
+    out = sel.assign(range(6))
+    assert len(out[ws[0]]) == 3 and len(out[ws[1]]) == 3  # stretched
+
+
+def test_multihost_honors_locality(tmp_path):
+    """End-to-end: a connector reporting split locations sees its
+    splits land on the matching workers."""
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.parallel.multihost import MultiHostRunner
+    from presto_tpu.runner import QueryRunner
+    from presto_tpu.server.worker import WorkerServer
+
+    class LocTpch(Tpch):
+        def split_location(self, table, split):
+            return "east" if split % 2 == 0 else "west"
+
+    def make_cat():
+        c = Catalog()
+        c.register("tpch", LocTpch(sf=0.002, split_rows=512))
+        return c
+
+    workers = [WorkerServer(make_cat()) for _ in range(2)]
+    for w in workers:
+        w.start()
+    try:
+        cat = make_cat()
+        local = QueryRunner(cat)
+        multi = MultiHostRunner(
+            cat, [w.uri for w in workers],
+            worker_locations={workers[0].uri: "east",
+                              workers[1].uri: "west"})
+        sql = "SELECT count(*), sum(o_totalprice) FROM orders"
+        got = multi.run(local.binder.plan(sql)).rows
+        want = local.executor.run(local.plan(sql)).rows
+        assert len(got) == len(want)
+        for (a1, a2), (e1, e2) in zip(got, want):
+            assert a1 == e1 and float(a2) == pytest.approx(float(e2))
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
