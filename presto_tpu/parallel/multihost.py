@@ -187,10 +187,17 @@ class MultiHostRunner:
         # scheduling policies (scheduler.py): split placement locality
         # keyed by worker URI, per-node split backpressure, and the
         # build-before-probe stage launch ordering
-        self.worker_locations = {
-            w: (worker_locations or {}).get(w.uri) for w in self.workers}
+        if execution_policy not in ("phased", "all_at_once"):
+            raise ValueError(
+                f"execution_policy must be 'phased' or 'all_at_once', "
+                f"got {execution_policy!r}")
+        locs = {k.rstrip("/"): v for k, v in (worker_locations or {}).items()}
+        self.worker_locations = {w: locs.get(w.uri) for w in self.workers}
         self.max_splits_per_node = max_splits_per_node
         self.execution_policy = execution_policy
+        # observability: last split placement per stage-launch
+        # ({worker uri: [split ids]})
+        self.last_assignments: Dict[str, List[int]] = {}
 
     def run(self, plan: PlanNode) -> MaterializedResult:
         try:
@@ -331,6 +338,25 @@ class MultiHostRunner:
             else:
                 return None
 
+    def _await_finished(self, tasks: List[tuple],
+                        timeout: float = 120.0) -> None:
+        """Poll task status until every task leaves RUNNING (the phased
+        gate between build and probe stages).  Bounded: on timeout the
+        next phase launches anyway — the pull buffers' backpressure
+        keeps a still-running build correct, just un-phased."""
+        deadline = time.time() + timeout
+        for w, tid in tasks:
+            while time.time() < deadline:
+                try:
+                    req = urllib.request.Request(f"{w.uri}/v1/task/{tid}")
+                    with urllib.request.urlopen(req, timeout=10.0) as resp:
+                        state = json.load(resp).get("state")
+                except Exception:
+                    return  # worker fault: surfaced by the next pull
+                if state != "RUNNING":
+                    break
+                time.sleep(0.02)
+
     def _fan_out_stage2(self, alive: List["WorkerClient"], make_frag,
                         stage2: List[tuple]) -> List[bytes]:
         """Create + drain one stage-2 task per worker concurrently
@@ -440,25 +466,43 @@ class MultiHostRunner:
             stage1: List[tuple] = []
             stage2: List[tuple] = []
             try:
-                # phased policy (PhasedExecutionSchedule.java's core
-                # property): the BUILD side's stage-1 tasks launch
-                # before the probe side's, so probe scans never sit on
-                # workers while the build is still materializing;
-                # all_at_once launches both sides together
-                if self.execution_policy == "phased":
-                    build_tasks = self._launch_stage1(
-                        join.right, build_scan, ridx, kd, alive)
-                    stage1 += build_tasks
-                    probe_tasks = self._launch_stage1(
-                        join.left, probe_scan, lidx, kd, alive)
-                    stage1 += probe_tasks
-                else:
-                    probe_tasks = self._launch_stage1(
-                        join.left, probe_scan, lidx, kd, alive)
-                    stage1 += probe_tasks
-                    build_tasks = self._launch_stage1(
-                        join.right, build_scan, ridx, kd, alive)
-                    stage1 += build_tasks
+                # stage launch order comes from the schedule policy
+                # (scheduler.py): phased gates the probe side on the
+                # build side's tasks FINISHING (builds are fully
+                # buffered before probes start scanning — the
+                # PhasedExecutionSchedule.java property); all_at_once
+                # launches both sides immediately
+                from presto_tpu.parallel.scheduler import (
+                    AllAtOnceExecutionSchedule,
+                    PhasedExecutionSchedule,
+                )
+
+                class _Side:
+                    def __init__(self, name, args, children=()):
+                        self.name = name
+                        self.args = args
+                        self.children = list(children)
+
+                build_side = _Side(
+                    "build", (join.right, build_scan, ridx))
+                probe_side = _Side(
+                    "probe", (join.left, probe_scan, lidx), [build_side])
+                sched_cls = (PhasedExecutionSchedule
+                             if self.execution_policy == "phased"
+                             else AllAtOnceExecutionSchedule)
+                launched: Dict[str, List[tuple]] = {}
+                phases = sched_cls([probe_side]).phases()
+                for pi, phase in enumerate(phases):
+                    for side in phase:
+                        subtree, scan_, idx_ = side.args
+                        tasks = self._launch_stage1(
+                            subtree, scan_, idx_, kd, alive)
+                        launched[side.name] = tasks
+                        stage1 += tasks
+                    if pi + 1 < len(phases):
+                        self._await_finished(launched["build"])
+                build_tasks = launched["build"]
+                probe_tasks = launched["probe"]
 
                 partial = AggregationNode(
                     source=agg.source, group_exprs=agg.group_exprs,
@@ -717,6 +761,8 @@ class MultiHostRunner:
                        for w in alive})
         assignments: Dict[WorkerClient, List[int]] = selector.assign(
             range(n_splits), preferred)
+        self.last_assignments = {w.uri: list(s)
+                                 for w, s in assignments.items()}
 
         results: List[bytes] = []
         lock = threading.Lock()

@@ -151,6 +151,12 @@ def test_multihost_honors_locality(tmp_path):
         assert len(got) == len(want)
         for (a1, a2), (e1, e2) in zip(got, want):
             assert a1 == e1 and float(a2) == pytest.approx(float(e2))
+        # placement actually honored locality: east worker got the even
+        # splits, west the odd ones
+        east = multi.last_assignments[workers[0].uri.rstrip("/")]
+        west = multi.last_assignments[workers[1].uri.rstrip("/")]
+        assert east and all(s % 2 == 0 for s in east)
+        assert west and all(s % 2 == 1 for s in west)
     finally:
         for w in workers:
             try:
