@@ -730,7 +730,16 @@ class LocalRunner:
         if isinstance(node, TableScanNode):
             conn = self.catalog.connector(node.handle.connector_name)
             idx = list(node.columns)
-            splits = node.splits if node.splits is not None else range(node.handle.num_splits)
+            # split enumeration happens at EXECUTION time, not plan time
+            # (DistributedExecutionPlanner opens SplitSources during
+            # planDistribution, so cached plans see connector-side
+            # changes — e.g. shardstore compaction/rebalance)
+            if node.splits is not None:
+                splits = node.splits
+            else:
+                splits = range(conn.num_splits(node.handle.table)
+                               if hasattr(conn, "num_splits")
+                               else node.handle.num_splits)
             td = None
             if node.constraints and hasattr(conn, "split_stats"):
                 from presto_tpu.predicate import TupleDomain

@@ -1,0 +1,450 @@
+"""Shard store: the engine's native storage engine — PCF shards on
+local disk behind a SQL (sqlite) shard-metadata database, with
+compaction, rebalancing across storage nodes, and backup/restore.
+
+Reference analog: ``presto-raptor`` (31k LoC) — ORC shards on worker
+disks + a MySQL metadata store (``raptor/metadata/DatabaseShardManager``),
+a shard compactor/organizer (``raptor/storage/organization/``), a
+rebalancer (``raptor/storage/ShardRecoveryManager`` / bucket balancer)
+and a pluggable backup store (``raptor/backup/BackupStore.java``).
+
+TPU-first redesign rather than a port:
+
+- Shard pruning happens **entirely in the metadata DB** (min/max
+  per-column stats stored per shard row) before any file is opened, so
+  a filtered scan launches one device program per *surviving* shard.
+- Every varchar column has ONE table-level dictionary owned by the
+  metadata DB; incoming writes are re-encoded to it (appending new
+  values — codes are stable forever).  All shard files therefore share
+  the same code space: cross-shard scans need no dictionary merging,
+  min/max code stats are meaningful for pruning, and compaction can
+  concatenate shard pages without re-encoding.
+- Shards are single-stripe PCF files bounded by ``max_shard_rows``;
+  an optional ``sorted_by`` table property keeps every shard sorted
+  (raptor's "organized tables"), which the engine's streaming
+  aggregation and merge paths exploit.
+- ``temporal_column`` groups compaction by disjoint value ranges so
+  time-correlated shards stay clustered (raptor's temporal
+  organization).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sqlite3
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu.page import Block, Dictionary, Page
+from presto_tpu.storage.pcf import PcfFile, _col_stats, _type_str, write_pcf
+from presto_tpu.types import Type, parse_type
+
+_SCHEMA_SQL = """
+CREATE TABLE IF NOT EXISTS tables (
+    table_id   INTEGER PRIMARY KEY,
+    name       TEXT UNIQUE NOT NULL,
+    schema     TEXT NOT NULL,          -- [[col, type], ...]
+    sorted_by  TEXT,                   -- json list or null
+    temporal   TEXT                    -- temporal column name or null
+);
+CREATE TABLE IF NOT EXISTS shards (
+    shard_uuid TEXT PRIMARY KEY,
+    table_id   INTEGER NOT NULL REFERENCES tables(table_id),
+    node       TEXT NOT NULL,
+    row_count  INTEGER NOT NULL,
+    data_bytes INTEGER NOT NULL,
+    stats      TEXT NOT NULL           -- {col: [min, max]}
+);
+CREATE INDEX IF NOT EXISTS shards_by_table ON shards(table_id);
+CREATE TABLE IF NOT EXISTS dictionaries (
+    table_id   INTEGER NOT NULL REFERENCES tables(table_id),
+    column     TEXT NOT NULL,
+    idx        INTEGER NOT NULL,
+    value      TEXT NOT NULL,
+    PRIMARY KEY (table_id, column, idx)
+);
+"""
+
+
+class ShardStoreConnector:
+    """Native storage engine: sqlite shard metadata over PCF shards."""
+
+    supports_table_properties = True
+
+    def __init__(self, root: str, nodes: Sequence[str] = ("node0",),
+                 max_shard_rows: int = 1 << 20,
+                 backup_root: Optional[str] = None):
+        self.root = root
+        self.nodes = list(nodes)
+        self.max_shard_rows = int(max_shard_rows)
+        self.backup_root = backup_root
+        os.makedirs(root, exist_ok=True)
+        for n in self.nodes:
+            os.makedirs(os.path.join(root, n), exist_ok=True)
+        if backup_root:
+            os.makedirs(backup_root, exist_ok=True)
+        self._db = sqlite3.connect(os.path.join(root, "metadata.db"))
+        self._db.executescript(_SCHEMA_SQL)
+        self._db.commit()
+        self._files: Dict[str, PcfFile] = {}
+        self._next_node = 0
+
+    # -- metadata helpers ---------------------------------------------------
+    def _table_row(self, name: str):
+        row = self._db.execute(
+            "SELECT table_id, schema, sorted_by, temporal FROM tables "
+            "WHERE name = ?", (name,)).fetchone()
+        if row is None:
+            raise KeyError(f"shardstore table {name!r} does not exist")
+        return row
+
+    def _shards(self, table: str) -> List[tuple]:
+        tid = self._table_row(table)[0]
+        return self._db.execute(
+            "SELECT shard_uuid, node, row_count, data_bytes, stats "
+            "FROM shards WHERE table_id = ? ORDER BY shard_uuid",
+            (tid,)).fetchall()
+
+    def _shard_path(self, node: str, shard_uuid: str) -> str:
+        return os.path.join(self.root, node, shard_uuid + ".pcf")
+
+    def _pcf(self, node: str, shard_uuid: str) -> PcfFile:
+        key = f"{node}/{shard_uuid}"
+        f = self._files.get(key)
+        if f is None:
+            f = self._files[key] = PcfFile(self._shard_path(node, shard_uuid))
+        return f
+
+    def _table_dict(self, tid: int, col: str) -> List[str]:
+        return [v for (v,) in self._db.execute(
+            "SELECT value FROM dictionaries WHERE table_id = ? AND "
+            "column = ? ORDER BY idx", (tid, col))]
+
+    # -- connector read SPI -------------------------------------------------
+    def table_names(self) -> List[str]:
+        return [n for (n,) in self._db.execute("SELECT name FROM tables")]
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return [(c, parse_type(t))
+                for c, t in json.loads(self._table_row(table)[1])]
+
+    def open_dictionary_columns(self, table: str) -> set:
+        """Every dictionary varchar column accepts unseen values: writes
+        re-encode onto the table dictionary, appending new entries."""
+        return {c for c, t in self.schema(table)
+                if t.is_string and not t.is_raw_string}
+
+    def sort_order(self, table: str) -> Optional[List[str]]:
+        s = self._table_row(table)[2]
+        return json.loads(s) if s else None
+
+    def num_splits(self, table: str) -> int:
+        return max(1, len(self._shards(table)))
+
+    def row_count(self, table: str) -> int:
+        tid = self._table_row(table)[0]
+        (n,) = self._db.execute(
+            "SELECT COALESCE(SUM(row_count), 0) FROM shards "
+            "WHERE table_id = ?", (tid,)).fetchone()
+        return int(n)
+
+    def dictionary_for(self, table: str, column: str) -> Optional[Dictionary]:
+        tid = self._table_row(table)[0]
+        vals = self._table_dict(tid, column)
+        return Dictionary(vals) if vals else None
+
+    def column_domain(self, table: str, column: str):
+        t = dict(self.schema(table))[column]
+        if t.is_string and not t.is_raw_string:
+            d = self.dictionary_for(table, column)
+            return (0, len(d) - 1) if d else None
+        los, his = [], []
+        for _, _, _, _, stats in self._shards(table):
+            st = json.loads(stats).get(column)
+            if st is None:
+                return None
+            los.append(st[0])
+            his.append(st[1])
+        return (min(los), max(his)) if los else None
+
+    def split_stats(self, table: str, split: int):
+        """Metadata-DB shard pruning: min/max per column straight from
+        the shards table — no file is opened for a pruned shard."""
+        shards = self._shards(table)
+        if not shards:
+            return {}
+        stats = json.loads(shards[split][4])
+        return {c: (v[0], v[1]) for c, v in stats.items()}
+
+    def page_for_split(self, table: str, split: int,
+                       capacity: Optional[int] = None,
+                       columns: Optional[Sequence[str]] = None) -> Page:
+        shards = self._shards(table)
+        if not shards:
+            return Page.empty([t for _, t in self.schema(table)], 1)
+        shard_uuid, node = shards[split][0], shards[split][1]
+        return self._pcf(node, shard_uuid).read_stripe(0, capacity=capacity)
+
+    # -- write SPI ----------------------------------------------------------
+    def create_table(self, name: str, schema, pages: Sequence[Page],
+                     domains=None, primary_key=None, sort_order=None,
+                     bucketing=None,
+                     properties: Optional[dict] = None) -> None:
+        props = properties or {}
+        sorted_by = props.get("sorted_by") or sort_order
+        if isinstance(sorted_by, str):
+            sorted_by = [sorted_by]
+        temporal = props.get("temporal_column")
+        cols = [c for c, _ in schema]
+        for c in (sorted_by or []) + ([temporal] if temporal else []):
+            if c not in cols:
+                raise ValueError(f"unknown column {c!r} in table property")
+        exists = self._db.execute(
+            "SELECT 1 FROM tables WHERE name = ?", (name,)).fetchone()
+        if exists:
+            # DELETE-by-rewrite recreates the table with survivor rows
+            self.drop_table(name)
+        cur = self._db.execute(
+            "INSERT INTO tables (name, schema, sorted_by, temporal) "
+            "VALUES (?, ?, ?, ?)",
+            (name, json.dumps([[c, _type_str(t)] for c, t in schema]),
+             json.dumps(sorted_by) if sorted_by else None, temporal))
+        tid = cur.lastrowid
+        self._write_shards(tid, name, list(schema), pages)
+        self._db.commit()
+
+    def append_pages(self, name: str, pages: Sequence[Page]) -> None:
+        tid = self._table_row(name)[0]
+        self._write_shards(tid, name, self.schema(name), pages)
+        self._db.commit()
+
+    def drop_table(self, name: str) -> None:
+        tid = self._table_row(name)[0]
+        for shard_uuid, node, *_ in self._shards(name):
+            self._files.pop(f"{node}/{shard_uuid}", None)
+            try:
+                os.unlink(self._shard_path(node, shard_uuid))
+            except FileNotFoundError:
+                pass
+        self._db.execute("DELETE FROM shards WHERE table_id = ?", (tid,))
+        self._db.execute("DELETE FROM dictionaries WHERE table_id = ?", (tid,))
+        self._db.execute("DELETE FROM tables WHERE table_id = ?", (tid,))
+        self._db.commit()
+
+    def rename_table(self, name: str, new_name: str) -> None:
+        if self._db.execute("SELECT 1 FROM tables WHERE name = ?",
+                            (new_name,)).fetchone():
+            raise ValueError(f"shardstore table {new_name} already exists")
+        self._table_row(name)  # existence check
+        self._db.execute("UPDATE tables SET name = ? WHERE name = ?",
+                         (new_name, name))
+        self._db.commit()
+
+    # -- transactions (staged writes) ---------------------------------------
+    def begin_transaction(self):
+        return _ShardTx()
+
+    def stage(self, tx: "_ShardTx", op: str, *args, **kwargs) -> None:
+        tx.ops.append((op, args, kwargs))
+
+    def commit_transaction(self, tx: "_ShardTx") -> None:
+        for op, args, kwargs in tx.ops:
+            getattr(self, op)(*args, **kwargs)
+        tx.ops.clear()
+
+    def rollback_transaction(self, tx: "_ShardTx") -> None:
+        tx.ops.clear()
+
+    # -- shard writing ------------------------------------------------------
+    def _encode_to_table_dict(self, tid: int, col: str, block_vals,
+                              codes: np.ndarray) -> np.ndarray:
+        """Remap one block's dictionary codes onto the table dictionary,
+        appending unseen values (codes are stable: append-only)."""
+        table_vals = self._table_dict(tid, col)
+        index = {v: i for i, v in enumerate(table_vals)}
+        remap = np.empty(len(block_vals), dtype=np.int32)
+        for i, v in enumerate(block_vals):
+            j = index.get(v)
+            if j is None:
+                j = len(index)
+                index[v] = j
+                self._db.execute(
+                    "INSERT INTO dictionaries (table_id, column, idx, value) "
+                    "VALUES (?, ?, ?, ?)", (tid, col, j, v))
+            remap[i] = j
+        return remap[np.asarray(codes, dtype=np.int64)]
+
+    def _write_shards(self, tid: int, name: str, schema, pages) -> None:
+        sorted_by = self.sort_order(name)
+        # one batched host transfer per page, then numpy throughout
+        pages = [p.compact_host() for p in pages]
+        pages = [p for p in pages if int(np.asarray(p.row_mask).sum()) > 0]
+        if not pages:
+            return
+        cols: List[np.ndarray] = []
+        valids: List[np.ndarray] = []
+        for i, (col, t) in enumerate(schema):
+            parts, vparts = [], []
+            for p in pages:
+                n = int(np.asarray(p.row_mask).sum())
+                b = p.blocks[i]
+                data = np.asarray(b.data)[:n]
+                if t.is_string and not t.is_raw_string and b.dictionary is not None:
+                    data = self._encode_to_table_dict(
+                        tid, col, list(b.dictionary.values), data)
+                parts.append(data)
+                vparts.append(np.asarray(b.valid)[:n])
+            cols.append(np.concatenate(parts))
+            valids.append(np.concatenate(vparts))
+        total = len(cols[0])
+        if sorted_by:
+            by_name = {c: i for i, (c, _) in enumerate(schema)}
+            keys = [cols[by_name[c]] for c in reversed(sorted_by)]
+            order = np.lexsort(keys)
+            cols = [c[order] for c in cols]
+            valids = [v[order] for v in valids]
+        dicts = {c: Dictionary(self._table_dict(tid, c))
+                 for c, t in schema
+                 if t.is_string and not t.is_raw_string and
+                 self._table_dict(tid, c)}
+        for lo in range(0, total, self.max_shard_rows):
+            hi = min(lo + self.max_shard_rows, total)
+            blocks, stats = [], {}
+            for (col, t), data, valid in zip(schema, cols, valids):
+                d, v = data[lo:hi], valid[lo:hi]
+                blocks.append(Block(d, v, t, dicts.get(col)))
+                st = _col_stats(d, v, t)
+                if "min" in st:
+                    stats[col] = [st["min"], st["max"]]
+            page = Page(tuple(blocks), np.ones(hi - lo, dtype=np.bool_))
+            shard_uuid = uuid.uuid4().hex
+            node = self.nodes[self._next_node % len(self.nodes)]
+            self._next_node += 1
+            path = self._shard_path(node, shard_uuid)
+            write_pcf(path, schema, [page])
+            if self.backup_root:  # eager backup (raptor BackupManager)
+                shutil.copyfile(
+                    path, os.path.join(self.backup_root, shard_uuid + ".pcf"))
+            self._db.execute(
+                "INSERT INTO shards (shard_uuid, table_id, node, row_count, "
+                "data_bytes, stats) VALUES (?, ?, ?, ?, ?, ?)",
+                (shard_uuid, tid, node, hi - lo, os.path.getsize(path),
+                 json.dumps(stats)))
+
+    # -- maintenance: compaction / rebalance / recovery ---------------------
+    def compact(self, table: str, target_rows: Optional[int] = None) -> int:
+        """Merge small shards into full ones (raptor's ShardCompactor).
+        Returns the number of shards eliminated.  With a temporal
+        column, only shards from the same temporal bucket merge, so
+        time-correlated data stays clustered."""
+        target = int(target_rows or self.max_shard_rows)
+        tid, schema_json, sorted_by, temporal = self._table_row(table)
+        schema = self.schema(table)
+        small = [s for s in self._shards(table) if s[2] < target]
+        if len(small) < 2:
+            return 0
+        if temporal:
+            # keep time-correlated shards together: order by temporal
+            # min, then greedily batch consecutive runs up to target
+            def tmin(shard):
+                st = json.loads(shard[4]).get(temporal)
+                return st[0] if st else float("inf")
+
+            small.sort(key=tmin)
+        groups: List[list] = [[]]
+        acc = 0
+        for s in small:
+            if acc + s[2] > target and groups[-1]:
+                groups.append([])
+                acc = 0
+            groups[-1].append(s)
+            acc += s[2]
+        eliminated = 0
+        for group in groups:
+            if len(group) < 2:
+                continue
+            pages = [self._pcf(node, su).read_stripe(0)
+                     for su, node, *_ in group]
+            # all shard files share the table dictionary: plain concat
+            old = [(su, node) for su, node, *_ in group]
+            with self._db:  # atomic metadata swap
+                self._db.executemany(
+                    "DELETE FROM shards WHERE shard_uuid = ?",
+                    [(su,) for su, _ in old])
+                self._write_shards(tid, table, schema, pages)
+            for su, node in old:
+                self._files.pop(f"{node}/{su}", None)
+                try:
+                    os.unlink(self._shard_path(node, su))
+                except FileNotFoundError:
+                    pass
+            eliminated += len(group)
+        return eliminated
+
+    def rebalance(self) -> int:
+        """Move shards so per-node byte totals even out (raptor's bucket
+        balancer).  Returns the number of shards moved."""
+        rows = self._db.execute(
+            "SELECT shard_uuid, node, data_bytes FROM shards").fetchall()
+        load = {n: 0 for n in self.nodes}
+        for _, node, b in rows:
+            load[node] = load.get(node, 0) + b
+        moved = 0
+        for shard_uuid, node, nbytes in sorted(rows, key=lambda r: -r[2]):
+            donor = max(load, key=load.get)
+            receiver = min(load, key=load.get)
+            if node != donor or donor == receiver:
+                continue
+            if load[donor] - load[receiver] <= nbytes:
+                continue
+            src = self._shard_path(node, shard_uuid)
+            dst = self._shard_path(receiver, shard_uuid)
+            shutil.move(src, dst)
+            with self._db:
+                self._db.execute(
+                    "UPDATE shards SET node = ? WHERE shard_uuid = ?",
+                    (receiver, shard_uuid))
+            self._files.pop(f"{node}/{shard_uuid}", None)
+            load[donor] -= nbytes
+            load[receiver] += nbytes
+            moved += 1
+        return moved
+
+    def restore_missing(self) -> int:
+        """Re-copy shard files lost from a node out of the backup store
+        (raptor's ShardRecoveryManager).  Returns shards restored."""
+        if not self.backup_root:
+            raise ValueError("shardstore has no backup_root configured")
+        restored = 0
+        for shard_uuid, node in self._db.execute(
+                "SELECT shard_uuid, node FROM shards"):
+            path = self._shard_path(node, shard_uuid)
+            if os.path.exists(path):
+                continue
+            bak = os.path.join(self.backup_root, shard_uuid + ".pcf")
+            if not os.path.exists(bak):
+                raise FileNotFoundError(
+                    f"shard {shard_uuid} missing and not in backup")
+            shutil.copyfile(bak, path)
+            self._files.pop(f"{node}/{shard_uuid}", None)
+            restored += 1
+        return restored
+
+    def shard_info(self, table: str) -> List[dict]:
+        """system-table style shard listing (raptor system.shards)."""
+        return [
+            {"shard_uuid": su, "node": node, "row_count": rc,
+             "data_bytes": b, "stats": json.loads(st)}
+            for su, node, rc, b, st in self._shards(table)
+        ]
+
+
+class _ShardTx:
+    """Staged write list (ConnectorTransactionHandle analog)."""
+
+    def __init__(self):
+        self.ops: list = []

@@ -1,0 +1,160 @@
+"""Shard store — the presto-raptor architectural slot (PCF shards +
+sqlite shard metadata + compactor/rebalancer/backup;
+``presto-raptor/.../metadata/DatabaseShardManager.java``,
+``storage/organization/ShardCompactor.java``, ``backup/BackupStore.java``)."""
+
+import os
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+from presto_tpu.storage.shardstore import ShardStoreConnector
+
+
+@pytest.fixture()
+def ss_runner(tmp_path):
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.002, split_rows=1024))
+    ss = ShardStoreConnector(
+        str(tmp_path / "ss"), nodes=("n1", "n2", "n3"),
+        max_shard_rows=600, backup_root=str(tmp_path / "backup"))
+    catalog.register("ss", ss, writable=True)
+    return QueryRunner(catalog), ss
+
+
+def test_ctas_roundtrip_and_shard_bound(ss_runner):
+    r, ss = ss_runner
+    r.execute("CREATE TABLE ss.orders_s AS "
+              "SELECT o_orderkey, o_custkey, o_totalprice, o_orderpriority "
+              "FROM orders")
+    want = r.execute(
+        "SELECT count(*), sum(o_totalprice), min(o_orderpriority) "
+        "FROM orders").rows
+    got = r.execute(
+        "SELECT count(*), sum(o_totalprice), min(o_orderpriority) "
+        "FROM orders_s").rows
+    assert got == want
+    # shards respect max_shard_rows and spread across nodes
+    info = ss.shard_info("orders_s")
+    assert all(s["row_count"] <= 600 for s in info)
+    assert len({s["node"] for s in info}) > 1
+
+
+def test_metadata_pruning_skips_files(ss_runner):
+    r, ss = ss_runner
+    r.execute("CREATE TABLE ss.orders_s AS "
+              "SELECT o_orderkey, o_totalprice FROM orders")
+    # pruning decision comes from the metadata DB alone; only matching
+    # shard files may be opened
+    info = ss.shard_info("orders_s")
+    lo = min(s["stats"]["o_orderkey"][0] for s in info)
+    matching = [s for s in info if s["stats"]["o_orderkey"][0] <= lo
+                <= s["stats"]["o_orderkey"][1]]
+    opened_before = set(ss._files)
+    (cnt,) = r.execute(
+        f"SELECT count(*) FROM orders_s WHERE o_orderkey = {lo}").rows[0]
+    assert cnt >= 1
+    opened = {k.split("/")[1] for k in set(ss._files) - opened_before}
+    assert opened <= {s["shard_uuid"] for s in matching}
+    assert len(opened) < len(info)
+
+
+def test_insert_extends_table_dictionary(ss_runner):
+    r, ss = ss_runner
+    r.execute("CREATE TABLE ss.t AS SELECT o_orderpriority FROM orders "
+              "WHERE o_orderkey < 100")
+    r.execute("INSERT INTO ss.t SELECT 'brand-new-value'")
+    vals = ss.dictionary_for("t", "o_orderpriority").values
+    assert "brand-new-value" in vals
+    (cnt,) = r.execute("SELECT count(*) FROM t "
+                       "WHERE o_orderpriority = 'brand-new-value'").rows[0]
+    assert cnt == 1
+
+
+def test_compaction_preserves_results(ss_runner):
+    r, ss = ss_runner
+    r.execute("CREATE TABLE ss.small AS "
+              "SELECT o_orderkey, o_totalprice, o_orderpriority FROM orders "
+              "WHERE o_orderkey < 512")
+    for lo in (512, 1024, 1536, 2048):
+        r.execute(f"INSERT INTO ss.small SELECT o_orderkey, o_totalprice, "
+                  f"o_orderpriority FROM orders "
+                  f"WHERE o_orderkey >= {lo} AND o_orderkey < {lo + 512}")
+    want = r.execute("SELECT count(*), sum(o_totalprice) FROM small").rows
+    before = len(ss.shard_info("small"))
+    eliminated = ss.compact("small")
+    assert eliminated > 0
+    assert len(ss.shard_info("small")) < before
+    assert r.execute("SELECT count(*), sum(o_totalprice) FROM small").rows \
+        == want
+    # dictionary-encoded column survives the merge
+    assert r.execute(
+        "SELECT o_orderpriority, count(*) FROM small "
+        "GROUP BY o_orderpriority ORDER BY o_orderpriority").rows == \
+        r.execute(
+        "SELECT o_orderpriority, count(*) FROM orders "
+        "WHERE o_orderkey < 2560 "
+        "GROUP BY o_orderpriority ORDER BY o_orderpriority").rows
+
+
+def test_sorted_by_keeps_shards_sorted(ss_runner):
+    r, ss = ss_runner
+    r.execute("CREATE TABLE ss.sorted_t WITH (sorted_by = 'o_totalprice') AS "
+              "SELECT o_orderkey, o_totalprice FROM orders")
+    assert ss.sort_order("sorted_t") == ["o_totalprice"]
+    import numpy as np
+    for i in range(ss.num_splits("sorted_t")):
+        p = ss.page_for_split("sorted_t", i)
+        n = int(np.asarray(p.row_mask).sum())
+        prices = np.asarray(p.blocks[1].data)[:n]
+        assert (np.diff(prices) >= 0).all()
+
+
+def test_rebalance_evens_nodes(tmp_path):
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.002, split_rows=1024))
+    ss = ShardStoreConnector(str(tmp_path / "ss"), nodes=("a",),
+                             max_shard_rows=500)
+    catalog.register("ss", ss, writable=True)
+    r = QueryRunner(catalog)
+    r.execute("CREATE TABLE ss.t AS SELECT o_orderkey, o_totalprice "
+              "FROM orders")
+    want = r.execute("SELECT sum(o_totalprice) FROM t").rows
+    # a new node joins empty; rebalance must move shards onto it
+    ss.nodes.append("b")
+    os.makedirs(os.path.join(ss.root, "b"), exist_ok=True)
+    moved = ss.rebalance()
+    assert moved > 0
+    nodes = {s["node"] for s in ss.shard_info("t")}
+    assert nodes == {"a", "b"}
+    assert r.execute("SELECT sum(o_totalprice) FROM t").rows == want
+
+
+def test_backup_restore_lost_shard(ss_runner):
+    r, ss = ss_runner
+    r.execute("CREATE TABLE ss.t AS SELECT o_orderkey, o_totalprice "
+              "FROM orders")
+    want = r.execute("SELECT count(*), sum(o_totalprice) FROM t").rows
+    # lose one shard file from its node
+    victim = ss.shard_info("t")[0]
+    os.unlink(ss._shard_path(victim["node"], victim["shard_uuid"]))
+    ss._files.clear()
+    assert ss.restore_missing() == 1
+    assert r.execute("SELECT count(*), sum(o_totalprice) FROM t").rows == want
+
+
+def test_delete_rewrite_and_drop(ss_runner):
+    r, ss = ss_runner
+    r.execute("CREATE TABLE ss.t AS SELECT o_orderkey, o_totalprice "
+              "FROM orders")
+    (total,) = r.execute("SELECT count(*) FROM t").rows[0]
+    r.execute("DELETE FROM t WHERE o_orderkey % 2 = 0")
+    (odd,) = r.execute("SELECT count(*) FROM t").rows[0]
+    assert 0 < odd < total
+    r.execute("DROP TABLE ss.t")
+    assert "t" not in ss.table_names()
+    assert not any(f.endswith(".pcf")
+                   for n in ss.nodes
+                   for f in os.listdir(os.path.join(ss.root, n)))
