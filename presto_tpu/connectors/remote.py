@@ -1,0 +1,250 @@
+"""Remote table service: any process can serve tables to the engine
+over a small HTTP + binary-page protocol.
+
+Reference analog: ``presto-thrift-connector`` (+ ``presto-thrift-api``,
+``presto-thrift-testing-server``) — a connector whose backend is any
+external service implementing ``PrestoThriftService`` (listTables /
+getTableMetadata / getSplits / getRows), letting teams expose bespoke
+storage to the engine without writing a connector.  Here the service
+interface is HTTP endpoints speaking the engine's deduplicated binary
+page frame (``server/serde.py``) instead of Thrift structs:
+
+    GET  /v1/svc/tables                      table list (JSON)
+    GET  /v1/svc/{table}/meta                schema / counts / dicts /
+                                             index capability (JSON)
+    GET  /v1/svc/{table}/stats/{split}       split min-max stats (JSON)
+    GET  /v1/svc/{table}/page/{split}        one split (binary page)
+    POST /v1/svc/{table}/index_lookup        point fetch (binary page)
+
+``TableServiceServer`` turns ANY object satisfying the duck-typed
+connector SPI into such a service (the testing-server analog);
+``RemoteConnector`` is the engine-side client.  Dictionaries ride the
+meta response once and are pinned client-side, so binary pages carry
+only codes (the r3 deduplicated wire format).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.server.serde import (deserialize_page, encode_page_batch,
+                                     parse_page_batch, serialize_page,
+                                     type_from_json, type_to_json)
+from presto_tpu.types import Type
+
+
+class TableServiceServer:
+    """Serve a {name: connector} mapping as a remote table service."""
+
+    def __init__(self, backings: Dict[str, object], host: str = "127.0.0.1",
+                 port: int = 0):
+        self._backings = dict(backings)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, code: int = 200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _binary(self, body: bytes):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-presto-page")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _resolve(self, table: str):
+                for conn in outer._backings.values():
+                    if table in conn.table_names():
+                        return conn
+                return None
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                try:
+                    if parts[:2] != ["v1", "svc"]:
+                        return self._json({"error": "not found"}, 404)
+                    if parts[2:] == ["tables"]:
+                        names: List[str] = []
+                        for conn in outer._backings.values():
+                            names.extend(conn.table_names())
+                        return self._json(sorted(set(names)))
+                    table = urllib.parse.unquote(parts[2])
+                    conn = self._resolve(table)
+                    if conn is None:
+                        return self._json({"error": "no such table"}, 404)
+                    if parts[3:] == ["meta"]:
+                        schema = conn.schema(table)
+                        dicts = {}
+                        if hasattr(conn, "dictionary_for"):
+                            for c, t in schema:
+                                if t.is_string and not t.is_raw_string:
+                                    d = conn.dictionary_for(table, c)
+                                    if d is not None:
+                                        dicts[c] = list(d.values)
+                        domains = {}
+                        if hasattr(conn, "column_domain"):
+                            for c, _ in schema:
+                                dom = conn.column_domain(table, c)
+                                if dom is not None:
+                                    domains[c] = list(dom)
+                        return self._json({
+                            "schema": [[c, type_to_json(t)] for c, t in schema],
+                            "num_splits": conn.num_splits(table),
+                            "row_count": conn.row_count(table)
+                            if hasattr(conn, "row_count") else None,
+                            "dictionaries": dicts,
+                            "domains": domains,
+                            "has_stats": hasattr(conn, "split_stats"),
+                            "has_index": hasattr(conn, "index_lookup"),
+                        })
+                    if len(parts) == 5 and parts[3] == "stats":
+                        if not hasattr(conn, "split_stats"):
+                            return self._json({})
+                        st = conn.split_stats(table, int(parts[4]))
+                        return self._json({c: list(v) for c, v in st.items()})
+                    if len(parts) == 5 and parts[3] == "page":
+                        page = conn.page_for_split(table, int(parts[4]))
+                        return self._binary(serialize_page(page))
+                    return self._json({"error": "not found"}, 404)
+                except Exception as e:  # surface backend errors to client
+                    return self._json({"error": repr(e)}, 500)
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                try:
+                    if (len(parts) == 4 and parts[:2] == ["v1", "svc"]
+                            and parts[3] == "index_lookup"):
+                        table = urllib.parse.unquote(parts[2])
+                        conn = self._resolve(table)
+                        if conn is None or not hasattr(conn, "index_lookup"):
+                            return self._json({"error": "no index"}, 404)
+                        ln = int(self.headers.get("Content-Length", "0"))
+                        req = json.loads(self.rfile.read(ln).decode())
+                        keys = [tuple(k) if isinstance(k, list) else k
+                                for k in req["keys"]]
+                        pages = conn.index_lookup(table, req["columns"], keys)
+                        if isinstance(pages, Page):
+                            pages = [pages]
+                        return self._binary(encode_page_batch(
+                            [serialize_page(p) for p in pages]))
+                    return self._json({"error": "not found"}, 404)
+                except Exception as e:
+                    return self._json({"error": repr(e)}, 500)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> "TableServiceServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class RemoteConnector:
+    """Engine-side client for a remote table service."""
+
+    def __init__(self, uri: str, timeout: float = 30.0):
+        self.uri = uri.rstrip("/")
+        self.timeout = timeout
+        self._meta: Dict[str, dict] = {}
+        self._dicts: Dict[str, Dict[str, Dictionary]] = {}
+
+    # -- transport ----------------------------------------------------------
+    def _get(self, path: str) -> bytes:
+        with urllib.request.urlopen(
+                f"{self.uri}{path}", timeout=self.timeout) as r:
+            return r.read()
+
+    def _get_json(self, path: str):
+        return json.loads(self._get(path).decode())
+
+    def meta(self, table: str) -> dict:
+        m = self._meta.get(table)
+        if m is None:
+            m = self._meta[table] = self._get_json(
+                f"/v1/svc/{urllib.parse.quote(table)}/meta")
+            self._dicts[table] = {c: Dictionary(v)
+                                  for c, v in m["dictionaries"].items()}
+            if m.get("has_index"):
+                # advertise the capability only when the service has it
+                # (the binder's index-join rule gates on hasattr)
+                self.index_lookup = self._index_lookup
+        return m
+
+    # -- connector SPI ------------------------------------------------------
+    def table_names(self) -> List[str]:
+        return self._get_json("/v1/svc/tables")
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        return [(c, type_from_json(t)) for c, t in self.meta(table)["schema"]]
+
+    def num_splits(self, table: str) -> int:
+        return int(self.meta(table)["num_splits"])
+
+    def row_count(self, table: str) -> int:
+        rc = self.meta(table)["row_count"]
+        if rc is not None:
+            return int(rc)
+        import numpy as np
+
+        return sum(int(np.asarray(self.page_for_split(table, s).row_mask).sum())
+                   for s in range(self.num_splits(table)))
+
+    def dictionary_for(self, table: str, column: str) -> Optional[Dictionary]:
+        self.meta(table)
+        return self._dicts[table].get(column)
+
+    def column_domain(self, table: str, column: str):
+        dom = self.meta(table)["domains"].get(column)
+        return tuple(dom) if dom else None
+
+    def split_stats(self, table: str, split: int):
+        if not self.meta(table)["has_stats"]:
+            return {}
+        st = self._get_json(
+            f"/v1/svc/{urllib.parse.quote(table)}/stats/{split}")
+        return {c: tuple(v) for c, v in st.items()}
+
+    def _page_dicts(self, table: str) -> list:
+        self.meta(table)
+        return [self._dicts[table].get(c) for c, _ in self.meta(table)["schema"]]
+
+    def page_for_split(self, table: str, split: int,
+                       capacity: Optional[int] = None,
+                       columns: Optional[Sequence[str]] = None) -> Page:
+        raw = self._get(f"/v1/svc/{urllib.parse.quote(table)}/page/{split}")
+        return deserialize_page(raw, dictionaries=self._page_dicts(table))
+
+    def _index_lookup(self, table: str, columns: Sequence[str],
+                      keys) -> List[Page]:
+        body = json.dumps({"columns": list(columns),
+                           "keys": [list(k) if isinstance(k, tuple) else k
+                                    for k in keys]}).encode()
+        req = urllib.request.Request(
+            f"{self.uri}/v1/svc/{urllib.parse.quote(table)}/index_lookup",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            raw = r.read()
+        dicts = self._page_dicts(table)
+        return [deserialize_page(r, dictionaries=dicts)
+                for r in parse_page_batch(raw)]
