@@ -1,0 +1,101 @@
+"""RGF second file format — the presto-rcfile slot (row groups, sync
+markers, byte-range splits, binary/text serdes;
+``presto-rcfile/.../RcFileReader.java`` sync resync)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.page import Dictionary, Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.storage.rgf import RgfConnector, RgfFile, write_rgf
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+
+
+def _pages(groups=7, rows=500):
+    rng = np.random.RandomState(7)
+    dic = Dictionary(["aa", "bb", "cc"])
+    out = []
+    for g in range(groups):
+        n = rows + g
+        out.append(Page.from_arrays(
+            [np.arange(g * 10_000, g * 10_000 + n, dtype=np.int64),
+             rng.rand(n),
+             rng.randint(0, 3, n).astype(np.int32)],
+            [BIGINT, DOUBLE, VARCHAR],
+            valids=[np.ones(n, bool), rng.rand(n) > 0.1, np.ones(n, bool)],
+            dictionaries=[None, None, dic]))
+    return out
+
+
+@pytest.mark.parametrize("serde", ["binary", "text"])
+def test_roundtrip(tmp_path, serde):
+    pages = _pages(3)
+    path = str(tmp_path / "t.rgf")
+    write_rgf(path, [("k", BIGINT), ("x", DOUBLE), ("s", VARCHAR)], pages,
+              serde=serde)
+    f = RgfFile(path)
+    assert f.rows == sum(p.capacity for p in pages)
+    got = f.read_range(0, f.size)
+    assert len(got) == 3
+    for want, have in zip(pages, got):
+        np.testing.assert_array_equal(
+            np.asarray(want.blocks[0].data), np.asarray(have.blocks[0].data))
+        wv = np.asarray(want.blocks[1].valid)
+        np.testing.assert_array_equal(wv, np.asarray(have.blocks[1].valid))
+        if serde == "binary":  # text serde stores 17 digits, binary exact
+            np.testing.assert_array_equal(
+                np.asarray(want.blocks[1].data)[wv],
+                np.asarray(have.blocks[1].data)[wv])
+        else:
+            np.testing.assert_allclose(
+                np.asarray(want.blocks[1].data)[wv],
+                np.asarray(have.blocks[1].data)[wv], rtol=1e-15)
+        assert have.blocks[2].dictionary.values == ("aa", "bb", "cc") or \
+            list(have.blocks[2].dictionary.values) == ["aa", "bb", "cc"]
+
+
+def test_byte_ranges_tile_exactly(tmp_path):
+    """The RCFile property: ANY partition of [0, size) into byte ranges
+    reads every row group exactly once."""
+    pages = _pages(9)
+    total = sum(p.capacity for p in pages)
+    path = str(tmp_path / "t.rgf")
+    write_rgf(path, [("k", BIGINT), ("x", DOUBLE), ("s", VARCHAR)], pages)
+    f = RgfFile(path)
+    for nsplits in (1, 2, 3, 5, 8, 40):
+        bounds = np.linspace(0, f.size, nsplits + 1).astype(int)
+        seen = 0
+        keys = []
+        for lo, hi in zip(bounds, bounds[1:]):
+            for p in f.read_range(int(lo), int(hi)):
+                seen += p.capacity
+                keys.append(np.asarray(p.blocks[0].data))
+        assert seen == total, (nsplits, seen, total)
+        allk = np.concatenate(keys)
+        assert len(np.unique(allk)) == total  # no group read twice
+
+
+def test_connector_scan_and_ctas(tmp_path):
+    # engine CTAS from TPC-H into nothing (RGF is read-only here):
+    # write via the API, scan via SQL, compare against the source
+    cat = Catalog()
+    tpch = Tpch(sf=0.002, split_rows=2048)
+    cat.register("tpch", tpch)
+    r0 = QueryRunner(cat)
+    schema = [(c, t) for c, t in tpch.schema("orders")]
+    pages = [tpch.page_for_split("orders", s)
+             for s in range(tpch.num_splits("orders"))]
+    root = tmp_path / "rgf"
+    root.mkdir()
+    write_rgf(str(root / "orders.rgf"), schema, pages)
+    cat2 = Catalog()
+    cat2.register("rgf", RgfConnector(str(root), split_bytes=1 << 15))
+    r = QueryRunner(cat2)
+    conn = cat2.connector("rgf")
+    assert conn.num_splits("orders") > 1  # small ranges -> real splits
+    for sql in ("SELECT count(*), sum(o_totalprice) FROM orders",
+                "SELECT o_orderpriority, count(*) FROM orders "
+                "GROUP BY o_orderpriority ORDER BY o_orderpriority"):
+        assert r.execute(sql).rows == r0.execute(sql).rows
