@@ -1662,7 +1662,7 @@ class ExprCompiler:
         if fn == "substr":
             start = int(expr.args[1].value)
             length = int(expr.args[2].value) if len(expr.args) > 2 else None
-            return lambda page: ((lambda dv: (rs.substr(dv[0], start, length), dv[1]))(cf(page)))
+            return lambda page: ((lambda dv: (rs.substr_chars(dv[0], start, length), dv[1]))(cf(page)))
         if fn in ("upper", "lower"):
             up = fn == "upper"
             return lambda page: ((lambda dv: (rs.change_case(dv[0], up), dv[1]))(cf(page)))

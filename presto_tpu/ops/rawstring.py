@@ -78,20 +78,54 @@ def compare(a: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 def substr(data: jax.Array, start: int, length=None) -> jax.Array:
-    """1-based static slice, re-padded to the column width (the type's
-    declared width is preserved; only the live bytes change)."""
+    """1-based static BYTE slice, re-padded to the column width (the
+    type's declared width is preserved; only the live bytes change).
+    Internal helper — SQL substr routes through :func:`substr_chars`,
+    which counts UTF-8 characters."""
     w = data.shape[-1]
     s = max(start - 1, 0)
     end = w if length is None else min(s + length, w)
     return _pad_to(data[..., s:end], w)
 
 
+def substr_chars(data: jax.Array, start: int, length=None) -> jax.Array:
+    """1-based substring by UTF-8 CHARACTER count, on device (SQL
+    semantics: a multi-byte code point is one position; byte slicing
+    would cut sequences mid-codepoint).  Char starts are the bytes that
+    are neither padding NULs nor continuations ((b & 0xC0) == 0x80); a
+    stable argsort compacts the kept bytes to the row prefix — O(W log
+    W) per row at the static column width, no scalar loops."""
+    is_byte = data != 0
+    is_start = is_byte & ((data & 0xC0) != 0x80)
+    char_idx = jnp.cumsum(is_start.astype(jnp.int32), axis=-1) - 1
+    s = max(start - 1, 0)  # same clamp as the byte path / SQL 1-based
+    keep = is_byte & (char_idx >= s)
+    if length is not None:
+        keep = keep & (char_idx < s + length)
+    order = jnp.argsort(~keep, axis=-1, stable=True)
+    vals = jnp.take_along_axis(data, order, axis=-1)
+    kept = jnp.take_along_axis(keep, order, axis=-1)
+    return jnp.where(kept, vals, 0)
+
+
 def change_case(data: jax.Array, upper: bool) -> jax.Array:
+    """ASCII + Latin-1 case mapping on device.  Bytes >= 0x80 outside
+    the UTF-8 0xC3 page pass through unchanged (never corrupting a
+    multi-byte sequence, since only letter bytes are remapped); the
+    Latin-1 letters À..Þ/à..þ live on the 0xC3 continuation byte and
+    map with a fixed ±0x20 like ASCII.  ÿ→Ÿ (prefix change) and full
+    Unicode case folding stay host-side (documented deviation)."""
+    prev = jnp.pad(data[..., :-1], [(0, 0)] * (data.ndim - 1) + [(1, 0)])
+    after_c3 = prev == 0xC3
     if upper:
-        in_range = (data >= ord("a")) & (data <= ord("z"))
-        return jnp.where(in_range, data - 32, data)
-    in_range = (data >= ord("A")) & (data <= ord("Z"))
-    return jnp.where(in_range, data + 32, data)
+        ascii_hit = (data >= ord("a")) & (data <= ord("z"))
+        # à (0xC3 0xA0) .. þ (0xC3 0xBE), excluding ÷ (0xC3 0xB7)
+        lat_hit = after_c3 & (data >= 0xA0) & (data <= 0xBE) & (data != 0xB7)
+        return jnp.where(ascii_hit | lat_hit, data - 32, data)
+    ascii_hit = (data >= ord("A")) & (data <= ord("Z"))
+    # À (0xC3 0x80) .. Þ (0xC3 0x9E), excluding × (0xC3 0x97)
+    lat_hit = after_c3 & (data >= 0x80) & (data <= 0x9E) & (data != 0x97)
+    return jnp.where(ascii_hit | lat_hit, data + 32, data)
 
 
 def concat(a: jax.Array, b: jax.Array) -> jax.Array:

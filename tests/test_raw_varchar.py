@@ -235,3 +235,56 @@ def test_pack_lanes_roundtrip_and_order():
     # lane tuple order == byte order
     order = sorted(range(len(vals)), key=lambda i: tuple(lanes[i]))
     assert [vals[i] for i in order] == sorted(vals)
+
+
+# -- Unicode on the raw device path (r4): substr counts UTF-8 chars,
+# -- case mapping covers ASCII + Latin-1 without corrupting sequences
+
+UNI = ["héllo wörld", "ÀÉÎÕÜ mixed", "naïve café", "ascii only",
+       "öß and þorn", "日本語テスト", ""]
+
+
+@pytest.fixture(scope="module")
+def uni_runner():
+    mem = MemoryConnector()
+    t = VarcharType(32, raw=True)
+    page = Page.from_arrays(
+        [np.arange(len(UNI), dtype=np.int64), UNI], [BIGINT, t])
+    mem.create_table("uni", [("id", BIGINT), ("s", t)], [page])
+    catalog = Catalog()
+    catalog.register("mem", mem)
+    return QueryRunner(catalog)
+
+
+def test_substr_counts_characters(uni_runner):
+    rows = uni_runner.execute(
+        "select id, substr(s, 2, 4) from uni order by id").rows
+    got = {i: s for i, s in rows}
+    for i, s in enumerate(UNI):
+        assert got[i] == s[1:5], (s, got[i])
+
+
+def test_substr_no_length_suffix(uni_runner):
+    rows = uni_runner.execute(
+        "select id, substr(s, 3) from uni order by id").rows
+    got = {i: s for i, s in rows}
+    for i, s in enumerate(UNI):
+        assert got[i] == s[2:], (s, got[i])
+
+
+def test_upper_lower_latin1(uni_runner):
+    rows = uni_runner.execute(
+        "select id, upper(s), lower(s) from uni order by id").rows
+    for i, up, lo in rows:
+        s = UNI[i]
+        # python casing restricted to chars whose upper/lower stays
+        # one char in Latin-1 (ß→SS and ÿ→Ÿ are documented deviations)
+        want_up = "".join(
+            c.upper() if c.upper() != "SS" and ord(c) != 0xFF
+            and len(c.upper()) == 1 and ord(c.upper()) < 0x100 else c
+            for c in s)
+        want_lo = "".join(
+            c.lower() if len(c.lower()) == 1 and ord(c.lower()) < 0x100
+            else c for c in s)
+        assert up == want_up, (s, up, want_up)
+        assert lo == want_lo, (s, lo, want_lo)
