@@ -326,6 +326,283 @@ class RemoveRedundantDistinct(Rule):
         return src
 
 
+def _empty_like(node: PlanNode) -> ValuesNode:
+    """Zero-row Values with the node's exact output channels (the
+    RemoveEmpty* rules' replacement relation)."""
+    chans = node.channels
+    return ValuesNode(
+        names=[c.name for c in chans], types=[c.type for c in chans],
+        rows=[], dictionaries=[c.dictionary for c in chans])
+
+
+def _is_empty(node: PlanNode) -> bool:
+    return isinstance(node, ValuesNode) and not node.rows
+
+
+class EvaluateZeroLimit(Rule):
+    """LIMIT 0 / TopN 0 produce nothing (EvaluateZeroLimit.java /
+    EvaluateZeroTopN variant)."""
+
+    pattern = Pattern.type_of((LimitNode, TopNNode)).where(
+        lambda n: n.count == 0)
+
+    def apply(self, node) -> Optional[PlanNode]:
+        return _empty_like(node)
+
+
+class PropagateEmptyValues(Rule):
+    """Collapse operators over provably-empty inputs (the
+    RemoveEmpty… rule family: empty scans, 1=0 filters and LIMIT 0
+    propagate upward instead of compiling device programs):
+
+    - Filter/Project/Sort/TopN/Limit/Window over empty -> empty
+    - grouped aggregation over empty -> empty (global aggregation
+      keeps its one-row result and is left alone)
+    - inner join with either side empty, left/semi/anti joins with an
+      empty probe, and semi joins with an empty build -> empty
+    - union arms that are empty drop out
+    """
+
+    pattern = Pattern.type_of(PlanNode).where(
+        lambda n: any(_is_empty(s) for s in n.sources))
+
+    def apply(self, node: PlanNode) -> Optional[PlanNode]:
+        from presto_tpu.planner.plan import JoinNode, WindowNode
+
+        if isinstance(node, (FilterNode, ProjectNode, SortNode, TopNNode,
+                             LimitNode, WindowNode)):
+            return _empty_like(node)
+        if isinstance(node, AggregationNode):
+            if node.group_exprs and node.step in ("single", "partial"):
+                return _empty_like(node)
+            return None
+        if isinstance(node, JoinNode):
+            left_empty = _is_empty(node.left)
+            right_empty = _is_empty(node.right)
+            if node.kind == "inner" and (left_empty or right_empty):
+                return _empty_like(node)
+            if node.kind in ("left", "semi", "anti", "mark") and left_empty:
+                return _empty_like(node)
+            if node.kind == "semi" and right_empty:
+                return _empty_like(node)
+            return None
+        if isinstance(node, UnionNode):
+            live = [i for i in node.inputs if not _is_empty(i)]
+            if not live:
+                return _empty_like(node)
+            if len(live) == len(node.inputs):
+                return None
+            if len(live) == 1:
+                arm = live[0]
+                return ProjectNode(
+                    arm,
+                    [ColumnRef(type=c.type, index=i, name=c.name)
+                     for i, c in enumerate(arm.channels)],
+                    list(node.output_names))
+            return UnionNode(live)
+        return None
+
+
+_NONDETERMINISTIC = {"random", "rand", "uuid", "now", "current_timestamp"}
+
+
+def _deterministic(e: Expr) -> bool:
+    if isinstance(e, Call):
+        return e.fn not in _NONDETERMINISTIC and all(
+            _deterministic(a) for a in e.args)
+    return True
+
+
+def _simplify_expr(e: Expr) -> Expr:
+    """Algebraic identity folding (SimplifyExpressions.java's
+    ExpressionInterpreter subset): boolean short-circuits, double
+    negation, +0 / *1 arithmetic units."""
+    if not isinstance(e, Call):
+        return e
+    args = tuple(_simplify_expr(a) for a in e.args)
+    e = Call(type=e.type, fn=e.fn, args=args)
+
+    def lit(a, v):
+        return isinstance(a, Literal) and a.value == v and not a.type.is_string
+
+    if e.fn in ("eq", "ne", "lt", "le", "gt", "ge") and len(args) == 2 \
+            and all(isinstance(a, Literal) and a.value is not None
+                    and not a.type.is_string for a in args) \
+            and not ((args[0].type.is_decimal or args[1].type.is_decimal)
+                     and (args[0].type.scale != args[1].type.scale)):
+        # decimals store SCALED ints: only same-scale pairs compare
+        # directly (the binder coerces comparisons to a common scale)
+        import operator
+
+        op = {"eq": operator.eq, "ne": operator.ne, "lt": operator.lt,
+              "le": operator.le, "gt": operator.gt, "ge": operator.ge}[e.fn]
+        return Literal(type=e.type, value=bool(op(args[0].value,
+                                                  args[1].value)))
+    if e.fn == "and":
+        if any(lit(a, False) for a in args):
+            return Literal(type=e.type, value=False)
+        live = [a for a in args if not lit(a, True)]
+        if not live:
+            return Literal(type=e.type, value=True)
+        if len(live) == 1:
+            return live[0]
+        return Call(type=e.type, fn="and", args=tuple(live))
+    if e.fn == "or":
+        if any(lit(a, True) for a in args):
+            return Literal(type=e.type, value=True)
+        live = [a for a in args if not lit(a, False)]
+        if not live:
+            return Literal(type=e.type, value=False)
+        if len(live) == 1:
+            return live[0]
+        return Call(type=e.type, fn="or", args=tuple(live))
+    if e.fn == "not":
+        a = args[0]
+        if isinstance(a, Literal) and isinstance(a.value, bool):
+            return Literal(type=e.type, value=not a.value)
+        if isinstance(a, Call) and a.fn == "not":
+            return a.args[0]
+        return e
+    if e.fn in ("add", "sub") and len(args) == 2:
+        a, b = args
+        if lit(b, 0) and a.type == e.type:
+            return a
+        if e.fn == "add" and lit(a, 0) and b.type == e.type:
+            return b
+        return e
+    if e.fn == "mul" and len(args) == 2:
+        a, b = args
+        if lit(b, 1) and a.type == e.type:
+            return a
+        if lit(a, 1) and b.type == e.type:
+            return b
+        return e
+    return e
+
+
+class SimplifyExpressions(Rule):
+    """Fold identities inside filter predicates and projections
+    (SimplifyExpressions.java)."""
+
+    pattern = Pattern.type_of((FilterNode, ProjectNode))
+
+    def apply(self, node) -> Optional[PlanNode]:
+        if isinstance(node, FilterNode):
+            s = _simplify_expr(node.predicate)
+            if s == node.predicate:
+                return None
+            if isinstance(s, Literal) and s.value is True:
+                return node.source
+            return FilterNode(node.source, s)
+        outs = [_simplify_expr(p) for p in node.projections]
+        if all(a == b for a, b in zip(outs, node.projections)):
+            return None
+        return ProjectNode(node.source, outs, list(node.names))
+
+
+#: aggregates whose result can depend on input order (kept behind sorts)
+_ORDER_SENSITIVE_AGGS = {"array_agg", "map_agg", "multimap_agg",
+                         "min_by", "max_by", "arbitrary"}
+
+
+class PruneOrderByInAggregation(Rule):
+    """A sort feeding a (non-streaming) aggregation is meaningless —
+    hash aggregation is order-insensitive
+    (PruneOrderByInAggregation.java).  Left alone when the planner
+    chose the presorted streaming path, where order IS load-bearing,
+    and when any aggregate is order-sensitive (array_agg and friends)."""
+
+    pattern = Pattern.type_of(AggregationNode).where(
+        lambda n: isinstance(n.source, SortNode) and not n.presorted
+        and not any(a.fn in _ORDER_SENSITIVE_AGGS for a in n.aggs))
+
+    def apply(self, node: AggregationNode) -> Optional[PlanNode]:
+        import dataclasses
+
+        return dataclasses.replace(node, source=node.source.source)
+
+
+class PushTopNThroughProject(Rule):
+    """TopN over Project -> Project over TopN, inlining the sort keys
+    (PushTopNThroughProject.java) so the bound applies before
+    projection work."""
+
+    pattern = Pattern.type_of(TopNNode).with_sources(
+        Pattern.type_of(ProjectNode))
+
+    def apply(self, node: TopNNode) -> Optional[PlanNode]:
+        proj: ProjectNode = node.source
+        if not all(_deterministic(p) for p in proj.projections):
+            return None
+        keys = [_subst(k, proj.projections) for k in node.sort_exprs]
+        return ProjectNode(
+            TopNNode(proj.source, keys, list(node.ascending), node.count,
+                     node.nulls_first),
+            list(proj.projections), list(proj.names))
+
+
+class PushFilterThroughSort(Rule):
+    """Filter commutes below Sort so fewer rows sort
+    (PredicatePushDown's sort case)."""
+
+    pattern = Pattern.type_of(FilterNode).with_sources(
+        Pattern.type_of(SortNode))
+
+    def apply(self, node: FilterNode) -> Optional[PlanNode]:
+        srt: SortNode = node.source
+        return SortNode(FilterNode(srt.source, node.predicate),
+                        list(srt.sort_exprs), list(srt.ascending),
+                        srt.nulls_first)
+
+
+class PushFilterThroughUnion(Rule):
+    """Filter distributes into UNION ALL arms (PredicatePushDown's
+    union case).  Guarded off when the predicate touches a dictionary
+    VARCHAR channel: arm-local codes differ from the union's merged
+    dictionary, so the compiled comparison would be wrong."""
+
+    pattern = Pattern.type_of(FilterNode).with_sources(
+        Pattern.type_of(UnionNode))
+
+    def apply(self, node: FilterNode) -> Optional[PlanNode]:
+        union: UnionNode = node.source
+        refs = set(_expr_refs(node.predicate))
+        chans = union.channels
+        for i in refs:
+            if chans[i].dictionary is not None:
+                return None
+            for arm in union.inputs:
+                if arm.channels[i].dictionary is not None:
+                    return None
+        return UnionNode([FilterNode(arm, node.predicate)
+                          for arm in union.inputs])
+
+
+class SimplifyCountOverConstant(Rule):
+    """count(<non-null literal>) == count(*)
+    (SimplifyCountOverConstant.java)."""
+
+    pattern = Pattern.type_of(AggregationNode).where(
+        lambda n: any(a.fn == "count" and isinstance(a.arg, Literal)
+                      and a.arg.value is not None and not a.distinct
+                      for a in n.aggs))
+
+    def apply(self, node: AggregationNode) -> Optional[PlanNode]:
+        import dataclasses
+
+        from presto_tpu.expr.ir import AggCall
+
+        aggs = [
+            AggCall(fn="count_star", arg=None, type=a.type, distinct=False,
+                    filter=a.filter)
+            if (a.fn == "count" and isinstance(a.arg, Literal)
+                and a.arg.value is not None and not a.distinct)
+            else a
+            for a in node.aggs
+        ]
+        return dataclasses.replace(node, aggs=aggs)
+
+
 DEFAULT_RULES: List[Rule] = [
     MergeAdjacentFilters(),
     PushFilterThroughProject(),
@@ -340,6 +617,14 @@ DEFAULT_RULES: List[Rule] = [
     FlattenUnions(),
     PushLimitIntoTableScan(),
     RemoveRedundantDistinct(),
+    EvaluateZeroLimit(),
+    PropagateEmptyValues(),
+    SimplifyExpressions(),
+    PruneOrderByInAggregation(),
+    PushTopNThroughProject(),
+    PushFilterThroughSort(),
+    PushFilterThroughUnion(),
+    SimplifyCountOverConstant(),
 ]
 
 

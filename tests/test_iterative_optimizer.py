@@ -236,3 +236,113 @@ def test_correlated_in_matches_exists(runner):
         "(SELECT l_orderkey FROM lineitem WHERE l_suppkey = o.o_custkey)").rows
     total = runner.execute("SELECT count(*) FROM orders").rows
     assert got_not[0][0] == total[0][0] - got[0][0]
+
+
+# ---------------------------------------------------------------------------
+# round-4 rule batch
+# ---------------------------------------------------------------------------
+
+def test_zero_limit_collapses_to_empty_values(runner):
+    plan = runner.plan("SELECT o_orderkey FROM orders LIMIT 0")
+    assert not _find(plan, TableScanNode)  # scan never compiles
+    vals = _find(plan, ValuesNode)
+    assert vals and vals[0].rows == []
+    assert runner.execute("SELECT o_orderkey FROM orders LIMIT 0").rows == []
+
+
+def test_empty_propagates_through_join_and_agg(runner):
+    from presto_tpu.planner.plan import AggregationNode, JoinNode
+
+    sql = ("SELECT o_orderpriority, count(*) FROM orders, customer "
+           "WHERE o_custkey = c_custkey AND 1 = 0 GROUP BY o_orderpriority")
+    plan = runner.plan(sql)
+    assert not _find(plan, JoinNode)
+    assert not _find(plan, TableScanNode)
+    assert runner.execute(sql).rows == []
+    # global aggregation over empty still returns its single row
+    assert runner.execute(
+        "SELECT count(*) FROM orders WHERE 1 = 0").rows == [(0,)]
+
+
+def test_simplify_boolean_identities(runner):
+    # (pred AND true) OR false -> pred: one plain comparison survives
+    sql = ("SELECT count(*) FROM orders "
+           "WHERE (o_orderkey > 100 AND 1 = 1) OR 1 = 2")
+    plan = runner.plan(sql)
+    filters = _find(plan, FilterNode)
+    preds = [f.predicate for f in filters]
+    assert all("or" != getattr(p, "fn", None) for p in preds), preds
+    want = runner.execute(
+        "SELECT count(*) FROM orders WHERE o_orderkey > 100").rows
+    assert runner.execute(sql).rows == want
+
+
+def test_prune_order_by_in_aggregation(runner):
+    from presto_tpu.planner.plan import SortNode
+
+    sql = ("SELECT o_orderpriority, count(*) FROM "
+           "(SELECT * FROM orders ORDER BY o_totalprice) "
+           "GROUP BY o_orderpriority")
+    plan = runner.plan(sql)
+    assert not _find(plan, SortNode)
+    # order-sensitive aggregate keeps the sort
+    sql2 = ("SELECT max_by(o_orderkey, o_totalprice) FROM "
+            "(SELECT * FROM orders ORDER BY o_totalprice)")
+    assert _find(runner.plan(sql2), SortNode)
+
+
+def test_topn_pushes_through_project(runner):
+    from presto_tpu.planner.plan import ProjectNode, TopNNode
+
+    sql = ("SELECT o_orderkey * 2 AS k2, o_totalprice FROM orders "
+           "ORDER BY o_totalprice DESC LIMIT 5")
+    plan = runner.plan(sql)
+    found = _find(plan, TopNNode)
+    assert found
+    # the TopN bound applies below the doubling projection
+
+    def above(node, kind):
+        for s in node.sources:
+            if isinstance(s, kind) or above(s, kind):
+                return True
+        return False
+
+    projs = _find(plan, ProjectNode)
+    assert any(above(p, TopNNode) for p in projs) or not projs
+    rows = runner.execute(sql).rows
+    assert len(rows) == 5
+    assert rows == sorted(rows, key=lambda r: -r[1])
+
+
+def test_filter_through_union(runner):
+    from presto_tpu.planner.plan import UnionNode
+
+    sql = ("SELECT count(*) FROM ("
+           "SELECT o_orderkey AS k FROM orders "
+           "UNION ALL SELECT l_orderkey AS k FROM lineitem) "
+           "WHERE k < 100")
+    plan = runner.plan(sql)
+    unions = _find(plan, UnionNode)
+    assert unions
+    # every arm is filtered (or reduced below a filter)
+    for arm in unions[0].inputs:
+        kinds = {type(n).__name__ for n in _walk(arm)}
+        assert "FilterNode" in kinds or "ValuesNode" in kinds, kinds
+    lhs = runner.execute(sql).rows
+    want = [(runner.execute(
+        "SELECT count(*) FROM orders WHERE o_orderkey < 100").rows[0][0]
+        + runner.execute(
+        "SELECT count(*) FROM lineitem WHERE l_orderkey < 100").rows[0][0],)]
+    assert lhs == want
+
+
+def test_count_literal_becomes_count_star(runner):
+    from presto_tpu.planner.plan import AggregationNode
+
+    plan = runner.plan("SELECT count(1) FROM orders")
+    aggs = _find(plan, AggregationNode)
+    assert aggs and aggs[0].aggs[0].fn == "count_star"
+    assert runner.execute("SELECT count(1) FROM orders").rows == \
+        runner.execute("SELECT count(*) FROM orders").rows
+    # count(NULL) is 0, not count(*)
+    assert runner.execute("SELECT count(NULL) FROM orders").rows == [(0,)]
