@@ -123,7 +123,9 @@ class EngineConfig:
         return Session(properties=self.session_defaults())
 
 
-_BUILTIN_CONNECTORS = ("tpch", "tpcds", "memory", "blackhole")
+_BUILTIN_CONNECTORS = ("tpch", "tpcds", "memory", "blackhole", "jdbc",
+                       "localfile", "pcf", "rgf", "warehouse", "shardstore",
+                       "remote", "stream", "kv", "metrics", "http")
 
 
 def _make_connector(kind: Optional[str], props: Dict[str, str]):
@@ -146,4 +148,71 @@ def _make_connector(kind: Optional[str], props: Dict[str, str]):
         from presto_tpu.connectors.blackhole import BlackholeConnector
 
         return BlackholeConnector()
+    if kind == "jdbc":
+        from presto_tpu.connectors.jdbc import JdbcConnector
+
+        return JdbcConnector.sqlite(props["jdbc.path"])
+    if kind == "localfile":
+        import json as _json
+
+        from presto_tpu.connectors.localfile import LocalFileConnector
+
+        conn = LocalFileConnector()
+        with open(props["localfile.catalog"]) as f:
+            for t in _json.load(f):  # [{name, path, format, schema}, ...]
+                conn.add_table(t["name"], t["path"], t["format"],
+                               [tuple(cs) for cs in t["schema"]])
+        return conn
+    if kind == "pcf":
+        from presto_tpu.storage.pcf import PcfConnector
+
+        return PcfConnector(props["pcf.root"])
+    if kind == "rgf":
+        from presto_tpu.storage.rgf import RgfConnector
+
+        return RgfConnector(
+            props["rgf.root"],
+            split_bytes=int(props.get("rgf.split-bytes", str(1 << 22))))
+    if kind == "warehouse":
+        from presto_tpu.storage.warehouse import WarehouseConnector
+
+        return WarehouseConnector(props["warehouse.root"])
+    if kind == "shardstore":
+        from presto_tpu.storage.shardstore import ShardStoreConnector
+
+        nodes = [n.strip() for n in
+                 props.get("shardstore.nodes", "node0").split(",")]
+        return ShardStoreConnector(
+            props["shardstore.root"], nodes=nodes,
+            max_shard_rows=int(props.get("shardstore.max-shard-rows",
+                                         str(1 << 20))),
+            backup_root=props.get("shardstore.backup-root"))
+    if kind == "remote":
+        from presto_tpu.connectors.remote import RemoteConnector
+
+        return RemoteConnector(props["remote.uri"])
+    if kind == "stream":
+        import json as _json
+
+        from presto_tpu.connectors.stream import LogBroker, StreamConnector
+
+        with open(props["stream.table-descriptions"]) as f:
+            desc = _json.load(f)
+        return StreamConnector(LogBroker(props["stream.root"]), desc)
+    if kind == "kv":
+        import json as _json
+
+        from presto_tpu.connectors.stream import KvConnector
+
+        with open(props["kv.table-descriptions"]) as f:
+            desc = _json.load(f)
+        return KvConnector(props["kv.path"], desc)
+    if kind == "metrics":
+        from presto_tpu.connectors.metrics import MetricsConnector
+
+        return MetricsConnector()
+    if kind == "http":
+        from presto_tpu.connectors.http import HttpConnector
+
+        return HttpConnector(catalog_uri=props["http.catalog-uri"])
     raise ValueError(f"unknown connector.name: {kind!r}")

@@ -45,13 +45,138 @@ def build_from_etc(etc_dir: str, port: int = 0):
     return server, role, cfg
 
 
+def _var_paths(etc_dir: str):
+    import os
+
+    var = os.path.join(etc_dir, "var")
+    os.makedirs(os.path.join(var, "log"), exist_ok=True)
+    return (os.path.join(var, "launcher.pid"),
+            os.path.join(var, "log", "server.log"))
+
+
+def _read_pid(pidfile: str):
+    import os
+
+    try:
+        with open(pidfile) as f:
+            pid = int(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        return None
+    try:
+        os.kill(pid, 0)  # alive?
+    except ProcessLookupError:
+        return None
+    except PermissionError:
+        pass  # EPERM: alive, owned by another user
+    return pid
+
+
+def daemon_start(etc_dir: str, port: int = 0) -> int:
+    """bin/launcher ``start``: detach a ``run`` child, record its pid
+    (the reference launcher's pidfile + var/log/server.log contract)."""
+    import os
+    import subprocess
+
+    pidfile, logfile = _var_paths(etc_dir)
+    pid = _read_pid(pidfile)
+    if pid is not None:
+        print(f"already running as {pid}")
+        return pid
+    cmd = [sys.executable, "-m", "presto_tpu.launcher", "run",
+           "--etc", etc_dir]
+    if port:
+        cmd += ["--port", str(port)]
+    with open(logfile, "ab") as log:
+        child = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                 start_new_session=True,
+                                 cwd=os.getcwd())
+    with open(pidfile, "w") as f:
+        f.write(str(child.pid))
+    print(f"started as {child.pid}")
+    return child.pid
+
+
+def daemon_stop(etc_dir: str, timeout: float = 30.0) -> bool:
+    """bin/launcher ``stop``: SIGTERM then wait (the server drains)."""
+    import os
+    import time
+
+    pidfile, _ = _var_paths(etc_dir)
+    pid = _read_pid(pidfile)
+    if pid is None:
+        print("not running")
+        return True
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:  # exited between check and signal
+        os.unlink(pidfile)
+        print("stopped")
+        return True
+    except PermissionError:
+        # recycled pid now owned by another user: never signal it
+        print(f"pid {pid} is not ours (stale pidfile?); not signalling")
+        return False
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            os.unlink(pidfile)
+            print("stopped")
+            return True
+        time.sleep(0.1)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass  # exited in the last poll window
+    os.unlink(pidfile)
+    print("killed")
+    return False
+
+
+def daemon_status(etc_dir: str):
+    pidfile, _ = _var_paths(etc_dir)
+    pid = _read_pid(pidfile)
+    print(f"running as {pid}" if pid else "not running")
+    return pid
+
+
 def main(argv=None):
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # jax is pre-imported at interpreter startup in this image
+        # (axon platform plugin), so the env var alone can be too late;
+        # jax.config still works until the backend first initializes
+        # (same stanza as bench.py / tests/conftest.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser(prog="presto_tpu.launcher", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
     run = sub.add_parser("run", help="run the server in the foreground")
     run.add_argument("--etc", required=True, help="etc/ config directory")
     run.add_argument("--port", type=int, default=0)
+    for name in ("start", "stop", "restart", "status"):
+        p = sub.add_parser(name, help=f"daemon {name} (pidfile under etc/var)")
+        p.add_argument("--etc", required=True)
+        if name in ("start", "restart"):
+            p.add_argument("--port", type=int, default=0)
     args = ap.parse_args(argv)
+
+    if args.cmd == "start":
+        daemon_start(args.etc, args.port)
+        return
+    if args.cmd == "stop":
+        daemon_stop(args.etc)
+        return
+    if args.cmd == "restart":
+        daemon_stop(args.etc)
+        daemon_start(args.etc, args.port)
+        return
+    if args.cmd == "status":
+        daemon_status(args.etc)
+        return
 
     server, role, cfg = build_from_etc(args.etc, args.port)
     server.start()

@@ -1,0 +1,75 @@
+"""Every built-in connector kind constructs from catalog property
+files (the ConnectorFactory registry behind etc/catalog/*.properties;
+reference: server/PluginManager + each connector's factory class)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.config import _BUILTIN_CONNECTORS, _make_connector
+
+
+def test_every_builtin_kind_constructs(tmp_path):
+    from presto_tpu.connectors.remote import TableServiceServer
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.page import Page
+    from presto_tpu.storage.pcf import write_pcf
+    from presto_tpu.storage.rgf import write_rgf
+    from presto_tpu.types import BIGINT
+
+    page = Page.from_arrays([np.arange(10, dtype=np.int64)], [BIGINT])
+    write_pcf(str(tmp_path / "pcfroot_t.pcf"), [("k", BIGINT)], [page])
+    os.makedirs(tmp_path / "pcfroot", exist_ok=True)
+    os.rename(tmp_path / "pcfroot_t.pcf", tmp_path / "pcfroot" / "t.pcf")
+    os.makedirs(tmp_path / "rgfroot", exist_ok=True)
+    write_rgf(str(tmp_path / "rgfroot" / "t.rgf"), [("k", BIGINT)], [page])
+    csv_path = tmp_path / "data.csv"
+    csv_path.write_text("1,a\n2,b\n")
+    (tmp_path / "lf.json").write_text(json.dumps([
+        {"name": "t", "path": str(csv_path), "format": "csv",
+         "schema": [["n", "bigint"], ["s", "varchar"]]}]))
+    (tmp_path / "stream.json").write_text(json.dumps(
+        {"events": {"format": "json", "schema": [["n", "bigint"]]}}))
+    import sqlite3
+
+    db = sqlite3.connect(str(tmp_path / "db.sqlite"))
+    db.execute("CREATE TABLE t (k INTEGER)")
+    db.commit()
+    db.close()
+    svc = TableServiceServer({"tpch": Tpch(sf=0.001, split_rows=512)}).start()
+    try:
+        props = {
+            "tpch": {"tpch.scale-factor": "0.001"},
+            "tpcds": {"tpcds.scale-factor": "0.001"},
+            "memory": {},
+            "blackhole": {},
+            "metrics": {},
+            "jdbc": {"jdbc.path": str(tmp_path / "db.sqlite")},
+            "localfile": {"localfile.catalog": str(tmp_path / "lf.json")},
+            "pcf": {"pcf.root": str(tmp_path / "pcfroot")},
+            "rgf": {"rgf.root": str(tmp_path / "rgfroot")},
+            "warehouse": {"warehouse.root": str(tmp_path / "wh")},
+            "shardstore": {"shardstore.root": str(tmp_path / "ss"),
+                           "shardstore.nodes": "a,b"},
+            "remote": {"remote.uri": svc.uri},
+            "stream": {"stream.root": str(tmp_path / "log"),
+                       "stream.table-descriptions":
+                           str(tmp_path / "stream.json")},
+            "kv": {"kv.path": str(tmp_path / "kv.db"),
+                   "kv.table-descriptions": str(tmp_path / "stream.json")},
+        }
+        # http needs a live catalog URI; serve one through the table
+        # service host? — skipped here, constructor covered in
+        # test_external_connectors
+        for kind in _BUILTIN_CONNECTORS:
+            if kind == "http":
+                continue
+            conn = _make_connector(kind, props[kind])
+            names = conn.table_names()
+            assert isinstance(names, list), kind
+    finally:
+        svc.stop()
+    with pytest.raises(ValueError):
+        _make_connector("nope", {})

@@ -1,0 +1,61 @@
+#!/bin/bash
+# Server package assembly — the presto-server (provisio tarball) /
+# presto-server-rpm slot: produce a relocatable
+# dist/presto-tpu-<version>.tar.gz containing
+#
+#   presto-tpu-<version>/
+#     bin/launcher            start|stop|restart|status|run wrapper
+#     lib/presto_tpu/...      the engine package
+#     etc/                    default configs (coordinator role, tpch
+#                             catalog) — the reference tarball's
+#                             etc/ skeleton
+#     docs/ README.md PARITY.md
+#
+# Unpack anywhere with python+jax available:
+#   tar xzf presto-tpu-<v>.tar.gz && presto-tpu-<v>/bin/launcher start
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VERSION=${VERSION:-$(git rev-list --count HEAD 2>/dev/null || echo 0).r4}
+NAME="presto-tpu-${VERSION}"
+STAGE="dist/${NAME}"
+
+rm -rf "$STAGE"
+mkdir -p "$STAGE"/{bin,lib,etc/catalog,docs}
+
+# engine package (no tests, no caches)
+rsync -a --exclude '__pycache__' presto_tpu "$STAGE/lib/" 2>/dev/null || {
+  mkdir -p "$STAGE/lib"
+  tar cf - --exclude '__pycache__' presto_tpu | tar xf - -C "$STAGE/lib"
+}
+cp README.md PARITY.md "$STAGE/" 2>/dev/null || true
+cp -r docs "$STAGE/" 2>/dev/null || true
+
+# default etc/: coordinator role + tpch catalog (reference default
+# config.properties/node.properties/catalog/*.properties skeleton)
+cat > "$STAGE/etc/config.properties" <<'EOF'
+coordinator=true
+http-server.http.port=8080
+EOF
+cat > "$STAGE/etc/node.properties" <<'EOF'
+node.environment=production
+EOF
+cat > "$STAGE/etc/catalog/tpch.properties" <<'EOF'
+connector.name=tpch
+tpch.scale-factor=0.01
+EOF
+
+# launcher wrapper (bin/launcher of the reference tarball)
+cat > "$STAGE/bin/launcher" <<'EOF'
+#!/bin/bash
+set -euo pipefail
+HERE="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$HERE/lib${PYTHONPATH:+:$PYTHONPATH}"
+CMD="${1:-status}"; shift || true
+exec python -m presto_tpu.launcher "$CMD" --etc "$HERE/etc" "$@"
+EOF
+chmod +x "$STAGE/bin/launcher"
+
+mkdir -p dist
+tar czf "dist/${NAME}.tar.gz" -C dist "$NAME"
+echo "dist/${NAME}.tar.gz"
