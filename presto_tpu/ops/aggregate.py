@@ -77,6 +77,10 @@ def _sum_type(t: Type) -> Type:
 
 
 VARIANCE_FNS = ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop")
+# higher central moments (CentralMomentsAggregation: skewness/kurtosis)
+MOMENT_FNS = ("skewness", "kurtosis")
+# bitwise folds (BitwiseAndAggregation / BitwiseOrAggregation)
+BITWISE_FNS = ("bitwise_and_agg", "bitwise_or_agg")
 
 # two-argument moment statistics (AggregationUtils covariance/corr/
 # regression states): fn(y, x) with state (sx, sy, sxy, sxx, syy, n)
@@ -96,6 +100,10 @@ def state_types(agg: AggCall) -> List[Type]:
         return [t, BIGINT]
     if agg.fn in VARIANCE_FNS:
         return [DOUBLE, DOUBLE, BIGINT]  # sum, M2 (Σ(x-mean)²), count
+    if agg.fn in MOMENT_FNS:
+        return [DOUBLE, DOUBLE, DOUBLE, DOUBLE, BIGINT]  # s, M2, M3, M4, n
+    if agg.fn in BITWISE_FNS:
+        return [BIGINT, BIGINT]  # folded value, count of non-null
     if agg.fn in ("bool_and", "bool_or", "every"):
         return [BIGINT, BIGINT]  # count of true, count of non-null
     if agg.fn in COVAR_FNS:
@@ -194,8 +202,10 @@ def output_type(agg: AggCall) -> Type:
             # rounded HALF_UP at scale s (DecimalAverageAggregation)
             return agg.arg.type
         return DOUBLE
-    if agg.fn in VARIANCE_FNS or agg.fn in COVAR_FNS:
+    if agg.fn in VARIANCE_FNS or agg.fn in COVAR_FNS or agg.fn in MOMENT_FNS:
         return DOUBLE
+    if agg.fn in BITWISE_FNS:
+        return BIGINT
     if agg.fn == "checksum":
         return BIGINT
     if agg.fn in ("bool_and", "bool_or", "every"):
@@ -276,6 +286,34 @@ def _seg_max(vals, gid, n):
         fill = jnp.asarray(_ident_min(vals.dtype), vals.dtype)
         return jnp.max(jnp.where(hit, vals[None, :], fill), axis=1)
     return jax.ops.segment_max(vals, gid, num_segments=n)
+
+
+def _seg_assoc(op, identity, vals, gid, n):
+    """Segmented reduction under ANY associative op (bitwise and/or
+    here): argsort rows by group, run one segmented
+    ``associative_scan`` (scan state = (segment-start flag, value); a
+    start flag resets the accumulation), then gather each group's last
+    scan position via searchsorted — no scatter, TPU-friendly.  Rows
+    with gid == n are dead and land in the trailing run."""
+    order = jnp.argsort(gid)
+    g = gid[order]
+    v = vals[order]
+    starts = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), g[1:] != g[:-1]])
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, op(va, vb))
+
+    _, scanned = jax.lax.associative_scan(combine, (starts, v))
+    # g is sorted: each group's last row index via right-edge search;
+    # a group is present exactly when the row at its right edge still
+    # carries its id
+    ends = jnp.clip(jnp.searchsorted(g, jnp.arange(n, dtype=g.dtype),
+                                     side="right") - 1, 0, g.shape[0] - 1)
+    present = g[ends] == jnp.arange(n, dtype=g.dtype)
+    return jnp.where(present, scanned[ends], identity)
 
 
 def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
@@ -364,6 +402,24 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             dx = jnp.where(nonnull, x - mu_row, 0.0)
             m2 = _gsum(ctx, dx * dx, gid_nn, n)
             out.append([s, m2, cnt])
+        elif agg.fn in MOMENT_FNS:
+            from presto_tpu.expr.compile import _to_double
+
+            # two-pass central moments, like the variance state
+            x = jnp.where(nonnull, _to_double(data, agg.arg.type), 0.0)
+            s = _gsum(ctx, x, gid_nn, n)
+            mu = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+            dx = jnp.where(nonnull, x - mu[jnp.clip(gid_nn, 0, n - 1)], 0.0)
+            dx2 = dx * dx
+            out.append([s, _gsum(ctx, dx2, gid_nn, n),
+                        _gsum(ctx, dx2 * dx, gid_nn, n),
+                        _gsum(ctx, dx2 * dx2, gid_nn, n), cnt])
+        elif agg.fn in BITWISE_FNS:
+            is_and = agg.fn == "bitwise_and_agg"
+            ident = jnp.int64(-1) if is_and else jnp.int64(0)
+            v = jnp.where(nonnull, data.astype(jnp.int64), ident)
+            op = jnp.bitwise_and if is_and else jnp.bitwise_or
+            out.append([_seg_assoc(op, ident, v, gid_nn, n), cnt])
         elif agg.fn in ("bool_and", "bool_or", "every"):
             t = _seg_sum((nonnull & data.astype(jnp.bool_)).astype(jnp.int64),
                          gid_nn, n + 1)[:n]
@@ -637,6 +693,32 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
             dev = jnp.where(c_i > 0, mu_i - mu[jnp.clip(gid, 0, n - 1)], 0.0)
             m2 = _gsum(ctx, m2_i + cf_i * dev * dev, gid, n)
             out.append([s, m2, cnt])
+        elif agg.fn in MOMENT_FNS:
+            # Chan's pairwise combination generalized to M3/M4 with
+            # δi = μi − μ (Σ ci δi = 0):
+            #   M3 += 3 M2i δi + ci δi³
+            #   M4 += 4 M3i δi + 6 M2i δi² + ci δi⁴
+            s_i, m2_i, m3_i, m4_i, c_i = cols
+            s = _gsum(ctx, s_i, gid, n)
+            cnt = _gsum(ctx, c_i, gid, n)
+            mu = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+            cf = c_i.astype(jnp.float64)
+            mu_i = s_i / jnp.maximum(cf, 1.0)
+            d = jnp.where(c_i > 0, mu_i - mu[jnp.clip(gid, 0, n - 1)], 0.0)
+            d2 = d * d
+            m2 = _gsum(ctx, m2_i + cf * d2, gid, n)
+            m3 = _gsum(ctx, m3_i + 3.0 * m2_i * d + cf * d2 * d, gid, n)
+            m4 = _gsum(ctx, m4_i + 4.0 * m3_i * d + 6.0 * m2_i * d2
+                       + cf * d2 * d2, gid, n)
+            out.append([s, m2, m3, m4, cnt])
+        elif agg.fn in BITWISE_FNS:
+            is_and = agg.fn == "bitwise_and_agg"
+            ident = jnp.int64(-1) if is_and else jnp.int64(0)
+            op = jnp.bitwise_and if is_and else jnp.bitwise_or
+            has = cols[1] > 0
+            v = jnp.where(has, cols[0], ident)
+            acc = _seg_assoc(op, ident, v, jnp.where(gid < n, gid, n), n)
+            out.append([acc, _gsum(ctx, cols[1], gid, n)])
         elif agg.fn in ("bool_and", "bool_or", "every"):
             out.append([_gsum(ctx, c, gid, n) for c in cols])
         elif agg.fn in COVAR_FNS:
@@ -862,6 +944,29 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
             else:
                 v = trues == cnt
             blocks.append(Block(v, cnt > 0, t))
+        elif agg.fn in MOMENT_FNS:
+            _s, m2, m3, m4, cnt = cols
+            nf = jnp.maximum(cnt, 1).astype(jnp.float64)
+            safe_m2 = jnp.where(m2 == 0, 1.0, m2)
+            if agg.fn == "skewness":
+                # sqrt(n) * M3 / M2^1.5 (CentralMomentsAggregation)
+                v = jnp.sqrt(nf) * m3 / jnp.power(safe_m2, 1.5)
+                ok = (cnt >= 3) & (m2 > 0)
+            else:
+                # unbiased sample excess kurtosis (Σd⁴/s⁴ with
+                # s² = M2/(n−1)):
+                #   n(n+1)(n−1)/((n−2)(n−3)) · M4/M2² −
+                #   3(n−1)²/((n−2)(n−3))
+                d1, d2, d3 = nf - 1.0, jnp.maximum(nf - 2.0, 1.0), \
+                    jnp.maximum(nf - 3.0, 1.0)
+                v = (nf * (nf + 1.0) * d1 / (d2 * d3)
+                     * (m4 / (safe_m2 * safe_m2))
+                     - 3.0 * d1 * d1 / (d2 * d3))
+                ok = (cnt >= 4) & (m2 > 0)
+            blocks.append(Block(v, ok, t))
+        elif agg.fn in BITWISE_FNS:
+            acc, cnt = cols
+            blocks.append(Block(acc.astype(jnp.int64), cnt > 0, t))
         elif agg.fn in ("min_by", "max_by"):
             x, xv, _y, cnt = cols
             blocks.append(Block(x.astype(t.np_dtype), (cnt > 0) & (xv > 0), t, adict))

@@ -66,6 +66,7 @@ AGG_FUNCTIONS = {
     "min_by", "max_by", "approx_percentile",
     "covar_pop", "covar_samp", "corr", "regr_slope", "regr_intercept",
     "checksum", "arbitrary", "count_if", "geometric_mean",
+    "skewness", "kurtosis", "bitwise_and_agg", "bitwise_or_agg",
     "array_agg", "map_agg", "histogram",
     # HLL sketches as first-class values (spi HyperLogLogType):
     # approx_set builds one, merge unions them, cardinality estimates

@@ -481,3 +481,83 @@ def test_weighted_percentile_ignores_null_rows(env):
         "select approx_percentile(nullif(x, 9.0), w, 0.5) from (values "
         "(1.0, 1), (2.0, 1), (9.0, 2)) t(x, w)").rows[0]
     assert v == 1.0
+
+
+# -- r4 batch 2: central moments + bitwise folds -----------------------------
+
+def test_skewness_kurtosis_vs_numpy(env):
+    runner, _ = env
+    import numpy as np
+
+    rows = runner.execute("select o_totalprice from orders").rows
+    x = np.asarray([r[0] for r in rows], dtype=np.float64)
+    n = len(x)
+    m2 = float(((x - x.mean()) ** 2).sum())
+    m3 = float(((x - x.mean()) ** 3).sum())
+    m4 = float(((x - x.mean()) ** 4).sum())
+    want_skew = np.sqrt(n) * m3 / m2 ** 1.5
+    # independent check via scipy-convention kurtosis: the unbiased
+    # estimator expressed through the population excess g2
+    g2 = n * m4 / (m2 * m2) - 3.0
+    want_kurt = ((n - 1) / ((n - 2) * (n - 3))) * ((n + 1) * g2 + 6.0)
+    got_s, got_k = runner.execute(
+        "select skewness(o_totalprice), kurtosis(o_totalprice) "
+        "from orders").rows[0]
+    assert abs(got_s - want_skew) < 1e-9 * max(1.0, abs(want_skew))
+    assert abs(got_k - want_kurt) < 1e-9 * max(1.0, abs(want_kurt))
+
+
+def test_moments_grouped_and_split_merged(env):
+    runner, _ = env
+    import numpy as np
+
+    # per-group result must equal a single-group computation on the
+    # filtered subset (exercises the M3/M4 partial-state merge)
+    rows = runner.execute(
+        "select o_orderpriority, skewness(o_totalprice), "
+        "kurtosis(o_totalprice) from orders group by o_orderpriority "
+        "order by o_orderpriority").rows
+    assert len(rows) == 5
+    for prio, skew, kurt in rows[:2]:
+        one = runner.execute(
+            f"select skewness(o_totalprice), kurtosis(o_totalprice) "
+            f"from orders where o_orderpriority = '{prio}'").rows[0]
+        assert abs(skew - one[0]) < 1e-9 * max(1.0, abs(one[0]))
+        assert abs(kurt - one[1]) < 1e-9 * max(1.0, abs(one[1]))
+
+
+def test_moment_null_thresholds(env):
+    runner, _ = env
+    # skewness needs n >= 3, kurtosis n >= 4
+    assert runner.execute(
+        "select skewness(x) from (values (1.0), (2.0)) t(x)"
+    ).rows == [(None,)]
+    assert runner.execute(
+        "select kurtosis(x) from (values (1.0), (2.0), (3.0)) t(x)"
+    ).rows == [(None,)]
+    assert runner.execute(
+        "select skewness(x) from (values (5.0), (5.0), (5.0)) t(x)"
+    ).rows == [(None,)]  # zero variance
+
+
+def test_bitwise_agg_vs_python(env):
+    runner, _ = env
+    rows = runner.execute("select o_orderkey from orders").rows
+    keys = [r[0] for r in rows]
+    import functools
+
+    want_and = functools.reduce(lambda a, b: a & b, keys)
+    want_or = functools.reduce(lambda a, b: a | b, keys)
+    got = runner.execute(
+        "select bitwise_and_agg(o_orderkey), bitwise_or_agg(o_orderkey) "
+        "from orders").rows[0]
+    assert got == (want_and, want_or)
+
+
+def test_bitwise_agg_grouped_nulls(env):
+    runner, _ = env
+    rows = runner.execute(
+        "select g, bitwise_and_agg(v), bitwise_or_agg(v) from (values "
+        "(1, 12), (1, 10), (1, NULL), (2, 5), (3, NULL)) t(g, v) "
+        "group by g order by g").rows
+    assert rows == [(1, 12 & 10, 12 | 10), (2, 5, 5), (3, None, None)]
