@@ -44,7 +44,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_FILE = os.path.join(HERE, "BASELINE_MEASURED.json")
 TPU_FILE = os.path.join(HERE, "TPU_MEASURED.json")
 
-QUERY_NAMES = ("q1", "q6", "q3")
+QUERY_NAMES = ("q1", "q6", "q3", "q14")
 
 
 def log(*a):
@@ -96,12 +96,14 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     mem.load_from(
         tpch, "lineitem",
         columns=[
-            "l_orderkey", "l_quantity", "l_extendedprice", "l_discount",
-            "l_tax", "l_returnflag", "l_linestatus", "l_shipdate",
+            "l_orderkey", "l_partkey", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_returnflag", "l_linestatus",
+            "l_shipdate",
         ],
     )
     mem.load_from(tpch, "orders", columns=["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"])
     mem.load_from(tpch, "customer", columns=["c_custkey", "c_mktsegment"])
+    mem.load_from(tpch, "part", columns=["p_partkey", "p_type"])
     lineitem_rows = mem.row_count("lineitem")
     log(f"loaded sf={sf}: lineitem={lineitem_rows} rows in {time.time()-t0:.1f}s")
 
@@ -111,17 +113,20 @@ def _measure(sf: float, iters: int, only: str) -> dict:
 
     from tests.tpch_queries import QUERIES  # the shared corpus
 
-    all_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3]}
+    all_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3],
+                   "q14": QUERIES[14]}
     bench_queries = {only: all_queries[only]} if only else all_queries
 
     # bytes the engine must stream from HBM per query (columns touched x
     # 8 bytes x rows) — the roofline denominator for bandwidth figures
-    nrows = {t: mem.row_count(t) for t in ("lineitem", "orders", "customer")}
+    nrows = {t: mem.row_count(t)
+             for t in ("lineitem", "orders", "customer", "part")}
     bytes_scanned = {
         "q1": 7 * 8 * nrows["lineitem"],
         "q6": 4 * 8 * nrows["lineitem"],
         "q3": (4 * 8 * nrows["lineitem"] + 4 * 8 * nrows["orders"]
                + 2 * 8 * nrows["customer"]),
+        "q14": 4 * 8 * nrows["lineitem"] + 2 * 8 * nrows["part"],
     }
 
     rates = {}
@@ -434,8 +439,11 @@ def main():
             result = cpu_res
             baseline = baseline or cpu_res
 
+    qtag = "_".join(QUERY_NAMES)
+    if result is not None and result.get("rates"):
+        qtag = "_".join(q for q in QUERY_NAMES if q in result["rates"])
     out = {
-        "metric": "tpch_sf%g_q1_q6_q3_lineitem_rows_per_sec_geomean" % sf,
+        "metric": "tpch_sf%g_%s_lineitem_rows_per_sec_geomean" % (sf, qtag),
         "value": 0.0,
         "unit": "rows/s",
         "vs_baseline": None,
