@@ -125,6 +125,21 @@ def state_types(agg: AggCall) -> List[Type]:
         from presto_tpu.types import MapType
 
         return [MapType(t, agg.arg2.type, ARRAY_AGG_CAP), BIGINT]
+    if agg.fn == "map_union":
+        from presto_tpu.types import MapType
+
+        return [MapType(t.key_element, t.element, ARRAY_AGG_CAP), BIGINT]
+    if agg.fn in ("max_n", "min_n"):
+        from presto_tpu.types import ArrayType
+
+        return [ArrayType(t, int(agg.arg2.value)), BIGINT]
+    if agg.fn in ("max_by_n", "min_by_n"):
+        # two value halves sharing one storage dtype: the map state
+        # geometry [len, xs.., ys..] with ys = the ordering keys, so
+        # partial states merge exactly (top-n is a semilattice)
+        from presto_tpu.types import MapType
+
+        return [MapType(t, agg.arg2.type, int(agg.arg3.value)), BIGINT]
     if agg.fn == "hll_sketch":
         from presto_tpu.types import HllType
 
@@ -173,6 +188,19 @@ def output_type(agg: AggCall) -> Type:
         if not vt.is_array:  # pre-rewrite: second arg is the scalar v
             vt = ArrayType(vt, ARRAY_AGG_CAP)
         return MapType(agg.arg.type, vt, ARRAY_AGG_CAP)
+    if agg.fn == "map_union":
+        from presto_tpu.types import MapType
+
+        t = agg.arg.type
+        return MapType(t.key_element, t.element, ARRAY_AGG_CAP)
+    if agg.fn in ("max_n", "min_n"):
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(agg.arg.type, int(agg.arg2.value))
+    if agg.fn in ("max_by_n", "min_by_n"):
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(agg.arg.type, int(agg.arg3.value))
     if agg.fn == "histogram":
         # rewritten to inner count + outer map_agg before execution
         from presto_tpu.types import MapType
@@ -605,6 +633,70 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
                 [length[:, None], kflat.reshape(n, cap_e),
                  vflat.reshape(n, cap_e * av)], axis=1)
             out.append([state, rcnt])
+        elif agg.fn == "map_union":
+            # union the entries of map-valued rows per group: flatten
+            # each row's [len, keys.., vals..] into per-entry virtual
+            # rows, then the map_agg (group, entry-rank) scatter
+            # (MapUnionAggregation.java).  Deviation (engine-wide map
+            # convention, see PARITY.md): duplicate keys keep every
+            # occurrence — lookups take the first, but cardinality
+            # counts entries, where the reference dedupes keys
+            st = state_types(agg)[0]
+            cap_e = st.max_elems
+            storage = st.np_dtype
+            sent = _container_sent(storage)
+            cap_in = agg.arg.type.max_elems
+            l0 = data[:, 0]
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                l0 = jnp.where(jnp.isnan(l0), 0.0, l0)
+            lens_in = jnp.maximum(l0.astype(jnp.int64), 0)
+            sel = rowsel & valid
+            j = jnp.arange(cap_in, dtype=jnp.int64)[None, :]
+            entry_ok = sel[:, None] & (j < lens_in[:, None])
+            egid = jnp.where(entry_ok, gid[:, None], n).reshape(-1)
+            rcnt = _gsum(ctx, entry_ok.astype(jnp.int64).sum(axis=1),
+                         gid_a, n)
+            rank = _within_group_rank(egid)
+            ok = entry_ok.reshape(-1) & (rank < cap_e) & (egid < n)
+            tgt = jnp.where(ok, egid.astype(jnp.int64) * cap_e + rank,
+                            n * cap_e)
+            kflat = jnp.full((n * cap_e,), sent, dtype=storage)
+            kflat = kflat.at[tgt].set(
+                data[:, 1:1 + cap_in].reshape(-1).astype(storage),
+                mode="drop")
+            vflat = jnp.full((n * cap_e,), sent, dtype=storage)
+            vflat = vflat.at[tgt].set(
+                data[:, 1 + cap_in:1 + 2 * cap_in].reshape(-1).astype(storage),
+                mode="drop")
+            length = jnp.minimum(rcnt, cap_e).astype(storage)
+            state = jnp.concatenate(
+                [length[:, None], kflat.reshape(n, cap_e),
+                 vflat.reshape(n, cap_e)], axis=1)
+            out.append([state, rcnt])
+        elif agg.fn in ("max_n", "min_n", "max_by_n", "min_by_n"):
+            # top-n per group via one value-ordered lexsort + scatter
+            # (Max/MinNAggregationFunction's TypedHeap,
+            # Max/MinByNAggregationFunction's TypedKeyValueHeap)
+            st = state_types(agg)[0]
+            cap_e = st.max_elems
+            storage = st.np_dtype
+            sent = _container_sent(storage)
+            by = agg.fn in ("max_by_n", "min_by_n")
+            if by:
+                k_data, k_valid = c.compile(agg.arg2)(page)
+                sel = rowsel & k_valid  # key must order; NULL x allowed
+                keys = k_data
+                vals = jnp.where(valid, data.astype(storage), sent)
+            else:
+                sel = rowsel & valid
+                keys = data
+                vals = data
+            halves, gcnt = _topn_halves(
+                ctx, gid, keys, vals, sel, n, cap_e, storage,
+                descending=agg.fn in ("max_n", "max_by_n"), with_keys=by)
+            length = jnp.minimum(gcnt, cap_e).astype(storage)
+            state = jnp.concatenate([length[:, None]] + halves, axis=1)
+            out.append([state, gcnt])
         else:
             raise KeyError(agg.fn)
     return out
@@ -616,16 +708,55 @@ def _container_sent(storage):
     return jnp.asarray(jnp.iinfo(storage).min, dtype=storage)
 
 
-def _within_group_rank(gid: jax.Array) -> jax.Array:
-    """0-based occurrence index of each row within its gid class
-    (stable: earlier rows get lower ranks)."""
-    order = jnp.argsort(gid, stable=True)
+def _ordered_rank(gid: jax.Array, order: jax.Array) -> jax.Array:
+    """0-based position of each element within its gid class when the
+    elements are visited in ``order`` (a permutation that clusters equal
+    gids together)."""
     gs = gid[order]
     idx = jnp.arange(gs.shape[0], dtype=jnp.int64)
     first = jnp.concatenate([jnp.ones(1, jnp.bool_), gs[1:] != gs[:-1]])
     start = jax.lax.cummax(jnp.where(first, idx, 0))
     rank_sorted = idx - start
     return jnp.zeros_like(rank_sorted).at[order].set(rank_sorted)
+
+
+def _within_group_rank(gid: jax.Array) -> jax.Array:
+    """0-based occurrence index of each row within its gid class
+    (stable: earlier rows get lower ranks)."""
+    return _ordered_rank(gid, jnp.argsort(gid, stable=True))
+
+
+def _topn_halves(ctx, egid, keys, vals, sel, n, cap_e, storage,
+                 descending, with_keys):
+    """Scatter each group's cap_e extreme elements (ordered by ``keys``)
+    into dense (n, cap_e) lanes, vals sorted by key — descending for
+    max-forms, ascending for min-forms.
+
+    The descending lane index is (group size - 1 - ascending rank), so
+    no key negation is needed (int64 min would overflow under negation).
+    Returns ([vals_lanes] or [vals_lanes, keys_lanes], live_count).
+    TypedHeap.java analog: the heap becomes one lexsort + one scatter.
+    """
+    sent = _container_sent(storage)
+    egid = jnp.where(sel, egid, n)
+    gcnt = _gsum(ctx, sel.astype(jnp.int64), egid, n)
+    order = jnp.lexsort((keys, egid))
+    rank = _ordered_rank(egid, order)
+    if descending:
+        size_e = jnp.where(sel, gcnt[jnp.clip(egid, 0, n - 1)], 0)
+        lane = size_e - 1 - rank
+    else:
+        lane = rank
+    ok = sel & (lane >= 0) & (lane < cap_e) & (egid < n)
+    tgt = jnp.where(ok, egid.astype(jnp.int64) * cap_e + lane, n * cap_e)
+    vflat = jnp.full((n * cap_e,), sent, dtype=storage)
+    vflat = vflat.at[tgt].set(vals.astype(storage), mode="drop")
+    halves = [vflat.reshape(n, cap_e)]
+    if with_keys:
+        kflat = jnp.full((n * cap_e,), sent, dtype=storage)
+        kflat = kflat.at[tgt].set(keys.astype(storage), mode="drop")
+        halves.append(kflat.reshape(n, cap_e))
+    return halves, gcnt
 
 
 def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
@@ -759,7 +890,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 _gsum(ctx, cnt, gid, n),
             ])
         elif agg.fn in ("array_agg", "map_agg", "hll_sketch",
-                        "multimap_agg"):
+                        "multimap_agg", "map_union"):
             # concatenate partial containers per group: each partial
             # row's elements land at the group's running offset (stable
             # order).  Halves: arrays have one value lane per rank; maps
@@ -807,6 +938,34 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
             out.append([
                 jnp.concatenate([length[:, None]] + halves, axis=1),
                 _gsum(ctx, cnt_col, gid, n),
+            ])
+        elif agg.fn in ("max_n", "min_n", "max_by_n", "min_by_n"):
+            # top-n of the union of per-partial top-n lanes IS the
+            # global top-n (semilattice), so merging re-runs the same
+            # ordered scatter over the flattened lanes
+            arr_col, cnt_col = cols
+            cap_e = state_types(agg)[0].max_elems
+            storage = arr_col.dtype
+            by = agg.fn in ("max_by_n", "min_by_n")
+            l0 = arr_col[:, 0]
+            if jnp.issubdtype(storage, jnp.floating):
+                l0 = jnp.where(jnp.isnan(l0), 0.0, l0)
+            lens = jnp.where(gid < n, jnp.maximum(l0.astype(jnp.int64), 0), 0)
+            j = jnp.arange(cap_e, dtype=jnp.int64)[None, :]
+            lane_ok = j < jnp.minimum(lens, cap_e)[:, None]
+            vals = arr_col[:, 1:1 + cap_e]
+            keys = arr_col[:, 1 + cap_e:1 + 2 * cap_e] if by else vals
+            egid = jnp.where(lane_ok, gid[:, None], n)
+            # ctx=None: the sort ctx's gather order covers row-length
+            # arrays, not the rows*cap_e flattened lanes
+            halves, _ = _topn_halves(
+                None, egid.reshape(-1), keys.reshape(-1), vals.reshape(-1),
+                lane_ok.reshape(-1), n, cap_e, storage,
+                descending=agg.fn in ("max_n", "max_by_n"), with_keys=by)
+            total = _gsum(ctx, cnt_col, gid, n)
+            length = jnp.minimum(total, cap_e).astype(storage)
+            out.append([
+                jnp.concatenate([length[:, None]] + halves, axis=1), total,
             ])
         else:
             raise KeyError(agg.fn)
@@ -1001,9 +1160,23 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
             ], axis=1)
             blocks.append(Block(model.astype(t.np_dtype), cnt > 0, t))
         elif agg.fn in ("array_agg", "map_agg", "hll_sketch",
-                        "multimap_agg"):
+                        "multimap_agg", "map_union", "max_n", "min_n"):
             arr_state, cnt = cols
             blocks.append(Block(arr_state.astype(t.np_dtype), cnt > 0, t, adict))
+        elif agg.fn in ("max_by_n", "min_by_n"):
+            # drop the ordering-key half of the state; convert the
+            # shared-storage sentinel to the output array's own
+            cap_e = state_types(agg)[0].max_elems
+            arr_state, cnt = cols
+            sub = arr_state[:, :1 + cap_e]
+            if jnp.issubdtype(sub.dtype, jnp.floating) \
+                    and not jnp.issubdtype(t.np_dtype, jnp.floating):
+                osent = _container_sent(t.np_dtype)
+                body = jnp.where(jnp.isnan(sub[:, 1:]),
+                                 jnp.float64(osent), sub[:, 1:])
+                l0 = jnp.where(jnp.isnan(sub[:, :1]), 0.0, sub[:, :1])
+                sub = jnp.concatenate([l0, body], axis=1)
+            blocks.append(Block(sub.astype(t.np_dtype), cnt > 0, t, adict))
         elif agg.fn == "hll_merge":
             # HLL estimator with linear-counting small-range correction
             # (airlift HyperLogLog / the original Flajolet et al. paper)

@@ -502,7 +502,8 @@ class SimplifyExpressions(Rule):
 
 #: aggregates whose result can depend on input order (kept behind sorts)
 _ORDER_SENSITIVE_AGGS = {"array_agg", "map_agg", "multimap_agg",
-                         "min_by", "max_by", "arbitrary"}
+                         "map_union", "min_by", "max_by", "arbitrary",
+                         "min_by_n", "max_by_n"}
 
 
 class PruneOrderByInAggregation(Rule):

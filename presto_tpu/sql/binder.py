@@ -67,7 +67,7 @@ AGG_FUNCTIONS = {
     "covar_pop", "covar_samp", "corr", "regr_slope", "regr_intercept",
     "checksum", "arbitrary", "count_if", "geometric_mean",
     "skewness", "kurtosis", "bitwise_and_agg", "bitwise_or_agg",
-    "array_agg", "map_agg", "histogram",
+    "array_agg", "map_agg", "histogram", "map_union",
     # HLL sketches as first-class values (spi HyperLogLogType):
     # approx_set builds one, merge unions them, cardinality estimates
     "approx_set", "merge", "numeric_histogram", "multimap_agg",
@@ -3132,6 +3132,59 @@ class Binder:
             a = AggCall(fn=fn, arg=arg, type=arg.type, arg2=b)
             a = dataclasses.replace(a, type=output_type(a))
             return agg.agg_ref(a)
+        if fn in ("min", "max") and len(e.args) == 2:
+            # max(x, n) / min(x, n): the n extreme values as an array,
+            # descending for max, ascending for min
+            # (Max/MinNAggregationFunction.java)
+            if distinct:
+                raise BindError(f"DISTINCT unsupported for {fn}(x, n)")
+            arg = self._bind(e.args[0], scope)
+            nn = self._bind(e.args[1], scope)
+            self._check_topn_count(fn, nn)
+            if not (arg.type.is_numeric or arg.type.name in
+                    ("date", "timestamp", "time")) or arg.type.is_long_decimal:
+                raise BindError(
+                    f"{fn}(x, n) requires a fixed-width orderable x "
+                    f"(got {arg.type})")
+            a = AggCall(fn=f"{fn}_n", arg=arg, type=arg.type, arg2=nn)
+            a = dataclasses.replace(a, type=output_type(a))
+            return agg.agg_ref(a)
+        if fn in ("min_by", "max_by") and len(e.args) == 3:
+            # max_by(x, y, n) / min_by(x, y, n): the x values paired
+            # with the n extreme y keys (Max/MinByNAggregationFunction)
+            if distinct:
+                raise BindError(f"DISTINCT unsupported for {fn}(x, y, n)")
+            arg = self._bind(e.args[0], scope)
+            key = self._bind(e.args[1], scope)
+            nn = self._bind(e.args[2], scope)
+            self._check_topn_count(fn, nn)
+            for name, t in (("x", arg.type), ("y", key.type)):
+                if not (t.is_numeric or t.name in
+                        ("date", "timestamp", "time")) or t.is_long_decimal:
+                    raise BindError(
+                        f"{fn}(x, y, n) requires fixed-width orderable "
+                        f"arguments (got {t} for {name})")
+            a = AggCall(fn=f"{fn}_n", arg=arg, type=arg.type, arg2=key,
+                        arg3=nn)
+            a = dataclasses.replace(a, type=output_type(a))
+            return agg.agg_ref(a)
+        if fn == "map_union":
+            if len(e.args) != 1:
+                raise BindError("map_union takes one argument")
+            if distinct:
+                raise BindError("DISTINCT unsupported for map_union")
+            arg = self._bind(e.args[0], scope)
+            # strictly scalar-valued maps: is_map also admits HLL
+            # sketches (union those via merge()) and multimap results,
+            # whose array-valued lanes this kernel cannot slice
+            if arg.type.name != "map" or (
+                    arg.type.element is not None and arg.type.element.is_array):
+                raise BindError(
+                    f"map_union requires a scalar-valued map argument "
+                    f"(got {arg.type})")
+            a = AggCall(fn=fn, arg=arg, type=arg.type)
+            a = dataclasses.replace(a, type=output_type(a))
+            return agg.agg_ref(a)
         if fn in ("min_by", "max_by", "approx_percentile", "map_agg",
                   "multimap_agg",
                   "covar_pop", "covar_samp", "corr", "regr_slope",
@@ -3160,6 +3213,18 @@ class Binder:
         a = AggCall(fn=fn, arg=arg, type=arg.type, distinct=distinct)
         a = AggCall(fn=a.fn, arg=a.arg, type=output_type(a), distinct=a.distinct)
         return agg.agg_ref(a)
+
+    @staticmethod
+    def _check_topn_count(fn, nn):
+        """n of max/min(x, n) and max_by/min_by(x, y, n) must be a
+        positive integer literal within the container cap."""
+        from presto_tpu.ops.aggregate import ARRAY_AGG_CAP
+
+        if not isinstance(nn, Literal) or nn.value is None \
+                or not nn.type.is_integerlike:
+            raise BindError(f"{fn}'s n must be an integer literal")
+        if not 1 <= int(nn.value) <= ARRAY_AGG_CAP:
+            raise BindError(f"{fn}'s n must be in [1, {ARRAY_AGG_CAP}]")
 
     # ------------------------------------------------------------------
     def _substitute_aliases(self, e: ast.Node, alias_map: Dict[str, ast.Node],

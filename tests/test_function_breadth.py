@@ -561,3 +561,130 @@ def test_bitwise_agg_grouped_nulls(env):
         "(1, 12), (1, 10), (1, NULL), (2, 5), (3, NULL)) t(g, v) "
         "group by g order by g").rows
     assert rows == [(1, 12 & 10, 12 | 10), (2, 5, 5), (3, None, None)]
+
+
+# round-4b aggregate breadth: map_union, max/min(x, n), max_by/min_by
+# (x, y, n).  Reference: operator/aggregation/MapUnionAggregation.java,
+# MaxNAggregationFunction.java (TypedHeap), MinByNAggregationFunction
+# (TypedKeyValueHeap) — the heaps become one lexsort + dense scatter.
+
+def test_map_union_grouped(env):
+    runner, _ = env
+    got = runner.execute(
+        "select g, map_union(m) from (select g, map_agg(k, v) m from "
+        "(values (1,1,10),(1,2,20),(2,3,30),(2,4,40),(9,5,50)) t(g,k,v)"
+        " group by g, k) group by g order by g").rows
+    assert got == [(1, {1: 10, 2: 20}), (2, {3: 30, 4: 40}), (9, {5: 50})]
+
+
+def test_map_union_global_and_null_maps(env):
+    runner, _ = env
+    (m,) = runner.execute(
+        "select map_union(m) from (select map_agg(k, v) m from (values "
+        "(1,10),(2,20)) t(k,v) group by k)").rows[0]
+    assert m == {1: 10, 2: 20}
+    # NULL maps are skipped; a group of only-NULL maps yields NULL
+    got = runner.execute(
+        "select a.g, map_union(b.m) from (values (1),(2)) a(g) left join "
+        "(select 1 g, map_agg(k, v) m from (values (1,10),(2,20)) t(k,v)"
+        " group by 1) b on a.g = b.g group by a.g order by a.g").rows
+    assert got == [(1, {1: 10, 2: 20}), (2, None)]
+
+
+def test_max_n_min_n_grouped(env):
+    runner, _ = env
+    got = runner.execute(
+        "select g, max(x, 2), min(x, 2) from (values "
+        "(1,5),(1,3),(1,9),(1,1),(2,7)) t(g,x) group by g order by g"
+    ).rows
+    assert got == [(1, [9, 5], [1, 3]), (2, [7], [7])]
+
+
+def test_max_n_nulls_and_count_cap(env):
+    runner, _ = env
+    got = runner.execute(
+        "select max(x, 3) from (values (1),(null),(4),(2),(null)) t(x)"
+    ).rows
+    assert got == [([4, 2, 1],)]
+
+
+def test_max_n_vs_numpy_over_splits(env):
+    runner, _ = env
+    prices = sorted(
+        (r[0] for r in runner.execute(
+            "select l_extendedprice from lineitem").rows), reverse=True)
+    (top,) = runner.execute(
+        "select max(l_extendedprice, 5) from lineitem").rows[0]
+    assert [round(float(v), 2) for v in top] == [round(float(v), 2) for v in prices[:5]]
+    got = runner.execute(
+        "select l_returnflag, min(l_extendedprice, 3) from lineitem "
+        "group by 1 order by 1").rows
+    import collections
+
+    per = collections.defaultdict(list)
+    for f, p in runner.execute(
+            "select l_returnflag, l_extendedprice from lineitem").rows:
+        per[f].append(p)
+    for flag, arr in got:
+        want = sorted(per[flag])[:3]
+        assert [round(float(v), 2) for v in arr] == [round(float(v), 2) for v in want]
+
+
+def test_max_by_n_min_by_n(env):
+    runner, _ = env
+    got = runner.execute(
+        "select g, max_by(x, y, 2), min_by(x, y, 2) from (values "
+        "(1, 100, 1.0), (1, 200, 3.0), (1, 300, 2.0), (2, 5, 9.0)) "
+        "t(g, x, y) group by g order by g").rows
+    assert got == [(1, [200, 300], [100, 300]), (2, [5], [5])]
+
+
+def test_max_by_n_null_value_slot(env):
+    runner, _ = env
+    (arr,) = runner.execute(
+        "select max_by(x, y, 2) from (values (null, 9), (7, 1)) t(x, y)"
+    ).rows[0]
+    assert arr == [None, 7]
+
+
+def test_topn_binder_errors(env):
+    runner, _ = env
+    for sql in ("select max(x, 0) from (values (1)) t(x)",
+                "select max(x, 100000) from (values (1)) t(x)",
+                "select min(x, y) from (values (1, 2)) t(x, y)",
+                "select map_union(x) from (values (1)) t(x)"):
+        with pytest.raises(Exception):
+            runner.execute(sql)
+
+
+def test_max_n_many_groups_merge_path(env):
+    """> SMALL_SEG_LIMIT groups exercises the sort-ctx segment path in
+    the grouped merge, where the flattened top-n lanes must NOT reuse
+    the row-length sort ctx (code-review regression)."""
+    runner, _ = env
+    got = runner.execute(
+        "select l_orderkey, max(l_extendedprice, 2) from lineitem "
+        "where l_orderkey <= 2000 group by 1 order by 1").rows
+    import collections
+
+    per = collections.defaultdict(list)
+    for k, p in runner.execute(
+            "select l_orderkey, l_extendedprice from lineitem "
+            "where l_orderkey <= 2000").rows:
+        per[k].append(float(p))
+    assert len(got) > 128
+    for key, arr in got:
+        want = sorted(per[key], reverse=True)[:2]
+        assert [round(float(v), 2) for v in arr] == \
+            [round(v, 2) for v in want], key
+
+
+def test_map_union_rejects_multimap_and_hll(env):
+    runner, _ = env
+    for sql in (
+            "select map_union(m) from (select multimap_agg(k, v) m from "
+            "(values (1, 10), (1, 11)) t(k, v)) s",
+            "select map_union(m) from (select approx_set(k) m from "
+            "(values (1), (2)) t(k)) s"):
+        with pytest.raises(Exception):
+            runner.execute(sql)
