@@ -654,8 +654,12 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             j = jnp.arange(cap_in, dtype=jnp.int64)[None, :]
             entry_ok = sel[:, None] & (j < lens_in[:, None])
             egid = jnp.where(entry_ok, gid[:, None], n).reshape(-1)
-            rcnt = _gsum(ctx, entry_ok.astype(jnp.int64).sum(axis=1),
+            ecnt = _gsum(ctx, entry_ok.astype(jnp.int64).sum(axis=1),
                          gid_a, n)
+            # the COUNT column tracks rows with non-null maps (empty
+            # maps still make the group's result an empty map, not
+            # NULL); the length lane tracks entries
+            rows_cnt = _gsum(ctx, sel.astype(jnp.int64), gid_a, n)
             rank = _within_group_rank(egid)
             ok = entry_ok.reshape(-1) & (rank < cap_e) & (egid < n)
             tgt = jnp.where(ok, egid.astype(jnp.int64) * cap_e + rank,
@@ -668,11 +672,11 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             vflat = vflat.at[tgt].set(
                 data[:, 1 + cap_in:1 + 2 * cap_in].reshape(-1).astype(storage),
                 mode="drop")
-            length = jnp.minimum(rcnt, cap_e).astype(storage)
+            length = jnp.minimum(ecnt, cap_e).astype(storage)
             state = jnp.concatenate(
                 [length[:, None], kflat.reshape(n, cap_e),
                  vflat.reshape(n, cap_e)], axis=1)
-            out.append([state, rcnt])
+            out.append([state, rows_cnt])
         elif agg.fn in ("max_n", "min_n", "max_by_n", "min_by_n"):
             # top-n per group via one value-ordered lexsort + scatter
             # (Max/MinNAggregationFunction's TypedHeap,

@@ -22,13 +22,17 @@ each names its reference rule):
 
 from __future__ import annotations
 
+import dataclasses
+
 from typing import List, Optional
 
 from presto_tpu.expr.ir import Call, ColumnRef, Expr, Literal
 from presto_tpu.matching import Pattern
 from presto_tpu.planner.plan import (
     AggregationNode,
+    CrossSingleNode,
     FilterNode,
+    JoinNode,
     LimitNode,
     OutputNode,
     PlanNode,
@@ -38,6 +42,7 @@ from presto_tpu.planner.plan import (
     TopNNode,
     UnionNode,
     ValuesNode,
+    WindowNode,
 )
 
 
@@ -604,6 +609,164 @@ class SimplifyCountOverConstant(Rule):
         return dataclasses.replace(node, aggs=aggs)
 
 
+class MergeLimitWithTopN(Rule):
+    """Limit over TopN: the smaller count wins — TopN output is sorted,
+    so its prefix IS the tighter TopN (MergeLimitWithTopN.java)."""
+
+    pattern = Pattern.type_of(LimitNode).with_sources(Pattern.type_of(TopNNode))
+
+    def apply(self, node: LimitNode) -> Optional[PlanNode]:
+        t: TopNNode = node.source
+        return TopNNode(t.source, list(t.sort_exprs), list(t.ascending),
+                        min(t.count, node.count), t.nulls_first)
+
+
+class PushTopNThroughUnion(Rule):
+    """TopN over UNION ALL: each arm only needs its own top N — bound
+    the arms, keep the outer TopN for the global pick
+    (PushTopNThroughUnion.java)."""
+
+    pattern = Pattern.type_of(TopNNode).with_sources(Pattern.type_of(UnionNode))
+
+    def apply(self, node: TopNNode) -> Optional[PlanNode]:
+        union: UnionNode = node.source
+
+        def bounded(arm: PlanNode) -> bool:
+            # the planted TopN may have been relocated below the arm's
+            # projection by PushTopNThroughProject — look through
+            # row-preserving projections only (an inner limit deep in
+            # e.g. a join subtree does NOT bound the arm)
+            while isinstance(arm, ProjectNode):
+                arm = arm.source
+            return (isinstance(arm, (TopNNode, LimitNode))
+                    and arm.count <= node.count)
+
+        if all(bounded(i) for i in union.inputs):
+            return None
+        arms = [
+            i if bounded(i) else TopNNode(
+                i, list(node.sort_exprs), list(node.ascending), node.count,
+                node.nulls_first)
+            for i in union.inputs
+        ]
+        return TopNNode(UnionNode(arms), list(node.sort_exprs),
+                        list(node.ascending), node.count, node.nulls_first)
+
+
+class PushLimitThroughRowPreserving(Rule):
+    """Limit commutes exactly with 1:1 row-preserving nodes: mark
+    joins (one output per probe row), left joins with a unique build
+    side, and scalar-subquery cross products — limiting the probe
+    first shrinks the join's work (PushLimitThroughSemiJoin.java /
+    PushLimitThroughMarkDistinct.java; their SemiJoinNode is this
+    engine's mark join)."""
+
+    @staticmethod
+    def _row_preserving(n: PlanNode) -> bool:
+        if isinstance(n, CrossSingleNode):
+            return True
+        return (isinstance(n, JoinNode) and not n.use_index
+                and (n.kind == "mark"
+                     or (n.kind == "left" and n.unique_build)))
+
+    pattern = Pattern.type_of(LimitNode).where(
+        lambda n: PushLimitThroughRowPreserving._row_preserving(n.source)
+        and not isinstance(n.source.sources[0], LimitNode))
+
+    def apply(self, node: LimitNode) -> Optional[PlanNode]:
+        j = node.source
+        limited = LimitNode(j.left, node.count)
+        if isinstance(j, CrossSingleNode):
+            return CrossSingleNode(limited, j.right)
+        return dataclasses.replace(j, left=limited)
+
+
+class PruneCountAggregationOverScalar(Rule):
+    """count(*) over a relation that produces exactly one row is the
+    literal 1 — no need to execute the source
+    (PruneCountAggregationOverScalar.java)."""
+
+    @staticmethod
+    def _scalar(n: PlanNode) -> bool:
+        while isinstance(n, ProjectNode):  # projections preserve rows
+            n = n.source
+        if isinstance(n, ValuesNode) and len(n.rows) == 1:
+            return True
+        return (isinstance(n, AggregationNode) and not n.group_exprs
+                and n.step in ("single", "final"))
+
+    pattern = Pattern.type_of(AggregationNode).where(
+        lambda n: n.step == "single" and not n.group_exprs and n.aggs
+        and all(a.fn == "count_star" and a.filter is None for a in n.aggs)
+        and PruneCountAggregationOverScalar._scalar(n.source))
+
+    def apply(self, node: AggregationNode) -> Optional[PlanNode]:
+        from presto_tpu.types import BIGINT
+
+        return ValuesNode(names=list(node.agg_names),
+                          types=[BIGINT] * len(node.aggs),
+                          rows=[tuple(1 for _ in node.aggs)])
+
+
+class GatherAndMergeWindows(Rule):
+    """Adjacent window nodes over the same (partition, order) spec
+    merge into one — one partition sort instead of two
+    (GatherAndMergeWindows.java).  Fires only when the outer node's
+    expressions read the shared source, not the inner's outputs."""
+
+    pattern = Pattern.type_of(WindowNode).with_sources(
+        Pattern.type_of(WindowNode))
+
+    def apply(self, node: WindowNode) -> Optional[PlanNode]:
+        inner: WindowNode = node.source
+        if (node.partition_exprs != inner.partition_exprs
+                or node.order_exprs != inner.order_exprs
+                or node.ascending != inner.ascending):
+            return None
+        base = len(inner.source.channels)
+        refs: List[int] = []
+        for e in list(node.partition_exprs) + list(node.order_exprs):
+            refs.extend(_expr_refs(e))
+        for f in node.funcs:
+            if f.arg is not None:
+                refs.extend(_expr_refs(f.arg))
+        if any(r >= base for r in refs):
+            return None  # outer consumes the inner's function outputs
+        return WindowNode(
+            inner.source, list(inner.partition_exprs),
+            list(inner.order_exprs), list(inner.ascending),
+            list(inner.funcs) + list(node.funcs),
+            list(inner.func_names) + list(node.func_names))
+
+
+class PruneUnionColumns(Rule):
+    """A pure column-selection projection over UNION ALL moves into
+    the arms, so each arm scans only what the query needs
+    (PushProjectionThroughUnion.java, restricted to the ColumnRef-only
+    pruning case — per-arm dictionaries re-merge in the new union)."""
+
+    pattern = Pattern.type_of(ProjectNode).where(
+        lambda n: isinstance(n.source, UnionNode)
+        and all(isinstance(p, ColumnRef) for p in n.projections)
+        and [p.index for p in n.projections]
+        != list(range(len(n.source.channels))))
+
+    def apply(self, node: ProjectNode) -> Optional[PlanNode]:
+        union: UnionNode = node.source
+        arms = []
+        for arm in union.inputs:
+            if isinstance(arm, ProjectNode):
+                # compose: select the surviving expressions directly
+                projs = [arm.projections[p.index] for p in node.projections]
+                arms.append(ProjectNode(arm.source, projs, list(node.names)))
+            else:
+                src = arm.channels
+                projs = [ColumnRef(type=src[p.index].type, index=p.index)
+                         for p in node.projections]
+                arms.append(ProjectNode(arm, projs, list(node.names)))
+        return UnionNode(arms)
+
+
 DEFAULT_RULES: List[Rule] = [
     MergeAdjacentFilters(),
     PushFilterThroughProject(),
@@ -626,6 +789,12 @@ DEFAULT_RULES: List[Rule] = [
     PushFilterThroughSort(),
     PushFilterThroughUnion(),
     SimplifyCountOverConstant(),
+    MergeLimitWithTopN(),
+    PushTopNThroughUnion(),
+    PushLimitThroughRowPreserving(),
+    PruneCountAggregationOverScalar(),
+    GatherAndMergeWindows(),
+    PruneUnionColumns(),
 ]
 
 

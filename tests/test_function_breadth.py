@@ -688,3 +688,14 @@ def test_map_union_rejects_multimap_and_hll(env):
             "(values (1), (2)) t(k)) s"):
         with pytest.raises(Exception):
             runner.execute(sql)
+
+
+def test_map_union_of_empty_maps_is_empty_map(env):
+    """A group whose maps are all EMPTY (not NULL) unions to an empty
+    map, not NULL (code-review regression: validity tracks rows, not
+    entries)."""
+    runner, _ = env
+    (m,) = runner.execute(
+        "select map_union(m) from (select map(slice(array[1], 1, 0), "
+        "slice(array[10], 1, 0)) m) t").rows[0]
+    assert m == {}

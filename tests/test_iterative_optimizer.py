@@ -346,3 +346,121 @@ def test_count_literal_becomes_count_star(runner):
         runner.execute("SELECT count(*) FROM orders").rows
     # count(NULL) is 0, not count(*)
     assert runner.execute("SELECT count(NULL) FROM orders").rows == [(0,)]
+
+
+# ---------------------------------------------------------------------------
+# round-4b rules
+# ---------------------------------------------------------------------------
+
+def test_merge_limit_with_topn(runner):
+    from presto_tpu.planner.plan import TopNNode
+
+    plan = runner.plan(
+        "SELECT * FROM (SELECT n_name FROM nation ORDER BY n_name "
+        "LIMIT 10) LIMIT 3")
+    topns = _find(plan, TopNNode)
+    assert topns and all(t.count == 3 for t in topns)
+    assert not _find(plan, LimitNode)
+    rows = runner.execute(
+        "SELECT * FROM (SELECT n_name FROM nation ORDER BY n_name "
+        "LIMIT 10) LIMIT 3").rows
+    assert [r[0] for r in rows] == sorted(
+        r for (r,) in runner.execute("SELECT n_name FROM nation").rows)[:3]
+
+
+def test_push_topn_through_union(runner):
+    from presto_tpu.planner.plan import TopNNode, UnionNode
+
+    sql = ("SELECT n_nationkey FROM nation UNION ALL "
+           "SELECT r_regionkey FROM region ORDER BY 1 DESC LIMIT 4")
+    plan = runner.plan(sql)
+    unions = _find(plan, UnionNode)
+    assert unions
+    for u in unions:
+        for arm in u.inputs:
+            # the planted per-arm TopN may sit below the arm projection
+            arm_topns = _find(arm, TopNNode)
+            assert arm_topns and all(t.count == 4 for t in arm_topns)
+    keys = sorted([r[0] for r in runner.execute(
+        "SELECT n_nationkey FROM nation").rows] + [r[0] for r in
+        runner.execute("SELECT r_regionkey FROM region").rows],
+        reverse=True)
+    assert [r[0] for r in runner.execute(sql).rows] == keys[:4]
+
+
+def test_push_limit_through_row_preserving(runner):
+    from presto_tpu.planner.plan import CrossSingleNode, JoinNode
+
+    def probe_has_limit(n):
+        while not isinstance(n, LimitNode):
+            if not n.sources:
+                return False
+            n = n.sources[0]
+        return True
+
+    # scalar-subquery cross product: one output row per probe row
+    sql = ("SELECT n_name, (SELECT max(r_regionkey) FROM region) "
+           "FROM nation LIMIT 5")
+    plan = runner.plan(sql)
+    crosses = _find(plan, CrossSingleNode)
+    assert crosses and any(probe_has_limit(c.left) for c in crosses)
+    assert len(runner.execute(sql).rows) == 5
+
+    # left join with a unique (primary-key) build side
+    sql2 = ("SELECT n_name, r_name FROM nation LEFT JOIN region "
+            "ON n_regionkey = r_regionkey LIMIT 7")
+    plan2 = runner.plan(sql2)
+    joins = [j for j in _find(plan2, JoinNode)
+             if j.kind == "left" and j.unique_build]
+    assert joins and any(probe_has_limit(j.left) for j in joins)
+    assert len(runner.execute(sql2).rows) == 7
+
+
+def test_prune_count_aggregation_over_scalar(runner):
+    from presto_tpu.planner.plan import AggregationNode
+
+    sql = "SELECT count(*) FROM (SELECT max(n_nationkey) FROM nation)"
+    plan = runner.plan(sql)
+    assert not _find(plan, AggregationNode)
+    assert runner.execute(sql).rows == [(1,)]
+
+
+def test_gather_and_merge_windows(runner):
+    from presto_tpu.planner.plan import WindowNode
+
+    sql = ("SELECT n_name, "
+           "rank() OVER (PARTITION BY n_regionkey ORDER BY n_name), "
+           "row_number() OVER (PARTITION BY n_regionkey ORDER BY n_name) "
+           "FROM nation")
+    plan = runner.plan(sql)
+    windows = _find(plan, WindowNode)
+    assert len(windows) == 1 and len(windows[0].funcs) == 2
+    rows = runner.execute(sql).rows
+    assert len(rows) == 25
+    for _, rk, rn in rows:
+        assert rk <= rn
+
+
+def test_windows_not_merged_when_specs_differ(runner):
+    from presto_tpu.planner.plan import WindowNode
+
+    plan = runner.plan(
+        "SELECT n_name, "
+        "rank() OVER (PARTITION BY n_regionkey ORDER BY n_name), "
+        "rank() OVER (ORDER BY n_name) FROM nation")
+    assert len(_find(plan, WindowNode)) == 2
+
+
+def test_prune_union_columns(runner):
+    from presto_tpu.planner.plan import UnionNode
+
+    sql = ("SELECT k FROM (SELECT n_nationkey k, n_name, n_comment "
+           "FROM nation UNION ALL SELECT r_regionkey, r_name, r_comment"
+           " FROM region) WHERE k < 2")
+    plan = runner.plan(sql)
+    unions = _find(plan, UnionNode)
+    # the column selection moved into the arms: every union emits only
+    # the single surviving channel
+    assert unions and all(len(u.channels) == 1 for u in unions)
+    got = sorted(r[0] for r in runner.execute(sql).rows)
+    assert got == [0, 0, 1, 1]
