@@ -51,7 +51,7 @@ STRING_TRANSFORM_FNS = frozenset({
     "regexp_extract", "regexp_replace", "replace", "split_part",
     "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
     "url_extract_host", "url_extract_path", "url_extract_protocol",
-    "url_extract_query",
+    "url_extract_query", "translate", "normalize", "soundex",
 })
 
 
@@ -79,7 +79,150 @@ _UNARY_DOUBLE_FNS = {
     "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
     "degrees": jnp.degrees, "radians": jnp.radians,
     "is_nan": jnp.isnan, "is_finite": jnp.isfinite,
+    "is_infinite": jnp.isinf,
 }
+
+
+# MySQL date_format/date_parse pattern -> python strftime/strptime
+# (DateTimeFunctions.java's JodaTime DateTimeFormat table)
+_MYSQL_FMT = {
+    "Y": "%Y", "y": "%y", "m": "%m", "c": "%-m", "d": "%d", "e": "%-d",
+    "j": "%j", "a": "%a", "W": "%A", "b": "%b", "M": "%B", "w": "%w",
+    "H": "%H", "k": "%-H", "h": "%I", "I": "%I", "i": "%M", "s": "%S",
+    "S": "%S", "f": "%f", "p": "%p", "T": "%H:%M:%S", "r": "%I:%M:%S %p",
+    "%": "%%",
+    # %-m / %-d / %-H (non-padded c/e/k) are glibc strftime extensions;
+    # strptime ignores the flag, so parsing accepts both forms
+}
+
+#: format codes that need time-of-day (unsupported for DATE columns'
+#: domain-dictionary path only when formatting, fine for parsing)
+_MYSQL_TIME_CODES = frozenset("HkhIisSfpTr")
+
+
+def _mysql_to_strftime(fmt: str, for_parse: bool = False) -> str:
+    out, i = [], 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "%" and i + 1 < len(fmt):
+            code = fmt[i + 1]
+            got = _MYSQL_FMT.get(code)
+            if got is None:
+                raise ValueError(f"unsupported date format code %{code}")
+            if for_parse:
+                # strptime rejects the glibc no-pad flag but already
+                # accepts non-padded numbers under the plain codes
+                got = got.replace("%-", "%")
+            out.append(got)
+            i += 2
+        else:
+            out.append(ch.replace("%", "%%"))
+            i += 1
+    return "".join(out)
+
+
+def mysql_datetime_micros(v: str, fmt: str):
+    """date_parse's conversion, shared by the bind-time literal fold
+    and the column LUT so they cannot diverge.  None on parse failure
+    (deviation: the reference raises)."""
+    import datetime as _dt
+
+    try:
+        ts = _dt.datetime.strptime(v, _mysql_to_strftime(fmt, for_parse=True))
+    except ValueError:
+        return None
+    delta = ts - _dt.datetime(1970, 1, 1)
+    return ((delta.days * 86400 + delta.seconds) * 1_000_000
+            + delta.microseconds)  # exact, no float round-trip
+
+
+def iso_date_days(v: str):
+    """from_iso8601_date's epoch-day conversion (shared fold/LUT)."""
+    import datetime as _dt
+
+    try:
+        return _dt.date.fromisoformat(v).toordinal() - 719163
+    except ValueError:
+        return None
+
+
+def xxh64_signed(data: bytes) -> int:
+    """xxhash64 wrapped into BIGINT's signed range (shared fold/LUT)."""
+    h = _xxh64(data)
+    return h - (1 << 64) if h >= (1 << 63) else h
+
+
+def _levenshtein(a: str, b: str) -> int:
+    """Classic DP edit distance (StringFunctions.java#levenshteinDistance)."""
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _xxh64(data: bytes, seed: int = 0) -> int:
+    """xxHash64 (public spec, xxhash.com) — host-side over dictionary
+    values, one device gather for the column form."""
+    P1, P2, P3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9
+    P4, P5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+    M = (1 << 64) - 1
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while i + 32 <= n:
+            for k, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 8 * k:i + 8 * k + 8], "little")
+                v = (v + lane * P2) & M
+                v = (rotl(v, 31) * P1) & M
+                if k == 0:
+                    v1 = v
+                elif k == 1:
+                    v2 = v
+                elif k == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            v = (rotl((v * P2) & M, 31) * P1) & M
+            h = ((h ^ v) * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i + 8 <= n:
+        lane = int.from_bytes(data[i:i + 8], "little")
+        h ^= (rotl((lane * P2) & M, 31) * P1) & M
+        h = (rotl(h, 27) * P1 + P4) & M
+        i += 8
+    if i + 4 <= n:
+        h ^= (int.from_bytes(data[i:i + 4], "little") * P1) & M
+        h = (rotl(h, 23) * P2 + P3) & M
+        i += 4
+    while i < n:
+        h ^= (data[i] * P5) & M
+        h = (rotl(h, 11) * P1) & M
+        i += 1
+    h ^= h >> 33
+    h = (h * P2) & M
+    h ^= h >> 29
+    h = (h * P3) & M
+    h ^= h >> 32
+    return h
 
 
 def _json_path_get(doc: str, path: str):
@@ -150,6 +293,45 @@ def _string_transform(e: "Call"):
         frm = e.args[1].value
         to = e.args[2].value if len(e.args) > 2 else ""
         return lambda v: v.replace(frm, to), key
+    if fn == "translate":
+        # chars of `from` map positionally to `to`; unpaired chars drop
+        # (StringFunctions.java#translate)
+        frm = e.args[1].value
+        to = e.args[2].value
+        table: dict = {}
+        for i, f in enumerate(frm):
+            # first occurrence of a duplicated `from` char wins
+            table.setdefault(ord(f), to[i] if i < len(to) else None)
+        return lambda v: v.translate(table), key
+    if fn == "normalize":
+        form = e.args[1].value if len(e.args) > 1 else "NFC"
+        import unicodedata
+
+        return lambda v: unicodedata.normalize(form, v), key
+    if fn == "soundex":
+        # classic American Soundex (StringFunctions.java#soundex)
+        codes = {}
+        for group, digit in (("BFPV", "1"), ("CGJKQSXZ", "2"),
+                             ("DT", "3"), ("L", "4"), ("MN", "5"),
+                             ("R", "6")):
+            for ch in group:
+                codes[ch] = digit
+
+        def sdx(v, codes=codes):
+            s = [c for c in v.upper() if c.isalpha()]
+            if not s:
+                return None
+            out = s[0]
+            prev = codes.get(s[0], "")
+            for c in s[1:]:
+                d = codes.get(c, "")
+                if d and d != prev:
+                    out += d
+                if c not in "HW":
+                    prev = d
+            return (out + "000")[:4]
+
+        return sdx, key
     if fn == "split_part":
         delim, n = e.args[1].value, int(e.args[2].value)
 
@@ -241,6 +423,11 @@ def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Op
     if isinstance(e, Call) and e.fn == "cast_char":
         # metadata-only re-type: same codes, same dictionary
         return expr_dictionary(e.args[0], dictionaries)
+    if isinstance(e, Call) and e.fn == "date_format":
+        fmt = e.args[1]
+        if isinstance(fmt, Literal) and fmt.value is not None:
+            return ExprCompiler.date_format_dictionary(fmt.value)
+        return None
     if isinstance(e, Call) and e.fn in ("case", "if", "coalesce"):
         return merged_string_dictionary(e, dictionaries)
     if isinstance(e, Call) and e.fn in STRING_TRANSFORM_FNS:
@@ -632,10 +819,22 @@ class ExprCompiler:
 
             return run_derived
         if fn in ("length", "strpos", "codepoint", "json_array_length",
-                  "url_extract_port"):
+                  "url_extract_port", "from_base", "date_parse",
+                  "from_iso8601_date", "levenshtein_distance",
+                  "hamming_distance"):
             if expr.args[0].type.is_raw_string:
+                if fn not in ("length", "strpos", "codepoint"):
+                    raise ValueError(
+                        f"{fn} is unsupported over raw varchar columns "
+                        "(dictionary varchar runs it as a value LUT)")
                 return self._compile_raw_int_fn(expr)
             return self._compile_string_lut_fn(expr)
+        if fn in ("crc32", "xxhash64"):
+            return self._compile_binary_hash(expr)
+        if fn == "date_format":
+            return self._compile_date_format(expr)
+        if fn in ("last_day_of_month", "year_of_week"):
+            return self._compile_datepart(expr)
         if fn in ("regexp_like", "starts_with", "ends_with", "is_json_scalar"):
             if expr.args[0].type.is_raw_string:
                 return self._compile_raw_bool(expr)
@@ -668,8 +867,11 @@ class ExprCompiler:
                   "power", "pow", "ceil", "ceiling", "floor", "round",
                   "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
                   "sinh", "cosh", "tanh", "degrees", "radians", "truncate",
-                  "width_bucket", "is_nan", "is_finite"):
+                  "width_bucket", "is_nan", "is_finite", "is_infinite"):
             return self._compile_math(expr)
+        if fn in ("bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+                  "bitwise_shift_left", "bitwise_shift_right", "bit_count"):
+            return self._compile_bitwise(expr)
         if fn in ("greatest", "least"):
             return self._compile_greatest_least(expr)
         if fn == "nullif":
@@ -707,11 +909,23 @@ class ExprCompiler:
         dictionary, one device gather (length, strpos, codepoint,
         json_array_length, url_extract_port). None values null out."""
         colref = expr.args[0]
+        if expr.fn in ("levenshtein_distance", "hamming_distance") \
+                and isinstance(colref, Literal):
+            colref = expr.args[1]  # literal may sit on either side
         cf = self.compile(colref)
         d = self._dict_of(colref)
         if d is None:
             raise ValueError(f"no dictionary for string column {colref}")
         fn = expr.fn
+        if any(isinstance(a, Literal) and a.value is None
+               for a in expr.args):
+            # a NULL parameter argument (either side for the symmetric
+            # distance fns) nulls the whole column out
+            def run_null(page):
+                dd, v = cf(page)
+                return jnp.zeros_like(dd, dtype=jnp.int64), v & False
+
+            return run_null
         if fn == "length":
             lut_vals = [len(v) for v in d.values]
         elif fn == "strpos":  # strpos(col, needle_literal): 1-based, 0 = miss
@@ -731,6 +945,36 @@ class ExprCompiler:
                 return len(got) if isinstance(got, list) else None
 
             lut_vals = [jal(v) for v in d.values]
+        elif fn == "from_base":
+            radix = int(expr.args[1].value)
+
+            def fb(v, radix=radix):
+                try:
+                    return int(v, radix)
+                except Exception:
+                    return None
+
+            lut_vals = [fb(v) for v in d.values]
+        elif fn == "date_parse":
+            fmt = expr.args[1].value
+            lut_vals = [mysql_datetime_micros(v, fmt) for v in d.values]
+        elif fn == "from_iso8601_date":
+            lut_vals = [iso_date_days(v) for v in d.values]
+        elif fn in ("levenshtein_distance", "hamming_distance"):
+            other = expr.args[1] if isinstance(expr.args[1], Literal) \
+                else expr.args[0]
+            if not isinstance(other, Literal) or other.value is None:
+                raise ValueError(f"{fn} needs one literal argument "
+                                 "(column x column would need a cross "
+                                 "product of dictionaries)")
+            lit = other.value
+            if fn == "hamming_distance":
+                lut_vals = [
+                    sum(a != b for a, b in zip(v, lit))
+                    if len(v) == len(lit) else None  # deviation: ref raises
+                    for v in d.values]
+            else:
+                lut_vals = [_levenshtein(v, lit) for v in d.values]
         else:  # url_extract_port
             from urllib.parse import urlparse
 
@@ -788,6 +1032,87 @@ class ExprCompiler:
             return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
 
         return run_blut
+
+    def _compile_binary_hash(self, expr: Call) -> CompiledExpr:
+        """crc32 / xxhash64 of to_utf8(varchar): hashed host-side over
+        the dictionary values, one device gather
+        (VarbinaryFunctions.java#crc32/#xxhash64).  Only the
+        to_utf8(string) composition is supported — general varbinary
+        lanes would hash bytes on device."""
+        inner = expr.args[0]
+        if not (isinstance(inner, Call) and inner.fn == "to_utf8"):
+            raise ValueError(f"{expr.fn} supports to_utf8(varchar) "
+                             "arguments only")
+        colref = inner.args[0]
+        cf = self.compile(colref)
+        d = self._dict_of(colref)
+        if d is None:
+            raise ValueError(f"no dictionary for string column {colref}")
+        if expr.fn == "crc32":
+            import zlib
+
+            vals = [zlib.crc32(v.encode()) for v in d.values]
+        else:
+            vals = [xxh64_signed(v.encode()) for v in d.values]
+        lut = jnp.asarray(vals, dtype=jnp.int64)
+
+        def run_hash(page):
+            dd, v = cf(page)
+            return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
+
+        return run_hash
+
+    # date_format dictionaries are pure functions of (fmt, day range) —
+    # cache them across queries
+    _DATE_FMT_CACHE: dict = {}
+    #: formatted-day dictionary range: 1900-01-01 .. 2100-01-01
+    DATE_FMT_BASE = -25567
+    DATE_FMT_SPAN = 73049
+
+    @classmethod
+    def date_format_dictionary(cls, fmt: str) -> "Dictionary":
+        """The domain dictionary for date_format(date_col, fmt): one
+        formatted string per epoch day over a 1900..2100 range, codes =
+        day - base.  TPU-first: the format never touches the device —
+        dates become dictionary codes with one subtract."""
+        got = cls._DATE_FMT_CACHE.get(fmt)
+        if got is not None:
+            return got
+        import datetime as _dt
+
+        py_fmt = _mysql_to_strftime(fmt)
+        if any(c in _MYSQL_TIME_CODES
+               for c in re.findall(r"%(.)", fmt)):
+            raise ValueError(
+                "date_format supports date-valued columns (time-of-day "
+                "format codes need the timestamp's full domain)")
+        base = _dt.date(1900, 1, 1)
+        values = [(base + _dt.timedelta(days=i)).strftime(py_fmt)
+                  for i in range(cls.DATE_FMT_SPAN)]
+        d = Dictionary(values)
+        cls._DATE_FMT_CACHE[fmt] = d
+        return d
+
+    def _compile_date_format(self, expr: Call) -> CompiledExpr:
+        if expr.args[0].type.name not in ("date", "timestamp"):
+            raise ValueError("date_format requires a date argument")
+        fmt = expr.args[1]
+        if not isinstance(fmt, Literal) or fmt.value is None:
+            raise ValueError("date_format format must be a literal")
+        self.date_format_dictionary(fmt.value)  # validate fmt eagerly
+        a = self.compile(expr.args[0])
+        is_ts = expr.args[0].type.name == "timestamp"
+
+        def run_date_format(page):
+            d, v = a(page)
+            days = (d.astype(jnp.int64) // MICROS_PER_DAY) if is_ts \
+                else d.astype(jnp.int64)
+            code = days - self.DATE_FMT_BASE
+            inrange = (code >= 0) & (code < self.DATE_FMT_SPAN)
+            return jnp.clip(code, 0, self.DATE_FMT_SPAN - 1).astype(
+                jnp.int32), v & inrange
+
+        return run_date_format
 
     # HLL sketch primitives (reference:
     # operator/aggregation/ApproximateCountDistinctAggregations.java +
@@ -1383,6 +1708,54 @@ class ExprCompiler:
             return run_rawlit
         return self.compile(e)
 
+    def _compile_bitwise(self, expr: Call) -> CompiledExpr:
+        """Two's-complement bitwise scalars over int64 lanes
+        (operator/scalar/BitwiseFunctions.java).  Shifts and bit_count
+        take a literal `bits` width and operate on the value's low
+        `bits` as an unsigned field (the reference's contract)."""
+        fn = expr.fn
+        fns = [self.compile(a) for a in expr.args
+               if not (fn in ("bitwise_shift_left", "bitwise_shift_right",
+                              "bit_count") and a is expr.args[-1])]
+        bits = None
+        if fn in ("bitwise_shift_left", "bitwise_shift_right", "bit_count"):
+            blit = expr.args[-1]
+            if not isinstance(blit, Literal) or blit.value is None:
+                raise ValueError(f"{fn} bits must be a literal")
+            bits = int(blit.value)
+            if not 2 <= bits <= 64:
+                raise ValueError(f"{fn} bits must be in [2, 64]")
+
+        def run_bitwise(page):
+            vals = [f(page) for f in fns]
+            v = vals[0][1]
+            for _, vv in vals[1:]:
+                v = v & vv
+            a = vals[0][0].astype(jnp.int64)
+            if fn == "bitwise_not":
+                return ~a, v
+            if fn in ("bitwise_and", "bitwise_or", "bitwise_xor"):
+                b = vals[1][0].astype(jnp.int64)
+                out = {"bitwise_and": a & b, "bitwise_or": a | b,
+                       "bitwise_xor": a ^ b}[fn]
+                return out, v
+            ua = a.astype(jnp.uint64)
+            if bits < 64:
+                ua = ua & jnp.uint64((1 << bits) - 1)
+            if fn == "bit_count":
+                return jax.lax.population_count(ua).astype(jnp.int64), v
+            # Java shift semantics (the reference's engine): the shift
+            # amount wraps mod 64, so shift 64 is a no-op and -1 acts
+            # as 63 — mask, don't clamp
+            s = (vals[1][0].astype(jnp.int64) & 63).astype(jnp.uint64)
+            out = jnp.left_shift(ua, s) if fn == "bitwise_shift_left" \
+                else jnp.right_shift(ua, s)
+            if bits < 64:
+                out = out & jnp.uint64((1 << bits) - 1)
+            return out.astype(jnp.int64), v
+
+        return run_bitwise
+
     def _compile_greatest_least(self, expr: Call) -> CompiledExpr:
         out_t = expr.type
         parts = [(self._compile_operand(x, out_t), x.type) for x in expr.args]
@@ -1967,9 +2340,22 @@ class ExprCompiler:
             elif part == "day_of_year":
                 jan1 = days - _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(day))
                 out = jan1 + 1
-            elif part == "week":
-                jan1 = days - _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(day))
-                out = jan1 // 7 + 1  # simple week-of-year
+            elif part in ("week", "year_of_week"):
+                # ISO 8601: the week containing a date's Thursday
+                # belongs to the Thursday's civil year (the reference's
+                # Joda weekOfWeekyear/weekyear)
+                th = days - (days + 3) % 7 + 3
+                y_th, _, _ = _civil_from_days(th)
+                if part == "year_of_week":
+                    out = y_th
+                else:
+                    jan1 = _days_from_civil(
+                        y_th, jnp.ones_like(m), jnp.ones_like(day))
+                    out = (th - jan1) // 7 + 1
+            elif part == "last_day_of_month":
+                nxt_y = jnp.where(m == 12, y + 1, y)
+                nxt_m = jnp.where(m == 12, 1, m + 1)
+                out = _days_from_civil(nxt_y, nxt_m, jnp.ones_like(day)) - 1
             else:
                 raise KeyError(part)
             return out.astype(jnp.int64), v

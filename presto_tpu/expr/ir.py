@@ -210,8 +210,27 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
               "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
               "json_format", "url_extract_host", "url_extract_path",
               "url_extract_protocol", "url_extract_query", "url_decode",
-              "url_encode", "normalize", "to_hex"):
+              "url_encode", "normalize", "to_hex", "translate", "soundex"):
         return ts[0]
+    if fn in ("bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+              "bitwise_shift_left", "bitwise_shift_right", "bit_count",
+              "from_base", "crc32", "xxhash64", "year_of_week",
+              "levenshtein_distance", "hamming_distance"):
+        return BIGINT
+    if fn == "is_infinite":
+        return BOOLEAN
+    if fn == "date_format":
+        from presto_tpu.types import VARCHAR as _VARCHAR
+
+        return _VARCHAR
+    if fn == "date_parse":
+        return TIMESTAMP
+    if fn in ("from_iso8601_date", "last_day_of_month"):
+        return DATE
+    if fn == "to_utf8":
+        from presto_tpu.types import VarbinaryType
+
+        return VarbinaryType(64)
     if fn in ("regexp_like", "starts_with", "ends_with", "contains_str",
               "is_json_scalar"):
         return BOOLEAN

@@ -96,7 +96,19 @@ SCALAR_FUNCTIONS = {
     "abs", "sign", "sqrt", "cbrt", "exp", "ln", "log10", "log2", "power", "pow",
     "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
     "sinh", "cosh", "tanh", "degrees", "radians", "truncate",
-    "width_bucket", "is_nan", "is_finite", "pi", "e",
+    "width_bucket", "is_nan", "is_finite", "is_infinite", "pi", "e",
+    "nan", "infinity",
+    # bitwise scalars (operator/scalar/BitwiseFunctions.java)
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_shift_left", "bitwise_shift_right", "bit_count",
+    # base conversion / binary hashes (VarbinaryFunctions.java)
+    "from_base", "to_base", "crc32", "xxhash64", "to_utf8",
+    # datetime breadth (DateTimeFunctions.java)
+    "date_format", "date_parse", "from_iso8601_date",
+    "last_day_of_month", "year_of_week",
+    # string breadth (StringFunctions.java)
+    "chr", "translate", "normalize", "soundex",
+    "levenshtein_distance", "hamming_distance",
     "ceil", "ceiling", "floor", "round", "mod", "greatest", "least",
     "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
     "trim", "ltrim", "rtrim", "reverse", "substr",
@@ -2714,11 +2726,55 @@ class Binder:
                     raise BindError("now() takes no arguments")
                 return Literal(type=TIMESTAMP,
                                value=int(self._query_now() * 1_000_000))
-            if e.name in ("pi", "e") and not e.args:
+            if e.name in ("pi", "e", "nan", "infinity") and not e.args:
                 import math as _math
 
-                return Literal(type=DOUBLE,
-                               value=_math.pi if e.name == "pi" else _math.e)
+                return Literal(type=DOUBLE, value={
+                    "pi": _math.pi, "e": _math.e, "nan": _math.nan,
+                    "infinity": _math.inf}[e.name])
+            if e.name in ("week_of_year", "yow", "doy", "dow",
+                          "day_of_month"):
+                # DateTimeFunctions.java aliases
+                canon = {"week_of_year": "week", "yow": "year_of_week",
+                         "doy": "day_of_year", "dow": "day_of_week",
+                         "day_of_month": "day"}[e.name]
+                return self._bind_impl(
+                    ast.FuncCall(canon, e.args), scope, agg)
+            if e.name == "chr":
+                # code point -> single-char string; literal-foldable
+                # only (a column form would need a dynamic dictionary)
+                arg = self._bind_impl(e.args[0], scope, agg) if e.args else None
+                if not isinstance(arg, Literal):
+                    raise BindError("chr requires an integer literal")
+                if arg.value is None:
+                    return Literal(type=VARCHAR, value=None)
+                cp = int(arg.value)
+                if not 0 <= cp < 0x110000:
+                    raise BindError(f"chr code point out of range: {cp}")
+                return Literal(type=VARCHAR, value=chr(cp))
+            if e.name == "to_base":
+                if len(e.args) != 2:
+                    raise BindError("to_base takes (value, radix)")
+                v = self._bind_impl(e.args[0], scope, agg)
+                rx = self._bind_impl(e.args[1], scope, agg)
+                if not isinstance(v, Literal) or not isinstance(rx, Literal):
+                    raise BindError(
+                        "to_base supports literal arguments only (a "
+                        "column form would need a dynamic dictionary)")
+                if v.value is None or rx.value is None:
+                    return Literal(type=VARCHAR, value=None)
+                n, radix = int(v.value), int(rx.value)
+                if not 2 <= radix <= 36:
+                    raise BindError("to_base radix must be in [2, 36]")
+                digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+                m, out = abs(n), ""
+                while True:
+                    m, r = divmod(m, radix)
+                    out = digits[r] + out
+                    if m == 0:
+                        break
+                return Literal(type=VARCHAR,
+                               value=("-" if n < 0 else "") + out)
             if e.name == "index":
                 # teradata index(s, sub) = strpos (DateTimeFunctions.java
                 # analog in presto-teradata-functions)
@@ -2738,6 +2794,9 @@ class Binder:
                 return self._bind_agg_call(e, scope, agg)
             if e.name in SCALAR_FUNCTIONS:
                 args = [self._bind_impl(a, scope, agg) for a in e.args]
+                folded = self._fold_literal_call(e.name, args)
+                if folded is not None:
+                    return folded
                 if e.name == "concat" and len(args) == 2 \
                         and any(a.type.is_array for a in args):
                     # ARRAY || scalar appends the element (and the
@@ -3213,6 +3272,77 @@ class Binder:
         a = AggCall(fn=fn, arg=arg, type=arg.type, distinct=distinct)
         a = AggCall(fn=a.fn, arg=a.arg, type=output_type(a), distinct=a.distinct)
         return agg.agg_ref(a)
+
+    @staticmethod
+    def _fold_literal_call(fn, args):
+        """Constant-fold scalar calls whose column forms run through
+        dictionary LUTs — with literal arguments there is no dictionary
+        to transform, so the value computes at bind time (the
+        reference's constant folding in ExpressionInterpreter.java)."""
+        from presto_tpu.expr.compile import (
+            STRING_TRANSFORM_FNS, _levenshtein, _string_transform,
+            iso_date_days, mysql_datetime_micros, xxh64_signed,
+        )
+
+        def lit_val(a):
+            return a.value if isinstance(a, Literal) else None
+
+        if fn in ("crc32", "xxhash64") and len(args) == 1 \
+                and isinstance(args[0], Call) and args[0].fn == "to_utf8" \
+                and isinstance(args[0].args[0], Literal):
+            s = args[0].args[0].value
+            if s is None:
+                return Literal(type=BIGINT, value=None)
+            import zlib
+
+            if fn == "crc32":
+                return Literal(type=BIGINT, value=zlib.crc32(s.encode()))
+            return Literal(type=BIGINT, value=xxh64_signed(s.encode()))
+        if not args or not all(isinstance(a, Literal) for a in args):
+            return None
+        v0 = lit_val(args[0])
+        _null_out = {"from_base": BIGINT, "levenshtein_distance": BIGINT,
+                     "hamming_distance": BIGINT, "date_parse": TIMESTAMP,
+                     "from_iso8601_date": DATE}
+        if fn in _null_out and any(a.value is None for a in args):
+            # NULL in ANY argument is NULL out (reference convention)
+            return Literal(type=_null_out[fn], value=None)
+        if fn in STRING_TRANSFORM_FNS and isinstance(v0, (str, type(None))) \
+                and args[0].type.is_string:
+            if any(a.value is None for a in args):
+                return Literal(type=VARCHAR, value=None)
+            tf = _string_transform(Call(type=args[0].type, fn=fn,
+                                        args=tuple(args)))
+            if tf is None:
+                return None
+            f, _ = tf
+            out = None if v0 is None else f(v0)
+            return Literal(type=VARCHAR, value=out)
+        if v0 is None:
+            return None
+        if fn == "from_base":
+            try:
+                return Literal(type=BIGINT,
+                               value=int(v0, int(args[1].value)))
+            except ValueError as ex:
+                raise BindError(f"from_base: {ex}")
+        if fn == "date_parse":
+            return Literal(type=TIMESTAMP,
+                           value=mysql_datetime_micros(v0, args[1].value))
+        if fn == "from_iso8601_date":
+            return Literal(type=DATE, value=iso_date_days(v0))
+        if fn == "levenshtein_distance":
+            return Literal(type=BIGINT,
+                           value=_levenshtein(v0, args[1].value))
+        if fn == "hamming_distance":
+            b = args[1].value
+            if len(v0) != len(b):
+                # deviation (documented): NULL where the reference
+                # raises — matches the column LUT path
+                return Literal(type=BIGINT, value=None)
+            return Literal(type=BIGINT,
+                           value=sum(x != y for x, y in zip(v0, b)))
+        return None
 
     @staticmethod
     def _check_topn_count(fn, nn):

@@ -1,0 +1,256 @@
+"""Round-4b scalar breadth: bitwise/numeric device functions and the
+datetime LUT/domain-dictionary family.
+
+Reference analogs: operator/scalar/BitwiseFunctions.java,
+MathFunctions.java (NAN/INFINITY), DateTimeFunctions.java
+(date_format/date_parse/week/year_of_week/last_day_of_month),
+VarbinaryFunctions.java (crc32/xxhash64/to_utf8 — here computed over
+dictionary values host-side, one device gather).
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def _d(days):
+    """DATE channels materialize as epoch-day ints (engine convention)."""
+    return EPOCH + datetime.timedelta(days=int(days))
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.runner import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    cat = Catalog()
+    cat.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    return QueryRunner(cat)
+
+
+def one(runner, sql):
+    return runner.execute(sql).rows[0][0]
+
+
+# ---------------------------------------------------------------------------
+# bitwise / numeric
+# ---------------------------------------------------------------------------
+
+def test_bitwise_scalars(runner):
+    assert one(runner, "select bitwise_and(19, 25)") == 19 & 25
+    assert one(runner, "select bitwise_or(19, 25)") == 19 | 25
+    assert one(runner, "select bitwise_xor(19, 25)") == 19 ^ 25
+    assert one(runner, "select bitwise_not(19)") == ~19
+    assert one(runner, "select bitwise_shift_left(1, 5, 64)") == 32
+    assert one(runner, "select bitwise_shift_right(-8, 2, 64)") \
+        == ((-8) % (1 << 64)) >> 2
+    assert one(runner, "select bitwise_shift_left(7, 2, 4)") == (7 << 2) % 16
+
+
+def test_bit_count(runner):
+    assert one(runner, "select bit_count(7, 64)") == 3
+    assert one(runner, "select bit_count(-1, 64)") == 64
+    assert one(runner, "select bit_count(-1, 8)") == 8
+    assert one(runner, "select bit_count(9, 64)") == 2
+
+
+def test_bitwise_over_column(runner):
+    rows = runner.execute(
+        "select o_orderkey, bitwise_and(o_orderkey, 255), "
+        "bitwise_xor(o_orderkey, 7) from orders limit 100").rows
+    for k, a, x in rows:
+        assert a == k & 255 and x == k ^ 7
+
+
+def test_nan_infinity(runner):
+    assert one(runner, "select is_nan(nan())") is True
+    assert one(runner, "select is_infinite(infinity())") is True
+    assert one(runner, "select is_infinite(1.5)") is False
+    assert one(runner, "select infinity() > 1e300") is True
+
+
+def test_from_base(runner):
+    assert one(runner, "select from_base('ff', 16)") == 255
+    assert one(runner, "select from_base('-101', 2)") == -5
+    assert one(runner, "select from_base('z', 36)") == 35
+
+
+def test_to_base(runner):
+    assert one(runner, "select to_base(255, 16)") == "ff"
+    assert one(runner, "select to_base(-5, 2)") == "-101"
+    assert one(runner, "select to_base(0, 8)") == "0"
+
+
+def test_crc32_xxhash64(runner):
+    import zlib
+
+    assert one(runner, "select crc32(to_utf8('presto'))") \
+        == zlib.crc32(b"presto")
+    # xxhash64 of empty-seed spec vector (xxHash reference value)
+    assert one(runner, "select xxhash64(to_utf8(''))") \
+        == 0xEF46DB3751D8E999 - (1 << 64)
+    got = runner.execute(
+        "select n_name, crc32(to_utf8(n_name)) from nation").rows
+    for name, c in got:
+        assert c == zlib.crc32(name.encode())
+
+
+# ---------------------------------------------------------------------------
+# datetime
+# ---------------------------------------------------------------------------
+
+def test_iso_week_functions(runner):
+    rows = runner.execute(
+        "select o_orderdate, week(o_orderdate), week_of_year(o_orderdate),"
+        " year_of_week(o_orderdate), yow(o_orderdate) "
+        "from orders limit 300").rows
+    for d, w, w2, yw, yw2 in rows:
+        iso = _d(d).isocalendar()
+        assert w == w2 == iso[1], d
+        assert yw == yw2 == iso[0], d
+
+
+def test_last_day_of_month(runner):
+    assert _d(one(runner, "select last_day_of_month(date '2020-02-10')")) \
+        == datetime.date(2020, 2, 29)
+    assert _d(one(runner, "select last_day_of_month(date '2021-12-31')")) \
+        == datetime.date(2021, 12, 31)
+    rows = runner.execute(
+        "select o_orderdate, last_day_of_month(o_orderdate) "
+        "from orders limit 200").rows
+    for di, ld in rows:
+        d = _d(di)
+        nxt = datetime.date(d.year + (d.month == 12), d.month % 12 + 1, 1)
+        assert _d(ld) == nxt - datetime.timedelta(days=1)
+
+
+def test_date_format(runner):
+    assert one(runner,
+               "select date_format(date '1995-03-04', '%Y-%m-%d')") \
+        == "1995-03-04"
+    rows = runner.execute(
+        "select o_orderdate, date_format(o_orderdate, '%d/%m/%Y') "
+        "from orders limit 200").rows
+    for d, fs in rows:
+        assert fs == _d(d).strftime("%d/%m/%Y")
+
+
+def test_date_parse_and_iso8601(runner):
+    assert one(runner, "select date_parse('1995-03-04', '%Y-%m-%d')") \
+        == datetime.datetime(1995, 3, 4)
+    assert one(runner,
+               "select date_parse('04/03/1995 13:30:15', "
+               "'%d/%m/%Y %H:%i:%s')") \
+        == datetime.datetime(1995, 3, 4, 13, 30, 15)
+    assert _d(one(runner, "select from_iso8601_date('2001-08-22')")) \
+        == datetime.date(2001, 8, 22)
+    # over a dictionary varchar column
+    rows = runner.execute(
+        "select s, date_parse(s, '%Y-%m-%d') from (values ('1999-01-08'),"
+        " ('2020-02-29')) t(s)").rows
+    for s, ts in rows:
+        assert ts == datetime.datetime.strptime(s, "%Y-%m-%d")
+
+
+def test_day_of_month_aliases(runner):
+    rows = runner.execute(
+        "select o_orderdate, day_of_month(o_orderdate), doy(o_orderdate),"
+        " dow(o_orderdate) from orders limit 100").rows
+    for di, dom, doy, dow in rows:
+        d = _d(di)
+        assert dom == d.day
+        assert doy == d.timetuple().tm_yday
+        assert dow == d.isoweekday()
+
+
+def test_null_arguments_null_out(runner):
+    """NULL in any argument is NULL out, never a crash (code-review
+    regression)."""
+    for sql in (
+            "select levenshtein_distance('abc', null)",
+            "select hamming_distance('abc', null)",
+            "select from_base('ff', null)",
+            "select from_base(null, 16)",
+            "select date_parse('1995-01-01', null)",
+            "select to_base(null, 16)",
+            "select chr(null)",
+            "select replace('abc', null)",
+            "select n2 from (select levenshtein_distance(n_name, null) n2 "
+            "from nation limit 1) t"):
+        assert runner.execute(sql).rows[0][0] is None, sql
+
+
+def test_shift_wraps_like_java(runner):
+    assert one(runner, "select bitwise_shift_left(1, 64, 64)") == 1
+    assert one(runner, "select bitwise_shift_left(1, 65, 64)") == 2
+    assert one(runner, "select bitwise_shift_right(8, 1, 64)") == 4
+
+
+def test_hamming_unequal_returns_null(runner):
+    assert one(runner, "select hamming_distance('ab', 'abc')") is None
+
+
+def test_date_parse_exact_micros(runner):
+    got = one(runner, "select date_parse('2017-08-01 13:30:15', "
+                      "'%Y-%m-%d %H:%i:%s')")
+    assert got == datetime.datetime(2017, 8, 1, 13, 30, 15)
+
+
+def test_levenshtein_over_column(runner):
+    rows = runner.execute(
+        "select n_name, levenshtein_distance(n_name, 'FRANCE'), "
+        "levenshtein_distance('FRANCE', n_name) from nation").rows
+
+    def lev(a, b):
+        import numpy as _np
+
+        m = _np.zeros((len(a) + 1, len(b) + 1), dtype=int)
+        m[:, 0] = range(len(a) + 1)
+        m[0, :] = range(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                m[i, j] = min(m[i - 1, j] + 1, m[i, j - 1] + 1,
+                              m[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return int(m[-1, -1])
+
+    for name, d1, d2 in rows:
+        assert d1 == d2 == lev(name, "FRANCE")
+
+
+def test_string_transform_breadth(runner):
+    assert one(runner, "select translate('abcd', 'abc', 'xy')") == "xyd"
+    assert one(runner, "select soundex('Robert')" ) == "R163"
+    assert one(runner, "select upper('x')") == "X"  # literal fold fixed
+    rows = runner.execute(
+        "select n_name, translate(n_name, 'AEIOU', 'aeiou'), "
+        "soundex(n_name) from nation").rows
+    for name, tr, sx in rows:
+        assert tr == name.translate(str.maketrans("AEIOU", "aeiou"))
+        assert len(sx) == 4 and sx[0] == name[0].upper()
+
+
+def test_translate_first_occurrence_wins(runner):
+    assert one(runner, "select translate('a', 'aa', 'xy')") == "x"
+
+
+def test_nonpadded_format_codes(runner):
+    assert one(runner,
+               "select date_format(date '2020-07-05', '%c/%e')") == "7/5"
+    assert one(runner, "select date_parse('7/5/2020', '%c/%e/%Y')") \
+        == datetime.datetime(2020, 7, 5)
+
+
+def test_null_first_argument_distance(runner):
+    assert runner.execute(
+        "select levenshtein_distance(null, n_name) from nation limit 1"
+    ).rows[0][0] is None
+
+
+def test_chr_out_of_range_is_bind_error(runner):
+    with pytest.raises(Exception) as ei:
+        runner.execute("select chr(1114112)")
+    assert "chr" in str(ei.value)
