@@ -67,6 +67,10 @@ _CONTAINER_FNS = frozenset({
     "map_values", "map", "map_construct",
     "array_transform", "array_filter", "any_match", "all_match",
     "none_match", "sequence", "slice", "repeat", "array_concat",
+    "array_intersect", "array_union", "array_except", "arrays_overlap",
+    "array_remove", "map_concat",
+    "map_filter", "transform_keys", "transform_values", "zip_with",
+    "reduce",
 })
 
 
@@ -150,6 +154,30 @@ def xxh64_signed(data: bytes) -> int:
     """xxhash64 wrapped into BIGINT's signed range (shared fold/LUT)."""
     h = _xxh64(data)
     return h - (1 << 64) if h >= (1 << 63) else h
+
+
+def _subst_lambda_vars(e, slot_to_index: dict):
+    """Replace THIS lambda's slot-numbered variables with ColumnRefs
+    into the lambda-evaluation page's appended virtual channels.  Slots
+    are binder-unique, so descending through nested LambdaExprs only
+    rewrites captures of the outer variables — the inner lambda's own
+    parameters (different slots) are left for its compile site."""
+    from presto_tpu.expr.ir import (
+        ColumnRef as _Ref, LambdaExpr as _LE, LambdaVar as _LV,
+    )
+
+    if isinstance(e, _LV):
+        if e.slot not in slot_to_index:
+            return e  # an inner lambda's own parameter
+        return _Ref(type=e.type, index=slot_to_index[e.slot], name=f"λ{e.slot}")
+    if isinstance(e, _LE):
+        return _LE(type=e.type, params=e.params,
+                   body=_subst_lambda_vars(e.body, slot_to_index))
+    if isinstance(e, Call):
+        return Call(type=e.type, fn=e.fn,
+                    args=tuple(_subst_lambda_vars(a, slot_to_index)
+                               for a in e.args))
+    return e
 
 
 def _levenshtein(a: str, b: str) -> int:
@@ -1498,6 +1526,51 @@ class ExprCompiler:
         if fn in ("array_transform", "array_filter", "any_match",
                   "all_match", "none_match"):
             return self._compile_array_lambda(expr, arg0, t0)
+        if fn in ("array_intersect", "array_union", "array_except"):
+            b_f = self.compile(expr.args[1])
+            tb = expr.args[1].type
+            kern = {"array_intersect": ct.array_intersect,
+                    "array_union": ct.array_union,
+                    "array_except": ct.array_except}[fn]
+
+            def run_setop(page):
+                (d, v), (bd, bv) = arg0(page), b_f(page)
+                return kern(d, t0, bd, tb, out_t), v & bv
+
+            return run_setop
+        if fn == "arrays_overlap":
+            b_f = self.compile(expr.args[1])
+            tb = expr.args[1].type
+
+            def run_overlap(page):
+                (d, v), (bd, bv) = arg0(page), b_f(page)
+                out, ov = ct.arrays_overlap(d, t0, bd, tb)
+                return out, v & bv & ov
+
+            return run_overlap
+        if fn == "array_remove":
+            x_f = self.compile(expr.args[1])
+
+            def run_remove(page):
+                (d, v), (xd, xv) = arg0(page), x_f(page)
+                return ct.array_remove(d, t0, xd), v & xv
+
+            return run_remove
+        if fn == "map_concat":
+            b_f = self.compile(expr.args[1])
+            tb = expr.args[1].type
+
+            def run_mconcat(page):
+                (d, v), (bd, bv) = arg0(page), b_f(page)
+                return ct.map_concat(d, t0, bd, tb, out_t), v & bv
+
+            return run_mconcat
+        if fn in ("map_filter", "transform_keys", "transform_values"):
+            return self._compile_map_lambda(expr, arg0, t0)
+        if fn == "zip_with":
+            return self._compile_zip_with(expr)
+        if fn == "reduce":
+            return self._compile_reduce(expr)
         if fn == "slice":
             start_e, len_e = expr.args[1], expr.args[2]
             if not (isinstance(start_e, Literal) and isinstance(len_e, Literal)):
@@ -1512,6 +1585,174 @@ class ExprCompiler:
             return run_slice
         raise KeyError(fn)
 
+    def _compile_map_lambda(self, expr: Call, m_f, t0: Type) -> CompiledExpr:
+        """Two-parameter lambdas over map entries (MapFilterFunction /
+        MapTransformKey/ValueFunction): both entry halves flatten into
+        TWO appended virtual channels and the body evaluates once over
+        the entry lanes — the array-lambda design with a (k, v) pair."""
+        from presto_tpu.ops import container as ct
+        from presto_tpu.page import Block as _Block, Page as _Page
+
+        fn = expr.fn
+        lam = expr.args[1]
+        body = lam.body
+        k_slot, v_slot = lam.params[0].slot, lam.params[1].slot
+        out_t = expr.type
+        M = t0.max_elems
+        kt, vt = t0.key_element, t0.element
+
+        def run(page):
+            d, v = m_f(page)
+            ks = ct.map_key_slots(d, t0)
+            vs = ct.map_value_slots(d, t0)
+            live = ct.slot_mask(d, M)
+            k_ok = live & ~ct.elem_null_mask(ks)
+            v_ok = live & ~ct.elem_null_mask(vs)
+            cap = page.capacity
+            rep_blocks = tuple(
+                _Block(jnp.repeat(b.data, M, axis=0), jnp.repeat(b.valid, M),
+                       b.type, b.dictionary)
+                for b in page.blocks)
+            lam_k = _Block(ks.reshape(cap * M).astype(kt.np_dtype),
+                           k_ok.reshape(cap * M), kt)
+            lam_v = _Block(vs.reshape(cap * M).astype(vt.np_dtype),
+                           v_ok.reshape(cap * M), vt)
+            epage = _Page(rep_blocks + (lam_k, lam_v),
+                          jnp.repeat(page.row_mask, M))
+            nb = len(page.blocks)
+            body2 = _subst_lambda_vars(body, {k_slot: nb, v_slot: nb + 1})
+            bd, bv = ExprCompiler.for_page(epage).compile(body2)(epage)
+            bd2 = bd.reshape(cap, M)
+            bv2 = bv.reshape(cap, M)
+            storage = out_t.np_dtype
+            sent = ct._null_const(storage)
+            n_live = ct.lengths(d)
+            if fn == "map_filter":
+                keep = live & bv2 & bd2.astype(jnp.bool_)
+                return ct.compact_entry_pairs(ks, vs, keep, M, storage), v
+            if fn == "transform_values":
+                newv = jnp.where(live & bv2, bd2.astype(storage), sent)
+                out = jnp.concatenate(
+                    [n_live[:, None].astype(storage),
+                     ks.astype(storage), newv], axis=1)
+                return out, v
+            # transform_keys: entries whose new key is NULL drop, and
+            # duplicate new keys keep the FIRST entry (deviations: the
+            # reference raises on both — deduping keeps device lookups
+            # and host decodes agreeing)
+            newk = bd2.astype(storage)
+            keep0 = live & bv2
+            eq = newk[:, :, None] == newk[:, None, :]
+            earlier = jnp.triu(jnp.ones((M, M), jnp.bool_), 1)  # [i, j] = i<j
+            dup = jnp.any(eq & keep0[:, :, None] & earlier[None], axis=1)
+            keep = keep0 & ~dup
+            return ct.compact_entry_pairs(newk, vs, keep, M, storage), v
+
+        return run
+
+    def _compile_zip_with(self, expr: Call) -> CompiledExpr:
+        """zip_with(a1, a2, (x, y) -> body): lanes align by index, the
+        shorter array's missing lanes bind NULL (ZipWithFunction), and
+        the body evaluates once over max-capacity flattened lanes."""
+        from presto_tpu.ops import container as ct
+        from presto_tpu.page import Block as _Block, Page as _Page
+
+        a1_f = self.compile(expr.args[0])
+        a2_f = self.compile(expr.args[1])
+        t1, t2 = expr.args[0].type, expr.args[1].type
+        lam = expr.args[2]
+        body = lam.body
+        x_slot, y_slot = lam.params[0].slot, lam.params[1].slot
+        out_t = expr.type
+        M = out_t.max_elems
+
+        def pad_slots(slots, m):
+            if m >= M:
+                return slots[:, :M]
+            pad = jnp.full((slots.shape[0], M - m),
+                           ct._null_const(slots.dtype), slots.dtype)
+            return jnp.concatenate([slots, pad], axis=1)
+
+        def run(page):
+            (d1, v1), (d2, v2) = a1_f(page), a2_f(page)
+            s1 = pad_slots(ct.elem_slots(d1, t1), t1.max_elems)
+            s2 = pad_slots(ct.elem_slots(d2, t2), t2.max_elems)
+            l1, l2 = ct.lengths(d1), ct.lengths(d2)
+            j = jnp.arange(M)[None, :]
+            x_ok = (j < l1[:, None]) & ~ct.elem_null_mask(s1)
+            y_ok = (j < l2[:, None]) & ~ct.elem_null_mask(s2)
+            lout = jnp.maximum(l1, l2)
+            live = j < lout[:, None]
+            cap = page.capacity
+            rep_blocks = tuple(
+                _Block(jnp.repeat(b.data, M, axis=0), jnp.repeat(b.valid, M),
+                       b.type, b.dictionary)
+                for b in page.blocks)
+            lam_x = _Block(s1.reshape(cap * M).astype(t1.element.np_dtype),
+                           x_ok.reshape(cap * M), t1.element)
+            lam_y = _Block(s2.reshape(cap * M).astype(t2.element.np_dtype),
+                           y_ok.reshape(cap * M), t2.element)
+            epage = _Page(rep_blocks + (lam_x, lam_y),
+                          jnp.repeat(page.row_mask, M))
+            nb = len(page.blocks)
+            body2 = _subst_lambda_vars(body, {x_slot: nb, y_slot: nb + 1})
+            bd, bv = ExprCompiler.for_page(epage).compile(body2)(epage)
+            storage = out_t.np_dtype
+            sent = ct._null_const(storage)
+            vals = jnp.where(live & bv.reshape(cap, M),
+                             bd.reshape(cap, M).astype(storage), sent)
+            out = jnp.concatenate(
+                [lout[:, None].astype(storage), vals], axis=1)
+            return out, v1 & v2
+
+        return run
+
+    def _compile_reduce(self, expr: Call) -> CompiledExpr:
+        """reduce(arr, init, (s, x) -> comb, s -> out): the combiner
+        unrolls over the static slot capacity — M body evaluations over
+        full columns, XLA-fused; NULL elements bind as NULL
+        (ReduceFunction)."""
+        from presto_tpu.ops import container as ct
+        from presto_tpu.page import Block as _Block, Page as _Page
+
+        arr_f = self.compile(expr.args[0])
+        init_f = self.compile(expr.args[1])
+        t0 = expr.args[0].type
+        st = expr.args[1].type
+        comb_lam, out_lam = expr.args[2], expr.args[3]
+        comb, out_body = comb_lam.body, out_lam.body
+        s_slot, x_slot = comb_lam.params[0].slot, comb_lam.params[1].slot
+        o_slot = out_lam.params[0].slot
+        out_t = expr.type
+        M = t0.max_elems
+
+        def run(page):
+            d, v = arr_f(page)
+            sd, sv = init_f(page)
+            sd = jnp.broadcast_to(sd, (page.capacity,)).astype(st.np_dtype)
+            sv = jnp.broadcast_to(sv, (page.capacity,))
+            slots = ct.elem_slots(d, t0)
+            live = ct.slot_mask(d, M)
+            nulls = ct.elem_null_mask(slots)
+            nb = len(page.blocks)
+            for i in range(M):
+                elem = _Block(slots[:, i].astype(t0.element.np_dtype),
+                              live[:, i] & ~nulls[:, i], t0.element)
+                state = _Block(sd, sv, st)
+                epage = _Page(page.blocks + (state, elem), page.row_mask)
+                body2 = _subst_lambda_vars(comb, {s_slot: nb, x_slot: nb + 1})
+                bd, bv = ExprCompiler.for_page(epage).compile(body2)(epage)
+                has = live[:, i]
+                sd = jnp.where(has, bd.astype(st.np_dtype), sd)
+                sv = jnp.where(has, bv, sv)
+            state = _Block(sd, sv, st)
+            epage = _Page(page.blocks + (state,), page.row_mask)
+            body3 = _subst_lambda_vars(out_body, {o_slot: nb})
+            od, ov = ExprCompiler.for_page(epage).compile(body3)(epage)
+            return od.astype(out_t.np_dtype), v & ov
+
+        return run
+
     def _compile_array_lambda(self, expr: Call, arr_f, t0: Type) -> CompiledExpr:
         """Lambda functions over arrays (LambdaBytecodeGenerator +
         ArrayTransformFunction/ArrayFilterFunction analogs): the body
@@ -1524,20 +1765,14 @@ class ExprCompiler:
         from presto_tpu.page import Block as _Block, Page as _Page
 
         fn = expr.fn
-        body = expr.args[1]
+        lam = expr.args[1]
+        body, lam_slot = lam.body, lam.params[0].slot
         out_t = expr.type
         M = t0.max_elems
         elem_t = t0.element
 
         def substitute(e, var_index):
-            if isinstance(e, LambdaVar):
-                from presto_tpu.expr.ir import ColumnRef as _Ref
-
-                return _Ref(type=e.type, index=var_index, name="λ")
-            if isinstance(e, Call):
-                return Call(type=e.type, fn=e.fn,
-                            args=tuple(substitute(a, var_index) for a in e.args))
-            return e
+            return _subst_lambda_vars(e, {lam_slot: var_index})
 
         def run(page):
             d, v = arr_f(page)

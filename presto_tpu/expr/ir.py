@@ -64,14 +64,33 @@ class Call(Expr):
 
 @dataclasses.dataclass(frozen=True)
 class LambdaVar(Expr):
-    """The bound variable of an array-lambda body
+    """A bound variable of a lambda body
     (VariableReferenceExpression inside LambdaDefinitionExpression) —
-    only meaningful inside array_transform/array_filter/..._match
-    second arguments, where the compiler binds it to the flattened
-    element lanes."""
+    only meaningful inside lambda-taking function arguments, where the
+    compiler binds it to flattened element lanes.  ``slot`` identifies
+    the parameter position for multi-parameter lambdas ((k, v) ->,
+    (state, x) ->)."""
+
+    slot: int = 0
 
     def __repr__(self):
-        return f"λx:{self.type}"
+        return f"λ{self.slot}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class LambdaExpr(Expr):
+    """A lambda argument of a lambda-taking function call
+    (LambdaDefinitionExpression): ``params`` are this lambda's OWN
+    slot-numbered variables, ``body`` the expression over them.  Slots
+    are binder-unique across a statement, so substituting an outer
+    lambda's variables descends through inner lambda bodies without
+    capturing the inner parameters.  ``type`` is the body's type."""
+
+    params: Tuple[LambdaVar, ...] = ()
+    body: Optional[Expr] = None
+
+    def __repr__(self):
+        return f"({', '.join(map(repr, self.params))}) -> {self.body!r}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -231,6 +250,24 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         from presto_tpu.types import VarbinaryType
 
         return VarbinaryType(64)
+    if fn in ("array_intersect", "array_except", "array_remove"):
+        return ts[0]  # bounded by the left array's capacity
+    if fn == "array_union":
+        from presto_tpu.types import ArrayType
+
+        return ArrayType(common_super_type(ts[0].element, ts[1].element),
+                         min(64, ts[0].max_elems + ts[1].max_elems))
+    if fn == "arrays_overlap":
+        return BOOLEAN
+    if fn == "map_concat":
+        from presto_tpu.types import MapType
+
+        cap = min(64, sum(t.max_elems for t in ts))
+        kt, vt = ts[0].key_element, ts[0].element
+        for t in ts[1:]:
+            kt = common_super_type(kt, t.key_element)
+            vt = common_super_type(vt, t.element)
+        return MapType(kt, vt, cap)
     if fn in ("regexp_like", "starts_with", "ends_with", "contains_str",
               "is_json_scalar"):
         return BOOLEAN

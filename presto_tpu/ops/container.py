@@ -509,3 +509,145 @@ def construct_map(keys: jax.Array, key_t: Type, values: jax.Array,
     k = jnp.where(live, k, sent)
     v = jnp.where(live, v, sent)
     return jnp.concatenate([n[:, None].astype(storage), k, v], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# set algebra (ArrayIntersect/Union/Except/ArraysOverlap/ArrayRemove,
+# MapConcatFunction) — membership is one (rows, Ma, Mb) broadcast
+# compare; compaction is the array_filter argsort pattern.  No scalar
+# loops; shapes stay static for XLA.
+# ---------------------------------------------------------------------------
+
+def _row_compact(slots, keep, cap_out, storage):
+    """Order-preserving per-row compaction of kept slots into a
+    [len, vals..] array matrix with cap_out value lanes."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    comp = jnp.take_along_axis(slots, order, axis=1).astype(storage)
+    n = jnp.sum(keep.astype(jnp.int64), axis=1)
+    M = slots.shape[1]
+    sent = _null_const(storage)
+    if cap_out > M:
+        comp = jnp.concatenate(
+            [comp, jnp.full((comp.shape[0], cap_out - M), sent, storage)],
+            axis=1)
+    elif cap_out < M:
+        comp = comp[:, :cap_out]
+        n = jnp.minimum(n, cap_out)
+    j = jnp.arange(cap_out)[None, :]
+    vals = jnp.where(j < n[:, None], comp, sent)
+    return jnp.concatenate([n[:, None].astype(storage), vals], axis=1)
+
+
+def _membership(a, ta, b, tb):
+    """Per-slot masks for the set ops: a's slots (ORIGINAL storage, for
+    compaction into the left-typed output), live/null masks, whether
+    each a-slot's value appears among b's non-null live slots, and
+    whether b holds any null element.  Values compare in the common
+    super type via coerce_slots (decimal rescaling included) — a raw
+    astype would truncate 2.5 to 2 and call it a match."""
+    from presto_tpu.types import common_super_type
+
+    sa = elem_slots(a, ta)
+    sb = elem_slots(b, tb)
+    la, na = slot_mask(a, ta.max_elems), elem_null_mask(sa)
+    lb, nb = slot_mask(b, tb.max_elems), elem_null_mask(sb)
+    cmp_t = common_super_type(ta.element, tb.element)
+    sa_c = coerce_slots(sa, ta.element, cmp_t, cmp_t.np_dtype)
+    sb_c = coerce_slots(sb, tb.element, cmp_t, cmp_t.np_dtype)
+    b_live_nn = lb & ~nb
+    member = jnp.any(
+        (sa_c[:, :, None] == sb_c[:, None, :]) & b_live_nn[:, None, :],
+        axis=2)
+    b_has_null = jnp.any(lb & nb, axis=1)
+    return sa, la, na, member, b_has_null
+
+
+def array_intersect(a: jax.Array, ta: Type, b: jax.Array, tb: Type,
+                    out_t: Type) -> jax.Array:
+    """Deduplicated intersection; NULL intersects when both sides hold
+    a NULL element (sorted output order — the array_distinct
+    deviation)."""
+    storage = out_t.np_dtype
+    sa, la, na, member, b_null = _membership(a, ta, b, tb)
+    keep = la & ((~na & member) | (na & b_null[:, None]))
+    return array_distinct(_row_compact(sa, keep, out_t.max_elems, storage),
+                          out_t)
+
+
+def array_except(a: jax.Array, ta: Type, b: jax.Array, tb: Type,
+                 out_t: Type) -> jax.Array:
+    storage = out_t.np_dtype
+    sa, la, na, member, b_null = _membership(a, ta, b, tb)
+    keep = la & ((~na & ~member) | (na & ~b_null[:, None]))
+    return array_distinct(_row_compact(sa, keep, out_t.max_elems, storage),
+                          out_t)
+
+
+def array_union(a: jax.Array, ta: Type, b: jax.Array, tb: Type,
+                out_t: Type) -> jax.Array:
+    return array_distinct(concat_arrays(a, ta, b, tb, out_t), out_t)
+
+
+def arrays_overlap(a: jax.Array, ta: Type, b: jax.Array, tb: Type):
+    """(bool, valid): TRUE on a shared non-null element; NULL when no
+    match but either side holds a NULL element (ANSI three-valued)."""
+    sa, la, na, member, b_null = _membership(a, ta, b, tb)
+    match = jnp.any(la & ~na & member, axis=1)
+    a_null = jnp.any(la & na, axis=1)
+    return match, match | ~(a_null | b_null)
+
+
+def array_remove(a: jax.Array, ta: Type, x: jax.Array) -> jax.Array:
+    """Drop elements equal to x; NULL elements stay
+    (ArrayRemoveFunction — a NULL x nulls the result, handled by the
+    caller's validity)."""
+    storage = ta.np_dtype
+    sa = elem_slots(a, ta)
+    la, na = slot_mask(a, ta.max_elems), elem_null_mask(sa)
+    keep = la & (na | (sa != x.astype(storage)[:, None]))
+    return _row_compact(sa, keep, ta.max_elems, storage)
+
+
+def map_concat(m1: jax.Array, t1: Type, m2: jax.Array, t2: Type,
+               out_t: Type) -> jax.Array:
+    """Key union with the LAST map's value winning on duplicates
+    (MapConcatFunction) — m1 entries shadowed by an m2 key DROP, so
+    device lookups, host decodes and the reference all agree."""
+    storage = out_t.np_dtype
+    cap = out_t.max_elems
+    k1 = coerce_slots(map_key_slots(m1, t1), t1.key_element,
+                      out_t.key_element, storage)
+    k2 = coerce_slots(map_key_slots(m2, t2), t2.key_element,
+                      out_t.key_element, storage)
+    v1 = coerce_slots(map_value_slots(m1, t1), t1.element,
+                      out_t.element, storage)
+    v2 = coerce_slots(map_value_slots(m2, t2), t2.element,
+                      out_t.element, storage)
+    live1 = slot_mask(m1, t1.max_elems)
+    live2 = slot_mask(m2, t2.max_elems)
+    shadowed = jnp.any(
+        (k1[:, :, None] == k2[:, None, :]) & live2[:, None, :], axis=2)
+    k = jnp.concatenate([k1, k2], axis=1)
+    v = jnp.concatenate([v1, v2], axis=1)
+    keep = jnp.concatenate([live1 & ~shadowed, live2], axis=1)
+    return compact_entry_pairs(k, v, keep, cap, storage)
+
+
+def compact_entry_pairs(ks: jax.Array, vs: jax.Array, keep: jax.Array,
+                        cap: int, storage) -> jax.Array:
+    """Order-preserving compaction of kept (key, value) entry pairs
+    into a [len, keys.., vals..] map matrix with cap entry lanes —
+    shared by map_filter / transform_keys / map_concat."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    kc = jnp.take_along_axis(ks, order, axis=1).astype(storage)
+    vc = jnp.take_along_axis(vs, order, axis=1).astype(storage)
+    n = jnp.minimum(jnp.sum(keep.astype(jnp.int64), axis=1), cap)
+    sent = _null_const(storage)
+    j = jnp.arange(kc.shape[1])[None, :]
+    kc = jnp.where(j < n[:, None], kc, sent)[:, :cap]
+    vc = jnp.where(j < n[:, None], vc, sent)[:, :cap]
+    if cap > kc.shape[1]:
+        pad = jnp.full((kc.shape[0], cap - kc.shape[1]), sent, storage)
+        kc = jnp.concatenate([kc, pad], axis=1)
+        vc = jnp.concatenate([vc, pad], axis=1)
+    return jnp.concatenate([n[:, None].astype(storage), kc, vc], axis=1)

@@ -61,6 +61,11 @@ def _subst(e: Expr, inputs: List[Expr]) -> Expr:
     if isinstance(e, Call):
         return Call(type=e.type, fn=e.fn,
                     args=tuple(_subst(a, inputs) for a in e.args))
+    from presto_tpu.expr.ir import LambdaExpr
+
+    if isinstance(e, LambdaExpr):
+        return LambdaExpr(type=e.type, params=e.params,
+                          body=_subst(e.body, inputs))
     return e
 
 
@@ -246,6 +251,10 @@ def _expr_refs(e: Expr) -> List[int]:
         return [e.index]
     if isinstance(e, Call):
         return [r for a in e.args for r in _expr_refs(a)]
+    from presto_tpu.expr.ir import LambdaExpr
+
+    if isinstance(e, LambdaExpr):
+        return _expr_refs(e.body)
     return []
 
 
@@ -415,6 +424,10 @@ def _deterministic(e: Expr) -> bool:
     if isinstance(e, Call):
         return e.fn not in _NONDETERMINISTIC and all(
             _deterministic(a) for a in e.args)
+    from presto_tpu.expr.ir import LambdaExpr
+
+    if isinstance(e, LambdaExpr):
+        return _deterministic(e.body)
     return True
 
 

@@ -192,11 +192,18 @@ class Describe(Node):
 
 @dataclasses.dataclass(frozen=True)
 class Lambda(Node):
-    """param -> body (sql/tree/LambdaExpression.java; single-parameter
-    subset — the array function surface)."""
+    """param -> body / (p1, p2, ...) -> body
+    (sql/tree/LambdaExpression.java).  ``params`` is the canonical
+    parameter tuple; ``param`` mirrors params[0] for the single-
+    parameter array-function surface."""
 
     param: str = ""
     body: Node = None
+    params: tuple = ()
+
+    @property
+    def all_params(self) -> tuple:
+        return self.params if self.params else (self.param,)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -133,6 +133,11 @@ SCALAR_FUNCTIONS = {
     "array_min", "array_max", "array_sum", "array_average",
     "array_sort", "array_distinct", "map_keys", "map_values", "map",
     "sequence", "slice", "repeat",
+    # ARRAY set algebra + map concat (ArrayIntersectFunction,
+    # ArrayUnionFunction, ArrayExceptFunction, ArraysOverlapFunction,
+    # ArrayRemoveFunction, MapConcatFunction)
+    "array_intersect", "array_union", "array_except", "arrays_overlap",
+    "array_remove", "map_concat",
 }
 
 
@@ -248,6 +253,10 @@ def expr_refs(e: Expr) -> List[int]:
         return [e.index]
     if isinstance(e, Call):
         return [r for a in e.args for r in expr_refs(a)]
+    from presto_tpu.expr.ir import LambdaExpr
+
+    if isinstance(e, LambdaExpr):
+        return expr_refs(e.body)  # captured outer-channel references
     return []
 
 
@@ -256,6 +265,11 @@ def remap_expr(e: Expr, mapping: Dict[int, int]) -> Expr:
         return ColumnRef(type=e.type, index=mapping[e.index], name=e.name)
     if isinstance(e, Call):
         return Call(type=e.type, fn=e.fn, args=tuple(remap_expr(a, mapping) for a in e.args))
+    from presto_tpu.expr.ir import LambdaExpr
+
+    if isinstance(e, LambdaExpr):
+        return LambdaExpr(type=e.type, params=e.params,
+                          body=remap_expr(e.body, mapping))
     return e
 
 
@@ -476,6 +490,8 @@ class Binder:
         self._now: Optional[float] = None
         # lambda parameter scopes (innermost last): name -> LambdaVar
         self._lambda_params: List[Dict[str, object]] = []
+        # statement-unique LambdaVar slots: shadowing-safe nesting
+        self._lambda_slot_seq = iter(range(1 << 30))
         # CBO stats (cost/StatsCalculator.java analog); memo is safe to
         # share across plan() calls since plan nodes are identity-keyed
         from presto_tpu.planner.stats import StatsCalculator
@@ -2716,6 +2732,17 @@ class Binder:
                           "none_match") and len(e.args) == 2 \
                     and isinstance(e.args[1], ast.Lambda):
                 return self._bind_array_lambda(e, scope, agg)
+            if (e.name in ("map_filter", "transform_keys",
+                           "transform_values") and len(e.args) == 2) \
+                    or (e.name == "zip_with" and len(e.args) == 3) \
+                    or (e.name == "reduce" and len(e.args) == 4):
+                return self._bind_container_lambda(e, scope, agg)
+            if e.name == "map_concat" and len(e.args) > 2:
+                # variadic: left-fold into binary concats
+                folded = ast.FuncCall("map_concat", e.args[:2])
+                for extra in e.args[2:]:
+                    folded = ast.FuncCall("map_concat", (folded, extra))
+                return self._bind_impl(folded, scope, agg)
             if e.name == "typeof":
                 if len(e.args) != 1:
                     raise BindError("typeof takes one argument")
@@ -2793,6 +2820,14 @@ class Binder:
                     raise BindError(f"aggregate {e.name} in scalar context")
                 return self._bind_agg_call(e, scope, agg)
             if e.name in SCALAR_FUNCTIONS:
+                _arity = {"array_intersect": 2, "array_union": 2,
+                          "array_except": 2, "arrays_overlap": 2,
+                          "array_remove": 2}.get(e.name)
+                if _arity is not None and len(e.args) != _arity:
+                    raise BindError(
+                        f"{e.name} takes {_arity} arguments")
+                if e.name == "map_concat" and len(e.args) < 2:
+                    raise BindError("map_concat takes at least two maps")
                 args = [self._bind_impl(a, scope, agg) for a in e.args]
                 folded = self._fold_literal_call(e.name, args)
                 if folded is not None:
@@ -2874,25 +2909,112 @@ class Binder:
         if not arr.type.is_array:
             raise BindError(f"{e.name} expects an ARRAY first argument")
         lam: ast.Lambda = e.args[1]
-        var = LambdaVar(type=arr.type.element)
-        body = self._bind_lambda_body(lam.body, lam.param, var, scope, agg)
+        var = LambdaVar(type=arr.type.element,
+                        slot=next(self._lambda_slot_seq))
+        body = self._bind_lambda_body(lam.body, {lam.param: var}, scope, agg)
         fn = {"transform": "array_transform", "filter": "array_filter"}.get(
             e.name, e.name)
         if fn == "array_filter" or fn.endswith("_match"):
             if body.type.name != "boolean":
                 raise BindError(f"{e.name} lambda must return boolean")
-        return call(fn, arr, body)
+        from presto_tpu.expr.ir import LambdaExpr
 
-    def _bind_lambda_body(self, body: ast.Node, param: str, var,
+        return call(fn, arr, LambdaExpr(type=body.type, params=(var,),
+                                        body=body))
+
+    def _bind_lambda_body(self, body: ast.Node, params: dict,
                           scope: Scope, agg) -> Expr:
-        """Bind with ``param`` shadowing outer columns (and exempt from
-        group-key checks inside aggregate contexts): a scoped parameter
-        frame is consulted before identifier resolution."""
-        self._lambda_params.append({param: var})
+        """Bind with the lambda parameters shadowing outer columns (and
+        exempt from group-key checks inside aggregate contexts): a
+        scoped parameter frame is consulted before identifier
+        resolution.  ``params`` maps name -> LambdaVar."""
+        self._lambda_params.append(dict(params))
         try:
             return self._bind_impl(body, scope, agg)
         finally:
             self._lambda_params.pop()
+
+    def _bind_container_lambda(self, e: ast.FuncCall, scope: Scope,
+                               agg) -> Expr:
+        """map_filter / transform_keys / transform_values / zip_with /
+        reduce — the multi-parameter lambda surface
+        (MapFilterFunction.java, MapTransformKeyFunction.java,
+        MapTransformValueFunction.java, ZipWithFunction.java,
+        ReduceFunction.java).  Lambda parameters become slot-numbered
+        LambdaVars bound to flattened entry lanes by the compiler."""
+        from presto_tpu.expr.ir import LambdaExpr, LambdaVar
+        from presto_tpu.types import ArrayType, MapType
+
+        name = e.name
+
+        def lam_of(a, n_params):
+            if not isinstance(a, ast.Lambda) or len(a.all_params) != n_params:
+                raise BindError(
+                    f"{name} expects a {n_params}-parameter lambda")
+            return a
+
+        def new_var(t):
+            return LambdaVar(type=t, slot=next(self._lambda_slot_seq))
+
+        if name in ("map_filter", "transform_keys", "transform_values"):
+            m = self._bind_impl(e.args[0], scope, agg)
+            if not m.type.is_map or m.type.name != "map" or (
+                    m.type.element is not None and m.type.element.is_array):
+                raise BindError(f"{name} expects a scalar-valued map")
+            lam = lam_of(e.args[1], 2)
+            kv, vv = new_var(m.type.key_element), new_var(m.type.element)
+            body = self._bind_lambda_body(
+                lam.body, {lam.all_params[0]: kv, lam.all_params[1]: vv},
+                scope, agg)
+            if name == "map_filter":
+                if body.type.name != "boolean":
+                    raise BindError("map_filter lambda must return boolean")
+                out_t = m.type
+            elif name == "transform_keys":
+                out_t = MapType(body.type, m.type.element, m.type.max_elems)
+            else:
+                out_t = MapType(m.type.key_element, body.type,
+                                m.type.max_elems)
+            le = LambdaExpr(type=body.type, params=(kv, vv), body=body)
+            return Call(type=out_t, fn=name, args=(m, le))
+        if name == "zip_with":
+            a1 = self._bind_impl(e.args[0], scope, agg)
+            a2 = self._bind_impl(e.args[1], scope, agg)
+            if not (a1.type.is_array and a2.type.is_array):
+                raise BindError("zip_with expects two arrays")
+            lam = lam_of(e.args[2], 2)
+            xv, yv = new_var(a1.type.element), new_var(a2.type.element)
+            body = self._bind_lambda_body(
+                lam.body, {lam.all_params[0]: xv, lam.all_params[1]: yv},
+                scope, agg)
+            out_t = ArrayType(body.type,
+                              max(a1.type.max_elems, a2.type.max_elems))
+            le = LambdaExpr(type=body.type, params=(xv, yv), body=body)
+            return Call(type=out_t, fn=name, args=(a1, a2, le))
+        # reduce(arr, init, (s, x) -> comb, s -> out)
+        arr = self._bind_impl(e.args[0], scope, agg)
+        if not arr.type.is_array:
+            raise BindError("reduce expects an array first argument")
+        init = self._bind_impl(e.args[1], scope, agg)
+        comb_l = lam_of(e.args[2], 2)
+        sv = new_var(init.type)
+        xv = new_var(arr.type.element)
+        comb = self._bind_lambda_body(
+            comb_l.body, {comb_l.all_params[0]: sv, comb_l.all_params[1]: xv},
+            scope, agg)
+        if comb.type != init.type:
+            raise BindError(
+                f"reduce combiner returns {comb.type}, state is {init.type}")
+        out_l = lam_of(e.args[3], 1)
+        sv2 = new_var(init.type)
+        out_body = self._bind_lambda_body(
+            out_l.body, {out_l.all_params[0]: sv2}, scope, agg)
+        return Call(
+            type=out_body.type, fn="reduce",
+            args=(arr, init,
+                  LambdaExpr(type=comb.type, params=(sv, xv), body=comb),
+                  LambdaExpr(type=out_body.type, params=(sv2,),
+                             body=out_body)))
 
     def _bind_grouping(self, e: ast.FuncCall, scope: Scope, agg: AggCtx) -> Expr:
         """grouping(a, b, ...) -> bitmask int: bit j (MSB-first) is 1
@@ -3122,6 +3244,11 @@ class Binder:
                 type=e.type, fn=e.fn,
                 args=tuple(self._patch_windows(a, mapping) for a in e.args),
             )
+        from presto_tpu.expr.ir import LambdaExpr
+
+        if isinstance(e, LambdaExpr):
+            return LambdaExpr(type=e.type, params=e.params,
+                              body=self._patch_windows(e.body, mapping))
         return e
 
     def _bind_agg_call(self, e: ast.FuncCall, scope: Scope, agg: AggCtx) -> ColumnRef:

@@ -474,13 +474,41 @@ class Parser:
 
     # -- expressions (precedence ladder) ------------------------------------
     def _expr(self) -> ast.Node:
-        # lambda: ident -> body (valid only as a function argument;
-        # the binder rejects stray lambdas)
+        # lambda: ident -> body | (a, b, ...) -> body (valid only as a
+        # function argument; the binder rejects stray lambdas)
         if self.tok.kind == "ident" and self.peek2("->"):
             param = self.ident()
             self.i += 1  # '->'
-            return ast.Lambda(param, self._expr())
+            return ast.Lambda(param, self._expr(), (param,))
+        if self.tok.kind == "op" and self.tok.value == "(":
+            params = self._try_lambda_params()
+            if params is not None:
+                return ast.Lambda(params[0], self._expr(), params)
         return self._or()
+
+    def _try_lambda_params(self):
+        """Lookahead for '(' ident (',' ident)* ')' '->'; consumes the
+        tokens (including '->') and returns the parameter tuple only
+        when the full pattern matches — else leaves the position
+        untouched (a parenthesized expression)."""
+        j = self.i + 1
+        params = []
+        toks = self.tokens
+        while True:
+            if j >= len(toks) or toks[j].kind != "ident":
+                return None  # covers '()' and trailing-comma forms
+            params.append(toks[j].value)
+            j += 1
+            if j < len(toks) and toks[j].kind == "op" and toks[j].value == ",":
+                j += 1
+                continue
+            break
+        if (j + 1 < len(toks)
+                and toks[j].kind == "op" and toks[j].value == ")"
+                and toks[j + 1].kind == "op" and toks[j + 1].value == "->"):
+            self.i = j + 2
+            return tuple(params)
+        return None
 
     def _or(self) -> ast.Node:
         e = self._and()

@@ -295,3 +295,162 @@ def test_histogram():
     assert all(sum(h.values()) == 5 for _, h in rows)
     assert r.execute("SELECT cardinality(histogram(n_regionkey)) FROM nation"
                      ).rows == [(5,)]
+
+
+# ---------------------------------------------------------------------------
+# round-4b: multi-parameter lambdas + array set algebra
+# (MapFilterFunction, MapTransformKey/ValueFunction, ZipWithFunction,
+# ReduceFunction, ArrayIntersect/Union/Except/RemoveFunction,
+# ArraysOverlapFunction, MapConcatFunction)
+# ---------------------------------------------------------------------------
+
+def test_map_filter_and_transforms(runner):
+    assert runner.execute(
+        "select map_filter(map(array[1,2,3], array[10,20,30]), "
+        "(k, v) -> v > 15 and k < 3)").rows == [({2: 20},)]
+    assert runner.execute(
+        "select transform_values(map(array[1,2], array[10,20]), "
+        "(k, v) -> k + v)").rows == [({1: 11, 2: 22},)]
+    assert runner.execute(
+        "select transform_keys(map(array[1,2], array[10,20]), "
+        "(k, v) -> k * 100)").rows == [({100: 10, 200: 20},)]
+    # empty result map is a map, not NULL
+    assert runner.execute(
+        "select map_filter(map(array[1], array[10]), (k, v) -> v < 0)"
+    ).rows == [({},)]
+
+
+def test_map_lambda_over_column(runner):
+    rows = runner.execute(
+        "select g, map_filter(m, (k, v) -> v >= 2) from (select g, "
+        "map_agg(k, v) m from (values (1,1,1),(1,2,2),(2,3,3)) t(g,k,v)"
+        " group by g) order by g").rows
+    assert rows == [(1, {2: 2}), (2, {3: 3})]
+
+
+def test_zip_with(runner):
+    assert runner.execute(
+        "select zip_with(array[1,2,3], array[10,20,30], (x, y) -> x + y)"
+    ).rows == [([11, 22, 33],)]
+    # shorter side binds NULL for its missing lanes
+    assert runner.execute(
+        "select zip_with(array[1,2], array[10], "
+        "(x, y) -> coalesce(y, 0) + x)").rows == [([11, 2],)]
+    assert runner.execute(
+        "select zip_with(array[1,2], array[3,4], (x, y) -> x * y)"
+    ).rows == [([3, 8],)]
+
+
+def test_reduce(runner):
+    assert runner.execute(
+        "select reduce(array[5,20,50], 0, (s, x) -> s + x, s -> s)"
+    ).rows == [(75,)]
+    assert runner.execute(
+        "select reduce(array[5,20,50], cast(1 as double), "
+        "(s, x) -> s * x, s -> s / 2)").rows == [(2500.0,)]
+    # NULL elements reach the lambda as NULL
+    assert runner.execute(
+        "select reduce(array[1, null, 3], 0, "
+        "(s, x) -> s + coalesce(x, 100), s -> s)").rows == [(104,)]
+    # outer-column capture inside the combiner (id is the init state)
+    rows = runner.execute(
+        "select id, reduce(array[1, 2], id, (s, x) -> s + x, s -> s) "
+        "from t order by 1").rows
+    assert rows == [(1, 4), (2, 5), (3, 6), (4, 7)]
+
+
+def test_array_set_algebra(runner):
+    assert runner.execute(
+        "select array_intersect(array[1,2,2,3], array[2,3,4])"
+    ).rows == [([2, 3],)]
+    assert runner.execute(
+        "select array_union(array[1,2], array[2,3])").rows == [([1, 2, 3],)]
+    assert runner.execute(
+        "select array_except(array[1,2,3], array[2])").rows == [([1, 3],)]
+    assert runner.execute(
+        "select array_remove(array[1,2,1,3], 1)").rows == [([2, 3],)]
+    assert runner.execute(
+        "select arrays_overlap(array[1,2], array[2,3]), "
+        "arrays_overlap(array[1], array[3])").rows == [(True, False)]
+    # three-valued: no match + a NULL element on either side -> NULL
+    assert runner.execute(
+        "select arrays_overlap(array[1, null], array[3])"
+    ).rows == [(None,)]
+    assert runner.execute(
+        "select arrays_overlap(array[1, null], array[1])").rows == [(True,)]
+
+
+def test_map_concat_last_wins(runner):
+    assert runner.execute(
+        "select map_concat(map(array[1], array[10]), "
+        "map(array[1,2], array[99,20]))").rows == [({1: 99, 2: 20},)]
+    # variadic left-fold
+    assert runner.execute(
+        "select map_concat(map(array[1], array[1]), "
+        "map(array[2], array[2]), map(array[1], array[7]))"
+    ).rows == [({1: 7, 2: 2},)]
+    # device lookup agrees with the decode (the dedupe guarantees it)
+    assert runner.execute(
+        "select map_concat(map(array[1], array[10]), "
+        "map(array[1], array[99]))[1]").rows == [(99,)]
+
+
+def test_parenthesized_single_param_lambda(runner):
+    assert runner.execute(
+        "select transform(array[1,2], (x) -> x + 1)").rows == [([2, 3],)]
+
+
+def test_nested_lambdas_scope_correctly(runner):
+    """Inner lambda parameters must not capture the outer lambda's
+    substitution (code-review regression: slot-unique LambdaVars)."""
+    assert runner.execute(
+        "select transform(array[1,2], x -> "
+        "array_sum(transform(array[10], y -> y + x)))"
+    ).rows == [([11, 12],)]
+
+
+def test_set_algebra_mixed_types_compare_exactly(runner):
+    # 2 (bigint) must NOT match 2.5 (double truncation regression)
+    assert runner.execute(
+        "select array_intersect(a, b), array_except(a, b), "
+        "arrays_overlap(a, b) from (select array[1,2] a, "
+        "array[2.5, 3.5] b) t").rows == [([], [1, 2], False)]
+    assert runner.execute(
+        "select array_intersect(a, b) from (select array[1,2] a, "
+        "array[2.0, 3.5] b) t").rows == [([2],)]
+
+
+def test_map_concat_mixed_value_types(runner):
+    (m,) = runner.execute(
+        "select map_concat(map(array[1], array[10]), "
+        "map(array[2], array[2.5]))").rows[0]
+    assert m[1] == 10.0 and m[2] == 2.5
+
+
+def test_transform_keys_dedupes_first_wins(runner):
+    got = runner.execute(
+        "select transform_keys(map(array[1,2], array[10,20]), "
+        "(k, v) -> 0)").rows
+    assert got == [({0: 10},)]
+    assert runner.execute(
+        "select transform_keys(map(array[1,2], array[10,20]), "
+        "(k, v) -> 0)[0]").rows == [(10,)]
+
+
+def test_container_arity_bind_errors(runner):
+    import pytest as _pytest
+
+    for sql in ("select map_concat(map(array[1], array[10]))",
+                "select array_intersect(array[1])",
+                "select arrays_overlap(array[1])"):
+        with _pytest.raises(Exception) as ei:
+            runner.execute(sql)
+        assert "argument" in str(ei.value) or "takes" in str(ei.value), sql
+
+
+def test_trailing_comma_lambda_rejected(runner):
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        runner.execute(
+            "select zip_with(array[1], array[2], (x, y,) -> x + y)")
