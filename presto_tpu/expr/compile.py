@@ -125,6 +125,30 @@ def _mysql_to_strftime(fmt: str, for_parse: bool = False) -> str:
     return "".join(out)
 
 
+_INT_RX = re.compile(r"^[+-]?\d+$")
+_FLOAT_RX = re.compile(r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$")
+_I64_LO, _I64_HI = -(1 << 63), (1 << 63) - 1
+
+
+def parse_number_strict(v, to_double: bool):
+    """varchar -> number with the reference's accepted syntax only (no
+    python extras like '1_0' or padding) and int64 range enforcement;
+    None for anything else (shared by the bind-time literal fold and
+    the column dictionary LUT so they cannot diverge)."""
+    if not isinstance(v, str):
+        return None
+    if to_double:
+        if _FLOAT_RX.match(v):
+            return float(v)
+        if v in ("Infinity", "-Infinity", "+Infinity", "NaN"):
+            return float(v.replace("Infinity", "inf"))
+        return None
+    if not _INT_RX.match(v):
+        return None
+    n = int(v)
+    return n if _I64_LO <= n <= _I64_HI else None
+
+
 def mysql_datetime_micros(v: str, fmt: str):
     """date_parse's conversion, shared by the bind-time literal fold
     and the column LUT so they cannot diverge.  None on parse failure
@@ -759,6 +783,13 @@ class ExprCompiler:
                 return data, valid
 
             return run_coalesce
+        if fn in ("cast_double", "cast_bigint") \
+                and expr.args[0].type.is_string \
+                and not expr.args[0].type.is_raw_string:
+            # varchar -> number: parse the dictionary values host-side,
+            # one device gather; unparseable -> NULL (deviation: the
+            # reference raises)
+            return self._compile_string_number_cast(expr)
         if fn == "cast_double":
             (a,) = [self.compile(x) for x in expr.args]
             t = expr.args[0].type
@@ -1060,6 +1091,25 @@ class ExprCompiler:
             return lut[jnp.clip(dd, 0, lut.shape[0] - 1)], v
 
         return run_blut
+
+    def _compile_string_number_cast(self, expr: Call) -> CompiledExpr:
+        colref = expr.args[0]
+        cf = self.compile(colref)
+        d = self._dict_of(colref)
+        if d is None:
+            raise ValueError(f"no dictionary for string column {colref}")
+        to_double = expr.fn == "cast_double"
+        vals = [parse_number_strict(v, to_double) for v in d.values]
+        dtype = jnp.float64 if to_double else jnp.int64
+        lut = jnp.asarray([0 if x is None else x for x in vals], dtype=dtype)
+        vlut = jnp.asarray([x is not None for x in vals])
+
+        def run_str_cast(page):
+            dd, v = cf(page)
+            c = jnp.clip(dd, 0, lut.shape[0] - 1)
+            return lut[c], v & vlut[c]
+
+        return run_str_cast
 
     def _compile_binary_hash(self, expr: Call) -> CompiledExpr:
         """crc32 / xxhash64 of to_utf8(varchar): hashed host-side over

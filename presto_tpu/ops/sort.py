@@ -122,6 +122,41 @@ def topn_page(
     return Page(out.blocks, out.row_mask & keep)
 
 
+def topn_compact_page(
+    page: Page,
+    sort_exprs: Sequence[Expr],
+    ascending: Sequence[bool],
+    n: int,
+    nulls_first: Optional[Sequence[bool]] = None,
+) -> Page:
+    """Top-n rows COMPACTED to an n-capacity page: the per-shard bound
+    of a distributed TopN (CreatePartialTopN.java role) — each shard
+    ships n rows across the mesh gather instead of its whole output.
+    Dead rows sort last, so the first n rows of the sorted page are
+    exactly the live top n."""
+    if n >= page.capacity:
+        return sort_page(page, sort_exprs, ascending, nulls_first)
+    out = sort_page(page, sort_exprs, ascending, nulls_first)
+    blocks = tuple(
+        Block(b.data[:n], b.valid[:n], b.type, b.dictionary)
+        for b in out.blocks)
+    return Page(blocks, out.row_mask[:n])
+
+
+def limit_compact_page(page: Page, n: int) -> Page:
+    """First n live rows compacted to an n-capacity page (the
+    per-shard bound of a distributed Limit)."""
+    if n >= page.capacity:
+        return limit_page(page, n)
+    live = limit_page(page, n)
+    order = jnp.argsort(~live.row_mask, stable=True)[:n]
+    blocks = tuple(
+        Block(jnp.take(b.data, order, axis=0), jnp.take(b.valid, order),
+              b.type, b.dictionary)
+        for b in live.blocks)
+    return Page(blocks, jnp.take(live.row_mask, order))
+
+
 def limit_page(page: Page, n: int) -> Page:
     """First n live rows in current order (LimitOperator analog).
     int32 running count: int64 scans are emulated (and observed

@@ -310,8 +310,8 @@ class DistributedRunner:
             page = self.run_aggregation_stage(node)
             return PrecomputedNode(page=page, channel_list=node.channels)
 
-        def run_chain(node: PlanNode) -> PrecomputedNode:
-            page = self.run_chain_stage(node)
+        def run_chain(node: PlanNode, bound=None) -> PrecomputedNode:
+            page = self.run_chain_stage(node, bound)
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def eval_glue(node: PlanNode) -> PrecomputedNode:
@@ -337,23 +337,31 @@ class DistributedRunner:
                 set_child(parent, slot, old)
 
     # ------------------------------------------------------------------
-    def run_chain_stage(self, chain_root: PlanNode) -> Page:
+    def run_chain_stage(self, chain_root: PlanNode, bound=None) -> Page:
         """Wave-execute a pure streaming chain over the mesh and gather
         its rows — a SOURCE fragment whose consumer is the coordinator
-        (or a glue breaker)."""
+        (or a glue breaker).  ``bound`` is a consuming TopN/Limit node:
+        each shard then ships only its own top/first ``bound.count``
+        rows across the gather (CreatePartialTopN.java role; the glue
+        breaker still runs the global pick on the coordinator)."""
         source = self._stage_source(chain_root)
         while True:
             try:
-                pages = self._run_chain_stage_once(chain_root, source)
+                pages = self._run_chain_stage_once(chain_root, source, bound)
                 break
             except GroupCapacityExceeded:
                 continue  # join capacities bumped; re-execute
         return concat_pages_host(pages)
 
     def _run_chain_stage_once(self, chain_root: PlanNode,
-                              source: "_StageSource") -> List[Page]:
+                              source: "_StageSource", bound=None) -> List[Page]:
+        from presto_tpu.ops.sort import limit_compact_page, topn_compact_page
+        from presto_tpu.planner.plan import TopNNode as _TopN
+
         ctx = _ChainCtx(source.cap)
         stage = self._build_dist_stage(chain_root, ctx)
+        if bound is not None and bound.count >= source.cap:
+            bound = None  # nothing to shrink
         runner = self._stage_runner
         consts_rep = {
             key: runner._materialize_build(j) for key, j in ctx.broadcast.items()
@@ -369,9 +377,21 @@ class DistributedRunner:
         def per_device_wave(page1, consts_r, consts_s):
             page = _squeeze(page1)
             p, checks = stage(page, {**consts_r, **consts_s})
+            if bound is not None:
+                if isinstance(bound, _TopN):
+                    p = topn_compact_page(p, bound.sort_exprs,
+                                          bound.ascending, bound.count,
+                                          bound.nulls_first)
+                else:
+                    p = limit_compact_page(p, bound.count)
             return _unsqueeze(p), {k: v[None] for k, v in checks.items()}
 
-        fn_key = (chain_root, "chain", ctx.sig(self._join_cfg))
+        bound_key = (None if bound is None else
+                     (type(bound).__name__, bound.count,
+                      tuple(getattr(bound, "sort_exprs", ()) or ()),
+                      tuple(getattr(bound, "ascending", ()) or ()),
+                      tuple(getattr(bound, "nulls_first", ()) or ())))
+        fn_key = (chain_root, "chain", ctx.sig(self._join_cfg), bound_key)
         wave_fn = self._wave_fns.get(fn_key)
         if wave_fn is None:
             check_specs = {name: P(axis) for name in ctx.checks}

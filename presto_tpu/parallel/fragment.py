@@ -78,12 +78,17 @@ class Fragment:
     distribution: Partitioning  # how this fragment's work is spread
     output: Partitioning  # how its output reaches the parent
     children: List["Fragment"] = dataclasses.field(default_factory=list)
+    # per-shard row bound from a TopN/Limit consumer (CreatePartialTopN)
+    shard_bound: Optional[int] = None
 
     def tree_str(self, indent: int = 0) -> str:
         pad = "  " * indent
+        bound = "" if self.shard_bound is None \
+            else f" shard_bound={self.shard_bound}"
         lines = [
             f"{pad}Fragment {self.fid} [{self.distribution}] "
             f"=> output [{self.output}] root={type(self.root).__name__}"
+            f"{bound}"
         ]
         for ch in self.children:
             lines.append(ch.tree_str(indent + 1))
@@ -401,11 +406,11 @@ def lower_stages(plan: PlanNode, run_agg, run_chain, eval_glue,
     decomposition, so EXPLAIN (TYPE DISTRIBUTED) always describes what
     execution would actually do."""
 
-    def try_stage(node):
+    def try_stage(node, bound=None):
         if is_agg_stage(node, min_stage_rows):
             return run_agg(node)
         if is_chain_stage(node, min_stage_rows):
-            return run_chain(node)
+            return run_chain(node, bound)
         return None
 
     def splice(parent, slot, old, new):
@@ -433,7 +438,12 @@ def lower_stages(plan: PlanNode, run_agg, run_chain, eval_glue,
         splices cannot break the probe chain)."""
         spine = child.source if isinstance(child, AggregationNode) else child
         n = sum(lower_edge(j, "right") for j in spine_joins(spine))
-        new = try_stage(child)
+        # a TopN/Limit consumer bounds each shard's output to its count
+        # before the gather (CreatePartialTopN.java role) — the glue
+        # breaker still runs on the coordinator for the global pick
+        bound = parent if (isinstance(parent, (TopNNode, LimitNode))
+                           and slot == "source") else None
+        new = try_stage(child, bound)
         assert new is not None  # build splices never un-distribute a chain
         splice(parent, slot, child, new)
         return n + 1
@@ -559,11 +569,12 @@ def fragment_plan(
                          output=Partitioning(SINGLE), children=[leaf])
         return tag(node, merge)
 
-    def sim_chain(node: PlanNode) -> PrecomputedNode:
+    def sim_chain(node: PlanNode, bound=None) -> PrecomputedNode:
         frag = Fragment(
             next_id(), node, distribution=_leaf_distribution(node),
             output=Partitioning(SINGLE), children=collect_children(node),
         )
+        frag.shard_bound = None if bound is None else bound.count
         return tag(node, frag)
 
     def sim_glue(node: PlanNode) -> PrecomputedNode:

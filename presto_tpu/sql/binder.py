@@ -2661,6 +2661,18 @@ class Binder:
         if isinstance(e, ast.Cast):
             v = self._bind_impl(e.value, scope, agg)
             tn = e.type_name.lower()
+            if isinstance(v, Literal) and v.type == VARCHAR \
+                    and tn in ("double", "double precision", "bigint",
+                               "integer", "int"):
+                # unparseable / out-of-int64-range -> NULL (deviation:
+                # the reference raises; the column form's dictionary
+                # LUT uses the same strict parser)
+                from presto_tpu.expr.compile import parse_number_strict
+
+                return Literal(
+                    type=DOUBLE if tn.startswith("double") else BIGINT,
+                    value=parse_number_strict(
+                        v.value, tn.startswith("double")))
             if tn in ("double", "double precision"):
                 return call("cast_double", v)
             if tn in ("bigint", "integer", "int"):
@@ -2810,6 +2822,16 @@ class Binder:
             if e.name == "nvl":
                 return self._bind_impl(
                     ast.FuncCall("coalesce", e.args), scope, agg)
+            if e.name == "try":
+                # TRY(e) -> e: the trappable errors the reference's
+                # TryExpression catches (division by zero, unparseable
+                # casts, out-of-range subscripts) already evaluate to
+                # NULL engine-wide (XLA kernels cannot trap), so TRY is
+                # the identity here (sql/tree/TryExpression.java +
+                # DesugarTryExpression.java)
+                if len(e.args) != 1:
+                    raise BindError("try takes one argument")
+                return self._bind_impl(e.args[0], scope, agg)
             if e.name == "features":
                 # presto-ml feature vector -> ARRAY(double)
                 args = [call("cast_double", self._bind_impl(a, scope, agg))

@@ -334,3 +334,43 @@ def test_completed_event_carries_dist_outcome(env):
     r.execute("SELECT count(*) FROM orders")
     assert seen and seen[-1].dist_stages >= 1
     assert seen[-1].dist_fallback is None
+
+
+def test_per_shard_topn_bound(env):
+    """A TopN/Limit consumer bounds each shard's gather to its count
+    (CreatePartialTopN.java role): the fragment advertises shard_bound
+    and distributed results match local exactly."""
+    runner, dist = env
+    sql = ("select l_orderkey, l_extendedprice from lineitem "
+           "where l_quantity > 10 order by l_extendedprice desc, "
+           "l_orderkey limit 5")
+    plan = runner.plan(sql)
+    got = dist.run(plan)
+    assert dist.last_fallback_reason is None
+    want = runner.execute(sql)
+    assert [tuple(map(float, r)) for r in got.rows] \
+        == [tuple(map(float, r)) for r in want.rows]
+    from presto_tpu.parallel.fragment import fragment_plan
+
+    frag = fragment_plan(runner.plan(sql))
+    bounds = []
+
+    def walk(f):
+        bounds.append(f.shard_bound)
+        for c in f.children:
+            walk(c)
+
+    walk(frag)
+    assert 5 in bounds
+
+
+def test_per_shard_limit_bound(env):
+    runner, dist = env
+    sql = "select l_orderkey from lineitem where l_quantity > 30 limit 7"
+    got = dist.run(runner.plan(sql))
+    assert dist.last_fallback_reason is None
+    assert len(got.rows) == 7
+    # every returned row satisfies the predicate (local check)
+    keys = {r[0] for r in runner.execute(
+        "select l_orderkey from lineitem where l_quantity > 30").rows}
+    assert all(r[0] in keys for r in got.rows)

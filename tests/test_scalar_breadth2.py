@@ -254,3 +254,39 @@ def test_chr_out_of_range_is_bind_error(runner):
     with pytest.raises(Exception) as ei:
         runner.execute("select chr(1114112)")
     assert "chr" in str(ei.value)
+
+
+def test_try_and_string_casts(runner):
+    """TRY is the identity: trappable errors already yield NULL
+    (DesugarTryExpression role); varchar->number casts parse via the
+    dictionary LUT with NULL on failure."""
+    assert one(runner, "select try(1/0)") is None
+    assert one(runner, "select try(cast('abc' as bigint))") is None
+    assert one(runner, "select cast('42' as bigint)") == 42
+    assert one(runner, "select cast('2.5' as double)") == 2.5
+    assert one(runner, "select cast('abc' as bigint)") is None
+    rows = runner.execute(
+        "select n_name, cast(n_name as bigint) from nation limit 5").rows
+    assert all(v is None for _, v in rows)
+    # numeric-looking dictionary values parse
+    assert runner.execute(
+        "select cast(s as bigint) from (values ('7'), ('x')) t(s)"
+    ).rows == [(7,), (None,)]
+
+
+def test_string_cast_strictness_and_overflow(runner):
+    """Review regressions: out-of-int64-range strings are NULL (never
+    OverflowError), python-only syntax ('1_0', padding) is rejected."""
+    assert one(runner, "select cast('99999999999999999999' as bigint)") \
+        is None
+    assert one(runner,
+               "select try(cast('99999999999999999999' as bigint))") is None
+    assert runner.execute(
+        "select cast(s as bigint) from (values "
+        "('99999999999999999999'), ('7')) t(s)").rows == [(None,), (7,)]
+    assert one(runner, "select cast('1_0' as bigint)") is None
+    assert one(runner, "select cast(' 7 ' as bigint)") is None
+    assert one(runner, "select cast('1.5e3' as double)") == 1500.0
+    assert one(runner, "select cast('Infinity' as double)") \
+        == float("inf")
+    assert one(runner, "select cast('1_0.5' as double)") is None
