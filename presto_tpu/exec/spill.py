@@ -65,7 +65,12 @@ def make_bucket_fn(key_exprs: Sequence[Expr], key_domains, num_buckets: int,
     def bucket_ids(page: Page) -> jax.Array:
         c = ExprCompiler.for_page(page)
         kd = [c.compile(e)(page) for e in key_exprs]
-        key, _ = pack_or_hash_keys([d for d, _ in kd], [v for _, v in kd], key_domains)
+        from presto_tpu.ops.aggregate import canonicalize_codes, expr_key_dicts
+
+        key, _ = pack_or_hash_keys(
+            canonicalize_codes([d for d, _ in kd],
+                               expr_key_dicts(page, key_exprs)),
+            [v for _, v in kd], key_domains)
         if key is None:
             return jnp.zeros(page.capacity, dtype=jnp.int32)
         # re-mix so packed (non-hashed) keys spread across buckets

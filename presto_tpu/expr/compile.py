@@ -52,6 +52,8 @@ STRING_TRANSFORM_FNS = frozenset({
     "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
     "url_extract_host", "url_extract_path", "url_extract_protocol",
     "url_extract_query", "translate", "normalize", "soundex",
+    "url_encode", "url_decode", "json_format", "json_parse",
+    "md5_hex", "sha1_hex", "sha256_hex",
 })
 
 
@@ -277,32 +279,41 @@ def _xxh64(data: bytes, seed: int = 0) -> int:
     return h
 
 
-def _json_path_get(doc: str, path: str):
+def _json_path_lookup(doc: str, path: str):
     """Tiny JSONPath subset: $, .name, [idx] (reference:
-    operator/scalar/JsonExtract.java's path engine)."""
+    operator/scalar/JsonExtract.java's path engine).
+    Returns (found, value) so a JSON null VALUE is distinguishable
+    from a missing path."""
     import json as _json
 
     try:
         cur = _json.loads(doc)
     except Exception:
-        return None
+        return False, None
     if not path.startswith("$"):
-        return None
+        return False, None
     i = 1
     toks = re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]", path[i:])
     consumed = sum(len(f".{a}") if a else len(f"[{b}]") for a, b in toks)
     if consumed != len(path) - 1:
-        return None
+        return False, None
     for name, idx in toks:
         if name:
             if not isinstance(cur, dict) or name not in cur:
-                return None
+                return False, None
             cur = cur[name]
         else:
             j = int(idx)
             if not isinstance(cur, list) or j >= len(cur):
-                return None
+                return False, None
             cur = cur[j]
+    return True, cur
+
+
+def _json_path_get(doc: str, path: str):
+    found, cur = _json_path_lookup(doc, path)
+    if not found:
+        return None
     return cur
 
 
@@ -360,6 +371,39 @@ def _string_transform(e: "Call"):
         import unicodedata
 
         return lambda v: unicodedata.normalize(form, v), key
+    if fn == "url_encode":
+        # application/x-www-form-urlencoded (the reference's
+        # URLEncoder): space -> '+', '*' '-' '.' '_' stay bare
+        from urllib.parse import quote_plus
+
+        return lambda v: quote_plus(v, safe="*-._"), key
+    if fn == "url_decode":
+        from urllib.parse import unquote_plus
+
+        return lambda v: unquote_plus(v), key
+    if fn in ("json_format", "json_parse"):
+        # both normalize JSON text (the engine's JSON values are
+        # varchar); invalid input -> NULL (deviation: json_parse raises
+        # in the reference)
+        import json as _json
+
+        def jf(v):
+            try:
+                return _json.dumps(_json.loads(v), separators=(",", ":"))
+            except Exception:
+                return None
+
+        return jf, key
+    if fn in ("md5_hex", "sha1_hex", "sha256_hex"):
+        import hashlib
+
+        algo = fn[:-4]
+
+        def hx(v, algo=algo):
+            # reference to_hex (BaseEncoding.base16) is UPPERCASE
+            return hashlib.new(algo, v.encode()).hexdigest().upper()
+
+        return hx, key
     if fn == "soundex":
         # classic American Soundex (StringFunctions.java#soundex)
         codes = {}
@@ -784,8 +828,12 @@ class ExprCompiler:
 
             return run_coalesce
         if fn in ("cast_double", "cast_bigint") \
-                and expr.args[0].type.is_string \
-                and not expr.args[0].type.is_raw_string:
+                and expr.args[0].type.is_raw_string:
+            raise ValueError(
+                f"{fn} is unsupported over raw varchar columns "
+                "(dictionary varchar parses via a value LUT)")
+        if fn in ("cast_double", "cast_bigint") \
+                and expr.args[0].type.is_string:
             # varchar -> number: parse the dictionary values host-side,
             # one device gather; unparseable -> NULL (deviation: the
             # reference raises)
@@ -880,7 +928,7 @@ class ExprCompiler:
         if fn in ("length", "strpos", "codepoint", "json_array_length",
                   "url_extract_port", "from_base", "date_parse",
                   "from_iso8601_date", "levenshtein_distance",
-                  "hamming_distance"):
+                  "hamming_distance", "json_size"):
             if expr.args[0].type.is_raw_string:
                 if fn not in ("length", "strpos", "codepoint"):
                     raise ValueError(
@@ -1004,6 +1052,16 @@ class ExprCompiler:
                 return len(got) if isinstance(got, list) else None
 
             lut_vals = [jal(v) for v in d.values]
+        elif fn == "json_size":
+            path = expr.args[1].value
+
+            def jsize(v, path=path):
+                found, got = _json_path_lookup(v, path)
+                if not found:
+                    return None
+                return len(got) if isinstance(got, (dict, list)) else 0
+
+            lut_vals = [jsize(v) for v in d.values]
         elif fn == "from_base":
             radix = int(expr.args[1].value)
 

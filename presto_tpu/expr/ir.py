@@ -209,7 +209,7 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
     if fn == "nullif":
         return ts[0]
     if fn in ("length", "strpos", "codepoint", "json_array_length",
-              "url_extract_port", "hll_bucket", "hll_rho"):
+              "url_extract_port", "hll_bucket", "hll_rho", "json_size"):
         return BIGINT
     if fn == "concat" and any(t.is_raw_string for t in ts):
         from presto_tpu.types import VarcharType
@@ -229,7 +229,8 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
               "lpad", "rpad", "concat", "json_extract", "json_extract_scalar",
               "json_format", "url_extract_host", "url_extract_path",
               "url_extract_protocol", "url_extract_query", "url_decode",
-              "url_encode", "normalize", "to_hex", "translate", "soundex"):
+              "url_encode", "normalize", "to_hex", "translate", "soundex",
+              "json_parse", "md5_hex", "sha1_hex", "sha256_hex"):
         return ts[0]
     if fn in ("bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
               "bitwise_shift_left", "bitwise_shift_right", "bit_count",

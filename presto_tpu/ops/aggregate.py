@@ -993,6 +993,46 @@ def _agg_dict(agg: AggCall, dictionaries) -> Optional[object]:
 # per dictionary and the eager spill path calls kernels per page)
 _COLLATION_CACHE: dict = {}
 
+# (id(dict)) -> (dict ref, has_duplicate_values) — derived dictionaries
+# (substr, date_format, day_name...) may map MANY codes to one value
+_DUP_CACHE: dict = {}
+
+
+def _dict_has_duplicates(d) -> bool:
+    got = _DUP_CACHE.get(id(d))
+    if got is not None:
+        return got[1]
+    dup = len(set(d.values)) < len(d.values)
+    _DUP_CACHE[id(d)] = (d, dup)
+    return dup
+
+
+def canonicalize_codes(datas, dicts):
+    """Replace each dictionary-coded key column's codes with the
+    representative code of their VALUE class when the dictionary holds
+    duplicate values — grouping, DISTINCT, joins, window partitions and
+    exchange routing must follow value equality, not code identity.
+    Non-string columns and injective dictionaries pass through
+    untouched (the common case: zero cost)."""
+    out = []
+    for d, dic in zip(datas, dicts):
+        if dic is None or not _dict_has_duplicates(dic):
+            out.append(d)
+            continue
+        rank, inv = _collation_luts(dic)
+        c = jnp.clip(d, 0, rank.shape[0] - 1)
+        out.append(inv[rank[c]].astype(d.dtype))
+    return out
+
+
+def expr_key_dicts(page: Page, exprs) -> list:
+    """Dictionary provenance per key expression (None for non-string)."""
+    from presto_tpu.expr.compile import expr_dictionary
+
+    dicts = [b.dictionary for b in page.blocks]
+    return [expr_dictionary(e, dicts) if e.type.is_string else None
+            for e in exprs]
+
 
 def _collation_luts(d) -> Tuple[jax.Array, jax.Array]:
     """(code -> collation rank, rank -> representative code) LUTs.
@@ -1492,15 +1532,12 @@ def grouped_aggregate(
     """
     c = ExprCompiler.for_page(page)
     kd = [c.compile(e)(page) for e in group_exprs]
-    datas = [d for d, _ in kd]
+    key_dicts = expr_key_dicts(page, group_exprs)
+    datas = canonicalize_codes([d for d, _ in kd], key_dicts)
     valids = [v for _, v in kd]
-    from presto_tpu.expr.compile import expr_dictionary
-
-    dicts = [b.dictionary for b in page.blocks]
-    key_dicts = [
-        expr_dictionary(e, dicts) if e.type.is_string else None for e in group_exprs
-    ]
-    agg_dicts = [_agg_dict(a, dicts) for a in aggs]
+    kd = list(zip(datas, valids))  # rep rows must carry canonical codes
+    agg_dicts = [_agg_dict(a, [b.dictionary for b in page.blocks])
+                 for a in aggs]
 
     live = page.row_mask
 
@@ -1606,9 +1643,10 @@ def merge_aggregate(
     detect ``num_groups > max_groups`` truncation and retry larger —
     the distributed counterpart of LocalRunner._check_overflow."""
     live = partial.row_mask
-    datas = [partial.blocks[i].data for i in range(num_keys)]
-    valids = [partial.blocks[i].valid for i in range(num_keys)]
     key_dicts = [partial.blocks[i].dictionary for i in range(num_keys)]
+    datas = canonicalize_codes(
+        [partial.blocks[i].data for i in range(num_keys)], key_dicts)
+    valids = [partial.blocks[i].valid for i in range(num_keys)]
     key_types = [partial.blocks[i].type for i in range(num_keys)]
 
     # slice state columns per agg; the first state column carries the

@@ -110,7 +110,10 @@ def build_join(
     — the INTERSECT/EXCEPT comparison; default SQL joins drop them)."""
     c = ExprCompiler.for_page(page)
     kd = [c.compile(e)(page) for e in key_exprs]
-    datas = [d for d, _ in kd]
+    from presto_tpu.ops.aggregate import canonicalize_codes, expr_key_dicts
+
+    datas = canonicalize_codes([d for d, _ in kd],
+                               expr_key_dicts(page, key_exprs))
     valids = [v for _, v in kd]
     key, exact = pack_or_hash_keys(datas, valids, key_domains)
     live = page.row_mask
@@ -170,7 +173,10 @@ def _probe_keys(page: Page, key_exprs: Sequence[Expr], key_domains,
                 null_safe: bool = False):
     c = ExprCompiler.for_page(page)
     kd = [c.compile(e)(page) for e in key_exprs]
-    datas = [d for d, _ in kd]
+    from presto_tpu.ops.aggregate import canonicalize_codes, expr_key_dicts
+
+    datas = canonicalize_codes([d for d, _ in kd],
+                               expr_key_dicts(page, key_exprs))
     valids = [v for _, v in kd]
     key, _ = pack_or_hash_keys(datas, valids, key_domains)
     ok = page.row_mask

@@ -113,8 +113,12 @@ def window_page(
         perm = perm[jnp.argsort(null_rank[perm], stable=True)]
     if partition_exprs:
         kd = [c.compile(e)(page) for e in partition_exprs]
+        from presto_tpu.ops.aggregate import canonicalize_codes, expr_key_dicts
+
         pkey, _ = pack_or_hash_keys(
-            [d for d, _ in kd], [v for _, v in kd], partition_domains
+            canonicalize_codes([d for d, _ in kd],
+                               expr_key_dicts(page, partition_exprs)),
+            [v for _, v in kd], partition_domains
         )
         perm = perm[jnp.argsort(pkey[perm], stable=True)]
     else:

@@ -45,7 +45,10 @@ def partition_targets(
     key's structure (LocalPartitionGenerator analog)."""
     c = ExprCompiler.for_page(page)
     kd = [c.compile(e)(page) for e in key_exprs]
-    datas = [d for d, _ in kd]
+    from presto_tpu.ops.aggregate import canonicalize_codes, expr_key_dicts
+
+    datas = canonicalize_codes([d for d, _ in kd],
+                               expr_key_dicts(page, key_exprs))
     valids = [v for _, v in kd]
     key, _ = pack_or_hash_keys(datas, valids, key_domains)
     h = _mix64(key.astype(jnp.uint64))

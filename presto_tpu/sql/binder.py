@@ -109,6 +109,9 @@ SCALAR_FUNCTIONS = {
     # string breadth (StringFunctions.java)
     "chr", "translate", "normalize", "soundex",
     "levenshtein_distance", "hamming_distance",
+    # URL codecs, JSON normalization, binary hash hex forms
+    "url_encode", "url_decode", "json_format", "json_parse", "json_size",
+    "md5_hex", "sha1_hex", "sha256_hex",
     "ceil", "ceiling", "floor", "round", "mod", "greatest", "least",
     "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
     "trim", "ltrim", "rtrim", "reverse", "substr",
@@ -258,6 +261,60 @@ def expr_refs(e: Expr) -> List[int]:
     if isinstance(e, LambdaExpr):
         return expr_refs(e.body)  # captured outer-channel references
     return []
+
+
+#: Joda-Time pattern letters -> the MySQL codes date_format speaks
+#: (format_datetime's date-field subset; runs of the same letter pick
+#: padded vs plain forms as Joda does)
+_JODA_RUNS = {
+    "yyyy": "%Y", "yy": "%y", "y": "%Y", "MMMM": "%M", "MMM": "%b",
+    "MM": "%m", "M": "%c", "dd": "%d", "d": "%e", "EEEE": "%W",
+    "EEE": "%a", "E": "%a", "DDD": "%j",
+    # 'D' (unpadded day-of-year) has no MySQL code -> rejected
+}
+
+
+def _joda_to_mysql(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "'":
+            if i + 1 < len(fmt) and fmt[i + 1] == "'":
+                out.append("'")  # Joda '' = one literal quote
+                i += 2
+                continue
+            j = i + 1
+            lit = []
+            while j < len(fmt):
+                if fmt[j] == "'":
+                    if j + 1 < len(fmt) and fmt[j + 1] == "'":
+                        lit.append("'")
+                        j += 2
+                        continue
+                    break
+                lit.append(fmt[j])
+                j += 1
+            else:
+                raise BindError("unterminated quote in datetime pattern")
+            out.append("".join(lit).replace("%", "%%"))
+            i = j + 1
+            continue
+        if ch.isalpha():
+            j = i
+            while j < len(fmt) and fmt[j] == ch:
+                j += 1
+            run = fmt[i:j]
+            got = _JODA_RUNS.get(run)
+            if got is None:
+                raise BindError(
+                    f"unsupported datetime pattern letter run '{run}'")
+            out.append(got)
+            i = j
+            continue
+        out.append(ch.replace("%", "%%"))
+        i += 1
+    return "".join(out)
 
 
 def remap_expr(e: Expr, mapping: Dict[int, int]) -> Expr:
@@ -2771,6 +2828,61 @@ class Binder:
                 return Literal(type=DOUBLE, value={
                     "pi": _math.pi, "e": _math.e, "nan": _math.nan,
                     "infinity": _math.inf}[e.name])
+            if e.name == "to_iso8601" and len(e.args) == 1:
+                # date -> ISO 'yyyy-mm-dd' via the date_format domain
+                # dictionary (DateTimeFunctions.java#toISO8601);
+                # timestamps would silently lose time-of-day, so reject
+                arg0 = self._bind_impl(e.args[0], scope, agg)
+                if arg0.type.name != "date":
+                    raise BindError(
+                        "to_iso8601 supports DATE arguments (a "
+                        "timestamp's time-of-day has no domain "
+                        "dictionary)")
+                return self._bind_impl(
+                    ast.FuncCall("date_format",
+                                 (e.args[0], ast.StringLit("%Y-%m-%d"))),
+                    scope, agg)
+            if e.name in ("day_name", "month_name") and len(e.args) == 1:
+                fmt = "%W" if e.name == "day_name" else "%M"
+                return self._bind_impl(
+                    ast.FuncCall("date_format",
+                                 (e.args[0], ast.StringLit(fmt))),
+                    scope, agg)
+            if e.name == "format_datetime" and len(e.args) == 2:
+                # Joda pattern subset -> the MySQL codes date_format
+                # speaks (DateTimeFunctions.java#formatDatetime)
+                p = self._bind_impl(e.args[1], scope, agg)
+                if not isinstance(p, Literal) or p.value is None:
+                    raise BindError(
+                        "format_datetime pattern must be a literal")
+                return self._bind_impl(
+                    ast.FuncCall(
+                        "date_format",
+                        (e.args[0], ast.StringLit(_joda_to_mysql(p.value)))),
+                    scope, agg)
+            if e.name == "concat_ws" and len(e.args) >= 2:
+                # separator-joined concat (deviation: a NULL argument
+                # nulls the result; the reference skips NULLs)
+                sep = e.args[0]
+                parts: list = []
+                for i, a in enumerate(e.args[1:]):
+                    if i:
+                        parts.append(sep)
+                    parts.append(a)
+                return self._bind_impl(
+                    ast.FuncCall("concat", tuple(parts)), scope, agg)
+            if e.name == "to_hex" and len(e.args) == 1 \
+                    and isinstance(e.args[0], ast.FuncCall) \
+                    and e.args[0].name in ("md5", "sha1", "sha256") \
+                    and len(e.args[0].args) == 1 \
+                    and isinstance(e.args[0].args[0], ast.FuncCall) \
+                    and e.args[0].args[0].name == "to_utf8":
+                # to_hex(md5(to_utf8(x))) collapses into one dictionary
+                # transform (VarbinaryFunctions md5/sha*/toHexString)
+                inner = e.args[0].args[0].args[0]
+                return self._bind_impl(
+                    ast.FuncCall(f"{e.args[0].name}_hex", (inner,)),
+                    scope, agg)
             if e.name in ("week_of_year", "yow", "doy", "dow",
                           "day_of_month"):
                 # DateTimeFunctions.java aliases
@@ -2916,6 +3028,9 @@ class Binder:
                 if not isinstance(ln, Literal):
                     raise BindError("substring length must be a literal")
                 args.append(ln)
+            folded = self._fold_literal_call("substr", args)
+            if folded is not None:
+                return folded
             return call("substr", *args)
 
         raise BindError(f"cannot bind {e!r}")
@@ -3452,7 +3567,7 @@ class Binder:
         v0 = lit_val(args[0])
         _null_out = {"from_base": BIGINT, "levenshtein_distance": BIGINT,
                      "hamming_distance": BIGINT, "date_parse": TIMESTAMP,
-                     "from_iso8601_date": DATE}
+                     "from_iso8601_date": DATE, "json_size": BIGINT}
         if fn in _null_out and any(a.value is None for a in args):
             # NULL in ANY argument is NULL out (reference convention)
             return Literal(type=_null_out[fn], value=None)
@@ -3469,6 +3584,15 @@ class Binder:
             return Literal(type=VARCHAR, value=out)
         if v0 is None:
             return None
+        if fn == "json_size":
+            from presto_tpu.expr.compile import _json_path_lookup
+
+            found, got = _json_path_lookup(v0, args[1].value)
+            if not found:
+                return Literal(type=BIGINT, value=None)
+            return Literal(
+                type=BIGINT,
+                value=len(got) if isinstance(got, (dict, list)) else 0)
         if fn == "from_base":
             try:
                 return Literal(type=BIGINT,

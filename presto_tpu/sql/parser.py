@@ -712,6 +712,18 @@ class Parser:
             self.expect(")")
             return ast.Extract(field, v)
 
+        if self.tok.kind == "ident" and self.tok.value.lower() == "position" \
+                and self.peek2("("):
+            # position(needle IN haystack) = strpos(haystack, needle);
+            # operands parse at additive precedence so the IN separator
+            # is not mistaken for an IN-list predicate
+            self.i += 2
+            needle = self._concat()
+            self.expect("in")
+            hay = self._concat()
+            self.expect(")")
+            return ast.FuncCall("strpos", (hay, needle))
+
         if self.accept("substring"):
             self.expect("(")
             v = self._expr()

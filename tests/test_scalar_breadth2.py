@@ -290,3 +290,154 @@ def test_string_cast_strictness_and_overflow(runner):
     assert one(runner, "select cast('Infinity' as double)") \
         == float("inf")
     assert one(runner, "select cast('1_0.5' as double)") is None
+
+
+# ---------------------------------------------------------------------------
+# second scalar batch: URL codecs, JSON normalization, Joda-pattern
+# datetime formatting, hash hex forms, position/substring forms
+# ---------------------------------------------------------------------------
+
+def test_url_codecs(runner):
+    import urllib.parse
+
+    # form-urlencoded (URLEncoder): space -> '+', '*' '-' '.' '_' bare
+    assert one(runner, "select url_encode('a b&c=d')") == "a+b%26c%3Dd"
+    assert one(runner, "select url_encode('x*-._y')") == "x*-._y"
+    assert one(runner, "select url_decode('a+b%26c')") == "a b&c"
+    assert one(runner, "select url_decode('a%20b')") == "a b"
+    rows = runner.execute(
+        "select n_name, url_encode(n_name) from nation").rows
+    for name, ue in rows:
+        assert ue == urllib.parse.quote_plus(name, safe="*-._")
+
+
+def test_json_normalization_and_size(runner):
+    assert one(runner, "select json_parse('[1, 2]')") == "[1,2]"
+    assert one(runner, "select json_parse('nope')") is None
+    assert one(runner,
+               "select json_format(json_extract('{\"a\":[1,2]}', '$.a'))") \
+        == "[1,2]"
+    assert one(runner, "select json_size('{\"a\":[1,2,3]}', '$.a')") == 3
+    assert one(runner, "select json_size('{\"a\":{\"b\":1}}', '$.a')") == 1
+    assert one(runner, "select json_size('{\"a\":5}', '$.a')") == 0
+    assert one(runner, "select json_size('{\"a\":5}', '$.x')") is None
+
+
+def test_datetime_name_functions(runner):
+    import datetime as _dt
+
+    assert one(runner, "select to_iso8601(date '2020-01-02')") == "2020-01-02"
+    assert one(runner, "select day_name(date '2020-01-02')") == "Thursday"
+    assert one(runner, "select month_name(date '2020-01-02')") == "January"
+    assert one(runner,
+               "select format_datetime(date '2020-01-02', 'd MMM yyyy')") \
+        == "2 Jan 2020"
+    rows = runner.execute(
+        "select o_orderdate, day_name(o_orderdate), "
+        "format_datetime(o_orderdate, 'yyyy/MM') from orders limit 100").rows
+    for di, dn, fm in rows:
+        d = _d(di)
+        assert dn == d.strftime("%A")
+        assert fm == d.strftime("%Y/%m")
+
+
+def test_hash_hex_forms(runner):
+    import hashlib
+
+    for algo in ("md5", "sha1", "sha256"):
+        got = one(runner, f"select to_hex({algo}(to_utf8('presto')))")
+        assert got == getattr(hashlib, algo)(b"presto").hexdigest().upper()
+    rows = runner.execute(
+        "select n_name, to_hex(md5(to_utf8(n_name))) from nation").rows
+    for name, h in rows:
+        assert h == hashlib.md5(name.encode()).hexdigest().upper()
+
+
+def test_position_and_concat_ws(runner):
+    assert one(runner, "select position('b' in 'abc')") == 2
+    assert one(runner, "select position('z' in 'abc')") == 0
+    assert one(runner, "select concat_ws('-', 'a', 'b', 'c')") == "a-b-c"
+    assert one(runner, "select substring('hello', 2, 3)") == "ell"
+    rows = runner.execute(
+        "select n_name, position('AN' in n_name) from nation").rows
+    for name, p in rows:
+        assert p == name.find("AN") + 1
+
+
+def test_hex_uppercase_and_position_concat(runner):
+    """Review regressions: to_hex is uppercase (BaseEncoding.base16);
+    position operands accept ||; Joda '' quoting."""
+    import hashlib
+
+    assert one(runner, "select to_hex(md5(to_utf8('presto')))") \
+        == hashlib.md5(b"presto").hexdigest().upper()
+    assert one(runner, "select position('b' || 'c' in 'abcd')") == 2
+    assert one(runner,
+               "select format_datetime(date '2020-01-02', 'yyyy''''MM')") \
+        == "2020'01"
+
+
+# ---------------------------------------------------------------------------
+# value-equality over duplicate-valued derived dictionaries
+# (pre-existing engine bug surfaced by date_format/day_name: substr,
+# date_format etc. map MANY codes to one value, and grouping, DISTINCT,
+# joins, window partitions and exchange routing must follow VALUES)
+# ---------------------------------------------------------------------------
+
+def test_group_by_derived_dictionary_merges_values(runner):
+    import collections
+
+    rows = runner.execute(
+        "select substr(c_phone, 1, 2), count(*) from customer "
+        "group by 1 order by 1").rows
+    per = collections.Counter(
+        p[:2] for (p,) in runner.execute(
+            "select c_phone from customer").rows)
+    assert dict(rows) == dict(per)
+    assert runner.execute(
+        "select count(distinct substr(c_phone, 1, 2)) from customer"
+    ).rows == [(len(per),)]
+
+
+def test_group_by_day_name_merges_dates(runner):
+    import collections
+
+    got = dict(runner.execute(
+        "select day_name(o_orderdate), count(*) from orders group by 1"
+    ).rows)
+    per = collections.Counter(
+        _d(d).strftime("%A") for (d,) in runner.execute(
+            "select o_orderdate from orders").rows)
+    assert got == dict(per)
+
+
+def test_join_on_derived_dictionary_value_equality(runner):
+    rows = runner.execute(
+        "select count(*) from (select distinct substr(c_phone, 1, 2) p "
+        "from customer) a join (select distinct substr(c_phone, 1, 2) p "
+        "from customer) b on a.p = b.p").rows
+    want = runner.execute(
+        "select count(distinct substr(c_phone, 1, 2)) from customer"
+    ).rows
+    assert rows == want
+
+
+def test_window_partition_by_derived_dictionary(runner):
+    rows = runner.execute(
+        "select substr(c_phone, 1, 2) p, count(*) over "
+        "(partition by substr(c_phone, 1, 2)) from customer").rows
+    import collections
+
+    per = collections.Counter(p for p, _ in rows)
+    for p, c in rows:
+        assert c == per[p], p
+
+
+def test_review_fixes_round2(runner):
+    assert one(runner, "select json_size('{\"a\":null}', '$.a')") == 0
+    assert one(runner, "select json_size('{\"a\":1}', '$.b')") is None
+    with pytest.raises(Exception):
+        runner.execute("select format_datetime(date '2020-01-02', 'D')")
+    with pytest.raises(Exception):
+        runner.execute(
+            "select to_iso8601(date_parse('2020-01-02', '%Y-%m-%d'))")
