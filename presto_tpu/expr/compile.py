@@ -72,7 +72,7 @@ _CONTAINER_FNS = frozenset({
     "array_intersect", "array_union", "array_except", "arrays_overlap",
     "array_remove", "map_concat",
     "map_filter", "transform_keys", "transform_values", "zip_with",
-    "reduce",
+    "reduce", "split",
 })
 
 
@@ -376,7 +376,8 @@ def _string_transform(e: "Call"):
         # URLEncoder): space -> '+', '*' '-' '.' '_' stay bare
         from urllib.parse import quote_plus
 
-        return lambda v: quote_plus(v, safe="*-._"), key
+        # quote_plus hard-codes '~' as safe; URLEncoder encodes it
+        return lambda v: quote_plus(v, safe="*-._").replace("~", "%7E"), key
     if fn == "url_decode":
         from urllib.parse import unquote_plus
 
@@ -518,6 +519,22 @@ def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Op
         return _DERIVED_DICTS[key][1]
     if isinstance(e, Call) and e.fn == "cast_char":
         # metadata-only re-type: same codes, same dictionary
+        return expr_dictionary(e.args[0], dictionaries)
+    if isinstance(e, Call) and e.fn == "split":
+        inner = expr_dictionary(e.args[0], dictionaries)
+        delim = e.args[1]
+        if inner is None or not isinstance(delim, Literal) \
+                or delim.value is None:
+            return None
+        pd, _ = ExprCompiler.split_parts(inner, delim.value,
+                                         e.type.max_elems)
+        return pd
+    if isinstance(e, Call) and e.fn in ("subscript", "element_at") \
+            and e.args[0].type.is_array \
+            and e.args[0].type.element is not None \
+            and e.args[0].type.element.is_string:
+        # an element of a dictionary-coded string array keeps the
+        # array's element dictionary
         return expr_dictionary(e.args[0], dictionaries)
     if isinstance(e, Call) and e.fn == "date_format":
         fmt = e.args[1]
@@ -1169,6 +1186,65 @@ class ExprCompiler:
 
         return run_str_cast
 
+    # (id(inner dict), delim, cap) -> (inner ref, parts Dictionary,
+    # np code matrix, np lengths)
+    _SPLIT_CACHE: dict = {}
+
+    @classmethod
+    def split_parts(cls, d, delim: str, cap: int):
+        """Derived artifacts of split(col, delim): the union dictionary
+        of every value's parts plus a (n_codes, 1+cap) array-matrix LUT
+        of part codes — one device gather per page
+        (StringFunctions.java#split realized dictionary-side)."""
+        key = (id(d), delim, cap)
+        got = cls._SPLIT_CACHE.get(key)
+        if got is not None:
+            return got[1], got[2]
+        parts_index: dict = {}
+        values: list = []
+
+        def code_of(p):
+            c = parts_index.get(p)
+            if c is None:
+                c = parts_index[p] = len(values)
+                values.append(p)
+            return c
+
+        import numpy as np
+
+        lut = np.zeros((len(d.values), 1 + cap), dtype=np.int32)
+        for i, v in enumerate(d.values):
+            # limit semantics: the last element keeps the unsplit
+            # remainder (StringFunctions.java#split's limit contract —
+            # the slot capacity acts as the limit, losslessly)
+            ps = v.split(delim, cap - 1)
+            lut[i, 0] = len(ps)
+            for j, p in enumerate(ps):
+                lut[i, 1 + j] = code_of(p)
+        pd = Dictionary(values or [""])
+        cls._SPLIT_CACHE[key] = (d, pd, lut)
+        return pd, lut
+
+    def _compile_split(self, expr: Call) -> CompiledExpr:
+        colref = expr.args[0]
+        cf = self.compile(colref)
+        d = self._dict_of(colref)
+        if d is None:
+            raise ValueError(f"no dictionary for string column {colref}")
+        delim = expr.args[1]
+        if not isinstance(delim, Literal) or delim.value is None:
+            raise ValueError("split delimiter must be a literal")
+        cap = expr.type.max_elems
+        _, lut_np = self.split_parts(d, delim.value, cap)
+        lut = jnp.asarray(lut_np)
+
+        def run_split(page):
+            dd, v = cf(page)
+            c = jnp.clip(dd, 0, lut.shape[0] - 1)
+            return lut[c].astype(expr.type.np_dtype), v
+
+        return run_split
+
     def _compile_binary_hash(self, expr: Call) -> CompiledExpr:
         """crc32 / xxhash64 of to_utf8(varchar): hashed host-side over
         the dictionary values, one device gather
@@ -1677,6 +1753,8 @@ class ExprCompiler:
             return self._compile_map_lambda(expr, arg0, t0)
         if fn == "zip_with":
             return self._compile_zip_with(expr)
+        if fn == "split":
+            return self._compile_split(expr)
         if fn == "reduce":
             return self._compile_reduce(expr)
         if fn == "slice":

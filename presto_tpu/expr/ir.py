@@ -251,6 +251,12 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
         from presto_tpu.types import VarbinaryType
 
         return VarbinaryType(64)
+    if fn == "split":
+        from presto_tpu.types import ArrayType, VARCHAR as _VARCHAR
+
+        cap = int(args[2].value) if len(args) > 2 and \
+            isinstance(args[2], Literal) and args[2].value else 8
+        return ArrayType(_VARCHAR, min(cap, 64))
     if fn in ("array_intersect", "array_except", "array_remove"):
         return ts[0]  # bounded by the left array's capacity
     if fn == "array_union":

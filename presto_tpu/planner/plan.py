@@ -64,10 +64,12 @@ def _expr_channel(e: Expr, name: str, src: List[Channel]) -> Channel:
     if isinstance(e, ColumnRef) and e.index < len(src):
         s = src[e.index]
         return Channel(name, e.type, s.dictionary, s.domain)
-    if e.type.is_string:
+    if e.type.is_string or (e.type.is_array and e.type.element is not None
+                            and e.type.element.is_string):
         d = expr_dictionary(e, [c.dictionary for c in src])
         if d is not None:
-            return Channel(name, e.type, d, (0, len(d) - 1))
+            dom = (0, len(d) - 1) if e.type.is_string else None
+            return Channel(name, e.type, d, dom)
     return Channel(name, e.type)
 
 

@@ -111,7 +111,7 @@ SCALAR_FUNCTIONS = {
     "levenshtein_distance", "hamming_distance",
     # URL codecs, JSON normalization, binary hash hex forms
     "url_encode", "url_decode", "json_format", "json_parse", "json_size",
-    "md5_hex", "sha1_hex", "sha256_hex",
+    "md5_hex", "sha1_hex", "sha256_hex", "split",
     "ceil", "ceiling", "floor", "round", "mod", "greatest", "least",
     "nullif", "coalesce", "if", "length", "strpos", "upper", "lower",
     "trim", "ltrim", "rtrim", "reverse", "substr",
@@ -2806,6 +2806,21 @@ class Binder:
                     or (e.name == "zip_with" and len(e.args) == 3) \
                     or (e.name == "reduce" and len(e.args) == 4):
                 return self._bind_container_lambda(e, scope, agg)
+            if e.name == "split":
+                if len(e.args) not in (2, 3):
+                    raise BindError("split takes (string, delimiter"
+                                    "[, limit])")
+                dl = self._bind_impl(e.args[1], scope, agg)
+                if not isinstance(dl, Literal) or not dl.value:
+                    raise BindError(
+                        "split delimiter must be a non-empty literal")
+                if len(e.args) == 3:
+                    lim = self._bind_impl(e.args[2], scope, agg)
+                    if not isinstance(lim, Literal) or lim.value is None \
+                            or not lim.type.is_integerlike \
+                            or not 1 <= int(lim.value) <= 64:
+                        raise BindError(
+                            "split limit must be a literal in [1, 64]")
             if e.name == "map_concat" and len(e.args) > 2:
                 # variadic: left-fold into binary concats
                 folded = ast.FuncCall("map_concat", e.args[:2])

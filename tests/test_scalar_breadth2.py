@@ -441,3 +441,40 @@ def test_review_fixes_round2(runner):
     with pytest.raises(Exception):
         runner.execute(
             "select to_iso8601(date_parse('2020-01-02', '%Y-%m-%d'))")
+
+
+def test_split(runner):
+    """split(s, delim) -> ARRAY(varchar) via a derived parts dictionary
+    + one (codes, 1+cap) LUT gather (StringFunctions.java#split)."""
+    assert one(runner, "select split('a,b,c', ',')") == ["a", "b", "c"]
+    assert one(runner, "select split('a,,c', ',')") == ["a", "", "c"]
+    assert one(runner, "select split('abc', 'x')") == ["abc"]
+    assert one(runner, "select split('a,b,c', ',')[2]") == "b"
+    rows = runner.execute(
+        "select c_phone, split(c_phone, '-'), split(c_phone, '-')[1] "
+        "from customer").rows
+    for p, parts, cc in rows:
+        assert parts == p.split("-")
+        assert cc == p.split("-")[0]
+    got = dict(runner.execute(
+        "select split(c_phone, '-')[1], count(*) from customer "
+        "group by 1").rows)
+    import collections
+
+    per = collections.Counter(p.split("-")[0] for (p,) in runner.execute(
+        "select c_phone from customer").rows)
+    assert got == dict(per)
+
+
+def test_split_limit_semantics(runner):
+    """Limit keeps the remainder in the last element; bad limits and
+    empty delimiters are bind errors (review regressions)."""
+    assert one(runner, "select split('a.b.c', '.', 2)") == ["a", "b.c"]
+    assert one(runner, "select split('a,b,c,d,e,f,g,h,i,j', ',')") \
+        == ["a", "b", "c", "d", "e", "f", "g", "h,i,j"]
+    for bad in ("select split('a,b', ',', 0)",
+                "select split('a,b', ',', -1)",
+                "select split('abc', '')"):
+        with pytest.raises(Exception):
+            runner.execute(bad)
+    assert one(runner, "select url_encode('~')") == "%7E"
