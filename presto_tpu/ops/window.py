@@ -57,6 +57,9 @@ class WindowFunc:
     arg: Optional[Expr] = None
     offset: int = 1  # lead/lag offset; ntile buckets; nth_value n
     frame: Optional[tuple] = None
+    # skip NULL argument values when stepping (lead/lag) or picking
+    # (first/last/nth_value) — the reference's IGNORE NULLS treatment
+    ignore_nulls: bool = False
 
     @property
     def type(self) -> Type:
@@ -171,6 +174,17 @@ def window_page(
     return Page(tuple(out_blocks), page.row_mask)
 
 
+def _nonnull_rank_index(vs, live_s, idx, cap):
+    """(nonnull mask, 1-based cumulative non-null rank, rank ->
+    position scatter) — the IGNORE NULLS lookup scaffolding shared by
+    lead/lag and first/last/nth_value."""
+    nonnull = vs & live_s
+    grank = jnp.cumsum(nonnull.astype(jnp.int64))
+    pos_of = jnp.zeros(cap + 1, jnp.int64).at[
+        jnp.where(nonnull, grank, 0)].set(idx, mode="drop")
+    return nonnull, grank, pos_of
+
+
 def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
                     seg_start, last_peer, has_order, seg_last):
     if f.kind == "row_number":
@@ -210,6 +224,20 @@ def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
     if f.kind in ("lead", "lag"):
         d, v = c.compile(f.arg)(page)
         ds, vs = d[perm], v[perm]
+        if f.ignore_nulls and f.offset > 0:  # offset 0 IS the current row
+            # step over NULLs: rank the non-null rows, look the k-th
+            # non-null rank up through a rank->position scatter
+            # (WindowOperator's IGNORE NULLS treatment, shape-static)
+            nonnull, grank, pos_of = _nonnull_rank_index(vs, live_s, idx, cap)
+            if f.kind == "lag":
+                tgt_rank = grank - nonnull.astype(jnp.int64) - (f.offset - 1)
+            else:
+                tgt_rank = grank + f.offset
+            exists = (tgt_rank >= 1) & (tgt_rank <= grank[-1])
+            src_c = pos_of[jnp.clip(tgt_rank, 0, cap)]
+            same_seg = seg_start[src_c] == seg_start
+            ok = exists & same_seg
+            return jnp.where(ok, ds[src_c], jnp.zeros_like(ds)), ok
         off = -f.offset if f.kind == "lag" else f.offset  # lag looks earlier
         src = idx + off
         in_range = (src >= 0) & (src < cap)
@@ -239,6 +267,22 @@ def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
     if f.kind in ("first_value", "last_value", "nth_value"):
         d, v = c.compile(f.arg)(page)
         ds, vs = d[perm], v[perm]
+        if f.ignore_nulls:
+            # pick by non-null RANK within the frame: frames stay
+            # inside a segment, so global ranks + bounds checks suffice
+            nonnull, grank, pos_of = _nonnull_rank_index(vs, live_s, idx, cap)
+            before = grank[s_c] - nonnull[s_c].astype(jnp.int64)
+            in_frame = grank[e_c] - before  # non-nulls inside the frame
+            if f.kind == "first_value":
+                want = before + 1
+            elif f.kind == "last_value":
+                want = grank[e_c]
+            else:
+                want = before + f.offset
+            have = jnp.logical_not(empty) & (want > before) \
+                & (want <= grank[e_c]) & (in_frame > 0)
+            pos = pos_of[jnp.clip(want, 0, cap)]
+            return jnp.where(have, ds[pos], jnp.zeros_like(ds)), have
         if f.kind == "first_value":
             pos = s_c
         elif f.kind == "last_value":

@@ -238,6 +238,7 @@ class FuncCall(Node):
     args: Tuple[Node, ...]
     distinct: bool = False
     star: bool = False  # count(*)
+    ignore_nulls: bool = False  # lead/lag/first/last/nth IGNORE NULLS
 
 
 @dataclasses.dataclass(frozen=True)

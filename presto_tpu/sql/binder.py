@@ -2797,6 +2797,11 @@ class Binder:
                             "transform/filter/any_match/all_match/none_match")
 
         if isinstance(e, ast.FuncCall):
+            if e.ignore_nulls:
+                # only the window value functions under OVER consume it
+                raise BindError(
+                    "IGNORE NULLS applies to window value functions "
+                    "(lead/lag/first_value/last_value/nth_value OVER)")
             if e.name in ("transform", "filter", "any_match", "all_match",
                           "none_match") and len(e.args) == 2 \
                     and isinstance(e.args[1], ast.Lambda):
@@ -3316,7 +3321,13 @@ class Binder:
                 if offset < 0:
                     raise BindError(f"{name} offset must be non-negative")
         frame = self._bind_frame(e.frame, kind)
-        wf = WindowFunc(kind=kind, arg=arg, offset=offset, frame=frame)
+        if fc.ignore_nulls and kind not in (
+                "lead", "lag", "first_value", "last_value", "nth_value"):
+            raise BindError(
+                "IGNORE NULLS applies to lead/lag/first_value/"
+                "last_value/nth_value only")
+        wf = WindowFunc(kind=kind, arg=arg, offset=offset, frame=frame,
+                        ignore_nulls=fc.ignore_nulls)
         partition_irs = [self._bind_impl(p, scope, agg) for p in e.partition_by]
         order_irs = [self._bind_impl(o.expr, scope, agg) for o in e.order_by]
         ascending = [o.ascending for o in e.order_by]
@@ -3404,6 +3415,10 @@ class Binder:
         return e
 
     def _bind_agg_call(self, e: ast.FuncCall, scope: Scope, agg: AggCtx) -> ColumnRef:
+        if e.ignore_nulls:
+            raise BindError(
+                "IGNORE NULLS applies to window value functions "
+                "(lead/lag/first_value/last_value/nth_value OVER)")
         from presto_tpu.ops.aggregate import output_type
 
         if e.star or (e.name == "count" and not e.args):

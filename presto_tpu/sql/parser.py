@@ -11,6 +11,7 @@ precedence mirrors the grammar's ``booleanExpression``/
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import List, Optional, Tuple
 
@@ -105,6 +106,10 @@ class Parser:
 
     def expect(self, val: str) -> None:
         if not self.accept(val):
+            raise SyntaxError(f"expected {val!r}, got {self.tok!r}")
+
+    def expect_word(self, val: str) -> None:
+        if not self.accept_word(val):
             raise SyntaxError(f"expected {val!r}, got {self.tok!r}")
 
     def accept_word(self, *vals: str) -> Optional[str]:
@@ -788,6 +793,19 @@ class Parser:
                             args.append(self._expr())
                     self.expect(")")
                     fc = ast.FuncCall(name.lower(), tuple(args), distinct=distinct)
+                # null treatment clause (window value functions):
+                # fn(...) [IGNORE NULLS | RESPECT NULLS] OVER (...) —
+                # two-token lookahead so a bare alias named ignore/
+                # respect still parses
+                t0 = self.tok
+                if t0.kind in ("ident", "keyword") \
+                        and t0.value.lower() in ("ignore", "respect") \
+                        and self.tokens[self.i + 1].kind in ("ident", "keyword") \
+                        and self.tokens[self.i + 1].value.lower() == "nulls":
+                    word = t0.value.lower()
+                    self.i += 2
+                    if word == "ignore":
+                        fc = dataclasses.replace(fc, ignore_nulls=True)
                 if self.accept("over"):
                     self.expect("(")
                     partition: List[ast.Node] = []

@@ -478,3 +478,66 @@ def test_split_limit_semantics(runner):
         with pytest.raises(Exception):
             runner.execute(bad)
     assert one(runner, "select url_encode('~')") == "%7E"
+
+
+# ---------------------------------------------------------------------------
+# window IGNORE NULLS (WindowOperator null-treatment clause)
+# ---------------------------------------------------------------------------
+
+def test_window_ignore_nulls(runner):
+    rows = runner.execute(
+        "select i, lag(v) ignore nulls over (order by i), "
+        "lead(v) ignore nulls over (order by i), "
+        "first_value(v) ignore nulls over (order by i), "
+        "last_value(v) ignore nulls over (order by i), "
+        "lag(v, 2) ignore nulls over (order by i), "
+        "nth_value(v, 2) ignore nulls over (order by i) "
+        "from (values (1, 10), (2, null), (3, 30), (4, null), (5, 50)) "
+        "t(i, v) order by i").rows
+    assert rows == [
+        (1, None, 30, 10, 10, None, None),
+        (2, 10, 30, 10, 10, None, None),
+        (3, 10, 50, 10, 30, None, 30),
+        (4, 30, 50, 10, 30, 10, 30),
+        (5, 30, None, 10, 50, 10, 30),
+    ]
+
+
+def test_window_ignore_nulls_partitioned(runner):
+    rows = runner.execute(
+        "select g, i, lag(v) ignore nulls over "
+        "(partition by g order by i) from (values "
+        "(1, 1, null), (1, 2, 12), (1, 3, null), (1, 4, 14), "
+        "(2, 1, 21), (2, 2, null), (2, 3, 23)) t(g, i, v) "
+        "order by g, i").rows
+    assert rows == [
+        (1, 1, None), (1, 2, None), (1, 3, 12), (1, 4, 12),
+        (2, 1, None), (2, 2, 21), (2, 3, 21),
+    ]
+
+
+def test_respect_nulls_is_default(runner):
+    rows = runner.execute(
+        "select lag(v) respect nulls over (order by i) from (values "
+        "(1, 10), (2, null), (3, 30)) t(i, v) order by i").rows
+    assert rows == [(None,), (10,), (None,)]
+
+
+def test_ignore_nulls_rejected_on_rank(runner):
+    with pytest.raises(Exception):
+        runner.execute(
+            "select rank() ignore nulls over (order by n_name) from nation")
+
+
+def test_ignore_nulls_review_regressions(runner):
+    # offset 0 returns the CURRENT row's value even under IGNORE NULLS
+    rows = runner.execute(
+        "select lag(v, 0) ignore nulls over (order by i) from (values "
+        "(1, 10), (2, null), (3, 30)) t(i, v) order by i").rows
+    assert rows == [(10,), (None,), (30,)]
+    # IGNORE NULLS without OVER is rejected, not silently dropped
+    with pytest.raises(Exception):
+        runner.execute("select sum(n_nationkey) ignore nulls from nation")
+    # a bare alias named 'ignore' still parses
+    assert runner.execute(
+        "select count(*) ignore from nation").rows == [(25,)]
