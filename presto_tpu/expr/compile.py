@@ -747,6 +747,32 @@ class ExprCompiler:
 
         assert isinstance(expr, Call), expr
         fn = expr.fn
+        if fn == "row_construct":
+            fns = [self.compile(a) for a in expr.args]
+            rt = expr.type
+
+            def run_row_construct(page, fns=fns, rt=rt):
+                from presto_tpu.ops import container as ct
+
+                pairs = [f(page) for f in fns]
+                out = ct.construct_row([d for d, _ in pairs],
+                                       [v for _, v in pairs], rt)
+                return out, page.row_mask
+
+            return run_row_construct
+        if fn == "row_field":
+            base_f = self.compile(expr.args[0])
+            rt = expr.args[0].type
+            i = int(expr.args[1].value)
+
+            def run_row_field(page, base_f=base_f, rt=rt, i=i):
+                from presto_tpu.ops import container as ct
+
+                d, v = base_f(page)
+                out, nn = ct.row_field(d, rt, i)
+                return out, v & nn
+
+            return run_row_field
         if fn in _CONTAINER_FNS:
             return self._compile_container(expr)
         if fn in _GEO_FNS:

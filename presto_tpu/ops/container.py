@@ -154,6 +154,43 @@ def decode_maps(data: np.ndarray, t: Type, dictionary=None) -> List[dict]:
     return out
 
 
+def decode_rows(data: np.ndarray, t: Type) -> List[tuple]:
+    """(n, nfields) row matrix -> python tuples (row validity is the
+    caller's; NULL fields decode as None)."""
+    out = []
+    storage = t.np_dtype
+    for r in data:
+        out.append(tuple(
+            None if _is_null_slot(x, storage) else _decode_scalar(x, ft)
+            for x, ft in zip(r, t.fields)))
+    return out
+
+
+def construct_row(field_datas, field_valids, t: Type) -> jax.Array:
+    """row(e1..en): stack per-row scalars into the (n, nfields) matrix
+    with NULL fields as the storage sentinel."""
+    storage = t.np_dtype
+    sent = _null_const(storage)
+    cols = []
+    for (d, v), ft in zip(zip(field_datas, field_valids), t.fields):
+        # decimals ride as their scaled ints; everything casts to the
+        # shared lane dtype
+        cols.append(jnp.where(v, d.astype(storage), sent))
+    return jnp.stack(cols, axis=1)
+
+
+def row_field(data: jax.Array, t: Type, i: int):
+    """1-based field access: (values, non-null mask)."""
+    ft = t.fields[i - 1]
+    col = data[:, i - 1]
+    nn = ~elem_null_mask(col)
+    if ft.is_decimal:
+        out = col.astype(jnp.int64)
+    else:
+        out = col.astype(ft.np_dtype)
+    return out, nn
+
+
 # ---------------------------------------------------------------------------
 # device kernels (used by the expression compiler)
 # ---------------------------------------------------------------------------

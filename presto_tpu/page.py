@@ -268,6 +268,11 @@ class Page:
 
                 vals = np.empty(len(data), dtype=object)
                 vals[:] = decode_maps(data, b.type, b.dictionary)
+            elif b.type.name == "row":
+                from presto_tpu.ops.container import decode_rows
+
+                vals = np.empty(len(data), dtype=object)
+                vals[:] = decode_rows(data, b.type)
             elif b.type.is_long_decimal:
                 import decimal
 

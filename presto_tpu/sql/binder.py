@@ -2652,13 +2652,20 @@ class Binder:
             if e.op in ("and", "or"):
                 return call(e.op, self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
             if e.op in ("=", "<>") and (
-                isinstance(e.left, ast.RowCtor) or isinstance(e.right, ast.RowCtor)
+                _is_row_ast(e.left) or _is_row_ast(e.right)
             ):
                 return self._bind_impl(
                     _row_comparison(e.left, e.right, e.op), scope, agg)
             if e.op in ("=", "<>", "<", "<=", ">", ">="):
                 opmap = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
-                return call(opmap[e.op], self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
+                l_ir = self._bind_impl(e.left, scope, agg)
+                r_ir = self._bind_impl(e.right, scope, agg)
+                if l_ir.type.name == "row" or r_ir.type.name == "row":
+                    raise BindError(
+                        "ROW comparisons desugar pairwise — compare "
+                        "row constructors directly, not row-typed "
+                        "values")
+                return call(opmap[e.op], l_ir, r_ir)
             if e.op in ("+", "-") and (
                 isinstance(e.right, ast.IntervalLit) or isinstance(e.left, ast.IntervalLit)
             ):
@@ -2811,6 +2818,17 @@ class Binder:
                     or (e.name == "zip_with" and len(e.args) == 3) \
                     or (e.name == "reduce" and len(e.args) == 4):
                 return self._bind_container_lambda(e, scope, agg)
+            if e.name == "row" and e.args:
+                # first-class anonymous ROW value (spi/type/RowType.java
+                # subset: fixed-width scalar fields, 1-based subscript)
+                from presto_tpu.types import RowType
+
+                items = [self._bind_impl(a, scope, agg) for a in e.args]
+                try:
+                    rt = RowType(*[a.type for a in items])
+                except ValueError as ex:
+                    raise BindError(str(ex))
+                return Call(type=rt, fn="row_construct", args=tuple(items))
             if e.name == "split":
                 if len(e.args) not in (2, 3):
                     raise BindError("split takes (string, delimiter"
@@ -3035,6 +3053,16 @@ class Binder:
         if isinstance(e, ast.Subscript):
             base = self._bind_impl(e.base, scope, agg)
             idx = self._bind_impl(e.index, scope, agg)
+            if base.type.name == "row":
+                if not isinstance(idx, Literal) or idx.value is None:
+                    raise BindError("ROW field index must be a literal")
+                i = int(idx.value)
+                if not 1 <= i <= len(base.type.fields):
+                    raise BindError(
+                        f"ROW field index {i} out of range "
+                        f"[1, {len(base.type.fields)}]")
+                return Call(type=base.type.fields[i - 1], fn="row_field",
+                            args=(base, Literal(type=BIGINT, value=i)))
             return call("subscript", base, idx)
 
         if isinstance(e, ast.Substring):
@@ -3712,10 +3740,23 @@ class Binder:
         return order_irs
 
 
+def _is_row_ast(e: ast.Node) -> bool:
+    """Row-constructor syntax: (a, b) or row(a, b)."""
+    return isinstance(e, ast.RowCtor) or (
+        isinstance(e, ast.FuncCall) and e.name == "row" and bool(e.args))
+
+
+def _row_items(e: ast.Node):
+    return e.items if isinstance(e, ast.RowCtor) else e.args
+
+
 def _row_comparison(left: ast.Node, right: ast.Node, op: str) -> ast.Node:
-    """(a, b) = (c, d) -> a = c AND b = d; <> negates the conjunction."""
-    if not (isinstance(left, ast.RowCtor) and isinstance(right, ast.RowCtor)):
+    """(a, b) = (c, d) -> a = c AND b = d; <> negates the conjunction.
+    Accepts both the (a, b) and row(a, b) constructor forms."""
+    if not (_is_row_ast(left) and _is_row_ast(right)):
         raise BindError("row comparison needs row constructors on both sides")
+    left = ast.RowCtor(tuple(_row_items(left)))
+    right = ast.RowCtor(tuple(_row_items(right)))
     if len(left.items) != len(right.items):
         raise BindError(
             f"row arity mismatch: {len(left.items)} vs {len(right.items)}")

@@ -63,8 +63,12 @@ class Type:
     # ARRAY element type / MAP value type (None otherwise); MAP key type.
     element: Optional["Type"] = None
     key_element: Optional["Type"] = None
+    # ROW field types (None otherwise)
+    fields: Optional[tuple] = None
 
     def __repr__(self) -> str:
+        if self.name == "row":
+            return f"row({', '.join(map(repr, self.fields))})"
         if self.name == "array":
             return f"array({self.element!r})"
         if self.name == "map":
@@ -117,6 +121,8 @@ class Type:
                 # multimap: each value lane is itself a fixed array
                 return (1 + m + m * (1 + self.element.max_elems),)
             return (1 + 2 * m,)
+        if self.name == "row":
+            return (len(self.fields),)
         return ()
 
     @property
@@ -229,7 +235,8 @@ def _container_storage_dtype(*types: Type, _allow_array: bool = False) -> np.dty
             raise ValueError(f"nested container element type {t} unsupported")
         else:
             flat.append(t)
-    if any(t.name == "double" for t in flat):
+    if any(t.name in ("double", "real") for t in flat):
+        # REAL rides a float64 lane too — an int64 lane would floor it
         return np.dtype(np.float64)
     if all(t.name == "boolean" for t in flat):
         return np.dtype(np.int32)
@@ -247,6 +254,21 @@ def ArrayType(element: Type, max_elems: int = 8) -> Type:
     static for XLA."""
     return Type("array", _container_storage_dtype(element),
                 precision=int(max_elems), element=element)
+
+
+def RowType(*field_types: Type) -> Type:
+    """Anonymous ROW value: one slot per field in a shared storage
+    dtype (reference: spi/type/RowType.java's variable per-field blocks
+    — here a dense (capacity, nfields) matrix, TPU-first).  Fields must
+    be fixed-width non-string scalars."""
+    if not field_types:
+        raise ValueError("ROW needs at least one field")
+    for t in field_types:
+        if t.is_string or t.is_array or t.is_map or t.is_long_decimal:
+            raise ValueError(
+                f"ROW fields must be fixed-width scalars (got {t})")
+    storage = _container_storage_dtype(*field_types)
+    return Type(name="row", np_dtype=storage, fields=tuple(field_types))
 
 
 def MapType(key: Type, value: Type, max_elems: int = 8) -> Type:

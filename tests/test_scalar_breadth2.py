@@ -541,3 +541,41 @@ def test_ignore_nulls_review_regressions(runner):
     # a bare alias named 'ignore' still parses
     assert runner.execute(
         "select count(*) ignore from nation").rows == [(25,)]
+
+
+# ---------------------------------------------------------------------------
+# first-class ROW values (spi/type/RowType.java subset)
+# ---------------------------------------------------------------------------
+
+def test_row_type_first_class(runner):
+    assert one(runner, "select row(1, 2.5)") == (1, 2.5)
+    assert one(runner, "select row(1, 2.5)[2]") == 2.5
+    assert one(runner, "select row(1, null)") == (1, None)
+    assert one(runner, "select row(1, null)[2]") is None
+    rows = runner.execute(
+        "select row(o_orderkey, o_custkey), "
+        "row(o_orderkey, o_custkey)[1] from orders limit 3").rows
+    for tup, k in rows:
+        assert tup[0] == k and len(tup) == 2
+    # derived expressions inside fields
+    assert one(runner,
+               "select row(1 + 1, o_orderkey * 2)[2] from orders "
+               "where o_orderkey = 3") == 6
+
+
+def test_row_type_errors(runner):
+    for sql in ("select row(n_name, 1) from nation",   # string field
+                "select row(1, 2)[3]",                  # out of range
+                "select row(1, 2)[0]"):
+        with pytest.raises(Exception):
+            runner.execute(sql)
+
+
+def test_row_review_regressions(runner):
+    # REAL fields ride a float lane (no int truncation)
+    assert one(runner, "select row(cast(1.5 as real))[1]") == 1.5
+    # row() comparisons desugar pairwise, both constructor forms
+    assert one(runner, "select count(*) from nation where "
+               "row(n_regionkey, 1) = row(1, 1)") == 5
+    assert one(runner, "select row(1, 2) = row(1, 2)") is True
+    assert one(runner, "select row(1, 2) <> (1, 3)") is True
