@@ -115,7 +115,12 @@ def _measure(sf: float, iters: int, only: str) -> dict:
 
     all_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3],
                    "q14": QUERIES[14]}
-    bench_queries = {only: all_queries[only]} if only else all_queries
+    if only == "ds":  # TPC-DS-only child (the TPU per-query path)
+        bench_queries = {}
+    elif only:
+        bench_queries = {only: all_queries[only]}
+    else:
+        bench_queries = all_queries
 
     # bytes the engine must stream from HBM per query (columns touched x
     # 8 bytes x rows) — the roofline denominator for bandwidth figures
@@ -184,7 +189,57 @@ def _measure(sf: float, iters: int, only: str) -> dict:
         out["device"] = device
     if errors:
         out["errors"] = errors
+
+    # TPC-DS star-schema rates (BASELINE.md protocol names Q3/Q7) —
+    # informational breadth alongside the headline TPC-H metric, so the
+    # pinned-baseline comparison stays stable.  Skipped per-query, on
+    # errors, and via BENCH_TPCDS=0.
+    ds_deadline = float(os.environ.get("BENCH_CHILD_DEADLINE_TS", "0"))
+    ds_ok = only in ("", "ds") and not errors \
+        and os.environ.get("BENCH_TPCDS", "1") != "0" \
+        and (not ds_deadline or ds_deadline - time.time() > 150)
+    if ds_ok:
+        try:
+            out["tpcds_rates"] = _measure_tpcds(
+                min(sf, 1.0), iters, split_rows, runner_cls=QueryRunner,
+                catalog_cls=Catalog, mem_cls=MemoryConnector)
+        except Exception as e:  # breadth must never sink the headline
+            log(f"tpcds rates failed: {type(e).__name__}: {e}")
     return out
+
+
+def _measure_tpcds(sf: float, iters: int, split_rows: int, *, runner_cls,
+                   catalog_cls, mem_cls) -> dict:
+    from presto_tpu.connectors.tpcds import Tpcds
+
+    t0 = time.time()
+    ds = Tpcds(sf=sf, split_rows=split_rows)
+    mem = mem_cls()
+    for t in ("store_sales", "date_dim", "item",
+              "customer_demographics", "promotion"):
+        mem.load_from(ds, t)
+    ss_rows = mem.row_count("store_sales")
+    log(f"tpcds sf={sf}: store_sales={ss_rows} rows in {time.time()-t0:.1f}s")
+    catalog = catalog_cls()
+    catalog.register("tpcds", mem)
+    runner = runner_cls(catalog)
+    from tests.tpcds_queries import QUERIES as DS
+
+    rates = {}
+    for qn in (3, 7):
+        name = f"ds_q{qn}"
+        t0 = time.time()
+        runner.execute(DS[qn])
+        log(f"{name}: warmup {time.time()-t0:.2f}s")
+        times = []
+        for _ in range(iters):
+            t0 = time.time()
+            runner.execute(DS[qn])
+            times.append(time.time() - t0)
+        rates[name] = round(ss_rows / min(times), 1)
+        log(f"{name}: best {min(times):.3f}s -> "
+            f"{rates[name]:.3e} store_sales rows/s")
+    return rates
 
 
 # ----------------------------------------------------------------------
@@ -198,6 +253,8 @@ def _run_child(env_extra: dict, timeout: float, only: str = "") -> dict:
     env = dict(os.environ)
     env.update(env_extra)
     env["BENCH_MODE"] = "child"
+    # the child self-limits optional breadth (TPC-DS) near its deadline
+    env["BENCH_CHILD_DEADLINE_TS"] = str(time.time() + timeout)
     if only:
         env["BENCH_QUERY"] = only
     else:
@@ -363,6 +420,20 @@ def _measure_tpu_per_query(sf, deadline, per_child_cap) -> dict:
         result["rates"].update(res.get("rates", {}))
         result["device"].update(res.get("device", {}))
         result["errors"].update(res.get("errors", {}))
+        if res.get("tpcds_rates"):
+            result["tpcds_rates"] = res["tpcds_rates"]
+        if name == QUERY_NAMES[-1] and not result["errors"] \
+            and result.get("rates") and _remaining(deadline) > 240:
+            # headline captured: spend leftover budget on the TPC-DS
+            # breadth rates in their own bounded child
+            try:
+                ds_res = _run_child(
+                    {}, min(per_child_cap, _remaining(deadline) - 60),
+                    only="ds")
+                if ds_res.get("tpcds_rates"):
+                    result["tpcds_rates"] = ds_res["tpcds_rates"]
+            except Exception as e:
+                log(f"tpcds child failed: {type(e).__name__}: {e}")
         if res.get("errors"):
             break  # backend already reported unreachable inside the child
         if result["platform"] == "cpu":
@@ -454,6 +525,8 @@ def main():
         out["value"] = round(_geomean(list(result["rates"].values())), 1)
         out["platform"] = result.get("platform")
         out["rates"] = {k: round(v, 1) for k, v in result["rates"].items()}
+        if result.get("tpcds_rates"):
+            out["tpcds_rates"] = result["tpcds_rates"]
         if result.get("device"):
             out["device"] = result["device"]
             if out["platform"] != "cpu":

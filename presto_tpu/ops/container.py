@@ -99,7 +99,7 @@ def _decode_scalar(v, t: Type, dictionary=None):
         if dictionary is not None and 0 <= code < len(dictionary):
             return dictionary.values[code]
         return None
-    if t.name == "double":
+    if t.name in ("double", "real"):
         return float(v)
     if t.is_decimal:
         return float(v) / 10 ** (t.scale or 0)
@@ -171,11 +171,8 @@ def construct_row(field_datas, field_valids, t: Type) -> jax.Array:
     with NULL fields as the storage sentinel."""
     storage = t.np_dtype
     sent = _null_const(storage)
-    cols = []
-    for (d, v), ft in zip(zip(field_datas, field_valids), t.fields):
-        # decimals ride as their scaled ints; everything casts to the
-        # shared lane dtype
-        cols.append(jnp.where(v, d.astype(storage), sent))
+    cols = [jnp.where(v, d.astype(storage), sent)
+            for d, v in zip(field_datas, field_valids)]
     return jnp.stack(cols, axis=1)
 
 

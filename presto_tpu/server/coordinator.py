@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -78,6 +79,9 @@ class _QueryState:
         self.rows: List[tuple] = []
         self.error: Optional[str] = None
         self.done = threading.Event()
+        # the computation thread: outlives `done` on cancel (DELETE
+        # sets done to unblock the client; the thread runs to the end)
+        self.thread: Optional[threading.Thread] = None
 
     def summary(self) -> dict:
         return {
@@ -225,12 +229,23 @@ class CoordinatorServer:
         if self.memory_manager is not None:
             self.memory_manager.start()
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 30.0) -> None:
         if self.memory_manager is not None:
             self.memory_manager.stop()
         if self._thread.is_alive():  # shutdown() blocks unless serving
             self.httpd.shutdown()
         self.httpd.server_close()
+        # drain in-flight computation threads: cancellation is
+        # cooperative (the thread discards its result but runs to the
+        # end), and its per-query pool reservations release only at
+        # completion — a stop() that abandons them leaks reservations
+        # into whatever runs next in the process
+        deadline = time.time() + drain_timeout
+        with self._lock:
+            pending = [q.thread for q in self.queries.values()
+                       if q.thread is not None]
+        for t in pending:
+            t.join(max(0.0, deadline - time.time()))
 
     def _kill_query(self, qid: str) -> None:
         """LowMemoryKiller action: cancel through the normal state path
@@ -296,7 +311,9 @@ class CoordinatorServer:
                 group.release()
                 q.done.set()
 
-        threading.Thread(target=run, daemon=True).start()
+        t = threading.Thread(target=run, daemon=True)
+        q.thread = t
+        t.start()
         return q
 
     def _cluster_stats(self) -> dict:

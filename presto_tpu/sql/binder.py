@@ -2689,9 +2689,9 @@ class Binder:
             return call("not", out) if e.negated else out
 
         if isinstance(e, ast.InList):
-            if isinstance(e.value, ast.RowCtor):
+            if _is_row_ast(e.value):
                 # (a, b) IN ((1, 2), (3, 4)) -> OR of pairwise ANDs
-                # (sql/tree/Row.java comparisons)
+                # (sql/tree/Row.java comparisons; row(a, b) form too)
                 out_ast = None
                 for item in e.items:
                     conj = _row_comparison(e.value, item, "=")

@@ -579,3 +579,11 @@ def test_row_review_regressions(runner):
                "row(n_regionkey, 1) = row(1, 1)") == 5
     assert one(runner, "select row(1, 2) = row(1, 2)") is True
     assert one(runner, "select row(1, 2) <> (1, 3)") is True
+
+
+def test_row_in_and_real_decode(runner):
+    """Review regressions: row() form in IN lists; REAL tuple decode."""
+    assert one(runner, "select row(1, 2) in (row(1, 2), row(3, 4))") is True
+    assert one(runner, "select row(1, 5) in (row(1, 2), row(3, 4))") in (
+        False, None)
+    assert one(runner, "select row(cast(1.5 as real))") == (1.5,)
