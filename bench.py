@@ -83,6 +83,19 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     from presto_tpu.connectors.tpch import Tpch
     from presto_tpu.runner import QueryRunner
 
+    if only == "ds":  # TPC-DS-only child: no TPC-H load at all
+        default_rows = (1 << 20) if platform == "cpu" else (1 << 23)
+        split_rows = int(os.environ.get("BENCH_SPLIT_ROWS",
+                                        str(default_rows)))
+        out = {"platform": platform, "sf": sf, "rates": {}}
+        try:
+            out["tpcds_rates"] = _measure_tpcds(
+                min(sf, 1.0), iters, split_rows, runner_cls=QueryRunner,
+                catalog_cls=Catalog, mem_cls=MemoryConnector)
+        except Exception as e:
+            log(f"tpcds rates failed: {type(e).__name__}: {e}")
+        return out
+
     # Split granularity: one dispatch per split per chain.  On TPU,
     # fewer/larger splits amortize dispatch+fold overhead (SF1 lineitem
     # fits one 8M-row split: 6M x 8 cols x 8B = 384MB vs 16GB HBM); on
@@ -426,10 +439,12 @@ def _measure_tpu_per_query(sf, deadline, per_child_cap) -> dict:
             and result.get("rates") and _remaining(deadline) > 240:
             # headline captured: spend leftover budget on the TPC-DS
             # breadth rates in their own bounded child
+            ds_budget = min(per_child_cap,
+                            _remaining(deadline) - 0.45 * deadline)
+            if ds_budget < 180:
+                continue  # never eat into the CPU-fallback reserve
             try:
-                ds_res = _run_child(
-                    {}, min(per_child_cap, _remaining(deadline) - 60),
-                    only="ds")
+                ds_res = _run_child({}, ds_budget, only="ds")
                 if ds_res.get("tpcds_rates"):
                     result["tpcds_rates"] = ds_res["tpcds_rates"]
             except Exception as e:

@@ -146,6 +146,11 @@ class QueryRunner:
 
         if isinstance(stmt, ast.Explain):
             plan = self.binder.plan_ast(stmt.query)
+            if getattr(stmt, "validate", False):
+                # reaching here means parse + bind both succeeded
+                from presto_tpu.types import BOOLEAN
+
+                return MaterializedResult(["Valid"], [BOOLEAN], [(True,)])
             if getattr(stmt, "distributed", False):
                 from presto_tpu.parallel.fragment import explain_distributed
 
@@ -296,6 +301,52 @@ class QueryRunner:
                 + [(f, "window") for f in window]
             )
             return MaterializedResult(["function", "kind"], [VARCHAR, VARCHAR], rows)
+
+        if isinstance(stmt, ast.ShowStats):
+            # ShowStatsRewrite.java's table shape: one row per column +
+            # the summary row carrying row_count.  Domains live in
+            # DEVICE representation (dictionary codes, epoch days,
+            # scaled decimal ints) — convert to logical values here.
+            import datetime as _dt
+
+            from presto_tpu.types import DOUBLE
+
+            def logical(c, v):
+                if v is None:
+                    return None
+                t = c.type
+                if t.is_string:
+                    return None  # codes say nothing about value order
+                if t.name == "date":
+                    return str(_dt.date(1970, 1, 1)
+                               + _dt.timedelta(days=int(v)))
+                if t.is_decimal:
+                    return str(v / 10 ** (t.scale or 0))
+                return str(v)
+
+            handle = self.catalog.resolve(stmt.table)
+            rows = []
+            for c in handle.columns:
+                ndv = c.ndv
+                if ndv is None and c.dictionary is not None:
+                    ndv = len(c.dictionary)
+                if ndv is None and c.domain is not None \
+                        and c.type.is_integerlike:
+                    # width == ndv only for unscaled integer domains
+                    ndv = c.domain[1] - c.domain[0] + 1
+                lo, hi = (c.domain if c.domain is not None else (None, None))
+                if c.type.is_string and c.dictionary is not None:
+                    vals = c.dictionary.values
+                    lo_s, hi_s = (min(vals), max(vals)) if vals else (None, None)
+                else:
+                    lo_s, hi_s = logical(c, lo), logical(c, hi)
+                rows.append((c.name, float(ndv) if ndv is not None else None,
+                             lo_s, hi_s, None))
+            rows.append((None, None, None, None, float(handle.row_count)))
+            return MaterializedResult(
+                ["column_name", "distinct_values_count", "low_value",
+                 "high_value", "row_count"],
+                [VARCHAR, DOUBLE, VARCHAR, VARCHAR, DOUBLE], rows)
 
         if isinstance(stmt, ast.Describe):
             handle = self.catalog.resolve(stmt.table)

@@ -312,8 +312,9 @@ class CoordinatorServer:
                 q.done.set()
 
         t = threading.Thread(target=run, daemon=True)
-        q.thread = t
-        t.start()
+        t.start()  # started before publication: stop() joins safely
+        with self._lock:
+            q.thread = t
         return q
 
     def _cluster_stats(self) -> dict:

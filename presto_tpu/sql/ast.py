@@ -372,12 +372,21 @@ class Explain(Node):
     # EXPLAIN ANALYZE VERBOSE: exclusive per-operator times by
     # re-running chain prefixes (fusion deliberately broken)
     verbose: bool = False
+    # EXPLAIN (TYPE VALIDATE): parse+bind only, one boolean column
+    validate: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
 class SetSession(Node):
     name: str
     value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowStats(Node):
+    """SHOW STATS FOR t (sql/tree/ShowStats.java)."""
+
+    table: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

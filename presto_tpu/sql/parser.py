@@ -891,19 +891,23 @@ def parse_statement(sql: str) -> ast.Node:
         analyze = bool(p.accept("analyze"))
         verbose = analyze and bool(p.accept_word("verbose"))
         distributed = False
+        validate = False
         if p.accept("("):
             while not p.accept(")"):
                 if p.accept_word("type"):
-                    kind = p.accept_word("distributed", "logical")
+                    kind = p.accept_word("distributed", "logical",
+                                         "validate")
                     if kind is None:
                         raise SyntaxError("EXPLAIN (TYPE ...) supports "
-                                          "LOGICAL | DISTRIBUTED")
+                                          "LOGICAL | DISTRIBUTED | "
+                                          "VALIDATE")
                     distributed = kind == "distributed"
+                    validate = kind == "validate"
                 elif p.accept(",") is None:
                     raise SyntaxError(f"bad EXPLAIN option at {p.tok!r}")
         q = p._query()
         p.accept(";")
-        return ast.Explain(q, analyze, distributed, verbose)
+        return ast.Explain(q, analyze, distributed, verbose, validate)
     if p.accept("set"):
         p.expect("session")
         name = p.ident()
@@ -1014,6 +1018,9 @@ def parse_statement(sql: str) -> ast.Node:
         p.accept_word("work")
         return _finish(p, ast.Rollback())
     if p.accept("show"):
+        if p.accept_word("stats"):
+            p.expect("for")
+            return _finish(p, ast.ShowStats(_qualified_name(p)))
         if p.accept("tables"):
             return _finish(p, ast.ShowTables())
         if p.accept("session"):

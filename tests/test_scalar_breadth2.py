@@ -587,3 +587,35 @@ def test_row_in_and_real_decode(runner):
     assert one(runner, "select row(1, 5) in (row(1, 2), row(3, 4))") in (
         False, None)
     assert one(runner, "select row(cast(1.5 as real))") == (1.5,)
+
+
+def test_show_stats_and_explain_validate(runner):
+    """SHOW STATS FOR t (ShowStats.java / ShowStatsRewrite shape) and
+    EXPLAIN (TYPE VALIDATE)."""
+    res = runner.execute("show stats for orders")
+    assert res.names[0] == "column_name" and res.names[-1] == "row_count"
+    summary = res.rows[-1]
+    assert summary[0] is None and summary[-1] == 1500.0
+    by_col = {r[0]: r for r in res.rows[:-1]}
+    assert by_col["o_orderkey"][1] == 1500.0  # pk: ndv == rows
+    assert runner.execute(
+        "explain (type validate) select count(*) from orders"
+    ).rows == [(True,)]
+    with pytest.raises(Exception):
+        runner.execute("explain (type validate) select nope from orders")
+
+
+def test_show_stats_logical_values(runner):
+    """Stats print LOGICAL values, not device representation (review
+    regression: epoch days, dictionary codes, scaled decimal ints)."""
+    rows = {r[0]: r for r in runner.execute(
+        "show stats for lineitem").rows if r[0]}
+    lo, hi = rows["l_shipdate"][2], rows["l_shipdate"][3]
+    assert lo.startswith("199") and hi.startswith("199")  # ISO dates
+    q = rows["l_quantity"]
+    assert float(q[2]) >= 1.0 and float(q[3]) <= 51.0  # descaled
+    assert q[1] is None or q[1] <= 60  # no 10^scale inflation
+    flags = {r[0]: r for r in runner.execute(
+        "show stats for orders").rows if r[0]}
+    st = flags["o_orderstatus"]
+    assert st[2] in (None, "F", "O", "P")  # values, never codes
