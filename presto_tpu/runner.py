@@ -302,6 +302,18 @@ class QueryRunner:
             )
             return MaterializedResult(["function", "kind"], [VARCHAR, VARCHAR], rows)
 
+        if isinstance(stmt, ast.ResetSession):
+            self.session.reset(stmt.name)
+            return MaterializedResult(["result"], [VARCHAR],
+                                      [("RESET SESSION",)])
+
+        if isinstance(stmt, ast.ShowCreateTable):
+            handle = self.catalog.resolve(stmt.table)
+            cols = ",\n".join(f"   {c.name} {c.type!r}"
+                              for c in handle.columns)
+            ddl = (f"CREATE TABLE {stmt.table} (\n{cols}\n)")
+            return MaterializedResult(["Create Table"], [VARCHAR], [(ddl,)])
+
         if isinstance(stmt, ast.ShowStats):
             # ShowStatsRewrite.java's table shape: one row per column +
             # the summary row carrying row_count.  Domains live in

@@ -111,6 +111,13 @@ class Session:
             value = meta.parse(value)
         self.properties[name] = value
 
+    def reset(self, name: str) -> None:
+        """RESET SESSION: back to the property's default."""
+        meta = self._meta.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        self.properties[name] = meta.default
+
     def describe(self):
         return [
             (p.name, self.properties[p.name], p.default, p.description)

@@ -383,6 +383,20 @@ class SetSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ResetSession(Node):
+    """RESET SESSION name (sql/tree/ResetSession.java)."""
+
+    name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCreateTable(Node):
+    """SHOW CREATE TABLE t (sql/tree/ShowCreate.java)."""
+
+    table: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowStats(Node):
     """SHOW STATS FOR t (sql/tree/ShowStats.java)."""
 

@@ -1017,7 +1017,13 @@ def parse_statement(sql: str) -> ast.Node:
     if p.accept_word("rollback"):
         p.accept_word("work")
         return _finish(p, ast.Rollback())
+    if p.accept_word("reset"):
+        p.expect("session")
+        return _finish(p, ast.ResetSession(p.ident()))
     if p.accept("show"):
+        if p.accept("create"):
+            p.expect("table")
+            return _finish(p, ast.ShowCreateTable(_qualified_name(p)))
         if p.accept_word("stats"):
             p.expect("for")
             return _finish(p, ast.ShowStats(_qualified_name(p)))

@@ -619,3 +619,16 @@ def test_show_stats_logical_values(runner):
         "show stats for orders").rows if r[0]}
     st = flags["o_orderstatus"]
     assert st[2] in (None, "F", "O", "P")  # values, never codes
+
+
+def test_reset_session_and_show_create(runner):
+    runner.execute("set session distributed_sort = true")
+    runner.execute("reset session distributed_sort")
+    vals = {r[0]: r[1] for r in runner.execute("show session").rows}
+    assert str(vals["distributed_sort"]) == str(
+        {r[0]: r[2] for r in runner.execute("show session").rows}
+        ["distributed_sort"])  # back to default
+    (ddl,) = runner.execute("show create table nation").rows[0]
+    assert ddl.startswith("CREATE TABLE nation") and "n_name varchar" in ddl
+    with pytest.raises(Exception):
+        runner.execute("reset session not_a_property")
