@@ -304,6 +304,9 @@ class QueryRunner:
 
         if isinstance(stmt, ast.ResetSession):
             self.session.reset(stmt.name)
+            # executor knobs may have changed; rebuild (plans survive)
+            self.executor = self._make_executor()
+            self._dist = None  # mesh/session knobs re-resolve lazily
             return MaterializedResult(["result"], [VARCHAR],
                                       [("RESET SESSION",)])
 

@@ -622,12 +622,15 @@ def test_show_stats_logical_values(runner):
 
 
 def test_reset_session_and_show_create(runner):
-    runner.execute("set session distributed_sort = true")
-    runner.execute("reset session distributed_sort")
+    defaults = {r[0]: r[2] for r in runner.execute("show session").rows}
+    # flip AWAY from the default so a no-op reset cannot pass
+    runner.execute("set session jit = false")
     vals = {r[0]: r[1] for r in runner.execute("show session").rows}
-    assert str(vals["distributed_sort"]) == str(
-        {r[0]: r[2] for r in runner.execute("show session").rows}
-        ["distributed_sort"])  # back to default
+    assert vals["jit"] != defaults["jit"]
+    runner.execute("reset session jit")
+    vals = {r[0]: r[1] for r in runner.execute("show session").rows}
+    assert str(vals["jit"]) == str(defaults["jit"])
+    assert runner.executor.jit  # the executor rebuilt with the default
     (ddl,) = runner.execute("show create table nation").rows[0]
     assert ddl.startswith("CREATE TABLE nation") and "n_name varchar" in ddl
     with pytest.raises(Exception):
