@@ -687,7 +687,14 @@ class Parser:
             self.expect("end")
             return ast.Case(tuple(whens), else_, operand)
 
-        if self.accept("cast"):
+        is_try_cast = (self.tok.kind == "ident"
+                       and self.tok.value.lower() == "try_cast"
+                       and self.peek2("("))
+        if is_try_cast:
+            self.i += 1
+        if is_try_cast or self.accept("cast"):
+            # try_cast == cast here: failed conversions already yield
+            # NULL engine-wide (the try() identity rationale)
             self.expect("(")
             v = self._expr()
             self.expect("as")

@@ -635,3 +635,12 @@ def test_reset_session_and_show_create(runner):
     assert ddl.startswith("CREATE TABLE nation") and "n_name varchar" in ddl
     with pytest.raises(Exception):
         runner.execute("reset session not_a_property")
+
+
+def test_try_cast(runner):
+    assert runner.execute(
+        "select try_cast('abc' as bigint), try_cast('7' as bigint), "
+        "try_cast('2.5' as double)").rows == [(None, 7, 2.5)]
+    assert runner.execute(
+        "select count(*) from nation where try_cast(n_name as bigint) "
+        "is null").rows == [(25,)]
