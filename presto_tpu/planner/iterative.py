@@ -780,6 +780,50 @@ class PruneUnionColumns(Rule):
         return UnionNode(arms)
 
 
+class EvaluateZeroSample(Rule):
+    """TABLESAMPLE at 0 percent is the empty relation — no scan
+    (EvaluateZeroSample.java)."""
+
+    pattern = Pattern.type_of(TableScanNode).where(
+        lambda n: n.sample is not None and n.sample[1] <= 0)
+
+    def apply(self, node: TableScanNode) -> Optional[PlanNode]:
+        return _empty_like(node)  # keeps channel dictionaries
+
+
+class RemoveFullSample(Rule):
+    """TABLESAMPLE at >= 100 percent samples nothing away — drop the
+    clause so scans fuse normally (RemoveFullSample.java)."""
+
+    pattern = Pattern.type_of(TableScanNode).where(
+        lambda n: n.sample is not None and n.sample[1] >= 100)
+
+    def apply(self, node: TableScanNode) -> Optional[PlanNode]:
+        import dataclasses as _dc
+
+        return _dc.replace(node, sample=None)
+
+
+class RemoveUnreferencedScalarApply(Rule):
+    """A scalar-subquery cross product whose single-row side is never
+    read by the consuming projection evaluates for nothing — drop it
+    (RemoveUnreferencedScalarApplyNodes.java / the lateral twin)."""
+
+    @staticmethod
+    def _fires(n: ProjectNode) -> bool:
+        if not isinstance(n.source, CrossSingleNode):
+            return False
+        base = len(n.source.left.channels)
+        return all(r < base for p in n.projections for r in _expr_refs(p))
+
+    pattern = Pattern.type_of(ProjectNode).where(
+        lambda n: RemoveUnreferencedScalarApply._fires(n))
+
+    def apply(self, node: ProjectNode) -> Optional[PlanNode]:
+        return ProjectNode(node.source.left, list(node.projections),
+                           list(node.names))
+
+
 DEFAULT_RULES: List[Rule] = [
     MergeAdjacentFilters(),
     PushFilterThroughProject(),
@@ -808,6 +852,9 @@ DEFAULT_RULES: List[Rule] = [
     PruneCountAggregationOverScalar(),
     GatherAndMergeWindows(),
     PruneUnionColumns(),
+    EvaluateZeroSample(),
+    RemoveFullSample(),
+    RemoveUnreferencedScalarApply(),
 ]
 
 

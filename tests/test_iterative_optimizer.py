@@ -464,3 +464,35 @@ def test_prune_union_columns(runner):
     assert unions and all(len(u.channels) == 1 for u in unions)
     got = sorted(r[0] for r in runner.execute(sql).rows)
     assert got == [0, 0, 1, 1]
+
+
+def test_sample_rules(runner):
+    from presto_tpu.planner.plan import TableScanNode, ValuesNode
+
+    plan0 = runner.plan("select n_name from nation tablesample bernoulli (0)")
+    assert not _find(plan0, TableScanNode)
+    assert runner.execute(
+        "select count(*) from nation tablesample bernoulli (0)"
+    ).rows == [(0,)]
+    plan100 = runner.plan(
+        "select n_name from nation tablesample bernoulli (100)")
+    scans = _find(plan100, TableScanNode)
+    assert scans and all(s.sample is None for s in scans)
+    assert runner.execute(
+        "select count(*) from nation tablesample bernoulli (100)"
+    ).rows == [(25,)]
+
+
+def test_remove_unreferenced_scalar_apply(runner):
+    from presto_tpu.planner.plan import CrossSingleNode
+
+    # the scalar subquery's value is never selected -> apply dropped
+    plan = runner.plan(
+        "select n_name from (select n_name, (select max(r_regionkey) "
+        "from region) m from nation)")
+    assert not _find(plan, CrossSingleNode)
+    # still present when referenced
+    plan2 = runner.plan(
+        "select n_name, m from (select n_name, (select max(r_regionkey)"
+        " from region) m from nation)")
+    assert _find(plan2, CrossSingleNode)
