@@ -51,6 +51,20 @@ def _substitute_params(node, params):
     return _dc.replace(node, **changes) if changes else node
 
 
+def _count_parameters(node) -> int:
+    """Number of ? placeholders in a statement tree."""
+    import dataclasses as _dc
+
+    if isinstance(node, ast.Parameter):
+        return 1
+    if isinstance(node, tuple):
+        return sum(_count_parameters(x) for x in node)
+    if not isinstance(node, ast.Node):
+        return 0
+    return sum(_count_parameters(getattr(node, f.name))
+               for f in _dc.fields(node))
+
+
 class QueryRunner:
     def __init__(self, catalog: Catalog, session: Optional[Session] = None, jit: bool = True,
                  memory_pool=None, access_control=None):
@@ -59,7 +73,7 @@ class QueryRunner:
 
         self.catalog = catalog
         self.session = session or Session()
-        self.binder = Binder(catalog)
+        self.binder = Binder(catalog, session=self.session)
         self._jit_default = jit
         # Accounting is always-on (memory/MemoryPool.java:43 tracks
         # every operator unconditionally): None selects the process
@@ -301,6 +315,31 @@ class QueryRunner:
                 + [(f, "window") for f in window]
             )
             return MaterializedResult(["function", "kind"], [VARCHAR, VARCHAR], rows)
+
+        if isinstance(stmt, (ast.DescribeOutput, ast.DescribeInput)):
+            q = self._prepared.get(stmt.name)
+            if q is None:
+                raise ValueError(f"prepared statement not found: {stmt.name}")
+            if isinstance(stmt, ast.DescribeInput):
+                # parameter positions; deviation (PARITY.md): every
+                # type reports 'unknown' — the reference's
+                # DescribeInputRewrite infers types from the parameter
+                # context, which this binder does not track
+                n = _count_parameters(q)
+                rows = [(i, "unknown") for i in range(n)]
+                return MaterializedResult(
+                    ["Position", "Type"], [BIGINT, VARCHAR], rows)
+            # DESCRIBE OUTPUT: bind with NULL parameters to recover the
+            # projected column names/types (DescribeOutputRewrite)
+            n = _count_parameters(q)
+            filled = _substitute_params(q, tuple(ast.NullLit()
+                                                 for _ in range(n)))
+            plan = self.binder.plan_ast(filled)
+            self._check_access(plan)  # no schema leaks on denied tables
+            rows = [(nm, repr(t)) for nm, t in
+                    zip(plan.output_names, plan.output_types)]
+            return MaterializedResult(
+                ["Column Name", "Type"], [VARCHAR, VARCHAR], rows)
 
         if isinstance(stmt, ast.ResetSession):
             self.session.reset(stmt.name)

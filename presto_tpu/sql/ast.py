@@ -383,6 +383,20 @@ class SetSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class DescribeOutput(Node):
+    """DESCRIBE OUTPUT name (sql/tree/DescribeOutput.java)."""
+
+    name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class DescribeInput(Node):
+    """DESCRIBE INPUT name (sql/tree/DescribeInput.java)."""
+
+    name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class ResetSession(Node):
     """RESET SESSION name (sql/tree/ResetSession.java)."""
 

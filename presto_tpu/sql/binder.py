@@ -525,8 +525,9 @@ class Term:
 class Binder:
     """Plans one SELECT query against a catalog."""
 
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, session=None):
         self.catalog = catalog
+        self.session = session
         # subquery conjuncts discovered while joining the current
         # query's FROM terms, applied after the join tree is built
         self._pending_subqueries: List[Tuple[ast.Node, Scope]] = []
@@ -554,6 +555,9 @@ class Binder:
         from presto_tpu.planner.stats import StatsCalculator
 
         self._stats = StatsCalculator()
+
+    def session_user(self) -> str:
+        return self.session.user if self.session is not None else "presto"
 
     # ==================================================================
     def _query_now(self) -> float:
@@ -2606,6 +2610,11 @@ class Binder:
             if e.name.lower() == "current_date":
                 return Literal(type=DATE, value=int(now // 86400))
             return Literal(type=TIMESTAMP, value=int(now * 1_000_000))
+
+        if isinstance(e, ast.Identifier) and e.qualifier is None \
+                and e.name.lower() == "current_user":
+            # SqlBase.g4 specialForm CURRENT_USER -> the session user
+            return Literal(type=VARCHAR, value=self.session_user())
 
         if isinstance(e, ast.Identifier):
             idx = scope.resolve(e.qualifier, e.name)

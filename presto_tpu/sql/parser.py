@@ -1049,6 +1049,10 @@ def parse_statement(sql: str) -> ast.Node:
         table = _qualified_name(p)
         return _finish(p, ast.ShowColumns(table))
     if p.accept_word("describe") or p.accept_word("desc"):
+        if p.accept_word("output"):
+            return _finish(p, ast.DescribeOutput(p.ident()))
+        if p.accept_word("input"):
+            return _finish(p, ast.DescribeInput(p.ident()))
         return _finish(p, ast.Describe(_qualified_name(p)))
     if p.accept_word("prepare"):
         name = p.ident()

@@ -644,3 +644,39 @@ def test_try_cast(runner):
     assert runner.execute(
         "select count(*) from nation where try_cast(n_name as bigint) "
         "is null").rows == [(25,)]
+
+
+def test_describe_input_output_and_current_user(runner):
+    runner.execute(
+        "prepare qd from select n_name, n_nationkey + ? as k from "
+        "nation where n_nationkey = ?")
+    assert runner.execute("describe output qd").rows == [
+        ("n_name", "varchar"), ("k", "bigint")]
+    assert runner.execute("describe input qd").rows == [
+        (0, "unknown"), (1, "unknown")]
+    with pytest.raises(Exception):
+        runner.execute("describe output nope")
+    assert runner.execute("select current_user").rows == [("presto",)]
+    runner.execute("deallocate prepare qd")
+
+
+def test_describe_output_respects_access_control(runner):
+    """DESCRIBE OUTPUT must not leak schema of denied tables (review
+    regression: it binds a plan, so it checks access like EXECUTE)."""
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.runner import QueryRunner
+    from presto_tpu.security import RuleBasedAccessControl
+    from presto_tpu.session import Session
+
+    cat = Catalog()
+    cat.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    ac = RuleBasedAccessControl(
+        [("analyst", "region", True, False),
+         ("analyst", "*", False, False)])
+    r = QueryRunner(cat, session=Session(user="analyst"),
+                    access_control=ac)
+    r.execute("prepare qa from select n_name from nation")
+    with pytest.raises(Exception) as ei:
+        r.execute("describe output qa")
+    assert "denied" in str(ei.value).lower() or "access" in str(ei.value).lower()
