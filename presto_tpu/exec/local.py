@@ -793,21 +793,29 @@ class LocalRunner:
                 pages = tuple(self._pages(node.right))
                 if not pages:
                     pages = (Page.empty(node.right.output_types, 1),)
-                fn = self._fold_cache.get(node)
-                if fn is None:
-                    right_keys = list(node.right_keys)
-                    kd = node.key_domains
-                    ns = getattr(node, "null_safe_keys", False)
+                def build_fn(uniq: bool):
+                    fn = self._fold_cache.get((node, uniq))
+                    if fn is None:
+                        right_keys = list(node.right_keys)
+                        kd = node.key_domains
+                        ns = getattr(node, "null_safe_keys", False)
 
-                    def make_build(ps):
-                        return build_join(
-                            concat_pages_device(list(ps)), right_keys,
-                            key_domains=kd, null_safe=ns,
-                        )
+                        def make_build(ps, _u=uniq):
+                            return build_join(
+                                concat_pages_device(list(ps)), right_keys,
+                                key_domains=kd, null_safe=ns, unique=_u,
+                            )
 
-                    fn = jax.jit(make_build) if self.jit else make_build
-                    self._fold_cache[node] = fn
-                build = fn(pages)
+                        fn = jax.jit(make_build) if self.jit else make_build
+                        self._fold_cache[(node, uniq)] = fn
+                    return fn
+
+                uniq = bool(getattr(node, "unique_build", False))
+                build = build_fn(uniq)(pages)
+                if build.unique_ok is not None and not bool(build.unique_ok):
+                    # the planner's uniqueness promise failed at runtime
+                    # (PagesHash would have chained): rebuild sorted
+                    build = build_fn(False)(pages)
                 self._account("join_build", build.page, node)
                 self._builds[node] = build
         return self._builds[node]

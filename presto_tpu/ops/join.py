@@ -64,6 +64,20 @@ def _direct_table_profitable() -> bool:
     return _jax.default_backend() != "cpu"
 
 
+def _unique_direct_enabled() -> bool:
+    import os
+
+    return os.environ.get("PRESTO_TPU_UNIQUE_DIRECT", "1") \
+        not in ("0", "false", "")
+
+
+def _direct_budget(page: Page) -> int:
+    """Largest key domain worth a direct-address table for this build
+    size (shared by the sorted and unique paths so they agree)."""
+    return min(DIRECT_DOMAIN_MAX,
+               max(1 << 20, DIRECT_DOMAIN_PER_ROW * page.capacity))
+
+
 def packed_domain_size(domains) -> Optional[int]:
     """Size of the packed-key code space [0, prod) when every key
     column has a known domain (mirrors pack_or_hash_keys' exact path:
@@ -87,9 +101,13 @@ class JoinBuild:
     # optional direct-address table: starts[k] = first sorted position
     # with key >= k, for k in [0, domain_size]; int32 (domain_size+1,)
     starts: Optional[jax.Array] = None
+    # sort-free unique-build path: False iff the planner's uniqueness
+    # promise was violated at runtime (caller rebuilds via the sort)
+    unique_ok: Optional[jax.Array] = None
 
     def tree_flatten(self):
-        return (self.sorted_keys, self.perm, self.page, self.starts), None
+        return (self.sorted_keys, self.perm, self.page, self.starts,
+                self.unique_ok), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -105,9 +123,17 @@ def build_join(
     key_exprs: Sequence[Expr],
     key_domains: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
     null_safe: bool = False,
+    unique: bool = False,
 ) -> JoinBuild:
     """``null_safe``: NULL keys match each other (IS NOT DISTINCT FROM
-    — the INTERSECT/EXCEPT comparison; default SQL joins drop them)."""
+    — the INTERSECT/EXCEPT comparison; default SQL joins drop them).
+    ``unique``: the planner promises distinct build keys (primary-key
+    joins) — with a dense exact domain the build then skips the sort
+    entirely: ranks come from a prefix count over the domain, the
+    direct-address table from its cumulative sum (PagesHash's
+    addressing rebuilt as two scatters + one scan; a violated promise
+    is detected and reported through ``unique_ok`` for the caller to
+    rebuild via the sort path)."""
     c = ExprCompiler.for_page(page)
     kd = [c.compile(e)(page) for e in key_exprs]
     from presto_tpu.ops.aggregate import canonicalize_codes, expr_key_dicts
@@ -122,16 +148,35 @@ def build_join(
         for v in valids:
             live = live & v
     key = jnp.where(live, key, jnp.iinfo(key.dtype).max)
+
+    prod_u = (packed_domain_size(key_domains)
+              if unique and exact and _unique_direct_enabled() else None)
+    if prod_u is not None and prod_u <= _direct_budget(page):
+        cap = page.capacity
+        key_c = jnp.clip(key, 0, prod_u - 1)
+        slot = jnp.where(live, key_c, prod_u)
+        counts = jnp.zeros(prod_u + 1, jnp.int32).at[slot].add(
+            jnp.where(live, 1, 0))
+        present = jnp.minimum(counts[:prod_u], 1)
+        starts_u = jnp.concatenate([
+            jnp.zeros(1, jnp.int32), jnp.cumsum(present).astype(jnp.int32)])
+        rank = starts_u[key_c.astype(jnp.int64)]
+        tgt = jnp.where(live, rank.astype(jnp.int64), cap)
+        sorted_keys = jnp.full((cap,), jnp.iinfo(key.dtype).max,
+                               dtype=key.dtype).at[tgt].set(key, mode="drop")
+        order_u = jnp.zeros((cap,), jnp.int32).at[tgt].set(
+            jnp.arange(cap, dtype=jnp.int32), mode="drop")
+        collision = jnp.any(counts[:prod_u] > 1)
+        return JoinBuild(sorted_keys, order_u, page, starts_u,
+                         unique_ok=jnp.logical_not(collision))
+
     order = jnp.argsort(key)
     sorted_keys = key[order]
 
     starts = None
     prod = (packed_domain_size(key_domains)
             if exact and _direct_table_profitable() else None)
-    if prod is not None and prod <= min(
-        DIRECT_DOMAIN_MAX,
-        max(1 << 20, DIRECT_DOMAIN_PER_ROW * page.capacity),
-    ):
+    if prod is not None and prod <= _direct_budget(page):
         # one fused sort at build time buys O(1)-gather probes forever:
         # dead/sentinel rows sort past prod-1 so they never enter a range
         queries = jnp.arange(prod + 1, dtype=sorted_keys.dtype)

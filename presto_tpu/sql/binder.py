@@ -1416,6 +1416,18 @@ class Binder:
                 ColumnRef(type=n.projections[i].type, index=j)
                 for i, j in zip(key_idx, inner_idx)
             ])
+        if isinstance(n, JoinNode):
+            # a join that emits at most ONE row per probe row (inner or
+            # left against a unique build, or a mark join) preserves
+            # probe-side key uniqueness: rows may drop, never duplicate
+            if (n.kind in ("mark", "semi", "anti")
+                    or (n.kind in ("inner", "left") and n.unique_build)) \
+                    and all(i < len(n.left.channels) for i in key_idx):
+                return self._build_is_unique(n.left, [
+                    ColumnRef(type=n.left.channels[i].type, index=i)
+                    for i in key_idx
+                ])
+            return False
         if isinstance(n, TableScanNode):
             conn = self.catalog.connector(n.handle.connector_name)
             if not hasattr(conn, "primary_key"):
