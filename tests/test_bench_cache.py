@@ -88,8 +88,15 @@ def test_legacy_single_entry_baseline_upgrades(tmp_path):
 def test_baseline_file_is_committed():
     """The pinned baseline must live in git: the watcher benches from a
     `git archive HEAD` snapshot, and an untracked baseline would be
-    re-measured into vs_baseline=1.0 there (r3 failure mode)."""
+    re-measured into vs_baseline=1.0 there (r3 failure mode).  Inside
+    such an archive export there is no .git to ask — but the file
+    having materialized there proves the same property."""
+    import os
     import subprocess
+
+    if not os.path.isdir(os.path.join(HERE, ".git")):
+        assert os.path.exists(os.path.join(HERE, "BASELINE_MEASURED.json"))
+        return
     out = subprocess.run(
         ["git", "ls-files", "BASELINE_MEASURED.json"], cwd=HERE,
         stdout=subprocess.PIPE).stdout.decode().strip()
