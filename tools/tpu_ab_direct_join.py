@@ -1,11 +1,15 @@
-"""Device A/B of the direct-address CSR join table on TPC-H Q3.
+"""Device A/B of the join addressing designs on TPC-H Q3.
 
-The direct table (ops/join.py DIRECT_DOMAIN_* path) is gated
-accelerator-only because it loses on XLA:CPU; this script produces the
-on-device evidence for that gate: it times Q3 with the table forced off
-(PRESTO_TPU_DIRECT_JOIN=0, binary-search probes) and forced on (=1,
-O(1) CSR gathers) in two child processes, verifies the row results
-match, and writes TPU_AB.json next to TPU_MEASURED.json.
+Three legs, each a bounded child process on the same data:
+  base    PRESTO_TPU_DIRECT_JOIN=0 PRESTO_TPU_UNIQUE_DIRECT=0
+          (sorted build + binary-search probes)
+  csr     PRESTO_TPU_DIRECT_JOIN=1 PRESTO_TPU_UNIQUE_DIRECT=0
+          (sorted build + domain-sized CSR starts: O(1) probes,
+          the r3 accelerator-gated design)
+  unique  PRESTO_TPU_UNIQUE_DIRECT=1 (r4b: sort-FREE builds for
+          planner-proven unique keys — rank by domain prefix count)
+Row results are cross-checked with fp tolerance and TPU_AB.json lands
+next to TPU_MEASURED.json.
 
 Run by tools/tpu_watch.sh when the tunnel recovers; safe to run by hand.
 """
@@ -17,10 +21,18 @@ import sys
 import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if HERE not in sys.path:  # children launch as tools/<script>.py
+    sys.path.insert(0, HERE)
 OUT = os.path.join(HERE, "TPU_AB.json")
 
 
 def _child(direct: str) -> dict:
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # jax may be pre-imported at interpreter startup (axon plugin);
+        # jax.config still works until the backend initializes
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import presto_tpu  # noqa: F401
     import jax
 
@@ -59,7 +71,7 @@ def _child(direct: str) -> dict:
     best = min(times)
     return {
         "platform": platform,
-        "direct": direct,
+        "leg": direct,
         "seconds": round(best, 4),
         "rows_per_sec": round(rows / best, 1),
         "result_rows": [[str(c) for c in r] for r in res],
@@ -87,27 +99,36 @@ def _rows_match(a, b, rel=1e-9) -> bool:
     return True
 
 
+LEGS = {
+    "base": {"PRESTO_TPU_DIRECT_JOIN": "0", "PRESTO_TPU_UNIQUE_DIRECT": "0"},
+    "csr": {"PRESTO_TPU_DIRECT_JOIN": "1", "PRESTO_TPU_UNIQUE_DIRECT": "0"},
+    "unique": {"PRESTO_TPU_DIRECT_JOIN": "0",
+               "PRESTO_TPU_UNIQUE_DIRECT": "1"},
+}
+
+
 def main() -> int:
     if os.environ.get("AB_MODE") == "child":
         print("AB_RESULT:" + json.dumps(_child(
-            os.environ["PRESTO_TPU_DIRECT_JOIN"])), flush=True)
+            os.environ.get("AB_LEG", "?"))), flush=True)
         return 0
 
     results = {}
-    for direct in ("0", "1"):
+    for leg, envs in LEGS.items():
         env = dict(os.environ)
-        env.update({"AB_MODE": "child", "PRESTO_TPU_DIRECT_JOIN": direct})
+        env.update({"AB_MODE": "child", "AB_LEG": leg})
+        env.update(envs)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
                 cwd=HERE, timeout=float(os.environ.get("AB_TIMEOUT", "1800")),
                 stdout=subprocess.PIPE, stderr=sys.stderr)
         except subprocess.TimeoutExpired:
-            print(f"direct={direct}: child timed out", file=sys.stderr)
+            print(f"{leg}: child timed out", file=sys.stderr)
             continue
         for line in proc.stdout.decode().splitlines():
             if line.startswith("AB_RESULT:"):
-                results[direct] = json.loads(line[len("AB_RESULT:"):])
+                results[leg] = json.loads(line[len("AB_RESULT:"):])
 
     out = {"query": "q3", "sf": float(os.environ.get("BENCH_SF", "1.0")),
            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
@@ -117,16 +138,21 @@ def main() -> int:
             stdout=subprocess.PIPE).stdout.decode().strip()
     except Exception:
         pass
-    if "0" in results and "1" in results:
-        out["off"] = results["0"]
-        out["on"] = results["1"]
-        out["results_match"] = _rows_match(
-            results["0"].pop("result_rows", []),
-            results["1"].pop("result_rows", []))
-        out["speedup_direct_on_vs_off"] = round(
-            results["1"]["rows_per_sec"] / results["0"]["rows_per_sec"], 3)
+    if "base" in results and len(results) > 1:
+        base_rows = results["base"].pop("result_rows", [])
+        out["results_match"] = all(
+            _rows_match(base_rows, results[k].pop("result_rows", []))
+            for k in results if k != "base")
+        base_rate = results["base"]["rows_per_sec"]
+        for k in results:
+            if k != "base":
+                out[f"speedup_{k}_vs_base"] = round(
+                    results[k]["rows_per_sec"] / base_rate, 3)
+        out["legs"] = results
     else:
-        out["partial"] = {k: v for k, v in results.items()}
+        for v in results.values():
+            v.pop("result_rows", None)
+        out["partial"] = results
     with open(OUT, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)
     print(json.dumps(out))
