@@ -18,18 +18,20 @@ to a real run, per BASELINE.md "must be self-measured").
 
 Robustness (hard-learned): the axon TPU tunnel's remote-compile service
 can die mid-run, hanging in-process jax calls indefinitely.  The parent
-therefore never imports jax; each QUERY runs in its own bounded-time
-child process, a dead backend is detected by timeout/UNAVAILABLE and
-the remaining TPU queries are skipped, and at least 45% of the wall
-budget is always reserved for the CPU fallback so a JSON line with a
-real measured number is emitted no matter what the tunnel does.
-Every successful on-device run is persisted to TPU_MEASURED.json
-(rates, timestamp, commit); when the tunnel is dead the cached rates
-are emitted as platform "tpu-cached" next to a fresh CPU measurement,
-so a dead tunnel degrades to "stale TPU + fresh CPU", never "no TPU".
+therefore never imports jax; the TPU measurement runs in ONE
+bounded-time child that loads data once, measures queries in
+cheapest-program-first order, and write-through-persists each rate to
+TPU_MEASURED.json the moment it is measured — so a child killed at its
+timeout still leaves every rate it reached (round-4 lesson: per-query
+children re-paid the ~82s load each and a timeout lost everything).
+A slice of the wall budget is always reserved for the CPU fallback
+(45% when the pinned baseline is missing, 15% otherwise) so a JSON
+line with a real measured number is emitted no matter what the tunnel
+does.  When the tunnel is dead the cached rates are emitted as
+platform "tpu-cached" next to a fresh CPU measurement, so a dead
+tunnel degrades to "stale TPU + fresh CPU", never "no TPU".
 
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 3),
-BENCH_TIMEOUT (per-child cap seconds, default 1200),
 BENCH_DEADLINE (overall seconds, default 3300).
 """
 
@@ -44,7 +46,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_FILE = os.path.join(HERE, "BASELINE_MEASURED.json")
 TPU_FILE = os.path.join(HERE, "TPU_MEASURED.json")
 
-QUERY_NAMES = ("q1", "q6", "q3", "q14")
+# Cheapest-program-first (CPU warmups: q6 1.3s, q14 3.9s, q1 6.0s,
+# q3 16.8s): through the tunnel a compile costs minutes, so the order
+# decides how much evidence a short up-window yields.  Combined with
+# in-child write-through (below), the first query's rate survives even
+# if the child dies compiling the second.
+QUERY_NAMES = ("q6", "q14", "q1", "q3")
 
 
 def log(*a):
@@ -54,6 +61,54 @@ def log(*a):
 # ----------------------------------------------------------------------
 # child mode: measure one query (or all) under a fixed platform
 # ----------------------------------------------------------------------
+
+def _merge_tpu_file(sf: float, platform: str, rates: dict, device: dict,
+                    run_id: str = "", commit: str = "") -> None:
+    """Atomic load-merge-save of TPU_MEASURED.json, shared by the
+    in-child write-through and the parent-side save.  ``run_id`` tags
+    this run's rates under "last_run" so a parent can recover fresh
+    partials from a timed-out child; ``commit`` stamps provenance."""
+    data = {}
+    if os.path.exists(TPU_FILE):
+        with open(TPU_FILE) as f:
+            data = json.load(f)
+    key = "sf%g" % sf
+    entry = data.get(key, {"rates": {}})
+    entry["platform"] = platform
+    entry.setdefault("rates", {}).update(
+        {k: round(v, 1) for k, v in rates.items()})
+    if device:
+        entry.setdefault("device", {}).update(device)
+    entry["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    if run_id:
+        entry["last_run"] = {
+            "run_id": run_id,
+            "rates": {k: round(v, 1) for k, v in rates.items()},
+            "device": dict(device or {}),
+        }
+    if commit:
+        entry["commit"] = commit
+    data[key] = entry
+    tmp = TPU_FILE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+    os.replace(tmp, TPU_FILE)
+
+
+def _write_through(sf: float, platform: str, rates: dict, device: dict) -> None:
+    """Persist on-device rates from INSIDE the measuring child, the
+    moment each query is measured (round-4 lesson: the q1 child died at
+    its timeout with three queries' worth of budget spent and zero
+    evidence persisted)."""
+    if platform == "cpu":
+        return
+    try:
+        _merge_tpu_file(sf, platform, rates, device,
+                        run_id=os.environ.get("BENCH_RUN_ID", ""))
+        log(f"write-through: {sorted(rates)} persisted")
+    except Exception as e:
+        log(f"write-through failed: {e}")
+
 
 def _measure(sf: float, iters: int, only: str) -> dict:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -126,8 +181,7 @@ def _measure(sf: float, iters: int, only: str) -> dict:
 
     from tests.tpch_queries import QUERIES  # the shared corpus
 
-    all_queries = {"q1": QUERIES[1], "q6": QUERIES[6], "q3": QUERIES[3],
-                   "q14": QUERIES[14]}
+    all_queries = {n: QUERIES[int(n[1:])] for n in QUERY_NAMES}
     if only == "ds":  # TPC-DS-only child (the TPU per-query path)
         bench_queries = {}
     elif only:
@@ -163,6 +217,7 @@ def _measure(sf: float, iters: int, only: str) -> dict:
             best = min(times)
             rates[name] = lineitem_rows / best
             log(f"{name}: best {best:.3f}s -> {rates[name]:.3e} lineitem rows/s")
+            _write_through(sf, platform, rates, device)
             # device-side attribution: same plan without the host
             # result-materialization tax (the ~74ms/read tunnel charge),
             # plus bytes-scanned / time vs the HBM roofline.  TPU-only
@@ -188,6 +243,7 @@ def _measure(sf: float, iters: int, only: str) -> dict:
                     "gbps": round(bytes_scanned.get(name, 0) / dt / 1e9, 2),
                 }
                 log(f"{name}: device {dt:.3f}s -> {device[name]['gbps']} GB/s")
+                _write_through(sf, platform, rates, device)
             except Exception as e:
                 log(f"{name}: device attribution failed: {e}")
         except Exception as e:  # keep going: partial evidence beats none
@@ -208,9 +264,12 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     # pinned-baseline comparison stays stable.  Skipped per-query, on
     # errors, and via BENCH_TPCDS=0.
     ds_deadline = float(os.environ.get("BENCH_CHILD_DEADLINE_TS", "0"))
+    # through the tunnel the DS load + 2 compiles cost far more than the
+    # CPU path's ~2.5 min — never let breadth threaten the headline
+    ds_margin = 150 if platform == "cpu" else 1200
     ds_ok = only in ("", "ds") and not errors \
         and os.environ.get("BENCH_TPCDS", "1") != "0" \
-        and (not ds_deadline or ds_deadline - time.time() > 150)
+        and (not ds_deadline or ds_deadline - time.time() > ds_margin)
     if ds_ok:
         try:
             out["tpcds_rates"] = _measure_tpcds(
@@ -301,29 +360,16 @@ def _save_tpu(result: dict) -> None:
     instead of silently degrading to CPU-only.  Keyed by scale factor;
     per-query rates merge so partial runs accumulate."""
     try:
-        data = {}
-        if os.path.exists(TPU_FILE):
-            with open(TPU_FILE) as f:
-                data = json.load(f)
-        key = "sf%g" % result["sf"]
-        entry = data.get(key, {"rates": {}})
-        entry["platform"] = result["platform"]
-        entry.setdefault("rates", {}).update(
-            {k: round(v, 1) for k, v in result["rates"].items()})
-        if result.get("device"):
-            entry.setdefault("device", {}).update(result["device"])
-        entry["measured_at"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        commit = ""
         try:
-            entry["commit"] = subprocess.run(
+            commit = subprocess.run(
                 ["git", "rev-parse", "--short", "HEAD"], cwd=HERE,
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             ).stdout.decode().strip()
         except Exception:
             pass
-        data[key] = entry
-        with open(TPU_FILE, "w") as f:
-            json.dump(data, f, indent=1, sort_keys=True)
+        _merge_tpu_file(result["sf"], result["platform"], result["rates"],
+                        result.get("device") or {}, commit=commit)
         log(f"tpu measurement persisted to {os.path.basename(TPU_FILE)}")
     except Exception as e:
         log(f"tpu measurement persist failed: {e}")
@@ -407,53 +453,57 @@ def _probe_backend(timeout: float) -> tuple:
         return False, False
 
 
-def _measure_tpu_per_query(sf, deadline, per_child_cap) -> dict:
-    """One child per query; a timeout/unreachable child skips the rest
-    (dead-tunnel fail-fast)."""
-    result = {"platform": None, "sf": sf, "rates": {}, "device": {}, "errors": {}}
-    for name in QUERY_NAMES:
-        # never eat into the CPU-fallback reserve (45% of total budget)
-        budget = _remaining(deadline) - 0.45 * deadline
-        timeout = min(per_child_cap, budget)
-        if timeout < 60:
-            log(f"tpu {name}: skipped, {budget:.0f}s tpu budget left")
-            break
-        try:
-            res = _run_child({}, timeout, only=name)
-        except subprocess.TimeoutExpired:
-            log(f"tpu {name}: child timed out after {timeout:.0f}s; "
-                "assuming backend dead, skipping remaining TPU queries")
-            result["errors"][name] = "timeout"
-            break
-        except Exception as e:
-            log(f"tpu {name}: {type(e).__name__}: {e}")
-            result["errors"][name] = str(e)
-            break
-        result["platform"] = res.get("platform")
-        result["rates"].update(res.get("rates", {}))
-        result["device"].update(res.get("device", {}))
-        result["errors"].update(res.get("errors", {}))
-        if res.get("tpcds_rates"):
-            result["tpcds_rates"] = res["tpcds_rates"]
-        if name == QUERY_NAMES[-1] and not result["errors"] \
-            and result.get("rates") and _remaining(deadline) > 240:
-            # headline captured: spend leftover budget on the TPC-DS
-            # breadth rates in their own bounded child
-            ds_budget = min(per_child_cap,
-                            _remaining(deadline) - 0.45 * deadline)
-            if ds_budget < 180:
-                continue  # never eat into the CPU-fallback reserve
-            try:
-                ds_res = _run_child({}, ds_budget, only="ds")
-                if ds_res.get("tpcds_rates"):
-                    result["tpcds_rates"] = ds_res["tpcds_rates"]
-            except Exception as e:
-                log(f"tpcds child failed: {type(e).__name__}: {e}")
-        if res.get("errors"):
-            break  # backend already reported unreachable inside the child
-        if result["platform"] == "cpu":
-            # default platform resolved to CPU: this IS the baseline run
-            break
+def _recover_last_run(sf: float, run_id: str) -> dict | None:
+    """Rates the timed-out child write-through-persisted THIS run."""
+    try:
+        with open(TPU_FILE) as f:
+            data = json.load(f)
+        entry = data.get("sf%g" % sf) or {}
+        last = entry.get("last_run") or {}
+        if last.get("run_id") == run_id and last.get("rates"):
+            return {
+                "platform": entry.get("platform", "tpu"), "sf": sf,
+                "rates": dict(last["rates"]),
+                "device": dict(last.get("device", {})),
+            }
+    except Exception as e:
+        log(f"last-run recovery failed: {e}")
+    return None
+
+
+def _measure_tpu(sf, deadline, cpu_reserve) -> dict | None:
+    """ONE child measures all queries cheapest-first, loading data once
+    and write-through-persisting each rate as it lands; a timeout
+    therefore still yields every query measured before the death
+    (round-4: four per-query children paid the ~82s load each and a
+    timeout lost everything)."""
+    budget = _remaining(deadline) - cpu_reserve * deadline
+    if budget < 60:
+        log(f"tpu: skipped, {budget:.0f}s budget left")
+        return None
+    run_id = "%d.%d" % (os.getpid(), time.time())
+    result = {"platform": None, "sf": sf, "rates": {},
+              "device": {}, "errors": {}}
+    try:
+        res = _run_child({"BENCH_RUN_ID": run_id}, budget)
+    except subprocess.TimeoutExpired:
+        log(f"tpu: child timed out after {budget:.0f}s; "
+            "recovering write-through partials")
+        rec = _recover_last_run(sf, run_id)
+        if rec is None:
+            result["errors"]["all"] = "timeout"
+            return result
+        rec["errors"] = {"partial": "child timeout"}
+        return rec
+    except Exception as e:
+        log(f"tpu child: {type(e).__name__}: {e}")
+        result["errors"]["all"] = str(e)
+        return result
+    for k in ("platform", "tpcds_rates"):
+        if res.get(k) is not None:
+            result[k] = res[k]
+    for k in ("rates", "device", "errors"):
+        result[k].update(res.get(k, {}))
     return result
 
 
@@ -466,15 +516,21 @@ def main():
         return
 
     sf = float(os.environ.get("BENCH_SF", "1.0"))
-    per_child_cap = float(os.environ.get("BENCH_TIMEOUT", "1200"))
     deadline = float(os.environ.get("BENCH_DEADLINE", "3300"))
+
+    # with a pinned baseline the CPU leg is only a fallback (the ratio
+    # denominator is already on disk), so nearly the whole budget can go
+    # to the TPU window; without one, reserve enough to self-measure it
+    baseline_all = _load_baselines()
+    have_baseline = bool((baseline_all.get("sf%g" % sf) or {}).get("rates"))
+    cpu_reserve = 0.15 if have_baseline else 0.45
 
     result = None
     ok, is_tpu = _probe_backend(
         timeout=min(120.0, max(_remaining(deadline) * 0.1, 30.0)))
     if ok and is_tpu:
-        result = _measure_tpu_per_query(sf, deadline, per_child_cap)
-        if not result.get("rates"):
+        result = _measure_tpu(sf, deadline, cpu_reserve)
+        if result is not None and not result.get("rates"):
             result = None
     elif ok:
         log("default backend resolved to CPU (tunnel down); "
@@ -496,7 +552,6 @@ def main():
 
     # ---- CPU measurement: fallback result and/or the baseline --------
     baseline = None
-    baseline_all = _load_baselines()
     entry = baseline_all.get("sf%g" % sf)
     if entry and entry.get("rates"):
         baseline = entry
@@ -525,9 +580,12 @@ def main():
             result = cpu_res
             baseline = baseline or cpu_res
 
-    qtag = "_".join(QUERY_NAMES)
+    # metric key keeps the historical q1_q6_q3_q14 order regardless of
+    # the execution order above, so the results series survives reorders
+    canon = [q for q in ("q1", "q6", "q3", "q14") if q in QUERY_NAMES]
+    qtag = "_".join(canon)
     if result is not None and result.get("rates"):
-        qtag = "_".join(q for q in QUERY_NAMES if q in result["rates"])
+        qtag = "_".join(q for q in canon if q in result["rates"])
     out = {
         "metric": "tpch_sf%g_%s_lineitem_rows_per_sec_geomean" % (sf, qtag),
         "value": 0.0,

@@ -36,6 +36,17 @@ class Connector(Protocol):
 
 
 @dataclasses.dataclass(frozen=True)
+class ViewDefinition:
+    """Stored view: original SQL + the creation-time session namespace
+    its unqualified table references re-bind against
+    (metadata/ViewDefinition.java: originalSql, catalog, schema)."""
+
+    sql: str
+    catalog: Optional[str] = None
+    schema: str = "default"
+
+
+@dataclasses.dataclass(frozen=True)
 class ColumnHandle:
     """Resolved column: position in the scan output + type + stats."""
 
@@ -72,20 +83,166 @@ class Catalog:
         # connector named in the qualified table name; flat namespace
         # here routes to a designated writable connector)
         self.write_connector: Optional[str] = None
+        # schema registry: catalog -> schema names.  Connector table
+        # namespaces stay flat; a table in schema s is physically named
+        # "s.t" there, and "default" holds the bare names (the reference
+        # keeps the triple in each connector's metastore —
+        # metadata/MetadataManager.java listSchemaNames).
+        self._schemas: Dict[str, set] = {}
+        # view registry: (catalog, schema, name) -> ViewDefinition.
+        # Views are engine-level metadata here (the reference persists
+        # them through ConnectorMetadata.createView; a single in-memory
+        # registry plays that role for every connector).
+        self._views: Dict[Tuple[str, str, str], "ViewDefinition"] = {}
 
     def register(self, name: str, connector, writable: bool = False) -> None:
         self._connectors[name] = connector
+        self._schemas.setdefault(name, {"default"})
         if writable or (self.write_connector is None and hasattr(connector, "create_table")):
             self.write_connector = name
+
+    # -- schemas -----------------------------------------------------------
+    def schemas(self, catalog: str) -> List[str]:
+        if catalog not in self._connectors:
+            raise KeyError(f"catalog not found: {catalog}")
+        return sorted(self._schemas.setdefault(catalog, {"default"}))
+
+    def has_schema(self, catalog: str, schema: str) -> bool:
+        return (catalog in self._connectors
+                and schema in self._schemas.setdefault(catalog, {"default"}))
+
+    def create_schema(self, catalog: str, schema: str,
+                      if_not_exists: bool = False) -> None:
+        if catalog not in self._connectors:
+            raise KeyError(f"catalog not found: {catalog}")
+        ss = self._schemas.setdefault(catalog, {"default"})
+        if schema in ss and not if_not_exists:
+            raise ValueError(f"schema already exists: {catalog}.{schema}")
+        ss.add(schema)
+
+    def schema_tables(self, catalog: str, schema: str) -> List[str]:
+        """Bare table names living in ``schema`` of ``catalog``."""
+        conn = self._connectors[catalog]
+        if schema == "default":
+            return [t for t in conn.table_names() if "." not in t]
+        pre = schema + "."
+        return [t[len(pre):] for t in conn.table_names() if t.startswith(pre)]
+
+    def drop_schema(self, catalog: str, schema: str, if_exists: bool = False,
+                    cascade: bool = False) -> None:
+        if schema == "default":
+            raise ValueError("cannot drop the default schema")
+        if not self.has_schema(catalog, schema):
+            if if_exists:
+                return
+            raise KeyError(f"schema not found: {catalog}.{schema}")
+        tables = self.schema_tables(catalog, schema)
+        views = [k for k in self._views if k[0] == catalog and k[1] == schema]
+        if (tables or views) and not cascade:
+            raise ValueError(
+                f"schema {catalog}.{schema} is not empty (use CASCADE)")
+        conn = self._connectors[catalog]
+        for t in tables:
+            conn.drop_table(f"{schema}.{t}")
+        for k in views:
+            del self._views[k]
+        self._schemas[catalog].discard(schema)
+
+    def rename_schema(self, catalog: str, schema: str, new_name: str) -> None:
+        if schema == "default" or new_name == "default":
+            raise ValueError("cannot rename to/from the default schema")
+        if not self.has_schema(catalog, schema):
+            raise KeyError(f"schema not found: {catalog}.{schema}")
+        if self.has_schema(catalog, new_name):
+            raise ValueError(f"schema already exists: {catalog}.{new_name}")
+        conn = self._connectors[catalog]
+        for t in self.schema_tables(catalog, schema):
+            conn.rename_table(f"{schema}.{t}", f"{new_name}.{t}")
+        for k in list(self._views):
+            if k[0] == catalog and k[1] == schema:
+                self._views[(catalog, new_name, k[2])] = self._views.pop(k)
+        ss = self._schemas[catalog]
+        ss.discard(schema)
+        ss.add(new_name)
+
+    # -- views -------------------------------------------------------------
+    def qualify(self, name: str, session=None) -> Tuple[str, str, str]:
+        """(catalog, schema, bare) for a possibly-qualified object name,
+        filling gaps from the session defaults (Session.getCatalog/
+        getSchema in the reference's MetadataUtil.createQualifiedObjectName)."""
+        parts = name.split(".")
+        s_cat = getattr(session, "catalog", None)
+        s_sch = getattr(session, "schema", None) or "default"
+        if len(parts) == 3:
+            return parts[0], parts[1], parts[2]
+        if len(parts) == 2:
+            if parts[0] in self._connectors:
+                return parts[0], "default", parts[1]
+            if s_cat is not None:  # schema-qualified under USE catalog
+                return s_cat, parts[0], parts[1]
+            return parts[0], "default", parts[1]
+        return s_cat or "$any", s_sch, parts[0]
+
+    def create_view(self, name: str, sql: str, session=None,
+                    replace: bool = False) -> None:
+        key = self.qualify(name, session)
+        if not replace and key in self._views:
+            raise ValueError(f"view already exists: {'.'.join(key)}")
+        self._views[key] = ViewDefinition(
+            sql=sql, catalog=getattr(session, "catalog", None),
+            schema=getattr(session, "schema", None) or "default")
+
+    def drop_view(self, name: str, session=None, if_exists: bool = False) -> None:
+        found = self.lookup_view(name, session)  # same fallback as SELECT
+        if found is None:
+            if if_exists:
+                return
+            raise KeyError(
+                f"view not found: {'.'.join(self.qualify(name, session))}")
+        del self._views[found[0]]
+
+    def lookup_view(self, name: str, session=None):
+        """(key, ViewDefinition) or None.  Only when the session has no
+        USE context does an unqualified name fall back to any-namespace
+        matching (mirroring the flat table search); under USE the
+        lookup is schema-scoped, so same-named views in other schemas
+        stay invisible."""
+        key = self.qualify(name, session)
+        v = self._views.get(key)
+        if v is None and "." not in name:
+            # the sessionless '$any' namespace is global: views created
+            # before any USE stay reachable (and droppable) afterwards
+            g = ("$any", "default", name)
+            if g in self._views:
+                key, v = g, self._views[g]
+            elif getattr(session, "catalog", None) is None:
+                for k, cand in self._views.items():
+                    if k[2] == name:
+                        key, v = k, cand
+                        break
+        return (key, v) if v is not None else None
+
+    def views_in(self, catalog: str, schema: str) -> List[str]:
+        return sorted(k[2] for k in self._views
+                      if k[0] == catalog and k[1] == schema)
 
     def connector(self, name: str):
         return self._connectors[name]
 
-    def resolve(self, table: str) -> TableHandle:
+    def resolve(self, table: str, session=None) -> TableHandle:
         """Find ``table`` in any registered connector, or resolve a
-        ``catalog.table`` qualified name against the named connector
-        (the reference's catalog.schema.table triple collapses to
-        catalog[.table] — there is a single default schema)."""
+        ``catalog[.schema].table`` qualified name against the named
+        connector.  A session's USE defaults are consulted first for
+        unqualified names: ``t`` under ``USE c.s`` means the physical
+        table ``s.t`` in connector ``c`` (non-default schemas store
+        tables schema-prefixed in the connector's flat namespace)."""
+        s_cat = getattr(session, "catalog", None)
+        s_sch = getattr(session, "schema", None)
+        if ("." not in table and s_cat in self._connectors and s_sch
+                and s_sch != "default"):
+            phys = f"{s_sch}.{table}"
+            if phys in self._connectors[s_cat].table_names():
+                table = f"{s_cat}.{phys}"
         items = self._connectors.items()
         if "." in table:
             cname, bare = table.split(".", 1)

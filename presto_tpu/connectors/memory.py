@@ -56,6 +56,56 @@ class MemoryConnector:
                   self._sort, self._bucketing, self._dicts):
             d.pop(name, None)
 
+    def add_column(self, name: str, column: str, ctype: Type) -> None:
+        """ALTER TABLE ADD COLUMN: existing rows read NULL in the new
+        column (MemoryMetadata.addColumn analog — the reference's
+        memory connector rejects this; hive-style NULL backfill here)."""
+        import jax.numpy as jnp
+
+        from presto_tpu.page import Block, Dictionary
+
+        if any(c == column for c, _ in self._schemas[name]):
+            raise ValueError(f"column {column} already exists in {name}")
+        self._schemas[name] = list(self._schemas[name]) + [(column, ctype)]
+        # dictionary-coded string columns get an empty dictionary so
+        # downstream decode paths stay total (raw_varchar/varbinary are
+        # value-carrying and take none)
+        dic = (Dictionary([])
+               if ctype.is_string and not ctype.is_raw_string else None)
+        if dic is not None:
+            self._dicts.setdefault(name, {})[column] = dic
+        new_pages = []
+        for p in self._tables[name]:
+            data = jnp.zeros((p.capacity,) + ctype.value_shape,
+                             dtype=ctype.np_dtype)
+            blk = Block(data, jnp.zeros((p.capacity,), dtype=jnp.bool_),
+                        ctype, dic)
+            new_pages.append(Page(tuple(p.blocks) + (blk,), p.row_mask))
+        self._tables[name] = new_pages
+
+    def drop_column(self, name: str, column: str) -> None:
+        idxs = [i for i, (c, _) in enumerate(self._schemas[name])
+                if c != column]
+        if len(idxs) == len(self._schemas[name]):
+            raise ValueError(f"column {column} not found in {name}")
+        if not idxs:
+            raise ValueError("cannot drop the only column")
+        self._schemas[name] = [self._schemas[name][i] for i in idxs]
+        self._tables[name] = [
+            Page(tuple(p.blocks[i] for i in idxs), p.row_mask)
+            for p in self._tables[name]
+        ]
+        self._domains.get(name, {}).pop(column, None)
+        self._dicts.get(name, {}).pop(column, None)
+        # planner metadata referencing the dropped column is void
+        if self._pks.get(name) and column in self._pks[name]:
+            self._pks[name] = None
+        if self._sort.get(name) and column in self._sort[name]:
+            self._sort[name] = None
+        bk = self._bucketing.get(name)
+        if bk is not None and column in bk[0]:
+            self._bucketing[name] = None
+
     def rename_table(self, name: str, new_name: str) -> None:
         if new_name in self._tables:
             raise ValueError(f"table {new_name} already exists")

@@ -170,6 +170,125 @@ def _split_pruned(constraints, stats) -> bool:
     return td.is_none or not td.overlaps_split_stats(stats)
 
 
+@jax.jit
+def _extent_live(mask):
+    """(highest live index + 1, live count) of a row mask, as one
+    2-element device array so the host pays a single transfer."""
+    idx = jnp.arange(mask.shape[0], dtype=jnp.int32)
+    extent = jnp.max(jnp.where(mask, idx, -1)) + 1
+    return jnp.stack([extent, jnp.sum(mask.astype(jnp.int32))])
+
+
+class _AggFoldTower:
+    """Binary-counter (LSM-style) fold of partial aggregation pages.
+
+    The round-4 running fold concatenated every partial page onto a
+    full-capacity accumulator and re-sorted ~2*max_groups keys per
+    split; at SF10 that made Q3's aggregation tail ~57x slower for 10x
+    data.  Two fixes compose here:
+
+    - each incoming partial page is sliced to the power-of-two bucket
+      just above its live extent (sort-path partials arrive
+      front-compacted, and extent-based slicing is safe even for the
+      packed-direct layout), so merge sizes track the data rather than
+      the planner's conservative ``max_groups``; and
+    - pages merge in a binary-counter tower — one slot per capacity,
+      a carry merges equal-capacity pages — so every group takes part
+      in O(log splits) merges instead of one full-capacity re-sort per
+      split.  This is the sorted-run analog of the reference's
+      incremental hash builder, which pays O(1) hash updates per row
+      (operator/aggregation/builder/InMemoryHashAggregationBuilder.java,
+      MultiChannelGroupByHash.java:138-145).
+
+    Truncation safety: a merge's output capacity is the pow2 bound of
+    its inputs' combined live counts clamped to ``max_groups``; a clamp
+    that truncates leaves ``max_groups`` live rows in the output, which
+    the caller's overflow check sees and retries doubled — the same
+    detect-and-retry contract as the round-4 fold.
+    """
+
+    MIN_CAP = 1 << 10
+
+    def __init__(self, runner, node, num_keys, aggs, kd, mg, account=True):
+        self.runner = runner
+        self.node = node
+        self.mg = mg
+        self.account = account
+        self.levels: Dict[int, tuple] = {}  # capacity -> (page, live, tag)
+        cache_key = (node, "tower")
+        fns = runner._fold_cache.get(cache_key)
+        if fns is None:
+            def fold(pages, out_cap):
+                return merge_aggregate(
+                    concat_pages_device(list(pages)), num_keys, list(aggs),
+                    out_cap, key_domains=kd, mode="partial",
+                    return_count=True)
+
+            def final(pages, out_cap):
+                return merge_aggregate(
+                    concat_pages_device(list(pages)), num_keys, list(aggs),
+                    out_cap, key_domains=kd, mode="single")
+
+            if runner.jit:
+                fold = jax.jit(fold, static_argnames=("out_cap",))
+                final = jax.jit(final, static_argnames=("out_cap",))
+            runner._fold_cache[cache_key] = (fold, final)
+            fns = (fold, final)
+        self.fold, self.final = fns
+
+    def _cap(self, n: int) -> int:
+        """Merge OUTPUT capacity: pow2 bound clamped to max_groups (a
+        clamp that truncates is caught by the caller's overflow check)."""
+        return min(self.mg, max(self.MIN_CAP,
+                                1 << max(0, int(n) - 1).bit_length()))
+
+    def _slice_cap(self, extent: int) -> int:
+        """Input-slice capacity: pow2 bound of the live EXTENT, never
+        clamped — an input page may be wider than max_groups (e.g. a
+        concat of K worker partials at the coordinator merge) and
+        slicing below its extent would silently drop live states."""
+        return max(self.MIN_CAP, 1 << max(0, int(extent) - 1).bit_length())
+
+    def _reserve(self, page):
+        if not self.account or self.runner._mem is None:
+            return None
+        from presto_tpu.memory import page_bytes
+
+        return self.runner._mem.reserve(
+            f"agg_accumulator@{id(self.node)}", page_bytes(page))
+
+    def add(self, page: Page) -> None:
+        el = np.asarray(_extent_live(page.row_mask))
+        extent, live = int(el[0]), int(el[1])
+        cap = self._slice_cap(extent)
+        if page.capacity > cap:
+            page = slice_page(page, cap)
+        mem = self.runner._mem if self.account else None
+        tag = self._reserve(page)
+        cap = page.capacity
+        while cap in self.levels:
+            o_page, o_live, o_tag = self.levels.pop(cap)
+            out_cap = self._cap(live + o_live)
+            page, cnt = self.fold([o_page, page], out_cap=out_cap)
+            live = min(int(np.asarray(cnt)), out_cap)
+            if mem is not None:
+                mem.free(tag)
+                mem.free(o_tag)
+            tag = self._reserve(page)
+            cap = page.capacity
+        self.levels[cap] = (page, live, tag)
+
+    def finish_single(self) -> Optional[Page]:
+        """One mode='single' merge over the surviving level pages,
+        largest first (deterministic program signature)."""
+        if not self.levels:
+            return None
+        entries = sorted(self.levels.values(), key=lambda e: -e[0].capacity)
+        pages = [e[0] for e in entries]
+        out_cap = self._cap(sum(e[1] for e in entries))
+        return self.final(pages, out_cap=out_cap)
+
+
 def _probe_with_retry(probe_fn, build, page):
     """One expanding probe with the pow2 capacity retry shared by the
     in-HBM and spilled join paths (yielding LookupJoinPageBuilder
@@ -1143,8 +1262,12 @@ class LocalRunner:
         # doubling below recovers skewed buckets
         cap0 = max(1 << 10, min(self._max_groups(node), SPILL_GROUP_THRESHOLD) // K)
 
-        def fold_bucket(pages: List[HostPage], cap: int):
-            acc: Optional[Page] = None
+        def fold_bucket(pages: List[HostPage], cap: int) -> Page:
+            # tower fold with live-extent compaction (same machinery as
+            # the in-memory path; account=False — spill state must not
+            # re-trip the pool it is relieving)
+            tower = _AggFoldTower(self, node, num_keys, aggs, kd, cap,
+                                  account=False)
             for hp in pages:
                 p = hp.rehydrate()
                 if partial_input:
@@ -1152,10 +1275,8 @@ class LocalRunner:
                 else:
                     pp = grouped_aggregate(p, group_exprs, aggs, cap,
                                            key_domains=kd, mode="partial")
-                cand = pp if acc is None else concat_pages_device([acc, pp])
-                acc = merge_aggregate(cand, num_keys, aggs, cap,
-                                      key_domains=kd, mode="partial")
-            return acc
+                tower.add(pp)
+            return tower.finish_single()
 
         outs: List[Page] = []
         for k in range(K):
@@ -1163,16 +1284,17 @@ class LocalRunner:
                 continue
             cap = cap0
             while True:
-                acc = fold_bucket(buckets[k], cap)
-                out = merge_aggregate(acc, num_keys, aggs, cap,
-                                      key_domains=kd, mode="single")
+                out = fold_bucket(buckets[k], cap)
+                if out is None:  # every page in the bucket was all-dead
+                    break
                 live = int(np.asarray(jnp.sum(out.row_mask.astype(jnp.int32))))
                 if live < cap or cap >= MAX_AGG_GROUPS:
                     break
                 cap *= 2
             # bucket outputs are result stream, not operator state — not
             # charged against the pool (the whole point of the spill)
-            outs.append(out)
+            if out is not None:
+                outs.append(out)
         if not outs:
             out = Page.empty(node.output_types, max(cap0, 1))
             return self._groupid_empty_fixup(node, out)
@@ -1209,6 +1331,21 @@ class LocalRunner:
             self._agg_overrides[partial] = mg
             source = partial
 
+        if node.group_exprs and not self._exact_capacity(node, mg):
+            # sort-path partials: live-extent compaction + tower merge
+            tower = _AggFoldTower(self, node, num_keys, aggs, kd, mg)
+            for p in self._pages(source):
+                tower.add(p)
+            out = tower.finish_single()
+            if out is None:
+                return self._groupid_empty_fixup(
+                    node, Page.empty(node.output_types, max(mg, 1)))
+            self._check_overflow(node, out, mg)
+            return self._groupid_empty_fixup(node, out)
+
+        # global aggregation and exact-capacity (packed-direct) partials:
+        # fixed-capacity running fold — pages are already as tight as the
+        # key domain allows, so compaction buys nothing
         def fold(acc: Optional[Page], p: Page) -> Page:
             cand = p if acc is None else concat_pages_device([acc, p])
             return merge_aggregate(cand, num_keys, aggs, mg, key_domains=kd, mode="partial")

@@ -93,6 +93,11 @@ class QueryRunner:
         # PREPARE name FROM <query> registry (StatementResource's
         # prepared-statement session map analog)
         self._prepared = {}
+        # CALL registry (ProcedureRegistry.java); kill_query ships
+        # built-in like the reference's KillQueryProcedure
+        self.procedures = {
+            "system.runtime.kill_query": self._kill_query_procedure,
+        }
         self.executor = self._make_executor()
         # plan cache: repeated executions of the same SQL reuse the same
         # plan-node identities, so the executor's compiled-chain caches
@@ -403,8 +408,7 @@ class QueryRunner:
                 [VARCHAR, DOUBLE, VARCHAR, VARCHAR, DOUBLE], rows)
 
         if isinstance(stmt, ast.Describe):
-            handle = self.catalog.resolve(stmt.table)
-            rows = [(c.name, repr(c.type)) for c in handle.columns]
+            rows = self._columns_of(stmt.table)
             return MaterializedResult(["column", "type"], [VARCHAR, VARCHAR], rows)
 
         if isinstance(stmt, ast.Delete):
@@ -412,18 +416,188 @@ class QueryRunner:
 
         if isinstance(stmt, ast.ShowTables):
             names = sorted(
-                t
-                for cname in self.catalog._connectors
-                for t in self.catalog.connector(cname).table_names()
+                set(
+                    t
+                    for cname in self.catalog._connectors
+                    for t in self.catalog.connector(cname).table_names()
+                )
+                | {k[2] for k in self.catalog._views}  # views list too
             )
             return MaterializedResult(["table"], [VARCHAR], [(n,) for n in names])
 
         if isinstance(stmt, ast.ShowColumns):
-            handle = self.catalog.resolve(stmt.table)
-            rows = [(c.name, repr(c.type)) for c in handle.columns]
+            rows = self._columns_of(stmt.table)
             return MaterializedResult(["column", "type"], [VARCHAR, VARCHAR], rows)
 
+        if isinstance(stmt, ast.Use):
+            cat = stmt.catalog or self.session.catalog
+            if cat is None:
+                raise ValueError("USE schema requires a current catalog "
+                                 "(USE catalog.schema)")
+            if cat not in self.catalog._connectors:
+                raise ValueError(f"catalog not found: {cat}")
+            if not self.catalog.has_schema(cat, stmt.schema):
+                raise ValueError(f"schema not found: {cat}.{stmt.schema}")
+            self.session.catalog = cat
+            self.session.schema = stmt.schema
+            self._invalidate_plans()  # name resolution changed
+            return MaterializedResult(["result"], [VARCHAR], [("USE",)])
+
+        if isinstance(stmt, ast.CreateView):
+            # bind now so a broken view fails at CREATE, store the text
+            # (CreateViewTask.java:44 analyzes the view statement first)
+            self.binder.plan(stmt.sql)
+            try:
+                self.catalog.resolve(stmt.name, session=self.session)
+                raise ValueError(
+                    f"a table with that name already exists: {stmt.name}")
+            except KeyError:
+                pass
+            self.access_control.check_can_write(
+                self.session.user, stmt.name.split(".")[-1])
+            self.catalog.create_view(stmt.name, stmt.sql,
+                                     session=self.session,
+                                     replace=stmt.replace)
+            self._invalidate_plans()
+            return MaterializedResult(["result"], [VARCHAR], [("CREATE VIEW",)])
+
+        if isinstance(stmt, ast.DropView):
+            self.access_control.check_can_write(
+                self.session.user, stmt.name.split(".")[-1])
+            self.catalog.drop_view(stmt.name, session=self.session,
+                                   if_exists=stmt.if_exists)
+            self._invalidate_plans()
+            return MaterializedResult(["result"], [VARCHAR], [("DROP VIEW",)])
+
+        if isinstance(stmt, ast.CreateSchema):
+            cat = stmt.catalog or self.session.catalog
+            if cat is None:
+                raise ValueError("CREATE SCHEMA requires a catalog")
+            self.catalog.create_schema(cat, stmt.name,
+                                       if_not_exists=stmt.if_not_exists)
+            return MaterializedResult(["result"], [VARCHAR],
+                                      [("CREATE SCHEMA",)])
+
+        if isinstance(stmt, ast.DropSchema):
+            cat = stmt.catalog or self.session.catalog
+            if cat is None:
+                raise ValueError("DROP SCHEMA requires a catalog")
+            self.catalog.drop_schema(cat, stmt.name,
+                                     if_exists=stmt.if_exists,
+                                     cascade=stmt.cascade)
+            self._invalidate_plans()
+            return MaterializedResult(["result"], [VARCHAR], [("DROP SCHEMA",)])
+
+        if isinstance(stmt, ast.RenameSchema):
+            cat = stmt.catalog or self.session.catalog
+            if cat is None:
+                raise ValueError("ALTER SCHEMA requires a catalog")
+            self.catalog.rename_schema(cat, stmt.name, stmt.new_name)
+            if self.session.catalog == cat and self.session.schema == stmt.name:
+                self.session.schema = stmt.new_name
+            self._invalidate_plans()
+            return MaterializedResult(["result"], [VARCHAR], [("ALTER SCHEMA",)])
+
+        if isinstance(stmt, ast.ShowSchemas):
+            cat = stmt.catalog or self.session.catalog
+            if cat is not None:
+                rows = [(s,) for s in self.catalog.schemas(cat)]
+            else:  # no catalog context: union over catalogs
+                seen = sorted({s for c in self.catalog._connectors
+                               for s in self.catalog.schemas(c)})
+                rows = [(s,) for s in seen]
+            return MaterializedResult(["Schema"], [VARCHAR], rows)
+
+        if isinstance(stmt, (ast.AddColumn, ast.DropColumn)):
+            handle = self.catalog.resolve(stmt.table, session=self.session)
+            self.access_control.check_can_write(self.session.user,
+                                                handle.table.split(".")[-1])
+            conn = self.catalog.connector(handle.connector_name)
+            self._check_tx_writable(handle.connector_name, conn)
+            if isinstance(stmt, ast.AddColumn):
+                if not hasattr(conn, "add_column"):
+                    raise ValueError(
+                        f"connector {handle.connector_name} does not "
+                        "support ADD COLUMN")
+                from presto_tpu.types import parse_type
+
+                conn.add_column(handle.table, stmt.column,
+                                parse_type(stmt.type_name))
+                msg = "ADD COLUMN"
+            else:
+                if not hasattr(conn, "drop_column"):
+                    raise ValueError(
+                        f"connector {handle.connector_name} does not "
+                        "support DROP COLUMN")
+                conn.drop_column(handle.table, stmt.column)
+                msg = "DROP COLUMN"
+            self._invalidate_plans()
+            return MaterializedResult(["result"], [VARCHAR], [(msg,)])
+
+        if isinstance(stmt, ast.Call):
+            return self._call_procedure(stmt)
+
         raise ValueError(f"unsupported statement {stmt!r}")
+
+    def _columns_of(self, name: str):
+        """(column, type) rows for a table OR a view (views bind their
+        stored SQL to recover the projected shape — ShowColumnsRewrite
+        consults metadata.getView the same way)."""
+        view = self.catalog.lookup_view(name, self.session)
+        if view is not None:
+            # bind under the view's creation-time namespace, exactly
+            # like the binder's reference-time expansion
+            vdef = view[1]
+            saved = (self.session.catalog, self.session.schema)
+            self.session.catalog = vdef.catalog
+            self.session.schema = vdef.schema
+            try:
+                plan = self.binder.plan(vdef.sql)
+            finally:
+                self.session.catalog, self.session.schema = saved
+            return [(n, repr(t))
+                    for n, t in zip(plan.output_names, plan.output_types)]
+        handle = self.catalog.resolve(name, session=self.session)
+        return [(c.name, repr(c.type)) for c in handle.columns]
+
+    def _call_procedure(self, stmt: ast.Call) -> MaterializedResult:
+        """CALL proc(literal args) via the procedure registry
+        (spi/procedure/Procedure.java + execution/CallTask.java:60 —
+        kill_query ships as a procedure there too)."""
+        proc = self.procedures.get(stmt.name.lower())
+        if proc is None:
+            raise ValueError(f"procedure not registered: {stmt.name}")
+
+        def lit(node):
+            if isinstance(node, ast.StringLit):
+                return node.value
+            if isinstance(node, ast.NumberLit):
+                v = node.text
+                return float(v) if ("." in v or "e" in v.lower()) else int(v)
+            if isinstance(node, ast.NullLit):
+                return None
+            if isinstance(node, ast.Unary) and node.op == "-":
+                return -lit(node.operand)
+            raise ValueError("CALL arguments must be literals")
+
+        out = proc(self.session, *[lit(a) for a in stmt.args])
+        return MaterializedResult(["result"], [VARCHAR],
+                                  [(out if out is not None else "CALL",)])
+
+    def register_procedure(self, name: str, fn) -> None:
+        """Connector/plugin procedure registration
+        (spi/procedure/Procedure.java)."""
+        self.procedures[name.lower()] = fn
+
+    def _kill_query_procedure(self, session, query_id, message=None):
+        """system.runtime.kill_query(query_id[, message]): fail the
+        query's future memory reservations (the in-process analog of
+        KillQueryProcedure.java — the coordinator overrides this with
+        its query-manager kill)."""
+        if self.memory_pool is None:
+            raise ValueError("no memory pool; kill_query unavailable")
+        freed = self.memory_pool.kill_query(str(query_id))
+        return f"killed {query_id} (freed {freed} bytes)"
 
     def _write(self, stmt, query_id=None) -> MaterializedResult:
         """CTAS / INSERT (TableWriterOperator + TableFinishOperator
@@ -444,6 +618,9 @@ class QueryRunner:
         # READ ONLY transaction / non-transactional connector rejects
         # without burning device time on the doomed SELECT
         if isinstance(stmt, ast.CreateTableAs):
+            if self.catalog.lookup_view(stmt.name, self.session) is not None:
+                raise ValueError(
+                    f"a view with that name already exists: {stmt.name}")
             cname, table = self._write_target(stmt.name)
             conn = self.catalog.connector(cname)
         else:
@@ -551,12 +728,19 @@ class QueryRunner:
         return MaterializedResult(["rows"], [BIGINT], [(before - after,)])
 
     def _write_target(self, name: str):
-        """(connector, bare table) for a CTAS target: a 'catalog.table'
-        prefix routes to that connector, else the default writable one."""
+        """(connector, physical table) for a CTAS target: a
+        'catalog.table' prefix routes to that connector, else the USE
+        defaults apply (non-default schema prefixes the physical name),
+        else the default writable one."""
         if "." in name:
             cname, bare = name.split(".", 1)
             if cname in self.catalog._connectors:
                 return cname, bare
+        s_cat, s_sch = self.session.catalog, self.session.schema
+        if ("." not in name and s_cat in self.catalog._connectors
+                and hasattr(self.catalog.connector(s_cat), "create_table")):
+            return s_cat, (name if s_sch in (None, "default")
+                           else f"{s_sch}.{name}")
         if self.catalog.write_connector is None:
             raise ValueError("no writable connector registered")
         return self.catalog.write_connector, name

@@ -99,6 +99,10 @@ class Session:
         # request-correlation token (X-Presto-Trace-Token analog); one
         # is generated per query when the client supplies none
         self.trace_token = trace_token
+        # USE state (Session.java catalog/schema; execution/UseTask.java
+        # mutates these): unqualified names resolve against them first
+        self.catalog: Optional[str] = None
+        self.schema: str = "default"
 
     def get(self, name: str) -> Any:
         return self.properties[name]

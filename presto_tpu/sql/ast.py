@@ -411,6 +411,99 @@ class ShowCreateTable(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW v AS query
+    (sql/tree/CreateView.java + execution/CreateViewTask.java:44).
+    ``sql`` keeps the original query text: views are stored as SQL and
+    re-bound at reference time (analyzer/StatementAnalyzer.java:789)."""
+
+    name: str = ""
+    sql: str = ""
+    replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropView(Node):
+    """DROP VIEW [IF EXISTS] v (sql/tree/DropView.java)."""
+
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Use(Node):
+    """USE [catalog.]schema (sql/tree/Use.java +
+    execution/UseTask.java:33)."""
+
+    catalog: Optional[str] = None
+    schema: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateSchema(Node):
+    """CREATE SCHEMA [IF NOT EXISTS] [catalog.]name
+    (execution/CreateSchemaTask.java:38)."""
+
+    catalog: Optional[str] = None
+    name: str = ""
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropSchema(Node):
+    """DROP SCHEMA [IF EXISTS] [catalog.]name [RESTRICT|CASCADE]
+    (execution/DropSchemaTask.java)."""
+
+    catalog: Optional[str] = None
+    name: str = ""
+    if_exists: bool = False
+    cascade: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RenameSchema(Node):
+    """ALTER SCHEMA [catalog.]a RENAME TO b
+    (execution/RenameSchemaTask.java)."""
+
+    catalog: Optional[str] = None
+    name: str = ""
+    new_name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class AddColumn(Node):
+    """ALTER TABLE t ADD COLUMN c type (execution/AddColumnTask.java)."""
+
+    table: str = ""
+    column: str = ""
+    type_name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class DropColumn(Node):
+    """ALTER TABLE t DROP COLUMN c (execution/DropColumnTask.java)."""
+
+    table: str = ""
+    column: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Node):
+    """CALL proc(arg, ...) (sql/tree/Call.java +
+    execution/CallTask.java:60; args are literal expressions)."""
+
+    name: str = ""
+    args: Tuple["Node", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSchemas(Node):
+    """SHOW SCHEMAS [FROM catalog] (sql/tree/ShowSchemas.java)."""
+
+    catalog: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowStats(Node):
     """SHOW STATS FOR t (sql/tree/ShowStats.java)."""
 

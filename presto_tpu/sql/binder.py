@@ -544,6 +544,9 @@ class Binder:
         self._from_unnests: List[ast.Unnest] = []
         # in-scope CTE definitions (WITH name AS (...)): name -> query ast
         self._ctes: Dict[str, ast.Node] = {}
+        # views currently being expanded (cycle detection, the
+        # reference's StatementAnalyzer.analyzeView recursion guard)
+        self._view_stack: List[tuple] = []
         # the statement's single now() instant (reset per plan_ast)
         self._now: Optional[float] = None
         # lambda parameter scopes (innermost last): name -> LambdaVar
@@ -776,7 +779,43 @@ class Binder:
                     [ScopeCol(qual, n, c) for n, c in zip(names, node.channels)]
                 )
                 return node, scope
-            handle = self.catalog.resolve(rel.name)
+            view = self.catalog.lookup_view(rel.name, self.session) \
+                if hasattr(self.catalog, "lookup_view") else None
+            if view is not None:
+                # view expansion: re-parse and re-bind the stored SQL
+                # under the view's own creation-time namespace
+                # (StatementAnalyzer.java:789 via metadata.getView)
+                key, vdef = view
+                if key in self._view_stack:
+                    raise BindError(
+                        "view is recursive: " + ".".join(key))
+                if getattr(rel, "sample", None) is not None:
+                    # the sample clause rides TableScanNode; silently
+                    # scanning 100% of an expanded view would be a
+                    # wrong result, so reject loudly
+                    raise BindError("TABLESAMPLE over a view is not supported")
+                from presto_tpu.sql.parser import parse_query
+
+                saved = None
+                if self.session is not None:
+                    saved = (self.session.catalog, self.session.schema)
+                    self.session.catalog = vdef.catalog
+                    self.session.schema = vdef.schema
+                self._view_stack.append(key)
+                saved_ctes, self._ctes = self._ctes, {}
+                try:
+                    node, names = self._plan_query_like(parse_query(vdef.sql))
+                finally:
+                    self._view_stack.pop()
+                    self._ctes = saved_ctes
+                    if saved is not None:
+                        self.session.catalog, self.session.schema = saved
+                qual = rel.alias or rel.name.split(".")[-1]
+                scope = Scope(
+                    [ScopeCol(qual, n, c) for n, c in zip(names, node.channels)]
+                )
+                return node, scope
+            handle = self.catalog.resolve(rel.name, session=self.session)
             scan = TableScanNode(handle, list(range(len(handle.columns))),
                                  sample=getattr(rel, "sample", None))
             # a catalog-qualified name aliases to its bare table name

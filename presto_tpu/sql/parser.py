@@ -80,6 +80,7 @@ _NON_ALIAS_WORDS = {"intersect", "except", "tablesample"}
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql  # original text (views store their query verbatim)
         self.tokens = tokenize(sql)
         self.i = 0
         self.n_params = 0  # ? placeholders seen (PREPARE/EXECUTE)
@@ -197,6 +198,26 @@ class Parser:
                           order_by=order_by, limit=limit)
 
     def _select_query(self) -> ast.Query:
+        if self.tok.kind == "ident" and self.tok.value.lower() == "values":
+            # VALUES as a query term (SqlBase.g4:89 queryPrimary):
+            # planned as SELECT * over the VALUES relation
+            rel = self._relation_primary()
+            order_by: Tuple[ast.OrderItem, ...] = ()
+            if self.accept("order"):
+                self.expect("by")
+                o = [self._order_item()]
+                while self.accept(","):
+                    o.append(self._order_item())
+                order_by = tuple(o)
+            limit = None
+            if self.accept("limit"):
+                t = self.tok
+                if t.kind != "number":
+                    raise SyntaxError(f"expected number after LIMIT, got {t!r}")
+                self.i += 1
+                limit = int(t.value)
+            return ast.Query(select=(ast.SelectItem(ast.Star(None)),),
+                             from_=(rel,), order_by=order_by, limit=limit)
         self.expect("select")
         distinct = bool(self.accept("distinct"))
         self.accept("all")
@@ -376,11 +397,15 @@ class Parser:
             self.i += 1
             rows = []
             while True:
-                self.expect("(")
-                row = [self._expr()]
-                while self.accept(","):
-                    row.append(self._expr())
-                self.expect(")")
+                if self.accept("("):
+                    row = [self._expr()]
+                    while self.accept(","):
+                        row.append(self._expr())
+                    self.expect(")")
+                else:
+                    # bare single-column row: VALUES 1, 2 (SqlBase.g4:145
+                    # rowValue := expression | '(' expression... ')')
+                    row = [self._expr()]
                 rows.append(tuple(row))
                 if not self.accept(","):
                     break
@@ -928,6 +953,22 @@ def parse_statement(sql: str) -> ast.Node:
         p.accept(";")
         return ast.SetSession(name, value)
     if p.accept("create"):
+        if p.accept_word("or"):
+            if p.accept_word("replace") is None:
+                raise SyntaxError("expected REPLACE after CREATE OR")
+            if p.accept_word("view") is None:
+                raise SyntaxError("expected VIEW after CREATE OR REPLACE")
+            return _create_view(p, replace=True)
+        if p.accept_word("view"):
+            return _create_view(p, replace=False)
+        if p.accept_word("schema"):
+            if_not_exists = False
+            if p.accept_word("if"):
+                p.expect("not")
+                p.expect("exists")
+                if_not_exists = True
+            cat, name = _schema_name(p)
+            return _finish(p, ast.CreateSchema(cat, name, if_not_exists))
         p.expect("table")
         name = _qualified_name(p)
         props = []
@@ -962,6 +1003,22 @@ def parse_statement(sql: str) -> ast.Node:
         q = p._query()
         return _finish(p, ast.InsertInto(name, q))
     if p.accept("drop"):
+        if p.accept_word("view"):
+            if_exists = False
+            if p.accept_word("if"):
+                p.expect("exists")
+                if_exists = True
+            return _finish(p, ast.DropView(_qualified_name(p), if_exists))
+        if p.accept_word("schema"):
+            if_exists = False
+            if p.accept_word("if"):
+                p.expect("exists")
+                if_exists = True
+            cat, name = _schema_name(p)
+            cascade = p.accept_word("cascade") is not None
+            if not cascade:
+                p.accept_word("restrict")
+            return _finish(p, ast.DropSchema(cat, name, if_exists, cascade))
         p.expect("table")
         name = _qualified_name(p)
         return _finish(p, ast.DropTable(name))
@@ -992,10 +1049,25 @@ def parse_statement(sql: str) -> ast.Node:
         cls = ast.Grant if is_grant else ast.Revoke
         return _finish(p, cls(tuple(privs), table, grantee))
     if p.accept_word("alter"):
+        if p.accept_word("schema"):
+            cat, name = _schema_name(p)
+            if p.accept_word("rename") is None or p.accept_word("to") is None:
+                raise SyntaxError("expected RENAME TO after ALTER SCHEMA")
+            _, new_name = _schema_name(p)
+            return _finish(p, ast.RenameSchema(cat, name, new_name))
         p.expect("table")
         name = _qualified_name(p)
+        if p.accept_word("add"):
+            p.accept_word("column")
+            col = p.ident()
+            type_name = _type_text(p)
+            return _finish(p, ast.AddColumn(name, col, type_name))
+        if p.accept("drop"):
+            p.accept_word("column")
+            return _finish(p, ast.DropColumn(name, p.ident()))
         if p.accept_word("rename") is None:
-            raise SyntaxError("only ALTER TABLE ... RENAME TO supported")
+            raise SyntaxError(
+                "ALTER TABLE supports RENAME TO / ADD COLUMN / DROP COLUMN")
         if p.accept_word("to") is None:
             raise SyntaxError("expected TO")
         new_name = _qualified_name(p)
@@ -1043,7 +1115,10 @@ def parse_statement(sql: str) -> ast.Node:
         if p.accept_word("functions"):
             return _finish(p, ast.ShowFunctions())
         if p.accept_word("schemas"):
-            return _finish(p, ast.ShowCatalogs())  # schema == catalog here
+            cat = None
+            if p.accept("from") or p.accept_word("in"):
+                cat = p.ident()
+            return _finish(p, ast.ShowSchemas(cat))
         p.expect("columns")
         p.expect("from")
         table = _qualified_name(p)
@@ -1071,7 +1146,72 @@ def parse_statement(sql: str) -> ast.Node:
     if p.accept_word("deallocate"):
         p.accept_word("prepare")
         return _finish(p, ast.Deallocate(p.ident()))
+    if p.accept_word("use"):
+        name = _qualified_name(p)
+        parts = name.split(".")
+        if len(parts) == 1:
+            return _finish(p, ast.Use(None, parts[0]))
+        if len(parts) == 2:
+            return _finish(p, ast.Use(parts[0], parts[1]))
+        raise SyntaxError("USE takes [catalog.]schema")
+    if p.accept_word("call"):
+        name = _qualified_name(p)
+        p.expect("(")
+        args = []
+        if not p.accept(")"):
+            args.append(p._expr())
+            while p.accept(","):
+                args.append(p._expr())
+            p.expect(")")
+        return _finish(p, ast.Call(name, tuple(args)))
     return p.parse_query()
+
+
+def _create_view(p: Parser, replace: bool) -> ast.Node:
+    """CREATE [OR REPLACE] VIEW v AS query — the query's original TEXT
+    is what gets stored (views re-bind at reference time, the way
+    metadata.createView persists ViewDefinition JSON with the SQL)."""
+    name = _qualified_name(p)
+    p.expect("as")
+    start = p.tok.pos
+    p._query()  # validate it parses; the stored form is the text
+    sql_text = p.sql[start:p.tok.pos].strip().rstrip(";").strip()
+    return _finish(p, ast.CreateView(name, sql_text, replace))
+
+
+def _schema_name(p: Parser) -> tuple:
+    """[catalog.]schema -> (catalog | None, schema)."""
+    name = _qualified_name(p)
+    parts = name.split(".")
+    if len(parts) == 1:
+        return None, parts[0]
+    if len(parts) == 2:
+        return parts[0], parts[1]
+    raise SyntaxError("schema names take [catalog.]name")
+
+
+def _type_text(p: Parser) -> str:
+    """A type name as written: ident/keyword plus optional (p[,s])
+    (ALTER TABLE ADD COLUMN re-uses the binder's type parser on it)."""
+    t = p.tok
+    if t.kind not in ("ident", "keyword"):
+        raise SyntaxError(f"expected type name, got {t!r}")
+    p.i += 1
+    text = t.value
+    if p.accept("("):
+        text += "("
+        first = True
+        while not p.accept(")"):
+            if not first:
+                p.expect(",")
+                text += ","
+            first = False
+            if p.tok.kind == "eof":
+                raise SyntaxError("unterminated type parameters")
+            text += p.tok.value
+            p.i += 1
+        text += ")"
+    return text
 
 
 def parse_statement_body(p: Parser) -> ast.Node:

@@ -1,0 +1,200 @@
+"""Views, USE, schema DDL, CALL procedures.
+
+Reference analogs: execution/CreateViewTask.java:44 (views stored as
+SQL, re-bound at reference time via StatementAnalyzer.java:789),
+execution/UseTask.java:33, execution/CreateSchemaTask.java:38,
+execution/AddColumnTask.java, spi/procedure/Procedure.java +
+execution/CallTask.java:60 (kill_query ships as a procedure).
+"""
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.runner import QueryRunner
+
+
+@pytest.fixture()
+def runner():
+    catalog = Catalog()
+    mem = MemoryConnector()
+    catalog.register("mem", mem, writable=True)
+    r = QueryRunner(catalog)
+    r.execute("create table base as select * from "
+              "(values (1, 'a'), (2, 'b'), (3, 'a')) t(id, tag)")
+    return r
+
+
+# -- views -------------------------------------------------------------------
+
+def test_create_select_drop_view(runner):
+    runner.execute("create view v as select id * 10 as ten, tag from base")
+    res = runner.execute("select ten, tag from v where ten > 10 order by ten")
+    assert res.rows == [(20, "b"), (30, "a")]
+    # SHOW TABLES lists the view
+    assert ("v",) in runner.execute("show tables").rows
+    runner.execute("drop view v")
+    with pytest.raises(Exception):
+        runner.execute("select * from v")
+
+
+def test_view_re_binds_at_reference_time(runner):
+    """Views store SQL, not plans: data changes flow through."""
+    runner.execute("create view v as select count(*) as n from base")
+    assert runner.execute("select n from v").rows == [(3,)]
+    runner.execute("insert into base select 4, 'a'")
+    assert runner.execute("select n from v").rows == [(4,)]
+    runner.execute("drop view v")
+
+
+def test_create_or_replace_view(runner):
+    runner.execute("create view v as select id from base")
+    with pytest.raises(Exception):
+        runner.execute("create view v as select tag from base")
+    runner.execute("create or replace view v as select tag from base")
+    assert runner.execute("select * from v limit 1").names == ["tag"]
+    runner.execute("drop view v")
+
+
+def test_drop_view_if_exists(runner):
+    runner.execute("drop view if exists nothere")
+    with pytest.raises(Exception):
+        runner.execute("drop view nothere")
+
+
+def test_view_over_view_and_cycle_detection(runner):
+    runner.execute("create view v1 as select id from base")
+    runner.execute("create view v2 as select id + 1 as id from v1")
+    assert sorted(runner.execute("select id from v2").rows) == [
+        (2,), (3,), (4,)]
+    # a replace that makes v1 reference v2 creates a cycle
+    runner.execute("create or replace view v1 as select id from v2")
+    with pytest.raises(Exception, match="[Rr]ecursi|cycle"):
+        runner.execute("select * from v2")
+    runner.execute("drop view v2")
+    runner.execute("drop view v1")
+
+
+def test_view_name_cannot_shadow_table(runner):
+    with pytest.raises(Exception, match="already exists"):
+        runner.execute("create view base as select 1 as x")
+
+
+def test_broken_view_fails_at_create(runner):
+    with pytest.raises(Exception):
+        runner.execute("create view v as select no_such_col from base")
+
+
+def test_cte_shadows_view(runner):
+    runner.execute("create view v as select id from base")
+    res = runner.execute("with v as (select 99 as id) select id from v")
+    assert res.rows == [(99,)]
+    runner.execute("drop view v")
+
+
+# -- USE + schemas -----------------------------------------------------------
+
+def test_use_and_schema_ddl(runner):
+    runner.execute("create schema mem.s1")
+    assert ("s1",) in runner.execute("show schemas from mem").rows
+    runner.execute("use mem.s1")
+    # CTAS lands in the schema; unqualified reads resolve there
+    runner.execute("create table t as select 7 as x")
+    assert runner.execute("select x from t").rows == [(7,)]
+    # fully-qualified name reaches it from any session state
+    assert runner.execute("select x from mem.s1.t").rows == [(7,)]
+    # the default schema still sees base via fallback search
+    assert len(runner.execute("select * from base").rows) == 3
+    runner.execute("use mem.default")
+    with pytest.raises(Exception):
+        runner.execute("select x from t")  # t lives in s1, not default
+
+
+def test_use_validates_names(runner):
+    with pytest.raises(Exception, match="catalog"):
+        runner.execute("use nope.default")
+    with pytest.raises(Exception, match="schema"):
+        runner.execute("use mem.nope")
+
+
+def test_create_schema_if_not_exists(runner):
+    runner.execute("create schema mem.s2")
+    with pytest.raises(Exception, match="exists"):
+        runner.execute("create schema mem.s2")
+    runner.execute("create schema if not exists mem.s2")
+
+
+def test_drop_schema_restrict_and_cascade(runner):
+    runner.execute("create schema mem.s3")
+    runner.execute("use mem.s3")
+    runner.execute("create table t3 as select 1 as a")
+    with pytest.raises(Exception, match="not empty"):
+        runner.execute("drop schema mem.s3")
+    runner.execute("use mem.default")
+    runner.execute("drop schema mem.s3 cascade")
+    assert ("s3",) not in runner.execute("show schemas from mem").rows
+    with pytest.raises(Exception):
+        runner.execute("select * from mem.s3.t3")
+    runner.execute("drop schema if exists mem.s3")
+
+
+def test_rename_schema(runner):
+    runner.execute("create schema mem.olds")
+    runner.execute("use mem.olds")
+    runner.execute("create table rt as select 5 as v")
+    runner.execute("alter schema mem.olds rename to news")
+    # session follows the rename
+    assert runner.execute("select v from rt").rows == [(5,)]
+    assert runner.execute("select v from mem.news.rt").rows == [(5,)]
+    runner.execute("use mem.default")
+    runner.execute("drop schema mem.news cascade")
+
+
+# -- ALTER TABLE ADD/DROP COLUMN --------------------------------------------
+
+def test_add_and_drop_column(runner):
+    runner.execute("create table alt as select 1 as a")
+    runner.execute("alter table alt add column b bigint")
+    res = runner.execute("select a, b from alt")
+    assert res.rows == [(1, None)]  # NULL backfill
+    runner.execute("insert into alt select 2, 20")
+    assert sorted(runner.execute("select a, b from alt").rows) == [
+        (1, None), (2, 20)]
+    runner.execute("alter table alt drop column b")
+    assert runner.execute("select * from alt").names == ["a"]
+
+
+# -- CALL --------------------------------------------------------------------
+
+def test_call_kill_query(runner):
+    res = runner.execute("call system.runtime.kill_query('q_42')")
+    assert "q_42" in res.rows[0][0]
+
+
+def test_call_unknown_procedure(runner):
+    with pytest.raises(Exception, match="procedure"):
+        runner.execute("call system.runtime.nope()")
+
+
+def test_registered_procedure_receives_literal_args(runner):
+    seen = {}
+
+    def proc(session, a, b=None):
+        seen["args"] = (a, b)
+        return "ok"
+
+    runner.register_procedure("sys.echo", proc)
+    assert runner.execute("call sys.echo(3, 'x')").rows == [("ok",)]
+    assert seen["args"] == (3, "x")
+
+
+# -- bare VALUES -------------------------------------------------------------
+
+def test_bare_values_statement(runner):
+    assert runner.execute("values 1, 2, 3").rows == [(1,), (2,), (3,)]
+    assert runner.execute("values (1, 'a'), (2, 'b')").rows == [
+        (1, "a"), (2, "b")]
+    assert runner.execute("values 3, 1, 2 order by 1 limit 2").rows == [
+        (1,), (2,)]
+    assert runner.execute(
+        "select a + 1 from (values 1, 2) t(a) order by 1").rows == [(2,), (3,)]
