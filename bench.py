@@ -58,6 +58,23 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _host_tag() -> str:
+    """CPU-feature fingerprint segmenting the compilation cache by
+    host (rounds run on heterogeneous machines; foreign AOT entries
+    segfault)."""
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    return platform.machine()
+
+
 # ----------------------------------------------------------------------
 # child mode: measure one query (or all) under a fixed platform
 # ----------------------------------------------------------------------
@@ -124,11 +141,16 @@ def _measure(sf: float, iters: int, only: str) -> dict:
 
     # persistent compilation cache: TPU warmups through the tunnel cost
     # minutes per program (q3 measured 551s cold); cached executables
-    # replay across bench children and rounds
-    cache_dir = os.path.join(HERE, ".jax_cache")
+    # replay across bench children and rounds.  Keyed by CPU-feature
+    # fingerprint (same scheme as tests/conftest.py host_cache_dir, NOT
+    # imported — conftest forces the CPU platform at import): replaying
+    # another host's AOT-compiled CPU executables segfaults.
+    cache_dir = os.path.join(HERE, ".jax_cache", _host_tag())
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # 0.25s floor mirrors tests/conftest.py: persisting every tiny
+    # executable tripped a cumulative segfault in jax's cache writer
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
 
     platform = jax.devices()[0].platform
     log(f"devices: {jax.devices()}")

@@ -240,9 +240,14 @@ class Catalog:
         s_sch = getattr(session, "schema", None)
         if ("." not in table and s_cat in self._connectors and s_sch
                 and s_sch != "default"):
+            # under USE catalog.schema an unqualified name means THAT
+            # schema — a miss errors rather than silently reading a
+            # same-named table elsewhere (MetadataUtil name resolution)
             phys = f"{s_sch}.{table}"
-            if phys in self._connectors[s_cat].table_names():
-                table = f"{s_cat}.{phys}"
+            if phys not in self._connectors[s_cat].table_names():
+                raise KeyError(
+                    f"table not found: {s_cat}.{s_sch}.{table}")
+            table = f"{s_cat}.{phys}"
         items = self._connectors.items()
         if "." in table:
             cname, bare = table.split(".", 1)

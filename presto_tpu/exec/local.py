@@ -87,6 +87,39 @@ def concat_pages_device(pages: Sequence[Page]) -> Page:
     return Page(tuple(blocks), mask)
 
 
+def pad_page_pow2(page: Page) -> Page:
+    """Pad a page with dead rows up to the next power-of-two capacity.
+    Scan splits otherwise carry data-dependent capacities (ragged last
+    split, per-table row counts) and every distinct capacity costs a
+    full XLA compile of the whole chain program — the dominant cold-
+    start cost (19 of q3's 32 warmup compiles were one agg program
+    re-traced per shape)."""
+    cap = page.capacity
+    tgt = 1 << max(0, int(cap) - 1).bit_length()
+    if tgt <= cap or cap == 0:
+        return page
+    arrs, pm = _pad_arrays(
+        tuple(b.data for b in page.blocks) + tuple(b.valid for b in page.blocks),
+        page.row_mask, tgt - cap)
+    nb = len(page.blocks)
+    blocks = tuple(
+        Block(arrs[i], arrs[nb + i], b.type, b.dictionary)
+        for i, b in enumerate(page.blocks))
+    return Page(blocks, pm)
+
+
+def _pad_arrays_impl(arrs, mask, pad):
+    """One jitted program per (shapes, pad) signature — not one concat
+    program per block — pads every column and the mask together."""
+    out = tuple(
+        jnp.concatenate(
+            [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]) for a in arrs)
+    return out, jnp.concatenate([mask, jnp.zeros((pad,), jnp.bool_)])
+
+
+_pad_arrays = jax.jit(_pad_arrays_impl, static_argnums=(2,))
+
+
 def slice_page(page: Page, n: int) -> Page:
     """First n physical rows (static slice — used after sorts where live
     rows are compacted to the front)."""
@@ -200,11 +233,16 @@ class _AggFoldTower:
       (operator/aggregation/builder/InMemoryHashAggregationBuilder.java,
       MultiChannelGroupByHash.java:138-145).
 
-    Truncation safety: a merge's output capacity is the pow2 bound of
-    its inputs' combined live counts clamped to ``max_groups``; a clamp
-    that truncates leaves ``max_groups`` live rows in the output, which
-    the caller's overflow check sees and retries doubled — the same
-    detect-and-retry contract as the round-4 fold.
+    Truncation: tower merges are UNCLAMPED — capacities follow the live
+    data past ``max_groups``, so the merged result is exact no matter
+    how conservative the planner's capacity guess was.  The one place
+    truncation can still happen is INSIDE the jitted chain's per-split
+    partial aggregation (grouped_aggregate at static ``max_groups``);
+    an input page arriving full (live >= max_groups) records
+    ``suspect_truncation`` and the caller re-plans with a capacity
+    jumped to the observed live total (one retry, not a doubling
+    ladder — MultiChannelGroupByHash.java:138 rehashes incrementally;
+    this is the static-shape analog).
     """
 
     MIN_CAP = 1 << 10
@@ -215,6 +253,10 @@ class _AggFoldTower:
         self.mg = mg
         self.account = account
         self.levels: Dict[int, tuple] = {}  # capacity -> (page, live, tag)
+        # a full input page means the chain's static-capacity partial
+        # may have dropped groups; the total live count sizes the retry
+        self.suspect_truncation = False
+        self.live_total = 0
         cache_key = (node, "tower")
         fns = runner._fold_cache.get(cache_key)
         if fns is None:
@@ -237,17 +279,12 @@ class _AggFoldTower:
         self.fold, self.final = fns
 
     def _cap(self, n: int) -> int:
-        """Merge OUTPUT capacity: pow2 bound clamped to max_groups (a
-        clamp that truncates is caught by the caller's overflow check)."""
-        return min(self.mg, max(self.MIN_CAP,
-                                1 << max(0, int(n) - 1).bit_length()))
+        """Pow2 capacity bound — never clamped to max_groups: tower
+        merges follow the live data, so results are exact past the
+        planner's capacity guess."""
+        return max(self.MIN_CAP, 1 << max(0, int(n) - 1).bit_length())
 
-    def _slice_cap(self, extent: int) -> int:
-        """Input-slice capacity: pow2 bound of the live EXTENT, never
-        clamped — an input page may be wider than max_groups (e.g. a
-        concat of K worker partials at the coordinator merge) and
-        slicing below its extent would silently drop live states."""
-        return max(self.MIN_CAP, 1 << max(0, int(extent) - 1).bit_length())
+    _slice_cap = _cap
 
     def _reserve(self, page):
         if not self.account or self.runner._mem is None:
@@ -260,6 +297,9 @@ class _AggFoldTower:
     def add(self, page: Page) -> None:
         el = np.asarray(_extent_live(page.row_mask))
         extent, live = int(el[0]), int(el[1])
+        self.live_total += live
+        if live >= self.mg:
+            self.suspect_truncation = True
         cap = self._slice_cap(extent)
         if page.capacity > cap:
             page = slice_page(page, cap)
@@ -466,7 +506,29 @@ class LocalRunner:
         peak = getattr(self, "last_peak_bytes", 0)
         if peak:
             text = f"peak reserved memory: {peak / 1e6:.1f}MB\n" + text
+        progs = self.compiled_program_count()
+        if progs is not None:
+            text = f"compiled XLA programs: {progs}\n" + text
         return text
+
+    def compiled_program_count(self) -> Optional[int]:
+        """Distinct compiled XLA programs behind this runner's cached
+        jitted callables (each shape signature of each callable is one
+        program — the TPU cold-start cost driver; VERDICT r4 #9)."""
+        total = 0
+        seen = set()
+        entries = list(self._chain_cache.values())
+        for v in self._fold_cache.values():
+            entries.extend(v if isinstance(v, tuple) else (v,))
+        for fn in entries:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            try:
+                total += fn._cache_size()
+            except Exception:
+                total += 1  # non-jitted (debug mode) counts as one
+        return total
 
     def _is_chain_member(self, n: PlanNode) -> bool:
         return (
@@ -629,8 +691,10 @@ class LocalRunner:
             for i, t in enumerate(node.types):
                 raw = [r[i] for r in node.rows]
                 valids.append(np.asarray([v is not None for v in raw], np.bool_))
-                if t.is_array or t.is_map:
-                    cols.append(raw)  # Page encodes container lists
+                if t.is_array or t.is_map or t.is_long_decimal:
+                    # Page encodes container lists / limb decimals
+                    # (unscaled ints may exceed int64 at p > 18)
+                    cols.append(raw)
                 else:
                     cols.append(np.asarray([0 if v is None else v for v in raw],
                                            dtype=t.np_dtype))
@@ -823,11 +887,13 @@ class LocalRunner:
             left_keys = list(node.left_keys)
             kind = node.kind
             ns = node.null_safe_keys
+            na = getattr(node, "null_aware", False)
 
             def probe_stage(p, c):
                 return probe_join(
                     c[key], inner(p, c), left_keys, key_domains=kd,
                     kind=kind, build_output=build_output, null_safe=ns,
+                    null_aware=na,
                 )
 
             return probe_stage
@@ -899,7 +965,8 @@ class LocalRunner:
                     import numpy as _np
 
                     produced += int(_np.asarray(page.row_mask).sum())
-                yield Page(tuple(page.blocks[i] for i in idx), page.row_mask)
+                yield pad_page_pow2(
+                    Page(tuple(page.blocks[i] for i in idx), page.row_mask))
         else:
             yield from self._pages(node)
 
@@ -1069,7 +1136,8 @@ class LocalRunner:
             self._account("index_join_build", build.page, node)
             if node.kind in ("semi", "anti", "mark"):
                 yield probe_join(build, p, left_keys, key_domains=None,
-                                 kind=node.kind, build_output=build_output)
+                                 kind=node.kind, build_output=build_output,
+                                 null_aware=getattr(node, "null_aware", False))
             elif node.unique_build:
                 yield probe_join(build, p, left_keys, key_domains=None,
                                  kind=node.kind, build_output=build_output)
@@ -1118,6 +1186,23 @@ class LocalRunner:
                 if hp is not None:
                     pbuckets[k].append(hp)
 
+        # three-valued IN/NOT IN needs GLOBAL build flags: a NULL build
+        # key in one partition makes unmatched probes in EVERY partition
+        # UNKNOWN, and "build nonempty" is a whole-relation property
+        na = getattr(node, "null_aware", False) and kind in ("semi", "anti",
+                                                             "mark")
+        g_has_null = g_nonempty = None
+        if na:
+            from presto_tpu.ops.join import build_null_flags
+
+            g_has_null = jnp.asarray(False)
+            g_nonempty = jnp.asarray(False)
+            for k in range(K):
+                for hp in bbuckets[k]:
+                    h, ne = build_null_flags(hp.rehydrate(), right_keys)
+                    g_has_null = g_has_null | h
+                    g_nonempty = g_nonempty | ne
+
         probe_spec = [(c.type, c.dictionary) for c in node.left.channels]
         for k in range(K):
             if not pbuckets[k] and not (is_full and bbuckets[k]):
@@ -1127,6 +1212,9 @@ class LocalRunner:
             else:
                 bpage = Page.empty(right_types, 1)
             build = build_join(bpage, right_keys, key_domains=kd, null_safe=ns)
+            if na:
+                build = dataclasses.replace(
+                    build, has_null_key=g_has_null, nonempty=g_nonempty)
             tag = None
             if self._mem is not None:
                 from presto_tpu.memory import page_bytes
@@ -1146,7 +1234,7 @@ class LocalRunner:
                 if kind in ("semi", "anti", "mark"):
                     yield probe_join(build, p, left_keys, key_domains=kd,
                                      kind=kind, build_output=build_output,
-                                     null_safe=ns)
+                                     null_safe=ns, null_aware=na)
                     continue
                 res = _probe_with_retry(probe_fn, build, p)
                 yield res[0]
@@ -1262,7 +1350,7 @@ class LocalRunner:
         # doubling below recovers skewed buckets
         cap0 = max(1 << 10, min(self._max_groups(node), SPILL_GROUP_THRESHOLD) // K)
 
-        def fold_bucket(pages: List[HostPage], cap: int) -> Page:
+        def fold_bucket(pages: List[HostPage], cap: int) -> "_AggFoldTower":
             # tower fold with live-extent compaction (same machinery as
             # the in-memory path; account=False — spill state must not
             # re-trip the pool it is relieving)
@@ -1276,7 +1364,7 @@ class LocalRunner:
                     pp = grouped_aggregate(p, group_exprs, aggs, cap,
                                            key_domains=kd, mode="partial")
                 tower.add(pp)
-            return tower.finish_single()
+            return tower
 
         outs: List[Page] = []
         for k in range(K):
@@ -1284,17 +1372,24 @@ class LocalRunner:
                 continue
             cap = cap0
             while True:
-                out = fold_bucket(buckets[k], cap)
-                if out is None:  # every page in the bucket was all-dead
+                tower = fold_bucket(buckets[k], cap)
+                # tower merges are unclamped; only the per-page
+                # grouped_aggregate at static ``cap`` can truncate, and
+                # a full page is the tell (partial_input pages are
+                # already states — nothing truncates)
+                if (partial_input or not tower.suspect_truncation
+                        or cap >= MAX_AGG_GROUPS):
+                    out = tower.finish_single()
                     break
-                live = int(np.asarray(jnp.sum(out.row_mask.astype(jnp.int32))))
-                if live < cap or cap >= MAX_AGG_GROUPS:
-                    break
-                cap *= 2
+                cap = min(MAX_AGG_GROUPS,
+                          max(cap * 2,
+                              1 << max(1,
+                                       2 * tower.live_total - 1).bit_length()))
+            if out is None:  # every page in the bucket was all-dead
+                continue
             # bucket outputs are result stream, not operator state — not
             # charged against the pool (the whole point of the spill)
-            if out is not None:
-                outs.append(out)
+            outs.append(out)
         if not outs:
             out = Page.empty(node.output_types, max(cap0, 1))
             return self._groupid_empty_fixup(node, out)
@@ -1331,16 +1426,35 @@ class LocalRunner:
             self._agg_overrides[partial] = mg
             source = partial
 
-        if node.group_exprs and not self._exact_capacity(node, mg):
-            # sort-path partials: live-extent compaction + tower merge
+        import os as _os
+
+        tower_on = _os.environ.get("PRESTO_TPU_AGG_TOWER", "1") \
+            not in ("0", "false")
+        if tower_on and node.group_exprs \
+                and not self._exact_capacity(node, mg):
+            # sort-path partials: live-extent compaction + tower merge.
+            # Tower capacities are unclamped, so the merge itself never
+            # truncates; the one remaining hazard is the chain's
+            # static-capacity per-split partial (only when THIS runner
+            # injected it, i.e. step single) — a full partial page
+            # triggers ONE retry with the capacity jumped to the
+            # observed live total instead of a doubling ladder.
             tower = _AggFoldTower(self, node, num_keys, aggs, kd, mg)
             for p in self._pages(source):
                 tower.add(p)
+            if node.step == "single" and tower.suspect_truncation \
+                    and mg < MAX_AGG_GROUPS:
+                needed = min(
+                    MAX_AGG_GROUPS,
+                    max(mg * 2,
+                        1 << max(1, 2 * tower.live_total - 1).bit_length()))
+                self._agg_overrides[node] = needed
+                self._invalidate_agg_caches(node)
+                raise GroupCapacityExceeded(needed, node)
             out = tower.finish_single()
             if out is None:
                 return self._groupid_empty_fixup(
                     node, Page.empty(node.output_types, max(mg, 1)))
-            self._check_overflow(node, out, mg)
             return self._groupid_empty_fixup(node, out)
 
         # global aggregation and exact-capacity (packed-direct) partials:
@@ -1412,12 +1526,38 @@ class LocalRunner:
         dicts = [c.dictionary for c in node.channels]
         return Page.from_arrays(cols, types, valids=valids, dictionaries=dicts)
 
+    def _invalidate_agg_caches(self, node: AggregationNode) -> None:
+        """Drop only the compiled programs the retried aggregation's
+        capacity is baked into — the rest of the query's chains, builds
+        and folds stay compiled across the retry (a full clear re-paid
+        every compile per capacity step)."""
+        targets = {id(node)}
+        partial = self._partial_nodes.get(node)
+        if partial is not None:
+            targets.add(id(partial))
+
+        def contains(root) -> bool:
+            stack = [root]
+            while stack:
+                n = stack.pop()
+                if id(n) in targets:
+                    return True
+                stack.extend(getattr(n, "sources", []) or [])
+            return False
+
+        for key in list(self._chain_cache):
+            if isinstance(key, PlanNode) and contains(key):
+                del self._chain_cache[key]
+        for key in list(self._fold_cache):
+            base = key[0] if isinstance(key, tuple) else key
+            if isinstance(base, PlanNode) and id(base) in targets:
+                del self._fold_cache[key]
+
     def _check_overflow(self, node: AggregationNode, out: Page, mg: int) -> None:
         if not node.group_exprs or self._exact_capacity(node, mg):
             return
         live = int(np.asarray(jnp.sum(out.row_mask.astype(jnp.int32))))
         if live >= mg and mg < MAX_AGG_GROUPS:
             self._agg_overrides[node] = mg * 2
-            self._chain_cache.clear()
-            self._fold_cache.clear()
+            self._invalidate_agg_caches(node)
             raise GroupCapacityExceeded(mg * 2, node)

@@ -44,7 +44,12 @@ class HostPage:
 
     def rehydrate(self, capacity: Optional[int] = None) -> Page:
         n = len(self.mask)
-        cap = capacity if capacity is not None else max(n, 1)
+        # pow2 padding by default: bucket sizes are data-dependent, and
+        # raw row counts would give every rehydrated page a distinct
+        # XLA shape — one full program compile per page (measured as
+        # the dominant cost of the r4 spill cliff, not the sorts)
+        cap = capacity if capacity is not None \
+            else max(1024, 1 << max(0, n - 1).bit_length())
         blocks = []
         for data, valid, t, d in self.columns:
             dd = np.zeros((cap,) + data.shape[1:], dtype=data.dtype)

@@ -64,6 +64,7 @@ _GEO_FNS = frozenset({
 
 _CONTAINER_FNS = frozenset({
     "array_construct", "subscript", "element_at", "cardinality",
+    "jaccard_index", "intersection_cardinality", "hash_counts",
     "contains", "array_position", "array_min", "array_max", "array_sum",
     "array_average", "array_sort", "array_distinct", "map_keys",
     "map_values", "map", "map_construct",
@@ -678,14 +679,25 @@ def _to_double(data: jax.Array, t: Type) -> jax.Array:
     return data.astype(jnp.float64)
 
 
-def _to_long_limbs(data: jax.Array, t: Type, from_scale: int, to_scale: int) -> jax.Array:
+def _to_long_limbs(data: jax.Array, t: Type, from_scale: int, to_scale: int,
+                   limbs: int = 2) -> jax.Array:
     """Coerce a short/long decimal (or integer) column to long-decimal
-    limbs at the target scale."""
+    limbs at the target scale (``limbs`` = 5 for decimal(37..38))."""
     from presto_tpu.ops import decimal128 as d128
 
     if t.is_long_decimal:
-        return d128.rescale(data, from_scale, to_scale)
-    return d128.rescale(d128.from_int64(data.astype(jnp.int64)), from_scale, to_scale)
+        cur = data
+        if limbs == 5 and data.shape[-1] == 2:
+            cur = d128.widen(cur)
+        return d128.rescale(cur, from_scale, to_scale)
+    return d128.rescale(d128.from_int64(data.astype(jnp.int64), limbs=limbs),
+                        from_scale, to_scale)
+
+
+def _decimal_limbs(*types) -> int:
+    """Limb width covering every decimal operand (5 once any p > 36)."""
+    return 5 if any(t.is_decimal and (t.precision or 0) > 36
+                    for t in types) else 2
 
 
 def _where_rows(cond: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
@@ -773,6 +785,15 @@ class ExprCompiler:
                 return out, v & nn
 
             return run_row_field
+        if fn == "retype_row":
+            # CAST(row AS ROW(name type, ...)): names are metadata on
+            # the type; the storage matrix passes through unchanged
+            base_f = self.compile(expr.args[0])
+
+            def run_retype_row(page, base_f=base_f):
+                return base_f(page)
+
+            return run_retype_row
         if fn in _CONTAINER_FNS:
             return self._compile_container(expr)
         if fn in _GEO_FNS:
@@ -1007,8 +1028,13 @@ class ExprCompiler:
                         scaled = jnp.round(d * (10.0 ** out_t.scale))
                         hi = jnp.floor(scaled / float(d128.BASE))
                         lo = scaled - hi * float(d128.BASE)
-                        return d128.normalize(hi.astype(jnp.int64),
-                                              lo.astype(jnp.int64)), v
+                        two = d128.normalize(hi.astype(jnp.int64),
+                                             lo.astype(jnp.int64))
+                        if (out_t.precision or 0) > 36:
+                            # float64 carries < 54 bits anyway; the
+                            # 2-limb path is exact for every float
+                            return d128.widen(two), v
+                        return two, v
                     return jnp.round(d * (10.0 ** out_t.scale)).astype(jnp.int64), v
                 return self._coerce(d, t0, out_t), v
 
@@ -1693,12 +1719,86 @@ class ExprCompiler:
                     return jnp.round(est).astype(jnp.int64), v
 
                 return run_hll_card
+            if t0.name == "setdigest":
+                # KMV estimator: exact below K slots; else
+                # (K-1) / (fraction of hash space below the K-th
+                # smallest hash)
+                K = t0.max_elems
+
+                def run_kmv_card(page):
+                    d, v = arg0(page)
+                    ln = jnp.maximum(d[:, 0].astype(jnp.int64), 0)
+                    kth = d[:, K].astype(jnp.float64)  # largest stored
+                    span = kth - float(jnp.iinfo(jnp.int64).min)
+                    frac = jnp.maximum(span, 1.0) / 2.0 ** 64
+                    est = jnp.round((K - 1) / frac).astype(jnp.int64)
+                    return jnp.where(ln < K, ln, jnp.maximum(est, ln)), v
+
+                return run_kmv_card
 
             def run_card(page):
                 d, v = arg0(page)
                 return ct.cardinality(d), v
 
             return run_card
+        if fn in ("jaccard_index", "intersection_cardinality") \
+                and t0.name == "setdigest":
+            # KMV minhash comparison (SetDigestFunctions.java): over the
+            # K smallest distinct hashes of the UNION, jaccard = the
+            # fraction present in both digests; intersection = jaccard
+            # x the union's KMV cardinality estimate.  A hash appearing
+            # in both digests shows up as an adjacent duplicate in the
+            # per-row sorted concat (hashes are distinct WITHIN one
+            # digest).
+            K = t0.max_elems
+            argb = self.compile(expr.args[1])
+            imin = float(jnp.iinfo(jnp.int64).min)
+
+            def run_setdigest_pair(page):
+                (da, va), (db, vb) = arg0(page), argb(page)
+                la = jnp.clip(da[:, 0].astype(jnp.int64), 0, K)
+                lb = jnp.clip(db[:, 0].astype(jnp.int64), 0, K)
+                j = jnp.arange(K, dtype=jnp.int64)[None, :]
+                big = jnp.iinfo(jnp.int64).max
+                ha = jnp.where(j < la[:, None],
+                               da[:, 1:1 + K].astype(jnp.int64), big)
+                hb = jnp.where(j < lb[:, None],
+                               db[:, 1:1 + K].astype(jnp.int64), big)
+                m = jnp.sort(jnp.concatenate([ha, hb], axis=1), axis=1)
+                live = m < big
+                firsts = jnp.concatenate(
+                    [jnp.ones_like(m[:, :1], jnp.bool_),
+                     m[:, 1:] != m[:, :-1]], axis=1) & live
+                nxt_dup = jnp.concatenate(
+                    [m[:, 1:] == m[:, :-1],
+                     jnp.zeros_like(m[:, :1], jnp.bool_)], axis=1)
+                rank = jnp.cumsum(firsts.astype(jnp.int64), axis=1) - 1
+                in_s = firsts & (rank < K)
+                inter = jnp.sum((in_s & nxt_dup).astype(jnp.int64), axis=1)
+                s_size = jnp.sum(in_s.astype(jnp.int64), axis=1)
+                jac = inter.astype(jnp.float64) / jnp.maximum(s_size, 1)
+                ok = va & vb
+                if fn == "jaccard_index":
+                    return jac, ok
+                # union KMV estimate from the merged distinct hashes
+                distinct_total = jnp.sum(firsts.astype(jnp.int64), axis=1)
+                kth = jnp.max(jnp.where(in_s, m, jnp.iinfo(jnp.int64).min),
+                              axis=1).astype(jnp.float64)
+                frac = jnp.maximum(kth - imin, 1.0) / 2.0 ** 64
+                union_est = jnp.where(
+                    distinct_total < K, distinct_total,
+                    jnp.round((K - 1) / frac).astype(jnp.int64))
+                return (jnp.round(jac * union_est).astype(jnp.int64), ok)
+
+            return run_setdigest_pair
+        if fn == "hash_counts" and t0.name == "setdigest":
+            # the digest IS [len, hashes.., counts..] — identical to the
+            # map(bigint,bigint) layout; retype in place
+            def run_hash_counts(page):
+                d, v = arg0(page)
+                return d.astype(out_t.np_dtype), v
+
+            return run_hash_counts
         if fn in ("contains", "array_position"):
             x = self.compile(expr.args[1])
             kern = ct.contains if fn == "contains" else ct.array_position
@@ -2055,8 +2155,10 @@ class ExprCompiler:
             if fn == "sign":
                 def run_lsign(page):
                     d, v = a(page)
-                    hi, lo = d128.split(d)
-                    s = jnp.where(hi < 0, -1, jnp.where((hi > 0) | (lo > 0), 1, 0))
+                    hi = d[..., 0]
+                    nonzero = jnp.any(d != 0, axis=-1)
+                    s = jnp.where(hi < 0, -1,
+                                  jnp.where(nonzero, 1, 0))
                     return s.astype(jnp.int64), v
 
                 return run_lsign
@@ -2264,12 +2366,15 @@ class ExprCompiler:
         if t.is_long_decimal:
             from presto_tpu.ops.decimal128 import encode_py
 
-            limbs = encode_py([int(val)], 1)[0]
+            limbs = encode_py([int(val)], 1,
+                              limbs=expr.type.value_shape[0])[0]
+
+            width = expr.type.value_shape[0]
 
             def run_llit(page):
                 n = page.capacity
                 return (
-                    jnp.broadcast_to(jnp.asarray(limbs), (n, 2)),
+                    jnp.broadcast_to(jnp.asarray(limbs), (n, width)),
                     jnp.ones(n, dtype=jnp.bool_),
                 )
 
@@ -2335,8 +2440,11 @@ class ExprCompiler:
 
             def run_lcmp(page):
                 (da, va), (db, vb) = a(page), b(page)
-                la = _to_long_limbs(da, ta, ta.scale if ta.is_decimal else 0, s)
-                lb = _to_long_limbs(db, tb, tb.scale if tb.is_decimal else 0, s)
+                w = _decimal_limbs(ta, tb)
+                la = _to_long_limbs(da, ta, ta.scale if ta.is_decimal else 0,
+                                    s, limbs=w)
+                lb = _to_long_limbs(db, tb, tb.scale if tb.is_decimal else 0,
+                                    s, limbs=w)
                 lt, eq, gt = d128.compare(la, lb)
                 d = {"eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
                      "gt": gt, "ge": gt | eq}[op]
@@ -2712,8 +2820,9 @@ class ExprCompiler:
                     if tb.is_long_decimal and not ta.is_long_decimal:
                         return d128.mul_long_short(db, da.astype(jnp.int64)), valid
                     raise ValueError("long-decimal x long-decimal mul unsupported")
-                da2 = _to_long_limbs(da, ta, sa, tr.scale)
-                db2 = _to_long_limbs(db, tb, sb, tr.scale)
+                w = _decimal_limbs(ta, tb, tr)
+                da2 = _to_long_limbs(da, ta, sa, tr.scale, limbs=w)
+                db2 = _to_long_limbs(db, tb, sb, tr.scale, limbs=w)
                 d = {
                     "add": lambda: d128.add(da2, db2),
                     "sub": lambda: d128.sub(da2, db2),
@@ -3124,13 +3233,14 @@ class ExprCompiler:
             return _to_double(data, from_t)
         if to_t.is_long_decimal:
             fs = from_t.scale if from_t.is_decimal else 0
-            return _to_long_limbs(data, from_t, fs, to_t.scale)
+            return _to_long_limbs(data, from_t, fs, to_t.scale,
+                                  limbs=to_t.value_shape[0])
         if to_t.is_decimal:
             if from_t.is_long_decimal:
                 from presto_tpu.ops import decimal128 as d128
 
                 limbs = d128.rescale(data, from_t.scale, to_t.scale)
-                return limbs[..., 0] * d128.BASE + limbs[..., 1]  # narrow
+                return _narrow_to_int64(limbs)
             fs = from_t.scale if from_t.is_decimal else 0
             return _rescale(data.astype(jnp.int64), fs, to_t.scale)
         if to_t.name == "bigint":
@@ -3138,9 +3248,22 @@ class ExprCompiler:
                 from presto_tpu.ops import decimal128 as d128
 
                 limbs = d128.rescale(data, from_t.scale or 0, 0)
-                return limbs[..., 0] * d128.BASE + limbs[..., 1]  # exact in range
+                return _narrow_to_int64(limbs)  # exact in range
             return data.astype(jnp.int64)
         return data
+
+
+def _narrow_to_int64(limbs: jax.Array) -> jax.Array:
+    """Collapse limb vectors to a single int64 (exact only when the
+    value fits — same contract as the reference's narrowing casts)."""
+    from presto_tpu.ops import decimal128 as d128
+
+    if limbs.shape[-1] == 2:
+        return limbs[..., 0] * d128.BASE + limbs[..., 1]
+    acc = limbs[..., 0]
+    for i in range(1, limbs.shape[-1]):
+        acc = acc * d128._B9 + limbs[..., i]
+    return acc
 
 
 def _unwrap_geomtext(e: Expr) -> Expr:

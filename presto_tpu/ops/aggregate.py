@@ -70,6 +70,8 @@ ML_MAX_CLASSES = 8
 
 def _sum_type(t: Type) -> Type:
     if t.is_decimal:
+        if (t.precision or 0) > 36:
+            return DecimalType(38, t.scale)
         return DecimalType(36 if t.is_long_decimal else 18, t.scale)
     if t.name in ("double", "real"):
         return DOUBLE  # REAL accumulates in double (reference parity)
@@ -144,6 +146,10 @@ def state_types(agg: AggCall) -> List[Type]:
         from presto_tpu.types import HllType
 
         return [HllType(), BIGINT]
+    if agg.fn in ("make_set_digest", "merge_set_digest"):
+        from presto_tpu.types import SetDigestType
+
+        return [SetDigestType(), BIGINT]
     if agg.fn == "learn_regressor":
         # normal-equation sufficient statistics: flattened upper
         # triangle-free full XtX (dim*dim) + Xty (dim), dim = k+1 bias
@@ -181,6 +187,10 @@ def output_type(agg: AggCall) -> Type:
         from presto_tpu.types import HllType
 
         return HllType()
+    if agg.fn in ("make_set_digest", "merge_set_digest"):
+        from presto_tpu.types import SetDigestType
+
+        return SetDigestType()
     if agg.fn == "multimap_agg":
         from presto_tpu.types import ArrayType, MapType
 
@@ -677,6 +687,37 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
                 [length[:, None], kflat.reshape(n, cap_e),
                  vflat.reshape(n, cap_e)], axis=1)
             out.append([state, rows_cnt])
+        elif agg.fn == "make_set_digest":
+            # KMV sketch build: hash the value, dedup per group summing
+            # multiplicities, keep the K smallest hashes
+            st = state_types(agg)[0]
+            cap_e = st.max_elems
+            storage = st.np_dtype
+            sel = rowsel & valid
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                v64 = jax.lax.bitcast_convert_type(
+                    data.astype(jnp.float64), jnp.int64)
+            else:
+                v64 = data.astype(jnp.int64)
+            h = mix64(v64)
+            ones = jnp.ones_like(h)
+            state, distinct = _kmv_lanes(gid, h, ones, sel, n, cap_e,
+                                         storage)
+            out.append([state, distinct])
+        elif agg.fn == "merge_set_digest":
+            # union of digest-valued rows: flatten their lanes and
+            # re-lane (counts sum on shared hashes)
+            st = state_types(agg)[0]
+            cap_e = st.max_elems
+            storage = st.np_dtype
+            sel = rowsel & valid
+            rows = jnp.where(sel[:, None], data.astype(storage),
+                             jnp.zeros((), storage))
+            egid, hs, cs, lane_ok = _digest_entries(
+                rows, jnp.where(sel, gid, n), n, cap_e)
+            state, distinct = _kmv_lanes(egid, hs, cs, lane_ok, n, cap_e,
+                                         storage)
+            out.append([state, distinct])
         elif agg.fn in ("max_n", "min_n", "max_by_n", "min_by_n"):
             # top-n per group via one value-ordered lexsort + scatter
             # (Max/MinNAggregationFunction's TypedHeap,
@@ -704,6 +745,67 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
         else:
             raise KeyError(agg.fn)
     return out
+
+
+def mix64(v: jax.Array) -> jax.Array:
+    """splitmix64 (golden-ratio increment + the _mix64 finalizer below):
+    int64 value -> well-mixed int64 hash — the hash behind
+    make_set_digest's KMV slots (the reference's XxHash64 role for
+    SetDigest.add)."""
+    z = v.astype(jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)
+    return _mix64(z).astype(jnp.int64)
+
+
+def _kmv_lanes(egid, hashes, counts, sel, n, cap_e, storage):
+    """Per-group KMV digest state from entry rows: dedup (group, hash)
+    runs summing their counts, keep each group's cap_e SMALLEST hashes
+    in ascending lanes.  Returns (state [len, hashes.., counts..],
+    distinct_total) — the sketch construction AND the sketch union are
+    this one kernel (SetDigest.mergeWith collapses to re-laning)."""
+    sent = _container_sent(storage)
+    m = hashes.shape[0]
+    egid = jnp.where(sel, egid, n)
+    order = jnp.lexsort((hashes, egid))
+    gs, hs, cs, sl = egid[order], hashes[order], counts[order], sel[order]
+    newrun = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), (gs[1:] != gs[:-1]) | (hs[1:] != hs[:-1])])
+    first = sl & newrun
+    rid = jnp.cumsum(first.astype(jnp.int64)) - 1
+    rsum = jnp.zeros((m + 1,), jnp.int64).at[
+        jnp.where(sl, rid, m)].add(cs.astype(jnp.int64))
+    # distinct rank within group (ascending hash): run id offset by the
+    # group's first run id (rid is nondecreasing over sorted rows)
+    gfirst = jnp.concatenate([jnp.ones(1, jnp.bool_), gs[1:] != gs[:-1]])
+    gstart = jax.lax.cummax(jnp.where(gfirst & sl, rid, 0))
+    rank_d = rid - gstart
+    ok = first & (rank_d < cap_e) & (gs < n)
+    tgt = jnp.where(ok, gs.astype(jnp.int64) * cap_e + rank_d, n * cap_e)
+    hflat = jnp.full((n * cap_e,), sent, dtype=storage)
+    hflat = hflat.at[tgt].set(hs.astype(storage), mode="drop")
+    cflat = jnp.full((n * cap_e,), sent, dtype=storage)
+    cflat = cflat.at[tgt].set(
+        rsum[jnp.clip(rid, 0, m)].astype(storage), mode="drop")
+    distinct = jnp.zeros((n + 1,), jnp.int64).at[
+        jnp.where(first, gs, n)].add(1)[:n]
+    length = jnp.minimum(distinct, cap_e).astype(storage)
+    state = jnp.concatenate(
+        [length[:, None], hflat.reshape(n, cap_e), cflat.reshape(n, cap_e)],
+        axis=1)
+    return state, distinct
+
+
+def _digest_entries(arr_col, gid, n, cap_e):
+    """Flatten digest-state rows into per-entry (egid, hash, count,
+    sel) vectors for re-laning."""
+    l0 = arr_col[:, 0]
+    lens = jnp.where(gid < n, jnp.maximum(l0.astype(jnp.int64), 0), 0)
+    j = jnp.arange(cap_e, dtype=jnp.int64)[None, :]
+    lane_ok = j < jnp.minimum(lens, cap_e)[:, None]
+    hashes = arr_col[:, 1:1 + cap_e]
+    counts = arr_col[:, 1 + cap_e:1 + 2 * cap_e]
+    egid = jnp.where(lane_ok, gid[:, None], n)
+    return (egid.reshape(-1), hashes.reshape(-1), counts.reshape(-1),
+            lane_ok.reshape(-1))
 
 
 def _container_sent(storage):
@@ -943,6 +1045,25 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 jnp.concatenate([length[:, None]] + halves, axis=1),
                 _gsum(ctx, cnt_col, gid, n),
             ])
+        elif agg.fn in ("make_set_digest", "merge_set_digest"):
+            # KMV union: the K smallest of the union of per-partial
+            # K-smallest lanes IS the union's K smallest (semilattice),
+            # with counts summing on shared hashes
+            arr_col, cnt_col = cols
+            cap_e = state_types(agg)[0].max_elems
+            storage = arr_col.dtype
+            egid, hs, cs, lane_ok = _digest_entries(arr_col, gid, n, cap_e)
+            state, _ = _kmv_lanes(egid, hs, cs, lane_ok, n, cap_e, storage)
+            # distinct totals OVERCOUNT across partials (shared hashes);
+            # the estimator only reads them below cap_e, where the lane
+            # union is exact — recompute from the merged lanes
+            merged_distinct = state[:, 0].astype(jnp.int64)
+            total = _gsum(ctx, cnt_col, gid, n)
+            distinct = jnp.where(merged_distinct < cap_e, merged_distinct,
+                                 jnp.maximum(total, merged_distinct))
+            state = state.at[:, 0].set(
+                jnp.minimum(distinct, cap_e).astype(storage))
+            out.append([state, distinct])
         elif agg.fn in ("max_n", "min_n", "max_by_n", "min_by_n"):
             # top-n of the union of per-partial top-n lanes IS the
             # global top-n (semilattice), so merging re-runs the same
@@ -1204,7 +1325,8 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
             ], axis=1)
             blocks.append(Block(model.astype(t.np_dtype), cnt > 0, t))
         elif agg.fn in ("array_agg", "map_agg", "hll_sketch",
-                        "multimap_agg", "map_union", "max_n", "min_n"):
+                        "multimap_agg", "map_union", "max_n", "min_n",
+                        "make_set_digest", "merge_set_digest"):
             arr_state, cnt = cols
             blocks.append(Block(arr_state.astype(t.np_dtype), cnt > 0, t, adict))
         elif agg.fn in ("max_by_n", "min_by_n"):
@@ -1240,16 +1362,27 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
 
 
 def _avg_decimal128(s: jax.Array, n: jax.Array) -> jax.Array:
-    """Exact (cap, 2)-limb decimal sum divided by int64 count with
-    HALF_UP rounding, keeping the unscaled representation — the
-    finalize of avg(decimal) over a two-limb accumulator.  Long
-    division over base-10^6 digits so the running remainder times the
-    base never overflows int64 (sound for counts < 2^43 — far above
-    any page capacity)."""
+    """Exact limb-decimal sum divided by int64 count with HALF_UP
+    rounding, keeping the unscaled representation — the finalize of
+    avg(decimal) over a limb accumulator.  Long division over base-10^6
+    (or, for wide 5-limb sums, base-10^9) digits so the running
+    remainder times the base never overflows int64 (sound for counts <
+    2^43 / 2^33 respectively — far above any page capacity)."""
     from presto_tpu.ops import decimal128 as d128
 
     neg = s[..., 0] < 0
     a = jnp.where(neg[..., None], d128.neg(s), s)
+    if a.shape[-1] == d128.WIDE_LIMBS:
+        r = jnp.zeros_like(n)
+        qs = []
+        for i in range(d128.WIDE_LIMBS):
+            cur = r * jnp.int64(d128._B9) + a[..., i]
+            qs.append(jnp.floor_divide(cur, n))
+            r = cur - qs[-1] * n
+        q = jnp.stack(qs, axis=-1)
+        q = q.at[..., -1].add((2 * r >= n).astype(jnp.int64))
+        q = d128._norm_wide(q)
+        return jnp.where(neg[..., None], d128.neg(q), q)
     hi, lo = a[..., 0], a[..., 1]
     m = jnp.int64(1_000_000)
     digits = [hi // (m * m), (hi // m) % m, hi % m,
@@ -1293,18 +1426,23 @@ def _minmax_lanes(fn: str, lanes, nonnull, gid_nn, n):
 
 
 def _minmax_long(fn: str, data, nonnull, gid_nn, n):
-    """Two-phase lexicographic extreme over (hi, lo) limb pairs — limb
-    order IS value order (lo canonical in [0, 10^18))."""
-    hi, lo = data[..., 0], data[..., 1]
+    """Phased lexicographic extreme over limb vectors, msb limb first —
+    canonical limb order IS value order (limbs[1:] in [0, base)).
+    Works for both the (.., 2) and the wide (.., 5) layouts."""
+    L = int(data.shape[-1])
     if fn == "min":
         red, fill = _seg_min, _I64_MAX
     else:
         red, fill = _seg_max, -_I64_MAX - 1
-    hi_best = red(jnp.where(nonnull, hi, fill), gid_nn, n + 1)[:n]
-    tie = nonnull & (hi == hi_best[jnp.clip(gid_nn, 0, n - 1)])
-    gid_tie = jnp.where(tie, gid_nn, n)
-    lo_best = red(jnp.where(tie, lo, fill), gid_tie, n + 1)[:n]
-    return [jnp.stack([hi_best, lo_best], axis=-1)]
+    tie = nonnull
+    bests = []
+    for i in range(L):
+        limb = data[..., i]
+        gid_tie = jnp.where(tie, gid_nn, n)
+        best = red(jnp.where(tie, limb, fill), gid_tie, n + 1)[:n]
+        bests.append(best)
+        tie = tie & (limb == best[jnp.clip(gid_nn, 0, n - 1)])
+    return [jnp.stack(bests, axis=-1)]
 
 
 # ---------------------------------------------------------------------------

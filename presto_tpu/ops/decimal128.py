@@ -1,15 +1,23 @@
-"""Long-decimal (p <= 36) limb arithmetic.
+"""Long-decimal limb arithmetic (p <= 38).
 
 Reference analog: ``presto-spi/.../type/Decimals.java`` +
 ``UnscaledDecimal128Arithmetic.java`` — the reference packs 128-bit
 unscaled values into two java longs and implements add/compare/rescale
-over them.  TPU redesign: limbs are **base 10^18** signed int64 arrays
-(`value = hi * 10^18 + lo`, invariant `0 <= lo < 10^18`), so every
-carry/borrow is a native vector op — no 128-bit emulation, no byte
-swizzles, and decimal rescaling by powers of ten stays exact.
+over them.  TPU redesign: limb vectors in native int64, so every
+carry/borrow is a vector op — no 128-bit emulation, no byte swizzles,
+and decimal rescaling by powers of ten stays exact.
 
-Device layout: a long-decimal Block's data has shape (capacity, 2) with
-[:, 0] = hi, [:, 1] = lo.
+Two layouts, selected by precision (dispatch is on the trailing array
+dimension, so call sites stay layout-blind):
+  p <= 36: (capacity, 2) base-10^18 limbs  (value = hi*10^18 + lo)
+  p <= 38: (capacity, 5) base-10^9  limbs  (most-significant first) —
+           the r5 extension for DecimalType.java's full 38 digits.
+           add/sub/compare/sum/avg/rescale/casts are exact; products
+           beyond 36 digits remain unsupported (the reference caps at
+           38 TOTAL digits, so p38 x pN multiplication overflows there
+           too).
+The canonical form keeps limbs [1:] in [0, base); limb 0 carries the
+sign, making lexicographic limb order the value order.
 """
 
 from __future__ import annotations
@@ -22,25 +30,55 @@ import numpy as np
 
 BASE = 10 ** 18
 _B9 = 10 ** 9
+#: limb count of the wide (p in (36, 38]) layout
+WIDE_LIMBS = 5
+
+
+def width(d) -> int:
+    """Limb count of a decimal array (2 = base-10^18, 5 = base-10^9)."""
+    return int(d.shape[-1])
+
+
+def _norm_wide(limbs: "jax.Array") -> "jax.Array":
+    """Restore the canonical form of a (..., 5) base-10^9 vector."""
+    outs = []
+    carry = jnp.zeros_like(limbs[..., 0])
+    for i in range(WIDE_LIMBS - 1, 0, -1):
+        cur = limbs[..., i] + carry
+        carry = jnp.floor_divide(cur, _B9)
+        outs.append(cur - carry * _B9)
+    outs.append(limbs[..., 0] + carry)
+    return jnp.stack(outs[::-1], axis=-1)
 
 
 # -- host-side encode/decode --------------------------------------------------
 
-def encode_py(values, capacity: int) -> np.ndarray:
-    """Python ints (arbitrary precision) -> (capacity, 2) limbs."""
-    out = np.zeros((capacity, 2), dtype=np.int64)
+def encode_py(values, capacity: int, limbs: int = 2) -> np.ndarray:
+    """Python ints (arbitrary precision) -> (capacity, limbs) limbs."""
+    out = np.zeros((capacity, limbs), dtype=np.int64)
+    base = BASE if limbs == 2 else _B9
     for i, v in enumerate(values):
         if v is None:
             continue
-        hi, lo = divmod(int(v), BASE)  # python divmod: 0 <= lo < BASE
-        out[i, 0] = hi
-        out[i, 1] = lo
+        rest = int(v)
+        for j in range(limbs - 1, 0, -1):
+            rest, lo = divmod(rest, base)
+            out[i, j] = lo
+        out[i, 0] = rest
     return out
 
 
 def decode_py(limbs: np.ndarray):
-    """(n, 2) limbs -> list of python ints."""
-    return [int(h) * BASE + int(l) for h, l in np.asarray(limbs, dtype=np.int64)]
+    """(n, L) limbs -> list of python ints."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    base = BASE if arr.shape[-1] == 2 else _B9
+    out = []
+    for row in arr:
+        v = int(row[0])
+        for x in row[1:]:
+            v = v * base + int(x)
+        out.append(v)
+    return out
 
 
 # -- normalization ------------------------------------------------------------
@@ -61,24 +99,42 @@ def split(d: jax.Array) -> Tuple[jax.Array, jax.Array]:
 # -- arithmetic ---------------------------------------------------------------
 
 def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    if width(a) != 2:
+        return _norm_wide(a + b)  # limb sums < 2*10^9: no overflow
     ah, al = split(a)
     bh, bl = split(b)
     return normalize(ah + bh, al + bl)  # lo sums < 2*BASE: no int64 overflow
 
 
 def neg(a: jax.Array) -> jax.Array:
+    if width(a) != 2:
+        return _norm_wide(-a)
     ah, al = split(a)
     return normalize(-ah, -al)
 
 
 def sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    if width(a) != 2:
+        return _norm_wide(a - b)
     ah, al = split(a)
     bh, bl = split(b)
     return normalize(ah - bh, al - bl)
 
 
-def from_int64(x: jax.Array) -> jax.Array:
+def widen(a: jax.Array) -> jax.Array:
+    """(n, 2) base-10^18 -> (n, 5) base-10^9 (decimal(38) coercion)."""
+    ah, al = split(a)
+    z = jnp.zeros_like(ah)
+    h1, h0 = jnp.floor_divide(ah, _B9), jnp.remainder(ah, _B9)
+    l1, l0 = jnp.floor_divide(al, _B9), jnp.remainder(al, _B9)
+    return _norm_wide(jnp.stack([z, h1, h0, l1, l0], axis=-1))
+
+
+def from_int64(x: jax.Array, limbs: int = 2) -> jax.Array:
     """Short (int64) value -> limbs."""
+    if limbs != 2:
+        cols = [jnp.zeros_like(x)] * (limbs - 1) + [x]
+        return _norm_wide(jnp.stack(cols, axis=-1))
     return normalize(jnp.zeros_like(x), x)
 
 
@@ -86,6 +142,16 @@ def mul_small(a: jax.Array, k: jax.Array) -> jax.Array:
     """Multiply limbs by a small int64 (|k| <= ~4*10^9, e.g. rescale
     powers of ten): split lo into base-10^9 halves so every partial
     product fits int64."""
+    if width(a) != 2:
+        # wide limbs are base 10^9: limb*k <= 10^9 * 4*10^9 overflows,
+        # so split k into <= 10^5-sized steps at the call sites; here
+        # k must stay <= ~9*10^9 / 1 — enforce the per-limb bound via
+        # base-10^5 halves of each limb
+        k5h = jnp.floor_divide(k, 100_000)
+        k5l = k - k5h * 100_000
+        hi_part = _norm_wide(a * k5h)          # limb * k/1e5 < 9e18/1e5*1e9 ok? see below
+        lo_part = _norm_wide(a * k5l)          # limb*1e5 < 1e14 ok
+        return add(_shift_digits_wide(hi_part, 5), lo_part)
     ah, al = split(a)
     l1, l0 = jnp.floor_divide(al, _B9), jnp.remainder(al, _B9)
     p0 = l0 * k  # < 10^9 * 4*10^9 < 9.2*10^18 OK
@@ -112,16 +178,60 @@ def mul_long_short(a: jax.Array, k: jax.Array) -> jax.Array:
     """Long limbs x int64 scaled value: (hi*B + lo)*k = (hi*k)*B + lo*k,
     with lo*k going through the full int64 multiplier. Exact whenever
     the result fits p<=36 (hi*k then < 10^18)."""
+    if width(a) != 2:
+        raise ValueError(
+            "decimal multiplication beyond 36 digits unsupported "
+            "(the reference's 38-digit cap overflows there too)")
     ah, al = split(a)
     low = mul_int64(al, k)
     lh, ll = split(low)
     return normalize(ah * k + lh, ll)
 
 
+def _shift_digits_wide(a: jax.Array, k: int) -> jax.Array:
+    """Multiply a wide vector by 10^k for k in [0, 9) via limb-local
+    shifts: each limb splits at 10^(9-k), the high part carries into
+    the next limb.  The most-significant limb's carry-out is dropped:
+    an upscale past 38 total digits wraps (documented deviation — the
+    reference raises DECIMAL overflow; in-jit code cannot raise, and
+    rescales the planner emits stay within the declared precision)."""
+    if k == 0:
+        return a
+    m = 10 ** (9 - k)
+    mul = 10 ** k
+    high = jnp.floor_divide(a, m)      # carries up
+    low = a - high * m
+    shifted = low * mul
+    carried = jnp.concatenate(
+        [high[..., 1:], jnp.zeros_like(high[..., :1])], axis=-1)
+    return _norm_wide(shifted + carried)
+
+
+def _downscale_wide(a: jax.Array, k: int) -> jax.Array:
+    """Floor-divide a wide vector by 10^k (k <= 9 per step): remainder
+    chain over base-10^9 limbs, msb first (r < 10^k <= 10^9, so
+    r*10^9 + limb < 10^18)."""
+    d = 10 ** k
+    outs = []
+    r = jnp.zeros_like(a[..., 0])
+    for i in range(WIDE_LIMBS):
+        cur = r * _B9 + a[..., i]
+        q = jnp.floor_divide(cur, d)
+        r = cur - q * d
+        outs.append(q)
+    return _norm_wide(jnp.stack(outs, axis=-1))
+
+
 def rescale(a: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
     if to_scale > from_scale:
         k = to_scale - from_scale
         out = a
+        if width(a) != 2:
+            while k > 0:
+                step = min(k, 8)
+                out = _shift_digits_wide(out, step)
+                k -= step
+            return out
         while k > 0:  # static python loop: at most a few steps of 10^9
             step = min(k, 9)
             out = mul_small(out, jnp.asarray(10 ** step, jnp.int64))
@@ -129,6 +239,13 @@ def rescale(a: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
         return out
     if to_scale < from_scale:
         k = from_scale - to_scale
+        if width(a) != 2:
+            out = a
+            while k > 0:
+                step = min(k, 9)
+                out = _downscale_wide(out, step)
+                k -= step
+            return out
         if k > 18:
             raise ValueError("long-decimal downscale beyond 18 digits unsupported")
         d = 10 ** k  # k <= 18: divides BASE exactly
@@ -141,16 +258,24 @@ def rescale(a: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
 
 
 def compare(a: jax.Array, b: jax.Array):
-    """(lt, eq, gt) boolean triples — limb order is value order since
-    lo is canonical."""
-    ah, al = split(a)
-    bh, bl = split(b)
-    lt = (ah < bh) | ((ah == bh) & (al < bl))
-    eq = (ah == bh) & (al == bl)
+    """(lt, eq, gt) boolean triples — canonical limb order (msb-first,
+    limbs[1:] non-negative) IS value order, any width."""
+    L = width(a)
+    lt = jnp.zeros(a.shape[:-1], jnp.bool_)
+    eq = jnp.ones(a.shape[:-1], jnp.bool_)
+    for i in range(L):
+        ai, bi = a[..., i], b[..., i]
+        lt = lt | (eq & (ai < bi))
+        eq = eq & (ai == bi)
     return lt, eq, ~(lt | eq)
 
 
 def to_double(a: jax.Array, scale: int) -> jax.Array:
+    if width(a) != 2:
+        acc = a[..., 0].astype(jnp.float64)
+        for i in range(1, WIDE_LIMBS):
+            acc = acc * float(_B9) + a[..., i].astype(jnp.float64)
+        return acc / (10.0 ** scale)
     ah, al = split(a)
     return (ah.astype(jnp.float64) * float(BASE) + al.astype(jnp.float64)) / (10.0 ** scale)
 
@@ -159,7 +284,10 @@ def to_double(a: jax.Array, scale: int) -> jax.Array:
 
 def to_sum_limbs(a: jax.Array) -> jax.Array:
     """(n, 2) base-10^18 -> (n, 4) base-10^9 limbs, safe to segment_sum
-    over ~9*10^9 rows without int64 overflow."""
+    over ~9*10^9 rows without int64 overflow.  Wide (n, 5) vectors are
+    already base-10^9: summed as-is under the same row bound."""
+    if width(a) != 2:
+        return a
     ah, al = split(a)
     return jnp.stack([
         jnp.floor_divide(ah, _B9), jnp.remainder(ah, _B9),
@@ -168,7 +296,10 @@ def to_sum_limbs(a: jax.Array) -> jax.Array:
 
 
 def from_sum_limbs(s: jax.Array) -> jax.Array:
-    """(n, 4) summed base-10^9 limbs -> normalized (n, 2)."""
+    """Summed base-10^9 limbs -> normalized: (n, 4) -> (n, 2) for the
+    classic layout, (n, 5) -> (n, 5) for the wide layout."""
+    if s.shape[-1] == WIDE_LIMBS:
+        return _norm_wide(s)
     h1, h0, l1, l0 = s[..., 0], s[..., 1], s[..., 2], s[..., 3]
     # fold base-10^9 carries upward
     c = jnp.floor_divide(l0, _B9)

@@ -104,10 +104,15 @@ class JoinBuild:
     # sort-free unique-build path: False iff the planner's uniqueness
     # promise was violated at runtime (caller rebuilds via the sort)
     unique_ok: Optional[jax.Array] = None
+    # three-valued IN/NOT IN support (HashSemiJoinOperator.java:32):
+    # whether any live build row had a NULL key, and whether the build
+    # had any live row at all — device bool scalars
+    has_null_key: Optional[jax.Array] = None
+    nonempty: Optional[jax.Array] = None
 
     def tree_flatten(self):
         return (self.sorted_keys, self.perm, self.page, self.starts,
-                self.unique_ok), None
+                self.unique_ok, self.has_null_key, self.nonempty), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -149,6 +154,14 @@ def build_join(
             live = live & v
     key = jnp.where(live, key, jnp.iinfo(key.dtype).max)
 
+    # three-valued IN/NOT IN metadata (cheap reductions; only the
+    # null-aware semi/anti/mark probes read them)
+    nonempty = jnp.any(page.row_mask)
+    all_valid = valids[0]
+    for v in valids[1:]:
+        all_valid = all_valid & v
+    has_null = jnp.any(page.row_mask & jnp.logical_not(all_valid))
+
     prod_u = (packed_domain_size(key_domains)
               if unique and exact and _unique_direct_enabled() else None)
     if prod_u is not None and prod_u <= _direct_budget(page):
@@ -168,7 +181,8 @@ def build_join(
             jnp.arange(cap, dtype=jnp.int32), mode="drop")
         collision = jnp.any(counts[:prod_u] > 1)
         return JoinBuild(sorted_keys, order_u, page, starts_u,
-                         unique_ok=jnp.logical_not(collision))
+                         unique_ok=jnp.logical_not(collision),
+                         has_null_key=has_null, nonempty=nonempty)
 
     order = jnp.argsort(key)
     sorted_keys = key[order]
@@ -182,7 +196,22 @@ def build_join(
         queries = jnp.arange(prod + 1, dtype=sorted_keys.dtype)
         starts = jnp.searchsorted(
             sorted_keys, queries, method="sort").astype(jnp.int32)
-    return JoinBuild(sorted_keys, order.astype(jnp.int32), page, starts)
+    return JoinBuild(sorted_keys, order.astype(jnp.int32), page, starts,
+                     has_null_key=has_null, nonempty=nonempty)
+
+
+def build_null_flags(page: Page, key_exprs: Sequence[Expr]):
+    """(has_null_key, nonempty) of a build-side page WITHOUT building
+    the sorted index — used by partitioned joins to compute the GLOBAL
+    three-valued-IN flags across partitions (a build NULL in one
+    partition makes every unmatched probe everywhere UNKNOWN)."""
+    c = ExprCompiler.for_page(page)
+    valids = [c.compile(e)(page)[1] for e in key_exprs]
+    all_valid = valids[0]
+    for v in valids[1:]:
+        all_valid = all_valid & v
+    return (jnp.any(page.row_mask & jnp.logical_not(all_valid)),
+            jnp.any(page.row_mask))
 
 
 def _lookup_first(build: JoinBuild, key: jax.Array):
@@ -240,6 +269,7 @@ def probe_join(
     kind: str = "inner",
     build_output: Optional[Sequence[int]] = None,
     null_safe: bool = False,
+    null_aware: bool = False,
 ) -> Page:
     """Probe-aligned join for unique (or first-match) build keys.
 
@@ -247,11 +277,37 @@ def probe_join(
     Output: probe blocks followed by the selected build blocks
     (build_output indexes into build.page.blocks; default all).
     semi/anti emit probe blocks only, with the row mask filtered.
+
+    ``null_aware`` selects ANSI three-valued IN/NOT IN semantics
+    (HashSemiJoinOperator.java:32): an unmatched probe whose key is
+    NULL — or any unmatched probe when the build holds a NULL key —
+    is UNKNOWN, which filters as FALSE (semi/anti) and surfaces as a
+    NULL mark.  IN over an empty subquery stays FALSE for every probe,
+    NULL keys included.
     """
-    key, _ = _probe_keys(probe, probe_key_exprs, key_domains, null_safe)
+    key, ok = _probe_keys(probe, probe_key_exprs, key_domains, null_safe)
     pos_c, found = _lookup_first(build, key)
     match = found & probe.row_mask
     build_row = build.perm[pos_c]
+
+    if null_aware and kind in ("semi", "anti", "mark") \
+            and build.has_null_key is not None:
+        has_null = build.has_null_key
+        nonempty = build.nonempty
+        # UNKNOWN rows: unmatched with a NULL somewhere in the
+        # comparison (probe key NULL against a nonempty build, or any
+        # build-side NULL key); empty build is decidedly FALSE
+        unknown = jnp.logical_not(match) & nonempty & (
+            jnp.logical_not(ok) | has_null)
+        if kind == "semi":
+            return Page(probe.blocks, probe.row_mask & match)
+        if kind == "anti":
+            keep = jnp.logical_not(match) & jnp.logical_not(unknown)
+            return Page(probe.blocks, probe.row_mask & keep)
+        from presto_tpu.types import BOOLEAN
+
+        mark = Block(match, jnp.logical_not(unknown), BOOLEAN)
+        return Page(tuple(probe.blocks) + (mark,), probe.row_mask)
 
     if kind == "semi":
         return Page(probe.blocks, probe.row_mask & match)

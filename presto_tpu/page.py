@@ -114,10 +114,12 @@ class Block:
         if type_.is_long_decimal and (
             not isinstance(values, np.ndarray) or values.ndim == 1
         ):
-            # python ints (possibly > 2^63) -> two base-10^18 limbs
+            # python ints (possibly > 2^63) -> base-10^18 (or, for
+            # decimal(37..38), base-10^9) limbs
             from presto_tpu.ops.decimal128 import encode_py
 
-            data = encode_py(list(values), cap)
+            data = encode_py(list(values), cap,
+                             limbs=type_.value_shape[0])
         elif type_.is_raw_string and not isinstance(values, np.ndarray):
             from presto_tpu.ops.rawstring import encode_strings
 
@@ -279,8 +281,10 @@ class Page:
                 from presto_tpu.ops.decimal128 import decode_py
 
                 vals = np.empty(len(data), dtype=object)
-                vals[:] = [decimal.Decimal(v).scaleb(-(b.type.scale or 0))
-                           for v in decode_py(data)]
+                with decimal.localcontext() as ctx:
+                    ctx.prec = 50  # scaleb must not round 38-digit values
+                    vals[:] = [decimal.Decimal(v).scaleb(-(b.type.scale or 0))
+                               for v in decode_py(data)]
             elif b.type.is_decimal:
                 # exact scaled-int values surface as decimal.Decimal —
                 # floats would silently round p>15 results (the

@@ -591,6 +591,7 @@ class DistributedRunner:
             kd = node.key_domains
             kind = node.kind
             ns = node.null_safe_keys
+            na = getattr(node, "null_aware", False)
             build_output = list(range(len(node.right.channels)))
             streaming = _is_streaming_join(node)
             cfg = self._join_cfg_for(node, ctx.cap)
@@ -606,7 +607,7 @@ class DistributedRunner:
                             probe_join(
                                 c[key], q, left_keys, key_domains=kd,
                                 kind=kind, build_output=build_output,
-                                null_safe=ns,
+                                null_safe=ns, null_aware=na,
                             ),
                             ch,
                         )
@@ -639,6 +640,7 @@ class DistributedRunner:
                         out = probe_join(
                             _squeeze(c[key]), q, left_keys, key_domains=kd,
                             kind=kind, build_output=build_output, null_safe=ns,
+                            null_aware=na,
                         )
                         return out, ch
 
@@ -672,6 +674,7 @@ class DistributedRunner:
                     out = probe_join(
                         _squeeze(c[key]), ex, left_keys, key_domains=kd,
                         kind=kind, build_output=build_output, null_safe=ns,
+                        null_aware=na,
                     )
                     return out, {**ch, fill_check: fill}
 

@@ -212,6 +212,11 @@ def decide_join_distribution(
     if isinstance(jnode, CrossSingleNode):
         return "broadcast", 1
     est = estimate_rows(jnode.right)
+    if getattr(jnode, "null_aware", False):
+        # three-valued IN/NOT IN: the "build holds a NULL key" flag is a
+        # whole-relation property, so the build must be replicated — a
+        # hash-partitioned build would confine the NULL to one shard
+        return "broadcast", est
     if forced == "BROADCAST":
         return "broadcast", est
     chainable = build_side_chainable(jnode.right)

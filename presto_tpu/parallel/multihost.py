@@ -23,6 +23,8 @@ from __future__ import annotations
 import json
 import threading
 import time
+
+import numpy as np
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
@@ -195,11 +197,18 @@ class MultiHostRunner:
         self.worker_locations = {w: locs.get(w.uri) for w in self.workers}
         self.max_splits_per_node = max_splits_per_node
         self.execution_policy = execution_policy
+        # stage-DAG knobs/observability (mirrors DistributedRunner)
+        from presto_tpu.parallel.fragment import DEFAULT_MIN_STAGE_ROWS
+
+        self.min_stage_rows = DEFAULT_MIN_STAGE_ROWS
+        self.last_stage_count = 0
+        self.last_gather_rows = 0
         # observability: last split placement per stage-launch
         # ({worker uri: [split ids]})
         self.last_assignments: Dict[str, List[int]] = {}
 
     def run(self, plan: PlanNode) -> MaterializedResult:
+        self.last_gather_rows = 0  # rows pulled to the coordinator
         try:
             return self._run_distributed(plan)
         except MultiHostUnsupported:
@@ -207,69 +216,206 @@ class MultiHostRunner:
 
     # ------------------------------------------------------------------
     def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
-        path: List[PlanNode] = []
-        node = plan
-        while not isinstance(node, AggregationNode):
-            if isinstance(node, (OutputNode, ProjectNode, FilterNode, SortNode,
-                                 TopNNode, LimitNode, WindowNode)):
-                path.append(node)
-                node = node.source
-            else:
-                return self._run_chain_distributed(plan)
-        agg = node
-        if agg.step != "single":
-            raise MultiHostUnsupported("non-single aggregation")
+        """Generalized stage-DAG execution at the DCN tier — the same
+        bottom-up ``lower_stages`` decomposition the mesh tier runs
+        (PlanFragmenter.java:84 + SqlQueryScheduler.java:441):
+        aggregation stages and streaming-chain stages execute as HTTP
+        worker fragments (leaves are table scans OR re-chunked
+        materialized intermediates of earlier stages), glue breakers
+        (sort/union/limit/window) evaluate on the coordinator between
+        stages, and the residual root runs locally over the spliced
+        results."""
+        from presto_tpu.parallel.fragment import (
+            lower_stages, set_child, undistributable_reason,
+        )
 
-        scan = self._leaf_scan(agg.source)
-        merged = self._run_agg_with_retry(agg, scan)
+        def run_agg(node: AggregationNode) -> PrecomputedNode:
+            page = self._stage_agg(node)
+            return PrecomputedNode(page=page, channel_list=node.channels)
 
-        pre = PrecomputedNode(page=merged, channel_list=agg.channels)
-        if not path:
-            out = self.local.run(pre)
-            out.names, out.types = plan.output_names, plan.output_types
-            return out
-        parent = path[-1]
-        original = parent.source
+        def run_chain(node: PlanNode, bound=None) -> PrecomputedNode:
+            page = self._stage_chain(node, bound)
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
+        def eval_glue(node: PlanNode) -> PrecomputedNode:
+            page = self.local.run_to_page(node)
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
+        splices: List = []
         try:
-            parent.source = pre
-            return self.local.run(plan)
+            n_stages, root = lower_stages(
+                plan, run_agg, run_chain, eval_glue, splices,
+                min_stage_rows=self.min_stage_rows)
+            if n_stages == 0:
+                raise MultiHostUnsupported(undistributable_reason(plan))
+            self.last_stage_count = n_stages
+            out = self.local.run(root)
+            if root is not plan:
+                out.names, out.types = plan.output_names, plan.output_types
+            return out
         finally:
-            parent.source = original
+            for parent, slot, old in reversed(splices):
+                set_child(parent, slot, old)
 
-    def _run_chain_distributed(self, plan: PlanNode) -> MaterializedResult:
-        """Non-aggregate plans: ship the streaming chain as worker
-        fragments (split subsets), gather pages, and run the local
-        sort/window/limit tail over the union (the SOURCE-fragment
-        execution of plain queries at the DCN tier)."""
+    # -- stage executors ------------------------------------------------
+    def _stage_agg(self, agg: AggregationNode):
+        """Aggregation stage: scan-leaf chains go through the two-stage
+        worker shuffle / coordinator-merge retry machinery; chains over
+        a materialized intermediate run worker-side partials over
+        re-chunked input with a coordinator merge."""
+        if agg.step != "single":
+            raise MultiHostUnsupported("non-single aggregation stage")
+        leaf = self.local._chain_leaf(agg.source)
+        if isinstance(leaf, TableScanNode):
+            return self._run_agg_with_retry(agg, leaf)
+        if isinstance(leaf, PrecomputedNode):
+            return self._run_agg_over_pre(agg, leaf)
+        raise MultiHostUnsupported("aggregation stage leaf is neither "
+                                   "scan nor materialized input")
+
+    def _stage_chain(self, chain_root: PlanNode, bound=None):
+        """Streaming-chain stage (SOURCE fragment).  A consuming
+        TopN/Limit ``bound`` ships as part of the fragment so each
+        WORKER truncates to ``count`` rows before the gather moves
+        O(workers x count) rows instead of the full selectivity
+        (CreatePartialTopN.java / per-shard bound at the DCN tier);
+        the coordinator's own bound node still does the global pick."""
         from presto_tpu.page import concat_pages_host
 
-        spine: List[PlanNode] = []
-        node = plan
-        while isinstance(node, (OutputNode, ProjectNode, FilterNode, SortNode,
-                                TopNNode, LimitNode, WindowNode)):
-            spine.append(node)
-            node = node.source
-        last_break = -1
-        for i, sp in enumerate(spine):
-            if isinstance(sp, (SortNode, TopNNode, LimitNode, WindowNode)):
-                last_break = i
-        path = spine[: last_break + 1]
-        chain_root = spine[last_break + 1] if last_break + 1 < len(spine) else node
-        scan = self._leaf_scan(chain_root)
-        pages = self._run_fragments(chain_root, scan)
-        merged = concat_pages_host(pages)
-        pre = PrecomputedNode(page=merged, channel_list=chain_root.channels)
-        parent = path[-1] if path else None
-        if parent is None:
-            out = self.local.run(pre)
-            out.names, out.types = plan.output_names, plan.output_types
-            return out
-        original = parent.source
-        try:
-            parent.source = pre
-            return self.local.run(plan)
-        finally:
-            parent.source = original
+        leaf = self.local._chain_leaf(chain_root)
+        frag: PlanNode = chain_root
+        if isinstance(bound, TopNNode):
+            frag = TopNNode(source=chain_root,
+                            sort_exprs=list(bound.sort_exprs),
+                            ascending=list(bound.ascending),
+                            count=bound.count,
+                            nulls_first=bound.nulls_first)
+        elif isinstance(bound, LimitNode):
+            frag = LimitNode(source=chain_root, count=bound.count)
+        if isinstance(leaf, TableScanNode):
+            pages = self._run_fragments(frag, leaf)
+        elif isinstance(leaf, PrecomputedNode):
+            pages = self._run_fragments_pre(frag, leaf)
+        else:
+            raise MultiHostUnsupported("chain stage leaf is neither scan "
+                                       "nor materialized input")
+        for p in pages:
+            self.last_gather_rows += int(np.asarray(p.row_mask).sum())
+        if not pages:  # an empty intermediate produced zero chunks
+            from presto_tpu.page import Page
+
+            return Page.empty([c.type for c in chain_root.channels], 1)
+        return concat_pages_host(pages)
+
+    def _run_agg_over_pre(self, agg: AggregationNode, pre: PrecomputedNode):
+        """Distributed aggregation whose input is a previous stage's
+        materialized output: re-chunk the page across workers, run the
+        partial aggregation worker-side, merge on the coordinator with
+        the usual truncation-detect-and-double protocol."""
+        from presto_tpu.exec.local import MAX_AGG_GROUPS, GroupCapacityExceeded
+
+        mg = self.local._max_groups(agg)
+        check = bool(agg.group_exprs) and not self.local._exact_capacity(
+            agg, mg)
+        while True:
+            partial = AggregationNode(
+                source=agg.source, group_exprs=agg.group_exprs,
+                group_names=agg.group_names, aggs=agg.aggs,
+                agg_names=agg.agg_names, step="partial", max_groups=mg,
+            )
+            pages = self._run_fragments_pre(partial, pre)
+            if not pages:  # empty intermediate: no partial states
+                from presto_tpu.page import Page
+
+                pages = [Page.empty([c.type for c in partial.channels], 1)]
+            if check and any(
+                int(np.asarray(p.row_mask).sum()) >= mg for p in pages
+            ):
+                if mg >= MAX_AGG_GROUPS:
+                    raise RuntimeError("aggregation capacity ceiling")
+                mg *= 2
+                continue
+            merge_mg = mg
+            while True:
+                final = AggregationNode(
+                    source=PrecomputedNode(
+                        page=concat_pages_device(pages),
+                        channel_list=partial.channels,
+                    ),
+                    group_exprs=[_key_ref(partial, i)
+                                 for i in range(len(agg.group_exprs))],
+                    group_names=agg.group_names, aggs=agg.aggs,
+                    agg_names=agg.agg_names, step="final",
+                    max_groups=merge_mg,
+                )
+                try:
+                    return self.local._execute_to_page(final)
+                except GroupCapacityExceeded:
+                    if merge_mg >= MAX_AGG_GROUPS:
+                        raise RuntimeError("aggregation capacity ceiling")
+                    merge_mg *= 2
+
+    def _run_fragments_pre(self, fragment_root: PlanNode,
+                           pre: PrecomputedNode) -> List["Page"]:
+        """Ship a fragment whose chain leaf is a materialized page:
+        the page re-chunks row-wise across live workers and each chunk
+        travels INSIDE its worker's fragment (serde "pre" node).  A
+        failed worker's chunk re-runs on a survivor."""
+        alive = [w for w in self.workers if w.ping()]
+        if not alive:
+            raise MultiHostUnsupported("no live workers")
+        chunks = _chunk_page(pre.page, len(alive))
+        dictionaries = [c.dictionary for c in fragment_root.channels]
+
+        results: List[bytes] = []
+        lock = threading.Lock()
+        failed: List[tuple] = []
+
+        def make_fragment(chunk) -> dict:
+            original = pre.page
+            try:
+                pre.page = chunk
+                return plan_to_json(fragment_root)
+            finally:
+                pre.page = original
+
+        errors: List[BaseException] = []
+
+        def run_on(w: WorkerClient, chunk, fragment: dict):
+            try:
+                raws = w.run_fragment(fragment)
+                with lock:
+                    results.extend(raws)
+            except ConnectionError:
+                with lock:
+                    failed.append(chunk)
+            except BaseException as e:  # deterministic query error:
+                with lock:              # must FAIL the query, not drop
+                    errors.append(e)    # the chunk's rows silently
+
+        def launch(pairs):
+            threads = [
+                threading.Thread(target=run_on, args=(w, c, make_fragment(c)))
+                for w, c in pairs if c is not None
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        launch(list(zip(alive, chunks)))
+        while failed:
+            if errors:
+                break
+            chunk = failed.pop()
+            survivors = [w for w in alive if w.alive]
+            if not survivors:
+                raise ConnectionError("all workers failed")
+            launch([(survivors[0], chunk)])
+        if errors:
+            raise errors[0]
+
+        return [deserialize_page(r, dictionaries) for r in results]
 
     def _run_agg_with_retry(self, agg: AggregationNode, scan: TableScanNode):
         """Grouped aggregations with >=2 live workers run the full
@@ -780,6 +926,8 @@ class MultiHostRunner:
             finally:
                 scan.splits = original
 
+        errors: List[BaseException] = []
+
         def run_on(w: WorkerClient, splits: List[int], fragment: dict):
             try:
                 raws = w.run_fragment(fragment)
@@ -788,6 +936,9 @@ class MultiHostRunner:
             except ConnectionError:
                 with lock:
                     failed.append((w, splits))
+            except BaseException as e:  # deterministic query error:
+                with lock:              # fail the query rather than
+                    errors.append(e)    # silently dropping the splits
 
         def launch(pairs):
             threads = [
@@ -803,14 +954,39 @@ class MultiHostRunner:
 
         # failover: re-run dead workers' splits on survivors
         while failed:
+            if errors:
+                break
             w_dead, splits = failed.pop()
             survivors = [w for w in alive if w.alive]
             if not survivors:
                 raise ConnectionError("all workers failed")
             chunks = [splits[i :: len(survivors)] for i in range(len(survivors))]
             launch(list(zip(survivors, chunks)))
+        if errors:
+            raise errors[0]
 
         return [deserialize_page(r, dictionaries) for r in results]
+
+
+def _chunk_page(page, k: int):
+    """Row-chunk a (possibly device) page into ``k`` contiguous
+    host-side pieces for re-distribution; dead rows are dropped first
+    so chunk sizes reflect live data."""
+    from presto_tpu.page import Block, Page
+
+    p = page.compact_host()
+    n = int(np.asarray(p.row_mask).sum())
+    bounds = [round(i * n / k) for i in range(k + 1)]
+    chunks = []
+    for lo, hi in zip(bounds, bounds[1:]):
+        if hi == lo:
+            chunks.append(None)
+            continue
+        blocks = tuple(
+            Block(b.data[lo:hi], b.valid[lo:hi], b.type, b.dictionary)
+            for b in p.blocks)
+        chunks.append(Page(blocks, p.row_mask[lo:hi]))
+    return chunks
 
 
 def _key_ref(partial: AggregationNode, i: int):

@@ -252,6 +252,11 @@ class JoinNode(PlanNode):
     # NULL keys match each other (IS NOT DISTINCT FROM): the
     # INTERSECT/EXCEPT lowering's comparison semantics
     null_safe_keys: bool = False
+    # ANSI three-valued IN/NOT IN (HashSemiJoinOperator.java:32): an
+    # unmatched probe is NULL (not FALSE) when its key is NULL or the
+    # build side holds a NULL key.  Set for IN-subquery lowerings;
+    # EXISTS keeps plain semi/anti semantics.
+    null_aware: bool = False
 
     @property
     def sources(self):

@@ -176,3 +176,139 @@ class ResourceGroupManager:
             if g is not None:
                 return g
         return self.root
+
+
+class DbResourceGroupManager(ResourceGroupManager):
+    """sqlite-backed resource-group configuration with live reload
+    (resource-group-managers/.../db/DbResourceGroupConfigurationManager
+    .java: groups + selectors live in DB tables and the manager polls
+    for changes, so admins retune concurrency without a restart).
+
+    Schema (created on first use):
+      resource_groups(name PK, parent, hard_concurrency, max_queued,
+                      scheduling_policy, scheduling_weight)
+      selectors(user_regex, group_name, priority)
+
+    Reload: every ``poll_interval`` seconds the config tables are
+    re-read when sqlite's data_version pragma moved.  Rebuilt groups
+    REPLACE the tree for new queries; queries already queued keep their
+    admission slot in the old tree (the reference migrates running
+    queries the same lazily)."""
+
+    def __init__(self, path: str, poll_interval: float = 1.0):
+        import sqlite3
+
+        self.path = path
+        self.poll_interval = poll_interval
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS resource_groups ("
+            " name TEXT PRIMARY KEY, parent TEXT,"
+            " hard_concurrency INTEGER NOT NULL DEFAULT 8,"
+            " max_queued INTEGER NOT NULL DEFAULT 100,"
+            " scheduling_policy TEXT NOT NULL DEFAULT 'fair',"
+            " scheduling_weight INTEGER NOT NULL DEFAULT 1)")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS selectors ("
+            " user_regex TEXT NOT NULL, group_name TEXT NOT NULL,"
+            " priority INTEGER NOT NULL DEFAULT 0)")
+        self._db.commit()
+        self._version = -1
+        self._last_poll = 0.0
+        super().__init__()
+        self._reload()
+
+    # -- admin helpers (tests + operational tooling) -------------------
+    def upsert_group(self, name: str, parent: Optional[str] = None,
+                     hard_concurrency: int = 8, max_queued: int = 100,
+                     scheduling_policy: str = "fair",
+                     scheduling_weight: int = 1) -> None:
+        self._db.execute(
+            "INSERT INTO resource_groups VALUES (?,?,?,?,?,?) "
+            "ON CONFLICT(name) DO UPDATE SET parent=excluded.parent,"
+            " hard_concurrency=excluded.hard_concurrency,"
+            " max_queued=excluded.max_queued,"
+            " scheduling_policy=excluded.scheduling_policy,"
+            " scheduling_weight=excluded.scheduling_weight",
+            (name, parent, hard_concurrency, max_queued,
+             scheduling_policy, scheduling_weight))
+        self._db.commit()
+        # data_version only moves for OTHER connections' writes — a
+        # manager that edits its own config reloads itself directly
+        self._reload()
+
+    def add_db_selector(self, user_regex: str, group_name: str,
+                        priority: int = 0) -> None:
+        self._db.execute("INSERT INTO selectors VALUES (?,?,?)",
+                         (user_regex, group_name, priority))
+        self._db.commit()
+        self._reload()
+
+    # -- reload --------------------------------------------------------
+    def _data_version(self) -> int:
+        return self._db.execute("PRAGMA data_version").fetchone()[0]
+
+    def _maybe_reload(self) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._last_poll < self.poll_interval:
+            return
+        self._last_poll = now
+        v = self._data_version()
+        if v != self._version:
+            self._reload()
+
+    def _reload(self) -> None:
+        import re
+
+        self._version = self._data_version()
+        rows = self._db.execute(
+            "SELECT name, parent, hard_concurrency, max_queued,"
+            " scheduling_policy, scheduling_weight "
+            "FROM resource_groups").fetchall()
+        groups: Dict[str, ResourceGroup] = {}
+        root_row = next((r for r in rows if r[1] is None), None)
+        if root_row is None:
+            root = ResourceGroup("global", hard_concurrency=16,
+                                 max_queued=1000)
+        else:
+            root = ResourceGroup(root_row[0], root_row[2], root_row[3],
+                                 scheduling_policy=root_row[4],
+                                 scheduling_weight=root_row[5])
+            groups[root_row[0]] = root
+        pending = [r for r in rows if r[1] is not None]
+        # attach children breadth-first so parents exist
+        while pending:
+            progressed = False
+            for r in list(pending):
+                parent = groups.get(r[1])
+                if parent is None:
+                    continue
+                groups[r[0]] = parent.subgroup(
+                    r[0], r[2], r[3], scheduling_policy=r[4],
+                    scheduling_weight=r[5])
+                pending.remove(r)
+                progressed = True
+            if not progressed:  # orphan rows: ignore (bad parent name)
+                break
+        sel_rows = self._db.execute(
+            "SELECT user_regex, group_name, priority FROM selectors "
+            "ORDER BY priority DESC").fetchall()
+        selectors: List[Callable[[str], Optional[ResourceGroup]]] = []
+        for user_regex, group_name, _prio in sel_rows:
+            target = groups.get(group_name)
+            if target is None:
+                continue
+            pat = re.compile(user_regex)
+
+            def sel(user: str, pat=pat, target=target):
+                return target if pat.fullmatch(user) else None
+
+            selectors.append(sel)
+        self.root = root
+        self._selectors = selectors
+
+    def group_for(self, user: str) -> ResourceGroup:
+        self._maybe_reload()
+        return super().group_for(user)

@@ -170,6 +170,90 @@ class FilePasswordAuthenticator(PasswordAuthenticator):
             raise AuthenticationError(f"invalid credentials for {user}")
 
 
+class TokenAuthenticator:
+    """HMAC-signed ticket authentication — the second mechanism slot
+    the reference fills with Kerberos
+    (server/security/KerberosAuthenticator.java: the coordinator
+    verifies a ticket issued by a trusted authority; here the authority
+    is a shared-secret HMAC signer, the infrastructure-free analog).
+
+    Ticket format: ``user.expiry_epoch.hex(hmac_sha256(secret,
+    user.expiry))`` — self-describing, stateless verification."""
+
+    def __init__(self, secret: str):
+        self._secret = secret.encode()
+
+    def _sig(self, payload: str) -> str:
+        import hashlib
+        import hmac
+
+        return hmac.new(self._secret, payload.encode(),
+                        hashlib.sha256).hexdigest()
+
+    def issue(self, user: str, ttl_seconds: int = 3600) -> str:
+        import time
+
+        exp = int(time.time()) + ttl_seconds
+        payload = f"{user}.{exp}"
+        return f"{payload}.{self._sig(payload)}"
+
+    def authenticate_token(self, token: str) -> str:
+        """Returns the authenticated user, or raises."""
+        import hmac as _hmac
+        import time
+
+        parts = token.rsplit(".", 2)
+        if len(parts) != 3:
+            raise AuthenticationError("malformed token")
+        user, exp_s, sig = parts
+        if not _hmac.compare_digest(sig, self._sig(f"{user}.{exp_s}")):
+            raise AuthenticationError("bad token signature")
+        try:
+            exp = int(exp_s)
+        except ValueError:
+            raise AuthenticationError("malformed token expiry")
+        if exp < time.time():
+            raise AuthenticationError("token expired")
+        return user
+
+
+class AuthenticatorChain:
+    """Ordered authentication mechanisms; the first that accepts wins
+    (the reference's http-server.authentication.type=password,kerberos
+    list semantics).  Password mechanisms serve the Basic leg, token
+    mechanisms the Bearer leg."""
+
+    def __init__(self, *mechanisms):
+        self.mechanisms = list(mechanisms)
+
+    def authenticate(self, user: str, password: str) -> None:
+        last: Exception = AuthenticationError("no password mechanism")
+        for m in self.mechanisms:
+            if hasattr(m, "authenticate"):
+                try:
+                    return m.authenticate(user, password)
+                except AuthenticationError as e:
+                    last = e
+        raise last
+
+    def authenticate_token(self, token: str) -> str:
+        last: Exception = AuthenticationError("no token mechanism")
+        for m in self.mechanisms:
+            if hasattr(m, "authenticate_token"):
+                try:
+                    return m.authenticate_token(token)
+                except AuthenticationError as e:
+                    last = e
+        raise last
+
+
+def parse_bearer_auth(header: str):
+    """'Bearer <token>' -> token or None."""
+    if not header.startswith("Bearer "):
+        return None
+    return header[len("Bearer "):].strip() or None
+
+
 def parse_basic_auth(header: str):
     """'Basic base64(user:pass)' -> (user, password) or None."""
     import base64

@@ -149,10 +149,20 @@ class CoordinatorServer:
                 if outer.authenticator is None:
                     return True
                 from presto_tpu.security import (
-                    AuthenticationError, parse_basic_auth,
+                    AuthenticationError, parse_basic_auth, parse_bearer_auth,
                 )
 
-                got = parse_basic_auth(self.headers.get("Authorization", ""))
+                header = self.headers.get("Authorization", "")
+                token = parse_bearer_auth(header)
+                if token is not None \
+                        and hasattr(outer.authenticator,
+                                    "authenticate_token"):
+                    try:
+                        outer.authenticator.authenticate_token(token)
+                        return True
+                    except AuthenticationError:
+                        pass
+                got = parse_basic_auth(header)
                 if got is not None:
                     try:
                         outer.authenticator.authenticate(*got)

@@ -28,12 +28,14 @@ from presto_tpu.ops.window import WindowFunc
 from presto_tpu.page import Block, Page
 from presto_tpu.planner.plan import (
     AggregationNode,
+    Channel,
     CrossSingleNode,
     FilterNode,
     JoinNode,
     LimitNode,
     OutputNode,
     PlanNode,
+    PrecomputedNode,
     ProjectNode,
     RemoteSourceNode,
     SortNode,
@@ -81,6 +83,10 @@ def type_from_json(d: dict) -> Type:
         from presto_tpu.types import HllType
 
         return HllType()
+    if d["name"] == "setdigest":
+        from presto_tpu.types import SetDigestType
+
+        return SetDigestType()
     if d["name"] == "decimal":
         return DecimalType(d["precision"], d["scale"])
     if d.get("raw"):
@@ -189,10 +195,29 @@ def plan_to_json(node: PlanNode) -> dict:
             "rk": [expr_to_json(e) for e in node.right_keys],
             "kind": node.kind, "unique": node.unique_build,
             "null_safe": node.null_safe_keys,
+            "na": node.null_aware,
         }
     if isinstance(node, CrossSingleNode):
         return {"k": "cross1", "left": plan_to_json(node.left),
                 "right": plan_to_json(node.right)}
+    if isinstance(node, PrecomputedNode):
+        # a materialized intermediate travels INSIDE the fragment: how
+        # the DCN tier re-chunks one stage's output across the next
+        # stage's workers (the data-bearing half of the reference's
+        # RemoteSourceNode + exchange, for coordinator-pushed chunks)
+        import base64
+
+        return {
+            "k": "pre",
+            "page": base64.b64encode(serialize_page(node.page)).decode(),
+            "channels": [
+                {"name": c.name, "type": type_to_json(c.type),
+                 "dict": (list(c.dictionary.values)
+                          if c.dictionary is not None else None),
+                 "domain": list(c.domain) if c.domain else None}
+                for c in node.channel_list
+            ],
+        }
     if isinstance(node, SortNode):
         return {"k": "sort", "src": plan_to_json(node.source),
                 "keys": [expr_to_json(e) for e in node.sort_exprs],
@@ -263,11 +288,25 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
             [expr_from_json(e) for e in d["lk"]], [expr_from_json(e) for e in d["rk"]],
             kind=d["kind"], unique_build=d["unique"],
             null_safe_keys=d.get("null_safe", False),
+            null_aware=d.get("na", False),
         )
     if k == "cross1":
         return CrossSingleNode(
             plan_from_json(d["left"], catalog), plan_from_json(d["right"], catalog)
         )
+    if k == "pre":
+        from presto_tpu.page import Dictionary
+
+        channels = []
+        for c in d["channels"]:
+            dic = Dictionary(c["dict"]) if c.get("dict") is not None else None
+            channels.append(Channel(
+                name=c["name"], type=type_from_json(c["type"]),
+                dictionary=dic,
+                domain=tuple(c["domain"]) if c.get("domain") else None))
+        page = deserialize_page(base64.b64decode(d["page"]),
+                                [c.dictionary for c in channels])
+        return PrecomputedNode(page=page, channel_list=channels)
     if k == "sort":
         return SortNode(
             plan_from_json(d["src"], catalog),

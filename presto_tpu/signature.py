@@ -209,5 +209,10 @@ for _sig in [
     Signature.of("array_distinct", ["array(T)"], "array(T)"),
     Signature.of("map_keys", ["map(K,V)"], "array(K)"),
     Signature.of("map_values", ["map(K,V)"], "array(V)"),
+    # KMV set digests (type/setdigest/SetDigestFunctions.java)
+    Signature.of("jaccard_index", ["setdigest", "setdigest"], "double"),
+    Signature.of("intersection_cardinality", ["setdigest", "setdigest"],
+                 "bigint"),
+    Signature.of("hash_counts", ["setdigest"], "map(bigint,bigint)"),
 ]:
     REGISTRY.register(_sig)

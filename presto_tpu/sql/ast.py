@@ -144,6 +144,15 @@ class Subscript(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class FieldAccess(Node):
+    """ROW field access: expr.name (sql/tree/DereferenceExpression.java
+    when the base is row-typed)."""
+
+    base: "Node" = None
+    field: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class RowCtor(Node):
     """(e1, e2, ...) row constructor (sql/tree/Row.java) — desugars to
     pairwise comparisons in =/<>/IN contexts."""

@@ -73,6 +73,10 @@ AGG_FUNCTIONS = {
     "approx_set", "merge", "numeric_histogram", "multimap_agg",
     # presto-ml analogs: sufficient-statistic training aggregates
     "learn_regressor", "learn_classifier",
+    "learn_libsvm_regressor", "learn_libsvm_classifier",
+    # KMV set digests (type/setdigest/BuildSetDigestAggregation.java +
+    # MergeSetDigestAggregation.java)
+    "make_set_digest", "merge_set_digest",
 }
 
 # Correlated bindings mark outer-scope columns with this offset so a
@@ -133,6 +137,7 @@ SCALAR_FUNCTIONS = {
     "index", "char2hexint", "nvl",
     # ARRAY / MAP (operator/scalar/ArrayFunctions, MapKeys, MapValues...)
     "cardinality", "contains", "element_at", "array_position",
+    "jaccard_index", "intersection_cardinality", "hash_counts",
     "array_min", "array_max", "array_sum", "array_average",
     "array_sort", "array_distinct", "map_keys", "map_values", "map",
     "sequence", "slice", "repeat",
@@ -199,35 +204,55 @@ def desugar_quantified(e: ast.Node) -> ast.Node:
     """value op ANY|ALL (subquery) -> existing subquery forms
     (iterative/rule/TransformQuantifiedComparisonApplyToLateralJoin's
     role, done as an AST rewrite):
-      = ANY  -> IN            <> ALL -> NOT IN
-      < ANY  -> < max(S)      < ALL  -> < min(S)   (dually for >, <=, >=)
-      = ALL  -> = min(S) AND = max(S)
-    Deviation (PARITY.md): over an EMPTY subquery the min/max forms
-    yield NULL (row dropped) where ANSI ALL is TRUE."""
+      = ANY  -> IN        <> ALL -> NOT IN        <> ANY -> NOT (= ALL)
+      other op ANY/ALL -> CASE over min/max(S), count(*), count(S) with
+      the ANSI edge semantics (the reference QuantifiedComparison
+      rewriter's count-based expansion): ALL over empty is TRUE, ANY
+      over empty is FALSE, and a non-definitive comparison against a
+      set holding NULLs is UNKNOWN."""
+    if isinstance(e, ast.Unary) and e.op == "not":
+        # NOT (v op ALL/ANY ...) must still desugar underneath
+        inner = desugar_quantified(e.operand)
+        return e if inner is e.operand else ast.Unary("not", inner)
     if not isinstance(e, ast.QuantifiedComparison):
         return e
     if e.quantifier == "any" and e.op == "=":
         return ast.InSubquery(e.value, e.query, negated=False)
     if e.quantifier == "all" and e.op == "<>":
         return ast.InSubquery(e.value, e.query, negated=True)
+    if e.quantifier == "any" and e.op == "<>":
+        # v <> ANY S == NOT (v = ALL S) — exact under three-valued logic
+        return ast.Unary("not", desugar_quantified(
+            dataclasses.replace(e, op="=", quantifier="all")))
 
     if len(e.query.select) != 1 or isinstance(e.query.select[0].expr,
                                               ast.Star):
         raise BindError("quantified subquery must select one column")
 
-    def scalar(fn: str) -> ast.ScalarSubquery:
+    def scalar(fc: ast.FuncCall) -> ast.ScalarSubquery:
         q = e.query
         # the subquery stays INTACT as a derived table (its ORDER BY /
         # LIMIT apply before the aggregation); only the output column
-        # gains a referenceable alias
+        # gains a referenceable alias.  Every call builds a FRESH node
+        # — subquery planning is keyed by object identity, so shared
+        # nodes would double-plan.  KNOWN COST: the CASE forms below
+        # re-plan the subquery once per aggregate reference (4-6x); a
+        # single derived aggregation computing min/max/count(*)/count
+        # together would be 1x (needs multi-column scalar subqueries —
+        # future work, quantified comparisons are a rare operator).
         inner = dataclasses.replace(q.select[0], alias="__qc")
         wrapped = ast.Query(
-            select=(ast.SelectItem(
-                ast.FuncCall(fn, (ast.Identifier(("__qc",)),)), None),),
+            select=(ast.SelectItem(fc, None),),
             from_=(ast.SubqueryRel(
                 dataclasses.replace(q, select=(inner,)), alias="__q"),),
         )
         return ast.ScalarSubquery(wrapped)
+
+    def agg(fn: str) -> ast.FuncCall:
+        return ast.FuncCall(fn, (ast.Identifier(("__qc",)),))
+
+    def count_star() -> ast.ScalarSubquery:
+        return scalar(ast.FuncCall("count", (), star=True))
 
     minmax = {("<", "any"): "max", ("<=", "any"): "max",
               (">", "any"): "min", (">=", "any"): "min",
@@ -235,12 +260,34 @@ def desugar_quantified(e: ast.Node) -> ast.Node:
               (">", "all"): "max", (">=", "all"): "max"}
     key = (e.op, e.quantifier)
     if key in minmax:
-        return ast.Binary(e.op, e.value, scalar(minmax[key]))
-    if e.op == "=" and e.quantifier == "all":
-        return ast.Binary("and",
-                          ast.Binary("=", e.value, scalar("min")),
-                          ast.Binary("=", e.value, scalar("max")))
-    raise BindError(f"{e.op} {e.quantifier.upper()} (subquery) unsupported")
+        cmp = ast.Binary(e.op, e.value, scalar(agg(minmax[key])))
+    elif e.op == "=" and e.quantifier == "all":
+        cmp = ast.Binary("and",
+                         ast.Binary("=", e.value, scalar(agg("min"))),
+                         ast.Binary("=", e.value, scalar(agg("max"))))
+    else:
+        raise BindError(f"{e.op} {e.quantifier.upper()} (subquery) unsupported")
+
+    true_, false_ = ast.NumberLit("1"), ast.NumberLit("0")
+    no_nulls = ast.Binary("=", count_star(), scalar(agg("count")))
+    empty = ast.Binary("=", count_star(), ast.NumberLit("0"))
+    if e.quantifier == "all":
+        whens = (
+            (empty, true_),                              # vacuous truth
+            (ast.Binary("and", cmp, no_nulls), true_),
+            (cmp, ast.NullLit()),       # non-nulls passed, NULLs unknown
+            (ast.Unary("not", cmp), false_),             # definite miss
+        )
+    else:  # any
+        whens = (
+            (empty, false_),
+            (cmp, true_),               # some non-null element satisfies
+            (ast.Binary("and", ast.Unary("not", cmp), no_nulls), false_),
+        )
+    # `CASE ... END = 1` keeps the three-valued result boolean-typed
+    # (TRUE/FALSE literals parse as numbers in this grammar)
+    return ast.Binary("=", ast.Case(whens=whens, else_=ast.NullLit()),
+                      ast.NumberLit("1"))
 
 
 def split_conjuncts(node: Optional[ast.Node]) -> List[ast.Node]:
@@ -561,6 +608,24 @@ class Binder:
 
     def session_user(self) -> str:
         return self.session.user if self.session is not None else "presto"
+
+    def _row_field(self, base, field: str):
+        """expr.field over a ROW value -> the field's column slice
+        (DereferenceExpression row access)."""
+        t = base.type
+        if t.name != "row":
+            raise BindError(f"field access on non-row type {t}")
+        if not t.field_names:
+            raise BindError("row has no named fields (CAST to "
+                            "ROW(name type, ...) to name them)")
+        fl = field.lower()
+        names = [n.lower() for n in t.field_names]
+        if fl not in names:
+            raise BindError(f"row has no field {field!r}")
+        i = names.index(fl)
+        # ops/container.row_field is 1-based (SQL subscript convention)
+        return Call(type=t.fields[i], fn="row_field",
+                    args=(base, Literal(type=BIGINT, value=i + 1)))
 
     # ==================================================================
     def _query_now(self) -> float:
@@ -2303,6 +2368,7 @@ class Binder:
                 left_keys=[value_ir],
                 right_keys=[ColumnRef(type=sub.channels[0].type, index=0)],
                 kind=kind,
+                null_aware=True,  # ANSI three-valued IN/NOT IN
             )
             return join, scope
 
@@ -2319,18 +2385,27 @@ class Binder:
             # remap the marker to the planned output channel
             subs: List[ast.Node] = []
             _find_scalar_subqueries(c, subs)
-            if len(subs) != 1:
-                raise BindError("exactly one scalar subquery per conjunct supported")
-            sq = subs[0]
-            node, scope, value_ref = self._plan_scalar_subquery(node, scope, remap, glob, sq.query)
-            marker = 1 << 28
-            self._scalar_refs[id(sq)] = ColumnRef(type=value_ref.type, index=marker)
+            if not subs:
+                raise BindError("no scalar subquery found in conjunct")
+            # any number of scalar subqueries per conjunct (quantified
+            # comparisons desugar to CASEs over min/max + two counts):
+            # each plans as a single-row cross join, bound through a
+            # distinct marker ref remapped to its spliced channel
+            markers: Dict[int, int] = {}
+            for j, sq in enumerate(subs):
+                node, scope, value_ref = self._plan_scalar_subquery(
+                    node, scope, remap, glob, sq.query)
+                marker = (1 << 28) + j
+                self._scalar_refs[id(sq)] = ColumnRef(
+                    type=value_ref.type, index=marker)
+                markers[marker] = value_ref.index
             try:
                 ir = self._bind(c, glob)
             finally:
-                del self._scalar_refs[id(sq)]
+                for sq in subs:
+                    self._scalar_refs.pop(id(sq), None)
             full_map = dict(remap)
-            full_map[marker] = value_ref.index
+            full_map.update(markers)
             pred = remap_expr(ir, full_map)
             if negated:
                 pred = call("not", pred)
@@ -2399,13 +2474,9 @@ class Binder:
 
     def _plan_in_mark(self, node, remap, glob, m):
         """value IN (subquery) as a mark join (uncorrelated only).
-
-        Deviation (documented in PARITY.md): the mark is two-valued —
-        FALSE for unmatched rows even when the subquery side contains
-        NULL keys (ANSI three-valued IN would yield NULL there, so a
-        negated use like ``NOT (x IN (...))`` under OR keeps rows the
-        reference would drop).  Same semantics as this engine's
-        semi/anti lowering."""
+        The mark is three-valued (HashSemiJoinOperator.java:32): NULL
+        when unmatched with a NULL probe value or a NULL on the
+        subquery side, so negated uses under OR agree with ANSI IN."""
         sub, _ = self._plan_query_like(m.query)
         value_ir = remap_expr(self._bind(m.value, glob), remap)
         mark_idx = len(node.channels)
@@ -2413,6 +2484,7 @@ class Binder:
             left=node, right=sub, left_keys=[value_ir],
             right_keys=[ColumnRef(type=sub.channels[0].type, index=0)],
             kind="mark",
+            null_aware=True,
         )
         return join, mark_idx
 
@@ -2668,7 +2740,29 @@ class Binder:
             return Literal(type=VARCHAR, value=self.session_user())
 
         if isinstance(e, ast.Identifier):
-            idx = scope.resolve(e.qualifier, e.name)
+            try:
+                idx = scope.resolve(e.qualifier, e.name)
+            except BindError:
+                # r.x / t.r.x where a prefix is a ROW-typed column:
+                # progressively re-resolve the prefix as a column (bare
+                # or table-qualified) and walk the rest as row fields
+                # (DereferenceExpression's row branch)
+                if e.qualifier is None:
+                    raise
+                parts = e.parts
+                prefixes = [(parts[:1], parts[1:])]
+                if len(parts) >= 3:
+                    prefixes.append((parts[:2], parts[2:]))
+                for head, fields in prefixes:
+                    try:
+                        base = self._bind_impl(
+                            ast.Identifier(tuple(head)), scope, agg)
+                    except BindError:
+                        continue
+                    for f in fields:
+                        base = self._row_field(base, f)
+                    return base
+                raise
             ch = scope.col(idx).channel
             if agg is not None:
                 raise BindError(f"column {e.name} not in GROUP BY")
@@ -2676,6 +2770,10 @@ class Binder:
 
         if isinstance(e, ast.QuantifiedComparison):
             return self._bind_impl(desugar_quantified(e), scope, agg)
+
+        if isinstance(e, ast.FieldAccess):
+            return self._row_field(self._bind_impl(e.base, scope, agg),
+                                   e.field)
 
         if isinstance(e, ast.ScalarSubquery):
             ref = self._scalar_refs.get(id(e))
@@ -2814,7 +2912,8 @@ class Binder:
 
                 t = parse_type(tn)
                 if v.type.is_decimal and v.type.scale == t.scale \
-                        and v.type.is_long_decimal == t.is_long_decimal:
+                        and v.type.is_long_decimal == t.is_long_decimal \
+                        and v.type.value_shape == t.value_shape:
                     return v
                 return call("cast_decimal", v,
                             Literal(type=BIGINT, value=t.precision or 18),
@@ -2853,6 +2952,34 @@ class Binder:
                 # are dictionary codes; re-typing is metadata-only)
                 if v.type.is_string:
                     return v
+            if tn.startswith("row"):
+                from presto_tpu.types import parse_type
+
+                target = parse_type(tn)
+                if v.type.name != "row":
+                    raise BindError("CAST to ROW requires a row value")
+                if len(v.type.fields) != len(target.fields):
+                    raise BindError("ROW cast arity mismatch")
+                if tuple(v.type.fields) == tuple(target.fields):
+                    # naming-only cast: the storage matrix is unchanged
+                    return Call(type=target, fn="retype_row", args=(v,))
+                # field types differ: rebuild the row from converted
+                # fields (value conversion, e.g. decimal -> double)
+                conv = {"double": "cast_double", "bigint": "cast_bigint",
+                        "integer": "cast_bigint", "real": "cast_real"}
+                new_fields = []
+                for i, (st, dt) in enumerate(zip(v.type.fields,
+                                                 target.fields)):
+                    f = Call(type=st, fn="row_field",
+                             args=(v, Literal(type=BIGINT, value=i + 1)))
+                    if st != dt:
+                        if dt.name not in conv:
+                            raise BindError(
+                                f"ROW cast cannot convert {st} to {dt}")
+                        f = call(conv[dt.name], f)
+                    new_fields.append(f)
+                return Call(type=target, fn="row_construct",
+                            args=tuple(new_fields))
             raise BindError(f"unsupported CAST to {e.type_name}")
 
         if isinstance(e, ast.Extract):
@@ -3304,11 +3431,22 @@ class Binder:
             scaled = int((whole + frac) or "0")
             digits = len((whole + frac).lstrip("+-").lstrip("0")) or 1
             precision = max(digits, scale)
+            if precision > 38:
+                raise BindError(f"decimal literal exceeds 38 digits: {text}")
             if precision > 36:
-                raise BindError(f"decimal literal exceeds 36 digits: {text}")
+                return Literal(type=DecimalType(38, scale), value=scaled)
             return Literal(type=DecimalType(36 if precision > 18 else 18, scale),
                            value=scaled)
-        return Literal(type=BIGINT, value=int(text))
+        v = int(text)
+        if not (-(1 << 63) <= v < (1 << 63)):
+            # integer literal beyond int64: a decimal(<=38, 0) literal
+            # (the reference types wide literals as decimals too)
+            digits = len(text.lstrip("+-").lstrip("0")) or 1
+            if digits > 38:
+                raise BindError(f"decimal literal exceeds 38 digits: {text}")
+            return Literal(type=DecimalType(38 if digits > 36 else 36, 0),
+                           value=v)
+        return Literal(type=BIGINT, value=v)
 
     def _bind_date_arith(self, e: ast.Binary, scope: Scope, agg) -> Expr:
         if isinstance(e.right, ast.IntervalLit):
@@ -3626,6 +3764,15 @@ class Binder:
             a = AggCall(fn=fn, arg=arg, type=arg.type)
             a = dataclasses.replace(a, type=output_type(a))
             return agg.agg_ref(a)
+        if fn in ("learn_libsvm_regressor", "learn_libsvm_classifier"):
+            # libsvm-parameterized variants (presto-ml
+            # LearnLibSvm*Aggregation): the params string configures a
+            # libsvm trainer there; the trainers here are the
+            # closed-form TPU redesigns (normal equations / Gaussian
+            # NB), so the params argument is accepted and ignored
+            if len(e.args) == 3:
+                e = dataclasses.replace(e, args=e.args[:2])
+            fn = fn.replace("_libsvm", "")
         if fn in ("min_by", "max_by", "approx_percentile", "map_agg",
                   "multimap_agg",
                   "covar_pop", "covar_samp", "corr", "regr_slope",

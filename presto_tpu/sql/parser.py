@@ -644,11 +644,21 @@ class Parser:
 
     def _postfix(self) -> ast.Node:
         e = self._primary()
-        while self.accept("["):
-            idx = self._expr()
-            self.expect("]")
-            e = ast.Subscript(e, idx)
-        return e
+        while True:
+            if self.accept("["):
+                idx = self._expr()
+                self.expect("]")
+                e = ast.Subscript(e, idx)
+                continue
+            # row-field access on non-identifier primaries:
+            # CAST(... AS ROW(x ...)).x — identifier dots are consumed
+            # by _primary's qualified-name path
+            if not isinstance(e, ast.Identifier) and self.peek(".") \
+                    and self.tokens[self.i + 1].kind in ("ident", "keyword"):
+                self.i += 1
+                e = ast.FieldAccess(e, self.ident())
+                continue
+            return e
 
     def _primary(self) -> ast.Node:
         t = self.tok
@@ -728,12 +738,35 @@ class Parser:
             self.i += 1
             type_name = tt.value
             if self.accept("("):
+                # nested type text (row(x bigint, y row(...)), ...):
+                # word tokens keep a separating space so field names
+                # survive ("x bigint", not "xbigint")
                 type_name += "("
-                while not self.peek(")"):
-                    type_name += self.tok.value
+                depth = 1
+                prev_word = False
+                while depth > 0:
+                    t = self.tok
+                    if t.kind == "eof":
+                        raise SyntaxError("unterminated type in CAST")
                     self.i += 1
-                type_name += ")"
+                    if t.value == "(":
+                        depth += 1
+                        type_name += "("
+                        prev_word = False
+                    elif t.value == ")":
+                        depth -= 1
+                        type_name += ")"
+                        prev_word = False
+                    elif t.value == ",":
+                        type_name += ","
+                        prev_word = False
+                    else:
+                        if prev_word:
+                            type_name += " "
+                        type_name += t.value
+                        prev_word = t.kind in ("ident", "keyword", "number")
                 self.expect(")")
+                return ast.Cast(v, type_name)
             self.expect(")")
             return ast.Cast(v, type_name)
 

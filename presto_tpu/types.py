@@ -63,11 +63,19 @@ class Type:
     # ARRAY element type / MAP value type (None otherwise); MAP key type.
     element: Optional["Type"] = None
     key_element: Optional["Type"] = None
-    # ROW field types (None otherwise)
+    # ROW field types (None otherwise); optional field names for
+    # named-row access (spi/type/RowType.java RowField names).  Names
+    # are access metadata, not identity: eq/hash ignore them so a cast
+    # that only names fields is a retype.
     fields: Optional[tuple] = None
+    field_names: Optional[tuple] = None
 
     def __repr__(self) -> str:
         if self.name == "row":
+            if self.field_names:
+                inner = ", ".join(f"{n} {t!r}" for n, t in
+                                  zip(self.field_names, self.fields))
+                return f"row({inner})"
             return f"row({', '.join(map(repr, self.fields))})"
         if self.name == "array":
             return f"array({self.element!r})"
@@ -110,12 +118,12 @@ class Type:
         (slot 0 = entry count, then keys, then values),
         () for everything else."""
         if self.is_long_decimal:
-            return (2,)
+            return (5,) if (self.precision or 0) > 36 else (2,)
         if self.is_raw_string or self.is_binary:
             return (self.precision or 32,)
         if self.name == "array":
             return (1 + (self.precision or 8),)
-        if self.name in ("map", "hll"):
+        if self.name in ("map", "hll", "setdigest"):
             m = self.precision or 8
             if self.element is not None and self.element.is_array:
                 # multimap: each value lane is itself a fixed array
@@ -140,7 +148,7 @@ class Type:
     @property
     def is_map(self) -> bool:
         # HYPERLOGLOG shares the map storage layout (bucket -> rho)
-        return self.name in ("map", "hll")
+        return self.name in ("map", "hll", "setdigest")
 
     @property
     def is_hll(self) -> bool:
@@ -256,19 +264,23 @@ def ArrayType(element: Type, max_elems: int = 8) -> Type:
                 precision=int(max_elems), element=element)
 
 
-def RowType(*field_types: Type) -> Type:
-    """Anonymous ROW value: one slot per field in a shared storage
-    dtype (reference: spi/type/RowType.java's variable per-field blocks
-    — here a dense (capacity, nfields) matrix, TPU-first).  Fields must
-    be fixed-width non-string scalars."""
+def RowType(*field_types: Type, names=None) -> Type:
+    """ROW value: one slot per field in a shared storage dtype
+    (reference: spi/type/RowType.java's variable per-field blocks —
+    here a dense (capacity, nfields) matrix, TPU-first).  Fields must
+    be fixed-width non-string scalars.  ``names`` makes the fields
+    addressable (CAST(... AS ROW(x bigint, ...)).x)."""
     if not field_types:
         raise ValueError("ROW needs at least one field")
     for t in field_types:
         if t.is_string or t.is_array or t.is_map or t.is_long_decimal:
             raise ValueError(
                 f"ROW fields must be fixed-width scalars (got {t})")
+    if names is not None and len(names) != len(field_types):
+        raise ValueError("ROW field names/types length mismatch")
     storage = _container_storage_dtype(*field_types)
-    return Type(name="row", np_dtype=storage, fields=tuple(field_types))
+    return Type(name="row", np_dtype=storage, fields=tuple(field_types),
+                field_names=tuple(names) if names is not None else None)
 
 
 def MapType(key: Type, value: Type, max_elems: int = 8) -> Type:
@@ -297,6 +309,25 @@ def HllType() -> Type:
                 precision=HLL_SET_BUCKETS, element=BIGINT, key_element=BIGINT)
 
 
+#: KMV (k-minimum-values) slot count for make_set_digest/
+#: merge_set_digest: the digest keeps the K smallest 64-bit hashes of
+#: the distinct inputs with per-hash counts.
+SET_DIGEST_HASHES = 64
+
+
+def SetDigestType() -> Type:
+    """SETDIGEST (reference: type/setdigest/SetDigestType.java — HLL +
+    minhash behind make_set_digest/merge_set_digest/jaccard_index/
+    intersection_cardinality/hash_counts).  TPU-first re-design: a KMV
+    sketch — the K smallest hashes with counts in the map storage
+    layout [len, hashes ascending.., counts..] — one structure serving
+    both the cardinality estimator ((K-1)/fraction-of-hash-space) and
+    the minhash role (jaccard from the K-smallest union sample)."""
+    return Type("setdigest", _container_storage_dtype(BIGINT, BIGINT),
+                precision=SET_DIGEST_HASHES, element=BIGINT,
+                key_element=BIGINT)
+
+
 def null_sentinel(storage: np.dtype):
     """In-slot NULL marker for container elements (int: INT64_MIN
     truncated to the lane dtype; float: NaN)."""
@@ -307,13 +338,13 @@ def null_sentinel(storage: np.dtype):
 
 def DecimalType(precision: int = 18, scale: int = 0) -> Type:
     """Scaled-integer decimal: int64 for p <= 18, two base-10^18 limbs
-    for p <= 36.
+    for p <= 36, five base-10^9 limbs for the full 38 digits.
 
     Reference: spi/type/DecimalType.java + spi/type/Decimals.java
     (short = long java primitive, long = Slice-backed 128-bit).
     """
-    if precision > 36:
-        raise ValueError("decimal precision > 36 unsupported")
+    if precision > 38:
+        raise ValueError("decimal precision > 38 unsupported")
     return Type("decimal", np.dtype(np.int64), scale=scale, precision=precision)
 
 
@@ -381,6 +412,8 @@ def parse_type(s: str) -> Type:
     s = s.strip().lower()
     if s == "hyperloglog" or s == "hll":
         return HllType()
+    if s == "setdigest":
+        return SetDigestType()
     if s.startswith("array"):
         inner = s[s.index("(") + 1 : s.rindex(")")]
         parts = _split_top_level(inner)
@@ -394,6 +427,24 @@ def parse_type(s: str) -> Type:
     if s.startswith("raw_varchar"):
         width = int(s[s.index("(") + 1 : s.rindex(")")]) if "(" in s else 32
         return VarcharType(width, raw=True)
+    if s.startswith("row(") or s.startswith("row ("):
+        inner = s[s.index("(") + 1: s.rindex(")")]
+        names, fts = [], []
+        for part in _split_top_level(inner):
+            part = part.strip()
+            # "name type" (named field) vs bare "type"
+            bits = part.split(None, 1)
+            # a name candidate must be a bare identifier — 'decimal(10,'
+            # from 'row(decimal(10, 2))' is type text, not a field name
+            if len(bits) == 2 and "(" not in bits[0] \
+                    and bits[0] not in ("double",):
+                names.append(bits[0])
+                fts.append(parse_type(bits[1]))
+            else:
+                names.append(None)
+                fts.append(parse_type(part))
+        named = [n for n in names if n is not None]
+        return RowType(*fts, names=names if len(named) == len(fts) else None)
     if s.startswith("decimal"):
         if "(" in s:
             inner = s[s.index("(") + 1 : s.rindex(")")]

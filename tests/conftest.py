@@ -25,10 +25,37 @@ jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: the suite's wall-clock is dominated by
 # XLA recompilation (every query/capacity pair is a fresh program), so
 # compiled executables are cached on disk across runs and processes.
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+# The directory is keyed by a CPU-feature fingerprint: rounds run on
+# heterogeneous driver hosts, and replaying executables AOT-compiled
+# for another host's avx512/amx feature set SIGILLs/segfaults (observed
+# r5: a 21k-entry cache from a prior host crashed the suite mid-write).
+
+
+def host_cache_dir(root: str) -> str:
+    import hashlib
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    tag = hashlib.sha256(line.encode()).hexdigest()[:12]
+                    break
+    except OSError:
+        pass
+    return os.path.join(root, tag)
+
+
+_cache_dir = host_cache_dir(
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# 0.25s floor: writing EVERY executable (tens of thousands per suite
+# run) tripped a cumulative segfault inside jax's cache-write path
+# (r5, deterministic at ~650 tests in); only the compiles that are
+# expensive enough to matter get persisted
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
 # NOTE: deliberately NOT enabling jax_persistent_cache_enable_xla_caches:
 # XLA:CPU kernel caches are AOT-compiled for this host's CPU features and
 # replaying them on a different machine can SIGILL; the jit cache alone

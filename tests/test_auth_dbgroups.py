@@ -1,0 +1,126 @@
+"""Authenticator chain (password + HMAC-ticket) and the sqlite-backed
+resource-group manager with live reload.
+
+Reference analogs: server/security/KerberosAuthenticator.java (the
+second-mechanism slot; the ticket verifier here is the
+infrastructure-free analog), the http-server.authentication.type list
+semantics, and resource-group-managers/.../db/
+DbResourceGroupConfigurationManager.java.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from presto_tpu.security import (
+    AuthenticationError,
+    AuthenticatorChain,
+    FilePasswordAuthenticator,
+    TokenAuthenticator,
+)
+
+
+def test_token_authenticator_roundtrip():
+    ta = TokenAuthenticator("s3cret")
+    tok = ta.issue("alice", ttl_seconds=60)
+    assert ta.authenticate_token(tok) == "alice"
+    with pytest.raises(AuthenticationError):
+        ta.authenticate_token(tok + "x")
+    with pytest.raises(AuthenticationError):
+        TokenAuthenticator("other").authenticate_token(tok)
+    expired = ta.issue("alice", ttl_seconds=-1)
+    with pytest.raises(AuthenticationError):
+        ta.authenticate_token(expired)
+
+
+def test_chain_tries_mechanisms_in_order():
+    chain = AuthenticatorChain(
+        FilePasswordAuthenticator(entries={"bob": "pw"}),
+        TokenAuthenticator("s3cret"),
+    )
+    chain.authenticate("bob", "pw")
+    with pytest.raises(AuthenticationError):
+        chain.authenticate("bob", "wrong")
+    tok = TokenAuthenticator("s3cret").issue("carol")
+    assert chain.authenticate_token(tok) == "carol"
+    with pytest.raises(AuthenticationError):
+        chain.authenticate_token("nope")
+
+
+def test_coordinator_accepts_bearer_and_basic():
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.runner import QueryRunner
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    cat = Catalog()
+    cat.register("mem", MemoryConnector(), writable=True)
+    runner = QueryRunner(cat)
+    ta = TokenAuthenticator("k")
+    chain = AuthenticatorChain(
+        FilePasswordAuthenticator(entries={"u": "p"}), ta)
+    srv = CoordinatorServer(runner, authenticator=chain)
+    srv.start()
+    try:
+        def post(sql, headers):
+            req = urllib.request.Request(
+                f"{srv.uri}/v1/statement", data=sql.encode(),
+                headers=headers, method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.load(r)
+
+        import base64
+
+        basic = "Basic " + base64.b64encode(b"u:p").decode()
+        assert post("select 1", {"Authorization": basic})["columns"]
+        bearer = "Bearer " + ta.issue("u")
+        assert post("select 1", {"Authorization": bearer})["columns"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("select 1", {"Authorization": "Bearer junk"})
+        assert ei.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            post("select 1", {})
+        assert ei2.value.code == 401
+    finally:
+        srv.stop()
+
+
+def test_db_resource_groups_live_reload(tmp_path):
+    from presto_tpu.resource_groups import DbResourceGroupManager
+
+    db = str(tmp_path / "groups.db")
+    mgr = DbResourceGroupManager(db, poll_interval=0.0)
+    mgr.upsert_group("global", None, hard_concurrency=5, max_queued=10)
+    mgr.upsert_group("etl", "global", hard_concurrency=2, max_queued=3)
+    mgr.add_db_selector("etl_.*", "etl")
+    assert mgr.group_for("etl_nightly").name == "global.etl"
+    assert mgr.group_for("alice").name == "global"
+    assert mgr.group_for("etl_nightly").hard_concurrency == 2
+
+    # live reload: a second handle (the admin) retunes concurrency
+    # and adds a selector; the manager picks both up without restart
+    admin = DbResourceGroupManager(db, poll_interval=0.0)
+    admin.upsert_group("etl", "global", hard_concurrency=7, max_queued=9)
+    admin.upsert_group("adhoc", "global", hard_concurrency=1, max_queued=1)
+    admin.add_db_selector("bi_.*", "adhoc")
+    g = mgr.group_for("etl_nightly")
+    assert g.hard_concurrency == 7
+    assert mgr.group_for("bi_dash").name == "global.adhoc"
+
+
+def test_db_groups_admission_semantics(tmp_path):
+    from presto_tpu.resource_groups import (
+        DbResourceGroupManager, QueryQueueFullError,
+    )
+
+    db = str(tmp_path / "g.db")
+    mgr = DbResourceGroupManager(db, poll_interval=0.0)
+    mgr.upsert_group("global", None, hard_concurrency=1, max_queued=1)
+    g = mgr.group_for("x")
+    g.acquire()
+    try:
+        with pytest.raises((QueryQueueFullError, TimeoutError)):
+            g.acquire(timeout=0.05)
+    finally:
+        g.release()

@@ -1,0 +1,95 @@
+"""DECIMAL precision 37-38: the five-limb base-10^9 wide layout.
+
+Reference analog: spi/type/DecimalType.java (MAX_PRECISION = 38) +
+UnscaledDecimal128Arithmetic.java.  The r5 extension: p <= 36 keeps the
+two base-10^18 limbs; p in (36, 38] stores five base-10^9 limbs, with
+add/sub/compare/min/max/sum/avg/rescale/casts exact.  Multiplication
+past 36 digits stays unsupported (the reference's 38-digit result cap
+overflows there too).
+
+Expected values come from python's arbitrary-precision Decimal.
+"""
+
+import decimal
+from decimal import Decimal
+
+import pytest
+
+decimal.getcontext().prec = 60  # expected values must not round at 28
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.runner import QueryRunner
+
+N38 = 99999999999999999999999999999999999999  # 38 nines
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalog = Catalog()
+    catalog.register("mem", MemoryConnector(), writable=True)
+    r = QueryRunner(catalog)
+    r.execute("create table d38 as select cast(x as decimal(38,2)) as v "
+              "from (values 1.25, 7.50, 12345678901234567890123456789012345.67) t(x)")
+    return r
+
+
+def test_wide_literal_roundtrip(runner):
+    assert runner.execute(
+        "select cast(12345678901234567890123456789012345678 as decimal(38,0))"
+    ).rows == [(Decimal(12345678901234567890123456789012345678),)]
+
+
+def test_add_sub_full_range(runner):
+    assert runner.execute(
+        "select cast(99999999999999999999999999999999999.99 as decimal(38,2))"
+        " - cast(0.99 as decimal(38,2))"
+    ).rows == [(Decimal("99999999999999999999999999999999999.00"),)]
+    assert runner.execute(
+        "select cast(1.25 as decimal(38,2)) + cast(2.50 as decimal(38,2))"
+    ).rows == [(Decimal("3.75"),)]
+
+
+def test_compare_and_mixed_width(runner):
+    assert runner.execute(
+        "select cast(1.25 as decimal(38,2)) < cast(1.30 as decimal(20,2))"
+    ).rows == [(True,)]
+    assert runner.execute(
+        "select cast(123.456 as decimal(38,3)) = cast(123.456 as decimal(20,3))"
+    ).rows == [(True,)]
+
+
+def test_table_sum_avg_min_max(runner):
+    s, a, mx, mn = runner.execute(
+        "select sum(v), avg(v), max(v), min(v) from d38").rows[0]
+    vals = [Decimal("1.25"), Decimal("7.50"),
+            Decimal("12345678901234567890123456789012345.67")]
+    assert s == sum(vals)
+    # avg HALF_UP at scale 2
+    expect_avg = (sum(vals) / 3).quantize(Decimal("0.01"))
+    assert a == expect_avg
+    assert mx == max(vals) and mn == min(vals)
+
+
+def test_filter_on_wide_values(runner):
+    rows = sorted(runner.execute("select v from d38 where v > 2").rows)
+    assert rows == [
+        (Decimal("7.50"),),
+        (Decimal("12345678901234567890123456789012345.67"),)]
+
+
+def test_cast_to_double_and_back(runner):
+    assert runner.execute(
+        "select cast(cast(5.75 as decimal(38,2)) as double)").rows == [(5.75,)]
+
+
+def test_wide_multiplication_unsupported(runner):
+    with pytest.raises(Exception, match="36 digits"):
+        runner.execute(
+            "select cast(2.5 as decimal(38,2)) * 3 from d38 limit 1")
+
+
+def test_rescale_between_wide_scales(runner):
+    assert runner.execute(
+        "select cast(cast(1.2 as decimal(38,1)) as decimal(38,4))"
+    ).rows == [(Decimal("1.2000"),)]

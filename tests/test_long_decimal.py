@@ -41,8 +41,15 @@ def runner():
 
 def as_exact(v: int) -> Decimal:
     """Exact expected value: results are decimal.Decimal now, so the
-    headline exactness claims compare with == (no float tolerance)."""
-    return Decimal(v).scaleb(-SCALE)
+    headline exactness claims compare with == (no float tolerance).
+    High-precision context: scaleb must not round 30+ digit values to
+    the default 28-significant-digit context (r5: the engine itself
+    became exact past 28 digits, exposing the helper's rounding)."""
+    import decimal
+
+    with decimal.localcontext() as ctx:
+        ctx.prec = 50
+        return Decimal(v).scaleb(-SCALE)
 
 
 def test_roundtrip_and_filter(runner):

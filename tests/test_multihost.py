@@ -280,3 +280,92 @@ def test_partitioned_join_survives_capacity_retry(cluster):
     sql = ("SELECT o_custkey, count(*) AS c FROM orders, lineitem "
            "WHERE l_orderkey = o_orderkey GROUP BY o_custkey")
     _check(local, multi, sql)
+
+
+# ---------------------------------------------------------------------------
+# r5: generalized stage-DAG at the DCN tier (lower_stages over HTTP
+# workers — the decomposition the mesh tier runs, parallel/dist.py)
+# ---------------------------------------------------------------------------
+
+def test_multilevel_agg_both_stages_distributed(cluster):
+    """Agg over agg: the inner aggregation distributes over scan
+    splits; the outer distributes over the RE-CHUNKED materialized
+    inner output (serde "pre" fragments).  min_stage_rows=0 so the
+    tiny test table still decomposes (the dryrun's setting)."""
+    local, _multi, workers = cluster
+    multi = MultiHostRunner(make_catalog(), [w.uri for w in workers])
+    multi.min_stage_rows = 0
+    sql = ("SELECT max(c) AS mx, min(ok) AS mn FROM "
+           "(SELECT o_custkey AS ok, count(*) AS c FROM orders "
+           "GROUP BY o_custkey)")
+    _check(local, multi, sql)
+    assert multi.last_stage_count >= 2
+
+
+def test_union_of_chains_with_outer_agg(cluster):
+    local, multi, _ = cluster
+    sql = ("SELECT count(*) AS n, sum(k) AS s FROM ("
+           "SELECT o_orderkey AS k FROM orders WHERE o_orderkey % 2 = 0 "
+           "UNION ALL "
+           "SELECT l_orderkey AS k FROM lineitem WHERE l_linenumber = 1)")
+    _check(local, multi, sql)
+    assert multi.last_stage_count >= 2
+
+
+def test_tpcds_q7_multihost(cluster):
+    """TPC-DS Q7 (star join + agg + TopN) end-to-end over 3 HTTP
+    workers — the mesh tier's flagship stage-DAG shape, now at DCN."""
+    _local, _multi, workers = cluster
+    from presto_tpu.connectors.tpcds import Tpcds
+
+    def ds_catalog():
+        c = Catalog()
+        c.register("tpcds", Tpcds(sf=0.01, split_rows=2048))
+        return c
+
+    ds_workers = [WorkerServer(ds_catalog()) for _ in range(3)]
+    for w in ds_workers:
+        w.start()
+    try:
+        local = QueryRunner(ds_catalog())
+        multi = MultiHostRunner(ds_catalog(), [w.uri for w in ds_workers])
+        from tests.tpcds_queries import QUERIES as DS
+
+        expected = local.executor.run(local.plan(DS[7])).rows
+        actual = multi.run(local.binder.plan(DS[7])).rows
+        assert len(actual) == len(expected)
+        for a, e in zip(actual, expected):  # ORDER BY: positional
+            for va, ve in zip(a, e):
+                if isinstance(va, float):
+                    assert va == pytest.approx(ve, rel=1e-9), (a, e)
+                else:
+                    assert va == ve, (a, e)
+        assert multi.last_stage_count >= 1
+    finally:
+        for w in ds_workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def test_topn_ships_per_shard_bound(cluster):
+    """ORDER BY ... LIMIT n over a chain: each worker truncates to n
+    before the gather, so the coordinator pulls O(workers x n) rows,
+    not the full selectivity (per-shard bound at the DCN tier)."""
+    local, multi, workers = cluster
+    sql = ("SELECT l_orderkey, l_extendedprice FROM lineitem "
+           "WHERE l_quantity > 10 "
+           "ORDER BY l_extendedprice DESC, l_orderkey LIMIT 5")
+    expected = local.executor.run(local.plan(sql)).rows
+    actual = multi.run(local.binder.plan(sql)).rows
+    assert actual == expected  # ORDER BY: positional comparison
+    assert 0 < multi.last_gather_rows <= len(workers) * 5
+
+
+def test_limit_ships_per_shard_bound(cluster):
+    local, multi, workers = cluster
+    sql = "SELECT l_orderkey FROM lineitem WHERE l_quantity > 10 LIMIT 7"
+    actual = multi.run(local.binder.plan(sql)).rows
+    assert len(actual) == 7
+    assert 0 < multi.last_gather_rows <= len(workers) * 7

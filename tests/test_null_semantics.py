@@ -1,0 +1,159 @@
+"""ANSI three-valued IN / NOT IN, ALL/ANY edge semantics.
+
+Reference analogs: operator/HashSemiJoinOperator.java:32 (NULL-aware
+semi join: the membership test is NULL for an unmatched probe whose
+key is NULL or when the build side holds a NULL key) and the
+QuantifiedComparison rewriter's count-based ALL/ANY expansion (ALL
+over an empty subquery is TRUE, ANY over empty is FALSE).
+
+Expected values are cross-checked against sqlite3, which implements
+ANSI IN/NOT IN three-valued logic.
+"""
+
+import sqlite3
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.runner import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalog = Catalog()
+    catalog.register("mem", MemoryConnector(), writable=True)
+    r = QueryRunner(catalog)
+    # t: values 1..4 plus NULL; s_null holds a NULL; s_clean does not
+    r.execute("create table t as select * from (values 1, 2, 3, 4, "
+              "null) v(x)")
+    r.execute("create table s_clean as select * from (values 2, 3) v(y)")
+    r.execute("create table s_null as select * from "
+              "(values 2, null) v(y)")
+    r.execute("create table s_empty as select y from s_clean where y < 0")
+    return r
+
+
+def nsort(rows):
+    return sorted(rows, key=lambda r: tuple((v is None, v) for v in r))
+
+
+def sqlite_rows(sql):
+    con = sqlite3.connect(":memory:")
+    con.execute("create table t(x)")
+    con.executemany("insert into t values (?)", [(1,), (2,), (3,), (4,),
+                                                 (None,)])
+    con.execute("create table s_clean(y)")
+    con.executemany("insert into s_clean values (?)", [(2,), (3,)])
+    con.execute("create table s_null(y)")
+    con.executemany("insert into s_null values (?)", [(2,), (None,)])
+    con.execute("create table s_empty(y)")
+    return nsort(con.execute(sql).fetchall())
+
+
+@pytest.mark.parametrize("sql", [
+    "select x from t where x in (select y from s_clean)",
+    "select x from t where x not in (select y from s_clean)",
+    "select x from t where x in (select y from s_null)",
+    "select x from t where x not in (select y from s_null)",
+    "select x from t where x in (select y from s_empty)",
+    "select x from t where x not in (select y from s_empty)",
+    "select x from t where not (x in (select y from s_null))",
+    "select x from t where not (x not in (select y from s_clean))",
+])
+def test_in_not_in_vs_sqlite(runner, sql):
+    assert nsort(runner.execute(sql).rows) == sqlite_rows(sql)
+
+
+def test_not_in_with_build_null_is_empty(runner):
+    # x NOT IN {2, NULL}: never TRUE for any x
+    assert runner.execute(
+        "select x from t where x not in (select y from s_null)").rows == []
+
+
+def test_not_in_empty_keeps_all_rows(runner):
+    rows = sorted(runner.execute(
+        "select x from t where x not in (select y from s_empty)").rows,
+        key=lambda r: (r[0] is None, r[0]))
+    assert rows == [(1,), (2,), (3,), (4,), (None,)]
+
+
+def test_in_mark_join_three_valued(runner):
+    """IN under OR lowers to a mark join; the mark must be
+    three-valued so the OR combines per Kleene logic."""
+    # x IN s_null OR x = 1: row 1 via the disjunct, row 2 via the IN;
+    # rows 3/4 have IN = NULL (build holds NULL) so NULL OR FALSE drops
+    assert sorted(runner.execute(
+        "select x from t where x in (select y from s_null) or x = 1"
+    ).rows) == [(1,), (2,)]
+    # NOT over the mark: NOT(NULL) is NULL, so only the definite
+    # non-member with no NULL uncertainty survives — none here
+    assert runner.execute(
+        "select x from t where not (x in (select y from s_null)) "
+        "and x is not null").rows == []
+    # IN over empty is FALSE even for the NULL probe: NOT keeps all
+    rows = runner.execute(
+        "select x from t where not (x in (select y from s_empty)) "
+        "or x = -1").rows
+    assert len(rows) == 5
+
+
+def test_all_over_empty_is_true(runner):
+    rows = sorted(runner.execute(
+        "select x from t where x < all (select y from s_empty)").rows,
+        key=lambda r: (r[0] is None, r[0]))
+    assert rows == [(1,), (2,), (3,), (4,), (None,)]  # vacuous truth
+
+
+def test_any_over_empty_is_false(runner):
+    assert runner.execute(
+        "select x from t where x < any (select y from s_empty)").rows == []
+
+
+def test_all_with_nulls_unknown(runner):
+    # x < ALL {2, NULL}: 1 < 2 TRUE but 1 < NULL unknown -> UNKNOWN (drop)
+    assert runner.execute(
+        "select x from t where x < all (select y from s_null)").rows == []
+    # definite miss stays FALSE regardless of NULLs (2 < 2, 3 < 2,
+    # 4 < 2 all FALSE), so NOT keeps those rows
+    assert sorted(runner.execute(
+        "select x from t where not (x < all (select y from s_null))"
+    ).rows) == [(2,), (3,), (4,)]
+
+
+def test_any_with_nulls(runner):
+    # x > ANY {2, NULL}: 3 > 2 TRUE; 1 > 2 FALSE and 1 > NULL unknown -> UNKNOWN
+    assert sorted(runner.execute(
+        "select x from t where x > any (select y from s_null)").rows) == [
+        (3,), (4,)]
+    # the FALSE-with-nulls case must NOT surface under NOT either
+    assert runner.execute(
+        "select x from t where not (x > any (select y from s_null))"
+    ).rows == []
+
+
+def test_all_any_clean_comparisons(runner):
+    assert sorted(runner.execute(
+        "select x from t where x >= all (select y from s_clean)").rows) == [
+        (3,), (4,)]
+    assert sorted(runner.execute(
+        "select x from t where x <= any (select y from s_clean)").rows) == [
+        (1,), (2,), (3,)]
+    assert runner.execute(
+        "select x from t where x = all (select y from s_clean)").rows == []
+    assert runner.execute(
+        "select x from t where x = all (select y from s_clean "
+        "where y = 2)").rows == [(2,)]
+
+
+def test_neq_any(runner):
+    # x <> ANY {2, 3}: TRUE unless the set is all-equal to x
+    assert sorted(runner.execute(
+        "select x from t where x <> any (select y from s_clean)").rows) == [
+        (1,), (2,), (3,), (4,)]
+    assert sorted(runner.execute(
+        "select x from t where x <> any (select y from s_clean "
+        "where y = 2)").rows) == [(1,), (3,), (4,)]
+    # over empty: FALSE (no element differs)
+    assert runner.execute(
+        "select x from t where x <> any (select y from s_empty)").rows == []

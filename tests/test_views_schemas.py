@@ -103,8 +103,11 @@ def test_use_and_schema_ddl(runner):
     assert runner.execute("select x from t").rows == [(7,)]
     # fully-qualified name reaches it from any session state
     assert runner.execute("select x from mem.s1.t").rows == [(7,)]
-    # the default schema still sees base via fallback search
-    assert len(runner.execute("select * from base").rows) == 3
+    # under USE mem.s1 an unqualified name means THAT schema: a table
+    # living elsewhere must be qualified (no silent cross-schema read)
+    with pytest.raises(Exception, match="not found"):
+        runner.execute("select * from base")
+    assert len(runner.execute("select * from mem.base").rows) == 3
     runner.execute("use mem.default")
     with pytest.raises(Exception):
         runner.execute("select x from t")  # t lives in s1, not default
