@@ -215,12 +215,33 @@ class _MemoryTx:
 def _to_device(page: Page):
     """Pin a page's arrays in HBM once at write time — compacted result
     pages arrive numpy-backed (page.compact_host), and storing them
-    as-is would re-pay the host->device transfer on every later scan."""
+    as-is would re-pay the host->device transfer on every later scan.
+    Pages also pad to pow2 capacity HERE, once: scan-time padding
+    (exec/local.pad_page_pow2) costs a ~50ms device concat per ragged
+    page per execution, so resident tables pre-pay it at load."""
     import jax.numpy as jnp
     import numpy as np
 
     from presto_tpu.page import Block
 
+    import os as _os
+
+    from presto_tpu.exec.local import bucket_capacity
+
+    cap = page.capacity
+    tgt = bucket_capacity(cap)
+    if tgt > cap and _os.environ.get("PRESTO_TPU_PAD_LOAD", "1") \
+            not in ("0", "false"):
+        def padded(a):
+            a = np.asarray(a)
+            out = np.zeros((tgt,) + a.shape[1:], dtype=a.dtype)
+            out[:cap] = a
+            return out
+
+        page = Page(
+            tuple(Block(padded(b.data), padded(b.valid), b.type,
+                        b.dictionary) for b in page.blocks),
+            padded(page.row_mask))
     if not any(isinstance(b.data, np.ndarray) for b in page.blocks):
         return page
     return Page(

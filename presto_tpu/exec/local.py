@@ -87,15 +87,34 @@ def concat_pages_device(pages: Sequence[Page]) -> Page:
     return Page(tuple(blocks), mask)
 
 
+def bucket_capacity(n: int) -> int:
+    """Shape-bucketed page capacity: next multiple of 64K for large
+    pages, next power of two below that.  Pow2 alone doubles pages
+    sitting just past a boundary (TPC-H generator splits land at
+    ~1048576 +- 1200 rows, so pow2 sent a third of them to 2M — a 33%
+    compute tax); 64K granularity keeps the waste <= 6% while still
+    collapsing the data-dependent capacities that each cost a full
+    XLA compile of the chain program."""
+    n = int(n)
+    if n >= (1 << 16):
+        g = 1 << 16
+        return ((n + g - 1) // g) * g
+    return 1 << max(0, n - 1).bit_length()
+
+
 def pad_page_pow2(page: Page) -> Page:
-    """Pad a page with dead rows up to the next power-of-two capacity.
-    Scan splits otherwise carry data-dependent capacities (ragged last
-    split, per-table row counts) and every distinct capacity costs a
-    full XLA compile of the whole chain program — the dominant cold-
-    start cost (19 of q3's 32 warmup compiles were one agg program
-    re-traced per shape)."""
+    """Pad a page with dead rows up to its bucketed capacity
+    (bucket_capacity).  Scan splits otherwise carry data-dependent
+    capacities (ragged last split, per-table row counts) and every
+    distinct capacity costs a full XLA compile of the whole chain
+    program — the dominant cold-start cost (19 of q3's 32 warmup
+    compiles were one agg program re-traced per shape)."""
+    import os as _os
+
+    if _os.environ.get("PRESTO_TPU_PAD_SCAN", "1") in ("0", "false"):
+        return page
     cap = page.capacity
-    tgt = 1 << max(0, int(cap) - 1).bit_length()
+    tgt = bucket_capacity(cap)
     if tgt <= cap or cap == 0:
         return page
     arrs, pm = _pad_arrays(
@@ -1193,15 +1212,28 @@ class LocalRunner:
                                                              "mark")
         g_has_null = g_nonempty = None
         if na:
-            from presto_tpu.ops.join import build_null_flags
+            from presto_tpu.expr.ir import ColumnRef as _CR
 
             g_has_null = jnp.asarray(False)
             g_nonempty = jnp.asarray(False)
+            plain = all(isinstance(k_, _CR) for k_ in right_keys)
             for k in range(K):
                 for hp in bbuckets[k]:
-                    h, ne = build_null_flags(hp.rehydrate(), right_keys)
-                    g_has_null = g_has_null | h
-                    g_nonempty = g_nonempty | ne
+                    if plain:
+                        # host-side flags from the spilled numpy columns
+                        # — no device rehydrate just for two booleans
+                        av = np.ones(len(hp.mask), dtype=bool)
+                        for k_ in right_keys:
+                            av &= np.asarray(hp.columns[k_.index][1])
+                        g_has_null = g_has_null | bool(
+                            (hp.mask & ~av).any())
+                        g_nonempty = g_nonempty | bool(hp.mask.any())
+                    else:
+                        from presto_tpu.ops.join import build_null_flags
+
+                        h, ne = build_null_flags(hp.rehydrate(), right_keys)
+                        g_has_null = g_has_null | h
+                        g_nonempty = g_nonempty | ne
 
         probe_spec = [(c.type, c.dictionary) for c in node.left.channels]
         for k in range(K):

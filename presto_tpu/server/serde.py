@@ -306,7 +306,14 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
                 domain=tuple(c["domain"]) if c.get("domain") else None))
         page = deserialize_page(base64.b64decode(d["page"]),
                                 [c.dictionary for c in channels])
-        return PrecomputedNode(page=page, channel_list=channels)
+        # chunk row counts are data-dependent (round(i*n/k) splits) and
+        # the wire format compacts live rows, so pad HERE to bucketed
+        # capacity — otherwise every chunk shape costs the worker a
+        # fresh XLA compile of its chain program
+        from presto_tpu.exec.local import pad_page_pow2
+
+        return PrecomputedNode(page=pad_page_pow2(page),
+                               channel_list=channels)
     if k == "sort":
         return SortNode(
             plan_from_json(d["src"], catalog),

@@ -2385,6 +2385,12 @@ class Binder:
             # remap the marker to the planned output channel
             subs: List[ast.Node] = []
             _find_scalar_subqueries(c, subs)
+            # one plan per DISTINCT node: the quantified-comparison
+            # desugar shares one comparison subtree across CASE whens,
+            # so the same ScalarSubquery object can occur repeatedly
+            seen_ids = set()
+            subs = [sq for sq in subs
+                    if not (id(sq) in seen_ids or seen_ids.add(id(sq)))]
             if not subs:
                 raise BindError("no scalar subquery found in conjunct")
             # any number of scalar subqueries per conjunct (quantified
