@@ -534,6 +534,23 @@ class QueryRunner:
             self._invalidate_plans()
             return MaterializedResult(["result"], [VARCHAR], [(msg,)])
 
+        if isinstance(stmt, ast.ShowPartitions):
+            handle = self.catalog.resolve(stmt.table, session=self.session)
+            conn = self.catalog.connector(handle.connector_name)
+            pcols = (conn.partition_columns(handle.table)
+                     if hasattr(conn, "partition_columns") else [])
+            if not pcols or not hasattr(conn, "partitions"):
+                raise ValueError(f"table is not partitioned: {stmt.table}")
+            rows = [tuple(p.get(c) for c in pcols)
+                    for p in conn.partitions(handle.table)]
+            types = {c.name: c.type for c in handle.columns}
+            return MaterializedResult(
+                list(pcols), [types.get(c, VARCHAR) for c in pcols], rows)
+
+        if isinstance(stmt, ast.SetPath):
+            self.session.path = stmt.path
+            return MaterializedResult(["result"], [VARCHAR], [("SET PATH",)])
+
         if isinstance(stmt, ast.Call):
             return self._call_procedure(stmt)
 

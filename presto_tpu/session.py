@@ -103,6 +103,10 @@ class Session:
         # mutates these): unqualified names resolve against them first
         self.catalog: Optional[str] = None
         self.schema: str = "default"
+        # SET PATH (sql/tree/SetPath.java): SQL function-resolution
+        # path; recorded for protocol parity (one flat function
+        # namespace here, so it does not affect resolution)
+        self.path: str = ""
 
     def get(self, name: str) -> Any:
         return self.properties[name]

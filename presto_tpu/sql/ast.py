@@ -506,6 +506,22 @@ class Call(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ShowPartitions(Node):
+    """SHOW PARTITIONS FROM t (SqlBase.g4:89; the reference routes it
+    to a partitions$ system table — a direct listing here)."""
+
+    table: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class SetPath(Node):
+    """SET PATH spec (SqlBase.g4:98 + sql/tree/SetPath.java): the SQL
+    function-resolution path; recorded on the session."""
+
+    path: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowSchemas(Node):
     """SHOW SCHEMAS [FROM catalog] (sql/tree/ShowSchemas.java)."""
 

@@ -974,6 +974,21 @@ def parse_statement(sql: str) -> ast.Node:
         p.accept(";")
         return ast.Explain(q, analyze, distributed, verbose, validate)
     if p.accept("set"):
+        if p.accept_word("path"):
+            # pathSpecification (SqlBase.g4:98): comma-separated
+            # elements, each a dotted name — both separators kept
+            # distinct in the recorded string
+            def element() -> str:
+                parts = [p.ident()]
+                while p.accept("."):
+                    parts.append(p.ident())
+                return ".".join(parts)
+
+            elems = [element()]
+            while p.accept(","):
+                elems.append(element())
+            p.accept(";")
+            return ast.SetPath(", ".join(elems))
         p.expect("session")
         name = p.ident()
         p.expect("=")
@@ -1147,6 +1162,10 @@ def parse_statement(sql: str) -> ast.Node:
             return _finish(p, ast.ShowCatalogs())
         if p.accept_word("functions"):
             return _finish(p, ast.ShowFunctions())
+        if p.accept_word("partitions"):
+            if p.accept("from") is None and p.accept_word("in") is None:
+                raise SyntaxError("expected FROM after SHOW PARTITIONS")
+            return _finish(p, ast.ShowPartitions(_qualified_name(p)))
         if p.accept_word("schemas"):
             cat = None
             if p.accept("from") or p.accept_word("in"):

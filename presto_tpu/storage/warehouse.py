@@ -91,6 +91,20 @@ class WarehouseConnector:
     def partition_columns(self, table: str) -> List[str]:
         return list(self._meta(table).get("partitioned_by", []))
 
+    def partitions(self, table: str) -> List[dict]:
+        """Partition-value dicts, one per DISTINCT partition (SHOW
+        PARTITIONS / HiveMetadata.listPartitionNames).  The metastore
+        keeps one entry per FILE, so appends into an existing partition
+        add entries — dedup on values, first-seen order."""
+        seen = set()
+        out = []
+        for p in self._meta(table)["partitions"]:
+            key = tuple(sorted(p["values"].items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(dict(p["values"]))
+        return out
+
     def open_dictionary_columns(self, table: str) -> set:
         """Partition columns accept NEW string values on INSERT (their
         'dictionary' is just the metastore's partition-value list, not

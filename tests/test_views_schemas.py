@@ -201,3 +201,13 @@ def test_bare_values_statement(runner):
         (1,), (2,)]
     assert runner.execute(
         "select a + 1 from (values 1, 2) t(a) order by 1").rows == [(2,), (3,)]
+
+
+def test_set_path(runner):
+    assert runner.execute("set path mem.default").rows == [("SET PATH",)]
+    assert runner.session.path == "mem.default"
+
+
+def test_show_partitions_unpartitioned_errors(runner):
+    with pytest.raises(Exception, match="not partitioned"):
+        runner.execute("show partitions from base")

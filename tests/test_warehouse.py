@@ -171,3 +171,19 @@ def test_properties_rejected_by_plain_connectors(tmp_path):
     with pytest.raises(Exception, match="does not support CREATE TABLE"):
         r.execute("CREATE TABLE mem.t WITH (partitioned_by = 'x') "
                   "AS SELECT o_orderkey AS x FROM orders")
+
+
+def test_show_partitions_statement(tmp_path):
+    """SHOW PARTITIONS FROM t (SqlBase.g4:89) lists the metastore's
+    partition values."""
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.runner import QueryRunner
+
+    wh = WarehouseConnector(str(tmp_path))
+    cat = Catalog()
+    cat.register("wh", wh, writable=True)
+    r = QueryRunner(cat)
+    r.execute("create table pt with (partitioned_by = 'g') as "
+              "select * from (values (1, 'a'), (2, 'b'), (3, 'a')) t(x, g)")
+    assert sorted(r.execute("show partitions from pt").rows) == [
+        ("a",), ("b",)]
