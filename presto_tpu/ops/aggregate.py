@@ -73,6 +73,8 @@ def _sum_type(t: Type) -> Type:
         if (t.precision or 0) > 36:
             return DecimalType(38, t.scale)
         return DecimalType(36 if t.is_long_decimal else 18, t.scale)
+    if t.name.startswith("interval"):
+        return t  # interval sums stay interval (Interval*SumAggregation)
     if t.name in ("double", "real"):
         return DOUBLE  # REAL accumulates in double (reference parity)
     return BIGINT  # tinyint/smallint/integer/bigint widen to bigint
@@ -239,6 +241,8 @@ def output_type(agg: AggCall) -> Type:
             # reference parity: avg(decimal(p,s)) keeps the input type,
             # rounded HALF_UP at scale s (DecimalAverageAggregation)
             return agg.arg.type
+        if agg.arg.type.name.startswith("interval"):
+            return agg.arg.type  # Interval*AverageAggregation
         return DOUBLE
     if agg.fn in VARIANCE_FNS or agg.fn in COVAR_FNS or agg.fn in MOMENT_FNS:
         return DOUBLE
@@ -1211,6 +1215,13 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
                 q = av // n
                 q = q + (2 * (av - q * n) >= n).astype(q.dtype)
                 blocks.append(Block((sign * q).astype(t.np_dtype), cnt > 0, t))
+            elif t.name.startswith("interval"):
+                # integer average, half rounded toward +inf (Java
+                # Math.round semantics — jnp.round would round half to
+                # even); counts fit float64 exactly here
+                d = jnp.floor(s.astype(jnp.float64)
+                              / n.astype(jnp.float64) + 0.5).astype(jnp.int64)
+                blocks.append(Block(d, cnt > 0, t))
             else:
                 num = s.astype(jnp.float64)
                 d = num / n.astype(jnp.float64)

@@ -335,6 +335,12 @@ def _to_py(v, t: Type):
         import datetime
 
         return datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(v))
+    if t.name == "interval day to second":
+        import datetime
+
+        return datetime.timedelta(microseconds=int(v))
+    if t.name == "interval year to month":
+        return int(v)  # months (the reference renders 'Y-M')
     if t.is_string:
         return v  # already decoded (str) or raw code
     if isinstance(v, (np.integer,)):

@@ -290,6 +290,35 @@ def desugar_quantified(e: ast.Node) -> ast.Node:
                       ast.NumberLit("1"))
 
 
+_INTERVAL_MICROS = {"second": 1_000_000, "minute": 60_000_000,
+                    "hour": 3_600_000_000, "day": 86_400_000_000}
+
+
+def _interval_literal(iv: "ast.IntervalLit"):
+    """(type, device value) of an interval literal — micros for the
+    day-second family, months for year-month.  Accepts fractional
+    seconds ('1.5' SECOND) and the 'Y-M' year-to-month form
+    (sql/tree/IntervalLiteral.java + DateTimeUtils.parse*Interval)."""
+    from presto_tpu.types import INTERVAL_DAY_SECOND, INTERVAL_YEAR_MONTH
+
+    sign = -1 if iv.negative else 1
+    text = iv.value.strip()
+    try:
+        if iv.unit in _INTERVAL_MICROS:
+            if "." in text:
+                n = round(float(text) * _INTERVAL_MICROS[iv.unit])
+            else:
+                n = int(text) * _INTERVAL_MICROS[iv.unit]
+            return INTERVAL_DAY_SECOND, sign * n
+        if "-" in text and iv.unit == "year":  # 'Y-M' YEAR TO MONTH
+            y, m = text.split("-", 1)
+            return INTERVAL_YEAR_MONTH, sign * (int(y) * 12 + int(m))
+        return (INTERVAL_YEAR_MONTH,
+                sign * int(text) * (12 if iv.unit == "year" else 1))
+    except ValueError:
+        raise BindError(f"malformed interval literal {iv.value!r}")
+
+
 def split_conjuncts(node: Optional[ast.Node]) -> List[ast.Node]:
     if node is None:
         return []
@@ -2808,6 +2837,12 @@ class Binder:
         if isinstance(e, ast.NullLit):
             return Literal(type=BIGINT, value=None)
 
+        if isinstance(e, ast.IntervalLit):
+            # standalone interval VALUE (spi IntervalDayTimeType /
+            # IntervalYearMonthType): micros / months on device
+            t, v = _interval_literal(e)
+            return Literal(type=t, value=v)
+
         if isinstance(e, ast.Parameter):
             raise BindError(
                 f"unbound parameter ?{e.index + 1} — run via EXECUTE ... USING")
@@ -2831,11 +2866,41 @@ class Binder:
                         "values")
                 return call(opmap[e.op], l_ir, r_ir)
             if e.op in ("+", "-") and (
-                isinstance(e.right, ast.IntervalLit) or isinstance(e.left, ast.IntervalLit)
-            ):
-                return self._bind_date_arith(e, scope, agg)
+                isinstance(e.right, ast.IntervalLit)
+                or isinstance(e.left, ast.IntervalLit)
+            ) and not (isinstance(e.right, ast.IntervalLit)
+                       and isinstance(e.left, ast.IntervalLit)):
+                # literal-interval date arithmetic keeps the civil
+                # month/year shift semantics for DATE bases — but only
+                # when the OTHER side is not itself an interval
+                probe = self._bind_impl(
+                    e.left if isinstance(e.right, ast.IntervalLit)
+                    else e.right, scope, agg)
+                if not probe.type.name.startswith("interval"):
+                    return self._bind_date_arith(e, scope, agg)
             opmap = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod"}
-            return call(opmap[e.op], self._bind_impl(e.left, scope, agg), self._bind_impl(e.right, scope, agg))
+            l_ir = self._bind_impl(e.left, scope, agg)
+            r_ir = self._bind_impl(e.right, scope, agg)
+            iv_arith = self._bind_interval_arith(e.op, l_ir, r_ir)
+            if iv_arith is not None:
+                return iv_arith
+            if e.op == "-" and l_ir.type.name == r_ir.type.name \
+                    and l_ir.type.name in ("timestamp", "date"):
+                # datetime difference -> INTERVAL DAY TO SECOND
+                # (IntervalDayTimeType; micros on device)
+                from presto_tpu.types import INTERVAL_DAY_SECOND
+
+                if l_ir.type.name == "date":
+                    l_ir = call("cast_bigint", l_ir)
+                    r_ir = call("cast_bigint", r_ir)
+                    days = Call(type=BIGINT, fn="sub", args=(l_ir, r_ir))
+                    return Call(
+                        type=INTERVAL_DAY_SECOND, fn="mul",
+                        args=(days,
+                              Literal(type=BIGINT, value=MICROS_PER_DAY)))
+                return Call(type=INTERVAL_DAY_SECOND, fn="sub",
+                            args=(l_ir, r_ir))
+            return call(opmap[e.op], l_ir, r_ir)
 
         if isinstance(e, ast.Unary):
             if e.op == "not":
@@ -3454,6 +3519,44 @@ class Binder:
                            value=v)
         return Literal(type=BIGINT, value=v)
 
+    def _bind_interval_arith(self, op: str, l_ir: Expr,
+                             r_ir: Expr) -> Optional[Expr]:
+        """Typed interval arithmetic (dispatch on BOUND types, so
+        interval-valued sub-expressions work like literals):
+        interval +- interval (same family), datetime +- day-second
+        interval, datetime +- year-month interval.  Returns None when
+        neither operand is interval-typed."""
+        from presto_tpu.types import INTERVAL_DAY_SECOND
+
+        IV = ("interval day to second", "interval year to month")
+        lt, rt = l_ir.type.name, r_ir.type.name
+        if lt not in IV and rt not in IV:
+            return None
+        if op not in ("+", "-"):
+            raise BindError(f"operator {op} undefined for intervals")
+        if lt in IV and rt in IV:
+            if lt != rt:
+                raise BindError(
+                    "cannot mix day-second and year-month intervals")
+            return Call(type=l_ir.type, fn="add" if op == "+" else "sub",
+                        args=(l_ir, r_ir))
+        iv, base = (l_ir, r_ir) if lt in IV else (r_ir, l_ir)
+        if base.type.name not in ("timestamp", "date"):
+            raise BindError(
+                f"cannot apply interval to {base.type}")
+        if op == "-" and lt in IV:
+            raise BindError("interval - datetime unsupported")
+        if op == "-":
+            iv = Call(type=iv.type, fn="mul",
+                      args=(iv, Literal(type=BIGINT, value=-1)))
+        if iv.type == INTERVAL_DAY_SECOND:
+            if base.type == DATE:
+                base = call("cast_timestamp", base)
+            return call("ts_add_micros", base, iv)
+        if base.type == DATE:
+            return call("date_add_months", base, iv)
+        return call("ts_add_months", base, iv)
+
     def _bind_date_arith(self, e: ast.Binary, scope: Scope, agg) -> Expr:
         if isinstance(e.right, ast.IntervalLit):
             base_ast, iv = e.left, e.right
@@ -3461,11 +3564,16 @@ class Binder:
             if e.op == "-":
                 raise BindError("interval - date unsupported")
             base_ast, iv = e.right, e.left
-        n = int(iv.value) * (-1 if iv.negative else 1)
+        try:
+            n = int(iv.value) * (-1 if iv.negative else 1)
+        except ValueError:
+            raise BindError(f"malformed interval literal {iv.value!r}")
         if e.op == "-":
             n = -n
         base = self._bind_impl(base_ast, scope, agg)
-        micros = {"second": 1_000_000, "minute": 60_000_000, "hour": 3_600_000_000}
+        # shared unit table minus 'day': date +- N days stays a civil
+        # DATE shift here rather than a micros promotion
+        micros = {k: v for k, v in _INTERVAL_MICROS.items() if k != "day"}
         if isinstance(base, Literal) and base.type == DATE and base.value is not None:
             if iv.unit in micros:
                 return Literal(type=TIMESTAMP,

@@ -96,7 +96,9 @@ class Type:
     @property
     def is_integerlike(self) -> bool:
         return self.name in ("bigint", "integer", "smallint", "tinyint",
-                             "date", "timestamp", "time")
+                             "date", "timestamp", "time",
+                             "interval day to second",
+                             "interval year to month")
 
     @property
     def is_binary(self) -> bool:
@@ -186,6 +188,12 @@ DATE = Type("date", np.dtype(np.int32))
 TIMESTAMP = Type("timestamp", np.dtype(np.int64))
 # TIME: microseconds since midnight (reference: spi/type/TimeType.java)
 TIME = Type("time", np.dtype(np.int64))
+# INTERVAL types (spi/type/IntervalDayTimeType.java / IntervalYearMonthType):
+# day-to-second = int64 microseconds, year-to-month = int64 months —
+# plain int64 columns on device, so interval sum/avg/min/max ride the
+# integer aggregation kernels unchanged
+INTERVAL_DAY_SECOND = Type("interval day to second", np.dtype(np.int64))
+INTERVAL_YEAR_MONTH = Type("interval year to month", np.dtype(np.int64))
 MICROS_PER_DAY = 86_400_000_000
 
 

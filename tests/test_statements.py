@@ -236,3 +236,25 @@ def test_quantified_keeps_subquery_order_limit():
     ).rows[0][0]
     n = r.execute("SELECT count(*) FROM orders").rows[0][0]
     assert got == n - 3
+
+
+def test_interval_values_and_aggregates(runner):
+    """First-class INTERVAL values (IntervalDayTimeType /
+    IntervalYearMonthType + Interval*Sum/AverageAggregation): datetime
+    differences produce intervals, and sum/avg/min/max fold them."""
+    import datetime
+
+    td = datetime.timedelta
+    assert runner.execute("select interval '3' day").rows == [(td(days=3),)]
+    assert runner.execute(
+        "select interval '90' second + interval '30' second").rows == [
+        (td(seconds=120),)]
+    rows = runner.execute(
+        "select sum(d), avg(d) from (select timestamp '2020-01-02 00:00:00'"
+        " - timestamp '2020-01-01 12:00:00' as d from nation)").rows
+    n = runner.execute("select count(*) from nation").rows[0][0]
+    assert rows == [(td(hours=12) * n, td(hours=12))]
+    assert runner.execute("select interval '14' month").rows == [(14,)]
+    assert runner.execute(
+        "select max(dd) from (select date '2020-01-03' - date '2020-01-01'"
+        " as dd from nation)").rows == [(td(days=2),)]
