@@ -1336,14 +1336,67 @@ class LocalRunner:
         from presto_tpu.memory import ExceededMemoryLimitError
 
         try:
-            return self._run_aggregation_impl(node)
+            return self._host_finalize_aggs(
+                node, self._run_aggregation_impl(node))
         except ExceededMemoryLimitError as e:
             if f"agg_accumulator@{id(node)}#" not in e.tag:
                 raise
         except GroupCapacityExceeded as e:
             if e.node is not node or e.needed <= SPILL_GROUP_THRESHOLD:
                 raise
-        return self._run_aggregation_spilled(node)
+        return self._host_finalize_aggs(
+            node, self._run_aggregation_spilled(node))
+
+    def _host_finalize_aggs(self, node: AggregationNode, out: Page) -> Page:
+        """Aggregates whose OUTPUT is a string cannot finalize inside
+        jit; their jitted finalize emits the numeric state and this
+        host pass formats it (evaluate_classifier_predictions — the
+        presto-ml output function's role)."""
+        if not any(a.fn == "evaluate_classifier_predictions"
+                   for a in node.aggs):
+            return out
+        from presto_tpu.ops.aggregate import ML_MAX_CLASSES
+        from presto_tpu.page import Dictionary
+        from presto_tpu.types import VARCHAR
+
+        C = ML_MAX_CLASSES
+        nkeys = len(node.group_exprs)
+        blocks = list(out.blocks)
+        for i, agg in enumerate(node.aggs):
+            if agg.fn != "evaluate_classifier_predictions":
+                continue
+            b = blocks[nkeys + i]
+            data = np.asarray(b.data)
+            valid = np.asarray(b.valid) & np.asarray(out.row_mask)
+            live_rows = np.nonzero(valid)[0]
+            texts = [""] * data.shape[0]
+            for r in live_rows:  # dead padded slots skip formatting
+                tp = data[r, 1:1 + C]
+                fp = data[r, 1 + C:1 + 2 * C]
+                fn = data[r, 1 + 2 * C:1 + 3 * C]
+                correct = int(tp.sum())
+                total = correct + int(fp.sum())
+                pct = 100.0 * correct / total if total else 0.0
+                parts = [f"Accuracy: {correct}/{total} ({pct:.2f}%)\n"]
+                for cls in range(C):
+                    t_, f_, n_ = int(tp[cls]), int(fp[cls]), int(fn[cls])
+                    if t_ == 0 and f_ == 0 and n_ == 0:
+                        continue
+                    pp = 100.0 * t_ / (t_ + f_) if t_ + f_ else 0.0
+                    rr = 100.0 * t_ / (t_ + n_) if t_ + n_ else 0.0
+                    parts.append(f"Class '{cls}'\n")
+                    parts.append(
+                        f"Precision: {t_}/{t_ + f_} ({pp:.2f}%)\n")
+                    parts.append(f"Recall: {t_}/{t_ + n_} ({rr:.2f}%)\n")
+                texts[r] = "".join(parts)
+            uniq = sorted({texts[r] for r in live_rows})
+            dic = Dictionary(uniq)
+            codes = np.zeros(data.shape[0], dtype=np.int32)
+            for r in live_rows:
+                codes[r] = dic.code_of(texts[r])  # memoized O(1) lookup
+            blocks[nkeys + i] = Block(jnp.asarray(codes),
+                                      jnp.asarray(valid), VARCHAR, dic)
+        return Page(tuple(blocks), out.row_mask)
 
     def _run_aggregation_spilled(self, node: AggregationNode) -> Page:
         """Lifespan-style partitioned aggregation: hash-partition the

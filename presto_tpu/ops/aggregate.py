@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from presto_tpu.expr.compile import ExprCompiler
 from presto_tpu.expr.ir import AggCall, Expr
 from presto_tpu.page import Block, Page
-from presto_tpu.types import BIGINT, DOUBLE, DecimalType, Type
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR, DecimalType, Type
 
 _I64_MAX = jnp.iinfo(jnp.int64).max
 
@@ -165,6 +165,12 @@ def state_types(agg: AggCall) -> List[Type]:
 
         k = agg.arg2.type.max_elems
         return [ArrayType(DOUBLE, ML_MAX_CLASSES * (1 + 2 * k)), BIGINT]
+    if agg.fn == "evaluate_classifier_predictions":
+        # per class: [tp, fp, fn] counts (presto-ml
+        # EvaluateClassifierPredictionsAggregation state maps)
+        from presto_tpu.types import ArrayType
+
+        return [ArrayType(BIGINT, 3 * ML_MAX_CLASSES), BIGINT]
     raise KeyError(f"unknown aggregate {agg.fn}")
 
 
@@ -193,6 +199,10 @@ def output_type(agg: AggCall) -> Type:
         from presto_tpu.types import SetDigestType
 
         return SetDigestType()
+    if agg.fn == "evaluate_classifier_predictions":
+        # VARCHAR summary; the numeric state travels through the jitted
+        # pipeline and LocalRunner formats it host-side at the end
+        return VARCHAR
     if agg.fn == "multimap_agg":
         from presto_tpu.types import ArrayType, MapType
 
@@ -538,6 +548,32 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
             rho = jnp.where(nonnull, data.astype(jnp.float64), 0.0)
             s = _seg_sum(jnp.where(nonnull, jnp.exp2(-rho), 0.0), gid_nn, n + 1)[:n]
             out.append([s, cnt])
+        elif agg.fn == "evaluate_classifier_predictions":
+            # per-class tp/fp/fn lanes summed per group (presto-ml
+            # EvaluateClassifierPredictionsAggregation input/combine;
+            # labels are class ids in [0, ML_MAX_CLASSES))
+            C = ML_MAX_CLASSES
+            p_data, p_valid = c.compile(agg.arg2)(page)
+            t64 = data.astype(jnp.int64)
+            p64 = p_data.astype(jnp.int64)
+            sel = (rowsel & valid & p_valid
+                   & (t64 >= 0) & (t64 < C) & (p64 >= 0) & (p64 < C))
+            cls = jnp.arange(C, dtype=jnp.int64)[None, :]
+            t_oh = t64[:, None] == cls
+            p_oh = p64[:, None] == cls
+            eq = (t64 == p64)[:, None]
+            lanes = jnp.concatenate(
+                [jnp.where(t_oh & eq, 1, 0),          # tp at truth cls
+                 jnp.where(p_oh & ~eq, 1, 0),         # fp at pred cls
+                 jnp.where(t_oh & ~eq, 1, 0)],        # fn at truth cls
+                axis=1).astype(jnp.int64)
+            lanes = jnp.where(sel[:, None], lanes, 0)
+            gid_s = jnp.where(sel, gid, n)
+            scnt = _gsum(ctx, sel.astype(jnp.int64), gid_s, n)
+            sums = _gsum(ctx, lanes, gid_s, n)
+            state = jnp.concatenate(
+                [jnp.full((n, 1), 3 * C, dtype=jnp.int64), sums], axis=1)
+            out.append([state, scnt])
         elif agg.fn in ("learn_regressor", "learn_classifier"):
             # sufficient statistics are segment sums (TPU-native
             # training): normal equations for the regressor, Gaussian
@@ -992,9 +1028,11 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
                 _gsum(ctx, cols[0], gid, n),
                 _gsum(ctx, cols[1], gid, n),
             ])
-        elif agg.fn in ("learn_regressor", "learn_classifier"):
+        elif agg.fn in ("learn_regressor", "learn_classifier",
+                        "evaluate_classifier_predictions"):
             arr, cnt = cols
-            zero_dead = jnp.where((gid < n)[:, None], arr, 0.0)
+            zero_dead = jnp.where((gid < n)[:, None], arr,
+                                  jnp.zeros((), arr.dtype))
             out.append([
                 _gsum(ctx, zero_dead, gid, n),
                 _gsum(ctx, cnt, gid, n),
@@ -1216,12 +1254,15 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
                 q = q + (2 * (av - q * n) >= n).astype(q.dtype)
                 blocks.append(Block((sign * q).astype(t.np_dtype), cnt > 0, t))
             elif t.name.startswith("interval"):
-                # integer average, half rounded toward +inf (Java
-                # Math.round semantics — jnp.round would round half to
-                # even); counts fit float64 exactly here
-                d = jnp.floor(s.astype(jnp.float64)
-                              / n.astype(jnp.float64) + 0.5).astype(jnp.int64)
-                blocks.append(Block(d, cnt > 0, t))
+                # exact integer average with HALF-UP away from zero —
+                # same machinery as the decimal branch (float division
+                # would lose microseconds once the sum passes 2^53)
+                av = jnp.abs(s)
+                sign = jnp.where(s < 0, -1, 1)
+                q = av // n
+                q = q + (2 * (av - q * n) >= n).astype(q.dtype)
+                blocks.append(Block((sign * q).astype(jnp.int64),
+                                    cnt > 0, t))
             else:
                 num = s.astype(jnp.float64)
                 d = num / n.astype(jnp.float64)
@@ -1340,6 +1381,13 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
                         "make_set_digest", "merge_set_digest"):
             arr_state, cnt = cols
             blocks.append(Block(arr_state.astype(t.np_dtype), cnt > 0, t, adict))
+        elif agg.fn == "evaluate_classifier_predictions":
+            # transient: int64 count matrix under the VARCHAR type —
+            # LocalRunner._host_finalize_aggs rewrites it to dictionary
+            # codes immediately after the final merge (strings cannot
+            # be built inside jit)
+            arr_state, cnt = cols
+            blocks.append(Block(arr_state.astype(jnp.int64), cnt > 0, t))
         elif agg.fn in ("max_by_n", "min_by_n"):
             # drop the ordering-key half of the state; convert the
             # shared-storage sentinel to the output array's own

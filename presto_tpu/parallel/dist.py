@@ -424,6 +424,11 @@ class DistributedRunner:
         host checks them and retries the stage with doubled max_groups,
         exactly as LocalRunner._check_overflow does locally (reference
         rehash: MultiChannelGroupByHash.java:138-145 tryRehash)."""
+        if any(a.fn == "evaluate_classifier_predictions" for a in agg.aggs):
+            # host-finalized string output: only the local runner
+            # formats it after the final merge
+            raise DistributedUnsupported(
+                "evaluate_classifier_predictions is local-only")
         while True:
             try:
                 return self._run_aggregation_stage_once(agg)

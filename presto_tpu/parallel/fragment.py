@@ -321,6 +321,8 @@ def is_agg_stage(node: PlanNode,
     """Root of a scan->chain->partial-agg->exchange->final-merge mesh
     stage (the reference's FIXED_HASH aggregation fragment pair)."""
     return (isinstance(node, AggregationNode) and node.step == "single"
+            and not any(a.fn == "evaluate_classifier_predictions"
+                        for a in node.aggs)  # host-finalized: local only
             and chain_distributable(node.source) is None
             and _leaf_big_enough(node.source, min_precomputed_rows))
 

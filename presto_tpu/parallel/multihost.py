@@ -265,6 +265,9 @@ class MultiHostRunner:
         re-chunked input with a coordinator merge."""
         if agg.step != "single":
             raise MultiHostUnsupported("non-single aggregation stage")
+        if any(a.fn == "evaluate_classifier_predictions" for a in agg.aggs):
+            raise MultiHostUnsupported(
+                "evaluate_classifier_predictions is local-only")
         leaf = self.local._chain_leaf(agg.source)
         if isinstance(leaf, TableScanNode):
             return self._run_agg_with_retry(agg, leaf)

@@ -77,6 +77,8 @@ AGG_FUNCTIONS = {
     # KMV set digests (type/setdigest/BuildSetDigestAggregation.java +
     # MergeSetDigestAggregation.java)
     "make_set_digest", "merge_set_digest",
+    # presto-ml classifier evaluation (host-finalized string summary)
+    "evaluate_classifier_predictions",
 }
 
 # Correlated bindings mark outer-scope columns with this offset so a
@@ -303,6 +305,10 @@ def _interval_literal(iv: "ast.IntervalLit"):
 
     sign = -1 if iv.negative else 1
     text = iv.value.strip()
+    if text.startswith(("-", "+")):  # sign inside the string
+        if text[0] == "-":
+            sign = -sign
+        text = text[1:].strip()
     try:
         if iv.unit in _INTERVAL_MICROS:
             if "." in text:
@@ -3532,6 +3538,28 @@ class Binder:
         lt, rt = l_ir.type.name, r_ir.type.name
         if lt not in IV and rt not in IV:
             return None
+        if op in ("*", "/"):
+            # interval scaled by a number (IntervalDayTimeOperators
+            # multiplyBy*/dividedBy*: the product truncates to the unit
+            # count like the reference's (long) cast)
+            if op == "*" and lt not in IV and l_ir.type.is_numeric:
+                iv, k = r_ir, l_ir
+            elif rt not in IV and r_ir.type.is_numeric:
+                iv, k = l_ir, r_ir
+            else:
+                raise BindError(
+                    f"operator {op} undefined for these interval operands")
+            from presto_tpu.types import DOUBLE as _DOUBLE
+
+            exact = op == "*" and k.type.name in (
+                "bigint", "integer", "smallint", "tinyint")
+            if exact:
+                return Call(type=iv.type, fn="mul", args=(iv, k))
+            # fractional scale: compute in double, truncate like the
+            # reference's (long) cast
+            prod = Call(type=_DOUBLE, fn="mul" if op == "*" else "div",
+                        args=(iv, k))
+            return Call(type=iv.type, fn="cast_bigint", args=(prod,))
         if op not in ("+", "-"):
             raise BindError(f"operator {op} undefined for intervals")
         if lt in IV and rt in IV:
@@ -3564,45 +3592,54 @@ class Binder:
             if e.op == "-":
                 raise BindError("interval - date unsupported")
             base_ast, iv = e.right, e.left
-        try:
-            n = int(iv.value) * (-1 if iv.negative else 1)
-        except ValueError:
-            raise BindError(f"malformed interval literal {iv.value!r}")
+        # ONE literal parser serves the standalone-value and date-arith
+        # paths (fractional seconds, 'Y-M', signed strings included)
+        t_iv, v_iv = _interval_literal(iv)
         if e.op == "-":
-            n = -n
+            v_iv = -v_iv
         base = self._bind_impl(base_ast, scope, agg)
-        # shared unit table minus 'day': date +- N days stays a civil
-        # DATE shift here rather than a micros promotion
-        micros = {k: v for k, v in _INTERVAL_MICROS.items() if k != "day"}
-        if isinstance(base, Literal) and base.type == DATE and base.value is not None:
-            if iv.unit in micros:
+        from presto_tpu.types import INTERVAL_DAY_SECOND
+
+        if t_iv == INTERVAL_DAY_SECOND:
+            whole_days = v_iv % MICROS_PER_DAY == 0
+            if isinstance(base, Literal) and base.type == DATE \
+                    and base.value is not None:
+                if whole_days:  # civil DATE shift stays a DATE
+                    return Literal(type=DATE,
+                                   value=base.value + v_iv // MICROS_PER_DAY)
                 return Literal(type=TIMESTAMP,
-                               value=base.value * MICROS_PER_DAY + n * micros[iv.unit])
-            return Literal(type=DATE, value=_shift_date(base.value, n, iv.unit))
-        if isinstance(base, Literal) and base.type == TIMESTAMP and base.value is not None:
-            if iv.unit in micros:
-                return Literal(type=TIMESTAMP, value=base.value + n * micros[iv.unit])
+                               value=base.value * MICROS_PER_DAY + v_iv)
+            if isinstance(base, Literal) and base.type == TIMESTAMP \
+                    and base.value is not None:
+                return Literal(type=TIMESTAMP, value=base.value + v_iv)
+            if base.type == TIMESTAMP:
+                return call("ts_add_micros", base,
+                            Literal(type=BIGINT, value=v_iv))
+            if whole_days:
+                return call("date_add_days", base,
+                            Literal(type=BIGINT,
+                                    value=v_iv // MICROS_PER_DAY))
+            # sub-day interval promotes the date to a timestamp
+            return call("ts_add_micros", call("cast_timestamp", base),
+                        Literal(type=BIGINT, value=v_iv))
+        months = v_iv
+        if isinstance(base, Literal) and base.type == DATE \
+                and base.value is not None:
+            return Literal(type=DATE,
+                           value=_shift_date(base.value, months, "month"))
+        if isinstance(base, Literal) and base.type == TIMESTAMP \
+                and base.value is not None:
             days = base.value // MICROS_PER_DAY
             tod = base.value - days * MICROS_PER_DAY
-            return Literal(type=TIMESTAMP,
-                           value=_shift_date(days, n, iv.unit) * MICROS_PER_DAY + tod)
+            return Literal(
+                type=TIMESTAMP,
+                value=_shift_date(days, months, "month") * MICROS_PER_DAY
+                + tod)
         if base.type == TIMESTAMP:
-            if iv.unit in micros:
-                return call("ts_add_micros", base,
-                            Literal(type=BIGINT, value=n * micros[iv.unit]))
-            if iv.unit == "day":
-                return call("ts_add_micros", base,
-                            Literal(type=BIGINT, value=n * MICROS_PER_DAY))
             return call("ts_add_months", base,
-                        Literal(type=BIGINT, value=n * (12 if iv.unit == "year" else 1)))
-        if iv.unit == "day":
-            return call("date_add_days", base, Literal(type=BIGINT, value=n))
-        if iv.unit in ("month", "year"):
-            return call("date_add_months", base,
-                        Literal(type=BIGINT, value=n * (12 if iv.unit == "year" else 1)))
-        # date column +/- an hour/minute/second interval promotes to timestamp
-        return call("ts_add_micros", call("cast_timestamp", base),
-                    Literal(type=BIGINT, value=n * micros[iv.unit]))
+                        Literal(type=BIGINT, value=months))
+        return call("date_add_months", base,
+                    Literal(type=BIGINT, value=months))
 
     def _bind_case(self, e: ast.Case, scope: Scope, agg) -> Expr:
         whens = []
@@ -3891,7 +3928,8 @@ class Binder:
                   "multimap_agg",
                   "covar_pop", "covar_samp", "corr", "regr_slope",
                   "regr_intercept",
-                  "learn_regressor", "learn_classifier"):
+                  "learn_regressor", "learn_classifier",
+                  "evaluate_classifier_predictions"):
             if len(e.args) != 2:
                 raise BindError(f"aggregate {fn} takes two arguments")
             if distinct:

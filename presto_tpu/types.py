@@ -388,6 +388,8 @@ def common_super_type(a: Type, b: Type) -> Type:
         loser = b if winner is a else a
         if winner.is_decimal and loser.is_decimal:
             scale = max(a.scale, b.scale)
+            if (a.precision or 0) > 36 or (b.precision or 0) > 36:
+                return DecimalType(38, scale)  # wide 5-limb layout
             long_ = a.is_long_decimal or b.is_long_decimal
             return DecimalType(36 if long_ else 18, scale)
         if winner.is_decimal and loser.name in (

@@ -142,4 +142,3 @@ def test_compiled_expr_jits():
     jf = jax.jit(lambda pg: f(pg))
     d, v = jf(p)
     assert np.asarray(d)[0] == 100 * (100 - 100)
-

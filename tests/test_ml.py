@@ -89,3 +89,21 @@ def test_partial_final_split_across_pages():
         "SELECT learn_regressor(y, features(x)) FROM t2").rows[0]
     assert model[0] == pytest.approx(3.0, abs=1e-6)
     assert model[1] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_evaluate_classifier_predictions(runner):
+    """presto-ml EvaluateClassifierPredictionsAggregation: accuracy +
+    per-class precision/recall summary (host-finalized string; class
+    labels are bounded integer ids here)."""
+    res = runner.execute(
+        "select evaluate_classifier_predictions(t, p) from "
+        "(values (1,1),(1,1),(0,1),(0,0),(1,0)) x(t, p)")
+    text = res.rows[0][0]
+    assert text.startswith("Accuracy: 3/5 (60.00%)\n")
+    assert "Class '1'\nPrecision: 2/3 (66.67%)" in text
+    # grouped: each group evaluates independently
+    rows = dict(runner.execute(
+        "select g, evaluate_classifier_predictions(t, p) from "
+        "(values (7,1,1),(7,0,1),(8,1,1),(8,0,0)) x(g,t,p) group by g").rows)
+    assert rows[8].startswith("Accuracy: 2/2 (100.00%)")
+    assert rows[7].startswith("Accuracy: 1/2 (50.00%)")
