@@ -1329,6 +1329,21 @@ class LocalRunner:
             return prod <= mg
         return False
 
+    def _packed_direct(self, node: AggregationNode, mg: int) -> bool:
+        """True when the chain's partial aggregation takes the
+        packed-direct layout (group id == slot position): exact domains
+        AND within DIRECT_GROUP_LIMIT — mirrors grouped_aggregate's own
+        branch condition.  Above the limit the sort path emits
+        front-compacted pages instead, where position says nothing."""
+        from presto_tpu.ops.aggregate import DIRECT_GROUP_LIMIT
+
+        # presorted partials take grouped_aggregate's STREAMING branch
+        # (front-compacted, first-appearance order) before packed-direct
+        # is even considered — position says nothing there
+        if getattr(node, "presorted", False):
+            return False
+        return self._exact_capacity(node, min(mg, DIRECT_GROUP_LIMIT))
+
     def _run_aggregation(self, node: AggregationNode) -> Page:
         """Breaker with spill fallback: the in-place path folds partial
         pages on device; past the pool limit or the capacity threshold
@@ -1516,7 +1531,7 @@ class LocalRunner:
         tower_on = _os.environ.get("PRESTO_TPU_AGG_TOWER", "1") \
             not in ("0", "false")
         if tower_on and node.group_exprs \
-                and not self._exact_capacity(node, mg):
+                and not self._packed_direct(node, mg):
             # sort-path partials: live-extent compaction + tower merge.
             # Tower capacities are unclamped, so the merge itself never
             # truncates; the one remaining hazard is the chain's
@@ -1528,6 +1543,7 @@ class LocalRunner:
             for p in self._pages(source):
                 tower.add(p)
             if node.step == "single" and tower.suspect_truncation \
+                    and not self._exact_capacity(node, mg) \
                     and mg < MAX_AGG_GROUPS:
                 needed = min(
                     MAX_AGG_GROUPS,
@@ -1542,7 +1558,49 @@ class LocalRunner:
                     node, Page.empty(node.output_types, max(mg, 1)))
             return self._groupid_empty_fixup(node, out)
 
-        # global aggregation and exact-capacity (packed-direct) partials:
+        # exact-capacity (packed-direct) partials: slot position IS the
+        # group key, so the fold is pure ELEMENTWISE state combination —
+        # no sort, no scatter, no concat (the direct-address layout's
+        # payoff; the classic sort-merge fold re-sorted 2*capacity keys
+        # per split)
+        from presto_tpu.ops.aggregate import (
+            combine_packed_states, finalize_packed, packed_fold_supported,
+        )
+
+        # positional fold requires the pages to BE packed-direct, which
+        # only this runner's own injected partial guarantees — step
+        # 'final' inputs arrive through exchange serde, which compacts
+        # live rows and destroys the slot layout
+        if node.group_exprs and node.step == "single" \
+                and self._packed_direct(node, mg) \
+                and packed_fold_supported(aggs):
+            def fold_pk(acc: Optional[Page], p: Page) -> Page:
+                if acc is None:
+                    return p
+                return combine_packed_states(acc, p, num_keys, aggs)
+
+            def final_pk(acc: Page) -> Page:
+                return finalize_packed(acc, num_keys, aggs)
+
+            fold_fn, final_fn = self._fold_cache.get(node, (None, None))
+            if fold_fn is None:
+                fold_fn = jax.jit(fold_pk) if self.jit else fold_pk
+                final_fn = jax.jit(final_pk) if self.jit else final_pk
+                self._fold_cache[node] = (fold_fn, final_fn)
+            acc = None
+            for p in self._pages(source):
+                if acc is None:
+                    acc = p
+                    self._account("agg_accumulator", acc, node)
+                else:
+                    acc = fold_fn(acc, p)
+            if acc is None:
+                return self._groupid_empty_fixup(
+                    node, Page.empty(node.output_types, max(mg, 1)))
+            out = final_fn(acc)
+            return self._groupid_empty_fixup(node, out)
+
+        # global aggregation and remaining exact-capacity shapes:
         # fixed-capacity running fold — pages are already as tight as the
         # key domain allows, so compaction buys nothing
         def fold(acc: Optional[Page], p: Page) -> Page:

@@ -787,6 +787,108 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
     return out
 
 
+#: aggregate fns whose packed-direct states combine POSITIONALLY —
+#: slot i of one partial merges with slot i of another by pure
+#: elementwise math (no sort, no scatter)
+_POSITIONAL_FNS = frozenset({
+    "count", "count_star", "sum", "sum0", "avg", "min", "max",
+    "bitwise_and_agg", "bitwise_or_agg",
+}) | set(VARIANCE_FNS)
+
+
+def packed_fold_supported(aggs: Sequence[AggCall]) -> bool:
+    """True when every aggregate's packed-direct state merges
+    elementwise (raw-string min/max lane matrices excluded)."""
+    for a in aggs:
+        if a.fn not in _POSITIONAL_FNS:
+            return False
+        if a.fn in ("min", "max") and a.arg is not None \
+                and (a.arg.type.is_raw_string
+                     or a.arg.type.is_long_decimal):
+            # lane matrices / limb vectors need lexicographic combines,
+            # not per-component minimum
+            return False
+    return True
+
+
+def _slice_state_cols(page: Page, num_keys: int, aggs) -> List[List[jax.Array]]:
+    cols: List[List[jax.Array]] = []
+    pos = num_keys
+    for agg in aggs:
+        k = len(state_types(agg))
+        cols.append([page.blocks[pos + j].data for j in range(k)])
+        pos += k
+    return cols
+
+
+def combine_packed_states(a: Page, b: Page, num_keys: int,
+                          aggs: Sequence[AggCall]) -> Page:
+    """Fold two packed-direct partial pages ELEMENTWISE: group id ==
+    packed key == slot position, so merging is vector adds/mins/maxes
+    over aligned slots — the no-sort fast path the direct-address
+    layout buys (dead slots hold the combine identities: 0 for sums,
+    type extremes for min/max).  Variance states combine via Chan's
+    pairwise formula, also elementwise."""
+    ca = _slice_state_cols(a, num_keys, aggs)
+    cb = _slice_state_cols(b, num_keys, aggs)
+    out_blocks = list(a.blocks[:num_keys])
+    pos = num_keys
+    for agg, sa, sb in zip(aggs, ca, cb):
+        sts = state_types(agg)
+        if agg.fn in ("count", "count_star"):
+            merged = [sa[0] + sb[0]]
+        elif agg.fn in ("sum", "sum0", "avg") and agg.arg is not None \
+                and agg.arg.type.is_long_decimal:
+            from presto_tpu.ops import decimal128 as d128
+
+            merged = [d128.add(sa[0], sb[0]), sa[1] + sb[1]]
+        elif agg.fn in ("sum", "sum0", "avg"):
+            merged = [sa[0] + sb[0], sa[1] + sb[1]]
+        elif agg.fn == "min":
+            merged = [jnp.minimum(sa[0], sb[0]), sa[1] + sb[1]]
+        elif agg.fn == "max":
+            merged = [jnp.maximum(sa[0], sb[0]), sa[1] + sb[1]]
+        elif agg.fn == "bitwise_and_agg":
+            merged = [sa[0] & sb[0], sa[1] + sb[1]]
+        elif agg.fn == "bitwise_or_agg":
+            merged = [sa[0] | sb[0], sa[1] + sb[1]]
+        else:  # VARIANCE_FNS: (s, m2, cnt) via Chan's pairwise update
+            s_a, m2a, n_a = sa
+            s_b, m2b, n_b = sb
+            naf = n_a.astype(jnp.float64)
+            nbf = n_b.astype(jnp.float64)
+            nf = jnp.maximum(naf + nbf, 1.0)
+            mean_a = s_a / jnp.maximum(naf, 1.0)
+            mean_b = s_b / jnp.maximum(nbf, 1.0)
+            delta = mean_b - mean_a
+            chan = m2a + m2b + delta * delta * naf * nbf / nf
+            m2 = jnp.where(n_a == 0, m2b, jnp.where(n_b == 0, m2a, chan))
+            merged = [s_a + s_b, m2, n_a + n_b]
+        for st, col in zip(sts, merged):
+            blk = a.blocks[pos]
+            out_blocks.append(Block(col.astype(st.np_dtype),
+                                    a.blocks[pos].valid | b.blocks[pos].valid,
+                                    st, blk.dictionary))
+            pos += 1
+    mask = a.row_mask | b.row_mask
+    return Page(tuple(out_blocks), mask)
+
+
+def finalize_packed(acc: Page, num_keys: int,
+                    aggs: Sequence[AggCall]) -> Page:
+    """mode='single' finalize of a packed-direct accumulator WITHOUT
+    re-grouping: slots already hold one group each."""
+    states = _slice_state_cols(acc, num_keys, aggs)
+    agg_dicts = [acc.blocks[num_keys + sum(
+        len(state_types(a)) for a in aggs[:i])].dictionary
+        for i, a in enumerate(aggs)]
+    agg_blocks = _finalize(states, aggs, agg_dicts)
+    mask = acc.row_mask
+    agg_blocks = [Block(b.data, b.valid & mask, b.type, b.dictionary)
+                  for b in agg_blocks]
+    return Page(tuple(acc.blocks[:num_keys]) + tuple(agg_blocks), mask)
+
+
 def mix64(v: jax.Array) -> jax.Array:
     """splitmix64 (golden-ratio increment + the _mix64 finalizer below):
     int64 value -> well-mixed int64 hash — the hash behind
