@@ -1335,14 +1335,14 @@ class LocalRunner:
         AND within DIRECT_GROUP_LIMIT — mirrors grouped_aggregate's own
         branch condition.  Above the limit the sort path emits
         front-compacted pages instead, where position says nothing."""
-        from presto_tpu.ops.aggregate import DIRECT_GROUP_LIMIT
+        from presto_tpu.ops.aggregate import packed_direct_layout
 
         # presorted partials take grouped_aggregate's STREAMING branch
         # (front-compacted, first-appearance order) before packed-direct
         # is even considered — position says nothing there
         if getattr(node, "presorted", False):
             return False
-        return self._exact_capacity(node, min(mg, DIRECT_GROUP_LIMIT))
+        return packed_direct_layout(node.group_exprs, node.key_domains, mg)
 
     def _run_aggregation(self, node: AggregationNode) -> Page:
         """Breaker with spill fallback: the in-place path folds partial

@@ -796,6 +796,24 @@ _POSITIONAL_FNS = frozenset({
 }) | set(VARIANCE_FNS)
 
 
+def packed_direct_layout(group_exprs, key_domains, max_groups: int) -> bool:
+    """THE packed-direct branch predicate (grouped_aggregate's own
+    condition, exported so runners never hand-mirror it): exact scalar
+    key domains whose product fits the direct-address budget.  Raw
+    byte-matrix and multi-dim keys pack inexactly (pack_or_hash_keys
+    returns exact=False for them), so they are excluded here too."""
+    if not group_exprs or not key_domains             or any(d is None for d in key_domains):
+        return False
+    for e in group_exprs:
+        t = getattr(e, "type", None)
+        if t is None or t.is_raw_string or t.is_binary                 or t.value_shape != ():
+            return False
+    prod = 1
+    for lo, hi in key_domains:
+        prod *= hi - lo + 2
+    return prod <= min(max_groups, DIRECT_GROUP_LIMIT)
+
+
 def packed_fold_supported(aggs: Sequence[AggCall]) -> bool:
     """True when every aggregate's packed-direct state merges
     elementwise (raw-string min/max lane matrices excluded)."""
@@ -811,14 +829,19 @@ def packed_fold_supported(aggs: Sequence[AggCall]) -> bool:
     return True
 
 
-def _slice_state_cols(page: Page, num_keys: int, aggs) -> List[List[jax.Array]]:
+def _slice_state_cols(page: Page, num_keys: int, aggs):
+    """(state columns, first-state dictionaries) per aggregate — ONE
+    linear walk of the state layout (shared by the positional fold and
+    finalize so the layout logic lives in one place)."""
     cols: List[List[jax.Array]] = []
+    dicts: List[Optional[object]] = []
     pos = num_keys
     for agg in aggs:
         k = len(state_types(agg))
         cols.append([page.blocks[pos + j].data for j in range(k)])
+        dicts.append(page.blocks[pos].dictionary)
         pos += k
-    return cols
+    return cols, dicts
 
 
 def combine_packed_states(a: Page, b: Page, num_keys: int,
@@ -829,8 +852,8 @@ def combine_packed_states(a: Page, b: Page, num_keys: int,
     layout buys (dead slots hold the combine identities: 0 for sums,
     type extremes for min/max).  Variance states combine via Chan's
     pairwise formula, also elementwise."""
-    ca = _slice_state_cols(a, num_keys, aggs)
-    cb = _slice_state_cols(b, num_keys, aggs)
+    ca, _ = _slice_state_cols(a, num_keys, aggs)
+    cb, _ = _slice_state_cols(b, num_keys, aggs)
     out_blocks = list(a.blocks[:num_keys])
     pos = num_keys
     for agg, sa, sb in zip(aggs, ca, cb):
@@ -878,10 +901,7 @@ def finalize_packed(acc: Page, num_keys: int,
                     aggs: Sequence[AggCall]) -> Page:
     """mode='single' finalize of a packed-direct accumulator WITHOUT
     re-grouping: slots already hold one group each."""
-    states = _slice_state_cols(acc, num_keys, aggs)
-    agg_dicts = [acc.blocks[num_keys + sum(
-        len(state_types(a)) for a in aggs[:i])].dictionary
-        for i, a in enumerate(aggs)]
+    states, agg_dicts = _slice_state_cols(acc, num_keys, aggs)
     agg_blocks = _finalize(states, aggs, agg_dicts)
     mask = acc.row_mask
     agg_blocks = [Block(b.data, b.valid & mask, b.type, b.dictionary)

@@ -404,3 +404,58 @@ def test_unique_direct_collision_detected():
     jb = build_join(b, [ColumnRef(type=BIGINT, index=0)],
                     key_domains=[(1, 5)], unique=True)
     assert jb.unique_ok is not None and not bool(jb.unique_ok)
+
+
+def test_packed_direct_positional_fold():
+    """combine_packed_states merges packed-direct partials ELEMENTWISE
+    (slot == group id): sums add, mins/maxes reduce, variance states
+    combine via Chan's formula — and finalize_packed emits the result
+    without any re-grouping sort."""
+    import jax.numpy as jnp
+
+    from presto_tpu.expr.ir import AggCall, ColumnRef
+    from presto_tpu.ops.aggregate import (
+        combine_packed_states, finalize_packed, grouped_aggregate,
+        packed_fold_supported,
+    )
+    from presto_tpu.page import Block, Page
+    from presto_tpu.types import BIGINT, DOUBLE, DecimalType
+
+    key = ColumnRef(type=BIGINT, index=0)
+    val = ColumnRef(type=DOUBLE, index=1)
+    aggs = [AggCall(fn="sum", arg=val, type=DOUBLE),
+            AggCall(fn="min", arg=val, type=DOUBLE),
+            AggCall(fn="count_star", arg=None, type=BIGINT),
+            AggCall(fn="variance", arg=val, type=DOUBLE)]
+    assert packed_fold_supported(aggs)
+    # long-decimal min must NOT take the per-limb elementwise path
+    assert not packed_fold_supported(
+        [AggCall(fn="min", arg=ColumnRef(type=DecimalType(38, 0), index=1),
+                 type=DecimalType(38, 0))])
+
+    def page(keys, vals):
+        return Page(
+            (Block(jnp.asarray(keys, jnp.int64),
+                   jnp.ones(len(keys), jnp.bool_), BIGINT),
+             Block(jnp.asarray(vals, jnp.float64),
+                   jnp.ones(len(vals), jnp.bool_), DOUBLE)),
+            jnp.ones(len(keys), jnp.bool_))
+
+    domains = [(0, 3)]
+    pa = grouped_aggregate(page([0, 1, 1, 3], [1.0, 2.0, 4.0, 8.0]),
+                           [key], aggs, 6, key_domains=domains,
+                           mode="partial")
+    pb = grouped_aggregate(page([1, 2, 3, 3], [10.0, 20.0, 40.0, 2.0]),
+                           [key], aggs, 6, key_domains=domains,
+                           mode="partial")
+    merged = combine_packed_states(pa, pb, 1, aggs)
+    out = finalize_packed(merged, 1, aggs)
+    rows = {int(k): (float(s), float(m), int(c))
+            for k, s, m, c, _v in out.to_pylist()}
+    assert rows[0] == (1.0, 1.0, 1)
+    assert rows[1] == (16.0, 2.0, 3)
+    assert rows[2] == (20.0, 20.0, 1)
+    assert rows[3] == (50.0, 2.0, 3)
+    # variance of group 3 values {8, 40, 2}: sample var = 417.3333
+    var3 = [r for r in out.to_pylist() if int(r[0]) == 3][0][4]
+    assert abs(float(var3) - 417.0 - 1.0 / 3.0) < 1e-6
