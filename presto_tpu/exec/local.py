@@ -92,14 +92,45 @@ def bucket_capacity(n: int) -> int:
     pages, next power of two below that.  Pow2 alone doubles pages
     sitting just past a boundary (TPC-H generator splits land at
     ~1048576 +- 1200 rows, so pow2 sent a third of them to 2M — a 33%
-    compute tax); 64K granularity keeps the waste <= 6% while still
+    compute tax); 64K granularity keeps the waste <= 6.5% while still
     collapsing the data-dependent capacities that each cost a full
-    XLA compile of the chain program."""
+    XLA compile of the chain program.
+
+    The 2048-row slack absorbs boundary straddle: generator split
+    sizes scatter within ~1200 rows of the nominal split, so a bare
+    ceil parked siblings of one scan in TWO adjacent buckets (1048576
+    vs 1114112 measured at SF1) — one extra chain program per scan for
+    0 rows of useful capacity.  Counts within slack below a boundary
+    round up with their just-past-the-boundary siblings; exact
+    multiples stay put so the function is idempotent.  The slack makes
+    the map non-monotonic in a 2048-row band below each boundary
+    (bounded extra padding, never insufficient capacity); scans avoid
+    even that via the uniform-capacity pass in ``_source_pages``, which
+    keeps a tail from overshooting the bucket its full-size siblings
+    occupy."""
     n = int(n)
     if n >= (1 << 16):
         g = 1 << 16
-        return ((n + g - 1) // g) * g
+        if n % g == 0:
+            return n
+        return ((n + 2048) // g + 1) * g
     return 1 << max(0, n - 1).bit_length()
+
+
+def pad_page_to(page: Page, tgt: int) -> Page:
+    """Pad a page with dead rows up to capacity ``tgt`` (no-op when
+    already at least that large)."""
+    cap = page.capacity
+    if tgt <= cap or cap == 0:
+        return page
+    arrs, pm = _pad_arrays(
+        tuple(b.data for b in page.blocks) + tuple(b.valid for b in page.blocks),
+        page.row_mask, tgt - cap)
+    nb = len(page.blocks)
+    blocks = tuple(
+        Block(arrs[i], arrs[nb + i], b.type, b.dictionary)
+        for i, b in enumerate(page.blocks))
+    return Page(blocks, pm)
 
 
 def pad_page_pow2(page: Page) -> Page:
@@ -113,18 +144,7 @@ def pad_page_pow2(page: Page) -> Page:
 
     if _os.environ.get("PRESTO_TPU_PAD_SCAN", "1") in ("0", "false"):
         return page
-    cap = page.capacity
-    tgt = bucket_capacity(cap)
-    if tgt <= cap or cap == 0:
-        return page
-    arrs, pm = _pad_arrays(
-        tuple(b.data for b in page.blocks) + tuple(b.valid for b in page.blocks),
-        page.row_mask, tgt - cap)
-    nb = len(page.blocks)
-    blocks = tuple(
-        Block(arrs[i], arrs[nb + i], b.type, b.dictionary)
-        for i, b in enumerate(page.blocks))
-    return Page(blocks, pm)
+    return pad_page_to(page, bucket_capacity(page.capacity))
 
 
 def _pad_arrays_impl(arrs, mask, pad):
@@ -264,7 +284,11 @@ class _AggFoldTower:
     this is the static-shape analog).
     """
 
-    MIN_CAP = 1 << 10
+    # floor of the slice/merge capacity ladder.  4096 starts typical
+    # per-split partials (a few thousand live groups) at ONE level, so
+    # the binary counter compiles log2(splits)-1 merge programs instead
+    # of one more; merging <=4096 rows is noise on the VPU either way
+    MIN_CAP = 1 << 12
 
     def __init__(self, runner, node, num_keys, aggs, kd, mg, account=True):
         self.runner = runner
@@ -290,11 +314,19 @@ class _AggFoldTower:
                     concat_pages_device(list(pages)), num_keys, list(aggs),
                     out_cap, key_domains=kd, mode="single")
 
-            if runner.jit:
-                fold = jax.jit(fold, static_argnames=("out_cap",))
-                final = jax.jit(final, static_argnames=("out_cap",))
-            runner._fold_cache[cache_key] = (fold, final)
-            fns = (fold, final)
+            sig = (num_keys, tuple(aggs), tuple(kd or ()))
+            fold_p = runner._program(
+                "agg_tower_fold", sig,
+                lambda f=fold: jax.jit(f, static_argnames=("out_cap",))
+                if runner.jit else f,
+                node=node)
+            final_p = runner._program(
+                "agg_tower_final", sig,
+                lambda f=final: jax.jit(f, static_argnames=("out_cap",))
+                if runner.jit else f,
+                node=node)
+            runner._fold_cache[cache_key] = (fold_p, final_p)
+            fns = (fold_p, final_p)
         self.fold, self.final = fns
 
     def _cap(self, n: int) -> int:
@@ -327,7 +359,11 @@ class _AggFoldTower:
         cap = page.capacity
         while cap in self.levels:
             o_page, o_live, o_tag = self.levels.pop(cap)
-            out_cap = self._cap(live + o_live)
+            # shape-determined merge capacity: the binary counter only
+            # merges equal-capacity pages, so 2*cap always fits
+            # live + o_live — a live-count-derived out_cap flip-flopped
+            # between cap and 2*cap, compiling two programs per level
+            out_cap = 2 * cap
             page, cnt = self.fold([o_page, page], out_cap=out_cap)
             live = min(int(np.asarray(cnt)), out_cap)
             if mem is not None:
@@ -349,14 +385,17 @@ class _AggFoldTower:
 
 
 def _probe_with_retry(probe_fn, build, page):
-    """One expanding probe with the pow2 capacity retry shared by the
-    in-HBM and spilled join paths (yielding LookupJoinPageBuilder
-    analog). probe_fn(build, page, out_capacity) -> (page, total, ...)."""
+    """One expanding probe with the bucketed capacity retry shared by
+    the in-HBM and spilled join paths (yielding LookupJoinPageBuilder
+    analog). probe_fn(build, page, out_capacity) -> (page, total, ...).
+    Retry capacities ride the same pow2/64K ladder as scan pages
+    (bucket_capacity) so expansions that land near each other share one
+    compiled probe program instead of one per observed match count."""
     cap = max(int(page.capacity), 1024)
     res = probe_fn(build, page, cap)
     total = int(np.asarray(res[1]))
     if total > cap:
-        res = probe_fn(build, page, 1 << (total - 1).bit_length())
+        res = probe_fn(build, page, bucket_capacity(total))
     return res
 
 
@@ -377,10 +416,26 @@ class LocalRunner:
     """
 
     def __init__(self, catalog: Catalog, jit: bool = True, split_capacity: Optional[int] = None,
-                 memory_pool=None, spill_partitions: int = 8):
+                 memory_pool=None, spill_partitions: int = 8, programs=None):
+        from presto_tpu.exec.programs import (
+            default_registry, maybe_enable_persistent_cache,
+            structural_sharing_enabled,
+        )
+        from presto_tpu.ops.join import resolve_direct_join
+
         self.catalog = catalog
         self.jit = jit
         self.split_capacity = split_capacity
+        # structural program registry (ExpressionCompiler-cache analog):
+        # compiled callables keyed by kernel family + canonical IR +
+        # baked-in parameters, shared process-wide unless injected
+        self.programs = programs if programs is not None else default_registry()
+        self._structural = structural_sharing_enabled()
+        self._own_registry = None  # per-node keying when sharing is off
+        maybe_enable_persistent_cache()
+        # env-dependent kernel choices resolve ONCE at construction —
+        # not per join build (satellite of the registry PR)
+        resolve_direct_join()
         self.stats: Optional[QueryStats] = None
         # HBM accounting (memory/MemoryPool.java analog); None = untracked
         self.memory_pool = memory_pool
@@ -528,6 +583,15 @@ class LocalRunner:
         progs = self.compiled_program_count()
         if progs is not None:
             text = f"compiled XLA programs: {progs}\n" + text
+        reg = (self._own_registry or self.programs).stats()
+        line = (f"program registry: {reg['callables']} callables, "
+                f"{reg['programs']} compiled programs, "
+                f"{reg['hits']} hits / {reg['misses']} misses, "
+                f"compile {reg['compile_s']:.1f}s")
+        if reg.get("dir"):
+            line += (f", persistent cache hits {reg['persistent_hits']}"
+                     f" ({reg['dir']})")
+        text = line + "\n" + text
         return text
 
     def compiled_program_count(self) -> Optional[int]:
@@ -538,7 +602,10 @@ class LocalRunner:
         seen = set()
         entries = list(self._chain_cache.values())
         for v in self._fold_cache.values():
-            entries.extend(v if isinstance(v, tuple) else (v,))
+            if isinstance(v, (tuple, list)):
+                entries.extend(x for x in v if x is not None)
+            else:
+                entries.append(v)
         for fn in entries:
             if id(fn) in seen:
                 continue
@@ -548,6 +615,60 @@ class LocalRunner:
             except Exception:
                 total += 1  # non-jitted (debug mode) counts as one
         return total
+
+    def _program(self, kind: str, sig, factory, node=None):
+        """Compiled callable for (kind, structural signature) from the
+        shared registry — identical operator shapes in other plans,
+        queries, and runners resolve to the same callable.  With
+        structural sharing disabled (PRESTO_TPU_PROGRAM_REGISTRY=0,
+        the A/B baseline) the key degrades to per-PlanNode identity in
+        a runner-private registry, i.e. the pre-registry behavior."""
+        if self._structural or node is None:
+            return self.programs.get(kind, sig, factory, jit=self.jit)
+        from presto_tpu.exec.programs import ProgramRegistry
+
+        # A/B baseline: NO dedup — every request compiles fresh and the
+        # per-runner memo dicts are the only cache (seed behavior), so
+        # capacity-retry invalidation (memo deletion) fully retires a
+        # stale program; a keyed per-node registry would hand the retry
+        # the old max-groups capacity back.  The private registry holds
+        # the programs solely for metrics (unique monotonic keys).
+        if self._own_registry is None:
+            self._own_registry = ProgramRegistry()
+        self._ab_seq = getattr(self, "_ab_seq", 0) + 1
+        return self._own_registry.get(kind, ("ab", self._ab_seq), factory,
+                                      jit=self.jit)
+
+    def _stage_signature(self, node: PlanNode):
+        """Structural signature of the fused streaming chain rooted at
+        ``node``.  Mirrors ``_build_stage`` member-for-member: every
+        parameter a stage closure bakes in (expression IR, resolved
+        capacities, key domains, join kind/flags, build arity) is part
+        of the signature, so equal signatures guarantee the cached
+        callable computes the same function.  Input-page schemas are
+        NOT included — they ride as jit-static pytree aux data
+        (types + dictionaries) and key jit's own trace cache."""
+        if isinstance(node, FilterNode):
+            return ("filter", node.predicate,
+                    self._stage_signature(node.source))
+        if isinstance(node, ProjectNode):
+            return ("project", tuple(node.projections),
+                    self._stage_signature(node.source))
+        if isinstance(node, AggregationNode) and node.step == "partial":
+            return ("agg_partial", tuple(node.group_exprs),
+                    tuple(node.aggs), self._max_groups(node),
+                    tuple(node.key_domains),
+                    bool(getattr(node, "presorted", False)),
+                    self._stage_signature(node.source))
+        if isinstance(node, JoinNode) and self._streaming(node):
+            return ("probe", tuple(node.left_keys),
+                    tuple(node.key_domains or ()), node.kind,
+                    node.null_safe_keys, getattr(node, "null_aware", False),
+                    len(node.right.channels),
+                    self._stage_signature(node.left))
+        if isinstance(node, CrossSingleNode):
+            return ("cross1", self._stage_signature(node.left))
+        return ("leaf",)
 
     def _is_chain_member(self, n: PlanNode) -> bool:
         return (
@@ -676,7 +797,10 @@ class LocalRunner:
                 def do_sort(p):
                     return sort_page(p, sort_exprs, ascending, nulls_first)
 
-                fn = jax.jit(do_sort) if self.jit else do_sort
+                fn = self._program(
+                    "sort", (sort_exprs, ascending, nulls_first),
+                    lambda: jax.jit(do_sort) if self.jit else do_sort,
+                    node=node)
                 self._fold_cache[node] = fn
             pages = list(self._pages(node.source))
             if len(pages) > 1 and self.merge_sort:
@@ -767,7 +891,11 @@ class LocalRunner:
                         partition_domains=pd,
                     )
 
-                fn = jax.jit(do_window) if self.jit else do_window
+                fn = self._program(
+                    "window",
+                    (partition_exprs, order_exprs, ascending, funcs, pd),
+                    lambda: jax.jit(do_window) if self.jit else do_window,
+                    node=node)
                 self._fold_cache[node] = fn
             yield fn(src)
             return
@@ -788,7 +916,12 @@ class LocalRunner:
                 def do_unnest(p: Page) -> Page:
                     return unnest_expand(p, exprs, ordinality, chans)
 
-                fn = jax.jit(do_unnest) if self.jit else do_unnest
+                fn = self._program(
+                    "unnest",
+                    (exprs, ordinality,
+                     [(c.type, c.dictionary) for c in chans]),
+                    lambda: jax.jit(do_unnest) if self.jit else do_unnest,
+                    node=node)
                 self._fold_cache[node] = fn
             for p in self._pages(node.source):
                 yield fn(p)
@@ -836,7 +969,9 @@ class LocalRunner:
         if node in self._chain_cache:
             fn = self._chain_cache[node]
         else:
-            fn = jax.jit(stage) if self.jit else stage
+            fn = self._program(
+                "chain", self._stage_signature(node),
+                lambda: jax.jit(stage) if self.jit else stage, node=node)
             self._chain_cache[node] = fn
         for page in self._source_pages(leaf):
             tag = None
@@ -870,7 +1005,13 @@ class LocalRunner:
 
     def _build_stage(self, node: PlanNode, joins: List[JoinNode]):
         """Recursively build fn(page, consts)->page for the streaming
-        prefix of ``node``; below the chain leaf, the identity."""
+        prefix of ``node``; below the chain leaf, the identity.
+
+        KEEP IN SYNC with ``_stage_signature``: every parameter a stage
+        closure bakes in here must appear in the signature, or two
+        different chains will share one compiled program (silent wrong
+        results, not a crash).  test_cold_compile pins the current
+        parameters' signature-sensitivity."""
         if isinstance(node, FilterNode):
             inner = self._build_stage(node.source, joins)
             pred = node.predicate
@@ -953,6 +1094,22 @@ class LocalRunner:
                     return  # provably empty scan
             sample = node.sample
             produced = 0
+            # scan-uniform capacity: a split that FITS a previously
+            # established bucket of this scan (and is at least a third
+            # of it) joins that bucket instead of opening its own, so the
+            # whole scan runs ONE chain program — this catches both the
+            # ragged tail and the boundary-straddle siblings without
+            # consulting bucket_capacity's slack again (an exact-size
+            # generator's just-short tail must NOT overshoot past the
+            # full splits' bucket).  Much smaller splits keep their own
+            # bucket: padding a sliver to full capacity would multiply
+            # its compute, not add +6%.  PRESTO_TPU_PAD_SCAN=0 disables
+            # all scan padding, uniform included.
+            import os as _os
+
+            uniform = _os.environ.get("PRESTO_TPU_PAD_SCAN", "1") \
+                not in ("0", "false")
+            cap_hi = 0
             for split in splits:
                 if node.limit is not None and produced >= node.limit:
                     break  # pushed-down LIMIT satisfied: skip the rest
@@ -984,8 +1141,15 @@ class LocalRunner:
                     import numpy as _np
 
                     produced += int(_np.asarray(page.row_mask).sum())
-                yield pad_page_pow2(
-                    Page(tuple(page.blocks[i] for i in idx), page.row_mask))
+                raw = Page(tuple(page.blocks[i] for i in idx), page.row_mask)
+                if uniform and 0 < raw.capacity <= cap_hi \
+                        and raw.capacity * 3 >= cap_hi:
+                    out = pad_page_to(raw, cap_hi)
+                else:
+                    out = pad_page_pow2(raw)
+                    if out.capacity > cap_hi:
+                        cap_hi = out.capacity
+                yield out
         else:
             yield from self._pages(node)
 
@@ -1006,12 +1170,22 @@ class LocalRunner:
                         ns = getattr(node, "null_safe_keys", False)
 
                         def make_build(ps, _u=uniq):
+                            # bucket the build capacity (concat sums the
+                            # producers' caps — a data-dependent shape
+                            # every downstream probe program would bake
+                            # in; padding dead rows restores the ladder)
                             return build_join(
-                                concat_pages_device(list(ps)), right_keys,
+                                pad_page_pow2(concat_pages_device(list(ps))),
+                                right_keys,
                                 key_domains=kd, null_safe=ns, unique=_u,
                             )
 
-                        fn = jax.jit(make_build) if self.jit else make_build
+                        fn = self._program(
+                            "join_build",
+                            (right_keys, tuple(kd or ()), ns, uniq),
+                            lambda: jax.jit(make_build) if self.jit
+                            else make_build,
+                            node=node)
                         self._fold_cache[(node, uniq)] = fn
                     return fn
 
@@ -1059,7 +1233,13 @@ class LocalRunner:
         if node in self._chain_cache:
             fn = self._chain_cache[node]
         else:
-            fn = jax.jit(probe, static_argnames=("out_capacity",)) if self.jit else probe
+            fn = self._program(
+                "probe_expand",
+                (left_keys, tuple(kd or ()), kind, tuple(build_output),
+                 is_full, ns),
+                lambda: jax.jit(probe, static_argnames=("out_capacity",))
+                if self.jit else probe,
+                node=node)
             self._chain_cache[node] = fn
 
         matched_acc = None
@@ -1107,9 +1287,20 @@ class LocalRunner:
                     )
                     return Page(tuple(blocks), p.row_mask)
 
-                return jax.jit(run) if self.jit else run
+                return run
 
-            fns = [make(mask, gid) for gid, mask in enumerate(node.set_masks)]
+            fns = [
+                self._program(
+                    "groupid",
+                    (tuple(key_exprs),
+                     [(c.type, c.dictionary) for c in key_chans],
+                     tuple(bool(b) for b in mask), gid,
+                     node.channels[-1].type),
+                    lambda m=mask, g=gid: jax.jit(make(m, g)) if self.jit
+                    else make(m, g),
+                    node=node)
+                for gid, mask in enumerate(node.set_masks)
+            ]
             self._fold_cache[node] = fns
         for p in self._pages(node.source):
             for fn in fns:
@@ -1191,8 +1382,14 @@ class LocalRunner:
         ns = node.null_safe_keys
         right_types = node.right.output_types
 
-        bfn_r = make_bucket_fn(right_keys, kd, K, jit=self.jit)
-        bfn_l = make_bucket_fn(left_keys, kd, K, jit=self.jit)
+        bfn_r = self._program(
+            "spill_bucket", (tuple(right_keys), tuple(kd or ()), K),
+            lambda: make_bucket_fn(right_keys, kd, K, jit=self.jit),
+            node=node)
+        bfn_l = self._program(
+            "spill_bucket", (tuple(left_keys), tuple(kd or ()), K),
+            lambda: make_bucket_fn(left_keys, kd, K, jit=self.jit),
+            node=node)
 
         bbuckets: List[List[HostPage]] = [[] for _ in range(K)]
         for p in self._pages(node.right):
@@ -1297,7 +1494,9 @@ class LocalRunner:
 
         fold_fn = self._fold_cache.get(node)
         if fold_fn is None:
-            fold_fn = jax.jit(fold) if self.jit else fold
+            fold_fn = self._program(
+                "topn", (n, sort_exprs, ascending, nulls_first),
+                lambda: jax.jit(fold) if self.jit else fold, node=node)
             self._fold_cache[node] = fold_fn
 
         acc: Optional[Page] = None
@@ -1438,7 +1637,10 @@ class LocalRunner:
                             for i in range(num_keys)]
         else:
             bucket_exprs = group_exprs
-        bucket_fn = make_bucket_fn(bucket_exprs, kd, K, jit=self.jit)
+        bucket_fn = self._program(
+            "spill_bucket", (tuple(bucket_exprs), tuple(kd or ()), K),
+            lambda: make_bucket_fn(bucket_exprs, kd, K, jit=self.jit),
+            node=node)
 
         buckets: List[List[HostPage]] = [[] for _ in range(K)]
         for p in self._pages(node.source):
@@ -1584,8 +1786,15 @@ class LocalRunner:
 
             fold_fn, final_fn = self._fold_cache.get(node, (None, None))
             if fold_fn is None:
-                fold_fn = jax.jit(fold_pk) if self.jit else fold_pk
-                final_fn = jax.jit(final_pk) if self.jit else final_pk
+                sig = (num_keys, tuple(aggs))
+                fold_fn = self._program(
+                    "agg_packed_fold", sig,
+                    lambda: jax.jit(fold_pk) if self.jit else fold_pk,
+                    node=node)
+                final_fn = self._program(
+                    "agg_packed_final", sig,
+                    lambda: jax.jit(final_pk) if self.jit else final_pk,
+                    node=node)
                 self._fold_cache[node] = (fold_fn, final_fn)
             acc = None
             for p in self._pages(source):
@@ -1612,14 +1821,28 @@ class LocalRunner:
 
         fold_fn, final_fn = self._fold_cache.get(node, (None, None))
         if fold_fn is None:
-            fold_fn = jax.jit(fold) if self.jit else fold
-            final_fn = jax.jit(final) if self.jit else final
+            sig = (num_keys, tuple(aggs), mg, tuple(kd or ()))
+            fold_fn = self._program(
+                "agg_fold", sig,
+                lambda: jax.jit(fold) if self.jit else fold, node=node)
+            final_fn = self._program(
+                "agg_final", sig,
+                lambda: jax.jit(final) if self.jit else final, node=node)
             self._fold_cache[node] = (fold_fn, final_fn)
 
+        # seed the first fold with a dead-rows accumulator so EVERY
+        # call has the steady-state (acc, page) shape — a bare first
+        # call traced a second program (fold of the page alone) per
+        # aggregation.  Dictionary-carrying states keep the unseeded
+        # start: an empty block's dictionary is None and concat would
+        # adopt it.
+        seedable = all(c.dictionary is None for c in source.channels)
         acc: Optional[Page] = None
         for p in self._pages(source):
             if acc is None:
-                acc = fold_fn(acc, p)
+                seed = Page.empty(source.output_types, mg) if seedable \
+                    else None
+                acc = fold_fn(seed, p)
                 self._account("agg_accumulator", acc, node)
             else:
                 acc = fold_fn(acc, p)

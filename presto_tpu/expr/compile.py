@@ -502,6 +502,17 @@ def _string_transform(e: "Call"):
     return None
 
 
+def literal_array_dictionary(values) -> Dictionary:
+    """Shared dictionary for an all-literal string array
+    (ARRAY['a','b']): codes are positions in the sorted distinct
+    values.  Cached by content so binder, compiler, and channel
+    provenance all resolve to the SAME identity-hashed Dictionary."""
+    key = ("$litarr", tuple(values))
+    if key not in _DERIVED_DICTS:
+        _DERIVED_DICTS[key] = (None, Dictionary(sorted(set(values))), [False])
+    return _DERIVED_DICTS[key][1]
+
+
 def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Optional[Dictionary]:
     """Dictionary provenance of a string-typed expression: bare columns
     keep theirs; string-transform calls derive a transformed dictionary
@@ -537,6 +548,13 @@ def expr_dictionary(e: Expr, dictionaries: Sequence[Optional[Dictionary]]) -> Op
         # an element of a dictionary-coded string array keeps the
         # array's element dictionary
         return expr_dictionary(e.args[0], dictionaries)
+    if isinstance(e, Call) and e.fn == "array_construct" \
+            and e.type.is_array and e.type.element is not None \
+            and e.type.element.is_string \
+            and all(isinstance(a, Literal) for a in e.args):
+        # ARRAY['a','b']: the elements code into one derived dictionary
+        return literal_array_dictionary(
+            [a.value for a in e.args if a.value is not None])
     if isinstance(e, Call) and e.fn == "date_format":
         fmt = e.args[1]
         if isinstance(fmt, Literal) and fmt.value is not None:
@@ -1617,6 +1635,26 @@ class ExprCompiler:
         out_t = expr.type
         if fn == "array_construct":
             elem_t = out_t.element
+            if elem_t is not None and elem_t.is_string \
+                    and all(isinstance(a, Literal) for a in expr.args):
+                # all-literal string array (the binder rejects any
+                # other string-array construction): elements become
+                # codes in the shared derived dictionary; the channel/
+                # unnest layer re-attaches it via expr_dictionary
+                dic = literal_array_dictionary(
+                    [a.value for a in expr.args if a.value is not None])
+                codes = [(dic.code_of(a.value) if a.value is not None
+                          else 0, a.value is not None) for a in expr.args]
+
+                def run_construct_lit(page):
+                    n = page.capacity
+                    datas = [jnp.full((n,), c, jnp.int64) for c, _ in codes]
+                    valids = [jnp.full((n,), ok, jnp.bool_)
+                              for _, ok in codes]
+                    return (ct.construct_array(datas, valids, out_t),
+                            jnp.ones(n, jnp.bool_))
+
+                return run_construct_lit
             parts = [(self._compile_operand(a, elem_t), a.type) for a in expr.args]
 
             def run_construct(page):

@@ -26,6 +26,12 @@ def build_from_etc(etc_dir: str, port: int = 0):
 
     cfg = EngineConfig.from_etc(etc_dir)
     catalog = cfg.build_catalog()
+    # persistent XLA program cache: a restarted coordinator/worker
+    # rehydrates compiled query programs from disk instead of paying
+    # the cold-start compile tax again (exec/programs.py)
+    from presto_tpu.exec.programs import maybe_enable_persistent_cache
+
+    maybe_enable_persistent_cache(cfg)
     port = port or cfg.int("http-server.http.port", 0)
     if cfg.bool("coordinator", True):
         from presto_tpu.server.coordinator import CoordinatorServer

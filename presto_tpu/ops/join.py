@@ -46,29 +46,63 @@ DIRECT_DOMAIN_MAX = 1 << 26
 DIRECT_DOMAIN_PER_ROW = 64
 
 
-def _direct_table_profitable() -> bool:
+# Direct-table / unique-direct selection resolves ONCE per process
+# (first runner construction warms it) instead of re-reading the
+# environment inside every build_join call — the per-build hot path.
+# The explicit override hooks exist for the A/B harness
+# (tools/tpu_ab_direct_join.py) and tests, which flip legs in-process.
+_DIRECT_JOIN_RESOLVED: "Optional[bool]" = None
+_UNIQUE_DIRECT_RESOLVED: "Optional[bool]" = None
+
+
+def set_direct_join_override(value: "Optional[bool]") -> None:
+    """Force the direct-address join table on/off (None re-resolves
+    from the environment/backend on next use)."""
+    global _DIRECT_JOIN_RESOLVED
+    _DIRECT_JOIN_RESOLVED = None if value is None else bool(value)
+
+
+def set_unique_direct_override(value: "Optional[bool]") -> None:
+    """Force the sort-free unique-build path on/off (None re-resolves
+    from the environment on next use)."""
+    global _UNIQUE_DIRECT_RESOLVED
+    _UNIQUE_DIRECT_RESOLVED = None if value is None else bool(value)
+
+
+def resolve_direct_join() -> bool:
     """The direct table pays a domain-sized fused sort at build time to
     make probes O(1) gathers.  That trade wins on TPU (binary-search
     probes serialize ~log2(build) gather rounds; measured CPU-vs-TPU in
     PERF.md) but LOSES on XLA:CPU, whose searchsorted is already cheap
     and whose domain-sized sort is not (TPC-H Q3 SF1 measured 1.7x
     slower with the table).  Env override PRESTO_TPU_DIRECT_JOIN=0/1
-    forces it off/on for A/B runs."""
-    import os as _os
+    forces it off/on for A/B runs; resolved once per process."""
+    global _DIRECT_JOIN_RESOLVED
+    if _DIRECT_JOIN_RESOLVED is None:
+        import os as _os
 
-    force = _os.environ.get("PRESTO_TPU_DIRECT_JOIN")
-    if force is not None:
-        return force not in ("0", "false", "")
-    import jax as _jax
+        force = _os.environ.get("PRESTO_TPU_DIRECT_JOIN")
+        if force is not None:
+            _DIRECT_JOIN_RESOLVED = force not in ("0", "false", "")
+        else:
+            import jax as _jax
 
-    return _jax.default_backend() != "cpu"
+            _DIRECT_JOIN_RESOLVED = _jax.default_backend() != "cpu"
+    return _DIRECT_JOIN_RESOLVED
+
+
+def _direct_table_profitable() -> bool:
+    return resolve_direct_join()
 
 
 def _unique_direct_enabled() -> bool:
-    import os
+    global _UNIQUE_DIRECT_RESOLVED
+    if _UNIQUE_DIRECT_RESOLVED is None:
+        import os
 
-    return os.environ.get("PRESTO_TPU_UNIQUE_DIRECT", "1") \
-        not in ("0", "false", "")
+        _UNIQUE_DIRECT_RESOLVED = os.environ.get(
+            "PRESTO_TPU_UNIQUE_DIRECT", "1") not in ("0", "false", "")
+    return _UNIQUE_DIRECT_RESOLVED
 
 
 def _direct_budget(page: Page) -> int:

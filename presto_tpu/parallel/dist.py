@@ -516,10 +516,17 @@ class DistributedRunner:
     def _join_cfg_for(self, jnode, cap: int) -> Dict[str, int]:
         """Static capacities for a partitioned/expanding join, grown by
         the check-and-retry protocol."""
+        from presto_tpu.exec.local import bucket_capacity
+
         cfg = self._join_cfg.setdefault(jnode, {})
         n = self.n
-        cfg.setdefault("bucket_cap", max(2 * cap // max(n, 1), 1024))
-        cfg.setdefault("out_cap", max(2 * cap, 4096))
+        # bucket/out capacities ride the shared pow2/64K shape ladder:
+        # raw 2*cap//n guesses are data-dependent (split row counts), so
+        # every distinct table size compiled its own exchange + probe
+        # programs — canonicalized caps let the registry hit instead
+        cfg.setdefault("bucket_cap",
+                       bucket_capacity(max(2 * cap // max(n, 1), 1024)))
+        cfg.setdefault("out_cap", bucket_capacity(max(2 * cap, 4096)))
         cfg.setdefault("build_bucket_cap", 0)  # lazily set from build cap
         return cfg
 
@@ -944,7 +951,10 @@ class DistributedRunner:
         cap_r = self._split_capacity(conn_r, leaf_r.handle.table)
         cfg = self._join_cfg.setdefault(jnode, {})
         if not cfg.get("build_bucket_cap"):
-            cfg["build_bucket_cap"] = max(2 * cap_r // max(self.n, 1), 1024)
+            from presto_tpu.exec.local import bucket_capacity
+
+            cfg["build_bucket_cap"] = bucket_capacity(
+                max(2 * cap_r // max(self.n, 1), 1024))
         while True:
             key = (jnode, cfg["build_bucket_cap"])
             cached = self._sharded_builds.get(key)

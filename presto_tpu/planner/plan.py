@@ -382,9 +382,15 @@ class UnnestNode(PlanNode):
 def _expr_channel_elem(e: Expr, name: str, src: List[Channel], key: bool = False) -> Channel:
     """Channel for an unnested element column: element type, with the
     container column's dictionary if the elements are dict-coded."""
+    from presto_tpu.expr.ir import Call as _Call
+
     t = e.type.key_element if key else e.type.element
     from presto_tpu.expr.compile import expr_dictionary
 
+    # MAP(keys_array, values_array): each side's dictionary provenance
+    # comes from its own constructor argument
+    if isinstance(e, _Call) and e.fn in ("map", "map_construct"):
+        e = e.args[0] if key else e.args[1]
     d = expr_dictionary(e, [c.dictionary for c in src]) if t.is_string else None
     return Channel(name, t, d)
 

@@ -67,12 +67,15 @@ def _count_parameters(node) -> int:
 
 class QueryRunner:
     def __init__(self, catalog: Catalog, session: Optional[Session] = None, jit: bool = True,
-                 memory_pool=None, access_control=None):
+                 memory_pool=None, access_control=None, programs=None):
         from presto_tpu.events import EventListenerManager
         from presto_tpu.security import AccessControl
 
         self.catalog = catalog
         self.session = session or Session()
+        # program registry shared by every executor this runner builds
+        # (SET SESSION rebuilds the executor; compiled programs survive)
+        self.programs = programs
         self.binder = Binder(catalog, session=self.session)
         self._jit_default = jit
         # Accounting is always-on (memory/MemoryPool.java:43 tracks
@@ -112,6 +115,7 @@ class QueryRunner:
             jit=self._jit_default and self.session.get("jit"),
             split_capacity=cap,
             memory_pool=self.memory_pool,
+            programs=self.programs,
         )
         ex.merge_sort = bool(self.session.get("distributed_sort"))
         return ex

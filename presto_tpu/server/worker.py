@@ -84,6 +84,10 @@ class WorkerServer:
         from presto_tpu.executor import TaskExecutor
 
         self.catalog = catalog
+        # all runners in this worker process (and any co-resident
+        # coordinator executor) share ONE program registry — the
+        # process-wide default: a fragment shape compiled for task A
+        # is a cache hit for task B
         self.runner = LocalRunner(catalog, memory_pool=memory_pool)
         # cooperative scheduler: page-granularity quanta over a
         # multilevel feedback queue (execution/executor/TaskExecutor.java)

@@ -170,11 +170,16 @@ class Type:
             and self.precision == other.precision
             and self.element == other.element
             and self.key_element == other.key_element
+            # ROW identity includes field types (but not names, which
+            # are access metadata) — eq ignoring fields made every two
+            # row types "equal" and row(bigint) silently adopted
+            # row(bigint, double)'s layout in coercion
+            and self.fields == other.fields
         )
 
     def __hash__(self) -> int:
         return hash((self.name, self.scale, self.precision,
-                     self.element, self.key_element))
+                     self.element, self.key_element, self.fields))
 
 
 BIGINT = Type("bigint", np.dtype(np.int64))
@@ -361,6 +366,23 @@ def common_super_type(a: Type, b: Type) -> Type:
     coercion matrix, metadata/FunctionRegistry.java:349)."""
     if a == b:
         return a
+    if a.name == "array" and b.name == "array":
+        # unify recursively; slot capacities (precision) widen to the
+        # larger — identity equality alone rejected array(bigint) vs
+        # array(bigint) whose widths differed (VERDICT r5 probe: the
+        # repr hides precision, so the error looked self-contradictory)
+        elem = common_super_type(a.element, b.element)
+        return ArrayType(elem, max(a.max_elems, b.max_elems))
+    if a.name == "map" and b.name == "map":
+        key = common_super_type(a.key_element, b.key_element)
+        val = common_super_type(a.element, b.element)
+        return MapType(key, val, max(a.max_elems, b.max_elems))
+    if (a.name == "row" and b.name == "row"
+            and len(a.fields or ()) == len(b.fields or ())):
+        fields = [common_super_type(x, y)
+                  for x, y in zip(a.fields, b.fields)]
+        names = a.field_names if a.field_names == b.field_names else None
+        return RowType(*fields, names=names)
     if {a.name, b.name} == {"date", "timestamp"}:
         return TIMESTAMP
     if a.is_string and b.is_string:

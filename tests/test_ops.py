@@ -247,7 +247,7 @@ def test_direct_table_join_paths(monkeypatch):
     domain) must agree with the searchsorted fallback on every probe
     flavor; forced on via the A/B override since CPU test runs would
     otherwise gate it off."""
-    monkeypatch.setenv("PRESTO_TPU_DIRECT_JOIN", "1")
+    monkeypatch.setattr("presto_tpu.ops.join._DIRECT_JOIN_RESOLVED", True)
     doms = [(10, 30)]
     b, p = _build_probe()
     jb = build_join(b, [col(0, BIGINT)], key_domains=doms)
@@ -301,7 +301,7 @@ def test_direct_table_join_paths(monkeypatch):
 def test_direct_table_respects_domain_budget(monkeypatch):
     """A tiny build over a huge domain must NOT pay a domain-sized
     sort: the per-row budget falls back to searchsorted."""
-    monkeypatch.setenv("PRESTO_TPU_DIRECT_JOIN", "1")
+    monkeypatch.setattr("presto_tpu.ops.join._DIRECT_JOIN_RESOLVED", True)
     from presto_tpu.ops.join import DIRECT_DOMAIN_MAX
 
     b, _ = _build_probe()

@@ -44,8 +44,18 @@ def _child(direct: str) -> dict:
     from presto_tpu.catalog import Catalog
     from presto_tpu.connectors.memory import MemoryConnector
     from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.ops.join import (
+        set_direct_join_override, set_unique_direct_override,
+    )
     from presto_tpu.runner import QueryRunner
     from tests.tpch_queries import QUERIES
+
+    # the env vars are resolved once per process now; set the explicit
+    # overrides too so a leg flip can never be lost to caching order
+    set_direct_join_override(
+        os.environ.get("PRESTO_TPU_DIRECT_JOIN") == "1")
+    set_unique_direct_override(
+        os.environ.get("PRESTO_TPU_UNIQUE_DIRECT") == "1")
 
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     platform = jax.devices()[0].platform
