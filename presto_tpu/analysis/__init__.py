@@ -1,0 +1,64 @@
+"""Static plan/IR analysis tier.
+
+Reference analog: ``EXPLAIN (TYPE VALIDATE)`` + the soundness
+guarantees the reference gets for free from its JIT boundary
+(``sql/gen/ExpressionCompiler``): generated operator bytecode cannot
+type-mismatch its inputs because javac/asm would reject it.  This
+engine compiles expressions to jnp closures instead — nothing rejects
+a plan whose channel types drifted out of sync until a kernel produces
+garbage (or XLA crashes) at execution time.  The validator walks the
+bound logical plan + expression IR *before* execution and checks the
+invariants the executor assumes:
+
+- type consistency at every node boundary (ColumnRef indexes/types
+  against the source's channels, predicate/key types, UNION arm
+  unification);
+- super-type unification sanity (reflexive over containers — the r5
+  "no common super type for array(bigint) and array(bigint)" bug
+  class);
+- null-mask propagation: every plan-node type declares whether it
+  preserves / derives / drops row validity (rules.NULL_MASK_POLICY);
+- shape-ladder conformance: baked capacities (aggregation
+  ``max_groups``) must be ladder values so structural program
+  signatures stay finite (exec/programs.py + bucket_capacity);
+- program-signature determinism: a node's structural signature must be
+  hashable, stable across computations, and NaN-free (a NaN literal
+  key never equals itself — every registry lookup would miss and
+  recompile).
+
+Enablement: ``EXPLAIN (TYPE VALIDATE)`` always runs it; the
+``validate_plans`` session property (``query.validate-plans`` config
+key / ``PRESTO_TPU_VALIDATE_PLANS`` env, resolved once per process
+with an override hook) makes it always-on, which the test harness uses
+so every tier-1 query validates for free.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from presto_tpu.analysis.validator import (  # noqa: F401
+    Issue,
+    PlanValidationError,
+    assert_valid,
+    validate_plan,
+)
+
+# resolved ONCE per process (the engine-lint env-read-hot-path rule:
+# plan validation runs per query, not a place for repeated env reads);
+# set_validation overrides for tests/tools.
+from presto_tpu.envflag import EnvFlag
+
+_VALIDATION = EnvFlag("PRESTO_TPU_VALIDATE_PLANS", default=False)
+
+
+def validation_enabled() -> bool:
+    """Process-wide always-on validation switch
+    (``PRESTO_TPU_VALIDATE_PLANS`` env; the per-session
+    ``validate_plans`` property ORs on top in the runner)."""
+    return _VALIDATION()
+
+
+def set_validation(value: Optional[bool]) -> None:
+    """Override hook (None re-resolves from the environment)."""
+    _VALIDATION.set(value)

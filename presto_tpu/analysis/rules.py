@@ -1,0 +1,482 @@
+"""The individual validator rules.
+
+Each rule is a function ``(node, ctx) -> Iterator[Issue]`` run against
+every node of the plan by :mod:`presto_tpu.analysis.validator`.  Rules
+are conservative: they only flag states the executor genuinely cannot
+handle (a wrong flag here fails EXPLAIN (TYPE VALIDATE) on a healthy
+query, and the whole TPC-H + TPC-DS corpora run with validation on in
+the test harness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Iterator, List, Optional
+
+from presto_tpu.expr.ir import (
+    CMP,
+    LOGIC,
+    AggCall,
+    Call,
+    ColumnRef,
+    Expr,
+    LambdaExpr,
+)
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    Channel,
+    CrossSingleNode,
+    FilterNode,
+    GroupIdNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    PrecomputedNode,
+    ProjectNode,
+    RemoteSourceNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    UnnestNode,
+    ValuesNode,
+    WindowNode,
+)
+from presto_tpu.types import Type, common_super_type
+
+
+@dataclasses.dataclass
+class Issue:
+    """One validator diagnostic, anchored to a named plan node."""
+
+    rule: str      # type-consistency | null-mask | shape-ladder | signature
+    node: str      # e.g. "AggregationNode#2"
+    message: str
+    severity: str = "error"  # error | warning
+
+    def __str__(self) -> str:
+        return f"[{self.rule}] {self.node}: {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# null-mask propagation policy
+# ---------------------------------------------------------------------------
+
+#: Every Block-producing plan-node type declares how it treats row
+#: validity: ``preserves`` (output channels are the source's channels —
+#: same count, same per-column validity), ``derives`` (computes fresh
+#: validity from its inputs: projections, aggregates, outer-join null
+#: extension, NULL-masked grouping sets), or ``source`` (leaf — validity
+#: originates here).  An undeclared node type is itself a finding: the
+#: executor's kernels assume one of these three contracts, and a new
+#: node that never picked one is exactly how silent validity corruption
+#: ships (the mutation tests seed that case).
+NULL_MASK_POLICY = {
+    TableScanNode: "source",
+    ValuesNode: "source",
+    PrecomputedNode: "source",
+    RemoteSourceNode: "source",
+    FilterNode: "preserves",
+    SortNode: "preserves",
+    TopNNode: "preserves",
+    LimitNode: "preserves",
+    UnionNode: "preserves",   # per-position validity concatenates
+    OutputNode: "preserves",
+    ProjectNode: "derives",
+    AggregationNode: "derives",
+    GroupIdNode: "derives",   # masks inactive keys to NULL per set
+    JoinNode: "derives",      # outer/semi variants extend validity
+    CrossSingleNode: "derives",
+    UnnestNode: "derives",    # element liveness = j < len[row]
+    WindowNode: "derives",
+}
+
+#: ``preserves`` nodes whose output legitimately narrows/renames but
+#: keeps per-channel validity untouched (OutputNode renames, UnionNode
+#: concatenates N same-shaped inputs).
+_PRESERVES_EXEMPT_COUNT = (UnionNode, OutputNode)
+
+
+def check_null_mask(node: PlanNode, ctx) -> Iterator[Issue]:
+    policy = NULL_MASK_POLICY.get(type(node))
+    if policy is None:
+        yield Issue(
+            "null-mask", ctx.name(node),
+            f"plan-node type {type(node).__name__} declares no null-mask "
+            "policy (preserves/derives/source) — register it in "
+            "analysis.rules.NULL_MASK_POLICY before executing it")
+        return
+    if policy == "preserves" and not isinstance(node, _PRESERVES_EXEMPT_COUNT):
+        src = node.sources
+        if len(src) == 1:
+            n_out = len(ctx.channels(node))
+            n_in = len(ctx.channels(src[0]))
+            if n_out != n_in:
+                yield Issue(
+                    "null-mask", ctx.name(node),
+                    f"declared validity-preserving but emits {n_out} "
+                    f"channels over a {n_in}-channel source — a "
+                    "preserving node must pass its source's channels "
+                    "through unchanged")
+
+
+# ---------------------------------------------------------------------------
+# type consistency
+# ---------------------------------------------------------------------------
+
+def _types_compatible(expr_t: Optional[Type], chan_t: Optional[Type]) -> bool:
+    """Loose structural agreement between an expression's declared type
+    and the channel it reads.  Names must match; decimals must agree on
+    scale (the scaled-int representation); containers recurse on their
+    element types.  Precision/raw-width/dictionary flags may differ —
+    projections retype those legitimately."""
+    if expr_t is None or chan_t is None:
+        return True
+    if expr_t.name != chan_t.name:
+        return False
+    if expr_t.is_decimal and (expr_t.scale or 0) != (chan_t.scale or 0):
+        return False
+    if expr_t.name == "array":
+        return _types_compatible(expr_t.element, chan_t.element)
+    if expr_t.name == "map":
+        return (_types_compatible(expr_t.key_element, chan_t.key_element)
+                and _types_compatible(expr_t.element, chan_t.element))
+    return True
+
+
+def _walk_exprs(e, in_lambda: bool = False):
+    """(expr, in_lambda) pairs over an IR tree; lambda bodies reference
+    binder-allocated slots, not source channels, so ColumnRef bounds
+    checks do not apply inside them."""
+    if e is None:
+        return
+    if isinstance(e, AggCall):
+        for sub in (e.arg, e.arg2, e.arg3, e.filter):
+            yield from _walk_exprs(sub, in_lambda)
+        return
+    if not isinstance(e, Expr):
+        return
+    yield e, in_lambda
+    if isinstance(e, LambdaExpr):
+        yield from _walk_exprs(e.body, True)
+    elif isinstance(e, Call):
+        for a in e.args:
+            yield from _walk_exprs(a, in_lambda)
+
+
+def _node_exprs(node: PlanNode):
+    """(expr, source_node, label) triples for every expression a node
+    evaluates, paired with the source whose channels it reads."""
+    if isinstance(node, FilterNode):
+        yield node.predicate, node.source, "predicate"
+    elif isinstance(node, ProjectNode):
+        for i, e in enumerate(node.projections):
+            yield e, node.source, f"projection[{i}]"
+    elif isinstance(node, AggregationNode):
+        for i, e in enumerate(node.group_exprs):
+            yield e, node.source, f"group[{i}]"
+        for i, a in enumerate(node.aggs):
+            yield a, node.source, f"agg[{i}]"
+    elif isinstance(node, GroupIdNode):
+        for i, e in enumerate(node.key_exprs):
+            yield e, node.source, f"key[{i}]"
+    elif isinstance(node, JoinNode):
+        for i, e in enumerate(node.left_keys):
+            yield e, node.left, f"left_key[{i}]"
+        for i, e in enumerate(node.right_keys):
+            yield e, node.right, f"right_key[{i}]"
+    elif isinstance(node, (SortNode, TopNNode)):
+        for i, e in enumerate(node.sort_exprs):
+            yield e, node.source, f"sort[{i}]"
+    elif isinstance(node, WindowNode):
+        for i, e in enumerate(node.partition_exprs):
+            yield e, node.source, f"partition[{i}]"
+        for i, e in enumerate(node.order_exprs):
+            yield e, node.source, f"order[{i}]"
+    elif isinstance(node, UnnestNode):
+        for i, e in enumerate(node.unnest_exprs):
+            yield e, node.source, f"unnest[{i}]"
+
+
+def check_type_consistency(node: PlanNode, ctx) -> Iterator[Issue]:
+    for root, src, label in _node_exprs(node):
+        src_channels = ctx.channels(src)
+        for e, in_lambda in _walk_exprs(root):
+            if isinstance(e, ColumnRef) and not in_lambda:
+                if not (0 <= e.index < len(src_channels)):
+                    yield Issue(
+                        "type-consistency", ctx.name(node),
+                        f"{label}: ColumnRef ${e.index} out of bounds "
+                        f"(source has {len(src_channels)} channels)")
+                    continue
+                ct = src_channels[e.index].type
+                if not _types_compatible(e.type, ct):
+                    yield Issue(
+                        "type-consistency", ctx.name(node),
+                        f"{label}: ColumnRef ${e.index} declares "
+                        f"{e.type!r} but the source channel is {ct!r}")
+            elif isinstance(e, Call) and (e.fn in CMP or e.fn in LOGIC):
+                if e.type.name != "boolean":
+                    yield Issue(
+                        "type-consistency", ctx.name(node),
+                        f"{label}: {e.fn}(...) must type as boolean, "
+                        f"got {e.type!r}")
+
+    # node-shape checks -----------------------------------------------------
+    if isinstance(node, FilterNode):
+        # integer-like predicates are legal: some binder lowerings
+        # (CASE-with-boolean-branches) type the 0/1 device repr
+        if node.predicate.type.name not in (
+                "boolean", "bigint", "integer", "smallint", "tinyint"):
+            yield Issue(
+                "type-consistency", ctx.name(node),
+                f"filter predicate types as {node.predicate.type!r}, "
+                "not boolean")
+    elif isinstance(node, ProjectNode):
+        if len(node.projections) != len(node.names):
+            yield Issue(
+                "type-consistency", ctx.name(node),
+                f"{len(node.projections)} projections vs "
+                f"{len(node.names)} names")
+    elif isinstance(node, AggregationNode):
+        if len(node.aggs) != len(node.agg_names):
+            yield Issue("type-consistency", ctx.name(node),
+                        f"{len(node.aggs)} aggregates vs "
+                        f"{len(node.agg_names)} names")
+        if len(node.group_exprs) != len(node.group_names):
+            yield Issue("type-consistency", ctx.name(node),
+                        f"{len(node.group_exprs)} group exprs vs "
+                        f"{len(node.group_names)} names")
+        if node.step not in ("single", "partial", "final"):
+            yield Issue("type-consistency", ctx.name(node),
+                        f"unknown aggregation step {node.step!r}")
+    elif isinstance(node, JoinNode):
+        if len(node.left_keys) != len(node.right_keys):
+            yield Issue("type-consistency", ctx.name(node),
+                        f"{len(node.left_keys)} probe keys vs "
+                        f"{len(node.right_keys)} build keys")
+        if node.kind not in ("inner", "left", "full", "semi", "anti",
+                             "mark", "cross"):
+            yield Issue("type-consistency", ctx.name(node),
+                        f"unknown join kind {node.kind!r}")
+        for i, (lk, rk) in enumerate(zip(node.left_keys, node.right_keys)):
+            yield from _check_unifies(
+                lk.type, rk.type, ctx.name(node), f"key[{i}]")
+    elif isinstance(node, UnionNode):
+        arities = {len(ctx.channels(s)) for s in node.inputs}
+        if len(arities) > 1:
+            yield Issue("type-consistency", ctx.name(node),
+                        f"UNION arms emit differing channel counts "
+                        f"{sorted(arities)}")
+        else:
+            base = ctx.channels(node.inputs[0])
+            for s in node.inputs[1:]:
+                for i, (a, b) in enumerate(zip(base, ctx.channels(s))):
+                    yield from _check_unifies(
+                        a.type, b.type, ctx.name(node), f"column[{i}]")
+    elif isinstance(node, (SortNode, TopNNode)):
+        if len(node.sort_exprs) != len(node.ascending):
+            yield Issue("type-consistency", ctx.name(node),
+                        f"{len(node.sort_exprs)} sort exprs vs "
+                        f"{len(node.ascending)} directions")
+        if isinstance(node, TopNNode) and node.count < 0:
+            yield Issue("type-consistency", ctx.name(node),
+                        f"negative TopN count {node.count}")
+    elif isinstance(node, ValuesNode):
+        for i, row in enumerate(node.rows):
+            if len(row) != len(node.types):
+                yield Issue("type-consistency", ctx.name(node),
+                            f"row {i} has {len(row)} cells for "
+                            f"{len(node.types)} columns")
+                break
+    elif isinstance(node, OutputNode):
+        n_src = len(ctx.channels(node.source))
+        if len(node.names) > n_src:
+            yield Issue("type-consistency", ctx.name(node),
+                        f"{len(node.names)} output names over a "
+                        f"{n_src}-channel source")
+    elif isinstance(node, UnnestNode):
+        for i, e in enumerate(node.unnest_exprs):
+            if not (e.type.is_array or e.type.is_map):
+                yield Issue("type-consistency", ctx.name(node),
+                            f"unnest[{i}] argument is {e.type!r}, "
+                            "not ARRAY or MAP")
+
+
+def _check_unifies(a: Type, b: Type, node_name: str, label: str):
+    """Key/column pairs must unify, and unification must be sane:
+    reflexive (T unify T == T — the r5 container bug produced 'no
+    common super type for array(bigint) and array(bigint)') and
+    symmetric."""
+    try:
+        ab = common_super_type(a, b)
+    except Exception as e:
+        yield Issue("type-consistency", node_name,
+                    f"{label}: {a!r} and {b!r} do not unify ({e})")
+        return
+    try:
+        ba = common_super_type(b, a)
+    except Exception as e:
+        yield Issue("type-consistency", node_name,
+                    f"{label}: unification is asymmetric — {a!r}/{b!r} "
+                    f"unify to {ab!r} but the reverse raises ({e})")
+        return
+    if ab != ba:
+        yield Issue("type-consistency", node_name,
+                    f"{label}: asymmetric unification {ab!r} vs {ba!r}")
+    for t in (a, b):
+        try:
+            if common_super_type(t, t) != t:
+                yield Issue(
+                    "type-consistency", node_name,
+                    f"{label}: unification is not reflexive for {t!r}")
+        except Exception as e:
+            yield Issue(
+                "type-consistency", node_name,
+                f"{label}: {t!r} does not unify with itself ({e}) — "
+                "container super-type bug class")
+
+
+# ---------------------------------------------------------------------------
+# shape-ladder conformance
+# ---------------------------------------------------------------------------
+
+def _is_ladder(n: int) -> bool:
+    """True when ``n`` is a fixed point of the executor's capacity
+    ladder (exec/local.bucket_capacity): a power of two below 64K, a
+    64K multiple above."""
+    if n <= 0:
+        return False
+    if n >= (1 << 16):
+        return n % (1 << 16) == 0
+    return (n & (n - 1)) == 0
+
+
+def check_shape_ladder(node: PlanNode, ctx) -> Iterator[Issue]:
+    if isinstance(node, AggregationNode):
+        mg = node.max_groups
+        if not isinstance(mg, int) or not _is_ladder(mg):
+            yield Issue(
+                "shape-ladder", ctx.name(node),
+                f"max_groups={mg!r} is not a capacity-ladder value "
+                "(pow2 / 64K multiple) — every off-ladder capacity "
+                "bakes a fresh XLA program (route through "
+                "bucket_capacity or a pow2 estimate)")
+        elif mg > (1 << 26):
+            yield Issue(
+                "shape-ladder", ctx.name(node),
+                f"max_groups={mg} exceeds MAX_AGG_GROUPS (1<<26)")
+    if isinstance(node, PrecomputedNode):
+        page = node.page
+        cap = getattr(page, "capacity", None)
+        if isinstance(cap, int) and cap > 0 and not _is_ladder(cap):
+            # materialized intermediates re-enter chains; an off-ladder
+            # capacity costs one extra program but is not unsound
+            yield Issue(
+                "shape-ladder", ctx.name(node),
+                f"materialized page capacity {cap} is off the ladder "
+                "(pad_page_pow2 before splicing to share programs)",
+                severity="warning")
+
+
+# ---------------------------------------------------------------------------
+# program-signature determinism
+# ---------------------------------------------------------------------------
+
+_SIG_SCALARS = (type(None), bool, int, float, str, bytes)
+
+
+def _sig_view(v):
+    """Signature-safe view of a node parameter: IR values (scalars,
+    Types, Dictionaries, Expr/AggCall trees) pass through; anything
+    opaque (materialized Pages of device arrays, connector handles)
+    collapses to its class name.  Routing opaque objects into
+    ``ir_signature`` would pin them — strong references in its
+    process-global identity-token table — for up to 4096 evictions;
+    the determinism check only needs the IR-shaped parts anyway."""
+    from presto_tpu.expr.ir import AggCall as _AggCall, Expr as _Expr
+    from presto_tpu.page import Dictionary as _Dictionary
+
+    if isinstance(v, _SIG_SCALARS) or isinstance(
+            v, (Type, _Dictionary, _Expr, _AggCall)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return tuple(_sig_view(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return tuple(sorted(map(repr, v)))
+    return type(v).__name__
+
+
+def _signature_params(node: PlanNode) -> List:
+    """The node's baked (non-source) parameters — what a structural
+    program signature embeds."""
+    out = []
+    if dataclasses.is_dataclass(node):
+        srcs = set(map(id, node.sources))
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if id(v) in srcs or (isinstance(v, (list, tuple))
+                                 and any(id(x) in srcs for x in v)):
+                continue
+            out.append(_sig_view(v))
+    return out
+
+
+def _find_nan(sig, path: str = "") -> Optional[str]:
+    if isinstance(sig, float) and math.isnan(sig):
+        return path or "<root>"
+    if isinstance(sig, tuple):
+        for i, x in enumerate(sig):
+            hit = _find_nan(x, f"{path}[{i}]")
+            if hit:
+                return hit
+    return None
+
+
+def check_signature_determinism(node: PlanNode, ctx) -> Iterator[Issue]:
+    from presto_tpu.exec.programs import ir_signature
+
+    params = _signature_params(node)
+    try:
+        s1 = ir_signature(params)
+        s2 = ir_signature(params)
+    except Exception as e:
+        yield Issue(
+            "signature", ctx.name(node),
+            f"structural signature raised {type(e).__name__}: {e}")
+        return
+    try:
+        hash(s1)
+    except TypeError as e:
+        yield Issue("signature", ctx.name(node),
+                    f"structural signature is unhashable ({e}) — it "
+                    "cannot key the program registry")
+        return
+    if s1 != s2:
+        yield Issue(
+            "signature", ctx.name(node),
+            "structural signature is nondeterministic (two computations "
+            "differ) — registry lookups would never hit")
+        return
+    nan_at = _find_nan(s1)
+    if nan_at:
+        # warning, not error: same-object NaN tuples still compare
+        # equal (identity shortcut), so cached-plan reuse works — but
+        # a structurally identical plan from different SQL text can
+        # never share the program (nan() literals are legal SQL)
+        yield Issue(
+            "signature", ctx.name(node),
+            f"NaN baked into the program signature at {nan_at} — "
+            "structural twins of this node can never share a compiled "
+            "program (NaN != NaN across plans)",
+            severity="warning")
+
+
+ALL_RULES = (
+    check_type_consistency,
+    check_null_mask,
+    check_shape_ladder,
+    check_signature_determinism,
+)

@@ -1,0 +1,120 @@
+"""Plan/IR validator pass.
+
+Walks a bound logical plan bottom-up, assigns every node a stable
+pre-order name (``AggregationNode#2``), and runs every rule in
+:mod:`presto_tpu.analysis.rules` against it.  Diagnostics come back as
+:class:`Issue` lists; :func:`assert_valid` raises
+:class:`PlanValidationError` when any error-severity issue survives —
+the form ``EXPLAIN (TYPE VALIDATE)`` and the ``validate_plans``
+session property consume.
+
+The walker is defensive by design: a rule (or a node's ``channels``
+property) that *crashes* becomes a diagnostic naming the node rather
+than an anonymous traceback — the validator's whole purpose is turning
+"raw ``KeyError`` three layers deep at execution time" into "node X
+violates invariant Y" before any kernel runs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from presto_tpu.analysis.rules import ALL_RULES, Issue
+from presto_tpu.planner.plan import PlanNode
+
+__all__ = ["Issue", "PlanValidationError", "validate_plan", "assert_valid"]
+
+
+class PlanValidationError(Exception):
+    """A plan failed static validation; ``issues`` carries the full
+    diagnostic list (each naming its node and rule)."""
+
+    def __init__(self, issues: List[Issue]):
+        self.issues = list(issues)
+        lines = "\n".join(f"  {i}" for i in self.issues)
+        super().__init__(
+            f"plan failed validation ({len(self.issues)} issue"
+            f"{'s' if len(self.issues) != 1 else ''}):\n{lines}")
+
+
+class _Context:
+    """Per-validation memo: stable node names + channel lists (channels
+    properties rebuild on every access; UnionNode's merge work should
+    run once, and a crashing derivation should crash once)."""
+
+    def __init__(self):
+        self._names: Dict[int, str] = {}
+        self._channels: Dict[int, list] = {}
+        self._chan_errors: Dict[int, Exception] = {}
+        self._counter = 0
+
+    def register(self, node: PlanNode) -> str:
+        if id(node) not in self._names:
+            self._names[id(node)] = f"{type(node).__name__}#{self._counter}"
+            self._counter += 1
+        return self._names[id(node)]
+
+    def name(self, node: PlanNode) -> str:
+        return self._names.get(id(node)) or self.register(node)
+
+    def channels(self, node: PlanNode) -> list:
+        key = id(node)
+        if key in self._chan_errors:
+            return []
+        if key not in self._channels:
+            try:
+                self._channels[key] = list(node.channels)
+            except Exception as e:
+                self._chan_errors[key] = e
+                return []
+        return self._channels[key]
+
+    def channel_error(self, node: PlanNode):
+        if id(node) not in self._channels and id(node) not in self._chan_errors:
+            self.channels(node)
+        return self._chan_errors.get(id(node))
+
+
+def _walk(node: PlanNode, ctx: _Context, seen: set, order: List[PlanNode]):
+    if id(node) in seen:
+        return
+    seen.add(id(node))
+    ctx.register(node)
+    for s in node.sources:
+        _walk(s, ctx, seen, order)
+    order.append(node)  # bottom-up: leaves first, diagnostics at cause
+
+
+def validate_plan(plan: PlanNode) -> List[Issue]:
+    """All diagnostics for ``plan``, bottom-up (a broken leaf reports
+    before the nodes it confuses downstream)."""
+    ctx = _Context()
+    order: List[PlanNode] = []
+    _walk(plan, ctx, set(), order)
+    issues: List[Issue] = []
+    for node in order:
+        err = ctx.channel_error(node)
+        if err is not None:
+            issues.append(Issue(
+                "type-consistency", ctx.name(node),
+                f"channel derivation raised {type(err).__name__}: {err}"))
+            continue  # downstream rules would re-crash on the same hole
+        for rule in ALL_RULES:
+            try:
+                issues.extend(rule(node, ctx))
+            except Exception as e:  # a crashing rule is itself a finding
+                issues.append(Issue(
+                    rule.__name__.replace("check_", "").replace("_", "-"),
+                    ctx.name(node),
+                    f"validator rule crashed: {type(e).__name__}: {e}"))
+    return issues
+
+
+def assert_valid(plan: PlanNode) -> List[Issue]:
+    """Raise :class:`PlanValidationError` on any error-severity issue;
+    returns the (possibly empty) warning list otherwise."""
+    issues = validate_plan(plan)
+    errors = [i for i in issues if i.severity == "error"]
+    if errors:
+        raise PlanValidationError(errors)
+    return [i for i in issues if i.severity != "error"]

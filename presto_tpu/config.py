@@ -11,6 +11,8 @@ Recognized keys (the engine's subset of the reference's config space):
   http-server.http.port       REST port
   node.id                     stable node identifier
   query.max-memory-per-node   bytes for the local MemoryPool
+  query.validate-plans        run the static plan/IR validator on every
+                              bound plan (docs/static-analysis.md)
   task.buffer-bytes           worker output-buffer cap
   session.<property>          default for any system session property
 
@@ -136,7 +138,14 @@ class EngineConfig:
     def build_session(self):
         from presto_tpu.session import Session
 
-        return Session(properties=self.session_defaults())
+        props = self.session_defaults()
+        # query.validate-plans: always-on static plan validation (the
+        # dotted key mirrors the reference's config namespace; it is
+        # sugar for session.validate_plans)
+        v = self.props.get("query.validate-plans")
+        if v is not None and "validate_plans" not in props:
+            props["validate_plans"] = v
+        return Session(properties=props)
 
 
 _BUILTIN_CONNECTORS = ("tpch", "tpcds", "memory", "blackhole", "jdbc",

@@ -42,6 +42,13 @@ class SystemConnector:
         "system_runtime_queries": [
             ("query_id", VARCHAR), ("state", VARCHAR), ("user", VARCHAR),
             ("rows", BIGINT), ("wall_seconds", DOUBLE), ("query", VARCHAR),
+            # distributed-tier observability: stage count of the mesh /
+            # multi-host run and the fallback reason when the query
+            # silently ran locally instead (VERDICT weak #8 — silent
+            # MultiHostUnsupported fallbacks must be countable:
+            # SELECT count(*) FROM system_runtime_queries WHERE
+            # dist_fallback IS NOT NULL)
+            ("dist_stages", BIGINT), ("dist_fallback", VARCHAR),
         ],
         "system_runtime_nodes": [
             ("node_id", VARCHAR), ("state", VARCHAR),
@@ -72,21 +79,38 @@ class SystemConnector:
                 [e.rows for e in evs],
                 [e.end_time - e.create_time for e in evs],
                 [e.sql.strip()[:200] for e in evs],
+                [e.dist_stages for e in evs],
+                [e.dist_fallback for e in evs],
             ]
         else:
             ns = self.nodes()
             cols = [[n["node_id"] for n in ns], [n["state"] for n in ns]]
         schema = self.SCHEMAS[table]
-        arrays, dicts = [], []
+        arrays, dicts, valids = [], [], []
         for vals, (_, t) in zip(cols, schema):
+            valid = np.asarray([v is not None for v in vals], dtype=np.bool_)
+            valids.append(valid)
             if t.is_string:
-                d = Dictionary(sorted(set(vals)))
-                arrays.append(np.asarray([d.code_of(v) for v in vals], dtype=np.int32))
+                # never an empty dictionary: an all-NULL column (every
+                # query distributed fine) still needs a value for code 0
+                d = Dictionary(sorted({v for v in vals if v is not None})
+                               or [""])
+                arrays.append(np.asarray(
+                    [d.code_of(v) if v is not None else 0 for v in vals],
+                    dtype=np.int32))
                 dicts.append(d)
             else:
-                arrays.append(np.asarray(vals, dtype=t.np_dtype))
+                arrays.append(np.asarray(
+                    [v if v is not None else 0 for v in vals],
+                    dtype=t.np_dtype))
                 dicts.append(None)
         n = len(cols[0])
+        # ladder capacity: the history length grows per query, and a
+        # raw capacity here would bake one fresh XLA program per
+        # history size (engine_lint raw-capacity rule)
+        from presto_tpu.exec.local import bucket_capacity
+
         return Page.from_arrays(
-            arrays, [t for _, t in schema], dictionaries=dicts, capacity=max(n, 1)
+            arrays, [t for _, t in schema], valids=valids,
+            dictionaries=dicts, capacity=bucket_capacity(max(n, 1))
         )

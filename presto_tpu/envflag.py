@@ -1,0 +1,49 @@
+"""Resolve-once boolean environment flags with override hooks.
+
+The engine_lint ``env-read`` contract: an ``os.environ`` read belongs
+at import/construction time or behind a resolve-once helper — never in
+a per-page/per-query path (a dict lookup per page, and program choice
+that flips mid-process with the environment).  Every A/B escape hatch
+(``PRESTO_TPU_PAD_SCAN``, ``PRESTO_TPU_AGG_TOWER``, ...) shares this
+one implementation instead of hand-rolling the getter/setter pair.
+
+Usage::
+
+    _PAD_SCAN = EnvFlag("PRESTO_TPU_PAD_SCAN", default=True)
+    if _PAD_SCAN(): ...
+    _PAD_SCAN.set(False)   # test override; .set(None) re-resolves
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _resolve_env_flag(name: str, default: bool) -> bool:
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false")
+
+
+class EnvFlag:
+    """A boolean env var resolved ONCE per process, with an override
+    hook for tests/tools (``set(True/False)``; ``set(None)``
+    re-resolves from the environment on next read)."""
+
+    __slots__ = ("name", "default", "_value")
+
+    def __init__(self, name: str, default: bool = True):
+        self.name = name
+        self.default = default
+        self._value: Optional[bool] = None
+
+    def __call__(self) -> bool:
+        if self._value is None:
+            self._value = _resolve_env_flag(self.name, self.default)
+        return self._value
+
+    def set(self, value: Optional[bool]) -> None:
+        self._value = value

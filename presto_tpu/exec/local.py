@@ -133,6 +133,36 @@ def pad_page_to(page: Page, tgt: int) -> Page:
     return Page(blocks, pm)
 
 
+# A/B escape hatches, resolved ONCE per process (engine_lint env-read
+# rule: pad_page_pow2 runs per page, _run_aggregation_impl per query —
+# neither is a place for an environment lookup); the set_* hooks
+# override for tests/tools without touching the environment.
+from presto_tpu.envflag import EnvFlag
+
+#: ``PRESTO_TPU_PAD_SCAN=0`` disables scan-page ladder padding
+#: (uniform-capacity pass included) for A/B runs.
+_PAD_SCAN = EnvFlag("PRESTO_TPU_PAD_SCAN", default=True)
+#: ``PRESTO_TPU_AGG_TOWER=0`` reverts to the running-fold aggregation
+#: path for A/B runs.
+_AGG_TOWER = EnvFlag("PRESTO_TPU_AGG_TOWER", default=True)
+
+
+def pad_scan_enabled() -> bool:
+    return _PAD_SCAN()
+
+
+def set_pad_scan(value: Optional[bool]) -> None:
+    _PAD_SCAN.set(value)
+
+
+def agg_tower_enabled() -> bool:
+    return _AGG_TOWER()
+
+
+def set_agg_tower(value: Optional[bool]) -> None:
+    _AGG_TOWER.set(value)
+
+
 def pad_page_pow2(page: Page) -> Page:
     """Pad a page with dead rows up to its bucketed capacity
     (bucket_capacity).  Scan splits otherwise carry data-dependent
@@ -140,9 +170,7 @@ def pad_page_pow2(page: Page) -> Page:
     distinct capacity costs a full XLA compile of the whole chain
     program — the dominant cold-start cost (19 of q3's 32 warmup
     compiles were one agg program re-traced per shape)."""
-    import os as _os
-
-    if _os.environ.get("PRESTO_TPU_PAD_SCAN", "1") in ("0", "false"):
+    if not pad_scan_enabled():
         return page
     return pad_page_to(page, bucket_capacity(page.capacity))
 
@@ -1105,10 +1133,7 @@ class LocalRunner:
             # bucket: padding a sliver to full capacity would multiply
             # its compute, not add +6%.  PRESTO_TPU_PAD_SCAN=0 disables
             # all scan padding, uniform included.
-            import os as _os
-
-            uniform = _os.environ.get("PRESTO_TPU_PAD_SCAN", "1") \
-                not in ("0", "false")
+            uniform = pad_scan_enabled()
             cap_hi = 0
             for split in splits:
                 if node.limit is not None and produced >= node.limit:
@@ -1728,11 +1753,7 @@ class LocalRunner:
             self._agg_overrides[partial] = mg
             source = partial
 
-        import os as _os
-
-        tower_on = _os.environ.get("PRESTO_TPU_AGG_TOWER", "1") \
-            not in ("0", "false")
-        if tower_on and node.group_exprs \
+        if agg_tower_enabled() and node.group_exprs \
                 and not self._packed_direct(node, mg):
             # sort-path partials: live-extent compaction + tower merge.
             # Tower capacities are unclamped, so the merge itself never

@@ -157,7 +157,8 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
             # deviation: the reference widens short x short products
             # past p=18 automatically, here that needs an explicit cast
             long_ = ad.is_long_decimal or bd.is_long_decimal
-            p = 36 if long_ else 18
+            wide = (ad.precision or 0) > 36 or (bd.precision or 0) > 36
+            p = 38 if wide else (36 if long_ else 18)
             if fn == "mul":
                 return DecimalType(p, ad.scale + bd.scale)
             if fn == "div":
@@ -407,7 +408,10 @@ def infer_type(fn: str, args: Sequence[Expr]) -> Type:
             raise TypeError("map(keys_array, values_array) expected")
         return MapType(ts[0].element, ts[1].element,
                        min(ts[0].max_elems, ts[1].max_elems))
-    raise KeyError(f"unknown function {fn} for types {ts}")
+    # typed, message-bearing error: a KeyError here leaked raw through
+    # the SPI boundary (engine_lint spi-exception rule); the binder's
+    # statement boundary re-wraps this as a BindError
+    raise TypeError(f"unknown function {fn} for types {ts}")
 
 
 # -- convenience constructors ------------------------------------------------

@@ -177,15 +177,40 @@ def mul_int64(a: jax.Array, b: jax.Array) -> jax.Array:
 def mul_long_short(a: jax.Array, k: jax.Array) -> jax.Array:
     """Long limbs x int64 scaled value: (hi*B + lo)*k = (hi*k)*B + lo*k,
     with lo*k going through the full int64 multiplier. Exact whenever
-    the result fits p<=36 (hi*k then < 10^18)."""
+    the result fits p<=36 (hi*k then < 10^18); wide (p<=38) operands
+    route through the base-10^9 schoolbook below."""
     if width(a) != 2:
-        raise ValueError(
-            "decimal multiplication beyond 36 digits unsupported "
-            "(the reference's 38-digit cap overflows there too)")
+        return mul_wide_small(a, k)
     ah, al = split(a)
     low = mul_int64(al, k)
     lh, ll = split(low)
     return normalize(ah * k + lh, ll)
+
+
+def mul_wide_small(a: jax.Array, k: jax.Array) -> jax.Array:
+    """Wide ((n, 5) base-10^9) limbs x int64 scaled value (|k| < 10^18)
+    -> wide limbs.  k splits into base-10^9 halves so every partial
+    limb product stays < 10^18; the k-high half's contribution shifts
+    up one limb.  Exact whenever the product fits 38 digits (the
+    reference's DecimalType cap); past 38 the most-significant carry
+    drops — the same wrap deviation _shift_digits_wide documents
+    (in-jit code cannot raise)."""
+    # canonical negative wides carry the sign in the MSB limb; the
+    # limb shift below drops that limb, so compute on magnitudes and
+    # reapply the sign
+    neg_a = a[..., 0] < 0
+    a_abs = jnp.where(neg_a[..., None], _norm_wide(-a), a)
+    neg_k = k < 0
+    k_abs = jnp.where(neg_k, -k, k)
+    k1 = jnp.floor_divide(k_abs, _B9)
+    k0 = k_abs - k1 * _B9
+    lo = _norm_wide(a_abs * k0)   # limb < 10^9, k0 < 10^9: fits int64
+    hi = _norm_wide(a_abs * k1)
+    hi_shift = jnp.concatenate(   # * 10^9 == shift limbs up one slot
+        [hi[..., 1:], jnp.zeros_like(hi[..., :1])], axis=-1)
+    res = add(hi_shift, lo)
+    flip = neg_a ^ neg_k
+    return jnp.where(flip[..., None], _norm_wide(-res), res)
 
 
 def _shift_digits_wide(a: jax.Array, k: int) -> jax.Array:

@@ -93,7 +93,10 @@ def merge_keys_for_pages(pages: Sequence[Page], sort_exprs: Sequence[Expr],
             neutral = jnp.where(jnp.any(present), lane[jnp.argmax(present)], 0)
             lane = jnp.where(present, lane, neutral)
             lanes.append((lane, v))
-            plo, phi = int(jnp.min(lane)), int(jnp.max(lane))
+            # one stacked transfer, not two blocking scalar pulls per
+            # lane per page (engine_lint device-sync rule)
+            lo_hi = jax.device_get(jnp.stack([jnp.min(lane), jnp.max(lane)]))
+            plo, phi = int(lo_hi[0]), int(lo_hi[1])
             lo = plo if lo is None else min(lo, plo)
             hi = phi if hi is None else max(hi, phi)
         width = hi - lo + 1

@@ -278,13 +278,21 @@ class DistributedRunner:
         self.last_stage_count = 0
         self.last_fallback_reason = None
         try:
-            return self._run_distributed(plan)
+            # per-run outcome rides the RESULT (dist_stages attached by
+            # _run_distributed from its local stage count): concurrent
+            # queries on one runner must not report each other's stats
+            out = self._run_distributed(plan)
+            out.dist_fallback = None
+            return out
         except DistributedUnsupported as e:
             reason = str(e) or type(e).__name__
             self.last_fallback_reason = reason
             _log.warning("distributed execution fell back to coordinator: %s",
                          reason)
-            return self.local.run(plan)
+            out = self.local.run(plan)
+            out.dist_stages = 0
+            out.dist_fallback = reason
+            return out
 
     def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
         """Generalized stage-DAG execution (PlanFragmenter.java:84 +
@@ -329,6 +337,9 @@ class DistributedRunner:
             out = self.local.run(root)
             if root is not plan:  # the whole plan was one stage
                 out.names, out.types = plan.output_names, plan.output_types
+            # per-run stage count from the LOCAL n_stages, not the
+            # shared field a concurrent run may have reset
+            out.dist_stages = n_stages
             return out
         finally:
             from presto_tpu.parallel.fragment import set_child

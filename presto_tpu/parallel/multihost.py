@@ -21,6 +21,7 @@ re-scheduled on the survivors.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 
@@ -45,6 +46,8 @@ from presto_tpu.planner.plan import (
     WindowNode,
 )
 from presto_tpu.server.serde import deserialize_page, plan_to_json
+
+_log = logging.getLogger("presto_tpu.multihost")
 
 
 class TaskFailed(Exception):
@@ -206,13 +209,35 @@ class MultiHostRunner:
         # observability: last split placement per stage-launch
         # ({worker uri: [split ids]})
         self.last_assignments: Dict[str, List[int]] = {}
+        # local-execution fallback accounting (VERDICT weak #8: the
+        # silent MultiHostUnsupported catch hid that queries never
+        # left the coordinator) — mirrors DistributedRunner's loud
+        # fallback contract and feeds system_runtime_queries /
+        # query-JSON stats
+        self.fallback_count = 0
+        self.last_fallback_reason: Optional[str] = None
 
     def run(self, plan: PlanNode) -> MaterializedResult:
         self.last_gather_rows = 0  # rows pulled to the coordinator
+        self.last_stage_count = 0
+        self.last_fallback_reason = None
         try:
-            return self._run_distributed(plan)
-        except MultiHostUnsupported:
-            return self.local.run(plan)
+            # per-run outcome rides the RESULT (dist_stages attached by
+            # _run_distributed from its local stage count): concurrent
+            # queries on one runner must not swap each other's stats
+            out = self._run_distributed(plan)
+            out.dist_fallback = None
+            return out
+        except MultiHostUnsupported as e:
+            reason = str(e) or type(e).__name__
+            self.last_fallback_reason = reason
+            self.fallback_count += 1
+            _log.warning(
+                "multi-host execution fell back to local: %s", reason)
+            out = self.local.run(plan)
+            out.dist_stages = 0
+            out.dist_fallback = reason
+            return out
 
     # ------------------------------------------------------------------
     def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
@@ -252,6 +277,9 @@ class MultiHostRunner:
             out = self.local.run(root)
             if root is not plan:
                 out.names, out.types = plan.output_names, plan.output_types
+            # per-run stage count from the LOCAL n_stages, not the
+            # shared field a concurrent run may have reset
+            out.dist_stages = n_stages
             return out
         finally:
             for parent, slot, old in reversed(splices):

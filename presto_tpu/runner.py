@@ -124,8 +124,21 @@ class QueryRunner:
     def plan(self, sql: str):
         plan = self._plans.get(sql)
         if plan is None:
-            plan = self.binder.plan(sql)
+            plan = self._validated(self.binder.plan(sql))
             self._plans[sql] = plan
+        return plan
+
+    def _validated(self, plan):
+        """Run the static plan/IR validator when always-on checking is
+        enabled (``validate_plans`` session property or the process-wide
+        ``PRESTO_TPU_VALIDATE_PLANS`` switch the test harness sets);
+        cached plans validate once at bind time."""
+        from presto_tpu.analysis import validation_enabled
+
+        if validation_enabled() or self.session.get("validate_plans"):
+            from presto_tpu.analysis import assert_valid
+
+            assert_valid(plan)
         return plan
 
     def execute(self, sql: str, query_id=None) -> MaterializedResult:
@@ -156,10 +169,10 @@ class QueryRunner:
                     error=f"{type(e).__name__}: {e}", trace_token=trace,
                 ))
                 raise
-            dist_stages = dist_fallback = None
-            if self.session.get("distributed") and getattr(self, "_dist", None):
-                dist_stages = self._dist.last_stage_count
-                dist_fallback = self._dist.last_fallback_reason
+            # per-run outcome off the result object (not the shared
+            # runner fields — concurrent queries would swap stats)
+            dist_stages = getattr(res, "dist_stages", None)
+            dist_fallback = getattr(res, "dist_fallback", None)
             self.events.query_completed(QueryCompletedEvent(
                 qid, sql, self.session.user, "FINISHED", t0, time.time(),
                 rows=len(res.rows), trace_token=trace,
@@ -170,9 +183,15 @@ class QueryRunner:
         if isinstance(stmt, ast.Explain):
             plan = self.binder.plan_ast(stmt.query)
             if getattr(stmt, "validate", False):
-                # reaching here means parse + bind both succeeded
+                # parse + bind succeeded; now the static tier: the
+                # plan/IR validator (analysis/) checks type soundness,
+                # null-mask policy, ladder conformance and signature
+                # determinism — PlanValidationError propagates with
+                # node-specific diagnostics (EXPLAIN (TYPE VALIDATE))
+                from presto_tpu.analysis import assert_valid
                 from presto_tpu.types import BOOLEAN
 
+                assert_valid(plan)
                 return MaterializedResult(["Valid"], [BOOLEAN], [(True,)])
             if getattr(stmt, "distributed", False):
                 from presto_tpu.parallel.fragment import explain_distributed
@@ -299,7 +318,7 @@ class QueryRunner:
             bound = _substitute_params(q, list(stmt.params))
             # parameters make each execution a distinct plan; don't
             # pollute the text-keyed plan cache
-            plan = self.binder.plan_ast(bound)
+            plan = self._validated(self.binder.plan_ast(bound))
             self._check_access(plan)
             return self.executor.run(plan, query_id=query_id)
 
@@ -626,7 +645,7 @@ class QueryRunner:
         the row count is returned)."""
         import numpy as np
 
-        plan = self.binder.plan_ast(stmt.query)
+        plan = self._validated(self.binder.plan_ast(stmt.query))
         self._check_access(plan)
         if isinstance(stmt, ast.InsertInto):
             self.access_control.check_can_insert(
@@ -859,7 +878,14 @@ class QueryRunner:
     def _plan_cached(self, sql: str, q: ast.Query):
         plan = self._plans.get(sql)
         if plan is None:
-            plan = self.binder.plan_ast(q)
+            from presto_tpu.sql.binder import BindError, annotate_position
+
+            try:
+                plan = self._validated(self.binder.plan_ast(q))
+            except BindError as e:
+                # statement text is known here: render the failing AST
+                # node's offset as line:col in the user-facing error
+                raise annotate_position(e, sql) from e.__cause__
             self._plans[sql] = plan
         return plan
 

@@ -82,6 +82,12 @@ class _QueryState:
         # the computation thread: outlives `done` on cancel (DELETE
         # sets done to unblock the client; the thread runs to the end)
         self.thread: Optional[threading.Thread] = None
+        # distributed-tier outcome: stage count and (when the query
+        # silently ran locally) the fallback reason — surfaced in the
+        # statement-protocol stats so clients see fallbacks without
+        # querying system_runtime_queries
+        self.dist_stages: Optional[int] = None
+        self.dist_fallback: Optional[str] = None
 
     def summary(self) -> dict:
         return {
@@ -305,6 +311,11 @@ class CoordinatorServer:
                 cols = [
                     {"name": n, "type": repr(t)} for n, t in zip(res.names, res.types)
                 ]
+                # per-run outcome rides the result object — reading the
+                # shared runner._dist here would let concurrent queries
+                # report each other's stats
+                q.dist_stages = getattr(res, "dist_stages", None)
+                q.dist_fallback = getattr(res, "dist_fallback", None)
                 # CANCELED is terminal: a DELETE that raced this query's
                 # completion must not be resurrected to FINISHED/FAILED
                 with self._lock:
@@ -349,6 +360,10 @@ class CoordinatorServer:
             "columns": q.columns,
             "stats": {"state": q.state, "rows": len(q.rows)},
         }
+        if q.dist_stages is not None:
+            out["stats"]["distStages"] = q.dist_stages
+        if q.dist_fallback is not None:
+            out["stats"]["distFallback"] = q.dist_fallback
         if q.error:
             out["error"] = q.error
             return out

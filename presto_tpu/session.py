@@ -75,6 +75,13 @@ SYSTEM_PROPERTIES = [
         "AUTOMATIC", lambda s: s.strip().upper(),
     ),
     PropertyMetadata(
+        "validate_plans",
+        "run the static plan/IR validator on every bound plan "
+        "(EXPLAIN (TYPE VALIDATE) always does; query.validate-plans "
+        "config key sets the default)",
+        False, _bool,
+    ),
+    PropertyMetadata(
         "distributed_min_stage_rows",
         "stages over intermediates smaller than this run on the "
         "coordinator (0 = every stage on the mesh)",

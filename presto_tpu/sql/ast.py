@@ -22,6 +22,10 @@ class Node:
 @dataclasses.dataclass(frozen=True)
 class Identifier(Node):
     parts: Tuple[str, ...]  # possibly qualified: ("l", "shipdate") or ("revenue",)
+    # character offset in the statement text (NodeLocation analog);
+    # excluded from eq/hash so GROUP BY / select-item matching still
+    # compares structurally
+    pos: Optional[int] = dataclasses.field(default=None, compare=False)
 
     @property
     def name(self) -> str:
@@ -248,6 +252,8 @@ class FuncCall(Node):
     distinct: bool = False
     star: bool = False  # count(*)
     ignore_nulls: bool = False  # lead/lag/first/last/nth IGNORE NULLS
+    # character offset in the statement text (NodeLocation analog)
+    pos: Optional[int] = dataclasses.field(default=None, compare=False)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -152,7 +152,30 @@ SCALAR_FUNCTIONS = {
 
 
 class BindError(Exception):
-    pass
+    """User-facing semantic error (SemanticException analog).  ``pos``
+    is the character offset into the statement text when the failing
+    AST node carried one (parser NodeLocation analog); the statement
+    boundary (:meth:`Binder.plan`) renders it as ``line:col``."""
+
+    def __init__(self, message, pos: Optional[int] = None):
+        super().__init__(message)
+        self.pos = pos
+
+
+def annotate_position(e: BindError, sql: str) -> BindError:
+    """Render a BindError's statement offset as ``line:col`` against
+    the statement text (the reference's SemanticException carries a
+    NodeLocation the same way).  No-op when no position is known or the
+    error was already annotated (structured flag, not a message-text
+    sniff — user identifiers may legitimately contain ' at line ')."""
+    pos = getattr(e, "pos", None)
+    if pos is None or getattr(e, "_annotated", False):
+        return e
+    line = sql.count("\n", 0, pos) + 1
+    col = pos - (sql.rfind("\n", 0, pos) + 1) + 1
+    out = BindError(f"{e} at line {line}:{col}", pos=pos)
+    out._annotated = True
+    return out
 
 
 @dataclasses.dataclass
@@ -675,19 +698,38 @@ class Binder:
 
     def plan(self, sql: str) -> OutputNode:
         self._stats.reset()  # don't pin prior queries' plan trees
-        return self.plan_ast(parse_query(sql))
+        try:
+            return self.plan_ast(parse_query(sql))
+        except BindError as e:
+            annotated = annotate_position(e, sql)
+            if annotated is not e:
+                # keep the internal traceback plan_ast's SPI wrap
+                # promised (__cause__), don't suppress it
+                raise annotated from e.__cause__
+            raise
 
     def plan_ast(self, q: ast.Node) -> OutputNode:
         self._now = None  # fresh instant for this statement
-        node, names = self._plan_query_like(q)
-        out = OutputNode(node, names)
-        # iterative rule engine over the bound plan
-        # (sql/planner/iterative/IterativeOptimizer.java)
-        from presto_tpu.planner.iterative import IterativeOptimizer
+        try:
+            node, names = self._plan_query_like(q)
+            out = OutputNode(node, names)
+            # iterative rule engine over the bound plan
+            # (sql/planner/iterative/IterativeOptimizer.java)
+            from presto_tpu.planner.iterative import IterativeOptimizer
 
-        out = IterativeOptimizer().optimize(out)
-        self._enable_index_joins(out)
-        return out
+            out = IterativeOptimizer().optimize(out)
+            self._enable_index_joins(out)
+            return out
+        except (BindError, SyntaxError):
+            raise
+        except (KeyError, IndexError, AssertionError, TypeError) as e:
+            # SPI boundary: internal exceptions must not leak raw to the
+            # user (the r5 ``KeyError: frozenset()`` class).  The
+            # message carries through verbatim; the original traceback
+            # rides __cause__ for debugging.
+            msg = (e.args[0] if e.args and isinstance(e.args[0], str)
+                   else (str(e) or type(e).__name__))
+            raise BindError(msg) from e
 
     def _enable_index_joins(self, root: PlanNode) -> None:
         """Flag (or side-swap) joins where one side is a bare scan of an
@@ -1242,7 +1284,9 @@ class Binder:
             for i, t in enumerate(terms):
                 if t.offset <= ref < t.offset + len(t.scope):
                     return i
-            raise AssertionError(ref)
+            raise BindError(
+                f"internal: channel reference ${ref} falls outside every "
+                "join term's scope (binder channel-offset bug)")
 
         # route single-term conjuncts as pushed-down filters
         edges: List[Tuple[int, int, Expr]] = []  # (term_i, term_j, eq ir)
@@ -2764,6 +2808,17 @@ class Binder:
         return self._bind_impl(e, scope, agg_ctx)
 
     def _bind_impl(self, e: ast.Node, scope: Scope, agg: Optional[AggCtx]) -> Expr:
+        try:
+            return self._bind_node(e, scope, agg)
+        except BindError as err:
+            # attach the nearest enclosing node's statement offset; the
+            # innermost failing node wins (recursion attaches first)
+            if getattr(err, "pos", None) is None \
+                    and getattr(e, "pos", None) is not None:
+                err.pos = e.pos
+            raise
+
+    def _bind_node(self, e: ast.Node, scope: Scope, agg: Optional[AggCtx]) -> Expr:
         if agg is not None:
             # group-expr match (AST or bound-IR equality)
             for i, g in enumerate(agg.group_asts):
@@ -3322,7 +3377,8 @@ class Binder:
                             "multi-column concat needs raw varchar operands"
                             " (dictionary columns support one column + literals)")
                 return call(e.name, *args)
-            raise BindError(f"unknown function {e.name}")
+            raise BindError(f"unknown function {e.name}",
+                            pos=getattr(e, "pos", None))
 
         if isinstance(e, ast.ArrayCtor):
             items = [self._bind_impl(x, scope, agg) for x in e.items]
@@ -4174,4 +4230,6 @@ def term_of_ref(terms: List[Term], ref: int) -> int:
     for i, t in enumerate(terms):
         if t.offset <= ref < t.offset + len(t.scope):
             return i
-    raise AssertionError(ref)
+    raise BindError(
+        f"internal: channel reference ${ref} falls outside every join "
+        "term's scope (binder channel-offset bug)")

@@ -844,11 +844,12 @@ class Parser:
 
         if t.kind == "ident" or (t.kind == "keyword" and t.value in ("year", "month", "day")):
             name = t.value
+            pos = t.pos  # statement offset for binder diagnostics
             self.i += 1
             if self.accept("("):  # function call
                 if self.accept("*"):
                     self.expect(")")
-                    fc = ast.FuncCall(name.lower(), (), star=True)
+                    fc = ast.FuncCall(name.lower(), (), star=True, pos=pos)
                 else:
                     distinct = bool(self.accept("distinct"))
                     args: List[ast.Node] = []
@@ -857,7 +858,8 @@ class Parser:
                         while self.accept(","):
                             args.append(self._expr())
                     self.expect(")")
-                    fc = ast.FuncCall(name.lower(), tuple(args), distinct=distinct)
+                    fc = ast.FuncCall(name.lower(), tuple(args),
+                                      distinct=distinct, pos=pos)
                 # null treatment clause (window value functions):
                 # fn(...) [IGNORE NULLS | RESPECT NULLS] OVER (...) —
                 # two-token lookahead so a bare alias named ignore/
@@ -903,7 +905,7 @@ class Parser:
             while self.peek(".") :
                 self.i += 1
                 parts.append(self.ident())
-            return ast.Identifier(tuple(parts))
+            return ast.Identifier(tuple(parts), pos=pos)
 
         raise SyntaxError(f"unexpected token {t!r}")
 

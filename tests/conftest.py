@@ -16,6 +16,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Static plan/IR validation always-on for the whole suite: every query
+# any test plans through a QueryRunner also runs the analysis tier
+# (presto_tpu/analysis/), so a type/null-mask/ladder invariant break
+# fails the suite with a node-specific diagnostic instead of a kernel
+# crash.  setdefault: an explicit =0 in the environment still wins.
+os.environ.setdefault("PRESTO_TPU_VALIDATE_PLANS", "1")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

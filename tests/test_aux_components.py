@@ -52,6 +52,39 @@ def test_system_runtime_queries():
     assert ("FINISHED", 1) in [(r[0], r[1]) for r in res.rows]
     nodes = runner.execute("select node_id, state from system_runtime_nodes")
     assert nodes.rows == [("local", "ACTIVE")]
+    # distributed-tier fallback accounting (VERDICT weak #8): local
+    # runs report NULL stages/fallback; the count-of-fallbacks query
+    # the issue asks for executes
+    fb = runner.execute(
+        "select count(*) from system_runtime_queries"
+        " where dist_fallback is not null")
+    assert fb.rows[0][0] == 0
+    cols = runner.execute(
+        "select dist_stages, dist_fallback from system_runtime_queries")
+    assert all(r == (None, None) for r in cols.rows)
+
+
+def test_system_runtime_queries_records_fallback_reason():
+    from presto_tpu.events import QueryCompletedEvent
+
+    history = QueryHistory()
+    history.query_completed(QueryCompletedEvent(
+        "q1", "select 1", "presto", "FINISHED", 0.0, 0.1,
+        rows=1, dist_stages=0, dist_fallback="plan has no scan leaf"))
+    history.query_completed(QueryCompletedEvent(
+        "q2", "select 2", "presto", "FINISHED", 0.0, 0.1,
+        rows=1, dist_stages=3))
+    catalog = Catalog()
+    catalog.register("system", SystemConnector(history))
+    runner = QueryRunner(catalog)
+    res = runner.execute(
+        "select query_id, dist_stages, dist_fallback"
+        " from system_runtime_queries order by query_id")
+    assert res.rows == [("q1", 0, "plan has no scan leaf"), ("q2", 3, None)]
+    count = runner.execute(
+        "select count(*) from system_runtime_queries"
+        " where dist_fallback is not null")
+    assert count.rows[0][0] == 1
 
 
 def test_resource_group_concurrency():

@@ -1,0 +1,190 @@
+"""engine_lint: per-rule fixture snippets + the repo-wide lint-clean
+pin (tier-1).  The pin is the CI contract ISSUE 2 establishes: a PR
+reintroducing a recompile/crash hazard (raw capacity, hot-path env
+read, traced branch, device sync, SPI exception leak) fails here with
+the exact file:line."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import engine_lint  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _lint_snippet(tmp_path, code, name="snippet.py", subdir=""):
+    d = tmp_path / "presto_tpu" / subdir if subdir else tmp_path
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(code))
+    return engine_lint.lint_file(str(p))
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures
+# ---------------------------------------------------------------------------
+
+def test_env_read_in_function_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import os
+
+        def per_page_hot_path(page):
+            return os.environ.get("PRESTO_TPU_X", "1")
+    """)
+    assert [f.rule for f in findings] == ["env-read"]
+
+
+def test_env_read_resolve_once_allowed(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import os
+
+        _X = os.environ.get("AT_IMPORT", "1")  # module scope: fine
+
+        def resolve_x():
+            return os.environ.get("PRESTO_TPU_X")
+
+        def x_enabled():
+            return os.environ.get("PRESTO_TPU_X", "1") != "0"
+
+        class C:
+            def __init__(self):
+                self.x = os.environ.get("PRESTO_TPU_X")
+    """)
+    assert findings == []
+
+
+def test_env_read_suppression_comment(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import os
+
+        def hot(page):
+            return os.environ.get("X")  # lint: allow(env-read)
+    """)
+    assert findings == []
+
+
+def test_traced_branch_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(mask):
+            if jnp.any(mask):
+                return 1
+            while jnp.sum(mask) > 0:
+                pass
+    """)
+    assert [f.rule for f in findings] == ["traced-branch", "traced-branch"]
+
+
+def test_dtype_predicates_not_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(data):
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                return 1
+    """)
+    assert findings == []
+
+
+def test_device_sync_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(lane):
+            lo = int(jnp.min(lane))
+            hi = float(jnp.max(lane))
+            v = lane.sum().item()
+            return lo, hi, v
+    """)
+    assert [f.rule for f in findings] == ["device-sync"] * 3
+
+
+def test_device_sync_metadata_exempt(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def f():
+            return float(jnp.iinfo(jnp.int64).min)
+    """)
+    assert findings == []
+
+
+def test_block_until_ready_in_ops_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax
+
+        def kernel(page):
+            jax.block_until_ready(page)
+    """, subdir="ops")
+    assert [f.rule for f in findings] == ["block-until-ready"]
+
+
+def test_bare_except_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+    """)
+    assert [f.rule for f in findings] == ["bare-except"]
+
+
+def test_spi_exception_leak_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def bind(name, scope):
+            if name not in scope:
+                raise KeyError(name)
+            raise AssertionError("unreachable")
+    """, subdir="sql")
+    assert [f.rule for f in findings] == ["spi-exception", "spi-exception"]
+
+
+def test_spi_rule_scoped_to_frontend(tmp_path):
+    # the same raise outside sql// expr/ir.py is internal dispatch
+    findings = _lint_snippet(tmp_path, """
+        def dispatch(kind):
+            raise KeyError(kind)
+    """, subdir="ops")
+    assert findings == []
+
+
+def test_raw_capacity_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def store(page, rows, Page):
+            return Page.from_arrays(rows, [], capacity=len(rows))
+    """)
+    assert [f.rule for f in findings] == ["raw-capacity"]
+
+
+def test_ladder_routed_capacity_allowed(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def store(page, rows, Page, bucket_capacity):
+            return Page.from_arrays(
+                rows, [], capacity=bucket_capacity(len(rows)))
+    """)
+    assert findings == []
+
+
+def test_rule_filter_and_check_exit():
+    rc = engine_lint.main(["--rule", "bare-except", "--check",
+                           os.path.join(REPO, "presto_tpu")])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# the repo-wide pin
+# ---------------------------------------------------------------------------
+
+def test_repo_lint_clean():
+    """``tools/engine_lint.py --check presto_tpu`` exits 0 on HEAD —
+    the ISSUE 2 acceptance pin.  A finding here names its file:line;
+    fix it or (with a reviewed reason) append ``# lint: allow(rule)``."""
+    findings = engine_lint.lint_paths([os.path.join(REPO, "presto_tpu")])
+    assert findings == [], "\n".join(str(f) for f in findings)

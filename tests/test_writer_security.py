@@ -47,7 +47,11 @@ def test_insert_schema_mismatch(runner):
 def test_drop_table(runner):
     runner.execute("create table t3 as select 1 as x")
     runner.execute("drop table t3")
-    with pytest.raises(KeyError):
+    # typed SPI error, not a raw KeyError (the binder's statement
+    # boundary wraps internal exceptions — engine_lint spi-exception)
+    from presto_tpu.sql.binder import BindError
+
+    with pytest.raises(BindError, match="not found"):
         runner.execute("select * from t3")
 
 

@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""TPU/JAX anti-pattern linter for the engine codebase.
+
+Static AST pass over ``presto_tpu/`` that flags the recompile- and
+crash-hazard patterns the execution tier cannot tolerate.  The
+validator (presto_tpu/analysis/) checks *plans* at query time; this
+tool checks the *source* at CI time — the two halves of the static
+tier ``EXPLAIN (TYPE VALIDATE)`` anchors.
+
+Rules
+-----
+raw-capacity        An ``int(...)``/``len(...)``-derived value used as
+                    a page/array capacity argument without routing
+                    through the shape ladder (``bucket_capacity`` /
+                    pow2 helpers).  Every off-ladder capacity is a
+                    distinct XLA program — the cold-start storm the
+                    program registry exists to prevent.
+env-read            ``os.environ`` / ``os.getenv`` read inside a
+                    function body.  Env reads belong at import time or
+                    in a resolve-once helper with an override hook
+                    (ops/join.resolve_direct_join is the model); a
+                    read in a per-page/per-build path re-pays a dict
+                    lookup per page and makes program choice
+                    env-timing-dependent.
+traced-branch       Python ``if``/``while`` branching directly on a
+                    ``jnp`` expression.  Under jit this is a tracer
+                    error; outside jit it is an implicit device sync
+                    per evaluation.  (dtype predicates like
+                    ``jnp.issubdtype`` are static and exempt.)
+device-sync         ``int(jnp...)``/``float(jnp...)``/``bool(jnp...)``
+                    or ``.item()`` — each is a blocking host transfer;
+                    batch values into one array and transfer once
+                    (exec/local._extent_live is the model).
+                    ``jnp.iinfo``/``jnp.finfo`` are metadata, exempt.
+block-until-ready   ``block_until_ready`` in operator/connector code.
+                    Synchronization belongs to the executor's timing
+                    boundaries (EXPLAIN ANALYZE), not inside kernels.
+bare-except         ``except:`` — swallows KeyboardInterrupt and masks
+                    engine bugs.
+spi-exception       ``raise KeyError/IndexError/AssertionError`` in
+                    the SQL frontend (``sql/``, ``expr/ir.py``).  User
+                    statements must fail with typed errors (BindError
+                    / SyntaxError / TypeError with a message) — the
+                    r5 raw ``KeyError: frozenset()`` leak class.
+
+Suppression: append ``# lint: allow(<rule>)`` to the offending line
+(comma-separate multiple rules).  Allow-listed helper shapes (resolve-
+once functions, ``__init__`` constructors, module scope) are exempt
+from ``env-read`` automatically.
+
+Usage::
+
+    python tools/engine_lint.py --check presto_tpu   # exit 1 on findings
+    python tools/engine_lint.py presto_tpu/exec/local.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, Iterator, List, NamedTuple, Optional, Set, Tuple
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
+
+#: env-read is legal in functions that resolve once / construct / set
+#: up — by naming convention (the resolve-once pattern of
+#: ops/join.resolve_direct_join) or constructor role.
+_ENV_OK_FN = re.compile(
+    r"^(resolve_|maybe_|enable_|default_|detected_|_resolve|main$|"
+    r"__init__$|host_cache_dir$|from_etc$)|_enabled$")
+
+#: jnp attributes that are static metadata, not traced values
+_STATIC_JNP = {"issubdtype", "iinfo", "finfo", "dtype", "bool_", "int32",
+               "int64", "float32", "float64", "uint32", "uint8", "ndim",
+               "floating", "integer", "signedinteger", "inexact", "shape"}
+
+#: callables whose argument is a page/array CAPACITY (positional index
+#: or keyword); int()/len() flowing in raw is a ladder bypass
+_CAPACITY_SINKS: Dict[str, Tuple[Optional[int], Optional[str]]] = {
+    # fn name -> (positional index of capacity arg, keyword name)
+    "pad_page_to": (1, None),
+    "from_arrays": (None, "capacity"),
+    "page_for_split": (None, "capacity"),
+    "empty": (1, "capacity"),  # Page.empty(types, capacity)
+}
+
+#: names that mark a value as already ladder-routed
+_LADDER_MARKERS = {"bucket_capacity", "_cap", "cap", "cap_hi", "capacity",
+                   "mg", "max_groups", "MIN_CAP", "out_cap", "tgt",
+                   "bucket", "split_capacity"}
+
+#: raise types the SQL frontend must not leak to users
+_SPI_RAW_RAISES = {"KeyError", "IndexError", "AssertionError"}
+
+
+def _suppressed(source_lines: List[str], lineno: int, rule: str) -> bool:
+    if 1 <= lineno <= len(source_lines):
+        m = _ALLOW_RE.search(source_lines[lineno - 1])
+        if m:
+            allowed = {r.strip() for r in m.group(1).split(",")}
+            return rule in allowed or "all" in allowed
+    return False
+
+
+def _is_jnp_value(node: ast.AST) -> bool:
+    """expression rooted at jnp.<traced fn>(...) (not static metadata)."""
+    if isinstance(node, ast.Call):
+        return _is_jnp_value(node.func)
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in ("jnp", "jax"):
+            return node.attr not in _STATIC_JNP
+        return _is_jnp_value(base)
+    if isinstance(node, ast.BinOp):
+        return _is_jnp_value(node.left) or _is_jnp_value(node.right)
+    if isinstance(node, ast.Compare):
+        return _is_jnp_value(node.left) or any(
+            _is_jnp_value(c) for c in node.comparators)
+    if isinstance(node, ast.BoolOp):
+        return any(_is_jnp_value(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp):
+        return _is_jnp_value(node.operand)
+    return False
+
+
+def _contains_call_to(node: ast.AST, names: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            n = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if n in names:
+                return True
+        elif isinstance(sub, ast.Name) and sub.id in names:
+            return True
+    return False
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module, source: str,
+                 rules: Set[str]):
+        self.path = path
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.rules = rules
+        self.findings: List[Finding] = []
+        # stack of enclosing function names
+        self._fn_stack: List[str] = []
+        self._in_sql_frontend = (
+            f"{os.sep}sql{os.sep}" in path
+            or path.endswith(os.path.join("expr", "ir.py")))
+        self._is_operator_code = any(
+            f"{os.sep}{d}{os.sep}" in path
+            for d in ("ops", "connectors", "storage"))
+
+    # -- helpers -----------------------------------------------------------
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        if rule not in self.rules:
+            return
+        if _suppressed(self.lines, node.lineno, rule):
+            return
+        self.findings.append(Finding(self.path, node.lineno, rule, message))
+
+    # -- visitors ----------------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+        txt_fn = ast.unparse(node.func) if node.func is not None else ""
+
+        # env-read ---------------------------------------------------------
+        if self._fn_stack and (
+                txt_fn.endswith("environ.get") or txt_fn.endswith("getenv")):
+            fn = self._fn_stack[-1]
+            if not _ENV_OK_FN.search(fn):
+                self._emit(
+                    node, "env-read",
+                    f"os.environ read inside {fn}() — resolve once at "
+                    "import/construction (with an override hook) instead "
+                    "of per call")
+
+        # device-sync: int(jnp...)/float(jnp...)/bool(jnp...) ---------------
+        if name in ("int", "float", "bool") and len(node.args) == 1 \
+                and _is_jnp_value(node.args[0]):
+            self._emit(
+                node, "device-sync",
+                f"{name}(jnp...) forces a blocking host transfer — stack "
+                "values and transfer once (see exec/local._extent_live)")
+
+        # device-sync: .item() ----------------------------------------------
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args:
+            self._emit(node, "device-sync",
+                       ".item() forces a blocking host transfer")
+
+        # block-until-ready --------------------------------------------------
+        if name == "block_until_ready" and self._is_operator_code:
+            self._emit(
+                node, "block-until-ready",
+                "block_until_ready in operator code — synchronization "
+                "belongs to the executor's timing boundaries")
+
+        # raw-capacity -------------------------------------------------------
+        sink = _CAPACITY_SINKS.get(name or "")
+        if sink is not None:
+            pos, kw = sink
+            cand: List[ast.AST] = []
+            if pos is not None and len(node.args) > pos:
+                cand.append(node.args[pos])
+            for k in node.keywords:
+                if kw is not None and k.arg == kw:
+                    cand.append(k.value)
+            for v in cand:
+                if _contains_call_to(v, {"int", "len"}) \
+                        and not _contains_call_to(v, _LADDER_MARKERS):
+                    self._emit(
+                        node, "raw-capacity",
+                        f"data-dependent capacity {ast.unparse(v)!r} "
+                        f"feeds {name}() without the shape ladder — "
+                        "wrap in bucket_capacity() so program "
+                        "signatures stay finite")
+
+        self.generic_visit(node)
+
+    def _check_branch(self, node) -> None:
+        if _is_jnp_value(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            self._emit(
+                node, "traced-branch",
+                f"python `{kind}` branches on a jnp expression — a "
+                "tracer error under jit, an implicit device sync "
+                "outside it (use jnp.where / lax.cond)")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._emit(node, "bare-except",
+                       "bare `except:` swallows KeyboardInterrupt and "
+                       "masks engine bugs — name the exception types")
+        self.generic_visit(node)
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if self._in_sql_frontend and node.exc is not None:
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call):
+                name = _call_name(exc)
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in _SPI_RAW_RAISES:
+                self._emit(
+                    node, "spi-exception",
+                    f"raise {name} in the SQL frontend leaks an internal "
+                    "exception across the SPI boundary — raise BindError "
+                    "(with the source position) instead")
+        self.generic_visit(node)
+
+
+ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
+             "block-until-ready", "bare-except", "spi-exception"}
+
+
+def lint_file(path: str, rules: Set[str] = ALL_RULES) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "parse", str(e))]
+    linter = _Linter(path, tree, source, rules)
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_targets(root: str) -> Iterator[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths, rules: Set[str] = ALL_RULES) -> List[Finding]:
+    findings: List[Finding] = []
+    for root in paths:
+        for path in iter_targets(root):
+            findings.extend(lint_file(path, rules))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="files or directories")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any finding remains (CI mode)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to specific rule(s)")
+    args = ap.parse_args(argv)
+    rules = set(args.rule) if args.rule else ALL_RULES
+    unknown = rules - ALL_RULES
+    if unknown:
+        ap.error(f"unknown rule(s): {sorted(unknown)} "
+                 f"(known: {sorted(ALL_RULES)})")
+    findings = lint_paths(args.paths, rules)
+    for f in findings:
+        print(f)
+    print(f"{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if (args.check and findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
