@@ -226,18 +226,27 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     rates = {}
     device = {}
     errors = {}
+    raw_times = {}
     for name, sql in bench_queries.items():
         try:
-            t0 = time.time()
+            # perf_counter, not time.time(): the engine_lint wallclock
+            # rule's contract — an NTP step must not be able to fake a
+            # rate change in the variance evidence
+            t0 = time.perf_counter()
             res = runner.execute(sql)  # warmup: compile + execute
-            log(f"{name}: warmup {time.time()-t0:.2f}s, {len(res)} rows")
+            log(f"{name}: warmup {time.perf_counter()-t0:.2f}s, "
+                f"{len(res)} rows")
             times = []
             for _ in range(iters):
-                t0 = time.time()
+                t0 = time.perf_counter()
                 runner.execute(sql)
-                times.append(time.time() - t0)
+                times.append(time.perf_counter() - t0)
             best = min(times)
             rates[name] = lineitem_rows / best
+            # variance protocol (VERDICT weak #3): every raw repeat
+            # time ships with the result, so a rate regression is
+            # distinguishable from host variance after the fact
+            raw_times[name] = [round(t, 4) for t in times]
             log(f"{name}: best {best:.3f}s -> {rates[name]:.3e} lineitem rows/s")
             _write_through(sf, platform, rates, device)
             # device-side attribution: same plan without the host
@@ -253,10 +262,10 @@ def _measure(sf: float, iters: int, only: str) -> dict:
                 plan = runner.plan(sql)
                 dts = []
                 for _ in range(min(iters, 2)):
-                    t0 = time.time()
+                    t0 = time.perf_counter()
                     page = runner.executor.run_to_page(plan)
                     jax.block_until_ready(page)
-                    dts.append(time.time() - t0)
+                    dts.append(time.perf_counter() - t0)
                 dt = min(dts)
                 device[name] = {
                     "seconds": round(dt, 4),
@@ -276,6 +285,8 @@ def _measure(sf: float, iters: int, only: str) -> dict:
                 break
 
     out = {"platform": platform, "sf": sf, "rates": rates}
+    if raw_times:
+        out["raw_times"] = raw_times
     if device:
         out["device"] = device
     if errors:
@@ -322,14 +333,14 @@ def _measure_tpcds(sf: float, iters: int, split_rows: int, *, runner_cls,
     rates = {}
     for qn in (3, 7):
         name = f"ds_q{qn}"
-        t0 = time.time()
+        t0 = time.perf_counter()
         runner.execute(DS[qn])
-        log(f"{name}: warmup {time.time()-t0:.2f}s")
+        log(f"{name}: warmup {time.perf_counter()-t0:.2f}s")
         times = []
         for _ in range(iters):
-            t0 = time.time()
+            t0 = time.perf_counter()
             runner.execute(DS[qn])
-            times.append(time.time() - t0)
+            times.append(time.perf_counter() - t0)
         rates[name] = round(ss_rows / min(times), 1)
         log(f"{name}: best {min(times):.3f}s -> "
             f"{rates[name]:.3e} store_sales rows/s")
@@ -505,7 +516,7 @@ def _measure_tpu(sf, deadline, cpu_reserve) -> dict | None:
         return None
     run_id = "%d.%d" % (os.getpid(), time.time())
     result = {"platform": None, "sf": sf, "rates": {},
-              "device": {}, "errors": {}}
+              "device": {}, "errors": {}, "raw_times": {}}
     try:
         res = _run_child({"BENCH_RUN_ID": run_id}, budget)
     except subprocess.TimeoutExpired:
@@ -524,7 +535,7 @@ def _measure_tpu(sf, deadline, cpu_reserve) -> dict | None:
     for k in ("platform", "tpcds_rates"):
         if res.get(k) is not None:
             result[k] = res[k]
-    for k in ("rates", "device", "errors"):
+    for k in ("rates", "device", "errors", "raw_times"):
         result[k].update(res.get(k, {}))
     return result
 
@@ -622,6 +633,10 @@ def main():
         out["rates"] = {k: round(v, 1) for k, v in result["rates"].items()}
         if result.get("tpcds_rates"):
             out["tpcds_rates"] = result["tpcds_rates"]
+        if result.get("raw_times"):
+            # per-repeat raw seconds per query: the variance evidence
+            # behind each best-of-N rate (VERDICT weak #3)
+            out["raw_times"] = result["raw_times"]
         if result.get("device"):
             out["device"] = result["device"]
             if out["platform"] != "cpu":

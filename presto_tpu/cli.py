@@ -97,7 +97,7 @@ def main(argv=None) -> int:
             return res.names, res.rows
 
     def run_one(sql: str) -> int:
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             names, rows = run(sql)
         except Exception as e:
@@ -105,7 +105,7 @@ def main(argv=None) -> int:
             return 1
         print(format_output(names, rows, args.output_format))
         if args.output_format == "ALIGNED":
-            print(f"({len(rows)} rows, {time.time() - t0:.2f}s)")
+            print(f"({len(rows)} rows, {time.perf_counter() - t0:.2f}s)")
         return 0
 
     if args.execute:

@@ -13,6 +13,10 @@ Recognized keys (the engine's subset of the reference's config space):
   query.max-memory-per-node   bytes for the local MemoryPool
   query.validate-plans        run the static plan/IR validator on every
                               bound plan (docs/static-analysis.md)
+  query.trace-dir             write one Chrome-trace JSON per query
+                              (docs/observability.md; enables tracing)
+  query.log-path              JSONL query log (one line per completed
+                              query via the EventListener sink)
   task.buffer-bytes           worker output-buffer cap
   session.<property>          default for any system session property
 
@@ -74,6 +78,12 @@ class EngineConfig:
             for k, v in self.props.items()
             if k.startswith("session.")
         }
+
+    def query_log_path(self) -> Optional[str]:
+        """Path for the JSONL query log (``query.log-path``); None
+        disables the sink."""
+        v = self.props.get("query.log-path")
+        return v if v and v.strip() not in ("0", "false") else None
 
     def program_cache_dir(self) -> Optional[str]:
         """Directory for the persistent XLA program cache

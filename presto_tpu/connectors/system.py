@@ -32,11 +32,21 @@ class QueryHistory(EventListener):
 
 
 class SystemConnector:
-    """system_runtime_queries + system_runtime_nodes."""
+    """system_runtime_queries + system_runtime_nodes +
+    system_runtime_tasks + system_metrics — the engine observing
+    itself in SQL (the reference's system connector + jmx tables)."""
 
-    def __init__(self, history: QueryHistory, nodes: Optional[Callable[[], List[dict]]] = None):
+    def __init__(self, history: QueryHistory,
+                 nodes: Optional[Callable[[], List[dict]]] = None,
+                 metrics=None, tasks=None):
+        from presto_tpu.obs import METRICS, TASKS
+
         self.history = history
         self.nodes = nodes or (lambda: [{"node_id": "local", "state": "ACTIVE"}])
+        # default to the process-wide registries (obs/metrics.py) —
+        # injectable for tests
+        self.metrics = metrics if metrics is not None else METRICS
+        self.tasks = tasks if tasks is not None else TASKS
 
     SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         "system_runtime_queries": [
@@ -49,9 +59,21 @@ class SystemConnector:
             # SELECT count(*) FROM system_runtime_queries WHERE
             # dist_fallback IS NOT NULL)
             ("dist_stages", BIGINT), ("dist_fallback", VARCHAR),
+            # lifecycle stage times from the obs span spine (NULL-safe:
+            # compile_ms is NULL when the query did not trace)
+            ("planning_ms", DOUBLE), ("compile_ms", DOUBLE),
+            ("execution_ms", DOUBLE),
         ],
         "system_runtime_nodes": [
             ("node_id", VARCHAR), ("state", VARCHAR),
+        ],
+        "system_runtime_tasks": [
+            ("task_id", VARCHAR), ("source", VARCHAR), ("state", VARCHAR),
+            ("trace_token", VARCHAR), ("elapsed_ms", DOUBLE),
+            ("rows", BIGINT),
+        ],
+        "system_metrics": [
+            ("name", VARCHAR), ("value", DOUBLE),
         ],
     }
 
@@ -67,6 +89,10 @@ class SystemConnector:
     def row_count(self, table: str) -> int:
         if table == "system_runtime_queries":
             return len(self.history.completed)
+        if table == "system_runtime_tasks":
+            return len(self.tasks.entries())
+        if table == "system_metrics":
+            return len(self.metrics.snapshot())
         return len(self.nodes())
 
     def page_for_split(self, table: str, split: int, capacity: Optional[int] = None) -> Page:
@@ -81,7 +107,23 @@ class SystemConnector:
                 [e.sql.strip()[:200] for e in evs],
                 [e.dist_stages for e in evs],
                 [e.dist_fallback for e in evs],
+                [getattr(e, "planning_ms", None) for e in evs],
+                [getattr(e, "compile_ms", None) for e in evs],
+                [getattr(e, "execution_ms", None) for e in evs],
             ]
+        elif table == "system_runtime_tasks":
+            ts = self.tasks.entries()
+            cols = [
+                [t.task_id for t in ts],
+                [t.source for t in ts],
+                [t.state for t in ts],
+                [t.trace_token for t in ts],
+                [t.elapsed_ms for t in ts],
+                [t.rows for t in ts],
+            ]
+        elif table == "system_metrics":
+            snap = self.metrics.snapshot()
+            cols = [[n for n, _ in snap], [float(v) for _, v in snap]]
         else:
             ns = self.nodes()
             cols = [[n["node_id"] for n in ns], [n["state"] for n in ns]]

@@ -41,6 +41,14 @@ class QueryCompletedEvent:
     # the coordinator despite SET SESSION distributed = true
     dist_stages: Optional[int] = None
     dist_fallback: Optional[str] = None
+    # lifecycle stage times from the obs span spine (QueryStats.java's
+    # analysisTime/planningTime/executionTime): planning covers
+    # bind+optimize+validate, compile is the XLA compile seconds the
+    # tracer attributed (None when the query did not trace), execution
+    # is the run itself.  All NULL-safe — consumers must handle None.
+    planning_ms: Optional[float] = None
+    compile_ms: Optional[float] = None
+    execution_ms: Optional[float] = None
 
 
 def new_trace_token() -> str:

@@ -217,20 +217,72 @@ class QueryStats:
     """Per-plan-node execution stats (QueryStats/OperatorStats analog).
     Wall times are inclusive of upstream stages (chains are fused into
     one XLA program; exclusive per-operator timing would require
-    breaking fusion)."""
+    breaking fusion).
+
+    Keying: entries key on a STABLE structural node id — (structural
+    signature, occurrence-within-plan) — not ``PlanNode`` object
+    identity.  Keying by identity fragmented stats the moment a
+    structurally identical node re-appeared (re-planned retries,
+    rebuilt executors sharing registry programs): each object opened
+    its own entry and EXPLAIN ANALYZE totals undercounted.  Twin nodes
+    inside one plan (self-join scans) stay distinct through the
+    occurrence index, assigned in deterministic walk order by
+    :meth:`register_plan`."""
 
     def __init__(self):
-        self.by_node: Dict[PlanNode, Dict[str, float]] = {}
+        self.by_key: Dict[tuple, Dict[str, float]] = {}
+        self._key_of: Dict[int, tuple] = {}
+        # keyed nodes are pinned so their id() can never be recycled
+        # onto a different node mid-lifetime
+        self._pin: List[PlanNode] = []
+
+    @staticmethod
+    def _sig(node: PlanNode):
+        from presto_tpu.exec.programs import ir_signature
+
+        try:
+            return (type(node).__name__, hash(ir_signature(node)))
+        except TypeError:
+            return (type(node).__name__, None)
+
+    def register_plan(self, root: PlanNode) -> None:
+        """Assign keys for a whole tree in preorder walk order, so two
+        structurally identical plans map node-for-node onto the SAME
+        keys: stats recorded while executing a re-built plan land on
+        the entries the original plan's annotations read."""
+        counts: Dict[tuple, int] = {}
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            sig = self._sig(n)
+            occ = counts.get(sig, 0)
+            counts[sig] = occ + 1
+            if id(n) not in self._key_of:
+                self._key_of[id(n)] = (sig, occ)
+                self._pin.append(n)
+            stack.extend(reversed(n.sources))
+
+    def _key(self, node: PlanNode) -> tuple:
+        k = self._key_of.get(id(node))
+        if k is None:
+            # lazily seen node (e.g. an injected partial-agg stage not
+            # present in the registered tree): occurrence 0 of its
+            # signature — structural twins merge, which is the point
+            k = (self._sig(node), 0)
+            self._key_of[id(node)] = k
+            self._pin.append(node)
+        return k
 
     def record(self, node: PlanNode, wall: float, rows: int) -> None:
-        s = self.by_node.setdefault(node, {"invocations": 0, "rows": 0, "wall_s": 0.0})
+        s = self.by_key.setdefault(
+            self._key(node), {"invocations": 0, "rows": 0, "wall_s": 0.0})
         s["invocations"] += 1
         s["rows"] += rows
         s["wall_s"] += wall
 
     def annotation(self, node: PlanNode) -> str:
-        s = self.by_node.get(node)
-        if s is None:
+        s = self.by_key.get(self._key(node))
+        if s is None or not s["invocations"]:
             return ""
         return (
             f"  [rows={s['rows']}, pages={s['invocations']}, "
@@ -492,12 +544,26 @@ class LocalRunner:
 
     # ------------------------------------------------------------------
     def run(self, plan: PlanNode, query_id: Optional[str] = None) -> MaterializedResult:
+        from presto_tpu.obs import METRICS, span
+
         page = self.run_to_page(plan, query_id=query_id)
-        out = page.compact_host()
+        # the result transfer is THE device sync of a local query — a
+        # span + counters so host-transfer time/bytes are attributable
+        # (the device_get tax EXPLAIN could not see before)
+        with span("device_get", cat="device"):
+            out = page.compact_host()
+            rows = out.to_pylist()
+        METRICS.counter("device.get_calls").inc()
+        try:
+            from presto_tpu.memory import page_bytes
+
+            METRICS.counter("device.get_bytes").inc(page_bytes(out))
+        except Exception:
+            pass  # byte accounting is best-effort on exotic pages
         return MaterializedResult(
             names=plan.output_names,
             types=plan.output_types,
-            rows=out.to_pylist(),
+            rows=rows,
         )
 
     def _query_mem(self, query_id: Optional[str]):
@@ -598,6 +664,7 @@ class LocalRunner:
         from presto_tpu.planner.plan import plan_tree_str
 
         stats = QueryStats()
+        stats.register_plan(plan)
         self.stats = stats
         try:
             self.run(plan)
@@ -782,22 +849,36 @@ class LocalRunner:
         """Stream output pages of ``node`` (pull model, Driver analog),
         recording per-stage stats when enabled (OperatorContext /
         OperatorStats analog, operator/OperatorStats.java:38 — times
-        here are inclusive of the stage's inputs since chains fuse)."""
-        if self.stats is None:
+        here are inclusive of the stage's inputs since chains fuse) and
+        per-pull operator spans when the query traces.  Tracer-only
+        runs skip the row-count device sync — tracing must not change
+        the execution profile it measures."""
+        from presto_tpu.obs.trace import current_tracer
+
+        tracer = current_tracer()
+        if self.stats is None and tracer is None:
             yield from self._pages_impl(node)
             return
         import time
 
         gen = self._pages_impl(node)
+        name = type(node).__name__
+        label = "op:" + (name[:-4] if name.endswith("Node") else name)
+        cat = "exchange" if isinstance(node, RemoteSourceNode) else "operator"
         while True:
             t0 = time.perf_counter()
             try:
-                p = next(gen)
+                if tracer is not None:
+                    with tracer.span(label, cat):
+                        p = next(gen)
+                else:
+                    p = next(gen)
             except StopIteration:
                 return
-            wall = time.perf_counter() - t0
-            rows = int(np.asarray(p.num_rows()))
-            self.stats.record(node, wall, rows)
+            if self.stats is not None:
+                wall = time.perf_counter() - t0
+                rows = int(np.asarray(p.num_rows()))
+                self.stats.record(node, wall, rows)
             yield p
 
     def _pages_impl(self, node: PlanNode) -> Iterator[Page]:

@@ -241,7 +241,8 @@ class Program:
         n0 = self._cache_size()
         t0 = time.perf_counter()
         out = self.fn(*args, **kwargs)
-        if self._cache_size() > n0:
+        n1 = self._cache_size()
+        if n1 > n0:
             dt = time.perf_counter() - t0
             self.compile_s += dt
             reg = self._registry
@@ -249,6 +250,19 @@ class Program:
                 with reg._lock:
                     reg.compile_s += dt
                     reg.trace_events += 1
+            # the compile becomes a span in the active query's trace
+            # (retroactive: detected only after the call returned) and
+            # feeds the process-wide XLA counters — "how much of this
+            # query was XLA compile" is the headline TPU question
+            from presto_tpu.obs import METRICS, current_tracer
+
+            METRICS.counter("xla.programs_compiled").inc(n1 - n0)
+            METRICS.counter("xla.compile_seconds_total").inc(dt)
+            METRICS.histogram("xla.compile_ms").observe(dt * 1e3)
+            tr = current_tracer()
+            if tr is not None:
+                tr.add_complete("xla_compile", "compile", t0, dt,
+                                kind=self.kind, programs=n1 - n0)
         return out
 
 
@@ -291,14 +305,18 @@ class ProgramRegistry:
         via ``factory`` on first request.  ``jit`` is part of the key
         (a debug runner's eager callable must not shadow the compiled
         one)."""
+        from presto_tpu.obs import METRICS
+
         key = (kind, bool(jit), ir_signature(sig))
         with self._lock:
             prog = self._programs.get(key)
             if prog is not None:
                 self.hits += 1
+                METRICS.counter("xla.registry_hits").inc()
                 self._programs.move_to_end(key)
                 return prog
             self.misses += 1
+            METRICS.counter("xla.registry_misses").inc()
             prog = Program(factory(), kind, jit, self)
             self._programs[key] = prog
             while len(self._programs) > self.max_callables:

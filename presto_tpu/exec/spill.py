@@ -103,4 +103,11 @@ def partition_to_host(page: Page, bids: jax.Array, num_buckets: int) -> List[Opt
         cols = [(d[idx], v[idx], b.type, b.dictionary)
                 for d, v, b in zip(datas, valids, page.blocks)]
         out.append(HostPage(cols, np.ones(len(idx), dtype=np.bool_)))
+    spilled = sum(
+        sum(d.nbytes + v.nbytes for d, v, _t, _dic in hp.columns)
+        for hp in out if hp is not None)
+    if spilled:
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("spill.bytes").inc(spilled)
     return out

@@ -32,11 +32,20 @@ def build_from_etc(etc_dir: str, port: int = 0):
     from presto_tpu.exec.programs import maybe_enable_persistent_cache
 
     maybe_enable_persistent_cache(cfg)
+    # observability wiring: query.trace-dir turns tracing on and drops
+    # one Chrome-trace JSON per query; query.log-path attaches the
+    # JSONL query-log EventListener (docs/observability.md)
+    from presto_tpu import obs
+
+    obs.maybe_enable_trace_dir(cfg)
     port = port or cfg.int("http-server.http.port", 0)
     if cfg.bool("coordinator", True):
         from presto_tpu.server.coordinator import CoordinatorServer
 
         runner = QueryRunner(catalog, session=cfg.build_session())
+        log_path = cfg.query_log_path()
+        if log_path:
+            runner.events.add(obs.QueryLogListener(log_path))
         server = CoordinatorServer(runner, port=port)
         role = "coordinator"
     else:
@@ -122,8 +131,8 @@ def daemon_stop(etc_dir: str, timeout: float = 30.0) -> bool:
         # recycled pid now owned by another user: never signal it
         print(f"pid {pid} is not ours (stale pidfile?); not signalling")
         return False
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         try:
             os.kill(pid, 0)
         except ProcessLookupError:

@@ -1,0 +1,36 @@
+"""Engine observability: spans + metrics + exports (the telemetry
+spine; docs/observability.md).
+
+``obs`` sits below every execution layer and imports none of them —
+runner/executor/server/parallel all instrument through this package,
+so it must stay dependency-free (events.py only).
+"""
+
+from presto_tpu.obs.metrics import METRICS, TASKS, MetricsRegistry, TaskRegistry
+from presto_tpu.obs.trace import (
+    NULL_SPAN,
+    Tracer,
+    current_tracer,
+    lookup,
+    register,
+    span,
+    tracer_for,
+    tracing,
+)
+from presto_tpu.obs.export import (
+    QueryLogListener,
+    chrome_trace,
+    maybe_enable_trace_dir,
+    maybe_write_trace,
+    set_trace_dir,
+    trace_dir,
+    write_trace,
+)
+
+__all__ = [
+    "METRICS", "TASKS", "MetricsRegistry", "TaskRegistry",
+    "NULL_SPAN", "Tracer", "current_tracer", "lookup", "register",
+    "span", "tracer_for", "tracing",
+    "QueryLogListener", "chrome_trace", "maybe_enable_trace_dir",
+    "maybe_write_trace", "set_trace_dir", "trace_dir", "write_trace",
+]

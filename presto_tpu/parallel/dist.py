@@ -285,8 +285,11 @@ class DistributedRunner:
             out.dist_fallback = None
             return out
         except DistributedUnsupported as e:
+            from presto_tpu.obs import METRICS
+
             reason = str(e) or type(e).__name__
             self.last_fallback_reason = reason
+            METRICS.counter("dist.fallbacks").inc()
             _log.warning("distributed execution fell back to coordinator: %s",
                          reason)
             out = self.local.run(plan)
@@ -333,6 +336,9 @@ class DistributedRunner:
                 min_stage_rows=self.min_stage_rows)
             if n_stages == 0:
                 raise DistributedUnsupported(undistributable_reason(plan))
+            from presto_tpu.obs import METRICS
+
+            METRICS.counter("dist.stages_total").inc(n_stages)
             self.last_stage_count = n_stages
             out = self.local.run(root)
             if root is not plan:  # the whole plan was one stage
@@ -355,14 +361,18 @@ class DistributedRunner:
         each shard then ships only its own top/first ``bound.count``
         rows across the gather (CreatePartialTopN.java role; the glue
         breaker still runs the global pick on the coordinator)."""
+        from presto_tpu.obs import span
+
         source = self._stage_source(chain_root)
-        while True:
-            try:
-                pages = self._run_chain_stage_once(chain_root, source, bound)
-                break
-            except GroupCapacityExceeded:
-                continue  # join capacities bumped; re-execute
-        return concat_pages_host(pages)
+        with span("dist_stage:chain", cat="exchange"):
+            while True:
+                try:
+                    pages = self._run_chain_stage_once(chain_root, source,
+                                                       bound)
+                    break
+                except GroupCapacityExceeded:
+                    continue  # join capacities bumped; re-execute
+            return concat_pages_host(pages)
 
     def _run_chain_stage_once(self, chain_root: PlanNode,
                               source: "_StageSource", bound=None) -> List[Page]:
@@ -440,11 +450,14 @@ class DistributedRunner:
             # formats it after the final merge
             raise DistributedUnsupported(
                 "evaluate_classifier_predictions is local-only")
-        while True:
-            try:
-                return self._run_aggregation_stage_once(agg)
-            except GroupCapacityExceeded:
-                continue  # _mg_overrides updated; re-execute
+        from presto_tpu.obs import span
+
+        with span("dist_stage:aggregation", cat="exchange"):
+            while True:
+                try:
+                    return self._run_aggregation_stage_once(agg)
+                except GroupCapacityExceeded:
+                    continue  # _mg_overrides updated; re-execute
 
     def _overflow(self, agg: AggregationNode, mg: int) -> None:
         if mg >= MAX_AGG_GROUPS:

@@ -85,6 +85,11 @@ class WorkerClient:
         self.max_attempts = max_attempts
         self.timeout = timeout
         self.alive = True
+        # request-correlation token stamped by the runner before a
+        # fan-out (X-Presto-Trace-Token, the reference's
+        # GenerateTraceTokenRequestFilter contract): every task POST
+        # carries it so worker-side spans stitch into the query's trace
+        self.trace_token: Optional[str] = None
 
     def ping(self, timeout: float = 5.0) -> bool:
         try:
@@ -126,9 +131,12 @@ class WorkerClient:
         if output_spec is not None:
             body_dict["output"] = output_spec
         body = json.dumps(body_dict).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.trace_token:
+            headers["X-Presto-Trace-Token"] = self.trace_token
         req = urllib.request.Request(
             f"{self.uri}/v1/task/{tid}", data=body, method="POST",
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             json.load(resp)
@@ -218,20 +226,35 @@ class MultiHostRunner:
         self.last_fallback_reason: Optional[str] = None
 
     def run(self, plan: PlanNode) -> MaterializedResult:
+        from presto_tpu.obs import METRICS, current_tracer
+
         self.last_gather_rows = 0  # rows pulled to the coordinator
         self.last_stage_count = 0
         self.last_fallback_reason = None
+        # stamp the active query's trace token on every worker client
+        # so fan-out task POSTs carry X-Presto-Trace-Token and the
+        # distributed stages stitch into one trace (best-effort under
+        # concurrency: the token is per-runner, like last_assignments)
+        tr = current_tracer()
+        token = tr.trace_token if tr is not None else None
+        for w in self.workers:
+            w.trace_token = token
         try:
             # per-run outcome rides the RESULT (dist_stages attached by
             # _run_distributed from its local stage count): concurrent
             # queries on one runner must not swap each other's stats
             out = self._run_distributed(plan)
             out.dist_fallback = None
+            # per-run count off the RESULT, not the shared field a
+            # concurrent run may have reset (same rule as dist_stages)
+            METRICS.counter("multihost.stages_total").inc(
+                out.dist_stages or 0)
             return out
         except MultiHostUnsupported as e:
             reason = str(e) or type(e).__name__
             self.last_fallback_reason = reason
             self.fallback_count += 1
+            METRICS.counter("multihost.fallbacks").inc()
             _log.warning(
                 "multi-host execution fell back to local: %s", reason)
             out = self.local.run(plan)
@@ -296,13 +319,16 @@ class MultiHostRunner:
         if any(a.fn == "evaluate_classifier_predictions" for a in agg.aggs):
             raise MultiHostUnsupported(
                 "evaluate_classifier_predictions is local-only")
+        from presto_tpu.obs import span
+
         leaf = self.local._chain_leaf(agg.source)
-        if isinstance(leaf, TableScanNode):
-            return self._run_agg_with_retry(agg, leaf)
-        if isinstance(leaf, PrecomputedNode):
-            return self._run_agg_over_pre(agg, leaf)
-        raise MultiHostUnsupported("aggregation stage leaf is neither "
-                                   "scan nor materialized input")
+        with span("mh_stage:aggregation", cat="exchange"):
+            if isinstance(leaf, TableScanNode):
+                return self._run_agg_with_retry(agg, leaf)
+            if isinstance(leaf, PrecomputedNode):
+                return self._run_agg_over_pre(agg, leaf)
+            raise MultiHostUnsupported("aggregation stage leaf is neither "
+                                       "scan nor materialized input")
 
     def _stage_chain(self, chain_root: PlanNode, bound=None):
         """Streaming-chain stage (SOURCE fragment).  A consuming
@@ -323,13 +349,16 @@ class MultiHostRunner:
                             nulls_first=bound.nulls_first)
         elif isinstance(bound, LimitNode):
             frag = LimitNode(source=chain_root, count=bound.count)
-        if isinstance(leaf, TableScanNode):
-            pages = self._run_fragments(frag, leaf)
-        elif isinstance(leaf, PrecomputedNode):
-            pages = self._run_fragments_pre(frag, leaf)
-        else:
-            raise MultiHostUnsupported("chain stage leaf is neither scan "
-                                       "nor materialized input")
+        from presto_tpu.obs import span
+
+        with span("mh_stage:chain", cat="exchange"):
+            if isinstance(leaf, TableScanNode):
+                pages = self._run_fragments(frag, leaf)
+            elif isinstance(leaf, PrecomputedNode):
+                pages = self._run_fragments_pre(frag, leaf)
+            else:
+                raise MultiHostUnsupported("chain stage leaf is neither "
+                                           "scan nor materialized input")
         for p in pages:
             self.last_gather_rows += int(np.asarray(p.row_mask).sum())
         if not pages:  # an empty intermediate produced zero chunks
@@ -521,9 +550,9 @@ class MultiHostRunner:
         gate between build and probe stages).  Bounded: on timeout the
         next phase launches anyway — the pull buffers' backpressure
         keeps a still-running build correct, just un-phased."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         for w, tid in tasks:
-            while time.time() < deadline:
+            while time.monotonic() < deadline:
                 try:
                     req = urllib.request.Request(f"{w.uri}/v1/task/{tid}")
                     with urllib.request.urlopen(req, timeout=10.0) as resp:

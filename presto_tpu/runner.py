@@ -141,42 +141,94 @@ class QueryRunner:
             assert_valid(plan)
         return plan
 
-    def execute(self, sql: str, query_id=None) -> MaterializedResult:
+    def _tracing_enabled(self) -> bool:
+        """Span tracing is on when the ``trace`` session property asks
+        for it or a trace directory is configured (query.trace-dir /
+        PRESTO_TPU_TRACE_DIR) — otherwise every span call is the no-op
+        fast path (obs/trace.py)."""
+        from presto_tpu import obs
+
+        try:
+            if self.session.get("trace"):
+                return True
+        except KeyError:
+            pass
+        return obs.trace_dir() is not None
+
+    def execute(self, sql: str, query_id=None,
+                trace_token: Optional[str] = None) -> MaterializedResult:
         import time
 
         from presto_tpu.events import (
             QueryCompletedEvent, QueryCreatedEvent, new_query_id,
         )
 
+        t_q0 = time.perf_counter()
         stmt = parse_statement(sql)
+        parse_s = time.perf_counter() - t_q0
 
         if isinstance(stmt, (ast.Query, ast.Union, ast.With, ast.SetOp)):
+            from presto_tpu import obs
             from presto_tpu.events import new_trace_token
 
             qid = query_id or new_query_id()
-            trace = self.session.trace_token or new_trace_token()
+            trace = (trace_token or self.session.trace_token
+                     or new_trace_token())
+            tracer = None
+            if self._tracing_enabled():
+                tracer = obs.register(obs.Tracer(qid, trace))
+                tracer.add_complete("parse", "lifecycle", t_q0, parse_s)
             t0 = time.time()
+            obs.METRICS.counter("query.started").inc()
+            obs.TASKS.start(qid, "local", trace_token=trace)
             self.events.query_created(
                 QueryCreatedEvent(qid, sql, self.session.user, t0, trace_token=trace)
             )
-            try:
-                plan = self._plan_cached(sql, stmt)
-                self._check_access(plan)
-                res = self._run_plan(plan, qid)
-            except Exception as e:
-                self.events.query_completed(QueryCompletedEvent(
-                    qid, sql, self.session.user, "FAILED", t0, time.time(),
-                    error=f"{type(e).__name__}: {e}", trace_token=trace,
-                ))
-                raise
+            planning_s: Optional[float] = None
+            with obs.tracing(tracer):
+                try:
+                    t1 = time.perf_counter()
+                    with obs.span("plan", cat="lifecycle"):
+                        plan = self._plan_cached(sql, stmt)
+                        self._check_access(plan)
+                    planning_s = time.perf_counter() - t1
+                    t1 = time.perf_counter()
+                    with obs.span("execute", cat="lifecycle"):
+                        res = self._run_plan(plan, qid)
+                    execution_s = time.perf_counter() - t1
+                except Exception as e:
+                    obs.METRICS.counter("query.failed").inc()
+                    err = f"{type(e).__name__}: {e}"
+                    obs.TASKS.finish(qid, "FAILED", error=err)
+                    self._finalize_trace(tracer, t_q0)
+                    self.events.query_completed(QueryCompletedEvent(
+                        qid, sql, self.session.user, "FAILED", t0, time.time(),
+                        error=err, trace_token=trace,
+                        planning_ms=self._ms(planning_s),
+                    ))
+                    raise
+            compile_ms = (round(tracer.total_s("xla_compile") * 1e3, 3)
+                          if tracer is not None else None)
+            obs.METRICS.counter("query.finished").inc()
+            obs.METRICS.counter("query.planning_seconds_total").inc(planning_s)
+            obs.METRICS.counter("query.execution_seconds_total").inc(execution_s)
+            obs.METRICS.histogram("query.execution_ms").observe(execution_s * 1e3)
+            obs.TASKS.finish(qid, "FINISHED", rows=len(res.rows))
             # per-run outcome off the result object (not the shared
             # runner fields — concurrent queries would swap stats)
             dist_stages = getattr(res, "dist_stages", None)
             dist_fallback = getattr(res, "dist_fallback", None)
+            # stage times ride the result for the statement protocol
+            res.planning_ms = self._ms(planning_s)
+            res.compile_ms = compile_ms
+            res.execution_ms = self._ms(execution_s)
+            self._finalize_trace(tracer, t_q0)
             self.events.query_completed(QueryCompletedEvent(
                 qid, sql, self.session.user, "FINISHED", t0, time.time(),
                 rows=len(res.rows), trace_token=trace,
                 dist_stages=dist_stages, dist_fallback=dist_fallback,
+                planning_ms=res.planning_ms, compile_ms=compile_ms,
+                execution_ms=res.execution_ms,
             ))
             return res
 
@@ -205,6 +257,7 @@ class QueryRunner:
                 text = self.executor.explain_analyze_verbose(plan)
             elif stmt.analyze:
                 stats = QueryStats()
+                stats.register_plan(plan)
                 self.executor.stats = stats
                 try:
                     self.executor.run(plan)
@@ -888,6 +941,24 @@ class QueryRunner:
                 raise annotate_position(e, sql) from e.__cause__
             self._plans[sql] = plan
         return plan
+
+    @staticmethod
+    def _ms(seconds: Optional[float]) -> Optional[float]:
+        return None if seconds is None else round(seconds * 1e3, 3)
+
+    @staticmethod
+    def _finalize_trace(tracer, t_q0: float) -> None:
+        """Close the root ``query`` span (parse start -> now) and write
+        the per-query Chrome-trace file when a trace dir is set."""
+        if tracer is None:
+            return
+        import time
+
+        from presto_tpu import obs
+
+        tracer.add_complete("query", "lifecycle", t_q0,
+                            time.perf_counter() - t_q0)
+        obs.maybe_write_trace(tracer)
 
     def _check_access(self, plan) -> None:
         from presto_tpu.security import scan_tables

@@ -193,7 +193,8 @@ class TokenAuthenticator:
     def issue(self, user: str, ttl_seconds: int = 3600) -> str:
         import time
 
-        exp = int(time.time()) + ttl_seconds
+        # epoch arithmetic by design: the exp claim is wall-clock time
+        exp = int(time.time()) + ttl_seconds  # lint: allow(wallclock)
         payload = f"{user}.{exp}"
         return f"{payload}.{self._sig(payload)}"
 

@@ -88,6 +88,12 @@ class _QueryState:
         # querying system_runtime_queries
         self.dist_stages: Optional[int] = None
         self.dist_fallback: Optional[str] = None
+        # lifecycle stage times from the obs span spine (NULL-safe)
+        self.planning_ms: Optional[float] = None
+        self.compile_ms: Optional[float] = None
+        self.execution_ms: Optional[float] = None
+        # client-supplied request correlation (X-Presto-Trace-Token)
+        self.trace_token: Optional[str] = None
 
     def summary(self) -> dict:
         return {
@@ -189,7 +195,9 @@ class CoordinatorServer:
                     return
                 n = int(self.headers.get("Content-Length", "0"))
                 sql = self.rfile.read(n).decode()
-                q = outer._submit(sql)
+                q = outer._submit(
+                    sql,
+                    trace_token=self.headers.get("X-Presto-Trace-Token"))
                 q.done.wait(timeout=600)
                 self._json(200, outer._page_response(q, 0))
 
@@ -211,6 +219,20 @@ class CoordinatorServer:
                     return
                 if parts in ([], ["ui"]):
                     self._html(200, _UI_HTML)
+                    return
+                if len(parts) == 4 and parts[:2] == ["v1", "query"] \
+                        and parts[3] == "trace":
+                    # per-query Chrome-trace JSON (open in Perfetto /
+                    # chrome://tracing); works by query id or trace token
+                    from presto_tpu import obs
+
+                    tracer = obs.lookup(parts[2])
+                    if tracer is None:
+                        self._json(404, {"error": "no trace for query "
+                                                  f"{parts[2]} (enable the "
+                                                  "trace session property)"})
+                        return
+                    self._json(200, obs.chrome_trace(tracer))
                     return
                 if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
                     qid, token = parts[2], int(parts[3])
@@ -256,12 +278,12 @@ class CoordinatorServer:
         # end), and its per-query pool reservations release only at
         # completion — a stop() that abandons them leaks reservations
         # into whatever runs next in the process
-        deadline = time.time() + drain_timeout
+        deadline = time.monotonic() + drain_timeout
         with self._lock:
             pending = [q.thread for q in self.queries.values()
                        if q.thread is not None]
         for t in pending:
-            t.join(max(0.0, deadline - time.time()))
+            t.join(max(0.0, deadline - time.monotonic()))
 
     def _kill_query(self, qid: str) -> None:
         """LowMemoryKiller action: cancel through the normal state path
@@ -279,9 +301,11 @@ class CoordinatorServer:
         return f"http://127.0.0.1:{self.port}"
 
     # ------------------------------------------------------------------
-    def _submit(self, sql: str) -> _QueryState:
+    def _submit(self, sql: str,
+                trace_token: Optional[str] = None) -> _QueryState:
         qid = uuid.uuid4().hex[:16]
         q = _QueryState(qid, sql)
+        q.trace_token = trace_token
         with self._lock:
             self.queries[qid] = q
 
@@ -307,7 +331,8 @@ class CoordinatorServer:
                     return
                 q.state = "RUNNING"
             try:
-                res = self.runner.execute(sql, query_id=q.id)
+                res = self.runner.execute(sql, query_id=q.id,
+                                          trace_token=q.trace_token)
                 cols = [
                     {"name": n, "type": repr(t)} for n, t in zip(res.names, res.types)
                 ]
@@ -316,6 +341,9 @@ class CoordinatorServer:
                 # report each other's stats
                 q.dist_stages = getattr(res, "dist_stages", None)
                 q.dist_fallback = getattr(res, "dist_fallback", None)
+                q.planning_ms = getattr(res, "planning_ms", None)
+                q.compile_ms = getattr(res, "compile_ms", None)
+                q.execution_ms = getattr(res, "execution_ms", None)
                 # CANCELED is terminal: a DELETE that raced this query's
                 # completion must not be resurrected to FINISHED/FAILED
                 with self._lock:
@@ -364,6 +392,14 @@ class CoordinatorServer:
             out["stats"]["distStages"] = q.dist_stages
         if q.dist_fallback is not None:
             out["stats"]["distFallback"] = q.dist_fallback
+        # per-stage lifecycle times (sourced from the obs spans; NULL
+        # keys simply absent, matching distStages' convention)
+        if q.planning_ms is not None:
+            out["stats"]["planningMs"] = q.planning_ms
+        if q.compile_ms is not None:
+            out["stats"]["compileMs"] = q.compile_ms
+        if q.execution_ms is not None:
+            out["stats"]["executionMs"] = q.execution_ms
         if q.error:
             out["error"] = q.error
             return out

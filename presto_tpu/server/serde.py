@@ -385,13 +385,22 @@ def _encode_page(columns, n: int, compress: bool) -> bytes:
     return len(hjson).to_bytes(4, "little") + hjson + payload
 
 
+def _count_exchange(direction: str, nbytes: int) -> None:
+    from presto_tpu.obs import METRICS
+
+    METRICS.counter(f"exchange.pages_{direction}").inc()
+    METRICS.counter(f"exchange.bytes_{direction}").inc(nbytes)
+
+
 def serialize_page(page: Page, compress: bool = True) -> bytes:
     """Compact live rows and encode (device page path)."""
     p = page.compact_host()
     n = int(np.asarray(p.row_mask).sum())
     cols = ((np.asarray(b.data)[:n], np.asarray(b.valid)[:n], b.type)
             for b in p.blocks)
-    return _encode_page(cols, n, compress)
+    out = _encode_page(cols, n, compress)
+    _count_exchange("serialized", len(out))
+    return out
 
 
 def serialize_host_page(hp, compress: bool = True) -> bytes:
@@ -400,7 +409,9 @@ def serialize_host_page(hp, compress: bool = True) -> bytes:
     bucket straight from host RAM without a device round trip."""
     n = int(hp.mask.sum())
     cols = ((data, valid, t) for data, valid, t, _dic in hp.columns)
-    return _encode_page(cols, n, compress)
+    out = _encode_page(cols, n, compress)
+    _count_exchange("serialized", len(out))
+    return out
 
 
 def encode_page_batch(pages) -> bytes:
@@ -426,6 +437,7 @@ def parse_page_batch(raw: bytes):
 def deserialize_page(raw: bytes, dictionaries=None) -> Page:
     import zlib
 
+    _count_exchange("deserialized", len(raw))
     hlen = int.from_bytes(raw[:4], "little")
     header = json.loads(raw[4 : 4 + hlen].decode())
     n = header["n"]

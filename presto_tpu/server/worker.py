@@ -205,8 +205,9 @@ class WorkerServer:
                             {"error": "worker is shutting down"}).encode())
                         return
                     tid = m.group(1)
-                    task = outer._create_task(tid, req["fragment"],
-                                              req.get("output"))
+                    task = outer._create_task(
+                        tid, req["fragment"], req.get("output"),
+                        trace_token=self.headers.get("X-Presto-Trace-Token"))
                     self._send(200, json.dumps(
                         {"taskId": tid, "state": task.state}).encode())
                     return
@@ -244,12 +245,19 @@ class WorkerServer:
 
     # ------------------------------------------------------------------
     def _create_task(self, task_id: str, fragment_json: dict,
-                     output_spec: Optional[dict] = None) -> _Task:
+                     output_spec: Optional[dict] = None,
+                     trace_token: Optional[str] = None) -> _Task:
         """``output_spec``: ``{"partitions": K, "key_indices": [...],
         "domains": [[lo,hi]|null...]}`` routes each produced page's rows
         into K per-partition buffers by key hash (the
         PartitionedOutputOperator + PartitionedOutputBuffer write path);
-        absent = single-stream output (TaskOutputOperator)."""
+        absent = single-stream output (TaskOutputOperator).
+        ``trace_token`` (X-Presto-Trace-Token) attaches this task's
+        spans to the originating query's tracer — the same object when
+        coordinator and worker share a process, a per-node tracer
+        retrievable by token otherwise."""
+        from presto_tpu import obs
+
         n_buffers = int(output_spec["partitions"]) if output_spec else 1
         with self._tasks_lock:
             existing = self._tasks.get(task_id)
@@ -257,6 +265,9 @@ class WorkerServer:
                 return existing
             task = _Task(task_id, self.buffer_bytes, n_buffers)
             self._tasks[task_id] = task
+        obs.TASKS.start(task_id, "worker", trace_token=trace_token)
+        tracer = (obs.tracer_for(trace_token, create=True)
+                  if trace_token else None)
 
         mem_ctx = None
         if self.runner.memory_pool is not None:
@@ -310,7 +321,11 @@ class WorkerServer:
                     if mem_ctx is not None:
                         self.runner._mem = mem_ctx
                     try:
-                        p = next(gen)
+                        # tracer re-binds around every quantum exactly
+                        # like the memory context: runner threads can
+                        # change between steps
+                        with obs.tracing(tracer):
+                            p = next(gen)
                     except StopIteration:
                         break
                     finally:
@@ -336,13 +351,16 @@ class WorkerServer:
                 for buf in task.buffers:
                     buf.set_complete()
                 self.tasks_executed += 1
+                obs.TASKS.finish(task_id, FINISHED)
             except BufferAborted:
                 task.state = ABORTED
+                obs.TASKS.finish(task_id, ABORTED)
             except Exception as e:
                 task.state = FAILED
                 task.error = f"{type(e).__name__}: {e}"
                 for buf in task.buffers:
                     buf.fail(task.error)
+                obs.TASKS.finish(task_id, FAILED, error=task.error)
             finally:
                 if mem_ctx is not None:
                     mem_ctx.release_all()
@@ -386,8 +404,8 @@ class WorkerServer:
         import time
 
         self.draining = True
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             with self._tasks_lock:
                 if all(t.state != RUNNING for t in self._tasks.values()):
                     break

@@ -75,6 +75,13 @@ SYSTEM_PROPERTIES = [
         "AUTOMATIC", lambda s: s.strip().upper(),
     ),
     PropertyMetadata(
+        "trace",
+        "record lifecycle/operator/compile spans for every query "
+        "(exportable as Chrome-trace JSON; query.trace-dir config "
+        "writes one file per query)",
+        False, _bool,
+    ),
+    PropertyMetadata(
         "validate_plans",
         "run the static plan/IR validator on every bound plan "
         "(EXPLAIN (TYPE VALIDATE) always does; query.validate-plans "

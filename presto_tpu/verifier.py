@@ -65,20 +65,20 @@ class Verifier:
     def verify(self, queries: Dict[str, str]) -> List[VerifierResult]:
         out: List[VerifierResult] = []
         for name, sql in queries.items():
-            t0 = time.time()
+            t0 = time.perf_counter()
             try:
                 control_rows = self.control(sql)
             except Exception as e:
                 out.append(VerifierResult(name, "CONTROL_FAILED", detail=str(e)))
                 continue
-            tc = time.time() - t0
-            t0 = time.time()
+            tc = time.perf_counter() - t0
+            t0 = time.perf_counter()
             try:
                 test_rows = self.test(sql)
             except Exception as e:
                 out.append(VerifierResult(name, "TEST_FAILED", control_time=tc, detail=str(e)))
                 continue
-            tt = time.time() - t0
+            tt = time.perf_counter() - t0
             if rows_match(control_rows, test_rows):
                 out.append(VerifierResult(name, "MATCH", tc, tt))
             else:

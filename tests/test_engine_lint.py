@@ -172,6 +172,89 @@ def test_ladder_routed_capacity_allowed(tmp_path):
     assert findings == []
 
 
+def test_wallclock_duration_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import time
+
+        def measure(run):
+            t0 = time.time()
+            run()
+            return time.time() - t0
+
+        def deadline(timeout):
+            return time.time() + timeout
+    """)
+    assert [f.rule for f in findings] == ["wallclock", "wallclock"]
+
+
+def test_wallclock_monotonic_and_timestamps_allowed(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import time
+
+        def measure(run):
+            t0 = time.perf_counter()
+            run()
+            return time.perf_counter() - t0
+
+        def deadline(timeout):
+            return time.monotonic() + timeout
+
+        def stamp():
+            return time.time()  # plain epoch timestamp: fine
+    """)
+    assert findings == []
+
+
+def test_wallclock_non_module_time_methods_allowed(tmp_path):
+    # .time() methods that are not the time module are not clocks
+    findings = _lint_snippet(tmp_path, """
+        def schedule(sched, delay):
+            return sched.time() + delay
+
+        def diff(self, t0):
+            return self.time() - t0
+    """)
+    assert findings == []
+
+
+def test_wallclock_aliased_time_module_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import time as _time
+
+        def measure(run):
+            t0 = _time.time()
+            run()
+            return _time.time() - t0
+    """)
+    assert [f.rule for f in findings] == ["wallclock"]
+
+
+def test_wallclock_from_import_flagged_once_per_expression(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import time
+        from time import time as now
+
+        def measure(run):
+            t0 = now()
+            run()
+            return now() - t0
+
+        def chained(a, b):
+            return time.time() + a + b
+    """)
+    assert [f.rule for f in findings] == ["wallclock", "wallclock"]
+
+
+def test_wallclock_suppression_comment(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import time
+
+        def jwt_exp(ttl):
+            return int(time.time()) + ttl  # lint: allow(wallclock)
+    """)
+    assert findings == []
+
+
 def test_rule_filter_and_check_exit():
     rc = engine_lint.main(["--rule", "bare-except", "--check",
                            os.path.join(REPO, "presto_tpu")])

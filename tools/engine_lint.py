@@ -42,6 +42,12 @@ spi-exception       ``raise KeyError/IndexError/AssertionError`` in
                     statements must fail with typed errors (BindError
                     / SyntaxError / TypeError with a message) — the
                     r5 raw ``KeyError: frozenset()`` leak class.
+wallclock           ``time.time()`` inside +/- arithmetic — duration
+                    or deadline math on the wall clock, which steps
+                    under NTP and skews bench/trace numbers.  Durations
+                    must use ``time.perf_counter()``, deadlines
+                    ``time.monotonic()``.  Genuine epoch arithmetic
+                    (JWT expiry claims) carries an allow comment.
 
 Suppression: append ``# lint: allow(<rule>)`` to the offending line
 (comma-separate multiple rules).  Allow-listed helper shapes (resolve-
@@ -175,6 +181,20 @@ class _Linter(ast.NodeVisitor):
         self._is_operator_code = any(
             f"{os.sep}{d}{os.sep}" in path
             for d in ("ops", "connectors", "storage"))
+        # names the time MODULE is bound to in this file (import time /
+        # import time as _time, at any scope) — the wallclock rule must
+        # not fire on unrelated .time() methods
+        self._time_aliases = {
+            alias.asname or alias.name
+            for stmt in ast.walk(tree) if isinstance(stmt, ast.Import)
+            for alias in stmt.names if alias.name == "time"}
+        # names the time.time FUNCTION is bound to (from time import
+        # time [as now]) — bare calls through these are wall clocks too
+        self._time_funcs = {
+            alias.asname or alias.name
+            for stmt in ast.walk(tree) if isinstance(stmt, ast.ImportFrom)
+            if stmt.module == "time"
+            for alias in stmt.names if alias.name == "time"}
 
     # -- helpers -----------------------------------------------------------
     def _emit(self, node: ast.AST, rule: str, message: str) -> None:
@@ -250,6 +270,43 @@ class _Linter(ast.NodeVisitor):
 
         self.generic_visit(node)
 
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        # wallclock: time.time() feeding +/- arithmetic is duration or
+        # deadline math on a clock that steps under NTP
+        if isinstance(node.op, (ast.Add, ast.Sub)) \
+                and (self._is_walltime(node.left)
+                     or self._is_walltime(node.right)):
+            self._emit(
+                node, "wallclock",
+                "time.time() in duration/deadline arithmetic — the wall "
+                "clock steps under NTP; use time.perf_counter() for "
+                "durations, time.monotonic() for deadlines (epoch math "
+                "needs # lint: allow(wallclock))")
+        self.generic_visit(node)
+
+    def _is_walltime(self, node: ast.AST) -> bool:
+        """expression containing a ``time.time()`` call on the time
+        MODULE (including aliases like ``_time.time()``) — other
+        ``.time()`` methods are not clocks.  BinOp operands are NOT
+        descended into: they visit and report themselves, and walking
+        through them double-reported chained arithmetic
+        (``time.time() + a + b``)."""
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, ast.BinOp):
+                continue
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "time" \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id in self._time_aliases:
+                    return True
+                if isinstance(fn, ast.Name) and fn.id in self._time_funcs:
+                    return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
     def _check_branch(self, node) -> None:
         if _is_jnp_value(node.test):
             kind = "if" if isinstance(node, ast.If) else "while"
@@ -292,7 +349,8 @@ class _Linter(ast.NodeVisitor):
 
 
 ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
-             "block-until-ready", "bare-except", "spi-exception"}
+             "block-until-ready", "bare-except", "spi-exception",
+             "wallclock"}
 
 
 def lint_file(path: str, rules: Set[str] = ALL_RULES) -> List[Finding]:
