@@ -57,6 +57,46 @@ def format_output(names, rows, fmt: str) -> str:
     raise SystemExit(f"unknown output format {fmt!r}")
 
 
+def _progress_text(stats: dict) -> str:
+    """One-line render of statement-protocol progress stats (the
+    reference CLI's status bar): percentage + the busiest stage."""
+    pct = stats.get("progressPercentage")
+    parts = []
+    if pct is not None:
+        parts.append(f"{pct:5.1f}%")
+    stages = stats.get("stages") or []
+    running = [s for s in stages if s.get("state") == "RUNNING"]
+    show = (running or stages)[-1:]
+    for s in show:
+        tot = s.get("splitsTotal")
+        parts.append(f"{s['stage']} {s['splitsDone']}/{tot if tot is not None else '?'}")
+    return " ".join(parts)
+
+
+class _ProgressLine:
+    """Carriage-return progress line on stderr (suppressed when stderr
+    is not a terminal unless --progress forces it)."""
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self._width = 0
+
+    def update(self, stats: dict) -> None:
+        if not self.enabled:
+            return
+        text = _progress_text(stats)
+        pad = max(self._width - len(text), 0)
+        sys.stderr.write("\r" + text + " " * pad)
+        sys.stderr.flush()
+        self._width = len(text)
+
+    def clear(self) -> None:
+        if self.enabled and self._width:
+            sys.stderr.write("\r" + " " * self._width + "\r")
+            sys.stderr.flush()
+            self._width = 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="presto-tpu")
     ap.add_argument("--server", help="coordinator URI (default: embedded engine)")
@@ -65,6 +105,9 @@ def main(argv=None) -> int:
     ap.add_argument("--output-format", default="ALIGNED",
                     choices=["ALIGNED", "CSV", "TSV", "JSON"],
                     help="result rendering (reference --output-format)")
+    ap.add_argument("--progress", action="store_true",
+                    help="render a live progress line even when stderr "
+                         "is not a terminal")
     ap.add_argument("--platform", default=None,
                     help="force the jax backend (e.g. cpu) — useful when "
                          "the accelerator tunnel is unreachable")
@@ -75,13 +118,16 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
+    show_progress = args.progress or sys.stderr.isatty()
+
     if args.server:
         from presto_tpu.client import StatementClient
 
         client = StatementClient(args.server)
 
-        def run(sql):
-            columns, rows = client.execute(sql)
+        def run(sql, line):
+            columns, rows = client.execute(
+                sql, on_progress=line.update if line.enabled else None)
             return [c["name"] for c in columns], rows
     else:
         from presto_tpu.catalog import Catalog
@@ -92,17 +138,49 @@ def main(argv=None) -> int:
         catalog.register("tpch", Tpch(sf=args.sf))
         runner = QueryRunner(catalog)
 
-        def run(sql):
-            res = runner.execute(sql)
+        def run(sql, line):
+            if not line.enabled:
+                res = runner.execute(sql)
+                return res.names, res.rows
+            # embedded: execute on a worker thread and poll the
+            # process progress registry from here (the same numbers
+            # the statement protocol serves)
+            import threading
+            import uuid
+
+            from presto_tpu import obs
+
+            qid = "cli_" + uuid.uuid4().hex[:12]
+            box = {}
+
+            def go():
+                try:
+                    box["res"] = runner.execute(sql, query_id=qid)
+                except BaseException as e:
+                    box["err"] = e
+
+            t = threading.Thread(target=go, daemon=True)
+            t.start()
+            while t.is_alive():
+                t.join(timeout=0.1)
+                prog = obs.progress_for(qid)
+                if prog is not None:
+                    line.update(prog.snapshot())
+            if "err" in box:
+                raise box["err"]
+            res = box["res"]
             return res.names, res.rows
 
     def run_one(sql: str) -> int:
         t0 = time.perf_counter()
+        line = _ProgressLine(show_progress)
         try:
-            names, rows = run(sql)
+            names, rows = run(sql, line)
         except Exception as e:
+            line.clear()
             print(f"error: {e}", file=sys.stderr)
             return 1
+        line.clear()
         print(format_output(names, rows, args.output_format))
         if args.output_format == "ALIGNED":
             print(f"({len(rows)} rows, {time.perf_counter() - t0:.2f}s)")

@@ -16,25 +16,42 @@ class StatementClient:
     def __init__(self, server_uri: str):
         self.server_uri = server_uri.rstrip("/")
 
-    def execute(self, sql: str) -> Tuple[List[dict], List[tuple]]:
-        """Run a statement; returns (columns, rows)."""
+    def execute(self, sql: str,
+                on_progress=None) -> Tuple[List[dict], List[tuple]]:
+        """Run a statement; returns (columns, rows).
+
+        ``on_progress``: optional callback receiving each page's
+        ``stats`` dict.  When set, the statement POSTs with
+        ``X-Presto-Async`` and the server returns immediately — pages
+        while the query runs carry ``progressPercentage`` / ``stages``
+        and no data; the loop below polls ``nextUri`` until the state
+        is terminal (the reference StatementClient's real shape)."""
+        headers = {"Content-Type": "text/plain"}
+        if on_progress is not None:
+            headers["X-Presto-Async"] = "1"
         req = urllib.request.Request(
             f"{self.server_uri}/v1/statement",
             data=sql.encode(),
             method="POST",
-            headers={"Content-Type": "text/plain"},
+            headers=headers,
         )
         with urllib.request.urlopen(req) as resp:
             page = json.load(resp)
+        if on_progress is not None and page.get("stats"):
+            on_progress(page["stats"])
         if page.get("error"):
             raise RuntimeError(page["error"])
-        columns = page.get("columns", [])
+        columns = page.get("columns") or []
         rows = [tuple(r) for r in page.get("data", [])]
         while page.get("nextUri"):
             with urllib.request.urlopen(page["nextUri"]) as resp:
                 page = json.load(resp)
+            if on_progress is not None and page.get("stats"):
+                on_progress(page["stats"])
             if page.get("error"):
                 raise RuntimeError(page["error"])
+            if not columns and page.get("columns"):
+                columns = page["columns"]  # set once the query finishes
             rows.extend(tuple(r) for r in page.get("data", []))
         return columns, rows
 

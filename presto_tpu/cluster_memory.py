@@ -50,6 +50,7 @@ class ClusterMemoryManager:
         threshold: float = 0.95,
         poll_interval: float = 1.0,
         killer: Callable[[Dict[str, int]], Optional[str]] = total_reservation_low_memory_killer,
+        events=None,
     ):
         self.local_pool = local_pool
         self.kill_query = kill_query
@@ -57,6 +58,11 @@ class ClusterMemoryManager:
         self.threshold = threshold
         self.poll_interval = poll_interval
         self.killer = killer
+        # EventListenerManager (or None): each kill emits a
+        # MemoryKillEvent so the query log records the DECISION —
+        # pool pressure and bytes freed — not just the victim's
+        # eventual failure line
+        self.events = events
         self.kills: List[str] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -116,8 +122,25 @@ class ClusterMemoryManager:
         if victim is None:
             return None
         self.kills.append(victim)
-        self.local_pool.kill_query(victim)  # immediate relief
+        freed = self.local_pool.kill_query(victim)  # immediate relief
         self.kill_query(victim)
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("memory.query_killed").inc()
+        if self.events is not None:
+            # telemetry AFTER both kill actions, and guarded: a raising
+            # user listener must not leave the victim half-killed
+            try:
+                import time
+
+                from presto_tpu.events import MemoryKillEvent
+
+                self.events.memory_killed(MemoryKillEvent(
+                    query_id=victim, freed_bytes=freed,
+                    reserved_bytes=reserved, limit_bytes=limit,
+                    kill_time=time.time()))
+            except Exception:
+                pass
         return victim
 
     # -- lifecycle ----------------------------------------------------------

@@ -31,14 +31,29 @@ class QueryHistory(EventListener):
             self.completed.pop(0)
 
 
+def pool_row(node: str, pool) -> dict:
+    """One system_memory_pools row for a live MemoryPool."""
+    tags = pool.tags()
+    return {
+        "node": node,
+        "reserved": int(pool.reserved),
+        "peak": int(pool.peak),
+        "limit": int(pool.limit),
+        "queries": len({t.split("/", 1)[0] for t in tags}),
+    }
+
+
 class SystemConnector:
     """system_runtime_queries + system_runtime_nodes +
-    system_runtime_tasks + system_metrics — the engine observing
-    itself in SQL (the reference's system connector + jmx tables)."""
+    system_runtime_tasks + system_metrics + system_memory_pools — the
+    engine observing itself in SQL (the reference's system connector +
+    jmx tables)."""
 
     def __init__(self, history: QueryHistory,
                  nodes: Optional[Callable[[], List[dict]]] = None,
-                 metrics=None, tasks=None):
+                 metrics=None, tasks=None, remote_metrics=None,
+                 pools: Optional[Callable[[], List[dict]]] = None,
+                 node_id: str = "local"):
         from presto_tpu.obs import METRICS, TASKS
 
         self.history = history
@@ -47,6 +62,21 @@ class SystemConnector:
         # injectable for tests
         self.metrics = metrics if metrics is not None else METRICS
         self.tasks = tasks if tasks is not None else TASKS
+        self.node_id = node_id
+        # cluster fan-in: () -> {node: [(name, value), ...]} — the
+        # coordinator wires CoordinatorServer.remote_metrics here so
+        # system_metrics carries every worker's registry plus a
+        # 'cluster' rollup row per metric (single-node processes skip
+        # the rollup: it would just duplicate the local rows)
+        self.remote_metrics = remote_metrics
+        # () -> [{node, reserved, peak, limit, queries}] — defaults to
+        # the process pool (memory.default_memory_pool)
+        self.pools = pools
+        # one cluster poll per scan, not one per metadata call:
+        # row_count (bind time) and page_for_split (execution) both
+        # need the rows, and polling twice doubles the HTTP fan-out
+        # AND risks the page disagreeing with the planned row count
+        self._metrics_cache: Optional[Tuple[float, List]] = None
 
     SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         "system_runtime_queries": [
@@ -73,7 +103,16 @@ class SystemConnector:
             ("rows", BIGINT),
         ],
         "system_metrics": [
-            ("name", VARCHAR), ("value", DOUBLE),
+            ("node", VARCHAR), ("name", VARCHAR), ("value", DOUBLE),
+        ],
+        # HBM pool accounting per node (memory/ClusterMemoryManager's
+        # RemoteNodeMemory view as a table): reserved/peak/limit bytes
+        # and the count of queries holding reservations ("limit" is a
+        # parser keyword, hence the _bytes suffixes)
+        "system_memory_pools": [
+            ("node", VARCHAR), ("reserved_bytes", BIGINT),
+            ("peak_bytes", BIGINT), ("limit_bytes", BIGINT),
+            ("queries", BIGINT),
         ],
     }
 
@@ -92,8 +131,52 @@ class SystemConnector:
         if table == "system_runtime_tasks":
             return len(self.tasks.entries())
         if table == "system_metrics":
-            return len(self.metrics.snapshot())
+            return len(self._metrics_rows())
+        if table == "system_memory_pools":
+            return len(self._pool_rows())
         return len(self.nodes())
+
+    def _metrics_rows(self) -> List[Tuple[str, str, float]]:
+        """(node, name, value) across the cluster: local registry rows,
+        every polled worker's rows, and — when remote nodes exist — a
+        'cluster' rollup summing each metric over all nodes.  The
+        cluster poll is cached for ~1s so the bind-time row count and
+        the executed page see ONE consistent snapshot (local-only
+        snapshots are cheap and always fresh)."""
+        import time
+
+        from presto_tpu.obs.openmetrics import merge_rows
+
+        if self.remote_metrics is not None and self._metrics_cache \
+                and time.monotonic() - self._metrics_cache[0] < 1.0:
+            return self._metrics_cache[1]
+        per_node = {self.node_id: list(self.metrics.snapshot())}
+        if self.remote_metrics is not None:
+            try:
+                for node, rows in self.remote_metrics().items():
+                    per_node[node] = [(n, float(v)) for n, v in rows]
+            except Exception:
+                pass  # a dead worker must not fail the system table
+        out = [(node, n, float(v))
+               for node in sorted(per_node)
+               for n, v in per_node[node]]
+        if len(per_node) > 1:
+            out += [("cluster", n, v) for n, v in merge_rows(per_node)]
+        if self.remote_metrics is not None:
+            self._metrics_cache = (time.monotonic(), out)
+        return out
+
+    def _pool_rows(self) -> List[dict]:
+        if self.pools is not None:
+            try:
+                rows = list(self.pools())
+                if rows:
+                    return rows
+            except Exception:
+                pass  # fall through to the process pool
+        from presto_tpu.memory import default_memory_pool
+
+        return [pool_row(self.node_id, default_memory_pool())]
 
     def page_for_split(self, table: str, split: int, capacity: Optional[int] = None) -> Page:
         if table == "system_runtime_queries":
@@ -122,8 +205,19 @@ class SystemConnector:
                 [t.rows for t in ts],
             ]
         elif table == "system_metrics":
-            snap = self.metrics.snapshot()
-            cols = [[n for n, _ in snap], [float(v) for _, v in snap]]
+            snap = self._metrics_rows()
+            cols = [[node for node, _, _ in snap],
+                    [n for _, n, _ in snap],
+                    [float(v) for _, _, v in snap]]
+        elif table == "system_memory_pools":
+            ps = self._pool_rows()
+            cols = [
+                [p["node"] for p in ps],
+                [int(p["reserved"]) for p in ps],
+                [int(p["peak"]) for p in ps],
+                [int(p["limit"]) for p in ps],
+                [int(p["queries"]) for p in ps],
+            ]
         else:
             ns = self.nodes()
             cols = [[n["node_id"] for n in ns], [n["state"] for n in ns]]

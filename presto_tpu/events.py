@@ -51,6 +51,21 @@ class QueryCompletedEvent:
     execution_ms: Optional[float] = None
 
 
+@dataclasses.dataclass
+class MemoryKillEvent:
+    """The cluster low-memory killer chose a victim (the reference logs
+    this from ClusterMemoryManager's kill path).  Emitted in ADDITION
+    to the victim's eventual QueryCompletedEvent — the kill decision
+    (pool pressure at decision time, bytes freed) is information the
+    completion event cannot carry."""
+
+    query_id: str
+    freed_bytes: int
+    reserved_bytes: int  # pool reservation at the decision
+    limit_bytes: int
+    kill_time: float  # epoch seconds (event timestamp, not a duration)
+
+
 def new_trace_token() -> str:
     return "trace_" + uuid.uuid4().hex[:16]
 
@@ -62,6 +77,9 @@ class EventListener:
         pass
 
     def query_completed(self, event: QueryCompletedEvent) -> None:  # pragma: no cover
+        pass
+
+    def memory_killed(self, event: MemoryKillEvent) -> None:  # pragma: no cover
         pass
 
 
@@ -79,6 +97,10 @@ class EventListenerManager:
     def query_completed(self, event: QueryCompletedEvent) -> None:
         for l in self._listeners:
             l.query_completed(event)
+
+    def memory_killed(self, event: MemoryKillEvent) -> None:
+        for l in self._listeners:
+            l.memory_killed(event)
 
 
 def new_query_id() -> str:

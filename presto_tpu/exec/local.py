@@ -520,6 +520,9 @@ class LocalRunner:
         # HBM accounting (memory/MemoryPool.java analog); None = untracked
         self.memory_pool = memory_pool
         self.last_peak_bytes = 0
+        # site -> peak bytes of the last completed query (EXPLAIN
+        # ANALYZE's per-operator memory source)
+        self.last_site_peaks: Dict[str, int] = {}
         # host-RAM spill fan-out when state exceeds the pool/threshold
         self.spill_partitions = spill_partitions
         # multi-producer ORDER BY: per-page sorts + order-preserving
@@ -585,6 +588,10 @@ class LocalRunner:
             finally:
                 if self._mem is not None:
                     self.last_peak_bytes = self._mem.peak
+                    # per-site peak reservations (site strings embed the
+                    # plan-node id) survive the context so EXPLAIN
+                    # ANALYZE can attribute peak bytes per operator
+                    self.last_site_peaks = dict(self._mem.site_peak)
                     self._mem.release_all()
                     self._mem = None
 
@@ -644,11 +651,28 @@ class LocalRunner:
     def explain_with_stats(self, plan: PlanNode, stats: "QueryStats") -> str:
         from presto_tpu.planner.plan import plan_tree_str
 
-        text = plan_tree_str(plan, stats=stats)
+        text = plan_tree_str(plan, stats=stats, mem=self._mem_by_node())
         peak = getattr(self, "last_peak_bytes", 0)
         if peak:
             text = f"peak reserved memory: {peak / 1e6:.1f}MB\n" + text
         return text
+
+    def _mem_by_node(self) -> Dict[int, int]:
+        """id(plan node) -> peak reserved bytes, recovered from the last
+        query's tagged reservation sites (``what@<id(node)>`` — the tag
+        convention of :meth:`_account` and the agg tower).  Sites for
+        different allocation kinds on the same node sum; sites without a
+        node id (scan pages, sort input) stay in the query-level peak
+        header only."""
+        import re as _re
+
+        out: Dict[int, int] = {}
+        for site, nbytes in getattr(self, "last_site_peaks", {}).items():
+            m = _re.search(r"@(\d+)$", site)
+            if m:
+                nid = int(m.group(1))
+                out[nid] = out.get(nid, 0) + nbytes
+        return out
 
     # ------------------------------------------------------------------
     # EXPLAIN ANALYZE VERBOSE: exclusive per-operator attribution
@@ -671,7 +695,8 @@ class LocalRunner:
         finally:
             self.stats = None
         exclusive = self._exclusive_times(plan)
-        text = plan_tree_str(plan, stats=stats, exclusive=exclusive)
+        text = plan_tree_str(plan, stats=stats, exclusive=exclusive,
+                             mem=self._mem_by_node())
         peak = getattr(self, "last_peak_bytes", 0)
         if peak:
             text = f"peak reserved memory: {peak / 1e6:.1f}MB\n" + text
@@ -1203,6 +1228,34 @@ class LocalRunner:
                     return  # provably empty scan
             sample = node.sample
             produced = 0
+            # live progress: one stage per scan invocation (self-join
+            # twins and capacity retries each get their own entry; the
+            # reported percentage is a running max, so re-runs never
+            # regress it).  Rows are padded row SLOTS — counting live
+            # rows would force a device sync per split.
+            from presto_tpu.obs import current_progress
+
+            prog = current_progress()
+            stage_name = None
+            if prog is not None:
+                stage_name = prog.new_stage_name(
+                    f"scan:{node.handle.table}")
+                try:
+                    total = len(splits)
+                except TypeError:
+                    total = None
+                prog.stage(stage_name, splits_total=total)
+
+            def _split_mark(page=None):
+                if prog is None:
+                    return
+                if page is None:
+                    prog.split_done(stage_name)
+                    return
+                from presto_tpu.memory import page_bytes
+
+                prog.split_done(stage_name, rows=page.capacity,
+                                nbytes=page_bytes(page))
             # scan-uniform capacity: a split that FITS a previously
             # established bucket of this scan (and is at least a third
             # of it) joins that bucket instead of opening its own, so the
@@ -1225,10 +1278,12 @@ class LocalRunner:
                     # split 0 is not a fixed point
                     h = (((split + 1) * 2654435761) ^ 0x9E3779B9) % 10_000
                     if h >= sample[1] * 100:
+                        _split_mark()
                         continue
                 if td is not None:
                     stats = conn.split_stats(node.handle.table, split)
                     if not td.overlaps_split_stats(stats):
+                        _split_mark()  # pruned splits still count as done
                         continue
                 page = conn.page_for_split(
                     node.handle.table, split, capacity=self.split_capacity
@@ -1255,7 +1310,10 @@ class LocalRunner:
                     out = pad_page_pow2(raw)
                     if out.capacity > cap_hi:
                         cap_hi = out.capacity
+                _split_mark(out)
                 yield out
+            if prog is not None:
+                prog.finish_stage(stage_name)
         else:
             yield from self._pages(node)
 

@@ -49,12 +49,18 @@ def build_from_etc(etc_dir: str, port: int = 0):
         server = CoordinatorServer(runner, port=port)
         role = "coordinator"
     else:
+        from presto_tpu.memory import default_memory_pool
         from presto_tpu.server.worker import WorkerServer
 
+        # the process HBM pool: gives a deployed worker the memory
+        # accounting surfaces (/v1/info breakdown, memory.pool_* gauges
+        # on /v1/metrics) the coordinator's killer and the metrics
+        # plane read
         server = WorkerServer(
             catalog,
             port=port,
             buffer_bytes=cfg.int("task.buffer-bytes", 64 << 20),
+            memory_pool=default_memory_pool(),
         )
         role = "worker"
     return server, role, cfg

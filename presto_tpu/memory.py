@@ -103,7 +103,10 @@ class MemoryPool:
 class QueryMemoryContext:
     """Per-query view over a pool (QueryContext analog): unique tags
     per allocation site, freed together at query end.  Tracks its own
-    reserved/peak so QueryStats can report per-query peak bytes."""
+    reserved/peak so QueryStats can report per-query peak bytes, and
+    per-SITE current/peak bytes (site = the ``what`` string, which for
+    operator reservations embeds the plan-node id) so EXPLAIN ANALYZE
+    can print per-operator peak memory from the tagged reservations."""
 
     def __init__(self, pool: MemoryPool, query_id: str = "q"):
         self.pool = pool
@@ -111,6 +114,9 @@ class QueryMemoryContext:
         self._seq = 0
         self.reserved = 0
         self.peak = 0
+        self._tag_site: Dict[str, tuple] = {}  # tag -> (site, nbytes)
+        self._site_current: Dict[str, int] = {}
+        self.site_peak: Dict[str, int] = {}
 
     def reserve(self, what: str, nbytes: int, enforce: bool = True) -> str:
         self._seq += 1
@@ -118,6 +124,11 @@ class QueryMemoryContext:
         self.pool.reserve(tag, nbytes, enforce=enforce)
         self.reserved += nbytes
         self.peak = max(self.peak, self.reserved)
+        self._tag_site[tag] = (what, nbytes)
+        cur = self._site_current.get(what, 0) + nbytes
+        self._site_current[what] = cur
+        if cur > self.site_peak.get(what, 0):
+            self.site_peak[what] = cur
         return tag
 
     def reserve_page(self, what: str, page) -> str:
@@ -126,12 +137,18 @@ class QueryMemoryContext:
     def free(self, tag: str) -> None:
         self.reserved -= self.pool.tags().get(tag, 0)
         self.pool.free(tag)
+        entry = self._tag_site.pop(tag, None)
+        if entry is not None:
+            site, nbytes = entry
+            self._site_current[site] = self._site_current.get(site, 0) - nbytes
 
     def release_all(self) -> None:
         for tag in list(self.pool.tags()):
             if tag.startswith(self.query_id + "/"):
                 self.pool.free(tag)
         self.reserved = 0
+        self._tag_site.clear()
+        self._site_current.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -178,4 +195,22 @@ def default_memory_pool() -> MemoryPool:
     with _DEFAULT_LOCK:
         if _DEFAULT_POOL is None:
             _DEFAULT_POOL = MemoryPool(detected_memory_limit())
+            wire_pool_gauges(_DEFAULT_POOL)
         return _DEFAULT_POOL
+
+
+def wire_pool_gauges(pool: MemoryPool) -> None:
+    """Attach the ``memory.pool_*`` gauges (pre-registered in the
+    obs catalog) to ``pool``.  Gauges sample through callbacks at
+    snapshot/scrape time, so they always read the live pool state.
+    Process semantics: ONE accountable pool per process (the default
+    pool, or a server's injected one) — the most recently wired pool
+    wins, which lets tests swap pools freely."""
+    from presto_tpu.obs import METRICS
+
+    METRICS.gauge("memory.pool_reserved_bytes").set_fn(
+        lambda: pool.reserved)
+    METRICS.gauge("memory.pool_peak_bytes").set_fn(lambda: pool.peak)
+    METRICS.gauge("memory.pool_limit_bytes").set_fn(lambda: pool.limit)
+    METRICS.gauge("memory.pool_queries").set_fn(
+        lambda: len({t.split("/", 1)[0] for t in pool.tags()}))

@@ -26,6 +26,15 @@ from presto_tpu.obs.export import (
     trace_dir,
     write_trace,
 )
+from presto_tpu.obs import openmetrics
+from presto_tpu.obs.progress import (
+    QueryProgress,
+    StageProgress,
+    current_progress,
+    progress_for,
+    publishing,
+    register_progress,
+)
 
 __all__ = [
     "METRICS", "TASKS", "MetricsRegistry", "TaskRegistry",
@@ -33,4 +42,7 @@ __all__ = [
     "span", "tracer_for", "tracing",
     "QueryLogListener", "chrome_trace", "maybe_enable_trace_dir",
     "maybe_write_trace", "set_trace_dir", "trace_dir", "write_trace",
+    "openmetrics",
+    "QueryProgress", "StageProgress", "current_progress", "progress_for",
+    "publishing", "register_progress",
 ]

@@ -21,7 +21,9 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
-from presto_tpu.events import EventListener, QueryCompletedEvent
+from presto_tpu.events import (
+    EventListener, MemoryKillEvent, QueryCompletedEvent,
+)
 from presto_tpu.obs.trace import Tracer
 
 def _normalize_dir(path: Optional[str]) -> Optional[str]:
@@ -159,6 +161,22 @@ class QueryLogListener(EventListener):
         tracer = lookup(e.query_id)
         if tracer is not None:
             rec["spans"] = tracer.summary()
+        self._append(rec)
+
+    def memory_killed(self, e: MemoryKillEvent) -> None:
+        """One ``"event": "memory_kill"`` line per low-memory-killer
+        victim — the kill DECISION, distinct from (and preceding) the
+        victim's completion line."""
+        self._append({
+            "event": "memory_kill",
+            "query_id": e.query_id,
+            "freed_bytes": e.freed_bytes,
+            "reserved_bytes": e.reserved_bytes,
+            "limit_bytes": e.limit_bytes,
+            "kill_time": e.kill_time,
+        })
+
+    def _append(self, rec: Dict[str, Any]) -> None:
         line = json.dumps(rec, default=str)
         try:
             with self._lock:

@@ -102,6 +102,13 @@ class Histogram:
                     out.append((f"{self.name}.bucket_le_{1 << k}", float(n)))
         return out
 
+    def snapshot_raw(self) -> Tuple[int, float, List[int]]:
+        """(count, sum, per-bucket counts) under one lock acquisition —
+        the structured form the OpenMetrics renderer needs to emit
+        cumulative ``_bucket`` series."""
+        with self._lock:
+            return self.count, self.total, list(self.buckets)
+
 
 class MetricsRegistry:
     def __init__(self):
@@ -144,6 +151,26 @@ class MetricsRegistry:
             rows += h.rows()
         return sorted(rows)
 
+    def export(self) -> Dict[str, Dict]:
+        """Typed snapshot keeping the instrument kinds apart — the
+        OpenMetrics exposition (obs/openmetrics.py) needs to know
+        counter from gauge from histogram, which the flat ``snapshot``
+        rows erase."""
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            histograms = list(self._histograms.values())
+        out: Dict[str, Dict] = {
+            "counters": {c.name: c.value for c in counters},
+            "gauges": {g.name: g.value for g in gauges},
+            "histograms": {},
+        }
+        for h in histograms:
+            count, total, buckets = h.snapshot_raw()
+            out["histograms"][h.name] = {
+                "count": count, "sum": total, "buckets": buckets}
+        return out
+
     def reset(self) -> None:
         """Tests only: drop every instrument (pre-registered names are
         re-created by re-importing callers on demand)."""
@@ -182,8 +209,17 @@ def _preregister(reg: MetricsRegistry) -> None:
         # failure — alerting keys on tasks.failed alone)
         "tasks.started", "tasks.finished", "tasks.failed",
         "tasks.aborted",
+        # memory plane: cluster low-memory killer victims
+        "memory.query_killed",
     ):
         reg.counter(name)
+    for name in (
+        # HBM pool accounting (memory.wire_pool_gauges attaches the
+        # sampling callbacks to the active MemoryPool)
+        "memory.pool_reserved_bytes", "memory.pool_peak_bytes",
+        "memory.pool_limit_bytes", "memory.pool_queries",
+    ):
+        reg.gauge(name)
     for name in ("query.execution_ms", "xla.compile_ms"):
         reg.histogram(name)
 

@@ -1,0 +1,119 @@
+"""OpenMetrics text exposition for the process metrics registry.
+
+Reference analogs: the Prometheus/OpenMetrics pull model the reference
+exposes through its JMX exporter sidecars, and the collection design of
+Google's Monarch (pull exposition + fixed-bucket distributions so the
+collection path never allocates per label).  ``render()`` turns
+``obs.METRICS`` into spec-valid OpenMetrics 1.0 text:
+
+- catalog names are dotted (``query.started``); exposition names map
+  ``[^a-zA-Z0-9_:]`` to ``_`` (``query_started``),
+- counters expose as ``<name>_total``,
+- the log2 histograms expose as CUMULATIVE ``_bucket{le="2^k"}`` series
+  plus ``_sum``/``_count`` (the last bucket is clamped at 2^31, so the
+  final finite ``le`` equals ``_count`` and ``+Inf`` adds nothing new —
+  monotonicity holds by construction),
+- the body ends with ``# EOF`` as the spec requires.
+
+``json_form()`` is the machine-to-machine twin: the coordinator polls
+it from every worker (``GET /v1/metrics?format=json``) to grow
+``system_metrics`` a ``node`` column with a cluster-wide rollup.
+
+This module must stay importable from anywhere (obs is the bottom of
+the dependency stack): it imports only the sibling registry.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Tuple
+
+from presto_tpu.obs.metrics import METRICS, Histogram, MetricsRegistry
+
+#: the content type OpenMetrics scrapers negotiate
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def metric_name(name: str) -> str:
+    """Catalog name -> OpenMetrics metric name (``query.started`` ->
+    ``query_started``); a leading digit gets an underscore prefix."""
+    out = _NAME_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the spec: backslash, double-quote and
+    newline must be escaped inside the quotes."""
+    return (value.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return format(float(v), ".10g")
+
+
+def render(registry: MetricsRegistry = None) -> str:
+    """The OpenMetrics text body for ``GET /v1/metrics``."""
+    reg = registry if registry is not None else METRICS
+    ex = reg.export()
+    lines: List[str] = []
+    for name in sorted(ex["counters"]):
+        # family names must not carry the reserved _total suffix; the
+        # catalog's *_seconds_total style names keep their sample name
+        # (family query_planning_seconds -> sample ..._seconds_total)
+        m = metric_name(name)
+        if m.endswith("_total"):
+            m = m[: -len("_total")]
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m}_total {_fmt(ex['counters'][name])}")
+    for name in sorted(ex["gauges"]):
+        m = metric_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(ex['gauges'][name])}")
+    for name in sorted(ex["histograms"]):
+        h = ex["histograms"][name]
+        m = metric_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for k, n in enumerate(h["buckets"]):
+            cum += n
+            lines.append(f'{m}_bucket{{le="{1 << k}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{m}_sum {_fmt(h['sum'])}")
+        lines.append(f"{m}_count {h['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def json_form(node: str, registry: MetricsRegistry = None) -> Dict:
+    """The poll form the coordinator aggregates: flat (name, value)
+    rows in the system_metrics dialect, stamped with this node's id."""
+    reg = registry if registry is not None else METRICS
+    return {"node": node, "metrics": [[n, float(v)]
+                                      for n, v in reg.snapshot()]}
+
+
+def merge_rows(
+    per_node: Dict[str, List[Tuple[str, float]]]
+) -> List[Tuple[str, float]]:
+    """Cluster rollup: sum each metric over the nodes (counters and
+    histogram rows sum exactly; gauge sums read as cluster totals —
+    e.g. total reserved HBM)."""
+    total: Dict[str, float] = {}
+    for rows in per_node.values():
+        for name, value in rows:
+            v = float(value)
+            if math.isnan(v):
+                continue  # an unwired gauge must not poison the sum
+            total[name] = total.get(name, 0.0) + v
+    return sorted(total.items())

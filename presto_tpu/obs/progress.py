@@ -1,0 +1,209 @@
+"""Live per-query progress: stage split counts + a monotone percentage.
+
+Reference analog: the driver/split counters behind Presto's
+``StatementStats.progressPercentage`` (``QueryStats.java``'s
+completedDrivers/totalDrivers) — the coordinator derives a 0..100
+figure from per-stage splits-done/total, and every surface (statement
+protocol, CLI progress line, web UI) reads the same numbers.
+
+Publication mirrors the tracer's design: execution code calls
+``current_progress()`` (one thread-local read; ``None`` when nothing
+was registered — queries outside the runner lifecycle cost nothing)
+and updates the active :class:`QueryProgress`.  A process-wide bounded
+registry keyed by query id serves readers (the statement protocol's
+page responses, ``GET /v1/query/<id>/progress``).
+
+Monotonicity contract: :meth:`QueryProgress.percentage` NEVER
+decreases — stages appear dynamically (a scan discovered mid-query
+adds a denominator), so the raw ratio can dip; the reported figure is
+the running maximum, pinned to 100 only when the query reaches a
+terminal state.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class StageProgress:
+    __slots__ = ("name", "splits_total", "splits_done", "rows", "bytes",
+                 "state")
+
+    def __init__(self, name: str, splits_total: Optional[int] = None):
+        self.name = name
+        self.splits_total = splits_total
+        self.splits_done = 0
+        self.rows = 0
+        self.bytes = 0
+        self.state = "RUNNING"
+
+    def snapshot(self) -> Dict:
+        return {
+            "stage": self.name,
+            "state": self.state,
+            "splitsDone": self.splits_done,
+            "splitsTotal": self.splits_total,
+            "rows": self.rows,
+            "bytes": self.bytes,
+        }
+
+
+class QueryProgress:
+    """One query's stage table + the monotone completion percentage."""
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._stages: "collections.OrderedDict[str, StageProgress]" = (
+            collections.OrderedDict())
+        self._max_pct = 0.0
+        self._done = False
+        self._seq = 0
+
+    # -- writers --------------------------------------------------------
+    def stage(self, name: str,
+              splits_total: Optional[int] = None) -> StageProgress:
+        """Get-or-create a stage entry.  Passing ``splits_total`` for an
+        existing stage RESETS its counters: a capacity retry re-runs the
+        stage from split zero, and stale done-counts would overshoot."""
+        with self._lock:
+            st = self._stages.get(name)
+            if st is None:
+                st = self._stages[name] = StageProgress(name, splits_total)
+            elif splits_total is not None:
+                st.splits_total = splits_total
+                st.splits_done = 0
+                st.rows = 0
+                st.bytes = 0
+                st.state = "RUNNING"
+            return st
+
+    def new_stage_name(self, prefix: str) -> str:
+        """Unique stage key for dynamically discovered stages
+        (``mh:chain#0``, ``dist:aggregation#2``...)."""
+        with self._lock:
+            n = self._seq
+            self._seq += 1
+        return f"{prefix}#{n}"
+
+    def split_done(self, name: str, rows: int = 0, nbytes: int = 0,
+                   n: int = 1) -> None:
+        with self._lock:
+            st = self._stages.get(name)
+            if st is None:
+                st = self._stages[name] = StageProgress(name)
+            st.splits_done += int(n)
+            st.rows += int(rows)
+            st.bytes += int(nbytes)
+
+    def finish_stage(self, name: str) -> None:
+        with self._lock:
+            st = self._stages.get(name)
+            if st is not None:
+                st.state = "FINISHED"
+                if st.splits_total is None:
+                    st.splits_total = st.splits_done
+                st.splits_done = max(st.splits_done, st.splits_total or 0)
+
+    def mark_done(self) -> None:
+        """Terminal: the query finished (or failed/was killed) — the
+        percentage pins to 100 and every open stage closes."""
+        with self._lock:
+            self._done = True
+            for st in self._stages.values():
+                if st.state == "RUNNING":
+                    st.state = "FINISHED"
+                    if st.splits_total is None:
+                        st.splits_total = st.splits_done
+
+    # -- readers --------------------------------------------------------
+    def percentage(self) -> float:
+        """0..100, never decreasing (running maximum; see module doc)."""
+        with self._lock:
+            if self._done:
+                self._max_pct = 100.0
+                return 100.0
+            ratios: List[float] = []
+            for st in self._stages.values():
+                if st.state == "FINISHED":
+                    ratios.append(1.0)
+                elif st.splits_total:
+                    ratios.append(min(st.splits_done / st.splits_total, 1.0))
+                else:
+                    ratios.append(0.0)
+            # cap at 99.9 while live: only mark_done may report 100
+            pct = min(99.9, 100.0 * sum(ratios) / len(ratios)) if ratios \
+                else 0.0
+            self._max_pct = max(self._max_pct, pct)
+            return round(self._max_pct, 1)
+
+    def snapshot(self) -> Dict:
+        pct = self.percentage()
+        with self._lock:
+            stages = [st.snapshot() for st in self._stages.values()]
+            done = self._done
+        return {
+            "queryId": self.query_id,
+            "done": done,
+            "progressPercentage": pct,
+            "elapsedMs": round((time.perf_counter() - self.t0) * 1e3, 1),
+            "stages": stages,
+        }
+
+
+# ---------------------------------------------------------------------------
+# process registry + thread-local activation (mirrors obs/trace.py)
+# ---------------------------------------------------------------------------
+
+_REGISTRY_MAX = 256
+_REGISTRY: "collections.OrderedDict[str, QueryProgress]" = (
+    collections.OrderedDict())
+_REGISTRY_LOCK = threading.Lock()
+
+_ACTIVE = threading.local()
+
+
+def register_progress(progress: QueryProgress) -> QueryProgress:
+    with _REGISTRY_LOCK:
+        _REGISTRY[progress.query_id] = progress
+        _REGISTRY.move_to_end(progress.query_id)
+        while len(_REGISTRY) > _REGISTRY_MAX:
+            _REGISTRY.popitem(last=False)
+    return progress
+
+
+def progress_for(query_id: str) -> Optional[QueryProgress]:
+    with _REGISTRY_LOCK:
+        return _REGISTRY.get(query_id)
+
+
+def current_progress() -> Optional[QueryProgress]:
+    return getattr(_ACTIVE, "progress", None)
+
+
+class _Activation:
+    __slots__ = ("_progress", "_prev")
+
+    def __init__(self, progress: Optional[QueryProgress]):
+        self._progress = progress
+
+    def __enter__(self):
+        self._prev = getattr(_ACTIVE, "progress", None)
+        if self._progress is not None:
+            _ACTIVE.progress = self._progress
+        return self._progress
+
+    def __exit__(self, *exc):
+        if self._progress is not None:
+            _ACTIVE.progress = self._prev
+        return False
+
+
+def publishing(progress: Optional[QueryProgress]) -> _Activation:
+    """Bind a progress object to the current thread (``None`` = no-op),
+    exactly like ``obs.tracing``."""
+    return _Activation(progress)

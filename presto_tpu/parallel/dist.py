@@ -317,12 +317,31 @@ class DistributedRunner:
         self._stage_runner._builds.clear()
         self._sharded_builds.clear()
 
+        # live progress: one entry per mesh stage as the scheduler
+        # launches it (stage-level; the scans inside each stage publish
+        # their own splits-done/total through the local runner)
+        from presto_tpu.obs import current_progress
+
+        prog = current_progress()
+
+        def _staged(prefix, run):
+            if prog is None:
+                return run()
+            name = prog.new_stage_name(prefix)
+            prog.stage(name, splits_total=1)
+            page = run()
+            prog.split_done(name)
+            prog.finish_stage(name)
+            return page
+
         def run_agg(node: AggregationNode) -> PrecomputedNode:
-            page = self.run_aggregation_stage(node)
+            page = _staged("dist:aggregation",
+                           lambda: self.run_aggregation_stage(node))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_chain(node: PlanNode, bound=None) -> PrecomputedNode:
-            page = self.run_chain_stage(node, bound)
+            page = _staged("dist:chain",
+                           lambda: self.run_chain_stage(node, bound))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def eval_glue(node: PlanNode) -> PrecomputedNode:

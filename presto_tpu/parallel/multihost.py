@@ -957,6 +957,17 @@ class MultiHostRunner:
 
         conn = self.catalog.connector(scan.handle.connector_name)
         n_splits = scan.handle.num_splits
+        # live progress: the DCN fan-out is the long pole of a
+        # multi-host query — publish splits-done/total as worker tasks
+        # land (the stage scheduler's completedDrivers analog)
+        from presto_tpu.obs import current_progress
+
+        prog = current_progress()
+        prog_stage = None
+        if prog is not None:
+            prog_stage = prog.new_stage_name(
+                f"mh:{scan.handle.table}")
+            prog.stage(prog_stage, splits_total=n_splits)
         preferred = None
         if hasattr(conn, "split_location"):
             preferred = {s: conn.split_location(scan.handle.table, s)
@@ -993,6 +1004,9 @@ class MultiHostRunner:
                 raws = w.run_fragment(fragment)
                 with lock:
                     results.extend(raws)
+                if prog is not None:
+                    prog.split_done(prog_stage, n=len(splits),
+                                    nbytes=sum(len(r) for r in raws))
             except ConnectionError:
                 with lock:
                     failed.append((w, splits))
@@ -1025,6 +1039,8 @@ class MultiHostRunner:
         if errors:
             raise errors[0]
 
+        if prog is not None:
+            prog.finish_stage(prog_stage)
         return [deserialize_page(r, dictionaries) for r in results]
 
 

@@ -586,13 +586,15 @@ class OutputNode(PlanNode):
 
 
 def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
-                  exclusive=None) -> str:
+                  exclusive=None, mem=None) -> str:
     """EXPLAIN-style rendering (planPrinter/PlanPrinter.java analog);
     pass the executor's QueryStats for EXPLAIN ANALYZE annotations and a
     planner StatsCalculator for cost estimates ({rows: N} like the
     reference's estimate lines).  ``exclusive`` maps chain-member nodes
     to per-operator EXCLUSIVE seconds (EXPLAIN ANALYZE VERBOSE — fused
-    chains re-run prefix-by-prefix; OperatorStats.java:38 analog)."""
+    chains re-run prefix-by-prefix; OperatorStats.java:38 analog).
+    ``mem`` maps ``id(node)`` to peak reserved bytes from the tagged
+    memory reservations (EXPLAIN ANALYZE per-operator memory)."""
     if estimator is None and stats is None and indent == 0:
         from presto_tpu.planner.stats import StatsCalculator
 
@@ -617,6 +619,11 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
     ann = stats.annotation(node) if stats is not None else ""
     if exclusive is not None and node in exclusive:
         ann += f"  [excl={exclusive[node] * 1e3:.1f}ms]"
+    if mem is not None and id(node) in mem:
+        nbytes = mem[id(node)]
+        human = (f"{nbytes / 1e6:.1f}MB" if nbytes >= 1e6
+                 else f"{nbytes / 1e3:.1f}kB")
+        ann += f"  [peak_mem={human}]"
     if estimator is not None:
         try:
             ann += "  {rows: %d}" % int(estimator.rows(node))
@@ -624,5 +631,5 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
             pass
     out = f"{pad}- {name}{detail}{ann}\n"
     for s in node.sources:
-        out += plan_tree_str(s, indent + 1, stats, estimator, exclusive)
+        out += plan_tree_str(s, indent + 1, stats, estimator, exclusive, mem)
     return out

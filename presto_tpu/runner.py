@@ -181,11 +181,15 @@ class QueryRunner:
             t0 = time.time()
             obs.METRICS.counter("query.started").inc()
             obs.TASKS.start(qid, "local", trace_token=trace)
+            # live progress: always registered (the statement protocol,
+            # CLI and UI read it) — publication is one thread-local
+            # read per split when nothing else is active
+            progress = obs.register_progress(obs.QueryProgress(qid))
             self.events.query_created(
                 QueryCreatedEvent(qid, sql, self.session.user, t0, trace_token=trace)
             )
             planning_s: Optional[float] = None
-            with obs.tracing(tracer):
+            with obs.tracing(tracer), obs.publishing(progress):
                 try:
                     t1 = time.perf_counter()
                     with obs.span("plan", cat="lifecycle"):
@@ -198,6 +202,7 @@ class QueryRunner:
                     execution_s = time.perf_counter() - t1
                 except Exception as e:
                     obs.METRICS.counter("query.failed").inc()
+                    progress.mark_done()
                     err = f"{type(e).__name__}: {e}"
                     obs.TASKS.finish(qid, "FAILED", error=err)
                     self._finalize_trace(tracer, t_q0)
@@ -207,6 +212,7 @@ class QueryRunner:
                         planning_ms=self._ms(planning_s),
                     ))
                     raise
+            progress.mark_done()
             compile_ms = (round(tracer.total_s("xla_compile") * 1e3, 3)
                           if tracer is not None else None)
             obs.METRICS.counter("query.finished").inc()

@@ -34,13 +34,15 @@ PAGE_ROWS = 1000
 
 # Minimal cluster console (the reference serves a React app from
 # presto-main/src/main/resources/webapp/; this single inline page covers
-# the same first-stop view — cluster tiles + live query list — from the
-# same REST resources).
+# the same first-stop view — cluster tiles + live query list + a
+# per-query detail view (stage progress table + span timeline) — from
+# the same REST resources).
 _UI_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>presto-tpu</title>
 <style>
  body{font-family:system-ui,sans-serif;margin:2rem;background:#16181d;color:#e8e8e8}
- h1{font-size:1.3rem} .tiles{display:flex;gap:1rem;margin:1rem 0}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;color:#9aa0ab}
+ .tiles{display:flex;gap:1rem;margin:1rem 0}
  .tile{background:#23262e;border-radius:8px;padding:1rem 1.5rem;min-width:8rem}
  .tile .v{font-size:1.8rem;font-weight:600} .tile .l{color:#9aa0ab;font-size:.8rem}
  table{border-collapse:collapse;width:100%;margin-top:1rem}
@@ -49,12 +51,23 @@ _UI_HTML = """<!doctype html>
  .FINISHED{color:#6fcf97}.RUNNING{color:#56ccf2}.FAILED,.CANCELED{color:#eb5757}
  .QUEUED{color:#f2c94c} td.q{font-family:ui-monospace,monospace;max-width:40rem;
  overflow:hidden;text-overflow:ellipsis;white-space:nowrap}
+ tr.row{cursor:pointer} tr.row:hover{background:#1c1f26}
+ #detail{display:none;background:#23262e;border-radius:8px;padding:1rem 1.5rem;margin:1rem 0}
+ .lane{position:relative;height:18px;margin:2px 0;background:#1a1d23}
+ .sp{position:absolute;height:14px;top:2px;background:#56ccf2;border-radius:2px;
+  overflow:hidden;font-size:.65rem;color:#0b0d10;white-space:nowrap;padding:0 2px}
+ .sp.lifecycle{background:#6fcf97}.sp.compile{background:#f2c94c}
+ .sp.exchange{background:#bb6bd9}.sp.device{background:#eb5757}
+ .bar{background:#1a1d23;border-radius:4px;height:8px;margin-top:2px}
+ .bar>div{background:#56ccf2;border-radius:4px;height:8px}
 </style></head><body>
 <h1>presto-tpu cluster console</h1>
 <div class="tiles" id="tiles"></div>
-<table><thead><tr><th>query id</th><th>state</th><th>rows</th><th>sql</th></tr></thead>
+<div id="detail"></div>
+<table><thead><tr><th>query id</th><th>state</th><th>progress</th><th>rows</th><th>sql</th></tr></thead>
 <tbody id="queries"></tbody></table>
 <script>
+let selected = null;
 async function refresh(){
   const c = await (await fetch('/v1/cluster')).json();
   document.getElementById('tiles').innerHTML =
@@ -63,7 +76,53 @@ async function refresh(){
     + (c.totalBytes?`<div class="tile"><div class="v">${(100*c.reservedBytes/c.totalBytes).toFixed(1)}%</div><div class="l">pool reserved</div></div>`:'');
   const qs = await (await fetch('/v1/query')).json();
   document.getElementById('queries').innerHTML = qs.reverse().map(q=>
-    `<tr><td>${q.id}</td><td class="${q.state}">${q.state}</td><td>${q.rows}</td><td class="q">${q.query.replace(/</g,'&lt;')}</td></tr>`).join('');
+    `<tr class="row" onclick="select('${q.id}')"><td>${q.id}</td>`+
+    `<td class="${q.state}">${q.state}</td>`+
+    `<td>${q.progress==null?'':q.progress.toFixed(0)+'%'}</td>`+
+    `<td>${q.rows}</td><td class="q">${q.query.replace(/</g,'&lt;')}</td></tr>`).join('');
+  if (selected) detail(selected);
+}
+function select(id){ selected = (selected===id)?null:id; detail(selected); }
+async function detail(id){
+  const box = document.getElementById('detail');
+  if (!id){ box.style.display='none'; return; }
+  let html = `<h2>query ${id}</h2>`;
+  const pr = await fetch(`/v1/query/${id}/progress`);
+  if (pr.ok){
+    const p = await pr.json();
+    html += `<div>progress ${p.progressPercentage}% · ${p.elapsedMs}ms</div>`;
+    html += '<table><thead><tr><th>stage</th><th>state</th><th>splits</th>'+
+            '<th>rows</th><th>bytes</th><th></th></tr></thead><tbody>';
+    for (const s of p.stages){
+      const tot = s.splitsTotal, pct = tot?100*s.splitsDone/tot:0;
+      html += `<tr><td>${s.stage}</td><td class="${s.state}">${s.state}</td>`+
+        `<td>${s.splitsDone}/${tot??'?'}</td><td>${s.rows}</td><td>${s.bytes}</td>`+
+        `<td style="min-width:8rem"><div class="bar"><div style="width:${pct.toFixed(0)}%"></div></div></td></tr>`;
+    }
+    html += '</tbody></table>';
+  }
+  const tr = await fetch(`/v1/query/${id}/trace`);
+  if (tr.ok){
+    // span timeline from the trace registry: top spans by duration,
+    // one lane per thread, scaled to the trace extent
+    const t = await tr.json();
+    const evs = t.traceEvents.filter(e=>e.ph==='X');
+    if (evs.length){
+      const end = Math.max(...evs.map(e=>e.ts+e.dur));
+      const top = evs.sort((a,b)=>b.dur-a.dur).slice(0,60);
+      const tids = [...new Set(top.map(e=>e.tid))];
+      html += `<h2>span timeline (${evs.length} spans, ${(end/1000).toFixed(1)}ms)</h2>`;
+      for (const tid of tids){
+        html += '<div class="lane">' + top.filter(e=>e.tid===tid).map(e=>
+          `<div class="sp ${e.cat}" title="${e.name} ${(e.dur/1000).toFixed(2)}ms"`+
+          ` style="left:${(100*e.ts/end).toFixed(2)}%;width:${Math.max(100*e.dur/end,.3).toFixed(2)}%">${e.name}</div>`
+        ).join('') + '</div>';
+      }
+    }
+  } else if (!pr.ok) {
+    html += '<div>no progress or trace recorded for this query</div>';
+  }
+  box.innerHTML = html; box.style.display='block';
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -96,11 +155,17 @@ class _QueryState:
         self.trace_token: Optional[str] = None
 
     def summary(self) -> dict:
+        from presto_tpu import obs
+
+        prog = obs.progress_for(self.id)
         return {
             "id": self.id,
             "query": self.sql,
             "state": self.state,
             "rows": len(self.rows),
+            "progress": (100.0 if self.state == "FINISHED"
+                         else prog.percentage() if prog is not None
+                         else None),
         }
 
 
@@ -121,6 +186,7 @@ class CoordinatorServer:
         self.runner = runner
         self.queries: Dict[str, _QueryState] = {}
         self.resource_groups = resource_groups or ResourceGroupManager()
+        self.worker_uris = list(worker_uris)
         self._lock = threading.Lock()
         # cluster-wide OOM protection (memory/ClusterMemoryManager.java:88):
         # polls local + worker pools, kills the biggest reserver at the
@@ -129,10 +195,25 @@ class CoordinatorServer:
         pool = getattr(runner.executor, "memory_pool", None)
         if pool is not None:
             from presto_tpu.cluster_memory import ClusterMemoryManager
+            from presto_tpu.memory import wire_pool_gauges
 
+            wire_pool_gauges(pool)
             self.memory_manager = ClusterMemoryManager(
                 pool, self._kill_query, worker_uris=worker_uris,
-                threshold=memory_threshold)
+                threshold=memory_threshold, events=runner.events)
+        # cluster fan-in wiring: any SystemConnector already registered
+        # in this runner's catalog gets the coordinator's worker polls,
+        # so system_metrics grows its per-node rows + cluster rollup
+        # and system_memory_pools covers the fleet without the caller
+        # wiring callbacks by hand (explicitly injected ones win)
+        from presto_tpu.connectors.system import SystemConnector
+
+        for conn in runner.catalog._connectors.values():
+            if isinstance(conn, SystemConnector):
+                if conn.remote_metrics is None:
+                    conn.remote_metrics = self.remote_metrics
+                if conn.pools is None:
+                    conn.pools = self.memory_pool_rows
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -198,11 +279,36 @@ class CoordinatorServer:
                 q = outer._submit(
                     sql,
                     trace_token=self.headers.get("X-Presto-Trace-Token"))
-                q.done.wait(timeout=600)
+                # X-Presto-Async: the reference protocol's real shape —
+                # return immediately with state + progress; the client
+                # polls nextUri until the state is terminal.  Without
+                # the header the legacy blocking behavior is kept.
+                if self.headers.get("X-Presto-Async"):
+                    q.done.wait(timeout=0.05)  # fast queries: one page
+                else:
+                    q.done.wait(timeout=600)
                 self._json(200, outer._page_response(q, 0))
 
             def do_GET(self):
-                parts = [p for p in self.path.split("/") if p]
+                parts = [p for p in self.path.split("/")
+                         if p and not p.startswith("?")]
+                if parts and parts[-1].split("?")[0] == "metrics" \
+                        and parts[0] == "v1" and len(parts) == 2:
+                    # OpenMetrics exposition (Prometheus scrape target);
+                    # ?format=json serves the machine-to-machine form
+                    from presto_tpu.obs import openmetrics
+
+                    if "format=json" in self.path:
+                        self._json(200, openmetrics.json_form("local"))
+                    else:
+                        body = openmetrics.render().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         openmetrics.CONTENT_TYPE)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    return
                 if parts == ["v1", "info"]:
                     self._json(200, {
                         "nodeVersion": {"version": __version__},
@@ -219,6 +325,19 @@ class CoordinatorServer:
                     return
                 if parts in ([], ["ui"]):
                     self._html(200, _UI_HTML)
+                    return
+                if len(parts) == 4 and parts[:2] == ["v1", "query"] \
+                        and parts[3] == "progress":
+                    # live stage table + monotone percentage for the
+                    # web UI's detail view and external pollers
+                    from presto_tpu import obs
+
+                    prog = obs.progress_for(parts[2])
+                    if prog is None:
+                        self._json(404, {"error": "no progress for query "
+                                                  f"{parts[2]}"})
+                        return
+                    self._json(200, prog.snapshot())
                     return
                 if len(parts) == 4 and parts[:2] == ["v1", "query"] \
                         and parts[3] == "trace":
@@ -240,6 +359,11 @@ class CoordinatorServer:
                     if q is None:
                         self._json(404, {"error": "unknown query"})
                         return
+                    # async pollers re-fetch the same token while the
+                    # query runs; a short wait turns a hot poll loop
+                    # into a long-poll without delaying finished pages
+                    if not q.done.is_set():
+                        q.done.wait(timeout=0.3)
                     self._json(200, outer._page_response(q, token))
                     return
                 self._json(404, {"error": "not found"})
@@ -400,8 +524,27 @@ class CoordinatorServer:
             out["stats"]["compileMs"] = q.compile_ms
         if q.execution_ms is not None:
             out["stats"]["executionMs"] = q.execution_ms
+        # Presto-style live progress (StatementStats.progressPercentage
+        # + a per-stage split table).  Monotone by construction: the
+        # progress object reports a running maximum, and a FINISHED
+        # query always reads 100.
+        from presto_tpu import obs
+
+        prog = obs.progress_for(q.id)
+        if q.state == "FINISHED":
+            out["stats"]["progressPercentage"] = 100.0
+        elif prog is not None:
+            out["stats"]["progressPercentage"] = prog.percentage()
+        if prog is not None:
+            snap = prog.snapshot()
+            out["stats"]["stages"] = snap["stages"]
+            out["stats"]["elapsedMs"] = snap["elapsedMs"]
         if q.error:
             out["error"] = q.error
+            return out
+        if q.state in ("QUEUED", "RUNNING"):
+            # async page: no data yet — the client re-polls this token
+            out["nextUri"] = f"{self.uri}/v1/statement/{q.id}/{token}"
             return out
         start = token * PAGE_ROWS
         chunk = q.rows[start : start + PAGE_ROWS]
@@ -409,3 +552,71 @@ class CoordinatorServer:
         if start + PAGE_ROWS < len(q.rows):
             out["nextUri"] = f"{self.uri}/v1/statement/{q.id}/{token + 1}"
         return out
+
+    # ------------------------------------------------------------------
+    def remote_metrics(self) -> Dict[str, List]:
+        """Poll every worker's ``/v1/metrics?format=json`` concurrently
+        (RemoteNodeMemory's poll pattern) — the fan-in behind
+        system_metrics' per-node rows and cluster rollup."""
+        import json as _json
+        import urllib.request
+
+        out: Dict[str, List] = {}
+        lock = threading.Lock()
+
+        def poll(uri):
+            try:
+                with urllib.request.urlopen(
+                        f"{uri}/v1/metrics?format=json", timeout=2.0) as r:
+                    payload = _json.load(r)
+                with lock:
+                    out[payload.get("node") or uri] = [
+                        (n, float(v)) for n, v in payload.get("metrics", [])]
+            except Exception:
+                pass  # dead workers are the failure detector's job
+
+        threads = [threading.Thread(target=poll, args=(u,), daemon=True)
+                   for u in self.worker_uris]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=2.5)
+        return out
+
+    def memory_pool_rows(self) -> List[dict]:
+        """system_memory_pools rows for this cluster: the local pool +
+        every worker's ``/v1/info`` memory section."""
+        import json as _json
+        import urllib.request
+
+        from presto_tpu.connectors.system import pool_row
+
+        rows: List[dict] = []
+        pool = getattr(self.runner.executor, "memory_pool", None)
+        if pool is not None:
+            rows.append(pool_row("local", pool))
+        lock = threading.Lock()
+
+        def poll(uri):
+            try:
+                with urllib.request.urlopen(f"{uri}/v1/info",
+                                            timeout=2.0) as r:
+                    mem = (_json.load(r).get("memory") or {})
+                with lock:
+                    rows.append({
+                        "node": uri,
+                        "reserved": int(mem.get("reserved", 0)),
+                        "peak": int(mem.get("peak", 0)),
+                        "limit": int(mem.get("limit", 0)),
+                        "queries": len(mem.get("query_reservations") or {}),
+                    })
+            except Exception:
+                pass
+
+        threads = [threading.Thread(target=poll, args=(u,), daemon=True)
+                   for u in self.worker_uris]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=2.5)
+        return rows

@@ -127,9 +127,28 @@ class WorkerServer:
                             "tasks": outer.tasks_executed}
                     pool = outer.runner.memory_pool
                     if pool is not None:
-                        info["memory"] = {"reserved": pool.reserved,
-                                          "limit": pool.limit}
+                        # per-query tagged breakdown rides along so the
+                        # coordinator's killer decisions are reproducible
+                        # from scraped data alone (not just pool totals)
+                        from presto_tpu.cluster_memory import query_reservations
+
+                        info["memory"] = {
+                            "reserved": pool.reserved,
+                            "peak": pool.peak,
+                            "limit": pool.limit,
+                            "query_reservations": query_reservations(pool),
+                        }
                     self._send(200, json.dumps(info).encode())
+                    return
+                if self.path.split("?")[0] == "/v1/metrics":
+                    from presto_tpu.obs import openmetrics
+
+                    if "format=json" in self.path:
+                        self._send(200, json.dumps(openmetrics.json_form(
+                            outer.node_id)).encode())
+                    else:
+                        self._send(200, openmetrics.render().encode(),
+                                   ctype=openmetrics.CONTENT_TYPE)
                     return
                 m = _RESULTS_RE.match(self.path.split("?")[0])
                 if m:
@@ -241,6 +260,17 @@ class WorkerServer:
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
+        # node identity for the metrics plane (the coordinator labels
+        # this worker's system_metrics rows with it).  Hostname-
+        # qualified: two containers both on :8080 must not collapse
+        # into one rollup key
+        import socket
+
+        self.node_id = f"worker-{socket.gethostname()}-{self.port}"
+        if memory_pool is not None:
+            from presto_tpu.memory import wire_pool_gauges
+
+            wire_pool_gauges(memory_pool)
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,442 @@
+"""Cluster metrics plane: OpenMetrics exposition, HBM/memory
+accounting surfaces, and live query progress (ISSUE 4).
+
+Covers: spec-valid ``GET /v1/metrics`` on coordinator AND worker
+(names, label escaping, cumulative bucket monotonicity via a line
+grammar), the ``system_metrics`` node column + cluster rollup,
+``system_memory_pools`` nonzero reservations, the low-memory-kill
+counter + query-log event line, EXPLAIN ANALYZE per-operator peak
+memory, and statement-protocol progress monotonicity for TPC-H Q3.
+"""
+
+import json
+import re
+import sys
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu import obs
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.system import QueryHistory, SystemConnector
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.memory import MemoryPool, QueryMemoryContext
+from presto_tpu.runner import QueryRunner
+
+from tests.tpch_queries import QUERIES
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def make_runner(sf=0.001, split_rows=4096):
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=sf, split_rows=split_rows))
+    history = QueryHistory()
+    runner = QueryRunner(catalog)
+    catalog.register("system", SystemConnector(history))
+    runner.events.add(history)
+    return runner, history
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics grammar validation
+# ---------------------------------------------------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABELS = r"\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\"" \
+          r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*\}"
+_VALUE = r"(?:[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|Inf)|NaN)"
+_SAMPLE_RE = re.compile(rf"^({_NAME})({_LABELS})? {_VALUE}$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram)$")
+_LE_RE = re.compile(r'le="([^"]*)"')
+
+
+def validate_openmetrics(text: str) -> dict:
+    """Line-grammar check for an OpenMetrics body; returns
+    {family: type}.  Asserts on: name charset, sample/label shape,
+    samples belonging to a declared family, counter ``_total`` suffix,
+    cumulative bucket monotonicity and ``+Inf == _count``."""
+    assert text.endswith("# EOF\n"), "body must end with # EOF"
+    families = {}
+    buckets = {}  # family -> [(le, value)]
+    counts = {}
+    for line in text.splitlines():
+        if line == "# EOF":
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            assert m, f"bad metadata line: {line!r}"
+            families[m.group(1)] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"bad sample line: {line!r}"
+        sample, labels = m.group(1), m.group(2)
+        fam = next((f for f in families
+                    if sample == f or (sample.startswith(f)
+                                       and sample[len(f):] in
+                                       ("_total", "_sum", "_count",
+                                        "_bucket"))), None)
+        assert fam is not None, f"sample {sample!r} has no # TYPE family"
+        kind = families[fam]
+        value = float(line.rsplit(" ", 1)[1])
+        if kind == "counter":
+            assert sample == f"{fam}_total", \
+                f"counter sample {sample!r} must end _total"
+            assert value >= 0
+        if kind == "histogram" and sample == f"{fam}_bucket":
+            le = _LE_RE.search(labels or "")
+            assert le, f"bucket sample without le label: {line!r}"
+            buckets.setdefault(fam, []).append((le.group(1), value))
+        if kind == "histogram" and sample == f"{fam}_count":
+            counts[fam] = value
+    for fam, series in buckets.items():
+        values = [v for _, v in series]
+        assert values == sorted(values), \
+            f"{fam} buckets not cumulative-monotone: {series}"
+        assert series[-1][0] == "+Inf", f"{fam} missing +Inf bucket"
+        assert series[-1][1] == counts.get(fam), \
+            f"{fam} +Inf bucket != _count"
+    return families
+
+
+def test_render_grammar_and_types():
+    reg = obs.MetricsRegistry()
+    reg.counter("query.started").inc(3)
+    reg.counter("dist.stages_total").inc(2)
+    reg.gauge("memory.pool_reserved_bytes").set(123.0)
+    h = reg.histogram("query.execution_ms")
+    for v in (0.5, 3.0, 3.0, 3000.0):
+        h.observe(v)
+    text = obs.openmetrics.render(reg)
+    families = validate_openmetrics(text)
+    assert families["query_started"] == "counter"
+    # catalog names already ending _total don't double the suffix
+    assert families["dist_stages"] == "counter"
+    assert "dist_stages_total 2" in text
+    assert families["memory_pool_reserved_bytes"] == "gauge"
+    assert families["query_execution_ms"] == "histogram"
+    # cumulative: le=1 has the 0.5 sample, le=4 adds both 3.0s
+    assert 'query_execution_ms_bucket{le="1"} 1' in text
+    assert 'query_execution_ms_bucket{le="4"} 3' in text
+    assert 'query_execution_ms_bucket{le="+Inf"} 4' in text
+    assert "query_execution_ms_count 4" in text
+
+
+def test_label_escaping():
+    assert obs.openmetrics.escape_label_value('a"b\\c\nd') \
+        == 'a\\"b\\\\c\\nd'
+    assert obs.openmetrics.metric_name("query.exec-ms/9") == "query_exec_ms_9"
+    assert obs.openmetrics.metric_name("9lives") == "_9lives"
+
+
+def test_live_coordinator_and_worker_expose_openmetrics():
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.server.worker import WorkerServer
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    wrk = WorkerServer(catalog, memory_pool=MemoryPool(1 << 30))
+    wrk.start()
+    runner, _ = make_runner()
+    srv = CoordinatorServer(runner, worker_uris=[wrk.uri])
+    srv.start()
+    try:
+        # move some counters + the execution histogram
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{srv.uri}/v1/statement",
+                data=b"select count(*) from nation", method="POST"),
+                timeout=60) as r:
+            assert json.load(r)["stats"]["state"] == "FINISHED"
+        for uri in (srv.uri, wrk.uri):
+            req = urllib.request.Request(f"{uri}/v1/metrics")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.headers["Content-Type"].startswith(
+                    "application/openmetrics-text")
+                text = r.read().decode()
+            families = validate_openmetrics(text)
+            assert families["query_execution_ms"] == "histogram"
+            assert "query_started_total" in text
+            # JSON twin for machine polling
+            with urllib.request.urlopen(
+                    f"{uri}/v1/metrics?format=json", timeout=10) as r:
+                doc = json.load(r)
+            assert doc["node"]
+            names = {n for n, _ in doc["metrics"]}
+            assert "query.started" in names
+        # the query moved the coordinator-side histogram
+        cm = dict(obs.METRICS.snapshot())
+        assert cm["query.execution_ms.count"] >= 1
+        # the coordinator auto-wired its worker polls into the runner's
+        # SystemConnector: SQL sees the worker node + cluster rollup
+        res = runner.execute(
+            "select node from system_metrics"
+            " where name = 'query.started'")
+        nodes = {r[0] for r in res.rows}
+        assert "cluster" in nodes and "local" in nodes
+        assert any(n.startswith("worker-") for n in nodes), nodes
+        # ...and system_memory_pools covers the worker's pool too
+        res = runner.execute(
+            "select node, limit_bytes from system_memory_pools")
+        assert any(limit == (1 << 30) for _, limit in res.rows), res.rows
+    finally:
+        srv.stop()
+        wrk.stop()
+
+
+# ---------------------------------------------------------------------------
+# memory accounting surfaces
+# ---------------------------------------------------------------------------
+
+def test_worker_info_reports_per_query_breakdown():
+    from presto_tpu.server.worker import WorkerServer
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    pool = MemoryPool(1 << 30)
+    wrk = WorkerServer(catalog, memory_pool=pool)
+    wrk.start()
+    try:
+        ctx = QueryMemoryContext(pool, "q_breakdown")
+        ctx.reserve("join_build", 4096)
+        with urllib.request.urlopen(f"{wrk.uri}/v1/info", timeout=10) as r:
+            info = json.load(r)
+        mem = info["memory"]
+        assert mem["reserved"] >= 4096
+        assert mem["limit"] == 1 << 30
+        assert mem["peak"] >= 4096
+        # killer decisions reproducible from scraped data alone
+        assert mem["query_reservations"]["q_breakdown"] == 4096
+    finally:
+        wrk.stop()
+
+
+def test_system_metrics_node_column_and_cluster_rollup():
+    runner, history = make_runner()
+    remote = {"worker-9": [("query.started", 2.0), ("spill.bytes", 5.0)]}
+    runner.catalog.register(
+        "sys2", SystemConnector(history, remote_metrics=lambda: remote))
+    runner._invalidate_plans()
+    res = runner.execute(
+        "select node, value from sys2.system_metrics "
+        "where name = 'query.started' order by node")
+    by_node = dict(res.rows)
+    assert set(by_node) == {"cluster", "local", "worker-9"}
+    assert by_node["worker-9"] == 2.0
+    assert by_node["cluster"] == by_node["local"] + 2.0
+    # without remote nodes there is no rollup row (it would duplicate)
+    res = runner.execute(
+        "select node from system_metrics where name = 'spill.bytes'")
+    # both connectors registered; the plain system one has local only
+    nodes = {r[0] for r in res.rows}
+    assert "local" in nodes
+
+
+def test_system_memory_pools_shows_live_reservations():
+    runner, _ = make_runner()
+    pool = runner.executor.memory_pool
+    assert pool is not None
+    ctx = QueryMemoryContext(pool, "q_pools_test")
+    ctx.reserve("join_build", 1 << 20)
+    try:
+        res = runner.execute(
+            "select node, reserved_bytes, peak_bytes, limit_bytes, queries"
+            " from system_memory_pools")
+        assert len(res.rows) >= 1
+        node, reserved, peak, limit, queries = res.rows[0]
+        assert reserved >= (1 << 20)
+        assert peak >= reserved
+        assert limit > 0
+        assert queries >= 1
+    finally:
+        ctx.release_all()
+
+
+def test_memory_pool_gauges_wired():
+    runner, _ = make_runner()
+    pool = runner.executor.memory_pool
+    from presto_tpu.memory import wire_pool_gauges
+
+    wire_pool_gauges(pool)
+    ctx = QueryMemoryContext(pool, "q_gauge")
+    ctx.reserve("agg", 2048)
+    try:
+        snap = dict(obs.METRICS.snapshot())
+        assert snap["memory.pool_reserved_bytes"] >= 2048
+        assert snap["memory.pool_limit_bytes"] == pool.limit
+        assert snap["memory.pool_queries"] >= 1
+    finally:
+        ctx.release_all()
+
+
+def test_low_memory_kill_emits_counter_and_log_event(tmp_path):
+    from presto_tpu.cluster_memory import ClusterMemoryManager
+    from presto_tpu.events import EventListenerManager
+
+    log = tmp_path / "query.log"
+    events = EventListenerManager()
+    events.add(obs.QueryLogListener(str(log)))
+    pool = MemoryPool(1000)
+    killed = []
+    mgr = ClusterMemoryManager(pool, killed.append, threshold=0.5,
+                               events=events)
+    before = obs.METRICS.counter("memory.query_killed").value
+    QueryMemoryContext(pool, "victim").reserve("huge", 900)
+    assert mgr.check_once() == "victim"
+    assert obs.METRICS.counter("memory.query_killed").value == before + 1
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    kills = [l for l in lines if l.get("event") == "memory_kill"]
+    assert len(kills) == 1
+    assert kills[0]["query_id"] == "victim"
+    assert kills[0]["freed_bytes"] == 900
+    assert kills[0]["limit_bytes"] == 1000
+
+
+def test_explain_analyze_reports_per_operator_peak_memory():
+    runner, _ = make_runner(sf=0.002, split_rows=2048)
+    res = runner.execute(
+        "explain analyze select n_name, count(*)"
+        " from nation, supplier where n_nationkey = s_nationkey"
+        " group by n_name")
+    text = res.rows[0][0]
+    assert "peak reserved memory:" in text
+    # the join build and/or aggregation accumulator attribute to their
+    # own plan lines from the tagged reservations
+    assert "peak_mem=" in text, text
+
+
+# ---------------------------------------------------------------------------
+# live progress
+# ---------------------------------------------------------------------------
+
+def test_query_progress_monotone_under_stage_resets():
+    p = obs.QueryProgress("q_prog")
+    st = p.stage("scan:a", splits_total=4)
+    assert p.percentage() == 0.0
+    p.split_done("scan:a")
+    p.split_done("scan:a")
+    mid = p.percentage()
+    assert mid == 50.0
+    # a new stage appears: the raw ratio dips, the figure must not
+    p.stage("scan:b", splits_total=4)
+    assert p.percentage() >= mid
+    # a retry resets stage a — still monotone
+    p.stage("scan:a", splits_total=4)
+    assert p.percentage() >= mid
+    p.mark_done()
+    assert p.percentage() == 100.0
+    snap = p.snapshot()
+    assert snap["progressPercentage"] == 100.0
+    assert all(s["state"] == "FINISHED" for s in snap["stages"])
+    del st
+
+
+def test_runner_publishes_scan_progress():
+    runner, _ = make_runner(sf=0.002, split_rows=1024)
+    res = runner.execute("select count(*) from lineitem",
+                         query_id="q_scan_prog")
+    assert res.rows
+    prog = obs.progress_for("q_scan_prog")
+    assert prog is not None
+    snap = prog.snapshot()
+    assert snap["done"] and snap["progressPercentage"] == 100.0
+    scans = [s for s in snap["stages"] if s["stage"].startswith("scan:")]
+    assert scans, snap["stages"]
+    assert any(s["splitsTotal"] and s["splitsDone"] == s["splitsTotal"]
+               and s["bytes"] > 0 for s in scans)
+
+
+def test_statement_protocol_progress_monotone_q3():
+    from presto_tpu.client import StatementClient
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    runner, _ = make_runner(sf=0.01, split_rows=2048)
+    srv = CoordinatorServer(runner)
+    srv.start()
+    try:
+        client = StatementClient(srv.uri)
+        seen = []
+
+        def on_progress(stats):
+            if "progressPercentage" in stats:
+                seen.append(stats["progressPercentage"])
+
+        columns, rows = client.execute(QUERIES[3], on_progress=on_progress)
+        assert rows, "Q3 returned no rows"
+        assert columns[0]["name"]
+        assert seen, "no page carried progressPercentage"
+        assert seen == sorted(seen), f"progress regressed: {seen}"
+        assert seen[-1] == 100.0
+    finally:
+        srv.stop()
+
+
+def test_progress_endpoint_and_ui_detail():
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    runner, _ = make_runner()
+    srv = CoordinatorServer(runner)
+    srv.start()
+    try:
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{srv.uri}/v1/statement",
+                data=b"select count(*) from orders", method="POST"),
+                timeout=60) as r:
+            doc = json.load(r)
+        qid = doc["id"]
+        assert doc["stats"]["progressPercentage"] == 100.0
+        with urllib.request.urlopen(
+                f"{srv.uri}/v1/query/{qid}/progress", timeout=10) as r:
+            snap = json.load(r)
+        assert snap["queryId"] == qid
+        assert snap["progressPercentage"] == 100.0
+        assert isinstance(snap["stages"], list)
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"{srv.uri}/v1/query/nope/progress", timeout=10)
+        with urllib.request.urlopen(f"{srv.uri}/ui", timeout=10) as r:
+            html = r.read().decode()
+        assert "/progress" in html and "span timeline" in html
+        # the query list carries the progress column
+        with urllib.request.urlopen(f"{srv.uri}/v1/query", timeout=10) as r:
+            qs = json.load(r)
+        assert any(q.get("progress") == 100.0 for q in qs)
+    finally:
+        srv.stop()
+
+
+def test_cli_progress_text():
+    from presto_tpu.cli import _progress_text
+
+    text = _progress_text({
+        "progressPercentage": 42.5,
+        "stages": [{"stage": "scan:lineitem#0", "state": "RUNNING",
+                    "splitsDone": 3, "splitsTotal": 8,
+                    "rows": 100, "bytes": 10}],
+    })
+    assert "42.5%" in text and "scan:lineitem#0 3/8" in text
+
+
+# ---------------------------------------------------------------------------
+# bench trajectory diff (tools/bench_compare.py)
+# ---------------------------------------------------------------------------
+
+def test_bench_compare_flags_regressions(tmp_path):
+    import bench_compare
+
+    old = {"parsed": {"rates": {"q1": 100.0, "q3": 50.0},
+                      "raw_times": {"q1": [1.0, 1.1, 1.05]}}}
+    new = {"parsed": {"rates": {"q1": 70.0, "q3": 55.0},
+                      "raw_times": {"q1": [1.4, 1.5, 1.45]}}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(new))
+    result = bench_compare.compare(old["parsed"], new["parsed"])
+    assert result["regressions"] == ["q1"]
+    q1 = next(e for e in result["queries"] if e["query"] == "q1")
+    assert q1["regression"] and q1["new_median_s"] == 1.45
+    # report mode exits 0 even with regressions; strict exits 1
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 0
+    assert bench_compare.main(["--dir", str(tmp_path), "--strict"]) == 1
+    # fewer than two rounds: clean no-op
+    assert bench_compare.main(["--dir", str(tmp_path / "nope")]) == 0

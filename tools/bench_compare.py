@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Diff the two newest ``BENCH_r*.json`` trajectory files.
+
+The bench driver writes one ``BENCH_rNN.json`` per round but nothing
+reads the series — regressions surfaced only when a human opened two
+files side by side.  This tool compares the newest round against the
+previous one:
+
+- per-query rows/s ratios (TPC-H ``rates`` + TPC-DS ``tpcds_rates``),
+  flagging regressions beyond the threshold (default 20%),
+- median ± half-spread per query from the ``raw_times`` repeat blocks
+  (the variance protocol's evidence), when both rounds carry them, so
+  a flagged drop is distinguishable from host noise,
+- the geomean ratio over the common query set.
+
+Exit code: 0 always in report mode (`tools/ci.sh` runs it as a
+non-fatal step); ``--strict`` exits 1 when a regression is flagged.
+
+Usage::
+
+    python tools/bench_compare.py [--dir .] [--threshold 0.2] [--strict]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_rounds(directory: str) -> List[Tuple[int, str]]:
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _ROUND_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def load_round(path: str) -> Optional[dict]:
+    """The bench payload of one trajectory file: the driver wraps the
+    child's BENCH line under ``parsed``; a bare payload (rates at top
+    level) is accepted too."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    payload = doc.get("parsed") if isinstance(doc, dict) else None
+    if not isinstance(payload, dict):
+        payload = doc if isinstance(doc, dict) and "rates" in doc else None
+    if payload is None or not payload.get("rates"):
+        return None
+    return payload
+
+
+def _geomean(values: List[float]) -> float:
+    vals = [v for v in values if v > 0]
+    if not vals:
+        return 0.0
+    return math.exp(sum(math.log(v) for v in vals) / len(vals))
+
+
+def _median_spread(times: List[float]) -> Tuple[float, float]:
+    ts = sorted(float(t) for t in times)
+    n = len(ts)
+    med = ts[n // 2] if n % 2 else (ts[n // 2 - 1] + ts[n // 2]) / 2
+    return med, (ts[-1] - ts[0]) / 2
+
+
+def _fmt_rate(v: float) -> str:
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}M/s"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f}k/s"
+    return f"{v:.0f}/s"
+
+
+def compare(old: dict, new: dict, threshold: float = 0.2) -> dict:
+    """{"queries": [...], "regressions": [...], "geomean_ratio": x}"""
+    rows = []
+    regressions = []
+    for key in ("rates", "tpcds_rates"):
+        o, n = old.get(key) or {}, new.get(key) or {}
+        for q in sorted(set(o) & set(n)):
+            if not o[q]:
+                continue
+            ratio = n[q] / o[q]
+            entry = {"query": q, "old": o[q], "new": n[q],
+                     "ratio": round(ratio, 3)}
+            for side, payload in (("old", old), ("new", new)):
+                raw = (payload.get("raw_times") or {}).get(q)
+                if raw:
+                    med, spread = _median_spread(raw)
+                    entry[f"{side}_median_s"] = round(med, 4)
+                    entry[f"{side}_spread_s"] = round(spread, 4)
+            if ratio < 1.0 - threshold:
+                entry["regression"] = True
+                regressions.append(q)
+            rows.append(entry)
+    common_tpch = sorted(set(old.get("rates") or {})
+                         & set(new.get("rates") or {}))
+    geo = None
+    if common_tpch:
+        geo = round(
+            _geomean([new["rates"][q] for q in common_tpch])
+            / _geomean([old["rates"][q] for q in common_tpch]), 3)
+    return {"queries": rows, "regressions": regressions,
+            "geomean_ratio": geo}
+
+
+def report(old_path: str, new_path: str, result: dict,
+           threshold: float) -> str:
+    lines = [f"bench trajectory: {os.path.basename(old_path)} -> "
+             f"{os.path.basename(new_path)} "
+             f"(regression threshold {threshold:.0%})"]
+    for e in result["queries"]:
+        delta = (e["ratio"] - 1.0) * 100
+        flag = "  ** REGRESSION **" if e.get("regression") else ""
+        extra = ""
+        if "new_median_s" in e:
+            extra = f"  [median {e['new_median_s']}s ±{e['new_spread_s']}s"
+            if "old_median_s" in e:
+                extra += f" vs {e['old_median_s']}s ±{e['old_spread_s']}s"
+            extra += "]"
+        lines.append(
+            f"  {e['query']:<8} {_fmt_rate(e['old']):>10} -> "
+            f"{_fmt_rate(e['new']):>10}  {delta:+6.1f}%{extra}{flag}")
+    if result["geomean_ratio"] is not None:
+        lines.append(f"  geomean ratio (tpch common set): "
+                     f"{result['geomean_ratio']:.3f}x")
+    if result["regressions"]:
+        lines.append(f"  {len(result['regressions'])} regression(s): "
+                     + ", ".join(result["regressions"]))
+    else:
+        lines.append("  no regressions beyond threshold")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (repo root)")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="per-query rate drop that counts as a "
+                         "regression (fraction, default 0.2)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when a regression is flagged "
+                         "(default: report-only, exit 0)")
+    args = ap.parse_args(argv)
+
+    rounds = find_rounds(args.dir)
+    if len(rounds) < 2:
+        print(f"bench-compare: need two BENCH_r*.json rounds under "
+              f"{args.dir!r}, found {len(rounds)} — nothing to diff")
+        return 0
+    (r_old, old_path), (r_new, new_path) = rounds[-2], rounds[-1]
+    old, new = load_round(old_path), load_round(new_path)
+    if old is None or new is None:
+        which = old_path if old is None else new_path
+        print(f"bench-compare: {which} carries no usable rates "
+              "(partial/failed round) — skipping the diff")
+        return 0
+    result = compare(old, new, threshold=args.threshold)
+    print(report(old_path, new_path, result, args.threshold))
+    return 1 if (args.strict and result["regressions"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,15 @@
 #!/usr/bin/env bash
 # One-command CI gate (the reference's maven verify analog):
 #
-#   1. engine anti-pattern lint   (tools/engine_lint.py --check)
-#   2. plan-validator corpus      (tests/test_plan_validator.py:
+#   1. engine anti-pattern lint   (tools/engine_lint.py --check, over
+#      the engine AND the tools themselves)
+#   2. bench trajectory diff      (tools/bench_compare.py — non-fatal
+#      report: >20% per-query rate drops between the two newest
+#      BENCH_r*.json rounds are flagged, not failed)
+#   3. plan-validator corpus      (tests/test_plan_validator.py:
 #      every TPC-H/TPC-DS query binds + validates clean, seeded-bug
 #      mutations still diagnose)
-#   3. tier-1 pytest suite        (the ROADMAP.md verify command)
+#   4. tier-1 pytest suite        (the ROADMAP.md verify command)
 #
 # Usage: tools/ci.sh [extra pytest args]
 
@@ -13,7 +17,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== engine lint =============================================="
-python tools/engine_lint.py --check presto_tpu
+python tools/engine_lint.py --check presto_tpu tools
+
+echo "== bench trajectory (non-fatal) ============================="
+python tools/bench_compare.py || echo "bench-compare failed (non-fatal)"
 
 echo "== plan-validator corpus ===================================="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_plan_validator.py -q \

@@ -48,11 +48,19 @@ wallclock           ``time.time()`` inside +/- arithmetic — duration
                     must use ``time.perf_counter()``, deadlines
                     ``time.monotonic()``.  Genuine epoch arithmetic
                     (JWT expiry claims) carries an allow comment.
+metric-catalog      ``.counter("name")`` / ``.gauge`` / ``.histogram``
+                    with a string literal NOT in the pre-registered
+                    catalog (obs/metrics.py ``_preregister``).  The
+                    catalog in docs/observability.md is authoritative;
+                    ad-hoc names silently fork it and break dashboards.
+                    Deliberately dynamic instruments carry a
+                    ``# metrics: allow`` comment.
 
 Suppression: append ``# lint: allow(<rule>)`` to the offending line
-(comma-separate multiple rules).  Allow-listed helper shapes (resolve-
-once functions, ``__init__`` constructors, module scope) are exempt
-from ``env-read`` automatically.
+(comma-separate multiple rules; ``# metrics: allow`` for the
+metric-catalog rule).  Allow-listed helper shapes (resolve-once
+functions, ``__init__`` constructors, module scope) are exempt from
+``env-read`` automatically.
 
 Usage::
 
@@ -112,6 +120,56 @@ _LADDER_MARKERS = {"bucket_capacity", "_cap", "cap", "cap_hi", "capacity",
 #: raise types the SQL frontend must not leak to users
 _SPI_RAW_RAISES = {"KeyError", "IndexError", "AssertionError"}
 
+#: metric-catalog: the ``# metrics: allow`` opt-out comment
+_METRICS_ALLOW_RE = re.compile(r"#\s*metrics:\s*allow")
+
+#: registry methods whose string-literal argument names an instrument
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+_CATALOG_CACHE: Dict[str, Optional[frozenset]] = {}
+
+
+def _metric_catalog_for(path: str) -> Optional[frozenset]:
+    """The pre-registered metric catalog governing ``path``: walk up
+    from the file to the repo root holding ``presto_tpu/obs/metrics.py``
+    and collect every string constant in its ``_preregister`` function.
+    Returns None (rule disabled) when no catalog is in scope — fixture
+    snippets in temp dirs lint without it."""
+    d = os.path.dirname(os.path.abspath(path))
+    probed = []
+    while True:
+        cached = _CATALOG_CACHE.get(d)
+        if cached is not None or d in _CATALOG_CACHE:
+            catalog = cached
+            break
+        probed.append(d)
+        candidate = os.path.join(d, "presto_tpu", "obs", "metrics.py")
+        if os.path.isfile(candidate):
+            catalog = _parse_catalog(candidate)
+            break
+        parent = os.path.dirname(d)
+        if parent == d:
+            catalog = None
+            break
+        d = parent
+    for p in probed:
+        _CATALOG_CACHE[p] = catalog
+    return catalog
+
+
+def _parse_catalog(metrics_py: str) -> Optional[frozenset]:
+    try:
+        with open(metrics_py, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_preregister":
+            return frozenset(
+                c.value for c in ast.walk(node)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str))
+    return None
+
 
 def _suppressed(source_lines: List[str], lineno: int, rule: str) -> bool:
     if 1 <= lineno <= len(source_lines):
@@ -167,11 +225,12 @@ def _call_name(call: ast.Call) -> Optional[str]:
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.Module, source: str,
-                 rules: Set[str]):
+                 rules: Set[str], metric_catalog: Optional[frozenset] = None):
         self.path = path
         self.tree = tree
         self.lines = source.splitlines()
         self.rules = rules
+        self.metric_catalog = metric_catalog
         self.findings: List[Finding] = []
         # stack of enclosing function names
         self._fn_stack: List[str] = []
@@ -240,6 +299,26 @@ class _Linter(ast.NodeVisitor):
                 and not node.args:
             self._emit(node, "device-sync",
                        ".item() forces a blocking host transfer")
+
+        # metric-catalog -----------------------------------------------------
+        if (self.metric_catalog is not None
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            metric = node.args[0].value
+            line = (self.lines[node.lineno - 1]
+                    if 1 <= node.lineno <= len(self.lines) else "")
+            if metric not in self.metric_catalog \
+                    and not _METRICS_ALLOW_RE.search(line):
+                self._emit(
+                    node, "metric-catalog",
+                    f"metric {metric!r} is not in the pre-registered "
+                    "catalog (obs/metrics.py _preregister, documented in "
+                    "docs/observability.md) — add it there, or mark a "
+                    "deliberately dynamic instrument with "
+                    "`# metrics: allow`")
 
         # block-until-ready --------------------------------------------------
         if name == "block_until_ready" and self._is_operator_code:
@@ -350,17 +429,23 @@ class _Linter(ast.NodeVisitor):
 
 ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
              "block-until-ready", "bare-except", "spi-exception",
-             "wallclock"}
+             "wallclock", "metric-catalog"}
+
+#: sentinel: discover the catalog by walking up from the linted file
+_AUTO = object()
 
 
-def lint_file(path: str, rules: Set[str] = ALL_RULES) -> List[Finding]:
+def lint_file(path: str, rules: Set[str] = ALL_RULES,
+              metric_catalog=_AUTO) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
         source = f.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "parse", str(e))]
-    linter = _Linter(path, tree, source, rules)
+    if metric_catalog is _AUTO:
+        metric_catalog = _metric_catalog_for(path)
+    linter = _Linter(path, tree, source, rules, metric_catalog=metric_catalog)
     linter.visit(tree)
     return linter.findings
 
