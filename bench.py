@@ -292,6 +292,26 @@ def _measure(sf: float, iters: int, only: str) -> dict:
     if errors:
         out["errors"] = errors
 
+    # concurrent-stream throughput (the split scheduler's cross-query
+    # behavior, measured not assumed): N client threads re-issuing q6
+    # against the same warm engine; aggregate rows/s + p50/p95 ride the
+    # BENCH line.  BENCH_STREAMS=0 disables.
+    try:
+        n_streams = int(os.environ.get("BENCH_STREAMS", "4"))
+    except ValueError:
+        n_streams = 4
+    if n_streams > 0 and "q6" in rates and "q6" in bench_queries:
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from benchmark_driver import run_streams
+
+            out["streams"] = run_streams(
+                runner, "q6", bench_queries["q6"], n_streams, 2)
+            log(f"streams: {out['streams']}")
+        except Exception as e:
+            log(f"streams measurement failed: {e}")
+
     # TPC-DS star-schema rates (BASELINE.md protocol names Q3/Q7) —
     # informational breadth alongside the headline TPC-H metric, so the
     # pinned-baseline comparison stays stable.  Skipped per-query, on

@@ -17,6 +17,10 @@ Recognized keys (the engine's subset of the reference's config space):
                               (docs/observability.md; enables tracing)
   query.log-path              JSONL query log (one line per completed
                               query via the EventListener sink)
+  query.task-concurrency      splits in flight per scan pipeline
+                              (morsel split scheduler; docs/tuning.md)
+  query.task-prefetch         host pages prepared ahead of the split
+                              worker pool (double-buffering depth)
   task.buffer-bytes           worker output-buffer cap
   session.<property>          default for any system session property
 
@@ -155,6 +159,15 @@ class EngineConfig:
         v = self.props.get("query.validate-plans")
         if v is not None and "validate_plans" not in props:
             props["validate_plans"] = v
+        # query.task-concurrency / query.task-prefetch: morsel split
+        # scheduler defaults (dotted keys mirror the reference's
+        # task.concurrency config; sugar for session.task_*)
+        v = self.props.get("query.task-concurrency")
+        if v is not None and "task_concurrency" not in props:
+            props["task_concurrency"] = v
+        v = self.props.get("query.task-prefetch")
+        if v is not None and "task_prefetch" not in props:
+            props["task_prefetch"] = v
         return Session(properties=props)
 
 

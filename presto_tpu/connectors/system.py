@@ -101,6 +101,10 @@ class SystemConnector:
             ("task_id", VARCHAR), ("source", VARCHAR), ("state", VARCHAR),
             ("trace_token", VARCHAR), ("elapsed_ms", DOUBLE),
             ("rows", BIGINT),
+            # morsel split-scheduler footprint (exec/tasks.py; NULL for
+            # tasks that never ran splits through it)
+            ("splits", BIGINT), ("task_concurrency", BIGINT),
+            ("scheduler_stall_ms", DOUBLE), ("prefetch_hits", BIGINT),
         ],
         "system_metrics": [
             ("node", VARCHAR), ("name", VARCHAR), ("value", DOUBLE),
@@ -203,6 +207,10 @@ class SystemConnector:
                 [t.trace_token for t in ts],
                 [t.elapsed_ms for t in ts],
                 [t.rows for t in ts],
+                [t.splits for t in ts],
+                [t.concurrency for t in ts],
+                [t.stall_ms for t in ts],
+                [t.prefetch_hits for t in ts],
             ]
         elif table == "system_metrics":
             snap = self._metrics_rows()

@@ -28,6 +28,16 @@ def _resolve_env_flag(name: str, default: bool) -> bool:
     return raw.strip().lower() not in ("", "0", "false")
 
 
+def _resolve_env_int(name: str, default: int) -> int:
+    import os
+
+    raw = os.environ.get(name)
+    try:
+        return default if raw is None or not raw.strip() else int(raw)
+    except ValueError:
+        return default
+
+
 class EnvFlag:
     """A boolean env var resolved ONCE per process, with an override
     hook for tests/tools (``set(True/False)``; ``set(None)``
@@ -47,3 +57,27 @@ class EnvFlag:
 
     def set(self, value: Optional[bool]) -> None:
         self._value = value
+
+
+class EnvInt:
+    """An integer env var resolved ONCE per process, same contract as
+    :class:`EnvFlag` (``set(n)`` overrides; ``set(None)`` re-resolves).
+    Values clamp to ``floor`` so a malformed/negative setting can never
+    produce an unbounded or zero-width pool."""
+
+    __slots__ = ("name", "default", "floor", "_value")
+
+    def __init__(self, name: str, default: int, floor: int = 0):
+        self.name = name
+        self.default = int(default)
+        self.floor = int(floor)
+        self._value: Optional[int] = None
+
+    def __call__(self) -> int:
+        if self._value is None:
+            self._value = max(self.floor,
+                              _resolve_env_int(self.name, self.default))
+        return self._value
+
+    def set(self, value: Optional[int]) -> None:
+        self._value = None if value is None else max(self.floor, int(value))

@@ -496,16 +496,31 @@ class LocalRunner:
     """
 
     def __init__(self, catalog: Catalog, jit: bool = True, split_capacity: Optional[int] = None,
-                 memory_pool=None, spill_partitions: int = 8, programs=None):
+                 memory_pool=None, spill_partitions: int = 8, programs=None,
+                 task_concurrency: Optional[int] = None,
+                 task_prefetch: Optional[int] = None):
         from presto_tpu.exec.programs import (
             default_registry, maybe_enable_persistent_cache,
             structural_sharing_enabled,
+        )
+        from presto_tpu.exec.tasks import (
+            task_concurrency_default, task_prefetch_default,
         )
         from presto_tpu.ops.join import resolve_direct_join
 
         self.catalog = catalog
         self.jit = jit
         self.split_capacity = split_capacity
+        # morsel-driven split scheduler knobs (exec/tasks.py): splits
+        # in flight per pipeline (1 = the exact legacy serial path) and
+        # prefetch depth.  None resolves the process default, which is
+        # env/config-derived — resolved ONCE here, not per chain.
+        self.task_concurrency = max(1, int(
+            task_concurrency if task_concurrency
+            else task_concurrency_default()))
+        self.task_prefetch = max(0, int(
+            task_prefetch if task_prefetch is not None and task_prefetch >= 0
+            else task_prefetch_default()))
         # structural program registry (ExpressionCompiler-cache analog):
         # compiled callables keyed by kernel family + canonical IR +
         # baked-in parameters, shared process-wide unless injected
@@ -544,6 +559,17 @@ class LocalRunner:
         self._builds_tls = _threading.local()
         # joins demoted out of fused chains because their build spilled
         self._force_expanding: set = set()
+        # per-query split-scheduler stats (consumer-thread-local: the
+        # scheduler's worker threads report through the shared stats
+        # object, but the accumulator is owned by the query thread) and
+        # the completed-query snapshot EXPLAIN ANALYZE prints
+        self._task_stats_tls = _threading.local()
+        self.last_task_stats: Dict[str, float] = {}
+        # consume-once unordered-delivery grant: an order-insensitive
+        # consumer (exact commutative aggregation fold) sets it just
+        # before pulling a chain; the TOP-level chain takes completion-
+        # order delivery, nested chains (join builds) stay ordered
+        self._unordered_tls = _threading.local()
 
     # ------------------------------------------------------------------
     def run(self, plan: PlanNode, query_id: Optional[str] = None) -> MaterializedResult:
@@ -577,6 +603,9 @@ class LocalRunner:
 
         @contextlib.contextmanager
         def ctx():
+            from presto_tpu.exec.tasks import SchedulerStats
+
+            self._task_stats_tls.stats = SchedulerStats()
             if self.memory_pool is not None:
                 from presto_tpu.memory import QueryMemoryContext
                 import uuid
@@ -586,6 +615,7 @@ class LocalRunner:
             try:
                 yield
             finally:
+                self.last_task_stats = self._task_stats.as_dict()
                 if self._mem is not None:
                     self.last_peak_bytes = self._mem.peak
                     # per-site peak reservations (site strings embed the
@@ -631,6 +661,24 @@ class LocalRunner:
     def _mem(self, value):
         self._mem_tls.ctx = value
 
+    @property
+    def _task_stats(self):
+        from presto_tpu.exec.tasks import SchedulerStats
+
+        got = getattr(self._task_stats_tls, "stats", None)
+        if got is None:
+            got = SchedulerStats()
+            self._task_stats_tls.stats = got
+        return got
+
+    def _take_unordered(self) -> bool:
+        """Pop the consume-once unordered-delivery grant (see
+        ``_unordered_tls``)."""
+        got = getattr(self._unordered_tls, "ok", False)
+        if got:
+            self._unordered_tls.ok = False
+        return bool(got)
+
     def _account(self, what: str, page, node=None) -> None:
         """Charge a materialized device intermediate against the pool
         (operator-level LocalMemoryContext.setBytes analog). ``node``
@@ -655,7 +703,22 @@ class LocalRunner:
         peak = getattr(self, "last_peak_bytes", 0)
         if peak:
             text = f"peak reserved memory: {peak / 1e6:.1f}MB\n" + text
+        sched = self._scheduler_line()
+        if sched:
+            text = sched + "\n" + text
         return text
+
+    def _scheduler_line(self) -> str:
+        """One-line split-scheduler summary for EXPLAIN ANALYZE (empty
+        when the last query ran no splits through a scan pipeline)."""
+        ts = getattr(self, "last_task_stats", None) or {}
+        if not ts.get("splits"):
+            return ""
+        total = ts["prefetch_hits"] + ts["prefetch_misses"]
+        return (f"task scheduler: {ts['splits']} splits, "
+                f"concurrency {ts['concurrency']}, "
+                f"stall {ts['stall_s']:.3f}s, "
+                f"prefetch hits {ts['prefetch_hits']}/{total}")
 
     def _mem_by_node(self) -> Dict[int, int]:
         """id(plan node) -> peak reserved bytes, recovered from the last
@@ -712,6 +775,9 @@ class LocalRunner:
             line += (f", persistent cache hits {reg['persistent_hits']}"
                      f" ({reg['dir']})")
         text = line + "\n" + text
+        sched = self._scheduler_line()
+        if sched:
+            text = sched + "\n" + text
         return text
 
     def compiled_program_count(self) -> Optional[int]:
@@ -1084,6 +1150,9 @@ class LocalRunner:
     def _chain_pages(self, node: PlanNode) -> Iterator[Page]:
         from presto_tpu.memory import ExceededMemoryLimitError
 
+        # pop the unordered grant FIRST: it applies to this chain only,
+        # never to nested chains pulled while materializing builds
+        unordered = self._take_unordered()
         leaf = self._chain_leaf(node)
         joins: List[JoinNode] = []
         stage = self._build_stage(node, joins)
@@ -1107,24 +1176,90 @@ class LocalRunner:
                 "chain", self._stage_signature(node),
                 lambda: jax.jit(stage) if self.jit else stage, node=node)
             self._chain_cache[node] = fn
-        for page in self._source_pages(leaf):
-            tag = None
-            mem = self._mem
-            if mem is not None:
-                from presto_tpu.memory import page_bytes
+        mem = self._mem
+        # the scheduler takes SCAN pipelines (independent connector
+        # splits — the morsel shape); breaker-leaf chains keep the
+        # serial pull, since their "source" is a materialized upstream
+        # whose own execution must stay on this thread (thread-local
+        # memory context and build registries)
+        if self.task_concurrency <= 1 or not isinstance(leaf, TableScanNode):
+            # serial leg (task_concurrency=1): the exact legacy pull
+            # loop — no threads, no reordering, the A/B baseline.
+            # Split accounting covers SCAN pipelines only — breaker-leaf
+            # chains pull materialized pages, not connector splits, and
+            # counting them would make the splits surface meaningless
+            count_splits = isinstance(leaf, TableScanNode)
+            for page in self._source_pages(leaf):
+                tag = None
+                if mem is not None:
+                    from presto_tpu.memory import page_bytes
 
-                # transient: the in-flight scan page is accountable
-                # while the chain program consumes it, but soft — a
-                # streaming input can't be spilled; it is bounded by
-                # split capacity, not by the pool
-                tag = mem.reserve("scan_page", page_bytes(page),
-                                  enforce=False)
+                    # transient: the in-flight scan page is accountable
+                    # while the chain program consumes it, but soft — a
+                    # streaming input can't be spilled; it is bounded by
+                    # split capacity, not by the pool
+                    tag = mem.reserve("scan_page", page_bytes(page),
+                                      enforce=False)
+                if count_splits:
+                    self._task_stats.splits += 1
+                try:
+                    yield fn(page, consts)
+                finally:
+                    # early generator exit (LIMIT) must not leak the tag
+                    if tag is not None:
+                        mem.free(tag)
+            return
+        yield from self._chain_pages_scheduled(leaf, fn, consts, mem,
+                                               unordered)
+
+    def _chain_pages_scheduled(self, leaf: PlanNode, fn, consts, mem,
+                               unordered: bool) -> Iterator[Page]:
+        """Morsel-driven chain execution: up to ``task_concurrency``
+        splits in flight on the scheduler's worker pool, host page prep
+        prefetched ahead, results delivered in source order (or
+        completion order when the consumer granted it).  Backpressure:
+        dispatch defers while the memory pool has no headroom, so
+        concurrency throttles instead of OOMing."""
+        from presto_tpu.exec.tasks import SplitScheduler
+
+        def produced():
+            for page in self._source_pages(leaf):
+                tag = None
+                if mem is not None:
+                    from presto_tpu.memory import page_bytes
+
+                    # soft reservation, exactly like the serial leg —
+                    # tagged per split so in-flight pages are visible
+                    # in the pool books while they await execution
+                    tag = mem.reserve("scan_page", page_bytes(page),
+                                      enforce=False)
+                yield page, tag
+
+        def run_split(item):
+            page, tag = item
             try:
-                yield fn(page, consts)
+                return fn(page, consts)
             finally:
-                # early generator exit (LIMIT) must not leak the tag
                 if tag is not None:
                     mem.free(tag)
+
+        def drop_split(item):
+            # produced-but-never-executed split on early close (LIMIT):
+            # its reservation must not linger until query end, where it
+            # would skew headroom backpressure and spill decisions
+            _, tag = item
+            if tag is not None:
+                mem.free(tag)
+
+        headroom = None
+        if mem is not None:
+            headroom = lambda: mem.headroom() > 0  # noqa: E731
+        sched = SplitScheduler(
+            concurrency=self.task_concurrency, prefetch=self.task_prefetch,
+            ordered=not unordered, headroom=headroom, name="chain",
+            stats=self._task_stats,
+            drop=drop_split if mem is not None else None)
+        yield from sched.map(produced(), run_split)
 
     def _chain_leaf(self, node: PlanNode) -> PlanNode:
         if isinstance(node, (FilterNode, ProjectNode)):
@@ -1707,6 +1842,24 @@ class LocalRunner:
             return False
         return packed_direct_layout(node.group_exprs, node.key_domains, mg)
 
+    def _commutative_exact(self, node: AggregationNode) -> bool:
+        """True when the aggregation's fold is order-insensitive in
+        EXACT arithmetic: count/min/max always, sum only over integer
+        representations (integer-like and short decimals — scaled
+        int64s).  Float sums/avg stay ordered: float addition is
+        non-associative, and concurrency must not change results."""
+        for a in node.aggs:
+            if a.distinct:
+                return False
+            if a.fn in ("count", "count_star", "min", "max"):
+                continue
+            if a.fn == "sum" and (
+                    a.type.is_integerlike
+                    or (a.type.is_decimal and not a.type.is_long_decimal)):
+                continue
+            return False
+        return True
+
     def _run_aggregation(self, node: AggregationNode) -> Page:
         """Breaker with spill fallback: the in-place path folds partial
         pages on device; past the pool limit or the capacity threshold
@@ -1902,8 +2055,18 @@ class LocalRunner:
             # triggers ONE retry with the capacity jumped to the
             # observed live total instead of a doubling ladder.
             tower = _AggFoldTower(self, node, num_keys, aggs, kd, mg)
-            for p in self._pages(source):
-                tower.add(p)
+            # exact commutative folds (count/min/max, integer sums) may
+            # take chain pages in COMPLETION order: the tower's merged
+            # values are order-independent in exact arithmetic, so the
+            # scheduler skips the reorder buffer (grant is consume-once
+            # and cleared below even if no chain ever claimed it)
+            if self.task_concurrency > 1 and self._commutative_exact(node):
+                self._unordered_tls.ok = True
+            try:
+                for p in self._pages(source):
+                    tower.add(p)
+            finally:
+                self._unordered_tls.ok = False
             if node.step == "single" and tower.suspect_truncation \
                     and not self._exact_capacity(node, mg) \
                     and mg < MAX_AGG_GROUPS:

@@ -61,6 +61,9 @@ def build_from_etc(etc_dir: str, port: int = 0):
             port=port,
             buffer_bytes=cfg.int("task.buffer-bytes", 64 << 20),
             memory_pool=default_memory_pool(),
+            # morsel split scheduler width for fragment scans (0 =
+            # process default from PRESTO_TPU_TASK_CONCURRENCY)
+            task_concurrency=cfg.int("query.task-concurrency", 0) or None,
         )
         role = "worker"
     return server, role, cfg

@@ -106,11 +106,19 @@ class QueryMemoryContext:
     reserved/peak so QueryStats can report per-query peak bytes, and
     per-SITE current/peak bytes (site = the ``what`` string, which for
     operator reservations embeds the plan-node id) so EXPLAIN ANALYZE
-    can print per-operator peak memory from the tagged reservations."""
+    can print per-operator peak memory from the tagged reservations.
+
+    Thread-safe: the morsel split scheduler (exec/tasks.py) reserves
+    and frees per-split tags from producer/worker threads while the
+    consumer thread charges breaker state, so the context is SHARED
+    per query rather than confined to one thread — a lock keeps the
+    reserved/peak/site books consistent (the pool has its own lock;
+    this one covers the query-local accounting)."""
 
     def __init__(self, pool: MemoryPool, query_id: str = "q"):
         self.pool = pool
         self.query_id = query_id
+        self._lock = threading.Lock()
         self._seq = 0
         self.reserved = 0
         self.peak = 0
@@ -119,36 +127,51 @@ class QueryMemoryContext:
         self.site_peak: Dict[str, int] = {}
 
     def reserve(self, what: str, nbytes: int, enforce: bool = True) -> str:
-        self._seq += 1
-        tag = f"{self.query_id}/{what}#{self._seq}"
+        with self._lock:
+            self._seq += 1
+            tag = f"{self.query_id}/{what}#{self._seq}"
+        # pool reservation outside the context lock: the pool enforces
+        # its own limit under its own lock, and a kill/limit error must
+        # not leave this context locked
         self.pool.reserve(tag, nbytes, enforce=enforce)
-        self.reserved += nbytes
-        self.peak = max(self.peak, self.reserved)
-        self._tag_site[tag] = (what, nbytes)
-        cur = self._site_current.get(what, 0) + nbytes
-        self._site_current[what] = cur
-        if cur > self.site_peak.get(what, 0):
-            self.site_peak[what] = cur
+        with self._lock:
+            self.reserved += nbytes
+            self.peak = max(self.peak, self.reserved)
+            self._tag_site[tag] = (what, nbytes)
+            cur = self._site_current.get(what, 0) + nbytes
+            self._site_current[what] = cur
+            if cur > self.site_peak.get(what, 0):
+                self.site_peak[what] = cur
         return tag
 
     def reserve_page(self, what: str, page) -> str:
         return self.reserve(what, page_bytes(page))
 
     def free(self, tag: str) -> None:
-        self.reserved -= self.pool.tags().get(tag, 0)
+        n = self.pool.tags().get(tag, 0)
         self.pool.free(tag)
-        entry = self._tag_site.pop(tag, None)
-        if entry is not None:
-            site, nbytes = entry
-            self._site_current[site] = self._site_current.get(site, 0) - nbytes
+        with self._lock:
+            self.reserved -= n
+            entry = self._tag_site.pop(tag, None)
+            if entry is not None:
+                site, nbytes = entry
+                self._site_current[site] = (
+                    self._site_current.get(site, 0) - nbytes)
+
+    def headroom(self) -> int:
+        """Pool bytes still available — the split scheduler's
+        backpressure probe (dispatch defers while a further in-flight
+        split would not fit)."""
+        return self.pool.limit - self.pool.reserved
 
     def release_all(self) -> None:
         for tag in list(self.pool.tags()):
             if tag.startswith(self.query_id + "/"):
                 self.pool.free(tag)
-        self.reserved = 0
-        self._tag_site.clear()
-        self._site_current.clear()
+        with self._lock:
+            self.reserved = 0
+            self._tag_site.clear()
+            self._site_current.clear()
 
 
 # ---------------------------------------------------------------------------

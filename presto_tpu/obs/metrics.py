@@ -209,6 +209,12 @@ def _preregister(reg: MetricsRegistry) -> None:
         # failure — alerting keys on tasks.failed alone)
         "tasks.started", "tasks.finished", "tasks.failed",
         "tasks.aborted",
+        # morsel-driven split scheduler (exec/tasks.py): dispatched
+        # split count, consumer stall time waiting on in-flight splits,
+        # and prefetch pipeline hit/miss (a hit = the next result was
+        # already buffered when the consumer asked)
+        "task.splits_dispatched", "task.scheduler_stall_seconds_total",
+        "task.prefetch_hits", "task.prefetch_misses",
         # memory plane: cluster low-memory killer victims
         "memory.query_killed",
     ):
@@ -218,6 +224,9 @@ def _preregister(reg: MetricsRegistry) -> None:
         # sampling callbacks to the active MemoryPool)
         "memory.pool_reserved_bytes", "memory.pool_peak_bytes",
         "memory.pool_limit_bytes", "memory.pool_queries",
+        # live split-scheduler state (exec/tasks.py wires the
+        # sampling callbacks at import)
+        "task.splits_queued", "task.splits_running",
     ):
         reg.gauge(name)
     for name in ("query.execution_ms", "xla.compile_ms"):
@@ -234,7 +243,8 @@ _preregister(METRICS)
 
 class TaskEntry:
     __slots__ = ("task_id", "source", "state", "trace_token", "_t0",
-                 "elapsed_ms", "rows", "error")
+                 "elapsed_ms", "rows", "error", "splits", "concurrency",
+                 "stall_ms", "prefetch_hits")
 
     def __init__(self, task_id: str, source: str,
                  trace_token: Optional[str] = None):
@@ -246,6 +256,12 @@ class TaskEntry:
         self.elapsed_ms: Optional[float] = None
         self.rows: Optional[int] = None
         self.error: Optional[str] = None
+        # split-scheduler footprint (exec/tasks.py; NULL until the
+        # executor reports — e.g. worker shuffle-pull tasks never do)
+        self.splits: Optional[int] = None
+        self.concurrency: Optional[int] = None
+        self.stall_ms: Optional[float] = None
+        self.prefetch_hits: Optional[int] = None
 
 
 class TaskRegistry:
@@ -286,6 +302,20 @@ class TaskRegistry:
         counter = {"FINISHED": "tasks.finished",
                    "ABORTED": "tasks.aborted"}.get(state, "tasks.failed")
         METRICS.counter(counter).inc()
+
+    def update_scheduler(self, task_id: str, splits: int, concurrency: int,
+                         stall_ms: float, prefetch_hits: int) -> None:
+        """Attach the split-scheduler footprint of a finished (or
+        running) execution to its task row — the system_runtime_tasks
+        surface of the morsel scheduler."""
+        with self._lock:
+            e = self._entries.get(task_id)
+            if e is None:
+                return
+            e.splits = int(splits)
+            e.concurrency = int(concurrency)
+            e.stall_ms = round(float(stall_ms), 3)
+            e.prefetch_hits = int(prefetch_hits)
 
     def entries(self) -> List[TaskEntry]:
         with self._lock:

@@ -40,6 +40,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# shard_map moved from jax.experimental to the jax namespace around
+# 0.6; resolve whichever this build ships so the mesh tier runs on both
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:  # pragma: no cover - depends on the jax build
+    from jax.experimental.shard_map import shard_map
+
 from presto_tpu.catalog import Catalog
 from presto_tpu.exec.local import (
     MAX_AGG_GROUPS,
@@ -252,7 +258,21 @@ class DistributedRunner:
             self.allow_colocated = bool(session.get("colocated_join"))
             self.min_stage_rows = int(
                 session.get("distributed_min_stage_rows"))
-        self.local = LocalRunner(catalog)
+        # morsel-scheduler knobs flow into the mesh tier too: the local
+        # fallback runner schedules its scan splits, and the wave loops
+        # prefetch the next wave's host assembly while the device mesh
+        # executes the current one (resolved ONCE here, per env-read)
+        from presto_tpu.exec.tasks import (
+            task_concurrency_default, task_prefetch_default,
+        )
+
+        tc = int(session.get("task_concurrency")) if session is not None \
+            else 0
+        tp = int(session.get("task_prefetch")) if session is not None else -1
+        self.task_concurrency = tc if tc > 0 else task_concurrency_default()
+        self.wave_prefetch = tp if tp >= 0 else task_prefetch_default()
+        self.local = LocalRunner(catalog, task_concurrency=tc or None,
+                                 task_prefetch=tp)
         # persistent un-jitted runner for stage building/builds: its
         # _agg_overrides must survive GroupCapacityExceeded retries
         # (a build-side aggregation overflow records its doubled
@@ -436,7 +456,7 @@ class DistributedRunner:
         if wave_fn is None:
             check_specs = {name: P(axis) for name in ctx.checks}
             wave_fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     per_device_wave, mesh=mesh,
                     in_specs=(P(axis), P(), {k: P(axis) for k in consts_shard}),
                     out_specs=(P(axis), check_specs),
@@ -448,13 +468,25 @@ class DistributedRunner:
         out_pages: List[Page] = []
         wave_checks = []
         channels = chain_root.channels
-        for w in range(source.waves):
-            stacked = jax.device_put(source.stacked_wave(w), sharding)
+        for stacked in self._wave_iter(source, sharding):
             out, cks = wave_fn(stacked, consts_rep, consts_shard)
             wave_checks.append(cks)
             out_pages.extend(_unstack_pages(jax.device_get(out), channels))
         self._verify_checks(chain_root, ctx, wave_checks, 0, False)
         return out_pages
+
+    def _wave_iter(self, source: "_StageSource", sharding):
+        """Device-placed wave pages, with the NEXT wave's host assembly
+        (+ transfer) prefetched while the mesh executes the current one
+        (double-buffering; wave_prefetch=0 keeps the serial loop)."""
+        waves = (jax.device_put(source.stacked_wave(w), sharding)
+                 for w in range(source.waves))
+        if self.wave_prefetch <= 0 or self.task_concurrency <= 1:
+            return waves
+        from presto_tpu.exec.tasks import prefetch_iter
+
+        return prefetch_iter(waves, depth=self.wave_prefetch,
+                             name="dist-wave")
 
     # ------------------------------------------------------------------
     def run_aggregation_stage(self, agg: AggregationNode) -> Page:
@@ -823,7 +855,7 @@ class DistributedRunner:
             check_specs = {name: P(axis) for name in ctx.checks}
             check_specs["groups"] = P(axis)
             wave_fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     per_device_wave, mesh=mesh,
                     in_specs=(
                         P(axis), P(axis), P(),
@@ -839,8 +871,7 @@ class DistributedRunner:
 
         acc = self._initial_acc(partial_channels, mg, n, sharding)
         wave_checks = []
-        for w in range(source.waves):
-            stacked = jax.device_put(source.stacked_wave(w), sharding)
+        for stacked in self._wave_iter(source, sharding):
             acc, cks = wave_fn(stacked, acc, consts_rep, consts_shard)
             wave_checks.append(cks)
         self._verify_checks(agg, ctx, wave_checks, mg, check)
@@ -868,7 +899,7 @@ class DistributedRunner:
         final_fn = self._final_fns.get((agg, mg))
         if final_fn is None:
             final_fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     per_device_final, mesh=mesh, in_specs=P(axis),
                     out_specs=(P(axis), P(axis)),
                 )
@@ -938,7 +969,7 @@ class DistributedRunner:
             return _unsqueeze(stage_r(_squeeze(page1), crep))
 
         bw_fn = jax.jit(
-            jax.shard_map(bw, mesh=mesh, in_specs=(P(axis), P()),
+            shard_map(bw, mesh=mesh, in_specs=(P(axis), P()),
                           out_specs=P(axis))
         )
         sharding = NamedSharding(mesh, P(axis))
@@ -969,7 +1000,7 @@ class DistributedRunner:
             )
         ns = getattr(jnode, "null_safe_keys", False)
         bj_fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda pg1: _unsqueeze(
                     build_join(_squeeze(pg1), right_keys, key_domains=kd,
                                null_safe=ns)
@@ -1037,7 +1068,7 @@ class DistributedRunner:
             return _unsqueeze(ex), fill[None]
 
         bw_fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 bw, mesh=mesh, in_specs=(P(axis), P()),
                 out_specs=(P(axis), P(axis)),
             )
@@ -1076,7 +1107,7 @@ class DistributedRunner:
             )
 
         bj_fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda pg1: _unsqueeze(
                     build_join(_squeeze(pg1), right_keys, key_domains=kd)
                 ),

@@ -193,6 +193,10 @@ class MultiHostRunner:
 
         self.catalog = catalog
         self.workers = [WorkerClient(u) for u in worker_uris]
+        # the coordinator-local fallback (and glue execution) runs its
+        # scan splits through the morsel scheduler like every other
+        # LocalRunner; worker-side fragments get it inside
+        # server/worker.py's runner (same exec/tasks.py pool knobs)
         self.local = LocalRunner(catalog)
         self.broadcast_threshold = (DEFAULT_BROADCAST_THRESHOLD
                                     if broadcast_threshold is None

@@ -116,6 +116,10 @@ class QueryRunner:
             split_capacity=cap,
             memory_pool=self.memory_pool,
             programs=self.programs,
+            # 0 / -1 = process default (config/env resolved once in
+            # exec/tasks.py); any positive session value wins per query
+            task_concurrency=int(self.session.get("task_concurrency")) or None,
+            task_prefetch=int(self.session.get("task_prefetch")),
         )
         ex.merge_sort = bool(self.session.get("distributed_sort"))
         return ex
@@ -220,6 +224,15 @@ class QueryRunner:
             obs.METRICS.counter("query.execution_seconds_total").inc(execution_s)
             obs.METRICS.histogram("query.execution_ms").observe(execution_s * 1e3)
             obs.TASKS.finish(qid, "FINISHED", rows=len(res.rows))
+            # split-scheduler footprint onto the task row (local tier
+            # only: a mesh run's executor stats would be stale).  The
+            # thread-local accumulator is read, not last_task_stats —
+            # concurrent queries on one runner must not swap footprints
+            ts = self.executor._task_stats.as_dict()
+            if not self.session.get("distributed") and ts.get("splits"):
+                obs.TASKS.update_scheduler(
+                    qid, ts["splits"], ts["concurrency"],
+                    ts["stall_s"] * 1e3, ts["prefetch_hits"])
             # per-run outcome off the result object (not the shared
             # runner fields — concurrent queries would swap stats)
             dist_stages = getattr(res, "dist_stages", None)

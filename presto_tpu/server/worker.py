@@ -80,15 +80,19 @@ class WorkerServer:
 
     def __init__(self, catalog: Catalog, host: str = "127.0.0.1", port: int = 0,
                  buffer_bytes: int = 64 << 20, task_ttl: float = 300.0,
-                 memory_pool=None, task_threads: int = 4):
+                 memory_pool=None, task_threads: int = 4,
+                 task_concurrency: Optional[int] = None):
         from presto_tpu.executor import TaskExecutor
 
         self.catalog = catalog
         # all runners in this worker process (and any co-resident
         # coordinator executor) share ONE program registry — the
         # process-wide default: a fragment shape compiled for task A
-        # is a cache hit for task B
-        self.runner = LocalRunner(catalog, memory_pool=memory_pool)
+        # is a cache hit for task B.  Worker fragments run their scan
+        # splits through the morsel split scheduler (exec/tasks.py);
+        # None = process default (query.task-concurrency / env)
+        self.runner = LocalRunner(catalog, memory_pool=memory_pool,
+                                  task_concurrency=task_concurrency)
         # cooperative scheduler: page-granularity quanta over a
         # multilevel feedback queue (execution/executor/TaskExecutor.java)
         self.executor = TaskExecutor(num_threads=task_threads)

@@ -94,6 +94,20 @@ SYSTEM_PROPERTIES = [
         "coordinator (0 = every stage on the mesh)",
         1 << 13, int,
     ),
+    PropertyMetadata(
+        "task_concurrency",
+        "splits in flight per scan pipeline (morsel scheduler, "
+        "exec/tasks.py); 1 = serial legacy path, 0 = process default "
+        "(query.task-concurrency config / PRESTO_TPU_TASK_CONCURRENCY)",
+        0, int,
+    ),
+    PropertyMetadata(
+        "task_prefetch",
+        "host pages prepared ahead of the split worker pool "
+        "(double-buffering depth); -1 = process default "
+        "(PRESTO_TPU_TASK_PREFETCH)",
+        -1, int,
+    ),
 ]
 
 

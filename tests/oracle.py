@@ -157,6 +157,9 @@ def register_scalar_udfs(conn: sqlite3.Connection) -> None:
     conn.create_function("strpos", 2, lambda s, sub: s.find(sub) + 1)
     conn.create_function("greatest", -1, lambda *a: max(a))
     conn.create_function("least", -1, lambda *a: min(a))
+    # SQL mod() truncates toward zero (fmod), unlike sqlite's % which
+    # this build lacks as a function anyway
+    conn.create_function("mod", 2, lambda a, b: math.fmod(a, b))
 
 
 def _key(row: Sequence) -> tuple:

@@ -294,6 +294,64 @@ def test_metric_catalog_allow_comment_and_dynamic_names(tmp_path):
     assert findings == []
 
 
+def test_thread_pool_unbounded_executor_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fan_out(tasks):
+            with ThreadPoolExecutor() as ex:
+                return list(ex.map(str, tasks))
+    """)
+    assert [f.rule for f in findings] == ["thread-pool"]
+
+
+def test_thread_pool_hardcoded_width_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+        import threading
+
+        def fan_out(tasks):
+            ex = ThreadPoolExecutor(max_workers=8)
+            workers = [threading.Thread(target=str) for _ in range(4)]
+            return ex, workers
+    """)
+    assert [f.rule for f in findings] == ["thread-pool", "thread-pool"]
+
+
+def test_thread_pool_config_derived_allowed(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+        import threading
+
+        def fan_out(tasks, workers, concurrency):
+            ex = ThreadPoolExecutor(max_workers=concurrency)
+            # per-target threads bounded by the (config-sized) worker
+            # list, and a pool sized by a parameter: both legal
+            ts = [threading.Thread(target=str, args=(w,)) for w in workers]
+            for i in range(concurrency):
+                threading.Thread(target=str, args=(i,))
+            # literal START is fine — only the stop argument sizes the
+            # pool (range(0, n) must not be misread as hard-coded)
+            for i in range(0, concurrency):
+                threading.Thread(target=str, args=(i,))
+            return ex, ts
+    """)
+    assert findings == []
+
+
+def test_thread_pool_suppression_comment(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import threading
+
+        def two_phase():
+            for _ in range(2):  # lint: allow(thread-pool)
+                threading.Thread(target=str)
+    """)
+    # the allow comment sits on the loop line; the Thread call inside
+    # still needs its own line-level suppression to pass
+    assert [f.rule for f in findings] == ["thread-pool"]
+
+
 def test_metric_catalog_discovered_from_repo():
     """Auto-discovery walks up to presto_tpu/obs/metrics.py: the real
     catalog governs files linted inside the repo."""

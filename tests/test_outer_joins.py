@@ -5,6 +5,8 @@ Reference analog: operator/LookupJoinOperators.java:37 (fullOuterJoin)
 probes); TestHashJoinOperator full-outer cases.
 """
 
+import sqlite3
+
 import pytest
 
 from presto_tpu.catalog import Catalog
@@ -12,6 +14,15 @@ from presto_tpu.connectors.tpch import Tpch
 from presto_tpu.runner import QueryRunner
 
 from tests.oracle import assert_rows_match, load_oracle, run_oracle
+
+# the ORACLE needs sqlite >= 3.39 for RIGHT/FULL OUTER JOIN; older
+# builds cannot produce the expected rows at all (the engine side is
+# exercised regardless by tests/test_feature_interactions and the
+# join-operator unit tests)
+needs_full_join_oracle = pytest.mark.skipif(
+    sqlite3.sqlite_version_info < (3, 39),
+    reason=f"sqlite {sqlite3.sqlite_version} lacks RIGHT/FULL OUTER "
+           "JOIN (needs >= 3.39); oracle cannot compute expected rows")
 
 
 @pytest.fixture(scope="module")
@@ -49,6 +60,7 @@ CASES = [
 ]
 
 
+@needs_full_join_oracle
 @pytest.mark.parametrize("i", range(len(CASES)))
 def test_full_outer(env, i):
     runner, oracle = env
@@ -58,6 +70,7 @@ def test_full_outer(env, i):
     assert_rows_match(actual, expected, ordered=False)
 
 
+@needs_full_join_oracle
 def test_right_outer(env):
     runner, oracle = env
     sql = ("select n_name, s_name from supplier"

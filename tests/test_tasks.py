@@ -1,0 +1,449 @@
+"""Morsel-driven split scheduler (exec/tasks.py): reorder-buffer
+ordering, backpressure, exception propagation, and the end-to-end
+determinism contract — concurrency 1 vs 4 produce identical query
+results across the TPC-H corpus (the existing oracle harness validates
+the serial leg; the concurrent leg must match it row for row).
+
+Reference analogs: execution/executor/TaskExecutor.java (bounded split
+concurrency), morsel-driven parallelism (Leis et al. SIGMOD 2014).
+"""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.exec.tasks import (
+    SchedulerStats,
+    SplitScheduler,
+    prefetch_iter,
+    set_task_concurrency,
+    task_concurrency_default,
+)
+from presto_tpu.runner import QueryRunner
+
+from tests.oracle import assert_rows_match, load_oracle, run_oracle
+from tests.tpch_queries import QUERIES
+
+
+# ---------------------------------------------------------------------------
+# scheduler unit behavior
+# ---------------------------------------------------------------------------
+
+def test_serial_concurrency_is_plain_generator():
+    s = SplitScheduler(concurrency=1)
+    seen = []
+
+    def items():
+        for i in range(5):
+            seen.append(i)
+            yield i
+
+    gen = s.map(items(), lambda x: x * 2)
+    assert seen == []  # nothing pulled until the consumer asks
+    assert next(gen) == 0
+    assert seen == [1] or seen == [1, 2] or len(seen) <= 2
+    assert list(gen) == [2, 4, 6, 8]
+    assert s.stats.splits == 5
+
+
+def test_ordered_delivery_reorders_out_of_order_completions():
+    """Split 0 takes far longer than splits 1..7; the reorder buffer
+    must still deliver source order."""
+
+    def fn(i):
+        if i == 0:
+            time.sleep(0.2)
+        return i * 10
+
+    s = SplitScheduler(concurrency=4, prefetch=2, ordered=True)
+    assert list(s.map(range(8), fn)) == [i * 10 for i in range(8)]
+
+
+def test_unordered_delivery_is_completion_order():
+    """With one slow head split and unordered delivery, faster splits
+    arrive first — completion order, not source order."""
+
+    def fn(i):
+        if i == 0:
+            time.sleep(0.25)
+        return i
+
+    s = SplitScheduler(concurrency=4, prefetch=2, ordered=False)
+    out = list(s.map(range(6), fn))
+    assert sorted(out) == list(range(6))  # nothing lost or duplicated
+    assert out[0] != 0  # the slow head split did NOT arrive first
+
+
+def test_worker_exception_propagates_at_ordered_position():
+    def fn(i):
+        if i == 3:
+            raise ValueError("split 3 blew up")
+        return i
+
+    s = SplitScheduler(concurrency=4, prefetch=2, ordered=True)
+    gen = s.map(range(8), fn)
+    assert [next(gen) for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(ValueError, match="split 3 blew up"):
+        next(gen)
+
+
+def test_source_exception_propagates():
+    def items():
+        yield 1
+        yield 2
+        raise RuntimeError("source died")
+
+    s = SplitScheduler(concurrency=2, ordered=True)
+    gen = s.map(items(), lambda x: x)
+    assert next(gen) == 1
+    assert next(gen) == 2
+    with pytest.raises(RuntimeError, match="source died"):
+        next(gen)
+
+
+def test_early_close_stops_threads():
+    """A consumer that stops early (LIMIT) must not leak producer or
+    worker threads, and must stop draining the source."""
+    produced = []
+
+    def items():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    s = SplitScheduler(concurrency=3, prefetch=1)
+    gen = s.map(items(), lambda x: x)
+    assert next(gen) == 0
+    gen.close()
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+    # window-bounded production: far from the full source
+    assert len(produced) <= 3 + 1 + 2
+
+
+def test_backpressure_bounds_inflight():
+    """At most concurrency + prefetch items are outstanding between
+    source and consumer."""
+    outstanding = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def items():
+        for i in range(40):
+            with lock:
+                outstanding[0] += 1
+                peak[0] = max(peak[0], outstanding[0])
+            yield i
+
+    def consume(gen):
+        for _ in gen:
+            with lock:
+                outstanding[0] -= 1
+            time.sleep(0.002)  # slow consumer
+
+    s = SplitScheduler(concurrency=2, prefetch=1)
+    consume(s.map(items(), lambda x: x))
+    assert peak[0] <= 2 + 1 + 1  # window, +1 for the one being yielded
+
+
+def test_headroom_probe_defers_dispatch():
+    """With a closed headroom probe, only the guaranteed-progress split
+    runs at a time (dispatch defers while the probe is False)."""
+    running = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            running[0] += 1
+            peak[0] = max(peak[0], running[0])
+        time.sleep(0.01)
+        with lock:
+            running[0] -= 1
+        return i
+
+    s = SplitScheduler(concurrency=4, prefetch=2, headroom=lambda: False)
+    out = list(s.map(range(10), fn))
+    assert out == list(range(10))
+    # headroom=False still guarantees progress but in-flight stays ~1
+    # (the one dispatch the progress guarantee admits, plus scheduling
+    # slack of one)
+    assert peak[0] <= 2
+
+
+def test_prefetch_iter_preserves_order_and_overlaps():
+    done = []
+
+    def items():
+        for i in range(6):
+            done.append(i)
+            yield i
+
+    out = list(prefetch_iter(items(), depth=2))
+    assert out == list(range(6))
+    assert done == list(range(6))
+
+
+def test_stats_accumulate():
+    stats = SchedulerStats()
+    s = SplitScheduler(concurrency=2, prefetch=1, stats=stats)
+    list(s.map(range(7), lambda x: x))
+    assert stats.splits == 7
+    assert stats.concurrency == 2
+    s2 = SplitScheduler(concurrency=4, stats=stats)
+    list(s2.map(range(3), lambda x: x))
+    assert stats.splits == 10
+    assert stats.concurrency == 4
+    d = stats.as_dict()
+    assert d["splits"] == 10 and d["concurrency"] == 4
+
+
+def test_env_default_resolves_once():
+    base = task_concurrency_default()
+    try:
+        set_task_concurrency(7)
+        assert task_concurrency_default() == 7
+        set_task_concurrency(0)  # floor clamps to 1
+        assert task_concurrency_default() == 1
+    finally:
+        set_task_concurrency(base)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: determinism, accounting, observability
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def env():
+    # small splits so every scan is genuinely multi-split (lineitem
+    # ~60k rows -> ~15 splits) and the worker pool has real work
+    tpch = Tpch(sf=0.01, split_rows=4096)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    runner = QueryRunner(catalog)
+    oracle = load_oracle(tpch)
+    return runner, oracle
+
+
+#: corpus slice for the determinism property: scan-heavy (q1/q6),
+#: join+agg (q3/q14), semi-join/exists (q4), multi-join (q9), TopN
+#: prefix order sensitivity (q2), SYSTEM-sampling-free global shapes
+DETERMINISM_QIDS = [1, 2, 3, 4, 6, 9, 14, 18]
+
+
+@pytest.mark.parametrize("qid", DETERMINISM_QIDS)
+def test_concurrency_matches_serial_results(env, qid):
+    """concurrency 4 must produce IDENTICAL rows to concurrency 1 (the
+    serial A/B leg), which itself is validated against the sqlite
+    oracle — the scheduler may change timing, never results."""
+    runner, oracle = env
+    sql = QUERIES[qid]
+    runner.execute("SET SESSION task_concurrency = 1")
+    serial = runner.execute(sql).rows
+    runner.execute("SET SESSION task_concurrency = 4")
+    try:
+        concurrent = runner.execute(sql).rows
+    finally:
+        runner.execute("RESET SESSION task_concurrency")
+    assert serial == concurrent  # byte-identical, order included
+    assert_rows_match(concurrent, run_oracle(oracle, sql), ordered=False)
+
+
+def test_agg_over_limit_subquery_deterministic(env):
+    """The unordered-delivery grant must never reach a scan chain that
+    feeds a LIMIT: the outer (serial, breaker-leaf) chain pops and
+    discards the grant, so the limited row set is scheduling-invariant."""
+    runner, _ = env
+    sql = ("select count(*), sum(l_orderkey) from "
+           "(select l_orderkey from lineitem where l_quantity < 30 "
+           "limit 1000)")
+    runner.execute("SET SESSION task_concurrency = 1")
+    serial = runner.execute(sql).rows
+    runner.execute("SET SESSION task_concurrency = 4")
+    try:
+        for _ in range(3):
+            assert runner.execute(sql).rows == serial
+    finally:
+        runner.execute("RESET SESSION task_concurrency")
+
+
+def test_early_close_drops_unexecuted_items():
+    """Items produced but never executed when the consumer closes
+    early are handed to the ``drop`` callback (the executor frees their
+    scan_page reservations there) — every produced item is either
+    executed or dropped, never silently discarded."""
+    executed, dropped = [], []
+
+    def fn(i):
+        time.sleep(0.02)
+        executed.append(i)
+        return i
+
+    s = SplitScheduler(concurrency=2, prefetch=3, ordered=True,
+                       drop=dropped.append)
+    gen = s.map(iter(range(50)), fn)
+    assert next(gen) == 0
+    gen.close()
+    # the prefetch window was full of produced-but-unexecuted items;
+    # each must have been dropped exactly once
+    assert dropped, "queued items were discarded without drop()"
+    assert not (set(dropped) & set(executed))
+    leaked = set(range(max(executed + dropped) + 1)) \
+        - set(executed) - set(dropped)
+    assert not leaked, f"items neither executed nor dropped: {leaked}"
+
+
+def test_system_sampling_deterministic_under_concurrency(env):
+    """TABLESAMPLE SYSTEM keeps whole splits by a split-hash — the
+    kept-split set (and row order) must not depend on scheduling."""
+    runner, _ = env
+    sql = ("select count(*), sum(l_quantity) from lineitem "
+           "tablesample system (40)")
+    runner.execute("SET SESSION task_concurrency = 1")
+    serial = runner.execute(sql).rows
+    runner.execute("SET SESSION task_concurrency = 4")
+    try:
+        concurrent = runner.execute(sql).rows
+    finally:
+        runner.execute("RESET SESSION task_concurrency")
+    assert serial == concurrent
+
+
+def test_limit_early_exit_under_concurrency(env):
+    runner, _ = env
+    runner.execute("SET SESSION task_concurrency = 4")
+    try:
+        before = threading.active_count()
+        rows = runner.execute(
+            "select l_orderkey from lineitem limit 5").rows
+        assert len(rows) == 5
+        deadline = time.monotonic() + 5.0
+        while threading.active_count() > before \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= before
+    finally:
+        runner.execute("RESET SESSION task_concurrency")
+
+
+def test_worker_error_fails_query_cleanly(env):
+    """An exception raised on a scheduler worker thread surfaces as an
+    ordinary query failure on the caller, and the engine keeps serving
+    queries afterwards."""
+    from presto_tpu.exec.local import LocalRunner
+
+    runner, _ = env
+    plan = runner.plan("select sum(l_quantity) from lineitem")
+    ex = LocalRunner(runner.catalog, task_concurrency=4)
+    boom = RuntimeError("injected split failure")
+    original = ex._source_pages
+
+    def poisoned(node):
+        for i, p in enumerate(original(node)):
+            yield p
+            if i == 1:
+                raise boom
+
+    ex._source_pages = poisoned
+    with pytest.raises(RuntimeError, match="injected split failure"):
+        ex.run(plan)
+    # the shared runner is unaffected and keeps executing
+    assert runner.execute("select count(*) from nation").rows == [(25,)]
+
+
+def test_memory_pool_limit_held_and_spill_triggers_under_concurrency():
+    """Backpressure under a small pool: the concurrency-4 run still
+    routes oversized aggregation state through the spill path, holds
+    every ENFORCED reservation under the pool limit, and matches the
+    unconstrained result."""
+    from presto_tpu.exec.local import LocalRunner
+    from presto_tpu.memory import MemoryPool
+    from presto_tpu.sql.binder import Binder
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.004, split_rows=1 << 12))
+    binder = Binder(catalog)
+    sql = ("select l_orderkey, count(*), sum(l_quantity) from lineitem "
+           "group by l_orderkey")
+    plan = binder.plan(sql)
+    reference = LocalRunner(catalog, task_concurrency=1).run(plan)
+
+    class AssertingPool(MemoryPool):
+        """Every ENFORCED reservation must hold the limit (soft scan
+        pages are exempt by contract — they are bounded by the
+        scheduler window, not the pool)."""
+
+        def __init__(self, limit):
+            super().__init__(limit)
+            self.enforced_peak = 0
+
+        def reserve(self, tag, nbytes, enforce=True):
+            super().reserve(tag, nbytes, enforce=enforce)
+            if enforce:
+                self.enforced_peak = max(self.enforced_peak, self.reserved)
+
+    pool = AssertingPool(4 << 20)  # 4MB: far below the agg state
+    ex = LocalRunner(catalog, memory_pool=pool, task_concurrency=4)
+    out = ex.run(plan)
+    assert sorted(out.rows) == sorted(reference.rows)
+    assert pool.enforced_peak <= pool.limit  # limit held, not OOM'd
+
+
+def test_explain_analyze_and_task_row_surface_scheduler(env):
+    runner, _ = env
+    runner.execute("SET SESSION task_concurrency = 4")
+    try:
+        text = runner.execute(
+            "EXPLAIN ANALYZE select sum(l_quantity) from lineitem"
+        ).rows[0][0]
+        assert "task scheduler:" in text
+        assert "concurrency 4" in text
+    finally:
+        runner.execute("RESET SESSION task_concurrency")
+    from presto_tpu import obs
+
+    entries = [e for e in obs.TASKS.entries()
+               if e.concurrency == 4 and e.splits]
+    assert entries, "no task entry carries the scheduler footprint"
+
+
+def test_scheduler_metrics_preregistered():
+    from presto_tpu import obs
+
+    names = {n for n, _ in obs.METRICS.snapshot()}
+    for metric in ("task.splits_dispatched",
+                   "task.scheduler_stall_seconds_total",
+                   "task.prefetch_hits", "task.prefetch_misses",
+                   "task.splits_queued", "task.splits_running"):
+        assert metric in names, metric
+
+
+def test_system_runtime_tasks_columns(env):
+    runner, _ = env
+    from presto_tpu.connectors.system import QueryHistory, SystemConnector
+
+    history = QueryHistory()
+    runner.events.add(history)
+    sys_conn = SystemConnector(history)
+    runner.catalog.register("system", sys_conn)
+    try:
+        runner.execute("SET SESSION task_concurrency = 4")
+        runner.execute("select count(*) from lineitem")
+        runner.execute("RESET SESSION task_concurrency")
+        rows = runner.execute(
+            "select task_id, splits, task_concurrency, scheduler_stall_ms,"
+            " prefetch_hits from system_runtime_tasks"
+            " where task_concurrency = 4").rows
+        assert rows, "no scheduler-annotated task rows"
+        tid, splits, conc, stall, hits = rows[-1]
+        assert splits >= 2 and conc == 4
+        assert stall is not None and hits is not None
+    finally:
+        runner.catalog._connectors.pop("system", None)
+        runner._invalidate_plans()

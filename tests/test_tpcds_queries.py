@@ -9,12 +9,16 @@ from presto_tpu.catalog import Catalog
 from presto_tpu.connectors.tpcds import SCHEMAS, Tpcds
 from presto_tpu.runner import QueryRunner
 
-from tests.oracle import assert_rows_match, translate
+from tests.oracle import assert_rows_match, register_scalar_udfs, translate
 from tests.tpcds_queries import ORACLE_OVERRIDES, QUERIES
 
 
 def load_tpcds_oracle(ds: Tpcds) -> sqlite3.Connection:
     conn = sqlite3.connect(":memory:")
+    # scalar builtins this sqlite build lacks (floor/sqrt/mod...) —
+    # without them q17/q39/q51/q54/q97 failed at the ORACLE, not the
+    # engine (the r6 standing-failure set)
+    register_scalar_udfs(conn)
     for table in ds.table_names():
         schema = SCHEMAS[table]
         cols = ", ".join(n for n, _ in schema)
@@ -52,9 +56,19 @@ def env():
 
 _since_clear = [0]
 
+# queries whose ORACLE text (or override) uses RIGHT/FULL OUTER JOIN —
+# sqlite < 3.39 cannot compute the expected rows (the engine side still
+# runs FULL joins under tests/test_outer_joins + feature interactions)
+_NEEDS_FULL_JOIN_ORACLE = {17, 51, 97}
+
 
 @pytest.mark.parametrize("qid", sorted(QUERIES))
 def test_tpcds_query(env, qid):
+    if qid in _NEEDS_FULL_JOIN_ORACLE \
+            and sqlite3.sqlite_version_info < (3, 39):
+        pytest.skip(f"sqlite {sqlite3.sqlite_version} lacks RIGHT/FULL "
+                    "OUTER JOIN (needs >= 3.39); oracle cannot compute "
+                    "expected rows")
     runner, oracle = env
     # bound live compiled executables: the 99-query corpus in ONE
     # process accumulates thousands of XLA:CPU programs across the

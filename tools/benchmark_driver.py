@@ -14,6 +14,15 @@ Usage:
   python tools/benchmark_driver.py --suite tpch --sf 0.01 --runs 3
   python tools/benchmark_driver.py --suite path/to/dir --catalog tpch
   python tools/benchmark_driver.py --suite tpch --queries q1,q6 --json
+  python tools/benchmark_driver.py --suite tpch --streams 4 --runs 2
+  python tools/benchmark_driver.py --queries q1,q6,q14 --task-concurrency 4
+
+``--streams N`` switches to concurrent-query THROUGHPUT mode: N client
+threads issue the query against the same warm engine and the report
+carries aggregate rows/s plus p50/p95 per-execution latency — the
+cross-query behavior of the split scheduler measured, not assumed.
+``--task-concurrency`` pins the morsel scheduler width for A/B legs
+(1 = the serial baseline).
 """
 
 from __future__ import annotations
@@ -151,6 +160,74 @@ def cold_compile_report(args):
     return 0
 
 
+def run_streams(runner, name: str, sql: str, streams: int, runs: int):
+    """Concurrent-query throughput: ``streams`` client threads each
+    execute ``sql`` ``runs`` times against the shared warm engine.
+    Returns the aggregate row/s + latency-percentile report row."""
+    import statistics as stats
+    import threading
+
+    warm = runner.execute(sql)
+    latencies: list = []
+    rows_total = [0]
+    errors: list = []
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            try:
+                res = runner.execute(sql)
+            except Exception as e:  # a failing stream must be visible
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+                rows_total[0] += len(res)
+
+    # client-count is CLI-derived (--streams), not hard-coded
+    threads = [threading.Thread(target=client, name=f"stream-{i}")
+               for i in range(streams)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if not latencies:
+        return {"query": name, "streams": streams,
+                "error": errors[0] if errors else "no executions"}
+    lat = sorted(latencies)
+
+    def pct(p):
+        # nearest-rank percentile (ceil, 1-indexed): floor-indexing
+        # returned the MAX for any n <= 20, making "p95" a worst-case
+        # outlier report at default stream counts
+        import math
+
+        return lat[min(len(lat) - 1,
+                       max(0, math.ceil(p / 100.0 * len(lat)) - 1))]
+
+    row = {
+        "query": name,
+        "streams": streams,
+        "runs_per_stream": runs,
+        "executions": len(lat),
+        "rows": len(warm),
+        "wall_s": round(wall, 3),
+        "queries_per_s": round(len(lat) / wall, 3),
+        "rows_per_s": round(rows_total[0] / wall, 1),
+        "p50_s": round(stats.median(lat), 4),
+        "p95_s": round(pct(95), 4),
+        "max_s": round(lat[-1], 4),
+    }
+    if errors:
+        row["errors"] = errors
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--suite", default="tpch",
@@ -164,6 +241,14 @@ def main():
                          "report carries median-of-medians ± spread and "
                          "every raw time (variance protocol)")
     ap.add_argument("--queries", default=None, help="comma list filter, e.g. q1,q6")
+    ap.add_argument("--streams", type=int, default=0,
+                    help="concurrent-query throughput mode: N client "
+                         "threads over the same warm engine (aggregate "
+                         "rows/s + p50/p95 latency)")
+    ap.add_argument("--task-concurrency", type=int, default=0,
+                    help="pin the morsel split-scheduler width for this "
+                         "run (session task_concurrency; 1 = serial A/B "
+                         "leg, 0 = process default)")
     ap.add_argument("--cpu", action="store_true", help="force the XLA CPU backend")
     ap.add_argument("--json", action="store_true", help="one JSON line per query")
     ap.add_argument("--cold-compile-report", action="store_true",
@@ -190,6 +275,30 @@ def main():
             raise SystemExit(f"no queries match {args.queries!r}")
 
     runner = build_runner(args)
+    if args.task_concurrency:
+        runner.execute(
+            f"SET SESSION task_concurrency = {args.task_concurrency}")
+
+    if args.streams:
+        results = []
+        for name, sql in suite:
+            try:
+                row = run_streams(runner, name, sql, args.streams,
+                                  max(args.runs, 1))
+            except Exception as e:
+                row = {"query": name, "error": f"{type(e).__name__}: {e}"}
+            results.append(row)
+            if args.json:
+                print(json.dumps(row), flush=True)
+            elif "error" in row:
+                print(f"{name:>8}  ERROR {row['error']}", flush=True)
+            else:
+                print(f"{name:>8}  streams={row['streams']} "
+                      f"qps={row['queries_per_s']:.2f} "
+                      f"rows/s={row['rows_per_s']:.1f} "
+                      f"p50={row['p50_s']:.3f}s p95={row['p95_s']:.3f}s",
+                      flush=True)
+        sys.exit(0 if all("error" not in r for r in results) else 1)
 
     results = []
     for name, sql in suite:

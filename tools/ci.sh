@@ -26,6 +26,16 @@ echo "== plan-validator corpus ===================================="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_plan_validator.py -q \
     -p no:cacheprovider
 
+echo "== concurrent split-scheduler leg ==========================="
+# a fast tier-1 subset under PRESTO_TPU_TASK_CONCURRENCY=4: the morsel
+# scheduler's threaded path (scan chains, spill/memory interaction,
+# TPC-H end-to-end vs the oracle) is exercised on EVERY gate, not just
+# in its dedicated tests
+env JAX_PLATFORMS=cpu PRESTO_TPU_TASK_CONCURRENCY=4 python -m pytest \
+    tests/test_tasks.py tests/test_tpch.py tests/test_spill.py \
+    tests/test_always_on_memory.py tests/test_executor.py -q \
+    -p no:cacheprovider
+
 echo "== tier-1 tests ============================================="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting before the pass-count

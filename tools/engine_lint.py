@@ -55,6 +55,15 @@ metric-catalog      ``.counter("name")`` / ``.gauge`` / ``.histogram``
                     ad-hoc names silently fork it and break dashboards.
                     Deliberately dynamic instruments carry a
                     ``# metrics: allow`` comment.
+thread-pool         ``ThreadPoolExecutor`` without a ``max_workers``
+                    argument (unbounded default), with an int-literal
+                    worker count, or a ``Thread`` constructed inside a
+                    ``for``/comprehension over ``range(<literal>)``.
+                    Pool widths must be bounded AND config-derived
+                    (task_concurrency / a constructor parameter — the
+                    exec/tasks.py contract): a hard-coded pool ignores
+                    the host, and an unbounded one is a fork bomb under
+                    concurrent queries.
 
 Suppression: append ``# lint: allow(<rule>)`` to the offending line
 (comma-separate multiple rules; ``# metrics: allow`` for the
@@ -254,6 +263,10 @@ class _Linter(ast.NodeVisitor):
             for stmt in ast.walk(tree) if isinstance(stmt, ast.ImportFrom)
             if stmt.module == "time"
             for alias in stmt.names if alias.name == "time"}
+        # depth of enclosing for-loops/comprehensions whose iterable is
+        # range(<int literal>) — a Thread() built there is a pool of
+        # hard-coded width (the thread-pool rule)
+        self._literal_range_depth = 0
 
     # -- helpers -----------------------------------------------------------
     def _emit(self, node: ast.AST, rule: str, message: str) -> None:
@@ -319,6 +332,36 @@ class _Linter(ast.NodeVisitor):
                     "docs/observability.md) — add it there, or mark a "
                     "deliberately dynamic instrument with "
                     "`# metrics: allow`")
+
+        # thread-pool --------------------------------------------------------
+        if name == "ThreadPoolExecutor":
+            width = None
+            if node.args:
+                width = node.args[0]
+            for k in node.keywords:
+                if k.arg == "max_workers":
+                    width = k.value
+            if width is None:
+                self._emit(
+                    node, "thread-pool",
+                    "ThreadPoolExecutor without max_workers defaults to "
+                    "an unbounded-ish pool — pass a bounded, "
+                    "config-derived worker count (task_concurrency / a "
+                    "constructor parameter)")
+            elif isinstance(width, ast.Constant) \
+                    and isinstance(width.value, int):
+                self._emit(
+                    node, "thread-pool",
+                    f"hard-coded ThreadPoolExecutor width "
+                    f"{width.value} — derive the worker count from "
+                    "config (task_concurrency / a constructor "
+                    "parameter) so deployments can size it")
+        if name == "Thread" and self._literal_range_depth > 0:
+            self._emit(
+                node, "thread-pool",
+                "Thread constructed in a range(<literal>) loop is a "
+                "pool of hard-coded width — derive the count from "
+                "config (task_concurrency / a constructor parameter)")
 
         # block-until-ready --------------------------------------------------
         if name == "block_until_ready" and self._is_operator_code:
@@ -395,6 +438,37 @@ class _Linter(ast.NodeVisitor):
                 "tracer error under jit, an implicit device sync "
                 "outside it (use jnp.where / lax.cond)")
 
+    @staticmethod
+    def _is_literal_range(it: ast.AST) -> bool:
+        """``range`` whose STOP argument is an int literal — the
+        hard-coded pool-width iterable of the thread-pool rule.  Only
+        the stop argument decides: ``range(0, concurrency)`` is
+        config-derived despite its literal start."""
+        if not (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name) and it.func.id == "range"
+                and it.args):
+            return False
+        stop = it.args[0] if len(it.args) == 1 else it.args[1]
+        return isinstance(stop, ast.Constant) and isinstance(stop.value, int)
+
+    def _visit_in_range_scope(self, node, iters) -> None:
+        bump = any(self._is_literal_range(it) for it in iters)
+        self._literal_range_depth += bump
+        self.generic_visit(node)
+        self._literal_range_depth -= bump
+
+    def visit_For(self, node: ast.For) -> None:
+        self._visit_in_range_scope(node, [node.iter])
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self._visit_in_range_scope(node, [g.iter for g in node.generators])
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        self._visit_in_range_scope(node, [g.iter for g in node.generators])
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self._visit_in_range_scope(node, [g.iter for g in node.generators])
+
     def visit_If(self, node: ast.If) -> None:
         self._check_branch(node)
         self.generic_visit(node)
@@ -429,7 +503,7 @@ class _Linter(ast.NodeVisitor):
 
 ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
              "block-until-ready", "bare-except", "spi-exception",
-             "wallclock", "metric-catalog"}
+             "wallclock", "metric-catalog", "thread-pool"}
 
 #: sentinel: discover the catalog by walking up from the linted file
 _AUTO = object()

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Split-scheduler scaling microbench: latency-bound splits.
+
+Measures `exec/tasks.SplitScheduler` throughput over splits whose cost
+is a host-side STALL (emulating remote-storage fetches / connector
+decode latency) rather than CPU — the component the morsel scheduler
+can actually overlap regardless of host core count.  On CPU-bound
+TPC-H splits the ratio is capped by spare cores (PERF.md round 7); this
+bench isolates the scheduler itself.
+
+Usage:
+  python tools/task_scaling_bench.py [--splits 16] [--stall-ms 50]
+                                     [--concurrency 1,2,4,8] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--splits", type=int, default=16)
+    ap.add_argument("--stall-ms", type=float, default=50.0)
+    ap.add_argument("--concurrency", default="1,2,4,8",
+                    help="comma list of worker-pool widths to measure")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    from presto_tpu.exec.tasks import SplitScheduler
+
+    stall_s = args.stall_ms / 1e3
+
+    def split(i: int) -> int:
+        time.sleep(stall_s)  # the latency being overlapped
+        return i
+
+    rows = []
+    base = None
+    for c in (int(x) for x in args.concurrency.split(",")):
+        sched = SplitScheduler(concurrency=c, prefetch=2, ordered=True)
+        t0 = time.perf_counter()
+        out = list(sched.map(range(args.splits), split))
+        wall = time.perf_counter() - t0
+        assert out == list(range(args.splits)), "ordering violated"
+        if base is None:
+            base = wall
+        row = {
+            "concurrency": c,
+            "wall_s": round(wall, 3),
+            "splits_per_s": round(args.splits / wall, 2),
+            "speedup": round(base / wall, 2),
+        }
+        rows.append(row)
+        if args.json:
+            print(json.dumps(row), flush=True)
+        else:
+            print(f"c={c:<3} wall={row['wall_s']:.3f}s "
+                  f"splits/s={row['splits_per_s']:.1f} "
+                  f"speedup={row['speedup']:.2f}x", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
