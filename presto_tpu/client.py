@@ -13,8 +13,14 @@ from typing import Iterator, List, Optional, Tuple
 
 
 class StatementClient:
-    def __init__(self, server_uri: str):
+    def __init__(self, server_uri: str, timeout: float = 650.0):
         self.server_uri = server_uri.rstrip("/")
+        # per-request bound: a wedged coordinator must fail the client
+        # call, not hang it (the naked-urlopen lint contract).  Sized
+        # past the server's 600s blocking-POST long-poll bound so the
+        # client always receives the server's page (terminal state or
+        # nextUri), never a client-side timeout first
+        self.timeout = timeout
 
     def execute(self, sql: str,
                 on_progress=None) -> Tuple[List[dict], List[tuple]]:
@@ -35,7 +41,7 @@ class StatementClient:
             method="POST",
             headers=headers,
         )
-        with urllib.request.urlopen(req) as resp:
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             page = json.load(resp)
         if on_progress is not None and page.get("stats"):
             on_progress(page["stats"])
@@ -44,7 +50,8 @@ class StatementClient:
         columns = page.get("columns") or []
         rows = [tuple(r) for r in page.get("data", [])]
         while page.get("nextUri"):
-            with urllib.request.urlopen(page["nextUri"]) as resp:
+            with urllib.request.urlopen(page["nextUri"],
+                                        timeout=self.timeout) as resp:
                 page = json.load(resp)
             if on_progress is not None and page.get("stats"):
                 on_progress(page["stats"])
@@ -56,9 +63,11 @@ class StatementClient:
         return columns, rows
 
     def server_info(self) -> dict:
-        with urllib.request.urlopen(f"{self.server_uri}/v1/info") as resp:
+        with urllib.request.urlopen(f"{self.server_uri}/v1/info",
+                                    timeout=10.0) as resp:
             return json.load(resp)
 
     def queries(self) -> list:
-        with urllib.request.urlopen(f"{self.server_uri}/v1/query") as resp:
+        with urllib.request.urlopen(f"{self.server_uri}/v1/query",
+                                    timeout=10.0) as resp:
             return json.load(resp)

@@ -12,10 +12,11 @@ normal cancel path.
 
 from __future__ import annotations
 
-import json
+import logging
 import threading
-import urllib.request
 from typing import Callable, Dict, List, Optional, Sequence
+
+_log = logging.getLogger("presto_tpu.cluster_memory")
 
 
 def total_reservation_low_memory_killer(
@@ -66,33 +67,32 @@ class ClusterMemoryManager:
         self.kills: List[str] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # availability-transition logging for the worker polls: one
+        # line per state change, never one per poll cycle
+        from presto_tpu.net import PollHealth
+
+        self._poll_health = PollHealth("worker memory", _log)
 
     # -- polling ------------------------------------------------------------
     def cluster_usage(self) -> Dict[str, int]:
         """(reserved, limit) across local + remote pools
         (RemoteNodeMemory poll). Workers are polled concurrently so one
         hung socket cannot stretch the decision cycle past ~2s."""
+        from presto_tpu.net import poll_each, request_json
+
         reserved = self.local_pool.reserved if self.local_pool else 0
         limit = self.local_pool.limit if self.local_pool else 0
-        results: List[Dict] = []
-        lock = threading.Lock()
-
-        def poll(uri):
-            try:
-                with urllib.request.urlopen(f"{uri}/v1/info", timeout=2.0) as r:
-                    info = json.load(r)
-                with lock:
-                    results.append(info.get("memory") or {})
-            except Exception:
-                pass  # dead workers are the failure detector's job
-
-        threads = [threading.Thread(target=poll, args=(u,), daemon=True)
-                   for u in self.worker_uris]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=2.5)
-        for mem in results:
+        # failures are classified/counted by request_json and
+        # transition-logged by the health tracker; EXCLUDING a dead
+        # worker from the usage sum is the correct degradation — its
+        # liveness is the failure detector's job
+        infos = poll_each(
+            self.worker_uris,
+            lambda uri: request_json(f"{uri}/v1/info", timeout=2.0,
+                                     site="cluster.memory_poll_errors"),
+            health=self._poll_health)
+        for info in infos.values():
+            mem = info.get("memory") or {}
             reserved += int(mem.get("reserved", 0))
             limit += int(mem.get("limit", 0))
         return {"reserved": reserved, "limit": limit}

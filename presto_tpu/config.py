@@ -21,6 +21,16 @@ Recognized keys (the engine's subset of the reference's config space):
                               (morsel split scheduler; docs/tuning.md)
   query.task-prefetch         host pages prepared ahead of the split
                               worker pool (double-buffering depth)
+  query.max-execution-time    duration (e.g. ``600s``, ``10m``) a query
+                              may RUN before the coordinator kills it
+                              (EXCEEDED_TIME_LIMIT; default 0 = no
+                              deadline; docs/fault-tolerance.md)
+  query.max-queued-time       duration a query may wait for resource-
+                              group admission before failing
+  coordinator.worker-uris     comma-separated worker base URIs the
+                              coordinator heartbeats, polls and
+                              schedules (failure detector, cluster
+                              memory manager, system tables)
   task.buffer-bytes           worker output-buffer cap
   session.<property>          default for any system session property
 
@@ -55,6 +65,29 @@ def load_properties(path: str) -> Dict[str, str]:
         return parse_properties(f.read())
 
 
+def parse_duration(text: str, default: float = 0.0) -> float:
+    """airlift ``Duration`` subset -> seconds: ``600``/``600s``,
+    ``500ms``, ``10m``, ``2h``, ``1d``.  Empty/None/unparseable ->
+    ``default`` (never raises: this runs on the coordinator's
+    query-execution path, where a garbage config value must degrade
+    to the default, not leak a resource-group slot — session values
+    are additionally validated at SET time, session.py).  ``0`` (any
+    unit) means disabled by the callers' convention."""
+    if text is None:
+        return default
+    s = str(text).strip().lower()
+    if not s:
+        return default
+    try:
+        for suffix, scale in (("ms", 1e-3), ("s", 1.0), ("m", 60.0),
+                              ("h", 3600.0), ("d", 86400.0)):
+            if s.endswith(suffix):
+                return float(s[: -len(suffix)]) * scale
+        return float(s)
+    except ValueError:
+        return default
+
+
 class EngineConfig:
     """Parsed etc/ directory (PrestoServer bootstrap analog)."""
 
@@ -82,6 +115,23 @@ class EngineConfig:
             for k, v in self.props.items()
             if k.startswith("session.")
         }
+
+    def max_execution_time(self, default: float = 0.0) -> float:
+        """``query.max-execution-time`` in seconds.  Default 0 = no
+        deadline: a kill policy must be OPTED INTO — an unchanged
+        config keeps the legacy behavior where long queries run to
+        completion (the old 600s was only a long-poll bound, and
+        silently turning it into a kill would fail every >10min query
+        on upgrade)."""
+        return parse_duration(self.props.get("query.max-execution-time"),
+                              default)
+
+    def max_queued_time(self, default: float = 600.0) -> float:
+        """``query.max-queued-time`` in seconds: the resource-group
+        admission wait bound (expiry = a FAILED statement, not a
+        hang)."""
+        return parse_duration(self.props.get("query.max-queued-time"),
+                              default)
 
     def query_log_path(self) -> Optional[str]:
         """Path for the JSONL query log (``query.log-path``); None

@@ -53,6 +53,7 @@ class SystemConnector:
                  nodes: Optional[Callable[[], List[dict]]] = None,
                  metrics=None, tasks=None, remote_metrics=None,
                  pools: Optional[Callable[[], List[dict]]] = None,
+                 workers: Optional[Callable[[], List[dict]]] = None,
                  node_id: str = "local"):
         from presto_tpu.obs import METRICS, TASKS
 
@@ -72,6 +73,10 @@ class SystemConnector:
         # () -> [{node, reserved, peak, limit, queries}] — defaults to
         # the process pool (memory.default_memory_pool)
         self.pools = pools
+        # () -> failure-detector rows (parallel/failure.py snapshot):
+        # the coordinator wires CoordinatorServer.worker_rows here so
+        # system_runtime_workers shows detector state per worker
+        self.workers = workers
         # one cluster poll per scan, not one per metadata call:
         # row_count (bind time) and page_for_split (execution) both
         # need the rows, and polling twice doubles the HTTP fan-out
@@ -96,6 +101,15 @@ class SystemConnector:
         ],
         "system_runtime_nodes": [
             ("node_id", VARCHAR), ("state", VARCHAR),
+        ],
+        # worker fleet through the failure detector's eyes
+        # (parallel/failure.py): detector state, failure streak, and
+        # ms since the last successful heartbeat (NULL before the
+        # first one — NULL-safe like every obs column)
+        "system_runtime_workers": [
+            ("node_id", VARCHAR), ("uri", VARCHAR), ("state", VARCHAR),
+            ("consecutive_failures", BIGINT),
+            ("last_heartbeat_ms", DOUBLE), ("last_error", VARCHAR),
         ],
         "system_runtime_tasks": [
             ("task_id", VARCHAR), ("source", VARCHAR), ("state", VARCHAR),
@@ -138,7 +152,17 @@ class SystemConnector:
             return len(self._metrics_rows())
         if table == "system_memory_pools":
             return len(self._pool_rows())
+        if table == "system_runtime_workers":
+            return len(self._worker_rows())
         return len(self.nodes())
+
+    def _worker_rows(self) -> List[dict]:
+        if self.workers is None:
+            return []
+        try:
+            return list(self.workers())
+        except Exception:
+            return []  # a wedged detector must not fail the table
 
     def _metrics_rows(self) -> List[Tuple[str, str, float]]:
         """(node, name, value) across the cluster: local registry rows,
@@ -225,6 +249,16 @@ class SystemConnector:
                 [int(p["peak"]) for p in ps],
                 [int(p["limit"]) for p in ps],
                 [int(p["queries"]) for p in ps],
+            ]
+        elif table == "system_runtime_workers":
+            ws = self._worker_rows()
+            cols = [
+                [w.get("node_id") for w in ws],
+                [w.get("uri") for w in ws],
+                [w.get("state") for w in ws],
+                [w.get("consecutive_failures") for w in ws],
+                [w.get("last_heartbeat_ms") for w in ws],
+                [w.get("last_error") for w in ws],
             ]
         else:
             ns = self.nodes()

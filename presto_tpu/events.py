@@ -66,6 +66,36 @@ class MemoryKillEvent:
     kill_time: float  # epoch seconds (event timestamp, not a duration)
 
 
+@dataclasses.dataclass
+class QueryKilledEvent:
+    """The coordinator killed a query for a policy reason (deadline,
+    admission, operator action) — emitted in ADDITION to the victim's
+    completion/failure line, carrying the DECISION: the reason code
+    and the limit that was exceeded (the reference's
+    QueryMonitor.queryImmediateFailureEvent + killed-query log)."""
+
+    query_id: str
+    reason: str  # e.g. EXCEEDED_TIME_LIMIT
+    message: str
+    limit_s: Optional[float]  # the configured limit, when one applies
+    elapsed_s: Optional[float]
+    kill_time: float  # epoch seconds (event timestamp, not a duration)
+
+
+@dataclasses.dataclass
+class WorkerStateChangeEvent:
+    """The failure detector moved a worker between states
+    (alive/suspect/dead/recovered) — the cluster-membership half of
+    the query log (HeartbeatFailureDetector's state-change logging,
+    made a first-class event)."""
+
+    uri: str
+    old_state: str
+    new_state: str
+    reason: Optional[str]
+    change_time: float  # epoch seconds
+
+
 def new_trace_token() -> str:
     return "trace_" + uuid.uuid4().hex[:16]
 
@@ -80,6 +110,13 @@ class EventListener:
         pass
 
     def memory_killed(self, event: MemoryKillEvent) -> None:  # pragma: no cover
+        pass
+
+    def query_killed(self, event: QueryKilledEvent) -> None:  # pragma: no cover
+        pass
+
+    def worker_state_changed(
+            self, event: WorkerStateChangeEvent) -> None:  # pragma: no cover
         pass
 
 
@@ -101,6 +138,14 @@ class EventListenerManager:
     def memory_killed(self, event: MemoryKillEvent) -> None:
         for l in self._listeners:
             l.memory_killed(event)
+
+    def query_killed(self, event: QueryKilledEvent) -> None:
+        for l in self._listeners:
+            l.query_killed(event)
+
+    def worker_state_changed(self, event: WorkerStateChangeEvent) -> None:
+        for l in self._listeners:
+            l.worker_state_changed(event)
 
 
 def new_query_id() -> str:

@@ -38,6 +38,12 @@ def build_from_etc(etc_dir: str, port: int = 0):
     from presto_tpu import obs
 
     obs.maybe_enable_trace_dir(cfg)
+    # deterministic fault injection (testing_faults.py): inert unless
+    # the PRESTO_TPU_FAULTS/_FAULT_SEED env pair arms it — the chaos
+    # legs' entry point, a no-op in production
+    from presto_tpu.testing_faults import arm_from_env
+
+    arm_from_env()
     port = port or cfg.int("http-server.http.port", 0)
     if cfg.bool("coordinator", True):
         from presto_tpu.server.coordinator import CoordinatorServer
@@ -46,7 +52,21 @@ def build_from_etc(etc_dir: str, port: int = 0):
         log_path = cfg.query_log_path()
         if log_path:
             runner.events.add(obs.QueryLogListener(log_path))
-        server = CoordinatorServer(runner, port=port)
+        # coordinator.worker-uris (comma-separated) feeds the worker
+        # plane: the failure detector's heartbeats, /v1/worker +
+        # system_runtime_workers + the web-UI worker list, the memory
+        # manager's remote polls and system_metrics' per-node rows —
+        # without it a launcher-built coordinator has no fleet to watch
+        worker_uris = [u.strip()
+                       for u in cfg.str("coordinator.worker-uris",
+                                        "").split(",") if u.strip()]
+        server = CoordinatorServer(
+            runner, port=port, worker_uris=worker_uris,
+            # query.max-execution-time / query.max-queued-time: the
+            # deadline plane (docs/fault-tolerance.md; the deadline is
+            # opt-in, the queue bound replaces the hard-coded 600s)
+            max_execution_time=cfg.max_execution_time(),
+            max_queued_time=cfg.max_queued_time())
         role = "coordinator"
     else:
         from presto_tpu.memory import default_memory_pool

@@ -1,0 +1,275 @@
+"""Shared transient-HTTP plane: error classification + bounded retry.
+
+Reference analog: ``server/remotetask/RequestErrorTracker.java`` +
+``Backoff.java`` — every coordinator↔worker RPC in the reference rides
+one shared error tracker that distinguishes *transient* transport
+faults (retried with exponential backoff, eventually blamed on the
+node) from *deterministic* query errors (propagated immediately,
+never retried, never poisoning the node).  This module is that shared
+plane for the engine's urllib call sites: ``WorkerClient``,
+``shuffle_client``, ``cluster_memory``, and the coordinator's
+metrics/memory polls all classify and retry through here, so the
+transient/deterministic boundary cannot drift between tiers.
+
+Classification contract (docs/fault-tolerance.md):
+
+* transient — connection refused/reset, DNS, socket timeouts, HTTP
+  5xx (handler crash / gateway / draining worker), page-integrity
+  (CRC) failures.  Retryable: the work is a pure function of its
+  fragment, so recomputation is safe (worker task create is
+  idempotent by task id) and failover can move it to a survivor.
+* deterministic — HTTP 4xx (the request is wrong) and any error whose
+  text carries a query-error marker (``BindError``,
+  ``GroupCapacityExceeded``, type errors...).  Task-protocol query
+  errors travel as task-error payloads (``TaskPullFailed`` ->
+  ``TaskFailed``), not bare HTTP status.  A retry recomputes the same
+  failure; blaming the worker would poison failover.  These must
+  NEVER be retried.
+
+Every classified failure increments the pre-registered
+``net.errors_<reason>`` counter; every retry sleep increments
+``retry.http_total`` (obs/metrics.py catalog).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+_log = logging.getLogger("presto_tpu.net")
+
+#: classified failure reasons (each has a pre-registered
+#: ``net.errors_<reason>`` counter in the metrics catalog)
+REASONS = ("refused", "timeout", "http", "protocol", "other")
+
+#: error-text markers that mean a deterministic QUERY error even when
+#: it arrives wrapped in transport-level exception text — these must
+#: never be retried (the BindError/GroupCapacityExceeded class)
+DETERMINISTIC_MARKERS = (
+    "BindError", "GroupCapacityExceeded", "TypeError", "ValueError",
+    "PlanValidationError",
+)
+
+
+class PageIntegrityError(Exception):
+    """A pulled page failed its CRC check: the bytes were damaged in
+    flight or by a faulty producer.  Transient by classification — the
+    fragment is pure, so re-pulling/recomputing is always safe."""
+
+
+def classify_reason(exc: BaseException) -> str:
+    """Map an exception from an HTTP call site to one of REASONS."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return "http"
+    if isinstance(exc, (socket.timeout, TimeoutError)):
+        return "timeout"
+    if isinstance(exc, ConnectionRefusedError):
+        return "refused"
+    if isinstance(exc, urllib.error.URLError):
+        reason = getattr(exc, "reason", None)
+        if isinstance(reason, BaseException):
+            return classify_reason(reason)
+        return "protocol"
+    if isinstance(exc, (ConnectionError, OSError)):
+        return "refused" if "refused" in str(exc).lower() else "protocol"
+    if isinstance(exc, (PageIntegrityError, http.client.HTTPException)):
+        return "protocol"
+    return "other"
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when retrying the call could succeed (transport fault),
+    False for deterministic query errors that travel with the data."""
+    if isinstance(exc, urllib.error.HTTPError):
+        # marker check covers the STATUS TEXT only (str(HTTPError)
+        # includes the reason phrase, not the body — task-protocol
+        # query errors travel as task-error payloads, TaskPullFailed,
+        # and are classified before ever reaching here)
+        if any(m in str(exc) for m in DETERMINISTIC_MARKERS):
+            return False
+        # 5xx = the WORKER (or a proxy in front of it) is in trouble —
+        # 500 handler crash, 502/504 gateway, 503 draining: transient,
+        # so failover can move the work.  Deterministic query errors in
+        # the task protocol travel as task-error payloads
+        # (TaskPullFailed), not bare HTTP status.  4xx = the REQUEST is
+        # wrong: deterministic.
+        return exc.code >= 500
+    if isinstance(exc, PageIntegrityError):
+        return True
+    if isinstance(exc, http.client.HTTPException):
+        # half-written responses from a dying peer (RemoteDisconnected,
+        # IncompleteRead, BadStatusLine): node faults, not query errors
+        return True
+    if isinstance(exc, (urllib.error.URLError, ConnectionError,
+                        socket.timeout, TimeoutError, OSError)):
+        text = str(exc)
+        return not any(m in text for m in DETERMINISTIC_MARKERS)
+    return False
+
+
+def count_error(exc: BaseException, site: Optional[str] = None) -> str:
+    """Increment the per-reason error counter (and the per-site one
+    when ``site`` names a pre-registered ``<site>`` counter); returns
+    the reason label for the caller's own logging."""
+    from presto_tpu.obs import METRICS
+
+    reason = classify_reason(exc)
+    METRICS.counter(f"net.errors_{reason}").inc()  # metrics: allow
+    if site is not None:
+        METRICS.counter(site).inc()
+    return reason
+
+
+def http_retry(
+    fn: Callable[[], Any],
+    attempts: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 2.0,
+    jitter: float = 0.25,
+    site: Optional[str] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+) -> Any:
+    """Call ``fn`` retrying *transient* failures with exponential
+    backoff + jitter; deterministic errors propagate immediately.  The
+    last transient failure re-raises after the budget is spent.
+
+    ``site`` optionally names a pre-registered per-site error counter
+    (e.g. ``worker.ping_errors``); ``rng`` makes the jitter schedule
+    reproducible under the fault-injection harness."""
+    from presto_tpu.obs import METRICS
+
+    rng = rng or random
+    last: Optional[BaseException] = None
+    for attempt in range(max(attempts, 1)):
+        try:
+            return fn()
+        except Exception as e:
+            count_error(e, site=site)
+            if not is_transient(e) or attempt + 1 >= max(attempts, 1):
+                raise
+            last = e
+            METRICS.counter("retry.http_total").inc()
+            delay = min(base_delay * (2 ** attempt), max_delay)
+            sleep(delay * (1.0 + jitter * rng.random()))
+    raise last  # pragma: no cover - loop always returns or raises
+
+
+def request_bytes(
+    url: str,
+    timeout: float,
+    data: Optional[bytes] = None,
+    method: Optional[str] = None,
+    headers: Optional[Dict[str, str]] = None,
+    attempts: int = 1,
+    site: Optional[str] = None,
+) -> Tuple[bytes, Dict[str, str]]:
+    """One classified HTTP request returning (body, headers).  With
+    ``attempts > 1`` transient failures retry through http_retry."""
+
+    def call() -> Tuple[bytes, Dict[str, str]]:
+        req = urllib.request.Request(url, data=data, headers=headers or {},
+                                     method=method)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read(), dict(resp.headers.items())
+
+    if attempts <= 1:
+        try:
+            return call()
+        except Exception as e:
+            count_error(e, site=site)
+            raise
+    return http_retry(call, attempts=attempts, site=site)
+
+
+class PollHealth:
+    """Availability log for periodic pollers: one warning when a
+    target STARTS failing, one info when it recovers — never a line
+    per poll (the satellite contract for the old blind
+    ``except: pass`` swallows).  Counting stays per-poll via the
+    classified counters."""
+
+    def __init__(self, what: str, log: Optional[logging.Logger] = None):
+        self.what = what
+        self._log = log or _log
+        self._ok: Dict[str, bool] = {}
+
+    def succeeded(self, target: str) -> None:
+        if self._ok.get(target) is False:
+            self._log.info("%s poll of %s recovered", self.what, target)
+        self._ok[target] = True
+
+    def failed(self, target: str, exc: BaseException) -> str:
+        # counting happened at the request site (request_json's
+        # ``site=`` counter); this is ONLY the transition log
+        reason = classify_reason(exc)
+        if self._ok.get(target, True):
+            self._log.warning("%s poll of %s failing (%s: %s)",
+                              self.what, target, reason, exc)
+        self._ok[target] = False
+        return reason
+
+
+def poll_each(
+    targets: Iterable[str],
+    fetch: Callable[[str], Any],
+    health: Optional[PollHealth] = None,
+    join_timeout: float = 2.5,
+) -> Dict[str, Any]:
+    """Concurrently call ``fetch(target)`` for every target (the
+    RemoteNodeMemory poll-fan pattern shared by the coordinator's
+    metrics/memory polls and the cluster memory manager) and return
+    ``{target: result}`` for the successes.  A failing target is
+    simply absent — its error was classified/counted by the fetch's
+    own request site and transition-logged via ``health``; one hung
+    socket cannot stretch the cycle past ``join_timeout``."""
+    out: Dict[str, Any] = {}
+    lock = threading.Lock()
+
+    def run(target: str) -> None:
+        try:
+            value = fetch(target)
+            with lock:
+                out[target] = value
+            if health is not None:
+                health.succeeded(target)
+        except Exception as e:
+            if health is not None:
+                health.failed(target, e)
+
+    threads = [threading.Thread(target=run, args=(t,), daemon=True)
+               for t in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=join_timeout)
+    return out
+
+
+def request_json(
+    url: str,
+    timeout: float,
+    data: Optional[dict] = None,
+    method: Optional[str] = None,
+    headers: Optional[Dict[str, str]] = None,
+    attempts: int = 1,
+    site: Optional[str] = None,
+) -> Any:
+    """request_bytes + JSON decode (the control-plane shape every
+    poll/info/task-status call uses)."""
+    body = None
+    hdrs = dict(headers or {})
+    if data is not None:
+        body = json.dumps(data).encode()
+        hdrs.setdefault("Content-Type", "application/json")
+    raw, _ = request_bytes(url, timeout=timeout, data=body, method=method,
+                           headers=hdrs, attempts=attempts, site=site)
+    return json.loads(raw.decode())

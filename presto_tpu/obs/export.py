@@ -22,7 +22,8 @@ import threading
 from typing import Any, Dict, Optional
 
 from presto_tpu.events import (
-    EventListener, MemoryKillEvent, QueryCompletedEvent,
+    EventListener, MemoryKillEvent, QueryCompletedEvent, QueryKilledEvent,
+    WorkerStateChangeEvent,
 )
 from presto_tpu.obs.trace import Tracer
 
@@ -174,6 +175,34 @@ class QueryLogListener(EventListener):
             "reserved_bytes": e.reserved_bytes,
             "limit_bytes": e.limit_bytes,
             "kill_time": e.kill_time,
+        })
+
+    def query_killed(self, e: QueryKilledEvent) -> None:
+        """One ``"event": "query_killed"`` line per coordinator kill
+        decision (deadline / policy) with its reason code — e.g.
+        ``EXCEEDED_TIME_LIMIT`` when ``query.max-execution-time``
+        expired (docs/fault-tolerance.md)."""
+        self._append({
+            "event": "query_killed",
+            "query_id": e.query_id,
+            "reason": e.reason,
+            "message": e.message,
+            "limit_s": e.limit_s,
+            "elapsed_s": e.elapsed_s,
+            "kill_time": e.kill_time,
+        })
+
+    def worker_state_changed(self, e: WorkerStateChangeEvent) -> None:
+        """One ``"event": "worker_state_change"`` line per failure-
+        detector transition — the audit trail that a mid-query retry
+        actually crossed a worker death, not just a slow response."""
+        self._append({
+            "event": "worker_state_change",
+            "uri": e.uri,
+            "old_state": e.old_state,
+            "new_state": e.new_state,
+            "reason": e.reason,
+            "change_time": e.change_time,
         })
 
     def _append(self, rec: Dict[str, Any]) -> None:

@@ -217,6 +217,29 @@ def _preregister(reg: MetricsRegistry) -> None:
         "task.prefetch_hits", "task.prefetch_misses",
         # memory plane: cluster low-memory killer victims
         "memory.query_killed",
+        # fault-tolerance plane (parallel/failure.py + net.py +
+        # testing_faults.py; docs/fault-tolerance.md).  Classified
+        # transport errors by reason — one counter per reason keeps the
+        # label space fixed (no per-URI series):
+        "net.errors_refused", "net.errors_timeout", "net.errors_http",
+        "net.errors_protocol", "net.errors_other",
+        # per-site poll errors (the classified replacements for the
+        # old blind `except: pass` swallows)
+        "worker.ping_errors", "cluster.metrics_poll_errors",
+        "cluster.memory_poll_errors",
+        # retry plane: transient HTTP retries, fragment re-dispatches
+        # onto survivors, and splits recovered by coordinator-local
+        # execution after every worker failed
+        "retry.http_total", "retry.fragments_total",
+        "retry.splits_recovered_local",
+        # failure-detector state machine: transitions by target state
+        "worker.state_transitions", "worker.transitions_to_suspect",
+        "worker.transitions_to_dead", "worker.transitions_to_recovered",
+        "worker.transitions_to_alive",
+        # query deadlines: coordinator kills for EXCEEDED_TIME_LIMIT
+        "query.killed_deadline",
+        # deterministic fault-injection harness firings
+        "fault.injections_total",
     ):
         reg.counter(name)
     for name in (
@@ -227,6 +250,10 @@ def _preregister(reg: MetricsRegistry) -> None:
         # live split-scheduler state (exec/tasks.py wires the
         # sampling callbacks at import)
         "task.splits_queued", "task.splits_running",
+        # failure-detector worker-state census (parallel/failure.py
+        # wires the sampling callbacks when a detector is live)
+        "worker.state_alive", "worker.state_suspect",
+        "worker.state_dead", "worker.state_recovered",
     ):
         reg.gauge(name)
     for name in ("query.execution_ms", "xla.compile_ms"):

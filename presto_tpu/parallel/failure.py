@@ -1,0 +1,329 @@
+"""Coordinator-side worker failure detector.
+
+Reference analog: ``failureDetector/HeartbeatFailureDetector.java:77``
+— the coordinator heartbeats every known worker in the background,
+keeps a per-worker decayed failure stat, and exposes the set of nodes
+currently considered failed so the scheduler excludes them from split
+placement; recovered nodes re-admit after sustained success.
+
+Here each worker carries an explicit four-state machine::
+
+    ALIVE ──failures──▶ SUSPECT ──more failures──▶ DEAD
+      ▲                    │succ                     │ sustained succ
+      └────────────────────┘          RECOVERED ◀────┘
+      ▲─────────succ────────────────────│
+
+* ALIVE / SUSPECT / RECOVERED workers are schedulable; DEAD workers
+  are excluded from fragment assignment (the circuit breaker) and
+  probed only on an exponential-backoff schedule so a dead host costs
+  one cheap connect attempt per backoff window, not one per stage.
+* DEAD → RECOVERED needs ``recover_after`` consecutive successful
+  probes (the reference's sustained-recovery gate); the first
+  successful *scheduled* use moves RECOVERED → ALIVE.
+
+Transitions log ONCE per edge (not per poll) and feed the
+``worker.state_transitions`` / ``worker.transitions_to_*`` counters
+and the ``worker.state_*`` census gauges; ``snapshot()`` feeds the
+``system_runtime_workers`` table and the web UI worker list.
+
+Everything time-dependent takes an injectable ``clock`` (and the
+jitter a seeded rng), so the state machine unit-tests run on a fake
+clock with zero wallclock sleeps.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional
+
+_log = logging.getLogger("presto_tpu.failure")
+
+ALIVE, SUSPECT, DEAD, RECOVERED = "ALIVE", "SUSPECT", "DEAD", "RECOVERED"
+
+#: states the scheduler may assign fragments to
+SCHEDULABLE_STATES = (ALIVE, SUSPECT, RECOVERED)
+
+#: weak reference to the detector feeding the process-wide
+#: ``worker.state_*`` census gauges (last constructed wins; weak so a
+#: retired detector is collectable instead of pinned by the registry)
+_census_source: Optional["weakref.ref"] = None
+
+
+class WorkerHealth:
+    """One worker's detector state (mutated only under the detector's
+    lock)."""
+
+    __slots__ = ("uri", "state", "consecutive_failures",
+                 "consecutive_successes", "last_heartbeat", "last_error",
+                 "next_probe", "transitions")
+
+    def __init__(self, uri: str):
+        self.uri = uri
+        self.state = ALIVE
+        self.consecutive_failures = 0
+        self.consecutive_successes = 0
+        # clock() of the last SUCCESSFUL heartbeat (None before any)
+        self.last_heartbeat: Optional[float] = None
+        self.last_error: Optional[str] = None
+        # clock() before which the prober skips this worker (backoff)
+        self.next_probe = 0.0
+        self.transitions = 0
+
+    def row(self, now: float) -> dict:
+        """system_runtime_workers row (NULL-safe: last_heartbeat_ms is
+        None until the first successful heartbeat)."""
+        age_ms = (None if self.last_heartbeat is None
+                  else round((now - self.last_heartbeat) * 1e3, 3))
+        return {
+            "node_id": self.uri,
+            "uri": self.uri,
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+            "last_heartbeat_ms": age_ms,
+            "last_error": self.last_error,
+        }
+
+
+def _default_probe(uri: str, timeout: float) -> None:
+    """GET /v1/info (the heartbeat endpoint); raises on failure."""
+    from presto_tpu.net import request_json
+
+    request_json(f"{uri.rstrip('/')}/v1/info", timeout=timeout,
+                 site="worker.ping_errors")
+
+
+class FailureDetector:
+    """Heartbeats a set of worker URIs and answers "may I schedule
+    onto this worker?".  Passive use (record_success/record_failure
+    from real fragment traffic) and active probing (probe_once / the
+    background start() thread) feed the same state machine."""
+
+    def __init__(
+        self,
+        uris=(),
+        probe: Optional[Callable[[str, float], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        interval: float = 1.0,
+        probe_timeout: float = 2.0,
+        backoff_base: float = 0.5,
+        backoff_max: float = 15.0,
+        suspect_after: int = 1,
+        dead_after: int = 3,
+        recover_after: int = 2,
+        jitter: float = 0.1,
+        seed: int = 0,
+    ):
+        self._probe = probe or _default_probe
+        self._clock = clock
+        self.interval = interval
+        self.probe_timeout = probe_timeout
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.suspect_after = max(int(suspect_after), 1)
+        self.dead_after = max(int(dead_after), self.suspect_after)
+        self.recover_after = max(int(recover_after), 1)
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._workers: Dict[str, WorkerHealth] = {}
+        self._listeners: List[Callable[[str, str, str, Optional[str]], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # an EMPTY detector (idle CoordinatorServer / bare rigs) must
+        # not steal the census gauges from a live one — watch() wires
+        # them on the first watched worker
+        self._gauges_wired = False
+        for u in uris:
+            self.watch(u)
+
+    # -- registration -------------------------------------------------------
+    def watch(self, uri: str) -> WorkerHealth:
+        uri = uri.rstrip("/")
+        with self._lock:
+            h = self._workers.get(uri)
+            if h is None:
+                h = self._workers[uri] = WorkerHealth(uri)
+        if not self._gauges_wired:
+            self._wire_gauges()
+        return h
+
+    def add_transition_listener(
+            self, fn: Callable[[str, str, str, Optional[str]], None]) -> None:
+        """``fn(uri, old_state, new_state, reason)`` — called outside
+        the detector lock on every edge (event-log / metrics wiring)."""
+        self._listeners.append(fn)
+
+    def _wire_gauges(self) -> None:
+        """Point the process-wide ``worker.state_*`` census gauges at
+        this detector.  Last constructed wins (processes that run
+        several detectors should share one — the testing rig and
+        CoordinatorServer's ``detector=`` parameter exist for that);
+        the gauges hold only a WEAK reference, so a retired detector
+        is collectable and the census reads 0, never stale counts."""
+        global _census_source
+        self._gauges_wired = True
+        _census_source = weakref.ref(self)
+        from presto_tpu.obs import METRICS
+
+        def census(state: str) -> Callable[[], float]:
+            def count() -> float:
+                det = _census_source() if _census_source is not None \
+                    else None
+                if det is None:
+                    return 0.0
+                with det._lock:
+                    return float(sum(1 for h in det._workers.values()
+                                     if h.state == state))
+            return count
+
+        METRICS.gauge("worker.state_alive").set_fn(census(ALIVE))
+        METRICS.gauge("worker.state_suspect").set_fn(census(SUSPECT))
+        METRICS.gauge("worker.state_dead").set_fn(census(DEAD))
+        METRICS.gauge("worker.state_recovered").set_fn(census(RECOVERED))
+
+    # -- state machine ------------------------------------------------------
+    def _transition(self, h: WorkerHealth, new_state: str,
+                    reason: Optional[str]) -> Optional[tuple]:
+        if h.state == new_state:
+            return None
+        old = h.state
+        h.state = new_state
+        h.transitions += 1
+        return (h.uri, old, new_state, reason)
+
+    def _announce(self, edge: Optional[tuple]) -> None:
+        """Log + count + notify ONE transition (outside the lock)."""
+        if edge is None:
+            return
+        uri, old, new, reason = edge
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("worker.state_transitions").inc()
+        METRICS.counter(
+            f"worker.transitions_to_{new.lower()}").inc()  # metrics: allow
+        level = logging.INFO if new in (ALIVE, RECOVERED) else logging.WARNING
+        _log.log(level, "worker %s: %s -> %s%s", uri, old, new,
+                 f" ({reason})" if reason else "")
+        for fn in self._listeners:
+            try:
+                fn(uri, old, new, reason)
+            except Exception:
+                pass  # telemetry must never fail the detector
+
+    def record_success(self, uri: str) -> None:
+        h = self.watch(uri)
+        now = self._clock()
+        with self._lock:
+            h.consecutive_failures = 0
+            h.consecutive_successes += 1
+            h.last_heartbeat = now
+            h.last_error = None
+            h.next_probe = now + self.interval
+            if h.state == DEAD:
+                edge = (self._transition(h, RECOVERED, "probe succeeded")
+                        if h.consecutive_successes >= self.recover_after
+                        else None)
+            elif h.state in (SUSPECT, RECOVERED):
+                edge = self._transition(h, ALIVE, "heartbeat restored")
+            else:
+                edge = None
+        self._announce(edge)
+
+    def record_failure(self, uri: str, reason: str = "") -> None:
+        h = self.watch(uri)
+        now = self._clock()
+        with self._lock:
+            h.consecutive_successes = 0
+            h.consecutive_failures += 1
+            h.last_error = reason or None
+            backoff = min(
+                self.backoff_base * (2 ** (h.consecutive_failures - 1)),
+                self.backoff_max)
+            h.next_probe = now + backoff * (
+                1.0 + self.jitter * self._rng.random())
+            edges = []
+            if h.state in (ALIVE, RECOVERED) \
+                    and h.consecutive_failures >= self.suspect_after:
+                edges.append(self._transition(h, SUSPECT, reason))
+            if h.state == SUSPECT \
+                    and h.consecutive_failures >= self.dead_after:
+                edges.append(self._transition(h, DEAD, reason))
+        for edge in edges:
+            self._announce(edge)
+
+    # -- queries ------------------------------------------------------------
+    def health(self, uri: str) -> WorkerHealth:
+        return self.watch(uri)
+
+    def state(self, uri: str) -> str:
+        return self.watch(uri).state
+
+    def is_schedulable(self, uri: str) -> bool:
+        """The circuit breaker: DEAD workers are excluded from
+        fragment assignment until sustained probes re-admit them."""
+        return self.watch(uri).state in SCHEDULABLE_STATES
+
+    def probe_due(self, uri: str) -> bool:
+        """True when the backoff window for this worker has elapsed —
+        schedulers may attempt one optimistic contact then."""
+        return self._clock() >= self.watch(uri).next_probe
+
+    def schedulable(self) -> List[str]:
+        with self._lock:
+            return [u for u, h in self._workers.items()
+                    if h.state in SCHEDULABLE_STATES]
+
+    def snapshot(self) -> List[dict]:
+        """system_runtime_workers / web-UI rows."""
+        now = self._clock()
+        with self._lock:
+            return [h.row(now) for h in self._workers.values()]
+
+    # -- active probing -----------------------------------------------------
+    def probe_once(self, force: bool = False) -> None:
+        """One heartbeat pass over every worker whose backoff window
+        has elapsed (all of them with ``force``).  Synchronous — the
+        unit-test entry point; the background thread just loops it."""
+        now = self._clock()
+        with self._lock:
+            due = [h.uri for h in self._workers.values()
+                   if force or now >= h.next_probe]
+        for uri in due:
+            try:
+                self._probe(uri, self.probe_timeout)
+            except Exception as e:
+                self.record_failure(uri, f"{type(e).__name__}: {e}")
+            else:
+                self.record_success(uri)
+
+    def start(self) -> None:
+        """Background heartbeat loop (HeartbeatFailureDetector's
+        scheduled executor)."""
+        if self._thread is not None:
+            return
+        # a FRESH event per generation: the old loop keeps its own
+        # (already-set) event captured, so a stop()/start() cycle can
+        # never revive a prior loop no matter how slowly its last
+        # probe pass drains — at most one heartbeat loop ever runs
+        stop = self._stop = threading.Event()
+
+        def loop():
+            while not stop.wait(self.interval):
+                try:
+                    self.probe_once()
+                except Exception:
+                    pass  # the detector outlives any single bad pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="failure-detector")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval + 1.0)  # best-effort drain

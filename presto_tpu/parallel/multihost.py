@@ -65,7 +65,12 @@ class _StageCapacity(Exception):
 #: a degraded path) rather than a deterministic query failure
 _TRANSPORT_MARKERS = ("URLError", "Connection refused", "ConnectionRefused",
                       "RemoteDisconnected", "TimeoutError", "timed out",
-                      "no progress")
+                      "no progress",
+                      # CRC damage in a worker-to-worker shuffle pull
+                      # surfaces inside the stage-2 task's error text;
+                      # the fragment is pure, so it recomputes (net.py
+                      # classifies PageIntegrityError transient)
+                      "PageIntegrityError")
 
 
 class MultiHostUnsupported(Exception):
@@ -80,43 +85,75 @@ class WorkerClient:
     whole task's output in one response and the producer sees
     backpressure from unacknowledged bytes."""
 
-    def __init__(self, uri: str, max_attempts: int = 3, timeout: float = 300.0):
+    def __init__(self, uri: str, max_attempts: int = 3, timeout: float = 300.0,
+                 detector=None):
         self.uri = uri.rstrip("/")
         self.max_attempts = max_attempts
         self.timeout = timeout
         self.alive = True
+        # failure detector sink (parallel/failure.py): every real
+        # protocol outcome feeds the same state machine the background
+        # heartbeat does, so the circuit breaker sees fragment traffic
+        self.detector = detector
         # request-correlation token stamped by the runner before a
         # fan-out (X-Presto-Trace-Token, the reference's
         # GenerateTraceTokenRequestFilter contract): every task POST
         # carries it so worker-side spans stitch into the query's trace
         self.trace_token: Optional[str] = None
 
+    def _ok(self) -> None:
+        self.alive = True
+        if self.detector is not None:
+            self.detector.record_success(self.uri)
+
+    def _failed(self, exc: BaseException) -> None:
+        self.alive = False
+        if self.detector is not None:
+            self.detector.record_failure(
+                self.uri, f"{type(exc).__name__}: {exc}")
+
     def ping(self, timeout: float = 5.0) -> bool:
+        """Heartbeat probe with CLASSIFIED failure handling: each
+        failure increments the per-reason net.errors_* counters and
+        worker.ping_errors; state-transition logging is the failure
+        detector's (once per edge, never per poll)."""
+        from presto_tpu.net import request_json
+
         try:
-            with urllib.request.urlopen(f"{self.uri}/v1/info", timeout=timeout) as r:
-                json.load(r)
-            self.alive = True
-        except Exception:
-            self.alive = False
+            # site= counts ONCE per failure (worker.ping_errors +
+            # net.errors_<reason>) inside the request helper
+            request_json(f"{self.uri}/v1/info", timeout=timeout,
+                         site="worker.ping_errors")
+            self._ok()
+        except Exception as e:
+            self._failed(e)
         return self.alive
 
     def run_fragment(self, fragment_json: dict) -> List[bytes]:
+        from presto_tpu.net import is_transient
+
         last: Optional[Exception] = None
         for attempt in range(self.max_attempts):
             try:
                 # a fresh task id per attempt: fragments are pure, so a
                 # retried task simply recomputes (at-least-once overall,
                 # de-duplicated by task id server-side)
-                return self._pull_task(fragment_json)
+                out = self._pull_task(fragment_json)
+                self._ok()
+                return out
             except TaskFailed:
                 # a deterministic query error, NOT a worker fault:
                 # retrying recomputes the same failure and blaming the
                 # worker would poison failover
                 raise
             except Exception as e:
+                if not is_transient(e):
+                    # deterministic by classification (net.py): never
+                    # retried, never blamed on the worker
+                    raise TaskFailed(f"{type(e).__name__}: {e}") from e
                 last = e
                 time.sleep(min(0.1 * (2 ** attempt), 2.0))
-        self.alive = False
+        self._failed(last)
         raise ConnectionError(f"worker {self.uri} failed: {last}")
 
     def create_task(self, fragment_json: dict,
@@ -149,12 +186,32 @@ class WorkerClient:
         consults /v1/task/{id} on error (the continuous status
         fetcher's role, ContinuousTaskStatusFetcher analog, without a
         dedicated polling thread per pull)."""
+        from presto_tpu.net import PageIntegrityError
+        from presto_tpu.server.serde import verify_page
         from presto_tpu.server.shuffle_client import TaskPullFailed, pull_pages
 
         try:
-            return list(pull_pages(self.uri, tid, 0, timeout=self.timeout))
+            raws = list(pull_pages(self.uri, tid, 0, timeout=self.timeout))
         except TaskPullFailed as e:
+            if "PageIntegrityError" in str(e):
+                # the task failed because its INPUT page arrived
+                # damaged — a transport fault, not a query error.
+                # Retrying is safe for every fragment run_fragment
+                # ships (scan-leaf and pre-chunk inputs travel INSIDE
+                # the fragment, so a retry re-serializes fresh bytes);
+                # RemoteSource consumers never come through here —
+                # they run via _fan_out_stage2, whose transport-marker
+                # triage falls back to a coordinator-merge that
+                # recomputes from base tables rather than re-pulling a
+                # drained upstream buffer
+                raise PageIntegrityError(str(e)) from e
             raise TaskFailed(str(e)) from e
+        for r in raws:
+            # CRC check at the pull boundary: a damaged page raises
+            # PageIntegrityError (transient) HERE, inside the caller's
+            # retry loop, instead of poisoning the stage-level decode
+            verify_page(r)
+        return raws
 
     def delete_task(self, tid: str) -> None:
         try:
@@ -188,11 +245,36 @@ class MultiHostRunner:
                  broadcast_threshold: Optional[int] = None,
                  worker_locations: Optional[dict] = None,
                  max_splits_per_node: int = 0,
-                 execution_policy: str = "phased"):
+                 execution_policy: str = "phased",
+                 detector=None, events=None,
+                 max_fragment_retries: Optional[int] = None):
+        from presto_tpu.parallel.failure import FailureDetector
         from presto_tpu.parallel.fragment import DEFAULT_BROADCAST_THRESHOLD
 
         self.catalog = catalog
-        self.workers = [WorkerClient(u) for u in worker_uris]
+        # failure detector: one state machine per worker, fed by every
+        # ping AND every real fragment outcome; DEAD workers are
+        # excluded from assignment until their backoff window lets one
+        # optimistic probe through (the circuit breaker)
+        self.detector = detector or FailureDetector(worker_uris)
+        if events is not None:
+            import time as _time
+
+            from presto_tpu.events import WorkerStateChangeEvent
+
+            self.detector.add_transition_listener(
+                lambda uri, old, new, reason: events.worker_state_changed(
+                    WorkerStateChangeEvent(
+                        uri=uri, old_state=old, new_state=new,
+                        reason=reason, change_time=_time.time())))
+        self.workers = [WorkerClient(u, detector=self.detector)
+                        for u in worker_uris]
+        # per-stage fragment re-dispatch budget: bounds how long a
+        # query chases a flapping cluster before the coordinator-local
+        # fallback finishes the work itself
+        self.max_fragment_retries = (max(4, 2 * len(self.workers))
+                                     if max_fragment_retries is None
+                                     else max_fragment_retries)
         # the coordinator-local fallback (and glue execution) runs its
         # scan splits through the morsel scheduler like every other
         # LocalRunner; worker-side fragments get it inside
@@ -265,6 +347,25 @@ class MultiHostRunner:
             out.dist_stages = 0
             out.dist_fallback = reason
             return out
+
+    def _live_workers(self) -> List["WorkerClient"]:
+        """Workers eligible for fragment assignment: the failure
+        detector's circuit breaker skips DEAD workers whose backoff
+        window has not elapsed (no connect attempt at all), and a ping
+        confirms the rest — feeding the same detector, so a recovered
+        worker re-admits here."""
+        alive = []
+        for w in self.workers:
+            if not self.detector.is_schedulable(w.uri) \
+                    and not self.detector.probe_due(w.uri):
+                continue  # circuit open: skip without a connect attempt
+            # the ping feeds the detector; the SECOND is_schedulable
+            # check enforces recover_after — a DEAD worker's first
+            # successful probe leaves it DEAD (not yet re-admitted),
+            # so placement waits for sustained recovery
+            if w.ping() and self.detector.is_schedulable(w.uri):
+                alive.append(w)
+        return alive
 
     # ------------------------------------------------------------------
     def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
@@ -424,8 +525,11 @@ class MultiHostRunner:
         """Ship a fragment whose chain leaf is a materialized page:
         the page re-chunks row-wise across live workers and each chunk
         travels INSIDE its worker's fragment (serde "pre" node).  A
-        failed worker's chunk re-runs on a survivor."""
-        alive = [w for w in self.workers if w.ping()]
+        failed worker's chunk re-runs on a survivor; with no survivors
+        (or a spent retry budget) remaining chunks run on the
+        coordinator — the fragment is pure, so local execution is
+        always a correct last resort."""
+        alive = self._live_workers()
         if not alive:
             raise MultiHostUnsupported("no live workers")
         chunks = _chunk_page(pre.page, len(alive))
@@ -468,18 +572,15 @@ class MultiHostRunner:
                 t.join()
 
         launch(list(zip(alive, chunks)))
-        while failed:
-            if errors:
-                break
-            chunk = failed.pop()
-            survivors = [w for w in alive if w.alive]
-            if not survivors:
-                raise ConnectionError("all workers failed")
-            launch([(survivors[0], chunk)])
-        if errors:
-            raise errors[0]
+        local_pages = self._failover(
+            failed, alive, errors,
+            # rotate retried chunks across survivors, not just [0]
+            lambda chunk, survivors, rr: launch(
+                [(survivors[rr % len(survivors)], chunk)]),
+            lambda chunk: self._run_chunk_local(fragment_root, pre, chunk))
 
-        return [deserialize_page(r, dictionaries) for r in results]
+        return [deserialize_page(r, dictionaries, verify=False)
+                for r in results] + local_pages
 
     def _run_agg_with_retry(self, agg: AggregationNode, scan: TableScanNode):
         """Grouped aggregations with >=2 live workers run the full
@@ -489,7 +590,7 @@ class MultiHostRunner:
         coordinator-merge fallback below.  A chain containing a join
         whose build side is too large to broadcast repartitions BOTH
         join sides across workers first (the DCN shuffle join)."""
-        alive = [w for w in self.workers if w.ping()]
+        alive = self._live_workers()
         if len(alive) >= 2:
             join = self._partitionable_join(agg.source)
             if join is not None:
@@ -747,7 +848,7 @@ class MultiHostRunner:
                     continue
 
                 dicts = [c.dictionary for c in partial.channels]
-                pages = [deserialize_page(r, dicts) for r in results]
+                pages = [deserialize_page(r, dicts, verify=False) for r in results]
                 if not pages:
                     from presto_tpu.page import Page
 
@@ -864,7 +965,7 @@ class MultiHostRunner:
                     continue
 
                 dicts = [c.dictionary for c in final.channels]
-                pages = [deserialize_page(r, dicts) for r in results]
+                pages = [deserialize_page(r, dicts, verify=False) for r in results]
                 if not pages:
                     from presto_tpu.page import Page
 
@@ -950,10 +1051,12 @@ class MultiHostRunner:
     # ------------------------------------------------------------------
     def _run_fragments(self, fragment_root: PlanNode, scan: TableScanNode):
         """Schedule split ranges across live workers; reassign a failed
-        worker's splits to survivors (elastic leaf recovery).  The
-        shipped fragment is ``fragment_root``'s subtree with the scan's
-        split list swapped per assignment."""
-        alive = [w for w in self.workers if w.ping()]
+        worker's splits to survivors (elastic leaf recovery) under a
+        bounded per-stage retry budget, and finish remaining splits
+        with coordinator-local execution when no worker can run them.
+        The shipped fragment is ``fragment_root``'s subtree with the
+        scan's split list swapped per assignment."""
+        alive = self._live_workers()
         if not alive:
             raise MultiHostUnsupported("no live workers")
 
@@ -1030,22 +1133,109 @@ class MultiHostRunner:
 
         launch(assignments.items())
 
-        # failover: re-run dead workers' splits on survivors
-        while failed:
-            if errors:
-                break
-            w_dead, splits = failed.pop()
-            survivors = [w for w in alive if w.alive]
-            if not survivors:
-                raise ConnectionError("all workers failed")
-            chunks = [splits[i :: len(survivors)] for i in range(len(survivors))]
+        # failover: re-run dead workers' splits on survivors (striped
+        # across all of them), spending the bounded per-stage retry
+        # budget; when the budget is gone or no worker survives, the
+        # coordinator runs the remaining splits itself (fragments are
+        # pure — local execution is the always-correct last resort,
+        # used ONLY when no worker can)
+        def redispatch(item, survivors, _rr):
+            _w_dead, splits = item
+            chunks = [splits[i :: len(survivors)]
+                      for i in range(len(survivors))]
             launch(list(zip(survivors, chunks)))
-        if errors:
-            raise errors[0]
+
+        def run_local(item):
+            _w_dead, splits = item
+            pages = self._run_splits_local(fragment_root, scan, splits)
+            if prog is not None:
+                prog.split_done(prog_stage, n=len(splits))
+            return pages
+
+        local_pages = self._failover(failed, alive, errors,
+                                     redispatch, run_local)
 
         if prog is not None:
             prog.finish_stage(prog_stage)
-        return [deserialize_page(r, dictionaries) for r in results]
+        return [deserialize_page(r, dictionaries, verify=False)
+                for r in results] + local_pages
+
+
+    # -- shared failover driver ----------------------------------------
+    def _failover(self, failed: List, alive: List["WorkerClient"],
+                  errors: List[BaseException], redispatch, run_local):
+        """Drain the ``failed`` work list: re-dispatch each item onto
+        survivors under the bounded per-stage retry budget
+        (``redispatch(item, survivors, attempt_index)``), falling back
+        to coordinator-local execution (``run_local(item)`` -> pages)
+        when no worker survives or the budget is spent.  Raises the
+        first deterministic error instead of dropping rows; returns
+        the locally recovered pages."""
+        from presto_tpu.obs import METRICS
+
+        local_pages: List = []
+        budget = self.max_fragment_retries
+        rr = 0
+        while failed:
+            if errors:
+                break
+            item = failed.pop()
+            survivors = [w for w in alive if w.alive]
+            if not survivors or budget <= 0:
+                local_pages.extend(run_local(item))
+                continue
+            budget -= 1
+            METRICS.counter("retry.fragments_total").inc()
+            redispatch(item, survivors, rr)
+            rr += 1
+        if errors:
+            raise errors[0]
+        return local_pages
+
+    # -- coordinator-local last resort ---------------------------------
+    def _local_fragment_pages(self, fragment_root: PlanNode):
+        """Run a fragment on the coordinator's own LocalRunner, round-
+        tripping the wire serde so downstream merging sees exactly
+        what a worker would have shipped."""
+        from presto_tpu.server.serde import serialize_page
+
+        raws = [serialize_page(p)
+                for p in self.local._pages(fragment_root)]
+        dicts = [c.dictionary for c in fragment_root.channels]
+        return [deserialize_page(r, dicts, verify=False) for r in raws]
+
+    def _run_splits_local(self, fragment_root: PlanNode,
+                          scan: TableScanNode, splits: List[int]):
+        """Execute a scan-leaf fragment's splits on the coordinator —
+        the terminal fallback when every worker is dead or the retry
+        budget is spent."""
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("retry.splits_recovered_local").inc(len(splits))
+        _log.warning(
+            "no worker available for %d split(s) of %s; finishing them "
+            "on the coordinator", len(splits), scan.handle.table)
+        original = scan.splits
+        try:
+            scan.splits = list(splits)
+            return self._local_fragment_pages(fragment_root)
+        finally:
+            scan.splits = original
+
+    def _run_chunk_local(self, fragment_root: PlanNode,
+                         pre: PrecomputedNode, chunk):
+        """_run_splits_local for a materialized-intermediate chunk."""
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("retry.splits_recovered_local").inc()
+        _log.warning("no worker available for an intermediate chunk; "
+                     "finishing it on the coordinator")
+        original = pre.page
+        try:
+            pre.page = chunk
+            return self._local_fragment_pages(fragment_root)
+        finally:
+            pre.page = original
 
 
 def _chunk_page(page, k: int):

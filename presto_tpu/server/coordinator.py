@@ -63,6 +63,7 @@ _UI_HTML = """<!doctype html>
 </style></head><body>
 <h1>presto-tpu cluster console</h1>
 <div class="tiles" id="tiles"></div>
+<div id="workers"></div>
 <div id="detail"></div>
 <table><thead><tr><th>query id</th><th>state</th><th>progress</th><th>rows</th><th>sql</th></tr></thead>
 <tbody id="queries"></tbody></table>
@@ -74,6 +75,18 @@ async function refresh(){
     ['runningQueries','queuedQueries','finishedQueries','failedQueries']
     .map(k=>`<div class="tile"><div class="v">${c[k]??0}</div><div class="l">${k.replace('Queries',' queries')}</div></div>`).join('')
     + (c.totalBytes?`<div class="tile"><div class="v">${(100*c.reservedBytes/c.totalBytes).toFixed(1)}%</div><div class="l">pool reserved</div></div>`:'');
+  const ws = await (await fetch('/v1/worker')).json();
+  document.getElementById('workers').innerHTML = !ws.length ? '' :
+    '<h2>workers</h2><table><thead><tr><th>worker</th><th>detector state</th>'+
+    '<th>consecutive failures</th><th>last heartbeat</th></tr></thead><tbody>'+
+    ws.map(w=>{
+      const cls = {ALIVE:'FINISHED',RECOVERED:'FINISHED',SUSPECT:'QUEUED',
+                   DEAD:'FAILED'}[w.state]||'';
+      const hb = w.last_heartbeat_ms==null?'never'
+                 :(w.last_heartbeat_ms/1000).toFixed(1)+'s ago';
+      return `<tr><td>${w.uri}</td><td class="${cls}">${w.state}</td>`+
+             `<td>${w.consecutive_failures}</td><td>${hb}</td></tr>`;
+    }).join('')+'</tbody></table>';
   const qs = await (await fetch('/v1/query')).json();
   document.getElementById('queries').innerHTML = qs.reverse().map(q=>
     `<tr class="row" onclick="select('${q.id}')"><td>${q.id}</td>`+
@@ -153,6 +166,15 @@ class _QueryState:
         self.execution_ms: Optional[float] = None
         # client-supplied request correlation (X-Presto-Trace-Token)
         self.trace_token: Optional[str] = None
+        # deadline bookkeeping: the effective limit (None = none) and
+        # the monotonic instant execution started
+        self.deadline_s: Optional[float] = None
+        self.t_running: Optional[float] = None
+        # the admission slot this query holds (set after acquire) and
+        # its once-only release guard: a kill frees the slot
+        # immediately instead of waiting for the zombie thread
+        self.group = None
+        self.group_released = False
 
     def summary(self) -> dict:
         from presto_tpu import obs
@@ -177,7 +199,9 @@ class CoordinatorServer:
 
     def __init__(self, runner: QueryRunner, host: str = "127.0.0.1", port: int = 0,
                  resource_groups=None, worker_uris=(), memory_threshold: float = 0.95,
-                 authenticator=None):
+                 authenticator=None, max_execution_time: float = 0.0,
+                 max_queued_time: float = 600.0, deadline_grace: float = 5.0,
+                 detector=None):
         from presto_tpu.resource_groups import ResourceGroupManager
 
         # optional PasswordAuthenticator (server/security + the
@@ -187,6 +211,32 @@ class CoordinatorServer:
         self.queries: Dict[str, _QueryState] = {}
         self.resource_groups = resource_groups or ResourceGroupManager()
         self.worker_uris = list(worker_uris)
+        # query deadlines (query.max-execution-time / max-queued-time
+        # config keys): the coordinator kills a query that runs past
+        # its deadline — frees its memory reservations, emits a
+        # QueryKilledEvent(EXCEEDED_TIME_LIMIT), fails the statement.
+        # The deadline is OPT-IN (default 0 = none: the legacy 600s
+        # was a long-poll bound, not a kill); the queue bound replaces
+        # the old hard-coded 600s acquire wait.
+        self.max_execution_time = float(max_execution_time)
+        self.max_queued_time = float(max_queued_time)
+        self.deadline_grace = float(deadline_grace)
+        # worker failure detector (parallel/failure.py): background
+        # heartbeats with backoff, state machine per worker, surfaced
+        # through /v1/worker, system_runtime_workers and the web UI;
+        # transitions flow into the event pipeline (query log)
+        from presto_tpu.parallel.failure import FailureDetector
+
+        self.failure_detector = detector or FailureDetector(self.worker_uris)
+        import time as _time
+
+        from presto_tpu.events import WorkerStateChangeEvent
+
+        self.failure_detector.add_transition_listener(
+            lambda uri, old, new, reason:
+            runner.events.worker_state_changed(WorkerStateChangeEvent(
+                uri=uri, old_state=old, new_state=new, reason=reason,
+                change_time=_time.time())))
         self._lock = threading.Lock()
         # cluster-wide OOM protection (memory/ClusterMemoryManager.java:88):
         # polls local + worker pools, kills the biggest reserver at the
@@ -214,6 +264,14 @@ class CoordinatorServer:
                     conn.remote_metrics = self.remote_metrics
                 if conn.pools is None:
                     conn.pools = self.memory_pool_rows
+                if conn.workers is None:
+                    conn.workers = self.worker_rows
+        # availability-transition logging for the metrics/memory polls:
+        # once per state change, never per poll cycle
+        from presto_tpu.net import PollHealth
+
+        self._metrics_poll_health = PollHealth("worker metrics")
+        self._memory_poll_health = PollHealth("worker memory")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -286,7 +344,12 @@ class CoordinatorServer:
                 if self.headers.get("X-Presto-Async"):
                     q.done.wait(timeout=0.05)  # fast queries: one page
                 else:
-                    q.done.wait(timeout=600)
+                    # config-driven long-poll bound (was a magic 600):
+                    # with a deadline set, the deadline killer fires
+                    # within limit+grace, so the wait below always
+                    # returns a terminal (or pollable) page — a
+                    # deadline-exceeding query can never hang the POST
+                    q.done.wait(timeout=outer._blocking_wait(q))
                 self._json(200, outer._page_response(q, 0))
 
             def do_GET(self):
@@ -322,6 +385,12 @@ class CoordinatorServer:
                     return
                 if parts == ["v1", "cluster"]:
                     self._json(200, outer._cluster_stats())
+                    return
+                if parts == ["v1", "worker"]:
+                    # failure-detector view of the worker fleet (feeds
+                    # the web UI worker list; same rows as the
+                    # system_runtime_workers table)
+                    self._json(200, outer.worker_rows())
                     return
                 if parts in ([], ["ui"]):
                     self._html(200, _UI_HTML)
@@ -390,8 +459,11 @@ class CoordinatorServer:
         self._thread.start()
         if self.memory_manager is not None:
             self.memory_manager.start()
+        if self.worker_uris:
+            self.failure_detector.start()
 
     def stop(self, drain_timeout: float = 30.0) -> None:
+        self.failure_detector.stop()
         if self.memory_manager is not None:
             self.memory_manager.stop()
         if self._thread.is_alive():  # shutdown() blocks unless serving
@@ -409,6 +481,21 @@ class CoordinatorServer:
         for t in pending:
             t.join(max(0.0, deadline - time.monotonic()))
 
+    def _release_group(self, q: _QueryState) -> None:
+        """Release a query's admission slot EXACTLY once — callable
+        from both the computation thread's finally and a killer (the
+        deadline timer / memory manager), so a killed query frees its
+        slot immediately instead of holding it until the cooperative
+        thread unwinds.  The zombie thread may briefly run past the
+        group's concurrency limit; that window is the same one the
+        cooperative memory-kill protocol already accepts."""
+        with self._lock:
+            if q.group is None or q.group_released:
+                return
+            q.group_released = True
+            group = q.group
+        group.release()
+
     def _kill_query(self, qid: str) -> None:
         """LowMemoryKiller action: cancel through the normal state path
         (the computation thread discards its result on completion)."""
@@ -419,6 +506,74 @@ class CoordinatorServer:
                     q.state = "CANCELED"
                     q.error = "query killed by the cluster memory manager"
                     q.done.set()
+            self._release_group(q)
+
+    # -- deadlines ------------------------------------------------------
+    def _effective_deadline(self) -> float:
+        """Seconds a query may run: the ``query_max_execution_time``
+        session property when set, else the coordinator's
+        ``query.max-execution-time`` config default (0 = none)."""
+        from presto_tpu.config import parse_duration
+
+        try:
+            prop = str(self.runner.session.get("query_max_execution_time"))
+        except KeyError:
+            prop = ""
+        if prop.strip():
+            return parse_duration(prop, self.max_execution_time)
+        return self.max_execution_time
+
+    def _blocking_wait(self, q: _QueryState) -> Optional[float]:
+        """Bound for the legacy blocking POST: deadline + grace when
+        that is tighter (the killer resolves the query within it),
+        capped at the protocol's 600s long-poll bound — either way the
+        response always arrives, carrying nextUri for a query still
+        queued or running, so clients with their own socket timeouts
+        (StatementClient's 650s default) never starve."""
+        # prefer the limit the killer was actually ARMED with (set when
+        # the query went RUNNING); fall back to the session-derived
+        # value for still-queued queries
+        limit = (q.deadline_s if q.deadline_s is not None
+                 else self._effective_deadline())
+        if limit and limit > 0:
+            return min(600.0, limit + self.deadline_grace)
+        return 600.0
+
+    def _deadline_kill(self, q: _QueryState, limit: float) -> None:
+        """Timer action at deadline expiry: fail the statement with
+        EXCEEDED_TIME_LIMIT, free the query's memory reservations
+        (poisoning future ones, so the computation thread unwinds at
+        its next reservation), and emit the kill event."""
+        with self._lock:
+            if q.state != "RUNNING":
+                return
+            q.state = "FAILED"
+            q.error = (f"Query exceeded the maximum execution time of "
+                       f"{limit:g}s (EXCEEDED_TIME_LIMIT)")
+        pool = getattr(self.runner.executor, "memory_pool", None)
+        if pool is not None:
+            pool.kill_query(q.id)
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("query.killed_deadline").inc()
+        elapsed = (round(time.monotonic() - q.t_running, 3)
+                   if q.t_running is not None else None)
+        try:
+            from presto_tpu.events import QueryKilledEvent
+
+            self.runner.events.query_killed(QueryKilledEvent(
+                query_id=q.id, reason="EXCEEDED_TIME_LIMIT",
+                message=q.error, limit_s=limit, elapsed_s=elapsed,
+                kill_time=time.time()))
+        except Exception:
+            pass  # telemetry must never block the kill
+        self._release_group(q)
+        q.done.set()
+
+    def worker_rows(self) -> List[dict]:
+        """Failure-detector rows for /v1/worker and the
+        system_runtime_workers table (NULL-safe columns)."""
+        return self.failure_detector.snapshot()
 
     @property
     def uri(self) -> str:
@@ -440,7 +595,13 @@ class CoordinatorServer:
                     prio = int(self.runner.session.get("query_priority"))
                 except Exception:
                     prio = 0
-                group.acquire(timeout=600, priority=prio)
+                # config-driven queue bound (query.max-queued-time; was
+                # a magic 600): expiry surfaces as a proper FAILED
+                # statement below, never a hang
+                group.acquire(
+                    timeout=(self.max_queued_time
+                             if self.max_queued_time > 0 else None),
+                    priority=prio)
             except Exception as e:
                 with self._lock:
                     if q.state == "QUEUED":
@@ -449,11 +610,29 @@ class CoordinatorServer:
                 q.done.set()
                 return
             with self._lock:
+                q.group = group
                 if q.state != "QUEUED":  # canceled while queued
-                    group.release()
-                    q.done.set()
-                    return
-                q.state = "RUNNING"
+                    pass  # fall through to the release below
+                else:
+                    q.state = "RUNNING"
+                    q.t_running = time.monotonic()
+            if q.state != "RUNNING":
+                self._release_group(q)
+                q.done.set()
+                return
+            # deadline enforcement (query.max-execution-time config /
+            # query_max_execution_time session property): the killer
+            # fails the statement, frees the query's memory
+            # reservations and emits QueryKilledEvent with reason
+            # EXCEEDED_TIME_LIMIT
+            limit = self._effective_deadline()
+            timer = None
+            if limit > 0:
+                q.deadline_s = limit
+                timer = threading.Timer(
+                    limit, self._deadline_kill, args=(q, limit))
+                timer.daemon = True
+                timer.start()
             try:
                 res = self.runner.execute(sql, query_id=q.id,
                                           trace_token=q.trace_token)
@@ -481,7 +660,9 @@ class CoordinatorServer:
                         q.error = f"{type(e).__name__}: {e}"
                         q.state = "FAILED"
             finally:
-                group.release()
+                if timer is not None:
+                    timer.cancel()
+                self._release_group(q)
                 q.done.set()
 
         t = threading.Thread(target=run, daemon=True)
@@ -556,67 +737,48 @@ class CoordinatorServer:
     # ------------------------------------------------------------------
     def remote_metrics(self) -> Dict[str, List]:
         """Poll every worker's ``/v1/metrics?format=json`` concurrently
-        (RemoteNodeMemory's poll pattern) — the fan-in behind
+        (net.poll_each; failures are classified, counted and
+        transition-logged there — a dead worker's liveness itself is
+        the failure detector's job) — the fan-in behind
         system_metrics' per-node rows and cluster rollup."""
-        import json as _json
-        import urllib.request
+        from presto_tpu.net import poll_each, request_json
 
-        out: Dict[str, List] = {}
-        lock = threading.Lock()
-
-        def poll(uri):
-            try:
-                with urllib.request.urlopen(
-                        f"{uri}/v1/metrics?format=json", timeout=2.0) as r:
-                    payload = _json.load(r)
-                with lock:
-                    out[payload.get("node") or uri] = [
-                        (n, float(v)) for n, v in payload.get("metrics", [])]
-            except Exception:
-                pass  # dead workers are the failure detector's job
-
-        threads = [threading.Thread(target=poll, args=(u,), daemon=True)
-                   for u in self.worker_uris]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=2.5)
-        return out
+        payloads = poll_each(
+            self.worker_uris,
+            lambda uri: request_json(
+                f"{uri}/v1/metrics?format=json", timeout=2.0,
+                site="cluster.metrics_poll_errors"),
+            health=self._metrics_poll_health)
+        return {
+            payload.get("node") or uri: [
+                (n, float(v)) for n, v in payload.get("metrics", [])]
+            for uri, payload in payloads.items()
+        }
 
     def memory_pool_rows(self) -> List[dict]:
         """system_memory_pools rows for this cluster: the local pool +
-        every worker's ``/v1/info`` memory section."""
-        import json as _json
-        import urllib.request
-
+        every worker's ``/v1/info`` memory section (net.poll_each —
+        same classification/transition-log contract as the metrics
+        poll)."""
         from presto_tpu.connectors.system import pool_row
+        from presto_tpu.net import poll_each, request_json
 
         rows: List[dict] = []
         pool = getattr(self.runner.executor, "memory_pool", None)
         if pool is not None:
             rows.append(pool_row("local", pool))
-        lock = threading.Lock()
-
-        def poll(uri):
-            try:
-                with urllib.request.urlopen(f"{uri}/v1/info",
-                                            timeout=2.0) as r:
-                    mem = (_json.load(r).get("memory") or {})
-                with lock:
-                    rows.append({
-                        "node": uri,
-                        "reserved": int(mem.get("reserved", 0)),
-                        "peak": int(mem.get("peak", 0)),
-                        "limit": int(mem.get("limit", 0)),
-                        "queries": len(mem.get("query_reservations") or {}),
-                    })
-            except Exception:
-                pass
-
-        threads = [threading.Thread(target=poll, args=(u,), daemon=True)
-                   for u in self.worker_uris]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=2.5)
+        infos = poll_each(
+            self.worker_uris,
+            lambda uri: request_json(f"{uri}/v1/info", timeout=2.0,
+                                     site="cluster.memory_poll_errors"),
+            health=self._memory_poll_health)
+        for uri, info in infos.items():
+            mem = info.get("memory") or {}
+            rows.append({
+                "node": uri,
+                "reserved": int(mem.get("reserved", 0)),
+                "peak": int(mem.get("peak", 0)),
+                "limit": int(mem.get("limit", 0)),
+                "queries": len(mem.get("query_reservations") or {}),
+            })
         return rows

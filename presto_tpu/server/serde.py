@@ -381,6 +381,11 @@ def _encode_page(columns, n: int, compress: bool) -> bytes:
         if len(z) < len(payload):
             header["z"] = len(payload)  # uncompressed size
             payload = z
+    # integrity: CRC32 over the ON-WIRE payload (post-compression), so
+    # a consumer verifies without decompressing and damage anywhere in
+    # the frame body is caught before rows are trusted (the reference
+    # ships page checksums in its serialized-page wire format too)
+    header["crc"] = zlib.crc32(payload)
     hjson = json.dumps(header).encode()
     return len(hjson).to_bytes(4, "little") + hjson + payload
 
@@ -434,12 +439,49 @@ def parse_page_batch(raw: bytes):
     return out
 
 
-def deserialize_page(raw: bytes, dictionaries=None) -> Page:
+def verify_page(raw: bytes) -> None:
+    """Check a serialized page's CRC without decoding it; raises
+    PageIntegrityError (classified TRANSIENT — the fragment is pure,
+    so recomputation is safe) on damage.  Pages from older producers
+    without a crc field pass."""
     import zlib
+
+    from presto_tpu.net import PageIntegrityError
+
+    try:
+        hlen = int.from_bytes(raw[:4], "little")
+        header = json.loads(raw[4: 4 + hlen].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise PageIntegrityError(f"page frame header unreadable: {e}")
+    crc = header.get("crc")
+    if crc is not None and zlib.crc32(raw[4 + hlen:]) != crc:
+        raise PageIntegrityError(
+            f"page payload CRC mismatch ({len(raw)} bytes)")
+
+
+def deserialize_page(raw: bytes, dictionaries=None,
+                     verify: bool = True) -> Page:
+    """``verify=False`` skips the CRC pass for bytes already checked
+    at their pull/ingest boundary (WorkerClient.pull_results) or
+    produced in-process — one checksum per page, not two."""
+    import zlib
+
+    from presto_tpu.net import PageIntegrityError
 
     _count_exchange("deserialized", len(raw))
     hlen = int.from_bytes(raw[:4], "little")
-    header = json.loads(raw[4 : 4 + hlen].decode())
+    try:
+        header = json.loads(raw[4 : 4 + hlen].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise PageIntegrityError(f"page frame header unreadable: {e}")
+    if verify:
+        # CRC folded into the decode's single header parse (the hot
+        # exchange path); verify_page stays for pull-boundary callers
+        # that check without decoding
+        crc = header.get("crc")
+        if crc is not None and zlib.crc32(raw[4 + hlen:]) != crc:
+            raise PageIntegrityError(
+                f"page payload CRC mismatch ({len(raw)} bytes)")
     n = header["n"]
     if header.get("z"):
         raw = raw[: 4 + hlen] + zlib.decompress(raw[4 + hlen :])

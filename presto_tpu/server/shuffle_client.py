@@ -5,6 +5,11 @@ long-poll GET of ``/v1/task/{id}/results/{buffer}/{token}`` with token
 acknowledgement (``server/TaskResource.java:239,298``), at-least-once
 delivery de-duplicated by the client-held token, plus a no-progress
 deadline so a wedged producer fails the pull instead of hanging it.
+Transient transport faults ride the shared classification plane
+(net.py, the RequestErrorTracker analog): a token GET is idempotent,
+so brief connection blips retry in place with backoff, while a worker
+that stays dead fails the pull within a few hundred milliseconds —
+fast enough for the caller's fragment failover.
 
 Used by BOTH tiers of the DCN exchange: the coordinator pulling a root
 stage, and a worker's RemoteSource leaf pulling an upstream stage's
@@ -18,10 +23,15 @@ import json
 import time
 import urllib.error
 import urllib.request
-from typing import Iterator, List
-
+from typing import Iterator
 
 from presto_tpu.server.serde import parse_page_batch as _parse_batch
+
+#: consecutive transient transport failures tolerated per token before
+#: the pull is abandoned (the caller's failover takes over) — small on
+#: purpose: a dead producer must fail fast, not ride the no-progress
+#: deadline
+MAX_TRANSIENT_RETRIES = 3
 
 
 class TaskPullFailed(Exception):
@@ -30,12 +40,15 @@ class TaskPullFailed(Exception):
 
 
 def _task_error(uri: str, task_id: str) -> str:
+    from presto_tpu.net import request_json
+
     try:
-        with urllib.request.urlopen(f"{uri}/v1/task/{task_id}", timeout=5.0) as r:
-            info = json.load(r)
+        info = request_json(f"{uri}/v1/task/{task_id}", timeout=5.0)
         if info.get("state") == "FAILED":
             return info.get("error") or "task failed"
     except Exception:
+        # the status probe is best-effort context for an error we are
+        # ALREADY raising; its own failure is classified by request_json
         pass
     return ""
 
@@ -45,10 +58,15 @@ def pull_pages(uri: str, task_id: str, buffer_id: int = 0,
                ) -> Iterator[bytes]:
     """Yield serialized pages from one buffer of a remote task until
     the producer marks it complete.  Raises TaskPullFailed on producer
-    task failure, TimeoutError after ``timeout`` with no progress."""
+    task failure, TimeoutError after ``timeout`` with no progress, and
+    the classified transport error after MAX_TRANSIENT_RETRIES
+    consecutive transient failures."""
+    from presto_tpu.net import count_error, is_transient
+
     uri = uri.rstrip("/")
     token = 0
     last_progress = time.monotonic()
+    transient_failures = 0
     while True:
         if time.monotonic() - last_progress > timeout:
             raise TimeoutError(
@@ -73,13 +91,34 @@ def pull_pages(uri: str, task_id: str, buffer_id: int = 0,
             raise
         except TimeoutError:
             continue  # long-poll expiry, not lack of progress
+        except Exception as e:
+            count_error(e)
+            transient_failures += 1
+            if not is_transient(e) \
+                    or transient_failures > MAX_TRANSIENT_RETRIES:
+                raise
+            # the token GET is idempotent (unacknowledged pages re-serve
+            # at the same token): retry in place with a short backoff
+            time.sleep(min(0.05 * (2 ** transient_failures), 0.5))
+            continue
+        transient_failures = 0
         yield from batch
         if nxt > token:
             token = nxt
             last_progress = time.monotonic()
-            urllib.request.urlopen(
-                f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}/acknowledge",
-                timeout=poll_timeout,
-            ).close()
+            try:
+                urllib.request.urlopen(
+                    f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}"
+                    "/acknowledge",
+                    timeout=poll_timeout,
+                ).close()
+            except Exception as e:
+                # best-effort: an ack only frees buffered pages below
+                # `token` — a later ack at a higher token supersedes a
+                # lost one, and a truly dead producer surfaces at the
+                # next results GET with proper triage.  Aborting the
+                # pull (and recomputing the whole task) over an ack
+                # blip would be strictly worse.
+                count_error(e)
         if complete:
             return

@@ -81,7 +81,7 @@ class WorkerServer:
     def __init__(self, catalog: Catalog, host: str = "127.0.0.1", port: int = 0,
                  buffer_bytes: int = 64 << 20, task_ttl: float = 300.0,
                  memory_pool=None, task_threads: int = 4,
-                 task_concurrency: Optional[int] = None):
+                 task_concurrency: Optional[int] = None, faults=None):
         from presto_tpu.executor import TaskExecutor
 
         self.catalog = catalog
@@ -105,6 +105,15 @@ class WorkerServer:
         self._tasks: Dict[str, _Task] = {}
         self._tasks_lock = threading.Lock()
         self.draining = False
+        # deterministic fault injection (testing_faults.py): the
+        # process-global registry is inert unless a test/CI leg armed
+        # it, so the per-request gate below is one attribute read in
+        # production.  _fault_dead = the simulated mid-query crash of
+        # worker.die_after_n_pages: once set, every request is dropped.
+        from presto_tpu.testing_faults import FAULTS
+
+        self.faults = faults if faults is not None else FAULTS
+        self._fault_dead = False
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -112,6 +121,41 @@ class WorkerServer:
 
             def log_message(self, *a):
                 pass
+
+            def _faulted(self) -> bool:
+                """Fault-injection gate for every request: a dead
+                worker (worker.die_after_n_pages fired) or a firing
+                worker.refuse_connect drops the connection without a
+                response; worker.slow_response_ms delays it.
+                Heartbeat probes (GET /v1/info) are EXEMPT from the
+                request-gated points: background detector probes fire
+                on wall-clock timers and would otherwise race query
+                traffic for after=N/count=K schedule slots, breaking
+                the harness's byte-for-byte determinism.  A DEAD
+                worker still drops everything — the detector must see
+                the death."""
+                f = outer.faults
+                if not f.enabled and not outer._fault_dead:
+                    return False
+                if outer._fault_dead:
+                    self.close_connection = True
+                    return True
+                if self.path.split("?")[0] == "/v1/info":
+                    return False
+                if f.should_fire(
+                        "worker.refuse_connect", outer.node_id) is not None:
+                    # no response at all: the connection closes when the
+                    # handler returns, so the client sees the peer drop
+                    # mid-request (RemoteDisconnected — transient)
+                    self.close_connection = True
+                    return True
+                spec = f.should_fire("worker.slow_response_ms",
+                                     outer.node_id)
+                if spec is not None and spec.ms > 0:
+                    import time
+
+                    time.sleep(spec.ms / 1000.0)
+                return False
 
             def _send(self, code: int, body: bytes, ctype="application/json",
                       headers=()):
@@ -124,6 +168,8 @@ class WorkerServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self._faulted():
+                    return
                 if self.path == "/v1/info":
                     info = {"nodeVersion": {"version": __version__},
                             "coordinator": False,
@@ -202,6 +248,8 @@ class WorkerServer:
                 self._send(404, b"{}")
 
             def do_PUT(self):
+                if self._faulted():
+                    return
                 # PUT /v1/info/state "SHUTTING_DOWN" triggers a drain in
                 # the background (server/GracefulShutdownHandler.java:43)
                 if self.path == "/v1/info/state":
@@ -218,6 +266,8 @@ class WorkerServer:
                 self._send(404, b"{}")
 
             def do_POST(self):
+                if self._faulted():
+                    return
                 n = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(n).decode())
                 m = _TASK_RE.match(self.path)
@@ -255,6 +305,8 @@ class WorkerServer:
                 self._send(404, b"{}")
 
             def do_DELETE(self):
+                if self._faulted():
+                    return
                 m = _TASK_RE.match(self.path)
                 if m:
                     outer._abort_task(m.group(1))
@@ -352,6 +404,14 @@ class WorkerServer:
                                 check_partial_mg = None
                 gen = self.runner._pages(fragment)
                 while True:
+                    if self.faults.enabled and self.faults.should_fire(
+                            "worker.die_after_n_pages",
+                            self.node_id) is not None:
+                        # simulated mid-query crash: stop producing and
+                        # drop every subsequent request — the consumer
+                        # sees a dead socket, never a task error
+                        self._fault_dead = True
+                        raise BufferAborted()
                     if mem_ctx is not None:
                         self.runner._mem = mem_ctx
                     try:
@@ -366,7 +426,11 @@ class WorkerServer:
                         if mem_ctx is not None:
                             self.runner._mem = None
                     if partition_fn is None:
-                        task.buffer.enqueue(serialize_page(p))
+                        raw = serialize_page(p)
+                        if self.faults.enabled:
+                            raw = self.faults.maybe_corrupt_page(
+                                raw, self.node_id)
+                        task.buffer.enqueue(raw)
                     else:
                         from presto_tpu.exec.spill import partition_to_host
                         from presto_tpu.server.serde import serialize_host_page
@@ -379,7 +443,11 @@ class WorkerServer:
                                 f"truncated at {check_partial_mg} groups")
                         for k, hp in enumerate(parts):
                             if hp is not None:
-                                task.buffers[k].enqueue(serialize_host_page(hp))
+                                raw = serialize_host_page(hp)
+                                if self.faults.enabled:
+                                    raw = self.faults.maybe_corrupt_page(
+                                        raw, self.node_id)
+                                task.buffers[k].enqueue(raw)
                     yield
                 task.state = FINISHED
                 for buf in task.buffers:

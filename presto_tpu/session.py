@@ -25,6 +25,20 @@ def _bool(s: str) -> bool:
     return s.strip().lower() in ("true", "1", "yes", "on")
 
 
+def _duration(s: str) -> str:
+    """Validate a duration-typed property at SET time ('30s', '10m',
+    plain seconds; empty = server default) — a malformed value must
+    fail the SET SESSION statement, not the next query's execution."""
+    s = s.strip()
+    if s:
+        from presto_tpu.config import parse_duration
+
+        if parse_duration(s, default=None) is None:
+            raise ValueError(
+                f"invalid duration {s!r} (use e.g. '30s', '10m', '2h')")
+    return s
+
+
 SYSTEM_PROPERTIES = [
     PropertyMetadata(
         "jit", "compile streaming chains with XLA (debugging escape hatch)",
@@ -100,6 +114,13 @@ SYSTEM_PROPERTIES = [
         "exec/tasks.py); 1 = serial legacy path, 0 = process default "
         "(query.task-concurrency config / PRESTO_TPU_TASK_CONCURRENCY)",
         0, int,
+    ),
+    PropertyMetadata(
+        "query_max_execution_time",
+        "kill the query after this long running (duration: '30s', "
+        "'10m'; empty = the coordinator's query.max-execution-time "
+        "config default, '0' = no deadline)",
+        "", _duration,
     ),
     PropertyMetadata(
         "task_prefetch",

@@ -60,11 +60,18 @@ class DistributedQueryRunner:
             w = WorkerServer(self.catalog)
             w.start()
             self.workers.append(w)
+        self.runner = QueryRunner(self.catalog)
+        # ONE failure detector for the whole rig: the multihost runner
+        # builds it (fed by fragment traffic + its pings) and the
+        # coordinator shares it — so /v1/worker, system_runtime_workers
+        # and the scheduler's circuit breaker all describe the same
+        # state machine, and the coordinator wires its transitions into
+        # the runner's event pipeline exactly once
         self.multihost = MultiHostRunner(
             self.catalog, [w.uri for w in self.workers])
-        self.runner = QueryRunner(self.catalog)
         self.coordinator = CoordinatorServer(
-            self.runner, worker_uris=[w.uri for w in self.workers])
+            self.runner, worker_uris=[w.uri for w in self.workers],
+            detector=self.multihost.detector)
         self.coordinator.start()
         from presto_tpu.client import StatementClient
 
@@ -84,6 +91,14 @@ class DistributedQueryRunner:
     # -- chaos --------------------------------------------------------------
     def kill_worker(self, index: int = 0) -> None:
         self.workers[index].stop()
+
+    def arm_fault(self, point: str, worker: Optional[int] = None, **kw):
+        """Arm a deterministic fault point (testing_faults.py) scoped
+        to one worker of this rig (``worker=None`` = any node)."""
+        from presto_tpu.testing_faults import FAULTS
+
+        node = self.workers[worker].node_id if worker is not None else None
+        return FAULTS.arm(point, node=node, **kw)
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:

@@ -1,0 +1,216 @@
+"""Deterministic fault-injection harness for chaos testing.
+
+Reference analog: the reference validates its failure paths with
+``TestingPrestoServer`` clusters whose nodes are killed mid-query
+(presto-tests) — ad hoc and time-dependent.  This harness makes the
+chaos *deterministic*: named fault points are armed with explicit
+schedules (fire on the Nth pass, at most K times, on a named node),
+and any randomized decision draws from ONE seeded RNG, so a chaos test
+reproduces byte-for-byte from its seed.
+
+Fault points (the catalog; docs/fault-tolerance.md):
+
+``worker.refuse_connect``     the worker drops the TCP connection of a
+                              matching request without a response
+                              (connection-refused/reset from the
+                              client's perspective).  Heartbeat probes
+                              (``GET /v1/info``) are exempt from the
+                              request-gated points: wall-clock-timed
+                              detector probes must not race query
+                              traffic for schedule slots.
+``worker.die_after_n_pages``  the worker produces ``pages`` task-output
+                              pages, then "dies": every subsequent
+                              request on that worker is dropped — the
+                              mid-query crash scenario.
+``worker.slow_response_ms``   the worker sleeps ``ms`` before handling
+                              a matching request (straggler/timeout
+                              scenario).
+``page.corrupt_crc``          a produced page's payload byte is flipped
+                              before it enters the output buffer; the
+                              consumer's CRC check rejects it
+                              (PageIntegrityError — transient, retried).
+
+Arming::
+
+    from presto_tpu.testing_faults import FAULTS
+    FAULTS.arm("worker.die_after_n_pages", node="worker-a-8080", pages=2)
+
+or from the environment (the CI chaos leg)::
+
+    PRESTO_TPU_FAULTS="worker.slow_response_ms:ms=50,count=3"
+    PRESTO_TPU_FAULT_SEED=1234
+
+The registry is process-global and INERT unless armed — the worker
+server's checks are one ``enabled`` attribute read when no fault was
+ever armed, so production paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Dict, List, Optional
+
+_log = logging.getLogger("presto_tpu.faults")
+
+FAULT_POINTS = (
+    "worker.refuse_connect",
+    "worker.die_after_n_pages",
+    "worker.slow_response_ms",
+    "page.corrupt_crc",
+)
+
+
+class FaultSpec:
+    """One armed fault: a point, a match scope, and a schedule."""
+
+    __slots__ = ("point", "node", "after", "count", "ms", "pages",
+                 "probability", "hits", "fired")
+
+    def __init__(self, point: str, node: Optional[str] = None,
+                 after: int = 0, count: Optional[int] = None,
+                 ms: int = 0, pages: int = 0, probability: float = 1.0):
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point {point!r} "
+                             f"(known: {list(FAULT_POINTS)})")
+        self.point = point
+        self.node = node          # substring match on node id/uri; None = any
+        self.after = int(after)   # skip the first N matching passes
+        self.count = None if count is None else int(count)  # max firings
+        self.ms = int(ms)
+        self.pages = int(pages)
+        # die_after_n_pages: the worker evaluates the point once per
+        # page it is about to produce, so "survive N pages" is exactly
+        # an after=N schedule
+        if point == "worker.die_after_n_pages" and self.pages and not after:
+            self.after = self.pages
+        self.probability = float(probability)
+        self.hits = 0             # matching passes observed
+        self.fired = 0            # times actually fired
+
+    def matches(self, node: Optional[str]) -> bool:
+        return self.node is None or (node is not None and self.node in node)
+
+
+class FaultRegistry:
+    """Process-global set of armed faults + the seeded RNG all
+    probabilistic decisions draw from."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._specs: List[FaultSpec] = []
+        self._rng = random.Random(seed)
+        self.seed = seed
+        #: fast-path gate: False means no fault was ever armed and
+        #: every check is a single attribute read
+        self.enabled = False
+
+    def reseed(self, seed: int) -> None:
+        with self._lock:
+            self.seed = seed
+            self._rng = random.Random(seed)
+
+    def arm(self, point: str, **kw) -> FaultSpec:
+        spec = FaultSpec(point, **kw)
+        with self._lock:
+            self._specs.append(spec)
+            self.enabled = True
+        _log.warning("fault armed: %s %s", point,
+                     {k: getattr(spec, k) for k in
+                      ("node", "after", "count", "ms", "pages")
+                      if getattr(spec, k) not in (None, 0)})
+        return spec
+
+    def disarm_all(self) -> None:
+        with self._lock:
+            self._specs.clear()
+            self.enabled = False
+
+    def specs(self, point: Optional[str] = None) -> List[FaultSpec]:
+        with self._lock:
+            return [s for s in self._specs
+                    if point is None or s.point == point]
+
+    # -- evaluation ---------------------------------------------------------
+    def should_fire(self, point: str,
+                    node: Optional[str] = None) -> Optional[FaultSpec]:
+        """Evaluate one pass through a fault point; returns the firing
+        spec (with its parameters) or None.  Counting is per-spec and
+        lock-protected, so ``after``/``count`` schedules are exact."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            for spec in self._specs:
+                if spec.point != point or not spec.matches(node):
+                    continue
+                spec.hits += 1
+                if spec.hits <= spec.after:
+                    continue
+                if spec.count is not None and spec.fired >= spec.count:
+                    continue
+                if spec.probability < 1.0 \
+                        and self._rng.random() >= spec.probability:
+                    continue
+                spec.fired += 1
+                self._count(point)
+                return spec
+        return None
+
+    @staticmethod
+    def _count(point: str) -> None:
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("fault.injections_total").inc()
+        METRICS.counter(f"fault.{point}").inc()  # metrics: allow
+        _log.warning("fault fired: %s", point)
+
+    def maybe_corrupt_page(self, raw: bytes,
+                           node: Optional[str] = None) -> bytes:
+        """page.corrupt_crc hook: flip one payload byte past the frame
+        header so the consumer's CRC check rejects the page."""
+        spec = self.should_fire("page.corrupt_crc", node)
+        if spec is None or len(raw) < 8:
+            return raw
+        i = len(raw) - 1  # last byte is always payload, never header
+        return raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+
+
+#: the process-global registry every hook consults
+FAULTS = FaultRegistry()
+
+
+def parse_fault_env(spec_text: str, registry: FaultRegistry) -> None:
+    """Arm from ``PRESTO_TPU_FAULTS`` syntax:
+    ``point[:k=v[,k=v...]][;point...]`` — e.g.
+    ``worker.slow_response_ms:ms=50,count=3;page.corrupt_crc:count=1``."""
+    for part in spec_text.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, args = part.partition(":")
+        kw: Dict[str, object] = {}
+        for pair in filter(None, (a.strip() for a in args.split(","))):
+            k, _, v = pair.partition("=")
+            if k in ("after", "count", "ms", "pages"):
+                kw[k] = int(v)
+            elif k == "probability":
+                kw[k] = float(v)
+            else:
+                kw[k] = v
+        registry.arm(point.strip(), **kw)
+
+
+def arm_from_env(registry: Optional[FaultRegistry] = None) -> FaultRegistry:
+    """Resolve the PRESTO_TPU_FAULTS / PRESTO_TPU_FAULT_SEED pair once
+    (launcher/test bootstrap; the engine-lint env-read convention)."""
+    import os
+
+    reg = registry or FAULTS
+    seed = os.environ.get("PRESTO_TPU_FAULT_SEED")  # lint: allow(env-read)
+    if seed:
+        reg.reseed(int(seed))
+    spec = os.environ.get("PRESTO_TPU_FAULTS")  # lint: allow(env-read)
+    if spec:
+        parse_fault_env(spec, reg)
+    return reg

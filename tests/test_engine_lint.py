@@ -352,6 +352,41 @@ def test_thread_pool_suppression_comment(tmp_path):
     assert [f.rule for f in findings] == ["thread-pool"]
 
 
+def test_naked_urlopen_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import urllib.request
+
+        def fetch(uri):
+            with urllib.request.urlopen(uri) as r:
+                return r.read()
+    """)
+    assert [f.rule for f in findings] == ["naked-urlopen"]
+
+
+def test_naked_urlopen_with_timeout_allowed(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import urllib.request
+
+        def fetch(uri, req):
+            with urllib.request.urlopen(uri, timeout=5.0) as r:
+                body = r.read()
+            # third positional IS the timeout
+            urllib.request.urlopen(uri, None, 10.0).close()
+            return body
+    """)
+    assert findings == []
+
+
+def test_naked_urlopen_suppression_comment(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import urllib.request
+
+        def fetch(uri):
+            return urllib.request.urlopen(uri)  # lint: allow(naked-urlopen)
+    """)
+    assert findings == []
+
+
 def test_metric_catalog_discovered_from_repo():
     """Auto-discovery walks up to presto_tpu/obs/metrics.py: the real
     catalog governs files linted inside the repo."""

@@ -1,0 +1,678 @@
+"""Fault-tolerance plane tests (docs/fault-tolerance.md).
+
+The chaos legs use the deterministic fault-injection harness
+(presto_tpu/testing_faults.py): named fault points with explicit
+schedules, so a worker "dies" at an exact page boundary and every run
+reproduces.  The failure-detector unit tests run on a fake clock — no
+wallclock sleeps.
+"""
+
+import json
+import time
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.events import EventListenerManager
+from presto_tpu.obs import METRICS, QueryLogListener
+from presto_tpu.parallel.failure import (
+    ALIVE, DEAD, RECOVERED, SUSPECT, FailureDetector,
+)
+from presto_tpu.parallel.multihost import MultiHostRunner, TaskFailed, WorkerClient
+from presto_tpu.runner import QueryRunner
+from presto_tpu.server.worker import WorkerServer
+from presto_tpu.testing_faults import FAULTS, FaultRegistry, parse_fault_env
+
+from tests.tpch_queries import QUERIES
+
+
+# the CI chaos leg (tools/ci.sh) pins PRESTO_TPU_FAULT_SEED so every
+# randomized fault decision in the process-global registry reproduces;
+# tests that prove seed-sensitivity build their own FaultRegistry
+import os as _os
+
+_ci_seed = _os.environ.get("PRESTO_TPU_FAULT_SEED")
+if _ci_seed:
+    FAULTS.reseed(int(_ci_seed))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    FAULTS.disarm_all()
+
+
+def make_catalog():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.005, split_rows=2048))
+    return catalog
+
+
+def _key(row):
+    return tuple(round(v, 6) if isinstance(v, float) else v for v in row)
+
+
+def _assert_rows_match(actual, expected):
+    assert len(actual) == len(expected)
+    for a, e in zip(sorted(actual, key=_key), sorted(expected, key=_key)):
+        for va, ve in zip(a, e):
+            if isinstance(va, float):
+                assert va == pytest.approx(ve, rel=1e-9), (a, e)
+            else:
+                assert va == ve, (a, e)
+
+
+# ---------------------------------------------------------------------------
+# failure detector: state machine on a fake clock (no wallclock sleeps)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _make_detector(clock, fails, calls, **kw):
+    def probe(uri, timeout):
+        calls.append(uri)
+        if fails["down"]:
+            raise ConnectionRefusedError("connection refused")
+
+    kw.setdefault("suspect_after", 1)
+    kw.setdefault("dead_after", 3)
+    kw.setdefault("recover_after", 2)
+    kw.setdefault("backoff_base", 0.5)
+    kw.setdefault("backoff_max", 8.0)
+    kw.setdefault("jitter", 0.0)
+    return FailureDetector(["http://w:1"], probe=probe, clock=clock.now, **kw)
+
+
+def test_detector_alive_suspect_dead_recovered_cycle():
+    clock, calls, fails = FakeClock(), [], {"down": True}
+    det = _make_detector(clock, fails, calls)
+    uri = "http://w:1"
+    edges = []
+    det.add_transition_listener(
+        lambda u, old, new, reason: edges.append((old, new)))
+
+    assert det.state(uri) == ALIVE and det.is_schedulable(uri)
+    det.probe_once(force=True)  # failure 1 -> SUSPECT (still schedulable)
+    assert det.state(uri) == SUSPECT and det.is_schedulable(uri)
+    det.probe_once(force=True)
+    det.probe_once(force=True)  # failure 3 -> DEAD (circuit open)
+    assert det.state(uri) == DEAD and not det.is_schedulable(uri)
+    assert det.schedulable() == []
+
+    # recovery needs recover_after consecutive successes
+    clock.advance(100)
+    fails["down"] = False
+    det.probe_once(force=True)  # success 1: still DEAD
+    assert det.state(uri) == DEAD
+    det.probe_once(force=True)  # success 2 -> RECOVERED (re-admitted)
+    assert det.state(uri) == RECOVERED and det.is_schedulable(uri)
+    det.record_success(uri)  # first scheduled use -> ALIVE
+    assert det.state(uri) == ALIVE
+    assert edges == [(ALIVE, SUSPECT), (SUSPECT, DEAD),
+                     (DEAD, RECOVERED), (RECOVERED, ALIVE)]
+
+
+def test_detector_backoff_gates_probes():
+    """A failing worker is probed on an exponential-backoff schedule:
+    an un-advanced clock means NO probe attempt at all."""
+    clock, calls, fails = FakeClock(), [], {"down": True}
+    det = _make_detector(clock, fails, calls)
+    uri = "http://w:1"
+    det.probe_once(force=True)
+    assert len(calls) == 1
+    assert not det.probe_due(uri)
+    det.probe_once()  # backoff window open: no contact
+    assert len(calls) == 1
+    clock.advance(0.5)  # base backoff elapsed
+    assert det.probe_due(uri)
+    det.probe_once()
+    assert len(calls) == 2
+    # consecutive failures double the window: 1.0s now
+    clock.advance(0.6)
+    det.probe_once()
+    assert len(calls) == 2
+    clock.advance(0.5)
+    det.probe_once()
+    assert len(calls) == 3
+
+
+def test_detector_healthy_worker_has_heartbeat_row():
+    clock, calls, fails = FakeClock(), [], {"down": False}
+    det = _make_detector(clock, fails, calls)
+    (row,) = det.snapshot()
+    assert row["state"] == ALIVE
+    assert row["last_heartbeat_ms"] is None  # NULL before any heartbeat
+    det.probe_once(force=True)
+    clock.advance(2.0)
+    (row,) = det.snapshot()
+    assert row["last_heartbeat_ms"] == pytest.approx(2000.0)
+    assert row["consecutive_failures"] == 0
+
+
+def test_detector_transition_counters():
+    before = METRICS.counter("worker.transitions_to_dead").value
+    clock, calls, fails = FakeClock(), [], {"down": True}
+    det = _make_detector(clock, fails, calls)
+    for _ in range(3):
+        det.probe_once(force=True)
+    assert METRICS.counter("worker.transitions_to_dead").value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# shared HTTP retry plane (net.py)
+# ---------------------------------------------------------------------------
+
+def test_http_retry_retries_transient_only():
+    from presto_tpu.net import http_retry
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("boom")
+        return "ok"
+
+    assert http_retry(flaky, attempts=5, sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+
+def test_http_retry_never_retries_deterministic_errors():
+    import io
+    import urllib.error
+
+    from presto_tpu.net import http_retry
+
+    calls = []
+
+    def query_error():
+        calls.append(1)
+        raise urllib.error.HTTPError(
+            "http://w/v1/task/x", 500, "BindError: no such column",
+            {}, io.BytesIO(b"{}"))
+
+    with pytest.raises(urllib.error.HTTPError):
+        http_retry(query_error, attempts=5, sleep=lambda s: None)
+    assert len(calls) == 1  # a deterministic failure burns ONE attempt
+
+
+def test_classification_table():
+    import io
+    import urllib.error
+
+    from presto_tpu.net import PageIntegrityError, is_transient
+
+    assert is_transient(ConnectionRefusedError("refused"))
+    assert is_transient(TimeoutError("timed out"))
+    assert is_transient(PageIntegrityError("crc"))
+    assert is_transient(urllib.error.HTTPError("u", 503, "drain", {}, None))
+    # bare 5xx = worker/proxy fault (failover can move the work) ...
+    assert is_transient(urllib.error.HTTPError(
+        "u", 500, "err", {}, io.BytesIO(b"{}")))
+    assert is_transient(urllib.error.HTTPError(
+        "u", 502, "bad gateway", {}, io.BytesIO(b"{}")))
+    # ... but a recognizable query error, a wrong request, or a
+    # deterministic marker is never retried
+    assert not is_transient(urllib.error.HTTPError(
+        "u", 500, "BindError: no such column", {}, io.BytesIO(b"{}")))
+    assert not is_transient(urllib.error.HTTPError(
+        "u", 404, "no such task", {}, io.BytesIO(b"{}")))
+    assert not is_transient(ValueError("GroupCapacityExceeded: 42"))
+
+
+# ---------------------------------------------------------------------------
+# fault harness determinism
+# ---------------------------------------------------------------------------
+
+def test_fault_schedule_reproduces_from_seed():
+    def run(seed):
+        reg = FaultRegistry(seed=seed)
+        reg.arm("worker.refuse_connect", probability=0.5, count=100)
+        return [reg.should_fire("worker.refuse_connect") is not None
+                for _ in range(32)]
+
+    a, b = run(7), run(7)
+    assert a == b  # byte-for-byte reproduction
+    assert any(a) and not all(a)
+    assert run(8) != a  # and the seed actually matters
+
+
+def test_fault_env_parsing():
+    reg = FaultRegistry()
+    parse_fault_env(
+        "worker.slow_response_ms:ms=50,count=2;page.corrupt_crc:count=1",
+        reg)
+    slow, crc = reg.specs()
+    assert slow.point == "worker.slow_response_ms"
+    assert slow.ms == 50 and slow.count == 2
+    assert crc.point == "page.corrupt_crc" and crc.count == 1
+    assert reg.enabled
+
+
+def test_fault_die_after_n_pages_schedule():
+    reg = FaultRegistry()
+    reg.arm("worker.die_after_n_pages", pages=2)
+    # the worker evaluates the point once per page it is ABOUT to
+    # produce: two pages survive, the third attempt dies
+    assert reg.should_fire("worker.die_after_n_pages", "w") is None
+    assert reg.should_fire("worker.die_after_n_pages", "w") is None
+    assert reg.should_fire("worker.die_after_n_pages", "w") is not None
+
+
+def test_fault_node_scoping():
+    reg = FaultRegistry()
+    reg.arm("worker.refuse_connect", node="worker-a")
+    assert reg.should_fire("worker.refuse_connect", "worker-b-8080") is None
+    assert reg.should_fire("worker.refuse_connect",
+                           "worker-a-8080") is not None
+
+
+# ---------------------------------------------------------------------------
+# page integrity (CRC)
+# ---------------------------------------------------------------------------
+
+def test_page_crc_roundtrip_and_corruption_detected():
+    import numpy as np
+
+    from presto_tpu.net import PageIntegrityError
+    from presto_tpu.page import Page
+    from presto_tpu.server.serde import (
+        deserialize_page, serialize_page, verify_page,
+    )
+    from presto_tpu.types import BIGINT
+
+    page = Page.from_arrays([np.arange(100, dtype=np.int64)], [BIGINT])
+    raw = serialize_page(page)
+    verify_page(raw)  # intact: passes
+    back = deserialize_page(raw)
+    assert int(np.asarray(back.row_mask).sum()) == 100
+    flipped = raw[:-1] + bytes([raw[-1] ^ 0xFF])
+    with pytest.raises(PageIntegrityError):
+        verify_page(flipped)
+    with pytest.raises(PageIntegrityError):
+        deserialize_page(flipped)
+
+
+def test_corrupt_page_is_retried_transparently():
+    """page.corrupt_crc armed for ONE page: the first pull fails the
+    CRC check, the client retries the (pure) fragment, the second
+    attempt succeeds — corruption never reaches results."""
+    import numpy as np
+
+    from presto_tpu.server.serde import deserialize_page, plan_to_json
+    from presto_tpu.planner.plan import TableScanNode
+
+    catalog = make_catalog()
+    w = WorkerServer(catalog)
+    w.start()
+    try:
+        spec = FAULTS.arm("page.corrupt_crc", node=w.node_id, count=1)
+        handle = catalog.resolve("nation")
+        frag = plan_to_json(TableScanNode(handle, [0]))
+        client = WorkerClient(w.uri, timeout=20.0)
+        raws = client.run_fragment(frag)
+        assert spec.fired == 1
+        rows = sum(int(np.asarray(deserialize_page(r).row_mask).sum())
+                   for r in raws)
+        assert rows == 25
+        assert client.alive
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill a worker mid-query (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+def test_kill_worker_mid_query_retries_on_survivors(tmp_path):
+    """3 workers; the fault harness kills worker 0 after it produced
+    exactly one task-output page.  The TPC-H query must complete with
+    oracle-correct results via fragment retry on the survivors, and
+    the retry.fragments_total metric, detector state and query-log
+    worker_state_change events must prove the path was exercised."""
+    workers = [WorkerServer(make_catalog()) for _ in range(3)]
+    for w in workers:
+        w.start()
+    log_path = tmp_path / "query.log"
+    events = EventListenerManager()
+    events.add(QueryLogListener(str(log_path)))
+    local = QueryRunner(make_catalog())
+    multi = MultiHostRunner(make_catalog(), [w.uri for w in workers],
+                            events=events)
+    retries_before = METRICS.counter("retry.fragments_total").value
+    try:
+        FAULTS.arm("worker.die_after_n_pages", node=workers[0].node_id,
+                   pages=1)
+        sql = QUERIES[6]
+        expected = local.executor.run(local.plan(sql)).rows
+        actual = multi.run(local.binder.plan(sql)).rows
+        _assert_rows_match(actual, expected)
+        # the retry path was exercised, not merely survived
+        assert METRICS.counter("retry.fragments_total").value \
+            > retries_before
+        assert multi.detector.state(workers[0].uri) in (SUSPECT, DEAD)
+        assert multi.last_fallback_reason is None  # NOT a local fallback
+        # the query log carries the worker state-change evidence
+        lines = [json.loads(l) for l in log_path.read_text().splitlines()]
+        changes = [l for l in lines
+                   if l.get("event") == "worker_state_change"]
+        assert changes and changes[0]["uri"] == workers[0].uri
+        assert changes[0]["new_state"] in (SUSPECT, DEAD)
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def test_corrupt_shuffle_page_recovers_in_two_stage_exchange():
+    """page.corrupt_crc on a stage-1 partitioned output: the stage-2
+    worker's RemoteSource pull rejects the page (PageIntegrityError in
+    the task error text), the shuffle aborts as a TRANSPORT fault, and
+    the coordinator-merge path re-answers — oracle-correct, never a
+    query failure and never silent corruption."""
+    workers = [WorkerServer(make_catalog()) for _ in range(2)]
+    for w in workers:
+        w.start()
+    local = QueryRunner(make_catalog())
+    multi = MultiHostRunner(make_catalog(), [w.uri for w in workers])
+    try:
+        spec = FAULTS.arm("page.corrupt_crc", node=workers[0].node_id,
+                          count=1)
+        sql = ("SELECT o_orderpriority, count(*) AS c FROM orders "
+               "GROUP BY o_orderpriority")
+        expected = local.executor.run(local.plan(sql)).rows
+        actual = multi.run(local.binder.plan(sql)).rows
+        _assert_rows_match(actual, expected)
+        assert spec.fired == 1
+        assert multi.last_fallback_reason is None
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def test_kill_worker_mid_grouped_query_two_stage_falls_back_correct():
+    """Worker death during the two-stage shuffle: stage-2 pulls hit
+    the dead producer, the shuffle aborts with a transport fault, and
+    the coordinator-merge path answers over the survivors — results
+    stay oracle-correct."""
+    workers = [WorkerServer(make_catalog()) for _ in range(3)]
+    for w in workers:
+        w.start()
+    local = QueryRunner(make_catalog())
+    multi = MultiHostRunner(make_catalog(), [w.uri for w in workers])
+    try:
+        FAULTS.arm("worker.die_after_n_pages", node=workers[0].node_id,
+                   pages=1)
+        sql = ("SELECT o_orderpriority, count(*) AS c, "
+               "sum(o_totalprice) AS s FROM orders "
+               "GROUP BY o_orderpriority")
+        expected = local.executor.run(local.plan(sql)).rows
+        actual = multi.run(local.binder.plan(sql)).rows
+        _assert_rows_match(actual, expected)
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def test_sole_worker_death_finishes_splits_on_coordinator():
+    """With every worker dead mid-stage and the retry budget useless
+    (no survivors), the remaining splits run coordinator-local — the
+    last resort reserved for exactly this case."""
+    workers = [WorkerServer(make_catalog())]
+    workers[0].start()
+    local = QueryRunner(make_catalog())
+    multi = MultiHostRunner(make_catalog(), [w.uri for w in workers])
+    local_before = METRICS.counter("retry.splits_recovered_local").value
+    try:
+        FAULTS.arm("worker.die_after_n_pages", node=workers[0].node_id,
+                   pages=1)
+        sql = ("SELECT l_orderkey, l_quantity FROM lineitem "
+               "WHERE l_quantity > 45 "
+               "ORDER BY l_orderkey, l_quantity LIMIT 25")
+        expected = local.executor.run(local.plan(sql)).rows
+        actual = multi.run(local.binder.plan(sql)).rows
+        assert actual == expected  # ORDER BY: positional
+        assert METRICS.counter("retry.splits_recovered_local").value \
+            > local_before
+    finally:
+        try:
+            workers[0].stop()
+        except Exception:
+            pass
+
+
+def test_whole_query_coordinator_fallback_only_when_all_workers_dead():
+    workers = [WorkerServer(make_catalog()) for _ in range(2)]
+    for w in workers:
+        w.start()
+    local = QueryRunner(make_catalog())
+    multi = MultiHostRunner(make_catalog(), [w.uri for w in workers])
+    sql = "SELECT sum(l_quantity) FROM lineitem"
+    plan = local.binder.plan(sql)
+    expected = local.executor.run(local.plan(sql)).rows
+    try:
+        # healthy cluster: distributed, no fallback
+        out = multi.run(plan)
+        _assert_rows_match(out.rows, expected)
+        assert out.dist_fallback is None
+        # all workers dead: the WHOLE query falls back, loudly
+        for w in workers:
+            w.stop()
+        before = multi.fallback_count
+        out = multi.run(plan)
+        _assert_rows_match(out.rows, expected)
+        assert multi.fallback_count == before + 1
+        assert "no live workers" in (out.dist_fallback or "")
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def test_deterministic_error_is_not_retried():
+    """A BindError-class failure (bad fragment) raises TaskFailed on
+    the FIRST attempt: no retry, no worker blame, detector unmoved."""
+    from presto_tpu.planner.plan import TableScanNode
+    from presto_tpu.server.serde import plan_to_json
+
+    catalog = make_catalog()
+    w = WorkerServer(catalog)
+    w.start()
+    try:
+        handle = catalog.resolve("nation")
+        bad = dict(plan_to_json(TableScanNode(handle, [0])),
+                   table="missing_table")
+        client = WorkerClient(w.uri, timeout=20.0,
+                              detector=FailureDetector([w.uri]))
+        attempts = []
+        original = client.create_task
+
+        def counting_create(*a, **kw):
+            attempts.append(1)
+            return original(*a, **kw)
+
+        client.create_task = counting_create
+        with pytest.raises(TaskFailed):
+            client.run_fragment(bad)
+        assert len(attempts) == 1  # never retried
+        assert client.alive
+        assert client.detector.state(w.uri) == ALIVE  # never blamed
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# query deadlines (query.max-execution-time)
+# ---------------------------------------------------------------------------
+
+def _stub_coordinator(tmp_path, **kw):
+    from presto_tpu.memory import QueryMemoryContext
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.testing import LocalQueryRunner
+
+    runner = LocalQueryRunner(sf=0.001)
+    runner.events.add(QueryLogListener(str(tmp_path / "query.log")))
+    pool = runner.executor.memory_pool
+
+    def slow_execute(sql, query_id=None, trace_token=None):
+        """Reserves tagged memory, then runs until the deadline kill
+        poisons its reservations (the cooperative unwind path)."""
+        ctx = QueryMemoryContext(pool, query_id)
+        ctx.reserve("deadline_probe", 1 << 20)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 8.0:
+            time.sleep(0.02)
+            ctx.reserve("tick", 1)  # raises QueryKilledError after kill
+        raise AssertionError("ran past the deadline without being killed")
+
+    runner.execute = slow_execute
+    return CoordinatorServer(runner, **kw), runner, pool
+
+
+def test_deadline_kill_fails_query_frees_memory_and_logs(tmp_path):
+    coordinator, runner, pool = _stub_coordinator(
+        tmp_path, max_execution_time=0.3, deadline_grace=2.0)
+    t0 = time.monotonic()
+    q = coordinator._submit("SELECT deadline_victim")
+    assert q.done.wait(timeout=10.0)
+    elapsed = time.monotonic() - t0
+    assert q.state == "FAILED"
+    assert "EXCEEDED_TIME_LIMIT" in (q.error or "")
+    # within deadline + grace, never a hang
+    assert elapsed < 0.3 + 2.0
+    # reservations freed at the kill (not merely at thread exit)
+    assert not [t for t in pool.tags() if t.startswith(q.id)]
+    # the kill DECISION is in the query log with its reason code
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        lines = [json.loads(l) for l in
+                 (tmp_path / "query.log").read_text().splitlines()]
+        kills = [l for l in lines if l.get("event") == "query_killed"]
+        if kills:
+            break
+        time.sleep(0.05)
+    assert kills and kills[0]["reason"] == "EXCEEDED_TIME_LIMIT"
+    assert kills[0]["query_id"] == q.id
+    # the kill released the admission slot immediately (not only when
+    # the zombie thread unwinds)
+    assert q.group_released
+    coordinator.stop(drain_timeout=2.0)
+
+
+def test_invalid_duration_rejected_at_set_time_and_safe_at_parse():
+    from presto_tpu.config import parse_duration
+    from presto_tpu.session import Session
+
+    # unparseable text degrades to the default instead of raising on
+    # the coordinator's execution path
+    assert parse_duration("1 hour", 12.5) == 12.5
+    assert parse_duration("abc", 0.0) == 0.0
+    assert parse_duration("45s", 0.0) == 45.0
+    assert parse_duration("300ms", 0.0) == pytest.approx(0.3)
+    # and a malformed session value fails the SET SESSION statement,
+    # never the next query
+    s = Session()
+    with pytest.raises(ValueError):
+        s.set("query_max_execution_time", "1 hour")
+    s.set("query_max_execution_time", "45s")
+    assert s.get("query_max_execution_time") == "45s"
+
+
+def test_session_property_overrides_deadline(tmp_path):
+    coordinator, runner, pool = _stub_coordinator(
+        tmp_path, max_execution_time=600.0)
+    runner.session.set("query_max_execution_time", "300ms")
+    t0 = time.monotonic()
+    q = coordinator._submit("SELECT session_deadline_victim")
+    assert q.done.wait(timeout=10.0)
+    assert q.state == "FAILED"
+    assert "EXCEEDED_TIME_LIMIT" in (q.error or "")
+    assert time.monotonic() - t0 < 6.0
+    coordinator.stop(drain_timeout=2.0)
+
+
+def test_queue_timeout_surfaces_as_failed_statement(tmp_path):
+    """query.max-queued-time expiry = a FAILED statement with the
+    timeout reason, not a hang."""
+    from presto_tpu.resource_groups import ResourceGroup, ResourceGroupManager
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.testing import LocalQueryRunner
+
+    runner = LocalQueryRunner(sf=0.001)
+    # a group that can never admit: every query waits in the queue
+    groups = ResourceGroupManager(
+        ResourceGroup("frozen", hard_concurrency=0))
+    coordinator = CoordinatorServer(runner, resource_groups=groups,
+                                    max_queued_time=0.2)
+    q = coordinator._submit("SELECT 1")
+    assert q.done.wait(timeout=10.0)
+    assert q.state == "FAILED"
+    assert "timed out" in (q.error or "")
+    coordinator.stop(drain_timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces: system_runtime_workers + /v1/worker
+# ---------------------------------------------------------------------------
+
+def test_system_runtime_workers_and_ui_endpoint():
+    from presto_tpu.connectors.system import QueryHistory, SystemConnector
+    from presto_tpu.net import request_json
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    catalog = make_catalog()
+    catalog.register("system", SystemConnector(QueryHistory()))
+    worker = WorkerServer(make_catalog())
+    worker.start()
+    runner = QueryRunner(catalog)
+    coordinator = CoordinatorServer(runner, worker_uris=[worker.uri])
+    try:
+        # NULL-safe before any heartbeat
+        rows = runner.execute(
+            "SELECT node_id, state, consecutive_failures, "
+            "last_heartbeat_ms FROM system_runtime_workers").rows
+        assert rows == [(worker.uri, "ALIVE", 0, None)]
+        coordinator.failure_detector.probe_once(force=True)
+        rows = runner.execute(
+            "SELECT state, last_heartbeat_ms "
+            "FROM system_runtime_workers").rows
+        assert rows[0][0] == "ALIVE" and rows[0][1] is not None
+        # kill the worker; the detector walks it to DEAD
+        worker.stop()
+        for _ in range(3):
+            coordinator.failure_detector.probe_once(force=True)
+        rows = runner.execute(
+            "SELECT state, consecutive_failures "
+            "FROM system_runtime_workers").rows
+        assert rows == [("DEAD", 3)]
+        # the web UI's worker list endpoint serves the same rows
+        coordinator.start()
+        got = request_json(f"{coordinator.uri}/v1/worker", timeout=5.0)
+        assert got[0]["state"] == "DEAD"
+        assert got[0]["consecutive_failures"] >= 3
+    finally:
+        coordinator.stop(drain_timeout=2.0)
+        try:
+            worker.stop()
+        except Exception:
+            pass

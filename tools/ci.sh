@@ -9,7 +9,11 @@
 #   3. plan-validator corpus      (tests/test_plan_validator.py:
 #      every TPC-H/TPC-DS query binds + validates clean, seeded-bug
 #      mutations still diagnose)
-#   4. tier-1 pytest suite        (the ROADMAP.md verify command)
+#   4. fault-injection leg        (tests/test_fault_tolerance.py under
+#      a FIXED fault seed: the chaos schedules — worker death
+#      mid-query, refused connects, corrupt pages, deadline kills —
+#      reproduce deterministically on every gate)
+#   5. tier-1 pytest suite        (the ROADMAP.md verify command)
 #
 # Usage: tools/ci.sh [extra pytest args]
 
@@ -35,6 +39,12 @@ env JAX_PLATFORMS=cpu PRESTO_TPU_TASK_CONCURRENCY=4 python -m pytest \
     tests/test_tasks.py tests/test_tpch.py tests/test_spill.py \
     tests/test_always_on_memory.py tests/test_executor.py -q \
     -p no:cacheprovider
+
+echo "== fault-injection (chaos) leg =============================="
+# fixed seed: the fault schedules (and their jittered backoffs) are
+# deterministic, so a chaos failure here reproduces byte-for-byte
+env JAX_PLATFORMS=cpu PRESTO_TPU_FAULT_SEED=1234 python -m pytest \
+    tests/test_fault_tolerance.py -q -p no:cacheprovider
 
 echo "== tier-1 tests ============================================="
 rm -f /tmp/_t1.log

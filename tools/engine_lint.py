@@ -55,6 +55,12 @@ metric-catalog      ``.counter("name")`` / ``.gauge`` / ``.histogram``
                     ad-hoc names silently fork it and break dashboards.
                     Deliberately dynamic instruments carry a
                     ``# metrics: allow`` comment.
+naked-urlopen       ``urlopen(...)`` without an explicit ``timeout=``
+                    argument.  The stdlib default is no timeout at
+                    all: one wedged peer hangs the calling thread
+                    forever — the exact hang class the fault-tolerance
+                    plane (net.py http_retry, failure detector, query
+                    deadlines) exists to prevent.
 thread-pool         ``ThreadPoolExecutor`` without a ``max_workers``
                     argument (unbounded default), with an int-literal
                     worker count, or a ``Thread`` constructed inside a
@@ -363,6 +369,20 @@ class _Linter(ast.NodeVisitor):
                 "pool of hard-coded width — derive the count from "
                 "config (task_concurrency / a constructor parameter)")
 
+        # naked-urlopen ------------------------------------------------------
+        if name == "urlopen":
+            # urlopen(url, data=None, timeout=...) — timeout is the
+            # third positional or the keyword
+            has_timeout = len(node.args) >= 3 or any(
+                k.arg == "timeout" for k in node.keywords)
+            if not has_timeout:
+                self._emit(
+                    node, "naked-urlopen",
+                    "urlopen without an explicit timeout= blocks its "
+                    "thread forever on a wedged peer — pass a bounded "
+                    "timeout (or use presto_tpu.net.request_json/"
+                    "request_bytes)")
+
         # block-until-ready --------------------------------------------------
         if name == "block_until_ready" and self._is_operator_code:
             self._emit(
@@ -503,7 +523,8 @@ class _Linter(ast.NodeVisitor):
 
 ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
              "block-until-ready", "bare-except", "spi-exception",
-             "wallclock", "metric-catalog", "thread-pool"}
+             "wallclock", "metric-catalog", "thread-pool",
+             "naked-urlopen"}
 
 #: sentinel: discover the catalog by walking up from the linted file
 _AUTO = object()
