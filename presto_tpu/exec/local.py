@@ -1062,15 +1062,13 @@ class LocalRunner:
             return
 
         if isinstance(node, UnionNode):
+            from presto_tpu.parallel.fragment import remap_union_leg_page
+
             chans = node.channels
             for k, src in enumerate(node.inputs):
                 offs = node.code_offsets[k]
                 for p in self._pages(src):
-                    blocks = []
-                    for i, b in enumerate(p.blocks):
-                        data = b.data + offs[i] if offs[i] else b.data
-                        blocks.append(Block(data, b.valid, chans[i].type, chans[i].dictionary))
-                    yield Page(tuple(blocks), p.row_mask)
+                    yield remap_union_leg_page(p, offs, chans)
             return
 
         if isinstance(node, WindowNode):

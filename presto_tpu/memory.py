@@ -83,6 +83,15 @@ class MemoryPool:
             for tag in [t for t in self._tagged if t.split("/", 1)[0] == query_id]:
                 freed += self._tagged.pop(tag)
             self.reserved -= freed
+        # abort the query's streaming-exchange buffers too: a producer
+        # thread blocked in enqueue (backpressure) never reaches its
+        # next pool reservation, so without this it would leak
+        try:
+            from presto_tpu.parallel.streams import abort_query
+
+            abort_query(query_id)
+        except Exception:
+            pass  # kill must still free memory if streams are torn down
         return freed
 
     def free(self, tag: str) -> None:

@@ -202,9 +202,20 @@ def _preregister(reg: MetricsRegistry) -> None:
         "spill.bytes", "exchange.pages_serialized",
         "exchange.bytes_serialized", "exchange.pages_deserialized",
         "exchange.bytes_deserialized",
+        # streaming page exchange (parallel/streams.py): pages/bytes
+        # through stage-boundary streams, producer time blocked on the
+        # byte cap (backpressure), mid-stream producer-death replays
+        # (resume from the consumer's last acked token), and kill-path
+        # aborts (pool.kill_query -> streams.abort_query)
+        "exchange.stream_pages_total", "exchange.stream_bytes_total",
+        "exchange.producer_stall_seconds_total",
+        "exchange.stream_replays_total", "exchange.streams_aborted",
         # distributed tiers (VERDICT weak #8: fallbacks countable)
         "dist.stages_total", "dist.fallbacks",
         "multihost.stages_total", "multihost.fallbacks",
+        # two-stage window shuffle lost a worker mid-flight and
+        # degraded to gather + coordinator window (stage-1 re-scanned)
+        "multihost.window_shuffle_degraded",
         # worker task protocol (aborted = client cancellation, not a
         # failure — alerting keys on tasks.failed alone)
         "tasks.started", "tasks.finished", "tasks.failed",
@@ -254,6 +265,10 @@ def _preregister(reg: MetricsRegistry) -> None:
         # wires the sampling callbacks when a detector is live)
         "worker.state_alive", "worker.state_suspect",
         "worker.state_dead", "worker.state_recovered",
+        # streaming-exchange occupancy (parallel/streams.py wires the
+        # sampling callbacks at import): unacked bytes buffered across
+        # live streams and streams not yet drained/aborted
+        "exchange.buffered_bytes", "exchange.open_streams",
     ):
         reg.gauge(name)
     for name in ("query.execution_ms", "xla.compile_ms"):

@@ -253,11 +253,26 @@ class DistributedRunner:
         self.join_distribution_type = "AUTOMATIC"
         self.allow_colocated = True
         self.min_stage_rows = DEFAULT_MIN_STAGE_ROWS
+        # streaming exchange knobs (parallel/streams.py): stage
+        # boundaries stream pages through token-acked buffers by
+        # default; off = materialize-then-consume (the A/B leg)
+        from presto_tpu.parallel.streams import (
+            exchange_buffer_bytes_default, exchange_streaming_default,
+        )
+
+        self.exchange_streaming = exchange_streaming_default()
+        self.exchange_buffer_bytes = exchange_buffer_bytes_default()
+        self.merge_fanin = 8  # sorted runs merged per consumer batch
         if session is not None:
             self.join_distribution_type = session.get("join_distribution_type")
             self.allow_colocated = bool(session.get("colocated_join"))
             self.min_stage_rows = int(
                 session.get("distributed_min_stage_rows"))
+            self.exchange_streaming = bool(session.get("exchange_streaming"))
+            eb = int(session.get("exchange_buffer_bytes"))
+            if eb > 0:
+                self.exchange_buffer_bytes = eb
+            self.merge_fanin = max(2, int(session.get("exchange_merge_fanin")))
         # morsel-scheduler knobs flow into the mesh tier too: the local
         # fallback runner schedules its scan splits, and the wave loops
         # prefetch the next wave's host assembly while the device mesh
@@ -368,11 +383,26 @@ class DistributedRunner:
             page = self.local.run_to_page(node)
             return PrecomputedNode(page=page, channel_list=node.channels)
 
+        def run_window(node) -> PrecomputedNode:
+            page = _staged("dist:window",
+                           lambda: self.run_window_stage(node))
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
+        def run_sort(node) -> PrecomputedNode:
+            page = _staged("dist:sort", lambda: self.run_sort_stage(node))
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
+        def run_union(node) -> PrecomputedNode:
+            page = _staged("dist:union", lambda: self.run_union_stage(node))
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
         splices: List = []
         try:
             n_stages, root = lower_stages(
                 plan, run_agg, run_chain, eval_glue, splices,
-                min_stage_rows=self.min_stage_rows)
+                min_stage_rows=self.min_stage_rows,
+                run_window=run_window, run_sort=run_sort,
+                run_union=run_union)
             if n_stages == 0:
                 raise DistributedUnsupported(undistributable_reason(plan))
             from presto_tpu.obs import METRICS
@@ -414,8 +444,20 @@ class DistributedRunner:
             return concat_pages_host(pages)
 
     def _run_chain_stage_once(self, chain_root: PlanNode,
-                              source: "_StageSource", bound=None) -> List[Page]:
-        from presto_tpu.ops.sort import limit_compact_page, topn_compact_page
+                              source: "_StageSource", bound=None,
+                              sort=None, emit=None) -> List[Page]:
+        """One attempt of a chain stage's wave loop.  ``sort`` (a
+        SortNode consumer) appends a per-shard sort to the wave program
+        so every emitted page is a pre-sorted run (the distributed-sort
+        producer half, CreatePartialTopN's unbounded sibling).
+        ``emit`` streams each per-device page to the consuming stage as
+        soon as it is verified: immediately when the stage carries no
+        runtime checks (nothing can invalidate a page), after the
+        host-side check pass otherwise (an exchange-bucket overflow
+        would retry the stage and re-emit)."""
+        from presto_tpu.ops.sort import (
+            limit_compact_page, sort_page, topn_compact_page,
+        )
         from presto_tpu.planner.plan import TopNNode as _TopN
 
         ctx = _ChainCtx(source.cap)
@@ -444,6 +486,9 @@ class DistributedRunner:
                                           bound.nulls_first)
                 else:
                     p = limit_compact_page(p, bound.count)
+            if sort is not None:
+                p = sort_page(p, list(sort.sort_exprs), list(sort.ascending),
+                              sort.nulls_first)
             return _unsqueeze(p), {k: v[None] for k, v in checks.items()}
 
         bound_key = (None if bound is None else
@@ -451,7 +496,11 @@ class DistributedRunner:
                       tuple(getattr(bound, "sort_exprs", ()) or ()),
                       tuple(getattr(bound, "ascending", ()) or ()),
                       tuple(getattr(bound, "nulls_first", ()) or ())))
-        fn_key = (chain_root, "chain", ctx.sig(self._join_cfg), bound_key)
+        sort_key = (None if sort is None else
+                    (tuple(sort.sort_exprs), tuple(sort.ascending),
+                     tuple(sort.nulls_first or ())))
+        fn_key = (chain_root, "chain", ctx.sig(self._join_cfg), bound_key,
+                  sort_key)
         wave_fn = self._wave_fns.get(fn_key)
         if wave_fn is None:
             check_specs = {name: P(axis) for name in ctx.checks}
@@ -468,11 +517,21 @@ class DistributedRunner:
         out_pages: List[Page] = []
         wave_checks = []
         channels = chain_root.channels
+        stream_now = emit is not None and not ctx.checks
         for stacked in self._wave_iter(source, sharding):
             out, cks = wave_fn(stacked, consts_rep, consts_shard)
             wave_checks.append(cks)
-            out_pages.extend(_unstack_pages(jax.device_get(out), channels))
+            pages = _unstack_pages(jax.device_get(out), channels)
+            if stream_now:
+                for p in pages:
+                    emit(p)
+            else:
+                out_pages.extend(pages)
         self._verify_checks(chain_root, ctx, wave_checks, 0, False)
+        if emit is not None and not stream_now:
+            for p in out_pages:
+                emit(p)
+            return []
         return out_pages
 
     def _wave_iter(self, source: "_StageSource", sharding):
@@ -487,6 +546,227 @@ class DistributedRunner:
 
         return prefetch_iter(waves, depth=self.wave_prefetch,
                              name="dist-wave")
+
+    # ------------------------------------------------------------------
+    # streaming breaker stages: window / sort / union run ON the mesh
+    # instead of as coordinator glue, their pages travelling through
+    # the token-acked exchange (parallel/streams.py) so the consumer
+    # side (bucket routing, run merging, offset mapping) overlaps the
+    # producing waves
+    # ------------------------------------------------------------------
+    def _exchange(self, kind: str, name: str):
+        from presto_tpu.parallel.streams import StreamingExchange
+
+        return StreamingExchange(kind, name,
+                                 streaming=self.exchange_streaming,
+                                 max_bytes=self.exchange_buffer_bytes)
+
+    def _produce_chain_into(self, chain_root: PlanNode, put,
+                            sort=None) -> None:
+        """Producer body for a streamed chain stage: wave-execute and
+        put per-device pages, retrying capacity bumps internally (pages
+        are only emitted once they cannot be invalidated, so a retry
+        never re-emits)."""
+        source = self._stage_source(chain_root)
+        while True:
+            try:
+                self._run_chain_stage_once(chain_root, source, None,
+                                           sort=sort, emit=put)
+                return
+            except GroupCapacityExceeded:
+                continue
+
+    def run_sort_stage(self, node) -> Page:
+        """Distributed ORDER BY: every shard sorts its wave output
+        in-program (ops/sort.py), the pre-sorted runs stream to the
+        coordinator, and a fan-in-bounded k-way merge (ops/merge.py)
+        folds runs as they arrive — MergeOperator.java:45's shape with
+        the merge overlapped against still-running waves."""
+        from presto_tpu.obs import span
+        from presto_tpu.ops.merge import merge_sorted_pages
+
+        sort_args = (list(node.sort_exprs), list(node.ascending),
+                     node.nulls_first)
+        with span("dist_stage:sort", cat="exchange"):
+            ex = self._exchange("merge", "dist:sort")
+            stream = ex.stream()
+            ex.run(stream, lambda st: self._produce_chain_into(
+                node.source, st.put, sort=node))
+            runs: List[Page] = []
+            try:
+                for p in stream.drain():
+                    runs.append(p)
+                    if len(runs) >= self.merge_fanin:
+                        runs = [merge_sorted_pages(runs, *sort_args)]
+            except BaseException:
+                ex.abort()
+                raise
+            finally:
+                # always reap the producer thread: an orphan would keep
+                # executing mesh waves into the next query's state
+                ex.join()
+            if not runs:
+                return Page.empty([c.type for c in node.channels], 1)
+            return merge_sorted_pages(runs, *sort_args)
+
+    def run_window_stage(self, node) -> Page:
+        """Distributed window: the source chain's pages stream off the
+        mesh and hash-route on the PARTITION BY keys into one bucket
+        per device (the FIXED_HASH exchange, host-side at this tier) —
+        routing overlaps the producing waves; then one shard_map'd
+        ``ops/window.py`` program evaluates every device's complete
+        partitions in parallel."""
+        from presto_tpu.exec.spill import make_bucket_fn
+        from presto_tpu.obs import span
+
+        n = self.n
+        with span("dist_stage:window", cat="exchange"):
+            ex = self._exchange("hash", "dist:window")
+            stream = ex.stream()
+            ex.run(stream, lambda st: self._produce_chain_into(
+                node.source, st.put))
+            # memoized like the window program below: a fresh jit
+            # wrapper per query would recompile the hash-routing kernel
+            bucket_key = (node, "window_buckets", n)
+            bucket_fn = self._wave_fns.get(bucket_key)
+            if bucket_fn is None:
+                bucket_fn = make_bucket_fn(
+                    list(node.partition_exprs), node.partition_domains, n,
+                    jit=True)
+                self._wave_fns[bucket_key] = bucket_fn
+            buckets: List[List] = [[] for _ in range(n)]
+            try:
+                for p in stream.drain():
+                    self._route_to_buckets(p, bucket_fn(p), buckets)
+            except BaseException:
+                ex.abort()
+                raise
+            finally:
+                ex.join()
+            return self._window_over_buckets(node, buckets)
+
+    @staticmethod
+    def _route_to_buckets(page: Page, bids, buckets: List[List]) -> None:
+        """Append each bucket's (columns, valids, rows) slice of
+        ``page`` — live rows only, hash-routed like the partitioned
+        exchange write (PartitionedOutputOperator's host twin)."""
+        bids_np = np.asarray(bids)
+        mask = np.asarray(page.row_mask)
+        datas = [np.asarray(b.data) for b in page.blocks]
+        valids = [np.asarray(b.valid) for b in page.blocks]
+        for k in range(len(buckets)):
+            idx = np.nonzero(mask & (bids_np == k))[0]
+            if idx.size:
+                buckets[k].append(([d[idx] for d in datas],
+                                   [v[idx] for v in valids], idx.size))
+
+    def _window_over_buckets(self, node, buckets: List[List]) -> Page:
+        """One shard_map'd window program over the stacked per-device
+        bucket pages (each device holds complete partitions)."""
+        from presto_tpu.exec.local import bucket_capacity
+
+        src_channels = node.source.channels
+        rows = [sum(r for _, _, r in parts) for parts in buckets]
+        cap = bucket_capacity(max(max(rows), 1))
+        # empty buckets mirror a non-empty bucket's column shapes/dtypes
+        # (multi-dim blocks, e.g. long-decimal limbs, must stack evenly)
+        ref = {}
+        for parts in buckets:
+            for p in parts:
+                for i, d in enumerate(p[0]):
+                    ref.setdefault(i, (d.shape[1:], d.dtype))
+                break
+        pages = []
+        for parts in buckets:
+            blocks = []
+            for i, ch in enumerate(src_channels):
+                if parts:
+                    data = np.concatenate([p[0][i] for p in parts])
+                    valid = np.concatenate([p[1][i] for p in parts])
+                else:
+                    shape, dtype = ref.get(i, ((), ch.type.np_dtype))
+                    data = np.zeros((0,) + shape, dtype=dtype)
+                    valid = np.zeros(0, np.bool_)
+                pad = cap - data.shape[0]
+                if pad > 0:
+                    data = np.concatenate(
+                        [data, np.zeros((pad,) + data.shape[1:], data.dtype)])
+                    valid = np.concatenate([valid, np.zeros(pad, np.bool_)])
+                blocks.append(Block(data, valid, ch.type, ch.dictionary))
+            nlive = sum(r for _, _, r in parts)
+            mask = np.zeros(cap, np.bool_)
+            mask[:nlive] = True
+            pages.append(Page(tuple(blocks), mask))
+        stacked = _stack_pages(pages)
+
+        fn_key = (node, "window", cap)
+        win_fn = self._wave_fns.get(fn_key)
+        if win_fn is None:
+            from presto_tpu.ops.window import window_page
+
+            partition_exprs = list(node.partition_exprs)
+            order_exprs = list(node.order_exprs)
+            ascending = list(node.ascending)
+            funcs = list(node.funcs)
+            pd = node.partition_domains
+            mesh, axis = self.mesh, self.axis
+
+            def per_device_window(page1):
+                return _unsqueeze(window_page(
+                    _squeeze(page1), partition_exprs, order_exprs,
+                    ascending, funcs, partition_domains=pd))
+
+            win_fn = jax.jit(
+                shard_map(per_device_window, mesh=mesh, in_specs=P(axis),
+                          out_specs=P(axis)))
+            self._wave_fns[fn_key] = win_fn
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        out = win_fn(jax.device_put(stacked, sharding))
+        host_pages = _unstack_pages(jax.device_get(out), node.channels)
+        return concat_pages_host(host_pages)
+
+    def run_union_stage(self, node) -> Page:
+        """UNION ALL as producer stages draining into ONE exchange: on
+        a single mesh the legs' waves run back to back (the devices are
+        shared), but their pages stream through the exchange so the
+        consumer-side dictionary-offset mapping and concat overlap
+        production, and the multihost tier runs the same shape with
+        truly concurrent legs."""
+        from presto_tpu.obs import span
+        from presto_tpu.parallel.fragment import (
+            is_agg_stage, remap_union_leg_page,
+        )
+        from presto_tpu.parallel.streams import page_nbytes
+
+        chans = node.channels
+        offsets = node.code_offsets
+        with span("dist_stage:union", cat="exchange"):
+            ex = self._exchange("union", "dist:union")
+            stream = ex.stream()
+
+            def produce(st):
+                for k, leg in enumerate(node.inputs):
+                    put = (lambda kk: lambda p: st.put(
+                        (kk, p), nbytes=page_nbytes(p)))(k)
+                    if is_agg_stage(leg, self.min_stage_rows):
+                        put(self.run_aggregation_stage(leg))
+                    else:
+                        self._produce_chain_into(leg, put)
+
+            ex.run(stream, produce)
+            out: List[Page] = []
+            try:
+                for k, p in stream.drain():
+                    out.append(remap_union_leg_page(p, offsets[k], chans))
+            except BaseException:
+                ex.abort()
+                raise
+            finally:
+                ex.join()
+            if not out:
+                return Page.empty([c.type for c in chans], 1)
+            return concat_pages_host(out)
 
     # ------------------------------------------------------------------
     def run_aggregation_stage(self, agg: AggregationNode) -> Page:

@@ -68,6 +68,19 @@ class Partitioning:
         return self.kind
 
 
+def _keys_str(keys) -> str:
+    """Human-readable partition-key list for EXPLAIN's exchange edges."""
+    from presto_tpu.expr.ir import ColumnRef
+
+    names = []
+    for k in keys:
+        if isinstance(k, ColumnRef):
+            names.append(k.name or f"#{k.index}")
+        else:
+            names.append(str(k))
+    return ",".join(names)
+
+
 @dataclasses.dataclass
 class Fragment:
     """One distributable unit (PlanFragment analog): ``root``'s subtree
@@ -80,6 +93,21 @@ class Fragment:
     children: List["Fragment"] = dataclasses.field(default_factory=list)
     # per-shard row bound from a TopN/Limit consumer (CreatePartialTopN)
     shard_bound: Optional[int] = None
+    # exchange kind on the edge to the parent (hash / gather / merge /
+    # broadcast); None derives from the output partitioning
+    exchange_kind: Optional[str] = None
+    exchange_keys: Tuple = ()
+
+    def exchange_str(self) -> str:
+        """The stage-edge exchange EXPLAIN prints: how this fragment's
+        pages travel to the consumer (streaming page exchange kinds)."""
+        kind = self.exchange_kind
+        if kind is None:
+            kind = {FIXED_HASH: "hash", BROADCAST: "broadcast",
+                    COLOCATED: "colocated"}.get(self.output.kind, "gather")
+        keys = self.exchange_keys or (
+            self.output.keys if kind == "hash" else ())
+        return f"{kind}[{_keys_str(keys)}]" if keys else kind
 
     def tree_str(self, indent: int = 0) -> str:
         pad = "  " * indent
@@ -87,7 +115,8 @@ class Fragment:
             else f" shard_bound={self.shard_bound}"
         lines = [
             f"{pad}Fragment {self.fid} [{self.distribution}] "
-            f"=> output [{self.output}] root={type(self.root).__name__}"
+            f"=> output [{self.output}] via {self.exchange_str()} "
+            f"root={type(self.root).__name__}"
             f"{bound}"
         ]
         for ch in self.children:
@@ -339,6 +368,65 @@ def is_chain_stage(node: PlanNode,
             and _leaf_big_enough(node, min_precomputed_rows))
 
 
+def is_window_stage(node: PlanNode,
+                    min_precomputed_rows: int = DEFAULT_MIN_STAGE_ROWS) -> bool:
+    """Root of a distributed window stage: a hash exchange on the
+    PARTITION BY keys routes every partition's rows to one shard, then
+    ``ops/window.py`` runs per shard (the reference's FIXED_HASH
+    WindowNode fragment, AddExchanges partitioning on
+    ``WindowNode.getPartitionBy``).  Plain column keys only — both
+    tiers route by key channel index; an empty PARTITION BY is a
+    whole-relation window and stays on the coordinator."""
+    from presto_tpu.expr.ir import ColumnRef
+
+    return (isinstance(node, WindowNode)
+            and bool(node.partition_exprs)
+            and all(isinstance(e, ColumnRef) for e in node.partition_exprs)
+            and chain_distributable(node.source) is None
+            and _leaf_big_enough(node.source, min_precomputed_rows))
+
+
+def is_sort_stage(node: PlanNode,
+                  min_precomputed_rows: int = DEFAULT_MIN_STAGE_ROWS) -> bool:
+    """Root of a distributed ORDER BY: each shard sorts its own rows
+    (ops/sort.py inside the stage program) and the coordinator k-way
+    merges the pre-sorted runs (ops/merge.py) — MergeOperator.java:45's
+    shape.  Small inputs stay coordinator glue: the merge tree would
+    cost more than one local sort."""
+    return (isinstance(node, SortNode)
+            and chain_distributable(node.source) is None
+            and _leaf_big_enough(node.source, min_precomputed_rows))
+
+
+def is_union_stage(node: PlanNode,
+                   min_precomputed_rows: int = DEFAULT_MIN_STAGE_ROWS) -> bool:
+    """A UNION whose every leg is itself a runnable stage (chain or
+    aggregation): the legs execute as concurrent producer stages
+    draining into ONE streaming exchange, instead of sequential
+    coordinator concatenation."""
+    if not isinstance(node, UnionNode) or len(node.inputs) < 2:
+        return False
+    return all(
+        is_agg_stage(leg, min_precomputed_rows)
+        or is_chain_stage(leg, min_precomputed_rows)
+        for leg in node.inputs)
+
+
+def remap_union_leg_page(page, offs, channels):
+    """Consumer side of the union exchange, shared by both tiers:
+    apply leg ``offs``'s dictionary-code offsets and retype blocks to
+    the union's output ``channels`` (legs built against different
+    varchar dictionaries unify here)."""
+    from presto_tpu.page import Block, Page
+
+    blocks = []
+    for i, b in enumerate(page.blocks):
+        data = b.data + offs[i] if offs[i] else b.data
+        blocks.append(Block(data, b.valid, channels[i].type,
+                            channels[i].dictionary))
+    return Page(tuple(blocks), page.row_mask)
+
+
 def child_slots(node: PlanNode):
     """(slot, child) edges of the node kinds the decomposition recurses
     through.  Unknown node kinds yield nothing — their subtree stays on
@@ -399,26 +487,50 @@ def _parent_fuses(parent: PlanNode, slot) -> bool:
 
 def lower_stages(plan: PlanNode, run_agg, run_chain, eval_glue,
                  splices: list,
-                 min_stage_rows: int = DEFAULT_MIN_STAGE_ROWS):
+                 min_stage_rows: int = DEFAULT_MIN_STAGE_ROWS,
+                 run_window=None, run_sort=None, run_union=None):
     """Decompose ``plan`` into mesh stages bottom-up, splicing each
     executed stage's materialization back into the tree.  ``run_agg`` /
     ``run_chain`` execute a stage and return its PrecomputedNode;
     ``eval_glue`` evaluates a fully-materialized glue breaker on the
-    coordinator (may return None to leave it in place).  ``splices``
-    records (parent, slot, old_child) for restoration.  Returns
-    (mesh_stage_count, lowered_root) — glue evaluations do not count.
+    coordinator (may return None to leave it in place).  ``run_window``
+    / ``run_sort`` / ``run_union`` (optional — a runner that omits one
+    keeps the coordinator-glue behavior for that breaker) execute the
+    distributed breaker stages: hash-exchanged per-shard windows,
+    per-shard sort + coordinator merge, and concurrent UNION legs into
+    one exchange.  ``splices`` records (parent, slot, old_child) for
+    restoration.  Returns (mesh_stage_count, lowered_root) — glue
+    evaluations do not count.
 
     Simulation (EXPLAIN) passes callbacks that fabricate empty
     PrecomputedNodes instead of executing, walking the identical
     decomposition, so EXPLAIN (TYPE DISTRIBUTED) always describes what
     execution would actually do."""
 
-    def try_stage(node, bound=None):
-        if is_agg_stage(node, min_stage_rows):
-            return run_agg(node)
-        if is_chain_stage(node, min_stage_rows):
-            return run_chain(node, bound)
+    def breaker_stage_kind(node) -> Optional[str]:
+        if run_window is not None and is_window_stage(node, min_stage_rows):
+            return "window"
+        if run_sort is not None and is_sort_stage(node, min_stage_rows):
+            return "sort"
+        if run_union is not None and is_union_stage(node, min_stage_rows):
+            return "union"
         return None
+
+    def try_stage(node, bound=None):
+        """(spliced PrecomputedNode, stage count) or (None, 0)."""
+        if is_agg_stage(node, min_stage_rows):
+            return run_agg(node), 1
+        kind = breaker_stage_kind(node)
+        if kind == "window":
+            return run_window(node), 1
+        if kind == "sort":
+            return run_sort(node), 1
+        if kind == "union":
+            # one producer stage per leg, all draining one exchange
+            return run_union(node), len(node.inputs)
+        if is_chain_stage(node, min_stage_rows):
+            return run_chain(node, bound), 1
+        return None, 0
 
     def splice(parent, slot, old, new):
         splices.append((parent, slot, old))
@@ -443,17 +555,35 @@ def lower_stages(plan: PlanNode, run_agg, run_chain, eval_glue,
         breakers hanging off its build sides (a join build containing
         an aggregation subquery distributes as its own stage; build
         splices cannot break the probe chain)."""
-        spine = child.source if isinstance(child, AggregationNode) else child
-        n = sum(lower_edge(j, "right") for j in spine_joins(spine))
+        if isinstance(child, UnionNode):
+            spines = [leg.source if isinstance(leg, AggregationNode) else leg
+                      for leg in child.inputs]
+        elif isinstance(child, (AggregationNode, WindowNode, SortNode)):
+            spines = [child.source]
+        else:
+            spines = [child]
+        n = 0
+        for sp in spines:
+            n += sum(lower_edge(j, "right") for j in spine_joins(sp))
         # a TopN/Limit consumer bounds each shard's output to its count
         # before the gather (CreatePartialTopN.java role) — the glue
         # breaker still runs on the coordinator for the global pick
         bound = parent if (isinstance(parent, (TopNNode, LimitNode))
                            and slot == "source") else None
-        new = try_stage(child, bound)
+        new, k = try_stage(child, bound)
         assert new is not None  # build splices never un-distribute a chain
         splice(parent, slot, child, new)
-        return n + 1
+        return n + k
+
+    def cuts_here(child, fuses: bool) -> bool:
+        """Whether ``child`` roots a stage at this edge: aggregations
+        and breaker stages cut regardless of the parent (they never
+        fuse into an ancestor chain); a pure chain cuts only at its
+        outermost position (fusing parents defer to the ancestor that
+        will include this subtree in its own stage)."""
+        return (is_agg_stage(child, min_stage_rows)
+                or breaker_stage_kind(child) is not None
+                or (not fuses and is_chain_stage(child, min_stage_rows)))
 
     def lower_edge(parent, slot) -> int:
         child = get_child(parent, slot)
@@ -463,23 +593,18 @@ def lower_stages(plan: PlanNode, run_agg, run_chain, eval_glue,
             # itself (sharded/colocated builds); pre-materializing here
             # would downgrade a partitioned build to broadcast
             return 0
-        # an aggregation stage cuts regardless of the parent (a single
-        # aggregation never fuses into an ancestor chain); a pure chain
-        # cuts only at its outermost position (fusing parents defer to
-        # the ancestor that will include this subtree in its own stage)
         fuses = _parent_fuses(parent, slot)
-        if is_agg_stage(child, min_stage_rows) or (
-                not fuses and is_chain_stage(child, min_stage_rows)):
+        if cuts_here(child, fuses):
             return run_stage_at(parent, slot, child)
         n = 0
         for cslot, _ in child_slots(child):
             n += lower_edge(child, cslot)
         if n == 0:
             return 0
-        if is_agg_stage(child, min_stage_rows) or (
-                not fuses and is_chain_stage(child, min_stage_rows)):
+        if cuts_here(child, fuses):
             # children materialized: the node became a stage root (e.g.
-            # an aggregation whose chain leaf was a subquery)
+            # an aggregation whose chain leaf was a subquery, or a
+            # window/sort over a now-materialized intermediate)
             return n + run_stage_at(parent, slot, child)
         # a glue breaker over a fully-materialized subtree evaluates on
         # the coordinator so an ANCESTOR stage can distribute over it
@@ -591,10 +716,50 @@ def fragment_plan(
         )
         return tag(node, frag)
 
+    def sim_window(node: WindowNode) -> PrecomputedNode:
+        # source fragment hash-exchanges on the PARTITION BY keys; the
+        # window fragment runs per shard and gathers
+        keys = tuple(node.partition_exprs)
+        part = Partitioning(FIXED_HASH, keys)
+        leaf = Fragment(
+            next_id(), node.source,
+            distribution=_leaf_distribution(node.source), output=part,
+            children=collect_children(node.source),
+        )
+        win = Fragment(next_id(), node, distribution=part,
+                       output=Partitioning(SINGLE), children=[leaf])
+        return tag(node, win)
+
+    def sim_sort(node: SortNode) -> PrecomputedNode:
+        # per-shard sort inside the stage; the edge to the consumer is
+        # an order-preserving merge of the pre-sorted runs
+        frag = Fragment(
+            next_id(), node, distribution=_leaf_distribution(node.source),
+            output=Partitioning(SINGLE), children=collect_children(node.source),
+            exchange_kind="merge", exchange_keys=tuple(node.sort_exprs),
+        )
+        return tag(node, frag)
+
+    def sim_union(node: UnionNode) -> PrecomputedNode:
+        # one concurrent producer fragment per leg, all draining into
+        # the union fragment's single streaming exchange
+        legs = []
+        for leg in node.inputs:
+            legs.append(Fragment(
+                next_id(), leg, distribution=_leaf_distribution(leg),
+                output=Partitioning(SINGLE), children=collect_children(leg),
+            ))
+        frag = Fragment(next_id(), node, distribution=Partitioning(SINGLE),
+                        output=Partitioning(SINGLE), children=legs,
+                        exchange_kind="union")
+        return tag(node, frag)
+
     splices: list = []
     try:
         n, root = lower_stages(plan, sim_agg, sim_chain, sim_glue, splices,
-                               min_stage_rows=min_stage_rows)
+                               min_stage_rows=min_stage_rows,
+                               run_window=sim_window, run_sort=sim_sort,
+                               run_union=sim_union)
         out = Fragment(
             next_id(), plan, distribution=Partitioning(SINGLE),
             output=Partitioning(SINGLE), children=collect_children(root),

@@ -77,6 +77,18 @@ class MultiHostUnsupported(Exception):
     pass
 
 
+class _StreamBroken(ConnectionError):
+    """A producing worker died mid-stream AFTER the consumer took
+    ``delivered`` pages: the failover re-run must replay from that
+    watermark (skip the first ``delivered`` pages) instead of
+    recomputing into duplicates — the streaming twin of the
+    all-or-nothing fragment retry."""
+
+    def __init__(self, delivered: int, cause: BaseException):
+        super().__init__(f"{type(cause).__name__}: {cause}")
+        self.delivered = delivered
+
+
 class WorkerClient:
     """One remote worker (HttpRemoteTask + Backoff analog). Results
     stream through the worker's acked pull buffers: long-poll GETs with
@@ -247,7 +259,8 @@ class MultiHostRunner:
                  max_splits_per_node: int = 0,
                  execution_policy: str = "phased",
                  detector=None, events=None,
-                 max_fragment_retries: Optional[int] = None):
+                 max_fragment_retries: Optional[int] = None,
+                 exchange_streaming: Optional[bool] = None):
         from presto_tpu.parallel.failure import FailureDetector
         from presto_tpu.parallel.fragment import DEFAULT_BROADCAST_THRESHOLD
 
@@ -296,8 +309,21 @@ class MultiHostRunner:
         self.execution_policy = execution_policy
         # stage-DAG knobs/observability (mirrors DistributedRunner)
         from presto_tpu.parallel.fragment import DEFAULT_MIN_STAGE_ROWS
+        from presto_tpu.parallel.streams import (
+            exchange_buffer_bytes_default, exchange_streaming_default,
+        )
 
         self.min_stage_rows = DEFAULT_MIN_STAGE_ROWS
+        # streaming page exchange (parallel/streams.py): worker pages
+        # reach the consumer as they land in the producer's output
+        # buffer; off = drain-everything-then-continue (the A/B leg)
+        self.exchange_streaming = (exchange_streaming_default()
+                                   if exchange_streaming is None
+                                   else bool(exchange_streaming))
+        self.exchange_buffer_bytes = exchange_buffer_bytes_default()
+        self.merge_fanin = 8
+        # stage-overlap evidence of the last streamed gather (A/B tool)
+        self.last_exchange_stats: Dict[str, float] = {}
         self.last_stage_count = 0
         self.last_gather_rows = 0
         # observability: last split placement per stage-launch
@@ -394,11 +420,25 @@ class MultiHostRunner:
             page = self.local.run_to_page(node)
             return PrecomputedNode(page=page, channel_list=node.channels)
 
+        def run_window(node) -> PrecomputedNode:
+            page = self._stage_window(node)
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
+        def run_sort(node) -> PrecomputedNode:
+            page = self._stage_sort(node)
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
+        def run_union(node) -> PrecomputedNode:
+            page = self._stage_union(node)
+            return PrecomputedNode(page=page, channel_list=node.channels)
+
         splices: List = []
         try:
             n_stages, root = lower_stages(
                 plan, run_agg, run_chain, eval_glue, splices,
-                min_stage_rows=self.min_stage_rows)
+                min_stage_rows=self.min_stage_rows,
+                run_window=run_window, run_sort=run_sort,
+                run_union=run_union)
             if n_stages == 0:
                 raise MultiHostUnsupported(undistributable_reason(plan))
             self.last_stage_count = n_stages
@@ -471,6 +511,166 @@ class MultiHostRunner:
 
             return Page.empty([c.type for c in chain_root.channels], 1)
         return concat_pages_host(pages)
+
+    def _stage_window(self, wnode: WindowNode):
+        """Distributed window stage: stage-1 tasks run the source chain
+        with hash-partitioned output on the PARTITION BY keys (one
+        buffer per consumer — PartitionedOutputBuffer); stage-2 worker
+        k pulls partition k from EVERY stage-1 task while stage 1 is
+        still producing (the streaming stage overlap) and runs
+        ``ops/window.py`` over its complete partitions; the coordinator
+        drains only the window outputs.  Degrades to a distributed
+        source gather + coordinator window when fewer than two workers
+        survive or the shuffle dies mid-flight."""
+        from presto_tpu.obs import span
+
+        leaf = self.local._chain_leaf(wnode.source)
+        with span("mh_stage:window", cat="exchange"):
+            alive = self._live_workers()
+            if len(alive) >= 2 and isinstance(leaf, TableScanNode):
+                try:
+                    return self._run_window_two_stage(wnode, leaf, alive)
+                except ConnectionError as e:
+                    # degrade below (gather + coordinator window) — loud:
+                    # the operator must be able to see stage-1 re-scans
+                    from presto_tpu.obs import METRICS
+
+                    METRICS.counter(
+                        "multihost.window_shuffle_degraded").inc()
+                    _log.warning(
+                        "window shuffle lost a worker mid-flight (%s); "
+                        "degrading to gather + coordinator window", e)
+            src_page = self._stage_chain(wnode.source)
+            pre = PrecomputedNode(page=src_page,
+                                  channel_list=wnode.source.channels)
+            orig = wnode.source
+            try:
+                wnode.source = pre
+                return self.local.run_to_page(wnode)
+            finally:
+                wnode.source = orig
+
+    def _run_window_two_stage(self, wnode: WindowNode, scan: TableScanNode,
+                              alive: List["WorkerClient"]):
+        from presto_tpu.page import Page, concat_pages_host
+        from presto_tpu.planner.plan import RemoteSourceNode
+
+        kidx = [e.index for e in wnode.partition_exprs]
+        kd = wnode.partition_domains
+        stage1 = self._launch_stage1(wnode.source, scan, kidx, kd, alive)
+        stage2: List[tuple] = []
+        try:
+            upstream = [(w.uri, tid) for w, tid in stage1]
+            final = WindowNode(
+                source=RemoteSourceNode(producer=wnode.source,
+                                        tasks=upstream, buffer_id=0),
+                partition_exprs=list(wnode.partition_exprs),
+                order_exprs=list(wnode.order_exprs),
+                ascending=list(wnode.ascending),
+                funcs=list(wnode.funcs),
+                func_names=list(wnode.func_names),
+            )
+            base = plan_to_json(final)
+
+            def make_frag(k: int) -> dict:
+                frag = json.loads(json.dumps(base))
+                _set_remote_buffers(frag, k)
+                return frag
+
+            results = self._fan_out_stage2(alive, make_frag, stage2)
+            dicts = [c.dictionary for c in wnode.channels]
+            pages = [deserialize_page(r, dicts, verify=False)
+                     for r in results]
+            if not pages:
+                return Page.empty([c.type for c in wnode.channels], 1)
+            return concat_pages_host(pages)
+        finally:
+            for w, tid in stage1 + stage2:
+                w.delete_task(tid)
+
+    def _stage_sort(self, snode: SortNode):
+        """Distributed ORDER BY: each worker's fragment sorts its own
+        split subset (the SortNode ships inside the fragment), sorted
+        runs stream back, and the coordinator finishes with the k-way
+        order-preserving merge (ops/merge.py) — it never re-sorts the
+        full relation."""
+        from presto_tpu.obs import span
+        from presto_tpu.ops.merge import merge_sorted_pages
+        from presto_tpu.page import Page
+
+        leaf = self.local._chain_leaf(snode.source)
+        with span("mh_stage:sort", cat="exchange"):
+            if isinstance(leaf, TableScanNode):
+                pages = self._run_fragments(snode, leaf)
+            elif isinstance(leaf, PrecomputedNode):
+                pages = self._run_fragments_pre(snode, leaf)
+            else:
+                raise MultiHostUnsupported("sort stage leaf is neither "
+                                           "scan nor materialized input")
+        for p in pages:
+            self.last_gather_rows += int(np.asarray(p.row_mask).sum())
+        if not pages:
+            return Page.empty([c.type for c in snode.channels], 1)
+        sort_args = (list(snode.sort_exprs), list(snode.ascending),
+                     snode.nulls_first)
+        # fold in exchange_merge_fanin-sized batches so each k-way
+        # merge's k (and its resident runs) stays bounded
+        runs = list(pages)
+        while len(runs) > self.merge_fanin:
+            runs = [merge_sorted_pages(runs[i:i + self.merge_fanin],
+                                       *sort_args)
+                    for i in range(0, len(runs), self.merge_fanin)]
+        return merge_sorted_pages(runs, *sort_args)
+
+    def _stage_union(self, unode):
+        """UNION legs as concurrent producer stages draining into ONE
+        streaming exchange: leg k's pages carry its dictionary-code
+        offsets; the consumer applies them and concatenates in leg
+        order.  With exchange_streaming off the legs run sequentially
+        (the materialized A/B leg)."""
+        from presto_tpu.obs import span
+        from presto_tpu.page import Page, concat_pages_host
+        from presto_tpu.parallel.fragment import (
+            is_agg_stage, remap_union_leg_page,
+        )
+        from presto_tpu.parallel.streams import (
+            StreamingExchange, page_nbytes,
+        )
+
+        chans = unode.channels
+        offsets = unode.code_offsets
+        with span("mh_stage:union", cat="exchange"):
+            ex = StreamingExchange(
+                "union", "mh:union", streaming=self.exchange_streaming,
+                max_bytes=self.exchange_buffer_bytes)
+            stream = ex.stream(producers=len(unode.inputs))
+
+            def make_producer(k: int, leg: PlanNode):
+                def produce(st):
+                    if is_agg_stage(leg, self.min_stage_rows):
+                        page = self._stage_agg(leg)
+                    else:
+                        page = self._stage_chain(leg)
+                    st.put((k, page), nbytes=page_nbytes(page))
+
+                return produce
+
+            for k, leg in enumerate(unode.inputs):
+                ex.run(stream, make_producer(k, leg))
+            by_leg: Dict[int, List] = {}
+            try:
+                for k, p in stream.drain():
+                    by_leg.setdefault(k, []).append(
+                        remap_union_leg_page(p, offsets[k], chans))
+            except BaseException:
+                ex.abort()
+                raise
+            finally:
+                ex.join()
+            out = [p for k in sorted(by_leg) for p in by_leg[k]]
+            if not out:
+                return Page.empty([c.type for c in chans], 1)
+            return concat_pages_host(out)
 
     def _run_agg_over_pre(self, agg: AggregationNode, pre: PrecomputedNode):
         """Distributed aggregation whose input is a previous stage's
@@ -546,6 +746,12 @@ class MultiHostRunner:
                 return plan_to_json(fragment_root)
             finally:
                 pre.page = original
+
+        if self.exchange_streaming:
+            return self._stream_fragment_pairs(
+                fragment_root, list(zip(alive, chunks)), make_fragment,
+                run_local=lambda chunk, skip: self._run_chunk_local(
+                    fragment_root, pre, chunk)[skip:])
 
         errors: List[BaseException] = []
 
@@ -1104,6 +1310,16 @@ class MultiHostRunner:
             finally:
                 scan.splits = original
 
+        if self.exchange_streaming:
+            pages = self._stream_fragment_pairs(
+                fragment_root, list(assignments.items()), make_fragment,
+                run_local=lambda splits, skip: self._run_splits_local(
+                    fragment_root, scan, splits)[skip:],
+                prog=prog, prog_stage=prog_stage, prog_n=len)
+            if prog is not None:
+                prog.finish_stage(prog_stage)
+            return pages
+
         errors: List[BaseException] = []
 
         def run_on(w: WorkerClient, splits: List[int], fragment: dict):
@@ -1160,6 +1376,176 @@ class MultiHostRunner:
         return [deserialize_page(r, dictionaries, verify=False)
                 for r in results] + local_pages
 
+
+    # -- streaming fragment fan-out ------------------------------------
+    def _pull_fragment_pages(self, w: "WorkerClient", fragment: dict, emit,
+                             dicts, skip: int = 0) -> int:
+        """Create + drain one fragment task, emitting each verified,
+        deserialized page as it lands in the worker's output buffer
+        (``emit(page, nbytes)``) — the streaming twin of
+        WorkerClient.run_fragment, with the same transient/deterministic
+        triage.  ``skip`` pages are discarded first: the consumer
+        already took them from a previous incarnation of this fragment
+        (replay from the last acked token; fragments are pure and page
+        order deterministic, so the re-run's prefix is byte-equal).
+        Returns the delivered-page watermark; raises _StreamBroken
+        (carrying it) when the worker dies mid-stream, TaskFailed on a
+        deterministic query error."""
+        from presto_tpu.net import is_transient
+        from presto_tpu.obs import METRICS
+        from presto_tpu.server.serde import deserialize_page, verify_page
+        from presto_tpu.server.shuffle_client import (
+            TaskPullFailed, pull_pages,
+        )
+
+        delivered = skip
+        last: Optional[BaseException] = None
+        for attempt in range(w.max_attempts):
+            if delivered > 0 and (attempt > 0 or skip > 0):
+                # this task re-produces pages the consumer already has
+                METRICS.counter("exchange.stream_replays_total").inc()
+            tid = None
+            skip_target = delivered  # prefix this incarnation replays
+            skipped = 0
+            try:
+                tid = w.create_task(fragment)
+                for raw in pull_pages(w.uri, tid, 0, timeout=w.timeout):
+                    if skipped < skip_target:
+                        skipped += 1
+                        continue
+                    verify_page(raw)
+                    emit(deserialize_page(raw, dicts, verify=False),
+                         len(raw))
+                    delivered += 1
+                w._ok()
+                return delivered
+            except TaskPullFailed as e:
+                if "PageIntegrityError" not in str(e):
+                    # deterministic query error: it travels; the worker
+                    # is not to blame and a retry recomputes the same
+                    raise TaskFailed(str(e)) from e
+                last = e  # damaged in-fragment input page: recompute
+            except TaskFailed:
+                raise
+            except Exception as e:
+                if not is_transient(e):
+                    raise TaskFailed(f"{type(e).__name__}: {e}") from e
+                last = e
+            finally:
+                if tid is not None:
+                    w.delete_task(tid)
+            time.sleep(min(0.1 * (2 ** attempt), 2.0))
+        w._failed(last)
+        raise _StreamBroken(delivered, last)
+
+    def _stream_fragment_pairs(self, fragment_root: PlanNode, pairs,
+                               make_fragment, run_local,
+                               prog=None, prog_stage=None,
+                               prog_n=lambda item: 1) -> List["Page"]:
+        """Streaming fan-out driver shared by the scan-leaf and
+        pre-chunk fragment paths: one puller thread per (worker, item)
+        feeds a token-acked PageStream and the consumer takes pages the
+        moment the FIRST producer emits — stage k+1 overlaps stage k.
+        Mid-stream producer death re-dispatches the SAME fragment onto
+        a survivor with the delivered-page watermark (replay), under
+        the usual bounded retry budget, finishing coordinator-local
+        (``run_local(item, skip)``) when no worker can.
+
+        Pages travel tagged (producer slot, sequence) and the returned
+        list is reassembled in assignment order — byte-identical to the
+        materialized gather — so order-carrying inputs (a chain stage
+        over a sorted intermediate) survive arrival-order races; the
+        overlap (pull + verify + deserialize while producers still run)
+        is unaffected."""
+        from presto_tpu.parallel.streams import PageStream
+
+        dicts = [c.dictionary for c in fragment_root.channels]
+        live = [(slot, w, item, make_fragment(item))
+                for slot, (w, item) in enumerate(p for p in pairs if p[1])]
+        stream = PageStream(max_bytes=self.exchange_buffer_bytes,
+                            producers=max(len(live), 1), name="mh:gather")
+        slotted: List[tuple] = []  # (slot, seq, page)
+        failed: List[tuple] = []
+        errors: List[BaseException] = []
+        lock = threading.Lock()
+
+        def emit_into(put, slot: int, start: int = 0):
+            seq = [start]
+
+            def emit(page, nbytes):
+                put((slot, seq[0], page), nbytes=nbytes)
+                seq[0] += 1
+
+            return emit
+
+        def run_on(slot: int, w: WorkerClient, item, fragment: dict):
+            try:
+                self._pull_fragment_pages(
+                    w, fragment, emit_into(stream.put, slot), dicts)
+                if prog is not None:
+                    prog.split_done(prog_stage, n=prog_n(item))
+            except _StreamBroken as e:
+                with lock:
+                    failed.append((slot, item, fragment, e.delivered))
+            except ConnectionError:
+                with lock:
+                    failed.append((slot, item, fragment, 0))
+            except BaseException as e:  # deterministic query error:
+                with lock:              # fail the query rather than
+                    errors.append(e)    # silently dropping the rows
+            finally:
+                stream.producer_done()
+
+        if not live:
+            stream.producer_done()
+        threads = [threading.Thread(target=run_on, args=t) for t in live]
+        for t in threads:
+            t.start()
+        for tagged in stream.drain():
+            slotted.append(tagged)
+        for t in threads:
+            t.join()
+        self.last_exchange_stats = {
+            "pages": float(stream.pages_in),
+            "bytes": float(stream.bytes_in),
+            "peak_buffered_bytes": float(stream.peak_bytes),
+            "first_page_at": stream.first_page_at or 0.0,
+            "producers_done_at": stream.completed_at or 0.0,
+        }
+
+        def redispatch(item4, survivors, rr):
+            slot, item, fragment, delivered = item4
+            w = survivors[rr % len(survivors)]
+            emit = emit_into(
+                lambda tagged, nbytes: slotted.append(tagged), slot,
+                start=delivered)
+            try:
+                self._pull_fragment_pages(w, fragment, emit, dicts,
+                                          skip=delivered)
+                if prog is not None:
+                    prog.split_done(prog_stage, n=prog_n(item))
+            except _StreamBroken as e:
+                with lock:
+                    failed.append((slot, item, fragment, e.delivered))
+            except ConnectionError:
+                with lock:
+                    failed.append((slot, item, fragment, delivered))
+            except BaseException as e:
+                with lock:
+                    errors.append(e)
+
+        def run_local_item(item4):
+            slot, item, _fragment, delivered = item4
+            out = run_local(item, delivered)
+            if prog is not None:
+                prog.split_done(prog_stage, n=prog_n(item))
+            return [(slot, delivered + i, p) for i, p in enumerate(out)]
+
+        slotted.extend(self._failover(
+            failed, [w for _, w, _, _ in live], errors, redispatch,
+            run_local_item))
+        slotted.sort(key=lambda t: (t[0], t[1]))
+        return [p for _, _, p in slotted]
 
     # -- shared failover driver ----------------------------------------
     def _failover(self, failed: List, alive: List["WorkerClient"],

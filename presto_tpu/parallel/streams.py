@@ -1,0 +1,269 @@
+"""In-process streaming page exchange: the pull-based, token-acked
+stage boundary shared by the mesh tier (parallel/dist.py) and the DCN
+tier (parallel/multihost.py).
+
+Reference analog: the consumer half of the exchange —
+``operator/ExchangeClient.java:58`` pulling
+``execution/buffer/OutputBuffer.java`` pages by (token, ack) long-poll
+— collapsed to an in-memory :class:`TaskOutputBuffer` when producer
+and consumer share a process.  A stage's producers (mesh waves, HTTP
+worker pullers, UNION legs) enqueue pages as they materialize; the
+consuming stage pulls them immediately, so stage k+1 overlaps stage k
+instead of waiting for a fully materialized intermediate.  The byte
+cap gives pull-side backpressure: producers block (and account stall
+time) when the consumer lags, bounding in-flight exchange memory.
+
+Kill integration: every stream created inside :func:`query_scope`
+registers under that query id, and :func:`abort_query` (called by
+``MemoryPool.kill_query`` — deadline and low-memory kills) aborts them
+so producer threads blocked in ``enqueue`` exit instead of leaking.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+from presto_tpu.envflag import EnvFlag, EnvInt
+from presto_tpu.server.buffers import BufferAborted, TaskOutputBuffer
+
+#: process defaults (session properties exchange_streaming /
+#: exchange_buffer_bytes override per query) — resolved once, per the
+#: hot-path env-read rule
+exchange_streaming_default = EnvFlag("PRESTO_TPU_EXCHANGE_STREAMING", True)
+exchange_buffer_bytes_default = EnvInt(
+    "PRESTO_TPU_EXCHANGE_BUFFER_BYTES", 64 << 20, floor=1 << 16)
+
+
+class StreamFailed(RuntimeError):
+    """A producer failed; the consumer re-raises the original error."""
+
+
+def page_nbytes(page) -> int:
+    """Best-effort in-memory size of a Page (backpressure accounting)."""
+    try:
+        from presto_tpu.memory import page_bytes
+
+        return int(page_bytes(page))
+    except Exception:
+        return 1 << 12  # exotic blocks: charge a nominal page
+
+
+class PageStream:
+    """One token-acked stream of Page payloads over an in-memory
+    buffer — the in-process twin of a worker's output buffer, with the
+    exact same enqueue / get(token) / acknowledge protocol."""
+
+    def __init__(self, max_bytes: Optional[int] = None, producers: int = 1,
+                 name: str = ""):
+        self.name = name
+        self.buffer = TaskOutputBuffer(
+            max_bytes=max_bytes or exchange_buffer_bytes_default(),
+            producers=producers)
+        self._exc: Optional[BaseException] = None
+        # concurrent producers (union legs, per-worker pullers) share
+        # one stream: the overlap stats must not drop updates
+        self._stats_lock = threading.Lock()
+        self.pages_in = 0
+        self.bytes_in = 0
+        self.peak_bytes = 0
+        self.closed = False
+        _LIVE.add(self)
+        _register(self)
+
+    # -- producer side -------------------------------------------------
+    def put(self, page, nbytes: Optional[int] = None) -> None:
+        from presto_tpu.obs import METRICS
+
+        size = page_nbytes(page) if nbytes is None else int(nbytes)
+        self.buffer.enqueue(page, nbytes=size)
+        b = self.buffer.unacked_bytes
+        with self._stats_lock:
+            self.pages_in += 1
+            self.bytes_in += size
+            if b > self.peak_bytes:
+                self.peak_bytes = b
+        METRICS.counter("exchange.stream_pages_total").inc()
+        METRICS.counter("exchange.stream_bytes_total").inc(size)
+
+    def producer_done(self) -> None:
+        self.buffer.set_complete()
+
+    def fail(self, exc: BaseException) -> None:
+        if self._exc is None:
+            self._exc = exc
+        self.buffer.fail(f"{type(exc).__name__}: {exc}")
+
+    def abort(self) -> None:
+        self.closed = True
+        self.buffer.abort()
+
+    # -- consumer side -------------------------------------------------
+    @property
+    def buffered_bytes(self) -> int:
+        return self.buffer.unacked_bytes
+
+    @property
+    def first_page_at(self) -> Optional[float]:
+        return self.buffer.first_page_at
+
+    @property
+    def completed_at(self) -> Optional[float]:
+        return self.buffer.completed_at
+
+    def drain(self, batch_bytes: int = 8 << 20) -> Iterator:
+        """Pull + ack until complete; re-raises a producer's error."""
+        token = 0
+        try:
+            while True:
+                items, nxt, done, err = self.buffer.get(
+                    token, max_bytes=batch_bytes, timeout=10.0)
+                if err is not None:
+                    raise self._exc if self._exc is not None \
+                        else StreamFailed(err)
+                for it in items:
+                    yield it
+                if nxt > token:
+                    self.buffer.acknowledge(nxt)
+                    token = nxt
+                if done:
+                    return
+        finally:
+            self.closed = True
+
+
+class StreamingExchange:
+    """One stage boundary: N producer streams feeding one consumer.
+    ``kind`` names the exchange shape EXPLAIN prints (hash / gather /
+    merge / union); ``streaming=False`` degrades every ``run``ed
+    producer to inline (materialize-then-consume) execution — the A/B
+    leg of the streamed-vs-materialized comparison."""
+
+    def __init__(self, kind: str, name: str = "", streaming: bool = True,
+                 max_bytes: Optional[int] = None):
+        self.kind = kind
+        self.name = name or kind
+        self.streaming = streaming
+        self.max_bytes = max_bytes
+        self.streams: List[PageStream] = []
+        self._threads: List[threading.Thread] = []
+
+    def stream(self, producers: int = 1) -> PageStream:
+        # materialized mode buffers the full intermediate by definition:
+        # producers run inline BEFORE the consumer drains, so the byte
+        # cap must not bind or an over-cap stage deadlocks in enqueue
+        cap = (self.max_bytes or exchange_buffer_bytes_default()) \
+            if self.streaming else (1 << 62)
+        s = PageStream(max_bytes=cap, producers=producers,
+                       name=f"{self.name}[{len(self.streams)}]")
+        self.streams.append(s)
+        return s
+
+    def run(self, stream: PageStream, produce: Callable[[PageStream], None],
+            ) -> None:
+        """Run one producer into ``stream``: a daemon thread when
+        streaming, inline (to completion, before the consumer pulls)
+        when not.  The producer's error travels to the consumer through
+        the stream; abort ends it quietly (kill path)."""
+
+        def _run():
+            try:
+                produce(stream)
+            except BufferAborted:
+                pass
+            except BaseException as e:
+                stream.fail(e)
+            finally:
+                stream.producer_done()
+
+        if not self.streaming:
+            _run()
+            return
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"exchange-{self.name}")
+        t.start()
+        self._threads.append(t)
+
+    def abort(self) -> None:
+        for s in self.streams:
+            s.abort()
+
+    def join(self) -> None:
+        for t in self._threads:
+            t.join()
+
+    # -- overlap evidence (A/B harness + tests) ------------------------
+    def stats(self) -> Dict[str, float]:
+        firsts = [s.first_page_at for s in self.streams
+                  if s.first_page_at is not None]
+        dones = [s.completed_at for s in self.streams
+                 if s.completed_at is not None]
+        return {
+            "streams": float(len(self.streams)),
+            "pages": float(sum(s.pages_in for s in self.streams)),
+            "bytes": float(sum(s.bytes_in for s in self.streams)),
+            "peak_buffered_bytes": float(
+                max((s.peak_bytes for s in self.streams), default=0)),
+            "first_page_at": min(firsts) if firsts else 0.0,
+            "producers_done_at": max(dones) if dones else 0.0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# query-scoped registry (the kill path) + process-wide occupancy gauges
+# ---------------------------------------------------------------------------
+
+_LIVE: "weakref.WeakSet[PageStream]" = weakref.WeakSet()
+_TLS = threading.local()
+_REGISTRY: Dict[str, "weakref.WeakSet[PageStream]"] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _register(stream: PageStream) -> None:
+    qid = getattr(_TLS, "qid", None)
+    if qid:
+        with _REG_LOCK:
+            _REGISTRY.setdefault(qid, weakref.WeakSet()).add(stream)
+
+
+@contextlib.contextmanager
+def query_scope(query_id: Optional[str]):
+    """Tag streams created on this thread with ``query_id`` so
+    ``abort_query`` (pool.kill_query) can reach them."""
+    prev = getattr(_TLS, "qid", None)
+    _TLS.qid = query_id
+    try:
+        yield
+    finally:
+        _TLS.qid = prev
+        if query_id:
+            with _REG_LOCK:
+                _REGISTRY.pop(query_id, None)
+
+
+def abort_query(query_id: str) -> int:
+    """Abort every live stream of a killed query: producers blocked in
+    ``enqueue`` raise BufferAborted and exit instead of leaking."""
+    with _REG_LOCK:
+        streams = list(_REGISTRY.pop(query_id, ()))
+    for s in streams:
+        s.abort()
+    if streams:
+        from presto_tpu.obs import METRICS
+
+        METRICS.counter("exchange.streams_aborted").inc(len(streams))
+    return len(streams)
+
+
+def _wire_gauges() -> None:
+    from presto_tpu.obs import METRICS
+
+    METRICS.gauge("exchange.buffered_bytes").set_fn(
+        lambda: float(sum(s.buffered_bytes for s in list(_LIVE))))
+    METRICS.gauge("exchange.open_streams").set_fn(
+        lambda: float(sum(1 for s in list(_LIVE) if not s.closed)))
+
+
+_wire_gauges()

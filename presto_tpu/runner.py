@@ -933,10 +933,16 @@ class QueryRunner:
     def _run_plan(self, plan, query_id=None):
         """Route through the device-mesh tier when ``SET SESSION
         distributed = true`` and the plan shape distributes; otherwise
-        (or on DistributedUnsupported) the local executor."""
-        if self.session.get("distributed"):
-            return self._distributed().run(plan)
-        return self.executor.run(plan, query_id=query_id)
+        (or on DistributedUnsupported) the local executor.  The query
+        scope tags streaming-exchange buffers with the query id so a
+        deadline/memory kill (pool.kill_query) aborts them and unblocks
+        backpressured producer threads."""
+        from presto_tpu.parallel.streams import query_scope
+
+        with query_scope(query_id):
+            if self.session.get("distributed"):
+                return self._distributed().run(plan)
+            return self.executor.run(plan, query_id=query_id)
 
     def _distributed(self):
         if getattr(self, "_dist", None) is None:

@@ -12,11 +12,20 @@ token protocol and ``OutputBufferMemoryManager``'s bounded footprint:
 * the producer blocks when unacknowledged bytes exceed the buffer's
   cap — pull-side backpressure, the deadlock-free flow control the
   reference gets from bounded OutputBufferMemoryManager.
+
+The payload is opaque: the HTTP tier stores serialized ``bytes`` (size
+= len), the in-process streaming exchange (parallel/streams.py) stores
+live Page objects with an explicit ``nbytes`` — ONE token/ack/
+backpressure protocol for both transports.  ``producers`` > 1 turns
+``set_complete`` into a countdown, so N concurrent producer threads
+(UNION legs, per-worker pullers) can share one buffer and the consumer
+sees completion only when the last one finishes.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Tuple
 
 
@@ -27,31 +36,58 @@ class BufferAborted(Exception):
 class TaskOutputBuffer:
     """One task's serialized-page output buffer."""
 
-    def __init__(self, max_bytes: int = 64 << 20):
+    def __init__(self, max_bytes: int = 64 << 20, producers: int = 1):
         self.max_bytes = max_bytes
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._pages: List[Optional[bytes]] = []  # None = acknowledged/freed
+        self._pages: List[Optional[object]] = []  # None = acknowledged/freed
+        self._sizes: List[int] = []  # parallel byte sizes (payload-agnostic)
         self._acked = 0  # tokens below this are freed
         self._bytes = 0  # unacknowledged payload bytes
+        self._producers = producers  # set_complete calls until complete
         self._complete = False
         self._aborted = False
         self._error: Optional[str] = None
+        # stage-overlap evidence (perf_counter): when the first page
+        # landed vs when production finished — the A/B harness proves a
+        # consumer's first pull preceded producer completion from these
+        self.first_page_at: Optional[float] = None
+        self.completed_at: Optional[float] = None
+        # time producers spent blocked on the byte cap (backpressure)
+        self.stall_seconds = 0.0
 
     # -- producer side ------------------------------------------------------
-    def enqueue(self, page: bytes) -> None:
+    def enqueue(self, page: object, nbytes: Optional[int] = None) -> None:
+        size = len(page) if nbytes is None else int(nbytes)
         with self._cond:
+            stalled = None
             while self._bytes >= self.max_bytes and not self._aborted:
+                if stalled is None:
+                    stalled = time.perf_counter()
                 self._cond.wait(timeout=1.0)
+            if stalled is not None:
+                waited = time.perf_counter() - stalled
+                self.stall_seconds += waited
+                from presto_tpu.obs import METRICS
+
+                METRICS.counter(
+                    "exchange.producer_stall_seconds_total").inc(waited)
             if self._aborted:
                 raise BufferAborted()
+            if self.first_page_at is None:
+                self.first_page_at = time.perf_counter()
             self._pages.append(page)
-            self._bytes += len(page)
+            self._sizes.append(size)
+            self._bytes += size
             self._cond.notify_all()
 
     def set_complete(self) -> None:
         with self._cond:
-            self._complete = True
+            self._producers -= 1
+            if self._producers <= 0:
+                self._complete = True
+                if self.completed_at is None:
+                    self.completed_at = time.perf_counter()
             self._cond.notify_all()
 
     def fail(self, message: str) -> None:
@@ -64,22 +100,27 @@ class TaskOutputBuffer:
         with self._cond:
             self._aborted = True
             self._pages = []
+            self._sizes = []
             self._bytes = 0
             self._cond.notify_all()
 
     # -- consumer side ------------------------------------------------------
     def get(self, token: int, max_bytes: int = 8 << 20,
-            timeout: float = 10.0) -> Tuple[List[bytes], int, bool, Optional[str]]:
+            timeout: float = 10.0) -> Tuple[List[object], int, bool, Optional[str]]:
         """(pages, next_token, buffer_complete, error): long-polls up to
         ``timeout`` for data at ``token``; tokens below the acknowledged
         watermark cannot be replayed (the client already saw them)."""
         deadline = threading.TIMEOUT_MAX if timeout is None else timeout
         with self._cond:
+            if self._aborted:
+                raise BufferAborted()
             if token < self._acked:
                 raise KeyError(f"token {token} already acknowledged")
             if not self._complete and token >= len(self._pages):
                 self._cond.wait(timeout=deadline)
-            out: List[bytes] = []
+            if self._aborted:
+                raise BufferAborted()
+            out: List[object] = []
             t = token
             size = 0
             while t < len(self._pages):
@@ -87,10 +128,10 @@ class TaskOutputBuffer:
                 if p is None:  # freed (should not happen above _acked)
                     t += 1
                     continue
-                if out and size + len(p) > max_bytes:
+                if out and size + self._sizes[t] > max_bytes:
                     break
                 out.append(p)
-                size += len(p)
+                size += self._sizes[t]
                 t += 1
             done = self._complete and t >= len(self._pages)
             return out, t, done, self._error
@@ -98,12 +139,21 @@ class TaskOutputBuffer:
     def acknowledge(self, token: int) -> None:
         with self._cond:
             for i in range(self._acked, min(token, len(self._pages))):
-                p = self._pages[i]
-                if p is not None:
-                    self._bytes -= len(p)
+                if self._pages[i] is not None:
+                    self._bytes -= self._sizes[i]
                     self._pages[i] = None
             self._acked = max(self._acked, token)
             self._cond.notify_all()
+
+    @property
+    def acked_token(self) -> int:
+        with self._lock:
+            return self._acked
+
+    @property
+    def aborted(self) -> bool:
+        with self._lock:
+            return self._aborted
 
     @property
     def unacked_bytes(self) -> int:

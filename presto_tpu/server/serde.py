@@ -236,7 +236,8 @@ def plan_to_json(node: PlanNode) -> dict:
             "asc": list(node.ascending),
             "funcs": [
                 {"kind": f.kind, "arg": expr_to_json(f.arg), "offset": f.offset,
-                 "frame": list(f.frame) if f.frame else None}
+                 "frame": list(f.frame) if f.frame else None,
+                 "ignore_nulls": f.ignore_nulls}
                 for f in node.funcs
             ],
             "names": list(node.func_names),
@@ -334,7 +335,8 @@ def plan_from_json(d: dict, catalog: Catalog) -> PlanNode:
             [expr_from_json(e) for e in d["order"]],
             list(d["asc"]),
             [WindowFunc(kind=f["kind"], arg=expr_from_json(f["arg"]), offset=f["offset"],
-                        frame=tuple(f["frame"]) if f.get("frame") else None)
+                        frame=tuple(f["frame"]) if f.get("frame") else None,
+                        ignore_nulls=f.get("ignore_nulls", False))
              for f in d["funcs"]],
             list(d["names"]),
         )

@@ -224,7 +224,13 @@ class WorkerServer:
                     maxsize = 8 << 20
                     if "maxsize=" in self.path:
                         maxsize = int(self.path.split("maxsize=")[1].split("&")[0])
-                    pages, nxt, done, err = buf.get(token, maxsize)
+                    try:
+                        pages, nxt, done, err = buf.get(token, maxsize)
+                    except BufferAborted:
+                        # aborted concurrently with this GET: same
+                        # answer an expired/deleted task gives
+                        self._send(404, b"{}")
+                        return
                     if err is not None:
                         self._send(500, json.dumps({"error": err}).encode())
                         return

@@ -109,6 +109,26 @@ SYSTEM_PROPERTIES = [
         1 << 13, int,
     ),
     PropertyMetadata(
+        "exchange_streaming",
+        "stream stage-boundary pages through the token-acked exchange "
+        "(parallel/streams.py) so consuming stages overlap producers; "
+        "false = materialize each stage before the next starts (A/B leg)",
+        True, _bool,
+    ),
+    PropertyMetadata(
+        "exchange_buffer_bytes",
+        "unacknowledged-byte cap per exchange stream (producer "
+        "backpressure bound); 0 = process default "
+        "(PRESTO_TPU_EXCHANGE_BUFFER_BYTES)",
+        0, int,
+    ),
+    PropertyMetadata(
+        "exchange_merge_fanin",
+        "pre-sorted runs the distributed-ORDER-BY consumer folds per "
+        "k-way merge batch (bounds merge memory while runs stream in)",
+        8, int,
+    ),
+    PropertyMetadata(
         "task_concurrency",
         "splits in flight per scan pipeline (morsel scheduler, "
         "exec/tasks.py); 1 = serial legacy path, 0 = process default "

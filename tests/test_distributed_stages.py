@@ -1,0 +1,249 @@
+"""Distributed window / sort / union stages on the 8-device mesh and
+the multi-host HTTP tier, plus the EXPLAIN (TYPE DISTRIBUTED) plan
+shapes for their exchanges.
+
+Reference analogs: AddExchanges partitioning WindowNode on its
+PARTITION BY (FIXED_HASH window fragments), MergeOperator.java:45
+(distributed sort = per-stage sort + consumer merge), and concurrent
+UNION source fragments draining one exchange."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.parallel.dist import DistributedRunner, make_mesh
+from presto_tpu.runner import QueryRunner
+
+WINDOW_SQL = ("SELECT o_custkey, o_totalprice, "
+              "sum(o_totalprice) OVER (PARTITION BY o_custkey) "
+              "FROM orders")
+WINDOW_ORDERED_SQL = (
+    "SELECT o_custkey, o_orderkey, "
+    "rank() OVER (PARTITION BY o_custkey ORDER BY o_totalprice DESC), "
+    "sum(o_totalprice) OVER (PARTITION BY o_custkey ORDER BY o_orderkey) "
+    "FROM orders")
+ORDER_BY_SQL = ("SELECT l_orderkey, l_extendedprice, l_shipdate "
+                "FROM lineitem "
+                "ORDER BY l_extendedprice DESC, l_orderkey, l_linenumber")
+UNION_SQL = ("SELECT o_orderkey FROM orders "
+             "UNION ALL SELECT o_orderkey FROM orders "
+             "UNION ALL SELECT l_orderkey FROM lineitem")
+UNION_MIXED_SQL = ("SELECT l_returnflag x FROM lineitem "
+                   "UNION ALL SELECT o_orderstatus FROM orders")
+
+
+@pytest.fixture(scope="module")
+def env():
+    tpch = Tpch(sf=0.01, split_rows=4096)
+    catalog = Catalog()
+    catalog.register("tpch", tpch)
+    local = QueryRunner(catalog)
+    dist = DistributedRunner(catalog, make_mesh(8))
+    # exercise multi-stage streaming on every input size (the CI leg's
+    # distributed_min_stage_rows=0 contract)
+    dist.min_stage_rows = 0
+    return local, dist
+
+
+def _key(row):
+    return tuple(round(v, 6) if isinstance(v, float) else v for v in row)
+
+
+def _check(local, dist, sql, ordered=False, min_stages=1):
+    expected = local.executor.run(local.plan(sql)).rows
+    out = dist.run(local.plan(sql))
+    assert out.dist_fallback is None, out.dist_fallback
+    assert out.dist_stages >= min_stages
+    actual = out.rows
+    assert len(actual) == len(expected)
+    pairs = (zip(actual, expected) if ordered else
+             zip(sorted(actual, key=_key), sorted(expected, key=_key)))
+    for a, e in pairs:
+        for va, ve in zip(a, e):
+            if isinstance(va, float):
+                assert va == pytest.approx(ve, rel=1e-9), f"{a} != {e}"
+            else:
+                assert va == ve, f"{a} != {e}"
+
+
+# ---------------------------------------------------------------------------
+# mesh tier (parallel/dist.py)
+# ---------------------------------------------------------------------------
+
+def test_mesh_window_partition_agg(env):
+    local, dist = env
+    _check(local, dist, WINDOW_SQL, min_stages=1)
+
+
+def test_mesh_window_with_order(env):
+    local, dist = env
+    _check(local, dist, WINDOW_ORDERED_SQL, min_stages=1)
+
+
+def test_mesh_large_order_by_exact_order(env):
+    local, dist = env
+    _check(local, dist, ORDER_BY_SQL, ordered=True, min_stages=1)
+
+
+def test_mesh_union_three_legs(env):
+    local, dist = env
+    _check(local, dist, UNION_SQL, min_stages=3)
+
+
+def test_mesh_union_merged_dictionaries(env):
+    """Legs with different varchar dictionaries ride per-leg code
+    offsets through the exchange."""
+    local, dist = env
+    _check(local, dist, UNION_MIXED_SQL, min_stages=2)
+
+
+def test_mesh_window_then_order_by(env):
+    """A window stage feeding a sort stage: two streamed breaker
+    stages in one plan."""
+    local, dist = env
+    sql = ("SELECT o_custkey, r FROM ("
+           "SELECT o_custkey, sum(o_totalprice) "
+           "OVER (PARTITION BY o_custkey) r FROM orders) "
+           "ORDER BY r DESC, o_custkey")
+    _check(local, dist, sql, ordered=True, min_stages=2)
+
+
+def test_mesh_streaming_toggle_same_result(env):
+    local, dist = env
+    expected = local.executor.run(local.plan(ORDER_BY_SQL)).rows
+    try:
+        dist.exchange_streaming = False
+        out = dist.run(local.plan(ORDER_BY_SQL))
+    finally:
+        dist.exchange_streaming = True
+    assert out.rows == expected
+
+
+def test_sort_stays_glue_over_small_intermediates(env):
+    """ORDER BY over a below-threshold materialized intermediate keeps
+    the coordinator-glue path (min_stage_rows gate)."""
+    local, _ = env
+    from presto_tpu.parallel.fragment import explain_distributed
+
+    sql = ("SELECT l_returnflag, sum(l_quantity) q FROM lineitem "
+           "GROUP BY l_returnflag ORDER BY q")
+    text = explain_distributed(local.plan(sql))  # default min_stage_rows
+    # the aggregation distributes; the tiny sort is a SINGLE coordinator
+    # fragment (glue), not a distributed merge stage
+    assert "root=AggregationNode" in text
+    assert "via merge[" not in text
+    assert "[SINGLE] => output [SINGLE] via gather root=SortNode" in text
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN (TYPE DISTRIBUTED) plan shapes
+# ---------------------------------------------------------------------------
+
+def test_explain_window_shows_hash_exchange(env):
+    local, _ = env
+    from presto_tpu.parallel.fragment import explain_distributed
+
+    text = explain_distributed(local.plan(WINDOW_SQL), min_stage_rows=0)
+    assert text.startswith("FRAGMENTED: yes")
+    assert "root=WindowNode" in text
+    assert "via hash[o_custkey]" in text  # partition keys on the edge
+
+
+def test_explain_order_by_shows_merge_exchange(env):
+    local, _ = env
+    from presto_tpu.parallel.fragment import explain_distributed
+
+    text = explain_distributed(local.plan(ORDER_BY_SQL), min_stage_rows=0)
+    assert text.startswith("FRAGMENTED: yes")
+    assert "root=SortNode" in text
+    assert "via merge[" in text  # sorted-run merge edge
+
+
+def test_explain_union_shows_concurrent_legs(env):
+    local, _ = env
+    from presto_tpu.parallel.fragment import explain_distributed
+
+    text = explain_distributed(local.plan(UNION_SQL), min_stage_rows=0)
+    assert text.startswith("FRAGMENTED: yes (3 mesh stages)")
+    assert "via union" in text
+    assert text.count("via gather root=ProjectNode") == 3  # one per leg
+
+
+def test_explain_agrees_with_execution(env):
+    """The simulated decomposition and the executed one count the same
+    stages for every breaker shape."""
+    local, dist = env
+    from presto_tpu.parallel.fragment import fragment_plan
+
+    for sql in (WINDOW_SQL, ORDER_BY_SQL, UNION_SQL):
+        frags = fragment_plan(local.plan(sql), min_stage_rows=0)
+        out = dist.run(local.plan(sql))
+        assert frags.mesh_stages == out.dist_stages, sql
+
+
+# ---------------------------------------------------------------------------
+# multi-host tier (parallel/multihost.py over HTTP workers)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dqr():
+    from presto_tpu.testing import DistributedQueryRunner
+
+    rig = DistributedQueryRunner(n_workers=2, sf=0.01, split_rows=4096)
+    rig.multihost.min_stage_rows = 0
+    yield rig
+    rig.close()
+
+
+def _check_mh(dqr, sql, ordered=False, min_stages=1):
+    local = dqr.runner
+    expected = local.executor.run(local.plan(sql)).rows
+    out = dqr.multihost.run(local.plan(sql))
+    assert out.dist_fallback is None, out.dist_fallback
+    assert out.dist_stages >= min_stages
+    actual = out.rows
+    assert len(actual) == len(expected)
+    pairs = (zip(actual, expected) if ordered else
+             zip(sorted(actual, key=_key), sorted(expected, key=_key)))
+    for a, e in pairs:
+        for va, ve in zip(a, e):
+            if isinstance(va, float):
+                assert va == pytest.approx(ve, rel=1e-9), f"{a} != {e}"
+            else:
+                assert va == ve, f"{a} != {e}"
+
+
+def test_multihost_window_two_stage_shuffle(dqr):
+    _check_mh(dqr, WINDOW_SQL)
+
+
+def test_multihost_window_with_order(dqr):
+    _check_mh(dqr, WINDOW_ORDERED_SQL)
+
+
+def test_multihost_order_by_merge(dqr):
+    _check_mh(dqr, ORDER_BY_SQL, ordered=True)
+
+
+def test_multihost_union_concurrent_legs(dqr):
+    _check_mh(dqr, UNION_SQL, min_stages=3)
+
+
+def test_multihost_union_merged_dictionaries(dqr):
+    _check_mh(dqr, UNION_MIXED_SQL, min_stages=2)
+
+
+def test_multihost_window_degrades_with_one_worker(dqr):
+    """With a single live worker the two-stage shuffle is pointless:
+    the stage degrades to a distributed source gather + coordinator
+    window, still oracle-correct."""
+    from presto_tpu.parallel.multihost import MultiHostRunner
+
+    local = dqr.runner
+    mh1 = MultiHostRunner(dqr.catalog, [dqr.workers[0].uri])
+    mh1.min_stage_rows = 0
+    expected = local.executor.run(local.plan(WINDOW_SQL)).rows
+    out = mh1.run(local.plan(WINDOW_SQL))
+    assert out.dist_fallback is None
+    assert sorted(out.rows, key=_key) == sorted(expected, key=_key)

@@ -1,0 +1,285 @@
+"""Streaming page exchange: token/ack protocol, backpressure, abort,
+kill-path cleanup, and mid-stream producer-death replay.
+
+Reference analogs: ``TestArbitraryOutputBuffer``/``TestClientBuffer``
+(token re-GET + ack semantics), OutputBufferMemoryManager blocking
+(backpressure), and the RFC-era fault-tolerance property that a retried
+fragment must not duplicate rows the consumer already took."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.obs import METRICS
+from presto_tpu.parallel import streams
+from presto_tpu.server.buffers import BufferAborted, TaskOutputBuffer
+
+
+# ---------------------------------------------------------------------------
+# token/ack protocol units
+# ---------------------------------------------------------------------------
+
+def test_token_reget_is_idempotent():
+    buf = TaskOutputBuffer()
+    buf.enqueue(b"page0")
+    buf.enqueue(b"page1")
+    pages1, nxt1, done1, _ = buf.get(0, timeout=0.1)
+    pages2, nxt2, done2, _ = buf.get(0, timeout=0.1)
+    assert pages1 == pages2 == [b"page0", b"page1"]
+    assert nxt1 == nxt2 == 2
+    assert not done1 and not done2  # producer not complete yet
+
+
+def test_acknowledge_frees_bytes_and_forbids_replay():
+    buf = TaskOutputBuffer()
+    buf.enqueue(b"x" * 100)
+    buf.enqueue(b"y" * 50)
+    assert buf.unacked_bytes == 150
+    buf.acknowledge(1)
+    assert buf.unacked_bytes == 50
+    assert buf.acked_token == 1
+    with pytest.raises(KeyError):
+        buf.get(0, timeout=0.1)  # below the acked watermark
+    pages, nxt, _, _ = buf.get(1, timeout=0.1)
+    assert pages == [b"y" * 50] and nxt == 2
+
+
+def test_payload_agnostic_sizes():
+    """In-process streams store live objects with explicit nbytes; the
+    byte accounting must follow the declared size, not len()."""
+    buf = TaskOutputBuffer(max_bytes=1 << 20)
+    buf.enqueue(("not", "bytes"), nbytes=4096)
+    assert buf.unacked_bytes == 4096
+    pages, nxt, _, _ = buf.get(0, timeout=0.1)
+    assert pages == [("not", "bytes")]
+    buf.acknowledge(nxt)
+    assert buf.unacked_bytes == 0
+
+
+def test_backpressure_blocks_then_unblocks():
+    buf = TaskOutputBuffer(max_bytes=10)
+    buf.enqueue(b"0123456789")  # cap reached
+    state = {"entered": False, "done": False}
+
+    def producer():
+        state["entered"] = True
+        buf.enqueue(b"next")  # must block until the consumer acks
+        state["done"] = True
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while not state["entered"] and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.15)
+    assert state["entered"] and not state["done"]  # blocked on the cap
+    _, nxt, _, _ = buf.get(0, timeout=0.1)
+    buf.acknowledge(nxt)  # frees bytes -> producer proceeds
+    t.join(2.0)
+    assert state["done"]
+    assert buf.stall_seconds > 0  # backpressure time accounted
+
+
+def test_abort_unblocks_producer_and_consumer():
+    buf = TaskOutputBuffer(max_bytes=5)
+    buf.enqueue(b"12345")
+    raised = []
+
+    def producer():
+        try:
+            buf.enqueue(b"67890")
+        except BufferAborted:
+            raised.append("producer")
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    buf.abort()
+    t.join(2.0)
+    assert raised == ["producer"]
+    with pytest.raises(BufferAborted):
+        buf.get(1, timeout=0.1)
+
+
+def test_multi_producer_completion_countdown():
+    buf = TaskOutputBuffer(producers=2)
+    buf.enqueue(b"a")
+    buf.set_complete()  # first producer done; stream still open
+    _, _, done, _ = buf.get(0, timeout=0.1)
+    assert not done
+    buf.enqueue(b"b")
+    buf.set_complete()  # second producer done -> complete
+    pages, nxt, done, _ = buf.get(0, timeout=0.1)
+    assert pages == [b"a", b"b"] and done
+
+
+# ---------------------------------------------------------------------------
+# PageStream / StreamingExchange
+# ---------------------------------------------------------------------------
+
+def test_pagestream_drain_counts_metrics():
+    p0 = METRICS.counter("exchange.stream_pages_total").value
+    b0 = METRICS.counter("exchange.stream_bytes_total").value
+    ex = streams.StreamingExchange("gather", "t")
+    s = ex.stream(producers=2)
+
+    def produce(st):
+        for i in range(4):
+            st.put(("page", i), nbytes=100)
+
+    ex.run(s, produce)
+    ex.run(s, produce)
+    got = list(s.drain())
+    ex.join()
+    assert len(got) == 8
+    assert METRICS.counter("exchange.stream_pages_total").value - p0 == 8
+    assert METRICS.counter("exchange.stream_bytes_total").value - b0 == 800
+    assert s.peak_bytes > 0
+    assert s.first_page_at is not None
+    assert s.completed_at is not None
+
+
+def test_producer_error_reaches_consumer_with_original_type():
+    class Boom(RuntimeError):
+        pass
+
+    ex = streams.StreamingExchange("gather", "t")
+    s = ex.stream()
+
+    def produce(st):
+        st.put(("ok",), nbytes=1)
+        raise Boom("producer died")
+
+    ex.run(s, produce)
+    with pytest.raises(Boom):
+        list(s.drain())
+    ex.join()
+
+
+def test_materialized_mode_runs_inline():
+    """streaming=False is the A/B leg: the producer completes before
+    the consumer sees anything (no thread)."""
+    ex = streams.StreamingExchange("gather", "t", streaming=False)
+    s = ex.stream()
+    order = []
+    ex.run(s, lambda st: (order.append("produced"), st.put((1,), nbytes=1))[0])
+    order.append("consumed")
+    assert list(s.drain()) == [(1,)]
+    assert order == ["produced", "consumed"]
+
+
+def test_kill_query_aborts_registered_streams():
+    """pool.kill_query must abort the query's exchange buffers so a
+    producer blocked in enqueue exits instead of leaking (deadline and
+    low-memory kills)."""
+    from presto_tpu.memory import MemoryPool
+
+    a0 = METRICS.counter("exchange.streams_aborted").value
+    pool = MemoryPool(limit_bytes=1 << 30)
+    outcome = []
+    with streams.query_scope("q-killed"):
+        s = streams.PageStream(max_bytes=8)
+
+        def producer():
+            try:
+                s.put(b"12345678")
+                s.put(b"12345678")  # blocks on the cap
+                outcome.append("no-block?")
+            except BufferAborted:
+                outcome.append("aborted")
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        pool.kill_query("q-killed")
+        t.join(2.0)
+    assert outcome == ["aborted"]
+    assert METRICS.counter("exchange.streams_aborted").value - a0 >= 1
+    assert METRICS.counter(
+        "exchange.producer_stall_seconds_total").value > 0
+
+
+# ---------------------------------------------------------------------------
+# mid-stream producer death: replay from the last acked token
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def dqr3():
+    from presto_tpu.testing import DistributedQueryRunner
+    from presto_tpu.testing_faults import FAULTS
+
+    FAULTS.disarm_all()
+    rig = DistributedQueryRunner(n_workers=3, sf=0.01, split_rows=2048)
+    rig.multihost.min_stage_rows = 0
+    try:
+        yield rig
+    finally:
+        FAULTS.disarm_all()
+        rig.close()
+
+
+def test_die_after_n_pages_replays_from_acked_token(dqr3):
+    """A producer killed mid-stream after the consumer took k pages:
+    the fragment re-runs on a survivor and the consumer's stream
+    resumes at its delivered watermark — oracle-correct, no duplicate
+    and no missing rows, with the replay counted."""
+    import collections
+
+    mh = dqr3.multihost
+    local = dqr3.runner
+    sql = "SELECT l_orderkey, l_extendedprice FROM lineitem"
+    expected = local.executor.run(local.plan(sql)).rows
+
+    dqr3.arm_fault("worker.die_after_n_pages", worker=0, pages=3)
+    r0 = METRICS.counter("exchange.stream_replays_total").value
+    leg = local.plan(sql).source
+    page = mh._stage_chain(leg)
+    got = page.compact_host().to_pylist()
+    assert collections.Counter(map(tuple, got)) == collections.Counter(
+        map(tuple, expected))
+    assert METRICS.counter("exchange.stream_replays_total").value > r0
+
+
+def test_die_mid_stream_distributed_sort_oracle(dqr3):
+    """End-to-end: mid-stream worker death under a distributed ORDER BY
+    still returns the exact ordered oracle result."""
+    mh = dqr3.multihost
+    local = dqr3.runner
+    sql = ("SELECT l_orderkey, l_extendedprice FROM lineitem "
+           "ORDER BY l_extendedprice, l_orderkey")
+    expected = local.executor.run(local.plan(sql)).rows
+    dqr3.arm_fault("worker.die_after_n_pages", worker=1, pages=2)
+    out = mh.run(local.plan(sql))
+    assert out.rows == expected
+
+
+def test_streamed_vs_materialized_same_rows(dqr3):
+    """The A/B toggle changes timing, never results."""
+    mh = dqr3.multihost
+    local = dqr3.runner
+    sql = ("SELECT o_orderkey FROM orders UNION ALL "
+           "SELECT l_orderkey FROM lineitem")
+    plan = local.plan(sql)
+    mh.exchange_streaming = True
+    a = sorted(mh.run(local.plan(sql)).rows)
+    mh.exchange_streaming = False
+    b = sorted(mh.run(local.plan(sql)).rows)
+    mh.exchange_streaming = True
+    assert a == b
+    assert len(a) == len(local.executor.run(plan).rows)
+
+
+def test_streaming_gather_overlap_evidence(dqr3):
+    """With in-process HTTP workers the consumer's first page must land
+    before the last producer completes (stage overlap), and the
+    exchange's in-flight memory stays bounded by the byte cap."""
+    mh = dqr3.multihost
+    local = dqr3.runner
+    leg = local.plan("SELECT l_orderkey, l_extendedprice FROM lineitem").source
+    mh._stage_chain(leg)
+    st = mh.last_exchange_stats
+    assert st["pages"] >= 2
+    assert 0 < st["first_page_at"] <= st["producers_done_at"]
+    assert st["peak_buffered_bytes"] <= mh.exchange_buffer_bytes

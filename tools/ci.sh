@@ -40,6 +40,17 @@ env JAX_PLATFORMS=cpu PRESTO_TPU_TASK_CONCURRENCY=4 python -m pytest \
     tests/test_always_on_memory.py tests/test_executor.py -q \
     -p no:cacheprovider
 
+echo "== distributed window/sort/union streaming leg =============="
+# the streaming-exchange stage tier on the 8-device CPU mesh: the
+# tests force distributed_min_stage_rows=0 so every breaker stage
+# (window hash-exchange, per-shard sort + merge, concurrent union
+# legs) and the exchange protocol (token/ack, backpressure, replay)
+# are exercised on EVERY gate
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_distributed_stages.py \
+    tests/test_streaming_exchange.py -q -p no:cacheprovider
+
 echo "== fault-injection (chaos) leg =============================="
 # fixed seed: the fault schedules (and their jittered backoffs) are
 # deterministic, so a chaos failure here reproduces byte-for-byte

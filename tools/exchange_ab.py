@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Streamed-vs-materialized exchange A/B (PERF.md protocol).
+
+Boots an in-process multihost rig (coordinator + N HTTP workers, the
+DistributedQueryRunner shape), runs the three distributed breaker
+shapes — windowed query, large ORDER BY, 3-leg UNION — with the
+streaming exchange ON and OFF, ``--repeat`` times each, and reports:
+
+* wall medians +- spread per leg (the --repeat variance protocol);
+* stage overlap: the consumer's first-page time vs the last producer's
+  completion on the streamed gather (first_page < producers_done means
+  stage k+1 consumed while stage k still produced);
+* peak exchange memory (unacked bytes high-water vs the buffer cap).
+
+Usage: python tools/exchange_ab.py [--sf 0.05] [--workers 2]
+           [--repeat 5] [--split-rows 4096] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+
+QUERIES = {
+    "window": ("SELECT o_custkey, o_totalprice, "
+               "sum(o_totalprice) OVER (PARTITION BY o_custkey) "
+               "FROM orders"),
+    "orderby": ("SELECT l_orderkey, l_extendedprice FROM lineitem "
+                "ORDER BY l_extendedprice, l_orderkey"),
+    "union3": ("SELECT o_orderkey FROM orders "
+               "UNION ALL SELECT o_orderkey FROM orders "
+               "UNION ALL SELECT l_orderkey FROM lineitem"),
+}
+
+
+def run_leg(mh, local, sql, repeat, streaming):
+    mh.exchange_streaming = streaming
+    times = []
+    overlap = None
+    rows = 0
+    for _ in range(repeat):
+        plan = local.plan(sql)
+        t0 = time.perf_counter()
+        out = mh.run(plan)
+        times.append(time.perf_counter() - t0)
+        assert out.dist_fallback is None, out.dist_fallback
+        rows = len(out.rows)
+        st = dict(mh.last_exchange_stats)
+        if streaming and st.get("pages"):
+            overlap = {
+                "first_page_lead_s": round(
+                    st["producers_done_at"] - st["first_page_at"], 4),
+                "peak_buffered_bytes": st["peak_buffered_bytes"],
+                "pages": st["pages"],
+            }
+    med = statistics.median(times)
+    spread = (max(times) - min(times)) / 2
+    return {"median_s": round(med, 4), "spread_s": round(spread, 4),
+            "raw_times_s": [round(t, 4) for t in times], "rows": rows,
+            "overlap": overlap}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.05)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--split-rows", type=int, default=4096)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    from presto_tpu.testing import DistributedQueryRunner
+
+    rig = DistributedQueryRunner(n_workers=args.workers, sf=args.sf,
+                                 split_rows=args.split_rows)
+    rig.multihost.min_stage_rows = 0
+    report = {"sf": args.sf, "workers": args.workers,
+              "repeat": args.repeat, "split_rows": args.split_rows,
+              "buffer_bytes": rig.multihost.exchange_buffer_bytes,
+              "queries": {}}
+    try:
+        for name, sql in QUERIES.items():
+            # warm both legs once (compile + dictionaries)
+            run_leg(rig.multihost, rig.runner, sql, 1, True)
+            run_leg(rig.multihost, rig.runner, sql, 1, False)
+            streamed = run_leg(rig.multihost, rig.runner, sql,
+                               args.repeat, True)
+            materialized = run_leg(rig.multihost, rig.runner, sql,
+                                   args.repeat, False)
+            ratio = (materialized["median_s"] / streamed["median_s"]
+                     if streamed["median_s"] else float("nan"))
+            report["queries"][name] = {
+                "streamed": streamed, "materialized": materialized,
+                "speedup_streamed": round(ratio, 3),
+            }
+            ov = streamed["overlap"] or {}
+            print(f"{name:8s} streamed {streamed['median_s']:.3f}s "
+                  f"+-{streamed['spread_s']:.3f} | materialized "
+                  f"{materialized['median_s']:.3f}s "
+                  f"+-{materialized['spread_s']:.3f} | x{ratio:.2f} | "
+                  f"first-page lead {ov.get('first_page_lead_s', 0)}s, "
+                  f"peak buffered {int(ov.get('peak_buffered_bytes', 0))}B",
+                  flush=True)
+    finally:
+        rig.close()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
