@@ -1,0 +1,997 @@
+"""Static concurrency sanitizer for the distributed tier.
+
+The source-level companion of the plan validator: a whole-repo AST
+pass over every module that touches ``threading`` — scheduler pools,
+heartbeat threads, token-acked exchange buffers, memory-pool gauges —
+checking the invariants Python's memory model does NOT give us for
+free the way the reference engine's Java tier gets them (``
+OutputBuffer`` long-poll, task executors: happens-before by
+``synchronized``/volatile construction).
+
+Detectors (rule names are what ``tools/engine_lint.py --check`` and
+the suppression file use):
+
+lock-order          A cycle in the whole-repo lock-acquisition graph.
+                    Nodes are lock NAMES (``module.Class.attr`` — the
+                    same scheme presto_tpu/sync.py names instrumented
+                    locks, so the runtime cross-check lines up);
+                    an edge A->B means some code path acquires B while
+                    holding A, including interprocedurally through
+                    direct method/function calls.  A cycle is a
+                    potential deadlock: two threads entering it from
+                    different arcs can block forever.
+blocking-in-lock    A blocking call while holding a lock: network I/O
+                    (net.py helpers, urlopen), ``time.sleep``,
+                    ``Future.result``, untimed ``queue.get``/
+                    ``Condition.wait`` on a DIFFERENT condition,
+                    ``Thread.join``, device syncs (``device_get``,
+                    ``block_until_ready``).  Every waiter on that lock
+                    stalls for the full I/O latency — the classic
+                    serving-tier lockup.
+untimed-wait        ``Condition.wait()`` / ``Event.wait()`` with no
+                    timeout.  A missed notify (or a peer that died
+                    before notifying) parks the thread forever, and
+                    shutdown paths cannot reap it.  Notify-driven
+                    waits whose every producer notifies under the same
+                    lock are legitimate — suppress with a justification.
+shared-state-race   An attribute written both from thread-target /
+                    executor-submitted code and from coordinator paths,
+                    with at least one write outside any lock.  Plain
+                    constant stores (``self.done = True``) are exempt —
+                    GIL-atomic flag handoffs are idiomatic; read-modify-
+                    write (``+=``) and computed stores are not.
+thread-leak         A non-daemon ``threading.Thread`` with no
+                    ``join()`` reachable in its module.  Leaked
+                    non-daemon threads block interpreter exit and pile
+                    up under concurrent queries.
+executor-leak       A ``ThreadPoolExecutor`` neither used as a context
+                    manager nor ``shutdown()`` anywhere in its module.
+unbounded-queue     ``queue.Queue()`` (or LifoQueue) without a
+                    ``maxsize`` — producers outrunning a consumer grow
+                    it without bound; the memory plane cannot see it.
+unnamed-thread      ``threading.Thread(...)`` without ``name=``.
+                    Sanitizer reports, trace exports, and py-spy dumps
+                    identify threads by name; anonymous ``Thread-12``
+                    is unattributable in a 40-thread coordinator.
+server-leak         A ``ThreadingHTTPServer`` whose module never calls
+                    ``server_close()`` — leaks the listening socket.
+
+Everything is a heuristic over the AST — no imports are executed.  The
+analyzer is deliberately dependency-free (stdlib ``ast`` only) so
+``tools/engine_lint.py`` can load it without pulling in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, NamedTuple, Optional, Set, Tuple
+
+#: constructors that create a mutex-like object
+_LOCK_CTORS = {"Lock", "RLock", "named_lock"}
+_COND_CTORS = {"Condition", "named_condition"}
+#: blocking call names (resolved by bare/attr name)
+_BLOCKING_NET = {"urlopen", "request_json", "request_bytes", "http_retry",
+                 "getaddrinfo", "create_connection"}
+_BLOCKING_SYNC = {"sleep", "device_get", "block_until_ready"}
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+CONCURRENCY_RULES = {
+    "lock-order", "blocking-in-lock", "untimed-wait", "shared-state-race",
+    "thread-leak", "executor-leak", "unbounded-queue", "unnamed-thread",
+    "server-leak",
+}
+
+
+def _mod_name(path: str) -> str:
+    return os.path.splitext(os.path.basename(path))[0]
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _target_names(node: ast.AST) -> List[str]:
+    """'x' for ``x = ...``, 'self.x' for ``self.x = ...``."""
+    out = []
+    if isinstance(node, ast.Name):
+        out.append(node.id)
+    elif isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        out.append(f"self.{node.attr}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# model extraction
+# ---------------------------------------------------------------------------
+
+
+class _FuncInfo:
+    """One function/method's concurrency-relevant summary."""
+
+    __slots__ = ("key", "node", "cls", "module", "acquires", "calls",
+                 "is_thread_entry")
+
+    def __init__(self, key: Tuple[str, Optional[str], str],
+                 node: ast.AST, cls: Optional[str], module: "_ModuleInfo"):
+        self.key = key
+        self.node = node
+        self.cls = cls
+        self.module = module
+        #: lock names directly acquired anywhere in the body
+        self.acquires: Set[str] = set()
+        #: callee keys of direct calls (resolved later)
+        self.calls: Set[Tuple[str, Optional[str], str]] = set()
+        self.is_thread_entry = False
+
+
+class _ModuleInfo:
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        #: lock-NAMING module name: the basename, because that is the
+        #: scheme presto_tpu/sync.py names runtime locks with
+        #: (``module.Class.attr``) and the cross-check must line up
+        self.name = _mod_name(path)
+        #: repo-model KEY: the normalized path, because basenames
+        #: collide (memory.py, metrics.py exist twice) and a dict
+        #: keyed on them silently drops whole files from analysis
+        self.key = os.path.normpath(os.path.abspath(path))
+        self.tree = tree
+        self.lines = source.splitlines()
+        #: lock-ish value names in scope -> canonical lock name
+        #: keys: "self.attr" (per class: ("Cls", "self.attr")), module
+        #: globals, and function-local vars (("fn", "var"))
+        self.locks: Dict[Tuple[Optional[str], str], str] = {}
+        #: conditions share their lock's canonical name when built on one
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.functions: Dict[Tuple[Optional[str], str], _FuncInfo] = {}
+        #: self.attr -> class name (for self.buffer.enqueue resolution)
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        #: queue-typed names (for queue.get blocking checks)
+        self.queue_names: Set[str] = set()
+        #: thread-typed names (for .join classification)
+        self.thread_names: Set[str] = set()
+        #: names holding a list of Threads (list/listcomp of Thread
+        #: calls, or an annotation mentioning Thread) — for-loop
+        #: targets over them are thread-typed too
+        self.thread_collections: Set[str] = set()
+        #: ThreadPoolExecutor-typed names
+        self.executor_names: Set[str] = set()
+        #: typed lifecycle evidence: a join/shutdown call on a
+        #: THREAD/EXECUTOR-typed receiver somewhere in the module.  A
+        #: raw substring scan is blind-spot bait: ``", ".join(cols)``
+        #: and ``httpd.shutdown()`` must not satisfy the leak checks.
+        self.has_thread_join = False
+        self.has_executor_shutdown = False
+
+
+class _Repo:
+    """The whole-repo model: modules, a class index, the lock graph."""
+
+    def __init__(self):
+        self.modules: Dict[str, _ModuleInfo] = {}
+        #: class name -> module KEY (repo-wide; first definition wins)
+        self.class_index: Dict[str, str] = {}
+        self.findings: List[Finding] = []
+        #: (holder, acquired) -> witness (path, line)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+
+def _is_lock_ctor(call: ast.Call) -> Optional[str]:
+    """'lock'/'cond' when the call constructs a mutex/condition."""
+    name = _call_name(call)
+    if name in _LOCK_CTORS:
+        return "lock"
+    if name in _COND_CTORS:
+        return "cond"
+    return None
+
+
+def _scan_module(path: str, tree: ast.Module, source: str) -> _ModuleInfo:
+    """Pass 1: classes, functions, lock declarations, attr types."""
+    mi = _ModuleInfo(path, tree, source)
+
+    def record_lock(scope: Optional[str], target: str, canonical: str):
+        mi.locks[(scope, target)] = canonical
+
+    def scan_assign(node, scope: Optional[str], cls: Optional[str]):
+        if isinstance(node, ast.AnnAssign):
+            # `self._threads: List[threading.Thread] = []` — the
+            # annotation types the collection
+            names = _target_names(node.target)
+            try:
+                ann = ast.unparse(node.annotation)
+            except Exception:
+                ann = ""
+            if "Thread" in ann and "Executor" not in ann:
+                mi.thread_collections.update(names)
+            elif "Executor" in ann:
+                mi.executor_names.update(names)
+            return
+        call = node.value
+        coll_elt = None
+        if isinstance(call, ast.ListComp):
+            coll_elt = call.elt
+        elif isinstance(call, ast.List) and call.elts:
+            coll_elt = call.elts[0]
+        if isinstance(coll_elt, ast.Call) \
+                and _call_name(coll_elt) == "Thread":
+            for t in node.targets:
+                mi.thread_collections.update(_target_names(t))
+            return
+        if isinstance(call, ast.IfExp):
+            # `self._lock = parent._lock if parent else Condition()`
+            # (resource_groups): the ctor lives in a ternary branch —
+            # whichever branch constructs a primitive names the lock
+            for branch in (call.body, call.orelse):
+                if isinstance(branch, ast.Call) \
+                        and _is_lock_ctor(branch) is not None:
+                    call = branch
+                    break
+        if not isinstance(call, ast.Call):
+            return
+        kind = _is_lock_ctor(call)
+        names = [n for t in node.targets for n in _target_names(t)]
+        if kind is not None:
+            for n in names:
+                if n.startswith("self.") and cls:
+                    canonical = f"{mi.name}.{cls}.{n[5:]}"
+                elif scope is None:
+                    canonical = f"{mi.name}.{n}"
+                else:
+                    canonical = f"{mi.name}.{scope}.{n}"
+                # Condition(existing_lock) aliases that lock's name —
+                # acquiring the condition IS acquiring the lock.  The
+                # lock may sit in ANY positional slot or the lock=
+                # kwarg (named_condition(name, lock) puts it second)
+                if kind == "cond":
+                    lock_args = list(call.args)
+                    lk = _kwarg(call, "lock")
+                    if lk is not None:
+                        lock_args.append(lk)
+                    for arg in lock_args:
+                        for a in _target_names(arg):
+                            key = ((cls, a) if a.startswith("self.")
+                                   else (scope, a))
+                            alias = (mi.locks.get(key)
+                                     or mi.locks.get((None, a)))
+                            if alias:
+                                canonical = alias
+                record_lock(cls if names and names[0].startswith("self.")
+                            else scope, names[0], canonical)
+                for n2 in names[1:]:
+                    record_lock(cls if n2.startswith("self.") else scope,
+                                n2, canonical)
+            return
+        ctor = _call_name(call)
+        if ctor in ("Queue", "LifoQueue", "SimpleQueue", "PriorityQueue"):
+            mi.queue_names.update(names)
+        if ctor == "Thread":
+            mi.thread_names.update(names)
+        if ctor == "ThreadPoolExecutor":
+            mi.executor_names.update(names)
+        if ctor and cls:
+            for n in names:
+                if n.startswith("self."):
+                    mi.attr_types[(cls, n[5:])] = ctor
+
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            scan_assign(node, None, None)
+        elif isinstance(node, ast.ClassDef):
+            mi.classes[node.name] = node
+            for sub in node.body:
+                if isinstance(sub, ast.Assign):
+                    # class-level lock attrs (TaskHandle._seq_lock)
+                    if isinstance(sub.value, ast.Call) \
+                            and _is_lock_ctor(sub.value):
+                        for t in sub.targets:
+                            for n in _target_names(t):
+                                mi.locks[(node.name, f"self.{n}")] = \
+                                    f"{mi.name}.{node.name}.{n}"
+                elif isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    key = (mi.key, node.name, sub.name)
+                    mi.functions[(node.name, sub.name)] = _FuncInfo(
+                        key, sub, node.name, mi)
+                    for stmt in ast.walk(sub):
+                        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                            scan_assign(stmt, sub.name, node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = (mi.key, None, node.name)
+            mi.functions[(None, node.name)] = _FuncInfo(key, node, None, mi)
+            for stmt in ast.walk(node):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    scan_assign(stmt, node.name, None)
+    _collect_lifecycle_evidence(mi)
+    return mi
+
+
+def _collect_lifecycle_evidence(mi: _ModuleInfo) -> None:
+    """Typed join/shutdown evidence for the leak detectors: only a
+    call on a thread/executor-typed receiver counts (a for-loop target
+    iterating a thread collection is thread-typed too)."""
+    threadish = set(mi.thread_names) | set(mi.thread_collections)
+    execish = set(mi.executor_names)
+    for (cls, attr), ctor in mi.attr_types.items():
+        if ctor == "Thread":
+            threadish.add(f"self.{attr}")
+        elif ctor == "ThreadPoolExecutor":
+            execish.add(f"self.{attr}")
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.For) \
+                and any(n in threadish
+                        for n in _target_names(node.iter)):
+            threadish.update(_target_names(node.target))
+    for node in ast.walk(mi.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        recv = _target_names(node.func.value)
+        if node.func.attr == "join" \
+                and any(r in threadish for r in recv):
+            mi.has_thread_join = True
+        elif node.func.attr == "shutdown" \
+                and any(r in execish for r in recv):
+            mi.has_executor_shutdown = True
+
+
+# ---------------------------------------------------------------------------
+# per-function walk: acquisitions, edges, blocking calls
+# ---------------------------------------------------------------------------
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Walk one function with a running held-lock stack.  Nested
+    function definitions are walked in the SAME instance (they close
+    over the same self and usually run on a different thread — their
+    bodies still belong to this lexical scope for lock naming)."""
+
+    def __init__(self, repo: _Repo, fi: _FuncInfo):
+        self.repo = repo
+        self.fi = fi
+        self.mi = fi.module
+        self.held: List[str] = []
+        #: (held_tuple, callee_key) — interprocedural edges resolved
+        #: in the propagation pass
+        self.calls_under: List[Tuple[Tuple[str, ...],
+                                     Tuple[str, Optional[str], str],
+                                     int]] = []
+        self.scope_names: List[str] = [getattr(fi.node, "name",
+                                               "<module>")]
+
+    # -- lock name resolution -------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        for name in _target_names(expr):
+            if name.startswith("self.") and self.fi.cls:
+                hit = self.mi.locks.get((self.fi.cls, name))
+                if hit:
+                    return hit
+            for scope in (*reversed(self.scope_names), None):
+                hit = self.mi.locks.get((scope, name))
+                if hit:
+                    return hit
+        return None
+
+    # -- emission --------------------------------------------------------
+    def _acquire(self, lock: str, node: ast.AST):
+        self.fi.acquires.add(lock)
+        for h in self.held:
+            if h != lock:
+                self.repo.edges.setdefault(
+                    (h, lock), (self.mi.path, node.lineno))
+
+    def _finding(self, node: ast.AST, rule: str, msg: str):
+        self.repo.findings.append(
+            Finding(self.mi.path, node.lineno, rule, msg))
+
+    # -- visitors --------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            # `with ThreadPoolExecutor(...) as ex:` IS the bounded
+            # lifecycle — mark before the context expr is visited
+            if isinstance(item.context_expr, ast.Call):
+                item.context_expr._in_with = True
+            lock = self._lock_of(item.context_expr)
+            if lock is not None:
+                self._acquire(lock, node)
+                self.held.append(lock)
+                acquired.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in acquired:
+            self.held.remove(lock)
+        # context expressions may contain calls too
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested def: walked here with an EMPTY held stack of its own —
+        # it executes later (usually on another thread), not at the
+        # definition point where outer locks may be held
+        outer_held, self.held = self.held, []
+        self.scope_names.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope_names.pop()
+        self.held = outer_held
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+        held = tuple(self.held)
+
+        # direct .acquire() on a known lock
+        if name == "acquire" and isinstance(node.func, ast.Attribute):
+            lock = self._lock_of(node.func.value)
+            if lock is not None:
+                self._acquire(lock, node)
+
+        # Condition/Event .wait()
+        if name == "wait" and isinstance(node.func, ast.Attribute):
+            has_timeout = bool(node.args) or _has_kwarg(node, "timeout")
+            waited_on = self._lock_of(node.func.value)
+            if not has_timeout:
+                self._finding(
+                    node, "untimed-wait",
+                    f"{ast.unparse(node.func)}() without a timeout — a "
+                    "missed/raced notify parks this thread forever and "
+                    "shutdown cannot reap it; pass a timeout and re-check "
+                    "the predicate (suppress with a justification when "
+                    "every producer provably notifies under this lock)")
+            other_held = [h for h in held if h != waited_on]
+            if other_held and waited_on is not None:
+                self._finding(
+                    node, "blocking-in-lock",
+                    f"waiting on {waited_on} while still holding "
+                    f"{other_held[-1]} — wait() only releases its own "
+                    "lock; every waiter on the held lock stalls until "
+                    "this thread is notified")
+
+        # blocking calls while holding a lock
+        if held:
+            blocking = None
+            if name in _BLOCKING_NET:
+                blocking = f"network I/O ({name})"
+            elif name == "sleep":
+                blocking = "time.sleep"
+            elif name in ("device_get", "block_until_ready"):
+                blocking = f"device sync ({name})"
+            elif name == "result" and not node.args \
+                    and not node.keywords:
+                blocking = "Future.result() (unbounded)"
+            elif name == "join" and isinstance(node.func, ast.Attribute):
+                has_timeout = bool(node.args) or _has_kwarg(node, "timeout")
+                base_names = _target_names(node.func.value)
+                threadish = any(
+                    b in self.mi.thread_names
+                    or (b.startswith("self.") and self.fi.cls
+                        and self.mi.attr_types.get(
+                            (self.fi.cls, b[5:])) == "Thread")
+                    for b in base_names)
+                if threadish and not has_timeout:
+                    blocking = "Thread.join() (unbounded)"
+            elif name in ("get", "put") \
+                    and isinstance(node.func, ast.Attribute):
+                base_names = _target_names(node.func.value)
+                queueish = any(
+                    b in self.mi.queue_names
+                    or (b.startswith("self.") and self.fi.cls
+                        and self.mi.attr_types.get((self.fi.cls, b[5:]))
+                        in ("Queue", "LifoQueue", "PriorityQueue"))
+                    for b in base_names)
+                if queueish and not _has_kwarg(node, "timeout"):
+                    blocking = f"queue.{name}() (unbounded)"
+            if blocking is not None:
+                self._finding(
+                    node, "blocking-in-lock",
+                    f"{blocking} while holding {held[-1]} — every "
+                    "waiter on that lock stalls for the full blocking "
+                    "latency; move the call outside the critical "
+                    "section")
+
+        # thread / executor / queue / server construction
+        if name == "Thread":
+            self._check_thread(node)
+        elif name == "ThreadPoolExecutor":
+            self._check_executor(node)
+        elif name in ("Queue", "LifoQueue", "PriorityQueue"):
+            if not node.args and not _has_kwarg(node, "maxsize"):
+                self._finding(
+                    node, "unbounded-queue",
+                    f"queue.{name}() without maxsize — a producer "
+                    "outrunning its consumer grows it without bound, "
+                    "invisible to the memory plane; pass a bounded, "
+                    "config-derived maxsize")
+        elif name == "ThreadingHTTPServer":
+            if not self._module_has("server_close"):
+                self._finding(
+                    node, "server-leak",
+                    "ThreadingHTTPServer with no server_close() in this "
+                    "module — the listening socket leaks on shutdown")
+
+        # record call edges for interprocedural propagation
+        callee = self._resolve_callee(node)
+        if callee is not None:
+            self.fi.calls.add(callee)
+            if held:
+                self.calls_under.append((held, callee, node.lineno))
+
+        self.generic_visit(node)
+
+    # -- thread/executor lifecycle ---------------------------------------
+    def _module_has(self, needle: str) -> bool:
+        return any(needle in ln for ln in self.mi.lines)
+
+    def _check_thread(self, node: ast.Call) -> None:
+        if not _has_kwarg(node, "target") and not node.args:
+            return  # bare Thread subclass/annotation use
+        if not _has_kwarg(node, "name"):
+            self._finding(
+                node, "unnamed-thread",
+                "Thread without name= — sanitizer reports, trace "
+                "exports, and stack dumps cannot attribute anonymous "
+                "threads; name it after its role")
+        daemon = _kwarg(node, "daemon")
+        is_daemon = isinstance(daemon, ast.Constant) and \
+            daemon.value is True
+        if not is_daemon and not self.mi.has_thread_join:
+            self._finding(
+                node, "thread-leak",
+                "non-daemon Thread with no join() reachable in this "
+                "module — it blocks interpreter exit and accumulates "
+                "under concurrent queries; join it on every path "
+                "(try/finally) or mark it daemon with a bounded-work "
+                "argument")
+
+    def _check_executor(self, node: ast.Call) -> None:
+        # used as a context manager right here?
+        if getattr(node, "_in_with", False):
+            return
+        if not self.mi.has_executor_shutdown:
+            self._finding(
+                node, "executor-leak",
+                "ThreadPoolExecutor neither used as a context manager "
+                "nor shutdown() anywhere in this module — its worker "
+                "threads leak past the owning scope")
+
+    # -- callee resolution ------------------------------------------------
+    def _resolve_callee(self, node: ast.Call) \
+            -> Optional[Tuple[str, Optional[str], str]]:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            # module-local function or repo class constructor
+            if (None, fn.id) in self.mi.functions:
+                return (self.mi.key, None, fn.id)
+            cls_mod = self.repo.class_index.get(fn.id)
+            if cls_mod is not None:
+                return (cls_mod, fn.id, "__init__")
+            return None
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and self.fi.cls:
+                if (self.fi.cls, fn.attr) in self.mi.functions:
+                    return (self.mi.key, self.fi.cls, fn.attr)
+                return None
+            # self.<attr>.<method>() where attr's class is known
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" and self.fi.cls:
+                cls = self.mi.attr_types.get((self.fi.cls, base.attr))
+                if cls:
+                    mod = self.repo.class_index.get(cls)
+                    if mod is not None:
+                        return (mod, cls, fn.attr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# race detection
+# ---------------------------------------------------------------------------
+
+
+class _RaceScanner:
+    """Per class: find attributes written from both thread-context and
+    coordinator-context with at least one unlocked write."""
+
+    def __init__(self, repo: _Repo, mi: _ModuleInfo, cls: ast.ClassDef):
+        self.repo = repo
+        self.mi = mi
+        self.cls = cls
+        #: attr -> (lineno, in_thread, protected, is_const_store, is_rmw)
+        self.writes: Dict[str, List[Tuple[int, bool, bool, bool,
+                                          bool]]] = {}
+
+    def _thread_entry_names(self) -> Tuple[Set[str], bool]:
+        """(names passed as Thread target= / executor .submit() inside
+        this class, whether entries can run CONCURRENTLY — several
+        construction sites, or construction inside a loop/
+        comprehension)."""
+        out: Set[str] = set()
+        sites = 0
+        looped = False
+
+        def scan(node: ast.AST, in_loop: bool) -> None:
+            nonlocal sites, looped
+            for child in ast.iter_child_nodes(node):
+                child_in_loop = in_loop or isinstance(
+                    node, (ast.For, ast.While, ast.ListComp, ast.SetComp,
+                           ast.GeneratorExp))
+                if isinstance(child, ast.Call):
+                    name = _call_name(child)
+                    tgt = None
+                    if name == "Thread":
+                        tgt = _kwarg(child, "target")
+                    elif name == "submit" and child.args:
+                        tgt = child.args[0]
+                    if tgt is not None:
+                        sites += 1
+                        looped = looped or child_in_loop
+                        if isinstance(tgt, ast.Name):
+                            out.add(tgt.id)
+                        elif isinstance(tgt, ast.Attribute):
+                            out.add(tgt.attr)
+                scan(child, child_in_loop)
+
+        scan(self.cls, False)
+        return out, (sites > 1 or looped)
+
+    def scan(self) -> None:
+        has_lock = any(c == self.cls.name for (c, _a) in self.mi.locks)
+        entries, concurrent = self._thread_entry_names()
+        if not entries:
+            return  # no threads started by this class: nothing to race
+
+        # thread-context closure: entry methods plus same-class methods
+        # they (transitively) call — those writes also run on the
+        # spawned thread
+        thread_methods = set(entries)
+        changed = True
+        while changed:
+            changed = False
+            for (cls, fname), fi in self.mi.functions.items():
+                if cls != self.cls.name or fname not in thread_methods:
+                    continue
+                for (cm, cc, cf) in fi.calls:
+                    if cm == self.mi.key and cc == self.cls.name \
+                            and cf not in thread_methods:
+                        thread_methods.add(cf)
+                        changed = True
+
+        for sub in self.cls.body:
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if sub.name == "__init__":
+                continue
+            self._scan_function(sub, sub.name in thread_methods,
+                                thread_methods)
+
+        lockhint = ("declare/extend a lock around every access"
+                    if has_lock else "the class declares no lock at all")
+        for attr, ws in self.writes.items():
+            in_thread = [w for w in ws if w[1]]
+            in_coord = [w for w in ws if not w[1]]
+            unprotected = [w for w in ws if not w[2] and not w[3]]
+            if in_thread and in_coord and unprotected:
+                w = unprotected[0]
+                self.repo.findings.append(Finding(
+                    self.mi.path, w[0], "shared-state-race",
+                    f"self.{attr} is written from both thread-target "
+                    f"and coordinator code, and this write holds no "
+                    f"lock — a read-modify-write here loses updates; "
+                    f"{lockhint}"))
+                continue
+            if not concurrent:
+                continue
+            # several thread instances share self: an unprotected
+            # read-modify-write races its siblings even with no
+            # coordinator-side writer (AugAssign only — w[4])
+            rmw = [w for w in in_thread if not w[2] and w[4]]
+            if rmw:
+                self.repo.findings.append(Finding(
+                    self.mi.path, rmw[0][0], "shared-state-race",
+                    f"self.{attr} takes an unlocked read-modify-write "
+                    f"from a thread entry this class runs CONCURRENTLY "
+                    f"(multiple workers) — += is not atomic; updates "
+                    f"are lost under contention; {lockhint}"))
+
+    def _scan_function(self, fn: ast.AST, in_thread: bool,
+                       thread_methods: Set[str]) -> None:
+        """Record self.X writes with their lock protection; nested
+        defs are thread context when their name was a Thread target."""
+        cls_name = self.cls.name
+
+        class W(ast.NodeVisitor):
+            def __init__(w, mi: _ModuleInfo, outer: "_RaceScanner"):
+                w.mi = mi
+                w.outer = outer
+                w.held = 0
+                w.thread_ctx = in_thread
+                w.scope = fn.name
+
+            def visit_With(w, node: ast.With) -> None:
+                lockish = 0
+                for item in node.items:
+                    for name in _target_names(item.context_expr):
+                        if ((cls_name, name) in w.mi.locks
+                                or (w.scope, name) in w.mi.locks
+                                or (None, name) in w.mi.locks):
+                            lockish += 1
+                            break
+                w.held += 1 if lockish else 0
+                w.generic_visit(node)
+                w.held -= 1 if lockish else 0
+
+            def visit_FunctionDef(w, node: ast.FunctionDef) -> None:
+                prev_ctx, prev_held = w.thread_ctx, w.held
+                if node.name in thread_methods:
+                    w.thread_ctx = True
+                w.held = 0  # nested def runs later: locks not held
+                w.generic_visit(node)
+                w.thread_ctx, w.held = prev_ctx, prev_held
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def _record(w, target: ast.AST, lineno: int,
+                        const: bool, rmw: bool) -> None:
+                if isinstance(target, ast.Attribute) \
+                        and isinstance(target.value, ast.Name) \
+                        and target.value.id == "self":
+                    w.outer.writes.setdefault(target.attr, []).append(
+                        (lineno, w.thread_ctx, w.held > 0, const, rmw))
+
+            def visit_Assign(w, node: ast.Assign) -> None:
+                const = isinstance(node.value, ast.Constant)
+                for t in node.targets:
+                    w._record(t, node.lineno, const, False)
+                w.generic_visit(node)
+
+            def visit_AugAssign(w, node: ast.AugAssign) -> None:
+                w._record(node.target, node.lineno, False, True)
+                w.generic_visit(node)
+
+        W(self.mi, self).visit(fn)
+
+
+# ---------------------------------------------------------------------------
+# whole-repo driver
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(root: str) -> Iterator[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def build_repo(paths) -> _Repo:
+    repo = _Repo()
+    for root in paths:
+        for path in iter_py_files(root):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError):
+                continue
+            if not any(marker in source for marker in
+                       ("threading", "Thread", "queue",
+                        "named_lock", "named_condition")):
+                continue  # no concurrency surface: skip the walks
+            mi = _scan_module(path, tree, source)
+            repo.modules[mi.key] = mi
+            for cls in mi.classes:
+                repo.class_index.setdefault(cls, mi.key)
+    return repo
+
+
+def analyze(paths) -> Tuple[List[Finding], dict]:
+    """Run every detector over ``paths``.  Returns (findings, report);
+    the report carries the lock graph + cycles for the runtime
+    cross-check (tools/lock_sanitizer.py)."""
+    repo = build_repo(paths)
+
+    # pass 2: per-function walks (edges, blocking, lifecycle)
+    walkers: Dict[Tuple[str, Optional[str], str], _FuncWalker] = {}
+    for mi in repo.modules.values():
+        for fi in mi.functions.values():
+            w = _FuncWalker(repo, fi)
+            for stmt in fi.node.body:
+                w.visit(stmt)
+            walkers[fi.key] = w
+        # module scope is a pseudo-function too (import-time Thread /
+        # Queue / server constructions); class and def bodies are
+        # walked above, so only bare top-level statements go here
+        mod_fi = _FuncInfo((mi.key, None, "<module>"), mi.tree, None, mi)
+        w = _FuncWalker(repo, mod_fi)
+        w.scope_names = ["<module>"]
+        for stmt in mi.tree.body:
+            if not isinstance(stmt, (ast.ClassDef, ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                w.visit(stmt)
+        walkers[mod_fi.key] = w
+
+    # pass 3: interprocedural lock-set propagation.  may_acquire(f) =
+    # direct acquires + union over callees, to a fixed point
+    may_acquire: Dict[Tuple[str, Optional[str], str], Set[str]] = {
+        k: set(walkers[k].fi.acquires) for k in walkers}
+    changed = True
+    while changed:
+        changed = False
+        for k, w in walkers.items():
+            acc = may_acquire[k]
+            before = len(acc)
+            for callee in w.fi.calls:
+                acc |= may_acquire.get(callee, set())
+            if len(acc) != before:
+                changed = True
+
+    # edges through calls: held locks at a call site reach everything
+    # the callee may acquire
+    for k, w in walkers.items():
+        for held, callee, lineno in w.calls_under:
+            for lock in may_acquire.get(callee, ()):
+                for h in held:
+                    if h != lock:
+                        repo.edges.setdefault(
+                            (h, lock), (w.mi.path, lineno))
+
+    # pass 4: cycles in the lock graph
+    cycles = _find_cycles(repo.edges)
+    for cyc in cycles:
+        witness = repo.edges[(cyc[0], cyc[1 % len(cyc)])]
+        chain = " -> ".join(cyc + [cyc[0]])
+        repo.findings.append(Finding(
+            witness[0], witness[1], "lock-order",
+            f"potential deadlock: lock-acquisition cycle {chain} — "
+            "impose one global order (or collapse to one lock); run "
+            "tools/lock_sanitizer.py to check whether the runtime "
+            "observes this cycle"))
+
+    # pass 5: races
+    for mi in repo.modules.values():
+        for cls in mi.classes.values():
+            _RaceScanner(repo, mi, cls).scan()
+
+    report = {
+        "edges": sorted([a, b, list(repo.edges[(a, b)])]
+                        for (a, b) in repo.edges),
+        "cycles": [list(c) for c in cycles],
+        "locks": sorted({n for e in repo.edges for n in e}
+                        | {a for mi in repo.modules.values()
+                           for a in mi.locks.values()}),
+    }
+    repo.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return repo.findings, report
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]) \
+        -> List[List[str]]:
+    """Simple cycles in the lock graph, deduped by canonical ROTATION
+    (smallest node first) — not by node set: a->b->c->a and
+    a->c->b->a are two distinct deadlock cycles over the same locks,
+    and the runtime cross-check must see both orientations.  The
+    graphs are tiny (tens of nodes), so a DFS per node is fine."""
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    cycles: List[List[str]] = []
+    seen: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            visited: Set[str]) -> None:
+        for nxt in adj.get(node, ()):
+            if nxt == start and len(path) > 1:
+                # canonical rotation: smallest node first
+                i = path.index(min(path))
+                key = tuple(path[i:] + path[:i])
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(list(key))
+            elif nxt not in visited and nxt > start:
+                # only explore nodes > start: each cycle found once,
+                # from its smallest node
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for n in sorted(adj):
+        dfs(n, n, [n], {n})
+    return cycles
+
+
+def crosscheck(static_report: dict, runtime_report: dict) -> dict:
+    """Compare the static lock graph against a runtime observation
+    (presto_tpu.sync.WATCHER.report()).  For each static cycle:
+
+    - **confirmed**: the cycle closes in the observed graph — every
+      arc directly observed, or every arc completed by an observed
+      transitive path (a runtime-cyclic ordering over these locks
+      either way): the deadlock is one unlucky interleaving away;
+    - **refuted**: every arc was either observed directly or DIRECTLY
+      contradicted (its reverse edge observed), and the whole doesn't
+      close — the runtime walked each leg of the cycle and took a
+      consistent, acyclic order: evidence, not proof, that the static
+      cycle is a false-positive of path-insensitivity.  Partial
+      observation is NOT refutation — a cycle with 2 of 3 arcs
+      observed and the third leg never exercised is one interleaving
+      short of confirmed, not dismissed (and transitive orientation
+      doesn't count here: the observed prefix of ANY partial cycle
+      trivially orients its own missing arc);
+    - **unobserved**: the test run never exercised enough of the cycle
+      to say either way.
+    """
+    observed = {(a, b) for a, b, _n in runtime_report.get("edges", [])}
+    adj: Dict[str, List[str]] = {}
+    for a, b in observed:
+        adj.setdefault(a, []).append(b)
+
+    def reach(src: str, dst: str) -> bool:
+        seen = {src}
+        frontier = [src]
+        while frontier:
+            nxt = []
+            for n in frontier:
+                for m in adj.get(n, ()):
+                    if m == dst:
+                        return True
+                    if m not in seen:
+                        seen.add(m)
+                        nxt.append(m)
+            frontier = nxt
+        return False
+
+    out = {"cycles": [], "inversions": runtime_report.get("inversions", []),
+           "observed_edges": len(observed)}
+    for cyc in static_report.get("cycles", []):
+        arcs = [(cyc[i], cyc[(i + 1) % len(cyc)]) for i in range(len(cyc))]
+        hit = sum(1 for a in arcs if a in observed)
+        if all(reach(u, v) for u, v in arcs):
+            # direct or transitive, the observed order closes the cycle
+            verdict = "confirmed"
+        elif all(((u, v) in observed) != ((v, u) in observed)
+                 for u, v in arcs):
+            # every leg exercised, each in exactly one direction, and
+            # the whole doesn't close: a consistent global order
+            verdict = "refuted"
+        else:
+            verdict = "unobserved"
+        out["cycles"].append({"cycle": cyc, "edges_observed": hit,
+                              "edges_total": len(arcs),
+                              "verdict": verdict})
+    return out

@@ -159,7 +159,8 @@ def main(argv=None) -> int:
                 except BaseException as e:
                     box["err"] = e
 
-            t = threading.Thread(target=go, daemon=True)
+            t = threading.Thread(target=go, daemon=True,
+                                 name=f"cli-query-{qid}")
             t.start()
             while t.is_alive():
                 t.join(timeout=0.1)

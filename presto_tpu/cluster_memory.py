@@ -152,8 +152,14 @@ class ClusterMemoryManager:
                 except Exception:
                     pass
 
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="cluster-memory")
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        # reap the poll loop (sanitizer thread-lifecycle): a stop()
+        # that abandons it lets one more kill cycle race the teardown
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.poll_interval + 1.0)

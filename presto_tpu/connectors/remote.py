@@ -149,7 +149,8 @@ class TableServiceServer:
         self.port = self.httpd.server_address[1]
         self.uri = f"http://{host}:{self.port}"
         self._thread = threading.Thread(
-            target=self.httpd.serve_forever, daemon=True)
+            target=self.httpd.serve_forever, daemon=True,
+            name="table-service-http")
 
     def start(self) -> "TableServiceServer":
         self._thread.start()

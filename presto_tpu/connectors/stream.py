@@ -29,6 +29,8 @@ import struct
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from presto_tpu.sync import named_lock
+
 import numpy as np
 
 from presto_tpu.connectors.jdbc import _encode_column
@@ -46,7 +48,7 @@ class LogBroker:
     def __init__(self, root: str, segment_bytes: int = 1 << 20):
         self.root = root
         self.segment_bytes = segment_bytes
-        self._lock = threading.Lock()
+        self._lock = named_lock("stream.LogBroker._lock")
         os.makedirs(root, exist_ok=True)
 
     def _topic_dir(self, topic: str) -> str:

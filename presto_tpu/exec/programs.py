@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from presto_tpu.sync import named_lock
+
 # ---------------------------------------------------------------------------
 # structural signatures
 # ---------------------------------------------------------------------------
@@ -62,7 +64,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 _DICT_TOKENS_MAX = 4096
 _DICT_TOKENS: "Dict[int, Tuple[object, int]]" = {}
 _DICT_SEQ = [0]
-_DICT_LOCK = threading.Lock()
+_DICT_LOCK = named_lock("programs._DICT_LOCK")
 
 
 def _dict_token(d) -> int:
@@ -125,7 +127,7 @@ def ir_signature(obj) -> Any:
 # ---------------------------------------------------------------------------
 
 _PERSISTENT = {"dir": None, "hits": 0, "requests": 0, "listener": False}
-_PERSISTENT_LOCK = threading.Lock()
+_PERSISTENT_LOCK = named_lock("programs._PERSISTENT_LOCK")
 
 
 def _cache_event_listener(event: str, **kwargs) -> None:
@@ -292,7 +294,7 @@ class ProgramRegistry:
         self.max_callables = max_callables
         self._programs: "collections.OrderedDict[tuple, Program]" = \
             collections.OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = named_lock("programs.ProgramRegistry._lock")
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -358,7 +360,7 @@ class ProgramRegistry:
 
 
 _DEFAULT: Optional[ProgramRegistry] = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = named_lock("programs._DEFAULT_LOCK")
 
 
 def default_registry() -> ProgramRegistry:

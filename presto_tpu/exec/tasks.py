@@ -40,6 +40,7 @@ import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
 from presto_tpu.envflag import EnvInt
+from presto_tpu.sync import named_condition, named_lock
 
 #: splits in flight per pipeline; 1 = today's serial path (A/B leg).
 #: The pool width is config-derived by construction: env var, config
@@ -69,7 +70,7 @@ def set_task_prefetch(value: Optional[int]) -> None:
 # process-wide live gauges (task.splits_queued / task.splits_running)
 # ---------------------------------------------------------------------------
 
-_LIVE_LOCK = threading.Lock()
+_LIVE_LOCK = named_lock("tasks._LIVE_LOCK")
 _LIVE = {"queued": 0, "running": 0}
 
 
@@ -200,8 +201,8 @@ class SplitScheduler:
         progress = current_progress()
         window = self.concurrency + self.prefetch
 
-        lock = threading.Lock()
-        cond = threading.Condition(lock)
+        lock = named_lock("tasks._map_threaded.lock")
+        cond = named_condition("tasks._map_threaded.lock", lock)
         inq: collections.deque = collections.deque()  # (seq, item)
         results: Dict[int, tuple] = {}  # seq -> (ok, value)
         completion: collections.deque = collections.deque()

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Callable, Iterator, List, Optional
 
+from presto_tpu.sync import named_condition, named_lock
+
 # cumulative-seconds thresholds of the levels (TaskExecutor's 0/1/10/60/300)
 LEVEL_THRESHOLDS = (0.0, 1.0, 10.0, 60.0, 300.0)
 # each level gets half the scheduling weight of the one above
@@ -36,7 +38,7 @@ def _level_of(cpu_seconds: float) -> int:
 class TaskHandle:
     """One submitted task: a generator of work steps + accounting."""
 
-    _seq_lock = threading.Lock()
+    _seq_lock = named_lock("executor.TaskHandle._seq_lock")
     _seq = 0
 
     def __init__(self, work: Iterator, on_done: Optional[Callable] = None,
@@ -77,8 +79,9 @@ class TaskExecutor:
     def __init__(self, num_threads: int = 4, quantum: float = 0.1):
         self.quantum = quantum
         self._heap: List = []  # (virtual_priority, seq, handle)
-        self._lock = threading.Lock()
-        self._available = threading.Condition(self._lock)
+        self._lock = named_lock("executor.TaskExecutor._lock")
+        self._available = named_condition("executor.TaskExecutor._lock",
+                                          self._lock)
         self._shutdown = False
         self.completed_tasks = 0
         self._threads = [
@@ -139,7 +142,10 @@ class TaskExecutor:
 
     def _finish(self, h: TaskHandle, error: Optional[BaseException]) -> None:
         h.error = error
-        self.completed_tasks += 1
+        # concurrent runner threads finish tasks at once: an unlocked
+        # += here loses counts (sanitizer shared-state-race)
+        with self._lock:
+            self.completed_tasks += 1
         h.done.set()
         cb = h.on_error if error is not None else h.on_done
         if cb is not None:

@@ -17,6 +17,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from presto_tpu.sync import named_lock
+
 
 class QueryKilledError(Exception):
     """Raised at the next reservation of a query the cluster memory
@@ -52,7 +54,7 @@ class MemoryPool:
 
     def __init__(self, limit_bytes: int):
         self.limit = int(limit_bytes)
-        self._lock = threading.Lock()
+        self._lock = named_lock("memory.MemoryPool._lock")
         self._tagged: Dict[str, int] = {}
         self.reserved = 0
         self.peak = 0
@@ -127,7 +129,7 @@ class QueryMemoryContext:
     def __init__(self, pool: MemoryPool, query_id: str = "q"):
         self.pool = pool
         self.query_id = query_id
-        self._lock = threading.Lock()
+        self._lock = named_lock("memory.QueryMemoryContext._lock")
         self._seq = 0
         self.reserved = 0
         self.peak = 0
@@ -188,7 +190,7 @@ class QueryMemoryContext:
 # ---------------------------------------------------------------------------
 
 _DEFAULT_POOL: Optional[MemoryPool] = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = named_lock("memory._DEFAULT_LOCK")
 
 
 def detected_memory_limit() -> int:

@@ -43,6 +43,7 @@ import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from presto_tpu.sync import named_lock
 
 _log = logging.getLogger("presto_tpu.net")
 
@@ -232,7 +233,7 @@ def poll_each(
     own request site and transition-logged via ``health``; one hung
     socket cannot stretch the cycle past ``join_timeout``."""
     out: Dict[str, Any] = {}
-    lock = threading.Lock()
+    lock = named_lock("net.poll_each.lock")
 
     def run(target: str) -> None:
         try:
@@ -245,8 +246,9 @@ def poll_each(
             if health is not None:
                 health.failed(target, e)
 
-    threads = [threading.Thread(target=run, args=(t,), daemon=True)
-               for t in targets]
+    threads = [threading.Thread(target=run, args=(t,), daemon=True,
+                                name=f"net-poll-{i}")
+               for i, t in enumerate(targets)]
     for t in threads:
         t.start()
     for t in threads:

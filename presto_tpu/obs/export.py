@@ -21,6 +21,8 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from presto_tpu.sync import named_lock
+
 from presto_tpu.events import (
     EventListener, MemoryKillEvent, QueryCompletedEvent, QueryKilledEvent,
     WorkerStateChangeEvent,
@@ -139,7 +141,7 @@ class QueryLogListener(EventListener):
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = named_lock("export.QueryLogListener._lock")
 
     def query_completed(self, e: QueryCompletedEvent) -> None:
         from presto_tpu.obs.trace import lookup

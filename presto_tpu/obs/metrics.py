@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from presto_tpu.sync import named_lock
+
 
 class Counter:
     """Monotonic counter (float-valued so *_seconds totals fit)."""
@@ -112,7 +114,7 @@ class Histogram:
 
 class MetricsRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.MetricsRegistry._lock")
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
@@ -269,6 +271,14 @@ def _preregister(reg: MetricsRegistry) -> None:
         # sampling callbacks at import): unacked bytes buffered across
         # live streams and streams not yet drained/aborted
         "exchange.buffered_bytes", "exchange.open_streams",
+        # concurrency sanitizer (presto_tpu/sync.py, opt-in via
+        # PRESTO_TPU_LOCK_SANITIZER): instrumented-lock totals sampled
+        # from the process-wide LockWatcher — zero when the sanitizer
+        # is off.  lock_inversions > 0 in any run is a release blocker
+        # (an observed lock-order cycle arc).
+        "sanitizer.lock_acquisitions", "sanitizer.lock_wait_seconds",
+        "sanitizer.lock_hold_seconds", "sanitizer.lock_inversions",
+        "sanitizer.locks_tracked", "sanitizer.edges_observed",
     ):
         reg.gauge(name)
     for name in ("query.execution_ms", "xla.compile_ms"):
@@ -313,7 +323,7 @@ class TaskRegistry:
     analog, what the reference surfaces as system.runtime.tasks)."""
 
     def __init__(self, limit: int = 1000):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.TaskRegistry._lock")
         self._entries: "Dict[str, TaskEntry]" = {}
         self._order: List[str] = []
         self.limit = limit

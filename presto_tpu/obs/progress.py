@@ -27,6 +27,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from presto_tpu.sync import named_lock
+
 
 class StageProgress:
     __slots__ = ("name", "splits_total", "splits_done", "rows", "bytes",
@@ -57,7 +59,7 @@ class QueryProgress:
     def __init__(self, query_id: str):
         self.query_id = query_id
         self.t0 = time.perf_counter()
-        self._lock = threading.Lock()
+        self._lock = named_lock("progress.QueryProgress._lock")
         self._stages: "collections.OrderedDict[str, StageProgress]" = (
             collections.OrderedDict())
         self._max_pct = 0.0
@@ -162,7 +164,7 @@ class QueryProgress:
 _REGISTRY_MAX = 256
 _REGISTRY: "collections.OrderedDict[str, QueryProgress]" = (
     collections.OrderedDict())
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = named_lock("progress._REGISTRY_LOCK")
 
 _ACTIVE = threading.local()
 

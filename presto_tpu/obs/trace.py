@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from presto_tpu.sync import named_lock
+
 
 class Span:
     """One completed (or in-flight) trace span.  ``t0``/``dur`` are
@@ -128,7 +130,7 @@ class Tracer:
         self.max_spans = (self.DEFAULT_MAX_SPANS
                           if max_spans is None else max_spans)
         self.dropped = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("trace.Tracer._lock")
 
     # -- recording ------------------------------------------------------
     def span(self, name: str, cat: str = "engine",
@@ -227,7 +229,7 @@ def span(name: str, cat: str = "engine", **args: Any):
 # unique, so a tracer usually occupies two keys: ~64 tracers).
 _REGISTRY_MAX = 128
 _REGISTRY: "collections.OrderedDict[str, Tracer]" = collections.OrderedDict()
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = named_lock("trace._REGISTRY_LOCK")
 
 
 def register(tracer: Tracer) -> Tracer:

@@ -40,6 +40,8 @@ import time
 import weakref
 from typing import Callable, Dict, List, Optional
 
+from presto_tpu.sync import named_lock
+
 _log = logging.getLogger("presto_tpu.failure")
 
 ALIVE, SUSPECT, DEAD, RECOVERED = "ALIVE", "SUSPECT", "DEAD", "RECOVERED"
@@ -128,7 +130,7 @@ class FailureDetector:
         self.recover_after = max(int(recover_after), 1)
         self.jitter = jitter
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = named_lock("failure.FailureDetector._lock")
         self._workers: Dict[str, WorkerHealth] = {}
         self._listeners: List[Callable[[str, str, str, Optional[str]], None]] = []
         self._stop = threading.Event()

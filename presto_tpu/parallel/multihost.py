@@ -46,6 +46,7 @@ from presto_tpu.planner.plan import (
     WindowNode,
 )
 from presto_tpu.server.serde import deserialize_page, plan_to_json
+from presto_tpu.sync import named_lock
 
 _log = logging.getLogger("presto_tpu.multihost")
 
@@ -736,7 +737,7 @@ class MultiHostRunner:
         dictionaries = [c.dictionary for c in fragment_root.channels]
 
         results: List[bytes] = []
-        lock = threading.Lock()
+        lock = named_lock("multihost._run_fragments_pre.lock")
         failed: List[tuple] = []
 
         def make_fragment(chunk) -> dict:
@@ -768,9 +769,14 @@ class MultiHostRunner:
                     errors.append(e)    # the chunk's rows silently
 
         def launch(pairs):
+            # daemon + named (sanitizer thread-leak/unnamed-thread): a
+            # worker POST wedged past its timeouts must not pin
+            # interpreter exit, and reports need attributable names
             threads = [
-                threading.Thread(target=run_on, args=(w, c, make_fragment(c)))
-                for w, c in pairs if c is not None
+                threading.Thread(target=run_on, args=(w, c,
+                                                      make_fragment(c)),
+                                 daemon=True, name=f"mh-chunk-{i}")
+                for i, (w, c) in enumerate(pairs) if c is not None
             ]
             for t in threads:
                 t.start()
@@ -885,7 +891,7 @@ class MultiHostRunner:
         deterministic task errors -> TaskFailed."""
         results: List[bytes] = []
         errors: List[Exception] = []
-        lock = threading.Lock()
+        lock = named_lock("multihost._fan_out_stage2.lock")
 
         def run_one(w: WorkerClient, k: int):
             try:
@@ -899,7 +905,8 @@ class MultiHostRunner:
                 with lock:
                     errors.append(e)
 
-        threads = [threading.Thread(target=run_one, args=(w, k))
+        threads = [threading.Thread(target=run_one, args=(w, k),
+                                    daemon=True, name=f"mh-stage2-{k}")
                    for k, w in enumerate(alive)]
         for t in threads:
             t.start()
@@ -1295,7 +1302,7 @@ class MultiHostRunner:
                                  for w, s in assignments.items()}
 
         results: List[bytes] = []
-        lock = threading.Lock()
+        lock = named_lock("multihost._run_fragments.lock")
         failed: List[tuple] = []
 
         dictionaries = [c.dictionary for c in fragment_root.channels]
@@ -1339,8 +1346,10 @@ class MultiHostRunner:
 
         def launch(pairs):
             threads = [
-                threading.Thread(target=run_on, args=(w, s, make_fragment(s)))
-                for w, s in pairs if s
+                threading.Thread(target=run_on, args=(w, s,
+                                                      make_fragment(s)),
+                                 daemon=True, name=f"mh-fragment-{i}")
+                for i, (w, s) in enumerate(pairs) if s
             ]
             for t in threads:
                 t.start()
@@ -1467,7 +1476,7 @@ class MultiHostRunner:
         slotted: List[tuple] = []  # (slot, seq, page)
         failed: List[tuple] = []
         errors: List[BaseException] = []
-        lock = threading.Lock()
+        lock = named_lock("multihost._stream_fragment_pairs.lock")
 
         def emit_into(put, slot: int, start: int = 0):
             seq = [start]
@@ -1498,13 +1507,21 @@ class MultiHostRunner:
 
         if not live:
             stream.producer_done()
-        threads = [threading.Thread(target=run_on, args=t) for t in live]
+        threads = [threading.Thread(target=run_on, args=t, daemon=True,
+                                    name=f"mh-stream-pull-{t[0]}")
+                   for t in live]
         for t in threads:
             t.start()
-        for tagged in stream.drain():
-            slotted.append(tagged)
-        for t in threads:
-            t.join()
+        try:
+            for tagged in stream.drain():
+                slotted.append(tagged)
+        finally:
+            # join in a finally (sanitizer thread-leak): a consumer-side
+            # error (kill/abort raising out of drain) must still reap
+            # the pullers — drain's early-close abort has already
+            # unblocked any producer stuck on the byte cap
+            for t in threads:
+                t.join(timeout=30.0)
         self.last_exchange_stats = {
             "pages": float(stream.pages_in),
             "bytes": float(stream.bytes_in),

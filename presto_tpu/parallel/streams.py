@@ -28,6 +28,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 from presto_tpu.envflag import EnvFlag, EnvInt
 from presto_tpu.server.buffers import BufferAborted, TaskOutputBuffer
+from presto_tpu.sync import named_lock
 
 #: process defaults (session properties exchange_streaming /
 #: exchange_buffer_bytes override per query) — resolved once, per the
@@ -65,7 +66,7 @@ class PageStream:
         self._exc: Optional[BaseException] = None
         # concurrent producers (union legs, per-worker pullers) share
         # one stream: the overlap stats must not drop updates
-        self._stats_lock = threading.Lock()
+        self._stats_lock = named_lock("streams.PageStream._stats_lock")
         self.pages_in = 0
         self.bytes_in = 0
         self.peak_bytes = 0
@@ -114,8 +115,14 @@ class PageStream:
         return self.buffer.completed_at
 
     def drain(self, batch_bytes: int = 8 << 20) -> Iterator:
-        """Pull + ack until complete; re-raises a producer's error."""
+        """Pull + ack until complete; re-raises a producer's error.
+        Closing the generator early (LIMIT, a consumer-side error)
+        aborts the buffer: a producer blocked on the byte cap would
+        otherwise wait for acks that can never come — the deadlock the
+        sanitizer's instrumented-lock runs flagged as unbounded
+        producer stalls on dead consumers."""
         token = 0
+        complete = False
         try:
             while True:
                 items, nxt, done, err = self.buffer.get(
@@ -129,9 +136,12 @@ class PageStream:
                     self.buffer.acknowledge(nxt)
                     token = nxt
                 if done:
+                    complete = True
                     return
         finally:
             self.closed = True
+            if not complete:
+                self.buffer.abort()
 
 
 class StreamingExchange:
@@ -218,7 +228,7 @@ class StreamingExchange:
 _LIVE: "weakref.WeakSet[PageStream]" = weakref.WeakSet()
 _TLS = threading.local()
 _REGISTRY: Dict[str, "weakref.WeakSet[PageStream]"] = {}
-_REG_LOCK = threading.Lock()
+_REG_LOCK = named_lock("streams._REG_LOCK")
 
 
 def _register(stream: PageStream) -> None:

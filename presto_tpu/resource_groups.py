@@ -19,8 +19,9 @@ chain so sibling fairness is enforced at every level.
 from __future__ import annotations
 
 import itertools
-import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+from presto_tpu.sync import named_condition
 
 
 class QueryQueueFullError(Exception):
@@ -46,7 +47,9 @@ class ResourceGroup:
         # one condition per TREE: cross-group fairness needs a shared
         # monitor (the reference synchronizes on the root too,
         # InternalResourceGroup.root lock)
-        self._lock = parent._lock if parent is not None else threading.Condition()
+        self._lock = (parent._lock if parent is not None
+                      else named_condition(
+                          "resource_groups.ResourceGroup._lock"))
         self.running = 0
         self.queued = 0
         self.pending = 0  # waiters in this subtree (for sibling contention)

@@ -194,7 +194,7 @@ class TokenAuthenticator:
         import time
 
         # epoch arithmetic by design: the exp claim is wall-clock time
-        exp = int(time.time()) + ttl_seconds  # lint: allow(wallclock)
+        exp = int(time.time()) + ttl_seconds
         payload = f"{user}.{exp}"
         return f"{payload}.{self._sig(payload)}"
 

@@ -24,9 +24,10 @@ sees completion only when the last one finishes.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import List, Optional, Tuple
+
+from presto_tpu.sync import named_condition, named_lock
 
 
 class BufferAborted(Exception):
@@ -38,8 +39,9 @@ class TaskOutputBuffer:
 
     def __init__(self, max_bytes: int = 64 << 20, producers: int = 1):
         self.max_bytes = max_bytes
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = named_lock("buffers.TaskOutputBuffer._lock")
+        self._cond = named_condition("buffers.TaskOutputBuffer._lock",
+                                     self._lock)
         self._pages: List[Optional[object]] = []  # None = acknowledged/freed
         self._sizes: List[int] = []  # parallel byte sizes (payload-agnostic)
         self._acked = 0  # tokens below this are freed

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 from presto_tpu import __version__
 from presto_tpu.runner import QueryRunner
+from presto_tpu.sync import named_lock
 
 PAGE_ROWS = 1000
 
@@ -237,7 +238,7 @@ class CoordinatorServer:
             runner.events.worker_state_changed(WorkerStateChangeEvent(
                 uri=uri, old_state=old, new_state=new, reason=reason,
                 change_time=_time.time())))
-        self._lock = threading.Lock()
+        self._lock = named_lock("coordinator.CoordinatorServer._lock")
         # cluster-wide OOM protection (memory/ClusterMemoryManager.java:88):
         # polls local + worker pools, kills the biggest reserver at the
         # threshold. Only active when the executor runs with a pool.
@@ -452,7 +453,9 @@ class CoordinatorServer:
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True,
+                                        name="coordinator-http")
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -665,7 +668,8 @@ class CoordinatorServer:
                 self._release_group(q)
                 q.done.set()
 
-        t = threading.Thread(target=run, daemon=True)
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"query-{q.id}")
         t.start()  # started before publication: stop() joins safely
         with self._lock:
             q.thread = t

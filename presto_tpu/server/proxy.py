@@ -101,7 +101,8 @@ class ProxyServer:
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="proxy-http")
 
     def start(self) -> None:
         self._thread.start()

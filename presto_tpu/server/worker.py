@@ -27,6 +27,7 @@ from presto_tpu.catalog import Catalog
 from presto_tpu.exec.local import LocalRunner
 from presto_tpu.server.buffers import BufferAborted, TaskOutputBuffer
 from presto_tpu.server.serde import plan_from_json, serialize_page
+from presto_tpu.sync import named_lock
 
 # /v1/task/{id}/results/{token} (single-stream, buffer 0) or
 # /v1/task/{id}/results/{buffer}/{token} (partitioned output — the
@@ -103,7 +104,7 @@ class WorkerServer:
         # expires tasks via TaskManagerConfig.infoMaxAge/clientTimeout)
         self.task_ttl = task_ttl
         self._tasks: Dict[str, _Task] = {}
-        self._tasks_lock = threading.Lock()
+        self._tasks_lock = named_lock("worker.WorkerServer._tasks_lock")
         self.draining = False
         # deterministic fault injection (testing_faults.py): the
         # process-global registry is inert unless a test/CI leg armed
@@ -263,7 +264,8 @@ class WorkerServer:
                     want = self.rfile.read(n).decode().strip().strip('"')
                     if want == "SHUTTING_DOWN":
                         outer.draining = True
-                        threading.Thread(target=outer.drain, daemon=True).start()
+                        threading.Thread(target=outer.drain, daemon=True,
+                                         name="worker-drain").start()
                         self._send(200, b"{}")
                     else:
                         self._send(400, json.dumps(
@@ -333,7 +335,8 @@ class WorkerServer:
             from presto_tpu.memory import wire_pool_gauges
 
             wire_pool_gauges(memory_pool)
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="worker-http")
 
     # ------------------------------------------------------------------
     def _create_task(self, task_id: str, fragment_json: dict,

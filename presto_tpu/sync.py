@@ -1,0 +1,356 @@
+"""Named synchronization primitives + opt-in lock instrumentation.
+
+The runtime half of the concurrency sanitizer
+(presto_tpu/analysis/concurrency.py is the static half).  Engine
+modules create their locks through :func:`named_lock` /
+:func:`named_condition` instead of bare ``threading.Lock()`` so every
+lock carries a stable name (``module.Class.attr`` — the same naming
+scheme the static analyzer derives from the AST).  In normal operation
+the factories return the plain stdlib primitives: zero per-acquisition
+overhead, one extra function call at construction.
+
+With ``PRESTO_TPU_LOCK_SANITIZER=1`` (resolved once via the
+:class:`~presto_tpu.envflag.EnvFlag` contract; ``set_lock_sanitizer``
+overrides for tests) the factories return instrumented wrappers that
+record, per lock NAME:
+
+- acquisition counts, wait time, and hold time;
+- the **observed acquisition-order graph**: an edge ``A -> B`` for
+  every acquire of ``B`` while ``A`` is held on the same thread;
+- **lock-order inversions**, detected online: acquiring ``B`` while
+  holding ``A`` when a ``B -> ... -> A`` path was already observed
+  means two threads can deadlock — recorded with both stacks' names.
+
+``WATCHER.report()`` returns the whole picture; ``tools/
+lock_sanitizer.py`` cross-checks it against the static lock graph
+(confirming or refuting each statically-possible cycle) and the
+``sanitizer.*`` gauges surface the totals through the metrics catalog.
+
+The watcher's own bookkeeping uses a bare ``threading.Lock`` — the
+instrumentation must never instrument itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from presto_tpu.envflag import EnvFlag
+
+#: opt-in: instrumented locks are for sanitizer runs/tests, never the
+#: serving default (they add two perf_counter reads per acquisition)
+_LOCK_SANITIZER = EnvFlag("PRESTO_TPU_LOCK_SANITIZER", default=False)
+
+
+def lock_sanitizer_enabled() -> bool:
+    return _LOCK_SANITIZER()
+
+
+def set_lock_sanitizer(value: Optional[bool]) -> None:
+    """Test/tool override (``None`` re-resolves from the environment).
+    Only affects locks constructed AFTER the call — module-level locks
+    created at import time need the env var set before the process
+    imports presto_tpu (tools/lock_sanitizer.py does exactly that)."""
+    _LOCK_SANITIZER.set(value)
+
+
+class _LockStats:
+    __slots__ = ("acquisitions", "wait_s", "hold_s", "max_hold_s",
+                 "contentions")
+
+    def __init__(self):
+        self.acquisitions = 0
+        self.wait_s = 0.0
+        self.hold_s = 0.0
+        self.max_hold_s = 0.0
+        self.contentions = 0
+
+
+class LockWatcher:
+    """Process-global recorder of lock acquisition order and timing.
+
+    Per-thread held stacks live in a ``threading.local``; the shared
+    edge graph / stats / inversion list are guarded by a bare
+    (uninstrumented) lock.  Everything aggregates by lock NAME, so two
+    instances of the same class feed one node — the granularity
+    deadlock analysis needs (a cycle between instances of classes A
+    and B exists iff it exists between their name nodes)."""
+
+    #: inversion records kept (each is a distinct (a, b) pair anyway)
+    MAX_INVERSIONS = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        # (holder_name, acquired_name) -> observation count
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.stats: Dict[str, _LockStats] = {}
+        self.inversions: List[dict] = []
+        self._inverted_pairs: Set[Tuple[str, str]] = set()
+
+    # -- per-thread stack ---------------------------------------------------
+    def _stack(self) -> List[list]:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    # -- recording ----------------------------------------------------------
+    def on_acquired(self, name: str, waited: float) -> None:
+        stack = self._stack()
+        held = [entry[0] for entry in stack]
+        stack.append([name, time.perf_counter()])
+        with self._lock:
+            st = self.stats.get(name)
+            if st is None:
+                st = self.stats[name] = _LockStats()
+            st.acquisitions += 1
+            st.wait_s += waited
+            if waited > 1e-4:
+                st.contentions += 1
+            for h in held:
+                if h == name:
+                    continue  # re-acquire of the same name: not an edge
+                key = (h, name)
+                fresh = key not in self.edges
+                self.edges[key] = self.edges.get(key, 0) + 1
+                if fresh and self._path_exists(name, h):
+                    self._record_inversion(h, name, held)
+
+    def on_released(self, name: str) -> None:
+        stack = self._stack()
+        # LIFO is the common case but out-of-order release is legal
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == name:
+                _, t0 = stack.pop(i)
+                held = time.perf_counter() - t0
+                with self._lock:
+                    st = self.stats.get(name)
+                    if st is not None:
+                        st.hold_s += held
+                        if held > st.max_hold_s:
+                            st.max_hold_s = held
+                return
+
+    def _path_exists(self, src: str, dst: str) -> bool:
+        """True when dst is reachable from src in the observed edge
+        graph (caller holds self._lock).  Graphs here are tens of
+        nodes; BFS is plenty."""
+        if src == dst:
+            return True
+        seen = {src}
+        frontier = [src]
+        while frontier:
+            nxt = []
+            for n in frontier:
+                for (a, b) in self.edges:
+                    if a == n and b not in seen:
+                        if b == dst:
+                            return True
+                        seen.add(b)
+                        nxt.append(b)
+            frontier = nxt
+        return False
+
+    def _record_inversion(self, held: str, acquired: str,
+                          held_stack: List[str]) -> None:
+        pair = (held, acquired) if held <= acquired else (acquired, held)
+        if pair in self._inverted_pairs:
+            return
+        self._inverted_pairs.add(pair)
+        if len(self.inversions) < self.MAX_INVERSIONS:
+            self.inversions.append({
+                "held": held,
+                "acquired": acquired,
+                "held_stack": list(held_stack),
+                "thread": threading.current_thread().name,
+            })
+
+    # -- reporting ----------------------------------------------------------
+    def report(self) -> dict:
+        _wire_gauges()
+        with self._lock:
+            return {
+                "locks": {
+                    name: {
+                        "acquisitions": st.acquisitions,
+                        "contentions": st.contentions,
+                        "wait_s": round(st.wait_s, 6),
+                        "hold_s": round(st.hold_s, 6),
+                        "max_hold_s": round(st.max_hold_s, 6),
+                    }
+                    for name, st in sorted(self.stats.items())
+                },
+                "edges": sorted(
+                    [a, b, n] for (a, b), n in self.edges.items()),
+                "inversions": list(self.inversions),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.edges.clear()
+            self.stats.clear()
+            self.inversions.clear()
+            self._inverted_pairs.clear()
+
+    # -- totals (sanitizer.* gauges sample these) ----------------------------
+    def total(self, field: str) -> float:
+        with self._lock:
+            if field == "inversions":
+                return float(len(self.inversions))
+            if field == "locks":
+                return float(len(self.stats))
+            if field == "edges":
+                return float(len(self.edges))
+            return float(sum(getattr(st, field) for st in
+                             self.stats.values()))
+
+
+#: the process-wide watcher (inert until an instrumented lock feeds it)
+WATCHER = LockWatcher()
+
+_GAUGES_WIRED = False
+
+
+def _wire_gauges() -> None:
+    """Attach the ``sanitizer.*`` gauge callbacks to the watcher.
+    Deferred (not at import): obs imports must not run while this
+    module loads, or a metrics->sync->obs->metrics cycle deadlocks the
+    import machinery.  Idempotent; called on the first instrumented
+    construction and from report()."""
+    global _GAUGES_WIRED
+    if _GAUGES_WIRED:
+        return
+    try:
+        from presto_tpu.obs import METRICS
+    except ImportError:
+        return
+    _GAUGES_WIRED = True
+    METRICS.gauge("sanitizer.lock_acquisitions").set_fn(
+        lambda: WATCHER.total("acquisitions"))
+    METRICS.gauge("sanitizer.lock_wait_seconds").set_fn(
+        lambda: WATCHER.total("wait_s"))
+    METRICS.gauge("sanitizer.lock_hold_seconds").set_fn(
+        lambda: WATCHER.total("hold_s"))
+    METRICS.gauge("sanitizer.lock_inversions").set_fn(
+        lambda: WATCHER.total("inversions"))
+    METRICS.gauge("sanitizer.locks_tracked").set_fn(
+        lambda: WATCHER.total("locks"))
+    METRICS.gauge("sanitizer.edges_observed").set_fn(
+        lambda: WATCHER.total("edges"))
+
+
+class _SanLock:
+    """Instrumented mutex: the ``threading.Lock`` surface the engine
+    uses (acquire/release/context manager/locked)."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            WATCHER.on_acquired(self.name, time.perf_counter() - t0)
+        return ok
+
+    def release(self) -> None:
+        WATCHER.on_released(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _SanCondition:
+    """Instrumented condition variable.  ``wait()`` releases the
+    underlying lock while blocked, and the held-stack must reflect
+    that — otherwise every waiter would fabricate edges from a lock it
+    does not actually hold."""
+
+    __slots__ = ("name", "_lock", "_inner")
+
+    def __init__(self, name: str, lock: Optional[_SanLock] = None):
+        self.name = name
+        self._lock = lock if lock is not None else _SanLock(name)
+        self._inner = threading.Condition(self._lock._inner)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._lock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> bool:
+        return self._lock.__enter__()
+
+    def __exit__(self, *exc) -> None:
+        self._lock.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        WATCHER.on_released(self._lock.name)
+        t0 = time.perf_counter()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            WATCHER.on_acquired(self._lock.name, time.perf_counter() - t0)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        result = predicate()
+        if timeout is None:
+            while not result:
+                self.wait()
+                result = predicate()
+            return result
+        deadline = time.monotonic() + timeout
+        while not result:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+def named_lock(name: str):
+    """A mutex named for the sanitizer.  Plain ``threading.Lock`` when
+    the sanitizer is off (the default); the name must follow the
+    static analyzer's scheme — ``<module>.<Class>.<attr>`` for
+    instance locks, ``<module>.<NAME>`` at module scope — so runtime
+    edges line up with static ones in the cross-check."""
+    if not _LOCK_SANITIZER():
+        return threading.Lock()
+    _wire_gauges()
+    return _SanLock(name)
+
+
+def named_condition(name: str, lock=None):
+    """A condition variable named for the sanitizer.  ``lock`` may be
+    a :func:`named_lock` result (instrumented or plain) so a
+    Lock+Condition pair shares one underlying mutex either way."""
+    if not _LOCK_SANITIZER():
+        if isinstance(lock, _SanLock):  # mixed construction windows
+            return threading.Condition(lock._inner)
+        return threading.Condition(lock)
+    if isinstance(lock, _SanLock) or lock is None:
+        _wire_gauges()
+        return _SanCondition(name, lock)
+    # a plain lock created before the override flipped on: wrap it
+    # un-instrumented rather than splitting the mutex in two
+    return threading.Condition(lock)

@@ -52,6 +52,8 @@ import random
 import threading
 from typing import Dict, List, Optional
 
+from presto_tpu.sync import named_lock
+
 _log = logging.getLogger("presto_tpu.faults")
 
 FAULT_POINTS = (
@@ -98,7 +100,7 @@ class FaultRegistry:
     probabilistic decisions draw from."""
 
     def __init__(self, seed: int = 0):
-        self._lock = threading.Lock()
+        self._lock = named_lock("testing_faults.FaultRegistry._lock")
         self._specs: List[FaultSpec] = []
         self._rng = random.Random(seed)
         self.seed = seed
@@ -207,10 +209,10 @@ def arm_from_env(registry: Optional[FaultRegistry] = None) -> FaultRegistry:
     import os
 
     reg = registry or FAULTS
-    seed = os.environ.get("PRESTO_TPU_FAULT_SEED")  # lint: allow(env-read)
+    seed = os.environ.get("PRESTO_TPU_FAULT_SEED")
     if seed:
         reg.reseed(int(seed))
-    spec = os.environ.get("PRESTO_TPU_FAULTS")  # lint: allow(env-read)
+    spec = os.environ.get("PRESTO_TPU_FAULTS")
     if spec:
         parse_fault_env(spec, reg)
     return reg

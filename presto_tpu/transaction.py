@@ -23,6 +23,8 @@ import time
 import uuid
 from typing import Dict, Optional
 
+from presto_tpu.sync import named_lock
+
 
 class TransactionError(Exception):
     pass
@@ -69,7 +71,7 @@ class TransactionManager:
 
     def __init__(self):
         self._open: Dict[str, Transaction] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("transaction.TransactionManager._lock")
 
     def begin(self, read_only: bool = False) -> Transaction:
         tx = Transaction(f"tx_{uuid.uuid4().hex[:12]}", read_only)

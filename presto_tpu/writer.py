@@ -15,6 +15,8 @@ import queue
 import threading
 from typing import Callable, List, Optional
 
+from presto_tpu.sync import named_lock
+
 
 class ScaledWriter:
     """Submit pages; ``finish()`` returns the processed results.
@@ -30,18 +32,26 @@ class ScaledWriter:
         self._write = write_fn
         self.max_writers = max_writers
         self.scale_depth = scale_depth
-        self._q: "queue.Queue" = queue.Queue()
+        # bounded (sanitizer unbounded-queue): a producer outrunning
+        # every writer blocks in submit() — backpressure — instead of
+        # growing the staged-page queue without limit.  Capacity scales
+        # with the pool so the scale-up trigger (qsize > scale_depth)
+        # still has room to observe backlog, and finish()/abort() can
+        # always enqueue one stop marker per writer.
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=max(2 * scale_depth, 2) * max(max_writers, 1))
         self._seq = 0
         self._results: List = []
         self._errors: List[BaseException] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("writer.ScaledWriter._lock")
         self._threads: List[threading.Thread] = []
         self._stop = object()
         self._spawn()
 
     # -- internals ----------------------------------------------------------
     def _spawn(self) -> None:
-        t = threading.Thread(target=self._run, daemon=True)
+        t = threading.Thread(target=self._run, daemon=True,
+                             name=f"scaled-writer-{len(self._threads)}")
         t.start()
         self._threads.append(t)
 

@@ -1,0 +1,783 @@
+"""Concurrency sanitizer: per-detector fixture snippets (positive +
+negative), the suppression-file contract, the unified CLI's per-check
+exit codes, the static/runtime cross-check, and an instrumented-lock
+smoke test over a real distributed query (zero inversions)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import engine_lint  # noqa: E402
+
+from presto_tpu.analysis import concurrency  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _analyze(tmp_path, code, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    findings, report = concurrency.analyze([str(p)])
+    return findings, report
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_flagged(tmp_path):
+    findings, report = _analyze(tmp_path, """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+    """)
+    assert "lock-order" in _rules(findings)
+    assert report["cycles"] == [["snippet.A", "snippet.B"]]
+
+
+def test_lock_order_consistent_order_clean(tmp_path):
+    findings, report = _analyze(tmp_path, """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def also_ab():
+            with A:
+                with B:
+                    pass
+    """)
+    assert "lock-order" not in _rules(findings)
+    assert report["cycles"] == []
+
+
+def test_lock_order_interprocedural_cycle(tmp_path):
+    """The B-acquire hides behind a helper call: the edge must still
+    land via the call graph."""
+    findings, report = _analyze(tmp_path, """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def take_b():
+            with B:
+                pass
+
+        def take_a():
+            with A:
+                pass
+
+        def a_then_b():
+            with A:
+                take_b()
+
+        def b_then_a():
+            with B:
+                take_a()
+    """)
+    assert report["cycles"] == [["snippet.A", "snippet.B"]]
+    assert "lock-order" in _rules(findings)
+
+
+def test_condition_aliases_its_lock(tmp_path):
+    """Condition(self._lock) IS self._lock: nesting them must not
+    fabricate a self-edge or a cycle."""
+    findings, report = _analyze(tmp_path, """
+        import threading
+
+        class Buf:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def poke(self):
+                with self._cond:
+                    self._cond.notify_all()
+
+            def peek(self):
+                with self._lock:
+                    return 1
+    """)
+    assert report["cycles"] == []
+    assert "lock-order" not in _rules(findings)
+
+
+def test_named_condition_lock_in_second_arg_aliases(tmp_path):
+    """named_condition(name, lock) carries the lock in args[1] — it
+    must alias like Condition(lock) does, or every converted pair
+    splits into a phantom static node the runtime never observes."""
+    findings, report = _analyze(tmp_path, """
+        from presto_tpu.sync import named_lock, named_condition
+
+        class Buf:
+            def __init__(self):
+                self._lock = named_lock("snippet.Buf._lock")
+                self._cond = named_condition("snippet.Buf._lock",
+                                             self._lock)
+
+            def poke(self):
+                with self._cond:
+                    self._cond.notify_all()
+
+            def peek(self):
+                with self._lock:
+                    return 1
+    """)
+    assert report["cycles"] == []
+    assert "snippet.Buf._cond" not in report["locks"]
+    assert "snippet.Buf._lock" in report["locks"]
+
+
+def test_ternary_lock_assignment_modeled(tmp_path):
+    """A lock constructed in a ternary branch (resource_groups'
+    parent-or-new-Condition pattern) must still be modeled."""
+    findings, report = _analyze(tmp_path, """
+        import threading
+        import time
+
+        class Group:
+            def __init__(self, parent=None):
+                self._lock = (parent._lock if parent is not None
+                              else threading.Condition())
+
+            def acquire(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+    assert "snippet.Group._lock" in report["locks"]
+    assert "blocking-in-lock" in _rules(findings)
+
+
+def test_same_basename_modules_both_analyzed(tmp_path):
+    """Two modules sharing a basename (the repo has memory.py and
+    metrics.py twice) must BOTH be analyzed — a basename-keyed model
+    silently drops one."""
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir()
+    b.mkdir()
+    (a / "metrics.py").write_text(textwrap.dedent("""
+        import queue
+        Q = queue.Queue()
+    """))
+    (b / "metrics.py").write_text(textwrap.dedent("""
+        import threading
+        t = threading.Thread(target=print, daemon=True)
+    """))
+    findings, _ = concurrency.analyze([str(tmp_path)])
+    assert "unbounded-queue" in _rules(findings)
+    assert "unnamed-thread" in _rules(findings)
+
+
+def test_cross_class_edge_via_attribute_call(tmp_path):
+    """self.buffer.enqueue() resolves through the attribute's
+    constructor type, so the holder->buffer edge is recorded."""
+    _, report = _analyze(tmp_path, """
+        import threading
+
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+        class Outer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.inner = Inner()
+
+            def run(self):
+                with self._lock:
+                    self.inner.poke()
+    """)
+    assert ["snippet.Outer._lock", "snippet.Inner._lock"] in \
+        [e[:2] for e in report["edges"]]
+
+
+# ---------------------------------------------------------------------------
+# blocking-in-lock / untimed-wait
+# ---------------------------------------------------------------------------
+
+def test_blocking_calls_in_lock_flagged(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+        import time
+        from urllib.request import urlopen
+
+        L = threading.Lock()
+
+        def bad():
+            with L:
+                time.sleep(0.5)
+                urlopen("http://peer/v1/info", timeout=2.0)
+                request_json("http://peer", timeout=1.0)
+    """)
+    assert _rules(findings).count("blocking-in-lock") == 3
+
+
+def test_blocking_outside_lock_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+        import time
+
+        L = threading.Lock()
+
+        def fine():
+            with L:
+                x = 1
+            time.sleep(0.5)
+            return x
+    """)
+    assert findings == []
+
+
+def test_untimed_queue_get_in_lock_flagged(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import queue
+        import threading
+
+        L = threading.Lock()
+        q = queue.Queue(maxsize=8)
+
+        def bad():
+            with L:
+                return q.get()
+
+        def fine():
+            with L:
+                return q.get(timeout=1.0)
+    """)
+    assert _rules(findings).count("blocking-in-lock") == 1
+
+
+def test_untimed_wait_flagged_timed_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        C = threading.Condition()
+
+        def bad():
+            with C:
+                C.wait()
+
+        def fine():
+            with C:
+                C.wait(timeout=1.0)
+    """)
+    assert _rules(findings) == ["untimed-wait"]
+
+
+def test_wait_while_holding_other_lock_flagged(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        L = threading.Lock()
+        C = threading.Condition()
+
+        def bad():
+            with L:
+                with C:
+                    C.wait(timeout=1.0)
+    """)
+    assert "blocking-in-lock" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# shared-state-race
+# ---------------------------------------------------------------------------
+
+def test_race_thread_vs_coordinator_flagged(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def _worker(self):
+                self.count += 1
+
+            def start(self):
+                threading.Thread(target=self._worker, name="w",
+                                 daemon=True).start()
+                self.count = self.count + 2
+    """)
+    assert "shared-state-race" in _rules(findings)
+
+
+def test_race_locked_writes_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def _worker(self):
+                with self._lock:
+                    self.count += 1
+
+            def start(self):
+                threading.Thread(target=self._worker, name="w",
+                                 daemon=True).start()
+                with self._lock:
+                    self.count += 2
+    """)
+    assert "shared-state-race" not in _rules(findings)
+
+
+def test_race_constant_flag_store_exempt(tmp_path):
+    """GIL-atomic flag handoffs (self.done = True) are idiomatic."""
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.draining = False
+
+            def _worker(self):
+                self.draining = True
+
+            def start(self):
+                threading.Thread(target=self._worker, name="w",
+                                 daemon=True).start()
+                self.draining = False
+    """)
+    assert "shared-state-race" not in _rules(findings)
+
+
+def test_race_concurrent_rmw_flagged(tmp_path):
+    """Multiple worker threads += the same attr with no coordinator
+    writer: still a lost update (the executor.completed_tasks class)."""
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        class Exec:
+            def __init__(self, n):
+                self._lock = threading.Lock()
+                self.completed = 0
+                self._threads = [
+                    threading.Thread(target=self._run, name=f"r{i}",
+                                     daemon=True)
+                    for i in range(n)
+                ]
+
+            def _run(self):
+                self.completed += 1
+    """)
+    assert "shared-state-race" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: threads / executors / queues / servers
+# ---------------------------------------------------------------------------
+
+def test_thread_leak_and_unnamed_flagged(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        def leak():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+    """)
+    rules = _rules(findings)
+    assert "thread-leak" in rules and "unnamed-thread" in rules
+
+
+def test_daemon_named_thread_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        def fine():
+            t = threading.Thread(target=print, name="helper", daemon=True)
+            t.start()
+            return t
+    """)
+    assert findings == []
+
+
+def test_joined_non_daemon_thread_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import threading
+
+        def fine():
+            t = threading.Thread(target=print, name="helper")
+            t.start()
+            t.join(timeout=5.0)
+    """)
+    assert "thread-leak" not in _rules(findings)
+
+
+def test_executor_leak_flagged_context_manager_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def leak(n):
+            ex = ThreadPoolExecutor(max_workers=n)
+            return ex
+    """)
+    assert "executor-leak" in _rules(findings)
+    findings, _ = _analyze(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fine(n, tasks):
+            with ThreadPoolExecutor(max_workers=n) as ex:
+                return list(ex.map(str, tasks))
+    """, name="snippet2.py")
+    assert "executor-leak" not in _rules(findings)
+
+
+def test_executor_shutdown_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Srv:
+            def __init__(self, n):
+                self.ex = ThreadPoolExecutor(max_workers=n)
+
+            def stop(self):
+                self.ex.shutdown(wait=False)
+    """)
+    assert "executor-leak" not in _rules(findings)
+
+
+def test_unbounded_queue_flagged_bounded_clean(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        import queue
+
+        def make(n):
+            bad = queue.Queue()
+            good = queue.Queue(maxsize=n)
+            also_good = queue.Queue(n)
+            return bad, good, also_good
+    """)
+    assert _rules(findings) == ["unbounded-queue"]
+
+
+def test_server_leak_flagged(tmp_path):
+    findings, _ = _analyze(tmp_path, """
+        from http.server import ThreadingHTTPServer
+
+        def serve(handler):
+            httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+            return httpd
+    """)
+    assert "server-leak" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# suppressions + unified CLI
+# ---------------------------------------------------------------------------
+
+def test_inline_allow_comment_honored(tmp_path):
+    p = tmp_path / "s.py"
+    p.write_text(textwrap.dedent("""
+        import queue
+
+        def make():
+            return queue.Queue()  # lint: allow(unbounded-queue)
+    """))
+    findings, _ = engine_lint.lint_concurrency([str(p)])
+    assert findings == []
+
+
+def test_suppression_file_format_and_matching(tmp_path):
+    sup = tmp_path / "sup.txt"
+    sup.write_text(
+        "# comment\n"
+        "s.py | unbounded-queue | queue.Queue() | reviewed: bounded by caller\n"
+        "bad-entry-without-fields\n"
+        "s.py | untimed-wait | x |\n")
+    entries, problems = engine_lint.load_suppressions(str(sup))
+    assert len(entries) == 1
+    assert [p.rule for p in problems] == ["suppression-format"] * 2
+
+    f = engine_lint.Finding(str(tmp_path / "s.py"), 4, "unbounded-queue", "m")
+    (tmp_path / "s.py").write_text("import queue\n\ndef make():\n"
+                                   "    return queue.Queue()\n")
+    assert engine_lint.apply_suppressions([f], entries) == []
+    # different rule: not covered
+    f2 = engine_lint.Finding(str(tmp_path / "s.py"), 4, "thread-leak", "m")
+    assert engine_lint.apply_suppressions([f2], entries) == [f2]
+
+
+def test_cli_per_check_exit_codes(tmp_path, capsys):
+    empty_sup = tmp_path / "none.txt"
+    empty_sup.write_text("")
+    # engine-only finding -> exit 1
+    eng = tmp_path / "eng.py"
+    eng.write_text("def f():\n    try:\n        return 1\n"
+                   "    except:\n        return 2\n")
+    assert engine_lint.main(["--check", "--suppressions", str(empty_sup),
+                             str(eng)]) == 1
+    # concurrency-only finding -> exit 2
+    conc = tmp_path / "conc.py"
+    conc.write_text("import queue\nq = queue.Queue()\n")
+    assert engine_lint.main(["--check", "--suppressions", str(empty_sup),
+                             str(conc)]) == 2
+    # both -> exit 3
+    assert engine_lint.main(["--check", "--suppressions", str(empty_sup),
+                             str(eng), str(conc)]) == 3
+    capsys.readouterr()
+
+
+def test_cli_json_output(tmp_path, capsys):
+    conc = tmp_path / "conc.py"
+    conc.write_text("import queue\nq = queue.Queue()\n")
+    empty_sup = tmp_path / "none.txt"
+    empty_sup.write_text("")
+    engine_lint.main(["--json", "--suppressions", str(empty_sup), str(conc)])
+    out = capsys.readouterr().out
+    payload = json.loads(out)
+    assert payload and payload[0]["rule"] == "unbounded-queue"
+    assert payload[0]["check"] == "concurrency"
+
+
+def test_rule_sets_stay_in_sync():
+    assert engine_lint.CONCURRENCY_RULES == concurrency.CONCURRENCY_RULES
+
+
+# ---------------------------------------------------------------------------
+# runtime: instrumented locks + cross-check
+# ---------------------------------------------------------------------------
+
+def _fresh_watcher():
+    import presto_tpu.sync as sync
+
+    sync.WATCHER.reset()
+    sync.set_lock_sanitizer(True)
+    return sync
+
+
+def test_instrumented_lock_records_edges_and_stats():
+    sync = _fresh_watcher()
+    try:
+        a = sync.named_lock("t.A")
+        b = sync.named_lock("t.B")
+        with a:
+            with b:
+                pass
+        rep = sync.WATCHER.report()
+        assert ["t.A", "t.B", 1] in rep["edges"]
+        assert rep["locks"]["t.A"]["acquisitions"] == 1
+        assert rep["inversions"] == []
+    finally:
+        sync.set_lock_sanitizer(None)
+        sync.WATCHER.reset()
+
+
+def test_inversion_detected_online():
+    sync = _fresh_watcher()
+    try:
+        a = sync.named_lock("t.A")
+        b = sync.named_lock("t.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # reverse order: B->A closes the cycle
+                pass
+        rep = sync.WATCHER.report()
+        assert len(rep["inversions"]) == 1
+        inv = rep["inversions"][0]
+        assert {inv["held"], inv["acquired"]} == {"t.A", "t.B"}
+    finally:
+        sync.set_lock_sanitizer(None)
+        sync.WATCHER.reset()
+
+
+def test_condition_wait_releases_in_stack():
+    """While parked in wait() the condition's lock is NOT held: a lock
+    taken by another thread then must not fabricate an edge from the
+    waiter's lock."""
+    import threading
+
+    sync = _fresh_watcher()
+    try:
+        lock = sync.named_lock("t.CondLock")
+        cond = sync.named_condition("t.CondLock", lock)
+        other = sync.named_lock("t.Other")
+        ready = threading.Event()
+
+        def waiter():
+            with cond:
+                ready.set()
+                cond.wait(timeout=5.0)
+
+        t = threading.Thread(target=waiter, name="waiter", daemon=True)
+        t.start()
+        ready.wait(timeout=5.0)
+        with other:
+            pass
+        with cond:
+            cond.notify_all()
+        t.join(timeout=5.0)
+        rep = sync.WATCHER.report()
+        assert ["t.CondLock", "t.Other", 1] not in rep["edges"]
+        assert rep["inversions"] == []
+    finally:
+        sync.set_lock_sanitizer(None)
+        sync.WATCHER.reset()
+
+
+def test_sanitizer_gauges_surface_totals():
+    sync = _fresh_watcher()
+    try:
+        with sync.named_lock("t.G"):
+            pass
+        from presto_tpu.obs import METRICS
+
+        snap = dict(METRICS.snapshot())
+        assert snap["sanitizer.lock_acquisitions"] >= 1
+        assert snap["sanitizer.locks_tracked"] >= 1
+        assert snap["sanitizer.lock_inversions"] == 0
+    finally:
+        sync.set_lock_sanitizer(None)
+        sync.WATCHER.reset()
+
+
+def test_crosscheck_verdicts():
+    static = {"cycles": [["a.L1", "b.L2"], ["c.L3", "d.L4"],
+                         ["e.L5", "f.L6"]]}
+    runtime = {"edges": [["a.L1", "b.L2", 3], ["b.L2", "a.L1", 1],
+                         ["c.L3", "d.L4", 2]],
+               "inversions": []}
+    xc = concurrency.crosscheck(static, runtime)
+    verdicts = {tuple(c["cycle"]): c["verdict"] for c in xc["cycles"]}
+    assert verdicts[("a.L1", "b.L2")] == "confirmed"
+    assert verdicts[("c.L3", "d.L4")] == "refuted"
+    assert verdicts[("e.L5", "f.L6")] == "unobserved"
+
+
+def test_crosscheck_partial_cycle_not_refuted():
+    """2 of 3 arcs observed and the third leg never exercised is one
+    interleaving short of confirmed — it must NOT be dismissed as
+    refuted (the observed prefix trivially orients its own missing
+    arc, so transitive orientation is not refutation evidence)."""
+    static = {"cycles": [["a", "b", "c"]]}
+    partial = {"edges": [["a", "b", 1], ["b", "c", 1]], "inversions": []}
+    xc = concurrency.crosscheck(static, partial)
+    assert xc["cycles"][0]["verdict"] == "unobserved"
+    # every leg exercised, each exactly one way, no close: refuted
+    oriented = {"edges": [["a", "b", 1], ["c", "b", 1], ["a", "c", 1]],
+                "inversions": []}
+    xc = concurrency.crosscheck(static, oriented)
+    assert xc["cycles"][0]["verdict"] == "refuted"
+    # a transitive path closing the cycle confirms it
+    closed = {"edges": [["a", "b", 1], ["b", "c", 1], ["c", "x", 1],
+                        ["x", "a", 1]], "inversions": []}
+    xc = concurrency.crosscheck(static, closed)
+    assert xc["cycles"][0]["verdict"] == "confirmed"
+
+
+def test_find_cycles_keeps_both_orientations():
+    """a->b->c->d->a and a->d->c->b->a are distinct deadlock cycles
+    over the same four locks: node-set dedup would drop one and the
+    cross-check could never confirm the dropped orientation."""
+    edges = {}
+    for a, b in [("a", "b"), ("b", "c"), ("c", "d"), ("d", "a"),
+                 ("a", "d"), ("d", "c"), ("c", "b"), ("b", "a")]:
+        edges[(a, b)] = ("f.py", 1)
+    four = [c for c in concurrency._find_cycles(edges) if len(c) == 4]
+    assert ["a", "b", "c", "d"] in four
+    assert ["a", "d", "c", "b"] in four
+
+
+def test_string_join_is_not_thread_join_evidence(tmp_path):
+    """','.join(cols) and httpd.shutdown() must not satisfy the
+    thread-leak / executor-leak checks — only a join/shutdown on a
+    thread/executor-typed receiver counts."""
+    findings, _ = _analyze(tmp_path, """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def leak(cols, httpd):
+            t = threading.Thread(target=print, name="t")
+            t.start()
+            ex = ThreadPoolExecutor(2)
+            httpd.shutdown()
+            return ", ".join(cols)
+    """)
+    rules = _rules(findings)
+    assert "thread-leak" in rules
+    assert "executor-leak" in rules
+
+
+def test_thread_list_loop_join_is_evidence(tmp_path):
+    """for t in self._threads: t.join() — the annotated thread list
+    types its loop target, so the join counts."""
+    findings, _ = _analyze(tmp_path, """
+        import threading
+        from typing import List
+
+        class Pool:
+            def __init__(self, n):
+                self._threads: List[threading.Thread] = []
+                for i in range(n):
+                    t = threading.Thread(target=print, name=f"w{i}")
+                    t.start()
+                    self._threads.append(t)
+
+            def close(self):
+                for t in self._threads:
+                    t.join()
+    """)
+    assert "thread-leak" not in _rules(findings)
+
+
+def test_instrumented_distributed_smoke():
+    """A real multihost query under the sanitizer: engine locks record
+    acquisitions and the run observes ZERO lock-order inversions — the
+    runtime half of the acceptance criterion (tools/lock_sanitizer.py
+    is the full-workload version)."""
+    sync = _fresh_watcher()
+    try:
+        from presto_tpu.testing import DistributedQueryRunner
+
+        with DistributedQueryRunner(n_workers=2, sf=0.01) as dqr:
+            rows = dqr.execute_multihost(
+                "SELECT l_orderkey, l_extendedprice FROM lineitem "
+                "ORDER BY l_extendedprice DESC, l_orderkey LIMIT 20")
+        assert len(rows) == 20
+        rep = sync.WATCHER.report()
+        assert rep["inversions"] == [], rep["inversions"]
+        # the threaded tier actually ran instrumented
+        assert "buffers.TaskOutputBuffer._lock" in rep["locks"]
+        total = sum(s["acquisitions"] for s in rep["locks"].values())
+        assert total > 50
+    finally:
+        sync.set_lock_sanitizer(None)
+        sync.WATCHER.reset()

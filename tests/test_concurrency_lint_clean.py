@@ -1,0 +1,52 @@
+"""The repo-wide concurrency-lint pin (tier-1), mirroring
+test_engine_lint.py::test_repo_lint_clean: the concurrency sanitizer's
+static detectors run over the whole engine + tools with the shared
+suppression file applied, and HEAD stays at zero findings.  A
+regression here names its file:line — fix it, or add a JUSTIFIED entry
+to tools/lint_suppressions.txt."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import engine_lint  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_repo_concurrency_lint_clean():
+    findings, _report = engine_lint.lint_concurrency(
+        [os.path.join(REPO, "presto_tpu"), os.path.join(REPO, "tools")])
+    entries, problems = engine_lint.load_suppressions(
+        engine_lint.DEFAULT_SUPPRESSIONS)
+    assert problems == [], "\n".join(str(p) for p in problems)
+    findings = engine_lint.apply_suppressions(findings, entries)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_no_statically_possible_deadlock_cycles():
+    """The whole-repo lock graph is acyclic: the strongest static
+    guarantee the sanitizer offers.  If this fails, run
+    ``python tools/lock_sanitizer.py`` to see whether the runtime
+    confirms or refutes the new cycle — then break it either way."""
+    sys.path.insert(0, REPO)
+    from presto_tpu.analysis import concurrency
+
+    _findings, report = concurrency.analyze(
+        [os.path.join(REPO, "presto_tpu")])
+    assert report["cycles"] == [], report["cycles"]
+
+
+def test_suppression_file_entries_all_still_match():
+    """Every suppression entry must still cover a live finding or at
+    least name an existing file — dead entries rot the contract.  (We
+    check file existence, not finding liveness: inline fixes may
+    legitimately leave file-level entries for near-identical lines.)"""
+    entries, _ = engine_lint.load_suppressions(
+        engine_lint.DEFAULT_SUPPRESSIONS)
+    assert entries, "suppression file missing or empty"
+    for e in entries:
+        assert os.path.exists(os.path.join(REPO, e.path)), \
+            f"suppression names a missing file: {e.path}"
+        assert e.reason.strip(), f"empty justification: {e}"

@@ -405,8 +405,14 @@ def test_metric_catalog_discovered_from_repo():
 def test_repo_lint_clean():
     """``tools/engine_lint.py --check presto_tpu tools`` exits 0 on
     HEAD — the ISSUE 2 acceptance pin (ISSUE 4 widened it to the tools
-    themselves).  A finding here names its file:line; fix it or (with a
-    reviewed reason) append ``# lint: allow(rule)``."""
+    themselves; ISSUE 8 moved reviewed exceptions into the shared
+    suppression file).  A finding here names its file:line; fix it, or
+    add a justified entry to tools/lint_suppressions.txt (inline
+    ``# lint: allow(rule)`` stays available for line-local cases)."""
     findings = engine_lint.lint_paths([os.path.join(REPO, "presto_tpu"),
                                        os.path.join(REPO, "tools")])
+    entries, problems = engine_lint.load_suppressions(
+        engine_lint.DEFAULT_SUPPRESSIONS)
+    assert problems == [], "\n".join(str(p) for p in problems)
+    findings = engine_lint.apply_suppressions(findings, entries)
     assert findings == [], "\n".join(str(f) for f in findings)

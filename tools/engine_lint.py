@@ -71,26 +71,69 @@ thread-pool         ``ThreadPoolExecutor`` without a ``max_workers``
                     the host, and an unbounded one is a fork bomb under
                     concurrent queries.
 
-Suppression: append ``# lint: allow(<rule>)`` to the offending line
-(comma-separate multiple rules; ``# metrics: allow`` for the
-metric-catalog rule).  Allow-listed helper shapes (resolve-once
-functions, ``__init__`` constructors, module scope) are exempt from
-``env-read`` automatically.
+Concurrency check
+-----------------
+The same entry point also runs the concurrency sanitizer's static
+detectors (``presto_tpu/analysis/concurrency.py`` — whole-repo
+lock-order cycles, blocking-in-lock, untimed waits, shared-state
+races, thread/executor/queue/server lifecycle, unnamed threads; see
+its docstring for the catalog).  ``--rule`` filters apply across both
+checks; ``--skip-concurrency`` / ``--only-concurrency`` select one.
+
+Suppression
+-----------
+Two mechanisms share one contract — every suppression carries a
+justification:
+
+- inline: append ``# lint: allow(<rule>)`` to the offending line
+  (comma-separate multiple rules; ``# metrics: allow`` for the
+  metric-catalog rule) — for fixtures and truly line-local exceptions;
+- the shared suppression file (``tools/lint_suppressions.txt``,
+  ``--suppressions`` overrides): one ``path | rule | line-substring |
+  justification`` entry per reviewed exception, matched on path
+  suffix + rule + source-line content so entries survive line drift.
+  A malformed or justification-less entry is itself a finding.
+
+Allow-listed helper shapes (resolve-once functions, ``__init__``
+constructors, module scope) are exempt from ``env-read``
+automatically.
+
+Exit codes (``--check``): 0 clean; bit 1 set = engine anti-pattern
+findings; bit 2 set = concurrency findings (so 1, 2, or 3).
 
 Usage::
 
-    python tools/engine_lint.py --check presto_tpu   # exit 1 on findings
-    python tools/engine_lint.py presto_tpu/exec/local.py
+    python tools/engine_lint.py --check presto_tpu tools  # CI mode
+    python tools/engine_lint.py --json presto_tpu/exec/local.py
+    python tools/engine_lint.py --rule lock-order --check presto_tpu
 """
 
 from __future__ import annotations
 
 import argparse
 import ast
+import json
 import os
 import re
 import sys
 from typing import Dict, Iterator, List, NamedTuple, Optional, Set, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_concurrency():
+    """Import the concurrency analyzer (dependency-free stdlib-ast
+    module) without requiring presto_tpu to be importable as a whole —
+    the linter must run on machines without jax."""
+    import importlib.util
+
+    path = os.path.join(_REPO_ROOT, "presto_tpu", "analysis",
+                        "concurrency.py")
+    spec = importlib.util.spec_from_file_location(
+        "presto_tpu_concurrency_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 class Finding(NamedTuple):
@@ -526,6 +569,108 @@ ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
              "wallclock", "metric-catalog", "thread-pool",
              "naked-urlopen"}
 
+#: the concurrency sanitizer's detector names (the second check); kept
+#: in sync with analysis/concurrency.CONCURRENCY_RULES by the tests
+CONCURRENCY_RULES = {
+    "lock-order", "blocking-in-lock", "untimed-wait", "shared-state-race",
+    "thread-leak", "executor-leak", "unbounded-queue", "unnamed-thread",
+    "server-leak",
+}
+
+DEFAULT_SUPPRESSIONS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "lint_suppressions.txt")
+
+
+class Suppression(NamedTuple):
+    path: str     # path suffix (repo-relative, / separators)
+    rule: str
+    match: str    # substring the finding's source line must contain
+    reason: str   # mandatory justification
+
+    def covers(self, finding: "Finding", line_text: str) -> bool:
+        norm = finding.path.replace(os.sep, "/")
+        return (norm.endswith(self.path) and finding.rule == self.rule
+                and (not self.match or self.match in line_text))
+
+
+def load_suppressions(path: str) -> Tuple[List[Suppression], List[Finding]]:
+    """Parse the shared suppression file.  Format (one per line)::
+
+        path | rule | line-substring | justification
+
+    ``#`` comments and blank lines are skipped.  A malformed entry or
+    an empty justification is returned as a finding against the file
+    itself — an unexplained suppression is a defect."""
+    entries: List[Suppression] = []
+    problems: List[Finding] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read().splitlines()
+    except OSError:
+        return entries, problems
+    for i, line in enumerate(raw, 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        parts = [p.strip() for p in stripped.split("|")]
+        if len(parts) != 4 or not all(parts[:2]) or not parts[3]:
+            problems.append(Finding(
+                path, i, "suppression-format",
+                "suppression entries are `path | rule | line-substring"
+                " | justification` with a non-empty justification"))
+            continue
+        entries.append(Suppression(parts[0].replace(os.sep, "/"),
+                                   parts[1], parts[2], parts[3]))
+    return entries, problems
+
+
+def _cached_lines(path: str, cache: Dict[str, List[str]]) -> List[str]:
+    """Source lines of ``path``, read once per lint run (shared by the
+    suppression matcher and the concurrency adapter so encoding/error
+    behavior cannot diverge between them)."""
+    lines = cache.get(path)
+    if lines is None:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            lines = []
+        cache[path] = lines
+    return lines
+
+
+def apply_suppressions(findings: List[Finding],
+                       entries: List[Suppression]) -> List[Finding]:
+    if not entries:
+        return findings
+    out: List[Finding] = []
+    line_cache: Dict[str, List[str]] = {}
+    for f in findings:
+        lines = _cached_lines(f.path, line_cache)
+        text = lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+        if not any(s.covers(f, text) for s in entries):
+            out.append(f)
+    return out
+
+
+def lint_concurrency(paths, rules: Optional[Set[str]] = None) \
+        -> Tuple[List[Finding], dict]:
+    """Run the whole-repo concurrency sanitizer and adapt its findings
+    to this linter's Finding type (inline ``# lint: allow`` comments
+    honored the same way)."""
+    conc = _load_concurrency()
+    raw, report = conc.analyze(paths)
+    findings: List[Finding] = []
+    line_cache: Dict[str, List[str]] = {}
+    for f in raw:
+        if rules is not None and f.rule not in rules:
+            continue
+        lines = _cached_lines(f.path, line_cache)
+        if _suppressed(lines, f.line, f.rule):
+            continue
+        findings.append(Finding(f.path, f.line, f.rule, f.message))
+    return findings, report
+
 #: sentinel: discover the catalog by walking up from the linted file
 _AUTO = object()
 
@@ -568,20 +713,61 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+", help="files or directories")
     ap.add_argument("--check", action="store_true",
-                    help="exit 1 when any finding remains (CI mode)")
+                    help="CI mode: nonzero exit on findings (bit 1 = "
+                         "engine anti-patterns, bit 2 = concurrency)")
     ap.add_argument("--rule", action="append", default=None,
-                    help="restrict to specific rule(s)")
+                    help="restrict to specific rule(s), either check")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--skip-concurrency", action="store_true",
+                    help="run only the engine anti-pattern check")
+    ap.add_argument("--only-concurrency", action="store_true",
+                    help="run only the concurrency sanitizer check")
+    ap.add_argument("--suppressions", default=DEFAULT_SUPPRESSIONS,
+                    help="shared suppression file (path | rule | "
+                         "line-substring | justification)")
     args = ap.parse_args(argv)
-    rules = set(args.rule) if args.rule else ALL_RULES
-    unknown = rules - ALL_RULES
+    known = ALL_RULES | CONCURRENCY_RULES
+    rules = set(args.rule) if args.rule else known
+    unknown = rules - known
     if unknown:
         ap.error(f"unknown rule(s): {sorted(unknown)} "
-                 f"(known: {sorted(ALL_RULES)})")
-    findings = lint_paths(args.paths, rules)
-    for f in findings:
-        print(f)
-    print(f"{len(findings)} finding(s)", file=sys.stderr)
-    return 1 if (args.check and findings) else 0
+                 f"(known: {sorted(known)})")
+    run_engine = not args.only_concurrency and bool(rules & ALL_RULES)
+    run_conc = not args.skip_concurrency and bool(rules & CONCURRENCY_RULES)
+
+    engine_findings: List[Finding] = []
+    conc_findings: List[Finding] = []
+    if run_engine:
+        engine_findings = lint_paths(args.paths, rules & ALL_RULES)
+    if run_conc:
+        conc_findings, _report = lint_concurrency(
+            args.paths, rules & CONCURRENCY_RULES)
+
+    entries, problems = load_suppressions(args.suppressions)
+    engine_findings = apply_suppressions(engine_findings, entries)
+    conc_findings = apply_suppressions(conc_findings, entries) + problems
+
+    if args.as_json:
+        print(json.dumps([
+            {"path": f.path, "line": f.line, "rule": f.rule,
+             "check": ("concurrency" if f.rule in CONCURRENCY_RULES
+                       or f.rule == "suppression-format" else "engine"),
+             "message": f.message}
+            for f in engine_findings + conc_findings], indent=2))
+    else:
+        for f in engine_findings + conc_findings:
+            print(f)
+    print(f"{len(engine_findings)} engine + {len(conc_findings)} "
+          "concurrency finding(s)", file=sys.stderr)
+    if not args.check:
+        return 0
+    rc = 0
+    if engine_findings:
+        rc |= 1
+    if conc_findings:
+        rc |= 2
+    return rc
 
 
 if __name__ == "__main__":

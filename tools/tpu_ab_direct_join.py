@@ -29,7 +29,7 @@ OUT = os.path.join(HERE, "TPU_AB.json")
 def _child(direct: str) -> dict:
     # one-shot child process: env IS the experiment arm, resolved
     # once at child startup (resolve-once in spirit)
-    if os.environ.get("JAX_PLATFORMS") == "cpu":  # lint: allow(env-read)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
         # jax may be pre-imported at interpreter startup (axon plugin);
         # jax.config still works until the backend initializes
         import jax
@@ -55,11 +55,11 @@ def _child(direct: str) -> dict:
     # the env vars are resolved once per process now; set the explicit
     # overrides too so a leg flip can never be lost to caching order
     set_direct_join_override(
-        os.environ.get("PRESTO_TPU_DIRECT_JOIN") == "1")  # lint: allow(env-read)
+        os.environ.get("PRESTO_TPU_DIRECT_JOIN") == "1")
     set_unique_direct_override(
-        os.environ.get("PRESTO_TPU_UNIQUE_DIRECT") == "1")  # lint: allow(env-read)
+        os.environ.get("PRESTO_TPU_UNIQUE_DIRECT") == "1")
 
-    sf = float(os.environ.get("BENCH_SF", "1.0"))  # lint: allow(env-read)
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
     platform = jax.devices()[0].platform
     tpch = Tpch(sf=sf, split_rows=1 << 23)
     mem = MemoryConnector()
