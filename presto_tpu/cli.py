@@ -59,9 +59,13 @@ def format_output(names, rows, fmt: str) -> str:
 
 def _progress_text(stats: dict) -> str:
     """One-line render of statement-protocol progress stats (the
-    reference CLI's status bar): percentage + the busiest stage."""
-    pct = stats.get("progressPercentage")
+    reference CLI's status bar): queue position while waiting for
+    admission, then percentage + the busiest stage."""
     parts = []
+    if stats.get("state") == "QUEUED":
+        pos = stats.get("queuePosition")
+        parts.append(f"queued #{pos}" if pos is not None else "queued")
+    pct = stats.get("progressPercentage")
     if pct is not None:
         parts.append(f"{pct:5.1f}%")
     stages = stats.get("stages") or []

@@ -46,7 +46,7 @@ class StatementClient:
         if on_progress is not None and page.get("stats"):
             on_progress(page["stats"])
         if page.get("error"):
-            raise RuntimeError(page["error"])
+            raise RuntimeError(self._error_text(page))
         columns = page.get("columns") or []
         rows = [tuple(r) for r in page.get("data", [])]
         while page.get("nextUri"):
@@ -56,11 +56,18 @@ class StatementClient:
             if on_progress is not None and page.get("stats"):
                 on_progress(page["stats"])
             if page.get("error"):
-                raise RuntimeError(page["error"])
+                raise RuntimeError(self._error_text(page))
             if not columns and page.get("columns"):
                 columns = page["columns"]  # set once the query finishes
             rows.extend(tuple(r) for r in page.get("data", []))
         return columns, rows
+
+    @staticmethod
+    def _error_text(page: dict) -> str:
+        """Statement error with its policy code when one is present
+        (QUERY_QUEUE_FULL / EXCEEDED_QUEUE_TIME / EXCEEDED_TIME_LIMIT)."""
+        code = page.get("errorCode")
+        return f"[{code}] {page['error']}" if code else str(page["error"])
 
     def server_info(self) -> dict:
         with urllib.request.urlopen(f"{self.server_uri}/v1/info",

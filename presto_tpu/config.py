@@ -31,6 +31,17 @@ Recognized keys (the engine's subset of the reference's config space):
                               coordinator heartbeats, polls and
                               schedules (failure detector, cluster
                               memory manager, system tables)
+  query.result-cache-enabled  serve repeated read-only queries from the
+                              structural result cache (docs/serving.md)
+  query.result-cache-bytes    byte budget for that cache (0 = default)
+  query.subplan-cache-enabled reuse warm stage intermediates at
+                              exchange boundaries (docs/serving.md)
+  query.admission-memory-fraction
+                              dispatch only while pool reserved +
+                              projected bytes <= fraction * limit
+  query.admission-reserve-bytes
+                              memory projection for statements with no
+                              observed peak history
   task.buffer-bytes           worker output-buffer cap
   session.<property>          default for any system session property
 
@@ -218,7 +229,41 @@ class EngineConfig:
         v = self.props.get("query.task-prefetch")
         if v is not None and "task_prefetch" not in props:
             props["task_prefetch"] = v
+        # query.result-cache-enabled / query.subplan-cache-enabled:
+        # serving-tier cache defaults (docs/serving.md; sugar for
+        # session.result_cache_enabled / session.subplan_cache_enabled)
+        v = self.props.get("query.result-cache-enabled")
+        if v is not None and "result_cache_enabled" not in props:
+            props["result_cache_enabled"] = v
+        v = self.props.get("query.subplan-cache-enabled")
+        if v is not None and "subplan_cache_enabled" not in props:
+            props["subplan_cache_enabled"] = v
         return Session(properties=props)
+
+    # -- serving tier (admission + caches; docs/serving.md) -----------------
+    def result_cache_bytes(self, default: int = 0) -> int:
+        """``query.result-cache-bytes``: byte budget for the structural
+        result cache (0 = the process default, 64 MiB or
+        PRESTO_TPU_RESULT_CACHE_BYTES)."""
+        return self.int("query.result-cache-bytes", default)
+
+    def admission_memory_fraction(self, default: float = 0.9) -> float:
+        """``query.admission-memory-fraction``: a query dispatches only
+        while pool reserved + its projected bytes stay under this
+        fraction of the pool limit (<= 0 disables the memory gate)."""
+        v = self.props.get("query.admission-memory-fraction")
+        if v is None:
+            return default
+        try:
+            return float(v)
+        except ValueError:
+            return default
+
+    def admission_reserve_bytes(self, default: int = 0) -> int:
+        """``query.admission-reserve-bytes``: the memory projection for
+        a statement with no observed history (0 = admit on the
+        fraction gate alone)."""
+        return self.int("query.admission-reserve-bytes", default)
 
 
 _BUILTIN_CONNECTORS = ("tpch", "tpcds", "memory", "blackhole", "jdbc",

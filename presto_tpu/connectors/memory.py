@@ -24,6 +24,27 @@ class MemoryConnector:
         self._sort: Dict[str, Optional[List[str]]] = {}
         self._bucketing: Dict[str, Optional[tuple]] = {}
         self._dicts: Dict[str, Dict[str, object]] = {}
+        # monotonically increasing per-table data version, bumped by
+        # EVERY mutation (CTAS/INSERT/DELETE-rewrite/DDL) — the serving
+        # tier's cache-invalidation token (serving/cache.py); one shared
+        # counter so a drop+recreate can never repeat an old number,
+        # paired with a per-INSTANCE token so two connectors holding
+        # same-named, same-shaped tables with different data can never
+        # alias each other's cache entries
+        import uuid as _uuid
+
+        self._instance_id = _uuid.uuid4().hex[:12]
+        self._versions: Dict[str, int] = {}
+        self._version_seq = 0
+
+    def _bump_version(self, name: str) -> None:
+        self._version_seq += 1
+        self._versions[name] = self._version_seq
+
+    def table_version(self, name: str):
+        """Current data version: (instance token, counter); the counter
+        is 0 until the first write through this connector instance."""
+        return (self._instance_id, self._versions.get(name, 0))
 
     # -- loading ------------------------------------------------------------
     def create_table(
@@ -47,14 +68,17 @@ class MemoryConnector:
             for (col, t), b in zip(schema, page.blocks):
                 if t.is_string:
                     self._dicts[name][col] = b.dictionary
+        self._bump_version(name)
 
     def append_pages(self, name: str, pages: Sequence[Page]) -> None:
         self._tables[name].extend(_to_device(p) for p in pages)
+        self._bump_version(name)
 
     def drop_table(self, name: str) -> None:
         for d in (self._tables, self._schemas, self._domains, self._pks,
                   self._sort, self._bucketing, self._dicts):
             d.pop(name, None)
+        self._bump_version(name)
 
     def add_column(self, name: str, column: str, ctype: Type) -> None:
         """ALTER TABLE ADD COLUMN: existing rows read NULL in the new
@@ -82,6 +106,7 @@ class MemoryConnector:
                         ctype, dic)
             new_pages.append(Page(tuple(p.blocks) + (blk,), p.row_mask))
         self._tables[name] = new_pages
+        self._bump_version(name)
 
     def drop_column(self, name: str, column: str) -> None:
         idxs = [i for i, (c, _) in enumerate(self._schemas[name])
@@ -105,6 +130,7 @@ class MemoryConnector:
         bk = self._bucketing.get(name)
         if bk is not None and column in bk[0]:
             self._bucketing[name] = None
+        self._bump_version(name)
 
     def rename_table(self, name: str, new_name: str) -> None:
         if new_name in self._tables:
@@ -113,6 +139,8 @@ class MemoryConnector:
                   self._sort, self._bucketing, self._dicts):
             if name in d:
                 d[new_name] = d.pop(name)
+        self._bump_version(name)
+        self._bump_version(new_name)
 
     def load_from(self, conn, table: str, name: Optional[str] = None,
                   columns: Optional[List[str]] = None) -> None:

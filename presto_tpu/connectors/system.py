@@ -98,6 +98,10 @@ class SystemConnector:
             # compile_ms is NULL when the query did not trace)
             ("planning_ms", DOUBLE), ("compile_ms", DOUBLE),
             ("execution_ms", DOUBLE),
+            # serving tier: 1 when the result came from the structural
+            # result cache, 0 when executed, NULL where the cache does
+            # not apply (writes, DDL, uncacheable plans)
+            ("cache_hit", BIGINT),
         ],
         "system_runtime_nodes": [
             ("node_id", VARCHAR), ("state", VARCHAR),
@@ -221,6 +225,8 @@ class SystemConnector:
                 [getattr(e, "planning_ms", None) for e in evs],
                 [getattr(e, "compile_ms", None) for e in evs],
                 [getattr(e, "execution_ms", None) for e in evs],
+                [None if getattr(e, "cache_hit", None) is None
+                 else int(e.cache_hit) for e in evs],
             ]
         elif table == "system_runtime_tasks":
             ts = self.tasks.entries()

@@ -316,6 +316,11 @@ class Tpcds:
     def num_splits(self, table: str) -> int:
         return max(1, -(-self.row_count(table) // self.split_rows))
 
+    def table_version(self, table: str) -> int:
+        """Generated data is immutable: a constant version marks every
+        table cacheable forever (serving-tier result/subplan caches)."""
+        return 0
+
     def max_split_rows(self, table: str) -> int:
         return min(self.split_rows, max(self.row_count(table), 1))
 

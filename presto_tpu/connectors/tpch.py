@@ -417,6 +417,11 @@ class Tpch:
             return max(1, -(-self.n_orders // per))
         return max(1, -(-self.row_count(table) // self.split_rows))
 
+    def table_version(self, table: str) -> int:
+        """Generated data is immutable: a constant version marks every
+        table cacheable forever (serving-tier result/subplan caches)."""
+        return 0
+
     def _per(self, table: str) -> int:
         """Orders per split for the order-range-partitioned tables."""
         if table == "lineitem" and not self.aligned_buckets:

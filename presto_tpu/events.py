@@ -49,6 +49,37 @@ class QueryCompletedEvent:
     planning_ms: Optional[float] = None
     compile_ms: Optional[float] = None
     execution_ms: Optional[float] = None
+    # serving tier (serving/cache.py): True when the result was served
+    # from the structural result cache without executing; None for
+    # statements the cache does not apply to (writes, DDL)
+    cache_hit: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class QueryQueuedEvent:
+    """The admission controller queued a query (serving/admission.py) —
+    the group it landed in and its live queue position, so the query
+    log shows WHERE each query waited, not just that it was slow."""
+
+    query_id: str
+    user: str
+    group: Optional[str]
+    position: Optional[int]
+    queue_time: float  # epoch seconds (event timestamp)
+
+
+@dataclasses.dataclass
+class QueryAdmittedEvent:
+    """The admission controller dispatched a queued query: how long it
+    waited and the memory projection it was admitted under — together
+    with QueryQueuedEvent this reconstructs every admission decision
+    from the log alone."""
+
+    query_id: str
+    group: Optional[str]
+    queued_ms: float
+    projected_bytes: int
+    admit_time: float  # epoch seconds (event timestamp)
 
 
 @dataclasses.dataclass
@@ -119,6 +150,13 @@ class EventListener:
             self, event: WorkerStateChangeEvent) -> None:  # pragma: no cover
         pass
 
+    def query_queued(self, event: QueryQueuedEvent) -> None:  # pragma: no cover
+        pass
+
+    def query_admitted(
+            self, event: QueryAdmittedEvent) -> None:  # pragma: no cover
+        pass
+
 
 class EventListenerManager:
     def __init__(self):
@@ -146,6 +184,14 @@ class EventListenerManager:
     def worker_state_changed(self, event: WorkerStateChangeEvent) -> None:
         for l in self._listeners:
             l.worker_state_changed(event)
+
+    def query_queued(self, event: QueryQueuedEvent) -> None:
+        for l in self._listeners:
+            l.query_queued(event)
+
+    def query_admitted(self, event: QueryAdmittedEvent) -> None:
+        for l in self._listeners:
+            l.query_admitted(event)
 
 
 def new_query_id() -> str:

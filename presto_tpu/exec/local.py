@@ -534,10 +534,15 @@ class LocalRunner:
         self.stats: Optional[QueryStats] = None
         # HBM accounting (memory/MemoryPool.java analog); None = untracked
         self.memory_pool = memory_pool
-        self.last_peak_bytes = 0
-        # site -> peak bytes of the last completed query (EXPLAIN
-        # ANALYZE's per-operator memory source)
-        self.last_site_peaks: Dict[str, int] = {}
+        # per-THREAD last-query peaks (properties below): concurrent
+        # queries on one runner must not swap memory footprints — the
+        # coordinator records last_peak_bytes into the admission
+        # projection history, and a cross-query swap would make a light
+        # statement inherit a heavy one's 8GB projection (and vice
+        # versa, defeating the memory gate)
+        import threading as _threading
+
+        self._peaks_tls = _threading.local()
         # host-RAM spill fan-out when state exceeds the pool/threshold
         self.spill_partitions = spill_partitions
         # multi-producer ORDER BY: per-page sorts + order-preserving
@@ -652,6 +657,29 @@ class LocalRunner:
             got = {}
             self._builds_tls.builds = got
         return got
+
+    @property
+    def last_peak_bytes(self) -> int:
+        """Peak reserved bytes of the last query completed ON THIS
+        THREAD (EXPLAIN headers and the coordinator's admission
+        projection both read the footprint of the query they just
+        ran, never a concurrent one's)."""
+        return getattr(self._peaks_tls, "peak", 0)
+
+    @last_peak_bytes.setter
+    def last_peak_bytes(self, value: int) -> None:
+        self._peaks_tls.peak = value
+
+    @property
+    def last_site_peaks(self) -> Dict[str, int]:
+        """Per-site peaks of the last query completed on this thread
+        (EXPLAIN ANALYZE's per-operator memory source)."""
+        got = getattr(self._peaks_tls, "sites", None)
+        return got if got is not None else {}
+
+    @last_site_peaks.setter
+    def last_site_peaks(self, value: Dict[str, int]) -> None:
+        self._peaks_tls.sites = value
 
     @property
     def _mem(self):

@@ -45,6 +45,11 @@ def build_from_etc(etc_dir: str, port: int = 0):
 
     arm_from_env()
     port = port or cfg.int("http-server.http.port", 0)
+    # serving-tier cache budget (query.result-cache-bytes overrides
+    # the PRESTO_TPU_RESULT_CACHE_BYTES / 64 MiB process default)
+    from presto_tpu.serving.cache import set_result_cache_bytes
+
+    set_result_cache_bytes(cfg.result_cache_bytes(0))
     if cfg.bool("coordinator", True):
         from presto_tpu.server.coordinator import CoordinatorServer
 
@@ -66,7 +71,11 @@ def build_from_etc(etc_dir: str, port: int = 0):
             # deadline plane (docs/fault-tolerance.md; the deadline is
             # opt-in, the queue bound replaces the hard-coded 600s)
             max_execution_time=cfg.max_execution_time(),
-            max_queued_time=cfg.max_queued_time())
+            max_queued_time=cfg.max_queued_time(),
+            # serving-tier admission knobs (docs/serving.md): memory
+            # gate fraction + default projection for unseen statements
+            admission_memory_fraction=cfg.admission_memory_fraction(),
+            admission_reserve_bytes=cfg.admission_reserve_bytes())
         role = "coordinator"
     else:
         from presto_tpu.memory import default_memory_pool

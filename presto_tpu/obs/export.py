@@ -24,8 +24,8 @@ from typing import Any, Dict, Optional
 from presto_tpu.sync import named_lock
 
 from presto_tpu.events import (
-    EventListener, MemoryKillEvent, QueryCompletedEvent, QueryKilledEvent,
-    WorkerStateChangeEvent,
+    EventListener, MemoryKillEvent, QueryAdmittedEvent, QueryCompletedEvent,
+    QueryKilledEvent, QueryQueuedEvent, WorkerStateChangeEvent,
 )
 from presto_tpu.obs.trace import Tracer
 
@@ -157,7 +157,8 @@ class QueryLogListener(EventListener):
             "sql": e.sql,
         }
         for k in ("error", "trace_token", "dist_stages", "dist_fallback",
-                  "planning_ms", "compile_ms", "execution_ms"):
+                  "planning_ms", "compile_ms", "execution_ms",
+                  "cache_hit"):
             v = getattr(e, k, None)
             if v is not None:
                 rec[k] = v
@@ -192,6 +193,30 @@ class QueryLogListener(EventListener):
             "limit_s": e.limit_s,
             "elapsed_s": e.elapsed_s,
             "kill_time": e.kill_time,
+        })
+
+    def query_queued(self, e: QueryQueuedEvent) -> None:
+        """One ``"event": "query_queued"`` line per admission-queue
+        entry (serving tier): group + live position at enqueue time."""
+        self._append({
+            "event": "query_queued",
+            "query_id": e.query_id,
+            "user": e.user,
+            "group": e.group,
+            "position": e.position,
+            "queue_time": e.queue_time,
+        })
+
+    def query_admitted(self, e: QueryAdmittedEvent) -> None:
+        """One ``"event": "query_admitted"`` line per dispatch: queue
+        wait and the memory projection the admission was made under."""
+        self._append({
+            "event": "query_admitted",
+            "query_id": e.query_id,
+            "group": e.group,
+            "queued_ms": e.queued_ms,
+            "projected_bytes": e.projected_bytes,
+            "admit_time": e.admit_time,
         })
 
     def worker_state_changed(self, e: WorkerStateChangeEvent) -> None:

@@ -253,6 +253,23 @@ def _preregister(reg: MetricsRegistry) -> None:
         "query.killed_deadline",
         # deterministic fault-injection harness firings
         "fault.injections_total",
+        # serving tier: admission plane (serving/admission.py) — queue
+        # entries/exits, rejections by reason, and time spent blocked
+        # on memory headroom (distinct from concurrency queueing)
+        "admission.queued_total", "admission.admitted_total",
+        "admission.rejected_queue_full", "admission.rejected_timeout",
+        "admission.memory_blocked_total",
+        "admission.memory_stall_seconds_total",
+        # serving tier: structural result cache (final rows of
+        # read-only queries, keyed by plan signature, invalidated by
+        # table versions) and the subplan (stage-intermediate) cache
+        # at exchange boundaries (serving/cache.py)
+        "cache.result_hits", "cache.result_misses",
+        "cache.result_stores", "cache.result_evictions",
+        "cache.result_invalidations", "cache.result_oversize",
+        "cache.subplan_hits", "cache.subplan_misses",
+        "cache.subplan_stores", "cache.subplan_evictions",
+        "cache.subplan_invalidations", "cache.subplan_oversize",
     ):
         reg.counter(name)
     for name in (
@@ -279,9 +296,17 @@ def _preregister(reg: MetricsRegistry) -> None:
         "sanitizer.lock_acquisitions", "sanitizer.lock_wait_seconds",
         "sanitizer.lock_hold_seconds", "sanitizer.lock_inversions",
         "sanitizer.locks_tracked", "sanitizer.edges_observed",
+        # serving tier: live admission queue depth / admitted-and-held
+        # tickets (serving/admission.py wires the sampling callbacks)
+        # and cache occupancy (serving/cache.py publishes on mutation)
+        "admission.queue_depth", "admission.running",
+        "cache.result_bytes", "cache.result_entries",
+        "cache.subplan_bytes", "cache.subplan_entries",
     ):
         reg.gauge(name)
-    for name in ("query.execution_ms", "xla.compile_ms"):
+    for name in ("query.execution_ms", "xla.compile_ms",
+                 # admission queue-wait distribution (serving tier)
+                 "admission.queue_wait_ms"):
         reg.histogram(name)
 
 

@@ -263,6 +263,10 @@ class DistributedRunner:
         self.exchange_streaming = exchange_streaming_default()
         self.exchange_buffer_bytes = exchange_buffer_bytes_default()
         self.merge_fanin = 8  # sorted runs merged per consumer batch
+        # serving tier: reuse warm stage intermediates at exchange
+        # boundaries when signature + table versions match
+        # (serving/cache.py; subplan_cache_enabled session property)
+        self.subplan_cache_enabled = False
         if session is not None:
             self.join_distribution_type = session.get("join_distribution_type")
             self.allow_colocated = bool(session.get("colocated_join"))
@@ -273,6 +277,8 @@ class DistributedRunner:
             if eb > 0:
                 self.exchange_buffer_bytes = eb
             self.merge_fanin = max(2, int(session.get("exchange_merge_fanin")))
+            self.subplan_cache_enabled = bool(
+                session.get("subplan_cache_enabled"))
         # morsel-scheduler knobs flow into the mesh tier too: the local
         # fallback runner schedules its scan splits, and the wave loops
         # prefetch the next wave's host assembly while the device mesh
@@ -370,13 +376,14 @@ class DistributedRunner:
             return page
 
         def run_agg(node: AggregationNode) -> PrecomputedNode:
-            page = _staged("dist:aggregation",
-                           lambda: self.run_aggregation_stage(node))
+            page = _staged("dist:aggregation", lambda: self._cached_stage(
+                "agg", node, lambda: self.run_aggregation_stage(node)))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_chain(node: PlanNode, bound=None) -> PrecomputedNode:
-            page = _staged("dist:chain",
-                           lambda: self.run_chain_stage(node, bound))
+            page = _staged("dist:chain", lambda: self._cached_stage(
+                "chain", node, lambda: self.run_chain_stage(node, bound),
+                bound=bound))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def eval_glue(node: PlanNode) -> PrecomputedNode:
@@ -384,12 +391,13 @@ class DistributedRunner:
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_window(node) -> PrecomputedNode:
-            page = _staged("dist:window",
-                           lambda: self.run_window_stage(node))
+            page = _staged("dist:window", lambda: self._cached_stage(
+                "window", node, lambda: self.run_window_stage(node)))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_sort(node) -> PrecomputedNode:
-            page = _staged("dist:sort", lambda: self.run_sort_stage(node))
+            page = _staged("dist:sort", lambda: self._cached_stage(
+                "sort", node, lambda: self.run_sort_stage(node)))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_union(node) -> PrecomputedNode:
@@ -423,6 +431,48 @@ class DistributedRunner:
                 set_child(parent, slot, old)
 
     # ------------------------------------------------------------------
+    def _cached_stage(self, kind: str, node: PlanNode, thunk,
+                      bound=None) -> Page:
+        """Subplan (fragment) cache at the exchange boundary: when the
+        stage rooted at ``node`` is cacheable (deterministic, leaves
+        are versioned base-table scans) and its structural signature +
+        table versions match a prior execution, the warm intermediate
+        page is reused instead of re-executing the stage — the shared
+        scan->filter->agg prefix of dashboard variants.  The mesh width
+        and any consumer shard bound fold into the key (they shape the
+        materialized page)."""
+        if not self.subplan_cache_enabled:
+            return thunk()
+        from presto_tpu.exec.programs import ir_signature
+        from presto_tpu.serving.cache import (
+            default_subplan_cache, signature_has_identity_keys,
+        )
+
+        extra = [kind, self.n]
+        if bound is not None:
+            # the bound's SHALLOW shape only (count + sort spec) — its
+            # source is the stage subtree prepare() signs anyway, and
+            # re-walking it here would sign the whole plan twice
+            bkey = (type(bound).__name__, bound.count,
+                    ir_signature(tuple(getattr(bound, "sort_exprs", ())
+                                       or ())),
+                    ir_signature(tuple(getattr(bound, "ascending", ())
+                                       or ())),
+                    ir_signature(tuple(getattr(bound, "nulls_first", ())
+                                       or ())))
+            if signature_has_identity_keys(bkey):
+                return thunk()
+            extra += [bkey]
+        cache = default_subplan_cache()
+        prepared = cache.prepare(node, self.catalog, extra=tuple(extra))
+        if prepared is not None:
+            page = cache.lookup(prepared)
+            if page is not None:
+                return page
+        page = thunk()
+        cache.store(prepared, page)
+        return page
+
     def run_chain_stage(self, chain_root: PlanNode, bound=None) -> Page:
         """Wave-execute a pure streaming chain over the mesh and gather
         its rows — a SOURCE fragment whose consumer is the coordinator

@@ -193,16 +193,27 @@ class QueryRunner:
                 QueryCreatedEvent(qid, sql, self.session.user, t0, trace_token=trace)
             )
             planning_s: Optional[float] = None
+            cache_hit: Optional[bool] = None
             with obs.tracing(tracer), obs.publishing(progress):
                 try:
                     t1 = time.perf_counter()
                     with obs.span("plan", cat="lifecycle"):
                         plan = self._plan_cached(sql, stmt)
                         self._check_access(plan)
+                        # serving tier: (key, versions) captured AT PLAN
+                        # TIME so a write racing this execution leaves
+                        # the stored entry stale-by-version, never
+                        # silently current (serving/cache.py)
+                        prepared = self._result_cache_prepared(plan)
                     planning_s = time.perf_counter() - t1
                     t1 = time.perf_counter()
                     with obs.span("execute", cat="lifecycle"):
-                        res = self._run_plan(plan, qid)
+                        res = None
+                        if prepared is not None:
+                            res = self._result_cache_hit(plan, prepared)
+                            cache_hit = res is not None
+                        if res is None:
+                            res = self._run_plan(plan, qid)
                     execution_s = time.perf_counter() - t1
                 except Exception as e:
                     obs.METRICS.counter("query.failed").inc()
@@ -216,6 +227,16 @@ class QueryRunner:
                         planning_ms=self._ms(planning_s),
                     ))
                     raise
+            # populate the result cache AFTER the query succeeded (and
+            # outside the failure path: a cache anomaly must never fail
+            # an already-executed query).  The entry carries the
+            # versions captured at plan time, so a write that raced the
+            # execution leaves it stale-by-version.
+            if prepared is not None and not cache_hit:
+                from presto_tpu.serving.cache import default_result_cache
+
+                default_result_cache().store(
+                    prepared, res.names, res.types, res.rows)
             progress.mark_done()
             compile_ms = (round(tracer.total_s("xla_compile") * 1e3, 3)
                           if tracer is not None else None)
@@ -229,7 +250,8 @@ class QueryRunner:
             # thread-local accumulator is read, not last_task_stats —
             # concurrent queries on one runner must not swap footprints
             ts = self.executor._task_stats.as_dict()
-            if not self.session.get("distributed") and ts.get("splits"):
+            if not cache_hit and not self.session.get("distributed") \
+                    and ts.get("splits"):
                 obs.TASKS.update_scheduler(
                     qid, ts["splits"], ts["concurrency"],
                     ts["stall_s"] * 1e3, ts["prefetch_hits"])
@@ -241,13 +263,21 @@ class QueryRunner:
             res.planning_ms = self._ms(planning_s)
             res.compile_ms = compile_ms
             res.execution_ms = self._ms(execution_s)
+            # serving-tier surfaces: whether this result came from the
+            # structural cache, and the executor's observed peak bytes
+            # (the admission controller's projection source for the
+            # next run of this statement)
+            res.cache_hit = cache_hit
+            res.peak_bytes = (0 if cache_hit
+                              else getattr(self.executor,
+                                           "last_peak_bytes", 0))
             self._finalize_trace(tracer, t_q0)
             self.events.query_completed(QueryCompletedEvent(
                 qid, sql, self.session.user, "FINISHED", t0, time.time(),
                 rows=len(res.rows), trace_token=trace,
                 dist_stages=dist_stages, dist_fallback=dist_fallback,
                 planning_ms=res.planning_ms, compile_ms=compile_ms,
-                execution_ms=res.execution_ms,
+                execution_ms=res.execution_ms, cache_hit=cache_hit,
             ))
             return res
 
@@ -929,6 +959,32 @@ class QueryRunner:
             blocks[i] = Block(new_codes.astype(codes.dtype), b.valid, b.type, dst)
             changed = True
         return Page(tuple(blocks), page.row_mask) if changed else page
+
+    def _result_cache_prepared(self, plan):
+        """(key, versions) when the result cache applies to this query
+        (``result_cache_enabled`` session property, deterministic plan,
+        every scanned table versioned) — None otherwise."""
+        try:
+            if not self.session.get("result_cache_enabled"):
+                return None
+        except KeyError:
+            return None
+        from presto_tpu.serving.cache import default_result_cache
+
+        return default_result_cache().prepare(plan, self.catalog)
+
+    def _result_cache_hit(self, plan, prepared):
+        """A MaterializedResult served from the structural result cache,
+        or None on miss.  The cached row list is copied — callers (the
+        coordinator's pager, verifiers) may hold results across later
+        invalidations."""
+        from presto_tpu.serving.cache import default_result_cache
+
+        got = default_result_cache().lookup(prepared)
+        if got is None:
+            return None
+        names, types, rows = got
+        return MaterializedResult(list(names), list(types), list(rows))
 
     def _run_plan(self, plan, query_id=None):
         """Route through the device-mesh tier when ``SET SESSION

@@ -92,7 +92,8 @@ async function refresh(){
   document.getElementById('queries').innerHTML = qs.reverse().map(q=>
     `<tr class="row" onclick="select('${q.id}')"><td>${q.id}</td>`+
     `<td class="${q.state}">${q.state}</td>`+
-    `<td>${q.progress==null?'':q.progress.toFixed(0)+'%'}</td>`+
+    `<td>${q.state==='QUEUED'&&q.queuePosition!=null?'queue #'+q.queuePosition
+         :q.progress==null?'':q.progress.toFixed(0)+'%'}</td>`+
     `<td>${q.rows}</td><td class="q">${q.query.replace(/</g,'&lt;')}</td></tr>`).join('');
   if (selected) detail(selected);
 }
@@ -171,11 +172,25 @@ class _QueryState:
         # the monotonic instant execution started
         self.deadline_s: Optional[float] = None
         self.t_running: Optional[float] = None
-        # the admission slot this query holds (set after acquire) and
-        # its once-only release guard: a kill frees the slot
-        # immediately instead of waiting for the zombie thread
-        self.group = None
-        self.group_released = False
+        # the admission ticket this query holds (serving/admission.py;
+        # set while queued) — released once-only through the
+        # controller, so a kill frees the slot immediately instead of
+        # waiting for the zombie thread
+        self.ticket = None
+        # statement error code for policy failures (QUERY_QUEUE_FULL /
+        # EXCEEDED_QUEUE_TIME / EXCEEDED_TIME_LIMIT); None for generic
+        # execution errors
+        self.error_code: Optional[str] = None
+        # serving-tier result provenance (statement stats cacheHit)
+        self.cache_hit: Optional[bool] = None
+        # live queue position served while QUEUED (filled per response)
+        self.queue_position: Optional[int] = None
+
+    @property
+    def group_released(self) -> bool:
+        """Whether the admission slot has been freed (legacy surface of
+        the pre-serving-tier flag; now the ticket's released state)."""
+        return self.ticket is not None and self.ticket.released
 
     def summary(self) -> dict:
         from presto_tpu import obs
@@ -189,6 +204,8 @@ class _QueryState:
             "progress": (100.0 if self.state == "FINISHED"
                          else prog.percentage() if prog is not None
                          else None),
+            "queuePosition": self.queue_position
+            if self.state == "QUEUED" else None,
         }
 
 
@@ -202,7 +219,9 @@ class CoordinatorServer:
                  resource_groups=None, worker_uris=(), memory_threshold: float = 0.95,
                  authenticator=None, max_execution_time: float = 0.0,
                  max_queued_time: float = 600.0, deadline_grace: float = 5.0,
-                 detector=None):
+                 detector=None, admission=None,
+                 admission_memory_fraction: float = 0.9,
+                 admission_reserve_bytes: int = 0):
         from presto_tpu.resource_groups import ResourceGroupManager
 
         # optional PasswordAuthenticator (server/security + the
@@ -239,6 +258,19 @@ class CoordinatorServer:
                 uri=uri, old_state=old, new_state=new, reason=reason,
                 change_time=_time.time())))
         self._lock = named_lock("coordinator.CoordinatorServer._lock")
+        # serving-tier admission plane (serving/admission.py): every
+        # statement passes the memory-aware controller — resource-group
+        # concurrency + projected pool headroom — instead of a bare
+        # group.acquire; queue positions flow back through the async
+        # statement protocol, the CLI and the web UI
+        from presto_tpu.serving.admission import AdmissionController
+
+        self.admission = admission or AdmissionController(
+            self.resource_groups,
+            pool=getattr(runner.executor, "memory_pool", None),
+            memory_fraction=admission_memory_fraction,
+            reserve_bytes=admission_reserve_bytes,
+            events=runner.events)
         # cluster-wide OOM protection (memory/ClusterMemoryManager.java:88):
         # polls local + worker pools, kills the biggest reserver at the
         # threshold. Only active when the executor runs with a pool.
@@ -447,6 +479,13 @@ class CoordinatorServer:
                             if q.state in ("QUEUED", "RUNNING"):
                                 q.state = "CANCELED"
                                 q.done.set()
+                        # a queued victim's memory-gate wait exits at
+                        # its next wakeup instead of running its bound,
+                        # and a RUNNING victim's slot + projected bytes
+                        # free immediately (once-only, same as a kill)
+                        # rather than when the zombie thread unwinds
+                        outer.admission.cancel(q.id)
+                        outer._release_group(q)
                     self._json(204, {})
                     return
                 self._json(404, {"error": "not found"})
@@ -485,19 +524,19 @@ class CoordinatorServer:
             t.join(max(0.0, deadline - time.monotonic()))
 
     def _release_group(self, q: _QueryState) -> None:
-        """Release a query's admission slot EXACTLY once — callable
+        """Release a query's admission ticket EXACTLY once — callable
         from both the computation thread's finally and a killer (the
         deadline timer / memory manager), so a killed query frees its
         slot immediately instead of holding it until the cooperative
         thread unwinds.  The zombie thread may briefly run past the
         group's concurrency limit; that window is the same one the
-        cooperative memory-kill protocol already accepts."""
+        cooperative memory-kill protocol already accepts.  (The
+        controller's release is itself once-only and additionally wakes
+        memory-gate waiters — a finished query is when headroom
+        reappears.)"""
         with self._lock:
-            if q.group is None or q.group_released:
-                return
-            q.group_released = True
-            group = q.group
-        group.release()
+            ticket = q.ticket
+        self.admission.release(ticket)
 
     def _kill_query(self, qid: str) -> None:
         """LowMemoryKiller action: cancel through the normal state path
@@ -509,6 +548,10 @@ class CoordinatorServer:
                     q.state = "CANCELED"
                     q.error = "query killed by the cluster memory manager"
                     q.done.set()
+            # a victim still waiting at the memory gate exits at its
+            # next wakeup instead of holding its group slot for the
+            # rest of the queue bound (same as the DELETE path)
+            self.admission.cancel(qid)
             self._release_group(q)
 
     # -- deadlines ------------------------------------------------------
@@ -551,6 +594,7 @@ class CoordinatorServer:
             if q.state != "RUNNING":
                 return
             q.state = "FAILED"
+            q.error_code = "EXCEEDED_TIME_LIMIT"
             q.error = (f"Query exceeded the maximum execution time of "
                        f"{limit:g}s (EXCEEDED_TIME_LIMIT)")
         pool = getattr(self.runner.executor, "memory_pool", None)
@@ -592,28 +636,35 @@ class CoordinatorServer:
             self.queries[qid] = q
 
         def run():
-            group = self.resource_groups.group_for(self.runner.session.user)
+            from presto_tpu.resource_groups import QueryQueueFullError
+
             try:
-                try:
-                    prio = int(self.runner.session.get("query_priority"))
-                except Exception:
-                    prio = 0
-                # config-driven queue bound (query.max-queued-time; was
-                # a magic 600): expiry surfaces as a proper FAILED
-                # statement below, never a hang
-                group.acquire(
+                prio = int(self.runner.session.get("query_priority"))
+            except Exception:
+                prio = 0
+            # the memory-aware admission gate (serving/admission.py):
+            # group concurrency + queue quota + projected pool
+            # headroom, bounded by query.max-queued-time.  Rejections
+            # keep distinct statement error codes (QUERY_QUEUE_FULL /
+            # EXCEEDED_QUEUE_TIME) instead of a generic failure.
+            try:
+                ticket = self.admission.admit(
+                    q.id, self.runner.session.user, priority=prio,
                     timeout=(self.max_queued_time
                              if self.max_queued_time > 0 else None),
-                    priority=prio)
-            except Exception as e:
+                    statement_key=sql)
                 with self._lock:
-                    if q.state == "QUEUED":
-                        q.error = f"{type(e).__name__}: {e}"
-                        q.state = "FAILED"
-                q.done.set()
+                    q.ticket = ticket
+            except QueryQueueFullError as e:
+                self._admission_failed(q, "QUERY_QUEUE_FULL", e)
+                return
+            except TimeoutError as e:
+                self._admission_failed(q, "EXCEEDED_QUEUE_TIME", e)
+                return
+            except Exception as e:
+                self._admission_failed(q, None, e)
                 return
             with self._lock:
-                q.group = group
                 if q.state != "QUEUED":  # canceled while queued
                     pass  # fall through to the release below
                 else:
@@ -650,6 +701,11 @@ class CoordinatorServer:
                 q.planning_ms = getattr(res, "planning_ms", None)
                 q.compile_ms = getattr(res, "compile_ms", None)
                 q.execution_ms = getattr(res, "execution_ms", None)
+                q.cache_hit = getattr(res, "cache_hit", None)
+                # observed peak feeds the admission controller's memory
+                # projection for the NEXT run of this statement
+                self.admission.record_peak(
+                    sql, getattr(res, "peak_bytes", 0) or 0)
                 # CANCELED is terminal: a DELETE that raced this query's
                 # completion must not be resurrected to FINISHED/FAILED
                 with self._lock:
@@ -674,6 +730,29 @@ class CoordinatorServer:
         with self._lock:
             q.thread = t
         return q
+
+    def _admission_failed(self, q: _QueryState, code: Optional[str],
+                          e: Exception) -> None:
+        """Fail a statement at the admission gate with its policy error
+        code, emitting the kill-decision event so the query log records
+        WHY the query never ran (queue full vs queue-time expiry)."""
+        with self._lock:
+            if q.state == "QUEUED":
+                q.error = f"{type(e).__name__}: {e}"
+                q.error_code = code
+                q.state = "FAILED"
+        if code is not None:
+            try:
+                from presto_tpu.events import QueryKilledEvent
+
+                self.runner.events.query_killed(QueryKilledEvent(
+                    query_id=q.id, reason=code, message=str(e),
+                    limit_s=(self.max_queued_time
+                             if code == "EXCEEDED_QUEUE_TIME" else None),
+                    elapsed_s=None, kill_time=time.time()))
+            except Exception:
+                pass  # telemetry must never mask the failure
+        q.done.set()
 
     def _cluster_stats(self) -> dict:
         """ClusterStatsResource analog (feeds the web UI tiles)."""
@@ -709,6 +788,16 @@ class CoordinatorServer:
             out["stats"]["compileMs"] = q.compile_ms
         if q.execution_ms is not None:
             out["stats"]["executionMs"] = q.execution_ms
+        # serving tier: result provenance (structural result cache)
+        if q.cache_hit is not None:
+            out["stats"]["cacheHit"] = q.cache_hit
+        # live queue position while waiting for admission (1-based;
+        # also cached on the state object for /v1/query summaries)
+        if q.state == "QUEUED":
+            pos = self.admission.queue_position(q.id)
+            q.queue_position = pos
+            if pos is not None:
+                out["stats"]["queuePosition"] = pos
         # Presto-style live progress (StatementStats.progressPercentage
         # + a per-stage split table).  Monotone by construction: the
         # progress object reports a running maximum, and a FINISHED
@@ -726,6 +815,11 @@ class CoordinatorServer:
             out["stats"]["elapsedMs"] = snap["elapsedMs"]
         if q.error:
             out["error"] = q.error
+            # distinct statement error codes for policy failures
+            # (QUERY_QUEUE_FULL / EXCEEDED_QUEUE_TIME /
+            # EXCEEDED_TIME_LIMIT); generic failures carry none
+            if q.error_code is not None:
+                out["errorCode"] = q.error_code
             return out
         if q.state in ("QUEUED", "RUNNING"):
             # async page: no data yet — the client re-polls this token

@@ -1,0 +1,37 @@
+"""Coordinator serving tier: admission control + structural caches.
+
+The multi-tenant plane ROADMAP item 2 names (docs/serving.md): the
+coordinator admits queries through a memory-aware
+:class:`AdmissionController` (resource-group concurrency + pool
+headroom, live queue positions through the statement protocol), and
+repeated read-only work serves from a byte-capped
+:class:`ResultCache` / :class:`SubplanCache` keyed by structural plan
+signatures and invalidated by warehouse table versions.
+"""
+
+from presto_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionTicket,
+    QueryQueueFullError,
+)
+from presto_tpu.serving.cache import (
+    ResultCache,
+    StructuralCache,
+    SubplanCache,
+    default_result_cache,
+    default_subplan_cache,
+    plan_cache_key,
+    plan_deterministic,
+    plan_table_versions,
+    reset_default_caches,
+    result_nbytes,
+    set_result_cache_bytes,
+)
+
+__all__ = [
+    "AdmissionController", "AdmissionTicket", "QueryQueueFullError",
+    "ResultCache", "StructuralCache", "SubplanCache",
+    "default_result_cache", "default_subplan_cache",
+    "plan_cache_key", "plan_deterministic", "plan_table_versions",
+    "reset_default_caches", "result_nbytes", "set_result_cache_bytes",
+]

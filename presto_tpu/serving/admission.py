@@ -1,0 +1,386 @@
+"""Memory-aware admission control with live queue positions.
+
+Reference analog: the resource-group admission plane of
+``execution/resourceGroups/InternalResourceGroupManager.java`` plus the
+coordinator's memory-aware dispatch (``ClusterMemoryManager`` feeding
+``QueryQueuer`` decisions) — the serving-tier half of ROADMAP item 2.
+
+The controller fronts the existing :mod:`presto_tpu.resource_groups`
+tree with two additions the bare ``group.acquire()`` call lacked:
+
+- **memory-aware dispatch**: after winning a concurrency slot, a query
+  is dispatched only when projected headroom exists on the memory pool
+  — ``reserved + projected <= memory_fraction * limit`` — where the
+  projection is the query's remembered peak from previous runs of the
+  same statement (falling back to a configured reserve).  The gauges
+  consulted are the same ``memory.pool_reserved/limit_bytes`` surfaces
+  ``memory.wire_pool_gauges`` exports, so operators can reproduce every
+  admission decision from scraped data.
+
+- **queue positions**: every waiting query holds a ticket in one
+  FIFO-ordered book; ``queue_position`` is served live through the
+  async statement protocol (``stats.queuePosition``), the CLI progress
+  line, and the web UI.  Positions are informational — dispatch order
+  follows the group policy for the slot and first-fit for memory
+  headroom (a light query may pass a memory-blocked heavy one; see
+  docs/serving.md for the tradeoff and its mitigations).
+
+Rejections keep their identities: a full queue raises
+``QueryQueueFullError`` and an expired wait raises ``TimeoutError`` —
+the coordinator maps them to the ``QUERY_QUEUE_FULL`` /
+``EXCEEDED_QUEUE_TIME`` statement error codes.
+
+Lifecycle telemetry: ``admission.*`` counters/gauges/histogram
+(obs catalog) and ``QueryQueuedEvent`` / ``QueryAdmittedEvent`` query-log
+lines, so queue depth, wait-time distribution, and memory stalls are
+first-class observables.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import weakref
+from typing import Dict, Optional
+
+from presto_tpu.resource_groups import (  # re-exported for callers
+    QueryQueueFullError, ResourceGroupManager,
+)
+from presto_tpu.sync import named_condition
+
+__all__ = ["AdmissionCancelledError", "AdmissionController",
+           "AdmissionTicket", "QueryQueueFullError"]
+
+
+class AdmissionCancelledError(Exception):
+    """The query was canceled while waiting for admission — the wait
+    ends without a slot, and nothing counts as admitted."""
+
+_seq = itertools.count(1)
+
+#: bounded per-signature peak-memory history (projection source)
+_HISTORY_MAX = 1024
+
+#: memory-gate poll interval: pool frees do not signal this condition,
+#: so blocked admissions also re-check on a short timer
+_MEM_POLL_S = 0.05
+
+#: every live controller, for the process-wide admission gauges — a
+#: second controller (bench harness, tests) must AGGREGATE with the
+#: coordinator's, not silently hijack the gauge callbacks
+_CONTROLLERS: "weakref.WeakSet" = weakref.WeakSet()
+_GAUGES_WIRED = [False]
+
+
+class AdmissionTicket:
+    """One query's admission state: QUEUED -> ADMITTED -> RELEASED
+    (or CANCELED while queued)."""
+
+    __slots__ = ("query_id", "user", "group", "priority", "seq", "state",
+                 "projected_bytes", "queued_at", "admitted_at", "released",
+                 "canceled")
+
+    def __init__(self, query_id: str, user: str, priority: int = 0):
+        self.query_id = query_id
+        self.user = user
+        self.group = None
+        self.priority = priority
+        self.seq = next(_seq)
+        self.state = "QUEUED"
+        self.projected_bytes = 0
+        self.queued_at = time.monotonic()
+        self.admitted_at: Optional[float] = None
+        self.released = False
+        self.canceled = False
+
+    def queued_ms(self) -> float:
+        end = self.admitted_at if self.admitted_at is not None \
+            else time.monotonic()
+        return round((end - self.queued_at) * 1e3, 3)
+
+
+def _wire_gauges() -> None:
+    """Attach the admission gauges ONCE per process; callbacks sum over
+    every live controller, so a bench/test controller aggregates with
+    the coordinator's instead of hijacking the series (and a collected
+    controller simply drops out of the sum)."""
+    if _GAUGES_WIRED[0]:
+        return
+    _GAUGES_WIRED[0] = True
+    from presto_tpu.obs import METRICS
+
+    METRICS.gauge("admission.queue_depth").set_fn(
+        lambda: float(sum(c.queue_depth() for c in list(_CONTROLLERS))))
+    METRICS.gauge("admission.running").set_fn(
+        lambda: float(sum(c._running_count() for c in list(_CONTROLLERS))))
+
+
+class AdmissionController:
+    """Group concurrency + memory headroom gate in front of dispatch."""
+
+    def __init__(self, groups: Optional[ResourceGroupManager] = None,
+                 pool=None, memory_fraction: float = 0.9,
+                 reserve_bytes: int = 0, events=None):
+        self.groups = groups or ResourceGroupManager()
+        # the MemoryPool whose reserved/limit gauges gate dispatch
+        # (None = no memory awareness, pure concurrency admission)
+        self.pool = pool
+        self.memory_fraction = float(memory_fraction)
+        self.reserve_bytes = int(reserve_bytes)
+        # EventListenerManager (or None) for queued/admitted log lines
+        self.events = events
+        # one monitor serves the ticket book AND the memory gate; the
+        # group tree has its own condition and is NEVER entered while
+        # this one is held (acquire happens outside the lock, so the
+        # only cross-lock order is admission -> resource_groups)
+        import collections
+
+        self._cond = named_condition("admission.AdmissionController._cond")
+        self._tickets: Dict[str, AdmissionTicket] = {}
+        # statement-signature -> observed peak bytes (projection for
+        # repeat queries; bounded LRU — a hot statement re-recorded
+        # every run must outlive 1024 one-off statements, or its
+        # projection silently falls back to the default and a burst of
+        # it overcommits exactly as if the gate were off)
+        self._peak_history: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        _CONTROLLERS.add(self)
+        _wire_gauges()
+
+    def _running_count(self) -> int:
+        with self._cond:
+            return sum(1 for t in self._tickets.values()
+                       if t.state == "ADMITTED")
+
+    # -- projection history -------------------------------------------------
+    def record_peak(self, statement_key: Optional[str],
+                    peak_bytes: int) -> None:
+        """Remember a completed statement's observed peak reservation —
+        the projection its next admission uses."""
+        if not statement_key or peak_bytes <= 0:
+            return
+        with self._cond:
+            prev = self._peak_history.get(statement_key, 0)
+            self._peak_history[statement_key] = max(prev, int(peak_bytes))
+            self._peak_history.move_to_end(statement_key)
+            while len(self._peak_history) > _HISTORY_MAX:
+                self._peak_history.popitem(last=False)
+
+    def projected_bytes(self, statement_key: Optional[str]) -> int:
+        with self._cond:
+            seen = self._peak_history.get(statement_key or "", 0)
+        return max(seen, self.reserve_bytes)
+
+    # -- queue surfaces -----------------------------------------------------
+    def queue_depth(self) -> int:
+        with self._cond:
+            return sum(1 for t in self._tickets.values()
+                       if t.state == "QUEUED")
+
+    def queue_position(self, query_id: str) -> Optional[int]:
+        """1-based FIFO position among queued tickets; None once the
+        query is admitted (or unknown)."""
+        with self._cond:
+            t = self._tickets.get(query_id)
+            if t is None or t.state != "QUEUED":
+                return None
+            return 1 + sum(1 for o in self._tickets.values()
+                           if o.state == "QUEUED" and o.seq < t.seq)
+
+    # -- admission ----------------------------------------------------------
+    def admit(self, query_id: str, user: str, priority: int = 0,
+              timeout: Optional[float] = None,
+              statement_key: Optional[str] = None) -> AdmissionTicket:
+        """Block until the query may run: resource-group concurrency
+        (+ queue quota) first, then memory headroom.  Raises
+        ``QueryQueueFullError`` when the group queue is at quota and
+        ``TimeoutError`` when ``timeout`` expires in either phase (the
+        deadline is ABSOLUTE across both)."""
+        from presto_tpu.obs import METRICS
+
+        ticket = AdmissionTicket(query_id, user, priority)
+        ticket.projected_bytes = self.projected_bytes(statement_key)
+        with self._cond:
+            self._tickets[query_id] = ticket
+        METRICS.counter("admission.queued_total").inc()
+        self._emit_queued(ticket)
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        group = self.groups.group_for(user)
+        ticket.group = group
+        try:
+            group.acquire(timeout=timeout, priority=priority)
+        except QueryQueueFullError:
+            METRICS.counter("admission.rejected_queue_full").inc()
+            self._drop(ticket)
+            raise
+        except TimeoutError:
+            METRICS.counter("admission.rejected_timeout").inc()
+            self._drop(ticket)
+            raise
+        except BaseException:
+            self._drop(ticket)
+            raise
+        try:
+            # the gate flips the ticket to ADMITTED inside its own
+            # critical section: the headroom decision and the moment
+            # the ticket starts counting as inflight are atomic, so
+            # two concurrent heavy admits can never both pass against
+            # the same headroom
+            self._wait_for_memory(ticket, deadline)
+        except TimeoutError:
+            METRICS.counter("admission.rejected_timeout").inc()
+            group.release()
+            self._drop(ticket)
+            raise
+        except BaseException:
+            group.release()
+            self._drop(ticket)
+            raise
+        METRICS.counter("admission.admitted_total").inc()
+        METRICS.histogram("admission.queue_wait_ms").observe(
+            ticket.queued_ms())
+        self._emit_admitted(ticket)
+        return ticket
+
+    def _inflight_projected(self) -> int:
+        """Projected-but-not-yet-reserved bytes of admitted, unreleased
+        tickets (caller holds ``_cond``).  Without this a burst of
+        heavy statements would ALL pass the headroom check before any
+        of them reserves — the exact OOM storm the gate exists to
+        prevent.  Each ticket's projection is discounted by what its
+        query has actually reserved so far (the pool's tagged
+        reservations), so a running query is never double-counted."""
+        admitted = [t for t in self._tickets.values()
+                    if t.state == "ADMITTED"]
+        if not admitted:
+            return 0
+        actual: Dict[str, int] = {}
+        pool = self.pool
+        if pool is not None and hasattr(pool, "tags"):
+            for tag, nbytes in pool.tags().items():
+                qid = tag.split("/", 1)[0]
+                actual[qid] = actual.get(qid, 0) + nbytes
+        return sum(max(0, t.projected_bytes - actual.get(t.query_id, 0))
+                   for t in admitted)
+
+    def _headroom_ok(self, need: int, inflight: int) -> bool:
+        pool = self.pool
+        if pool is None or self.memory_fraction <= 0:
+            return True
+        limit = getattr(pool, "limit", 0)
+        if limit <= 0:
+            return True
+        return (pool.reserved + inflight + need
+                <= self.memory_fraction * limit)
+
+    def _wait_for_memory(self, ticket: AdmissionTicket,
+                         deadline: Optional[float]) -> None:
+        """Memory gate: wait (on this controller's own condition; frees
+        are also caught by a short re-check timer) until projected
+        headroom exists — against the pool's LIVE reservations plus the
+        still-unreserved projections of already-admitted queries.  One
+        query always proceeds when the pool is idle and nothing else is
+        admitted, so a projection larger than the whole pool degrades
+        to run-alone instead of wedging forever."""
+        from presto_tpu.obs import METRICS
+
+        need = ticket.projected_bytes
+        t0 = time.monotonic()
+        blocked = False
+        with self._cond:
+            while True:
+                if ticket.canceled:
+                    raise AdmissionCancelledError(
+                        f"query {ticket.query_id} canceled while queued")
+                inflight = self._inflight_projected()
+                pool = self.pool
+                idle = (pool is not None
+                        and getattr(pool, "reserved", 0) <= 0
+                        and inflight == 0)
+                if self._headroom_ok(need, inflight) or idle:
+                    # decision and ADMITTED transition are ONE critical
+                    # section: the ticket counts as inflight before any
+                    # concurrent admit can evaluate its own headroom
+                    ticket.admitted_at = time.monotonic()
+                    ticket.state = "ADMITTED"
+                    break
+                if not blocked:
+                    blocked = True
+                    METRICS.counter("admission.memory_blocked_total").inc()
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"query {ticket.query_id}: queue wait timed out "
+                        f"waiting for memory headroom "
+                        f"({need} projected bytes)")
+                wait = _MEM_POLL_S if remaining is None \
+                    else min(_MEM_POLL_S, remaining)
+                self._cond.wait(timeout=wait)
+        if blocked:
+            METRICS.counter("admission.memory_stall_seconds_total").inc(
+                time.monotonic() - t0)
+
+    # -- release ------------------------------------------------------------
+    def release(self, ticket: Optional[AdmissionTicket]) -> None:
+        """Free the ticket's slot EXACTLY once (callable from the
+        completion path and any killer) and wake memory-gate waiters —
+        a finished query is precisely when headroom reappears."""
+        if ticket is None:
+            return
+        with self._cond:
+            if ticket.released:
+                return
+            ticket.released = True
+            ticket.state = "RELEASED"
+            self._tickets.pop(ticket.query_id, None)
+            self._cond.notify_all()
+        if ticket.group is not None and ticket.admitted_at is not None:
+            ticket.group.release()
+
+    def cancel(self, query_id: str) -> None:
+        """Mark a queued query canceled so its memory-gate wait exits at
+        the next wakeup (a wait inside ``group.acquire`` still runs to
+        its own bound — the same cooperative window the kill protocol
+        accepts)."""
+        with self._cond:
+            t = self._tickets.get(query_id)
+            if t is not None:
+                t.canceled = True
+            self._cond.notify_all()
+
+    def _drop(self, ticket: AdmissionTicket) -> None:
+        with self._cond:
+            self._tickets.pop(ticket.query_id, None)
+            self._cond.notify_all()
+
+    # -- events -------------------------------------------------------------
+    def _emit_queued(self, ticket: AdmissionTicket) -> None:
+        if self.events is None:
+            return
+        try:
+            from presto_tpu.events import QueryQueuedEvent
+
+            self.events.query_queued(QueryQueuedEvent(
+                query_id=ticket.query_id, user=ticket.user,
+                group=getattr(ticket.group, "name", None),
+                position=self.queue_position(ticket.query_id),
+                queue_time=time.time()))
+        except Exception:
+            pass  # telemetry must never block admission
+
+    def _emit_admitted(self, ticket: AdmissionTicket) -> None:
+        if self.events is None:
+            return
+        try:
+            from presto_tpu.events import QueryAdmittedEvent
+
+            self.events.query_admitted(QueryAdmittedEvent(
+                query_id=ticket.query_id,
+                group=getattr(ticket.group, "name", None),
+                queued_ms=ticket.queued_ms(),
+                projected_bytes=ticket.projected_bytes,
+                admit_time=time.time()))
+        except Exception:
+            pass

@@ -1,0 +1,467 @@
+"""Structural result + subplan caches for the serving tier.
+
+Reference analog: the coordinator-side caching plane of the reference's
+serving deployments (materialized query results keyed by canonical plan
+shape, invalidated by table data versions — the role fragment-result
+caching plays in warehouse serving tiers; presto-main itself re-executes
+everything, which is exactly the gap ROADMAP item 2 names for the
+"millions of users" half of the north star).
+
+Two caches share one byte-capped LRU implementation:
+
+- :class:`ResultCache` stores the final rows of read-only queries,
+  keyed by the STRUCTURAL plan signature (``exec/programs.ir_signature``
+  — the same canonical-IR identity that keys the ProgramRegistry and
+  QueryStats), so two dashboard clients issuing textually different but
+  structurally identical queries share one entry.
+
+- :class:`SubplanCache` applies the same scheme at exchange boundaries:
+  a distributed stage (scan -> filter -> partial agg -> exchange)
+  shared as a prefix across dashboard variants hits warm intermediate
+  pages instead of re-executing the stage (``parallel/dist.py`` wires
+  it around its stage callbacks).
+
+Correctness model — entries are invalidated by WAREHOUSE TABLE
+VERSIONS: every versioned connector exposes ``table_version(table)``, a
+monotonically increasing integer bumped on INSERT/CTAS/DELETE/DDL.  The
+versions of every scanned table are captured into the key at plan time
+(before execution starts); a lookup whose captured versions disagree
+with the live ones drops the entry and misses.  A plan that scans ANY
+table whose connector does not expose versions (system tables, streams,
+remote) is uncacheable, as is a plan containing a nondeterministic
+function call — stale results are never served (docs/serving.md states
+the full consistency contract).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from presto_tpu.envflag import EnvInt
+from presto_tpu.sync import named_lock
+
+#: process default for the result-cache byte budget
+#: (``query.result-cache-bytes`` config / PRESTO_TPU_RESULT_CACHE_BYTES)
+_RESULT_CACHE_BYTES = EnvInt("PRESTO_TPU_RESULT_CACHE_BYTES", 64 << 20)
+#: and for the subplan (stage intermediate) cache
+_SUBPLAN_CACHE_BYTES = EnvInt("PRESTO_TPU_SUBPLAN_CACHE_BYTES", 128 << 20)
+
+#: no single entry may take more than this fraction of the cache — one
+#: giant result must not evict the whole working set to store itself
+_MAX_ENTRY_FRACTION = 0.5
+
+# function calls whose value is not a pure function of the inputs; a
+# plan containing one must never serve from (or populate) a cache.
+# now()/current_timestamp bind to a per-plan literal (binder._query_now)
+# but are listed anyway: a cached LITERAL timestamp served forever is
+# exactly the staleness the cache must not introduce.
+NONDETERMINISTIC_FNS = frozenset(
+    {"random", "rand", "uuid", "now", "current_timestamp", "current_time",
+     "current_date", "localtimestamp", "shuffle"})
+
+
+# ---------------------------------------------------------------------------
+# cacheability + keys
+# ---------------------------------------------------------------------------
+
+
+def _walk_exprs(obj, seen: set):
+    """Yield every expr Call in a plan/IR tree (generic dataclass walk;
+    descent stops at leaf value objects — Types, Dictionaries, Pages)."""
+    from presto_tpu.expr.ir import Call
+    from presto_tpu.page import Dictionary, Page
+    from presto_tpu.types import Type
+
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes,
+                                       Type, Dictionary, Page)):
+        return
+    oid = id(obj)
+    if oid in seen:
+        return
+    seen.add(oid)
+    if isinstance(obj, Call):
+        yield obj
+        for a in obj.args:
+            yield from _walk_exprs(a, seen)
+        return
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for x in obj:
+            yield from _walk_exprs(x, seen)
+        return
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            yield from _walk_exprs(getattr(obj, f.name), seen)
+
+
+def plan_deterministic(plan) -> bool:
+    """False when any expression in the plan calls a nondeterministic
+    function — such a plan must never populate or serve from a cache."""
+    return all(c.fn not in NONDETERMINISTIC_FNS
+               for c in _walk_exprs(plan, set()))
+
+
+def _scan_nodes(plan) -> List:
+    from presto_tpu.planner.plan import TableScanNode
+
+    out: List = []
+
+    def walk(node):
+        if isinstance(node, TableScanNode):
+            out.append(node)
+        for s in node.sources:
+            walk(s)
+
+    walk(plan)
+    return out
+
+
+def plan_table_versions(plan, catalog) -> Optional[Tuple]:
+    """Sorted ``(connector, table, version)`` triples for every table
+    the plan scans, or None when any scanned table's connector does not
+    expose ``table_version`` (-> the plan is uncacheable).  A plan with
+    no scans at all (pure VALUES) versions to the empty tuple."""
+    versions = set()
+    for scan in _scan_nodes(plan):
+        handle = scan.handle
+        try:
+            conn = catalog.connector(handle.connector_name)
+        except KeyError:
+            return None
+        fn = getattr(conn, "table_version", None)
+        if fn is None:
+            return None
+        try:
+            # versions are opaque hashable tokens: ints for the memory
+            # connector, (incarnation, counter) pairs for the warehouse
+            versions.add((handle.connector_name, handle.table,
+                          fn(handle.table)))
+        except Exception:
+            return None  # a connector that errors on versioning opts out
+    return tuple(sorted(versions, key=repr))
+
+
+def plan_cache_key(plan) -> Optional[Tuple]:
+    """Hashable structural signature of a bound plan (the
+    ProgramRegistry's ``ir_signature`` applied to the whole tree), or
+    None when the plan is not cacheable (nondeterministic functions).
+    Textually different queries with identical structure — the repeated
+    dashboard case — produce the SAME key; anything ``ir_signature``
+    keys by object identity (unknown leaf objects) merely forgoes
+    sharing, never produces a wrong hit."""
+    if not plan_deterministic(plan):
+        return None
+    from presto_tpu.exec.programs import ir_signature
+
+    try:
+        return ("plan", ir_signature(plan))
+    except Exception:
+        return None  # unsignable plans are simply uncacheable
+
+
+def signature_has_identity_keys(sig) -> bool:
+    """True when an ``ir_signature`` tree contains an identity-keyed
+    leaf (the ``("I", type, token)`` form): such a key is stable only
+    for the lifetime of one specific object and can never match across
+    queries — a cache entry stored under it is pure budget pollution.
+    (Dictionary tokens ``("D", n)`` are fine: table dictionaries are
+    long-lived connector state.)"""
+    if isinstance(sig, tuple):
+        if len(sig) == 3 and sig[0] == "I" and isinstance(sig[2], int):
+            return True
+        return any(signature_has_identity_keys(x) for x in sig)
+    return False
+
+
+def result_nbytes(rows: List[tuple]) -> int:
+    """Approximate host footprint of a materialized row set (byte-cap
+    accounting; exactness is not required, monotonicity in data size
+    is)."""
+    import sys
+
+    total = 0
+    for r in rows:
+        total += 64  # tuple + list-slot overhead
+        for v in r:
+            if isinstance(v, (str, bytes)):
+                total += 48 + len(v)
+            else:
+                total += sys.getsizeof(v) if v is not None else 16
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the byte-capped LRU both caches share
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("value", "versions", "nbytes")
+
+    def __init__(self, value, versions, nbytes: int):
+        self.value = value
+        self.versions = versions
+        self.nbytes = int(nbytes)
+
+
+class StructuralCache:
+    """Byte-capped LRU keyed by structural signatures, validated by
+    table versions on every read.  ``metric_prefix`` selects the
+    pre-registered ``cache.<prefix>_*`` instrument family
+    (obs/metrics.py catalog)."""
+
+    def __init__(self, max_bytes: int, metric_prefix: str):
+        self.max_bytes = int(max_bytes)
+        self.metric_prefix = metric_prefix
+        self._lock = named_lock("cache.StructuralCache._lock")
+        self._entries: "collections.OrderedDict[Any, _Entry]" = \
+            collections.OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def _counter(self, what: str):
+        from presto_tpu.obs import METRICS
+
+        return METRICS.counter(f"cache.{self.metric_prefix}_{what}")
+
+    def _publish_gauges(self) -> None:
+        from presto_tpu.obs import METRICS
+
+        METRICS.gauge(f"cache.{self.metric_prefix}_bytes").set(self.bytes)
+        METRICS.gauge(f"cache.{self.metric_prefix}_entries").set(
+            len(self._entries))
+
+    def get(self, key, versions) -> Optional[Any]:
+        """The cached value when present AND its captured table versions
+        equal ``versions`` — a version mismatch drops the entry (write
+        invalidation is lazy: the bump happens in the connector, the
+        entry dies at its next lookup)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                self._counter("misses").inc()
+                return None
+            if e.versions != versions:
+                self._entries.pop(key)
+                self.bytes -= e.nbytes
+                self.invalidations += 1
+                self.misses += 1
+                self._counter("invalidations").inc()
+                self._counter("misses").inc()
+                self._publish_gauges()
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            self._counter("hits").inc()
+            return e.value
+
+    def put(self, key, versions, value, nbytes: int) -> bool:
+        """Insert (replacing any same-key entry); False when the value
+        is too large to cache (> half the budget)."""
+        nbytes = int(nbytes)
+        if nbytes > self.max_bytes * _MAX_ENTRY_FRACTION:
+            self._counter("oversize").inc()
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old.nbytes
+            self._entries[key] = _Entry(value, versions, nbytes)
+            self.bytes += nbytes
+            self._counter("stores").inc()
+            while self.bytes > self.max_bytes and self._entries:
+                _, ev = self._entries.popitem(last=False)
+                self.bytes -= ev.nbytes
+                self.evictions += 1
+                self._counter("evictions").inc()
+            self._publish_gauges()
+        return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.bytes = 0
+            self._publish_gauges()
+
+    def resize(self, max_bytes: int) -> None:
+        """Change the byte budget (config wiring), evicting LRU-first
+        down to the new cap."""
+        with self._lock:
+            self.max_bytes = int(max_bytes)
+            while self.bytes > self.max_bytes and self._entries:
+                _, ev = self._entries.popitem(last=False)
+                self.bytes -= ev.nbytes
+                self.evictions += 1
+                self._counter("evictions").inc()
+            self._publish_gauges()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }
+
+
+# ---------------------------------------------------------------------------
+# result cache (final rows of read-only queries)
+# ---------------------------------------------------------------------------
+
+
+class ResultCache:
+    """Final-result cache over :class:`StructuralCache`: entry = the
+    (names, types, rows) triple of a finished read-only query."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.cache = StructuralCache(
+            max_bytes if max_bytes is not None else _RESULT_CACHE_BYTES(),
+            "result")
+
+    def prepare(self, plan, catalog) -> Optional[Tuple]:
+        """(key, versions) when the plan is cacheable — computed ONCE
+        at plan time so the versions a stored entry carries are the
+        pre-execution ones (a write racing the execution makes the
+        entry stale-by-version, never silently current)."""
+        key = plan_cache_key(plan)
+        if key is None:
+            return None
+        versions = plan_table_versions(plan, catalog)
+        if versions is None:
+            return None
+        return (key, versions)
+
+    def lookup(self, prepared):
+        """Cached (names, types, rows) or None."""
+        if prepared is None:
+            return None
+        return self.cache.get(prepared[0], prepared[1])
+
+    def store(self, prepared, names, types, rows) -> bool:
+        if prepared is None:
+            return False
+        return self.cache.put(prepared[0], prepared[1],
+                              (list(names), list(types), list(rows)),
+                              result_nbytes(rows))
+
+    def stats(self) -> Dict[str, Any]:
+        return self.cache.stats()
+
+    def clear(self) -> None:
+        self.cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# subplan (stage-intermediate) cache
+# ---------------------------------------------------------------------------
+
+
+class SubplanCache:
+    """Stage-output cache at exchange boundaries: the distributed
+    runner consults it before executing a stage whose subtree reads
+    only versioned base tables, and stores the stage's materialized
+    page after.  Pages are immutable device arrays, so sharing one
+    across queries is safe; the byte cap bounds the HBM the cache may
+    pin (``memory.page_bytes`` accounting)."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.cache = StructuralCache(
+            max_bytes if max_bytes is not None else _SUBPLAN_CACHE_BYTES(),
+            "subplan")
+
+    def prepare(self, stage_root, catalog, extra=()) -> Optional[Tuple]:
+        """(key, versions) when the stage is cacheable: deterministic,
+        every leaf a versioned base-table scan (a stage over another
+        stage's intermediate keys that intermediate by object identity,
+        which never repeats across queries — prepare still succeeds but
+        such keys simply never hit).  ``extra`` folds stage-level
+        execution parameters (shard bounds, mesh width) into the key."""
+        key = plan_cache_key(stage_root)
+        if key is None:
+            return None
+        # a stage over another stage's intermediate (PrecomputedNode
+        # page) keys by object identity — that entry can never be
+        # looked up by a later query, so storing it would only evict
+        # the genuinely shareable base-table-prefix entries
+        if signature_has_identity_keys(key):
+            return None
+        versions = plan_table_versions(stage_root, catalog)
+        if versions is None:
+            return None
+        return (("stage",) + tuple(extra) + (key,), versions)
+
+    def lookup(self, prepared):
+        if prepared is None:
+            return None
+        return self.cache.get(prepared[0], prepared[1])
+
+    def store(self, prepared, page) -> bool:
+        if prepared is None or page is None:
+            return False
+        from presto_tpu.memory import page_bytes
+
+        try:
+            nbytes = page_bytes(page)
+        except Exception:
+            return False
+        return self.cache.put(prepared[0], prepared[1], page, nbytes)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.cache.stats()
+
+    def clear(self) -> None:
+        self.cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-wide defaults (the sharing model of programs.default_registry:
+# coordinator + every runner in the process serve from one budget)
+# ---------------------------------------------------------------------------
+
+_DEFAULTS: Dict[str, Any] = {"result": None, "subplan": None}
+_DEFAULTS_LOCK = named_lock("cache._DEFAULTS_LOCK")
+
+
+def default_result_cache() -> ResultCache:
+    with _DEFAULTS_LOCK:
+        if _DEFAULTS["result"] is None:
+            _DEFAULTS["result"] = ResultCache()
+        return _DEFAULTS["result"]
+
+
+def default_subplan_cache() -> SubplanCache:
+    with _DEFAULTS_LOCK:
+        if _DEFAULTS["subplan"] is None:
+            _DEFAULTS["subplan"] = SubplanCache()
+        return _DEFAULTS["subplan"]
+
+
+def set_result_cache_bytes(max_bytes: int) -> None:
+    """Wire the ``query.result-cache-bytes`` config key into the
+    process default (launcher): overrides the env/default budget and
+    resizes an already-built cache in place (<= 0 is ignored — the
+    env/default stands)."""
+    if max_bytes <= 0:
+        return
+    with _DEFAULTS_LOCK:
+        _RESULT_CACHE_BYTES.set(max_bytes)
+        if _DEFAULTS["result"] is not None:
+            _DEFAULTS["result"].cache.resize(max_bytes)
+
+
+def reset_default_caches() -> None:
+    """Tests: drop the process-wide caches (and re-resolve byte caps)."""
+    with _DEFAULTS_LOCK:
+        for k in ("result", "subplan"):
+            if _DEFAULTS[k] is not None:
+                _DEFAULTS[k].clear()
+            _DEFAULTS[k] = None

@@ -149,6 +149,21 @@ SYSTEM_PROPERTIES = [
         "(PRESTO_TPU_TASK_PREFETCH)",
         -1, int,
     ),
+    PropertyMetadata(
+        "result_cache_enabled",
+        "serve repeated read-only queries from the structural result "
+        "cache (keyed by plan signature, invalidated by table "
+        "versions; docs/serving.md — query.result-cache-enabled "
+        "config sets the default, query.result-cache-bytes the budget)",
+        False, _bool,
+    ),
+    PropertyMetadata(
+        "subplan_cache_enabled",
+        "reuse warm stage intermediates at exchange boundaries when a "
+        "distributed stage's signature and table versions match a "
+        "prior execution (docs/serving.md)",
+        False, _bool,
+    ),
 ]
 
 

@@ -133,6 +133,16 @@ class WarehouseConnector:
     def row_count(self, table: str) -> int:
         return sum(int(p["rows"]) for p in self._meta(table)["partitions"])
 
+    def table_version(self, table: str):
+        """Monotonically increasing data version, persisted in the
+        metastore and bumped on every committed write — the serving
+        tier's cache-invalidation token (serving/cache.py).  Paired
+        with the table's incarnation id so a drop + recreate can never
+        alias an old incarnation's counter (old metastores without the
+        fields read as version 0 of incarnation '')."""
+        m = self._meta(table)
+        return (m.get("table_id", ""), int(m.get("version", 0)))
+
     def _pvalue_dict(self, table: str, col: str) -> Dictionary:
         """Table-level dictionary for a VARCHAR partition column: the
         ordered distinct partition values."""
@@ -251,14 +261,18 @@ class WarehouseConnector:
             "schema": [[c, _type_str(t)] for c, t in schema],
             "partitioned_by": pby,
             "partitions": [],
+            "table_id": uuid.uuid4().hex[:12],
+            "version": 0,
         }
         self._append(name, meta, schema, pages)
+        meta["version"] = int(meta.get("version", 0)) + 1
         self._write_meta(name, meta)
 
     def append_pages(self, name: str, pages: Sequence[Page]) -> None:
         meta = self._meta(name)
         schema = self.schema(name)
         self._append(name, meta, schema, pages)
+        meta["version"] = int(meta.get("version", 0)) + 1
         self._write_meta(name, meta)
 
     def drop_table(self, name: str) -> None:

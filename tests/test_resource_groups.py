@@ -1,0 +1,234 @@
+"""Direct coverage for resource_groups.py admission policies.
+
+Satellite of the serving-tier PR: the priority/eligibility/subgroup
+paths and DbResourceGroupManager live-reload were only exercised
+indirectly (through the coordinator) — these tests pin the scheduling
+semantics themselves: query_priority ordering, weighted_fair sibling
+eligibility (including the saturated-sibling head-of-line case),
+ancestor-chain concurrency, queue quotas, and concurrent ``group_for``
+calls racing a live reload.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.resource_groups import (
+    DbResourceGroupManager,
+    QueryQueueFullError,
+    ResourceGroup,
+    ResourceGroupManager,
+)
+
+
+def _drain(group, n, timeout=10.0):
+    """Release ``n`` slots of ``group``."""
+    for _ in range(n):
+        group.release()
+
+
+# ---------------------------------------------------------------------------
+# policy paths
+# ---------------------------------------------------------------------------
+
+def test_query_priority_order_beats_fifo():
+    g = ResourceGroup("p", hard_concurrency=1, max_queued=100,
+                      scheduling_policy="query_priority")
+    g.acquire()  # hold the only slot
+    order = []
+    started = []
+
+    def waiter(tag, prio):
+        started.append(tag)
+        g.acquire(timeout=30, priority=prio)
+        order.append(tag)
+        g.release()
+
+    threads = []
+    for tag, prio in (("low", 1), ("mid", 5), ("high", 9)):
+        t = threading.Thread(target=waiter, args=(tag, prio),
+                             daemon=True, name=f"rg-{tag}")
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 5.0
+        while tag not in started and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.05)  # let it enqueue before the next submitter
+    g.release()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert order == ["high", "mid", "low"]
+
+
+def test_weighted_fair_converges_to_weight_ratio():
+    root = ResourceGroup("root", hard_concurrency=1, max_queued=100,
+                         scheduling_policy="weighted_fair")
+    heavy = root.subgroup("heavy", hard_concurrency=1, max_queued=100,
+                          scheduling_weight=3)
+    light = root.subgroup("light", hard_concurrency=1, max_queued=100,
+                          scheduling_weight=1)
+    admitted = []
+    lock = threading.Lock()
+
+    def client(group, tag, n):
+        for _ in range(n):
+            group.acquire(timeout=30)
+            with lock:
+                admitted.append(tag)
+            group.release()
+
+    ts = [threading.Thread(target=client, args=(heavy, "h", 30),
+                           daemon=True, name="rg-heavy"),
+          threading.Thread(target=client, args=(light, "l", 10),
+                           daemon=True, name="rg-light")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30.0)
+    assert admitted.count("h") == 30 and admitted.count("l") == 10
+    # stride scheduling: in any long prefix where both contend, heavy
+    # admissions outnumber light ones (weight 3:1), never the reverse
+    first = admitted[:20]
+    assert first.count("h") >= first.count("l")
+
+
+def test_weighted_fair_saturated_sibling_does_not_starve():
+    """A capacity-saturated preferred child must not idle the parent's
+    free slots (the head-of-line case _eligible handles)."""
+    root = ResourceGroup("root", hard_concurrency=2, max_queued=100,
+                         scheduling_policy="weighted_fair")
+    fat = root.subgroup("fat", hard_concurrency=1, max_queued=100,
+                        scheduling_weight=100)
+    thin = root.subgroup("thin", hard_concurrency=2, max_queued=100,
+                         scheduling_weight=1)
+    fat.acquire()  # fat is now saturated (its own limit, not root's)
+    got = []
+
+    def thin_client():
+        thin.acquire(timeout=5)
+        got.append("thin")
+        thin.release()
+
+    t = threading.Thread(target=thin_client, daemon=True, name="rg-thin")
+    t.start()
+    t.join(timeout=10.0)
+    assert got == ["thin"]  # admitted despite fat's higher weight
+    fat.release()
+
+
+def test_subgroup_concurrency_charges_ancestor_chain():
+    root = ResourceGroup("root", hard_concurrency=2, max_queued=100)
+    a = root.subgroup("a", hard_concurrency=2, max_queued=100)
+    b = root.subgroup("b", hard_concurrency=2, max_queued=100)
+    a.acquire()
+    b.acquire()
+    assert root.running == 2
+    # both children have local capacity, but the ROOT is at its limit
+    with pytest.raises(TimeoutError):
+        a.acquire(timeout=0.1)
+    b.release()
+    a.acquire(timeout=5)  # freed root slot flows to the other child
+    _drain(a, 2)
+    assert root.running == 0
+
+
+def test_queue_quota_is_per_group():
+    g = ResourceGroup("q", hard_concurrency=1, max_queued=1)
+    g.acquire()
+    filler = threading.Thread(
+        target=lambda: (g.acquire(timeout=10), g.release()),
+        daemon=True, name="rg-filler")
+    filler.start()
+    deadline = time.monotonic() + 5.0
+    while g.queued < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with pytest.raises(QueryQueueFullError):
+        g.acquire()
+    g.release()
+    filler.join(timeout=10.0)
+
+
+def test_run_helper_releases_on_exception():
+    g = ResourceGroup("r", hard_concurrency=1, max_queued=10)
+    with pytest.raises(RuntimeError):
+        g.run(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert g.running == 0
+    assert g.run(lambda: 42) == 42
+
+
+# ---------------------------------------------------------------------------
+# DbResourceGroupManager live reload under concurrency
+# ---------------------------------------------------------------------------
+
+def test_db_manager_live_reload_under_concurrent_group_for(tmp_path):
+    """group_for from many threads while an admin connection retunes
+    the tree: every call resolves to a consistent group (old or new
+    generation, never an error), and after the reload settles new
+    queries see the new limits."""
+    db = str(tmp_path / "groups.db")
+    mgr = DbResourceGroupManager(db, poll_interval=0.0)
+    mgr.upsert_group("global", hard_concurrency=16, max_queued=100)
+    mgr.upsert_group("etl", parent="global", hard_concurrency=2)
+    mgr.add_db_selector("etl_.*", "etl")
+
+    stop = threading.Event()
+    errors = []
+    seen = set()
+
+    def resolver(user):
+        while not stop.is_set():
+            try:
+                g = mgr.group_for(user)
+                seen.add((user, g.name))
+                # exercise a full admission cycle through the resolved
+                # group so reload-replaced trees stay internally sound
+                g.acquire(timeout=5)
+                g.release()
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+
+    threads = [threading.Thread(target=resolver, args=(u,), daemon=True,
+                                name=f"rg-resolve-{i}")
+               for i, u in enumerate(["etl_nightly", "alice"] * 3)]
+    for t in threads:
+        t.start()
+    # admin retunes concurrency from a SECOND connection repeatedly
+    # (data_version moves -> the resolving manager hot-reloads)
+    admin = DbResourceGroupManager(db, poll_interval=0.0)
+    for conc in (3, 4, 5, 6):
+        admin.upsert_group("etl", parent="global", hard_concurrency=conc)
+        time.sleep(0.02)
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors
+    assert ("etl_nightly", "global.etl") in seen
+    assert ("alice", "global") in seen
+    # the reload settled: new resolutions carry the last written limit
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if mgr.group_for("etl_nightly").hard_concurrency == 6:
+            break
+        time.sleep(0.02)
+    assert mgr.group_for("etl_nightly").hard_concurrency == 6
+
+
+def test_db_manager_orphan_and_selector_priority(tmp_path):
+    db = str(tmp_path / "groups.db")
+    mgr = DbResourceGroupManager(db, poll_interval=0.0)
+    mgr.upsert_group("global", hard_concurrency=8)
+    mgr.upsert_group("a", parent="global", hard_concurrency=2)
+    # orphan row (parent never defined) is ignored, not fatal
+    mgr.upsert_group("lost", parent="nope", hard_concurrency=1)
+    # higher-priority selector wins for overlapping patterns
+    mgr.upsert_group("b", parent="global", hard_concurrency=3)
+    mgr.add_db_selector("user.*", "a", priority=1)
+    mgr.add_db_selector("user_vip", "b", priority=9)
+    assert mgr.group_for("user_vip").name == "global.b"
+    assert mgr.group_for("user_x").name == "global.a"
+    assert mgr.group_for("nobody").name == "global"

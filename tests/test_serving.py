@@ -1,0 +1,764 @@
+"""Serving tier: memory-aware admission control + structural caches.
+
+Covers presto_tpu/serving/ (docs/serving.md): the admission
+controller's concurrency/memory gates and queue positions, the
+result/subplan caches' structural keying and version invalidation (the
+correctness pin: stale results are NEVER served), the coordinator's
+distinct policy error codes, and every observability surface the
+subsystem promises (admission.*/cache.* metrics, queued/admitted query
+log events, system_runtime_queries.cache_hit).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from presto_tpu.obs import METRICS
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    from presto_tpu.serving import reset_default_caches
+
+    reset_default_caches()
+    yield
+    reset_default_caches()
+
+
+def _snap(*names):
+    rows = dict(METRICS.snapshot())
+    return tuple(rows.get(n, 0.0) for n in names)
+
+
+# ---------------------------------------------------------------------------
+# StructuralCache mechanics
+# ---------------------------------------------------------------------------
+
+def test_structural_cache_lru_bytes_and_versions():
+    from presto_tpu.serving.cache import StructuralCache
+
+    c = StructuralCache(max_bytes=100, metric_prefix="result")
+    v = (("m", "t", 1),)
+    assert c.get("k1", v) is None  # miss
+    assert c.put("k1", v, "a", 40)
+    assert c.get("k1", v) == "a"  # hit
+    # version mismatch drops the entry (lazy write invalidation)
+    assert c.get("k1", (("m", "t", 2),)) is None
+    assert c.stats()["invalidations"] == 1
+    assert c.get("k1", v) is None  # gone
+    # byte-capped LRU: inserting past the cap evicts oldest-first
+    assert c.put("a", v, "x", 40)
+    assert c.put("b", v, "y", 40)
+    assert c.get("a", v) == "x"  # refresh a
+    assert c.put("c", v, "z", 40)  # 120 > 100: evicts b (LRU)
+    assert c.get("b", v) is None
+    assert c.get("a", v) == "x"
+    assert c.get("c", v) == "z"
+    # oversize values (> half the budget) are refused, not stored
+    assert not c.put("big", v, "w", 60)
+    assert c.stats()["bytes"] <= 100
+
+
+def test_plan_signature_structural_and_determinism():
+    from presto_tpu.serving.cache import (
+        plan_cache_key, plan_deterministic, plan_table_versions,
+    )
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    p1 = r.plan("SELECT count(*) FROM lineitem WHERE l_quantity < 10")
+    p2 = r.plan("select COUNT(*) from lineitem where l_quantity < 10")
+    p3 = r.plan("SELECT count(*) FROM lineitem WHERE l_quantity < 11")
+    k1, k2, k3 = map(plan_cache_key, (p1, p2, p3))
+    assert k1 == k2  # textually different, structurally identical
+    assert k1 != k3  # the literal is part of the structure
+    assert plan_deterministic(p1)
+    # nondeterministic calls make a tree uncacheable (the SQL surface
+    # has no random() yet, so the IR guard is exercised directly)
+    from presto_tpu.expr.ir import Call
+    from presto_tpu.types import DOUBLE
+
+    nondet = Call(type=DOUBLE, fn="random", args=())
+    assert not plan_deterministic(nondet)
+    assert plan_cache_key(nondet) is None
+    # tpch tables are versioned (immutable, version 0)
+    assert plan_table_versions(p1, r.catalog) == \
+        (("tpch", "lineitem", 0),)
+
+
+def test_unversioned_connector_is_uncacheable():
+    from presto_tpu.connectors.system import QueryHistory, SystemConnector
+    from presto_tpu.serving.cache import plan_table_versions
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    r.catalog.register("system", SystemConnector(QueryHistory()))
+    plan = r.plan("SELECT count(*) FROM system_metrics")
+    assert plan_table_versions(plan, r.catalog) is None
+    # and the full pipeline therefore never caches it
+    r.execute("SET SESSION result_cache_enabled = true")
+    res1 = r.execute("SELECT count(*) FROM system_metrics")
+    res2 = r.execute("SELECT count(*) FROM system_metrics")
+    assert res1.cache_hit is None and res2.cache_hit is None
+
+
+# ---------------------------------------------------------------------------
+# table versions
+# ---------------------------------------------------------------------------
+
+def test_memory_connector_versions_bump_on_every_write():
+    import numpy as np
+
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.page import Page
+    from presto_tpu.types import BIGINT
+
+    conn = MemoryConnector()
+    _, v0 = conn.table_version("t")
+    assert v0 == 0
+    page = Page.from_arrays([np.arange(4, dtype=np.int64)], [BIGINT])
+    conn.create_table("t", [("a", BIGINT)], [page])
+    _, v1 = conn.table_version("t")
+    conn.append_pages("t", [page])
+    _, v2 = conn.table_version("t")
+    conn.add_column("t", "b", BIGINT)
+    _, v3 = conn.table_version("t")
+    conn.drop_column("t", "b")
+    _, v4 = conn.table_version("t")
+    conn.rename_table("t", "u")
+    _, v5 = conn.table_version("u")
+    conn.drop_table("u")
+    _, v6 = conn.table_version("u")
+    assert v1 < v2 < v3 < v4 < v5 < v6  # strictly monotone
+    # two instances can never alias (same names/shapes, different data)
+    other = MemoryConnector()
+    other.create_table("t", [("a", BIGINT)], [page])
+    assert other.table_version("t") != conn.table_version("t")
+
+
+def test_warehouse_versions_persist_and_survive_recreate(tmp_path):
+    import numpy as np
+
+    from presto_tpu.page import Page
+    from presto_tpu.storage.warehouse import WarehouseConnector
+    from presto_tpu.types import BIGINT
+
+    root = str(tmp_path / "wh")
+    conn = WarehouseConnector(root)
+    page = Page.from_arrays([np.arange(4, dtype=np.int64)], [BIGINT])
+    conn.create_table("t", [("a", BIGINT)], [page])
+    v1 = conn.table_version("t")
+    conn.append_pages("t", [page])
+    v2 = conn.table_version("t")
+    assert v1 != v2 and v2[1] > v1[1]
+    # a second connector over the same root sees the SAME version
+    # (data-addressed, so two coordinators share cache entries)
+    assert WarehouseConnector(root).table_version("t") == v2
+    # drop + recreate changes the incarnation id: old entries dead even
+    # though the counter restarted
+    conn.drop_table("t")
+    conn.create_table("t", [("a", BIGINT)], [page])
+    assert conn.table_version("t") != v1
+
+
+# ---------------------------------------------------------------------------
+# result cache end-to-end (the correctness pin)
+# ---------------------------------------------------------------------------
+
+def _cached_runner(sf=0.001):
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=sf)
+    r.execute("SET SESSION result_cache_enabled = true")
+    return r
+
+
+def test_result_cache_hit_and_metrics():
+    r = _cached_runner()
+    h0, m0 = _snap("cache.result_hits", "cache.result_misses")
+    a = r.execute("SELECT count(*) FROM lineitem WHERE l_quantity < 10")
+    b = r.execute("SELECT count(*) FROM lineitem WHERE l_quantity < 10")
+    assert a.cache_hit is False and b.cache_hit is True
+    assert a.rows == b.rows
+    # structural: different text, same plan shape
+    c = r.execute("select COUNT(*) from lineitem where l_quantity < 10")
+    assert c.cache_hit is True and c.rows == a.rows
+    h1, m1 = _snap("cache.result_hits", "cache.result_misses")
+    assert h1 - h0 == 2 and m1 - m0 == 1
+
+
+def test_result_cache_never_serves_stale_rows():
+    """The acceptance-criteria pin: a write to a cached table
+    invalidates its entries — every post-write read reflects the
+    write, through INSERT, DELETE and CTAS-replacement."""
+    r = _cached_runner()
+    r.execute("CREATE TABLE t AS SELECT l_orderkey, l_quantity "
+              "FROM lineitem WHERE l_quantity < 5")
+    q = "SELECT count(*) FROM t"
+    base = r.execute(q).rows[0][0]
+    assert r.execute(q).cache_hit is True  # warm
+    r.execute("INSERT INTO t SELECT l_orderkey, l_quantity "
+              "FROM lineitem WHERE l_quantity = 5")
+    after_insert = r.execute(q)
+    assert after_insert.cache_hit is False  # version moved: no stale hit
+    assert after_insert.rows[0][0] > base
+    assert r.execute(q).cache_hit is True  # re-warmed at the new version
+    r.execute("DELETE FROM t WHERE l_quantity = 5")
+    after_delete = r.execute(q)
+    assert after_delete.cache_hit is False
+    assert after_delete.rows[0][0] == base
+    inv, = _snap("cache.result_invalidations")
+    assert inv >= 1
+
+
+def test_result_cache_write_during_execution_is_not_cached_as_current():
+    """Versions are captured at PLAN time: an entry stored after a
+    concurrent write carries the pre-write versions, so the next lookup
+    misses instead of serving the torn snapshot as current."""
+    from presto_tpu.serving.cache import default_result_cache
+
+    r = _cached_runner()
+    r.execute("CREATE TABLE t AS SELECT l_orderkey FROM lineitem "
+              "WHERE l_quantity < 5")
+    plan = r.plan("SELECT count(*) FROM t")
+    cache = default_result_cache()
+    prepared = cache.prepare(plan, r.catalog)
+    assert prepared is not None
+    # the write lands between prepare (plan time) and store
+    r.execute("INSERT INTO t SELECT l_orderkey FROM lineitem "
+              "WHERE l_quantity = 5")
+    cache.store(prepared, ["c"], [None], [(123,)])
+    fresh = cache.prepare(plan, r.catalog)
+    assert cache.lookup(fresh) is None  # stale-by-version, never served
+
+
+def test_result_cache_disabled_by_default():
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    a = r.execute("SELECT count(*) FROM lineitem")
+    b = r.execute("SELECT count(*) FROM lineitem")
+    assert a.cache_hit is None and b.cache_hit is None
+
+
+def test_cache_hit_in_query_log_and_system_table(tmp_path):
+    from presto_tpu.connectors.system import QueryHistory, SystemConnector
+    from presto_tpu.obs import QueryLogListener
+
+    r = _cached_runner()
+    hist = QueryHistory()
+    r.events.add(hist)
+    log = tmp_path / "query.log"
+    r.events.add(QueryLogListener(str(log)))
+    r.catalog.register("system", SystemConnector(hist))
+    r.execute("SELECT count(*) FROM lineitem WHERE l_quantity < 7")
+    r.execute("SELECT count(*) FROM lineitem WHERE l_quantity < 7")
+    # history is insertion-ordered: cold execution then the warm hit
+    rows = r.execute(
+        "SELECT query_id, cache_hit FROM system_runtime_queries "
+        "WHERE cache_hit IS NOT NULL").rows
+    assert [h for _, h in rows] == [0, 1]
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    hits = [l.get("cache_hit") for l in lines if "state" in l]
+    assert True in hits  # the cached completion line says so
+
+
+# ---------------------------------------------------------------------------
+# subplan (stage) cache
+# ---------------------------------------------------------------------------
+
+def _dist_runner():
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.001)
+    r.execute("SET SESSION distributed = true")
+    r.execute("SET SESSION subplan_cache_enabled = true")
+    r.execute("SET SESSION distributed_min_stage_rows = 0")
+    return r
+
+
+def test_subplan_cache_repeat_and_shared_prefix():
+    r = _dist_runner()
+    q = ("SELECT l_returnflag, sum(l_quantity) FROM lineitem "
+         "GROUP BY l_returnflag ORDER BY l_returnflag")
+    variant = ("SELECT l_returnflag, sum(l_quantity) FROM lineitem "
+               "GROUP BY l_returnflag ORDER BY 2 DESC LIMIT 2")
+    h0, = _snap("cache.subplan_hits")
+    first = r.execute(q)
+    h1, = _snap("cache.subplan_hits")
+    second = r.execute(q)
+    h2, = _snap("cache.subplan_hits")
+    assert second.rows == first.rows
+    assert h2 > h1  # the repeat hit warm stage intermediates
+    third = r.execute(variant)  # dashboard variant: shared agg prefix
+    h3, = _snap("cache.subplan_hits")
+    assert h3 > h2
+    # the variant's answer is consistent with the uncached base query
+    by_flag = dict(first.rows)
+    assert all(by_flag[f] == v for f, v in third.rows)
+
+
+def test_subplan_cache_invalidated_by_write():
+    r = _dist_runner()
+    r.execute("CREATE TABLE t AS SELECT l_returnflag, l_quantity "
+              "FROM lineitem")
+    q = ("SELECT l_returnflag, sum(l_quantity) FROM t "
+         "GROUP BY l_returnflag ORDER BY l_returnflag")
+    base = r.execute(q).rows
+    warm = r.execute(q).rows
+    assert warm == base
+    # duplicate the whole table: the appended page has the SAME shape
+    # as the original (the mesh tier predates ragged memory-table
+    # appends), and every sum exactly doubles — a stale warm
+    # intermediate would be off by half
+    r.execute("INSERT INTO t SELECT l_returnflag, l_quantity FROM t")
+    after = dict(r.execute(q).rows)
+    assert after == {f: 2 * v for f, v in base}
+    # and the post-write state re-warms at the new version
+    assert dict(r.execute(q).rows) == after
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def _controller(pool=None, **kw):
+    from presto_tpu.resource_groups import ResourceGroup, ResourceGroupManager
+    from presto_tpu.serving import AdmissionController
+
+    root = kw.pop("root", None) or ResourceGroup(
+        "global", hard_concurrency=kw.pop("hard_concurrency", 4),
+        max_queued=kw.pop("max_queued", 100))
+    return AdmissionController(ResourceGroupManager(root), pool=pool, **kw)
+
+
+def test_admission_concurrency_and_queue_positions():
+    ctl = _controller(hard_concurrency=1)
+    t1 = ctl.admit("q1", "alice")
+    order = []
+    done = threading.Event()
+
+    def waiter(qid):
+        t = ctl.admit(qid, "alice", timeout=10.0)
+        order.append(qid)
+        if len(order) == 2:
+            done.set()
+        ctl.release(t)
+
+    ws = [threading.Thread(target=waiter, args=(f"q{i}",), daemon=True,
+                           name=f"admit-{i}") for i in (2, 3)]
+    ws[0].start()
+    # q2 must be queued at position 1 before q3 enters
+    deadline = time.monotonic() + 5.0
+    while ctl.queue_position("q2") is None \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ctl.queue_position("q2") == 1
+    ws[1].start()
+    deadline = time.monotonic() + 5.0
+    while ctl.queue_position("q3") is None \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ctl.queue_position("q3") == 2
+    assert ctl.queue_depth() == 2
+    ctl.release(t1)  # frees the only slot: q2 then q3 run
+    assert done.wait(timeout=10.0)
+    for w in ws:
+        w.join(timeout=5.0)
+    assert order == ["q2", "q3"]
+    assert ctl.queue_depth() == 0
+
+
+def test_admission_memory_gate_blocks_until_headroom():
+    from presto_tpu.memory import MemoryPool
+
+    pool = MemoryPool(1000)
+    pool.reserve("other/x", 950)  # pool nearly full
+    ctl = _controller(pool=pool, memory_fraction=0.9)
+    b0, = _snap("admission.memory_blocked_total")
+    got = []
+
+    def submit():
+        t = ctl.admit("q1", "alice", timeout=10.0)
+        got.append(t)
+
+    th = threading.Thread(target=submit, daemon=True, name="admit-mem")
+    th.start()
+    time.sleep(0.3)
+    assert not got  # blocked: 950 > 0.9 * 1000
+    pool.free("other/x")
+    th.join(timeout=10.0)
+    assert got and got[0].state == "ADMITTED"
+    b1, = _snap("admission.memory_blocked_total")
+    assert b1 > b0
+    ctl.release(got[0])
+
+
+def test_admission_memory_projection_from_history():
+    from presto_tpu.memory import MemoryPool
+
+    pool = MemoryPool(1000)
+    ctl = _controller(pool=pool, memory_fraction=0.9)
+    ctl.record_peak("SELECT big", 800)
+    assert ctl.projected_bytes("SELECT big") == 800
+    pool.reserve("other/x", 300)
+    # 300 + 800 > 900: the remembered peak blocks admission...
+    with pytest.raises(TimeoutError):
+        ctl.admit("q1", "alice", timeout=0.2, statement_key="SELECT big")
+    # ...while an unseen statement (projection 0) sails through
+    t = ctl.admit("q2", "alice", timeout=5.0, statement_key="SELECT small")
+    ctl.release(t)
+    pool.free("other/x")
+    # idle pool: even an oversized projection admits (no wedging)
+    t = ctl.admit("q3", "alice", timeout=5.0, statement_key="SELECT big")
+    ctl.release(t)
+
+
+def test_admission_burst_serializes_on_projected_bytes():
+    """A burst of heavy statements must NOT all pass the headroom
+    check before any of them reserves: admitted-but-unreserved
+    projections count against headroom, so the second heavy query
+    waits for the first ticket's release even while pool.reserved is
+    still 0."""
+    from presto_tpu.memory import MemoryPool
+
+    pool = MemoryPool(1000)
+    ctl = _controller(pool=pool, memory_fraction=0.9, hard_concurrency=8)
+    ctl.record_peak("heavy", 600)
+    t1 = ctl.admit("q1", "alice", statement_key="heavy")
+    got = []
+
+    def second():
+        got.append(ctl.admit("q2", "alice", timeout=10.0,
+                             statement_key="heavy"))
+
+    th = threading.Thread(target=second, daemon=True, name="admit-burst")
+    th.start()
+    time.sleep(0.3)
+    assert not got  # 600 (inflight) + 600 (q2) > 900, reserved still 0
+    # q1 reserving its actual bytes discounts its projection 1:1 —
+    # still no double-count headroom for q2
+    pool.reserve("q1/build", 600)
+    time.sleep(0.2)
+    assert not got
+    ctl.release(t1)  # q1 done (its reservation freed by the query end)
+    pool.free("q1/build")
+    th.join(timeout=10.0)
+    assert got and got[0].state == "ADMITTED"
+    ctl.release(got[0])
+
+
+def test_admission_concurrent_burst_never_overcommits():
+    """The headroom decision and the ADMITTED transition are one
+    critical section: N threads admitting the same heavy statement
+    SIMULTANEOUSLY never hold more than one admitted ticket at a time
+    (each projection is 600 of the 900 headroom)."""
+    from presto_tpu.memory import MemoryPool
+
+    pool = MemoryPool(1000)
+    ctl = _controller(pool=pool, memory_fraction=0.9, hard_concurrency=8)
+    ctl.record_peak("heavy", 600)
+    lock = threading.Lock()
+    live = [0]
+    max_live = [0]
+    errors = []
+
+    def worker(i):
+        try:
+            t = ctl.admit(f"q{i}", "alice", timeout=30.0,
+                          statement_key="heavy")
+        except Exception as e:
+            errors.append(repr(e))
+            return
+        with lock:
+            live[0] += 1
+            max_live[0] = max(max_live[0], live[0])
+        time.sleep(0.05)  # hold the admission while others race
+        with lock:
+            live[0] -= 1
+        ctl.release(t)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                name=f"burst-{i}") for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errors
+    assert max_live[0] == 1  # serialized, never overcommitted
+
+
+def test_admission_cancel_raises_not_admits():
+    """cancel() during the memory wait must NOT produce a successful
+    admission (no admitted counter, no slot held)."""
+    from presto_tpu.memory import MemoryPool
+    from presto_tpu.serving.admission import AdmissionCancelledError
+
+    pool = MemoryPool(1000)
+    pool.reserve("other/x", 950)
+    ctl = _controller(pool=pool, memory_fraction=0.9)
+    a0, = _snap("admission.admitted_total")
+    outcome = []
+
+    def submit():
+        try:
+            outcome.append(ctl.admit("q1", "alice", timeout=10.0))
+        except AdmissionCancelledError as e:
+            outcome.append(e)
+
+    th = threading.Thread(target=submit, daemon=True, name="admit-cxl")
+    th.start()
+    time.sleep(0.2)
+    ctl.cancel("q1")
+    th.join(timeout=10.0)
+    assert len(outcome) == 1
+    assert isinstance(outcome[0], AdmissionCancelledError)
+    a1, = _snap("admission.admitted_total")
+    assert a1 == a0  # nothing counted as admitted
+    pool.free("other/x")
+    # the group slot was released: a fresh admit sails through
+    t = ctl.admit("q2", "alice", timeout=5.0)
+    ctl.release(t)
+
+
+def test_admission_gauges_aggregate_across_controllers():
+    c1 = _controller(hard_concurrency=4)
+    c2 = _controller(hard_concurrency=4)
+    t1 = c1.admit("g1", "alice")
+    t2 = c2.admit("g2", "bob")
+    running, = _snap("admission.running")
+    assert running >= 2  # both controllers' tickets visible in ONE gauge
+    c1.release(t1)
+    c2.release(t2)
+
+
+def test_result_cache_bytes_config_wiring():
+    from presto_tpu.serving import (
+        default_result_cache, set_result_cache_bytes,
+    )
+
+    cache = default_result_cache()
+    set_result_cache_bytes(12345)
+    assert cache.cache.max_bytes == 12345  # live resize
+    # and a freshly-built default picks the override up too
+    from presto_tpu.serving import reset_default_caches
+
+    reset_default_caches()
+    assert default_result_cache().cache.max_bytes == 12345
+    from presto_tpu.serving.cache import _RESULT_CACHE_BYTES
+
+    _RESULT_CACHE_BYTES.set(None)  # restore env/default resolution
+
+
+def test_subplan_identity_keys_are_not_stored():
+    from presto_tpu.serving.cache import (
+        SubplanCache, signature_has_identity_keys,
+    )
+    from presto_tpu.exec.programs import ir_signature
+
+    class Opaque:  # not a dataclass: ir_signature keys it by identity
+        pass
+
+    sig = ir_signature((1, "x", Opaque()))
+    assert signature_has_identity_keys(sig)
+    assert not signature_has_identity_keys(ir_signature((1, "x", 2.5)))
+    # prepare() refuses a stage keyed by an intermediate's identity
+    # (a PrecomputedNode leaf carries a live Page, identity-signed)
+    import numpy as np
+
+    from presto_tpu.page import Page
+    from presto_tpu.planner.plan import PrecomputedNode
+    from presto_tpu.testing import LocalQueryRunner
+    from presto_tpu.types import BIGINT
+
+    r = LocalQueryRunner(sf=0.001)
+    page = Page.from_arrays([np.arange(2, dtype=np.int64)], [BIGINT])
+    pre = PrecomputedNode(page=page, channel_list=[])
+    assert SubplanCache(1 << 20).prepare(pre, r.catalog) is None
+
+
+def test_admission_rejections_and_metrics():
+    from presto_tpu.resource_groups import QueryQueueFullError
+
+    ctl = _controller(hard_concurrency=1, max_queued=1)
+    t1 = ctl.admit("q1", "alice")
+    qf0, to0 = _snap("admission.rejected_queue_full",
+                     "admission.rejected_timeout")
+    hold = threading.Thread(
+        target=lambda: ctl.release(ctl.admit("q2", "alice", timeout=10.0)),
+        daemon=True, name="admit-hold")
+    hold.start()
+    deadline = time.monotonic() + 5.0
+    while ctl.queue_depth() < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(QueryQueueFullError):
+        ctl.admit("q3", "alice")  # queue quota (1) already taken
+    ctl.release(t1)
+    hold.join(timeout=10.0)
+    # a group that can never admit: the wait expires as TimeoutError
+    frozen = _controller(hard_concurrency=0, max_queued=10)
+    with pytest.raises(TimeoutError):
+        frozen.admit("q4", "bob", timeout=0.1)
+    qf1, to1 = _snap("admission.rejected_queue_full",
+                     "admission.rejected_timeout")
+    assert qf1 - qf0 == 1 and to1 - to0 >= 1
+
+
+def test_peak_bytes_are_per_thread_not_shared():
+    """res.peak_bytes feeds the admission projection history, so a
+    light query racing a heavy one on the same runner must never
+    inherit the heavy footprint (executor.last_peak_bytes is
+    thread-local)."""
+    from presto_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner(sf=0.002)
+    out = {}
+
+    def run(tag, sql):
+        res = r.execute(sql)
+        out[tag] = getattr(res, "peak_bytes", None)
+
+    heavy = ("SELECT l_orderkey, sum(l_quantity) FROM lineitem "
+             "GROUP BY l_orderkey")
+    ts = [threading.Thread(target=run, args=("heavy", heavy),
+                           daemon=True, name="peak-heavy"),
+          threading.Thread(target=run, args=("light", "SELECT 1"),
+                           daemon=True, name="peak-light")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60.0)
+    assert out["heavy"] is not None and out["light"] is not None
+    # SELECT 1 reserves a few bytes of its own; it must record THAT,
+    # never the concurrent aggregation's footprint (which is orders of
+    # magnitude larger — a shared attribute would swap them)
+    assert out["heavy"] > 10_000
+    assert out["light"] < 1_000
+    assert out["light"] != out["heavy"]
+
+
+def test_admission_events_emitted():
+    from presto_tpu.events import EventListener, EventListenerManager
+
+    seen = []
+
+    class Rec(EventListener):
+        def query_queued(self, e):
+            seen.append(("queued", e.query_id, e.position))
+
+        def query_admitted(self, e):
+            seen.append(("admitted", e.query_id, e.queued_ms))
+
+    events = EventListenerManager()
+    events.add(Rec())
+    ctl = _controller(events=events)
+    t = ctl.admit("q1", "alice")
+    ctl.release(t)
+    kinds = [s[0] for s in seen]
+    assert kinds == ["queued", "admitted"]
+    assert seen[0][1] == "q1" and seen[1][2] >= 0
+
+
+# ---------------------------------------------------------------------------
+# coordinator: error codes + queue position over the statement protocol
+# ---------------------------------------------------------------------------
+
+def _coordinator(**kw):
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.testing import LocalQueryRunner
+
+    runner = LocalQueryRunner(sf=0.001)
+    return CoordinatorServer(runner, **kw), runner
+
+
+def test_queue_full_maps_to_distinct_error_code():
+    from presto_tpu.resource_groups import ResourceGroup, ResourceGroupManager
+
+    groups = ResourceGroupManager(
+        ResourceGroup("tiny", hard_concurrency=0, max_queued=0))
+    srv, _ = _coordinator(resource_groups=groups)
+    q = srv._submit("SELECT 1")
+    assert q.done.wait(timeout=10.0)
+    assert q.state == "FAILED"
+    assert q.error_code == "QUERY_QUEUE_FULL"
+    page = srv._page_response(q, 0)
+    assert page["errorCode"] == "QUERY_QUEUE_FULL"
+    srv.stop(drain_timeout=2.0)
+
+
+def test_queue_timeout_maps_to_exceeded_queue_time():
+    from presto_tpu.resource_groups import ResourceGroup, ResourceGroupManager
+
+    groups = ResourceGroupManager(
+        ResourceGroup("frozen", hard_concurrency=0))
+    srv, _ = _coordinator(resource_groups=groups, max_queued_time=0.2)
+    q = srv._submit("SELECT 1")
+    assert q.done.wait(timeout=10.0)
+    assert q.state == "FAILED"
+    assert q.error_code == "EXCEEDED_QUEUE_TIME"
+    assert "timed out" in q.error
+    page = srv._page_response(q, 0)
+    assert page["errorCode"] == "EXCEEDED_QUEUE_TIME"
+    srv.stop(drain_timeout=2.0)
+
+
+def test_statement_protocol_serves_queue_position():
+    from presto_tpu.resource_groups import ResourceGroup, ResourceGroupManager
+
+    groups = ResourceGroupManager(
+        ResourceGroup("one", hard_concurrency=1, max_queued=10))
+    srv, _ = _coordinator(resource_groups=groups, max_queued_time=30.0)
+    blocker = srv._submit("SELECT count(*) FROM lineitem l1, lineitem l2 "
+                          "WHERE l1.l_quantity = l2.l_quantity")
+    # wait for the blocker to hold the slot
+    deadline = time.monotonic() + 10.0
+    while blocker.state == "QUEUED" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    waiting = srv._submit("SELECT 2")
+    deadline = time.monotonic() + 10.0
+    pos = None
+    while time.monotonic() < deadline:
+        page = srv._page_response(waiting, 0)
+        pos = page.get("stats", {}).get("queuePosition")
+        if pos is not None or waiting.state != "QUEUED":
+            break
+        time.sleep(0.01)
+    assert pos == 1  # first in line behind the running blocker
+    assert waiting.summary()["queuePosition"] == 1
+    assert blocker.done.wait(timeout=60.0)
+    assert waiting.done.wait(timeout=60.0)
+    srv.stop(drain_timeout=5.0)
+
+
+def test_coordinator_serves_cache_hit_stat_and_logs(tmp_path):
+    from presto_tpu.obs import QueryLogListener
+
+    srv, runner = _coordinator()
+    log = tmp_path / "query.log"
+    runner.events.add(QueryLogListener(str(log)))
+    runner.execute("SET SESSION result_cache_enabled = true")
+    sql = "SELECT count(*) FROM lineitem WHERE l_quantity < 9"
+    q1 = srv._submit(sql)
+    assert q1.done.wait(timeout=30.0) and q1.state == "FINISHED"
+    q2 = srv._submit(sql)
+    assert q2.done.wait(timeout=30.0) and q2.state == "FINISHED"
+    assert srv._page_response(q1, 0)["stats"]["cacheHit"] is False
+    assert srv._page_response(q2, 0)["stats"]["cacheHit"] is True
+    assert q2.rows == q1.rows
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    events = [l.get("event") for l in lines if l.get("event")]
+    assert "query_queued" in events and "query_admitted" in events
+    srv.stop(drain_timeout=5.0)
+
+
+def test_cli_progress_text_shows_queue_position():
+    from presto_tpu.cli import _progress_text
+
+    text = _progress_text({"state": "QUEUED", "queuePosition": 3})
+    assert "queued #3" in text
+    text = _progress_text({"state": "RUNNING", "progressPercentage": 42.0})
+    assert "42.0%" in text and "queued" not in text

@@ -23,6 +23,15 @@ carries aggregate rows/s plus p50/p95 per-execution latency — the
 cross-query behavior of the split scheduler measured, not assumed.
 ``--task-concurrency`` pins the morsel scheduler width for A/B legs
 (1 = the serial baseline).
+
+``--hot-cold H:C`` (with ``--streams``) runs the serving-tier workload
+mix: of every H+C executions per client, H repeat the suite query
+verbatim (the hot dashboard set) and C run a UNIQUE structurally
+distinct cold variant — reporting per-class p50/p95 and the result-
+cache hit rate.  ``--result-cache on`` enables the structural result
+cache (the A/B lever for PERF.md), ``--admit N`` routes every
+execution through a serving-tier AdmissionController with per-group
+hard concurrency N so enforced limits are part of what's measured.
 """
 
 from __future__ import annotations
@@ -202,13 +211,9 @@ def run_streams(runner, name: str, sql: str, streams: int, runs: int):
     lat = sorted(latencies)
 
     def pct(p):
-        # nearest-rank percentile (ceil, 1-indexed): floor-indexing
-        # returned the MAX for any n <= 20, making "p95" a worst-case
-        # outlier report at default stream counts
-        import math
-
-        return lat[min(len(lat) - 1,
-                       max(0, math.ceil(p / 100.0 * len(lat)) - 1))]
+        # nearest-rank (ceil, 1-indexed): floor-indexing returned the
+        # MAX for any n <= 20, making "p95" a worst-case outlier report
+        return _percentile(lat, p)
 
     row = {
         "query": name,
@@ -225,6 +230,140 @@ def run_streams(runner, name: str, sql: str, streams: int, runs: int):
     }
     if errors:
         row["errors"] = errors
+    return row
+
+
+def _percentile(sorted_vals, p):
+    """Nearest-rank percentile (ceil, 1-indexed) — run_streams' pct."""
+    import math
+
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           max(0, math.ceil(p / 100.0 * len(sorted_vals)) - 1))]
+
+
+def _cold_variant(sql: str, uid: int) -> str:
+    """A structurally distinct sibling of ``sql``: a huge, unique LIMIT
+    changes the plan shape (TopN/Limit count is part of the structural
+    signature) without changing the rows — so cold variants can never
+    hit the hot entry yet stay oracle-comparable."""
+    base = sql.strip().rstrip(";")
+    if " limit " in base.lower():
+        return f"SELECT * FROM ({base}) cold_{uid} LIMIT {9_000_000 + uid}"
+    return f"{base} LIMIT {9_000_000 + uid}"
+
+
+def run_hot_cold(runner, name: str, sql: str, streams: int, runs: int,
+                 mix: str, admit: int = 0):
+    """Serving-tier workload mix: each of ``streams`` clients runs
+    ``runs`` executions scheduled hot:cold by ``mix`` (e.g. ``3:1``).
+    Hot = the query verbatim (result-cache candidates); cold = unique
+    structural variants (guaranteed misses).  Reports per-class p50/p95
+    and the result-cache hit rate over the run; ``--admit N`` funnels
+    every execution through an AdmissionController so per-group limits
+    are enforced while the percentiles are measured."""
+    import statistics as stats
+    import threading
+
+    from presto_tpu.obs import METRICS
+
+    h, c = (int(x) for x in mix.split(":"))
+    if h <= 0 or c < 0:
+        raise SystemExit(f"bad --hot-cold mix {mix!r} (use e.g. 3:1)")
+    ctl = None
+    if admit > 0:
+        from presto_tpu.resource_groups import (
+            ResourceGroup, ResourceGroupManager,
+        )
+        from presto_tpu.serving import AdmissionController
+
+        ctl = AdmissionController(
+            ResourceGroupManager(ResourceGroup(
+                "bench", hard_concurrency=admit, max_queued=10_000)),
+            pool=runner.memory_pool)
+    warm = runner.execute(sql)  # plan + compile out of the measurement
+    snap0 = dict(METRICS.snapshot())
+    lock = threading.Lock()
+    lat = {"hot": [], "cold": []}
+    queue_waits: list = []
+    errors: list = []
+    uid_counter = [0]
+
+    def client(ci: int):
+        for k in range(runs):
+            hot = (k % (h + c)) < h
+            if hot:
+                stmt = sql
+            else:
+                with lock:
+                    uid_counter[0] += 1
+                    uid = uid_counter[0]
+                stmt = _cold_variant(sql, uid)
+            ticket = None
+            t0 = time.perf_counter()
+            try:
+                if ctl is not None:
+                    ticket = ctl.admit(f"{name}-{ci}-{k}", "bench",
+                                       timeout=300.0, statement_key=stmt)
+                    queue_waits.append(ticket.queued_ms())
+                res = runner.execute(stmt)
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            finally:
+                if ctl is not None:
+                    ctl.release(ticket)
+            dt = time.perf_counter() - t0
+            with lock:
+                lat["hot" if hot else "cold"].append(dt)
+                del res
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"hotcold-{i}")
+               for i in range(streams)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    snap1 = dict(METRICS.snapshot())
+
+    def delta(metric):
+        return snap1.get(metric, 0.0) - snap0.get(metric, 0.0)
+
+    hits, misses = delta("cache.result_hits"), delta("cache.result_misses")
+    row = {
+        "query": name,
+        "streams": streams,
+        "mix": mix,
+        "rows": len(warm),
+        "executions": len(lat["hot"]) + len(lat["cold"]),
+        "wall_s": round(wall, 3),
+        "queries_per_s": round(
+            (len(lat["hot"]) + len(lat["cold"])) / wall, 3) if wall else None,
+        "cache_result_hits": int(hits),
+        "cache_result_misses": int(misses),
+        "cache_hit_rate": (round(hits / (hits + misses), 3)
+                           if hits + misses else None),
+    }
+    for cls in ("hot", "cold"):
+        vals = sorted(lat[cls])
+        row[cls] = {
+            "executions": len(vals),
+            "p50_s": round(stats.median(vals), 4) if vals else None,
+            "p95_s": round(_percentile(vals, 95), 4) if vals else None,
+            "max_s": round(vals[-1], 4) if vals else None,
+        }
+    if ctl is not None:
+        qw = sorted(queue_waits)
+        row["admit_concurrency"] = admit
+        row["queue_wait_p50_ms"] = round(_percentile(qw, 50), 2) if qw else None
+        row["queue_wait_p95_ms"] = round(_percentile(qw, 95), 2) if qw else None
+    if errors:
+        row["errors"] = errors[:5]
     return row
 
 
@@ -249,6 +388,19 @@ def main():
                     help="pin the morsel split-scheduler width for this "
                          "run (session task_concurrency; 1 = serial A/B "
                          "leg, 0 = process default)")
+    ap.add_argument("--hot-cold", default=None, metavar="MIX",
+                    help="with --streams: hot:cold execution mix per "
+                         "client (e.g. 3:1) — repeating hot queries + "
+                         "unique cold variants, per-class p50/p95 and "
+                         "result-cache hit rate")
+    ap.add_argument("--result-cache", default=None, choices=["on", "off"],
+                    help="enable/disable the structural result cache "
+                         "for this run (default: on for --hot-cold, "
+                         "off otherwise)")
+    ap.add_argument("--admit", type=int, default=0,
+                    help="route every execution through a serving-tier "
+                         "AdmissionController with this per-group hard "
+                         "concurrency (0 = no admission gate)")
     ap.add_argument("--cpu", action="store_true", help="force the XLA CPU backend")
     ap.add_argument("--json", action="store_true", help="one JSON line per query")
     ap.add_argument("--cold-compile-report", action="store_true",
@@ -278,13 +430,25 @@ def main():
     if args.task_concurrency:
         runner.execute(
             f"SET SESSION task_concurrency = {args.task_concurrency}")
+    cache_mode = args.result_cache or ("on" if args.hot_cold else None)
+    if cache_mode is not None:
+        runner.execute("SET SESSION result_cache_enabled = "
+                       + ("true" if cache_mode == "on" else "false"))
+
+    if args.hot_cold and not args.streams:
+        raise SystemExit("--hot-cold requires --streams N")
 
     if args.streams:
         results = []
         for name, sql in suite:
             try:
-                row = run_streams(runner, name, sql, args.streams,
-                                  max(args.runs, 1))
+                if args.hot_cold:
+                    row = run_hot_cold(runner, name, sql, args.streams,
+                                       max(args.runs, 1), args.hot_cold,
+                                       admit=args.admit)
+                else:
+                    row = run_streams(runner, name, sql, args.streams,
+                                      max(args.runs, 1))
             except Exception as e:
                 row = {"query": name, "error": f"{type(e).__name__}: {e}"}
             results.append(row)
@@ -292,6 +456,17 @@ def main():
                 print(json.dumps(row), flush=True)
             elif "error" in row:
                 print(f"{name:>8}  ERROR {row['error']}", flush=True)
+            elif args.hot_cold:
+                hr = row.get("cache_hit_rate")
+                print(f"{name:>8}  mix={row['mix']} "
+                      f"hot p50={row['hot']['p50_s']}s "
+                      f"p95={row['hot']['p95_s']}s | "
+                      f"cold p50={row['cold']['p50_s']}s "
+                      f"p95={row['cold']['p95_s']}s | "
+                      f"hit rate={'n/a' if hr is None else hr}"
+                      + (f" | queue p95={row['queue_wait_p95_ms']}ms"
+                         if "queue_wait_p95_ms" in row else ""),
+                      flush=True)
             else:
                 print(f"{name:>8}  streams={row['streams']} "
                       f"qps={row['queries_per_s']:.2f} "

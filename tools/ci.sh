@@ -51,6 +51,15 @@ env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_distributed_stages.py \
     tests/test_streaming_exchange.py -q -p no:cacheprovider
 
+echo "== serving tier leg (lock-sanitized) ========================"
+# admission queue/cache locks run INSTRUMENTED: the ISSUE-8 sanitizer
+# order-checks every serving-tier lock (sync.named_lock constructions)
+# while the admission/cache/coordinator tests exercise them under real
+# contention — an observed lock-order inversion fails the gate
+env JAX_PLATFORMS=cpu PRESTO_TPU_LOCK_SANITIZER=1 python -m pytest \
+    tests/test_serving.py tests/test_resource_groups.py -q \
+    -p no:cacheprovider
+
 echo "== fault-injection (chaos) leg =============================="
 # fixed seed: the fault schedules (and their jittered backoffs) are
 # deterministic, so a chaos failure here reproduces byte-for-byte
