@@ -62,3 +62,35 @@ def validation_enabled() -> bool:
 def set_validation(value: Optional[bool]) -> None:
     """Override hook (None re-resolves from the environment)."""
     _VALIDATION.set(value)
+
+
+# per-REWRITE soundness gating (analysis/soundness.py): same enablement
+# shape as plan validation — session property ``validate_rewrites`` /
+# config ``query.validate-rewrites`` / env, resolved once per process
+_REWRITES = EnvFlag("PRESTO_TPU_VALIDATE_REWRITES", default=False)
+
+
+def rewrite_validation_enabled() -> bool:
+    """Process-wide switch for per-rewrite soundness checking in the
+    iterative optimizer (``PRESTO_TPU_VALIDATE_REWRITES`` env; the
+    per-session ``validate_rewrites`` property ORs on top in the
+    binder)."""
+    return _REWRITES()
+
+
+def set_rewrite_validation(value: Optional[bool]) -> None:
+    """Override hook (None re-resolves from the environment)."""
+    _REWRITES.set(value)
+
+
+from presto_tpu.analysis.properties import (  # noqa: E402,F401
+    LogicalProperties,
+    derive_properties,
+)
+from presto_tpu.analysis.soundness import (  # noqa: E402,F401
+    RewriteSoundnessError,
+    RewriteViolation,
+    check_rewrite,
+    plan_shape_lines,
+    plan_shape_str,
+)

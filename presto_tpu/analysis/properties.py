@@ -1,0 +1,367 @@
+"""Logical-property derivation for plan subtrees.
+
+Reference analog: the property framework behind
+``sql/planner/optimizations/`` (LogicalPropertiesProviderImpl /
+StreamPropertyDerivations / LocalProperties) — the facts an optimizer
+rule is allowed to rely on and therefore must not destroy.  Derived
+bottom-up for any subtree, id-memoized across a DAG:
+
+- **schema**: output channel names + types (positional; a rewrite that
+  drops/retypes a channel breaks every consumer above it);
+- **keys**: sets of output channel indices whose tuples are provably
+  unique (``iterative._provably_distinct`` generalized to per-node
+  propagation: scan primary keys, grouped-aggregation keys, survival
+  through filters/limits/1:1 joins, remapping through ColumnRef
+  projections).  A relation with at most one row carries the universal
+  key ``frozenset()``;
+- **ordering**: sort keys guaranteed on the output stream, each
+  canonicalized by inlining through projection chains below the sort
+  so the same physical ordering compares equal across rewrites;
+- **row bounds**: ``[lo, hi]`` plus ``exact`` when the cardinality is
+  statically known (Values, Limit over known input, zero-Sample);
+- **determinism**: whether any expression in the subtree calls a
+  nondeterministic function, and how many such call sites exist (a
+  rewrite that *duplicates* a ``random()`` changes semantics even
+  though each copy is "equally nondeterministic").
+
+The per-rewrite checkers in ``analysis/soundness.py`` compare these
+properties across a ``Rule.apply`` — see that module for the gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from presto_tpu.expr.ir import AggCall, Call, ColumnRef, Expr, LambdaExpr
+from presto_tpu.planner.iterative import _NONDETERMINISTIC, _subst
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    CrossSingleNode,
+    FilterNode,
+    GroupIdNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    UnnestNode,
+    ValuesNode,
+    WindowNode,
+)
+
+#: one guaranteed sort key: (canonical expression, ascending,
+#: nulls_first — None when the node didn't specify)
+OrderingKey = Tuple[str, bool, Optional[bool]]
+
+
+@dataclasses.dataclass
+class LogicalProperties:
+    """Facts about one subtree's output, derived bottom-up."""
+
+    names: Tuple[str, ...]
+    types: Tuple[object, ...]
+    #: each member is a set of output channel indices forming a unique
+    #: key; ``frozenset()`` is the universal key (at most one row)
+    keys: FrozenSet[FrozenSet[int]] = frozenset()
+    ordering: Tuple[OrderingKey, ...] = ()
+    lo: int = 0
+    hi: Optional[int] = None  # None = unbounded
+    exact: Optional[int] = None
+    #: nondeterministic call sites in the subtree's expressions
+    nondet_sites: int = 0
+
+    @property
+    def deterministic(self) -> bool:
+        return self.nondet_sites == 0
+
+    @property
+    def scalar(self) -> bool:
+        """At most one output row."""
+        return self.exact is not None and self.exact <= 1
+
+
+def _nondet_sites(e: Optional[Expr]) -> int:
+    if isinstance(e, Call):
+        own = 1 if e.fn in _NONDETERMINISTIC else 0
+        return own + sum(_nondet_sites(a) for a in e.args)
+    if isinstance(e, LambdaExpr):
+        return _nondet_sites(e.body)
+    return 0
+
+
+def _agg_exprs(a: AggCall) -> List[Expr]:
+    return [e for e in (a.arg, a.arg2, a.arg3, a.filter) if e is not None]
+
+
+def node_exprs(node: PlanNode) -> List[Expr]:
+    """Every expression a node evaluates over its sources' channels."""
+    if isinstance(node, FilterNode):
+        return [node.predicate]
+    if isinstance(node, ProjectNode):
+        return list(node.projections)
+    if isinstance(node, AggregationNode):
+        out = list(node.group_exprs)
+        for a in node.aggs:
+            out.extend(_agg_exprs(a))
+        return out
+    if isinstance(node, GroupIdNode):
+        return list(node.key_exprs)
+    if isinstance(node, JoinNode):
+        return list(node.left_keys) + list(node.right_keys)
+    if isinstance(node, (SortNode, TopNNode)):
+        return list(node.sort_exprs)
+    if isinstance(node, UnnestNode):
+        return list(node.unnest_exprs)
+    if isinstance(node, WindowNode):
+        out = list(node.partition_exprs) + list(node.order_exprs)
+        for f in node.funcs:
+            arg = getattr(f, "arg", None)
+            if arg is not None:
+                out.append(arg)
+        return out
+    return []
+
+
+def _canon_sort_key(e: Expr, src: PlanNode) -> str:
+    """Canonical form of a sort key: inline through projection chains
+    and descend through channel-preserving nodes below ``src`` so the
+    same physical ordering yields the same string regardless of where a
+    rewrite left the Sort/TopN relative to its projections."""
+    while True:
+        if isinstance(src, ProjectNode):
+            e = _subst(e, list(src.projections))
+            src = src.source
+        elif isinstance(src, (FilterNode, LimitNode, SortNode, TopNNode)):
+            src = src.source
+        else:
+            return repr(e)
+
+
+def _ordering_of(node, src: PlanNode) -> Tuple[OrderingKey, ...]:
+    nf = node.nulls_first
+    return tuple(
+        (_canon_sort_key(e, src), bool(asc),
+         None if nf is None else bool(nf[i]))
+        for i, (e, asc) in enumerate(zip(node.sort_exprs, node.ascending)))
+
+
+def _remap_keys(keys: FrozenSet[FrozenSet[int]],
+                projections: List[Expr]) -> FrozenSet[FrozenSet[int]]:
+    """Keys surviving a projection: every member channel must be kept
+    by a plain ColumnRef output (renames are fine, computed columns are
+    not — uniqueness of f(x) does not follow from uniqueness of x)."""
+    outmap: Dict[int, int] = {}
+    for j, e in enumerate(projections):
+        if isinstance(e, ColumnRef) and e.index not in outmap:
+            outmap[e.index] = j
+    out = set()
+    for k in keys:
+        if all(i in outmap for i in k):
+            out.add(frozenset(outmap[i] for i in k))
+    return frozenset(out)
+
+
+def _mul(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    return None if a is None or b is None else a * b
+
+
+def _min_opt(a: Optional[int], b: int) -> Optional[int]:
+    return b if a is None else min(a, b)
+
+
+def derive_properties(node: PlanNode,
+                      memo: Optional[Dict[int, LogicalProperties]] = None
+                      ) -> LogicalProperties:
+    """Bottom-up property derivation, id-memoized (plan nodes are
+    identity-hashed DAG nodes; shared subtrees derive once per call)."""
+    if memo is None:
+        memo = {}
+    got = memo.get(id(node))
+    if got is not None:
+        return got
+    props = _derive(node, memo)
+    if props.scalar:
+        # at most one row: universally unique, any ordering holds
+        props.keys = props.keys | {frozenset()}
+    memo[id(node)] = props
+    return props
+
+
+def _derive(node: PlanNode, memo) -> LogicalProperties:
+    ch = node.channels
+    names = tuple(c.name for c in ch)
+    types = tuple(c.type for c in ch)
+    srcs = [derive_properties(s, memo) for s in node.sources]
+    nondet = sum(_nondet_sites(e) for e in node_exprs(node)) \
+        + sum(s.nondet_sites for s in srcs)
+    p = LogicalProperties(names=names, types=types, nondet_sites=nondet)
+
+    if isinstance(node, ValuesNode):
+        n = len(node.rows)
+        p.lo = p.hi = p.exact = n
+        return p
+
+    if isinstance(node, TableScanNode):
+        rc = getattr(node.handle, "row_count", None)
+        known = isinstance(rc, int) and rc >= 0
+        if known:
+            p.hi = rc
+            if (not node.constraints and node.sample is None
+                    and node.splits is None):
+                if node.limit is None:
+                    p.lo = p.exact = rc
+                else:
+                    # a limit-annotated scan stops producing splits
+                    # once satisfied but still emits at least
+                    # min(rc, limit) rows — the Limit above it keeps
+                    # its exact count through PushLimitIntoTableScan
+                    p.lo = min(rc, node.limit)
+        pk = node.handle.primary_key
+        if pk:
+            sel = [node.handle.columns[i].name for i in node.columns]
+            if all(k in sel for k in pk):
+                p.keys = frozenset({frozenset(sel.index(k) for k in pk)})
+        return p
+
+    if isinstance(node, FilterNode):
+        s = srcs[0]
+        p.hi = s.hi
+        p.exact = 0 if s.hi == 0 else None
+        p.keys = s.keys
+        p.ordering = s.ordering
+        return p
+
+    if isinstance(node, ProjectNode):
+        s = srcs[0]
+        p.lo, p.hi, p.exact = s.lo, s.hi, s.exact
+        p.ordering = s.ordering
+        p.keys = _remap_keys(s.keys, list(node.projections))
+        return p
+
+    if isinstance(node, OutputNode):
+        s = srcs[0]
+        p.lo, p.hi, p.exact = s.lo, s.hi, s.exact
+        p.ordering = s.ordering
+        p.keys = s.keys
+        return p
+
+    if isinstance(node, AggregationNode):
+        s = srcs[0]
+        if not node.group_exprs:
+            if node.step in ("single", "final"):
+                p.lo = p.hi = p.exact = 1
+            # partial global: one state row per split — count unknown
+            return p
+        p.hi = s.hi
+        if node.step in ("single", "final"):
+            p.keys = frozenset({frozenset(range(len(node.group_exprs)))})
+            if node.step == "single" and s.lo > 0:
+                p.lo = 1
+        return p
+
+    if isinstance(node, GroupIdNode):
+        s = srcs[0]
+        n = max(len(node.set_masks), 1)
+        p.lo = s.lo * n
+        p.hi = _mul(s.hi, n)
+        p.exact = _mul(s.exact, n)
+        return p
+
+    if isinstance(node, JoinNode):
+        left, right = srcs
+        if node.kind == "mark":
+            # exactly one output row per probe row
+            p.lo, p.hi, p.exact = left.lo, left.hi, left.exact
+            p.keys = left.keys
+            p.ordering = left.ordering
+        elif node.kind in ("semi", "anti"):
+            p.hi = left.hi
+            p.keys = left.keys
+            p.ordering = left.ordering
+        elif node.kind == "left":
+            p.lo = left.lo  # unmatched probes null-extend, never drop
+            if node.unique_build:
+                p.hi, p.exact = left.hi, left.exact
+                p.keys = left.keys
+                p.ordering = left.ordering
+            else:
+                # an empty build still yields one null-extended row per
+                # probe row, hence max(right.hi, 1)
+                p.hi = (None if left.hi is None or right.hi is None
+                        else left.hi * max(right.hi, 1))
+        elif node.kind == "inner":
+            if node.unique_build:
+                p.hi = left.hi
+                p.keys = left.keys
+            else:
+                p.hi = _mul(left.hi, right.hi)
+        return p
+
+    if isinstance(node, CrossSingleNode):
+        left = srcs[0]
+        # the right side is a guaranteed single-row relation
+        p.lo, p.hi, p.exact = left.lo, left.hi, left.exact
+        p.keys = left.keys
+        p.ordering = left.ordering
+        return p
+
+    if isinstance(node, UnnestNode):
+        s = srcs[0]
+        p.hi = _mul(s.hi, node.max_elems)
+        return p
+
+    if isinstance(node, SortNode):
+        s = srcs[0]
+        p.lo, p.hi, p.exact = s.lo, s.hi, s.exact
+        p.keys = s.keys
+        p.ordering = _ordering_of(node, node.source)
+        return p
+
+    if isinstance(node, TopNNode):
+        s = srcs[0]
+        p.lo = min(s.lo, node.count)
+        p.hi = _min_opt(s.hi, node.count)
+        if s.exact is not None:
+            p.exact = min(s.exact, node.count)
+        elif s.lo >= node.count:
+            p.exact = node.count
+        p.keys = s.keys
+        p.ordering = _ordering_of(node, node.source)
+        return p
+
+    if isinstance(node, LimitNode):
+        s = srcs[0]
+        p.lo = min(s.lo, node.count)
+        p.hi = _min_opt(s.hi, node.count)
+        if s.exact is not None:
+            p.exact = min(s.exact, node.count)
+        elif s.lo >= node.count:
+            p.exact = node.count
+        p.keys = s.keys
+        p.ordering = s.ordering
+        return p
+
+    if isinstance(node, UnionNode):
+        p.lo = sum(s.lo for s in srcs)
+        hi = 0
+        exact: Optional[int] = 0
+        for s in srcs:
+            hi = None if (hi is None or s.hi is None) else hi + s.hi
+            exact = (None if (exact is None or s.exact is None)
+                     else exact + s.exact)
+        p.hi, p.exact = hi, exact
+        return p
+
+    if isinstance(node, WindowNode):
+        s = srcs[0]
+        p.lo, p.hi, p.exact = s.lo, s.hi, s.exact
+        p.keys = s.keys  # channels appended, indices unchanged
+        return p
+
+    # RemoteSourceNode, PrecomputedNode, unknown extensions: no claims
+    return p

@@ -1,0 +1,348 @@
+"""Per-rewrite soundness gate for the iterative optimizer.
+
+Reference analog: ``sql/planner/sanity/PlanSanityChecker`` — the
+reference runs ValidateDependenciesChecker / NoDuplicatePlanNodeIds /
+TypeValidator *between* optimizer passes so an unsound rewrite fails
+loudly at plan time instead of as a wrong answer.  Here the gate is
+finer-grained: ``IterativeOptimizer`` calls :func:`check_rewrite`
+around every successful ``Rule.apply`` (when the ``validate_rewrites``
+session property / ``query.validate-rewrites`` config /
+``PRESTO_TPU_VALIDATE_REWRITES`` env switch is on), comparing the
+logical properties (analysis/properties.py) of the matched subtree
+against its replacement.
+
+Checker catalog (each violation carries the checker name + the
+applied rule, so a failing corpus query names its culprit):
+
+- ``output-schema``      channel names/types must match exactly
+- ``row-count``          bounds must stay consistent: the before/after
+                         ``[lo, hi]`` intervals must intersect, and an
+                         exact count may tighten under a new Limit but
+                         never silently change
+- ``ordering``           a guaranteed output ordering must survive
+                         (the after-ordering keeps the before-ordering
+                         as a prefix; trivially true for <=1-row
+                         results)
+- ``keys``               every provably-unique key set must still be
+                         implied by some after-key
+- ``determinism``        nondeterministic call sites must not increase
+                         (a hoist that duplicates ``random()`` changes
+                         semantics)
+- ``duplicate-node``     a node *introduced* by the rewrite must not
+                         appear in two source positions (plan nodes
+                         are identity-keyed; aliasing one double-counts
+                         its rows and breaks per-node bookkeeping).
+                         Nodes that already existed before the rewrite
+                         may stay legitimately shared (DAG reuse)
+- ``dangling-columnref`` every ColumnRef in the replacement subtree
+                         must index a real source channel
+- ``sources-replaced``   raised by the optimizer itself when
+                         ``_replace_sources`` fails to take effect (the
+                         in-place mutation class of bug)
+- ``properties``         property derivation crashed on the
+                         replacement subtree (itself a malformation)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from presto_tpu.analysis.properties import derive_properties, node_exprs
+from presto_tpu.expr.ir import Call, ColumnRef, Expr, LambdaExpr
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    FilterNode,
+    GroupIdNode,
+    JoinNode,
+    LimitNode,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    UnnestNode,
+    ValuesNode,
+    WindowNode,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RewriteViolation:
+    checker: str
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.checker}] rule {self.rule}: {self.message}"
+
+
+class RewriteSoundnessError(Exception):
+    """An optimizer rule produced an unsound rewrite.  Carries the rule
+    name, the per-checker violations, and before/after plan snippets."""
+
+    def __init__(self, rule: str, violations: List[RewriteViolation],
+                 before: Optional[PlanNode] = None,
+                 after: Optional[PlanNode] = None):
+        self.rule = rule
+        self.violations = violations
+        lines = [f"unsound rewrite by {rule}:"]
+        lines.extend(f"  {v}" for v in violations)
+        if before is not None:
+            lines.append("before:")
+            lines.extend("  " + s for s in plan_shape_lines(before)[:12])
+        if after is not None and after is not before:
+            lines.append("after:")
+            lines.extend("  " + s for s in plan_shape_lines(after)[:12])
+        super().__init__("\n".join(lines))
+
+
+# ---------------------------------------------------------------------------
+# canonical plan-shape rendering (shared with tools/plan_diff.py)
+# ---------------------------------------------------------------------------
+
+
+def _shape_detail(node: PlanNode) -> str:
+    """Deterministic one-line description of a node: everything that
+    defines the plan's *shape*, nothing that depends on object
+    identity, scale factor statistics, or process state."""
+    if isinstance(node, TableScanNode):
+        cols = [node.handle.columns[i].name for i in node.columns]
+        out = f"{node.handle.table} cols={cols}"
+        if node.constraints:
+            out += f" constraints={sorted(node.constraints)}"
+        if node.limit is not None:
+            out += f" limit={node.limit}"
+        if node.sample is not None:
+            out += f" sample={node.sample}"
+        return out
+    if isinstance(node, FilterNode):
+        return repr(node.predicate)
+    if isinstance(node, ProjectNode):
+        return f"{list(node.names)} = {node.projections!r}"
+    if isinstance(node, AggregationNode):
+        out = (f"[{node.step}] keys={node.group_exprs!r} "
+               f"aggs={node.aggs!r}")
+        if node.presorted:
+            out += " presorted"
+        return out
+    if isinstance(node, GroupIdNode):
+        return f"keys={node.key_exprs!r} sets={node.set_masks}"
+    if isinstance(node, JoinNode):
+        out = f"[{node.kind}] {node.left_keys!r} = {node.right_keys!r}"
+        for flag in ("unique_build", "use_index", "null_safe_keys",
+                     "null_aware"):
+            if getattr(node, flag):
+                out += f" {flag}"
+        return out
+    if isinstance(node, UnnestNode):
+        out = f"{node.unnest_exprs!r}"
+        if node.ordinality:
+            out += " ordinality"
+        return out
+    if isinstance(node, SortNode):
+        return (f"keys={node.sort_exprs!r} asc={node.ascending} "
+                f"nulls_first={node.nulls_first}")
+    if isinstance(node, TopNNode):
+        return (f"{node.count} keys={node.sort_exprs!r} "
+                f"asc={node.ascending} nulls_first={node.nulls_first}")
+    if isinstance(node, LimitNode):
+        return str(node.count)
+    if isinstance(node, ValuesNode):
+        types = [str(t) for t in node.types]
+        return f"rows={len(node.rows)} {list(node.names)} {types}"
+    if isinstance(node, WindowNode):
+        kinds = [getattr(f, "kind", type(f).__name__) for f in node.funcs]
+        return (f"partition={node.partition_exprs!r} "
+                f"order={node.order_exprs!r} funcs={kinds}")
+    if isinstance(node, OutputNode):
+        return str(list(node.names))
+    if isinstance(node, UnionNode):
+        return f"{len(node.inputs)} arms"
+    return ""
+
+
+def plan_shape_lines(node: PlanNode, indent: int = 0) -> List[str]:
+    """Canonical EXPLAIN-like rendering without stats/estimates — the
+    stable form behind golden plan fingerprints and violation
+    snippets."""
+    name = type(node).__name__.replace("Node", "")
+    detail = _shape_detail(node)
+    out = ["  " * indent + f"- {name}" + (f" {detail}" if detail else "")]
+    for s in node.sources:
+        out.extend(plan_shape_lines(s, indent + 1))
+    return out
+
+
+def plan_shape_str(node: PlanNode) -> str:
+    return "\n".join(plan_shape_lines(node))
+
+
+# ---------------------------------------------------------------------------
+# structural well-formedness
+# ---------------------------------------------------------------------------
+
+
+def _walk_ids(node: PlanNode, acc: Set[int]) -> None:
+    if id(node) in acc:
+        return
+    acc.add(id(node))
+    for s in node.sources:
+        _walk_ids(s, acc)
+
+
+#: nodes whose expressions read a source other than sources[0]
+def _expr_source_counts(node: PlanNode) -> List[int]:
+    """Channel count of the source each expression list reads — used
+    for the dangling-ColumnRef bound check."""
+    if isinstance(node, JoinNode):
+        return [len(node.left.channels), len(node.right.channels)]
+    if node.sources:
+        return [len(node.sources[0].channels)]
+    return []
+
+
+def _expr_refs_shallow(e: Expr) -> List[int]:
+    if isinstance(e, ColumnRef):
+        return [e.index]
+    if isinstance(e, Call):
+        return [r for a in e.args for r in _expr_refs_shallow(a)]
+    if isinstance(e, LambdaExpr):
+        return _expr_refs_shallow(e.body)
+    return []
+
+
+def _check_structure(rule: str, before: PlanNode,
+                     after: PlanNode) -> List[RewriteViolation]:
+    violations: List[RewriteViolation] = []
+
+    before_ids: Set[int] = set()
+    _walk_ids(before, before_ids)
+
+    # duplicate-node: a FRESH node referenced from >1 source position
+    seen_edges: Dict[int, int] = {}
+    dup_reported: Set[int] = set()
+
+    def walk(n: PlanNode) -> None:
+        count = seen_edges.get(id(n), 0) + 1
+        seen_edges[id(n)] = count
+        if count > 1:
+            if id(n) not in before_ids and id(n) not in dup_reported:
+                dup_reported.add(id(n))
+                violations.append(RewriteViolation(
+                    "duplicate-node", rule,
+                    f"rewrite introduces {type(n).__name__} aliased into "
+                    f"{count}+ source positions — identity-keyed plan "
+                    "nodes must not be shared by a rewrite that created "
+                    "them"))
+            return  # already visited: stop (also bounds DAG traversal)
+        for s in n.sources:
+            walk(s)
+
+    walk(after)
+
+    # dangling-columnref: every expression must index a real channel
+    checked: Set[int] = set()
+
+    def check_refs(n: PlanNode) -> None:
+        if id(n) in checked:
+            return
+        checked.add(id(n))
+        try:
+            bounds = _expr_source_counts(n)
+        except Exception:
+            bounds = []
+        if isinstance(n, JoinNode):
+            groups = [(list(n.left_keys), bounds[0] if bounds else None),
+                      (list(n.right_keys),
+                       bounds[1] if len(bounds) > 1 else None)]
+        else:
+            groups = [(node_exprs(n), bounds[0] if bounds else None)]
+        for exprs, limit in groups:
+            if limit is None:
+                continue
+            for e in exprs:
+                for r in _expr_refs_shallow(e):
+                    if r >= limit or r < 0:
+                        violations.append(RewriteViolation(
+                            "dangling-columnref", rule,
+                            f"{type(n).__name__} references channel ${r} "
+                            f"but its source has {limit} channels"))
+        for s in n.sources:
+            check_refs(s)
+
+    check_refs(after)
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# property checks
+# ---------------------------------------------------------------------------
+
+
+def _keys_implied(required: frozenset, available) -> bool:
+    return any(k <= required for k in available)
+
+
+def check_rewrite(rule: str, before: PlanNode,
+                  after: PlanNode) -> List[RewriteViolation]:
+    """All soundness violations of replacing ``before`` with ``after``
+    (empty list = the rewrite is consistent with every derivable
+    property).  The caller attributes them to ``rule``."""
+    violations = _check_structure(rule, before, after)
+    if violations:
+        return violations  # property derivation needs a sane tree
+
+    memo: Dict[int, object] = {}
+    try:
+        b = derive_properties(before, memo)
+        a = derive_properties(after, memo)
+    except Exception as e:  # malformed replacement: derivation crashed
+        return [RewriteViolation(
+            "properties", rule,
+            f"property derivation failed on the rewritten subtree: "
+            f"{type(e).__name__}: {e}")]
+
+    if b.names != a.names or b.types != a.types:
+        violations.append(RewriteViolation(
+            "output-schema", rule,
+            f"output schema changed: "
+            f"{list(zip(b.names, map(str, b.types)))} -> "
+            f"{list(zip(a.names, map(str, a.types)))}"))
+
+    # row bounds must intersect; exact counts must agree
+    if (b.hi is not None and a.lo > b.hi) or \
+            (a.hi is not None and b.lo > a.hi):
+        violations.append(RewriteViolation(
+            "row-count", rule,
+            f"row bounds disjoint: before [{b.lo}, {b.hi}] vs "
+            f"after [{a.lo}, {a.hi}]"))
+    elif b.exact is not None and a.exact is not None and b.exact != a.exact:
+        violations.append(RewriteViolation(
+            "row-count", rule,
+            f"exact row count changed: {b.exact} -> {a.exact}"))
+
+    if b.ordering and not a.scalar \
+            and a.ordering[:len(b.ordering)] != b.ordering:
+        violations.append(RewriteViolation(
+            "ordering", rule,
+            f"guaranteed ordering lost: before {list(b.ordering)}, "
+            f"after {list(a.ordering)}"))
+
+    for k in b.keys:
+        if not _keys_implied(k, a.keys):
+            violations.append(RewriteViolation(
+                "keys", rule,
+                f"uniqueness of channels {sorted(k)} no longer provable "
+                f"(after-keys: {[sorted(x) for x in a.keys]})"))
+
+    if a.nondet_sites > b.nondet_sites:
+        violations.append(RewriteViolation(
+            "determinism", rule,
+            f"nondeterministic call sites increased "
+            f"{b.nondet_sites} -> {a.nondet_sites} — the rewrite "
+            "duplicates a nondeterministic expression"))
+
+    return violations

@@ -13,6 +13,9 @@ Recognized keys (the engine's subset of the reference's config space):
   query.max-memory-per-node   bytes for the local MemoryPool
   query.validate-plans        run the static plan/IR validator on every
                               bound plan (docs/static-analysis.md)
+  query.validate-rewrites     gate every optimizer rule application
+                              with the rewrite-soundness checker
+                              (docs/static-analysis.md)
   query.trace-dir             write one Chrome-trace JSON per query
                               (docs/observability.md; enables tracing)
   query.log-path              JSONL query log (one line per completed
@@ -220,6 +223,11 @@ class EngineConfig:
         v = self.props.get("query.validate-plans")
         if v is not None and "validate_plans" not in props:
             props["validate_plans"] = v
+        # query.validate-rewrites: per-rewrite soundness gating in the
+        # iterative optimizer (same sugar shape as validate-plans)
+        v = self.props.get("query.validate-rewrites")
+        if v is not None and "validate_rewrites" not in props:
+            props["validate_rewrites"] = v
         # query.task-concurrency / query.task-prefetch: morsel split
         # scheduler defaults (dotted keys mirror the reference's
         # task.concurrency config; sugar for session.task_*)

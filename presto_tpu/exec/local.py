@@ -806,6 +806,11 @@ class LocalRunner:
         sched = self._scheduler_line()
         if sched:
             text = sched + "\n" + text
+        report = getattr(plan, "_optimizer_report", None)
+        if report is not None:
+            # "optimizer: N iterations, rule hits: ..." — which rules
+            # shaped this plan (binder attaches the OptimizerStats)
+            text = report.summary() + "\n" + text
         return text
 
     def compiled_program_count(self) -> Optional[int]:

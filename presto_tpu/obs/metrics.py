@@ -270,6 +270,10 @@ def _preregister(reg: MetricsRegistry) -> None:
         "cache.subplan_hits", "cache.subplan_misses",
         "cache.subplan_stores", "cache.subplan_evictions",
         "cache.subplan_invalidations", "cache.subplan_oversize",
+        # iterative optimizer: successful rule applications and
+        # rewrites rejected by the soundness gate
+        # (planner/iterative.py + analysis/soundness.py)
+        "optimizer.rule_applications", "optimizer.rule_violations",
     ):
         reg.counter(name)
     for name in (

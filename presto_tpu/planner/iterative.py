@@ -24,10 +24,11 @@ from __future__ import annotations
 
 import dataclasses
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from presto_tpu.expr.ir import Call, ColumnRef, Expr, Literal
 from presto_tpu.matching import Pattern
+from presto_tpu.obs.metrics import METRICS
 from presto_tpu.planner.plan import (
     AggregationNode,
     CrossSingleNode,
@@ -105,6 +106,13 @@ class PushFilterThroughProject(Rule):
 
     def apply(self, node: FilterNode) -> Optional[PlanNode]:
         proj: ProjectNode = node.source
+        # a nondeterministic projection the predicate reads must stay
+        # upstream of the filter decision: substituting would evaluate
+        # e.g. random() once for the filter and again for the output
+        # (PredicatePushDown pushes deterministic conjuncts only)
+        if any(not _deterministic(proj.projections[i])
+               for i in set(_expr_refs(node.predicate))):
+            return None
         pred = _subst(node.predicate, list(proj.projections))
         return ProjectNode(FilterNode(proj.source, pred),
                            list(proj.projections), list(proj.names))
@@ -585,6 +593,11 @@ class PushFilterThroughUnion(Rule):
 
     def apply(self, node: FilterNode) -> Optional[PlanNode]:
         union: UnionNode = node.source
+        # one predicate instance becomes one per arm — replicating a
+        # nondeterministic predicate multiplies its call sites
+        # (PredicatePushDown pushes deterministic conjuncts only)
+        if not _deterministic(node.predicate):
+            return None
         refs = set(_expr_refs(node.predicate))
         chans = union.channels
         for i in refs:
@@ -643,6 +656,10 @@ class PushTopNThroughUnion(Rule):
 
     def apply(self, node: TopNNode) -> Optional[PlanNode]:
         union: UnionNode = node.source
+        # the sort keys get replicated into every arm — see
+        # PushFilterThroughUnion's determinism guard
+        if not all(_deterministic(k) for k in node.sort_exprs):
+            return None
 
         def bounded(arm: PlanNode) -> bool:
             # the planted TopN may have been relocated below the arm's
@@ -858,16 +875,49 @@ DEFAULT_RULES: List[Rule] = [
 ]
 
 
+class OptimizerStats:
+    """Per-optimize() rule-application bookkeeping, surfaced by
+    EXPLAIN (TYPE VALIDATE) / EXPLAIN ANALYZE VERBOSE so plan-diff
+    investigations can see which rules moved a plan without a
+    debugger."""
+
+    def __init__(self):
+        self.iterations = 0
+        self.rule_hits: Dict[str, int] = {}
+
+    def record(self, rule_name: str) -> None:
+        self.iterations += 1
+        self.rule_hits[rule_name] = self.rule_hits.get(rule_name, 0) + 1
+
+    def summary(self) -> str:
+        if not self.iterations:
+            return "optimizer: 0 iterations"
+        hits = ", ".join(
+            f"{name}={count}"
+            for name, count in sorted(self.rule_hits.items(),
+                                      key=lambda kv: (-kv[1], kv[0])))
+        return f"optimizer: {self.iterations} iterations, rule hits: {hits}"
+
+
 class IterativeOptimizer:
     """Bottom-up fixpoint driver (IterativeOptimizer.java's exploration
-    loop over a Memo, with node identity as the group key)."""
+    loop over a Memo, with node identity as the group key).
 
-    def __init__(self, rules: Optional[List[Rule]] = None, max_iterations: int = 1000):
+    With ``validate=True`` every successful ``Rule.apply`` is gated by
+    ``analysis.soundness.check_rewrite`` — an unsound rewrite raises
+    ``RewriteSoundnessError`` naming the rule (the per-rewrite analog
+    of the reference's PlanSanityChecker between-optimizer runs)."""
+
+    def __init__(self, rules: Optional[List[Rule]] = None,
+                 max_iterations: int = 1000, validate: bool = False):
         self.rules = rules if rules is not None else DEFAULT_RULES
         self.max_iterations = max_iterations
+        self.validate = validate
+        self.stats = OptimizerStats()
 
     def optimize(self, root: PlanNode) -> PlanNode:
         self._budget = self.max_iterations
+        self.stats = OptimizerStats()
         return self._explore(root)
 
     def _explore(self, node: PlanNode) -> PlanNode:
@@ -883,10 +933,25 @@ class IterativeOptimizer:
                 if out is None or out is node:
                     continue
                 self._budget -= 1
+                rname = type(rule).__name__
+                self.stats.record(rname)
+                METRICS.counter("optimizer.rule_applications").inc()
+                if self.validate:
+                    self._check(rname, node, out)
                 node = self._rewrite_sources(out)
                 progress = True
                 break
         return node
+
+    def _check(self, rule_name: str, before: PlanNode,
+               after: PlanNode) -> None:
+        from presto_tpu.analysis.soundness import (RewriteSoundnessError,
+                                                   check_rewrite)
+
+        violations = check_rewrite(rule_name, before, after)
+        if violations:
+            METRICS.counter("optimizer.rule_violations").inc()
+            raise RewriteSoundnessError(rule_name, violations, before, after)
 
     def _rewrite_sources(self, node: PlanNode) -> PlanNode:
         srcs = node.sources
@@ -896,6 +961,21 @@ class IterativeOptimizer:
         if all(a is b for a, b in zip(new, srcs)):
             return node
         _replace_sources(node, new)
+        if self.validate and any(
+                a is not b and a not in node.sources
+                for a, b in zip(new, srcs) if a is not b):
+            from presto_tpu.analysis.soundness import (RewriteSoundnessError,
+                                                       RewriteViolation)
+
+            METRICS.counter("optimizer.rule_violations").inc()
+            raise RewriteSoundnessError(
+                "_replace_sources",
+                [RewriteViolation(
+                    "sources-replaced", "_replace_sources",
+                    f"{type(node).__name__} still references a stale "
+                    "source after replacement — in-place source "
+                    "mutation did not take effect")],
+                node)
         return node
 
 

@@ -282,18 +282,27 @@ class QueryRunner:
             return res
 
         if isinstance(stmt, ast.Explain):
-            plan = self.binder.plan_ast(stmt.query)
-            if getattr(stmt, "validate", False):
+            validate = getattr(stmt, "validate", False)
+            # EXPLAIN (TYPE VALIDATE) always gates every rewrite, like
+            # it always runs the plan validator
+            plan = self.binder.plan_ast(
+                stmt.query, validate_rewrites=True if validate else None)
+            if validate:
                 # parse + bind succeeded; now the static tier: the
                 # plan/IR validator (analysis/) checks type soundness,
                 # null-mask policy, ladder conformance and signature
                 # determinism — PlanValidationError propagates with
-                # node-specific diagnostics (EXPLAIN (TYPE VALIDATE))
+                # node-specific diagnostics (EXPLAIN (TYPE VALIDATE));
+                # every rewrite already passed the soundness gate above
                 from presto_tpu.analysis import assert_valid
                 from presto_tpu.types import BOOLEAN
 
                 assert_valid(plan)
-                return MaterializedResult(["Valid"], [BOOLEAN], [(True,)])
+                report = getattr(plan, "_optimizer_report", None)
+                summary = report.summary() if report else "optimizer: n/a"
+                return MaterializedResult(
+                    ["Valid", "Optimizer"], [BOOLEAN, VARCHAR],
+                    [(True, summary)])
             if getattr(stmt, "distributed", False):
                 from presto_tpu.parallel.fragment import explain_distributed
 

@@ -103,6 +103,14 @@ SYSTEM_PROPERTIES = [
         False, _bool,
     ),
     PropertyMetadata(
+        "validate_rewrites",
+        "gate every optimizer rule application with the rewrite-"
+        "soundness checker (analysis/soundness.py; EXPLAIN (TYPE "
+        "VALIDATE) always does; query.validate-rewrites config key "
+        "sets the default)",
+        False, _bool,
+    ),
+    PropertyMetadata(
         "distributed_min_stage_rows",
         "stages over intermediates smaller than this run on the "
         "coordinator (0 = every stage on the mesh)",

@@ -708,16 +708,32 @@ class Binder:
                 raise annotated from e.__cause__
             raise
 
-    def plan_ast(self, q: ast.Node) -> OutputNode:
+    def plan_ast(self, q: ast.Node,
+                 validate_rewrites: Optional[bool] = None) -> OutputNode:
         self._now = None  # fresh instant for this statement
         try:
+            from presto_tpu import analysis
+
+            if validate_rewrites is None:
+                validate_rewrites = analysis.rewrite_validation_enabled() or (
+                    self.session is not None
+                    and bool(self.session.get("validate_rewrites")))
             node, names = self._plan_query_like(q)
             out = OutputNode(node, names)
+            if analysis.validation_enabled() or (
+                    self.session is not None
+                    and bool(self.session.get("validate_plans"))):
+                # pre-optimization half of the validate_plans contract:
+                # a clean bound plan isolates any later violation to a
+                # rewrite (the runner validates the optimized plan)
+                analysis.assert_valid(out)
             # iterative rule engine over the bound plan
             # (sql/planner/iterative/IterativeOptimizer.java)
             from presto_tpu.planner.iterative import IterativeOptimizer
 
-            out = IterativeOptimizer().optimize(out)
+            opt = IterativeOptimizer(validate=validate_rewrites)
+            out = opt.optimize(out)
+            out._optimizer_report = opt.stats
             self._enable_index_joins(out)
             return out
         except (BindError, SyntaxError):

@@ -22,6 +22,10 @@ if "xla_force_host_platform_device_count" not in flags:
 # fails the suite with a node-specific diagnostic instead of a kernel
 # crash.  setdefault: an explicit =0 in the environment still wins.
 os.environ.setdefault("PRESTO_TPU_VALIDATE_PLANS", "1")
+# ... and every optimizer rule application runs the rewrite-soundness
+# gate (presto_tpu/analysis/soundness.py): an unsound rewrite fails
+# the suite naming the rule, not as a wrong answer downstream
+os.environ.setdefault("PRESTO_TPU_VALIDATE_REWRITES", "1")
 
 import jax
 

@@ -399,6 +399,86 @@ def test_metric_catalog_discovered_from_repo():
 
 
 # ---------------------------------------------------------------------------
+# rule-purity: Rule.apply must not mutate its input or read the env
+# ---------------------------------------------------------------------------
+
+def test_rule_purity_attribute_assignment_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        class ShrinkLimit(Rule):
+            def apply(self, node):
+                node.count = 1  # in-place edit of the matched node
+                return node
+    """)
+    assert [f.rule for f in findings] == ["rule-purity"]
+    assert "node.count" in findings[0].message
+
+
+def test_rule_purity_mutation_through_alias_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        class RecordThings(Rule):
+            def apply(self, node):
+                scan = node.source
+                scan.constraints.extend([("a", "eq", 1)])
+                for arm in node.source.inputs:
+                    arm.names[0] = "renamed"
+                return node
+    """)
+    assert [f.rule for f in findings] == ["rule-purity", "rule-purity"]
+    assert ".extend() on scan.constraints" in findings[0].message
+
+
+def test_rule_purity_env_read_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import os
+
+        class EnvGated(Rule):
+            def apply(self, node):
+                if os.environ.get("FAST_MODE"):
+                    return node.source
+                return None
+    """)
+    assert [f.rule for f in findings
+            if f.rule == "rule-purity"] == ["rule-purity"]
+
+
+def test_rule_purity_fresh_construction_allowed(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import dataclasses
+
+        class PureRewrite(Rule):
+            def apply(self, node):
+                projs = list(node.projections)  # fresh list: mutable
+                projs.append(None)
+                out = dataclasses.replace(node, projections=projs)
+                out.cached = True  # fresh node: attribute set is fine
+                return out
+
+        class NotARule:
+            def apply(self, node):
+                node.count = 1  # not a Rule subclass: out of scope
+                return node
+    """)
+    assert findings == []
+
+
+def test_rule_purity_suppression_entry(tmp_path):
+    code = """
+        class Recorder(Rule):
+            def apply(self, node):
+                node.source.constraints.extend([1])
+                return node
+    """
+    findings = _lint_snippet(tmp_path, code)
+    assert [f.rule for f in findings] == ["rule-purity"]
+    sup = tmp_path / "sup.txt"
+    sup.write_text("snippet.py | rule-purity | constraints.extend | "
+                   "reviewed: metadata-only recording\n")
+    entries, problems = engine_lint.load_suppressions(str(sup))
+    assert problems == []
+    assert engine_lint.apply_suppressions(findings, entries) == []
+
+
+# ---------------------------------------------------------------------------
 # the repo-wide pin
 # ---------------------------------------------------------------------------
 

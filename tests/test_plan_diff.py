@@ -1,0 +1,73 @@
+"""Corpus plan-diff harness (tools/plan_diff.py).
+
+The full 121-query corpus sweep runs as a ci.sh leg
+(``python tools/plan_diff.py --check``); these tests pin the harness
+mechanics — fingerprint determinism, golden-file integrity, the diff
+report — plus a small live-replan slice against the committed goldens
+so a rule change that moves TPC-H plan shapes fails tier-1 too, not
+just the CI leg.
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.plan_diff import GOLDEN_PATH, diff, fingerprint
+
+
+@pytest.fixture(scope="module")
+def golden():
+    assert os.path.exists(GOLDEN_PATH), "committed goldens missing"
+    with open(GOLDEN_PATH) as f:
+        return json.load(f)
+
+
+def test_fingerprint_deterministic():
+    shape = "Project\n  TableScan[nation]"
+    assert fingerprint(shape) == fingerprint(shape)
+    assert len(fingerprint(shape)) == 16
+    assert fingerprint(shape) != fingerprint(shape + " ")
+
+
+def test_golden_file_integrity(golden):
+    # both corpora present, and every stored fingerprint is the hash
+    # of its stored shape (a hand-edited golden can't sneak through)
+    assert len(golden) == 121
+    assert sum(1 for k in golden if k.startswith("tpch/")) == 22
+    assert sum(1 for k in golden if k.startswith("tpcds/")) == 99
+    for key, entry in golden.items():
+        assert entry["fingerprint"] == fingerprint(entry["shape"]), key
+
+
+def test_diff_reports_changes(capsys):
+    base = {"tpch/1": {"fingerprint": "aaaa", "shape": "A"},
+            "tpch/2": {"fingerprint": "bbbb", "shape": "B"}}
+    assert diff(base, dict(base)) is False
+
+    moved = {"tpch/1": {"fingerprint": "cccc", "shape": "A2"},
+             "tpch/3": {"fingerprint": "dddd", "shape": "D"}}
+    assert diff(base, moved) is True
+    out = capsys.readouterr().out
+    assert "CHANGED tpch/1" in out
+    assert "REMOVED tpch/2" in out
+    assert "NEW     tpch/3" in out
+
+
+def test_live_replan_matches_goldens(golden):
+    """Replan a slice of TPC-H and compare against the committed
+    goldens — the same path the CI leg takes, scoped for tier-1."""
+    from presto_tpu.analysis.soundness import plan_shape_str
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.runner import QueryRunner
+    from tests.tpch_queries import QUERIES
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.01))
+    runner = QueryRunner(catalog)
+    runner.session.set("validate_rewrites", True)
+    for qid in (1, 3, 6, 14):
+        shape = plan_shape_str(runner.binder.plan(QUERIES[qid]))
+        assert fingerprint(shape) == golden[f"tpch/{qid}"]["fingerprint"], (
+            f"tpch/{qid} plan shape moved vs goldens:\n{shape}")

@@ -61,7 +61,10 @@ def _agg_plan(runner):
 @pytest.mark.parametrize("qid", sorted(QUERIES))
 def test_explain_validate_every_tpch_query(runner, qid):
     res = runner.execute(f"EXPLAIN (TYPE VALIDATE) {QUERIES[qid]}")
-    assert res.rows == [(True,)]
+    assert res.names == ["Valid", "Optimizer"]
+    assert res.rows[0][0] is True
+    # the per-rule application report rides along (ISSUE 12)
+    assert res.rows[0][1].startswith("optimizer:")
 
 
 def test_validate_plans_session_property(runner):
@@ -201,4 +204,4 @@ def test_explain_validate_fails_on_seeded_bug(runner):
     not rubber-stamps."""
     res = runner.execute(
         "EXPLAIN (TYPE VALIDATE) SELECT max(l_tax) FROM lineitem")
-    assert res.rows == [(True,)]
+    assert res.rows[0][0] is True

@@ -1,0 +1,407 @@
+"""Rewrite-soundness gate (presto_tpu/analysis/properties.py +
+soundness.py + the IterativeOptimizer ``validate`` hook).
+
+Three halves, mirroring tests/test_plan_validator.py's structure:
+
+- the TPC-H corpus optimizes CLEAN with per-rewrite validation forced
+  on (the TPC-DS corpus runs in the tools/plan_diff.py CI leg);
+- ~8 deliberately unsound rules are each caught by their NAMED checker
+  with the rule attributed — the gate's whole contract is "unsound
+  rewrite -> rule name + checker + before/after snippet", not "wrong
+  answer three stages later";
+- the observability satellites: per-rule counters in EXPLAIN (TYPE
+  VALIDATE) / EXPLAIN ANALYZE VERBOSE and the pre-registered
+  ``optimizer.*`` metrics.
+"""
+
+import dataclasses
+
+import pytest
+
+from presto_tpu.analysis import (
+    RewriteSoundnessError,
+    check_rewrite,
+    derive_properties,
+    rewrite_validation_enabled,
+    set_rewrite_validation,
+)
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.expr.ir import AggCall, Call, call, col, lit
+from presto_tpu.matching import Pattern
+from presto_tpu.planner.iterative import (
+    DEFAULT_RULES,
+    IterativeOptimizer,
+    OptimizerStats,
+    Rule,
+)
+from presto_tpu.planner.plan import (
+    AggregationNode,
+    FilterNode,
+    LimitNode,
+    OutputNode,
+    ProjectNode,
+    SortNode,
+    TopNNode,
+    UnionNode,
+    ValuesNode,
+)
+from presto_tpu.runner import QueryRunner
+from presto_tpu.sql.parser import parse_query
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+from tests.tpch_queries import QUERIES
+
+
+def _random():
+    # random() is not a registered SQL function in this engine; the
+    # determinism checker keys on the _NONDETERMINISTIC name set
+    return Call(type=DOUBLE, fn="random", args=())
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.01))
+    return QueryRunner(catalog)
+
+
+def _values(n=3):
+    rows = [(i, f"s{i}") for i in range(n)]
+    return ValuesNode(["a", "b"], [BIGINT, VARCHAR], rows)
+
+
+def _optimize(plan, rule):
+    """One seeded rule under the gate; DEFAULT_RULES stay out of the
+    way so the violation is unambiguously the seed's."""
+    return IterativeOptimizer(rules=[rule], validate=True).optimize(plan)
+
+
+def _catch(plan, rule):
+    with pytest.raises(RewriteSoundnessError) as ei:
+        _optimize(plan, rule)
+    return ei.value
+
+
+# ---------------------------------------------------------------------------
+# clean corpus: every TPC-H query optimizes with zero violations
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpch_corpus_rewrites_sound(runner, qid):
+    plan = runner.binder.plan_ast(parse_query(QUERIES[qid]),
+                                  validate_rewrites=True)
+    assert plan is not None
+
+
+def test_env_flag_enables_gate_suite_wide():
+    """conftest sets PRESTO_TPU_VALIDATE_REWRITES=1, so every suite
+    query already runs under the gate — pin that wiring."""
+    assert rewrite_validation_enabled() is True
+
+
+def test_set_rewrite_validation_override():
+    set_rewrite_validation(False)
+    try:
+        assert rewrite_validation_enabled() is False
+    finally:
+        set_rewrite_validation(None)
+    assert rewrite_validation_enabled() is True
+
+
+# ---------------------------------------------------------------------------
+# seeded unsound rules: each caught by its named checker
+# ---------------------------------------------------------------------------
+
+def test_seeded_dropped_column_caught():
+    class DropColumn(Rule):
+        pattern = Pattern.type_of(ProjectNode)
+
+        def apply(self, node):
+            if len(node.projections) < 2:
+                return None
+            return ProjectNode(node.source, list(node.projections[:-1]),
+                               list(node.names[:-1]))
+
+    v = _values()
+    plan = OutputNode(
+        ProjectNode(v, [col(0, BIGINT), col(1, VARCHAR)], ["a", "b"]),
+        ["a", "b"])
+    err = _catch(plan, DropColumn())
+    assert err.rule == "DropColumn"
+    assert "output-schema" in {x.checker for x in err.violations}
+
+
+def test_seeded_retyped_column_caught():
+    class RetypeColumn(Rule):
+        pattern = Pattern.type_of(ProjectNode).where(
+            lambda n: any(p.type is BIGINT for p in n.projections))
+
+        def apply(self, node):
+            projs = [lit(0.0, DOUBLE) if p.type is BIGINT else p
+                     for p in node.projections]
+            return ProjectNode(node.source, projs, list(node.names))
+
+    plan = OutputNode(ProjectNode(_values(), [col(0, BIGINT)], ["a"]), ["a"])
+    err = _catch(plan, RetypeColumn())
+    assert err.rule == "RetypeColumn"
+    assert "output-schema" in {x.checker for x in err.violations}
+
+
+def test_seeded_widened_exact_count_caught():
+    class WidenLimit(Rule):
+        pattern = Pattern.type_of(LimitNode).where(lambda n: n.count == 2)
+
+        def apply(self, node):
+            return LimitNode(node.source, 3)
+
+    plan = OutputNode(LimitNode(_values(3), 2), ["a", "b"])
+    err = _catch(plan, WidenLimit())
+    assert err.rule == "WidenLimit"
+    assert "row-count" in {x.checker for x in err.violations}
+    # the diagnostic carries before/after plan snippets
+    assert "before:" in str(err) and "after:" in str(err)
+
+
+def test_seeded_lost_ordering_caught():
+    class DropSortKeepCount(Rule):
+        pattern = Pattern.type_of(TopNNode)
+
+        def apply(self, node):
+            return LimitNode(node.source, node.count)  # forgot the sort
+
+    plan = OutputNode(
+        TopNNode(_values(), [col(0, BIGINT)], [True], 2, None), ["a", "b"])
+    err = _catch(plan, DropSortKeepCount())
+    assert err.rule == "DropSortKeepCount"
+    assert "ordering" in {x.checker for x in err.violations}
+
+
+def test_seeded_duplicate_node_caught():
+    class SelfUnion(Rule):
+        pattern = Pattern.type_of(FilterNode)
+
+        def apply(self, node):
+            fresh = FilterNode(node.source, node.predicate)
+            return UnionNode([fresh, fresh])  # one node, two positions
+
+    plan = OutputNode(
+        FilterNode(_values(), call("gt", col(0, BIGINT), lit(0, BIGINT))),
+        ["a", "b"])
+    err = _catch(plan, SelfUnion())
+    assert err.rule == "SelfUnion"
+    assert "duplicate-node" in {x.checker for x in err.violations}
+
+
+def test_seeded_stale_columnref_caught():
+    class StaleRef(Rule):
+        pattern = Pattern.type_of(FilterNode)
+
+        def apply(self, node):
+            # predicate indexes a channel the source does not have
+            return FilterNode(node.source,
+                              call("gt", col(7, BIGINT), lit(0, BIGINT)))
+
+    plan = OutputNode(
+        FilterNode(_values(), call("gt", col(0, BIGINT), lit(0, BIGINT))),
+        ["a", "b"])
+    err = _catch(plan, StaleRef())
+    assert err.rule == "StaleRef"
+    assert "dangling-columnref" in {x.checker for x in err.violations}
+
+
+def test_seeded_nondeterministic_hoist_caught():
+    class DoubleRandom(Rule):
+        pattern = Pattern.type_of(ProjectNode).where(
+            lambda n: any(getattr(p, "fn", None) == "random"
+                          for p in n.projections))
+
+        def apply(self, node):
+            projs = [call("add", p, _random())
+                     if getattr(p, "fn", None) == "random" else p
+                     for p in node.projections]
+            return ProjectNode(node.source, projs, list(node.names))
+
+    plan = OutputNode(
+        ProjectNode(_values(), [_random()], ["r"]), ["r"])
+    err = _catch(plan, DoubleRandom())
+    assert err.rule == "DoubleRandom"
+    assert "determinism" in {x.checker for x in err.violations}
+
+
+def test_seeded_lost_uniqueness_caught():
+    class DropDistinct(Rule):
+        """distinct-projecting aggregation replaced by its source —
+        uniqueness of the group key is lost."""
+
+        pattern = Pattern.type_of(AggregationNode).where(
+            lambda n: not n.aggs and n.step == "single")
+
+        def apply(self, node):
+            return node.source
+
+    v = ValuesNode(["a"], [BIGINT], [(1,), (1,), (2,)])
+    plan = OutputNode(
+        AggregationNode(v, [col(0, BIGINT)], ["a"], [], [], "single"),
+        ["a"])
+    err = _catch(plan, DropDistinct())
+    assert err.rule == "DropDistinct"
+    assert "keys" in {x.checker for x in err.violations}
+
+
+def test_seeded_sort_dropped_entirely_caught():
+    class DropSort(Rule):
+        pattern = Pattern.type_of(SortNode)
+
+        def apply(self, node):
+            return node.source
+
+    plan = OutputNode(
+        SortNode(_values(), [col(0, BIGINT)], [True], None), ["a", "b"])
+    err = _catch(plan, DropSort())
+    assert err.rule == "DropSort"
+    assert "ordering" in {x.checker for x in err.violations}
+
+
+def test_violations_off_without_validate():
+    """The same unsound rule passes silently with validate=False — the
+    gate, not luck, is what catches it."""
+    class WidenLimit(Rule):
+        pattern = Pattern.type_of(LimitNode).where(lambda n: n.count == 2)
+
+        def apply(self, node):
+            return LimitNode(node.source, 3)
+
+    plan = OutputNode(LimitNode(_values(3), 2), ["a", "b"])
+    out = IterativeOptimizer(rules=[WidenLimit()]).optimize(plan)
+    assert out is not None  # silently wrong: exactly the pre-gate world
+
+
+# ---------------------------------------------------------------------------
+# logical-properties unit checks
+# ---------------------------------------------------------------------------
+
+def test_properties_values_exact():
+    p = derive_properties(_values(4))
+    assert (p.lo, p.hi, p.exact) == (4, 4, 4)
+    assert p.names == ("a", "b")
+
+
+def test_properties_limit_tightens():
+    p = derive_properties(LimitNode(_values(5), 2))
+    assert p.exact == 2
+
+
+def test_properties_filter_upper_bound_only():
+    p = derive_properties(
+        FilterNode(_values(5), call("gt", col(0, BIGINT), lit(3, BIGINT))))
+    assert (p.lo, p.hi, p.exact) == (0, 5, None)
+
+
+def test_properties_scan_keys_from_primary_key(runner):
+    plan = runner.binder.plan("SELECT n_nationkey, n_name FROM nation")
+    p = derive_properties(plan)
+    assert frozenset([0]) in p.keys  # pk column survives projection
+    assert p.exact == 25
+
+
+def test_properties_distinct_agg_keys():
+    v = ValuesNode(["a"], [BIGINT], [(1,), (1,), (2,)])
+    agg = AggregationNode(v, [col(0, BIGINT)], ["a"], [], [], "single")
+    p = derive_properties(agg)
+    assert frozenset([0]) in p.keys
+
+
+def test_properties_topn_ordering():
+    p = derive_properties(
+        TopNNode(_values(), [col(0, BIGINT)], [True], 2, None))
+    assert len(p.ordering) == 1 and p.ordering[0][1] is True
+
+
+def test_properties_global_agg_scalar():
+    agg = AggregationNode(
+        _values(), [], [],
+        [AggCall(fn="count_star", arg=None, type=BIGINT)], ["c"], "single")
+    p = derive_properties(agg)
+    assert p.exact == 1 and p.scalar
+
+
+def test_check_rewrite_identical_tree_clean():
+    plan = LimitNode(_values(), 2)
+    assert check_rewrite("Noop", plan, plan) == []
+
+
+# ---------------------------------------------------------------------------
+# observability satellites
+# ---------------------------------------------------------------------------
+
+def test_optimizer_stats_summary_format():
+    s = OptimizerStats()
+    assert s.summary() == "optimizer: 0 iterations"
+    s.record("B")
+    s.record("A")
+    s.record("A")
+    assert s.summary() == "optimizer: 3 iterations, rule hits: A=2, B=1"
+
+
+def test_explain_validate_reports_rule_hits(runner):
+    res = runner.execute(
+        "EXPLAIN (TYPE VALIDATE) SELECT n_name FROM nation "
+        "ORDER BY n_name LIMIT 3")
+    assert res.names == ["Valid", "Optimizer"]
+    valid, report = res.rows[0]
+    assert valid is True
+    assert report.startswith("optimizer:")
+    # the ORDER BY + LIMIT collapses via the TopN path; the report
+    # names whichever rule fired with its hit count
+    assert "PushTopNThroughProject=1" in report
+
+
+def test_explain_analyze_verbose_reports_optimizer_line(runner):
+    res = runner.execute(
+        "EXPLAIN ANALYZE VERBOSE SELECT n_name FROM nation "
+        "ORDER BY n_name LIMIT 3")
+    text = res.rows[0][0]
+    assert any(line.startswith("optimizer: ")
+               for line in text.splitlines())
+
+
+def test_optimizer_metrics_preregistered_and_counted(runner):
+    from presto_tpu.obs.metrics import METRICS
+
+    before = METRICS.counter("optimizer.rule_applications").value
+    runner.binder.plan("SELECT n_name FROM nation ORDER BY n_name LIMIT 3")
+    after = METRICS.counter("optimizer.rule_applications").value
+    assert after > before
+
+
+def test_rule_violations_metric_incremented():
+    from presto_tpu.obs.metrics import METRICS
+
+    class WidenLimit(Rule):
+        pattern = Pattern.type_of(LimitNode).where(lambda n: n.count == 2)
+
+        def apply(self, node):
+            return LimitNode(node.source, 3)
+
+    before = METRICS.counter("optimizer.rule_violations").value
+    with pytest.raises(RewriteSoundnessError):
+        IterativeOptimizer(rules=[WidenLimit()], validate=True).optimize(
+            OutputNode(LimitNode(_values(3), 2), ["a", "b"]))
+    assert METRICS.counter("optimizer.rule_violations").value == before + 1
+
+
+def test_session_property_round_trip(runner):
+    runner.execute("SET SESSION validate_rewrites = true")
+    try:
+        res = runner.execute("SELECT count(*) FROM region")
+        assert res.rows == [(5,)]
+    finally:
+        runner.execute("RESET SESSION validate_rewrites")
+
+
+def test_config_key_sets_session_default():
+    from presto_tpu.config import EngineConfig
+
+    cfg = EngineConfig(props={"query.validate-rewrites": "true"})
+    assert cfg.build_session().get("validate_rewrites") is True
+    assert EngineConfig().build_session().get("validate_rewrites") is False

@@ -598,9 +598,10 @@ def test_show_stats_and_explain_validate(runner):
     assert summary[0] is None and summary[-1] == 1500.0
     by_col = {r[0]: r for r in res.rows[:-1]}
     assert by_col["o_orderkey"][1] == 1500.0  # pk: ndv == rows
-    assert runner.execute(
-        "explain (type validate) select count(*) from orders"
-    ).rows == [(True,)]
+    res = runner.execute(
+        "explain (type validate) select count(*) from orders")
+    assert res.rows[0][0] is True
+    assert res.rows[0][1].startswith("optimizer:")
     with pytest.raises(Exception):
         runner.execute("explain (type validate) select nope from orders")
 

@@ -9,6 +9,10 @@
 #   3. plan-validator corpus      (tests/test_plan_validator.py:
 #      every TPC-H/TPC-DS query binds + validates clean, seeded-bug
 #      mutations still diagnose)
+#   3b. corpus plan-diff          (tools/plan_diff.py --check: golden
+#      plan-shape fingerprints for all 22 TPC-H + 99 TPC-DS queries,
+#      planned under the rewrite-soundness gate; an optimizer change
+#      that moves plans must refresh the goldens with --update)
 #   4. fault-injection leg        (tests/test_fault_tolerance.py under
 #      a FIXED fault seed: the chaos schedules — worker death
 #      mid-query, refused connects, corrupt pages, deadline kills —
@@ -29,6 +33,9 @@ python tools/bench_compare.py || echo "bench-compare failed (non-fatal)"
 echo "== plan-validator corpus ===================================="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_plan_validator.py -q \
     -p no:cacheprovider
+
+echo "== corpus plan-diff (golden fingerprints) ==================="
+env JAX_PLATFORMS=cpu python tools/plan_diff.py --check
 
 echo "== concurrent split-scheduler leg ==========================="
 # a fast tier-1 subset under PRESTO_TPU_TASK_CONCURRENCY=4: the morsel

@@ -70,6 +70,20 @@ thread-pool         ``ThreadPoolExecutor`` without a ``max_workers``
                     exec/tasks.py contract): a hard-coded pool ignores
                     the host, and an unbounded one is a fork bomb under
                     concurrent queries.
+rule-purity         An optimizer ``Rule.apply`` body that mutates its
+                    *input* — attribute/subscript assignment on the
+                    matched node or anything reachable from it, or a
+                    mutating container method (``.append``/``.extend``/
+                    ``.sort``…) on one of its fields — or reads the
+                    process environment.  Rules must be pure functions
+                    of the matched subtree that build replacement
+                    nodes: an in-place edit corrupts the shared DAG
+                    behind the optimizer's back (the rewrite-soundness
+                    gate in analysis/soundness.py can only compare
+                    before/after trees that are actually distinct).
+                    Locals built fresh (``list(node.projections)``,
+                    ``dataclasses.replace``) are exempt — taint follows
+                    aliases of the input only.
 
 Concurrency check
 -----------------
@@ -547,6 +561,115 @@ class _Linter(ast.NodeVisitor):
                        "masks engine bugs — name the exception types")
         self.generic_visit(node)
 
+    # -- rule-purity -------------------------------------------------------
+    #: container methods that mutate their receiver in place
+    _MUTATORS = {"append", "extend", "insert", "add", "update", "remove",
+                 "pop", "popitem", "clear", "setdefault", "sort", "reverse",
+                 "discard"}
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if any((isinstance(b, ast.Name) and b.id == "Rule")
+               or (isinstance(b, ast.Attribute) and b.attr == "Rule")
+               for b in node.bases):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and item.name == "apply":
+                    self._check_rule_purity(item)
+        self.generic_visit(node)
+
+    def _check_rule_purity(self, fn: ast.FunctionDef) -> None:
+        """``Rule.apply`` must be a pure function of the matched
+        subtree: no in-place mutation of the input node or anything
+        reachable from it, no environment reads.  Taint starts at the
+        node parameter and follows plain aliases (``x = node.source``,
+        ``for arm in node.inputs``); calls build fresh objects and
+        clear taint (``list(node.projections)``)."""
+        params = [a.arg for a in fn.args.args if a.arg != "self"]
+        if not params:
+            return
+        tainted = {params[0]}
+
+        def root(e: ast.AST) -> Optional[str]:
+            while isinstance(e, (ast.Attribute, ast.Subscript)):
+                e = e.value
+            return e.id if isinstance(e, ast.Name) else None
+
+        def aliases_input(e: ast.AST) -> bool:
+            # bare names / attribute / subscript chains alias existing
+            # objects; anything routed through a Call is fresh
+            if isinstance(e, (ast.Tuple, ast.List)):
+                return any(aliases_input(x) for x in e.elts)
+            if isinstance(e, (ast.Name, ast.Attribute, ast.Subscript)):
+                return root(e) in tainted
+            return False
+
+        changed = True
+        while changed:  # alias fixpoint (chains like a = node; b = a.left)
+            changed = False
+            for sub in ast.walk(fn):
+                names: List[str] = []
+                if isinstance(sub, ast.Assign) \
+                        and aliases_input(sub.value):
+                    names = [t.id for t in sub.targets
+                             if isinstance(t, ast.Name)]
+                elif isinstance(sub, ast.AnnAssign) \
+                        and sub.value is not None \
+                        and aliases_input(sub.value) \
+                        and isinstance(sub.target, ast.Name):
+                    names = [sub.target.id]
+                elif isinstance(sub, ast.For) \
+                        and aliases_input(sub.iter) \
+                        and isinstance(sub.target, ast.Name):
+                    names = [sub.target.id]
+                elif isinstance(sub, ast.comprehension) \
+                        and aliases_input(sub.iter) \
+                        and isinstance(sub.target, ast.Name):
+                    names = [sub.target.id]
+                for n in names:
+                    if n not in tainted:
+                        tainted.add(n)
+                        changed = True
+
+        for sub in ast.walk(fn):
+            targets: List[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                targets = list(sub.targets)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            for t in targets:
+                if isinstance(t, ast.Tuple):
+                    targets.extend(t.elts)
+                    continue
+                if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                        and root(t) in tainted:
+                    self._emit(
+                        sub, "rule-purity",
+                        f"Rule.apply mutates its input: assignment to "
+                        f"{ast.unparse(t)} — rules must build "
+                        "replacement nodes, not edit the matched "
+                        "subtree in place")
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in self._MUTATORS \
+                        and aliases_input(f.value):
+                    self._emit(
+                        sub, "rule-purity",
+                        f"Rule.apply mutates its input via "
+                        f".{f.attr}() on {ast.unparse(f.value)} — "
+                        "rules must build replacement nodes, not edit "
+                        "the matched subtree in place")
+            elif isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("environ", "getenv") \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "os":
+                self._emit(
+                    sub, "rule-purity",
+                    "Rule.apply reads the process environment — rule "
+                    "behavior must be a pure function of the matched "
+                    "subtree (resolve config at rule construction)")
+
     def visit_Raise(self, node: ast.Raise) -> None:
         if self._in_sql_frontend and node.exc is not None:
             exc = node.exc
@@ -567,7 +690,7 @@ class _Linter(ast.NodeVisitor):
 ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
              "block-until-ready", "bare-except", "spi-exception",
              "wallclock", "metric-catalog", "thread-pool",
-             "naked-urlopen"}
+             "naked-urlopen", "rule-purity"}
 
 #: the concurrency sanitizer's detector names (the second check); kept
 #: in sync with analysis/concurrency.CONCURRENCY_RULES by the tests

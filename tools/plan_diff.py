@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Corpus plan-diff harness: golden plan-shape fingerprints.
+
+Optimizes every TPC-H (22) + TPC-DS (99) query and fingerprints the
+optimized plan's canonical shape (``analysis.soundness.plan_shape_str``
+— no stats, estimates, or object identity), then compares against the
+committed goldens in ``tools/goldens/plan_fingerprints.json``.  Any
+optimizer-rule change shows exactly which query plans moved — the
+instrument ROADMAP item 3 (next ~15 rules) chooses rules by.
+
+Modes (mirroring tools/bench_compare.py):
+
+  python tools/plan_diff.py            report-only: print the diff,
+                                       exit 0
+  python tools/plan_diff.py --check    CI gate: exit 1 on any diff or
+                                       missing goldens
+  python tools/plan_diff.py --update   rewrite the goldens from the
+                                       current planner (commit the
+                                       result with the rule change
+                                       that moved the plans)
+
+Every query is planned with the rewrite-soundness gate ON, so a
+golden refresh can never capture the output of an unsound rewrite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+from typing import Dict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN_PATH = os.path.join(REPO, "tools", "goldens",
+                           "plan_fingerprints.json")
+
+
+def fingerprint(shape: str) -> str:
+    return hashlib.sha256(shape.encode()).hexdigest()[:16]
+
+
+def corpus_shapes() -> Dict[str, Dict[str, str]]:
+    """``{"tpch/q01": {"fingerprint": ..., "shape": ...}, ...}`` for
+    both corpora, planned with rewrite validation forced on."""
+    from presto_tpu.analysis.soundness import plan_shape_str
+    from presto_tpu.catalog import Catalog
+    from presto_tpu.connectors.tpcds import Tpcds
+    from presto_tpu.connectors.tpch import Tpch
+    from presto_tpu.runner import QueryRunner
+    from tests.tpcds_queries import QUERIES as TPCDS
+    from tests.tpch_queries import QUERIES as TPCH
+
+    corpora = (
+        ("tpch", TPCH, Tpch(sf=0.01)),
+        # cd/inventory truncated like the TPC-DS suite fixture: both
+        # are sf-independent cross products
+        ("tpcds", TPCDS, Tpcds(sf=0.01, split_rows=16384,
+                               cd_rows=2 * 5 * 7 * 20, inv_rows=60000)),
+    )
+    out: Dict[str, Dict[str, str]] = {}
+    for name, queries, conn in corpora:
+        catalog = Catalog()
+        catalog.register(name, conn)
+        runner = QueryRunner(catalog)
+        runner.session.set("validate_rewrites", True)
+        for qid in sorted(queries):
+            plan = runner.binder.plan(queries[qid])
+            shape = plan_shape_str(plan)
+            out[f"{name}/{qid}"] = {"fingerprint": fingerprint(shape),
+                                    "shape": shape}
+    return out
+
+
+def diff(golden: Dict[str, Dict[str, str]],
+         current: Dict[str, Dict[str, str]]) -> bool:
+    """Print per-query changes; True if anything differs."""
+    changed = False
+    for key in sorted(set(golden) | set(current)):
+        g, c = golden.get(key), current.get(key)
+        if g is None:
+            print(f"NEW     {key}  {c['fingerprint']}")
+            changed = True
+        elif c is None:
+            print(f"REMOVED {key}  {g['fingerprint']}")
+            changed = True
+        elif g["fingerprint"] != c["fingerprint"]:
+            changed = True
+            print(f"CHANGED {key}  {g['fingerprint']} -> {c['fingerprint']}")
+            old = g.get("shape", "").splitlines()
+            new = c.get("shape", "").splitlines()
+            import difflib
+
+            for line in difflib.unified_diff(old, new, "golden", "current",
+                                             lineterm="", n=1):
+                print(f"    {line}")
+    return changed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="exit 1 on any diff (the CI gate)")
+    mode.add_argument("--update", action="store_true",
+                      help="rewrite the goldens from the current planner")
+    args = ap.parse_args(argv)
+
+    current = corpus_shapes()
+
+    if args.update:
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(current, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(current)} fingerprints to {GOLDEN_PATH}")
+        return 0
+
+    if not os.path.exists(GOLDEN_PATH):
+        print(f"no goldens at {GOLDEN_PATH} — run with --update first")
+        return 1 if args.check else 0
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+
+    changed = diff(golden, current)
+    if not changed:
+        print(f"plan fingerprints clean: {len(current)} queries match "
+              "the goldens")
+        return 0
+    print("plan fingerprints moved — review the diff; if intended, "
+          "refresh with: python tools/plan_diff.py --update")
+    return 1 if args.check else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
