@@ -83,6 +83,42 @@ def set_rewrite_validation(value: Optional[bool]) -> None:
     _REWRITES.set(value)
 
 
+# expression-tier kernel-soundness gating (analysis/kernel_soundness.py):
+# same enablement shape — session property ``validate_kernels`` / config
+# ``query.validate-kernels`` / env, resolved once per process
+_KERNELS = EnvFlag("PRESTO_TPU_VALIDATE_KERNELS", default=False)
+
+
+def kernel_validation_enabled() -> bool:
+    """Process-wide switch for the expression-tier abstract
+    interpreter (``PRESTO_TPU_VALIDATE_KERNELS`` env; the per-session
+    ``validate_kernels`` property ORs on top in the runner)."""
+    return _KERNELS()
+
+
+def set_kernel_validation(value: Optional[bool]) -> None:
+    """Override hook (None re-resolves from the environment)."""
+    _KERNELS.set(value)
+
+
+# runtime cross-check for the interval domain: sample observed column
+# min/max at page boundaries and fail loudly on any escape from the
+# statically predicted interval (exec/local.py consumes this; the
+# concurrency sanitizer's PRESTO_TPU_LOCK_SANITIZER is the shape model)
+_RANGE_SANITIZER = EnvFlag("PRESTO_TPU_RANGE_SANITIZER", default=False)
+
+
+def range_sanitizer_enabled() -> bool:
+    """Process-wide switch for the runtime range sanitizer
+    (``PRESTO_TPU_RANGE_SANITIZER`` env)."""
+    return _RANGE_SANITIZER()
+
+
+def set_range_sanitizer(value: Optional[bool]) -> None:
+    """Override hook (None re-resolves from the environment)."""
+    _RANGE_SANITIZER.set(value)
+
+
 from presto_tpu.analysis.properties import (  # noqa: E402,F401
     LogicalProperties,
     derive_properties,
@@ -94,3 +130,9 @@ from presto_tpu.analysis.soundness import (  # noqa: E402,F401
     plan_shape_lines,
     plan_shape_str,
 )
+from presto_tpu.analysis.kernel_soundness import (  # noqa: E402,F401
+    KernelSoundnessError,
+    analyze_kernels,
+    assert_kernel_sound,
+)
+from presto_tpu.analysis.ranges import AbstractValue  # noqa: E402,F401

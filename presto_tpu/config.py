@@ -228,6 +228,11 @@ class EngineConfig:
         v = self.props.get("query.validate-rewrites")
         if v is not None and "validate_rewrites" not in props:
             props["validate_rewrites"] = v
+        # query.validate-kernels: expression-tier kernel-soundness
+        # gating (same sugar shape as validate-plans)
+        v = self.props.get("query.validate-kernels")
+        if v is not None and "validate_kernels" not in props:
+            props["validate_kernels"] = v
         # query.task-concurrency / query.task-prefetch: morsel split
         # scheduler defaults (dotted keys mirror the reference's
         # task.concurrency config; sugar for session.task_*)

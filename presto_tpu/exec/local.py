@@ -611,6 +611,9 @@ class LocalRunner:
             from presto_tpu.exec.tasks import SchedulerStats
 
             self._task_stats_tls.stats = SchedulerStats()
+            # per-query: predicted-interval memo keys on id(node), which
+            # is only stable while this query's plan is alive
+            self._range_pred_memo = {}
             if self.memory_pool is not None:
                 from presto_tpu.memory import QueryMemoryContext
                 import uuid
@@ -977,10 +980,12 @@ class LocalRunner:
         per-pull operator spans when the query traces.  Tracer-only
         runs skip the row-count device sync — tracing must not change
         the execution profile it measures."""
+        from presto_tpu.analysis import range_sanitizer_enabled
         from presto_tpu.obs.trace import current_tracer
 
         tracer = current_tracer()
-        if self.stats is None and tracer is None:
+        sanitize = range_sanitizer_enabled()
+        if self.stats is None and tracer is None and not sanitize:
             yield from self._pages_impl(node)
             return
         import time
@@ -1003,7 +1008,53 @@ class LocalRunner:
                 wall = time.perf_counter() - t0
                 rows = int(np.asarray(p.num_rows()))
                 self.stats.record(node, wall, rows)
+            if sanitize:
+                self._sanitize_page(node, p)
             yield p
+
+    def _sanitize_page(self, node: PlanNode, page: Page) -> None:
+        """PRESTO_TPU_RANGE_SANITIZER cross-check: every page crossing
+        a stage boundary is tested against the abstract interpreter's
+        predicted per-channel intervals (analysis/kernel_soundness.
+        predicted_intervals).  An observed value outside its predicted
+        interval means a transfer function under-approximates — that is
+        a checker bug, and it fails LOUDLY here rather than silently
+        missing real overflows forever."""
+        from presto_tpu.analysis.kernel_soundness import predicted_intervals
+        from presto_tpu.obs import METRICS
+
+        memo = getattr(self, "_range_pred_memo", None)
+        if memo is None:
+            memo = self._range_pred_memo = {}
+        if id(node) not in memo:
+            # the root call fills the whole subtree in one analysis;
+            # nodes the analyzer has no prediction for map to None
+            memo.update(predicted_intervals(node))
+            memo.setdefault(id(node), None)
+        preds = memo[id(node)]
+        if not preds:
+            return
+        for i, pred in enumerate(preds):
+            if pred is None or i >= len(page.blocks):
+                continue
+            b = page.blocks[i]
+            if getattr(b.data, "ndim", 0) != 1:
+                continue
+            live = np.asarray(page.row_mask & b.valid)
+            if not live.any():
+                continue
+            vals = np.asarray(b.data)[live]
+            lo, hi = pred
+            mn, mx = int(vals.min()), int(vals.max())
+            if mn < lo or mx > hi:
+                METRICS.counter("kernel.sanitizer_escapes").inc()
+                name = (node.output_names[i]
+                        if i < len(node.output_names) else f"${i}")
+                raise RuntimeError(
+                    f"range sanitizer: {type(node).__name__} channel "
+                    f"{i} ({name!r}) observed [{mn}, {mx}] outside the "
+                    f"predicted interval [{lo}, {hi}] — an abstract "
+                    "transfer under-approximates (analysis/ranges.py)")
 
     def _pages_impl(self, node: PlanNode) -> Iterator[Page]:
         if isinstance(node, OutputNode):
@@ -1884,9 +1935,10 @@ class LocalRunner:
                 return False
             if a.fn in ("count", "count_star", "min", "max"):
                 continue
-            if a.fn == "sum" and (
-                    a.type.is_integerlike
-                    or (a.type.is_decimal and not a.type.is_long_decimal)):
+            if a.fn == "sum" and (a.type.is_integerlike or a.type.is_decimal):
+                # all decimal sums are exact integer folds now — short
+                # ones in scaled int64, widened/long ones in base-1e9
+                # sum limbs (both associative and commutative)
                 continue
             return False
         return True

@@ -90,6 +90,108 @@ _UNARY_DOUBLE_FNS = {
 }
 
 
+# -- null-mask policy declarations ------------------------------------------
+# Expression-level analogue of analysis/rules.NULL_MASK_POLICY: every
+# scalar kernel family declares how its output validity mask relates to
+# its inputs'.  analysis/kernel_soundness.py proves this table against an
+# independent model (analysis/ranges.null_effect, derived from the
+# abstract-transfer catalog); a kernel with no declaration — or one whose
+# declaration disagrees with the model — fails EXPLAIN (TYPE VALIDATE)
+# and the corpus gate.
+#
+#   strict      output NULL iff any input NULL (validity = AND of inputs)
+#   preserving  validity is DERIVED, not intersected: 3VL short-circuits,
+#               conditionals, and null tests can return non-NULL from
+#               NULL inputs
+#   generating  the kernel itself introduces NULLs beyond its inputs'
+#               (overflow / zero-divisor / out-of-range-cast / parse
+#               failure lanes go invalid at runtime)
+NULL_POLICY = {}
+for _f in (
+    # comparisons and predicates over valid lanes
+    "eq", "ne", "lt", "le", "gt", "ge", "not", "like", "in",
+    "regexp_like", "starts_with", "ends_with", "contains",
+    "arrays_overlap", "is_json_scalar", "st_contains",
+    # arithmetic carried in float lanes (NaN, never wraps)
+    "pow", "power", "atan2", "sqrt", "cbrt", "exp", "ln", "log10", "log2",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "degrees", "radians", "is_nan", "is_finite", "is_infinite",
+    "sign", "ceil", "ceiling", "floor", "round", "truncate",
+    # widening / representation-preserving casts
+    "cast_real", "cast_decimal", "cast_char", "cast_varbinary",
+    "cast_date", "cast_time", "cast_timestamp",
+    # calendar moves and field extraction (every date has every field)
+    "year", "month", "day", "quarter", "week", "year_of_week",
+    "day_of_week", "day_of_year", "hour", "minute", "second",
+    "millisecond", "date_add", "date_add_days", "date_add_months",
+    "date_diff", "date_trunc", "date_format", "last_day_of_month",
+    "ts_add_micros", "ts_add_months", "to_unixtime",
+    # string transforms (total functions over their domain)
+    "length", "lower", "upper", "trim", "ltrim", "rtrim", "substr",
+    "concat", "replace", "reverse", "lpad", "rpad", "split",
+    "regexp_replace", "translate", "normalize", "soundex", "codepoint",
+    "levenshtein_distance", "hamming_distance", "jaccard_index",
+    "char2hexint", "to_utf8", "url_encode", "json_format", "repeat",
+    # digests and hashes
+    "md5_hex", "sha1_hex", "sha256_hex", "crc32", "xxhash64",
+    "hll_bucket", "hll_rho", "hash_counts", "classify", "regress",
+    "intersection_cardinality",
+    # containers: construction and total accessors
+    "cardinality", "array_construct", "array_concat", "array_distinct",
+    "array_union", "array_intersect", "array_except", "array_position",
+    "array_remove", "array_sort", "array_filter", "array_transform",
+    "any_match", "none_match", "all_match", "zip_with", "slice",
+    "sequence", "map", "map_construct", "map_keys", "map_values",
+    "map_filter", "transform_keys", "transform_values",
+    "row_construct", "row_field", "retype_row", "split_to_map",
+    # bitwise (wrap-free lane ops)
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_shift_left", "bitwise_shift_right", "bit_count",
+    # strict-null variadics (any NULL argument nulls the row)
+    "greatest", "least",
+    # geometry
+    "st_point", "st_x", "st_y", "st_area", "st_distance",
+    "st_geometryfromtext",
+    # TRY marker: runtime identity, mask passes through unchanged (the
+    # child's own policy accounts for its trapped lanes)
+    "try",
+):
+    NULL_POLICY[_f] = "strict"
+for _f in (
+    # 3VL short-circuits and conditionals derive their own validity
+    "and", "or", "coalesce", "if", "case",
+    # null tests always return a non-NULL boolean
+    "is_null", "not_null",
+    # and(ge, le) under the hood: FALSE can emerge from a NULL bound
+    "between",
+):
+    NULL_POLICY[_f] = "preserving"
+for _f in (
+    # wrapped add/sub/mul/neg/abs lanes NULL at runtime (the reference
+    # raises ARITHMETIC_OVERFLOW; see _ovf_add and friends)
+    "add", "sub", "mul", "neg", "abs",
+    # zero divisors NULL the lane (reference raises DIVISION_BY_ZERO)
+    "div", "mod",
+    # out-of-range narrowing NULLs (reference raises INVALID_CAST_ARGUMENT)
+    "cast_smallint", "cast_tinyint",
+    # varchar parse failures NULL (reference raises on bad input)
+    "cast_bigint", "cast_double",
+    "nullif",
+    # out-of-bounds / missing-key access
+    "subscript", "element_at",
+    # partial parses and extractions
+    "json_extract", "json_extract_scalar", "json_array_length",
+    "json_size", "json_parse",
+    "url_extract_host", "url_extract_path", "url_extract_port",
+    "url_extract_protocol", "url_extract_query", "url_decode",
+    "regexp_extract", "from_base", "date_parse", "from_iso8601_date",
+    "split_part", "array_min", "array_max", "array_sum", "array_average",
+    "reduce", "map_concat", "strpos", "width_bucket", "from_unixtime",
+):
+    NULL_POLICY[_f] = "generating"
+del _f
+
+
 # MySQL date_format/date_parse pattern -> python strftime/strptime
 # (DateTimeFunctions.java's JodaTime DateTimeFormat table)
 _MYSQL_FMT = {
@@ -734,6 +836,53 @@ def _trunc_div(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.where((a < 0) ^ (bs < 0), -q, q)
 
 
+# -- two's-complement overflow detection ------------------------------------
+# jnp integer ops wrap like C; the reference's checked bytecode raises
+# ARITHMETIC_OVERFLOW instead (operator/scalar/MathFunctions.java uses
+# Math.addExact and friends).  Jitted kernels can't raise, so wrapped
+# lanes are detected post-hoc and NULLed — the same documented-deviation
+# family as division-by-zero -> NULL.  The static analyzer
+# (analysis/kernel_soundness.py) reports where these guards can fire.
+
+def _ovf_add(a: jax.Array, b: jax.Array, r: jax.Array) -> jax.Array:
+    """r = a + b wrapped iff operands share a sign the result lost."""
+    return ((a ^ r) & (b ^ r)) < 0
+
+
+def _ovf_sub(a: jax.Array, b: jax.Array, r: jax.Array) -> jax.Array:
+    """r = a - b wrapped iff operands differ in sign and r flipped."""
+    return ((a ^ b) & (a ^ r)) < 0
+
+
+def _ovf_mul(a: jax.Array, b: jax.Array, r: jax.Array) -> jax.Array:
+    """r = a * b wrapped iff floor-dividing the result back misses b or
+    leaves a remainder (any nonzero deviation is a multiple of 2^width,
+    far above |a|).  The -1 * INT_MIN corner is pinned separately: there
+    the check division itself wraps and reports exact."""
+    imin = jnp.iinfo(r.dtype).min
+    den = jnp.where(a == 0, 1, a)
+    q = r // den
+    exact = (r - q * den == 0) & (q == b)
+    return ((a != 0) & jnp.logical_not(exact)) | ((a == -1) & (b == imin))
+
+
+def _ovf_neg(d: jax.Array) -> jax.Array:
+    """-INT_MIN / |INT_MIN| have no representation and wrap in place."""
+    return d == jnp.iinfo(d.dtype).min
+
+
+def _rescale_guard(data: jax.Array, from_scale: int,
+                   to_scale: int) -> Tuple[jax.Array, jax.Array]:
+    """`_rescale` plus a wrap mask: up-scaling multiplies by 10^k, so
+    any |value| beyond int64_max // 10^k wraps before the arithmetic it
+    feeds even runs (down-scaling only shrinks — never wraps)."""
+    if to_scale > from_scale:
+        f = 10 ** (to_scale - from_scale)
+        lim = jnp.iinfo(jnp.int64).max // f
+        return data * f, (data > lim) | (data < -lim)
+    return _rescale(data, from_scale, to_scale), jnp.zeros(data.shape, jnp.bool_)
+
+
 def _trunc_mod(a: jax.Array, b: jax.Array) -> jax.Array:
     """SQL mod takes the sign of the dividend."""
     bs = jnp.where(b == 0, 1, b)
@@ -777,6 +926,11 @@ class ExprCompiler:
 
         assert isinstance(expr, Call), expr
         fn = expr.fn
+        if fn == "try":
+            # runtime identity: trappable errors already NULL their
+            # lanes engine-wide; the node only marks the subtree as
+            # TRY-sanctioned for the kernel-soundness tier
+            return self.compile(expr.args[0])
         if fn == "row_construct":
             fns = [self.compile(a) for a in expr.args]
             rt = expr.type
@@ -856,7 +1010,16 @@ class ExprCompiler:
                 from presto_tpu.ops import decimal128 as d128
 
                 return lambda page: ((lambda dv: (d128.neg(dv[0]), dv[1]))(a(page)))
-            return lambda page: ((lambda dv: (-dv[0], dv[1]))(a(page)))
+
+            def run_neg(page):
+                d, v = a(page)
+                if jnp.issubdtype(d.dtype, jnp.integer):
+                    # -INT_MIN wraps in place; NULL that lane (deviation:
+                    # the reference raises ARITHMETIC_OVERFLOW)
+                    v = v & jnp.logical_not(_ovf_neg(d))
+                return -d, v
+
+            return run_neg
         if fn in ("year", "month", "day"):
             return self._compile_datepart(expr)
         if fn == "date_add_days":
@@ -932,8 +1095,16 @@ class ExprCompiler:
                 d, v = a(page)
                 if t.is_long_decimal:
                     return self._coerce(d, t, BIGINT_T), v
-                if t.is_decimal:
-                    d = d // (10 ** t.scale)
+                if t.is_decimal and t.scale:
+                    # HALF_UP, matching the reference's
+                    # DecimalCasts.shortDecimalToBigint (2.5 -> 3,
+                    # -2.5 -> -3); floor q plus remainder vote, with the
+                    # negative side tipping strictly past the midpoint
+                    s = 10 ** t.scale
+                    q = d // s
+                    r = d - q * s
+                    up = jnp.where(d >= 0, r * 2 >= s, r * 2 > s)
+                    d = q + up.astype(d.dtype)
                 return d.astype(jnp.int64), v
 
             return run_cast_bigint
@@ -953,9 +1124,16 @@ class ExprCompiler:
                 elif t.is_decimal:
                     d = d / (10.0 ** t.scale) if fn == "cast_real" \
                         else d // (10 ** t.scale)
-                # overflow truncates (documented deviation: the
-                # reference raises on out-of-range casts)
-                return d.astype(target), v
+                if fn == "cast_real":
+                    return d.astype(target), v
+                # out-of-range values NULL instead of wrapping
+                # (documented deviation: the reference raises
+                # INVALID_CAST_ARGUMENT); the range test runs at the
+                # wide dtype, before the narrowing astype can lie
+                info = jnp.iinfo(target)
+                wide = d.astype(jnp.int64)
+                fits = (wide >= info.min) & (wide <= info.max)
+                return wide.astype(target), v & fits
 
             return run_cast_narrow
         if fn in ("cast_char", "cast_varbinary"):
@@ -2239,6 +2417,10 @@ class ExprCompiler:
         def run_math(page):
             da, va = a(page)
             if fn == "abs":
+                if jnp.issubdtype(da.dtype, jnp.integer):
+                    # |INT_MIN| wraps in place; NULL that lane
+                    # (deviation: the reference raises)
+                    va = va & jnp.logical_not(_ovf_neg(da))
                 return jnp.abs(da), va
             if fn == "sign":
                 return jnp.sign(_to_double(da, ta)).astype(jnp.int64), va
@@ -2875,18 +3057,25 @@ class ExprCompiler:
                 db2 = db.astype(jnp.int64)
                 if op == "mul":
                     d = da2 * db2  # scale sa+sb == tr.scale
+                    valid = valid & jnp.logical_not(_ovf_mul(da2, db2, d))
                 else:
-                    da2 = _rescale(da2, sa, tr.scale)
-                    db2 = _rescale(db2, sb, tr.scale)
+                    da2, oa = _rescale_guard(da2, sa, tr.scale)
+                    db2, ob = _rescale_guard(db2, sb, tr.scale)
+                    valid = valid & jnp.logical_not(oa | ob)
                     d = {
                         "add": lambda: da2 + db2,
                         "sub": lambda: da2 - db2,
                         "mod": lambda: _trunc_mod(da2, db2),
                     }[op]()
-                    if op == "mod":
+                    if op == "add":
+                        valid = valid & jnp.logical_not(_ovf_add(da2, db2, d))
+                    elif op == "sub":
+                        valid = valid & jnp.logical_not(_ovf_sub(da2, db2, d))
+                    elif op == "mod":
                         valid = valid & (db2 != 0)
                 return d, valid
-            # integer arithmetic (SQL truncating div/mod)
+            # integer arithmetic (SQL truncating div/mod); wrapped
+            # add/sub/mul lanes NULL (deviation: reference raises)
             d = {
                 "add": lambda: da + db,
                 "sub": lambda: da - db,
@@ -2894,7 +3083,17 @@ class ExprCompiler:
                 "div": lambda: _trunc_div(da, db),
                 "mod": lambda: _trunc_mod(da, db),
             }[op]()
-            if op in ("div", "mod"):
+            if op == "add":
+                valid = valid & jnp.logical_not(_ovf_add(da, db, d))
+            elif op == "sub":
+                valid = valid & jnp.logical_not(_ovf_sub(da, db, d))
+            elif op == "mul":
+                valid = valid & jnp.logical_not(_ovf_mul(da, db, d))
+            elif op == "div":
+                imin = jnp.iinfo(d.dtype).min
+                valid = valid & (db != 0) \
+                    & jnp.logical_not((da == imin) & (db == -1))
+            elif op == "mod":
                 valid = valid & (db != 0)
             return d, valid
 

@@ -274,6 +274,11 @@ def _preregister(reg: MetricsRegistry) -> None:
         # rewrites rejected by the soundness gate
         # (planner/iterative.py + analysis/soundness.py)
         "optimizer.rule_applications", "optimizer.rule_violations",
+        # kernel-soundness analyzer: value hazards (overflow +
+        # lossy-cast + division) and null-policy violations found per
+        # analyzed plan (analysis/kernel_soundness.py)
+        "kernel.overflow_hazards", "kernel.null_violations",
+        "kernel.sanitizer_escapes",
     ):
         reg.counter(name)
     for name in (

@@ -23,10 +23,16 @@ the same kernel serves single-node, partial (pre-exchange) and final
 (post-exchange) aggregation — the PARTIAL/FINAL split of
 iterative/rule/PushPartialAggregationThroughExchange.java.
 
-Exact sums: DECIMAL aggregates accumulate in scaled int64, which is
-exact; chunk-level partial states are combined by the final step, and
-the driver can combine per-chunk int64 partials host-side in arbitrary
-precision if a single chunk could overflow (SF100 Q1 sum_charge).
+Exact sums: DECIMAL aggregates accumulate in scaled int64 when the
+argument precision is at most SUM_SHORT_SAFE_PRECISION (15); higher
+short precisions — every decimal arithmetic product types as p=18 —
+accumulate in two-limb decimal128 state instead, because an int64
+accumulator wraps silently once |addend| * rows crosses 2^63 (the
+SF100 Q1 sum_charge class: ~6e9 rows x 10^16-scale addends; the
+reference's checked accumulators raise ARITHMETIC_OVERFLOW there).
+The limb fold (decimal128.to_sum_limbs) is exact to ~9.2e9 addends;
+the kernel-soundness analyzer (analysis/kernel_soundness.py) flags any
+accumulator whose folded interval still escapes its state width.
 """
 
 from __future__ import annotations
@@ -68,11 +74,23 @@ ML_MAX_CLASSES = 8
 # agg state machinery
 # ---------------------------------------------------------------------------
 
+# max short-decimal argument precision whose sum may accumulate in a
+# plain int64 lane: 10^15 * ~9.2e3 max rows-per-... — conservatively,
+# |addend| <= 10^15 leaves four orders of magnitude of headroom below
+# 2^63 (~9.2e18), i.e. the fold stays exact past 9000x the largest
+# tier-1 table; p=16..18 addends (every decimal arith product types as
+# p=18) can cross 2^63 at realistic SF100 row counts and widen to
+# two-limb decimal128 accumulation instead
+SUM_SHORT_SAFE_PRECISION = 15
+
+
 def _sum_type(t: Type) -> Type:
     if t.is_decimal:
         if (t.precision or 0) > 36:
             return DecimalType(38, t.scale)
-        return DecimalType(36 if t.is_long_decimal else 18, t.scale)
+        if t.is_long_decimal or (t.precision or 0) > SUM_SHORT_SAFE_PRECISION:
+            return DecimalType(36, t.scale)
+        return DecimalType(18, t.scale)
     if t.name.startswith("interval"):
         return t  # interval sums stay interval (Interval*SumAggregation)
     if t.name in ("double", "real"):
@@ -413,9 +431,14 @@ def _partial_states(page: Page, aggs: Sequence[AggCall], gid: jax.Array, n: int,
         cnt = _gsum(ctx, nonnull.astype(jnp.int64), gid_nn, n)
         if agg.fn == "count":
             out.append([cnt])
-        elif agg.fn in ("sum", "sum0", "avg") and agg.arg.type.is_long_decimal:
+        elif agg.fn in ("sum", "sum0", "avg") \
+                and _sum_type(agg.arg.type).is_long_decimal:
             from presto_tpu.ops import decimal128 as d128
 
+            # covers short p>15 args too: their scaled-int64 lanes lift
+            # to two-limb rows first, then the same base-1e9 digit fold
+            if not agg.arg.type.is_long_decimal:
+                data = d128.from_int64(data.astype(jnp.int64))
             limbs = d128.to_sum_limbs(data)
             limbs = jnp.where(nonnull[:, None], limbs, 0)
             s = d128.from_sum_limbs(_gsum(ctx, limbs, gid_nn, n))
@@ -861,7 +884,7 @@ def combine_packed_states(a: Page, b: Page, num_keys: int,
         if agg.fn in ("count", "count_star"):
             merged = [sa[0] + sb[0]]
         elif agg.fn in ("sum", "sum0", "avg") and agg.arg is not None \
-                and agg.arg.type.is_long_decimal:
+                and sts[0].is_long_decimal:  # incl. widened short p>15 args
             from presto_tpu.ops import decimal128 as d128
 
             merged = [d128.add(sa[0], sb[0]), sa[1] + sb[1]]
@@ -1036,7 +1059,7 @@ def _merge_states(state_cols: List[List[jax.Array]], aggs, gid, n,
         if agg.fn in ("count", "count_star"):
             out.append([_gsum(ctx, cols[0], gid, n)])
         elif agg.fn in ("sum", "sum0", "avg") and agg.arg is not None \
-                and agg.arg.type.is_long_decimal:
+                and state_types(agg)[0].is_long_decimal:
             from presto_tpu.ops import decimal128 as d128
 
             live_rows = cols[1] > 0
@@ -1351,22 +1374,34 @@ def _finalize(states: List[List[jax.Array]], aggs, agg_dicts=None) -> List[Block
         t = output_type(agg)
         if agg.fn in ("count", "count_star"):
             blocks.append(Block(cols[0].astype(jnp.int64), jnp.ones_like(cols[0], jnp.bool_), t))
-        elif agg.fn == "sum":
+        elif agg.fn in ("sum", "sum0"):
+            # sum0 = sum with 0-on-empty: the outer half of a decomposed
+            # plain count in the mixed-DISTINCT rewrite (never NULL)
             s, cnt = cols
-            blocks.append(Block(s.astype(t.np_dtype), cnt > 0, t))
-        elif agg.fn == "sum0":
-            # sum with 0-on-empty: the outer half of a decomposed plain
-            # count in the mixed-DISTINCT rewrite (count is never NULL)
-            s, cnt = cols
-            blocks.append(Block(s.astype(t.np_dtype),
-                                jnp.ones_like(cnt, jnp.bool_), t))
+            st = _sum_type(agg.arg.type) if agg.arg is not None else t
+            if st.is_long_decimal and agg.type.is_decimal \
+                    and not agg.type.is_long_decimal:
+                # outer half of a decomposed sum (mixed-DISTINCT
+                # rewrite): the fold runs in widened limbs because the
+                # partial-sum argument types as p=18, but the plan keeps
+                # the original short output type — collapse like avg
+                s = s[..., 0] * jnp.int64(10 ** 18) + s[..., 1]
+                t = agg.type
+            valid = cnt > 0 if agg.fn == "sum" \
+                else jnp.ones_like(cnt, jnp.bool_)
+            blocks.append(Block(s.astype(t.np_dtype), valid, t))
         elif agg.fn == "avg":
             s, cnt = cols
             st = _sum_type(agg.arg.type)
             n = jnp.maximum(cnt, 1)
             if t.is_decimal and st.is_long_decimal:
                 # exact unscaled-sum / count, HALF_UP, staying decimal
-                blocks.append(Block(_avg_decimal128(s, n), cnt > 0, t))
+                q = _avg_decimal128(s, n)
+                if not t.is_long_decimal:
+                    # widened accumulator over a short p>15 argument:
+                    # the per-group mean fits the argument type again
+                    q = q[..., 0] * jnp.int64(10 ** 18) + q[..., 1]
+                blocks.append(Block(q, cnt > 0, t))
             elif t.is_decimal:
                 av = jnp.abs(s)
                 sign = jnp.where(s < 0, -1, 1)

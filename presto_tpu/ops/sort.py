@@ -44,11 +44,11 @@ def _value_key(data: jax.Array, ascending: bool) -> jax.Array:
     """Exact sortable form of one key's values. Integers stay integral
     (no float64 round-trip — BIGINT/DECIMAL beyond 2^53 must order
     exactly); descending integers use bitwise complement (~x = -x-1,
-    overflow-free), descending floats negate."""
+    overflow-free), descending floats negate.  Long-decimal limb
+    matrices go through the multi-pass path in sort_perm, not here."""
     if data.ndim > 1:
         raise ValueError(
-            "long-decimal sort keys unsupported (cast to a shorter "
-            "decimal or double)")
+            "limb sort keys take the per-limb radix path (sort_perm)")
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.int32)
     if jnp.issubdtype(data.dtype, jnp.floating):
@@ -79,6 +79,15 @@ def sort_perm(
             # last byte column to the first (static width unrolls)
             for j in range(d.shape[-1] - 1, -1, -1):
                 kb = _value_key(d[:, j].astype(jnp.int32), asc)
+                perm = perm[jnp.argsort(kb[perm], stable=True)]
+        elif e.type.is_long_decimal and d.ndim > 1:
+            # long decimals (widened sums, p>18 columns): the canonical
+            # limb form IS value order (msb-first digits, limbs[1:]
+            # non-negative — ops/decimal128.compare), so the same
+            # stable radix composition as raw strings works limb-wise;
+            # ~x on each int64 limb inverts the order exactly
+            for j in range(d.shape[-1] - 1, -1, -1):
+                kb = _value_key(d[:, j], asc)
                 perm = perm[jnp.argsort(kb[perm], stable=True)]
         else:
             k = _value_key(d, asc)

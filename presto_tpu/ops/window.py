@@ -69,10 +69,25 @@ class WindowFunc:
         if self.kind in ("avg", "percent_rank", "cume_dist"):
             return DOUBLE
         if self.kind == "sum":
-            from presto_tpu.ops.aggregate import _sum_type
-
-            return _sum_type(self.arg.type)
+            return _window_sum_type(self.arg.type)
         return self.arg.type
+
+
+def _window_sum_type(t: Type) -> Type:
+    """Window-frame sum accumulator/output type.  Unlike the grouped
+    aggregation tier (ops/aggregate._sum_type widens short p>15 args to
+    limb state), frames accumulate via 1-D cumsum over at most one
+    page of rows, so short decimals stay scaled int64 — the
+    kernel-soundness analyzer treats window outputs as unbounded and
+    the page-capacity row bound keeps the fold inside 2^63 for the
+    corpus precisions."""
+    if t.is_decimal and not t.is_long_decimal:
+        from presto_tpu.types import DecimalType
+
+        return DecimalType(18, t.scale)
+    from presto_tpu.ops.aggregate import _sum_type
+
+    return _sum_type(t)
 
 
 def _segmented_scan(op, vals: jax.Array, seg_first: jax.Array) -> jax.Array:
@@ -110,8 +125,19 @@ def window_page(
         from presto_tpu.ops.sort import _dict_rank
 
         d = _dict_rank(page, e, d)
-        k = _value_key(d, asc)
-        perm = perm[jnp.argsort(k[perm], stable=True)]
+        if d.ndim > 1:
+            # limb matrices (widened long-decimal sums) and raw-string
+            # lane keys: canonical form IS value order, so the same
+            # stable radix composition sort_perm uses works here
+            for j in range(d.shape[-1] - 1, -1, -1):
+                col = d[:, j]
+                if col.dtype != jnp.int64:
+                    col = col.astype(jnp.int32)
+                kb = _value_key(col, asc)
+                perm = perm[jnp.argsort(kb[perm], stable=True)]
+        else:
+            k = _value_key(d, asc)
+            perm = perm[jnp.argsort(k[perm], stable=True)]
         null_rank = jnp.where(v, 0, 1)  # nulls last (Presto default asc)
         perm = perm[jnp.argsort(null_rank[perm], stable=True)]
     if partition_exprs:
@@ -142,8 +168,11 @@ def window_page(
         d, v = c.compile(e)(page)
         ds = d[perm]
         vs = v[perm]
+        neq = ds[1:] != ds[:-1]
+        if neq.ndim > 1:  # limb keys: rows differ if ANY limb differs
+            neq = neq.any(axis=-1)
         changed = jnp.concatenate(
-            [jnp.ones(1, jnp.bool_), (ds[1:] != ds[:-1]) | (vs[1:] != vs[:-1])]
+            [jnp.ones(1, jnp.bool_), neq | (vs[1:] != vs[:-1])]
         )
         peer_first = peer_first | changed
 
@@ -312,9 +341,7 @@ def _compute_sorted(f, c, page, perm, idx, cap, live_s, seg_first, peer_first,
     if f.kind in ("count", "count_star"):
         return cnt, jnp.ones(cap, jnp.bool_)
     if f.kind in ("sum", "avg"):
-        from presto_tpu.ops.aggregate import _sum_type
-
-        st = _sum_type(f.arg.type)
+        st = _window_sum_type(f.arg.type)
         vals = jnp.where(vs, ds.astype(st.np_dtype), jnp.zeros((), st.np_dtype))
         s_out = frame_sum(vals)
         if f.kind == "sum":

@@ -1457,9 +1457,18 @@ class DistributedRunner:
     def _initial_acc(self, channels, mg: int, n: int, sharding) -> Page:
         blocks = []
         for ch in channels:
+            shape = (n, mg)
+            if ch.type.is_long_decimal:
+                # widened decimal sum states ride the exchange as limb
+                # matrices; all-zero limbs are the canonical combine
+                # identity (ops/decimal128 layout)
+                from presto_tpu.ops import decimal128 as d128
+
+                shape += (d128.WIDE_LIMBS
+                          if (ch.type.precision or 0) > 36 else 2,)
             blocks.append(
                 Block(
-                    jnp.zeros((n, mg), dtype=ch.type.np_dtype),
+                    jnp.zeros(shape, dtype=ch.type.np_dtype),
                     jnp.zeros((n, mg), dtype=jnp.bool_),
                     ch.type,
                     ch.dictionary,

@@ -91,7 +91,9 @@ def partition_for_exchange(
     out_cap = n_parts * bucket_cap
     blocks: List[Block] = []
     for b in page.blocks:
-        data = jnp.zeros((out_cap,), dtype=b.data.dtype).at[dest].set(
+        # trailing dims ride along (limb matrices, raw-string lanes)
+        data = jnp.zeros((out_cap,) + b.data.shape[1:],
+                         dtype=b.data.dtype).at[dest].set(
             b.data[order], mode="drop"
         )
         valid = jnp.zeros((out_cap,), dtype=jnp.bool_).at[dest].set(

@@ -136,13 +136,22 @@ class QueryRunner:
         """Run the static plan/IR validator when always-on checking is
         enabled (``validate_plans`` session property or the process-wide
         ``PRESTO_TPU_VALIDATE_PLANS`` switch the test harness sets);
-        cached plans validate once at bind time."""
-        from presto_tpu.analysis import validation_enabled
+        cached plans validate once at bind time.  The kernel-soundness
+        tier (``validate_kernels`` / ``PRESTO_TPU_VALIDATE_KERNELS``)
+        gates the same way: the abstract interpreter proves overflow,
+        lossy-cast, division, accumulator, and null-policy soundness of
+        every compiled expression before the plan can execute."""
+        from presto_tpu.analysis import (kernel_validation_enabled,
+                                         validation_enabled)
 
         if validation_enabled() or self.session.get("validate_plans"):
             from presto_tpu.analysis import assert_valid
 
             assert_valid(plan)
+        if kernel_validation_enabled() or self.session.get("validate_kernels"):
+            from presto_tpu.analysis import assert_kernel_sound
+
+            assert_kernel_sound(plan)
         return plan
 
     def _tracing_enabled(self) -> bool:
@@ -294,10 +303,15 @@ class QueryRunner:
                 # determinism — PlanValidationError propagates with
                 # node-specific diagnostics (EXPLAIN (TYPE VALIDATE));
                 # every rewrite already passed the soundness gate above
-                from presto_tpu.analysis import assert_valid
+                from presto_tpu.analysis import (assert_kernel_sound,
+                                                 assert_valid)
                 from presto_tpu.types import BOOLEAN
 
                 assert_valid(plan)
+                # kernel-soundness tier: interval/overflow/null-policy
+                # proof over every compiled expression (KernelSoundness-
+                # Error carries node-attributed diagnostics)
+                assert_kernel_sound(plan)
                 report = getattr(plan, "_optimizer_report", None)
                 summary = report.summary() if report else "optimizer: n/a"
                 return MaterializedResult(

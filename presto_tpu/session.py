@@ -111,6 +111,15 @@ SYSTEM_PROPERTIES = [
         False, _bool,
     ),
     PropertyMetadata(
+        "validate_kernels",
+        "run the expression-tier abstract interpreter on every bound "
+        "plan: overflow, lossy-cast, division, accumulator, and "
+        "null-policy soundness (analysis/kernel_soundness.py; EXPLAIN "
+        "(TYPE VALIDATE) always does; query.validate-kernels config "
+        "key sets the default)",
+        False, _bool,
+    ),
+    PropertyMetadata(
         "distributed_min_stage_rows",
         "stages over intermediates smaller than this run on the "
         "coordinator (0 = every stage on the mesh)",

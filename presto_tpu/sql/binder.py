@@ -727,6 +727,13 @@ class Binder:
                 # a clean bound plan isolates any later violation to a
                 # rewrite (the runner validates the optimized plan)
                 analysis.assert_valid(out)
+            if analysis.kernel_validation_enabled() or (
+                    self.session is not None
+                    and bool(self.session.get("validate_kernels"))):
+                # same pre-optimization split for the kernel-soundness
+                # tier: a clean bound plan pins any post-optimization
+                # hazard on the rewrite that introduced it
+                analysis.assert_kernel_sound(out)
             # iterative rule engine over the bound plan
             # (sql/planner/iterative/IterativeOptimizer.java)
             from presto_tpu.planner.iterative import IterativeOptimizer
@@ -3330,15 +3337,19 @@ class Binder:
                 return self._bind_impl(
                     ast.FuncCall("coalesce", e.args), scope, agg)
             if e.name == "try":
-                # TRY(e) -> e: the trappable errors the reference's
+                # TRY(e): the trappable errors the reference's
                 # TryExpression catches (division by zero, unparseable
                 # casts, out-of-range subscripts) already evaluate to
-                # NULL engine-wide (XLA kernels cannot trap), so TRY is
-                # the identity here (sql/tree/TryExpression.java +
-                # DesugarTryExpression.java)
+                # NULL engine-wide (XLA kernels cannot trap), so TRY
+                # compiles to the identity (sql/tree/TryExpression.java
+                # + DesugarTryExpression.java) — but the marker stays
+                # in the IR so the kernel-soundness tier knows hazards
+                # beneath it are sanctioned: inside TRY the reference
+                # ALSO returns NULL, so NULLed lanes are not deviations
                 if len(e.args) != 1:
                     raise BindError("try takes one argument")
-                return self._bind_impl(e.args[0], scope, agg)
+                inner = self._bind_impl(e.args[0], scope, agg)
+                return Call(type=inner.type, fn="try", args=(inner,))
             if e.name == "features":
                 # presto-ml feature vector -> ARRAY(double)
                 args = [call("cast_double", self._bind_impl(a, scope, agg))

@@ -26,6 +26,11 @@ os.environ.setdefault("PRESTO_TPU_VALIDATE_PLANS", "1")
 # gate (presto_tpu/analysis/soundness.py): an unsound rewrite fails
 # the suite naming the rule, not as a wrong answer downstream
 os.environ.setdefault("PRESTO_TPU_VALIDATE_REWRITES", "1")
+# ... and every bound plan runs the expression-tier abstract
+# interpreter (presto_tpu/analysis/kernel_soundness.py): a provable
+# overflow, lossy cast, literal zero divisor, wrapping accumulator, or
+# null-policy mismatch fails the suite with node-level attribution
+os.environ.setdefault("PRESTO_TPU_VALIDATE_KERNELS", "1")
 
 import jax
 

@@ -478,6 +478,56 @@ def test_rule_purity_suppression_entry(tmp_path):
     assert engine_lint.apply_suppressions(findings, entries) == []
 
 
+def test_narrow_cast_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def kernel(d):
+            a = d.astype(jnp.int32)
+            b = jnp.asarray(d, dtype=jnp.int16)
+            c = d.astype("int8")
+            return a, b, c
+    """, subdir="ops")
+    assert [f.rule for f in findings] == ["narrow-cast"] * 3
+
+
+def test_narrow_cast_type_map_and_fresh_construction_exempt(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def kernel(d, t):
+            a = d.astype(t.np_dtype)              # declared type map
+            idx = jnp.arange(8, dtype=jnp.int32)  # fresh construction
+            z = jnp.zeros(8, dtype=jnp.int32)     # fresh construction
+            wide = d.astype(jnp.int64)            # widening
+            return a, idx, z, wide
+    """, subdir="ops")
+    assert findings == []
+
+
+def test_narrow_cast_scoped_to_kernel_code(tmp_path):
+    # non-kernel tiers (exec/, parallel/, obs/...) narrow host-side
+    # bookkeeping values freely
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def helper(d):
+            return d.astype(jnp.int32)
+    """)
+    assert findings == []
+
+
+def test_narrow_cast_allow_comment(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def kernel(codes):
+            # codes bounded by dictionary size
+            return codes.astype(jnp.int32)  # lint: allow(narrow-cast)
+    """, subdir="ops")
+    assert findings == []
+
+
 # ---------------------------------------------------------------------------
 # the repo-wide pin
 # ---------------------------------------------------------------------------

@@ -1,0 +1,399 @@
+"""Unit tests for the interval × null × nan abstract domain
+(presto_tpu/analysis/ranges.py): interval arithmetic with ±inf
+sentinels, per-type bounds, and one transfer-function test per IR op
+family.  These are the soundness bricks the kernel-soundness checker
+and the runtime range sanitizer are built from — each case states the
+concrete kernel behavior the abstract rule must over-approximate.
+"""
+
+import math
+
+import pytest
+
+from presto_tpu.analysis import ranges
+from presto_tpu.analysis.ranges import (
+    I8,
+    I16,
+    I32,
+    I64,
+    INF,
+    AbstractValue,
+    device_int_bounds,
+    eval_expr,
+    from_literal,
+    iv_abs,
+    iv_add,
+    iv_div,
+    iv_mod,
+    iv_mul,
+    iv_neg,
+    iv_sub,
+    null_effect,
+    top,
+    transfer,
+    type_bounds,
+)
+from presto_tpu.expr.ir import Call, ColumnRef, Literal
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    REAL,
+    SMALLINT,
+    TINYINT,
+    VARCHAR,
+    DecimalType,
+)
+
+
+def av(lo, hi, **kw):
+    kw.setdefault("may_null", False)
+    kw.setdefault("known", True)
+    return AbstractValue(lo, hi, **kw)
+
+
+# ---------------------------------------------------------------------------
+# lattice + bounds
+# ---------------------------------------------------------------------------
+
+def test_join_is_lub():
+    a = av(0, 10)
+    b = AbstractValue(-5, 3, may_null=True, may_nan=True, known=False)
+    j = a.join(b)
+    assert (j.lo, j.hi) == (-5, 10)
+    assert j.may_null and j.may_nan
+    # evidence survives only if BOTH sides carry it
+    assert j.known is False
+    assert a.join(av(20, 30)).known is True
+
+
+def test_contains():
+    assert av(-INF, 5).contains(-(10 ** 30))
+    assert not av(0, 5).contains(6)
+
+
+def test_type_bounds_per_type():
+    assert type_bounds(TINYINT) == I8
+    assert type_bounds(SMALLINT) == I16
+    assert type_bounds(INTEGER) == I32
+    assert type_bounds(DATE) == I32
+    assert type_bounds(BIGINT) == I64
+    assert type_bounds(BOOLEAN) == (0, 1)
+    assert type_bounds(DOUBLE) == (-INF, INF)
+    # dictionary codes are non-negative
+    assert type_bounds(VARCHAR) == (0, INF)
+    # short decimal: the declared bound (fits the int64 lane at p<=18)
+    assert type_bounds(DecimalType(3, 1)) == (-999, 999)
+    assert type_bounds(DecimalType(18, 0)) == (-(10 ** 18 - 1), 10 ** 18 - 1)
+    # long decimal: limbs cover the full declared precision
+    assert type_bounds(DecimalType(30, 0)) == (-(10 ** 30 - 1), 10 ** 30 - 1)
+
+
+def test_device_int_bounds_wrap_points():
+    # DECIMAL(12,2) is stored in int64 lanes: it physically wraps at
+    # I64, not at 10^12 — the distinction the overflow checker rests on
+    assert device_int_bounds(DecimalType(12, 2)) == I64
+    assert device_int_bounds(BIGINT) == I64
+    assert device_int_bounds(INTEGER) == I32
+    assert device_int_bounds(DATE) == I32
+    assert device_int_bounds(SMALLINT) == I16
+    assert device_int_bounds(TINYINT) == I8
+    # floats and limb vectors have no wrap point
+    assert device_int_bounds(DOUBLE) is None
+    assert device_int_bounds(DecimalType(30, 2)) is None
+
+
+def test_from_literal():
+    assert from_literal(Literal(type=BIGINT, value=7)) == av(7, 7)
+    n = from_literal(Literal(type=BIGINT, value=None))
+    assert n.may_null and n.known
+    t = from_literal(Literal(type=BOOLEAN, value=True))
+    assert (t.lo, t.hi) == (1, 1)
+    nan = from_literal(Literal(type=DOUBLE, value=float("nan")))
+    assert nan.may_nan and nan.known and nan.lo == -INF
+    # strings resolve to dictionary codes at compile time: unknown here
+    s = from_literal(Literal(type=VARCHAR, value="x"))
+    assert not s.known and not s.may_null
+
+
+def test_top_is_assumed():
+    t = top(DOUBLE)
+    assert not t.known and t.may_nan and t.may_null
+    assert not top(BIGINT, may_null=False).may_null
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic (±inf sentinels, exact ints when finite)
+# ---------------------------------------------------------------------------
+
+def test_iv_add_sub():
+    assert iv_add(av(1, 2), av(10, 20)) == (11, 22)
+    assert iv_sub(av(1, 2), av(10, 20)) == (-19, -8)
+    assert iv_add(av(-INF, 5), av(1, 1)) == (-INF, 6)
+
+
+def test_iv_mul_corners_and_zero_times_inf():
+    assert iv_mul(av(-2, 3), av(-5, 7)) == (-15, 21)
+    # standard interval convention: 0 × ±inf = 0
+    assert iv_mul(av(0, 0), av(-INF, INF)) == (0, 0)
+    assert iv_mul(av(0, 2), av(-INF, INF)) == (-INF, INF)
+
+
+def test_iv_neg_abs():
+    assert iv_neg(av(-3, 7)) == (-7, 3)
+    assert iv_abs(av(-3, 7)) == (0, 7)
+    assert iv_abs(av(2, 7)) == (2, 7)
+    assert iv_abs(av(-7, -2)) == (2, 7)
+    assert iv_abs(av(-INF, -2)) == (2, INF)
+
+
+def test_iv_div():
+    # positive divisor interval
+    assert iv_div(av(10, 10), av(2, 5), trunc=True) == (2, 5)
+    # straddling zero: the excluded-zero worst cases are at ±1
+    assert iv_div(av(7, 7), av(-3, 3), trunc=True) == (-7, 7)
+    # all-zero divisor: every lane nulls, quotient interval collapses
+    assert iv_div(av(7, 7), av(0, 0), trunc=True) == (0, 0)
+    # unbounded dividend keeps the unbounded direction
+    lo, hi = iv_div(av(-INF, INF), av(1, 1), trunc=True)
+    assert (lo, hi) == (-INF, INF)
+    # unbounded divisor magnitude drives quotients toward zero
+    assert 0 in range(*map(int, iv_div(av(5, 5), av(1, INF), trunc=True))) \
+        or iv_div(av(5, 5), av(1, INF), trunc=True)[0] == 0
+
+
+def test_iv_mod_dividend_sign():
+    # SQL mod takes the dividend's sign, |r| < |b|
+    assert iv_mod(av(-10, 20), av(3, 7)) == (-6, 6)
+    assert iv_mod(av(5, 20), av(3, 7)) == (0, 6)
+    # |r| also bounded by |a|
+    assert iv_mod(av(2, 2), av(100, 100)) == (0, 2)
+    assert iv_mod(av(-4, -1), av(-INF, INF)) == (-4, 0)
+
+
+def test_rescale_iv():
+    # up-scale multiplies, preserving inf sentinels
+    assert ranges._rescale_iv(-2, 3, 0, 2) == (-200, 300)
+    assert ranges._rescale_iv(-INF, 3, 0, 2) == (-INF, 300)
+    # down-scale truncates toward zero (the kernel's integer divide)
+    assert ranges._rescale_iv(-25, 25, 1, 0) == (-2, 2)
+
+
+# ---------------------------------------------------------------------------
+# transfer catalog, one case per op family
+# ---------------------------------------------------------------------------
+
+def test_transfer_bool_fns_three_valued():
+    r = transfer("lt", BOOLEAN, [av(0, 9), av(0, 9, may_null=True)],
+                 [BIGINT, BIGINT])
+    assert (r.lo, r.hi) == (0, 1)
+    assert r.may_null and r.known
+    # is_null / not_null never return NULL, whatever the input
+    r = transfer("is_null", BOOLEAN, [top(BIGINT)], [BIGINT])
+    assert not r.may_null
+
+
+def test_transfer_add_rescales_to_output_scale():
+    # DECIMAL(4,1) + DECIMAL(4,2) -> scale-2 output: the scale-1 arg's
+    # raw ints are ×10 before the add, exactly like the kernel
+    a = av(-50, 50)      # 5.0 at scale 1
+    b = av(-25, 25)      # 0.25 at scale 2
+    r = transfer("add", DecimalType(6, 2), [a, b],
+                 [DecimalType(4, 1), DecimalType(4, 2)])
+    assert (r.lo, r.hi) == (-525, 525)
+    assert r.known and not r.may_null
+
+
+def test_transfer_mul_scales_add():
+    # mul: no rescale — output scale is sa+sb, raw products are exact
+    r = transfer("mul", DecimalType(8, 3), [av(0, 100), av(-30, 30)],
+                 [DecimalType(4, 1), DecimalType(4, 2)])
+    assert (r.lo, r.hi) == (-3000, 3000)
+
+
+def test_transfer_div():
+    # double division: TOP with nan (inf/0-adjacent lanes)
+    r = transfer("div", DOUBLE, [av(1, 1), av(1, 1)], [DOUBLE, DOUBLE])
+    assert (r.lo, r.hi) == (-INF, INF) and r.may_nan
+    # integer division: iv_div, and may_null (zero-divisor guard)
+    r = transfer("div", BIGINT, [av(100, 100), av(3, 5)], [BIGINT, BIGINT])
+    assert (r.lo, r.hi) == (20, 33)
+    assert r.may_null
+
+
+def test_transfer_cast_bigint_half_up_slack():
+    # short-decimal -> bigint rounds HALF_UP: ±1 slack on the truncated
+    # interval keeps the rule sound for the round-away-from-zero lane
+    r = transfer("cast_bigint", BIGINT, [av(-25, 25)], [DecimalType(10, 1)])
+    assert (r.lo, r.hi) == (-3, 3)
+    assert r.known
+    # parse casts (string source) are bounded by the target width only
+    # and may NULL on unparseable input (documented deviation)
+    r = transfer("cast_bigint", BIGINT, [av(0, 5)], [VARCHAR])
+    assert (r.lo, r.hi) == I64 and r.may_null and not r.known
+
+
+def test_transfer_cast_decimal_rescale():
+    r = transfer("cast_decimal", DecimalType(10, 3), [av(-7, 7)],
+                 [DecimalType(10, 1)])
+    assert (r.lo, r.hi) == (-700, 700) and r.known
+
+
+def test_transfer_cast_double_unscales():
+    r = transfer("cast_double", DOUBLE, [av(-250, 250)], [DecimalType(10, 2)])
+    assert (r.lo, r.hi) == (-2.5, 2.5)
+    r = transfer("cast_real", REAL, [av(1, 1)], [BIGINT])
+    assert r.may_nan and (r.lo, r.hi) == (-INF, INF)
+
+
+def test_transfer_dateparts_exact_and_known():
+    # calendar-field ranges are exact by construction of the kernels —
+    # the one family where the contract itself is evidence
+    r = transfer("month", BIGINT, [top(DATE)], [DATE])
+    assert (r.lo, r.hi) == (1, 12) and r.known
+    assert transfer("day_of_week", BIGINT, [top(DATE)], [DATE]).hi == 7
+    # calendar MOVES are data-dependent: contract only
+    r = transfer("date_add_days", DATE, [av(0, 10), top(DATE)],
+                 [BIGINT, DATE])
+    assert not r.known and (r.lo, r.hi) == I32
+
+
+def test_transfer_sign_round_family():
+    assert (lambda r: (r.lo, r.hi, r.known))(
+        transfer("sign", BIGINT, [av(-9, 9)], [BIGINT])) == (-1, 1, True)
+    # decimal round family rescales with ±1 rounding slack
+    r = transfer("round", BIGINT, [av(-149, 149)], [DecimalType(5, 2)])
+    assert (r.lo, r.hi) == (-2, 2)
+
+
+def test_transfer_greatest_least_strict():
+    g = transfer("greatest", BIGINT, [av(0, 5), av(3, 9, may_null=True)],
+                 [BIGINT, BIGINT])
+    assert (g.lo, g.hi) == (3, 9)
+    assert g.may_null  # NULL if ANY argument is NULL (kernel parity)
+    l = transfer("least", BIGINT, [av(0, 5), av(3, 9)], [BIGINT, BIGINT])
+    assert (l.lo, l.hi) == (0, 5)
+
+
+def test_transfer_coalesce_if_nullif():
+    c = transfer("coalesce", BIGINT,
+                 [av(0, 5, may_null=True), av(10, 20)], [BIGINT, BIGINT])
+    assert (c.lo, c.hi) == (0, 20)
+    assert not c.may_null  # a non-null fallback resolves the chain
+    # IF without ELSE can yield NULL even over non-null branches
+    i = transfer("if", BIGINT, [av(0, 1), av(5, 5)], [BOOLEAN, BIGINT])
+    assert i.may_null and (i.lo, i.hi) == (5, 5)
+    n = transfer("nullif", BIGINT, [av(5, 5), av(5, 5)], [BIGINT, BIGINT])
+    assert n.may_null and (n.lo, n.hi) == (5, 5)
+
+
+def test_transfer_length_family_and_bitwise():
+    r = transfer("bit_count", BIGINT, [top(BIGINT)], [BIGINT])
+    assert (r.lo, r.hi) == (0, 64)
+    r = transfer("from_base", BIGINT, [top(VARCHAR), av(16, 16)],
+                 [VARCHAR, BIGINT])
+    assert (r.lo, r.hi) == I64 and r.may_null  # parse failures NULL
+    r = transfer("bitwise_xor", BIGINT, [av(0, 1), av(0, 1)],
+                 [BIGINT, BIGINT])
+    assert (r.lo, r.hi) == I64  # bit ops roam the whole lane
+
+
+def test_transfer_default_is_type_contract():
+    # any unmodeled scalar kernel falls back to the output contract
+    r = transfer("upper", VARCHAR, [top(VARCHAR)], [VARCHAR])
+    assert (r.lo, r.hi) == (0, INF) and not r.known and r.may_null
+
+
+def test_null_effect_classes():
+    assert null_effect("add") == "generating"       # overflow -> NULL
+    assert null_effect("div") == "generating"       # zero divisor
+    assert null_effect("cast_tinyint") == "generating"
+    assert null_effect("coalesce") == "preserving"
+    assert null_effect("between") == "preserving"   # and(ge, le) 3VL
+    assert null_effect("eq") == "strict"
+    assert null_effect("upper") == "strict"
+
+
+# ---------------------------------------------------------------------------
+# eval_expr: clamping + hazard reporting
+# ---------------------------------------------------------------------------
+
+def _hazards_of(e, env=()):
+    got = []
+
+    def on_hazard(kind, expr, raw, bounds, known):
+        got.append((kind, expr.fn, raw, bounds, known))
+
+    v = eval_expr(e, list(env), on_hazard)
+    return v, got
+
+
+def test_eval_literal_add_overflow_hazard_and_clamp():
+    e = Call(type=BIGINT, fn="add",
+             args=(Literal(type=BIGINT, value=I64[1]),
+                   Literal(type=BIGINT, value=1)))
+    v, hazards = _hazards_of(e)
+    assert hazards and hazards[0][0] == "overflow"
+    assert hazards[0][4] is True  # literal evidence: error-grade
+    # the returned value is clamped to the lane (escaped lanes NULL)
+    assert v.hi == I64[1] and v.may_null
+
+
+def test_eval_contract_overflow_not_known():
+    # type-contract-only escape: hazard fires with known=False (the
+    # checker downgrades / ignores it — every int64 add "may" overflow)
+    e = Call(type=BIGINT, fn="add",
+             args=(ColumnRef(type=BIGINT, index=0),
+                   ColumnRef(type=BIGINT, index=1)))
+    _, hazards = _hazards_of(e, env=[top(BIGINT), top(BIGINT)])
+    assert hazards and hazards[0][0] == "overflow" and hazards[0][4] is False
+
+
+def test_eval_division_hazard_point_zero_vs_straddle():
+    zero = Call(type=BIGINT, fn="div",
+                args=(Literal(type=BIGINT, value=10),
+                      Literal(type=BIGINT, value=0)))
+    _, hazards = _hazards_of(zero)
+    assert hazards == [("division", "div", (0, 0), (0, 0), True)]
+    # a divisor that merely CAN be zero is a possibility, not evidence
+    straddle = Call(type=BIGINT, fn="div",
+                    args=(Literal(type=BIGINT, value=10),
+                          ColumnRef(type=BIGINT, index=0)))
+    _, hazards = _hazards_of(
+        straddle, env=[av(-5, 5, may_null=True)])
+    assert hazards and hazards[0][0] == "division" and hazards[0][4] is False
+
+
+def test_eval_lossy_cast_hazard():
+    e = Call(type=SMALLINT, fn="cast_smallint",
+             args=(Literal(type=BIGINT, value=40_000),))
+    v, hazards = _hazards_of(e)
+    assert hazards and hazards[0][0] == "lossy-cast"
+    assert hazards[0][3] == I16 and hazards[0][4] is True
+    assert v.may_null  # out-of-range lanes NULL at runtime
+
+
+def test_eval_in_range_expressions_are_silent():
+    e = Call(type=BIGINT, fn="add",
+             args=(Literal(type=BIGINT, value=3),
+                   Literal(type=BIGINT, value=4)))
+    v, hazards = _hazards_of(e)
+    assert hazards == []
+    assert (v.lo, v.hi) == (7, 7) and not v.may_null and v.known
+
+
+def test_eval_columnref_out_of_bounds_is_top():
+    v = eval_expr(ColumnRef(type=BIGINT, index=99), [])
+    assert not v.known and (v.lo, v.hi) == I64
+
+
+def test_channel_value_of_channel_domain_is_evidence():
+    from types import SimpleNamespace
+
+    ch = SimpleNamespace(type=BIGINT, domain=(0, 100))
+    v = ranges.channel_value_of_channel(ch)
+    assert v.known and (v.lo, v.hi) == (0, 100)
+    bare = SimpleNamespace(type=BIGINT, domain=None)
+    assert not ranges.channel_value_of_channel(bare).known

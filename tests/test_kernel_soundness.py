@@ -1,0 +1,251 @@
+"""Kernel-soundness checker (presto_tpu/analysis/kernel_soundness.py).
+
+Same two-halves contract as test_plan_validator: the TPC-H and TPC-DS
+corpora must analyze CLEAN (no error-severity finding on any of the
+121 queries — the gate the conftest arms suite-wide), and seeded-bug
+fixtures must each be CAUGHT by their named checker with node-level
+attribution — overflow (expression and accumulator), division,
+lossy-cast, null-policy, and the runtime range sanitizer catching a
+deliberately under-approximating transfer function.
+"""
+
+import os
+
+import pytest
+
+from presto_tpu.analysis import (
+    KernelSoundnessError,
+    analyze_kernels,
+    assert_kernel_sound,
+    kernel_validation_enabled,
+    set_kernel_validation,
+    set_range_sanitizer,
+)
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.obs import METRICS
+from presto_tpu.runner import QueryRunner
+from tests.tpch_queries import QUERIES
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.01))
+    return QueryRunner(catalog)
+
+
+def _plan_ungated(runner, sql):
+    """Bind ``sql`` with the kernel gate forced off — seeded-bug tests
+    need the broken plan OBJECT to hand to the analyzer directly."""
+    set_kernel_validation(False)
+    try:
+        return runner.binder.plan(sql)
+    finally:
+        set_kernel_validation(None)
+
+
+# a projection the reference's checked bytecode would raise
+# ARITHMETIC_OVERFLOW on: 4e18 * 3 escapes the int64 lane, and the
+# VALUES row makes the interval evidence-backed (known), i.e. an error
+_MUL_OVERFLOW_SQL = \
+    "select x * 3 from (values (4000000000000000000)) t(x)"
+
+
+# ---------------------------------------------------------------------------
+# clean corpus
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpch_corpus_analyzes_clean(runner, qid):
+    plan = runner.binder.plan(QUERIES[qid])
+    errs = [i for i in analyze_kernels(plan) if i.severity == "error"]
+    assert not errs, f"tpch q{qid}: {errs}"
+
+
+def test_tpcds_corpus_analyzes_clean():
+    from presto_tpu.connectors.tpcds import Tpcds
+    from tests.tpcds_queries import QUERIES as DS
+
+    # cd/inventory truncated: both are sf-independent cross products
+    catalog = Catalog()
+    catalog.register("tpcds", Tpcds(sf=0.01, split_rows=16384,
+                                    cd_rows=2 * 5 * 7 * 20, inv_rows=60000))
+    r = QueryRunner(catalog)
+    bad = {}
+    for qid in sorted(DS):
+        plan = r.binder.plan(DS[qid])
+        errs = [i for i in analyze_kernels(plan) if i.severity == "error"]
+        if errs:
+            bad[qid] = errs
+    assert not bad, f"TPC-DS queries with kernel-soundness errors: {bad}"
+
+
+def test_explain_validate_runs_kernel_tier(runner):
+    res = runner.execute(
+        "EXPLAIN (TYPE VALIDATE) SELECT sum(l_quantity) FROM lineitem")
+    assert res.rows[0][0] is True
+    # ... and actually distinguishes: the same surface rejects a plan
+    # with a proven int64 escape
+    with pytest.raises(KernelSoundnessError, match="overflow"):
+        runner.execute(f"EXPLAIN (TYPE VALIDATE) {_MUL_OVERFLOW_SQL}")
+
+
+# ---------------------------------------------------------------------------
+# gating wiring
+# ---------------------------------------------------------------------------
+
+def test_env_gate_armed_suite_wide():
+    # conftest sets PRESTO_TPU_VALIDATE_KERNELS=1 for the whole suite:
+    # every executed query in every test runs under the checker
+    assert os.environ.get("PRESTO_TPU_VALIDATE_KERNELS") == "1"
+    assert kernel_validation_enabled() is True
+
+
+def test_set_kernel_validation_override(runner):
+    # gate off: the unsound query PLANS (the analyzer still reports)
+    plan = _plan_ungated(runner, _MUL_OVERFLOW_SQL)
+    errs = [i for i in analyze_kernels(plan) if i.severity == "error"]
+    assert errs and errs[0].rule == "overflow"
+    # gate on (default here, via the env var): the same query refuses
+    with pytest.raises(KernelSoundnessError):
+        runner.binder.plan(_MUL_OVERFLOW_SQL)
+
+
+def test_validate_kernels_session_property(runner):
+    set_kernel_validation(False)  # isolate the property from the env
+    try:
+        runner.execute("SET SESSION validate_kernels = true")
+        try:
+            res = runner.execute("SELECT count(*) FROM region")
+            assert res.rows == [(5,)]
+            with pytest.raises(KernelSoundnessError):
+                runner.execute(_MUL_OVERFLOW_SQL)
+        finally:
+            runner.execute("RESET SESSION validate_kernels")
+    finally:
+        set_kernel_validation(None)
+
+
+def test_query_validate_kernels_config_key():
+    from presto_tpu.config import EngineConfig
+
+    cfg = EngineConfig(props={"query.validate-kernels": "true"})
+    assert cfg.build_session().get("validate_kernels") is True
+    assert EngineConfig().build_session().get("validate_kernels") is False
+
+
+# ---------------------------------------------------------------------------
+# seeded bugs: each checker must catch its class, naming the node
+# ---------------------------------------------------------------------------
+
+def test_seeded_expression_overflow_caught(runner):
+    with pytest.raises(KernelSoundnessError, match="overflow") as ei:
+        runner.execute(_MUL_OVERFLOW_SQL)
+    assert "ProjectNode" in str(ei.value)
+    assert "ARITHMETIC_OVERFLOW" in str(ei.value)
+
+
+def test_seeded_accumulator_overflow_caught(runner):
+    # three evidence-backed 4e18 addends: the int64 sum state can reach
+    # 1.2e19 > 2^63 — the silent-wrap class the accumulator rule owns
+    sql = ("select sum(x) from (values (4000000000000000000), "
+           "(4000000000000000000), (4000000000000000000)) t(x)")
+    with pytest.raises(KernelSoundnessError, match="accumulates") as ei:
+        runner.execute(sql)
+    assert "AggregationNode" in str(ei.value)
+
+
+def test_seeded_division_by_zero_caught(runner):
+    with pytest.raises(KernelSoundnessError, match="division") as ei:
+        runner.execute("select x / 0 from (values (1)) t(x)")
+    assert "DIVISION_BY_ZERO" in str(ei.value)
+    # a divisor that merely MIGHT be zero is a warning, not an error
+    plan = _plan_ungated(
+        runner, "select 10 / x from (values (-1), (1)) t(x)")
+    issues = [i for i in analyze_kernels(plan) if i.rule == "division"]
+    assert issues and all(i.severity == "warning" for i in issues)
+
+
+def test_seeded_lossy_cast_caught(runner):
+    with pytest.raises(KernelSoundnessError, match="lossy-cast") as ei:
+        runner.execute(
+            "select cast(x as smallint) from (values (40000)) t(x)")
+    assert "INVALID_CAST_ARGUMENT" in str(ei.value)
+
+
+def test_seeded_missing_null_policy_caught(runner, monkeypatch):
+    from presto_tpu.expr.compile import NULL_POLICY
+
+    plan = _plan_ungated(runner, "select x + 1 from (values (1)) t(x)")
+    assert not [i for i in analyze_kernels(plan) if i.severity == "error"]
+    monkeypatch.delitem(NULL_POLICY, "add")
+    errs = [i for i in analyze_kernels(plan) if i.rule == "null-policy"]
+    assert errs and "declares no null policy" in errs[0].message
+    assert "ProjectNode" in errs[0].node
+
+
+def test_seeded_null_policy_mismatch_caught(runner, monkeypatch):
+    from presto_tpu.expr.compile import NULL_POLICY
+
+    plan = _plan_ungated(runner, "select x + 1 from (values (1)) t(x)")
+    # declare 'add' strict: the kernel NULLs wrapped lanes, so the
+    # structural model derives 'generating' — a declaration the masks
+    # would not actually follow
+    monkeypatch.setitem(NULL_POLICY, "add", "strict")
+    errs = [i for i in analyze_kernels(plan) if i.rule == "null-policy"]
+    assert errs and "masks would not flow as declared" in errs[0].message
+
+
+def test_declared_policies_match_model_everywhere():
+    # the whole declared table agrees with the independent model — the
+    # repo-wide form of the two fixtures above
+    from presto_tpu.analysis.ranges import null_effect
+    from presto_tpu.expr.compile import NULL_POLICY
+
+    mismatches = {fn: (pol, null_effect(fn))
+                  for fn, pol in NULL_POLICY.items()
+                  if pol != null_effect(fn)}
+    assert mismatches == {}
+
+
+def test_counters_increment_on_findings(runner):
+    plan = _plan_ungated(runner, _MUL_OVERFLOW_SQL)
+    before = METRICS.counter("kernel.overflow_hazards").value
+    n = len([i for i in analyze_kernels(plan)
+             if i.rule in ("overflow", "lossy-cast", "division")])
+    assert n >= 1
+    assert METRICS.counter("kernel.overflow_hazards").value == before + n
+
+
+# ---------------------------------------------------------------------------
+# runtime range sanitizer (the checker's own checker)
+# ---------------------------------------------------------------------------
+
+def test_sanitizer_clean_on_healthy_query(runner):
+    set_range_sanitizer(True)
+    try:
+        before = METRICS.counter("kernel.sanitizer_escapes").value
+        res = runner.execute("select x + 1 from (values (5), (6)) t(x)")
+        assert sorted(r[0] for r in res.rows) == [6, 7]
+        assert METRICS.counter("kernel.sanitizer_escapes").value == before
+    finally:
+        set_range_sanitizer(None)
+
+
+def test_sanitizer_catches_under_approximating_transfer(runner, monkeypatch):
+    # seed the bug class the sanitizer exists for: make iv_add claim
+    # x + 1 stays in [0, 0]; the observed page values 6/7 must escape
+    # LOUDLY (counter + RuntimeError naming node/channel/intervals)
+    from presto_tpu.analysis import ranges
+
+    monkeypatch.setattr(ranges, "iv_add", lambda a, b: (0, 0))
+    set_range_sanitizer(True)
+    try:
+        before = METRICS.counter("kernel.sanitizer_escapes").value
+        with pytest.raises(RuntimeError, match="range sanitizer") as ei:
+            runner.execute("select x + 1 from (values (5), (6)) t(x)")
+        assert "predicted interval [0, 0]" in str(ei.value)
+        assert METRICS.counter("kernel.sanitizer_escapes").value == before + 1
+    finally:
+        set_range_sanitizer(None)

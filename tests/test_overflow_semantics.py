@@ -1,0 +1,187 @@
+"""Overflow semantics at the int64/decimal edges, oracle-validated
+against python ints / decimal.Decimal.
+
+The engine's documented deviation family: where the reference raises
+ARITHMETIC_OVERFLOW / INVALID_CAST_ARGUMENT, our jitted kernels cannot
+raise, so the offending lanes are NULLed (same family as div-by-zero)
+— and the static tier (analysis/kernel_soundness.py) proves where that
+can happen before execution.  These tests pin the RUNTIME half: the
+two's-complement wrap detectors in expr/compile.py (add/sub/mul/neg/
+abs and the decimal rescale guard), HALF_UP narrowing casts, and the
+decimal128-limb sum accumulators that keep wide folds exact where an
+int64 state would silently wrap.
+"""
+
+from decimal import ROUND_HALF_UP, Decimal
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.page import Page
+from presto_tpu.runner import QueryRunner
+from presto_tpu.types import BIGINT, DecimalType
+
+I64_MAX = (1 << 63) - 1
+I64_MIN = -(1 << 63)
+
+# int64 edge cases: the exact values whose neighborhoods wrap
+EDGE = [I64_MAX, I64_MIN, 0, 1, -1, 4 * 10 ** 18, -(4 * 10 ** 18)]
+
+# narrowing-cast probes around the int16/int8 ranges
+SMALL = [-40000, -32768, -200, -128, 0, 100, 127, 200, 32767, 40000]
+
+MAX38 = 10 ** 38 - 1
+
+# DECIMAL(18,0) rows whose sum reaches 1.8e19 > 2^63: exact only
+# because sum states for p>15 decimals run in decimal128 limbs
+WIDE = [9 * 10 ** 17] * 20 + [123456789, -987654321, 1]
+
+
+def _table(mem, name, typ, values):
+    ids = np.arange(len(values), dtype=np.int64)
+    page = Page.from_arrays([ids, values], [BIGINT, typ])
+    mem.create_table(name, [("id", BIGINT), ("x", typ)], [page])
+
+
+@pytest.fixture(scope="module")
+def runner():
+    mem = MemoryConnector()
+    _table(mem, "edge", BIGINT, EDGE)
+    _table(mem, "small", BIGINT, SMALL)
+    _table(mem, "d38", DecimalType(38, 0), [MAX38, -MAX38, 1, 0])
+    _table(mem, "wide", DecimalType(18, 0), WIDE)
+    # adversarial connector: a stored value EXCEEDING the declared
+    # DECIMAL(15,0) range — the case the rescale guard exists for
+    _table(mem, "decl", DecimalType(15, 0), [5 * 10 ** 17, 7])
+    catalog = Catalog()
+    catalog.register("mem", mem)
+    return QueryRunner(catalog)
+
+
+def _col(runner, sql):
+    """id-ordered single result column."""
+    return [r[1] for r in runner.execute(
+        f"select id, {sql} order by id").rows]
+
+
+# ---------------------------------------------------------------------------
+# int64 add/sub/mul/neg/abs wrap -> NULL (reference: ARITHMETIC_OVERFLOW)
+# ---------------------------------------------------------------------------
+
+def test_bigint_max_plus_one_is_null(runner):
+    got = _col(runner, "x + 1 from edge")
+    assert got == [None if v == I64_MAX else v + 1 for v in EDGE]
+
+
+def test_bigint_min_minus_one_is_null(runner):
+    got = _col(runner, "x - 1 from edge")
+    assert got == [None if v == I64_MIN else v - 1 for v in EDGE]
+
+
+def test_bigint_mul_wrap_is_null(runner):
+    got = _col(runner, "x * 3 from edge")
+    assert got == [v * 3 if I64_MIN <= v * 3 <= I64_MAX else None
+                   for v in EDGE]
+
+
+def test_bigint_neg_abs_of_min_is_null(runner):
+    # -(-2^63) and abs(-2^63) are unrepresentable: the one int64 value
+    # whose negation wraps onto itself
+    got = _col(runner, "-x from edge")
+    assert got == [None if v == I64_MIN else -v for v in EDGE]
+    got = _col(runner, "abs(x) from edge")
+    assert got == [None if v == I64_MIN else abs(v) for v in EDGE]
+
+
+def test_bigint_mul_minus_one_corner(runner):
+    # imin * -1 wraps even though the back-division check's own divide
+    # wraps there too — the corner pinned separately in _ovf_mul
+    got = _col(runner, "x * -1 from edge")
+    assert got == [None if v == I64_MIN else -v for v in EDGE]
+
+
+def test_bigint_div_min_by_minus_one_is_null_mod_is_zero(runner):
+    got = _col(runner, "x / -1 from edge")
+    assert got == [None if v == I64_MIN else -v for v in EDGE]
+    # imin % -1 == 0 exactly: representable, so NOT nulled
+    got = _col(runner, "x % -1 from edge")
+    assert got == [0] * len(EDGE)
+
+
+def test_in_range_arithmetic_untouched(runner):
+    # the guards must not null anything representable
+    got = _col(runner, "x + x from edge where id >= 2")
+    assert got == [v + v for v in EDGE[2:]]  # 8e18 still fits int64
+
+
+# ---------------------------------------------------------------------------
+# narrowing casts: out-of-range -> NULL, HALF_UP from decimals
+# ---------------------------------------------------------------------------
+
+def test_cast_out_of_range_smallint_tinyint_null(runner):
+    got = _col(runner, "cast(x as smallint) from small")
+    assert got == [v if -(1 << 15) <= v < (1 << 15) else None
+                   for v in SMALL]
+    got = _col(runner, "cast(x as tinyint) from small")
+    assert got == [v if -128 <= v <= 127 else None for v in SMALL]
+
+
+def test_cast_decimal_to_bigint_rounds_half_up(runner):
+    # reference DecimalCasts semantics: HALF_UP, away from zero at .5
+    rows = runner.execute(
+        "select x, cast(x as bigint) from (values (2.5), (2.4), (-2.5),"
+        " (-2.4), (-2.6), (0.5), (-0.5)) t(x)").rows
+    got = {str(x): v for x, v in rows}
+    assert got == {"2.5": 3, "2.4": 2, "-2.5": -3, "-2.4": -2,
+                   "-2.6": -3, "0.5": 1, "-0.5": -1}
+
+
+# ---------------------------------------------------------------------------
+# decimal p38 edges + limb-exact accumulators
+# ---------------------------------------------------------------------------
+
+def test_decimal38_edge_roundtrip_and_steps(runner):
+    got = _col(runner, "x from d38")
+    assert got == [Decimal(MAX38), Decimal(-MAX38), Decimal(1), Decimal(0)]
+    # one step inside the edge, exactly (no float path anywhere)
+    assert runner.execute(
+        "select x - 1 from d38 where id = 0").rows == [(Decimal(MAX38 - 1),)]
+    assert runner.execute(
+        "select x + 1 from d38 where id = 1").rows == [(Decimal(-MAX38 + 1),)]
+    got = runner.execute("select min(x), max(x) from d38").rows[0]
+    assert got == (Decimal(-MAX38), Decimal(MAX38))
+
+
+def test_engineered_sum_exact_past_int64(runner):
+    exact = sum(WIDE)
+    assert exact > I64_MAX  # an int64 accumulator would wrap silently
+    got = runner.execute("select sum(x) from wide").rows[0][0]
+    assert got == Decimal(exact)
+
+
+def test_engineered_sum_grouped_and_filtered(runner):
+    got = dict(runner.execute(
+        "select mod(id, 3), sum(x) from wide group by mod(id, 3)").rows)
+    for k in range(3):
+        exact = sum(v for i, v in enumerate(WIDE) if i % 3 == k)
+        assert got[k] == Decimal(exact), k
+    got = runner.execute(
+        "select sum(case when x > 0 then x end) from wide").rows[0][0]
+    assert got == Decimal(sum(v for v in WIDE if v > 0))
+
+
+def test_engineered_avg_half_up(runner):
+    got = runner.execute("select avg(x) from wide").rows[0][0]
+    exact = (Decimal(sum(WIDE)) / len(WIDE)).quantize(
+        Decimal(1), rounding=ROUND_HALF_UP)
+    assert got == exact
+
+
+def test_rescale_guard_nulls_out_of_contract_values(runner):
+    # x declared DECIMAL(15,0) but the connector stored 5e17: the ×100
+    # rescale for a scale-2 add would wrap int64 — guard nulls the lane
+    # instead of producing garbage; the in-contract row stays exact
+    got = _col(runner, "x + 0.01 from decl")
+    assert got == [None, Decimal("7.01")]

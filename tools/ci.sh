@@ -37,6 +37,37 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_plan_validator.py -q \
 echo "== corpus plan-diff (golden fingerprints) ==================="
 env JAX_PLATFORMS=cpu python tools/plan_diff.py --check
 
+echo "== kernel-soundness corpus =================================="
+# the expression-tier abstract interpreter over every TPC-H + TPC-DS
+# plan (overflow / lossy-cast / division / accumulator / null-policy),
+# plus the seeded-bug fixtures that prove each checker still catches
+# its bug class (tests/test_kernel_soundness.py)
+env JAX_PLATFORMS=cpu python -m pytest tests/test_kernel_soundness.py \
+    tests/test_kernel_ranges.py -q -p no:cacheprovider
+
+echo "== range-sanitizer smoke (TPC-H q1/q6) ======================"
+# runtime cross-check of the analyzer's predicted intervals: observed
+# page min/max outside a predicted interval means a transfer function
+# under-approximates — that fails LOUDLY here, not silently in prod
+env JAX_PLATFORMS=cpu PRESTO_TPU_RANGE_SANITIZER=1 python - <<'EOF'
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.obs import METRICS
+from presto_tpu.runner import QueryRunner
+import sys, os
+sys.path.insert(0, "tests")
+from tpch_queries import QUERIES
+
+catalog = Catalog()
+catalog.register("tpch", Tpch(sf=0.01))
+runner = QueryRunner(catalog)
+for qid in (1, 6):
+    res = runner.execute(QUERIES[qid])
+    print(f"q{qid}: {len(res.rows)} rows, sanitizer clean")
+escapes = METRICS.counter("kernel.sanitizer_escapes").value
+assert escapes == 0, f"{escapes} interval escapes"
+EOF
+
 echo "== concurrent split-scheduler leg ==========================="
 # a fast tier-1 subset under PRESTO_TPU_TASK_CONCURRENCY=4: the morsel
 # scheduler's threaded path (scan chains, spill/memory interaction,

@@ -84,6 +84,17 @@ rule-purity         An optimizer ``Rule.apply`` body that mutates its
                     Locals built fresh (``list(node.projections)``,
                     ``dataclasses.replace``) are exempt — taint follows
                     aliases of the input only.
+narrow-cast         A literal narrow integer width (``jnp.int32``/
+                    ``np.int16``/``"int8"``, via ``astype`` or a
+                    ``dtype=`` keyword) in kernel code (``ops/``,
+                    ``expr/``).  Int64-lane column values silently
+                    truncate through such casts — the overflow class
+                    the kernel-soundness analyzer
+                    (analysis/kernel_soundness.py) proves absent.
+                    Lane widths must come from the declared type map
+                    (``Type.np_dtype``); a proven-safe narrow (bounded
+                    codes, counts, field ranges) carries
+                    ``# lint: allow(narrow-cast)``.
 
 Concurrency check
 -----------------
@@ -286,6 +297,22 @@ def _contains_call_to(node: ast.AST, names: Set[str]) -> bool:
     return False
 
 
+_NARROW_INTS = {"int8", "int16", "int32"}
+
+
+def _narrow_dtype_name(node: ast.AST) -> Optional[str]:
+    """``jnp.int32`` / ``np.int16`` / ``"int8"`` — a literal narrow
+    integer width.  Widths routed through the type map (``t.np_dtype``,
+    ``block.data.dtype``) resolve dynamically and are exempt."""
+    if isinstance(node, ast.Attribute) and node.attr in _NARROW_INTS \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id in ("jnp", "np", "jax", "numpy"):
+        return node.attr
+    if isinstance(node, ast.Constant) and node.value in _NARROW_INTS:
+        return node.value
+    return None
+
+
 def _call_name(call: ast.Call) -> Optional[str]:
     fn = call.func
     if isinstance(fn, ast.Name):
@@ -312,6 +339,11 @@ class _Linter(ast.NodeVisitor):
         self._is_operator_code = any(
             f"{os.sep}{d}{os.sep}" in path
             for d in ("ops", "connectors", "storage"))
+        # the narrow-cast rule covers KERNEL code: the expression
+        # compiler and the vectorized operators, where a literal narrow
+        # width truncates column lanes
+        self._is_kernel_code = any(
+            f"{os.sep}{d}{os.sep}" in path for d in ("ops", "expr"))
         # names the time MODULE is bound to in this file (import time /
         # import time as _time, at any scope) — the wallclock rule must
         # not fire on unrelated .time() methods
@@ -439,6 +471,31 @@ class _Linter(ast.NodeVisitor):
                     "thread forever on a wedged peer — pass a bounded "
                     "timeout (or use presto_tpu.net.request_json/"
                     "request_bytes)")
+
+        # narrow-cast --------------------------------------------------------
+        # kernel code (ops/, expr/) narrowing lanes to a literal int8/
+        # int16/int32 width: silent truncation of int64-lane values (the
+        # overflow class analysis/kernel_soundness.py proves absent).
+        # Widths must come from the declared type map (Type.np_dtype) or
+        # carry `# lint: allow(narrow-cast)` with the reason nearby.
+        if self._is_kernel_code:
+            narrow = None
+            if name == "astype" and node.args:
+                narrow = _narrow_dtype_name(node.args[0])
+            elif name in ("asarray", "array", "full_like", "zeros_like",
+                          "ones_like"):
+                # conversions of EXISTING values; fresh constructions
+                # (arange/zeros/ones) narrow nothing and are exempt
+                for k in node.keywords:
+                    if k.arg == "dtype":
+                        narrow = _narrow_dtype_name(k.value)
+            if narrow is not None:
+                self._emit(
+                    node, "narrow-cast",
+                    f"literal {narrow} narrowing in kernel code — derive "
+                    "the lane width from the declared type map "
+                    "(Type.np_dtype), or mark a proven-safe narrow with "
+                    "`# lint: allow(narrow-cast)`")
 
         # block-until-ready --------------------------------------------------
         if name == "block_until_ready" and self._is_operator_code:
@@ -690,7 +747,7 @@ class _Linter(ast.NodeVisitor):
 ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
              "block-until-ready", "bare-except", "spi-exception",
              "wallclock", "metric-catalog", "thread-pool",
-             "naked-urlopen", "rule-purity"}
+             "naked-urlopen", "rule-purity", "narrow-cast"}
 
 #: the concurrency sanitizer's detector names (the second check); kept
 #: in sync with analysis/concurrency.CONCURRENCY_RULES by the tests
