@@ -136,3 +136,22 @@ from presto_tpu.analysis.kernel_soundness import (  # noqa: E402,F401
     assert_kernel_sound,
 )
 from presto_tpu.analysis.ranges import AbstractValue  # noqa: E402,F401
+
+# protocol soundness tier (analysis/protocols.py + analysis/mcheck.py):
+# spec automata + runtime conformance recorder
+# (PRESTO_TPU_PROTOCOL_TRACE env) and the bounded schedule explorer
+from presto_tpu.analysis.protocols import (  # noqa: E402,F401
+    RECORDER,
+    ProtocolEvent,
+    Violation,
+    check_trace,
+    protocol_trace_enabled,
+    set_protocol_trace,
+)
+from presto_tpu.analysis.mcheck import (  # noqa: E402,F401
+    Counterexample,
+    ExploreResult,
+    explore,
+    explore_all,
+    replay,
+)

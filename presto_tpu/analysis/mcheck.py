@@ -1,0 +1,704 @@
+"""Bounded schedule explorer over the protocol models.
+
+The model-checking half of the protocol soundness tier
+(analysis/protocols.py holds the spec automata + runtime recorder).
+Each :class:`Model` is a small-scope abstraction of one real protocol
+— the streaming exchange (server/buffers.py + shuffle_client.py), the
+failure detector (parallel/failure.py), fragment retry
+(parallel/multihost.py), admission (serving/admission.py) — whose
+``apply`` checks the named invariants from the shared catalog inline.
+:func:`explore` enumerates every interleaving of enabled protocol
+actions to a bounded depth, with visited-state dedup plus DPOR-style
+sleep sets (Flanagan & Godefroid): when two enabled actions provably
+commute *at this state* (applying them in either order reaches the
+same abstract state with the same violations), only one order is
+explored.  Commutativity is decided semantically and memoized, not
+assumed from an independence relation — slower, but sound by
+construction for these tiny state spaces.
+
+Counterexamples are replayable: a :class:`Counterexample` carries the
+exact action trace, :func:`replay` re-runs it deterministically, and
+the regression tests pin the traces the explorer found against the
+pre-fix implementation semantics (the ``bugs`` flags below reproduce
+each fixed bug in the model so its counterexample stays checkable).
+
+Small-scope sizing: 2-3 pages, 2 fragments, 2 workers, 2-3 queries.
+Every interleaving bug this tier targets (duplicate delivery,
+ack regression, replay past ack, abort-after-drain, eager re-admit,
+budget overspend, off-by-one watermark, headroom race, slot leak,
+cancel/admit race) manifests within these bounds — the point of
+small-scope model checking is that protocol bugs don't need big
+instances, they need the *right interleaving*.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, FrozenSet, List, NamedTuple, Optional, Tuple
+
+from presto_tpu.analysis.protocols import (
+    INV_ABORT_DRAINED,
+    INV_ACK_MONOTONIC,
+    INV_ADM_CANCEL,
+    INV_ADM_HEADROOM,
+    INV_ADM_LIFECYCLE,
+    INV_ADM_SLOTS,
+    INV_AT_MOST_ONCE,
+    INV_DET_EDGE,
+    INV_DET_NO_DEAD_SCHEDULE,
+    INV_DET_RECOVER_GATE,
+    INV_NO_REPLAY_PAST_ACK,
+    INV_REPLAY_PREFIX,
+    INV_RETRY_BUDGET,
+    INV_RETRY_LOCAL,
+    INV_RETRY_PREFIX,
+)
+
+Action = Tuple  # ("name", arg0, arg1, ...) — hashable, sortable
+Fault = Tuple[str, str]  # (invariant name, message)
+
+
+class Model:
+    """A protocol as a small labeled transition system.
+
+    Subclasses define ``initial()``, ``actions(state)`` (enabled
+    actions, deterministic order), and ``apply(state, action)`` →
+    ``(new_state, faults)`` where faults are ``(invariant, message)``
+    pairs for every named invariant the step violates.  States and
+    actions must be hashable; ``apply`` must be pure (the explorer
+    replays it freely).  ``bugs`` switches on seeded mutations that
+    reproduce real (fixed) implementation bugs for the mutation tests.
+    """
+
+    name = "model"
+
+    def __init__(self, bugs: FrozenSet[str] = frozenset()):
+        self.bugs = frozenset(bugs)
+
+    def initial(self):
+        raise NotImplementedError
+
+    def actions(self, state) -> List[Action]:
+        raise NotImplementedError
+
+    def apply(self, state, action) -> Tuple[object, List[Fault]]:
+        raise NotImplementedError
+
+    def key(self, state):
+        return state
+
+
+class Counterexample(NamedTuple):
+    model: str
+    trace: Tuple[Action, ...]   # replay(model, trace) reproduces it
+    faults: Tuple[Fault, ...]
+    seed: int
+
+    def __str__(self) -> str:
+        steps = " ; ".join("(" + ",".join(map(str, a)) + ")"
+                           for a in self.trace)
+        why = "; ".join(f"[{i}] {m}" for i, m in self.faults)
+        return f"{self.model} seed={self.seed}: {steps} => {why}"
+
+
+class ExploreResult(NamedTuple):
+    model: str
+    states: int                 # distinct abstract states visited
+    transitions: int            # apply() steps taken on the main walk
+    max_depth: int
+    seed: int
+    hit_state_cap: bool
+    counterexamples: Tuple[Counterexample, ...]
+
+    @property
+    def ok(self) -> bool:
+        return not self.counterexamples
+
+
+def replay(model: Model, trace) -> List[Fault]:
+    """Deterministically re-run a counterexample trace; returns every
+    fault the trace trips (empty ⇒ the model no longer exhibits it)."""
+    state = model.initial()
+    faults: List[Fault] = []
+    for action in trace:
+        state, f = model.apply(state, action)
+        faults.extend(f)
+    return faults
+
+
+def explore(model: Model, max_depth: int = 14, seed: int = 0,
+            max_states: int = 200_000,
+            stop_at_first: bool = False) -> ExploreResult:
+    """Bounded DFS over every interleaving of enabled actions.
+
+    Dedups on ``model.key(state)`` (re-entering a visited abstract
+    state explores nothing new — ``apply`` is pure) and prunes with
+    sleep sets: having explored action ``a`` from a state, ``a`` is
+    put to sleep for the sibling subtrees of every action that
+    commutes with it there, so commuting schedules are enumerated
+    once.  ``seed`` shuffles action order deterministically — runs
+    with different seeds walk different schedule orders first, which
+    is what makes ``stop_at_first`` counterexamples varied yet
+    replayable (the trace + seed fully determine the run).
+    """
+    rng = random.Random(seed)
+    visited: Dict[object, int] = {}   # abstract state -> min depth seen
+    commute_cache: Dict[Tuple, bool] = {}
+    counterexamples: List[Counterexample] = []
+    transitions = 0
+    hit_cap = False
+
+    def commutes(state, skey, a, b) -> bool:
+        ck = (skey, a, b) if a <= b else (skey, b, a)
+        hit = commute_cache.get(ck)
+        if hit is not None:
+            return hit
+        try:
+            s_ab, f1 = model.apply(state, a)
+            s_ab, f2 = model.apply(s_ab, b)
+            s_ba, f3 = model.apply(state, b)
+            s_ba, f4 = model.apply(s_ba, a)
+        except Exception:
+            commute_cache[ck] = False
+            return False
+        ok = (model.key(s_ab) == model.key(s_ba)
+              and sorted(f1 + f2) == sorted(f3 + f4))
+        commute_cache[ck] = ok
+        return ok
+
+    # stack entries: (state, depth, trace, sleep_set)
+    stack = [(model.initial(), 0, (), frozenset())]
+    while stack:
+        state, depth, trace, sleep = stack.pop()
+        skey = model.key(state)
+        prev = visited.get(skey)
+        if prev is not None and prev <= depth:
+            continue   # already explored from here with >= remaining depth
+        visited[skey] = depth
+        if len(visited) >= max_states:
+            hit_cap = True
+            break
+        if depth >= max_depth:
+            continue
+        enabled = [a for a in model.actions(state) if a not in sleep]
+        if seed:
+            rng.shuffle(enabled)
+        explored: List[Action] = []
+        for action in enabled:
+            new_state, faults = model.apply(state, action)
+            transitions += 1
+            new_trace = trace + (action,)
+            if faults:
+                counterexamples.append(Counterexample(
+                    model.name, new_trace, tuple(faults), seed))
+                if stop_at_first:
+                    return ExploreResult(
+                        model.name, len(visited), transitions, max_depth,
+                        seed, hit_cap, tuple(counterexamples))
+                continue  # don't explore past a violated state
+            # sleep-set: siblings already explored that commute with
+            # `action` here need not be re-ordered inside its subtree
+            child_sleep = frozenset(
+                x for x in explored if commutes(state, skey, x, action))
+            stack.append((new_state, depth + 1, new_trace, child_sleep))
+            explored.append(action)
+    return ExploreResult(model.name, len(visited), transitions, max_depth,
+                         seed, hit_cap, tuple(counterexamples))
+
+
+# ---------------------------------------------------------------------------
+# 1. streaming exchange: token / ack / abort + client pull
+# ---------------------------------------------------------------------------
+
+class _ExState(NamedTuple):
+    produced: int
+    complete: bool
+    aborted: bool
+    acked: int
+    ctoken: int                 # client's next-token cursor
+    next_deliver: int           # consumer's canonical next page seq
+    inflight: Tuple[Tuple[int, int, bool], ...]  # (token, next, done)
+    acks: Tuple[int, ...]       # ack messages in flight to the server
+    client_done: bool
+    dups_injected: int
+
+
+class ExchangeModel(Model):
+    """Token-acked exchange with an explicit in-flight network.
+
+    Responses sit in ``inflight`` until a ``recv`` consumes them — the
+    schedule chooses WHICH, so delayed/duplicated/reordered responses
+    are just interleavings.  Page batch size 1 keeps tokens == seqs.
+
+    Bug flags (each reproduces a fixed implementation bug):
+
+    - ``no_dedupe``   — client yields every page of every response
+      without the seq >= cursor check (shuffle_client.pull_pages
+      before this PR) → duplicate delivery under dup/reorder.
+    - ``ack_regress`` — server applies ``acked = token`` instead of
+      ``max(acked, token)`` → watermark regression when two in-flight
+      ack messages arrive at the server out of order.
+    - ``replay_past_ack`` — client may re-GET an already-acked token
+      (no KeyError guard) → replay below the watermark.
+    - ``abort_clears_drained`` — abort unconditionally clears state
+      (TaskOutputBuffer.abort before this PR) → the
+      abort-after-final-ack race retroactively fails a drained query.
+    """
+
+    name = "exchange"
+    MAX_PAGES = 3
+    MAX_INFLIGHT = 2
+    MAX_ACKS = 2
+
+    def __init__(self, bugs=frozenset(), faults: bool = True):
+        super().__init__(bugs)
+        self.faults = faults
+
+    def initial(self):
+        return _ExState(0, False, False, 0, 0, 0, (), (), False, 0)
+
+    def actions(self, s: _ExState) -> List[Action]:
+        out: List[Action] = []
+        if not s.aborted and not s.complete and s.produced < self.MAX_PAGES:
+            out.append(("enqueue",))
+        if not s.aborted and not s.complete:
+            out.append(("complete",))
+        if (not s.aborted and not s.client_done
+                and len(s.inflight) < self.MAX_INFLIGHT):
+            out.append(("request",))
+            if "replay_past_ack" in self.bugs and s.acked > 0:
+                out.append(("re_get_old",))
+        if self.faults and s.inflight and s.dups_injected < 1:
+            out.append(("dup_response", 0))
+        for i in range(len(s.inflight)):
+            out.append(("recv", i, True))
+            if self.faults:
+                out.append(("recv", i, False))   # ack lost en route
+        for i in range(len(s.acks)):
+            out.append(("ack_arrive", i))        # any arrival order
+        out.append(("abort",))
+        return out
+
+    def _serve(self, s: _ExState, token: int):
+        faults: List[Fault] = []
+        if token < s.acked:
+            faults.append((INV_NO_REPLAY_PAST_ACK,
+                           f"server served token {token} < acked {s.acked}"))
+        nxt = min(token + 1, s.produced) if token < s.produced else token
+        done = s.complete and nxt >= s.produced
+        return (token, nxt, done), faults
+
+    def apply(self, s: _ExState, action: Action):
+        kind = action[0]
+        faults: List[Fault] = []
+        if kind == "enqueue":
+            return s._replace(produced=s.produced + 1), faults
+        if kind == "complete":
+            return s._replace(complete=True), faults
+        if kind == "request":
+            resp, faults = self._serve(s, s.ctoken)
+            return s._replace(inflight=s.inflight + (resp,)), faults
+        if kind == "re_get_old":
+            resp, faults = self._serve(s, s.acked - 1)
+            return s._replace(inflight=s.inflight + (resp,)), faults
+        if kind == "dup_response":
+            resp = s.inflight[action[1]]
+            return s._replace(inflight=s.inflight + (resp,),
+                              dups_injected=s.dups_injected + 1), faults
+        if kind == "recv":
+            idx, ack_ok = action[1], action[2]
+            token, nxt, done = s.inflight[idx]
+            inflight = s.inflight[:idx] + s.inflight[idx + 1:]
+            next_deliver, ctoken = s.next_deliver, s.ctoken
+            for seq in range(token, nxt):
+                if "no_dedupe" not in self.bugs and seq < ctoken:
+                    continue        # client dedupe: stale page, drop
+                if seq < next_deliver:
+                    faults.append((INV_AT_MOST_ONCE,
+                                   f"page {seq} delivered twice"))
+                elif seq > next_deliver:
+                    faults.append((INV_REPLAY_PREFIX,
+                                   f"gap: delivered {seq}, expected "
+                                   f"{next_deliver}"))
+                next_deliver = max(next_deliver, seq + 1)
+            ctoken = max(ctoken, nxt)
+            acks = s.acks
+            if ack_ok and len(acks) < self.MAX_ACKS:
+                acks = acks + (ctoken,)   # ack rides the network too
+            return s._replace(inflight=inflight, ctoken=ctoken,
+                              next_deliver=next_deliver, acks=acks,
+                              client_done=s.client_done or done), faults
+        if kind == "ack_arrive":
+            idx = action[1]
+            token = s.acks[idx]
+            acks = s.acks[:idx] + s.acks[idx + 1:]
+            if "ack_regress" in self.bugs:
+                if token < s.acked:
+                    faults.append((INV_ACK_MONOTONIC,
+                                   f"acked regressed {s.acked} -> "
+                                   f"{token}"))
+                acked = token
+            else:
+                acked = max(s.acked, token)
+            return s._replace(acks=acks, acked=acked), faults
+        if kind == "abort":
+            drained = s.complete and s.acked >= s.produced
+            if "abort_clears_drained" in self.bugs:
+                changed = True       # legacy: abort always clears
+            else:
+                changed = not s.aborted and not drained
+            if changed and s.aborted:
+                faults.append((INV_ABORT_DRAINED,
+                               "second abort was not a no-op"))
+            if changed and drained:
+                faults.append((INV_ABORT_DRAINED,
+                               "abort of a drained stream cleared it"))
+            return s._replace(aborted=s.aborted or changed), faults
+        raise ValueError(f"unknown action {action!r}")
+
+
+# ---------------------------------------------------------------------------
+# 2. failure detector
+# ---------------------------------------------------------------------------
+
+_ALIVE, _SUSPECT, _DEAD, _RECOVERED = "ALIVE", "SUSPECT", "DEAD", "RECOVERED"
+
+
+class _DetState(NamedTuple):
+    state: str
+    cf: int
+    cs: int
+
+
+class DetectorModel(Model):
+    """One worker under the ALIVE/SUSPECT/DEAD/RECOVERED machine
+    (small thresholds: suspect_after=1, dead_after=2, recover_after=2
+    — the gates, not the exact production counts, are the invariant).
+
+    Bug flags: ``eager_readmit`` (DEAD -> RECOVERED on the first
+    success), ``skip_suspect`` (ALIVE -> DEAD without passing
+    SUSPECT), ``schedule_dead`` (fragments assignable to DEAD).
+    """
+
+    name = "detector"
+    SUSPECT_AFTER, DEAD_AFTER, RECOVER_AFTER = 1, 2, 2
+
+    def initial(self):
+        return _DetState(_ALIVE, 0, 0)
+
+    def actions(self, s: _DetState) -> List[Action]:
+        out: List[Action] = [("ok",), ("fail",)]
+        if s.state != _DEAD or "schedule_dead" in self.bugs:
+            out.append(("assign",))
+        return out
+
+    def apply(self, s: _DetState, action: Action):
+        kind = action[0]
+        faults: List[Fault] = []
+        if kind == "assign":
+            if s.state == _DEAD:
+                faults.append((INV_DET_NO_DEAD_SCHEDULE,
+                               "fragment assigned to a DEAD worker"))
+            return s, faults
+        if kind == "ok":
+            cf, cs = 0, s.cs + 1
+            new = s.state
+            if s.state == _DEAD:
+                gate = (1 if "eager_readmit" in self.bugs
+                        else self.RECOVER_AFTER)
+                if cs >= gate:
+                    new = _RECOVERED
+                    if cs < self.RECOVER_AFTER:
+                        faults.append((INV_DET_RECOVER_GATE,
+                                       f"re-admitted after {cs} successes"
+                                       f" (recover_after="
+                                       f"{self.RECOVER_AFTER})"))
+            elif s.state in (_SUSPECT, _RECOVERED):
+                new = _ALIVE
+            return _DetState(new, cf, cs), faults
+        if kind == "fail":
+            cf, cs = s.cf + 1, 0
+            new = s.state
+            if s.state in (_ALIVE, _RECOVERED):
+                if "skip_suspect" in self.bugs and cf >= self.SUSPECT_AFTER:
+                    new = _DEAD
+                    faults.append((INV_DET_EDGE,
+                                   f"illegal edge {s.state} -> DEAD "
+                                   "(must pass SUSPECT)"))
+                elif cf >= self.SUSPECT_AFTER:
+                    new = _SUSPECT
+            elif s.state == _SUSPECT and cf >= self.DEAD_AFTER:
+                new = _DEAD
+            return _DetState(new, cf, cs), faults
+        raise ValueError(f"unknown action {action!r}")
+
+
+# ---------------------------------------------------------------------------
+# 3. fragment retry with watermark replay
+# ---------------------------------------------------------------------------
+
+class _Frag(NamedTuple):
+    status: str          # "running" | "failed" | "done"
+    worker: int          # -1 = coordinator-local
+    consumer_next: int   # consumer watermark: next expected page seq
+    attempt_pos: int     # next seq the current attempt will emit
+
+
+class _RetryState(NamedTuple):
+    frags: Tuple[_Frag, ...]
+    alive: Tuple[bool, ...]
+    budget_used: int
+
+
+class RetryModel(Model):
+    """Two fragments on two workers, PAGES pages each, retry budget 1.
+    Fragments fail two ways: worker death (``die`` — fragments on the
+    worker fail and it leaves the survivor set) and transient stream
+    breaks (``break`` — the _StreamBroken path; the worker lives).
+
+    Bug flags: ``overspend`` (redispatch ignores the exhausted
+    budget), ``skip_off_by_one`` (replay skips delivered-1 pages, the
+    classic watermark off-by-one → one duplicate page), ``eager_local``
+    (coordinator-local fallback while survivors and budget remain).
+    """
+
+    name = "retry"
+    PAGES = 2
+    BUDGET = 1
+
+    def initial(self):
+        return _RetryState((_Frag("running", 0, 0, 0),
+                            _Frag("running", 1, 0, 0)),
+                           (True, True), 0)
+
+    def actions(self, s: _RetryState) -> List[Action]:
+        out: List[Action] = []
+        for i, f in enumerate(s.frags):
+            if f.status == "running" and (f.worker < 0 or s.alive[f.worker]):
+                out.append(("page", i))
+                out.append(("break", i))   # transient stream break
+            if f.status == "failed":
+                survivors = any(s.alive)
+                if survivors and (s.budget_used < self.BUDGET
+                                  or "overspend" in self.bugs):
+                    out.append(("redispatch", i))
+                if (not survivors or s.budget_used >= self.BUDGET
+                        or "eager_local" in self.bugs):
+                    out.append(("local", i))
+        for w, up in enumerate(s.alive):
+            if up:
+                out.append(("die", w))
+        return out
+
+    def _set(self, s: _RetryState, i: int, f: _Frag) -> _RetryState:
+        return s._replace(frags=s.frags[:i] + (f,) + s.frags[i + 1:])
+
+    def apply(self, s: _RetryState, action: Action):
+        kind = action[0]
+        faults: List[Fault] = []
+        if kind == "die":
+            w = action[1]
+            alive = tuple(up and i != w for i, up in enumerate(s.alive))
+            frags = tuple(
+                f._replace(status="failed") if (f.status == "running"
+                                                and f.worker == w) else f
+                for f in s.frags)
+            return s._replace(frags=frags, alive=alive), faults
+        i = action[1]
+        f = s.frags[i]
+        if kind == "break":
+            # stream broke (timeout, reset) but the worker lives on —
+            # the _StreamBroken path, distinct from worker death
+            return self._set(s, i, f._replace(status="failed")), faults
+        if kind == "page":
+            seq = f.attempt_pos
+            if seq < f.consumer_next:
+                faults.append((INV_RETRY_PREFIX,
+                               f"fragment {i} re-emitted page {seq} "
+                               f"(watermark {f.consumer_next})"))
+            elif seq > f.consumer_next:
+                faults.append((INV_RETRY_PREFIX,
+                               f"fragment {i} skipped to page {seq} "
+                               f"(watermark {f.consumer_next})"))
+            nxt = max(f.consumer_next, seq + 1)
+            done = nxt >= self.PAGES
+            return self._set(s, i, f._replace(
+                status="done" if done else f.status,
+                consumer_next=nxt, attempt_pos=f.attempt_pos + 1)), faults
+        if kind == "redispatch":
+            if s.budget_used >= self.BUDGET:
+                faults.append((INV_RETRY_BUDGET,
+                               f"retry {s.budget_used + 1} exceeds "
+                               f"budget {self.BUDGET}"))
+            skip = f.consumer_next
+            if "skip_off_by_one" in self.bugs:
+                skip = max(0, skip - 1)
+            target = next(w for w, up in enumerate(s.alive) if up)
+            return self._set(
+                s._replace(budget_used=s.budget_used + 1), i,
+                f._replace(status="running", worker=target,
+                           attempt_pos=skip)), faults
+        if kind == "local":
+            survivors = any(s.alive)
+            if survivors and s.budget_used < self.BUDGET:
+                faults.append((INV_RETRY_LOCAL,
+                               "local fallback with survivors and "
+                               f"budget left ({self.BUDGET - s.budget_used})"))
+            return self._set(s, i, f._replace(
+                status="done", worker=-1,
+                consumer_next=self.PAGES,
+                attempt_pos=self.PAGES)), faults
+        raise ValueError(f"unknown action {action!r}")
+
+
+# ---------------------------------------------------------------------------
+# 4. admission ticket lifecycle
+# ---------------------------------------------------------------------------
+
+class _Ticket(NamedTuple):
+    state: str           # "NONE" | "QUEUED" | "ADMITTED" | "DONE"
+    canceled: bool
+
+
+class _AdmState(NamedTuple):
+    tickets: Tuple[_Ticket, ...]
+    reserved: int        # committed pool bytes (abstract units)
+    inflight: int        # projected bytes of admitted-not-yet-reserved
+    issued: int
+    resolved: int
+
+
+class AdmissionModel(Model):
+    """Two queries, each needing NEED of CAP pool units (two can't
+    both fit), one admission slot semantics via the headroom check.
+
+    Bug flags: ``headroom_race`` (admit gate ignores
+    inflight-projected bytes — the double-admit race), ``slot_leak``
+    (finish forgets to mark the ticket resolved), ``admit_canceled``
+    (the cancel flag is not re-checked inside the admit critical
+    section).
+    """
+
+    name = "admission"
+    QUERIES = 2
+    CAP = 10
+    NEED = 6
+
+    def initial(self):
+        return _AdmState((_Ticket("NONE", False),) * self.QUERIES,
+                         0, 0, 0, 0)
+
+    def actions(self, s: _AdmState) -> List[Action]:
+        out: List[Action] = []
+        for q, t in enumerate(s.tickets):
+            if t.state == "NONE":
+                out.append(("submit", q))
+            if t.state == "QUEUED":
+                if not t.canceled or "admit_canceled" in self.bugs:
+                    gate = s.reserved + self.NEED <= self.CAP
+                    if "headroom_race" not in self.bugs:
+                        gate = (s.reserved + s.inflight + self.NEED
+                                <= self.CAP)
+                    idle = s.reserved <= 0 and s.inflight == 0
+                    if gate or idle:
+                        out.append(("admit", q))
+                out.append(("timeout", q))
+                if not t.canceled:
+                    out.append(("cancel", q))
+            if t.state == "ADMITTED":
+                out.append(("reserve", q))
+                out.append(("finish", q))
+        return out
+
+    def _set(self, s: _AdmState, q: int, t: _Ticket) -> _AdmState:
+        return s._replace(tickets=s.tickets[:q] + (t,) + s.tickets[q + 1:])
+
+    def _conserve(self, s: _AdmState, faults: List[Fault]) -> None:
+        running = sum(1 for t in s.tickets if t.state == "ADMITTED")
+        queued = sum(1 for t in s.tickets if t.state == "QUEUED")
+        if running + queued + s.resolved != s.issued:
+            faults.append((INV_ADM_SLOTS,
+                           f"running={running} queued={queued} "
+                           f"resolved={s.resolved} != issued={s.issued}"))
+
+    def apply(self, s: _AdmState, action: Action):
+        kind, q = action[0], action[1]
+        t = s.tickets[q]
+        faults: List[Fault] = []
+        if kind == "submit":
+            s = self._set(s._replace(issued=s.issued + 1), q,
+                          _Ticket("QUEUED", False))
+        elif kind == "cancel":
+            s = self._set(s, q, t._replace(canceled=True))
+        elif kind == "admit":
+            if t.state != "QUEUED":
+                faults.append((INV_ADM_LIFECYCLE,
+                               f"admit from {t.state}"))
+            if t.canceled:
+                faults.append((INV_ADM_CANCEL,
+                               f"query {q} admitted after cancel"))
+            idle = s.reserved <= 0 and s.inflight == 0
+            if (not idle
+                    and s.reserved + s.inflight + self.NEED > self.CAP):
+                faults.append((INV_ADM_HEADROOM,
+                               f"admitted with reserved={s.reserved} "
+                               f"inflight={s.inflight} need={self.NEED}"
+                               f" > cap={self.CAP}"))
+            s = self._set(s._replace(inflight=s.inflight + self.NEED),
+                          q, t._replace(state="ADMITTED"))
+        elif kind == "reserve":
+            s = s._replace(inflight=s.inflight - self.NEED,
+                           reserved=s.reserved + self.NEED)
+        elif kind == "timeout":
+            if t.state != "QUEUED":
+                faults.append((INV_ADM_LIFECYCLE,
+                               f"reject from {t.state}"))
+            s = self._set(s._replace(resolved=s.resolved + 1), q,
+                          t._replace(state="DONE"))
+        elif kind == "finish":
+            if t.state != "ADMITTED":
+                faults.append((INV_ADM_LIFECYCLE,
+                               f"release from {t.state} (release is "
+                               "exactly-once)"))
+            freed = s.reserved - self.NEED if s.reserved >= self.NEED \
+                else s.reserved
+            infl = s.inflight if s.reserved >= self.NEED \
+                else s.inflight - self.NEED
+            resolved = s.resolved
+            if "slot_leak" not in self.bugs:
+                resolved += 1
+            s = self._set(s._replace(reserved=freed, inflight=infl,
+                                     resolved=resolved), q,
+                          t._replace(state="DONE"))
+        else:
+            raise ValueError(f"unknown action {action!r}")
+        self._conserve(s, faults)
+        return s, faults
+
+
+#: the four protocols at their pinned exploration depths — what the
+#: ci.sh protocol leg and tools/protocol_check.py sweep
+PINNED_DEPTHS: Dict[str, int] = {
+    "exchange": 12,
+    "detector": 10,
+    "retry": 12,
+    "admission": 12,
+}
+
+MODELS = {
+    "exchange": ExchangeModel,
+    "detector": DetectorModel,
+    "retry": RetryModel,
+    "admission": AdmissionModel,
+}
+
+
+def explore_all(seed: int = 0,
+                depths: Optional[Dict[str, int]] = None
+                ) -> Dict[str, ExploreResult]:
+    """Run every protocol model at its pinned depth (the CI sweep)."""
+    depths = depths or PINNED_DEPTHS
+    out: Dict[str, ExploreResult] = {}
+    for name, make in MODELS.items():
+        out[name] = explore(make(), max_depth=depths[name], seed=seed)
+    return out

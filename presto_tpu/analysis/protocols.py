@@ -1,0 +1,542 @@
+"""Protocol soundness tier: spec automata + runtime conformance.
+
+Four distributed protocols carry this engine's fault-tolerance story —
+the token-acked streaming exchange (server/buffers.py +
+parallel/streams.py + server/shuffle_client.py), the
+ALIVE/SUSPECT/DEAD/RECOVERED failure detector (parallel/failure.py),
+the bounded fragment-retry budget with watermark replay
+(parallel/multihost.py), and the admission ticket lifecycle
+(serving/admission.py).  Their single-threaded behavior is pinned by
+unit tests; their *interleavings* are exactly what ROADMAP item 5
+(dynamic membership, straggler speculation) will stress.
+
+This module is the spec half of the tier:
+
+- **Spec automata** — one acceptor per protocol
+  (:class:`ExchangeAutomaton`, :class:`DetectorAutomaton`,
+  :class:`RetryAutomaton`, :class:`AdmissionAutomaton`) consuming the
+  protocol's event vocabulary and flagging violations of the *named
+  invariant catalog* (the ``INV_*`` constants below).  The same
+  acceptors serve two masters: the bounded schedule explorer
+  (analysis/mcheck.py) checks every interleaving it enumerates, and
+  the runtime conformance half checks event traces recorded from the
+  real implementation — so spec and implementation cannot drift.
+
+- **Runtime recorder** — :data:`RECORDER`, the protocol twin of
+  ``sync.WATCHER``: emission sites in the real code are one
+  ``RECORDER.enabled`` attribute read when tracing is off (the
+  production default), and append cheap event tuples when
+  ``PRESTO_TPU_PROTOCOL_TRACE=1`` (or :func:`set_protocol_trace`)
+  arms them.  :func:`check_trace` replays the recorded events through
+  the spec automata; ``tools/protocol_check.py`` does exactly that
+  after a real 2-worker faulted run and fails CI on any rejection.
+
+Inspired by stateless model checking with dynamic partial-order
+reduction (Flanagan & Godefroid) and FoundationDB-style deterministic
+simulation: the explorer proves the spec's invariants over bounded
+schedules, the conformance half proves the implementation speaks the
+spec's language.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from presto_tpu.envflag import EnvFlag
+
+# ---------------------------------------------------------------------------
+# the named invariant catalog (docs/static-analysis.md "Protocol
+# soundness"); explorer counterexamples and conformance rejections both
+# cite these names, and the seeded-mutation tests assert on them
+# ---------------------------------------------------------------------------
+
+#: each page sequence number reaches the consumer at most once
+INV_AT_MOST_ONCE = "exchange.at-most-once-delivery"
+#: the acked watermark never regresses, and only served tokens ack
+INV_ACK_MONOTONIC = "exchange.ack-monotonic"
+#: the server never re-serves a token below the acked watermark
+INV_NO_REPLAY_PAST_ACK = "exchange.no-replay-past-ack"
+#: a GET serves only pages that were actually enqueued, in order
+INV_SERVE_BOUNDS = "exchange.serve-within-produced"
+#: the consumer's delivered pages are exactly the canonical prefix
+#: 0,1,2,... — replayed incarnations must re-produce the same prefix
+INV_REPLAY_PREFIX = "exchange.replay-prefix-equality"
+#: aborting a drained, complete stream (or aborting twice) is a no-op:
+#: the abort-after-final-ack race must not retroactively fail a query
+INV_ABORT_DRAINED = "exchange.abort-after-drain-noop"
+
+#: detector edges come only from the reference state machine
+INV_DET_EDGE = "detector.legal-edge"
+#: DEAD -> RECOVERED requires recover_after consecutive successes
+INV_DET_RECOVER_GATE = "detector.recover-after-gate"
+#: fragments are never assigned to a DEAD worker
+INV_DET_NO_DEAD_SCHEDULE = "detector.no-dead-schedule"
+
+#: per-stage fragment retries never exceed the configured budget
+INV_RETRY_BUDGET = "retry.budget-bounded"
+#: a replayed fragment skips exactly its delivered watermark
+INV_RETRY_PREFIX = "retry.replay-prefix-equality"
+#: coordinator-local fallback only when no survivor or budget spent
+INV_RETRY_LOCAL = "retry.local-only-when-spent"
+
+#: tickets move QUEUED -> ADMITTED -> RELEASED (or one terminal
+#: rejection/cancellation) — never skip, repeat, or resurrect
+INV_ADM_LIFECYCLE = "admission.ticket-lifecycle"
+#: running + queued + resolved == issued, and slots track admissions
+INV_ADM_SLOTS = "admission.slot-conservation"
+#: no admit while projected headroom is negative (unless idle-pool)
+INV_ADM_HEADROOM = "admission.headroom-nonnegative"
+#: a ticket canceled before the admit decision never admits
+INV_ADM_CANCEL = "admission.no-admit-after-cancel"
+
+ALL_INVARIANTS = frozenset({
+    INV_AT_MOST_ONCE, INV_ACK_MONOTONIC, INV_NO_REPLAY_PAST_ACK,
+    INV_SERVE_BOUNDS, INV_REPLAY_PREFIX, INV_ABORT_DRAINED,
+    INV_DET_EDGE, INV_DET_RECOVER_GATE, INV_DET_NO_DEAD_SCHEDULE,
+    INV_RETRY_BUDGET, INV_RETRY_PREFIX, INV_RETRY_LOCAL,
+    INV_ADM_LIFECYCLE, INV_ADM_SLOTS, INV_ADM_HEADROOM, INV_ADM_CANCEL,
+})
+
+
+class ProtocolEvent(NamedTuple):
+    """One recorded protocol action.  ``protocol`` selects the
+    automaton, ``key`` the instance (one automaton run per key), and
+    ``fields`` carries the action's observed arguments."""
+
+    seq: int
+    protocol: str       # "exchange" | "detector" | "retry" | "admission"
+    key: str            # instance identity (buffer id, worker uri, ...)
+    action: str
+    fields: tuple       # sorted (name, value) pairs — hashable
+
+    def get(self, name: str, default=None):
+        for k, v in self.fields:
+            if k == name:
+                return v
+        return default
+
+
+class Violation(NamedTuple):
+    invariant: str
+    key: str
+    seq: int            # event sequence number that tripped the check
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.invariant}] {self.key} @#{self.seq}: {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# spec automata
+# ---------------------------------------------------------------------------
+
+class _Automaton:
+    """Base acceptor: feeds events to per-action ``on_<action>``
+    handlers; unknown actions are conformance rejections (the spec's
+    vocabulary is closed)."""
+
+    def __init__(self, key: str):
+        self.key = key
+        self.violations: List[Violation] = []
+
+    def flag(self, invariant: str, seq: int, message: str) -> None:
+        self.violations.append(Violation(invariant, self.key, seq, message))
+
+    def step(self, ev: ProtocolEvent) -> None:
+        handler = getattr(self, f"on_{ev.action}", None)
+        if handler is None:
+            self.flag("protocol.unknown-action", ev.seq,
+                      f"spec automaton has no action {ev.action!r}")
+            return
+        handler(ev)
+
+
+class ExchangeAutomaton(_Automaton):
+    """Token/ack/abort acceptor for ONE buffer or pull stream.
+
+    Server-side events (TaskOutputBuffer): ``enqueue(seq)``,
+    ``complete``, ``fail``, ``get(token, served_to, done)``,
+    ``ack(token, acked)``, ``abort(changed, drained)``.
+
+    Client-side events (shuffle_client / multihost pullers):
+    ``recv(token, next, done)`` — a response arrival, possibly a
+    duplicate (network artifact, acceptable) — and ``deliver(seq)``,
+    a page handed to the consumer, which must be exactly-once and in
+    canonical order no matter how delivery raced or replayed.
+    """
+
+    def __init__(self, key: str):
+        super().__init__(key)
+        self.produced = 0       # pages enqueued (server side)
+        self.acked = 0          # acked watermark
+        self.max_served = 0     # highest token ever served by a get
+        self.complete = False
+        self.aborted = False
+        self.next_deliver = 0   # consumer's canonical next sequence
+
+    # -- server side --------------------------------------------------------
+    def on_enqueue(self, ev: ProtocolEvent) -> None:
+        seq = ev.get("seq", self.produced)
+        if self.aborted:
+            self.flag(INV_SERVE_BOUNDS, ev.seq,
+                      "enqueue on an aborted buffer")
+        if seq != self.produced:
+            self.flag(INV_SERVE_BOUNDS, ev.seq,
+                      f"page enqueued at {seq}, expected {self.produced} "
+                      "(pages must append in token order)")
+        self.produced = max(self.produced, seq + 1)
+
+    def on_complete(self, ev: ProtocolEvent) -> None:
+        self.complete = True
+
+    def on_fail(self, ev: ProtocolEvent) -> None:
+        self.complete = True
+
+    def on_get(self, ev: ProtocolEvent) -> None:
+        token = ev.get("token", 0)
+        served_to = ev.get("served_to", token)
+        done = bool(ev.get("done", False))
+        if token < self.acked:
+            self.flag(INV_NO_REPLAY_PAST_ACK, ev.seq,
+                      f"get at token {token} below acked watermark "
+                      f"{self.acked}")
+        if served_to < token or served_to > self.produced:
+            self.flag(INV_SERVE_BOUNDS, ev.seq,
+                      f"get served [{token}, {served_to}) with only "
+                      f"{self.produced} pages produced")
+        if done and (not self.complete or served_to < self.produced):
+            self.flag(INV_SERVE_BOUNDS, ev.seq,
+                      "done=True before the producer completed or with "
+                      f"unserved pages ({served_to} < {self.produced})")
+        self.max_served = max(self.max_served, served_to)
+
+    def on_ack(self, ev: ProtocolEvent) -> None:
+        token = ev.get("token", 0)
+        acked = ev.get("acked", token)
+        if acked < self.acked:
+            self.flag(INV_ACK_MONOTONIC, ev.seq,
+                      f"acked watermark regressed {self.acked} -> {acked}")
+        if token > self.max_served and token > self.produced:
+            self.flag(INV_ACK_MONOTONIC, ev.seq,
+                      f"ack of unserved token {token} "
+                      f"(max served {self.max_served})")
+        self.acked = max(self.acked, acked)
+
+    def on_abort(self, ev: ProtocolEvent) -> None:
+        changed = bool(ev.get("changed", True))
+        drained = bool(ev.get("drained", False))
+        if changed and self.aborted:
+            self.flag(INV_ABORT_DRAINED, ev.seq,
+                      "second abort was not a no-op")
+        if changed and drained:
+            self.flag(INV_ABORT_DRAINED, ev.seq,
+                      "abort of a drained, complete stream was not a "
+                      "no-op (the abort-after-final-ack race)")
+        if changed:
+            self.aborted = True
+
+    # -- client side --------------------------------------------------------
+    def on_recv(self, ev: ProtocolEvent) -> None:
+        # response arrivals may duplicate or reorder (network); only
+        # what gets DELIVERED is constrained
+        pass
+
+    def on_deliver(self, ev: ProtocolEvent) -> None:
+        seq = ev.get("seq", -1)
+        if seq < self.next_deliver:
+            self.flag(INV_AT_MOST_ONCE, ev.seq,
+                      f"page {seq} delivered again (consumer already at "
+                      f"{self.next_deliver})")
+        elif seq > self.next_deliver:
+            self.flag(INV_REPLAY_PREFIX, ev.seq,
+                      f"delivery gap: got page {seq}, expected "
+                      f"{self.next_deliver} (replayed prefix must be "
+                      "canonical)")
+        self.next_deliver = max(self.next_deliver, seq + 1)
+
+    def on_replay(self, ev: ProtocolEvent) -> None:
+        skip = ev.get("skip", 0)
+        if skip != self.next_deliver:
+            self.flag(INV_RETRY_PREFIX, ev.seq,
+                      f"replay skips {skip} pages but the consumer's "
+                      f"delivered watermark is {self.next_deliver}")
+
+
+_ALIVE, _SUSPECT, _DEAD, _RECOVERED = "ALIVE", "SUSPECT", "DEAD", "RECOVERED"
+
+#: the reference edge set (parallel/failure.py's diagram)
+_DET_EDGES = frozenset({
+    (_ALIVE, _SUSPECT), (_RECOVERED, _SUSPECT),   # failures accumulate
+    (_SUSPECT, _DEAD),                            # more failures
+    (_DEAD, _RECOVERED),                          # sustained probes
+    (_SUSPECT, _ALIVE), (_RECOVERED, _ALIVE),     # success restores
+})
+
+
+class DetectorAutomaton(_Automaton):
+    """Failure-detector acceptor for ONE worker.  Events:
+    ``watch(suspect_after, dead_after, recover_after)`` (thresholds),
+    ``probe_ok`` / ``probe_fail`` (heartbeat outcomes),
+    ``transition(old, new)``, and ``assign(state)`` (a fragment was
+    scheduled onto this worker while it was in ``state``)."""
+
+    def __init__(self, key: str):
+        super().__init__(key)
+        self.state = _ALIVE
+        self.cf = 0             # consecutive failures
+        self.cs = 0             # consecutive successes
+        self.suspect_after = 1
+        self.dead_after = 3
+        self.recover_after = 2
+
+    def on_watch(self, ev: ProtocolEvent) -> None:
+        self.suspect_after = ev.get("suspect_after", self.suspect_after)
+        self.dead_after = ev.get("dead_after", self.dead_after)
+        self.recover_after = ev.get("recover_after", self.recover_after)
+
+    def on_probe_ok(self, ev: ProtocolEvent) -> None:
+        self.cf = 0
+        self.cs += 1
+
+    def on_probe_fail(self, ev: ProtocolEvent) -> None:
+        self.cs = 0
+        self.cf += 1
+
+    def on_transition(self, ev: ProtocolEvent) -> None:
+        old, new = ev.get("old"), ev.get("new")
+        if old != self.state:
+            self.flag(INV_DET_EDGE, ev.seq,
+                      f"transition from {old} but the spec state is "
+                      f"{self.state}")
+        if (old, new) not in _DET_EDGES:
+            self.flag(INV_DET_EDGE, ev.seq,
+                      f"illegal detector edge {old} -> {new}")
+        elif new == _SUSPECT and self.cf < self.suspect_after:
+            self.flag(INV_DET_EDGE, ev.seq,
+                      f"-> SUSPECT after {self.cf} failures "
+                      f"(suspect_after={self.suspect_after})")
+        elif new == _DEAD and self.cf < self.dead_after:
+            self.flag(INV_DET_EDGE, ev.seq,
+                      f"-> DEAD after {self.cf} failures "
+                      f"(dead_after={self.dead_after})")
+        elif old == _DEAD and self.cs < self.recover_after:
+            self.flag(INV_DET_RECOVER_GATE, ev.seq,
+                      f"re-admitted after {self.cs} consecutive "
+                      f"successes (recover_after={self.recover_after})")
+        self.state = new
+
+    def on_assign(self, ev: ProtocolEvent) -> None:
+        state = ev.get("state", self.state)
+        if state == _DEAD or self.state == _DEAD:
+            self.flag(INV_DET_NO_DEAD_SCHEDULE, ev.seq,
+                      "fragment assigned to a DEAD worker")
+
+
+class RetryAutomaton(_Automaton):
+    """Fragment-retry acceptor for ONE failover drain.  Events:
+    ``begin(budget)``, ``retry(used)``, ``local(survivors,
+    budget_left)``."""
+
+    def __init__(self, key: str):
+        super().__init__(key)
+        self.budget: Optional[int] = None
+        self.used = 0
+
+    def on_begin(self, ev: ProtocolEvent) -> None:
+        self.budget = ev.get("budget", 0)
+
+    def on_retry(self, ev: ProtocolEvent) -> None:
+        self.used += 1
+        if self.budget is not None and self.used > self.budget:
+            self.flag(INV_RETRY_BUDGET, ev.seq,
+                      f"{self.used} retries exceed the stage budget "
+                      f"{self.budget}")
+
+    def on_local(self, ev: ProtocolEvent) -> None:
+        survivors = ev.get("survivors", 0)
+        budget_left = ev.get("budget_left", 0)
+        if survivors > 0 and budget_left > 0:
+            self.flag(INV_RETRY_LOCAL, ev.seq,
+                      f"coordinator-local fallback with {survivors} "
+                      f"survivors and {budget_left} retries left")
+
+
+class AdmissionAutomaton(_Automaton):
+    """Admission-lifecycle acceptor for ONE controller.  Events carry
+    ``qid``; the automaton books every ticket: ``queued``,
+    ``admitted(reserved, inflight, need, cap, idle)``,
+    ``rejected(reason)``, ``cancel``, ``released``."""
+
+    QUEUED, ADMITTED, DONE = "QUEUED", "ADMITTED", "DONE"
+
+    def __init__(self, key: str):
+        super().__init__(key)
+        self.tickets: Dict[str, str] = {}
+        self.canceled: Dict[str, bool] = {}
+        self.issued = 0
+        self.resolved = 0
+
+    def _conserved(self, ev: ProtocolEvent) -> None:
+        running = sum(1 for s in self.tickets.values() if s == self.ADMITTED)
+        queued = sum(1 for s in self.tickets.values() if s == self.QUEUED)
+        if running + queued + self.resolved != self.issued:
+            self.flag(INV_ADM_SLOTS, ev.seq,
+                      f"slot books diverged: running={running} "
+                      f"queued={queued} resolved={self.resolved} "
+                      f"issued={self.issued}")
+
+    def on_queued(self, ev: ProtocolEvent) -> None:
+        qid = ev.get("qid")
+        if self.tickets.get(qid) is not None:
+            self.flag(INV_ADM_LIFECYCLE, ev.seq,
+                      f"ticket {qid} queued twice")
+            return
+        self.tickets[qid] = self.QUEUED
+        self.issued += 1
+        self._conserved(ev)
+
+    def on_admitted(self, ev: ProtocolEvent) -> None:
+        qid = ev.get("qid")
+        if self.tickets.get(qid) != self.QUEUED:
+            self.flag(INV_ADM_LIFECYCLE, ev.seq,
+                      f"ticket {qid} admitted from state "
+                      f"{self.tickets.get(qid)!r} (must be QUEUED)")
+        if self.canceled.get(qid):
+            self.flag(INV_ADM_CANCEL, ev.seq,
+                      f"ticket {qid} admitted after cancellation")
+        cap = ev.get("cap")
+        if cap is not None and not ev.get("idle", False):
+            reserved = ev.get("reserved", 0)
+            inflight = ev.get("inflight", 0)
+            need = ev.get("need", 0)
+            if reserved + inflight + need > cap:
+                self.flag(INV_ADM_HEADROOM, ev.seq,
+                          f"admitted {qid} with negative projected "
+                          f"headroom ({reserved} reserved + {inflight} "
+                          f"inflight + {need} needed > {cap})")
+        self.tickets[qid] = self.ADMITTED
+        self._conserved(ev)
+
+    def on_rejected(self, ev: ProtocolEvent) -> None:
+        qid = ev.get("qid")
+        if self.tickets.get(qid) != self.QUEUED:
+            self.flag(INV_ADM_LIFECYCLE, ev.seq,
+                      f"ticket {qid} rejected from state "
+                      f"{self.tickets.get(qid)!r} (must be QUEUED)")
+        self.tickets[qid] = self.DONE
+        self.resolved += 1
+        self._conserved(ev)
+
+    def on_cancel(self, ev: ProtocolEvent) -> None:
+        self.canceled[ev.get("qid")] = True
+
+    def on_released(self, ev: ProtocolEvent) -> None:
+        qid = ev.get("qid")
+        if self.tickets.get(qid) != self.ADMITTED:
+            self.flag(INV_ADM_LIFECYCLE, ev.seq,
+                      f"ticket {qid} released from state "
+                      f"{self.tickets.get(qid)!r} (must be ADMITTED — "
+                      "release is exactly-once)")
+        self.tickets[qid] = self.DONE
+        self.resolved += 1
+        self._conserved(ev)
+
+
+AUTOMATA: Dict[str, Callable[[str], _Automaton]] = {
+    "exchange": ExchangeAutomaton,
+    "detector": DetectorAutomaton,
+    "retry": RetryAutomaton,
+    "admission": AdmissionAutomaton,
+}
+
+
+def check_trace(events) -> List[Violation]:
+    """Replay recorded events through the spec automata — one
+    automaton instance per (protocol, key) — and return every
+    violation.  The runtime-conformance entry point
+    (tools/protocol_check.py and the conformance tests)."""
+    runs: Dict[Tuple[str, str], _Automaton] = {}
+    for ev in events:
+        make = AUTOMATA.get(ev.protocol)
+        if make is None:
+            continue
+        a = runs.get((ev.protocol, ev.key))
+        if a is None:
+            a = runs[(ev.protocol, ev.key)] = make(ev.key)
+        a.step(ev)
+    out: List[Violation] = []
+    for a in runs.values():
+        out.extend(a.violations)
+    out.sort(key=lambda v: v.seq)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runtime recorder (the sync.WATCHER idiom: inert by default, one
+# attribute read per emission site when off)
+# ---------------------------------------------------------------------------
+
+_PROTOCOL_TRACE = EnvFlag("PRESTO_TPU_PROTOCOL_TRACE", default=False)
+
+
+def protocol_trace_enabled() -> bool:
+    return _PROTOCOL_TRACE()
+
+
+class ProtocolRecorder:
+    """Process-global, bounded protocol event log.  Emission sites in
+    the real code guard on the ``enabled`` attribute (a plain read —
+    the production fast path) and call :meth:`record` only when a
+    conformance run armed tracing.  The recorder's own lock is a bare
+    ``threading.Lock`` and the record path never calls out, so it is
+    safe to emit while holding any engine lock (event order then
+    matches the critical-section order the automata assume)."""
+
+    #: hard cap — a runaway workload degrades to a truncated (and
+    #: reported) trace instead of unbounded memory
+    MAX_EVENTS = 500_000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[ProtocolEvent] = []
+        self._seq = 0
+        self.dropped = 0
+        self.enabled = _PROTOCOL_TRACE()
+
+    def record(self, protocol: str, key: str, action: str, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append(ProtocolEvent(
+                self._seq, protocol, key, action,
+                tuple(sorted(fields.items()))))
+
+    def events(self) -> List[ProtocolEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+            self.dropped = 0
+
+    def check(self) -> List[Violation]:
+        """Conformance verdict over everything recorded so far."""
+        return check_trace(self.events())
+
+
+#: the process-wide recorder every emission site consults
+RECORDER = ProtocolRecorder()
+
+
+def set_protocol_trace(value: Optional[bool]) -> None:
+    """Test/tool override (``None`` re-resolves from the environment).
+    Unlike the lock sanitizer this flips LIVE: emission sites re-read
+    ``RECORDER.enabled`` on every pass, so no reconstruction window
+    exists."""
+    _PROTOCOL_TRACE.set(value)
+    RECORDER.enabled = _PROTOCOL_TRACE()

@@ -40,6 +40,7 @@ import time
 import weakref
 from typing import Callable, Dict, List, Optional
 
+from presto_tpu.analysis.protocols import RECORDER
 from presto_tpu.sync import named_lock
 
 _log = logging.getLogger("presto_tpu.failure")
@@ -149,9 +150,20 @@ class FailureDetector:
             h = self._workers.get(uri)
             if h is None:
                 h = self._workers[uri] = WorkerHealth(uri)
+                if RECORDER.enabled:
+                    RECORDER.record(
+                        "detector", self._pkey(uri), "watch",
+                        suspect_after=self.suspect_after,
+                        dead_after=self.dead_after,
+                        recover_after=self.recover_after)
         if not self._gauges_wired:
             self._wire_gauges()
         return h
+
+    def _pkey(self, uri: str) -> str:
+        # per-detector-instance key: two rigs watching the same uri in
+        # one process must not interleave on one spec-automaton run
+        return f"det:{id(self):x}:{uri}"
 
     def add_transition_listener(
             self, fn: Callable[[str, str, str, Optional[str]], None]) -> None:
@@ -195,6 +207,9 @@ class FailureDetector:
         old = h.state
         h.state = new_state
         h.transitions += 1
+        if RECORDER.enabled:
+            RECORDER.record("detector", self._pkey(h.uri), "transition",
+                            old=old, new=new_state)
         return (h.uri, old, new_state, reason)
 
     def _announce(self, edge: Optional[tuple]) -> None:
@@ -225,6 +240,10 @@ class FailureDetector:
             h.last_heartbeat = now
             h.last_error = None
             h.next_probe = now + self.interval
+            if RECORDER.enabled:
+                # inside the lock: the recorded order IS the
+                # state-machine order the spec automaton assumes
+                RECORDER.record("detector", self._pkey(h.uri), "probe_ok")
             if h.state == DEAD:
                 edge = (self._transition(h, RECOVERED, "probe succeeded")
                         if h.consecutive_successes >= self.recover_after
@@ -247,6 +266,8 @@ class FailureDetector:
                 self.backoff_max)
             h.next_probe = now + backoff * (
                 1.0 + self.jitter * self._rng.random())
+            if RECORDER.enabled:
+                RECORDER.record("detector", self._pkey(h.uri), "probe_fail")
             edges = []
             if h.state in (ALIVE, RECOVERED) \
                     and h.consecutive_failures >= self.suspect_after:
@@ -268,6 +289,17 @@ class FailureDetector:
         """The circuit breaker: DEAD workers are excluded from
         fragment assignment until sustained probes re-admit them."""
         return self.watch(uri).state in SCHEDULABLE_STATES
+
+    def note_assignment(self, uri: str) -> None:
+        """Conformance hook: the scheduler actually placed a fragment
+        on ``uri``.  Recorded so the spec automaton can check
+        detector.no-dead-schedule against the detector's own state."""
+        if RECORDER.enabled:
+            with self._lock:
+                h = self._workers.get(uri.rstrip("/"))
+                state = h.state if h is not None else ALIVE
+                RECORDER.record("detector", self._pkey(uri.rstrip("/")),
+                                "assign", state=state)
 
     def probe_due(self, uri: str) -> bool:
         """True when the backoff window for this worker has elapsed —

@@ -20,6 +20,7 @@ re-scheduled on the survivors.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import threading
@@ -30,6 +31,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
+from presto_tpu.analysis.protocols import RECORDER
 from presto_tpu.catalog import Catalog
 from presto_tpu.exec.local import LocalRunner, MaterializedResult, concat_pages_device
 from presto_tpu.planner.plan import (
@@ -49,6 +51,10 @@ from presto_tpu.server.serde import deserialize_page, plan_to_json
 from presto_tpu.sync import named_lock
 
 _log = logging.getLogger("presto_tpu.multihost")
+
+#: distinguishes concurrent failover drains in one process — each gets
+#: its own retry spec-automaton run (conformance tracing only)
+_FAILOVER_SEQ = itertools.count(1)
 
 
 class TaskFailed(Exception):
@@ -1409,6 +1415,8 @@ class MultiHostRunner:
 
         delivered = skip
         last: Optional[BaseException] = None
+        if RECORDER.enabled and self.detector is not None:
+            self.detector.note_assignment(w.uri)
         for attempt in range(w.max_attempts):
             if delivered > 0 and (attempt > 0 or skip > 0):
                 # this task re-produces pages the consumer already has
@@ -1480,8 +1488,14 @@ class MultiHostRunner:
 
         def emit_into(put, slot: int, start: int = 0):
             seq = [start]
+            pk = f"mh:{id(stream):x}:{slot}"
 
             def emit(page, nbytes):
+                if RECORDER.enabled:
+                    # per-slot canonical sequencing: the spec automaton
+                    # checks exactly-once delivery + replay-prefix
+                    # equality across fragment re-incarnations
+                    RECORDER.record("exchange", pk, "deliver", seq=seq[0])
                 put((slot, seq[0], page), nbytes=nbytes)
                 seq[0] += 1
 
@@ -1533,6 +1547,11 @@ class MultiHostRunner:
         def redispatch(item4, survivors, rr):
             slot, item, fragment, delivered = item4
             w = survivors[rr % len(survivors)]
+            if RECORDER.enabled:
+                # skip must equal the consumer's delivered watermark —
+                # the automaton cross-checks it against its own count
+                RECORDER.record("exchange", f"mh:{id(stream):x}:{slot}",
+                                "replay", skip=delivered)
             emit = emit_into(
                 lambda tagged, nbytes: slotted.append(tagged), slot,
                 start=delivered)
@@ -1556,6 +1575,12 @@ class MultiHostRunner:
             out = run_local(item, delivered)
             if prog is not None:
                 prog.split_done(prog_stage, n=prog_n(item))
+            if RECORDER.enabled:
+                pk = f"mh:{id(stream):x}:{slot}"
+                RECORDER.record("exchange", pk, "replay", skip=delivered)
+                for i in range(len(out)):
+                    RECORDER.record("exchange", pk, "deliver",
+                                    seq=delivered + i)
             return [(slot, delivered + i, p) for i, p in enumerate(out)]
 
         slotted.extend(self._failover(
@@ -1578,6 +1603,10 @@ class MultiHostRunner:
 
         local_pages: List = []
         budget = self.max_fragment_retries
+        pkey = None
+        if RECORDER.enabled:
+            pkey = f"fo:{id(self):x}:{next(_FAILOVER_SEQ)}"
+            RECORDER.record("retry", pkey, "begin", budget=budget)
         rr = 0
         while failed:
             if errors:
@@ -1585,10 +1614,17 @@ class MultiHostRunner:
             item = failed.pop()
             survivors = [w for w in alive if w.alive]
             if not survivors or budget <= 0:
+                if pkey is not None:
+                    RECORDER.record("retry", pkey, "local",
+                                    survivors=len(survivors),
+                                    budget_left=max(budget, 0))
                 local_pages.extend(run_local(item))
                 continue
             budget -= 1
             METRICS.counter("retry.fragments_total").inc()
+            if pkey is not None:
+                RECORDER.record("retry", pkey, "retry",
+                                used=self.max_fragment_retries - budget)
             redispatch(item, survivors, rr)
             rr += 1
         if errors:

@@ -97,9 +97,15 @@ class PageStream:
             self._exc = exc
         self.buffer.fail(f"{type(exc).__name__}: {exc}")
 
-    def abort(self) -> None:
+    def abort(self) -> bool:
+        """Abort the stream; returns whether the underlying buffer
+        actually aborted.  Idempotent and drain-safe (the buffer's
+        abort is a no-op on a second call or after a full drain), so
+        racing kill paths — deadline kill vs. memory kill vs. a
+        consumer that already finished — never raise and never fail a
+        query that delivered everything."""
         self.closed = True
-        self.buffer.abort()
+        return self.buffer.abort()
 
     # -- consumer side -------------------------------------------------
     @property
@@ -255,16 +261,22 @@ def query_scope(query_id: Optional[str]):
 
 def abort_query(query_id: str) -> int:
     """Abort every live stream of a killed query: producers blocked in
-    ``enqueue`` raise BufferAborted and exit instead of leaking."""
+    ``enqueue`` raise BufferAborted and exit instead of leaking.
+
+    Idempotent and drain-safe: calling it twice, or while (or after) a
+    consumer drains the last page and acks it, is a no-op for the
+    already-settled streams — never raises, and only streams this call
+    actually tore down count toward ``exchange.streams_aborted`` (a
+    deadline kill that loses the race with a successful drain must not
+    report an abort that never happened).  Returns that count."""
     with _REG_LOCK:
         streams = list(_REGISTRY.pop(query_id, ()))
-    for s in streams:
-        s.abort()
-    if streams:
+    aborted = sum(1 for s in streams if s.abort())
+    if aborted:
         from presto_tpu.obs import METRICS
 
-        METRICS.counter("exchange.streams_aborted").inc(len(streams))
-    return len(streams)
+        METRICS.counter("exchange.streams_aborted").inc(aborted)
+    return aborted
 
 
 def _wire_gauges() -> None:

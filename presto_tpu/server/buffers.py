@@ -24,9 +24,11 @@ sees completion only when the last one finishes.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import List, Optional, Tuple
 
+from presto_tpu.analysis.protocols import RECORDER
 from presto_tpu.sync import named_condition, named_lock
 
 
@@ -50,6 +52,8 @@ class TaskOutputBuffer:
         self._complete = False
         self._aborted = False
         self._error: Optional[str] = None
+        # conformance identity: one spec-automaton run per buffer
+        self._pkey = f"buf:{id(self):x}"
         # stage-overlap evidence (perf_counter): when the first page
         # landed vs when production finished — the A/B harness proves a
         # consumer's first pull preceded producer completion from these
@@ -81,6 +85,9 @@ class TaskOutputBuffer:
             self._pages.append(page)
             self._sizes.append(size)
             self._bytes += size
+            if RECORDER.enabled:
+                RECORDER.record("exchange", self._pkey, "enqueue",
+                                seq=len(self._pages) - 1)
             self._cond.notify_all()
 
     def set_complete(self) -> None:
@@ -90,21 +97,43 @@ class TaskOutputBuffer:
                 self._complete = True
                 if self.completed_at is None:
                     self.completed_at = time.perf_counter()
+                if RECORDER.enabled:
+                    RECORDER.record("exchange", self._pkey, "complete")
             self._cond.notify_all()
 
     def fail(self, message: str) -> None:
         with self._cond:
             self._error = message
             self._complete = True
+            if RECORDER.enabled:
+                RECORDER.record("exchange", self._pkey, "fail")
             self._cond.notify_all()
 
-    def abort(self) -> None:
+    def abort(self) -> bool:
+        """Tear down the buffer, waking blocked producers/consumers.
+
+        Idempotent and drain-safe: a second abort, or an abort racing
+        a consumer's final acknowledge (complete stream, every page
+        acked), is a no-op — a deadline/cancel kill arriving after the
+        query already delivered everything must not retroactively fail
+        it (INV exchange.abort-after-drain-noop).  Returns whether
+        this call actually aborted the buffer.
+        """
         with self._cond:
+            drained = (self._complete and self._bytes == 0
+                       and self._acked >= len(self._pages))
+            changed = not self._aborted and not drained
+            if RECORDER.enabled:
+                RECORDER.record("exchange", self._pkey, "abort",
+                                changed=changed, drained=drained)
+            if not changed:
+                return False
             self._aborted = True
             self._pages = []
             self._sizes = []
             self._bytes = 0
             self._cond.notify_all()
+            return True
 
     # -- consumer side ------------------------------------------------------
     def get(self, token: int, max_bytes: int = 8 << 20,
@@ -136,6 +165,9 @@ class TaskOutputBuffer:
                 size += self._sizes[t]
                 t += 1
             done = self._complete and t >= len(self._pages)
+            if RECORDER.enabled:
+                RECORDER.record("exchange", self._pkey, "get",
+                                token=token, served_to=t, done=done)
             return out, t, done, self._error
 
     def acknowledge(self, token: int) -> None:
@@ -145,6 +177,9 @@ class TaskOutputBuffer:
                     self._bytes -= self._sizes[i]
                     self._pages[i] = None
             self._acked = max(self._acked, token)
+            if RECORDER.enabled:
+                RECORDER.record("exchange", self._pkey, "ack",
+                                token=token, acked=self._acked)
             self._cond.notify_all()
 
     @property

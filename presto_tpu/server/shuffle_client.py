@@ -25,7 +25,9 @@ import urllib.error
 import urllib.request
 from typing import Iterator
 
+from presto_tpu.analysis.protocols import RECORDER
 from presto_tpu.server.serde import parse_page_batch as _parse_batch
+from presto_tpu.testing_faults import FAULTS
 
 #: consecutive transient transport failures tolerated per token before
 #: the pull is abandoned (the caller's failover takes over) — small on
@@ -65,6 +67,7 @@ def pull_pages(uri: str, task_id: str, buffer_id: int = 0,
 
     uri = uri.rstrip("/")
     token = 0
+    pkey = f"pull:{uri}/{task_id}/{buffer_id}"
     last_progress = time.monotonic()
     transient_failures = 0
     while True:
@@ -72,6 +75,7 @@ def pull_pages(uri: str, task_id: str, buffer_id: int = 0,
             raise TimeoutError(
                 f"buffer {buffer_id} of task {task_id} on {uri} made no "
                 f"progress for {timeout}s")
+        rtoken = token
         try:
             with urllib.request.urlopen(
                 f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}",
@@ -102,23 +106,44 @@ def pull_pages(uri: str, task_id: str, buffer_id: int = 0,
             time.sleep(min(0.05 * (2 ** transient_failures), 0.5))
             continue
         transient_failures = 0
-        yield from batch
-        if nxt > token:
-            token = nxt
-            last_progress = time.monotonic()
-            try:
-                urllib.request.urlopen(
-                    f"{uri}/v1/task/{task_id}/results/{buffer_id}/{token}"
-                    "/acknowledge",
-                    timeout=poll_timeout,
-                ).close()
-            except Exception as e:
-                # best-effort: an ack only frees buffered pages below
-                # `token` — a later ack at a higher token supersedes a
-                # lost one, and a truly dead producer surfaces at the
-                # next results GET with proper triage.  Aborting the
-                # pull (and recomputing the whole task) over an ack
-                # blip would be strictly worse.
-                count_error(e)
+        responses = [(rtoken, batch, nxt, complete)]
+        if FAULTS.enabled and FAULTS.should_fire(
+                "net.duplicate_page", uri) is not None:
+            # the delayed duplicate reply of a token GET the client
+            # retried (both responses eventually arrive): the seq-based
+            # dedupe below must swallow the repeated pages
+            responses.append((rtoken, batch, nxt, complete))
+        for r_tok, r_batch, r_nxt, r_done in responses:
+            if RECORDER.enabled:
+                RECORDER.record("exchange", pkey, "recv",
+                                token=r_tok, next=r_nxt, done=r_done)
+            for i, raw in enumerate(r_batch):
+                seq = r_tok + i
+                if seq < token:
+                    # dedupe by sequence number: a duplicated or stale
+                    # response (client retry whose first reply was not
+                    # lost after all) re-carries pages already yielded —
+                    # at-least-once delivery becomes exactly-once HERE
+                    continue
+                if RECORDER.enabled:
+                    RECORDER.record("exchange", pkey, "deliver", seq=seq)
+                yield raw
+            if r_nxt > token:
+                token = r_nxt
+                last_progress = time.monotonic()
+                try:
+                    urllib.request.urlopen(
+                        f"{uri}/v1/task/{task_id}/results/{buffer_id}"
+                        f"/{token}/acknowledge",
+                        timeout=poll_timeout,
+                    ).close()
+                except Exception as e:
+                    # best-effort: an ack only frees buffered pages below
+                    # `token` — a later ack at a higher token supersedes a
+                    # lost one, and a truly dead producer surfaces at the
+                    # next results GET with proper triage.  Aborting the
+                    # pull (and recomputing the whole task) over an ack
+                    # blip would be strictly worse.
+                    count_error(e)
         if complete:
             return

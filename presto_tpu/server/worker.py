@@ -219,6 +219,17 @@ class WorkerServer:
                         return
                     buf = task.buffers[buffer_id]
                     if m.group(4):  # acknowledge
+                        # scoped by URI like the client-side net.*
+                        # point, so one node key addresses both ends
+                        if outer.faults.enabled and outer.faults.should_fire(
+                                "net.drop_ack", outer.uri) is not None:
+                            # the ack is "lost en route": respond OK
+                            # without applying it — unacked pages
+                            # re-serve at the same token and a later,
+                            # higher ack supersedes (the client's
+                            # seq dedupe keeps delivery exactly-once)
+                            self._send(200, b"{}")
+                            return
                         buf.acknowledge(token)
                         self._send(200, b"{}")
                         return

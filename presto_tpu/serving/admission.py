@@ -43,6 +43,7 @@ import time
 import weakref
 from typing import Dict, Optional
 
+from presto_tpu.analysis.protocols import RECORDER
 from presto_tpu.resource_groups import (  # re-exported for callers
     QueryQueueFullError, ResourceGroupManager,
 )
@@ -145,7 +146,15 @@ class AdmissionController:
         self._peak_history: "collections.OrderedDict[str, int]" = \
             collections.OrderedDict()
         _CONTROLLERS.add(self)
+        # conformance identity: one admission spec-automaton run per
+        # controller (every event carries its qid)
+        self._pkey = f"adm:{id(self):x}"
         _wire_gauges()
+
+    def _record_reject(self, ticket: AdmissionTicket, reason: str) -> None:
+        if RECORDER.enabled:
+            RECORDER.record("admission", self._pkey, "rejected",
+                            qid=ticket.query_id, reason=reason)
 
     def _running_count(self) -> int:
         with self._cond:
@@ -202,6 +211,9 @@ class AdmissionController:
         ticket.projected_bytes = self.projected_bytes(statement_key)
         with self._cond:
             self._tickets[query_id] = ticket
+            if RECORDER.enabled:
+                RECORDER.record("admission", self._pkey, "queued",
+                                qid=query_id)
         METRICS.counter("admission.queued_total").inc()
         self._emit_queued(ticket)
         deadline = None if timeout is None \
@@ -212,13 +224,16 @@ class AdmissionController:
             group.acquire(timeout=timeout, priority=priority)
         except QueryQueueFullError:
             METRICS.counter("admission.rejected_queue_full").inc()
+            self._record_reject(ticket, "queue_full")
             self._drop(ticket)
             raise
         except TimeoutError:
             METRICS.counter("admission.rejected_timeout").inc()
+            self._record_reject(ticket, "timeout")
             self._drop(ticket)
             raise
-        except BaseException:
+        except BaseException as e:
+            self._record_reject(ticket, type(e).__name__)
             self._drop(ticket)
             raise
         try:
@@ -230,10 +245,12 @@ class AdmissionController:
             self._wait_for_memory(ticket, deadline)
         except TimeoutError:
             METRICS.counter("admission.rejected_timeout").inc()
+            self._record_reject(ticket, "timeout")
             group.release()
             self._drop(ticket)
             raise
-        except BaseException:
+        except BaseException as e:
+            self._record_reject(ticket, type(e).__name__)
             group.release()
             self._drop(ticket)
             raise
@@ -304,6 +321,19 @@ class AdmissionController:
                     # concurrent admit can evaluate its own headroom
                     ticket.admitted_at = time.monotonic()
                     ticket.state = "ADMITTED"
+                    if RECORDER.enabled:
+                        limit = getattr(pool, "limit", 0) \
+                            if pool is not None else 0
+                        fields = dict(qid=ticket.query_id,
+                                      reserved=int(getattr(
+                                          pool, "reserved", 0) or 0),
+                                      inflight=int(inflight),
+                                      need=int(need), idle=bool(idle))
+                        if limit > 0 and self.memory_fraction > 0:
+                            fields["cap"] = int(
+                                self.memory_fraction * limit)
+                        RECORDER.record("admission", self._pkey,
+                                        "admitted", **fields)
                     break
                 if not blocked:
                     blocked = True
@@ -335,6 +365,9 @@ class AdmissionController:
             ticket.released = True
             ticket.state = "RELEASED"
             self._tickets.pop(ticket.query_id, None)
+            if RECORDER.enabled and ticket.admitted_at is not None:
+                RECORDER.record("admission", self._pkey, "released",
+                                qid=ticket.query_id)
             self._cond.notify_all()
         if ticket.group is not None and ticket.admitted_at is not None:
             ticket.group.release()
@@ -348,6 +381,9 @@ class AdmissionController:
             t = self._tickets.get(query_id)
             if t is not None:
                 t.canceled = True
+                if RECORDER.enabled:
+                    RECORDER.record("admission", self._pkey, "cancel",
+                                    qid=query_id)
             self._cond.notify_all()
 
     def _drop(self, ticket: AdmissionTicket) -> None:

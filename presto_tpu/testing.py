@@ -94,10 +94,17 @@ class DistributedQueryRunner:
 
     def arm_fault(self, point: str, worker: Optional[int] = None, **kw):
         """Arm a deterministic fault point (testing_faults.py) scoped
-        to one worker of this rig (``worker=None`` = any node)."""
+        to one worker of this rig (``worker=None`` = any node).
+        ``net.*`` points evaluate on the CLIENT side of a pull, where
+        only the worker's URI is known — scope them by port, which both
+        the URI and the server-side node id carry."""
         from presto_tpu.testing_faults import FAULTS
 
-        node = self.workers[worker].node_id if worker is not None else None
+        node = None
+        if worker is not None:
+            node = (f":{self.workers[worker].port}"
+                    if point.startswith("net.")
+                    else self.workers[worker].node_id)
         return FAULTS.arm(point, node=node, **kw)
 
     # -- lifecycle ----------------------------------------------------------

@@ -29,6 +29,18 @@ Fault points (the catalog; docs/fault-tolerance.md):
                               before it enters the output buffer; the
                               consumer's CRC check rejects it
                               (PageIntegrityError — transient, retried).
+``net.duplicate_page``        the shuffle client re-processes a results
+                              response it already consumed — the delayed
+                              duplicate reply of a retried token GET.
+                              The client's seq-based dedupe must drop
+                              the duplicated pages (protocol invariant
+                              exchange.at-most-once-delivery).
+``net.drop_ack``              the worker accepts an acknowledge request
+                              but discards it (the ack is lost en
+                              route); the unacked pages re-serve at the
+                              same token and a later, higher ack
+                              supersedes — delivery must stay
+                              exactly-once under replay.
 
 Arming::
 
@@ -61,6 +73,8 @@ FAULT_POINTS = (
     "worker.die_after_n_pages",
     "worker.slow_response_ms",
     "page.corrupt_crc",
+    "net.duplicate_page",
+    "net.drop_ack",
 )
 
 

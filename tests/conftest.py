@@ -38,6 +38,14 @@ jax.config.update("jax_platforms", "cpu")
 # float64/int64 for DOUBLE/BIGINT columns on the CPU test backend.
 jax.config.update("jax_enable_x64", True)
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multi-worker/chaos tests excluded from the tier-1 "
+        "sweep (-m 'not slow'); tools/ci.sh runs them in dedicated legs",
+    )
+
 # Persistent compilation cache: the suite's wall-clock is dominated by
 # XLA recompilation (every query/capacity pair is a fresh program), so
 # compiled executables are cached on disk across runs and processes.

@@ -528,6 +528,75 @@ def test_narrow_cast_allow_comment(tmp_path):
     assert findings == []
 
 
+def test_protocol_state_write_outside_owner_flagged(tmp_path):
+    # a transition bypass: WorkerHealth.state assigned outside
+    # __init__/_transition defeats the model-checked detector machine
+    findings = _lint_snippet(tmp_path, """
+        class WorkerHealth:
+            def __init__(self):
+                self.state = "ALIVE"
+
+            def _transition(self, new):
+                self.state = new
+
+            def force_dead(self):
+                self.state = "DEAD"
+    """, name="failure.py", subdir="parallel")
+    assert [f.rule for f in findings] == ["protocol-state"]
+    assert "force_dead" in findings[0].message
+
+
+def test_protocol_state_ticket_flags_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def helper(ticket):
+            ticket.released = True
+            ticket.canceled = True
+    """, name="admission.py", subdir="serving")
+    assert sorted(f.rule for f in findings) == ["protocol-state"] * 2
+
+
+def test_protocol_state_owner_methods_allowed(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        class TaskOutputBuffer:
+            def __init__(self):
+                self._acked = 0
+                self._aborted = False
+                self._complete = False
+
+            def acknowledge(self, token):
+                self._acked = max(self._acked, token)
+
+            def abort(self):
+                self._aborted = True
+
+            def set_complete(self):
+                self._complete = True
+
+            def fail(self, message):
+                self._complete = True
+    """, name="buffers.py", subdir="server")
+    assert findings == []
+
+
+def test_protocol_state_scoped_to_owning_files(tmp_path):
+    # `.state` names unrelated machines elsewhere (coordinator query
+    # lifecycle, progress tracker) — the rule must not fire there
+    findings = _lint_snippet(tmp_path, """
+        def helper(q):
+            q.state = "FINISHED"
+            q.released = True
+    """, name="coordinator.py", subdir="server")
+    assert findings == []
+
+
+def test_protocol_state_allow_comment(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def test_fixture(h):
+            h.state = "DEAD"  # lint: allow(protocol-state)
+    """, name="failure.py", subdir="parallel")
+    assert findings == []
+
+
 # ---------------------------------------------------------------------------
 # the repo-wide pin
 # ---------------------------------------------------------------------------

@@ -201,6 +201,33 @@ def test_kill_query_aborts_registered_streams():
         "exchange.producer_stall_seconds_total").value > 0
 
 
+def test_abort_query_idempotent_and_drain_safe():
+    """The abort-after-final-ack race (protocol invariant
+    exchange.abort-after-drain-noop): a deadline/kill abort that loses
+    the race with a successful drain must be a no-op — never raise,
+    never retroactively fail the drained stream, never count an abort
+    that didn't happen."""
+    a0 = METRICS.counter("exchange.streams_aborted").value
+    with streams.query_scope("q-drained"):
+        s = streams.PageStream()
+        s.put((1,), nbytes=8)
+        s.buffer.set_complete()
+        _, nxt, done, _ = s.buffer.get(0, timeout=1.0)
+        assert done
+        s.buffer.acknowledge(nxt)       # consumer took everything
+        assert streams.abort_query("q-drained") == 0   # lost the race
+        assert not s.buffer.aborted     # the drained result stands
+    # double abort on a LIVE stream: first wins, second is a no-op
+    with streams.query_scope("q-live"):
+        live = streams.PageStream()
+        live.put((1,), nbytes=8)
+        assert streams.abort_query("q-live") == 1
+        assert live.abort() is False    # already aborted: idempotent
+    assert streams.abort_query("q-live") == 0      # registry drained
+    assert streams.abort_query("q-never-existed") == 0
+    assert METRICS.counter("exchange.streams_aborted").value - a0 == 1
+
+
 # ---------------------------------------------------------------------------
 # mid-stream producer death: replay from the last acked token
 # ---------------------------------------------------------------------------
@@ -271,7 +298,29 @@ def test_streamed_vs_materialized_same_rows(dqr3):
     assert len(a) == len(local.executor.run(plan).rows)
 
 
-def test_streaming_gather_overlap_evidence(dqr3):
+@pytest.mark.slow  # heavy 3-worker chaos runs; exercised by the ci.sh protocol leg
+@pytest.mark.parametrize("qid", [3, 6])
+def test_replay_byte_equality_under_net_faults(dqr3, qid):
+    """Replay-from-watermark property over real TPC-H plans: with a
+    worker dying mid-stream (fragment failover + watermark replay), a
+    duplicated results response (net.duplicate_page — client dedupe
+    must swallow it), AND dropped acks (net.drop_ack — unacked pages
+    re-serve at the same token), q3/q6 still return the EXACT oracle
+    rows.  This is invariant exchange.replay-prefix-equality made
+    end-to-end: at-least-once on the wire, exactly-once delivered."""
+    from tests.tpch_queries import QUERIES
+
+    mh = dqr3.multihost
+    local = dqr3.runner
+    sql = QUERIES[qid]
+    expected = local.executor.run(local.plan(sql)).rows
+
+    dqr3.arm_fault("worker.die_after_n_pages", worker=0, pages=2)
+    # the net faults go on SURVIVORS — worker 0's pulls die with it
+    dqr3.arm_fault("net.duplicate_page", worker=1, after=1, count=3)
+    dqr3.arm_fault("net.drop_ack", worker=2, count=3)
+    out = mh.run(local.plan(sql))
+    assert out.rows == expected
     """With in-process HTTP workers the consumer's first page must land
     before the last producer completes (stage overlap), and the
     exchange's in-flight memory stays bounded by the byte cap."""

@@ -104,6 +104,24 @@ echo "== fault-injection (chaos) leg =============================="
 env JAX_PLATFORMS=cpu PRESTO_TPU_FAULT_SEED=1234 python -m pytest \
     tests/test_fault_tolerance.py -q -p no:cacheprovider
 
+echo "== protocol-soundness leg ==================================="
+# bounded model checking of the exchange/detector/retry/admission
+# state machines at pinned depths (any reachable invariant violation
+# fails with a replayable counterexample schedule), the seeded-bug
+# mutation fixtures (each must be caught by its named invariant), and
+# a runtime conformance pass: a faulted 2-worker workload's protocol
+# trace replayed through the spec automata
+env JAX_PLATFORMS=cpu python -m pytest tests/test_protocol_soundness.py \
+    -q -p no:cacheprovider
+# replay-from-watermark byte-equality property (q3/q6 under
+# net.duplicate_page / net.drop_ack / worker death) — marked slow, so
+# it runs here rather than in the tier-1 sweep
+env JAX_PLATFORMS=cpu python -m pytest \
+    "tests/test_streaming_exchange.py::test_replay_byte_equality_under_net_faults" \
+    -q -p no:cacheprovider
+env JAX_PLATFORMS=cpu PRESTO_TPU_FAULT_SEED=1234 \
+    python tools/protocol_check.py
+
 echo "== tier-1 tests ============================================="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting before the pass-count

@@ -95,6 +95,19 @@ narrow-cast         A literal narrow integer width (``jnp.int32``/
                     (``Type.np_dtype``); a proven-safe narrow (bounded
                     codes, counts, field ranges) carries
                     ``# lint: allow(narrow-cast)``.
+protocol-state      Direct assignment to a model-checked protocol
+                    state attribute outside its owning transition
+                    method.  The protocol-soundness tier
+                    (analysis/protocols.py, analysis/mcheck.py) proves
+                    invariants over the exchange/detector/retry/
+                    admission state machines assuming ALL transitions
+                    flow through the audited methods — a write from
+                    anywhere else (``h.state = DEAD`` in a helper,
+                    ``ticket.released = True`` in a caller) bypasses
+                    both the invariant guards and the conformance
+                    trace.  The owner map is ``_PROTOCOL_STATE``;
+                    extend it when a protocol grows a new transition
+                    method.
 
 Concurrency check
 -----------------
@@ -202,6 +215,28 @@ _LADDER_MARKERS = {"bucket_capacity", "_cap", "cap", "cap_hi", "capacity",
 
 #: raise types the SQL frontend must not leak to users
 _SPI_RAW_RAISES = {"KeyError", "IndexError", "AssertionError"}
+
+#: protocol-state: the owner map of the model-checked state machines
+#: (analysis/protocols.py).  Key = (owning-file path suffix, attribute
+#: name); value = the transition methods allowed to assign it.  The
+#: attribute names are deliberately scoped to their owning file —
+#: ``.state`` and ``.canceled`` name unrelated machines elsewhere
+#: (coordinator query lifecycle, executor futures).
+_PROTOCOL_STATE: Dict[Tuple[str, str], frozenset] = {
+    # failure detector: WorkerHealth.state only moves via _transition
+    ("parallel/failure.py", "state"): frozenset({"__init__", "_transition"}),
+    # admission tickets: QUEUED -> ADMITTED happens inside the
+    # _wait_for_memory critical section; RELEASED only via release()
+    ("serving/admission.py", "state"): frozenset(
+        {"__init__", "_wait_for_memory", "release"}),
+    ("serving/admission.py", "released"): frozenset({"__init__", "release"}),
+    ("serving/admission.py", "canceled"): frozenset({"__init__", "cancel"}),
+    # exchange buffer: ack watermark / abort / completion flags
+    ("server/buffers.py", "_acked"): frozenset({"__init__", "acknowledge"}),
+    ("server/buffers.py", "_aborted"): frozenset({"__init__", "abort"}),
+    ("server/buffers.py", "_complete"): frozenset(
+        {"__init__", "set_complete", "fail"}),
+}
 
 #: metric-catalog: the ``# metrics: allow`` opt-out comment
 _METRICS_ALLOW_RE = re.compile(r"#\s*metrics:\s*allow")
@@ -362,6 +397,12 @@ class _Linter(ast.NodeVisitor):
         # range(<int literal>) — a Thread() built there is a pool of
         # hard-coded width (the thread-pool rule)
         self._literal_range_depth = 0
+        # protocol-state: the attribute -> allowed-methods map for THIS
+        # file (empty outside the owning modules)
+        norm = path.replace(os.sep, "/")
+        self._protocol_attrs = {
+            attr: allowed for (suffix, attr), allowed
+            in _PROTOCOL_STATE.items() if norm.endswith(suffix)}
 
     # -- helpers -----------------------------------------------------------
     def _emit(self, node: ast.AST, rule: str, message: str) -> None:
@@ -524,6 +565,48 @@ class _Linter(ast.NodeVisitor):
                         "wrap in bucket_capacity() so program "
                         "signatures stay finite")
 
+        self.generic_visit(node)
+
+    # -- protocol-state ----------------------------------------------------
+    def _check_protocol_write(self, node: ast.AST,
+                              targets: List[ast.AST]) -> None:
+        """Assignment targets hitting a model-checked protocol state
+        attribute (``_PROTOCOL_STATE``) outside its owning transition
+        methods — such a write bypasses the invariant guards and the
+        conformance trace of the protocol-soundness tier."""
+        while targets:
+            t = targets.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+                continue
+            if not isinstance(t, ast.Attribute):
+                continue
+            allowed = self._protocol_attrs.get(t.attr)
+            if allowed is None:
+                continue
+            fn = self._fn_stack[-1] if self._fn_stack else "<module>"
+            if fn not in allowed:
+                self._emit(
+                    node, "protocol-state",
+                    f"direct write to protocol state "
+                    f"{ast.unparse(t)} in {fn}() — transitions must go "
+                    f"through {'/'.join(sorted(allowed - {'__init__'}))}"
+                    " so the model-checked invariants and the "
+                    "conformance trace stay sound")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._protocol_attrs:
+            self._check_protocol_write(node, list(node.targets))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self._protocol_attrs:
+            self._check_protocol_write(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if self._protocol_attrs and node.value is not None:
+            self._check_protocol_write(node, [node.target])
         self.generic_visit(node)
 
     def visit_BinOp(self, node: ast.BinOp) -> None:
@@ -747,7 +830,8 @@ class _Linter(ast.NodeVisitor):
 ALL_RULES = {"raw-capacity", "env-read", "traced-branch", "device-sync",
              "block-until-ready", "bare-except", "spi-exception",
              "wallclock", "metric-catalog", "thread-pool",
-             "naked-urlopen", "rule-purity", "narrow-cast"}
+             "naked-urlopen", "rule-purity", "narrow-cast",
+             "protocol-state"}
 
 #: the concurrency sanitizer's detector names (the second check); kept
 #: in sync with analysis/concurrency.CONCURRENCY_RULES by the tests

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Protocol-soundness gate: bounded model checking + runtime conformance.
+
+The one-command proof behind the protocol tier
+(docs/static-analysis.md, "Protocol soundness"):
+
+1. **Exploration** — runs the deterministic schedule explorer
+   (``presto_tpu/analysis/mcheck.py``) over all four protocol models
+   (exchange token/ack/abort, failure detector, fragment-retry budget,
+   admission tickets) to their pinned depths.  Any reachable invariant
+   violation is printed with its replayable counterexample schedule
+   and fails the gate — a protocol bug one interleaving away.
+
+2. **Conformance** — arms ``PRESTO_TPU_PROTOCOL_TRACE=1`` **before**
+   importing presto_tpu, boots a real 2-worker
+   ``DistributedQueryRunner``, and runs a faulted workload: a worker
+   dies mid-query (fragment failover + watermark replay), a results
+   response is duplicated (``net.duplicate_page`` — the client dedupe
+   must swallow it), and acks are dropped (``net.drop_ack`` — replay
+   must stay exactly-once).  Every event the implementation emitted is
+   then replayed through the spec automata
+   (``presto_tpu/analysis/protocols.py``); a rejected trace means the
+   implementation and the model diverged — on THIS machine, under the
+   pinned fault seed.
+
+Exit status: 0 when exploration is clean AND the runtime trace
+conforms; 1 otherwise.
+
+Usage::
+
+    python tools/protocol_check.py            # human summary + verdict
+    python tools/protocol_check.py --json     # full machine report
+    PRESTO_TPU_FAULT_SEED=1234 python tools/protocol_check.py
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# MUST precede any presto_tpu import: the recorder's enable flag is
+# resolved when analysis/protocols.py constructs it at import time
+os.environ["PRESTO_TPU_PROTOCOL_TRACE"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: conformance workload: a multihost aggregation (fragment fan-out,
+#: token/ack exchange), a distributed ORDER BY (per-shard sort +
+#: merge, multiple buffers), and a coordinator-protocol query (REST
+#: statement path -> admission tickets)
+WORKLOAD_MULTIHOST = [
+    "SELECT count(*) FROM lineitem",
+    "SELECT l_returnflag, count(*), sum(l_quantity) FROM lineitem "
+    "GROUP BY l_returnflag ORDER BY l_returnflag",
+    "SELECT l_orderkey, l_extendedprice FROM lineitem "
+    "ORDER BY l_extendedprice DESC, l_orderkey LIMIT 50",
+]
+WORKLOAD_REST = [
+    "SELECT sum(l_extendedprice) FROM lineitem WHERE l_quantity < 24",
+]
+
+
+def run_exploration(seed: int) -> dict:
+    from presto_tpu.analysis.mcheck import PINNED_DEPTHS, explore_all
+
+    results = explore_all(seed=seed)
+    report = {}
+    ok = True
+    for name, r in sorted(results.items()):
+        report[name] = {
+            "depth": PINNED_DEPTHS[name],
+            "states": r.states,
+            "transitions": r.transitions,
+            "hit_state_cap": r.hit_state_cap,
+            "counterexamples": [
+                {"faults": sorted(cex.faults),
+                 "trace": [list(a) for a in cex.trace]}
+                for cex in r.counterexamples],
+        }
+        if not r.ok or r.hit_state_cap:
+            ok = False
+    report["ok"] = ok
+    return report
+
+
+def run_conformance(n_workers: int, sf: float) -> dict:
+    from presto_tpu.analysis.protocols import RECORDER
+    from presto_tpu.testing import DistributedQueryRunner
+    from presto_tpu.testing_faults import FAULTS, arm_from_env
+
+    arm_from_env()  # PRESTO_TPU_FAULT_SEED / PRESTO_TPU_FAULTS
+    RECORDER.reset()
+    rig = DistributedQueryRunner(n_workers=n_workers, sf=sf,
+                                 split_rows=2048)
+    rig.multihost.min_stage_rows = 0  # force breaker stages distributed
+    queries = 0
+    try:
+        # clean pass first: the failover replay below re-pulls from the
+        # survivor, and the detector needs a success history to recover
+        for sql in WORKLOAD_MULTIHOST:
+            rig.execute_multihost(sql)
+            queries += 1
+        # chaos pass: mid-stream worker death (watermark replay),
+        # duplicated results response, dropped acks — the protocol
+        # surfaces the models prove invariants over
+        rig.arm_fault("worker.die_after_n_pages", worker=0, pages=3)
+        rig.execute_multihost(WORKLOAD_MULTIHOST[0])
+        queries += 1
+        FAULTS.disarm_all()
+        # worker 0 is "dead" from the fault above — the net faults go
+        # on the SURVIVOR, whose pulls actually happen
+        rig.arm_fault("net.duplicate_page", worker=1, after=1, count=2)
+        rig.arm_fault("net.drop_ack", worker=1, count=2)
+        for sql in WORKLOAD_MULTIHOST[:2]:
+            rig.execute_multihost(sql)
+            queries += 1
+        FAULTS.disarm_all()
+        # coordinator/REST path: admission tickets + root-stage pull
+        for sql in WORKLOAD_REST:
+            rig.execute(sql)
+            queries += 1
+    finally:
+        FAULTS.disarm_all()
+        rig.close()
+
+    events = RECORDER.events()
+    violations = RECORDER.check()
+    by_protocol = {}
+    for ev in events:
+        by_protocol[ev.protocol] = by_protocol.get(ev.protocol, 0) + 1
+    return {
+        "queries": queries,
+        "events": len(events),
+        "events_dropped": RECORDER.dropped,
+        "by_protocol": by_protocol,
+        "violations": [
+            {"invariant": v.invariant, "key": v.key, "seq": v.seq,
+             "message": v.message}
+            for v in violations],
+        "ok": not violations and not RECORDER.dropped,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="TPC-H scale factor for the conformance rig")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="worker count for the conformance rig")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="exploration schedule seed (0 = deterministic "
+                         "DFS order)")
+    ap.add_argument("--skip-conformance", action="store_true",
+                    help="exploration only (no rig boot — for "
+                         "constrained environments)")
+    args = ap.parse_args(argv)
+
+    explore = run_exploration(args.seed)
+    conform = None
+    if not args.skip_conformance:
+        conform = run_conformance(args.workers, args.sf)
+
+    ok = explore["ok"] and (conform is None or conform["ok"])
+    if args.as_json:
+        print(json.dumps({"exploration": explore, "conformance": conform,
+                          "ok": ok}, indent=2))
+    else:
+        for name, r in sorted(explore.items()):
+            if name == "ok":
+                continue
+            verdict = ("OK" if not r["counterexamples"]
+                       and not r["hit_state_cap"] else "FAIL")
+            print(f"explore {name:<10} depth={r['depth']:<3} "
+                  f"states={r['states']:<7} "
+                  f"transitions={r['transitions']:<8} {verdict}")
+            for cex in r["counterexamples"]:
+                print(f"  counterexample ({len(cex['trace'])} steps): "
+                      f"{cex['faults']}")
+                for step in cex["trace"]:
+                    print(f"    {step}")
+        if conform is not None:
+            print(f"conformance: {conform['queries']} queries, "
+                  f"{conform['events']} events "
+                  f"{conform['by_protocol']}, "
+                  f"{len(conform['violations'])} violation(s)"
+                  + (f", {conform['events_dropped']} DROPPED"
+                     if conform["events_dropped"] else ""))
+            for v in conform["violations"]:
+                print(f"  VIOLATION [{v['invariant']}] {v['key']} "
+                      f"seq={v['seq']}: {v['message']}")
+        print(f"{'OK' if ok else 'FAIL'}: protocol soundness "
+              f"{'holds' if ok else 'violated'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
