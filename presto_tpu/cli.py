@@ -115,6 +115,9 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", default=None,
                     help="force the jax backend (e.g. cpu) — useful when "
                          "the accelerator tunnel is unreachable")
+    ap.add_argument("--doctor", action="store_true",
+                    help="print the query doctor's ranked bottleneck "
+                         "findings after each statement")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -132,7 +135,14 @@ def main(argv=None) -> int:
         def run(sql, line):
             columns, rows = client.execute(
                 sql, on_progress=line.update if line.enabled else None)
-            return [c["name"] for c in columns], rows
+            findings = None
+            if args.doctor and client.last_query_id:
+                try:
+                    findings = client.doctor(
+                        client.last_query_id).get("findings")
+                except Exception:
+                    findings = None  # no telemetry (DDL, old server)
+            return [c["name"] for c in columns], rows, findings
     else:
         from presto_tpu.catalog import Catalog
         from presto_tpu.connectors.tpch import Tpch
@@ -145,7 +155,7 @@ def main(argv=None) -> int:
         def run(sql, line):
             if not line.enabled:
                 res = runner.execute(sql)
-                return res.names, res.rows
+                return res.names, res.rows, getattr(res, "findings", None)
             # embedded: execute on a worker thread and poll the
             # process progress registry from here (the same numbers
             # the statement protocol serves)
@@ -174,13 +184,13 @@ def main(argv=None) -> int:
             if "err" in box:
                 raise box["err"]
             res = box["res"]
-            return res.names, res.rows
+            return res.names, res.rows, getattr(res, "findings", None)
 
     def run_one(sql: str) -> int:
         t0 = time.perf_counter()
         line = _ProgressLine(show_progress)
         try:
-            names, rows = run(sql, line)
+            names, rows, findings = run(sql, line)
         except Exception as e:
             line.clear()
             print(f"error: {e}", file=sys.stderr)
@@ -189,6 +199,10 @@ def main(argv=None) -> int:
         print(format_output(names, rows, args.output_format))
         if args.output_format == "ALIGNED":
             print(f"({len(rows)} rows, {time.perf_counter() - t0:.2f}s)")
+        if args.doctor and findings is not None:
+            from presto_tpu.obs.doctor import format_findings
+
+            print(format_findings(findings), file=sys.stderr)
         return 0
 
     if args.execute:

@@ -21,6 +21,8 @@ class StatementClient:
         # client always receives the server's page (terminal state or
         # nextUri), never a client-side timeout first
         self.timeout = timeout
+        # id of the last executed statement (the CLI's --doctor key)
+        self.last_query_id: Optional[str] = None
 
     def execute(self, sql: str,
                 on_progress=None) -> Tuple[List[dict], List[tuple]]:
@@ -43,6 +45,7 @@ class StatementClient:
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             page = json.load(resp)
+        self.last_query_id = page.get("id")
         if on_progress is not None and page.get("stats"):
             on_progress(page["stats"])
         if page.get("error"):
@@ -77,4 +80,12 @@ class StatementClient:
     def queries(self) -> list:
         with urllib.request.urlopen(f"{self.server_uri}/v1/query",
                                     timeout=10.0) as resp:
+            return json.load(resp)
+
+    def doctor(self, query_id: str) -> dict:
+        """``GET /v1/query/<id>/doctor``: the ranked post-query
+        diagnosis (obs/doctor.py findings)."""
+        with urllib.request.urlopen(
+                f"{self.server_uri}/v1/query/{query_id}/doctor",
+                timeout=10.0) as resp:
             return json.load(resp)

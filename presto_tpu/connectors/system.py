@@ -52,6 +52,7 @@ class SystemConnector:
     def __init__(self, history: QueryHistory,
                  nodes: Optional[Callable[[], List[dict]]] = None,
                  metrics=None, tasks=None, remote_metrics=None,
+                 remote_history=None,
                  pools: Optional[Callable[[], List[dict]]] = None,
                  workers: Optional[Callable[[], List[dict]]] = None,
                  node_id: str = "local"):
@@ -70,6 +71,11 @@ class SystemConnector:
         # 'cluster' rollup row per metric (single-node processes skip
         # the rollup: it would just duplicate the local rows)
         self.remote_metrics = remote_metrics
+        # cluster fan-in for the history ring:
+        # () -> {node: [(ts_ms, name, value), ...]} — the coordinator
+        # wires CoordinatorServer.remote_history here so
+        # system_metrics_history carries every worker's ring
+        self.remote_history = remote_history
         # () -> [{node, reserved, peak, limit, queries}] — defaults to
         # the process pool (memory.default_memory_pool)
         self.pools = pools
@@ -82,6 +88,7 @@ class SystemConnector:
         # need the rows, and polling twice doubles the HTTP fan-out
         # AND risks the page disagreeing with the planned row count
         self._metrics_cache: Optional[Tuple[float, List]] = None
+        self._history_cache: Optional[Tuple[float, List]] = None
 
     SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         "system_runtime_queries": [
@@ -102,6 +109,10 @@ class SystemConnector:
             # result cache, 0 when executed, NULL where the cache does
             # not apply (writes, DDL, uncacheable plans)
             ("cache_hit", BIGINT),
+            # admission-plane waits (serving/admission.py via the query
+            # timeline): NULL when the query bypassed admission or
+            # never blocked on memory headroom
+            ("queued_ms", DOUBLE), ("memory_blocked_ms", DOUBLE),
         ],
         "system_runtime_nodes": [
             ("node_id", VARCHAR), ("state", VARCHAR),
@@ -126,6 +137,15 @@ class SystemConnector:
         ],
         "system_metrics": [
             ("node", VARCHAR), ("name", VARCHAR), ("value", DOUBLE),
+        ],
+        # the in-process metrics-history ring (obs/timeseries.py): one
+        # row per (tick, metric) — gauges raw, counters as rates/s,
+        # histograms as count-rates + p50/p95/p99.  ts_ms is epoch
+        # milliseconds of the tick; the ring is bounded, so this table
+        # is a sliding window, not an archive (docs/observability.md)
+        "system_metrics_history": [
+            ("node", VARCHAR), ("ts_ms", DOUBLE),
+            ("name", VARCHAR), ("value", DOUBLE),
         ],
         # HBM pool accounting per node (memory/ClusterMemoryManager's
         # RemoteNodeMemory view as a table): reserved/peak/limit bytes
@@ -154,6 +174,8 @@ class SystemConnector:
             return len(self.tasks.entries())
         if table == "system_metrics":
             return len(self._metrics_rows())
+        if table == "system_metrics_history":
+            return len(self._history_rows())
         if table == "system_memory_pools":
             return len(self._pool_rows())
         if table == "system_runtime_workers":
@@ -198,6 +220,30 @@ class SystemConnector:
             self._metrics_cache = (time.monotonic(), out)
         return out
 
+    def _history_rows(self) -> List[Tuple[str, float, str, float]]:
+        """(node, ts_ms, name, value) from the local metrics-history
+        ring plus every polled worker's ring.  Same ~1s cache contract
+        as _metrics_rows: bind-time row count and the executed page
+        must see ONE snapshot when a cluster poll is involved."""
+        import time
+
+        from presto_tpu.obs.timeseries import HISTORY
+
+        if self.remote_history is not None and self._history_cache \
+                and time.monotonic() - self._history_cache[0] < 1.0:
+            return self._history_cache[1]
+        out = [(self.node_id, float(ts), n, float(v))
+               for ts, n, v in HISTORY.rows()]
+        if self.remote_history is not None:
+            try:
+                for node, rows in self.remote_history().items():
+                    out += [(str(node), float(ts), str(n), float(v))
+                            for ts, n, v in rows]
+            except Exception:
+                pass  # a dead worker must not fail the system table
+            self._history_cache = (time.monotonic(), out)
+        return out
+
     def _pool_rows(self) -> List[dict]:
         if self.pools is not None:
             try:
@@ -227,6 +273,8 @@ class SystemConnector:
                 [getattr(e, "execution_ms", None) for e in evs],
                 [None if getattr(e, "cache_hit", None) is None
                  else int(e.cache_hit) for e in evs],
+                [getattr(e, "queued_ms", None) for e in evs],
+                [getattr(e, "memory_blocked_ms", None) for e in evs],
             ]
         elif table == "system_runtime_tasks":
             ts = self.tasks.entries()
@@ -247,6 +295,12 @@ class SystemConnector:
             cols = [[node for node, _, _ in snap],
                     [n for _, n, _ in snap],
                     [float(v) for _, _, v in snap]]
+        elif table == "system_metrics_history":
+            hist = self._history_rows()
+            cols = [[node for node, _, _, _ in hist],
+                    [float(ts) for _, ts, _, _ in hist],
+                    [n for _, _, n, _ in hist],
+                    [float(v) for _, _, _, v in hist]]
         elif table == "system_memory_pools":
             ps = self._pool_rows()
             cols = [

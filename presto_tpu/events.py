@@ -53,6 +53,15 @@ class QueryCompletedEvent:
     # from the structural result cache without executing; None for
     # statements the cache does not apply to (writes, DDL)
     cache_hit: Optional[bool] = None
+    # admission-plane waits (serving/admission.py annotates the query
+    # timeline; the runner copies them here): time queued for a
+    # concurrency slot, and time blocked on memory headroom AFTER
+    # admission.  NULL-safe — None when the query bypassed admission.
+    queued_ms: Optional[float] = None
+    memory_blocked_ms: Optional[float] = None
+    # ranked doctor findings (obs/doctor.py as_dict rows) — the query
+    # log's bottleneck attribution; None when diagnosis did not run
+    findings: Optional[list] = None
 
 
 @dataclasses.dataclass

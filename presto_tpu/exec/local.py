@@ -578,7 +578,7 @@ class LocalRunner:
 
     # ------------------------------------------------------------------
     def run(self, plan: PlanNode, query_id: Optional[str] = None) -> MaterializedResult:
-        from presto_tpu.obs import METRICS, span
+        from presto_tpu.obs import METRICS, record_point, span
 
         page = self.run_to_page(plan, query_id=query_id)
         # the result transfer is THE device sync of a local query — a
@@ -588,6 +588,7 @@ class LocalRunner:
             out = page.compact_host()
             rows = out.to_pylist()
         METRICS.counter("device.get_calls").inc()
+        record_point("device.get_calls", 1.0)
         try:
             from presto_tpu.memory import page_bytes
 
@@ -1450,9 +1451,10 @@ class LocalRunner:
             # reported percentage is a running max, so re-runs never
             # regress it).  Rows are padded row SLOTS — counting live
             # rows would force a device sync per split.
-            from presto_tpu.obs import current_progress
+            from presto_tpu.obs import current_progress, current_timeline
 
             prog = current_progress()
+            tl = current_timeline()
             stage_name = None
             if prog is not None:
                 stage_name = prog.new_stage_name(
@@ -1464,6 +1466,11 @@ class LocalRunner:
                 prog.stage(stage_name, splits_total=total)
 
             def _split_mark(page=None):
+                if tl is not None and stage_name is not None:
+                    # one point per finished split, named by stage — the
+                    # timeline's scan-progress track (value is always 1;
+                    # consumers count points, not sum values)
+                    tl.record(f"splits_done.{stage_name}", 1.0)
                 if prog is None:
                     return
                 if page is None:

@@ -107,7 +107,12 @@ def partition_to_host(page: Page, bids: jax.Array, num_buckets: int) -> List[Opt
         sum(d.nbytes + v.nbytes for d, v, _t, _dic in hp.columns)
         for hp in out if hp is not None)
     if spilled:
-        from presto_tpu.obs import METRICS
+        from presto_tpu.obs import METRICS, current_timeline
 
         METRICS.counter("spill.bytes").inc(spilled)
+        tl = current_timeline()
+        if tl is not None:
+            # per-query spill evidence for the doctor's spill-bound rule
+            tl.record("spill.bytes", float(spilled))
+            tl.bump("spill_bytes", spilled)
     return out

@@ -136,6 +136,17 @@ class QueryMemoryContext:
         self._tag_site: Dict[str, tuple] = {}  # tag -> (site, nbytes)
         self._site_current: Dict[str, int] = {}
         self.site_peak: Dict[str, int] = {}
+        # per-query resource timeline, captured at construction on the
+        # query thread: reserve/free also run on split-scheduler worker
+        # threads, where the recording thread-local is not inherited
+        from presto_tpu.obs.timeseries import current_timeline
+
+        self._timeline = current_timeline()
+
+    def _record_reserved(self, reserved_now: int) -> None:
+        tl = self._timeline
+        if tl is not None:
+            tl.record("memory.reserved_bytes", float(reserved_now))
 
     def reserve(self, what: str, nbytes: int, enforce: bool = True) -> str:
         with self._lock:
@@ -148,11 +159,13 @@ class QueryMemoryContext:
         with self._lock:
             self.reserved += nbytes
             self.peak = max(self.peak, self.reserved)
+            reserved_now = self.reserved
             self._tag_site[tag] = (what, nbytes)
             cur = self._site_current.get(what, 0) + nbytes
             self._site_current[what] = cur
             if cur > self.site_peak.get(what, 0):
                 self.site_peak[what] = cur
+        self._record_reserved(reserved_now)
         return tag
 
     def reserve_page(self, what: str, page) -> str:
@@ -163,11 +176,13 @@ class QueryMemoryContext:
         self.pool.free(tag)
         with self._lock:
             self.reserved -= n
+            reserved_now = self.reserved
             entry = self._tag_site.pop(tag, None)
             if entry is not None:
                 site, nbytes = entry
                 self._site_current[site] = (
                     self._site_current.get(site, 0) - nbytes)
+        self._record_reserved(reserved_now)
 
     def headroom(self) -> int:
         """Pool bytes still available — the split scheduler's

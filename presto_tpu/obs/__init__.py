@@ -35,6 +35,18 @@ from presto_tpu.obs.progress import (
     publishing,
     register_progress,
 )
+from presto_tpu.obs.timeseries import (
+    HISTORY,
+    MetricsHistory,
+    QueryTimeline,
+    current_timeline,
+    ensure_timeline,
+    record_point,
+    recording,
+    register_timeline,
+    timeline_for,
+)
+from presto_tpu.obs import doctor
 
 __all__ = [
     "METRICS", "TASKS", "MetricsRegistry", "TaskRegistry",
@@ -45,4 +57,7 @@ __all__ = [
     "openmetrics",
     "QueryProgress", "StageProgress", "current_progress", "progress_for",
     "publishing", "register_progress",
+    "HISTORY", "MetricsHistory", "QueryTimeline", "current_timeline",
+    "ensure_timeline", "record_point", "recording", "register_timeline",
+    "timeline_for", "doctor",
 ]

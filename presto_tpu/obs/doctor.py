@@ -1,0 +1,300 @@
+"""Query doctor: post-query bottleneck diagnosis from retained
+telemetry.
+
+Consumes the trace registry (obs/trace.py), the per-query timeline +
+annotations (obs/timeseries.py) and the progress table, and emits
+RANKED, evidence-carrying findings from a fixed rulebook — nothing
+heuristic is free-floating: every finding names its rule, its score,
+and the numbers that fired it, so "why was this query slow" is
+answerable from retained telemetry alone (the reference's
+QueryStats-driven postmortems, automated).
+
+The rulebook (thresholds are module constants, documented in
+docs/observability.md):
+
+========================  ==================================================
+rule                      fires when
+========================  ==================================================
+``compile-bound``         xla_compile span share of wall >= 25%
+``queue-bound``           admission wait >= 50% of wall (and >= 10ms)
+``memory-blocked``        headroom stall >= 25% of wall (and >= 10ms)
+``spill-bound``           spill bytes >= 25% of input bytes (or any spill
+                          when input is unknown)
+``exchange-backpressure`` producer stall share of wall >= 20%
+``skewed-stage``          per-partition rows max/median >= 4x (max >= 64)
+``straggler-worker``      per-fragment worker time max/median >= 3x
+                          (max >= 50ms, >= 2 workers)
+``scan-bound``            ``*:split`` span share of wall >= 50%
+``fallback-taken``        the distributed tier fell back to the
+                          coordinator (dist_fallback reason present)
+========================  ==================================================
+
+Scores are comparable severities in [0, 1]; findings sort by score so
+the injected dominant cause of a run ranks first (tests pin each rule
+that way).  All inputs are read-only registry lookups — diagnosing a
+finished query costs microseconds and touches no execution state.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Dict, List, Optional
+
+from presto_tpu.obs import trace as _trace
+from presto_tpu.obs import timeseries as _timeseries
+from presto_tpu.obs import progress as _progress
+
+#: rulebook thresholds (docs/observability.md documents each)
+COMPILE_SHARE = 0.25
+QUEUE_SHARE = 0.50
+QUEUE_MIN_MS = 10.0
+MEMORY_SHARE = 0.25
+MEMORY_MIN_MS = 10.0
+SPILL_INPUT_SHARE = 0.25
+STALL_SHARE = 0.20
+SKEW_RATIO = 4.0
+SKEW_MIN_ROWS = 64
+STRAGGLER_RATIO = 3.0
+STRAGGLER_MIN_MS = 50.0
+SCAN_SHARE = 0.50
+FALLBACK_SCORE = 0.95
+
+
+class Finding:
+    """One diagnosis: rule name, severity score, a human summary, and
+    the evidence numbers that fired it."""
+
+    __slots__ = ("rule", "score", "summary", "evidence")
+
+    def __init__(self, rule: str, score: float, summary: str,
+                 evidence: Dict[str, object]):
+        self.rule = rule
+        self.score = max(0.0, min(1.0, float(score)))
+        self.summary = summary
+        self.evidence = evidence
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "score": round(self.score, 3),
+            "summary": self.summary,
+            "evidence": self.evidence,
+        }
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Finding({self.rule!r}, {self.score:.2f})"
+
+
+def _share(part_ms: float, wall_ms: float) -> float:
+    return part_ms / wall_ms if wall_ms > 0 else 0.0
+
+
+def diagnose(
+    query_id: Optional[str] = None,
+    *,
+    tracer=None,
+    timeline=None,
+    progress=None,
+    wall_ms: Optional[float] = None,
+    dist_fallback: Optional[str] = None,
+) -> List[Finding]:
+    """Run the rulebook over whatever telemetry exists for the query.
+    Explicit objects win; otherwise the registries are consulted by
+    ``query_id``.  Rules whose evidence source is absent stay silent —
+    a traceless query can still be diagnosed from its timeline and
+    vice versa."""
+    if tracer is None and query_id:
+        tracer = _trace.lookup(query_id)
+    if timeline is None and query_id:
+        timeline = _timeseries.timeline_for(query_id)
+    if progress is None and query_id:
+        progress = _progress.progress_for(query_id)
+
+    ann: Dict[str, object] = timeline.annotations() if timeline is not None \
+        else {}
+    if dist_fallback is None:
+        dist_fallback = ann.get("dist_fallback")
+
+    span_summary: Dict[str, Dict[str, float]] = (
+        tracer.summary() if tracer is not None else {})
+    if wall_ms is None:
+        w = ann.get("wall_ms")
+        if w is not None:
+            wall_ms = float(w)
+        elif "query" in span_summary:
+            wall_ms = span_summary["query"]["total_ms"]
+        elif "execute" in span_summary:
+            wall_ms = (span_summary["execute"]["total_ms"]
+                       + span_summary.get("plan", {}).get("total_ms", 0.0))
+    wall_ms = float(wall_ms or 0.0)
+
+    findings: List[Finding] = []
+
+    # -- compile-bound --------------------------------------------------
+    compile_ms = span_summary.get("xla_compile", {}).get("total_ms", 0.0)
+    share = _share(compile_ms, wall_ms)
+    if share >= COMPILE_SHARE:
+        findings.append(Finding(
+            "compile-bound", share,
+            f"XLA compilation took {compile_ms:.0f}ms of {wall_ms:.0f}ms "
+            f"wall ({share:.0%}) — warm the program registry or enable "
+            "the persistent cache",
+            {"compile_ms": round(compile_ms, 3),
+             "wall_ms": round(wall_ms, 3), "share": round(share, 3),
+             "compiles": span_summary.get("xla_compile", {}).get("count", 0)},
+        ))
+
+    # -- queue-bound ----------------------------------------------------
+    queued_ms = float(ann.get("queued_ms") or 0.0)
+    if queued_ms >= QUEUE_MIN_MS and queued_ms >= QUEUE_SHARE * wall_ms:
+        findings.append(Finding(
+            "queue-bound", queued_ms / (queued_ms + wall_ms)
+            if (queued_ms + wall_ms) > 0 else 0.0,
+            f"spent {queued_ms:.0f}ms in the admission queue vs "
+            f"{wall_ms:.0f}ms executing — raise admission concurrency or "
+            "spread the burst",
+            {"queued_ms": round(queued_ms, 3),
+             "wall_ms": round(wall_ms, 3)},
+        ))
+
+    # -- memory-blocked -------------------------------------------------
+    blocked_ms = float(ann.get("memory_blocked_ms") or 0.0)
+    if blocked_ms >= MEMORY_MIN_MS and blocked_ms >= MEMORY_SHARE * wall_ms:
+        findings.append(Finding(
+            "memory-blocked", min(1.0, _share(blocked_ms, wall_ms)),
+            f"blocked {blocked_ms:.0f}ms waiting for memory headroom — "
+            "lower concurrency or grow the pool",
+            {"memory_blocked_ms": round(blocked_ms, 3),
+             "wall_ms": round(wall_ms, 3)},
+        ))
+
+    # -- spill-bound ----------------------------------------------------
+    spill_bytes = float(ann.get("spill_bytes") or 0.0)
+    input_bytes = float(ann.get("input_bytes") or 0.0)
+    if input_bytes <= 0 and progress is not None:
+        input_bytes = float(sum(
+            s.get("bytes") or 0 for s in progress.snapshot()["stages"]))
+    if spill_bytes > 0 and (
+            input_bytes <= 0
+            or spill_bytes >= SPILL_INPUT_SHARE * input_bytes):
+        ratio = spill_bytes / max(input_bytes, spill_bytes)
+        findings.append(Finding(
+            "spill-bound", ratio,
+            f"spilled {spill_bytes / 1e6:.1f}MB "
+            f"({ratio:.0%} of input) to host RAM — the working set "
+            "exceeds the pool; grow the limit or reduce concurrency",
+            {"spill_bytes": spill_bytes, "input_bytes": input_bytes,
+             "ratio": round(ratio, 3)},
+        ))
+
+    # -- exchange-backpressure -------------------------------------------
+    stall_ms = float(ann.get("exchange_producer_stall_s") or 0.0) * 1e3
+    share = _share(stall_ms, wall_ms)
+    if share >= STALL_SHARE:
+        findings.append(Finding(
+            "exchange-backpressure", min(1.0, share),
+            f"producers stalled {stall_ms:.0f}ms on the exchange byte cap "
+            f"({share:.0%} of wall) — the consumer lags; raise "
+            "exchange_buffer_bytes or speed the consuming stage",
+            {"producer_stall_ms": round(stall_ms, 3),
+             "wall_ms": round(wall_ms, 3), "share": round(share, 3)},
+        ))
+
+    # -- skewed-stage ----------------------------------------------------
+    partition_rows = ann.get("partition_rows") or {}
+    worst = None  # (ratio, stage, mx, med)
+    for stage, series in partition_rows.items():
+        counts: List[float] = []
+        for entry in series:
+            counts.extend(float(c) for c in entry)
+        live = [c for c in counts if c >= 0]
+        if len(live) < 2 or not any(live):
+            continue
+        mx = max(live)
+        med = statistics.median(live)
+        ratio = mx / max(med, 1.0)
+        if mx >= SKEW_MIN_ROWS and ratio >= SKEW_RATIO:
+            if worst is None or ratio > worst[0]:
+                worst = (ratio, stage, mx, med)
+    if worst is not None:
+        ratio, stage, mx, med = worst
+        findings.append(Finding(
+            "skewed-stage", min(1.0, ratio / (4 * SKEW_RATIO)),
+            f"stage {stage} is skewed: busiest partition holds {mx:.0f} "
+            f"rows vs median {med:.0f} ({ratio:.1f}x) — a hot key "
+            "serializes the stage on one device",
+            {"stage": stage, "max_rows": mx, "median_rows": med,
+             "ratio": round(ratio, 2)},
+        ))
+
+    # -- straggler-worker -------------------------------------------------
+    fragment_ms = ann.get("fragment_ms") or {}
+    totals = {w: float(sum(v)) for w, v in fragment_ms.items() if v}
+    if len(totals) >= 2:
+        mx_worker = max(totals, key=totals.get)
+        mx = totals[mx_worker]
+        med = statistics.median(totals.values())
+        ratio = mx / max(med, 1e-9)
+        if mx >= STRAGGLER_MIN_MS and ratio >= STRAGGLER_RATIO:
+            findings.append(Finding(
+                "straggler-worker", min(1.0, ratio / (4 * STRAGGLER_RATIO)),
+                f"worker {mx_worker} took {mx:.0f}ms vs median "
+                f"{med:.0f}ms ({ratio:.1f}x) — a straggler gates the "
+                "stage; see docs/fault-tolerance.md (speculation)",
+                {"worker": mx_worker, "max_ms": round(mx, 3),
+                 "median_ms": round(med, 3), "ratio": round(ratio, 2),
+                 "per_worker_ms": {w: round(v, 3)
+                                   for w, v in totals.items()}},
+            ))
+
+    # -- scan-bound -------------------------------------------------------
+    split_ms = sum(e["total_ms"] for name, e in span_summary.items()
+                   if name.endswith(":split"))
+    share = _share(split_ms, wall_ms)
+    if share >= SCAN_SHARE:
+        findings.append(Finding(
+            "scan-bound", min(0.9, share),
+            f"split execution took {split_ms:.0f}ms of {wall_ms:.0f}ms "
+            f"wall ({share:.0%}) — the query is scan-dominated; raise "
+            "task concurrency/prefetch or prune with predicates",
+            {"split_ms": round(split_ms, 3), "wall_ms": round(wall_ms, 3),
+             "share": round(share, 3)},
+        ))
+
+    # -- fallback-taken ---------------------------------------------------
+    if dist_fallback:
+        findings.append(Finding(
+            "fallback-taken", FALLBACK_SCORE,
+            "distributed execution fell back to the coordinator: "
+            f"{dist_fallback}",
+            {"reason": str(dist_fallback)},
+        ))
+
+    findings.sort(key=lambda f: f.score, reverse=True)
+    return findings
+
+
+def report(query_id: str) -> Dict[str, object]:
+    """The ``/v1/query/<id>/doctor`` body: findings stored at query
+    completion when present (the runner annotates them), else a fresh
+    diagnosis from whatever the registries still hold."""
+    timeline = _timeseries.timeline_for(query_id)
+    stored = timeline.annotation("findings") if timeline is not None else None
+    if stored is not None:
+        return {"queryId": query_id, "findings": stored}
+    return {"queryId": query_id,
+            "findings": [f.as_dict() for f in diagnose(query_id)]}
+
+
+def format_findings(findings: List[Dict[str, object]],
+                    indent: str = "  ") -> str:
+    """The human rendering shared by EXPLAIN ANALYZE VERBOSE's
+    ``diagnosis:`` block and the CLI ``--doctor`` flag."""
+    if not findings:
+        return "diagnosis: no findings (nothing crossed a threshold)"
+    lines = ["diagnosis:"]
+    for i, f in enumerate(findings, 1):
+        d = f.as_dict() if isinstance(f, Finding) else f
+        lines.append(f"{indent}{i}. {d['rule']} "
+                     f"(score {d['score']:.2f}): {d['summary']}")
+    return "\n".join(lines)

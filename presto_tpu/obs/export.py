@@ -158,7 +158,8 @@ class QueryLogListener(EventListener):
         }
         for k in ("error", "trace_token", "dist_stages", "dist_fallback",
                   "planning_ms", "compile_ms", "execution_ms",
-                  "cache_hit"):
+                  "cache_hit", "queued_ms", "memory_blocked_ms",
+                  "findings"):
             v = getattr(e, k, None)
             if v is not None:
                 rec[k] = v

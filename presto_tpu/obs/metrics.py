@@ -102,7 +102,19 @@ class Histogram:
             for k, n in enumerate(self.buckets):
                 if n:
                     out.append((f"{self.name}.bucket_le_{1 << k}", float(n)))
+            count, buckets = self.count, list(self.buckets)
+        if count:
+            # derived quantiles ride the flat rows so system_metrics and
+            # the ?format=json twin carry them; note merge_rows SUMS
+            # across nodes — per-node reads are the meaningful ones
+            out.extend((f"{self.name}.{p}", v)
+                       for p, v in bucket_percentiles(buckets, count).items())
         return out
+
+    def percentiles(self) -> Dict[str, float]:
+        """Current p50/p95/p99 upper-bound estimates (doctor evidence)."""
+        count, _, buckets = self.snapshot_raw()
+        return bucket_percentiles(buckets, count)
 
     def snapshot_raw(self) -> Tuple[int, float, List[int]]:
         """(count, sum, per-bucket counts) under one lock acquisition —
@@ -110,6 +122,33 @@ class Histogram:
         cumulative ``_bucket`` series."""
         with self._lock:
             return self.count, self.total, list(self.buckets)
+
+
+def bucket_percentiles(
+    buckets: List[int], count: int,
+    qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
+) -> Dict[str, float]:
+    """{"p50": v, ...} from log2 bucket counts.  Each estimate is the
+    UPPER bound (2^k) of the bucket containing the quantile rank — a
+    deterministic, allocation-free derivation whose error is bounded by
+    the bucket width (one octave), the Monarch/Prometheus fixed-bucket
+    tradeoff.  Empty histograms report 0."""
+    out: Dict[str, float] = {}
+    for q in qs:
+        label = f"p{int(round(q * 100))}"
+        if count <= 0:
+            out[label] = 0.0
+            continue
+        rank = q * count
+        cum = 0
+        value = float(1 << (len(buckets) - 1))
+        for k, n in enumerate(buckets):
+            cum += n
+            if cum >= rank:
+                value = float(1 << k)
+                break
+        out[label] = value
+    return out
 
 
 class MetricsRegistry:

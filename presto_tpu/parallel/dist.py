@@ -714,9 +714,14 @@ class DistributedRunner:
         """One shard_map'd window program over the stacked per-device
         bucket pages (each device holds complete partitions)."""
         from presto_tpu.exec.local import bucket_capacity
+        from presto_tpu.obs import current_timeline
 
         src_channels = node.source.channels
         rows = [sum(r for _, _, r in parts) for parts in buckets]
+        tl = current_timeline()
+        if tl is not None:
+            # per-partition row counts: the doctor's skew evidence
+            tl.extend("partition_rows", "dist:window", rows)
         cap = bucket_capacity(max(max(rows), 1))
         # empty buckets mirror a non-empty bucket's column shapes/dtypes
         # (multi-dim blocks, e.g. long-decimal limbs, must stack evenly)
@@ -1415,7 +1420,17 @@ class DistributedRunner:
             rec, fill = bw_fn(stacked, consts_r)
             received.append(rec)
             fills.append(fill)
-        peak = max(int(np.asarray(jax.device_get(f)).max()) for f in fills)
+        from presto_tpu.obs import current_timeline
+
+        fill_rows = [int(v) for f in fills
+                     for v in np.asarray(jax.device_get(f)).reshape(-1)]
+        peak = max(fill_rows)
+        tl = current_timeline()
+        if tl is not None:
+            # per-device build fills after the repartitioning exchange —
+            # the only host-visible per-partition counts of the sharded
+            # join (the probe exchange lives inside the jitted program)
+            tl.extend("partition_rows", "dist:join-build", fill_rows)
         if peak > bcap:
             raise _BuildOverflow(1 << (peak - 1).bit_length())
 

@@ -761,12 +761,21 @@ class MultiHostRunner:
                     fragment_root, pre, chunk)[skip:])
 
         errors: List[BaseException] = []
+        # timeline captured on the scheduling thread: run_on executes on
+        # mh-chunk-* threads, which never inherit the recording TLS
+        from presto_tpu.obs import current_timeline
+
+        tl = current_timeline()
 
         def run_on(w: WorkerClient, chunk, fragment: dict):
+            t0 = time.perf_counter()
             try:
                 raws = w.run_fragment(fragment)
                 with lock:
                     results.extend(raws)
+                if tl is not None:
+                    tl.extend("fragment_ms", w.uri,
+                              (time.perf_counter() - t0) * 1e3)
             except ConnectionError:
                 with lock:
                     failed.append(chunk)
@@ -898,8 +907,14 @@ class MultiHostRunner:
         results: List[bytes] = []
         errors: List[Exception] = []
         lock = named_lock("multihost._fan_out_stage2.lock")
+        # timeline captured on the scheduling thread: run_one executes
+        # on mh-stage2-* threads, which never inherit the recording TLS
+        from presto_tpu.obs import current_timeline
+
+        tl = current_timeline()
 
         def run_one(w: WorkerClient, k: int):
+            t0 = time.perf_counter()
             try:
                 tid = w.create_task(make_frag(k))
                 with lock:
@@ -907,6 +922,9 @@ class MultiHostRunner:
                 raws = w.pull_results(tid)
                 with lock:
                     results.extend(raws)
+                if tl is not None:
+                    tl.extend("fragment_ms", w.uri,
+                              (time.perf_counter() - t0) * 1e3)
             except Exception as e:
                 with lock:
                     errors.append(e)
@@ -1334,12 +1352,23 @@ class MultiHostRunner:
             return pages
 
         errors: List[BaseException] = []
+        # timeline captured on the scheduling thread: run_on executes on
+        # mh-fragment-* threads, which never inherit the recording TLS
+        from presto_tpu.obs import current_timeline
+
+        tl = current_timeline()
 
         def run_on(w: WorkerClient, splits: List[int], fragment: dict):
+            t0 = time.perf_counter()
             try:
                 raws = w.run_fragment(fragment)
                 with lock:
                     results.extend(raws)
+                if tl is not None:
+                    # per-worker wall time: the doctor's straggler
+                    # evidence (fragment_ms keyed by worker uri)
+                    tl.extend("fragment_ms", w.uri,
+                              (time.perf_counter() - t0) * 1e3)
                 if prog is not None:
                     prog.split_done(prog_stage, n=len(splits),
                                     nbytes=sum(len(r) for r in raws))
@@ -1501,10 +1530,20 @@ class MultiHostRunner:
 
             return emit
 
+        # timeline captured on the consumer thread: run_on executes on
+        # mh-stream-pull-* threads, which never inherit the recording TLS
+        from presto_tpu.obs import current_timeline
+
+        tl = current_timeline()
+
         def run_on(slot: int, w: WorkerClient, item, fragment: dict):
+            t0 = time.perf_counter()
             try:
                 self._pull_fragment_pages(
                     w, fragment, emit_into(stream.put, slot), dicts)
+                if tl is not None:
+                    tl.extend("fragment_ms", w.uri,
+                              (time.perf_counter() - t0) * 1e3)
                 if prog is not None:
                     prog.split_done(prog_stage, n=prog_n(item))
             except _StreamBroken as e:

@@ -71,6 +71,14 @@ class PageStream:
         self.bytes_in = 0
         self.peak_bytes = 0
         self.closed = False
+        # query timeline, captured HERE because streams are constructed
+        # on the consumer thread (inside the query's recording scope)
+        # while put() runs on producer threads that never inherit the
+        # activation thread-local
+        from presto_tpu.obs.timeseries import current_timeline
+
+        self._timeline = current_timeline()
+        self._stall_seen = 0.0
         _LIVE.add(self)
         _register(self)
 
@@ -88,6 +96,18 @@ class PageStream:
                 self.peak_bytes = b
         METRICS.counter("exchange.stream_pages_total").inc()
         METRICS.counter("exchange.stream_bytes_total").inc(size)
+        tl = self._timeline
+        if tl is not None:
+            tl.record("exchange.buffered_bytes", float(b))
+            # producer stall accumulates on the buffer; publish only the
+            # delta since this stream last looked, so multiple streams
+            # on one timeline stay additive
+            stalled = self.buffer.stall_seconds
+            with self._stats_lock:
+                delta = stalled - self._stall_seen
+                self._stall_seen = stalled
+            if delta > 0:
+                tl.bump("exchange_producer_stall_s", delta)
 
     def producer_done(self) -> None:
         self.buffer.set_complete()

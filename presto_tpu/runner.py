@@ -198,12 +198,17 @@ class QueryRunner:
             # CLI and UI read it) — publication is one thread-local
             # read per split when nothing else is active
             progress = obs.register_progress(obs.QueryProgress(qid))
+            # resource timeline: admission may have created it already
+            # (queue-depth points + queued/blocked annotations land
+            # before execution starts); None when timelines are off
+            timeline = obs.ensure_timeline(qid)
             self.events.query_created(
                 QueryCreatedEvent(qid, sql, self.session.user, t0, trace_token=trace)
             )
             planning_s: Optional[float] = None
             cache_hit: Optional[bool] = None
-            with obs.tracing(tracer), obs.publishing(progress):
+            with obs.tracing(tracer), obs.publishing(progress), \
+                    obs.recording(timeline):
                 try:
                     t1 = time.perf_counter()
                     with obs.span("plan", cat="lifecycle"):
@@ -277,16 +282,41 @@ class QueryRunner:
             # (the admission controller's projection source for the
             # next run of this statement)
             res.cache_hit = cache_hit
+            res.query_id = qid  # embedded callers (CLI --doctor) key
+            # the timeline/doctor registries off the result itself
             res.peak_bytes = (0 if cache_hit
                               else getattr(self.executor,
                                            "last_peak_bytes", 0))
             self._finalize_trace(tracer, t_q0)
+            # post-query diagnosis (obs/doctor.py): ranked findings from
+            # the rulebook over trace + timeline + progress; they ride
+            # the result (statement protocol), the timeline (the
+            # /v1/query/<id>/doctor endpoint) and the completion event
+            # (query-log `findings` field)
+            wall_ms = ((res.planning_ms or 0.0) + (res.execution_ms or 0.0))
+            queued_ms = memory_blocked_ms = None
+            if timeline is not None:
+                timeline.annotate("wall_ms", wall_ms)
+                if dist_fallback:
+                    timeline.annotate("dist_fallback", dist_fallback)
+                queued_ms = timeline.annotation("queued_ms")
+                memory_blocked_ms = timeline.annotation("memory_blocked_ms")
+            findings = [f.as_dict() for f in obs.doctor.diagnose(
+                qid, tracer=tracer, timeline=timeline, progress=progress,
+                wall_ms=wall_ms, dist_fallback=dist_fallback)]
+            if timeline is not None:
+                timeline.annotate("findings", findings)
+            res.findings = findings
+            res.queued_ms = queued_ms
+            res.memory_blocked_ms = memory_blocked_ms
             self.events.query_completed(QueryCompletedEvent(
                 qid, sql, self.session.user, "FINISHED", t0, time.time(),
                 rows=len(res.rows), trace_token=trace,
                 dist_stages=dist_stages, dist_fallback=dist_fallback,
                 planning_ms=res.planning_ms, compile_ms=compile_ms,
                 execution_ms=res.execution_ms, cache_hit=cache_hit,
+                queued_ms=queued_ms, memory_blocked_ms=memory_blocked_ms,
+                findings=findings,
             ))
             return res
 
@@ -326,7 +356,29 @@ class QueryRunner:
                         self.session.get("distributed_min_stage_rows")))
                 return MaterializedResult(["Query Plan"], [VARCHAR], [(text,)])
             if stmt.analyze and getattr(stmt, "verbose", False):
-                text = self.executor.explain_analyze_verbose(plan)
+                # the verbose re-execution runs under its own tracer +
+                # timeline so the doctor can append a `diagnosis:` block
+                # (EXPLAIN has no client query id; a synthetic one keys
+                # the registries like any other query)
+                from presto_tpu import obs
+                from presto_tpu.events import new_query_id
+
+                qid = query_id or new_query_id()
+                tracer = obs.register(obs.Tracer(qid))
+                timeline = obs.ensure_timeline(qid)
+                progress = obs.register_progress(obs.QueryProgress(qid))
+                t1 = time.perf_counter()
+                with obs.tracing(tracer), obs.publishing(progress), \
+                        obs.recording(timeline):
+                    text = self.executor.explain_analyze_verbose(plan)
+                wall_ms = (time.perf_counter() - t1) * 1e3
+                progress.mark_done()
+                findings = [f.as_dict() for f in obs.doctor.diagnose(
+                    qid, tracer=tracer, timeline=timeline,
+                    progress=progress, wall_ms=wall_ms)]
+                if timeline is not None:
+                    timeline.annotate("findings", findings)
+                text = obs.doctor.format_findings(findings) + "\n" + text
             elif stmt.analyze:
                 stats = QueryStats()
                 stats.register_plan(plan)

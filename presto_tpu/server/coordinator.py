@@ -137,6 +137,21 @@ async function detail(id){
   } else if (!pr.ok) {
     html += '<div>no progress or trace recorded for this query</div>';
   }
+  const dr = await fetch(`/v1/query/${id}/doctor`);
+  if (dr.ok){
+    // post-query diagnosis (obs/doctor.py): ranked bottleneck findings
+    const d = await dr.json();
+    if (d.findings && d.findings.length){
+      html += '<h2>diagnosis</h2><table><thead><tr><th>#</th><th>rule</th>'+
+              '<th>score</th><th>summary</th></tr></thead><tbody>';
+      d.findings.forEach((f,i)=>{
+        html += `<tr><td>${i+1}</td><td>${f.rule}</td>`+
+          `<td>${Number(f.score).toFixed(2)}</td>`+
+          `<td class="q">${String(f.summary).replace(/</g,'&lt;')}</td></tr>`;
+      });
+      html += '</tbody></table>';
+    }
+  }
   box.innerHTML = html; box.style.display='block';
 }
 refresh(); setInterval(refresh, 2000);
@@ -183,6 +198,11 @@ class _QueryState:
         self.error_code: Optional[str] = None
         # serving-tier result provenance (statement stats cacheHit)
         self.cache_hit: Optional[bool] = None
+        # admission-plane waits + doctor findings (NULL-safe, copied
+        # off the result like the stage times above)
+        self.queued_ms: Optional[float] = None
+        self.memory_blocked_ms: Optional[float] = None
+        self.findings: Optional[list] = None
         # live queue position served while QUEUED (filled per response)
         self.queue_position: Optional[int] = None
 
@@ -295,6 +315,8 @@ class CoordinatorServer:
             if isinstance(conn, SystemConnector):
                 if conn.remote_metrics is None:
                     conn.remote_metrics = self.remote_metrics
+                if conn.remote_history is None:
+                    conn.remote_history = self.remote_history
                 if conn.pools is None:
                     conn.pools = self.memory_pool_rows
                 if conn.workers is None:
@@ -305,6 +327,7 @@ class CoordinatorServer:
 
         self._metrics_poll_health = PollHealth("worker metrics")
         self._memory_poll_health = PollHealth("worker memory")
+        self._history_poll_health = PollHealth("worker history")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -405,6 +428,13 @@ class CoordinatorServer:
                         self.end_headers()
                         self.wfile.write(body)
                     return
+                if len(parts) == 3 and parts[:2] == ["v1", "metrics"] \
+                        and parts[2].split("?")[0] == "history":
+                    # cluster metrics history: the local ring plus every
+                    # worker's, keyed by node (system_metrics_history's
+                    # HTTP twin)
+                    self._json(200, outer.metrics_history())
+                    return
                 if parts == ["v1", "info"]:
                     self._json(200, {
                         "nodeVersion": {"version": __version__},
@@ -455,6 +485,34 @@ class CoordinatorServer:
                         return
                     self._json(200, obs.chrome_trace(tracer))
                     return
+                if len(parts) == 4 and parts[:2] == ["v1", "query"] \
+                        and parts[3] == "timeline":
+                    # per-query resource timeline (obs/timeseries.py):
+                    # bounded (ts_ms, metric, value) points + the
+                    # annotation dict the doctor consumes
+                    from presto_tpu import obs
+
+                    tl = obs.timeline_for(parts[2])
+                    if tl is None:
+                        self._json(404, {"error": "no timeline for "
+                                                  f"query {parts[2]}"})
+                        return
+                    self._json(200, tl.snapshot())
+                    return
+                if len(parts) == 4 and parts[:2] == ["v1", "query"] \
+                        and parts[3] == "doctor":
+                    # post-query diagnosis: findings stored at
+                    # completion, else a fresh run over the registries
+                    from presto_tpu import obs
+
+                    if obs.timeline_for(parts[2]) is None \
+                            and obs.lookup(parts[2]) is None \
+                            and obs.progress_for(parts[2]) is None:
+                        self._json(404, {"error": "no telemetry for "
+                                                  f"query {parts[2]}"})
+                        return
+                    self._json(200, obs.doctor.report(parts[2]))
+                    return
                 if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
                     qid, token = parts[2], int(parts[3])
                     q = outer.queries.get(qid)
@@ -503,11 +561,26 @@ class CoordinatorServer:
             self.memory_manager.start()
         if self.worker_uris:
             self.failure_detector.start()
+        # serving processes keep a metrics-history ring by default
+        # (1s cadence unless PRESTO_TPU_METRICS_HISTORY_MS overrides);
+        # only the server that armed the process singleton stops it
+        from presto_tpu.obs.timeseries import HISTORY
+
+        with self._lock:
+            self._history_owner = (not HISTORY.running
+                                   and HISTORY.start(default_ms=1000))
 
     def stop(self, drain_timeout: float = 30.0) -> None:
         self.failure_detector.stop()
         if self.memory_manager is not None:
             self.memory_manager.stop()
+        from presto_tpu.obs.timeseries import HISTORY
+
+        with self._lock:
+            owner = getattr(self, "_history_owner", False)
+            self._history_owner = False
+        if owner:
+            HISTORY.stop()
         if self._thread.is_alive():  # shutdown() blocks unless serving
             self.httpd.shutdown()
         self.httpd.server_close()
@@ -702,6 +775,10 @@ class CoordinatorServer:
                 q.compile_ms = getattr(res, "compile_ms", None)
                 q.execution_ms = getattr(res, "execution_ms", None)
                 q.cache_hit = getattr(res, "cache_hit", None)
+                q.queued_ms = getattr(res, "queued_ms", None)
+                q.memory_blocked_ms = getattr(res, "memory_blocked_ms",
+                                              None)
+                q.findings = getattr(res, "findings", None)
                 # observed peak feeds the admission controller's memory
                 # projection for the NEXT run of this statement
                 self.admission.record_peak(
@@ -791,6 +868,12 @@ class CoordinatorServer:
         # serving tier: result provenance (structural result cache)
         if q.cache_hit is not None:
             out["stats"]["cacheHit"] = q.cache_hit
+        # admission-plane waits (mirrors system_runtime_queries'
+        # queued_ms/memory_blocked_ms columns; absent when NULL)
+        if q.queued_ms is not None:
+            out["stats"]["queuedMs"] = q.queued_ms
+        if q.memory_blocked_ms is not None:
+            out["stats"]["memoryBlockedMs"] = q.memory_blocked_ms
         # live queue position while waiting for admission (1-based;
         # also cached on the state object for /v1/query summaries)
         if q.state == "QUEUED":
@@ -852,6 +935,38 @@ class CoordinatorServer:
                 (n, float(v)) for n, v in payload.get("metrics", [])]
             for uri, payload in payloads.items()
         }
+
+    def remote_history(self) -> Dict[str, List]:
+        """Poll every worker's ``/v1/metrics/history`` concurrently —
+        the fan-in behind system_metrics_history's per-node rows and
+        the coordinator's merged history endpoint."""
+        from presto_tpu.net import poll_each, request_json
+
+        payloads = poll_each(
+            self.worker_uris,
+            lambda uri: request_json(
+                f"{uri}/v1/metrics/history", timeout=2.0,
+                site="cluster.metrics_poll_errors"),
+            health=self._history_poll_health)
+        return {
+            payload.get("node") or uri: [
+                (float(ts), str(n), float(v))
+                for ts, n, v in payload.get("rows", [])]
+            for uri, payload in payloads.items()
+        }
+
+    def metrics_history(self) -> dict:
+        """``GET /v1/metrics/history``: the local ring plus every
+        worker's, keyed by node id (the cluster-merged twin of the
+        worker endpoint's single-node body)."""
+        from presto_tpu.obs.timeseries import HISTORY
+
+        nodes: Dict[str, List] = {
+            "local": [[ts, n, v] for ts, n, v in HISTORY.rows()]}
+        if self.worker_uris:
+            for node, rows in self.remote_history().items():
+                nodes[node] = [[ts, n, v] for ts, n, v in rows]
+        return {"intervalMs": HISTORY.interval_ms, "nodes": nodes}
 
     def memory_pool_rows(self) -> List[dict]:
         """system_memory_pools rows for this cluster: the local pool +

@@ -201,6 +201,17 @@ class WorkerServer:
                         self._send(200, openmetrics.render().encode(),
                                    ctype=openmetrics.CONTENT_TYPE)
                     return
+                if self.path.split("?")[0] == "/v1/metrics/history":
+                    from presto_tpu.obs.timeseries import HISTORY
+
+                    self._send(200, json.dumps({
+                        "node": outer.node_id,
+                        "intervalMs": HISTORY.interval_ms,
+                        "ticks": HISTORY.tick_count(),
+                        "rows": [[ts, n, v]
+                                 for ts, n, v in HISTORY.rows()],
+                    }).encode())
+                    return
                 m = _RESULTS_RE.match(self.path.split("?")[0])
                 if m:
                     outer._expire_tasks()
@@ -513,11 +524,29 @@ class WorkerServer:
     # ------------------------------------------------------------------
     def start(self) -> None:
         self._thread.start()
+        # serving processes keep a metrics-history ring by default
+        # (1s cadence unless PRESTO_TPU_METRICS_HISTORY_MS overrides);
+        # the process singleton may already be armed by a co-resident
+        # coordinator — only the server that armed it stops it
+        from presto_tpu.obs.timeseries import HISTORY
+
+        with self._tasks_lock:
+            self._history_owner = (not HISTORY.running
+                                   and HISTORY.start(default_ms=1000))
 
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
         self.executor.shutdown(wait=False)
+        # stop() may run from a drain thread while start() ran on the
+        # coordinator thread — settle ownership under the lock
+        from presto_tpu.obs.timeseries import HISTORY
+
+        with self._tasks_lock:
+            owner = getattr(self, "_history_owner", False)
+            self._history_owner = False
+        if owner:
+            HISTORY.stop()
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown: refuse visibility as ACTIVE, wait for

@@ -79,7 +79,7 @@ class AdmissionTicket:
 
     __slots__ = ("query_id", "user", "group", "priority", "seq", "state",
                  "projected_bytes", "queued_at", "admitted_at", "released",
-                 "canceled")
+                 "canceled", "memory_blocked_s")
 
     def __init__(self, query_id: str, user: str, priority: int = 0):
         self.query_id = query_id
@@ -93,6 +93,9 @@ class AdmissionTicket:
         self.admitted_at: Optional[float] = None
         self.released = False
         self.canceled = False
+        # seconds this ticket spent blocked on memory headroom AFTER
+        # winning its concurrency slot (0.0 when the gate never blocked)
+        self.memory_blocked_s = 0.0
 
     def queued_ms(self) -> float:
         end = self.admitted_at if self.admitted_at is not None \
@@ -257,6 +260,7 @@ class AdmissionController:
         METRICS.counter("admission.admitted_total").inc()
         METRICS.histogram("admission.queue_wait_ms").observe(
             ticket.queued_ms())
+        self._annotate_timeline(ticket)
         self._emit_admitted(ticket)
         return ticket
 
@@ -349,8 +353,10 @@ class AdmissionController:
                     else min(_MEM_POLL_S, remaining)
                 self._cond.wait(timeout=wait)
         if blocked:
+            stalled = time.monotonic() - t0
+            ticket.memory_blocked_s = stalled
             METRICS.counter("admission.memory_stall_seconds_total").inc(
-                time.monotonic() - t0)
+                stalled)
 
     # -- release ------------------------------------------------------------
     def release(self, ticket: Optional[AdmissionTicket]) -> None:
@@ -390,6 +396,27 @@ class AdmissionController:
         with self._cond:
             self._tickets.pop(ticket.query_id, None)
             self._cond.notify_all()
+
+    def _annotate_timeline(self, ticket: AdmissionTicket) -> None:
+        """Stamp the admission-plane waits on the query's resource
+        timeline (obs/timeseries.py) so the doctor's queue-bound and
+        memory-blocked rules have per-query evidence rather than only
+        the process-wide counters.  Creating the timeline here — the
+        runner's later ensure_timeline is get-or-create — makes the
+        admission wait part of the query's recorded life."""
+        try:
+            from presto_tpu import obs
+
+            tl = obs.ensure_timeline(ticket.query_id)
+            if tl is None:
+                return
+            tl.annotate("queued_ms", ticket.queued_ms())
+            if ticket.memory_blocked_s > 0:
+                tl.annotate("memory_blocked_ms",
+                            round(ticket.memory_blocked_s * 1e3, 3))
+            tl.record("admission.queue_depth", float(self.queue_depth()))
+        except Exception:
+            pass  # telemetry must never block admission
 
     # -- events -------------------------------------------------------------
     def _emit_queued(self, ticket: AdmissionTicket) -> None:

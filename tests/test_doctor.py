@@ -1,0 +1,580 @@
+"""Query doctor + telemetry history: rulebook diagnosis, the metrics
+history ring, per-query timelines, and their SQL/REST surfaces.
+
+Every rulebook rule (obs/doctor.py) is pinned twice:
+
+1. deterministically — synthetic evidence that makes the rule the
+   TOP-ranked finding, asserting rule name, rank, and the evidence
+   numbers it carries, and
+2. end-to-end where the engine can produce the evidence cheaply — a
+   cold run (compile-bound), a tiny pool (spill-bound), an admission
+   burst (queue-bound / memory-blocked), a skewed join key on the
+   device mesh (skewed-stage), a slowed worker (straggler-worker).
+
+Plus: ring bounds/eviction for both retention planes, the
+``system_metrics_history`` table, and the coordinator's
+``/v1/metrics/history`` / ``/v1/query/<id>/timeline`` /
+``/v1/query/<id>/doctor`` endpoints."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu import obs
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.system import QueryHistory, SystemConnector
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.obs import doctor
+from presto_tpu.obs.timeseries import (
+    HISTORY,
+    MetricsHistory,
+    QueryTimeline,
+    ensure_timeline,
+    record_point,
+    recording,
+    timeline_for,
+    timelines_enabled,
+)
+from presto_tpu.runner import QueryRunner
+
+
+def make_runner(sf=0.001, split_rows=4096):
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=sf, split_rows=split_rows))
+    history = QueryHistory()
+    catalog.register("system", SystemConnector(history))
+    runner = QueryRunner(catalog)
+    runner.events.add(history)
+    return runner, history
+
+
+class _StubTracer:
+    """diagnose() only consults tracer.summary()."""
+
+    def __init__(self, summary):
+        self._summary = summary
+
+    def summary(self):
+        return self._summary
+
+
+def _tl(qid="q_doc", **annotations):
+    tl = QueryTimeline(qid)
+    for k, v in annotations.items():
+        tl.annotate(k, v)
+    return tl
+
+
+# ---------------------------------------------------------------------------
+# rulebook: every rule ranks FIRST under deterministic evidence
+# ---------------------------------------------------------------------------
+
+def test_compile_bound_ranks_first():
+    tracer = _StubTracer({"xla_compile": {"total_ms": 800.0, "count": 3}})
+    fs = doctor.diagnose(tracer=tracer, wall_ms=1000.0)
+    assert fs and fs[0].rule == "compile-bound"
+    ev = fs[0].evidence
+    assert ev["compile_ms"] == 800.0
+    assert ev["share"] == pytest.approx(0.8)
+    assert ev["compiles"] == 3
+
+
+def test_queue_bound_ranks_first():
+    fs = doctor.diagnose(timeline=_tl(queued_ms=900.0), wall_ms=100.0)
+    assert fs and fs[0].rule == "queue-bound"
+    assert fs[0].evidence["queued_ms"] == 900.0
+    assert fs[0].score == pytest.approx(0.9)
+
+
+def test_memory_blocked_ranks_first():
+    fs = doctor.diagnose(timeline=_tl(memory_blocked_ms=450.0),
+                         wall_ms=500.0)
+    assert fs and fs[0].rule == "memory-blocked"
+    assert fs[0].evidence["memory_blocked_ms"] == 450.0
+
+
+def test_spill_bound_ranks_first():
+    fs = doctor.diagnose(
+        timeline=_tl(spill_bytes=80e6, input_bytes=100e6), wall_ms=100.0)
+    assert fs and fs[0].rule == "spill-bound"
+    assert fs[0].evidence["ratio"] == pytest.approx(0.8)
+
+
+def test_exchange_backpressure_ranks_first():
+    fs = doctor.diagnose(
+        timeline=_tl(exchange_producer_stall_s=0.9), wall_ms=1000.0)
+    assert fs and fs[0].rule == "exchange-backpressure"
+    assert fs[0].evidence["producer_stall_ms"] == pytest.approx(900.0)
+
+
+def test_skewed_stage_ranks_first():
+    tl = _tl()
+    tl.extend("partition_rows", "dist:join-build", [1000, 10, 10, 10])
+    fs = doctor.diagnose(timeline=tl, wall_ms=100.0)
+    assert fs and fs[0].rule == "skewed-stage"
+    ev = fs[0].evidence
+    assert ev["stage"] == "dist:join-build"
+    assert ev["max_rows"] == 1000
+    assert ev["ratio"] == pytest.approx(100.0)
+
+
+def test_straggler_worker_ranks_first():
+    tl = _tl()
+    tl.extend("fragment_ms", "http://w1", 900.0)
+    tl.extend("fragment_ms", "http://w2", 10.0)
+    tl.extend("fragment_ms", "http://w3", 12.0)
+    fs = doctor.diagnose(timeline=tl, wall_ms=1000.0)
+    assert fs and fs[0].rule == "straggler-worker"
+    ev = fs[0].evidence
+    assert ev["worker"] == "http://w1"
+    assert ev["max_ms"] == pytest.approx(900.0)
+    assert set(ev["per_worker_ms"]) == {"http://w1", "http://w2",
+                                        "http://w3"}
+
+
+def test_straggler_needs_three_workers():
+    """With two workers the median IS the midpoint, so the 3x ratio is
+    unreachable by construction — the rule must stay silent rather than
+    fire on a meaningless 2-sample median."""
+    tl = _tl()
+    tl.extend("fragment_ms", "http://w1", 900.0)
+    tl.extend("fragment_ms", "http://w2", 10.0)
+    fs = doctor.diagnose(timeline=tl, wall_ms=1000.0)
+    assert not any(f.rule == "straggler-worker" for f in fs)
+
+
+def test_scan_bound_ranks_first():
+    tracer = _StubTracer({"tpch:split": {"total_ms": 900.0, "count": 8}})
+    fs = doctor.diagnose(tracer=tracer, wall_ms=1000.0)
+    assert fs and fs[0].rule == "scan-bound"
+    assert fs[0].evidence["split_ms"] == pytest.approx(900.0)
+
+
+def test_fallback_taken_ranks_first():
+    fs = doctor.diagnose(dist_fallback="unsupported plan shape: limit",
+                         wall_ms=50.0)
+    assert fs and fs[0].rule == "fallback-taken"
+    assert "limit" in fs[0].evidence["reason"]
+
+
+def test_findings_rank_by_score_across_rules():
+    """Mixed evidence sorts by severity: a 90% queue wait must outrank
+    a 30% compile share."""
+    tracer = _StubTracer({"xla_compile": {"total_ms": 30.0, "count": 1}})
+    fs = doctor.diagnose(tracer=tracer, timeline=_tl(queued_ms=900.0),
+                         wall_ms=100.0)
+    rules = [f.rule for f in fs]
+    assert rules.index("queue-bound") < rules.index("compile-bound")
+    assert [f.score for f in fs] == sorted(
+        (f.score for f in fs), reverse=True)
+
+
+def test_quiet_query_yields_no_findings():
+    fs = doctor.diagnose(timeline=_tl(), wall_ms=100.0)
+    assert fs == []
+    text = doctor.format_findings([])
+    assert text.startswith("diagnosis:") and "no findings" in text
+
+
+def test_format_findings_renders_rank_and_score():
+    fs = doctor.diagnose(dist_fallback="no mesh")
+    text = doctor.format_findings([f.as_dict() for f in fs])
+    assert "1. fallback-taken" in text and "score 0.95" in text
+
+
+# ---------------------------------------------------------------------------
+# retention planes: both rings bounded, eviction observable
+# ---------------------------------------------------------------------------
+
+def test_metrics_history_ring_evicts_oldest():
+    h = MetricsHistory(max_ticks=4)
+    for _ in range(10):
+        h.sample_once()
+    assert h.tick_count() == 4
+    ts = [t for t, _, _ in h.rows()]
+    assert ts == sorted(ts)  # oldest tick first
+    h.clear()
+    assert h.tick_count() == 0 and h.rows() == []
+
+
+def test_metrics_history_rates_and_percentiles():
+    h = MetricsHistory(max_ticks=8)
+    h.sample_once()  # baseline for rate deltas
+    obs.METRICS.counter("device.get_calls").inc(5)
+    obs.METRICS.histogram("admission.queue_wait_ms").observe(7.0)
+    h.sample_once()
+    last = {}
+    for ts, name, value in h.rows():
+        last[name] = value
+    assert last["device.get_calls.rate"] > 0
+    # log2-bucket percentiles ride the tick for any observed histogram
+    assert "admission.queue_wait_ms.p50" in last
+    assert "admission.queue_wait_ms.p95" in last
+    assert "admission.queue_wait_ms.p99" in last
+
+
+def test_timeline_ring_bounds_and_dropped_counter():
+    tl = QueryTimeline("q_doc_ring", max_points=8)
+    for i in range(20):
+        tl.record("x", float(i))
+    pts = tl.points()
+    assert len(pts) == 8
+    assert tl.dropped == 12
+    assert pts[0][2] == 12.0  # oldest points evicted, newest kept
+    snap = tl.snapshot()
+    assert snap["dropped"] == 12 and len(snap["points"]) == 8
+
+
+def test_record_point_is_noop_without_active_timeline():
+    assert obs.current_timeline() is None
+    record_point("x", 1.0)  # must not raise, must not allocate a timeline
+    tl = QueryTimeline("q_doc_active")
+    with recording(tl):
+        record_point("y", 2.0)
+    assert [p[1] for p in tl.points()] == ["y"]
+    assert obs.current_timeline() is None
+
+
+def test_timelines_master_switch_disables_everything():
+    timelines_enabled.set(False)
+    try:
+        assert ensure_timeline("q_doc_disabled") is None
+        assert timeline_for("q_doc_disabled") is None
+    finally:
+        timelines_enabled.set(None)
+    tl = ensure_timeline("q_doc_enabled")
+    assert tl is not None
+    assert ensure_timeline("q_doc_enabled") is tl  # get-or-create
+
+
+# ---------------------------------------------------------------------------
+# SQL surfaces
+# ---------------------------------------------------------------------------
+
+def test_system_metrics_history_table():
+    runner, _ = make_runner()
+    HISTORY.clear()
+    try:
+        HISTORY.sample_once()
+        obs.METRICS.counter("query.started").inc(0)  # registry warm
+        HISTORY.sample_once()
+        res = runner.execute(
+            "select node, ts_ms, name, value from system_metrics_history")
+        assert res.rows, "armed ring produced no table rows"
+        nodes = {node for node, _, _, _ in res.rows}
+        assert nodes == {"local"}
+        assert all(isinstance(ts, float) and ts > 0
+                   for _, ts, _, _ in res.rows)
+        assert any(name.endswith(".rate") for _, _, name, _ in res.rows)
+        res = runner.execute(
+            "select count(*) from system_metrics_history"
+            " where name = 'query.started.rate'")
+        assert res.rows[0][0] >= 1
+    finally:
+        HISTORY.clear()
+
+
+def test_runtime_queries_queued_columns_null_safe():
+    runner, history = make_runner()
+    runner.execute("select count(*) from nation")
+    qid = history.completed[-1].query_id
+    res = runner.execute(
+        "select queued_ms, memory_blocked_ms from system_runtime_queries"
+        " where query_id = '%s'" % qid)
+    assert len(res.rows) == 1
+    queued, blocked = res.rows[0]
+    # embedded runs skip admission: both columns are NULL, not a crash
+    assert queued is None and blocked is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end evidence: the engine produces what the rulebook consumes
+# ---------------------------------------------------------------------------
+
+def test_cold_run_is_compile_bound_end_to_end():
+    runner, history = make_runner(sf=0.002)
+    runner.session.set("trace", "true")
+    res = runner.execute(
+        "select l_linestatus, max(l_discount * 0.34), min(l_tax + 0.21)"
+        " from lineitem group by l_linestatus")
+    findings = res.findings
+    assert findings is not None
+    by_rule = {f["rule"]: f for f in findings}
+    assert "compile-bound" in by_rule, findings
+    ev = by_rule["compile-bound"]["evidence"]
+    assert ev["compile_ms"] > 0 and ev["compiles"] >= 1
+    # the completion event carries the same findings (query-log field)
+    assert history.completed[-1].findings == findings
+
+
+def test_spill_bound_end_to_end():
+    from presto_tpu.memory import MemoryPool
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.004, split_rows=1 << 12))
+    sql = ("select l_orderkey, count(*), sum(l_quantity)"
+           " from lineitem group by l_orderkey")
+    probe = QueryRunner(catalog, memory_pool=MemoryPool(1 << 40))
+    probe.execute(sql)  # measure the unconstrained accumulator
+    peak = probe.executor.last_peak_bytes
+    runner = QueryRunner(catalog, memory_pool=MemoryPool(int(peak * 0.5)))
+    res = runner.execute(sql)
+    by_rule = {f["rule"]: f for f in res.findings}
+    assert "spill-bound" in by_rule, res.findings
+    assert by_rule["spill-bound"]["evidence"]["spill_bytes"] > 0
+    tl = timeline_for(res.query_id)
+    assert tl is not None and tl.annotation("spill_bytes") > 0
+
+
+def _controller(pool=None, **kw):
+    from presto_tpu.resource_groups import ResourceGroup, ResourceGroupManager
+    from presto_tpu.serving import AdmissionController
+
+    root = ResourceGroup(
+        "global", hard_concurrency=kw.pop("hard_concurrency", 4),
+        max_queued=kw.pop("max_queued", 100))
+    return AdmissionController(ResourceGroupManager(root), pool=pool, **kw)
+
+
+def test_queue_bound_from_admission_burst():
+    """concurrency-1 controller + a held slot: the waiter's real
+    queued_ms lands on its timeline and the doctor ranks queue-bound
+    first for a short query."""
+    ctl = _controller(hard_concurrency=1)
+    first = ctl.admit("q_doc_holder", "alice")
+    got = []
+
+    def waiter():
+        t = ctl.admit("q_doc_queued", "alice", timeout=10.0)
+        got.append(t)
+
+    th = threading.Thread(target=waiter, daemon=True, name="doc-admit")
+    th.start()
+    time.sleep(0.06)  # hold the slot past QUEUE_MIN_MS
+    ctl.release(first)
+    th.join(timeout=10.0)
+    assert got
+    ctl.release(got[0])
+    tl = timeline_for("q_doc_queued")
+    assert tl is not None
+    queued = tl.annotation("queued_ms")
+    assert queued is not None and queued >= 10.0
+    # admission also timelines the queue depth it saw
+    assert any(name == "admission.queue_depth" for _, name, _ in tl.points())
+    fs = doctor.diagnose("q_doc_queued", wall_ms=5.0)
+    assert fs and fs[0].rule == "queue-bound"
+    assert fs[0].evidence["queued_ms"] == pytest.approx(queued)
+
+
+def test_memory_blocked_from_admission_gate():
+    from presto_tpu.memory import MemoryPool
+
+    pool = MemoryPool(1000)
+    pool.reserve("other/x", 950)
+    ctl = _controller(pool=pool, memory_fraction=0.9)
+    got = []
+
+    def submit():
+        got.append(ctl.admit("q_doc_blocked", "alice", timeout=10.0))
+
+    th = threading.Thread(target=submit, daemon=True, name="doc-admit-mem")
+    th.start()
+    time.sleep(0.1)
+    assert not got  # still blocked on headroom
+    pool.free("other/x")
+    th.join(timeout=10.0)
+    assert got
+    ctl.release(got[0])
+    tl = timeline_for("q_doc_blocked")
+    assert tl is not None
+    blocked = tl.annotation("memory_blocked_ms")
+    assert blocked is not None and blocked >= 50.0
+    fs = doctor.diagnose("q_doc_blocked", wall_ms=20.0)
+    assert fs and fs[0].rule == "memory-blocked"
+    assert fs[0].evidence["memory_blocked_ms"] == pytest.approx(blocked)
+
+
+def test_skewed_join_key_end_to_end():
+    """A build side whose key is constant hash-routes every row to one
+    device partition; the dist tier's fill counts land on the timeline
+    and the doctor calls the skew."""
+    from presto_tpu.parallel.dist import DistributedRunner, make_mesh
+
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.002, split_rows=4096))
+    local = QueryRunner(catalog)
+    dist = DistributedRunner(catalog, make_mesh(8), broadcast_threshold=0)
+    sql = ("select count(*) from orders o join"
+           " (select (l_orderkey % 1) + 1 as k from lineitem) b"
+           " on o.o_orderkey = b.k")
+    plan = local.binder.plan(sql)
+    tl = ensure_timeline("q_doc_skew")
+    with recording(tl):
+        out = dist.run(plan)
+    assert out.dist_fallback is None, out.dist_fallback
+    rows_by_stage = tl.annotation("partition_rows")
+    assert rows_by_stage and "dist:join-build" in rows_by_stage
+    fs = doctor.diagnose("q_doc_skew", wall_ms=100.0)
+    assert fs and fs[0].rule == "skewed-stage"
+    ev = fs[0].evidence
+    assert ev["stage"] == "dist:join-build"
+    assert ev["ratio"] >= doctor.SKEW_RATIO
+    assert ev["max_rows"] >= doctor.SKEW_MIN_ROWS
+
+
+def test_straggler_worker_end_to_end():
+    """One of three workers answers every request 150ms late
+    (worker.slow_response_ms, node-scoped): its fragment_ms total
+    dwarfs the median and the doctor names the worker.  A chain stage
+    keeps fragments independent (no worker-to-worker shuffle), so the
+    delay attributes cleanly, and a faultless warm-up run first takes
+    worker-side compilation out of the timings."""
+    from presto_tpu.parallel.multihost import MultiHostRunner
+    from presto_tpu.server.worker import WorkerServer
+    from presto_tpu.testing_faults import FAULTS
+
+    def make_catalog():
+        catalog = Catalog()
+        catalog.register("tpch", Tpch(sf=0.002, split_rows=2048))
+        return catalog
+
+    workers = [WorkerServer(make_catalog()) for _ in range(3)]
+    for w in workers:
+        w.start()
+    try:
+        catalog = make_catalog()
+        local = QueryRunner(catalog)
+        multi = MultiHostRunner(catalog, [w.uri for w in workers])
+        plan = local.binder.plan(
+            "select l_orderkey, l_quantity from lineitem"
+            " where l_quantity < 10")
+        warm = multi.run(plan)  # compile worker-side programs
+        assert warm.dist_fallback is None, warm.dist_fallback
+        FAULTS.arm("worker.slow_response_ms",
+                   node=workers[0].node_id, ms=150)
+        tl = ensure_timeline("q_doc_straggler")
+        with recording(tl):
+            out = multi.run(plan)
+        assert out.dist_fallback is None, out.dist_fallback
+        assert len(out.rows) == len(warm.rows)
+        fragment_ms = tl.annotation("fragment_ms")
+        assert fragment_ms and workers[0].uri in fragment_ms, fragment_ms
+        fs = doctor.diagnose("q_doc_straggler", wall_ms=600.0)
+        straggler = [f for f in fs if f.rule == "straggler-worker"]
+        assert straggler, (fs, fragment_ms)
+        assert straggler[0].evidence["worker"] == workers[0].uri
+    finally:
+        FAULTS.disarm_all()
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def test_explain_analyze_verbose_carries_diagnosis():
+    runner, _ = make_runner()
+    res = runner.execute("explain analyze verbose"
+                         " select count(*) from nation")
+    text = res.rows[0][0]
+    assert text.startswith("diagnosis:")
+
+
+# ---------------------------------------------------------------------------
+# REST surfaces (coordinator)
+# ---------------------------------------------------------------------------
+
+def test_coordinator_history_timeline_doctor_endpoints():
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    runner, _ = make_runner()
+    sampler_was_running = HISTORY.running
+    srv = CoordinatorServer(runner)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"{srv.uri}/v1/statement",
+            data=b"select count(*) from nation", method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            doc = json.load(r)
+        assert doc["stats"]["state"] == "FINISHED"
+        qid = doc["id"]
+
+        with urllib.request.urlopen(
+                f"{srv.uri}/v1/metrics/history", timeout=10) as r:
+            hist = json.load(r)
+        assert hist["intervalMs"] >= 1  # the server armed the sampler
+        assert "local" in hist["nodes"]
+        HISTORY.sample_once()  # don't wait out the 1s cadence
+        with urllib.request.urlopen(
+                f"{srv.uri}/v1/metrics/history", timeout=10) as r:
+            hist = json.load(r)
+        assert hist["nodes"]["local"], "sampled tick missing from endpoint"
+        ts, name, value = hist["nodes"]["local"][0]
+        assert isinstance(name, str) and isinstance(value, (int, float))
+
+        with urllib.request.urlopen(
+                f"{srv.uri}/v1/query/{qid}/timeline", timeout=10) as r:
+            snap = json.load(r)
+        assert snap["queryId"] == qid
+        assert {"points", "dropped", "annotations"} <= set(snap)
+        assert "wall_ms" in snap["annotations"]
+
+        with urllib.request.urlopen(
+                f"{srv.uri}/v1/query/{qid}/doctor", timeout=10) as r:
+            rep = json.load(r)
+        assert rep["queryId"] == qid
+        assert isinstance(rep["findings"], list)
+        for f in rep["findings"]:
+            assert {"rule", "score", "summary", "evidence"} <= set(f)
+
+        for endpoint in ("timeline", "doctor"):
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{srv.uri}/v1/query/nope/{endpoint}", timeout=10)
+    finally:
+        srv.stop()
+    # the arming server stopped its sampler: no thread leaks into the
+    # rest of the suite
+    assert HISTORY.running == sampler_was_running
+
+
+def test_statement_stats_mirror_queued_columns():
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    runner, _ = make_runner()
+    srv = CoordinatorServer(runner)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"{srv.uri}/v1/statement",
+            data=b"select count(*) from region", method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            doc = json.load(r)
+        stats = doc["stats"]
+        # embedded coordinator runs don't queue: the keys appear only
+        # when admission produced a value (JSON mirrors are omitted-
+        # when-NULL like compileMs)
+        for key in ("queuedMs", "memoryBlockedMs"):
+            if key in stats:
+                assert isinstance(stats[key], (int, float))
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI --doctor
+# ---------------------------------------------------------------------------
+
+def test_cli_doctor_prints_diagnosis(capsys):
+    from presto_tpu import cli
+
+    rc = cli.main(["--sf", "0.001", "-e", "select count(*) from nation",
+                   "--doctor"])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "diagnosis:" in captured.err

@@ -11,7 +11,10 @@ previous one:
 - median ± half-spread per query from the ``raw_times`` repeat blocks
   (the variance protocol's evidence), when both rounds carry them, so
   a flagged drop is distinguishable from host noise,
-- the geomean ratio over the common query set.
+- the geomean ratio over the common query set,
+- the query doctor's top finding for each flagged regression, when the
+  new round's payload carries a ``doctor`` map (benchmark_driver rows
+  include one) — the diagnosed bottleneck prints under the flag.
 
 Exit code: 0 always in report mode (`tools/ci.sh` runs it as a
 non-fatal step); ``--strict`` exits 1 when a regression is flagged.
@@ -107,6 +110,12 @@ def compare(old: dict, new: dict, threshold: float = 0.2) -> dict:
             if ratio < 1.0 - threshold:
                 entry["regression"] = True
                 regressions.append(q)
+            # the query doctor's top finding for the NEW round, when the
+            # payload carries one ({query: {rule, score, summary}}) —
+            # a flagged drop arrives with its diagnosed bottleneck
+            doc = (new.get("doctor") or {}).get(q)
+            if isinstance(doc, dict) and doc.get("rule"):
+                entry["doctor"] = doc
             rows.append(entry)
     common_tpch = sorted(set(old.get("rates") or {})
                          & set(new.get("rates") or {}))
@@ -136,6 +145,10 @@ def report(old_path: str, new_path: str, result: dict,
         lines.append(
             f"  {e['query']:<8} {_fmt_rate(e['old']):>10} -> "
             f"{_fmt_rate(e['new']):>10}  {delta:+6.1f}%{extra}{flag}")
+        if e.get("regression") and e.get("doctor"):
+            d = e["doctor"]
+            lines.append(f"           doctor: {d['rule']} "
+                         f"(score {d['score']:.2f}): {d['summary']}")
     if result["geomean_ratio"] is not None:
         lines.append(f"  geomean ratio (tpch common set): "
                      f"{result['geomean_ratio']:.3f}x")

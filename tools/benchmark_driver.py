@@ -233,6 +233,18 @@ def run_streams(runner, name: str, sql: str, streams: int, runs: int):
     return row
 
 
+def _top_finding(res):
+    """The doctor's top-ranked finding riding a MaterializedResult
+    (runner attaches the full ranked list), trimmed to what the report
+    needs — bench_compare.py prints it next to flagged regressions."""
+    findings = getattr(res, "findings", None)
+    if not findings:
+        return None
+    top = findings[0]
+    return {"rule": top["rule"], "score": top["score"],
+            "summary": top["summary"]}
+
+
 def _percentile(sorted_vals, p):
     """Nearest-rank percentile (ceil, 1-indexed) — run_streams' pct."""
     import math
@@ -488,14 +500,19 @@ def main():
             # regression is distinguishable from host variance.
             raw: list = []
             repeat_medians = []
+            last = res
             for _ in range(max(args.repeat, 1)):
                 times = []
                 for _ in range(args.runs):
                     t0 = time.perf_counter()
-                    runner.execute(sql)
+                    last = runner.execute(sql)
                     times.append(time.perf_counter() - t0)
                 raw.append([round(t, 4) for t in times])
                 repeat_medians.append(statistics.median(times))
+            # the query doctor's top-ranked finding for the final timed
+            # run (obs/doctor.py) — "why is this query slow" travels
+            # with the number that says it is
+            top = _top_finding(last)
             flat = [t for block in raw for t in block]
             spread = (max(repeat_medians) - min(repeat_medians)) / 2
             row = {
@@ -511,6 +528,8 @@ def main():
                 "max_s": round(max(flat), 4),
                 "stddev_s": round(statistics.stdev(flat), 4) if len(flat) > 1 else 0.0,
             }
+            if top is not None:
+                row["doctor"] = top
         except Exception as e:
             row = {"query": name, "error": f"{type(e).__name__}: {e}"}
         results.append(row)
@@ -519,10 +538,13 @@ def main():
         elif "error" in row:
             print(f"{name:>8}  ERROR {row['error']}", flush=True)
         else:
+            doc = row.get("doctor")
             print(f"{name:>8}  rows={row['rows']:<8} "
                   f"median={row['median_s']:.4f}s ±{row['spread_s']:.4f} "
                   f"mean={row['mean_s']:.4f}s min={row['min_s']:.4f}s "
-                  f"max={row['max_s']:.4f}s (warmup {row['warmup_s']:.1f}s)",
+                  f"max={row['max_s']:.4f}s (warmup {row['warmup_s']:.1f}s)"
+                  + (f"  doctor: {doc['rule']} ({doc['score']:.2f})"
+                     if doc else ""),
                   flush=True)
 
     ok = [r for r in results if "error" not in r]

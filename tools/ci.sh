@@ -13,6 +13,9 @@
 #      plan-shape fingerprints for all 22 TPC-H + 99 TPC-DS queries,
 #      planned under the rewrite-soundness gate; an optimizer change
 #      that moves plans must refresh the goldens with --update)
+#   3c. doctor/telemetry smoke    (metrics-history ring armed over real
+#      queries: ticks recorded, per-query timelines populated, doctor
+#      findings schema-valid, sampler thread stops clean)
 #   4. fault-injection leg        (tests/test_fault_tolerance.py under
 #      a FIXED fault seed: the chaos schedules — worker death
 #      mid-query, refused connects, corrupt pages, deadline kills —
@@ -66,6 +69,52 @@ for qid in (1, 6):
     print(f"q{qid}: {len(res.rows)} rows, sanitizer clean")
 escapes = METRICS.counter("kernel.sanitizer_escapes").value
 assert escapes == 0, f"{escapes} interval escapes"
+EOF
+
+echo "== telemetry-history / query-doctor smoke ==================="
+# arm the metrics-history ring, run real queries, and assert the whole
+# observability loop end-to-end: the ring holds ticks, the per-query
+# timeline recorded points, the doctor's findings are schema-valid,
+# and the sampler thread does not leak past stop()
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.system import QueryHistory, SystemConnector
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.obs import doctor, timeline_for
+from presto_tpu.obs.timeseries import HISTORY
+from presto_tpu.runner import QueryRunner
+
+catalog = Catalog()
+catalog.register("tpch", Tpch(sf=0.01))
+history = QueryHistory()
+catalog.register("system", SystemConnector(history))
+runner = QueryRunner(catalog)
+runner.events.add(history)
+assert HISTORY.start(interval_ms=50)
+try:
+    runner.execute("select l_returnflag, sum(l_quantity) from lineitem"
+                   " group by l_returnflag")
+    runner.execute("select count(*) from orders where o_totalprice > 1000")
+    res = runner.execute("select count(*) from system_metrics_history")
+    assert res.rows[0][0] > 0, "history ring empty after armed run"
+    for e in history.completed:
+        assert e.findings is not None, "completed event missing findings"
+        for f in e.findings:
+            assert {"rule", "score", "summary", "evidence"} <= set(f), f
+            assert 0.0 <= f["score"] <= 1.0, f
+        tl = timeline_for(e.query_id)
+        assert tl is not None and tl.points(), "timeline recorded nothing"
+        rep = doctor.report(e.query_id)
+        assert rep["findings"] == e.findings
+finally:
+    HISTORY.stop()
+    HISTORY.clear()
+assert not HISTORY.running
+names = [t.name for t in threading.enumerate()]
+assert "obs-history-sampler" not in names, f"sampler leaked: {names}"
+print(f"doctor smoke: {len(history.completed)} queries diagnosed, "
+      "ring sampled, sampler stopped clean")
 EOF
 
 echo "== concurrent split-scheduler leg ==========================="
