@@ -156,6 +156,17 @@ class SystemConnector:
             ("peak_bytes", BIGINT), ("limit_bytes", BIGINT),
             ("queries", BIGINT),
         ],
+        # the plan-history store (obs/history.py): observed per-operator
+        # actuals retained ACROSS queries, keyed by the stable
+        # structural node signature.  ratio_last is the last run's
+        # estimate-vs-actual factor (>= 1.0, NULL before any estimate
+        # was comparable); a warehouse-backed store survives restarts
+        "system_plan_history": [
+            ("node_type", VARCHAR), ("digest", VARCHAR),
+            ("observations", BIGINT), ("rows_mean", DOUBLE),
+            ("rows_last", BIGINT), ("est_last", DOUBLE),
+            ("ratio_last", DOUBLE), ("peak_bytes_max", BIGINT),
+        ],
     }
 
     def table_names(self) -> List[str]:
@@ -180,7 +191,20 @@ class SystemConnector:
             return len(self._pool_rows())
         if table == "system_runtime_workers":
             return len(self._worker_rows())
+        if table == "system_plan_history":
+            return len(self._plan_history_rows())
         return len(self.nodes())
+
+    @staticmethod
+    def _plan_history_rows() -> List[dict]:
+        from presto_tpu.obs.history import default_history
+
+        # stable order: a bind-time row count and the executed page
+        # must agree even if observations land in between — snapshot
+        # sorted by key and let the count clamp (same contract as the
+        # other live tables)
+        return sorted(default_history().rows(),
+                      key=lambda e: (e["node"], e["digest"]))
 
     def _worker_rows(self) -> List[dict]:
         if self.workers is None:
@@ -309,6 +333,20 @@ class SystemConnector:
                 [int(p["peak"]) for p in ps],
                 [int(p["limit"]) for p in ps],
                 [int(p["queries"]) for p in ps],
+            ]
+        elif table == "system_plan_history":
+            hs = self._plan_history_rows()
+            cols = [
+                [h["node"] for h in hs],
+                [h["digest"] for h in hs],
+                [int(h["n"]) for h in hs],
+                [float(h["rows_mean"]) for h in hs],
+                [int(h["rows_last"]) for h in hs],
+                [None if h.get("est_last") is None
+                 else float(h["est_last"]) for h in hs],
+                [None if h.get("ratio_last") is None
+                 else float(h["ratio_last"]) for h in hs],
+                [int(h.get("peak_bytes_max", 0)) for h in hs],
             ]
         elif table == "system_runtime_workers":
             ws = self._worker_rows()

@@ -62,6 +62,10 @@ class QueryCompletedEvent:
     # ranked doctor findings (obs/doctor.py as_dict rows) — the query
     # log's bottleneck attribution; None when diagnosis did not run
     findings: Optional[list] = None
+    # estimate-vs-actual plane (obs/history.py worst_estimate): the
+    # query's worst per-operator misestimate factor (>= 1.0); None when
+    # stats collection was off or nothing was comparable
+    worst_estimate_ratio: Optional[float] = None
 
 
 @dataclasses.dataclass

@@ -235,32 +235,27 @@ class QueryStats:
         # keyed nodes are pinned so their id() can never be recycled
         # onto a different node mid-lifetime
         self._pin: List[PlanNode] = []
+        # record() runs on whichever thread iterates the page
+        # generator; distributed roll-up merges from puller threads
+        import threading
+
+        self._lock = threading.Lock()
 
     @staticmethod
     def _sig(node: PlanNode):
-        from presto_tpu.exec.programs import ir_signature
+        from presto_tpu.exec.programs import structural_digest
 
-        try:
-            return (type(node).__name__, hash(ir_signature(node)))
-        except TypeError:
-            return (type(node).__name__, None)
+        return (type(node).__name__, structural_digest(node))
 
     def register_plan(self, root: PlanNode) -> None:
         """Assign keys for a whole tree in preorder walk order, so two
         structurally identical plans map node-for-node onto the SAME
         keys: stats recorded while executing a re-built plan land on
         the entries the original plan's annotations read."""
-        counts: Dict[tuple, int] = {}
-        stack = [root]
-        while stack:
-            n = stack.pop()
-            sig = self._sig(n)
-            occ = counts.get(sig, 0)
-            counts[sig] = occ + 1
+        for n, key in plan_node_keys(root):
             if id(n) not in self._key_of:
-                self._key_of[id(n)] = (sig, occ)
+                self._key_of[id(n)] = key
                 self._pin.append(n)
-            stack.extend(reversed(n.sources))
 
     def _key(self, node: PlanNode) -> tuple:
         k = self._key_of.get(id(node))
@@ -273,12 +268,16 @@ class QueryStats:
             self._pin.append(node)
         return k
 
-    def record(self, node: PlanNode, wall: float, rows: int) -> None:
-        s = self.by_key.setdefault(
-            self._key(node), {"invocations": 0, "rows": 0, "wall_s": 0.0})
-        s["invocations"] += 1
-        s["rows"] += rows
-        s["wall_s"] += wall
+    def record(self, node: PlanNode, wall: float, rows: int,
+               nbytes: int = 0) -> None:
+        with self._lock:
+            s = self.by_key.setdefault(
+                self._key(node),
+                {"invocations": 0, "rows": 0, "wall_s": 0.0, "bytes": 0})
+            s["invocations"] += 1
+            s["rows"] += rows
+            s["wall_s"] += wall
+            s["bytes"] += nbytes
 
     def annotation(self, node: PlanNode) -> str:
         s = self.by_key.get(self._key(node))
@@ -288,6 +287,60 @@ class QueryStats:
             f"  [rows={s['rows']}, pages={s['invocations']}, "
             f"wall={s['wall_s'] * 1e3:.1f}ms]"
         )
+
+    def actual_rows(self, node: PlanNode) -> Optional[int]:
+        """Observed output rows for a node, or None when it never
+        recorded (est-vs-actual rendering, history feed)."""
+        s = self.by_key.get(self._key(node))
+        if s is None or not s["invocations"]:
+            return None
+        return int(s["rows"])
+
+    # -- distributed roll-up wire format ------------------------------------
+    # Keys are stable across processes (structural_digest), so a
+    # worker's by_key snapshot serializes as JSON and merges onto the
+    # coordinator's entries by key alone — the OperatorStats →
+    # TaskStats → QueryStats roll-up of the reference, flattened.
+    def to_wire(self) -> list:
+        with self._lock:
+            return [
+                {"node": sig[0], "digest": sig[1], "occ": occ,
+                 "invocations": int(s["invocations"]),
+                 "rows": int(s["rows"]), "wall_s": float(s["wall_s"]),
+                 "bytes": int(s.get("bytes", 0))}
+                for (sig, occ), s in self.by_key.items()
+            ]
+
+    def merge_wire(self, entries) -> None:
+        with self._lock:
+            for e in entries or ():
+                key = ((str(e["node"]), str(e["digest"])), int(e["occ"]))
+                s = self.by_key.setdefault(
+                    key, {"invocations": 0, "rows": 0, "wall_s": 0.0,
+                          "bytes": 0})
+                s["invocations"] += int(e.get("invocations", 0))
+                s["rows"] += int(e.get("rows", 0))
+                s["wall_s"] += float(e.get("wall_s", 0.0))
+                s["bytes"] += int(e.get("bytes", 0))
+
+
+def plan_node_keys(root: PlanNode):
+    """``[(node, ((type name, digest), occurrence))]`` for a whole plan
+    tree in deterministic preorder — THE shared key walk: QueryStats
+    registration, bind-time estimate capture, and the history provider
+    all key through this one function, so estimates and actuals share a
+    key space by construction."""
+    counts: Dict[tuple, int] = {}
+    out = []
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        sig = QueryStats._sig(n)
+        occ = counts.get(sig, 0)
+        counts[sig] = occ + 1
+        out.append((n, (sig, occ)))
+        stack.extend(reversed(n.sources))
+    return out
 
 
 # Ceiling for capacity-doubling retries, shared by the local, mesh
@@ -531,7 +584,12 @@ class LocalRunner:
         # env-dependent kernel choices resolve ONCE at construction —
         # not per join build (satellite of the registry PR)
         resolve_direct_join()
-        self.stats: Optional[QueryStats] = None
+        # per-THREAD stats sink (property below): worker task threads
+        # and concurrent coordinator queries share one runner, and a
+        # shared sink would interleave two queries' actuals
+        import threading as _threading
+
+        self._stats_tls = _threading.local()
         # HBM accounting (memory/MemoryPool.java analog); None = untracked
         self.memory_pool = memory_pool
         # per-THREAD last-query peaks (properties below): concurrent
@@ -655,6 +713,18 @@ class LocalRunner:
             yield from self._pages(plan)
 
     @property
+    def stats(self) -> Optional[QueryStats]:
+        """Per-THREAD QueryStats sink: pages record on the thread that
+        iterates the generator, and worker task quanta rebind this per
+        step — a plain attribute would let concurrent queries (or two
+        worker tasks) interleave actuals."""
+        return getattr(self._stats_tls, "stats", None)
+
+    @stats.setter
+    def stats(self, value: Optional["QueryStats"]) -> None:
+        self._stats_tls.stats = value
+
+    @property
     def _builds(self) -> Dict[JoinNode, JoinBuild]:
         got = getattr(self._builds_tls, "builds", None)
         if got is None:
@@ -728,10 +798,19 @@ class LocalRunner:
 
         return plan_tree_str(plan)
 
-    def explain_with_stats(self, plan: PlanNode, stats: "QueryStats") -> str:
+    def explain_with_stats(self, plan: PlanNode, stats: "QueryStats",
+                           misestimate_factor: float = 8.0) -> str:
+        from presto_tpu.obs.history import worst_estimate
         from presto_tpu.planner.plan import plan_tree_str
 
-        text = plan_tree_str(plan, stats=stats, mem=self._mem_by_node())
+        text = plan_tree_str(plan, stats=stats, mem=self._mem_by_node(),
+                             misestimate_factor=misestimate_factor)
+        worst = worst_estimate(stats, getattr(plan, "_estimates", None))
+        if worst is not None and worst["ratio"] >= misestimate_factor:
+            text = (f"worst estimate: {worst['node']} "
+                    f"est {worst['est']:.0f} rows / actual "
+                    f"{worst['actual']} rows (x{worst['ratio']:.1f})\n"
+                    + text)
         peak = getattr(self, "last_peak_bytes", 0)
         if peak:
             text = f"peak reserved memory: {peak / 1e6:.1f}MB\n" + text
@@ -1008,7 +1087,13 @@ class LocalRunner:
             if self.stats is not None:
                 wall = time.perf_counter() - t0
                 rows = int(np.asarray(p.num_rows()))
-                self.stats.record(node, wall, rows)
+                try:
+                    from presto_tpu.memory import page_bytes
+
+                    nb = page_bytes(p)
+                except Exception:
+                    nb = 0  # byte accounting is best-effort
+                self.stats.record(node, wall, rows, nb)
             if sanitize:
                 self._sanitize_page(node, p)
             yield p

@@ -122,6 +122,68 @@ def ir_signature(obj) -> Any:
     return ("I", type(obj).__name__, _dict_token(obj))
 
 
+# Plan-node fields excluded from the CROSS-PROCESS structural signature:
+# they vary between the coordinator's plan and the fragment a worker
+# executes (the coordinator assigns `splits` per worker; Precomputed
+# stage results carry a materialized `page`) without changing what the
+# operator *is* — including them would make worker actuals unmergeable
+# with coordinator estimates.
+_VOLATILE_FIELDS = {
+    "TableScanNode": {"splits"},
+    "PrecomputedNode": {"page"},
+}
+
+
+def stable_signature(obj) -> Any:
+    """``ir_signature`` minus every per-process identity source: a
+    signature that is equal for structurally equal plans ACROSS
+    processes, so a worker's per-node stats can be merged onto the
+    coordinator's entries by key alone (estimate-vs-actual roll-up,
+    plan-history store).
+
+    Differences from :func:`ir_signature` (which must stay
+    identity-precise for program-cache correctness): Dictionaries
+    collapse to a bare marker instead of an identity token, unknown
+    objects key by type name only, and per-dispatch volatile plan
+    fields (``splits``, materialized stage pages) are skipped.  That
+    trades some precision for portability — exactly right for stats
+    keys, where structural twins merging is the point, and exactly
+    wrong for compiled-program keys, where it would alias kernels."""
+    from presto_tpu.page import Dictionary
+    from presto_tpu.types import Type
+
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, Type):
+        return ("T",) + type_signature(obj)
+    if isinstance(obj, Dictionary):
+        return "D"
+    if isinstance(obj, (list, tuple)):
+        return tuple(stable_signature(x) for x in obj)
+    if isinstance(obj, (set, frozenset)):
+        return ("S",) + tuple(sorted(map(stable_signature, obj), key=repr))
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        name = type(obj).__name__
+        skip = _VOLATILE_FIELDS.get(name, ())
+        return (name,) + tuple(
+            stable_signature(getattr(obj, f.name))
+            for f in dataclasses.fields(obj) if f.name not in skip)
+    return ("I", type(obj).__name__)
+
+
+def structural_digest(node) -> str:
+    """16-hex-char digest of a plan node's stable structural signature
+    — the JSON-safe half of the ``(signature, occurrence)`` stats key
+    shared by the coordinator, every worker, and the persisted
+    plan-history store.  sha1 over the signature's repr: ``hash()`` is
+    salted per process and identity tokens are per-process counters,
+    so neither survives serialization; this does."""
+    import hashlib
+
+    return hashlib.sha1(
+        repr(stable_signature(node)).encode()).hexdigest()[:16]
+
+
 # ---------------------------------------------------------------------------
 # persistent compilation cache
 # ---------------------------------------------------------------------------

@@ -47,8 +47,16 @@ from presto_tpu.obs.timeseries import (
     timeline_for,
 )
 from presto_tpu.obs import doctor
+from presto_tpu.obs.history import (
+    HistoricalStatsProvider,
+    PlanHistoryStore,
+    default_history,
+    set_default_history,
+)
 
 __all__ = [
+    "HistoricalStatsProvider", "PlanHistoryStore", "default_history",
+    "set_default_history",
     "METRICS", "TASKS", "MetricsRegistry", "TaskRegistry",
     "NULL_SPAN", "Tracer", "current_tracer", "lookup", "register",
     "span", "tracer_for", "tracing",

@@ -27,6 +27,8 @@ rule                      fires when
 ``scan-bound``            ``*:split`` span share of wall >= 50%
 ``fallback-taken``        the distributed tier fell back to the
                           coordinator (dist_fallback reason present)
+``misestimate``           worst estimate-vs-actual node ratio >= 8x
+                          (the ``worst_estimate`` timeline annotation)
 ========================  ==================================================
 
 Scores are comparable severities in [0, 1]; findings sort by score so
@@ -58,6 +60,7 @@ STRAGGLER_RATIO = 3.0
 STRAGGLER_MIN_MS = 50.0
 SCAN_SHARE = 0.50
 FALLBACK_SCORE = 0.95
+MISESTIMATE_RATIO = 8.0
 
 
 class Finding:
@@ -259,6 +262,20 @@ def diagnose(
             "task concurrency/prefetch or prune with predicates",
             {"split_ms": round(split_ms, 3), "wall_ms": round(wall_ms, 3),
              "share": round(share, 3)},
+        ))
+
+    # -- misestimate ------------------------------------------------------
+    we = ann.get("worst_estimate") or {}
+    ratio = float(we.get("ratio") or 0.0)
+    if ratio >= MISESTIMATE_RATIO:
+        findings.append(Finding(
+            "misestimate", min(1.0, ratio / (4 * MISESTIMATE_RATIO)),
+            f"planner misestimated {we.get('node')}: est "
+            f"{float(we.get('est') or 0):.0f} rows vs actual "
+            f"{int(we.get('actual') or 0)} ({ratio:.1f}x) — consider "
+            "SET SESSION feedback_stats = true or fresher table stats",
+            {"node": we.get("node"), "est_rows": we.get("est"),
+             "actual_rows": we.get("actual"), "ratio": round(ratio, 2)},
         ))
 
     # -- fallback-taken ---------------------------------------------------

@@ -159,7 +159,7 @@ class QueryLogListener(EventListener):
         for k in ("error", "trace_token", "dist_stages", "dist_fallback",
                   "planning_ms", "compile_ms", "execution_ms",
                   "cache_hit", "queued_ms", "memory_blocked_ms",
-                  "findings"):
+                  "findings", "worst_estimate_ratio"):
             v = getattr(e, k, None)
             if v is not None:
                 rec[k] = v

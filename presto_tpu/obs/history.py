@@ -1,0 +1,267 @@
+"""Plan-history store: observed per-operator actuals retained ACROSS
+queries, keyed by the stable structural node signature (exec/programs.
+structural_digest) — the persistence half of the estimate-vs-actual
+plane (docs/observability.md "Estimate vs actual").
+
+Reference analog: the historical stats feeding presto-main's
+HistoryBasedPlanStatisticsProvider — observed cardinalities beat
+textbook selectivity rules whenever a structurally identical node ran
+before.
+
+Persistence follows the warehouse metastore idiom
+(storage/warehouse.py): one JSON file under the warehouse root,
+replaced atomically (tmp + ``os.replace``), carrying a uuid
+incarnation that survives coordinator restarts plus a monotonic
+version bumped on every save.  A store without a path is purely
+in-memory (unit tests, catalogs without a warehouse).
+
+Layering: ``obs`` stays import-time independent of the execution
+layers — the structural digest is resolved lazily inside the methods
+that need it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+_FILE = "_plan_history.json"
+
+#: entries kept per store — LRU by update sequence, like the
+#: dictionary-token table in exec/programs.py
+DEFAULT_LIMIT = 4096
+
+#: observations of a signature required before the provider trusts it
+MIN_OBSERVATIONS = 1
+
+
+class PlanHistoryStore:
+    """Bounded per-warehouse map ``(node type, structural digest) ->
+    observed row counts / estimate ratios / peak bytes``."""
+
+    def __init__(self, path: Optional[str] = None,
+                 limit: int = DEFAULT_LIMIT):
+        self.path = path
+        self.limit = limit
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+        self._seq = 0
+        self.incarnation = uuid.uuid4().hex[:12]
+        self.version = 0
+        if path is not None and os.path.exists(path):
+            self._load()
+
+    # -- persistence --------------------------------------------------------
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return  # a corrupt store starts fresh, never fails a query
+        if not isinstance(doc, dict):
+            return
+        self.incarnation = str(doc.get("incarnation") or self.incarnation)
+        self.version = int(doc.get("version") or 0)
+        ents = doc.get("entries")
+        if isinstance(ents, dict):
+            self._entries = {str(k): dict(v) for k, v in ents.items()
+                             if isinstance(v, dict)}
+            self._seq = max(
+                (int(e.get("seq", 0)) for e in self._entries.values()),
+                default=0)
+
+    def save(self) -> None:
+        if self.path is None:
+            return
+        with self._lock:
+            self.version += 1
+            doc = {"incarnation": self.incarnation, "version": self.version,
+                   "entries": self._entries}
+            tmp = self.path + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, sort_keys=True)
+                os.replace(tmp, self.path)  # atomic publish
+            except OSError:
+                pass  # read-only roots degrade to in-memory behavior
+
+    # -- writes -------------------------------------------------------------
+    def observe(self, node_type: str, digest: str, rows: int,
+                est_rows: Optional[float] = None,
+                peak_bytes: int = 0) -> None:
+        """One finished node observation.  Running mean + last value;
+        the ratio keeps misestimate attribution queryable later."""
+        key = f"{node_type}:{digest}"
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = {"node": node_type, "digest": digest, "n": 0,
+                     "rows_mean": 0.0, "rows_last": 0, "est_last": None,
+                     "ratio_last": None, "peak_bytes_max": 0, "seq": 0,
+                     "updated_ms": 0.0}
+                self._entries[key] = e
+            n = int(e["n"]) + 1
+            e["n"] = n
+            e["rows_mean"] = (float(e["rows_mean"]) * (n - 1) + rows) / n
+            e["rows_last"] = int(rows)
+            if est_rows is not None:
+                e["est_last"] = float(est_rows)
+                e["ratio_last"] = estimate_ratio(est_rows, rows)
+            e["peak_bytes_max"] = max(int(e.get("peak_bytes_max", 0)),
+                                      int(peak_bytes))
+            self._seq += 1
+            e["seq"] = self._seq
+            e["updated_ms"] = time.time() * 1e3
+            while len(self._entries) > self.limit:
+                oldest = min(self._entries,
+                             key=lambda k: self._entries[k]["seq"])
+                self._entries.pop(oldest)
+
+    def record_query(self, stats, estimates: Optional[dict] = None,
+                     save: bool = True) -> None:
+        """Fold a finished query's ``QueryStats`` (and its bind-time
+        estimate map, when the plan carried one) into the store."""
+        estimates = estimates or {}
+        for (sig, occ), s in list(stats.by_key.items()):
+            if not s.get("invocations"):
+                continue
+            node_type, digest = sig
+            if node_type in ("PrecomputedNode", "ValuesNode"):
+                # their stable digests exclude the payload, so every
+                # instance would alias one entry — no planning value
+                continue
+            est = (estimates.get((sig, occ)) or {}).get("rows")
+            self.observe(node_type, str(digest), int(s["rows"]),
+                         est_rows=est, peak_bytes=int(s.get("bytes", 0)))
+        if save:
+            self.save()
+
+    # -- reads --------------------------------------------------------------
+    def observed_rows(self, node_type: str, digest: str) -> Optional[float]:
+        e = self._entries.get(f"{node_type}:{digest}")
+        if e is None or int(e.get("n", 0)) < MIN_OBSERVATIONS:
+            return None
+        return float(e["rows_mean"])
+
+    def rows(self) -> List[dict]:
+        """Snapshot for the ``system_plan_history`` table."""
+        with self._lock:
+            return [dict(e) for e in self._entries.values()]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class HistoricalStatsProvider:
+    """The planner-facing read adapter ``planner/stats.py`` consults
+    behind the ``feedback_stats`` session property: observed mean rows
+    for a structurally matching node, or None to keep the textbook
+    estimate."""
+
+    def __init__(self, store: PlanHistoryStore):
+        self.store = store
+
+    def observed_rows(self, node) -> Optional[float]:
+        from presto_tpu.exec.programs import structural_digest
+
+        name = type(node).__name__
+        if name in ("PrecomputedNode", "ValuesNode", "OutputNode"):
+            return None  # exact or payload-blind digests — never override
+        return self.store.observed_rows(name, structural_digest(node))
+
+
+def estimate_ratio(est: Optional[float], actual: int) -> Optional[float]:
+    """Misestimate factor ≥1.0, direction-free: max(actual/est,
+    est/actual) with both sides floored at one row so an estimated-0 /
+    actual-0 node never divides by zero."""
+    if est is None:
+        return None
+    e = max(float(est), 1.0)
+    a = max(float(actual), 1.0)
+    return max(a / e, e / a)
+
+
+def operator_rows(stats, estimates: Optional[dict]) -> List[dict]:
+    """Per-operator est/actual rows for a finished query — the web
+    UI's detail table and the ``/v1/query/<id>/operators`` endpoint
+    (annotated onto the timeline as ``operators``)."""
+    estimates = estimates or {}
+    rows = []
+    for (sig, occ), s in sorted(stats.by_key.items(),
+                                key=lambda kv: (kv[0][0][0], kv[0][1])):
+        if not s.get("invocations"):
+            continue
+        est = (estimates.get((sig, occ)) or {}).get("rows")
+        rows.append({
+            "node": sig[0], "occ": int(occ),
+            "rows": int(s["rows"]), "pages": int(s["invocations"]),
+            "wall_ms": round(float(s["wall_s"]) * 1e3, 3),
+            "bytes": int(s.get("bytes", 0)),
+            "est_rows": None if est is None else float(est),
+            "ratio": estimate_ratio(est, int(s["rows"])),
+        })
+    return rows
+
+
+def worst_estimate(stats, estimates: Optional[dict]) -> Optional[dict]:
+    """The worst estimate-vs-actual node of a finished query:
+    ``{"ratio", "node", "est", "actual"}`` over a QueryStats + the
+    plan's bind-time estimate map, or None when nothing is comparable.
+    Feeds the timeline annotation the doctor's ``misestimate`` rule
+    reads, the query-log completion line, and QueryCompletedEvent."""
+    if estimates is None:
+        return None
+    worst = None
+    for (sig, occ), s in list(stats.by_key.items()):
+        if not s.get("invocations"):
+            continue
+        est = (estimates.get((sig, occ)) or {}).get("rows")
+        ratio = estimate_ratio(est, int(s["rows"]))
+        if ratio is None:
+            continue
+        if worst is None or ratio > worst["ratio"]:
+            worst = {"ratio": float(ratio), "node": sig[0],
+                     "est": float(est), "actual": int(s["rows"])}
+    return worst
+
+
+# -- process default (the coordinator's store) ------------------------------
+_DEFAULT: Optional[PlanHistoryStore] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_history() -> PlanHistoryStore:
+    """The process-wide store.  A warehouse-backed runner replaces it
+    with a persisted one (set_default_history); otherwise an in-memory
+    store materializes on first use so ``feedback_stats`` works on any
+    catalog."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = PlanHistoryStore()
+        return _DEFAULT
+
+
+def set_default_history(store: Optional[PlanHistoryStore]) -> None:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = store
+
+
+def ensure_default_history(path: str) -> PlanHistoryStore:
+    """Install a persisted store at ``path`` unless one is already the
+    default — re-building a QueryRunner over the same warehouse must
+    not discard accumulated in-memory observations."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None or _DEFAULT.path != path:
+            _DEFAULT = PlanHistoryStore(path)
+        return _DEFAULT
+
+
+def history_path(warehouse_root: str) -> str:
+    return os.path.join(warehouse_root, _FILE)

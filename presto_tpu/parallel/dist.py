@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
+import time
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -52,6 +53,7 @@ from presto_tpu.exec.local import (
     GroupCapacityExceeded,
     LocalRunner,
     MaterializedResult,
+    QueryStats,
     concat_pages_device,
 )
 from presto_tpu.ops.join import JoinBuild, build_join, probe_expand, probe_join
@@ -310,19 +312,28 @@ class DistributedRunner:
         return self.mesh.devices.size
 
     # ------------------------------------------------------------------
-    def run(self, plan: PlanNode) -> MaterializedResult:
+    def run(self, plan: PlanNode,
+            stats: Optional["QueryStats"] = None) -> MaterializedResult:
         """Execute distributed; on an undistributable plan fall back to
         the coordinator LOUDLY: the reason is logged, kept on
         ``last_fallback_reason``, and surfaced through query events and
         EXPLAIN (TYPE DISTRIBUTED)'s FRAGMENTED header (VERDICT r3:
-        silent local fallback hid that no TPC-DS query distributed)."""
+        silent local fallback hid that no TPC-DS query distributed).
+
+        ``stats``: estimate-vs-actual roll-up sink — mesh stage roots
+        record their materialized output at the stage boundary, glue
+        breakers and the residual root record through the coordinator
+        runner's per-thread sink."""
         self.last_stage_count = 0
         self.last_fallback_reason = None
+        if stats is not None:
+            stats.register_plan(plan)  # idempotent — shared key space
+            self.local.stats = stats
         try:
             # per-run outcome rides the RESULT (dist_stages attached by
             # _run_distributed from its local stage count): concurrent
             # queries on one runner must not report each other's stats
-            out = self._run_distributed(plan)
+            out = self._run_distributed(plan, stats)
             out.dist_fallback = None
             return out
         except DistributedUnsupported as e:
@@ -337,8 +348,13 @@ class DistributedRunner:
             out.dist_stages = 0
             out.dist_fallback = reason
             return out
+        finally:
+            if stats is not None:
+                self.local.stats = None
 
-    def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
+    def _run_distributed(self, plan: PlanNode,
+                         qstats: Optional["QueryStats"] = None,
+                         ) -> MaterializedResult:
         """Generalized stage-DAG execution (PlanFragmenter.java:84 +
         SqlQueryScheduler.java:441 analog): ``lower_stages`` decomposes
         ANY plan bottom-up into mesh stages — aggregation stages and
@@ -365,43 +381,61 @@ class DistributedRunner:
 
         prog = current_progress()
 
-        def _staged(prefix, run):
+        def _staged(prefix, node, run):
+            t0 = time.perf_counter()
             if prog is None:
-                return run()
-            name = prog.new_stage_name(prefix)
-            prog.stage(name, splits_total=1)
-            page = run()
-            prog.split_done(name)
-            prog.finish_stage(name)
+                page = run()
+            else:
+                name = prog.new_stage_name(prefix)
+                prog.stage(name, splits_total=1)
+                page = run()
+                prog.split_done(name)
+                prog.finish_stage(name)
+            # estimate-vs-actual: a mesh stage's output is the one
+            # place the ORIGINAL node's actual is observable (sharded
+            # internals run rebuilt partial-step shapes)
+            if qstats is not None and qstats.actual_rows(node) is None:
+                import numpy as _np
+
+                rows = int(_np.asarray(page.row_mask).sum())
+                try:
+                    from presto_tpu.memory import page_bytes
+                    nb = page_bytes(page)
+                except Exception:
+                    nb = 0
+                qstats.record(node, time.perf_counter() - t0, rows, nb)
             return page
 
         def run_agg(node: AggregationNode) -> PrecomputedNode:
-            page = _staged("dist:aggregation", lambda: self._cached_stage(
+            page = _staged("dist:aggregation", node, lambda: self._cached_stage(
                 "agg", node, lambda: self.run_aggregation_stage(node)))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_chain(node: PlanNode, bound=None) -> PrecomputedNode:
-            page = _staged("dist:chain", lambda: self._cached_stage(
+            page = _staged("dist:chain", node, lambda: self._cached_stage(
                 "chain", node, lambda: self.run_chain_stage(node, bound),
                 bound=bound))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def eval_glue(node: PlanNode) -> PrecomputedNode:
+            # runs through self.local on this thread — the per-thread
+            # stats sink records it like any coordinator operator
             page = self.local.run_to_page(node)
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_window(node) -> PrecomputedNode:
-            page = _staged("dist:window", lambda: self._cached_stage(
+            page = _staged("dist:window", node, lambda: self._cached_stage(
                 "window", node, lambda: self.run_window_stage(node)))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_sort(node) -> PrecomputedNode:
-            page = _staged("dist:sort", lambda: self._cached_stage(
+            page = _staged("dist:sort", node, lambda: self._cached_stage(
                 "sort", node, lambda: self.run_sort_stage(node)))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_union(node) -> PrecomputedNode:
-            page = _staged("dist:union", lambda: self.run_union_stage(node))
+            page = _staged("dist:union", node,
+                           lambda: self.run_union_stage(node))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         splices: List = []

@@ -113,11 +113,20 @@ class Fragment:
         pad = "  " * indent
         bound = "" if self.shard_bound is None \
             else f" shard_bound={self.shard_bound}"
+        # stats-calculator row estimate on the stage edge: what the
+        # planner believes travels over this exchange (estimate-vs-
+        # actual closes the loop in EXPLAIN ANALYZE; this is the est
+        # half at fragment granularity)
+        try:
+            est = estimate_rows(self.root)
+        except Exception:
+            est = None
+        est_s = "" if est is None else f" ~{est} rows"
         lines = [
             f"{pad}Fragment {self.fid} [{self.distribution}] "
             f"=> output [{self.output}] via {self.exchange_str()} "
             f"root={type(self.root).__name__}"
-            f"{bound}"
+            f"{bound}{est_s}"
         ]
         for ch in self.children:
             lines.append(ch.tree_str(indent + 1))

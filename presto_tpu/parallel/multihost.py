@@ -119,6 +119,13 @@ class WorkerClient:
         # GenerateTraceTokenRequestFilter contract): every task POST
         # carries it so worker-side spans stitch into the query's trace
         self.trace_token: Optional[str] = None
+        # estimate-vs-actual roll-up: when the runner installs a sink,
+        # every task POST asks the worker to record per-operator
+        # actuals, and delete_task fetches the FINISHED task's stats
+        # snapshot before dropping it — delete is the one chokepoint
+        # every task path (streamed, two-stage, retried) goes through
+        self.collect_stats = False
+        self.stats_sink = None  # (task id, wire entries) -> None
 
     def _ok(self) -> None:
         self.alive = True
@@ -186,6 +193,8 @@ class WorkerClient:
         body_dict = {"fragment": fragment_json}
         if output_spec is not None:
             body_dict["output"] = output_spec
+        if self.collect_stats:
+            body_dict["collect_stats"] = True
         body = json.dumps(body_dict).encode()
         headers = {"Content-Type": "application/json"}
         if self.trace_token:
@@ -233,6 +242,19 @@ class WorkerClient:
         return raws
 
     def delete_task(self, tid: str) -> None:
+        if self.stats_sink is not None:
+            # fetch-before-delete: only a FINISHED task's snapshot
+            # merges (a retried attempt's partial stats would double-
+            # count rows the fresh attempt recounts); best-effort like
+            # the delete itself
+            try:
+                req = urllib.request.Request(f"{self.uri}/v1/task/{tid}")
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    status = json.load(resp)
+                if status.get("state") == "FINISHED" and status.get("stats"):
+                    self.stats_sink(tid, status["stats"])
+            except Exception:
+                pass
         try:
             req = urllib.request.Request(
                 f"{self.uri}/v1/task/{tid}", method="DELETE")
@@ -343,8 +365,11 @@ class MultiHostRunner:
         # query-JSON stats
         self.fallback_count = 0
         self.last_fallback_reason: Optional[str] = None
+        # estimate-vs-actual plane: a caller-provided QueryStats that
+        # worker-task snapshots merge into (see run()); None = off
+        self.stats = None
 
-    def run(self, plan: PlanNode) -> MaterializedResult:
+    def run(self, plan: PlanNode, stats=None) -> MaterializedResult:
         from presto_tpu.obs import METRICS, current_tracer
 
         self.last_gather_rows = 0  # rows pulled to the coordinator
@@ -356,13 +381,36 @@ class MultiHostRunner:
         # concurrency: the token is per-runner, like last_assignments)
         tr = current_tracer()
         token = tr.trace_token if tr is not None else None
+        # distributed actuals roll-up: workers record per-operator
+        # stats (one device sync per page — opt-in), and every task's
+        # FINISHED snapshot merges here by structural key.  Dedupe by
+        # task id: retried fragments use fresh tids, but a double
+        # delete of one tid must not double-count.
+        qstats = stats if stats is not None else self.stats
+        if qstats is not None:
+            qstats.register_plan(plan)  # idempotent — shared key space
+        seen_tids = set()
+
+        def sink(tid: str, entries) -> None:
+            if tid in seen_tids:
+                return
+            seen_tids.add(tid)
+            qstats.merge_wire(entries)
+
         for w in self.workers:
             w.trace_token = token
+            w.collect_stats = qstats is not None
+            w.stats_sink = sink if qstats is not None else None
+        if qstats is not None:
+            # coordinator-side halves (glue breakers, residual root,
+            # final merges) record through the local runner's per-
+            # thread sink on THIS thread
+            self.local.stats = qstats
         try:
             # per-run outcome rides the RESULT (dist_stages attached by
             # _run_distributed from its local stage count): concurrent
             # queries on one runner must not swap each other's stats
-            out = self._run_distributed(plan)
+            out = self._run_distributed(plan, qstats)
             out.dist_fallback = None
             # per-run count off the RESULT, not the shared field a
             # concurrent run may have reset (same rule as dist_stages)
@@ -380,6 +428,12 @@ class MultiHostRunner:
             out.dist_stages = 0
             out.dist_fallback = reason
             return out
+        finally:
+            if qstats is not None:
+                self.local.stats = None
+            for w in self.workers:
+                w.collect_stats = False
+                w.stats_sink = None
 
     def _live_workers(self) -> List["WorkerClient"]:
         """Workers eligible for fragment assignment: the failure
@@ -401,7 +455,8 @@ class MultiHostRunner:
         return alive
 
     # ------------------------------------------------------------------
-    def _run_distributed(self, plan: PlanNode) -> MaterializedResult:
+    def _run_distributed(self, plan: PlanNode,
+                         qstats=None) -> MaterializedResult:
         """Generalized stage-DAG execution at the DCN tier — the same
         bottom-up ``lower_stages`` decomposition the mesh tier runs
         (PlanFragmenter.java:84 + SqlQueryScheduler.java:441):
@@ -415,28 +470,50 @@ class MultiHostRunner:
             lower_stages, set_child, undistributable_reason,
         )
 
+        def staged(node, run):
+            """Run one stage, recording its output rows onto the
+            ORIGINAL plan node when nothing else did: worker fragments
+            whose root is structurally the coordinator's node (chain
+            stages) already merged by key, but rebuilt-shape stages
+            (partial/final agg splits, per-shard window/sort) report
+            under their own signatures — the stage boundary is the one
+            place the original node's actual is observable."""
+            t0 = time.perf_counter()
+            page = run()
+            if qstats is not None and qstats.actual_rows(node) is None:
+                rows = int(np.asarray(page.row_mask).sum())
+                try:
+                    from presto_tpu.memory import page_bytes
+                    nb = page_bytes(page)
+                except Exception:
+                    nb = 0
+                qstats.record(node, time.perf_counter() - t0, rows, nb)
+            return page
+
         def run_agg(node: AggregationNode) -> PrecomputedNode:
-            page = self._stage_agg(node)
+            page = staged(node, lambda: self._stage_agg(node))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_chain(node: PlanNode, bound=None) -> PrecomputedNode:
-            page = self._stage_chain(node, bound)
+            page = staged(node, lambda: self._stage_chain(node, bound))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def eval_glue(node: PlanNode) -> PrecomputedNode:
+            # runs through self.local on this thread — the per-thread
+            # stats sink records it like any local operator
             page = self.local.run_to_page(node)
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_window(node) -> PrecomputedNode:
-            page = self._stage_window(node)
+            page = staged(node, lambda: self._stage_window(node))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_sort(node) -> PrecomputedNode:
-            page = self._stage_sort(node)
+            page = staged(node, lambda: self._stage_sort(node))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         def run_union(node) -> PrecomputedNode:
-            page = self._stage_union(node)
+            page = staged(node, lambda: self._stage_union(node))
             return PrecomputedNode(page=page, channel_list=node.channels)
 
         splices: List = []

@@ -586,7 +586,8 @@ class OutputNode(PlanNode):
 
 
 def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
-                  exclusive=None, mem=None) -> str:
+                  exclusive=None, mem=None, estimates=None,
+                  misestimate_factor: float = 8.0, _keys=None) -> str:
     """EXPLAIN-style rendering (planPrinter/PlanPrinter.java analog);
     pass the executor's QueryStats for EXPLAIN ANALYZE annotations and a
     planner StatsCalculator for cost estimates ({rows: N} like the
@@ -594,11 +595,27 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
     to per-operator EXCLUSIVE seconds (EXPLAIN ANALYZE VERBOSE — fused
     chains re-run prefix-by-prefix; OperatorStats.java:38 analog).
     ``mem`` maps ``id(node)`` to peak reserved bytes from the tagged
-    memory reservations (EXPLAIN ANALYZE per-operator memory)."""
+    memory reservations (EXPLAIN ANALYZE per-operator memory).
+
+    ``estimates`` is the binder's bind-time estimate map
+    (``plan._estimates``, keyed by the structural stats keys); with
+    ``stats`` it turns every operator line into an estimate-vs-actual
+    line — ``est: X rows · actual: Y rows (×Z)`` — flagging nodes whose
+    ratio exceeds ``misestimate_factor`` in either direction."""
     if estimator is None and stats is None and indent == 0:
         from presto_tpu.planner.stats import StatsCalculator
 
         estimator = StatsCalculator()
+    if indent == 0 and estimates is None and stats is not None:
+        estimates = getattr(node, "_estimates", None)
+    if estimates is not None and _keys is None:
+        # one shared key walk for the whole render (the same walk that
+        # registered the stats entries), so twins resolve by occurrence
+        from presto_tpu.exec.local import plan_node_keys
+
+        _keys = {}
+        for n, key in plan_node_keys(node):
+            _keys.setdefault(id(n), key)
     pad = "  " * indent
     name = type(node).__name__.replace("Node", "")
     detail = ""
@@ -617,6 +634,24 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
     elif isinstance(node, (LimitNode, TopNNode)):
         detail = f" {node.count}"
     ann = stats.annotation(node) if stats is not None else ""
+    if stats is not None and estimates is not None:
+        from presto_tpu.obs.history import estimate_ratio
+
+        key = _keys.get(id(node)) if _keys is not None else None
+        est = (estimates.get(key) or {}).get("rows") if key else None
+        actual = stats.actual_rows(node)
+        if est is not None:
+            line = f"  est: {int(est)} rows"
+            if actual is not None:
+                ratio = estimate_ratio(est, actual)
+                line += f" · actual: {actual} rows (×{ratio:.1f})"
+                if ratio >= misestimate_factor:
+                    line += " ** MISESTIMATE **"
+            else:
+                # fused chain interior: its pages never stream
+                # individually, so there is no per-node actual
+                line += " · actual: n/a"
+            ann += line
     if exclusive is not None and node in exclusive:
         ann += f"  [excl={exclusive[node] * 1e3:.1f}ms]"
     if mem is not None and id(node) in mem:
@@ -631,5 +666,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0, stats=None, estimator=None,
             pass
     out = f"{pad}- {name}{detail}{ann}\n"
     for s in node.sources:
-        out += plan_tree_str(s, indent + 1, stats, estimator, exclusive, mem)
+        out += plan_tree_str(s, indent + 1, stats, estimator, exclusive, mem,
+                             estimates=estimates,
+                             misestimate_factor=misestimate_factor,
+                             _keys=_keys)
     return out

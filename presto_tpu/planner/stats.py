@@ -77,6 +77,12 @@ class StatsCalculator:
 
     def __init__(self):
         self._memo: Dict[int, Tuple[PlanNode, PlanEstimate]] = {}
+        # feedback loop (obs/history.py HistoricalStatsProvider): when
+        # set, observed row counts from prior executions override the
+        # textbook rules on structural-signature match — the binder
+        # installs it per plan when the `feedback_stats` session
+        # property is on
+        self.history = None
 
     def rows(self, node: PlanNode) -> float:
         return self.estimate(node).rows
@@ -87,6 +93,15 @@ class StatsCalculator:
             return got[1]
         est = self._compute(node)
         est.rows = max(est.rows, 0.0)
+        if self.history is not None:
+            try:
+                observed = self.history.observed_rows(node)
+            except Exception:
+                observed = None  # a corrupt store must not fail planning
+            if observed is not None:
+                # observed actuals beat textbook selectivities; column
+                # estimates stay — only the cardinality is fed back
+                est = dataclasses.replace(est, rows=float(observed))
         from presto_tpu.planner.plan import PrecomputedNode
 
         if not isinstance(node, PrecomputedNode):  # don't pin device pages
@@ -348,3 +363,27 @@ class StatsCalculator:
         new_ndv = None if c.ndv is None else max(c.ndv * frac, 1.0)
         cols[col.index] = ColumnEstimate(new_dom, new_ndv)
         return max(frac, 1e-4)
+
+
+def capture_estimates(plan: PlanNode, calc: Optional[StatsCalculator] = None
+                      ) -> Dict[tuple, dict]:
+    """Stamp the whole plan with its bind-time estimates, keyed by the
+    SAME ``((type name, structural digest), occurrence)`` ids
+    ``QueryStats.register_plan`` assigns — so estimates and actuals
+    share one key space by construction.  The binder attaches the
+    result as ``plan._estimates``; EXPLAIN ANALYZE and the history
+    feed read it back per node."""
+    from presto_tpu.exec.local import plan_node_keys
+
+    if calc is None:
+        calc = StatsCalculator()
+    out: Dict[tuple, dict] = {}
+    for node, key in plan_node_keys(plan):
+        if key in out:
+            continue  # structural twins: the first occurrence-keyed hit wins
+        try:
+            est = calc.estimate(node)
+        except Exception:
+            continue  # an unestimable node renders without an estimate
+        out[key] = {"rows": float(est.rows)}
+    return out

@@ -102,6 +102,21 @@ class QueryRunner:
             "system.runtime.kill_query": self._kill_query_procedure,
         }
         self.executor = self._make_executor()
+        # estimate-vs-actual: a warehouse-backed catalog persists its
+        # plan history next to the metastore (obs/history.py); catalogs
+        # without a warehouse share the process in-memory store
+        try:
+            from presto_tpu.obs.history import (
+                ensure_default_history, history_path,
+            )
+            from presto_tpu.storage.warehouse import WarehouseConnector
+
+            for _c in catalog._connectors.values():
+                if isinstance(_c, WarehouseConnector):
+                    ensure_default_history(history_path(_c.root))
+                    break
+        except Exception:
+            pass  # history must never block runner construction
         # plan cache: repeated executions of the same SQL reuse the same
         # plan-node identities, so the executor's compiled-chain caches
         # hit and nothing retraces (ExpressionCompiler's cache role,
@@ -221,13 +236,17 @@ class QueryRunner:
                         prepared = self._result_cache_prepared(plan)
                     planning_s = time.perf_counter() - t1
                     t1 = time.perf_counter()
+                    # estimate-vs-actual: per-operator actuals sink,
+                    # opt-in (one device sync per page)
+                    qstats = (QueryStats()
+                              if self.session.get("collect_stats") else None)
                     with obs.span("execute", cat="lifecycle"):
                         res = None
                         if prepared is not None:
                             res = self._result_cache_hit(plan, prepared)
                             cache_hit = res is not None
                         if res is None:
-                            res = self._run_plan(plan, qid)
+                            res = self._run_plan(plan, qid, stats=qstats)
                     execution_s = time.perf_counter() - t1
                 except Exception as e:
                     obs.METRICS.counter("query.failed").inc()
@@ -295,6 +314,28 @@ class QueryRunner:
             # (query-log `findings` field)
             wall_ms = ((res.planning_ms or 0.0) + (res.execution_ms or 0.0))
             queued_ms = memory_blocked_ms = None
+            # estimate-vs-actual attribution: the worst-node ratio is
+            # annotated BEFORE the doctor runs (its `misestimate` rule
+            # reads it), feeds the plan-history store, and rides the
+            # result + completion event + query-log line
+            worst = None
+            if qstats is not None and not cache_hit:
+                from presto_tpu.obs.history import (
+                    default_history, operator_rows, worst_estimate,
+                )
+
+                est_map = getattr(plan, "_estimates", None)
+                worst = worst_estimate(qstats, est_map)
+                if timeline is not None:
+                    if worst is not None:
+                        timeline.annotate("worst_estimate", worst)
+                    # per-operator detail rows for the web UI /
+                    # /v1/query/<id>/operators endpoint
+                    timeline.annotate(
+                        "operators", operator_rows(qstats, est_map))
+                default_history().record_query(qstats, est_map)
+            res.worst_estimate = worst
+            res.worst_estimate_ratio = worst["ratio"] if worst else None
             if timeline is not None:
                 timeline.annotate("wall_ms", wall_ms)
                 if dist_fallback:
@@ -317,6 +358,7 @@ class QueryRunner:
                 execution_ms=res.execution_ms, cache_hit=cache_hit,
                 queued_ms=queued_ms, memory_blocked_ms=memory_blocked_ms,
                 findings=findings,
+                worst_estimate_ratio=res.worst_estimate_ratio,
             ))
             return res
 
@@ -382,12 +424,27 @@ class QueryRunner:
             elif stmt.analyze:
                 stats = QueryStats()
                 stats.register_plan(plan)
-                self.executor.stats = stats
-                try:
-                    self.executor.run(plan)
-                finally:
-                    self.executor.stats = None
-                text = self.executor.explain_with_stats(plan, stats)
+                if self.session.get("distributed"):
+                    # a distributed session's ANALYZE must execute on
+                    # the tier the query would actually use — running
+                    # local-only silently dropped every worker-fragment
+                    # operator from the output
+                    self._distributed().run(plan, stats=stats)
+                else:
+                    self.executor.stats = stats
+                    try:
+                        self.executor.run(plan)
+                    finally:
+                        self.executor.stats = None
+                text = self.executor.explain_with_stats(
+                    plan, stats, misestimate_factor=float(
+                        self.session.get("misestimate_factor")))
+                # analyze runs feed the plan-history store like any
+                # stats-collecting execution
+                from presto_tpu.obs.history import default_history
+
+                default_history().record_query(
+                    stats, getattr(plan, "_estimates", None))
             else:
                 text = self.executor.explain(plan)
             return MaterializedResult(["Query Plan"], [VARCHAR], [(text,)])
@@ -1061,18 +1118,29 @@ class QueryRunner:
         names, types, rows = got
         return MaterializedResult(list(names), list(types), list(rows))
 
-    def _run_plan(self, plan, query_id=None):
+    def _run_plan(self, plan, query_id=None, stats=None):
         """Route through the device-mesh tier when ``SET SESSION
         distributed = true`` and the plan shape distributes; otherwise
         (or on DistributedUnsupported) the local executor.  The query
         scope tags streaming-exchange buffers with the query id so a
         deadline/memory kill (pool.kill_query) aborts them and unblocks
-        backpressured producer threads."""
+        backpressured producer threads.
+
+        ``stats``: per-operator actuals sink (``collect_stats`` /
+        EXPLAIN ANALYZE) — threaded into whichever tier executes so
+        estimate-vs-actual attribution works on every path."""
         from presto_tpu.parallel.streams import query_scope
 
         with query_scope(query_id):
             if self.session.get("distributed"):
-                return self._distributed().run(plan)
+                return self._distributed().run(plan, stats=stats)
+            if stats is not None:
+                stats.register_plan(plan)
+                self.executor.stats = stats
+                try:
+                    return self.executor.run(plan, query_id=query_id)
+                finally:
+                    self.executor.stats = None
             return self.executor.run(plan, query_id=query_id)
 
     def _distributed(self):

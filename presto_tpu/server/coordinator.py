@@ -137,6 +137,24 @@ async function detail(id){
   } else if (!pr.ok) {
     html += '<div>no progress or trace recorded for this query</div>';
   }
+  const or_ = await fetch(`/v1/query/${id}/operators`);
+  if (or_.ok){
+    // per-operator est/actual rows (collect_stats sessions)
+    const o = await or_.json();
+    if (o.operators && o.operators.length){
+      html += '<h2>operators</h2><table><thead><tr><th>operator</th>'+
+              '<th>est rows</th><th>actual rows</th><th>ratio</th>'+
+              '<th>pages</th><th>wall ms</th></tr></thead><tbody>';
+      for (const op of o.operators){
+        html += `<tr><td>${op.node}#${op.occ}</td>`+
+          `<td>${op.est_rows==null?'':Number(op.est_rows).toFixed(0)}</td>`+
+          `<td>${op.rows}</td>`+
+          `<td>${op.ratio==null?'':'×'+Number(op.ratio).toFixed(1)}</td>`+
+          `<td>${op.pages}</td><td>${op.wall_ms}</td></tr>`;
+      }
+      html += '</tbody></table>';
+    }
+  }
   const dr = await fetch(`/v1/query/${id}/doctor`);
   if (dr.ok){
     // post-query diagnosis (obs/doctor.py): ranked bottleneck findings
@@ -498,6 +516,25 @@ class CoordinatorServer:
                                                   f"query {parts[2]}"})
                         return
                     self._json(200, tl.snapshot())
+                    return
+                if len(parts) == 4 and parts[:2] == ["v1", "query"] \
+                        and parts[3] == "operators":
+                    # per-operator est/actual rows annotated at query
+                    # completion (SET SESSION collect_stats = true) —
+                    # the web UI's operator detail table
+                    from presto_tpu import obs
+
+                    tl = obs.timeline_for(parts[2])
+                    ops = tl.annotation("operators") if tl is not None \
+                        else None
+                    if ops is None:
+                        self._json(404, {"error": "no operator stats for "
+                                                  f"query {parts[2]} (SET "
+                                                  "SESSION collect_stats "
+                                                  "= true)"})
+                        return
+                    self._json(200, {"queryId": parts[2],
+                                     "operators": ops})
                     return
                 if len(parts) == 4 and parts[:2] == ["v1", "query"] \
                         and parts[3] == "doctor":

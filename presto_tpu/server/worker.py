@@ -55,6 +55,11 @@ class _Task:
         self.state = RUNNING
         self.error: Optional[str] = None
         self.last_access = time.monotonic()
+        # per-operator actuals of the fragment (QueryStats.to_wire),
+        # set once at FINISHED — the task-completion half of the
+        # estimate-vs-actual roll-up (None when the coordinator did
+        # not ask: recording costs one device sync per page)
+        self.stats_wire: Optional[list] = None
 
     @property
     def buffer(self) -> TaskOutputBuffer:
@@ -272,7 +277,8 @@ class WorkerServer:
                         return
                     self._send(200, json.dumps(
                         {"taskId": task.task_id, "state": task.state,
-                         "error": task.error}).encode())
+                         "error": task.error,
+                         "stats": task.stats_wire}).encode())
                     return
                 self._send(404, b"{}")
 
@@ -310,7 +316,8 @@ class WorkerServer:
                     tid = m.group(1)
                     task = outer._create_task(
                         tid, req["fragment"], req.get("output"),
-                        trace_token=self.headers.get("X-Presto-Trace-Token"))
+                        trace_token=self.headers.get("X-Presto-Trace-Token"),
+                        collect_stats=bool(req.get("collect_stats")))
                     self._send(200, json.dumps(
                         {"taskId": tid, "state": task.state}).encode())
                     return
@@ -363,7 +370,8 @@ class WorkerServer:
     # ------------------------------------------------------------------
     def _create_task(self, task_id: str, fragment_json: dict,
                      output_spec: Optional[dict] = None,
-                     trace_token: Optional[str] = None) -> _Task:
+                     trace_token: Optional[str] = None,
+                     collect_stats: bool = False) -> _Task:
         """``output_spec``: ``{"partitions": K, "key_indices": [...],
         "domains": [[lo,hi]|null...]}`` routes each produced page's rows
         into K per-partition buffers by key hash (the
@@ -399,6 +407,17 @@ class WorkerServer:
             memory context re-binds around every step."""
             try:
                 fragment = plan_from_json(fragment_json, self.catalog)
+                # per-task stats sink, rebound around every quantum
+                # like the memory context (runner threads can change
+                # between steps).  Keys are the stable structural ids,
+                # so the wire snapshot merges onto the coordinator's
+                # entries even though this plan was rebuilt from JSON.
+                tstats = None
+                if collect_stats:
+                    from presto_tpu.exec.local import QueryStats
+
+                    tstats = QueryStats()
+                    tstats.register_plan(fragment)
                 partition_fn = None
                 check_partial_mg = None
                 if output_spec is not None:
@@ -445,6 +464,8 @@ class WorkerServer:
                         raise BufferAborted()
                     if mem_ctx is not None:
                         self.runner._mem = mem_ctx
+                    if tstats is not None:
+                        self.runner.stats = tstats
                     try:
                         # tracer re-binds around every quantum exactly
                         # like the memory context: runner threads can
@@ -456,6 +477,8 @@ class WorkerServer:
                     finally:
                         if mem_ctx is not None:
                             self.runner._mem = None
+                        if tstats is not None:
+                            self.runner.stats = None
                     if partition_fn is None:
                         raw = serialize_page(p)
                         if self.faults.enabled:
@@ -480,6 +503,10 @@ class WorkerServer:
                                         raw, self.node_id)
                                 task.buffers[k].enqueue(raw)
                     yield
+                if tstats is not None:
+                    # publish BEFORE the state flip: a consumer that
+                    # observes FINISHED must find the stats attached
+                    task.stats_wire = tstats.to_wire()
                 task.state = FINISHED
                 for buf in task.buffers:
                     buf.set_complete()

@@ -181,6 +181,21 @@ SYSTEM_PROPERTIES = [
         "prior execution (docs/serving.md)",
         False, _bool,
     ),
+    PropertyMetadata(
+        "feedback_stats",
+        "let the planner consult the plan-history store: observed row "
+        "counts from prior executions override textbook selectivities "
+        "on structural-signature match (obs/history.py; "
+        "docs/observability.md 'Estimate vs actual')",
+        False, _bool,
+    ),
+    PropertyMetadata(
+        "misestimate_factor",
+        "flag EXPLAIN ANALYZE operators whose actual/estimate row "
+        "ratio exceeds this factor (either direction); also the doctor "
+        "misestimate rule's evidence threshold source",
+        8.0, float,
+    ),
 ]
 
 

@@ -711,6 +711,21 @@ class Binder:
     def plan_ast(self, q: ast.Node,
                  validate_rewrites: Optional[bool] = None) -> OutputNode:
         self._now = None  # fresh instant for this statement
+        # feedback loop: under the `feedback_stats` session property the
+        # stats calculator consults the plan-history store (observed
+        # actuals from prior executions override textbook selectivities
+        # on structural-signature match).  Resolved per statement — the
+        # session can toggle it between queries on this binder.
+        self._stats.history = None
+        if self.session is not None and bool(
+                self.session.get("feedback_stats")):
+            from presto_tpu.obs.history import (
+                HistoricalStatsProvider, default_history,
+            )
+
+            store = default_history()
+            if store is not None:
+                self._stats.history = HistoricalStatsProvider(store)
         try:
             from presto_tpu import analysis
 
@@ -742,6 +757,14 @@ class Binder:
             out = opt.optimize(out)
             out._optimizer_report = opt.stats
             self._enable_index_joins(out)
+            # estimate capture: stamp the FINAL plan with its bind-time
+            # row estimates under the structural stats keys, so EXPLAIN
+            # ANALYZE can print est-vs-actual per operator and the
+            # history store can attribute misestimates (planner/stats.
+            # capture_estimates; feedback applied above via _stats.history)
+            from presto_tpu.planner.stats import capture_estimates
+
+            out._estimates = capture_estimates(out, self._stats)
             return out
         except (BindError, SyntaxError):
             raise

@@ -1,0 +1,357 @@
+"""Estimate-vs-actual plan observability (docs/observability.md
+"Estimate vs actual"): bind-time estimates stamped under the structural
+stats keys, the distributed per-operator actuals roll-up, EXPLAIN
+ANALYZE est/actual annotations, the persisted plan-history store
+(``system_plan_history``), and the ``feedback_stats`` replan loop.
+
+Reference analogs: HistoryBasedPlanStatisticsProvider and the
+PlanNodeStatsEstimate-vs-OperatorStats comparison PlanPrinter renders
+for EXPLAIN ANALYZE."""
+
+import os
+import re
+
+import pytest
+
+from presto_tpu.catalog import Catalog
+from presto_tpu.connectors.system import QueryHistory, SystemConnector
+from presto_tpu.connectors.tpch import Tpch
+from presto_tpu.exec.local import QueryStats
+from presto_tpu.obs import doctor
+from presto_tpu.obs.history import (
+    PlanHistoryStore,
+    default_history,
+    estimate_ratio,
+    history_path,
+    operator_rows,
+    set_default_history,
+    worst_estimate,
+)
+from presto_tpu.obs.timeseries import QueryTimeline
+from presto_tpu.runner import QueryRunner
+from presto_tpu.storage.warehouse import WarehouseConnector
+from presto_tpu.testing import DistributedQueryRunner, LocalQueryRunner
+
+from tests.tpch_queries import QUERIES
+
+
+@pytest.fixture(autouse=True)
+def _fresh_history():
+    """Each test gets a clean process-default history store."""
+    set_default_history(None)
+    yield
+    set_default_history(None)
+
+
+def make_runner(sf=0.001, split_rows=4096):
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=sf, split_rows=split_rows))
+    history = QueryHistory()
+    catalog.register("system", SystemConnector(history))
+    runner = QueryRunner(catalog)
+    runner.events.add(history)
+    return runner, history
+
+
+# ---------------------------------------------------------------------------
+# unit layer: ratio math, worst-node attribution, operator rows
+# ---------------------------------------------------------------------------
+
+def _stats_from(entries):
+    qs = QueryStats()
+    qs.merge_wire(entries)
+    return qs
+
+
+def test_estimate_ratio_math():
+    assert estimate_ratio(None, 5) is None
+    assert estimate_ratio(10.0, 10) == 1.0
+    assert estimate_ratio(10.0, 1000) == 100.0  # underestimate
+    assert estimate_ratio(1000.0, 10) == 100.0  # overestimate, same factor
+    # both sides floored at one row: estimated-0/actual-0 never divides
+    assert estimate_ratio(0.0, 0) == 1.0
+
+
+def test_worst_estimate_and_operator_rows():
+    qs = _stats_from([
+        {"node": "FilterNode", "digest": "d1", "occ": 0,
+         "invocations": 2, "rows": 900, "wall_s": 0.01, "bytes": 64},
+        {"node": "TableScanNode", "digest": "d2", "occ": 0,
+         "invocations": 1, "rows": 1000, "wall_s": 0.02, "bytes": 128},
+    ])
+    est = {(("FilterNode", "d1"), 0): {"rows": 9.0},
+           (("TableScanNode", "d2"), 0): {"rows": 1000.0}}
+    w = worst_estimate(qs, est)
+    assert w["node"] == "FilterNode"
+    assert w["ratio"] == 100.0
+    assert w["est"] == 9.0 and w["actual"] == 900
+    # no estimate map (plain queries planned before the feature): None
+    assert worst_estimate(qs, None) is None
+
+    ops = operator_rows(qs, est)
+    assert [o["node"] for o in ops] == ["FilterNode", "TableScanNode"]
+    f = ops[0]
+    assert f["rows"] == 900 and f["pages"] == 2 and f["bytes"] == 64
+    assert f["est_rows"] == 9.0 and f["ratio"] == 100.0
+    assert ops[1]["ratio"] == 1.0
+
+
+def test_doctor_misestimate_rule():
+    tl = QueryTimeline("misest-unit")
+    tl.annotate("worst_estimate", {"ratio": 64.0, "node": "JoinNode",
+                                   "est": 10.0, "actual": 640})
+    findings = doctor.diagnose(timeline=tl, wall_ms=50.0)
+    f = next(f for f in findings if f.rule == "misestimate")
+    assert "JoinNode" in f.summary and "feedback_stats" in f.summary
+    assert 0.0 < f.score <= 1.0
+    assert f.evidence["ratio"] == 64.0
+    # below the 8x threshold: silent
+    tl2 = QueryTimeline("misest-unit-ok")
+    tl2.annotate("worst_estimate", {"ratio": 2.0, "node": "FilterNode",
+                                    "est": 10.0, "actual": 20})
+    assert not [f for f in doctor.diagnose(timeline=tl2, wall_ms=50.0)
+                if f.rule == "misestimate"]
+
+
+# ---------------------------------------------------------------------------
+# plan-history store: round-trip, LRU bound, incarnation across restart
+# ---------------------------------------------------------------------------
+
+def test_history_store_roundtrip_and_lru(tmp_path):
+    path = history_path(str(tmp_path))
+    store = PlanHistoryStore(path, limit=3)
+    for i in range(5):
+        store.observe("FilterNode", f"d{i}", 10 * i, est_rows=1.0)
+    assert len(store) == 3  # LRU by update sequence
+    store.observe("FilterNode", "d4", 50, est_rows=5.0)
+    store.save()
+
+    reopened = PlanHistoryStore(path)
+    assert reopened.incarnation == store.incarnation
+    assert reopened.version == store.version
+    assert reopened.observed_rows("FilterNode", "d4") == 45.0  # (40+50)/2
+    assert reopened.observed_rows("FilterNode", "d0") is None  # evicted
+
+
+def test_plan_history_survives_coordinator_restart(tmp_path):
+    """End to end: a warehouse-backed runner installs a persisted
+    default store; a fresh runner over the same root (the coordinator
+    restart) reloads it with incarnation and observations intact, and
+    ``system_plan_history`` serves the reloaded rows."""
+    root = str(tmp_path / "wh")
+
+    def mk():
+        catalog = Catalog()
+        catalog.register("tpch", Tpch(sf=0.002, split_rows=1024))
+        catalog.register("wh", WarehouseConnector(root), writable=True)
+        catalog.register("system", SystemConnector(QueryHistory()))
+        return QueryRunner(catalog)
+
+    r1 = mk()
+    store1 = default_history()
+    assert store1.path == history_path(root)
+    r1.execute("EXPLAIN ANALYZE SELECT count(*) FROM lineitem"
+               " WHERE l_quantity < 10")
+    assert store1.rows(), "EXPLAIN ANALYZE fed no observations"
+    assert os.path.exists(history_path(root))
+    inc, version = store1.incarnation, store1.version
+    assert version >= 1
+
+    set_default_history(None)  # process restart
+    r2 = mk()
+    store2 = default_history()
+    assert store2 is not store1
+    assert store2.incarnation == inc
+    assert store2.version == version
+    assert {e["digest"] for e in store2.rows()} == \
+        {e["digest"] for e in store1.rows()}
+    got = r2.execute("SELECT count(*) FROM system_plan_history").rows[0][0]
+    assert got == len(store2.rows()) > 0
+
+
+def test_system_plan_history_table():
+    runner, _ = make_runner()
+    runner.execute("EXPLAIN ANALYZE SELECT count(*) FROM lineitem"
+                   " WHERE l_quantity < 10")
+    rows = runner.execute(
+        "SELECT node_type, observations, rows_last, ratio_last"
+        " FROM system_plan_history").rows
+    assert rows
+    assert "AggregationNode" in {r[0] for r in rows}
+    for _nt, n, last, _ratio in rows:
+        assert n >= 1 and last >= 0
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN surfaces
+# ---------------------------------------------------------------------------
+
+_OP_LINE = re.compile(r"^\s*- ")
+
+
+@pytest.fixture(scope="module")
+def sweep_runner():
+    catalog = Catalog()
+    catalog.register("tpch", Tpch(sf=0.001, split_rows=4096))
+    return QueryRunner(catalog)
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_explain_analyze_est_actual_every_operator(sweep_runner, qid):
+    """Every operator line of every TPC-H EXPLAIN ANALYZE carries both
+    an estimate and an actual field (fused interiors render
+    ``actual: n/a`` — still present, never silently missing)."""
+    text = sweep_runner.execute(
+        "EXPLAIN ANALYZE " + QUERIES[qid]).rows[0][0]
+    ops = [ln for ln in text.splitlines() if _OP_LINE.match(ln)]
+    assert ops, text
+    for ln in ops:
+        assert "est:" in ln, f"q{qid} line missing estimate: {ln!r}"
+        assert "actual:" in ln, f"q{qid} line missing actual: {ln!r}"
+
+
+def test_explain_analyze_flags_misestimate():
+    """An engineered 100x join underestimate renders the
+    ``** MISESTIMATE **`` flag and the worst-estimate header, and the
+    flag threshold follows the misestimate_factor session property."""
+    r = LocalQueryRunner()
+    r.execute("CREATE TABLE mem.mx AS SELECT l_orderkey * 0 AS j"
+              " FROM tpch.lineitem LIMIT 100")
+    r.execute("CREATE TABLE mem.my AS SELECT l_orderkey * 0 AS j"
+              " FROM tpch.lineitem LIMIT 150")
+    sql = "SELECT count(*) FROM mem.mx x JOIN mem.my y ON x.j = y.j"
+    text = r.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+    assert "** MISESTIMATE **" in text
+    assert "worst estimate:" in text
+    # a looser factor silences the flag (same plan, fresh cache key)
+    r.session.set("misestimate_factor", 1e6)
+    text2 = r.execute("EXPLAIN ANALYZE  " + sql).rows[0][0]
+    assert "** MISESTIMATE **" not in text2
+
+
+def test_explain_distributed_edge_row_estimates():
+    """EXPLAIN (TYPE DISTRIBUTED) prints the stats-calculator row
+    estimate on every stage edge next to the exchange kind."""
+    runner, _ = make_runner()
+    text = runner.execute(
+        "EXPLAIN (TYPE DISTRIBUTED) SELECT l_returnflag, count(*)"
+        " FROM lineitem GROUP BY l_returnflag").rows[0][0]
+    via = [ln for ln in text.splitlines() if "via " in ln]
+    assert via, text
+    for ln in via:
+        assert re.search(r"~\d+ rows", ln), ln
+
+
+def test_completed_event_carries_worst_ratio():
+    runner, history = make_runner()
+    runner.session.set("collect_stats", True)
+    res = runner.execute("SELECT count(*) FROM lineitem"
+                         " WHERE l_quantity < 10")
+    assert res.worst_estimate_ratio is not None
+    assert res.worst_estimate_ratio >= 1.0
+    e = history.completed[-1]
+    assert e.worst_estimate_ratio == res.worst_estimate_ratio
+
+
+# ---------------------------------------------------------------------------
+# distributed actuals roll-up (the silently-absent-stats regression pin)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dqr():
+    rig = DistributedQueryRunner(n_workers=3, sf=0.01, split_rows=4096)
+    rig.multihost.min_stage_rows = 0
+    yield rig
+    rig.close()
+
+
+@pytest.mark.parametrize("qid", [3, 6])
+def test_multihost_actuals_match_local(dqr, qid):
+    """Worker-fragment per-operator stats used to be silently absent
+    from multihost EXPLAIN ANALYZE.  Pin the fix at the strongest
+    observable: every operator the local run records is present in the
+    distributed roll-up with identical output rows (structural keys
+    are cross-process, so the maps align key-for-key)."""
+    plan = dqr.runner.plan(QUERIES[qid])
+
+    dstats = QueryStats()
+    dqr.multihost.run(plan, stats=dstats)
+
+    lstats = QueryStats()
+    lstats.register_plan(plan)
+    dqr.runner.executor.stats = lstats
+    try:
+        dqr.runner.executor.run(plan)
+    finally:
+        dqr.runner.executor.stats = None
+
+    local = {k: s for k, s in lstats.by_key.items() if s["invocations"]}
+    dist = {k: s for k, s in dstats.by_key.items() if s["invocations"]}
+    assert local, "local run recorded nothing"
+    n = len(dqr.workers)
+    for key, s in local.items():
+        assert key in dist, f"distributed stats missing {key}"
+        # broadcast build chains run replicated on every worker, so
+        # their cluster-wide row total is exactly n_workers x the
+        # local count (summed-across-tasks, like the reference's
+        # EXPLAIN ANALYZE); everything else must match one-for-one
+        assert dist[key]["rows"] in (s["rows"], n * s["rows"]), \
+            f"q{qid} {key}: dist {dist[key]['rows']} != local {s['rows']}"
+    # and the merged stats render real actuals in the ANALYZE text
+    text = dqr.runner.executor.explain_with_stats(plan, dstats)
+    assert "est:" in text and "actual:" in text
+
+
+# ---------------------------------------------------------------------------
+# feedback loop: observed actuals change the replan
+# ---------------------------------------------------------------------------
+
+def _probe_side(explain_text):
+    """The first child line under the Join (the probe side)."""
+    lines = explain_text.splitlines()
+    for i, ln in enumerate(lines):
+        if "- Join" in ln:
+            return lines[i + 1].strip()
+    raise AssertionError(f"no join in plan:\n{explain_text}")
+
+
+def test_feedback_stats_corrects_build_side():
+    """A/B on an engineered misestimate: every row shares one join key,
+    so the join output explodes to 100x150 = 15000 rows while the
+    textbook rule (no NDV stats) says max(100, 150) = 150.  With
+    feedback_stats the cost-based orderer re-costs the orientations
+    against the observed cardinality and flips the probe/build sides —
+    the replan measurably changes."""
+    r = LocalQueryRunner()
+    r.execute("CREATE TABLE mem.fx AS SELECT l_orderkey * 0 AS j"
+              " FROM tpch.lineitem LIMIT 100")
+    r.execute("CREATE TABLE mem.fy AS SELECT l_orderkey * 0 AS j,"
+              " l_orderkey AS k FROM tpch.lineitem LIMIT 150")
+    sql = "SELECT count(*) FROM mem.fx x JOIN mem.fy y ON x.j = y.j"
+
+    before = r.execute("EXPLAIN " + sql).rows[0][0]
+    assert "TableScan fx" in _probe_side(before), before
+
+    # execute under collect_stats: actuals feed the history store
+    r.session.set("collect_stats", True)
+    res = r.execute(sql)
+    r.session.set("collect_stats", False)
+    assert res.rows[0][0] == 15000
+    assert res.worst_estimate_ratio >= 8.0  # the engineered misestimate
+    joins = [e for e in default_history().rows()
+             if e["node"] == "JoinNode"]
+    assert joins and joins[0]["rows_last"] == 15000
+    assert joins[0]["ratio_last"] >= 8.0
+
+    # replan under feedback: the observed 15000-row output re-costs the
+    # executed orientation and the probe side flips (trailing spaces
+    # dodge the plan cache, which keys on statement text)
+    r.session.set("feedback_stats", True)
+    after = r.execute("EXPLAIN " + sql + " ").rows[0][0]
+    assert "TableScan fy" in _probe_side(after), after
+    assert before != after
+
+    # feedback off again: the textbook plan comes back
+    r.session.set("feedback_stats", False)
+    again = r.execute("EXPLAIN " + sql + "  ").rows[0][0]
+    assert "TableScan fx" in _probe_side(again), again

@@ -385,7 +385,7 @@ def test_fallback_counted_and_reason_recorded(cluster):
 
     orig = multi._run_distributed
     try:
-        def raising(p):
+        def raising(p, qstats=None):
             raise MultiHostUnsupported("forced for the fallback test")
         multi._run_distributed = raising
         res = multi.run(plan)

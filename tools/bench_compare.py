@@ -14,7 +14,11 @@ previous one:
 - the geomean ratio over the common query set,
 - the query doctor's top finding for each flagged regression, when the
   new round's payload carries a ``doctor`` map (benchmark_driver rows
-  include one) — the diagnosed bottleneck prints under the flag.
+  include one) — the diagnosed bottleneck prints under the flag,
+- the worst estimate-vs-actual ratio for each flagged regression, when
+  the new round carries a ``misestimates`` map ({query: ratio};
+  benchmark_driver rows ship ``worst_estimate_ratio``) — a planner
+  misestimate prints as a candidate cause next to the drop.
 
 Exit code: 0 always in report mode (`tools/ci.sh` runs it as a
 non-fatal step); ``--strict`` exits 1 when a regression is flagged.
@@ -116,6 +120,15 @@ def compare(old: dict, new: dict, threshold: float = 0.2) -> dict:
             doc = (new.get("doctor") or {}).get(q)
             if isinstance(doc, dict) and doc.get("rule"):
                 entry["doctor"] = doc
+            # the worst estimate-vs-actual ratio of the NEW round, when
+            # the payload carries a ``misestimates`` map ({query:
+            # ratio} — benchmark_driver rows ship worst_estimate_ratio)
+            mis = (new.get("misestimates") or {}).get(q)
+            if mis is not None:
+                try:
+                    entry["misestimate"] = round(float(mis), 2)
+                except (TypeError, ValueError):
+                    pass
             rows.append(entry)
     common_tpch = sorted(set(old.get("rates") or {})
                          & set(new.get("rates") or {}))
@@ -149,6 +162,10 @@ def report(old_path: str, new_path: str, result: dict,
             d = e["doctor"]
             lines.append(f"           doctor: {d['rule']} "
                          f"(score {d['score']:.2f}): {d['summary']}")
+        if e.get("regression") and e.get("misestimate") is not None:
+            lines.append(f"           misestimate: worst est-vs-actual "
+                         f"x{e['misestimate']:.1f} — stale stats may "
+                         "explain the drop (try feedback_stats)")
     if result["geomean_ratio"] is not None:
         lines.append(f"  geomean ratio (tpch common set): "
                      f"{result['geomean_ratio']:.3f}x")

@@ -491,7 +491,17 @@ def main():
     for name, sql in suite:
         try:
             t0 = time.perf_counter()
-            res = runner.execute(sql)
+            # estimate-vs-actual: per-operator stats on the WARMUP run
+            # only (session.set, not SET SESSION — an executor rebuild
+            # here would discard the warmed compile caches, and the
+            # per-page device sync must not perturb the timed runs).
+            # The worst misestimate ratio rides the row so
+            # bench_compare can print it next to a flagged regression.
+            runner.session.set("collect_stats", True)
+            try:
+                res = runner.execute(sql)
+            finally:
+                runner.session.set("collect_stats", False)
             warmup = time.perf_counter() - t0
             # variance protocol (VERDICT weak #3): --repeat independent
             # measurement blocks of --runs timed runs each.  The
@@ -530,6 +540,9 @@ def main():
             }
             if top is not None:
                 row["doctor"] = top
+            wr = getattr(res, "worst_estimate_ratio", None)
+            if wr is not None:
+                row["worst_estimate_ratio"] = round(float(wr), 2)
         except Exception as e:
             row = {"query": name, "error": f"{type(e).__name__}: {e}"}
         results.append(row)

@@ -117,6 +117,43 @@ print(f"doctor smoke: {len(history.completed)} queries diagnosed, "
       "ring sampled, sampler stopped clean")
 EOF
 
+echo "== estimate-vs-actual / plan-history smoke =================="
+# the estimate-vs-actual loop end-to-end: EXPLAIN ANALYZE renders
+# est/actual per operator, the plan-history store round-trips across
+# a re-open with its incarnation preserved, and the doctor's
+# misestimate rule fires on an engineered ratio
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile, os
+from presto_tpu.obs import doctor
+from presto_tpu.obs.history import PlanHistoryStore, history_path, set_default_history
+from presto_tpu.obs.timeseries import QueryTimeline
+from presto_tpu.testing import LocalQueryRunner
+
+set_default_history(None)
+runner = LocalQueryRunner()
+res = runner.execute(
+    "EXPLAIN ANALYZE select count(*) from lineitem where l_quantity < 10")
+text = res.rows[0][0]
+assert "est:" in text and "actual:" in text, text
+
+root = tempfile.mkdtemp(prefix="ci_plan_history_")
+store = PlanHistoryStore(history_path(root))
+store.observe("FilterNode", "abc123", 500, est_rows=10.0)
+store.save()
+reopened = PlanHistoryStore(history_path(root))
+assert reopened.incarnation == store.incarnation, "incarnation lost"
+assert reopened.observed_rows("FilterNode", "abc123") == 500.0
+
+tl = QueryTimeline("ci-misest")
+tl.annotate("worst_estimate",
+            {"ratio": 50.0, "node": "FilterNode", "est": 10.0, "actual": 500})
+findings = doctor.diagnose(timeline=tl, wall_ms=100.0)
+assert any(f.rule == "misestimate" for f in findings), findings
+set_default_history(None)
+print("estimate-vs-actual smoke: explain annotated, store round-tripped, "
+      "misestimate rule fired")
+EOF
+
 echo "== concurrent split-scheduler leg ==========================="
 # a fast tier-1 subset under PRESTO_TPU_TASK_CONCURRENCY=4: the morsel
 # scheduler's threaded path (scan chains, spill/memory interaction,
